@@ -1,0 +1,12 @@
+"""dynamo-tpu: a TPU-native distributed LLM inference serving framework.
+
+A ground-up re-design of the capabilities of NVIDIA Dynamo (reference mounted
+at /root/reference; see SURVEY.md) for TPU hardware: OpenAI-compatible
+frontend, disaggregated prefill/decode serving, KV-aware routing over a global
+radix index, paged KV-block management with host offload — with the inference
+engine implemented in JAX/XLA/Pallas (pjit-sharded prefill/decode, Pallas
+paged attention, ICI/DCN KV handoff) instead of delegating to external GPU
+engine subprocesses.
+"""
+
+__version__ = "0.1.0"

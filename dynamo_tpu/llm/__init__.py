@@ -1,0 +1,1 @@
+"""LLM library layer (reference: lib/llm, the dynamo-llm crate)."""

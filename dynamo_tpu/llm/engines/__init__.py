@@ -1,0 +1,3 @@
+from .echo import EchoEngineCore, EchoEngineFull
+
+__all__ = ["EchoEngineCore", "EchoEngineFull"]

@@ -1,0 +1,4 @@
+from .metrics import InflightGuard, ServiceMetrics
+from .service import HttpService, ModelManager
+
+__all__ = ["HttpService", "ModelManager", "ServiceMetrics", "InflightGuard"]

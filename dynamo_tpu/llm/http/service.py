@@ -1,0 +1,258 @@
+"""OpenAI-compatible HTTP frontend.
+
+Reference: the axum service in lib/llm/src/http/service/{service_v2.rs:24-132,
+openai.rs:132-528, error.rs} — `/v1/chat/completions`, `/v1/completions`,
+`/v1/models`, `/metrics`, `/health`; SSE streaming with a client-disconnect
+monitor that calls `ctx.kill()`; a `ModelManager` of named engines that
+discovery can add/remove at runtime.
+
+Implementation is aiohttp (asyncio-native streaming + backpressure); engines
+are anything implementing `AsyncEngine[openai-request-dict, Annotated[chunk]]`
+— an in-process pipeline, a JAX engine, or a remote client over the request
+plane, interchangeably.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Dict, Optional
+
+from aiohttp import web
+
+from ...runtime.engine import AsyncEngine, Context, EngineContext
+from ..protocols.annotated import Annotated
+from ..protocols.openai import (aggregate_chat_stream,
+                                aggregate_completion_stream)
+from ..protocols.sse import encode_annotated, encode_done
+from .metrics import ServiceMetrics
+
+logger = logging.getLogger("dynamo_tpu.http")
+
+
+class ModelManager:
+    """Named engine registry (reference `ModelManager`, service_v2.rs)."""
+
+    def __init__(self) -> None:
+        self._chat: Dict[str, AsyncEngine] = {}
+        self._completion: Dict[str, AsyncEngine] = {}
+        self._cards: Dict[str, dict] = {}
+
+    def add_chat_model(self, name: str, engine: AsyncEngine,
+                       card: Optional[dict] = None) -> None:
+        self._chat[name] = engine
+        self._cards.setdefault(name, card or {})
+
+    def add_completion_model(self, name: str, engine: AsyncEngine,
+                             card: Optional[dict] = None) -> None:
+        self._completion[name] = engine
+        self._cards.setdefault(name, card or {})
+
+    def remove_model(self, name: str) -> None:
+        self._chat.pop(name, None)
+        self._completion.pop(name, None)
+        self._cards.pop(name, None)
+
+    def chat_engine(self, name: str) -> Optional[AsyncEngine]:
+        return self._chat.get(name)
+
+    def completion_engine(self, name: str) -> Optional[AsyncEngine]:
+        return self._completion.get(name)
+
+    def list_models(self) -> list:
+        return sorted(set(self._chat) | set(self._completion))
+
+
+def _chunk_token_count(chunk) -> int:
+    """Text-bearing choices in an OpenAI chunk (for the output-token metric)."""
+    if not isinstance(chunk, dict):
+        return 0
+    n = 0
+    for choice in chunk.get("choices") or []:
+        delta = choice.get("delta")
+        if delta is not None:
+            if delta.get("content"):
+                n += 1
+        elif choice.get("text"):
+            n += 1
+    return n
+
+
+def _error_response(status: int, message: str, err_type: str = "invalid_request_error"):
+    return web.json_response(
+        {"error": {"message": message, "type": err_type, "code": status}},
+        status=status)
+
+
+class HttpService:
+    """The frontend server (reference `HttpService` service_v2 builder)."""
+
+    def __init__(self, port: int = 8080, host: str = "0.0.0.0",
+                 manager: Optional[ModelManager] = None,
+                 metrics: Optional[ServiceMetrics] = None):
+        self.port = port
+        self.host = host
+        self.manager = manager or ModelManager()
+        self.metrics = metrics or ServiceMetrics()
+        self.app = web.Application()
+        self.app.router.add_post("/v1/chat/completions", self._chat)
+        self.app.router.add_post("/v1/completions", self._completions)
+        self.app.router.add_get("/v1/models", self._models)
+        self.app.router.add_get("/metrics", self._metrics)
+        self.app.router.add_get("/health", self._health)
+        self.app.router.add_get("/live", self._health)
+        self._runner: Optional[web.AppRunner] = None
+        self._site: Optional[web.TCPSite] = None
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, self.host, self.port)
+        await self._site.start()
+        if self.port == 0:
+            # pick up the ephemeral port for tests
+            self.port = self._site._server.sockets[0].getsockname()[1]  # type: ignore
+        logger.info("HTTP service listening on %s:%s", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def run_forever(self) -> None:
+        await self.start()
+        try:
+            while True:
+                await asyncio.sleep(3600)
+        finally:
+            await self.stop()
+
+    # ------------------------------------------------------------- handlers
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy",
+                                  "models": self.manager.list_models()})
+
+    async def _models(self, request: web.Request) -> web.Response:
+        now = int(time.time())
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": m, "object": "model", "created": now,
+                      "owned_by": "dynamo-tpu"}
+                     for m in self.manager.list_models()],
+        })
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.metrics.render(),
+                            content_type="text/plain", charset="utf-8")
+
+    async def _chat(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle(request, "chat_completions")
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._handle(request, "completions")
+
+    async def _handle(self, request: web.Request,
+                      endpoint: str) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as e:
+            return _error_response(400, f"invalid JSON body: {e}")
+        model = body.get("model")
+        if not model:
+            return _error_response(400, "missing 'model'")
+        is_chat = endpoint == "chat_completions"
+        engine = (self.manager.chat_engine(model) if is_chat
+                  else self.manager.completion_engine(model))
+        if engine is None:
+            return _error_response(
+                404, f"model '{model}' not found", "model_not_found")
+        streaming = bool(body.get("stream", False))
+        guard = self.metrics.inflight_guard(model, endpoint, streaming)
+        ectx = EngineContext()
+        try:
+            stream = await engine.generate(Context(body, ectx))
+        except ValueError as e:
+            guard.close()
+            return _error_response(400, str(e))
+        except Exception as e:  # noqa: BLE001 — engine boundary
+            logger.exception("engine error on %s", endpoint)
+            guard.close()
+            return _error_response(500, f"engine error: {e}", "internal_error")
+
+        if streaming:
+            include_usage = bool((body.get("stream_options") or {})
+                                 .get("include_usage"))
+            return await self._stream_sse(request, stream, ectx, guard,
+                                          include_usage)
+        return await self._unary(stream, ectx, guard, is_chat)
+
+    async def _unary(self, stream, ectx: EngineContext, guard,
+                     is_chat: bool) -> web.Response:
+        try:
+            folded = await (aggregate_chat_stream(stream) if is_chat
+                            else aggregate_completion_stream(stream))
+            guard.mark_ok()
+            return web.json_response(folded)
+        except RuntimeError as e:
+            return _error_response(500, str(e), "internal_error")
+        finally:
+            guard.close()
+
+    async def _stream_sse(self, request: web.Request, stream,
+                          ectx: EngineContext, guard,
+                          include_usage: bool) -> web.StreamResponse:
+        resp = web.StreamResponse(status=200, headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+            "X-Accel-Buffering": "no",
+        })
+        await resp.prepare(request)
+
+        # Disconnect monitor (reference openai.rs:406): if the client goes
+        # away mid-stream, kill() the context so the engine frees its slot.
+        # aiohttp has no disconnect future, so poll the transport.
+        async def monitor():
+            while True:
+                await asyncio.sleep(0.25)
+                tr = request.transport
+                if tr is None or tr.is_closing():
+                    guard.mark_cancelled()
+                    ectx.kill()
+                    return
+
+        monitor_task = asyncio.create_task(monitor())
+        try:
+            async for ann in stream:
+                if not isinstance(ann, Annotated):
+                    ann = Annotated.from_data(ann)
+                chunk = ann.data
+                if isinstance(chunk, dict) and not include_usage:
+                    # usage chunks / piggybacked usage are opt-in for SSE
+                    if chunk.get("usage") is not None and not chunk.get("choices"):
+                        continue
+                    if "usage" in chunk:
+                        chunk = {k: v for k, v in chunk.items() if k != "usage"}
+                        ann = Annotated(data=chunk, id=ann.id, event=ann.event,
+                                        comment=ann.comment)
+                if _chunk_token_count(chunk):
+                    guard.note_token(_chunk_token_count(chunk))
+                try:
+                    await resp.write(encode_annotated(ann).encode())
+                except (ConnectionResetError, asyncio.CancelledError):
+                    guard.mark_cancelled()
+                    ectx.kill()
+                    return resp
+            if not ectx.is_killed:
+                try:
+                    await resp.write(encode_done().encode())
+                    guard.mark_ok()
+                except (ConnectionResetError, asyncio.CancelledError):
+                    guard.mark_cancelled()
+        finally:
+            monitor_task.cancel()
+            guard.close()
+        return resp

@@ -1,0 +1,264 @@
+"""OpenAI → engine-internal preprocessing (and the backward delta path).
+
+Reference: `OpenAIPreprocessor` (lib/llm/src/preprocessor.rs:63-303) plus the
+prompt-template machinery (preprocessor/prompt/template/{oai,tokcfg,formatters}.rs):
+render the HF chat template (jinja), tokenize, merge request sampling/stop
+options with the model's EOS ids, optionally emit `token_ids` /
+`formatted_prompt` annotations, and on the way back turn `BackendOutput`
+deltas into OpenAI streaming chunks.
+
+It is a pipeline :class:`Operator` on both the chat and completion types, so
+`link(OpenAIPreprocessor(mdc), Backend(mdc), engine)` is a full OpenAI engine.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import AsyncIterator, List, Optional
+
+import jinja2
+
+from ..runtime.engine import AsyncEngine, ManyOut, ResponseStream, SingleIn
+from ..runtime.pipeline import Operator
+from .model_card import ModelDeploymentCard
+from .protocols.annotated import Annotated
+from .protocols.common import (BackendOutput, FinishReason, OutputOptions,
+                               PreprocessedRequest, SamplingOptions,
+                               StopConditions)
+from .protocols.openai import (ChatCompletionRequest, ChatDeltaGenerator,
+                               CompletionDeltaGenerator, CompletionRequest,
+                               usage_dict)
+
+ANNOTATION_TOKEN_IDS = "token_ids"
+ANNOTATION_FORMATTED_PROMPT = "formatted_prompt"
+
+_FALLBACK_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|{{ message.role }}|>\n{{ message.content }}\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|assistant|>\n{% endif %}"
+)
+
+
+def _strftime_now(fmt: str) -> str:
+    return datetime.datetime.now().strftime(fmt)
+
+
+class PromptFormatter:
+    """HF chat-template renderer (reference template/oai.rs + formatters.rs)."""
+
+    def __init__(self, template: Optional[str], bos_token: str = "",
+                 eos_token: str = ""):
+        env = jinja2.Environment(
+            loader=jinja2.BaseLoader(),
+            trim_blocks=True, lstrip_blocks=True,
+            extensions=["jinja2.ext.loopcontrols"])
+        env.globals["raise_exception"] = self._raise
+        env.globals["strftime_now"] = _strftime_now
+        env.filters["tojson"] = lambda v, **kw: jinja2.filters.do_tojson(v, **kw)
+        self._env = env
+        self._template = env.from_string(template or _FALLBACK_TEMPLATE)
+        self.bos_token = bos_token
+        self.eos_token = eos_token
+
+    @staticmethod
+    def _raise(msg: str):
+        raise jinja2.TemplateError(msg)
+
+    def render(self, messages: List[dict], add_generation_prompt: bool = True,
+               tools: Optional[List[dict]] = None, **extra) -> str:
+        return self._template.render(
+            messages=messages,
+            add_generation_prompt=add_generation_prompt,
+            bos_token=self.bos_token, eos_token=self.eos_token,
+            tools=tools, **extra)
+
+
+class OpenAIPreprocessor(Operator):
+    """Chat/completions → PreprocessedRequest operator.
+
+    forward: validate + render + tokenize + merge options
+    backward: BackendOutput deltas → OpenAI chunks via the delta generators
+    """
+
+    def __init__(self, mdc: ModelDeploymentCard):
+        self.mdc = mdc
+        self.tokenizer = mdc.tokenizer()
+        bos = ""
+        if mdc.model_info.bos_token_id is not None:
+            bos = self.tokenizer.id_to_token(mdc.model_info.bos_token_id) or ""
+        eos = ""
+        if mdc.model_info.eos_token_ids:
+            eos = self.tokenizer.id_to_token(mdc.model_info.eos_token_ids[0]) or ""
+        self.formatter = PromptFormatter(
+            mdc.prompt_format.chat_template, bos_token=bos, eos_token=eos)
+
+    # ------------------------------------------------------------------ fwd
+    def preprocess_chat(self, req: ChatCompletionRequest) -> PreprocessedRequest:
+        use_raw = bool(req.nvext and req.nvext.use_raw_prompt)
+        if use_raw and len(req.messages) == 1:
+            prompt = req.messages[0].text()
+        else:
+            messages = []
+            for m in req.messages:
+                d = {"role": m.role, "content": m.text()}
+                if m.name:
+                    d["name"] = m.name
+                if m.tool_calls:
+                    d["tool_calls"] = m.tool_calls
+                messages.append(d)
+            prompt = self.formatter.render(messages, tools=req.tools)
+        token_ids = self.tokenizer.encode(prompt).ids
+        pre = self._common(req, token_ids, req.effective_max_tokens(),
+                           req.stop_list())
+        pre.annotations = list((req.nvext.annotations if req.nvext else None) or [])
+        self._formatted_prompt = prompt  # surfaced via annotation below
+        return pre
+
+    def preprocess_completion(self, req: CompletionRequest) -> PreprocessedRequest:
+        if isinstance(req.prompt, str):
+            token_ids = self.tokenizer.encode(req.prompt).ids
+        elif req.prompt and isinstance(req.prompt[0], int):
+            token_ids = list(req.prompt)  # pre-tokenized
+        else:
+            raise ValueError("batch prompts must be fanned out before preprocessing")
+        pre = self._common(req, token_ids, req.max_tokens, req.stop_list())
+        pre.annotations = list((req.nvext.annotations if req.nvext else None) or [])
+        return pre
+
+    def _common(self, req, token_ids: List[int], max_tokens: Optional[int],
+                stops: List[str]) -> PreprocessedRequest:
+        info = self.mdc.model_info
+        budget = info.context_length - len(token_ids)
+        if budget <= 0:
+            raise ValueError(
+                f"prompt length {len(token_ids)} exceeds model context "
+                f"{info.context_length}")
+        nvext = getattr(req, "nvext", None)
+        ignore_eos = bool(nvext and nvext.ignore_eos)
+        stop_conditions = StopConditions(
+            max_tokens=min(max_tokens, budget) if max_tokens is not None else budget,
+            stop=stops or None,
+            stop_token_ids_hidden=list(info.eos_token_ids),
+            ignore_eos=ignore_eos,
+        )
+        stop_conditions.apply_ignore_eos()
+        sampling = SamplingOptions(
+            n=getattr(req, "n", 1) or 1,
+            temperature=req.temperature,
+            top_p=req.top_p,
+            top_k=(nvext.top_k if nvext else None),
+            seed=req.seed,
+            frequency_penalty=req.frequency_penalty,
+            presence_penalty=req.presence_penalty,
+            repetition_penalty=(nvext.repetition_penalty if nvext else None),
+            greedy=bool(nvext and nvext.greed_sampling),
+        )
+        # chat: `logprobs` is a bool + `top_logprobs` a count;
+        # completions: `logprobs` IS the count.
+        want = getattr(req, "logprobs", None)
+        if isinstance(want, bool):
+            n_logprobs = (getattr(req, "top_logprobs", None) or 1) if want else None
+        else:
+            n_logprobs = want
+        output = OutputOptions(logprobs=n_logprobs)
+        return PreprocessedRequest(
+            token_ids=token_ids,
+            stop_conditions=stop_conditions,
+            sampling_options=sampling,
+            output_options=output,
+            eos_token_ids=list(info.eos_token_ids),
+            mdc_sum=None,
+        )
+
+    # ------------------------------------------------------------- operator
+    async def generate(self, request: SingleIn, next_engine: AsyncEngine) -> ManyOut:
+        req = request.data
+        if isinstance(req, dict):
+            req = (ChatCompletionRequest.model_validate(req)
+                   if "messages" in req else CompletionRequest.model_validate(req))
+        is_chat = isinstance(req, ChatCompletionRequest)
+        pre = (self.preprocess_chat(req) if is_chat
+               else self.preprocess_completion(req))
+        prompt_len = len(pre.token_ids)
+        annotations: List[Annotated] = []
+        if ANNOTATION_TOKEN_IDS in pre.annotations:
+            annotations.append(Annotated.from_annotation(
+                ANNOTATION_TOKEN_IDS, pre.token_ids))
+        if is_chat and ANNOTATION_FORMATTED_PROMPT in pre.annotations:
+            annotations.append(Annotated.from_annotation(
+                ANNOTATION_FORMATTED_PROMPT, self._formatted_prompt))
+
+        downstream = await next_engine.generate(request.transfer(pre))
+
+        gen = (ChatDeltaGenerator(req.model, request_id=f"chatcmpl-{request.id}")
+               if is_chat else
+               CompletionDeltaGenerator(req.model, request_id=f"cmpl-{request.id}"))
+
+        async def backward() -> AsyncIterator[Annotated[dict]]:
+            for ann in annotations:
+                yield ann
+            completion_tokens = 0
+            finished = False
+            async for item in downstream:
+                if isinstance(item, Annotated):
+                    if item.data is None:
+                        yield item  # pass through errors/annotations
+                        continue
+                    out: BackendOutput = item.data
+                else:
+                    out = item
+                completion_tokens += len(out.token_ids)
+                text = out.text
+                if text is None and out.tokens:
+                    text = "".join(out.tokens)
+                logprobs_payload = _format_logprobs(out, is_chat)
+                if text:
+                    yield Annotated.from_data(
+                        gen.text_chunk(text, logprobs=logprobs_payload))
+                elif logprobs_payload is not None:
+                    yield Annotated.from_data(
+                        gen.text_chunk("", logprobs=logprobs_payload))
+                if out.finish_reason is not None:
+                    finished = True
+                    if is_chat:
+                        yield Annotated.from_data(gen.finish_chunk(out.finish_reason))
+                        # Usage always rides the stream; the HTTP layer drops
+                        # it for SSE clients that didn't opt in, and the unary
+                        # aggregator folds it into the response.
+                        yield Annotated.from_data(
+                            gen.usage_chunk(prompt_len, completion_tokens))
+                    else:
+                        yield Annotated.from_data(gen.finish_chunk(
+                            out.finish_reason,
+                            usage=usage_dict(prompt_len, completion_tokens)))
+            if not finished and not request.ctx.is_killed:
+                reason = (FinishReason.CANCELLED if request.ctx.is_stopped
+                          else FinishReason.STOP)
+                if is_chat:
+                    yield Annotated.from_data(gen.finish_chunk(reason))
+                    yield Annotated.from_data(
+                        gen.usage_chunk(prompt_len, completion_tokens))
+                else:
+                    yield Annotated.from_data(gen.finish_chunk(
+                        reason, usage=usage_dict(prompt_len, completion_tokens)))
+
+        return ResponseStream(backward(), request.ctx)
+
+
+def _format_logprobs(out: BackendOutput, is_chat: bool) -> Optional[dict]:
+    if out.log_probs is None:
+        return None
+    if is_chat:
+        content = []
+        for i, lp in enumerate(out.log_probs):
+            tok = (out.tokens[i] if out.tokens and i < len(out.tokens) else "")
+            entry = {"token": tok, "logprob": lp, "top_logprobs": []}
+            if out.top_logprobs and i < len(out.top_logprobs):
+                entry["top_logprobs"] = [
+                    {"token": str(t), "logprob": p}
+                    for t, p in out.top_logprobs[i].items()]
+            content.append(entry)
+        return {"content": content}
+    return {"token_logprobs": list(out.log_probs),
+            "tokens": list(out.tokens or [])}

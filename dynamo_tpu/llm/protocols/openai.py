@@ -1,0 +1,345 @@
+"""OpenAI wire protocol: requests, responses, streaming deltas, aggregation.
+
+Reference: lib/llm/src/protocols/openai/{chat_completions,completions}.rs with
+their delta generators and SSE aggregators (delta.rs, aggregator.rs:32-113 test
+semantics) and nvext.rs:28-193. Pydantic models give request validation at the
+HTTP edge; everything internal stays dataclass/dict.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Literal, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from .annotated import Annotated
+from .common import FinishReason
+
+# ---------------------------------------------------------------------------
+# nvext — framework extension fields (reference nvext.rs:28-193)
+# ---------------------------------------------------------------------------
+
+
+class NvExt(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    ignore_eos: Optional[bool] = None
+    use_raw_prompt: Optional[bool] = None
+    annotations: Optional[List[str]] = None
+    greed_sampling: Optional[bool] = None
+    top_k: Optional[int] = None
+    repetition_penalty: Optional[float] = None
+
+
+# ---------------------------------------------------------------------------
+# Requests
+# ---------------------------------------------------------------------------
+
+
+class ChatMessage(BaseModel):
+    model_config = ConfigDict(extra="allow")
+
+    role: str
+    content: Optional[Union[str, List[Dict[str, Any]]]] = None
+    name: Optional[str] = None
+    tool_calls: Optional[List[Dict[str, Any]]] = None
+    tool_call_id: Optional[str] = None
+
+    def text(self) -> str:
+        if self.content is None:
+            return ""
+        if isinstance(self.content, str):
+            return self.content
+        parts = []
+        for part in self.content:
+            if part.get("type") == "text":
+                parts.append(part.get("text", ""))
+        return "".join(parts)
+
+
+class StreamOptions(BaseModel):
+    include_usage: Optional[bool] = None
+
+
+class ChatCompletionRequest(BaseModel):
+    """`POST /v1/chat/completions` body (reference
+    NvCreateChatCompletionRequest: async-openai CreateChatCompletionRequest +
+    nvext)."""
+
+    model_config = ConfigDict(extra="allow")
+
+    model: str
+    messages: List[ChatMessage]
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    n: Optional[int] = 1
+    stream: Optional[bool] = False
+    stream_options: Optional[StreamOptions] = None
+    stop: Optional[Union[str, List[str]]] = None
+    max_tokens: Optional[int] = None
+    max_completion_tokens: Optional[int] = None
+    presence_penalty: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    logit_bias: Optional[Dict[str, float]] = None
+    logprobs: Optional[bool] = None
+    top_logprobs: Optional[int] = None
+    user: Optional[str] = None
+    seed: Optional[int] = None
+    tools: Optional[List[Dict[str, Any]]] = None
+    tool_choice: Optional[Union[str, Dict[str, Any]]] = None
+    parallel_tool_calls: Optional[bool] = None
+    response_format: Optional[Dict[str, Any]] = None
+    nvext: Optional[NvExt] = None
+
+    def stop_list(self) -> List[str]:
+        if self.stop is None:
+            return []
+        return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+    def effective_max_tokens(self) -> Optional[int]:
+        if self.max_completion_tokens is not None:
+            return self.max_completion_tokens
+        return self.max_tokens
+
+
+class CompletionRequest(BaseModel):
+    """`POST /v1/completions` body."""
+
+    model_config = ConfigDict(extra="allow")
+
+    model: str
+    prompt: Union[str, List[str], List[int], List[List[int]]]
+    suffix: Optional[str] = None
+    max_tokens: Optional[int] = 16
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    n: Optional[int] = 1
+    stream: Optional[bool] = False
+    stream_options: Optional[StreamOptions] = None
+    logprobs: Optional[int] = None
+    echo: Optional[bool] = False
+    stop: Optional[Union[str, List[str]]] = None
+    presence_penalty: Optional[float] = None
+    frequency_penalty: Optional[float] = None
+    best_of: Optional[int] = None
+    user: Optional[str] = None
+    seed: Optional[int] = None
+    nvext: Optional[NvExt] = None
+
+    def stop_list(self) -> List[str]:
+        if self.stop is None:
+            return []
+        return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+
+# ---------------------------------------------------------------------------
+# Responses (plain dict builders — hot path, no pydantic validation cost)
+# ---------------------------------------------------------------------------
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+
+
+class ChatDeltaGenerator:
+    """Builds `chat.completion.chunk` dicts from engine text deltas.
+
+    Reference: the chat delta generator (protocols/openai/chat_completions/delta.rs).
+    One generator per request; emits the role-bearing first chunk lazily.
+    """
+
+    def __init__(self, model: str, request_id: Optional[str] = None,
+                 n_choices: int = 1):
+        self.id = request_id or f"chatcmpl-{uuid.uuid4().hex}"
+        self.model = model
+        self.created = _now()
+        self.n_choices = n_choices
+        self._sent_role = [False] * n_choices
+        self.object = "chat.completion.chunk"
+
+    def _chunk(self, choices: List[dict], usage: Optional[dict] = None) -> dict:
+        out = {
+            "id": self.id,
+            "object": self.object,
+            "created": self.created,
+            "model": self.model,
+            "choices": choices,
+        }
+        if usage is not None:
+            out["usage"] = usage
+        return out
+
+    def role_chunk(self, index: int = 0) -> dict:
+        self._sent_role[index] = True
+        return self._chunk([{
+            "index": index,
+            "delta": {"role": "assistant", "content": ""},
+            "finish_reason": None,
+        }])
+
+    def text_chunk(self, text: str, index: int = 0,
+                   logprobs: Optional[dict] = None) -> dict:
+        delta: dict = {"content": text}
+        if not self._sent_role[index]:
+            delta["role"] = "assistant"
+            self._sent_role[index] = True
+        choice: dict = {"index": index, "delta": delta, "finish_reason": None}
+        if logprobs is not None:
+            choice["logprobs"] = logprobs
+        return self._chunk([choice])
+
+    def finish_chunk(self, reason: FinishReason, index: int = 0) -> dict:
+        return self._chunk([{
+            "index": index,
+            "delta": {},
+            "finish_reason": reason.to_openai(),
+        }])
+
+    def usage_chunk(self, prompt_tokens: int, completion_tokens: int) -> dict:
+        return self._chunk([], usage=usage_dict(prompt_tokens, completion_tokens))
+
+
+class CompletionDeltaGenerator:
+    """Builds `text_completion` streaming chunks."""
+
+    def __init__(self, model: str, request_id: Optional[str] = None):
+        self.id = request_id or f"cmpl-{uuid.uuid4().hex}"
+        self.model = model
+        self.created = _now()
+        self.object = "text_completion"
+
+    def text_chunk(self, text: str, index: int = 0,
+                   logprobs: Optional[dict] = None) -> dict:
+        choice: dict = {"index": index, "text": text, "finish_reason": None}
+        if logprobs is not None:
+            choice["logprobs"] = logprobs
+        return {
+            "id": self.id, "object": self.object, "created": self.created,
+            "model": self.model, "choices": [choice],
+        }
+
+    def finish_chunk(self, reason: FinishReason, index: int = 0,
+                     usage: Optional[dict] = None) -> dict:
+        out = {
+            "id": self.id, "object": self.object, "created": self.created,
+            "model": self.model,
+            "choices": [{"index": index, "text": "", "finish_reason": reason.to_openai()}],
+        }
+        if usage is not None:
+            out["usage"] = usage
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Aggregators: fold a stream of chunks back into a unary response
+# (reference protocols/openai/*/aggregator.rs; conformance: tests/aggregators.rs)
+# ---------------------------------------------------------------------------
+
+
+async def aggregate_chat_stream(stream) -> dict:
+    """Fold `Annotated[chunk-dict]` into one `chat.completion` response."""
+    base: Optional[dict] = None
+    texts: Dict[int, List[str]] = {}
+    roles: Dict[int, str] = {}
+    finish: Dict[int, Optional[str]] = {}
+    tool_calls: Dict[int, list] = {}
+    usage: Optional[dict] = None
+    async for ann in stream:
+        if isinstance(ann, Annotated):
+            if ann.is_error:
+                raise RuntimeError(ann.error_message())
+            chunk = ann.data
+        else:
+            chunk = ann
+        if chunk is None:
+            continue
+        if base is None:
+            base = {k: chunk.get(k) for k in ("id", "created", "model")}
+        if chunk.get("usage"):
+            usage = chunk["usage"]
+        for choice in chunk.get("choices", []):
+            idx = choice.get("index", 0)
+            delta = choice.get("delta", {})
+            if delta.get("role"):
+                roles[idx] = delta["role"]
+            if delta.get("content"):
+                texts.setdefault(idx, []).append(delta["content"])
+            if delta.get("tool_calls"):
+                tool_calls.setdefault(idx, []).extend(delta["tool_calls"])
+            if choice.get("finish_reason"):
+                finish[idx] = choice["finish_reason"]
+    if base is None:
+        raise RuntimeError("empty response stream")
+    indices = sorted(set(texts) | set(finish) | set(roles) | {0})
+    choices = []
+    for idx in indices:
+        message: dict = {
+            "role": roles.get(idx, "assistant"),
+            "content": "".join(texts.get(idx, [])),
+        }
+        if tool_calls.get(idx):
+            message["tool_calls"] = tool_calls[idx]
+        choices.append({
+            "index": idx,
+            "message": message,
+            "finish_reason": finish.get(idx, "stop"),
+        })
+    out = {
+        "id": base["id"], "object": "chat.completion",
+        "created": base["created"], "model": base["model"],
+        "choices": choices,
+    }
+    if usage is not None:
+        out["usage"] = usage
+    return out
+
+
+async def aggregate_completion_stream(stream) -> dict:
+    base: Optional[dict] = None
+    texts: Dict[int, List[str]] = {}
+    finish: Dict[int, Optional[str]] = {}
+    usage: Optional[dict] = None
+    async for ann in stream:
+        if isinstance(ann, Annotated):
+            if ann.is_error:
+                raise RuntimeError(ann.error_message())
+            chunk = ann.data
+        else:
+            chunk = ann
+        if chunk is None:
+            continue
+        if base is None:
+            base = {k: chunk.get(k) for k in ("id", "created", "model")}
+        if chunk.get("usage"):
+            usage = chunk["usage"]
+        for choice in chunk.get("choices", []):
+            idx = choice.get("index", 0)
+            if choice.get("text"):
+                texts.setdefault(idx, []).append(choice["text"])
+            if choice.get("finish_reason"):
+                finish[idx] = choice["finish_reason"]
+    if base is None:
+        raise RuntimeError("empty response stream")
+    indices = sorted(set(texts) | set(finish) | {0})
+    out = {
+        "id": base["id"], "object": "text_completion",
+        "created": base["created"], "model": base["model"],
+        "choices": [{
+            "index": idx,
+            "text": "".join(texts.get(idx, [])),
+            "finish_reason": finish.get(idx, "stop"),
+        } for idx in indices],
+    }
+    if usage is not None:
+        out["usage"] = usage
+    return out

@@ -1,0 +1,11 @@
+"""Distributed runtime layer (reference: lib/runtime, the dynamo-runtime crate)."""
+
+from .engine import (AsyncEngine, Context, EngineContext, EngineFn, ManyOut,
+                     ResponseStream, SingleIn, engine_from_fn)
+from .pipeline import Operator, ServiceFrontend, link
+
+__all__ = [
+    "AsyncEngine", "Context", "EngineContext", "EngineFn", "ManyOut",
+    "ResponseStream", "SingleIn", "engine_from_fn",
+    "Operator", "ServiceFrontend", "link",
+]

@@ -1,0 +1,194 @@
+"""Core async-engine abstraction: the TPU-native analog of the reference's
+``AsyncEngine`` trait (reference: lib/runtime/src/engine.rs:47-168).
+
+Everything that produces a stream of responses from a single request — a model
+engine, a remote client, a whole pipeline — implements :class:`AsyncEngine`.
+Requests travel wrapped in a :class:`Context` (reference ``Context<T>``,
+lib/runtime/src/pipeline/context.rs) that carries a request id, metadata and a
+cancellation handle (:class:`EngineContext`, reference ``AsyncEngineContext``).
+
+Design notes (TPU-first): cancellation must be *step-granular* — an XLA
+computation cannot be interrupted mid-dispatch, so engines are required to poll
+``ctx.is_stopped`` between decode steps rather than rely on task cancellation.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import uuid
+from typing import (Any, AsyncIterator, Awaitable, Callable, Dict, Generic,
+                    Optional, TypeVar)
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+__all__ = [
+    "EngineContext",
+    "Context",
+    "SingleIn",
+    "ManyOut",
+    "ResponseStream",
+    "AsyncEngine",
+    "EngineFn",
+    "engine_from_fn",
+]
+
+
+class EngineContext:
+    """Cancellation + identity handle shared by a request and all streams
+    derived from it.
+
+    Mirrors the semantics of the reference's ``AsyncEngineContext``
+    (lib/runtime/src/engine.rs:47-100):
+
+    - ``stop_generating()`` — graceful: the engine should finish the current
+      step, emit what it has, and stop issuing new work.
+    - ``kill()`` — hard: downstream should drop the stream as soon as possible
+      (used by the HTTP layer when a client disconnects mid-SSE).
+    """
+
+    __slots__ = ("_id", "_stopped", "_killed", "_stop_event")
+
+    def __init__(self, request_id: Optional[str] = None):
+        self._id = request_id or uuid.uuid4().hex
+        self._stopped = False
+        self._killed = False
+        self._stop_event: Optional[asyncio.Event] = None
+
+    @property
+    def id(self) -> str:
+        return self._id
+
+    def stop_generating(self) -> None:
+        self._stopped = True
+        if self._stop_event is not None:
+            self._stop_event.set()
+
+    def kill(self) -> None:
+        self._killed = True
+        self.stop_generating()
+
+    @property
+    def is_stopped(self) -> bool:
+        return self._stopped
+
+    @property
+    def is_killed(self) -> bool:
+        return self._killed
+
+    async def stopped(self) -> None:
+        """Await until stop_generating()/kill() is called."""
+        if self._stop_event is None:
+            self._stop_event = asyncio.Event()
+            if self._stopped:
+                self._stop_event.set()
+        await self._stop_event.wait()
+
+
+class Context(Generic[T]):
+    """A request payload plus its engine context and metadata.
+
+    Reference ``Context<T>`` / ``SingleIn<T>``
+    (lib/runtime/src/pipeline/context.rs, pipeline.rs:41-68). ``map`` derives a
+    new payload while keeping id/cancellation; ``transfer`` swaps the payload
+    entirely (used at operator boundaries where the type changes).
+    """
+
+    __slots__ = ("data", "ctx", "metadata")
+
+    def __init__(self, data: T, ctx: Optional[EngineContext] = None,
+                 metadata: Optional[Dict[str, Any]] = None):
+        self.data = data
+        self.ctx = ctx or EngineContext()
+        self.metadata: Dict[str, Any] = metadata if metadata is not None else {}
+
+    @property
+    def id(self) -> str:
+        return self.ctx.id
+
+    def map(self, fn: Callable[[T], U]) -> "Context[U]":
+        return self.transfer(fn(self.data))
+
+    def transfer(self, data: U) -> "Context[U]":
+        return Context(data, self.ctx, self.metadata)
+
+
+# Type aliases matching the reference's pipeline vocabulary
+# (lib/runtime/src/pipeline.rs:41-68).
+SingleIn = Context
+
+
+class ResponseStream(Generic[U]):
+    """An async stream of responses bound to an :class:`EngineContext`.
+
+    Reference ``ResponseStream`` / ``ManyOut`` (lib/runtime/src/engine.rs:120-168).
+    Iteration stops early if the context is killed (not merely stopped: a
+    graceful stop lets the engine flush its tail).
+    """
+
+    def __init__(self, stream: AsyncIterator[U], ctx: EngineContext):
+        self._stream = stream
+        self.ctx = ctx
+
+    def __aiter__(self) -> AsyncIterator[U]:
+        return self._iter()
+
+    async def _iter(self) -> AsyncIterator[U]:
+        async for item in self._stream:
+            if self.ctx.is_killed:
+                break
+            yield item
+
+    async def collect(self) -> list:
+        return [item async for item in self]
+
+    def map(self, fn: Callable[[U], T]) -> "ResponseStream[T]":
+        async def gen() -> AsyncIterator[T]:
+            async for item in self._stream:
+                yield fn(item)
+
+        return ResponseStream(gen(), self.ctx)
+
+    @staticmethod
+    def from_iterable(items, ctx: EngineContext) -> "ResponseStream":
+        async def gen():
+            for item in items:
+                yield item
+
+        return ResponseStream(gen(), ctx)
+
+
+ManyOut = ResponseStream
+
+
+class AsyncEngine(abc.ABC, Generic[T, U]):
+    """The one core interface: ``generate(SingleIn[T]) -> ManyOut[U]``.
+
+    Reference trait ``AsyncEngine<Req, Resp, Err>`` (lib/runtime/src/engine.rs:104-118).
+    """
+
+    @abc.abstractmethod
+    async def generate(self, request: SingleIn[T]) -> ManyOut[U]:
+        ...
+
+
+class EngineFn(AsyncEngine[T, U]):
+    """Adapter: build an engine from ``async fn(Context[T]) -> AsyncIterator[U]``
+    (the closure-engine pattern used throughout the reference's tests,
+    lib/runtime/tests/common/engines.rs)."""
+
+    def __init__(self, fn: Callable[[SingleIn[T]], Any]):
+        self._fn = fn
+
+    async def generate(self, request: SingleIn[T]) -> ManyOut[U]:
+        result = self._fn(request)
+        if isinstance(result, Awaitable):
+            result = await result
+        if isinstance(result, ResponseStream):
+            return result
+        return ResponseStream(result, request.ctx)
+
+
+def engine_from_fn(fn: Callable[[SingleIn[T]], Any]) -> EngineFn:
+    return EngineFn(fn)

@@ -1,0 +1,37 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh so every
+sharding/collective path runs without TPU hardware (SURVEY.md §4: the
+reference tests multi-node with mock transports + no-GPU fixtures; our analog
+is XLA's forced host platform device count)."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+# The image has no pytest-asyncio; anyio (a httpx dependency) auto-registers
+# its pytest plugin, which runs coroutine tests and async fixtures. Auto-mark
+# every async test below so `@pytest.mark.asyncio` works as authored.
+
+
+@pytest.fixture
+def anyio_backend():
+    return "asyncio"
+
+
+@pytest.fixture(scope="session")
+def tiny_model_dir(tmp_path_factory):
+    """HF-style tiny model directory: trained byte-level BPE tokenizer +
+    config.json + chat template (the test-fixture analog of the reference's
+    lib/llm/tests/data/ pinned repos)."""
+    from tests.fixtures import build_tiny_model_dir
+    path = tmp_path_factory.mktemp("tiny-model")
+    build_tiny_model_dir(str(path))
+    return str(path)

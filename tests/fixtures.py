@@ -1,0 +1,86 @@
+"""Shared no-hardware test fixtures (SURVEY.md §4 patterns: fake engines,
+tiny local model repos, mock transports)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import AsyncIterator, List
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "hello world this is a tiny tokenizer corpus",
+    "deep speed serving with paged attention on tpu hardware",
+    "señor açaí naïve café résumé über straße",  # exercises multibyte UTF-8
+    "0123456789 !@#$%^&*() tokens and more tokens",
+    "STOP sequences and <|endoftext|> special markers",
+    "日本語のテキストも少し含める",
+]
+
+CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "{{ '<|' + message['role'] + '|>' }}{{ message['content'] }}{{ '<|end|>' }}"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}{{ '<|assistant|>' }}{% endif %}"
+)
+
+
+def build_tiny_tokenizer():
+    """Train a small byte-level BPE so incremental detokenization sees real
+    multi-byte merge behavior."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=512, special_tokens=["<|endoftext|>", "<|end|>",
+                                        "<|user|>", "<|assistant|>",
+                                        "<|system|>"],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet())
+    tok.train_from_iterator(CORPUS * 4, trainer)
+    return tok
+
+
+def build_tiny_model_dir(path: str, vocab_size: int = 512) -> str:
+    os.makedirs(path, exist_ok=True)
+    tok = build_tiny_tokenizer()
+    tok.save(os.path.join(path, "tokenizer.json"))
+    eos_id = tok.token_to_id("<|endoftext|>")
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump({
+            "model_type": "llama",
+            "max_position_embeddings": 2048,
+            "vocab_size": tok.get_vocab_size(),
+            "eos_token_id": eos_id,
+            "bos_token_id": None,
+            "hidden_size": 64,
+            "intermediate_size": 128,
+            "num_attention_heads": 4,
+            "num_key_value_heads": 2,
+            "num_hidden_layers": 2,
+            "rms_norm_eps": 1e-5,
+            "rope_theta": 10000.0,
+        }, f)
+    with open(os.path.join(path, "tokenizer_config.json"), "w") as f:
+        json.dump({"chat_template": CHAT_TEMPLATE,
+                   "eos_token": "<|endoftext|>"}, f)
+    return path
+
+
+class RecordingEngine:
+    """Closure-style fake engine (reference tests/common/engines.rs pattern):
+    records requests, replays a canned list of outputs."""
+
+    def __init__(self, outputs: List):
+        self.outputs = outputs
+        self.requests: List = []
+
+    async def generate(self, request):
+        from dynamo_tpu.runtime.engine import ResponseStream
+        self.requests.append(request)
+
+        async def gen() -> AsyncIterator:
+            for out in self.outputs:
+                yield out
+
+        return ResponseStream(gen(), request.ctx)

@@ -1,0 +1,142 @@
+"""HTTP frontend tests — analog of lib/llm/tests/http-service.rs:41-300:
+stub engines behind a live server, streaming + unary + error matrix +
+Prometheus counters."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.llm.engines.echo import EchoEngineCore, EchoEngineFull
+from dynamo_tpu.llm.http import HttpService
+from dynamo_tpu.llm.protocols.annotated import Annotated
+from dynamo_tpu.llm.protocols.sse import parse_sse_stream
+from dynamo_tpu.runtime import ResponseStream
+
+
+class AlwaysFailEngine:
+    async def generate(self, request):
+        raise RuntimeError("engine exploded")
+
+
+class ErrorStreamEngine:
+    async def generate(self, request):
+        async def gen():
+            yield Annotated.from_error("midstream failure")
+        return ResponseStream(gen(), request.ctx)
+
+
+@pytest.fixture
+async def service():
+    svc = HttpService(port=0, host="127.0.0.1")
+    svc.manager.add_chat_model("echo", EchoEngineFull())
+    svc.manager.add_completion_model("echo", EchoEngineFull())
+    svc.manager.add_chat_model("fail", AlwaysFailEngine())
+    svc.manager.add_chat_model("errstream", ErrorStreamEngine())
+    await svc.start()
+    yield svc
+    await svc.stop()
+
+
+def _url(svc, path):
+    return f"http://127.0.0.1:{svc.port}{path}"
+
+
+@pytest.mark.asyncio
+async def test_models_list(service):
+    async with aiohttp.ClientSession() as s:
+        async with s.get(_url(service, "/v1/models")) as r:
+            body = await r.json()
+    ids = [m["id"] for m in body["data"]]
+    assert "echo" in ids and body["object"] == "list"
+
+
+@pytest.mark.asyncio
+async def test_chat_unary(service):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(_url(service, "/v1/chat/completions"), json={
+            "model": "echo",
+            "messages": [{"role": "user", "content": "hello world"}],
+        }) as r:
+            assert r.status == 200
+            body = await r.json()
+    assert body["object"] == "chat.completion"
+    assert body["choices"][0]["message"]["content"].strip() == "hello world"
+    assert body["choices"][0]["finish_reason"] == "stop"
+
+
+@pytest.mark.asyncio
+async def test_chat_streaming_sse(service):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(_url(service, "/v1/chat/completions"), json={
+            "model": "echo", "stream": True,
+            "messages": [{"role": "user", "content": "a b c"}],
+        }) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            anns = [a async for a in parse_sse_stream(r.content.iter_any())]
+    chunks = [a.data for a in anns if a.data]
+    text = "".join(c["choices"][0]["delta"].get("content", "")
+                   for c in chunks if c.get("choices"))
+    assert text.strip() == "a b c"
+
+
+@pytest.mark.asyncio
+async def test_unknown_model_404(service):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(_url(service, "/v1/chat/completions"), json={
+            "model": "nope", "messages": [{"role": "user", "content": "x"}],
+        }) as r:
+            assert r.status == 404
+            body = await r.json()
+    assert body["error"]["type"] == "model_not_found"
+
+
+@pytest.mark.asyncio
+async def test_invalid_json_400(service):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(_url(service, "/v1/chat/completions"),
+                          data=b"{oops") as r:
+            assert r.status == 400
+
+
+@pytest.mark.asyncio
+async def test_engine_failure_500(service):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(_url(service, "/v1/chat/completions"), json={
+            "model": "fail", "messages": [{"role": "user", "content": "x"}],
+        }) as r:
+            assert r.status == 500
+
+
+@pytest.mark.asyncio
+async def test_midstream_error_surfaces_unary(service):
+    async with aiohttp.ClientSession() as s:
+        async with s.post(_url(service, "/v1/chat/completions"), json={
+            "model": "errstream",
+            "messages": [{"role": "user", "content": "x"}],
+        }) as r:
+            assert r.status == 500
+            body = await r.json()
+    assert "midstream failure" in body["error"]["message"]
+
+
+@pytest.mark.asyncio
+async def test_metrics_counters(service):
+    async with aiohttp.ClientSession() as s:
+        await s.post(_url(service, "/v1/chat/completions"), json={
+            "model": "echo", "messages": [{"role": "user", "content": "x"}]})
+        async with s.get(_url(service, "/metrics")) as r:
+            text = await r.text()
+    assert 'nv_llm_http_service_requests_total' in text
+    assert 'model="echo"' in text
+    assert 'status="success"' in text
+
+
+@pytest.mark.asyncio
+async def test_health(service):
+    async with aiohttp.ClientSession() as s:
+        async with s.get(_url(service, "/health")) as r:
+            body = await r.json()
+    assert body["status"] == "healthy" and "echo" in body["models"]

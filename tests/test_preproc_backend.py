@@ -1,0 +1,206 @@
+"""Preprocessor + detokenizing Backend tests against the tiny trained
+tokenizer (reference analogs: lib/llm/tests/preprocessor.rs snapshot tests,
+backend.rs in-module Decoder tests)."""
+
+import pytest
+
+from dynamo_tpu.llm.backend import Backend, Decoder, StopTrigger
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.protocols.annotated import Annotated
+from dynamo_tpu.llm.protocols.common import BackendOutput, FinishReason
+from dynamo_tpu.llm.protocols.openai import (ChatCompletionRequest,
+                                             CompletionRequest)
+from dynamo_tpu.runtime import Context, link
+from tests.fixtures import RecordingEngine
+
+
+@pytest.fixture(scope="module")
+def mdc(request):
+    tiny = request.getfixturevalue("tiny_model_dir")
+    return ModelDeploymentCard.from_local_path(tiny, display_name="tiny")
+
+
+def test_mdc_from_local_path(mdc):
+    assert mdc.model_info.eos_token_ids, "eos ids read from config.json"
+    assert mdc.prompt_format.chat_template
+    assert mdc.mdcsum() == mdc.mdcsum()
+    tk = mdc.tokenizer()
+    ids = tk.encode("hello world").ids
+    assert ids and tk.decode(ids) == "hello world"
+
+
+def test_mdc_json_roundtrip(mdc, tmp_path):
+    p = tmp_path / "mdc.json"
+    mdc.save(str(p))
+    loaded = ModelDeploymentCard.load(str(p))
+    assert loaded.mdcsum() == mdc.mdcsum()
+
+
+def test_chat_template_rendering(mdc):
+    pre = OpenAIPreprocessor(mdc)
+    req = ChatCompletionRequest(model="tiny", messages=[
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": "hello world"},
+    ])
+    out = pre.preprocess_chat(req)
+    text = mdc.tokenizer().decode(out.token_ids, skip_special_tokens=False)
+    assert "<|system|>" in text and "<|user|>" in text
+    assert text.endswith("<|assistant|>")
+    assert out.stop_conditions.stop_token_ids_hidden == mdc.model_info.eos_token_ids
+
+
+def test_preprocess_merges_options(mdc):
+    pre = OpenAIPreprocessor(mdc)
+    req = ChatCompletionRequest(
+        model="tiny", messages=[{"role": "user", "content": "hi"}],
+        max_tokens=7, temperature=0.5, stop=["END"], seed=3,
+        nvext={"ignore_eos": True})
+    out = pre.preprocess_chat(req)
+    assert out.stop_conditions.max_tokens == 7
+    assert out.stop_conditions.stop == ["END"]
+    assert out.stop_conditions.stop_token_ids_hidden == []  # ignore_eos
+    assert out.sampling_options.temperature == 0.5
+    assert out.sampling_options.seed == 3
+
+
+def test_preprocess_completion_pretokenized(mdc):
+    pre = OpenAIPreprocessor(mdc)
+    req = CompletionRequest(model="tiny", prompt=[5, 6, 7], max_tokens=2)
+    out = pre.preprocess_completion(req)
+    assert out.token_ids == [5, 6, 7]
+
+
+def test_context_overflow_rejected(mdc):
+    pre = OpenAIPreprocessor(mdc)
+    huge = "word " * 5000
+    with pytest.raises(ValueError):
+        pre.preprocess_chat(ChatCompletionRequest(
+            model="tiny", messages=[{"role": "user", "content": huge}]))
+
+
+# ---------------------------------------------------------------- decoder
+
+
+def test_decoder_incremental_roundtrip(mdc):
+    tk = mdc.tokenizer()
+    text = "señor açaí over the lazy dog 日本語"
+    ids = tk.encode(text).ids
+    dec = Decoder(tk)
+    got = "".join(r.text for r in map(dec.step, ids) if r.text)
+    assert got == text
+
+
+def test_decoder_hidden_stop_token(mdc):
+    tk = mdc.tokenizer()
+    eos = mdc.model_info.eos_token_ids[0]
+    dec = Decoder(tk, hidden_stop_ids=[eos])
+    ids = tk.encode("hello world").ids
+    for tid in ids:
+        assert dec.step(tid).stop_trigger is None
+    res = dec.step(eos)
+    assert res.stop_trigger is StopTrigger.HIDDEN_STOP_TOKEN
+    assert res.text is None  # hidden: no text surfaced for the EOS
+
+
+def test_decoder_stop_sequence_is_swallowed(mdc):
+    tk = mdc.tokenizer()
+    dec = Decoder(tk, stop_sequences=["lazy"])
+    ids = tk.encode("the quick lazy dog").ids
+    out, trigger = [], None
+    for tid in ids:
+        r = dec.step(tid)
+        if r.text:
+            out.append(r.text)
+        if r.stop_trigger:
+            trigger = r.stop_trigger
+            break
+    assert trigger is StopTrigger.STOP_SEQUENCE
+    text = "".join(out)
+    assert "lazy" not in text and "dog" not in text
+    assert text.startswith("the quick")
+
+
+def test_decoder_partial_stop_prefix_jailed(mdc):
+    tk = mdc.tokenizer()
+    # stop seq never completes: its prefix must be held (jailed), not leaked
+    dec = Decoder(tk, stop_sequences=["lazyXX"])
+    ids = tk.encode("quick lazy").ids
+    out = [r.text for r in map(dec.step, ids) if r.text]
+    # 'lazy' could still become 'lazyXX' so it stays jailed at stream end
+    assert "".join(out).startswith("quick")
+    assert "lazy" not in "".join(out)
+
+
+def test_decoder_max_tokens(mdc):
+    tk = mdc.tokenizer()
+    dec = Decoder(tk, max_tokens=3)
+    ids = tk.encode("the quick brown fox jumps").ids
+    triggers = [dec.step(t).stop_trigger for t in ids[:3]]
+    assert triggers[-1] is StopTrigger.MAX_TOKENS
+
+
+# ----------------------------------------------------- backend as operator
+
+
+@pytest.mark.asyncio
+async def test_full_pipeline_preproc_backend_engine(mdc):
+    pre = OpenAIPreprocessor(mdc)
+    tk = mdc.tokenizer()
+    reply_ids = tk.encode("the quick brown fox").ids
+    eos = mdc.model_info.eos_token_ids[0]
+    outputs = [Annotated.from_data(BackendOutput(token_ids=[t]))
+               for t in reply_ids]
+    outputs.append(Annotated.from_data(BackendOutput(token_ids=[eos])))
+    engine = RecordingEngine(outputs)
+    pipeline = link(pre, Backend(mdc), engine)
+
+    req = {"model": "tiny",
+           "messages": [{"role": "user", "content": "say something"}]}
+    stream = await pipeline.generate(Context(req))
+    chunks = [a.data async for a in stream if a.data is not None]
+    text = "".join(c["choices"][0]["delta"].get("content", "")
+                   for c in chunks if c["choices"])
+    assert text == "the quick brown fox"
+    finals = [c["choices"][0]["finish_reason"] for c in chunks if c["choices"]]
+    assert finals[-1] == "stop"
+    # engine saw a PreprocessedRequest
+    seen = engine.requests[0].data
+    assert seen.token_ids and seen.eos_token_ids == [eos]
+
+
+@pytest.mark.asyncio
+async def test_pipeline_stop_sequence_stops_engine(mdc):
+    pre = OpenAIPreprocessor(mdc)
+    tk = mdc.tokenizer()
+    reply_ids = tk.encode("hello world STOP more text").ids
+    outputs = [Annotated.from_data(BackendOutput(token_ids=[t]))
+               for t in reply_ids]
+    engine = RecordingEngine(outputs)
+    pipeline = link(pre, Backend(mdc), engine)
+    req = {"model": "tiny", "stop": ["STOP"],
+           "messages": [{"role": "user", "content": "go"}]}
+    ctx = Context(req)
+    stream = await pipeline.generate(ctx)
+    chunks = [a.data async for a in stream if a.data is not None]
+    text = "".join(c["choices"][0]["delta"].get("content", "")
+                   for c in chunks if c["choices"])
+    assert "STOP" not in text and "more" not in text
+    assert ctx.ctx.is_stopped  # backend told the engine to halt
+    finals = [c["choices"][0]["finish_reason"] for c in chunks if c["choices"]]
+    assert finals[-1] == "stop"
+
+
+@pytest.mark.asyncio
+async def test_token_ids_annotation(mdc):
+    pre = OpenAIPreprocessor(mdc)
+    engine = RecordingEngine(
+        [Annotated.from_data(BackendOutput(
+            token_ids=[1], finish_reason=FinishReason.EOS))])
+    pipeline = link(pre, Backend(mdc), engine)
+    req = {"model": "tiny",
+           "messages": [{"role": "user", "content": "hi"}],
+           "nvext": {"annotations": ["token_ids"]}}
+    stream = await pipeline.generate(Context(req))
+    events = [a async for a in stream]
+    assert any(a.event == "token_ids" for a in events)

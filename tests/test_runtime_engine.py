@@ -1,0 +1,106 @@
+"""Runtime core tests: Context/cancellation/pipeline composition — the analog
+of the reference's lib/runtime/tests/pipeline.rs with closure engines."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import (AsyncEngine, Context, EngineContext, Operator,
+                                ResponseStream, engine_from_fn, link)
+
+
+@pytest.mark.asyncio
+async def test_context_map_transfer_keeps_identity():
+    ctx = Context({"a": 1})
+    rid = ctx.id
+    mapped = ctx.map(lambda d: d["a"])
+    assert mapped.data == 1
+    assert mapped.id == rid
+    assert mapped.ctx is ctx.ctx
+
+
+@pytest.mark.asyncio
+async def test_closure_engine_streams():
+    async def fn(request):
+        async def gen():
+            for i in range(request.data):
+                yield i
+        return gen()
+
+    engine = engine_from_fn(fn)
+    stream = await engine.generate(Context(3))
+    assert await stream.collect() == [0, 1, 2]
+
+
+@pytest.mark.asyncio
+async def test_kill_truncates_stream():
+    ectx = EngineContext()
+
+    async def fn(request):
+        async def gen():
+            for i in range(100):
+                if i == 5:
+                    request.ctx.kill()
+                yield i
+        return gen()
+
+    stream = await engine_from_fn(fn).generate(Context(None, ectx))
+    got = await stream.collect()
+    # kill() fires while item 5 is being produced; the wrapper drops it and
+    # stops — kill is "drop the stream asap", not "flush the tail"
+    assert got == [0, 1, 2, 3, 4]
+    assert ectx.is_killed and ectx.is_stopped
+
+
+@pytest.mark.asyncio
+async def test_stop_generating_event():
+    ectx = EngineContext()
+
+    async def stopper():
+        await asyncio.sleep(0.01)
+        ectx.stop_generating()
+
+    task = asyncio.create_task(stopper())
+    await asyncio.wait_for(ectx.stopped(), timeout=1.0)
+    assert ectx.is_stopped and not ectx.is_killed
+    await task
+
+
+class _Doubler(Operator):
+    """Forward: double the request; backward: +1000 each response."""
+
+    async def generate(self, request, next_engine):
+        stream = await next_engine.generate(request.map(lambda x: x * 2))
+        return stream.map(lambda r: r + 1000)
+
+
+@pytest.mark.asyncio
+async def test_linked_pipeline_forward_and_backward():
+    async def fn(request):
+        async def gen():
+            yield request.data
+            yield request.data + 1
+        return gen()
+
+    pipeline = link(_Doubler(), _Doubler(), engine_from_fn(fn))
+    stream = await pipeline.generate(Context(5))
+    # forward: 5 → 10 → 20; backward: +1000 twice
+    assert await stream.collect() == [2020, 2021]
+
+
+def test_link_validation():
+    with pytest.raises(TypeError):
+        link(_Doubler())
+    with pytest.raises(ValueError):
+        link()
+    with pytest.raises(TypeError):
+        link(engine_from_fn(lambda r: None), _Doubler())
+
+
+@pytest.mark.asyncio
+async def test_pipeline_is_an_engine():
+    inner = link(_Doubler(), engine_from_fn(
+        lambda req: ResponseStream.from_iterable([req.data], req.ctx)))
+    outer = link(_Doubler(), inner)
+    stream = await outer.generate(Context(1))
+    assert await stream.collect() == [2004]

@@ -1,0 +1,89 @@
+"""SSE codec conformance — the reference pins these edge cases in
+lib/llm/tests/aggregators.rs:32-113 and protocols/codec.rs."""
+
+import json
+
+import pytest
+
+from dynamo_tpu.llm.protocols.annotated import Annotated
+from dynamo_tpu.llm.protocols.sse import (SseParser, encode_annotated,
+                                          encode_done, encode_event,
+                                          event_to_annotated)
+
+
+def _parse_all(text: str):
+    p = SseParser()
+    events = list(p.push(text))
+    tail = p.finish()
+    if tail:
+        events.append(tail)
+    return events
+
+
+def test_roundtrip_simple():
+    ann = Annotated.from_data({"x": 1})
+    wire = encode_annotated(ann)
+    evs = _parse_all(wire)
+    assert len(evs) == 1
+    back = event_to_annotated(evs[0])
+    assert back.data == {"x": 1}
+
+
+def test_multiline_data_joined_with_newline():
+    wire = "data: line1\ndata: line2\n\n"
+    evs = _parse_all(wire)
+    assert evs[0].data == "line1\nline2"
+
+
+def test_comments_preserved():
+    wire = ": a comment\n: second\ndata: {}\n\n"
+    evs = _parse_all(wire)
+    assert evs[0].comments == ["a comment", "second"]
+    ann = event_to_annotated(evs[0])
+    assert ann.comment == ["a comment", "second"]
+
+
+def test_invalid_json_becomes_error_not_crash():
+    evs = _parse_all("data: {not json\n\n")
+    ann = event_to_annotated(evs[0])
+    assert ann.is_error
+    assert "invalid JSON" in ann.error_message()
+
+
+def test_done_sentinel():
+    evs = _parse_all(encode_done())
+    assert evs[0].is_done
+
+
+def test_event_and_id_fields():
+    wire = encode_event(data=json.dumps([1]), event="error", id="42")
+    evs = _parse_all(wire)
+    assert evs[0].event == "error" and evs[0].id == "42"
+
+
+def test_incremental_push_across_chunk_boundaries():
+    p = SseParser()
+    out = []
+    for ch in "data: ab\nda" "ta: cd\n\n":
+        out.extend(p.push(ch))
+    assert len(out) == 1 and out[0].data == "ab\ncd"
+
+
+def test_error_annotation_roundtrip():
+    ann = Annotated.from_error("boom")
+    evs = _parse_all(encode_annotated(ann))
+    back = event_to_annotated(evs[0])
+    assert back.is_error and back.error_message() == "boom"
+
+
+@pytest.mark.asyncio
+async def test_parse_sse_stream_stops_at_done():
+    from dynamo_tpu.llm.protocols.sse import parse_sse_stream
+
+    async def chunks():
+        yield encode_annotated(Annotated.from_data({"i": 0})).encode()
+        yield encode_done().encode()
+        yield encode_annotated(Annotated.from_data({"i": 99})).encode()
+
+    got = [a async for a in parse_sse_stream(chunks())]
+    assert [a.data for a in got] == [{"i": 0}]
