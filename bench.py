@@ -1,0 +1,129 @@
+"""Serving benchmark — prints ONE JSON line for the driver.
+
+Measures steady-state decode throughput of the continuous-batching engine on
+whatever accelerator JAX sees (the driver runs this on one real TPU chip).
+Model: Llama-3.2-1B-class shapes, random bf16 weights (weights don't change
+the math's cost). The loop includes the real host-side scheduler path
+(per-step token fetch + block-table updates), not just raw XLA step time.
+
+Baseline context (BASELINE.md): the north-star target is ≥2000 decode
+tok/s/chip for 70B on a v5e-64 pod; `vs_baseline` reports value/2000 so the
+driver has a consistent scalar across rounds.
+
+Env knobs: BENCH_BATCH (default 16), BENCH_STEPS (128), BENCH_PROMPT (128),
+BENCH_MODEL (1b|tiny), BENCH_ATTN (auto|pallas|xla).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.engine.models import llama
+    from dynamo_tpu.engine.sampling import make_slot_keys
+
+    batch = int(os.environ.get("BENCH_BATCH", "16"))
+    steps = int(os.environ.get("BENCH_STEPS", "128"))
+    prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
+    model = os.environ.get("BENCH_MODEL", "1b")
+    attn = os.environ.get("BENCH_ATTN", "auto")
+
+    if model == "tiny":
+        mcfg = ModelConfig(vocab_size=2048, hidden_size=256,
+                           intermediate_size=512, num_layers=4, num_heads=8,
+                           num_kv_heads=4, head_dim=32,
+                           max_position_embeddings=2048)
+    else:  # llama-3.2-1B shapes
+        mcfg = ModelConfig(vocab_size=128256, hidden_size=2048,
+                           intermediate_size=8192, num_layers=16,
+                           num_heads=32, num_kv_heads=8, head_dim=64,
+                           max_position_embeddings=4096,
+                           rope_theta=500000.0, tie_word_embeddings=True)
+    max_len = prompt_len + steps + 64
+    bs = 16
+    blocks_per_seq = (max_len + bs - 1) // bs
+    ecfg = EngineConfig(
+        max_model_len=max_len, kv_block_size=bs,
+        num_kv_blocks=batch * blocks_per_seq + 2, max_num_seqs=batch,
+        prefill_buckets=[prompt_len, max_len])
+
+    dev = jax.devices()[0]
+    print(f"# bench on {dev.platform}:{dev.device_kind} model={model} "
+          f"B={batch} steps={steps} prompt={prompt_len} attn={attn}",
+          file=sys.stderr)
+
+    core = EngineCore(mcfg, ecfg, attn_impl=attn, param_dtype=jnp.bfloat16)
+
+    rng = np.random.default_rng(0)
+    statics = core.statics
+
+    # --- manual slot setup (bypass asyncio; measure the step loop itself)
+    t_prefill0 = time.monotonic()
+    prompts = rng.integers(1, mcfg.vocab_size, size=(batch, prompt_len))
+    for i in range(batch):
+        blocks = core.kv_manager.pool.alloc_uninit(blocks_per_seq)
+        table = np.zeros((core.M,), np.int32)
+        table[:len(blocks)] = blocks
+        core._block_tables[i, :] = table
+        padded = np.zeros((prompt_len,), np.int32)
+        padded[:] = prompts[i]
+        key = make_slot_keys(0, jnp.asarray([0]), jnp.asarray(0))[0]
+        tok, lp, core.kv = core._prefill_jit(
+            core.params, core.kv, jnp.asarray(padded), jnp.asarray(table),
+            jnp.asarray(0, jnp.int32), jnp.asarray(prompt_len, jnp.int32),
+            key, jnp.asarray(0.7, jnp.float32), jnp.asarray(0, jnp.int32),
+            jnp.asarray(1.0, jnp.float32))
+        core._tokens[i] = int(tok)
+        core._positions[i] = prompt_len
+    jax.block_until_ready(core.kv["k"])
+    prefill_s = time.monotonic() - t_prefill0
+
+    # --- timed decode loop (host loop included, as in real serving)
+    def step_once(step_i):
+        keys = make_slot_keys(0, jnp.asarray(np.zeros((batch,), np.int64)),
+                              jnp.asarray(np.full((batch,), step_i, np.int64)))
+        toks, lps, core.kv = core._decode_jit(
+            core.params, core.kv,
+            jnp.asarray(core._tokens), jnp.asarray(core._positions),
+            jnp.asarray(core._block_tables), keys,
+            jnp.asarray(np.full((batch,), 0.7, np.float32)),
+            jnp.asarray(np.zeros((batch,), np.int32)),
+            jnp.asarray(np.ones((batch,), np.float32)))
+        toks = np.asarray(toks)  # host fetch, like the real loop
+        core._tokens[:] = toks
+        core._positions[:] += 1
+        return toks
+
+    step_once(0)  # compile
+    t0 = time.monotonic()
+    for s in range(1, steps + 1):
+        step_once(s)
+    dt = time.monotonic() - t0
+
+    tok_per_s = batch * steps / dt
+    result = {
+        "metric": f"decode_tok_per_s_chip_llama{model}_b{batch}",
+        "value": round(tok_per_s, 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_per_s / 2000.0, 3),
+        "extra": {
+            "platform": dev.platform,
+            "step_ms": round(1e3 * dt / steps, 2),
+            "prefill_s_total": round(prefill_s, 2),
+            "prefill_tok_per_s": round(batch * prompt_len / prefill_s, 1),
+            "attn_impl": attn,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,220 @@
+"""Attention for the TPU engine: prefill (dense causal) + paged decode.
+
+TPU-native replacement for the engine-side attention the reference delegates
+to vLLM/TRT-LLM (paged attention over KV block tables; the reference's KV
+block layout is kv/layer.rs `[kv, blocks, block_size, heads, head_size]`).
+
+Our canonical KV-cache layout is `[KVH, NTOK, Dh]` per layer where
+`NTOK = num_blocks * block_size` is a flat paged token pool — chosen so that
+(a) a (kv-head, block) slice is contiguous for Pallas DMA, and (b) sharding
+over the `tp` mesh axis is a plain leading-axis PartitionSpec.
+
+Two decode implementations with identical semantics:
+- `paged_attention_xla`: gather + masked softmax, runs everywhere (CPU tests).
+- `paged_attention_pallas`: flash-style streaming kernel over the block table
+  with scalar-prefetched indices (TPU; `interpret=True` for CPU testing).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Prefill: dense causal attention (optionally against a KV prefix from cache)
+# ---------------------------------------------------------------------------
+
+
+def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     *, scale: float, kv_offset: int = 0,
+                     length: jax.Array | None = None) -> jax.Array:
+    """q: [T, H, Dh], k/v: [S, KVH, Dh]. Causal with query i attending to
+    kv j where j <= i + kv_offset. `length` masks padded kv positions."""
+    T, H, Dh = q.shape
+    S, KVH, _ = k.shape
+    g = H // KVH
+    qg = q.reshape(T, KVH, g, Dh)
+    scores = jnp.einsum("tkgd,skd->kgts", qg, k) * scale
+    qpos = jnp.arange(T)[:, None] + kv_offset
+    kpos = jnp.arange(S)[None, :]
+    mask = kpos <= qpos
+    if length is not None:
+        mask = mask & (kpos < length)
+    scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("kgts,skd->tkgd", probs, v)
+    return out.reshape(T, H, Dh)
+
+
+# ---------------------------------------------------------------------------
+# Decode: paged attention (XLA reference implementation)
+# ---------------------------------------------------------------------------
+
+
+def flat_token_indices(block_tables: jax.Array, block_size: int) -> jax.Array:
+    """[B, M] block ids → [B, M*BS] flat token-pool indices."""
+    B, M = block_tables.shape
+    offs = jnp.arange(block_size)[None, None, :]
+    return (block_tables[:, :, None] * block_size + offs).reshape(B, -1)
+
+
+def paged_attention_xla(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                        block_tables: jax.Array, seq_lens: jax.Array,
+                        *, block_size: int, scale: float) -> jax.Array:
+    """q: [B, H, Dh]; k_cache/v_cache: [KVH, NTOK, Dh];
+    block_tables: [B, M] int32; seq_lens: [B] (kv length incl. current token).
+    Returns [B, H, Dh]."""
+    B, H, Dh = q.shape
+    KVH = k_cache.shape[0]
+    g = H // KVH
+    idx = flat_token_indices(block_tables, block_size)        # [B, T]
+    T = idx.shape[1]
+    k = jnp.take(k_cache, idx, axis=1)                        # [KVH, B, T, Dh]
+    v = jnp.take(v_cache, idx, axis=1)
+    qg = q.reshape(B, KVH, g, Dh)
+    scores = jnp.einsum("bkgd,kbtd->bkgt", qg, k) * scale
+    mask = jnp.arange(T)[None, :] < seq_lens[:, None]         # [B, T]
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgt,kbtd->bkgd", probs, v)
+    return out.reshape(B, H, Dh)
+
+
+# ---------------------------------------------------------------------------
+# Decode: Pallas flash-style kernel streaming KV blocks from HBM
+# ---------------------------------------------------------------------------
+
+
+def _paged_attn_kernel(block_tables_ref, seq_lens_ref,  # scalar prefetch
+                       q_ref, k_hbm, v_hbm, o_ref,
+                       m_ref, l_ref, acc_ref, k_vmem, v_vmem, dma_sem,
+                       *, block_size: int, scale: float, max_blocks: int):
+    """Grid: (B, KVH). Streams this sequence's KV blocks for one kv-head,
+    flash-accumulating softmax online.
+
+    q_ref: [G, Dh] (VMEM) — the group of query heads for this kv head
+    k_hbm/v_hbm: [NTOK, Dh] (ANY/HBM) — this kv head's flat token pool
+    o_ref: [G, Dh] (VMEM)
+    """
+    b = pl.program_id(0)
+    seq_len = seq_lens_ref[b]
+    num_blocks = (seq_len + block_size - 1) // block_size
+
+    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[:] = jnp.zeros_like(l_ref)
+    acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[:].astype(jnp.float32) * scale  # [G, Dh]
+
+    def body(i, _):
+        blk = block_tables_ref[b, i]
+        start = blk * block_size
+        k_copy = pltpu.make_async_copy(
+            k_hbm.at[pl.ds(start, block_size), :], k_vmem, dma_sem)
+        k_copy.start()
+        k_copy.wait()
+        v_copy = pltpu.make_async_copy(
+            v_hbm.at[pl.ds(start, block_size), :], v_vmem, dma_sem)
+        v_copy.start()
+        v_copy.wait()
+        k = k_vmem[:].astype(jnp.float32)      # [BS, Dh]
+        v = v_vmem[:].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [G, BS]
+        kv_pos = i * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=1)
+        s = jnp.where(kv_pos < seq_len, s, NEG_INF)
+        m_prev = m_ref[:]                      # [G, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                 # [G, BS]
+        alpha = jnp.exp(m_prev - m_new)        # [G, 1]
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())))    # [G, Dh]
+        m_ref[:] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, num_blocks, body, 0)
+    o_ref[:] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-20)).astype(o_ref.dtype)
+
+
+def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                           block_tables: jax.Array, seq_lens: jax.Array,
+                           *, block_size: int, scale: float,
+                           interpret: bool = False) -> jax.Array:
+    """Same contract as `paged_attention_xla`; KV stays in HBM and is DMA'd
+    block-by-block (no [B, M*BS] gather materialization)."""
+    B, H, Dh = q.shape
+    KVH, NTOK, _ = k_cache.shape
+    g = H // KVH
+    M = block_tables.shape[1]
+    qg = q.reshape(B, KVH, g, Dh)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KVH),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, Dh), lambda b, h, *_: (b, h, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # k_cache stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),   # v_cache stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, Dh), lambda b, h, *_: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),        # m
+            pltpu.VMEM((g, 1), jnp.float32),        # l
+            pltpu.VMEM((g, Dh), jnp.float32),       # acc
+            pltpu.VMEM((block_size, Dh), k_cache.dtype),
+            pltpu.VMEM((block_size, Dh), v_cache.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+
+    def kernel(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm, o_ref,
+               m_ref, l_ref, acc_ref, k_vmem, v_vmem, dma_sem):
+        h = pl.program_id(1)
+        _paged_attn_kernel(
+            block_tables_ref, seq_lens_ref,
+            q_ref.at[0, 0], k_hbm.at[h], v_hbm.at[h], o_ref.at[0, 0],
+            m_ref, l_ref, acc_ref, k_vmem, v_vmem, dma_sem,
+            block_size=block_size, scale=scale, max_blocks=M)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KVH, g, Dh), q.dtype),
+        interpret=interpret,
+    )(block_tables, seq_lens, qg, k_cache, v_cache)
+    return out.reshape(B, H, Dh)
+
+
+def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
+                    block_size: int, scale: float,
+                    impl: str = "auto") -> jax.Array:
+    """Dispatch: pallas on TPU, XLA gather fallback elsewhere."""
+    if impl == "auto":
+        impl = "pallas" if _on_tpu() else "xla"
+    if impl == "pallas":
+        return paged_attention_pallas(q, k_cache, v_cache, block_tables,
+                                      seq_lens, block_size=block_size,
+                                      scale=scale)
+    if impl == "pallas_interpret":
+        return paged_attention_pallas(q, k_cache, v_cache, block_tables,
+                                      seq_lens, block_size=block_size,
+                                      scale=scale, interpret=True)
+    return paged_attention_xla(q, k_cache, v_cache, block_tables, seq_lens,
+                               block_size=block_size, scale=scale)
+
+
+@functools.cache
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        return False

@@ -1,0 +1,131 @@
+"""Model + engine configuration.
+
+The reference delegates model config to external engines (vLLM/TRT-LLM); here
+the engine is ours, so the model config is first-class. Parsed from HF-style
+config.json (the same artifact the reference's ModelDeploymentCard points at,
+lib/llm/src/model_card/create.rs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class RopeScaling:
+    """Llama-3 style rope scaling (config.json `rope_scaling`)."""
+
+    rope_type: str = "default"
+    factor: float = 1.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+
+@dataclasses.dataclass
+class ModelConfig:
+    """Transformer shape config (llama / qwen / mixtral families)."""
+
+    model_type: str = "llama"
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: int = 128
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    rope_scaling: Optional[RopeScaling] = None
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False
+    # MoE (mixtral-style); num_experts == 0 → dense MLP
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # qwen3-style per-head q/k norm
+    qk_norm: bool = False
+
+    @classmethod
+    def from_hf_config(cls, cfg: Dict[str, Any]) -> "ModelConfig":
+        n_heads = int(cfg.get("num_attention_heads", 32))
+        hidden = int(cfg.get("hidden_size", 4096))
+        rs = None
+        raw_rs = cfg.get("rope_scaling")
+        if isinstance(raw_rs, dict):
+            rs = RopeScaling(
+                rope_type=raw_rs.get("rope_type", raw_rs.get("type", "default")),
+                factor=float(raw_rs.get("factor", 1.0)),
+                low_freq_factor=float(raw_rs.get("low_freq_factor", 1.0)),
+                high_freq_factor=float(raw_rs.get("high_freq_factor", 4.0)),
+                original_max_position_embeddings=int(
+                    raw_rs.get("original_max_position_embeddings", 8192)),
+            )
+        return cls(
+            model_type=cfg.get("model_type", "llama"),
+            vocab_size=int(cfg.get("vocab_size", 32000)),
+            hidden_size=hidden,
+            intermediate_size=int(cfg.get("intermediate_size", 4 * hidden)),
+            num_layers=int(cfg.get("num_hidden_layers", 32)),
+            num_heads=n_heads,
+            num_kv_heads=int(cfg.get("num_key_value_heads", n_heads)),
+            head_dim=int(cfg.get("head_dim", hidden // n_heads)),
+            max_position_embeddings=int(cfg.get("max_position_embeddings", 4096)),
+            rms_norm_eps=float(cfg.get("rms_norm_eps", 1e-5)),
+            rope_theta=float(cfg.get("rope_theta", 10000.0)),
+            rope_scaling=rs,
+            tie_word_embeddings=bool(cfg.get("tie_word_embeddings", False)),
+            attention_bias=bool(cfg.get("attention_bias", False)),
+            num_experts=int(cfg.get("num_local_experts", 0) or
+                            cfg.get("num_experts", 0) or 0),
+            num_experts_per_tok=int(cfg.get("num_experts_per_tok", 2)),
+            qk_norm=bool(cfg.get("qk_norm", cfg.get("model_type") == "qwen3")),
+        )
+
+    @classmethod
+    def from_model_dir(cls, model_dir: str) -> "ModelConfig":
+        with open(os.path.join(model_dir, "config.json")) as f:
+            return cls.from_hf_config(json.load(f))
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Serving-engine knobs (the analog of the reference's engine flags,
+    launch/dynamo-run/src/flags.rs, plus XLA-specific bucketing)."""
+
+    max_model_len: int = 2048
+    kv_block_size: int = 16
+    num_kv_blocks: int = 512          # HBM KV pool size (blocks across all seqs)
+    max_num_seqs: int = 8             # decode batch slots
+    enable_prefix_reuse: bool = True  # match prompt blocks against the pool
+    prefill_buckets: List[int] = dataclasses.field(
+        default_factory=lambda: [128, 256, 512, 1024, 2048])
+    prefill_chunk: int = 0            # 0 = whole-prompt prefill
+    dtype: str = "bfloat16"
+    # parallelism over the device mesh
+    tp: int = 1                       # tensor parallel (heads/mlp sharding)
+    dp: int = 1                       # data parallel replicas inside one engine
+    sp: int = 1                       # sequence parallel (ring attention) for prefill
+    ep: int = 1                       # expert parallel (MoE)
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        self.prefill_buckets = sorted(
+            b for b in self.prefill_buckets if b <= self.max_model_len) or [
+                self.max_model_len]
+        if self.prefill_buckets[-1] < self.max_model_len:
+            self.prefill_buckets.append(self.max_model_len)
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return (self.max_model_len + self.kv_block_size - 1) // self.kv_block_size
+
+    def bucket_for(self, length: int) -> int:
+        for b in self.prefill_buckets:
+            if length <= b:
+                return b
+        raise ValueError(f"prompt length {length} exceeds max_model_len "
+                         f"{self.max_model_len}")

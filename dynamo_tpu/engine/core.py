@@ -1,0 +1,413 @@
+"""Continuous-batching engine core: slots, paged-block allocator, and the
+async scheduling loop driving the jitted prefill/decode steps.
+
+The reference's analog is the external engine it orchestrates (vLLM's
+scheduler + paged allocator); here it is native. TPU-first specifics:
+
+- one jitted decode program serves the whole batch every step (static
+  [max_num_seqs] shapes; inactive slots aim at the trash block and their
+  outputs are ignored);
+- prefill programs are compiled per bucket length (EngineConfig.prefill_buckets)
+  so XLA sees only static shapes;
+- KV caches are donated through every step call → XLA updates HBM in place;
+- cancellation is step-granular: each loop iteration polls request contexts
+  (an in-flight XLA dispatch is never interrupted), matching the semantics
+  the runtime's EngineContext promises (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..llm.kv.blocks import TokenBlockSequence
+from ..llm.kv.pool import KvBlockManager
+from ..llm.protocols.common import FinishReason
+from .config import EngineConfig, ModelConfig
+from .models import llama
+from .sampling import SlotSampling, make_slot_keys, sample_tokens
+
+logger = logging.getLogger("dynamo_tpu.engine")
+
+
+@dataclasses.dataclass
+class ForwardPassMetrics:
+    """Worker load metrics published to the router (reference
+    kv_router/protocols.rs:18-97)."""
+
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    num_requests_waiting: int = 0
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class BlockAllocator:
+    """Host-side free-list allocator over the flat paged KV pool.
+
+    Block 0 is reserved as the trash block (pad/inactive writes land there;
+    see models/llama.py docstrings)."""
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_blocks(self) -> int:
+        return self.num_blocks - 1 - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks: List[int]) -> None:
+        for b in blocks:
+            if b != 0:
+                self._free.append(b)
+
+
+@dataclasses.dataclass
+class EngineRequest:
+    """One sequence's engine-side state."""
+
+    rid: str
+    prompt: List[int]
+    sampling: SlotSampling
+    max_new_tokens: int
+    eos_ids: frozenset
+    ctx: object = None            # runtime EngineContext (cancellation)
+    out_queue: asyncio.Queue = dataclasses.field(default_factory=asyncio.Queue)
+    # engine state
+    slot: int = -1
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    pos: int = 0                  # tokens currently in KV
+    generated: int = 0
+    last_token: int = -1
+    prefix_hit_tokens: int = 0
+    seq: Optional[TokenBlockSequence] = None   # full token history + hashes
+    registered_blocks: int = 0
+    enqueue_time: float = dataclasses.field(default_factory=time.monotonic)
+    first_token_time: Optional[float] = None
+
+    @property
+    def cancelled(self) -> bool:
+        return bool(self.ctx is not None and self.ctx.is_stopped)
+
+
+_FINISH = object()  # queue sentinel
+
+
+class EngineCore:
+    """The model-executing scheduler. Owns params + KV cache on device."""
+
+    def __init__(self, model_cfg: ModelConfig, engine_cfg: EngineConfig,
+                 params: Optional[dict] = None, attn_impl: str = "auto",
+                 param_dtype=jnp.bfloat16, mesh=None):
+        self.model_cfg = model_cfg
+        self.cfg = engine_cfg
+        self.mesh = mesh
+        self.statics = llama.ModelStatics(
+            cfg=model_cfg, block_size=engine_cfg.kv_block_size,
+            attn_impl=attn_impl)
+        if params is None:
+            params = llama.init_params(
+                model_cfg, jax.random.PRNGKey(engine_cfg.seed), dtype=param_dtype)
+        self.params = params
+        self.kv = llama.init_kv_cache(
+            model_cfg, engine_cfg.num_kv_blocks, engine_cfg.kv_block_size,
+            dtype=param_dtype)
+        self.kv_manager = KvBlockManager(
+            engine_cfg.num_kv_blocks, engine_cfg.kv_block_size,
+            enable_reuse=engine_cfg.enable_prefix_reuse)
+        self.M = engine_cfg.max_blocks_per_seq
+        self.B = engine_cfg.max_num_seqs
+
+        self.slots: List[Optional[EngineRequest]] = [None] * self.B
+        self.waiting: asyncio.Queue[EngineRequest] = asyncio.Queue()
+        self._work_event = asyncio.Event()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._stopping = False
+        self._step = 0
+        # host mirrors of per-slot state
+        self._block_tables = np.zeros((self.B, self.M), dtype=np.int32)
+        self._positions = np.zeros((self.B,), dtype=np.int32)
+        self._tokens = np.zeros((self.B,), dtype=np.int32)
+        self._samp = {
+            "temperature": np.zeros((self.B,), np.float32),
+            "top_k": np.zeros((self.B,), np.int32),
+            "top_p": np.ones((self.B,), np.float32),
+        }
+        self._seeds = np.zeros((self.B,), np.int64)
+        self._compile_jits()
+        # serving stats
+        self.total_prefill_tokens = 0
+        self.total_decode_tokens = 0
+
+    # ------------------------------------------------------------------ jit
+    def _compile_jits(self) -> None:
+        statics = self.statics
+
+        def prefill(params, kv, tokens, block_table, start_pos, true_len,
+                    key, temperature, top_k, top_p):
+            logits, kv = llama.prefill_forward(
+                params, kv, tokens, block_table, start_pos, true_len, statics)
+            tok, logprob = sample_tokens(
+                logits[None, :], key[None], temperature[None], top_k[None],
+                top_p[None])
+            return tok[0], logprob[0], kv
+
+        self._prefill_jit = jax.jit(prefill, donate_argnums=(1,))
+
+        def decode(params, kv, tokens, positions, block_tables,
+                   keys, temperature, top_k, top_p):
+            logits, kv = llama.decode_forward(
+                params, kv, tokens, positions, block_tables, statics)
+            toks, logprobs = sample_tokens(logits, keys, temperature,
+                                           top_k, top_p)
+            return toks, logprobs, kv
+
+        self._decode_jit = jax.jit(decode, donate_argnums=(1,))
+
+    # ------------------------------------------------------------ lifecycle
+    def ensure_started(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._stopping = False
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._run_loop(), name="engine-core-loop")
+
+    async def stop(self) -> None:
+        self._stopping = True
+        self._work_event.set()
+        if self._loop_task is not None:
+            try:
+                await asyncio.wait_for(self._loop_task, timeout=5)
+            except asyncio.TimeoutError:
+                self._loop_task.cancel()
+            self._loop_task = None
+
+    # ------------------------------------------------------------- frontend
+    async def submit(self, req: EngineRequest) -> None:
+        self.ensure_started()
+        await self.waiting.put(req)
+        self._work_event.set()
+
+    def metrics(self) -> ForwardPassMetrics:
+        active = sum(1 for s in self.slots if s is not None)
+        total_blocks = self.cfg.num_kv_blocks - 1
+        used = self.kv_manager.pool.used_blocks
+        return ForwardPassMetrics(
+            request_active_slots=active,
+            request_total_slots=self.B,
+            kv_active_blocks=used,
+            kv_total_blocks=total_blocks,
+            num_requests_waiting=self.waiting.qsize(),
+            gpu_cache_usage_perc=used / max(total_blocks, 1),
+            gpu_prefix_cache_hit_rate=self.kv_manager.pool.hit_rate(),
+        )
+
+    # ------------------------------------------------------------ scheduler
+    def _free_slot_index(self) -> int:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return -1
+
+    def _blocks_needed(self, n_tokens: int) -> int:
+        bs = self.cfg.kv_block_size
+        return (n_tokens + bs - 1) // bs
+
+    async def _run_loop(self) -> None:
+        logger.info("engine loop starting: %d slots, %d KV blocks, block=%d",
+                    self.B, self.cfg.num_kv_blocks, self.cfg.kv_block_size)
+        while not self._stopping:
+            progressed = False
+            # 1) admit waiting work into free slots
+            while not self.waiting.empty():
+                slot = self._free_slot_index()
+                if slot < 0:
+                    break
+                req: EngineRequest = self.waiting.get_nowait()
+                if req.cancelled:
+                    self._finish_request(req, FinishReason.CANCELLED)
+                    continue
+                if not self._try_admit(req, slot):
+                    # not enough KV blocks — put it back and stop admitting
+                    self.waiting._queue.appendleft(req)  # type: ignore[attr-defined]
+                    break
+                progressed = True
+            # 2) run one decode step for whatever is active
+            if any(s is not None for s in self.slots):
+                self._decode_step()
+                progressed = True
+            if not progressed:
+                self._work_event.clear()
+                try:
+                    await asyncio.wait_for(self._work_event.wait(), timeout=0.5)
+                except asyncio.TimeoutError:
+                    pass
+            else:
+                await asyncio.sleep(0)  # let producers/consumers run
+        logger.info("engine loop stopped")
+
+    # ---------------------------------------------------------------- admit
+    def _try_admit(self, req: EngineRequest, slot: int) -> bool:
+        n_prompt = len(req.prompt)
+        plan = self.kv_manager.prepare_prefill(req.prompt)
+        if plan is None:
+            return False
+        req.slot = slot
+        req.blocks = plan.all_blocks
+        req.seq = plan.seq
+        req.prefix_hit_tokens = plan.hit_tokens
+        # prefill only the un-matched suffix — the prefix KV is already in
+        # the pool's blocks (this is the TTFT win of prefix reuse)
+        chunk = req.prompt[plan.hit_tokens:]
+        bucket = self.cfg.bucket_for(len(chunk))
+        padded = np.zeros((bucket,), np.int32)
+        padded[:len(chunk)] = chunk
+        table = np.zeros((self.M,), np.int32)
+        table[:len(req.blocks)] = req.blocks
+        key = make_slot_keys(self.cfg.seed,
+                             jnp.asarray([req.sampling.seed]),
+                             jnp.asarray(0))[0]
+        t0 = time.monotonic()
+        tok, logprob, self.kv = self._prefill_jit(
+            self.params, self.kv, jnp.asarray(padded), jnp.asarray(table),
+            jnp.asarray(plan.hit_tokens, jnp.int32),
+            jnp.asarray(len(chunk), jnp.int32),
+            key,
+            jnp.asarray(req.sampling.temperature, jnp.float32),
+            jnp.asarray(req.sampling.top_k, jnp.int32),
+            jnp.asarray(req.sampling.top_p, jnp.float32))
+        tok = int(tok)
+        req.pos = n_prompt
+        req.generated = 1
+        req.last_token = tok
+        req.first_token_time = time.monotonic()
+        self.total_prefill_tokens += len(chunk)
+        # the prompt's full blocks now hold valid KV — register for reuse
+        req.registered_blocks = self.kv_manager.register_full_blocks(
+            req.blocks, plan.seq, already_registered=len(plan.hit_blocks))
+        self.slots[slot] = req
+        # host mirrors
+        self._block_tables[slot, :] = 0
+        self._block_tables[slot, :len(req.blocks)] = req.blocks
+        self._samp["temperature"][slot] = req.sampling.temperature
+        self._samp["top_k"][slot] = req.sampling.top_k
+        self._samp["top_p"][slot] = req.sampling.top_p
+        self._seeds[slot] = req.sampling.seed
+        logger.debug(
+            "admitted %s into slot %d (prompt=%d, hit=%d, bucket=%d, %.1fms)",
+            req.rid, slot, n_prompt, plan.hit_tokens, bucket,
+            1e3 * (time.monotonic() - t0))
+        self._emit(req, tok, float(logprob))
+        self._maybe_finish_after_emit(req)
+        return True
+
+    # --------------------------------------------------------------- decode
+    def _decode_step(self) -> None:
+        active_idx = [i for i, s in enumerate(self.slots) if s is not None]
+        steps = np.zeros((self.B,), np.int64)
+        for i in range(self.B):
+            s = self.slots[i]
+            if s is None:
+                self._tokens[i] = 0
+                self._positions[i] = 0
+                self._block_tables[i, :] = 0  # trash block
+            else:
+                self._tokens[i] = s.last_token
+                self._positions[i] = s.pos
+                steps[i] = s.generated
+        self._step += 1
+        keys = make_slot_keys(self.cfg.seed, jnp.asarray(self._seeds),
+                              jnp.asarray(steps))
+        toks, logprobs, self.kv = self._decode_jit(
+            self.params, self.kv,
+            jnp.asarray(self._tokens), jnp.asarray(self._positions),
+            jnp.asarray(self._block_tables), keys,
+            jnp.asarray(self._samp["temperature"]),
+            jnp.asarray(self._samp["top_k"]),
+            jnp.asarray(self._samp["top_p"]))
+        toks = np.asarray(toks)
+        logprobs = np.asarray(logprobs)
+        bs = self.cfg.kv_block_size
+        for i in active_idx:
+            req = self.slots[i]
+            if req is None:
+                continue
+            if req.cancelled:
+                self._release_slot(req)
+                self._finish_request(req, FinishReason.CANCELLED)
+                continue
+            tok = int(toks[i])
+            # the step wrote the *input* token's KV into the cache — its
+            # block may now be full and registrable for prefix reuse
+            if req.seq is not None:
+                req.seq.append(int(self._tokens[i]))
+                req.registered_blocks = self.kv_manager.register_full_blocks(
+                    req.blocks, req.seq, req.registered_blocks)
+            req.pos += 1
+            req.generated += 1
+            req.last_token = tok
+            self.total_decode_tokens += 1
+            # grow block table if the *next* token would start a new block
+            if (req.pos + 1) > len(req.blocks) * bs:
+                new = self.kv_manager.pool.alloc_uninit(1)
+                if new is None:
+                    # out of KV memory: finish with length (preemption is a
+                    # later-stage feature; SURVEY.md §7 stage 5)
+                    self._emit(req, tok, float(logprobs[i]))
+                    self._release_slot(req)
+                    self._finish_request(req, FinishReason.LENGTH)
+                    continue
+                req.blocks.extend(new)
+                self._block_tables[i, len(req.blocks) - 1] = new[0]
+            self._emit(req, tok, float(logprobs[i]))
+            self._maybe_finish_after_emit(req)
+
+    # ------------------------------------------------------------- finishes
+    def _emit(self, req: EngineRequest, token: int, logprob: float) -> None:
+        req.out_queue.put_nowait((token, logprob))
+
+    def _maybe_finish_after_emit(self, req: EngineRequest) -> None:
+        if req.last_token in req.eos_ids:
+            self._release_slot(req)
+            self._finish_request(req, FinishReason.EOS)
+        elif req.generated >= req.max_new_tokens:
+            self._release_slot(req)
+            self._finish_request(req, FinishReason.LENGTH)
+        elif req.cancelled:
+            self._release_slot(req)
+            self._finish_request(req, FinishReason.CANCELLED)
+
+    def _release_slot(self, req: EngineRequest) -> None:
+        if req.slot >= 0 and self.slots[req.slot] is req:
+            self.slots[req.slot] = None
+            self._block_tables[req.slot, :] = 0
+        self.kv_manager.pool.release(req.blocks)
+        req.blocks = []
+
+    def _finish_request(self, req: EngineRequest,
+                        reason: FinishReason) -> None:
+        req.out_queue.put_nowait((_FINISH, reason))
+
+
+FINISH_SENTINEL = _FINISH

@@ -1,0 +1,278 @@
+"""Llama-family transformer in pure JAX with a paged KV cache.
+
+This is the engine-side model the reference outsources to vLLM/SGLang/TRT-LLM
+(SURVEY.md §2.2 engines). Design is TPU-first:
+
+- stacked-layer parameters + `lax.scan` over layers → one compiled layer body
+  (fast compile, good for pjit partitioning);
+- KV cache per layer is a flat paged token pool `[KVH, NTOK, Dh]`
+  (see attention.py for why), updated in place via donated buffers;
+- prefill is "batched multi-token decode": chunk KV is scattered into the
+  paged pool first, then queries attend over the block table — which makes
+  chunked prefill and prefix-cache reuse the same code path;
+- no data-dependent Python control flow: everything under jit uses static
+  shapes (bucketed T) and `lax` primitives.
+
+Weight layout matches HF llama checkpoints after transpose (see weights.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..attention import causal_attention  # noqa: F401  (used by sp path)
+from ..attention import flat_token_indices, paged_attention
+from ..config import ModelConfig
+
+Params = Dict[str, jax.Array]
+KVCache = Dict[str, jax.Array]  # {"k": [L, KVH, NTOK, Dh], "v": ...}
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def rope_inv_freq(cfg: ModelConfig) -> np.ndarray:
+    """Rotary inverse frequencies incl. llama-3 rope scaling."""
+    dim = cfg.head_dim
+    inv = 1.0 / (cfg.rope_theta ** (np.arange(0, dim, 2, dtype=np.float64) / dim))
+    rs = cfg.rope_scaling
+    if rs is not None and rs.rope_type in ("llama3",):
+        low_wl = rs.original_max_position_embeddings / rs.low_freq_factor
+        high_wl = rs.original_max_position_embeddings / rs.high_freq_factor
+        wl = 2 * np.pi / inv
+        smooth = (rs.original_max_position_embeddings / wl - rs.low_freq_factor) / (
+            rs.high_freq_factor - rs.low_freq_factor)
+        scaled = np.where(
+            wl > low_wl, inv / rs.factor,
+            np.where(wl < high_wl, inv,
+                     (1 - smooth) * inv / rs.factor + smooth * inv))
+        inv = scaled
+    elif rs is not None and rs.rope_type == "linear":
+        inv = inv / rs.factor
+    return inv.astype(np.float32)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               inv_freq: jax.Array) -> jax.Array:
+    """x: [T, H, Dh]; positions: [T]. HF half-split rotate convention."""
+    angles = positions[:, None].astype(jnp.float32) * inv_freq[None, :]  # [T, Dh/2]
+    cos = jnp.cos(angles)[:, None, :]
+    sin = jnp.sin(angles)[:, None, :]
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out = jnp.concatenate([x1f * cos - x2f * sin,
+                           x2f * cos + x1f * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def swiglu(x: jax.Array, gate_w: jax.Array, up_w: jax.Array,
+           down_w: jax.Array) -> jax.Array:
+    return (jax.nn.silu(x @ gate_w) * (x @ up_w)) @ down_w
+
+
+# ---------------------------------------------------------------------------
+# Parameter init / shapes
+# ---------------------------------------------------------------------------
+
+
+def param_shapes(cfg: ModelConfig) -> Dict[str, Tuple[int, ...]]:
+    L, D = cfg.num_layers, cfg.hidden_size
+    H, KVH, Dh, F = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, cfg.intermediate_size
+    shapes = {
+        "embed": (cfg.vocab_size, D),
+        "final_norm": (D,),
+        "layers.ln1": (L, D),
+        "layers.ln2": (L, D),
+        "layers.wq": (L, D, H * Dh),
+        "layers.wk": (L, D, KVH * Dh),
+        "layers.wv": (L, D, KVH * Dh),
+        "layers.wo": (L, H * Dh, D),
+        "layers.gate": (L, D, F),
+        "layers.up": (L, D, F),
+        "layers.down": (L, F, D),
+    }
+    if not cfg.tie_word_embeddings:
+        shapes["lm_head"] = (D, cfg.vocab_size)
+    return shapes
+
+
+def init_params(cfg: ModelConfig, key: jax.Array,
+                dtype=jnp.bfloat16) -> Params:
+    params: Params = {}
+    for name, shape in param_shapes(cfg).items():
+        key, sub = jax.random.split(key)
+        if name.endswith(("ln1", "ln2")) or name == "final_norm":
+            params[name] = jnp.ones(shape, dtype=dtype)
+        else:
+            fan_in = shape[-2] if len(shape) > 1 else shape[-1]
+            params[name] = (jax.random.normal(sub, shape, dtype=jnp.float32)
+                            * (fan_in ** -0.5)).astype(dtype)
+    return params
+
+
+def init_kv_cache(cfg: ModelConfig, num_blocks: int, block_size: int,
+                  dtype=jnp.bfloat16) -> KVCache:
+    shape = (cfg.num_layers, cfg.num_kv_heads, num_blocks * block_size,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype=dtype),
+            "v": jnp.zeros(shape, dtype=dtype)}
+
+
+def _layer_stack(params: Params):
+    return {k.split(".", 1)[1]: v for k, v in params.items()
+            if k.startswith("layers.")}
+
+
+# ---------------------------------------------------------------------------
+# Forward passes
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelStatics:
+    """Static (hashable) arguments threaded into the jitted functions."""
+
+    cfg: ModelConfig
+    block_size: int
+    attn_impl: str = "auto"
+
+    def __hash__(self):
+        return hash((id(self.cfg), self.block_size, self.attn_impl))
+
+
+def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
+                    block_table: jax.Array, start_pos: jax.Array,
+                    true_len: jax.Array, statics: ModelStatics
+                    ) -> Tuple[jax.Array, KVCache]:
+    """Single-sequence (chunk) prefill.
+
+    tokens: [T] padded to a bucket; block_table: [M] this sequence's blocks;
+    start_pos: scalar — tokens[0]'s absolute position (>0 for chunked prefill
+    or prefix-cache hits, in which case blocks [0, start_pos) must already
+    hold the prefix KV); true_len: scalar — valid tokens in this chunk.
+
+    Returns (logits_last [V], updated kv). Pad positions scatter into the
+    reserved trash block 0 (allocators never hand out block 0) and are masked
+    out of attention reads.
+    """
+    cfg = statics.cfg
+    T = tokens.shape[0]
+    bsz = statics.block_size
+    inv_freq = jnp.asarray(rope_inv_freq(cfg))
+    scale = cfg.head_dim ** -0.5
+
+    positions = start_pos + jnp.arange(T, dtype=jnp.int32)
+    valid = jnp.arange(T, dtype=jnp.int32) < true_len
+    # flat pool slot for each chunk token; pads → slot 0 (trash block)
+    slots = jnp.where(
+        valid,
+        block_table[positions // bsz] * bsz + positions % bsz,
+        0)
+    seq_len = start_pos + true_len
+
+    x = params["embed"][tokens]  # activation dtype follows param dtype
+
+    layer_params = _layer_stack(params)
+
+    def layer(carry, xs):
+        h = carry
+        lp, k_l, v_l = xs["lp"], xs["k"], xs["v"]
+        hn = rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
+        q = (hn @ lp["wq"]).reshape(T, cfg.num_heads, cfg.head_dim)
+        k = (hn @ lp["wk"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+        v = (hn @ lp["wv"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        # write chunk KV into the paged pool, then attend over the block table
+        k_l = k_l.at[:, slots, :].set(k.transpose(1, 0, 2).astype(k_l.dtype),
+                                      mode="drop")
+        v_l = v_l.at[:, slots, :].set(v.transpose(1, 0, 2).astype(v_l.dtype),
+                                      mode="drop")
+        idx = flat_token_indices(block_table[None, :], bsz)[0]       # [S]
+        ks = jnp.take(k_l, idx, axis=1)                              # [KVH,S,Dh]
+        vs = jnp.take(v_l, idx, axis=1)
+        g = cfg.num_heads // cfg.num_kv_heads
+        qg = q.reshape(T, cfg.num_kv_heads, g, cfg.head_dim)
+        scores = jnp.einsum("tkgd,ksd->kgts", qg, ks).astype(jnp.float32) * scale
+        kv_pos = jnp.arange(idx.shape[0], dtype=jnp.int32)
+        mask = (kv_pos[None, :] <= positions[:, None]) & (
+            kv_pos[None, :] < seq_len)
+        scores = jnp.where(mask[None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(vs.dtype)
+        attn = jnp.einsum("kgts,ksd->tkgd", probs, vs).reshape(
+            T, cfg.num_heads * cfg.head_dim)
+        h = h + attn @ lp["wo"]
+        hn2 = rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
+        h = h + swiglu(hn2, lp["gate"], lp["up"], lp["down"])
+        return h, (k_l, v_l)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, {"lp": layer_params, "k": kv["k"], "v": kv["v"]})
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = x[jnp.maximum(true_len - 1, 0)]
+    head = params.get("lm_head")
+    logits = (last @ head if head is not None
+              else last @ params["embed"].T.astype(last.dtype))
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+
+
+def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
+                   positions: jax.Array, block_tables: jax.Array,
+                   statics: ModelStatics) -> Tuple[jax.Array, KVCache]:
+    """Batched single-token decode step.
+
+    tokens: [B] current input token per slot; positions: [B] their absolute
+    positions (inactive slots: position 0 w/ trash block table);
+    block_tables: [B, M]. Returns (logits [B, V], updated kv).
+    """
+    cfg = statics.cfg
+    B = tokens.shape[0]
+    bsz = statics.block_size
+    inv_freq = jnp.asarray(rope_inv_freq(cfg))
+    scale = cfg.head_dim ** -0.5
+    slots = block_tables[jnp.arange(B), positions // bsz] * bsz + positions % bsz
+    seq_lens = positions + 1
+
+    x = params["embed"][tokens]  # [B, D]
+    layer_params = _layer_stack(params)
+
+    def layer(carry, xs):
+        h = carry
+        lp, k_l, v_l = xs["lp"], xs["k"], xs["v"]
+        hn = rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
+        q = (hn @ lp["wq"]).reshape(B, cfg.num_heads, cfg.head_dim)
+        k = (hn @ lp["wk"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
+        v = (hn @ lp["wv"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        k_l = k_l.at[:, slots, :].set(k.transpose(1, 0, 2).astype(k_l.dtype))
+        v_l = v_l.at[:, slots, :].set(v.transpose(1, 0, 2).astype(v_l.dtype))
+        attn = paged_attention(q, k_l, v_l, block_tables, seq_lens,
+                               block_size=bsz, scale=scale,
+                               impl=statics.attn_impl)
+        h = h + attn.reshape(B, -1) @ lp["wo"]
+        hn2 = rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
+        h = h + swiglu(hn2, lp["gate"], lp["up"], lp["down"])
+        return h, (k_l, v_l)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, {"lp": layer_params, "k": kv["k"], "v": kv["v"]})
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    logits = (x @ head if head is not None
+              else x @ params["embed"].T.astype(x.dtype))
+    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}

@@ -1,0 +1,118 @@
+"""HF checkpoint → stacked-layer JAX params.
+
+Reads `*.safetensors` from an HF-style model dir (the artifact the MDC's
+model_path points at) and produces the stacked layout models/llama.py expects.
+Torch linear weights are stored `[out, in]` → transposed to `[in, out]` for
+right-multiplication; per-layer tensors are stacked on a leading L axis so
+`lax.scan` consumes them directly.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig
+
+try:
+    from safetensors import safe_open
+    _HAVE_ST = True
+except ImportError:  # pragma: no cover
+    _HAVE_ST = False
+
+_LAYER_MAP = {
+    "input_layernorm.weight": ("ln1", False),
+    "post_attention_layernorm.weight": ("ln2", False),
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.o_proj.weight": ("wo", True),
+    "mlp.gate_proj.weight": ("gate", True),
+    "mlp.up_proj.weight": ("up", True),
+    "mlp.down_proj.weight": ("down", True),
+}
+
+
+def _iter_safetensors(model_dir: str):
+    files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
+    if not files:
+        raise FileNotFoundError(f"no .safetensors under {model_dir}")
+    for path in files:
+        with safe_open(path, framework="np") as f:
+            for name in f.keys():
+                yield name, f.get_tensor(name)
+
+
+def load_llama_params(model_dir: str, cfg: Optional[ModelConfig] = None,
+                      dtype=jnp.bfloat16) -> Dict[str, jax.Array]:
+    """Load an HF llama/qwen-style checkpoint into the stacked param pytree."""
+    if not _HAVE_ST:
+        raise RuntimeError("safetensors not available")
+    cfg = cfg or ModelConfig.from_model_dir(model_dir)
+    L = cfg.num_layers
+    staging: Dict[str, list] = {}
+    singles: Dict[str, np.ndarray] = {}
+    for name, tensor in _iter_safetensors(model_dir):
+        if name == "model.embed_tokens.weight":
+            singles["embed"] = tensor
+        elif name == "model.norm.weight":
+            singles["final_norm"] = tensor
+        elif name == "lm_head.weight":
+            singles["lm_head"] = tensor.T
+        elif name.startswith("model.layers."):
+            rest = name[len("model.layers."):]
+            idx_str, sub = rest.split(".", 1)
+            mapped = _LAYER_MAP.get(sub)
+            if mapped is None:
+                continue  # rotary inv_freq buffers, biases handled elsewhere
+            key, transpose = mapped
+            arr = tensor.T if transpose else tensor
+            staging.setdefault(key, [None] * L)[int(idx_str)] = arr
+
+    params: Dict[str, jax.Array] = {}
+    for key, arr in singles.items():
+        params[key] = jnp.asarray(arr, dtype=dtype)
+    for key, per_layer in staging.items():
+        missing = [i for i, a in enumerate(per_layer) if a is None]
+        if missing:
+            raise ValueError(f"checkpoint missing layers {missing} for {key}")
+        params[f"layers.{key}"] = jnp.asarray(
+            np.stack(per_layer, axis=0), dtype=dtype)
+    if "lm_head" not in params and not cfg.tie_word_embeddings:
+        # some checkpoints tie implicitly by omitting lm_head
+        cfg.tie_word_embeddings = True
+    return params
+
+
+def save_hf_style(params: Dict[str, jax.Array], cfg: ModelConfig,
+                  out_dir: str) -> None:
+    """Write params back out as a single HF-style safetensors file (used by
+    tests to cross-check against the torch reference implementation)."""
+    from safetensors.numpy import save_file
+    os.makedirs(out_dir, exist_ok=True)
+
+    def c(a) -> np.ndarray:
+        # save_file serializes the raw buffer — it MUST be C-contiguous
+        # (np.asarray of a jax array can surface a column-major buffer).
+        return np.ascontiguousarray(np.asarray(a, np.float32))
+
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": c(params["embed"]),
+        "model.norm.weight": c(params["final_norm"]),
+    }
+    if "lm_head" in params:
+        out["lm_head.weight"] = c(np.asarray(params["lm_head"], np.float32).T)
+    inv = {v[0]: (k, v[1]) for k, v in _LAYER_MAP.items()}
+    for key, (hf_sub, transpose) in inv.items():
+        stacked = np.ascontiguousarray(
+            np.asarray(params[f"layers.{key}"], np.float32))
+        for i in range(stacked.shape[0]):
+            arr = stacked[i].T if transpose else stacked[i]
+            out[f"model.layers.{i}.{hf_sub}"] = np.ascontiguousarray(arr)
+    save_file(out, os.path.join(out_dir, "model.safetensors"))

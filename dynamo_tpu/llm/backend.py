@@ -169,7 +169,9 @@ class Backend(Operator):
                 out: BackendOutput = ann.data
                 texts: List[str] = []
                 trigger: Optional[StopTrigger] = None
+                consumed: List[int] = []
                 for tid in out.token_ids:
+                    consumed.append(tid)
                     res = decoder.step(tid)
                     if res.text:
                         texts.append(res.text)
@@ -177,7 +179,9 @@ class Backend(Operator):
                         trigger = res.stop_trigger
                         break
                 new = BackendOutput(
-                    token_ids=out.token_ids,
+                    # truncate to what the decoder consumed so usage
+                    # accounting matches the visible completion
+                    token_ids=consumed,
                     text="".join(texts) if texts else None,
                     cum_log_probs=out.cum_log_probs,
                     log_probs=out.log_probs,

@@ -1,0 +1,66 @@
+"""JAX engine adapter: EngineCore → AsyncEngine[PreprocessedRequest, ...].
+
+The reference's engines translate BackendInput into vLLM/SGLang/TRT-LLM wire
+protocols (lib/llm/src/engines/*); here the "engine" is in-process JAX, so
+this adapter only maps the request, streams sampled tokens out of the slot
+queue, and honors step-granular cancellation.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Optional
+
+from ...engine.config import EngineConfig, ModelConfig
+from ...engine.core import FINISH_SENTINEL, EngineCore, EngineRequest
+from ...engine.sampling import SlotSampling
+from ...runtime.engine import AsyncEngine, ManyOut, ResponseStream, SingleIn
+from ..protocols.annotated import Annotated
+from ..protocols.common import BackendOutput, FinishReason, PreprocessedRequest
+
+
+class JaxEngine(AsyncEngine):
+    """Serves the engine-internal token protocol from an EngineCore."""
+
+    def __init__(self, core: EngineCore):
+        self.core = core
+
+    @classmethod
+    def from_model_dir(cls, model_dir: str,
+                       engine_cfg: Optional[EngineConfig] = None,
+                       load_weights: bool = True, **core_kwargs) -> "JaxEngine":
+        model_cfg = ModelConfig.from_model_dir(model_dir)
+        engine_cfg = engine_cfg or EngineConfig()
+        params = None
+        if load_weights:
+            from ...engine.weights import load_llama_params
+            params = load_llama_params(model_dir, model_cfg)
+        return cls(EngineCore(model_cfg, engine_cfg, params=params,
+                              **core_kwargs))
+
+    async def generate(self, request: SingleIn) -> ManyOut:
+        pre: PreprocessedRequest = request.data
+        sc = pre.stop_conditions
+        req = EngineRequest(
+            rid=request.id,
+            prompt=list(pre.token_ids),
+            sampling=SlotSampling.from_options(pre.sampling_options),
+            max_new_tokens=sc.max_tokens or 16384,
+            eos_ids=frozenset(() if sc.ignore_eos else
+                              (sc.stop_token_ids_hidden or pre.eos_token_ids)),
+            ctx=request.ctx,
+        )
+        await self.core.submit(req)
+
+        async def stream() -> AsyncIterator[Annotated[BackendOutput]]:
+            while True:
+                item, payload = await req.out_queue.get()
+                if item is FINISH_SENTINEL:
+                    reason: FinishReason = payload
+                    yield Annotated.from_data(BackendOutput.final(reason))
+                    return
+                token, logprob = item, payload
+                yield Annotated.from_data(BackendOutput(
+                    token_ids=[token], log_probs=[logprob],
+                    cum_log_probs=None))
+
+        return ResponseStream(stream(), request.ctx)

@@ -210,7 +210,13 @@ class HttpService:
             "Connection": "keep-alive",
             "X-Accel-Buffering": "no",
         })
-        await resp.prepare(request)
+        try:
+            await resp.prepare(request)
+        except (ConnectionResetError, asyncio.CancelledError):
+            guard.mark_cancelled()
+            guard.close()
+            ectx.kill()
+            raise
 
         # Disconnect monitor (reference openai.rs:406): if the client goes
         # away mid-stream, kill() the context so the engine frees its slot.
@@ -238,8 +244,9 @@ class HttpService:
                         chunk = {k: v for k, v in chunk.items() if k != "usage"}
                         ann = Annotated(data=chunk, id=ann.id, event=ann.event,
                                         comment=ann.comment)
-                if _chunk_token_count(chunk):
-                    guard.note_token(_chunk_token_count(chunk))
+                n_tok = _chunk_token_count(chunk)
+                if n_tok:
+                    guard.note_token(n_tok)
                 try:
                     await resp.write(encode_annotated(ann).encode())
                 except (ConnectionResetError, asyncio.CancelledError):

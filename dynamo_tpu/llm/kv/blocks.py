@@ -1,0 +1,83 @@
+"""Token-block hashing: the identity scheme for KV reuse and routing.
+
+Reference: lib/llm/src/tokens.rs:27-200 + tokens/blocks.rs — token sequences
+split into fixed-size blocks; per-block `block_hash = xxh3(tokens)` and
+chained `sequence_hash = xxh3([parent_seq_hash, block_hash])`, seed 1337
+(kv_router/indexer.rs:64). The sequence hash identifies a block's *content in
+context* (same tokens after a different prefix hash differently), which is
+what makes prefix matching a single hash lookup.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Sequence
+
+import xxhash
+
+HASH_SEED = 1337
+
+
+def hash_tokens(tokens: Sequence[int]) -> int:
+    """Local block hash: xxh3_64 over the little-endian u32 token ids."""
+    buf = struct.pack(f"<{len(tokens)}I", *tokens)
+    return xxhash.xxh3_64_intdigest(buf, seed=HASH_SEED)
+
+
+def chain_hash(parent_seq_hash: Optional[int], block_hash: int) -> int:
+    """sequence_hash = xxh3([parent_seq_hash, block_hash])."""
+    if parent_seq_hash is None:
+        buf = struct.pack("<Q", block_hash)
+    else:
+        buf = struct.pack("<QQ", parent_seq_hash, block_hash)
+    return xxhash.xxh3_64_intdigest(buf, seed=HASH_SEED)
+
+
+class TokenBlockSequence:
+    """Splits a token stream into fixed-size blocks with chained hashes.
+
+    Incremental: `extend` consumes tokens one block at a time so the decode
+    loop can register blocks as they fill.
+    """
+
+    def __init__(self, block_size: int,
+                 tokens: Optional[Sequence[int]] = None):
+        self.block_size = block_size
+        self.tokens: List[int] = []
+        self.block_hashes: List[int] = []      # local hash per full block
+        self.sequence_hashes: List[int] = []   # chained hash per full block
+        if tokens:
+            self.extend(tokens)
+
+    def extend(self, tokens: Sequence[int]) -> None:
+        self.tokens.extend(int(t) for t in tokens)
+        self._absorb()
+
+    def append(self, token: int) -> None:
+        self.tokens.append(int(token))
+        self._absorb()
+
+    def _absorb(self) -> None:
+        bs = self.block_size
+        while len(self.block_hashes) < len(self.tokens) // bs:
+            i = len(self.block_hashes)
+            block = self.tokens[i * bs:(i + 1) * bs]
+            bh = hash_tokens(block)
+            parent = self.sequence_hashes[-1] if self.sequence_hashes else None
+            self.block_hashes.append(bh)
+            self.sequence_hashes.append(chain_hash(parent, bh))
+
+    @property
+    def num_full_blocks(self) -> int:
+        return len(self.block_hashes)
+
+    def partial_tokens(self) -> List[int]:
+        return self.tokens[self.num_full_blocks * self.block_size:]
+
+
+def compute_block_hashes(tokens: Sequence[int], block_size: int
+                         ) -> List[int]:
+    """Chained sequence hashes for every full block of `tokens` (reference
+    `compute_block_hash_for_seq`, kv_router/indexer.rs:123)."""
+    seq = TokenBlockSequence(block_size, tokens)
+    return seq.sequence_hashes

@@ -1,0 +1,241 @@
+"""Refcounted device-block pool with prefix reuse and LRU+priority eviction.
+
+TPU-native redesign of the reference's three cooperating pieces
+(lib/llm/src/kv/manager.rs `KvStorageManager`, kv/reuse.rs `AvailableBlocks`
+with its `PriorityKey{priority, return_tick, seq_hash}` eviction order, and
+kv/reserved.rs `ReservedBlocks`): one pool object owning every block of the
+engine's flat paged HBM pool.
+
+States per block:
+- uninitialized: free, content garbage (`_free_uninit`)
+- inflight: refcount > 0, attached to ≥1 running sequence
+- reusable: refcount == 0 but content valid & registered under its
+  sequence hash — eligible for prefix matching, evicted priority-then-LRU
+  when uninitialized blocks run out.
+
+Single-threaded by design (one pool per engine loop — the same actor
+discipline the reference enforces with its mpsc progress engine,
+reuse.rs:638; here the asyncio loop IS the actor).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .blocks import TokenBlockSequence
+
+
+@dataclasses.dataclass
+class BlockMeta:
+    block_id: int
+    seq_hash: Optional[int] = None        # set when registered
+    tokens_hash: Optional[int] = None     # local (unchained) hash
+    parent_hash: Optional[int] = None
+    refcount: int = 0
+    priority: int = 0                     # lower evicts first
+    return_tick: int = 0                  # LRU tiebreak
+
+
+class KvBlockPool:
+    """Owns block ids [1, num_blocks) — block 0 is the engine's trash block."""
+
+    def __init__(self, num_blocks: int,
+                 on_stored: Optional[Callable] = None,
+                 on_removed: Optional[Callable] = None):
+        self.num_blocks = num_blocks
+        self._meta: Dict[int, BlockMeta] = {
+            i: BlockMeta(i) for i in range(1, num_blocks)}
+        self._free_uninit: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._by_hash: Dict[int, int] = {}          # seq_hash → block_id
+        self._reusable: Dict[int, int] = {}         # block_id → seq_hash (dict = insertion/LRU order)
+        self._tick = 0
+        self.on_stored = on_stored
+        self.on_removed = on_removed
+        # stats
+        self.match_queries = 0
+        self.match_hits = 0
+
+    # ------------------------------------------------------------- queries
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free_uninit) + len(self._reusable)
+
+    @property
+    def used_blocks(self) -> int:
+        return (self.num_blocks - 1) - self.free_blocks
+
+    @property
+    def reusable_blocks(self) -> int:
+        return len(self._reusable)
+
+    def hit_rate(self) -> float:
+        return self.match_hits / max(self.match_queries, 1)
+
+    # ------------------------------------------------------------ matching
+    def match_prefix(self, seq_hashes: Sequence[int]) -> List[int]:
+        """Longest-prefix match: returns device block ids whose registered
+        content equals the leading chained hashes. Matched blocks get a
+        refcount hold (caller must release them later)."""
+        out: List[int] = []
+        for h in seq_hashes:
+            self.match_queries += 1
+            bid = self._by_hash.get(h)
+            if bid is None:
+                break
+            self.match_hits += 1
+            meta = self._meta[bid]
+            if meta.refcount == 0:
+                self._reusable.pop(bid, None)
+            meta.refcount += 1
+            out.append(bid)
+        return out
+
+    # ----------------------------------------------------------- allocate
+    def alloc_uninit(self, n: int) -> Optional[List[int]]:
+        """n fresh blocks (content garbage), evicting reusable LRU if needed.
+        Returns None if even eviction can't satisfy."""
+        if n > self.free_blocks:
+            return None
+        out: List[int] = []
+        for _ in range(n):
+            if self._free_uninit:
+                bid = self._free_uninit.pop()
+            else:
+                bid = self._evict_one()
+            meta = self._meta[bid]
+            meta.refcount = 1
+            out.append(bid)
+        return out
+
+    def _evict_one(self) -> int:
+        # priority first (lower first), then LRU by return_tick — the
+        # reference's PriorityKey ordering (reuse.rs).
+        bid = min(self._reusable,
+                  key=lambda b: (self._meta[b].priority,
+                                 self._meta[b].return_tick))
+        self._invalidate(bid)
+        return bid
+
+    def _invalidate(self, bid: int) -> None:
+        meta = self._meta[bid]
+        self._reusable.pop(bid, None)
+        if meta.seq_hash is not None:
+            self._by_hash.pop(meta.seq_hash, None)
+            if self.on_removed is not None:
+                self.on_removed([meta.seq_hash])
+        meta.seq_hash = None
+        meta.tokens_hash = None
+        meta.parent_hash = None
+
+    # ------------------------------------------------------------ register
+    def register(self, bid: int, seq_hash: int, tokens_hash: int,
+                 parent_hash: Optional[int], priority: int = 0) -> None:
+        """Declare a block's content: it now holds the KV for the block whose
+        chained hash is seq_hash. Emits a `stored` event."""
+        meta = self._meta[bid]
+        if meta.seq_hash == seq_hash:
+            return
+        existing = self._by_hash.get(seq_hash)
+        if existing is not None and existing != bid:
+            # duplicate content (two seqs computed the same prefix block):
+            # keep the first registration; this block stays unregistered and
+            # will return to the uninit pool on release.
+            return
+        if meta.seq_hash is not None:
+            self._by_hash.pop(meta.seq_hash, None)
+        meta.seq_hash = seq_hash
+        meta.tokens_hash = tokens_hash
+        meta.parent_hash = parent_hash
+        meta.priority = priority
+        self._by_hash[seq_hash] = bid
+        if self.on_stored is not None:
+            self.on_stored(bid, seq_hash, tokens_hash, parent_hash)
+
+    # ------------------------------------------------------------- release
+    def release(self, blocks: Sequence[int]) -> None:
+        """Drop one reference from each block; refcount-0 blocks become
+        reusable (if registered) or uninitialized."""
+        for bid in blocks:
+            if bid == 0:
+                continue
+            meta = self._meta[bid]
+            meta.refcount = max(meta.refcount - 1, 0)
+            if meta.refcount == 0:
+                self._tick += 1
+                meta.return_tick = self._tick
+                if meta.seq_hash is not None:
+                    self._reusable[bid] = meta.seq_hash
+                else:
+                    self._free_uninit.append(bid)
+
+    def reset(self) -> None:
+        """Drop all reusable content (reference reuse.rs `reset`)."""
+        for bid in list(self._reusable):
+            self._invalidate(bid)
+            self._free_uninit.append(bid)
+
+
+@dataclasses.dataclass
+class PrefillPlan:
+    """Outcome of preparing a sequence for prefill (reference
+    `KvStorageManager::prepare_prefill_sequence`, kv/manager.rs:21-168)."""
+
+    hit_blocks: List[int]
+    new_blocks: List[int]
+    hit_tokens: int
+    seq: TokenBlockSequence
+
+    @property
+    def all_blocks(self) -> List[int]:
+        return self.hit_blocks + self.new_blocks
+
+
+class KvBlockManager:
+    """Pool + hashing glue the engine admit path calls."""
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 on_stored=None, on_removed=None, enable_reuse: bool = True):
+        self.block_size = block_size
+        self.pool = KvBlockPool(num_blocks, on_stored=on_stored,
+                                on_removed=on_removed)
+        self.enable_reuse = enable_reuse
+
+    def prepare_prefill(self, prompt: Sequence[int],
+                        extra_blocks: int = 1) -> Optional[PrefillPlan]:
+        """Match the prompt's full blocks against the pool, allocate the
+        remainder (+ room for `extra_blocks` of generation). None = out of
+        memory. At least one prompt token is always left to recompute so
+        prefill produces the first-token logits."""
+        seq = TokenBlockSequence(self.block_size, prompt)
+        matchable = seq.sequence_hashes
+        # never match the *entire* prompt — hold back the final block so at
+        # least one token runs through prefill
+        if len(prompt) % self.block_size == 0 and matchable:
+            matchable = matchable[:-1]
+        hit_blocks = (self.pool.match_prefix(matchable)
+                      if self.enable_reuse else [])
+        hit_tokens = len(hit_blocks) * self.block_size
+        total_needed = (len(prompt) + extra_blocks * self.block_size
+                        + self.block_size - 1) // self.block_size
+        n_new = total_needed - len(hit_blocks)
+        new_blocks = self.pool.alloc_uninit(n_new)
+        if new_blocks is None:
+            self.pool.release(hit_blocks)
+            return None
+        return PrefillPlan(hit_blocks=hit_blocks, new_blocks=new_blocks,
+                           hit_tokens=hit_tokens, seq=seq)
+
+    def register_full_blocks(self, plan_blocks: List[int],
+                             seq: TokenBlockSequence,
+                             already_registered: int) -> int:
+        """Register every newly-full block of `seq` (device block order ==
+        block-hash order). Returns the new count of registered blocks."""
+        n_full = seq.num_full_blocks
+        for i in range(already_registered, n_full):
+            if i >= len(plan_blocks):
+                break
+            parent = seq.sequence_hashes[i - 1] if i > 0 else None
+            self.pool.register(plan_blocks[i], seq.sequence_hashes[i],
+                               seq.block_hashes[i], parent)
+        return min(n_full, len(plan_blocks))

@@ -95,6 +95,12 @@ class OpenAIPreprocessor(Operator):
 
     # ------------------------------------------------------------------ fwd
     def preprocess_chat(self, req: ChatCompletionRequest) -> PreprocessedRequest:
+        return self._preprocess_chat(req)[0]
+
+    def _preprocess_chat(self, req: ChatCompletionRequest
+                         ) -> tuple[PreprocessedRequest, str]:
+        """Returns (request, formatted_prompt) — kept stateless so one
+        operator instance serves concurrent requests."""
         use_raw = bool(req.nvext and req.nvext.use_raw_prompt)
         if use_raw and len(req.messages) == 1:
             prompt = req.messages[0].text()
@@ -112,8 +118,7 @@ class OpenAIPreprocessor(Operator):
         pre = self._common(req, token_ids, req.effective_max_tokens(),
                            req.stop_list())
         pre.annotations = list((req.nvext.annotations if req.nvext else None) or [])
-        self._formatted_prompt = prompt  # surfaced via annotation below
-        return pre
+        return pre, prompt
 
     def preprocess_completion(self, req: CompletionRequest) -> PreprocessedRequest:
         if isinstance(req.prompt, str):
@@ -178,8 +183,11 @@ class OpenAIPreprocessor(Operator):
             req = (ChatCompletionRequest.model_validate(req)
                    if "messages" in req else CompletionRequest.model_validate(req))
         is_chat = isinstance(req, ChatCompletionRequest)
-        pre = (self.preprocess_chat(req) if is_chat
-               else self.preprocess_completion(req))
+        if is_chat:
+            pre, formatted_prompt = self._preprocess_chat(req)
+        else:
+            pre = self.preprocess_completion(req)
+            formatted_prompt = None
         prompt_len = len(pre.token_ids)
         annotations: List[Annotated] = []
         if ANNOTATION_TOKEN_IDS in pre.annotations:
@@ -187,7 +195,7 @@ class OpenAIPreprocessor(Operator):
                 ANNOTATION_TOKEN_IDS, pre.token_ids))
         if is_chat and ANNOTATION_FORMATTED_PROMPT in pre.annotations:
             annotations.append(Annotated.from_annotation(
-                ANNOTATION_FORMATTED_PROMPT, self._formatted_prompt))
+                ANNOTATION_FORMATTED_PROMPT, formatted_prompt))
 
         downstream = await next_engine.generate(request.transfer(pre))
 

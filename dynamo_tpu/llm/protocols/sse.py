@@ -99,8 +99,9 @@ class SseParser:
         return None
 
     def finish(self) -> Optional[SseEvent]:
-        """Flush a trailing event not terminated by a blank line."""
-        for ev in self.push("\n"):
+        """Flush a trailing event not terminated by a blank line (the final
+        line itself may also lack its newline, so push two)."""
+        for ev in self.push("\n\n"):
             return ev
         return None
 
@@ -121,10 +122,20 @@ def event_to_annotated(ev: SseEvent) -> Annotated[dict]:
 
 
 async def parse_sse_stream(chunks: AsyncIterator[bytes]) -> AsyncIterator[Annotated[dict]]:
-    """Parse an async byte stream into Annotated dicts; stops at [DONE]."""
+    """Parse an async byte stream into Annotated dicts; stops at [DONE].
+    UTF-8 is decoded incrementally so multi-byte characters split across
+    network chunks survive."""
+    import codecs
+    decoder = codecs.getincrementaldecoder("utf-8")(errors="replace")
     parser = SseParser()
     async for chunk in chunks:
-        for ev in parser.push(chunk.decode("utf-8", errors="replace")):
+        for ev in parser.push(decoder.decode(chunk)):
+            if ev.is_done:
+                return
+            yield event_to_annotated(ev)
+    tail_text = decoder.decode(b"", final=True)
+    if tail_text:
+        for ev in parser.push(tail_text):
             if ev.is_done:
                 return
             yield event_to_annotated(ev)

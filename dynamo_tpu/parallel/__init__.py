@@ -1,0 +1,5 @@
+from .sharding import (batch_pspecs, kv_pspecs, make_mesh, param_pspecs,
+                       shard_kv, shard_params)
+
+__all__ = ["make_mesh", "param_pspecs", "kv_pspecs", "batch_pspecs",
+           "shard_params", "shard_kv"]

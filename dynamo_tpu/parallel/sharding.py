@@ -1,0 +1,96 @@
+"""Device-mesh sharding layouts for the engine.
+
+The reference reaches TP/PP by passing flags to external engines
+(SURVEY.md §2.3 parallelism inventory: --tensor-parallel-size wired into
+vLLM/SGLang; multinode via Ray/torch-distributed). TPU-native, parallelism is
+a compiler problem: pick a `jax.sharding.Mesh`, annotate params/KV/batch with
+PartitionSpecs, and XLA inserts the collectives over ICI.
+
+Mesh axes:
+- "dp": data parallel — batch slots split across replicas
+- "tp": tensor parallel — attention heads / MLP intermediate / KV heads split
+- "sp": sequence parallel — ring-attention prefill for long context
+- "ep": expert parallel — MoE experts split (models with num_experts > 0)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..engine.config import ModelConfig
+
+AXES = ("dp", "tp", "sp", "ep")
+
+
+def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1, ep: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * tp * sp * ep
+    if need > len(devices):
+        raise ValueError(f"mesh dp*tp*sp*ep={need} > {len(devices)} devices")
+    arr = np.array(devices[:need]).reshape(dp, tp, sp, ep)
+    return Mesh(arr, AXES)
+
+
+def param_pspecs(cfg: ModelConfig) -> Dict[str, P]:
+    """Megatron-style TP layout: column-parallel qkv/gate/up, row-parallel
+    o/down (XLA inserts the psum on the row-parallel matmul output);
+    vocab-sharded embedding + lm_head."""
+    specs = {
+        "embed": P("tp", None),           # vocab-sharded
+        "final_norm": P(),
+        "layers.ln1": P(None, None),
+        "layers.ln2": P(None, None),
+        "layers.wq": P(None, None, "tp"),
+        "layers.wk": P(None, None, "tp"),
+        "layers.wv": P(None, None, "tp"),
+        "layers.wo": P(None, "tp", None),
+        "layers.gate": P(None, None, "tp"),
+        "layers.up": P(None, None, "tp"),
+        "layers.down": P(None, "tp", None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    if cfg.num_experts > 0:
+        specs.update({
+            "layers.router": P(None, None, None),
+            "layers.moe_gate": P(None, "ep", None, "tp"),
+            "layers.moe_up": P(None, "ep", None, "tp"),
+            "layers.moe_down": P(None, "ep", "tp", None),
+        })
+    return specs
+
+
+def kv_pspecs() -> Dict[str, P]:
+    # KV heads split over tp — the KV pool for one head lives wholly on one
+    # chip, so paged-attention DMA never crosses chips.
+    return {"k": P(None, "tp", None, None), "v": P(None, "tp", None, None)}
+
+
+def batch_pspecs() -> Dict[str, P]:
+    return {
+        "tokens": P("dp"),
+        "positions": P("dp"),
+        "block_tables": P("dp", None),
+        "seq_lens": P("dp"),
+    }
+
+
+def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
+    specs = param_pspecs(cfg)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs.get(k, P())))
+            for k, v in params.items()}
+
+
+def shard_kv(kv: dict, mesh: Mesh) -> dict:
+    specs = kv_pspecs()
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in kv.items()}
+
+
+def named(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)

@@ -6,11 +6,20 @@ is XLA's forced host platform device count)."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The image's sitecustomize imports jax at interpreter startup with
+# JAX_PLATFORMS=axon (the tunneled TPU). For tests we must BOTH set the env
+# (for subprocesses) and update the already-loaded jax config, or everything
+# silently runs on the one real TPU chip — slow, serialized, and with MXU
+# bf16 matmul numerics that break float32 reference comparisons.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
