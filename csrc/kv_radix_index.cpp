@@ -1,0 +1,195 @@
+// Global KV radix index: block-hash prefix tree → per-worker overlap counts.
+//
+// Native component per SURVEY.md §2.3: the reference implements this in Rust
+// (lib/llm/src/kv_router/indexer.rs:139-790 — RadixTree::find_matches,
+// apply_event, remove_worker). This is the same data structure implemented
+// fresh in C++ with a C ABI consumed from Python via ctypes. It is the hot
+// path of KV-aware routing: every request does a prefix walk, and every
+// engine block store/evict lands here as an event.
+//
+// Threading model: single-writer actor (the Python indexer task), so no
+// internal locking — same discipline as the reference's mpsc-fed tree.
+//
+// Build: g++ -O3 -shared -fPIC -o libdynkv.so kv_radix_index.cpp
+
+#include <cstdint>
+#include <cstddef>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+#include <memory>
+
+namespace {
+
+using WorkerId = int64_t;
+using BlockHash = uint64_t;
+
+struct Node {
+    BlockHash hash = 0;
+    Node* parent = nullptr;
+    std::unordered_map<BlockHash, std::unique_ptr<Node>> children;
+    std::unordered_set<WorkerId> workers;
+};
+
+struct RadixIndex {
+    Node root;
+    // every node addressable by its (chained) block hash — chained hashes
+    // are globally unique per content-in-context, so a flat map is sound
+    std::unordered_map<BlockHash, Node*> by_hash;
+    // worker → nodes, for O(worker footprint) removal on lease expiry
+    std::unordered_map<WorkerId, std::unordered_set<Node*>> worker_nodes;
+    uint64_t event_count = 0;
+
+    Node* find(BlockHash h) {
+        if (h == 0) return &root;
+        auto it = by_hash.find(h);
+        return it == by_hash.end() ? nullptr : it->second;
+    }
+
+    void apply_stored(WorkerId w, BlockHash parent_hash,
+                      const BlockHash* hashes, size_t n) {
+        event_count++;
+        Node* node = find(parent_hash);
+        if (node == nullptr) {
+            // parent unknown (e.g. events arrived out of order after a prune):
+            // root the chain at the top — matching still works because the
+            // chained hash encodes the full prefix.
+            node = &root;
+        }
+        for (size_t i = 0; i < n; i++) {
+            BlockHash h = hashes[i];
+            auto it = node->children.find(h);
+            Node* child;
+            if (it == node->children.end()) {
+                auto owned = std::make_unique<Node>();
+                child = owned.get();
+                child->hash = h;
+                child->parent = node;
+                node->children.emplace(h, std::move(owned));
+                by_hash.emplace(h, child);
+            } else {
+                child = it->second.get();
+            }
+            child->workers.insert(w);
+            worker_nodes[w].insert(child);
+            node = child;
+        }
+    }
+
+    void detach_if_empty(Node* node) {
+        while (node != nullptr && node != &root && node->workers.empty() &&
+               node->children.empty()) {
+            Node* parent = node->parent;
+            by_hash.erase(node->hash);
+            parent->children.erase(node->hash);  // frees node
+            node = parent;
+        }
+    }
+
+    void apply_removed(WorkerId w, const BlockHash* hashes, size_t n) {
+        event_count++;
+        for (size_t i = 0; i < n; i++) {
+            Node* node = find(hashes[i]);
+            if (node == nullptr || node == &root) continue;
+            node->workers.erase(w);
+            auto wn = worker_nodes.find(w);
+            if (wn != worker_nodes.end()) wn->second.erase(node);
+            detach_if_empty(node);
+        }
+    }
+
+    void remove_worker(WorkerId w) {
+        event_count++;
+        auto it = worker_nodes.find(w);
+        if (it == worker_nodes.end()) return;
+        std::vector<Node*> nodes(it->second.begin(), it->second.end());
+        worker_nodes.erase(it);
+        for (Node* node : nodes) node->workers.erase(w);
+        for (Node* node : nodes) {
+            // node may already have been freed by an earlier detach — guard
+            // by re-resolving through by_hash
+            auto bh = by_hash.find(node->hash);
+            if (bh != by_hash.end() && bh->second == node)
+                detach_if_empty(node);
+        }
+    }
+
+    // Walk the request's chained block hashes from the root; a worker's
+    // score is its number of *consecutive* leading blocks present
+    // (reference RadixTree::find_matches, indexer.rs:239).
+    size_t find_matches(const BlockHash* hashes, size_t n,
+                        WorkerId* out_workers, uint32_t* out_counts,
+                        size_t cap, int early_exit) {
+        std::unordered_map<WorkerId, uint32_t> scores;
+        Node* node = &root;
+        for (size_t depth = 0; depth < n; depth++) {
+            auto it = node->children.find(hashes[depth]);
+            if (it == node->children.end()) break;
+            node = it->second.get();
+            bool any = false;
+            for (WorkerId w : node->workers) {
+                auto s = scores.find(w);
+                uint32_t cur = (s == scores.end()) ? 0 : s->second;
+                if (cur == depth) {  // consecutive requirement
+                    scores[w] = static_cast<uint32_t>(depth) + 1;
+                    any = true;
+                }
+            }
+            if (early_exit && !any) break;
+        }
+        size_t k = 0;
+        for (const auto& [w, c] : scores) {
+            if (k >= cap) break;
+            out_workers[k] = w;
+            out_counts[k] = c;
+            k++;
+        }
+        return k;
+    }
+
+    size_t node_count(const Node* n) const {
+        size_t c = 1;
+        for (const auto& [h, child] : n->children) c += node_count(child.get());
+        return c;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dyn_kv_index_new() { return new RadixIndex(); }
+
+void dyn_kv_index_free(void* p) { delete static_cast<RadixIndex*>(p); }
+
+void dyn_kv_index_apply_stored(void* p, int64_t worker, uint64_t parent_hash,
+                               const uint64_t* hashes, size_t n) {
+    static_cast<RadixIndex*>(p)->apply_stored(worker, parent_hash, hashes, n);
+}
+
+void dyn_kv_index_apply_removed(void* p, int64_t worker,
+                                const uint64_t* hashes, size_t n) {
+    static_cast<RadixIndex*>(p)->apply_removed(worker, hashes, n);
+}
+
+void dyn_kv_index_remove_worker(void* p, int64_t worker) {
+    static_cast<RadixIndex*>(p)->remove_worker(worker);
+}
+
+size_t dyn_kv_index_find_matches(void* p, const uint64_t* hashes, size_t n,
+                                 int64_t* out_workers, uint32_t* out_counts,
+                                 size_t cap, int early_exit) {
+    return static_cast<RadixIndex*>(p)->find_matches(
+        hashes, n, out_workers, out_counts, cap, early_exit);
+}
+
+size_t dyn_kv_index_node_count(void* p) {
+    auto* idx = static_cast<RadixIndex*>(p);
+    return idx->node_count(&idx->root) - 1;  // exclude root
+}
+
+uint64_t dyn_kv_index_event_count(void* p) {
+    return static_cast<RadixIndex*>(p)->event_count;
+}
+
+}  // extern "C"

@@ -1,7 +1,7 @@
 """TPU inference engine: JAX/XLA/Pallas models, paged KV, continuous batching."""
 
 from .config import EngineConfig, ModelConfig
-from .core import BlockAllocator, EngineCore, EngineRequest, ForwardPassMetrics
+from .core import EngineCore, EngineRequest, ForwardPassMetrics
 
 __all__ = ["EngineConfig", "ModelConfig", "EngineCore", "EngineRequest",
-           "BlockAllocator", "ForwardPassMetrics"]
+           "ForwardPassMetrics"]

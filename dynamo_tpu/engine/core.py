@@ -29,59 +29,13 @@ import numpy as np
 
 from ..llm.kv.blocks import TokenBlockSequence
 from ..llm.kv.pool import KvBlockManager
+from ..llm.kv_router.protocols import ForwardPassMetrics
 from ..llm.protocols.common import FinishReason
 from .config import EngineConfig, ModelConfig
 from .models import llama
 from .sampling import SlotSampling, make_slot_keys, sample_tokens
 
 logger = logging.getLogger("dynamo_tpu.engine")
-
-
-@dataclasses.dataclass
-class ForwardPassMetrics:
-    """Worker load metrics published to the router (reference
-    kv_router/protocols.rs:18-97)."""
-
-    request_active_slots: int = 0
-    request_total_slots: int = 0
-    kv_active_blocks: int = 0
-    kv_total_blocks: int = 0
-    num_requests_waiting: int = 0
-    gpu_cache_usage_perc: float = 0.0
-    gpu_prefix_cache_hit_rate: float = 0.0
-
-    def to_dict(self) -> dict:
-        return dataclasses.asdict(self)
-
-
-class BlockAllocator:
-    """Host-side free-list allocator over the flat paged KV pool.
-
-    Block 0 is reserved as the trash block (pad/inactive writes land there;
-    see models/llama.py docstrings)."""
-
-    def __init__(self, num_blocks: int):
-        self.num_blocks = num_blocks
-        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-
-    @property
-    def free_blocks(self) -> int:
-        return len(self._free)
-
-    @property
-    def used_blocks(self) -> int:
-        return self.num_blocks - 1 - len(self._free)
-
-    def alloc(self, n: int) -> Optional[List[int]]:
-        if n > len(self._free):
-            return None
-        out = [self._free.pop() for _ in range(n)]
-        return out
-
-    def free(self, blocks: List[int]) -> None:
-        for b in blocks:
-            if b != 0:
-                self._free.append(b)
 
 
 @dataclasses.dataclass
@@ -120,7 +74,8 @@ class EngineCore:
 
     def __init__(self, model_cfg: ModelConfig, engine_cfg: EngineConfig,
                  params: Optional[dict] = None, attn_impl: str = "auto",
-                 param_dtype=jnp.bfloat16, mesh=None):
+                 param_dtype=jnp.bfloat16, mesh=None,
+                 kv_event_publisher=None):
         self.model_cfg = model_cfg
         self.cfg = engine_cfg
         self.mesh = mesh
@@ -134,9 +89,15 @@ class EngineCore:
         self.kv = llama.init_kv_cache(
             model_cfg, engine_cfg.num_kv_blocks, engine_cfg.kv_block_size,
             dtype=param_dtype)
+        self.kv_event_publisher = kv_event_publisher
+        on_stored = (kv_event_publisher.publish_stored
+                     if kv_event_publisher is not None else None)
+        on_removed = (kv_event_publisher.publish_removed
+                      if kv_event_publisher is not None else None)
         self.kv_manager = KvBlockManager(
             engine_cfg.num_kv_blocks, engine_cfg.kv_block_size,
-            enable_reuse=engine_cfg.enable_prefix_reuse)
+            enable_reuse=engine_cfg.enable_prefix_reuse,
+            on_stored=on_stored, on_removed=on_removed)
         self.M = engine_cfg.max_blocks_per_seq
         self.B = engine_cfg.max_num_seqs
 

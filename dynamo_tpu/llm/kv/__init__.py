@@ -1,0 +1,6 @@
+from .blocks import (TokenBlockSequence, chain_hash, compute_block_hashes,
+                     hash_tokens)
+from .pool import KvBlockManager, KvBlockPool, PrefillPlan
+
+__all__ = ["TokenBlockSequence", "chain_hash", "compute_block_hashes",
+           "hash_tokens", "KvBlockManager", "KvBlockPool", "PrefillPlan"]

@@ -1,0 +1,12 @@
+from .indexer import KvIndexer, KvIndexerSharded, OverlapScores
+from .protocols import (ForwardPassMetrics, KVHitRateEvent, KvRemovedEvent,
+                        KvStoredEvent, RouterEvent)
+from .router import KvRouter
+from .scheduler import KvScheduler
+from .scoring import Endpoint, ProcessedEndpoints
+
+__all__ = [
+    "KvIndexer", "KvIndexerSharded", "OverlapScores", "KvRouter",
+    "KvScheduler", "Endpoint", "ProcessedEndpoints", "ForwardPassMetrics",
+    "KVHitRateEvent", "KvStoredEvent", "KvRemovedEvent", "RouterEvent",
+]

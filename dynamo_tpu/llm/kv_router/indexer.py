@@ -1,0 +1,287 @@
+"""KV indexer: the router's global radix/prefix index of which worker holds
+which KV blocks.
+
+Reference: lib/llm/src/kv_router/indexer.rs:139-790 (`RadixTree`,
+`KvIndexer::new` single-writer event task, `compute_block_hash_for_seq`,
+`KvIndexerSharded`). The tree itself is native C++ (csrc/kv_radix_index.cpp)
+behind ctypes, with a pure-Python fallback; both sit behind the same
+single-writer asyncio task so event application is serialized exactly like
+the reference's mpsc actor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ctypes
+from typing import Dict, List, Optional, Sequence
+
+from ...utils import native
+from ..kv.blocks import compute_block_hashes
+from .protocols import RouterEvent
+
+__all__ = ["OverlapScores", "KvIndexer", "RadixIndexNative",
+           "RadixIndexPython", "make_radix_index"]
+
+
+class OverlapScores:
+    """worker_id → number of consecutive leading request blocks that worker
+    already holds (reference `OverlapScores`)."""
+
+    def __init__(self, scores: Optional[Dict[int, int]] = None):
+        self.scores: Dict[int, int] = scores or {}
+
+    def best(self) -> Optional[int]:
+        if not self.scores:
+            return None
+        return max(self.scores, key=lambda w: self.scores[w])
+
+    def __repr__(self) -> str:
+        return f"OverlapScores({self.scores})"
+
+
+# ---------------------------------------------------------------------------
+# Native tree (C++ via ctypes)
+# ---------------------------------------------------------------------------
+
+
+class RadixIndexNative:
+    MAX_WORKERS = 4096
+
+    def __init__(self):
+        lib = native.load("dynkv", ["kv_radix_index.cpp"])
+        if lib is None:
+            raise RuntimeError("native radix index unavailable")
+        self._lib = lib
+        lib.dyn_kv_index_new.restype = ctypes.c_void_p
+        lib.dyn_kv_index_free.argtypes = [ctypes.c_void_p]
+        lib.dyn_kv_index_apply_stored.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t]
+        lib.dyn_kv_index_apply_removed.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t]
+        lib.dyn_kv_index_remove_worker.argtypes = [ctypes.c_void_p,
+                                                   ctypes.c_int64]
+        lib.dyn_kv_index_find_matches.restype = ctypes.c_size_t
+        lib.dyn_kv_index_find_matches.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_size_t, ctypes.c_int]
+        lib.dyn_kv_index_node_count.restype = ctypes.c_size_t
+        lib.dyn_kv_index_node_count.argtypes = [ctypes.c_void_p]
+        self._ptr = lib.dyn_kv_index_new()
+
+    def __del__(self):
+        ptr = getattr(self, "_ptr", None)
+        if ptr:
+            self._lib.dyn_kv_index_free(ptr)
+            self._ptr = None
+
+    @staticmethod
+    def _arr(hashes: Sequence[int]):
+        return (ctypes.c_uint64 * len(hashes))(*[h & 0xFFFFFFFFFFFFFFFF
+                                                 for h in hashes])
+
+    def apply_stored(self, worker_id: int, parent_hash: Optional[int],
+                     block_hashes: Sequence[int]) -> None:
+        self._lib.dyn_kv_index_apply_stored(
+            self._ptr, worker_id, (parent_hash or 0) & 0xFFFFFFFFFFFFFFFF,
+            self._arr(block_hashes), len(block_hashes))
+
+    def apply_removed(self, worker_id: int,
+                      block_hashes: Sequence[int]) -> None:
+        self._lib.dyn_kv_index_apply_removed(
+            self._ptr, worker_id, self._arr(block_hashes), len(block_hashes))
+
+    def remove_worker(self, worker_id: int) -> None:
+        self._lib.dyn_kv_index_remove_worker(self._ptr, worker_id)
+
+    def find_matches(self, block_hashes: Sequence[int]) -> OverlapScores:
+        cap = self.MAX_WORKERS
+        out_w = (ctypes.c_int64 * cap)()
+        out_c = (ctypes.c_uint32 * cap)()
+        n = self._lib.dyn_kv_index_find_matches(
+            self._ptr, self._arr(block_hashes), len(block_hashes),
+            out_w, out_c, cap, 1)
+        return OverlapScores({int(out_w[i]): int(out_c[i]) for i in range(n)})
+
+    def node_count(self) -> int:
+        return int(self._lib.dyn_kv_index_node_count(self._ptr))
+
+
+# ---------------------------------------------------------------------------
+# Python fallback (same semantics)
+# ---------------------------------------------------------------------------
+
+
+class _PyNode:
+    __slots__ = ("hash", "parent", "children", "workers")
+
+    def __init__(self, h: int = 0, parent=None):
+        self.hash = h
+        self.parent = parent
+        self.children: Dict[int, "_PyNode"] = {}
+        self.workers: set = set()
+
+
+class RadixIndexPython:
+    def __init__(self):
+        self._root = _PyNode()
+        self._by_hash: Dict[int, _PyNode] = {}
+        self._worker_nodes: Dict[int, set] = {}
+
+    def _find(self, h: Optional[int]) -> Optional[_PyNode]:
+        if not h:
+            return self._root
+        return self._by_hash.get(h)
+
+    def apply_stored(self, worker_id, parent_hash, block_hashes) -> None:
+        node = self._find(parent_hash) or self._root
+        for h in block_hashes:
+            child = node.children.get(h)
+            if child is None:
+                child = _PyNode(h, node)
+                node.children[h] = child
+                self._by_hash[h] = child
+            child.workers.add(worker_id)
+            self._worker_nodes.setdefault(worker_id, set()).add(child)
+            node = child
+
+    def _detach_if_empty(self, node: _PyNode) -> None:
+        while (node is not None and node is not self._root
+               and not node.workers and not node.children):
+            parent = node.parent
+            self._by_hash.pop(node.hash, None)
+            parent.children.pop(node.hash, None)
+            node = parent
+
+    def apply_removed(self, worker_id, block_hashes) -> None:
+        for h in block_hashes:
+            node = self._by_hash.get(h)
+            if node is None:
+                continue
+            node.workers.discard(worker_id)
+            nodes = self._worker_nodes.get(worker_id)
+            if nodes:
+                nodes.discard(node)
+            self._detach_if_empty(node)
+
+    def remove_worker(self, worker_id) -> None:
+        nodes = self._worker_nodes.pop(worker_id, set())
+        for node in nodes:
+            node.workers.discard(worker_id)
+        for node in nodes:
+            if self._by_hash.get(node.hash) is node:
+                self._detach_if_empty(node)
+
+    def find_matches(self, block_hashes) -> OverlapScores:
+        scores: Dict[int, int] = {}
+        node = self._root
+        for depth, h in enumerate(block_hashes):
+            node = node.children.get(h)
+            if node is None:
+                break
+            any_advance = False
+            for w in node.workers:
+                if scores.get(w, 0) == depth:
+                    scores[w] = depth + 1
+                    any_advance = True
+            if not any_advance:
+                break
+        return OverlapScores(scores)
+
+    def node_count(self) -> int:
+        return len(self._by_hash)
+
+
+def make_radix_index(prefer_native: bool = True):
+    if prefer_native:
+        try:
+            return RadixIndexNative()
+        except RuntimeError:
+            pass
+    return RadixIndexPython()
+
+
+# ---------------------------------------------------------------------------
+# KvIndexer: single-writer event application + query API
+# ---------------------------------------------------------------------------
+
+
+class KvIndexer:
+    """Applies RouterEvents to the tree from one task; queries compute block
+    hashes for the request tokens then walk the tree (reference
+    KvIndexer::new / find_matches_for_request)."""
+
+    def __init__(self, block_size: int, prefer_native: bool = True):
+        self.block_size = block_size
+        self.tree = make_radix_index(prefer_native)
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+
+    # -- event side
+    def apply_event(self, event: RouterEvent) -> None:
+        if event.stored is not None:
+            self.tree.apply_stored(event.worker_id, event.stored.parent_hash,
+                                   event.stored.block_hashes)
+        if event.removed is not None:
+            self.tree.apply_removed(event.worker_id,
+                                    event.removed.block_hashes)
+
+    async def enqueue_event(self, event: RouterEvent) -> None:
+        self._ensure_task()
+        await self._queue.put(event)
+
+    def _ensure_task(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(
+                self._run(), name="kv-indexer")
+
+    async def _run(self) -> None:
+        while True:
+            ev = await self._queue.get()
+            self.apply_event(ev)
+
+    async def drain(self) -> None:
+        while not self._queue.empty():
+            await asyncio.sleep(0)
+
+    def remove_worker(self, worker_id: int) -> None:
+        self.tree.remove_worker(worker_id)
+
+    # -- query side
+    def find_matches(self, block_hashes: Sequence[int]) -> OverlapScores:
+        return self.tree.find_matches(block_hashes)
+
+    def find_matches_for_request(self, token_ids: Sequence[int]
+                                 ) -> OverlapScores:
+        return self.find_matches(
+            compute_block_hashes(token_ids, self.block_size))
+
+
+class KvIndexerSharded:
+    """N independent trees, events partitioned by worker id — bounds
+    single-writer throughput at high event rates (reference
+    `KvIndexerSharded`). Queries fan out and merge."""
+
+    def __init__(self, block_size: int, shards: int = 4,
+                 prefer_native: bool = True):
+        self.block_size = block_size
+        self.shards = [KvIndexer(block_size, prefer_native)
+                       for _ in range(shards)]
+
+    def _shard(self, worker_id: int) -> KvIndexer:
+        return self.shards[worker_id % len(self.shards)]
+
+    def apply_event(self, event: RouterEvent) -> None:
+        self._shard(event.worker_id).apply_event(event)
+
+    def remove_worker(self, worker_id: int) -> None:
+        self._shard(worker_id).remove_worker(worker_id)
+
+    def find_matches_for_request(self, token_ids) -> OverlapScores:
+        hashes = compute_block_hashes(token_ids, self.block_size)
+        merged: Dict[int, int] = {}
+        for sh in self.shards:
+            merged.update(sh.find_matches(hashes).scores)
+        return OverlapScores(merged)

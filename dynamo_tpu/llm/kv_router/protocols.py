@@ -1,0 +1,91 @@
+"""KV-routing wire protocols.
+
+Reference: lib/llm/src/kv_router/protocols.rs:18-97 — ForwardPassMetrics
+scraped from workers, KvCacheEvent stored/removed payloads flowing over the
+`kv_events` subject, and the router-side RouterEvent envelope tagging events
+with the emitting worker.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+KV_EVENTS_SUBJECT = "kv_events"
+KV_HIT_RATE_SUBJECT = "kv-hit-rate"
+LOAD_METRICS_ENDPOINT = "load_metrics"
+
+
+@dataclasses.dataclass
+class ForwardPassMetrics:
+    """Worker load metrics published to the router (reference
+    kv_router/protocols.rs ForwardPassMetrics)."""
+
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 0
+    num_requests_waiting: int = 0
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ForwardPassMetrics":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+@dataclasses.dataclass
+class KvStoredEvent:
+    """Blocks entered a worker's reusable pool. `block_hashes` are chained
+    sequence hashes (globally comparable); `tokens_hashes` the local ones."""
+
+    parent_hash: Optional[int]
+    block_hashes: List[int]
+    tokens_hashes: List[int] = dataclasses.field(default_factory=list)
+    lora_id: int = 0
+
+
+@dataclasses.dataclass
+class KvRemovedEvent:
+    block_hashes: List[int] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class RouterEvent:
+    """Worker-tagged KV cache event (reference RouterEvent)."""
+
+    worker_id: int
+    event_id: int = 0
+    stored: Optional[KvStoredEvent] = None
+    removed: Optional[KvRemovedEvent] = None
+
+    def to_dict(self) -> dict:
+        d: dict = {"worker_id": self.worker_id, "event_id": self.event_id}
+        if self.stored is not None:
+            d["stored"] = dataclasses.asdict(self.stored)
+        if self.removed is not None:
+            d["removed"] = dataclasses.asdict(self.removed)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RouterEvent":
+        ev = cls(worker_id=d["worker_id"], event_id=d.get("event_id", 0))
+        if d.get("stored"):
+            ev.stored = KvStoredEvent(**d["stored"])
+        if d.get("removed"):
+            ev.removed = KvRemovedEvent(**d["removed"])
+        return ev
+
+
+@dataclasses.dataclass
+class KVHitRateEvent:
+    """Emitted by the scheduler per routing decision (reference
+    scheduler.rs:28-33); consumed by the metrics component."""
+
+    worker_id: int
+    isl_blocks: int
+    overlap_blocks: int

@@ -1,0 +1,48 @@
+"""KvRouter: indexer + scheduler glued into one `schedule(tokens)` service
+(reference lib/llm/src/kv_router/kv_router.rs:44-140 — subscribe `kv_events`,
+feed the indexer, scrape metrics, pick a worker)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+from .indexer import KvIndexer
+from .protocols import ForwardPassMetrics, RouterEvent
+from .scheduler import KvScheduler
+from .scoring import Endpoint, ProcessedEndpoints
+
+logger = logging.getLogger("dynamo_tpu.kv_router")
+
+
+class KvRouter:
+    def __init__(self, block_size: int, prefer_native: bool = True,
+                 on_hit_rate=None):
+        self.block_size = block_size
+        self.indexer = KvIndexer(block_size, prefer_native=prefer_native)
+        self.scheduler = KvScheduler(block_size, on_hit_rate=on_hit_rate)
+
+    # -- feeds (wired to transports in the distributed runtime layer)
+    def on_kv_event(self, event: RouterEvent) -> None:
+        self.indexer.apply_event(event)
+
+    def on_metrics(self, worker_metrics: dict) -> None:
+        """worker_metrics: worker_id → ForwardPassMetrics (or dict)."""
+        eps = []
+        for wid, m in worker_metrics.items():
+            if isinstance(m, dict):
+                m = ForwardPassMetrics.from_dict(m)
+            eps.append(Endpoint(worker_id=int(wid), metrics=m))
+        self.scheduler.update_endpoints(ProcessedEndpoints(eps))
+
+    def on_worker_gone(self, worker_id: int) -> None:
+        self.indexer.remove_worker(worker_id)
+
+    # -- decision
+    def schedule(self, token_ids: Sequence[int]) -> Optional[tuple]:
+        """Returns (worker_id, overlap_blocks) or None if no workers."""
+        overlap = self.indexer.find_matches_for_request(token_ids)
+        worker = self.scheduler.schedule(len(token_ids), overlap.scores)
+        if worker is None:
+            return None
+        return worker, overlap.scores.get(worker, 0)

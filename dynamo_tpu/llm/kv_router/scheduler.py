@@ -1,0 +1,102 @@
+"""KV scheduler: pick the worker for a request given prefix overlap + load.
+
+Reference: lib/llm/src/kv_router/scheduler.rs:88-316 (`select_worker`). The
+cost model re-implemented here keeps the reference's observable behavior:
+
+- cost = alpha * load_deviation + (1 - alpha) * normalized_new_tokens
+         + gamma * request_load_ratio
+- balance mode: alpha = 0.7 when load_std > 0.1 * load_avg (loads diverging →
+  weight load more), else alpha = 0.3 (loads even → weight cache hits more)
+- workers with no free request slots are skipped
+- optimistic local accounting: the chosen worker's active blocks/slots are
+  bumped immediately so back-to-back decisions don't dogpile one worker
+  before the next metrics scrape lands
+- a KVHitRateEvent is emitted per decision
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from typing import Callable, Optional
+
+from .protocols import KVHitRateEvent
+from .scoring import ProcessedEndpoints
+
+logger = logging.getLogger("dynamo_tpu.kv_scheduler")
+
+GAMMA = 0.2
+
+
+class KvScheduler:
+    def __init__(self, block_size: int,
+                 on_hit_rate: Optional[Callable[[KVHitRateEvent], None]] = None,
+                 rng: Optional[random.Random] = None):
+        self.block_size = block_size
+        self.on_hit_rate = on_hit_rate
+        self.endpoints = ProcessedEndpoints([])
+        self._rng = rng or random.Random(0)
+        # optimistic deltas applied on top of the last scrape
+        self._opt_blocks: dict = {}
+        self._opt_slots: dict = {}
+
+    def update_endpoints(self, endpoints: ProcessedEndpoints) -> None:
+        self.endpoints = endpoints
+        self._opt_blocks.clear()
+        self._opt_slots.clear()
+
+    def schedule(self, isl_tokens: int, overlap_scores: dict) -> Optional[int]:
+        """Returns the chosen worker id, or None when no worker is usable."""
+        eps = self.endpoints
+        if not len(eps):
+            return None
+        isl_blocks = max((isl_tokens + self.block_size - 1) // self.block_size,
+                         1)
+        load_avg = eps.load_avg
+        load_std = eps.load_std
+        balance_mode = load_std > 0.1 * load_avg
+        alpha = 0.7 if balance_mode else 0.3
+
+        best_cost = None
+        best_worker = None
+        candidates = list(eps.endpoints.values())
+        self._rng.shuffle(candidates)  # tie-break fairness
+        for ep in candidates:
+            m = ep.metrics
+            slots_used = (m.request_active_slots
+                          + self._opt_slots.get(ep.worker_id, 0))
+            if m.request_total_slots and slots_used >= m.request_total_slots:
+                continue  # full worker
+            overlap_blocks = min(overlap_scores.get(ep.worker_id, 0),
+                                 isl_blocks)
+            new_blocks = isl_blocks - overlap_blocks
+            normalized_new = new_blocks / isl_blocks
+            load = ep.load + self._opt_blocks.get(ep.worker_id, 0)
+            # deviation normalized by the fleet average (not stddev — a tiny
+            # stddev would explode the term and drown out cache overlap)
+            load_dev = (load - load_avg) / max(load_avg, 1.0)
+            req_ratio = (slots_used / m.request_total_slots
+                         if m.request_total_slots else 0.0)
+            cost = (alpha * load_dev + (1 - alpha) * normalized_new
+                    + GAMMA * req_ratio)
+            if best_cost is None or cost < best_cost:
+                best_cost = cost
+                best_worker = ep
+        if best_worker is None:
+            return None
+        overlap_blocks = min(overlap_scores.get(best_worker.worker_id, 0),
+                             isl_blocks)
+        # optimistic accounting until the next metrics scrape
+        self._opt_blocks[best_worker.worker_id] = (
+            self._opt_blocks.get(best_worker.worker_id, 0)
+            + (isl_blocks - overlap_blocks))
+        self._opt_slots[best_worker.worker_id] = (
+            self._opt_slots.get(best_worker.worker_id, 0) + 1)
+        if self.on_hit_rate is not None:
+            self.on_hit_rate(KVHitRateEvent(
+                worker_id=best_worker.worker_id, isl_blocks=isl_blocks,
+                overlap_blocks=overlap_blocks))
+        logger.debug("scheduled worker=%d cost=%.3f overlap=%d/%d alpha=%.1f",
+                     best_worker.worker_id, best_cost, overlap_blocks,
+                     isl_blocks, alpha)
+        return best_worker.worker_id

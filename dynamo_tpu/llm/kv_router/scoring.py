@@ -1,0 +1,41 @@
+"""Endpoint load scoring (reference lib/llm/src/kv_router/scoring.rs:24-55:
+`ProcessedEndpoints` — load average/stddev over kv_active_blocks)."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List
+
+from .protocols import ForwardPassMetrics
+
+
+@dataclasses.dataclass
+class Endpoint:
+    worker_id: int
+    metrics: ForwardPassMetrics
+
+    @property
+    def load(self) -> int:
+        return self.metrics.kv_active_blocks
+
+
+class ProcessedEndpoints:
+    def __init__(self, endpoints: List[Endpoint]):
+        self.endpoints: Dict[int, Endpoint] = {e.worker_id: e
+                                               for e in endpoints}
+        loads = [e.load for e in endpoints]
+        n = len(loads)
+        self.load_avg = sum(loads) / n if n else 0.0
+        if n:
+            var = sum((x - self.load_avg) ** 2 for x in loads) / n
+            self.load_std = math.sqrt(var)
+        else:
+            self.load_std = 0.0
+
+    @property
+    def worker_ids(self) -> List[int]:
+        return list(self.endpoints)
+
+    def __len__(self) -> int:
+        return len(self.endpoints)

@@ -1,0 +1,54 @@
+"""Native (C++) component loader: builds csrc/ into shared libs on first use
+and memoizes. Keeps the framework importable on machines without a toolchain
+(callers fall back to pure-Python implementations when load fails)."""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger("dynamo_tpu.native")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_CSRC = os.path.join(_REPO_ROOT, "csrc")
+_BUILD_DIR = os.path.join(_CSRC, "build")
+_LOCK = threading.Lock()
+_CACHE: dict = {}
+
+
+def _build(name: str, sources: list, extra_flags: Optional[list] = None) -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    out = os.path.join(_BUILD_DIR, f"lib{name}.so")
+    srcs = [os.path.join(_CSRC, s) for s in sources]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if os.path.exists(out) and os.path.getmtime(out) >= newest_src:
+        return out
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", out,
+           *srcs, *(extra_flags or [])]
+    logger.info("building native lib: %s", " ".join(cmd))
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    return out
+
+
+def load(name: str, sources: list,
+         extra_flags: Optional[list] = None) -> Optional[ctypes.CDLL]:
+    """Build (if stale) and dlopen csrc/<sources> as lib<name>.so.
+    Returns None when the toolchain or build fails."""
+    with _LOCK:
+        if name in _CACHE:
+            return _CACHE[name]
+        try:
+            path = _build(name, sources, extra_flags)
+            lib = ctypes.CDLL(path)
+        except (subprocess.CalledProcessError, OSError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            logger.warning("native lib %s unavailable (%s); using Python "
+                           "fallback", name, detail.strip()[:500])
+            lib = None
+        _CACHE[name] = lib
+        return lib

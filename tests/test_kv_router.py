@@ -1,0 +1,233 @@
+"""KV router stack tests: native C++ radix index vs Python fallback
+equivalence, indexer event flow, scheduler cost behavior, full-router
+decisions with mock workers (reference analogs: indexer.rs tail tests,
+scheduler tests, components/metrics mock_worker)."""
+
+import random
+
+import pytest
+
+from dynamo_tpu.llm.kv.blocks import compute_block_hashes
+from dynamo_tpu.llm.kv_router import (Endpoint, ForwardPassMetrics, KvIndexer,
+                                      KvRouter, KvScheduler,
+                                      ProcessedEndpoints, RouterEvent)
+from dynamo_tpu.llm.kv_router.indexer import (RadixIndexNative,
+                                              RadixIndexPython,
+                                              make_radix_index)
+from dynamo_tpu.llm.kv_router.protocols import KvRemovedEvent, KvStoredEvent
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+
+BS = 4
+
+
+def _native_or_skip():
+    try:
+        return RadixIndexNative()
+    except RuntimeError:
+        pytest.skip("no C++ toolchain")
+
+
+def test_native_index_builds():
+    idx = _native_or_skip()
+    h = compute_block_hashes(list(range(8)), BS)
+    idx.apply_stored(1, None, h)
+    assert idx.node_count() == 2
+    scores = idx.find_matches(h)
+    assert scores.scores == {1: 2}
+
+
+def test_native_matches_python_randomized():
+    """Property test: native and Python trees agree on a random event/query
+    workload."""
+    native = _native_or_skip()
+    py = RadixIndexPython()
+    rng = random.Random(0)
+    sequences = [[rng.randrange(100) for _ in range(rng.randrange(4, 24))]
+                 for _ in range(30)]
+    all_hashes = [compute_block_hashes(s, BS) for s in sequences]
+    stored = []  # (worker, hashes)
+    for step in range(300):
+        op = rng.random()
+        if op < 0.55 or not stored:
+            w = rng.randrange(4)
+            h = rng.choice(all_hashes)
+            k = rng.randrange(1, len(h) + 1) if h else 0
+            if not h:
+                continue
+            native.apply_stored(w, None, h[:k])
+            py.apply_stored(w, None, h[:k])
+            stored.append((w, h[:k]))
+        elif op < 0.8:
+            w, h = rng.choice(stored)
+            drop = h[rng.randrange(len(h)):]
+            native.apply_removed(w, drop)
+            py.apply_removed(w, drop)
+        else:
+            w = rng.randrange(4)
+            native.remove_worker(w)
+            py.remove_worker(w)
+            stored = [(sw, sh) for sw, sh in stored if sw != w]
+        if step % 10 == 0:
+            q = rng.choice(all_hashes)
+            assert native.find_matches(q).scores == py.find_matches(q).scores
+    assert native.node_count() == py.node_count()
+
+
+def test_index_consecutive_requirement():
+    idx = make_radix_index(prefer_native=False)
+    h = compute_block_hashes(list(range(16)), BS)  # 4 blocks
+    idx.apply_stored(1, None, h)          # worker 1 has all 4
+    idx.apply_stored(2, None, h[:1])      # worker 2 has block 0 only
+    # worker 3 has blocks 0 and 2 (gap at 1) — overlap must stop at 1
+    idx.apply_stored(3, None, h[:1])
+    idx.apply_stored(3, h[1], h[2:3])
+    scores = idx.find_matches(h).scores
+    assert scores == {1: 4, 2: 1, 3: 1}
+
+
+def test_index_remove_worker_prunes():
+    idx = make_radix_index(prefer_native=False)
+    h = compute_block_hashes(list(range(8)), BS)
+    idx.apply_stored(1, None, h)
+    idx.apply_stored(2, None, h[:1])
+    idx.remove_worker(1)
+    assert idx.find_matches(h).scores == {2: 1}
+    assert idx.node_count() == 1  # worker 1's deeper node pruned
+
+
+@pytest.mark.asyncio
+async def test_kv_indexer_event_flow():
+    indexer = KvIndexer(BS, prefer_native=False)
+    tokens = list(range(12))
+    h = compute_block_hashes(tokens, BS)
+    await indexer.enqueue_event(RouterEvent(
+        worker_id=7, stored=KvStoredEvent(parent_hash=None, block_hashes=h)))
+    await indexer.drain()
+    assert indexer.find_matches_for_request(tokens).scores == {7: 3}
+    await indexer.enqueue_event(RouterEvent(
+        worker_id=7, removed=KvRemovedEvent(block_hashes=[h[-1]])))
+    await indexer.drain()
+    assert indexer.find_matches_for_request(tokens).scores == {7: 2}
+
+
+def _eps(loads, slots=(0, 8)):
+    return ProcessedEndpoints([
+        Endpoint(worker_id=i, metrics=ForwardPassMetrics(
+            request_active_slots=slots[0], request_total_slots=slots[1],
+            kv_active_blocks=load, kv_total_blocks=100))
+        for i, load in enumerate(loads)])
+
+
+def test_scheduler_prefers_overlap_when_balanced():
+    s = KvScheduler(BS)
+    s.update_endpoints(_eps([10, 10, 10]))
+    # equal load → cache-hit weighted (alpha=0.3): worker 2 with overlap wins
+    assert s.schedule(isl_tokens=64, overlap_scores={2: 10}) == 2
+
+
+def test_scheduler_balance_mode_avoids_hot_worker():
+    s = KvScheduler(BS)
+    # worker 0 has full overlap but is massively overloaded
+    s.update_endpoints(_eps([95, 2, 2]))
+    chosen = s.schedule(isl_tokens=64, overlap_scores={0: 16})
+    assert chosen != 0
+
+
+def test_scheduler_skips_full_workers():
+    eps = ProcessedEndpoints([
+        Endpoint(worker_id=0, metrics=ForwardPassMetrics(
+            request_active_slots=8, request_total_slots=8,
+            kv_active_blocks=0)),
+        Endpoint(worker_id=1, metrics=ForwardPassMetrics(
+            request_active_slots=0, request_total_slots=8,
+            kv_active_blocks=50)),
+    ])
+    s = KvScheduler(BS)
+    s.update_endpoints(eps)
+    assert s.schedule(isl_tokens=32, overlap_scores={0: 8}) == 1
+
+
+def test_scheduler_optimistic_accounting_spreads_burst():
+    s = KvScheduler(BS)
+    s.update_endpoints(_eps([0, 0, 0, 0]))
+    chosen = [s.schedule(isl_tokens=256, overlap_scores={}) for _ in range(8)]
+    assert len(set(chosen)) > 1  # a burst must not dogpile one worker
+
+
+def test_scheduler_emits_hit_rate_events():
+    events = []
+    s = KvScheduler(BS, on_hit_rate=events.append)
+    s.update_endpoints(_eps([5, 5]))
+    s.schedule(isl_tokens=32, overlap_scores={1: 4})
+    assert len(events) == 1
+    assert events[0].isl_blocks == 8
+    assert events[0].overlap_blocks in (0, 4)
+
+
+@pytest.mark.asyncio
+async def test_full_router_with_mock_workers():
+    """Mock-worker pattern (reference mock_worker.rs): fake metrics + events,
+    zero hardware. A request whose prefix lives on worker 2 routes there."""
+    router = KvRouter(BS, prefer_native=True)
+    tokens = list(range(32))
+    h = compute_block_hashes(tokens, BS)
+    router.on_kv_event(RouterEvent(
+        worker_id=2, stored=KvStoredEvent(parent_hash=None,
+                                          block_hashes=h[:6])))
+    router.on_metrics({
+        0: ForwardPassMetrics(request_total_slots=8, kv_active_blocks=10,
+                              kv_total_blocks=100),
+        1: ForwardPassMetrics(request_total_slots=8, kv_active_blocks=10,
+                              kv_total_blocks=100),
+        2: ForwardPassMetrics(request_total_slots=8, kv_active_blocks=12,
+                              kv_total_blocks=100),
+    })
+    worker, overlap = router.schedule(tokens)
+    assert worker == 2 and overlap == 6
+    # worker 2 dies → rerouted elsewhere
+    router.on_worker_gone(2)
+    router.on_metrics({
+        0: ForwardPassMetrics(request_total_slots=8, kv_active_blocks=10),
+        1: ForwardPassMetrics(request_total_slots=8, kv_active_blocks=10),
+    })
+    worker2, overlap2 = router.schedule(tokens)
+    assert worker2 in (0, 1) and overlap2 == 0
+
+
+@pytest.mark.asyncio
+async def test_engine_publishes_kv_events_to_router():
+    """Engine block registration flows through the publisher into a router
+    indexer — the in-process version of call stack §3.5."""
+    import numpy as np
+    import jax.numpy as jnp
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.core import (FINISH_SENTINEL, EngineCore,
+                                        EngineRequest)
+    from dynamo_tpu.engine.sampling import SlotSampling
+
+    indexer = KvIndexer(8, prefer_native=False)
+
+    async def sink(ev):
+        indexer.apply_event(ev)
+
+    pub = KvEventPublisher(worker_id=42, sink=sink)
+    mcfg = ModelConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                       num_layers=1, num_heads=2, num_kv_heads=2, head_dim=16,
+                       max_position_embeddings=128)
+    ecfg = EngineConfig(max_model_len=64, kv_block_size=8, num_kv_blocks=16,
+                        max_num_seqs=2, prefill_buckets=[32, 64])
+    core = EngineCore(mcfg, ecfg, attn_impl="xla", param_dtype=jnp.float32,
+                      kv_event_publisher=pub)
+    prompt = list(np.random.default_rng(0).integers(1, 64, size=20))
+    req = EngineRequest(rid="x", prompt=[int(t) for t in prompt],
+                        sampling=SlotSampling(temperature=0.0),
+                        max_new_tokens=4, eos_ids=frozenset())
+    await core.submit(req)
+    while True:
+        item, payload = await req.out_queue.get()
+        if item is FINISH_SENTINEL:
+            break
+    await pub.drain()
+    await core.stop()
+    scores = indexer.find_matches_for_request([int(t) for t in prompt])
+    assert scores.scores.get(42, 0) >= 2  # prompt's full blocks indexed
