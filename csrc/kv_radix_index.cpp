@@ -66,7 +66,10 @@ struct RadixIndex {
                 child->hash = h;
                 child->parent = node;
                 node->children.emplace(h, std::move(owned));
-                by_hash.emplace(h, child);
+                // overwrite: the newest node for a hash wins the flat map
+                // (out-of-order re-roots create duplicates; the newer node
+                // has the correct parent chain)
+                by_hash[h] = child;
             } else {
                 child = it->second.get();
             }
@@ -80,7 +83,9 @@ struct RadixIndex {
         while (node != nullptr && node != &root && node->workers.empty() &&
                node->children.empty()) {
             Node* parent = node->parent;
-            by_hash.erase(node->hash);
+            auto bh = by_hash.find(node->hash);
+            if (bh != by_hash.end() && bh->second == node)
+                by_hash.erase(bh);  // only if we are the map's holder
             parent->children.erase(node->hash);  // frees node
             node = parent;
         }
@@ -104,13 +109,18 @@ struct RadixIndex {
         if (it == worker_nodes.end()) return;
         std::vector<Node*> nodes(it->second.begin(), it->second.end());
         worker_nodes.erase(it);
-        for (Node* node : nodes) node->workers.erase(w);
+        // snapshot hash VALUES while every node is still alive: a detach of
+        // one node can free its (also-snapshotted) ancestors, so node
+        // pointers must never be dereferenced after the first detach
+        std::vector<BlockHash> hashes;
+        hashes.reserve(nodes.size());
         for (Node* node : nodes) {
-            // node may already have been freed by an earlier detach — guard
-            // by re-resolving through by_hash
-            auto bh = by_hash.find(node->hash);
-            if (bh != by_hash.end() && bh->second == node)
-                detach_if_empty(node);
+            node->workers.erase(w);
+            hashes.push_back(node->hash);
+        }
+        for (BlockHash h : hashes) {
+            auto bh = by_hash.find(h);
+            if (bh != by_hash.end()) detach_if_empty(bh->second);
         }
     }
 

@@ -70,6 +70,10 @@ class RadixIndexNative:
         lib.dyn_kv_index_node_count.restype = ctypes.c_size_t
         lib.dyn_kv_index_node_count.argtypes = [ctypes.c_void_p]
         self._ptr = lib.dyn_kv_index_new()
+        # reusable output buffers: find_matches is the routing hot path and
+        # the index is single-reader by design, so one pair suffices
+        self._out_w = (ctypes.c_int64 * self.MAX_WORKERS)()
+        self._out_c = (ctypes.c_uint32 * self.MAX_WORKERS)()
 
     def __del__(self):
         ptr = getattr(self, "_ptr", None)
@@ -97,12 +101,10 @@ class RadixIndexNative:
         self._lib.dyn_kv_index_remove_worker(self._ptr, worker_id)
 
     def find_matches(self, block_hashes: Sequence[int]) -> OverlapScores:
-        cap = self.MAX_WORKERS
-        out_w = (ctypes.c_int64 * cap)()
-        out_c = (ctypes.c_uint32 * cap)()
+        out_w, out_c = self._out_w, self._out_c
         n = self._lib.dyn_kv_index_find_matches(
             self._ptr, self._arr(block_hashes), len(block_hashes),
-            out_w, out_c, cap, 1)
+            out_w, out_c, self.MAX_WORKERS, 1)
         return OverlapScores({int(out_w[i]): int(out_c[i]) for i in range(n)})
 
     def node_count(self) -> int:
@@ -151,7 +153,8 @@ class RadixIndexPython:
         while (node is not None and node is not self._root
                and not node.workers and not node.children):
             parent = node.parent
-            self._by_hash.pop(node.hash, None)
+            if self._by_hash.get(node.hash) is node:  # only the map's holder
+                del self._by_hash[node.hash]
             parent.children.pop(node.hash, None)
             node = parent
 
@@ -167,11 +170,16 @@ class RadixIndexPython:
             self._detach_if_empty(node)
 
     def remove_worker(self, worker_id) -> None:
+        # mirror the native tree exactly: snapshot hash values, then detach
+        # via the flat map's current holder (kv_radix_index.cpp remove_worker)
         nodes = self._worker_nodes.pop(worker_id, set())
+        hashes = []
         for node in nodes:
             node.workers.discard(worker_id)
-        for node in nodes:
-            if self._by_hash.get(node.hash) is node:
+            hashes.append(node.hash)
+        for h in hashes:
+            node = self._by_hash.get(h)
+            if node is not None:
                 self._detach_if_empty(node)
 
     def find_matches(self, block_hashes) -> OverlapScores:
@@ -191,7 +199,11 @@ class RadixIndexPython:
         return OverlapScores(scores)
 
     def node_count(self) -> int:
-        return len(self._by_hash)
+        # count actual tree nodes, not the flat map: duplicate hashes from
+        # out-of-order re-roots occupy two tree positions but one map slot
+        def cnt(n: _PyNode) -> int:
+            return 1 + sum(cnt(c) for c in n.children.values())
+        return cnt(self._root) - 1
 
 
 def make_radix_index(prefer_native: bool = True):

@@ -74,17 +74,20 @@ class KvEventPublisher:
     async def _run(self) -> None:
         while True:
             ev = await self._queue.get()
-            if self.sink is None:
-                continue
             try:
-                await self.sink(ev)
+                if self.sink is not None:
+                    await self.sink(ev)
             except Exception:  # noqa: BLE001 — transport boundary
                 logger.exception("kv event publish failed (event dropped)")
+            finally:
+                self._queue.task_done()
 
     async def drain(self) -> None:
+        """Wait until every enqueued event has fully passed the sink (not
+        merely left the queue — the last event may still be awaiting inside
+        ``sink`` when the queue reads empty)."""
         self._ensure_task()
-        while not self._queue.empty():
-            await asyncio.sleep(0)
+        await self._queue.join()
 
 
 class KvMetricsPublisher:

@@ -8,4 +8,18 @@ __all__ = [
     "AsyncEngine", "Context", "EngineContext", "EngineFn", "ManyOut",
     "ResponseStream", "SingleIn", "engine_from_fn",
     "Operator", "ServiceFrontend", "link",
+    # distributed layer (imported lazily by most callers)
+    "DistributedRuntime", "Namespace", "Component", "Endpoint", "Client",
+    "Worker",
 ]
+
+
+def __getattr__(name):  # lazy: keep `import dynamo_tpu.runtime` light
+    if name in ("DistributedRuntime", "Namespace", "Component", "Endpoint",
+                "EndpointServer", "Client", "json_serde"):
+        from . import distributed
+        return getattr(distributed, name)
+    if name == "Worker":
+        from .worker import Worker
+        return Worker
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

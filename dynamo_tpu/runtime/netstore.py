@@ -1,0 +1,279 @@
+"""TCP clients for the discovery/bus daemon (runtime/server.py): NetKvStore
+implements the KvStore interface, NetBus the MessageBus interface, over the
+daemon's length-prefixed JSON protocol.
+
+These are the reference's etcd-client / async-nats analogs
+(lib/runtime/src/transports/{etcd,nats}.rs): a single multiplexed connection
+each, a demux reader matching ``rid`` replies and routing ``push`` frames
+(watch events, bus messages) to their handles.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import logging
+from typing import Dict, List, Optional
+
+from .bus import BusMessage, MessageBus, Subscription, WorkItem, WorkQueue
+from .kvstore import (KvEntry, KvStore, Lease, PrefixWatcher, WatchEvent,
+                      WatchEventType)
+from .server import recv_msg, send_msg
+
+logger = logging.getLogger("dynamo_tpu.runtime.netstore")
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+class _Conn:
+    """One multiplexed daemon connection: request/reply + push routing."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self._next_rid = 1
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._push_watch: Dict[int, PrefixWatcher] = {}
+        self._push_sub: Dict[int, Subscription] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+        self._write_lock = asyncio.Lock()
+        self.closed = False
+
+    @classmethod
+    async def open(cls, addr: str, timeout: float = 10.0) -> "_Conn":
+        host, port = addr.rsplit(":", 1)
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, int(port)), timeout)
+        conn = cls(reader, writer)
+        conn._reader_task = asyncio.get_running_loop().create_task(
+            conn._read_loop(), name="netstore-demux")
+        return conn
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = await recv_msg(self.reader)
+                if msg is None:
+                    break
+                if "push" in msg:
+                    self._route_push(msg)
+                    continue
+                fut = self._pending.pop(msg.get("rid"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result(msg)
+        except (ConnectionError, ValueError):
+            pass
+        finally:
+            self.closed = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("daemon connection lost"))
+            self._pending.clear()
+
+    def _route_push(self, msg: dict) -> None:
+        if msg["push"] == "watch":
+            w = self._push_watch.get(msg["wid"])
+            if w is not None:
+                typ = (WatchEventType.PUT if msg["type"] == "put"
+                       else WatchEventType.DELETE)
+                w._push(WatchEvent(typ, KvEntry(
+                    msg["key"], _unb64(msg["value"]), msg.get("lease", 0))))
+        elif msg["push"] == "msg":
+            s = self._push_sub.get(msg["sid"])
+            if s is not None:
+                s._push(BusMessage(msg["subject"], _unb64(msg["payload"])))
+
+    async def call(self, op: str, **kwargs) -> dict:
+        if self.closed:
+            raise ConnectionError("daemon connection lost")
+        rid = self._next_rid
+        self._next_rid += 1
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        async with self._write_lock:
+            await send_msg(self.writer, {"rid": rid, "op": op, **kwargs})
+        reply = await fut
+        if not reply.get("ok"):
+            raise RuntimeError(reply.get("error", f"{op} failed"))
+        return reply
+
+    async def close(self) -> None:
+        self.closed = True
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        if not self.writer.is_closing():
+            self.writer.close()
+
+
+class NetKvStore(KvStore):
+    def __init__(self, conn: _Conn):
+        self._conn = conn
+
+    @classmethod
+    async def connect(cls, addr: str) -> "NetKvStore":
+        return cls(await _Conn.open(addr))
+
+    async def kv_create(self, key: str, value: bytes, lease_id: int = 0) -> bool:
+        r = await self._conn.call("kv_create", key=key, value=_b64(value),
+                                  lease=lease_id)
+        return bool(r["result"])
+
+    async def kv_create_or_validate(self, key: str, value: bytes,
+                                    lease_id: int = 0) -> bool:
+        r = await self._conn.call("kv_create_or_validate", key=key,
+                                  value=_b64(value), lease=lease_id)
+        return bool(r["result"])
+
+    async def kv_put(self, key: str, value: bytes, lease_id: int = 0) -> None:
+        await self._conn.call("kv_put", key=key, value=_b64(value),
+                              lease=lease_id)
+
+    async def kv_get(self, key: str) -> Optional[KvEntry]:
+        r = await self._conn.call("kv_get", key=key)
+        e = r.get("entry")
+        if e is None:
+            return None
+        return KvEntry(e["key"], _unb64(e["value"]), e.get("lease", 0))
+
+    async def kv_get_prefix(self, prefix: str) -> List[KvEntry]:
+        r = await self._conn.call("kv_get_prefix", prefix=prefix)
+        return [KvEntry(e["key"], _unb64(e["value"]), e.get("lease", 0))
+                for e in r["entries"]]
+
+    async def kv_delete(self, key: str) -> bool:
+        r = await self._conn.call("kv_delete", key=key)
+        return bool(r["result"])
+
+    async def watch_prefix(self, prefix: str) -> PrefixWatcher:
+        # client-allocated handle, registered BEFORE the call so pushes that
+        # race the reply are never dropped
+        wid = self._conn._next_rid + 1_000_000
+
+        def unsub(_w: PrefixWatcher) -> None:
+            self._conn._push_watch.pop(wid, None)
+            if not self._conn.closed:
+                asyncio.get_running_loop().create_task(
+                    self._safe_call("watch_close", wid=wid))
+
+        w = PrefixWatcher(prefix, [], unsub)
+        self._conn._push_watch[wid] = w
+        try:
+            await self._conn.call("watch_prefix", prefix=prefix, wid=wid)
+        except Exception:
+            self._conn._push_watch.pop(wid, None)
+            raise
+        return w
+
+    async def _safe_call(self, op: str, **kw) -> None:
+        try:
+            await self._conn.call(op, **kw)
+        except Exception:
+            pass
+
+    async def lease_create(self, ttl: float) -> Lease:
+        r = await self._conn.call("lease_create", ttl=ttl)
+        return Lease(self, r["lease_id"], ttl)
+
+    async def lease_refresh(self, lease_id: int) -> bool:
+        r = await self._conn.call("lease_refresh", lease_id=lease_id)
+        return bool(r["result"])
+
+    async def lease_revoke(self, lease_id: int) -> None:
+        await self._conn.call("lease_revoke", lease_id=lease_id)
+
+    async def close(self) -> None:
+        await self._conn.close()
+
+
+class _NetWorkQueue(WorkQueue):
+    def __init__(self, conn: _Conn, name: str):
+        self._conn = conn
+        self.name = name
+
+    async def enqueue(self, payload: bytes) -> int:
+        r = await self._conn.call("wq_enqueue", queue=self.name,
+                                  payload=_b64(payload))
+        return r["id"]
+
+    async def dequeue(self, timeout: Optional[float] = None,
+                      ack_deadline: float = 30.0) -> Optional[WorkItem]:
+        r = await self._conn.call("wq_dequeue", queue=self.name,
+                                  timeout=timeout, ack_deadline=ack_deadline)
+        item = r.get("item")
+        if item is None:
+            return None
+        return WorkItem(item["id"], _unb64(item["payload"]),
+                        item.get("deliveries", 1))
+
+    async def ack(self, item_id: int) -> None:
+        await self._conn.call("wq_ack", queue=self.name, id=item_id)
+
+    async def nack(self, item_id: int) -> None:
+        await self._conn.call("wq_nack", queue=self.name, id=item_id)
+
+    async def depth(self) -> int:
+        r = await self._conn.call("wq_depth", queue=self.name)
+        return r["depth"]
+
+
+class NetBus(MessageBus):
+    def __init__(self, conn: _Conn):
+        self._conn = conn
+        self._served: Dict[str, int] = {}
+
+    @classmethod
+    async def connect(cls, addr: str) -> "NetBus":
+        return cls(await _Conn.open(addr))
+
+    async def publish(self, subject: str, payload: bytes) -> None:
+        await self._conn.call("publish", subject=subject, payload=_b64(payload))
+
+    async def _make_sub(self, op: str, **kw) -> Subscription:
+        sid = self._conn._next_rid + 2_000_000  # client-allocated (see watch)
+
+        def unsub(_s: Subscription) -> None:
+            self._conn._push_sub.pop(sid, None)
+            if not self._conn.closed:
+                asyncio.get_running_loop().create_task(
+                    self._safe_call("sub_close", sid=sid))
+
+        sub = Subscription(kw.get("pattern") or kw.get("subject", ""), unsub)
+        self._conn._push_sub[sid] = sub
+        try:
+            await self._conn.call(op, sid=sid, **kw)
+        except Exception:
+            self._conn._push_sub.pop(sid, None)
+            raise
+        return sub, sid
+
+    async def subscribe(self, pattern: str) -> Subscription:
+        sub, _sid = await self._make_sub("subscribe", pattern=pattern)
+        return sub
+
+    async def serve(self, subject: str) -> Subscription:
+        sub, sid = await self._make_sub("serve", subject=subject)
+        self._served[subject] = sid
+        return sub
+
+    async def unserve(self, subject: str) -> None:
+        self._served.pop(subject, None)
+        await self._conn.call("unserve", subject=subject)
+
+    async def work_queue(self, name: str) -> WorkQueue:
+        return _NetWorkQueue(self._conn, name)
+
+    async def _safe_call(self, op: str, **kw) -> None:
+        try:
+            await self._conn.call(op, **kw)
+        except Exception:
+            pass
+
+    async def close(self) -> None:
+        await self._conn.close()

@@ -1,0 +1,101 @@
+"""Worker main-wrapper: signal handling + graceful-shutdown timeout.
+
+Reference: lib/runtime/src/worker.rs:35-211 — ``Worker::from_settings()
+.execute(app)`` builds the runtime, traps SIGINT/SIGTERM, cancels the root
+token, and force-exits with code 911 if shutdown overruns
+``DYN_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT`` seconds. Same contract here, on
+asyncio: the app is an ``async fn(runtime)``; first signal cancels, second
+signal (or timeout overrun) force-exits.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+import sys
+from typing import Awaitable, Callable, Optional
+
+from .distributed import DistributedRuntime
+
+logger = logging.getLogger("dynamo_tpu.runtime.worker")
+
+GRACEFUL_EXIT_OVERRUN_CODE = 911  # matches the reference's worker.rs
+
+
+class Worker:
+    """Run an async app against a DistributedRuntime with UNIX-signal
+    lifecycle management."""
+
+    def __init__(self, runtime: Optional[DistributedRuntime] = None,
+                 graceful_timeout: Optional[float] = None):
+        self.runtime = runtime
+        if graceful_timeout is None:
+            graceful_timeout = float(os.environ.get(
+                "DYN_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT", "30"))
+        self.graceful_timeout = graceful_timeout
+
+    @classmethod
+    def from_settings(cls) -> "Worker":
+        """Build from environment: ``DYN_DISCOVERY_ADDR`` selects the
+        networked runtime; unset means in-process."""
+        return cls()
+
+    async def _build_runtime(self) -> DistributedRuntime:
+        if self.runtime is not None:
+            return self.runtime
+        addr = os.environ.get("DYN_DISCOVERY_ADDR", "")
+        if addr:
+            self.runtime = await DistributedRuntime.connect(
+                addr, advertise=os.environ.get("DYN_ADVERTISE_HOST"))
+        else:
+            self.runtime = DistributedRuntime.in_process()
+        return self.runtime
+
+    def execute(self, app: Callable[[DistributedRuntime], Awaitable]) -> None:
+        try:
+            asyncio.run(self._execute(app))
+        except KeyboardInterrupt:
+            pass
+
+    async def _execute(self, app) -> None:
+        runtime = await self._build_runtime()
+        stop = asyncio.Event()
+        hits = {"n": 0}
+
+        def on_signal() -> None:
+            hits["n"] += 1
+            if hits["n"] >= 2:
+                logger.error("second signal — force exit")
+                os._exit(GRACEFUL_EXIT_OVERRUN_CODE)
+            logger.info("shutdown signal received")
+            stop.set()
+
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, on_signal)
+            except (NotImplementedError, RuntimeError):
+                pass
+        runtime.on_lease_lost = stop.set
+
+        app_task = loop.create_task(app(runtime), name="worker-app")
+        stop_task = loop.create_task(stop.wait(), name="worker-stop")
+        done, _ = await asyncio.wait({app_task, stop_task},
+                                     return_when=asyncio.FIRST_COMPLETED)
+        if app_task in done:
+            stop_task.cancel()
+            exc = app_task.exception()
+            if exc is not None:
+                await runtime.shutdown()
+                raise exc
+        else:
+            app_task.cancel()
+        try:
+            await asyncio.wait_for(runtime.shutdown(), self.graceful_timeout)
+        except asyncio.TimeoutError:
+            logger.error("graceful shutdown overran %.0fs — force exit %d",
+                         self.graceful_timeout, GRACEFUL_EXIT_OVERRUN_CODE)
+            sys.stderr.flush()
+            os._exit(GRACEFUL_EXIT_OVERRUN_CODE)

@@ -95,6 +95,45 @@ def test_index_remove_worker_prunes():
     assert idx.node_count() == 1  # worker 1's deeper node pruned
 
 
+@pytest.mark.parametrize("native", [False, True])
+def test_remove_worker_sole_chain_holder(native):
+    """Regression: removing the only worker of a deep chain detaches the
+    whole chain; the native tree must not touch freed ancestor nodes while
+    walking its snapshot (use-after-free found in review)."""
+    idx = RadixIndexNative() if native else RadixIndexPython()
+    if native and idx is None:
+        pytest.skip("no C++ toolchain")
+    h = compute_block_hashes(list(range(40)), BS)  # 10-block chain
+    idx.apply_stored(7, None, h)
+    idx.remove_worker(7)
+    assert idx.node_count() == 0
+    assert idx.find_matches(h).scores == {}
+    # removing again is a no-op, and the tree is still usable
+    idx.remove_worker(7)
+    idx.apply_stored(8, None, h[:2])
+    assert idx.find_matches(h).scores == {8: 2}
+
+
+def test_duplicate_hash_reroot_native_python_equivalence():
+    """Out-of-order events can root the same block hash at two positions;
+    both trees must keep the same flat-map winner (the newest node) so
+    removals agree (divergence found in review)."""
+    try:
+        native = RadixIndexNative()
+    except RuntimeError:
+        pytest.skip("no C++ toolchain")
+    py = RadixIndexPython()
+    h = compute_block_hashes(list(range(12)), BS)  # 3 chained hashes
+    for idx in (native, py):
+        # child h[1] arrives before its parent is known → rooted at top
+        idx.apply_stored(1, h[0], h[1:2])   # parent unknown: re-rooted
+        idx.apply_stored(1, None, h[:1])    # parent arrives
+        idx.apply_stored(1, h[0], h[1:2])   # child again, correct position
+        idx.apply_removed(1, h[1:2])        # remove by hash
+    assert native.node_count() == py.node_count()
+    assert (native.find_matches(h).scores == py.find_matches(h).scores)
+
+
 @pytest.mark.asyncio
 async def test_kv_indexer_event_flow():
     indexer = KvIndexer(BS, prefer_native=False)
