@@ -1,0 +1,100 @@
+"""Paged-KV block gather/scatter: the TPU-native analog of the reference's
+CUDA copy kernels (lib/llm/src/kernels/block_copy.cu:41-758 —
+``copy_blocks_kernel`` strided gather/scatter, ``copy_stream_*`` staging API).
+
+On TPU these are XLA ops, not hand kernels: a block copy is a take /
+dynamic-update along the paged token axis, which XLA lowers to efficient HBM
+DMA; host staging is ``jax.device_put`` / ``device_get`` through TPU-VM DRAM
+(the pinned-memory tier, reference kv/storage.rs:241-316 CudaPinnedMemory).
+The TP-reshard-on-transfer permute (block_copy.cu:558-728) is likewise not a
+kernel here: resharding is a sharding annotation change and XLA inserts the
+collective (SURVEY.md §5.8).
+
+Cache layout (engine/models/llama.py init_kv_cache):
+    {"k": [L, H_kv, num_blocks*block_size, D], "v": same}
+block b occupies token slice [b*bs, (b+1)*bs).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+KVCache = Dict[str, jax.Array]
+
+__all__ = ["gather_blocks", "scatter_blocks", "gather_blocks_to_host",
+           "scatter_blocks_from_host"]
+
+
+@functools.partial(jax.jit, static_argnames=("block_size",))
+def gather_blocks(kv: KVCache, block_ids: jax.Array,
+                  block_size: int) -> KVCache:
+    """Stack ``n`` blocks out of the paged pool → {"k": [L, H, n, bs, D]}."""
+
+    def one(arr: jax.Array) -> jax.Array:
+        L, H, _T, D = arr.shape
+        paged = arr.reshape(L, H, -1, block_size, D)
+        return jnp.take(paged, block_ids, axis=2)
+
+    return {k: one(v) for k, v in kv.items()}
+
+
+@functools.partial(jax.jit, static_argnames=("block_size",),
+                   donate_argnums=(0,))
+def scatter_blocks(kv: KVCache, block_ids: jax.Array, values: KVCache,
+                   block_size: int) -> KVCache:
+    """Write stacked block values ([L, H, n, bs, D]) into pool slots
+    ``block_ids``; kv is donated so XLA updates HBM in place."""
+
+    def one(arr: jax.Array, val: jax.Array) -> jax.Array:
+        L, H, _T, D = arr.shape
+        paged = arr.reshape(L, H, -1, block_size, D)
+        paged = paged.at[:, :, block_ids].set(val.astype(arr.dtype))
+        return paged.reshape(L, H, -1, D)
+
+    return {k: one(arr, values[k]) for k, arr in kv.items()}
+
+
+def _pad_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def gather_blocks_to_host(kv: KVCache, block_ids, block_size: int) -> dict:
+    """Device → TPU-VM DRAM: gather on device (one DMA-friendly slice), then
+    a single transfer. Returns numpy {"k": [L, H, n, bs, D]}.
+
+    Block-id count is padded to a power of two (with the trash block, id 0)
+    so XLA compiles O(log n) gather programs, not one per count."""
+    n = len(block_ids)
+    padded = list(block_ids) + [0] * (_pad_pow2(n) - n)
+    ids = jnp.asarray(np.asarray(padded, dtype=np.int32))
+    stacked = gather_blocks(kv, ids, block_size)
+    return {k: np.asarray(v)[:, :, :n] for k, v in stacked.items()}
+
+
+def scatter_blocks_from_host(kv: KVCache, block_ids, host_values: dict,
+                             block_size: int) -> KVCache:
+    """TPU-VM DRAM → device: one transfer, then an on-device scatter into
+    the paged pool. Returns the new (donated-in-place) cache.
+
+    Padding targets the trash block (id 0), whose content is never read."""
+    n = len(block_ids)
+    pad = _pad_pow2(n) - n
+    padded = list(block_ids) + [0] * pad
+    ids = jnp.asarray(np.asarray(padded, dtype=np.int32))
+    dev_vals = {}
+    for k, v in host_values.items():
+        v = np.asarray(v)
+        if pad:
+            v = np.concatenate(
+                [v, np.zeros(v.shape[:2] + (pad,) + v.shape[3:], v.dtype)],
+                axis=2)
+        dev_vals[k] = jnp.asarray(v)
+    return scatter_blocks(kv, ids, dev_vals, block_size)

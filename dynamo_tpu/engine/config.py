@@ -101,6 +101,7 @@ class EngineConfig:
     num_kv_blocks: int = 512          # HBM KV pool size (blocks across all seqs)
     max_num_seqs: int = 8             # decode batch slots
     enable_prefix_reuse: bool = True  # match prompt blocks against the pool
+    host_kv_blocks: int = 0           # host (TPU-VM DRAM) offload tier; 0 = off
     prefill_buckets: List[int] = dataclasses.field(
         default_factory=lambda: [128, 256, 512, 1024, 2048])
     prefill_chunk: int = 0            # 0 = whole-prompt prefill

@@ -28,7 +28,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..llm.kv.blocks import TokenBlockSequence
+from ..llm.kv.offload import OffloadJob
 from ..llm.kv.pool import KvBlockManager
+from .block_copy import scatter_blocks_from_host
 from ..llm.kv_router.protocols import ForwardPassMetrics
 from ..llm.protocols.common import FinishReason
 from .config import EngineConfig, ModelConfig
@@ -94,10 +96,23 @@ class EngineCore:
                      if kv_event_publisher is not None else None)
         on_removed = (kv_event_publisher.publish_removed
                       if kv_event_publisher is not None else None)
+        host_pool = None
+        self.offload_engine = None
+        if engine_cfg.host_kv_blocks > 0:
+            from ..llm.kv.offload import HostKvPool, KvOffloadEngine
+            host_pool = HostKvPool(
+                engine_cfg.host_kv_blocks, model_cfg.num_layers,
+                model_cfg.num_kv_heads, engine_cfg.kv_block_size,
+                model_cfg.head_dim, dtype=param_dtype)
         self.kv_manager = KvBlockManager(
             engine_cfg.num_kv_blocks, engine_cfg.kv_block_size,
             enable_reuse=engine_cfg.enable_prefix_reuse,
-            on_stored=on_stored, on_removed=on_removed)
+            on_stored=on_stored, on_removed=on_removed, host_pool=host_pool)
+        if host_pool is not None:
+            self.offload_engine = KvOffloadEngine(
+                host_pool, engine_cfg.kv_block_size,
+                get_kv=lambda: self.kv,
+                release_holds=self.kv_manager.pool.release)
         self.M = engine_cfg.max_blocks_per_seq
         self.B = engine_cfg.max_num_seqs
 
@@ -163,6 +178,8 @@ class EngineCore:
             except asyncio.TimeoutError:
                 self._loop_task.cancel()
             self._loop_task = None
+        if self.offload_engine is not None:
+            await self.offload_engine.stop()
 
     # ------------------------------------------------------------- frontend
     async def submit(self, req: EngineRequest) -> None:
@@ -237,10 +254,26 @@ class EngineCore:
         req.slot = slot
         req.blocks = plan.all_blocks
         req.seq = plan.seq
-        req.prefix_hit_tokens = plan.hit_tokens
+        # host-tier hits: copy offloaded blocks up into their device slots
+        # before prefill (reference prepare_prefill_offload; the +40% TTFT
+        # multi-turn win, docs/architecture.md:91)
+        if plan.host_slots:
+            targets = plan.new_blocks[:len(plan.host_slots)]
+            values = self.kv_manager.host_pool.fetch(plan.host_slots)
+            self.kv = scatter_blocks_from_host(
+                self.kv, targets, values, self.cfg.kv_block_size)
+            # onboarded blocks now hold valid registered content
+            n_dev = len(plan.hit_blocks)
+            for i, bid in enumerate(targets):
+                j = n_dev + i
+                parent = plan.seq.sequence_hashes[j - 1] if j > 0 else None
+                self.kv_manager.pool.register(
+                    bid, plan.seq.sequence_hashes[j],
+                    plan.seq.block_hashes[j], parent)
+        req.prefix_hit_tokens = plan.hit_tokens + plan.host_hit_tokens
         # prefill only the un-matched suffix — the prefix KV is already in
         # the pool's blocks (this is the TTFT win of prefix reuse)
-        chunk = req.prompt[plan.hit_tokens:]
+        chunk = req.prompt[req.prefix_hit_tokens:]
         bucket = self.cfg.bucket_for(len(chunk))
         padded = np.zeros((bucket,), np.int32)
         padded[:len(chunk)] = chunk
@@ -252,7 +285,7 @@ class EngineCore:
         t0 = time.monotonic()
         tok, logprob, self.kv = self._prefill_jit(
             self.params, self.kv, jnp.asarray(padded), jnp.asarray(table),
-            jnp.asarray(plan.hit_tokens, jnp.int32),
+            jnp.asarray(req.prefix_hit_tokens, jnp.int32),
             jnp.asarray(len(chunk), jnp.int32),
             key,
             jnp.asarray(req.sampling.temperature, jnp.float32),
@@ -266,7 +299,8 @@ class EngineCore:
         self.total_prefill_tokens += len(chunk)
         # the prompt's full blocks now hold valid KV — register for reuse
         req.registered_blocks = self.kv_manager.register_full_blocks(
-            req.blocks, plan.seq, already_registered=len(plan.hit_blocks))
+            req.blocks, plan.seq,
+            already_registered=len(plan.hit_blocks) + len(plan.host_slots))
         self.slots[slot] = req
         # host mirrors
         self._block_tables[slot, :] = 0
@@ -276,9 +310,9 @@ class EngineCore:
         self._samp["top_p"][slot] = req.sampling.top_p
         self._seeds[slot] = req.sampling.seed
         logger.debug(
-            "admitted %s into slot %d (prompt=%d, hit=%d, bucket=%d, %.1fms)",
-            req.rid, slot, n_prompt, plan.hit_tokens, bucket,
-            1e3 * (time.monotonic() - t0))
+            "admitted %s into slot %d (prompt=%d, hit=%d+%dhost, bucket=%d, "
+            "%.1fms)", req.rid, slot, n_prompt, plan.hit_tokens,
+            plan.host_hit_tokens, bucket, 1e3 * (time.monotonic() - t0))
         self._emit(req, tok, float(logprob))
         self._maybe_finish_after_emit(req)
         return True
@@ -363,6 +397,17 @@ class EngineCore:
         if req.slot >= 0 and self.slots[req.slot] is req:
             self.slots[req.slot] = None
             self._block_tables[req.slot, :] = 0
+        # write registered prefix blocks back to the host tier before the
+        # device copies can be evicted; the extra hold keeps them pinned
+        # until the async copy lands (released by the offload engine)
+        if (self.offload_engine is not None and req.registered_blocks > 0
+                and req.seq is not None):
+            n = req.registered_blocks
+            pinned = req.blocks[:n]
+            self.kv_manager.pool.hold(pinned)
+            self.offload_engine.enqueue(OffloadJob(
+                block_ids=list(pinned),
+                seq_hashes=list(req.seq.sequence_hashes[:n])))
         self.kv_manager.pool.release(req.blocks)
         req.blocks = []
 

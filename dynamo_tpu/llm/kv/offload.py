@@ -1,0 +1,239 @@
+"""Host-memory KV tier: offloaded prefix blocks in TPU-VM DRAM.
+
+Reference: the "KV cache offload to system memory" pillar — kv/storage.rs
+``StorageType::{Device,Pinned,System}`` + CudaPinnedMemory staging +
+``KvStorageManager::prepare_prefill_offload`` (kv/manager.rs:21-168), which
+buys +40% TTFT on multi-turn workloads (docs/architecture.md:91). TPU-native
+redesign: the host tier is one preallocated numpy arena (TPU-VM DRAM is the
+pinned tier — no cudaHostAlloc analog needed), blocks keyed by chained
+sequence hash with LRU eviction, and device↔host movement is the XLA
+gather/scatter + single-transfer path in engine/block_copy.py.
+
+Two pieces:
+- :class:`HostKvPool` — the arena: slot allocation, hash→slot map, LRU.
+- :class:`KvOffloadEngine` — async pump: drains an offload queue (device →
+  host) off the engine's critical path, and performs synchronous onboarding
+  (host → device) during admission, where the data is needed *now*.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger("dynamo_tpu.kv.offload")
+
+__all__ = ["HostKvPool", "KvOffloadEngine", "OffloadJob"]
+
+
+class HostKvPool:
+    """Preallocated host arena of KV blocks keyed by sequence hash.
+
+    Shapes: per block the stacked layout [L, H_kv, bs, D] for k and v —
+    matching engine/block_copy.py's gather output sliced per block.
+    """
+
+    def __init__(self, capacity_blocks: int, num_layers: int,
+                 num_kv_heads: int, block_size: int, head_dim: int,
+                 dtype=np.float32):
+        self.capacity = capacity_blocks
+        shape = (capacity_blocks, num_layers, num_kv_heads, block_size,
+                 head_dim)
+        self._arena = {"k": np.zeros(shape, dtype=dtype),
+                       "v": np.zeros(shape, dtype=dtype)}
+        self._free: List[int] = list(range(capacity_blocks - 1, -1, -1))
+        self._by_hash: Dict[int, int] = {}       # seq_hash → slot
+        self._lru: Dict[int, None] = {}          # seq_hash → (ordered dict)
+        # stats
+        self.stored_blocks_total = 0
+        self.evicted_blocks_total = 0
+        self.match_queries = 0
+        self.match_hits = 0
+
+    def __len__(self) -> int:
+        return len(self._by_hash)
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    def _slot_for(self, seq_hash: int) -> Optional[int]:
+        """Existing slot, else a fresh/evicted one. None if capacity == 0."""
+        slot = self._by_hash.get(seq_hash)
+        if slot is not None:
+            self._lru.pop(seq_hash, None)
+            self._lru[seq_hash] = None
+            return slot
+        if not self._free:
+            if not self._lru:
+                return None
+            victim = next(iter(self._lru))
+            self._lru.pop(victim)
+            self._free.append(self._by_hash.pop(victim))
+            self.evicted_blocks_total += 1
+        slot = self._free.pop()
+        self._by_hash[seq_hash] = slot
+        self._lru[seq_hash] = None
+        return slot
+
+    def store(self, seq_hashes: Sequence[int], values: dict) -> int:
+        """Write stacked blocks ({"k": [L, H, n, bs, D]}) under their hashes.
+        Returns how many were stored (capacity may evict others)."""
+        n = 0
+        for i, h in enumerate(seq_hashes):
+            slot = self._slot_for(h)
+            if slot is None:
+                break
+            self._arena["k"][slot] = values["k"][:, :, i]
+            self._arena["v"][slot] = values["v"][:, :, i]
+            self.stored_blocks_total += 1
+            n += 1
+        return n
+
+    def match_prefix(self, seq_hashes: Sequence[int]) -> List[int]:
+        """Longest leading run of hashes present. Returns their slots and
+        freshens LRU order."""
+        out: List[int] = []
+        for h in seq_hashes:
+            self.match_queries += 1
+            slot = self._by_hash.get(h)
+            if slot is None:
+                break
+            self.match_hits += 1
+            self._lru.pop(h, None)
+            self._lru[h] = None
+            out.append(slot)
+        return out
+
+    def fetch(self, slots: Sequence[int]) -> dict:
+        """Stacked values for ``slots``: {"k": [L, H, n, bs, D]}."""
+        idx = np.asarray(slots, dtype=np.int64)
+        return {"k": np.ascontiguousarray(
+                    self._arena["k"][idx].transpose(1, 2, 0, 3, 4)),
+                "v": np.ascontiguousarray(
+                    self._arena["v"][idx].transpose(1, 2, 0, 3, 4))}
+
+    def contains(self, seq_hash: int) -> bool:
+        return seq_hash in self._by_hash
+
+    def hit_rate(self) -> float:
+        return self.match_hits / max(self.match_queries, 1)
+
+
+@dataclasses.dataclass
+class OffloadJob:
+    """Device blocks to write back to host. The enqueuer pre-holds
+    ``block_ids`` in the device pool (an extra refcount) so they cannot be
+    reused mid-copy; :class:`KvOffloadEngine` releases that hold via its
+    ``release_holds`` callback once the copy lands (or fails)."""
+
+    block_ids: List[int]
+    seq_hashes: List[int]
+
+
+class KvOffloadEngine:
+    """Asynchronous device→host write-back pump.
+
+    The engine enqueues jobs when sequences finish (their full blocks hold
+    valid KV); the pump batches jobs, gathers once on device, transfers once,
+    and releases the device holds. Mirrors the role of the reference's
+    CopyStream + offload path (kv/layer.rs CopyStream, manager.rs
+    prepare_prefill_offload) with XLA DMA instead of CUDA streams.
+    """
+
+    def __init__(self, host_pool: HostKvPool, block_size: int,
+                 get_kv: Callable[[], dict],
+                 release_holds: Optional[Callable[[List[int]], None]] = None,
+                 max_batch_blocks: int = 64):
+        self.host_pool = host_pool
+        self.block_size = block_size
+        self.get_kv = get_kv
+        self.release_holds = release_holds
+        self.max_batch_blocks = max_batch_blocks
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+        self.offloaded_blocks_total = 0
+
+    def enqueue(self, job: OffloadJob) -> None:
+        self._queue.put_nowait(job)
+        self._ensure_task()
+
+    def _ensure_task(self) -> None:
+        if self._task is None or self._task.done():
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return
+            self._task = loop.create_task(self._run(), name="kv-offload")
+
+    async def _run(self) -> None:
+        while True:
+            job: OffloadJob = await self._queue.get()
+            jobs = [job]
+            total = len(job.block_ids)
+            while total < self.max_batch_blocks and not self._queue.empty():
+                j = self._queue.get_nowait()
+                jobs.append(j)
+                total += len(j.block_ids)
+            try:
+                await self._process(jobs)
+            except Exception:  # noqa: BLE001 — write-back is best-effort
+                logger.exception("kv offload batch failed")
+            finally:
+                if self.release_holds is not None:
+                    for j in jobs:
+                        self.release_holds(j.block_ids)
+                for _ in jobs:
+                    self._queue.task_done()
+            await asyncio.sleep(0)  # yield to the engine loop
+
+    async def _process(self, jobs: List[OffloadJob]) -> None:
+        import jax.numpy as jnp
+        from ...engine.block_copy import _pad_pow2, gather_blocks
+
+        block_ids = [b for j in jobs for b in j.block_ids]
+        seq_hashes = [h for j in jobs for h in j.seq_hashes]
+        # skip blocks already resident on host (multi-turn re-offload)
+        keep = [i for i, h in enumerate(seq_hashes)
+                if not self.host_pool.contains(h)]
+        if not keep:
+            return
+        ids = [block_ids[i] for i in keep]
+        hashes = [seq_hashes[i] for i in keep]
+        # dispatch the on-device gather HERE, on the loop thread: it orders
+        # correctly against the engine's donated decode steps and returns a
+        # fresh (never-donated) buffer
+        n = len(ids)
+        padded = ids + [0] * (_pad_pow2(n) - n)
+        stacked = gather_blocks(self.get_kv(),
+                                jnp.asarray(np.asarray(padded, np.int32)),
+                                self.block_size)
+        # ...then do the blocking device→DRAM transfer off-thread so decode
+        # keeps stepping during the DMA
+        values = await asyncio.to_thread(
+            lambda: {k: np.asarray(v)[:, :, :n] for k, v in stacked.items()})
+        stored = self.host_pool.store(hashes, values)
+        self.offloaded_blocks_total += stored
+
+    async def drain(self) -> None:
+        self._ensure_task()
+        await self._queue.join()
+
+    async def stop(self) -> None:
+        """Flush pending write-backs, then cancel the pump."""
+        try:
+            await asyncio.wait_for(self.drain(), timeout=10)
+        except asyncio.TimeoutError:
+            logger.warning("kv offload drain timed out; dropping queue")
+            while not self._queue.empty():
+                job = self._queue.get_nowait()
+                if self.release_holds is not None:
+                    self.release_holds(job.block_ids)
+                self._queue.task_done()
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None

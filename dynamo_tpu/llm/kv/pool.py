@@ -152,6 +152,13 @@ class KvBlockPool:
         if self.on_stored is not None:
             self.on_stored(bid, seq_hash, tokens_hash, parent_hash)
 
+    def hold(self, blocks: Sequence[int]) -> None:
+        """Add one reference to already-held blocks (pins them across an
+        async copy, e.g. host offload write-back)."""
+        for bid in blocks:
+            if bid != 0:
+                self._meta[bid].refcount += 1
+
     # ------------------------------------------------------------- release
     def release(self, blocks: Sequence[int]) -> None:
         """Drop one reference from each block; refcount-0 blocks become
@@ -179,34 +186,47 @@ class KvBlockPool:
 @dataclasses.dataclass
 class PrefillPlan:
     """Outcome of preparing a sequence for prefill (reference
-    `KvStorageManager::prepare_prefill_sequence`, kv/manager.rs:21-168)."""
+    `KvStorageManager::prepare_prefill_sequence` /
+    `prepare_prefill_offload`, kv/manager.rs:21-168)."""
 
     hit_blocks: List[int]
     new_blocks: List[int]
     hit_tokens: int
     seq: TokenBlockSequence
+    # host-tier hits: slots in the HostKvPool whose content must be copied
+    # into the first len(host_slots) entries of new_blocks before prefill
+    host_slots: List[int] = dataclasses.field(default_factory=list)
 
     @property
     def all_blocks(self) -> List[int]:
         return self.hit_blocks + self.new_blocks
 
+    @property
+    def host_hit_tokens(self) -> int:
+        return len(self.host_slots) * self.seq.block_size
+
 
 class KvBlockManager:
-    """Pool + hashing glue the engine admit path calls."""
+    """Pool + hashing glue the engine admit path calls. Optionally backed by
+    a host (TPU-VM DRAM) tier: device misses fall through to the host pool
+    (reference `prepare_prefill_offload`)."""
 
     def __init__(self, num_blocks: int, block_size: int,
-                 on_stored=None, on_removed=None, enable_reuse: bool = True):
+                 on_stored=None, on_removed=None, enable_reuse: bool = True,
+                 host_pool=None):
         self.block_size = block_size
         self.pool = KvBlockPool(num_blocks, on_stored=on_stored,
                                 on_removed=on_removed)
         self.enable_reuse = enable_reuse
+        self.host_pool = host_pool
 
     def prepare_prefill(self, prompt: Sequence[int],
                         extra_blocks: int = 1) -> Optional[PrefillPlan]:
-        """Match the prompt's full blocks against the pool, allocate the
-        remainder (+ room for `extra_blocks` of generation). None = out of
-        memory. At least one prompt token is always left to recompute so
-        prefill produces the first-token logits."""
+        """Match the prompt's full blocks against the pool (device tier, then
+        host tier), allocate the remainder (+ room for `extra_blocks` of
+        generation). None = out of memory. At least one prompt token is
+        always left to recompute so prefill produces the first-token
+        logits."""
         seq = TokenBlockSequence(self.block_size, prompt)
         matchable = seq.sequence_hashes
         # never match the *entire* prompt — hold back the final block so at
@@ -216,6 +236,10 @@ class KvBlockManager:
         hit_blocks = (self.pool.match_prefix(matchable)
                       if self.enable_reuse else [])
         hit_tokens = len(hit_blocks) * self.block_size
+        host_slots: List[int] = []
+        if self.enable_reuse and self.host_pool is not None:
+            host_slots = self.host_pool.match_prefix(
+                matchable[len(hit_blocks):])
         total_needed = (len(prompt) + extra_blocks * self.block_size
                         + self.block_size - 1) // self.block_size
         n_new = total_needed - len(hit_blocks)
@@ -224,7 +248,8 @@ class KvBlockManager:
             self.pool.release(hit_blocks)
             return None
         return PrefillPlan(hit_blocks=hit_blocks, new_blocks=new_blocks,
-                           hit_tokens=hit_tokens, seq=seq)
+                           hit_tokens=hit_tokens, seq=seq,
+                           host_slots=host_slots)
 
     def register_full_blocks(self, plan_blocks: List[int],
                              seq: TokenBlockSequence,

@@ -1,0 +1,162 @@
+"""Host KV offload tier: block copy ops, host pool, and the engine's
+offload → evict → onboard cycle (the reference's system-memory KV offload
+pillar, docs/architecture.md:91; TPU-native per SURVEY.md §5.8)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.block_copy import (gather_blocks_to_host,
+                                          scatter_blocks_from_host)
+from dynamo_tpu.llm.kv.blocks import TokenBlockSequence
+from dynamo_tpu.llm.kv.offload import HostKvPool, KvOffloadEngine, OffloadJob
+from dynamo_tpu.llm.kv.pool import KvBlockManager
+
+BS = 4  # block size
+L, H, D = 2, 2, 8
+NB = 16  # device blocks
+
+
+def _rand_kv(rng):
+    import jax.numpy as jnp
+    return {"k": jnp.asarray(rng.normal(size=(L, H, NB * BS, D)),
+                             dtype=jnp.float32),
+            "v": jnp.asarray(rng.normal(size=(L, H, NB * BS, D)),
+                             dtype=jnp.float32)}
+
+
+def test_gather_scatter_roundtrip():
+    rng = np.random.default_rng(0)
+    kv = _rand_kv(rng)
+    src = [2, 5, 7]
+    host = gather_blocks_to_host(kv, src, BS)
+    assert host["k"].shape == (L, H, 3, BS, D)
+    # gathered content matches the pool slices
+    k_np = np.asarray(kv["k"]).reshape(L, H, NB, BS, D)
+    np.testing.assert_allclose(host["k"][:, :, 1], k_np[:, :, 5])
+    # scatter into different slots of a second cache
+    kv2 = _rand_kv(rng)
+    dst = [9, 11, 3]
+    kv2 = scatter_blocks_from_host(kv2, dst, host, BS)
+    k2 = np.asarray(kv2["k"]).reshape(L, H, NB, BS, D)
+    v2 = np.asarray(kv2["v"]).reshape(L, H, NB, BS, D)
+    np.testing.assert_allclose(k2[:, :, 9], k_np[:, :, 2])
+    np.testing.assert_allclose(k2[:, :, 3], k_np[:, :, 7])
+    np.testing.assert_allclose(
+        v2[:, :, 11],
+        np.asarray(kv["v"]).reshape(L, H, NB, BS, D)[:, :, 5])
+
+
+def test_host_pool_store_match_lru_eviction():
+    pool = HostKvPool(capacity_blocks=3, num_layers=L, num_kv_heads=H,
+                      block_size=BS, head_dim=D)
+    vals = {"k": np.ones((L, H, 3, BS, D), np.float32),
+            "v": np.ones((L, H, 3, BS, D), np.float32)}
+    assert pool.store([101, 102, 103], vals) == 3
+    assert pool.match_prefix([101, 102, 103]) == [
+        pool._by_hash[101], pool._by_hash[102], pool._by_hash[103]]
+    assert pool.match_prefix([999]) == []
+    # prefix semantics: gap stops the match
+    assert len(pool.match_prefix([101, 999, 103])) == 1
+    # store a 4th block → LRU victim is the least recently matched
+    pool.match_prefix([101, 102, 103])   # freshen all; 101 oldest after...
+    pool.match_prefix([102, 103])        # ...this leaves 101 LRU
+    one = {"k": np.zeros((L, H, 1, BS, D), np.float32),
+           "v": np.zeros((L, H, 1, BS, D), np.float32)}
+    assert pool.store([104], one) == 1
+    assert not pool.contains(101) and pool.contains(104)
+    assert pool.evicted_blocks_total == 1
+
+
+def test_host_pool_fetch_returns_stacked_layout():
+    pool = HostKvPool(capacity_blocks=4, num_layers=L, num_kv_heads=H,
+                      block_size=BS, head_dim=D)
+    vals = {"k": np.stack([np.full((L, H, BS, D), i, np.float32)
+                           for i in range(2)], axis=2),
+            "v": np.stack([np.full((L, H, BS, D), 10 + i, np.float32)
+                           for i in range(2)], axis=2)}
+    pool.store([7, 8], vals)
+    out = pool.fetch(pool.match_prefix([7, 8]))
+    assert out["k"].shape == (L, H, 2, BS, D)
+    np.testing.assert_allclose(out["k"][:, :, 0], 0.0)
+    np.testing.assert_allclose(out["k"][:, :, 1], 1.0)
+    np.testing.assert_allclose(out["v"][:, :, 1], 11.0)
+
+
+@pytest.mark.asyncio
+async def test_offload_engine_write_back_and_manager_fallthrough():
+    """Device pool + host tier: blocks offloaded on release survive device
+    eviction and are found by prepare_prefill's host match."""
+    rng = np.random.default_rng(1)
+    kv = {"kv": _rand_kv(rng)}  # mutable holder for get_kv
+    host = HostKvPool(capacity_blocks=8, num_layers=L, num_kv_heads=H,
+                      block_size=BS, head_dim=D)
+    mgr = KvBlockManager(NB, BS, host_pool=host)
+    eng = KvOffloadEngine(host, BS, get_kv=lambda: kv["kv"],
+                          release_holds=mgr.pool.release)
+
+    prompt = list(range(10))  # 2 full blocks + partial
+    plan = mgr.prepare_prefill(prompt)
+    assert plan.hit_tokens == 0 and not plan.host_slots
+    mgr.register_full_blocks(plan.all_blocks, plan.seq, 0)
+    # finish: pin + offload the 2 registered blocks, then release
+    mgr.pool.hold(plan.all_blocks[:2])
+    eng.enqueue(OffloadJob(block_ids=plan.all_blocks[:2],
+                           seq_hashes=plan.seq.sequence_hashes[:2]))
+    mgr.pool.release(plan.all_blocks)
+    await eng.drain()
+    assert eng.offloaded_blocks_total == 2
+    # wipe the device tier (simulates eviction under pressure)
+    mgr.pool.reset()
+    plan2 = mgr.prepare_prefill(prompt)
+    assert plan2.hit_tokens == 0
+    assert len(plan2.host_slots) == 2
+    assert plan2.host_hit_tokens == 8
+    # onboarded content equals what was offloaded
+    fetched = host.fetch(plan2.host_slots)
+    orig = gather_blocks_to_host(kv["kv"], plan.all_blocks[:2], BS)
+    np.testing.assert_allclose(fetched["k"], orig["k"])
+
+
+@pytest.mark.asyncio
+async def test_engine_core_multi_turn_offload_onboard_equivalence():
+    """End-to-end through EngineCore: generate with prompt P (registers +
+    offloads on finish), wipe the device reuse pool, resubmit P — the host
+    tier restores the prefix and generation is identical to a cold run."""
+    import jax.numpy as jnp
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineCore, EngineRequest
+    from dynamo_tpu.engine.sampling import SlotSampling
+
+    mcfg = ModelConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                       num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                       max_position_embeddings=256)
+    ecfg = EngineConfig(max_model_len=64, kv_block_size=4, num_kv_blocks=32,
+                        max_num_seqs=2, prefill_buckets=[32, 64],
+                        host_kv_blocks=16)
+    core = EngineCore(mcfg, ecfg, attn_impl="xla", param_dtype=jnp.float32)
+    prompt = list(range(1, 13))  # 3 full blocks
+
+    async def run_once():
+        req = EngineRequest(rid="r", prompt=list(prompt),
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=4, eos_ids=frozenset())
+        await core.submit(req)
+        toks = []
+        while True:
+            item, payload = await req.out_queue.get()
+            if item is FINISH_SENTINEL:
+                return toks, req.prefix_hit_tokens
+            toks.append(item)
+
+    toks1, hit1 = await run_once()
+    assert hit1 == 0
+    await core.offload_engine.drain()
+    assert core.offload_engine.offloaded_blocks_total >= 2
+    # wipe the device reuse tier: only the host tier can restore the prefix
+    core.kv_manager.pool.reset()
+    toks2, hit2 = await run_once()
+    assert hit2 >= 8  # host-tier hit (first 2+ blocks; last is held back)
+    assert toks2 == toks1  # identical continuation through onboarded KV
+    await core.stop()
