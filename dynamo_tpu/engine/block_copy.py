@@ -26,8 +26,8 @@ import numpy as np
 
 KVCache = Dict[str, jax.Array]
 
-__all__ = ["gather_blocks", "scatter_blocks", "gather_blocks_to_host",
-           "scatter_blocks_from_host"]
+__all__ = ["gather_blocks", "scatter_blocks", "gather_blocks_dispatch",
+           "gather_blocks_to_host", "scatter_blocks_from_host"]
 
 
 @functools.partial(jax.jit, static_argnames=("block_size",))
@@ -66,16 +66,25 @@ def _pad_pow2(n: int) -> int:
     return p
 
 
-def gather_blocks_to_host(kv: KVCache, block_ids, block_size: int) -> dict:
-    """Device → TPU-VM DRAM: gather on device (one DMA-friendly slice), then
-    a single transfer. Returns numpy {"k": [L, H, n, bs, D]}.
+def gather_blocks_dispatch(kv: KVCache, block_ids, block_size: int) -> KVCache:
+    """Dispatch (but do not fetch) the on-device gather of ``block_ids``.
 
     Block-id count is padded to a power of two (with the trash block, id 0)
-    so XLA compiles O(log n) gather programs, not one per count."""
+    so XLA compiles O(log n) gather programs, not one per count; callers
+    slice ``[:, :, :len(block_ids)]`` after fetching. Dispatching eagerly
+    orders the read before any later donated in-place KV update (single
+    device stream = program order), so the caller may fetch off-thread."""
     n = len(block_ids)
     padded = list(block_ids) + [0] * (_pad_pow2(n) - n)
     ids = jnp.asarray(np.asarray(padded, dtype=np.int32))
-    stacked = gather_blocks(kv, ids, block_size)
+    return gather_blocks(kv, ids, block_size)
+
+
+def gather_blocks_to_host(kv: KVCache, block_ids, block_size: int) -> dict:
+    """Device → TPU-VM DRAM: gather on device (one DMA-friendly slice), then
+    a single transfer. Returns numpy {"k": [L, H, n, bs, D]}."""
+    n = len(block_ids)
+    stacked = gather_blocks_dispatch(kv, block_ids, block_size)
     return {k: np.asarray(v)[:, :, :n] for k, v in stacked.items()}
 
 

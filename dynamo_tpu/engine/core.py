@@ -51,6 +51,15 @@ class EngineRequest:
     eos_ids: frozenset
     ctx: object = None            # runtime EngineContext (cancellation)
     out_queue: asyncio.Queue = dataclasses.field(default_factory=asyncio.Queue)
+    # disaggregation (SURVEY.md §7 stage 7):
+    # - prefill worker: async callback(first_token, logprob, host_values,
+    #   seq_hashes) shipping the prompt's KV blocks to the decode engine;
+    #   the request finishes after prefill (the reference's max_tokens=1
+    #   remote-decode prefill, examples/llm/components/prefill_worker.py).
+    handoff: object = None
+    # - decode worker: KV arrived from a remote prefill (KvPayload);
+    #   admission scatters it instead of running the prefill program.
+    precomputed: object = None
     # engine state
     slot: int = -1
     blocks: List[int] = dataclasses.field(default_factory=list)
@@ -117,6 +126,7 @@ class EngineCore:
         self.B = engine_cfg.max_num_seqs
 
         self.slots: List[Optional[EngineRequest]] = [None] * self.B
+        self._handoff_tasks: set = set()
         self.waiting: asyncio.Queue[EngineRequest] = asyncio.Queue()
         self._work_event = asyncio.Event()
         self._loop_task: Optional[asyncio.Task] = None
@@ -248,7 +258,7 @@ class EngineCore:
     # ---------------------------------------------------------------- admit
     def _try_admit(self, req: EngineRequest, slot: int) -> bool:
         n_prompt = len(req.prompt)
-        plan = self.kv_manager.prepare_prefill(req.prompt)
+        plan = self.kv_manager.prepare_prefill(req.prompt, seq=req.seq)
         if plan is None:
             return False
         req.slot = slot
@@ -271,36 +281,42 @@ class EngineCore:
                     bid, plan.seq.sequence_hashes[j],
                     plan.seq.block_hashes[j], parent)
         req.prefix_hit_tokens = plan.hit_tokens + plan.host_hit_tokens
-        # prefill only the un-matched suffix — the prefix KV is already in
-        # the pool's blocks (this is the TTFT win of prefix reuse)
-        chunk = req.prompt[req.prefix_hit_tokens:]
-        bucket = self.cfg.bucket_for(len(chunk))
-        padded = np.zeros((bucket,), np.int32)
-        padded[:len(chunk)] = chunk
-        table = np.zeros((self.M,), np.int32)
-        table[:len(req.blocks)] = req.blocks
-        key = make_slot_keys(self.cfg.seed,
-                             jnp.asarray([req.sampling.seed]),
-                             jnp.asarray(0))[0]
+        n_already = len(plan.hit_blocks) + len(plan.host_slots)
         t0 = time.monotonic()
-        tok, logprob, self.kv = self._prefill_jit(
-            self.params, self.kv, jnp.asarray(padded), jnp.asarray(table),
-            jnp.asarray(req.prefix_hit_tokens, jnp.int32),
-            jnp.asarray(len(chunk), jnp.int32),
-            key,
-            jnp.asarray(req.sampling.temperature, jnp.float32),
-            jnp.asarray(req.sampling.top_k, jnp.int32),
-            jnp.asarray(req.sampling.top_p, jnp.float32))
-        tok = int(tok)
+        if req.precomputed is not None:
+            tok, logprob = self._admit_precomputed(req, n_already)
+        else:
+            # prefill only the un-matched suffix — the prefix KV is already
+            # in the pool's blocks (this is the TTFT win of prefix reuse)
+            chunk = req.prompt[req.prefix_hit_tokens:]
+            bucket = self.cfg.bucket_for(len(chunk))
+            padded = np.zeros((bucket,), np.int32)
+            padded[:len(chunk)] = chunk
+            table = np.zeros((self.M,), np.int32)
+            table[:len(req.blocks)] = req.blocks
+            key = make_slot_keys(self.cfg.seed,
+                                 jnp.asarray([req.sampling.seed]),
+                                 jnp.asarray(0))[0]
+            tok, logprob, self.kv = self._prefill_jit(
+                self.params, self.kv, jnp.asarray(padded), jnp.asarray(table),
+                jnp.asarray(req.prefix_hit_tokens, jnp.int32),
+                jnp.asarray(len(chunk), jnp.int32),
+                key,
+                jnp.asarray(req.sampling.temperature, jnp.float32),
+                jnp.asarray(req.sampling.top_k, jnp.int32),
+                jnp.asarray(req.sampling.top_p, jnp.float32))
+            tok, logprob = int(tok), float(logprob)
+            self.total_prefill_tokens += len(chunk)
         req.pos = n_prompt
         req.generated = 1
         req.last_token = tok
         req.first_token_time = time.monotonic()
-        self.total_prefill_tokens += len(chunk)
         # the prompt's full blocks now hold valid KV — register for reuse
         req.registered_blocks = self.kv_manager.register_full_blocks(
-            req.blocks, plan.seq,
-            already_registered=len(plan.hit_blocks) + len(plan.host_slots))
+            req.blocks, plan.seq, already_registered=n_already)
+        if req.handoff is not None:
+            self._handoff_and_finish(req, tok, logprob)
+            return True
         self.slots[slot] = req
         # host mirrors
         self._block_tables[slot, :] = 0
@@ -310,12 +326,58 @@ class EngineCore:
         self._samp["top_p"][slot] = req.sampling.top_p
         self._seeds[slot] = req.sampling.seed
         logger.debug(
-            "admitted %s into slot %d (prompt=%d, hit=%d+%dhost, bucket=%d, "
+            "admitted %s into slot %d (prompt=%d, hit=%d+%dhost, remote=%s, "
             "%.1fms)", req.rid, slot, n_prompt, plan.hit_tokens,
-            plan.host_hit_tokens, bucket, 1e3 * (time.monotonic() - t0))
+            plan.host_hit_tokens, req.precomputed is not None,
+            1e3 * (time.monotonic() - t0))
         self._emit(req, tok, float(logprob))
         self._maybe_finish_after_emit(req)
         return True
+
+    def _admit_precomputed(self, req: EngineRequest,
+                           n_already: int) -> tuple:
+        """Admission from a remote-prefill KV payload: scatter the shipped
+        block values into this engine's paged pool instead of running the
+        prefill program (the decode half of PD disaggregation; reference
+        examples/llm/components/worker.py remote-prefill path). Blocks the
+        decode engine already had (device/host prefix hits) are skipped —
+        only the remainder is written."""
+        pc = req.precomputed
+        n_prompt_blocks = self._blocks_needed(len(req.prompt))
+        targets = req.blocks[n_already:n_prompt_blocks]
+        if targets:
+            vals = {k: v[:, :, n_already:n_prompt_blocks]
+                    for k, v in pc.values.items()}
+            self.kv = scatter_blocks_from_host(
+                self.kv, targets, vals, self.cfg.kv_block_size)
+        return pc.first_token, pc.first_logprob
+
+    def _handoff_and_finish(self, req: EngineRequest, tok: int,
+                            logprob: float) -> None:
+        """Prefill-worker epilogue: dispatch an on-device gather of the
+        prompt's blocks (ordered before any later donated decode step by
+        the device's program order), then ship device→DRAM→TCP off-thread
+        so the engine loop keeps stepping during the DMA + DCN transfer."""
+        from .block_copy import gather_blocks_dispatch
+        n_blocks = self._blocks_needed(req.pos)
+        ids = req.blocks[:n_blocks]
+        stacked = gather_blocks_dispatch(self.kv, ids, self.cfg.kv_block_size)
+        seq_hashes = list(req.seq.sequence_hashes[:req.registered_blocks])
+        handoff = req.handoff
+
+        async def send() -> None:
+            values = await asyncio.to_thread(
+                lambda: {k: np.asarray(v)[:, :, :n_blocks]
+                         for k, v in stacked.items()})
+            await handoff(tok, logprob, values, seq_hashes)
+
+        task = asyncio.get_running_loop().create_task(
+            send(), name=f"kv-handoff-{req.rid}")
+        self._handoff_tasks.add(task)
+        task.add_done_callback(self._handoff_tasks.discard)
+        self._emit(req, tok, logprob)
+        self._release_slot(req)
+        self._finish_request(req, FinishReason.LENGTH)
 
     # --------------------------------------------------------------- decode
     def _decode_step(self) -> None:

@@ -1,0 +1,388 @@
+"""PD disaggregation: conditional remote prefill, the prefill queue, the
+decode-side engine wrapper, and the prefill worker.
+
+Reference pieces this re-implements TPU-natively:
+- ``DisaggregatedRouter`` (lib/llm/src/disagg_router.rs:24-259): remote iff
+  ``(prefill_len - prefix_hit_len) > max_local_prefill_length``, threshold
+  live-reconfigurable via a KV-store watch.
+- The NATS JetStream prefill queue (examples/llm/utils/{nats_queue,
+  prefill_queue}.py) → our bus WorkQueue (at-least-once, ack/nack).
+- ``VllmWorker``'s remote-prefill path + ``PrefillWorker``
+  (examples/llm/components/{worker,prefill_worker}.py): decode allocates,
+  enqueues a RemotePrefillRequest, prefill runs with max_tokens=1 and writes
+  the KV back, then decode proceeds.
+- The NIXL RDMA block handoff (vLLM patch nixl.py) → a TCP stream on the
+  existing response plane carrying the gathered block values (DCN staged
+  through TPU-VM DRAM; TP-reshard happens in the decode engine's scatter —
+  SURVEY.md §5.8).
+
+Failure semantics: remote prefill is an *optimization*. Any failure —
+no prefill workers, queue timeout, transfer error — falls back to local
+prefill on the decode engine, so disagg can never lose a request.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+from typing import Optional
+
+from ..engine.core import EngineCore, FINISH_SENTINEL, EngineRequest
+from ..runtime.codec import ConnectionInfo
+from ..runtime.distributed import DistributedRuntime
+from ..runtime.engine import ManyOut, SingleIn
+from ..runtime.kvstore import WatchEventType
+from ..runtime.tcp import StreamSender
+from .engines.jax_engine import JaxEngine
+from .kv.blocks import TokenBlockSequence
+from .protocols.disagg import (KvPayload, RemotePrefillRequest,
+                               decode_kv_payload, encode_kv_payload)
+
+logger = logging.getLogger("dynamo_tpu.llm.disagg")
+
+__all__ = ["DisaggregatedRouter", "PrefillQueue", "DisaggEngine",
+           "PrefillWorker", "PREFILL_QUEUE"]
+
+PREFILL_QUEUE = "prefill_queue"
+
+
+def disagg_config_key(model_name: str, kind: str = "chat") -> str:
+    """Reference etcd path: public/components/disagg_router/models/chat/{m}
+    (disagg_router.rs:38-140)."""
+    return f"public/components/disagg_router/models/{kind}/{model_name}"
+
+
+class DisaggregatedRouter:
+    """Local-vs-remote prefill decision with a live-watched threshold."""
+
+    def __init__(self, runtime: DistributedRuntime, model_name: str,
+                 max_local_prefill_length: int = 512,
+                 conditional: bool = True):
+        self.runtime = runtime
+        self.model_name = model_name
+        self.max_local_prefill_length = max_local_prefill_length
+        self.conditional = conditional
+        self._watch_task: Optional[asyncio.Task] = None
+        self._watcher = None
+
+    def prefill_remote(self, prefill_len: int, prefix_hit_len: int) -> bool:
+        """disagg_router.rs:239-249: remote iff the *un-cached* prefill work
+        exceeds the local threshold."""
+        if not self.conditional:
+            return True
+        return (prefill_len - prefix_hit_len) > self.max_local_prefill_length
+
+    async def start(self) -> "DisaggregatedRouter":
+        """Load the current stored threshold and watch for live updates."""
+        key = disagg_config_key(self.model_name)
+        entry = await self.runtime.store.kv_get(key)
+        if entry is not None:
+            self._apply(entry.value)
+        self._watcher = await self.runtime.store.watch_prefix(key)
+        self._watch_task = asyncio.get_running_loop().create_task(
+            self._watch_loop(), name="disagg-router-watch")
+        return self
+
+    def _apply(self, raw: bytes) -> None:
+        try:
+            cfg = json.loads(raw)
+            self.max_local_prefill_length = int(
+                cfg["max_local_prefill_length"])
+            logger.info("disagg threshold for %s → %d", self.model_name,
+                        self.max_local_prefill_length)
+        except (ValueError, KeyError, TypeError):
+            logger.warning("bad disagg config update ignored: %r", raw)
+
+    async def _watch_loop(self) -> None:
+        async for ev in self._watcher:
+            if ev.type == WatchEventType.PUT:
+                self._apply(ev.entry.value)
+
+    async def publish_threshold(self, value: int) -> None:
+        """Admin write (the llmctl-style live reconfig path)."""
+        await self.runtime.store.kv_put(
+            disagg_config_key(self.model_name),
+            json.dumps({"max_local_prefill_length": value}).encode())
+
+    async def stop(self) -> None:
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+        if self._watcher is not None:
+            self._watcher.close()
+
+
+class PrefillQueue:
+    """Thin wrapper over the bus work queue (prefill_queue.py:24-56)."""
+
+    def __init__(self, runtime: DistributedRuntime, name: str = PREFILL_QUEUE):
+        self.runtime = runtime
+        self.name = name
+        self._q = None
+
+    async def _queue(self):
+        if self._q is None:
+            self._q = await self.runtime.bus.work_queue(self.name)
+        return self._q
+
+    async def enqueue(self, req: RemotePrefillRequest) -> int:
+        q = await self._queue()
+        return await q.enqueue(req.to_json())
+
+    async def dequeue(self, timeout: Optional[float] = None,
+                      ack_deadline: float = 60.0):
+        q = await self._queue()
+        return await q.dequeue(timeout=timeout, ack_deadline=ack_deadline)
+
+    async def ack(self, item_id: int) -> None:
+        q = await self._queue()
+        await q.ack(item_id)
+
+    async def nack(self, item_id: int) -> None:
+        q = await self._queue()
+        await q.nack(item_id)
+
+    async def depth(self) -> int:
+        q = await self._queue()
+        return await q.depth()
+
+
+class DisaggEngine(JaxEngine):
+    """Decode-side engine: per request, decide local vs remote prefill;
+    remote path registers a KV-sink stream, enqueues the prefill work, and
+    admits the request with the shipped KV (examples worker.py:37-189)."""
+
+    def __init__(self, core: EngineCore, runtime: DistributedRuntime,
+                 disagg_router: DisaggregatedRouter,
+                 queue: Optional[PrefillQueue] = None,
+                 prefill_timeout: float = 30.0):
+        super().__init__(core)
+        self.runtime = runtime
+        self.disagg_router = disagg_router
+        self.queue = queue or PrefillQueue(runtime)
+        self.prefill_timeout = prefill_timeout
+        # observability
+        self.remote_prefills = 0
+        self.local_prefills = 0
+        self.remote_failures = 0
+
+    def _estimate_prefix_hit(self, req: EngineRequest) -> int:
+        """Hold-free device-tier prefix estimate (in tokens). The hash chain
+        is kept on the request so admission doesn't re-hash the prompt."""
+        bs = self.core.cfg.kv_block_size
+        req.seq = TokenBlockSequence(bs, req.prompt)
+        n = self.core.kv_manager.pool.peek_prefix(req.seq.sequence_hashes)
+        return n * bs
+
+    async def generate(self, request: SingleIn) -> ManyOut:
+        req = self.build_request(request)
+        hit = self._estimate_prefix_hit(req)
+        if self.disagg_router.prefill_remote(len(req.prompt), hit):
+            payload = await self._remote_prefill(req, hit)
+            if payload is not None:
+                req.precomputed = payload
+                self.remote_prefills += 1
+            else:
+                self.remote_failures += 1
+                self.local_prefills += 1
+        else:
+            self.local_prefills += 1
+        await self.core.submit(req)
+        return self.stream_response(req, request)
+
+    async def _remote_prefill(self, req: EngineRequest,
+                              hit: int) -> Optional[KvPayload]:
+        rt = self.runtime
+        await rt.tcp.start()
+        rx = rt.tcp.register()
+        rpr = RemotePrefillRequest(
+            request_id=req.rid, token_ids=list(req.prompt),
+            sampling=dataclasses.asdict(req.sampling),
+            connection_info=rt.tcp.connection_info(rx).to_dict(),
+            engine_id=rt.worker_uuid, prefix_hit_tokens=hit)
+        try:
+            await self.queue.enqueue(rpr)
+            prologue = await rx.wait_connected(timeout=self.prefill_timeout)
+            if prologue.error is not None:
+                raise RuntimeError(prologue.error)
+            deadline = asyncio.get_running_loop().time() + self.prefill_timeout
+            from ..runtime.codec import FrameKind
+            meta_header: Optional[bytes] = None
+            chunks: list = []
+            while True:
+                remaining = deadline - asyncio.get_running_loop().time()
+                if remaining <= 0:
+                    raise TimeoutError("kv payload timeout")
+                f = await rx.next_frame(timeout=remaining)
+                if f is None:
+                    continue
+                if f.kind == FrameKind.DATA:
+                    if f.header:
+                        meta_header = f.header
+                    chunks.append(f.data)
+                elif f.kind == FrameKind.ERROR:
+                    raise RuntimeError(f.header_json().get("error", "remote"))
+                elif f.kind == FrameKind.SENTINEL:
+                    if meta_header is None:
+                        raise RuntimeError("stream ended without payload")
+                    return decode_kv_payload(meta_header, b"".join(chunks))
+        except Exception as e:  # noqa: BLE001 — any failure → local fallback
+            logger.warning("remote prefill failed for %s (%s); "
+                           "falling back to local", req.rid, e)
+            return None
+        finally:
+            rx.close()
+            rt.tcp.unregister(rx.stream_id)
+
+    def stats(self) -> dict:
+        return {"remote_prefills": self.remote_prefills,
+                "local_prefills": self.local_prefills,
+                "remote_failures": self.remote_failures,
+                "max_local_prefill_length":
+                    self.disagg_router.max_local_prefill_length}
+
+
+class PrefillWorker:
+    """Pulls the prefill queue, runs prefill-with-handoff on its own engine,
+    streams the KV payload to the decode worker's sink, and acks.
+
+    Reference: examples/llm/components/prefill_worker.py:36-141 (dequeue →
+    NIXL-read metadata → prefill is_remote_decode max_tokens=1 → NIXL write
+    → notify). The TPU version needs no metadata store: the decode worker's
+    sink address travels inside the request."""
+
+    MAX_DELIVERIES = 3
+
+    def __init__(self, core: EngineCore, runtime: DistributedRuntime,
+                 queue: Optional[PrefillQueue] = None,
+                 send_timeout: float = 30.0):
+        self.core = core
+        self.runtime = runtime
+        self.queue = queue or PrefillQueue(runtime)
+        self.send_timeout = send_timeout
+        self._task: Optional[asyncio.Task] = None
+        self._inflight: set = set()
+        self._stopping = False
+        self.prefills_done = 0
+        self.prefills_failed = 0
+
+    async def start(self) -> "PrefillWorker":
+        self._stopping = False
+        self._task = asyncio.get_running_loop().create_task(
+            self._loop(), name="prefill-worker")
+        return self
+
+    async def _loop(self) -> None:
+        backoff = 0.5
+        while not self._stopping:
+            try:
+                item = await self.queue.dequeue(timeout=0.5)
+                backoff = 0.5
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — transient bus errors
+                logger.warning("prefill dequeue failed (%s); retrying in "
+                               "%.1fs", e, backoff)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 10.0)
+                continue
+            if item is None:
+                continue
+            t = asyncio.get_running_loop().create_task(
+                self._handle(item), name=f"prefill-item-{item.id}")
+            self._inflight.add(t)
+            t.add_done_callback(self._inflight.discard)
+
+    async def _handle(self, item) -> None:
+        try:
+            rpr = RemotePrefillRequest.from_json(item.payload)
+        except Exception:
+            logger.exception("undecodable prefill work item %d", item.id)
+            await self.queue.ack(item.id)
+            return
+        conn = ConnectionInfo.from_dict(rpr.connection_info)
+        try:
+            sender = await StreamSender.connect(conn, timeout=5.0)
+        except Exception:
+            # decode worker unreachable — retry a bounded number of times
+            # (it may be us who's partitioned), then drop: the decode side
+            # falls back to local prefill on its own timeout.
+            if item.deliveries >= self.MAX_DELIVERIES:
+                logger.warning("dropping prefill item %d after %d deliveries",
+                               item.id, item.deliveries)
+                await self.queue.ack(item.id)
+            else:
+                await self.queue.nack(item.id)
+            return
+
+        sent = asyncio.get_running_loop().create_future()
+        # the failure path may abandon `sent` mid-flight — consume any late
+        # exception so asyncio never logs "exception was never retrieved"
+        sent.add_done_callback(
+            lambda f: None if f.cancelled() else f.exception())
+
+        async def handoff(tok, logprob, values, seq_hashes) -> None:
+            try:
+                payload = KvPayload(
+                    request_id=rpr.request_id, first_token=tok,
+                    first_logprob=logprob, seq_hashes=seq_hashes,
+                    values=values)
+                header, data = encode_kv_payload(payload)
+                from .protocols.disagg import KV_CHUNK_BYTES
+                await sender.send(data[:KV_CHUNK_BYTES], header=header)
+                for off in range(KV_CHUNK_BYTES, len(data), KV_CHUNK_BYTES):
+                    await sender.send(data[off:off + KV_CHUNK_BYTES])
+                await sender.finish()
+                if not sent.done():
+                    sent.set_result(True)
+            except Exception as e:  # noqa: BLE001
+                if not sent.done():
+                    sent.set_exception(e)
+
+        from ..engine.sampling import SlotSampling
+        from ..runtime.engine import EngineContext
+        ctx = EngineContext(rpr.request_id)
+        req = EngineRequest(
+            rid=rpr.request_id, prompt=list(rpr.token_ids),
+            sampling=SlotSampling(**rpr.sampling), max_new_tokens=1,
+            eos_ids=frozenset(), ctx=ctx, handoff=handoff)
+        await self.core.submit(req)
+        try:
+            # drain the engine's (token, finish) signals, then await the send
+            while True:
+                out, _ = await asyncio.wait_for(req.out_queue.get(),
+                                                self.send_timeout)
+                if out is FINISH_SENTINEL:
+                    break
+            await asyncio.wait_for(sent, self.send_timeout)
+            await self.queue.ack(item.id)
+            self.prefills_done += 1
+        except Exception as e:  # noqa: BLE001
+            self.prefills_failed += 1
+            logger.warning("prefill handoff failed for %s (%s)",
+                           rpr.request_id, e)
+            # if the request is still queued/admitted in the engine, cancel
+            # it — its sink stream is gone, so its prefill would be wasted
+            ctx.stop_generating()
+            try:
+                await sender.finish(error=str(e))
+            except Exception:  # noqa: BLE001
+                pass
+            # the KV was computed but not delivered; decode falls back —
+            # ack (a re-run would hit the prefill worker's prefix cache
+            # anyway, but the sink stream is gone)
+            await self.queue.ack(item.id)
+
+    def stats(self) -> dict:
+        return {"prefills_done": self.prefills_done,
+                "prefills_failed": self.prefills_failed,
+                "inflight": len(self._inflight)}
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        for t in list(self._inflight):
+            t.cancel()

@@ -37,10 +37,10 @@ class JaxEngine(AsyncEngine):
         return cls(EngineCore(model_cfg, engine_cfg, params=params,
                               **core_kwargs))
 
-    async def generate(self, request: SingleIn) -> ManyOut:
+    def build_request(self, request: SingleIn) -> EngineRequest:
         pre: PreprocessedRequest = request.data
         sc = pre.stop_conditions
-        req = EngineRequest(
+        return EngineRequest(
             rid=request.id,
             prompt=list(pre.token_ids),
             sampling=SlotSampling.from_options(pre.sampling_options),
@@ -49,8 +49,14 @@ class JaxEngine(AsyncEngine):
                               (sc.stop_token_ids_hidden or pre.eos_token_ids)),
             ctx=request.ctx,
         )
-        await self.core.submit(req)
 
+    async def generate(self, request: SingleIn) -> ManyOut:
+        req = self.build_request(request)
+        await self.core.submit(req)
+        return self.stream_response(req, request)
+
+    def stream_response(self, req: EngineRequest,
+                        request: SingleIn) -> ManyOut:
         async def stream() -> AsyncIterator[Annotated[BackendOutput]]:
             while True:
                 item, payload = await req.out_queue.get()

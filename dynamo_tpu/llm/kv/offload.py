@@ -192,8 +192,7 @@ class KvOffloadEngine:
             await asyncio.sleep(0)  # yield to the engine loop
 
     async def _process(self, jobs: List[OffloadJob]) -> None:
-        import jax.numpy as jnp
-        from ...engine.block_copy import _pad_pow2, gather_blocks
+        from ...engine.block_copy import gather_blocks_dispatch
 
         block_ids = [b for j in jobs for b in j.block_ids]
         seq_hashes = [h for j in jobs for h in j.seq_hashes]
@@ -208,10 +207,7 @@ class KvOffloadEngine:
         # correctly against the engine's donated decode steps and returns a
         # fresh (never-donated) buffer
         n = len(ids)
-        padded = ids + [0] * (_pad_pow2(n) - n)
-        stacked = gather_blocks(self.get_kv(),
-                                jnp.asarray(np.asarray(padded, np.int32)),
-                                self.block_size)
+        stacked = gather_blocks_dispatch(self.get_kv(), ids, self.block_size)
         # ...then do the blocking device→DRAM transfer off-thread so decode
         # keeps stepping during the DMA
         values = await asyncio.to_thread(

@@ -91,6 +91,18 @@ class KvBlockPool:
             out.append(bid)
         return out
 
+    def peek_prefix(self, seq_hashes: Sequence[int]) -> int:
+        """Length (in blocks) of the longest matchable prefix, without
+        taking holds or touching stats — the disagg router's cheap estimate
+        of local prefix overlap (reference disagg_router.rs prefix_hit_len
+        input, computed by the worker before the remote/local decision)."""
+        n = 0
+        for h in seq_hashes:
+            if h not in self._by_hash:
+                break
+            n += 1
+        return n
+
     # ----------------------------------------------------------- allocate
     def alloc_uninit(self, n: int) -> Optional[List[int]]:
         """n fresh blocks (content garbage), evicting reusable LRU if needed.
@@ -220,14 +232,17 @@ class KvBlockManager:
         self.enable_reuse = enable_reuse
         self.host_pool = host_pool
 
-    def prepare_prefill(self, prompt: Sequence[int],
-                        extra_blocks: int = 1) -> Optional[PrefillPlan]:
+    def prepare_prefill(self, prompt: Sequence[int], extra_blocks: int = 1,
+                        seq: Optional[TokenBlockSequence] = None
+                        ) -> Optional[PrefillPlan]:
         """Match the prompt's full blocks against the pool (device tier, then
         host tier), allocate the remainder (+ room for `extra_blocks` of
         generation). None = out of memory. At least one prompt token is
         always left to recompute so prefill produces the first-token
-        logits."""
-        seq = TokenBlockSequence(self.block_size, prompt)
+        logits. ``seq`` may carry the prompt's already-computed hash chain
+        (e.g. from the disagg router's estimate) to avoid re-hashing."""
+        if seq is None:
+            seq = TokenBlockSequence(self.block_size, prompt)
         matchable = seq.sequence_hashes
         # never match the *entire* prompt — hold back the final block so at
         # least one token runs through prefill

@@ -1,0 +1,107 @@
+"""Disaggregation protocols: remote-prefill requests and the KV handoff
+wire format.
+
+Reference: the vLLM patch's ``RemotePrefillRequest{request_id,
+prompt_token_ids, sampling_params, block_ids, engine_id}`` and
+``RemotePrefillParams`` (container/deps/vllm patch:3584-3645), plus the NATS
+JetStream prefill queue (examples/llm/utils/prefill_queue.py:24-56).
+
+TPU-native redesign of the KV *transfer* itself: the reference moves blocks
+with NIXL RDMA writes into the decode engine's VRAM (patch nixl.py). Here the
+prefill worker dials the decode worker's TCP stream server (the same response
+plane every request already uses, runtime/tcp.py) and streams the gathered
+block values; the decode side scatters them into its paged HBM pool. Within
+a slice this is ICI-adjacent host staging; across slices it is DCN — both
+ride TPU-VM DRAM, which is the pinned tier (SURVEY.md §5.8). TP-reshard on
+handoff is free: the payload is the *unsharded* logical block array, and the
+decode engine's scatter re-shards it under its own mesh (the analog of
+``permute_scatter_memcpy``, block_copy.cu:558-728, done by XLA instead).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["RemotePrefillRequest", "KvPayload", "KV_CHUNK_BYTES",
+           "encode_kv_payload", "decode_kv_payload"]
+
+# One KV handoff can be GBs for long prompts (a Llama-8B-class model is
+# ~128 KB of K+V per token); split it across frames so no single frame
+# approaches the codec's MAX_FRAME bound (runtime/codec.py). The first
+# frame carries the metadata header; the rest are continuation chunks, and
+# the stream's SENTINEL marks completion.
+KV_CHUNK_BYTES = 64 * 1024 * 1024
+
+
+@dataclasses.dataclass
+class RemotePrefillRequest:
+    """One unit of work on the prefill queue."""
+
+    request_id: str
+    token_ids: List[int]
+    sampling: Dict                 # SlotSampling fields
+    connection_info: Dict          # decode worker's KV-sink stream (addr+id)
+    engine_id: str = ""            # decode worker identity (diagnostics)
+    prefix_hit_tokens: int = 0     # decode-side estimate (router metric)
+
+    def to_json(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "RemotePrefillRequest":
+        return cls(**json.loads(raw))
+
+
+@dataclasses.dataclass
+class KvPayload:
+    """Decoded KV handoff: first sampled token + stacked block values."""
+
+    request_id: str
+    first_token: int
+    first_logprob: float
+    seq_hashes: List[int]          # chained hashes of the FULL blocks
+    values: Dict[str, np.ndarray]  # {"k": [L, H_kv, n, bs, D], "v": ...}
+
+
+def _dtype_of(arr: np.ndarray) -> str:
+    return arr.dtype.name  # "bfloat16" round-trips via ml_dtypes
+
+
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_kv_payload(payload: KvPayload) -> tuple:
+    """→ (header bytes, data bytes) for one TCP DATA frame."""
+    k, v = payload.values["k"], payload.values["v"]
+    header = json.dumps({
+        "request_id": payload.request_id,
+        "first_token": payload.first_token,
+        "first_logprob": payload.first_logprob,
+        "seq_hashes": payload.seq_hashes,
+        "shape": list(k.shape),
+        "dtype": _dtype_of(k),
+    }).encode()
+    return header, k.tobytes() + v.tobytes()
+
+
+def decode_kv_payload(header: bytes, data: bytes) -> KvPayload:
+    h = json.loads(header)
+    shape = tuple(h["shape"])
+    dt = _np_dtype(h["dtype"])
+    nbytes = int(np.prod(shape)) * dt.itemsize
+    k = np.frombuffer(data[:nbytes], dtype=dt).reshape(shape)
+    v = np.frombuffer(data[nbytes:2 * nbytes], dtype=dt).reshape(shape)
+    return KvPayload(
+        request_id=h["request_id"], first_token=int(h["first_token"]),
+        first_logprob=float(h["first_logprob"]),
+        seq_hashes=[int(x) for x in h["seq_hashes"]],
+        values={"k": k, "v": v})

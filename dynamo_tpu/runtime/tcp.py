@@ -120,7 +120,15 @@ class TcpStreamServer:
         rx._connected.set()
         try:
             while True:
-                f = await read_frame(reader)
+                try:
+                    f = await read_frame(reader)
+                except Exception as e:  # malformed/oversized frame
+                    logger.warning("stream %s read failed: %s", sid, e)
+                    rx.frames.put_nowait(Frame(
+                        FrameKind.ERROR,
+                        json.dumps({"error": f"stream read failed: {e}"})
+                        .encode()))
+                    return
                 if f is None:
                     rx.frames.put_nowait(Frame(FrameKind.ERROR,
                                                b'{"error": "connection lost"}'))
@@ -181,8 +189,8 @@ class StreamSender:
         except (ConnectionError, asyncio.CancelledError):
             pass
 
-    async def send(self, data: bytes) -> None:
-        await write_frame(self._writer, Frame(FrameKind.DATA, b"", data))
+    async def send(self, data: bytes, header: bytes = b"") -> None:
+        await write_frame(self._writer, Frame(FrameKind.DATA, header, data))
 
     async def finish(self, error: Optional[str] = None) -> None:
         try:
