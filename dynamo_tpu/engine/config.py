@@ -111,6 +111,9 @@ class EngineConfig:
     dp: int = 1                       # data parallel replicas inside one engine
     sp: int = 1                       # sequence parallel (ring attention) for prefill
     ep: int = 1                       # expert parallel (MoE)
+    # shortest cold prefill worth the ring path (per-layer shard_map +
+    # sp-1 ppermute rounds); shorter prompts stay on the chunked program
+    sp_min_prefill_tokens: int = 512
     seed: int = 0
 
     def __post_init__(self) -> None:

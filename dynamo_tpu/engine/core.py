@@ -100,6 +100,12 @@ class EngineCore:
         self.kv = llama.init_kv_cache(
             model_cfg, engine_cfg.num_kv_blocks, engine_cfg.kv_block_size,
             dtype=param_dtype)
+        if mesh is not None:
+            # place params/KV under the tp/sp layout; every jitted step then
+            # runs SPMD over the mesh with XLA-inserted ICI collectives
+            from ..parallel.sharding import shard_kv, shard_params
+            self.params = shard_params(self.params, mesh, model_cfg)
+            self.kv = shard_kv(self.kv, mesh)
         self.kv_event_publisher = kv_event_publisher
         on_stored = (kv_event_publisher.publish_stored
                      if kv_event_publisher is not None else None)
@@ -171,6 +177,24 @@ class EngineCore:
             return toks, logprobs, kv
 
         self._decode_jit = jax.jit(decode, donate_argnums=(1,))
+
+        # sequence-parallel long-prompt prefill (ring attention over "sp")
+        self._prefill_sp_jit = None
+        self._sp = 1
+        if self.mesh is not None and self.mesh.shape.get("sp", 1) > 1:
+            self._sp = self.mesh.shape["sp"]
+            mesh = self.mesh
+
+            def prefill_sp(params, kv, tokens, block_table, true_len,
+                           key, temperature, top_k, top_p):
+                logits, kv = llama.prefill_forward_sp(
+                    params, kv, tokens, block_table, true_len, statics, mesh)
+                tok, logprob = sample_tokens(
+                    logits[None, :], key[None], temperature[None],
+                    top_k[None], top_p[None])
+                return tok[0], logprob[0], kv
+
+            self._prefill_sp_jit = jax.jit(prefill_sp, donate_argnums=(1,))
 
     # ------------------------------------------------------------ lifecycle
     def ensure_started(self) -> None:
@@ -297,14 +321,28 @@ class EngineCore:
             key = make_slot_keys(self.cfg.seed,
                                  jnp.asarray([req.sampling.seed]),
                                  jnp.asarray(0))[0]
-            tok, logprob, self.kv = self._prefill_jit(
-                self.params, self.kv, jnp.asarray(padded), jnp.asarray(table),
-                jnp.asarray(req.prefix_hit_tokens, jnp.int32),
-                jnp.asarray(len(chunk), jnp.int32),
-                key,
-                jnp.asarray(req.sampling.temperature, jnp.float32),
-                jnp.asarray(req.sampling.top_k, jnp.int32),
-                jnp.asarray(req.sampling.top_p, jnp.float32))
+            use_sp = (self._prefill_sp_jit is not None
+                      and req.prefix_hit_tokens == 0
+                      and len(chunk) >= self.cfg.sp_min_prefill_tokens
+                      and bucket % self._sp == 0)
+            if use_sp:
+                tok, logprob, self.kv = self._prefill_sp_jit(
+                    self.params, self.kv, jnp.asarray(padded),
+                    jnp.asarray(table), jnp.asarray(len(chunk), jnp.int32),
+                    key,
+                    jnp.asarray(req.sampling.temperature, jnp.float32),
+                    jnp.asarray(req.sampling.top_k, jnp.int32),
+                    jnp.asarray(req.sampling.top_p, jnp.float32))
+            else:
+                tok, logprob, self.kv = self._prefill_jit(
+                    self.params, self.kv, jnp.asarray(padded),
+                    jnp.asarray(table),
+                    jnp.asarray(req.prefix_hit_tokens, jnp.int32),
+                    jnp.asarray(len(chunk), jnp.int32),
+                    key,
+                    jnp.asarray(req.sampling.temperature, jnp.float32),
+                    jnp.asarray(req.sampling.top_k, jnp.int32),
+                    jnp.asarray(req.sampling.top_p, jnp.float32))
             tok, logprob = int(tok), float(logprob)
             self.total_prefill_tokens += len(chunk)
         req.pos = n_prompt

@@ -154,6 +154,54 @@ class ModelStatics:
         return hash((id(self.cfg), self.block_size, self.attn_impl))
 
 
+def _run_layers(params: Params, kv: KVCache, x: jax.Array,
+                positions: jax.Array, slots: jax.Array, cfg: ModelConfig,
+                attn_fn) -> Tuple[jax.Array, KVCache]:
+    """Shared transformer stack: per layer — qkv projection, rope, KV
+    scatter into the paged pool, ``attn_fn`` (the only thing the three
+    forward paths differ in), wo residual, swiglu MLP; scanned over the
+    stacked layer params.
+
+    attn_fn(q, k_chunk, v_chunk, k_pool, v_pool) -> [N, H, Dh] where N is
+    the leading axis of x (tokens for prefill, batch for decode); the pool
+    args already contain this step's scattered KV.
+    """
+    N = x.shape[0]
+    inv_freq = jnp.asarray(rope_inv_freq(cfg))
+    layer_params = _layer_stack(params)
+
+    def layer(carry, xs):
+        h = carry
+        lp, k_l, v_l = xs["lp"], xs["k"], xs["v"]
+        hn = rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
+        q = (hn @ lp["wq"]).reshape(N, cfg.num_heads, cfg.head_dim)
+        k = (hn @ lp["wk"]).reshape(N, cfg.num_kv_heads, cfg.head_dim)
+        v = (hn @ lp["wv"]).reshape(N, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        k_l = k_l.at[:, slots, :].set(k.transpose(1, 0, 2).astype(k_l.dtype),
+                                      mode="drop")
+        v_l = v_l.at[:, slots, :].set(v.transpose(1, 0, 2).astype(v_l.dtype),
+                                      mode="drop")
+        attn = attn_fn(q, k, v, k_l, v_l)
+        h = h + attn.reshape(N, -1) @ lp["wo"]
+        hn2 = rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
+        h = h + swiglu(hn2, lp["gate"], lp["up"], lp["down"])
+        return h, (k_l, v_l)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer, x, {"lp": layer_params, "k": kv["k"], "v": kv["v"]})
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return x, {"k": k_new, "v": v_new}
+
+
+def _logits(params: Params, x: jax.Array) -> jax.Array:
+    head = params.get("lm_head")
+    out = (x @ head if head is not None
+           else x @ params["embed"].T.astype(x.dtype))
+    return out.astype(jnp.float32)
+
+
 def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
                     block_table: jax.Array, start_pos: jax.Array,
                     true_len: jax.Array, statics: ModelStatics
@@ -172,7 +220,6 @@ def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
     cfg = statics.cfg
     T = tokens.shape[0]
     bsz = statics.block_size
-    inv_freq = jnp.asarray(rope_inv_freq(cfg))
     scale = cfg.head_dim ** -0.5
 
     positions = start_pos + jnp.arange(T, dtype=jnp.int32)
@@ -184,24 +231,8 @@ def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
         0)
     seq_len = start_pos + true_len
 
-    x = params["embed"][tokens]  # activation dtype follows param dtype
-
-    layer_params = _layer_stack(params)
-
-    def layer(carry, xs):
-        h = carry
-        lp, k_l, v_l = xs["lp"], xs["k"], xs["v"]
-        hn = rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
-        q = (hn @ lp["wq"]).reshape(T, cfg.num_heads, cfg.head_dim)
-        k = (hn @ lp["wk"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
-        v = (hn @ lp["wv"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
-        # write chunk KV into the paged pool, then attend over the block table
-        k_l = k_l.at[:, slots, :].set(k.transpose(1, 0, 2).astype(k_l.dtype),
-                                      mode="drop")
-        v_l = v_l.at[:, slots, :].set(v.transpose(1, 0, 2).astype(v_l.dtype),
-                                      mode="drop")
+    def attn(q, _k, _v, k_l, v_l):
+        # attend over the whole block table (prefix KV + this chunk)
         idx = flat_token_indices(block_table[None, :], bsz)[0]       # [S]
         ks = jnp.take(k_l, idx, axis=1)                              # [KVH,S,Dh]
         vs = jnp.take(v_l, idx, axis=1)
@@ -213,21 +244,46 @@ def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
             kv_pos[None, :] < seq_len)
         scores = jnp.where(mask[None, None, :, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(vs.dtype)
-        attn = jnp.einsum("kgts,ksd->tkgd", probs, vs).reshape(
-            T, cfg.num_heads * cfg.head_dim)
-        h = h + attn @ lp["wo"]
-        hn2 = rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
-        h = h + swiglu(hn2, lp["gate"], lp["up"], lp["down"])
-        return h, (k_l, v_l)
+        return jnp.einsum("kgts,ksd->tkgd", probs, vs).reshape(
+            T, cfg.num_heads, cfg.head_dim)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer, x, {"lp": layer_params, "k": kv["k"], "v": kv["v"]})
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = params["embed"][tokens]  # activation dtype follows param dtype
+    x, kv_new = _run_layers(params, kv, x, positions, slots, cfg, attn)
     last = x[jnp.maximum(true_len - 1, 0)]
-    head = params.get("lm_head")
-    logits = (last @ head if head is not None
-              else last @ params["embed"].T.astype(last.dtype))
-    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+    return _logits(params, last), kv_new
+
+
+def prefill_forward_sp(params: Params, kv: KVCache, tokens: jax.Array,
+                       block_table: jax.Array, true_len: jax.Array,
+                       statics: ModelStatics, mesh) -> Tuple[jax.Array, KVCache]:
+    """Sequence-parallel whole-prompt prefill: the token axis is sharded
+    over the mesh's "sp" axis and attention runs as a ring over ICI
+    (parallel/ring_attention.py) — per-device activation/KV memory is
+    O(T / sp), enabling prompts that don't fit one chip's HBM.
+
+    Same contract as `prefill_forward` with start_pos fixed at 0 (the
+    engine uses this path for long prompts with no prefix-cache hit; hits
+    fall back to the chunked path). T must divide by the sp axis size.
+    """
+    from ...parallel.ring_attention import ring_attention
+
+    cfg = statics.cfg
+    T = tokens.shape[0]
+    bsz = statics.block_size
+    scale = cfg.head_dim ** -0.5
+
+    positions = jnp.arange(T, dtype=jnp.int32)
+    valid = positions < true_len
+    slots = jnp.where(valid, block_table[positions // bsz] * bsz +
+                      positions % bsz, 0)
+
+    def attn(q, k, v, _k_l, _v_l):
+        return ring_attention(q, k, v, mesh, scale=scale, kv_len=true_len)
+
+    x = params["embed"][tokens]
+    x, kv_new = _run_layers(params, kv, x, positions, slots, cfg, attn)
+    last = x[jnp.maximum(true_len - 1, 0)]
+    return _logits(params, last), kv_new
 
 
 def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
@@ -242,37 +298,15 @@ def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
     cfg = statics.cfg
     B = tokens.shape[0]
     bsz = statics.block_size
-    inv_freq = jnp.asarray(rope_inv_freq(cfg))
     scale = cfg.head_dim ** -0.5
     slots = block_tables[jnp.arange(B), positions // bsz] * bsz + positions % bsz
     seq_lens = positions + 1
 
-    x = params["embed"][tokens]  # [B, D]
-    layer_params = _layer_stack(params)
-
-    def layer(carry, xs):
-        h = carry
-        lp, k_l, v_l = xs["lp"], xs["k"], xs["v"]
-        hn = rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
-        q = (hn @ lp["wq"]).reshape(B, cfg.num_heads, cfg.head_dim)
-        k = (hn @ lp["wk"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
-        v = (hn @ lp["wv"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
-        k_l = k_l.at[:, slots, :].set(k.transpose(1, 0, 2).astype(k_l.dtype))
-        v_l = v_l.at[:, slots, :].set(v.transpose(1, 0, 2).astype(v_l.dtype))
-        attn = paged_attention(q, k_l, v_l, block_tables, seq_lens,
+    def attn(q, _k, _v, k_l, v_l):
+        return paged_attention(q, k_l, v_l, block_tables, seq_lens,
                                block_size=bsz, scale=scale,
                                impl=statics.attn_impl)
-        h = h + attn.reshape(B, -1) @ lp["wo"]
-        hn2 = rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
-        h = h + swiglu(hn2, lp["gate"], lp["up"], lp["down"])
-        return h, (k_l, v_l)
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer, x, {"lp": layer_params, "k": kv["k"], "v": kv["v"]})
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    head = params.get("lm_head")
-    logits = (x @ head if head is not None
-              else x @ params["embed"].T.astype(x.dtype))
-    return logits.astype(jnp.float32), {"k": k_new, "v": v_new}
+    x = params["embed"][tokens]  # [B, D]
+    x, kv_new = _run_layers(params, kv, x, positions, slots, cfg, attn)
+    return _logits(params, x), kv_new

@@ -1,0 +1,115 @@
+"""Ring attention: sequence-parallel causal attention over the "sp" mesh
+axis for long-context prefill.
+
+The reference has NO sequence/context parallelism (SURVEY.md §2.3: no hits
+for ring/ulysses/context_parallel) — its long-context levers stop at KV
+paging + conditional disagg. This is the TPU build's designed-fresh
+capability (SURVEY.md §5.7): shard the prompt over `sp`, keep each device's
+KV chunk resident, and rotate KV around the ring with `lax.ppermute` so
+every query chunk sees every KV chunk while per-device memory stays
+O(seq_len / sp). Softmax is accumulated online (flash-style m/l/acc
+carries), so the result is exact — not an approximation.
+
+Communication pattern: n-1 ppermute steps of [S/n, KVH, Dh] chunks ride the
+ICI ring concurrently with the local chunk matmuls (XLA overlaps the
+collective-permute with compute when the chunk math is large enough —
+the classic ring-attention latency-hiding schedule).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+__all__ = ["ring_attention_local", "ring_attention"]
+
+
+def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
+                         *, axis_name: str, scale: float,
+                         q_offset: Optional[jax.Array] = None,
+                         kv_len: Optional[jax.Array] = None) -> jax.Array:
+    """Per-shard body (call inside shard_map over `axis_name`).
+
+    q: [Tl, H, Dh] — this device's query chunk (global sequence is the
+    concatenation over the axis, in axis order).
+    k/v: [Sl, KVH, Dh] — this device's resident KV chunk.
+    q_offset: global position of q[0] (default: axis_index * Tl).
+    kv_len: total valid kv length (default: axis_size * Sl) — positions
+    beyond it are masked (padded final chunk).
+
+    Returns [Tl, H, Dh].
+    """
+    Tl, H, Dh = q.shape
+    Sl, KVH, _ = k.shape
+    g = H // KVH
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    if q_offset is None:
+        q_offset = me * Tl
+    total = n * Sl if kv_len is None else kv_len
+
+    qg = (q.astype(jnp.float32) * scale).reshape(Tl, KVH, g, Dh)
+    qpos = q_offset + jnp.arange(Tl, dtype=jnp.int32)          # [Tl]
+
+    m0 = jnp.full((KVH, g, Tl, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((KVH, g, Tl, 1), jnp.float32)
+    acc0 = jnp.zeros((KVH, g, Tl, Dh), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, s):
+        k_c, v_c, m, l, acc = carry
+        src = (me - s) % n                     # who computed this chunk
+        kpos = src * Sl + jnp.arange(Sl, dtype=jnp.int32)      # [Sl]
+        scores = jnp.einsum("tkgd,skd->kgts", qg,
+                            k_c.astype(jnp.float32))           # [KVH,g,Tl,Sl]
+        mask = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < total)
+        scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(scores - m_new)
+        # fully-masked rows: m_new stays NEG_INF and p would be exp(0)=1 —
+        # zero them so padded chunks contribute nothing
+        p = jnp.where(m_new > NEG_INF / 2, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("kgts,skd->kgtd", p,
+                                       v_c.astype(jnp.float32))
+        k_n = jax.lax.ppermute(k_c, axis_name, perm)
+        v_n = jax.lax.ppermute(v_c, axis_name, perm)
+        return (k_n, v_n, m_new, l, acc), None
+
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-20)                          # [KVH,g,Tl,Dh]
+    return out.transpose(2, 0, 1, 3).reshape(Tl, H, Dh).astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                   *, scale: float, axis_name: str = "sp",
+                   tp_axis: Optional[str] = "tp",
+                   kv_len: Optional[jax.Array] = None) -> jax.Array:
+    """Global entry: q [T, H, Dh], k/v [S, KVH, Dh] with the sequence axis
+    sharded over `axis_name` (and heads optionally over `tp_axis`). T and S
+    must divide by the axis size. Returns [T, H, Dh], same shardings."""
+    head_ax = tp_axis if (tp_axis and tp_axis in mesh.shape) else None
+    spec_q = P(axis_name, head_ax, None)
+    spec_kv = P(axis_name, head_ax, None)
+    kv_spec = None if kv_len is None else P()
+
+    def body(q_l, k_l, v_l, *rest):
+        kvl = rest[0] if rest else None
+        return ring_attention_local(q_l, k_l, v_l, axis_name=axis_name,
+                                    scale=scale, kv_len=kvl)
+
+    args = (q, k, v) + ((kv_len,) if kv_len is not None else ())
+    in_specs = (spec_q, spec_kv, spec_kv) + (
+        (kv_spec,) if kv_len is not None else ())
+    return shard_map(body, mesh=mesh, in_specs=in_specs,
+                     out_specs=spec_q, check_rep=False)(*args)

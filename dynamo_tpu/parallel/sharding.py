@@ -15,6 +15,7 @@ Mesh axes:
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, Optional, Sequence
 
 import jax
@@ -22,6 +23,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine.config import ModelConfig
+
+logger = logging.getLogger("dynamo_tpu.parallel.sharding")
 
 AXES = ("dp", "tp", "sp", "ep")
 
@@ -80,10 +83,33 @@ def batch_pspecs() -> Dict[str, P]:
     }
 
 
+def _spec_fits(shape, spec: P, mesh: Mesh) -> bool:
+    """Every sharded dim must divide by the product of its axis sizes."""
+    for dim, axes in zip(shape, spec):
+        if axes is None:
+            continue
+        axes = (axes,) if isinstance(axes, str) else axes
+        size = int(np.prod([mesh.shape[a] for a in axes]))
+        if dim % size != 0:
+            return False
+    return True
+
+
 def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
+    """Place params under their TP layout; params whose dims don't divide
+    the mesh axes (e.g. an odd vocab size) are replicated instead."""
     specs = param_pspecs(cfg)
-    return {k: jax.device_put(v, NamedSharding(mesh, specs.get(k, P())))
-            for k, v in params.items()}
+    out = {}
+    for k, v in params.items():
+        spec = specs.get(k, P())
+        if not _spec_fits(v.shape, spec, mesh):
+            logger.warning(
+                "param %s shape %s does not divide mesh axes for spec %s — "
+                "replicating (costs %d bytes per extra device copy)",
+                k, v.shape, spec, v.size * v.dtype.itemsize)
+            spec = P()
+        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out
 
 
 def shard_kv(kv: dict, mesh: Mesh) -> dict:

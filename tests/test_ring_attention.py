@@ -1,0 +1,133 @@
+"""Ring attention (sequence parallelism) — exactness vs dense attention,
+sp whole-prompt prefill vs the chunked prefill path, and end-to-end engine
+serving over a tp×sp mesh (CPU 8-device mesh; SURVEY.md §5.7 — this
+capability is designed fresh, the reference has none)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.attention import causal_attention
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.core import EngineCore
+from dynamo_tpu.engine.models import llama
+from dynamo_tpu.llm.engines.jax_engine import JaxEngine
+from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                             SamplingOptions, StopConditions)
+from dynamo_tpu.parallel.ring_attention import ring_attention
+from dynamo_tpu.parallel.sharding import make_mesh, shard_kv, shard_params
+from dynamo_tpu.runtime import Context
+from dynamo_tpu.runtime.engine import EngineContext
+
+TINY = ModelConfig(
+    model_type="llama", vocab_size=128, hidden_size=64,
+    intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=16, max_position_embeddings=256)
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.default_rng(0)
+    T, H, KVH, Dh = 32, 8, 4, 16
+    return (jnp.asarray(rng.standard_normal((T, H, Dh)), jnp.float32),
+            jnp.asarray(rng.standard_normal((T, KVH, Dh)), jnp.float32),
+            jnp.asarray(rng.standard_normal((T, KVH, Dh)), jnp.float32))
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_ring_matches_dense(qkv, sp):
+    q, k, v = qkv
+    scale = q.shape[-1] ** -0.5
+    ref = causal_attention(q, k, v, scale=scale)
+    out = ring_attention(q, k, v, make_mesh(sp=sp), scale=scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6, rtol=1e-5)
+
+
+def test_ring_padded_tail_masked(qkv):
+    q, k, v = qkv
+    scale = q.shape[-1] ** -0.5
+    kv_len = jnp.asarray(25, jnp.int32)
+    ref = causal_attention(q, k, v, scale=scale, length=kv_len)
+    out = ring_attention(q, k, v, make_mesh(sp=4), scale=scale, kv_len=kv_len)
+    np.testing.assert_allclose(np.asarray(out)[:25], np.asarray(ref)[:25],
+                               atol=2e-6, rtol=1e-5)
+
+
+def test_ring_composes_with_tp(qkv):
+    q, k, v = qkv
+    scale = q.shape[-1] ** -0.5
+    ref = causal_attention(q, k, v, scale=scale)
+    out = ring_attention(q, k, v, make_mesh(tp=2, sp=4), scale=scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6, rtol=1e-5)
+
+
+def test_sp_prefill_matches_chunked_prefill():
+    params = llama.init_params(TINY, jax.random.PRNGKey(0), dtype=jnp.float32)
+    statics = llama.ModelStatics(cfg=TINY, block_size=8, attn_impl="xla")
+    kv1 = llama.init_kv_cache(TINY, 16, 8, dtype=jnp.float32)
+    kv2 = llama.init_kv_cache(TINY, 16, 8, dtype=jnp.float32)
+    rng = np.random.default_rng(1)
+    T = 64
+    tokens = jnp.asarray(rng.integers(0, 128, T), jnp.int32)
+    table = jnp.asarray(np.arange(1, 9), jnp.int32)
+    true_len = jnp.asarray(53, jnp.int32)
+
+    logits_ref, kv_ref = jax.jit(
+        llama.prefill_forward, static_argnums=(6,))(
+        params, kv1, tokens, table, jnp.asarray(0), true_len, statics)
+
+    mesh = make_mesh(tp=2, sp=4)
+    ps = shard_params(params, mesh, TINY)
+    kvs = shard_kv(kv2, mesh)
+    logits_sp, kv_sp = jax.jit(
+        lambda p, k, t, bt, tl: llama.prefill_forward_sp(
+            p, k, t, bt, tl, statics, mesh))(ps, kvs, tokens, table, true_len)
+    np.testing.assert_allclose(np.asarray(logits_sp), np.asarray(logits_ref),
+                               atol=5e-5, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(kv_sp["k"]), np.asarray(kv_ref["k"]),
+                               atol=5e-5, rtol=1e-4)
+
+
+def _request(prompt, rid):
+    pre = PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+        sampling_options=SamplingOptions(greedy=True))
+    return Context(pre, ctx=EngineContext(rid))
+
+
+@pytest.mark.asyncio
+async def test_engine_serving_over_tp_sp_mesh():
+    """Full serving path (continuous batching + sp prefill + tp decode) on a
+    tp=2 × sp=2 mesh produces the single-device greedy tokens."""
+    ecfg = dict(max_model_len=128, kv_block_size=8, num_kv_blocks=48,
+                max_num_seqs=2, prefill_buckets=[16, 32, 64, 128])
+    rng = np.random.default_rng(7)
+    prompt = [int(t) for t in rng.integers(2, 120, size=41)]
+
+    core1 = EngineCore(TINY, EngineConfig(**ecfg), attn_impl="xla",
+                       param_dtype=jnp.float32)
+    try:
+        stream = await JaxEngine(core1).generate(_request(prompt, "ref"))
+        want = [t async for a in stream if a.data is not None
+                for t in a.data.token_ids]
+    finally:
+        await core1.stop()
+    assert len(want) == 8
+
+    mesh = make_mesh(tp=2, sp=2)
+    core2 = EngineCore(TINY, EngineConfig(**ecfg, sp=2,
+                                          sp_min_prefill_tokens=1),
+                       attn_impl="xla", param_dtype=jnp.float32, mesh=mesh)
+    assert core2._prefill_sp_jit is not None
+    try:
+        stream = await JaxEngine(core2).generate(_request(prompt, "sp"))
+        got = [t async for a in stream if a.data is not None
+               for t in a.data.token_ids]
+        assert got == want
+    finally:
+        await core2.stop()
