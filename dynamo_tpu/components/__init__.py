@@ -1,0 +1,2 @@
+"""Standalone service components (reference components/{http,metrics} +
+examples/llm/components)."""

@@ -1,0 +1,61 @@
+"""Standalone OpenAI HTTP frontend: discovers served models from the KV
+store and routes to their dyn:// worker endpoints.
+
+Reference: components/http (src/main.rs:49-110) — a model-agnostic axum
+frontend whose model list is driven entirely by etcd ModelEntry watchers;
+workers publish entries (llmctl or self-registration) and the frontend
+adds/removes them live. Run:
+
+    python -m dynamo_tpu.components.http_frontend \
+        --runtime-server HOST:PORT --port 8080
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+logger = logging.getLogger("dynamo_tpu.components.http")
+
+
+async def amain(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="dynamo-tpu-http")
+    p.add_argument("--runtime-server", required=True)
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--router-mode", choices=["random", "round_robin"],
+                   default="random")
+    p.add_argument("--verbose", "-v", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    from ..llm.discovery import ModelWatcher
+    from ..llm.http import HttpService
+    from ..runtime.distributed import DistributedRuntime
+
+    runtime = await DistributedRuntime.connect(args.runtime_server)
+    svc = HttpService(port=args.port, host=args.host)
+    watcher = await ModelWatcher(runtime, svc.manager,
+                                 router_mode=args.router_mode).start()
+    await svc.start()
+    logger.info("http frontend on %s:%d (models from discovery)",
+                args.host, args.port)
+    try:
+        await svc.run_forever()
+    finally:
+        await watcher.stop()
+        await runtime.shutdown()
+
+
+def main() -> None:
+    try:
+        asyncio.run(amain())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,4 @@
+"""Launch layer: the single-binary CLI (`run`) and the admin CLI (`llmctl`).
+
+Reference: launch/dynamo-run (in=/out= matrix, SURVEY.md §2.4) and
+launch/llmctl (etcd ModelEntry admin)."""

@@ -1,0 +1,78 @@
+"""Admin CLI: manage ModelEntry records and live disagg config in the KV
+store. Reference: launch/llmctl (``llmctl http add chat-model <name>
+<ns.comp.endpoint>`` → etcd ModelEntry, main.rs:81-210) plus a subcommand
+for the disagg router's watched threshold (disagg_router.rs:38-140)."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="llmctl")
+    p.add_argument("--runtime-server", required=True,
+                   help="discovery daemon host:port")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    http = sub.add_parser("http", help="manage served models")
+    hsub = http.add_subparsers(dest="http_cmd", required=True)
+    add = hsub.add_parser("add")
+    add.add_argument("kind", choices=["chat-model", "completion-model"])
+    add.add_argument("name")
+    add.add_argument("endpoint", help="dyn://ns/comp/ep or ns.comp.ep")
+    rm = hsub.add_parser("remove")
+    rm.add_argument("kind", choices=["chat-model", "completion-model"])
+    rm.add_argument("name")
+    hsub.add_parser("list")
+
+    dis = sub.add_parser("disagg", help="live disagg-router config")
+    dsub = dis.add_subparsers(dest="disagg_cmd", required=True)
+    st = dsub.add_parser("set-threshold")
+    st.add_argument("model")
+    st.add_argument("value", type=int)
+    return p
+
+
+async def amain(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from ..runtime.distributed import DistributedRuntime
+    runtime = await DistributedRuntime.connect(args.runtime_server)
+    try:
+        if args.cmd == "http":
+            from ..llm.discovery import (ModelEntry, list_models,
+                                         register_model, remove_model)
+            kind = getattr(args, "kind", "").replace("-model", "")
+            if args.http_cmd == "add":
+                await register_model(runtime, ModelEntry(
+                    name=args.name, endpoint=args.endpoint, model_type=kind))
+                print(f"added {kind} model {args.name} → {args.endpoint}")
+            elif args.http_cmd == "remove":
+                ok = await remove_model(runtime, kind, args.name)
+                print(f"{'removed' if ok else 'not found'}: {args.name}")
+                return 0 if ok else 1
+            else:
+                entries = await list_models(runtime)
+                if not entries:
+                    print("(no models)")
+                for key, e in sorted(entries.items()):
+                    print(f"{e.model_type:11s} {e.name:30s} {e.endpoint}")
+        elif args.cmd == "disagg":
+            from ..llm.disagg import disagg_config_key
+            import json
+            await runtime.store.kv_put(
+                disagg_config_key(args.model),
+                json.dumps({"max_local_prefill_length": args.value}).encode())
+            print(f"disagg threshold for {args.model} → {args.value}")
+        return 0
+    finally:
+        await runtime.shutdown()
+
+
+def main() -> None:
+    sys.exit(asyncio.run(amain()))
+
+
+if __name__ == "__main__":
+    main()

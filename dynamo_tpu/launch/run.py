@@ -1,0 +1,381 @@
+"""The single-binary launcher: ``python -m dynamo_tpu.launch.run in=<src>
+out=<engine> [flags]``.
+
+Reference: launch/dynamo-run (src/opt.rs:23-130 input/output matrix,
+src/flags.rs:22-158 flag set, src/input/common.rs:35-92 pipeline link,
+src/input/endpoint.rs:34-115 worker registration).
+
+Inputs:  http | text | stdin | batch:FILE.jsonl | dyn://ns/comp/ep | none
+Outputs: jax | echo_core | echo_full | dyn://ns/comp/ep
+
+The canonical local pipeline for core engines (jax/echo_core) is
+preprocessor → backend(detokenizer) → engine, exactly the reference's
+6-stage link (SURVEY.md §3.1). ``out=dyn://`` makes this process a frontend
+routing to remote workers; ``in=dyn://`` makes it a worker serving its
+pipeline on the distributed runtime. Disaggregation: ``--remote-prefill``
+turns the worker into a disagg decode worker; ``--is-prefill-worker`` (with
+``in=none``) runs the prefill side pulling the shared queue."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+from typing import Optional, Tuple
+
+logger = logging.getLogger("dynamo_tpu.launch")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dynamo-tpu-run",
+        description="TPU-native LLM serving launcher (in=SRC out=ENGINE)")
+    p.add_argument("io", nargs="*", metavar="in=|out=",
+                   help="in=http|text|stdin|batch:F|dyn://ns/c/e|none "
+                        "out=jax|echo_core|echo_full|dyn://ns/c/e")
+    p.add_argument("--model-path", help="HF-style model dir (config.json, "
+                                        "tokenizer.json, safetensors)")
+    p.add_argument("--model-name", help="served model name "
+                                        "(default: basename of model path)")
+    p.add_argument("--http-port", type=int, default=8080)
+    p.add_argument("--http-host", default="0.0.0.0")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--runtime-server",
+                   help="discovery daemon host:port (default: in-process "
+                        "runtime — single-process deployments)")
+    p.add_argument("--advertise-host",
+                   help="address other hosts can dial back (DCN)")
+    # engine knobs (flags.rs analogs)
+    p.add_argument("--max-model-len", type=int, default=4096)
+    p.add_argument("--kv-block-size", type=int, default=16)
+    p.add_argument("--num-kv-blocks", type=int, default=2048)
+    p.add_argument("--max-num-seqs", type=int, default=8)
+    p.add_argument("--host-kv-blocks", type=int, default=0,
+                   help="host (TPU-VM DRAM) KV offload tier size")
+    p.add_argument("--no-prefix-reuse", action="store_true")
+    p.add_argument("--random-weights", action="store_true",
+                   help="skip checkpoint load (benchmarks/smoke)")
+    # parallelism (tensor-parallel-size analog + our axes)
+    p.add_argument("--tensor-parallel-size", "--tp", type=int, default=1,
+                   dest="tp")
+    p.add_argument("--sequence-parallel-size", "--sp", type=int, default=1,
+                   dest="sp")
+    p.add_argument("--data-parallel-size", "--dp", type=int, default=1,
+                   dest="dp")
+    # routing / disagg
+    p.add_argument("--router-mode", choices=["random", "round_robin"],
+                   default="random")
+    p.add_argument("--remote-prefill", action="store_true",
+                   help="decode worker: offload long prefills to the "
+                        "prefill queue")
+    p.add_argument("--is-prefill-worker", action="store_true",
+                   help="serve the prefill side of disaggregation")
+    p.add_argument("--max-local-prefill-length", type=int, default=512)
+    p.add_argument("--unconditional-disagg", action="store_true",
+                   help="always prefill remotely (skip the threshold)")
+    # batch mode
+    p.add_argument("--output-path", help="batch: output JSONL path")
+    p.add_argument("--max-tokens", type=int, default=256,
+                   help="text/stdin/batch: generation budget")
+    p.add_argument("--verbose", "-v", action="store_true")
+    return p
+
+
+def parse_io(io_args) -> Tuple[str, str]:
+    src, out = "text", "echo_core"
+    for a in io_args:
+        if a.startswith("in="):
+            src = a[3:]
+        elif a.startswith("out="):
+            out = a[4:]
+        else:
+            raise SystemExit(f"unrecognized positional arg {a!r} "
+                             "(expected in=... / out=...)")
+    return src, out
+
+
+async def make_runtime(args):
+    from ..runtime.distributed import DistributedRuntime
+    if args.runtime_server:
+        return await DistributedRuntime.connect(args.runtime_server,
+                                                advertise=args.advertise_host)
+    return DistributedRuntime.in_process()
+
+
+def engine_config(args):
+    from ..engine.config import EngineConfig
+    return EngineConfig(
+        max_model_len=args.max_model_len,
+        kv_block_size=args.kv_block_size,
+        num_kv_blocks=args.num_kv_blocks,
+        max_num_seqs=args.max_num_seqs,
+        enable_prefix_reuse=not args.no_prefix_reuse,
+        host_kv_blocks=args.host_kv_blocks,
+        tp=args.tp, sp=args.sp, dp=args.dp)
+
+
+def _model_name(args) -> str:
+    if args.model_name:
+        return args.model_name
+    if args.model_path:
+        return os.path.basename(os.path.normpath(args.model_path))
+    return "echo"
+
+
+async def build_engine(args, out: str, runtime):
+    """→ (engine, mdc|None, core|None). Core engines get the preproc/backend
+    link added by the caller; full engines speak OpenAI directly."""
+    from ..llm.model_card import ModelDeploymentCard
+
+    if out == "echo_full":
+        from ..llm.engines.echo import EchoEngineFull
+        return EchoEngineFull(), None, None
+    if out == "echo_core":
+        from ..llm.engines.echo import EchoEngineCore
+        if not args.model_path:
+            raise SystemExit("out=echo_core needs --model-path (tokenizer)")
+        mdc = ModelDeploymentCard.from_local_path(
+            args.model_path, display_name=_model_name(args))
+        return EchoEngineCore(), mdc, None
+    if out.startswith("dyn://") or out.count(".") == 2:
+        from ..llm.engines.remote import RemoteEngine
+        from ..runtime.distributed import Endpoint
+        endpoint = Endpoint.parse_path(runtime, out)
+        engine = await RemoteEngine.start(endpoint,
+                                          router_mode=args.router_mode)
+        return engine, None, None
+    if out == "jax":
+        import jax.numpy as jnp
+        from ..engine.core import EngineCore
+        from ..engine.config import ModelConfig
+        from ..llm.engines.jax_engine import JaxEngine
+        if not args.model_path:
+            raise SystemExit("out=jax needs --model-path")
+        mdc = ModelDeploymentCard.from_local_path(
+            args.model_path, display_name=_model_name(args))
+        mesh = None
+        if args.tp * args.sp * args.dp > 1:
+            from ..parallel.sharding import make_mesh
+            mesh = make_mesh(dp=args.dp, tp=args.tp, sp=args.sp)
+        model_cfg = ModelConfig.from_model_dir(args.model_path)
+        params = None
+        if not args.random_weights:
+            from ..engine.weights import load_llama_params
+            params = load_llama_params(args.model_path, model_cfg)
+        core = EngineCore(model_cfg, engine_config(args), params=params,
+                          mesh=mesh)
+        engine = JaxEngine(core)
+        if args.remote_prefill:
+            from ..llm.disagg import DisaggEngine, DisaggregatedRouter
+            router = DisaggregatedRouter(
+                runtime, _model_name(args),
+                max_local_prefill_length=args.max_local_prefill_length,
+                conditional=not args.unconditional_disagg)
+            await router.start()
+            engine = DisaggEngine(core, runtime, router)
+        return engine, mdc, core
+    raise SystemExit(f"unknown out= engine {out!r}")
+
+
+def link_pipeline(engine, mdc):
+    """Core engines ride the canonical 6-stage link; full engines are the
+    pipeline (input/common.rs:35-92)."""
+    if mdc is None:
+        return engine
+    from ..llm.backend import Backend
+    from ..llm.preprocessor import OpenAIPreprocessor
+    from ..runtime import link
+    return link(OpenAIPreprocessor(mdc), Backend(mdc), engine)
+
+
+async def collect_chat_text(stream) -> str:
+    """Fold a chat chunk stream to its text; raises on Annotated error
+    items so failures surface instead of reading as empty output."""
+    parts = []
+    async for a in stream:
+        if getattr(a, "is_error", False):
+            raise RuntimeError(a.error_message() or "engine stream error")
+        d = a.data if hasattr(a, "data") else a
+        if not d or not isinstance(d, dict):
+            continue
+        for c in d.get("choices", ()):
+            delta = c.get("delta") or c.get("message") or {}
+            if delta.get("content"):
+                parts.append(delta["content"])
+    return "".join(parts)
+
+
+async def run_http(args, pipeline, core) -> None:
+    from ..llm.http import HttpService
+    svc = HttpService(port=args.http_port, host=args.http_host)
+    name = _model_name(args)
+    svc.manager.add_chat_model(name, pipeline)
+    svc.manager.add_completion_model(name, pipeline)
+    await svc.start()
+    logger.info("serving %s on http://%s:%d/v1", name, args.http_host,
+                args.http_port)
+    await svc.run_forever()
+
+
+async def run_text(args, pipeline, interactive: bool) -> None:
+    from ..runtime import Context
+    name = _model_name(args)
+    loop = asyncio.get_running_loop()
+    if interactive and sys.stdin.isatty():
+        print(f"model: {name} — empty line or Ctrl-D to exit")
+    while True:
+        if interactive and sys.stdin.isatty():
+            print("> ", end="", flush=True)
+        line = await loop.run_in_executor(None, sys.stdin.readline)
+        if not line:
+            return                      # EOF
+        if not line.strip():
+            if interactive:
+                return                  # empty line exits the REPL
+            continue                    # piped input: skip blanks, keep going
+        req = {"model": name, "max_tokens": args.max_tokens, "stream": True,
+               "messages": [{"role": "user", "content": line.strip()}]}
+        stream = await pipeline.generate(Context(req))
+        print(await collect_chat_text(stream))
+
+
+async def run_batch(args, pipeline, path: str) -> None:
+    """batch:FILE.jsonl — one JSON per line: {"text": ...} (completion
+    prompt) or {"messages": [...]} (chat). Results go to --output-path
+    (default: <input>.out.jsonl)."""
+    from ..runtime import Context
+    name = _model_name(args)
+    out_path = args.output_path or (path.rsplit(".jsonl", 1)[0] + ".out.jsonl")
+    done = 0
+    failed = 0
+    with open(path) as fin, open(out_path, "w") as fout:
+        for line in fin:
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            messages = d.get("messages") or [
+                {"role": "user", "content": d.get("text", d.get("prompt", ""))}]
+            req = {"model": name, "stream": True,
+                   "max_tokens": d.get("max_tokens", args.max_tokens),
+                   "messages": messages}
+            if "temperature" in d:
+                req["temperature"] = d["temperature"]
+            try:
+                stream = await pipeline.generate(Context(req))
+                text = await collect_chat_text(stream)
+                fout.write(json.dumps({**d, "response": text}) + "\n")
+            except Exception as e:  # noqa: BLE001 — per-row isolation
+                failed += 1
+                fout.write(json.dumps({**d, "error": str(e)}) + "\n")
+            done += 1
+    level = logging.WARNING if failed else logging.INFO
+    logger.log(level, "batch complete: %d requests (%d failed) → %s",
+               done, failed, out_path)
+    if failed:
+        raise SystemExit(1)
+
+
+async def run_worker_endpoint(args, pipeline, core, runtime,
+                              path: str) -> None:
+    """in=dyn://ns/comp/ep — serve the local pipeline as a discoverable
+    worker instance (input/endpoint.rs:34-115): stats handler publishes
+    ForwardPassMetrics; KV events go to the component's kv_events subject
+    for KV-aware routers."""
+    from ..llm.protocols.annotated import encode_annotated_json
+    from ..runtime.distributed import Endpoint
+    endpoint = Endpoint.parse_path(runtime, path)
+    stats_handler = None
+    if core is not None:
+        stats_handler = lambda: core.metrics().to_dict()  # noqa: E731
+        await _wire_kv_events(core, runtime, endpoint)
+    await endpoint.serve(pipeline, encode_resp=encode_annotated_json,
+                         stats_handler=stats_handler)
+    # register the model entries under our lease so discovery-driven
+    # frontends pick the model up — and drop it when this worker dies
+    if args.model_path or args.model_name:
+        from ..llm.discovery import ModelEntry, register_model
+        lease = await runtime.primary_lease()
+        for mt in ("chat", "completion"):
+            await register_model(runtime, ModelEntry(
+                name=_model_name(args), endpoint=endpoint.path,
+                model_type=mt), lease_id=lease.id)
+    logger.info("worker serving %s", endpoint.path)
+    await asyncio.Event().wait()
+
+
+async def _wire_kv_events(core, runtime, endpoint) -> None:
+    """Attach a KvEventPublisher to the engine's block pool → bus subject
+    ``evt.{ns}.{comp}.kv_events`` (reference kv_router/publisher.rs)."""
+    from ..llm.kv_router.publisher import KvEventPublisher
+    component = runtime.namespace(endpoint.namespace).component(
+        endpoint.component)
+    lease = await runtime.primary_lease()
+
+    async def sink(ev) -> None:
+        await component.publish_event("kv_events", ev)
+
+    pub = KvEventPublisher(worker_id=lease.id, sink=sink)
+    core.kv_event_publisher = pub
+    core.kv_manager.pool.on_stored = pub.publish_stored
+    core.kv_manager.pool.on_removed = pub.publish_removed
+
+
+async def run_prefill_worker(args, core, runtime) -> None:
+    from ..llm.disagg import PrefillWorker
+    worker = await PrefillWorker(core, runtime).start()
+    logger.info("prefill worker pulling queue (engine ready)")
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await worker.stop()
+
+
+async def amain(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    src, out = parse_io(args.io)
+
+    runtime = await make_runtime(args)
+    try:
+        engine, mdc, core = await build_engine(args, out, runtime)
+        if args.is_prefill_worker:
+            if core is None:
+                raise SystemExit("--is-prefill-worker requires out=jax")
+            await run_prefill_worker(args, core, runtime)
+            return
+        pipeline = link_pipeline(engine, mdc)
+        if src == "http":
+            await run_http(args, pipeline, core)
+        elif src == "text":
+            await run_text(args, pipeline, interactive=True)
+        elif src == "stdin":
+            await run_text(args, pipeline, interactive=False)
+        elif src.startswith("batch:"):
+            await run_batch(args, pipeline, src[len("batch:"):])
+        elif src.startswith("dyn://") or src.count(".") == 2:
+            await run_worker_endpoint(args, pipeline, core, runtime, src)
+        elif src == "none":
+            await asyncio.Event().wait()
+        else:
+            raise SystemExit(f"unknown in= source {src!r}")
+    finally:
+        if 'core' in locals() and core is not None:
+            await core.stop()
+        await runtime.shutdown()
+
+
+def main() -> None:
+    try:
+        asyncio.run(amain())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

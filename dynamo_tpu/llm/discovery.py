@@ -1,0 +1,155 @@
+"""Model discovery: ModelEntry records in the KV store + the watcher that
+keeps an HTTP frontend's model list in sync.
+
+Reference: llmctl writes ``ModelEntry{name, endpoint, model_type}`` into etcd
+(launch/llmctl/src/main.rs:81-210) and the HTTP service watches the prefix,
+adding/removing served models as workers come and go
+(lib/llm/src/http/service/discovery.rs:37-145, components/http/src/main.rs:
+49-110). Same shape here: entries live under ``models/{chat|completion}/
+{name}``; ``ModelWatcher`` wires a distributed Client per entry into a
+``ModelManager``."""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+from typing import Dict, Optional
+
+from ..runtime.distributed import DistributedRuntime, Endpoint
+from ..runtime.kvstore import WatchEventType
+
+logger = logging.getLogger("dynamo_tpu.llm.discovery")
+
+__all__ = ["ModelEntry", "ModelWatcher", "model_key", "MODELS_PREFIX"]
+
+MODELS_PREFIX = "models/"
+
+
+def model_key(model_type: str, name: str) -> str:
+    return f"{MODELS_PREFIX}{model_type}/{name}"
+
+
+@dataclasses.dataclass
+class ModelEntry:
+    """One served model → the dyn:// endpoint that serves it."""
+
+    name: str
+    endpoint: str                 # "dyn://ns/comp/ep" or "ns.comp.ep"
+    model_type: str = "chat"      # chat | completion
+
+    def to_json(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "ModelEntry":
+        return cls(**json.loads(raw))
+
+
+async def register_model(runtime: DistributedRuntime, entry: ModelEntry,
+                         lease_id: int = 0) -> None:
+    """Write a ModelEntry. Self-registering workers pass their primary
+    lease so the entry dies with the worker (frontends then drop the model
+    instead of routing to a ghost); llmctl-managed entries persist."""
+    await runtime.store.kv_put(model_key(entry.model_type, entry.name),
+                               entry.to_json(), lease_id=lease_id)
+
+
+async def remove_model(runtime: DistributedRuntime, model_type: str,
+                       name: str) -> bool:
+    return await runtime.store.kv_delete(model_key(model_type, name))
+
+
+async def list_models(runtime: DistributedRuntime) -> Dict[str, ModelEntry]:
+    out: Dict[str, ModelEntry] = {}
+    for e in await runtime.store.kv_get_prefix(MODELS_PREFIX):
+        try:
+            out[e.key] = ModelEntry.from_json(e.value)
+        except Exception:  # noqa: BLE001
+            logger.warning("bad model entry at %s", e.key)
+    return out
+
+
+class ModelWatcher:
+    """Watches ``models/`` and keeps a ModelManager in sync: a PUT builds a
+    routed Client pipeline to the entry's endpoint; a DELETE removes the
+    model. The served request/response is the OpenAI JSON dict the worker's
+    pipeline speaks (frontend stays model-agnostic)."""
+
+    def __init__(self, runtime: DistributedRuntime, manager,
+                 router_mode: str = "random"):
+        self.runtime = runtime
+        self.manager = manager
+        self.router_mode = router_mode
+        # key → endpoint path; engines are shared per endpoint (a worker
+        # registering chat+completion costs one client, not two)
+        self._entries: Dict[str, str] = {}
+        self._engines: Dict[str, object] = {}      # endpoint path → engine
+        self._watcher = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> "ModelWatcher":
+        self._watcher = await self.runtime.store.watch_prefix(MODELS_PREFIX)
+        self._task = asyncio.get_running_loop().create_task(
+            self._loop(), name="model-watcher")
+        return self
+
+    async def _loop(self) -> None:
+        async for ev in self._watcher:
+            key = ev.entry.key
+            try:
+                if ev.type == WatchEventType.PUT:
+                    await self._add(key, ModelEntry.from_json(ev.entry.value))
+                else:
+                    await self._remove(key)
+            except Exception:  # noqa: BLE001
+                logger.exception("model watch event failed for %s", key)
+
+    async def _engine_for(self, path: str):
+        engine = self._engines.get(path)
+        if engine is None:
+            from .engines.remote import RemoteEngine
+            endpoint = Endpoint.parse_path(self.runtime, path)
+            engine = await RemoteEngine.start(endpoint,
+                                              router_mode=self.router_mode)
+            self._engines[path] = engine
+        return engine
+
+    async def _gc_engine(self, path: str) -> None:
+        if path not in self._entries.values():
+            engine = self._engines.pop(path, None)
+            if engine is not None:
+                await engine.close()
+
+    async def _add(self, key: str, entry: ModelEntry) -> None:
+        old_path = self._entries.get(key)
+        engine = await self._engine_for(entry.endpoint)
+        self._entries[key] = entry.endpoint
+        if old_path is not None and old_path != entry.endpoint:
+            await self._gc_engine(old_path)   # re-registration moved target
+        if entry.model_type == "completion":
+            self.manager.add_completion_model(entry.name, engine)
+        else:
+            self.manager.add_chat_model(entry.name, engine)
+        logger.info("model added: %s (%s) → %s", entry.name,
+                    entry.model_type, entry.endpoint)
+
+    async def _remove(self, key: str) -> None:
+        path = self._entries.pop(key, None)
+        if path is not None:
+            await self._gc_engine(path)
+        parts = key[len(MODELS_PREFIX):].split("/", 1)
+        if len(parts) == 2:
+            self.manager.remove_model(parts[1], model_type=parts[0])
+            logger.info("model removed: %s (%s)", parts[1], parts[0])
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        if self._watcher is not None:
+            self._watcher.close()
+        for engine in self._engines.values():
+            await engine.close()
+        self._engines.clear()
+        self._entries.clear()

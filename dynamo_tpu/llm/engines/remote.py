@@ -1,0 +1,46 @@
+"""RemoteEngine: an AsyncEngine that routes requests to dyn:// worker
+endpoints over the distributed runtime (the frontend half of the reference's
+``EngineConfig::Dynamic`` path, launch/dynamo-run/src/input/common.rs:35-92 +
+component/client.rs routing).
+
+The wire payload is whatever the worker's pipeline speaks — for full-pipeline
+workers that is the OpenAI request/chunk JSON dicts, so the frontend stays
+model-agnostic. Routing modes: random (reference default), round_robin, or
+direct via `instance_id`."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...runtime.distributed import Client, Endpoint
+from ...runtime.engine import AsyncEngine, ManyOut, SingleIn
+
+__all__ = ["RemoteEngine"]
+
+
+class RemoteEngine(AsyncEngine):
+    def __init__(self, client: Client, router_mode: str = "random"):
+        self.client = client
+        self.router_mode = router_mode
+
+    @classmethod
+    async def start(cls, endpoint: Endpoint, router_mode: str = "random",
+                    wait: bool = False, timeout: float = 30.0
+                    ) -> "RemoteEngine":
+        from ..protocols.annotated import decode_annotated_json
+        client = endpoint.client(decode_resp=decode_annotated_json)
+        await client.start()
+        if wait:
+            await client.wait_for_instances(timeout)
+        return cls(client, router_mode)
+
+    async def generate(self, request: SingleIn,
+                       instance_id: Optional[int] = None) -> ManyOut:
+        if instance_id is not None:
+            return await self.client.direct(request, instance_id)
+        if self.router_mode == "round_robin":
+            return await self.client.round_robin(request)
+        return await self.client.random(request)
+
+    async def close(self) -> None:
+        await self.client.close()

@@ -50,10 +50,16 @@ class ModelManager:
         self._completion[name] = engine
         self._cards.setdefault(name, card or {})
 
-    def remove_model(self, name: str) -> None:
-        self._chat.pop(name, None)
-        self._completion.pop(name, None)
-        self._cards.pop(name, None)
+    def remove_model(self, name: str,
+                     model_type: Optional[str] = None) -> None:
+        """Remove one registry's entry ("chat"/"completion") or, with no
+        model_type, every trace of the name."""
+        if model_type in (None, "chat"):
+            self._chat.pop(name, None)
+        if model_type in (None, "completion"):
+            self._completion.pop(name, None)
+        if name not in self._chat and name not in self._completion:
+            self._cards.pop(name, None)
 
     def chat_engine(self, name: str) -> Optional[AsyncEngine]:
         return self._chat.get(name)
@@ -108,6 +114,8 @@ class HttpService:
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> None:
+        if self._runner is not None:
+            return  # already serving (run_forever after start is fine)
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
         self._site = web.TCPSite(self._runner, self.host, self.port)

@@ -61,3 +61,20 @@ class Annotated(Generic[R]):
         if self.comment:
             out["comment"] = self.comment
         return out
+
+
+# Wire serde for the distributed response plane: workers stream
+# Annotated[dict] items; the frontend client reconstructs them so errors
+# and annotations survive the hop (the reference streams the same
+# Annotated JSON over its TCP response plane).
+
+def encode_annotated_json(item) -> bytes:
+    if not isinstance(item, Annotated):
+        item = Annotated.from_data(item)
+    return json.dumps(item.to_json_dict()).encode()
+
+
+def decode_annotated_json(raw: bytes) -> "Annotated":
+    d = json.loads(raw)
+    return Annotated(data=d.get("data"), id=d.get("id"),
+                     event=d.get("event"), comment=d.get("comment"))

@@ -1,0 +1,196 @@
+"""Launch layer: in=/out= parsing, batch mode, worker endpoint + remote
+frontend over the discovery daemon, model discovery watcher, llmctl admin.
+
+Reference test analog: CLI-level echo-engine tests (docs/guides/
+dynamo_run.md:388-415) and the single-machine distributed tier (SURVEY.md
+§4) — worker and frontend share one process but speak through the real
+daemon's sockets."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.launch.run import amain as run_amain, parse_io
+from dynamo_tpu.launch.llmctl import amain as llmctl_amain
+from dynamo_tpu.runtime.server import DiscoveryServer
+
+pytestmark = pytest.mark.asyncio
+
+
+def test_parse_io():
+    assert parse_io([]) == ("text", "echo_core")
+    assert parse_io(["in=http", "out=jax"]) == ("http", "jax")
+    assert parse_io(["out=dyn://a/b/c"]) == ("text", "dyn://a/b/c")
+    with pytest.raises(SystemExit):
+        parse_io(["frobnicate"])
+
+
+async def test_batch_mode_echo(tiny_model_dir, tmp_path):
+    inp = tmp_path / "batch.jsonl"
+    out = tmp_path / "out.jsonl"
+    rows = [{"text": "hello world"}, {"messages": [
+        {"role": "user", "content": "hi there"}]}]
+    inp.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    await run_amain([f"in=batch:{inp}", "out=echo_core",
+                     "--model-path", tiny_model_dir,
+                     "--output-path", str(out), "--max-tokens", "32"])
+    lines = [json.loads(l) for l in out.read_text().splitlines()]
+    assert len(lines) == 2
+    # echo engine: the response decodes back to the prompt text
+    assert "hello world" in lines[0]["response"]
+
+
+@pytest.fixture
+async def daemon():
+    srv = DiscoveryServer(host="127.0.0.1")
+    await srv.start()
+    yield srv
+    await srv.close()
+
+
+async def test_worker_and_remote_frontend(tiny_model_dir, daemon):
+    """Worker (in=dyn:// out=echo_core) + frontend client over the daemon:
+    the full dynamo-run pair of SURVEY.md §3.2."""
+    addr = daemon.address
+    worker = asyncio.ensure_future(run_amain(
+        ["in=dyn://testns/worker/generate", "out=echo_core",
+         "--model-path", tiny_model_dir, "--model-name", "tiny",
+         "--runtime-server", addr]))
+    try:
+        from dynamo_tpu.llm.engines.remote import RemoteEngine
+        from dynamo_tpu.runtime import Context
+        from dynamo_tpu.runtime.distributed import DistributedRuntime, Endpoint
+
+        rt = await DistributedRuntime.connect(addr)
+        try:
+            endpoint = Endpoint.parse_path(rt, "dyn://testns/worker/generate")
+            engine = await RemoteEngine.start(endpoint, wait=True, timeout=15)
+            req = {"model": "tiny", "max_tokens": 16, "stream": True,
+                   "messages": [{"role": "user", "content": "round trip"}]}
+            stream = await engine.generate(Context(req))
+            text = ""
+            async for ann in stream:
+                d = ann.data
+                if d and d.get("choices"):
+                    text += d["choices"][0]["delta"].get("content") or ""
+            assert "round trip" in text
+            # the worker self-registered its model entries
+            from dynamo_tpu.llm.discovery import list_models
+            entries = await list_models(rt)
+            names = {e.name for e in entries.values()}
+            assert "tiny" in names
+            await engine.close()
+        finally:
+            await rt.shutdown()
+    finally:
+        worker.cancel()
+        try:
+            await worker
+        except (asyncio.CancelledError, Exception):
+            pass
+
+
+async def test_model_watcher_drives_manager(tiny_model_dir, daemon):
+    """ModelEntry PUT/DELETE → ModelManager add/remove with live routing
+    (components/http discovery loop)."""
+    addr = daemon.address
+    worker = asyncio.ensure_future(run_amain(
+        ["in=dyn://ns2/w/gen", "out=echo_core",
+         "--model-path", tiny_model_dir, "--model-name", "disc-model",
+         "--runtime-server", addr]))
+    try:
+        from dynamo_tpu.llm.discovery import ModelWatcher, remove_model
+        from dynamo_tpu.llm.http.service import ModelManager
+        from dynamo_tpu.runtime import Context
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+        rt = await DistributedRuntime.connect(addr)
+        try:
+            manager = ModelManager()
+            watcher = await ModelWatcher(rt, manager).start()
+            for _ in range(100):
+                if manager.chat_engine("disc-model") is not None:
+                    break
+                await asyncio.sleep(0.1)
+            engine = manager.chat_engine("disc-model")
+            assert engine is not None
+            await engine.client.wait_for_instances(15)
+            req = {"model": "disc-model", "max_tokens": 8, "stream": True,
+                   "messages": [{"role": "user", "content": "watch me"}]}
+            stream = await engine.generate(Context(req))
+            chunks = [a async for a in stream]
+            assert chunks
+            # removal
+            await remove_model(rt, "chat", "disc-model")
+            for _ in range(100):
+                if manager.chat_engine("disc-model") is None:
+                    break
+                await asyncio.sleep(0.1)
+            assert manager.chat_engine("disc-model") is None
+            await watcher.stop()
+        finally:
+            await rt.shutdown()
+    finally:
+        worker.cancel()
+        try:
+            await worker
+        except (asyncio.CancelledError, Exception):
+            pass
+
+
+async def test_worker_death_removes_model(tiny_model_dir, daemon):
+    """Self-registered ModelEntry rides the worker's lease: when the worker
+    dies, frontends drop the model instead of routing to a ghost."""
+    addr = daemon.address
+    worker = asyncio.ensure_future(run_amain(
+        ["in=dyn://ns3/w/gen", "out=echo_core",
+         "--model-path", tiny_model_dir, "--model-name", "mortal",
+         "--runtime-server", addr]))
+    from dynamo_tpu.llm.discovery import ModelWatcher
+    from dynamo_tpu.llm.http.service import ModelManager
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = await DistributedRuntime.connect(addr)
+    try:
+        manager = ModelManager()
+        watcher = await ModelWatcher(rt, manager).start()
+        for _ in range(100):
+            if manager.chat_engine("mortal") is not None:
+                break
+            await asyncio.sleep(0.1)
+        assert manager.chat_engine("mortal") is not None
+        assert manager.completion_engine("mortal") is not None
+        # chat and completion entries share one client under the hood
+        assert len(watcher._engines) == 1
+        worker.cancel()
+        try:
+            await worker
+        except (asyncio.CancelledError, Exception):
+            pass
+        # lease revocation (graceful) or expiry deletes both entries
+        for _ in range(100):
+            if (manager.chat_engine("mortal") is None
+                    and manager.completion_engine("mortal") is None):
+                break
+            await asyncio.sleep(0.1)
+        assert manager.chat_engine("mortal") is None
+        assert manager.completion_engine("mortal") is None
+        await watcher.stop()
+    finally:
+        await rt.shutdown()
+
+
+async def test_llmctl_add_list_remove(daemon, capsys):
+    addr = daemon.address
+    assert await llmctl_amain(["--runtime-server", addr, "http", "add",
+                               "chat-model", "m1", "dyn://ns/c/e"]) == 0
+    assert await llmctl_amain(["--runtime-server", addr, "http", "list"]) == 0
+    out = capsys.readouterr().out
+    assert "m1" in out and "dyn://ns/c/e" in out
+    assert await llmctl_amain(["--runtime-server", addr, "http", "remove",
+                               "chat-model", "m1"]) == 0
+    assert await llmctl_amain(["--runtime-server", addr, "http", "remove",
+                               "chat-model", "m1"]) == 1
+    assert await llmctl_amain(["--runtime-server", addr, "disagg",
+                               "set-threshold", "m1", "123"]) == 0
