@@ -1,0 +1,124 @@
+"""Mock worker: the zero-hardware routing/metrics test fixture.
+
+Reference: components/metrics/src/bin/mock_worker.rs — a worker publishing
+synthetic ForwardPassMetrics and KV events so the router/metrics stack runs
+with no GPUs (SURVEY.md §4 "mock worker" tier). Ours additionally *serves*
+the token protocol with an echo engine and publishes stored-block events for
+every prompt it sees, so a KV-aware router's radix tree fills exactly as it
+would against a real engine's prefix cache."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+from typing import Optional
+
+from ..llm.engines.echo import EchoEngineCore
+from ..llm.kv.blocks import TokenBlockSequence
+from ..llm.kv_router.protocols import ForwardPassMetrics
+from ..llm.kv_router.publisher import KvEventPublisher
+from ..llm.protocols.annotated import encode_annotated_json
+from ..llm.protocols.common import PreprocessedRequest
+from ..runtime.distributed import DistributedRuntime, Endpoint
+from ..runtime.engine import AsyncEngine, ManyOut, SingleIn
+
+logger = logging.getLogger("dynamo_tpu.components.mock_worker")
+
+__all__ = ["MockTokenWorker"]
+
+
+class _EchoWithKvEvents(AsyncEngine):
+    """Echo engine that mimics a paged engine's prefix-cache events: each
+    prompt's full blocks are published as stored (chained hashes)."""
+
+    def __init__(self, publisher: KvEventPublisher, block_size: int):
+        self.inner = EchoEngineCore()
+        self.publisher = publisher
+        self.block_size = block_size
+        self.requests_served = 0
+
+    async def generate(self, request: SingleIn) -> ManyOut:
+        pre: PreprocessedRequest = request.data
+        self.requests_served += 1
+        seq = TokenBlockSequence(self.block_size, pre.token_ids)
+        parent = None
+        for i, (sh, bh) in enumerate(zip(seq.sequence_hashes,
+                                         seq.block_hashes)):
+            self.publisher.publish_stored(i, sh, bh, parent)
+            parent = seq.sequence_hashes[i]
+        return await self.inner.generate(request)
+
+
+class MockTokenWorker:
+    """Embeddable fixture: serve a token-protocol endpoint with synthetic
+    metrics + KV events."""
+
+    def __init__(self, runtime: DistributedRuntime, endpoint_path: str,
+                 block_size: int = 16,
+                 metrics: Optional[ForwardPassMetrics] = None):
+        self.runtime = runtime
+        self.endpoint = Endpoint.parse_path(runtime, endpoint_path)
+        self.block_size = block_size
+        self.metrics = metrics or ForwardPassMetrics(
+            request_active_slots=0, request_total_slots=8,
+            kv_active_blocks=0, kv_total_blocks=1024)
+        self.engine: Optional[_EchoWithKvEvents] = None
+        self.server = None
+
+    @property
+    def worker_id(self) -> int:
+        return self.server.lease_id
+
+    async def start(self) -> "MockTokenWorker":
+        component = self.runtime.namespace(
+            self.endpoint.namespace).component(self.endpoint.component)
+        lease = await self.runtime.primary_lease()
+
+        async def sink(ev) -> None:
+            await component.publish_event("kv_events", ev)
+
+        publisher = KvEventPublisher(worker_id=lease.id, sink=sink)
+        self.engine = _EchoWithKvEvents(publisher, self.block_size)
+        self.server = await self.endpoint.serve(
+            self.engine,
+            decode_req=lambda raw: PreprocessedRequest.from_dict(
+                json.loads(raw)),
+            encode_resp=encode_annotated_json,
+            stats_handler=lambda: self.metrics.to_dict(),
+            stats_interval=0.2)
+        return self
+
+    async def stop(self) -> None:
+        if self.server is not None:
+            await self.server.stop()
+
+
+async def amain(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="dynamo-tpu-mock-worker")
+    p.add_argument("--runtime-server", required=True)
+    p.add_argument("--endpoint", default="dyn://dynamo/worker/generate")
+    p.add_argument("--kv-block-size", type=int, default=16)
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    runtime = await DistributedRuntime.connect(args.runtime_server)
+    worker = await MockTokenWorker(runtime, args.endpoint,
+                                   block_size=args.kv_block_size).start()
+    logger.info("mock worker %x serving %s", worker.worker_id, args.endpoint)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await worker.stop()
+        await runtime.shutdown()
+
+
+def main() -> None:
+    try:
+        asyncio.run(amain())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

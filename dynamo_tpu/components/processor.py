@@ -1,0 +1,80 @@
+"""KV-aware processor frontend: OpenAI HTTP → tokenize → KV-routed dispatch
+to token-protocol workers → detokenize.
+
+Reference: the Processor + Router components of the disagg reference graph
+(examples/llm/components/{processor,kv_router}.py; SURVEY.md §2.6, §3.3) —
+preprocessing happens *before* routing so the router can match the prompt's
+block hashes against its radix index. Run:
+
+    python -m dynamo_tpu.components.processor \
+        --runtime-server HOST:PORT --model-path DIR \
+        --endpoint dyn://dynamo/worker/generate --port 8080
+
+Workers: `python -m dynamo_tpu.launch.run in=dyn://dynamo/worker/generate \
+out=jax --protocol tokens --model-path DIR --runtime-server HOST:PORT`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+
+logger = logging.getLogger("dynamo_tpu.components.processor")
+
+
+async def amain(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="dynamo-tpu-processor")
+    p.add_argument("--runtime-server", required=True)
+    p.add_argument("--model-path", required=True)
+    p.add_argument("--model-name")
+    p.add_argument("--endpoint", default="dyn://dynamo/worker/generate")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--kv-block-size", type=int, default=16,
+                   help="must match the workers' engine block size")
+    p.add_argument("--verbose", "-v", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    from ..llm.backend import Backend
+    from ..llm.engines.kv_routed import KvRoutedEngine
+    from ..llm.http import HttpService
+    from ..llm.model_card import ModelDeploymentCard
+    from ..llm.preprocessor import OpenAIPreprocessor
+    from ..runtime import link
+    from ..runtime.distributed import DistributedRuntime, Endpoint
+
+    name = args.model_name or os.path.basename(
+        os.path.normpath(args.model_path))
+    runtime = await DistributedRuntime.connect(args.runtime_server)
+    mdc = ModelDeploymentCard.from_local_path(args.model_path,
+                                              display_name=name)
+    endpoint = Endpoint.parse_path(runtime, args.endpoint)
+    engine = await KvRoutedEngine.start(endpoint,
+                                        block_size=args.kv_block_size)
+    pipeline = link(OpenAIPreprocessor(mdc), Backend(mdc), engine)
+    svc = HttpService(port=args.port, host=args.host)
+    svc.manager.add_chat_model(name, pipeline)
+    svc.manager.add_completion_model(name, pipeline)
+    logger.info("processor serving %s on %s:%d → %s (KV-aware)",
+                name, args.host, args.port, args.endpoint)
+    try:
+        await svc.run_forever()
+    finally:
+        await engine.close()
+        await runtime.shutdown()
+
+
+def main() -> None:
+    try:
+        asyncio.run(amain())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

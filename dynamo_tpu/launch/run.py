@@ -68,6 +68,11 @@ def build_parser() -> argparse.ArgumentParser:
     # routing / disagg
     p.add_argument("--router-mode", choices=["random", "round_robin"],
                    default="random")
+    p.add_argument("--protocol", choices=["openai", "tokens"],
+                   default="openai",
+                   help="worker wire protocol for in=dyn://: openai = full "
+                        "pipeline on the worker; tokens = core engine only "
+                        "(preprocessing lives in a KV-routing processor)")
     p.add_argument("--remote-prefill", action="store_true",
                    help="decode worker: offload long prefills to the "
                         "prefill queue")
@@ -192,20 +197,15 @@ def link_pipeline(engine, mdc):
 
 
 async def collect_chat_text(stream) -> str:
-    """Fold a chat chunk stream to its text; raises on Annotated error
-    items so failures surface instead of reading as empty output."""
-    parts = []
-    async for a in stream:
-        if getattr(a, "is_error", False):
-            raise RuntimeError(a.error_message() or "engine stream error")
-        d = a.data if hasattr(a, "data") else a
-        if not d or not isinstance(d, dict):
-            continue
-        for c in d.get("choices", ()):
-            delta = c.get("delta") or c.get("message") or {}
-            if delta.get("content"):
-                parts.append(delta["content"])
-    return "".join(parts)
+    """Fold a chat chunk stream to its first choice's text; raises on
+    Annotated error items so failures surface instead of reading as empty
+    output (delegates to the OpenAI aggregator — one fold implementation)."""
+    from ..llm.protocols.openai import aggregate_chat_stream
+    folded = await aggregate_chat_stream(stream)
+    choices = folded.get("choices") or []
+    if not choices:
+        return ""
+    return (choices[0].get("message") or {}).get("content") or ""
 
 
 async def run_http(args, pipeline, core) -> None:
@@ -256,18 +256,22 @@ async def run_batch(args, pipeline, path: str) -> None:
             line = line.strip()
             if not line:
                 continue
-            d = json.loads(line)
-            messages = d.get("messages") or [
-                {"role": "user", "content": d.get("text", d.get("prompt", ""))}]
-            req = {"model": name, "stream": True,
-                   "max_tokens": d.get("max_tokens", args.max_tokens),
-                   "messages": messages}
-            if "temperature" in d:
-                req["temperature"] = d["temperature"]
             try:
+                d = json.loads(line)
+                messages = d.get("messages") or [
+                    {"role": "user",
+                     "content": d.get("text", d.get("prompt", ""))}]
+                req = {"model": name, "stream": True,
+                       "max_tokens": d.get("max_tokens", args.max_tokens),
+                       "messages": messages}
+                if "temperature" in d:
+                    req["temperature"] = d["temperature"]
                 stream = await pipeline.generate(Context(req))
                 text = await collect_chat_text(stream)
                 fout.write(json.dumps({**d, "response": text}) + "\n")
+            except json.JSONDecodeError as e:
+                failed += 1
+                fout.write(json.dumps({"input": line, "error": str(e)}) + "\n")
             except Exception as e:  # noqa: BLE001 — per-row isolation
                 failed += 1
                 fout.write(json.dumps({**d, "error": str(e)}) + "\n")
@@ -279,31 +283,46 @@ async def run_batch(args, pipeline, path: str) -> None:
         raise SystemExit(1)
 
 
-async def run_worker_endpoint(args, pipeline, core, runtime,
+async def run_worker_endpoint(args, engine, pipeline, core, runtime,
                               path: str) -> None:
-    """in=dyn://ns/comp/ep — serve the local pipeline as a discoverable
-    worker instance (input/endpoint.rs:34-115): stats handler publishes
-    ForwardPassMetrics; KV events go to the component's kv_events subject
-    for KV-aware routers."""
+    """in=dyn://ns/comp/ep — serve as a discoverable worker instance
+    (input/endpoint.rs:34-115): stats handler publishes ForwardPassMetrics;
+    KV events go to the component's kv_events subject for KV-aware routers.
+
+    protocol=openai serves the full pipeline (preproc+detok on the worker,
+    the dynamo-run shape); protocol=tokens serves the bare core engine (a
+    KV-routing processor tokenizes and detokenizes, the examples/llm
+    Processor→Router→Worker shape)."""
+    import json as _json
     from ..llm.protocols.annotated import encode_annotated_json
+    from ..llm.protocols.common import PreprocessedRequest
     from ..runtime.distributed import Endpoint
     endpoint = Endpoint.parse_path(runtime, path)
     stats_handler = None
     if core is not None:
         stats_handler = lambda: core.metrics().to_dict()  # noqa: E731
         await _wire_kv_events(core, runtime, endpoint)
-    await endpoint.serve(pipeline, encode_resp=encode_annotated_json,
-                         stats_handler=stats_handler)
-    # register the model entries under our lease so discovery-driven
-    # frontends pick the model up — and drop it when this worker dies
-    if args.model_path or args.model_name:
-        from ..llm.discovery import ModelEntry, register_model
-        lease = await runtime.primary_lease()
-        for mt in ("chat", "completion"):
-            await register_model(runtime, ModelEntry(
-                name=_model_name(args), endpoint=endpoint.path,
-                model_type=mt), lease_id=lease.id)
-    logger.info("worker serving %s", endpoint.path)
+    if args.protocol == "tokens":
+        await endpoint.serve(
+            engine,
+            decode_req=lambda raw: PreprocessedRequest.from_dict(
+                _json.loads(raw)),
+            encode_resp=encode_annotated_json,
+            stats_handler=stats_handler)
+    else:
+        await endpoint.serve(pipeline, encode_resp=encode_annotated_json,
+                             stats_handler=stats_handler)
+        # register the model entries under our lease so discovery-driven
+        # frontends pick the model up — and drop it when this worker dies
+        if args.model_path or args.model_name:
+            from ..llm.discovery import ModelEntry, register_model
+            lease = await runtime.primary_lease()
+            for mt in ("chat", "completion"):
+                await register_model(runtime, ModelEntry(
+                    name=_model_name(args), endpoint=endpoint.path,
+                    model_type=mt), lease_id=lease.id)
+    logger.info("worker serving %s (%s protocol)", endpoint.path,
+                args.protocol)
     await asyncio.Event().wait()
 
 
@@ -359,7 +378,8 @@ async def amain(argv=None) -> None:
         elif src.startswith("batch:"):
             await run_batch(args, pipeline, src[len("batch:"):])
         elif src.startswith("dyn://") or src.count(".") == 2:
-            await run_worker_endpoint(args, pipeline, core, runtime, src)
+            await run_worker_endpoint(args, engine, pipeline, core, runtime,
+                                      src)
         elif src == "none":
             await asyncio.Event().wait()
         else:

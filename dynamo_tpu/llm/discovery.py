@@ -116,6 +116,11 @@ class ModelWatcher:
             self._engines[path] = engine
         return engine
 
+    def _canonical(self, endpoint: str) -> str:
+        """Both accepted spellings (dyn://ns/c/e and ns.c.e) must share one
+        client and one GC identity."""
+        return Endpoint.parse_path(self.runtime, endpoint).path
+
     async def _gc_engine(self, path: str) -> None:
         if path not in self._entries.values():
             engine = self._engines.pop(path, None)
@@ -123,10 +128,11 @@ class ModelWatcher:
                 await engine.close()
 
     async def _add(self, key: str, entry: ModelEntry) -> None:
+        path = self._canonical(entry.endpoint)
         old_path = self._entries.get(key)
-        engine = await self._engine_for(entry.endpoint)
-        self._entries[key] = entry.endpoint
-        if old_path is not None and old_path != entry.endpoint:
+        engine = await self._engine_for(path)
+        self._entries[key] = path
+        if old_path is not None and old_path != path:
             await self._gc_engine(old_path)   # re-registration moved target
         if entry.model_type == "completion":
             self.manager.add_completion_model(entry.name, engine)
@@ -147,6 +153,11 @@ class ModelWatcher:
     async def stop(self) -> None:
         if self._task is not None:
             self._task.cancel()
+            try:
+                await self._task          # let an in-flight _add finish/abort
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
         if self._watcher is not None:
             self._watcher.close()
         for engine in self._engines.values():

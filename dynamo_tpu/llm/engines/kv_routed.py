@@ -1,0 +1,123 @@
+"""KV-aware routed engine: the processor-side client that picks the worker
+whose KV cache best overlaps the request's prompt.
+
+Reference: the Router component + KvRouter service (SURVEY.md §3.4,
+examples/llm/components/kv_router.py:66-238, lib/llm/src/kv_router/
+kv_router.rs:44-140): subscribe the component's ``kv_events`` subject into a
+radix-tree indexer, scrape per-instance ForwardPassMetrics, and per request
+combine prefix-overlap with load cost to choose an instance — then dispatch
+with ``client.direct``. Speaks the token protocol (PreprocessedRequest →
+Annotated[BackendOutput]) so it slots into the standard pipeline where a
+local engine would sit."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Optional, Set
+
+from ...runtime.distributed import Client, Endpoint
+from ...runtime.engine import AsyncEngine, ManyOut, SingleIn
+from ..kv_router.protocols import RouterEvent
+from ..kv_router.router import KvRouter
+from ..protocols.annotated import decode_annotated_json
+from ..protocols.common import BackendOutput
+
+logger = logging.getLogger("dynamo_tpu.llm.kv_routed")
+
+__all__ = ["KvRoutedEngine"]
+
+
+def _decode_backend_annotated(raw: bytes):
+    ann = decode_annotated_json(raw)
+    if isinstance(ann.data, dict):
+        ann = ann.map_data(BackendOutput.from_dict)
+    return ann
+
+
+class KvRoutedEngine(AsyncEngine):
+    def __init__(self, client: Client, router: KvRouter,
+                 scrape_interval: float = 1.0):
+        self.client = client
+        self.router = router
+        self.scrape_interval = scrape_interval
+        self._tasks: list = []
+        self._known_workers: Set[int] = set()
+        # observability
+        self.kv_hits = 0
+        self.kv_routed = 0
+        self.fallback_routed = 0
+
+    @classmethod
+    async def start(cls, endpoint: Endpoint, block_size: int = 16,
+                    scrape_interval: float = 1.0) -> "KvRoutedEngine":
+        client = endpoint.client(decode_resp=_decode_backend_annotated)
+        await client.start()
+        router = KvRouter(block_size)
+        self = cls(client, router, scrape_interval)
+        rt = endpoint.runtime
+        sub = await rt.bus.subscribe(
+            f"evt.{endpoint.namespace}.{endpoint.component}.kv_events")
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._event_loop(sub), name="kvr-events"),
+            loop.create_task(self._scrape_loop(), name="kvr-scrape"),
+        ]
+        client.on_instances_changed = self._instances_changed
+        return self
+
+    # ---------------------------------------------------------------- feeds
+    async def _event_loop(self, sub) -> None:
+        async for msg in sub:
+            try:
+                self.router.on_kv_event(
+                    RouterEvent.from_dict(json.loads(msg.payload)))
+            except Exception:  # noqa: BLE001
+                logger.exception("bad kv event dropped")
+
+    async def _scrape_loop(self) -> None:
+        while True:
+            try:
+                stats = await self.client.collect_stats()
+                if stats:
+                    self.router.on_metrics(stats)
+            except Exception:  # noqa: BLE001
+                logger.exception("metrics scrape failed")
+            await asyncio.sleep(self.scrape_interval)
+
+    def _instances_changed(self, present: Set[int]) -> None:
+        for gone in self._known_workers - present:
+            self.router.on_worker_gone(gone)
+        self._known_workers = set(present)
+
+    # ------------------------------------------------------------- dispatch
+    async def generate(self, request: SingleIn) -> ManyOut:
+        tokens = list(request.data.token_ids)
+        pick = self.router.schedule(tokens)
+        if pick is None:
+            self.fallback_routed += 1
+            return await self.client.random(request)
+        worker_id, overlap_blocks = pick
+        request.data.estimated_prefix_hit_blocks = overlap_blocks
+        request.data.prefix_hit_len = overlap_blocks * self.router.block_size
+        if overlap_blocks:
+            self.kv_hits += 1
+        self.kv_routed += 1
+        try:
+            return await self.client.direct(request, worker_id)
+        except Exception:  # noqa: BLE001 — instance raced away; fall back
+            logger.warning("direct dispatch to %x failed; falling back",
+                           worker_id)
+            self.fallback_routed += 1
+            return await self.client.random(request)
+
+    def stats(self) -> dict:
+        return {"kv_routed": self.kv_routed, "kv_hits": self.kv_hits,
+                "fallback_routed": self.fallback_routed,
+                "known_workers": sorted(self._known_workers)}
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await self.client.close()

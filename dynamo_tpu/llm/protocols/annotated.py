@@ -71,7 +71,9 @@ class Annotated(Generic[R]):
 def encode_annotated_json(item) -> bytes:
     if not isinstance(item, Annotated):
         item = Annotated.from_data(item)
-    return json.dumps(item.to_json_dict()).encode()
+    enc = (dataclasses.asdict
+           if dataclasses.is_dataclass(item.data) else None)
+    return json.dumps(item.to_json_dict(data_encoder=enc)).encode()
 
 
 def decode_annotated_json(raw: bytes) -> "Annotated":

@@ -90,6 +90,18 @@ class PreprocessedRequest:
     prefix_hit_len: int = 0
     estimated_prefix_hit_blocks: int = 0
 
+    @classmethod
+    def from_dict(cls, d: dict) -> "PreprocessedRequest":
+        """Wire decode for the token protocol (processor → worker hop)."""
+        d = dict(d)
+        if isinstance(d.get("stop_conditions"), dict):
+            d["stop_conditions"] = StopConditions(**d["stop_conditions"])
+        if isinstance(d.get("sampling_options"), dict):
+            d["sampling_options"] = SamplingOptions(**d["sampling_options"])
+        if isinstance(d.get("output_options"), dict):
+            d["output_options"] = OutputOptions(**d["output_options"])
+        return cls(**d)
+
 
 BackendInput = PreprocessedRequest
 
@@ -112,6 +124,13 @@ class BackendOutput:
     @classmethod
     def final(cls, reason: FinishReason) -> "BackendOutput":
         return cls(finish_reason=reason)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BackendOutput":
+        d = dict(d)
+        if d.get("finish_reason") is not None:
+            d["finish_reason"] = FinishReason(d["finish_reason"])
+        return cls(**d)
 
 
 LLMEngineOutput = BackendOutput

@@ -1,0 +1,132 @@
+"""KV-aware routed serving end-to-end with mock workers (zero hardware):
+events fill the radix index over the bus, metrics arrive via stats scrape,
+and repeat prompts ride to the worker that owns the prefix.
+
+Reference: the mock_worker test tier (components/metrics/src/bin/
+mock_worker.rs; SURVEY.md §4) + the Router component behavior (§3.4)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.components.mock_worker import MockTokenWorker
+from dynamo_tpu.llm.engines.kv_routed import KvRoutedEngine
+from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                             SamplingOptions, StopConditions)
+from dynamo_tpu.runtime import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime, Endpoint
+from dynamo_tpu.runtime.engine import EngineContext
+from dynamo_tpu.runtime.server import DiscoveryServer
+
+pytestmark = pytest.mark.asyncio
+
+PATH = "dyn://kvns/worker/generate"
+
+
+@pytest.fixture
+async def daemon():
+    srv = DiscoveryServer(host="127.0.0.1")
+    await srv.start()
+    yield srv
+    await srv.close()
+
+
+def _req(tokens, rid):
+    pre = PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+        sampling_options=SamplingOptions(greedy=True))
+    return Context(pre, ctx=EngineContext(rid))
+
+
+async def _drain(stream):
+    return [a async for a in stream]
+
+
+async def test_kv_routed_repeat_prompt_sticks(daemon):
+    addr = daemon.address
+    rt_router = await DistributedRuntime.connect(addr)
+    rt_w1 = await DistributedRuntime.connect(addr)
+    rt_w2 = await DistributedRuntime.connect(addr)
+    w1 = await MockTokenWorker(rt_w1, PATH, block_size=4).start()
+    w2 = await MockTokenWorker(rt_w2, PATH, block_size=4).start()
+    engine = None
+    try:
+        endpoint = Endpoint.parse_path(rt_router, PATH)
+        engine = await KvRoutedEngine.start(endpoint, block_size=4,
+                                            scrape_interval=0.2)
+        await engine.client.wait_for_instances(15)
+        # wait until the metrics scrape has populated the scheduler
+        for _ in range(100):
+            if engine.router.schedule([1, 2, 3, 4]) is not None:
+                break
+            await asyncio.sleep(0.1)
+        assert engine.router.schedule([1, 2, 3, 4]) is not None
+
+        prompt = list(range(10, 26))            # 4 full blocks of 4
+        out = await _drain(await engine.generate(_req(prompt, "first")))
+        assert out and out[-1].data.finish_reason is not None
+        first_worker = (w1 if w1.engine.requests_served else w2)
+        other_worker = w2 if first_worker is w1 else w1
+        assert first_worker.engine.requests_served == 1
+
+        # the serving worker published stored events → router index catches up
+        wid = first_worker.worker_id
+        for _ in range(100):
+            pick = engine.router.schedule(prompt)
+            if pick is not None and pick[0] == wid and pick[1] > 0:
+                break
+            await asyncio.sleep(0.1)
+        pick = engine.router.schedule(prompt)
+        assert pick is not None and pick[0] == wid and pick[1] > 0
+
+        # repeat prompt → sticks to the prefix owner
+        await _drain(await engine.generate(_req(prompt, "second")))
+        assert first_worker.engine.requests_served == 2
+        assert other_worker.engine.requests_served == 0
+        assert engine.kv_hits >= 1
+    finally:
+        if engine is not None:
+            await engine.close()
+        await w1.stop()
+        await w2.stop()
+        for rt in (rt_router, rt_w1, rt_w2):
+            await rt.shutdown()
+
+
+async def test_kv_routed_balances_on_load(daemon):
+    """With no prefix overlap anywhere, the cost function avoids the
+    heavily-loaded instance (scheduler.rs select_worker semantics)."""
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    addr = daemon.address
+    rt_router = await DistributedRuntime.connect(addr)
+    rt_w1 = await DistributedRuntime.connect(addr)
+    rt_w2 = await DistributedRuntime.connect(addr)
+    busy = ForwardPassMetrics(request_active_slots=8, request_total_slots=8,
+                              kv_active_blocks=1000, kv_total_blocks=1024,
+                              num_requests_waiting=50)
+    idle = ForwardPassMetrics(request_active_slots=0, request_total_slots=8,
+                              kv_active_blocks=0, kv_total_blocks=1024)
+    w1 = await MockTokenWorker(rt_w1, PATH, block_size=4, metrics=busy).start()
+    w2 = await MockTokenWorker(rt_w2, PATH, block_size=4, metrics=idle).start()
+    engine = None
+    try:
+        endpoint = Endpoint.parse_path(rt_router, PATH)
+        engine = await KvRoutedEngine.start(endpoint, block_size=4,
+                                            scrape_interval=0.2)
+        await engine.client.wait_for_instances(15)
+        for _ in range(100):
+            pick = engine.router.schedule(list(range(40, 52)))
+            if pick is not None:
+                break
+            await asyncio.sleep(0.1)
+        pick = engine.router.schedule(list(range(40, 52)))
+        assert pick is not None
+        assert pick[0] == w2.worker_id   # idle worker wins
+    finally:
+        if engine is not None:
+            await engine.close()
+        await w1.stop()
+        await w2.stop()
+        for rt in (rt_router, rt_w1, rt_w2):
+            await rt.shutdown()
