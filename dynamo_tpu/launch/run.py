@@ -284,7 +284,7 @@ async def run_batch(args, pipeline, path: str) -> None:
 
 
 async def run_worker_endpoint(args, engine, pipeline, core, runtime,
-                              path: str) -> None:
+                              path: str, mdc=None) -> None:
     """in=dyn://ns/comp/ep — serve as a discoverable worker instance
     (input/endpoint.rs:34-115): stats handler publishes ForwardPassMetrics;
     KV events go to the component's kv_events subject for KV-aware routers.
@@ -293,7 +293,6 @@ async def run_worker_endpoint(args, engine, pipeline, core, runtime,
     the dynamo-run shape); protocol=tokens serves the bare core engine (a
     KV-routing processor tokenizes and detokenizes, the examples/llm
     Processor→Router→Worker shape)."""
-    import json as _json
     from ..llm.protocols.annotated import encode_annotated_json
     from ..llm.protocols.common import PreprocessedRequest
     from ..runtime.distributed import Endpoint
@@ -303,10 +302,14 @@ async def run_worker_endpoint(args, engine, pipeline, core, runtime,
         stats_handler = lambda: core.metrics().to_dict()  # noqa: E731
         await _wire_kv_events(core, runtime, endpoint)
     if args.protocol == "tokens":
+        if mdc is None:
+            raise SystemExit(
+                "--protocol tokens needs a token-level engine "
+                "(out=jax or out=echo_core), not a full-pipeline one")
         await endpoint.serve(
             engine,
             decode_req=lambda raw: PreprocessedRequest.from_dict(
-                _json.loads(raw)),
+                json.loads(raw)),
             encode_resp=encode_annotated_json,
             stats_handler=stats_handler)
     else:
@@ -379,7 +382,7 @@ async def amain(argv=None) -> None:
             await run_batch(args, pipeline, src[len("batch:"):])
         elif src.startswith("dyn://") or src.count(".") == 2:
             await run_worker_endpoint(args, engine, pipeline, core, runtime,
-                                      src)
+                                      src, mdc=mdc)
         elif src == "none":
             await asyncio.Event().wait()
         else:

@@ -43,6 +43,7 @@ class KvRoutedEngine(AsyncEngine):
         self.router = router
         self.scrape_interval = scrape_interval
         self._tasks: list = []
+        self._sub = None
         self._known_workers: Set[int] = set()
         # observability
         self.kv_hits = 0
@@ -53,18 +54,21 @@ class KvRoutedEngine(AsyncEngine):
     async def start(cls, endpoint: Endpoint, block_size: int = 16,
                     scrape_interval: float = 1.0) -> "KvRoutedEngine":
         client = endpoint.client(decode_resp=_decode_backend_annotated)
-        await client.start()
         router = KvRouter(block_size)
         self = cls(client, router, scrape_interval)
+        # attach the membership callback BEFORE the watch starts so no
+        # join/leave can slip between discovery replay and the hook
+        client.on_instances_changed = self._instances_changed
+        await client.start()
+        self._known_workers |= set(client.instance_ids())
         rt = endpoint.runtime
-        sub = await rt.bus.subscribe(
+        self._sub = await rt.bus.subscribe(
             f"evt.{endpoint.namespace}.{endpoint.component}.kv_events")
         loop = asyncio.get_running_loop()
         self._tasks = [
-            loop.create_task(self._event_loop(sub), name="kvr-events"),
+            loop.create_task(self._event_loop(self._sub), name="kvr-events"),
             loop.create_task(self._scrape_loop(), name="kvr-scrape"),
         ]
-        client.on_instances_changed = self._instances_changed
         return self
 
     # ---------------------------------------------------------------- feeds
@@ -109,6 +113,11 @@ class KvRoutedEngine(AsyncEngine):
         except Exception:  # noqa: BLE001 — instance raced away; fall back
             logger.warning("direct dispatch to %x failed; falling back",
                            worker_id)
+            # the hints described the failed worker's cache, not the
+            # fallback target's — reset so its disagg/prefill planning
+            # doesn't skip work it actually has to do
+            request.data.estimated_prefix_hit_blocks = 0
+            request.data.prefix_hit_len = 0
             self.fallback_routed += 1
             return await self.client.random(request)
 
@@ -118,6 +127,13 @@ class KvRoutedEngine(AsyncEngine):
                 "known_workers": sorted(self._known_workers)}
 
     async def close(self) -> None:
+        if self._sub is not None:
+            self._sub.close()
         for t in self._tasks:
             t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
         await self.client.close()

@@ -1,0 +1,15 @@
+"""Deployment SDK: service graph decorators + serve CLI.
+
+Reference: deploy/dynamo/sdk (BentoML-derived; SURVEY.md §2.5) —
+``@service`` classes with ``@dynamo_endpoint`` async-generator methods,
+``depends()`` typed clients, ``.link()`` graph edges, YAML per-service
+config, and a process supervisor with a per-service accelerator allocator.
+Ours is BentoML-free: plain decorators, an asyncio supervisor (circus
+analog), and a TPU chip allocator."""
+
+from .config import ServiceConfig
+from .service import (DynamoService, async_on_start, depends,
+                      dynamo_endpoint, service)
+
+__all__ = ["service", "dynamo_endpoint", "async_on_start", "depends",
+           "DynamoService", "ServiceConfig"]

@@ -1,0 +1,67 @@
+"""Per-service TPU chip allocator.
+
+Reference: cli/allocator.py:28-120 — the serve CLI reads each service's
+``resources={gpu: n}`` and assigns disjoint ``CUDA_VISIBLE_DEVICES`` ranges
+to its workers. TPU-native analog: assign chip indices and export
+``TPU_VISIBLE_CHIPS`` (+ ``TPU_PROCESS_BOUNDS``-friendly count) so multiple
+engine processes on one TPU-VM host split the local chips; CPU/dry-run
+deployments get the same accounting with no env effect."""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("dynamo_tpu.sdk.allocator")
+
+__all__ = ["TpuAllocator"]
+
+
+def _detect_chip_count(default: int = 4) -> int:
+    """Chips on this host. v5e/v6e TPU-VM hosts expose 1/4/8 chips; fall
+    back to the JAX device count when available, else `default`."""
+    try:
+        import jax
+        devs = [d for d in jax.devices() if d.platform == "tpu"]
+        if devs:
+            return len(devs)
+    except Exception:  # noqa: BLE001 — no jax / no TPU: accounting only
+        pass
+    return default
+
+
+@dataclasses.dataclass
+class Allocation:
+    service: str
+    chips: List[int]
+
+    def env(self) -> Dict[str, str]:
+        if not self.chips:
+            return {}
+        return {"TPU_VISIBLE_CHIPS": ",".join(str(c) for c in self.chips),
+                "TPU_CHIPS_PER_PROCESS_BOUNDS":
+                    f"1,1,{len(self.chips)}"}
+
+
+class TpuAllocator:
+    def __init__(self, total_chips: Optional[int] = None):
+        self.total = (_detect_chip_count() if total_chips is None
+                      else total_chips)
+        self._next = 0
+        self.allocations: Dict[str, Allocation] = {}
+
+    def allocate(self, service: str, n_chips: int) -> Allocation:
+        if n_chips == 0:
+            alloc = Allocation(service, [])
+        else:
+            if self._next + n_chips > self.total:
+                raise RuntimeError(
+                    f"service {service!r} wants {n_chips} chips but only "
+                    f"{self.total - self._next}/{self.total} remain")
+            alloc = Allocation(
+                service, list(range(self._next, self._next + n_chips)))
+            self._next += n_chips
+            logger.info("allocated chips %s → %s", alloc.chips, service)
+        self.allocations[service] = alloc
+        return alloc

@@ -1,0 +1,73 @@
+"""DependencyClient: what a ``depends()`` attribute becomes at serve time.
+
+Reference: lib/dependency.py — a typed stub over the distributed client for
+the dependency's first endpoint, with ``.generate(...)`` streaming and
+``.get_endpoint(name)`` for explicit endpoint selection."""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Optional
+
+from ..runtime.distributed import Client, DistributedRuntime, Endpoint
+from .service import DynamoService
+
+__all__ = ["DependencyClient"]
+
+
+class DependencyClient:
+    def __init__(self, runtime: DistributedRuntime, svc: DynamoService):
+        self.runtime = runtime
+        self.service = svc
+        self._clients: dict = {}
+
+    @classmethod
+    async def connect(cls, runtime: DistributedRuntime,
+                      svc: DynamoService) -> "DependencyClient":
+        self = cls(runtime, svc)
+        for ep_name in svc.endpoints:
+            await self._client(ep_name)
+        return self
+
+    def get_endpoint(self, name: str) -> Endpoint:
+        return Endpoint(self.runtime, self.service.namespace,
+                        self.service.name, name)
+
+    async def _client(self, ep_name: str) -> Client:
+        c = self._clients.get(ep_name)
+        if c is None:
+            c = self.get_endpoint(ep_name).client()
+            await c.start()
+            self._clients[ep_name] = c
+        return c
+
+    async def wait_ready(self, timeout: float = 60.0) -> None:
+        for ep_name in self.service.endpoints:
+            client = await self._client(ep_name)
+            await client.wait_for_instances(timeout)
+
+    async def call(self, endpoint: str, payload: Any,
+                   instance_id: Optional[int] = None) -> AsyncIterator[Any]:
+        client = await self._client(endpoint)
+        if not client.instances:
+            # services boot concurrently; first calls tolerate a late peer
+            await client.wait_for_instances(timeout=30.0)
+        from ..runtime import Context
+        ctx = payload if isinstance(payload, Context) else Context(payload)
+        if instance_id is not None:
+            return await client.direct(ctx, instance_id)
+        return await client.random(ctx)
+
+    def __getattr__(self, name: str):
+        """dep.generate(payload) — dynamic method per endpoint name."""
+        if name.startswith("_") or name not in self.service.endpoints:
+            raise AttributeError(name)
+
+        async def invoke(payload: Any, instance_id: Optional[int] = None):
+            return await self.call(name, payload, instance_id)
+
+        return invoke
+
+    async def close(self) -> None:
+        for c in self._clients.values():
+            await c.close()
+        self._clients.clear()

@@ -1,0 +1,72 @@
+"""Per-service configuration: YAML file sections + env injection.
+
+Reference: lib/config.py ``ServiceConfig`` singleton — ``-f config.yaml``
+sections keyed by service name, injected into worker subprocesses via the
+``DYNAMO_SERVICE_CONFIG`` env var (service.py:110-117), with ``as_args``
+flattening for engine flags."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+__all__ = ["ServiceConfig"]
+
+ENV_VAR = "DYNAMO_SERVICE_CONFIG"
+
+
+class ServiceConfig:
+    _instance: Optional["ServiceConfig"] = None
+
+    def __init__(self, data: Optional[Dict[str, Any]] = None):
+        self.data: Dict[str, Any] = data or {}
+
+    # singleton plumbing ---------------------------------------------------
+    @classmethod
+    def get_instance(cls) -> "ServiceConfig":
+        if cls._instance is None:
+            raw = os.environ.get(ENV_VAR)
+            cls._instance = cls(json.loads(raw) if raw else {})
+        return cls._instance
+
+    @classmethod
+    def set_instance(cls, cfg: "ServiceConfig") -> None:
+        cls._instance = cfg
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._instance = None
+
+    # loading --------------------------------------------------------------
+    @classmethod
+    def from_yaml(cls, path: str) -> "ServiceConfig":
+        import yaml
+        with open(path) as f:
+            return cls(yaml.safe_load(f) or {})
+
+    def to_env(self) -> str:
+        return json.dumps(self.data)
+
+    # access ---------------------------------------------------------------
+    def for_service(self, name: str) -> Dict[str, Any]:
+        return dict(self.data.get(name) or {})
+
+    def get(self, service: str, key: str, default: Any = None) -> Any:
+        return self.for_service(service).get(key, default)
+
+    def as_args(self, service: str, prefix: str = "") -> List[str]:
+        """Flatten a service section into ``--key value`` CLI args
+        (reference as_args; booleans become bare flags when true)."""
+        out: List[str] = []
+        for k, v in self.for_service(service).items():
+            if prefix and not k.startswith(prefix):
+                continue
+            key = k[len(prefix):] if prefix else k
+            flag = f"--{key.replace('_', '-')}"
+            if isinstance(v, bool):
+                if v:
+                    out.append(flag)
+            elif v is not None:
+                out.extend([flag, str(v)])
+        return out

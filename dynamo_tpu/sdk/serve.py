@@ -1,0 +1,154 @@
+"""The serve CLI: deploy a service graph as supervised processes.
+
+    python -m dynamo_tpu.sdk.serve graphs.agg:Frontend -f configs/agg.yaml
+
+Reference: cli/serve.py + cli/serving.py — resolve the graph entry, build
+one supervised worker per service (the reference uses a circus arbiter;
+ours is a plain asyncio supervisor with bounded restarts), allocate
+accelerator chips per service, inject per-service YAML config via the
+``DYNAMO_SERVICE_CONFIG`` env var, and (unless one is given) host the
+discovery/bus daemon in-process."""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+import signal
+import sys
+from typing import Dict, List, Optional
+
+from .allocator import TpuAllocator
+from .config import ENV_VAR, ServiceConfig
+from .serve_worker import resolve_service
+
+logger = logging.getLogger("dynamo_tpu.sdk.serve")
+
+MAX_RESTARTS = 3
+
+
+class Watcher:
+    """One supervised service process (circus Watcher analog,
+    serving.py:127-166)."""
+
+    def __init__(self, target: str, service_name: str, runtime_server: str,
+                 env: Dict[str, str]):
+        self.target = target
+        self.service_name = service_name
+        self.runtime_server = runtime_server
+        self.env = env
+        self.proc: Optional[asyncio.subprocess.Process] = None
+        self.restarts = 0
+        self._stopping = False
+
+    async def start(self) -> None:
+        env = {**os.environ, **self.env}
+        self.proc = await asyncio.create_subprocess_exec(
+            sys.executable, "-m", "dynamo_tpu.sdk.serve_worker",
+            "--target", self.target,
+            "--service-name", self.service_name,
+            "--runtime-server", self.runtime_server,
+            env=env)
+        logger.info("started %s (pid %d)", self.service_name, self.proc.pid)
+
+    async def supervise(self) -> None:
+        while not self._stopping:
+            rc = await self.proc.wait()
+            if self._stopping:
+                return
+            if self.restarts >= MAX_RESTARTS:
+                raise RuntimeError(
+                    f"service {self.service_name} exited rc={rc} "
+                    f"(gave up after {self.restarts} restarts)")
+            self.restarts += 1
+            logger.warning("service %s exited rc=%s — restart %d/%d",
+                           self.service_name, rc, self.restarts, MAX_RESTARTS)
+            await asyncio.sleep(min(2 ** self.restarts, 10))
+            await self.start()
+
+    async def stop(self, grace: float = 5.0) -> None:
+        self._stopping = True
+        if self.proc is None or self.proc.returncode is not None:
+            return
+        self.proc.terminate()
+        try:
+            await asyncio.wait_for(self.proc.wait(), grace)
+        except asyncio.TimeoutError:
+            logger.warning("killing %s (graceful timeout)", self.service_name)
+            self.proc.kill()
+            await self.proc.wait()
+
+
+async def amain(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="dynamo-tpu-serve")
+    p.add_argument("target", help="graph entry, e.g. graphs.agg:Frontend")
+    p.add_argument("-f", "--config", help="per-service YAML config")
+    p.add_argument("--runtime-server",
+                   help="external discovery daemon (default: host one)")
+    p.add_argument("--daemon-port", type=int, default=0)
+    p.add_argument("--total-chips", type=int,
+                   help="override detected TPU chip count")
+    p.add_argument("--verbose", "-v", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+
+    entry = resolve_service(args.target)
+    graph = entry.graph()
+    logger.info("deploying graph: %s", " → ".join(s.name for s in graph))
+
+    cfg = (ServiceConfig.from_yaml(args.config) if args.config
+           else ServiceConfig())
+
+    daemon = None
+    runtime_server = args.runtime_server
+    if not runtime_server:
+        from ..runtime.server import DiscoveryServer
+        daemon = DiscoveryServer(host="127.0.0.1", port=args.daemon_port)
+        await daemon.start()
+        runtime_server = daemon.address
+        logger.info("hosting discovery daemon on %s", runtime_server)
+
+    allocator = TpuAllocator(total_chips=args.total_chips)
+    watchers: List[Watcher] = []
+    for svc in graph:
+        alloc = allocator.allocate(svc.name, svc.resources.tpu)
+        env = {ENV_VAR: cfg.to_env(), **alloc.env()}
+        watchers.append(Watcher(args.target, svc.name, runtime_server, env))
+
+    stop_evt = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop_evt.set)
+        except NotImplementedError:
+            pass
+
+    try:
+        for w in watchers:
+            await w.start()
+        tasks = [asyncio.ensure_future(w.supervise()) for w in watchers]
+        stop_task = asyncio.ensure_future(stop_evt.wait())
+        done, _ = await asyncio.wait(
+            tasks + [stop_task], return_when=asyncio.FIRST_COMPLETED)
+        for t in done:
+            if t is not stop_task and t.exception() is not None:
+                raise t.exception()
+    finally:
+        for w in watchers:
+            await w.stop()
+        if daemon is not None:
+            await daemon.close()
+
+
+def main() -> None:
+    try:
+        asyncio.run(amain())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

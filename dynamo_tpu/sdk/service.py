@@ -1,0 +1,143 @@
+"""Service graph primitives.
+
+Reference mapping (deploy/dynamo/sdk/src/dynamo/sdk/lib/):
+- ``@service`` → DynamoService wrapper (service.py:30-241)
+- ``@dynamo_endpoint`` → marks async-generator endpoint methods
+  (decorators.py:26-100)
+- ``@async_on_start`` → post-init hooks run before serving
+- ``depends(Other)`` → typed client attribute resolved at serve time
+  (dependency.py)
+- ``A.link(B)`` → deployment edge; the serve CLI walks deps ∪ links from
+  the entry service to decide what to launch (LinkedServices pruning)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["service", "dynamo_endpoint", "async_on_start", "depends",
+           "Depends", "DynamoService"]
+
+
+def dynamo_endpoint(name: Optional[str] = None):
+    """Mark an async-generator method as a served endpoint."""
+
+    def wrap(fn):
+        fn.__dynamo_endpoint__ = name or fn.__name__
+        return fn
+
+    # bare usage: @dynamo_endpoint without parens
+    if callable(name):
+        fn, name = name, None
+        return wrap(fn)
+    return wrap
+
+
+def async_on_start(fn):
+    """Mark an async method to run after dependency resolution, before
+    serving endpoints."""
+    fn.__dynamo_on_start__ = True
+    return fn
+
+
+class Depends:
+    """Class-attribute placeholder for a client to another service; the
+    serve runtime replaces it with a live ``DependencyClient``."""
+
+    def __init__(self, on: "DynamoService"):
+        if not isinstance(on, DynamoService):
+            raise TypeError("depends() takes a @service-decorated class")
+        self.on = on
+
+    def __repr__(self) -> str:
+        return f"depends({self.on.name})"
+
+
+def depends(on: "DynamoService") -> Depends:
+    return Depends(on)
+
+
+@dataclasses.dataclass
+class Resources:
+    tpu: int = 0
+    cpu: Optional[str] = None
+    memory: Optional[str] = None
+
+
+class DynamoService:
+    """The object a ``@service`` class becomes (the reference subclasses
+    bentoml.Service; ours is standalone)."""
+
+    def __init__(self, cls: type, name: Optional[str] = None,
+                 namespace: str = "dynamo",
+                 resources: Optional[dict] = None,
+                 dynamo: Optional[dict] = None):
+        self.inner = cls
+        cfg = dynamo or {}
+        self.enabled = bool(cfg.get("enabled", True))
+        self.name = name or cfg.get("name") or cls.__name__
+        self.namespace = cfg.get("namespace", namespace)
+        res = resources or {}
+        self.resources = Resources(
+            tpu=int(res.get("tpu", res.get("gpu", 0)) or 0),
+            cpu=res.get("cpu"), memory=res.get("memory"))
+        self.endpoints: Dict[str, str] = {}      # endpoint name → attr name
+        self.on_start_hooks: List[str] = []
+        self.dependencies: Dict[str, Depends] = {}
+        for attr, val in list(vars(cls).items()):
+            if isinstance(val, Depends):
+                self.dependencies[attr] = val
+            elif callable(val) and hasattr(val, "__dynamo_endpoint__"):
+                self.endpoints[val.__dynamo_endpoint__] = attr
+            elif callable(val) and getattr(val, "__dynamo_on_start__", False):
+                self.on_start_hooks.append(attr)
+        self.links: List["DynamoService"] = []
+
+    # graph edges ----------------------------------------------------------
+    def link(self, other: "DynamoService") -> "DynamoService":
+        """Record a deployment edge and return the *target* so chains like
+        ``Frontend.link(Processor).link(Worker)`` build a path
+        (graphs/disagg_router.py:16-22)."""
+        if other not in self.links:
+            self.links.append(other)
+        return other
+
+    def graph(self) -> List["DynamoService"]:
+        """Every service reachable from this entry via deps ∪ links, in
+        discovery (BFS) order — what the serve CLI deploys."""
+        seen: List[DynamoService] = []
+        queue = [self]
+        while queue:
+            svc = queue.pop(0)
+            if svc in seen or not svc.enabled:
+                continue
+            seen.append(svc)
+            queue.extend(d.on for d in svc.dependencies.values())
+            queue.extend(svc.links)
+        return seen
+
+    def instantiate(self) -> Any:
+        return self.inner()
+
+    def __repr__(self) -> str:
+        return (f"DynamoService({self.name}, ns={self.namespace}, "
+                f"endpoints={sorted(self.endpoints)}, "
+                f"deps={sorted(self.dependencies)})")
+
+
+def service(cls: Optional[type] = None, *, name: Optional[str] = None,
+            namespace: str = "dynamo", resources: Optional[dict] = None,
+            dynamo: Optional[dict] = None, **_ignored):
+    """Class decorator → DynamoService. Usable bare or with kwargs."""
+
+    def wrap(c: type) -> DynamoService:
+        if not inspect.isclass(c):
+            raise TypeError("@service decorates a class")
+        return DynamoService(c, name=name, namespace=namespace,
+                             resources=resources, dynamo=dynamo)
+
+    if cls is not None:
+        return wrap(cls)
+    return wrap

@@ -1,0 +1,56 @@
+"""Hello-world service graph: Frontend → Middle → Backend.
+
+Reference: examples/hello_world — the minimal three-stage SDK pipeline used
+to demonstrate @service/@dynamo_endpoint/depends/link and `dynamo serve`.
+
+    python -m dynamo_tpu.sdk.serve examples.hello_world.graph:Frontend \
+        -f examples/hello_world/config.yaml
+"""
+
+from dynamo_tpu.sdk import (async_on_start, depends, dynamo_endpoint,
+                            service)
+
+
+@service(dynamo={"namespace": "hello"})
+class Backend:
+    """Terminal stage: shouts each word back."""
+
+    @dynamo_endpoint()
+    async def generate(self, request):
+        for word in request["text"].split():
+            yield {"word": f"{word}!"}
+
+
+@service(dynamo={"namespace": "hello"})
+class Middle:
+    """Relay stage: decorates the text, forwards, re-streams."""
+
+    backend = depends(Backend)
+
+    @dynamo_endpoint()
+    async def generate(self, request):
+        stream = await self.backend.generate(
+            {"text": request["text"] + " via-middle"})
+        async for item in stream:
+            yield item
+
+
+@service(dynamo={"namespace": "hello"})
+class Frontend:
+    """Entry stage: applies configured greeting, forwards to Middle."""
+
+    middle = depends(Middle)
+
+    @async_on_start
+    async def init(self):
+        self.greeting = self.config.get("greeting", "hello")
+
+    @dynamo_endpoint()
+    async def generate(self, request):
+        stream = await self.middle.generate(
+            {"text": f"{self.greeting} {request['text']}"})
+        async for item in stream:
+            yield item
+
+
+Frontend.link(Middle).link(Backend)
