@@ -28,6 +28,7 @@ import dataclasses
 import itertools
 import json
 import logging
+import os
 import random
 import uuid
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional
@@ -99,7 +100,9 @@ class ComponentEndpointInfo:
 class DistributedRuntime:
     """One per process. Owns transports + the primary lease."""
 
-    LEASE_TTL = 2.0
+    # etcd-style liveness TTL; generous enough that long XLA compiles on the
+    # same event loop can't starve the keepalive (refresh runs every TTL/3)
+    LEASE_TTL = float(os.environ.get("DYN_LEASE_TTL", "10.0"))
 
     def __init__(self, store: KvStore, bus: MessageBus,
                  tcp_host: str = "127.0.0.1",
