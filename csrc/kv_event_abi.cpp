@@ -1,0 +1,221 @@
+// C ABI for engine-side KV event publication.
+//
+// Native component per SURVEY.md §2.3 item 4: the reference ships a Rust
+// cdylib (lib/bindings/c/src/lib.rs:51-297) exposing `dynamo_llm_init`,
+// `dynamo_kv_event_publish_stored`, `dynamo_kv_event_publish_removed` so
+// out-of-process engines (the vLLM patch's KVCacheEventManager, patch lines
+// 302-416) can feed the KV routers without linking the full runtime.
+//
+// This is the same contract built fresh for the TPU stack: the ABI enqueues
+// events into a bounded in-process queue (mutex + deque — engines call from
+// arbitrary threads); the Python runtime drains it (`dyn_kv_event_poll`) and
+// publishes RouterEvents on the message bus. The reference publishes to NATS
+// from inside the cdylib; splitting publish out keeps the native lib free of
+// any transport dependency while preserving the engine-facing signatures.
+//
+// Events are serialized as JSON carrying the raw per-block token ids; the
+// drain side computes the local token hashes (xxh3, seed 1337) with the same
+// code the in-process engine uses, so both paths are hash-identical.
+//
+// Build: g++ -O3 -shared -fPIC -o libdynkvabi.so kv_event_abi.cpp
+
+#include <cstdint>
+#include <cstddef>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+
+namespace {
+
+constexpr size_t kMaxQueued = 65536;
+
+struct Publisher {
+    std::string ns;
+    std::string component;
+    int64_t worker_id = 0;
+    uint32_t kv_block_size = 0;
+    std::deque<std::string> queue;
+    uint64_t dropped = 0;
+    uint64_t published = 0;
+};
+
+std::mutex g_mu;
+Publisher* g_pub = nullptr;  // global singleton, as in the reference cdylib
+
+void append_json_string(std::string& out, const std::string& s) {
+    out += '"';
+    for (char c : s) {
+        switch (c) {
+            case '"': out += "\\\""; break;
+            case '\\': out += "\\\\"; break;
+            case '\n': out += "\\n"; break;
+            case '\t': out += "\\t"; break;
+            case '\r': out += "\\r"; break;
+            default:
+                if (static_cast<unsigned char>(c) < 0x20) {
+                    char buf[8];
+                    snprintf(buf, sizeof buf, "\\u%04x", c);
+                    out += buf;
+                } else {
+                    out += c;
+                }
+        }
+    }
+    out += '"';
+}
+
+void append_u64_array(std::string& out, const uint64_t* v, size_t n) {
+    out += '[';
+    for (size_t i = 0; i < n; i++) {
+        if (i) out += ',';
+        out += std::to_string(v[i]);
+    }
+    out += ']';
+}
+
+bool enqueue_locked(std::string&& json) {
+    if (g_pub->queue.size() >= kMaxQueued) {
+        g_pub->dropped++;
+        return false;
+    }
+    g_pub->queue.push_back(std::move(json));
+    g_pub->published++;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Result codes mirror the reference's DynamoLlmResult: 0 = OK.
+enum : int64_t {
+    DYN_OK = 0,
+    DYN_ERR = 1,
+    DYN_ERR_UNINITIALIZED = 2,
+    DYN_ERR_ALREADY_INITIALIZED = 3,
+    DYN_ERR_QUEUE_FULL = 4,
+};
+
+int64_t dynamo_llm_init(const char* ns, const char* component,
+                        int64_t worker_id, uint32_t kv_block_size) {
+    if (ns == nullptr || component == nullptr) return DYN_ERR;
+    std::lock_guard<std::mutex> lk(g_mu);
+    if (g_pub != nullptr) return DYN_ERR_ALREADY_INITIALIZED;
+    g_pub = new Publisher();
+    g_pub->ns = ns;
+    g_pub->component = component;
+    g_pub->worker_id = worker_id;
+    g_pub->kv_block_size = kv_block_size;
+    return DYN_OK;
+}
+
+int64_t dynamo_llm_shutdown() {
+    std::lock_guard<std::mutex> lk(g_mu);
+    if (g_pub == nullptr) return DYN_ERR_UNINITIALIZED;
+    delete g_pub;
+    g_pub = nullptr;
+    return DYN_OK;
+}
+
+// Blocks entered the engine's reusable pool. `token_ids` is the
+// concatenation of every block's tokens; `num_block_tokens[i]` its length;
+// `block_hashes[i]` the engine's (chained) hash identifying block i;
+// `parent_hash` nullable — hash of the block preceding the first one here.
+int64_t dynamo_kv_event_publish_stored(
+    uint64_t event_id, const uint32_t* token_ids,
+    const size_t* num_block_tokens, const uint64_t* block_hashes,
+    size_t num_blocks, const uint64_t* parent_hash, uint64_t lora_id) {
+    if (num_blocks > 0 &&
+        (token_ids == nullptr || num_block_tokens == nullptr ||
+         block_hashes == nullptr))
+        return DYN_ERR;
+    std::lock_guard<std::mutex> lk(g_mu);
+    if (g_pub == nullptr) return DYN_ERR_UNINITIALIZED;
+
+    std::string j;
+    j.reserve(128 + num_blocks * 64);
+    j += "{\"event_id\":" + std::to_string(event_id);
+    j += ",\"worker_id\":" + std::to_string(g_pub->worker_id);
+    j += ",\"stored\":{\"parent_hash\":";
+    j += parent_hash ? std::to_string(*parent_hash) : std::string("null");
+    j += ",\"lora_id\":" + std::to_string(lora_id);
+    j += ",\"block_hashes\":";
+    append_u64_array(j, block_hashes, num_blocks);
+    j += ",\"blocks_tokens\":[";
+    size_t off = 0;
+    for (size_t b = 0; b < num_blocks; b++) {
+        if (b) j += ',';
+        j += '[';
+        for (size_t t = 0; t < num_block_tokens[b]; t++) {
+            if (t) j += ',';
+            j += std::to_string(token_ids[off + t]);
+        }
+        j += ']';
+        off += num_block_tokens[b];
+    }
+    j += "]}}";
+    return enqueue_locked(std::move(j)) ? DYN_OK : DYN_ERR_QUEUE_FULL;
+}
+
+int64_t dynamo_kv_event_publish_removed(uint64_t event_id,
+                                        const uint64_t* block_hashes,
+                                        size_t num_blocks) {
+    if (num_blocks > 0 && block_hashes == nullptr) return DYN_ERR;
+    std::lock_guard<std::mutex> lk(g_mu);
+    if (g_pub == nullptr) return DYN_ERR_UNINITIALIZED;
+    std::string j;
+    j.reserve(64 + num_blocks * 21);
+    j += "{\"event_id\":" + std::to_string(event_id);
+    j += ",\"worker_id\":" + std::to_string(g_pub->worker_id);
+    j += ",\"removed\":{\"block_hashes\":";
+    append_u64_array(j, block_hashes, num_blocks);
+    j += "}}";
+    return enqueue_locked(std::move(j)) ? DYN_OK : DYN_ERR_QUEUE_FULL;
+}
+
+// ---- drain side (consumed by the runtime's publisher task) ----
+
+// Pops one event as a malloc'd JSON string (caller frees with
+// dyn_kv_event_str_free); NULL when the queue is empty.
+char* dyn_kv_event_poll() {
+    std::lock_guard<std::mutex> lk(g_mu);
+    if (g_pub == nullptr || g_pub->queue.empty()) return nullptr;
+    const std::string& s = g_pub->queue.front();
+    char* out = static_cast<char*>(malloc(s.size() + 1));
+    if (out == nullptr) return nullptr;
+    memcpy(out, s.data(), s.size() + 1);
+    g_pub->queue.pop_front();
+    return out;
+}
+
+void dyn_kv_event_str_free(char* s) { free(s); }
+
+size_t dyn_kv_event_pending() {
+    std::lock_guard<std::mutex> lk(g_mu);
+    return g_pub == nullptr ? 0 : g_pub->queue.size();
+}
+
+uint64_t dyn_kv_event_dropped() {
+    std::lock_guard<std::mutex> lk(g_mu);
+    return g_pub == nullptr ? 0 : g_pub->dropped;
+}
+
+// Init params back out as JSON (the drain needs the subject scope).
+char* dyn_kv_abi_info() {
+    std::lock_guard<std::mutex> lk(g_mu);
+    if (g_pub == nullptr) return nullptr;
+    std::string j = "{\"namespace\":";
+    append_json_string(j, g_pub->ns);
+    j += ",\"component\":";
+    append_json_string(j, g_pub->component);
+    j += ",\"worker_id\":" + std::to_string(g_pub->worker_id) +
+         ",\"kv_block_size\":" + std::to_string(g_pub->kv_block_size) + "}";
+    char* out = static_cast<char*>(malloc(j.size() + 1));
+    if (out == nullptr) return nullptr;
+    memcpy(out, j.data(), j.size() + 1);
+    return out;
+}
+
+}  // extern "C"

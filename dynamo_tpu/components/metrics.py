@@ -1,0 +1,201 @@
+"""Standalone metrics aggregation service.
+
+Reference: components/metrics (components/metrics/src/main.rs:26-210,
+src/lib.rs) — a service that (a) subscribes the routers' KV-hit-rate event
+subject, (b) scrapes every worker instance's ForwardPassMetrics stats, and
+(c) exposes the merged picture as Prometheus text for Grafana/alerting
+(deploy/metrics/{grafana.json,prometheus.yml}). Runs with zero TPUs against
+the mock worker (SURVEY.md §4's no-GPU fixture).
+
+Usage (module CLI)::
+
+    python -m dynamo_tpu.components.metrics dyn://ns/component/endpoint \
+        --daemon 127.0.0.1:5600 --port 9091
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Dict, Optional, Set
+
+from prometheus_client import (CollectorRegistry, Counter, Gauge,
+                               generate_latest)
+
+from ..llm.kv_router.protocols import (KV_HIT_RATE_SUBJECT,
+                                       ForwardPassMetrics)
+from ..runtime.distributed import DistributedRuntime, Endpoint
+
+logger = logging.getLogger("dynamo_tpu.components.metrics")
+
+PREFIX = "nv_llm_kv"
+
+_GAUGE_FIELDS = (
+    "request_active_slots", "request_total_slots", "kv_active_blocks",
+    "kv_total_blocks", "num_requests_waiting", "gpu_cache_usage_perc",
+    "gpu_prefix_cache_hit_rate",
+)
+
+
+class MetricsAggregatorService:
+    """Aggregates worker load + router hit-rate into one Prometheus registry.
+
+    One instance watches one logical endpoint (namespace/component/endpoint);
+    workers appear/disappear with their leases and their gauge series follow.
+    """
+
+    def __init__(self, endpoint: Endpoint, scrape_interval: float = 1.0,
+                 registry: Optional[CollectorRegistry] = None):
+        self.endpoint = endpoint
+        self.scrape_interval = scrape_interval
+        self.registry = registry or CollectorRegistry()
+        labels = ["component", "endpoint", "worker_id"]
+        self._gauges: Dict[str, Gauge] = {
+            f: Gauge(f"{PREFIX}_{f}", f"worker {f} (scraped stats)",
+                     labels, registry=self.registry)
+            for f in _GAUGE_FIELDS}
+        self.hit_isl_blocks = Counter(
+            f"{PREFIX}_hit_rate_isl_blocks_total",
+            "Routing decisions: total request blocks (ISL)",
+            labels, registry=self.registry)
+        self.hit_overlap_blocks = Counter(
+            f"{PREFIX}_hit_rate_overlap_blocks_total",
+            "Routing decisions: blocks already held by the chosen worker",
+            labels, registry=self.registry)
+        self._seen_workers: Set[int] = set()
+        self._client = None
+        self._sub = None
+        self._tasks: list = []
+        self.events_received = 0
+        self.latest: Dict[int, ForwardPassMetrics] = {}
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> "MetricsAggregatorService":
+        ep = self.endpoint
+        self._client = ep.client()
+        await self._client.start()
+        self._sub = await ep.parent_component().subscribe_event(
+            KV_HIT_RATE_SUBJECT)
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._scrape_loop(), name="metrics-scrape"),
+            loop.create_task(self._hit_rate_loop(), name="metrics-hitrate"),
+        ]
+        return self
+
+    async def close(self) -> None:
+        if self._sub is not None:
+            self._sub.close()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        if self._client is not None:
+            await self._client.close()
+
+    # ----------------------------------------------------------------- feeds
+    def _labels(self, worker_id: int):
+        return (self.endpoint.component, self.endpoint.name,
+                f"{worker_id:x}")
+
+    async def _scrape_loop(self) -> None:
+        while True:
+            try:
+                stats = await self._client.collect_stats()
+                self._apply_stats(stats)
+            except Exception:  # noqa: BLE001
+                logger.exception("stats scrape failed")
+            await asyncio.sleep(self.scrape_interval)
+
+    def _apply_stats(self, stats: Dict[int, dict]) -> None:
+        present = set(stats)
+        for wid, raw in stats.items():
+            m = (raw if isinstance(raw, ForwardPassMetrics)
+                 else ForwardPassMetrics.from_dict(raw))
+            self.latest[wid] = m
+            lbl = self._labels(wid)
+            for f in _GAUGE_FIELDS:
+                self._gauges[f].labels(*lbl).set(getattr(m, f))
+        # drop series for workers whose leases died (the watcher pruned them)
+        for gone in self._seen_workers - present:
+            self.latest.pop(gone, None)
+            lbl = self._labels(gone)
+            for g in self._gauges.values():
+                try:
+                    g.remove(*lbl)
+                except KeyError:
+                    pass
+        self._seen_workers = present
+
+    async def _hit_rate_loop(self) -> None:
+        async for msg in self._sub:
+            try:
+                d = json.loads(msg.payload)
+                lbl = self._labels(int(d["worker_id"]))
+                self.hit_isl_blocks.labels(*lbl).inc(int(d["isl_blocks"]))
+                self.hit_overlap_blocks.labels(*lbl).inc(
+                    int(d["overlap_blocks"]))
+                self.events_received += 1
+            except Exception:  # noqa: BLE001
+                logger.exception("bad hit-rate event dropped")
+
+    # ----------------------------------------------------------------- serve
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+    async def serve_http(self, host: str = "0.0.0.0",
+                         port: int = 9091):
+        """Expose GET /metrics (Prometheus text); returns the aiohttp
+        runner (caller owns cleanup)."""
+        from aiohttp import web
+
+        async def metrics(_request):
+            return web.Response(body=self.render(),
+                                content_type="text/plain")
+
+        app = web.Application()
+        app.router.add_get("/metrics", metrics)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, host, port)
+        await site.start()
+        logger.info("metrics exposition on http://%s:%d/metrics", host, port)
+        return runner
+
+
+async def amain(argv=None) -> None:
+    import argparse
+    p = argparse.ArgumentParser(
+        description="KV metrics aggregation service (Prometheus exposition)")
+    p.add_argument("endpoint", help="dyn://ns/component/endpoint to watch")
+    p.add_argument("--daemon", default="127.0.0.1:5600",
+                   help="discovery daemon host:port")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=9091)
+    p.add_argument("--scrape-interval", type=float, default=1.0)
+    args = p.parse_args(argv)
+
+    rt = await DistributedRuntime.connect(args.daemon)
+    ep = Endpoint.parse_path(rt, args.endpoint)
+    svc = await MetricsAggregatorService(
+        ep, scrape_interval=args.scrape_interval).start()
+    runner = await svc.serve_http(args.host, args.port)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await runner.cleanup()
+        await svc.close()
+        await rt.shutdown()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

@@ -19,7 +19,8 @@ from typing import Optional, Set
 
 from ...runtime.distributed import Client, Endpoint
 from ...runtime.engine import AsyncEngine, ManyOut, SingleIn
-from ..kv_router.protocols import RouterEvent
+from ..kv_router.protocols import (KV_EVENTS_SUBJECT, KV_HIT_RATE_SUBJECT,
+                                   RouterEvent)
 from ..kv_router.router import KvRouter
 from ..protocols.annotated import decode_annotated_json
 from ..protocols.common import BackendOutput
@@ -45,6 +46,8 @@ class KvRoutedEngine(AsyncEngine):
         self._tasks: list = []
         self._sub = None
         self._known_workers: Set[int] = set()
+        self._hit_component = None
+        self._pub_tasks: Set[asyncio.Task] = set()
         # observability
         self.kv_hits = 0
         self.kv_routed = 0
@@ -56,20 +59,34 @@ class KvRoutedEngine(AsyncEngine):
         client = endpoint.client(decode_resp=_decode_backend_annotated)
         router = KvRouter(block_size)
         self = cls(client, router, scrape_interval)
+        # per-decision KVHitRateEvents go out on the component's hit-rate
+        # subject for the metrics aggregation service (reference
+        # scheduler.rs:28-33 → components/metrics subscriber)
+        self._hit_component = endpoint.parent_component()
+        router.scheduler.on_hit_rate = self._publish_hit_rate
         # attach the membership callback BEFORE the watch starts so no
         # join/leave can slip between discovery replay and the hook
         client.on_instances_changed = self._instances_changed
         await client.start()
         self._known_workers |= set(client.instance_ids())
-        rt = endpoint.runtime
-        self._sub = await rt.bus.subscribe(
-            f"evt.{endpoint.namespace}.{endpoint.component}.kv_events")
+        self._sub = await self._hit_component.subscribe_event(
+            KV_EVENTS_SUBJECT)
         loop = asyncio.get_running_loop()
         self._tasks = [
             loop.create_task(self._event_loop(self._sub), name="kvr-events"),
             loop.create_task(self._scrape_loop(), name="kvr-scrape"),
         ]
         return self
+
+    def _publish_hit_rate(self, ev) -> None:
+        # keep a strong ref so the loop can't GC the task mid-flight
+        # (same discipline as EndpointServer._inflight)
+        task = asyncio.get_running_loop().create_task(
+            self._hit_component.publish_event(KV_HIT_RATE_SUBJECT,
+                                              ev.__dict__),
+            name="kvr-hit-rate-pub")
+        self._pub_tasks.add(task)
+        task.add_done_callback(self._pub_tasks.discard)
 
     # ---------------------------------------------------------------- feeds
     async def _event_loop(self, sub) -> None:
@@ -129,6 +146,8 @@ class KvRoutedEngine(AsyncEngine):
     async def close(self) -> None:
         if self._sub is not None:
             self._sub.close()
+        if self._pub_tasks:  # flush in-flight hit-rate publishes
+            await asyncio.gather(*self._pub_tasks, return_exceptions=True)
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:

@@ -217,6 +217,9 @@ class Endpoint:
     component: str
     name: str
 
+    def parent_component(self) -> Component:
+        return Component(self.runtime, self.namespace, self.component)
+
     # naming (reference component.rs:246-257 / component/endpoint.rs:110-137)
     def discovery_prefix(self) -> str:
         return f"{self.namespace}/components/{self.component}/{self.name}:"
@@ -397,6 +400,8 @@ class EndpointServer:
         if self.lease is not None:
             await rt.bus.unserve(self.endpoint.subject(self.lease.id))
             await rt.store.kv_delete(self.endpoint.discovery_key(self.lease.id))
+            if self._stats_task is not None:
+                await rt.store.kv_delete(self.endpoint.stats_key(self.lease.id))
         if self in rt._servers:
             rt._servers.remove(self)
 

@@ -1,0 +1,108 @@
+"""C ABI KV-event publisher parity (csrc/kv_event_abi.cpp via ctypes).
+
+Reference test tier: lib/bindings/python/tests/test_kv_bindings.py:68-215 —
+a ctypes publisher and the in-process publisher feed ONE indexer and must
+produce identical overlap scores. Skips when no C++ toolchain is present.
+"""
+
+import pytest
+
+from dynamo_tpu.llm.kv.blocks import compute_block_hashes, hash_tokens
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+
+c_abi = pytest.importorskip("dynamo_tpu.llm.kv_router.c_abi")
+
+BS = 4
+
+
+@pytest.fixture
+def abi():
+    try:
+        pub = c_abi.CtypesKvEventPublisher("testns", "worker", 111, BS)
+    except RuntimeError as e:
+        pytest.skip(f"native ABI unavailable: {e}")
+    yield pub
+    pub.shutdown()
+
+
+def _blocks(tokens):
+    """(blocks_tokens, chained_hashes) for a token stream, as the engine
+    would pass them to the ABI."""
+    blocks = [list(tokens[i:i + BS]) for i in range(0, len(tokens), BS)]
+    return blocks, compute_block_hashes(tokens, BS)
+
+
+@pytest.mark.asyncio
+async def test_ctypes_and_python_publishers_agree(abi):
+    indexer = KvIndexer(block_size=BS)
+
+    async def sink(ev):
+        indexer.apply_event(ev)
+
+    prompt = list(range(100, 100 + 3 * BS))
+    blocks_tokens, seq_hashes = _blocks(prompt)
+
+    # worker 111 → C ABI path
+    rc = abi.publish_stored(1, blocks_tokens, seq_hashes, parent_hash=None)
+    assert rc == c_abi.DYN_OK
+    drained = await abi.drain_pending(sink)
+    assert drained == 1
+
+    # worker 222 → in-process python path, same blocks
+    py_pub = KvEventPublisher(worker_id=222, sink=sink)
+    parent = None
+    for blk, seq_hash in zip(blocks_tokens, seq_hashes):
+        py_pub.publish_stored(0, seq_hash, hash_tokens(blk), parent)
+        parent = seq_hash
+    await py_pub.drain()
+
+    scores = indexer.find_matches_for_request(prompt).scores
+    assert scores == {111: 3, 222: 3}
+
+    # partial prefix → both still agree
+    scores = indexer.find_matches_for_request(prompt[:BS * 2]).scores
+    assert scores == {111: 2, 222: 2}
+
+
+@pytest.mark.asyncio
+async def test_ctypes_removed_prunes(abi):
+    indexer = KvIndexer(block_size=BS)
+
+    async def sink(ev):
+        indexer.apply_event(ev)
+
+    prompt = list(range(7, 7 + 2 * BS))
+    blocks_tokens, seq_hashes = _blocks(prompt)
+    assert abi.publish_stored(1, blocks_tokens, seq_hashes) == c_abi.DYN_OK
+    await abi.drain_pending(sink)
+    assert indexer.find_matches_for_request(prompt).scores == {111: 2}
+
+    # evict the tail block → overlap shrinks to the surviving prefix
+    assert abi.publish_removed(2, [seq_hashes[-1]]) == c_abi.DYN_OK
+    await abi.drain_pending(sink)
+    assert indexer.find_matches_for_request(prompt).scores == {111: 1}
+
+
+def test_tokens_hashes_match_engine_hashing(abi):
+    blocks_tokens, seq_hashes = _blocks(list(range(40, 40 + 2 * BS)))
+    assert abi.publish_stored(5, blocks_tokens, seq_hashes) == c_abi.DYN_OK
+    ev = abi.poll()
+    assert ev is not None and ev.stored is not None
+    assert ev.worker_id == 111 and ev.event_id == 5
+    assert ev.stored.block_hashes == seq_hashes
+    assert ev.stored.tokens_hashes == [hash_tokens(b) for b in blocks_tokens]
+    assert abi.poll() is None
+
+
+def test_abi_error_codes(abi):
+    # double init (global singleton, as in the reference cdylib)
+    rc = abi.lib.dynamo_llm_init(b"x", b"y", 1, 4)
+    assert rc == 3  # ALREADY_INITIALIZED
+    info = abi.info()
+    assert info == {"namespace": "testns", "component": "worker",
+                    "worker_id": 111, "kv_block_size": BS}
+    # publish after shutdown → UNINITIALIZED; re-init for the fixture teardown
+    abi.shutdown()
+    assert abi.publish_removed(1, [1, 2]) == 2
+    assert abi.lib.dynamo_llm_init(b"testns", b"worker", 111, BS) == 0
