@@ -78,7 +78,10 @@ class ModelConfig:
             rope_theta=float(cfg.get("rope_theta", 10000.0)),
             rope_scaling=rs,
             tie_word_embeddings=bool(cfg.get("tie_word_embeddings", False)),
-            attention_bias=bool(cfg.get("attention_bias", False)),
+            # HF Qwen2 hardcodes qkv bias in the modeling code and ships no
+            # attention_bias key, so default it on for that family
+            attention_bias=bool(cfg.get(
+                "attention_bias", cfg.get("model_type") == "qwen2")),
             num_experts=int(cfg.get("num_local_experts", 0) or
                             cfg.get("num_experts", 0) or 0),
             num_experts_per_tok=int(cfg.get("num_experts_per_tok", 2)),

@@ -84,6 +84,34 @@ def swiglu(x: jax.Array, gate_w: jax.Array, up_w: jax.Array,
     return (jax.nn.silu(x @ gate_w) * (x @ up_w)) @ down_w
 
 
+def moe_mlp(x: jax.Array, router_w: jax.Array, gate_w: jax.Array,
+            up_w: jax.Array, down_w: jax.Array, top_k: int) -> jax.Array:
+    """Mixtral-style sparse MoE MLP, computed densely over the expert axis.
+
+    x: [N, D]; router_w: [D, E]; gate/up: [E, D, F]; down: [E, F, D].
+    Routing weights are softmax over the top-k router logits (HF Mixtral
+    convention: normalize AFTER the top-k cut). The expert einsums keep E as
+    a contracted/batched axis, so sharding E over the mesh "ep" axis makes
+    XLA compute E/ep experts per device and psum the combine — expert
+    parallelism as a compiler layout, no explicit dispatch.
+
+    Dense compute trades FLOPs (E/top_k× the active-expert cost) for static
+    shapes — the right call for serving-batch sizes where a GShard-style
+    sort/permute dispatch would be latency-bound on reshuffles anyway.
+    """
+    N, E = x.shape[0], router_w.shape[-1]
+    logits = (x @ router_w).astype(jnp.float32)                  # [N, E]
+    top_logits, top_idx = jax.lax.top_k(logits, top_k)           # [N, k]
+    top_w = jax.nn.softmax(top_logits, axis=-1)                  # [N, k]
+    combine = jnp.sum(
+        jax.nn.one_hot(top_idx, E, dtype=jnp.float32)
+        * top_w[..., None], axis=1)                              # [N, E]
+    g = jnp.einsum("nd,edf->enf", x, gate_w)
+    u = jnp.einsum("nd,edf->enf", x, up_w)
+    y = jnp.einsum("enf,efd->end", jax.nn.silu(g) * u, down_w)   # [E, N, D]
+    return jnp.einsum("ne,end->nd", combine.astype(y.dtype), y)
+
+
 # ---------------------------------------------------------------------------
 # Parameter init / shapes
 # ---------------------------------------------------------------------------
@@ -101,10 +129,30 @@ def param_shapes(cfg: ModelConfig) -> Dict[str, Tuple[int, ...]]:
         "layers.wk": (L, D, KVH * Dh),
         "layers.wv": (L, D, KVH * Dh),
         "layers.wo": (L, H * Dh, D),
-        "layers.gate": (L, D, F),
-        "layers.up": (L, D, F),
-        "layers.down": (L, F, D),
     }
+    if cfg.num_experts > 0:
+        # mixtral-style sparse MoE MLP (experts stacked on axis 1, sharded
+        # over the mesh "ep" axis — parallel/sharding.py param_pspecs)
+        E = cfg.num_experts
+        shapes.update({
+            "layers.router": (L, D, E),
+            "layers.moe_gate": (L, E, D, F),
+            "layers.moe_up": (L, E, D, F),
+            "layers.moe_down": (L, E, F, D),
+        })
+    else:
+        shapes.update({
+            "layers.gate": (L, D, F),
+            "layers.up": (L, D, F),
+            "layers.down": (L, F, D),
+        })
+    if cfg.attention_bias:  # qwen2-style qkv biases
+        shapes["layers.bq"] = (L, H * Dh)
+        shapes["layers.bk"] = (L, KVH * Dh)
+        shapes["layers.bv"] = (L, KVH * Dh)
+    if cfg.qk_norm:  # qwen3-style per-head q/k rms norm
+        shapes["layers.q_norm"] = (L, Dh)
+        shapes["layers.k_norm"] = (L, Dh)
     if not cfg.tie_word_embeddings:
         shapes["lm_head"] = (D, cfg.vocab_size)
     return shapes
@@ -115,8 +163,11 @@ def init_params(cfg: ModelConfig, key: jax.Array,
     params: Params = {}
     for name, shape in param_shapes(cfg).items():
         key, sub = jax.random.split(key)
-        if name.endswith(("ln1", "ln2")) or name == "final_norm":
+        if name.endswith(("ln1", "ln2", "q_norm", "k_norm")) \
+                or name == "final_norm":
             params[name] = jnp.ones(shape, dtype=dtype)
+        elif name.endswith(("bq", "bk", "bv")):
+            params[name] = jnp.zeros(shape, dtype=dtype)
         else:
             fan_in = shape[-2] if len(shape) > 1 else shape[-1]
             params[name] = (jax.random.normal(sub, shape, dtype=jnp.float32)
@@ -174,9 +225,15 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
         h = carry
         lp, k_l, v_l = xs["lp"], xs["k"], xs["v"]
         hn = rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
-        q = (hn @ lp["wq"]).reshape(N, cfg.num_heads, cfg.head_dim)
-        k = (hn @ lp["wk"]).reshape(N, cfg.num_kv_heads, cfg.head_dim)
-        v = (hn @ lp["wv"]).reshape(N, cfg.num_kv_heads, cfg.head_dim)
+        q, k, v = hn @ lp["wq"], hn @ lp["wk"], hn @ lp["wv"]
+        if cfg.attention_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(N, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(N, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(N, cfg.num_kv_heads, cfg.head_dim)
+        if cfg.qk_norm:
+            q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+            k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         k_l = k_l.at[:, slots, :].set(k.transpose(1, 0, 2).astype(k_l.dtype),
@@ -186,7 +243,11 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
         attn = attn_fn(q, k, v, k_l, v_l)
         h = h + attn.reshape(N, -1) @ lp["wo"]
         hn2 = rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
-        h = h + swiglu(hn2, lp["gate"], lp["up"], lp["down"])
+        if cfg.num_experts > 0:
+            h = h + moe_mlp(hn2, lp["router"], lp["moe_gate"], lp["moe_up"],
+                            lp["moe_down"], cfg.num_experts_per_tok)
+        else:
+            h = h + swiglu(hn2, lp["gate"], lp["up"], lp["down"])
         return h, (k_l, v_l)
 
     x, (k_new, v_new) = jax.lax.scan(

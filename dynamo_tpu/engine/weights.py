@@ -36,7 +36,19 @@ _LAYER_MAP = {
     "mlp.gate_proj.weight": ("gate", True),
     "mlp.up_proj.weight": ("up", True),
     "mlp.down_proj.weight": ("down", True),
+    # qwen2-style attention biases
+    "self_attn.q_proj.bias": ("bq", False),
+    "self_attn.k_proj.bias": ("bk", False),
+    "self_attn.v_proj.bias": ("bv", False),
+    # qwen3-style per-head q/k norms
+    "self_attn.q_norm.weight": ("q_norm", False),
+    "self_attn.k_norm.weight": ("k_norm", False),
+    # mixtral MoE router
+    "block_sparse_moe.gate.weight": ("router", True),
 }
+
+# mixtral expert sub-weights: w1=gate, w3=up, w2=down (all torch [out, in])
+_EXPERT_MAP = {"w1": "moe_gate", "w3": "moe_up", "w2": "moe_down"}
 
 
 def _iter_safetensors(model_dir: str):
@@ -55,8 +67,9 @@ def load_llama_params(model_dir: str, cfg: Optional[ModelConfig] = None,
     if not _HAVE_ST:
         raise RuntimeError("safetensors not available")
     cfg = cfg or ModelConfig.from_model_dir(model_dir)
-    L = cfg.num_layers
+    L, E = cfg.num_layers, cfg.num_experts
     staging: Dict[str, list] = {}
+    expert_staging: Dict[str, list] = {}   # key → [L][E] tensors
     singles: Dict[str, np.ndarray] = {}
     for name, tensor in _iter_safetensors(model_dir):
         if name == "model.embed_tokens.weight":
@@ -68,9 +81,20 @@ def load_llama_params(model_dir: str, cfg: Optional[ModelConfig] = None,
         elif name.startswith("model.layers."):
             rest = name[len("model.layers."):]
             idx_str, sub = rest.split(".", 1)
+            if sub.startswith("block_sparse_moe.experts."):
+                # block_sparse_moe.experts.{e}.w{1,2,3}.weight
+                e_str, wname, _ = sub[len("block_sparse_moe.experts."):].split(
+                    ".", 2)
+                key = _EXPERT_MAP.get(wname)
+                if key is None:
+                    continue
+                grid = expert_staging.setdefault(
+                    key, [[None] * E for _ in range(L)])
+                grid[int(idx_str)][int(e_str)] = tensor.T
+                continue
             mapped = _LAYER_MAP.get(sub)
             if mapped is None:
-                continue  # rotary inv_freq buffers, biases handled elsewhere
+                continue  # rotary inv_freq buffers etc.
             key, transpose = mapped
             arr = tensor.T if transpose else tensor
             staging.setdefault(key, [None] * L)[int(idx_str)] = arr
@@ -84,6 +108,15 @@ def load_llama_params(model_dir: str, cfg: Optional[ModelConfig] = None,
             raise ValueError(f"checkpoint missing layers {missing} for {key}")
         params[f"layers.{key}"] = jnp.asarray(
             np.stack(per_layer, axis=0), dtype=dtype)
+    for key, grid in expert_staging.items():
+        missing = [(i, j) for i, row in enumerate(grid)
+                   for j, a in enumerate(row) if a is None]
+        if missing:
+            raise ValueError(f"checkpoint missing experts {missing[:4]}… "
+                             f"for {key}")
+        params[f"layers.{key}"] = jnp.asarray(
+            np.stack([np.stack(row, axis=0) for row in grid], axis=0),
+            dtype=dtype)
     if "lm_head" not in params and not cfg.tie_word_embeddings:
         # some checkpoints tie implicitly by omitting lm_head
         cfg.tie_word_embeddings = True
@@ -109,10 +142,22 @@ def save_hf_style(params: Dict[str, jax.Array], cfg: ModelConfig,
     if "lm_head" in params:
         out["lm_head.weight"] = c(np.asarray(params["lm_head"], np.float32).T)
     inv = {v[0]: (k, v[1]) for k, v in _LAYER_MAP.items()}
+    inv_experts = {v: k for k, v in _EXPERT_MAP.items()}
     for key, (hf_sub, transpose) in inv.items():
+        if f"layers.{key}" not in params:
+            continue
         stacked = np.ascontiguousarray(
             np.asarray(params[f"layers.{key}"], np.float32))
         for i in range(stacked.shape[0]):
             arr = stacked[i].T if transpose else stacked[i]
             out[f"model.layers.{i}.{hf_sub}"] = np.ascontiguousarray(arr)
+    for key, wname in inv_experts.items():
+        if f"layers.{key}" not in params:
+            continue
+        stacked = np.asarray(params[f"layers.{key}"], np.float32)  # [L,E,..]
+        for i in range(stacked.shape[0]):
+            for e in range(stacked.shape[1]):
+                out[(f"model.layers.{i}.block_sparse_moe.experts."
+                     f"{e}.{wname}.weight")] = np.ascontiguousarray(
+                         stacked[i, e].T)
     save_file(out, os.path.join(out_dir, "model.safetensors"))

@@ -35,7 +35,8 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native LLM serving launcher (in=SRC out=ENGINE)")
     p.add_argument("io", nargs="*", metavar="in=|out=",
                    help="in=http|text|stdin|batch:F|dyn://ns/c/e|none "
-                        "out=jax|echo_core|echo_full|dyn://ns/c/e")
+                        "out=jax|echo_core|echo_full|pystr:F|pytok:F|"
+                        "dyn://ns/c/e")
     p.add_argument("--model-path", help="HF-style model dir (config.json, "
                                         "tokenizer.json, safetensors)")
     p.add_argument("--model-name", help="served model name "
@@ -65,6 +66,8 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="sp")
     p.add_argument("--data-parallel-size", "--dp", type=int, default=1,
                    dest="dp")
+    p.add_argument("--expert-parallel-size", "--ep", type=int, default=1,
+                   dest="ep")
     # routing / disagg
     p.add_argument("--router-mode", choices=["random", "round_robin"],
                    default="random")
@@ -119,7 +122,7 @@ def engine_config(args):
         max_num_seqs=args.max_num_seqs,
         enable_prefix_reuse=not args.no_prefix_reuse,
         host_kv_blocks=args.host_kv_blocks,
-        tp=args.tp, sp=args.sp, dp=args.dp)
+        tp=args.tp, sp=args.sp, dp=args.dp, ep=args.ep)
 
 
 def _model_name(args) -> str:
@@ -145,6 +148,20 @@ async def build_engine(args, out: str, runtime):
         mdc = ModelDeploymentCard.from_local_path(
             args.model_path, display_name=_model_name(args))
         return EchoEngineCore(), mdc, None
+    if out.startswith("pystr:") or out.startswith("pytok:"):
+        # user python-file engines (reference engines/python.rs:57-354)
+        from ..llm.engines.python_file import (PythonFileEngineCore,
+                                               PythonFileEngineFull)
+        kind, _, path = out.partition(":")
+        engine_args = {"model_path": args.model_path,
+                       "model_name": _model_name(args)}
+        if kind == "pystr":
+            return PythonFileEngineFull(path, engine_args), None, None
+        if not args.model_path:
+            raise SystemExit("out=pytok needs --model-path (tokenizer)")
+        mdc = ModelDeploymentCard.from_local_path(
+            args.model_path, display_name=_model_name(args))
+        return PythonFileEngineCore(path, engine_args), mdc, None
     if out.startswith("dyn://") or out.count(".") == 2:
         from ..llm.engines.remote import RemoteEngine
         from ..runtime.distributed import Endpoint
@@ -162,9 +179,10 @@ async def build_engine(args, out: str, runtime):
         mdc = ModelDeploymentCard.from_local_path(
             args.model_path, display_name=_model_name(args))
         mesh = None
-        if args.tp * args.sp * args.dp > 1:
+        if args.tp * args.sp * args.dp * args.ep > 1:
             from ..parallel.sharding import make_mesh
-            mesh = make_mesh(dp=args.dp, tp=args.tp, sp=args.sp)
+            mesh = make_mesh(dp=args.dp, tp=args.tp, sp=args.sp,
+                             ep=args.ep)
         model_cfg = ModelConfig.from_model_dir(args.model_path)
         params = None
         if not args.random_weights:

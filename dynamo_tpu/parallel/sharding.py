@@ -52,12 +52,20 @@ def param_pspecs(cfg: ModelConfig) -> Dict[str, P]:
         "layers.wk": P(None, None, "tp"),
         "layers.wv": P(None, None, "tp"),
         "layers.wo": P(None, "tp", None),
-        "layers.gate": P(None, None, "tp"),
-        "layers.up": P(None, None, "tp"),
-        "layers.down": P(None, "tp", None),
     }
+    if cfg.num_experts == 0:
+        specs.update({
+            "layers.gate": P(None, None, "tp"),
+            "layers.up": P(None, None, "tp"),
+            "layers.down": P(None, "tp", None),
+        })
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, "tp")
+    if cfg.attention_bias:
+        # biases follow their projection's column sharding
+        specs.update({"layers.bq": P(None, "tp"),
+                      "layers.bk": P(None, "tp"),
+                      "layers.bv": P(None, "tp")})
     if cfg.num_experts > 0:
         specs.update({
             "layers.router": P(None, None, None),
