@@ -114,7 +114,16 @@ async def amain(argv=None) -> None:
     allocator = TpuAllocator(total_chips=args.total_chips)
     watchers: List[Watcher] = []
     for svc in graph:
-        alloc = allocator.allocate(svc.name, svc.resources.tpu)
+        # YAML `resources: {tpu: n}` overrides the class declaration — e.g.
+        # a TpuWorker running its echo engine needs no chips (the reference
+        # reads resources from the service config the same way,
+        # cli/allocator.py:28-120)
+        res = cfg.get(svc.name, "resources") or {}
+        if "tpu" in res or "gpu" in res:     # same aliasing as @service
+            want = int(res.get("tpu", res.get("gpu", 0)) or 0)
+        else:
+            want = svc.resources.tpu
+        alloc = allocator.allocate(svc.name, want)
         env = {ENV_VAR: cfg.to_env(), **alloc.env()}
         watchers.append(Watcher(args.target, svc.name, runtime_server, env))
 

@@ -67,8 +67,12 @@ async def serve_service(svc: DynamoService, runtime: DistributedRuntime
     """Bind + serve one service instance. Returns the instance (the caller
     owns the serve-forever wait)."""
     instance = svc.instantiate()
-    # config injection (DYNAMO_SERVICE_CONFIG → instance.config)
+    # config injection (DYNAMO_SERVICE_CONFIG → instance.config) and the
+    # runtime handle (the reference's @dynamo_worker passes the
+    # DistributedRuntime into the service, cli/serve_dynamo.py:61-190) —
+    # on-start hooks need it for KV event publishers, prefill queues, etc.
     instance.config = ServiceConfig.get_instance().for_service(svc.name)
+    instance.runtime = runtime
     # dependency resolution
     for attr, dep in svc.dependencies.items():
         setattr(instance, attr,

@@ -105,8 +105,16 @@ class DynamoService:
         return other
 
     def graph(self) -> List["DynamoService"]:
-        """Every service reachable from this entry via deps ∪ links, in
-        discovery (BFS) order — what the serve CLI deploys."""
+        """Every service reachable from this entry, in discovery (BFS)
+        order — what the serve CLI deploys.
+
+        A service with explicit ``link()`` edges contributes only those:
+        its unused ``depends()`` are pruned (the reference's LinkedServices
+        ``remove_unused_edges``, lib/service.py:30-241) — a Processor may
+        declare `router = depends(Router)` yet an `agg` graph that never
+        links Router won't launch one. A service without links contributes
+        all its deps, so partially linked graphs still deploy every
+        depended-on service."""
         seen: List[DynamoService] = []
         queue = [self]
         while queue:
@@ -114,8 +122,10 @@ class DynamoService:
             if svc in seen or not svc.enabled:
                 continue
             seen.append(svc)
-            queue.extend(d.on for d in svc.dependencies.values())
-            queue.extend(svc.links)
+            if svc.links:
+                queue.extend(svc.links)
+            else:
+                queue.extend(d.on for d in svc.dependencies.values())
         return seen
 
     def instantiate(self) -> Any:

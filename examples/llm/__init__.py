@@ -1,0 +1,8 @@
+"""The LLM serving reference graphs — the TPU equivalent of the reference's
+`examples/llm/` disaggregated-serving example (SURVEY.md §2.6): SDK services
+Frontend / Processor / Router / TpuWorker / PrefillWorker composed into
+`agg`, `agg_router`, `disagg`, `disagg_router` deployment graphs.
+
+    python -m dynamo_tpu.sdk.serve examples.llm.graphs.agg:Frontend \
+        -f examples/llm/configs/agg.yaml --runtime-server HOST:PORT
+"""

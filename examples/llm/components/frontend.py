@@ -1,0 +1,58 @@
+"""Frontend — the OpenAI HTTP entry of the LLM graph.
+
+Reference: examples/llm/components/frontend.py (83 LoC) — spawns the HTTP
+frontend configured to forward `/v1/chat/completions` to the Processor
+component. Ours hosts the library HttpService in-process and bridges each
+OpenAI request to the Processor dependency's `chat`/`completions` endpoints.
+
+Config keys (``Frontend`` section):
+    model_name: str  (served model name; default "model")
+    port: int        (default 8080; 0 → ephemeral, bound port on self.http.port)
+    host: str        (default 0.0.0.0)
+"""
+
+from __future__ import annotations
+
+from dynamo_tpu.llm.http import HttpService
+from dynamo_tpu.llm.protocols.annotated import Annotated
+from dynamo_tpu.runtime.engine import (AsyncEngine, ManyOut, ResponseStream,
+                                       SingleIn)
+from dynamo_tpu.sdk import async_on_start, depends, service
+
+from .processor import Processor
+
+
+class _ProcessorEngine(AsyncEngine):
+    """AsyncEngine[openai dict → Annotated[chunk]] over the Processor dep."""
+
+    def __init__(self, dep, endpoint: str):
+        self.dep = dep
+        self.endpoint = endpoint
+
+    async def generate(self, request: SingleIn) -> ManyOut:
+        stream = await self.dep.call(self.endpoint, request.data)
+
+        async def decode():
+            async for item in stream:
+                yield Annotated(**item) if isinstance(item, dict) else item
+
+        return ResponseStream(decode(), request.ctx)
+
+
+@service(dynamo={"namespace": "dynamo"})
+class Frontend:
+    processor = depends(Processor)
+
+    @async_on_start
+    async def async_init(self):
+        cfg = self.config
+        name = cfg.get("model_name", "model")
+        self.http = HttpService(port=int(cfg.get("port", 8080)),
+                                host=cfg.get("host", "0.0.0.0"))
+        self.http.manager.add_chat_model(
+            name, _ProcessorEngine(self.processor, "chat"))
+        self.http.manager.add_completion_model(
+            name, _ProcessorEngine(self.processor, "completions"))
+        # start() leaves the aiohttp site serving; the serve_worker process
+        # owns the serve-forever wait
+        await self.http.start()

@@ -1,0 +1,50 @@
+"""Router — the KV-aware worker-selection service of the LLM graph.
+
+Reference: examples/llm/components/kv_router.py:66-238 — a service that
+feeds a radix-tree indexer from the workers' `kv_events` and combines
+prefix-overlap with scraped ForwardPassMetrics into a per-request worker
+choice; the Processor calls it *before* dispatch and then uses
+``client.direct(worker_id)``. The cost model lives in
+dynamo_tpu.llm.kv_router (indexer/scheduler/scoring); this service is the
+thin endpoint wrapper around the shared KvRoutedEngine machinery.
+"""
+
+from __future__ import annotations
+
+from dynamo_tpu.llm.engines.kv_routed import KvRoutedEngine
+from dynamo_tpu.runtime.distributed import Endpoint
+from dynamo_tpu.sdk import async_on_start, dynamo_endpoint, service
+
+
+@service(dynamo={"namespace": "dynamo"})
+class Router:
+    """Endpoint ``find_worker``: {"token_ids": [...]} → one item
+    {"worker_id": lease-id | None, "overlap_blocks": n, "prefix_hit_len": n}.
+    """
+
+    @async_on_start
+    async def async_init(self):
+        cfg = self.config
+        worker_endpoint = Endpoint(
+            self.runtime, "dynamo",
+            cfg.get("worker_component", "TpuWorker"),
+            cfg.get("worker_endpoint", "generate"))
+        # KvRoutedEngine owns the kv_events subscription, the metrics scrape
+        # loop, worker-membership pruning, and hit-rate event publication —
+        # the Router service only uses its schedule() half, never dispatch.
+        self.kv = await KvRoutedEngine.start(
+            worker_endpoint,
+            block_size=int(cfg.get("kv_block_size", 16)),
+            scrape_interval=float(cfg.get("scrape_interval", 1.0)))
+
+    @dynamo_endpoint()
+    async def find_worker(self, request):
+        tokens = list(request["token_ids"])
+        pick = self.kv.router.schedule(tokens)
+        if pick is None:
+            yield {"worker_id": None, "overlap_blocks": 0,
+                   "prefix_hit_len": 0}
+            return
+        worker_id, overlap_blocks = pick
+        yield {"worker_id": worker_id, "overlap_blocks": overlap_blocks,
+               "prefix_hit_len": overlap_blocks * self.kv.router.block_size}

@@ -1,0 +1,35 @@
+"""PrefillWorker — the prefill side of disaggregated serving.
+
+Reference: examples/llm/components/prefill_worker.py:36-141 — pulls the
+prefill queue, runs prefill with remote-decode semantics, ships the computed
+KV back to the decode worker. The pull loop, KV handoff framing, and ack
+logic live in dynamo_tpu.llm.disagg.PrefillWorker; this service just hosts
+an engine core for it.
+
+Config keys (``PrefillWorker`` section):
+    model_path: DIR     (required)
+    kv_block_size: int  (default 16; must match decode workers)
+    max_slots: int
+"""
+
+from __future__ import annotations
+
+from dynamo_tpu.sdk import async_on_start, service
+
+
+@service(dynamo={"namespace": "dynamo"}, resources={"tpu": 1})
+class PrefillWorker:
+    """No request-plane endpoint: work arrives via the prefill queue
+    (reference: the NATS JetStream `prefill_queue` stream, §3.3)."""
+
+    @async_on_start
+    async def async_init(self):
+        cfg = self.config
+        from dynamo_tpu.engine.config import EngineConfig
+        from dynamo_tpu.llm.disagg import PrefillWorker as PrefillLoop
+        from dynamo_tpu.llm.engines.jax_engine import JaxEngine
+
+        ecfg = EngineConfig(kv_block_size=int(cfg.get("kv_block_size", 16)),
+                            max_slots=int(cfg.get("max_slots", 8)))
+        eng = JaxEngine.from_model_dir(cfg["model_path"], engine_cfg=ecfg)
+        self.loop = await PrefillLoop(eng.core, self.runtime).start()

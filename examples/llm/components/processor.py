@@ -1,0 +1,108 @@
+"""Processor — chat/completions pre/post-processing + routed dispatch.
+
+Reference: examples/llm/components/processor.py (208 LoC) +
+utils/chat_processor.py — tokenize the OpenAI request, ask the Router for a
+KV-overlap-ranked worker (or fall back to round-robin), dispatch with
+``client.direct``, then detokenize the token stream back into OpenAI chunks.
+The pre/post stages are the library's OpenAIPreprocessor and Backend
+operators (SURVEY.md §2.2), linked over a dispatch sink that speaks the
+token protocol to the TpuWorker dependency.
+
+Config keys (``Processor`` section):
+    model_path: DIR           (tokenizer + chat template source; required)
+    model_name: str           (served model name; default basename)
+    router: kv | round-robin  (default round-robin)
+    kv_block_size: int        (default 16; must match workers)
+"""
+
+from __future__ import annotations
+
+import os
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.protocols.annotated import Annotated
+from dynamo_tpu.llm.protocols.common import BackendOutput
+from dynamo_tpu.runtime import Context, link
+from dynamo_tpu.runtime.engine import (AsyncEngine, ManyOut, ResponseStream,
+                                       SingleIn)
+from dynamo_tpu.sdk import async_on_start, depends, dynamo_endpoint, service
+
+from .kv_router import Router
+from .worker import TpuWorker
+
+
+class _RoutedDispatch(AsyncEngine):
+    """Pipeline sink: PreprocessedRequest → (Router pick?) → worker dep →
+    Annotated[BackendOutput] stream."""
+
+    def __init__(self, worker, router, use_kv: bool):
+        self.worker = worker          # DependencyClient(TpuWorker)
+        self.router = router          # DependencyClient(Router) | None
+        self.use_kv = use_kv
+        self.kv_routed = 0
+        self.fallback_routed = 0
+
+    async def generate(self, request: SingleIn) -> ManyOut:
+        pre = request.data
+        instance_id = None
+        if self.use_kv and self.router is not None:
+            picks = await self.router.find_worker(
+                {"token_ids": list(pre.token_ids)})
+            async for pick in picks:
+                if pick.get("worker_id") is not None:
+                    instance_id = pick["worker_id"]
+                    pre.estimated_prefix_hit_blocks = pick["overlap_blocks"]
+                    pre.prefix_hit_len = pick["prefix_hit_len"]
+        if instance_id is not None:
+            self.kv_routed += 1
+        else:
+            self.fallback_routed += 1
+        stream = await self.worker.call("generate", Context(pre),
+                                        instance_id=instance_id)
+
+        async def decode():
+            async for item in stream:
+                ann = Annotated(**item) if isinstance(item, dict) else item
+                if isinstance(ann.data, dict):
+                    ann = ann.map_data(BackendOutput.from_dict)
+                yield ann
+
+        return ResponseStream(decode(), request.ctx)
+
+
+@service(dynamo={"namespace": "dynamo"})
+class Processor:
+    worker = depends(TpuWorker)
+    router = depends(Router)
+
+    @async_on_start
+    async def async_init(self):
+        cfg = self.config
+        model_path = cfg["model_path"]
+        self.model_name = cfg.get("model_name") or os.path.basename(
+            os.path.normpath(model_path))
+        mdc = ModelDeploymentCard.from_local_path(
+            model_path, display_name=self.model_name)
+        self.mdc = mdc
+        use_kv = cfg.get("router", "round-robin") == "kv"
+        self.dispatch = _RoutedDispatch(
+            self.worker, self.router if use_kv else None, use_kv)
+        self.pipeline = link(OpenAIPreprocessor(mdc), Backend(mdc),
+                             self.dispatch)
+
+    async def _run(self, request):
+        stream = await self.pipeline.generate(Context(request))
+        async for ann in stream:
+            yield ann.to_json_dict() if isinstance(ann, Annotated) else ann
+
+    @dynamo_endpoint()
+    async def chat(self, request):
+        async for item in self._run(request):
+            yield item
+
+    @dynamo_endpoint()
+    async def completions(self, request):
+        async for item in self._run(request):
+            yield item

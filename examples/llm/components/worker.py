@@ -1,0 +1,113 @@
+"""TpuWorker — the decode worker service of the LLM reference graph.
+
+Reference: examples/llm/components/worker.py:37-189 (VllmWorker): a
+token-protocol engine worker that publishes KV events + ForwardPassMetrics
+and, when remote prefill is enabled, routes long prompts through the prefill
+queue. Ours hosts the in-process JAX engine (or the echo engine for
+zero-hardware runs) instead of a patched vLLM subprocess.
+
+Config keys (YAML service section ``TpuWorker``):
+    engine: echo | jax        (default echo — no model/TPU needed)
+    model_path: DIR           (required for engine: jax)
+    kv_block_size: int        (default 16)
+    remote_prefill: bool      (default false — jax only; enables DisaggEngine)
+    conditional_disagg: bool  (default true when remote_prefill)
+    max_local_prefill_length: int (default 64)
+    max_slots: int            (jax engine batch slots)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from dynamo_tpu.llm.kv.blocks import TokenBlockSequence
+from dynamo_tpu.llm.kv_router.protocols import (KV_EVENTS_SUBJECT,
+                                                ForwardPassMetrics)
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+from dynamo_tpu.runtime import Context
+from dynamo_tpu.sdk import async_on_start, dynamo_endpoint, service
+
+
+@service(dynamo={"namespace": "dynamo"}, resources={"tpu": 1})
+class TpuWorker:
+    """Serves `generate` under the token protocol: request is a
+    PreprocessedRequest dict, responses are Annotated[BackendOutput] dicts."""
+
+    @async_on_start
+    async def async_init(self):
+        cfg = self.config
+        self.block_size = int(cfg.get("kv_block_size", 16))
+        lease = await self.runtime.primary_lease()
+        component = self.runtime.namespace("dynamo").component("TpuWorker")
+
+        async def sink(ev) -> None:
+            await component.publish_event(KV_EVENTS_SUBJECT, ev)
+
+        self.kv_publisher = KvEventPublisher(worker_id=lease.id, sink=sink)
+
+        kind = cfg.get("engine", "echo")
+        if kind == "jax":
+            self.engine = self._build_jax_engine(cfg)
+        else:
+            from dynamo_tpu.llm.engines.echo import EchoEngineCore
+            self.engine = EchoEngineCore()
+        self._metrics = ForwardPassMetrics(
+            request_active_slots=0,
+            request_total_slots=int(cfg.get("max_slots", 8)),
+            kv_active_blocks=0, kv_total_blocks=1024)
+        self.stats_handler = self._stats
+
+    def _build_jax_engine(self, cfg):
+        from dynamo_tpu.engine.config import EngineConfig
+        from dynamo_tpu.llm.engines.jax_engine import JaxEngine
+
+        ecfg = EngineConfig(kv_block_size=self.block_size,
+                            max_slots=int(cfg.get("max_slots", 8)))
+        eng = JaxEngine.from_model_dir(cfg["model_path"], engine_cfg=ecfg)
+        if cfg.get("remote_prefill"):
+            from dynamo_tpu.llm.disagg import (DisaggEngine,
+                                               DisaggregatedRouter)
+            router = DisaggregatedRouter(
+                self.runtime, cfg.get("model_name", "model"),
+                max_local_prefill_length=int(
+                    cfg.get("max_local_prefill_length", 64)),
+                conditional=bool(cfg.get("conditional_disagg", True)))
+            eng = DisaggEngine(eng.core, self.runtime, router)
+        # engine-side KV event publication: reuse-pool store/evict →
+        # router radix tree (reference call stack §3.5)
+        eng.core.kv_event_publisher = self.kv_publisher
+        eng.core.kv_manager.pool.on_stored = self.kv_publisher.publish_stored
+        eng.core.kv_manager.pool.on_removed = self.kv_publisher.publish_removed
+        return eng
+
+    def _stats(self) -> dict:
+        core = getattr(self.engine, "core", None)
+        if core is not None:
+            return core.metrics().to_dict()
+        return self._metrics.to_dict()
+
+    def _publish_prompt_blocks(self, token_ids) -> None:
+        """Echo mode: mimic a paged engine's prefix cache by publishing every
+        full prompt block as stored (same trick as the mock worker)."""
+        seq = TokenBlockSequence(self.block_size, list(token_ids))
+        parent = None
+        for i, (sh, bh) in enumerate(zip(seq.sequence_hashes,
+                                         seq.block_hashes)):
+            self.kv_publisher.publish_stored(i, sh, bh, parent)
+            parent = seq.sequence_hashes[i]
+
+    @dynamo_endpoint()
+    async def generate(self, request):
+        pre = PreprocessedRequest.from_dict(request)
+        if not hasattr(self.engine, "core"):   # echo path: synthesize events
+            self._publish_prompt_blocks(pre.token_ids)
+        self._metrics.request_active_slots += 1
+        try:
+            stream = await self.engine.generate(Context(pre))
+            async for ann in stream:
+                yield ann.to_json_dict(
+                    data_encoder=lambda d: dataclasses.asdict(d)
+                    if dataclasses.is_dataclass(d) else d)
+        finally:
+            self._metrics.request_active_slots -= 1

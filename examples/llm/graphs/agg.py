@@ -1,0 +1,8 @@
+"""Aggregated serving: one worker does prefill + decode, round-robin routing.
+
+Reference: examples/llm/graphs/agg.py — Frontend.link(Processor).link(Worker).
+"""
+
+from examples.llm.components import Frontend, Processor, TpuWorker
+
+Frontend.link(Processor).link(TpuWorker)

@@ -1,0 +1,12 @@
+"""Aggregated serving with KV-aware routing.
+
+Reference: examples/llm/graphs/agg_router.py —
+Frontend.link(Processor).link(Router).link(Worker): the Processor consults
+the Router's radix index before dispatching direct to the chosen worker.
+"""
+
+from examples.llm.components import Frontend, Processor, Router, TpuWorker
+
+Frontend.link(Processor)
+Processor.link(Router)
+Processor.link(TpuWorker)

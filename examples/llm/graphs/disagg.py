@@ -1,0 +1,10 @@
+"""Disaggregated serving: decode workers push long prefills to the queue.
+
+Reference: examples/llm/graphs/disagg.py —
+Frontend.link(Processor).link(Worker).link(PrefillWorker).
+"""
+
+from examples.llm.components import (Frontend, PrefillWorker, Processor,
+                                     TpuWorker)
+
+Frontend.link(Processor).link(TpuWorker).link(PrefillWorker)

@@ -1,0 +1,12 @@
+"""Disaggregated serving with KV-aware routing — the full reference graph.
+
+Reference: examples/llm/graphs/disagg_router.py:16-22 —
+Frontend.link(Processor).link(Router).link(VllmWorker).link(PrefillWorker).
+"""
+
+from examples.llm.components import (Frontend, PrefillWorker, Processor,
+                                     Router, TpuWorker)
+
+Frontend.link(Processor)
+Processor.link(Router)
+Processor.link(TpuWorker).link(PrefillWorker)
