@@ -1,0 +1,249 @@
+// Native KV block reuse pool: refcounted device blocks, prefix matching by
+// chained sequence hash, priority-then-LRU eviction.
+//
+// This is the C++ hot path behind dynamo_tpu/llm/kv/pool.py's KvBlockPool —
+// the TPU-native equivalent of the reference's Rust `AvailableBlocks` /
+// `ReservedBlocks` machinery (lib/llm/src/kv/reuse.rs:50-750 with its
+// `PriorityKey{priority, return_tick, seq_hash}` eviction order, and
+// kv/reserved.rs). Exposed as a flat C ABI consumed via ctypes; stored /
+// removed events are returned to the caller (who owns event publication)
+// rather than invoked as callbacks, keeping the ABI trivially safe.
+//
+// Single-threaded by design: one pool per engine loop, same actor
+// discipline as the reference's mpsc progress engine (reuse.rs:638).
+
+#include <cstdint>
+#include <set>
+#include <tuple>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Meta {
+    uint64_t seq_hash = 0;
+    uint64_t tokens_hash = 0;
+    uint64_t parent_hash = 0;
+    bool registered = false;
+    bool has_parent = false;
+    int64_t refcount = 0;
+    int64_t priority = 0;
+    int64_t return_tick = 0;
+    bool reusable = false;
+};
+
+// eviction order: (priority asc, return_tick asc, block_id) — the
+// reference's PriorityKey with block id as the deterministic tiebreak
+using EvictKey = std::tuple<int64_t, int64_t, int64_t>;
+
+struct Pool {
+    int64_t num_blocks;
+    std::vector<Meta> meta;                      // indexed by block id
+    std::vector<int64_t> free_uninit;            // stack, top = back
+    std::unordered_map<uint64_t, int64_t> by_hash;
+    std::set<EvictKey> evict_order;              // reusable blocks only
+    int64_t tick = 0;
+    int64_t match_queries = 0;
+    int64_t match_hits = 0;
+
+    explicit Pool(int64_t n) : num_blocks(n), meta(n) {
+        free_uninit.reserve(n > 0 ? n - 1 : 0);
+        for (int64_t i = 1; i < n; ++i) free_uninit.push_back(i);
+        // Python fallback pops ids ascending (list built descending, pop()
+        // from the back) — match it so differential tests see identical
+        // allocation order.
+        // free_uninit currently [1..n-1]; pop from back yields n-1 first,
+        // python yields 1 first → reverse.
+        std::vector<int64_t> rev(free_uninit.rbegin(), free_uninit.rend());
+        free_uninit.swap(rev);
+    }
+
+    EvictKey key(int64_t bid) const {
+        return {meta[bid].priority, meta[bid].return_tick, bid};
+    }
+
+    void drop_reusable(int64_t bid) {
+        if (meta[bid].reusable) {
+            evict_order.erase(key(bid));
+            meta[bid].reusable = false;
+        }
+    }
+
+    // returns true (and the removed hash) when the block had registered
+    // content the caller must publish as removed
+    bool invalidate(int64_t bid, uint64_t* removed_hash) {
+        Meta& m = meta[bid];
+        drop_reusable(bid);
+        bool had = false;
+        if (m.registered) {
+            auto it = by_hash.find(m.seq_hash);
+            if (it != by_hash.end() && it->second == bid) by_hash.erase(it);
+            *removed_hash = m.seq_hash;
+            had = true;
+        }
+        m.registered = false;
+        m.has_parent = false;
+        return had;
+    }
+
+    int64_t evict_one(uint64_t* removed_hash, bool* had_hash) {
+        auto it = evict_order.begin();
+        int64_t bid = std::get<2>(*it);
+        *had_hash = invalidate(bid, removed_hash);
+        return bid;
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kvpool_create(int64_t num_blocks) { return new Pool(num_blocks); }
+
+void kvpool_destroy(void* p) { delete static_cast<Pool*>(p); }
+
+int64_t kvpool_free_blocks(void* p) {
+    Pool* pool = static_cast<Pool*>(p);
+    return static_cast<int64_t>(pool->free_uninit.size() +
+                                pool->evict_order.size());
+}
+
+int64_t kvpool_reusable_blocks(void* p) {
+    return static_cast<int64_t>(static_cast<Pool*>(p)->evict_order.size());
+}
+
+int64_t kvpool_match_queries(void* p) {
+    return static_cast<Pool*>(p)->match_queries;
+}
+
+int64_t kvpool_match_hits(void* p) {
+    return static_cast<Pool*>(p)->match_hits;
+}
+
+// Longest-prefix match with refcount holds. Writes matched block ids to
+// out_bids (caller-sized >= n); returns the match count.
+int64_t kvpool_match_prefix(void* p, const uint64_t* hashes, int64_t n,
+                            int64_t* out_bids) {
+    Pool* pool = static_cast<Pool*>(p);
+    int64_t count = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        pool->match_queries++;
+        auto it = pool->by_hash.find(hashes[i]);
+        if (it == pool->by_hash.end()) break;
+        pool->match_hits++;
+        int64_t bid = it->second;
+        Meta& m = pool->meta[bid];
+        if (m.refcount == 0) pool->drop_reusable(bid);
+        m.refcount++;
+        out_bids[count++] = bid;
+    }
+    return count;
+}
+
+int64_t kvpool_peek_prefix(void* p, const uint64_t* hashes, int64_t n) {
+    Pool* pool = static_cast<Pool*>(p);
+    int64_t count = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        if (pool->by_hash.find(hashes[i]) == pool->by_hash.end()) break;
+        ++count;
+    }
+    return count;
+}
+
+// Allocate n uninitialized blocks (refcount=1), evicting reusable blocks
+// priority-then-LRU when the uninit stack runs dry. out_bids sized >= n;
+// out_removed sized >= n receives the seq hashes of evicted registered
+// content (the caller publishes them as removed events), *n_removed their
+// count. Returns 0 on success, -1 when even eviction can't satisfy (state
+// untouched).
+int64_t kvpool_alloc_uninit(void* p, int64_t n, int64_t* out_bids,
+                            uint64_t* out_removed, int64_t* n_removed) {
+    Pool* pool = static_cast<Pool*>(p);
+    *n_removed = 0;
+    if (n > kvpool_free_blocks(p)) return -1;
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t bid;
+        if (!pool->free_uninit.empty()) {
+            bid = pool->free_uninit.back();
+            pool->free_uninit.pop_back();
+        } else {
+            uint64_t removed = 0;
+            bool had = false;
+            bid = pool->evict_one(&removed, &had);
+            if (had) out_removed[(*n_removed)++] = removed;
+        }
+        pool->meta[bid].refcount = 1;
+        out_bids[i] = bid;
+    }
+    return 0;
+}
+
+// Declare a block's content. Returns 1 when the caller should emit a
+// stored event, 0 for the no-op/duplicate paths (pool.py register()).
+int64_t kvpool_register(void* p, int64_t bid, uint64_t seq_hash,
+                        uint64_t tokens_hash, uint64_t parent_hash,
+                        int64_t has_parent, int64_t priority) {
+    Pool* pool = static_cast<Pool*>(p);
+    Meta& m = pool->meta[bid];
+    if (m.registered && m.seq_hash == seq_hash) return 0;
+    auto it = pool->by_hash.find(seq_hash);
+    if (it != pool->by_hash.end() && it->second != bid) return 0;  // dup
+    if (m.registered) pool->by_hash.erase(m.seq_hash);
+    // re-key the eviction entry before mutating priority, or a stale
+    // EvictKey would linger and later hand an in-use block to alloc
+    bool was_reusable = m.reusable;
+    if (was_reusable) pool->evict_order.erase(pool->key(bid));
+    m.seq_hash = seq_hash;
+    m.tokens_hash = tokens_hash;
+    m.parent_hash = parent_hash;
+    m.has_parent = has_parent != 0;
+    m.registered = true;
+    m.priority = priority;
+    if (was_reusable) pool->evict_order.insert(pool->key(bid));
+    pool->by_hash[seq_hash] = bid;
+    return 1;
+}
+
+void kvpool_hold(void* p, const int64_t* bids, int64_t n) {
+    Pool* pool = static_cast<Pool*>(p);
+    for (int64_t i = 0; i < n; ++i)
+        if (bids[i] != 0) pool->meta[bids[i]].refcount++;
+}
+
+void kvpool_release(void* p, const int64_t* bids, int64_t n) {
+    Pool* pool = static_cast<Pool*>(p);
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t bid = bids[i];
+        if (bid == 0) continue;
+        Meta& m = pool->meta[bid];
+        if (m.refcount == 0) continue;  // double release is a no-op
+        m.refcount--;
+        if (m.refcount == 0) {
+            m.return_tick = ++pool->tick;
+            if (m.registered) {
+                if (!m.reusable) {
+                    m.reusable = true;
+                    pool->evict_order.insert(pool->key(bid));
+                }
+            } else {
+                pool->free_uninit.push_back(bid);
+            }
+        }
+    }
+}
+
+// Drop all reusable content. out_removed sized >= num_blocks; returns the
+// number of removed-hash entries written.
+int64_t kvpool_reset(void* p, uint64_t* out_removed) {
+    Pool* pool = static_cast<Pool*>(p);
+    int64_t count = 0;
+    while (!pool->evict_order.empty()) {
+        int64_t bid = std::get<2>(*pool->evict_order.begin());
+        uint64_t removed = 0;
+        if (pool->invalidate(bid, &removed)) out_removed[count++] = removed;
+        pool->free_uninit.push_back(bid);
+    }
+    return count;
+}
+
+}  // extern "C"

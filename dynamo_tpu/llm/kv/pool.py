@@ -21,9 +21,13 @@ reuse.rs:638; here the asyncio loop IS the actor).
 from __future__ import annotations
 
 import dataclasses
+import logging
+import os
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from .blocks import TokenBlockSequence
+
+logger = logging.getLogger("dynamo_tpu.kv.pool")
 
 
 @dataclasses.dataclass
@@ -179,7 +183,9 @@ class KvBlockPool:
             if bid == 0:
                 continue
             meta = self._meta[bid]
-            meta.refcount = max(meta.refcount - 1, 0)
+            if meta.refcount == 0:
+                continue          # double release is a no-op
+            meta.refcount -= 1
             if meta.refcount == 0:
                 self._tick += 1
                 meta.return_tick = self._tick
@@ -193,6 +199,22 @@ class KvBlockPool:
         for bid in list(self._reusable):
             self._invalidate(bid)
             self._free_uninit.append(bid)
+
+
+def make_kv_block_pool(num_blocks: int, on_stored=None, on_removed=None,
+                       prefer_native: bool = True):
+    """Pool factory: the C++ pool (csrc/kv_reuse_pool.cpp) when the
+    toolchain is available and DYN_NATIVE_KVPOOL != 0, else the Python
+    implementation above. Both expose the identical interface."""
+    if prefer_native and os.environ.get("DYN_NATIVE_KVPOOL", "1") != "0":
+        try:
+            from .native_pool import NativeKvBlockPool
+            return NativeKvBlockPool(num_blocks, on_stored=on_stored,
+                                     on_removed=on_removed)
+        except Exception as e:  # noqa: BLE001 — no toolchain / build failure
+            logger.info("native kv pool unavailable (%s); using Python", e)
+    return KvBlockPool(num_blocks, on_stored=on_stored,
+                       on_removed=on_removed)
 
 
 @dataclasses.dataclass
@@ -225,10 +247,11 @@ class KvBlockManager:
 
     def __init__(self, num_blocks: int, block_size: int,
                  on_stored=None, on_removed=None, enable_reuse: bool = True,
-                 host_pool=None):
+                 host_pool=None, prefer_native: bool = True):
         self.block_size = block_size
-        self.pool = KvBlockPool(num_blocks, on_stored=on_stored,
-                                on_removed=on_removed)
+        self.pool = make_kv_block_pool(num_blocks, on_stored=on_stored,
+                                       on_removed=on_removed,
+                                       prefer_native=prefer_native)
         self.enable_reuse = enable_reuse
         self.host_pool = host_pool
 

@@ -21,6 +21,7 @@ from typing import Dict, List, Optional
 
 from .allocator import TpuAllocator
 from .config import ENV_VAR, ServiceConfig
+from .service import Resources
 from .serve_worker import resolve_service
 
 logger = logging.getLogger("dynamo_tpu.sdk.serve")
@@ -119,8 +120,8 @@ async def amain(argv=None) -> None:
         # reads resources from the service config the same way,
         # cli/allocator.py:28-120)
         res = cfg.get(svc.name, "resources") or {}
-        if "tpu" in res or "gpu" in res:     # same aliasing as @service
-            want = int(res.get("tpu", res.get("gpu", 0)) or 0)
+        if "tpu" in res or "gpu" in res:
+            want = Resources.tpu_count(res)
         else:
             want = svc.resources.tpu
         alloc = allocator.allocate(svc.name, want)

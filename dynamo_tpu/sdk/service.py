@@ -65,6 +65,12 @@ class Resources:
     cpu: Optional[str] = None
     memory: Optional[str] = None
 
+    @staticmethod
+    def tpu_count(res: dict) -> int:
+        """Chip count from a resources dict, accepting the reference's
+        ``gpu`` key as an alias (lib/service.py resources config)."""
+        return int(res.get("tpu", res.get("gpu", 0)) or 0)
+
 
 class DynamoService:
     """The object a ``@service`` class becomes (the reference subclasses
@@ -81,7 +87,7 @@ class DynamoService:
         self.namespace = cfg.get("namespace", namespace)
         res = resources or {}
         self.resources = Resources(
-            tpu=int(res.get("tpu", res.get("gpu", 0)) or 0),
+            tpu=Resources.tpu_count(res),
             cpu=res.get("cpu"), memory=res.get("memory"))
         self.endpoints: Dict[str, str] = {}      # endpoint name → attr name
         self.on_start_hooks: List[str] = []

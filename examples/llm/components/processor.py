@@ -48,13 +48,18 @@ class _RoutedDispatch(AsyncEngine):
         pre = request.data
         instance_id = None
         if self.use_kv and self.router is not None:
-            picks = await self.router.find_worker(
-                {"token_ids": list(pre.token_ids)})
-            async for pick in picks:
-                if pick.get("worker_id") is not None:
-                    instance_id = pick["worker_id"]
-                    pre.estimated_prefix_hit_blocks = pick["overlap_blocks"]
-                    pre.prefix_hit_len = pick["prefix_hit_len"]
+            try:
+                picks = await self.router.find_worker(
+                    {"token_ids": list(pre.token_ids)})
+                async for pick in picks:
+                    if pick.get("worker_id") is not None:
+                        instance_id = pick["worker_id"]
+                        pre.estimated_prefix_hit_blocks = \
+                            pick["overlap_blocks"]
+                        pre.prefix_hit_len = pick["prefix_hit_len"]
+            except Exception:  # noqa: BLE001 — dead/slow Router must not
+                # take down traffic; degrade to unroutered dispatch
+                instance_id = None
         if instance_id is not None:
             self.kv_routed += 1
         else:

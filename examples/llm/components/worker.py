@@ -9,6 +9,8 @@ zero-hardware runs) instead of a patched vLLM subprocess.
 Config keys (YAML service section ``TpuWorker``):
     engine: echo | jax        (default echo — no model/TPU needed)
     model_path: DIR           (required for engine: jax)
+    model_name: str           (served model name — keys the disagg router's
+                               etcd-watched config, must match Processor's)
     kv_block_size: int        (default 16)
     remote_prefill: bool      (default false — jax only; enables DisaggEngine)
     conditional_disagg: bool  (default true when remote_prefill)

@@ -11,7 +11,18 @@ import jax.numpy as jnp
 
 from dynamo_tpu.llm.kv.blocks import (TokenBlockSequence, chain_hash,
                                       compute_block_hashes, hash_tokens)
+from dynamo_tpu.llm.kv.native_pool import (NativeKvBlockPool,
+                                           load_native_pool_lib)
 from dynamo_tpu.llm.kv.pool import KvBlockManager, KvBlockPool
+
+_POOL_IMPLS = [KvBlockPool]
+if load_native_pool_lib() is not None:
+    _POOL_IMPLS.append(NativeKvBlockPool)
+
+
+@pytest.fixture(params=_POOL_IMPLS, ids=lambda c: c.__name__)
+def pool_cls(request):
+    return request.param
 
 
 def test_hash_determinism_and_chaining():
@@ -32,8 +43,8 @@ def test_token_block_sequence_incremental():
     assert seq.sequence_hashes == compute_block_hashes(list(range(1, 9)), 4)
 
 
-def test_pool_match_refcount_and_release():
-    pool = KvBlockPool(8)
+def test_pool_match_refcount_and_release(pool_cls):
+    pool = pool_cls(8)
     blocks = pool.alloc_uninit(2)
     hashes = compute_block_hashes([1, 2, 3, 4, 5, 6, 7, 8], 4)
     pool.register(blocks[0], hashes[0], 0, None)
@@ -52,9 +63,9 @@ def test_pool_match_refcount_and_release():
     pool.release([blocks[0]])
 
 
-def test_pool_eviction_lru_and_removed_event():
+def test_pool_eviction_lru_and_removed_event(pool_cls):
     removed = []
-    pool = KvBlockPool(4, on_removed=removed.append)  # 3 usable blocks
+    pool = pool_cls(4, on_removed=removed.append)  # 3 usable blocks
     b = pool.alloc_uninit(3)
     h = compute_block_hashes(list(range(12)), 4)
     for i, bid in enumerate(b):
@@ -70,12 +81,71 @@ def test_pool_eviction_lru_and_removed_event():
     assert pool.match_prefix([h[0]]) == []
 
 
-def test_pool_oom_returns_none():
-    pool = KvBlockPool(4)
+def test_pool_oom_returns_none(pool_cls):
+    pool = pool_cls(4)
     held = pool.alloc_uninit(3)
     assert pool.alloc_uninit(1) is None
     pool.release(held)
     assert len(pool.alloc_uninit(3)) == 3
+
+
+@pytest.mark.skipif(len(_POOL_IMPLS) < 2, reason="native pool not built")
+def test_native_pool_differential_fuzz():
+    """Random op sequences must drive the C++ and Python pools through
+    identical states: same block ids, same match results, same event
+    stream, same occupancy counters."""
+    rng = np.random.default_rng(1337)
+    ev_py, ev_cc = [], []
+    py = KvBlockPool(32, on_stored=lambda *a: ev_py.append(("s", a)),
+                     on_removed=lambda h: ev_py.append(("r", list(h))))
+    cc = NativeKvBlockPool(32, on_stored=lambda *a: ev_cc.append(("s", a)),
+                           on_removed=lambda h: ev_cc.append(("r", list(h))))
+    hashes = compute_block_hashes(list(range(400)), 4)  # 100 chained hashes
+    held_py, held_cc = [], []
+    for step in range(2000):
+        op = rng.integers(0, 5)
+        if op == 0:                                   # alloc
+            n = int(rng.integers(1, 5))
+            a, b = py.alloc_uninit(n), cc.alloc_uninit(n)
+            assert (a is None) == (b is None), step
+            assert a == b, step
+            if a is not None:
+                held_py.extend(a)
+                held_cc.extend(a)
+        elif op == 1 and held_py:                     # register a held block
+            i = int(rng.integers(0, len(held_py)))
+            j = int(rng.integers(0, len(hashes)))
+            parent = hashes[j - 1] if j else None
+            py.register(held_py[i], hashes[j], j, parent)
+            cc.register(held_cc[i], hashes[j], j, parent)
+        elif op == 2 and held_py:                     # release some
+            k = int(rng.integers(1, len(held_py) + 1))
+            py.release(held_py[:k])
+            cc.release(held_cc[:k])
+            del held_py[:k], held_cc[:k]
+        elif op == 3:                                 # match a random prefix
+            j = int(rng.integers(1, len(hashes)))
+            a, b = py.match_prefix(hashes[:j]), cc.match_prefix(hashes[:j])
+            assert a == b, step
+            held_py.extend(a)
+            held_cc.extend(b)
+        else:                                         # peek
+            j = int(rng.integers(1, len(hashes)))
+            assert py.peek_prefix(hashes[:j]) == cc.peek_prefix(hashes[:j])
+        assert py.free_blocks == cc.free_blocks, step
+        assert py.reusable_blocks == cc.reusable_blocks, step
+    # event streams: stored events identical in order; removed events may
+    # batch differently per call (python emits per block) — compare flat
+    flat = lambda evs, kind: [h for k, v in evs if k == kind  # noqa: E731
+                              for h in (v if kind == "r" else [v])]
+    assert flat(ev_py, "s") == flat(ev_cc, "s")
+    assert flat(ev_py, "r") == flat(ev_cc, "r")
+    assert py.match_queries == cc.match_queries
+    assert py.match_hits == cc.match_hits
+    py.reset()
+    cc.reset()
+    assert py.free_blocks == cc.free_blocks
+    assert py.reusable_blocks == cc.reusable_blocks == 0
 
 
 def test_manager_prefill_plan_reuse():
