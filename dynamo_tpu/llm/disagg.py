@@ -34,7 +34,7 @@ from ..runtime.codec import ConnectionInfo
 from ..runtime.distributed import DistributedRuntime
 from ..runtime.engine import ManyOut, SingleIn
 from ..runtime.kvstore import WatchEventType
-from ..runtime.tcp import StreamSender
+from ..runtime.tcp import open_stream_sender
 from .engines.jax_engine import JaxEngine
 from .kv.blocks import TokenBlockSequence
 from .protocols.disagg import (KvPayload, RemotePrefillRequest,
@@ -303,7 +303,7 @@ class PrefillWorker:
             return
         conn = ConnectionInfo.from_dict(rpr.connection_info)
         try:
-            sender = await StreamSender.connect(conn, timeout=5.0)
+            sender = await open_stream_sender(conn, timeout=5.0)
         except Exception:
             # decode worker unreachable — retry a bounded number of times
             # (it may be us who's partitioned), then drop: the decode side

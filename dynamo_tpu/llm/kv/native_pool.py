@@ -113,9 +113,13 @@ class NativeKvBlockPool:
     def match_prefix(self, seq_hashes: Sequence[int]) -> List[int]:
         if not seq_hashes:
             return []
+        # repeated hashes can match the same block more than once, so the
+        # out buffer must be input-sized, not pool-sized
+        buf = (self._bid_buf if len(seq_hashes) <= self.num_blocks
+               else (_I64 * len(seq_hashes))())
         n = self._lib.kvpool_match_prefix(self._h, _u64s(seq_hashes),
-                                          len(seq_hashes), self._bid_buf)
-        return list(self._bid_buf[:n])
+                                          len(seq_hashes), buf)
+        return list(buf[:n])
 
     def peek_prefix(self, seq_hashes: Sequence[int]) -> int:
         if not seq_hashes:

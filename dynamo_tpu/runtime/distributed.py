@@ -38,7 +38,7 @@ from .codec import (ConnectionInfo, ControlMessage, Frame, FrameKind,
                     RequestControlMessage, decode_two_part, encode_two_part)
 from .engine import AsyncEngine, Context, ManyOut, ResponseStream, SingleIn
 from .kvstore import (KvStore, Lease, MemoryKvStore, WatchEventType)
-from .tcp import StreamSender, TcpStreamServer
+from .tcp import StreamSender, TcpStreamServer, open_stream_sender
 
 logger = logging.getLogger("dynamo_tpu.runtime.distributed")
 
@@ -344,7 +344,7 @@ class EndpointServer:
             request = self.decode_req(body)
         except Exception as e:
             if info is not None:
-                sender = await StreamSender.connect(info, error=str(e))
+                sender = await open_stream_sender(info, error=str(e))
                 await sender.finish()
             return
         from .engine import EngineContext
@@ -354,14 +354,14 @@ class EndpointServer:
         except Exception as e:
             logger.exception("engine rejected request %s", ctrl.id)
             if info is not None:
-                sender = await StreamSender.connect(info, error=str(e))
+                sender = await open_stream_sender(info, error=str(e))
                 await sender.finish()
             return
         if info is None:
             async for _ in stream:   # fire-and-forget request type
                 pass
             return
-        sender = await StreamSender.connect(info)
+        sender = await open_stream_sender(info)
         sender.on_stop = ctx.ctx.stop_generating
         sender.on_kill = ctx.ctx.kill
         try:

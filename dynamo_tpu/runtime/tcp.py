@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import socket
 import uuid
 from typing import Callable, Dict, Optional
@@ -24,7 +25,23 @@ from .codec import ConnectionInfo
 
 logger = logging.getLogger("dynamo_tpu.runtime.tcp")
 
-__all__ = ["TcpStreamServer", "StreamReceiver", "StreamSender"]
+__all__ = ["TcpStreamServer", "StreamReceiver", "StreamSender",
+           "open_stream_sender"]
+
+
+async def open_stream_sender(info: "ConnectionInfo",
+                             error: Optional[str] = None,
+                             timeout: float = 10.0):
+    """Sender factory: the C++ data-plane sender (csrc/data_plane.cpp) when
+    the toolchain is available and DYN_NATIVE_DATAPLANE != 0, else the
+    asyncio StreamSender below. Only lib-unavailability falls back — real
+    connection failures propagate identically for both paths."""
+    if os.environ.get("DYN_NATIVE_DATAPLANE", "1") != "0":
+        from .native_tcp import NativeStreamSender, load_data_plane_lib
+        if load_data_plane_lib() is not None:
+            return await NativeStreamSender.connect(info, error=error,
+                                                    timeout=timeout)
+    return await StreamSender.connect(info, error=error, timeout=timeout)
 
 
 class StreamReceiver:

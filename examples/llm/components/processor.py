@@ -58,8 +58,12 @@ class _RoutedDispatch(AsyncEngine):
                             pick["overlap_blocks"]
                         pre.prefix_hit_len = pick["prefix_hit_len"]
             except Exception:  # noqa: BLE001 — dead/slow Router must not
-                # take down traffic; degrade to unroutered dispatch
+                # take down traffic; degrade to unroutered dispatch, and
+                # drop any partial pick's hints (they describe the failed
+                # pick's worker, not wherever fallback dispatch lands)
                 instance_id = None
+                pre.estimated_prefix_hit_blocks = 0
+                pre.prefix_hit_len = 0
         if instance_id is not None:
             self.kv_routed += 1
         else:

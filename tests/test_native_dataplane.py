@@ -1,0 +1,124 @@
+"""Native (C++) data-plane sender: wire compatibility with the Python codec
+and control-frame (STOP/KILL) delivery through the atomic-flag poll path."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.runtime.codec import FrameKind
+from dynamo_tpu.runtime.native_tcp import (NativeStreamSender,
+                                           load_data_plane_lib)
+from dynamo_tpu.runtime.tcp import StreamSender, TcpStreamServer
+
+pytestmark = [
+    pytest.mark.asyncio,
+    pytest.mark.skipif(load_data_plane_lib() is None,
+                       reason="native data plane not built"),
+]
+
+
+@pytest.fixture
+async def server():
+    srv = TcpStreamServer(host="127.0.0.1")
+    await srv.start()
+    yield srv
+    await srv.close()
+
+
+@pytest.mark.parametrize("sender_cls", [StreamSender, NativeStreamSender],
+                         ids=["python", "native"])
+async def test_sender_wire_compat(server, sender_cls):
+    """Both senders must produce byte-identical framing: prologue, data
+    frames (with and without headers), sentinel."""
+    rx = server.register()
+    sender = await sender_cls.connect(server.connection_info(rx))
+    await sender.send(b'{"tok": 1}')
+    await sender.send(b'{"tok": 2}', header=b'{"meta": true}')
+    await sender.finish()
+
+    prologue = await rx.wait_connected(5)
+    assert prologue.error is None
+    f1 = await rx.next_frame(timeout=5)
+    assert f1.kind == FrameKind.DATA and f1.data == b'{"tok": 1}'
+    assert f1.header == b""
+    f2 = await rx.next_frame(timeout=5)
+    assert f2.data == b'{"tok": 2}' and f2.header == b'{"meta": true}'
+    f3 = await rx.next_frame(timeout=5)
+    assert f3.kind == FrameKind.SENTINEL
+    rx.close()
+    server.unregister(rx.stream_id)
+
+
+async def test_native_error_prologue_and_finish_error(server):
+    rx = server.register()
+    sender = await NativeStreamSender.connect(server.connection_info(rx),
+                                              error="bad request")
+    await sender.finish()
+    prologue = await rx.wait_connected(5)
+    assert prologue.error == "bad request"
+    rx.close()
+
+    rx2 = server.register()
+    sender2 = await NativeStreamSender.connect(server.connection_info(rx2))
+    await sender2.send(b"x")
+    await sender2.finish(error="engine exploded")
+    await rx2.wait_connected(5)
+    await rx2.next_frame(timeout=5)
+    err = await rx2.next_frame(timeout=5)
+    assert err.kind == FrameKind.ERROR
+    assert json.loads(err.header)["error"] == "engine exploded"
+    rx2.close()
+
+
+async def test_native_stop_kill_flags(server):
+    rx = server.register()
+    sender = await NativeStreamSender.connect(server.connection_info(rx))
+    stops, kills = [], []
+    sender.on_stop = lambda: stops.append(1)
+    sender.on_kill = lambda: kills.append(1)
+    await rx.wait_connected(5)
+
+    from dynamo_tpu.runtime.codec import ControlMessage
+    await rx.send_control(ControlMessage.stop())
+    for _ in range(100):
+        if stops:
+            break
+        await asyncio.sleep(0.02)
+    assert stops == [1] and not sender.killed
+
+    await rx.send_control(ControlMessage.kill())
+    for _ in range(100):
+        if kills:
+            break
+        await asyncio.sleep(0.02)
+    assert kills == [1] and sender.killed
+    await sender.finish()
+    rx.close()
+
+
+async def test_native_many_frames_backpressure(server):
+    """A few thousand frames must arrive in order and intact."""
+    rx = server.register()
+    sender = await NativeStreamSender.connect(server.connection_info(rx))
+
+    async def produce():
+        for i in range(3000):
+            await sender.send(json.dumps({"i": i}).encode())
+        await sender.finish()
+
+    async def consume():
+        await rx.wait_connected(5)
+        n = 0
+        while True:
+            f = await rx.next_frame(timeout=10)
+            if f is None:
+                continue
+            if f.kind == FrameKind.SENTINEL:
+                return n
+            assert json.loads(f.data)["i"] == n
+            n += 1
+
+    _, n = await asyncio.gather(produce(), consume())
+    assert n == 3000
+    rx.close()
