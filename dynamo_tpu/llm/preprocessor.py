@@ -28,6 +28,7 @@ from .protocols.common import (BackendOutput, FinishReason, OutputOptions,
 from .protocols.openai import (ChatCompletionRequest, ChatDeltaGenerator,
                                CompletionDeltaGenerator, CompletionRequest,
                                usage_dict)
+from .tools import ToolCallingMatcher, ToolChoice
 
 ANNOTATION_TOKEN_IDS = "token_ids"
 ANNOTATION_FORMATTED_PROMPT = "formatted_prompt"
@@ -203,11 +204,66 @@ class OpenAIPreprocessor(Operator):
                if is_chat else
                CompletionDeltaGenerator(req.model, request_id=f"cmpl-{request.id}"))
 
+        # Tool calling (reference preprocessor/tools.rs): when tools are in
+        # play the full message must be inspected, so text is buffered and
+        # either re-emitted verbatim or replaced by tool_calls at finish.
+        matcher = None
+        if is_chat:
+            choice = ToolChoice(req.tool_choice,
+                                has_tools=bool(req.tools))
+            if choice.active and not req.tools:
+                raise ValueError(
+                    "tool_choice requires a non-empty tools list")
+            if req.tools and choice.active:
+                matcher = ToolCallingMatcher(choice)
+
         async def backward() -> AsyncIterator[Annotated[dict]]:
             for ann in annotations:
                 yield ann
             completion_tokens = 0
             finished = False
+            buffered: List[str] = []
+            buffered_logprobs: List[dict] = []
+
+            def chat_end_chunks(reason: FinishReason) -> List[dict]:
+                """Finish-time chunks for the chat path, applying the tool
+                matcher to the buffered message when active. Raises
+                ValueError when a required tool call is missing — but only
+                for clean finishes: a cancelled or truncated generation is
+                reported as its real finish reason, not a tool error."""
+                chunks: List[dict] = []
+                if matcher is not None:
+                    full = "".join(buffered)
+                    clean = reason in (FinishReason.EOS, FinishReason.STOP)
+                    try:
+                        calls = matcher.get_calls(full)
+                    except ValueError:
+                        if clean:
+                            raise
+                        calls = []
+                    if calls:
+                        chunks.append(gen.tool_calls_chunk(calls))
+                        reason = FinishReason.TOOL_CALLS
+                    elif full:
+                        merged = None
+                        if buffered_logprobs:
+                            merged = {"content": [
+                                e for lp in buffered_logprobs
+                                for e in lp.get("content", [])]}
+                        chunks.append(gen.text_chunk(full, logprobs=merged))
+                chunks.append(gen.finish_chunk(reason))
+                # Usage always rides the stream; the HTTP layer drops it for
+                # SSE clients that didn't opt in, and the unary aggregator
+                # folds it into the response.
+                chunks.append(gen.usage_chunk(prompt_len, completion_tokens))
+                return chunks
+
+            def chat_end(reason: FinishReason):
+                try:
+                    return chat_end_chunks(reason)
+                except ValueError as e:
+                    return [Annotated.from_error(str(e))]
+
             async for item in downstream:
                 if isinstance(item, Annotated):
                     if item.data is None:
@@ -221,7 +277,11 @@ class OpenAIPreprocessor(Operator):
                 if text is None and out.tokens:
                     text = "".join(out.tokens)
                 logprobs_payload = _format_logprobs(out, is_chat)
-                if text:
+                if text and matcher is not None:
+                    buffered.append(text)
+                    if logprobs_payload is not None:
+                        buffered_logprobs.append(logprobs_payload)
+                elif text:
                     yield Annotated.from_data(
                         gen.text_chunk(text, logprobs=logprobs_payload))
                 elif logprobs_payload is not None:
@@ -230,12 +290,9 @@ class OpenAIPreprocessor(Operator):
                 if out.finish_reason is not None:
                     finished = True
                     if is_chat:
-                        yield Annotated.from_data(gen.finish_chunk(out.finish_reason))
-                        # Usage always rides the stream; the HTTP layer drops
-                        # it for SSE clients that didn't opt in, and the unary
-                        # aggregator folds it into the response.
-                        yield Annotated.from_data(
-                            gen.usage_chunk(prompt_len, completion_tokens))
+                        for c in chat_end(out.finish_reason):
+                            yield (c if isinstance(c, Annotated)
+                                   else Annotated.from_data(c))
                     else:
                         yield Annotated.from_data(gen.finish_chunk(
                             out.finish_reason,
@@ -244,9 +301,9 @@ class OpenAIPreprocessor(Operator):
                 reason = (FinishReason.CANCELLED if request.ctx.is_stopped
                           else FinishReason.STOP)
                 if is_chat:
-                    yield Annotated.from_data(gen.finish_chunk(reason))
-                    yield Annotated.from_data(
-                        gen.usage_chunk(prompt_len, completion_tokens))
+                    for c in chat_end(reason):
+                        yield (c if isinstance(c, Annotated)
+                               else Annotated.from_data(c))
                 else:
                     yield Annotated.from_data(gen.finish_chunk(
                         reason, usage=usage_dict(prompt_len, completion_tokens)))

@@ -22,12 +22,15 @@ class FinishReason(str, enum.Enum):
     STOP = "stop"
     ERROR = "error"
     CANCELLED = "cancelled"
+    TOOL_CALLS = "tool_calls"
 
     def to_openai(self) -> str:
         if self in (FinishReason.EOS, FinishReason.STOP):
             return "stop"
         if self is FinishReason.LENGTH:
             return "length"
+        if self is FinishReason.TOOL_CALLS:
+            return "tool_calls"
         return "error" if self is FinishReason.ERROR else "stop"
 
 

@@ -198,6 +198,17 @@ class ChatDeltaGenerator:
             choice["logprobs"] = logprobs
         return self._chunk([choice])
 
+    def tool_calls_chunk(self, calls: List[dict], index: int = 0) -> dict:
+        """One delta carrying the parsed tool calls, followed (by the
+        caller) by a finish chunk with reason "tool_calls"."""
+        delta: dict = {"tool_calls": [
+            {**call, "index": i} for i, call in enumerate(calls)]}
+        if not self._sent_role[index]:
+            delta["role"] = "assistant"
+            self._sent_role[index] = True
+        return self._chunk([{"index": index, "delta": delta,
+                             "finish_reason": None}])
+
     def finish_chunk(self, reason: FinishReason, index: int = 0) -> dict:
         return self._chunk([{
             "index": index,

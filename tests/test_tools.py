@@ -1,0 +1,180 @@
+"""Tool-calling: matcher shapes (reference preprocessor/tools.rs), choice
+normalization, and the full chat pipeline emitting tool_calls chunks."""
+
+import json
+
+import pytest
+
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.llm.protocols.annotated import Annotated
+from dynamo_tpu.llm.protocols.common import BackendOutput
+from dynamo_tpu.llm.protocols.openai import aggregate_chat_stream
+from dynamo_tpu.llm.tools import ToolCallingMatcher, ToolChoice
+from dynamo_tpu.runtime import Context, link
+from tests.fixtures import RecordingEngine
+
+WEATHER_TOOL = {
+    "type": "function",
+    "function": {
+        "name": "get_weather",
+        "description": "Get the weather",
+        "parameters": {"type": "object",
+                       "properties": {"city": {"type": "string"}}},
+    },
+}
+
+
+# ------------------------------------------------------------------ matcher
+
+def _m(choice="auto"):
+    return ToolCallingMatcher(ToolChoice(choice, has_tools=True))
+
+
+@pytest.mark.parametrize("key", ["parameters", "arguments"])
+def test_matcher_single_and_list(key):
+    msg = json.dumps({"name": "get_weather", key: {"city": "sf"}})
+    calls = _m().get_calls(msg)
+    assert len(calls) == 1
+    assert calls[0]["type"] == "function"
+    assert calls[0]["function"]["name"] == "get_weather"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"city": "sf"}
+    assert calls[0]["id"].startswith("call-")
+
+    many = json.dumps([{"name": "a", key: {}}, {"name": "b", key: {"x": 1}}])
+    calls = _m().get_calls(many)
+    assert [c["function"]["name"] for c in calls] == ["a", "b"]
+
+
+def test_matcher_non_tool_text_and_none_choice():
+    assert _m().get_calls("just words") == []
+    assert _m().get_calls('{"name": 42}') == []
+    assert _m("none").get_calls(
+        '{"name": "get_weather", "arguments": {}}') == []
+
+
+def test_matcher_required_and_forced():
+    with pytest.raises(ValueError):
+        _m("required").get_calls("no call here")
+    forced = ToolCallingMatcher(ToolChoice(
+        {"type": "function", "function": {"name": "get_weather"}},
+        has_tools=True))
+    assert forced.get_calls(
+        '{"name": "get_weather", "arguments": {}}')[0]["function"]["name"] \
+        == "get_weather"
+    with pytest.raises(ValueError):
+        forced.get_calls('{"name": "other_tool", "arguments": {}}')
+
+
+def test_choice_default_depends_on_tools():
+    assert ToolChoice(None, has_tools=True).mode == ToolChoice.AUTO
+    assert ToolChoice(None, has_tools=False).mode == ToolChoice.NONE
+    with pytest.raises(ValueError):
+        ToolChoice("sometimes", has_tools=True)
+
+
+# ----------------------------------------------------------------- pipeline
+
+@pytest.fixture(scope="module")
+def mdc(request):
+    tiny = request.getfixturevalue("tiny_model_dir")
+    return ModelDeploymentCard.from_local_path(tiny, display_name="tiny")
+
+
+def _engine_replying(mdc, text: str) -> RecordingEngine:
+    tk = mdc.tokenizer()
+    outs = [Annotated.from_data(BackendOutput(token_ids=[t]))
+            for t in tk.encode(text).ids]
+    outs.append(Annotated.from_data(
+        BackendOutput(token_ids=[mdc.model_info.eos_token_ids[0]])))
+    return RecordingEngine(outs)
+
+
+@pytest.mark.asyncio
+async def test_chat_pipeline_emits_tool_calls(mdc):
+    reply = json.dumps({"name": "get_weather",
+                        "arguments": {"city": "tokyo"}})
+    pipeline = link(OpenAIPreprocessor(mdc), Backend(mdc),
+                    _engine_replying(mdc, reply))
+    req = {"model": "tiny", "tools": [WEATHER_TOOL],
+           "messages": [{"role": "user", "content": "weather in tokyo?"}]}
+    resp = await aggregate_chat_stream(await pipeline.generate(Context(req)))
+    choice = resp["choices"][0]
+    assert choice["finish_reason"] == "tool_calls"
+    calls = choice["message"]["tool_calls"]
+    assert len(calls) == 1 and calls[0]["function"]["name"] == "get_weather"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"city": "tokyo"}
+
+
+@pytest.mark.asyncio
+async def test_chat_pipeline_tools_plain_answer_passes_through(mdc):
+    pipeline = link(OpenAIPreprocessor(mdc), Backend(mdc),
+                    _engine_replying(mdc, "sunny and warm"))
+    req = {"model": "tiny", "tools": [WEATHER_TOOL],
+           "messages": [{"role": "user", "content": "weather?"}]}
+    resp = await aggregate_chat_stream(await pipeline.generate(Context(req)))
+    choice = resp["choices"][0]
+    assert choice["finish_reason"] == "stop"
+    assert choice["message"]["content"] == "sunny and warm"
+    assert "tool_calls" not in choice["message"]
+
+
+@pytest.mark.asyncio
+async def test_chat_pipeline_required_unmet_is_stream_error(mdc):
+    pipeline = link(OpenAIPreprocessor(mdc), Backend(mdc),
+                    _engine_replying(mdc, "not a tool call"))
+    req = {"model": "tiny", "tools": [WEATHER_TOOL],
+           "tool_choice": "required",
+           "messages": [{"role": "user", "content": "weather?"}]}
+    with pytest.raises(RuntimeError, match="required"):
+        await aggregate_chat_stream(await pipeline.generate(Context(req)))
+
+
+@pytest.mark.asyncio
+async def test_tool_choice_without_tools_rejected(mdc):
+    pipeline = link(OpenAIPreprocessor(mdc), Backend(mdc),
+                    _engine_replying(mdc, "hi"))
+    req = {"model": "tiny", "tool_choice": "required",
+           "messages": [{"role": "user", "content": "x"}]}
+    with pytest.raises(ValueError, match="tools"):
+        await pipeline.generate(Context(req))
+
+
+@pytest.mark.asyncio
+async def test_tools_preserve_logprobs_on_plain_answer(mdc):
+    tk = mdc.tokenizer()
+    ids = tk.encode("sunny day").ids
+    outs = [Annotated.from_data(BackendOutput(
+        token_ids=[t], tokens=[tk.decode([t])], log_probs=[-0.1 * i]))
+        for i, t in enumerate(ids)]
+    outs.append(Annotated.from_data(
+        BackendOutput(token_ids=[mdc.model_info.eos_token_ids[0]])))
+    pipeline = link(OpenAIPreprocessor(mdc), Backend(mdc),
+                    RecordingEngine(outs))
+    req = {"model": "tiny", "tools": [WEATHER_TOOL], "logprobs": True,
+           "messages": [{"role": "user", "content": "weather?"}]}
+    stream = await pipeline.generate(Context(req))
+    lp_entries = []
+    async for a in stream:
+        if a.data and a.data.get("choices"):
+            ch = a.data["choices"][0]
+            if ch.get("logprobs"):
+                lp_entries.extend(ch["logprobs"]["content"])
+    assert len(lp_entries) == len(ids)   # buffered, then re-emitted intact
+
+
+@pytest.mark.asyncio
+async def test_no_tools_streams_normally(mdc):
+    """Without tools the buffering path must stay off (streaming deltas)."""
+    pipeline = link(OpenAIPreprocessor(mdc), Backend(mdc),
+                    _engine_replying(mdc, "hello world"))
+    req = {"model": "tiny",
+           "messages": [{"role": "user", "content": "hi"}]}
+    stream = await pipeline.generate(Context(req))
+    content_chunks = 0
+    async for a in stream:
+        if a.data and a.data.get("choices"):
+            if a.data["choices"][0].get("delta", {}).get("content"):
+                content_chunks += 1
+    assert content_chunks > 1   # token-by-token, not one buffered blob
