@@ -198,15 +198,11 @@ class OpenAIPreprocessor(Operator):
             annotations.append(Annotated.from_annotation(
                 ANNOTATION_FORMATTED_PROMPT, formatted_prompt))
 
-        downstream = await next_engine.generate(request.transfer(pre))
-
-        gen = (ChatDeltaGenerator(req.model, request_id=f"chatcmpl-{request.id}")
-               if is_chat else
-               CompletionDeltaGenerator(req.model, request_id=f"cmpl-{request.id}"))
-
         # Tool calling (reference preprocessor/tools.rs): when tools are in
         # play the full message must be inspected, so text is buffered and
         # either re-emitted verbatim or replaced by tool_calls at finish.
+        # Validation happens BEFORE engine dispatch — a malformed request
+        # must not leak an orphaned in-flight generation.
         matcher = None
         if is_chat:
             choice = ToolChoice(req.tool_choice,
@@ -216,6 +212,12 @@ class OpenAIPreprocessor(Operator):
                     "tool_choice requires a non-empty tools list")
             if req.tools and choice.active:
                 matcher = ToolCallingMatcher(choice)
+
+        downstream = await next_engine.generate(request.transfer(pre))
+
+        gen = (ChatDeltaGenerator(req.model, request_id=f"chatcmpl-{request.id}")
+               if is_chat else
+               CompletionDeltaGenerator(req.model, request_id=f"cmpl-{request.id}"))
 
         async def backward() -> AsyncIterator[Annotated[dict]]:
             for ann in annotations:
@@ -277,8 +279,12 @@ class OpenAIPreprocessor(Operator):
                 if text is None and out.tokens:
                     text = "".join(out.tokens)
                 logprobs_payload = _format_logprobs(out, is_chat)
-                if text and matcher is not None:
-                    buffered.append(text)
+                if matcher is not None and (text
+                                            or logprobs_payload is not None):
+                    # nothing escapes mid-buffer: empty-text deltas carrying
+                    # logprobs are buffered too
+                    if text:
+                        buffered.append(text)
                     if logprobs_payload is not None:
                         buffered_logprobs.append(logprobs_payload)
                 elif text:

@@ -29,8 +29,13 @@ class ToolChoice:
                  has_tools: bool):
         self.forced_name: Optional[str] = None
         if isinstance(raw, dict):
+            # only the OpenAI named-tool shape is valid:
+            # {"type": "function", "function": {"name": ...}}
+            name = (raw.get("function") or {}).get("name")
+            if raw.get("type") != "function" or not isinstance(name, str):
+                raise ValueError(f"invalid tool_choice object: {raw!r}")
             self.mode = self.REQUIRED
-            self.forced_name = (raw.get("function") or {}).get("name")
+            self.forced_name = name
         elif raw in (self.NONE, self.AUTO, self.REQUIRED):
             self.mode = raw
         elif raw is None:

@@ -1,0 +1,4 @@
+from .pool import AsyncPool, PoolItem, SharedPoolItem
+from .stream import until_deadline
+
+__all__ = ["AsyncPool", "PoolItem", "SharedPoolItem", "until_deadline"]

@@ -1,0 +1,181 @@
+"""Async object pool with RAII-style return and priority reuse.
+
+Reference: lib/runtime/src/utils/pool.rs:23-427 — `Returnable` items,
+`PoolItem` (return-on-drop), `SharedPoolItem` (refcounted sharing), used for
+KV blocks and copy streams. The Python analog returns items via context
+manager or explicit release; a GC finalizer backstops forgotten items so a
+leaked handle can't shrink the pool permanently.
+
+Priority reuse: `acquire()` pops the most-recently-returned item (LIFO) so
+hot items (warm caches, bound buffers) are reused first — the reference's
+priority ordering with recency as the default priority.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import weakref
+from typing import Any, Generic, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+__all__ = ["AsyncPool", "PoolItem", "SharedPoolItem"]
+
+
+class PoolItem(Generic[T]):
+    """A borrowed item. Use as an async context manager, or call
+    ``release()``; either returns the value to the pool exactly once."""
+
+    def __init__(self, pool: "AsyncPool[T]", value: T):
+        self._pool = pool
+        self.value = value
+        self._released = False
+        # GC backstop: if the holder drops the handle without releasing,
+        # the finalizer puts the value back (reference: Drop impl).
+        self._finalizer = weakref.finalize(
+            self, AsyncPool._return_raw, pool, value)
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._finalizer.detach()
+        self._pool._return(self.value)
+
+    def share(self) -> "SharedPoolItem[T]":
+        """Convert to a refcounted shared handle (reference
+        SharedPoolItem); this PoolItem becomes inert."""
+        if self._released:
+            raise RuntimeError("cannot share a released item")
+        self._released = True
+        self._finalizer.detach()
+        return SharedPoolItem(_SharedState(self._pool, self.value))
+
+    async def __aenter__(self) -> T:
+        return self.value
+
+    async def __aexit__(self, *exc) -> None:
+        self.release()
+
+    def __enter__(self) -> T:
+        return self.value
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class _SharedState(Generic[T]):
+    """Refcount cell shared by every clone of one borrowed value."""
+
+    def __init__(self, pool: "AsyncPool[T]", value: T):
+        self.pool = pool
+        self.value = value
+        self.refs = 0
+
+
+class SharedPoolItem(Generic[T]):
+    """Refcounted shared borrow: ``clone()`` makes an independent handle,
+    ``release()`` drops this handle's reference (idempotent per handle,
+    like the reference's Arc clone/drop); the value returns to the pool
+    when the last handle releases. Each handle carries its own GC
+    finalizer, so a leaked clone can't shrink the pool."""
+
+    def __init__(self, state: _SharedState):
+        self._state = state
+        self._released = False
+        state.refs += 1
+        self._finalizer = weakref.finalize(
+            self, SharedPoolItem._drop_ref, state)
+
+    @property
+    def value(self) -> T:
+        return self._state.value
+
+    def clone(self) -> "SharedPoolItem[T]":
+        if self._released:
+            raise RuntimeError("clone of a released shared item")
+        return SharedPoolItem(self._state)
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._finalizer.detach()
+        self._drop_ref(self._state)
+
+    @staticmethod
+    def _drop_ref(state: _SharedState) -> None:
+        state.refs -= 1
+        if state.refs == 0:
+            state.pool._return(state.value)
+
+
+class AsyncPool(Generic[T]):
+    """Fixed population of reusable objects.
+
+    ``on_return(value)`` (optional ctor arg) runs when a value re-enters
+    the pool — the reference's ``Returnable::on_return`` reset hook.
+    """
+
+    def __init__(self, items: List[T], on_return=None):
+        self._free: List[T] = list(items)          # LIFO: hot items on top
+        self._capacity = len(items)
+        self._on_return = on_return
+        self._waiters: List[asyncio.Future] = []
+
+    @property
+    def available(self) -> int:
+        return len(self._free)
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def try_acquire(self) -> Optional[PoolItem[T]]:
+        if not self._free:
+            return None
+        return PoolItem(self, self._free.pop())
+
+    async def acquire(self, timeout: Optional[float] = None) -> PoolItem[T]:
+        item = self.try_acquire()
+        if item is not None:
+            return item
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._waiters.append(fut)
+        try:
+            value = await (asyncio.wait_for(fut, timeout)
+                           if timeout is not None else fut)
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            if not fut.done():
+                fut.cancel()
+            self._waiters = [w for w in self._waiters if w is not fut]
+            if fut.done() and not fut.cancelled():
+                # value was handed to us as we timed out — put it back
+                self._return(fut.result())
+            raise
+        return PoolItem(self, value)
+
+    # internal ------------------------------------------------------------
+    def _return(self, value: T) -> None:
+        if self._on_return is not None:
+            self._on_return(value)
+        while self._waiters:
+            fut = self._waiters.pop(0)
+            if not fut.done():
+                fut.set_result(value)
+                return
+        self._free.append(value)
+
+    @staticmethod
+    def _return_raw(pool: "AsyncPool[Any]", value: Any) -> None:
+        """Finalizer path — GC may run this on any thread, and
+        Future.set_result is not thread-safe, so waiter wakeup is
+        marshalled onto the waiter's loop; with no waiters a plain append
+        suffices."""
+        if pool._waiters:
+            loop = pool._waiters[0].get_loop()
+            loop.call_soon_threadsafe(pool._return, value)
+        else:
+            if pool._on_return is not None:
+                pool._on_return(value)
+            pool._free.append(value)

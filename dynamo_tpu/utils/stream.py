@@ -1,0 +1,52 @@
+"""Stream utilities.
+
+Reference: lib/runtime/src/utils/stream.rs:25-60 — ``until_deadline``
+(DeadlineStream): pass items through until a deadline, then end the stream
+cleanly (the remote side keeps its cancellation semantics; this only bounds
+how long the consumer waits).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import AsyncIterator, Optional, TypeVar
+
+T = TypeVar("T")
+
+__all__ = ["until_deadline"]
+
+
+async def until_deadline(stream: AsyncIterator[T],
+                         deadline_s: float) -> AsyncIterator[T]:
+    """Yield from ``stream`` until ``deadline_s`` seconds (monotonic, from
+    now) elapse; stops cleanly at the deadline, mid-wait included."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + deadline_s
+    it = stream.__aiter__()
+    task = None
+    try:
+        while True:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return
+            task = asyncio.ensure_future(it.__anext__())
+            try:
+                yield await asyncio.wait_for(asyncio.shield(task), remaining)
+            except asyncio.TimeoutError:
+                task.cancel()
+                try:
+                    await task
+                except (StopAsyncIteration, asyncio.CancelledError):
+                    pass
+                task = None
+                return
+            except StopAsyncIteration:
+                task = None
+                return
+            task = None
+    finally:
+        # consumer break or cancellation mid-yield: the shielded __anext__
+        # may still be pending — cancel it so the source stream doesn't run
+        # detached. No await here: this may execute under GeneratorExit.
+        if task is not None and not task.done():
+            task.cancel()

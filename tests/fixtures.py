@@ -84,3 +84,61 @@ class RecordingEngine:
                 yield out
 
         return ResponseStream(gen(), request.ctx)
+
+
+class LatencyModel:
+    """Injected network latency for mock-transport tests (reference
+    tests/common/mock.rs `LatencyModel::{NoDelay, ConstantDelayInNanos,
+    NormalDistribution}`)."""
+
+    def __init__(self, mean_ms: float = 0.0, stddev_ms: float = 0.0,
+                 seed: int = 0):
+        import numpy as _np
+        self.mean = mean_ms / 1000.0
+        self.stddev = stddev_ms / 1000.0
+        self._rng = _np.random.default_rng(seed)
+
+    @classmethod
+    def no_delay(cls) -> "LatencyModel":
+        return cls()
+
+    @classmethod
+    def constant(cls, ms: float) -> "LatencyModel":
+        return cls(mean_ms=ms)
+
+    @classmethod
+    def normal(cls, mean_ms: float, stddev_ms: float,
+               seed: int = 0) -> "LatencyModel":
+        return cls(mean_ms=mean_ms, stddev_ms=stddev_ms, seed=seed)
+
+    def sample(self) -> float:
+        if self.stddev:
+            return max(float(self._rng.normal(self.mean, self.stddev)), 0.0)
+        return self.mean
+
+    async def wait(self) -> None:
+        import asyncio as _asyncio
+        d = self.sample()
+        if d > 0:
+            await _asyncio.sleep(d)
+
+
+class DelayedEngine:
+    """Wrap any engine with request + per-item latency — the in-process
+    stand-in for a slow network path (mock.rs's delayed transport)."""
+
+    def __init__(self, inner, latency: LatencyModel):
+        self.inner = inner
+        self.latency = latency
+
+    async def generate(self, request):
+        from dynamo_tpu.runtime.engine import ResponseStream
+        await self.latency.wait()          # request-plane hop
+        stream = await self.inner.generate(request)
+
+        async def gen():
+            async for item in stream:
+                await self.latency.wait()  # response-plane hop per frame
+                yield item
+
+        return ResponseStream(gen(), request.ctx)

@@ -1,0 +1,88 @@
+"""Soak: sustained request load through the real daemon/bus/TCP stack in
+one process — backend + client together, like the reference's
+`lib/runtime/tests/soak.rs` (spawn both against live etcd/NATS and loop).
+Scale with DYN_SOAK_REQUESTS (default 150, CI-sized)."""
+
+import asyncio
+import os
+
+import pytest
+
+from dynamo_tpu.components.mock_worker import MockTokenWorker
+from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                             SamplingOptions, StopConditions)
+from dynamo_tpu.runtime import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime, Endpoint
+from dynamo_tpu.runtime.engine import EngineContext
+from dynamo_tpu.runtime.server import DiscoveryServer
+
+pytestmark = pytest.mark.asyncio
+
+PATH = "dyn://soak/worker/generate"
+N_REQUESTS = int(os.environ.get("DYN_SOAK_REQUESTS", "150"))
+CONCURRENCY = 16
+
+
+async def test_soak_sustained_load_with_worker_join():
+    daemon = DiscoveryServer(host="127.0.0.1")
+    await daemon.start()
+    rt_client = await DistributedRuntime.connect(daemon.address)
+    rt_w1 = await DistributedRuntime.connect(daemon.address)
+    rt_w2 = await DistributedRuntime.connect(daemon.address)
+    w1 = await MockTokenWorker(rt_w1, PATH, block_size=4).start()
+    w2 = None
+    try:
+        endpoint = Endpoint.parse_path(rt_client, PATH)
+        from dynamo_tpu.llm.protocols.annotated import decode_annotated_json
+        client = endpoint.client(decode_resp=decode_annotated_json)
+        await client.start()
+        await client.wait_for_instances(15)
+
+        ok = 0
+        failures = []
+        sem = asyncio.Semaphore(CONCURRENCY)
+
+        async def one(i: int):
+            nonlocal ok
+            async with sem:
+                prompt = [10 + (i % 7), 11, 12, 13 + (i % 3)]
+                pre = PreprocessedRequest(
+                    token_ids=prompt,
+                    stop_conditions=StopConditions(max_tokens=4,
+                                                   ignore_eos=True),
+                    sampling_options=SamplingOptions(greedy=True))
+                try:
+                    stream = await client.round_robin(
+                        Context(pre, ctx=EngineContext(f"soak-{i}")))
+                    toks = []
+                    async for ann in stream:
+                        if ann.data and ann.data.get("token_ids"):
+                            toks.extend(ann.data["token_ids"])
+                    # echo engine: first max_tokens prompt tokens come back
+                    assert toks == prompt[:4], (i, toks)
+                    ok += 1
+                except Exception as e:  # noqa: BLE001
+                    failures.append((i, repr(e)))
+
+        first = [asyncio.ensure_future(one(i))
+                 for i in range(N_REQUESTS // 2)]
+        # elastic join mid-soak: a second worker appears with no global sync
+        await asyncio.sleep(0.2)
+        w2 = await MockTokenWorker(rt_w2, PATH, block_size=4).start()
+        rest = [asyncio.ensure_future(one(i))
+                for i in range(N_REQUESTS // 2, N_REQUESTS)]
+        await asyncio.gather(*first, *rest)
+
+        assert not failures, failures[:5]
+        assert ok == N_REQUESTS
+        # the joined worker actually took traffic
+        assert w2.engine.requests_served > 0
+        assert w1.engine.requests_served > 0
+        await client.close()
+    finally:
+        await w1.stop()
+        if w2 is not None:
+            await w2.stop()
+        for rt in (rt_client, rt_w1, rt_w2):
+            await rt.shutdown()
+        await daemon.close()

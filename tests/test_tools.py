@@ -132,13 +132,22 @@ async def test_chat_pipeline_required_unmet_is_stream_error(mdc):
 
 
 @pytest.mark.asyncio
-async def test_tool_choice_without_tools_rejected(mdc):
-    pipeline = link(OpenAIPreprocessor(mdc), Backend(mdc),
-                    _engine_replying(mdc, "hi"))
+async def test_tool_choice_without_tools_rejected_before_dispatch(mdc):
+    engine = _engine_replying(mdc, "hi")
+    pipeline = link(OpenAIPreprocessor(mdc), Backend(mdc), engine)
     req = {"model": "tiny", "tool_choice": "required",
            "messages": [{"role": "user", "content": "x"}]}
     with pytest.raises(ValueError, match="tools"):
         await pipeline.generate(Context(req))
+    # rejected BEFORE engine dispatch — no orphaned in-flight generation
+    assert engine.requests == []
+
+
+def test_malformed_tool_choice_object_rejected():
+    with pytest.raises(ValueError, match="tool_choice"):
+        ToolChoice({"type": "function"}, has_tools=True)   # no name
+    with pytest.raises(ValueError, match="tool_choice"):
+        ToolChoice({"typo": True}, has_tools=True)
 
 
 @pytest.mark.asyncio

@@ -1,0 +1,170 @@
+"""Async pool + stream utils (reference utils/pool.rs and utils/stream.rs
+test semantics) and the latency-model mock tier (tests/common/mock.rs)."""
+
+import asyncio
+import time
+
+import pytest
+
+from dynamo_tpu.utils.pool import AsyncPool
+from dynamo_tpu.utils.stream import until_deadline
+from tests.fixtures import DelayedEngine, LatencyModel, RecordingEngine
+
+pytestmark = pytest.mark.asyncio
+
+
+# -------------------------------------------------------------------- pool
+
+async def test_pool_acquire_release_lifo():
+    pool = AsyncPool(["a", "b", "c"])
+    i1 = await pool.acquire()
+    assert i1.value == "c"                 # LIFO: hot item first
+    i1.release()
+    i2 = await pool.acquire()
+    assert i2.value == "c"                 # most recently returned
+    i2.release()
+    assert pool.available == 3
+
+
+async def test_pool_blocks_until_return_and_wakes_fifo():
+    pool = AsyncPool([1])
+    held = await pool.acquire()
+    order = []
+
+    async def waiter(tag):
+        item = await pool.acquire()
+        order.append(tag)
+        await asyncio.sleep(0.01)
+        item.release()
+
+    tasks = [asyncio.ensure_future(waiter("w1")),
+             asyncio.ensure_future(waiter("w2"))]
+    await asyncio.sleep(0.02)
+    assert order == []                     # both blocked
+    held.release()
+    await asyncio.gather(*tasks)
+    assert order == ["w1", "w2"]           # FIFO handoff
+
+
+async def test_pool_timeout_and_value_not_lost():
+    pool = AsyncPool(["x"])
+    held = await pool.acquire()
+    with pytest.raises(asyncio.TimeoutError):
+        await pool.acquire(timeout=0.05)
+    held.release()
+    assert pool.available == 1             # timed-out waiter didn't leak it
+    item = await pool.acquire(timeout=0.05)
+    assert item.value == "x"
+    item.release()
+
+
+async def test_pool_on_return_hook_and_context_manager():
+    resets = []
+    pool = AsyncPool([{"n": 0}], on_return=lambda v: resets.append(v["n"]))
+    async with await pool.acquire() as v:
+        v["n"] = 7
+    assert resets == [7]
+    assert pool.available == 1
+
+
+async def test_pool_shared_item_refcount():
+    pool = AsyncPool(["s"])
+    shared = (await pool.acquire()).share()
+    clone = shared.clone()
+    shared.release()
+    assert pool.available == 0             # one holder left
+    clone.release()
+    assert pool.available == 1
+
+
+async def test_pool_shared_clone_is_independent_and_double_release_safe():
+    pool = AsyncPool(["s"])
+    a = (await pool.acquire()).share()
+    b = a.clone()
+    assert a is not b
+    a.release()
+    a.release()                            # per-handle idempotent: no steal
+    assert pool.available == 0             # b still holds the value
+    b.release()
+    assert pool.available == 1
+
+
+async def test_pool_leaked_shared_clone_gc_backstop():
+    import gc
+    pool = AsyncPool(["s"])
+    a = (await pool.acquire()).share()
+    b = a.clone()
+    a.release()
+    del b                                  # leaked clone, never released
+    gc.collect()
+    assert pool.available == 1
+
+
+async def test_pool_gc_backstop_returns_leaked_item():
+    pool = AsyncPool(["leak"])
+    item = await pool.acquire()
+    assert pool.available == 0
+    del item                               # dropped without release()
+    import gc
+    gc.collect()
+    assert pool.available == 1
+
+
+# ------------------------------------------------------------------ stream
+
+async def test_until_deadline_passes_and_cuts():
+    async def ticks():
+        for i in range(100):
+            yield i
+            await asyncio.sleep(0.01)
+
+    got = [x async for x in until_deadline(ticks(), 0.055)]
+    assert got and got == list(range(len(got)))
+    assert 3 <= len(got) <= 9              # ~5 ticks, scheduler slop
+
+
+async def test_until_deadline_consumer_break_reaps_pending_task():
+    cleaned = asyncio.Event()
+
+    async def src():
+        try:
+            yield 1
+            await asyncio.sleep(30)
+            yield 2
+        finally:
+            cleaned.set()
+
+    agen = until_deadline(src(), 10.0)
+    async for x in agen:
+        assert x == 1
+        break                              # consumer walks away mid-stream
+    await agen.aclose()
+    await asyncio.wait_for(cleaned.wait(), 2)
+
+
+async def test_until_deadline_short_stream_ends_cleanly():
+    async def three():
+        for i in range(3):
+            yield i
+
+    assert [x async for x in until_deadline(three(), 10.0)] == [0, 1, 2]
+
+
+# ----------------------------------------------------- latency mock tier
+
+async def test_latency_model_pipeline_ordering_and_cost():
+    """A normal-distribution latency on every hop must not reorder the
+    stream, and total time must reflect the injected delays (the mock
+    network transport tier, reference tests/common/mock.rs)."""
+    from dynamo_tpu.llm.protocols.annotated import Annotated
+    from dynamo_tpu.runtime import Context
+
+    outputs = [Annotated.from_data({"i": i}) for i in range(10)]
+    engine = DelayedEngine(RecordingEngine(outputs),
+                           LatencyModel.normal(5.0, 2.0, seed=42))
+    t0 = time.monotonic()
+    stream = await engine.generate(Context({}))
+    got = [a.data["i"] async for a in stream]
+    elapsed = time.monotonic() - t0
+    assert got == list(range(10))          # order preserved under jitter
+    assert elapsed >= 0.02                 # 11 hops × ~5ms, very loose floor
