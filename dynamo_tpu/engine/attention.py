@@ -197,9 +197,12 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
                     block_size: int, scale: float,
                     impl: str = "auto") -> jax.Array:
-    """Dispatch: pallas on TPU, XLA gather fallback elsewhere."""
+    """Dispatch: pallas on TPU, XLA gather fallback elsewhere. Mosaic
+    requires lane-aligned (128) head dims for the kernel's q/o tiles, so
+    64-dim-head models (llama-1B class) auto-route to the XLA path."""
     if impl == "auto":
-        impl = "pallas" if _on_tpu() else "xla"
+        head_dim = q.shape[-1]
+        impl = ("pallas" if _on_tpu() and head_dim % 128 == 0 else "xla")
     if impl == "pallas":
         return paged_attention_pallas(q, k_cache, v_cache, block_tables,
                                       seq_lens, block_size=block_size,

@@ -117,6 +117,12 @@ class EngineConfig:
     # shortest cold prefill worth the ring path (per-layer shard_map +
     # sp-1 ppermute rounds); shorter prompts stay on the chunked program
     sp_min_prefill_tokens: int = 512
+    # decode steps fused into one XLA dispatch (lax.scan): tokens are
+    # harvested to the host once per dispatch, so device→host latency —
+    # sub-ms on a local chip, hundreds of ms over a tunneled device — is
+    # amortized K×. K>1 trades step-granular EOS/cancel reaction (worst
+    # case K-1 wasted steps per sequence) for throughput.
+    decode_steps_per_dispatch: int = 1
     seed: int = 0
 
     def __post_init__(self) -> None:

@@ -178,6 +178,30 @@ class EngineCore:
 
         self._decode_jit = jax.jit(decode, donate_argnums=(1,))
 
+        # K decode steps fused into one dispatch (EngineConfig
+        # decode_steps_per_dispatch): the sampled token feeds the next step
+        # ON DEVICE, and the host harvests [K, B] tokens once per dispatch.
+        K = self.cfg.decode_steps_per_dispatch
+        seed = self.cfg.seed
+
+        def decode_k(params, kv, tokens, positions, block_tables,
+                     seeds, steps0, temperature, top_k, top_p):
+            def body(carry, k):
+                kv, toks, pos = carry
+                keys = make_slot_keys(seed, seeds, steps0 + k)
+                logits, kv = llama.decode_forward(
+                    params, kv, toks, pos, block_tables, statics)
+                toks2, logprobs = sample_tokens(logits, keys, temperature,
+                                                top_k, top_p)
+                return (kv, toks2, pos + 1), (toks2, logprobs)
+
+            (kv, _, _), (toks_k, logprobs_k) = jax.lax.scan(
+                body, (kv, tokens, positions), jnp.arange(K))
+            return toks_k, logprobs_k, kv
+
+        self._decode_k_jit = (jax.jit(decode_k, donate_argnums=(1,))
+                              if K > 1 else None)
+
         # sequence-parallel long-prompt prefill (ring attention over "sp")
         self._prefill_sp_jit = None
         self._sp = 1
@@ -419,6 +443,9 @@ class EngineCore:
 
     # --------------------------------------------------------------- decode
     def _decode_step(self) -> None:
+        if self._decode_k_jit is not None:
+            self._decode_step_multi(self.cfg.decode_steps_per_dispatch)
+            return
         active_idx = [i for i, s in enumerate(self.slots) if s is not None]
         steps = np.zeros((self.B,), np.int64)
         for i in range(self.B):
@@ -465,7 +492,8 @@ class EngineCore:
             self.total_decode_tokens += 1
             # grow block table if the *next* token would start a new block
             if (req.pos + 1) > len(req.blocks) * bs:
-                new = self.kv_manager.pool.alloc_uninit(1)
+                new = (self.kv_manager.pool.alloc_uninit(1)
+                       if len(req.blocks) < self.M else None)
                 if new is None:
                     # out of KV memory: finish with length (preemption is a
                     # later-stage feature; SURVEY.md §7 stage 5)
@@ -477,6 +505,88 @@ class EngineCore:
                 self._block_tables[i, len(req.blocks) - 1] = new[0]
             self._emit(req, tok, float(logprobs[i]))
             self._maybe_finish_after_emit(req)
+
+    def _decode_step_multi(self, K: int) -> None:
+        """K fused decode steps, one dispatch, one host harvest: sampled
+        tokens chain into the next step on device (lax.scan), so the
+        device→host fetch — the dominant per-step cost on high-latency
+        links — is paid once per K tokens. EOS/cancel/max_tokens are
+        applied at harvest: device steps past a finish are discarded (the
+        documented K-1-steps-of-waste trade, EngineConfig)."""
+        # pre-grow block tables: the scan writes KV at positions
+        # pos..pos+K-1 and the next dispatch's input sits at pos+K
+        capacity = self.M * self.cfg.kv_block_size
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            if s.pos + K + 1 > capacity:
+                # within K tokens of the context capacity: finish now
+                # rather than let the scan write past the block table
+                # (bounded early stop, same K-granularity trade as EOS)
+                self._release_slot(s)
+                self._finish_request(s, FinishReason.LENGTH)
+                continue
+            need = self._blocks_needed(s.pos + K + 1)
+            if need > len(s.blocks):
+                new = self.kv_manager.pool.alloc_uninit(need - len(s.blocks))
+                if new is None:
+                    # out of KV memory: finish with length (same policy as
+                    # the single-step path's mid-decode allocation failure)
+                    self._release_slot(s)
+                    self._finish_request(s, FinishReason.LENGTH)
+                    continue
+                s.blocks.extend(new)
+                self._block_tables[i, :len(s.blocks)] = s.blocks
+        active_idx = [i for i, s in enumerate(self.slots) if s is not None]
+        if not active_idx:
+            return
+        steps = np.zeros((self.B,), np.int64)
+        for i in range(self.B):
+            s = self.slots[i]
+            if s is None:
+                self._tokens[i] = 0
+                self._positions[i] = 0
+                self._block_tables[i, :] = 0  # trash block
+            else:
+                self._tokens[i] = s.last_token
+                self._positions[i] = s.pos
+                steps[i] = s.generated
+        self._step += K
+        toks_k, logprobs_k, self.kv = self._decode_k_jit(
+            self.params, self.kv,
+            jnp.asarray(self._tokens), jnp.asarray(self._positions),
+            jnp.asarray(self._block_tables),
+            jnp.asarray(self._seeds), jnp.asarray(steps),
+            jnp.asarray(self._samp["temperature"]),
+            jnp.asarray(self._samp["top_k"]),
+            jnp.asarray(self._samp["top_p"]))
+        toks_k = np.asarray(toks_k)            # [K, B] — ONE host fetch
+        logprobs_k = np.asarray(logprobs_k)
+        for i in active_idx:
+            req = self.slots[i]
+            if req is None:
+                continue
+            input_tok = int(self._tokens[i])
+            for k in range(K):
+                if req.cancelled:
+                    self._release_slot(req)
+                    self._finish_request(req, FinishReason.CANCELLED)
+                    break
+                tok = int(toks_k[k, i])
+                if req.seq is not None:
+                    req.seq.append(input_tok)
+                    req.registered_blocks = \
+                        self.kv_manager.register_full_blocks(
+                            req.blocks, req.seq, req.registered_blocks)
+                req.pos += 1
+                req.generated += 1
+                req.last_token = tok
+                self.total_decode_tokens += 1
+                self._emit(req, tok, float(logprobs_k[k, i]))
+                self._maybe_finish_after_emit(req)
+                if self.slots[i] is not req:
+                    break                      # finished: drop device overrun
+                input_tok = tok
 
     # ------------------------------------------------------------- finishes
     def _emit(self, req: EngineRequest, token: int, logprob: float) -> None:
@@ -497,6 +607,12 @@ class EngineCore:
         if req.slot >= 0 and self.slots[req.slot] is req:
             self.slots[req.slot] = None
             self._block_tables[req.slot, :] = 0
+            # reset sampler state: stale top_p/top_k would keep the
+            # whole-batch `need_filter` predicate true and defeat the
+            # sampler's sort-free fast path
+            self._samp["temperature"][req.slot] = 0.0
+            self._samp["top_k"][req.slot] = 0
+            self._samp["top_p"][req.slot] = 1.0
         # write registered prefix blocks back to the host tier before the
         # device copies can be evicted; the extra hold keeps them pinned
         # until the async copy lands (released by the offload engine)

@@ -63,22 +63,33 @@ def sample_tokens(logits: jax.Array, keys: jax.Array,
 
     temp = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / temp
-    order = jnp.argsort(-scaled, axis=-1)                       # [B, V] desc
-    sorted_logits = jnp.take_along_axis(scaled, order, axis=-1)
-    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(sorted_probs, axis=-1)
-    keep_p = (cum - sorted_probs) < top_p[:, None]
-    k_eff = jnp.where(top_k > 0, top_k, V)[:, None]
-    keep_k = jnp.arange(V)[None, :] < k_eff
-    keep = keep_p & keep_k
-    keep = keep.at[:, 0].set(True)
-    masked = jnp.where(keep, sorted_logits, NEG_INF)
-
     gumbel = jax.vmap(
         lambda k: jax.random.gumbel(k, (V,), dtype=jnp.float32))(keys)
-    choice_sorted = jnp.argmax(masked + gumbel, axis=-1)
-    sampled_tok = jnp.take_along_axis(
-        order, choice_sorted[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+    def _plain(_):
+        # no top-k/top-p anywhere in the batch: Gumbel-argmax IS exact
+        # temperature sampling, and skips the [B, V] argsort that would
+        # otherwise dominate the decode step at 100k+ vocabs
+        return jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
+
+    def _filtered(_):
+        order = jnp.argsort(-scaled, axis=-1)                   # [B, V] desc
+        sorted_logits = jnp.take_along_axis(scaled, order, axis=-1)
+        sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(sorted_probs, axis=-1)
+        keep_p = (cum - sorted_probs) < top_p[:, None]
+        k_eff = jnp.where(top_k > 0, top_k, V)[:, None]
+        keep_k = jnp.arange(V)[None, :] < k_eff
+        keep = keep_p & keep_k
+        keep = keep.at[:, 0].set(True)
+        masked = jnp.where(keep, sorted_logits, NEG_INF)
+        sorted_gumbel = jnp.take_along_axis(gumbel, order, axis=-1)
+        choice_sorted = jnp.argmax(masked + sorted_gumbel, axis=-1)
+        return jnp.take_along_axis(
+            order, choice_sorted[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+    need_filter = jnp.any((top_p < 1.0) | (top_k > 0))
+    sampled_tok = jax.lax.cond(need_filter, _filtered, _plain, None)
 
     tok = jnp.where(temperature <= 0.0, greedy_tok, sampled_tok)
     chosen_logprob = jnp.take_along_axis(

@@ -52,6 +52,10 @@ def build_parser() -> argparse.ArgumentParser:
     # engine knobs (flags.rs analogs)
     p.add_argument("--max-model-len", type=int, default=4096)
     p.add_argument("--kv-block-size", type=int, default=16)
+    p.add_argument("--decode-steps-per-dispatch", type=int, default=1,
+                   help="fuse K decode steps per XLA dispatch (amortizes "
+                        "device→host token-harvest latency; EOS/cancel "
+                        "react at K-step granularity)")
     p.add_argument("--num-kv-blocks", type=int, default=2048)
     p.add_argument("--max-num-seqs", type=int, default=8)
     p.add_argument("--host-kv-blocks", type=int, default=0,
@@ -122,6 +126,7 @@ def engine_config(args):
         max_num_seqs=args.max_num_seqs,
         enable_prefix_reuse=not args.no_prefix_reuse,
         host_kv_blocks=args.host_kv_blocks,
+        decode_steps_per_dispatch=args.decode_steps_per_dispatch,
         tp=args.tp, sp=args.sp, dp=args.dp, ep=args.ep)
 
 

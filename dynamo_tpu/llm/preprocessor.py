@@ -219,6 +219,10 @@ class OpenAIPreprocessor(Operator):
                if is_chat else
                CompletionDeltaGenerator(req.model, request_id=f"cmpl-{request.id}"))
 
+        # engines report chosen-token logprobs unconditionally; the wire
+        # only carries them when the client asked (OpenAI conformance)
+        want_logprobs = pre.output_options.logprobs is not None
+
         async def backward() -> AsyncIterator[Annotated[dict]]:
             for ann in annotations:
                 yield ann
@@ -278,7 +282,8 @@ class OpenAIPreprocessor(Operator):
                 text = out.text
                 if text is None and out.tokens:
                     text = "".join(out.tokens)
-                logprobs_payload = _format_logprobs(out, is_chat)
+                logprobs_payload = (_format_logprobs(out, is_chat)
+                                    if want_logprobs else None)
                 if matcher is not None and (text
                                             or logprobs_payload is not None):
                     # nothing escapes mid-buffer: empty-text deltas carrying
