@@ -110,10 +110,75 @@ class DecodeStream:
         return delta
 
 
-def load_tokenizer(model_dir_or_file: str) -> HuggingFaceTokenizer:
-    """Load from a tokenizer.json path or an HF-style model directory."""
+class SentencePieceTokenizer:
+    """SentencePiece-model tokenizer behind the same interface as
+    HuggingFaceTokenizer (reference lib/llm/src/tokenizers/sp.rs — the
+    second tokenizer kind the model card can declare). Gated on the
+    `sentencepiece` package: constructing without it raises with guidance,
+    keeping the framework importable everywhere."""
+
+    def __init__(self, processor):
+        self._sp = processor
+
+    @classmethod
+    def from_file(cls, path: str) -> "SentencePieceTokenizer":
+        try:
+            import sentencepiece as spm
+        except ImportError as e:  # pragma: no cover - env without the lib
+            raise RuntimeError(
+                "sentencepiece models need the `sentencepiece` package "
+                f"(loading {path!r}); install it or convert the model to "
+                "an HF tokenizer.json") from e
+        sp = spm.SentencePieceProcessor()
+        sp.Load(path)
+        return cls(sp)
+
+    def encode(self, text: str, add_special_tokens: bool = False) -> Encoding:
+        ids = self._sp.EncodeAsIds(text)
+        if add_special_tokens and self._sp.bos_id() >= 0:
+            ids = [self._sp.bos_id()] + ids
+        return Encoding(ids=list(ids))
+
+    def decode(self, ids: Sequence[int],
+               skip_special_tokens: bool = True) -> str:
+        if skip_special_tokens:
+            control = {i for i in (self._sp.bos_id(), self._sp.eos_id(),
+                                   self._sp.pad_id()) if i >= 0}
+            ids = [i for i in ids if i not in control]
+        return self._sp.DecodeIds(list(ids))
+
+    def id_to_token(self, token_id: int) -> Optional[str]:
+        try:
+            return self._sp.IdToPiece(int(token_id))
+        except Exception:  # noqa: BLE001 — out-of-range ids
+            return None
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        tid = self._sp.PieceToId(token)
+        return tid if tid >= 0 else None
+
+    @property
+    def vocab_size(self) -> int:
+        return int(self._sp.GetPieceSize())
+
+    def decode_stream(self, skip_special_tokens: bool = True) -> "DecodeStream":
+        return DecodeStream(self, skip_special_tokens=skip_special_tokens)
+
+
+def load_tokenizer(model_dir_or_file: str):
+    """Load from a tokenizer.json / .model path or an HF-style model
+    directory; HF tokenizer.json is preferred, sentencepiece
+    tokenizer.model is the fallback kind (reference model_card tokenizer
+    detection, model_card/create.rs)."""
     if os.path.isdir(model_dir_or_file):
+        sp_path = os.path.join(model_dir_or_file, "tokenizer.model")
+        if (not os.path.exists(os.path.join(model_dir_or_file,
+                                            "tokenizer.json"))
+                and os.path.exists(sp_path)):
+            return SentencePieceTokenizer.from_file(sp_path)
         return HuggingFaceTokenizer.from_pretrained_dir(model_dir_or_file)
+    if model_dir_or_file.endswith(".model"):
+        return SentencePieceTokenizer.from_file(model_dir_or_file)
     return HuggingFaceTokenizer.from_file(model_dir_or_file)
 
 

@@ -1,0 +1,127 @@
+"""Topology-aware static placement of serving roles onto TPU devices.
+
+The reference ships no working planner (its "Planner" is aspirational;
+SURVEY.md §7 stage 8 scopes ours as a static placer reading the real
+topology). This module turns `jax.devices()` into a host/coords snapshot and
+assigns prefill/decode/router roles to chip groups such that:
+
+- a worker's chips are ICI-contiguous (same host, adjacent coords) so its
+  tp/sp collectives never cross DCN;
+- different roles are packed from opposite ends of the host list, so
+  prefill and decode fleets land on disjoint hosts when capacity allows
+  (the disaggregation win depends on them not stealing each other's HBM
+  bandwidth);
+- the result is serializable and feeds the SDK allocator's
+  `TPU_VISIBLE_CHIPS` env contract (sdk/allocator.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["DeviceInfo", "Topology", "Placement", "snapshot_topology",
+           "plan_placement"]
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceInfo:
+    id: int
+    process_index: int
+    coords: Optional[tuple] = None   # TPU (x, y, z) when exposed
+    local_index: int = 0             # position within its host
+
+
+@dataclasses.dataclass
+class Topology:
+    devices: List[DeviceInfo]
+
+    @property
+    def hosts(self) -> Dict[int, List[DeviceInfo]]:
+        out: Dict[int, List[DeviceInfo]] = {}
+        for d in self.devices:
+            out.setdefault(d.process_index, []).append(d)
+        for devs in out.values():
+            devs.sort(key=lambda d: (d.coords or (d.id,), d.id))
+        return out
+
+
+@dataclasses.dataclass
+class Placement:
+    role: str
+    index: int                       # replica number within the role
+    process_index: int
+    devices: List[DeviceInfo]
+
+    def env(self) -> Dict[str, str]:
+        """Per-process env pinning this worker to its chips (same contract
+        as sdk/allocator.py Allocation.env: both variables, so multiple
+        engine processes can subslice one host's chips)."""
+        if not self.devices:
+            return {}
+        return {"TPU_VISIBLE_CHIPS": ",".join(
+                    str(d.local_index) for d in self.devices),
+                "TPU_CHIPS_PER_PROCESS_BOUNDS":
+                    f"1,1,{len(self.devices)}"}
+
+    def device_ids(self) -> List[int]:
+        return [d.id for d in self.devices]
+
+
+def snapshot_topology(devices: Optional[Sequence] = None) -> Topology:
+    """Build a Topology from live `jax.devices()` (or any objects with
+    `.id` / `.process_index` / optional `.coords`)."""
+    if devices is None:
+        import jax
+        devices = jax.devices()
+    per_host_counter: Dict[int, int] = {}
+    infos = []
+    for d in sorted(devices, key=lambda d: (d.process_index, d.id)):
+        li = per_host_counter.get(d.process_index, 0)
+        per_host_counter[d.process_index] = li + 1
+        infos.append(DeviceInfo(
+            id=d.id, process_index=d.process_index,
+            coords=tuple(getattr(d, "coords", ()) or ()) or None,
+            local_index=li))
+    return Topology(infos)
+
+
+def plan_placement(topology: Topology,
+                   roles: Sequence[dict]) -> List[Placement]:
+    """Assign chip groups to roles.
+
+    ``roles``: [{"role": "decode", "count": 2, "chips": 4}, ...] in
+    priority order. Raises when a worker can't get an ICI-contiguous group
+    (a group never spans hosts) or capacity runs out.
+
+    Packing: the first role fills hosts front-to-back, the second
+    back-to-front, alternating — so e.g. decode and prefill fleets occupy
+    disjoint hosts whenever the chip math allows.
+    """
+    hosts = topology.hosts
+    host_order = sorted(hosts)
+    free: Dict[int, List[DeviceInfo]] = {h: list(hosts[h])
+                                         for h in host_order}
+    placements: List[Placement] = []
+    for role_i, spec in enumerate(roles):
+        role, count = spec["role"], int(spec.get("count", 1))
+        chips = int(spec.get("chips", 1))
+        order = host_order if role_i % 2 == 0 else list(reversed(host_order))
+        for idx in range(count):
+            placed = False
+            if chips == 0:
+                placements.append(Placement(role, idx, -1, []))
+                continue
+            for h in order:
+                if len(free[h]) >= chips:
+                    take, free[h] = free[h][:chips], free[h][chips:]
+                    placements.append(Placement(role, idx, h, take))
+                    placed = True
+                    break
+            if not placed:
+                biggest = max((len(v) for v in free.values()), default=0)
+                raise ValueError(
+                    f"cannot place {role}[{idx}]: needs {chips} contiguous "
+                    f"chips on one host, largest free host block is "
+                    f"{biggest} (groups never span hosts — ICI only)")
+    return placements

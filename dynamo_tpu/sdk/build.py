@@ -1,0 +1,181 @@
+"""`python -m dynamo_tpu.sdk.build graphs.agg:Frontend -f cfg.yaml -o out/`
+— package a service graph into a deployable artifact.
+
+Reference: the SDK's `dynamo build` / `dynamo deploy` pair
+(deploy/dynamo/sdk/src/dynamo/sdk/cli/{bentos,deploy}.py) packages the graph
+as a bento and uploads it to the api-server control plane. TPU-native scope
+(SURVEY.md §2.3 item 7: manifests instead of an operator): the artifact is a
+directory with
+
+- ``manifest.json`` — the resolved graph: services, endpoints, deps,
+  namespaces, resource requests, entry target;
+- ``config.yaml`` — the service config, verbatim;
+- ``k8s/`` — one generated Deployment per service running the serve worker
+  (plus the shared discovery daemon), ready for `kubectl apply -f`;
+- ``run.sh`` — the local single-host launch line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+from typing import List, Optional
+
+from .config import ServiceConfig
+from .serve_worker import resolve_service
+from .service import DynamoService
+
+_K8S_DEPLOYMENT = """\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: {name}
+  namespace: {k8s_namespace}
+  labels: {{app: {name}}}
+spec:
+  replicas: {replicas}
+  selector:
+    matchLabels: {{app: {name}}}
+  template:
+    metadata:
+      labels: {{app: {name}}}
+    spec:
+      containers:
+        - name: service
+          image: {image}
+          command: ["python", "-m", "dynamo_tpu.sdk.serve_worker",
+                    "--target", "{target}",
+                    "--service-name", "{service}",
+                    "--runtime-server", "discovery:6510"]
+          env:
+            - {{name: DYNAMO_SERVICE_CONFIG, value: {config_env}}}
+{resources}"""
+
+_K8S_DISCOVERY = """\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: discovery
+  namespace: {k8s_namespace}
+  labels: {{app: discovery}}
+spec:
+  replicas: 1
+  selector:
+    matchLabels: {{app: discovery}}
+  template:
+    metadata:
+      labels: {{app: discovery}}
+    spec:
+      containers:
+        - name: discovery
+          image: {image}
+          command: ["python", "-m", "dynamo_tpu.runtime.server",
+                    "--host", "0.0.0.0", "--port", "6510"]
+          ports:
+            - {{containerPort: 6510, name: runtime}}
+---
+apiVersion: v1
+kind: Service
+metadata:
+  name: discovery
+  namespace: {k8s_namespace}
+spec:
+  selector: {{app: discovery}}
+  ports:
+    - {{port: 6510, targetPort: 6510, name: runtime}}
+"""
+
+_K8S_TPU_RESOURCES = """\
+          resources:
+            requests: {{"google.com/tpu": "{tpu}", cpu: "4", memory: 16Gi}}
+            limits: {{"google.com/tpu": "{tpu}"}}
+      nodeSelector:
+        cloud.google.com/gke-tpu-accelerator: tpu-v5-lite-podslice
+"""
+
+_K8S_CPU_RESOURCES = """\
+          resources:
+            requests: {cpu: "1", memory: 2Gi}
+"""
+
+
+def build_artifact(target: str, config_path: Optional[str], out_dir: str,
+                   image: str = "dynamo-tpu:latest",
+                   k8s_namespace: str = "dynamo-tpu") -> dict:
+    entry = resolve_service(target)
+    graph: List[DynamoService] = entry.graph()
+    cfg = (ServiceConfig.from_yaml(config_path) if config_path
+           else ServiceConfig())
+
+    os.makedirs(out_dir, exist_ok=True)
+    k8s_dir = os.path.join(out_dir, "k8s")
+    os.makedirs(k8s_dir, exist_ok=True)
+
+    manifest = {
+        "target": target,
+        "entry": entry.name,
+        "services": [{
+            "name": s.name,
+            "namespace": s.namespace,
+            "endpoints": sorted(s.endpoints),
+            "depends": sorted(d.on.name for d in s.dependencies.values()),
+            "links": [l.name for l in s.links],
+            "resources": {"tpu": s.resources.tpu},
+        } for s in graph],
+    }
+    with open(os.path.join(out_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+
+    if config_path:
+        shutil.copy(config_path, os.path.join(out_dir, "config.yaml"))
+
+    with open(os.path.join(k8s_dir, "discovery.yaml"), "w") as f:
+        f.write(_K8S_DISCOVERY.format(k8s_namespace=k8s_namespace,
+                                      image=image))
+
+    # the env value is a JSON string inside YAML: json.dumps again yields a
+    # double-quoted scalar with YAML-compatible escaping
+    config_env = json.dumps(cfg.to_env())
+    for svc in graph:
+        override = cfg.tpu_override(svc.name)
+        tpu = svc.resources.tpu if override is None else override
+        replicas = cfg.get(svc.name, "replicas")
+        body = _K8S_DEPLOYMENT.format(
+            name=svc.name.lower(), k8s_namespace=k8s_namespace,
+            replicas=1 if replicas is None else int(replicas),
+            image=image, target=target, service=svc.name,
+            config_env=config_env,
+            resources=(_K8S_TPU_RESOURCES.format(tpu=tpu) if tpu
+                       else _K8S_CPU_RESOURCES))
+        with open(os.path.join(k8s_dir, f"{svc.name.lower()}.yaml"),
+                  "w") as f:
+            f.write(body)
+
+    run_line = (f"python -m dynamo_tpu.sdk.serve {target}"
+                + (" -f config.yaml" if config_path else ""))
+    with open(os.path.join(out_dir, "run.sh"), "w") as f:
+        f.write('#!/bin/sh\n# local single-host launch\n'
+                'cd "$(dirname "$0")"\n' + run_line + "\n")
+    os.chmod(os.path.join(out_dir, "run.sh"), 0o755)
+    return manifest
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="dynamo-tpu-build")
+    p.add_argument("target", help="graph entry, e.g. pkg.graphs.agg:Frontend")
+    p.add_argument("-f", "--config", help="service config YAML")
+    p.add_argument("-o", "--out", required=True, help="artifact directory")
+    p.add_argument("--image", default="dynamo-tpu:latest")
+    p.add_argument("--k8s-namespace", default="dynamo-tpu")
+    args = p.parse_args(argv)
+    manifest = build_artifact(args.target, args.config, args.out,
+                              image=args.image,
+                              k8s_namespace=args.k8s_namespace)
+    print(f"built {args.out}: {len(manifest['services'])} services "
+          f"({', '.join(s['name'] for s in manifest['services'])})")
+
+
+if __name__ == "__main__":
+    main()

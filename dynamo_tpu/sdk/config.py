@@ -55,6 +55,17 @@ class ServiceConfig:
     def get(self, service: str, key: str, default: Any = None) -> Any:
         return self.for_service(service).get(key, default)
 
+    def tpu_override(self, service: str) -> Any:
+        """Chip count from the service's YAML ``resources`` section, or
+        None when the section doesn't set one — the single home of the
+        'config resources win over the class declaration' rule used by
+        both the serve allocator and artifact generation."""
+        res = self.get(service, "resources") or {}
+        if "tpu" in res or "gpu" in res:
+            from .service import Resources
+            return Resources.tpu_count(res)
+        return None
+
     def as_args(self, service: str, prefix: str = "") -> List[str]:
         """Flatten a service section into ``--key value`` CLI args
         (reference as_args; booleans become bare flags when true)."""

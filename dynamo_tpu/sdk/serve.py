@@ -21,7 +21,6 @@ from typing import Dict, List, Optional
 
 from .allocator import TpuAllocator
 from .config import ENV_VAR, ServiceConfig
-from .service import Resources
 from .serve_worker import resolve_service
 
 logger = logging.getLogger("dynamo_tpu.sdk.serve")
@@ -119,11 +118,8 @@ async def amain(argv=None) -> None:
         # a TpuWorker running its echo engine needs no chips (the reference
         # reads resources from the service config the same way,
         # cli/allocator.py:28-120)
-        res = cfg.get(svc.name, "resources") or {}
-        if "tpu" in res or "gpu" in res:
-            want = Resources.tpu_count(res)
-        else:
-            want = svc.resources.tpu
+        override = cfg.tpu_override(svc.name)
+        want = svc.resources.tpu if override is None else override
         alloc = allocator.allocate(svc.name, want)
         env = {ENV_VAR: cfg.to_env(), **alloc.env()}
         watchers.append(Watcher(args.target, svc.name, runtime_server, env))

@@ -1,0 +1,71 @@
+"""Topology-aware placement (SURVEY.md §7 stage 8): contiguous chip groups,
+role separation across hosts, allocator env contract."""
+
+import dataclasses
+
+import pytest
+
+from dynamo_tpu.parallel.planner import (DeviceInfo, Topology,
+                                         plan_placement, snapshot_topology)
+
+
+@dataclasses.dataclass
+class FakeDev:
+    id: int
+    process_index: int
+    coords: tuple = ()
+
+
+def two_host_topology(chips_per_host=4):
+    devs = [FakeDev(id=h * chips_per_host + i, process_index=h,
+                    coords=(i, h, 0))
+            for h in range(2) for i in range(chips_per_host)]
+    return snapshot_topology(devs)
+
+
+def test_snapshot_orders_and_indexes():
+    topo = two_host_topology()
+    assert len(topo.devices) == 8
+    hosts = topo.hosts
+    assert set(hosts) == {0, 1}
+    assert [d.local_index for d in hosts[0]] == [0, 1, 2, 3]
+
+
+def test_roles_land_on_disjoint_hosts():
+    topo = two_host_topology()
+    placements = plan_placement(topo, [
+        {"role": "decode", "count": 1, "chips": 4},
+        {"role": "prefill", "count": 1, "chips": 4},
+    ])
+    decode, prefill = placements
+    assert decode.process_index != prefill.process_index
+    assert len(decode.devices) == 4
+    assert decode.env()["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+    # disjoint chips overall
+    assert not set(decode.device_ids()) & set(prefill.device_ids())
+
+
+def test_groups_never_span_hosts():
+    topo = two_host_topology(chips_per_host=4)
+    with pytest.raises(ValueError, match="never span hosts"):
+        plan_placement(topo, [{"role": "big", "count": 1, "chips": 6}])
+
+
+def test_capacity_exhaustion_and_zero_chip_roles():
+    topo = two_host_topology()
+    placements = plan_placement(topo, [
+        {"role": "decode", "count": 2, "chips": 4},
+        {"role": "router", "count": 3, "chips": 0},
+    ])
+    assert [p.role for p in placements] == ["decode"] * 2 + ["router"] * 3
+    assert placements[0].process_index != placements[1].process_index
+    assert placements[2].env() == {}
+    with pytest.raises(ValueError):
+        plan_placement(topo, [{"role": "decode", "count": 3, "chips": 4}])
+
+
+def test_snapshot_from_live_jax_devices():
+    topo = snapshot_topology()          # 8 virtual CPU devices (conftest)
+    assert len(topo.devices) >= 1
+    assert plan_placement(topo, [
+        {"role": "w", "count": 1, "chips": 1}])[0].devices

@@ -204,3 +204,30 @@ async def test_token_ids_annotation(mdc):
     stream = await pipeline.generate(Context(req))
     events = [a async for a in stream]
     assert any(a.event == "token_ids" for a in events)
+
+
+def test_sentencepiece_gating(tmp_path):
+    """.model files route to the sentencepiece kind; without the library
+    the error says so instead of crashing on import (reference
+    tokenizers/sp.rs is the second tokenizer kind)."""
+    from dynamo_tpu.llm.tokenizer import load_tokenizer
+    fake = tmp_path / "tokenizer.model"
+    fake.write_bytes(b"\x00spm")
+    try:
+        import sentencepiece  # noqa: F401
+        with pytest.raises(Exception):   # invalid model file
+            load_tokenizer(str(fake))
+    except ImportError:
+        with pytest.raises(RuntimeError, match="sentencepiece"):
+            load_tokenizer(str(fake))
+
+
+def test_dir_prefers_hf_tokenizer_json(tmp_path):
+    from dynamo_tpu.llm.tokenizer import (HuggingFaceTokenizer,
+                                          load_tokenizer)
+    # a dir with both artifacts prefers tokenizer.json (HF kind)
+    from tests.fixtures import build_tiny_model_dir
+    d = tmp_path / "both"
+    build_tiny_model_dir(str(d))
+    (d / "tokenizer.model").write_bytes(b"\x00spm")
+    assert isinstance(load_tokenizer(str(d)), HuggingFaceTokenizer)

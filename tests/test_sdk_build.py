@@ -1,0 +1,56 @@
+"""`dynamo_tpu.sdk.build` packaging: manifest, generated K8s, run script
+(reference cli/{bentos,deploy}.py packaging tier)."""
+
+import json
+import os
+
+import yaml
+
+from dynamo_tpu.sdk.build import build_artifact
+
+
+def test_build_artifact_hello_world(tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        "Frontend:\n  greeting: \"don't\"\nBackend:\n  replicas: 2\n")
+    out = tmp_path / "artifact"
+    manifest = build_artifact("examples.hello_world.graph:Frontend",
+                              str(cfg), str(out))
+    names = [s["name"] for s in manifest["services"]]
+    assert names == ["Frontend", "Middle", "Backend"]
+
+    with open(out / "manifest.json") as f:
+        assert json.load(f) == manifest
+    assert (out / "config.yaml").exists()
+    assert os.access(out / "run.sh", os.X_OK)
+    assert "dynamo_tpu.sdk.serve" in (out / "run.sh").read_text()
+
+    # generated k8s parses and carries the right command + config env
+    for svc in names:
+        with open(out / "k8s" / f"{svc.lower()}.yaml") as f:
+            doc = yaml.safe_load(f)
+        c = doc["spec"]["template"]["spec"]["containers"][0]
+        assert c["command"][2] == "dynamo_tpu.sdk.serve_worker"
+        assert svc in c["command"]
+        env = {e["name"]: e["value"] for e in c["env"]}
+        import json as _json
+        assert _json.loads(env["DYNAMO_SERVICE_CONFIG"])[
+            "Frontend"]["greeting"] == "don't"   # YAML-safe quoting
+    with open(out / "k8s" / "backend.yaml") as f:
+        assert yaml.safe_load(f)["spec"]["replicas"] == 2
+    # self-contained: the discovery daemon the workers dial is included
+    with open(out / "k8s" / "discovery.yaml") as f:
+        kinds = [d["kind"] for d in yaml.safe_load_all(f)]
+    assert kinds == ["Deployment", "Service"]
+
+
+def test_build_tpu_resources(tmp_path):
+    import examples.llm.graphs.agg  # noqa: F401 — links
+    out = tmp_path / "a"
+    build_artifact("examples.llm.graphs.agg:Frontend", None, str(out))
+    with open(out / "k8s" / "tpuworker.yaml") as f:
+        doc = yaml.safe_load(f)
+    spec = doc["spec"]["template"]["spec"]
+    assert spec["containers"][0]["resources"]["requests"][
+        "google.com/tpu"] == "1"
+    assert "nodeSelector" in spec
