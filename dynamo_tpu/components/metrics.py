@@ -193,7 +193,8 @@ async def amain(argv=None) -> None:
 
 
 def main() -> None:
-    logging.basicConfig(level=logging.INFO)
+    from ..runtime.log import setup_logging
+    setup_logging()
     asyncio.run(amain())
 
 

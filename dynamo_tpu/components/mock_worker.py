@@ -101,7 +101,8 @@ async def amain(argv=None) -> None:
     p.add_argument("--endpoint", default="dyn://dynamo/worker/generate")
     p.add_argument("--kv-block-size", type=int, default=16)
     args = p.parse_args(argv)
-    logging.basicConfig(level=logging.INFO)
+    from ..runtime.log import setup_logging
+    setup_logging()
     runtime = await DistributedRuntime.connect(args.runtime_server)
     worker = await MockTokenWorker(runtime, args.endpoint,
                                    block_size=args.kv_block_size).start()

@@ -338,8 +338,6 @@ class EngineCore:
             # in the pool's blocks (this is the TTFT win of prefix reuse)
             chunk = req.prompt[req.prefix_hit_tokens:]
             bucket = self.cfg.bucket_for(len(chunk))
-            padded = np.zeros((bucket,), np.int32)
-            padded[:len(chunk)] = chunk
             table = np.zeros((self.M,), np.int32)
             table[:len(req.blocks)] = req.blocks
             key = make_slot_keys(self.cfg.seed,
@@ -350,6 +348,8 @@ class EngineCore:
                       and len(chunk) >= self.cfg.sp_min_prefill_tokens
                       and bucket % self._sp == 0)
             if use_sp:
+                padded = np.zeros((bucket,), np.int32)
+                padded[:len(chunk)] = chunk
                 tok, logprob, self.kv = self._prefill_sp_jit(
                     self.params, self.kv, jnp.asarray(padded),
                     jnp.asarray(table), jnp.asarray(len(chunk), jnp.int32),
@@ -357,7 +357,12 @@ class EngineCore:
                     jnp.asarray(req.sampling.temperature, jnp.float32),
                     jnp.asarray(req.sampling.top_k, jnp.int32),
                     jnp.asarray(req.sampling.top_p, jnp.float32))
+            elif (self.cfg.prefill_chunk > 0
+                    and len(chunk) > self.cfg.prefill_chunk):
+                tok, logprob = self._chunked_prefill(req, chunk, table, key)
             else:
+                padded = np.zeros((bucket,), np.int32)
+                padded[:len(chunk)] = chunk
                 tok, logprob, self.kv = self._prefill_jit(
                     self.params, self.kv, jnp.asarray(padded),
                     jnp.asarray(table),
@@ -395,6 +400,36 @@ class EngineCore:
         self._emit(req, tok, float(logprob))
         self._maybe_finish_after_emit(req)
         return True
+
+    def _chunked_prefill(self, req: EngineRequest, chunk: list,
+                         table: np.ndarray, key) -> tuple:
+        """Prompt prefill as a sequence of fixed-size chunk dispatches
+        (EngineConfig.prefill_chunk): each chunk continues at
+        ``start_pos`` against the KV already written — the same mechanism
+        as prefix-reuse continuation — so one compiled chunk shape serves
+        any prompt length, bounding both compile count and per-dispatch
+        activation memory (SURVEY.md §7 "blockwise prefill chunks"). Only
+        the final chunk's sampled token matters."""
+        C = self.cfg.prefill_chunk
+        off = req.prefix_hit_tokens
+        tok = logprob = None
+        for lo in range(0, len(chunk), C):
+            piece = chunk[lo:lo + C]
+            # the tail pads to C too: exactly ONE compiled prefill shape
+            # regardless of prompt length or bucket list
+            padded = np.zeros((C,), np.int32)
+            padded[:len(piece)] = piece
+            tok, logprob, self.kv = self._prefill_jit(
+                self.params, self.kv, jnp.asarray(padded),
+                jnp.asarray(table),
+                jnp.asarray(off, jnp.int32),
+                jnp.asarray(len(piece), jnp.int32),
+                key,
+                jnp.asarray(req.sampling.temperature, jnp.float32),
+                jnp.asarray(req.sampling.top_k, jnp.int32),
+                jnp.asarray(req.sampling.top_p, jnp.float32))
+            off += len(piece)
+        return tok, logprob
 
     def _admit_precomputed(self, req: EngineRequest,
                            n_already: int) -> tuple:

@@ -52,6 +52,9 @@ def build_parser() -> argparse.ArgumentParser:
     # engine knobs (flags.rs analogs)
     p.add_argument("--max-model-len", type=int, default=4096)
     p.add_argument("--kv-block-size", type=int, default=16)
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="split prompt prefill into fixed-size chunk "
+                        "dispatches (0 = whole-prompt)")
     p.add_argument("--decode-steps-per-dispatch", type=int, default=1,
                    help="fuse K decode steps per XLA dispatch (amortizes "
                         "device→host token-harvest latency; EOS/cancel "
@@ -126,6 +129,7 @@ def engine_config(args):
         max_num_seqs=args.max_num_seqs,
         enable_prefix_reuse=not args.no_prefix_reuse,
         host_kv_blocks=args.host_kv_blocks,
+        prefill_chunk=args.prefill_chunk,
         decode_steps_per_dispatch=args.decode_steps_per_dispatch,
         tp=args.tp, sp=args.sp, dp=args.dp, ep=args.ep)
 
@@ -381,9 +385,8 @@ async def run_prefill_worker(args, core, runtime) -> None:
 
 async def amain(argv=None) -> None:
     args = build_parser().parse_args(argv)
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    from ..runtime.log import setup_logging
+    setup_logging('debug' if args.verbose else None)
     src, out = parse_io(args.io)
 
     runtime = await make_runtime(args)

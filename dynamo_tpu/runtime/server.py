@@ -290,7 +290,8 @@ def main() -> None:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=6510)
     args = ap.parse_args()
-    logging.basicConfig(level=logging.INFO)
+    from .log import setup_logging
+    setup_logging()
     try:
         asyncio.run(_amain(args.host, args.port))
     except KeyboardInterrupt:

@@ -30,25 +30,38 @@ class Worker:
 
     def __init__(self, runtime: Optional[DistributedRuntime] = None,
                  graceful_timeout: Optional[float] = None):
+        self._config = None
         self.runtime = runtime
-        if graceful_timeout is None:
-            graceful_timeout = float(os.environ.get(
-                "DYN_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT", "30"))
-        self.graceful_timeout = graceful_timeout
+        self.graceful_timeout = (self.config.graceful_shutdown_timeout
+                                 if graceful_timeout is None
+                                 else graceful_timeout)
+
+    @property
+    def config(self):
+        """Lazily loaded layered WorkerConfig — embedders that pass both
+        runtime and timeout never touch the filesystem."""
+        if self._config is None:
+            from .config import load_worker_config
+            self._config = load_worker_config()
+        return self._config
 
     @classmethod
     def from_settings(cls) -> "Worker":
-        """Build from environment: ``DYN_DISCOVERY_ADDR`` selects the
-        networked runtime; unset means in-process."""
+        """Build from layered config (runtime/config.py): discovery_addr
+        set (env ``DYN_DISCOVERY_ADDR`` / ``DYN_WORKER_DISCOVERY_ADDR`` or
+        TOML) selects the networked runtime; unset means in-process. Also
+        installs the DYN_LOG/DYN_LOGGING_JSONL logging setup."""
+        from .log import setup_logging
+        setup_logging()
         return cls()
 
     async def _build_runtime(self) -> DistributedRuntime:
         if self.runtime is not None:
             return self.runtime
-        addr = os.environ.get("DYN_DISCOVERY_ADDR", "")
-        if addr:
+        if self.config.discovery_addr:
             self.runtime = await DistributedRuntime.connect(
-                addr, advertise=os.environ.get("DYN_ADVERTISE_HOST"))
+                self.config.discovery_addr,
+                advertise=self.config.advertise_host)
         else:
             self.runtime = DistributedRuntime.in_process()
         return self.runtime

@@ -91,9 +91,8 @@ async def amain(argv=None) -> None:
                    help="override detected TPU chip count")
     p.add_argument("--verbose", "-v", action="store_true")
     args = p.parse_args(argv)
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    from ..runtime.log import setup_logging
+    setup_logging('debug' if args.verbose else None)
 
     entry = resolve_service(args.target)
     graph = entry.graph()

@@ -98,9 +98,8 @@ async def amain(argv=None) -> None:
     p.add_argument("--runtime-server", required=True)
     p.add_argument("--verbose", "-v", action="store_true")
     args = p.parse_args(argv)
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    from ..runtime.log import setup_logging
+    setup_logging('debug' if args.verbose else None)
     entry = resolve_service(args.target)
     svc = find_in_graph(entry, args.service_name)
     runtime = await DistributedRuntime.connect(args.runtime_server)

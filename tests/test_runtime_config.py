@@ -1,0 +1,97 @@
+"""Layered runtime config + logging setup (reference config.rs figment
+layering tests via figment::Jail env sandboxing; ours via monkeypatch)."""
+
+import json
+import logging
+
+import pytest
+
+from dynamo_tpu.runtime.config import (RuntimeConfig, WorkerConfig,
+                                       load_runtime_config,
+                                       load_worker_config)
+from dynamo_tpu.runtime.log import JsonlFormatter, _parse_dyn_log
+
+
+def test_defaults(monkeypatch):
+    for k in list(__import__("os").environ):
+        if k.startswith("DYN_"):
+            monkeypatch.delenv(k, raising=False)
+    cfg = load_runtime_config()
+    assert cfg == RuntimeConfig()
+    assert load_worker_config() == WorkerConfig()
+
+
+def test_toml_then_env_precedence(tmp_path, monkeypatch):
+    toml = tmp_path / "runtime.toml"
+    toml.write_text("""
+[runtime]
+lease_ttl = 3.5
+tcp_host = "0.0.0.0"
+
+[worker]
+graceful_shutdown_timeout = 7
+""")
+    monkeypatch.setenv("DYN_RUNTIME_CONFIG_PATH", str(toml))
+    cfg = load_runtime_config()
+    assert cfg.lease_ttl == 3.5 and cfg.tcp_host == "0.0.0.0"
+    assert load_worker_config().graceful_shutdown_timeout == 7
+
+    # env beats toml
+    monkeypatch.setenv("DYN_RUNTIME_LEASE_TTL", "9")
+    monkeypatch.setenv("DYN_WORKER_GRACEFUL_SHUTDOWN_TIMEOUT", "2.5")
+    assert load_runtime_config().lease_ttl == 9.0
+    assert load_worker_config().graceful_shutdown_timeout == 2.5
+
+
+def test_env_bool_and_optional_coercion(monkeypatch):
+    monkeypatch.setenv("DYN_RUNTIME_NATIVE_DATAPLANE", "false")
+    monkeypatch.setenv("DYN_WORKER_ADVERTISE_HOST", "")
+    assert load_runtime_config().native_dataplane is False
+    assert load_worker_config().advertise_host is None
+    monkeypatch.setenv("DYN_RUNTIME_NATIVE_DATAPLANE", "1")
+    assert load_runtime_config().native_dataplane is True
+
+
+def test_legacy_env_names_still_win(monkeypatch):
+    monkeypatch.setenv("DYN_DISCOVERY_ADDR", "h:1")
+    monkeypatch.setenv("DYN_ADVERTISE_HOST", "pub")
+    cfg = load_worker_config()
+    assert cfg.discovery_addr == "h:1" and cfg.advertise_host == "pub"
+
+
+def test_bad_toml_is_skipped(tmp_path, monkeypatch, caplog):
+    bad = tmp_path / "broken.toml"
+    bad.write_text("[runtime\nlease_ttl = ")
+    monkeypatch.setenv("DYN_RUNTIME_CONFIG_PATH", str(bad))
+    with caplog.at_level(logging.WARNING):
+        assert load_runtime_config() == RuntimeConfig()
+    assert "skipping config file" in caplog.text
+
+
+# ---------------------------------------------------------------- logging
+
+def test_dyn_log_spec_parsing():
+    root, mods = _parse_dyn_log("debug,dynamo_tpu.kv=warning, x.y=error")
+    assert root == logging.DEBUG
+    assert mods == {"dynamo_tpu.kv": logging.WARNING, "x.y": logging.ERROR}
+    root, mods = _parse_dyn_log("info")
+    assert root == logging.INFO and mods == {}
+
+
+def test_jsonl_formatter_shape():
+    rec = logging.LogRecord("dynamo_tpu.test", logging.WARNING, __file__,
+                            1, "hello %s", ("world",), None)
+    line = JsonlFormatter().format(rec)
+    obj = json.loads(line)
+    assert obj["level"] == "WARNING"
+    assert obj["target"] == "dynamo_tpu.test"
+    assert obj["message"] == "hello world"
+    assert obj["iso"].endswith("Z")
+
+    try:
+        raise ValueError("boom")
+    except ValueError:
+        import sys
+        rec2 = logging.LogRecord("t", logging.ERROR, __file__, 1, "bad",
+                                 (), sys.exc_info())
+    assert "boom" in json.loads(JsonlFormatter().format(rec2))["exception"]
