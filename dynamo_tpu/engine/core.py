@@ -65,6 +65,10 @@ class EngineRequest:
     blocks: List[int] = dataclasses.field(default_factory=list)
     pos: int = 0                  # tokens currently in KV
     generated: int = 0
+    # monotone per-request PRNG step: equals `generated` until a
+    # preemption, after which it keeps advancing so recompute never reuses
+    # consumed sampling keys (seeded streams stay reproducible under load)
+    key_step: int = 0
     last_token: int = -1
     prefix_hit_tokens: int = 0
     seq: Optional[TokenBlockSequence] = None   # full token history + hashes
@@ -152,6 +156,7 @@ class EngineCore:
         # serving stats
         self.total_prefill_tokens = 0
         self.total_decode_tokens = 0
+        self.preemptions = 0
 
     # ------------------------------------------------------------------ jit
     def _compile_jits(self) -> None:
@@ -309,6 +314,13 @@ class EngineCore:
         plan = self.kv_manager.prepare_prefill(req.prompt, seq=req.seq)
         if plan is None:
             return False
+        if len(plan.all_blocks) > self.M:
+            # longer than a block table row — reject rather than overflow
+            # the table (external prompts are length-checked upstream, but
+            # preemption-grown prompts and misconfigured callers land here)
+            self.kv_manager.pool.release(plan.all_blocks)
+            self._finish_request(req, FinishReason.LENGTH)
+            return True
         req.slot = slot
         req.blocks = plan.all_blocks
         req.seq = plan.seq
@@ -342,7 +354,7 @@ class EngineCore:
             table[:len(req.blocks)] = req.blocks
             key = make_slot_keys(self.cfg.seed,
                                  jnp.asarray([req.sampling.seed]),
-                                 jnp.asarray(0))[0]
+                                 jnp.asarray(req.key_step))[0]
             use_sp = (self._prefill_sp_jit is not None
                       and req.prefix_hit_tokens == 0
                       and len(chunk) >= self.cfg.sp_min_prefill_tokens
@@ -376,6 +388,7 @@ class EngineCore:
             self.total_prefill_tokens += len(chunk)
         req.pos = n_prompt
         req.generated = 1
+        req.key_step += 1
         req.last_token = tok
         req.first_token_time = time.monotonic()
         # the prompt's full blocks now hold valid KV — register for reuse
@@ -492,7 +505,7 @@ class EngineCore:
             else:
                 self._tokens[i] = s.last_token
                 self._positions[i] = s.pos
-                steps[i] = s.generated
+                steps[i] = s.key_step
         self._step += 1
         keys = make_slot_keys(self.cfg.seed, jnp.asarray(self._seeds),
                               jnp.asarray(steps))
@@ -523,18 +536,29 @@ class EngineCore:
                     req.blocks, req.seq, req.registered_blocks)
             req.pos += 1
             req.generated += 1
+            req.key_step += 1
             req.last_token = tok
             self.total_decode_tokens += 1
             # grow block table if the *next* token would start a new block
             if (req.pos + 1) > len(req.blocks) * bs:
-                new = (self.kv_manager.pool.alloc_uninit(1)
-                       if len(req.blocks) < self.M else None)
-                if new is None:
-                    # out of KV memory: finish with length (preemption is a
-                    # later-stage feature; SURVEY.md §7 stage 5)
+                if len(req.blocks) >= self.M:       # context capacity
                     self._emit(req, tok, float(logprobs[i]))
                     self._release_slot(req)
                     self._finish_request(req, FinishReason.LENGTH)
+                    continue
+                new = self.kv_manager.pool.alloc_uninit(1)
+                if new is None:
+                    # out of KV memory: the sampled token is still valid
+                    # (its input's KV was written) — emit it, then finish
+                    # if it was terminal anyway (EOS / budget / cancel),
+                    # else preempt
+                    self._emit(req, tok, float(logprobs[i]))
+                    if (req.last_token in req.eos_ids
+                            or req.generated >= req.max_new_tokens
+                            or req.cancelled):
+                        self._maybe_finish_after_emit(req)
+                    else:
+                        self._preempt_or_finish(req)
                     continue
                 req.blocks.extend(new)
                 self._block_tables[i, len(req.blocks) - 1] = new[0]
@@ -565,10 +589,9 @@ class EngineCore:
             if need > len(s.blocks):
                 new = self.kv_manager.pool.alloc_uninit(need - len(s.blocks))
                 if new is None:
-                    # out of KV memory: finish with length (same policy as
-                    # the single-step path's mid-decode allocation failure)
-                    self._release_slot(s)
-                    self._finish_request(s, FinishReason.LENGTH)
+                    # out of KV memory: preempt (recompute) when other
+                    # sequences keep the pool contended, else finish
+                    self._preempt_or_finish(s)
                     continue
                 s.blocks.extend(new)
                 self._block_tables[i, :len(s.blocks)] = s.blocks
@@ -585,7 +608,7 @@ class EngineCore:
             else:
                 self._tokens[i] = s.last_token
                 self._positions[i] = s.pos
-                steps[i] = s.generated
+                steps[i] = s.key_step
         self._step += K
         toks_k, logprobs_k, self.kv = self._decode_k_jit(
             self.params, self.kv,
@@ -615,6 +638,7 @@ class EngineCore:
                             req.blocks, req.seq, req.registered_blocks)
                 req.pos += 1
                 req.generated += 1
+                req.key_step += 1
                 req.last_token = tok
                 self.total_decode_tokens += 1
                 self._emit(req, tok, float(logprobs_k[k, i]))
@@ -622,6 +646,49 @@ class EngineCore:
                 if self.slots[i] is not req:
                     break                      # finished: drop device overrun
                 input_tok = tok
+
+    # ----------------------------------------------------------- preemption
+    def _preempt_or_finish(self, req: EngineRequest) -> None:
+        """KV exhaustion policy: recompute preemption (vLLM-style) when the
+        pool is contended, else finish.
+
+        The preempted request releases its blocks and goes back to the
+        waiting queue with every emitted token appended to its prompt — on
+        re-admission the prefill recomputes (prefix reuse recovers whatever
+        survived in the pool) and the next sampled token seamlessly
+        continues the client's stream. With no other active sequence,
+        recompute couldn't allocate any more than the request already holds,
+        so the request finishes with LENGTH instead (the pool simply is too
+        small for it)."""
+        others = any(s is not None and s is not req for s in self.slots)
+        budget_left = req.max_new_tokens - req.generated
+        emitted_len = len(req.seq.tokens) - len(req.prompt) if req.seq else 0
+        new_len = len(req.prompt) + emitted_len + 1
+        bs = self.cfg.kv_block_size
+        fits = (new_len < self.cfg.max_model_len
+                and self._blocks_needed(new_len + bs) <= self.M)
+        if not others or budget_left <= 0 or not fits:
+            # no contention to wait out, no budget left, or the grown
+            # prompt wouldn't fit a block table on re-admission
+            self._release_slot(req)
+            self._finish_request(req, FinishReason.LENGTH)
+            return
+        self.preemptions += 1
+        logger.info("preempting %s after %d tokens (KV exhausted; "
+                    "recompute on re-admission)", req.rid, req.generated)
+        emitted = req.seq.tokens[len(req.prompt):] if req.seq else []
+        self._release_slot(req)
+        req.prompt = list(req.prompt) + list(emitted) + [req.last_token]
+        req.max_new_tokens = budget_left
+        req.seq = None               # admission rebuilds the hash chain
+        req.precomputed = None       # any shipped KV described the old prompt
+        req.slot = -1
+        req.pos = 0
+        req.generated = 0
+        req.registered_blocks = 0
+        req.prefix_hit_tokens = 0
+        self.waiting.put_nowait(req)
+        self._work_event.set()
 
     # ------------------------------------------------------------- finishes
     def _emit(self, req: EngineRequest, token: int, logprob: float) -> None:
