@@ -67,7 +67,8 @@ def flat_token_indices(block_tables: jax.Array, block_size: int) -> jax.Array:
 
 def paged_attention_xla(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                         block_tables: jax.Array, seq_lens: jax.Array,
-                        *, block_size: int, scale: float) -> jax.Array:
+                        *, block_size: int, scale: float,
+                        softcap: float | None = None) -> jax.Array:
     """q: [B, H, Dh]; k_cache/v_cache: [KVH, NTOK, Dh];
     block_tables: [B, M] int32; seq_lens: [B] (kv length incl. current token).
     Returns [B, H, Dh]."""
@@ -79,7 +80,9 @@ def paged_attention_xla(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     k = jnp.take(k_cache, idx, axis=1)                        # [KVH, B, T, Dh]
     v = jnp.take(v_cache, idx, axis=1)
     qg = q.reshape(B, KVH, g, Dh)
-    scores = jnp.einsum("bkgd,kbtd->bkgt", qg, k) * scale
+    scores = jnp.einsum("bkgd,kbtd->bkgt", qg, k).astype(jnp.float32) * scale
+    if softcap:
+        scores = softcap * jnp.tanh(scores / softcap)         # gemma2
     mask = jnp.arange(T)[None, :] < seq_lens[:, None]         # [B, T]
     scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
@@ -95,7 +98,8 @@ def paged_attention_xla(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 def _paged_attn_kernel(block_tables_ref, seq_lens_ref,  # scalar prefetch
                        q_ref, k_hbm, v_hbm, o_ref,
                        m_ref, l_ref, acc_ref, k_vmem, v_vmem, dma_sem,
-                       *, block_size: int, scale: float, max_blocks: int):
+                       *, block_size: int, scale: float, max_blocks: int,
+                       softcap: float | None = None):
     """Grid: (B, KVH). Streams this sequence's KV blocks for one kv-head,
     flash-accumulating softmax online.
 
@@ -127,6 +131,8 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref,  # scalar prefetch
         k = k_vmem[:].astype(jnp.float32)      # [BS, Dh]
         v = v_vmem[:].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [G, BS]
+        if softcap:
+            s = softcap * jnp.tanh(s / softcap)   # gemma2 score capping
         kv_pos = i * block_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, dimension=1)
         s = jnp.where(kv_pos < seq_len, s, NEG_INF)
@@ -148,6 +154,7 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref,  # scalar prefetch
 def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                            block_tables: jax.Array, seq_lens: jax.Array,
                            *, block_size: int, scale: float,
+                           softcap: float | None = None,
                            interpret: bool = False) -> jax.Array:
     """Same contract as `paged_attention_xla`; KV stays in HBM and is DMA'd
     block-by-block (no [B, M*BS] gather materialization)."""
@@ -183,7 +190,8 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             block_tables_ref, seq_lens_ref,
             q_ref.at[0, 0], k_hbm.at[h], v_hbm.at[h], o_ref.at[0, 0],
             m_ref, l_ref, acc_ref, k_vmem, v_vmem, dma_sem,
-            block_size=block_size, scale=scale, max_blocks=M)
+            block_size=block_size, scale=scale, max_blocks=M,
+            softcap=softcap)
 
     out = pl.pallas_call(
         kernel,
@@ -196,23 +204,27 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
 def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
                     block_size: int, scale: float,
-                    impl: str = "auto") -> jax.Array:
+                    impl: str = "auto",
+                    softcap: float | None = None) -> jax.Array:
     """Dispatch: pallas on TPU, XLA gather fallback elsewhere. Mosaic
     requires lane-aligned (128) head dims for the kernel's q/o tiles, so
-    64-dim-head models (llama-1B class) auto-route to the XLA path."""
+    64-dim-head models (llama-1B class) auto-route to the XLA path;
+    both implementations support score soft-capping (gemma2)."""
     if impl == "auto":
         head_dim = q.shape[-1]
         impl = ("pallas" if _on_tpu() and head_dim % 128 == 0 else "xla")
     if impl == "pallas":
         return paged_attention_pallas(q, k_cache, v_cache, block_tables,
                                       seq_lens, block_size=block_size,
-                                      scale=scale)
+                                      scale=scale, softcap=softcap)
     if impl == "pallas_interpret":
         return paged_attention_pallas(q, k_cache, v_cache, block_tables,
                                       seq_lens, block_size=block_size,
-                                      scale=scale, interpret=True)
+                                      scale=scale, softcap=softcap,
+                                      interpret=True)
     return paged_attention_xla(q, k_cache, v_cache, block_tables, seq_lens,
-                               block_size=block_size, scale=scale)
+                               block_size=block_size, scale=scale,
+                               softcap=softcap)
 
 
 @functools.cache

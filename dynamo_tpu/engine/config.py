@@ -48,6 +48,18 @@ class ModelConfig:
     num_experts_per_tok: int = 2
     # qwen3-style per-head q/k norm
     qk_norm: bool = False
+    # gemma-family deltas (model_type gemma/gemma2): gelu MLP, scaled
+    # embeddings, (1+w) RMSNorm, post-block norms, logit soft-capping
+    hidden_act: str = "silu"          # silu | gelu_pytorch_tanh
+    embed_scale: bool = False         # multiply embeddings by sqrt(hidden)
+    norm_plus_one: bool = False       # RMSNorm uses (1 + weight)
+    post_norms: bool = False          # gemma2 post-attn/post-ffw norms
+    attn_logit_softcap: Optional[float] = None
+    final_logit_softcap: Optional[float] = None
+    query_pre_attn_scalar: Optional[float] = None  # None → head_dim
+    # gemma2 interleaves sliding-window layers; local attention is NOT
+    # implemented, so the engine rejects contexts beyond the window
+    sliding_window: Optional[int] = None
 
     @classmethod
     def from_hf_config(cls, cfg: Dict[str, Any]) -> "ModelConfig":
@@ -86,6 +98,28 @@ class ModelConfig:
                             cfg.get("num_experts", 0) or 0),
             num_experts_per_tok=int(cfg.get("num_experts_per_tok", 2)),
             qk_norm=bool(cfg.get("qk_norm", cfg.get("model_type") == "qwen3")),
+            # hidden_activation is authoritative when present; gemma-1 hub
+            # configs ship a stale hidden_act="gelu" that HF itself
+            # overrides to the tanh-approx gelu at runtime
+            hidden_act=(cfg.get("hidden_activation")
+                        or ("gelu_pytorch_tanh"
+                            if str(cfg.get("model_type", "")).startswith(
+                                "gemma")
+                            else cfg.get("hidden_act") or "silu")),
+            embed_scale=str(cfg.get("model_type", "")).startswith("gemma"),
+            norm_plus_one=str(cfg.get("model_type", "")).startswith("gemma"),
+            post_norms=cfg.get("model_type") == "gemma2",
+            attn_logit_softcap=(float(cfg["attn_logit_softcapping"])
+                                if cfg.get("attn_logit_softcapping")
+                                else None),
+            final_logit_softcap=(float(cfg["final_logit_softcapping"])
+                                 if cfg.get("final_logit_softcapping")
+                                 else None),
+            query_pre_attn_scalar=(float(cfg["query_pre_attn_scalar"])
+                                   if cfg.get("query_pre_attn_scalar")
+                                   else None),
+            sliding_window=(int(cfg.get("sliding_window") or 4096)
+                            if cfg.get("model_type") == "gemma2" else None),
         )
 
     @classmethod

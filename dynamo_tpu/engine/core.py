@@ -94,6 +94,13 @@ class EngineCore:
         self.model_cfg = model_cfg
         self.cfg = engine_cfg
         self.mesh = mesh
+        if (model_cfg.sliding_window
+                and engine_cfg.max_model_len > model_cfg.sliding_window):
+            raise ValueError(
+                f"max_model_len {engine_cfg.max_model_len} exceeds the "
+                f"model's sliding window {model_cfg.sliding_window}; "
+                "interleaved local attention is not implemented — serve "
+                "this model with max_model_len <= sliding_window")
         self.statics = llama.ModelStatics(
             cfg=model_cfg, block_size=engine_cfg.kv_block_size,
             attn_impl=attn_impl)
@@ -358,7 +365,9 @@ class EngineCore:
             use_sp = (self._prefill_sp_jit is not None
                       and req.prefix_hit_tokens == 0
                       and len(chunk) >= self.cfg.sp_min_prefill_tokens
-                      and bucket % self._sp == 0)
+                      and bucket % self._sp == 0
+                      # ring attention has no score soft-capping (gemma2)
+                      and self.model_cfg.attn_logit_softcap is None)
             if use_sp:
                 padded = np.zeros((bucket,), np.int32)
                 padded[:len(chunk)] = chunk

@@ -38,10 +38,19 @@ KVCache = Dict[str, jax.Array]  # {"k": [L, KVH, NTOK, Dh], "v": ...}
 # ---------------------------------------------------------------------------
 
 
-def rms_norm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+def rms_norm(x: jax.Array, w: jax.Array, eps: float,
+             plus_one: bool = False) -> jax.Array:
     x32 = x.astype(jnp.float32)
     var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
-    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+    normed = x32 * jax.lax.rsqrt(var + eps)
+    if plus_one:   # gemma convention: weights are zero-centered
+        return (normed * (1.0 + w.astype(jnp.float32))).astype(x.dtype)
+    return normed.astype(x.dtype) * w
+
+
+def _softcap(scores: jax.Array, cap) -> jax.Array:
+    """Gemma2 logit soft-capping: cap·tanh(x/cap)."""
+    return cap * jnp.tanh(scores / cap)
 
 
 def rope_inv_freq(cfg: ModelConfig) -> np.ndarray:
@@ -80,8 +89,15 @@ def apply_rope(x: jax.Array, positions: jax.Array,
 
 
 def swiglu(x: jax.Array, gate_w: jax.Array, up_w: jax.Array,
-           down_w: jax.Array) -> jax.Array:
-    return (jax.nn.silu(x @ gate_w) * (x @ up_w)) @ down_w
+           down_w: jax.Array, act: str = "silu") -> jax.Array:
+    g = x @ gate_w
+    if act in ("gelu_pytorch_tanh", "gelu"):   # gemma families
+        gated = jax.nn.gelu(g, approximate=True)
+    elif act == "silu":
+        gated = jax.nn.silu(g)
+    else:
+        raise ValueError(f"unsupported hidden_act {act!r}")
+    return (gated * (x @ up_w)) @ down_w
 
 
 def moe_mlp(x: jax.Array, router_w: jax.Array, gate_w: jax.Array,
@@ -153,6 +169,9 @@ def param_shapes(cfg: ModelConfig) -> Dict[str, Tuple[int, ...]]:
     if cfg.qk_norm:  # qwen3-style per-head q/k rms norm
         shapes["layers.q_norm"] = (L, Dh)
         shapes["layers.k_norm"] = (L, Dh)
+    if cfg.post_norms:  # gemma2 post-attn / pre+post-ffw norms
+        shapes["layers.ln1_post"] = (L, D)
+        shapes["layers.ln2_post"] = (L, D)
     if not cfg.tie_word_embeddings:
         shapes["lm_head"] = (D, cfg.vocab_size)
     return shapes
@@ -163,9 +182,11 @@ def init_params(cfg: ModelConfig, key: jax.Array,
     params: Params = {}
     for name, shape in param_shapes(cfg).items():
         key, sub = jax.random.split(key)
-        if name.endswith(("ln1", "ln2", "q_norm", "k_norm")) \
-                or name == "final_norm":
-            params[name] = jnp.ones(shape, dtype=dtype)
+        if name.endswith(("ln1", "ln2", "ln1_post", "ln2_post",
+                          "q_norm", "k_norm")) or name == "final_norm":
+            params[name] = (jnp.zeros(shape, dtype=dtype)
+                            if cfg.norm_plus_one
+                            else jnp.ones(shape, dtype=dtype))
         elif name.endswith(("bq", "bk", "bv")):
             params[name] = jnp.zeros(shape, dtype=dtype)
         else:
@@ -221,10 +242,12 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
     inv_freq = jnp.asarray(rope_inv_freq(cfg))
     layer_params = _layer_stack(params)
 
+    p1 = cfg.norm_plus_one
+
     def layer(carry, xs):
         h = carry
         lp, k_l, v_l = xs["lp"], xs["k"], xs["v"]
-        hn = rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
+        hn = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, p1)
         q, k, v = hn @ lp["wq"], hn @ lp["wk"], hn @ lp["wv"]
         if cfg.attention_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
@@ -232,8 +255,8 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
         k = k.reshape(N, cfg.num_kv_heads, cfg.head_dim)
         v = v.reshape(N, cfg.num_kv_heads, cfg.head_dim)
         if cfg.qk_norm:
-            q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
-            k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+            q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps, p1)
+            k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps, p1)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         k_l = k_l.at[:, slots, :].set(k.transpose(1, 0, 2).astype(k_l.dtype),
@@ -241,26 +264,50 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
         v_l = v_l.at[:, slots, :].set(v.transpose(1, 0, 2).astype(v_l.dtype),
                                       mode="drop")
         attn = attn_fn(q, k, v, k_l, v_l)
-        h = h + attn.reshape(N, -1) @ lp["wo"]
-        hn2 = rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
+        attn_out = attn.reshape(N, -1) @ lp["wo"]
+        if cfg.post_norms:   # gemma2: norm the block output, then residual
+            attn_out = rms_norm(attn_out, lp["ln1_post"],
+                                cfg.rms_norm_eps, p1)
+        h = h + attn_out
+        hn2 = rms_norm(h, lp["ln2"], cfg.rms_norm_eps, p1)
         if cfg.num_experts > 0:
-            h = h + moe_mlp(hn2, lp["router"], lp["moe_gate"], lp["moe_up"],
-                            lp["moe_down"], cfg.num_experts_per_tok)
+            mlp_out = moe_mlp(hn2, lp["router"], lp["moe_gate"],
+                              lp["moe_up"], lp["moe_down"],
+                              cfg.num_experts_per_tok)
         else:
-            h = h + swiglu(hn2, lp["gate"], lp["up"], lp["down"])
+            mlp_out = swiglu(hn2, lp["gate"], lp["up"], lp["down"],
+                             cfg.hidden_act)
+        if cfg.post_norms:
+            mlp_out = rms_norm(mlp_out, lp["ln2_post"], cfg.rms_norm_eps, p1)
+        h = h + mlp_out
         return h, (k_l, v_l)
 
     x, (k_new, v_new) = jax.lax.scan(
         layer, x, {"lp": layer_params, "k": kv["k"], "v": kv["v"]})
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, p1)
     return x, {"k": k_new, "v": v_new}
 
 
-def _logits(params: Params, x: jax.Array) -> jax.Array:
+def _logits(params: Params, x: jax.Array,
+            cfg: ModelConfig = None) -> jax.Array:
     head = params.get("lm_head")
     out = (x @ head if head is not None
            else x @ params["embed"].T.astype(x.dtype))
-    return out.astype(jnp.float32)
+    out = out.astype(jnp.float32)
+    if cfg is not None and cfg.final_logit_softcap:
+        out = _softcap(out, cfg.final_logit_softcap)
+    return out
+
+
+def _embed(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
+    x = params["embed"][tokens]
+    if cfg.embed_scale:   # gemma normalizer, applied in the embed dtype
+        x = x * jnp.asarray(cfg.hidden_size ** 0.5, dtype=x.dtype)
+    return x
+
+
+def _attn_scale(cfg: ModelConfig) -> float:
+    return (cfg.query_pre_attn_scalar or cfg.head_dim) ** -0.5
 
 
 def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
@@ -281,7 +328,7 @@ def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
     cfg = statics.cfg
     T = tokens.shape[0]
     bsz = statics.block_size
-    scale = cfg.head_dim ** -0.5
+    scale = _attn_scale(cfg)
 
     positions = start_pos + jnp.arange(T, dtype=jnp.int32)
     valid = jnp.arange(T, dtype=jnp.int32) < true_len
@@ -300,6 +347,8 @@ def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
         g = cfg.num_heads // cfg.num_kv_heads
         qg = q.reshape(T, cfg.num_kv_heads, g, cfg.head_dim)
         scores = jnp.einsum("tkgd,ksd->kgts", qg, ks).astype(jnp.float32) * scale
+        if cfg.attn_logit_softcap:
+            scores = _softcap(scores, cfg.attn_logit_softcap)
         kv_pos = jnp.arange(idx.shape[0], dtype=jnp.int32)
         mask = (kv_pos[None, :] <= positions[:, None]) & (
             kv_pos[None, :] < seq_len)
@@ -308,10 +357,10 @@ def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
         return jnp.einsum("kgts,ksd->tkgd", probs, vs).reshape(
             T, cfg.num_heads, cfg.head_dim)
 
-    x = params["embed"][tokens]  # activation dtype follows param dtype
+    x = _embed(params, tokens, cfg)  # activation dtype follows param dtype
     x, kv_new = _run_layers(params, kv, x, positions, slots, cfg, attn)
     last = x[jnp.maximum(true_len - 1, 0)]
-    return _logits(params, last), kv_new
+    return _logits(params, last, cfg), kv_new
 
 
 def prefill_forward_sp(params: Params, kv: KVCache, tokens: jax.Array,
@@ -331,7 +380,7 @@ def prefill_forward_sp(params: Params, kv: KVCache, tokens: jax.Array,
     cfg = statics.cfg
     T = tokens.shape[0]
     bsz = statics.block_size
-    scale = cfg.head_dim ** -0.5
+    scale = _attn_scale(cfg)
 
     positions = jnp.arange(T, dtype=jnp.int32)
     valid = positions < true_len
@@ -341,10 +390,10 @@ def prefill_forward_sp(params: Params, kv: KVCache, tokens: jax.Array,
     def attn(q, k, v, _k_l, _v_l):
         return ring_attention(q, k, v, mesh, scale=scale, kv_len=true_len)
 
-    x = params["embed"][tokens]
+    x = _embed(params, tokens, cfg)
     x, kv_new = _run_layers(params, kv, x, positions, slots, cfg, attn)
     last = x[jnp.maximum(true_len - 1, 0)]
-    return _logits(params, last), kv_new
+    return _logits(params, last, cfg), kv_new
 
 
 def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
@@ -359,15 +408,16 @@ def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
     cfg = statics.cfg
     B = tokens.shape[0]
     bsz = statics.block_size
-    scale = cfg.head_dim ** -0.5
+    scale = _attn_scale(cfg)
     slots = block_tables[jnp.arange(B), positions // bsz] * bsz + positions % bsz
     seq_lens = positions + 1
 
     def attn(q, _k, _v, k_l, v_l):
         return paged_attention(q, k_l, v_l, block_tables, seq_lens,
                                block_size=bsz, scale=scale,
-                               impl=statics.attn_impl)
+                               impl=statics.attn_impl,
+                               softcap=cfg.attn_logit_softcap)
 
-    x = params["embed"][tokens]  # [B, D]
+    x = _embed(params, tokens, cfg)  # [B, D]
     x, kv_new = _run_layers(params, kv, x, positions, slots, cfg, attn)
-    return _logits(params, x), kv_new
+    return _logits(params, x, cfg), kv_new

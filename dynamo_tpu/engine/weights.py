@@ -68,6 +68,13 @@ def load_llama_params(model_dir: str, cfg: Optional[ModelConfig] = None,
         raise RuntimeError("safetensors not available")
     cfg = cfg or ModelConfig.from_model_dir(model_dir)
     L, E = cfg.num_layers, cfg.num_experts
+    layer_map = dict(_LAYER_MAP)
+    if cfg.post_norms:
+        # gemma2: "post_attention_layernorm" is a true post-attn norm (not
+        # llama's pre-MLP norm) and the MLP has its own pre/post pair
+        layer_map["post_attention_layernorm.weight"] = ("ln1_post", False)
+        layer_map["pre_feedforward_layernorm.weight"] = ("ln2", False)
+        layer_map["post_feedforward_layernorm.weight"] = ("ln2_post", False)
     staging: Dict[str, list] = {}
     expert_staging: Dict[str, list] = {}   # key → [L][E] tensors
     singles: Dict[str, np.ndarray] = {}
@@ -92,7 +99,7 @@ def load_llama_params(model_dir: str, cfg: Optional[ModelConfig] = None,
                     key, [[None] * E for _ in range(L)])
                 grid[int(idx_str)][int(e_str)] = tensor.T
                 continue
-            mapped = _LAYER_MAP.get(sub)
+            mapped = layer_map.get(sub)
             if mapped is None:
                 continue  # rotary inv_freq buffers etc.
             key, transpose = mapped
@@ -142,6 +149,10 @@ def save_hf_style(params: Dict[str, jax.Array], cfg: ModelConfig,
     if "lm_head" in params:
         out["lm_head.weight"] = c(np.asarray(params["lm_head"], np.float32).T)
     inv = {v[0]: (k, v[1]) for k, v in _LAYER_MAP.items()}
+    if cfg.post_norms:   # gemma2 norm naming (see load_llama_params)
+        inv["ln1_post"] = ("post_attention_layernorm.weight", False)
+        inv["ln2"] = ("pre_feedforward_layernorm.weight", False)
+        inv["ln2_post"] = ("post_feedforward_layernorm.weight", False)
     inv_experts = {v: k for k, v in _EXPERT_MAP.items()}
     for key, (hf_sub, transpose) in inv.items():
         if f"layers.{key}" not in params:
