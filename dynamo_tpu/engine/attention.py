@@ -27,6 +27,12 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def softcap_scores(scores: jax.Array, cap) -> jax.Array:
+    """Gemma2 logit soft-capping: cap·tanh(x/cap) — the single home of the
+    formula, shared by prefill, both decode impls, and the lm head."""
+    return cap * jnp.tanh(scores / cap)
+
+
 # ---------------------------------------------------------------------------
 # Prefill: dense causal attention (optionally against a KV prefix from cache)
 # ---------------------------------------------------------------------------
@@ -82,7 +88,7 @@ def paged_attention_xla(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     qg = q.reshape(B, KVH, g, Dh)
     scores = jnp.einsum("bkgd,kbtd->bkgt", qg, k).astype(jnp.float32) * scale
     if softcap:
-        scores = softcap * jnp.tanh(scores / softcap)         # gemma2
+        scores = softcap_scores(scores, softcap)              # gemma2
     mask = jnp.arange(T)[None, :] < seq_lens[:, None]         # [B, T]
     scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
@@ -132,7 +138,7 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref,  # scalar prefetch
         v = v_vmem[:].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [G, BS]
         if softcap:
-            s = softcap * jnp.tanh(s / softcap)   # gemma2 score capping
+            s = softcap_scores(s, softcap)        # gemma2 score capping
         kv_pos = i * block_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, dimension=1)
         s = jnp.where(kv_pos < seq_len, s, NEG_INF)

@@ -63,6 +63,12 @@ class ModelConfig:
 
     @classmethod
     def from_hf_config(cls, cfg: Dict[str, Any]) -> "ModelConfig":
+        mt = str(cfg.get("model_type", "llama"))
+        if mt.startswith("gemma") and mt not in ("gemma", "gemma2"):
+            # gemma3+ has different norms/attention — half-detecting it
+            # via the gemma defaults would load garbage silently
+            raise ValueError(f"unsupported gemma variant {mt!r} "
+                             "(gemma and gemma2 are implemented)")
         n_heads = int(cfg.get("num_attention_heads", 32))
         hidden = int(cfg.get("hidden_size", 4096))
         rs = None

@@ -26,7 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..attention import causal_attention  # noqa: F401  (used by sp path)
-from ..attention import flat_token_indices, paged_attention
+from ..attention import (flat_token_indices, paged_attention,
+                         softcap_scores as _softcap)
 from ..config import ModelConfig
 
 Params = Dict[str, jax.Array]
@@ -48,9 +49,6 @@ def rms_norm(x: jax.Array, w: jax.Array, eps: float,
     return normed.astype(x.dtype) * w
 
 
-def _softcap(scores: jax.Array, cap) -> jax.Array:
-    """Gemma2 logit soft-capping: cap·tanh(x/cap)."""
-    return cap * jnp.tanh(scores / cap)
 
 
 def rope_inv_freq(cfg: ModelConfig) -> np.ndarray:

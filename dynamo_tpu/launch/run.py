@@ -75,6 +75,13 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="dp")
     p.add_argument("--expert-parallel-size", "--ep", type=int, default=1,
                    dest="ep")
+    # multi-node bootstrap (reference MultiNodeConfig, engines.rs:33-50):
+    # every host runs the same command with its own --node-rank; rank 0's
+    # address is the coordinator
+    p.add_argument("--num-nodes", type=int, default=1)
+    p.add_argument("--node-rank", type=int, default=0)
+    p.add_argument("--leader-addr",
+                   help="host:port of node 0 (jax.distributed coordinator)")
     # routing / disagg
     p.add_argument("--router-mode", choices=["random", "round_robin"],
                    default="random")
@@ -388,6 +395,23 @@ async def amain(argv=None) -> None:
     from ..runtime.log import setup_logging
     setup_logging('debug' if args.verbose else None)
     src, out = parse_io(args.io)
+
+    # Multi-host join must precede any JAX use in this process. The run
+    # CLI's serving loops are single-controller: after a global join every
+    # pjit step is a collective all hosts must enter in lockstep, which an
+    # independently-fed frontend per rank cannot guarantee — so the CLI
+    # refuses; embedders drive followers via parallel/multihost.py with a
+    # leader-broadcast step loop.
+    if args.num_nodes > 1:
+        raise SystemExit(
+            "multi-host serving is not wired into the run CLI yet: "
+            "followers must execute the leader's exact dispatch sequence "
+            "(see dynamo_tpu/parallel/multihost.py). Scale out with "
+            "multiple single-host workers behind the KV router instead.")
+    from ..parallel.multihost import MultiNodeConfig, initialize_multihost
+    initialize_multihost(MultiNodeConfig(
+        num_nodes=args.num_nodes, node_rank=args.node_rank,
+        leader_addr=args.leader_addr))
 
     runtime = await make_runtime(args)
     try:

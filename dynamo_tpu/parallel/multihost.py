@@ -1,0 +1,63 @@
+"""Multi-host bootstrap: one engine spanning several TPU hosts.
+
+Reference: lib/llm/src/engines.rs:33-50 ``MultiNodeConfig{num_nodes,
+node_rank, leader_addr}`` — wired into Ray leader/follower for vLLM and
+torch-distributed for SGLang (SURVEY.md §2.3 multi-node bootstrap). The
+JAX analog is ``jax.distributed.initialize``: every host calls it with the
+leader's coordinator address, after which ``jax.devices()`` spans the whole
+slice and the SPMD programs (pjit over the dp/tp/sp/ep mesh) run
+megascale-style across ICI/DCN with no further framework plumbing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+logger = logging.getLogger("dynamo_tpu.parallel.multihost")
+
+__all__ = ["MultiNodeConfig", "initialize_multihost", "is_leader"]
+
+
+@dataclasses.dataclass
+class MultiNodeConfig:
+    """Reference MultiNodeConfig, 1:1 field mapping."""
+
+    num_nodes: int = 1
+    node_rank: int = 0
+    leader_addr: Optional[str] = None    # "host:port" of node_rank 0
+
+    def __post_init__(self) -> None:
+        if self.num_nodes > 1 and not self.leader_addr:
+            raise ValueError("--leader-addr is required when num_nodes > 1")
+        if not (0 <= self.node_rank < max(self.num_nodes, 1)):
+            raise ValueError(
+                f"node_rank {self.node_rank} out of range for "
+                f"{self.num_nodes} nodes")
+
+    @property
+    def single_node(self) -> bool:
+        return self.num_nodes <= 1
+
+
+def is_leader(cfg: MultiNodeConfig) -> bool:
+    return cfg.node_rank == 0
+
+
+def initialize_multihost(cfg: MultiNodeConfig) -> None:
+    """Join this process into the multi-host JAX runtime. No-op for a
+    single node. Must run before any other JAX call on the process
+    (jax.distributed's contract); afterwards ``jax.devices()`` is global
+    and ``jax.local_devices()`` is this host's chips."""
+    if cfg.single_node:
+        return
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=cfg.leader_addr,
+        num_processes=cfg.num_nodes,
+        process_id=cfg.node_rank)
+    logger.info("joined multihost runtime: node %d/%d (leader %s), "
+                "%d global / %d local devices",
+                cfg.node_rank, cfg.num_nodes, cfg.leader_addr,
+                len(jax.devices()), len(jax.local_devices()))

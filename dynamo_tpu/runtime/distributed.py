@@ -238,6 +238,14 @@ class Endpoint:
     def path(self) -> str:
         return f"dyn://{self.namespace}/{self.component}/{self.name}"
 
+    def __post_init__(self) -> None:
+        # structure characters (| . - : /) in names would corrupt subjects
+        # and discovery keys (reference slug.rs; component.rs:323-339 TODO)
+        from .slug import validate_name
+        validate_name(self.namespace, "namespace")
+        validate_name(self.component, "component")
+        validate_name(self.name, "endpoint")
+
     @classmethod
     def parse_path(cls, runtime: DistributedRuntime, path: str) -> "Endpoint":
         """Parse ``dyn://ns/comp/ep`` or ``ns.comp.ep`` (reference

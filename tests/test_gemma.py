@@ -210,3 +210,9 @@ def test_paged_attention_softcap_pallas_matches_xla():
     got = paged_attention_pallas(q, k, v, bt, sl, interpret=True, **kw)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_unknown_gemma_variant_rejected():
+    with pytest.raises(ValueError, match="gemma3"):
+        ModelConfig.from_hf_config({"model_type": "gemma3",
+                                    "vocab_size": 256, "hidden_size": 64})

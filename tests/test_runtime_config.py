@@ -95,3 +95,42 @@ def test_jsonl_formatter_shape():
         rec2 = logging.LogRecord("t", logging.ERROR, __file__, 1, "bad",
                                  (), sys.exc_info())
     assert "boom" in json.loads(JsonlFormatter().format(rec2))["exception"]
+
+
+# ------------------------------------------------------------------- slug
+
+def test_slugify_and_validate():
+    from dynamo_tpu.runtime.slug import slugify, validate_name
+    assert slugify("Hello World/v2") == "hello-world-v2"
+    assert slugify("--x--") == "x"
+    assert slugify("") == "x"
+    assert validate_name("my_comp-2") == "my_comp-2"
+    with pytest.raises(ValueError, match="namespace"):
+        validate_name("a|b", "namespace")
+
+
+def test_endpoint_rejects_structure_chars():
+    from dynamo_tpu.runtime.distributed import DistributedRuntime, Endpoint
+    rt = DistributedRuntime.in_process()
+    with pytest.raises(ValueError, match="component"):
+        Endpoint(rt, "ns", "comp.oops", "gen")
+    with pytest.raises(ValueError, match="endpoint"):
+        Endpoint(rt, "ns", "comp", "gen|x")
+    Endpoint(rt, "ns", "comp", "gen")    # clean names pass
+
+
+# -------------------------------------------------------------- multihost
+
+def test_multinode_config_validation():
+    from dynamo_tpu.parallel.multihost import (MultiNodeConfig,
+                                               initialize_multihost,
+                                               is_leader)
+    cfg = MultiNodeConfig()
+    assert cfg.single_node and is_leader(cfg)
+    initialize_multihost(cfg)            # single node: no-op
+    with pytest.raises(ValueError, match="leader-addr"):
+        MultiNodeConfig(num_nodes=2)
+    with pytest.raises(ValueError, match="out of range"):
+        MultiNodeConfig(num_nodes=2, node_rank=5, leader_addr="h:1")
+    assert not is_leader(MultiNodeConfig(num_nodes=2, node_rank=1,
+                                         leader_addr="h:1"))
