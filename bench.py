@@ -12,9 +12,12 @@ driver has a consistent scalar across rounds.
 
 Env knobs: BENCH_BATCH (default 16), BENCH_STEPS (128), BENCH_PROMPT (128),
 BENCH_MODEL (1b|tiny), BENCH_ATTN (auto|pallas|xla), BENCH_HARVEST (default
-64) — decode steps fused per dispatch (EngineConfig.decode_steps_per_dispatch):
+32) — decode steps fused per dispatch (EngineConfig.decode_steps_per_dispatch):
 sampled tokens chain on device and the host harvests once per dispatch,
-amortizing device→host latency.
+amortizing device→host latency. BENCH_PIPELINE (default 1): defer each
+dispatch's harvest one dispatch so the device→host copy overlaps the next
+dispatch's compute (EngineConfig.decode_dispatch_pipeline); set 0 for the
+older harvest-then-dispatch measurement mode.
 """
 
 import json
@@ -38,7 +41,8 @@ def main() -> None:
     prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
     model = os.environ.get("BENCH_MODEL", "1b")
     attn = os.environ.get("BENCH_ATTN", "auto")
-    harvest = int(os.environ.get("BENCH_HARVEST", "64"))
+    harvest = int(os.environ.get("BENCH_HARVEST", "32"))
+    pipeline = os.environ.get("BENCH_PIPELINE", "1") != "0"
 
     if model == "tiny":
         mcfg = ModelConfig(vocab_size=2048, hidden_size=256,
@@ -106,17 +110,36 @@ def main() -> None:
     topp = jnp.asarray(np.ones((batch,), np.float32))
     seeds = jnp.asarray(np.zeros((batch,), np.int64))
 
+    pending = None
+    chain = None        # device [B] last-token array from the prior dispatch
+
     def dispatch_once(step_i):
+        nonlocal pending, chain
         if harvest > 1:
             steps0 = jnp.asarray(np.full((batch,), step_i, np.int64))
+            # jnp.array copies — the host mirrors are mutated while a
+            # pipelined dispatch may still be executing
+            tokens_in = (chain if pipeline and chain is not None
+                         else jnp.array(core._tokens))
             toks_k, _lps, core.kv = core._decode_k_jit(
                 core.params, core.kv,
-                jnp.asarray(core._tokens), jnp.asarray(core._positions),
-                jnp.asarray(core._block_tables), seeds, steps0,
+                tokens_in, jnp.array(core._positions),
+                jnp.array(core._block_tables), seeds, steps0,
                 temp, topk, topp)
+            core._positions[:] += harvest
+            if pipeline:
+                # chain the next dispatch off device tokens; harvest the
+                # PREVIOUS batch while this one computes (the engine's
+                # decode_dispatch_pipeline shape)
+                chain = toks_k[-1]
+                prev, pending = pending, toks_k
+                if prev is not None:
+                    harvested = np.asarray(prev)
+                    core._tokens[:] = harvested[-1]
+                    return harvested
+                return None
             toks_k = np.asarray(toks_k)  # ONE host fetch per K tokens
             core._tokens[:] = toks_k[-1]
-            core._positions[:] += harvest
             return toks_k
         keys = make_slot_keys(0, seeds,
                               jnp.asarray(np.full((batch,), step_i,
@@ -132,9 +155,17 @@ def main() -> None:
 
     n_dispatch = max(steps // harvest, 1)
     dispatch_once(0)  # compile
+    if pipeline and harvest > 1 and pending is not None:
+        np.asarray(pending)  # settle the warmup dispatch outside the timer
+        pending = None
     t0 = time.monotonic()
     for s in range(1, n_dispatch + 1):
-        dispatch_once(s * harvest)
+        out = dispatch_once(s * harvest)
+        if pipeline and harvest > 1 and s > 1:
+            assert out is not None           # steady state harvests s-1
+    if pipeline and harvest > 1 and pending is not None:
+        np.asarray(pending)                  # drain the last batch
+        pending = None
     dt = time.monotonic() - t0
     steps = n_dispatch * harvest  # actual tokens per slot timed
 
@@ -152,6 +183,7 @@ def main() -> None:
                 prefill_batch * prompt_len / prefill_s, 1),
             "attn_impl": attn,
             "steps_per_dispatch": harvest,
+            "pipelined": pipeline,
         },
     }
     print(json.dumps(result))

@@ -163,9 +163,18 @@ class EngineConfig:
     # amortized K×. K>1 trades step-granular EOS/cancel reaction (worst
     # case K-1 wasted steps per sequence) for throughput.
     decode_steps_per_dispatch: int = 1
+    # defer each K-dispatch's harvest one dispatch: the next batch chains
+    # off on-device tokens while the previous results copy to the host —
+    # steady-state cost max(fetch, compute) instead of fetch+compute.
+    # Finish/cancel reaction widens to ≤2K-1 steps. Requires K > 1.
+    decode_dispatch_pipeline: bool = False
     seed: int = 0
 
     def __post_init__(self) -> None:
+        if self.decode_dispatch_pipeline and self.decode_steps_per_dispatch <= 1:
+            raise ValueError(
+                "decode_dispatch_pipeline requires decode_steps_per_dispatch"
+                " > 1 (the pipeline defers multi-step harvests)")
         self.prefill_buckets = sorted(
             b for b in self.prefill_buckets if b <= self.max_model_len) or [
                 self.max_model_len]

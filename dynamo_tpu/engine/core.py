@@ -143,6 +143,7 @@ class EngineCore:
         self.B = engine_cfg.max_num_seqs
 
         self.slots: List[Optional[EngineRequest]] = [None] * self.B
+        self._pending: Optional[dict] = None   # un-harvested decode dispatch
         self._handoff_tasks: set = set()
         self.waiting: asyncio.Queue[EngineRequest] = asyncio.Queue()
         self._work_event = asyncio.Event()
@@ -213,6 +214,10 @@ class EngineCore:
 
         self._decode_k_jit = (jax.jit(decode_k, donate_argnums=(1,))
                               if K > 1 else None)
+        # pipelined-dispatch input merge: continuing slots chain the
+        # previous dispatch's device tokens, fresh slots feed host values
+        self._merge_jit = jax.jit(
+            lambda dev, host, mask: jnp.where(mask, dev, host))
 
         # sequence-parallel long-prompt prefill (ring attention over "sp")
         self._prefill_sp_jit = None
@@ -248,6 +253,9 @@ class EngineCore:
             except asyncio.TimeoutError:
                 self._loop_task.cancel()
             self._loop_task = None
+        if self._pending is not None:     # drain the pipelined dispatch
+            self._harvest(self._pending)
+            self._pending = None
         if self.offload_engine is not None:
             await self.offload_engine.stop()
 
@@ -304,6 +312,13 @@ class EngineCore:
             # 2) run one decode step for whatever is active
             if any(s is not None for s in self.slots):
                 self._decode_step()
+                progressed = True
+            elif self._pending is not None:
+                # all requests finished mid-harvest with a chained dispatch
+                # still in flight: drain it so the dead requests and device
+                # buffers don't sit retained across an idle period
+                self._harvest(self._pending)
+                self._pending = None
                 progressed = True
             if not progressed:
                 self._work_event.clear()
@@ -580,60 +595,140 @@ class EngineCore:
         device→host fetch — the dominant per-step cost on high-latency
         links — is paid once per K tokens. EOS/cancel/max_tokens are
         applied at harvest: device steps past a finish are discarded (the
-        documented K-1-steps-of-waste trade, EngineConfig)."""
-        # pre-grow block tables: the scan writes KV at positions
-        # pos..pos+K-1 and the next dispatch's input sits at pos+K
+        documented K-1-steps-of-waste trade, EngineConfig).
+
+        With ``decode_dispatch_pipeline`` the harvest is deferred one
+        dispatch: the next K-batch launches chained off the previous
+        dispatch's ON-DEVICE tokens, so the device→host copy overlaps the
+        next dispatch's compute — steady state max(fetch, compute)
+        instead of their sum. Finish reaction widens to ≤2K-1 steps."""
+        if self._pending is not None:
+            nxt = self._dispatch_pipelined(K)
+            prev, self._pending = self._pending, None
+            self._harvest(prev)
+            if nxt is not None:
+                self._pending = nxt
+                return
+            # couldn't chain (slot churn / growth failure): fall through to
+            # a fresh host-fed dispatch against the harvested state
+        if not self._prepare_multi(K):
+            return
+        pending = self._dispatch_multi(K)
+        if self.cfg.decode_dispatch_pipeline:
+            self._pending = pending
+        else:
+            self._harvest(pending)
+
+    def _prepare_multi(self, K: int, ahead_mask=None) -> bool:
+        """Capacity check + block-table pre-grow for the next K steps.
+        ``ahead_mask`` flags slots whose request has K un-harvested steps
+        already in flight (pipelined dispatch). Returns False when nothing
+        is left to decode — or, with a mask, when the pipeline must drain
+        before growth/finish decisions can be made safely (note: blocks
+        already grown for earlier slots in the pass stay attached; they
+        remain owned by their requests either way)."""
         capacity = self.M * self.cfg.kv_block_size
         for i, s in enumerate(self.slots):
             if s is None:
                 continue
-            if s.pos + K + 1 > capacity:
+            in_flight = bool(ahead_mask is not None and ahead_mask[i])
+            pos_eff = s.pos + (K if in_flight else 0)
+            if pos_eff + K + 1 > capacity:
                 # within K tokens of the context capacity: finish now
                 # rather than let the scan write past the block table
                 # (bounded early stop, same K-granularity trade as EOS)
+                if in_flight:
+                    return False
                 self._release_slot(s)
                 self._finish_request(s, FinishReason.LENGTH)
                 continue
-            need = self._blocks_needed(s.pos + K + 1)
+            need = self._blocks_needed(pos_eff + K + 1)
             if need > len(s.blocks):
                 new = self.kv_manager.pool.alloc_uninit(need - len(s.blocks))
                 if new is None:
                     # out of KV memory: preempt (recompute) when other
-                    # sequences keep the pool contended, else finish
+                    # sequences keep the pool contended, else finish — but
+                    # never with un-harvested tokens in flight
+                    if in_flight:
+                        return False
                     self._preempt_or_finish(s)
                     continue
                 s.blocks.extend(new)
                 self._block_tables[i, :len(s.blocks)] = s.blocks
-        active_idx = [i for i, s in enumerate(self.slots) if s is not None]
-        if not active_idx:
-            return
+        return any(s is not None for s in self.slots)
+
+    def _dispatch_pipelined(self, K: int):
+        """Steady-state pipelined dispatch: chain off the in-flight batch's
+        device tokens. Returns the new pending record, or None when the
+        pipeline must drain first.
+
+        Chaining requires the slot→request mapping to be IDENTICAL to the
+        in-flight dispatch's: any churn (admission, finish, preemption,
+        re-admission) drains the pipeline and restarts it from harvested
+        host state. Stable decode phases — where the overlap matters — pay
+        nothing; churn costs one un-overlapped dispatch."""
+        prev = self._pending
+        if prev["K"] != K:
+            return None
+        if any(self.slots[i] is not prev["reqs"][i] for i in range(self.B)):
+            return None
+        mask = np.array([s is not None for s in self.slots], dtype=bool)
+        if not mask.any():
+            return None
+        if not self._prepare_multi(K, ahead_mask=mask):
+            return None
+        return self._dispatch_multi(K, chain=prev["toks"][-1], mask=mask)
+
+    def _dispatch_multi(self, K: int, chain=None, mask=None) -> dict:
+        """Launch one K-step scan. ``mask`` flags slots chained off the
+        in-flight dispatch: their input token comes from ``chain`` (device)
+        and their positions/keys run K steps ahead of harvested host
+        state; everything else feeds host-known last_tokens."""
+        if mask is None:
+            mask = np.zeros((self.B,), dtype=bool)
         steps = np.zeros((self.B,), np.int64)
         for i in range(self.B):
             s = self.slots[i]
+            ahead = K if mask[i] else 0
             if s is None:
                 self._tokens[i] = 0
                 self._positions[i] = 0
                 self._block_tables[i, :] = 0  # trash block
             else:
                 self._tokens[i] = s.last_token
-                self._positions[i] = s.pos
-                steps[i] = s.key_step
+                self._positions[i] = s.pos + ahead
+                steps[i] = s.key_step + ahead
         self._step += K
+        # jnp.array COPIES: jnp.asarray of a numpy buffer may alias it
+        # zero-copy on CPU, and these mirrors are mutated by the next
+        # iteration while a deferred-harvest dispatch may still be
+        # executing — the single-step path never sees this because its
+        # harvest blocks before any mutation
+        host_tokens = jnp.array(self._tokens)
+        tokens_in = (self._merge_jit(chain, host_tokens, jnp.array(mask))
+                     if chain is not None else host_tokens)
         toks_k, logprobs_k, self.kv = self._decode_k_jit(
             self.params, self.kv,
-            jnp.asarray(self._tokens), jnp.asarray(self._positions),
-            jnp.asarray(self._block_tables),
-            jnp.asarray(self._seeds), jnp.asarray(steps),
-            jnp.asarray(self._samp["temperature"]),
-            jnp.asarray(self._samp["top_k"]),
-            jnp.asarray(self._samp["top_p"]))
-        toks_k = np.asarray(toks_k)            # [K, B] — ONE host fetch
-        logprobs_k = np.asarray(logprobs_k)
-        for i in active_idx:
-            req = self.slots[i]
-            if req is None:
+            tokens_in, jnp.array(self._positions),
+            jnp.array(self._block_tables),
+            jnp.array(self._seeds), jnp.array(steps),
+            jnp.array(self._samp["temperature"]),
+            jnp.array(self._samp["top_k"]),
+            jnp.array(self._samp["top_p"]))
+        return {"toks": toks_k, "logprobs": logprobs_k, "K": K,
+                "reqs": list(self.slots)}
+
+    def _harvest(self, pending: dict) -> None:
+        """Apply one dispatch's results: emissions, seq bookkeeping,
+        EOS/budget/cancel finishes. Device overrun past a finish — or past
+        a slot whose request changed since dispatch — is discarded."""
+        toks_k = np.asarray(pending["toks"])       # [K, B] — ONE host fetch
+        logprobs_k = np.asarray(pending["logprobs"])
+        K = pending["K"]
+        for i, req in enumerate(pending["reqs"]):
+            if req is None or self.slots[i] is not req:
                 continue
-            input_tok = int(self._tokens[i])
+            input_tok = req.last_token
             for k in range(K):
                 if req.cancelled:
                     self._release_slot(req)

@@ -59,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fuse K decode steps per XLA dispatch (amortizes "
                         "device→host token-harvest latency; EOS/cancel "
                         "react at K-step granularity)")
+    p.add_argument("--decode-dispatch-pipeline", action="store_true",
+                   help="overlap each dispatch's token harvest with the "
+                        "next dispatch (requires K>1; finish reaction "
+                        "widens to <=2K-1 steps)")
     p.add_argument("--num-kv-blocks", type=int, default=2048)
     p.add_argument("--max-num-seqs", type=int, default=8)
     p.add_argument("--host-kv-blocks", type=int, default=0,
@@ -138,6 +142,7 @@ def engine_config(args):
         host_kv_blocks=args.host_kv_blocks,
         prefill_chunk=args.prefill_chunk,
         decode_steps_per_dispatch=args.decode_steps_per_dispatch,
+        decode_dispatch_pipeline=args.decode_dispatch_pipeline,
         tp=args.tp, sp=args.sp, dp=args.dp, ep=args.ep)
 
 

@@ -19,10 +19,11 @@ TINY = ModelConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
                    max_position_embeddings=512)
 
 
-def make_core(k: int) -> EngineCore:
+def make_core(k: int, pipeline: bool = False) -> EngineCore:
     ecfg = EngineConfig(max_model_len=256, kv_block_size=8, num_kv_blocks=64,
                         max_num_seqs=4, prefill_buckets=[16, 32, 64],
-                        decode_steps_per_dispatch=k)
+                        decode_steps_per_dispatch=k,
+                        decode_dispatch_pipeline=pipeline)
     return EngineCore(TINY, ecfg, attn_impl="xla", param_dtype=jnp.float32)
 
 
@@ -42,8 +43,9 @@ async def run_req_collect(core, prompt, **kw):
         toks.append(item)
 
 
-@pytest.mark.parametrize("k", [4, 5])
-async def test_multistep_matches_single_step_greedy(k):
+@pytest.mark.parametrize("k,pipeline", [(4, False), (5, False),
+                                        (4, True)])
+async def test_multistep_matches_single_step_greedy(k, pipeline):
     rng = np.random.default_rng(3)
     prompt = rng.integers(1, TINY.vocab_size, size=21).tolist()
     core1 = make_core(1)
@@ -51,7 +53,7 @@ async def test_multistep_matches_single_step_greedy(k):
         ref, reason1 = await run_req_collect(core1, prompt, max_new=13)
     finally:
         await core1.stop()
-    corek = make_core(k)
+    corek = make_core(k, pipeline=pipeline)
     try:
         got, reasonk = await run_req_collect(corek, prompt, max_new=13)
     finally:
@@ -104,3 +106,31 @@ async def test_multistep_two_concurrent_sequences(anyio_backend):
     finally:
         await core3.stop()
     assert g1[0] == r1[0] and g2[0] == r2[0]
+
+
+async def test_pipelined_two_sequences_and_staggered_admission():
+    """Pipelined dispatch with slot churn: a second request admitted while
+    a batch is in flight must chain correctly from its prefill token."""
+    rng = np.random.default_rng(41)
+    p1 = rng.integers(1, TINY.vocab_size, size=12).tolist()
+    p2 = rng.integers(1, TINY.vocab_size, size=18).tolist()
+    ref_core = make_core(1)
+    try:
+        r1, _ = await run_req_collect(ref_core, p1, max_new=17)
+        r2, _ = await run_req_collect(ref_core, p2, max_new=9)
+    finally:
+        await ref_core.stop()
+
+    core = make_core(4, pipeline=True)
+    try:
+        async def delayed(prompt, max_new, delay):
+            await asyncio.sleep(delay)
+            return await run_req_collect(core, prompt, max_new=max_new)
+
+        (g1, _), (g2, _) = await asyncio.gather(
+            run_req_collect(core, p1, max_new=17),
+            delayed(p2, 9, 0.15))
+        assert g1 == r1
+        assert g2 == r2
+    finally:
+        await core.stop()

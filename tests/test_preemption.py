@@ -18,11 +18,13 @@ TINY = ModelConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
                    max_position_embeddings=512)
 
 
-def make_core(num_kv_blocks: int, k: int = 1) -> EngineCore:
+def make_core(num_kv_blocks: int, k: int = 1,
+              pipeline: bool = False) -> EngineCore:
     ecfg = EngineConfig(max_model_len=256, kv_block_size=8,
                         num_kv_blocks=num_kv_blocks, max_num_seqs=2,
                         prefill_buckets=[32, 64, 128],
-                        decode_steps_per_dispatch=k)
+                        decode_steps_per_dispatch=k,
+                        decode_dispatch_pipeline=pipeline)
     return EngineCore(TINY, ecfg, attn_impl="xla", param_dtype=jnp.float32)
 
 
@@ -39,15 +41,16 @@ async def run_req(core, prompt, max_new, rid="r"):
         toks.append(item)
 
 
-@pytest.mark.parametrize("k", [1, 4])
-async def test_preemption_exact_streams_under_contention(k):
+@pytest.mark.parametrize("k,pipeline", [(1, False), (4, False),
+                                        (4, True)])
+async def test_preemption_exact_streams_under_contention(k, pipeline):
     rng = np.random.default_rng(23)
     p1 = rng.integers(1, TINY.vocab_size, size=30).tolist()
     p2 = rng.integers(1, TINY.vocab_size, size=30).tolist()
     max_new = 40
 
     # uncontended references (big pool)
-    big = make_core(num_kv_blocks=64, k=k)
+    big = make_core(num_kv_blocks=64, k=k, pipeline=pipeline)
     try:
         ref1, _ = await run_req(big, p1, max_new)
         ref2, _ = await run_req(big, p2, max_new)
@@ -57,7 +60,7 @@ async def test_preemption_exact_streams_under_contention(k):
 
     # pool big enough for either sequence alone (~9 blocks each + slack)
     # but not both at full length → forced preemption traffic
-    small = make_core(num_kv_blocks=16, k=k)
+    small = make_core(num_kv_blocks=16, k=k, pipeline=pipeline)
     try:
         (g1, r1), (g2, r2) = await asyncio.gather(
             run_req(small, p1, max_new, rid="a"),
