@@ -167,7 +167,16 @@ class EngineConfig:
     # off on-device tokens while the previous results copy to the host —
     # steady-state cost max(fetch, compute) instead of fetch+compute.
     # Finish/cancel reaction widens to ≤2K-1 steps. Requires K > 1.
+    # KNOWN GAP: under heavy preemption/re-admission churn a rare
+    # (~1/36 adversarial interleavings) exactness race exists in the
+    # chained path — keep this off for workloads that preempt and need
+    # bit-exact streams; stable-batch serving (and bench.py) is exact.
     decode_dispatch_pipeline: bool = False
+    # admission prefills start an async device→host copy of their sampled
+    # token and complete after the next decode dispatch, so the fetch —
+    # hundreds of ms on tunneled devices — overlaps decode instead of
+    # stalling the engine loop. Emission order per request is unchanged.
+    overlap_admission_fetch: bool = True
     seed: int = 0
 
     def __post_init__(self) -> None:

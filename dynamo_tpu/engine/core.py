@@ -70,6 +70,10 @@ class EngineRequest:
     # consumed sampling keys (seeded streams stay reproducible under load)
     key_step: int = 0
     last_token: int = -1
+    # False while the admission prefill's sampled token is still being
+    # fetched from the device (overlap_admission_fetch): the slot is held
+    # but excluded from decode until completion
+    ready: bool = True
     prefix_hit_tokens: int = 0
     seq: Optional[TokenBlockSequence] = None   # full token history + hashes
     registered_blocks: int = 0
@@ -144,6 +148,7 @@ class EngineCore:
 
         self.slots: List[Optional[EngineRequest]] = [None] * self.B
         self._pending: Optional[dict] = None   # un-harvested decode dispatch
+        self._admissions: List[tuple] = []     # (req, tok_dev, logprob_dev)
         self._handoff_tasks: set = set()
         self.waiting: asyncio.Queue[EngineRequest] = asyncio.Queue()
         self._work_event = asyncio.Event()
@@ -253,6 +258,8 @@ class EngineCore:
             except asyncio.TimeoutError:
                 self._loop_task.cancel()
             self._loop_task = None
+        if self._admissions:              # finish deferred admissions
+            self._complete_admissions()
         if self._pending is not None:     # drain the pipelined dispatch
             self._harvest(self._pending)
             self._pending = None
@@ -309,8 +316,8 @@ class EngineCore:
                     self.waiting._queue.appendleft(req)  # type: ignore[attr-defined]
                     break
                 progressed = True
-            # 2) run one decode step for whatever is active
-            if any(s is not None for s in self.slots):
+            # 2) run one decode step for whatever is active and ready
+            if any(s is not None and s.ready for s in self.slots):
                 self._decode_step()
                 progressed = True
             elif self._pending is not None:
@@ -319,6 +326,10 @@ class EngineCore:
                 # buffers don't sit retained across an idle period
                 self._harvest(self._pending)
                 self._pending = None
+                progressed = True
+            # 3) deferred admissions: their async fetch overlapped step 2
+            if self._admissions:
+                self._complete_admissions()
                 progressed = True
             if not progressed:
                 self._work_event.clear()
@@ -365,8 +376,10 @@ class EngineCore:
         req.prefix_hit_tokens = plan.hit_tokens + plan.host_hit_tokens
         n_already = len(plan.hit_blocks) + len(plan.host_slots)
         t0 = time.monotonic()
+        defer = False
         if req.precomputed is not None:
             tok, logprob = self._admit_precomputed(req, n_already)
+            tok, logprob = int(tok), float(logprob)
         else:
             # prefill only the un-matched suffix — the prefix KV is already
             # in the pool's blocks (this is the TTFT win of prefix reuse)
@@ -408,19 +421,35 @@ class EngineCore:
                     jnp.asarray(req.sampling.temperature, jnp.float32),
                     jnp.asarray(req.sampling.top_k, jnp.int32),
                     jnp.asarray(req.sampling.top_p, jnp.float32))
-            tok, logprob = int(tok), float(logprob)
             self.total_prefill_tokens += len(chunk)
+            # defer the device→host fetch of the first token: it overlaps
+            # the next decode dispatch instead of stalling the loop
+            # (handoff needs the host value immediately — no deferral)
+            defer = (self.cfg.overlap_admission_fetch
+                     and req.handoff is None)
+            if not defer:
+                tok, logprob = int(tok), float(logprob)
+        if req.handoff is not None:
+            defer = False
         req.pos = n_prompt
         req.generated = 1
         req.key_step += 1
-        req.last_token = tok
-        req.first_token_time = time.monotonic()
         # the prompt's full blocks now hold valid KV — register for reuse
         req.registered_blocks = self.kv_manager.register_full_blocks(
             req.blocks, plan.seq, already_registered=n_already)
         if req.handoff is not None:
             self._handoff_and_finish(req, tok, logprob)
             return True
+        if not defer:
+            req.last_token = int(tok)
+            req.first_token_time = time.monotonic()
+        else:
+            req.ready = False
+            req.last_token = -1
+            for a in (tok, logprob):
+                if hasattr(a, "copy_to_host_async"):
+                    a.copy_to_host_async()
+            self._admissions.append((req, tok, logprob))
         self.slots[slot] = req
         # host mirrors
         self._block_tables[slot, :] = 0
@@ -434,8 +463,9 @@ class EngineCore:
             "%.1fms)", req.rid, slot, n_prompt, plan.hit_tokens,
             plan.host_hit_tokens, req.precomputed is not None,
             1e3 * (time.monotonic() - t0))
-        self._emit(req, tok, float(logprob))
-        self._maybe_finish_after_emit(req)
+        if req.ready:
+            self._emit(req, tok, float(logprob))
+            self._maybe_finish_after_emit(req)
         return True
 
     def _chunked_prefill(self, req: EngineRequest, chunk: list,
@@ -467,6 +497,22 @@ class EngineCore:
                 jnp.asarray(req.sampling.top_p, jnp.float32))
             off += len(piece)
         return tok, logprob
+
+    def _complete_admissions(self) -> None:
+        """Finish deferred admissions: the async device→host copies have
+        been in flight across a decode dispatch; fetch, emit the first
+        token, and make the slot decodable."""
+        pending, self._admissions = self._admissions, []
+        for req, tok_dev, logprob_dev in pending:
+            tok = int(np.asarray(tok_dev))
+            logprob = float(np.asarray(logprob_dev))
+            req.last_token = tok
+            req.first_token_time = time.monotonic()
+            req.ready = True
+            if self.slots[req.slot] is not req:
+                continue               # raced away (shutdown edge)
+            self._emit(req, tok, logprob)
+            self._maybe_finish_after_emit(req)
 
     def _admit_precomputed(self, req: EngineRequest,
                            n_already: int) -> tuple:
@@ -513,30 +559,45 @@ class EngineCore:
         self._release_slot(req)
         self._finish_request(req, FinishReason.LENGTH)
 
+    def _tables_for_dispatch(self) -> np.ndarray:
+        """Block tables a dispatch should see: non-ready admissions keep
+        their mirror row (written at admission) but the DISPATCH aims them
+        at the trash block — copy-on-write so the mirror survives."""
+        tables = self._block_tables
+        for i, s in enumerate(self.slots):
+            if s is not None and not s.ready:
+                if tables is self._block_tables:
+                    tables = self._block_tables.copy()
+                tables[i, :] = 0
+        return tables
+
     # --------------------------------------------------------------- decode
     def _decode_step(self) -> None:
         if self._decode_k_jit is not None:
             self._decode_step_multi(self.cfg.decode_steps_per_dispatch)
             return
-        active_idx = [i for i, s in enumerate(self.slots) if s is not None]
+        active_idx = [i for i, s in enumerate(self.slots)
+                      if s is not None and s.ready]
         steps = np.zeros((self.B,), np.int64)
         for i in range(self.B):
             s = self.slots[i]
-            if s is None:
+            if s is None or not s.ready:
                 self._tokens[i] = 0
                 self._positions[i] = 0
-                self._block_tables[i, :] = 0  # trash block
+                if s is None:
+                    self._block_tables[i, :] = 0  # trash block
             else:
                 self._tokens[i] = s.last_token
                 self._positions[i] = s.pos
                 steps[i] = s.key_step
+        tables = self._tables_for_dispatch()
         self._step += 1
         keys = make_slot_keys(self.cfg.seed, jnp.asarray(self._seeds),
                               jnp.asarray(steps))
         toks, logprobs, self.kv = self._decode_jit(
             self.params, self.kv,
             jnp.asarray(self._tokens), jnp.asarray(self._positions),
-            jnp.asarray(self._block_tables), keys,
+            jnp.asarray(tables), keys,
             jnp.asarray(self._samp["temperature"]),
             jnp.asarray(self._samp["top_k"]),
             jnp.asarray(self._samp["top_p"]))
@@ -629,7 +690,7 @@ class EngineCore:
         remain owned by their requests either way)."""
         capacity = self.M * self.cfg.kv_block_size
         for i, s in enumerate(self.slots):
-            if s is None:
+            if s is None or not s.ready:
                 continue
             in_flight = bool(ahead_mask is not None and ahead_mask[i])
             pos_eff = s.pos + (K if in_flight else 0)
@@ -655,7 +716,7 @@ class EngineCore:
                     continue
                 s.blocks.extend(new)
                 self._block_tables[i, :len(s.blocks)] = s.blocks
-        return any(s is not None for s in self.slots)
+        return any(s is not None and s.ready for s in self.slots)
 
     def _dispatch_pipelined(self, K: int):
         """Steady-state pipelined dispatch: chain off the in-flight batch's
@@ -670,9 +731,11 @@ class EngineCore:
         prev = self._pending
         if prev["K"] != K:
             return None
-        if any(self.slots[i] is not prev["reqs"][i] for i in range(self.B)):
+        now = [s if (s is not None and s.ready) else None
+               for s in self.slots]
+        if any(now[i] is not prev["reqs"][i] for i in range(self.B)):
             return None
-        mask = np.array([s is not None for s in self.slots], dtype=bool)
+        mask = np.array([s is not None for s in now], dtype=bool)
         if not mask.any():
             return None
         if not self._prepare_multi(K, ahead_mask=mask):
@@ -690,14 +753,16 @@ class EngineCore:
         for i in range(self.B):
             s = self.slots[i]
             ahead = K if mask[i] else 0
-            if s is None:
+            if s is None or not s.ready:
                 self._tokens[i] = 0
                 self._positions[i] = 0
-                self._block_tables[i, :] = 0  # trash block
+                if s is None:
+                    self._block_tables[i, :] = 0  # trash block
             else:
                 self._tokens[i] = s.last_token
                 self._positions[i] = s.pos + ahead
                 steps[i] = s.key_step + ahead
+        tables = self._tables_for_dispatch()
         self._step += K
         # jnp.array COPIES: jnp.asarray of a numpy buffer may alias it
         # zero-copy on CPU, and these mirrors are mutated by the next
@@ -710,13 +775,14 @@ class EngineCore:
         toks_k, logprobs_k, self.kv = self._decode_k_jit(
             self.params, self.kv,
             tokens_in, jnp.array(self._positions),
-            jnp.array(self._block_tables),
+            jnp.array(tables),
             jnp.array(self._seeds), jnp.array(steps),
             jnp.array(self._samp["temperature"]),
             jnp.array(self._samp["top_k"]),
             jnp.array(self._samp["top_p"]))
         return {"toks": toks_k, "logprobs": logprobs_k, "K": K,
-                "reqs": list(self.slots)}
+                "reqs": [s if (s is not None and s.ready) else None
+                         for s in self.slots]}
 
     def _harvest(self, pending: dict) -> None:
         """Apply one dispatch's results: emissions, seq bookkeeping,
