@@ -74,7 +74,8 @@ def flat_token_indices(block_tables: jax.Array, block_size: int) -> jax.Array:
 def paged_attention_xla(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                         block_tables: jax.Array, seq_lens: jax.Array,
                         *, block_size: int, scale: float,
-                        softcap: float | None = None) -> jax.Array:
+                        softcap: float | None = None,
+                        win_lo: jax.Array | None = None) -> jax.Array:
     """q: [B, H, Dh]; k_cache/v_cache: [KVH, NTOK, Dh];
     block_tables: [B, M] int32; seq_lens: [B] (kv length incl. current token).
     Returns [B, H, Dh]."""
@@ -90,6 +91,8 @@ def paged_attention_xla(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     if softcap:
         scores = softcap_scores(scores, softcap)              # gemma2
     mask = jnp.arange(T)[None, :] < seq_lens[:, None]         # [B, T]
+    if win_lo is not None:   # sliding-window layers: trailing window only
+        mask = mask & (jnp.arange(T)[None, :] > win_lo[:, None])
     scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bkgt,kbtd->bkgd", probs, v)
@@ -211,11 +214,19 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
                     block_size: int, scale: float,
                     impl: str = "auto",
-                    softcap: float | None = None) -> jax.Array:
+                    softcap: float | None = None,
+                    win_lo: jax.Array | None = None) -> jax.Array:
     """Dispatch: pallas on TPU, XLA gather fallback elsewhere. Mosaic
     requires lane-aligned (128) head dims for the kernel's q/o tiles, so
     64-dim-head models (llama-1B class) auto-route to the XLA path;
-    both implementations support score soft-capping (gemma2)."""
+    both implementations support score soft-capping (gemma2). Sliding
+    windows (win_lo: [B] lowest attendable position minus one, -1 for
+    global) are XLA-path only."""
+    if win_lo is not None:
+        return paged_attention_xla(q, k_cache, v_cache, block_tables,
+                                   seq_lens, block_size=block_size,
+                                   scale=scale, softcap=softcap,
+                                   win_lo=win_lo)
     if impl == "auto":
         head_dim = q.shape[-1]
         impl = ("pallas" if _on_tpu() and head_dim % 128 == 0 else "xla")

@@ -57,9 +57,11 @@ class ModelConfig:
     attn_logit_softcap: Optional[float] = None
     final_logit_softcap: Optional[float] = None
     query_pre_attn_scalar: Optional[float] = None  # None → head_dim
-    # gemma2 interleaves sliding-window layers; local attention is NOT
-    # implemented, so the engine rejects contexts beyond the window
+    # gemma2 interleaves sliding-window (local) and global attention
+    # layers; which layers are local comes from HF ``layer_types`` (or the
+    # even-layers-local default)
     sliding_window: Optional[int] = None
+    layer_types: Optional[List[str]] = None
 
     @classmethod
     def from_hf_config(cls, cfg: Dict[str, Any]) -> "ModelConfig":
@@ -126,6 +128,7 @@ class ModelConfig:
                                    else None),
             sliding_window=(int(cfg.get("sliding_window") or 4096)
                             if cfg.get("model_type") == "gemma2" else None),
+            layer_types=cfg.get("layer_types"),
         )
 
     @classmethod

@@ -98,13 +98,13 @@ class EngineCore:
         self.model_cfg = model_cfg
         self.cfg = engine_cfg
         self.mesh = mesh
-        if (model_cfg.sliding_window
-                and engine_cfg.max_model_len > model_cfg.sliding_window):
-            raise ValueError(
-                f"max_model_len {engine_cfg.max_model_len} exceeds the "
-                f"model's sliding window {model_cfg.sliding_window}; "
-                "interleaved local attention is not implemented — serve "
-                "this model with max_model_len <= sliding_window")
+        if (model_cfg.sliding_window is not None
+                and engine_cfg.max_model_len <= model_cfg.sliding_window):
+            # the window can never bind at this serving length: drop it so
+            # decode keeps the Pallas-eligible path (window masking forces
+            # the XLA gather implementation)
+            model_cfg = dataclasses.replace(model_cfg, sliding_window=None)
+            self.model_cfg = model_cfg
         self.statics = llama.ModelStatics(
             cfg=model_cfg, block_size=engine_cfg.kv_block_size,
             attn_impl=attn_impl)
@@ -394,8 +394,10 @@ class EngineCore:
                       and req.prefix_hit_tokens == 0
                       and len(chunk) >= self.cfg.sp_min_prefill_tokens
                       and bucket % self._sp == 0
-                      # ring attention has no score soft-capping (gemma2)
-                      and self.model_cfg.attn_logit_softcap is None)
+                      # ring attention supports neither score soft-capping
+                      # nor sliding-window layers (gemma2)
+                      and self.model_cfg.attn_logit_softcap is None
+                      and self.model_cfg.sliding_window is None)
             if use_sp:
                 padded = np.zeros((bucket,), np.int32)
                 padded[:len(chunk)] = chunk

@@ -232,19 +232,23 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
     forward paths differ in), wo residual, swiglu MLP; scanned over the
     stacked layer params.
 
-    attn_fn(q, k_chunk, v_chunk, k_pool, v_pool) -> [N, H, Dh] where N is
-    the leading axis of x (tokens for prefill, batch for decode); the pool
-    args already contain this step's scattered KV.
+    attn_fn(q, k_chunk, v_chunk, k_pool, v_pool, sliding) -> [N, H, Dh]
+    where N is the leading axis of x (tokens for prefill, batch for
+    decode); the pool args already contain this step's scattered KV and
+    ``sliding`` is this layer's local-attention flag (bool scalar, traced
+    through the scan — gemma2 interleaved window layers).
     """
     N = x.shape[0]
     inv_freq = jnp.asarray(rope_inv_freq(cfg))
     layer_params = _layer_stack(params)
+    sliding_flags = jnp.asarray(sliding_layer_mask(cfg))
 
     p1 = cfg.norm_plus_one
 
     def layer(carry, xs):
         h = carry
         lp, k_l, v_l = xs["lp"], xs["k"], xs["v"]
+        sliding = xs["sliding"]
         hn = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, p1)
         q, k, v = hn @ lp["wq"], hn @ lp["wk"], hn @ lp["wv"]
         if cfg.attention_bias:
@@ -261,7 +265,7 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
                                       mode="drop")
         v_l = v_l.at[:, slots, :].set(v.transpose(1, 0, 2).astype(v_l.dtype),
                                       mode="drop")
-        attn = attn_fn(q, k, v, k_l, v_l)
+        attn = attn_fn(q, k, v, k_l, v_l, sliding)
         attn_out = attn.reshape(N, -1) @ lp["wo"]
         if cfg.post_norms:   # gemma2: norm the block output, then residual
             attn_out = rms_norm(attn_out, lp["ln1_post"],
@@ -281,7 +285,8 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
         return h, (k_l, v_l)
 
     x, (k_new, v_new) = jax.lax.scan(
-        layer, x, {"lp": layer_params, "k": kv["k"], "v": kv["v"]})
+        layer, x, {"lp": layer_params, "k": kv["k"], "v": kv["v"],
+                   "sliding": sliding_flags})
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, p1)
     return x, {"k": k_new, "v": v_new}
 
@@ -306,6 +311,18 @@ def _embed(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
 
 def _attn_scale(cfg: ModelConfig) -> float:
     return (cfg.query_pre_attn_scalar or cfg.head_dim) ** -0.5
+
+
+def sliding_layer_mask(cfg: ModelConfig) -> np.ndarray:
+    """Per-layer local-attention flags. gemma2 interleaves sliding and
+    global layers: HF ``layer_types`` when present, else the
+    even-layers-local default (HF Gemma2Config)."""
+    if cfg.sliding_window is None:
+        return np.zeros((cfg.num_layers,), dtype=bool)
+    if cfg.layer_types:
+        return np.array([t == "sliding_attention" for t in cfg.layer_types],
+                        dtype=bool)
+    return np.array([l % 2 == 0 for l in range(cfg.num_layers)], dtype=bool)
 
 
 def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
@@ -337,7 +354,7 @@ def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
         0)
     seq_len = start_pos + true_len
 
-    def attn(q, _k, _v, k_l, v_l):
+    def attn(q, _k, _v, k_l, v_l, sliding):
         # attend over the whole block table (prefix KV + this chunk)
         idx = flat_token_indices(block_table[None, :], bsz)[0]       # [S]
         ks = jnp.take(k_l, idx, axis=1)                              # [KVH,S,Dh]
@@ -350,6 +367,11 @@ def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
         kv_pos = jnp.arange(idx.shape[0], dtype=jnp.int32)
         mask = (kv_pos[None, :] <= positions[:, None]) & (
             kv_pos[None, :] < seq_len)
+        if cfg.sliding_window is not None:
+            # local layers attend only the trailing window
+            win_lo = jnp.where(sliding,
+                               positions - cfg.sliding_window, -1)
+            mask = mask & (kv_pos[None, :] > win_lo[:, None])
         scores = jnp.where(mask[None, None, :, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(vs.dtype)
         return jnp.einsum("kgts,ksd->tkgd", probs, vs).reshape(
@@ -385,7 +407,8 @@ def prefill_forward_sp(params: Params, kv: KVCache, tokens: jax.Array,
     slots = jnp.where(valid, block_table[positions // bsz] * bsz +
                       positions % bsz, 0)
 
-    def attn(q, k, v, _k_l, _v_l):
+    def attn(q, k, v, _k_l, _v_l, sliding):
+        del sliding   # sp path serves global-attention models only
         return ring_attention(q, k, v, mesh, scale=scale, kv_len=true_len)
 
     x = _embed(params, tokens, cfg)
@@ -410,11 +433,17 @@ def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
     slots = block_tables[jnp.arange(B), positions // bsz] * bsz + positions % bsz
     seq_lens = positions + 1
 
-    def attn(q, _k, _v, k_l, v_l):
+    def attn(q, _k, _v, k_l, v_l, sliding):
+        win_lo = None
+        if cfg.sliding_window is not None:
+            win_lo = jnp.where(sliding,
+                               positions - cfg.sliding_window,
+                               jnp.full_like(positions, -1))
         return paged_attention(q, k_l, v_l, block_tables, seq_lens,
                                block_size=bsz, scale=scale,
                                impl=statics.attn_impl,
-                               softcap=cfg.attn_logit_softcap)
+                               softcap=cfg.attn_logit_softcap,
+                               win_lo=win_lo)
 
     x = _embed(params, tokens, cfg)  # [B, D]
     x, kv_new = _run_layers(params, kv, x, positions, slots, cfg, attn)

@@ -187,12 +187,10 @@ def test_gemma1_act_and_engine_window_guard():
         "num_attention_heads": 4, "num_key_value_heads": 2,
         "head_dim": 16, "sliding_window": 64})
     assert cfg2.sliding_window == 64
-    from dynamo_tpu.engine.config import EngineConfig
-    from dynamo_tpu.engine.core import EngineCore
-    with pytest.raises(ValueError, match="sliding window"):
-        EngineCore(cfg2, EngineConfig(max_model_len=128, kv_block_size=8,
-                                      num_kv_blocks=32, max_num_seqs=1),
-                   attn_impl="xla", param_dtype=jnp.float32)
+    from dynamo_tpu.engine.models.llama import sliding_layer_mask
+    assert sliding_layer_mask(cfg2).tolist() == [True, False]
+    cfg2.layer_types = ["full_attention", "sliding_attention"]
+    assert sliding_layer_mask(cfg2).tolist() == [False, True]
 
 
 def test_paged_attention_softcap_pallas_matches_xla():
@@ -216,3 +214,96 @@ def test_unknown_gemma_variant_rejected():
     with pytest.raises(ValueError, match="gemma3"):
         ModelConfig.from_hf_config({"model_type": "gemma3",
                                     "vocab_size": 256, "hidden_size": 64})
+
+
+SW_CFG = ModelConfig(
+    model_type="gemma2", vocab_size=128, hidden_size=64,
+    intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=16, max_position_embeddings=256, rms_norm_eps=1e-6,
+    rope_theta=10000.0, tie_word_embeddings=True,
+    hidden_act="gelu_pytorch_tanh", embed_scale=True, norm_plus_one=True,
+    post_norms=True, attn_logit_softcap=50.0, final_logit_softcap=30.0,
+    query_pre_attn_scalar=16.0, sliding_window=8)
+
+
+@pytest.fixture(scope="module")
+def hf_gemma_sw(gemma_params, tmp_path_factory):
+    torch = pytest.importorskip("torch")
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+    from dynamo_tpu.engine.weights import save_hf_style
+    d = tmp_path_factory.mktemp("tiny-gemma2-sw-hf")
+    save_hf_style(gemma_params, SW_CFG, str(d))
+    hf_cfg = Gemma2Config(
+        vocab_size=SW_CFG.vocab_size, hidden_size=SW_CFG.hidden_size,
+        intermediate_size=SW_CFG.intermediate_size,
+        num_hidden_layers=SW_CFG.num_layers,
+        num_attention_heads=SW_CFG.num_heads,
+        num_key_value_heads=SW_CFG.num_kv_heads,
+        head_dim=SW_CFG.head_dim,
+        max_position_embeddings=SW_CFG.max_position_embeddings,
+        rms_norm_eps=SW_CFG.rms_norm_eps, rope_theta=SW_CFG.rope_theta,
+        hidden_activation="gelu_pytorch_tanh",
+        attn_logit_softcapping=SW_CFG.attn_logit_softcap,
+        final_logit_softcapping=SW_CFG.final_logit_softcap,
+        query_pre_attn_scalar=SW_CFG.query_pre_attn_scalar,
+        sliding_window=8,               # << sequence length: SW is active
+        tie_word_embeddings=True, attention_bias=False,
+        attn_implementation="eager")
+    hf_cfg.save_pretrained(str(d))
+    model = Gemma2ForCausalLM.from_pretrained(
+        str(d), torch_dtype=torch.float32, attn_implementation="eager")
+    model.eval()
+    return model
+
+
+def test_gemma2_sliding_window_matches_hf(gemma_params, hf_gemma_sw):
+    """Interleaved local attention: window (8) far below the sequence
+    length (21) so the sliding layers actually mask — prefill and
+    teacher-forced decode must match HF exactly."""
+    import torch
+    rng = np.random.default_rng(19)
+    tokens = rng.integers(1, SW_CFG.vocab_size, size=21).tolist()
+    with torch.no_grad():
+        ref_all = hf_gemma_sw(torch.tensor([tokens])).logits[0].numpy()
+
+    statics = llama.ModelStatics(cfg=SW_CFG, block_size=BS, attn_impl="xla")
+    kv = llama.init_kv_cache(SW_CFG, NUM_BLOCKS, BS, dtype=jnp.float32)
+    T = 32
+    padded = np.zeros((T,), np.int32)
+    padded[:len(tokens)] = tokens
+    full_table = np.zeros((NUM_BLOCKS,), np.int32)
+    full_table[:4] = np.arange(1, 5, dtype=np.int32)
+    logits, kv = llama.prefill_forward(
+        gemma_params, kv, jnp.asarray(padded), jnp.asarray(full_table),
+        jnp.asarray(0, jnp.int32), jnp.asarray(len(tokens), jnp.int32),
+        statics)
+    np.testing.assert_allclose(np.asarray(logits), ref_all[-1],
+                               rtol=2e-4, atol=2e-4)
+
+    # teacher-forced decode continues past the prefill with the window
+    bt = np.zeros((1, NUM_BLOCKS), np.int32)
+    bt[0, :4] = np.arange(1, 5)
+    extra = rng.integers(1, SW_CFG.vocab_size, size=5).tolist()
+    seq = list(tokens)
+    for tok in extra:
+        with torch.no_grad():
+            ref = hf_gemma_sw(torch.tensor([seq + [tok]])).logits[0, -1].numpy()
+        logits, kv = llama.decode_forward(
+            gemma_params, kv, jnp.asarray([tok]),
+            jnp.asarray([len(seq)], jnp.int32), jnp.asarray(bt), statics)
+        np.testing.assert_allclose(np.asarray(logits[0]), ref,
+                                   rtol=2e-4, atol=2e-4)
+        seq.append(tok)
+
+
+def test_unbindable_window_dropped_at_engine():
+    """max_model_len <= sliding_window: the window can never mask anything,
+    so the engine drops it (keeps decode Pallas-eligible)."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import EngineCore
+    cfg = ModelConfig(**{**GEMMA_CFG.__dict__, "sliding_window": 4096})
+    core = EngineCore(cfg, EngineConfig(max_model_len=256, kv_block_size=8,
+                                        num_kv_blocks=16, max_num_seqs=1),
+                      attn_impl="xla", param_dtype=jnp.float32)
+    assert core.model_cfg.sliding_window is None
+    assert cfg.sliding_window == 4096          # caller's config untouched
