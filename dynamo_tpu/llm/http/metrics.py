@@ -52,6 +52,13 @@ class ServiceMetrics:
             "Output tokens (streamed chunks) per model",
             ["model", "endpoint"],
             registry=self.registry)
+        self.inter_token_latency = Histogram(
+            f"{PREFIX}_inter_token_latency_seconds",
+            "Gap between consecutive streamed tokens (ITL)",
+            ["model", "endpoint"],
+            registry=self.registry,
+            buckets=(0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5))
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
@@ -75,6 +82,7 @@ class InflightGuard:
         self._status = REQUEST_STATUS_ERROR
         self._start = time.monotonic()
         self._first_token_at: Optional[float] = None
+        self._last_token_at: float = 0.0
         self._m.inflight.labels(model, endpoint).inc()
         self._closed = False
 
@@ -85,10 +93,24 @@ class InflightGuard:
         self._status = REQUEST_STATUS_CANCELLED
 
     def note_token(self, n: int = 1) -> None:
+        now = time.monotonic()
         if self._first_token_at is None:
-            self._first_token_at = time.monotonic()
+            self._first_token_at = now
             self._m.time_to_first_token.labels(self.model, self.endpoint).observe(
-                self._first_token_at - self._start)
+                now - self._start)
+        else:
+            # token-weighted ITL: the arrival gap is split across the n
+            # tokens this chunk carries and observed once per token, so
+            # histogram _count tracks output_tokens and quantiles weight
+            # per token. n comes from the chunk's text-bearing choices —
+            # a single choice whose delta batches several tokens' text
+            # still counts once (the HTTP layer can't see token counts).
+            per_tok = (now - self._last_token_at) / max(n, 1)
+            itl = self._m.inter_token_latency.labels(self.model,
+                                                     self.endpoint)
+            for _ in range(max(n, 1)):
+                itl.observe(per_tok)
+        self._last_token_at = now
         self._m.output_tokens.labels(self.model, self.endpoint).inc(n)
 
     def close(self) -> None:

@@ -75,6 +75,7 @@ def test_grafana_dashboard_queries_real_metrics():
                  f"{HTTP_PREFIX}_inflight_requests",
                  f"{HTTP_PREFIX}_output_tokens_total",
                  f"{HTTP_PREFIX}_request_duration_seconds_bucket",
-                 f"{HTTP_PREFIX}_time_to_first_token_seconds_bucket"}
+                 f"{HTTP_PREFIX}_time_to_first_token_seconds_bucket",
+                 f"{HTTP_PREFIX}_inter_token_latency_seconds_bucket"}
     for m in metric_names:
         assert m in exported, f"dashboard references unknown metric {m}"

@@ -140,3 +140,24 @@ async def test_health(service):
         async with s.get(_url(service, "/health")) as r:
             body = await r.json()
     assert body["status"] == "healthy" and "echo" in body["models"]
+
+
+@pytest.mark.asyncio
+async def test_streaming_records_itl_histogram(service):
+    """Streaming requests emit inter-token-latency samples alongside TTFT
+    (reference exposes TTFT only; ITL is the decode-side SLO metric)."""
+    async with aiohttp.ClientSession() as session:
+        async with session.post(_url(service, "/v1/chat/completions"), json={
+                "model": "echo", "stream": True, "max_tokens": 6,
+                "messages": [{"role": "user",
+                              "content": "a few words to stream"}]}) as r:
+            assert r.status == 200
+            async for _ in r.content:
+                pass
+        async with session.get(_url(service, "/metrics")) as r:
+            text = await r.text()
+    assert "nv_llm_http_service_inter_token_latency_seconds_count" in text
+    count = [l for l in text.splitlines()
+             if l.startswith("nv_llm_http_service_inter_token_latency_"
+                             "seconds_count")][0]
+    assert float(count.split()[-1]) >= 1   # at least one gap observed
