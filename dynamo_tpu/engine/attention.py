@@ -102,113 +102,203 @@ def paged_attention_xla(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 # ---------------------------------------------------------------------------
 # Decode: Pallas flash-style kernel streaming KV blocks from HBM
 # ---------------------------------------------------------------------------
+#
+# One unified kernel covers every supported head dim via a "lane pack"
+# factor P = max(1, 128/Dh):
+#   - Dh >= 128 (lane-aligned): P = 1, the KV pool is used as-is.
+#   - Dh < 128 (llama-1B class 64, tiny-test 32): Mosaic rejects sub-128-lane
+#     memref slices, so the flat `[KVH, NTOK, Dh]` pool is viewed (free
+#     reshape, row-major) as `[KVH, NTOK/P, P*Dh]`: packed row r holds tokens
+#     r*P .. r*P+P-1 side by side in lanes. q is pre-placed at lane slot p of
+#     panel p (zeros elsewhere) so panel p's dot against a packed row selects
+#     exactly the parity-p token; one shared online softmax spans the panels
+#     and the host-side wrapper extracts `sum_p acc_p[:, p*Dh:(p+1)*Dh]`.
+#
+# KV blocks are fetched `chunk_blocks` at a time into a double-buffered VMEM
+# scratch — the next chunk's DMAs are in flight while the current chunk is
+# computed (the MultiPageAsyncCopyDescriptor pattern: many copies per slot
+# semaphore, waits via reconstructed same-shape descriptors; out-of-range
+# tail blocks clamp to block-table slot 0 and are masked by position).
 
 
 def _paged_attn_kernel(block_tables_ref, seq_lens_ref,  # scalar prefetch
                        q_ref, k_hbm, v_hbm, o_ref,
-                       m_ref, l_ref, acc_ref, k_vmem, v_vmem, dma_sem,
-                       *, block_size: int, scale: float, max_blocks: int,
-                       softcap: float | None = None):
-    """Grid: (B, KVH). Streams this sequence's KV blocks for one kv-head,
-    flash-accumulating softmax online.
+                       m_ref, l_ref, acc_ref, k_bufs, v_bufs, sems,
+                       *, block_size: int, pack: int, chunk: int,
+                       scale: float, softcap: float | None = None):
+    """Grid: (B, KVH); one kv-head of one sequence per step.
 
-    q_ref: [G, Dh] (VMEM) — the group of query heads for this kv head
-    k_hbm/v_hbm: [NTOK, Dh] (ANY/HBM) — this kv head's flat token pool
-    o_ref: [G, Dh] (VMEM)
+    q_ref: [P, G, L] (VMEM), L = max(Dh, 128); k_hbm/v_hbm: [NTOK/P, L] (HBM);
+    o_ref: [P, G, L]; k_bufs/v_bufs: [2, chunk*rows, L] double buffers;
+    sems: DMA semaphore pair (one per buffer slot); m/l: [G, 1];
+    acc: [P, G, L] f32.
     """
     b = pl.program_id(0)
     seq_len = seq_lens_ref[b]
     num_blocks = (seq_len + block_size - 1) // block_size
+    num_chunks = (num_blocks + chunk - 1) // chunk
+    rows = block_size // pack                  # packed rows per KV block
+
+    def chunk_copies(ci, slot):
+        """The 2*chunk async copies moving chunk ci into buffer `slot`.
+        Reconstructed identically at wait time (copies on one semaphore;
+        wait decrements by each copy's bytes)."""
+        copies = []
+        for j in range(chunk):                 # static unroll
+            bi = ci * chunk + j
+            bi = jax.lax.select(bi < num_blocks, bi, 0)  # clamp tail
+            blk = block_tables_ref[b, bi]
+            copies.append(pltpu.make_async_copy(
+                k_hbm.at[pl.ds(blk * rows, rows), :],
+                k_bufs.at[slot, pl.ds(j * rows, rows), :], sems.at[slot]))
+            copies.append(pltpu.make_async_copy(
+                v_hbm.at[pl.ds(blk * rows, rows), :],
+                v_bufs.at[slot, pl.ds(j * rows, rows), :], sems.at[slot]))
+        return copies
 
     m_ref[:] = jnp.full_like(m_ref, NEG_INF)
     l_ref[:] = jnp.zeros_like(l_ref)
     acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[:].astype(jnp.float32) * scale  # [G, Dh]
+    qps = [q_ref[p].astype(jnp.float32) * scale for p in range(pack)]
 
-    def body(i, _):
-        blk = block_tables_ref[b, i]
-        start = blk * block_size
-        k_copy = pltpu.make_async_copy(
-            k_hbm.at[pl.ds(start, block_size), :], k_vmem, dma_sem)
-        k_copy.start()
-        k_copy.wait()
-        v_copy = pltpu.make_async_copy(
-            v_hbm.at[pl.ds(start, block_size), :], v_vmem, dma_sem)
-        v_copy.start()
-        v_copy.wait()
-        k = k_vmem[:].astype(jnp.float32)      # [BS, Dh]
-        v = v_vmem[:].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [G, BS]
-        if softcap:
-            s = softcap_scores(s, softcap)        # gemma2 score capping
-        kv_pos = i * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, dimension=1)
-        s = jnp.where(kv_pos < seq_len, s, NEG_INF)
+    for c in chunk_copies(0, 0):
+        c.start()
+
+    def body(ci, _):
+        slot = jax.lax.rem(ci, 2)
+
+        @pl.when(ci + 1 < num_chunks)
+        def _():
+            for c in chunk_copies(ci + 1, 1 - slot):
+                c.start()
+
+        for c in chunk_copies(ci, slot):
+            c.wait()
+        k = k_bufs[slot].astype(jnp.float32)   # [chunk*rows, L]
+        v = v_bufs[slot].astype(jnp.float32)
+        base = ci * chunk * block_size
+        panels = []
+        for p in range(pack):                  # static unroll
+            s = jax.lax.dot_general(qps[p], k, (((1,), (1,)), ((), ())))
+            if softcap:
+                s = softcap_scores(s, softcap)
+            kv_pos = base + pack * jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, dimension=1) + p
+            panels.append(jnp.where(kv_pos < seq_len, s, NEG_INF))
         m_prev = m_ref[:]                      # [G, 1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_cur = panels[0].max(axis=1, keepdims=True)
+        for s in panels[1:]:
+            m_cur = jnp.maximum(m_cur, s.max(axis=1, keepdims=True))
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                 # [G, BS]
         alpha = jnp.exp(m_prev - m_new)        # [G, 1]
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())))    # [G, Dh]
+        l_new = l_ref[:] * alpha
+        for p, s in enumerate(panels):
+            probs = jnp.exp(s - m_new)         # [G, chunk*rows]
+            l_new = l_new + jnp.sum(probs, axis=1, keepdims=True)
+            acc_ref[p] = acc_ref[p] * alpha + jax.lax.dot_general(
+                probs, v, (((1,), (0,)), ((), ())))          # [G, L]
+        l_ref[:] = l_new
         m_ref[:] = m_new
         return 0
 
-    jax.lax.fori_loop(0, num_blocks, body, 0)
-    o_ref[:] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-20)).astype(o_ref.dtype)
+    jax.lax.fori_loop(0, num_chunks, body, 0)
+    l = jnp.maximum(l_ref[:], 1e-20)
+    for p in range(pack):
+        o_ref[p] = (acc_ref[p] / l).astype(o_ref.dtype)
 
 
 def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                            block_tables: jax.Array, seq_lens: jax.Array,
                            *, block_size: int, scale: float,
                            softcap: float | None = None,
+                           chunk_blocks: int = 8,
                            interpret: bool = False) -> jax.Array:
     """Same contract as `paged_attention_xla`; KV stays in HBM and is DMA'd
-    block-by-block (no [B, M*BS] gather materialization)."""
+    chunk-by-chunk with double buffering (no [B, M*BS] gather
+    materialization). Head dims < 128 use the lane-packed KV view."""
     B, H, Dh = q.shape
     KVH, NTOK, _ = k_cache.shape
+    if not pallas_supported(Dh, block_size):
+        raise ValueError(
+            f"unsupported pallas geometry (Dh={Dh}, block_size={block_size}):"
+            f" needs Dh % 128 == 0, or 128 % Dh == 0 with 8-sublane-aligned"
+            f" packed rows — see pallas_supported")
+    pack, L = max(1, 128 // Dh), max(Dh, 128)
     g = H // KVH
     M = block_tables.shape[1]
+    chunk = max(1, min(chunk_blocks, M))
+    rows = block_size // pack
+    k2 = k_cache.reshape(KVH, NTOK // pack, L)     # free, row-major
+    v2 = v_cache.reshape(KVH, NTOK // pack, L)
     qg = q.reshape(B, KVH, g, Dh)
+    if pack == 1:
+        qp = qg[:, :, None]                        # [B, KVH, 1, G, L]
+    else:
+        # q at lane slot p of panel p, zeros elsewhere → panel p's dot
+        # against a packed row selects exactly the parity-p token.
+        qp = jnp.zeros((B, KVH, pack, g, L), q.dtype)
+        for p in range(pack):
+            qp = qp.at[:, :, p, :, p * Dh:(p + 1) * Dh].set(qg)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, KVH),
         in_specs=[
-            pl.BlockSpec((1, 1, g, Dh), lambda b, h, *_: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, pack, g, L), lambda b, h, *_: (b, h, 0, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),   # k_cache stays in HBM
             pl.BlockSpec(memory_space=pltpu.ANY),   # v_cache stays in HBM
         ],
-        out_specs=pl.BlockSpec((1, 1, g, Dh), lambda b, h, *_: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, pack, g, L),
+                               lambda b, h, *_: (b, h, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((g, 1), jnp.float32),        # m
-            pltpu.VMEM((g, 1), jnp.float32),        # l
-            pltpu.VMEM((g, Dh), jnp.float32),       # acc
-            pltpu.VMEM((block_size, Dh), k_cache.dtype),
-            pltpu.VMEM((block_size, Dh), v_cache.dtype),
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((g, 1), jnp.float32),                 # m
+            pltpu.VMEM((g, 1), jnp.float32),                 # l
+            pltpu.VMEM((pack, g, L), jnp.float32),           # acc panels
+            pltpu.VMEM((2, chunk * rows, L), k_cache.dtype), # k double buffer
+            pltpu.VMEM((2, chunk * rows, L), v_cache.dtype), # v double buffer
+            pltpu.SemaphoreType.DMA((2,)),
         ],
     )
 
     def kernel(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm, o_ref,
-               m_ref, l_ref, acc_ref, k_vmem, v_vmem, dma_sem):
+               m_ref, l_ref, acc_ref, k_bufs, v_bufs, sems):
         h = pl.program_id(1)
         _paged_attn_kernel(
             block_tables_ref, seq_lens_ref,
             q_ref.at[0, 0], k_hbm.at[h], v_hbm.at[h], o_ref.at[0, 0],
-            m_ref, l_ref, acc_ref, k_vmem, v_vmem, dma_sem,
-            block_size=block_size, scale=scale, max_blocks=M,
+            m_ref, l_ref, acc_ref, k_bufs, v_bufs, sems,
+            block_size=block_size, pack=pack, chunk=chunk, scale=scale,
             softcap=softcap)
 
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, KVH, g, Dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, KVH, pack, g, L), q.dtype),
         interpret=interpret,
-    )(block_tables, seq_lens, qg, k_cache, v_cache)
-    return out.reshape(B, H, Dh)
+    )(block_tables, seq_lens, qp, k2, v2)
+    if pack == 1:
+        return out[:, :, 0].reshape(B, H, Dh)
+    # panel p's slot-p lanes hold its tokens' v contributions; the rest is
+    # cross-slot garbage by construction — sum the diagonal slots.
+    res = out[:, :, 0, :, :Dh]
+    for p in range(1, pack):
+        res = res + out[:, :, p, :, p * Dh:(p + 1) * Dh]
+    return res.reshape(B, H, Dh)
+
+
+def pallas_supported(head_dim: int, block_size: int) -> bool:
+    """True if the Pallas decode kernel handles this geometry (lane-aligned
+    heads directly; sub-lane heads via the packed-KV kernel). Packed-view
+    DMA slices are `block_size/P` sublanes tall and Mosaic requires sublane
+    slices aligned to the 8-row tile, so tiny head dims need commensurately
+    larger KV blocks (Dh=64 ⇒ bs≥16, Dh=32 ⇒ bs≥32, Dh=16 ⇒ bs≥64)."""
+    if head_dim % 128 == 0:
+        return True
+    if 128 % head_dim:
+        return False
+    pack = 128 // head_dim
+    return block_size % pack == 0 and (block_size // pack) % 8 == 0
 
 
 def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
@@ -217,8 +307,9 @@ def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
                     softcap: float | None = None,
                     win_lo: jax.Array | None = None) -> jax.Array:
     """Dispatch: pallas on TPU, XLA gather fallback elsewhere. Mosaic
-    requires lane-aligned (128) head dims for the kernel's q/o tiles, so
-    64-dim-head models (llama-1B class) auto-route to the XLA path;
+    requires lane-aligned (128) memref slices: lane-aligned head dims use
+    the direct kernel; sub-lane head dims (llama-1B class Dh=64) use the
+    lane-packed kernel when the geometry allows (`pallas_supported`);
     both implementations support score soft-capping (gemma2). Sliding
     windows (win_lo: [B] lowest attendable position minus one, -1 for
     global) are XLA-path only."""
@@ -229,7 +320,16 @@ def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
                                    win_lo=win_lo)
     if impl == "auto":
         head_dim = q.shape[-1]
-        impl = ("pallas" if _on_tpu() and head_dim % 128 == 0 else "xla")
+        max_ctx = block_tables.shape[1] * block_size
+        # Lane-aligned heads: kernel wins broadly. Sub-lane (packed) heads:
+        # the kernel reads only valid KV (4x faster at 4k ctx on v5e) but
+        # per-block DMA overhead loses to XLA's fused gather at short ctx,
+        # so require a long-context block table before switching.
+        if _on_tpu() and pallas_supported(head_dim, block_size):
+            impl = ("pallas" if head_dim % 128 == 0 or max_ctx >= 2048
+                    else "xla")
+        else:
+            impl = "xla"
     if impl == "pallas":
         return paged_attention_pallas(q, k_cache, v_cache, block_tables,
                                       seq_lens, block_size=block_size,

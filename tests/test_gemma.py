@@ -197,7 +197,7 @@ def test_paged_attention_softcap_pallas_matches_xla():
     from dynamo_tpu.engine.attention import (paged_attention_pallas,
                                              paged_attention_xla)
     rng = np.random.default_rng(17)
-    B, H, KVH, Dh, bs, M = 2, 4, 2, 16, 8, 4
+    B, H, KVH, Dh, bs, M = 2, 4, 2, 32, 32, 4
     q = jnp.asarray(rng.standard_normal((B, H, Dh)), jnp.float32)
     k = jnp.asarray(rng.standard_normal((KVH, M * bs * 2, Dh)), jnp.float32)
     v = jnp.asarray(rng.standard_normal((KVH, M * bs * 2, Dh)), jnp.float32)
