@@ -162,8 +162,10 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref,  # scalar prefetch
 
     qps = [q_ref[p].astype(jnp.float32) * scale for p in range(pack)]
 
-    for c in chunk_copies(0, 0):
-        c.start()
+    @pl.when(num_chunks > 0)   # seq_len 0: no copies — an unwaited start
+    def _():                   # would leak semaphore signal into the next
+        for c in chunk_copies(0, 0):   # grid step's scratch
+            c.start()
 
     def body(ci, _):
         slot = jax.lax.rem(ci, 2)

@@ -147,6 +147,9 @@ class EngineCore:
         self.B = engine_cfg.max_num_seqs
 
         self.slots: List[Optional[EngineRequest]] = [None] * self.B
+        # optional engine.replay.Recorder capturing the schedule decision
+        # log (dispatch inputs in device order) for deterministic replay
+        self.recorder = None
         self._pending: Optional[dict] = None   # un-harvested decode dispatch
         self._admissions: List[tuple] = []     # (req, tok_dev, logprob_dev)
         self._handoff_tasks: set = set()
@@ -375,9 +378,17 @@ class EngineCore:
                     plan.seq.block_hashes[j], parent)
         req.prefix_hit_tokens = plan.hit_tokens + plan.host_hit_tokens
         n_already = len(plan.hit_blocks) + len(plan.host_slots)
+        if self.recorder is not None and req.prefix_hit_tokens > 0:
+            # before the prefill record: read rights over the shared prefix
+            self.recorder.rec("hit_transfer", rid=req.rid,
+                              hit=req.prefix_hit_tokens,
+                              blocks=list(plan.all_blocks))
         t0 = time.monotonic()
         defer = False
         if req.precomputed is not None:
+            if self.recorder is not None:
+                self.recorder.rec("prefill_unsupported", rid=req.rid,
+                                  path="precomputed")
             tok, logprob = self._admit_precomputed(req, n_already)
             tok, logprob = int(tok), float(logprob)
         else:
@@ -399,6 +410,9 @@ class EngineCore:
                       and self.model_cfg.attn_logit_softcap is None
                       and self.model_cfg.sliding_window is None)
             if use_sp:
+                if self.recorder is not None:
+                    self.recorder.rec("prefill_unsupported", rid=req.rid,
+                                      path="sp")
                 padded = np.zeros((bucket,), np.int32)
                 padded[:len(chunk)] = chunk
                 tok, logprob, self.kv = self._prefill_sp_jit(
@@ -410,10 +424,22 @@ class EngineCore:
                     jnp.asarray(req.sampling.top_p, jnp.float32))
             elif (self.cfg.prefill_chunk > 0
                     and len(chunk) > self.cfg.prefill_chunk):
+                if self.recorder is not None:
+                    self.recorder.rec("prefill_unsupported", rid=req.rid,
+                                      path="chunked")
                 tok, logprob = self._chunked_prefill(req, chunk, table, key)
             else:
                 padded = np.zeros((bucket,), np.int32)
                 padded[:len(chunk)] = chunk
+                if self.recorder is not None:
+                    req._pf_seq = self.recorder.next_dispatch_id()
+                    self.recorder.rec(
+                        "prefill", pf_seq=req._pf_seq, rid=req.rid,
+                        slot=slot, padded=padded.copy(), table=table.copy(),
+                        start_pos=req.prefix_hit_tokens, true_len=len(chunk),
+                        samp_seed=req.sampling.seed, key_step=req.key_step,
+                        temp=req.sampling.temperature,
+                        top_k=req.sampling.top_k, top_p=req.sampling.top_p)
                 tok, logprob, self.kv = self._prefill_jit(
                     self.params, self.kv, jnp.asarray(padded),
                     jnp.asarray(table),
@@ -439,12 +465,21 @@ class EngineCore:
         # the prompt's full blocks now hold valid KV — register for reuse
         req.registered_blocks = self.kv_manager.register_full_blocks(
             req.blocks, plan.seq, already_registered=n_already)
+        if self.recorder is not None:
+            self.recorder.rec(
+                "admit", rid=req.rid, slot=slot, pos=req.pos,
+                key_step=req.key_step, blocks=list(req.blocks),
+                hit=req.prefix_hit_tokens, prompt=list(req.prompt))
         if req.handoff is not None:
             self._handoff_and_finish(req, tok, logprob)
             return True
         if not defer:
             req.last_token = int(tok)
             req.first_token_time = time.monotonic()
+            if self.recorder is not None:
+                self.recorder.rec("first_token", rid=req.rid,
+                                  pf_seq=getattr(req, "_pf_seq", None),
+                                  tok=req.last_token)
         else:
             req.ready = False
             req.last_token = -1
@@ -511,6 +546,10 @@ class EngineCore:
             req.last_token = tok
             req.first_token_time = time.monotonic()
             req.ready = True
+            if self.recorder is not None:
+                self.recorder.rec("first_token", rid=req.rid,
+                                  pf_seq=getattr(req, "_pf_seq", None),
+                                  tok=tok)
             if self.slots[req.slot] is not req:
                 continue               # raced away (shutdown edge)
             self._emit(req, tok, logprob)
@@ -742,9 +781,11 @@ class EngineCore:
             return None
         if not self._prepare_multi(K, ahead_mask=mask):
             return None
-        return self._dispatch_multi(K, chain=prev["toks"][-1], mask=mask)
+        return self._dispatch_multi(K, chain=prev["toks"][-1], mask=mask,
+                                    chained_from=prev.get("id"))
 
-    def _dispatch_multi(self, K: int, chain=None, mask=None) -> dict:
+    def _dispatch_multi(self, K: int, chain=None, mask=None,
+                        chained_from=None) -> dict:
         """Launch one K-step scan. ``mask`` flags slots chained off the
         in-flight dispatch: their input token comes from ``chain`` (device)
         and their positions/keys run K steps ahead of harvested host
@@ -774,6 +815,20 @@ class EngineCore:
         host_tokens = jnp.array(self._tokens)
         tokens_in = (self._merge_jit(chain, host_tokens, jnp.array(mask))
                      if chain is not None else host_tokens)
+        did = None
+        if self.recorder is not None:
+            did = self.recorder.next_dispatch_id()
+            self.recorder.rec(
+                "dispatch", id=did, K=K,
+                chained_from=chained_from if chain is not None else None,
+                mask=mask.copy(), tokens=self._tokens.copy(),
+                positions=self._positions.copy(), tables=tables.copy(),
+                seeds=self._seeds.copy(), steps=steps.copy(),
+                temperature=self._samp["temperature"].copy(),
+                top_k=self._samp["top_k"].copy(),
+                top_p=self._samp["top_p"].copy(),
+                reqs=[s.rid if (s is not None and s.ready) else None
+                      for s in self.slots])
         toks_k, logprobs_k, self.kv = self._decode_k_jit(
             self.params, self.kv,
             tokens_in, jnp.array(self._positions),
@@ -782,7 +837,7 @@ class EngineCore:
             jnp.array(self._samp["temperature"]),
             jnp.array(self._samp["top_k"]),
             jnp.array(self._samp["top_p"]))
-        return {"toks": toks_k, "logprobs": logprobs_k, "K": K,
+        return {"toks": toks_k, "logprobs": logprobs_k, "K": K, "id": did,
                 "reqs": [s if (s is not None and s.ready) else None
                          for s in self.slots]}
 
@@ -793,9 +848,11 @@ class EngineCore:
         toks_k = np.asarray(pending["toks"])       # [K, B] — ONE host fetch
         logprobs_k = np.asarray(pending["logprobs"])
         K = pending["K"]
+        applied = []
         for i, req in enumerate(pending["reqs"]):
             if req is None or self.slots[i] is not req:
                 continue
+            n0 = req.generated
             input_tok = req.last_token
             for k in range(K):
                 if req.cancelled:
@@ -818,6 +875,10 @@ class EngineCore:
                 if self.slots[i] is not req:
                     break                      # finished: drop device overrun
                 input_tok = tok
+            applied.append((i, req.rid, req.generated - n0))
+        if self.recorder is not None and pending.get("id") is not None:
+            self.recorder.rec("harvest", id=pending["id"],
+                              toks=toks_k.copy(), applied=applied)
 
     # ----------------------------------------------------------- preemption
     def _preempt_or_finish(self, req: EngineRequest) -> None:
@@ -848,6 +909,9 @@ class EngineCore:
         self.preemptions += 1
         logger.info("preempting %s after %d tokens (KV exhausted; "
                     "recompute on re-admission)", req.rid, req.generated)
+        if self.recorder is not None:
+            self.recorder.rec("preempt", rid=req.rid,
+                              generated=req.generated)
         emitted = req.seq.tokens[len(req.prompt):] if req.seq else []
         self._release_slot(req)
         req.prompt = list(req.prompt) + list(emitted) + [req.last_token]
@@ -898,6 +962,9 @@ class EngineCore:
             self.offload_engine.enqueue(OffloadJob(
                 block_ids=list(pinned),
                 seq_hashes=list(req.seq.sequence_hashes[:n])))
+        if self.recorder is not None and req.blocks:
+            self.recorder.rec("release", rid=req.rid,
+                              blocks=list(req.blocks))
         self.kv_manager.pool.release(req.blocks)
         req.blocks = []
 

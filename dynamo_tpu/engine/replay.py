@@ -1,0 +1,307 @@
+"""Deterministic schedule recording + replay for the engine core.
+
+Debugging aid for async-interleaving bugs (KNOWN_ISSUES: the pipelined
+dispatch + preemption exactness race). The reference debugs its engine-side
+races with deterministic in-process mock transports
+(lib/runtime/tests/common/mock.rs); our engine's nondeterminism lives in
+the asyncio-loop interleaving of admissions/harvests against in-flight XLA
+dispatches, so the analogous tool is: record the complete scheduler
+decision log of a live run (every dispatched program's HOST inputs, in
+device order), then
+
+- `replay()` re-executes the identical dispatch sequence synchronously
+  (block_until_ready between programs). If the replay reproduces the live
+  run's (corrupt) tokens, the bug is deterministic given the schedule and
+  lives in the recorded inputs or step semantics; if the replay diverges
+  from the live run, the corruption needed real async overlap — a buffer
+  lifetime / donation hazard.
+- `check_log()` simulates pool-slot ownership over the log and flags any
+  dispatch that READS a KV pool slot last written by a different request —
+  the stale-read signature — plus input-consistency invariants
+  (chained positions/tokens, table/ownership mismatches), with no model
+  evaluation at all.
+
+Recording copies only small host arrays; it does not synchronize the
+device, so it can run inside the adversarial sweeps without perturbing
+the interleaving materially.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class Recorder:
+    """Collects scheduler events in device-dispatch order."""
+
+    def __init__(self) -> None:
+        self.events: List[dict] = []
+        self.dispatch_seq = 0
+
+    def rec(self, ev: str, **kw) -> None:
+        kw["ev"] = ev
+        self.events.append(kw)
+
+    def next_dispatch_id(self) -> int:
+        self.dispatch_seq += 1
+        return self.dispatch_seq
+
+
+# --------------------------------------------------------------------------
+# Synchronous replay of the recorded dispatch sequence
+# --------------------------------------------------------------------------
+
+
+def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
+    """Re-execute the recorded schedule against a fresh KV cache, strictly
+    synchronously. `core` supplies params and compiled jits (its own KV is
+    untouched). Returns {"prefill": {seq: tok}, "dispatch": {id: [K,B]},
+    "fingerprints": [(label, digest), ...]}.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from . import core as core_mod  # noqa: F401 (parity of import style)
+    from .models import llama
+    from .sampling import make_slot_keys
+
+    dtype = jax.tree_util.tree_leaves(core.params)[0].dtype
+    kv = llama.init_kv_cache(core.model_cfg, core.cfg.num_kv_blocks,
+                             core.cfg.kv_block_size, dtype=dtype)
+    out = {"prefill": {}, "dispatch": {}, "fingerprints": []}
+    disp_toks: Dict[int, object] = {}
+
+    def fp(label):
+        if not fingerprint:
+            return
+        import hashlib
+        h = hashlib.blake2b(digest_size=16)
+        h.update(np.asarray(kv["k"]).tobytes())
+        h.update(np.asarray(kv["v"]).tobytes())
+        out["fingerprints"].append((label, h.hexdigest()))
+
+    for ev in events:
+        kind = ev["ev"]
+        if kind == "prefill_unsupported":
+            raise NotImplementedError(
+                f"run used an unrecorded admission path "
+                f"({ev.get('path')}, rid={ev.get('rid')}); replay would "
+                f"silently diverge — record only plain-prefill runs")
+        if kind == "prefill":
+            key = make_slot_keys(core.cfg.seed,
+                                 jnp.asarray([ev["samp_seed"]]),
+                                 jnp.asarray(ev["key_step"]))[0]
+            tok, _lp, kv = core._prefill_jit(
+                core.params, kv,
+                jnp.asarray(ev["padded"]), jnp.asarray(ev["table"]),
+                jnp.asarray(ev["start_pos"], jnp.int32),
+                jnp.asarray(ev["true_len"], jnp.int32), key,
+                jnp.asarray(ev["temp"], jnp.float32),
+                jnp.asarray(ev["top_k"], jnp.int32),
+                jnp.asarray(ev["top_p"], jnp.float32))
+            tok = jax.block_until_ready(tok)
+            out["prefill"][ev["pf_seq"]] = int(tok)
+            fp(("prefill", ev["pf_seq"]))
+        elif kind == "dispatch":
+            host_tokens = jnp.array(np.asarray(ev["tokens"]))
+            if ev["chained_from"] is not None:
+                chain = disp_toks[ev["chained_from"]][-1]
+                tokens_in = core._merge_jit(
+                    chain, host_tokens, jnp.array(np.asarray(ev["mask"])))
+            else:
+                tokens_in = host_tokens
+            toks_k, _lps, kv = core._decode_k_jit(
+                core.params, kv, tokens_in,
+                jnp.array(ev["positions"]), jnp.array(ev["tables"]),
+                jnp.array(ev["seeds"]), jnp.array(ev["steps"]),
+                jnp.array(ev["temperature"]), jnp.array(ev["top_k"]),
+                jnp.array(ev["top_p"]))
+            toks_k = jax.block_until_ready(toks_k)
+            disp_toks[ev["id"]] = toks_k
+            out["dispatch"][ev["id"]] = np.asarray(toks_k).copy()
+            fp(("dispatch", ev["id"]))
+    return out
+
+
+def compare_replay(events: List[dict], replayed: dict) -> List[str]:
+    """Diff the live run's harvested tokens / first tokens against the
+    synchronous replay. Returns human-readable mismatch lines."""
+    diffs = []
+    for ev in events:
+        if ev["ev"] == "harvest":
+            rep = replayed["dispatch"].get(ev["id"])
+            if rep is None:
+                continue
+            live = np.asarray(ev["toks"])
+            if not np.array_equal(live, rep):
+                bad = np.argwhere(live != rep)
+                diffs.append(
+                    f"dispatch {ev['id']}: live != replay at (k,slot) "
+                    f"{bad.tolist()} live={live.tolist()} "
+                    f"replay={rep.tolist()}")
+        elif ev["ev"] == "first_token":
+            rep = replayed["prefill"].get(ev["pf_seq"])
+            if rep is not None and rep != ev["tok"]:
+                diffs.append(
+                    f"prefill {ev['pf_seq']} ({ev['rid']}): live tok "
+                    f"{ev['tok']} != replay {rep}")
+    return diffs
+
+
+# --------------------------------------------------------------------------
+# Pure log analysis: pool-slot ownership + stale-read detection
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class StaleRead:
+    dispatch_id: int
+    slot: int
+    rid: str
+    kv_pos: int
+    pool_slot: int
+    writer: Optional[str]
+
+    def __str__(self) -> str:
+        return (f"dispatch {self.dispatch_id} slot {self.slot} ({self.rid}) "
+                f"reads kv position {self.kv_pos} from pool slot "
+                f"{self.pool_slot}, last written by {self.writer!r}")
+
+
+def check_log(events: List[dict], block_size: int) -> List[StaleRead]:
+    """Simulate per-pool-slot last-writer over the recorded device order and
+    report reads of slots whose last writer is a different request.
+
+    Device order == log order for prefill/dispatch events (one stream).
+    A prefill writes positions start_pos..start_pos+true_len-1 through its
+    table (pads go to the trash block). A K-step dispatch, for each active
+    slot, writes the input token's KV at positions p..p+K-1 and at step k
+    reads every position <= p+k through its table. Writes to the trash
+    block (id 0) are ignored.
+    """
+    last_writer: Dict[int, str] = {}
+    stale: List[StaleRead] = []
+
+    def write(pool_slot: int, rid: str) -> None:
+        if pool_slot // block_size != 0:       # trash block: ignore
+            last_writer[pool_slot] = rid
+
+    for ev in events:
+        if ev["ev"] == "hit_transfer":
+            # prefix-cache hit (recorded before the admission's prefill):
+            # the first `hit` positions are legitimately shared with their
+            # original writer — transfer read rights so by-design sharing
+            # isn't reported as a stale read
+            table = list(ev["blocks"])
+            for p in range(int(ev["hit"])):
+                ps = table[p // block_size] * block_size + p % block_size
+                write(ps, ev["rid"])
+        if ev["ev"] == "prefill":
+            table = np.asarray(ev["table"])
+            rid = ev["rid"]
+            start, n = int(ev["start_pos"]), int(ev["true_len"])
+            # reads: the chunk attends to everything < start+n through the
+            # same table (prefix continuation) — check those too
+            for p in range(0, start + n):
+                ps = int(table[p // block_size]) * block_size + p % block_size
+                if p >= start:
+                    write(ps, rid)
+                else:
+                    w = last_writer.get(ps)
+                    if w is not None and w != rid:
+                        stale.append(StaleRead(-1, -1, rid, p, ps, w))
+        elif ev["ev"] == "dispatch":
+            K = int(ev["K"])
+            tables = np.asarray(ev["tables"])
+            positions = np.asarray(ev["positions"])
+            for i, rid in enumerate(ev["reqs"]):
+                if rid is None:
+                    continue
+                p0 = int(positions[i])
+                for k in range(K):
+                    p = p0 + k
+                    ps = (int(tables[i, p // block_size]) * block_size
+                          + p % block_size)
+                    write(ps, rid)
+                    # reads: every position <= p via this table
+                    for q in range(0, p + 1):
+                        qs = (int(tables[i, q // block_size]) * block_size
+                              + q % block_size)
+                        w = last_writer.get(qs)
+                        if w is not None and w != rid:
+                            stale.append(StaleRead(
+                                ev["id"], i, rid, q, qs, w))
+    # dedupe (same slot re-read every later step)
+    seen = set()
+    uniq = []
+    for s in stale:
+        key = (s.rid, s.kv_pos, s.pool_slot, s.writer)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(s)
+    return uniq
+
+
+def check_inputs(events: List[dict]) -> List[str]:
+    """Input-consistency invariants over the log, reconstructed purely from
+    admit/harvest/dispatch events: chained dispatches must run K ahead on
+    positions/steps and their request mapping must equal the chained-from
+    dispatch's; host-fed dispatches must feed the request's last harvested
+    token at its current position."""
+    problems = []
+    state: Dict[str, dict] = {}       # rid -> {pos, key_step, last_tok}
+    disp: Dict[int, dict] = {}
+    for ev in events:
+        if ev["ev"] == "admit":
+            state[ev["rid"]] = {
+                "pos": ev["pos"], "key_step": ev["key_step"],
+                "last": None}         # last token may be deferred
+        elif ev["ev"] == "first_token":
+            if ev["rid"] in state:
+                state[ev["rid"]]["last"] = ev["tok"]
+        elif ev["ev"] == "dispatch":
+            disp[ev["id"]] = ev
+            positions = np.asarray(ev["positions"])
+            steps = np.asarray(ev["steps"])
+            tokens = np.asarray(ev["tokens"])
+            mask = np.asarray(ev["mask"])
+            if ev["chained_from"] is not None:
+                src = disp.get(ev["chained_from"])
+                for i, rid in enumerate(ev["reqs"]):
+                    if mask[i] and (src is None or src["reqs"][i] != rid):
+                        problems.append(
+                            f"dispatch {ev['id']} slot {i} chained but "
+                            f"chained-from mapping differs")
+            for i, rid in enumerate(ev["reqs"]):
+                if rid is None or rid not in state:
+                    continue
+                st = state[rid]
+                ahead = int(ev["K"]) if mask[i] else 0
+                if int(positions[i]) != st["pos"] + ahead:
+                    problems.append(
+                        f"dispatch {ev['id']} slot {i} ({rid}): position "
+                        f"{int(positions[i])} != state {st['pos']}+{ahead}")
+                if int(steps[i]) != st["key_step"] + ahead:
+                    problems.append(
+                        f"dispatch {ev['id']} slot {i} ({rid}): key step "
+                        f"{int(steps[i])} != state {st['key_step']}+{ahead}")
+                if (not mask[i] and st["last"] is not None
+                        and int(tokens[i]) != st["last"]):
+                    problems.append(
+                        f"dispatch {ev['id']} slot {i} ({rid}): host token "
+                        f"{int(tokens[i])} != last harvested {st['last']}")
+        elif ev["ev"] == "harvest":
+            toks = np.asarray(ev["toks"])
+            for slot, rid, n in ev["applied"]:
+                if rid in state:
+                    st = state[rid]
+                    st["pos"] += n
+                    st["key_step"] += n
+                    if n > 0:
+                        st["last"] = int(toks[n - 1, slot])
+        elif ev["ev"] == "preempt":
+            state.pop(ev["rid"], None)
+    return problems

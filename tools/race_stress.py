@@ -1,0 +1,134 @@
+"""Randomized churn stress for the pipelined-preemption race: 4 requests
+with random budgets/delays contend for 2 slots over a tiny pool, forcing
+finish/preempt/re-admission churn against chained dispatches. Every trial's
+recorded log runs the stale-read and input-consistency checkers; divergent
+or flagged logs are pickled for tools/race_replay.py-style forensics.
+
+Usage: JAX_PLATFORMS=cpu python tools/race_stress.py [n_trials] [out_dir]
+"""
+
+import asyncio
+import pickle
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineCore, EngineRequest
+from dynamo_tpu.engine.replay import Recorder, check_inputs, check_log
+from dynamo_tpu.engine.sampling import SlotSampling
+
+TINY = ModelConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                   num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                   max_position_embeddings=512)
+K = 4
+_DONOR = {}
+
+
+def make_core(blocks, record=True):
+    ecfg = EngineConfig(max_model_len=256, kv_block_size=8,
+                        num_kv_blocks=blocks, max_num_seqs=2,
+                        prefill_buckets=[32, 64, 128],
+                        decode_steps_per_dispatch=K,
+                        decode_dispatch_pipeline=True)
+    c = EngineCore(TINY, ecfg, attn_impl="xla", param_dtype=jnp.float32,
+                   params=_DONOR.get("params"))
+    if not _DONOR:
+        _DONOR.update(params=c.params, pf=c._prefill_jit,
+                      dk=c._decode_k_jit, mg=c._merge_jit)
+    else:   # identical statics/shapes: reuse the compiled programs
+        c._prefill_jit, c._decode_k_jit, c._merge_jit = (
+            _DONOR["pf"], _DONOR["dk"], _DONOR["mg"])
+    if record:
+        c.recorder = Recorder()
+    return c
+
+
+async def run_req(core, prompt, rid, max_new, delay=0.0):
+    if delay:
+        await asyncio.sleep(delay)
+    req = EngineRequest(rid=rid, prompt=list(prompt),
+                        sampling=SlotSampling(temperature=0.0),
+                        max_new_tokens=max_new, eos_ids=frozenset())
+    await core.submit(req)
+    toks = []
+    while True:
+        item, _ = await asyncio.wait_for(req.out_queue.get(), 120)
+        if item is FINISH_SENTINEL:
+            return toks
+        toks.append(item)
+
+
+_REF_CACHE = {}
+
+
+def solo_ref(prompt, max_new):
+    key = (tuple(prompt), max_new)
+    if key not in _REF_CACHE:
+        async def go():
+            core = make_core(64, record=False)
+            try:
+                return await run_req(core, prompt, "ref", max_new)
+            finally:
+                await core.stop()
+        _REF_CACHE[key] = asyncio.run(go())
+    return _REF_CACHE[key]
+
+
+def trial(seed):
+    rng = np.random.default_rng(seed)
+    n_req = 4
+    prompts = [rng.integers(1, TINY.vocab_size,
+                            size=int(rng.integers(20, 40))).tolist()
+               for _ in range(n_req)]
+    budgets = [int(rng.integers(20, 50)) for _ in range(n_req)]
+    delays = [float(rng.uniform(0, 0.05)) for _ in range(n_req)]
+    refs = [solo_ref(p, m) for p, m in zip(prompts, budgets)]
+
+    async def go():
+        core = make_core(16)
+        try:
+            outs = await asyncio.gather(*[
+                run_req(core, p, f"r{i}", m, d)
+                for i, (p, m, d) in enumerate(
+                    zip(prompts, budgets, delays))])
+        finally:
+            await core.stop()
+        return core, outs
+
+    core, outs = asyncio.run(go())
+    bad = [i for i in range(n_req) if outs[i] != refs[i]]
+    stale = check_log(core.recorder.events, block_size=8)
+    problems = check_inputs(core.recorder.events)
+    return core, bad, stale, problems
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+    out_dir = sys.argv[2] if len(sys.argv) > 2 else "/tmp"
+    n_bad = 0
+    for seed in range(n):
+        core, bad, stale, problems = trial(seed)
+        flag = f"BAD={bad}" if bad else "ok"
+        extra = (f" stale={len(stale)}" if stale else "") + \
+                (f" input={len(problems)}" if problems else "")
+        print(f"seed {seed}: preempt={core.preemptions} {flag}{extra}",
+              flush=True)
+        if bad or stale or problems:
+            n_bad += 1
+            path = f"{out_dir}/race_log_{seed}.pkl"
+            with open(path, "wb") as f:
+                pickle.dump(core.recorder.events, f)
+            for s in stale[:8]:
+                print("   ", s, flush=True)
+            for p in problems[:8]:
+                print("   ", p, flush=True)
+            print(f"    log -> {path}", flush=True)
+    print(f"done: {n_bad}/{n} flagged", flush=True)
+
+
+if __name__ == "__main__":
+    main()
