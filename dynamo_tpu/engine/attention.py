@@ -4,10 +4,10 @@ TPU-native replacement for the engine-side attention the reference delegates
 to vLLM/TRT-LLM (paged attention over KV block tables; the reference's KV
 block layout is kv/layer.rs `[kv, blocks, block_size, heads, head_size]`).
 
-Our canonical KV-cache layout is `[KVH, NTOK, Dh]` per layer where
-`NTOK = num_blocks * block_size` is a flat paged token pool — chosen so that
-(a) a (kv-head, block) slice is contiguous for Pallas DMA, and (b) sharding
-over the `tp` mesh axis is a plain leading-axis PartitionSpec.
+Our canonical KV-cache layout is BLOCK-MAJOR: `[NTOK, KVH*Dh]` per layer
+where `NTOK = num_blocks * block_size` is a flat paged token pool and every
+kv head's vector sits side by side in lanes (see the decode section header
+for the full rationale).
 
 Two decode implementations with identical semantics:
 - `paged_attention_xla`: gather + masked softmax, runs everywhere (CPU tests).
@@ -62,6 +62,15 @@ def causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # ---------------------------------------------------------------------------
 # Decode: paged attention (XLA reference implementation)
 # ---------------------------------------------------------------------------
+#
+# The canonical KV-cache layout is BLOCK-MAJOR: per layer `[NTOK, C]` where
+# `NTOK = num_blocks * block_size` is the flat paged token pool and
+# `C = KVH * Dh` packs every kv head's vector side by side in lanes. Chosen
+# so that (a) one contiguous DMA per KV block fetches ALL heads (the
+# head-major layout needed KVH separate sub-slices per block), (b) decode
+# attention for every query head is ONE MXU dot against packed rows (see the
+# Pallas kernel), and (c) tensor-parallel sharding over kv heads is a plain
+# last-axis PartitionSpec (head vectors are contiguous lane groups).
 
 
 def flat_token_indices(block_tables: jax.Array, block_size: int) -> jax.Array:
@@ -76,18 +85,18 @@ def paged_attention_xla(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                         *, block_size: int, scale: float,
                         softcap: float | None = None,
                         win_lo: jax.Array | None = None) -> jax.Array:
-    """q: [B, H, Dh]; k_cache/v_cache: [KVH, NTOK, Dh];
+    """q: [B, H, Dh]; k_cache/v_cache: [NTOK, KVH*Dh] (block-major pool);
     block_tables: [B, M] int32; seq_lens: [B] (kv length incl. current token).
     Returns [B, H, Dh]."""
     B, H, Dh = q.shape
-    KVH = k_cache.shape[0]
+    KVH = k_cache.shape[1] // Dh
     g = H // KVH
     idx = flat_token_indices(block_tables, block_size)        # [B, T]
     T = idx.shape[1]
-    k = jnp.take(k_cache, idx, axis=1)                        # [KVH, B, T, Dh]
-    v = jnp.take(v_cache, idx, axis=1)
+    k = jnp.take(k_cache, idx, axis=0).reshape(B, T, KVH, Dh)
+    v = jnp.take(v_cache, idx, axis=0).reshape(B, T, KVH, Dh)
     qg = q.reshape(B, KVH, g, Dh)
-    scores = jnp.einsum("bkgd,kbtd->bkgt", qg, k).astype(jnp.float32) * scale
+    scores = jnp.einsum("bkgd,btkd->bkgt", qg, k).astype(jnp.float32) * scale
     if softcap:
         scores = softcap_scores(scores, softcap)              # gemma2
     mask = jnp.arange(T)[None, :] < seq_lens[:, None]         # [B, T]
@@ -95,77 +104,70 @@ def paged_attention_xla(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         mask = mask & (jnp.arange(T)[None, :] > win_lo[:, None])
     scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    out = jnp.einsum("bkgt,kbtd->bkgd", probs, v)
+    out = jnp.einsum("bkgt,btkd->bkgd", probs, v)
     return out.reshape(B, H, Dh)
 
 
 # ---------------------------------------------------------------------------
-# Decode: Pallas flash-style kernel streaming KV blocks from HBM
+# Decode: Pallas flash kernel streaming block-major KV from HBM
 # ---------------------------------------------------------------------------
 #
-# One unified kernel covers every supported head dim via a "lane pack"
-# factor P = max(1, 128/Dh):
-#   - Dh >= 128 (lane-aligned): P = 1, the KV pool is used as-is.
-#   - Dh < 128 (llama-1B class 64, tiny-test 32): Mosaic rejects sub-128-lane
-#     memref slices, so the flat `[KVH, NTOK, Dh]` pool is viewed (free
-#     reshape, row-major) as `[KVH, NTOK/P, P*Dh]`: packed row r holds tokens
-#     r*P .. r*P+P-1 side by side in lanes. q is pre-placed at lane slot p of
-#     panel p (zeros elsewhere) so panel p's dot against a packed row selects
-#     exactly the parity-p token; one shared online softmax spans the panels
-#     and the host-side wrapper extracts `sum_p acc_p[:, p*Dh:(p+1)*Dh]`.
-#
-# KV blocks are fetched `chunk_blocks` at a time into a double-buffered VMEM
-# scratch — the next chunk's DMAs are in flight while the current chunk is
-# computed (the MultiPageAsyncCopyDescriptor pattern: many copies per slot
-# semaphore, waits via reconstructed same-shape descriptors; out-of-range
-# tail blocks clamp to block-table slot 0 and are masked by position).
+# Grid (B,): one sequence per step, ALL heads at once. The sparse-slotted
+# query matrix `qm[h, kh(h)*Dh:(kh(h)+1)*Dh] = q[h]` (zeros elsewhere) makes
+# `qm @ k_row` select exactly head h's kv slot, so scores for every query
+# head are one [H, C] x [C, chunk*bs] MXU dot per KV chunk; the accumulator
+# keeps all C lanes and the host-side wrapper extracts each head's slot.
+# KV blocks stream `chunk_blocks` per DMA wave into double-buffered VMEM
+# (next wave in flight during compute); each block is ONE contiguous
+# [block_size, C] copy — the payoff of the block-major layout.
 
 
-def _paged_attn_kernel(block_tables_ref, seq_lens_ref,  # scalar prefetch
+def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
                        q_ref, k_hbm, v_hbm, o_ref,
                        m_ref, l_ref, acc_ref, k_bufs, v_bufs, sems,
-                       *, block_size: int, pack: int, chunk: int,
-                       scale: float, softcap: float | None = None):
-    """Grid: (B, KVH); one kv-head of one sequence per step.
-
-    q_ref: [P, G, L] (VMEM), L = max(Dh, 128); k_hbm/v_hbm: [NTOK/P, L] (HBM);
-    o_ref: [P, G, L]; k_bufs/v_bufs: [2, chunk*rows, L] double buffers;
-    sems: DMA semaphore pair (one per buffer slot); m/l: [G, 1];
-    acc: [P, G, L] f32.
-    """
+                       *, block_size: int, chunk: int, scale: float,
+                       softcap: float | None = None):
+    """q_ref: [Hp, C] sparse-slotted (VMEM); k_hbm/v_hbm: [NTOK, C] (HBM);
+    o_ref: [Hp, C]; k_bufs/v_bufs: [2, chunk*block_size, C] double buffers;
+    sems: DMA semaphore pair; m/l: [Hp, 1]; acc: [Hp, C] f32."""
     b = pl.program_id(0)
     seq_len = seq_lens_ref[b]
+    win_lo = win_lo_ref[b]
     num_blocks = (seq_len + block_size - 1) // block_size
     num_chunks = (num_blocks + chunk - 1) // chunk
-    rows = block_size // pack                  # packed rows per KV block
 
     def chunk_copies(ci, slot):
-        """The 2*chunk async copies moving chunk ci into buffer `slot`.
-        Reconstructed identically at wait time (copies on one semaphore;
-        wait decrements by each copy's bytes)."""
+        """2*chunk contiguous block copies into buffer `slot` (reconstructed
+        identically at wait time; all on one semaphore)."""
         copies = []
         for j in range(chunk):                 # static unroll
             bi = ci * chunk + j
             bi = jax.lax.select(bi < num_blocks, bi, 0)  # clamp tail
             blk = block_tables_ref[b, bi]
             copies.append(pltpu.make_async_copy(
-                k_hbm.at[pl.ds(blk * rows, rows), :],
-                k_bufs.at[slot, pl.ds(j * rows, rows), :], sems.at[slot]))
+                k_hbm.at[pl.ds(blk * block_size, block_size), :],
+                k_bufs.at[slot, pl.ds(j * block_size, block_size), :],
+                sems.at[slot]))
             copies.append(pltpu.make_async_copy(
-                v_hbm.at[pl.ds(blk * rows, rows), :],
-                v_bufs.at[slot, pl.ds(j * rows, rows), :], sems.at[slot]))
+                v_hbm.at[pl.ds(blk * block_size, block_size), :],
+                v_bufs.at[slot, pl.ds(j * block_size, block_size), :],
+                sems.at[slot]))
         return copies
 
     m_ref[:] = jnp.full_like(m_ref, NEG_INF)
     l_ref[:] = jnp.zeros_like(l_ref)
     acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    qps = [q_ref[p].astype(jnp.float32) * scale for p in range(pack)]
+    qm = q_ref[:].astype(jnp.float32) * scale   # [Hp, C]
 
-    @pl.when(num_chunks > 0)   # seq_len 0: no copies — an unwaited start
-    def _():                   # would leak semaphore signal into the next
-        for c in chunk_copies(0, 0):   # grid step's scratch
-            c.start()
+    # sliding-window layers: chunks entirely below the window would be
+    # DMA'd and masked to nothing — start at the first in-window chunk
+    start_ci = jnp.maximum(win_lo + 1, 0) // (chunk * block_size)
+
+    @pl.when(start_ci < num_chunks)  # empty range: an unwaited start would
+    def _():                         # leak semaphore signal into the next
+        for c in chunk_copies(start_ci, jax.lax.rem(start_ci, 2)):
+            c.start()                # grid step's scratch
 
     def body(ci, _):
         slot = jax.lax.rem(ci, 2)
@@ -177,130 +179,107 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref,  # scalar prefetch
 
         for c in chunk_copies(ci, slot):
             c.wait()
-        k = k_bufs[slot].astype(jnp.float32)   # [chunk*rows, L]
+        k = k_bufs[slot].astype(jnp.float32)    # [chunk*bs, C]
         v = v_bufs[slot].astype(jnp.float32)
-        base = ci * chunk * block_size
-        panels = []
-        for p in range(pack):                  # static unroll
-            s = jax.lax.dot_general(qps[p], k, (((1,), (1,)), ((), ())))
-            if softcap:
-                s = softcap_scores(s, softcap)
-            kv_pos = base + pack * jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, dimension=1) + p
-            panels.append(jnp.where(kv_pos < seq_len, s, NEG_INF))
-        m_prev = m_ref[:]                      # [G, 1]
-        m_cur = panels[0].max(axis=1, keepdims=True)
-        for s in panels[1:]:
-            m_cur = jnp.maximum(m_cur, s.max(axis=1, keepdims=True))
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)        # [G, 1]
-        l_new = l_ref[:] * alpha
-        for p, s in enumerate(panels):
-            probs = jnp.exp(s - m_new)         # [G, chunk*rows]
-            l_new = l_new + jnp.sum(probs, axis=1, keepdims=True)
-            acc_ref[p] = acc_ref[p] * alpha + jax.lax.dot_general(
-                probs, v, (((1,), (0,)), ((), ())))          # [G, L]
-        l_ref[:] = l_new
+        s = jax.lax.dot_general(qm, k, (((1,), (1,)), ((), ())))  # [Hp, cbs]
+        if softcap:
+            s = softcap_scores(s, softcap)
+        kv_pos = ci * chunk * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=1)
+        s = jnp.where((kv_pos < seq_len) & (kv_pos > win_lo), s, NEG_INF)
+        m_prev = m_ref[:]                       # [Hp, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())))     # [Hp, C]
         m_ref[:] = m_new
         return 0
 
-    jax.lax.fori_loop(0, num_chunks, body, 0)
-    l = jnp.maximum(l_ref[:], 1e-20)
-    for p in range(pack):
-        o_ref[p] = (acc_ref[p] / l).astype(o_ref.dtype)
+    jax.lax.fori_loop(start_ci, num_chunks, body, 0)
+    o_ref[:] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-20)).astype(o_ref.dtype)
 
 
 def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                            block_tables: jax.Array, seq_lens: jax.Array,
                            *, block_size: int, scale: float,
                            softcap: float | None = None,
+                           win_lo: jax.Array | None = None,
                            chunk_blocks: int = 8,
                            interpret: bool = False) -> jax.Array:
-    """Same contract as `paged_attention_xla`; KV stays in HBM and is DMA'd
-    chunk-by-chunk with double buffering (no [B, M*BS] gather
-    materialization). Head dims < 128 use the lane-packed KV view."""
+    """Same contract as `paged_attention_xla`; KV stays in HBM and streams
+    chunk-by-chunk with double buffering (no [B, M*BS] gather). Sliding
+    windows are in-kernel (win_lo: [B], -1 for global layers)."""
     B, H, Dh = q.shape
-    KVH, NTOK, _ = k_cache.shape
-    if not pallas_supported(Dh, block_size):
+    NTOK, C = k_cache.shape
+    KVH = C // Dh
+    if not pallas_supported(H, KVH, Dh, block_size):
         raise ValueError(
-            f"unsupported pallas geometry (Dh={Dh}, block_size={block_size}):"
-            f" needs Dh % 128 == 0, or 128 % Dh == 0 with 8-sublane-aligned"
-            f" packed rows — see pallas_supported")
-    pack, L = max(1, 128 // Dh), max(Dh, 128)
+            f"unsupported pallas geometry (H={H}, KVH={KVH}, Dh={Dh}, "
+            f"block_size={block_size}): needs KVH*Dh % 128 == 0 and "
+            f"block_size % 8 == 0 — see pallas_supported")
     g = H // KVH
     M = block_tables.shape[1]
     chunk = max(1, min(chunk_blocks, M))
-    rows = block_size // pack
-    k2 = k_cache.reshape(KVH, NTOK // pack, L)     # free, row-major
-    v2 = v_cache.reshape(KVH, NTOK // pack, L)
-    qg = q.reshape(B, KVH, g, Dh)
-    if pack == 1:
-        qp = qg[:, :, None]                        # [B, KVH, 1, G, L]
-    else:
-        # q at lane slot p of panel p, zeros elsewhere → panel p's dot
-        # against a packed row selects exactly the parity-p token.
-        qp = jnp.zeros((B, KVH, pack, g, L), q.dtype)
-        for p in range(pack):
-            qp = qp.at[:, :, p, :, p * Dh:(p + 1) * Dh].set(qg)
+    Hp = max(8, H)   # sublane-pad the head rows for tiny models
+    # sparse slot placement: row h carries q[h] at its kv head's lane group
+    qm = jnp.zeros((B, Hp, KVH, Dh), q.dtype)
+    qm = qm.at[:, jnp.arange(H), jnp.arange(H) // g, :].set(q)
+    qm = qm.reshape(B, Hp, C)
+    if win_lo is None:
+        win_lo = jnp.full((B,), -1, jnp.int32)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, KVH),
+        num_scalar_prefetch=3,
+        grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, 1, pack, g, L), lambda b, h, *_: (b, h, 0, 0, 0)),
+            pl.BlockSpec((1, Hp, C), lambda b, *_: (b, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),   # k_cache stays in HBM
             pl.BlockSpec(memory_space=pltpu.ANY),   # v_cache stays in HBM
         ],
-        out_specs=pl.BlockSpec((1, 1, pack, g, L),
-                               lambda b, h, *_: (b, h, 0, 0, 0)),
+        out_specs=pl.BlockSpec((1, Hp, C), lambda b, *_: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((g, 1), jnp.float32),                 # m
-            pltpu.VMEM((g, 1), jnp.float32),                 # l
-            pltpu.VMEM((pack, g, L), jnp.float32),           # acc panels
-            pltpu.VMEM((2, chunk * rows, L), k_cache.dtype), # k double buffer
-            pltpu.VMEM((2, chunk * rows, L), v_cache.dtype), # v double buffer
+            pltpu.VMEM((Hp, 1), jnp.float32),                 # m
+            pltpu.VMEM((Hp, 1), jnp.float32),                 # l
+            pltpu.VMEM((Hp, C), jnp.float32),                 # acc
+            pltpu.VMEM((2, chunk * block_size, C), k_cache.dtype),
+            pltpu.VMEM((2, chunk * block_size, C), v_cache.dtype),
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
 
-    def kernel(block_tables_ref, seq_lens_ref, q_ref, k_hbm, v_hbm, o_ref,
-               m_ref, l_ref, acc_ref, k_bufs, v_bufs, sems):
-        h = pl.program_id(1)
+    def kernel(block_tables_ref, seq_lens_ref, win_lo_ref, q_ref,
+               k_hbm, v_hbm, o_ref, m_ref, l_ref, acc_ref,
+               k_bufs, v_bufs, sems):
         _paged_attn_kernel(
-            block_tables_ref, seq_lens_ref,
-            q_ref.at[0, 0], k_hbm.at[h], v_hbm.at[h], o_ref.at[0, 0],
+            block_tables_ref, seq_lens_ref, win_lo_ref,
+            q_ref.at[0], k_hbm, v_hbm, o_ref.at[0],
             m_ref, l_ref, acc_ref, k_bufs, v_bufs, sems,
-            block_size=block_size, pack=pack, chunk=chunk, scale=scale,
-            softcap=softcap)
+            block_size=block_size, chunk=chunk, scale=scale, softcap=softcap)
 
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, KVH, pack, g, L), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, Hp, C), q.dtype),
         interpret=interpret,
-    )(block_tables, seq_lens, qp, k2, v2)
-    if pack == 1:
-        return out[:, :, 0].reshape(B, H, Dh)
-    # panel p's slot-p lanes hold its tokens' v contributions; the rest is
-    # cross-slot garbage by construction — sum the diagonal slots.
-    res = out[:, :, 0, :, :Dh]
-    for p in range(1, pack):
-        res = res + out[:, :, p, :, p * Dh:(p + 1) * Dh]
-    return res.reshape(B, H, Dh)
+    )(block_tables, seq_lens, jnp.asarray(win_lo, jnp.int32), qm,
+      k_cache, v_cache)
+    # row h's useful lanes are its kv head's slot; the rest is cross-slot
+    # garbage by construction
+    out = out.reshape(B, Hp, KVH, Dh)[:, :H]
+    kh = (jnp.arange(H) // g)[None, :, None, None]
+    return jnp.take_along_axis(out, kh, axis=2)[:, :, 0].reshape(B, H, Dh)
 
 
-def pallas_supported(head_dim: int, block_size: int) -> bool:
-    """True if the Pallas decode kernel handles this geometry (lane-aligned
-    heads directly; sub-lane heads via the packed-KV kernel). Packed-view
-    DMA slices are `block_size/P` sublanes tall and Mosaic requires sublane
-    slices aligned to the 8-row tile, so tiny head dims need commensurately
-    larger KV blocks (Dh=64 ⇒ bs≥16, Dh=32 ⇒ bs≥32, Dh=16 ⇒ bs≥64)."""
-    if head_dim % 128 == 0:
-        return True
-    if 128 % head_dim:
-        return False
-    pack = 128 // head_dim
-    return block_size % pack == 0 and (block_size // pack) % 8 == 0
+def pallas_supported(num_heads: int, num_kv_heads: int, head_dim: int,
+                     block_size: int) -> bool:
+    """True if the Pallas decode kernel handles this geometry: the packed
+    lane width KVH*Dh must be lane-aligned (128) and KV blocks must be
+    8-sublane aligned. Tiny test models (KVH*Dh < 128) fall back to XLA."""
+    return ((num_kv_heads * head_dim) % 128 == 0
+            and block_size % 8 == 0
+            and num_heads % num_kv_heads == 0)
 
 
 def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
@@ -308,42 +287,27 @@ def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
                     impl: str = "auto",
                     softcap: float | None = None,
                     win_lo: jax.Array | None = None) -> jax.Array:
-    """Dispatch: pallas on TPU, XLA gather fallback elsewhere. Mosaic
-    requires lane-aligned (128) memref slices: lane-aligned head dims use
-    the direct kernel; sub-lane head dims (llama-1B class Dh=64) use the
-    lane-packed kernel when the geometry allows (`pallas_supported`);
-    both implementations support score soft-capping (gemma2). Sliding
-    windows (win_lo: [B] lowest attendable position minus one, -1 for
-    global) are XLA-path only."""
-    if win_lo is not None:
-        return paged_attention_xla(q, k_cache, v_cache, block_tables,
-                                   seq_lens, block_size=block_size,
-                                   scale=scale, softcap=softcap,
-                                   win_lo=win_lo)
+    """Dispatch: pallas on TPU (block-major streaming kernel, incl. sliding
+    windows and soft-capping), XLA gather fallback elsewhere and for
+    geometries the kernel can't tile (lane width KVH*Dh < 128)."""
     if impl == "auto":
-        head_dim = q.shape[-1]
-        max_ctx = block_tables.shape[1] * block_size
-        # Lane-aligned heads: kernel wins broadly. Sub-lane (packed) heads:
-        # the kernel reads only valid KV (4x faster at 4k ctx on v5e) but
-        # per-block DMA overhead loses to XLA's fused gather at short ctx,
-        # so require a long-context block table before switching.
-        if _on_tpu() and pallas_supported(head_dim, block_size):
-            impl = ("pallas" if head_dim % 128 == 0 or max_ctx >= 2048
-                    else "xla")
-        else:
-            impl = "xla"
+        B, H, Dh = q.shape
+        KVH = k_cache.shape[1] // Dh
+        impl = ("pallas" if _on_tpu()
+                and pallas_supported(H, KVH, Dh, block_size) else "xla")
     if impl == "pallas":
         return paged_attention_pallas(q, k_cache, v_cache, block_tables,
                                       seq_lens, block_size=block_size,
-                                      scale=scale, softcap=softcap)
+                                      scale=scale, softcap=softcap,
+                                      win_lo=win_lo)
     if impl == "pallas_interpret":
         return paged_attention_pallas(q, k_cache, v_cache, block_tables,
                                       seq_lens, block_size=block_size,
                                       scale=scale, softcap=softcap,
-                                      interpret=True)
+                                      win_lo=win_lo, interpret=True)
     return paged_attention_xla(q, k_cache, v_cache, block_tables, seq_lens,
                                block_size=block_size, scale=scale,
-                               softcap=softcap)
+                               softcap=softcap, win_lo=win_lo)
 
 
 @functools.cache

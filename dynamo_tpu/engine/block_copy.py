@@ -10,9 +10,12 @@ The TP-reshard-on-transfer permute (block_copy.cu:558-728) is likewise not a
 kernel here: resharding is a sharding annotation change and XLA inserts the
 collective (SURVEY.md §5.8).
 
-Cache layout (engine/models/llama.py init_kv_cache):
-    {"k": [L, H_kv, num_blocks*block_size, D], "v": same}
-block b occupies token slice [b*bs, (b+1)*bs).
+Device cache layout (engine/models/llama.py init_kv_cache) is BLOCK-MAJOR:
+    {"k": [L, num_blocks*block_size, H_kv*D], "v": same}
+block b occupies token-row slice [b*bs, (b+1)*bs). The WIRE/HOST format for
+stacked blocks stays head-major ``[L, H, n, bs, D]`` (the disagg handoff
+protocol and the host offload arena predate the device-layout change);
+gather/scatter convert between the two inside the jitted op.
 """
 
 from __future__ import annotations
@@ -27,18 +30,22 @@ import numpy as np
 KVCache = Dict[str, jax.Array]
 
 __all__ = ["gather_blocks", "scatter_blocks", "gather_blocks_dispatch",
-           "gather_blocks_to_host", "scatter_blocks_from_host"]
+           "gather_blocks_to_host", "scatter_blocks_from_host",
+           "to_wire_format", "from_wire_format", "fetch_wire"]
 
 
 @functools.partial(jax.jit, static_argnames=("block_size",))
 def gather_blocks(kv: KVCache, block_ids: jax.Array,
                   block_size: int) -> KVCache:
-    """Stack ``n`` blocks out of the paged pool → {"k": [L, H, n, bs, D]}."""
+    """Stack ``n`` blocks out of the paged pool -> {"k": [L, n, bs, H*D]}
+    (block-major, same lane packing as the pool; convert to the head-major
+    wire format with ``to_wire_format`` / ``fetch_wire``)."""
 
     def one(arr: jax.Array) -> jax.Array:
-        L, H, _T, D = arr.shape
-        paged = arr.reshape(L, H, -1, block_size, D)
-        return jnp.take(paged, block_ids, axis=2)
+        L, _T, HD = arr.shape
+        paged = arr.reshape(L, -1, block_size, HD)
+        picked = jnp.take(paged, block_ids, axis=1)     # [L, n, bs, HD]
+        return picked
 
     return {k: one(v) for k, v in kv.items()}
 
@@ -47,14 +54,14 @@ def gather_blocks(kv: KVCache, block_ids: jax.Array,
                    donate_argnums=(0,))
 def scatter_blocks(kv: KVCache, block_ids: jax.Array, values: KVCache,
                    block_size: int) -> KVCache:
-    """Write stacked block values ([L, H, n, bs, D]) into pool slots
+    """Write stacked block values ([L, n, bs, H*D]) into pool row slices
     ``block_ids``; kv is donated so XLA updates HBM in place."""
 
     def one(arr: jax.Array, val: jax.Array) -> jax.Array:
-        L, H, _T, D = arr.shape
-        paged = arr.reshape(L, H, -1, block_size, D)
-        paged = paged.at[:, :, block_ids].set(val.astype(arr.dtype))
-        return paged.reshape(L, H, -1, D)
+        L, _T, HD = arr.shape
+        paged = arr.reshape(L, -1, block_size, HD)
+        paged = paged.at[:, block_ids].set(val.astype(arr.dtype))
+        return paged.reshape(L, -1, HD)
 
     return {k: one(arr, values[k]) for k, arr in kv.items()}
 
@@ -66,32 +73,58 @@ def _pad_pow2(n: int) -> int:
     return p
 
 
+def to_wire_format(picked: np.ndarray, num_heads: int) -> np.ndarray:
+    """[L, n, bs, H*D] (block-major) -> wire [L, H, n, bs, D]."""
+    L, n, bs, HD = picked.shape
+    d = HD // num_heads
+    return np.ascontiguousarray(
+        picked.reshape(L, n, bs, num_heads, d).transpose(0, 3, 1, 2, 4))
+
+
+def from_wire_format(vals: np.ndarray) -> np.ndarray:
+    """wire [L, H, n, bs, D] -> [L, n, bs, H*D] (block-major)."""
+    L, H, n, bs, d = vals.shape
+    return np.ascontiguousarray(
+        vals.transpose(0, 2, 3, 1, 4).reshape(L, n, bs, H * d))
+
+
 def gather_blocks_dispatch(kv: KVCache, block_ids, block_size: int) -> KVCache:
     """Dispatch (but do not fetch) the on-device gather of ``block_ids``.
 
     Block-id count is padded to a power of two (with the trash block, id 0)
     so XLA compiles O(log n) gather programs, not one per count; callers
-    slice ``[:, :, :len(block_ids)]`` after fetching. Dispatching eagerly
+    slice ``[:n]`` on the block axis after fetching. Dispatching eagerly
     orders the read before any later donated in-place KV update (single
-    device stream = program order), so the caller may fetch off-thread."""
+    device stream = program order), so the caller may fetch off-thread.
+    Result layout: [L, n_padded, bs, H*D] per entry."""
     n = len(block_ids)
     padded = list(block_ids) + [0] * (_pad_pow2(n) - n)
     ids = jnp.asarray(np.asarray(padded, dtype=np.int32))
     return gather_blocks(kv, ids, block_size)
 
 
-def gather_blocks_to_host(kv: KVCache, block_ids, block_size: int) -> dict:
-    """Device → TPU-VM DRAM: gather on device (one DMA-friendly slice), then
-    a single transfer. Returns numpy {"k": [L, H, n, bs, D]}."""
-    n = len(block_ids)
+def fetch_wire(stacked: KVCache, n: int, num_heads: int) -> dict:
+    """Fetch a dispatched gather ([L, n_padded, bs, H*D] device arrays) to
+    the host and convert to wire format {"k": [L, H, n, bs, D]} — the one
+    device->wire harvest used by offload, handoff, and gather_blocks_to_host
+    (keep in sync by calling, not copying)."""
+    return {k: to_wire_format(np.asarray(v)[:, :n], num_heads)
+            for k, v in stacked.items()}
+
+
+def gather_blocks_to_host(kv: KVCache, block_ids, block_size: int,
+                          num_heads: int) -> dict:
+    """Device -> TPU-VM DRAM: gather on device (one DMA-friendly slice), then
+    a single transfer. Returns numpy wire format {"k": [L, H, n, bs, D]}."""
     stacked = gather_blocks_dispatch(kv, block_ids, block_size)
-    return {k: np.asarray(v)[:, :, :n] for k, v in stacked.items()}
+    return fetch_wire(stacked, len(block_ids), num_heads)
 
 
 def scatter_blocks_from_host(kv: KVCache, block_ids, host_values: dict,
                              block_size: int) -> KVCache:
-    """TPU-VM DRAM → device: one transfer, then an on-device scatter into
-    the paged pool. Returns the new (donated-in-place) cache.
+    """TPU-VM DRAM -> device: one transfer, then an on-device scatter into
+    the paged pool. ``host_values`` is wire format [L, H, n, bs, D]; returns
+    the new (donated-in-place) cache.
 
     Padding targets the trash block (id 0), whose content is never read."""
     n = len(block_ids)
@@ -100,10 +133,10 @@ def scatter_blocks_from_host(kv: KVCache, block_ids, host_values: dict,
     ids = jnp.asarray(np.asarray(padded, dtype=np.int32))
     dev_vals = {}
     for k, v in host_values.items():
-        v = np.asarray(v)
+        v = from_wire_format(np.asarray(v))
         if pad:
             v = np.concatenate(
-                [v, np.zeros(v.shape[:2] + (pad,) + v.shape[3:], v.dtype)],
-                axis=2)
+                [v, np.zeros((v.shape[0], pad) + v.shape[2:], v.dtype)],
+                axis=1)
         dev_vals[k] = jnp.asarray(v)
     return scatter_blocks(kv, ids, dev_vals, block_size)

@@ -170,10 +170,12 @@ class EngineConfig:
     # off on-device tokens while the previous results copy to the host —
     # steady-state cost max(fetch, compute) instead of fetch+compute.
     # Finish/cancel reaction widens to ≤2K-1 steps. Requires K > 1.
-    # KNOWN GAP: under heavy preemption/re-admission churn a rare
-    # (~1/36 adversarial interleavings) exactness race exists in the
-    # chained path — keep this off for workloads that preempt and need
-    # bit-exact streams; stable-batch serving (and bench.py) is exact.
+    # Note on exactness: under RECOMPUTE PREEMPTION (any dispatch mode,
+    # pipelined or not) a stream is bit-exact vs an uncontended run only up
+    # to its first preemption point — the re-admission prefill's f32
+    # numerics differ slightly from the decode program's, which can flip a
+    # greedy argmax at near-tie logits (root-caused via engine/replay.py;
+    # previously misattributed to a pipelined-dispatch race).
     decode_dispatch_pipeline: bool = False
     # admission prefills start an async device→host copy of their sampled
     # token and complete after the next decode dispatch, so the fetch —

@@ -77,6 +77,13 @@ class EngineRequest:
     prefix_hit_tokens: int = 0
     seq: Optional[TokenBlockSequence] = None   # full token history + hashes
     registered_blocks: int = 0
+    emitted_total: int = 0        # tokens the client has seen (across lives)
+    # client-stream indices where recompute preemption re-derived the next
+    # token via the prefill program; bit-exactness vs an uncontended run is
+    # guaranteed only UP TO the first of these (prefill/decode numerics can
+    # legitimately flip a greedy argmax at near-tie logits — see
+    # KNOWN_ISSUES "recompute preemption exactness")
+    preempt_points: List[int] = dataclasses.field(default_factory=list)
     enqueue_time: float = dataclasses.field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
 
@@ -579,17 +586,17 @@ class EngineCore:
         prompt's blocks (ordered before any later donated decode step by
         the device's program order), then ship device→DRAM→TCP off-thread
         so the engine loop keeps stepping during the DMA + DCN transfer."""
-        from .block_copy import gather_blocks_dispatch
+        from .block_copy import fetch_wire, gather_blocks_dispatch
         n_blocks = self._blocks_needed(req.pos)
         ids = req.blocks[:n_blocks]
         stacked = gather_blocks_dispatch(self.kv, ids, self.cfg.kv_block_size)
         seq_hashes = list(req.seq.sequence_hashes[:req.registered_blocks])
         handoff = req.handoff
+        kvh = self.model_cfg.num_kv_heads
 
         async def send() -> None:
             values = await asyncio.to_thread(
-                lambda: {k: np.asarray(v)[:, :, :n_blocks]
-                         for k, v in stacked.items()})
+                fetch_wire, stacked, n_blocks, kvh)
             await handoff(tok, logprob, values, seq_hashes)
 
         task = asyncio.get_running_loop().create_task(
@@ -907,6 +914,7 @@ class EngineCore:
             self._finish_request(req, FinishReason.LENGTH)
             return
         self.preemptions += 1
+        req.preempt_points.append(req.emitted_total)
         logger.info("preempting %s after %d tokens (KV exhausted; "
                     "recompute on re-admission)", req.rid, req.generated)
         if self.recorder is not None:
@@ -928,6 +936,7 @@ class EngineCore:
 
     # ------------------------------------------------------------- finishes
     def _emit(self, req: EngineRequest, token: int, logprob: float) -> None:
+        req.emitted_total += 1
         req.out_queue.put_nowait((token, logprob))
 
     def _maybe_finish_after_emit(self, req: EngineRequest) -> None:

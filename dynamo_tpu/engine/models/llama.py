@@ -5,8 +5,9 @@ This is the engine-side model the reference outsources to vLLM/SGLang/TRT-LLM
 
 - stacked-layer parameters + `lax.scan` over layers → one compiled layer body
   (fast compile, good for pjit partitioning);
-- KV cache per layer is a flat paged token pool `[KVH, NTOK, Dh]`
-  (see attention.py for why), updated in place via donated buffers;
+- KV cache per layer is a flat paged token pool `[NTOK, KVH*Dh]`
+  (block-major; see attention.py for why), updated in place via donated
+  buffers;
 - prefill is "batched multi-token decode": chunk KV is scattered into the
   paged pool first, then queries attend over the block table — which makes
   chunked prefill and prefix-cache reuse the same code path;
@@ -31,7 +32,7 @@ from ..attention import (flat_token_indices, paged_attention,
 from ..config import ModelConfig
 
 Params = Dict[str, jax.Array]
-KVCache = Dict[str, jax.Array]  # {"k": [L, KVH, NTOK, Dh], "v": ...}
+KVCache = Dict[str, jax.Array]  # {"k": [L, NTOK, KVH*Dh], "v": ...}
 
 
 # ---------------------------------------------------------------------------
@@ -196,8 +197,8 @@ def init_params(cfg: ModelConfig, key: jax.Array,
 
 def init_kv_cache(cfg: ModelConfig, num_blocks: int, block_size: int,
                   dtype=jnp.bfloat16) -> KVCache:
-    shape = (cfg.num_layers, cfg.num_kv_heads, num_blocks * block_size,
-             cfg.head_dim)
+    shape = (cfg.num_layers, num_blocks * block_size,
+             cfg.num_kv_heads * cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype=dtype),
             "v": jnp.zeros(shape, dtype=dtype)}
 
@@ -261,10 +262,10 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
             k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps, p1)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        k_l = k_l.at[:, slots, :].set(k.transpose(1, 0, 2).astype(k_l.dtype),
-                                      mode="drop")
-        v_l = v_l.at[:, slots, :].set(v.transpose(1, 0, 2).astype(v_l.dtype),
-                                      mode="drop")
+        k_l = k_l.at[slots, :].set(k.reshape(N, -1).astype(k_l.dtype),
+                                   mode="drop")
+        v_l = v_l.at[slots, :].set(v.reshape(N, -1).astype(v_l.dtype),
+                                   mode="drop")
         attn = attn_fn(q, k, v, k_l, v_l, sliding)
         attn_out = attn.reshape(N, -1) @ lp["wo"]
         if cfg.post_norms:   # gemma2: norm the block output, then residual
@@ -357,11 +358,14 @@ def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
     def attn(q, _k, _v, k_l, v_l, sliding):
         # attend over the whole block table (prefix KV + this chunk)
         idx = flat_token_indices(block_table[None, :], bsz)[0]       # [S]
-        ks = jnp.take(k_l, idx, axis=1)                              # [KVH,S,Dh]
-        vs = jnp.take(v_l, idx, axis=1)
+        S = idx.shape[0]
+        ks = jnp.take(k_l, idx, axis=0).reshape(                     # [S,KVH,Dh]
+            S, cfg.num_kv_heads, cfg.head_dim)
+        vs = jnp.take(v_l, idx, axis=0).reshape(
+            S, cfg.num_kv_heads, cfg.head_dim)
         g = cfg.num_heads // cfg.num_kv_heads
         qg = q.reshape(T, cfg.num_kv_heads, g, cfg.head_dim)
-        scores = jnp.einsum("tkgd,ksd->kgts", qg, ks).astype(jnp.float32) * scale
+        scores = jnp.einsum("tkgd,skd->kgts", qg, ks).astype(jnp.float32) * scale
         if cfg.attn_logit_softcap:
             scores = _softcap(scores, cfg.attn_logit_softcap)
         kv_pos = jnp.arange(idx.shape[0], dtype=jnp.int32)
@@ -374,7 +378,7 @@ def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
             mask = mask & (kv_pos[None, :] > win_lo[:, None])
         scores = jnp.where(mask[None, None, :, :], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1).astype(vs.dtype)
-        return jnp.einsum("kgts,ksd->tkgd", probs, vs).reshape(
+        return jnp.einsum("kgts,skd->tkgd", probs, vs).reshape(
             T, cfg.num_heads, cfg.head_dim)
 
     x = _embed(params, tokens, cfg)  # activation dtype follows param dtype

@@ -33,14 +33,17 @@ __all__ = ["HostKvPool", "KvOffloadEngine", "OffloadJob"]
 class HostKvPool:
     """Preallocated host arena of KV blocks keyed by sequence hash.
 
-    Shapes: per block the stacked layout [L, H_kv, bs, D] for k and v —
-    matching engine/block_copy.py's gather output sliced per block.
+    Shapes: per block the head-major WIRE layout [L, H_kv, bs, D] for k and
+    v — i.e. engine/block_copy.py's ``fetch_wire``/``to_wire_format`` output
+    sliced per block (the device pool itself is block-major; convert before
+    storing).
     """
 
     def __init__(self, capacity_blocks: int, num_layers: int,
                  num_kv_heads: int, block_size: int, head_dim: int,
                  dtype=np.float32):
         self.capacity = capacity_blocks
+        self.num_kv_heads = num_kv_heads
         shape = (capacity_blocks, num_layers, num_kv_heads, block_size,
                  head_dim)
         self._arena = {"k": np.zeros(shape, dtype=dtype),
@@ -192,7 +195,7 @@ class KvOffloadEngine:
             await asyncio.sleep(0)  # yield to the engine loop
 
     async def _process(self, jobs: List[OffloadJob]) -> None:
-        from ...engine.block_copy import gather_blocks_dispatch
+        from ...engine.block_copy import fetch_wire, gather_blocks_dispatch
 
         block_ids = [b for j in jobs for b in j.block_ids]
         seq_hashes = [h for j in jobs for h in j.seq_hashes]
@@ -211,7 +214,7 @@ class KvOffloadEngine:
         # ...then do the blocking device→DRAM transfer off-thread so decode
         # keeps stepping during the DMA
         values = await asyncio.to_thread(
-            lambda: {k: np.asarray(v)[:, :, :n] for k, v in stacked.items()})
+            fetch_wire, stacked, n, self.host_pool.num_kv_heads)
         stored = self.host_pool.store(hashes, values)
         self.offloaded_blocks_total += stored
 

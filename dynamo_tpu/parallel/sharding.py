@@ -77,9 +77,11 @@ def param_pspecs(cfg: ModelConfig) -> Dict[str, P]:
 
 
 def kv_pspecs() -> Dict[str, P]:
-    # KV heads split over tp — the KV pool for one head lives wholly on one
-    # chip, so paged-attention DMA never crosses chips.
-    return {"k": P(None, "tp", None, None), "v": P(None, "tp", None, None)}
+    # KV heads split over tp — in the block-major pool [L, NTOK, KVH*Dh]
+    # head vectors are contiguous lane groups, so sharding the last axis
+    # keeps each head's pool wholly on one chip and paged-attention DMA
+    # never crosses chips.
+    return {"k": P(None, None, "tp"), "v": P(None, None, "tp")}
 
 
 def batch_pspecs() -> Dict[str, P]:

@@ -154,45 +154,28 @@ def test_chunked_prefill_matches_whole(tiny_params):
                                rtol=2e-4, atol=2e-4)
 
 
-def test_paged_attention_pallas_interpret_matches_xla():
+@pytest.mark.parametrize("geom", [
+    # (H, KVH, Dh): lane width KVH*Dh must be 128-aligned
+    (4, 2, 128),      # GQA, lane-aligned heads
+    (8, 4, 64),       # llama-1B-class sub-lane heads (C=256)
+    (8, 4, 32),       # tiny heads, C=128
+    (4, 4, 32),       # MHA, H < 8 exercises the sublane pad
+])
+@pytest.mark.parametrize("chunk_blocks", [2, 8])
+def test_paged_attention_pallas_interpret_matches_xla(geom, chunk_blocks):
+    """Block-major kernel vs the XLA gather path, incl. softcap and the
+    multi-chunk double-buffer path (chunk_blocks=2 with M=4 chunks)."""
+    H, KVH, Dh = geom
     rng = np.random.default_rng(3)
-    B, H, KVH, Dh, M = 3, 4, 2, 128, 4
+    B, M = 3, 4
     NTOK = NUM_BLOCKS * BS
     q = jnp.asarray(rng.standard_normal((B, H, Dh)), jnp.float32)
-    k = jnp.asarray(rng.standard_normal((KVH, NTOK, Dh)), jnp.float32)
-    v = jnp.asarray(rng.standard_normal((KVH, NTOK, Dh)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((NTOK, KVH * Dh)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((NTOK, KVH * Dh)), jnp.float32)
     tables = jnp.asarray(rng.integers(1, NUM_BLOCKS, size=(B, M)), jnp.int32)
     seq_lens = jnp.asarray([5, 17, 32], jnp.int32)
-    scale = Dh ** -0.5
-    ref = paged_attention_xla(q, k, v, tables, seq_lens,
-                              block_size=BS, scale=scale)
-    for chunk_blocks in (2, 8):   # 2 forces the multi-chunk path (M=4)
-        out = paged_attention_pallas(q, k, v, tables, seq_lens,
-                                     block_size=BS, scale=scale,
-                                     chunk_blocks=chunk_blocks,
-                                     interpret=True)
-        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                                   rtol=1e-5, atol=1e-5)
-
-
-@pytest.mark.parametrize("head_dim,bs", [(64, 16), (32, 32), (16, 128)])
-@pytest.mark.parametrize("chunk_blocks", [2, 8])
-def test_paged_attention_pallas_packed_matches_xla(head_dim, bs, chunk_blocks):
-    """Sub-128 head dims route to the lane-packed kernel ([KVH, NTOK/P, 128]
-    view); numerics must match the XLA gather path, incl. softcap.
-    chunk_blocks=2 forces multiple chunks (M=5) so the double-buffer slot
-    alternation, prefetch branch, and cross-chunk softmax rescale run."""
-    rng = np.random.default_rng(7)
-    B, H, KVH, M = 4, 8, 4, 5
-    num_blocks = 24
-    ntok = num_blocks * bs
-    q = jnp.asarray(rng.standard_normal((B, H, head_dim)), jnp.float32)
-    k = jnp.asarray(rng.standard_normal((KVH, ntok, head_dim)), jnp.float32)
-    v = jnp.asarray(rng.standard_normal((KVH, ntok, head_dim)), jnp.float32)
-    tables = jnp.asarray(rng.integers(1, num_blocks, size=(B, M)), jnp.int32)
-    seq_lens = jnp.asarray([1, bs + 3, 2 * bs, M * bs], jnp.int32)
     for softcap in (None, 30.0):
-        kw = dict(block_size=bs, scale=head_dim ** -0.5, softcap=softcap)
+        kw = dict(block_size=BS, scale=Dh ** -0.5, softcap=softcap)
         ref = paged_attention_xla(q, k, v, tables, seq_lens, **kw)
         out = paged_attention_pallas(q, k, v, tables, seq_lens,
                                      chunk_blocks=chunk_blocks,
@@ -201,16 +184,37 @@ def test_paged_attention_pallas_packed_matches_xla(head_dim, bs, chunk_blocks):
                                    rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("chunk_blocks", [1, 2, 8])
+def test_paged_attention_pallas_sliding_window_matches_xla(chunk_blocks):
+    """win_lo (gemma2 local layers) is in-kernel in the block-major design.
+    chunk_blocks=1/2 force multi-chunk runs so the below-window chunk skip
+    and the cross-chunk online-softmax rescale under masking execute."""
+    rng = np.random.default_rng(11)
+    B, H, KVH, Dh, M = 3, 4, 2, 64, 4
+    NTOK = NUM_BLOCKS * BS
+    q = jnp.asarray(rng.standard_normal((B, H, Dh)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((NTOK, KVH * Dh)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((NTOK, KVH * Dh)), jnp.float32)
+    tables = jnp.asarray(rng.integers(1, NUM_BLOCKS, size=(B, M)), jnp.int32)
+    seq_lens = jnp.asarray([7, 20, 32], jnp.int32)
+    win_lo = jnp.asarray([-1, 8, 25], jnp.int32)   # global, windowed, windowed
+    kw = dict(block_size=BS, scale=Dh ** -0.5, win_lo=win_lo)
+    ref = paged_attention_xla(q, k, v, tables, seq_lens, **kw)
+    out = paged_attention_pallas(q, k, v, tables, seq_lens,
+                                 chunk_blocks=chunk_blocks,
+                                 interpret=True, **kw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_pallas_supported_geometry():
     from dynamo_tpu.engine.attention import pallas_supported
-    assert pallas_supported(128, 16)
-    assert pallas_supported(256, 16)
-    assert pallas_supported(64, 16)      # pack=2, rows=8
-    assert pallas_supported(32, 32)      # pack=4, rows=8
-    assert not pallas_supported(32, 8)   # pack=4, rows=2: sublane-misaligned
-    assert not pallas_supported(16, 16)  # pack=8, rows=2: sublane-misaligned
-    assert not pallas_supported(64, 1)   # block not divisible by pack
-    assert not pallas_supported(96, 16)  # 128 % 96 != 0
+    assert pallas_supported(32, 8, 128, 16)   # llama-8B class
+    assert pallas_supported(32, 8, 64, 16)    # llama-1B class, C=512
+    assert pallas_supported(8, 4, 32, 8)      # C=128
+    assert not pallas_supported(4, 2, 16, 8)  # C=32 < 128 (tiny test model)
+    assert not pallas_supported(32, 8, 128, 4)  # sub-8-sublane blocks
+    assert not pallas_supported(12, 5, 64, 16)  # H % KVH != 0
 
 
 def test_greedy_generation_matches_hf(tiny_params, hf_model):

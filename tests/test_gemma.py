@@ -197,10 +197,10 @@ def test_paged_attention_softcap_pallas_matches_xla():
     from dynamo_tpu.engine.attention import (paged_attention_pallas,
                                              paged_attention_xla)
     rng = np.random.default_rng(17)
-    B, H, KVH, Dh, bs, M = 2, 4, 2, 32, 32, 4
+    B, H, KVH, Dh, bs, M = 2, 4, 2, 64, 32, 4
     q = jnp.asarray(rng.standard_normal((B, H, Dh)), jnp.float32)
-    k = jnp.asarray(rng.standard_normal((KVH, M * bs * 2, Dh)), jnp.float32)
-    v = jnp.asarray(rng.standard_normal((KVH, M * bs * 2, Dh)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((M * bs * 2, KVH * Dh)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((M * bs * 2, KVH * Dh)), jnp.float32)
     bt = jnp.asarray(rng.integers(1, 2 * M, (B, M)), jnp.int32)
     sl = jnp.asarray([13, 25], jnp.int32)
     kw = dict(block_size=bs, scale=Dh ** -0.5, softcap=30.0)

@@ -19,33 +19,37 @@ NB = 16  # device blocks
 
 
 def _rand_kv(rng):
+    # block-major device layout [L, NTOK, H*D]
     import jax.numpy as jnp
-    return {"k": jnp.asarray(rng.normal(size=(L, H, NB * BS, D)),
+    return {"k": jnp.asarray(rng.normal(size=(L, NB * BS, H * D)),
                              dtype=jnp.float32),
-            "v": jnp.asarray(rng.normal(size=(L, H, NB * BS, D)),
+            "v": jnp.asarray(rng.normal(size=(L, NB * BS, H * D)),
                              dtype=jnp.float32)}
+
+
+def _headmajor(arr):
+    """Device [L, NTOK, H*D] → [L, H, NB, BS, D] for content checks."""
+    return np.asarray(arr).reshape(L, NB, BS, H, D).transpose(0, 3, 1, 2, 4)
 
 
 def test_gather_scatter_roundtrip():
     rng = np.random.default_rng(0)
     kv = _rand_kv(rng)
     src = [2, 5, 7]
-    host = gather_blocks_to_host(kv, src, BS)
-    assert host["k"].shape == (L, H, 3, BS, D)
+    host = gather_blocks_to_host(kv, src, BS, H)
+    assert host["k"].shape == (L, H, 3, BS, D)   # wire format
     # gathered content matches the pool slices
-    k_np = np.asarray(kv["k"]).reshape(L, H, NB, BS, D)
+    k_np = _headmajor(kv["k"])
     np.testing.assert_allclose(host["k"][:, :, 1], k_np[:, :, 5])
     # scatter into different slots of a second cache
     kv2 = _rand_kv(rng)
     dst = [9, 11, 3]
     kv2 = scatter_blocks_from_host(kv2, dst, host, BS)
-    k2 = np.asarray(kv2["k"]).reshape(L, H, NB, BS, D)
-    v2 = np.asarray(kv2["v"]).reshape(L, H, NB, BS, D)
+    k2 = _headmajor(kv2["k"])
+    v2 = _headmajor(kv2["v"])
     np.testing.assert_allclose(k2[:, :, 9], k_np[:, :, 2])
     np.testing.assert_allclose(k2[:, :, 3], k_np[:, :, 7])
-    np.testing.assert_allclose(
-        v2[:, :, 11],
-        np.asarray(kv["v"]).reshape(L, H, NB, BS, D)[:, :, 5])
+    np.testing.assert_allclose(v2[:, :, 11], _headmajor(kv["v"])[:, :, 5])
 
 
 def test_host_pool_store_match_lru_eviction():
@@ -115,7 +119,7 @@ async def test_offload_engine_write_back_and_manager_fallthrough():
     assert plan2.host_hit_tokens == 8
     # onboarded content equals what was offloaded
     fetched = host.fetch(plan2.host_slots)
-    orig = gather_blocks_to_host(kv["kv"], plan.all_blocks[:2], BS)
+    orig = gather_blocks_to_host(kv["kv"], plan.all_blocks[:2], BS, H)
     np.testing.assert_allclose(fetched["k"], orig["k"])
 
 

@@ -58,7 +58,7 @@ async def run_req(core, prompt, rid, max_new, delay=0.0):
     while True:
         item, _ = await asyncio.wait_for(req.out_queue.get(), 120)
         if item is FINISH_SENTINEL:
-            return toks
+            return toks, req
         toks.append(item)
 
 
@@ -71,7 +71,8 @@ def solo_ref(prompt, max_new):
         async def go():
             core = make_core(64, record=False)
             try:
-                return await run_req(core, prompt, "ref", max_new)
+                toks, _req = await run_req(core, prompt, "ref", max_new)
+                return toks
             finally:
                 await core.stop()
         _REF_CACHE[key] = asyncio.run(go())
@@ -100,7 +101,20 @@ def trial(seed):
         return core, outs
 
     core, outs = asyncio.run(go())
-    bad = [i for i in range(n_req) if outs[i] != refs[i]]
+    # the exactness contract: bit-exact up to the first recompute boundary
+    # (prefill/decode numerics may flip a greedy argmax there — see
+    # KNOWN_ISSUES); divergence BEFORE the boundary is a real bug
+    bad = []
+    for i in range(n_req):
+        toks, req = outs[i]
+        if toks == refs[i]:
+            continue
+        boundary = (min(req.preempt_points) if req.preempt_points
+                    else len(refs[i]))
+        first = next(j for j, (a, b) in enumerate(zip(toks, refs[i]))
+                     if a != b)
+        if first < boundary:
+            bad.append(i)
     stale = check_log(core.recorder.events, block_size=8)
     problems = check_inputs(core.recorder.events)
     return core, bad, stale, problems
