@@ -182,6 +182,13 @@ class EngineConfig:
     # hundreds of ms on tunneled devices — overlaps decode instead of
     # stalling the engine loop. Emission order per request is unchanged.
     overlap_admission_fetch: bool = True
+    # weight-only quantization: "none" | "int8" | "int8-noembed"
+    # (engine/quant.py — int8 weights + per-output-channel scales, dequant
+    # fused into the matmuls; halves the per-step weights-read floor).
+    # "int8-noembed" keeps the embedding (and a tied lm head) in the load
+    # dtype — a quality/bandwidth middle ground. The reference serves FP8
+    # models via its engines; this is the native analog.
+    quantization: str = "none"
     seed: int = 0
 
     def __post_init__(self) -> None:

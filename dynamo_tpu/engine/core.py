@@ -118,6 +118,18 @@ class EngineCore:
         if params is None:
             params = llama.init_params(
                 model_cfg, jax.random.PRNGKey(engine_cfg.seed), dtype=param_dtype)
+        if engine_cfg.quantization in ("int8", "int8-noembed"):
+            if mesh is not None:
+                raise NotImplementedError(
+                    "int8 weights + mesh sharding not wired up yet "
+                    "(shard_params would need per-leaf specs for q/scale)")
+            from .quant import quantize_params
+            params = quantize_params(
+                params,
+                include_embed=engine_cfg.quantization == "int8")
+        elif engine_cfg.quantization != "none":
+            raise ValueError(
+                f"unknown quantization {engine_cfg.quantization!r}")
         self.params = params
         self.kv = llama.init_kv_cache(
             model_cfg, engine_cfg.num_kv_blocks, engine_cfg.kv_block_size,

@@ -30,6 +30,7 @@ from ..attention import causal_attention  # noqa: F401  (used by sp path)
 from ..attention import (flat_token_indices, paged_attention,
                          softcap_scores as _softcap)
 from ..config import ModelConfig
+from ..quant import QuantizedArray, mm
 
 Params = Dict[str, jax.Array]
 KVCache = Dict[str, jax.Array]  # {"k": [L, NTOK, KVH*Dh], "v": ...}
@@ -89,14 +90,14 @@ def apply_rope(x: jax.Array, positions: jax.Array,
 
 def swiglu(x: jax.Array, gate_w: jax.Array, up_w: jax.Array,
            down_w: jax.Array, act: str = "silu") -> jax.Array:
-    g = x @ gate_w
+    g = mm(x, gate_w)
     if act in ("gelu_pytorch_tanh", "gelu"):   # gemma families
         gated = jax.nn.gelu(g, approximate=True)
     elif act == "silu":
         gated = jax.nn.silu(g)
     else:
         raise ValueError(f"unsupported hidden_act {act!r}")
-    return (gated * (x @ up_w)) @ down_w
+    return mm(gated * mm(x, up_w), down_w)
 
 
 def moe_mlp(x: jax.Array, router_w: jax.Array, gate_w: jax.Array,
@@ -251,7 +252,7 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
         lp, k_l, v_l = xs["lp"], xs["k"], xs["v"]
         sliding = xs["sliding"]
         hn = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, p1)
-        q, k, v = hn @ lp["wq"], hn @ lp["wk"], hn @ lp["wv"]
+        q, k, v = mm(hn, lp["wq"]), mm(hn, lp["wk"]), mm(hn, lp["wv"])
         if cfg.attention_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = q.reshape(N, cfg.num_heads, cfg.head_dim)
@@ -267,7 +268,7 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
         v_l = v_l.at[slots, :].set(v.reshape(N, -1).astype(v_l.dtype),
                                    mode="drop")
         attn = attn_fn(q, k, v, k_l, v_l, sliding)
-        attn_out = attn.reshape(N, -1) @ lp["wo"]
+        attn_out = mm(attn.reshape(N, -1), lp["wo"])
         if cfg.post_norms:   # gemma2: norm the block output, then residual
             attn_out = rms_norm(attn_out, lp["ln1_post"],
                                 cfg.rms_norm_eps, p1)
@@ -295,8 +296,28 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
 def _logits(params: Params, x: jax.Array,
             cfg: ModelConfig = None) -> jax.Array:
     head = params.get("lm_head")
-    out = (x @ head if head is not None
-           else x @ params["embed"].T.astype(x.dtype))
+    emb = params["embed"]
+    # "tied" must come from the config, not from both leaves being
+    # quantized — an untied quantized model has a real lm_head AND a
+    # quantized embed, and projecting through the embedding would be
+    # garbage
+    tied_q = (cfg is not None and cfg.tie_word_embeddings
+              and isinstance(head, QuantizedArray)
+              and isinstance(emb, QuantizedArray))
+    # XLA's int8 matmul heuristics flip with batch size (measured on v5e,
+    # llama-1B head [2048, 128256]): the pre-transposed int8 head wins
+    # below ~32 rows (4.5ms vs 12.3ms step at B=16) but collapses at
+    # B=64 (82ms), where computing against the transposed int8 embedding
+    # is fine (9.7ms) — pick per traced batch size, it's static under jit
+    big_batch = x.ndim > 1 and x.shape[0] >= 32
+    if head is not None and not (tied_q and big_batch):
+        out = mm(x, head)
+    elif isinstance(emb, QuantizedArray):
+        # tied head: per-row embed scales become per-column here
+        out = (x @ emb.q.T.astype(x.dtype)) * emb.scale.astype(
+            x.dtype).reshape(-1)
+    else:
+        out = x @ emb.T.astype(x.dtype)
     out = out.astype(jnp.float32)
     if cfg is not None and cfg.final_logit_softcap:
         out = _softcap(out, cfg.final_logit_softcap)
@@ -304,7 +325,12 @@ def _logits(params: Params, x: jax.Array,
 
 
 def _embed(params: Params, tokens: jax.Array, cfg: ModelConfig) -> jax.Array:
-    x = params["embed"][tokens]
+    emb = params["embed"]
+    if isinstance(emb, QuantizedArray):
+        dt = params["final_norm"].dtype
+        x = emb.q[tokens].astype(dt) * emb.scale[tokens].astype(dt)
+    else:
+        x = emb[tokens]
     if cfg.embed_scale:   # gemma normalizer, applied in the embed dtype
         x = x * jnp.asarray(cfg.hidden_size ** 0.5, dtype=x.dtype)
     return x

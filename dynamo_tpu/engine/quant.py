@@ -1,0 +1,120 @@
+"""Weight-only int8 quantization for the serving engine.
+
+The reference's headline configs serve FP8-quantized models through its
+external engines (BASELINE: R1-Distill-Llama-70B FP8 on vLLM/TRT-LLM;
+docs/architecture.md benchmarks). Our engine owns the model, so the analog
+is native: weights are stored int8 with per-output-channel scales and
+dequantized inside the matmul — XLA reads int8 from HBM and fuses the
+convert+scale into the MXU op, halving the per-decode-step weights-read
+floor (the dominant cost at small batch).
+
+Scheme: symmetric absmax per output channel (the last axis of a stacked
+[L, D, F] weight; per row for the [V, D] embedding so the token gather
+dequantizes cheaply and a tied lm head reuses the same scales per column).
+Norms, biases, and MoE expert tensors stay in the load dtype (MoE expert
+matmuls are E-batched einsums with their own bandwidth profile — quantize
+later if profiling justifies it).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["QuantizedArray", "quantize_array", "quantize_params", "mm"]
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedArray:
+    """int8 tensor + broadcastable f32 scale; dequantizes as q * scale."""
+
+    def __init__(self, q: jax.Array, scale: jax.Array):
+        self.q = q
+        self.scale = scale
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):           # the *logical* dtype callers compute in
+        return self.scale.dtype
+
+    def dequantize(self, dtype=None) -> jax.Array:
+        out = self.q.astype(self.scale.dtype) * self.scale
+        return out.astype(dtype) if dtype is not None else out
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"QuantizedArray(q={self.q.shape}, scale={self.scale.shape})"
+
+
+def quantize_array(w: jax.Array, *,
+                   keep_axes: tuple = (-1,)) -> QuantizedArray:
+    """Symmetric absmax int8, one scale per coordinate of ``keep_axes``
+    (reduced over every other axis; scale stays broadcast-shaped). Stacked
+    per-layer weights pass keep_axes=(0, -1) so each (layer, out-channel)
+    pair gets its own scale."""
+    w32 = w.astype(jnp.float32)
+    keep = {a % w.ndim for a in keep_axes}
+    reduce_axes = tuple(a for a in range(w.ndim) if a not in keep)
+    absmax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return QuantizedArray(q, scale.astype(jnp.float32))
+
+
+def mm(x: jax.Array, w) -> jax.Array:
+    """x @ w for a plain array or a QuantizedArray (dequant fused into the
+    matmul: XLA reads int8 and converts in-register)."""
+    if isinstance(w, QuantizedArray):
+        y = x @ w.q.astype(x.dtype)
+        return y * w.scale.astype(x.dtype).reshape(w.scale.shape[-1])
+    return x @ w
+
+
+# Weight names quantized (stacked per-layer [L, D, F] → per (L, F) scales).
+_LAYER_MATMULS = ("wq", "wk", "wv", "wo", "gate", "up", "down")
+
+
+def quantize_params(params: Dict[str, jax.Array],
+                    include_embed: bool = True) -> Dict[str, object]:
+    """Return a params tree with matmul weights int8-quantized.
+
+    - ``layers.{wq,wk,wv,wo,gate,up,down}``: per-(layer, out-channel).
+    - ``lm_head`` ([D, V]): per out-channel.
+    - ``embed`` ([V, D], optional): per ROW (= per token vector), so the
+      embedding gather dequantizes with one scale per token and a TIED lm
+      head (x @ embed.T) gets per-column scales from the same tensor.
+    - norms / biases / MoE tensors untouched.
+    """
+    out: Dict[str, object] = {}
+    for name, w in params.items():
+        suffix = name.split(".", 1)[1] if name.startswith("layers.") else name
+        if name.startswith("layers.") and suffix in _LAYER_MATMULS:
+            # stacked [L, D, F]: per (layer, out-channel) → scale [L, 1, F]
+            out[name] = quantize_array(w, keep_axes=(0, -1))
+        elif name == "lm_head":
+            out[name] = quantize_array(w, keep_axes=(-1,))
+        elif name == "embed" and include_embed:
+            # per-row: scale shape [V, 1]
+            out[name] = quantize_array(w, keep_axes=(0,))
+            if "lm_head" not in params:
+                # tied head: materialize a PRE-TRANSPOSED int8 head —
+                # `x @ q.T` of an int8 matrix defeats XLA's transpose
+                # fusion and measured 2x slower than the bf16 tied path
+                # at small batch; the [D, V] copy reads int8 in natural
+                # orientation instead (263MB vs 525MB bf16 per step for
+                # llama-1B)
+                out["lm_head"] = quantize_array(w.T, keep_axes=(-1,))
+        else:
+            out[name] = w
+    return out

@@ -68,6 +68,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host-kv-blocks", type=int, default=0,
                    help="host (TPU-VM DRAM) KV offload tier size")
     p.add_argument("--no-prefix-reuse", action="store_true")
+    p.add_argument("--quantization",
+                   choices=["none", "int8", "int8-noembed"],
+                   default="none",
+                   help="weight-only quantization (int8 weights + "
+                        "per-channel scales, dequant fused into matmuls; "
+                        "-noembed keeps the embedding full-precision)")
     p.add_argument("--random-weights", action="store_true",
                    help="skip checkpoint load (benchmarks/smoke)")
     # parallelism (tensor-parallel-size analog + our axes)
@@ -143,6 +149,7 @@ def engine_config(args):
         prefill_chunk=args.prefill_chunk,
         decode_steps_per_dispatch=args.decode_steps_per_dispatch,
         decode_dispatch_pipeline=args.decode_dispatch_pipeline,
+        quantization=args.quantization,
         tp=args.tp, sp=args.sp, dp=args.dp, ep=args.ep)
 
 

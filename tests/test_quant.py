@@ -1,0 +1,131 @@
+"""Weight-only int8 quantization (engine/quant.py): round-trip fidelity,
+model-level logit closeness vs full precision, and the engine e2e path."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.models import llama
+from dynamo_tpu.engine.quant import (QuantizedArray, mm, quantize_array,
+                                     quantize_params)
+
+TINY = ModelConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                   num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                   max_position_embeddings=256, tie_word_embeddings=True)
+BS = 8
+NUM_BLOCKS = 16
+
+
+def test_quantize_array_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((32, 48)), jnp.float32)
+    qa = quantize_array(w)
+    assert qa.q.dtype == jnp.int8 and qa.scale.shape == (1, 48)
+    deq = np.asarray(qa.dequantize())
+    # absmax/127 per channel bounds the elementwise error by scale/2
+    bound = np.asarray(qa.scale)[0] / 2 + 1e-7
+    assert np.all(np.abs(deq - np.asarray(w)) <= bound[None, :])
+
+
+def test_mm_matches_dequantized_matmul():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((5, 32)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((32, 48)), jnp.float32)
+    qa = quantize_array(w)
+    np.testing.assert_allclose(np.asarray(mm(x, qa)),
+                               np.asarray(x @ qa.dequantize()),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(mm(x, w)), np.asarray(x @ w),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_model_logits_close_to_full_precision():
+    params = llama.init_params(TINY, jax.random.PRNGKey(0),
+                               dtype=jnp.float32)
+    qparams = quantize_params(params)
+    # quantized leaves are int8-backed
+    assert isinstance(qparams["layers.wq"], QuantizedArray)
+    assert isinstance(qparams["embed"], QuantizedArray)
+    statics = llama.ModelStatics(cfg=TINY, block_size=BS, attn_impl="xla")
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(1, TINY.vocab_size, size=12)
+    padded = np.zeros((16,), np.int32)
+    padded[:12] = tokens
+    table = np.zeros((32,), np.int32)
+    table[:2] = [1, 2]
+
+    outs = {}
+    for name, p in (("fp", params), ("q", qparams)):
+        kv = llama.init_kv_cache(TINY, NUM_BLOCKS, BS, dtype=jnp.float32)
+        logits, _ = llama.prefill_forward(
+            p, kv, jnp.asarray(padded), jnp.asarray(table),
+            jnp.asarray(0, jnp.int32), jnp.asarray(12, jnp.int32), statics)
+        outs[name] = np.asarray(logits)
+    ref, got = outs["fp"], outs["q"]
+    cos = np.dot(ref, got) / (np.linalg.norm(ref) * np.linalg.norm(got))
+    assert cos > 0.999, f"quantized logits diverged (cos={cos})"
+
+
+@pytest.mark.asyncio
+async def test_engine_end_to_end_int8():
+    from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineCore, EngineRequest
+    from dynamo_tpu.engine.sampling import SlotSampling
+    from dynamo_tpu.llm.protocols.common import FinishReason
+
+    ecfg = EngineConfig(max_model_len=128, kv_block_size=BS,
+                        num_kv_blocks=NUM_BLOCKS, max_num_seqs=2,
+                        prefill_buckets=[32], quantization="int8")
+    core = EngineCore(TINY, ecfg, attn_impl="xla", param_dtype=jnp.float32)
+    req = EngineRequest(rid="q", prompt=list(range(1, 11)),
+                        sampling=SlotSampling(temperature=0.0),
+                        max_new_tokens=8, eos_ids=frozenset())
+    await core.submit(req)
+    toks = []
+    while True:
+        item, payload = await asyncio.wait_for(req.out_queue.get(), 60)
+        if item is FINISH_SENTINEL:
+            break
+        toks.append(item)
+    await core.stop()
+    assert payload == FinishReason.LENGTH and len(toks) == 8
+    assert all(0 <= t < TINY.vocab_size for t in toks)
+
+
+def test_untied_model_big_batch_uses_real_head():
+    """Untied + quantized: _logits must project through lm_head at every
+    batch size — the tied-path branch once misfired for B >= 32 and
+    projected through the (unrelated) embedding matrix."""
+    cfg = ModelConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                      max_position_embeddings=256, tie_word_embeddings=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    qparams = quantize_params(params)
+    assert isinstance(qparams["lm_head"], QuantizedArray)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((40, cfg.hidden_size)), jnp.float32)
+    got = np.asarray(llama._logits(qparams, x, cfg))
+    want = np.asarray(x @ qparams["lm_head"].dequantize(jnp.float32))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_noembed_mode_keeps_embedding_full_precision():
+    params = llama.init_params(TINY, jax.random.PRNGKey(0),
+                               dtype=jnp.float32)
+    q = quantize_params(params, include_embed=False)
+    assert not isinstance(q["embed"], QuantizedArray)
+    assert "lm_head" not in q               # tied: no materialized head
+    assert isinstance(q["layers.wq"], QuantizedArray)
+
+
+def test_unknown_quantization_rejected():
+    from dynamo_tpu.engine.core import EngineCore
+    ecfg = EngineConfig(max_model_len=64, kv_block_size=BS,
+                        num_kv_blocks=8, max_num_seqs=1,
+                        prefill_buckets=[32], quantization="int4")
+    with pytest.raises(ValueError, match="int4"):
+        EngineCore(TINY, ecfg, attn_impl="xla", param_dtype=jnp.float32)
