@@ -182,6 +182,18 @@ class EngineConfig:
     # hundreds of ms on tunneled devices — overlaps decode instead of
     # stalling the engine loop. Emission order per request is unchanged.
     overlap_admission_fetch: bool = True
+    # continuous-batching lane prefill: when the engine is ALREADY decoding,
+    # an admission whose un-hit prompt suffix is <= this many tokens skips
+    # the dedicated prefill program and instead rides the decode batch —
+    # its prompt tokens are fed as "planned" inputs to the K-step decode
+    # scan (one per step through its slot) and the transition to sampling
+    # happens on device mid-dispatch. Decode throughput is unaffected by
+    # admissions (prompt tokens are marginal extra batch rows on a
+    # bandwidth-bound step) instead of stalling for a prefill dispatch.
+    # Idle engines still use the dedicated prefill program (better TTFT:
+    # one compute-bound dispatch instead of len(prompt) steps).
+    # 0 disables; requires decode_steps_per_dispatch > 1.
+    lane_prefill_max_tokens: int = 0
     # weight-only quantization: "none" | "int8" | "int8-noembed"
     # (engine/quant.py — int8 weights + per-output-channel scales, dequant
     # fused into the matmuls; halves the per-step weights-read floor).
@@ -196,6 +208,11 @@ class EngineConfig:
             raise ValueError(
                 "decode_dispatch_pipeline requires decode_steps_per_dispatch"
                 " > 1 (the pipeline defers multi-step harvests)")
+        if self.lane_prefill_max_tokens > 0 \
+                and self.decode_steps_per_dispatch <= 1:
+            raise ValueError(
+                "lane_prefill_max_tokens requires decode_steps_per_dispatch"
+                " > 1 (planned tokens feed the multi-step scan)")
         self.prefill_buckets = sorted(
             b for b in self.prefill_buckets if b <= self.max_model_len) or [
                 self.max_model_len]

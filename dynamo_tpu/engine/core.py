@@ -78,12 +78,20 @@ class EngineRequest:
     seq: Optional[TokenBlockSequence] = None   # full token history + hashes
     registered_blocks: int = 0
     emitted_total: int = 0        # tokens the client has seen (across lives)
-    # client-stream indices where recompute preemption re-derived the next
-    # token via the prefill program; bit-exactness vs an uncontended run is
-    # guaranteed only UP TO the first of these (prefill/decode numerics can
-    # legitimately flip a greedy argmax at near-tie logits — see
-    # KNOWN_ISSUES "recompute preemption exactness")
-    preempt_points: List[int] = dataclasses.field(default_factory=list)
+    # lane-prefill mode (EngineConfig.lane_prefill_max_tokens): the FULL
+    # prompt (incl. any prefix-hit tokens); while pos < len(lane_prompt)
+    # the slot's decode inputs come from here ("planned" tokens) and
+    # sampled outputs are discarded — the step consuming the last prompt
+    # token yields the first real generation. None = normal admission.
+    lane_prompt: Optional[List[int]] = None
+    # client-stream indices where the next token was derived through a
+    # DIFFERENT compiled program than an uncontended prefill-path run would
+    # use: recompute preemptions (prefill re-derives the boundary token)
+    # and lane admissions (the decode program derives the first token).
+    # Bit-exactness vs an uncontended run is guaranteed only UP TO the
+    # first of these — f32 numerics differ across program shapes and can
+    # legitimately flip a greedy argmax at near-tie logits (KNOWN_ISSUES).
+    numeric_boundaries: List[int] = dataclasses.field(default_factory=list)
     enqueue_time: float = dataclasses.field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
 
@@ -192,6 +200,7 @@ class EngineCore:
         self.total_prefill_tokens = 0
         self.total_decode_tokens = 0
         self.preemptions = 0
+        self.lane_admissions = 0
 
     # ------------------------------------------------------------------ jit
     def _compile_jits(self) -> None:
@@ -225,22 +234,35 @@ class EngineCore:
         seed = self.cfg.seed
 
         def decode_k(params, kv, tokens, positions, block_tables,
-                     seeds, steps0, temperature, top_k, top_p):
-            def body(carry, k):
+                     seeds, steps0, temperature, top_k, top_p,
+                     planned, planned_mask):
+            # planned [K, B] / planned_mask [K, B]: lane-prefill slots feed
+            # predetermined prompt tokens per step instead of chaining the
+            # sample; the step after a lane's last planned token chains the
+            # freshly sampled first generation — prefill→decode transition
+            # happens on device, mid-scan.
+            def body(carry, xs):
                 kv, toks, pos = carry
-                keys = make_slot_keys(seed, seeds, steps0 + k)
+                keys = make_slot_keys(seed, seeds, steps0 + xs["k"])
+                tok_in = jnp.where(xs["pm"], xs["pt"], toks)
                 logits, kv = llama.decode_forward(
-                    params, kv, toks, pos, block_tables, statics)
+                    params, kv, tok_in, pos, block_tables, statics)
                 toks2, logprobs = sample_tokens(logits, keys, temperature,
                                                 top_k, top_p)
                 return (kv, toks2, pos + 1), (toks2, logprobs)
 
             (kv, _, _), (toks_k, logprobs_k) = jax.lax.scan(
-                body, (kv, tokens, positions), jnp.arange(K))
+                body, (kv, tokens, positions),
+                {"k": jnp.arange(K), "pt": planned, "pm": planned_mask})
             return toks_k, logprobs_k, kv
 
         self._decode_k_jit = (jax.jit(decode_k, donate_argnums=(1,))
                               if K > 1 else None)
+        # device-resident zeros reused by every dispatch with no active
+        # lane (the overwhelmingly common case)
+        self._planned_zero = (jnp.zeros((K, self.cfg.max_num_seqs),
+                                        jnp.int32),
+                              jnp.zeros((K, self.cfg.max_num_seqs), bool))
         # pipelined-dispatch input merge: continuing slots chain the
         # previous dispatch's device tokens, fresh slots feed host values
         self._merge_jit = jax.jit(
@@ -403,6 +425,16 @@ class EngineCore:
                               hit=req.prefix_hit_tokens,
                               blocks=list(plan.all_blocks))
         t0 = time.monotonic()
+        suffix_len = n_prompt - req.prefix_hit_tokens
+        if (self.cfg.lane_prefill_max_tokens > 0
+                and self._decode_k_jit is not None
+                and req.handoff is None and req.precomputed is None
+                and 0 < suffix_len <= self.cfg.lane_prefill_max_tokens
+                and any(s is not None and s.ready for s in self.slots)):
+            # lane prefill: the engine is already decoding — ride the
+            # decode batch instead of stalling it with a prefill dispatch
+            self._admit_lane(req, slot, n_already)
+            return True
         defer = False
         if req.precomputed is not None:
             if self.recorder is not None:
@@ -523,6 +555,49 @@ class EngineCore:
             self._emit(req, tok, float(logprob))
             self._maybe_finish_after_emit(req)
         return True
+
+    def _admit_lane(self, req: EngineRequest, slot: int,
+                    n_already: int) -> None:
+        """Continuous-batching admission: no prefill dispatch — the prompt
+        rides the decode batch as planned tokens (see EngineConfig.
+        lane_prefill_max_tokens). Blocks are allocated (done by the caller's
+        plan) but NOT registered yet: their KV is written step by step, so
+        registration follows harvest progress exactly like decode."""
+        self.lane_admissions += 1
+        n_prompt = len(req.prompt)
+        hit = req.prefix_hit_tokens
+        # the first generated token comes from the decode program here
+        # (an uncontended run derives it via the prefill program) — a
+        # numeric boundary for the exactness contract
+        req.numeric_boundaries.append(req.emitted_total)
+        req.lane_prompt = list(req.prompt)
+        req.pos = hit
+        req.generated = 0
+        # sampling-key parity with the prefill path: the step consuming the
+        # last prompt token samples the first generation and must use the
+        # request's CURRENT key_step; planned steps before it burn earlier
+        # (negative-offset) key values whose samples are discarded anyway
+        req.key_step -= n_prompt - hit - 1
+        req.last_token = req.prompt[hit]       # step-0 planned input
+        req.ready = True
+        # hash chain restarts from the hit prefix and grows per input token
+        req.seq = TokenBlockSequence(self.cfg.kv_block_size,
+                                     req.prompt[:hit])
+        req.registered_blocks = n_already
+        self.slots[slot] = req
+        self._block_tables[slot, :] = 0
+        self._block_tables[slot, :len(req.blocks)] = req.blocks
+        self._samp["temperature"][slot] = req.sampling.temperature
+        self._samp["top_k"][slot] = req.sampling.top_k
+        self._samp["top_p"][slot] = req.sampling.top_p
+        self._seeds[slot] = req.sampling.seed
+        if self.recorder is not None:
+            self.recorder.rec(
+                "admit", rid=req.rid, slot=slot, pos=req.pos,
+                key_step=req.key_step, blocks=list(req.blocks),
+                hit=hit, prompt=list(req.prompt), lane=True)
+        logger.debug("lane-admitted %s into slot %d (prompt=%d, hit=%d)",
+                     req.rid, slot, n_prompt, hit)
 
     def _chunked_prefill(self, req: EngineRequest, chunk: list,
                          table: np.ndarray, key) -> tuple:
@@ -825,6 +900,25 @@ class EngineCore:
                 self._positions[i] = s.pos + ahead
                 steps[i] = s.key_step + ahead
         tables = self._tables_for_dispatch()
+        # lane-prefill planned inputs: stateless from positions (which
+        # already include the pipelined +K lookahead), so chained and
+        # host-fed dispatches agree without extra bookkeeping. The common
+        # no-lanes case reuses cached device-resident zeros (no per-dispatch
+        # host allocation/transfer on the latency-sensitive path).
+        planned = pmask = None
+        for i, s in enumerate(self.slots):
+            if s is None or not s.ready or s.lane_prompt is None:
+                continue
+            if planned is None:
+                planned = np.zeros((K, self.B), np.int32)
+                pmask = np.zeros((K, self.B), bool)
+            pos0 = int(self._positions[i])
+            n_pr = len(s.lane_prompt)
+            for k in range(K):
+                p = pos0 + k
+                if p < n_pr:
+                    planned[k, i] = s.lane_prompt[p]
+                    pmask[k, i] = True
         self._step += K
         # jnp.array COPIES: jnp.asarray of a numpy buffer may alias it
         # zero-copy on CPU, and these mirrors are mutated by the next
@@ -846,8 +940,15 @@ class EngineCore:
                 temperature=self._samp["temperature"].copy(),
                 top_k=self._samp["top_k"].copy(),
                 top_p=self._samp["top_p"].copy(),
+                **({"planned": planned.copy(),
+                    "planned_mask": pmask.copy()}
+                   if planned is not None else {}),
                 reqs=[s.rid if (s is not None and s.ready) else None
                       for s in self.slots])
+        if planned is None:
+            planned_dev, pmask_dev = self._planned_zero
+        else:
+            planned_dev, pmask_dev = jnp.array(planned), jnp.array(pmask)
         toks_k, logprobs_k, self.kv = self._decode_k_jit(
             self.params, self.kv,
             tokens_in, jnp.array(self._positions),
@@ -855,7 +956,8 @@ class EngineCore:
             jnp.array(self._seeds), jnp.array(steps),
             jnp.array(self._samp["temperature"]),
             jnp.array(self._samp["top_k"]),
-            jnp.array(self._samp["top_p"]))
+            jnp.array(self._samp["top_p"]),
+            planned_dev, pmask_dev)
         return {"toks": toks_k, "logprobs": logprobs_k, "K": K, "id": did,
                 "reqs": [s if (s is not None and s.ready) else None
                          for s in self.slots]}
@@ -872,12 +974,17 @@ class EngineCore:
             if req is None or self.slots[i] is not req:
                 continue
             n0 = req.generated
+            n_applied = 0
             input_tok = req.last_token
             for k in range(K):
                 if req.cancelled:
                     self._release_slot(req)
                     self._finish_request(req, FinishReason.CANCELLED)
                     break
+                in_prompt = (req.lane_prompt is not None
+                             and req.pos < len(req.lane_prompt))
+                if in_prompt:
+                    input_tok = req.lane_prompt[req.pos]
                 tok = int(toks_k[k, i])
                 if req.seq is not None:
                     req.seq.append(input_tok)
@@ -885,16 +992,27 @@ class EngineCore:
                         self.kv_manager.register_full_blocks(
                             req.blocks, req.seq, req.registered_blocks)
                 req.pos += 1
-                req.generated += 1
                 req.key_step += 1
+                n_applied += 1
+                if in_prompt and req.pos < len(req.lane_prompt):
+                    # mid-prompt planned step: the sampled token is
+                    # discarded; the next input comes from the prompt
+                    self.total_prefill_tokens += 1
+                    continue
+                if in_prompt:               # consumed the LAST prompt token
+                    self.total_prefill_tokens += 1
+                    req.lane_prompt = None  # plain decode from here on
+                req.generated += 1
                 req.last_token = tok
                 self.total_decode_tokens += 1
+                if req.first_token_time is None:
+                    req.first_token_time = time.monotonic()
                 self._emit(req, tok, float(logprobs_k[k, i]))
                 self._maybe_finish_after_emit(req)
                 if self.slots[i] is not req:
                     break                      # finished: drop device overrun
                 input_tok = tok
-            applied.append((i, req.rid, req.generated - n0))
+            applied.append((i, req.rid, n_applied))
         if self.recorder is not None and pending.get("id") is not None:
             self.recorder.rec("harvest", id=pending["id"],
                               toks=toks_k.copy(), applied=applied)
@@ -914,7 +1032,10 @@ class EngineCore:
         small for it)."""
         others = any(s is not None and s is not req for s in self.slots)
         budget_left = req.max_new_tokens - req.generated
-        emitted_len = len(req.seq.tokens) - len(req.prompt) if req.seq else 0
+        in_prompt = (req.lane_prompt is not None
+                     and req.pos < len(req.lane_prompt))
+        emitted_len = (0 if in_prompt or req.seq is None
+                       else len(req.seq.tokens) - len(req.prompt))
         new_len = len(req.prompt) + emitted_len + 1
         bs = self.cfg.kv_block_size
         fits = (new_len < self.cfg.max_model_len
@@ -926,15 +1047,24 @@ class EngineCore:
             self._finish_request(req, FinishReason.LENGTH)
             return
         self.preemptions += 1
-        req.preempt_points.append(req.emitted_total)
         logger.info("preempting %s after %d tokens (KV exhausted; "
                     "recompute on re-admission)", req.rid, req.generated)
         if self.recorder is not None:
             self.recorder.rec("preempt", rid=req.rid,
                               generated=req.generated)
-        emitted = req.seq.tokens[len(req.prompt):] if req.seq else []
-        self._release_slot(req)
-        req.prompt = list(req.prompt) + list(emitted) + [req.last_token]
+        if in_prompt:
+            # lane preempted mid-prompt: nothing was emitted — requeue
+            # with the original prompt unchanged (progress recomputes; no
+            # recompute boundary is recorded because no sampled token
+            # depended on a re-derived state)
+            self._release_slot(req)
+            req.key_step += len(req.lane_prompt) - req.pos - 1  # undo skew
+        else:
+            req.numeric_boundaries.append(req.emitted_total)
+            emitted = req.seq.tokens[len(req.prompt):] if req.seq else []
+            self._release_slot(req)
+            req.prompt = list(req.prompt) + list(emitted) + [req.last_token]
+        req.lane_prompt = None
         req.max_new_tokens = budget_left
         req.seq = None               # admission rebuilds the hash chain
         req.precomputed = None       # any shipped KV described the old prompt

@@ -113,12 +113,19 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                     chain, host_tokens, jnp.array(np.asarray(ev["mask"])))
             else:
                 tokens_in = host_tokens
+            K = int(ev["K"])
+            B = np.asarray(ev["tokens"]).shape[0]
+            planned = np.asarray(ev.get("planned",
+                                        np.zeros((K, B), np.int32)))
+            pmask = np.asarray(ev.get("planned_mask",
+                                      np.zeros((K, B), bool)))
             toks_k, _lps, kv = core._decode_k_jit(
                 core.params, kv, tokens_in,
                 jnp.array(ev["positions"]), jnp.array(ev["tables"]),
                 jnp.array(ev["seeds"]), jnp.array(ev["steps"]),
                 jnp.array(ev["temperature"]), jnp.array(ev["top_k"]),
-                jnp.array(ev["top_p"]))
+                jnp.array(ev["top_p"]),
+                jnp.array(planned), jnp.array(pmask))
             toks_k = jax.block_until_ready(toks_k)
             disp_toks[ev["id"]] = toks_k
             out["dispatch"][ev["id"]] = np.asarray(toks_k).copy()
@@ -288,7 +295,11 @@ def check_inputs(events: List[dict]) -> List[str]:
                     problems.append(
                         f"dispatch {ev['id']} slot {i} ({rid}): key step "
                         f"{int(steps[i])} != state {st['key_step']}+{ahead}")
-                if (not mask[i] and st["last"] is not None
+                pm = np.asarray(ev["planned_mask"]) if "planned_mask" in ev \
+                    else None
+                planned_first = bool(pm is not None and pm[0, i])
+                if (not mask[i] and not planned_first
+                        and st["last"] is not None
                         and int(tokens[i]) != st["last"]):
                     problems.append(
                         f"dispatch {ev['id']} slot {i} ({rid}): host token "

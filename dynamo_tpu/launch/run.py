@@ -59,6 +59,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fuse K decode steps per XLA dispatch (amortizes "
                         "device→host token-harvest latency; EOS/cancel "
                         "react at K-step granularity)")
+    p.add_argument("--lane-prefill-max-tokens", type=int, default=0,
+                   help="admissions with <= this many un-cached prompt "
+                        "tokens ride the decode batch as planned inputs "
+                        "when the engine is busy (continuous batching; "
+                        "0 disables, needs K>1)")
     p.add_argument("--decode-dispatch-pipeline", action="store_true",
                    help="overlap each dispatch's token harvest with the "
                         "next dispatch (requires K>1; finish reaction "
@@ -149,6 +154,7 @@ def engine_config(args):
         prefill_chunk=args.prefill_chunk,
         decode_steps_per_dispatch=args.decode_steps_per_dispatch,
         decode_dispatch_pipeline=args.decode_dispatch_pipeline,
+        lane_prefill_max_tokens=args.lane_prefill_max_tokens,
         quantization=args.quantization,
         tp=args.tp, sp=args.sp, dp=args.dp, ep=args.ep)
 

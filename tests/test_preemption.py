@@ -53,12 +53,12 @@ def assert_exact_to_recompute_boundary(got, ref, req, name):
     gap). A divergence BEFORE the first boundary would be a real bug."""
     if got == ref:
         return
-    boundary = min(req.preempt_points) if req.preempt_points else len(ref)
+    boundary = min(req.numeric_boundaries) if req.numeric_boundaries else len(ref)
     first_diff = next(i for i, (a, b) in enumerate(zip(got, ref)) if a != b)
     assert first_diff >= boundary, (
         f"stream {name} diverged at {first_diff}, BEFORE its first "
         f"recompute boundary {boundary} — not explainable by prefill/"
-        f"decode numerics; preempt_points={req.preempt_points}")
+        f"decode numerics; numeric_boundaries={req.numeric_boundaries}")
 
 
 @pytest.mark.parametrize("k,pipeline", [(1, False), (4, False),

@@ -109,7 +109,7 @@ def trial(seed):
         toks, req = outs[i]
         if toks == refs[i]:
             continue
-        boundary = (min(req.preempt_points) if req.preempt_points
+        boundary = (min(req.numeric_boundaries) if req.numeric_boundaries
                     else len(refs[i]))
         first = next(j for j, (a, b) in enumerate(zip(toks, refs[i]))
                      if a != b)

@@ -1,0 +1,93 @@
+"""End-to-end engine-loop serving benchmark: N requests stream through the
+real EngineCore asyncio loop (admissions, continuous batching, harvests),
+reporting wall-clock throughput and TTFT percentiles. Complements bench.py
+(which measures the bare dispatch loop): this is where admission policy —
+prefill-program vs lane prefill (--lanes) — shows up.
+
+Usage: python tools/serve_bench.py [n_requests] [max_num_seqs] [lanes]
+"""
+
+import asyncio
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineCore, EngineRequest
+from dynamo_tpu.engine.sampling import SlotSampling
+
+PROMPT = 128
+GEN = 64
+
+
+def main():
+    n_req = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    slots = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    lanes = int(sys.argv[3]) if len(sys.argv) > 3 else 0
+
+    mcfg = ModelConfig(vocab_size=128256, hidden_size=2048,
+                       intermediate_size=8192, num_layers=16,
+                       num_heads=32, num_kv_heads=8, head_dim=64,
+                       max_position_embeddings=4096,
+                       rope_theta=500000.0, tie_word_embeddings=True)
+    max_len = PROMPT + GEN + 64
+    ecfg = EngineConfig(
+        max_model_len=max_len, kv_block_size=16,
+        num_kv_blocks=slots * ((max_len + 15) // 16) + 2,
+        max_num_seqs=slots, prefill_buckets=[PROMPT, max_len],
+        decode_steps_per_dispatch=16, decode_dispatch_pipeline=True,
+        lane_prefill_max_tokens=lanes, quantization="int8")
+    core = EngineCore(mcfg, ecfg, attn_impl="auto",
+                      param_dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 32000, PROMPT).tolist() for _ in range(n_req)]
+
+    gens = [int(g) for g in rng.integers(GEN // 2, GEN * 2, n_req)]
+    gaps = rng.exponential(0.15, n_req)     # paced arrivals (open loop-ish)
+
+    async def one(i, delay=0.0):
+        if delay:
+            await asyncio.sleep(delay)
+        req = EngineRequest(rid=f"r{i}", prompt=prompts[i],
+                            sampling=SlotSampling(temperature=0.7, seed=i),
+                            max_new_tokens=gens[i], eos_ids=frozenset())
+        await core.submit(req)
+        n = 0
+        ttft = None
+        t0 = time.monotonic()
+        while True:
+            item, _ = await req.out_queue.get()
+            if item is FINISH_SENTINEL:
+                return n, ttft
+            if ttft is None:
+                ttft = time.monotonic() - t0
+            n += 1
+
+    async def run():
+        # warm the compiles with one request end-to-end
+        _ = await one(0)
+        t0 = time.monotonic()
+        arrivals = np.cumsum(gaps)
+        outs = await asyncio.gather(
+            *[one(i, delay=float(arrivals[i])) for i in range(n_req)])
+        dt = time.monotonic() - t0
+        await core.stop()
+        total = sum(n for n, _ in outs)
+        ttfts = sorted(t for _, t in outs if t is not None)
+        p50 = ttfts[len(ttfts) // 2]
+        p95 = ttfts[int(len(ttfts) * 0.95)]
+        print(f"lanes={lanes}: {n_req} reqs x ({PROMPT}p+{GEN}g), "
+              f"slots={slots}: {total} tokens in {dt:.1f}s = "
+              f"{total / dt:.0f} tok/s | TTFT p50 {p50:.2f}s p95 {p95:.2f}s "
+              f"| lane_admissions={core.lane_admissions} "
+              f"prefill_tok={core.total_prefill_tokens}")
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()
