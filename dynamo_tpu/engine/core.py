@@ -127,10 +127,6 @@ class EngineCore:
             params = llama.init_params(
                 model_cfg, jax.random.PRNGKey(engine_cfg.seed), dtype=param_dtype)
         if engine_cfg.quantization in ("int8", "int8-noembed"):
-            if mesh is not None:
-                raise NotImplementedError(
-                    "int8 weights + mesh sharding not wired up yet "
-                    "(shard_params would need per-leaf specs for q/scale)")
             from .quant import quantize_params
             params = quantize_params(
                 params,

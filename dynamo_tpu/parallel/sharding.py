@@ -59,8 +59,10 @@ def param_pspecs(cfg: ModelConfig) -> Dict[str, P]:
             "layers.up": P(None, None, "tp"),
             "layers.down": P(None, "tp", None),
         })
-    if not cfg.tie_word_embeddings:
-        specs["lm_head"] = P(None, "tp")
+    # untied checkpoints ship a real lm_head; tied QUANTIZED params carry a
+    # materialized pre-transposed head (engine/quant.py) with the same
+    # [D, V] orientation — the spec is harmless when the key is absent
+    specs["lm_head"] = P(None, "tp")
     if cfg.attention_bias:
         # biases follow their projection's column sharding
         specs.update({"layers.bq": P(None, "tp"),
@@ -107,18 +109,35 @@ def _spec_fits(shape, spec: P, mesh: Mesh) -> bool:
 
 def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
     """Place params under their TP layout; params whose dims don't divide
-    the mesh axes (e.g. an odd vocab size) are replicated instead."""
+    the mesh axes (e.g. an odd vocab size) are replicated instead.
+
+    int8-quantized leaves (engine/quant.QuantizedArray) shard their q
+    tensor with the weight's spec and their scale with the same spec where
+    it fits — per-output-channel scales follow column-parallel weights,
+    while row-parallel weights' scales (broadcast dim 1 on the sharded
+    axis) fall back to replication, which is also the correct layout."""
+    from ..engine.quant import QuantizedArray
+
     specs = param_pspecs(cfg)
+
+    def put(arr, spec):
+        if not _spec_fits(arr.shape, spec, mesh):
+            spec = P()
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
     out = {}
     for k, v in params.items():
         spec = specs.get(k, P())
+        if isinstance(v, QuantizedArray):
+            out[k] = QuantizedArray(put(v.q, spec), put(v.scale, spec))
+            continue
         if not _spec_fits(v.shape, spec, mesh):
             logger.warning(
                 "param %s shape %s does not divide mesh axes for spec %s — "
                 "replicating (costs %d bytes per extra device copy)",
                 k, v.shape, spec, v.size * v.dtype.itemsize)
             spec = P()
-        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+        out[k] = put(v, spec)
     return out
 
 

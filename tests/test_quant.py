@@ -122,6 +122,46 @@ def test_noembed_mode_keeps_embedding_full_precision():
     assert isinstance(q["layers.wq"], QuantizedArray)
 
 
+def test_int8_sharded_decode_matches_single_device():
+    """Quantized params shard over a tp×dp mesh (q with the weight spec,
+    scales following where they fit) and the sharded decode step matches
+    the unsharded quantized one."""
+    import jax.numpy as jnp
+    from dynamo_tpu.parallel.sharding import (batch_pspecs, kv_pspecs,
+                                              make_mesh, named, param_pspecs,
+                                              shard_kv, shard_params)
+    cfg = ModelConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=8, num_kv_heads=4, head_dim=8,
+                      max_position_embeddings=128,
+                      tie_word_embeddings=False)
+    params = llama.init_params(cfg, jax.random.PRNGKey(2), dtype=jnp.float32)
+    qparams = quantize_params(params)
+    statics = llama.ModelStatics(cfg=cfg, block_size=8, attn_impl="xla")
+    B, M, nb = 4, 4, 16
+    rng = np.random.default_rng(5)
+    tokens = jnp.asarray(rng.integers(1, 200, B), jnp.int32)
+    positions = jnp.asarray([3, 5, 2, 7], jnp.int32)
+    tables = jnp.asarray(rng.integers(1, nb, (B, M)), jnp.int32)
+
+    kv0 = llama.init_kv_cache(cfg, nb, 8, dtype=jnp.float32)
+    ref_logits, _ = llama.decode_forward(qparams, kv0, tokens, positions,
+                                         tables, statics)
+
+    mesh = make_mesh(dp=2, tp=2)
+    sp = shard_params(qparams, mesh, cfg)
+    # quantized column-parallel weights actually sharded, not replicated
+    wq = sp["layers.wq"]
+    assert isinstance(wq, QuantizedArray)
+    kv = shard_kv(llama.init_kv_cache(cfg, nb, 8, dtype=jnp.float32), mesh)
+    with mesh:
+        step = jax.jit(
+            lambda p, kv, t, pos, bt: llama.decode_forward(
+                p, kv, t, pos, bt, statics))
+        logits, _ = step(sp, kv, tokens, positions, tables)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_unknown_quantization_rejected():
     from dynamo_tpu.engine.core import EngineCore
     ecfg = EngineConfig(max_model_len=64, kv_block_size=BS,

@@ -28,12 +28,13 @@ K = 4
 _DONOR = {}
 
 
-def make_core(blocks, record=True):
+def make_core(blocks, record=True, lanes=0):
     ecfg = EngineConfig(max_model_len=256, kv_block_size=8,
                         num_kv_blocks=blocks, max_num_seqs=2,
                         prefill_buckets=[32, 64, 128],
                         decode_steps_per_dispatch=K,
-                        decode_dispatch_pipeline=True)
+                        decode_dispatch_pipeline=True,
+                        lane_prefill_max_tokens=lanes)
     c = EngineCore(TINY, ecfg, attn_impl="xla", param_dtype=jnp.float32,
                    params=_DONOR.get("params"))
     if not _DONOR:
@@ -80,6 +81,8 @@ def solo_ref(prompt, max_new):
 
 
 def trial(seed):
+    # odd seeds exercise lane prefill under the same churn
+    lanes = 512 if seed % 2 else 0
     rng = np.random.default_rng(seed)
     n_req = 4
     prompts = [rng.integers(1, TINY.vocab_size,
@@ -90,7 +93,7 @@ def trial(seed):
     refs = [solo_ref(p, m) for p, m in zip(prompts, budgets)]
 
     async def go():
-        core = make_core(16)
+        core = make_core(16, lanes=lanes)
         try:
             outs = await asyncio.gather(*[
                 run_req(core, p, f"r{i}", m, d)
