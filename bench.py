@@ -127,11 +127,12 @@ def main() -> None:
             # pipelined dispatch may still be executing
             tokens_in = (chain if pipeline and chain is not None
                          else jnp.array(core._tokens))
+            planned, pmask = core._planned_zero  # no lane-prefill in bench
             toks_k, _lps, core.kv = core._decode_k_jit(
                 core.params, core.kv,
                 tokens_in, jnp.array(core._positions),
                 jnp.array(core._block_tables), seeds, steps0,
-                temp, topk, topp)
+                temp, topk, topp, planned, pmask)
             core._positions[:] += harvest
             if pipeline:
                 # chain the next dispatch off device tokens; harvest the

@@ -7,21 +7,18 @@ import os
 import sys
 
 # The image's sitecustomize imports jax at interpreter startup with
-# JAX_PLATFORMS=axon (the tunneled TPU). For tests we must BOTH set the env
-# (for subprocesses) and update the already-loaded jax config, or everything
-# silently runs on the one real TPU chip — slow, serialized, and with MXU
-# bf16 matmul numerics that break float32 reference comparisons.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+# JAX_PLATFORMS=axon (the tunneled TPU). For tests everything must run on
+# the virtual CPU mesh instead — otherwise tests are slow, serialized, and
+# MXU bf16 matmul numerics break float32 reference comparisons. The forcing
+# recipe lives in __graft_entry__.force_cpu_devices (shared with the
+# driver's multi-chip dryrun so the two can't drift).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from __graft_entry__ import force_cpu_devices  # noqa: E402
+
+force_cpu_devices(8)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,82 @@
+"""Smoke tests for the driver entry points: bench.py and __graft_entry__.
+
+Round-1 postmortem (VERDICT.md "What's weak" 1-2): both driver artifacts
+crashed because neither was covered by a test — bench.py drifted from the
+engine's decode_k signature, and dryrun_multichip never forced the CPU
+platform. These tests import and RUN both on the tiny model so any future
+signature or platform drift fails CI instead of the round-end driver run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cmd, env_extra, timeout=600):
+    env = dict(os.environ)
+    env.update(env_extra)
+    return subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout,
+                          capture_output=True, text=True)
+
+
+def test_bench_runs_and_prints_json():
+    """bench.py end to end on CPU with the tiny model: one compile dispatch
+    + a couple of timed dispatches, then the driver's ONE JSON line."""
+    r = _run(
+        [sys.executable, "bench.py"],
+        {"JAX_PLATFORMS": "cpu", "BENCH_MODEL": "tiny", "BENCH_BATCH": "4",
+         "BENCH_STEPS": "8", "BENCH_PROMPT": "16", "BENCH_HARVEST": "4",
+         "BENCH_QUANT": "none"})
+    assert r.returncode == 0, f"bench.py crashed:\n{r.stderr[-4000:]}"
+    lines = [l for l in r.stdout.strip().splitlines()
+             if l.startswith("{")]
+    assert lines, f"no JSON line in bench output: {r.stdout!r}"
+    out = json.loads(lines[-1])
+    for field in ("metric", "value", "unit", "vs_baseline"):
+        assert field in out
+    assert out["value"] > 0
+
+
+def test_bench_pipelined_and_unpipelined():
+    """Both harvest modes run (the round-1 breakage was in the multi-step
+    dispatch path specifically)."""
+    for pipeline in ("0", "1"):
+        r = _run(
+            [sys.executable, "bench.py"],
+            {"JAX_PLATFORMS": "cpu", "BENCH_MODEL": "tiny",
+             "BENCH_BATCH": "2", "BENCH_STEPS": "4", "BENCH_PROMPT": "8",
+             "BENCH_HARVEST": "2", "BENCH_PIPELINE": pipeline,
+             "BENCH_QUANT": "none"})
+        assert r.returncode == 0, (
+            f"bench.py pipeline={pipeline} crashed:\n{r.stderr[-4000:]}")
+
+
+def test_dryrun_multichip_forces_cpu():
+    """dryrun_multichip(8) in a fresh process with NO helpful env: the
+    function itself must force the CPU platform + device count (the round-1
+    failure was relying on the caller to do it)."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        cwd=REPO, env=env, timeout=600, capture_output=True, text=True)
+    assert r.returncode == 0, f"dryrun crashed:\n{r.stderr[-4000:]}"
+    assert "dryrun_multichip OK" in r.stdout
+
+
+def test_entry_compiles():
+    """entry() returns a jittable fn + args that run single-device."""
+    r = _run(
+        [sys.executable, "-c",
+         "import jax, __graft_entry__ as g\n"
+         "fn, args = g.entry()\n"
+         "out = jax.jit(fn)(*args)\n"
+         "jax.block_until_ready(out[0])\n"
+         "print('entry OK', out[0].shape)"],
+        {"JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, f"entry crashed:\n{r.stderr[-4000:]}"
+    assert "entry OK" in r.stdout
