@@ -27,6 +27,126 @@ import os
 import sys
 import time
 
+# Peak specs per device kind for roofline accounting (public TPU specs:
+# bf16 MXU TFLOP/s, int8 TOP/s, HBM GB/s). Matched by substring of
+# jax.devices()[0].device_kind; the axon chip reports "TPU v5 lite".
+DEVICE_PEAKS = {
+    "v5 lite": (197e12, 394e12, 819e9),     # v5e
+    "v5litepod": (197e12, 394e12, 819e9),
+    "v4": (275e12, 275e12, 1228e9),
+    "v5p": (459e12, 918e12, 2765e9),
+    "v6 lite": (918e12, 1836e12, 1640e9),   # v6e / Trillium
+    "v6e": (918e12, 1836e12, 1640e9),
+}
+
+
+def _device_peaks(device_kind: str):
+    dk = device_kind.lower()
+    for key, peaks in DEVICE_PEAKS.items():
+        if key in dk:
+            return peaks
+    return DEVICE_PEAKS["v5 lite"]          # conservative default
+
+
+def _param_bytes(params) -> int:
+    import jax
+    return sum(x.nbytes for x in jax.tree.leaves(params))
+
+
+def _matmul_flops_per_token(mcfg) -> float:
+    """2·(matmul weight count) per token: qkv + wo + mlp per layer, + lm
+    head. Embedding lookup is free; attention score/update flops are
+    accounted separately (they scale with seq len)."""
+    D, F = mcfg.hidden_size, mcfg.intermediate_size
+    H, KVH, Dh = mcfg.num_heads, mcfg.num_kv_heads, mcfg.head_dim
+    per_layer = D * (H + 2 * KVH) * Dh + H * Dh * D + 3 * D * F
+    return 2.0 * (mcfg.num_layers * per_layer
+                  + D * mcfg.vocab_size)
+
+
+def device_timing(core, mcfg, batch, avg_seq_len, kv_itemsize, *,
+                  temp, topk, topp, seeds):
+    """Per-step DEVICE time for the real fused-K decode dispatch, via the
+    chained-dispatch slope method (KNOWN_ISSUES.md: wall-clock over the
+    axon tunnel pays ~131ms per value fetch and block_until_ready does not
+    wait through the tunnel — so time m1 vs m2 chained dispatches with ONE
+    final token fetch as the barrier; the difference cancels fetch cost and
+    constant overheads). Returns a dict of device-truth metrics."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.utils.timing import slope_per_unit
+
+    K = core.cfg.decode_steps_per_dispatch
+    planned, pmask = core._planned_zero
+
+    def chain(m):
+        toks_k = None
+        t0 = time.monotonic()
+        for _ in range(m):
+            steps0 = jnp.asarray(np.full((batch,), core._positions[0],
+                                         np.int64))
+            tokens_in = (jnp.array(core._tokens) if toks_k is None
+                         else toks_k[-1])
+            toks_k, _lps, core.kv = core._decode_k_jit(
+                core.params, core.kv,
+                tokens_in, jnp.array(core._positions),
+                jnp.array(core._block_tables), seeds, steps0,
+                temp, topk, topp, planned, pmask)
+            core._positions[:] += K
+        np.asarray(toks_k)                 # the one barrier fetch
+        return time.monotonic() - t0
+
+    step_s = max(slope_per_unit(chain, 2, 6) / K, 1e-9)
+
+    dev = jax.devices()[0]
+    peak_bf16, _peak_int8, peak_hbm = _device_peaks(dev.device_kind)
+    pbytes = _param_bytes(core.params)
+    C = mcfg.num_kv_heads * mcfg.head_dim
+    kv_bytes = (batch * avg_seq_len * 2 * C * kv_itemsize
+                * mcfg.num_layers)
+    # weight-only int8 dequantizes into bf16 MXU matmuls → bf16 peak
+    flops = batch * (_matmul_flops_per_token(mcfg)
+                     + 4.0 * mcfg.num_heads * mcfg.head_dim
+                     * avg_seq_len * mcfg.num_layers)
+    return {
+        "device_step_ms": round(step_s * 1e3, 3),
+        "device_tok_per_s": round(batch / step_s, 1),
+        "weights_gb": round(pbytes / 1e9, 3),
+        # weight reads alone vs HBM peak: the decode roofline at small B
+        "weights_read_bw_util": round(pbytes / step_s / peak_hbm, 3),
+        # all modeled HBM traffic (weights + KV reads) vs peak
+        "hbm_util": round((pbytes + kv_bytes) / step_s / peak_hbm, 3),
+        "mfu": round(flops / step_s / peak_bf16, 4),
+    }
+
+
+def device_prefill_timing(core, prompt_len, prefill_args):
+    """Device time per whole-prompt prefill via the same chained-dispatch
+    slope (prefill_jit donates+returns kv, so dispatches chain on device
+    with no host sync until the final token fetch)."""
+    import numpy as np
+
+    from dynamo_tpu.utils.timing import slope_per_unit
+
+    def chain(m):
+        tok = None
+        t0 = time.monotonic()
+        for _ in range(m):
+            tok, _lp, core.kv = core._prefill_jit(
+                core.params, core.kv, *prefill_args)
+        np.asarray(tok)
+        return time.monotonic() - t0
+
+    # the first dispatch after an idle gap pays a full tunnel round-trip,
+    # so use deep chains (amortized cost stabilizes by ~m=8)
+    per_prefill_s = max(slope_per_unit(chain, 4, 12), 1e-9)
+    return {
+        "device_prefill_ms": round(per_prefill_s * 1e3, 2),
+        "device_prefill_tok_per_s": round(prompt_len / per_prefill_s, 1),
+    }
+
 
 def main() -> None:
     import numpy as np
@@ -49,6 +169,8 @@ def main() -> None:
     # FP8-quantized serving (R1-Distill-Llama-70B FP8), so quantized is the
     # comparable configuration; BENCH_QUANT=none for full-precision runs
     quant = os.environ.get("BENCH_QUANT", "int8")
+    # device-side slope timing (adds ~9 extra chained dispatches)
+    device_time = os.environ.get("BENCH_DEVICE", "1") != "0"
 
     if model == "tiny":
         mcfg = ModelConfig(vocab_size=2048, hidden_size=256,
@@ -62,7 +184,8 @@ def main() -> None:
                            max_position_embeddings=4096,
                            rope_theta=500000.0, tie_word_embeddings=True)
     # budget: timed steps + the untimed compile dispatch (harvest tokens)
-    max_len = prompt_len + steps + harvest + 64
+    # + the device-timing chains (1+2·(2+6) = 17 extra dispatches of K)
+    max_len = prompt_len + steps + harvest * (18 if device_time else 1) + 64
     bs = 16
     blocks_per_seq = (max_len + bs - 1) // bs
     ecfg = EngineConfig(
@@ -93,11 +216,13 @@ def main() -> None:
         padded = np.zeros((prompt_len,), np.int32)
         padded[:] = prompts[i]
         key = make_slot_keys(0, jnp.asarray([0]), jnp.asarray(0))[0]
-        tok, lp, core.kv = core._prefill_jit(
-            core.params, core.kv, jnp.asarray(padded), jnp.asarray(table),
+        last_prefill_args = (
+            jnp.asarray(padded), jnp.asarray(table),
             jnp.asarray(0, jnp.int32), jnp.asarray(prompt_len, jnp.int32),
             key, jnp.asarray(0.7, jnp.float32), jnp.asarray(0, jnp.int32),
             jnp.asarray(1.0, jnp.float32))
+        tok, lp, core.kv = core._prefill_jit(
+            core.params, core.kv, *last_prefill_args)
         core._tokens[i] = int(tok)
         core._positions[i] = prompt_len
         if not warmed:
@@ -177,6 +302,17 @@ def main() -> None:
     steps = n_dispatch * harvest  # actual tokens per slot timed
 
     tok_per_s = batch * steps / dt
+
+    device_extra = {}
+    if device_time and core._decode_k_jit is not None:
+        kv_itemsize = core.kv["k"].dtype.itemsize
+        avg_seq = float(np.mean(core._positions))
+        device_extra.update(device_timing(
+            core, mcfg, batch, avg_seq, kv_itemsize,
+            temp=temp, topk=topk, topp=topp, seeds=seeds))
+        device_extra.update(device_prefill_timing(
+            core, prompt_len, last_prefill_args))
+
     result = {
         "metric": (f"decode_tok_per_s_chip_llama{model}_b{batch}"
                    + ("" if quant == "none" else f"_{quant}")),
@@ -192,6 +328,7 @@ def main() -> None:
             "attn_impl": attn,
             "steps_per_dispatch": harvest,
             "pipelined": pipeline,
+            **device_extra,
         },
     }
     print(json.dumps(result))

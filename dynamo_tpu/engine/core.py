@@ -416,9 +416,12 @@ class EngineCore:
         req.prefix_hit_tokens = plan.hit_tokens + plan.host_hit_tokens
         n_already = len(plan.hit_blocks) + len(plan.host_slots)
         if self.recorder is not None and req.prefix_hit_tokens > 0:
-            # before the prefill record: read rights over the shared prefix
+            # before the prefill record: read rights over the shared prefix.
+            # host_hit is recorded so replay can refuse host-restored hits —
+            # the h2d scatter above is a device write replay never re-executes
             self.recorder.rec("hit_transfer", rid=req.rid,
                               hit=req.prefix_hit_tokens,
+                              host_hit=plan.host_hit_tokens,
                               blocks=list(plan.all_blocks))
         t0 = time.monotonic()
         suffix_len = n_prompt - req.prefix_hit_tokens

@@ -27,7 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..attention import causal_attention  # noqa: F401  (used by sp path)
-from ..attention import (flat_token_indices, paged_attention,
+from ..attention import (_on_tpu, flash_prefill, flash_prefill_supported,
+                         flat_token_indices, paged_attention,
                          softcap_scores as _softcap)
 from ..config import ModelConfig
 from ..quant import QuantizedArray, mm
@@ -234,23 +235,35 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
     forward paths differ in), wo residual, swiglu MLP; scanned over the
     stacked layer params.
 
-    attn_fn(q, k_chunk, v_chunk, k_pool, v_pool, sliding) -> [N, H, Dh]
+    attn_fn(q, k_chunk, v_chunk, k_flat, v_flat, li, sliding) -> [N, H, Dh]
     where N is the leading axis of x (tokens for prefill, batch for
-    decode); the pool args already contain this step's scattered KV and
-    ``sliding`` is this layer's local-attention flag (bool scalar, traced
-    through the scan — gemma2 interleaved window layers).
+    decode), k_flat/v_flat are the FULL pool flattened to [L*NTOK, C]
+    (already containing this step's scattered KV), ``li`` is the traced
+    layer index (reads address rows li*NTOK + slot — callers offset their
+    block tables / gather indices by li), and ``sliding`` is this layer's
+    local-attention flag (bool scalar, traced through the scan — gemma2
+    interleaved window layers).
+
+    The KV pool rides the scan as a CARRY with in-place [li, slots]
+    scatters — NOT as per-layer xs/ys slices. The ys form forced XLA to
+    materialize every layer's whole [NTOK, C] slice into the stacked
+    output each step (~pool-sized read+write per step), which made decode
+    scale with pool size instead of batch (measured: B=64 step 15.9ms →
+    the stack alone was 14.4ms; see tools/decode_profile.py).
     """
     N = x.shape[0]
+    L = cfg.num_layers
     inv_freq = jnp.asarray(rope_inv_freq(cfg))
     layer_params = _layer_stack(params)
     sliding_flags = jnp.asarray(sliding_layer_mask(cfg))
+    NTOK = kv["k"].shape[1]
+    C = kv["k"].shape[2]
 
     p1 = cfg.norm_plus_one
 
     def layer(carry, xs):
-        h = carry
-        lp, k_l, v_l = xs["lp"], xs["k"], xs["v"]
-        sliding = xs["sliding"]
+        h, kp, vp = carry
+        lp, sliding, li = xs["lp"], xs["sliding"], xs["i"]
         hn = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, p1)
         q, k, v = mm(hn, lp["wq"]), mm(hn, lp["wk"]), mm(hn, lp["wv"])
         if cfg.attention_bias:
@@ -263,11 +276,14 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
             k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps, p1)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        k_l = k_l.at[slots, :].set(k.reshape(N, -1).astype(k_l.dtype),
-                                   mode="drop")
-        v_l = v_l.at[slots, :].set(v.reshape(N, -1).astype(v_l.dtype),
-                                   mode="drop")
-        attn = attn_fn(q, k, v, k_l, v_l, sliding)
+        kp = kp.at[li, slots, :].set(k.reshape(N, -1).astype(kp.dtype),
+                                     mode="drop")
+        vp = vp.at[li, slots, :].set(v.reshape(N, -1).astype(vp.dtype),
+                                     mode="drop")
+        # flat [L*NTOK, C] views (metadata-only reshape of the carry
+        # buffers); readers address layer li at row offset li*NTOK
+        attn = attn_fn(q, k, v, kp.reshape(L * NTOK, C),
+                       vp.reshape(L * NTOK, C), li, sliding)
         attn_out = mm(attn.reshape(N, -1), lp["wo"])
         if cfg.post_norms:   # gemma2: norm the block output, then residual
             attn_out = rms_norm(attn_out, lp["ln1_post"],
@@ -284,11 +300,12 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
         if cfg.post_norms:
             mlp_out = rms_norm(mlp_out, lp["ln2_post"], cfg.rms_norm_eps, p1)
         h = h + mlp_out
-        return h, (k_l, v_l)
+        return (h, kp, vp), None
 
-    x, (k_new, v_new) = jax.lax.scan(
-        layer, x, {"lp": layer_params, "k": kv["k"], "v": kv["v"],
-                   "sliding": sliding_flags})
+    (x, k_new, v_new), _ = jax.lax.scan(
+        layer, (x, kv["k"], kv["v"]),
+        {"lp": layer_params, "sliding": sliding_flags,
+         "i": jnp.arange(L, dtype=jnp.int32)})
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, p1)
     return x, {"k": k_new, "v": v_new}
 
@@ -340,6 +357,28 @@ def _attn_scale(cfg: ModelConfig) -> float:
     return (cfg.query_pre_attn_scalar or cfg.head_dim) ** -0.5
 
 
+def _prefill_flash_impl(statics: ModelStatics):
+    """Prefill attention dispatch: the Pallas flash kernel on TPU (or
+    interpret mode when forced), the dense-score einsum elsewhere. Mirrors
+    paged_attention's impl resolution for decode — including raising on a
+    forced impl the geometry can't run, so a parity test can never silently
+    compare the einsum path against itself."""
+    cfg = statics.cfg
+    supported = flash_prefill_supported(cfg.num_heads, cfg.num_kv_heads,
+                                        cfg.head_dim)
+    impl = statics.attn_impl
+    if impl == "auto":
+        return _on_tpu() and supported
+    if impl in ("pallas", "pallas_interpret"):
+        if not supported:
+            raise ValueError(
+                f"prefill impl {impl!r} forced but unsupported geometry "
+                f"(H={cfg.num_heads}, KVH={cfg.num_kv_heads}, "
+                f"Dh={cfg.head_dim}) — see flash_prefill_supported")
+        return "interpret" if impl == "pallas_interpret" else True
+    return False
+
+
 def sliding_layer_mask(cfg: ModelConfig) -> np.ndarray:
     """Per-layer local-attention flags. gemma2 interleaves sliding and
     global layers: HF ``layer_types`` when present, else the
@@ -381,14 +420,28 @@ def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
         0)
     seq_len = start_pos + true_len
 
-    def attn(q, _k, _v, k_l, v_l, sliding):
-        # attend over the whole block table (prefix KV + this chunk)
-        idx = flat_token_indices(block_table[None, :], bsz)[0]       # [S]
+    use_flash = _prefill_flash_impl(statics)
+
+    def attn(q, _k, _v, k_flat, v_flat, li, sliding):
+        # attend over the whole block table (prefix KV + this chunk);
+        # layer li's rows sit at offset li*NTOK in the flat pool
+        NTOK = k_flat.shape[0] // cfg.num_layers
+        idx = (flat_token_indices(block_table[None, :], bsz)[0]      # [S]
+               + li * NTOK)
         S = idx.shape[0]
-        ks = jnp.take(k_l, idx, axis=0).reshape(                     # [S,KVH,Dh]
+        ks = jnp.take(k_flat, idx, axis=0).reshape(                  # [S,KVH,Dh]
             S, cfg.num_kv_heads, cfg.head_dim)
-        vs = jnp.take(v_l, idx, axis=0).reshape(
+        vs = jnp.take(v_flat, idx, axis=0).reshape(
             S, cfg.num_kv_heads, cfg.head_dim)
+        if use_flash:
+            # Pallas online-softmax kernel: O(TQ·SC) live memory instead
+            # of a [KVH, g, T, S] score materialization
+            return flash_prefill(
+                q, ks, vs, scale=scale, start_pos=start_pos,
+                seq_len=seq_len, sliding=sliding,
+                window=cfg.sliding_window,
+                softcap=cfg.attn_logit_softcap or None,
+                interpret=(use_flash == "interpret"))
         g = cfg.num_heads // cfg.num_kv_heads
         qg = q.reshape(T, cfg.num_kv_heads, g, cfg.head_dim)
         scores = jnp.einsum("tkgd,skd->kgts", qg, ks).astype(jnp.float32) * scale
@@ -437,7 +490,7 @@ def prefill_forward_sp(params: Params, kv: KVCache, tokens: jax.Array,
     slots = jnp.where(valid, block_table[positions // bsz] * bsz +
                       positions % bsz, 0)
 
-    def attn(q, k, v, _k_l, _v_l, sliding):
+    def attn(q, k, v, _k_flat, _v_flat, _li, sliding):
         del sliding   # sp path serves global-attention models only
         return ring_attention(q, k, v, mesh, scale=scale, kv_len=true_len)
 
@@ -463,13 +516,18 @@ def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
     slots = block_tables[jnp.arange(B), positions // bsz] * bsz + positions % bsz
     seq_lens = positions + 1
 
-    def attn(q, _k, _v, k_l, v_l, sliding):
+    def attn(q, _k, _v, k_flat, v_flat, li, sliding):
         win_lo = None
         if cfg.sliding_window is not None:
             win_lo = jnp.where(sliding,
                                positions - cfg.sliding_window,
                                jnp.full_like(positions, -1))
-        return paged_attention(q, k_l, v_l, block_tables, seq_lens,
+        # layer li's blocks sit at block offset li*num_blocks in the flat
+        # pool — the whole paged-attention path (incl. the Pallas kernel's
+        # DMA addressing) works unchanged on offset tables
+        num_blocks = k_flat.shape[0] // (cfg.num_layers * bsz)
+        return paged_attention(q, k_flat, v_flat,
+                               block_tables + li * num_blocks, seq_lens,
                                block_size=bsz, scale=scale,
                                impl=statics.attn_impl,
                                softcap=cfg.attn_logit_softcap,

@@ -73,6 +73,12 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                              core.cfg.kv_block_size, dtype=dtype)
     out = {"prefill": {}, "dispatch": {}, "fingerprints": []}
     disp_toks: Dict[int, object] = {}
+    # pool slots written by in-log prefills/dispatches: a prefix hit whose
+    # blocks were registered BEFORE recording began has no in-log writer —
+    # the fresh replay KV holds zeros there and every downstream compare
+    # would report phantom mismatches (advisor round-1 finding)
+    bs = core.cfg.kv_block_size
+    written: set = set()
 
     def fp(label):
         if not fingerprint:
@@ -90,6 +96,30 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                 f"run used an unrecorded admission path "
                 f"({ev.get('path')}, rid={ev.get('rid')}); replay would "
                 f"silently diverge — record only plain-prefill runs")
+        if kind == "hit_transfer" and int(ev.get("hit", 0)) > 0:
+            if int(ev.get("host_hit", 0)) > 0:
+                # host-tier hits scatter offloaded content back to device
+                # (core scatter_blocks_from_host) — a write replay cannot
+                # re-execute, and the in-log-writer check below can't see:
+                # the reused target blocks may have a PRIOR in-log writer
+                # whose stale values the replay KV would still hold
+                raise NotImplementedError(
+                    f"prefix hit for rid={ev.get('rid')} includes "
+                    f"{ev['host_hit']} host-restored tokens; the h2d "
+                    f"restore is not replayable — disable host offload "
+                    f"when recording")
+            table = list(ev["blocks"])
+            for p in range(int(ev["hit"])):
+                ps = table[p // bs] * bs + p % bs
+                if ps not in written:
+                    raise NotImplementedError(
+                        f"prefix hit for rid={ev.get('rid')} reads pool "
+                        f"slot {ps} (kv position {p}) with no in-log "
+                        f"writer — its blocks were registered before "
+                        f"recording began, so the fresh replay KV is zeros "
+                        f"there and compare_replay would report phantom "
+                        f"mismatches; start recording before any prefix "
+                        f"blocks are stored")
         if kind == "prefill":
             key = make_slot_keys(core.cfg.seed,
                                  jnp.asarray([ev["samp_seed"]]),
@@ -104,6 +134,11 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                 jnp.asarray(ev["top_p"], jnp.float32))
             tok = jax.block_until_ready(tok)
             out["prefill"][ev["pf_seq"]] = int(tok)
+            table = np.asarray(ev["table"])
+            start, n = int(ev["start_pos"]), int(ev["true_len"])
+            written.update(
+                int(table[p // bs]) * bs + p % bs
+                for p in range(start, start + n))
             fp(("prefill", ev["pf_seq"]))
         elif kind == "dispatch":
             host_tokens = jnp.array(np.asarray(ev["tokens"]))
@@ -129,6 +164,15 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
             toks_k = jax.block_until_ready(toks_k)
             disp_toks[ev["id"]] = toks_k
             out["dispatch"][ev["id"]] = np.asarray(toks_k).copy()
+            tables = np.asarray(ev["tables"])
+            positions = np.asarray(ev["positions"])
+            for i, rid in enumerate(ev.get("reqs", [])):
+                if rid is None:
+                    continue
+                p0 = int(positions[i])
+                written.update(
+                    int(tables[i, p // bs]) * bs + p % bs
+                    for p in range(p0, p0 + K))
             fp(("dispatch", ev["id"]))
     return out
 

@@ -156,6 +156,13 @@ async def test_lane_under_preemption_contention(pipeline):
         await big.stop()
 
     small = make_core(lanes=512, blocks=16, pipeline=pipeline)
+    # record the schedule: stream b's lane admission carries numeric
+    # boundary 0, which makes the boundary assert below vacuous for b
+    # (advisor round-1 finding) — the synchronous replay check is the
+    # non-vacuous verification that EVERY harvested token of both streams
+    # reproduces from the recorded schedule
+    from dynamo_tpu.engine.replay import Recorder, compare_replay, replay
+    small.recorder = Recorder()
     try:
         r_a = await submit(small, p1, "a", max_new=max_new)
         t0 = await first_token(r_a)
@@ -165,12 +172,15 @@ async def test_lane_under_preemption_contention(pipeline):
         from dynamo_tpu.llm.protocols.common import FinishReason
         assert r1 == FinishReason.LENGTH and r2 == FinishReason.LENGTH
         assert len(g1) == max_new and len(g2) == max_new
+        assert small.lane_admissions >= 1, "lane admission never engaged"
         # lane admissions re-derive the FIRST token through the decode
         # program while the prefill-path reference derives it via the
-        # prefill program — same near-tie caveat as recompute boundaries,
-        # so streams that engaged a lane get boundary 0 allowance only if
-        # they were actually lane-admitted after a preemption
+        # prefill program — same near-tie caveat as recompute boundaries
         assert_exact_to_recompute_boundary(g1, ref1, q1, "a")
         assert_exact_to_recompute_boundary(g2, ref2, q2, "b")
+        # no waiver here: post-boundary tokens (incl. all of b's) must
+        # match a synchronous re-execution of the recorded schedule
+        rep = replay(small, small.recorder.events)
+        assert compare_replay(small.recorder.events, rep) == []
     finally:
         await small.stop()

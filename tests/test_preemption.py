@@ -81,6 +81,11 @@ async def test_preemption_exact_streams_under_contention(k, pipeline):
     # pool big enough for either sequence alone (~9 blocks each + slack)
     # but not both at full length → forced preemption traffic
     small = make_core(num_kv_blocks=16, k=k, pipeline=pipeline)
+    if k > 1:
+        # record the schedule so post-boundary tokens are verified too
+        # (dispatch recording exists only in the multi-step path)
+        from dynamo_tpu.engine.replay import Recorder
+        small.recorder = Recorder()
     try:
         (g1, r1, q1), (g2, r2, q2) = await asyncio.gather(
             run_req(small, p1, max_new, rid="a"),
@@ -92,6 +97,16 @@ async def test_preemption_exact_streams_under_contention(k, pipeline):
         assert small.preemptions > 0, "contention never triggered preemption"
         assert_exact_to_recompute_boundary(g1, ref1, q1, "a")
         assert_exact_to_recompute_boundary(g2, ref2, q2, "b")
+        if k > 1:
+            # tokens AFTER a recompute boundary aren't waived: a
+            # synchronous replay of the recorded schedule (same prefill
+            # programs, fresh KV) must reproduce every harvested token —
+            # post-preemption corruption would diverge here (advisor
+            # round-1 finding: the boundary assert alone left the tail
+            # unchecked)
+            from dynamo_tpu.engine.replay import compare_replay, replay
+            rep = replay(small, small.recorder.events)
+            assert compare_replay(small.recorder.events, rep) == []
     finally:
         await small.stop()
 
