@@ -57,8 +57,14 @@ class EngineRequest:
     #   the request finishes after prefill (the reference's max_tokens=1
     #   remote-decode prefill, examples/llm/components/prefill_worker.py).
     handoff: object = None
-    # - decode worker: KV arrived from a remote prefill (KvPayload);
-    #   admission scatters it instead of running the prefill program.
+    # - device mode: handoff receives the DEVICE gather ({"stacked", ...})
+    #   instead of host wire values — the in-process ICI bulk plane
+    #   (llm/kv_transport.py); no device→host fetch happens at all.
+    handoff_device: bool = False
+    # - decode worker: KV arrived from a remote prefill (KvPayload with
+    #   host wire values, or kv_transport.DeviceKvPayload with device
+    #   arrays); admission scatters it instead of running the prefill
+    #   program.
     precomputed: object = None
     # engine state
     slot: int = -1
@@ -435,12 +441,20 @@ class EngineCore:
             self._admit_lane(req, slot, n_already)
             return True
         defer = False
+        remote_admit = req.precomputed is not None
         if req.precomputed is not None:
             if self.recorder is not None:
                 self.recorder.rec("prefill_unsupported", rid=req.rid,
                                   path="precomputed")
             tok, logprob = self._admit_precomputed(req, n_already)
-            tok, logprob = int(tok), float(logprob)
+            # device payloads ship the first token as a device scalar (the
+            # prefill side never fetched it — one round-trip saved); defer
+            # our fetch behind the next decode dispatch like a local
+            # admission
+            defer = (self.cfg.overlap_admission_fetch
+                     and hasattr(tok, "copy_to_host_async"))
+            if not defer:
+                tok, logprob = int(tok), float(logprob)
         else:
             # prefill only the un-matched suffix — the prefix KV is already
             # in the pool's blocks (this is the TTFT win of prefix reuse)
@@ -501,11 +515,13 @@ class EngineCore:
                     jnp.asarray(req.sampling.top_p, jnp.float32))
             self.total_prefill_tokens += len(chunk)
             # defer the device→host fetch of the first token: it overlaps
-            # the next decode dispatch instead of stalling the loop
-            # (handoff needs the host value immediately — no deferral)
+            # the next decode dispatch instead of stalling the loop. Wire
+            # handoff needs the host value immediately; DEVICE handoff
+            # never needs it at all — the token rides the payload as a
+            # device scalar and the decode side defers its own fetch.
             defer = (self.cfg.overlap_admission_fetch
                      and req.handoff is None)
-            if not defer:
+            if not defer and not req.handoff_device:
                 tok, logprob = int(tok), float(logprob)
         if req.handoff is not None:
             defer = False
@@ -548,7 +564,7 @@ class EngineCore:
         logger.debug(
             "admitted %s into slot %d (prompt=%d, hit=%d+%dhost, remote=%s, "
             "%.1fms)", req.rid, slot, n_prompt, plan.hit_tokens,
-            plan.host_hit_tokens, req.precomputed is not None,
+            plan.host_hit_tokens, remote_admit,
             1e3 * (time.monotonic() - t0))
         if req.ready:
             self._emit(req, tok, float(logprob))
@@ -660,10 +676,24 @@ class EngineCore:
         n_prompt_blocks = self._blocks_needed(len(req.prompt))
         targets = req.blocks[n_already:n_prompt_blocks]
         if targets:
-            vals = {k: v[:, :, n_already:n_prompt_blocks]
-                    for k, v in pc.values.items()}
-            self.kv = scatter_blocks_from_host(
-                self.kv, targets, vals, self.cfg.kv_block_size)
+            from ..llm.kv_transport import (DeviceKvPayload,
+                                            scatter_blocks_device)
+            if isinstance(pc, DeviceKvPayload):
+                # device bulk plane: blocks hop prefill-devices →
+                # decode-devices (ICI, resharding under our mesh) with no
+                # host staging
+                self.kv = scatter_blocks_device(
+                    self.kv, targets, pc, n_already, n_prompt_blocks,
+                    mesh=self.mesh)
+            else:
+                vals = {k: v[:, :, n_already:n_prompt_blocks]
+                        for k, v in pc.values.items()}
+                self.kv = scatter_blocks_from_host(
+                    self.kv, targets, vals, self.cfg.kv_block_size)
+        # drop the payload now: nothing reads it after the scatter, and a
+        # DeviceKvPayload would otherwise pin the whole gathered KV stack
+        # in the PREFILL engine's HBM for this request's lifetime
+        req.precomputed = None
         return pc.first_token, pc.first_logprob
 
     def _handoff_and_finish(self, req: EngineRequest, tok: int,
@@ -680,10 +710,18 @@ class EngineCore:
         handoff = req.handoff
         kvh = self.model_cfg.num_kv_heads
 
-        async def send() -> None:
-            values = await asyncio.to_thread(
-                fetch_wire, stacked, n_blocks, kvh)
-            await handoff(tok, logprob, values, seq_hashes)
+        if req.handoff_device:
+            # device bulk plane: ship the gather output as device arrays —
+            # no host fetch; the decode engine device_puts + scatters
+            async def send() -> None:
+                await handoff(tok, logprob,
+                              {"stacked": stacked, "n_blocks": n_blocks},
+                              seq_hashes)
+        else:
+            async def send() -> None:
+                values = await asyncio.to_thread(
+                    fetch_wire, stacked, n_blocks, kvh)
+                await handoff(tok, logprob, values, seq_hashes)
 
         task = asyncio.get_running_loop().create_task(
             send(), name=f"kv-handoff-{req.rid}")

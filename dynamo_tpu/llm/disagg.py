@@ -156,16 +156,21 @@ class DisaggEngine(JaxEngine):
     def __init__(self, core: EngineCore, runtime: DistributedRuntime,
                  disagg_router: DisaggregatedRouter,
                  queue: Optional[PrefillQueue] = None,
-                 prefill_timeout: float = 30.0):
+                 prefill_timeout: float = 30.0,
+                 device_plane: bool = True):
         super().__init__(core)
         self.runtime = runtime
         self.disagg_router = disagg_router
         self.queue = queue or PrefillQueue(runtime)
         self.prefill_timeout = prefill_timeout
+        # advertise the in-process ICI bulk plane (kv_transport) to prefill
+        # workers; False forces the TCP wire path even in-process
+        self.device_plane = device_plane
         # observability
         self.remote_prefills = 0
         self.local_prefills = 0
         self.remote_failures = 0
+        self.device_transfers = 0   # handoffs that rode the ICI bulk plane
 
     def _estimate_prefix_hit(self, req: EngineRequest) -> int:
         """Hold-free device-tier prefix estimate (in tokens). The hash chain
@@ -193,14 +198,17 @@ class DisaggEngine(JaxEngine):
 
     async def _remote_prefill(self, req: EngineRequest,
                               hit: int) -> Optional[KvPayload]:
+        from .kv_transport import PROC_TOKEN, bridge
         rt = self.runtime
         await rt.tcp.start()
         rx = rt.tcp.register()
+        sink = bridge().register(req.rid)   # device-path rendezvous
         rpr = RemotePrefillRequest(
             request_id=req.rid, token_ids=list(req.prompt),
             sampling=dataclasses.asdict(req.sampling),
             connection_info=rt.tcp.connection_info(rx).to_dict(),
-            engine_id=rt.worker_uuid, prefix_hit_tokens=hit)
+            engine_id=rt.worker_uuid, prefix_hit_tokens=hit,
+            device_bridge=PROC_TOKEN if self.device_plane else "")
         try:
             await self.queue.enqueue(rpr)
             prologue = await rx.wait_connected(timeout=self.prefill_timeout)
@@ -226,12 +234,22 @@ class DisaggEngine(JaxEngine):
                 elif f.kind == FrameKind.SENTINEL:
                     if meta_header is None:
                         raise RuntimeError("stream ended without payload")
+                    meta = json.loads(meta_header)
+                    if meta.get("device_deposit"):
+                        # bulk bytes took the in-process ICI plane; the
+                        # deposit happened before this control frame
+                        if not sink.done():
+                            raise RuntimeError(
+                                "device-deposit frame without deposit")
+                        self.device_transfers += 1
+                        return sink.result()
                     return decode_kv_payload(meta_header, b"".join(chunks))
         except Exception as e:  # noqa: BLE001 — any failure → local fallback
             logger.warning("remote prefill failed for %s (%s); "
                            "falling back to local", req.rid, e)
             return None
         finally:
+            bridge().cancel(req.rid)
             rx.close()
             rt.tcp.unregister(rx.stream_id)
 
@@ -239,6 +257,7 @@ class DisaggEngine(JaxEngine):
         return {"remote_prefills": self.remote_prefills,
                 "local_prefills": self.local_prefills,
                 "remote_failures": self.remote_failures,
+                "device_transfers": self.device_transfers,
                 "max_local_prefill_length":
                     self.disagg_router.max_local_prefill_length}
 
@@ -266,6 +285,7 @@ class PrefillWorker:
         self._stopping = False
         self.prefills_done = 0
         self.prefills_failed = 0
+        self.device_handoffs = 0    # handoffs that rode the ICI bulk plane
 
     async def start(self) -> "PrefillWorker":
         self._stopping = False
@@ -322,7 +342,10 @@ class PrefillWorker:
         sent.add_done_callback(
             lambda f: None if f.cancelled() else f.exception())
 
-        async def handoff(tok, logprob, values, seq_hashes) -> None:
+        from .kv_transport import PROC_TOKEN, DeviceKvPayload, bridge
+        use_device = rpr.device_bridge == PROC_TOKEN
+
+        async def handoff_wire(tok, logprob, values, seq_hashes) -> None:
             try:
                 payload = KvPayload(
                     request_id=rpr.request_id, first_token=tok,
@@ -340,13 +363,48 @@ class PrefillWorker:
                 if not sent.done():
                     sent.set_exception(e)
 
+        async def handoff_device(tok, logprob, dev, seq_hashes) -> None:
+            # deposit the device arrays in the in-process bridge, then tell
+            # the decode side over the response plane (tiny control frame);
+            # if the sink is gone (decode timed out), fall back to wire so
+            # the blocks still arrive
+            try:
+                payload = DeviceKvPayload(
+                    request_id=rpr.request_id, first_token=tok,
+                    first_logprob=logprob, seq_hashes=seq_hashes,
+                    stacked=dev["stacked"], n_blocks=dev["n_blocks"],
+                    block_size=self.core.cfg.kv_block_size)
+                if bridge().deposit(rpr.request_id, payload):
+                    self.device_handoffs += 1
+                    header = json.dumps(
+                        {"device_deposit": True,
+                         "request_id": rpr.request_id}).encode()
+                    await sender.send(b"", header=header)
+                    await sender.finish()
+                    if not sent.done():
+                        sent.set_result(True)
+                    return
+                from ..engine.block_copy import fetch_wire
+                values = await asyncio.to_thread(
+                    fetch_wire, dev["stacked"], dev["n_blocks"],
+                    self.core.model_cfg.num_kv_heads)
+                # wire fallback needs host scalars (device mode skipped the
+                # prefill-side fetch)
+                await handoff_wire(int(tok), float(logprob), values,
+                                   seq_hashes)
+            except Exception as e:  # noqa: BLE001
+                if not sent.done():
+                    sent.set_exception(e)
+
         from ..engine.sampling import SlotSampling
         from ..runtime.engine import EngineContext
         ctx = EngineContext(rpr.request_id)
         req = EngineRequest(
             rid=rpr.request_id, prompt=list(rpr.token_ids),
             sampling=SlotSampling(**rpr.sampling), max_new_tokens=1,
-            eos_ids=frozenset(), ctx=ctx, handoff=handoff)
+            eos_ids=frozenset(), ctx=ctx,
+            handoff=handoff_device if use_device else handoff_wire,
+            handoff_device=use_device)
         await self.core.submit(req)
         try:
             # drain the engine's (token, finish) signals, then await the send
@@ -377,6 +435,7 @@ class PrefillWorker:
     def stats(self) -> dict:
         return {"prefills_done": self.prefills_done,
                 "prefills_failed": self.prefills_failed,
+                "device_handoffs": self.device_handoffs,
                 "inflight": len(self._inflight)}
 
     async def stop(self) -> None:

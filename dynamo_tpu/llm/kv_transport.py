@@ -1,0 +1,145 @@
+"""Device-to-device KV bulk plane for PD disaggregation.
+
+The reference moves KV blocks engine-to-engine with NIXL RDMA so the
+handoff never touches host Python (vLLM patch nixl.py `read_blocks` /
+`write_blocks`; SURVEY.md §5.8 names this THE transfer to replace). The
+TPU-native equivalent exploits JAX's single-controller model: same-host
+disagg runs BOTH engines in one process on disjoint device subsets (e.g.
+a v5e-8 split 4+4 — BASELINE config 3), so the bulk handoff is a
+`jax.device_put` of the gathered block stack from the prefill engine's
+devices to the decode engine's devices/sharding — a pure ICI transfer
+scheduled on the device streams, never staged through host numpy. The
+TCP wire path (llm/disagg.py) remains the cross-host/DCN fallback.
+
+TP-reshard on handoff falls out of the same `device_put`: the stacked
+blocks [L, n, bs, KVH*Dh] are placed under the decode mesh's KV pspec
+(last axis over "tp"), so XLA performs the reshard collective — the
+analog of the reference's `permute_scatter_memcpy` (block_copy.cu:558).
+
+Rendezvous: the decode side registers a sink future keyed by request id
+before enqueueing the prefill work and advertises this process's token in
+`RemotePrefillRequest.device_bridge`; a prefill worker in the same
+process deposits the device payload here and sends only a tiny control
+frame over the response plane (keeping the existing timeout/fallback
+control flow). Everything else falls back to the wire path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import uuid
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger("dynamo_tpu.llm.kv_transport")
+
+__all__ = ["PROC_TOKEN", "DeviceKvPayload", "DeviceKvBridge", "bridge",
+           "scatter_blocks_device"]
+
+# identity of this process's bridge: a prefill worker seeing this token in
+# a request knows the decode engine shares its jax runtime
+PROC_TOKEN = uuid.uuid4().hex
+
+
+@dataclasses.dataclass
+class DeviceKvPayload:
+    """KV handoff that never left the devices: stacked block-major gather
+    output straight from the prefill engine's pool."""
+
+    request_id: str
+    first_token: object             # int OR device scalar (never fetched on
+    first_logprob: object           # the prefill side — saves a round-trip)
+    seq_hashes: List[int]
+    stacked: Dict[str, jax.Array]   # {"k": [L, n_padded, bs, KVH*Dh], "v"}
+    n_blocks: int                   # valid blocks (rest is pow2 padding)
+    block_size: int
+
+
+class DeviceKvBridge:
+    """In-process rendezvous: decode registers a sink, prefill deposits."""
+
+    def __init__(self) -> None:
+        self._sinks: Dict[str, asyncio.Future] = {}
+        self.deposits = 0
+        self.misses = 0
+
+    def register(self, request_id: str) -> asyncio.Future:
+        fut = asyncio.get_running_loop().create_future()
+        self._sinks[request_id] = fut
+        return fut
+
+    def deposit(self, request_id: str, payload: DeviceKvPayload) -> bool:
+        """True if a sink was waiting (decode will take the device path)."""
+        fut = self._sinks.pop(request_id, None)
+        if fut is None or fut.done():
+            self.misses += 1
+            return False
+        fut.set_result(payload)
+        self.deposits += 1
+        return True
+
+    def cancel(self, request_id: str) -> None:
+        fut = self._sinks.pop(request_id, None)
+        if fut is not None and not fut.done():
+            fut.cancel()
+
+
+_BRIDGE: Optional[DeviceKvBridge] = None
+
+
+def bridge() -> DeviceKvBridge:
+    global _BRIDGE
+    if _BRIDGE is None:
+        _BRIDGE = DeviceKvBridge()
+    return _BRIDGE
+
+
+def _stacked_kv_sharding(mesh):
+    """The pool pspec (parallel/sharding.kv_pspecs, [L, NTOK, C]) lifted to
+    the stacked-blocks rank [L, n, bs, C]: the block axis is new and
+    unsharded, bs inherits the (unsharded) token axis, C keeps its axes —
+    derived, not duplicated, so a pool-layout change can't silently
+    diverge the device plane's placement."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.sharding import kv_pspecs
+    s = kv_pspecs()["k"]
+    return NamedSharding(mesh, P(s[0], None, s[1], s[2]))
+
+
+def scatter_blocks_device(kv, target_ids, payload: DeviceKvPayload,
+                          skip_blocks: int, n_needed: int, mesh=None):
+    """Scatter a device payload's blocks [skip_blocks:n_needed] into this
+    engine's pool at `target_ids`, moving the values device-to-device
+    (ICI) — and resharding under `mesh`'s KV layout when given — without
+    host staging. Returns the new (donated-in-place) cache."""
+    from jax.sharding import NamedSharding
+
+    from ..engine.block_copy import _pad_pow2, scatter_blocks
+
+    vals = {k: v[:, skip_blocks:n_needed]
+            for k, v in payload.stacked.items()}
+    pool_sharding = kv["k"].sharding
+    if mesh is not None:
+        target = _stacked_kv_sharding(mesh)
+    elif isinstance(pool_sharding, NamedSharding):
+        target = _stacked_kv_sharding(pool_sharding.mesh)
+    else:
+        # single-device pool: its placement applies rank-agnostically
+        target = pool_sharding
+    # the cross-engine (and cross-mesh) hop: device→device over ICI
+    vals = jax.device_put(vals, target)
+    n = n_needed - skip_blocks
+    pad = _pad_pow2(n) - n
+    ids = list(target_ids) + [0] * pad     # pad scatters hit trash block 0
+    if pad:
+        vals = {k: jnp.concatenate(
+            [v, jnp.zeros((v.shape[0], pad) + v.shape[2:], v.dtype)], axis=1)
+            for k, v in vals.items()}
+    return scatter_blocks(kv, jnp.asarray(np.asarray(ids, np.int32)),
+                          vals, payload.block_size)

@@ -47,6 +47,10 @@ class RemotePrefillRequest:
     connection_info: Dict          # decode worker's KV-sink stream (addr+id)
     engine_id: str = ""            # decode worker identity (diagnostics)
     prefix_hit_tokens: int = 0     # decode-side estimate (router metric)
+    # decode process's kv_transport.PROC_TOKEN: a prefill worker in the
+    # SAME process takes the device-to-device bulk plane (ICI) and sends
+    # only a control frame over TCP; others stream the wire payload
+    device_bridge: str = ""
 
     def to_json(self) -> bytes:
         return json.dumps(dataclasses.asdict(self)).encode()

@@ -1,0 +1,176 @@
+"""Disagg TTFT vs aggregated TTFT, and handoff latency vs ISL.
+
+The reference's headline disagg claim is +30% throughput/GPU at 3K ISL /
+150 OSL with KV moved by NIXL RDMA (docs/architecture.md:57). The gate for
+our device bulk plane (BASELINE config 3): disagg TTFT must not be worse
+than aggregated TTFT for long prompts. This tool measures, per ISL:
+
+  agg_ttft      — prefill + first token on one engine
+  disagg_ttft   — decode-side TTFT with remote prefill on a second engine
+                  in the same process (device plane: gather → device_put →
+                  scatter, no host staging)
+  handoff_ms    — the pure KV transfer+scatter cost (disagg TTFT minus the
+                  prefill compute both paths share)
+
+Both engines share the one available chip, so this measures the per-hop
+software + DMA cost of the plane; on a real split (4+4 chips) prefill and
+decode overlap and disagg wins additionally from specialization.
+
+Usage: python tools/disagg_bench.py [isl ...]    (default 512 1024 2048 3072)
+Env: DISAGG_MODEL (tiny|1b, default 1b), DISAGG_PLANE (device|wire).
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def model_cfg(name):
+    from dynamo_tpu.engine.config import ModelConfig
+    if name == "tiny":
+        return ModelConfig(vocab_size=2048, hidden_size=256,
+                           intermediate_size=512, num_layers=4, num_heads=8,
+                           num_kv_heads=4, head_dim=32,
+                           max_position_embeddings=8192)
+    return ModelConfig(vocab_size=128256, hidden_size=2048,
+                       intermediate_size=8192, num_layers=16,
+                       num_heads=32, num_kv_heads=8, head_dim=64,
+                       max_position_embeddings=8192,
+                       rope_theta=500000.0, tie_word_embeddings=True)
+
+
+async def run(isls, model, plane):
+    import jax.numpy as jnp
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import EngineCore, FINISH_SENTINEL, \
+        EngineRequest
+    from dynamo_tpu.engine.sampling import SlotSampling
+    from dynamo_tpu.llm.disagg import (DisaggEngine, DisaggregatedRouter,
+                                       PrefillWorker)
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    mcfg = model_cfg(model)
+    max_isl = max(isls)
+    bs = 16
+    bps = (max_isl + 64 + bs - 1) // bs
+    ecfg = dict(max_model_len=max_isl + 64, kv_block_size=bs,
+                num_kv_blocks=2 * bps + 2, max_num_seqs=2,
+                prefill_buckets=sorted({*isls, max_isl + 64}),
+                enable_prefix_reuse=False)   # each trial must prefill fully
+
+    def core():
+        return EngineCore(mcfg, EngineConfig(**ecfg), attn_impl="auto",
+                          param_dtype=jnp.bfloat16)
+
+    async def ttft(engine_core, submit):
+        """Submit via `submit(prompt, rid)` → seconds to first token."""
+        rng = np.random.default_rng(0)
+
+        async def once(isl, rid):
+            prompt = rng.integers(1, 1000, size=isl).tolist()
+            t0 = time.monotonic()
+            req = await submit(prompt, rid)
+            dt = None
+            while True:
+                item, _ = await asyncio.wait_for(req.out_queue.get(), 300)
+                if item is FINISH_SENTINEL:
+                    break
+                if dt is None:
+                    dt = time.monotonic() - t0   # FIRST token only
+            return dt
+
+        return once
+
+    results = []
+    # ---- aggregated reference
+    agg = core()
+
+    async def agg_submit(prompt, rid):
+        req = EngineRequest(rid=rid, prompt=prompt,
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=2, eos_ids=frozenset())
+        await agg.submit(req)
+        return req
+
+    once = await ttft(agg, agg_submit)
+    agg_ttft = {}
+    for isl in isls:
+        await once(isl, f"warm-{isl}")          # compile this bucket
+        agg_ttft[isl] = min([await once(isl, f"agg-{isl}-{i}")
+                             for i in range(3)])
+    await agg.stop()
+
+    # ---- disagg pair (same chip: measures the handoff hop itself)
+    rt = DistributedRuntime.in_process()
+    prefill_core, decode_core = core(), core()
+    router = DisaggregatedRouter(rt, "m", max_local_prefill_length=0,
+                                 conditional=False)
+    engine = DisaggEngine(decode_core, rt, router, prefill_timeout=300.0,
+                          device_plane=(plane == "device"))
+    worker = await PrefillWorker(prefill_core, rt).start()
+
+    async def dis_submit(prompt, rid):
+        from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                     SamplingOptions,
+                                                     StopConditions)
+        from dynamo_tpu.runtime import Context
+        from dynamo_tpu.runtime.engine import EngineContext
+        pre = PreprocessedRequest(
+            token_ids=list(prompt),
+            stop_conditions=StopConditions(max_tokens=2, ignore_eos=True),
+            sampling_options=SamplingOptions(greedy=True))
+        # generate() drives the full disagg path; recover the EngineRequest
+        # via the engine core's slot after submit — instead, reuse the
+        # DisaggEngine building blocks directly for a clean TTFT probe
+        req = engine.build_request(Context(pre, ctx=EngineContext(rid)))
+        hit = engine._estimate_prefix_hit(req)
+        payload = await engine._remote_prefill(req, hit)
+        if payload is None:
+            # a silent local fallback would report aggregated TTFT as
+            # disagg TTFT — fail the bench loudly instead
+            raise RuntimeError(
+                f"remote prefill fell back for {rid} "
+                f"(remote_failures={engine.remote_failures}); "
+                "bench numbers would be meaningless")
+        req.precomputed = payload
+        await decode_core.submit(req)
+        return req
+
+    once = await ttft(decode_core, dis_submit)
+    for isl in isls:
+        await once(isl, f"dwarm-{isl}")
+        vals = [await once(isl, f"dis-{isl}-{i}") for i in range(3)]
+        dis = min(vals)
+        results.append({
+            "isl": isl,
+            "agg_ttft_ms": round(agg_ttft[isl] * 1e3, 1),
+            "disagg_ttft_ms": round(dis * 1e3, 1),
+            "handoff_overhead_ms": round((dis - agg_ttft[isl]) * 1e3, 1),
+            "disagg_not_worse": dis <= agg_ttft[isl] * 1.05,
+        })
+    await worker.stop()
+    await prefill_core.stop()
+    await decode_core.stop()
+    await rt.shutdown()
+
+    import json
+    print(f"# plane={plane} model={model} "
+          f"device_transfers={engine.device_transfers}", file=sys.stderr)
+    for r in results:
+        print(json.dumps(r))
+
+
+def main():
+    isls = [int(a) for a in sys.argv[1:]] or [512, 1024, 2048, 3072]
+    model = os.environ.get("DISAGG_MODEL", "1b")
+    plane = os.environ.get("DISAGG_PLANE", "device")
+    asyncio.run(run(isls, model, plane))
+
+
+if __name__ == "__main__":
+    main()
