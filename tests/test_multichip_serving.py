@@ -1,0 +1,274 @@
+"""Full-stack multi-chip serving on the virtual 8-device CPU mesh.
+
+Round-1 gap (VERDICT "Next round" 6): multi-chip was exercised only by the
+raw-step dryrun and unit tests — never by the serving engine. These tests
+drive EngineCore + JaxEngine + HTTP with tp/sp > 1, a disagg pair across
+meshes, and a KV-routed duo of real sharded engines.
+
+Parallelism architecture note (vs the reference's per-engine TP flags,
+SURVEY.md §2.3): in-engine axes are tp (weights/KV heads), sp (ring-
+attention prefill), ep (MoE experts); dp is ACROSS engines — replicas
+behind the KV router — because the paged KV pool is an engine-local
+resource (the reference reaches the same shape with router + replicas).
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import aiohttp
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.core import EngineCore
+from dynamo_tpu.llm.backend import Backend
+from dynamo_tpu.llm.engines.jax_engine import JaxEngine
+from dynamo_tpu.llm.http import HttpService
+from dynamo_tpu.llm.model_card import ModelDeploymentCard
+from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.parallel.sharding import make_mesh
+from dynamo_tpu.runtime import Context, link
+from dynamo_tpu.runtime.engine import EngineContext
+
+pytestmark = pytest.mark.asyncio
+
+TINY = ModelConfig(
+    model_type="llama", vocab_size=128, hidden_size=64,
+    intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=16, max_position_embeddings=256, tie_word_embeddings=False)
+
+
+def make_core(mesh=None, kv_event_publisher=None, **over) -> EngineCore:
+    cfg = EngineConfig(**{
+        "max_model_len": 128, "kv_block_size": 8, "num_kv_blocks": 48,
+        "max_num_seqs": 2, "prefill_buckets": [32, 64, 128],
+        "sp_min_prefill_tokens": 32, **over})
+    return EngineCore(TINY, cfg, attn_impl="xla", param_dtype=jnp.float32,
+                      mesh=mesh, kv_event_publisher=kv_event_publisher)
+
+
+def token_request(prompt, rid, max_tokens=8):
+    from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+    pre = PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=max_tokens,
+                                       ignore_eos=True),
+        sampling_options=SamplingOptions(greedy=True))
+    return Context(pre, ctx=EngineContext(rid))
+
+
+async def collect_tokens(stream):
+    toks = []
+    async for a in stream:
+        if a.data is not None and a.data.token_ids:
+            toks.extend(a.data.token_ids)
+    return toks
+
+
+@pytest.fixture
+def long_prompt():
+    rng = np.random.default_rng(71)
+    return [int(t) for t in rng.integers(2, 120, size=40)]   # ≥ sp_min 32
+
+
+# NOTE: the bare EngineCore+JaxEngine serving run on a tp×sp mesh lives in
+# tests/test_ring_attention.py::test_engine_serving_over_tp_sp_mesh (with an
+# sp-dispatch counter); this file covers the layers above it.
+
+
+async def test_http_serving_on_tp_mesh(tiny_model_dir, long_prompt):
+    """OpenAI HTTP frontend over a tp=2-sharded engine end to end."""
+    mdc = ModelDeploymentCard.from_local_path(tiny_model_dir,
+                                              display_name="tiny")
+    mcfg = ModelConfig.from_model_dir(tiny_model_dir)
+    mesh = make_mesh(dp=1, tp=2)
+    core = EngineCore(
+        mcfg,
+        EngineConfig(max_model_len=256, kv_block_size=8, num_kv_blocks=64,
+                     max_num_seqs=4, prefill_buckets=[32, 64, 128, 256]),
+        attn_impl="xla", param_dtype=jnp.float32, mesh=mesh)
+    pipe = link(OpenAIPreprocessor(mdc), Backend(mdc), JaxEngine(core))
+    svc = HttpService(port=0, host="127.0.0.1")
+    svc.manager.add_chat_model("tiny", pipe)
+    await svc.start()
+    try:
+        url = f"http://127.0.0.1:{svc.port}/v1/chat/completions"
+        body = {"model": "tiny", "max_tokens": 8, "temperature": 0.0,
+                "messages": [{"role": "user", "content": "hello mesh"}]}
+        async with aiohttp.ClientSession() as s:
+            async with s.post(url, json=body) as r:
+                assert r.status == 200
+                out = await r.json()
+        assert out["choices"][0]["finish_reason"] in ("stop", "length")
+        assert out["usage"]["completion_tokens"] >= 1
+        # the model really is sharded: a weight leaf spans 2 devices
+        wq = core.params["layers.wq"]
+        assert len(wq.sharding.device_set) == 2
+    finally:
+        await svc.stop()
+        await core.stop()
+
+
+async def test_disagg_pair_across_meshes(long_prompt):
+    """Disagg with BOTH engines sharded: prefill on tp=2 × sp=2 (ring
+    prefill), decode on tp=4 — the handoff reshards over the device plane
+    and the stream matches the decode mesh serving alone."""
+    from dynamo_tpu.llm.disagg import (DisaggEngine, DisaggregatedRouter,
+                                       PrefillWorker)
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    ref_core = make_core(mesh=make_mesh(dp=1, tp=4))
+    try:
+        want = await collect_tokens(await JaxEngine(ref_core).generate(
+            token_request(long_prompt, "want")))
+    finally:
+        await ref_core.stop()
+
+    rt = DistributedRuntime.in_process()
+    prefill_core = make_core(mesh=make_mesh(dp=1, tp=2, sp=2))
+    decode_core = make_core(mesh=make_mesh(dp=1, tp=4))
+    router = DisaggregatedRouter(rt, "tiny", max_local_prefill_length=0,
+                                 conditional=False)
+    engine = DisaggEngine(decode_core, rt, router)
+    worker = await PrefillWorker(prefill_core, rt).start()
+    try:
+        got = await collect_tokens(await engine.generate(
+            token_request(long_prompt, "got")))
+        assert engine.remote_prefills == 1 and engine.remote_failures == 0
+        assert engine.device_transfers == 1
+        assert decode_core.total_prefill_tokens == 0
+        assert got == want
+    finally:
+        await worker.stop()
+        await prefill_core.stop()
+        await decode_core.stop()
+        await rt.shutdown()
+
+
+async def test_kv_routed_duo_of_sharded_engines(long_prompt):
+    """Two REAL tp=2-sharded engines behind the KV-aware router (this is
+    the dp axis: replicas): repeat prompts stick to the prefix owner."""
+    from dynamo_tpu.llm.engines.kv_routed import KvRoutedEngine
+    from dynamo_tpu.llm.kv_router.protocols import KV_EVENTS_SUBJECT
+    from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+    from dynamo_tpu.llm.protocols.annotated import encode_annotated_json
+    from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+    from dynamo_tpu.runtime.distributed import DistributedRuntime, Endpoint
+    from dynamo_tpu.runtime.server import DiscoveryServer
+
+    PATH = "dyn://kvns/meshworker/generate"
+    srv = DiscoveryServer(host="127.0.0.1")
+    await srv.start()
+
+    async def start_worker(rt, devices):
+        endpoint = Endpoint.parse_path(rt, PATH)
+        component = rt.namespace(endpoint.namespace).component(
+            endpoint.component)
+        lease = await rt.primary_lease()
+
+        async def sink(ev):
+            await component.publish_event(KV_EVENTS_SUBJECT, ev)
+
+        # publisher BEFORE the core: EngineCore's constructor wires
+        # pool.on_stored/on_removed itself, so no block-store can slip in
+        # between construction and a post-hoc hookup
+        pub = KvEventPublisher(worker_id=lease.id, sink=sink)
+        mesh = make_mesh(dp=1, tp=2, devices=devices)
+        core = make_core(mesh=mesh, kv_event_publisher=pub)
+        engine = JaxEngine(core)
+        server = await endpoint.serve(
+            engine,
+            decode_req=lambda raw: PreprocessedRequest.from_dict(
+                json.loads(raw)),
+            encode_resp=encode_annotated_json,
+            stats_handler=lambda: core.metrics().to_dict(),
+            stats_interval=0.2)
+        return core, server, lease.id
+
+    import jax
+    devs = jax.devices()
+    rt_router = await DistributedRuntime.connect(srv.address)
+    rt1 = await DistributedRuntime.connect(srv.address)
+    rt2 = await DistributedRuntime.connect(srv.address)
+    core1, srv1, wid1 = await start_worker(rt1, devs[0:2])
+    core2, srv2, wid2 = await start_worker(rt2, devs[2:4])
+    engine = None
+
+    async def wait_for(pred, timeout=15.0, what=""):
+        # pure-read waits only: router.schedule() is a stateful DECISION
+        # (optimistic slot/load accounting) — polling it as a probe marks
+        # tiny workers full and skews the next real pick
+        for _ in range(int(timeout / 0.1)):
+            if pred():
+                return
+            await asyncio.sleep(0.1)
+        raise AssertionError(f"timeout waiting for {what}")
+
+    try:
+        endpoint = Endpoint.parse_path(rt_router, PATH)
+        engine = await KvRoutedEngine.start(endpoint, block_size=8,
+                                            scrape_interval=0.2)
+        await engine.client.wait_for_instances(15)
+        await wait_for(
+            lambda: len(engine.router.scheduler.endpoints) == 2,
+            what="metrics from both workers")
+
+        out1 = await collect_tokens(await engine.generate(
+            token_request(long_prompt, "first")))
+        assert len(out1) == 8
+        served_first = core1 if core1.total_prefill_tokens else core2
+        owner = wid1 if served_first is core1 else wid2
+        other_core = core2 if served_first is core1 else core1
+
+        # stored events reach the radix index (pure query, no side effects)
+        await wait_for(
+            lambda: engine.router.indexer.find_matches_for_request(
+                long_prompt).scores.get(owner, 0) > 0,
+            what="owner's blocks in the radix index")
+
+        # balance the fleet: a DIFFERENT prompt fills the other worker, so
+        # the scheduler's load-balance term stops dominating and cache
+        # affinity decides (single-request fleets legitimately route for
+        # balance — the sticky-routing contract is about comparable loads)
+        rng = np.random.default_rng(99)
+        other_prompt = [int(t) for t in rng.integers(2, 120, size=40)]
+        await collect_tokens(await engine.generate(
+            token_request(other_prompt, "fill")))
+        assert other_core.total_prefill_tokens > 0, (
+            "balancing prompt landed on the owner — loads were already "
+            "skewed; test premise broken")
+        await wait_for(
+            lambda: len(engine.router.indexer.find_matches_for_request(
+                other_prompt).scores) > 0,
+            what="other worker's blocks in the index")
+        await asyncio.sleep(0.5)     # a fresh scrape clears optimistic state
+
+        # the sticky-routing assertion is END-TO-END: the second request
+        # must land on the owner (decode counters move there and nowhere
+        # else) — not a schedule() probe, which is itself a stateful
+        # decision and would charge optimistic load right before the real
+        # pick
+        owner_decode0 = served_first.total_decode_tokens
+        other_decode0 = other_core.total_decode_tokens
+        out2 = await collect_tokens(await engine.generate(
+            token_request(long_prompt, "second")))
+        assert out2 == out1                      # prefix hit, same stream
+        assert served_first.total_decode_tokens > owner_decode0, (
+            "repeat prompt did not route to the prefix owner")
+        assert other_core.total_decode_tokens == other_decode0, (
+            "repeat prompt leaked to the non-owner")
+    finally:
+        if engine is not None:
+            await engine.close()
+        await srv1.stop()
+        await srv2.stop()
+        await core1.stop()
+        await core2.stop()
+        for rt in (rt_router, rt1, rt2):
+            await rt.shutdown()
+        await srv.close()

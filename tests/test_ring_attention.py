@@ -124,10 +124,16 @@ async def test_engine_serving_over_tp_sp_mesh():
                                           sp_min_prefill_tokens=1),
                        attn_impl="xla", param_dtype=jnp.float32, mesh=mesh)
     assert core2._prefill_sp_jit is not None
+    # count sp dispatches so the test can't silently take plain prefill
+    sp_calls = []
+    orig_sp = core2._prefill_sp_jit
+    core2._prefill_sp_jit = lambda *a, **kw: (sp_calls.append(1),
+                                              orig_sp(*a, **kw))[1]
     try:
         stream = await JaxEngine(core2).generate(_request(prompt, "sp"))
         got = [t async for a in stream if a.data is not None
                for t in a.data.token_ids]
+        assert sp_calls, "sp ring prefill never engaged"
         assert got == want
     finally:
         await core2.stop()
