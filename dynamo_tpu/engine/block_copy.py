@@ -31,7 +31,8 @@ KVCache = Dict[str, jax.Array]
 
 __all__ = ["gather_blocks", "scatter_blocks", "gather_blocks_dispatch",
            "gather_blocks_to_host", "scatter_blocks_from_host",
-           "to_wire_format", "from_wire_format", "fetch_wire"]
+           "prep_host_values", "to_wire_format", "from_wire_format",
+           "fetch_wire"]
 
 
 @functools.partial(jax.jit, static_argnames=("block_size",))
@@ -120,23 +121,34 @@ def gather_blocks_to_host(kv: KVCache, block_ids, block_size: int,
     return fetch_wire(stacked, len(block_ids), num_heads)
 
 
-def scatter_blocks_from_host(kv: KVCache, block_ids, host_values: dict,
-                             block_size: int) -> KVCache:
-    """TPU-VM DRAM -> device: one transfer, then an on-device scatter into
-    the paged pool. ``host_values`` is wire format [L, H, n, bs, D]; returns
-    the new (donated-in-place) cache.
+def prep_host_values(block_ids, host_values: dict) -> tuple:
+    """The pure-numpy half of a host→device block scatter: wire→block-major
+    transposes + pow2 padding. Returns (ids int32 [n_padded], values
+    {"k": [L, n_padded, bs, H*D]}). Safe to run OFF the loop thread —
+    async onboarding does (llm/kv/offload.py), so admission never stalls
+    on these copies.
 
     Padding targets the trash block (id 0), whose content is never read."""
     n = len(block_ids)
     pad = _pad_pow2(n) - n
-    padded = list(block_ids) + [0] * pad
-    ids = jnp.asarray(np.asarray(padded, dtype=np.int32))
-    dev_vals = {}
+    ids = np.asarray(list(block_ids) + [0] * pad, dtype=np.int32)
+    out = {}
     for k, v in host_values.items():
         v = from_wire_format(np.asarray(v))
         if pad:
             v = np.concatenate(
                 [v, np.zeros((v.shape[0], pad) + v.shape[2:], v.dtype)],
                 axis=1)
-        dev_vals[k] = jnp.asarray(v)
-    return scatter_blocks(kv, ids, dev_vals, block_size)
+        out[k] = v
+    return ids, out
+
+
+def scatter_blocks_from_host(kv: KVCache, block_ids, host_values: dict,
+                             block_size: int) -> KVCache:
+    """TPU-VM DRAM -> device: one transfer, then an on-device scatter into
+    the paged pool. ``host_values`` is wire format [L, H, n, bs, D]; returns
+    the new (donated-in-place) cache."""
+    ids, vals = prep_host_values(block_ids, host_values)
+    return scatter_blocks(kv, jnp.asarray(ids),
+                          {k: jnp.asarray(v) for k, v in vals.items()},
+                          block_size)

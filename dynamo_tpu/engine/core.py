@@ -181,6 +181,8 @@ class EngineCore:
         self.recorder = None
         self._pending: Optional[dict] = None   # un-harvested decode dispatch
         self._admissions: List[tuple] = []     # (req, tok_dev, logprob_dev)
+        self._onboards: List[tuple] = []       # (req, slot, plan, prepped)
+        self._onboard_tasks: set = set()
         self._handoff_tasks: set = set()
         self.waiting: asyncio.Queue[EngineRequest] = asyncio.Queue()
         self._work_event = asyncio.Event()
@@ -203,6 +205,7 @@ class EngineCore:
         self.total_decode_tokens = 0
         self.preemptions = 0
         self.lane_admissions = 0
+        self.host_onboards = 0
 
     # ------------------------------------------------------------------ jit
     def _compile_jits(self) -> None:
@@ -306,6 +309,17 @@ class EngineCore:
             self._loop_task = None
         if self._admissions:              # finish deferred admissions
             self._complete_admissions()
+        if self._onboard_tasks:           # in-flight onboard preps
+            for t in list(self._onboard_tasks):
+                t.cancel()
+            await asyncio.gather(*list(self._onboard_tasks),
+                                 return_exceptions=True)
+        if self._onboards:                # release reserved onboard blocks
+            for req, slot, plan, _prepped in self._onboards:
+                self.slots[slot] = None
+                self.kv_manager.pool.release(plan.all_blocks)
+                self._finish_request(req, FinishReason.CANCELLED)
+            self._onboards = []
         if self._pending is not None:     # drain the pipelined dispatch
             self._harvest(self._pending)
             self._pending = None
@@ -377,6 +391,10 @@ class EngineCore:
             if self._admissions:
                 self._complete_admissions()
                 progressed = True
+            # 4) host-tier onboards whose off-thread prep finished
+            if self._onboards:
+                self._complete_onboards()
+                progressed = True
             if not progressed:
                 self._work_event.clear()
                 try:
@@ -389,7 +407,6 @@ class EngineCore:
 
     # ---------------------------------------------------------------- admit
     def _try_admit(self, req: EngineRequest, slot: int) -> bool:
-        n_prompt = len(req.prompt)
         plan = self.kv_manager.prepare_prefill(req.prompt, seq=req.seq)
         if plan is None:
             return False
@@ -400,17 +417,82 @@ class EngineCore:
             self.kv_manager.pool.release(plan.all_blocks)
             self._finish_request(req, FinishReason.LENGTH)
             return True
+        if plan.host_slots:
+            # host-tier hits: the wire→block-major copies are pure numpy —
+            # run them OFF the loop (reference overlaps its tier copies
+            # with compute via CopyStream, kv/layer.rs; our analog is a
+            # thread + deferred admission) and finish admitting when ready
+            self._start_onboard(req, slot, plan)
+            return True
+        return self._admit_with_plan(req, slot, plan, None)
+
+    def _start_onboard(self, req: EngineRequest, slot: int, plan) -> None:
+        """Reserve the slot, then prepare the host-tier values off-thread;
+        the loop's onboard step completes the admission (the decode batch
+        keeps stepping during the copies)."""
+        req.slot = slot
+        req.ready = False
+        self.slots[slot] = req            # reserve (skipped by dispatch)
+        self.host_onboards += 1
+        host_pool = self.kv_manager.host_pool
+        host_pool.pin(plan.host_slots)    # offload stores must not evict
+
+        async def prepare() -> None:
+            prepped = None
+            try:
+                targets = plan.new_blocks[:len(plan.host_slots)]
+
+                def prep():
+                    from .block_copy import prep_host_values
+                    return prep_host_values(targets,
+                                            host_pool.fetch(plan.host_slots))
+
+                prepped = await asyncio.to_thread(prep)
+            except asyncio.CancelledError:
+                raise      # stop(): finally below records the dead onboard
+            except Exception:  # noqa: BLE001
+                logger.exception("host-tier onboard prep failed for %s",
+                                 req.rid)
+            finally:
+                host_pool.unpin(plan.host_slots)
+                self._onboards.append((req, slot, plan, prepped))
+                self._work_event.set()
+
+        task = asyncio.get_running_loop().create_task(
+            prepare(), name=f"kv-onboard-{req.rid}")
+        self._onboard_tasks.add(task)
+        task.add_done_callback(self._onboard_tasks.discard)
+
+    def _complete_onboards(self) -> None:
+        pending, self._onboards = self._onboards, []
+        for req, slot, plan, prepped in pending:
+            self.slots[slot] = None       # _admit_with_plan re-reserves
+            if req.cancelled or prepped is None:
+                self.kv_manager.pool.release(plan.all_blocks)
+                self._finish_request(
+                    req, FinishReason.CANCELLED if req.cancelled
+                    else FinishReason.ERROR)
+                continue
+            self._admit_with_plan(req, slot, plan, prepped)
+
+    def _admit_with_plan(self, req: EngineRequest, slot: int, plan,
+                         onboard) -> bool:
+        n_prompt = len(req.prompt)
         req.slot = slot
         req.blocks = plan.all_blocks
         req.seq = plan.seq
-        # host-tier hits: copy offloaded blocks up into their device slots
-        # before prefill (reference prepare_prefill_offload; the +40% TTFT
-        # multi-turn win, docs/architecture.md:91)
+        # host-tier hits: scatter the prepared (block-major, padded) values
+        # into their device slots before prefill (reference
+        # prepare_prefill_offload; the +40% TTFT multi-turn win,
+        # docs/architecture.md:91)
         if plan.host_slots:
+            from .block_copy import scatter_blocks
+            ids, vals = onboard
+            self.kv = scatter_blocks(
+                self.kv, jnp.asarray(ids),
+                {k: jnp.asarray(v) for k, v in vals.items()},
+                self.cfg.kv_block_size)
             targets = plan.new_blocks[:len(plan.host_slots)]
-            values = self.kv_manager.host_pool.fetch(plan.host_slots)
-            self.kv = scatter_blocks_from_host(
-                self.kv, targets, values, self.cfg.kv_block_size)
             # onboarded blocks now hold valid registered content
             n_dev = len(plan.hit_blocks)
             for i, bid in enumerate(targets):

@@ -57,14 +57,24 @@ class JaxEngine(AsyncEngine):
 
     def stream_response(self, req: EngineRequest,
                         request: SingleIn) -> ManyOut:
+        from ...runtime.tracing import current_trace
+        trace = current_trace()
+
         async def stream() -> AsyncIterator[Annotated[BackendOutput]]:
+            first = True
             while True:
                 item, payload = await req.out_queue.get()
                 if item is FINISH_SENTINEL:
                     reason: FinishReason = payload
+                    if trace is not None:
+                        trace.event("engine.finish", reason=str(reason))
                     yield Annotated.from_data(BackendOutput.final(reason))
                     return
                 token, logprob = item, payload
+                if first:
+                    first = False
+                    if trace is not None:   # TTFT marker on the trace
+                        trace.event("engine.first_token")
                 yield Annotated.from_data(BackendOutput(
                     token_ids=[token], log_probs=[logprob],
                     cum_log_probs=None))

@@ -23,6 +23,7 @@ from typing import Dict, Optional
 from aiohttp import web
 
 from ...runtime.engine import AsyncEngine, Context, EngineContext
+from ...runtime.tracing import Trace, span, use_trace
 from ..protocols.annotated import Annotated
 from ..protocols.openai import (aggregate_chat_stream,
                                 aggregate_completion_stream)
@@ -109,6 +110,7 @@ class HttpService:
         self.app.router.add_get("/metrics", self._metrics)
         self.app.router.add_get("/health", self._health)
         self.app.router.add_get("/live", self._health)
+        self.app.router.add_get("/traces", self._traces)
         self._runner: Optional[web.AppRunner] = None
         self._site: Optional[web.TCPSite] = None
 
@@ -142,6 +144,15 @@ class HttpService:
     async def _health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "healthy",
                                   "models": self.manager.list_models()})
+
+    async def _traces(self, request: web.Request) -> web.Response:
+        """Recent per-request traces (debug): stage latencies keyed by
+        request id; ?request_id= filters to one request."""
+        from ...runtime.tracing import tracer
+        rid = request.query.get("request_id")
+        data = tracer.find(rid) if rid else tracer.recent()
+        return web.json_response({"traces": data,
+                                  "completed": tracer.completed})
 
     async def _models(self, request: web.Request) -> web.Response:
         now = int(time.time())
@@ -180,22 +191,30 @@ class HttpService:
         streaming = bool(body.get("stream", False))
         guard = self.metrics.inflight_guard(model, endpoint, streaming)
         ectx = EngineContext()
-        try:
-            stream = await engine.generate(Context(body, ectx))
-        except ValueError as e:
-            guard.close()
-            return _error_response(400, str(e))
-        except Exception as e:  # noqa: BLE001 — engine boundary
-            logger.exception("engine error on %s", endpoint)
-            guard.close()
-            return _error_response(500, f"engine error: {e}", "internal_error")
+        # per-request trace (reference egress/push.rs:134-151): stage
+        # latencies from HTTP ingress through dispatch to last byte, keyed
+        # by the request id the control plane already carries everywhere
+        with use_trace(Trace(ectx.id, role="frontend")):
+            with span("dispatch", model=model, endpoint=endpoint):
+                try:
+                    stream = await engine.generate(Context(body, ectx))
+                except ValueError as e:
+                    guard.close()
+                    return _error_response(400, str(e))
+                except Exception as e:  # noqa: BLE001 — engine boundary
+                    logger.exception("engine error on %s", endpoint)
+                    guard.close()
+                    return _error_response(
+                        500, f"engine error: {e}", "internal_error")
 
-        if streaming:
-            include_usage = bool((body.get("stream_options") or {})
-                                 .get("include_usage"))
-            return await self._stream_sse(request, stream, ectx, guard,
-                                          include_usage)
-        return await self._unary(stream, ectx, guard, is_chat)
+            if streaming:
+                include_usage = bool((body.get("stream_options") or {})
+                                     .get("include_usage"))
+                with span("stream"):
+                    return await self._stream_sse(request, stream, ectx,
+                                                  guard, include_usage)
+            with span("aggregate"):
+                return await self._unary(stream, ectx, guard, is_chat)
 
     async def _unary(self, stream, ectx: EngineContext, guard,
                      is_chat: bool) -> web.Response:

@@ -51,6 +51,7 @@ class HostKvPool:
         self._free: List[int] = list(range(capacity_blocks - 1, -1, -1))
         self._by_hash: Dict[int, int] = {}       # seq_hash → slot
         self._lru: Dict[int, None] = {}          # seq_hash → (ordered dict)
+        self._pins: Dict[int, int] = {}          # slot → pin count
         # stats
         self.stored_blocks_total = 0
         self.evicted_blocks_total = 0
@@ -72,9 +73,10 @@ class HostKvPool:
             self._lru[seq_hash] = None
             return slot
         if not self._free:
-            if not self._lru:
+            victim = next((h for h in self._lru
+                           if not self._pins.get(self._by_hash[h])), None)
+            if victim is None:       # empty, or everything pinned mid-fetch
                 return None
-            victim = next(iter(self._lru))
             self._lru.pop(victim)
             self._free.append(self._by_hash.pop(victim))
             self.evicted_blocks_total += 1
@@ -119,6 +121,21 @@ class HostKvPool:
                     self._arena["k"][idx].transpose(1, 2, 0, 3, 4)),
                 "v": np.ascontiguousarray(
                     self._arena["v"][idx].transpose(1, 2, 0, 3, 4))}
+
+    def pin(self, slots: Sequence[int]) -> None:
+        """Exclude ``slots`` from LRU eviction while an async onboarding
+        fetch reads them off the loop thread (the offload pump's stores
+        could otherwise evict+reuse an arena row mid-copy)."""
+        for s in slots:
+            self._pins[s] = self._pins.get(s, 0) + 1
+
+    def unpin(self, slots: Sequence[int]) -> None:
+        for s in slots:
+            n = self._pins.get(s, 0) - 1
+            if n <= 0:
+                self._pins.pop(s, None)
+            else:
+                self._pins[s] = n
 
     def contains(self, seq_hash: int) -> bool:
         return seq_hash in self._by_hash

@@ -45,6 +45,14 @@ def _strftime_now(fmt: str) -> str:
     return datetime.datetime.now().strftime(fmt)
 
 
+def _tojson(value, ensure_ascii: bool = False, indent=None, separators=None,
+            sort_keys: bool = False) -> str:
+    """transformers' chat-template tojson (plain json.dumps)."""
+    import json
+    return json.dumps(value, ensure_ascii=ensure_ascii, indent=indent,
+                      separators=separators, sort_keys=sort_keys)
+
+
 class PromptFormatter:
     """HF chat-template renderer (reference template/oai.rs + formatters.rs)."""
 
@@ -56,7 +64,10 @@ class PromptFormatter:
             extensions=["jinja2.ext.loopcontrols"])
         env.globals["raise_exception"] = self._raise
         env.globals["strftime_now"] = _strftime_now
-        env.filters["tojson"] = lambda v, **kw: jinja2.filters.do_tojson(v, **kw)
+        # HF's renderer uses plain json.dumps, NOT jinja's HTML-escaping
+        # tojson — tool schemas with &, <, > must render identically to
+        # apply_chat_template (tests/test_chat_template_conformance.py)
+        env.filters["tojson"] = _tojson
         self._env = env
         self._template = env.from_string(template or _FALLBACK_TEMPLATE)
         self.bos_token = bos_token
@@ -179,16 +190,18 @@ class OpenAIPreprocessor(Operator):
 
     # ------------------------------------------------------------- operator
     async def generate(self, request: SingleIn, next_engine: AsyncEngine) -> ManyOut:
+        from ..runtime.tracing import span
         req = request.data
         if isinstance(req, dict):
             req = (ChatCompletionRequest.model_validate(req)
                    if "messages" in req else CompletionRequest.model_validate(req))
         is_chat = isinstance(req, ChatCompletionRequest)
-        if is_chat:
-            pre, formatted_prompt = self._preprocess_chat(req)
-        else:
-            pre = self.preprocess_completion(req)
-            formatted_prompt = None
+        with span("preprocess", chat=is_chat):
+            if is_chat:
+                pre, formatted_prompt = self._preprocess_chat(req)
+            else:
+                pre = self.preprocess_completion(req)
+                formatted_prompt = None
         prompt_len = len(pre.token_ids)
         annotations: List[Annotated] = []
         if ANNOTATION_TOKEN_IDS in pre.annotations:

@@ -103,7 +103,9 @@ class WorkQueue(abc.ABC):
 
 class MessageBus(abc.ABC):
     @abc.abstractmethod
-    async def publish(self, subject: str, payload: bytes) -> None: ...
+    async def publish(self, subject: str, payload: bytes) -> int:
+        """Returns how many receivers got the message (0 = no responders,
+        the NATS-style signal the request plane retries on)."""
 
     @abc.abstractmethod
     async def subscribe(self, pattern: str) -> Subscription: ...
@@ -191,14 +193,22 @@ class MemoryBus(MessageBus):
         self._servers: Dict[str, Subscription] = {}
         self._queues: Dict[str, _MemoryWorkQueue] = {}
 
-    async def publish(self, subject: str, payload: bytes) -> None:
+    async def publish(self, subject: str, payload: bytes) -> int:
+        """Returns the receiver count — 0 is NATS's "no responders"
+        signal; the request plane (Client.direct) retries on it so a
+        request published while its server's subscription is being
+        re-established (daemon restart) is never silently dropped."""
         msg = BusMessage(subject, payload)
+        n = 0
         srv = self._servers.get(subject)
         if srv is not None:
             srv._push(msg)
+            n += 1
         for sub in list(self._subs):
             if sub.pattern == subject or fnmatch.fnmatchcase(subject, sub.pattern):
                 sub._push(msg)
+                n += 1
+        return n
 
     async def subscribe(self, pattern: str) -> Subscription:
         sub = Subscription(pattern, self._unsub)

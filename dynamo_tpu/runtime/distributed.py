@@ -356,33 +356,44 @@ class EndpointServer:
                 await sender.finish()
             return
         from .engine import EngineContext
+        from .tracing import Trace, span, use_trace
         ctx = Context(request, ctx=EngineContext(ctrl.id))
-        try:
-            stream = await self.engine.generate(ctx)
-        except Exception as e:
-            logger.exception("engine rejected request %s", ctrl.id)
-            if info is not None:
-                sender = await open_stream_sender(info, error=str(e))
-                await sender.finish()
-            return
-        if info is None:
-            async for _ in stream:   # fire-and-forget request type
-                pass
-            return
-        sender = await open_stream_sender(info)
-        sender.on_stop = ctx.ctx.stop_generating
-        sender.on_kill = ctx.ctx.kill
-        try:
-            async for item in stream:
-                if sender.killed:
-                    break
-                await sender.send(self.encode_resp(item))
-            await sender.finish()
-        except (ConnectionError, OSError):
-            ctx.ctx.kill()
-        except Exception as e:
-            logger.exception("stream failed for %s", ctrl.id)
-            await sender.finish(error=str(e))
+        # worker-side trace under the SAME request id the frontend logged
+        # (ingress prologue → engine → first frame → stream end)
+        with use_trace(Trace(ctrl.id, role="worker")) as trace:
+            with span("engine.accept"):
+                try:
+                    stream = await self.engine.generate(ctx)
+                except Exception as e:
+                    logger.exception("engine rejected request %s", ctrl.id)
+                    if info is not None:
+                        sender = await open_stream_sender(info, error=str(e))
+                        await sender.finish()
+                    return
+            if info is None:
+                async for _ in stream:   # fire-and-forget request type
+                    pass
+                return
+            with span("dial_back"):
+                sender = await open_stream_sender(info)
+            sender.on_stop = ctx.ctx.stop_generating
+            sender.on_kill = ctx.ctx.kill
+            try:
+                with span("respond") as resp_span:
+                    first = True
+                    async for item in stream:
+                        if sender.killed:
+                            break
+                        await sender.send(self.encode_resp(item))
+                        if first:
+                            first = False
+                            trace.event("first_response")
+                    await sender.finish()
+            except (ConnectionError, OSError):
+                ctx.ctx.kill()
+            except Exception as e:
+                logger.exception("stream failed for %s", ctrl.id)
+                await sender.finish(error=str(e))
 
     async def _stats_loop(self) -> None:
         rt = self.endpoint.runtime
@@ -406,10 +417,22 @@ class EndpointServer:
         for t in list(self._inflight):
             t.cancel()
         if self.lease is not None:
-            await rt.bus.unserve(self.endpoint.subject(self.lease.id))
-            await rt.store.kv_delete(self.endpoint.discovery_key(self.lease.id))
-            if self._stats_task is not None:
-                await rt.store.kv_delete(self.endpoint.stats_key(self.lease.id))
+            # best-effort, bounded deregistration: if the daemon is gone,
+            # lease expiry cleans these up anyway — shutdown must never
+            # hang in the netstore reconnect window
+            try:
+                async with asyncio.timeout(2.0):
+                    await rt.bus.unserve(
+                        self.endpoint.subject(self.lease.id))
+                    await rt.store.kv_delete(
+                        self.endpoint.discovery_key(self.lease.id))
+                    if self._stats_task is not None:
+                        await rt.store.kv_delete(
+                            self.endpoint.stats_key(self.lease.id))
+            except (TimeoutError, ConnectionError, OSError):
+                logger.warning("endpoint %s deregistration skipped (daemon "
+                               "unreachable); lease expiry will clean up",
+                               self.endpoint.path)
         if self in rt._servers:
             rt._servers.remove(self)
 
@@ -541,12 +564,14 @@ class Client(AsyncEngine):
         rt = self.endpoint.runtime
         ctx = request if isinstance(request, Context) else Context(request)
         rx = rt.tcp.register()
-        conn = rt.tcp.connection_info(rx)
-        ctrl = RequestControlMessage(id=ctx.id, connection_info=conn)
-        payload = encode_two_part(ctrl, self.encode_req(ctx.data))
         try:
-            await rt.bus.publish(info.subject, payload)
-            prologue = await rx.wait_connected()
+            # egress span (reference egress/push.rs:134-151): publish +
+            # dial-back wait, tagged with the target instance
+            from .tracing import span as _span
+            with _span("egress", instance=f"{instance_id:x}",
+                       path=self.endpoint.path):
+                rx, prologue = await self._dispatch_with_retry(
+                    rt, rx, ctx, info, instance_id)
         except Exception:
             rt.tcp.unregister(rx.stream_id)
             raise
@@ -554,6 +579,59 @@ class Client(AsyncEngine):
             rt.tcp.unregister(rx.stream_id)
             raise RuntimeError(f"remote rejected request: {prologue.error}")
         return _RemoteStream(ctx.ctx, rx, self.decode_resp, rt.tcp)
+
+    DIAL_BACK_TIMEOUT = 10.0
+    DISPATCH_ATTEMPTS = 3
+
+    async def _dispatch_with_retry(self, rt, rx, ctx, info, instance_id):
+        """Publish the two-part request and await the worker's dial-back,
+        retrying the failure modes a daemon restart creates:
+
+        - publish reaches ZERO receivers (the worker's serve subscription
+          is mid-re-establishment) — NATS "no responders" semantics;
+        - publish reached a receiver that died before dialing back (the
+          message sat in a killed session's queue) — dial-back timeout,
+          re-dispatch on a fresh stream.
+
+        Re-dispatch is at-least-once: a slow-but-alive worker could end up
+        serving the request twice, with the client consuming only the last
+        stream — the same contract as the reference's NATS request plane."""
+        loop = asyncio.get_running_loop()
+        last_err: Exception = RuntimeError("dispatch failed")
+        for attempt in range(self.DISPATCH_ATTEMPTS):
+            conn = rt.tcp.connection_info(rx)
+            ctrl = RequestControlMessage(id=ctx.id, connection_info=conn)
+            payload = encode_two_part(ctrl, self.encode_req(ctx.data))
+            deadline = loop.time() + self.DIAL_BACK_TIMEOUT
+            delay = 0.05
+            try:
+                while True:   # no-responders backoff within this attempt
+                    n = await rt.bus.publish(info.subject, payload)
+                    if n is None or n > 0:  # None: bus without counts
+                        break
+                    if loop.time() >= deadline:
+                        raise RuntimeError(
+                            f"no responders on {info.subject} "
+                            f"(instance {instance_id:x})")
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 0.5)
+                prologue = await rx.wait_connected(
+                    timeout=max(deadline - loop.time(), 1.0))
+                return rx, prologue
+            except (TimeoutError, asyncio.TimeoutError, RuntimeError) as e:
+                last_err = e
+                if attempt + 1 >= self.DISPATCH_ATTEMPTS:
+                    # the caller's cleanup unregisters ITS original rx —
+                    # the retry streams registered here must not leak
+                    # (unregister is idempotent, double-pop is fine)
+                    rt.tcp.unregister(rx.stream_id)
+                    raise
+                logger.warning(
+                    "dispatch to %s attempt %d failed (%s); retrying on a "
+                    "fresh stream", self.endpoint.path, attempt + 1, e)
+                rt.tcp.unregister(rx.stream_id)
+                rx = rt.tcp.register()
+        raise last_err
 
     # -------------------------------------------------------------- scrape
     async def collect_stats(self) -> Dict[int, Any]:

@@ -292,9 +292,20 @@ class MemoryKvStore(KvStore):
         self._watchers = [(p, w) for p, w in self._watchers if w is not watcher]
 
     # ------------------------------------------------------------- leases
-    async def lease_create(self, ttl: float) -> Lease:
-        lid = self._next_lease
-        self._next_lease += 1
+    async def lease_create(self, ttl: float, want_id: int = 0) -> Lease:
+        """``want_id``: reclaim a specific id after a store restart (the
+        worker's identity — subjects, discovery keys — is the lease id, so
+        reconnection must be able to keep it; etcd grants ids the same
+        way via LeaseGrant with a client-chosen ID). Raises if taken."""
+        self._expire_due()
+        if want_id:
+            if want_id in self._leases:
+                raise RuntimeError(f"lease id {want_id:#x} already held")
+            lid = want_id
+            self._next_lease = max(self._next_lease, want_id + 1)
+        else:
+            lid = self._next_lease
+            self._next_lease += 1
         self._leases[lid] = self._now() + ttl
         self._lease_ttl[lid] = ttl
         self._ensure_reaper()

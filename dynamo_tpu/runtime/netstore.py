@@ -32,34 +32,60 @@ def _unb64(s: str) -> bytes:
 
 
 class _Conn:
-    """One multiplexed daemon connection: request/reply + push routing."""
+    """One multiplexed daemon connection: request/reply + push routing,
+    with transparent reconnection.
 
-    def __init__(self, reader: asyncio.StreamReader,
-                 writer: asyncio.StreamWriter):
-        self.reader = reader
-        self.writer = writer
+    Liveness contract (reference: transports/etcd/lease.rs — clients ride
+    out etcd leader changes): if the daemon dies and comes back at the
+    same address within RETRY_WINDOW, every pending/new call retries, and
+    registered watches/subscriptions/served subjects are re-established on
+    the fresh connection under their original client-allocated ids (the
+    push-routing tables keep working untouched). Re-established prefix
+    watches replay the server's CURRENT keys as synthetic PUTs — consumers
+    are keyed/idempotent, so duplicates are harmless; keys whose owners
+    died during the outage simply never reappear. Lease identity recovery
+    lives in NetKvStore.lease_refresh (reclaim-by-id + leased-key replay).
+    """
+
+    RETRY_WINDOW = 30.0
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.reader: Optional[asyncio.StreamReader] = None
+        self.writer: Optional[asyncio.StreamWriter] = None
         self._next_rid = 1
         self._pending: Dict[int, asyncio.Future] = {}
         self._push_watch: Dict[int, PrefixWatcher] = {}
         self._push_sub: Dict[int, Subscription] = {}
+        # replay registries: wid → prefix; sid → (op, kwargs)
+        self._watch_reg: Dict[int, str] = {}
+        self._sub_reg: Dict[int, tuple] = {}
         self._reader_task: Optional[asyncio.Task] = None
         self._write_lock = asyncio.Lock()
-        self.closed = False
+        self._conn_lock = asyncio.Lock()
+        self._connected = False
+        self.closed = False            # permanent, client-initiated
+        self.reconnects = 0
 
     @classmethod
     async def open(cls, addr: str, timeout: float = 10.0) -> "_Conn":
-        host, port = addr.rsplit(":", 1)
-        reader, writer = await asyncio.wait_for(
-            asyncio.open_connection(host, int(port)), timeout)
-        conn = cls(reader, writer)
-        conn._reader_task = asyncio.get_running_loop().create_task(
-            conn._read_loop(), name="netstore-demux")
+        conn = cls(addr)
+        await conn._establish(timeout)   # initial connect fails fast
         return conn
 
-    async def _read_loop(self) -> None:
+    async def _establish(self, timeout: float = 5.0) -> None:
+        host, port = self.addr.rsplit(":", 1)
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, int(port)), timeout)
+        self.reader, self.writer = reader, writer
+        self._connected = True
+        self._reader_task = asyncio.get_running_loop().create_task(
+            self._read_loop(reader), name="netstore-demux")
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
         try:
             while True:
-                msg = await recv_msg(self.reader)
+                msg = await recv_msg(reader)
                 if msg is None:
                     break
                 if "push" in msg:
@@ -71,11 +97,29 @@ class _Conn:
         except (ConnectionError, ValueError):
             pass
         finally:
-            self.closed = True
-            for fut in self._pending.values():
-                if not fut.done():
-                    fut.set_exception(ConnectionError("daemon connection lost"))
-            self._pending.clear()
+            if reader is self.reader:    # a stale loop must not clobber a
+                self._connected = False  # newer connection's state —
+                # including the pending futures: if a NEWER connection is
+                # already up, those futures belong to IT (replay calls);
+                # failing them here would abort the replay silently
+                for fut in self._pending.values():
+                    if not fut.done():
+                        fut.set_exception(
+                            ConnectionError("daemon connection lost"))
+                self._pending.clear()
+                if not self.closed and (self._watch_reg or self._sub_reg):
+                    # push consumers (watches/subscriptions) make no calls
+                    # of their own — reconnect eagerly on their behalf
+                    asyncio.get_running_loop().create_task(
+                        self._auto_reconnect(), name="netstore-reconnect")
+
+    async def _auto_reconnect(self) -> None:
+        try:
+            await self._ensure_connected()
+        except ConnectionError:
+            logger.warning("auto-reconnect to %s gave up after %.0fs; "
+                           "watch/subscription streams stay dark until the "
+                           "next call", self.addr, self.RETRY_WINDOW)
 
     def _route_push(self, msg: dict) -> None:
         if msg["push"] == "watch":
@@ -90,50 +134,112 @@ class _Conn:
             if s is not None:
                 s._push(BusMessage(msg["subject"], _unb64(msg["payload"])))
 
-    async def call(self, op: str, **kwargs) -> dict:
+    async def _ensure_connected(self) -> None:
         if self.closed:
-            raise ConnectionError("daemon connection lost")
+            raise ConnectionError("connection closed")
+        if self._connected:
+            return
+        async with self._conn_lock:
+            if self._connected or self.closed:
+                return
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + self.RETRY_WINDOW
+            delay = 0.05
+            while True:
+                try:
+                    await self._establish()
+                    break
+                except (OSError, asyncio.TimeoutError):
+                    if self.closed or loop.time() + delay > deadline:
+                        raise ConnectionError(
+                            f"daemon unreachable at {self.addr}")
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, 1.0)
+            self.reconnects += 1
+            logger.info("reconnected to daemon %s (attempt %d); replaying "
+                        "%d watches, %d subscriptions", self.addr,
+                        self.reconnects, len(self._watch_reg),
+                        len(self._sub_reg))
+            for wid, prefix in list(self._watch_reg.items()):
+                await self._call_once("watch_prefix", prefix=prefix, wid=wid)
+            for sid, (op, kw) in list(self._sub_reg.items()):
+                await self._call_once(op, sid=sid, **kw)
+
+    async def _call_once(self, op: str, **kwargs) -> dict:
         rid = self._next_rid
         self._next_rid += 1
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
-        async with self._write_lock:
-            await send_msg(self.writer, {"rid": rid, "op": op, **kwargs})
+        try:
+            async with self._write_lock:
+                await send_msg(self.writer, {"rid": rid, "op": op, **kwargs})
+        except (OSError, ConnectionError) as e:
+            self._pending.pop(rid, None)
+            self._connected = False
+            raise ConnectionError(str(e))
         reply = await fut
         if not reply.get("ok"):
             raise RuntimeError(reply.get("error", f"{op} failed"))
         return reply
 
+    async def call(self, op: str, **kwargs) -> dict:
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.RETRY_WINDOW
+        delay = 0.05
+        while True:
+            try:
+                await self._ensure_connected()
+                return await self._call_once(op, **kwargs)
+            except ConnectionError:
+                if self.closed or loop.time() >= deadline:
+                    raise
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 1.0)
+
     async def close(self) -> None:
         self.closed = True
+        self._connected = False
         if self._reader_task is not None:
             self._reader_task.cancel()
-        if not self.writer.is_closing():
+        if self.writer is not None and not self.writer.is_closing():
             self.writer.close()
 
 
 class NetKvStore(KvStore):
     def __init__(self, conn: _Conn):
         self._conn = conn
+        # lease-identity recovery state: ttl per lease + the keys written
+        # under it, replayed after a daemon restart (lease_refresh)
+        self._lease_ttl: Dict[int, float] = {}
+        self._leased_keys: Dict[int, Dict[str, bytes]] = {}
 
     @classmethod
     async def connect(cls, addr: str) -> "NetKvStore":
         return cls(await _Conn.open(addr))
 
+    def _record(self, key: str, value: bytes, lease_id: int) -> None:
+        if lease_id:
+            self._leased_keys.setdefault(lease_id, {})[key] = value
+
     async def kv_create(self, key: str, value: bytes, lease_id: int = 0) -> bool:
         r = await self._conn.call("kv_create", key=key, value=_b64(value),
                                   lease=lease_id)
+        if r["result"]:
+            self._record(key, value, lease_id)
         return bool(r["result"])
 
     async def kv_create_or_validate(self, key: str, value: bytes,
                                     lease_id: int = 0) -> bool:
         r = await self._conn.call("kv_create_or_validate", key=key,
                                   value=_b64(value), lease=lease_id)
+        if r["result"]:
+            self._record(key, value, lease_id)
         return bool(r["result"])
 
     async def kv_put(self, key: str, value: bytes, lease_id: int = 0) -> None:
         await self._conn.call("kv_put", key=key, value=_b64(value),
                               lease=lease_id)
+        self._record(key, value, lease_id)
 
     async def kv_get(self, key: str) -> Optional[KvEntry]:
         r = await self._conn.call("kv_get", key=key)
@@ -149,6 +255,8 @@ class NetKvStore(KvStore):
 
     async def kv_delete(self, key: str) -> bool:
         r = await self._conn.call("kv_delete", key=key)
+        for keys in self._leased_keys.values():
+            keys.pop(key, None)
         return bool(r["result"])
 
     async def watch_prefix(self, prefix: str) -> PrefixWatcher:
@@ -158,16 +266,19 @@ class NetKvStore(KvStore):
 
         def unsub(_w: PrefixWatcher) -> None:
             self._conn._push_watch.pop(wid, None)
+            self._conn._watch_reg.pop(wid, None)
             if not self._conn.closed:
                 asyncio.get_running_loop().create_task(
                     self._safe_call("watch_close", wid=wid))
 
         w = PrefixWatcher(prefix, [], unsub)
         self._conn._push_watch[wid] = w
+        self._conn._watch_reg[wid] = prefix   # re-established on reconnect
         try:
             await self._conn.call("watch_prefix", prefix=prefix, wid=wid)
         except Exception:
             self._conn._push_watch.pop(wid, None)
+            self._conn._watch_reg.pop(wid, None)
             raise
         return w
 
@@ -177,15 +288,38 @@ class NetKvStore(KvStore):
         except Exception:
             pass
 
-    async def lease_create(self, ttl: float) -> Lease:
-        r = await self._conn.call("lease_create", ttl=ttl)
+    async def lease_create(self, ttl: float, want_id: int = 0) -> Lease:
+        r = await self._conn.call("lease_create", ttl=ttl, want_id=want_id)
+        self._lease_ttl[r["lease_id"]] = ttl
         return Lease(self, r["lease_id"], ttl)
 
     async def lease_refresh(self, lease_id: int) -> bool:
         r = await self._conn.call("lease_refresh", lease_id=lease_id)
-        return bool(r["result"])
+        if r["result"]:
+            return True
+        # unknown lease: either it expired (we were gone too long) or the
+        # daemon restarted with empty state. Reclaim the SAME id — it is
+        # the worker's identity (subjects, discovery keys) — and replay
+        # the keys registered under it, so routing recovers without the
+        # worker noticing (reference liveness: transports/etcd/lease.rs).
+        ttl = self._lease_ttl.get(lease_id)
+        if ttl is None:
+            return False
+        try:
+            await self._conn.call("lease_create", ttl=ttl, want_id=lease_id)
+        except RuntimeError:
+            return False       # id taken by someone else — truly lost
+        for key, value in self._leased_keys.get(lease_id, {}).items():
+            await self._conn.call("kv_put", key=key, value=_b64(value),
+                                  lease=lease_id)
+        logger.info("lease %x reclaimed after daemon restart (%d keys "
+                    "replayed)", lease_id,
+                    len(self._leased_keys.get(lease_id, {})))
+        return True
 
     async def lease_revoke(self, lease_id: int) -> None:
+        self._lease_ttl.pop(lease_id, None)
+        self._leased_keys.pop(lease_id, None)
         await self._conn.call("lease_revoke", lease_id=lease_id)
 
     async def close(self) -> None:
@@ -232,24 +366,29 @@ class NetBus(MessageBus):
     async def connect(cls, addr: str) -> "NetBus":
         return cls(await _Conn.open(addr))
 
-    async def publish(self, subject: str, payload: bytes) -> None:
-        await self._conn.call("publish", subject=subject, payload=_b64(payload))
+    async def publish(self, subject: str, payload: bytes) -> int:
+        r = await self._conn.call("publish", subject=subject,
+                                  payload=_b64(payload))
+        return int(r.get("receivers", 0))
 
     async def _make_sub(self, op: str, **kw) -> Subscription:
         sid = self._conn._next_rid + 2_000_000  # client-allocated (see watch)
 
         def unsub(_s: Subscription) -> None:
             self._conn._push_sub.pop(sid, None)
+            self._conn._sub_reg.pop(sid, None)
             if not self._conn.closed:
                 asyncio.get_running_loop().create_task(
                     self._safe_call("sub_close", sid=sid))
 
         sub = Subscription(kw.get("pattern") or kw.get("subject", ""), unsub)
         self._conn._push_sub[sid] = sub
+        self._conn._sub_reg[sid] = (op, dict(kw))  # replayed on reconnect
         try:
             await self._conn.call(op, sid=sid, **kw)
         except Exception:
             self._conn._push_sub.pop(sid, None)
+            self._conn._sub_reg.pop(sid, None)
             raise
         return sub, sid
 
@@ -263,7 +402,9 @@ class NetBus(MessageBus):
         return sub
 
     async def unserve(self, subject: str) -> None:
-        self._served.pop(subject, None)
+        sid = self._served.pop(subject, None)
+        if sid is not None:
+            self._conn._sub_reg.pop(sid, None)
         await self._conn.call("unserve", subject=subject)
 
     async def work_queue(self, name: str) -> WorkQueue:

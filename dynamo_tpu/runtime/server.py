@@ -147,7 +147,8 @@ class _ClientSession:
                     w.close()
                 await self.send({"rid": rid, "ok": True})
             elif op == "lease_create":
-                lease = await store.lease_create(msg["ttl"])
+                lease = await store.lease_create(msg["ttl"],
+                                                 want_id=msg.get("want_id", 0))
                 await self.send({"rid": rid, "ok": True, "lease_id": lease.id})
             elif op == "lease_refresh":
                 ok = await store.lease_refresh(msg["lease_id"])
@@ -156,8 +157,8 @@ class _ClientSession:
                 await store.lease_revoke(msg["lease_id"])
                 await self.send({"rid": rid, "ok": True})
             elif op == "publish":
-                await bus.publish(msg["subject"], _unb64(msg["payload"]))
-                await self.send({"rid": rid, "ok": True})
+                n = await bus.publish(msg["subject"], _unb64(msg["payload"]))
+                await self.send({"rid": rid, "ok": True, "receivers": n})
             elif op == "subscribe":
                 sid = msg["sid"]
                 sub = await bus.subscribe(msg["pattern"])
@@ -252,6 +253,7 @@ class DiscoveryServer:
         self.store = MemoryKvStore()
         self.bus = MemoryBus()
         self._server: Optional[asyncio.base_events.Server] = None
+        self._sessions: set = set()
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -260,7 +262,12 @@ class DiscoveryServer:
         logger.info("discovery/bus daemon on %s:%d", self.host, self.port)
 
     async def _on_conn(self, reader, writer) -> None:
-        await _ClientSession(self, reader, writer).run()
+        session = _ClientSession(self, reader, writer)
+        self._sessions.add(session)
+        try:
+            await session.run()
+        finally:
+            self._sessions.discard(session)
 
     @property
     def address(self) -> str:
@@ -269,6 +276,12 @@ class DiscoveryServer:
     async def close(self) -> None:
         if self._server is not None:
             self._server.close()
+            # drop live client connections too: wait_closed() (3.12+)
+            # otherwise blocks on them, and a killed daemon must look
+            # KILLED to clients (their reconnect path takes over)
+            for session in list(self._sessions):
+                if not session.writer.is_closing():
+                    session.writer.close()
             await self._server.wait_closed()
             self._server = None
         await self.store.close()

@@ -1,0 +1,134 @@
+"""Per-request tracing spans across frontend → router → worker.
+
+Reference: the request plane instruments ingress/egress with request-id
+spans (lib/runtime/src/pipeline/network/egress/push.rs:134-151 — a
+tracing span wrapping publish + dial-back, carrying the request id). The
+TPU runtime's analog is dependency-free: a per-request :class:`Trace`
+collects named spans with wall-clock durations, a process-global
+:class:`Tracer` keeps a ring buffer of recent traces and emits one
+structured log line per completed trace (request id + stage latencies),
+and a contextvar propagates the current trace through the async call
+chain so operators don't thread it explicitly.
+
+Cross-process correlation is BY REQUEST ID: the control message already
+carries it (codec.RequestControlMessage.id), so the worker side opens its
+own trace under the same id and log aggregation joins the two — the same
+scheme the reference uses (no span-context wire format).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import logging
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("dynamo_tpu.trace")
+
+__all__ = ["Span", "Trace", "Tracer", "tracer", "current_trace",
+           "use_trace", "span"]
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    start: float
+    end: float = 0.0
+    attrs: Dict[str, object] = dataclasses.field(default_factory=dict)
+
+    @property
+    def ms(self) -> float:
+        return 1e3 * (self.end - self.start)
+
+
+class Trace:
+    """All spans of one request on one process ("role" tags which side)."""
+
+    def __init__(self, request_id: str, role: str = ""):
+        self.request_id = request_id
+        self.role = role
+        self.start = time.monotonic()
+        self.spans: List[Span] = []
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        s = Span(name=name, start=time.monotonic(), attrs=attrs)
+        self.spans.append(s)
+        try:
+            yield s
+        finally:
+            s.end = time.monotonic()
+
+    def event(self, name: str, **attrs) -> None:
+        """Zero-duration marker (e.g. first_token)."""
+        t = time.monotonic()
+        self.spans.append(Span(name=name, start=t, end=t, attrs=attrs))
+
+    def to_dict(self) -> dict:
+        return {
+            "request_id": self.request_id,
+            "role": self.role,
+            "total_ms": round(1e3 * (time.monotonic() - self.start), 2),
+            "spans": [{"name": s.name, "ms": round(s.ms, 2),
+                       "at_ms": round(1e3 * (s.start - self.start), 2),
+                       **({"attrs": s.attrs} if s.attrs else {})}
+                      for s in self.spans],
+        }
+
+
+class Tracer:
+    """Process-global registry: ring buffer + per-trace log line."""
+
+    def __init__(self, keep: int = 256):
+        self._recent: deque = deque(maxlen=keep)
+        self.completed = 0
+
+    def finish(self, trace: Trace) -> None:
+        d = trace.to_dict()
+        self._recent.append(d)
+        self.completed += 1
+        logger.info("trace %s [%s] %.1fms: %s", trace.request_id,
+                    trace.role, d["total_ms"],
+                    " ".join(f"{s['name']}={s['ms']}ms" for s in d["spans"]))
+
+    def recent(self, n: int = 32) -> List[dict]:
+        return list(self._recent)[-n:]
+
+    def find(self, request_id: str) -> List[dict]:
+        return [t for t in self._recent if t["request_id"] == request_id]
+
+
+tracer = Tracer()
+
+_current: contextvars.ContextVar[Optional[Trace]] = contextvars.ContextVar(
+    "dynamo_tpu_trace", default=None)
+
+
+def current_trace() -> Optional[Trace]:
+    return _current.get()
+
+
+@contextlib.contextmanager
+def use_trace(trace: Trace, finish: bool = True):
+    """Bind `trace` as the ambient trace for the enclosed async chain."""
+    token = _current.set(trace)
+    try:
+        yield trace
+    finally:
+        _current.reset(token)
+        if finish:
+            tracer.finish(trace)
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs):
+    """Span on the ambient trace; no-op when none is bound."""
+    t = _current.get()
+    if t is None:
+        yield None
+    else:
+        with t.span(name, **attrs) as s:
+            yield s

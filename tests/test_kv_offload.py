@@ -163,4 +163,119 @@ async def test_engine_core_multi_turn_offload_onboard_equivalence():
     toks2, hit2 = await run_once()
     assert hit2 >= 8  # host-tier hit (first 2+ blocks; last is held back)
     assert toks2 == toks1  # identical continuation through onboarded KV
+    # the restore went through the ASYNC onboarding path (numpy prep
+    # off-thread, admission deferred), not a loop-blocking scatter
+    assert core.host_onboards == 1
+    await core.stop()
+
+
+async def test_onboard_overlaps_active_decode():
+    """A host-tier admission must not stall an active decode stream: A
+    decodes while B's onboard prep runs off-thread; both streams match
+    their solo runs."""
+    import jax.numpy as jnp
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineCore, EngineRequest
+    from dynamo_tpu.engine.sampling import SlotSampling
+
+    mcfg = ModelConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                       num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                       max_position_embeddings=256)
+
+    def make():
+        return EngineCore(
+            mcfg,
+            EngineConfig(max_model_len=64, kv_block_size=4, num_kv_blocks=48,
+                         max_num_seqs=2, prefill_buckets=[16, 32, 64],
+                         host_kv_blocks=16),
+            attn_impl="xla", param_dtype=jnp.float32)
+
+    pa = list(range(1, 15))
+    pb = list(range(20, 32))   # 3 full blocks
+
+    async def run(core, prompt, rid, max_new):
+        req = EngineRequest(rid=rid, prompt=list(prompt),
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=max_new, eos_ids=frozenset())
+        await core.submit(req)
+        toks = []
+        while True:
+            item, _ = await req.out_queue.get()
+            if item is FINISH_SENTINEL:
+                return toks
+            toks.append(item)
+
+    solo = make()
+    want_a = await run(solo, pa, "a", 16)
+    want_b = await run(solo, pb, "b", 4)
+    await solo.stop()
+
+    core = make()
+    # seed the host tier with B's blocks, then wipe the device tier
+    await run(core, pb, "seed", 4)
+    await core.offload_engine.drain()
+    core.kv_manager.pool.reset()
+    # A decodes while B onboards mid-flight
+    got_a, got_b = await asyncio.gather(run(core, pa, "a2", 16),
+                                        run(core, pb, "b2", 4))
+    assert core.host_onboards == 1
+    assert got_a == want_a and got_b == want_b
+    await core.stop()
+
+
+async def test_cancel_during_onboard_releases_blocks():
+    """Cancelling a request whose onboard prep is in flight frees its
+    reserved device blocks and finishes the stream CANCELLED."""
+    import jax.numpy as jnp
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineCore, EngineRequest
+    from dynamo_tpu.engine.sampling import SlotSampling
+    from dynamo_tpu.llm.protocols.common import FinishReason
+    from dynamo_tpu.runtime.engine import EngineContext
+
+    mcfg = ModelConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                       num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                       max_position_embeddings=256)
+    core = EngineCore(
+        mcfg,
+        EngineConfig(max_model_len=64, kv_block_size=4, num_kv_blocks=32,
+                     max_num_seqs=2, prefill_buckets=[16, 32, 64],
+                     host_kv_blocks=16),
+        attn_impl="xla", param_dtype=jnp.float32)
+    prompt = list(range(1, 13))
+
+    async def run(rid, cancel_ctx=None):
+        req = EngineRequest(rid=rid, prompt=list(prompt),
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=4, eos_ids=frozenset(),
+                            ctx=cancel_ctx)
+        await core.submit(req)
+        if cancel_ctx is not None:
+            # cancel once the onboard has actually started (cancelling
+            # before admission takes the plain pre-admission cancel path)
+            for _ in range(200):
+                if core.host_onboards:
+                    break
+                await asyncio.sleep(0.01)
+            cancel_ctx.stop_generating()
+        toks = []
+        while True:
+            item, payload = await asyncio.wait_for(req.out_queue.get(), 60)
+            if item is FINISH_SENTINEL:
+                return toks, payload
+        return toks, None
+
+    await run("seed")
+    await core.offload_engine.drain()
+    core.kv_manager.pool.reset()
+    # hold the onboard-prep window open so the cancel lands mid-flight
+    import time as _time
+    orig_fetch = core.kv_manager.host_pool.fetch
+    core.kv_manager.host_pool.fetch = (
+        lambda slots: (_time.sleep(0.3), orig_fetch(slots))[1])
+    used0 = core.kv_manager.pool.used_blocks
+    _, reason = await run("victim", cancel_ctx=EngineContext("victim"))
+    assert reason == FinishReason.CANCELLED
+    assert core.host_onboards == 1
+    assert core.kv_manager.pool.used_blocks == used0, "onboard leaked blocks"
     await core.stop()
