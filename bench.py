@@ -10,9 +10,9 @@ Baseline context (BASELINE.md): the north-star target is ≥2000 decode
 tok/s/chip for 70B on a v5e-64 pod; `vs_baseline` reports value/2000 so the
 driver has a consistent scalar across rounds.
 
-Env knobs: BENCH_BATCH (default 64 — the block-major attention layout makes
-large decode batches pay; B=16 ≈ 2.5k, B=64 ≈ 5.6k, B=128 ≈ 7.5k tok/s/chip
-on v5e), BENCH_STEPS (128), BENCH_PROMPT (128),
+Env knobs: BENCH_BATCH (default 128 — post-KV-carry-fix scaling on v5e:
+B=64 ≈ 10.3k, B=128 ≈ 14.7k, B=256 ≈ 15.9k tok/s/chip int8; 128 balances
+throughput against ~9 ms ITL), BENCH_STEPS (128), BENCH_PROMPT (128),
 BENCH_MODEL (1b|tiny), BENCH_ATTN (auto|pallas|xla), BENCH_HARVEST (default
 32) — decode steps fused per dispatch (EngineConfig.decode_steps_per_dispatch):
 sampled tokens chain on device and the host harvests once per dispatch,
@@ -158,7 +158,7 @@ def main() -> None:
     from dynamo_tpu.engine.models import llama
     from dynamo_tpu.engine.sampling import make_slot_keys
 
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "128"))
     prompt_len = int(os.environ.get("BENCH_PROMPT", "128"))
     model = os.environ.get("BENCH_MODEL", "1b")

@@ -1,0 +1,128 @@
+"""70B-class AOT sharding validation: the north-star config compiles.
+
+BASELINE config 4 (Llama-3-70B TP-8 on v5e-64) cannot RUN here — no pod —
+but its sharding program can be fully validated ahead-of-time: build the
+real ModelConfig, a tp=8 mesh of virtual CPU devices, ABSTRACT params/KV
+(jax.ShapeDtypeStruct — no 70 GB of weights materialize), and lower the
+actual decode/prefill computations with the production pspecs. Lowering +
+SPMD partitioning is where every divisibility/layout error would surface
+(wrong pspec, head count not dividing tp, vocab padding, collective
+mismatches).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.engine.models import llama
+from dynamo_tpu.parallel.sharding import (batch_pspecs, kv_pspecs, make_mesh,
+                                          named, param_pspecs)
+
+LLAMA3_70B = ModelConfig(
+    model_type="llama", vocab_size=128256, hidden_size=8192,
+    intermediate_size=28672, num_layers=80, num_heads=64, num_kv_heads=8,
+    head_dim=128, max_position_embeddings=8192, rope_theta=500000.0,
+    tie_word_embeddings=False)
+
+MIXTRAL_8X7B = ModelConfig(
+    model_type="mixtral", vocab_size=32000, hidden_size=4096,
+    intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+    head_dim=128, max_position_embeddings=8192, rope_theta=1e6,
+    tie_word_embeddings=False, num_experts=8, num_experts_per_tok=2)
+
+
+def abstract_tree(shapes_dtypes):
+    return {k: jax.ShapeDtypeStruct(s, jnp.bfloat16)
+            for k, s in shapes_dtypes.items()}
+
+
+def _lower(cfg, mesh, B=8, blocks=64, bs=16, M=32, prefill_T=None):
+    """Lower the REAL decode (or prefill) step with production shardings
+    over abstract arrays; returns the lowered object (partitioning ran)."""
+    statics = llama.ModelStatics(cfg=cfg, block_size=bs, attn_impl="xla")
+    params_abs = abstract_tree(llama.param_shapes(cfg))
+    kv_abs = {
+        "k": jax.ShapeDtypeStruct(
+            (cfg.num_layers, blocks * bs, cfg.num_kv_heads * cfg.head_dim),
+            jnp.bfloat16),
+        "v": jax.ShapeDtypeStruct(
+            (cfg.num_layers, blocks * bs, cfg.num_kv_heads * cfg.head_dim),
+            jnp.bfloat16),
+    }
+    pspecs = param_pspecs(cfg)
+    kvspecs = kv_pspecs()
+    bspecs = batch_pspecs()
+
+    if prefill_T is not None:
+        def step(params, kv, tokens, table, start, true_len):
+            return llama.prefill_forward(params, kv, tokens, table, start,
+                                         true_len, statics)
+        args = (params_abs, kv_abs,
+                jax.ShapeDtypeStruct((prefill_T,), jnp.int32),
+                jax.ShapeDtypeStruct((M,), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32))
+        in_shardings = (
+            {k: named(mesh, pspecs.get(k, P())) for k in params_abs},
+            {k: named(mesh, kvspecs[k]) for k in kv_abs},
+            named(mesh, P()), named(mesh, P()), named(mesh, P()),
+            named(mesh, P()))
+    else:
+        def step(params, kv, tokens, positions, tables):
+            return llama.decode_forward(params, kv, tokens, positions,
+                                        tables, statics)
+        args = (params_abs, kv_abs,
+                jax.ShapeDtypeStruct((B,), jnp.int32),
+                jax.ShapeDtypeStruct((B,), jnp.int32),
+                jax.ShapeDtypeStruct((B, M), jnp.int32))
+        in_shardings = (
+            {k: named(mesh, pspecs.get(k, P())) for k in params_abs},
+            {k: named(mesh, kvspecs[k]) for k in kv_abs},
+            named(mesh, bspecs["tokens"]), named(mesh, bspecs["positions"]),
+            named(mesh, bspecs["block_tables"]))
+
+    return jax.jit(step, in_shardings=in_shardings).lower(*args)
+
+
+def test_llama3_70b_tp8_decode_lowers():
+    mesh = make_mesh(dp=1, tp=8)
+    lowered = _lower(LLAMA3_70B, mesh, B=8)
+    hlo = lowered.as_text()
+    assert "sharding" in hlo          # SPMD annotations survived
+    # weight math really is 70B-scale: check one layer tensor's shape
+    assert "28672" in hlo
+
+
+def test_llama3_70b_tp8_prefill_lowers():
+    mesh = make_mesh(dp=1, tp=8)
+    lowered = _lower(LLAMA3_70B, mesh, prefill_T=512)
+    assert "sharding" in lowered.as_text()
+
+
+def test_llama3_70b_dp2_tp4_decode_lowers():
+    """The multi-replica pod layout (dp across replicas in one program)."""
+    mesh = make_mesh(dp=2, tp=4)
+    lowered = _lower(LLAMA3_70B, mesh, B=8)
+    assert "sharding" in lowered.as_text()
+
+
+def test_mixtral_ep_tp_decode_lowers():
+    """MoE north star: experts over ep, attention over tp."""
+    mesh = make_mesh(dp=1, tp=4, ep=2)
+    lowered = _lower(MIXTRAL_8X7B, mesh, B=8)
+    assert "sharding" in lowered.as_text()
+
+
+def test_70b_param_shapes_divide_tp8():
+    """Every sharded axis divides the mesh — no silent replication of a
+    70B weight (parallel/sharding falls back to replication with a
+    warning; at this scale that would be an OOM in production)."""
+    from dynamo_tpu.parallel.sharding import _spec_fits
+    mesh = make_mesh(dp=1, tp=8)
+    specs = param_pspecs(LLAMA3_70B)
+    shapes = llama.param_shapes(LLAMA3_70B)
+    for name, shape in shapes.items():
+        spec = specs.get(name, P())
+        assert _spec_fits(shape, spec, mesh), (
+            f"{name} {shape} does not divide tp=8 under {spec}")
