@@ -1,0 +1,4 @@
+from .spec import DeploymentSpec, DeploymentStatus  # noqa: F401
+from .controller import DeploymentController  # noqa: F401
+
+__all__ = ["DeploymentSpec", "DeploymentStatus", "DeploymentController"]

@@ -1,0 +1,225 @@
+"""Deployment REST API: the api-server analog.
+
+Reference surface (deploy/dynamo/api-server/api/routes/routes.go):
+create / get / update / delete / terminate / sync_status / list over
+deployment resources. Ours is the same CRUD over the discovery-store-
+backed specs the controller watches:
+
+    POST   /v1/deployments                create
+    GET    /v1/deployments                list (specs + statuses)
+    GET    /v1/deployments/{name}         get one
+    PUT    /v1/deployments/{name}         update (bumps generation)
+    POST   /v1/deployments/{name}/terminate   scale to 0 (keep spec)
+    DELETE /v1/deployments/{name}         delete
+
+Run: ``python -m dynamo_tpu.deploy.api_server --runtime-server HOST:PORT``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import re
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from .spec import (SPEC_PREFIX, STATUS_PREFIX, DeploymentSpec,
+                   DeploymentStatus)
+
+logger = logging.getLogger("dynamo_tpu.deploy.api")
+
+_NAME_RE = re.compile(r"^[a-zA-Z0-9][a-zA-Z0-9_.-]{0,62}$")
+
+
+def _validate(name: str, replicas: int) -> Optional[str]:
+    """Returns an error string, or None. Names must be route- and
+    key-safe (no '/', non-empty — 'a/b' would be unreachable via the
+    {name} routes and '' would collide with the watch prefix itself);
+    replicas must be >= 0 (a negative count would make the reconciler
+    pop an empty list forever)."""
+    if not _NAME_RE.match(name or ""):
+        return f"invalid deployment name {name!r}"
+    if replicas < 0:
+        return f"replicas must be >= 0, got {replicas}"
+    return None
+
+
+class DeploymentApi:
+    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0):
+        self.runtime = runtime
+        self.host = host
+        self.port = port
+        # serialize read-modify-write per deployment: the store has no
+        # CAS, so concurrent updates would silently lose writes and mint
+        # duplicate generation numbers
+        self._locks: dict = {}
+        self.app = web.Application()
+        self.app.router.add_post("/v1/deployments", self._create)
+        self.app.router.add_get("/v1/deployments", self._list)
+        self.app.router.add_get("/v1/deployments/{name}", self._get)
+        self.app.router.add_put("/v1/deployments/{name}", self._update)
+        self.app.router.add_post("/v1/deployments/{name}/terminate",
+                                 self._terminate)
+        self.app.router.add_delete("/v1/deployments/{name}", self._delete)
+        self.app.router.add_get("/health", self._health)
+        self._runner: Optional[web.AppRunner] = None
+
+    async def start(self) -> "DeploymentApi":
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        logger.info("deployment api on %s:%d", self.host, self.port)
+        return self
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # ------------------------------------------------------------- handlers
+    async def _spec(self, name: str) -> Optional[DeploymentSpec]:
+        e = await self.runtime.store.kv_get(SPEC_PREFIX + name)
+        return None if e is None else DeploymentSpec.from_json(e.value)
+
+    async def _status(self, name: str) -> Optional[dict]:
+        e = await self.runtime.store.kv_get(STATUS_PREFIX + name)
+        return None if e is None else json.loads(e.value)
+
+    def _lock(self, name: str) -> asyncio.Lock:
+        return self._locks.setdefault(name, asyncio.Lock())
+
+    async def _create(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+            spec = DeploymentSpec(
+                name=body["name"], graph=body["graph"],
+                config=body.get("config"),
+                replicas=int(body.get("replicas", 1)),
+                env=dict(body.get("env", {})), created_at=time.time())
+        except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
+            return web.json_response({"error": f"bad spec: {e}"}, status=400)
+        err = _validate(spec.name, spec.replicas)
+        if err:
+            return web.json_response({"error": err}, status=400)
+        created = await self.runtime.store.kv_create(spec.key(),
+                                                     spec.to_json())
+        if not created:
+            return web.json_response(
+                {"error": f"deployment {spec.name!r} exists"}, status=409)
+        return web.json_response(await self._view(spec), status=201)
+
+    async def _view(self, spec: DeploymentSpec) -> dict:
+        return {"spec": json.loads(spec.to_json()),
+                "status": await self._status(spec.name)}
+
+    async def _list(self, request: web.Request) -> web.Response:
+        entries = await self.runtime.store.kv_get_prefix(SPEC_PREFIX)
+        out = []
+        for e in entries:
+            spec = DeploymentSpec.from_json(e.value)
+            out.append(await self._view(spec))
+        return web.json_response({"deployments": out})
+
+    async def _get(self, request: web.Request) -> web.Response:
+        spec = await self._spec(request.match_info["name"])
+        if spec is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(await self._view(spec))
+
+    async def _update(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        try:
+            body = await request.json()
+        except json.JSONDecodeError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        async with self._lock(name):
+            spec = await self._spec(name)
+            if spec is None:
+                return web.json_response({"error": "not found"}, status=404)
+            for field in ("graph", "config"):
+                if field in body:
+                    setattr(spec, field, body[field])
+            if "replicas" in body:
+                try:
+                    spec.replicas = int(body["replicas"])
+                except (TypeError, ValueError) as e:
+                    return web.json_response({"error": str(e)}, status=400)
+            if "env" in body:
+                spec.env = dict(body["env"])
+            err = _validate(spec.name, spec.replicas)
+            if err:
+                return web.json_response({"error": err}, status=400)
+            spec.generation += 1
+            await self.runtime.store.kv_put(spec.key(), spec.to_json())
+        return web.json_response(await self._view(spec))
+
+    async def _terminate(self, request: web.Request) -> web.Response:
+        """Scale to zero, keep the resource (DeploymentController.Terminate)."""
+        name = request.match_info["name"]
+        async with self._lock(name):
+            spec = await self._spec(name)
+            if spec is None:
+                return web.json_response({"error": "not found"}, status=404)
+            spec.replicas = 0
+            spec.generation += 1
+            await self.runtime.store.kv_put(spec.key(), spec.to_json())
+        return web.json_response(await self._view(spec))
+
+    async def _delete(self, request: web.Request) -> web.Response:
+        name = request.match_info["name"]
+        if await self._spec(name) is None:
+            return web.json_response({"error": "not found"}, status=404)
+        await self.runtime.store.kv_delete(SPEC_PREFIX + name)
+        return web.json_response({"deleted": name})
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "healthy"})
+
+
+async def _amain(runtime_server: str, host: str, port: int,
+                 with_controller: bool) -> None:
+    from ..runtime.distributed import DistributedRuntime
+    runtime = await DistributedRuntime.connect(runtime_server)
+    runtime.server_address = runtime_server
+    api = await DeploymentApi(runtime, host, port).start()
+    controller = None
+    if with_controller:
+        from .controller import DeploymentController
+        controller = await DeploymentController(
+            runtime, runtime_server=runtime_server).start()
+    print(f"deployment api on {api.host}:{api.port}"
+          + (" (controller attached)" if controller else ""), flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        if controller is not None:
+            await controller.stop()
+        await api.stop()
+        await runtime.shutdown()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--runtime-server", required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8280)
+    ap.add_argument("--no-controller", action="store_true",
+                    help="REST only; reconcile elsewhere")
+    args = ap.parse_args()
+    from ..runtime.log import setup_logging
+    setup_logging()
+    try:
+        asyncio.run(_amain(args.runtime_server, args.host, args.port,
+                           not args.no_controller))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,238 @@
+"""Deployment controller: the operator's reconcile loop, TPU-host-native.
+
+Reference: `DynamoDeploymentReconciler.Reconcile` (deploy/dynamo/operator/
+internal/controller/dynamodeployment_controller.go) — compare the state
+specified by the custom resource against actual cluster state, converge,
+write status. The Kubernetes substrate is replaced by what a TPU host
+actually runs: each replica is a ``python -m dynamo_tpu.sdk.serve``
+supervisor process (the pod analog; the SDK supervisor inside it is the
+container analog). The reconcile shape is identical:
+
+    watch specs → diff desired vs actual → start/stop replicas →
+    restart crashed ones (with backoff cap) → publish status on change
+
+Concurrency discipline (same as controller-runtime): the WATCHER only
+records intent (new spec generation / deletion) and wakes the reconciler;
+ALL process operations happen in the single reconcile task, so the two
+never race on a deployment's replica list.
+
+The process launcher is injectable so the same reconciler can drive a
+different substrate (tests inject a fake; a k8s launcher would shell out
+to kubectl against deploy/k8s manifests).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import os
+import sys
+from typing import Dict, List, Optional
+
+from ..runtime.kvstore import WatchEventType
+from .spec import (SPEC_PREFIX, DeploymentSpec, DeploymentStatus)
+
+logger = logging.getLogger("dynamo_tpu.deploy.controller")
+
+MAX_RESTARTS = 3
+
+
+class ProcessLauncher:
+    """Default substrate: one OS process per replica."""
+
+    async def start(self, spec: DeploymentSpec, replica: int,
+                    runtime_server: str) -> object:
+        cmd = [sys.executable, "-m", "dynamo_tpu.sdk.serve", spec.graph,
+               "--runtime-server", runtime_server]
+        if spec.config:
+            cmd += ["-f", spec.config]
+        env = dict(os.environ)
+        env.update(spec.env)
+        env["DYN_DEPLOYMENT"] = spec.name
+        env["DYN_REPLICA"] = str(replica)
+        return await asyncio.create_subprocess_exec(*cmd, env=env)
+
+    def alive(self, proc) -> bool:
+        return proc.returncode is None
+
+    async def stop(self, proc) -> None:
+        if proc.returncode is None:
+            try:
+                proc.terminate()
+            except ProcessLookupError:
+                return                    # exited between check and signal
+            try:
+                await asyncio.wait_for(proc.wait(), timeout=10)
+            except asyncio.TimeoutError:
+                proc.kill()
+                await proc.wait()
+
+
+@dataclasses.dataclass
+class _Replica:
+    proc: object
+    idx: int                              # stable DYN_REPLICA identity
+    restarts: int = 0
+
+
+@dataclasses.dataclass
+class _Managed:
+    spec: DeploymentSpec
+    replicas: List[_Replica] = dataclasses.field(default_factory=list)
+    failed: bool = False
+    pending_spec: Optional[DeploymentSpec] = None   # watcher → reconciler
+    deleted: bool = False
+    last_status: Optional[tuple] = None   # change-only status publish
+
+
+class DeploymentController:
+    """Watches ``deployments/`` and converges processes toward the specs."""
+
+    def __init__(self, runtime, launcher: Optional[ProcessLauncher] = None,
+                 resync_interval: float = 2.0,
+                 runtime_server: Optional[str] = None):
+        self.runtime = runtime
+        self.launcher = launcher or ProcessLauncher()
+        self.resync_interval = resync_interval
+        # the address replicas connect back to; an explicit parameter — a
+        # controller embedded without it would launch replicas pointing at
+        # nothing and crash-loop them all
+        self.runtime_server = (runtime_server
+                               or getattr(runtime, "server_address", "")
+                               or "")
+        self._managed: Dict[str, _Managed] = {}
+        self._tasks: List[asyncio.Task] = []
+        self._watcher = None
+        self._dirty = asyncio.Event()
+        self._stopping = False
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> "DeploymentController":
+        # replay current specs, then watch (kv_get_and_watch_prefix shape)
+        self._watcher = await self.runtime.store.watch_prefix(SPEC_PREFIX)
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._watch_loop(), name="deploy-watch"),
+            loop.create_task(self._reconcile_loop(), name="deploy-reconcile"),
+        ]
+        return self
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        if self._watcher is not None:
+            self._watcher.close()
+        for m in self._managed.values():
+            for r in m.replicas:
+                await self.launcher.stop(r.proc)
+        self._managed.clear()
+
+    # ------------------------------------------------------------- watching
+    async def _watch_loop(self) -> None:
+        """Record intent only — never touches processes (the reconciler
+        owns every replica mutation)."""
+        async for ev in self._watcher:
+            try:
+                name = ev.entry.key[len(SPEC_PREFIX):]
+                if ev.type == WatchEventType.PUT:
+                    try:
+                        spec = DeploymentSpec.from_json(ev.entry.value)
+                    except Exception:  # noqa: BLE001 — user input
+                        logger.exception("undecodable deployment spec %s",
+                                         name)
+                        continue
+                    cur = self._managed.get(name)
+                    if cur is None:
+                        self._managed[name] = _Managed(spec)
+                    elif spec.generation != cur.spec.generation:
+                        cur.pending_spec = spec
+                else:
+                    cur = self._managed.get(name)
+                    if cur is not None:
+                        cur.deleted = True
+                self._dirty.set()
+            except Exception:  # noqa: BLE001 — the watch must never die
+                logger.exception("deployment watch event failed")
+
+    # ----------------------------------------------------------- reconciling
+    async def _reconcile_loop(self) -> None:
+        while not self._stopping:
+            try:
+                await asyncio.wait_for(self._dirty.wait(),
+                                       self.resync_interval)
+            except asyncio.TimeoutError:
+                pass
+            self._dirty.clear()
+            for name, m in list(self._managed.items()):
+                try:
+                    await self._reconcile_one(name, m)
+                except Exception:  # noqa: BLE001 — keep the loop alive
+                    logger.exception("reconcile failed for %s", name)
+
+    async def _reconcile_one(self, name: str, m: _Managed) -> None:
+        if m.deleted:
+            for r in m.replicas:
+                await self.launcher.stop(r.proc)
+            m.replicas.clear()
+            self._managed.pop(name, None)
+            await self._publish_status(m, DeploymentStatus(
+                name=name, state="terminated"))
+            return
+        if m.pending_spec is not None:
+            # generation bounce: stop the old generation, adopt the spec
+            for r in m.replicas:
+                await self.launcher.stop(r.proc)
+            m.replicas.clear()
+            m.spec, m.pending_spec = m.pending_spec, None
+            m.failed = False
+        spec = m.spec
+        want = max(spec.replicas, 0)
+
+        # reap dead replicas → restart with a cap (CrashLoopBackOff
+        # analog), keeping the crashed replica's identity slot
+        for r in list(m.replicas):
+            if not self.launcher.alive(r.proc):
+                m.replicas.remove(r)
+                if r.restarts + 1 > MAX_RESTARTS:
+                    m.failed = True
+                    logger.error("deployment %s replica %d crashed %d "
+                                 "times; marking failed", spec.name, r.idx,
+                                 r.restarts + 1)
+                else:
+                    proc = await self.launcher.start(
+                        spec, r.idx, self.runtime_server)
+                    m.replicas.append(_Replica(proc, r.idx, r.restarts + 1))
+        # scale up/down toward the spec (fresh replicas take free indices)
+        if not m.failed:
+            used = {r.idx for r in m.replicas}
+            free = (i for i in range(want) if i not in used)
+            while len(m.replicas) < want:
+                idx = next(free)
+                proc = await self.launcher.start(spec, idx,
+                                                 self.runtime_server)
+                m.replicas.append(_Replica(proc, idx))
+        while len(m.replicas) > want:
+            r = m.replicas.pop()
+            await self.launcher.stop(r.proc)
+
+        ready = sum(1 for r in m.replicas if self.launcher.alive(r.proc))
+        state = ("failed" if m.failed
+                 else "terminated" if want == 0
+                 else "running" if ready == want
+                 else "degraded" if ready else "pending")
+        await self._publish_status(m, DeploymentStatus(
+            name=spec.name, state=state, ready_replicas=ready,
+            observed_generation=spec.generation,
+            message="" if not m.failed else
+            f"replica exceeded {MAX_RESTARTS} restarts"))
+
+    async def _publish_status(self, m: _Managed,
+                              status: DeploymentStatus) -> None:
+        key = (status.state, status.ready_replicas,
+               status.observed_generation, status.message)
+        if m.last_status == key:
+            return                        # SyncStatus writes only on change
+        m.last_status = key
+        await self.runtime.store.kv_put(status.key(), status.to_json())

@@ -1,0 +1,71 @@
+"""Deployment resource: the DynamoDeployment CRD analog.
+
+Reference: the Go operator's `DynamoDeployment` custom resource
+(deploy/dynamo/operator/api/v1alpha1) + the api-server's deployment
+models (deploy/dynamo/api-server/api/models). A deployment names a graph
+entry (module:Service), its config, and target replica counts; the
+controller reconciles actual state toward it and writes status back.
+
+Storage: specs live in the discovery KV store under ``deployments/{name}``
+and statuses under ``deployment_status/{name}`` — the store IS our etcd,
+so the CRD lifecycle (create/update/watch/delete) uses the same machinery
+workers already depend on, and the controller is just another watcher.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Dict, Optional
+
+SPEC_PREFIX = "deployments/"
+STATUS_PREFIX = "deployment_status/"
+
+
+@dataclasses.dataclass
+class DeploymentSpec:
+    """Desired state of one serving graph deployment."""
+
+    name: str
+    graph: str                        # "package.module:ServiceClass"
+    config: Optional[str] = None      # YAML service config path
+    replicas: int = 1                 # graph supervisor replicas
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # bookkeeping
+    created_at: float = 0.0
+    generation: int = 1               # bumped on every update
+
+    def key(self) -> str:
+        return SPEC_PREFIX + self.name
+
+    def to_json(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "DeploymentSpec":
+        return cls(**json.loads(raw))
+
+
+@dataclasses.dataclass
+class DeploymentStatus:
+    """Observed state, written by the controller (SyncStatus analog)."""
+
+    name: str
+    state: str = "pending"            # pending|running|degraded|failed|terminated
+    ready_replicas: int = 0
+    observed_generation: int = 0
+    message: str = ""
+    updated_at: float = 0.0
+
+    def key(self) -> str:
+        return STATUS_PREFIX + self.name
+
+    def to_json(self) -> bytes:
+        d = dataclasses.asdict(self)
+        d["updated_at"] = time.time()
+        return json.dumps(d).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "DeploymentStatus":
+        return cls(**json.loads(raw))

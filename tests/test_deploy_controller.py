@@ -1,0 +1,202 @@
+"""Deployment control plane: REST api + reconciling controller.
+
+Reference: the Go operator's DynamoDeployment reconcile loop
+(deploy/dynamo/operator/internal/controller/dynamodeployment_controller.go)
+and the api-server CRUD surface (deploy/dynamo/api-server/api/routes).
+The substrate here is processes on a TPU host; tests inject a fake
+launcher to drive the control loop deterministically, plus one real
+subprocess smoke."""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.deploy.api_server import DeploymentApi
+from dynamo_tpu.deploy.controller import (MAX_RESTARTS, DeploymentController,
+                                          ProcessLauncher)
+from dynamo_tpu.deploy.spec import DeploymentSpec, DeploymentStatus
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+pytestmark = pytest.mark.asyncio
+
+
+class FakeProc:
+    def __init__(self):
+        self.returncode = None
+        self.stopped = False
+
+
+class FakeLauncher(ProcessLauncher):
+    def __init__(self):
+        self.started = []          # (deployment, replica_idx)
+        self.procs = []
+
+    async def start(self, spec, replica, runtime_server):
+        p = FakeProc()
+        self.started.append((spec.name, replica, spec.generation))
+        self.procs.append(p)
+        return p
+
+    def alive(self, proc):
+        return proc.returncode is None
+
+    async def stop(self, proc):
+        proc.returncode = -15
+        proc.stopped = True
+
+
+async def wait_status(rt, name, pred, timeout=10.0):
+    from dynamo_tpu.deploy.spec import STATUS_PREFIX
+    import json
+    for _ in range(int(timeout / 0.05)):
+        e = await rt.store.kv_get(STATUS_PREFIX + name)
+        if e is not None:
+            s = json.loads(e.value)
+            if pred(s):
+                return s
+        await asyncio.sleep(0.05)
+    raise AssertionError(f"status for {name} never satisfied predicate")
+
+
+@pytest.fixture
+async def stack():
+    rt = DistributedRuntime.in_process()
+    launcher = FakeLauncher()
+    controller = await DeploymentController(rt, launcher=launcher,
+                                            resync_interval=0.1).start()
+    api = await DeploymentApi(rt).start()
+    yield rt, launcher, controller, api
+    await api.stop()
+    await controller.stop()
+    await rt.shutdown()
+
+
+async def test_create_scale_terminate_delete(stack):
+    rt, launcher, controller, api = stack
+    base = f"http://127.0.0.1:{api.port}/v1/deployments"
+    async with aiohttp.ClientSession() as s:
+        # create with 2 replicas → controller converges → running
+        async with s.post(base, json={"name": "d1", "graph": "m:Svc",
+                                      "replicas": 2}) as r:
+            assert r.status == 201
+        st = await wait_status(rt, "d1",
+                               lambda x: x["state"] == "running")
+        assert st["ready_replicas"] == 2
+        assert len([x for x in launcher.started if x[0] == "d1"]) == 2
+
+        # duplicate create → 409
+        async with s.post(base, json={"name": "d1", "graph": "m:Svc"}) as r:
+            assert r.status == 409
+
+        # scale down to 1
+        async with s.put(f"{base}/d1", json={"replicas": 1}) as r:
+            assert r.status == 200
+        await wait_status(rt, "d1",
+                          lambda x: x["ready_replicas"] == 1
+                          and x["observed_generation"] == 2)
+
+        # terminate → 0 replicas, state terminated, spec retained
+        async with s.post(f"{base}/d1/terminate") as r:
+            assert r.status == 200
+        await wait_status(rt, "d1", lambda x: x["ready_replicas"] == 0
+                          and x["state"] == "terminated")
+        async with s.get(f"{base}/d1") as r:
+            assert r.status == 200
+            body = await r.json()
+            assert body["spec"]["replicas"] == 0
+
+        # delete → resource gone, procs stopped, status terminated
+        async with s.delete(f"{base}/d1") as r:
+            assert r.status == 200
+        await wait_status(rt, "d1", lambda x: x["state"] == "terminated")
+        async with s.get(f"{base}/d1") as r:
+            assert r.status == 404
+    assert all(p.stopped for p in launcher.procs)
+
+
+async def test_crash_restart_then_failed(stack):
+    rt, launcher, controller, api = stack
+    await rt.store.kv_put(
+        "deployments/crashy",
+        DeploymentSpec(name="crashy", graph="m:Svc", replicas=1).to_json())
+    await wait_status(rt, "crashy", lambda x: x["state"] == "running")
+
+    # kill the replica repeatedly: restarts with a cap, then failed
+    for _ in range(MAX_RESTARTS + 1):
+        launcher.procs[-1].returncode = 1
+        await asyncio.sleep(0.25)
+    st = await wait_status(rt, "crashy", lambda x: x["state"] == "failed")
+    assert "restarts" in st["message"]
+    # 1 initial + MAX_RESTARTS restarts
+    assert len([x for x in launcher.started if x[0] == "crashy"]) == \
+        1 + MAX_RESTARTS
+
+
+async def test_update_bounces_replicas_on_new_generation(stack):
+    rt, launcher, controller, api = stack
+    base = f"http://127.0.0.1:{api.port}/v1/deployments"
+    async with aiohttp.ClientSession() as s:
+        async with s.post(base, json={"name": "d2", "graph": "m:Old"}) as r:
+            assert r.status == 201
+        await wait_status(rt, "d2", lambda x: x["state"] == "running")
+        first = launcher.procs[-1]
+        async with s.put(f"{base}/d2", json={"graph": "m:New"}) as r:
+            assert r.status == 200
+        await wait_status(rt, "d2",
+                          lambda x: x["state"] == "running"
+                          and x["observed_generation"] == 2)
+    assert first.stopped                      # old generation bounced
+    gens = [g for (n, _i, g) in launcher.started if n == "d2"]
+    assert gens == [1, 2]
+
+
+async def test_validation_rejects_bad_specs(stack):
+    rt, launcher, controller, api = stack
+    base = f"http://127.0.0.1:{api.port}/v1/deployments"
+    async with aiohttp.ClientSession() as s:
+        for bad in ({"name": "a/b", "graph": "m:S"},
+                    {"name": "", "graph": "m:S"},
+                    {"name": "ok", "graph": "m:S", "replicas": -1}):
+            async with s.post(base, json=bad) as r:
+                assert r.status == 400, bad
+        async with s.post(base, json={"name": "ok", "graph": "m:S"}) as r:
+            assert r.status == 201
+        async with s.put(f"{base}/ok", json={"replicas": -3}) as r:
+            assert r.status == 400
+
+
+async def test_crash_replacement_keeps_replica_identity(stack):
+    rt, launcher, controller, api = stack
+    await rt.store.kv_put(
+        "deployments/ids",
+        DeploymentSpec(name="ids", graph="m:S", replicas=2).to_json())
+    await wait_status(rt, "ids", lambda x: x["ready_replicas"] == 2)
+    # crash replica idx 0 → its replacement reuses idx 0, not idx 2
+    first = next(p for (n, i, _g), p in
+                 zip(launcher.started, launcher.procs)
+                 if n == "ids" and i == 0)
+    first.returncode = 1
+    await wait_status(rt, "ids", lambda x: x["ready_replicas"] == 2
+                      and len([s for s in launcher.started
+                               if s[0] == "ids"]) == 3)
+    idxs = sorted(i for (n, i, _g) in launcher.started if n == "ids")
+    assert idxs == [0, 0, 1]
+
+
+async def test_real_subprocess_launcher():
+    """One real replica process end-to-end (sleep stand-in for the graph):
+    start → alive → stop terminates it."""
+    spec = DeploymentSpec(name="real", graph="x", replicas=1)
+    launcher = ProcessLauncher()
+
+    async def fake_start(spec, replica, runtime_server):
+        import sys
+        return await asyncio.create_subprocess_exec(
+            sys.executable, "-c", "import time; time.sleep(60)")
+
+    launcher.start = fake_start                # substrate minus sdk.serve
+    proc = await launcher.start(spec, 0, "")
+    assert launcher.alive(proc)
+    await launcher.stop(proc)
+    assert not launcher.alive(proc)
