@@ -83,15 +83,25 @@ def _flash_prefill_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref,
                           m_ref, l_ref, acc_ref,
                           *, q_chunk: int, kv_chunk: int, g: int,
                           scale: float, window: int | None,
-                          softcap: float | None):
+                          softcap: float | None,
+                          ml_ref=None):
     """meta_ref (SMEM): [start_pos, seq_len, sliding]; q_ref: [1, TQ*g, Dh];
     k_ref/v_ref: [1, SC, Dh]; o_ref: [1, TQ*g, Dh]; m/l: [TQ*g, 1] f32;
-    acc: [TQ*g, Dh] f32."""
+    acc: [TQ*g, Dh] f32.
+
+    ``ml_ref`` set → PARTIAL mode (ring attention, attention.py
+    flash_prefill_partial): o gets the UNNORMALIZED f32 accumulator and
+    ml_ref [1, TQ*g, 2] gets (m, l), so ring steps combine across devices
+    with the online-softmax recurrence. Partial mode also tolerates a
+    fully-masked q chunk (negative start_pos / zero seq_len — a ring hop
+    whose KV lies entirely after the queries): it contributes exact zeros.
+    """
     tq, sc = pl.program_id(1), pl.program_id(2)
     n_sc = pl.num_programs(2)
     start_pos = meta_ref[0]
     seq_len = meta_ref[1]
     sliding = meta_ref[2]
+    partial = ml_ref is not None
 
     qpos_lo = start_pos + tq * q_chunk
     qpos_hi = qpos_lo + q_chunk - 1
@@ -106,6 +116,12 @@ def _flash_prefill_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref,
             sliding > 0,
             jnp.maximum(qpos_lo - window + 1, 0) // kv_chunk,
             0)
+    if partial:
+        # empty causal range: still run chunk 0 (fully masked → zeros) so
+        # the outputs are always written
+        empty = last < first
+        first = jnp.where(empty, 0, first)
+        last = jnp.where(empty, 0, last)
 
     @pl.when((sc >= first) & (sc <= last))
     def _():
@@ -133,6 +149,10 @@ def _flash_prefill_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref,
         m_prev = m_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
+        if partial:
+            # fully-masked rows: m_new == NEG_INF makes exp(s-m) == 1 —
+            # zero them so dead ring hops contribute nothing
+            p = jnp.where(m_new > NEG_INF / 2, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
@@ -142,8 +162,61 @@ def _flash_prefill_kernel(meta_ref, q_ref, k_ref, v_ref, o_ref,
 
         @pl.when(sc == last)
         def _():
-            o_ref[0] = (acc_ref[:] /
-                        jnp.maximum(l_ref[:], 1e-20)).astype(o_ref.dtype)
+            if partial:
+                o_ref[0] = acc_ref[:].astype(o_ref.dtype)
+                ml_ref[0, :, 0:1] = m_ref[:]
+                ml_ref[0, :, 1:2] = l_ref[:]
+            else:
+                o_ref[0] = (acc_ref[:] /
+                            jnp.maximum(l_ref[:], 1e-20)).astype(o_ref.dtype)
+
+
+def _flash_layout(q, k, v, q_chunk: int, kv_chunk: int):
+    """Shared wrapper plumbing for both flash variants: ceil-pad T/S,
+    rearrange q to [KVH, Tp*g, Dh] (g query heads of one kv head
+    contiguous in sublanes) and k/v to [KVH, Sp, Dh]. ONE home — a tiling
+    or layout change here serves flash_prefill AND flash_prefill_partial."""
+    T, H, Dh = q.shape
+    S, KVH, _ = k.shape
+    g = H // KVH
+    Tp = -(-T // q_chunk) * q_chunk
+    Sp = -(-S // kv_chunk) * kv_chunk
+    if Tp != T:   # pad queries; pad rows attend real kv, output sliced off
+        q = jnp.pad(q, ((0, Tp - T), (0, 0), (0, 0)))
+    if Sp != S:   # pad kv; dead rows are masked by kv_pos < seq_len
+        k = jnp.pad(k, ((0, Sp - S), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, Sp - S), (0, 0), (0, 0)))
+    qr = q.reshape(Tp, KVH, g, Dh).transpose(1, 0, 2, 3).reshape(
+        KVH, Tp * g, Dh)
+    kr = k.transpose(1, 0, 2)
+    vr = v.transpose(1, 0, 2)
+    return qr, kr, vr, Tp, Sp, g
+
+
+def _flash_grid_spec(KVH: int, n_tq: int, n_sc: int, tqg: int, Dh: int,
+                     kv_chunk: int, out_specs):
+    return pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(KVH, n_tq, n_sc),
+        in_specs=[
+            pl.BlockSpec((1, tqg, Dh), lambda kh, tq, sc, *_: (kh, tq, 0)),
+            pl.BlockSpec((1, kv_chunk, Dh),
+                         lambda kh, tq, sc, *_: (kh, sc, 0)),
+            pl.BlockSpec((1, kv_chunk, Dh),
+                         lambda kh, tq, sc, *_: (kh, sc, 0)),
+        ],
+        out_specs=out_specs,
+        scratch_shapes=[
+            pltpu.VMEM((tqg, 1), jnp.float32),     # m
+            pltpu.VMEM((tqg, 1), jnp.float32),     # l
+            pltpu.VMEM((tqg, Dh), jnp.float32),    # acc
+        ],
+    )
+
+
+def _flash_unpack(x, KVH: int, Tp: int, g: int, last: int, T: int):
+    x = x.reshape(KVH, Tp, g, last).transpose(1, 0, 2, 3)
+    return x.reshape(Tp, KVH * g, last)[:T]
 
 
 def flash_prefill(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -159,45 +232,18 @@ def flash_prefill(q: jax.Array, k: jax.Array, v: jax.Array, *,
     kv padding; `sliding` (traced bool) applies the static `window` to
     this layer (gemma2 interleaving). Returns [T, H, Dh]."""
     T, H, Dh = q.shape
-    S, KVH, _ = k.shape
-    g = H // KVH
-
-    Tp = -(-T // q_chunk) * q_chunk
-    Sp = -(-S // kv_chunk) * kv_chunk
-    if Tp != T:   # pad queries; pad rows attend real kv, output sliced off
-        q = jnp.pad(q, ((0, Tp - T), (0, 0), (0, 0)))
-    if Sp != S:   # pad kv; dead rows are masked by kv_pos < seq_len
-        k = jnp.pad(k, ((0, Sp - S), (0, 0), (0, 0)))
-        v = jnp.pad(v, ((0, Sp - S), (0, 0), (0, 0)))
-
-    qr = q.reshape(Tp, KVH, g, Dh).transpose(1, 0, 2, 3).reshape(
-        KVH, Tp * g, Dh)
-    kr = k.transpose(1, 0, 2)                      # [KVH, Sp, Dh]
-    vr = v.transpose(1, 0, 2)
+    KVH = k.shape[1]
+    qr, kr, vr, Tp, Sp, g = _flash_layout(q, k, v, q_chunk, kv_chunk)
     meta = jnp.stack([jnp.asarray(start_pos, jnp.int32),
                       jnp.asarray(seq_len, jnp.int32),
                       jnp.asarray(sliding, jnp.int32)])
 
     n_tq, n_sc = Tp // q_chunk, Sp // kv_chunk
     tqg = q_chunk * g
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(KVH, n_tq, n_sc),
-        in_specs=[
-            pl.BlockSpec((1, tqg, Dh), lambda kh, tq, sc, *_: (kh, tq, 0)),
-            pl.BlockSpec((1, kv_chunk, Dh),
-                         lambda kh, tq, sc, *_: (kh, sc, 0)),
-            pl.BlockSpec((1, kv_chunk, Dh),
-                         lambda kh, tq, sc, *_: (kh, sc, 0)),
-        ],
+    grid_spec = _flash_grid_spec(
+        KVH, n_tq, n_sc, tqg, Dh, kv_chunk,
         out_specs=pl.BlockSpec((1, tqg, Dh),
-                               lambda kh, tq, sc, *_: (kh, tq, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((tqg, 1), jnp.float32),     # m
-            pltpu.VMEM((tqg, 1), jnp.float32),     # l
-            pltpu.VMEM((tqg, Dh), jnp.float32),    # acc
-        ],
-    )
+                               lambda kh, tq, sc, *_: (kh, tq, 0)))
     kernel = functools.partial(
         _flash_prefill_kernel, q_chunk=q_chunk, kv_chunk=kv_chunk, g=g,
         scale=scale, window=window, softcap=softcap)
@@ -209,8 +255,59 @@ def flash_prefill(q: jax.Array, k: jax.Array, v: jax.Array, *,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(meta, qr, kr, vr)
-    out = out.reshape(KVH, Tp, g, Dh).transpose(1, 0, 2, 3)
-    return out.reshape(Tp, H, Dh)[:T]
+    return _flash_unpack(out, KVH, Tp, g, Dh, T)
+
+
+def flash_prefill_partial(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                          scale: float, start_pos: jax.Array,
+                          seq_len: jax.Array,
+                          q_chunk: int = 128, kv_chunk: int = 256,
+                          interpret: bool = False) -> tuple:
+    """Flash attention returning UNNORMALIZED partial state for cross-chunk
+    combination (ring attention: each hop computes a partial against one
+    KV chunk; hops merge with the online-softmax recurrence).
+
+    q: [T, H, Dh] at absolute positions start_pos + t (start_pos may be
+    NEGATIVE — queries before this KV chunk are fully masked and
+    contribute zeros); k/v: [S, KVH, Dh] at positions 0..seq_len.
+    Returns (acc [T, H, Dh] f32, m [T, H] f32, l [T, H] f32).
+    """
+    T, H, Dh = q.shape
+    KVH = k.shape[1]
+    qr, kr, vr, Tp, Sp, g = _flash_layout(q, k, v, q_chunk, kv_chunk)
+    meta = jnp.stack([jnp.asarray(start_pos, jnp.int32),
+                      jnp.asarray(seq_len, jnp.int32),
+                      jnp.asarray(0, jnp.int32)])
+
+    n_tq, n_sc = Tp // q_chunk, Sp // kv_chunk
+    tqg = q_chunk * g
+    grid_spec = _flash_grid_spec(
+        KVH, n_tq, n_sc, tqg, Dh, kv_chunk,
+        out_specs=[
+            pl.BlockSpec((1, tqg, Dh), lambda kh, tq, sc, *_: (kh, tq, 0)),
+            pl.BlockSpec((1, tqg, 2), lambda kh, tq, sc, *_: (kh, tq, 0)),
+        ])
+
+    def kernel(meta_ref, q_ref, k_ref, v_ref, o_ref, ml_ref,
+               m_ref, l_ref, acc_ref):
+        _flash_prefill_kernel(
+            meta_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+            q_chunk=q_chunk, kv_chunk=kv_chunk, g=g, scale=scale,
+            window=None, softcap=None, ml_ref=ml_ref)
+
+    acc, ml = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((KVH, Tp * g, Dh), jnp.float32),
+                   jax.ShapeDtypeStruct((KVH, Tp * g, 2), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(meta, qr, kr, vr)
+
+    acc = _flash_unpack(acc, KVH, Tp, g, Dh, T)
+    ml = _flash_unpack(ml, KVH, Tp, g, 2, T)
+    return acc, ml[:, :, 0], ml[:, :, 1]
 
 
 def flash_prefill_supported(num_heads: int, num_kv_heads: int,

@@ -34,7 +34,8 @@ __all__ = ["ring_attention_local", "ring_attention"]
 def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
                          *, axis_name: str, scale: float,
                          q_offset: Optional[jax.Array] = None,
-                         kv_len: Optional[jax.Array] = None) -> jax.Array:
+                         kv_len: Optional[jax.Array] = None,
+                         impl: str = "dense") -> jax.Array:
     """Per-shard body (call inside shard_map over `axis_name`).
 
     q: [Tl, H, Dh] — this device's query chunk (global sequence is the
@@ -43,6 +44,13 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     q_offset: global position of q[0] (default: axis_index * Tl).
     kv_len: total valid kv length (default: axis_size * Sl) — positions
     beyond it are masked (padded final chunk).
+
+    impl: per-hop attention body. "dense" materializes [KVH, g, Tl, Sl]
+    scores — fine for moderate chunks, O((T/sp)²) memory at long context.
+    "flash"/"flash_interpret" streams each hop through the Pallas partial
+    kernel (engine/attention.flash_prefill_partial): O(TQ·SC) live memory
+    per hop, so per-device memory stays O(T/sp) end to end — the long-
+    context configuration.
 
     Returns [Tl, H, Dh].
     """
@@ -54,6 +62,40 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     if q_offset is None:
         q_offset = me * Tl
     total = n * Sl if kv_len is None else kv_len
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    if impl.startswith("flash"):
+        from ..engine.attention import flash_prefill_partial
+        interpret = impl == "flash_interpret"
+
+        m0 = jnp.full((Tl, H), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((Tl, H), jnp.float32)
+        acc0 = jnp.zeros((Tl, H, Dh), jnp.float32)
+
+        def step(carry, s):
+            k_c, v_c, m, l, acc = carry
+            src = (me - s) % n             # who computed this chunk
+            # hop combine: the kernel returns this chunk's partial
+            # (acc_c, m_c, l_c); merge with the carried state via the
+            # online-softmax recurrence
+            acc_c, m_c, l_c = flash_prefill_partial(
+                q, k_c, v_c, scale=scale,
+                start_pos=q_offset - src * Sl,
+                seq_len=jnp.clip(total - src * Sl, 0, Sl),
+                interpret=interpret)
+            m_new = jnp.maximum(m, m_c)
+            a_old = jnp.exp(m - m_new)
+            a_new = jnp.exp(m_c - m_new)
+            l = l * a_old + l_c * a_new
+            acc = acc * a_old[..., None] + acc_c * a_new[..., None]
+            k_n = jax.lax.ppermute(k_c, axis_name, perm)
+            v_n = jax.lax.ppermute(v_c, axis_name, perm)
+            return (k_n, v_n, m_new, l, acc), None
+
+        (_, _, m, l, acc), _ = jax.lax.scan(
+            step, (k, v, m0, l0, acc0), jnp.arange(n))
+        out = acc / jnp.maximum(l, 1e-20)[..., None]           # [Tl,H,Dh]
+        return out.astype(q.dtype)
 
     qg = (q.astype(jnp.float32) * scale).reshape(Tl, KVH, g, Dh)
     qpos = q_offset + jnp.arange(Tl, dtype=jnp.int32)          # [Tl]
@@ -61,7 +103,6 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     m0 = jnp.full((KVH, g, Tl, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((KVH, g, Tl, 1), jnp.float32)
     acc0 = jnp.zeros((KVH, g, Tl, Dh), jnp.float32)
-    perm = [(i, (i + 1) % n) for i in range(n)]
 
     def step(carry, s):
         k_c, v_c, m, l, acc = carry
@@ -91,13 +132,26 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.transpose(2, 0, 1, 3).reshape(Tl, H, Dh).astype(q.dtype)
 
 
+def _default_impl(num_heads: int, num_kv_heads: int, head_dim: int) -> str:
+    from ..engine.attention import _on_tpu, flash_prefill_supported
+    return ("flash" if _on_tpu()
+            and flash_prefill_supported(num_heads, num_kv_heads, head_dim)
+            else "dense")
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    *, scale: float, axis_name: str = "sp",
                    tp_axis: Optional[str] = "tp",
-                   kv_len: Optional[jax.Array] = None) -> jax.Array:
+                   kv_len: Optional[jax.Array] = None,
+                   impl: str = "auto") -> jax.Array:
     """Global entry: q [T, H, Dh], k/v [S, KVH, Dh] with the sequence axis
     sharded over `axis_name` (and heads optionally over `tp_axis`). T and S
-    must divide by the axis size. Returns [T, H, Dh], same shardings."""
+    must divide by the axis size. Returns [T, H, Dh], same shardings.
+
+    impl: "auto" picks the Pallas flash hop body on TPU (per-device memory
+    O(T/sp) at any context length), dense einsum elsewhere."""
+    if impl == "auto":
+        impl = _default_impl(q.shape[1], k.shape[1], q.shape[2])
     head_ax = tp_axis if (tp_axis and tp_axis in mesh.shape) else None
     spec_q = P(axis_name, head_ax, None)
     spec_kv = P(axis_name, head_ax, None)
@@ -106,7 +160,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     def body(q_l, k_l, v_l, *rest):
         kvl = rest[0] if rest else None
         return ring_attention_local(q_l, k_l, v_l, axis_name=axis_name,
-                                    scale=scale, kv_len=kvl)
+                                    scale=scale, kv_len=kvl, impl=impl)
 
     args = (q, k, v) + ((kv_len,) if kv_len is not None else ())
     in_specs = (spec_q, spec_kv, spec_kv) + (

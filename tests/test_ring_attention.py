@@ -65,6 +65,82 @@ def test_ring_composes_with_tp(qkv):
                                atol=2e-6, rtol=1e-5)
 
 
+def test_flash_partial_matches_dense_single_chunk():
+    """flash_prefill_partial's (acc, m, l) normalize to the dense result,
+    including a NEGATIVE start_pos (a ring hop whose KV lies after the
+    queries → exact zeros) and a clipped seq_len."""
+    from dynamo_tpu.engine.attention import flash_prefill_partial
+    rng = np.random.default_rng(3)
+    T, S, H, KVH, Dh = 32, 32, 8, 4, 16
+    q = jnp.asarray(rng.standard_normal((T, H, Dh)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((S, KVH, Dh)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((S, KVH, Dh)), jnp.float32)
+    scale = Dh ** -0.5
+
+    # plain causal (start 0): normalized partial == dense
+    acc, m, l = flash_prefill_partial(q, k, v, scale=scale,
+                                      start_pos=jnp.asarray(0),
+                                      seq_len=jnp.asarray(S),
+                                      q_chunk=16, kv_chunk=16,
+                                      interpret=True)
+    out = acc / np.maximum(np.asarray(l)[..., None], 1e-20)
+    ref = causal_attention(q, k, v, scale=scale)
+    np.testing.assert_allclose(out, np.asarray(ref), atol=2e-6, rtol=1e-5)
+
+    # fully-masked hop: everything zero, m stays -inf-ish
+    acc, m, l = flash_prefill_partial(q, k, v, scale=scale,
+                                      start_pos=jnp.asarray(-S),
+                                      seq_len=jnp.asarray(S),
+                                      q_chunk=16, kv_chunk=16,
+                                      interpret=True)
+    assert float(np.abs(np.asarray(acc)).max()) == 0.0
+    assert float(np.asarray(l).max()) == 0.0
+
+    # zero seq_len (dead ring hop past the valid prefix): zeros too
+    acc, m, l = flash_prefill_partial(q, k, v, scale=scale,
+                                      start_pos=jnp.asarray(0),
+                                      seq_len=jnp.asarray(0),
+                                      q_chunk=16, kv_chunk=16,
+                                      interpret=True)
+    assert float(np.asarray(l).max()) == 0.0
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ring_flash_matches_dense(qkv, sp):
+    """The flash hop body (Pallas partial kernel, interpret mode on CPU)
+    produces the same ring result as the dense hop body."""
+    q, k, v = qkv
+    scale = q.shape[-1] ** -0.5
+    ref = causal_attention(q, k, v, scale=scale)
+    out = ring_attention(q, k, v, make_mesh(sp=sp), scale=scale,
+                         impl="flash_interpret")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6, rtol=1e-5)
+
+
+def test_ring_flash_composes_with_tp(qkv):
+    """Flash hop body under head sharding: local H/tp, KVH/tp shapes run
+    through the partial kernel (interpret) and still match dense."""
+    q, k, v = qkv
+    scale = q.shape[-1] ** -0.5
+    ref = causal_attention(q, k, v, scale=scale)
+    out = ring_attention(q, k, v, make_mesh(tp=2, sp=4), scale=scale,
+                         impl="flash_interpret")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6, rtol=1e-5)
+
+
+def test_ring_flash_padded_tail(qkv):
+    q, k, v = qkv
+    scale = q.shape[-1] ** -0.5
+    kv_len = jnp.asarray(25, jnp.int32)
+    ref = causal_attention(q, k, v, scale=scale, length=kv_len)
+    out = ring_attention(q, k, v, make_mesh(sp=4), scale=scale,
+                         kv_len=kv_len, impl="flash_interpret")
+    np.testing.assert_allclose(np.asarray(out)[:25], np.asarray(ref)[:25],
+                               atol=2e-6, rtol=1e-5)
+
+
 def test_sp_prefill_matches_chunked_prefill():
     params = llama.init_params(TINY, jax.random.PRNGKey(0), dtype=jnp.float32)
     statics = llama.ModelStatics(cfg=TINY, block_size=8, attn_impl="xla")
