@@ -21,31 +21,15 @@ import argparse
 import asyncio
 import json
 import logging
-import re
 import time
 from typing import Optional
 
 from aiohttp import web
 
 from .spec import (SPEC_PREFIX, STATUS_PREFIX, DeploymentSpec,
-                   DeploymentStatus)
+                   DeploymentStatus, update_spec, validate_spec)
 
 logger = logging.getLogger("dynamo_tpu.deploy.api")
-
-_NAME_RE = re.compile(r"^[a-zA-Z0-9][a-zA-Z0-9_.-]{0,62}$")
-
-
-def _validate(name: str, replicas: int) -> Optional[str]:
-    """Returns an error string, or None. Names must be route- and
-    key-safe (no '/', non-empty — 'a/b' would be unreachable via the
-    {name} routes and '' would collide with the watch prefix itself);
-    replicas must be >= 0 (a negative count would make the reconciler
-    pop an empty list forever)."""
-    if not _NAME_RE.match(name or ""):
-        return f"invalid deployment name {name!r}"
-    if replicas < 0:
-        return f"replicas must be >= 0, got {replicas}"
-    return None
 
 
 class DeploymentApi:
@@ -53,10 +37,6 @@ class DeploymentApi:
         self.runtime = runtime
         self.host = host
         self.port = port
-        # serialize read-modify-write per deployment: the store has no
-        # CAS, so concurrent updates would silently lose writes and mint
-        # duplicate generation numbers
-        self._locks: dict = {}
         self.app = web.Application()
         self.app.router.add_post("/v1/deployments", self._create)
         self.app.router.add_get("/v1/deployments", self._list)
@@ -91,9 +71,6 @@ class DeploymentApi:
         e = await self.runtime.store.kv_get(STATUS_PREFIX + name)
         return None if e is None else json.loads(e.value)
 
-    def _lock(self, name: str) -> asyncio.Lock:
-        return self._locks.setdefault(name, asyncio.Lock())
-
     async def _create(self, request: web.Request) -> web.Response:
         try:
             body = await request.json()
@@ -104,7 +81,7 @@ class DeploymentApi:
                 env=dict(body.get("env", {})), created_at=time.time())
         except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
             return web.json_response({"error": f"bad spec: {e}"}, status=400)
-        err = _validate(spec.name, spec.replicas)
+        err = validate_spec(spec.name, spec.replicas)
         if err:
             return web.json_response({"error": err}, status=400)
         created = await self.runtime.store.kv_create(spec.key(),
@@ -138,10 +115,8 @@ class DeploymentApi:
             body = await request.json()
         except json.JSONDecodeError as e:
             return web.json_response({"error": str(e)}, status=400)
-        async with self._lock(name):
-            spec = await self._spec(name)
-            if spec is None:
-                return web.json_response({"error": "not found"}, status=404)
+
+        def mutate(spec: DeploymentSpec) -> Optional[str]:
             for field in ("graph", "config"):
                 if field in body:
                     setattr(spec, field, body[field])
@@ -149,26 +124,30 @@ class DeploymentApi:
                 try:
                     spec.replicas = int(body["replicas"])
                 except (TypeError, ValueError) as e:
-                    return web.json_response({"error": str(e)}, status=400)
+                    return str(e)
             if "env" in body:
                 spec.env = dict(body["env"])
-            err = _validate(spec.name, spec.replicas)
-            if err:
-                return web.json_response({"error": err}, status=400)
-            spec.generation += 1
-            await self.runtime.store.kv_put(spec.key(), spec.to_json())
+            return validate_spec(spec.name, spec.replicas)
+
+        try:
+            spec = await update_spec(self.runtime.store, name, mutate)
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        if spec is None:
+            return web.json_response({"error": "not found"}, status=404)
         return web.json_response(await self._view(spec))
 
     async def _terminate(self, request: web.Request) -> web.Response:
         """Scale to zero, keep the resource (DeploymentController.Terminate)."""
         name = request.match_info["name"]
-        async with self._lock(name):
-            spec = await self._spec(name)
-            if spec is None:
-                return web.json_response({"error": "not found"}, status=404)
+
+        def mutate(spec: DeploymentSpec) -> Optional[str]:
             spec.replicas = 0
-            spec.generation += 1
-            await self.runtime.store.kv_put(spec.key(), spec.to_json())
+            return None
+
+        spec = await update_spec(self.runtime.store, name, mutate)
+        if spec is None:
+            return web.json_response({"error": "not found"}, status=404)
         return web.json_response(await self._view(spec))
 
     async def _delete(self, request: web.Request) -> web.Response:

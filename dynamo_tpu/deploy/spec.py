@@ -16,11 +16,27 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import re
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 SPEC_PREFIX = "deployments/"
 STATUS_PREFIX = "deployment_status/"
+
+_NAME_RE = re.compile(r"^[a-zA-Z0-9][a-zA-Z0-9_.-]{0,62}$")
+
+
+def validate_spec(name: str, replicas: int) -> Optional[str]:
+    """Returns an error string, or None. Names must be route- and
+    key-safe (no '/', non-empty — 'a/b' would be unreachable via the
+    api-server's {name} routes and '' would collide with the watch prefix
+    itself); replicas must be >= 0 (a negative count would make the
+    reconciler pop an empty list forever)."""
+    if not _NAME_RE.match(name or ""):
+        return f"invalid deployment name {name!r}"
+    if replicas < 0:
+        return f"replicas must be >= 0, got {replicas}"
+    return None
 
 
 @dataclasses.dataclass
@@ -69,3 +85,30 @@ class DeploymentStatus:
     @classmethod
     def from_json(cls, raw: bytes) -> "DeploymentStatus":
         return cls(**json.loads(raw))
+
+
+async def update_spec(store, name: str,
+                      mutate: Callable[[DeploymentSpec], Optional[str]],
+                      retries: int = 16) -> Optional[DeploymentSpec]:
+    """Compare-and-swap read-modify-write of a deployment spec: load,
+    apply ``mutate`` (returns an error string to abort), bump generation,
+    CAS against the loaded bytes; retry on contention. The ONE safe way
+    to update a spec — writers live in different processes (api-server,
+    llmctl), so local locks cannot serialize them.
+
+    Returns the written spec, None if the deployment doesn't exist.
+    Raises ValueError on a mutate error, RuntimeError if contention never
+    resolves."""
+    for _ in range(retries):
+        entry = await store.kv_get(SPEC_PREFIX + name)
+        if entry is None:
+            return None
+        spec = DeploymentSpec.from_json(entry.value)
+        err = mutate(spec)
+        if err:
+            raise ValueError(err)
+        spec.generation += 1
+        if await store.kv_cas(spec.key(), entry.value, spec.to_json()):
+            return spec
+    raise RuntimeError(f"update of deployment {name!r} kept losing CAS "
+                       f"races after {retries} attempts")

@@ -32,6 +32,24 @@ def build_parser() -> argparse.ArgumentParser:
     st = dsub.add_parser("set-threshold")
     st.add_argument("model")
     st.add_argument("value", type=int)
+
+    dep = sub.add_parser("deployment",
+                         help="manage graph deployments (deploy/ control "
+                              "plane — the api-server CRUD over the store)")
+    dpsub = dep.add_subparsers(dest="dep_cmd", required=True)
+    dc = dpsub.add_parser("create")
+    dc.add_argument("name")
+    dc.add_argument("graph", help="module:ServiceClass")
+    dc.add_argument("--config", help="service YAML path")
+    dc.add_argument("--replicas", type=int, default=1)
+    ds = dpsub.add_parser("scale")
+    ds.add_argument("name")
+    ds.add_argument("replicas", type=int)
+    dt = dpsub.add_parser("terminate")
+    dt.add_argument("name")
+    dd = dpsub.add_parser("delete")
+    dd.add_argument("name")
+    dpsub.add_parser("list")
     return p
 
 
@@ -65,9 +83,72 @@ async def amain(argv=None) -> int:
                 disagg_config_key(args.model),
                 json.dumps({"max_local_prefill_length": args.value}).encode())
             print(f"disagg threshold for {args.model} → {args.value}")
+        elif args.cmd == "deployment":
+            return await _deployment_cmd(runtime, args)
         return 0
     finally:
         await runtime.shutdown()
+
+
+async def _deployment_cmd(runtime, args) -> int:
+    """Deployment CRUD straight against the store (the controller watches
+    it; works whether the REST api-server is running or not). Updates go
+    through the shared CAS helper — the api-server is a concurrent writer
+    in another process, so plain read-modify-write would lose races."""
+    import json
+    import time
+
+    from ..deploy.spec import (SPEC_PREFIX, STATUS_PREFIX, DeploymentSpec,
+                               update_spec, validate_spec)
+
+    if args.dep_cmd == "create":
+        err = validate_spec(args.name, args.replicas)
+        if err:
+            print(err, file=sys.stderr)
+            return 1
+        spec = DeploymentSpec(name=args.name, graph=args.graph,
+                              config=args.config, replicas=args.replicas,
+                              created_at=time.time())
+        if not await runtime.store.kv_create(spec.key(), spec.to_json()):
+            print(f"deployment {args.name!r} already exists", file=sys.stderr)
+            return 1
+        print(f"created deployment {args.name} ({args.graph} "
+              f"x{args.replicas})")
+    elif args.dep_cmd in ("scale", "terminate"):
+        want = args.replicas if args.dep_cmd == "scale" else 0
+        err = validate_spec(args.name, want)
+        if err:
+            print(err, file=sys.stderr)
+            return 1
+
+        def mutate(spec: DeploymentSpec):
+            spec.replicas = want
+            return None
+
+        spec = await update_spec(runtime.store, args.name, mutate)
+        if spec is None:
+            print(f"not found: {args.name}", file=sys.stderr)
+            return 1
+        print(f"{args.dep_cmd}d {args.name} → replicas={spec.replicas}")
+    elif args.dep_cmd == "delete":
+        if not await runtime.store.kv_delete(SPEC_PREFIX + args.name):
+            print(f"not found: {args.name}", file=sys.stderr)
+            return 1
+        print(f"deleted {args.name}")
+    else:   # list
+        specs = await runtime.store.kv_get_prefix(SPEC_PREFIX)
+        statuses = {e.key[len(STATUS_PREFIX):]: json.loads(e.value)
+                    for e in await runtime.store.kv_get_prefix(STATUS_PREFIX)}
+        if not specs:
+            print("(no deployments)")
+        for e in sorted(specs, key=lambda x: x.key):
+            spec = DeploymentSpec.from_json(e.value)
+            status = statuses.get(spec.name, {})
+            print(f"{spec.name:24s} {spec.graph:40s} "
+                  f"replicas={spec.replicas} gen={spec.generation} "
+                  f"state={status.get('state', '?')} "
+                  f"ready={status.get('ready_replicas', '?')}")
+    return 0
 
 
 def main() -> None:

@@ -156,6 +156,12 @@ class KvStore(abc.ABC):
     @abc.abstractmethod
     async def kv_put(self, key: str, value: bytes, lease_id: int = 0) -> None: ...
 
+    async def kv_cas(self, key: str, expected: Optional[bytes],
+                     value: bytes, lease_id: int = 0) -> bool:
+        """Write iff current value == expected (None = absent). Default
+        raises — backends opt in (Memory + Net both do)."""
+        raise NotImplementedError
+
     @abc.abstractmethod
     async def kv_get(self, key: str) -> Optional[KvEntry]: ...
 
@@ -262,6 +268,22 @@ class MemoryKvStore(KvStore):
         self._kv[key] = e
         self._attach(key, lease_id)
         self._notify(WatchEvent(WatchEventType.PUT, e))
+
+    async def kv_cas(self, key: str, expected: Optional[bytes],
+                     value: bytes, lease_id: int = 0) -> bool:
+        """Compare-and-swap (etcd txn compare-put analog): write iff the
+        current value equals ``expected`` (None = key absent). The store's
+        only safe read-modify-write primitive — writers in DIFFERENT
+        processes cannot serialize with local locks."""
+        self._expire_due()
+        cur = self._kv.get(key)
+        if (cur.value if cur is not None else None) != expected:
+            return False
+        e = KvEntry(key, value, lease_id)
+        self._kv[key] = e
+        self._attach(key, lease_id)
+        self._notify(WatchEvent(WatchEventType.PUT, e))
+        return True
 
     async def kv_get(self, key: str) -> Optional[KvEntry]:
         self._expire_due()

@@ -241,6 +241,16 @@ class NetKvStore(KvStore):
                               lease=lease_id)
         self._record(key, value, lease_id)
 
+    async def kv_cas(self, key: str, expected, value: bytes,
+                     lease_id: int = 0) -> bool:
+        r = await self._conn.call(
+            "kv_cas", key=key,
+            expected=None if expected is None else _b64(expected),
+            value=_b64(value), lease=lease_id)
+        if r["result"]:
+            self._record(key, value, lease_id)
+        return bool(r["result"])
+
     async def kv_get(self, key: str) -> Optional[KvEntry]:
         r = await self._conn.call("kv_get", key=key)
         e = r.get("entry")

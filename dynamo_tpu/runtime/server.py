@@ -120,6 +120,12 @@ class _ClientSession:
                 await store.kv_put(msg["key"], _unb64(msg["value"]),
                                    msg.get("lease", 0))
                 await self.send({"rid": rid, "ok": True})
+            elif op == "kv_cas":
+                exp = msg.get("expected")
+                ok = await store.kv_cas(
+                    msg["key"], _unb64(exp) if exp is not None else None,
+                    _unb64(msg["value"]), msg.get("lease", 0))
+                await self.send({"rid": rid, "ok": True, "result": ok})
             elif op == "kv_get":
                 e = await store.kv_get(msg["key"])
                 await self.send({

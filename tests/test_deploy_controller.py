@@ -184,6 +184,47 @@ async def test_crash_replacement_keeps_replica_identity(stack):
     assert idxs == [0, 0, 1]
 
 
+async def test_llmctl_deployment_commands():
+    """The admin CLI drives the same store resources the controller
+    watches: create → running, scale, terminate, list, delete."""
+    from dynamo_tpu.launch.llmctl import amain as llmctl
+    from dynamo_tpu.runtime.server import DiscoveryServer
+
+    srv = DiscoveryServer(host="127.0.0.1")
+    await srv.start()
+    rt = await DistributedRuntime.connect(srv.address)
+    launcher = FakeLauncher()
+    controller = await DeploymentController(rt, launcher=launcher,
+                                            resync_interval=0.1).start()
+    addr = srv.address
+    try:
+        assert await llmctl(["--runtime-server", addr, "deployment",
+                             "create", "d9", "m:Svc",
+                             "--replicas", "2"]) == 0
+        # duplicate + invalid specs rejected
+        assert await llmctl(["--runtime-server", addr, "deployment",
+                             "create", "d9", "m:Svc"]) == 1
+        assert await llmctl(["--runtime-server", addr, "deployment",
+                             "create", "bad/name", "m:Svc"]) == 1
+        await wait_status(rt, "d9", lambda x: x["ready_replicas"] == 2)
+        assert await llmctl(["--runtime-server", addr, "deployment",
+                             "scale", "d9", "1"]) == 0
+        await wait_status(rt, "d9", lambda x: x["ready_replicas"] == 1)
+        assert await llmctl(["--runtime-server", addr, "deployment",
+                             "terminate", "d9"]) == 0
+        await wait_status(rt, "d9", lambda x: x["state"] == "terminated")
+        assert await llmctl(["--runtime-server", addr, "deployment",
+                             "list"]) == 0
+        assert await llmctl(["--runtime-server", addr, "deployment",
+                             "delete", "d9"]) == 0
+        assert await llmctl(["--runtime-server", addr, "deployment",
+                             "delete", "d9"]) == 1
+    finally:
+        await controller.stop()
+        await rt.shutdown()
+        await srv.close()
+
+
 async def test_real_subprocess_launcher():
     """One real replica process end-to-end (sleep stand-in for the graph):
     start → alive → stop terminates it."""

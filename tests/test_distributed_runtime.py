@@ -63,6 +63,33 @@ async def test_kvstore_create_watch_delete():
     w.close()
 
 
+async def test_kvstore_cas():
+    """Compare-and-swap: the store's only safe cross-process RMW
+    primitive (etcd txn compare-put analog; deployment spec updates
+    depend on it)."""
+    store = MemoryKvStore()
+    assert await store.kv_cas("k", None, b"v1")          # create-if-absent
+    assert not await store.kv_cas("k", None, b"v2")      # exists now
+    assert not await store.kv_cas("k", b"stale", b"v2")  # wrong expected
+    assert await store.kv_cas("k", b"v1", b"v2")
+    assert (await store.kv_get("k")).value == b"v2"
+
+
+async def test_netstore_cas_over_daemon():
+    from dynamo_tpu.runtime.server import DiscoveryServer
+    srv = DiscoveryServer(host="127.0.0.1")
+    await srv.start()
+    rt = await DistributedRuntime.connect(srv.address)
+    try:
+        assert await rt.store.kv_cas("k", None, b"v1")
+        assert not await rt.store.kv_cas("k", b"nope", b"v2")
+        assert await rt.store.kv_cas("k", b"v1", b"v2")
+        assert (await rt.store.kv_get("k")).value == b"v2"
+    finally:
+        await rt.shutdown()
+        await srv.close()
+
+
 async def test_lease_expiry_deletes_keys_and_fires_watch():
     t = [0.0]
     store = MemoryKvStore(now=lambda: t[0])
