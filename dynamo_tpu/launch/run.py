@@ -220,8 +220,8 @@ async def build_engine(args, out: str, runtime):
         model_cfg = ModelConfig.from_model_dir(args.model_path)
         params = None
         if not args.random_weights:
-            from ..engine.weights import load_llama_params
-            params = load_llama_params(args.model_path, model_cfg)
+            from ..engine.weights import load_params_auto
+            params = load_params_auto(args.model_path, model_cfg, mesh=mesh)
         core = EngineCore(model_cfg, engine_config(args), params=params,
                           mesh=mesh)
         engine = JaxEngine(core)

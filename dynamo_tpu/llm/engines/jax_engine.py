@@ -32,8 +32,15 @@ class JaxEngine(AsyncEngine):
         engine_cfg = engine_cfg or EngineConfig()
         params = None
         if load_weights:
-            from ...engine.weights import load_llama_params
-            params = load_llama_params(model_dir, model_cfg)
+            import jax.numpy as jnp
+
+            # load_params_auto streams each device's shard straight from
+            # disk when a mesh is given (host peak = one shard — the
+            # 70B-scale path)
+            from ...engine.weights import load_params_auto
+            params = load_params_auto(
+                model_dir, model_cfg, mesh=core_kwargs.get("mesh"),
+                dtype=core_kwargs.get("param_dtype", jnp.bfloat16))
         return cls(EngineCore(model_cfg, engine_cfg, params=params,
                               **core_kwargs))
 
