@@ -174,7 +174,7 @@ def load_llama_params_sharded(model_dir: str, mesh,
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from ..parallel.sharding import _spec_fits, param_pspecs
+    from ..parallel.sharding import fit_or_replicate, param_pspecs
     cfg = cfg or ModelConfig.from_model_dir(model_dir)
     L = cfg.num_layers
 
@@ -213,15 +213,8 @@ def load_llama_params_sharded(model_dir: str, mesh,
         params: Dict[str, jax.Array] = {}
         from .models.llama import param_shapes
         for pkey, shape in param_shapes(cfg).items():
-            spec = specs.get(pkey, P())
-            if spec != P() and not _spec_fits(shape, spec, mesh):
-                import logging
-                logging.getLogger("dynamo_tpu.engine.weights").warning(
-                    "param %s shape %s does not divide mesh axes for "
-                    "spec %s — replicating (costs %d bytes per extra "
-                    "device copy)", pkey, shape, spec,
-                    int(np.prod(shape)) * _np_dtype(dtype).itemsize)
-                spec = P()
+            spec = fit_or_replicate(pkey, shape, specs.get(pkey, P()),
+                                    mesh, _np_dtype(dtype).itemsize)
             sharding = NamedSharding(mesh, spec)
             if pkey in singles:
                 name, transpose = singles[pkey]

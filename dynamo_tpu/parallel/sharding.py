@@ -107,6 +107,21 @@ def _spec_fits(shape, spec: P, mesh: Mesh) -> bool:
     return True
 
 
+def fit_or_replicate(name: str, shape, spec: P, mesh: Mesh,
+                     itemsize: int) -> P:
+    """The one replication-fallback policy: return ``spec`` when it
+    divides the mesh, else warn (with the per-device byte cost) and
+    return the replicated spec. Used by shard_params AND the sharded
+    checkpoint loader so the two can't drift."""
+    if spec == P() or _spec_fits(shape, spec, mesh):
+        return spec
+    logger.warning(
+        "param %s shape %s does not divide mesh axes for spec %s — "
+        "replicating (costs %d bytes per extra device copy)",
+        name, tuple(shape), spec, int(np.prod(shape)) * itemsize)
+    return P()
+
+
 def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
     """Place params under their TP layout; params whose dims don't divide
     the mesh axes (e.g. an odd vocab size) are replicated instead.
@@ -140,12 +155,7 @@ def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
                     k, v.q.shape, spec, v.q.size * v.q.dtype.itemsize)
             out[k] = QuantizedArray(put(v.q, spec), put(v.scale, spec))
             continue
-        if not _spec_fits(v.shape, spec, mesh):
-            logger.warning(
-                "param %s shape %s does not divide mesh axes for spec %s — "
-                "replicating (costs %d bytes per extra device copy)",
-                k, v.shape, spec, v.size * v.dtype.itemsize)
-            spec = P()
+        spec = fit_or_replicate(k, v.shape, spec, mesh, v.dtype.itemsize)
         out[k] = put(v, spec)
     return out
 

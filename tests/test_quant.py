@@ -162,6 +162,56 @@ def test_int8_sharded_decode_matches_single_device():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.asyncio
+async def test_int8_engine_serving_on_mesh_matches_unsharded():
+    """The FULL serving engine with quantization=int8 on a tp=2 mesh
+    produces the single-device int8 engine's greedy stream (the
+    checkpoint-loaded composition — streamed shards → quantize — is
+    covered in test_sharded_weights)."""
+    import asyncio
+
+    import jax.numpy as jnp
+    from dynamo_tpu.engine.core import (FINISH_SENTINEL, EngineCore,
+                                        EngineRequest)
+    from dynamo_tpu.engine.sampling import SlotSampling
+    from dynamo_tpu.parallel.sharding import make_mesh
+
+    cfg = ModelConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=8, num_kv_heads=4, head_dim=8,
+                      max_position_embeddings=128,
+                      tie_word_embeddings=False)
+    ecfg = dict(max_model_len=64, kv_block_size=8, num_kv_blocks=24,
+                max_num_seqs=2, prefill_buckets=[16, 32],
+                quantization="int8")
+    rng = np.random.default_rng(11)
+    prompt = [int(t) for t in rng.integers(1, 200, size=12)]
+
+    async def run(core):
+        req = EngineRequest(rid="q", prompt=list(prompt),
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=6, eos_ids=frozenset())
+        await core.submit(req)
+        toks = []
+        while True:
+            item, _ = await asyncio.wait_for(req.out_queue.get(), 120)
+            if item is FINISH_SENTINEL:
+                return toks
+            toks.append(item)
+
+    solo = EngineCore(cfg, EngineConfig(**ecfg), attn_impl="xla",
+                      param_dtype=jnp.float32)
+    want = await run(solo)
+    await solo.stop()
+    assert len(want) == 6
+
+    sharded = EngineCore(cfg, EngineConfig(**ecfg), attn_impl="xla",
+                         param_dtype=jnp.float32,
+                         mesh=make_mesh(dp=1, tp=2))
+    got = await run(sharded)
+    await sharded.stop()
+    assert got == want
+
+
 def test_unknown_quantization_rejected():
     from dynamo_tpu.engine.core import EngineCore
     ecfg = EngineConfig(max_model_len=64, kv_block_size=BS,

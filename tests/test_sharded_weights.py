@@ -117,10 +117,16 @@ async def test_from_model_dir_with_mesh_uses_sharded_loader(ckpt_dir,
     eng = JaxEngine.from_model_dir(
         ckpt_dir,
         EngineConfig(max_model_len=64, kv_block_size=8, num_kv_blocks=16,
-                     max_num_seqs=2, prefill_buckets=[16, 32]),
+                     max_num_seqs=2, prefill_buckets=[16, 32],
+                     # int8 on top of sharded-loaded params: the EXACT
+                     # production 70B composition (run.py mesh launch →
+                     # streamed shards → quantize_params → serve)
+                     quantization="int8"),
         mesh=make_mesh(dp=1, tp=2), attn_impl="xla",
         param_dtype=jnp.float32)
     assert calls, "sharded loader not used for mesh engines"
+    from dynamo_tpu.engine.quant import QuantizedArray
+    assert isinstance(eng.core.params["layers.wq"], QuantizedArray)
     req = EngineRequest(rid="r", prompt=[3, 4, 5],
                         sampling=SlotSampling(temperature=0.0),
                         max_new_tokens=3, eos_ids=frozenset())
