@@ -149,6 +149,15 @@ def device_prefill_timing(core, prompt_len, prefill_args):
 
 
 def main() -> None:
+    # BENCH_FORCE_CPU=1: hermetic CPU run (smoke tests). The image's
+    # sitecustomize overrides JAX_PLATFORMS, so env alone does NOT keep
+    # jax off the tunneled TPU — a dead tunnel would hang the run.
+    if os.environ.get("BENCH_FORCE_CPU", "0") != "0":
+        import sys as _sys
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from __graft_entry__ import force_cpu_devices
+        force_cpu_devices(1)
+
     import numpy as np
     import jax
     import jax.numpy as jnp

@@ -144,16 +144,12 @@ def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
     for k, v in params.items():
         spec = specs.get(k, P())
         if isinstance(v, QuantizedArray):
-            # a q tensor failing to shard replicates the full int8 weight —
-            # warn like the plain path (scale fallback stays silent: for
-            # row-parallel weights replication IS the scale's layout)
-            if not _spec_fits(v.q.shape, spec, mesh):
-                logger.warning(
-                    "quantized param %s q shape %s does not divide mesh "
-                    "axes for spec %s — replicating (costs %d bytes per "
-                    "extra device copy)",
-                    k, v.q.shape, spec, v.q.size * v.q.dtype.itemsize)
-            out[k] = QuantizedArray(put(v.q, spec), put(v.scale, spec))
+            # shared fallback policy for the q tensor (scale fallback
+            # stays silent inside put(): for row-parallel weights
+            # replication IS the scale's correct layout)
+            q_spec = fit_or_replicate(k, v.q.shape, spec, mesh,
+                                      v.q.dtype.itemsize)
+            out[k] = QuantizedArray(put(v.q, q_spec), put(v.scale, spec))
             continue
         spec = fit_or_replicate(k, v.shape, spec, mesh, v.dtype.itemsize)
         out[k] = put(v, spec)

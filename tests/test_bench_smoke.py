@@ -23,11 +23,14 @@ def _run(cmd, env_extra, timeout=600):
 
 
 def test_bench_runs_and_prints_json():
-    """bench.py end to end on CPU with the tiny model: one compile dispatch
+    """bench.py end to end on FORCED CPU with the tiny model
+    (BENCH_FORCE_CPU: the sitecustomize overrides JAX_PLATFORMS, so env
+    alone would land these subprocesses on the tunneled TPU — and hang
+    the suite whenever the tunnel is down): one compile dispatch
     + a couple of timed dispatches, then the driver's ONE JSON line."""
     r = _run(
         [sys.executable, "bench.py"],
-        {"JAX_PLATFORMS": "cpu", "BENCH_MODEL": "tiny", "BENCH_BATCH": "4",
+        {"BENCH_FORCE_CPU": "1", "BENCH_MODEL": "tiny", "BENCH_BATCH": "4",
          "BENCH_STEPS": "8", "BENCH_PROMPT": "16", "BENCH_HARVEST": "4",
          "BENCH_QUANT": "none"})
     assert r.returncode == 0, f"bench.py crashed:\n{r.stderr[-4000:]}"
@@ -46,7 +49,7 @@ def test_bench_pipelined_and_unpipelined():
     for pipeline in ("0", "1"):
         r = _run(
             [sys.executable, "bench.py"],
-            {"JAX_PLATFORMS": "cpu", "BENCH_MODEL": "tiny",
+            {"BENCH_FORCE_CPU": "1", "BENCH_MODEL": "tiny",
              "BENCH_BATCH": "2", "BENCH_STEPS": "4", "BENCH_PROMPT": "8",
              "BENCH_HARVEST": "2", "BENCH_PIPELINE": pipeline,
              "BENCH_QUANT": "none"})
@@ -69,14 +72,18 @@ def test_dryrun_multichip_forces_cpu():
 
 
 def test_entry_compiles():
-    """entry() returns a jittable fn + args that run single-device."""
+    """entry() returns a jittable fn + args that run single-device.
+    Forced CPU (sitecustomize ignores JAX_PLATFORMS): the driver runs
+    entry() on the real chip; the TEST must not depend on the tunnel."""
     r = _run(
         [sys.executable, "-c",
-         "import jax, __graft_entry__ as g\n"
+         "import __graft_entry__ as g\n"
+         "g.force_cpu_devices(1)\n"
+         "import jax\n"
          "fn, args = g.entry()\n"
          "out = jax.jit(fn)(*args)\n"
          "jax.block_until_ready(out[0])\n"
          "print('entry OK', out[0].shape)"],
-        {"JAX_PLATFORMS": "cpu"})
+        {})
     assert r.returncode == 0, f"entry crashed:\n{r.stderr[-4000:]}"
     assert "entry OK" in r.stdout

@@ -21,8 +21,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 WORKER = textwrap.dedent("""
     import os, sys
-    os.environ["JAX_PLATFORMS"] = "cpu"
     sys.path.insert(0, {repo!r})
+    # force CPU via the shared helper: the image's sitecustomize ignores
+    # a bare JAX_PLATFORMS env, and a dead tunnel would hang the worker
+    from __graft_entry__ import force_cpu_devices
+    force_cpu_devices(1, check=False)
     from dynamo_tpu.parallel.multihost import (MultiNodeConfig,
                                                initialize_multihost,
                                                is_leader)
