@@ -41,6 +41,10 @@ def test_bench_runs_and_prints_json():
     for field in ("metric", "value", "unit", "vs_baseline"):
         assert field in out
     assert out["value"] > 0
+    # a crash replayed through the fallback would also print JSON with
+    # value>0 — this test is about main() actually running, so reject it
+    assert "error" not in out, f"bench fell back instead of running: {out}"
+    assert out["extra"]["platform"] == "cpu"
 
 
 def test_bench_pipelined_and_unpipelined():
@@ -55,6 +59,86 @@ def test_bench_pipelined_and_unpipelined():
              "BENCH_QUANT": "none"})
         assert r.returncode == 0, (
             f"bench.py pipeline={pipeline} crashed:\n{r.stderr[-4000:]}")
+        out = json.loads([l for l in r.stdout.strip().splitlines()
+                          if l.startswith("{")][-1])
+        assert "error" not in out, (
+            f"pipeline={pipeline} fell back instead of running: {out}")
+
+
+def test_bench_failure_emits_structured_fallback():
+    """Round-1 AND round-2 postmortem (VERDICT r2 item 1): a failed bench
+    must never again produce rc=1 with no parseable output. Force a failure
+    (BENCH_SELFTEST_FAIL) and assert ONE JSON line comes out with an
+    `error` field, provenance, and the last committed device-truth values
+    replayed from BENCH_LOCAL.jsonl."""
+    r = _run([sys.executable, "bench.py"], {"BENCH_SELFTEST_FAIL": "1"})
+    assert r.returncode == 0, f"fallback path crashed:\n{r.stderr[-4000:]}"
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    assert lines, f"no JSON line on failure: {r.stdout!r}"
+    out = json.loads(lines[-1])
+    for field in ("metric", "value", "unit", "vs_baseline", "error",
+                  "provenance"):
+        assert field in out, f"missing {field}: {out}"
+    assert "selftest: forced failure" in out["error"]
+    # BENCH_LOCAL.jsonl is committed with at least one device-truth entry;
+    # the fallback must replay it rather than report zeros.
+    if os.path.exists(os.path.join(REPO, "BENCH_LOCAL.jsonl")):
+        assert out["value"] > 0
+        assert "NOT measured this run" in out["provenance"]
+
+
+def test_bench_fallback_without_history(tmp_path):
+    """With no BENCH_LOCAL.jsonl at all, the fallback still prints a
+    parseable line (value 0, explicit 'no committed bench history')."""
+    import shutil
+    shutil.copy(os.path.join(REPO, "bench.py"), tmp_path / "bench.py")
+    env = dict(os.environ)
+    env["BENCH_SELFTEST_FAIL"] = "1"
+    r = subprocess.run([sys.executable, "bench.py"], cwd=tmp_path, env=env,
+                       timeout=120, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads([l for l in r.stdout.strip().splitlines()
+                      if l.startswith("{")][-1])
+    assert out["value"] == 0.0
+    assert "no committed bench history" in out["provenance"]
+
+
+def test_bench_probe_retry_exhaustion(tmp_path, monkeypatch):
+    """The probe retry loop exhausts against a python that always fails
+    and raises the structured 'unavailable after N probes' error (which
+    __main__ then turns into the fallback line)."""
+    import pytest
+
+    fake_py = tmp_path / "nopy"
+    fake_py.write_text("#!/bin/sh\nexit 7\n")
+    fake_py.chmod(0o755)
+    monkeypatch.syspath_prepend(REPO)
+    import importlib
+    bench = importlib.import_module("bench")
+    monkeypatch.setenv("BENCH_PROBE_ATTEMPTS", "2")
+    monkeypatch.setenv("BENCH_PROBE_FAST", "1")
+    monkeypatch.setattr(sys, "executable", str(fake_py))
+    with pytest.raises(RuntimeError, match="unavailable after"):
+        bench._probe_backend_with_retry()
+
+
+def test_bench_probe_rejects_cpu_landing(tmp_path, monkeypatch):
+    """A probe that 'succeeds' on the CPU backend is a dead tunnel, not a
+    live accelerator — the probe must treat it as a failure so the bench
+    never silently reports CPU numbers as official device truth."""
+    import pytest
+
+    fake_py = tmp_path / "cpupy"
+    fake_py.write_text("#!/bin/sh\necho 'cpu TFRT_CPU_0'\n")
+    fake_py.chmod(0o755)
+    monkeypatch.syspath_prepend(REPO)
+    import importlib
+    bench = importlib.import_module("bench")
+    monkeypatch.setenv("BENCH_PROBE_ATTEMPTS", "2")
+    monkeypatch.setenv("BENCH_PROBE_FAST", "1")
+    monkeypatch.setattr(sys, "executable", str(fake_py))
+    with pytest.raises(RuntimeError, match="unavailable after"):
+        bench._probe_backend_with_retry()
 
 
 def test_dryrun_multichip_forces_cpu():
