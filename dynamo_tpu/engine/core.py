@@ -809,7 +809,11 @@ class EngineCore:
             send(), name=f"kv-handoff-{req.rid}")
         self._handoff_tasks.add(task)
         task.add_done_callback(self._handoff_tasks.discard)
-        self._emit(req, tok, logprob)
+        if not req.handoff_device:
+            # device mode keeps tok/logprob as device scalars (the token
+            # rides the payload; no host sync here) — emitting them would
+            # hand device arrays to a queue whose contract is host values
+            self._emit(req, tok, logprob)
         self._release_slot(req)
         self._finish_request(req, FinishReason.LENGTH)
 

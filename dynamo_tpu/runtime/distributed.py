@@ -24,12 +24,14 @@ protocol layer (dataclasses) and tests (plain dicts) share the same plane.
 from __future__ import annotations
 
 import asyncio
+import collections
 import dataclasses
 import itertools
 import json
 import logging
 import os
 import random
+import time
 import uuid
 from typing import Any, AsyncIterator, Callable, Dict, List, Optional
 
@@ -300,6 +302,39 @@ class EndpointServer:
         self._stats_task: Optional[asyncio.Task] = None
         self._inflight: set = set()
         self._stopping = False
+        # fire-and-forget dedup window (ADVICE r2): the client's dispatch
+        # retry is at-least-once; for streaming requests duplicates are
+        # harmless (the client consumes only the last dialed-back stream),
+        # but a request WITHOUT connection info has no stream to
+        # disambiguate and real side effects — drop repeats of its id.
+        self._recent_ff_ids: "collections.OrderedDict[str, float]" = \
+            collections.OrderedDict()
+
+    RECENT_ID_WINDOW = 60.0
+    RECENT_ID_MAX = 4096
+
+    def _ff_duplicate(self, rid: str) -> bool:
+        """Record rid; True if it was already accepted inside the window."""
+        now = time.monotonic()
+        while self._recent_ff_ids:     # expire by age BEFORE the check, so
+            oldest_id, t = next(iter(self._recent_ff_ids.items()))
+            if now - t <= self.RECENT_ID_WINDOW:
+                break
+            del self._recent_ff_ids[oldest_id]
+        if rid in self._recent_ff_ids:
+            return True
+        self._recent_ff_ids[rid] = now
+        while len(self._recent_ff_ids) > self.RECENT_ID_MAX:
+            # capacity-evict AFTER inserting — evicting first could evict
+            # rid's own prior entry and accept the duplicate as new
+            self._recent_ff_ids.popitem(last=False)
+        return False
+
+    def _ff_forget(self, rid: str) -> None:
+        """The request did NOT execute — let a redelivery run it (recording
+        at accept time and forgetting on failure keeps concurrent in-flight
+        duplicates deduped without turning transient failures into drops)."""
+        self._recent_ff_ids.pop(rid, None)
 
     @property
     def lease_id(self) -> int:
@@ -347,6 +382,10 @@ class EndpointServer:
             logger.exception("undecodable request envelope")
             return
         info = ctrl.connection_info
+        if info is None and self._ff_duplicate(ctrl.id):
+            logger.warning("dropping duplicate fire-and-forget request %s "
+                           "(at-least-once re-dispatch)", ctrl.id)
+            return
         sender: Optional[StreamSender] = None
         try:
             request = self.decode_req(body)
@@ -354,6 +393,8 @@ class EndpointServer:
             if info is not None:
                 sender = await open_stream_sender(info, error=str(e))
                 await sender.finish()
+            else:
+                self._ff_forget(ctrl.id)
             return
         from .engine import EngineContext
         from .tracing import Trace, span, use_trace
@@ -369,10 +410,16 @@ class EndpointServer:
                     if info is not None:
                         sender = await open_stream_sender(info, error=str(e))
                         await sender.finish()
+                    else:
+                        self._ff_forget(ctrl.id)
                     return
             if info is None:
-                async for _ in stream:   # fire-and-forget request type
-                    pass
+                try:
+                    async for _ in stream:   # fire-and-forget request type
+                        pass
+                except Exception:
+                    self._ff_forget(ctrl.id)
+                    raise
                 return
             with span("dial_back"):
                 sender = await open_stream_sender(info)

@@ -54,7 +54,13 @@ class _Conn:
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
         self._next_rid = 1
-        self._pending: Dict[int, asyncio.Future] = {}
+        # rid → (future, connection epoch the request was written on).
+        # Epoch tagging closes a reconnect race: a future written on
+        # connection N must be failed when N dies, even if connection N+1
+        # is already up by the time N's read loop unwinds — otherwise the
+        # caller awaits a reply that can never arrive.
+        self._pending: Dict[int, tuple] = {}
+        self._epoch = 0
         self._push_watch: Dict[int, PrefixWatcher] = {}
         self._push_sub: Dict[int, Subscription] = {}
         # replay registries: wid → prefix; sid → (op, kwargs)
@@ -77,12 +83,28 @@ class _Conn:
         host, port = self.addr.rsplit(":", 1)
         reader, writer = await asyncio.wait_for(
             asyncio.open_connection(host, int(port)), timeout)
+        old_task = self._reader_task
+        self._epoch += 1
         self.reader, self.writer = reader, writer
         self._connected = True
         self._reader_task = asyncio.get_running_loop().create_task(
-            self._read_loop(reader), name="netstore-demux")
+            self._read_loop(reader, self._epoch), name="netstore-demux")
+        # requests written to the replaced socket can never be answered —
+        # fail them now rather than waiting for the old read loop to unwind
+        self._fail_pending_epochs(self._epoch - 1)
+        if old_task is not None:
+            old_task.cancel()
 
-    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+    def _fail_pending_epochs(self, max_epoch: int) -> None:
+        stale = [rid for rid, (_f, ep) in self._pending.items()
+                 if ep <= max_epoch]
+        for rid in stale:
+            fut, _ep = self._pending.pop(rid)
+            if not fut.done():
+                fut.set_exception(ConnectionError("daemon connection lost"))
+
+    async def _read_loop(self, reader: asyncio.StreamReader,
+                         epoch: int) -> None:
         try:
             while True:
                 msg = await recv_msg(reader)
@@ -91,22 +113,18 @@ class _Conn:
                 if "push" in msg:
                     self._route_push(msg)
                     continue
-                fut = self._pending.pop(msg.get("rid"), None)
-                if fut is not None and not fut.done():
-                    fut.set_result(msg)
+                entry = self._pending.pop(msg.get("rid"), None)
+                if entry is not None and not entry[0].done():
+                    entry[0].set_result(msg)
         except (ConnectionError, ValueError):
             pass
         finally:
+            # fail exactly the requests written on THIS connection (or an
+            # older one) — futures tagged with a newer epoch belong to the
+            # replacement connection (replay calls) and must survive
+            self._fail_pending_epochs(epoch)
             if reader is self.reader:    # a stale loop must not clobber a
-                self._connected = False  # newer connection's state —
-                # including the pending futures: if a NEWER connection is
-                # already up, those futures belong to IT (replay calls);
-                # failing them here would abort the replay silently
-                for fut in self._pending.values():
-                    if not fut.done():
-                        fut.set_exception(
-                            ConnectionError("daemon connection lost"))
-                self._pending.clear()
+                self._connected = False  # newer connection's state
                 if not self.closed and (self._watch_reg or self._sub_reg):
                     # push consumers (watches/subscriptions) make no calls
                     # of their own — reconnect eagerly on their behalf
@@ -169,12 +187,17 @@ class _Conn:
         rid = self._next_rid
         self._next_rid += 1
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending[rid] = fut
         try:
             async with self._write_lock:
-                await send_msg(self.writer, {"rid": rid, "op": op, **kwargs})
+                # snapshot writer+epoch with no await in between so the
+                # future is tagged with the connection it is written on
+                writer, epoch = self.writer, self._epoch
+                self._pending[rid] = (fut, epoch)
+                await send_msg(writer, {"rid": rid, "op": op, **kwargs})
         except (OSError, ConnectionError) as e:
             self._pending.pop(rid, None)
+            if fut.done():
+                fut.exception()   # consume — a racing epoch-fail set it
             self._connected = False
             raise ConnectionError(str(e))
         reply = await fut

@@ -51,6 +51,7 @@ class Trace:
         self.request_id = request_id
         self.role = role
         self.start = time.monotonic()
+        self.finished: Optional[float] = None   # set by Tracer.finish
         self.spans: List[Span] = []
 
     @contextlib.contextmanager
@@ -68,10 +69,11 @@ class Trace:
         self.spans.append(Span(name=name, start=t, end=t, attrs=attrs))
 
     def to_dict(self) -> dict:
+        end = self.finished if self.finished is not None else time.monotonic()
         return {
             "request_id": self.request_id,
             "role": self.role,
-            "total_ms": round(1e3 * (time.monotonic() - self.start), 2),
+            "total_ms": round(1e3 * (end - self.start), 2),
             "spans": [{"name": s.name, "ms": round(s.ms, 2),
                        "at_ms": round(1e3 * (s.start - self.start), 2),
                        **({"attrs": s.attrs} if s.attrs else {})}
@@ -87,18 +89,24 @@ class Tracer:
         self.completed = 0
 
     def finish(self, trace: Trace) -> None:
-        d = trace.to_dict()
-        self._recent.append(d)
+        # store the Trace OBJECT and serialize lazily: code holding a
+        # captured reference (e.g. the engine's stream_response) may append
+        # events after use_trace exits, and those must still show up in
+        # /traces (ADVICE r2). total_ms freezes here, not at read time.
+        trace.finished = time.monotonic()
+        self._recent.append(trace)
         self.completed += 1
+        d = trace.to_dict()
         logger.info("trace %s [%s] %.1fms: %s", trace.request_id,
                     trace.role, d["total_ms"],
                     " ".join(f"{s['name']}={s['ms']}ms" for s in d["spans"]))
 
     def recent(self, n: int = 32) -> List[dict]:
-        return list(self._recent)[-n:]
+        return [t.to_dict() for t in list(self._recent)[-n:]]
 
     def find(self, request_id: str) -> List[dict]:
-        return [t for t in self._recent if t["request_id"] == request_id]
+        return [t.to_dict() for t in self._recent
+                if t.request_id == request_id]
 
 
 tracer = Tracer()

@@ -52,6 +52,41 @@ async def test_calls_retry_across_restart():
         await srv.close()
 
 
+async def test_pending_futures_fail_on_connection_replacement():
+    """ADVICE r2 (medium): a reply future written on connection N must be
+    failed when N is replaced by N+1 — before epoch tagging, the old read
+    loop saw `reader is not self.reader`, skipped the pending sweep, and
+    the caller awaited forever."""
+    srv = DiscoveryServer(host="127.0.0.1")
+    await srv.start()
+    rt = await DistributedRuntime.connect(srv.address)
+    try:
+        conn = rt.store._conn
+        loop = asyncio.get_running_loop()
+        orphan = loop.create_future()
+        conn._pending[999_999] = (orphan, conn._epoch)
+        # the replacement connection comes up while the old read loop is
+        # still alive — exactly the race window
+        await conn._establish()
+        with pytest.raises(ConnectionError):
+            await asyncio.wait_for(orphan, 5.0)
+        # a future tagged with the NEW epoch (a replay call) must survive
+        # the old epochs being swept
+        survivor = loop.create_future()
+        conn._pending[999_998] = (survivor, conn._epoch)
+        conn._fail_pending_epochs(conn._epoch - 1)
+        assert not survivor.done()
+        del conn._pending[999_998]
+        survivor.cancel()
+        # the connection still serves calls after the churn
+        await rt.store.kv_put("k-after", b"v")
+        e = await rt.store.kv_get("k-after")
+        assert e is not None and e.value == b"v"
+    finally:
+        await rt.shutdown()
+        await srv.close()
+
+
 async def test_lease_reclaimed_and_keys_replayed():
     srv = DiscoveryServer(host="127.0.0.1")
     await srv.start()

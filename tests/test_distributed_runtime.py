@@ -345,3 +345,66 @@ async def test_networked_lease_expiry_removes_instance():
         await caller_rt.shutdown()
         await worker_rt.shutdown()
         await daemon.close()
+
+
+async def test_fire_and_forget_duplicate_dropped():
+    """ADVICE r2: dispatch retry is at-least-once; a fire-and-forget
+    request (no connection info → no stream for the client to
+    disambiguate) must not execute twice on the same worker. Streaming
+    requests intentionally stay at-least-once (client consumes only the
+    last dialed-back stream)."""
+    from dynamo_tpu.runtime.codec import (RequestControlMessage,
+                                          encode_two_part)
+    from dynamo_tpu.runtime.distributed import EndpointServer
+
+    calls = []
+
+    class Eng:
+        async def generate(self, ctx):
+            calls.append(1)
+
+            async def gen():
+                yield b"ok"
+            return gen()
+
+    srv = EndpointServer(endpoint=None, engine=Eng(),
+                         decode_req=lambda b: b, encode_resp=lambda x: x)
+    payload = encode_two_part(
+        RequestControlMessage(id="ff-1", connection_info=None), b"body")
+    await srv._handle(payload)
+    await srv._handle(payload)          # duplicate redelivery
+    assert len(calls) == 1
+    payload2 = encode_two_part(
+        RequestControlMessage(id="ff-2", connection_info=None), b"body")
+    await srv._handle(payload2)         # distinct id still served
+    assert len(calls) == 2
+
+
+async def test_fire_and_forget_retry_after_failure_executes():
+    """Transient failure must NOT consume the dedup slot: a redelivery
+    after the engine rejected the first attempt gets executed."""
+    from dynamo_tpu.runtime.codec import (RequestControlMessage,
+                                          encode_two_part)
+    from dynamo_tpu.runtime.distributed import EndpointServer
+
+    calls = []
+
+    class FlakyEng:
+        async def generate(self, ctx):
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("transient overload")
+
+            async def gen():
+                yield b"ok"
+            return gen()
+
+    srv = EndpointServer(endpoint=None, engine=FlakyEng(),
+                         decode_req=lambda b: b, encode_resp=lambda x: x)
+    payload = encode_two_part(
+        RequestControlMessage(id="ff-retry", connection_info=None), b"body")
+    await srv._handle(payload)          # attempt 1: engine rejects
+    await srv._handle(payload)          # redelivery: must run
+    assert len(calls) == 2
+    await srv._handle(payload)          # second success IS a duplicate
+    assert len(calls) == 2

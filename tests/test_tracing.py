@@ -130,3 +130,22 @@ async def test_distributed_roundtrip_traces_both_sides(caplog):
         await rt_w.shutdown()
         await rt_c.shutdown()
         await srv.close()
+
+
+async def test_late_events_visible_in_ring_buffer():
+    """ADVICE r2: events appended AFTER use_trace exits (by code holding a
+    captured Trace reference, e.g. the engine's stream loop) must still
+    appear in the ring buffer — traces serialize lazily, and total_ms is
+    frozen at finish time."""
+    t = Trace("late-req", role="test")
+    with use_trace(t):
+        t.event("early")
+    total_at_finish = t.to_dict()["total_ms"]
+    await asyncio.sleep(0.02)
+    t.event("late_first_token")
+    found = tracer.find("late-req")
+    assert found, "finished trace missing from ring buffer"
+    names = [s["name"] for s in found[-1]["spans"]]
+    assert "early" in names and "late_first_token" in names
+    # total_ms does not grow with wall time after finish
+    assert found[-1]["total_ms"] == pytest.approx(total_at_finish, abs=1.0)
