@@ -1,0 +1,228 @@
+"""Multi-host single-engine serving: leader drives, followers live-replay.
+
+The reference runs one engine across hosts with Ray leader/follower
+(lib/llm/src/engines/vllm/ray.rs:1-387, vllm.rs:39-87) and sglang's
+per-rank subprocess split (lib/llm/src/engines/sglang/worker.rs:304-336).
+The TPU-native analog is multi-controller SPMD: every process holds the
+same jitted programs over one global ``jax.sharding.Mesh`` (formed by
+``parallel.multihost.initialize_multihost``); XLA collectives span hosts
+over ICI/DCN. What still needs framework plumbing is HOST control flow:
+every process must issue the SAME sequence of device programs with the
+SAME host inputs, or the collectives deadlock.
+
+Design: the leader runs the real engine — scheduler, HTTP ingress, KV
+manager, detokenizer — exactly as on one host. Its scheduler decisions
+already stream through the :class:`engine.replay.Recorder` event format
+(every dispatched program's host inputs, in device order). A follower is
+a live replay consumer: it receives that stream over TCP and issues the
+identical programs against its own EngineCore (same config, same weights
+path, same global mesh). Device state (params, KV pool) stays
+bit-identical by induction; sampled tokens come back replicated, the
+leader harvests them (rank-0 token egress), followers drop theirs.
+
+Lockstep comes for free from XLA: if the leader runs ahead, its programs
+wait at the first cross-host collective until the follower catches up;
+the leader's event send happens synchronously BEFORE its own dispatch,
+so the follower can always make progress.
+
+Wire format: length-prefixed pickle frames of the recorder's numpy-only
+event dicts. The stream shares the deployment's trust domain with
+``jax.distributed`` itself (same hosts, same network) — it is an
+intra-engine control channel, not a public endpoint.
+
+Unsupported on the multihost engine (the recorder marks these paths and
+the follower refuses rather than silently diverge): sp/chunked prefill
+admissions, host-KV-tier restores, and disagg KV onboarding.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import socket
+import struct
+import time
+from collections import OrderedDict
+from typing import List, Optional
+
+import numpy as np
+
+from .replay import Recorder
+
+logger = logging.getLogger("dynamo_tpu.engine.multihost")
+
+__all__ = ["DispatchStreamLeader", "connect_follower", "run_follower"]
+
+# events a follower needs for device-state lockstep; everything else the
+# recorder sees (admit/harvest/first_token/preempt/release) is leader-side
+# host bookkeeping
+WIRE_EVENTS = frozenset(
+    {"prefill", "dispatch", "hit_transfer", "prefill_unsupported"})
+_SHUTDOWN = {"ev": "__shutdown__"}
+
+_LEN = struct.Struct(">I")
+
+
+def _send_frame(sock: socket.socket, obj: dict) -> None:
+    data = pickle.dumps(obj, protocol=5)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("dispatch stream closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> dict:
+    (n,) = _LEN.unpack(_recv_exact(sock, 4))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class DispatchStreamLeader(Recorder):
+    """Leader-side recorder that forwards device-order events to follower
+    sockets instead of buffering them.
+
+    Attach as ``core.recorder``. ``rec`` sends synchronously (blocking
+    sendall) so the event is on the wire BEFORE the leader's own jit
+    dispatch for that event — the ordering that makes follower progress
+    independent of the leader's device state. TCP backpressure bounds
+    leader run-ahead naturally.
+    """
+
+    def __init__(self, port: int, num_followers: int,
+                 host: str = "0.0.0.0", accept_timeout: float = 120.0):
+        super().__init__()
+        self._listener = socket.create_server((host, port))
+        self.port = self._listener.getsockname()[1]
+        self.num_followers = num_followers
+        self._accept_timeout = accept_timeout
+        self.socks: List[socket.socket] = []
+        self.sent = 0
+
+    def attach(self, core) -> None:
+        """Validate the engine is in a configuration whose EVERY device
+        program flows through the recorder stream, then become its
+        recorder. A program the follower never hears about deadlocks the
+        first cross-host collective (the single-step `_decode_jit` path
+        taught us this the hard way — it is unrecorded by design)."""
+        if core._decode_k_jit is None:
+            raise ValueError(
+                "multihost serving requires decode_steps_per_dispatch > 1 "
+                "(the single-step decode path is not in the dispatch "
+                "stream)")
+        if core.cfg.host_kv_blocks > 0:
+            raise ValueError(
+                "multihost serving requires host_kv_blocks=0 (host-tier "
+                "restores are not replayable on followers)")
+        if core.cfg.prefill_chunk > 0:
+            raise ValueError(
+                "multihost serving requires prefill_chunk=0 (chunked "
+                "prefill admissions are not in the dispatch stream)")
+        if core.mesh is not None and core.mesh.shape.get("sp", 1) > 1:
+            raise ValueError(
+                "multihost serving does not support sp>1 yet (ring-prefill "
+                "admissions are not in the dispatch stream)")
+        core.recorder = self
+
+    def wait_for_followers(self) -> None:
+        """Block until every follower has connected."""
+        self._listener.settimeout(self._accept_timeout)
+        while len(self.socks) < self.num_followers:
+            try:
+                s, addr = self._listener.accept()
+            except socket.timeout:
+                raise TimeoutError(
+                    f"only {len(self.socks)}/{self.num_followers} followers "
+                    f"connected within {self._accept_timeout}s")
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self.socks.append(s)
+            logger.info("follower %d/%d connected from %s",
+                        len(self.socks), self.num_followers, addr)
+
+    def rec(self, ev: str, **kw) -> None:
+        if ev not in WIRE_EVENTS:
+            return
+        kw["ev"] = ev
+        for s in self.socks:
+            _send_frame(s, kw)
+        self.sent += 1
+
+    def close(self) -> None:
+        for s in self.socks:
+            try:
+                _send_frame(s, _SHUTDOWN)
+                s.close()
+            except OSError:
+                pass
+        self._listener.close()
+
+
+def connect_follower(addr: str, timeout: float = 120.0) -> socket.socket:
+    """Dial the leader's dispatch stream, retrying while it boots."""
+    host, port = addr.rsplit(":", 1)
+    deadline = time.monotonic() + timeout
+    delay = 0.1
+    while True:
+        try:
+            sock = socket.create_connection((host, int(port)), timeout=5.0)
+            sock.settimeout(None)   # connect timeout only — the stream
+            # idles for as long as the leader has nothing to dispatch
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return sock
+        except OSError:
+            if time.monotonic() + delay > deadline:
+                raise
+            time.sleep(delay)
+            delay = min(delay * 2, 1.0)
+
+
+def run_follower(core, sock: socket.socket,
+                 max_chain_keep: int = 8) -> dict:
+    """Consume the leader's dispatch stream against a local EngineCore
+    until shutdown. Blocking; run as the follower process's main loop.
+
+    The event→program marshalling is shared with the offline replayer
+    (replay.exec_prefill_event / exec_dispatch_event) so the jit-call
+    signatures live in exactly one place; this loop only adds the live
+    carry (``core.kv``) and a bounded chain window.
+    """
+    from .replay import exec_dispatch_event, exec_prefill_event
+
+    disp_toks: "OrderedDict[int, object]" = OrderedDict()
+    stats = {"prefills": 0, "dispatches": 0}
+
+    while True:
+        ev = _recv_frame(sock)
+        kind = ev["ev"]
+        logger.debug("follower event %s", kind)
+        if kind == "__shutdown__":
+            break
+        if kind == "prefill_unsupported":
+            raise NotImplementedError(
+                f"leader used an admission path the multihost follower "
+                f"cannot replay ({ev.get('path')}, rid={ev.get('rid')}); "
+                f"disable sp/chunked prefill on a multihost engine")
+        if kind == "hit_transfer":
+            if int(ev.get("host_hit", 0)) > 0:
+                raise NotImplementedError(
+                    "host-KV-tier restore is not replayable on a follower; "
+                    "disable host offload on a multihost engine")
+            continue   # device-state no-op: prefix hits reuse resident KV
+        if kind == "prefill":
+            _tok, core.kv = exec_prefill_event(core, core.kv, ev)
+            stats["prefills"] += 1
+        elif kind == "dispatch":
+            chain = (disp_toks[ev["chained_from"]]
+                     if ev["chained_from"] is not None else None)
+            toks_k, core.kv = exec_dispatch_event(core, core.kv, ev, chain)
+            disp_toks[ev["id"]] = toks_k
+            while len(disp_toks) > max_chain_keep:
+                disp_toks.popitem(last=False)
+            stats["dispatches"] += 1
+    logger.info("follower done: %s", stats)
+    return stats

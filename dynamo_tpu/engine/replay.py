@@ -55,6 +55,55 @@ class Recorder:
 # --------------------------------------------------------------------------
 
 
+def exec_prefill_event(core, kv, ev: dict):
+    """Issue the recorded prefill program against `kv`. The ONE place the
+    recorded-event → _prefill_jit argument marshalling lives (used by both
+    the offline replayer below and the live multihost follower,
+    engine/multihost.py). Returns (tok_device, kv)."""
+    import jax.numpy as jnp
+
+    from .sampling import make_slot_keys
+
+    key = make_slot_keys(core.cfg.seed, jnp.asarray([ev["samp_seed"]]),
+                         jnp.asarray(ev["key_step"]))[0]
+    tok, _lp, kv = core._prefill_jit(
+        core.params, kv,
+        jnp.asarray(ev["padded"]), jnp.asarray(ev["table"]),
+        jnp.asarray(ev["start_pos"], jnp.int32),
+        jnp.asarray(ev["true_len"], jnp.int32), key,
+        jnp.asarray(ev["temp"], jnp.float32),
+        jnp.asarray(ev["top_k"], jnp.int32),
+        jnp.asarray(ev["top_p"], jnp.float32))
+    return tok, kv
+
+
+def exec_dispatch_event(core, kv, ev: dict, chain):
+    """Issue the recorded K-step decode dispatch against `kv`. ``chain`` is
+    the chained-from dispatch's [K, B] device tokens (None when host-fed).
+    Single home of the event → _decode_k_jit marshalling, like
+    exec_prefill_event. Returns (toks_k, kv)."""
+    import jax.numpy as jnp
+
+    host_tokens = jnp.array(np.asarray(ev["tokens"]))
+    if ev["chained_from"] is not None:
+        tokens_in = core._merge_jit(
+            chain[-1], host_tokens, jnp.array(np.asarray(ev["mask"])))
+    else:
+        tokens_in = host_tokens
+    K = int(ev["K"])
+    B = np.asarray(ev["tokens"]).shape[0]
+    planned = np.asarray(ev.get("planned", np.zeros((K, B), np.int32)))
+    pmask = np.asarray(ev.get("planned_mask", np.zeros((K, B), bool)))
+    toks_k, _lps, kv = core._decode_k_jit(
+        core.params, kv, tokens_in,
+        jnp.array(ev["positions"]), jnp.array(ev["tables"]),
+        jnp.array(ev["seeds"]), jnp.array(ev["steps"]),
+        jnp.array(ev["temperature"]), jnp.array(ev["top_k"]),
+        jnp.array(ev["top_p"]),
+        jnp.array(planned), jnp.array(pmask))
+    return toks_k, kv
+
+
 def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
     """Re-execute the recorded schedule against a fresh KV cache, strictly
     synchronously. `core` supplies params and compiled jits (its own KV is
@@ -62,11 +111,8 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
     "fingerprints": [(label, digest), ...]}.
     """
     import jax
-    import jax.numpy as jnp
 
-    from . import core as core_mod  # noqa: F401 (parity of import style)
     from .models import llama
-    from .sampling import make_slot_keys
 
     dtype = jax.tree_util.tree_leaves(core.params)[0].dtype
     kv = llama.init_kv_cache(core.model_cfg, core.cfg.num_kv_blocks,
@@ -121,17 +167,7 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                         f"mismatches; start recording before any prefix "
                         f"blocks are stored")
         if kind == "prefill":
-            key = make_slot_keys(core.cfg.seed,
-                                 jnp.asarray([ev["samp_seed"]]),
-                                 jnp.asarray(ev["key_step"]))[0]
-            tok, _lp, kv = core._prefill_jit(
-                core.params, kv,
-                jnp.asarray(ev["padded"]), jnp.asarray(ev["table"]),
-                jnp.asarray(ev["start_pos"], jnp.int32),
-                jnp.asarray(ev["true_len"], jnp.int32), key,
-                jnp.asarray(ev["temp"], jnp.float32),
-                jnp.asarray(ev["top_k"], jnp.int32),
-                jnp.asarray(ev["top_p"], jnp.float32))
+            tok, kv = exec_prefill_event(core, kv, ev)
             tok = jax.block_until_ready(tok)
             out["prefill"][ev["pf_seq"]] = int(tok)
             table = np.asarray(ev["table"])
@@ -141,26 +177,10 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                 for p in range(start, start + n))
             fp(("prefill", ev["pf_seq"]))
         elif kind == "dispatch":
-            host_tokens = jnp.array(np.asarray(ev["tokens"]))
-            if ev["chained_from"] is not None:
-                chain = disp_toks[ev["chained_from"]][-1]
-                tokens_in = core._merge_jit(
-                    chain, host_tokens, jnp.array(np.asarray(ev["mask"])))
-            else:
-                tokens_in = host_tokens
             K = int(ev["K"])
-            B = np.asarray(ev["tokens"]).shape[0]
-            planned = np.asarray(ev.get("planned",
-                                        np.zeros((K, B), np.int32)))
-            pmask = np.asarray(ev.get("planned_mask",
-                                      np.zeros((K, B), bool)))
-            toks_k, _lps, kv = core._decode_k_jit(
-                core.params, kv, tokens_in,
-                jnp.array(ev["positions"]), jnp.array(ev["tables"]),
-                jnp.array(ev["seeds"]), jnp.array(ev["steps"]),
-                jnp.array(ev["temperature"]), jnp.array(ev["top_k"]),
-                jnp.array(ev["top_p"]),
-                jnp.array(planned), jnp.array(pmask))
+            chain = (disp_toks[ev["chained_from"]]
+                     if ev["chained_from"] is not None else None)
+            toks_k, kv = exec_dispatch_event(core, kv, ev, chain)
             toks_k = jax.block_until_ready(toks_k)
             disp_toks[ev["id"]] = toks_k
             out["dispatch"][ev["id"]] = np.asarray(toks_k).copy()

@@ -97,6 +97,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--node-rank", type=int, default=0)
     p.add_argument("--leader-addr",
                    help="host:port of node 0 (jax.distributed coordinator)")
+    p.add_argument("--dispatch-stream-port", type=int, default=5557,
+                   help="leader port for the multihost dispatch stream "
+                        "(engine/multihost.py; followers dial the "
+                        "--leader-addr host at this port)")
     # routing / disagg
     p.add_argument("--router-mode", choices=["random", "round_robin"],
                    default="random")
@@ -204,26 +208,12 @@ async def build_engine(args, out: str, runtime):
                                           router_mode=args.router_mode)
         return engine, None, None
     if out == "jax":
-        import jax.numpy as jnp
-        from ..engine.core import EngineCore
-        from ..engine.config import ModelConfig
         from ..llm.engines.jax_engine import JaxEngine
         if not args.model_path:
             raise SystemExit("out=jax needs --model-path")
         mdc = ModelDeploymentCard.from_local_path(
             args.model_path, display_name=_model_name(args))
-        mesh = None
-        if args.tp * args.sp * args.dp * args.ep > 1:
-            from ..parallel.sharding import make_mesh
-            mesh = make_mesh(dp=args.dp, tp=args.tp, sp=args.sp,
-                             ep=args.ep)
-        model_cfg = ModelConfig.from_model_dir(args.model_path)
-        params = None
-        if not args.random_weights:
-            from ..engine.weights import load_params_auto
-            params = load_params_auto(args.model_path, model_cfg, mesh=mesh)
-        core = EngineCore(model_cfg, engine_config(args), params=params,
-                          mesh=mesh)
+        core = build_jax_core(args)
         engine = JaxEngine(core)
         if args.remote_prefill:
             from ..llm.disagg import DisaggEngine, DisaggregatedRouter
@@ -235,6 +225,44 @@ async def build_engine(args, out: str, runtime):
             engine = DisaggEngine(core, runtime, router)
         return engine, mdc, core
     raise SystemExit(f"unknown out= engine {out!r}")
+
+
+def build_jax_core(args):
+    """Construct the (possibly sharded) EngineCore from CLI flags. Every
+    rank of a multi-host engine calls this with identical flags, which is
+    what makes the leader's and followers' device state bit-identical."""
+    from ..engine.config import ModelConfig
+    from ..engine.core import EngineCore
+    if not args.model_path:
+        raise SystemExit("out=jax needs --model-path")
+    mesh = None
+    if args.tp * args.sp * args.dp * args.ep > 1:
+        from ..parallel.sharding import make_mesh
+        mesh = make_mesh(dp=args.dp, tp=args.tp, sp=args.sp, ep=args.ep)
+    model_cfg = ModelConfig.from_model_dir(args.model_path)
+    params = None
+    if not args.random_weights:
+        from ..engine.weights import load_params_auto
+        params = load_params_auto(args.model_path, model_cfg, mesh=mesh)
+    return EngineCore(model_cfg, engine_config(args), params=params,
+                      mesh=mesh)
+
+
+async def run_follower_rank(args, out: str) -> None:
+    """Follower rank of one multi-host engine: build the identical core,
+    dial the leader's dispatch stream, live-replay until leader shutdown
+    (engine/multihost.py; reference: sglang per-rank worker split,
+    lib/llm/src/engines/sglang/worker.rs:304-336)."""
+    if out != "jax":
+        raise SystemExit("multi-host serving requires out=jax")
+    from ..engine.multihost import connect_follower, run_follower
+    core = build_jax_core(args)
+    host = args.leader_addr.rsplit(":", 1)[0]
+    sock = connect_follower(f"{host}:{args.dispatch_stream_port}")
+    logger.info("follower rank %d/%d replaying the leader dispatch stream",
+                args.node_rank, args.num_nodes)
+    stats = await asyncio.to_thread(run_follower, core, sock)
+    logger.info("follower rank %d done: %s", args.node_rank, stats)
 
 
 def link_pipeline(engine, mdc):
@@ -414,26 +442,65 @@ async def amain(argv=None) -> None:
     setup_logging('debug' if args.verbose else None)
     src, out = parse_io(args.io)
 
-    # Multi-host join must precede any JAX use in this process. The run
-    # CLI's serving loops are single-controller: after a global join every
-    # pjit step is a collective all hosts must enter in lockstep, which an
-    # independently-fed frontend per rank cannot guarantee — so the CLI
-    # refuses; embedders drive followers via parallel/multihost.py with a
-    # leader-broadcast step loop.
-    if args.num_nodes > 1:
-        raise SystemExit(
-            "multi-host serving is not wired into the run CLI yet: "
-            "followers must execute the leader's exact dispatch sequence "
-            "(see dynamo_tpu/parallel/multihost.py). Scale out with "
-            "multiple single-host workers behind the KV router instead.")
+    if args.model_path and not os.path.isdir(args.model_path):
+        # hub resolution (reference launch/dynamo-run/src/hub.rs: a model
+        # NAME is fetched into the local cache; a directory passes through)
+        from ..llm.hub import HubError, fetch_model
+        try:
+            args.model_path = fetch_model(args.model_path)
+        except HubError as e:
+            raise SystemExit(str(e))
+
+    # Multi-host join must precede any JAX use in this process. Every host
+    # runs the same command with its own --node-rank; rank 0 is the leader
+    # (scheduler + frontend + token egress) and streams its dispatch
+    # sequence to the followers, which live-replay it so every rank enters
+    # the SPMD collectives in lockstep (engine/multihost.py; reference:
+    # lib/llm/src/engines/vllm/ray.rs leader/follower).
     from ..parallel.multihost import MultiNodeConfig, initialize_multihost
+    if args.num_nodes > 1:
+        # validate BEFORE weights load / listener bind, on every rank — the
+        # same constraints DispatchStreamLeader.attach enforces, surfaced
+        # as CLI config errors
+        if out != "jax":
+            raise SystemExit("multi-host serving requires out=jax")
+        if args.decode_steps_per_dispatch <= 1:
+            raise SystemExit(
+                "multi-host serving requires --decode-steps-per-dispatch "
+                "> 1 (the single-step decode path is not in the dispatch "
+                "stream)")
+        if args.host_kv_blocks > 0:
+            raise SystemExit("multi-host serving requires "
+                             "--host-kv-blocks 0")
+        if args.prefill_chunk > 0:
+            raise SystemExit("multi-host serving requires "
+                             "--prefill-chunk 0")
+        if args.sp > 1:
+            raise SystemExit("multi-host serving does not support --sp > 1 "
+                             "yet")
     initialize_multihost(MultiNodeConfig(
         num_nodes=args.num_nodes, node_rank=args.node_rank,
         leader_addr=args.leader_addr))
 
+    if args.num_nodes > 1 and args.node_rank > 0:
+        await run_follower_rank(args, out)
+        return
+
     runtime = await make_runtime(args)
+    stream = None
     try:
         engine, mdc, core = await build_engine(args, out, runtime)
+        if args.num_nodes > 1:
+            if core is None:
+                raise SystemExit("multi-host serving requires out=jax")
+            from ..engine.multihost import DispatchStreamLeader
+            stream = DispatchStreamLeader(
+                port=args.dispatch_stream_port,
+                num_followers=args.num_nodes - 1)
+            stream.attach(core)
+            logger.info("waiting for %d follower rank(s) on dispatch "
+                        "stream port %d", args.num_nodes - 1, stream.port)
+            stream.wait_for_followers()
         if args.is_prefill_worker:
             if core is None:
                 raise SystemExit("--is-prefill-worker requires out=jax")
@@ -458,6 +525,8 @@ async def amain(argv=None) -> None:
     finally:
         if 'core' in locals() and core is not None:
             await core.stop()
+        if stream is not None:
+            stream.close()   # followers get __shutdown__, exit cleanly
         await runtime.shutdown()
 
 
