@@ -14,6 +14,7 @@
 
 #include <cstdint>
 #include <cstddef>
+#include <deque>
 #include <unordered_map>
 #include <unordered_set>
 #include <vector>
@@ -29,6 +30,10 @@ struct Node {
     Node* parent = nullptr;
     std::unordered_map<BlockHash, std::unique_ptr<Node>> children;
     std::unordered_set<WorkerId> workers;
+    // access timestamps inside the expiration window (reference
+    // RadixBlock::recent_uses, indexer.rs:252-263) — only populated when
+    // the index was built with an expiration duration
+    std::deque<double> recent_uses;
 };
 
 struct RadixIndex {
@@ -39,6 +44,7 @@ struct RadixIndex {
     // worker → nodes, for O(worker footprint) removal on lease expiry
     std::unordered_map<WorkerId, std::unordered_set<Node*>> worker_nodes;
     uint64_t event_count = 0;
+    double expiration = 0;   // seconds; 0 = frequency tracking off
 
     Node* find(BlockHash h) {
         if (h == 0) return &root;
@@ -129,8 +135,11 @@ struct RadixIndex {
     // (reference RadixTree::find_matches, indexer.rs:239).
     size_t find_matches(const BlockHash* hashes, size_t n,
                         WorkerId* out_workers, uint32_t* out_counts,
-                        size_t cap, int early_exit) {
+                        size_t cap, int early_exit, double now = 0,
+                        uint32_t* out_freqs = nullptr,
+                        size_t* out_nfreq = nullptr) {
         std::unordered_map<WorkerId, uint32_t> scores;
+        size_t nfreq = 0;
         Node* node = &root;
         for (size_t depth = 0; depth < n; depth++) {
             auto it = node->children.find(hashes[depth]);
@@ -145,8 +154,21 @@ struct RadixIndex {
                     any = true;
                 }
             }
+            if (expiration > 0) {
+                // expire stale uses, report the surviving count, record
+                // this access (reference find_matches, indexer.rs:252-263;
+                // zero counts are skipped exactly like add_frequency)
+                while (!node->recent_uses.empty() &&
+                       now - node->recent_uses.front() > expiration)
+                    node->recent_uses.pop_front();
+                if (out_freqs != nullptr && !node->recent_uses.empty())
+                    out_freqs[nfreq++] =
+                        static_cast<uint32_t>(node->recent_uses.size());
+                node->recent_uses.push_back(now);
+            }
             if (early_exit && !any) break;
         }
+        if (out_nfreq != nullptr) *out_nfreq = nfreq;
         size_t k = 0;
         for (const auto& [w, c] : scores) {
             if (k >= cap) break;
@@ -191,6 +213,22 @@ size_t dyn_kv_index_find_matches(void* p, const uint64_t* hashes, size_t n,
                                  size_t cap, int early_exit) {
     return static_cast<RadixIndex*>(p)->find_matches(
         hashes, n, out_workers, out_counts, cap, early_exit);
+}
+
+void dyn_kv_index_set_expiration(void* p, double seconds) {
+    static_cast<RadixIndex*>(p)->expiration = seconds;
+}
+
+// find_matches with frequency tracking: caller supplies the clock (`now`,
+// seconds on any monotonic base) plus an out array of per-depth recent-use
+// counts (capacity n — one per matched block at most)
+size_t dyn_kv_index_find_matches2(void* p, const uint64_t* hashes, size_t n,
+                                  int64_t* out_workers, uint32_t* out_counts,
+                                  size_t cap, int early_exit, double now,
+                                  uint32_t* out_freqs, size_t* out_nfreq) {
+    return static_cast<RadixIndex*>(p)->find_matches(
+        hashes, n, out_workers, out_counts, cap, early_exit, now,
+        out_freqs, out_nfreq);
 }
 
 size_t dyn_kv_index_node_count(void* p) {

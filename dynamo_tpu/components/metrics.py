@@ -68,6 +68,7 @@ class MetricsAggregatorService:
         self._sub = None
         self._tasks: list = []
         self.events_received = 0
+        self.pushes = 0
         self.latest: Dict[int, ForwardPassMetrics] = {}
 
     # ------------------------------------------------------------ lifecycle
@@ -147,6 +148,32 @@ class MetricsAggregatorService:
     def render(self) -> bytes:
         return generate_latest(self.registry)
 
+    async def serve_push(self, gateway: str,
+                         job: str = "dynamo_tpu_metrics",
+                         interval: float = 2.0) -> asyncio.Task:
+        """Push mode (reference MetricsMode::Push,
+        components/metrics/src/lib.rs:104-296): periodically PUT the whole
+        registry to a Prometheus PushGateway instead of — or alongside —
+        pull exposition. Returns the pushing task (cancelled by close())."""
+        from prometheus_client import push_to_gateway
+
+        async def push_loop() -> None:
+            while True:
+                try:
+                    await asyncio.to_thread(push_to_gateway, gateway,
+                                            job=job, registry=self.registry)
+                    self.pushes += 1
+                except Exception:  # noqa: BLE001 — gateway may flap
+                    logger.exception("metrics push to %s failed", gateway)
+                await asyncio.sleep(interval)
+
+        task = asyncio.get_running_loop().create_task(
+            push_loop(), name="metrics-push")
+        self._tasks.append(task)
+        logger.info("pushing metrics to gateway %s every %.1fs (job=%s)",
+                    gateway, interval, job)
+        return task
+
     async def serve_http(self, host: str = "0.0.0.0",
                          port: int = 9091):
         """Expose GET /metrics (Prometheus text); returns the aiohttp
@@ -177,17 +204,33 @@ async def amain(argv=None) -> None:
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--port", type=int, default=9091)
     p.add_argument("--scrape-interval", type=float, default=1.0)
+    p.add_argument("--push-gateway",
+                   help="Prometheus PushGateway address (host:port or URL); "
+                        "enables push mode alongside pull exposition "
+                        "(reference MetricsMode::Push)")
+    p.add_argument("--push-job", default="dynamo_tpu_metrics")
+    p.add_argument("--push-interval", type=float, default=2.0)
+    p.add_argument("--no-pull", action="store_true",
+                   help="push mode only: skip the /metrics HTTP listener")
     args = p.parse_args(argv)
+    if args.no_pull and not args.push_gateway:
+        raise SystemExit("--no-pull requires --push-gateway")
 
     rt = await DistributedRuntime.connect(args.daemon)
     ep = Endpoint.parse_path(rt, args.endpoint)
     svc = await MetricsAggregatorService(
         ep, scrape_interval=args.scrape_interval).start()
-    runner = await svc.serve_http(args.host, args.port)
+    runner = None
+    if not args.no_pull:
+        runner = await svc.serve_http(args.host, args.port)
+    if args.push_gateway:
+        await svc.serve_push(args.push_gateway, job=args.push_job,
+                             interval=args.push_interval)
     try:
         await asyncio.Event().wait()
     finally:
-        await runner.cleanup()
+        if runner is not None:
+            await runner.cleanup()
         await svc.close()
         await rt.shutdown()
 

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import asyncio
 import ctypes
+import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 from ...utils import native
@@ -25,10 +27,16 @@ __all__ = ["OverlapScores", "KvIndexer", "RadixIndexNative",
 
 class OverlapScores:
     """worker_id → number of consecutive leading request blocks that worker
-    already holds (reference `OverlapScores`)."""
+    already holds (reference `OverlapScores`). With frequency tracking on
+    (an ``expiration_s`` on the index), ``frequencies`` lists the matched
+    blocks' recent-use counts inside the expiration window, outermost
+    first — the scheduler's hotness signal (reference add_frequency,
+    indexer.rs:429-436)."""
 
-    def __init__(self, scores: Optional[Dict[int, int]] = None):
+    def __init__(self, scores: Optional[Dict[int, int]] = None,
+                 frequencies: Optional[List[int]] = None):
         self.scores: Dict[int, int] = scores or {}
+        self.frequencies: List[int] = frequencies or []
 
     def best(self) -> Optional[int]:
         if not self.scores:
@@ -36,6 +44,8 @@ class OverlapScores:
         return max(self.scores, key=lambda w: self.scores[w])
 
     def __repr__(self) -> str:
+        if self.frequencies:
+            return f"OverlapScores({self.scores}, freq={self.frequencies})"
         return f"OverlapScores({self.scores})"
 
 
@@ -46,12 +56,17 @@ class OverlapScores:
 
 class RadixIndexNative:
     MAX_WORKERS = 4096
+    MAX_DEPTH = 65536      # frequency out-buffer bound (blocks per request)
 
-    def __init__(self):
+    def __init__(self, expiration_s: Optional[float] = None):
         lib = native.load("dynkv", ["kv_radix_index.cpp"])
         if lib is None:
             raise RuntimeError("native radix index unavailable")
         self._lib = lib
+        # normalize: <=0 means off, matching the C++ gate (expiration > 0)
+        if expiration_s is not None and expiration_s <= 0:
+            expiration_s = None
+        self.expiration_s = expiration_s
         lib.dyn_kv_index_new.restype = ctypes.c_void_p
         lib.dyn_kv_index_free.argtypes = [ctypes.c_void_p]
         lib.dyn_kv_index_apply_stored.argtypes = [
@@ -69,11 +84,23 @@ class RadixIndexNative:
             ctypes.c_size_t, ctypes.c_int]
         lib.dyn_kv_index_node_count.restype = ctypes.c_size_t
         lib.dyn_kv_index_node_count.argtypes = [ctypes.c_void_p]
+        lib.dyn_kv_index_set_expiration.argtypes = [ctypes.c_void_p,
+                                                    ctypes.c_double]
+        lib.dyn_kv_index_find_matches2.restype = ctypes.c_size_t
+        lib.dyn_kv_index_find_matches2.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_size_t, ctypes.c_int, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_size_t)]
         self._ptr = lib.dyn_kv_index_new()
+        if expiration_s is not None:
+            lib.dyn_kv_index_set_expiration(self._ptr, float(expiration_s))
         # reusable output buffers: find_matches is the routing hot path and
         # the index is single-reader by design, so one pair suffices
         self._out_w = (ctypes.c_int64 * self.MAX_WORKERS)()
         self._out_c = (ctypes.c_uint32 * self.MAX_WORKERS)()
+        self._out_f = (ctypes.c_uint32 * self.MAX_DEPTH)()
+        self._out_nf = ctypes.c_size_t(0)
 
     def __del__(self):
         ptr = getattr(self, "_ptr", None)
@@ -100,12 +127,24 @@ class RadixIndexNative:
     def remove_worker(self, worker_id: int) -> None:
         self._lib.dyn_kv_index_remove_worker(self._ptr, worker_id)
 
-    def find_matches(self, block_hashes: Sequence[int]) -> OverlapScores:
+    def find_matches(self, block_hashes: Sequence[int],
+                     now: Optional[float] = None) -> OverlapScores:
         out_w, out_c = self._out_w, self._out_c
-        n = self._lib.dyn_kv_index_find_matches(
-            self._ptr, self._arr(block_hashes), len(block_hashes),
-            out_w, out_c, self.MAX_WORKERS, 1)
-        return OverlapScores({int(out_w[i]): int(out_c[i]) for i in range(n)})
+        if self.expiration_s is None:
+            n = self._lib.dyn_kv_index_find_matches(
+                self._ptr, self._arr(block_hashes), len(block_hashes),
+                out_w, out_c, self.MAX_WORKERS, 1)
+            return OverlapScores(
+                {int(out_w[i]): int(out_c[i]) for i in range(n)})
+        n = self._lib.dyn_kv_index_find_matches2(
+            self._ptr, self._arr(block_hashes),
+            min(len(block_hashes), self.MAX_DEPTH),
+            out_w, out_c, self.MAX_WORKERS, 1,
+            float(time.monotonic() if now is None else now),
+            self._out_f, ctypes.byref(self._out_nf))
+        freqs = [int(self._out_f[i]) for i in range(self._out_nf.value)]
+        return OverlapScores(
+            {int(out_w[i]): int(out_c[i]) for i in range(n)}, freqs)
 
     def node_count(self) -> int:
         return int(self._lib.dyn_kv_index_node_count(self._ptr))
@@ -117,20 +156,25 @@ class RadixIndexNative:
 
 
 class _PyNode:
-    __slots__ = ("hash", "parent", "children", "workers")
+    __slots__ = ("hash", "parent", "children", "workers", "recent_uses")
 
     def __init__(self, h: int = 0, parent=None):
         self.hash = h
         self.parent = parent
         self.children: Dict[int, "_PyNode"] = {}
         self.workers: set = set()
+        self.recent_uses: deque = deque()   # timestamps inside the window
 
 
 class RadixIndexPython:
-    def __init__(self):
+    def __init__(self, expiration_s: Optional[float] = None):
         self._root = _PyNode()
         self._by_hash: Dict[int, _PyNode] = {}
         self._worker_nodes: Dict[int, set] = {}
+        # normalize: <=0 means off, matching the native tree's gate
+        if expiration_s is not None and expiration_s <= 0:
+            expiration_s = None
+        self.expiration_s = expiration_s
 
     def _find(self, h: Optional[int]) -> Optional[_PyNode]:
         if not h:
@@ -182,8 +226,13 @@ class RadixIndexPython:
             if node is not None:
                 self._detach_if_empty(node)
 
-    def find_matches(self, block_hashes) -> OverlapScores:
+    def find_matches(self, block_hashes,
+                     now: Optional[float] = None) -> OverlapScores:
         scores: Dict[int, int] = {}
+        freqs: List[int] = []
+        exp = self.expiration_s
+        if exp is not None and now is None:
+            now = time.monotonic()
         node = self._root
         for depth, h in enumerate(block_hashes):
             node = node.children.get(h)
@@ -194,9 +243,18 @@ class RadixIndexPython:
                 if scores.get(w, 0) == depth:
                     scores[w] = depth + 1
                     any_advance = True
+            if exp is not None:
+                # expire stale uses, report survivors, record this access
+                # (reference find_matches, indexer.rs:252-263)
+                uses = node.recent_uses
+                while uses and now - uses[0] > exp:
+                    uses.popleft()
+                if uses:
+                    freqs.append(len(uses))
+                uses.append(now)
             if not any_advance:
                 break
-        return OverlapScores(scores)
+        return OverlapScores(scores, freqs)
 
     def node_count(self) -> int:
         # count actual tree nodes, not the flat map: duplicate hashes from
@@ -206,13 +264,14 @@ class RadixIndexPython:
         return cnt(self._root) - 1
 
 
-def make_radix_index(prefer_native: bool = True):
+def make_radix_index(prefer_native: bool = True,
+                     expiration_s: Optional[float] = None):
     if prefer_native:
         try:
-            return RadixIndexNative()
+            return RadixIndexNative(expiration_s)
         except RuntimeError:
             pass
-    return RadixIndexPython()
+    return RadixIndexPython(expiration_s)
 
 
 # ---------------------------------------------------------------------------
@@ -225,9 +284,14 @@ class KvIndexer:
     hashes for the request tokens then walk the tree (reference
     KvIndexer::new / find_matches_for_request)."""
 
-    def __init__(self, block_size: int, prefer_native: bool = True):
+    def __init__(self, block_size: int, prefer_native: bool = True,
+                 expiration_s: Optional[float] = None):
+        """``expiration_s`` enables frequency tracking: matched blocks
+        report their recent-use counts inside that window via
+        OverlapScores.frequencies (reference KvIndexer::new_with_frequency,
+        indexer.rs:525-560)."""
         self.block_size = block_size
-        self.tree = make_radix_index(prefer_native)
+        self.tree = make_radix_index(prefer_native, expiration_s)
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
 
@@ -277,9 +341,10 @@ class KvIndexerSharded:
     `KvIndexerSharded`). Queries fan out and merge."""
 
     def __init__(self, block_size: int, shards: int = 4,
-                 prefer_native: bool = True):
+                 prefer_native: bool = True,
+                 expiration_s: Optional[float] = None):
         self.block_size = block_size
-        self.shards = [KvIndexer(block_size, prefer_native)
+        self.shards = [KvIndexer(block_size, prefer_native, expiration_s)
                        for _ in range(shards)]
 
     def _shard(self, worker_id: int) -> KvIndexer:
@@ -294,6 +359,15 @@ class KvIndexerSharded:
     def find_matches_for_request(self, token_ids) -> OverlapScores:
         hashes = compute_block_hashes(token_ids, self.block_size)
         merged: Dict[int, int] = {}
+        freqs: List[int] = []
         for sh in self.shards:
-            merged.update(sh.find_matches(hashes).scores)
-        return OverlapScores(merged)
+            r = sh.find_matches(hashes)
+            merged.update(r.scores)
+            # each shard tracks its own subtree's uses; take the
+            # elementwise max as the merged hotness view
+            for i, f in enumerate(r.frequencies):
+                if i < len(freqs):
+                    freqs[i] = max(freqs[i], f)
+                else:
+                    freqs.append(f)
+        return OverlapScores(merged, freqs)

@@ -134,6 +134,55 @@ def test_duplicate_hash_reroot_native_python_equivalence():
     assert (native.find_matches(h).scores == py.find_matches(h).scores)
 
 
+def test_frequency_tracking_native_python_equivalence():
+    """expiration_s enables per-block recent-use counts in OverlapScores
+    (reference KvIndexer::new_with_frequency + RadixTree recent_uses,
+    indexer.rs:202-263). Both trees, injected clock, exact parity."""
+    try:
+        native = RadixIndexNative(expiration_s=10.0)
+    except RuntimeError:
+        pytest.skip("no C++ toolchain")
+    py = RadixIndexPython(expiration_s=10.0)
+    h = compute_block_hashes(list(range(16)), BS)  # 4 chained blocks
+    for idx in (native, py):
+        idx.apply_stored(1, None, h)
+        r1 = idx.find_matches(h, now=0.0)
+        assert r1.scores == {1: 4}
+        assert r1.frequencies == []            # first visit: nothing recent
+        r2 = idx.find_matches(h, now=1.0)
+        assert r2.frequencies == [1, 1, 1, 1]  # the t=0 visit, per block
+        r3 = idx.find_matches(h[:2], now=2.0)
+        assert r3.frequencies == [2, 2]        # t=0 and t=1 visits
+        # expiration: at t=11.5 the t=0/t=1 uses fall out of the 10s
+        # window; blocks 0-1 keep the t=2 use, blocks 2-3 report nothing
+        # (zero counts are skipped, like the reference's add_frequency)
+        r4 = idx.find_matches(h, now=11.5)
+        assert r4.frequencies == [1, 1]
+        assert r4.scores == {1: 4}
+
+
+def test_frequency_off_by_default():
+    py = RadixIndexPython()
+    h = compute_block_hashes(list(range(8)), BS)
+    py.apply_stored(1, None, h)
+    assert py.find_matches(h).frequencies == []
+    assert py.find_matches(h).frequencies == []
+
+
+@pytest.mark.asyncio
+async def test_kv_indexer_frequency_passthrough():
+    indexer = KvIndexer(BS, prefer_native=False, expiration_s=60.0)
+    tokens = list(range(12))
+    h = compute_block_hashes(tokens, BS)
+    await indexer.enqueue_event(RouterEvent(
+        worker_id=7, stored=KvStoredEvent(parent_hash=None, block_hashes=h)))
+    await indexer.drain()
+    assert indexer.find_matches_for_request(tokens).frequencies == []
+    r = indexer.find_matches_for_request(tokens)
+    assert r.scores == {7: 3}
+    assert r.frequencies == [1, 1, 1]
+
+
 @pytest.mark.asyncio
 async def test_kv_indexer_event_flow():
     indexer = KvIndexer(BS, prefer_native=False)

@@ -134,3 +134,53 @@ async def test_http_exposition(daemon):
         await worker.stop()
         for rt in (rt_w, rt_metrics):
             await rt.shutdown()
+
+
+async def test_push_mode_to_fake_gateway(daemon):
+    """Push collection (reference MetricsMode::Push,
+    components/metrics/src/lib.rs:104-296): the aggregator periodically
+    PUTs its registry to a PushGateway; a fake gateway captures the body."""
+    from aiohttp import web
+
+    received = []
+
+    async def capture(request):
+        received.append((request.method, request.path,
+                         await request.read()))
+        return web.Response(status=200)
+
+    app = web.Application()
+    app.router.add_route("*", "/metrics/job/{job}", capture)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    gw_port = runner.addresses[0][1]
+
+    addr = daemon.address
+    rt_w = await DistributedRuntime.connect(addr)
+    rt_metrics = await DistributedRuntime.connect(addr)
+    worker = await MockTokenWorker(rt_w, PATH, block_size=4).start()
+    svc = None
+    try:
+        svc = await MetricsAggregatorService(
+            Endpoint.parse_path(rt_metrics, PATH),
+            scrape_interval=0.1).start()
+        await svc.serve_push(f"127.0.0.1:{gw_port}", job="testjob",
+                             interval=0.1)
+        for _ in range(100):
+            if svc.pushes >= 2 and worker.worker_id in svc.latest:
+                break
+            await asyncio.sleep(0.05)
+        assert svc.pushes >= 2, "no pushes reached the fake gateway"
+        assert received, "gateway captured nothing"
+        method, path, body = received[-1]
+        assert path == "/metrics/job/testjob"
+        assert b"nv_llm_kv_kv_total_blocks" in body
+    finally:
+        if svc is not None:
+            await svc.close()
+        await worker.stop()
+        for rt in (rt_w, rt_metrics):
+            await rt.shutdown()
+        await runner.cleanup()
