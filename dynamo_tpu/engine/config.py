@@ -148,6 +148,11 @@ class EngineConfig:
     max_num_seqs: int = 8             # decode batch slots
     enable_prefix_reuse: bool = True  # match prompt blocks against the pool
     host_kv_blocks: int = 0           # host (TPU-VM DRAM) offload tier; 0 = off
+    # pace the offload pump's write-backs to this simulated d2h link
+    # (GB/s); 0 = real link speed. Lets a CPU run measure the tier under a
+    # realistic TPU-VM link instead of this rig's tunnel (tools/
+    # bandwidth_model.py holds the analytic tables)
+    offload_simulated_gbps: float = 0.0
     prefill_buckets: List[int] = dataclasses.field(
         default_factory=lambda: [128, 256, 512, 1024, 2048])
     prefill_chunk: int = 0            # 0 = whole-prompt prefill

@@ -171,7 +171,8 @@ class EngineCore:
             self.offload_engine = KvOffloadEngine(
                 host_pool, engine_cfg.kv_block_size,
                 get_kv=lambda: self.kv,
-                release_holds=self.kv_manager.pool.release)
+                release_holds=self.kv_manager.pool.release,
+                simulated_gbps=engine_cfg.offload_simulated_gbps or None)
         self.M = engine_cfg.max_blocks_per_seq
         self.B = engine_cfg.max_num_seqs
 

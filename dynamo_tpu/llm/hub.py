@@ -1,0 +1,179 @@
+"""Model-hub fetch: resolve a model NAME to a local directory.
+
+Reference: launch/dynamo-run/src/hub.rs — `from_hf` lists a repo's files,
+downloads everything except housekeeping files (.gitattributes, LICENSE,
+README.md) and images into the hub cache, and returns the snapshot
+directory. The TPU deployment runs in zero-egress environments, so the
+transport here is a MIRROR — a directory (or file:// URL) laid out like
+the hub (``<mirror>/<org>/<name>/<files>``), typically an NFS/GCS-fuse
+mount — with the same filtering, the same local cache, and per-file
+sha256 validation recorded in a manifest so a torn copy is detected and
+re-fetched instead of served.
+
+Resolution order (`fetch_model`):
+1. an existing local directory path is returned as-is;
+2. a cached snapshot with a valid manifest is reused;
+3. otherwise the model is copied from the mirror into the cache
+   atomically (temp dir + rename) and the manifest written last.
+
+Env: ``DYN_HUB_MIRROR`` (mirror root), ``DYN_HUB_CACHE`` (cache root,
+default ``~/.cache/dynamo_tpu/hub``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+import tempfile
+from typing import Dict, Optional
+
+logger = logging.getLogger("dynamo_tpu.llm.hub")
+
+__all__ = ["fetch_model", "HubError"]
+
+# reference hub.rs:19 IGNORED + is_image
+IGNORED = {".gitattributes", "LICENSE", "README.md"}
+IMAGE_EXTS = {".png", ".jpg", ".jpeg", ".gif", ".bmp", ".webp", ".svg"}
+
+MANIFEST = ".dynamo_hub_manifest.json"
+
+
+class HubError(RuntimeError):
+    pass
+
+
+def _is_ignored(name: str) -> bool:
+    return (name in IGNORED
+            or os.path.splitext(name)[1].lower() in IMAGE_EXTS)
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _mirror_root(mirror: Optional[str]) -> str:
+    mirror = mirror or os.environ.get("DYN_HUB_MIRROR", "")
+    if not mirror:
+        raise HubError(
+            "model is not a local directory and no hub mirror is "
+            "configured (set DYN_HUB_MIRROR or pass mirror=)")
+    if mirror.startswith("file://"):
+        mirror = mirror[len("file://"):]
+    return mirror
+
+
+def _cache_root(cache_dir: Optional[str]) -> str:
+    return (cache_dir or os.environ.get("DYN_HUB_CACHE")
+            or os.path.expanduser("~/.cache/dynamo_tpu/hub"))
+
+
+def _snapshot_valid(snap: str, deep: bool = False) -> bool:
+    """A snapshot is valid iff its manifest exists and every listed file
+    is present with the recorded size (hot path — cheap enough for every
+    process start even at 70B scale). ``deep`` additionally verifies each
+    sha256 (hub.rs relies on hf-hub's etag cache; a mirror copy needs
+    explicit integrity on demand)."""
+    mpath = os.path.join(snap, MANIFEST)
+    try:
+        with open(mpath) as f:
+            manifest: Dict[str, dict] = json.load(f)["files"]
+    except (OSError, ValueError, KeyError):
+        return False
+    for name, rec in manifest.items():
+        p = os.path.join(snap, name)
+        try:
+            ok = os.path.getsize(p) == rec["size"]
+        except OSError:
+            ok = False
+        if ok and deep:
+            ok = _sha256(p) == rec["sha256"]
+        if not ok:
+            logger.warning("hub cache %s: %s failed validation", snap, name)
+            return False
+    return True
+
+
+def _list_files(root: str) -> list:
+    """Relative paths of all regular files under root, housekeeping and
+    images filtered by BASENAME (subdirectories like HF's `original/`
+    are part of the snapshot and must not be silently dropped)."""
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        for name in files:
+            if _is_ignored(name):
+                continue
+            out.append(os.path.relpath(os.path.join(dirpath, name), root))
+    return sorted(out)
+
+
+def fetch_model(name_or_path: str, mirror: Optional[str] = None,
+                cache_dir: Optional[str] = None,
+                revalidate: bool = False) -> str:
+    """Resolve a model name (or local path) to a local snapshot directory.
+
+    Reference contract: launch/dynamo-run/src/hub.rs `from_hf` (name →
+    cached dir, housekeeping files skipped, empty repos rejected).
+    """
+    if os.path.isdir(name_or_path):
+        return name_or_path
+
+    slug = name_or_path.replace("/", "--")
+    snap = os.path.join(_cache_root(cache_dir), slug)
+    if os.path.isdir(snap) and _snapshot_valid(snap, deep=revalidate):
+        logger.info("hub cache hit: %s -> %s", name_or_path, snap)
+        return snap
+
+    src = os.path.join(_mirror_root(mirror), name_or_path)
+    if not os.path.isdir(src):
+        raise HubError(
+            f"model {name_or_path!r} not found in hub mirror "
+            f"({src} does not exist). Is this a valid model id?")
+    names = _list_files(src)
+    if not names:
+        raise HubError(
+            f"model {name_or_path!r} exists but contains no usable files")
+
+    os.makedirs(_cache_root(cache_dir), exist_ok=True)
+    tmp = tempfile.mkdtemp(prefix=f".{slug}.", dir=_cache_root(cache_dir))
+    try:
+        manifest: Dict[str, dict] = {}
+        for name in names:
+            dst = os.path.join(tmp, name)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            shutil.copyfile(os.path.join(src, name), dst)
+            manifest[name] = {"sha256": _sha256(dst),
+                              "size": os.path.getsize(dst)}
+        with open(os.path.join(tmp, MANIFEST), "w") as f:
+            json.dump({"model": name_or_path, "files": manifest}, f,
+                      indent=1)
+        # atomic publish, never destructive to a concurrent reader: if
+        # another process won the race with a VALID snapshot, use theirs;
+        # only an invalid loser is moved aside and removed
+        try:
+            os.rename(tmp, snap)
+        except OSError:
+            # validate at the caller's requested depth — a shallow check
+            # here would bless the very snapshot a deep revalidate just
+            # rejected
+            if _snapshot_valid(snap, deep=revalidate):
+                shutil.rmtree(tmp, ignore_errors=True)
+                logger.info("hub fetch race: reusing %s", snap)
+                return snap
+            aside = tempfile.mkdtemp(prefix=f".{slug}.stale.",
+                                     dir=_cache_root(cache_dir))
+            os.rename(snap, os.path.join(aside, "old"))
+            os.rename(tmp, snap)
+            shutil.rmtree(aside, ignore_errors=True)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    logger.info("hub fetch: %s -> %s (%d files)", name_or_path, snap,
+                len(names))
+    return snap

