@@ -74,14 +74,17 @@ class DeploymentApi:
     async def _create(self, request: web.Request) -> web.Response:
         try:
             body = await request.json()
+            mr = body.get("max_restarts")
             spec = DeploymentSpec(
                 name=body["name"], graph=body["graph"],
                 config=body.get("config"),
                 replicas=int(body.get("replicas", 1)),
-                env=dict(body.get("env", {})), created_at=time.time())
+                env=dict(body.get("env", {})), created_at=time.time(),
+                max_restarts=None if mr is None else int(mr))
         except (json.JSONDecodeError, KeyError, TypeError, ValueError) as e:
             return web.json_response({"error": f"bad spec: {e}"}, status=400)
-        err = validate_spec(spec.name, spec.replicas)
+        err = validate_spec(spec.name, spec.replicas,
+                            max_restarts=spec.max_restarts)
         if err:
             return web.json_response({"error": err}, status=400)
         created = await self.runtime.store.kv_create(spec.key(),
@@ -127,7 +130,14 @@ class DeploymentApi:
                     return str(e)
             if "env" in body:
                 spec.env = dict(body["env"])
-            return validate_spec(spec.name, spec.replicas)
+            if "max_restarts" in body:
+                mr = body["max_restarts"]
+                try:
+                    spec.max_restarts = None if mr is None else int(mr)
+                except (TypeError, ValueError) as e:
+                    return str(e)
+            return validate_spec(spec.name, spec.replicas,
+                                 max_restarts=spec.max_restarts)
 
         try:
             spec = await update_spec(self.runtime.store, name, mutate)

@@ -190,12 +190,14 @@ class DeploymentController:
         spec = m.spec
         want = max(spec.replicas, 0)
 
+        max_restarts = (spec.max_restarts if spec.max_restarts is not None
+                        else MAX_RESTARTS)
         # reap dead replicas → restart with a cap (CrashLoopBackOff
         # analog), keeping the crashed replica's identity slot
         for r in list(m.replicas):
             if not self.launcher.alive(r.proc):
                 m.replicas.remove(r)
-                if r.restarts + 1 > MAX_RESTARTS:
+                if r.restarts + 1 > max_restarts:
                     m.failed = True
                     logger.error("deployment %s replica %d crashed %d "
                                  "times; marking failed", spec.name, r.idx,
@@ -226,7 +228,7 @@ class DeploymentController:
             name=spec.name, state=state, ready_replicas=ready,
             observed_generation=spec.generation,
             message="" if not m.failed else
-            f"replica exceeded {MAX_RESTARTS} restarts"))
+            f"replica exceeded {max_restarts} restarts"))
 
     async def _publish_status(self, m: _Managed,
                               status: DeploymentStatus) -> None:

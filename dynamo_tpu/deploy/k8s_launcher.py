@@ -1,0 +1,130 @@
+"""Kubernetes substrate for the deployment controller.
+
+Reference: the operator reconciles real Kubernetes objects
+(deploy/dynamo/operator/internal/controller/dynamodeployment_controller.go);
+our reconciler (deploy/controller.py) is substrate-injectable, and this
+module is the k8s substrate: one POD per replica, driven by shelling out
+to ``kubectl`` against the same cluster the static manifests in
+deploy/k8s/ describe.
+
+Design choices:
+- Pod-per-replica with ``restartPolicy: Never``: the controller owns
+  crash restarts (with its per-spec cap) exactly as it does on the
+  OS-process substrate — double-managing restarts with the kubelet would
+  make the CrashLoopBackOff analog unobservable to our status publisher.
+- Manifests are generated as JSON (kubectl accepts JSON everywhere YAML
+  is accepted) so the launcher has zero new dependencies; the static
+  deploy/k8s/*.yaml files remain the hand-operated path and this
+  launcher is the controller-operated one.
+- The kubectl binary is injectable for hermetic tests (a recorded fake)
+  and for kubectl-compatible CLIs (oc, k3s kubectl).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import subprocess
+from typing import Dict, Optional
+
+from .spec import DeploymentSpec
+
+logger = logging.getLogger("dynamo_tpu.deploy.k8s")
+
+__all__ = ["KubectlLauncher"]
+
+
+class KubectlLauncher:
+    """deploy/controller.py ProcessLauncher interface over kubectl pods."""
+
+    def __init__(self, kubectl: str = "kubectl",
+                 namespace: str = "dynamo-tpu",
+                 image: str = "dynamo-tpu:latest",
+                 model_volume_claim: Optional[str] = "dynamo-tpu-models"):
+        self.kubectl = kubectl
+        self.namespace = namespace
+        self.image = image
+        self.model_volume_claim = model_volume_claim
+
+    # ------------------------------------------------------------ manifest
+    def pod_name(self, spec: DeploymentSpec, replica: int) -> str:
+        return f"{spec.name}-{replica}"
+
+    def manifest(self, spec: DeploymentSpec, replica: int,
+                 runtime_server: str) -> dict:
+        command = ["python", "-m", "dynamo_tpu.sdk.serve", spec.graph,
+                   "--runtime-server", runtime_server]
+        if spec.config:
+            command += ["-f", spec.config]
+        env = [{"name": k, "value": str(v)} for k, v in spec.env.items()]
+        env += [{"name": "DYN_DEPLOYMENT", "value": spec.name},
+                {"name": "DYN_REPLICA", "value": str(replica)}]
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": self.pod_name(spec, replica),
+                "namespace": self.namespace,
+                "labels": {"app": "dynamo-tpu-graph",
+                           "deployment": spec.name,
+                           "replica": str(replica),
+                           "generation": str(spec.generation)},
+            },
+            "spec": {
+                "restartPolicy": "Never",   # reconciler owns restarts
+                "containers": [{
+                    "name": "graph",
+                    "image": self.image,
+                    "command": command,
+                    "env": env,
+                }],
+            },
+        }
+        if self.model_volume_claim:
+            pod["spec"]["volumes"] = [{
+                "name": "models",
+                "persistentVolumeClaim":
+                    {"claimName": self.model_volume_claim}}]
+            pod["spec"]["containers"][0]["volumeMounts"] = [
+                {"name": "models", "mountPath": "/models",
+                 "readOnly": True}]
+        return pod
+
+    # ----------------------------------------------------------- interface
+    async def start(self, spec: DeploymentSpec, replica: int,
+                    runtime_server: str) -> Dict[str, str]:
+        body = json.dumps(self.manifest(spec, replica, runtime_server))
+        proc = await asyncio.create_subprocess_exec(
+            self.kubectl, "apply", "-n", self.namespace, "-f", "-",
+            stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE)
+        out, err = await proc.communicate(body.encode())
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"kubectl apply failed for {self.pod_name(spec, replica)}: "
+                f"{(err or out).decode()[-500:]}")
+        name = self.pod_name(spec, replica)
+        logger.info("applied pod %s/%s", self.namespace, name)
+        return {"pod": name}
+
+    def alive(self, handle: Dict[str, str]) -> bool:
+        """Pod phase probe. Synchronous by the launcher interface contract
+        (the reconciler polls at resync cadence); Pending counts as alive
+        — the scheduler may still be placing the pod."""
+        r = subprocess.run(
+            [self.kubectl, "get", "pod", handle["pod"],
+             "-n", self.namespace, "-o", "jsonpath={.status.phase}"],
+            capture_output=True, text=True)
+        if r.returncode != 0:
+            return False                   # pod object gone
+        return r.stdout.strip() in ("Pending", "Running")
+
+    async def stop(self, handle: Dict[str, str]) -> None:
+        proc = await asyncio.create_subprocess_exec(
+            self.kubectl, "delete", "pod", handle["pod"],
+            "-n", self.namespace, "--ignore-not-found", "--wait=false",
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE)
+        await proc.communicate()

@@ -26,16 +26,20 @@ STATUS_PREFIX = "deployment_status/"
 _NAME_RE = re.compile(r"^[a-zA-Z0-9][a-zA-Z0-9_.-]{0,62}$")
 
 
-def validate_spec(name: str, replicas: int) -> Optional[str]:
+def validate_spec(name: str, replicas: int,
+                  max_restarts: Optional[int] = None) -> Optional[str]:
     """Returns an error string, or None. Names must be route- and
     key-safe (no '/', non-empty — 'a/b' would be unreachable via the
     api-server's {name} routes and '' would collide with the watch prefix
     itself); replicas must be >= 0 (a negative count would make the
-    reconciler pop an empty list forever)."""
+    reconciler pop an empty list forever); max_restarts, when set, must
+    be >= 0 (the controller compares restarts+1 > cap)."""
     if not _NAME_RE.match(name or ""):
         return f"invalid deployment name {name!r}"
     if replicas < 0:
         return f"replicas must be >= 0, got {replicas}"
+    if max_restarts is not None and max_restarts < 0:
+        return f"max_restarts must be >= 0, got {max_restarts}"
     return None
 
 
@@ -48,6 +52,9 @@ class DeploymentSpec:
     config: Optional[str] = None      # YAML service config path
     replicas: int = 1                 # graph supervisor replicas
     env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # crash-restart cap per replica before the deployment is marked
+    # failed (CrashLoopBackOff analog); None = controller default
+    max_restarts: Optional[int] = None
     # bookkeeping
     created_at: float = 0.0
     generation: int = 1               # bumped on every update

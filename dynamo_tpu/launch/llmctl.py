@@ -42,6 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
     dc.add_argument("graph", help="module:ServiceClass")
     dc.add_argument("--config", help="service YAML path")
     dc.add_argument("--replicas", type=int, default=1)
+    dc.add_argument("--max-restarts", type=int, default=None,
+                    help="crash-restart cap per replica before the "
+                         "deployment is marked failed (default: "
+                         "controller default)")
     ds = dpsub.add_parser("scale")
     ds.add_argument("name")
     ds.add_argument("replicas", type=int)
@@ -102,13 +106,15 @@ async def _deployment_cmd(runtime, args) -> int:
                                update_spec, validate_spec)
 
     if args.dep_cmd == "create":
-        err = validate_spec(args.name, args.replicas)
+        err = validate_spec(args.name, args.replicas,
+                            max_restarts=args.max_restarts)
         if err:
             print(err, file=sys.stderr)
             return 1
         spec = DeploymentSpec(name=args.name, graph=args.graph,
                               config=args.config, replicas=args.replicas,
-                              created_at=time.time())
+                              created_at=time.time(),
+                              max_restarts=args.max_restarts)
         if not await runtime.store.kv_create(spec.key(), spec.to_json()):
             print(f"deployment {args.name!r} already exists", file=sys.stderr)
             return 1
