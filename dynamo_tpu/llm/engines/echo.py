@@ -17,7 +17,8 @@ from ...runtime.engine import AsyncEngine, ManyOut, ResponseStream, SingleIn
 from ..protocols.annotated import Annotated
 from ..protocols.common import BackendOutput, FinishReason, PreprocessedRequest
 from ..protocols.openai import (ChatCompletionRequest, ChatDeltaGenerator,
-                                CompletionDeltaGenerator, CompletionRequest)
+                                CompletionDeltaGenerator, CompletionRequest,
+                                usage_dict)
 
 
 def _delay_s() -> float:
@@ -68,12 +69,23 @@ class EchoEngineFull(AsyncEngine):
             gen = CompletionDeltaGenerator(req.model, request_id=f"cmpl-{request.id}")
 
         async def stream() -> AsyncIterator[Annotated[dict]]:
-            for word in text.split(" "):
+            words = text.split(" ")
+            emitted = 0
+            for word in words:
                 if ctx.is_stopped:
                     break
                 if delay:
                     await asyncio.sleep(delay)
                 yield Annotated.from_data(gen.text_chunk(word + " "))
-            yield Annotated.from_data(gen.finish_chunk(FinishReason.STOP))
+                emitted += 1
+            # word counts stand in for token counts (echo has no tokenizer)
+            if isinstance(gen, ChatDeltaGenerator):
+                yield Annotated.from_data(gen.finish_chunk(FinishReason.STOP))
+                yield Annotated.from_data(gen.usage_chunk(len(words),
+                                                          emitted))
+            else:
+                yield Annotated.from_data(gen.finish_chunk(
+                    FinishReason.STOP,
+                    usage=usage_dict(len(words), emitted)))
 
         return ResponseStream(stream(), ctx)

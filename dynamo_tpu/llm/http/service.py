@@ -93,6 +93,122 @@ def _error_response(status: int, message: str, err_type: str = "invalid_request_
         status=status)
 
 
+MAX_N = 16          # parallel-sampling fan-out cap (engine slots are finite)
+
+
+class _FanoutContext(EngineContext):
+    """Parent context of an n>1 request: cancellation fans out to every
+    per-choice child generation."""
+
+    __slots__ = ("children",)
+
+    def __init__(self):
+        super().__init__()
+        self.children: list = []
+
+    def stop_generating(self) -> None:
+        super().stop_generating()
+        for c in self.children:
+            c.stop_generating()
+
+    def kill(self) -> None:
+        super().kill()
+        for c in self.children:
+            c.kill()
+
+
+async def _merge_choice_streams(streams, ectx: "_FanoutContext"):
+    """n independent single-choice streams → one multi-choice stream
+    (OpenAI `n` semantics): choice indices are rewritten to the sub-stream
+    slot, chunk identity (id/created/model) is normalized to one stream's
+    (each child pipeline minted its own), and per-stream usage folds into
+    ONE trailing usage chunk — prompt counted once, completions summed.
+    A child failure kills the sibling generations (their slots must not
+    stay held) before the error surfaces."""
+    from ..protocols.openai import usage_dict
+
+    q: asyncio.Queue = asyncio.Queue(maxsize=4)   # backpressure: children
+    done = object()                               # run at consumer speed
+
+    async def pump(i, s):
+        try:
+            async for item in s:
+                await q.put((i, item, None))
+        except Exception as e:  # noqa: BLE001 — surfaced to the consumer
+            await q.put((i, None, e))
+        finally:
+            await q.put((i, done, None))
+
+    tasks = [asyncio.create_task(pump(i, s))
+             for i, s in enumerate(streams)]
+    usages: Dict[int, dict] = {}
+    template: Optional[dict] = None
+    pending = len(streams)
+    try:
+        while pending:
+            i, item, err = await q.get()
+            if err is not None:
+                ectx.kill()               # reap the sibling generations
+                raise err
+            if item is done:
+                pending -= 1
+                continue
+            ann = (item if isinstance(item, Annotated)
+                   else Annotated.from_data(item))
+            chunk = ann.data
+            if isinstance(chunk, dict):
+                if template is None and chunk.get("id"):
+                    template = {k: chunk.get(k)
+                                for k in ("id", "object", "created",
+                                          "model")}
+                elif template is not None and chunk.get("id"):
+                    # one id per SSE stream (OpenAI contract) — children
+                    # minted their own
+                    chunk.update(template)
+                for c in chunk.get("choices") or []:
+                    c["index"] = i
+                if chunk.get("usage") is not None:
+                    usages[i] = chunk.pop("usage")
+                    if not chunk.get("choices"):
+                        continue          # combined usage emitted at the end
+            yield ann
+        if usages:
+            vals = list(usages.values())
+            combined = usage_dict(
+                vals[0].get("prompt_tokens", 0),
+                sum(v.get("completion_tokens", 0) for v in vals))
+            yield Annotated.from_data({**(template or {}), "choices": [],
+                                       "usage": combined})
+    finally:
+        for t in tasks:
+            t.cancel()
+
+
+async def _start_fanout(engine, body: dict, ectx: "_FanoutContext",
+                        n: int):
+    """Launch n single-choice generations for one request. Seeded requests
+    get seed+i per choice (reproducible but decorrelated); unseeded
+    requests get a fresh random base per REQUEST (a constant base would
+    make choices 1..n-1 identical across every request)."""
+    import random
+
+    base = (int(body["seed"]) if body.get("seed") is not None
+            else random.getrandbits(31))
+    streams = []
+    try:
+        for i in range(n):
+            sub = dict(body)
+            sub["n"] = 1
+            sub["seed"] = base + i
+            sctx = EngineContext(f"{ectx.id}-c{i}")
+            ectx.children.append(sctx)
+            streams.append(await engine.generate(Context(sub, sctx)))
+    except BaseException:
+        ectx.kill()          # reap the children that already started
+        raise
+    return _merge_choice_streams(streams, ectx)
+
+
 class HttpService:
     """The frontend server (reference `HttpService` service_v2 builder)."""
 
@@ -188,16 +304,31 @@ class HttpService:
         if engine is None:
             return _error_response(
                 404, f"model '{model}' not found", "model_not_found")
+        raw_n = body.get("n")
+        if raw_n is None:
+            n_choices = 1
+        elif isinstance(raw_n, int) and not isinstance(raw_n, bool):
+            n_choices = raw_n
+        else:
+            # 2.9 must not silently truncate to 2, nor true to 1
+            return _error_response(400, "'n' must be an integer")
+        if not 1 <= n_choices <= MAX_N:
+            return _error_response(
+                400, f"'n' must be between 1 and {MAX_N}")
         streaming = bool(body.get("stream", False))
         guard = self.metrics.inflight_guard(model, endpoint, streaming)
-        ectx = EngineContext()
+        ectx = EngineContext() if n_choices == 1 else _FanoutContext()
         # per-request trace (reference egress/push.rs:134-151): stage
         # latencies from HTTP ingress through dispatch to last byte, keyed
         # by the request id the control plane already carries everywhere
         with use_trace(Trace(ectx.id, role="frontend")):
             with span("dispatch", model=model, endpoint=endpoint):
                 try:
-                    stream = await engine.generate(Context(body, ectx))
+                    if n_choices == 1:
+                        stream = await engine.generate(Context(body, ectx))
+                    else:
+                        stream = await _start_fanout(engine, body, ectx,
+                                                     n_choices)
                 except ValueError as e:
                     guard.close()
                     return _error_response(400, str(e))

@@ -264,6 +264,7 @@ async def aggregate_chat_stream(stream) -> dict:
     roles: Dict[int, str] = {}
     finish: Dict[int, Optional[str]] = {}
     tool_calls: Dict[int, list] = {}
+    logprobs: Dict[int, list] = {}
     usage: Optional[dict] = None
     async for ann in stream:
         if isinstance(ann, Annotated):
@@ -287,6 +288,9 @@ async def aggregate_chat_stream(stream) -> dict:
                 texts.setdefault(idx, []).append(delta["content"])
             if delta.get("tool_calls"):
                 tool_calls.setdefault(idx, []).extend(delta["tool_calls"])
+            if (choice.get("logprobs") or {}).get("content"):
+                logprobs.setdefault(idx, []).extend(
+                    choice["logprobs"]["content"])
             if choice.get("finish_reason"):
                 finish[idx] = choice["finish_reason"]
     if base is None:
@@ -300,11 +304,14 @@ async def aggregate_chat_stream(stream) -> dict:
         }
         if tool_calls.get(idx):
             message["tool_calls"] = tool_calls[idx]
-        choices.append({
+        choice = {
             "index": idx,
             "message": message,
             "finish_reason": finish.get(idx, "stop"),
-        })
+        }
+        if logprobs.get(idx):
+            choice["logprobs"] = {"content": logprobs[idx]}
+        choices.append(choice)
     out = {
         "id": base["id"], "object": "chat.completion",
         "created": base["created"], "model": base["model"],
@@ -319,6 +326,8 @@ async def aggregate_completion_stream(stream) -> dict:
     base: Optional[dict] = None
     texts: Dict[int, List[str]] = {}
     finish: Dict[int, Optional[str]] = {}
+    lp_tokens: Dict[int, list] = {}
+    lp_values: Dict[int, list] = {}
     usage: Optional[dict] = None
     async for ann in stream:
         if isinstance(ann, Annotated):
@@ -337,19 +346,30 @@ async def aggregate_completion_stream(stream) -> dict:
             idx = choice.get("index", 0)
             if choice.get("text"):
                 texts.setdefault(idx, []).append(choice["text"])
+            lp = choice.get("logprobs") or {}
+            if lp.get("token_logprobs"):
+                lp_values.setdefault(idx, []).extend(lp["token_logprobs"])
+                lp_tokens.setdefault(idx, []).extend(lp.get("tokens", []))
             if choice.get("finish_reason"):
                 finish[idx] = choice["finish_reason"]
     if base is None:
         raise RuntimeError("empty response stream")
     indices = sorted(set(texts) | set(finish) | {0})
-    out = {
-        "id": base["id"], "object": "text_completion",
-        "created": base["created"], "model": base["model"],
-        "choices": [{
+    choices = []
+    for idx in indices:
+        choice = {
             "index": idx,
             "text": "".join(texts.get(idx, [])),
             "finish_reason": finish.get(idx, "stop"),
-        } for idx in indices],
+        }
+        if lp_values.get(idx):
+            choice["logprobs"] = {"token_logprobs": lp_values[idx],
+                                  "tokens": lp_tokens.get(idx, [])}
+        choices.append(choice)
+    out = {
+        "id": base["id"], "object": "text_completion",
+        "created": base["created"], "model": base["model"],
+        "choices": choices,
     }
     if usage is not None:
         out["usage"] = usage
