@@ -186,27 +186,39 @@ async def _merge_choice_streams(streams, ectx: "_FanoutContext"):
 
 async def _start_fanout(engine, body: dict, ectx: "_FanoutContext",
                         n: int):
-    """Launch n single-choice generations for one request. Seeded requests
-    get seed+i per choice (reproducible but decorrelated); unseeded
-    requests get a fresh random base per REQUEST (a constant base would
-    make choices 1..n-1 identical across every request)."""
+    """Launch n single-choice generations CONCURRENTLY for one request
+    (sequential dispatch would serialize per-child dial-back latency
+    against remote engines). Seeded requests get seed+i per choice
+    (reproducible but decorrelated); unseeded requests get a fresh random
+    base per REQUEST (a constant base would make choices 1..n-1 identical
+    across every request).
+
+    This is whole-request fan-out: the prompt prefills n times and holds
+    n engine slots. The deeper mechanism — one prefill, n decode streams
+    sharing the prompt KV in the engine — would replace this layer's seed
+    derivation and stream merging when the engine grows native n; until
+    then the prefix cache absorbs the repeat prefills on cache-enabled
+    engines."""
     import random
 
     base = (int(body["seed"]) if body.get("seed") is not None
             else random.getrandbits(31))
-    streams = []
-    try:
-        for i in range(n):
-            sub = dict(body)
-            sub["n"] = 1
-            sub["seed"] = base + i
-            sctx = EngineContext(f"{ectx.id}-c{i}")
-            ectx.children.append(sctx)
-            streams.append(await engine.generate(Context(sub, sctx)))
-    except BaseException:
-        ectx.kill()          # reap the children that already started
-        raise
-    return _merge_choice_streams(streams, ectx)
+
+    async def one(i: int):
+        sub = dict(body)
+        sub["n"] = 1
+        sub["seed"] = base + i
+        sctx = EngineContext(f"{ectx.id}-c{i}")
+        ectx.children.append(sctx)
+        return await engine.generate(Context(sub, sctx))
+
+    results = await asyncio.gather(*(one(i) for i in range(n)),
+                                   return_exceptions=True)
+    errs = [r for r in results if isinstance(r, BaseException)]
+    if errs:
+        ectx.kill()          # reap the children that did start
+        raise errs[0]
+    return _merge_choice_streams(list(results), ectx)
 
 
 class HttpService:

@@ -19,28 +19,28 @@ This is the TPU build's analog of the reference's ``DistributedRuntime``
 
 Requests/responses are serialized with pluggable serde callables so the LLM
 protocol layer (dataclasses) and tests (plain dicts) share the same plane.
+
+Module layout (round 3 — split mirroring the reference's component/*.rs):
+naming + discovery records in :mod:`.component`, the serving side in
+:mod:`.ingress`, the calling side in :mod:`.egress`. This module holds the
+per-process runtime and re-exports the public surface, so existing imports
+keep working.
 """
 
 from __future__ import annotations
 
-import asyncio
-import collections
-import dataclasses
-import itertools
-import json
 import logging
 import os
-import random
-import time
 import uuid
-from typing import Any, AsyncIterator, Callable, Dict, List, Optional
+from typing import Callable, List, Optional
 
 from .bus import MemoryBus, MessageBus
-from .codec import (ConnectionInfo, ControlMessage, Frame, FrameKind,
-                    RequestControlMessage, decode_two_part, encode_two_part)
-from .engine import AsyncEngine, Context, ManyOut, ResponseStream, SingleIn
-from .kvstore import (KvStore, Lease, MemoryKvStore, WatchEventType)
-from .tcp import StreamSender, TcpStreamServer, open_stream_sender
+from .component import (Component, ComponentEndpointInfo, Endpoint,
+                        Namespace, json_serde)
+from .egress import Client
+from .ingress import EndpointServer
+from .kvstore import KvStore, Lease, MemoryKvStore
+from .tcp import TcpStreamServer
 
 logger = logging.getLogger("dynamo_tpu.runtime.distributed")
 
@@ -51,52 +51,9 @@ __all__ = [
     "Endpoint",
     "EndpointServer",
     "Client",
+    "ComponentEndpointInfo",
     "json_serde",
 ]
-
-
-def _default_encode(obj: Any) -> bytes:
-    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
-        obj = dataclasses.asdict(obj)
-    elif hasattr(obj, "to_dict"):
-        obj = obj.to_dict()
-    return json.dumps(obj).encode()
-
-
-def json_serde(cls: Optional[type] = None):
-    """(encode, decode) pair: dataclass/dict → JSON bytes and back.
-    ``cls`` may define ``from_dict`` or be a dataclass for typed decode."""
-
-    def decode(raw: bytes) -> Any:
-        d = json.loads(raw)
-        if cls is None:
-            return d
-        if hasattr(cls, "from_dict"):
-            return cls.from_dict(d)
-        if dataclasses.is_dataclass(cls):
-            return cls(**d)
-        return d
-
-    return _default_encode, decode
-
-
-@dataclasses.dataclass
-class ComponentEndpointInfo:
-    """Discovery record one serving endpoint writes.
-    Reference: ``ComponentEndpointInfo`` (component.rs:90-97)."""
-
-    subject: str
-    worker_id: int
-    component: str
-    endpoint: str
-    namespace: str
-
-    def to_json(self) -> bytes:
-        return json.dumps(dataclasses.asdict(self)).encode()
-
-    @classmethod
-    def from_json(cls, raw: bytes) -> "ComponentEndpointInfo":
-        return cls(**json.loads(raw))
 
 
 class DistributedRuntime:
@@ -114,7 +71,7 @@ class DistributedRuntime:
         self.tcp = TcpStreamServer(tcp_host, advertise)
         self.worker_uuid = uuid.uuid4().hex
         self._primary_lease: Optional[Lease] = None
-        self._servers: List["EndpointServer"] = []
+        self._servers: List[EndpointServer] = []
         self.on_lease_lost: Optional[Callable[[], None]] = None
         self._closed = False
 
@@ -155,7 +112,7 @@ class DistributedRuntime:
             raise RuntimeError("no primary lease yet (serve an endpoint first)")
         return self._primary_lease.id
 
-    def namespace(self, name: str) -> "Namespace":
+    def namespace(self, name: str) -> Namespace:
         return Namespace(self, name)
 
     async def shutdown(self) -> None:
@@ -170,534 +127,3 @@ class DistributedRuntime:
         await self.tcp.close()
         await self.bus.close()
         await self.store.close()
-
-
-@dataclasses.dataclass
-class Namespace:
-    runtime: DistributedRuntime
-    name: str
-
-    def component(self, name: str) -> "Component":
-        return Component(self.runtime, self.name, name)
-
-    # -- event plane (reference traits/events.rs: namespace-scoped pub/sub)
-    def event_subject(self, topic: str) -> str:
-        return f"evt.{self.name}.{topic}"
-
-    async def publish_event(self, topic: str, payload: Any) -> None:
-        await self.runtime.bus.publish(self.event_subject(topic),
-                                       _default_encode(payload))
-
-    async def subscribe_event(self, topic: str):
-        return await self.runtime.bus.subscribe(self.event_subject(topic))
-
-
-@dataclasses.dataclass
-class Component:
-    runtime: DistributedRuntime
-    namespace: str
-    name: str
-
-    def endpoint(self, name: str) -> "Endpoint":
-        return Endpoint(self.runtime, self.namespace, self.name, name)
-
-    def event_subject(self, topic: str) -> str:
-        return f"evt.{self.namespace}.{self.name}.{topic}"
-
-    async def publish_event(self, topic: str, payload: Any) -> None:
-        await self.runtime.bus.publish(self.event_subject(topic),
-                                       _default_encode(payload))
-
-    async def subscribe_event(self, topic: str):
-        return await self.runtime.bus.subscribe(self.event_subject(topic))
-
-
-@dataclasses.dataclass
-class Endpoint:
-    runtime: DistributedRuntime
-    namespace: str
-    component: str
-    name: str
-
-    def parent_component(self) -> Component:
-        return Component(self.runtime, self.namespace, self.component)
-
-    # naming (reference component.rs:246-257 / component/endpoint.rs:110-137)
-    def discovery_prefix(self) -> str:
-        return f"{self.namespace}/components/{self.component}/{self.name}:"
-
-    def discovery_key(self, lease_id: int) -> str:
-        return f"{self.discovery_prefix()}{lease_id:x}"
-
-    def subject(self, lease_id: int) -> str:
-        return f"{self.namespace}|{self.component}.{self.name}-{lease_id:x}"
-
-    def stats_key(self, lease_id: int) -> str:
-        return (f"{self.namespace}/stats/{self.component}/"
-                f"{self.name}:{lease_id:x}")
-
-    @property
-    def path(self) -> str:
-        return f"dyn://{self.namespace}/{self.component}/{self.name}"
-
-    def __post_init__(self) -> None:
-        # structure characters (| . - : /) in names would corrupt subjects
-        # and discovery keys (reference slug.rs; component.rs:323-339 TODO)
-        from .slug import validate_name
-        validate_name(self.namespace, "namespace")
-        validate_name(self.component, "component")
-        validate_name(self.name, "endpoint")
-
-    @classmethod
-    def parse_path(cls, runtime: DistributedRuntime, path: str) -> "Endpoint":
-        """Parse ``dyn://ns/comp/ep`` or ``ns.comp.ep`` (reference
-        protocols.rs:33-200)."""
-        p = path
-        if p.startswith("dyn://"):
-            p = p[len("dyn://"):]
-        parts = p.replace(".", "/").split("/")
-        if len(parts) != 3 or not all(parts):
-            raise ValueError(f"invalid endpoint path: {path!r}")
-        return cls(runtime, *parts)
-
-    async def serve(self, engine: AsyncEngine,
-                    decode_req: Optional[Callable[[bytes], Any]] = None,
-                    encode_resp: Optional[Callable[[Any], bytes]] = None,
-                    stats_handler: Optional[Callable[[], Any]] = None,
-                    stats_interval: float = 1.0) -> "EndpointServer":
-        """Register + start serving. Returns the running server handle."""
-        server = EndpointServer(self, engine,
-                                decode_req or json_serde()[1],
-                                encode_resp or _default_encode,
-                                stats_handler, stats_interval)
-        await server.start()
-        self.runtime._servers.append(server)
-        return server
-
-    def client(self, decode_resp: Optional[Callable[[bytes], Any]] = None,
-               encode_req: Optional[Callable[[Any], bytes]] = None) -> "Client":
-        return Client(self, encode_req or _default_encode,
-                      decode_resp or json_serde()[1])
-
-
-class EndpointServer:
-    """Serving side: bus inbox loop → engine → TCP dial-back stream.
-    Reference: ``PushEndpoint`` (ingress/push_endpoint.rs:36-84) +
-    ``Ingress`` (network.rs:51-325)."""
-
-    def __init__(self, endpoint: Endpoint, engine: AsyncEngine,
-                 decode_req: Callable[[bytes], Any],
-                 encode_resp: Callable[[Any], bytes],
-                 stats_handler: Optional[Callable[[], Any]] = None,
-                 stats_interval: float = 1.0):
-        self.endpoint = endpoint
-        self.engine = engine
-        self.decode_req = decode_req
-        self.encode_resp = encode_resp
-        self.stats_handler = stats_handler
-        self.stats_interval = stats_interval
-        self.lease: Optional[Lease] = None
-        self._inbox = None
-        self._loop_task: Optional[asyncio.Task] = None
-        self._stats_task: Optional[asyncio.Task] = None
-        self._inflight: set = set()
-        self._stopping = False
-        # fire-and-forget dedup window (ADVICE r2): the client's dispatch
-        # retry is at-least-once; for streaming requests duplicates are
-        # harmless (the client consumes only the last dialed-back stream),
-        # but a request WITHOUT connection info has no stream to
-        # disambiguate and real side effects — drop repeats of its id.
-        self._recent_ff_ids: "collections.OrderedDict[str, float]" = \
-            collections.OrderedDict()
-
-    RECENT_ID_WINDOW = 60.0
-    RECENT_ID_MAX = 4096
-
-    def _ff_duplicate(self, rid: str) -> bool:
-        """Record rid; True if it was already accepted inside the window."""
-        now = time.monotonic()
-        while self._recent_ff_ids:     # expire by age BEFORE the check, so
-            oldest_id, t = next(iter(self._recent_ff_ids.items()))
-            if now - t <= self.RECENT_ID_WINDOW:
-                break
-            del self._recent_ff_ids[oldest_id]
-        if rid in self._recent_ff_ids:
-            return True
-        self._recent_ff_ids[rid] = now
-        while len(self._recent_ff_ids) > self.RECENT_ID_MAX:
-            # capacity-evict AFTER inserting — evicting first could evict
-            # rid's own prior entry and accept the duplicate as new
-            self._recent_ff_ids.popitem(last=False)
-        return False
-
-    def _ff_forget(self, rid: str) -> None:
-        """The request did NOT execute — let a redelivery run it (recording
-        at accept time and forgetting on failure keeps concurrent in-flight
-        duplicates deduped without turning transient failures into drops)."""
-        self._recent_ff_ids.pop(rid, None)
-
-    @property
-    def lease_id(self) -> int:
-        assert self.lease is not None
-        return self.lease.id
-
-    async def start(self) -> None:
-        rt = self.endpoint.runtime
-        await rt.tcp.start()
-        self.lease = await rt.primary_lease()
-        subject = self.endpoint.subject(self.lease.id)
-        self._inbox = await rt.bus.serve(subject)
-        info = ComponentEndpointInfo(
-            subject=subject, worker_id=self.lease.id,
-            component=self.endpoint.component, endpoint=self.endpoint.name,
-            namespace=self.endpoint.namespace)
-        created = await rt.store.kv_create(
-            self.endpoint.discovery_key(self.lease.id), info.to_json(),
-            lease_id=self.lease.id)
-        if not created:
-            raise RuntimeError(
-                f"endpoint already registered: {self.endpoint.path}")
-        self._loop_task = asyncio.get_running_loop().create_task(
-            self._serve_loop(), name=f"endpoint-{self.endpoint.name}")
-        if self.stats_handler is not None:
-            self._stats_task = asyncio.get_running_loop().create_task(
-                self._stats_loop(), name=f"stats-{self.endpoint.name}")
-        logger.info("serving %s as instance %x", self.endpoint.path,
-                    self.lease.id)
-
-    async def _serve_loop(self) -> None:
-        while not self._stopping:
-            msg = await self._inbox.next(timeout=0.5)
-            if msg is None:
-                continue
-            task = asyncio.get_running_loop().create_task(
-                self._handle(msg.payload))
-            self._inflight.add(task)
-            task.add_done_callback(self._inflight.discard)
-
-    async def _handle(self, payload: bytes) -> None:
-        try:
-            ctrl, body = decode_two_part(payload)
-        except Exception:
-            logger.exception("undecodable request envelope")
-            return
-        info = ctrl.connection_info
-        if info is None and self._ff_duplicate(ctrl.id):
-            logger.warning("dropping duplicate fire-and-forget request %s "
-                           "(at-least-once re-dispatch)", ctrl.id)
-            return
-        sender: Optional[StreamSender] = None
-        try:
-            request = self.decode_req(body)
-        except Exception as e:
-            if info is not None:
-                sender = await open_stream_sender(info, error=str(e))
-                await sender.finish()
-            else:
-                self._ff_forget(ctrl.id)
-            return
-        from .engine import EngineContext
-        from .tracing import Trace, span, use_trace
-        ctx = Context(request, ctx=EngineContext(ctrl.id))
-        # worker-side trace under the SAME request id the frontend logged
-        # (ingress prologue → engine → first frame → stream end)
-        with use_trace(Trace(ctrl.id, role="worker")) as trace:
-            with span("engine.accept"):
-                try:
-                    stream = await self.engine.generate(ctx)
-                except Exception as e:
-                    logger.exception("engine rejected request %s", ctrl.id)
-                    if info is not None:
-                        sender = await open_stream_sender(info, error=str(e))
-                        await sender.finish()
-                    else:
-                        self._ff_forget(ctrl.id)
-                    return
-            if info is None:
-                try:
-                    async for _ in stream:   # fire-and-forget request type
-                        pass
-                except Exception:
-                    self._ff_forget(ctrl.id)
-                    raise
-                return
-            with span("dial_back"):
-                sender = await open_stream_sender(info)
-            sender.on_stop = ctx.ctx.stop_generating
-            sender.on_kill = ctx.ctx.kill
-            try:
-                with span("respond") as resp_span:
-                    first = True
-                    async for item in stream:
-                        if sender.killed:
-                            break
-                        await sender.send(self.encode_resp(item))
-                        if first:
-                            first = False
-                            trace.event("first_response")
-                    await sender.finish()
-            except (ConnectionError, OSError):
-                ctx.ctx.kill()
-            except Exception as e:
-                logger.exception("stream failed for %s", ctrl.id)
-                await sender.finish(error=str(e))
-
-    async def _stats_loop(self) -> None:
-        rt = self.endpoint.runtime
-        key = self.endpoint.stats_key(self.lease.id)
-        while not self._stopping:
-            try:
-                data = self.stats_handler()
-                await rt.store.kv_put(key, _default_encode(data),
-                                      lease_id=self.lease.id)
-            except Exception:
-                logger.exception("stats publish failed")
-            await asyncio.sleep(self.stats_interval)
-
-    async def stop(self) -> None:
-        self._stopping = True
-        rt = self.endpoint.runtime
-        if self._loop_task is not None:
-            self._loop_task.cancel()
-        if self._stats_task is not None:
-            self._stats_task.cancel()
-        for t in list(self._inflight):
-            t.cancel()
-        if self.lease is not None:
-            # best-effort, bounded deregistration: if the daemon is gone,
-            # lease expiry cleans these up anyway — shutdown must never
-            # hang in the netstore reconnect window
-            try:
-                async with asyncio.timeout(2.0):
-                    await rt.bus.unserve(
-                        self.endpoint.subject(self.lease.id))
-                    await rt.store.kv_delete(
-                        self.endpoint.discovery_key(self.lease.id))
-                    if self._stats_task is not None:
-                        await rt.store.kv_delete(
-                            self.endpoint.stats_key(self.lease.id))
-            except (TimeoutError, ConnectionError, OSError):
-                logger.warning("endpoint %s deregistration skipped (daemon "
-                               "unreachable); lease expiry will clean up",
-                               self.endpoint.path)
-        if self in rt._servers:
-            rt._servers.remove(self)
-
-
-class _RemoteStream(ResponseStream):
-    """Client-side view of a worker's TCP response stream; forwards
-    stop/kill from the local context as upstream control frames."""
-
-    def __init__(self, ctx, rx, decode_resp, server: TcpStreamServer):
-        self._rx = rx
-        self._decode = decode_resp
-        self._server = server
-        self._ctx = ctx
-        super().__init__(self._gen(), ctx)
-
-    def _gen(self) -> AsyncIterator[Any]:
-        async def gen():
-            try:
-                while True:
-                    if self._ctx.is_killed:
-                        await self._rx.send_control(ControlMessage.kill())
-                        return
-                    if self._ctx.is_stopped:
-                        await self._rx.send_control(ControlMessage.stop())
-                    f = await self._rx.next_frame(timeout=0.5)
-                    if f is None:
-                        continue
-                    if f.kind == FrameKind.DATA:
-                        yield self._decode(f.data)
-                    elif f.kind == FrameKind.SENTINEL:
-                        return
-                    elif f.kind == FrameKind.ERROR:
-                        err = f.header_json().get("error", "stream error")
-                        raise RuntimeError(f"remote stream error: {err}")
-            finally:
-                self._rx.close()
-                self._server.unregister(self._rx.stream_id)
-        return gen()
-
-
-class Client(AsyncEngine):
-    """Watches discovery, routes requests. Reference ``Client<T,U>``
-    (component/client.rs:52-256); default routing is random, like the
-    reference's AsyncEngine impl for Client."""
-
-    def __init__(self, endpoint: Endpoint,
-                 encode_req: Callable[[Any], bytes],
-                 decode_resp: Callable[[bytes], Any]):
-        self.endpoint = endpoint
-        self.encode_req = encode_req
-        self.decode_resp = decode_resp
-        self.instances: Dict[int, ComponentEndpointInfo] = {}
-        self._watcher = None
-        self._watch_task: Optional[asyncio.Task] = None
-        self._rr = itertools.count()
-        self._instances_event = asyncio.Event()
-        self.on_instances_changed: Optional[Callable[[set], None]] = None
-
-    async def start(self) -> "Client":
-        rt = self.endpoint.runtime
-        await rt.tcp.start()
-        self._watcher = await rt.store.watch_prefix(
-            self.endpoint.discovery_prefix())
-        self._watch_task = asyncio.get_running_loop().create_task(
-            self._watch_loop(), name=f"client-watch-{self.endpoint.name}")
-        return self
-
-    async def _watch_loop(self) -> None:
-        async for ev in self._watcher:
-            key = ev.entry.key
-            lease_hex = key.rsplit(":", 1)[-1]
-            try:
-                lease_id = int(lease_hex, 16)
-            except ValueError:
-                continue
-            if ev.type == WatchEventType.PUT:
-                try:
-                    self.instances[lease_id] = ComponentEndpointInfo.from_json(
-                        ev.entry.value)
-                except Exception:
-                    continue
-            else:
-                self.instances.pop(lease_id, None)
-            self._instances_event.set()
-            if self.on_instances_changed is not None:
-                self.on_instances_changed(set(self.instances))
-
-    def instance_ids(self) -> List[int]:
-        return sorted(self.instances)
-
-    async def wait_for_instances(self, timeout: float = 30.0) -> List[int]:
-        deadline = asyncio.get_running_loop().time() + timeout
-        while not self.instances:
-            remaining = deadline - asyncio.get_running_loop().time()
-            if remaining <= 0:
-                raise TimeoutError(
-                    f"no instances for {self.endpoint.path} after {timeout}s")
-            self._instances_event.clear()
-            try:
-                await asyncio.wait_for(self._instances_event.wait(),
-                                       min(remaining, 1.0))
-            except asyncio.TimeoutError:
-                pass
-        return self.instance_ids()
-
-    # --------------------------------------------------------------- routes
-    async def generate(self, request: SingleIn) -> ManyOut:
-        return await self.random(request)
-
-    async def random(self, request: SingleIn) -> ManyOut:
-        ids = self.instance_ids()
-        if not ids:
-            raise RuntimeError(f"no instances for {self.endpoint.path}")
-        return await self.direct(request, random.choice(ids))
-
-    async def round_robin(self, request: SingleIn) -> ManyOut:
-        ids = self.instance_ids()
-        if not ids:
-            raise RuntimeError(f"no instances for {self.endpoint.path}")
-        return await self.direct(request, ids[next(self._rr) % len(ids)])
-
-    async def direct(self, request: SingleIn, instance_id: int) -> ManyOut:
-        """The push-router send path (egress/push.rs:88-156): register a
-        response stream, publish the two-part request, await dial-back."""
-        info = self.instances.get(instance_id)
-        if info is None:
-            raise RuntimeError(
-                f"unknown instance {instance_id:x} for {self.endpoint.path}")
-        rt = self.endpoint.runtime
-        ctx = request if isinstance(request, Context) else Context(request)
-        rx = rt.tcp.register()
-        try:
-            # egress span (reference egress/push.rs:134-151): publish +
-            # dial-back wait, tagged with the target instance
-            from .tracing import span as _span
-            with _span("egress", instance=f"{instance_id:x}",
-                       path=self.endpoint.path):
-                rx, prologue = await self._dispatch_with_retry(
-                    rt, rx, ctx, info, instance_id)
-        except Exception:
-            rt.tcp.unregister(rx.stream_id)
-            raise
-        if prologue.error is not None:
-            rt.tcp.unregister(rx.stream_id)
-            raise RuntimeError(f"remote rejected request: {prologue.error}")
-        return _RemoteStream(ctx.ctx, rx, self.decode_resp, rt.tcp)
-
-    DIAL_BACK_TIMEOUT = 10.0
-    DISPATCH_ATTEMPTS = 3
-
-    async def _dispatch_with_retry(self, rt, rx, ctx, info, instance_id):
-        """Publish the two-part request and await the worker's dial-back,
-        retrying the failure modes a daemon restart creates:
-
-        - publish reaches ZERO receivers (the worker's serve subscription
-          is mid-re-establishment) — NATS "no responders" semantics;
-        - publish reached a receiver that died before dialing back (the
-          message sat in a killed session's queue) — dial-back timeout,
-          re-dispatch on a fresh stream.
-
-        Re-dispatch is at-least-once: a slow-but-alive worker could end up
-        serving the request twice, with the client consuming only the last
-        stream — the same contract as the reference's NATS request plane."""
-        loop = asyncio.get_running_loop()
-        last_err: Exception = RuntimeError("dispatch failed")
-        for attempt in range(self.DISPATCH_ATTEMPTS):
-            conn = rt.tcp.connection_info(rx)
-            ctrl = RequestControlMessage(id=ctx.id, connection_info=conn)
-            payload = encode_two_part(ctrl, self.encode_req(ctx.data))
-            deadline = loop.time() + self.DIAL_BACK_TIMEOUT
-            delay = 0.05
-            try:
-                while True:   # no-responders backoff within this attempt
-                    n = await rt.bus.publish(info.subject, payload)
-                    if n is None or n > 0:  # None: bus without counts
-                        break
-                    if loop.time() >= deadline:
-                        raise RuntimeError(
-                            f"no responders on {info.subject} "
-                            f"(instance {instance_id:x})")
-                    await asyncio.sleep(delay)
-                    delay = min(delay * 2, 0.5)
-                prologue = await rx.wait_connected(
-                    timeout=max(deadline - loop.time(), 1.0))
-                return rx, prologue
-            except (TimeoutError, asyncio.TimeoutError, RuntimeError) as e:
-                last_err = e
-                if attempt + 1 >= self.DISPATCH_ATTEMPTS:
-                    # the caller's cleanup unregisters ITS original rx —
-                    # the retry streams registered here must not leak
-                    # (unregister is idempotent, double-pop is fine)
-                    rt.tcp.unregister(rx.stream_id)
-                    raise
-                logger.warning(
-                    "dispatch to %s attempt %d failed (%s); retrying on a "
-                    "fresh stream", self.endpoint.path, attempt + 1, e)
-                rt.tcp.unregister(rx.stream_id)
-                rx = rt.tcp.register()
-        raise last_err
-
-    # -------------------------------------------------------------- scrape
-    async def collect_stats(self) -> Dict[int, Any]:
-        """Scrape per-instance stats records (reference ServiceClient
-        ``collect_services`` via NATS $SRV.STATS; ours ride the KV store —
-        same data, discovery-backed transport)."""
-        rt = self.endpoint.runtime
-        prefix = (f"{self.endpoint.namespace}/stats/"
-                  f"{self.endpoint.component}/{self.endpoint.name}:")
-        out: Dict[int, Any] = {}
-        for e in await rt.store.kv_get_prefix(prefix):
-            try:
-                out[int(e.key.rsplit(":", 1)[-1], 16)] = json.loads(e.value)
-            except Exception:
-                continue
-        return out
-
-    async def close(self) -> None:
-        if self._watch_task is not None:
-            self._watch_task.cancel()
-        if self._watcher is not None:
-            self._watcher.close()

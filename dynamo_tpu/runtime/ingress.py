@@ -1,0 +1,229 @@
+"""Serving side of the request plane: bus inbox → engine → TCP dial-back.
+
+Reference: ``PushEndpoint`` (lib/runtime/src/pipeline/network/ingress/
+push_endpoint.rs:36-84) + ``Ingress`` (network.rs:51-325). Split out of
+distributed.py (round 3); naming lives in runtime/component.py, the
+calling side in runtime/egress.py.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import time
+from typing import Any, Callable, Optional
+
+from .codec import decode_two_part
+from .component import ComponentEndpointInfo, _default_encode
+from .engine import AsyncEngine, Context
+from .kvstore import Lease
+from .tcp import StreamSender, open_stream_sender
+
+logger = logging.getLogger("dynamo_tpu.runtime.distributed")
+
+__all__ = ["EndpointServer"]
+
+
+class EndpointServer:
+    """Serving side: bus inbox loop → engine → TCP dial-back stream.
+    Reference: ``PushEndpoint`` (ingress/push_endpoint.rs:36-84) +
+    ``Ingress`` (network.rs:51-325)."""
+
+    def __init__(self, endpoint, engine: AsyncEngine,
+                 decode_req: Callable[[bytes], Any],
+                 encode_resp: Callable[[Any], bytes],
+                 stats_handler: Optional[Callable[[], Any]] = None,
+                 stats_interval: float = 1.0):
+        self.endpoint = endpoint
+        self.engine = engine
+        self.decode_req = decode_req
+        self.encode_resp = encode_resp
+        self.stats_handler = stats_handler
+        self.stats_interval = stats_interval
+        self.lease: Optional[Lease] = None
+        self._inbox = None
+        self._loop_task: Optional[asyncio.Task] = None
+        self._stats_task: Optional[asyncio.Task] = None
+        self._inflight: set = set()
+        self._stopping = False
+        # fire-and-forget dedup window (ADVICE r2): the client's dispatch
+        # retry is at-least-once; for streaming requests duplicates are
+        # harmless (the client consumes only the last dialed-back stream),
+        # but a request WITHOUT connection info has no stream to
+        # disambiguate and real side effects — drop repeats of its id.
+        self._recent_ff_ids: "collections.OrderedDict[str, float]" = \
+            collections.OrderedDict()
+
+    RECENT_ID_WINDOW = 60.0
+    RECENT_ID_MAX = 4096
+
+    def _ff_duplicate(self, rid: str) -> bool:
+        """Record rid; True if it was already accepted inside the window."""
+        now = time.monotonic()
+        while self._recent_ff_ids:     # expire by age BEFORE the check, so
+            oldest_id, t = next(iter(self._recent_ff_ids.items()))
+            if now - t <= self.RECENT_ID_WINDOW:
+                break
+            del self._recent_ff_ids[oldest_id]
+        if rid in self._recent_ff_ids:
+            return True
+        self._recent_ff_ids[rid] = now
+        while len(self._recent_ff_ids) > self.RECENT_ID_MAX:
+            # capacity-evict AFTER inserting — evicting first could evict
+            # rid's own prior entry and accept the duplicate as new
+            self._recent_ff_ids.popitem(last=False)
+        return False
+
+    def _ff_forget(self, rid: str) -> None:
+        """The request did NOT execute — let a redelivery run it (recording
+        at accept time and forgetting on failure keeps concurrent in-flight
+        duplicates deduped without turning transient failures into drops)."""
+        self._recent_ff_ids.pop(rid, None)
+
+    @property
+    def lease_id(self) -> int:
+        assert self.lease is not None
+        return self.lease.id
+
+    async def start(self) -> None:
+        rt = self.endpoint.runtime
+        await rt.tcp.start()
+        self.lease = await rt.primary_lease()
+        subject = self.endpoint.subject(self.lease.id)
+        self._inbox = await rt.bus.serve(subject)
+        info = ComponentEndpointInfo(
+            subject=subject, worker_id=self.lease.id,
+            component=self.endpoint.component, endpoint=self.endpoint.name,
+            namespace=self.endpoint.namespace)
+        created = await rt.store.kv_create(
+            self.endpoint.discovery_key(self.lease.id), info.to_json(),
+            lease_id=self.lease.id)
+        if not created:
+            raise RuntimeError(
+                f"endpoint already registered: {self.endpoint.path}")
+        self._loop_task = asyncio.get_running_loop().create_task(
+            self._serve_loop(), name=f"endpoint-{self.endpoint.name}")
+        if self.stats_handler is not None:
+            self._stats_task = asyncio.get_running_loop().create_task(
+                self._stats_loop(), name=f"stats-{self.endpoint.name}")
+        logger.info("serving %s as instance %x", self.endpoint.path,
+                    self.lease.id)
+
+    async def _serve_loop(self) -> None:
+        while not self._stopping:
+            msg = await self._inbox.next(timeout=0.5)
+            if msg is None:
+                continue
+            task = asyncio.get_running_loop().create_task(
+                self._handle(msg.payload))
+            self._inflight.add(task)
+            task.add_done_callback(self._inflight.discard)
+
+    async def _handle(self, payload: bytes) -> None:
+        try:
+            ctrl, body = decode_two_part(payload)
+        except Exception:
+            logger.exception("undecodable request envelope")
+            return
+        info = ctrl.connection_info
+        if info is None and self._ff_duplicate(ctrl.id):
+            logger.warning("dropping duplicate fire-and-forget request %s "
+                           "(at-least-once re-dispatch)", ctrl.id)
+            return
+        sender: Optional[StreamSender] = None
+        try:
+            request = self.decode_req(body)
+        except Exception as e:
+            if info is not None:
+                sender = await open_stream_sender(info, error=str(e))
+                await sender.finish()
+            else:
+                self._ff_forget(ctrl.id)
+            return
+        from .engine import EngineContext
+        from .tracing import Trace, span, use_trace
+        ctx = Context(request, ctx=EngineContext(ctrl.id))
+        # worker-side trace under the SAME request id the frontend logged
+        # (ingress prologue → engine → first frame → stream end)
+        with use_trace(Trace(ctrl.id, role="worker")) as trace:
+            with span("engine.accept"):
+                try:
+                    stream = await self.engine.generate(ctx)
+                except Exception as e:
+                    logger.exception("engine rejected request %s", ctrl.id)
+                    if info is not None:
+                        sender = await open_stream_sender(info, error=str(e))
+                        await sender.finish()
+                    else:
+                        self._ff_forget(ctrl.id)
+                    return
+            if info is None:
+                try:
+                    async for _ in stream:   # fire-and-forget request type
+                        pass
+                except Exception:
+                    self._ff_forget(ctrl.id)
+                    raise
+                return
+            with span("dial_back"):
+                sender = await open_stream_sender(info)
+            sender.on_stop = ctx.ctx.stop_generating
+            sender.on_kill = ctx.ctx.kill
+            try:
+                with span("respond"):
+                    first = True
+                    async for item in stream:
+                        if sender.killed:
+                            break
+                        await sender.send(self.encode_resp(item))
+                        if first:
+                            first = False
+                            trace.event("first_response")
+                    await sender.finish()
+            except (ConnectionError, OSError):
+                ctx.ctx.kill()
+            except Exception as e:
+                logger.exception("stream failed for %s", ctrl.id)
+                await sender.finish(error=str(e))
+
+    async def _stats_loop(self) -> None:
+        rt = self.endpoint.runtime
+        key = self.endpoint.stats_key(self.lease.id)
+        while not self._stopping:
+            try:
+                data = self.stats_handler()
+                await rt.store.kv_put(key, _default_encode(data),
+                                      lease_id=self.lease.id)
+            except Exception:
+                logger.exception("stats publish failed")
+            await asyncio.sleep(self.stats_interval)
+
+    async def stop(self) -> None:
+        self._stopping = True
+        rt = self.endpoint.runtime
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+        if self._stats_task is not None:
+            self._stats_task.cancel()
+        for t in list(self._inflight):
+            t.cancel()
+        if self.lease is not None:
+            # best-effort, bounded deregistration: if the daemon is gone,
+            # lease expiry cleans these up anyway — shutdown must never
+            # hang in the netstore reconnect window
+            try:
+                async with asyncio.timeout(2.0):
+                    await rt.bus.unserve(
+                        self.endpoint.subject(self.lease.id))
+                    await rt.store.kv_delete(
+                        self.endpoint.discovery_key(self.lease.id))
+                    if self._stats_task is not None:
+                        await rt.store.kv_delete(
+                            self.endpoint.stats_key(self.lease.id))
+            except (TimeoutError, ConnectionError, OSError):
+                logger.warning("endpoint %s deregistration skipped (daemon "
+                               "unreachable); lease expiry will clean up",
+                               self.endpoint.path)
+        if self in rt._servers:
+            rt._servers.remove(self)
