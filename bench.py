@@ -13,7 +13,10 @@ driver has a consistent scalar across rounds.
 Env knobs: BENCH_BATCH (default 128 — post-KV-carry-fix scaling on v5e:
 B=64 ≈ 10.3k, B=128 ≈ 14.7k, B=256 ≈ 15.9k tok/s/chip int8; 128 balances
 throughput against ~9 ms ITL), BENCH_STEPS (128), BENCH_PROMPT (128),
-BENCH_MODEL (1b|tiny), BENCH_ATTN (auto|pallas|xla), BENCH_HARVEST (default
+BENCH_MODEL (1b|tiny|8b — 8b is Llama-3-8B geometry, random weights; at
+int8 the weights are ~8 GB of the 16 GB HBM, so pick BENCH_BATCH/LEN so
+KV fits: B=64 with default lengths, B=128 with BENCH_HARVEST<=8),
+BENCH_ATTN (auto|pallas|xla), BENCH_HARVEST (default
 32) — decode steps fused per dispatch (EngineConfig.decode_steps_per_dispatch):
 sampled tokens chain on device and the host harvests once per dispatch,
 amortizing device→host latency. BENCH_PIPELINE (default 1): defer each
@@ -297,6 +300,16 @@ def main() -> None:
                            intermediate_size=512, num_layers=4, num_heads=8,
                            num_kv_heads=4, head_dim=32,
                            max_position_embeddings=2048)
+    elif model == "8b":
+        # Llama-3-8B geometry (BASELINE.md config 2): the largest real
+        # on-chip datapoint one v5e can produce — int8 weights ≈ 8 GB
+        # against 16 GB HBM — anchoring the 70B TP-8 extrapolation with
+        # an HBM-bound measurement instead of the 1B compute-light one
+        mcfg = ModelConfig(vocab_size=128256, hidden_size=4096,
+                           intermediate_size=14336, num_layers=32,
+                           num_heads=32, num_kv_heads=8, head_dim=128,
+                           max_position_embeddings=8192,
+                           rope_theta=500000.0)
     else:  # llama-3.2-1B shapes
         mcfg = ModelConfig(vocab_size=128256, hidden_size=2048,
                            intermediate_size=8192, num_layers=16,

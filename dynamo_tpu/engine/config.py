@@ -62,6 +62,10 @@ class ModelConfig:
     # even-layers-local default)
     sliding_window: Optional[int] = None
     layer_types: Optional[List[str]] = None
+    # runtime switch, not model geometry: the engine clears this when the
+    # head is mesh-sharded (tp>1) — the fused Pallas head has no GSPMD
+    # partitioning rule (models/llama.py _lm_head_kernel_ok)
+    lm_head_pallas: bool = True
 
     @classmethod
     def from_hf_config(cls, cfg: Dict[str, Any]) -> "ModelConfig":

@@ -150,6 +150,14 @@ class EngineCore:
             from ..parallel.sharding import shard_kv, shard_params
             self.params = shard_params(self.params, mesh, model_cfg)
             self.kv = shard_kv(self.kv, mesh)
+            if mesh.shape.get("tp", 1) > 1 and model_cfg.lm_head_pallas:
+                # the head is vocab-sharded over tp; the fused Pallas head
+                # cannot partition — route _logits to the XLA paths
+                model_cfg = dataclasses.replace(model_cfg,
+                                                lm_head_pallas=False)
+                self.model_cfg = model_cfg
+                self.statics = dataclasses.replace(self.statics,
+                                                   cfg=model_cfg)
         self.kv_event_publisher = kv_event_publisher
         on_stored = (kv_event_publisher.publish_stored
                      if kv_event_publisher is not None else None)

@@ -310,6 +310,24 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
     return x, {"k": k_new, "v": v_new}
 
 
+def _lm_head_kernel_ok(head: QuantizedArray,
+                       cfg: ModelConfig = None) -> bool:
+    """Use the fused Pallas head on real TPUs when the vocab tiles evenly
+    AND the head is unsharded — under tensor parallelism the vocab axis is
+    mesh-sharded and pallas_call has no GSPMD partitioning rule (the
+    engine clears cfg.lm_head_pallas when it shards params over tp>1).
+    DYN_LMHEAD_KERNEL=0 is the escape hatch back to the XLA paths."""
+    import os
+    if os.environ.get("DYN_LMHEAD_KERNEL", "1") == "0":
+        return False
+    if cfg is not None and not cfg.lm_head_pallas:
+        return False
+    from ..lm_head import TILE_V
+    if head.q.shape[1] % TILE_V != 0:
+        return False
+    return _on_tpu()
+
+
 def _logits(params: Params, x: jax.Array,
             cfg: ModelConfig = None) -> jax.Array:
     head = params.get("lm_head")
@@ -321,20 +339,31 @@ def _logits(params: Params, x: jax.Array,
     tied_q = (cfg is not None and cfg.tie_word_embeddings
               and isinstance(head, QuantizedArray)
               and isinstance(emb, QuantizedArray))
-    # XLA's int8 matmul heuristics flip with batch size (measured on v5e,
-    # llama-1B head [2048, 128256]): the pre-transposed int8 head wins
-    # below ~32 rows (4.5ms vs 12.3ms step at B=16) but collapses at
-    # B=64 (82ms), where computing against the transposed int8 embedding
-    # is fine (9.7ms) — pick per traced batch size, it's static under jit
-    big_batch = x.ndim > 1 and x.shape[0] >= 32
-    if head is not None and not (tied_q and big_batch):
-        out = mm(x, head)
-    elif isinstance(emb, QuantizedArray):
-        # tied head: per-row embed scales become per-column here
-        out = (x @ emb.q.T.astype(x.dtype)) * emb.scale.astype(
-            x.dtype).reshape(-1)
+    # Fused Pallas dequant-matmul (engine/lm_head.py): pins the int8 head
+    # at its weights-read floor regardless of batch — XLA's int8 matmul
+    # heuristics are batch-dependent (the pre-transposed head collapses
+    # 4.5ms → 82ms between B=16 and B=64 on v5e). DYN_LMHEAD_KERNEL=0
+    # falls back to the XLA paths below.
+    if (isinstance(head, QuantizedArray) and head.q.ndim == 2
+            and _lm_head_kernel_ok(head, cfg)):
+        from ..lm_head import lm_head_int8
+        out = lm_head_int8(x, head.q, head.scale)
     else:
-        out = x @ emb.T.astype(x.dtype)
+        # XLA's int8 matmul heuristics flip with batch size (measured on
+        # v5e, llama-1B head [2048, 128256]): the pre-transposed int8 head
+        # wins below ~32 rows (4.5ms vs 12.3ms step at B=16) but collapses
+        # at B=64 (82ms), where computing against the transposed int8
+        # embedding is fine (9.7ms) — pick per traced batch size, it's
+        # static under jit
+        big_batch = x.ndim > 1 and x.shape[0] >= 32
+        if head is not None and not (tied_q and big_batch):
+            out = mm(x, head)
+        elif isinstance(emb, QuantizedArray):
+            # tied head: per-row embed scales become per-column here
+            out = (x @ emb.q.T.astype(x.dtype)) * emb.scale.astype(
+                x.dtype).reshape(-1)
+        else:
+            out = x @ emb.T.astype(x.dtype)
     out = out.astype(jnp.float32)
     if cfg is not None and cfg.final_logit_softcap:
         out = _softcap(out, cfg.final_logit_softcap)
