@@ -33,11 +33,20 @@ logger = logging.getLogger("dynamo_tpu.deploy.api")
 
 
 class DeploymentApi:
-    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0,
+                 auth_token: Optional[str] = None):
+        """``auth_token`` enables bearer-token auth on every /v1 route
+        (the reference api-server sits behind authenticated ingress; ours
+        must not expose unauthenticated mutation when bound beyond
+        localhost). /health stays open for probes. Also settable via
+        DYN_DEPLOY_TOKEN."""
+        import os
         self.runtime = runtime
         self.host = host
         self.port = port
-        self.app = web.Application()
+        self.auth_token = (auth_token
+                           or os.environ.get("DYN_DEPLOY_TOKEN") or None)
+        self.app = web.Application(middlewares=[self._auth_middleware])
         self.app.router.add_post("/v1/deployments", self._create)
         self.app.router.add_get("/v1/deployments", self._list)
         self.app.router.add_get("/v1/deployments/{name}", self._get)
@@ -47,6 +56,15 @@ class DeploymentApi:
         self.app.router.add_delete("/v1/deployments/{name}", self._delete)
         self.app.router.add_get("/health", self._health)
         self._runner: Optional[web.AppRunner] = None
+
+    @web.middleware
+    async def _auth_middleware(self, request: web.Request, handler):
+        if self.auth_token and request.path != "/health":
+            got = request.headers.get("Authorization", "")
+            if got != f"Bearer {self.auth_token}":
+                return web.json_response({"error": "unauthorized"},
+                                         status=401)
+        return await handler(request)
 
     async def start(self) -> "DeploymentApi":
         self._runner = web.AppRunner(self.app)
@@ -172,11 +190,13 @@ class DeploymentApi:
 
 
 async def _amain(runtime_server: str, host: str, port: int,
-                 with_controller: bool) -> None:
+                 with_controller: bool,
+                 auth_token: str = None) -> None:
     from ..runtime.distributed import DistributedRuntime
     runtime = await DistributedRuntime.connect(runtime_server)
     runtime.server_address = runtime_server
-    api = await DeploymentApi(runtime, host, port).start()
+    api = await DeploymentApi(runtime, host, port,
+                              auth_token=auth_token).start()
     controller = None
     if with_controller:
         from .controller import DeploymentController
@@ -200,12 +220,16 @@ def main() -> None:
     ap.add_argument("--port", type=int, default=8280)
     ap.add_argument("--no-controller", action="store_true",
                     help="REST only; reconcile elsewhere")
+    ap.add_argument("--auth-token",
+                    help="bearer token required on /v1 routes "
+                         "(or env DYN_DEPLOY_TOKEN)")
     args = ap.parse_args()
     from ..runtime.log import setup_logging
     setup_logging()
     try:
         asyncio.run(_amain(args.runtime_server, args.host, args.port,
-                           not args.no_controller))
+                           not args.no_controller,
+                           auth_token=args.auth_token))
     except KeyboardInterrupt:
         pass
 

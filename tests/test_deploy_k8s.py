@@ -233,3 +233,31 @@ async def test_max_restarts_through_api_and_cli(rig):
     finally:
         await api.stop()
     assert validate_spec("x", 1, max_restarts=-2) is not None
+
+
+async def test_api_bearer_auth(rig):
+    """VERDICT r2 weak-6: the api-server had no authn story. With a token
+    configured, /v1 routes require the bearer; /health stays open."""
+    import aiohttp
+
+    from dynamo_tpu.deploy.api_server import DeploymentApi
+
+    rt, ctrl, state = rig
+    api = await DeploymentApi(rt, host="127.0.0.1", port=0,
+                              auth_token="s3cret").start()
+    try:
+        base = f"http://127.0.0.1:{api.port}"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/v1/deployments") as r:
+                assert r.status == 401
+            async with s.post(f"{base}/v1/deployments", json={
+                    "name": "x", "graph": "g:S"},
+                    headers={"Authorization": "Bearer wrong"}) as r:
+                assert r.status == 401
+            async with s.get(f"{base}/health") as r:
+                assert r.status == 200        # probes stay open
+            async with s.get(f"{base}/v1/deployments", headers={
+                    "Authorization": "Bearer s3cret"}) as r:
+                assert r.status == 200
+    finally:
+        await api.stop()
