@@ -87,7 +87,9 @@ def set_phase(state, name, phase):
     json.dump(d, open(p, "w"))
 
 
-async def wait_for(pred, timeout=10.0, what=""):
+async def wait_for(pred, timeout=45.0, what=""):
+    # generous: each fake-kubectl alive() probe is a python subprocess
+    # start (~100ms, much worse when the full suite saturates the host)
     deadline = asyncio.get_running_loop().time() + timeout
     while asyncio.get_running_loop().time() < deadline:
         if pred():
@@ -138,10 +140,10 @@ async def test_converge_scale_and_delete(rig):
     async def running():
         s = await status_of(rt, "graphA")
         return s and s["state"] == "running" and s["ready_replicas"] == 2
-    for _ in range(100):
+    for _ in range(400):
         if await running():
             break
-        await asyncio.sleep(0.05)
+        await asyncio.sleep(0.1)
     assert await running()
 
     # scale down to 1
@@ -176,10 +178,10 @@ async def test_crash_restart_cap_marks_failed(rig):
     async def failed():
         s = await status_of(rt, "crashy")
         return s and s["state"] == "failed" and "1 restarts" in s["message"]
-    for _ in range(100):
+    for _ in range(400):
         if await failed():
             break
-        await asyncio.sleep(0.05)
+        await asyncio.sleep(0.1)
     assert await failed()
 
 
