@@ -565,11 +565,19 @@ class EngineCore:
                       and self.model_cfg.attn_logit_softcap is None
                       and self.model_cfg.sliding_window is None)
             if use_sp:
-                if self.recorder is not None:
-                    self.recorder.rec("prefill_unsupported", rid=req.rid,
-                                      path="sp")
                 padded = np.zeros((bucket,), np.int32)
                 padded[:len(chunk)] = chunk
+                if self.recorder is not None:
+                    # streamable like plain prefill (start_pos is always 0
+                    # on the sp path) — multihost followers replay it
+                    req._pf_seq = self.recorder.next_dispatch_id()
+                    self.recorder.rec(
+                        "prefill_sp", pf_seq=req._pf_seq, rid=req.rid,
+                        slot=slot, padded=padded.copy(), table=table.copy(),
+                        true_len=len(chunk), samp_seed=req.sampling.seed,
+                        key_step=req.key_step,
+                        temp=req.sampling.temperature,
+                        top_k=req.sampling.top_k, top_p=req.sampling.top_p)
                 tok, logprob, self.kv = self._prefill_sp_jit(
                     self.params, self.kv, jnp.asarray(padded),
                     jnp.asarray(table), jnp.asarray(len(chunk), jnp.int32),

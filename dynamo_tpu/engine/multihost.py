@@ -31,8 +31,10 @@ event dicts. The stream shares the deployment's trust domain with
 intra-engine control channel, not a public endpoint.
 
 Unsupported on the multihost engine (the recorder marks these paths and
-the follower refuses rather than silently diverge): sp/chunked prefill
-admissions, host-KV-tier restores, and disagg KV onboarding.
+the follower refuses rather than silently diverge): chunked-prefill
+admissions, host-KV-tier restores, and disagg KV onboarding. sp ring
+prefill IS streamed (the "prefill_sp" event) — its cross-host ppermute
+rides ICI on real hardware.
 """
 
 from __future__ import annotations
@@ -57,7 +59,8 @@ __all__ = ["DispatchStreamLeader", "connect_follower", "run_follower"]
 # recorder sees (admit/harvest/first_token/preempt/release) is leader-side
 # host bookkeeping
 WIRE_EVENTS = frozenset(
-    {"prefill", "dispatch", "hit_transfer", "prefill_unsupported"})
+    {"prefill", "prefill_sp", "dispatch", "hit_transfer",
+     "prefill_unsupported"})
 _SHUTDOWN = {"ev": "__shutdown__"}
 
 _LEN = struct.Struct(">I")
@@ -123,10 +126,6 @@ class DispatchStreamLeader(Recorder):
             raise ValueError(
                 "multihost serving requires prefill_chunk=0 (chunked "
                 "prefill admissions are not in the dispatch stream)")
-        if core.mesh is not None and core.mesh.shape.get("sp", 1) > 1:
-            raise ValueError(
-                "multihost serving does not support sp>1 yet (ring-prefill "
-                "admissions are not in the dispatch stream)")
         core.recorder = self
 
     def wait_for_followers(self) -> None:
@@ -191,7 +190,8 @@ def run_follower(core, sock: socket.socket,
     signatures live in exactly one place; this loop only adds the live
     carry (``core.kv``) and a bounded chain window.
     """
-    from .replay import exec_dispatch_event, exec_prefill_event
+    from .replay import (exec_dispatch_event, exec_prefill_event,
+                         exec_sp_prefill_event)
 
     disp_toks: "OrderedDict[int, object]" = OrderedDict()
     stats = {"prefills": 0, "dispatches": 0}
@@ -206,7 +206,8 @@ def run_follower(core, sock: socket.socket,
             raise NotImplementedError(
                 f"leader used an admission path the multihost follower "
                 f"cannot replay ({ev.get('path')}, rid={ev.get('rid')}); "
-                f"disable sp/chunked prefill on a multihost engine")
+                f"disable chunked prefill / disagg onboarding on a "
+                f"multihost engine")
         if kind == "hit_transfer":
             if int(ev.get("host_hit", 0)) > 0:
                 raise NotImplementedError(
@@ -215,6 +216,9 @@ def run_follower(core, sock: socket.socket,
             continue   # device-state no-op: prefix hits reuse resident KV
         if kind == "prefill":
             _tok, core.kv = exec_prefill_event(core, core.kv, ev)
+            stats["prefills"] += 1
+        elif kind == "prefill_sp":
+            _tok, core.kv = exec_sp_prefill_event(core, core.kv, ev)
             stats["prefills"] += 1
         elif kind == "dispatch":
             chain = (disp_toks[ev["chained_from"]]

@@ -55,26 +55,36 @@ class Recorder:
 # --------------------------------------------------------------------------
 
 
-def exec_prefill_event(core, kv, ev: dict):
-    """Issue the recorded prefill program against `kv`. The ONE place the
-    recorded-event → _prefill_jit argument marshalling lives (used by both
-    the offline replayer below and the live multihost follower,
-    engine/multihost.py). Returns (tok_device, kv)."""
+def _exec_prefill(core, kv, ev: dict, sp: bool):
+    """The ONE home of recorded-event → prefill-jit marshalling (used by
+    both the offline replayer and the live multihost follower). The sp
+    variant issues _prefill_sp_jit and has no start_pos (the sp path
+    never has a prefix hit); everything else is identical by
+    construction. Returns (tok_device, kv)."""
     import jax.numpy as jnp
 
     from .sampling import make_slot_keys
 
     key = make_slot_keys(core.cfg.seed, jnp.asarray([ev["samp_seed"]]),
                          jnp.asarray(ev["key_step"]))[0]
-    tok, _lp, kv = core._prefill_jit(
-        core.params, kv,
-        jnp.asarray(ev["padded"]), jnp.asarray(ev["table"]),
-        jnp.asarray(ev["start_pos"], jnp.int32),
-        jnp.asarray(ev["true_len"], jnp.int32), key,
-        jnp.asarray(ev["temp"], jnp.float32),
-        jnp.asarray(ev["top_k"], jnp.int32),
-        jnp.asarray(ev["top_p"], jnp.float32))
+    head = (jnp.asarray(ev["padded"]), jnp.asarray(ev["table"]))
+    pos = (() if sp
+           else (jnp.asarray(ev["start_pos"], jnp.int32),))
+    tail = (jnp.asarray(ev["true_len"], jnp.int32), key,
+            jnp.asarray(ev["temp"], jnp.float32),
+            jnp.asarray(ev["top_k"], jnp.int32),
+            jnp.asarray(ev["top_p"], jnp.float32))
+    fn = core._prefill_sp_jit if sp else core._prefill_jit
+    tok, _lp, kv = fn(core.params, kv, *head, *pos, *tail)
     return tok, kv
+
+
+def exec_prefill_event(core, kv, ev: dict):
+    return _exec_prefill(core, kv, ev, sp=False)
+
+
+def exec_sp_prefill_event(core, kv, ev: dict):
+    return _exec_prefill(core, kv, ev, sp=True)
 
 
 def exec_dispatch_event(core, kv, ev: dict, chain):
@@ -141,7 +151,8 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
             raise NotImplementedError(
                 f"run used an unrecorded admission path "
                 f"({ev.get('path')}, rid={ev.get('rid')}); replay would "
-                f"silently diverge — record only plain-prefill runs")
+                f"silently diverge — record only runs without chunked "
+                f"prefill or disagg onboarding")
         if kind == "hit_transfer" and int(ev.get("hit", 0)) > 0:
             if int(ev.get("host_hit", 0)) > 0:
                 # host-tier hits scatter offloaded content back to device
@@ -166,12 +177,15 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                         f"there and compare_replay would report phantom "
                         f"mismatches; start recording before any prefix "
                         f"blocks are stored")
-        if kind == "prefill":
-            tok, kv = exec_prefill_event(core, kv, ev)
+        if kind in ("prefill", "prefill_sp"):
+            tok, kv = (exec_prefill_event(core, kv, ev)
+                       if kind == "prefill"
+                       else exec_sp_prefill_event(core, kv, ev))
             tok = jax.block_until_ready(tok)
             out["prefill"][ev["pf_seq"]] = int(tok)
             table = np.asarray(ev["table"])
-            start, n = int(ev["start_pos"]), int(ev["true_len"])
+            start = int(ev.get("start_pos", 0))   # sp path: always 0
+            n = int(ev["true_len"])
             written.update(
                 int(table[p // bs]) * bs + p % bs
                 for p in range(start, start + n))
@@ -270,10 +284,11 @@ def check_log(events: List[dict], block_size: int) -> List[StaleRead]:
             for p in range(int(ev["hit"])):
                 ps = table[p // block_size] * block_size + p % block_size
                 write(ps, ev["rid"])
-        if ev["ev"] == "prefill":
+        if ev["ev"] in ("prefill", "prefill_sp"):
             table = np.asarray(ev["table"])
             rid = ev["rid"]
-            start, n = int(ev["start_pos"]), int(ev["true_len"])
+            start = int(ev.get("start_pos", 0))   # sp path: always 0
+            n = int(ev["true_len"])
             # reads: the chunk attends to everything < start+n through the
             # same table (prefix continuation) — check those too
             for p in range(0, start + n):

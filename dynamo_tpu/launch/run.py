@@ -475,9 +475,6 @@ async def amain(argv=None) -> None:
         if args.prefill_chunk > 0:
             raise SystemExit("multi-host serving requires "
                              "--prefill-chunk 0")
-        if args.sp > 1:
-            raise SystemExit("multi-host serving does not support --sp > 1 "
-                             "yet")
     initialize_multihost(MultiNodeConfig(
         num_nodes=args.num_nodes, node_rank=args.node_rank,
         leader_addr=args.leader_addr))

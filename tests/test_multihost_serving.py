@@ -266,6 +266,84 @@ def test_two_host_tp2_engine_serves_http(tiny_model_dir):
         f"{got_texts} != {ref_texts}")
 
 
+@pytest.mark.asyncio
+async def test_sp_ring_prefill_streams_to_follower(tiny_model_dir):
+    """sp ring-prefill admissions ride the dispatch stream (round-3: the
+    'prefill_sp' event) — a follower core replays them and its device
+    state stays BIT-IDENTICAL to the leader's. In-process variant: both
+    cores on one sp=2 local mesh, wired through a real TCP socket; on a
+    pod the same ppermutes ride ICI."""
+    import asyncio
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.engine.multihost import (DispatchStreamLeader,
+                                             connect_follower, run_follower)
+    from dynamo_tpu.llm.engines.jax_engine import JaxEngine
+    from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+    from dynamo_tpu.parallel.sharding import make_mesh
+    from dynamo_tpu.runtime import Context
+    from dynamo_tpu.runtime.engine import EngineContext
+
+    mcfg = ModelConfig.from_model_dir(str(tiny_model_dir))
+    ecfg = EngineConfig(max_model_len=128, kv_block_size=8,
+                        num_kv_blocks=48, max_num_seqs=2,
+                        prefill_buckets=[32, 64, 128],
+                        sp_min_prefill_tokens=16,
+                        decode_steps_per_dispatch=4)
+
+    def core():
+        return EngineCore(mcfg, ecfg, attn_impl="xla",
+                          param_dtype=jnp.float32,
+                          mesh=make_mesh(dp=1, tp=1, sp=2))
+
+    leader_core, follower_core = core(), core()
+
+    kinds = []
+    stream = DispatchStreamLeader(port=0, num_followers=1, host="127.0.0.1")
+    orig_rec = stream.rec
+    stream.rec = lambda ev, **kw: (kinds.append(ev), orig_rec(ev, **kw))
+    stream.attach(leader_core)
+    conn_fut = asyncio.get_running_loop().run_in_executor(
+        None, connect_follower, f"127.0.0.1:{stream.port}")
+    await asyncio.to_thread(stream.wait_for_followers)
+    sock = await conn_fut
+    follower_task = asyncio.create_task(
+        asyncio.to_thread(run_follower, follower_core, sock))
+
+    rng = np.random.default_rng(5)
+    prompt = [int(t) for t in rng.integers(2, 120, size=40)]  # ≥ sp_min 16
+    engine = JaxEngine(leader_core)
+    pre = PreprocessedRequest(
+        token_ids=prompt,
+        stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+        sampling_options=SamplingOptions(greedy=True))
+    out_stream = await engine.generate(Context(pre, ctx=EngineContext("r1")))
+    toks = []
+    async for a in out_stream:
+        if a.data is not None and a.data.token_ids:
+            toks.extend(a.data.token_ids)
+    assert len(toks) >= 6
+    await leader_core.stop()
+    stream.close()
+    stats = await follower_task
+
+    assert "prefill_sp" in kinds, f"sp path not taken: {kinds}"
+    assert stats["prefills"] >= 1 and stats["dispatches"] >= 1
+    # the invariant the whole design rests on: replaying the stream keeps
+    # the follower's device state bit-identical
+    np.testing.assert_array_equal(np.asarray(leader_core.kv["k"]),
+                                  np.asarray(follower_core.kv["k"]))
+    np.testing.assert_array_equal(np.asarray(leader_core.kv["v"]),
+                                  np.asarray(follower_core.kv["v"]))
+
+
 def test_cli_two_rank_serving(tiny_model_dir):
     """The PRODUCTION entrypoint: `dynamo-run in=http out=jax --num-nodes 2`
     on both ranks — rank 0 leads (HTTP + dispatch stream), rank 1 follows
