@@ -587,22 +587,16 @@ class EngineCore:
                     jnp.asarray(req.sampling.top_p, jnp.float32))
             elif (self.cfg.prefill_chunk > 0
                     and len(chunk) > self.cfg.prefill_chunk):
-                if self.recorder is not None:
-                    self.recorder.rec("prefill_unsupported", rid=req.rid,
-                                      path="chunked")
-                tok, logprob = self._chunked_prefill(req, chunk, table, key)
+                tok, logprob = self._chunked_prefill(req, chunk, table, key,
+                                                     slot=slot)
             else:
                 padded = np.zeros((bucket,), np.int32)
                 padded[:len(chunk)] = chunk
                 if self.recorder is not None:
-                    req._pf_seq = self.recorder.next_dispatch_id()
-                    self.recorder.rec(
-                        "prefill", pf_seq=req._pf_seq, rid=req.rid,
-                        slot=slot, padded=padded.copy(), table=table.copy(),
-                        start_pos=req.prefix_hit_tokens, true_len=len(chunk),
-                        samp_seed=req.sampling.seed, key_step=req.key_step,
-                        temp=req.sampling.temperature,
-                        top_k=req.sampling.top_k, top_p=req.sampling.top_p)
+                    req._pf_seq = self._rec_prefill(
+                        req, slot, padded, table,
+                        start_pos=req.prefix_hit_tokens,
+                        true_len=len(chunk))
                 tok, logprob, self.kv = self._prefill_jit(
                     self.params, self.kv, jnp.asarray(padded),
                     jnp.asarray(table),
@@ -713,15 +707,33 @@ class EngineCore:
         logger.debug("lane-admitted %s into slot %d (prompt=%d, hit=%d)",
                      req.rid, slot, n_prompt, hit)
 
+    def _rec_prefill(self, req: "EngineRequest", slot: int,
+                     padded: np.ndarray, table: np.ndarray, *,
+                     start_pos: int, true_len: int) -> int:
+        """Record one plain-prefill event (the ONE home of its field set —
+        whole-prompt admissions and each chunk of a chunked admission both
+        go through here). Returns the event's pf_seq."""
+        pf = self.recorder.next_dispatch_id()
+        self.recorder.rec(
+            "prefill", pf_seq=pf, rid=req.rid, slot=slot,
+            padded=padded.copy(), table=table.copy(),
+            start_pos=start_pos, true_len=true_len,
+            samp_seed=req.sampling.seed, key_step=req.key_step,
+            temp=req.sampling.temperature,
+            top_k=req.sampling.top_k, top_p=req.sampling.top_p)
+        return pf
+
     def _chunked_prefill(self, req: EngineRequest, chunk: list,
-                         table: np.ndarray, key) -> tuple:
+                         table: np.ndarray, key, *, slot: int) -> tuple:
         """Prompt prefill as a sequence of fixed-size chunk dispatches
         (EngineConfig.prefill_chunk): each chunk continues at
         ``start_pos`` against the KV already written — the same mechanism
         as prefix-reuse continuation — so one compiled chunk shape serves
         any prompt length, bounding both compile count and per-dispatch
         activation memory (SURVEY.md §7 "blockwise prefill chunks"). Only
-        the final chunk's sampled token matters."""
+        the final chunk's sampled token matters. Each chunk records as a
+        plain "prefill" event (it IS one), so chunked runs replay and
+        stream to multihost followers."""
         C = self.cfg.prefill_chunk
         off = req.prefix_hit_tokens
         tok = logprob = None
@@ -731,6 +743,11 @@ class EngineCore:
             # regardless of prompt length or bucket list
             padded = np.zeros((C,), np.int32)
             padded[:len(piece)] = piece
+            if self.recorder is not None:
+                pf = self._rec_prefill(req, slot, padded, table,
+                                       start_pos=off, true_len=len(piece))
+                if lo + C >= len(chunk):
+                    req._pf_seq = pf      # final chunk samples the token
             tok, logprob, self.kv = self._prefill_jit(
                 self.params, self.kv, jnp.asarray(padded),
                 jnp.asarray(table),

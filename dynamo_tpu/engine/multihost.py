@@ -31,10 +31,10 @@ event dicts. The stream shares the deployment's trust domain with
 intra-engine control channel, not a public endpoint.
 
 Unsupported on the multihost engine (the recorder marks these paths and
-the follower refuses rather than silently diverge): chunked-prefill
-admissions, host-KV-tier restores, and disagg KV onboarding. sp ring
-prefill IS streamed (the "prefill_sp" event) — its cross-host ppermute
-rides ICI on real hardware.
+the follower refuses rather than silently diverge): host-KV-tier
+restores and disagg KV onboarding. sp ring prefill and chunked prefill
+ARE streamed (the "prefill_sp" event; chunks record as plain "prefill"
+events) — sp's cross-host ppermute rides ICI on real hardware.
 """
 
 from __future__ import annotations
@@ -122,10 +122,6 @@ class DispatchStreamLeader(Recorder):
             raise ValueError(
                 "multihost serving requires host_kv_blocks=0 (host-tier "
                 "restores are not replayable on followers)")
-        if core.cfg.prefill_chunk > 0:
-            raise ValueError(
-                "multihost serving requires prefill_chunk=0 (chunked "
-                "prefill admissions are not in the dispatch stream)")
         core.recorder = self
 
     def wait_for_followers(self) -> None:
@@ -206,8 +202,7 @@ def run_follower(core, sock: socket.socket,
             raise NotImplementedError(
                 f"leader used an admission path the multihost follower "
                 f"cannot replay ({ev.get('path')}, rid={ev.get('rid')}); "
-                f"disable chunked prefill / disagg onboarding on a "
-                f"multihost engine")
+                f"disable disagg onboarding on a multihost engine")
         if kind == "hit_transfer":
             if int(ev.get("host_hit", 0)) > 0:
                 raise NotImplementedError(

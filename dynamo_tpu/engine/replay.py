@@ -151,8 +151,8 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
             raise NotImplementedError(
                 f"run used an unrecorded admission path "
                 f"({ev.get('path')}, rid={ev.get('rid')}); replay would "
-                f"silently diverge — record only runs without chunked "
-                f"prefill or disagg onboarding")
+                f"silently diverge — record only runs without disagg "
+                f"onboarding")
         if kind == "hit_transfer" and int(ev.get("hit", 0)) > 0:
             if int(ev.get("host_hit", 0)) > 0:
                 # host-tier hits scatter offloaded content back to device

@@ -472,9 +472,6 @@ async def amain(argv=None) -> None:
         if args.host_kv_blocks > 0:
             raise SystemExit("multi-host serving requires "
                              "--host-kv-blocks 0")
-        if args.prefill_chunk > 0:
-            raise SystemExit("multi-host serving requires "
-                             "--prefill-chunk 0")
     initialize_multihost(MultiNodeConfig(
         num_nodes=args.num_nodes, node_rank=args.node_rank,
         leader_addr=args.leader_addr))

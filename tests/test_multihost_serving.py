@@ -266,13 +266,12 @@ def test_two_host_tp2_engine_serves_http(tiny_model_dir):
         f"{got_texts} != {ref_texts}")
 
 
-@pytest.mark.asyncio
-async def test_sp_ring_prefill_streams_to_follower(tiny_model_dir):
-    """sp ring-prefill admissions ride the dispatch stream (round-3: the
-    'prefill_sp' event) — a follower core replays them and its device
-    state stays BIT-IDENTICAL to the leader's. In-process variant: both
-    cores on one sp=2 local mesh, wired through a real TCP socket; on a
-    pod the same ppermutes ride ICI."""
+async def _drive_leader_follower(tiny_model_dir, ecfg_over: dict,
+                                 mesh_axes: dict, prompt_len: int = 40):
+    """In-process leader+follower pair wired through a real TCP socket:
+    serve one request on the leader, live-replay on the follower, then
+    assert the follower's device KV is BIT-IDENTICAL — the invariant the
+    whole multihost design rests on. Returns (event kinds, stats)."""
     import asyncio
 
     import numpy as np
@@ -292,16 +291,15 @@ async def test_sp_ring_prefill_streams_to_follower(tiny_model_dir):
     from dynamo_tpu.runtime.engine import EngineContext
 
     mcfg = ModelConfig.from_model_dir(str(tiny_model_dir))
-    ecfg = EngineConfig(max_model_len=128, kv_block_size=8,
-                        num_kv_blocks=48, max_num_seqs=2,
-                        prefill_buckets=[32, 64, 128],
-                        sp_min_prefill_tokens=16,
-                        decode_steps_per_dispatch=4)
+    ecfg = EngineConfig(**{
+        "max_model_len": 128, "kv_block_size": 8, "num_kv_blocks": 48,
+        "max_num_seqs": 2, "prefill_buckets": [32, 64, 128],
+        "decode_steps_per_dispatch": 4, **ecfg_over})
 
     def core():
+        mesh = make_mesh(**mesh_axes) if mesh_axes else None
         return EngineCore(mcfg, ecfg, attn_impl="xla",
-                          param_dtype=jnp.float32,
-                          mesh=make_mesh(dp=1, tp=1, sp=2))
+                          param_dtype=jnp.float32, mesh=mesh)
 
     leader_core, follower_core = core(), core()
 
@@ -318,7 +316,7 @@ async def test_sp_ring_prefill_streams_to_follower(tiny_model_dir):
         asyncio.to_thread(run_follower, follower_core, sock))
 
     rng = np.random.default_rng(5)
-    prompt = [int(t) for t in rng.integers(2, 120, size=40)]  # ≥ sp_min 16
+    prompt = [int(t) for t in rng.integers(2, 120, size=prompt_len)]
     engine = JaxEngine(leader_core)
     pre = PreprocessedRequest(
         token_ids=prompt,
@@ -334,14 +332,33 @@ async def test_sp_ring_prefill_streams_to_follower(tiny_model_dir):
     stream.close()
     stats = await follower_task
 
-    assert "prefill_sp" in kinds, f"sp path not taken: {kinds}"
     assert stats["prefills"] >= 1 and stats["dispatches"] >= 1
-    # the invariant the whole design rests on: replaying the stream keeps
-    # the follower's device state bit-identical
     np.testing.assert_array_equal(np.asarray(leader_core.kv["k"]),
                                   np.asarray(follower_core.kv["k"]))
     np.testing.assert_array_equal(np.asarray(leader_core.kv["v"]),
                                   np.asarray(follower_core.kv["v"]))
+    return kinds, stats
+
+
+@pytest.mark.asyncio
+async def test_sp_ring_prefill_streams_to_follower(tiny_model_dir):
+    """sp ring-prefill admissions ride the dispatch stream (round 3: the
+    'prefill_sp' event); on a pod the same ppermutes ride ICI."""
+    kinds, _stats = await _drive_leader_follower(
+        tiny_model_dir, {"sp_min_prefill_tokens": 16},
+        {"dp": 1, "tp": 1, "sp": 2})
+    assert "prefill_sp" in kinds, f"sp path not taken: {kinds}"
+
+
+@pytest.mark.asyncio
+async def test_chunked_prefill_streams_to_follower(tiny_model_dir):
+    """Chunked-prefill admissions stream as plain per-chunk 'prefill'
+    events (round 3) — a 40-token prompt at chunk 16 is 3 chunk
+    dispatches, all replayed."""
+    kinds, stats = await _drive_leader_follower(
+        tiny_model_dir, {"prefill_chunk": 16}, {})
+    assert kinds.count("prefill") >= 3, f"chunks not streamed: {kinds}"
+    assert stats["prefills"] >= 3
 
 
 def test_cli_two_rank_serving(tiny_model_dir):
