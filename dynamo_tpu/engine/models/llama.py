@@ -31,7 +31,7 @@ from ..attention import (_on_tpu, flash_prefill, flash_prefill_supported,
                          flat_token_indices, paged_attention,
                          softcap_scores as _softcap)
 from ..config import ModelConfig
-from ..quant import QuantizedArray, mm
+from ..quant import QuantizedArray, mm, qeinsum
 
 Params = Dict[str, jax.Array]
 KVCache = Dict[str, jax.Array]  # {"k": [L, NTOK, KVH*Dh], "v": ...}
@@ -123,9 +123,9 @@ def moe_mlp(x: jax.Array, router_w: jax.Array, gate_w: jax.Array,
     combine = jnp.sum(
         jax.nn.one_hot(top_idx, E, dtype=jnp.float32)
         * top_w[..., None], axis=1)                              # [N, E]
-    g = jnp.einsum("nd,edf->enf", x, gate_w)
-    u = jnp.einsum("nd,edf->enf", x, up_w)
-    y = jnp.einsum("enf,efd->end", jax.nn.silu(g) * u, down_w)   # [E, N, D]
+    g = qeinsum("nd,edf->enf", x, gate_w)
+    u = qeinsum("nd,edf->enf", x, up_w)
+    y = qeinsum("enf,efd->end", jax.nn.silu(g) * u, down_w)      # [E, N, D]
     return jnp.einsum("ne,end->nd", combine.astype(y.dtype), y)
 
 

@@ -10,10 +10,10 @@ floor (the dominant cost at small batch).
 
 Scheme: symmetric absmax per output channel (the last axis of a stacked
 [L, D, F] weight; per row for the [V, D] embedding so the token gather
-dequantizes cheaply and a tied lm head reuses the same scales per column).
-Norms, biases, and MoE expert tensors stay in the load dtype (MoE expert
-matmuls are E-batched einsums with their own bandwidth profile — quantize
-later if profiling justifies it).
+dequantizes cheaply and a tied lm head reuses the same scales per column;
+per (layer, expert, out-channel) for the stacked MoE expert tensors —
+for mixtral-class models the experts are the bulk of the weights). Norms,
+biases, and the MoE router stay in the load dtype.
 """
 
 from __future__ import annotations
@@ -23,7 +23,8 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
-__all__ = ["QuantizedArray", "quantize_array", "quantize_params", "mm"]
+__all__ = ["QuantizedArray", "quantize_array", "quantize_params",
+           "mm", "qeinsum"]
 
 
 @jax.tree_util.register_pytree_node_class
@@ -81,8 +82,24 @@ def mm(x: jax.Array, w) -> jax.Array:
     return x @ w
 
 
+def qeinsum(spec: str, a: jax.Array, w) -> jax.Array:
+    """einsum with the same dequant-fuse rule as :func:`mm` for batched
+    weights (MoE experts): contract on int8 converted in-register, apply
+    the broadcast-shaped scale after the contraction. One owner for the
+    dequant semantics — keep in sync with mm by calling, not copying."""
+    if isinstance(w, QuantizedArray):
+        return jnp.einsum(spec, a, w.q.astype(a.dtype)) \
+            * w.scale.astype(a.dtype)
+    return jnp.einsum(spec, a, w)
+
+
 # Weight names quantized (stacked per-layer [L, D, F] → per (L, F) scales).
 _LAYER_MATMULS = ("wq", "wk", "wv", "wo", "gate", "up", "down")
+# MoE expert tensors [L, E, D, F] → per (L, E, out-channel) scales. For
+# mixtral-class models the experts ARE the weights, so leaving them bf16
+# would forfeit the whole int8 HBM-read win; the router stays full
+# precision (tiny, and routing is precision-sensitive).
+_MOE_MATMULS = ("moe_gate", "moe_up", "moe_down")
 
 
 def quantize_params(params: Dict[str, jax.Array],
@@ -94,7 +111,10 @@ def quantize_params(params: Dict[str, jax.Array],
     - ``embed`` ([V, D], optional): per ROW (= per token vector), so the
       embedding gather dequantizes with one scale per token and a TIED lm
       head (x @ embed.T) gets per-column scales from the same tensor.
-    - norms / biases / MoE tensors untouched.
+    - ``layers.{moe_gate,moe_up,moe_down}`` ([L, E, D, F]): per
+      (layer, expert, out-channel) — for MoE models the experts are the
+      bulk of the weights (models/llama.py moe_mlp dequant-fuses them).
+    - norms / biases / MoE router untouched.
     """
     out: Dict[str, object] = {}
     for name, w in params.items():
@@ -102,6 +122,11 @@ def quantize_params(params: Dict[str, jax.Array],
         if name.startswith("layers.") and suffix in _LAYER_MATMULS:
             # stacked [L, D, F]: per (layer, out-channel) → scale [L, 1, F]
             out[name] = quantize_array(w, keep_axes=(0, -1))
+        elif name.startswith("layers.") and suffix in _MOE_MATMULS:
+            # stacked [L, E, D, F]: per (layer, expert, out-channel)
+            # → scale [L, E, 1, F], which broadcasts over the expert
+            # einsums' batched-N axis after the per-layer slice
+            out[name] = quantize_array(w, keep_axes=(0, 1, -1))
         elif name == "lm_head":
             out[name] = quantize_array(w, keep_axes=(-1,))
         elif name == "embed" and include_embed:

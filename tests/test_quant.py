@@ -219,3 +219,84 @@ def test_unknown_quantization_rejected():
                         prefill_buckets=[32], quantization="int4")
     with pytest.raises(ValueError, match="int4"):
         EngineCore(TINY, ecfg, attn_impl="xla", param_dtype=jnp.float32)
+
+
+def test_moe_expert_quantization_logits_close():
+    """MoE expert tensors quantize per (layer, expert, out-channel) and
+    moe_mlp dequant-fuses the expert einsums — for mixtral-class models
+    the experts ARE the weights, so this is where the int8 win lives.
+    Router stays full precision."""
+    cfg = ModelConfig(model_type="mixtral", vocab_size=128, hidden_size=64,
+                      intermediate_size=96, num_layers=2, num_heads=4,
+                      num_kv_heads=2, head_dim=16,
+                      max_position_embeddings=128,
+                      tie_word_embeddings=False,
+                      num_experts=4, num_experts_per_tok=2)
+    params = llama.init_params(cfg, jax.random.PRNGKey(11),
+                               dtype=jnp.float32)
+    qparams = quantize_params(params)
+    for name in ("layers.moe_gate", "layers.moe_up", "layers.moe_down"):
+        qa = qparams[name]
+        assert isinstance(qa, QuantizedArray), name
+        L, E = params[name].shape[:2]
+        assert qa.scale.shape[:2] == (L, E)       # per (layer, expert)
+    assert not isinstance(qparams["layers.router"], QuantizedArray)
+
+    statics = llama.ModelStatics(cfg=cfg, block_size=8, attn_impl="xla")
+    nb, B, M = 16, 4, 4
+    rng = np.random.default_rng(9)
+    tokens = jnp.asarray(rng.integers(1, 100, B), jnp.int32)
+    positions = jnp.asarray([3, 5, 2, 7], jnp.int32)
+    tables = jnp.asarray(rng.integers(1, nb, (B, M)), jnp.int32)
+    kv = llama.init_kv_cache(cfg, nb, 8, dtype=jnp.float32)
+    full_logits, _ = llama.decode_forward(
+        params, kv, tokens, positions, tables, statics)
+    kv = llama.init_kv_cache(cfg, nb, 8, dtype=jnp.float32)
+    q_logits, _ = llama.decode_forward(
+        qparams, kv, tokens, positions, tables, statics)
+    # int8 tolerance: same order as the dense-model quantization test
+    err = np.max(np.abs(np.asarray(q_logits) - np.asarray(full_logits)))
+    scale = np.max(np.abs(np.asarray(full_logits)))
+    assert err / scale < 0.05, (err, scale)
+
+
+def test_moe_int8_ep_sharded_matches_unsharded():
+    """int8 expert tensors shard over the ep×tp mesh (q with the expert
+    spec, scales following) and the sharded step matches unsharded."""
+    from dynamo_tpu.parallel.sharding import (make_mesh, shard_kv,
+                                              shard_params)
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >=4 virtual devices")
+    cfg = ModelConfig(model_type="mixtral", vocab_size=128, hidden_size=64,
+                      intermediate_size=96, num_layers=2, num_heads=4,
+                      num_kv_heads=2, head_dim=16,
+                      max_position_embeddings=128,
+                      tie_word_embeddings=False,
+                      num_experts=4, num_experts_per_tok=2)
+    params = llama.init_params(cfg, jax.random.PRNGKey(12),
+                               dtype=jnp.float32)
+    qparams = quantize_params(params)
+    statics = llama.ModelStatics(cfg=cfg, block_size=8, attn_impl="xla")
+    nb, B, M = 16, 4, 4
+    rng = np.random.default_rng(10)
+    tokens = jnp.asarray(rng.integers(1, 100, B), jnp.int32)
+    positions = jnp.asarray([3, 5, 2, 7], jnp.int32)
+    tables = jnp.asarray(rng.integers(1, nb, (B, M)), jnp.int32)
+    kv0 = llama.init_kv_cache(cfg, nb, 8, dtype=jnp.float32)
+    ref_logits, _ = llama.decode_forward(
+        qparams, kv0, tokens, positions, tables, statics)
+
+    mesh = make_mesh(dp=1, tp=2, ep=2)
+    sp = shard_params(qparams, mesh, cfg)
+    gate = sp["layers.moe_gate"]
+    assert isinstance(gate, QuantizedArray)
+    # experts really sharded over ep (not replicated)
+    assert len(gate.q.sharding.device_set) == 4
+    kv = shard_kv(llama.init_kv_cache(cfg, nb, 8, dtype=jnp.float32), mesh)
+    with mesh:
+        step = jax.jit(
+            lambda p, kv, t, pos, bt: llama.decode_forward(
+                p, kv, t, pos, bt, statics))
+        logits, _ = step(sp, kv, tokens, positions, tables)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
+                               rtol=2e-4, atol=2e-4)
