@@ -169,8 +169,13 @@ def _probe_backend_with_retry(attempts: int | None = None) -> None:
     ("UNAVAILABLE: TPU backend setup/compile error") zeroed the round's
     official numbers twice."""
     if attempts is None:
-        attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "5"))
-    delays = (15, 30, 45, 60)
+        attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "4"))
+    # a LIVE tunnel initializes in ~20-40s; a dead one hangs until the
+    # timeout, so the probe budget bounds the whole fallback path:
+    # 4 × 120s + delays ≈ 9 min worst case (measured: a hard-down tunnel
+    # burns every probe's full timeout)
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+    delays = (10, 20, 30)
     if os.environ.get("BENCH_PROBE_FAST", "0") != "0":   # tests only
         delays = (0.01,)
     last = ""
@@ -181,9 +186,9 @@ def _probe_backend_with_retry(attempts: int | None = None) -> None:
                 [sys.executable, "-c",
                  "import jax; d = jax.devices();"
                  "print(d[0].platform, d[0].device_kind)"],
-                capture_output=True, text=True, timeout=180)
+                capture_output=True, text=True, timeout=probe_timeout)
         except subprocess.TimeoutExpired:
-            last = "probe timed out after 180s"
+            last = f"probe timed out after {probe_timeout:.0f}s"
         if p is not None:
             if p.returncode == 0:
                 plat = (p.stdout or "").strip().split(" ")[0]
