@@ -158,6 +158,18 @@ class EngineCore:
                 self.model_cfg = model_cfg
                 self.statics = dataclasses.replace(self.statics,
                                                    cfg=model_cfg)
+        if model_cfg.lm_head_pallas and engine_cfg.quantization != "none":
+            # eager one-time kernel selftest (must run OUTSIDE jit traces):
+            # a lowering failure on this backend degrades to the XLA head
+            # paths instead of breaking every decode program
+            from .attention import _on_tpu
+            from .lm_head import kernel_selftest
+            if _on_tpu() and not kernel_selftest():
+                model_cfg = dataclasses.replace(model_cfg,
+                                                lm_head_pallas=False)
+                self.model_cfg = model_cfg
+                self.statics = dataclasses.replace(self.statics,
+                                                   cfg=model_cfg)
         self.kv_event_publisher = kv_event_publisher
         on_stored = (kv_event_publisher.publish_stored
                      if kv_event_publisher is not None else None)

@@ -19,14 +19,48 @@ HBM traffic = the int8 weights once + the f32 logits once — the floor.
 from __future__ import annotations
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["lm_head_int8", "TILE_V"]
+logger = logging.getLogger("dynamo_tpu.engine.lm_head")
+
+__all__ = ["lm_head_int8", "kernel_selftest", "TILE_V"]
 
 TILE_V = 256    # vocab tile; the gate in models/llama.py checks V % TILE_V
+
+_SELFTEST_OK = None
+
+
+def kernel_selftest() -> bool:
+    """Compile + run the kernel once on tiny shapes, EAGERLY (must be
+    called outside any jit trace). The engine gates the fused head on
+    this at construction so a lowering regression on some backend
+    degrades to the XLA paths instead of breaking serving — the kernel
+    was developed in interpret mode against a tunnel that was down for
+    a whole round, so the first real-TPU lowering happens in the field.
+    Result is cached per process."""
+    global _SELFTEST_OK
+    if _SELFTEST_OK is None:
+        try:
+            x = jnp.ones((16, 256), jnp.bfloat16)
+            q = jnp.ones((256, TILE_V), jnp.int8)
+            s = jnp.full((1, TILE_V), 0.5, jnp.float32)
+            out = jax.block_until_ready(lm_head_int8(x, q, s))
+            # 256 ones × 1 × 0.5 = 128.0 per element
+            ok = abs(float(out[0, 0]) - 128.0) < 1.0
+            if not ok:
+                logger.error("lm-head kernel selftest produced %r, "
+                             "expected 128.0 — disabling the fused head",
+                             float(out[0, 0]))
+            _SELFTEST_OK = ok
+        except Exception:  # noqa: BLE001 — any failure means fall back
+            logger.exception("fused lm-head kernel failed its selftest; "
+                             "serving falls back to the XLA head paths")
+            _SELFTEST_OK = False
+    return _SELFTEST_OK
 
 
 def _kernel(x_ref, wq_ref, scale_ref, out_ref):

@@ -91,3 +91,19 @@ def test_tp_mesh_disables_pallas_head():
     dp = EngineCore(mcfg, ecfg, attn_impl="xla", param_dtype=jnp.float32,
                     mesh=make_mesh(dp=2, tp=1))
     assert dp.model_cfg.lm_head_pallas is True
+
+
+def test_selftest_fails_gracefully_off_tpu():
+    """kernel_selftest must never raise — on a backend where the TPU
+    kernel cannot lower (this CPU), it returns False and the engine
+    falls back to the XLA head paths. (The engine only consults it on
+    TPU; this asserts the degrade-not-crash contract.)"""
+    import dynamo_tpu.engine.lm_head as lh
+
+    prev = lh._SELFTEST_OK
+    lh._SELFTEST_OK = None
+    try:
+        assert lh.kernel_selftest() is False
+        assert lh.kernel_selftest() is False     # cached, still no raise
+    finally:
+        lh._SELFTEST_OK = prev
