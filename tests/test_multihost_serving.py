@@ -267,11 +267,12 @@ def test_two_host_tp2_engine_serves_http(tiny_model_dir):
 
 
 async def _drive_leader_follower(tiny_model_dir, ecfg_over: dict,
-                                 mesh_axes: dict, prompt_len: int = 40):
-    """In-process leader+follower pair wired through a real TCP socket:
-    serve one request on the leader, live-replay on the follower, then
-    assert the follower's device KV is BIT-IDENTICAL — the invariant the
-    whole multihost design rests on. Returns (event kinds, stats)."""
+                                 mesh_axes: dict, prompt_len: int = 40,
+                                 num_followers: int = 1):
+    """In-process leader + N followers wired through real TCP sockets:
+    serve one request on the leader, live-replay on every follower, then
+    assert each follower's device KV is BIT-IDENTICAL — the invariant the
+    whole multihost design rests on. Returns (event kinds, stats list)."""
     import asyncio
 
     import numpy as np
@@ -301,19 +302,24 @@ async def _drive_leader_follower(tiny_model_dir, ecfg_over: dict,
         return EngineCore(mcfg, ecfg, attn_impl="xla",
                           param_dtype=jnp.float32, mesh=mesh)
 
-    leader_core, follower_core = core(), core()
+    leader_core = core()
+    followers = [core() for _ in range(num_followers)]
 
     kinds = []
-    stream = DispatchStreamLeader(port=0, num_followers=1, host="127.0.0.1")
+    stream = DispatchStreamLeader(port=0, num_followers=num_followers,
+                                  host="127.0.0.1")
     orig_rec = stream.rec
     stream.rec = lambda ev, **kw: (kinds.append(ev), orig_rec(ev, **kw))
     stream.attach(leader_core)
-    conn_fut = asyncio.get_running_loop().run_in_executor(
-        None, connect_follower, f"127.0.0.1:{stream.port}")
+    loop = asyncio.get_running_loop()
+    conn_futs = [loop.run_in_executor(None, connect_follower,
+                                      f"127.0.0.1:{stream.port}")
+                 for _ in followers]
     await asyncio.to_thread(stream.wait_for_followers)
-    sock = await conn_fut
-    follower_task = asyncio.create_task(
-        asyncio.to_thread(run_follower, follower_core, sock))
+    socks = [await c for c in conn_futs]
+    follower_tasks = [
+        asyncio.create_task(asyncio.to_thread(run_follower, fc, s))
+        for fc, s in zip(followers, socks)]
 
     rng = np.random.default_rng(5)
     prompt = [int(t) for t in rng.integers(2, 120, size=prompt_len)]
@@ -330,14 +336,15 @@ async def _drive_leader_follower(tiny_model_dir, ecfg_over: dict,
     assert len(toks) >= 6
     await leader_core.stop()
     stream.close()
-    stats = await follower_task
+    all_stats = [await t for t in follower_tasks]
 
-    assert stats["prefills"] >= 1 and stats["dispatches"] >= 1
-    np.testing.assert_array_equal(np.asarray(leader_core.kv["k"]),
-                                  np.asarray(follower_core.kv["k"]))
-    np.testing.assert_array_equal(np.asarray(leader_core.kv["v"]),
-                                  np.asarray(follower_core.kv["v"]))
-    return kinds, stats
+    for fc, stats in zip(followers, all_stats):
+        assert stats["prefills"] >= 1 and stats["dispatches"] >= 1
+        np.testing.assert_array_equal(np.asarray(leader_core.kv["k"]),
+                                      np.asarray(fc.kv["k"]))
+        np.testing.assert_array_equal(np.asarray(leader_core.kv["v"]),
+                                      np.asarray(fc.kv["v"]))
+    return kinds, all_stats
 
 
 @pytest.mark.asyncio
@@ -351,14 +358,23 @@ async def test_sp_ring_prefill_streams_to_follower(tiny_model_dir):
 
 
 @pytest.mark.asyncio
+async def test_two_followers_stay_bit_identical(tiny_model_dir):
+    """The dispatch stream fans out to EVERY follower (a 3-host engine
+    has two) — both replicas replay to bit-identical device state."""
+    _kinds, all_stats = await _drive_leader_follower(
+        tiny_model_dir, {}, {}, prompt_len=20, num_followers=2)
+    assert len(all_stats) == 2
+
+
+@pytest.mark.asyncio
 async def test_chunked_prefill_streams_to_follower(tiny_model_dir):
     """Chunked-prefill admissions stream as plain per-chunk 'prefill'
     events (round 3) — a 40-token prompt at chunk 16 is 3 chunk
     dispatches, all replayed."""
-    kinds, stats = await _drive_leader_follower(
+    kinds, all_stats = await _drive_leader_follower(
         tiny_model_dir, {"prefill_chunk": 16}, {})
     assert kinds.count("prefill") >= 3, f"chunks not streamed: {kinds}"
-    assert stats["prefills"] >= 3
+    assert all_stats[0]["prefills"] >= 3
 
 
 def test_cli_two_rank_serving(tiny_model_dir):
