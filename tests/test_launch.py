@@ -194,3 +194,27 @@ async def test_llmctl_add_list_remove(daemon, capsys):
                                "chat-model", "m1"]) == 1
     assert await llmctl_amain(["--runtime-server", addr, "disagg",
                                "set-threshold", "m1", "123"]) == 0
+
+
+async def test_llmctl_deployment_max_restarts(daemon):
+    """--max-restarts flows through llmctl create into the stored spec
+    and is validated (the CLI leg of the per-spec CrashLoopBackOff cap)."""
+    import json as _json
+
+    from dynamo_tpu.deploy.spec import SPEC_PREFIX
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    addr = daemon.address
+    assert await llmctl_amain([
+        "--runtime-server", addr, "deployment", "create", "capped", "g:S",
+        "--replicas", "0", "--max-restarts", "5"]) == 0
+    assert await llmctl_amain([
+        "--runtime-server", addr, "deployment", "create", "bad", "g:S",
+        "--max-restarts", "-1"]) == 1          # validated, rejected
+    rt = await DistributedRuntime.connect(addr)
+    try:
+        e = await rt.store.kv_get(SPEC_PREFIX + "capped")
+        assert _json.loads(e.value)["max_restarts"] == 5
+        assert await rt.store.kv_get(SPEC_PREFIX + "bad") is None
+    finally:
+        await rt.shutdown()
