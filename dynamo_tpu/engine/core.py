@@ -129,17 +129,26 @@ class EngineCore:
         self.statics = llama.ModelStatics(
             cfg=model_cfg, block_size=engine_cfg.kv_block_size,
             attn_impl=attn_impl)
-        if params is None:
+        if engine_cfg.quantization not in ("none", "int8", "int8-noembed"):
+            raise ValueError(
+                f"unknown quantization {engine_cfg.quantization!r}")
+        quantized = engine_cfg.quantization != "none"
+        if params is None and quantized:
+            # streaming init→quantize: never materializes the full bf16
+            # tree (16 GB for 8B geometry — OOM on one 16 GB v5e)
+            from .quant import init_params_quantized
+            params = init_params_quantized(
+                model_cfg, jax.random.PRNGKey(engine_cfg.seed),
+                dtype=param_dtype,
+                include_embed=engine_cfg.quantization == "int8")
+        elif params is None:
             params = llama.init_params(
                 model_cfg, jax.random.PRNGKey(engine_cfg.seed), dtype=param_dtype)
-        if engine_cfg.quantization in ("int8", "int8-noembed"):
+        elif quantized:
             from .quant import quantize_params
             params = quantize_params(
                 params,
                 include_embed=engine_cfg.quantization == "int8")
-        elif engine_cfg.quantization != "none":
-            raise ValueError(
-                f"unknown quantization {engine_cfg.quantization!r}")
         self.params = params
         self.kv = llama.init_kv_cache(
             model_cfg, engine_cfg.num_kv_blocks, engine_cfg.kv_block_size,

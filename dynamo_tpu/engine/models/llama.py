@@ -178,22 +178,29 @@ def param_shapes(cfg: ModelConfig) -> Dict[str, Tuple[int, ...]]:
     return shapes
 
 
+def init_one_param(cfg: ModelConfig, name: str, shape: tuple,
+                   sub: jax.Array, dtype=jnp.bfloat16) -> jax.Array:
+    """Initialize a single (stacked) parameter tensor; factored out of
+    init_params so quant.init_params_quantized can build+quantize one
+    tensor at a time without materializing the full bf16 tree."""
+    if name.endswith(("ln1", "ln2", "ln1_post", "ln2_post",
+                      "q_norm", "k_norm")) or name == "final_norm":
+        return (jnp.zeros(shape, dtype=dtype)
+                if cfg.norm_plus_one
+                else jnp.ones(shape, dtype=dtype))
+    if name.endswith(("bq", "bk", "bv")):
+        return jnp.zeros(shape, dtype=dtype)
+    fan_in = shape[-2] if len(shape) > 1 else shape[-1]
+    return (jax.random.normal(sub, shape, dtype=jnp.float32)
+            * (fan_in ** -0.5)).astype(dtype)
+
+
 def init_params(cfg: ModelConfig, key: jax.Array,
                 dtype=jnp.bfloat16) -> Params:
     params: Params = {}
     for name, shape in param_shapes(cfg).items():
         key, sub = jax.random.split(key)
-        if name.endswith(("ln1", "ln2", "ln1_post", "ln2_post",
-                          "q_norm", "k_norm")) or name == "final_norm":
-            params[name] = (jnp.zeros(shape, dtype=dtype)
-                            if cfg.norm_plus_one
-                            else jnp.ones(shape, dtype=dtype))
-        elif name.endswith(("bq", "bk", "bv")):
-            params[name] = jnp.zeros(shape, dtype=dtype)
-        else:
-            fan_in = shape[-2] if len(shape) > 1 else shape[-1]
-            params[name] = (jax.random.normal(sub, shape, dtype=jnp.float32)
-                            * (fan_in ** -0.5)).astype(dtype)
+        params[name] = init_one_param(cfg, name, shape, sub, dtype)
     return params
 
 

@@ -116,30 +116,70 @@ def quantize_params(params: Dict[str, jax.Array],
       bulk of the weights (models/llama.py moe_mlp dequant-fuses them).
     - norms / biases / MoE router untouched.
     """
+    tied = "lm_head" not in params
     out: Dict[str, object] = {}
     for name, w in params.items():
-        suffix = name.split(".", 1)[1] if name.startswith("layers.") else name
-        if name.startswith("layers.") and suffix in _LAYER_MATMULS:
-            # stacked [L, D, F]: per (layer, out-channel) → scale [L, 1, F]
-            out[name] = quantize_array(w, keep_axes=(0, -1))
-        elif name.startswith("layers.") and suffix in _MOE_MATMULS:
-            # stacked [L, E, D, F]: per (layer, expert, out-channel)
-            # → scale [L, E, 1, F], which broadcasts over the expert
-            # einsums' batched-N axis after the per-layer slice
-            out[name] = quantize_array(w, keep_axes=(0, 1, -1))
-        elif name == "lm_head":
-            out[name] = quantize_array(w, keep_axes=(-1,))
-        elif name == "embed" and include_embed:
-            # per-row: scale shape [V, 1]
-            out[name] = quantize_array(w, keep_axes=(0,))
-            if "lm_head" not in params:
-                # tied head: materialize a PRE-TRANSPOSED int8 head —
-                # `x @ q.T` of an int8 matrix defeats XLA's transpose
-                # fusion and measured 2x slower than the bf16 tied path
-                # at small batch; the [D, V] copy reads int8 in natural
-                # orientation instead (263MB vs 525MB bf16 per step for
-                # llama-1B)
-                out["lm_head"] = quantize_array(w.T, keep_axes=(-1,))
-        else:
-            out[name] = w
+        out.update(_quantize_named(name, w, include_embed, tied))
+    return out
+
+
+def _quantize_named(name: str, w: jax.Array, include_embed: bool,
+                    tied: bool) -> Dict[str, object]:
+    """The per-tensor dispatch shared by quantize_params (whole-tree,
+    eager) and init_params_quantized (streaming, one jit per tensor)."""
+    suffix = name.split(".", 1)[1] if name.startswith("layers.") else name
+    if name.startswith("layers.") and suffix in _LAYER_MATMULS:
+        # stacked [L, D, F]: per (layer, out-channel) → scale [L, 1, F]
+        return {name: quantize_array(w, keep_axes=(0, -1))}
+    if name.startswith("layers.") and suffix in _MOE_MATMULS:
+        # stacked [L, E, D, F]: per (layer, expert, out-channel)
+        # → scale [L, E, 1, F], which broadcasts over the expert
+        # einsums' batched-N axis after the per-layer slice
+        return {name: quantize_array(w, keep_axes=(0, 1, -1))}
+    if name == "lm_head":
+        return {name: quantize_array(w, keep_axes=(-1,))}
+    if name == "embed" and include_embed:
+        # per-row: scale shape [V, 1]
+        out = {name: quantize_array(w, keep_axes=(0,))}
+        if tied:
+            # tied head: materialize a PRE-TRANSPOSED int8 head —
+            # `x @ q.T` of an int8 matrix defeats XLA's transpose
+            # fusion and measured 2x slower than the bf16 tied path
+            # at small batch; the [D, V] copy reads int8 in natural
+            # orientation instead (263MB vs 525MB bf16 per step for
+            # llama-1B)
+            out["lm_head"] = quantize_array(w.T, keep_axes=(-1,))
+        return out
+    return {name: w}
+
+
+def init_params_quantized(cfg, key: jax.Array, dtype=jnp.bfloat16,
+                          include_embed: bool = True) -> Dict[str, object]:
+    """Random-init + quantize one stacked tensor at a time, entirely
+    inside a jit, so the full bf16 tree is never materialized.
+
+    init_params followed by quantize_params peaks at the whole bf16 tree
+    (16 GB for Llama-3-8B geometry — an OOM on one 16 GB v5e chip before
+    quantization even starts). Here each tensor's init→absmax→round
+    pipeline is one jitted program whose only output is the int8 payload
+    + f32 scales, so XLA frees the bf16/f32 intermediates inside the
+    program; peak HBM ≈ quantized-so-far + one tensor's working set.
+
+    Key-splitting order matches init_params exactly, so the quantized
+    values equal quantize_params(init_params(...)) for the same seed, up
+    to one-step int8 rounding ties (jit fusion may contract the
+    round(w/scale) arithmetic differently than the eager two-pass)."""
+    from .models.llama import init_one_param, param_shapes
+
+    shapes = param_shapes(cfg)
+    tied = "lm_head" not in shapes
+    out: Dict[str, object] = {}
+    for name, shape in shapes.items():
+        key, sub = jax.random.split(key)
+
+        def build(sub, name=name, shape=shape):
+            w = init_one_param(cfg, name, shape, sub, dtype)
+            return _quantize_named(name, w, include_embed, tied)
+
+        out.update(jax.jit(build)(sub))
     return out

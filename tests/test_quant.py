@@ -221,6 +221,33 @@ def test_unknown_quantization_rejected():
         EngineCore(TINY, ecfg, attn_impl="xla", param_dtype=jnp.float32)
 
 
+def test_streaming_init_quantize_matches_two_pass():
+    """init_params_quantized (one jitted init→quantize per tensor, never
+    materializing the bf16 tree — the 8B-on-one-chip OOM fix) must match
+    quantize_params(init_params(...)) at the same seed, allowing only
+    one-step int8 rounding ties from jit fusion."""
+    from dynamo_tpu.engine.quant import init_params_quantized
+
+    key = jax.random.PRNGKey(7)
+    streamed = init_params_quantized(TINY, key)
+    two_pass = quantize_params(llama.init_params(TINY, key))
+    assert set(streamed) == set(two_pass)
+    for name in two_pass:
+        a, b = streamed[name], two_pass[name]
+        if isinstance(b, QuantizedArray):
+            assert isinstance(a, QuantizedArray), name
+            qa, qb = np.asarray(a.q, np.int32), np.asarray(b.q, np.int32)
+            diff = np.abs(qa - qb)
+            assert diff.max(initial=0) <= 1, name
+            assert (diff != 0).mean() < 1e-3, name
+            np.testing.assert_allclose(np.asarray(a.scale),
+                                       np.asarray(b.scale),
+                                       rtol=1e-6, err_msg=name)
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)
+
+
 def test_moe_expert_quantization_logits_close():
     """MoE expert tensors quantize per (layer, expert, out-channel) and
     moe_mlp dequant-fuses the expert einsums — for mixtral-class models
