@@ -18,6 +18,7 @@ Two decode implementations with identical semantics:
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -385,25 +386,48 @@ def paged_attention_xla(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
                        q_ref, k_hbm, v_hbm, o_ref,
                        m_ref, l_ref, acc_ref, k_bufs, v_bufs, sems,
+                       wave_ref,
                        *, block_size: int, chunk: int, scale: float,
+                       num_seqs: int,
                        softcap: float | None = None):
     """q_ref: [Hp, C] sparse-slotted (VMEM); k_hbm/v_hbm: [NTOK, C] (HBM);
     o_ref: [Hp, C]; k_bufs/v_bufs: [2, chunk*block_size, C] double buffers;
-    sems: DMA semaphore pair; m/l: [Hp, 1]; acc: [Hp, C] f32."""
+    sems: DMA semaphore pair; m/l: [Hp, 1]; acc: [Hp, C] f32;
+    wave_ref: [1] SMEM global wave-parity carried ACROSS grid programs.
+
+    The DMA pipeline is cross-program: scratch persists over the (B,)
+    grid, so each program's LAST wave prefetches the NEXT sequence's
+    first wave. Without this every program exposes its first wave's DMA
+    latency — at seq 512 / chunk 16 that is 1 exposed wave in 2, which
+    measured as ~44% of HBM peak on v5e. Buffer slots follow a GLOBAL
+    wave counter (wave_ref) rather than the per-program chunk index so
+    producer and consumer agree on parity across the program boundary."""
     b = pl.program_id(0)
+
+    def seq_shape(bi):
+        """(num_blocks, num_chunks, start_ci) for sequence bi
+        (scalar-prefetch math)."""
+        nb = (seq_lens_ref[bi] + block_size - 1) // block_size
+        nc = (nb + chunk - 1) // chunk
+        # sliding-window layers: chunks entirely below the window would
+        # be DMA'd and masked to nothing — start at the first in-window
+        # chunk
+        sc = jnp.maximum(win_lo_ref[bi] + 1, 0) // (chunk * block_size)
+        return nb, nc, sc
+
+    num_blocks, num_chunks, start_ci = seq_shape(b)
     seq_len = seq_lens_ref[b]
     win_lo = win_lo_ref[b]
-    num_blocks = (seq_len + block_size - 1) // block_size
-    num_chunks = (num_blocks + chunk - 1) // chunk
 
-    def chunk_copies(ci, slot):
-        """2*chunk contiguous block copies into buffer `slot` (reconstructed
-        identically at wait time; all on one semaphore)."""
+    def chunk_copies(sq, ci, slot, nb):
+        """2*chunk contiguous block copies of sequence `sq`'s chunk `ci`
+        into buffer `slot` (reconstructed identically at wait time; all
+        on one semaphore)."""
         copies = []
         for j in range(chunk):                 # static unroll
             bi = ci * chunk + j
-            bi = jax.lax.select(bi < num_blocks, bi, 0)  # clamp tail
-            blk = block_tables_ref[b, bi]
+            bi = jax.lax.select(bi < nb, bi, 0)  # clamp tail
+            blk = block_tables_ref[sq, bi]
             copies.append(pltpu.make_async_copy(
                 k_hbm.at[pl.ds(blk * block_size, block_size), :],
                 k_bufs.at[slot, pl.ds(j * block_size, block_size), :],
@@ -420,24 +444,44 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
 
     qm = q_ref[:].astype(jnp.float32) * scale   # [Hp, C]
 
-    # sliding-window layers: chunks entirely below the window would be
-    # DMA'd and masked to nothing — start at the first in-window chunk
-    start_ci = jnp.maximum(win_lo + 1, 0) // (chunk * block_size)
+    @pl.when(b == 0)
+    def _():
+        wave_ref[0] = 0
+    p0 = wave_ref[0]          # global parity of this program's first wave
 
-    @pl.when(start_ci < num_chunks)  # empty range: an unwaited start would
-    def _():                         # leak semaphore signal into the next
-        for c in chunk_copies(start_ci, jax.lax.rem(start_ci, 2)):
-            c.start()                # grid step's scratch
+    # this program's first wave was already started by the previous
+    # program's last loop iteration — unless there is no predecessor or
+    # the predecessor had no waves (its loop never ran)
+    if num_seqs > 1:
+        _, prev_nc, prev_sc = seq_shape(jnp.maximum(b - 1, 0))
+        pred_started = (b > 0) & (prev_sc < prev_nc)
+        bn = jnp.minimum(b + 1, num_seqs - 1)
+        next_nb, next_nc, next_sc = seq_shape(bn)
+    else:
+        pred_started = jnp.bool_(False)
+
+    @pl.when((start_ci < num_chunks) & ~pred_started)
+    def _():                  # empty range: an unwaited start would leak
+        for c in chunk_copies(b, start_ci, jax.lax.rem(p0, 2),
+                              num_blocks):     # semaphore signal into the
+            c.start()                          # next grid step's scratch
 
     def body(ci, _):
-        slot = jax.lax.rem(ci, 2)
+        slot = jax.lax.rem(p0 + (ci - start_ci), 2)
 
         @pl.when(ci + 1 < num_chunks)
         def _():
-            for c in chunk_copies(ci + 1, 1 - slot):
+            for c in chunk_copies(b, ci + 1, 1 - slot, num_blocks):
                 c.start()
 
-        for c in chunk_copies(ci, slot):
+        if num_seqs > 1:
+            @pl.when((ci + 1 >= num_chunks) & (b + 1 < num_seqs)
+                     & (next_sc < next_nc))
+            def _():          # last wave: prefetch the successor's first
+                for c in chunk_copies(bn, next_sc, 1 - slot, next_nb):
+                    c.start()
+
+        for c in chunk_copies(b, ci, slot, num_blocks):
             c.wait()
         k = k_bufs[slot].astype(jnp.float32)    # [chunk*bs, C]
         v = v_bufs[slot].astype(jnp.float32)
@@ -458,6 +502,10 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
         return 0
 
     jax.lax.fori_loop(start_ci, num_chunks, body, 0)
+    # hand the successor its first-wave parity: the prefetch above placed
+    # it at 1 - rem(p0 + num_waves - 1, 2) == rem(p0 + num_waves, 2)
+    wave_ref[0] = jax.lax.rem(
+        p0 + jnp.maximum(num_chunks - start_ci, 0), 2)
     o_ref[:] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-20)).astype(o_ref.dtype)
 
 
@@ -466,7 +514,7 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                            *, block_size: int, scale: float,
                            softcap: float | None = None,
                            win_lo: jax.Array | None = None,
-                           chunk_blocks: int = 8,
+                           chunk_blocks: int | None = None,
                            interpret: bool = False) -> jax.Array:
     """Same contract as `paged_attention_xla`; KV stays in HBM and streams
     chunk-by-chunk with double buffering (no [B, M*BS] gather). Sliding
@@ -481,6 +529,13 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             f"block_size % 8 == 0 — see pallas_supported")
     g = H // KVH
     M = block_tables.shape[1]
+    if chunk_blocks is None:
+        # DMA wave depth; 16 blocks = 256 tokens/wave at bs=16. Tuned
+        # on-chip (v5e, llama-1B shapes): 16 beats 8 by ~1 ms at
+        # B=128/seq=512 and ~2 ms at seq=1024, ties elsewhere — deeper
+        # waves amortize per-wave DMA issue cost at long seq (PERF.md).
+        # Overridable for sweeps (tools/decode_profile.py).
+        chunk_blocks = int(os.environ.get("DYN_ATTN_CHUNK_BLOCKS", "16"))
     chunk = max(1, min(chunk_blocks, M))
     Hp = max(8, H)   # sublane-pad the head rows for tiny models
     # sparse slot placement: row h carries q[h] at its kv head's lane group
@@ -506,17 +561,19 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             pltpu.VMEM((2, chunk * block_size, C), k_cache.dtype),
             pltpu.VMEM((2, chunk * block_size, C), v_cache.dtype),
             pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SMEM((1,), jnp.int32),   # cross-program wave parity
         ],
     )
 
     def kernel(block_tables_ref, seq_lens_ref, win_lo_ref, q_ref,
                k_hbm, v_hbm, o_ref, m_ref, l_ref, acc_ref,
-               k_bufs, v_bufs, sems):
+               k_bufs, v_bufs, sems, wave_ref):
         _paged_attn_kernel(
             block_tables_ref, seq_lens_ref, win_lo_ref,
             q_ref.at[0], k_hbm, v_hbm, o_ref.at[0],
-            m_ref, l_ref, acc_ref, k_bufs, v_bufs, sems,
-            block_size=block_size, chunk=chunk, scale=scale, softcap=softcap)
+            m_ref, l_ref, acc_ref, k_bufs, v_bufs, sems, wave_ref,
+            block_size=block_size, chunk=chunk, scale=scale,
+            num_seqs=B, softcap=softcap)
 
     out = pl.pallas_call(
         kernel,
