@@ -388,21 +388,27 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
                        m_ref, l_ref, acc_ref, k_bufs, v_bufs, sems,
                        wave_ref,
                        *, block_size: int, chunk: int, scale: float,
-                       num_seqs: int,
+                       num_seqs: int, seqs_per_program: int,
                        softcap: float | None = None):
-    """q_ref: [Hp, C] sparse-slotted (VMEM); k_hbm/v_hbm: [NTOK, C] (HBM);
-    o_ref: [Hp, C]; k_bufs/v_bufs: [2, chunk*block_size, C] double buffers;
-    sems: DMA semaphore pair; m/l: [Hp, 1]; acc: [Hp, C] f32;
-    wave_ref: [1] SMEM global wave-parity carried ACROSS grid programs.
+    """q_ref: [G, Hp, C] sparse-slotted (VMEM); k_hbm/v_hbm: [NTOK, C]
+    (HBM); o_ref: [G, Hp, C]; k_bufs/v_bufs: [2, chunk*block_size, C]
+    double buffers; sems: DMA semaphore pair; m/l: [Hp, 1]; acc: [Hp, C]
+    f32; wave_ref: [1] SMEM global wave-parity carried ACROSS programs.
 
-    The DMA pipeline is cross-program: scratch persists over the (B,)
-    grid, so each program's LAST wave prefetches the NEXT sequence's
-    first wave. Without this every program exposes its first wave's DMA
-    latency — at seq 512 / chunk 16 that is 1 exposed wave in 2, which
-    measured as ~44% of HBM peak on v5e. Buffer slots follow a GLOBAL
-    wave counter (wave_ref) rather than the per-program chunk index so
-    producer and consumer agree on parity across the program boundary."""
-    b = pl.program_id(0)
+    Each grid program handles G = seqs_per_program sequences (static
+    unroll): per-program fixed costs (q/o block pipelining, grid step
+    dispatch) measured ~150 us per kernel call at B=128 on v5e — ~2.4
+    ms/step over 16 layers — and amortize G-fold.
+
+    The DMA pipeline crosses sequence AND program boundaries: scratch
+    persists over the grid, so each sequence's LAST wave prefetches the
+    NEXT sequence's first wave. Without this every sequence exposes its
+    first wave's DMA latency — at seq 512 / chunk 16 that is 1 exposed
+    wave in 2, which measured as ~44% of HBM peak. Buffer slots follow a
+    GLOBAL wave counter (wave_ref) rather than the per-sequence chunk
+    index so producer and consumer agree on parity across boundaries."""
+    pb = pl.program_id(0)
+    G = seqs_per_program
 
     def seq_shape(bi):
         """(num_blocks, num_chunks, start_ci) for sequence bi
@@ -414,10 +420,6 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
         # chunk
         sc = jnp.maximum(win_lo_ref[bi] + 1, 0) // (chunk * block_size)
         return nb, nc, sc
-
-    num_blocks, num_chunks, start_ci = seq_shape(b)
-    seq_len = seq_lens_ref[b]
-    win_lo = win_lo_ref[b]
 
     def chunk_copies(sq, ci, slot, nb):
         """2*chunk contiguous block copies of sequence `sq`'s chunk `ci`
@@ -438,75 +440,88 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
                 sems.at[slot]))
         return copies
 
-    m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-    l_ref[:] = jnp.zeros_like(l_ref)
-    acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    qm = q_ref[:].astype(jnp.float32) * scale   # [Hp, C]
-
-    @pl.when(b == 0)
+    @pl.when(pb == 0)
     def _():
         wave_ref[0] = 0
-    p0 = wave_ref[0]          # global parity of this program's first wave
 
-    # this program's first wave was already started by the previous
-    # program's last loop iteration — unless there is no predecessor or
-    # the predecessor had no waves (its loop never ran)
-    if num_seqs > 1:
-        _, prev_nc, prev_sc = seq_shape(jnp.maximum(b - 1, 0))
-        pred_started = (b > 0) & (prev_sc < prev_nc)
-        bn = jnp.minimum(b + 1, num_seqs - 1)
-        next_nb, next_nc, next_sc = seq_shape(bn)
-    else:
-        pred_started = jnp.bool_(False)
+    for s in range(G):                         # static unroll over the
+        sq = pb * G + s                        # program's sequence group
+        num_blocks, num_chunks, start_ci = seq_shape(sq)
+        seq_len = seq_lens_ref[sq]
+        win_lo = win_lo_ref[sq]
 
-    @pl.when((start_ci < num_chunks) & ~pred_started)
-    def _():                  # empty range: an unwaited start would leak
-        for c in chunk_copies(b, start_ci, jax.lax.rem(p0, 2),
-                              num_blocks):     # semaphore signal into the
-            c.start()                          # next grid step's scratch
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    def body(ci, _):
-        slot = jax.lax.rem(p0 + (ci - start_ci), 2)
+        qm = q_ref[s].astype(jnp.float32) * scale   # [Hp, C]
 
-        @pl.when(ci + 1 < num_chunks)
-        def _():
-            for c in chunk_copies(b, ci + 1, 1 - slot, num_blocks):
+        p0 = wave_ref[0]      # global parity of this sequence's first wave
+
+        # this sequence's first wave was already started by the previous
+        # sequence's last loop iteration — unless there is no predecessor
+        # or the predecessor had no waves (its loop never ran)
+        if num_seqs > 1:
+            _, prev_nc, prev_sc = seq_shape(jnp.maximum(sq - 1, 0))
+            pred_started = (sq > 0) & (prev_sc < prev_nc)
+            nsq = jnp.minimum(sq + 1, num_seqs - 1)
+            next_nb, next_nc, next_sc = seq_shape(nsq)
+        else:
+            pred_started = jnp.bool_(False)
+
+        @pl.when((start_ci < num_chunks) & ~pred_started)
+        def _(start_ci=start_ci, p0=p0, sq=sq, num_blocks=num_blocks):
+            # empty range: an unwaited start would leak semaphore signal
+            # into the next sequence's waves
+            for c in chunk_copies(sq, start_ci, jax.lax.rem(p0, 2),
+                                  num_blocks):
                 c.start()
 
-        if num_seqs > 1:
-            @pl.when((ci + 1 >= num_chunks) & (b + 1 < num_seqs)
-                     & (next_sc < next_nc))
-            def _():          # last wave: prefetch the successor's first
-                for c in chunk_copies(bn, next_sc, 1 - slot, next_nb):
+        def body(ci, _, *, sq=sq, p0=p0, start_ci=start_ci,
+                 num_chunks=num_chunks, num_blocks=num_blocks,
+                 seq_len=seq_len, win_lo=win_lo, qm=qm):
+            slot = jax.lax.rem(p0 + (ci - start_ci), 2)
+
+            @pl.when(ci + 1 < num_chunks)
+            def _():
+                for c in chunk_copies(sq, ci + 1, 1 - slot, num_blocks):
                     c.start()
 
-        for c in chunk_copies(b, ci, slot, num_blocks):
-            c.wait()
-        k = k_bufs[slot].astype(jnp.float32)    # [chunk*bs, C]
-        v = v_bufs[slot].astype(jnp.float32)
-        s = jax.lax.dot_general(qm, k, (((1,), (1,)), ((), ())))  # [Hp, cbs]
-        if softcap:
-            s = softcap_scores(s, softcap)
-        kv_pos = ci * chunk * block_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, dimension=1)
-        s = jnp.where((kv_pos < seq_len) & (kv_pos > win_lo), s, NEG_INF)
-        m_prev = m_ref[:]                       # [Hp, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())))     # [Hp, C]
-        m_ref[:] = m_new
-        return 0
+            if num_seqs > 1:
+                @pl.when((ci + 1 >= num_chunks) & (sq + 1 < num_seqs)
+                         & (next_sc < next_nc))
+                def _():      # last wave: prefetch the successor's first
+                    for c in chunk_copies(nsq, next_sc, 1 - slot, next_nb):
+                        c.start()
 
-    jax.lax.fori_loop(start_ci, num_chunks, body, 0)
-    # hand the successor its first-wave parity: the prefetch above placed
-    # it at 1 - rem(p0 + num_waves - 1, 2) == rem(p0 + num_waves, 2)
-    wave_ref[0] = jax.lax.rem(
-        p0 + jnp.maximum(num_chunks - start_ci, 0), 2)
-    o_ref[:] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-20)).astype(o_ref.dtype)
+            for c in chunk_copies(sq, ci, slot, num_blocks):
+                c.wait()
+            k = k_bufs[slot].astype(jnp.float32)    # [chunk*bs, C]
+            v = v_bufs[slot].astype(jnp.float32)
+            sm = jax.lax.dot_general(qm, k, (((1,), (1,)), ((), ())))
+            if softcap:
+                sm = softcap_scores(sm, softcap)    # [Hp, cbs]
+            kv_pos = ci * chunk * block_size + jax.lax.broadcasted_iota(
+                jnp.int32, sm.shape, dimension=1)
+            sm = jnp.where((kv_pos < seq_len) & (kv_pos > win_lo),
+                           sm, NEG_INF)
+            m_prev = m_ref[:]                       # [Hp, 1]
+            m_new = jnp.maximum(m_prev, jnp.max(sm, axis=1, keepdims=True))
+            p = jnp.exp(sm - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+            acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())))     # [Hp, C]
+            m_ref[:] = m_new
+            return 0
+
+        jax.lax.fori_loop(start_ci, num_chunks, body, 0)
+        # hand the successor its first-wave parity: the prefetch above
+        # placed it at 1 - rem(p0 + num_waves - 1, 2) == rem(p0+waves, 2)
+        wave_ref[0] = jax.lax.rem(
+            p0 + jnp.maximum(num_chunks - start_ci, 0), 2)
+        o_ref[s] = (acc_ref[:] /
+                    jnp.maximum(l_ref[:], 1e-20)).astype(o_ref.dtype)
 
 
 def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
@@ -515,6 +530,7 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                            softcap: float | None = None,
                            win_lo: jax.Array | None = None,
                            chunk_blocks: int | None = None,
+                           seqs_per_program: int | None = None,
                            interpret: bool = False) -> jax.Array:
     """Same contract as `paged_attention_xla`; KV stays in HBM and streams
     chunk-by-chunk with double buffering (no [B, M*BS] gather). Sliding
@@ -534,26 +550,41 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         # on-chip (v5e, llama-1B shapes): 16 beats 8 by ~1 ms at
         # B=128/seq=512 and ~2 ms at seq=1024, ties elsewhere — deeper
         # waves amortize per-wave DMA issue cost at long seq (PERF.md).
-        # Overridable for sweeps (tools/decode_profile.py).
+        # Both env overrides are read at TRACE time: under jit the value
+        # bakes into the compiled program, so sweeps must use a fresh
+        # process per setting (or pass the parameter, which keys caches).
         chunk_blocks = int(os.environ.get("DYN_ATTN_CHUNK_BLOCKS", "16"))
     chunk = max(1, min(chunk_blocks, M))
     Hp = max(8, H)   # sublane-pad the head rows for tiny models
+    if seqs_per_program is None:
+        # sequences per grid program (fixed-cost amortization; kernel doc)
+        seqs_per_program = int(os.environ.get("DYN_ATTN_SEQS_PER_PROG",
+                                              "8"))
+    G = max(1, min(seqs_per_program, B))
+    Bp = ((B + G - 1) // G) * G
     # sparse slot placement: row h carries q[h] at its kv head's lane group
-    qm = jnp.zeros((B, Hp, KVH, Dh), q.dtype)
-    qm = qm.at[:, jnp.arange(H), jnp.arange(H) // g, :].set(q)
-    qm = qm.reshape(B, Hp, C)
+    qm = jnp.zeros((Bp, Hp, KVH, Dh), q.dtype)
+    qm = qm.at[:B, jnp.arange(H), jnp.arange(H) // g, :].set(q)
+    qm = qm.reshape(Bp, Hp, C)
     if win_lo is None:
         win_lo = jnp.full((B,), -1, jnp.int32)
+    if Bp > B:       # pad group tail with zero-length sequences (no waves)
+        block_tables = jnp.concatenate(
+            [block_tables, jnp.zeros((Bp - B, M), block_tables.dtype)])
+        seq_lens = jnp.concatenate(
+            [seq_lens, jnp.zeros((Bp - B,), seq_lens.dtype)])
+        win_lo = jnp.concatenate(
+            [win_lo, jnp.full((Bp - B,), -1, jnp.int32)])
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(B,),
+        grid=(Bp // G,),
         in_specs=[
-            pl.BlockSpec((1, Hp, C), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((G, Hp, C), lambda b, *_: (b, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.ANY),   # k_cache stays in HBM
             pl.BlockSpec(memory_space=pltpu.ANY),   # v_cache stays in HBM
         ],
-        out_specs=pl.BlockSpec((1, Hp, C), lambda b, *_: (b, 0, 0)),
+        out_specs=pl.BlockSpec((G, Hp, C), lambda b, *_: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((Hp, 1), jnp.float32),                 # m
             pltpu.VMEM((Hp, 1), jnp.float32),                 # l
@@ -570,21 +601,21 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                k_bufs, v_bufs, sems, wave_ref):
         _paged_attn_kernel(
             block_tables_ref, seq_lens_ref, win_lo_ref,
-            q_ref.at[0], k_hbm, v_hbm, o_ref.at[0],
+            q_ref, k_hbm, v_hbm, o_ref,
             m_ref, l_ref, acc_ref, k_bufs, v_bufs, sems, wave_ref,
             block_size=block_size, chunk=chunk, scale=scale,
-            num_seqs=B, softcap=softcap)
+            num_seqs=Bp, seqs_per_program=G, softcap=softcap)
 
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, Hp, C), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((Bp, Hp, C), q.dtype),
         interpret=interpret,
     )(block_tables, seq_lens, jnp.asarray(win_lo, jnp.int32), qm,
       k_cache, v_cache)
     # row h's useful lanes are its kv head's slot; the rest is cross-slot
     # garbage by construction
-    out = out.reshape(B, Hp, KVH, Dh)[:, :H]
+    out = out.reshape(Bp, Hp, KVH, Dh)[:B, :H]
     kh = (jnp.arange(H) // g)[None, :, None, None]
     return jnp.take_along_axis(out, kh, axis=2)[:, :, 0].reshape(B, H, Dh)
 
