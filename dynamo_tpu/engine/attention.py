@@ -450,9 +450,7 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
         seq_len = seq_lens_ref[sq]
         win_lo = win_lo_ref[sq]
 
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+        one_wave = (num_chunks - start_ci) == 1
 
         qm = q_ref[s].astype(jnp.float32) * scale   # [Hp, C]
 
@@ -477,11 +475,12 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
                                   num_blocks):
                 c.start()
 
-        def body(ci, _, *, sq=sq, p0=p0, start_ci=start_ci,
-                 num_chunks=num_chunks, num_blocks=num_blocks,
-                 seq_len=seq_len, win_lo=win_lo, qm=qm):
-            slot = jax.lax.rem(p0 + (ci - start_ci), 2)
-
+        def wave_scores(ci, slot, *, sq=sq, num_chunks=num_chunks,
+                        num_blocks=num_blocks, seq_len=seq_len,
+                        win_lo=win_lo, qm=qm):
+            """DMA bookkeeping + masked scores for wave `ci`: start the
+            next wave (or the successor sequence's first), wait this
+            one, return (p-ready scores, v)."""
             @pl.when(ci + 1 < num_chunks)
             def _():
                 for c in chunk_copies(sq, ci + 1, 1 - slot, num_blocks):
@@ -505,6 +504,11 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
                 jnp.int32, sm.shape, dimension=1)
             sm = jnp.where((kv_pos < seq_len) & (kv_pos > win_lo),
                            sm, NEG_INF)
+            return sm, v
+
+        def body(ci, _, *, p0=p0, start_ci=start_ci, ws=wave_scores):
+            slot = jax.lax.rem(p0 + (ci - start_ci), 2)
+            sm, v = ws(ci, slot)
             m_prev = m_ref[:]                       # [Hp, 1]
             m_new = jnp.maximum(m_prev, jnp.max(sm, axis=1, keepdims=True))
             p = jnp.exp(sm - m_new)
@@ -515,13 +519,33 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
             m_ref[:] = m_new
             return 0
 
-        jax.lax.fori_loop(start_ci, num_chunks, body, 0)
+        @pl.when(one_wave)
+        def _(s=s, start_ci=start_ci, p0=p0, ws=wave_scores):
+            # fast path for sequences whose live KV fits one wave (every
+            # sequence at seq <= chunk*block_size, the common serving
+            # case): plain softmax straight to the output block — no
+            # scratch init, no carry reads, no epilogue divide pass
+            sm, v = ws(start_ci, jax.lax.rem(p0, 2))
+            m = jnp.max(sm, axis=1, keepdims=True)
+            p = jnp.exp(sm - m)
+            l = jnp.sum(p, axis=1, keepdims=True)
+            o_ref[s] = (jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())))
+                / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+        @pl.when(~one_wave)
+        def _(s=s, start_ci=start_ci, num_chunks=num_chunks, body=body):
+            m_ref[:] = jnp.full_like(m_ref, NEG_INF)  # online-softmax
+            l_ref[:] = jnp.zeros_like(l_ref)          # carry state
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+            jax.lax.fori_loop(start_ci, num_chunks, body, 0)
+            o_ref[s] = (acc_ref[:] /
+                        jnp.maximum(l_ref[:], 1e-20)).astype(o_ref.dtype)
+
         # hand the successor its first-wave parity: the prefetch above
         # placed it at 1 - rem(p0 + num_waves - 1, 2) == rem(p0+waves, 2)
         wave_ref[0] = jax.lax.rem(
             p0 + jnp.maximum(num_chunks - start_ci, 0), 2)
-        o_ref[s] = (acc_ref[:] /
-                    jnp.maximum(l_ref[:], 1e-20)).astype(o_ref.dtype)
 
 
 def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,

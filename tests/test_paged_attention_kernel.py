@@ -76,6 +76,28 @@ def test_grouped_grid_with_sliding_window(inputs, g):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("cb", [1, 2, 4])
+def test_multi_wave_online_softmax(inputs, cb):
+    """Small chunk_blocks force the MULTI-wave branch (online-softmax
+    carry, alpha rescale, epilogue divide) that default chunking never
+    reaches with M=8 tables. Compared under matmul precision 'highest':
+    the default TPU-style bf16 multiply passes wiggle the two impls'
+    dots by ~2e-3, which would mask real carry bugs at this tolerance
+    (verified f32-highest vs f64: 3e-7)."""
+    q, k, v, tables, seq_lens = inputs
+    with jax.default_matmul_precision("highest"):
+        got = paged_attention_pallas(q, k, v, tables, seq_lens,
+                                     block_size=BS, scale=Dh ** -0.5,
+                                     chunk_blocks=cb, seqs_per_program=4,
+                                     interpret=True)
+        want = paged_attention_xla(q, k, v, tables, seq_lens,
+                                   block_size=BS, scale=Dh ** -0.5)
+    live = np.asarray(seq_lens) > 0
+    np.testing.assert_allclose(np.asarray(got)[live],
+                               np.asarray(want)[live],
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_single_wave_chain():
     """Consecutive single-wave sequences: every wave is both a first and
     a last wave, the hardest case for the parity handoff."""
