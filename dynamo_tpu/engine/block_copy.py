@@ -31,8 +31,8 @@ KVCache = Dict[str, jax.Array]
 
 __all__ = ["gather_blocks", "scatter_blocks", "gather_blocks_dispatch",
            "gather_blocks_to_host", "scatter_blocks_from_host",
-           "prep_host_values", "to_wire_format", "from_wire_format",
-           "fetch_wire"]
+           "prep_host_values", "scatter_prepped", "to_wire_format",
+           "from_wire_format", "fetch_wire"]
 
 
 @functools.partial(jax.jit, static_argnames=("block_size",))
@@ -104,13 +104,46 @@ def gather_blocks_dispatch(kv: KVCache, block_ids, block_size: int) -> KVCache:
     return gather_blocks(kv, ids, block_size)
 
 
+def _local_np(x) -> np.ndarray:
+    """np.asarray for possibly multi-process arrays: when ``x`` spans
+    non-addressable devices (a multi-controller mesh), assemble THIS
+    process's contiguous portion from its addressable shards. Only the
+    last (lane-packed H*D) axis may be partitioned across processes —
+    the KV layouts this module moves shard heads over tp and replicate
+    the rest."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    by_start: dict = {}
+    for s in x.addressable_shards:
+        idx = s.index
+        for ax, sl in enumerate(idx[:-1]):
+            if not (sl.start in (None, 0) and sl.stop in (None, x.shape[ax])):
+                raise NotImplementedError(
+                    f"multi-process KV partitioned on axis {ax}; only "
+                    f"last-axis (head) sharding is supported here")
+        start = idx[-1].start or 0
+        if start not in by_start:      # replicated shards: fetch once
+            by_start[start] = np.asarray(s.data)
+    return np.concatenate([by_start[st] for st in sorted(by_start)],
+                          axis=-1)
+
+
 def fetch_wire(stacked: KVCache, n: int, num_heads: int) -> dict:
     """Fetch a dispatched gather ([L, n_padded, bs, H*D] device arrays) to
     the host and convert to wire format {"k": [L, H, n, bs, D]} — the one
     device->wire harvest used by offload, handoff, and gather_blocks_to_host
-    (keep in sync by calling, not copying)."""
-    return {k: to_wire_format(np.asarray(v)[:, :n], num_heads)
-            for k, v in stacked.items()}
+    (keep in sync by calling, not copying).
+
+    ``num_heads`` is the GLOBAL kv-head count; on a multi-controller mesh
+    each process harvests only its local head shard and the result's H
+    axis is the local count (the host tier is per-rank — multihost mirror
+    pools hold each rank's shard, engine/multihost.py)."""
+    out = {}
+    for k, v in stacked.items():
+        arr = _local_np(v)[:, :n]
+        heads = num_heads * arr.shape[-1] // v.shape[-1]
+        out[k] = to_wire_format(arr, heads)
+    return out
 
 
 def gather_blocks_to_host(kv: KVCache, block_ids, block_size: int,
@@ -143,12 +176,30 @@ def prep_host_values(block_ids, host_values: dict) -> tuple:
     return ids, out
 
 
+def scatter_prepped(kv: KVCache, ids: np.ndarray, vals: dict,
+                    block_size: int) -> KVCache:
+    """Run the h2d scatter for prep_host_values output against ``kv``'s
+    actual placement: on a single-process mesh the values upload directly;
+    on a multi-controller mesh each rank holds only its local head shard
+    (fetch_wire), so the global values array is assembled from the
+    process-local data under kv's own last-axis sharding."""
+    sample = next(iter(kv.values()))
+    if getattr(sample, "is_fully_addressable", True):
+        vj = {k: jnp.asarray(v) for k, v in vals.items()}
+    else:
+        sh = sample.sharding
+        spec = tuple(sh.spec) + (None,) * (sample.ndim - len(sh.spec))
+        vsh = jax.sharding.NamedSharding(
+            sh.mesh, jax.sharding.PartitionSpec(None, None, None, spec[-1]))
+        vj = {k: jax.make_array_from_process_local_data(vsh, v)
+              for k, v in vals.items()}
+    return scatter_blocks(kv, jnp.asarray(ids), vj, block_size)
+
+
 def scatter_blocks_from_host(kv: KVCache, block_ids, host_values: dict,
                              block_size: int) -> KVCache:
     """TPU-VM DRAM -> device: one transfer, then an on-device scatter into
     the paged pool. ``host_values`` is wire format [L, H, n, bs, D]; returns
     the new (donated-in-place) cache."""
     ids, vals = prep_host_values(block_ids, host_values)
-    return scatter_blocks(kv, jnp.asarray(ids),
-                          {k: jnp.asarray(v) for k, v in vals.items()},
-                          block_size)
+    return scatter_prepped(kv, ids, vals, block_size)

@@ -201,7 +201,8 @@ class EngineCore:
                 host_pool, engine_cfg.kv_block_size,
                 get_kv=lambda: self.kv,
                 release_holds=self.kv_manager.pool.release,
-                simulated_gbps=engine_cfg.offload_simulated_gbps or None)
+                simulated_gbps=engine_cfg.offload_simulated_gbps or None,
+                on_store=self._emit_kv_store)
         self.M = engine_cfg.max_blocks_per_seq
         self.B = engine_cfg.max_num_seqs
 
@@ -348,6 +349,7 @@ class EngineCore:
             for req, slot, plan, _prepped in self._onboards:
                 self.slots[slot] = None
                 self.kv_manager.pool.release(plan.all_blocks)
+                self.kv_manager.host_pool.unpin(plan.host_slots)
                 self._finish_request(req, FinishReason.CANCELLED)
             self._onboards = []
         if self._pending is not None:     # drain the pipelined dispatch
@@ -456,6 +458,14 @@ class EngineCore:
             return True
         return self._admit_with_plan(req, slot, plan, None)
 
+    def _emit_kv_store(self, items: list) -> None:
+        """Offload-pump commit hook → the recorder stream. Multihost
+        followers mirror the store (gathering the same device blocks from
+        their own bit-identical KV), making host-tier restores replayable;
+        the offline replayer skips the event (it refuses host hits)."""
+        if self.recorder is not None:
+            self.recorder.rec("kv_store", items=items)
+
     def _start_onboard(self, req: EngineRequest, slot: int, plan) -> None:
         """Reserve the slot, then prepare the host-tier values off-thread;
         the loop's onboard step completes the admission (the decode batch
@@ -484,7 +494,12 @@ class EngineCore:
                 logger.exception("host-tier onboard prep failed for %s",
                                  req.rid)
             finally:
-                host_pool.unpin(plan.host_slots)
+                # pins release in _complete_onboards, AFTER the admission
+                # records hit_transfer: an offload-pump eviction of these
+                # slots must not be stream-ordered before the event, or a
+                # multihost follower's mirror restore would read the
+                # clobbered slot (the leader scatters prefetched values
+                # and would not notice the divergence)
                 self._onboards.append((req, slot, plan, prepped))
                 self._work_event.set()
 
@@ -497,13 +512,18 @@ class EngineCore:
         pending, self._onboards = self._onboards, []
         for req, slot, plan, prepped in pending:
             self.slots[slot] = None       # _admit_with_plan re-reserves
-            if req.cancelled or prepped is None:
-                self.kv_manager.pool.release(plan.all_blocks)
-                self._finish_request(
-                    req, FinishReason.CANCELLED if req.cancelled
-                    else FinishReason.ERROR)
-                continue
-            self._admit_with_plan(req, slot, plan, prepped)
+            try:
+                if req.cancelled or prepped is None:
+                    self.kv_manager.pool.release(plan.all_blocks)
+                    self._finish_request(
+                        req, FinishReason.CANCELLED if req.cancelled
+                        else FinishReason.ERROR)
+                    continue
+                self._admit_with_plan(req, slot, plan, prepped)
+            finally:
+                # _start_onboard pinned these; safe to evict only now
+                # that hit_transfer (if any) is on the stream
+                self.kv_manager.host_pool.unpin(plan.host_slots)
 
     def _admit_with_plan(self, req: EngineRequest, slot: int, plan,
                          onboard) -> bool:
@@ -516,12 +536,10 @@ class EngineCore:
         # prepare_prefill_offload; the +40% TTFT multi-turn win,
         # docs/architecture.md:91)
         if plan.host_slots:
-            from .block_copy import scatter_blocks
+            from .block_copy import scatter_prepped
             ids, vals = onboard
-            self.kv = scatter_blocks(
-                self.kv, jnp.asarray(ids),
-                {k: jnp.asarray(v) for k, v in vals.items()},
-                self.cfg.kv_block_size)
+            self.kv = scatter_prepped(self.kv, ids, vals,
+                                      self.cfg.kv_block_size)
             targets = plan.new_blocks[:len(plan.host_slots)]
             # onboarded blocks now hold valid registered content
             n_dev = len(plan.hit_blocks)
@@ -540,7 +558,13 @@ class EngineCore:
             self.recorder.rec("hit_transfer", rid=req.rid,
                               hit=req.prefix_hit_tokens,
                               host_hit=plan.host_hit_tokens,
-                              blocks=list(plan.all_blocks))
+                              blocks=list(plan.all_blocks),
+                              # multihost followers replay the h2d restore
+                              # from their mirror pool at these slots into
+                              # these device blocks (run_follower)
+                              host_slots=list(plan.host_slots),
+                              host_targets=list(
+                                  plan.new_blocks[:len(plan.host_slots)]))
         t0 = time.monotonic()
         suffix_len = n_prompt - req.prefix_hit_tokens
         if (self.cfg.lane_prefill_max_tokens > 0

@@ -31,10 +31,21 @@ event dicts. The stream shares the deployment's trust domain with
 intra-engine control channel, not a public endpoint.
 
 Unsupported on the multihost engine (the recorder marks these paths and
-the follower refuses rather than silently diverge): host-KV-tier
-restores and disagg KV onboarding. sp ring prefill and chunked prefill
-ARE streamed (the "prefill_sp" event; chunks record as plain "prefill"
-events) — sp's cross-host ppermute rides ICI on real hardware.
+the follower refuses rather than silently diverge): disagg KV
+onboarding. sp ring prefill and chunked prefill ARE streamed (the
+"prefill_sp" event; chunks record as plain "prefill" events) — sp's
+cross-host ppermute rides ICI on real hardware.
+
+The host-KV tier IS streamed: followers keep a MIRROR host pool. The
+leader's offload pump emits its literal placement decisions ("kv_store":
+hash → slot, eviction, source device block) at commit time — before the
+device holds release, so the stream orders the event ahead of any
+program that could overwrite a reused block. The follower gathers the
+SAME device blocks from its own bit-identical KV and applies the
+decisions verbatim (HostKvPool.apply_store) — arena bytes equal by
+induction, no bulk KV on the wire. A host-restored admission then
+replays h2d locally: "hit_transfer" carries the mirror slots + device
+targets and the follower runs the same scatter program the leader ran.
 """
 
 from __future__ import annotations
@@ -60,7 +71,7 @@ __all__ = ["DispatchStreamLeader", "connect_follower", "run_follower"]
 # host bookkeeping
 WIRE_EVENTS = frozenset(
     {"prefill", "prefill_sp", "dispatch", "hit_transfer",
-     "prefill_unsupported"})
+     "kv_store", "prefill_unsupported"})
 _SHUTDOWN = {"ev": "__shutdown__"}
 
 _LEN = struct.Struct(">I")
@@ -118,10 +129,6 @@ class DispatchStreamLeader(Recorder):
                 "multihost serving requires decode_steps_per_dispatch > 1 "
                 "(the single-step decode path is not in the dispatch "
                 "stream)")
-        if core.cfg.host_kv_blocks > 0:
-            raise ValueError(
-                "multihost serving requires host_kv_blocks=0 (host-tier "
-                "restores are not replayable on followers)")
         core.recorder = self
 
     def wait_for_followers(self) -> None:
@@ -190,7 +197,8 @@ def run_follower(core, sock: socket.socket,
                          exec_sp_prefill_event)
 
     disp_toks: "OrderedDict[int, object]" = OrderedDict()
-    stats = {"prefills": 0, "dispatches": 0}
+    stats = {"prefills": 0, "dispatches": 0, "kv_stores": 0,
+             "host_restores": 0}
 
     while True:
         ev = _recv_frame(sock)
@@ -203,12 +211,40 @@ def run_follower(core, sock: socket.socket,
                 f"leader used an admission path the multihost follower "
                 f"cannot replay ({ev.get('path')}, rid={ev.get('rid')}); "
                 f"disable disagg onboarding on a multihost engine")
+        if kind == "kv_store":
+            # mirror the leader's offload commit: gather the SAME device
+            # blocks from our bit-identical KV, apply the leader's literal
+            # hash→slot placements (no LRU policy re-run on followers)
+            from .block_copy import fetch_wire, gather_blocks_dispatch
+            pool = core.kv_manager.host_pool
+            if pool is None:
+                raise ValueError(
+                    "leader streams host-KV-tier stores but this follower "
+                    "was built with host_kv_blocks=0 — ranks must share "
+                    "one engine config")
+            items = ev["items"]
+            ids = [int(it[3]) for it in items]
+            stacked = gather_blocks_dispatch(core.kv, ids,
+                                             core.cfg.kv_block_size)
+            values = fetch_wire(stacked, len(ids), pool.num_kv_heads)
+            for i, (h, hslot, evicted, _bid) in enumerate(items):
+                pool.apply_store(h, hslot, evicted,
+                                 values["k"][:, :, i], values["v"][:, :, i])
+            stats["kv_stores"] += 1
+            continue
         if kind == "hit_transfer":
             if int(ev.get("host_hit", 0)) > 0:
-                raise NotImplementedError(
-                    "host-KV-tier restore is not replayable on a follower; "
-                    "disable host offload on a multihost engine")
-            continue   # device-state no-op: prefix hits reuse resident KV
+                # replay the leader's h2d restore from the mirror pool:
+                # same slots, same device targets, same scatter program
+                from .block_copy import prep_host_values, scatter_prepped
+                pool = core.kv_manager.host_pool
+                ids, vals = prep_host_values(
+                    list(ev["host_targets"]),
+                    pool.fetch(list(ev["host_slots"])))
+                core.kv = scatter_prepped(core.kv, ids, vals,
+                                          core.cfg.kv_block_size)
+                stats["host_restores"] += 1
+            continue   # device-hit-only: prefix hits reuse resident KV
         if kind == "prefill":
             _tok, core.kv = exec_prefill_event(core, core.kv, ev)
             stats["prefills"] += 1

@@ -469,9 +469,6 @@ async def amain(argv=None) -> None:
                 "multi-host serving requires --decode-steps-per-dispatch "
                 "> 1 (the single-step decode path is not in the dispatch "
                 "stream)")
-        if args.host_kv_blocks > 0:
-            raise SystemExit("multi-host serving requires "
-                             "--host-kv-blocks 0")
     initialize_multihost(MultiNodeConfig(
         num_nodes=args.num_nodes, node_rank=args.node_rank,
         leader_addr=args.leader_addr))

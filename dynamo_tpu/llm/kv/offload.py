@@ -45,10 +45,13 @@ class HostKvPool:
                  dtype=np.float32):
         self.capacity = capacity_blocks
         self.num_kv_heads = num_kv_heads
-        shape = (capacity_blocks, num_layers, num_kv_heads, block_size,
-                 head_dim)
-        self._arena = {"k": np.zeros(shape, dtype=dtype),
-                       "v": np.zeros(shape, dtype=dtype)}
+        # the arena materializes on FIRST store: on a multi-controller
+        # mesh each rank's pool holds only its local head shard, whose
+        # count is known from the first fetched values, not the config
+        # (engine/block_copy.py fetch_wire)
+        self._shape_tail = (num_layers, num_kv_heads, block_size, head_dim)
+        self._dtype = np.dtype(dtype)
+        self._arena: Optional[dict] = None
         self._free: List[int] = list(range(capacity_blocks - 1, -1, -1))
         self._by_hash: Dict[int, int] = {}       # seq_hash → slot
         self._lru: Dict[int, None] = {}          # seq_hash → (ordered dict)
@@ -66,39 +69,85 @@ class HostKvPool:
     def free_slots(self) -> int:
         return len(self._free)
 
-    def _slot_for(self, seq_hash: int) -> Optional[int]:
-        """Existing slot, else a fresh/evicted one. None if capacity == 0."""
+    def _slot_for(self, seq_hash: int):
+        """(slot, evicted_hash) — existing slot, else a fresh/evicted one.
+        (None, None) if nothing is placeable (capacity 0 / all pinned)."""
         slot = self._by_hash.get(seq_hash)
         if slot is not None:
             self._lru.pop(seq_hash, None)
             self._lru[seq_hash] = None
-            return slot
+            return slot, None
+        evicted = None
         if not self._free:
             victim = next((h for h in self._lru
                            if not self._pins.get(self._by_hash[h])), None)
             if victim is None:       # empty, or everything pinned mid-fetch
-                return None
+                return None, None
             self._lru.pop(victim)
             self._free.append(self._by_hash.pop(victim))
             self.evicted_blocks_total += 1
+            evicted = victim
         slot = self._free.pop()
         self._by_hash[seq_hash] = slot
         self._lru[seq_hash] = None
-        return slot
+        return slot, evicted
 
-    def store(self, seq_hashes: Sequence[int], values: dict) -> int:
+    def store(self, seq_hashes: Sequence[int], values: dict) -> list:
         """Write stacked blocks ({"k": [L, H, n, bs, D]}) under their hashes.
-        Returns how many were stored (capacity may evict others)."""
-        n = 0
+        Returns the literal placement decisions ``[(hash, slot,
+        evicted_hash | None)]`` — len(result) blocks were stored (capacity
+        may stop early). Multihost follower mirrors replay these decisions
+        verbatim instead of re-running the LRU policy (apply_store)."""
+        decisions = []
         for i, h in enumerate(seq_hashes):
-            slot = self._slot_for(h)
+            slot, evicted = self._slot_for(h)
             if slot is None:
                 break
+            self._ensure_arena(values["k"][:, :, i])
             self._arena["k"][slot] = values["k"][:, :, i]
             self._arena["v"][slot] = values["v"][:, :, i]
             self.stored_blocks_total += 1
-            n += 1
-        return n
+            decisions.append((h, slot, evicted))
+        return decisions
+
+    def _ensure_arena(self, block_kv: np.ndarray) -> None:
+        if self._arena is None:
+            L, _h, bs, d = self._shape_tail
+            if (block_kv.shape[0], block_kv.shape[2],
+                    block_kv.shape[3]) != (L, bs, d):
+                raise ValueError(
+                    f"host-tier block shape {block_kv.shape} does not "
+                    f"match config {self._shape_tail} (heads may differ "
+                    f"per rank; layers/block_size/head_dim may not)")
+            shape = (self.capacity,) + block_kv.shape
+            self._arena = {"k": np.zeros(shape, self._dtype),
+                           "v": np.zeros(shape, self._dtype)}
+
+    def apply_store(self, seq_hash: int, slot: int,
+                    evicted_hash: Optional[int], k: np.ndarray,
+                    v: np.ndarray) -> None:
+        """Apply one of the leader's literal store decisions to a mirror
+        pool (multihost follower): same hash→slot placement, same
+        eviction, arena bytes from the FOLLOWER's own device KV (which is
+        bit-identical to the leader's by the dispatch-stream induction)."""
+        if evicted_hash is not None:
+            old = self._by_hash.pop(evicted_hash, None)
+            self._lru.pop(evicted_hash, None)
+            if old is not None and old != slot:
+                self._free.append(old)
+            self.evicted_blocks_total += 1
+        if self._by_hash.get(seq_hash) != slot:
+            try:
+                self._free.remove(slot)
+            except ValueError:
+                pass
+            self._by_hash[seq_hash] = slot
+        self._lru.pop(seq_hash, None)
+        self._lru[seq_hash] = None
+        self._ensure_arena(k)
+        self._arena["k"][slot] = k
+        self._arena["v"][slot] = v
+        self.stored_blocks_total += 1
 
     def match_prefix(self, seq_hashes: Sequence[int]) -> List[int]:
         """Longest leading run of hashes present. Returns their slots and
@@ -170,11 +219,17 @@ class KvOffloadEngine:
                  get_kv: Callable[[], dict],
                  release_holds: Optional[Callable[[List[int]], None]] = None,
                  max_batch_blocks: int = 64,
-                 simulated_gbps: Optional[float] = None):
+                 simulated_gbps: Optional[float] = None,
+                 on_store: Optional[Callable[[list], None]] = None):
         self.host_pool = host_pool
         self.block_size = block_size
         self.get_kv = get_kv
         self.release_holds = release_holds
+        # multihost: called with [(hash, slot, evicted_hash, device_block)]
+        # after each committed batch, BEFORE the device holds are released
+        # — so the dispatch stream orders the event ahead of any program
+        # that could overwrite a reused block (engine/multihost.py)
+        self.on_store = on_store
         self.max_batch_blocks = max_batch_blocks
         # injectable d2h link model (VERDICT r2 weak-3): when set, each
         # write-back batch is paced to `bytes / simulated_gbps` wall time,
@@ -249,8 +304,12 @@ class KvOffloadEngine:
             if wait > 0:
                 self.simulated_wait_s += wait
                 await asyncio.sleep(wait)
-        stored = self.host_pool.store(hashes, values)
-        self.offloaded_blocks_total += stored
+        decisions = self.host_pool.store(hashes, values)
+        self.offloaded_blocks_total += len(decisions)
+        if self.on_store is not None and decisions:
+            self.on_store([(h, slot, evicted, ids[i])
+                           for i, (h, slot, evicted)
+                           in enumerate(decisions)])
 
     async def drain(self) -> None:
         self._ensure_task()

@@ -57,7 +57,7 @@ def test_host_pool_store_match_lru_eviction():
                       block_size=BS, head_dim=D)
     vals = {"k": np.ones((L, H, 3, BS, D), np.float32),
             "v": np.ones((L, H, 3, BS, D), np.float32)}
-    assert pool.store([101, 102, 103], vals) == 3
+    assert len(pool.store([101, 102, 103], vals)) == 3
     assert pool.match_prefix([101, 102, 103]) == [
         pool._by_hash[101], pool._by_hash[102], pool._by_hash[103]]
     assert pool.match_prefix([999]) == []
@@ -68,7 +68,7 @@ def test_host_pool_store_match_lru_eviction():
     pool.match_prefix([102, 103])        # ...this leaves 101 LRU
     one = {"k": np.zeros((L, H, 1, BS, D), np.float32),
            "v": np.zeros((L, H, 1, BS, D), np.float32)}
-    assert pool.store([104], one) == 1
+    assert len(pool.store([104], one)) == 1
     assert not pool.contains(101) and pool.contains(104)
     assert pool.evicted_blocks_total == 1
 

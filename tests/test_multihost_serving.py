@@ -111,6 +111,50 @@ FOLLOWER = COMMON + textwrap.dedent("""
 """)
 
 
+HOSTTIER_COMMON = COMMON.replace(
+    "decode_steps_per_dispatch=4)",
+    "decode_steps_per_dispatch=4, host_kv_blocks=16)")
+
+HOSTTIER_LEADER = HOSTTIER_COMMON + textwrap.dedent("""
+    import asyncio
+    from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineRequest
+    from dynamo_tpu.engine.multihost import DispatchStreamLeader
+    from dynamo_tpu.engine.sampling import SlotSampling
+
+    async def run_once(prompt, rid):
+        req = EngineRequest(rid=rid, prompt=list(prompt),
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=4, eos_ids=frozenset())
+        await core.submit(req)
+        toks = []
+        while True:
+            item, payload = await req.out_queue.get()
+            if item is FINISH_SENTINEL:
+                return toks
+            toks.append(item)
+
+    async def main():
+        stream = DispatchStreamLeader(port={dport}, num_followers=1,
+                                      host="127.0.0.1")
+        stream.attach(core)
+        stream.wait_for_followers()
+        assert len(core.params["layers.wq"].sharding.device_set) == 2
+        prompt = list(range(2, 42))
+        t1 = await run_once(prompt, "r1")
+        await core.offload_engine.drain()
+        assert core.offload_engine.offloaded_blocks_total >= 2
+        core.kv_manager.pool.reset()   # only the host tier can restore now
+        t2 = await run_once(prompt, "r2")
+        assert core.host_onboards == 1, core.host_onboards
+        await core.stop()
+        stream.close()
+        print(f"LEADER-DONE eq={{t1 == t2}} onboards={{core.host_onboards}}",
+              flush=True)
+
+    asyncio.run(main())
+""")
+
+
 CLI_RANK = textwrap.dedent("""
     import faulthandler, signal, sys
     faulthandler.register(signal.SIGUSR1)
@@ -150,6 +194,47 @@ def chat(port: int, content: str):
     with urllib.request.urlopen(req, timeout=120) as r:
         assert r.status == 200
         return json.loads(r.read())
+
+
+def test_two_host_tp2_host_tier_restore(tiny_model_dir):
+    """The host-KV tier on a REAL multi-controller mesh (tp=2 across two
+    processes): each rank's pool holds its LOCAL head shard (the KV spans
+    non-addressable devices — np.asarray on the full array would throw),
+    and the h2d restore reassembles the global array from per-rank local
+    data. Drives offload → device-pool wipe → host restore on rank 0 with
+    rank 1 mirroring, and asserts the restored continuation is identical."""
+    coord = f"127.0.0.1:{free_port()}"
+    dport = free_port()
+    fmt = dict(repo=REPO, coord=coord, model_dir=str(tiny_model_dir),
+               dport=dport)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    hosttier_follower = HOSTTIER_COMMON + FOLLOWER[len(COMMON):]
+    leader = subprocess.Popen(
+        [sys.executable, "-c", HOSTTIER_LEADER.format(**fmt), "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+    follower = subprocess.Popen(
+        [sys.executable, "-c", hosttier_follower.format(**fmt), "1"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+    outs = {}
+    try:
+        for name, p in (("leader", leader), ("follower", follower)):
+            out, _ = p.communicate(timeout=420)
+            outs[name] = out
+    finally:
+        for p in (leader, follower):
+            if p.poll() is None:
+                p.kill()
+    assert leader.returncode == 0, f"leader:\n{outs.get('leader', '')[-3000:]}"
+    assert follower.returncode == 0, (
+        f"follower:\n{outs.get('follower', '')[-3000:]}")
+    done = [l for l in outs["leader"].splitlines() if "LEADER-DONE" in l][-1]
+    assert "eq=True" in done and "onboards=1" in done, done
+    stats_line = [l for l in outs["follower"].splitlines()
+                  if "FOLLOWER-DONE" in l][-1]
+    stats = json.loads(stats_line.split("FOLLOWER-DONE ", 1)[1])
+    assert stats["kv_stores"] >= 1, stats
+    assert stats["host_restores"] == 1, stats
 
 
 def test_two_host_tp2_engine_serves_http(tiny_model_dir):
@@ -268,11 +353,12 @@ def test_two_host_tp2_engine_serves_http(tiny_model_dir):
 
 async def _drive_leader_follower(tiny_model_dir, ecfg_over: dict,
                                  mesh_axes: dict, prompt_len: int = 40,
-                                 num_followers: int = 1):
+                                 num_followers: int = 1, drive=None):
     """In-process leader + N followers wired through real TCP sockets:
-    serve one request on the leader, live-replay on every follower, then
-    assert each follower's device KV is BIT-IDENTICAL — the invariant the
-    whole multihost design rests on. Returns (event kinds, stats list)."""
+    serve one request on the leader (or a custom ``drive(core, send)``
+    scenario), live-replay on every follower, then assert each follower's
+    device KV is BIT-IDENTICAL — the invariant the whole multihost design
+    rests on. Returns (event kinds, stats list, leader core, followers)."""
     import asyncio
 
     import numpy as np
@@ -324,16 +410,25 @@ async def _drive_leader_follower(tiny_model_dir, ecfg_over: dict,
     rng = np.random.default_rng(5)
     prompt = [int(t) for t in rng.integers(2, 120, size=prompt_len)]
     engine = JaxEngine(leader_core)
-    pre = PreprocessedRequest(
-        token_ids=prompt,
-        stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
-        sampling_options=SamplingOptions(greedy=True))
-    out_stream = await engine.generate(Context(pre, ctx=EngineContext("r1")))
-    toks = []
-    async for a in out_stream:
-        if a.data is not None and a.data.token_ids:
-            toks.extend(a.data.token_ids)
-    assert len(toks) >= 6
+
+    async def send(tokens, rid):
+        pre = PreprocessedRequest(
+            token_ids=list(tokens),
+            stop_conditions=StopConditions(max_tokens=6, ignore_eos=True),
+            sampling_options=SamplingOptions(greedy=True))
+        out_stream = await engine.generate(
+            Context(pre, ctx=EngineContext(rid)))
+        toks = []
+        async for a in out_stream:
+            if a.data is not None and a.data.token_ids:
+                toks.extend(a.data.token_ids)
+        return toks
+
+    if drive is not None:
+        await drive(leader_core, send)
+    else:
+        toks = await send(prompt, "r1")
+        assert len(toks) >= 6
     await leader_core.stop()
     stream.close()
     all_stats = [await t for t in follower_tasks]
@@ -344,14 +439,14 @@ async def _drive_leader_follower(tiny_model_dir, ecfg_over: dict,
                                       np.asarray(fc.kv["k"]))
         np.testing.assert_array_equal(np.asarray(leader_core.kv["v"]),
                                       np.asarray(fc.kv["v"]))
-    return kinds, all_stats
+    return kinds, all_stats, leader_core, followers
 
 
 @pytest.mark.asyncio
 async def test_sp_ring_prefill_streams_to_follower(tiny_model_dir):
     """sp ring-prefill admissions ride the dispatch stream (round 3: the
     'prefill_sp' event); on a pod the same ppermutes ride ICI."""
-    kinds, _stats = await _drive_leader_follower(
+    kinds, *_ = await _drive_leader_follower(
         tiny_model_dir, {"sp_min_prefill_tokens": 16},
         {"dp": 1, "tp": 1, "sp": 2})
     assert "prefill_sp" in kinds, f"sp path not taken: {kinds}"
@@ -361,7 +456,7 @@ async def test_sp_ring_prefill_streams_to_follower(tiny_model_dir):
 async def test_two_followers_stay_bit_identical(tiny_model_dir):
     """The dispatch stream fans out to EVERY follower (a 3-host engine
     has two) — both replicas replay to bit-identical device state."""
-    _kinds, all_stats = await _drive_leader_follower(
+    _kinds, all_stats, *_ = await _drive_leader_follower(
         tiny_model_dir, {}, {}, prompt_len=20, num_followers=2)
     assert len(all_stats) == 2
 
@@ -371,10 +466,49 @@ async def test_chunked_prefill_streams_to_follower(tiny_model_dir):
     """Chunked-prefill admissions stream as plain per-chunk 'prefill'
     events (round 3) — a 40-token prompt at chunk 16 is 3 chunk
     dispatches, all replayed."""
-    kinds, all_stats = await _drive_leader_follower(
+    kinds, all_stats, *_ = await _drive_leader_follower(
         tiny_model_dir, {"prefill_chunk": 16}, {})
     assert kinds.count("prefill") >= 3, f"chunks not streamed: {kinds}"
     assert all_stats[0]["prefills"] >= 3
+
+
+@pytest.mark.asyncio
+async def test_host_kv_tier_streams_to_follower(tiny_model_dir):
+    """The host-KV tier rides the dispatch stream (round-3 continuation):
+    the leader's offload commits mirror onto the follower's host pool
+    ('kv_store' — follower gathers the SAME blocks from its own device
+    KV), and a host-restored admission replays its h2d scatter from that
+    mirror. Scenario: serve P, drain the offload pump, wipe the device
+    reuse tier, re-serve P — the second serve restores from the host tier
+    on the leader AND the follower, and the final device KV (asserted
+    bit-identical by the driver helper) proves the restore matched."""
+    import numpy as np
+
+    prompt = list(range(2, 42))                 # 5 full blocks at bs=8
+    seen = {}
+
+    async def drive(core, send):
+        seen["t1"] = await send(prompt, "r1")
+        await core.offload_engine.drain()
+        assert core.offload_engine.offloaded_blocks_total >= 2
+        # wipe the device reuse tier: only the host tier can restore
+        core.kv_manager.pool.reset()
+        seen["t2"] = await send(prompt, "r2")
+        assert core.host_onboards == 1
+
+    kinds, _stats, leader, followers = await _drive_leader_follower(
+        tiny_model_dir, {"host_kv_blocks": 16}, {}, drive=drive)
+    assert "kv_store" in kinds, f"offload commits not streamed: {kinds}"
+    assert seen["t2"] == seen["t1"]             # greedy, restored prefix
+    lp = leader.kv_manager.host_pool
+    fp = followers[0].kv_manager.host_pool
+    # the mirror pool matches the leader's: same hash→slot map, same bytes
+    assert fp._by_hash == lp._by_hash and len(fp) > 0
+    for h, slot in lp._by_hash.items():
+        np.testing.assert_array_equal(lp._arena["k"][slot],
+                                      fp._arena["k"][slot])
+        np.testing.assert_array_equal(lp._arena["v"][slot],
+                                      fp._arena["v"][slot])
 
 
 def test_cli_two_rank_serving(tiny_model_dir):
