@@ -199,7 +199,25 @@ def scatter_prepped(kv: KVCache, ids: np.ndarray, vals: dict,
 def scatter_blocks_from_host(kv: KVCache, block_ids, host_values: dict,
                              block_size: int) -> KVCache:
     """TPU-VM DRAM -> device: one transfer, then an on-device scatter into
-    the paged pool. ``host_values`` is wire format [L, H, n, bs, D]; returns
-    the new (donated-in-place) cache."""
+    the paged pool. ``host_values`` is GLOBAL-head wire format
+    [L, H, n, bs, D]; on a multi-controller mesh each rank slices its
+    local head shard before uploading (scatter_prepped assembles the
+    global array from the per-rank locals). Returns the new
+    (donated-in-place) cache."""
+    sample = next(iter(kv.values()))
+    if not getattr(sample, "is_fully_addressable", True):
+        lo, hi = _local_lane_range(sample)
+        d = next(iter(host_values.values())).shape[-1]
+        host_values = {k: v[:, lo // d:hi // d]
+                       for k, v in host_values.items()}
     ids, vals = prep_host_values(block_ids, host_values)
     return scatter_prepped(kv, ids, vals, block_size)
+
+
+def _local_lane_range(x) -> tuple:
+    """This process's contiguous [start, stop) span of the last (lane)
+    axis of a multi-process array (same contiguity assumption _local_np
+    validates)."""
+    starts = {s.index[-1].start or 0 for s in x.addressable_shards}
+    stops = {s.index[-1].stop or x.shape[-1] for s in x.addressable_shards}
+    return min(starts), max(stops)

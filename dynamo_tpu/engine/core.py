@@ -580,8 +580,14 @@ class EngineCore:
         remote_admit = req.precomputed is not None
         if req.precomputed is not None:
             if self.recorder is not None:
-                self.recorder.rec("prefill_unsupported", rid=req.rid,
-                                  path="precomputed")
+                from ..llm.kv_transport import DeviceKvPayload
+                if isinstance(req.precomputed, DeviceKvPayload):
+                    # the device bulk plane's arrays live in THIS
+                    # process's bridge — nothing streamable; a multihost
+                    # deployment's prefill workers are other processes
+                    # and arrive on the wire plane (streamed below)
+                    self.recorder.rec("prefill_unsupported", rid=req.rid,
+                                      path="precomputed_device")
             tok, logprob = self._admit_precomputed(req, n_already)
             # device payloads ship the first token as a device scalar (the
             # prefill side never fetched it — one round-trip saved); defer
@@ -849,6 +855,15 @@ class EngineCore:
             else:
                 vals = {k: v[:, :, n_already:n_prompt_blocks]
                         for k, v in pc.values.items()}
+                if self.recorder is not None:
+                    # wire-plane payload: stream the (global-head) values
+                    # so multihost followers and the offline replayer can
+                    # apply the identical scatter — recorded BEFORE the
+                    # device op, like every streamed program
+                    self.recorder.rec(
+                        "precomputed_admit", rid=req.rid,
+                        targets=list(targets),
+                        values={k: np.asarray(v) for k, v in vals.items()})
                 self.kv = scatter_blocks_from_host(
                     self.kv, targets, vals, self.cfg.kv_block_size)
         # drop the payload now: nothing reads it after the scatter, and a

@@ -30,11 +30,15 @@ event dicts. The stream shares the deployment's trust domain with
 ``jax.distributed`` itself (same hosts, same network) — it is an
 intra-engine control channel, not a public endpoint.
 
-Unsupported on the multihost engine (the recorder marks these paths and
-the follower refuses rather than silently diverge): disagg KV
-onboarding. sp ring prefill and chunked prefill ARE streamed (the
-"prefill_sp" event; chunks record as plain "prefill" events) — sp's
-cross-host ppermute rides ICI on real hardware.
+sp ring prefill and chunked prefill ARE streamed (the "prefill_sp"
+event; chunks record as plain "prefill" events) — sp's cross-host
+ppermute rides ICI on real hardware. Wire-plane disagg onboarding IS
+streamed too ("precomputed_admit" forwards the remote prefill's KV
+values; each rank scatters its head shard). The one remaining refusal
+is DEVICE-plane disagg payloads ("prefill_unsupported"
+path=precomputed_device): their arrays live in the leader process's
+bridge and cannot reach other ranks — a multihost deployment's prefill
+workers are separate processes and arrive on the wire plane anyway.
 
 The host-KV tier IS streamed: followers keep a MIRROR host pool. The
 leader's offload pump emits its literal placement decisions ("kv_store":
@@ -71,7 +75,7 @@ __all__ = ["DispatchStreamLeader", "connect_follower", "run_follower"]
 # host bookkeeping
 WIRE_EVENTS = frozenset(
     {"prefill", "prefill_sp", "dispatch", "hit_transfer",
-     "kv_store", "prefill_unsupported"})
+     "kv_store", "precomputed_admit", "prefill_unsupported"})
 _SHUTDOWN = {"ev": "__shutdown__"}
 
 _LEN = struct.Struct(">I")
@@ -117,6 +121,7 @@ class DispatchStreamLeader(Recorder):
         self._accept_timeout = accept_timeout
         self.socks: List[socket.socket] = []
         self.sent = 0
+        self.broken = False
 
     def attach(self, core) -> None:
         """Validate the engine is in a configuration whose EVERY device
@@ -129,6 +134,13 @@ class DispatchStreamLeader(Recorder):
                 "multihost serving requires decode_steps_per_dispatch > 1 "
                 "(the single-step decode path is not in the dispatch "
                 "stream)")
+        pool = core.kv_manager.host_pool
+        if pool is not None and len(pool) > 0:
+            # followers mirror only post-attach stores; a pre-attach
+            # offload would later host-hit with slots no follower holds
+            raise ValueError(
+                "attach the dispatch stream before the engine offloads "
+                f"anything (host pool already holds {len(pool)} blocks)")
         core.recorder = self
 
     def wait_for_followers(self) -> None:
@@ -149,9 +161,25 @@ class DispatchStreamLeader(Recorder):
     def rec(self, ev: str, **kw) -> None:
         if ev not in WIRE_EVENTS:
             return
+        if self.broken:
+            # fail FAST and deterministically: after any send failure some
+            # follower may have missed an event, so device state can no
+            # longer be proven bit-identical — serving must stop, not
+            # silently diverge
+            raise RuntimeError(
+                "multihost dispatch stream is broken (a prior event send "
+                "failed); the engine cannot guarantee follower lockstep")
         kw["ev"] = ev
-        for s in self.socks:
-            _send_frame(s, kw)
+        # serialize ONCE: precomputed_admit carries bulk KV values, and
+        # per-socket pickling would redo megabytes of work on the loop
+        data = pickle.dumps(kw, protocol=5)
+        frame = _LEN.pack(len(data)) + data
+        try:
+            for s in self.socks:
+                s.sendall(frame)
+        except OSError:
+            self.broken = True
+            raise
         self.sent += 1
 
     def close(self) -> None:
@@ -211,11 +239,21 @@ def run_follower(core, sock: socket.socket,
                 f"leader used an admission path the multihost follower "
                 f"cannot replay ({ev.get('path')}, rid={ev.get('rid')}); "
                 f"disable disagg onboarding on a multihost engine")
+        if kind == "precomputed_admit":
+            # wire-plane disagg admission: the leader forwarded the
+            # remote prefill's (global-head) KV values; scatter our
+            # shard into the same target blocks
+            from .block_copy import scatter_blocks_from_host
+            core.kv = scatter_blocks_from_host(
+                core.kv, list(ev["targets"]), ev["values"],
+                core.cfg.kv_block_size)
+            stats["precomputed"] = stats.get("precomputed", 0) + 1
+            continue
         if kind == "kv_store":
             # mirror the leader's offload commit: gather the SAME device
             # blocks from our bit-identical KV, apply the leader's literal
             # hash→slot placements (no LRU policy re-run on followers)
-            from .block_copy import fetch_wire, gather_blocks_dispatch
+            from .block_copy import gather_blocks_to_host
             pool = core.kv_manager.host_pool
             if pool is None:
                 raise ValueError(
@@ -224,9 +262,9 @@ def run_follower(core, sock: socket.socket,
                     "one engine config")
             items = ev["items"]
             ids = [int(it[3]) for it in items]
-            stacked = gather_blocks_dispatch(core.kv, ids,
-                                             core.cfg.kv_block_size)
-            values = fetch_wire(stacked, len(ids), pool.num_kv_heads)
+            values = gather_blocks_to_host(core.kv, ids,
+                                           core.cfg.kv_block_size,
+                                           pool.num_kv_heads)
             for i, (h, hslot, evicted, _bid) in enumerate(items):
                 pool.apply_store(h, hslot, evicted,
                                  values["k"][:, :, i], values["v"][:, :, i])
@@ -238,6 +276,11 @@ def run_follower(core, sock: socket.socket,
                 # same slots, same device targets, same scatter program
                 from .block_copy import prep_host_values, scatter_prepped
                 pool = core.kv_manager.host_pool
+                if pool is None or pool._arena is None:
+                    raise ValueError(
+                        "host restore references slots this follower "
+                        "never mirrored (no kv_store seen) — the leader "
+                        "must attach the stream before any offloads")
                 ids, vals = prep_host_values(
                     list(ev["host_targets"]),
                     pool.fetch(list(ev["host_slots"])))

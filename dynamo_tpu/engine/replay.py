@@ -177,6 +177,16 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                         f"there and compare_replay would report phantom "
                         f"mismatches; start recording before any prefix "
                         f"blocks are stored")
+        if kind == "precomputed_admit":
+            # wire-plane disagg admission: the record carries the remote
+            # prefill's KV values, so the replay applies the identical
+            # scatter and those slots gain an in-log writer
+            from .block_copy import scatter_blocks_from_host
+            kv = scatter_blocks_from_host(kv, list(ev["targets"]),
+                                          ev["values"], bs)
+            written.update(int(b) * bs + o for b in ev["targets"]
+                           for o in range(bs))
+            fp(("precomputed_admit", ev.get("rid")))
         if kind in ("prefill", "prefill_sp"):
             tok, kv = (exec_prefill_event(core, kv, ev)
                        if kind == "prefill"
@@ -284,6 +294,11 @@ def check_log(events: List[dict], block_size: int) -> List[StaleRead]:
             for p in range(int(ev["hit"])):
                 ps = table[p // block_size] * block_size + p % block_size
                 write(ps, ev["rid"])
+        if ev["ev"] == "precomputed_admit":
+            # wire-plane disagg scatter writes whole target blocks
+            for b in ev["targets"]:
+                for o in range(block_size):
+                    write(int(b) * block_size + o, ev["rid"])
         if ev["ev"] in ("prefill", "prefill_sp"):
             table = np.asarray(ev["table"])
             rid = ev["rid"]

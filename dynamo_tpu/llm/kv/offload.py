@@ -194,6 +194,14 @@ class HostKvPool:
         return self.match_hits / max(self.match_queries, 1)
 
 
+class KvStoreEmitError(RuntimeError):
+    """The on_store (dispatch-stream) emission failed AFTER the host pool
+    committed a store: multihost follower mirrors can no longer be proven
+    identical. Never swallowed by the pump's best-effort handler — the
+    pump dies and the broken stream fails every later recorded admission
+    (engine/multihost.py DispatchStreamLeader.rec)."""
+
+
 @dataclasses.dataclass
 class OffloadJob:
     """Device blocks to write back to host. The enqueuer pre-holds
@@ -264,6 +272,12 @@ class KvOffloadEngine:
                 total += len(j.block_ids)
             try:
                 await self._process(jobs)
+            except KvStoreEmitError:
+                logger.critical(
+                    "kv_store stream emission failed after the pool "
+                    "committed — multihost mirrors are unprovable; "
+                    "killing the pump (the broken stream stops serving)")
+                raise
             except Exception:  # noqa: BLE001 — write-back is best-effort
                 logger.exception("kv offload batch failed")
             finally:
@@ -307,9 +321,12 @@ class KvOffloadEngine:
         decisions = self.host_pool.store(hashes, values)
         self.offloaded_blocks_total += len(decisions)
         if self.on_store is not None and decisions:
-            self.on_store([(h, slot, evicted, ids[i])
-                           for i, (h, slot, evicted)
-                           in enumerate(decisions)])
+            try:
+                self.on_store([(h, slot, evicted, ids[i])
+                               for i, (h, slot, evicted)
+                               in enumerate(decisions)])
+            except Exception as e:  # noqa: BLE001
+                raise KvStoreEmitError(str(e)) from e
 
     async def drain(self) -> None:
         self._ensure_task()

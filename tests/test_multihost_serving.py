@@ -196,6 +196,48 @@ def chat(port: int, content: str):
         return json.loads(r.read())
 
 
+@pytest.mark.asyncio
+async def test_wire_disagg_admission_streams_to_follower(tiny_model_dir):
+    """Wire-plane disagg onboarding rides the dispatch stream (round-3
+    continuation): a remote-prefill KvPayload admission emits
+    'precomputed_admit' with the payload's KV values, the follower
+    scatters the same bytes into the same target blocks, and the helper's
+    final bit-identical-KV assertion proves the replay matched. Synthetic
+    payload values — follower lockstep is the property under test;
+    disagg semantics live in test_disagg."""
+    import numpy as np
+
+    from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineRequest
+    from dynamo_tpu.engine.sampling import SlotSampling
+    from dynamo_tpu.llm.protocols.disagg import KvPayload
+
+    rng = np.random.default_rng(9)
+    prompt = [int(t) for t in rng.integers(2, 120, size=32)]   # 4 blocks
+
+    async def drive(core, send):
+        mc, bs = core.model_cfg, core.cfg.kv_block_size
+        n = len(prompt) // bs
+        shape = (mc.num_layers, mc.num_kv_heads, n, bs, mc.head_dim)
+        vals = {k: rng.standard_normal(shape).astype(np.float32)
+                for k in ("k", "v")}
+        payload = KvPayload(request_id="rp", first_token=5,
+                            first_logprob=-0.1, seq_hashes=[], values=vals)
+        req = EngineRequest(rid="rp", prompt=list(prompt),
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=4, eos_ids=frozenset(),
+                            precomputed=payload)
+        await core.submit(req)
+        while True:
+            item, _payload = await req.out_queue.get()
+            if item is FINISH_SENTINEL:
+                break
+
+    kinds, stats, *_ = await _drive_leader_follower(
+        tiny_model_dir, {}, {}, drive=drive)
+    assert "precomputed_admit" in kinds, kinds
+    assert stats[0].get("precomputed", 0) == 1, stats[0]
+
+
 def test_two_host_tp2_host_tier_restore(tiny_model_dir):
     """The host-KV tier on a REAL multi-controller mesh (tp=2 across two
     processes): each rank's pool holds its LOCAL head shard (the KV spans
@@ -434,7 +476,9 @@ async def _drive_leader_follower(tiny_model_dir, ecfg_over: dict,
     all_stats = [await t for t in follower_tasks]
 
     for fc, stats in zip(followers, all_stats):
-        assert stats["prefills"] >= 1 and stats["dispatches"] >= 1
+        # precomputed (disagg) admissions legitimately have no prefill
+        assert stats["dispatches"] >= 1
+        assert drive is not None or stats["prefills"] >= 1
         np.testing.assert_array_equal(np.asarray(leader_core.kv["k"]),
                                       np.asarray(fc.kv["k"]))
         np.testing.assert_array_equal(np.asarray(leader_core.kv["v"]),
