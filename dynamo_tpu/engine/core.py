@@ -460,9 +460,10 @@ class EngineCore:
 
     def _emit_kv_store(self, items: list) -> None:
         """Offload-pump commit hook → the recorder stream. Multihost
-        followers mirror the store (gathering the same device blocks from
-        their own bit-identical KV), making host-tier restores replayable;
-        the offline replayer skips the event (it refuses host hits)."""
+        followers AND the offline replayer mirror the store (gathering
+        the same device blocks from their own bit-identical KV), making
+        host-tier restores replayable in both
+        (replay.exec_kv_store_event)."""
         if self.recorder is not None:
             self.recorder.rec("kv_store", items=items)
 
@@ -552,9 +553,11 @@ class EngineCore:
         req.prefix_hit_tokens = plan.hit_tokens + plan.host_hit_tokens
         n_already = len(plan.hit_blocks) + len(plan.host_slots)
         if self.recorder is not None and req.prefix_hit_tokens > 0:
-            # before the prefill record: read rights over the shared prefix.
-            # host_hit is recorded so replay can refuse host-restored hits —
-            # the h2d scatter above is a device write replay never re-executes
+            # before the prefill record: read rights over the shared
+            # prefix. host_hit + host_slots/targets let multihost
+            # followers and the offline replayer re-execute the h2d
+            # restore above from their mirror pools
+            # (replay.exec_host_restore_event)
             self.recorder.rec("hit_transfer", rid=req.rid,
                               hit=req.prefix_hit_tokens,
                               host_hit=plan.host_hit_tokens,

@@ -221,7 +221,8 @@ def run_follower(core, sock: socket.socket,
     signatures live in exactly one place; this loop only adds the live
     carry (``core.kv``) and a bounded chain window.
     """
-    from .replay import (exec_dispatch_event, exec_prefill_event,
+    from .replay import (exec_dispatch_event, exec_host_restore_event,
+                         exec_kv_store_event, exec_prefill_event,
                          exec_sp_prefill_event)
 
     disp_toks: "OrderedDict[int, object]" = OrderedDict()
@@ -252,40 +253,30 @@ def run_follower(core, sock: socket.socket,
         if kind == "kv_store":
             # mirror the leader's offload commit: gather the SAME device
             # blocks from our bit-identical KV, apply the leader's literal
-            # hash→slot placements (no LRU policy re-run on followers)
-            from .block_copy import gather_blocks_to_host
+            # hash→slot placements (no LRU policy re-run on followers) —
+            # shared with the offline replayer (replay.exec_kv_store_event)
             pool = core.kv_manager.host_pool
             if pool is None:
                 raise ValueError(
                     "leader streams host-KV-tier stores but this follower "
                     "was built with host_kv_blocks=0 — ranks must share "
                     "one engine config")
-            items = ev["items"]
-            ids = [int(it[3]) for it in items]
-            values = gather_blocks_to_host(core.kv, ids,
-                                           core.cfg.kv_block_size,
-                                           pool.num_kv_heads)
-            for i, (h, hslot, evicted, _bid) in enumerate(items):
-                pool.apply_store(h, hslot, evicted,
-                                 values["k"][:, :, i], values["v"][:, :, i])
+            exec_kv_store_event(core.kv, ev, pool, core.cfg.kv_block_size)
             stats["kv_stores"] += 1
             continue
         if kind == "hit_transfer":
             if int(ev.get("host_hit", 0)) > 0:
-                # replay the leader's h2d restore from the mirror pool:
-                # same slots, same device targets, same scatter program
-                from .block_copy import prep_host_values, scatter_prepped
+                # replay the leader's h2d restore from the mirror pool —
+                # shared with the offline replayer
+                # (replay.exec_host_restore_event)
                 pool = core.kv_manager.host_pool
                 if pool is None or pool._arena is None:
                     raise ValueError(
                         "host restore references slots this follower "
                         "never mirrored (no kv_store seen) — the leader "
                         "must attach the stream before any offloads")
-                ids, vals = prep_host_values(
-                    list(ev["host_targets"]),
-                    pool.fetch(list(ev["host_slots"])))
-                core.kv = scatter_prepped(core.kv, ids, vals,
-                                          core.cfg.kv_block_size)
+                core.kv = exec_host_restore_event(core.kv, ev, pool,
+                                                  core.cfg.kv_block_size)
                 stats["host_restores"] += 1
             continue   # device-hit-only: prefix hits reuse resident KV
         if kind == "prefill":

@@ -87,6 +87,33 @@ def exec_sp_prefill_event(core, kv, ev: dict):
     return _exec_prefill(core, kv, ev, sp=True)
 
 
+def exec_kv_store_event(kv, ev: dict, pool, block_size: int) -> None:
+    """Mirror one of the leader's offload commits: gather the SAME device
+    blocks from ``kv`` (bit-identical by the replay/stream induction) and
+    apply the literal hash→slot placements to ``pool``. Single home of
+    the kv_store event, shared by the offline replayer and the live
+    multihost follower (engine/multihost.py)."""
+    from .block_copy import gather_blocks_to_host
+
+    ids = [int(it[3]) for it in ev["items"]]
+    values = gather_blocks_to_host(kv, ids, block_size, pool.num_kv_heads)
+    for i, (h, hslot, evicted, _bid) in enumerate(ev["items"]):
+        pool.apply_store(h, hslot, evicted,
+                         values["k"][:, :, i], values["v"][:, :, i])
+
+
+def exec_host_restore_event(kv, ev: dict, pool, block_size: int):
+    """Re-execute a host-tier h2d restore from the mirror ``pool``: same
+    slots, same device targets, same scatter program as the leader's
+    admission. Single home of the hit_transfer host path (see
+    exec_kv_store_event). Returns the new kv."""
+    from .block_copy import prep_host_values, scatter_prepped
+
+    ids, vals = prep_host_values(list(ev["host_targets"]),
+                                 pool.fetch(list(ev["host_slots"])))
+    return scatter_prepped(kv, ids, vals, block_size)
+
+
 def exec_dispatch_event(core, kv, ev: dict, chain):
     """Issue the recorded K-step decode dispatch against `kv`. ``chain`` is
     the chained-from dispatch's [K, B] device tokens (None when host-fed).
@@ -129,6 +156,9 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                              core.cfg.kv_block_size, dtype=dtype)
     out = {"prefill": {}, "dispatch": {}, "fingerprints": []}
     disp_toks: Dict[int, object] = {}
+    mirror = None          # host-tier mirror pool, built from kv_store
+    # events exactly like a multihost follower's (engine/multihost.py):
+    # gather the SAME blocks from the replay KV, apply literal placements
     # pool slots written by in-log prefills/dispatches: a prefix hit whose
     # blocks were registered BEFORE recording began has no in-log writer —
     # the fresh replay KV holds zeros there and every downstream compare
@@ -153,18 +183,48 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                 f"({ev.get('path')}, rid={ev.get('rid')}); replay would "
                 f"silently diverge — record only runs without disagg "
                 f"onboarding")
+        if kind == "kv_store":
+            from ..llm.kv.offload import HostKvPool
+            if mirror is None:
+                if core.cfg.host_kv_blocks <= 0:
+                    raise NotImplementedError(
+                        "the record offloaded to a host tier but the "
+                        "replaying core has host_kv_blocks=0 — replay "
+                        "with the recorded engine config")
+                mirror = HostKvPool(
+                    core.cfg.host_kv_blocks, core.model_cfg.num_layers,
+                    core.model_cfg.num_kv_heads, bs,
+                    core.model_cfg.head_dim, dtype=dtype)
+            top = max(it[1] for it in ev["items"])
+            if top >= core.cfg.host_kv_blocks:
+                raise NotImplementedError(
+                    f"recorded host-pool slot {top} exceeds this core's "
+                    f"host_kv_blocks={core.cfg.host_kv_blocks} — replay "
+                    f"with the recorded engine config")
+            for b in (int(it[3]) for it in ev["items"]):
+                for o in range(bs):
+                    if b * bs + o not in written:
+                        raise NotImplementedError(
+                            f"kv_store gathers block {b} with no in-log "
+                            f"writer — its content predates the "
+                            f"recording; start recording before any "
+                            f"blocks are stored")
+            exec_kv_store_event(kv, ev, mirror, bs)
         if kind == "hit_transfer" and int(ev.get("hit", 0)) > 0:
             if int(ev.get("host_hit", 0)) > 0:
-                # host-tier hits scatter offloaded content back to device
-                # (core scatter_blocks_from_host) — a write replay cannot
-                # re-execute, and the in-log-writer check below can't see:
-                # the reused target blocks may have a PRIOR in-log writer
-                # whose stale values the replay KV would still hold
-                raise NotImplementedError(
-                    f"prefix hit for rid={ev.get('rid')} includes "
-                    f"{ev['host_hit']} host-restored tokens; the h2d "
-                    f"restore is not replayable — disable host offload "
-                    f"when recording")
+                # host-tier hit: replay the h2d restore from the mirror
+                # (exactly the follower's path); the restored target
+                # blocks gain an in-log writer for the check below
+                if mirror is None:
+                    raise NotImplementedError(
+                        f"host-restored hit for rid={ev.get('rid')} with "
+                        f"no prior kv_store in the log — the offloads "
+                        f"happened before recording began")
+                kv = exec_host_restore_event(kv, ev, mirror, bs)
+                written.update(int(b) * bs + o
+                               for b in ev["host_targets"]
+                               for o in range(bs))
+                fp(("host_restore", ev.get("rid")))
             table = list(ev["blocks"])
             for p in range(int(ev["hit"])):
                 ps = table[p // bs] * bs + p % bs

@@ -84,3 +84,36 @@ async def test_checker_flags_synthetic_stale_read():
     stale = check_log(events, block_size=8)
     assert stale, "synthetic cross-request read not flagged"
     assert stale[0].rid == "b" and stale[0].writer == "a"
+
+
+async def test_host_tier_run_replays_bit_exact():
+    """A recorded run that offloads to the host tier and later restores
+    from it replays bit-exactly: the replayer maintains a mirror pool
+    from kv_store events (gathering from its own replay KV, exactly the
+    multihost follower's logic) and re-applies the h2d restore."""
+    ecfg = EngineConfig(max_model_len=256, kv_block_size=8,
+                        num_kv_blocks=32, max_num_seqs=2,
+                        prefill_buckets=[32, 64],
+                        decode_steps_per_dispatch=4,
+                        host_kv_blocks=16)
+    core = EngineCore(TINY, ecfg, attn_impl="xla", param_dtype=jnp.float32)
+    core.recorder = Recorder()
+    prompt = list(range(1, 25))                  # 3 full blocks at bs=8
+    try:
+        t1 = await _run(core, prompt, "a", max_new=4)
+        await core.offload_engine.drain()
+        assert core.offload_engine.offloaded_blocks_total >= 2
+        core.kv_manager.pool.reset()             # force the host tier
+        t2 = await _run(core, prompt, "b", max_new=4)
+        assert core.host_onboards == 1
+        assert t2 == t1
+    finally:
+        await core.stop()
+    events = core.recorder.events
+    kinds = [e["ev"] for e in events]
+    assert "kv_store" in kinds
+    host_hits = [e for e in events if e["ev"] == "hit_transfer"
+                 and int(e.get("host_hit", 0)) > 0]
+    assert host_hits, kinds
+    rep = replay(core, events)
+    assert compare_replay(events, rep) == []
