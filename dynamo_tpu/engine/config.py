@@ -75,6 +75,24 @@ class ModelConfig:
             # via the gemma defaults would load garbage silently
             raise ValueError(f"unsupported gemma variant {mt!r} "
                              "(gemma and gemma2 are implemented)")
+        if mt == "qwen3_moe" and not cfg.get("norm_topk_prob", False):
+            # moe_mlp implements the normalized (mixtral-equivalent)
+            # routing convention; softmax-then-topk WITHOUT renorm is a
+            # different function and would decode garbage silently. HF's
+            # Qwen3MoeConfig DEFAULTS the key to false, so an absent key
+            # must reject too (released checkpoints set it true).
+            raise ValueError("qwen3_moe requires norm_topk_prob=true "
+                             "(routing weights must renormalize over "
+                             "the top-k)")
+        if mt == "qwen3_moe" and (cfg.get("mlp_only_layers")
+                                  or int(cfg.get("decoder_sparse_step",
+                                                 1) or 1) > 1):
+            # hybrid dense/sparse layer mixes cannot be represented by
+            # the uniform stacked expert tensors; failing here beats a
+            # misleading "checkpoint missing experts" later
+            raise ValueError("qwen3_moe hybrid sparsity (mlp_only_layers "
+                             "/ decoder_sparse_step > 1) is not supported "
+                             "— every layer must be sparse")
         n_heads = int(cfg.get("num_attention_heads", 32))
         hidden = int(cfg.get("hidden_size", 4096))
         rs = None
@@ -92,7 +110,13 @@ class ModelConfig:
             model_type=cfg.get("model_type", "llama"),
             vocab_size=int(cfg.get("vocab_size", 32000)),
             hidden_size=hidden,
-            intermediate_size=int(cfg.get("intermediate_size", 4 * hidden)),
+            # qwen3-moe sizes the EXPERT mlps by moe_intermediate_size;
+            # our stacked expert tensors use intermediate_size for F
+            intermediate_size=int(
+                (cfg.get("moe_intermediate_size")
+                 if cfg.get("moe_intermediate_size")
+                 and int(cfg.get("num_experts", 0) or 0) > 0
+                 else cfg.get("intermediate_size", 4 * hidden))),
             num_layers=int(cfg.get("num_hidden_layers", 32)),
             num_heads=n_heads,
             num_kv_heads=int(cfg.get("num_key_value_heads", n_heads)),
@@ -109,7 +133,8 @@ class ModelConfig:
             num_experts=int(cfg.get("num_local_experts", 0) or
                             cfg.get("num_experts", 0) or 0),
             num_experts_per_tok=int(cfg.get("num_experts_per_tok", 2)),
-            qk_norm=bool(cfg.get("qk_norm", cfg.get("model_type") == "qwen3")),
+            qk_norm=bool(cfg.get("qk_norm", cfg.get("model_type")
+                         in ("qwen3", "qwen3_moe"))),
             # hidden_activation is authoritative when present; gemma-1 hub
             # configs ship a stale hidden_act="gelu" that HF itself
             # overrides to the tanh-approx gelu at runtime

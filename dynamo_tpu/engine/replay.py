@@ -159,6 +159,7 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
     mirror = None          # host-tier mirror pool, built from kv_store
     # events exactly like a multihost follower's (engine/multihost.py):
     # gather the SAME blocks from the replay KV, apply literal placements
+    mirrored_slots: set = set()   # host slots with an IN-LOG store
     # pool slots written by in-log prefills/dispatches: a prefix hit whose
     # blocks were registered BEFORE recording began has no in-log writer —
     # the fresh replay KV holds zeros there and every downstream compare
@@ -210,16 +211,21 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                             f"recording; start recording before any "
                             f"blocks are stored")
             exec_kv_store_event(kv, ev, mirror, bs)
+            mirrored_slots.update(int(it[1]) for it in ev["items"])
         if kind == "hit_transfer" and int(ev.get("hit", 0)) > 0:
             if int(ev.get("host_hit", 0)) > 0:
                 # host-tier hit: replay the h2d restore from the mirror
                 # (exactly the follower's path); the restored target
                 # blocks gain an in-log writer for the check below
-                if mirror is None:
+                missing_slots = [s for s in ev["host_slots"]
+                                 if s not in mirrored_slots]
+                if mirror is None or missing_slots:
                     raise NotImplementedError(
-                        f"host-restored hit for rid={ev.get('rid')} with "
-                        f"no prior kv_store in the log — the offloads "
-                        f"happened before recording began")
+                        f"host-restored hit for rid={ev.get('rid')} "
+                        f"references host slots {missing_slots[:4]} with "
+                        f"no in-log kv_store — those offloads happened "
+                        f"before recording began; the mirror would "
+                        f"scatter zeros and report phantom divergence")
                 kv = exec_host_restore_event(kv, ev, mirror, bs)
                 written.update(int(b) * bs + o
                                for b in ev["host_targets"]

@@ -45,10 +45,19 @@ _LAYER_MAP = {
     "self_attn.k_norm.weight": ("k_norm", False),
     # mixtral MoE router
     "block_sparse_moe.gate.weight": ("router", True),
+    # qwen3-moe router (same role, different HF naming; the expert
+    # tensors live under mlp.experts.{e}.*_proj — see _EXPERT_PREFIXES)
+    "mlp.gate.weight": ("router", True),
 }
 
 # mixtral expert sub-weights: w1=gate, w3=up, w2=down (all torch [out, in])
-_EXPERT_MAP = {"w1": "moe_gate", "w3": "moe_up", "w2": "moe_down"}
+_EXPERT_MAP = {"w1": "moe_gate", "w3": "moe_up", "w2": "moe_down",
+               # qwen3-moe naming for the same three matmuls
+               "gate_proj": "moe_gate", "up_proj": "moe_up",
+               "down_proj": "moe_down"}
+
+# per-family expert tensor prefixes under model.layers.{i}.
+_EXPERT_PREFIXES = ("block_sparse_moe.experts.", "mlp.experts.")
 
 
 def _layer_map_for(cfg: ModelConfig) -> Dict[str, tuple]:
@@ -107,10 +116,12 @@ def load_llama_params(model_dir: str, cfg: Optional[ModelConfig] = None,
         elif name.startswith("model.layers."):
             rest = name[len("model.layers."):]
             idx_str, sub = rest.split(".", 1)
-            if sub.startswith("block_sparse_moe.experts."):
-                # block_sparse_moe.experts.{e}.w{1,2,3}.weight
-                e_str, wname, _ = sub[len("block_sparse_moe.experts."):].split(
-                    ".", 2)
+            expert_prefix = next(
+                (p for p in _EXPERT_PREFIXES if sub.startswith(p)), None)
+            if expert_prefix is not None:
+                # {prefix}{e}.w{1,2,3}.weight (mixtral) or
+                # {prefix}{e}.{gate,up,down}_proj.weight (qwen3-moe)
+                e_str, wname, _ = sub[len(expert_prefix):].split(".", 2)
                 key = _EXPERT_MAP.get(wname)
                 if key is None:
                     continue
@@ -191,9 +202,15 @@ def load_llama_params_sharded(model_dir: str, mesh,
             for name in f.keys():
                 where[name] = f
 
-        by_key = {key: (suffix, transpose)     # "wq" → (hf_suffix, T?)
-                  for suffix, (key, transpose)
-                  in _layer_map_for(cfg).items()}
+        # "wq" → [(hf_suffix, T?), ...]: some keys have per-family HF
+        # namings (router: mixtral block_sparse_moe.gate vs qwen3-moe
+        # mlp.gate) — resolve by whichever name the checkpoint contains.
+        # No MoE sharded load SUCCEEDS (layers.moe_* raises guidance
+        # below), but resolving the router by presence lets BOTH families
+        # reach that guidance instead of a bogus missing-layers error
+        by_key: Dict[str, list] = {}
+        for suffix, (key, transpose) in _layer_map_for(cfg).items():
+            by_key.setdefault(key, []).append((suffix, transpose))
         singles = {"embed": ("model.embed_tokens.weight", False),
                    "final_norm": ("model.norm.weight", False),
                    "lm_head": ("lm_head.weight", True)}
@@ -229,7 +246,10 @@ def load_llama_params_sharded(model_dir: str, mesh,
                     shape, sharding, cb)
                 continue
             if pkey.startswith("layers.") and pkey[7:] in by_key:
-                suffix, transpose = by_key[pkey[7:]]
+                cands = by_key[pkey[7:]]
+                suffix, transpose = next(
+                    (c for c in cands
+                     if f"model.layers.0.{c[0]}" in where), cands[0])
                 names = [f"model.layers.{i}.{suffix}" for i in range(L)]
                 if any(n not in where for n in names):
                     missing = [i for i, n in enumerate(names)
@@ -290,7 +310,18 @@ def save_hf_style(params: Dict[str, jax.Array], cfg: ModelConfig,
         inv["ln1_post"] = ("post_attention_layernorm.weight", False)
         inv["ln2"] = ("pre_feedforward_layernorm.weight", False)
         inv["ln2_post"] = ("post_feedforward_layernorm.weight", False)
-    inv_experts = {v: k for k, v in _EXPERT_MAP.items()}
+    # two HF namings map to "router"/each expert matmul (mixtral vs
+    # qwen3-moe); saving must pick the family's names explicitly
+    if cfg.model_type == "qwen3_moe":
+        inv["router"] = ("mlp.gate.weight", True)
+        inv_experts = {"moe_gate": "gate_proj", "moe_up": "up_proj",
+                       "moe_down": "down_proj"}
+        expert_prefix = "mlp.experts."
+    else:
+        inv["router"] = ("block_sparse_moe.gate.weight", True)
+        inv_experts = {"moe_gate": "w1", "moe_up": "w3",
+                       "moe_down": "w2"}
+        expert_prefix = "block_sparse_moe.experts."
     for key, (hf_sub, transpose) in inv.items():
         if f"layers.{key}" not in params:
             continue
@@ -305,7 +336,7 @@ def save_hf_style(params: Dict[str, jax.Array], cfg: ModelConfig,
         stacked = np.asarray(params[f"layers.{key}"], np.float32)  # [L,E,..]
         for i in range(stacked.shape[0]):
             for e in range(stacked.shape[1]):
-                out[(f"model.layers.{i}.block_sparse_moe.experts."
+                out[(f"model.layers.{i}.{expert_prefix}"
                      f"{e}.{wname}.weight")] = np.ascontiguousarray(
                          stacked[i, e].T)
     save_file(out, os.path.join(out_dir, "model.safetensors"))

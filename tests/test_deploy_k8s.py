@@ -41,8 +41,13 @@ cmd = args[0]
 if cmd == "apply":
     body = json.load(sys.stdin)
     name = body["metadata"]["name"]
-    with open(os.path.join(PODS, name + ".json"), "w") as f:
+    # atomic publish: a concurrent `get` (controller poll) must never
+    # see a half-written file (flaked under full-suite host contention)
+    dest = os.path.join(PODS, name + ".json")
+    tmp = dest + ".tmp." + str(os.getpid())
+    with open(tmp, "w") as f:
         json.dump({{"phase": "Running", "manifest": body}}, f)
+    os.replace(tmp, dest)
     print(f"pod/{{name}} created")
 elif cmd == "get":
     name = args[2]
@@ -84,7 +89,9 @@ def set_phase(state, name, phase):
     p = os.path.join(state, "pods", name + ".json")
     d = json.load(open(p))
     d["phase"] = phase
-    json.dump(d, open(p, "w"))
+    tmp = p + ".tmp"
+    json.dump(d, open(tmp, "w"))
+    os.replace(tmp, p)
 
 
 async def wait_for(pred, timeout=45.0, what=""):
