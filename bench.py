@@ -13,7 +13,7 @@ driver has a consistent scalar across rounds.
 Env knobs: BENCH_BATCH (default 128 — post-KV-carry-fix scaling on v5e:
 B=64 ≈ 10.3k, B=128 ≈ 14.7k, B=256 ≈ 15.9k tok/s/chip int8; 128 balances
 throughput against ~9 ms ITL), BENCH_STEPS (128), BENCH_PROMPT (128),
-BENCH_MODEL (1b|tiny|8b — 8b is Llama-3-8B geometry, random weights; at
+BENCH_MODEL (1b|tiny|8b|moe — 8b is Llama-3-8B geometry, random weights; at
 int8 the weights are ~8 GB of the 16 GB HBM, so pick BENCH_BATCH/LEN so
 KV fits: B=64 with default lengths, B=128 with BENCH_HARVEST<=8),
 BENCH_ATTN (auto|pallas|xla), BENCH_HARVEST (default
@@ -315,6 +315,18 @@ def main() -> None:
                            num_heads=32, num_kv_heads=8, head_dim=128,
                            max_position_embeddings=8192,
                            rope_theta=500000.0)
+    elif model == "moe":
+        # synthetic mixtral-class geometry sized for one 16 GB chip
+        # (~4.7 GB int8: 16L x 8 experts x [2048 x 5632] x 3 + attn):
+        # times the dense-over-experts int8 einsum path (engine quant +
+        # models/llama.py moe_mlp) that serves mixtral/qwen3-moe — the
+        # only MoE decode datapoint one chip can produce
+        mcfg = ModelConfig(model_type="mixtral", vocab_size=32000,
+                           hidden_size=2048, intermediate_size=5632,
+                           num_layers=16, num_heads=32, num_kv_heads=8,
+                           head_dim=64, max_position_embeddings=8192,
+                           rope_theta=500000.0, num_experts=8,
+                           num_experts_per_tok=2)
     else:  # llama-3.2-1B shapes
         mcfg = ModelConfig(vocab_size=128256, hidden_size=2048,
                            intermediate_size=8192, num_layers=16,
@@ -451,8 +463,9 @@ def main() -> None:
         device_extra.update(device_prefill_timing(
             core, prompt_len, last_prefill_args))
 
+    family = "mixtral_" if model == "moe" else "llama"
     result = {
-        "metric": (f"decode_tok_per_s_chip_llama{model}_b{batch}"
+        "metric": (f"decode_tok_per_s_chip_{family}{model}_b{batch}"
                    + ("" if quant == "none" else f"_{quant}")),
         "value": round(tok_per_s, 1),
         "unit": "tok/s/chip",

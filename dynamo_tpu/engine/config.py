@@ -75,6 +75,15 @@ class ModelConfig:
             # via the gemma defaults would load garbage silently
             raise ValueError(f"unsupported gemma variant {mt!r} "
                              "(gemma and gemma2 are implemented)")
+        if (mt in ("qwen2_moe", "deepseek_v2", "deepseek_v3")
+                or cfg.get("shared_expert_intermediate_size")):
+            # shared-expert MoE families: the generic expert-name matching
+            # would load the routed experts and silently DROP the shared
+            # expert — garbage logits with no error; reject loudly instead
+            raise ValueError(
+                f"unsupported MoE family {mt!r} (shared-expert "
+                f"architectures are not implemented; mixtral and "
+                f"qwen3_moe are)")
         if mt == "qwen3_moe" and not cfg.get("norm_topk_prob", False):
             # moe_mlp implements the normalized (mixtral-equivalent)
             # routing convention; softmax-then-topk WITHOUT renorm is a

@@ -291,9 +291,24 @@ def test_qwen3_moe_config_and_weights_roundtrip(qwen3_moe_params, tmp_path):
         np.testing.assert_allclose(np.asarray(loaded[k]), np.asarray(v),
                                    rtol=1e-6, atol=1e-6, err_msg=k)
 
-    import copy
     bad = json.loads((tmp_path / "config.json").read_text())
     bad["norm_topk_prob"] = False
     (tmp_path / "config.json").write_text(json.dumps(bad))
     with pytest.raises(ValueError, match="norm_topk_prob"):
         ModelConfig.from_model_dir(str(tmp_path))
+
+
+def test_shared_expert_moe_families_rejected():
+    """qwen2-moe-class checkpoints carry a shared expert the generic
+    expert matching would silently drop — from_hf_config must reject
+    them loudly rather than load garbage."""
+    with pytest.raises(ValueError, match="shared-expert"):
+        ModelConfig.from_hf_config({
+            "model_type": "qwen2_moe", "vocab_size": 128,
+            "hidden_size": 64, "num_attention_heads": 4,
+            "num_experts": 4, "moe_intermediate_size": 96})
+    with pytest.raises(ValueError, match="shared-expert"):
+        ModelConfig.from_hf_config({
+            "model_type": "mystery_moe", "vocab_size": 128,
+            "hidden_size": 64, "num_attention_heads": 4,
+            "shared_expert_intermediate_size": 128})
