@@ -281,7 +281,7 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.core import EngineCore
     from dynamo_tpu.engine.models import llama
     from dynamo_tpu.engine.sampling import make_slot_keys
@@ -300,39 +300,12 @@ def main() -> None:
     # device-side slope timing (adds ~9 extra chained dispatches)
     device_time = os.environ.get("BENCH_DEVICE", "1") != "0"
 
-    if model == "tiny":
-        mcfg = ModelConfig(vocab_size=2048, hidden_size=256,
-                           intermediate_size=512, num_layers=4, num_heads=8,
-                           num_kv_heads=4, head_dim=32,
-                           max_position_embeddings=2048)
-    elif model == "8b":
-        # Llama-3-8B geometry (BASELINE.md config 2): the largest real
-        # on-chip datapoint one v5e can produce — int8 weights ≈ 8 GB
-        # against 16 GB HBM — anchoring the 70B TP-8 extrapolation with
-        # an HBM-bound measurement instead of the 1B compute-light one
-        mcfg = ModelConfig(vocab_size=128256, hidden_size=4096,
-                           intermediate_size=14336, num_layers=32,
-                           num_heads=32, num_kv_heads=8, head_dim=128,
-                           max_position_embeddings=8192,
-                           rope_theta=500000.0)
-    elif model == "moe":
-        # synthetic mixtral-class geometry sized for one 16 GB chip
-        # (~4.7 GB int8: 16L x 8 experts x [2048 x 5632] x 3 + attn):
-        # times the dense-over-experts int8 einsum path (engine quant +
-        # models/llama.py moe_mlp) that serves mixtral/qwen3-moe — the
-        # only MoE decode datapoint one chip can produce
-        mcfg = ModelConfig(model_type="mixtral", vocab_size=32000,
-                           hidden_size=2048, intermediate_size=5632,
-                           num_layers=16, num_heads=32, num_kv_heads=8,
-                           head_dim=64, max_position_embeddings=8192,
-                           rope_theta=500000.0, num_experts=8,
-                           num_experts_per_tok=2)
-    else:  # llama-3.2-1B shapes
-        mcfg = ModelConfig(vocab_size=128256, hidden_size=2048,
-                           intermediate_size=8192, num_layers=16,
-                           num_heads=32, num_kv_heads=8, head_dim=64,
-                           max_position_embeddings=4096,
-                           rope_theta=500000.0, tie_word_embeddings=True)
+    # geometry table shared with tools/decode_profile.py — ONE home
+    # (dynamo_tpu/engine/config.py bench_model_config). 8b anchors the
+    # 70B TP-8 extrapolation (BASELINE.md config 2); moe times the
+    # dense-over-experts int8 einsum path serving mixtral/qwen3-moe.
+    from dynamo_tpu.engine.config import bench_model_config
+    mcfg = bench_model_config(model)
     # budget: timed steps + the untimed compile dispatch (harvest tokens)
     # + the device-timing chains (1+2·(2+6) = 17 extra dispatches of K)
     max_len = prompt_len + steps + harvest * (18 if device_time else 1) + 64

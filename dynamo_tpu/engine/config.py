@@ -175,6 +175,39 @@ class ModelConfig:
             return cls.from_hf_config(json.load(f))
 
 
+def bench_model_config(name: str) -> "ModelConfig":
+    """The benchmark geometries, in ONE place so bench.py and
+    tools/decode_profile.py measure the same model (they drifted when
+    each carried its own literals). Unknown names raise — a typo must
+    not silently profile the 1B fallback under the requested label."""
+    if name == "tiny":
+        return ModelConfig(vocab_size=2048, hidden_size=256,
+                           intermediate_size=512, num_layers=4,
+                           num_heads=8, num_kv_heads=4, head_dim=32,
+                           max_position_embeddings=2048)
+    if name == "1b":     # llama-3.2-1B shapes
+        return ModelConfig(vocab_size=128256, hidden_size=2048,
+                           intermediate_size=8192, num_layers=16,
+                           num_heads=32, num_kv_heads=8, head_dim=64,
+                           max_position_embeddings=4096,
+                           rope_theta=500000.0, tie_word_embeddings=True)
+    if name == "8b":     # Llama-3-8B geometry (int8 ≈ 8 GB)
+        return ModelConfig(vocab_size=128256, hidden_size=4096,
+                           intermediate_size=14336, num_layers=32,
+                           num_heads=32, num_kv_heads=8, head_dim=128,
+                           max_position_embeddings=8192,
+                           rope_theta=500000.0)
+    if name == "moe":    # synthetic mixtral-class, one-chip (~4.7 GB)
+        return ModelConfig(model_type="mixtral", vocab_size=32000,
+                           hidden_size=2048, intermediate_size=5632,
+                           num_layers=16, num_heads=32, num_kv_heads=8,
+                           head_dim=64, max_position_embeddings=8192,
+                           rope_theta=500000.0, num_experts=8,
+                           num_experts_per_tok=2)
+    raise ValueError(f"unknown bench model {name!r} "
+                     f"(tiny|1b|8b|moe)")
+
+
 @dataclasses.dataclass
 class EngineConfig:
     """Serving-engine knobs (the analog of the reference's engine flags,

@@ -13,8 +13,11 @@ Components:
                 on pool-shaped inputs)
 
 Usage: python tools/decode_profile.py [batch ...]   (default 16 64 128)
-Env: PROF_QUANT (int8|none, default int8), PROF_SEQ (kv len, default 512),
-     PROF_ATTN (auto|pallas|xla).
+Env: PROF_MODEL (1b|8b — 8b weighs ~8 GB int8, so pass explicit batches
+     that keep batch*(seq+256) KV inside the remaining HBM: B<=32 at
+     seq 512 with bf16 KV; the 1b default batch list OOMs at 8b),
+     PROF_QUANT (int8|none, default int8), PROF_SEQ (kv len, default
+     512), PROF_ATTN (auto|pallas|xla).
 """
 
 import os
@@ -46,7 +49,7 @@ def main():
     import jax.numpy as jnp
     from functools import partial
 
-    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.config import EngineConfig
     from dynamo_tpu.engine.core import EngineCore
     from dynamo_tpu.engine.models import llama
     from dynamo_tpu.engine.sampling import make_slot_keys, sample_tokens
@@ -55,14 +58,14 @@ def main():
     quant = os.environ.get("PROF_QUANT", "int8")
     seq = int(os.environ.get("PROF_SEQ", "512"))
     attn_impl = os.environ.get("PROF_ATTN", "auto")
+    model = os.environ.get("PROF_MODEL", "1b")
 
-    mcfg = ModelConfig(vocab_size=128256, hidden_size=2048,
-                       intermediate_size=8192, num_layers=16,
-                       num_heads=32, num_kv_heads=8, head_dim=64,
-                       max_position_embeddings=8192,
-                       rope_theta=500000.0, tie_word_embeddings=True)
+    # geometry shared with bench.py (ONE home; unknown names raise —
+    # no silent 1B fallback under a mislabeled header)
+    from dynamo_tpu.engine.config import bench_model_config
+    mcfg = bench_model_config(model)
     dev = jax.devices()[0]
-    print(f"# {dev.platform}:{dev.device_kind} quant={quant} seq={seq} "
+    print(f"# {dev.platform}:{dev.device_kind} model={model} quant={quant} seq={seq} "
           f"attn={attn_impl}", file=sys.stderr)
 
     for batch in batches:
