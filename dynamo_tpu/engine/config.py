@@ -186,15 +186,35 @@ def bench_model_config(name: str) -> "ModelConfig":
                            num_heads=8, num_kv_heads=4, head_dim=32,
                            max_position_embeddings=2048)
     if name == "1b":     # llama-3.2-1B shapes
+        # 8192 positions (not the model's real 131k): the shared bench
+        # geometry must cover tools/decode_profile.py's long-context
+        # sweeps (PROF_SEQ up to ~8K) — 4096 silently capped them once
+        # (ADVICE r3). RoPE-table cost at 8192 is negligible.
         return ModelConfig(vocab_size=128256, hidden_size=2048,
                            intermediate_size=8192, num_layers=16,
                            num_heads=32, num_kv_heads=8, head_dim=64,
-                           max_position_embeddings=4096,
+                           max_position_embeddings=8192,
                            rope_theta=500000.0, tie_word_embeddings=True)
     if name == "8b":     # Llama-3-8B geometry (int8 ≈ 8 GB)
         return ModelConfig(vocab_size=128256, hidden_size=4096,
                            intermediate_size=14336, num_layers=32,
                            num_heads=32, num_kv_heads=8, head_dim=128,
+                           max_position_embeddings=8192,
+                           rope_theta=500000.0)
+    if name == "70b_tp8shard":
+        # The slice of Llama-3-70B (80L, D=8192, F=28672, H=64, KVH=8,
+        # Dh=128, V=128256) that ONE chip owns under the production TP-8
+        # pspecs (parallel/sharding.py param_pspecs: column-parallel
+        # qkv/gate/up, row-parallel o/down, vocab-sharded embed+head):
+        # 8 q heads, 1 kv head, F/8=3584, V/8=16032, full hidden — ≈8.9 GB
+        # int8, the real per-chip HBM working set of the BASELINE.md
+        # config-4 north star. Benching this geometry on the one real chip
+        # measures the per-chip compute+HBM side of TP-8 decode; the
+        # per-layer ICI collectives are priced separately
+        # (parallel/ici_model.py) and bench.py reports the net number.
+        return ModelConfig(vocab_size=16032, hidden_size=8192,
+                           intermediate_size=3584, num_layers=80,
+                           num_heads=8, num_kv_heads=1, head_dim=128,
                            max_position_embeddings=8192,
                            rope_theta=500000.0)
     if name == "moe":    # synthetic mixtral-class, one-chip (~4.7 GB)
@@ -205,7 +225,7 @@ def bench_model_config(name: str) -> "ModelConfig":
                            rope_theta=500000.0, num_experts=8,
                            num_experts_per_tok=2)
     raise ValueError(f"unknown bench model {name!r} "
-                     f"(tiny|1b|8b|moe)")
+                     f"(tiny|1b|8b|70b_tp8shard|moe)")
 
 
 @dataclasses.dataclass

@@ -217,6 +217,13 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                 # host-tier hit: replay the h2d restore from the mirror
                 # (exactly the follower's path); the restored target
                 # blocks gain an in-log writer for the check below
+                if ev.get("host_slots") is None or \
+                        ev.get("host_targets") is None:
+                    raise NotImplementedError(
+                        f"host-restored hit for rid={ev.get('rid')} has "
+                        f"no host_slots/host_targets — this log was "
+                        f"recorded by a pre-r3 engine; host restores "
+                        f"are not replayable for that log version")
                 missing_slots = [s for s in ev["host_slots"]
                                  if s not in mirrored_slots]
                 if mirror is None or missing_slots:

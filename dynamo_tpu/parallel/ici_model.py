@@ -1,0 +1,51 @@
+"""Analytic ICI collective costs for TP decode on v5e.
+
+Why a model and not a measurement: this rig has ONE real chip (the
+multi-chip path is validated on a virtual CPU mesh, which says nothing
+about ICI time), so the TP-8 north-star number (BASELINE.md config 4)
+must price the per-layer collectives explicitly. The reference faces the
+same structural cost in NCCL all-reduces inside its engines' TP groups
+(SURVEY.md §2.3 parallelism inventory); here the collectives are XLA
+psums over ICI inserted by the row-parallel matmul pspecs
+(parallel/sharding.py param_pspecs).
+
+Link assumptions (public v5e specs, the same source as bench.py's
+DEVICE_PEAKS): 1600 Gbps ICI per chip aggregate across 4 links = 200 GB/s
+bidirectional. A ring all-reduce moves 2·(N-1)/N·bytes per chip; with
+bidirectional links the effective per-chip throughput is half the
+aggregate. We take 100 GB/s effective and add a per-collective latency
+term (~5 us: a few hops of us-scale link latency + dispatch). Both are
+deliberately conservative: XLA's async collectives overlap much of this
+with the next layer's compute on real meshes, and a 2D torus can ride two
+axes at once — the model books the FULL serial cost.
+
+What TP-8 decode pays per step (Megatron layout, per param_pspecs):
+  - per layer: 2 all-reduces of the [B, D] bf16 activations (after the
+    row-parallel attention out-proj and MLP down-proj);
+  - embed: 1 all-reduce of [B, D] (vocab-sharded table gather + psum);
+  - sampling over the vocab-sharded logits: per-shard top-k/Gumbel then a
+    max-reduce of (value, index) pairs — O(B·k) bytes, booked in the
+    latency term (it is orders of magnitude below the [B, D] psums).
+"""
+
+from __future__ import annotations
+
+V5E_ICI_EFFECTIVE_GBPS = 100e9      # per-chip effective all-reduce GB/s
+COLLECTIVE_LATENCY_S = 5e-6         # per-collective fixed cost
+
+
+def allreduce_s(nbytes: int, n_chips: int,
+                eff_bw: float = V5E_ICI_EFFECTIVE_GBPS) -> float:
+    """Ring all-reduce wall time for one [nbytes] buffer over n_chips."""
+    if n_chips <= 1:
+        return 0.0
+    return (2.0 * nbytes * (n_chips - 1) / n_chips / eff_bw
+            + COLLECTIVE_LATENCY_S)
+
+
+def tp_decode_step_s(batch: int, hidden: int, num_layers: int,
+                     n_chips: int, act_itemsize: int = 2) -> float:
+    """Total modeled ICI time one TP-sharded decode step spends in
+    collectives: 2 [B, D] psums per layer + 1 for the embedding."""
+    per = allreduce_s(batch * hidden * act_itemsize, n_chips)
+    return (2 * num_layers + 1) * per

@@ -64,6 +64,15 @@ def main():
     # no silent 1B fallback under a mislabeled header)
     from dynamo_tpu.engine.config import bench_model_config
     mcfg = bench_model_config(model)
+    if seq >= mcfg.max_position_embeddings:
+        # positions stay pinned at `seq` throughout the profile chains
+        # (the fori body never advances them), so the only alias hazard
+        # is the decode position itself falling past the RoPE table
+        raise SystemExit(
+            f"PROF_SEQ={seq} >= the {model!r} geometry's "
+            f"max_position_embeddings={mcfg.max_position_embeddings}; "
+            f"the decode position would silently alias past the RoPE "
+            f"table (ADVICE r3). Use a geometry that covers the sweep.")
     dev = jax.devices()[0]
     print(f"# {dev.platform}:{dev.device_kind} model={model} quant={quant} seq={seq} "
           f"attn={attn_impl}", file=sys.stderr)
