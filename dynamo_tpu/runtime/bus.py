@@ -130,6 +130,7 @@ class _MemoryWorkQueue(WorkQueue):
         self._next_id = 1
         self._ready: List[WorkItem] = []
         self._pending: Dict[int, Tuple[WorkItem, float]] = {}  # id → (item, deadline)
+        self._restored: set = set()   # ids recovery put back as pending
         self._event = asyncio.Event()
 
     def _redeliver_due(self) -> None:
@@ -171,6 +172,14 @@ class _MemoryWorkQueue(WorkQueue):
 
     async def ack(self, item_id: int) -> None:
         self._pending.pop(item_id, None)
+        # after a daemon restart a consumer may ack an item the recovery
+        # path restored as PENDING — the ack must still retire it or the
+        # completed item would be redelivered. Only restored ids can be
+        # acked out of _ready, so the O(depth) scrub is restart-only and
+        # steady-state acks stay O(1).
+        if item_id in self._restored:
+            self._restored.discard(item_id)
+            self._ready = [it for it in self._ready if it.id != item_id]
 
     async def nack(self, item_id: int) -> None:
         got = self._pending.pop(item_id, None)
@@ -183,6 +192,29 @@ class _MemoryWorkQueue(WorkQueue):
     async def depth(self) -> int:
         self._redeliver_due()
         return len(self._ready)
+
+    # ---------------------------------------------- durability (wal.py)
+    def restore_item(self, iid: int, payload: bytes,
+                     deliveries: int = 1) -> None:
+        """Re-materialize a persisted item as PENDING with its original id
+        (so later wq_ack WAL records and consumer-side dedup still match).
+        Delivered-but-unacked items come back this way too — at-least-once
+        redelivery, the JetStream work-queue semantic. ``deliveries``
+        defaults to 1 to match a fresh enqueue (the WAL replay path cannot
+        know the true count; under-reporting 0 would let a poison item
+        dodge consumers' MAX_DELIVERIES guards across restart cycles)."""
+        self._ready.append(WorkItem(iid, payload, deliveries))
+        self._restored.add(iid)
+        self._next_id = max(self._next_id, iid + 1)
+        self._event.set()
+
+    def dump_items(self) -> list:
+        """Pending + in-flight items (in-flight fold back to pending)."""
+        import base64
+        self._redeliver_due()
+        items = list(self._ready) + [it for it, _ in self._pending.values()]
+        return [[it.id, base64.b64encode(it.payload).decode(), it.deliveries]
+                for it in items]
 
 
 class MemoryBus(MessageBus):
@@ -241,3 +273,18 @@ class MemoryBus(MessageBus):
         if q is None:
             q = self._queues[name] = _MemoryWorkQueue()
         return q
+
+    # ---------------------------------------------- durability (wal.py)
+    def dump_state(self) -> dict:
+        """JSON-able snapshot of the work queues (the bus's only durable
+        state — pub/sub and served subjects are connection-scoped)."""
+        return {"queues": {name: q.dump_items()
+                           for name, q in self._queues.items()}}
+
+    async def restore_state(self, state: dict) -> None:
+        import base64
+        for name, items in state.get("queues", {}).items():
+            q = await self.work_queue(name)
+            for iid, payload, deliveries in items:
+                q.restore_item(int(iid), base64.b64decode(payload),
+                               int(deliveries))

@@ -202,6 +202,11 @@ class MemoryKvStore(KvStore):
         self._next_lease = 0xA0000001
         self._now = now
         self._reaper: Optional[asyncio.Task] = None
+        # durability hook (runtime/server.py): fires on EVERY lease drop,
+        # revocation and expiry alike — etcd logs expiry as a revocation,
+        # so a crash right after an expiry must not resurrect the dead
+        # worker's lease+keys from stale WAL records
+        self.on_lease_drop: Optional[Callable[[int], None]] = None
 
     # ------------------------------------------------------------- helpers
     def _notify(self, ev: WatchEvent) -> None:
@@ -220,12 +225,15 @@ class MemoryKvStore(KvStore):
             self._drop_lease(lid)
 
     def _drop_lease(self, lease_id: int) -> None:
+        known = lease_id in self._leases or lease_id in self._lease_keys
         self._leases.pop(lease_id, None)
         self._lease_ttl.pop(lease_id, None)
         for key in sorted(self._lease_keys.pop(lease_id, ())):
             entry = self._kv.pop(key, None)
             if entry is not None:
                 self._notify(WatchEvent(WatchEventType.DELETE, entry))
+        if known and self.on_lease_drop is not None:
+            self.on_lease_drop(lease_id)
 
     def _ensure_reaper(self) -> None:
         if self._reaper is None or self._reaper.done():
@@ -347,3 +355,23 @@ class MemoryKvStore(KvStore):
         if self._reaper is not None:
             self._reaper.cancel()
             self._reaper = None
+
+    # ---------------------------------------------- durability (wal.py)
+    def dump_state(self) -> dict:
+        """JSON-able snapshot of entries + leases for the daemon's WAL
+        layer. Lease deadlines are NOT captured — a restored lease gets a
+        fresh TTL window (see wal.py's module docstring)."""
+        import base64
+        self._expire_due()
+        return {
+            "kv": [[e.key, base64.b64encode(e.value).decode(), e.lease_id]
+                   for e in self._kv.values()],
+            "leases": [[lid, self._lease_ttl[lid]] for lid in self._leases],
+        }
+
+    async def restore_state(self, state: dict) -> None:
+        import base64
+        for lid, ttl in state.get("leases", ()):
+            await self.lease_create(float(ttl), want_id=int(lid))
+        for key, val, lease in state.get("kv", ()):
+            await self.kv_put(key, base64.b64decode(val), int(lease))

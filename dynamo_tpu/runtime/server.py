@@ -28,6 +28,7 @@ from typing import Dict, Optional
 
 from .bus import MemoryBus
 from .kvstore import MemoryKvStore, WatchEventType
+from .wal import Wal
 
 logger = logging.getLogger("dynamo_tpu.runtime.server")
 
@@ -107,24 +108,47 @@ class _ClientSession:
         rid = msg.get("rid")
         op = msg.get("op", "")
         store, bus = self.server.store, self.server.bus
+        # WAL discipline: log IMMEDIATELY after the (synchronous-body)
+        # store/bus mutation with no await in between, and BEFORE the
+        # reply — so WAL order matches mutation order and an acknowledged
+        # op is already on disk (wal.py module docstring)
+        log = self.server.wal_append
         try:
             if op == "kv_create":
                 ok = await store.kv_create(msg["key"], _unb64(msg["value"]),
                                            msg.get("lease", 0))
+                if ok:
+                    log({"op": "kv_put", "key": msg["key"],
+                         "value": msg["value"],
+                         "lease": msg.get("lease", 0)})
                 await self.send({"rid": rid, "ok": True, "result": ok})
             elif op == "kv_create_or_validate":
+                existed = await store.kv_get(msg["key"]) is not None
                 ok = await store.kv_create_or_validate(
                     msg["key"], _unb64(msg["value"]), msg.get("lease", 0))
+                if ok and not existed:
+                    # log only the actual CREATE: the validated-equal case
+                    # mutates nothing, and logging it would re-home the
+                    # key to the second caller's lease on replay
+                    log({"op": "kv_put", "key": msg["key"],
+                         "value": msg["value"],
+                         "lease": msg.get("lease", 0)})
                 await self.send({"rid": rid, "ok": True, "result": ok})
             elif op == "kv_put":
                 await store.kv_put(msg["key"], _unb64(msg["value"]),
                                    msg.get("lease", 0))
+                log({"op": "kv_put", "key": msg["key"],
+                     "value": msg["value"], "lease": msg.get("lease", 0)})
                 await self.send({"rid": rid, "ok": True})
             elif op == "kv_cas":
                 exp = msg.get("expected")
                 ok = await store.kv_cas(
                     msg["key"], _unb64(exp) if exp is not None else None,
                     _unb64(msg["value"]), msg.get("lease", 0))
+                if ok:
+                    log({"op": "kv_put", "key": msg["key"],
+                         "value": msg["value"],
+                         "lease": msg.get("lease", 0)})
                 await self.send({"rid": rid, "ok": True, "result": ok})
             elif op == "kv_get":
                 e = await store.kv_get(msg["key"])
@@ -140,6 +164,8 @@ class _ClientSession:
                                  "lease": e.lease_id} for e in es]})
             elif op == "kv_delete":
                 ok = await store.kv_delete(msg["key"])
+                if ok:
+                    log({"op": "kv_delete", "key": msg["key"]})
                 await self.send({"rid": rid, "ok": True, "result": ok})
             elif op == "watch_prefix":
                 wid = msg["wid"]      # client-allocated: pushes are routable
@@ -155,11 +181,16 @@ class _ClientSession:
             elif op == "lease_create":
                 lease = await store.lease_create(msg["ttl"],
                                                  want_id=msg.get("want_id", 0))
+                log({"op": "lease", "id": lease.id, "ttl": msg["ttl"]})
                 await self.send({"rid": rid, "ok": True, "lease_id": lease.id})
             elif op == "lease_refresh":
+                # NOT logged: liveness is runtime state; a restored lease
+                # gets a fresh TTL window (wal.py)
                 ok = await store.lease_refresh(msg["lease_id"])
                 await self.send({"rid": rid, "ok": True, "result": ok})
             elif op == "lease_revoke":
+                # logged via the store's on_lease_drop hook (shared with
+                # TTL expiry, which must also reach the WAL)
                 await store.lease_revoke(msg["lease_id"])
                 await self.send({"rid": rid, "ok": True})
             elif op == "publish":
@@ -195,6 +226,8 @@ class _ClientSession:
             elif op == "wq_enqueue":
                 q = await bus.work_queue(msg["queue"])
                 iid = await q.enqueue(_unb64(msg["payload"]))
+                log({"op": "wq_enqueue", "queue": msg["queue"],
+                     "id": iid, "payload": msg["payload"]})
                 await self.send({"rid": rid, "ok": True, "id": iid})
             elif op == "wq_dequeue":
                 q = await bus.work_queue(msg["queue"])
@@ -208,6 +241,8 @@ class _ClientSession:
             elif op == "wq_ack":
                 q = await bus.work_queue(msg["queue"])
                 await q.ack(msg["id"])
+                log({"op": "wq_ack", "queue": msg["queue"],
+                     "id": msg["id"]})
                 await self.send({"rid": rid, "ok": True})
             elif op == "wq_nack":
                 q = await bus.work_queue(msg["queue"])
@@ -253,15 +288,87 @@ class _ClientSession:
 
 
 class DiscoveryServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 data_dir: Optional[str] = None, *, wal_fsync: bool = True):
         self.host = host
         self.port = port
         self.store = MemoryKvStore()
         self.bus = MemoryBus()
+        self.wal: Optional[Wal] = (
+            Wal(data_dir, fsync=wal_fsync) if data_dir else None)
         self._server: Optional[asyncio.base_events.Server] = None
         self._sessions: set = set()
 
+    def wal_append(self, rec: dict) -> None:
+        """Durably log one mutation (no-op without --data-dir). Called by
+        sessions immediately after applying the mutation, before the
+        reply; the fsync blocks the event loop for the write — the
+        acknowledged-is-durable trade, same as etcd's fsync-per-commit."""
+        if self.wal is None:
+            return
+        self.wal.append(rec)
+        if self.wal.due_for_snapshot():
+            self._write_snapshot()
+
+    def _write_snapshot(self) -> None:
+        assert self.wal is not None
+        self.wal.write_snapshot({"store": self.store.dump_state(),
+                                 "bus": self.bus.dump_state()})
+
+    async def _recover(self) -> int:
+        assert self.wal is not None
+        snap, records = self.wal.load()
+        if snap is not None:
+            await self.store.restore_state(snap.get("store", {}))
+            await self.bus.restore_state(snap.get("bus", {}))
+        n = 0
+        for rec in records:
+            await self._apply_wal_record(rec)
+            n += 1
+        if snap is not None or n:
+            logger.info("recovered state: snapshot=%s, %d WAL records",
+                        snap is not None, n)
+        return n
+
+    async def _apply_wal_record(self, rec: dict) -> None:
+        op = rec.get("op")
+        if op == "kv_put":
+            await self.store.kv_put(rec["key"], _unb64(rec["value"]),
+                                    rec.get("lease", 0))
+        elif op == "kv_delete":
+            await self.store.kv_delete(rec["key"])
+        elif op == "lease":
+            try:
+                await self.store.lease_create(float(rec["ttl"]),
+                                              want_id=int(rec["id"]))
+            except RuntimeError:
+                pass                      # already restored from snapshot
+        elif op == "lease_revoke":
+            await self.store.lease_revoke(int(rec["id"]))
+        elif op == "wq_enqueue":
+            q = await self.bus.work_queue(rec["queue"])
+            q.restore_item(int(rec["id"]), _unb64(rec["payload"]))
+        elif op == "wq_ack":
+            q = await self.bus.work_queue(rec["queue"])
+            await q.ack(int(rec["id"]))
+        else:
+            logger.warning("unknown WAL record op %r (skipped)", op)
+
     async def start(self) -> None:
+        if self.wal is not None:
+            replayed = await self._recover()
+            if replayed:
+                # fold a non-trivial replay immediately: without this the
+                # WAL grows without bound across crash-restart cycles
+                # (each run replays the previous runs' records but never
+                # reaches the in-run snapshot threshold)
+                self._write_snapshot()
+        # hook AFTER recovery (a replayed lease_revoke must not re-log):
+        # every lease drop — explicit revoke or TTL expiry — reaches the
+        # WAL, so a crash after an expiry cannot resurrect the dead
+        # worker's lease+keys from stale records
+        self.store.on_lease_drop = (
+            lambda lid: self.wal_append({"op": "lease_revoke", "id": lid}))
         self._server = await asyncio.start_server(
             self._on_conn, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]
@@ -290,11 +397,14 @@ class DiscoveryServer:
                     session.writer.close()
             await self._server.wait_closed()
             self._server = None
+        if self.wal is not None:
+            self._write_snapshot()        # fold the WAL on graceful exit
+            self.wal.close()
         await self.store.close()
 
 
-async def _amain(host: str, port: int) -> None:
-    srv = DiscoveryServer(host, port)
+async def _amain(host: str, port: int, data_dir: Optional[str]) -> None:
+    srv = DiscoveryServer(host, port, data_dir)
     await srv.start()
     print(f"dynamo-tpu discovery/bus daemon listening on {srv.address}",
           flush=True)
@@ -308,11 +418,14 @@ def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=6510)
+    ap.add_argument("--data-dir", default=None,
+                    help="persist KV/lease/queue state here (WAL + "
+                         "snapshot); omit for a purely in-memory daemon")
     args = ap.parse_args()
     from .log import setup_logging
     setup_logging()
     try:
-        asyncio.run(_amain(args.host, args.port))
+        asyncio.run(_amain(args.host, args.port, args.data_dir))
     except KeyboardInterrupt:
         pass
 

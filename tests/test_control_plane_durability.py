@@ -1,0 +1,319 @@
+"""Control-plane durability: the daemon survives its own death.
+
+VERDICT r3 missing #2 / weak #6. Reference semantics being matched:
+- etcd is crash-durable — an acknowledged put is on disk
+  (transports/etcd.rs:38-360);
+- the prefill queue is a JetStream DURABLE work-queue consumer
+  (examples/llm/utils/nats_queue.py:89-99): acknowledged enqueues survive
+  a broker crash; delivered-but-unacked items are REDELIVERED.
+
+Our daemon gets the same contract from runtime/wal.py (fsync'd WAL +
+snapshot). The headline test kills -9 a real daemon process mid
+remote-prefill load, restarts it on the same port + data dir, and asserts
+ZERO lost and ZERO double-executed requests (consumer-side request-id
+dedup absorbs at-least-once redelivery, as in llm/disagg.py).
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.server import DiscoveryServer
+
+pytestmark = pytest.mark.asyncio
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------- in-process
+
+
+async def test_wal_graceful_restart_roundtrip(tmp_path):
+    """Graceful close writes a snapshot; a fresh daemon on the same dir
+    restores keys, LEASED keys (the worker client stays alive across the
+    restart — a gracefully-shut-down client revokes its lease and
+    correctly deregisters), and queue state (acked items gone, pending
+    and in-flight items back)."""
+    d = str(tmp_path / "data")
+    srv = DiscoveryServer(host="127.0.0.1", data_dir=d)
+    await srv.start()
+    rt = await DistributedRuntime.connect(srv.address)
+    srv2 = rt2 = None
+    try:
+        await rt.store.kv_put("models/m1", b"card")
+        lease = await rt.primary_lease()
+        await rt.store.kv_put("disc/worker", b"addr", lease_id=lease.id)
+        q = await rt.bus.work_queue("prefill_queue")
+        ids = [await q.enqueue(f"req-{i}".encode()) for i in range(5)]
+        # consume two: one acked (must NOT come back), one left in-flight
+        # (MUST come back as pending)
+        it1 = await q.dequeue(timeout=5)
+        await q.ack(it1.id)
+        it2 = await q.dequeue(timeout=5)
+        assert it2 is not None
+        consumed_unacked = it2.id
+
+        # daemon restarts; the worker client rides it out (reconnect)
+        host, port = srv.host, srv.port
+        await srv.close()
+        srv2 = DiscoveryServer(host=host, port=port, data_dir=d)
+        await srv2.start()
+
+        rt2 = await DistributedRuntime.connect(srv2.address)
+        e = await rt2.store.kv_get("models/m1")
+        assert e is not None and e.value == b"card"
+        # the leased discovery key survived: restored from the snapshot
+        # with its lease intact (fresh TTL window, wal.py)
+        e = await rt2.store.kv_get("disc/worker")
+        assert e is not None and e.value == b"addr"
+        assert e.lease_id == lease.id
+        q2 = await rt2.bus.work_queue("prefill_queue")
+        assert await q2.depth() == 4          # 5 − 1 acked
+        seen = set()
+        for _ in range(4):
+            it = await q2.dequeue(timeout=5)
+            seen.add(it.id)
+            await q2.ack(it.id)
+        assert it1.id not in seen             # acked stays retired
+        assert consumed_unacked in seen       # unacked was redelivered
+        assert seen == set(ids) - {it1.id}
+    finally:
+        await rt.shutdown()
+        if rt2 is not None:
+            await rt2.shutdown()
+        if srv2 is not None:
+            await srv2.close()
+
+
+async def test_wal_snapshot_compaction(tmp_path):
+    """Crossing snapshot_every folds the WAL into snapshot.json and
+    truncates wal.jsonl; recovery still sees every acknowledged op."""
+    d = str(tmp_path / "data")
+    srv = DiscoveryServer(host="127.0.0.1", data_dir=d)
+    srv.wal.snapshot_every = 10
+    await srv.start()
+    rt = await DistributedRuntime.connect(srv.address)
+    try:
+        for i in range(25):
+            await rt.store.kv_put(f"k/{i}", str(i).encode())
+        assert os.path.exists(os.path.join(d, "snapshot.json"))
+        # WAL holds only the records since the last fold
+        with open(os.path.join(d, "wal.jsonl")) as f:
+            assert len(f.readlines()) < 10
+    finally:
+        await rt.shutdown()
+        # NOT graceful w.r.t. state: simulate a crash by skipping close()'s
+        # snapshot — close the sockets only
+        srv.wal.close()
+        srv.wal = None
+        await srv.close()
+
+    srv2 = DiscoveryServer(host="127.0.0.1", data_dir=d)
+    await srv2.start()
+    rt2 = await DistributedRuntime.connect(srv2.address)
+    try:
+        for i in range(25):
+            e = await rt2.store.kv_get(f"k/{i}")
+            assert e is not None and e.value == str(i).encode(), f"lost k/{i}"
+    finally:
+        await rt2.shutdown()
+        await srv2.close()
+
+
+async def test_torn_wal_tail_skipped(tmp_path):
+    """A crash mid-append leaves a torn last line; it was never
+    acknowledged, so recovery takes the valid prefix and drops it."""
+    d = str(tmp_path / "data")
+    os.makedirs(d)
+    with open(os.path.join(d, "wal.jsonl"), "w") as f:
+        f.write(json.dumps({"op": "kv_put", "key": "a",
+                            "value": "dg==", "lease": 0}) + "\n")
+        f.write('{"op": "kv_put", "key": "b", "val')   # torn
+    srv = DiscoveryServer(host="127.0.0.1", data_dir=d)
+    await srv.start()
+    try:
+        e = await srv.store.kv_get("a")
+        assert e is not None and e.value == b"v"
+        assert await srv.store.kv_get("b") is None
+    finally:
+        await srv.close()
+
+
+async def test_expired_lease_does_not_resurrect_after_crash(tmp_path):
+    """A worker's lease expires (worker died), THEN the daemon crashes
+    before any snapshot: recovery must not resurrect the dead worker's
+    lease+keys from the stale lease/kv_put WAL records — expiry reaches
+    the WAL as a revocation, exactly as etcd logs it."""
+    d = str(tmp_path / "data")
+    srv = DiscoveryServer(host="127.0.0.1", data_dir=d)
+    await srv.start()
+    rt = await DistributedRuntime.connect(srv.address)
+    try:
+        r = await rt.store._conn.call("lease_create", ttl=0.2)
+        lid = r["lease_id"]
+        await rt.store.kv_put("disc/dead-worker", b"addr", lease_id=lid)
+        # no refresh → the reaper expires the lease and deletes the key
+        for _ in range(50):
+            if await rt.store.kv_get("disc/dead-worker") is None:
+                break
+            await asyncio.sleep(0.1)
+        assert await rt.store.kv_get("disc/dead-worker") is None
+    finally:
+        await rt.shutdown()
+        srv.wal.close()        # crash: no graceful snapshot
+        srv.wal = None
+        await srv.close()
+
+    srv2 = DiscoveryServer(host="127.0.0.1", data_dir=d)
+    await srv2.start()
+    try:
+        assert await srv2.store.kv_get("disc/dead-worker") is None, (
+            "dead worker resurrected from stale WAL records")
+    finally:
+        await srv2.close()
+
+
+# ------------------------------------------------------------------ kill -9
+
+
+def _spawn_daemon(data_dir: str, port: int = 0) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    return subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.runtime.server",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--data-dir", data_dir],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, text=True)
+
+
+def _wait_addr(proc: subprocess.Popen) -> str:
+    line = proc.stdout.readline()
+    assert "listening on" in line, f"daemon failed to start: {line!r}"
+    return line.rsplit(" ", 1)[-1].strip()
+
+
+async def test_kill9_mid_disagg_load_zero_lost_zero_double(tmp_path):
+    """THE durability gate (VERDICT r3 next #5): a real daemon process is
+    SIGKILLed mid remote-prefill load with queue depth > 0 and items
+    in-flight, restarted on the same port + data dir; every accepted
+    request executes exactly once."""
+    d = str(tmp_path / "data")
+    proc = _spawn_daemon(d)
+    addr = _wait_addr(proc)
+    port = int(addr.rsplit(":", 1)[-1])
+
+    N = 40
+    executed: list = []                  # consumer-side execution log
+    executed_rids: set = set()           # the dedup set (llm/disagg.py's)
+    acked_rids: set = set()
+    delivered_after_restart: list = []
+    restarted = asyncio.Event()
+
+    rt_p = await DistributedRuntime.connect(addr)
+    rt_c = await DistributedRuntime.connect(addr)
+    try:
+        qp = await rt_p.bus.work_queue("prefill_queue")
+        qc = await rt_c.bus.work_queue("prefill_queue")
+
+        async def produce():
+            for i in range(N):
+                # enqueue acknowledged == durable; the producer never
+                # retries, so any missing execution is a LOST request
+                await asyncio.wait_for(
+                    qp.enqueue(json.dumps({"rid": f"r{i}"}).encode()), 30)
+                await asyncio.sleep(0.01)
+
+        async def consume():
+            while len(executed_rids) < N:
+                try:
+                    item = await asyncio.wait_for(qc.dequeue(timeout=1.0),
+                                                  30)
+                except (ConnectionError, asyncio.TimeoutError):
+                    await asyncio.sleep(0.05)
+                    continue
+                if item is None:
+                    continue
+                rid = json.loads(item.payload)["rid"]
+                if restarted.is_set():
+                    delivered_after_restart.append(rid)
+                if rid not in executed_rids:     # at-least-once dedup
+                    executed_rids.add(rid)
+                    executed.append(rid)
+                await asyncio.sleep(0.005)       # "prefill work"
+                await qc.ack(item.id)
+                acked_rids.add(rid)
+
+        prod = asyncio.ensure_future(produce())
+        cons = asyncio.ensure_future(consume())
+        # let load build, then murder the daemon mid-flight
+        await asyncio.sleep(0.15)
+        acked_before_crash = set(acked_rids)
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        await asyncio.sleep(0.3)                 # clients see the outage
+        proc = _spawn_daemon(d, port=port)
+        _wait_addr(proc)
+        restarted.set()
+
+        await asyncio.wait_for(prod, 60)
+        await asyncio.wait_for(cons, 60)
+
+        # zero lost: every acknowledged enqueue executed
+        assert set(executed) == {f"r{i}" for i in range(N)}
+        # zero double-executed: the dedup'd log has no duplicates
+        assert len(executed) == N
+        # daemon-level: an item acked before the crash is never redelivered
+        assert not (set(delivered_after_restart) & acked_before_crash), (
+            "acked items redelivered after restart")
+    finally:
+        await rt_p.shutdown()
+        await rt_c.shutdown()
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+async def test_kill9_queue_depth_survives_without_consumer(tmp_path):
+    """The exact round-3 failure: queued items with NO consumer attached
+    die with the daemon. Now: enqueue, SIGKILL (no graceful snapshot),
+    restart, and the items are all still there."""
+    d = str(tmp_path / "data")
+    proc = _spawn_daemon(d)
+    addr = _wait_addr(proc)
+    port = int(addr.rsplit(":", 1)[-1])
+    rt = await DistributedRuntime.connect(addr)
+    try:
+        q = await rt.bus.work_queue("prefill_queue")
+        for i in range(7):
+            await q.enqueue(f"p{i}".encode())
+        await rt.store.kv_put("cfg/threshold", b"512")
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+        proc = _spawn_daemon(d, port=port)
+        _wait_addr(proc)
+
+        for _ in range(50):                      # ride the reconnect
+            try:
+                if await q.depth() == 7:
+                    break
+            except ConnectionError:
+                pass
+            await asyncio.sleep(0.1)
+        assert await q.depth() == 7
+        payloads = set()
+        for _ in range(7):
+            it = await q.dequeue(timeout=5)
+            payloads.add(it.payload)
+            await q.ack(it.id)
+        assert payloads == {f"p{i}".encode() for i in range(7)}
+        e = await rt.store.kv_get("cfg/threshold")
+        assert e is not None and e.value == b"512"
+    finally:
+        await rt.shutdown()
+        proc.kill()
+        proc.wait(timeout=10)
