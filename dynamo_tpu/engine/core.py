@@ -582,15 +582,6 @@ class EngineCore:
         defer = False
         remote_admit = req.precomputed is not None
         if req.precomputed is not None:
-            if self.recorder is not None:
-                from ..llm.kv_transport import DeviceKvPayload
-                if isinstance(req.precomputed, DeviceKvPayload):
-                    # the device bulk plane's arrays live in THIS
-                    # process's bridge — nothing streamable; a multihost
-                    # deployment's prefill workers are other processes
-                    # and arrive on the wire plane (streamed below)
-                    self.recorder.rec("prefill_unsupported", rid=req.rid,
-                                      path="precomputed_device")
             tok, logprob = self._admit_precomputed(req, n_already)
             # device payloads ship the first token as a device scalar (the
             # prefill side never fetched it — one round-trip saved); defer
@@ -845,9 +836,23 @@ class EngineCore:
         pc = req.precomputed
         n_prompt_blocks = self._blocks_needed(len(req.prompt))
         targets = req.blocks[n_already:n_prompt_blocks]
+        from ..llm.kv_transport import (DeviceKvPayload,
+                                        scatter_blocks_device)
+        if isinstance(pc, DeviceKvPayload) and self.recorder is not None:
+            # device payloads are NOT copied onto the stream — their
+            # arrays are device-resident. Each follower rank's co-located
+            # prefill-engine replica parked its own shard of this payload
+            # under the request id ("handoff_gather" park=True); stream
+            # only the admission metadata and let each rank scatter its
+            # local deposit (multihost.run_follower
+            # "precomputed_device_admit"). Streamed even with empty
+            # targets (full prefix hit): the followers must still CLAIM
+            # and drop their parked shard or it would pin HBM forever.
+            self.recorder.rec(
+                "precomputed_device_admit", rid=req.rid,
+                targets=list(targets), skip=n_already,
+                n_needed=n_prompt_blocks)
         if targets:
-            from ..llm.kv_transport import (DeviceKvPayload,
-                                            scatter_blocks_device)
             if isinstance(pc, DeviceKvPayload):
                 # device bulk plane: blocks hop prefill-devices →
                 # decode-devices (ICI, resharding under our mesh) with no
@@ -884,6 +889,17 @@ class EngineCore:
         from .block_copy import fetch_wire, gather_blocks_dispatch
         n_blocks = self._blocks_needed(req.pos)
         ids = req.blocks[:n_blocks]
+        if self.recorder is not None:
+            # a multihost PREFILL engine must stream the gather — it is a
+            # device program, and an unstreamed dispatch would deadlock
+            # followers at the next collective. park=True additionally
+            # tells each follower rank to hold its shard of the gather
+            # output in the process bridge so a co-located multihost
+            # DECODE engine's follower can claim it on the leader's
+            # "precomputed_device_admit" (multihost.run_follower)
+            self.recorder.rec("handoff_gather", rid=req.rid,
+                              ids=list(ids), n_blocks=n_blocks,
+                              park=bool(req.handoff_device))
         stacked = gather_blocks_dispatch(self.kv, ids, self.cfg.kv_block_size)
         seq_hashes = list(req.seq.sequence_hashes[:req.registered_blocks])
         handoff = req.handoff

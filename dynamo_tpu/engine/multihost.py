@@ -34,11 +34,16 @@ sp ring prefill and chunked prefill ARE streamed (the "prefill_sp"
 event; chunks record as plain "prefill" events) — sp's cross-host
 ppermute rides ICI on real hardware. Wire-plane disagg onboarding IS
 streamed too ("precomputed_admit" forwards the remote prefill's KV
-values; each rank scatters its head shard). The one remaining refusal
-is DEVICE-plane disagg payloads ("prefill_unsupported"
-path=precomputed_device): their arrays live in the leader process's
-bridge and cannot reach other ranks — a multihost deployment's prefill
-workers are separate processes and arrive on the wire plane anyway.
+values; each rank scatters its head shard). DEVICE-plane disagg
+payloads are streamed as metadata only ("precomputed_device_admit":
+rid + target blocks): the payload's arrays are device-resident, so in a
+multihost disagg deployment every rank runs an SPMD replica of the
+prefill engine, parks its own shard of the payload in its process
+bridge (kv_transport.DeviceKvBridge.park), and scatters it when the
+leader's admission event arrives — the per-rank routing the wire plane
+already uses, without bulk KV on the control stream. This closed the
+last multihost refusal (round 4); "prefill_unsupported" remains as a
+defensive guard for any future unstreamable path.
 
 The host-KV tier IS streamed: followers keep a MIRROR host pool. The
 leader's offload pump emits its literal placement decisions ("kv_store":
@@ -75,7 +80,8 @@ __all__ = ["DispatchStreamLeader", "connect_follower", "run_follower"]
 # host bookkeeping
 WIRE_EVENTS = frozenset(
     {"prefill", "prefill_sp", "dispatch", "hit_transfer",
-     "kv_store", "precomputed_admit", "prefill_unsupported"})
+     "kv_store", "precomputed_admit", "precomputed_device_admit",
+     "handoff_gather", "prefill_unsupported"})
 _SHUTDOWN = {"ev": "__shutdown__"}
 
 _LEN = struct.Struct(">I")
@@ -249,6 +255,55 @@ def run_follower(core, sock: socket.socket,
                 core.kv, list(ev["targets"]), ev["values"],
                 core.cfg.kv_block_size)
             stats["precomputed"] = stats.get("precomputed", 0) + 1
+            continue
+        if kind == "handoff_gather":
+            # prefill-engine follower: run the leader's handoff gather (a
+            # device program — skipping it would deadlock the next
+            # collective). For device-plane handoffs (park=True) hold
+            # this rank's shard of the gather output in the process
+            # bridge so a co-located decode follower can claim it.
+            from .block_copy import gather_blocks_dispatch
+            stacked = gather_blocks_dispatch(core.kv, list(ev["ids"]),
+                                             core.cfg.kv_block_size)
+            if ev.get("park"):
+                from ..llm.kv_transport import DeviceKvPayload, bridge
+                bridge().park(ev["rid"], DeviceKvPayload(
+                    # followers never read the token fields — the scatter
+                    # consumes only stacked/n_blocks/block_size
+                    request_id=ev["rid"], first_token=None,
+                    first_logprob=None, seq_hashes=[],
+                    stacked=stacked, n_blocks=int(ev["n_blocks"]),
+                    block_size=core.cfg.kv_block_size))
+            stats["handoff_gathers"] = stats.get("handoff_gathers", 0) + 1
+            continue
+        if kind == "precomputed_device_admit":
+            # decode-engine follower: the payload's arrays never ride the
+            # stream — this rank's prefill-engine replica parked its OWN
+            # shard in the process bridge ("handoff_gather" park=True);
+            # run the same scatter program the leader ran. The prefill
+            # replica consumes a DIFFERENT stream, so rendezvous with a
+            # bounded wait rather than assuming it already parked.
+            from ..llm.kv_transport import bridge, scatter_blocks_device
+            deadline = time.monotonic() + 120.0
+            payload = bridge().take_parked(ev["rid"])
+            while payload is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+                payload = bridge().take_parked(ev["rid"])
+            if payload is None:
+                raise ValueError(
+                    f"leader admitted a device-plane payload for "
+                    f"rid={ev.get('rid')} but nothing was parked in this "
+                    f"rank's bridge within 120s — is the prefill engine "
+                    f"replica running on this rank with its dispatch "
+                    f"stream attached?")
+            if ev["targets"]:
+                core.kv = scatter_blocks_device(
+                    core.kv, list(ev["targets"]), payload,
+                    int(ev["skip"]), int(ev["n_needed"]), mesh=core.mesh)
+            # else: full prefix hit — claiming (and dropping) the parked
+            # shard was the point; nothing to scatter
+            stats["precomputed_device"] = (
+                stats.get("precomputed_device", 0) + 1)
             continue
         if kind == "kv_store":
             # mirror the leader's offload commit: gather the SAME device

@@ -184,6 +184,22 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                 f"({ev.get('path')}, rid={ev.get('rid')}); replay would "
                 f"silently diverge — record only runs without disagg "
                 f"onboarding")
+        if kind == "precomputed_device_admit":
+            # live multihost followers resolve this from their own
+            # process bridge (their prefill replica's parked shard); an
+            # OFFLINE replay has no bridge and the arrays were never
+            # logged (device-resident by design)
+            raise NotImplementedError(
+                f"device-plane disagg admission for rid={ev.get('rid')} "
+                f"is not offline-replayable: the payload's arrays are "
+                f"device-resident and not in the log — record with the "
+                f"wire plane (precomputed_admit) for replayable disagg "
+                f"runs")
+        if kind == "handoff_gather":
+            # read-only device program (prefill epilogue gather); its
+            # output feeds the handoff plane, not the KV pool — offline
+            # replay of pool state may skip it
+            continue
         if kind == "kv_store":
             from ..llm.kv.offload import HostKvPool
             if mirror is None:

