@@ -28,6 +28,51 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+# int8 KV pools carry per-token scales IN-ROW as two extra int8 lanes
+# (exponent at lane C, mantissa at C+1; scale = 2^e·(1+m/256)), padded to
+# one 128-lane group so rows stay lane-aligned. Rationale: TPU DMA slices
+# must be tile-aligned — int8 memrefs tile at (32, 128), f32 at (8, 128)
+# — so a separate per-token scale array cannot be block-DMA'd (Mosaic
+# rejects sub-tile slices; measured on v5e). quantize_kv_rows /
+# dequant_kv_rows below are the encoding's single home.
+KV_SCALE_LANES = 128
+
+
+def kv_value_lanes(k_cache: jax.Array) -> int:
+    """C (= KVH·Dh value lanes) of a pool row, minus the in-row scale
+    group when the pool is int8-quantized."""
+    lanes = k_cache.shape[-1]
+    return lanes - KV_SCALE_LANES if k_cache.dtype == jnp.int8 else lanes
+
+
+def quantize_kv_rows(x: jax.Array) -> jax.Array:
+    """Per-row int8 with in-row (e, m) scale lanes: x [N, C] ->
+    int8 [N, C + KV_SCALE_LANES]. scale = 2^e·(1+m/256) ≈ absmax/127
+    (within 2^-9 relative). One home for the encoding; the kernel's
+    dequant_tile and dequant_kv_rows below are its readers."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.maximum(jnp.max(jnp.abs(xf), axis=1), 1e-30)
+    target = absmax / 127.0
+    e = jnp.floor(jnp.log2(target))
+    m = jnp.clip(jnp.round((target / jnp.exp2(e) - 1.0) * 256.0), 0, 255)
+    scale = jnp.exp2(e) * (1.0 + m / 256.0)
+    q = jnp.clip(jnp.round(xf / scale[:, None]), -127, 127).astype(jnp.int8)
+    pad = jnp.zeros((x.shape[0], KV_SCALE_LANES), jnp.int8)
+    pad = pad.at[:, 0].set(jnp.clip(e, -127, 127).astype(jnp.int8))
+    # m 0..255 stored as wrapped int8; readers mask with & 0xFF
+    pad = pad.at[:, 1].set(m.astype(jnp.uint8).astype(jnp.int8))
+    return jnp.concatenate([q, pad], axis=1)
+
+
+def dequant_kv_rows(rows: jax.Array, C: int, out_dtype) -> jax.Array:
+    """Inverse of quantize_kv_rows for gathered rows [..., C+SCALE_LANES]."""
+    e = rows[..., C].astype(jnp.float32)
+    m = (rows[..., C + 1].astype(jnp.int32) & 0xFF).astype(jnp.float32)
+    scale = jnp.exp2(e) * (1.0 + m / 256.0)
+    return (rows[..., :C].astype(jnp.float32)
+            * scale[..., None]).astype(out_dtype)
+
+
 def softcap_scores(scores: jax.Array, cap) -> jax.Array:
     """Gemma2 logit soft-capping: cap·tanh(x/cap) — the single home of the
     formula, shared by prefill, both decode impls, and the lm head."""
@@ -346,16 +391,23 @@ def paged_attention_xla(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                         *, block_size: int, scale: float,
                         softcap: float | None = None,
                         win_lo: jax.Array | None = None) -> jax.Array:
-    """q: [B, H, Dh]; k_cache/v_cache: [NTOK, KVH*Dh] (block-major pool);
-    block_tables: [B, M] int32; seq_lens: [B] (kv length incl. current token).
-    Returns [B, H, Dh]."""
+    """q: [B, H, Dh]; k_cache/v_cache: [NTOK, KVH*Dh] (block-major pool;
+    int8 pools carry KV_SCALE_LANES extra in-row scale lanes and
+    dequantize after the gather); block_tables: [B, M] int32; seq_lens:
+    [B] (kv length incl. current token). Returns [B, H, Dh]."""
     B, H, Dh = q.shape
-    KVH = k_cache.shape[1] // Dh
+    C = kv_value_lanes(k_cache)
+    KVH = C // Dh
     g = H // KVH
     idx = flat_token_indices(block_tables, block_size)        # [B, T]
     T = idx.shape[1]
-    k = jnp.take(k_cache, idx, axis=0).reshape(B, T, KVH, Dh)
-    v = jnp.take(v_cache, idx, axis=0).reshape(B, T, KVH, Dh)
+    k = jnp.take(k_cache, idx, axis=0)
+    v = jnp.take(v_cache, idx, axis=0)
+    if k_cache.dtype == jnp.int8:
+        k = dequant_kv_rows(k, C, q.dtype)
+        v = dequant_kv_rows(v, C, q.dtype)
+    k = k.reshape(B, T, KVH, Dh)
+    v = v.reshape(B, T, KVH, Dh)
     qg = q.reshape(B, KVH, g, Dh)
     scores = jnp.einsum("bkgd,btkd->bkgt", qg, k).astype(jnp.float32) * scale
     if softcap:
@@ -389,11 +441,20 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
                        wave_ref,
                        *, block_size: int, chunk: int, scale: float,
                        num_seqs: int, seqs_per_program: int,
-                       softcap: float | None = None):
-    """q_ref: [G, Hp, C] sparse-slotted (VMEM); k_hbm/v_hbm: [NTOK, C]
-    (HBM); o_ref: [G, Hp, C]; k_bufs/v_bufs: [2, chunk*block_size, C]
+                       softcap: float | None = None,
+                       value_lanes: int | None = None):
+    """q_ref: [G, Hp, C] sparse-slotted (VMEM); k_hbm/v_hbm: [NTOK, Cx]
+    (HBM); o_ref: [G, Hp, C]; k_bufs/v_bufs: [2, chunk*block_size, Cx]
     double buffers; sems: DMA semaphore pair; m/l: [Hp, 1]; acc: [Hp, C]
     f32; wave_ref: [1] SMEM global wave-parity carried ACROSS programs.
+
+    int8 KV pools carry their per-token scales IN-ROW (KV_SCALE_LANES;
+    Cx = C + 128, `value_lanes`=C): the block DMA is unchanged — ONE
+    contiguous copy fetches values + scales — and dequant_tile rescales
+    each wave's [cbs, C] tile in ROW space before the dots (keepdim lane
+    slices broadcast along lanes with no sublane↔lane movement; the
+    score-space variant needed a transpose per wave and measured slower
+    on v5e).
 
     Each grid program handles G = seqs_per_program sequences (static
     unroll): per-program fixed costs (q/o block pipelining, grid step
@@ -420,6 +481,21 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
         # chunk
         sc = jnp.maximum(win_lo_ref[bi] + 1, 0) // (chunk * block_size)
         return nb, nc, sc
+
+    quantized = value_lanes is not None
+    C = value_lanes if quantized else q_ref.shape[-1]
+
+    def dequant_tile(tile):
+        """[cbs, Cx] int8 tile → [cbs, C] f32 values, rescaled from the
+        in-row (e, m) lanes. Keepdim lane slices ([cbs, 1]) broadcast
+        along lanes with no sublane↔lane movement — the score-space
+        variant (scale as a [cbs] LANE vector) costs a transpose per
+        wave and measured slower than the DMA saving on v5e."""
+        e = tile[:, C:C + 1].astype(jnp.float32)
+        m = (tile[:, C + 1:C + 2].astype(jnp.int32)
+             & 0xFF).astype(jnp.float32)
+        scale = jnp.exp2(e) * (1.0 + m * (1.0 / 256.0))
+        return tile[:, :C].astype(jnp.float32) * scale
 
     def chunk_copies(sq, ci, slot, nb):
         """2*chunk contiguous block copies of sequence `sq`'s chunk `ci`
@@ -495,8 +571,12 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
 
             for c in chunk_copies(sq, ci, slot, num_blocks):
                 c.wait()
-            k = k_bufs[slot].astype(jnp.float32)    # [chunk*bs, C]
-            v = v_bufs[slot].astype(jnp.float32)
+            if quantized:
+                k = dequant_tile(k_bufs[slot])        # [cbs, C] f32
+                v = dequant_tile(v_bufs[slot])
+            else:
+                k = k_bufs[slot].astype(jnp.float32)  # [chunk*bs, C]
+                v = v_bufs[slot].astype(jnp.float32)
             sm = jax.lax.dot_general(qm, k, (((1,), (1,)), ((), ())))
             if softcap:
                 sm = softcap_scores(sm, softcap)    # [Hp, cbs]
@@ -558,15 +638,21 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                            interpret: bool = False) -> jax.Array:
     """Same contract as `paged_attention_xla`; KV stays in HBM and streams
     chunk-by-chunk with double buffering (no [B, M*BS] gather). Sliding
-    windows are in-kernel (win_lo: [B], -1 for global layers)."""
+    windows are in-kernel (win_lo: [B], -1 for global layers). int8 pools
+    (in-row scales, KV_SCALE_LANES) cut the DMA bytes 1.6× with the same
+    one-copy-per-block structure."""
     B, H, Dh = q.shape
-    NTOK, C = k_cache.shape
+    NTOK, Cx = k_cache.shape
+    quantized = k_cache.dtype == jnp.int8
+    C = kv_value_lanes(k_cache)
     KVH = C // Dh
-    if not pallas_supported(H, KVH, Dh, block_size):
+    if not pallas_supported(H, KVH, Dh, block_size,
+                            kv_dtype=k_cache.dtype):
         raise ValueError(
             f"unsupported pallas geometry (H={H}, KVH={KVH}, Dh={Dh}, "
-            f"block_size={block_size}): needs KVH*Dh % 128 == 0 and "
-            f"block_size % 8 == 0 — see pallas_supported")
+            f"block_size={block_size}, kv={k_cache.dtype}): needs "
+            f"KVH*Dh % 128 == 0 and block_size % 8 == 0 (int8 pools: "
+            f"% 32, the int8 sublane tile) — see pallas_supported")
     g = H // KVH
     M = block_tables.shape[1]
     if chunk_blocks is None:
@@ -613,8 +699,8 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             pltpu.VMEM((Hp, 1), jnp.float32),                 # m
             pltpu.VMEM((Hp, 1), jnp.float32),                 # l
             pltpu.VMEM((Hp, C), jnp.float32),                 # acc
-            pltpu.VMEM((2, chunk * block_size, C), k_cache.dtype),
-            pltpu.VMEM((2, chunk * block_size, C), v_cache.dtype),
+            pltpu.VMEM((2, chunk * block_size, Cx), k_cache.dtype),
+            pltpu.VMEM((2, chunk * block_size, Cx), v_cache.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SMEM((1,), jnp.int32),   # cross-program wave parity
         ],
@@ -628,7 +714,8 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             q_ref, k_hbm, v_hbm, o_ref,
             m_ref, l_ref, acc_ref, k_bufs, v_bufs, sems, wave_ref,
             block_size=block_size, chunk=chunk, scale=scale,
-            num_seqs=Bp, seqs_per_program=G, softcap=softcap)
+            num_seqs=Bp, seqs_per_program=G, softcap=softcap,
+            value_lanes=C if quantized else None)
 
     out = pl.pallas_call(
         kernel,
@@ -645,12 +732,15 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
 
 def pallas_supported(num_heads: int, num_kv_heads: int, head_dim: int,
-                     block_size: int) -> bool:
+                     block_size: int, kv_dtype=None) -> bool:
     """True if the Pallas decode kernel handles this geometry: the packed
     lane width KVH*Dh must be lane-aligned (128) and KV blocks must be
-    8-sublane aligned. Tiny test models (KVH*Dh < 128) fall back to XLA."""
+    8-sublane aligned — 32 for int8 pools (the int8 sublane tile; DMA
+    slices must be tile-aligned). Tiny test models (KVH*Dh < 128) fall
+    back to XLA."""
+    sublane = 32 if kv_dtype == jnp.int8 else 8
     return ((num_kv_heads * head_dim) % 128 == 0
-            and block_size % 8 == 0
+            and block_size % sublane == 0
             and num_heads % num_kv_heads == 0)
 
 
@@ -660,13 +750,15 @@ def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
                     softcap: float | None = None,
                     win_lo: jax.Array | None = None) -> jax.Array:
     """Dispatch: pallas on TPU (block-major streaming kernel, incl. sliding
-    windows and soft-capping), XLA gather fallback elsewhere and for
-    geometries the kernel can't tile (lane width KVH*Dh < 128)."""
+    windows, soft-capping, and int8 pools w/ in-row per-token scales), XLA
+    gather fallback elsewhere and for geometries the kernel can't tile
+    (lane width KVH*Dh < 128; int8 pools with block_size % 32 != 0)."""
     if impl == "auto":
         B, H, Dh = q.shape
-        KVH = k_cache.shape[1] // Dh
+        KVH = kv_value_lanes(k_cache) // Dh
         impl = ("pallas" if _on_tpu()
-                and pallas_supported(H, KVH, Dh, block_size) else "xla")
+                and pallas_supported(H, KVH, Dh, block_size,
+                                     kv_dtype=k_cache.dtype) else "xla")
     if impl == "pallas":
         return paged_attention_pallas(q, k_cache, v_cache, block_tables,
                                       seq_lens, block_size=block_size,

@@ -290,6 +290,12 @@ class EngineConfig:
     # one compute-bound dispatch instead of len(prompt) steps).
     # 0 disables; requires decode_steps_per_dispatch > 1.
     lane_prefill_max_tokens: int = 0
+    # KV-cache quantization: "none" | "int8" (per-token symmetric int8
+    # pool + f32 scales — halves the decode KV read stream, the dominant
+    # HBM term at seq >= ~1k). Current limits (refused loudly): no host
+    # KV tier, no disagg handoff/onboarding (the bulk planes move raw
+    # pool blocks and don't carry scale arrays yet).
+    kv_quantization: str = "none"
     # weight-only quantization: "none" | "int8" | "int8-noembed"
     # (engine/quant.py — int8 weights + per-output-channel scales, dequant
     # fused into the matmuls; halves the per-step weights-read floor).

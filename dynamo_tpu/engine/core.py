@@ -150,9 +150,21 @@ class EngineCore:
                 params,
                 include_embed=engine_cfg.quantization == "int8")
         self.params = params
+        if (engine_cfg.kv_quantization != "none"
+                and engine_cfg.host_kv_blocks > 0):
+            raise ValueError(
+                "kv_quantization + the host KV tier are not supported "
+                "together yet: the offload pump's wire format assumes "
+                "full-precision pool rows")
+        if (engine_cfg.kv_quantization != "none" and mesh is not None
+                and mesh.shape.get("tp", 1) > 1):
+            raise ValueError(
+                "kv_quantization + tp>1 is not supported yet: the int8 "
+                "pool's in-row scale lanes would be split across the "
+                "tp-sharded lane axis")
         self.kv = llama.init_kv_cache(
             model_cfg, engine_cfg.num_kv_blocks, engine_cfg.kv_block_size,
-            dtype=param_dtype)
+            dtype=param_dtype, quantization=engine_cfg.kv_quantization)
         if mesh is not None:
             # place params/KV under the tp/sp layout; every jitted step then
             # runs SPMD over the mesh with XLA-inserted ICI collectives
@@ -360,6 +372,13 @@ class EngineCore:
 
     # ------------------------------------------------------------- frontend
     async def submit(self, req: EngineRequest) -> None:
+        if (self.cfg.kv_quantization != "none"
+                and (req.handoff is not None
+                     or req.precomputed is not None)):
+            raise NotImplementedError(
+                "disagg handoff/onboarding is not supported with an int8 "
+                "KV pool yet: the bulk KV planes move raw pool blocks "
+                "and do not carry the per-token scale arrays")
         self.ensure_started()
         await self.waiting.put(req)
         self._work_event.set()

@@ -152,8 +152,12 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
     from .models import llama
 
     dtype = jax.tree_util.tree_leaves(core.params)[0].dtype
+    # the pool LAYOUT must match the recording core's (an int8-KV engine
+    # replayed against a bf16 pool would retrace the unquantized branch
+    # and report phantom divergence)
     kv = llama.init_kv_cache(core.model_cfg, core.cfg.num_kv_blocks,
-                             core.cfg.kv_block_size, dtype=dtype)
+                             core.cfg.kv_block_size, dtype=dtype,
+                             quantization=core.cfg.kv_quantization)
     out = {"prefill": {}, "dispatch": {}, "fingerprints": []}
     disp_toks: Dict[int, object] = {}
     mirror = None          # host-tier mirror pool, built from kv_store

@@ -113,9 +113,10 @@ class DecodeStream:
 class SentencePieceTokenizer:
     """SentencePiece-model tokenizer behind the same interface as
     HuggingFaceTokenizer (reference lib/llm/src/tokenizers/sp.rs — the
-    second tokenizer kind the model card can declare). Gated on the
-    `sentencepiece` package: constructing without it raises with guidance,
-    keeping the framework importable everywhere."""
+    second tokenizer kind the model card can declare). Uses the
+    `sentencepiece` package when importable; otherwise the native
+    unigram engine (llm/sp_model.py) loads the same .model file, so the
+    tokenizer kind works — and is tested — in every image."""
 
     def __init__(self, processor):
         self._sp = processor
@@ -124,11 +125,9 @@ class SentencePieceTokenizer:
     def from_file(cls, path: str) -> "SentencePieceTokenizer":
         try:
             import sentencepiece as spm
-        except ImportError as e:  # pragma: no cover - env without the lib
-            raise RuntimeError(
-                "sentencepiece models need the `sentencepiece` package "
-                f"(loading {path!r}); install it or convert the model to "
-                "an HF tokenizer.json") from e
+        except ImportError:
+            from .sp_model import NativeSentencePiece
+            return cls(NativeSentencePiece.load(path))
         sp = spm.SentencePieceProcessor()
         sp.Load(path)
         return cls(sp)

@@ -56,14 +56,21 @@ def main():
 
     batches = [int(a) for a in sys.argv[1:]] or [16, 64, 128]
     quant = os.environ.get("PROF_QUANT", "int8")
+    kv_quant = os.environ.get("PROF_KV", "none")   # none|int8 KV pool
     seq = int(os.environ.get("PROF_SEQ", "512"))
     attn_impl = os.environ.get("PROF_ATTN", "auto")
     model = os.environ.get("PROF_MODEL", "1b")
+    # long-context sweeps past the geometry's RoPE table: PROF_MAXPOS
+    # raises max_position_embeddings (table cost is linear and tiny)
+    maxpos = int(os.environ.get("PROF_MAXPOS", "0"))
 
     # geometry shared with bench.py (ONE home; unknown names raise —
     # no silent 1B fallback under a mislabeled header)
     from dynamo_tpu.engine.config import bench_model_config
     mcfg = bench_model_config(model)
+    if maxpos:
+        import dataclasses
+        mcfg = dataclasses.replace(mcfg, max_position_embeddings=maxpos)
     if seq >= mcfg.max_position_embeddings:
         # positions stay pinned at `seq` throughout the profile chains
         # (the fori body never advances them), so the only alias hazard
@@ -74,16 +81,17 @@ def main():
             f"the decode position would silently alias past the RoPE "
             f"table (ADVICE r3). Use a geometry that covers the sweep.")
     dev = jax.devices()[0]
-    print(f"# {dev.platform}:{dev.device_kind} model={model} quant={quant} seq={seq} "
-          f"attn={attn_impl}", file=sys.stderr)
+    print(f"# {dev.platform}:{dev.device_kind} model={model} quant={quant} "
+          f"kv={kv_quant} seq={seq} attn={attn_impl}", file=sys.stderr)
 
     for batch in batches:
-        bs = 16
+        # int8 pools need 32-token blocks (int8 sublane tile)
+        bs = 32 if kv_quant == "int8" else 16
         bps = (seq + 256 + bs - 1) // bs
         ecfg = EngineConfig(max_model_len=seq + 256, kv_block_size=bs,
                             num_kv_blocks=batch * bps + 2,
                             max_num_seqs=batch, prefill_buckets=[128],
-                            quantization=quant)
+                            quantization=quant, kv_quantization=kv_quant)
         core = EngineCore(mcfg, ecfg, attn_impl=attn_impl,
                           param_dtype=jnp.bfloat16)
         statics = core.statics

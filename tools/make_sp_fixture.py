@@ -1,0 +1,51 @@
+"""Generate the committed SentencePiece fixture tests/data/sp/tiny.model.
+
+Deterministic (no RNG): a small unigram vocab with control pieces, word
+and subword pieces, single letters, and the full <0x00>..<0xFF> byte
+table (byte_fallback=True — the llama tokenizer.model shape). Scores make
+longer pieces win Viterbi where available. The bytes follow the public
+sentencepiece_model.proto field numbers (llm/sp_model.py
+write_model_proto), so a real `sentencepiece` install loads this file
+unchanged — the parity test in tests/test_sp_tokenizer.py runs wherever
+that package exists.
+
+Run: python tools/make_sp_fixture.py  (rewrites the fixture in place)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dynamo_tpu.llm.sp_model import (BYTE, CONTROL, NORMAL, UNKNOWN,
+                                     write_model_proto)
+
+WORDS = ["▁the", "▁quick", "▁brown", "▁fox", "▁jumps", "▁over", "▁lazy",
+         "▁dog", "▁hello", "▁wor", "ld", "▁t", "he", "ll", "o", "er",
+         "ing", "▁a", "▁of", "un", "re"]
+LETTERS = [chr(c) for c in range(ord("a"), ord("z") + 1)] + ["▁"]
+
+
+def build() -> bytes:
+    pieces = [("<unk>", 0.0, UNKNOWN),
+              ("<s>", 0.0, CONTROL),
+              ("</s>", 0.0, CONTROL)]
+    pieces += [(w, -2.0 - 0.01 * i, NORMAL) for i, w in enumerate(WORDS)]
+    pieces += [(c, -8.0, NORMAL) for c in LETTERS]
+    pieces += [(f"<0x{b:02X}>", -20.0, BYTE) for b in range(256)]
+    return write_model_proto(pieces, unk_id=0, bos_id=1, eos_id=2,
+                             pad_id=-1, byte_fallback=True,
+                             add_dummy_prefix=True)
+
+
+def main() -> None:
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tests", "data", "sp", "tiny.model")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "wb") as f:
+        f.write(build())
+    print(f"wrote {out} ({os.path.getsize(out)} bytes)")
+
+
+if __name__ == "__main__":
+    main()
