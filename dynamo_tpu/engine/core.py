@@ -249,6 +249,17 @@ class EngineCore:
         self.preemptions = 0
         self.lane_admissions = 0
         self.host_onboards = 0
+        # synchronous device→host fetches the engine loop has paid
+        # (harvests + admission token fetches): count + MEASURED stall
+        # seconds. On the tunneled rig each blocking fetch costs ~131 ms;
+        # on a local TPU-VM, microseconds — sampling host_stall_s around
+        # a latency window lets tools/serve_bench.py report
+        # host-scheduler-only latency net of the measured (not modeled)
+        # tunnel tax: an async copy that already landed, or a fetch of an
+        # already-host value, measures ~0 by construction (VERDICT r3
+        # next #7)
+        self.host_roundtrips = 0
+        self.host_stall_s = 0.0
 
     # ------------------------------------------------------------------ jit
     def _compile_jits(self) -> None:
@@ -609,7 +620,11 @@ class EngineCore:
             defer = (self.cfg.overlap_admission_fetch
                      and hasattr(tok, "copy_to_host_async"))
             if not defer:
+                if hasattr(tok, "copy_to_host_async"):  # device, not host
+                    self.host_roundtrips += 1
+                _t0 = time.monotonic()
                 tok, logprob = int(tok), float(logprob)
+                self.host_stall_s += time.monotonic() - _t0
         else:
             # prefill only the un-matched suffix — the prefix KV is already
             # in the pool's blocks (this is the TTFT win of prefix reuse)
@@ -679,7 +694,10 @@ class EngineCore:
             defer = (self.cfg.overlap_admission_fetch
                      and req.handoff is None)
             if not defer and not req.handoff_device:
+                self.host_roundtrips += 1
+                _t0 = time.monotonic()
                 tok, logprob = int(tok), float(logprob)
+                self.host_stall_s += time.monotonic() - _t0
         if req.handoff is not None:
             defer = False
         req.pos = n_prompt
@@ -829,9 +847,16 @@ class EngineCore:
         been in flight across a decode dispatch; fetch, emit the first
         token, and make the slot decodable."""
         pending, self._admissions = self._admissions, []
+        if pending:
+            # the async copies were issued at admission and usually land
+            # during the intervening dispatch harvest — host_stall_s
+            # records what the fetches below ACTUALLY cost (often ~0)
+            self.host_roundtrips += 1
         for req, tok_dev, logprob_dev in pending:
+            _t0 = time.monotonic()
             tok = int(np.asarray(tok_dev))
             logprob = float(np.asarray(logprob_dev))
+            self.host_stall_s += time.monotonic() - _t0
             req.last_token = tok
             req.first_token_time = time.monotonic()
             req.ready = True
@@ -1221,8 +1246,11 @@ class EngineCore:
         """Apply one dispatch's results: emissions, seq bookkeeping,
         EOS/budget/cancel finishes. Device overrun past a finish — or past
         a slot whose request changed since dispatch — is discarded."""
+        self.host_roundtrips += 1
+        _t0 = time.monotonic()
         toks_k = np.asarray(pending["toks"])       # [K, B] — ONE host fetch
         logprobs_k = np.asarray(pending["logprobs"])
+        self.host_stall_s += time.monotonic() - _t0
         K = pending["K"]
         applied = []
         for i, req in enumerate(pending["reqs"]):
