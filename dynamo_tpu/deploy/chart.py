@@ -1,0 +1,263 @@
+"""Helm-analog packaging for the K8s deployment (values → rendered).
+
+The reference ships helm charts (deploy/dynamo/helm/platform) validated
+by a render-test tier that exercises GOOD and BAD values files
+(deploy/Kubernetes/test_helm_charts.py:47, common/tests/{basic,
+invalid_values}.yaml). This image has no helm binary, so the analog is
+native: ``deploy/chart/templates/*.yaml`` hold the manifests with
+``${placeholder}`` slots, ``deploy/chart/values.yaml`` holds the
+defaults, and this module validates a values tree against a strict
+schema (unknown keys are typos, not extensions) and renders the final
+manifests. The committed ``deploy/k8s/*.yaml`` are the DEFAULT render —
+``render --check`` (and tests/test_deploy_manifests.py) fail on drift,
+so the raw-manifest workflow keeps working unchanged.
+
+CLI:
+  python -m dynamo_tpu.deploy.chart render [-f values.yaml] [-o outdir]
+  python -m dynamo_tpu.deploy.chart render --check   # drift gate
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import string
+import sys
+from typing import Dict, List, Optional
+
+import yaml
+
+__all__ = ["ChartError", "default_values", "validate_values", "render"]
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+CHART_DIR = os.path.join(REPO, "deploy", "chart")
+RENDERED_DIR = os.path.join(REPO, "deploy", "k8s")
+
+_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")  # RFC 1123
+_QTY_RE = re.compile(r"^[0-9]+(\.[0-9]+)?(m|Ki|Mi|Gi|Ti|k|M|G|T)?$")
+_TOPO_RE = re.compile(r"^[0-9]+x[0-9]+(x[0-9]+)?$")
+# values substituted into quoted YAML command strings: quotes, whitespace,
+# commas, backslashes or brackets would inject extra CLI arguments while
+# still parsing as YAML — reject them at validation, not at the cluster
+_SAFE_ARG_RE = re.compile(r"^[A-Za-z0-9/_.:@-]+$")
+
+
+class ChartError(ValueError):
+    """Invalid values: carries every problem, not just the first."""
+
+    def __init__(self, problems: List[str]):
+        self.problems = problems
+        super().__init__("invalid chart values:\n  - "
+                         + "\n  - ".join(problems))
+
+
+def default_values() -> dict:
+    with open(os.path.join(CHART_DIR, "values.yaml")) as f:
+        return yaml.safe_load(f)
+
+
+def _merge(base: dict, over: dict, path: str,
+           problems: List[str]) -> dict:
+    """Deep-merge ``over`` into ``base``; keys absent from base are
+    rejected (helm-schema-style strictness: a typo must not silently
+    deploy defaults)."""
+    out = dict(base)
+    for k, v in (over or {}).items():
+        if k not in base:
+            problems.append(f"unknown key {path}{k!r}")
+            continue
+        if isinstance(base[k], dict):
+            if not isinstance(v, dict):
+                problems.append(f"{path}{k} must be a mapping")
+                continue
+            out[k] = _merge(base[k], v, f"{path}{k}.", problems)
+        else:
+            out[k] = v
+    return out
+
+
+def _check(problems: List[str], cond: bool, msg: str) -> None:
+    if not cond:
+        problems.append(msg)
+
+
+def validate_values(v: dict) -> None:
+    """Raise ChartError listing every schema violation."""
+    p: List[str] = []
+
+    def is_int(x) -> bool:
+        return isinstance(x, int) and not isinstance(x, bool)
+
+    _check(p, isinstance(v["namespace"], str)
+           and _NAME_RE.match(v["namespace"] or ""),
+           f"namespace must be an RFC1123 label, got {v['namespace']!r}")
+    _check(p, isinstance(v["image"], str)
+           and _SAFE_ARG_RE.match(v["image"] or ""),
+           f"image must be a plain image reference "
+           f"(no spaces/quotes), got {v['image']!r}")
+    _check(p, isinstance(v["model"]["name"], str)
+           and _NAME_RE.match(v["model"]["name"] or ""),
+           f"model.name must be an RFC1123 label, got {v['model']['name']!r}")
+    _check(p, isinstance(v["model"]["path"], str)
+           and v["model"]["path"].startswith("/")
+           and _SAFE_ARG_RE.match(v["model"]["path"]),
+           f"model.path must be an absolute path with no "
+           f"spaces/quotes (it lands in a command string), "
+           f"got {v['model']['path']!r}")
+    bsz = v["kv_block_size"]
+    _check(p, is_int(bsz) and 8 <= bsz <= 256 and (bsz & (bsz - 1)) == 0,
+           f"kv_block_size must be a power of two in [8, 256], got {bsz!r}")
+    for comp in ("frontend", "decode", "prefill"):
+        r = v[comp]["replicas"]
+        _check(p, is_int(r) and r >= 0,
+               f"{comp}.replicas must be a non-negative integer, got {r!r}")
+    for comp, key in (("frontend", "port"), ("discovery", "port"),
+                      ("metrics", "port")):
+        port = v[comp][key]
+        _check(p, is_int(port) and 1 <= port <= 65535,
+               f"{comp}.{key} must be a port (1-65535), got {port!r}")
+    tpu = v["tpu"]
+    _check(p, is_int(tpu["chips"]) and tpu["chips"] >= 1,
+           f"tpu.chips must be a positive integer, got {tpu['chips']!r}")
+    _check(p, isinstance(tpu["topology"], str)
+           and _TOPO_RE.match(tpu["topology"] or ""),
+           f"tpu.topology must look like 2x4, got {tpu['topology']!r}")
+    mlp = v["decode"]["max_local_prefill_length"]
+    _check(p, is_int(mlp) and mlp >= 0,
+           f"decode.max_local_prefill_length must be >= 0, got {mlp!r}")
+    _check(p, _QTY_RE.match(str(v["models_pvc"]["size"])),
+           f"models_pvc.size must be a k8s quantity (e.g. 500Gi), "
+           f"got {v['models_pvc']['size']!r}")
+    sc = v["models_pvc"]["storage_class"]
+    _check(p, sc == "" or (isinstance(sc, str) and _NAME_RE.match(sc)),
+           f"models_pvc.storage_class must be empty or an RFC1123 "
+           f"label, got {sc!r}")
+    dd = v["discovery"]["data_dir"]
+    _check(p, dd == "" or (isinstance(dd, str) and dd.startswith("/")
+                           and _SAFE_ARG_RE.match(dd)),
+           f"discovery.data_dir must be empty or an absolute path with "
+           f"no spaces/quotes (it lands in a command string), got {dd!r}")
+    _check(p, _SAFE_ARG_RE.match(v["tpu"]["accelerator"] or "")
+           if isinstance(v["tpu"]["accelerator"], str) else False,
+           f"tpu.accelerator must be a plain identifier, "
+           f"got {v['tpu']['accelerator']!r}")
+    if p:
+        raise ChartError(p)
+
+
+def _substitutions(v: dict) -> Dict[str, str]:
+    sc = v["models_pvc"]["storage_class"]
+    dd = v["discovery"]["data_dir"]
+    return {
+        "ns": v["namespace"],
+        "image": v["image"],
+        "model_name": v["model"]["name"],
+        "model_path": v["model"]["path"],
+        "kv_block_size": str(v["kv_block_size"]),
+        "frontend_replicas": str(v["frontend"]["replicas"]),
+        "frontend_port": str(v["frontend"]["port"]),
+        "decode_replicas": str(v["decode"]["replicas"]),
+        "prefill_replicas": str(v["prefill"]["replicas"]),
+        "max_local_prefill": str(v["decode"]["max_local_prefill_length"]),
+        "discovery_port": str(v["discovery"]["port"]),
+        "metrics_port": str(v["metrics"]["port"]),
+        "tpu_accelerator": v["tpu"]["accelerator"],
+        "tpu_topology": v["tpu"]["topology"],
+        "tpu_chips": str(v["tpu"]["chips"]),
+        "pvc_size": str(v["models_pvc"]["size"]),
+        # conditional fragments (empty string = omitted)
+        "storage_class_line": (f"\n  storageClassName: {sc}" if sc else ""),
+        "discovery_data_dir_args": (
+            f',\n                    "--data-dir", "{dd}"' if dd else ""),
+    }
+
+
+def render(values: Optional[dict] = None) -> Dict[str, str]:
+    """Render every template with ``values`` (deep-merged over defaults,
+    validated). Returns {filename: manifest text}."""
+    problems: List[str] = []
+    merged = _merge(default_values(), values or {}, "", problems)
+    if problems:
+        raise ChartError(problems)
+    validate_values(merged)
+    subs = _substitutions(merged)
+    out: Dict[str, str] = {}
+    tdir = os.path.join(CHART_DIR, "templates")
+    for name in sorted(os.listdir(tdir)):
+        if not name.endswith(".yaml"):
+            continue
+        with open(os.path.join(tdir, name)) as f:
+            tpl = string.Template(f.read())
+        try:
+            text = tpl.substitute(subs)
+        except (KeyError, ValueError) as e:
+            # KeyError: unknown ${placeholder}; ValueError: a literal $
+            # not escaped as $$ (k8s manifests legitimately use $(VAR))
+            raise ChartError(
+                [f"template {name} has a bad placeholder: {e}"])
+        # every rendered doc must still be valid YAML
+        try:
+            list(yaml.safe_load_all(text))
+        except yaml.YAMLError as e:
+            raise ChartError([f"template {name} rendered invalid YAML: {e}"])
+        out[name] = text
+    if not out:
+        raise ChartError([f"no templates under {tdir}"])
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    r = sub.add_parser("render", help="render manifests from values")
+    r.add_argument("-f", "--values", default=None,
+                   help="values overrides (YAML; deep-merged over "
+                        "deploy/chart/values.yaml)")
+    r.add_argument("-o", "--out", default=None,
+                   help="write rendered manifests here (default: stdout)")
+    r.add_argument("--check", action="store_true",
+                   help="verify deploy/k8s matches the DEFAULT render "
+                        "(drift gate; exits 1 on mismatch)")
+    args = ap.parse_args()
+
+    if args.check and args.values:
+        ap.error("--check verifies the DEFAULT render; it cannot be "
+                 "combined with -f/--values")
+    overrides = None
+    if args.values:
+        with open(args.values) as f:
+            overrides = yaml.safe_load(f) or {}
+    rendered = render(overrides)
+
+    if args.check:
+        bad = []
+        for name, text in rendered.items():
+            path = os.path.join(RENDERED_DIR, name)
+            on_disk = open(path).read() if os.path.exists(path) else None
+            if on_disk != text:
+                bad.append(name)
+        if bad:
+            print(f"deploy/k8s drifted from the chart render: {bad}\n"
+                  f"re-render with: python -m dynamo_tpu.deploy.chart "
+                  f"render -o deploy/k8s", file=sys.stderr)
+            raise SystemExit(1)
+        print(f"deploy/k8s matches the default render "
+              f"({len(rendered)} files)")
+        return
+
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+        for name, text in rendered.items():
+            with open(os.path.join(args.out, name), "w") as f:
+                f.write(text)
+        print(f"rendered {len(rendered)} manifests into {args.out}")
+    else:
+        for name, text in rendered.items():
+            print(f"# ---- {name}")
+            print(text)
+
+
+if __name__ == "__main__":
+    main()

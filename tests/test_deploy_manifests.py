@@ -56,6 +56,129 @@ def test_tpu_workers_request_tpu_resources():
             assert any("tpu" in k for k in sel)
 
 
+# --------------------------------------------------------------- chart tier
+# The helm-analog render/validate layer (dynamo_tpu/deploy/chart.py) —
+# reference pattern: deploy/Kubernetes/test_helm_charts.py:47 renders
+# charts against valid AND invalid values files.
+
+
+def test_chart_default_render_matches_committed_manifests():
+    """The committed deploy/k8s manifests ARE the default render — any
+    drift between templates/values and the raw manifests fails here."""
+    from dynamo_tpu.deploy.chart import RENDERED_DIR, render
+    rendered = render()
+    assert len(rendered) == 7
+    for name, text in rendered.items():
+        with open(os.path.join(RENDERED_DIR, name)) as f:
+            assert f.read() == text, f"deploy/k8s/{name} drifted"
+
+
+def test_chart_render_applies_overrides_everywhere():
+    """The reference's basic.yaml-style GOOD values render: overrides
+    must land in every document (namespace, image, replicas, ports,
+    conditional fragments)."""
+    from dynamo_tpu.deploy.chart import render
+    rendered = render({
+        "namespace": "prod-serving", "image": "gcr.io/x/dynamo:1.2",
+        "kv_block_size": 32,
+        "frontend": {"replicas": 6, "port": 9000},
+        "decode": {"replicas": 16},
+        "discovery": {"port": 7000, "data_dir": "/var/dynamo"},
+        "models_pvc": {"size": "2Ti", "storage_class": "premium-rwx"},
+        "tpu": {"topology": "4x4", "chips": 16},
+    })
+    docs = [d for text in rendered.values()
+            for d in yaml.safe_load_all(text) if d]
+    for d in docs:
+        if d["kind"] != "Namespace":
+            assert d["metadata"]["namespace"] == "prod-serving"
+        for c in (d.get("spec", {}).get("template", {})
+                  .get("spec", {}).get("containers", [])):
+            assert c["image"] == "gcr.io/x/dynamo:1.2"
+    by_name = {(d["kind"], d["metadata"]["name"]): d for d in docs}
+    assert by_name[("Deployment", "frontend")]["spec"]["replicas"] == 6
+    assert by_name[("Deployment", "decode-worker")]["spec"]["replicas"] == 16
+    pvc = by_name[("PersistentVolumeClaim", "dynamo-tpu-models")]
+    assert pvc["spec"]["storageClassName"] == "premium-rwx"
+    assert pvc["spec"]["resources"]["requests"]["storage"] == "2Ti"
+    disc_cmd = by_name[("Deployment", "discovery")][
+        "spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--data-dir" in disc_cmd and "/var/dynamo" in disc_cmd
+    assert "7000" in disc_cmd
+    dec = by_name[("Deployment", "decode-worker")][
+        "spec"]["template"]["spec"]
+    assert dec["nodeSelector"][
+        "cloud.google.com/gke-tpu-topology"] == "4x4"
+    assert dec["containers"][0]["resources"]["requests"][
+        "google.com/tpu"] == "16"
+    # default-off conditionals stay omitted (the field, not the comment
+    # that mentions it)
+    plain = render()
+    pvc_plain = next(d for d in yaml.safe_load_all(
+        plain["15-models-pvc.yaml"]) if d)
+    assert "storageClassName" not in pvc_plain["spec"]
+    disc_plain = next(d for d in yaml.safe_load_all(
+        plain["10-discovery.yaml"]) if d and d["kind"] == "Deployment")
+    plain_cmd = disc_plain["spec"]["template"]["spec"][
+        "containers"][0]["command"]
+    assert "--data-dir" not in plain_cmd
+
+
+def test_chart_rejects_invalid_values():
+    """The reference's invalid_values.yaml tier: every bad values file
+    is REJECTED with a clear error naming the field — never rendered."""
+    import pytest
+
+    from dynamo_tpu.deploy.chart import ChartError, render
+    bad_cases = [
+        ({"namespace": "Not_Valid!"}, "namespace"),
+        ({"image": ""}, "image"),
+        ({"frontend": {"replicas": "two"}}, "frontend.replicas"),
+        ({"frontend": {"replicas": -1}}, "frontend.replicas"),
+        ({"frontend": {"port": 99999}}, "frontend.port"),
+        ({"kv_block_size": 48}, "kv_block_size"),          # not a pow2
+        ({"kv_block_size": True}, "kv_block_size"),        # bool is not int
+        ({"tpu": {"topology": "weird"}}, "tpu.topology"),
+        ({"models_pvc": {"size": "lots"}}, "models_pvc.size"),
+        ({"discovery": {"data_dir": "relative/path"}}, "data_dir"),
+        ({"model": {"path": "no-leading-slash"}}, "model.path"),
+        ({"frontned": {"replicas": 2}}, "unknown key"),    # typo'd key
+        ({"decode": {"replica": 3}}, "unknown key"),       # typo'd subkey
+    ]
+    for overrides, needle in bad_cases:
+        with pytest.raises(ChartError) as ei:
+            render(overrides)
+        assert needle in str(ei.value), (overrides, str(ei.value))
+    # multiple problems are all reported at once
+    with pytest.raises(ChartError) as ei:
+        render({"namespace": "Bad!", "image": "", "kv_block_size": 7})
+    msg = str(ei.value)
+    assert "namespace" in msg and "image" in msg and "kv_block_size" in msg
+
+
+def test_chart_rendered_manifests_pass_schema_checks():
+    """A non-default render must satisfy the same structural K8s checks
+    the committed manifests do (selector/label coherence, commands on
+    real modules, containers have resources)."""
+    from dynamo_tpu.deploy.chart import render
+    rendered = render({"namespace": "alt", "decode": {"replicas": 1}})
+    docs = [d for text in rendered.values()
+            for d in yaml.safe_load_all(text) if d]
+    assert {d["kind"] for d in docs} == {
+        "Namespace", "Deployment", "Service", "PersistentVolumeClaim"}
+    for d in docs:
+        if d["kind"] == "Deployment":
+            tmpl = d["spec"]["template"]
+            assert (d["spec"]["selector"]["matchLabels"]
+                    == tmpl["metadata"]["labels"])
+            for c in tmpl["spec"]["containers"]:
+                assert c["command"][0] == "python" and c["command"][1] == "-m"
+                importlib.import_module(c["command"][2])
+                assert "resources" in c
+        if d["kind"] == "Service":
+            assert d["spec"]["selector"], d["metadata"]["name"]
+
+
 def test_grafana_dashboard_queries_real_metrics():
     with open(os.path.join(REPO,
                            "deploy/metrics/grafana-dashboard.json")) as f:
