@@ -35,13 +35,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 CHART_DIR = os.path.join(REPO, "deploy", "chart")
 RENDERED_DIR = os.path.join(REPO, "deploy", "k8s")
 
-_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")  # RFC 1123
-_QTY_RE = re.compile(r"^[0-9]+(\.[0-9]+)?(m|Ki|Mi|Gi|Ti|k|M|G|T)?$")
-_TOPO_RE = re.compile(r"^[0-9]+x[0-9]+(x[0-9]+)?$")
+# \Z (not $) anchors: $ matches before a trailing newline, which a
+# double-quoted YAML scalar can carry into a rendered command string
+_NAME_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?\Z")  # RFC 1123
+_QTY_RE = re.compile(r"^[0-9]+(\.[0-9]+)?(m|Ki|Mi|Gi|Ti|k|M|G|T)?\Z")
+_TOPO_RE = re.compile(r"^[0-9]+x[0-9]+(x[0-9]+)?\Z")
 # values substituted into quoted YAML command strings: quotes, whitespace,
 # commas, backslashes or brackets would inject extra CLI arguments while
 # still parsing as YAML — reject them at validation, not at the cluster
-_SAFE_ARG_RE = re.compile(r"^[A-Za-z0-9/_.:@-]+$")
+_SAFE_ARG_RE = re.compile(r"^[A-Za-z0-9/_.:@-]+\Z")
 
 
 class ChartError(ValueError):
@@ -208,6 +210,24 @@ def render(values: Optional[dict] = None) -> Dict[str, str]:
     return out
 
 
+def drift(rendered: Dict[str, str],
+          rendered_dir: Optional[str] = None) -> List[str]:
+    """Names where deploy/k8s disagrees with ``rendered`` — mismatched
+    or missing files, plus ORPHANS (a yaml on disk with no template
+    would still be kubectl-applied by the documented workflow)."""
+    rdir = rendered_dir or RENDERED_DIR
+    bad = []
+    for name, text in rendered.items():
+        path = os.path.join(rdir, name)
+        on_disk = open(path).read() if os.path.exists(path) else None
+        if on_disk != text:
+            bad.append(name)
+    on_disk_yaml = {n for n in os.listdir(rdir) if n.endswith(".yaml")}
+    bad += [f"{n} (orphan: no template renders it)"
+            for n in sorted(on_disk_yaml - set(rendered))]
+    return bad
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -232,12 +252,7 @@ def main() -> None:
     rendered = render(overrides)
 
     if args.check:
-        bad = []
-        for name, text in rendered.items():
-            path = os.path.join(RENDERED_DIR, name)
-            on_disk = open(path).read() if os.path.exists(path) else None
-            if on_disk != text:
-                bad.append(name)
+        bad = drift(rendered)
         if bad:
             print(f"deploy/k8s drifted from the chart render: {bad}\n"
                   f"re-render with: python -m dynamo_tpu.deploy.chart "

@@ -105,6 +105,9 @@ def test_chart_render_applies_overrides_everywhere():
         "spec"]["template"]["spec"]["containers"][0]["command"]
     assert "--data-dir" in disc_cmd and "/var/dynamo" in disc_cmd
     assert "7000" in disc_cmd
+    # the Service must follow the container port or all ingress breaks
+    svc = by_name[("Service", "frontend")]
+    assert svc["spec"]["ports"][0]["targetPort"] == 9000
     dec = by_name[("Deployment", "decode-worker")][
         "spec"]["template"]["spec"]
     assert dec["nodeSelector"][
@@ -144,6 +147,10 @@ def test_chart_rejects_invalid_values():
         ({"model": {"path": "no-leading-slash"}}, "model.path"),
         ({"frontned": {"replicas": 2}}, "unknown key"),    # typo'd key
         ({"decode": {"replica": 3}}, "unknown key"),       # typo'd subkey
+        # $ anchors match before a trailing newline; \Z must not — a
+        # double-quoted YAML scalar can smuggle one into a command string
+        ({"model": {"path": "/models/m\n"}}, "model.path"),
+        ({"namespace": "ns\n"}, "namespace"),
     ]
     for overrides, needle in bad_cases:
         with pytest.raises(ChartError) as ei:
@@ -154,6 +161,24 @@ def test_chart_rejects_invalid_values():
         render({"namespace": "Bad!", "image": "", "kv_block_size": 7})
     msg = str(ei.value)
     assert "namespace" in msg and "image" in msg and "kv_block_size" in msg
+
+
+def test_chart_drift_gate_catches_mismatch_and_orphans(tmp_path):
+    """`render --check`'s comparator: flags edited files, missing files,
+    AND orphans (a yaml on disk no template renders — it would still be
+    kubectl-applied)."""
+    import shutil
+
+    from dynamo_tpu.deploy.chart import RENDERED_DIR, drift, render
+    rendered = render()
+    d = tmp_path / "k8s"
+    shutil.copytree(RENDERED_DIR, d)
+    assert drift(rendered, str(d)) == []
+    (d / "99-orphan.yaml").write_text("kind: ConfigMap\n")
+    (d / "00-namespace.yaml").write_text("kind: Namespace\n")  # edited
+    bad = drift(rendered, str(d))
+    assert "00-namespace.yaml" in bad
+    assert any("orphan" in b for b in bad)
 
 
 def test_chart_rendered_manifests_pass_schema_checks():
