@@ -2,6 +2,8 @@
 tokenizer (reference analogs: lib/llm/tests/preprocessor.rs snapshot tests,
 backend.rs in-module Decoder tests)."""
 
+import os
+
 import pytest
 
 from dynamo_tpu.llm.backend import Backend, Decoder, StopTrigger
@@ -206,20 +208,24 @@ async def test_token_ids_annotation(mdc):
     assert any(a.event == "token_ids" for a in events)
 
 
-def test_sentencepiece_gating(tmp_path):
-    """.model files route to the sentencepiece kind; without the library
-    the error says so instead of crashing on import (reference
-    tokenizers/sp.rs is the second tokenizer kind)."""
-    from dynamo_tpu.llm.tokenizer import load_tokenizer
+def test_sentencepiece_routing(tmp_path):
+    """.model files route to the sentencepiece kind, which LOADS in every
+    image since round 4 (native engine llm/sp_model.py when the
+    `sentencepiece` package is absent — reference tokenizers/sp.rs is
+    the second tokenizer kind; full coverage in test_sp_tokenizer.py).
+    A corrupt .model still fails with a clear error, not an import
+    crash."""
+    from dynamo_tpu.llm.tokenizer import (SentencePieceTokenizer,
+                                          load_tokenizer)
     fake = tmp_path / "tokenizer.model"
     fake.write_bytes(b"\x00spm")
-    try:
-        import sentencepiece  # noqa: F401
-        with pytest.raises(Exception):   # invalid model file
-            load_tokenizer(str(fake))
-    except ImportError:
-        with pytest.raises(RuntimeError, match="sentencepiece"):
-            load_tokenizer(str(fake))
+    with pytest.raises(Exception):       # invalid model file, either impl
+        load_tokenizer(str(fake))
+    real = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "data", "sp", "tiny.model")
+    tk = load_tokenizer(real)
+    assert isinstance(tk, SentencePieceTokenizer)
+    assert tk.decode(tk.encode("the dog").ids) == "the dog"
 
 
 def test_dir_prefers_hf_tokenizer_json(tmp_path):
