@@ -1,0 +1,257 @@
+"""CRD operator: Kubernetes custom resources → the deployment controller.
+
+Reference: the Go operator watches `DynamoDeployment` custom resources
+and reconciles cluster state, writing status back to the CR
+(deploy/dynamo/operator/internal/controller/dynamodeployment_controller.go,
+CRDs under deploy/dynamo/operator/config/crd/bases/). Our reconcile loop
+already exists (deploy/controller.py: store-watched specs → replica
+convergence → store-published status); this module is the CRD FACE of
+it: a level-triggered sync that
+
+  1. lists `DynamoTpuDeployment` resources (kubectl, injectable — the
+     tests drive a recorded fake, the pattern of test_deploy_k8s.py),
+  2. mirrors their specs into the controller's store (create; CAS update
+     on drift via spec.update_spec; delete when the CR disappears —
+     ownership is tracked in durable `deployments_cr_owned/` keys, so an
+     operator restart still garbage-collects specs whose CR went away
+     while it was down),
+  3. patches observed status back onto each CR's status subresource
+     (state, readyReplicas, observedGeneration, message — the SyncStatus
+     analog), writing only on change,
+  4. marks CRs that fail spec validation as state=invalid with the
+     validation message instead of mirroring garbage into the store.
+
+Level-triggered polling (not a watch) is deliberate: it is the
+controller-runtime resync model, it needs no kubectl watch session
+management, and every sync converges from observed state — a missed
+event cannot wedge it.
+
+Run: ``python -m dynamo_tpu.deploy.operator --runtime-server host:port``
+(in-cluster: the `operator` Deployment, whose pod has kubectl + RBAC for
+the CRD; apply deploy/k8s/crd/ first).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+from typing import Dict, Optional
+
+from .spec import (SPEC_PREFIX, STATUS_PREFIX, DeploymentSpec,
+                   DeploymentStatus, update_spec, validate_spec)
+
+logger = logging.getLogger("dynamo_tpu.deploy.operator")
+
+PLURAL = "dynamotpudeployments"
+OWNED_PREFIX = "deployments_cr_owned/"
+
+
+class KubectlCr:
+    """Minimal kubectl driver for the CRD (injectable binary)."""
+
+    def __init__(self, kubectl: str = "kubectl",
+                 namespace: str = "dynamo-tpu"):
+        self.kubectl = kubectl
+        self.namespace = namespace
+
+    async def _run(self, *args: str) -> str:
+        proc = await asyncio.create_subprocess_exec(
+            self.kubectl, *args, "-n", self.namespace,
+            stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE)
+        out, err = await proc.communicate()
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"kubectl {' '.join(args)} failed: {err.decode()[-400:]}")
+        return out.decode()
+
+    async def list(self) -> list:
+        out = await self._run("get", PLURAL, "-o", "json")
+        return json.loads(out).get("items", [])
+
+    async def patch_status(self, name: str, status: dict) -> None:
+        await self._run(
+            "patch", PLURAL, name, "--subresource", "status",
+            "--type", "merge", "-p", json.dumps({"status": status}))
+
+
+def cr_to_spec(cr: dict) -> DeploymentSpec:
+    """Map a CR's spec onto the controller's DeploymentSpec (camelCase →
+    our fields; defaults per the CRD schema)."""
+    name = cr["metadata"]["name"]
+    spec = cr.get("spec", {})
+    return DeploymentSpec(
+        name=name,
+        graph=spec.get("graph", ""),
+        config=spec.get("config"),
+        replicas=int(spec.get("replicas", 1)),
+        env={str(k): str(v) for k, v in (spec.get("env") or {}).items()},
+        max_restarts=(int(spec["maxRestarts"])
+                      if spec.get("maxRestarts") is not None else None),
+    )
+
+
+def _drifted(cur: DeploymentSpec, want: DeploymentSpec) -> bool:
+    """True if the CR's desired fields differ from the stored spec
+    (bookkeeping fields — generation, created_at — excluded)."""
+    return (cur.graph != want.graph or cur.config != want.config
+            or cur.replicas != want.replicas or cur.env != want.env
+            or cur.max_restarts != want.max_restarts)
+
+
+class CrOperator:
+    """Level-triggered CR ↔ store reconciler."""
+
+    def __init__(self, runtime, kube: Optional[KubectlCr] = None,
+                 interval: float = 2.0):
+        self.runtime = runtime
+        self.kube = kube or KubectlCr()
+        self.interval = interval
+        self._task: Optional[asyncio.Task] = None
+        self._stopping = False
+        self._last_status: Dict[str, tuple] = {}   # change-only patches
+        self.syncs = 0
+
+    async def start(self) -> "CrOperator":
+        self._task = asyncio.get_running_loop().create_task(
+            self._loop(), name="cr-operator")
+        return self
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._task is not None:
+            self._task.cancel()
+
+    async def _loop(self) -> None:
+        while not self._stopping:
+            try:
+                await self.sync_once()
+            except Exception:  # noqa: BLE001 — the operator must not die
+                logger.exception("CR sync failed")
+            await asyncio.sleep(self.interval)
+
+    async def sync_once(self) -> None:
+        store = self.runtime.store
+        crs = {cr["metadata"]["name"]: cr for cr in await self.kube.list()}
+        # a CR whose generation the operator has mirrored into the store
+        # this or an earlier sync; status.observedGeneration reports THIS
+        # (the k8s staleness contract: observedGeneration compares to the
+        # CR's metadata.generation — the store's internal generation can
+        # skew ahead when other writers touch owned specs)
+        mirrored: Dict[str, int] = {}
+
+        # 1+4: mirror CR specs into the store (validate first; only specs
+        # this operator OWNS may be touched — a same-name deployment made
+        # by llmctl/api-server must not be hijacked)
+        for name, cr in crs.items():
+            want = cr_to_spec(cr)
+            cr_gen = int(cr["metadata"].get("generation", 0))
+            err = (validate_spec(want.name, want.replicas,
+                                 want.max_restarts)
+                   or ("" if want.graph else "spec.graph is required"))
+            if err:
+                await self._status(name, cr, {"state": "invalid",
+                                              "message": err})
+                continue
+            owned = await store.kv_get(OWNED_PREFIX + name) is not None
+            entry = await store.kv_get(SPEC_PREFIX + name)
+            if entry is None:
+                if await store.kv_create(want.key(), want.to_json()):
+                    # marker only on a WON create: a lost race means a
+                    # foreign writer owns the name — adopting it would
+                    # let CR deletion garbage-collect their deployment
+                    await store.kv_put(OWNED_PREFIX + name, b"1")
+                    mirrored[name] = cr_gen
+                    logger.info("CR %s: created deployment spec", name)
+            elif owned:
+                cur = DeploymentSpec.from_json(entry.value)
+                if _drifted(cur, want):
+                    def mutate(s: DeploymentSpec) -> Optional[str]:
+                        s.graph = want.graph
+                        s.config = want.config
+                        s.replicas = want.replicas
+                        s.env = want.env
+                        s.max_restarts = want.max_restarts
+                        return None
+                    await update_spec(store, name, mutate)
+                    logger.info("CR %s: spec updated from CR drift", name)
+                mirrored[name] = cr_gen
+            else:
+                await self._status(name, cr, {
+                    "state": "conflict",
+                    "message": f"deployment {name!r} already exists and "
+                               f"is not CR-managed (created via "
+                               f"llmctl/api-server); delete it or rename "
+                               f"the CR"})
+
+        # 2: garbage-collect specs whose CR is gone (durable ownership —
+        # survives operator restarts)
+        for entry in await store.kv_get_prefix(OWNED_PREFIX):
+            name = entry.key[len(OWNED_PREFIX):]
+            if name not in crs:
+                await store.kv_delete(SPEC_PREFIX + name)
+                await store.kv_delete(OWNED_PREFIX + name)
+                self._last_status.pop(name, None)
+                logger.info("CR %s deleted: deployment spec removed", name)
+
+        # 3: status write-back (change-only)
+        for name, cr in crs.items():
+            if name not in mirrored:
+                continue               # invalid/conflict already patched
+            entry = await store.kv_get(STATUS_PREFIX + name)
+            if entry is None:
+                continue
+            st = DeploymentStatus.from_json(entry.value)
+            await self._status(name, cr, {
+                "state": st.state,
+                "readyReplicas": st.ready_replicas,
+                "observedGeneration": mirrored[name],
+                "message": st.message,
+            })
+        self.syncs += 1
+
+    async def _status(self, name: str, cr: dict, status: dict) -> None:
+        # cache key includes the CR's identity (uid, or creation stamp):
+        # a delete+recreate within one sync interval must NOT hit the old
+        # cache entry and leave the fresh CR's status empty
+        ident = (cr["metadata"].get("uid")
+                 or cr["metadata"].get("creationTimestamp") or "")
+        key = (ident, tuple(sorted(status.items())))
+        if self._last_status.get(name) == key:
+            return
+        await self.kube.patch_status(name, status)
+        self._last_status[name] = key
+
+
+async def _amain(args) -> None:
+    from ..runtime.distributed import DistributedRuntime
+    runtime = await DistributedRuntime.connect(args.runtime_server)
+    op = await CrOperator(
+        runtime, KubectlCr(args.kubectl, args.namespace),
+        interval=args.interval).start()
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await op.stop()
+        await runtime.shutdown()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--runtime-server", required=True,
+                    help="discovery daemon host:port")
+    ap.add_argument("--kubectl", default="kubectl")
+    ap.add_argument("--namespace", default="dynamo-tpu")
+    ap.add_argument("--interval", type=float, default=2.0)
+    args = ap.parse_args()
+    from ..runtime.log import setup_logging
+    setup_logging()
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -67,7 +67,7 @@ def test_chart_default_render_matches_committed_manifests():
     drift between templates/values and the raw manifests fails here."""
     from dynamo_tpu.deploy.chart import RENDERED_DIR, render
     rendered = render()
-    assert len(rendered) == 7
+    assert len(rendered) == 8
     for name, text in rendered.items():
         with open(os.path.join(RENDERED_DIR, name)) as f:
             assert f.read() == text, f"deploy/k8s/{name} drifted"
@@ -190,7 +190,8 @@ def test_chart_rendered_manifests_pass_schema_checks():
     docs = [d for text in rendered.values()
             for d in yaml.safe_load_all(text) if d]
     assert {d["kind"] for d in docs} == {
-        "Namespace", "Deployment", "Service", "PersistentVolumeClaim"}
+        "Namespace", "Deployment", "Service", "PersistentVolumeClaim",
+        "ServiceAccount", "Role", "RoleBinding"}
     for d in docs:
         if d["kind"] == "Deployment":
             tmpl = d["spec"]["template"]
