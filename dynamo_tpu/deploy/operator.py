@@ -192,6 +192,10 @@ class CrOperator:
             if name not in crs:
                 await store.kv_delete(SPEC_PREFIX + name)
                 await store.kv_delete(OWNED_PREFIX + name)
+                # drop the controller's status too: a recreated same-name
+                # CR must not inherit the dead deployment's state stamped
+                # with its own fresh observedGeneration
+                await store.kv_delete(STATUS_PREFIX + name)
                 self._last_status.pop(name, None)
                 logger.info("CR %s deleted: deployment spec removed", name)
 

@@ -45,32 +45,61 @@ def kv_value_lanes(k_cache: jax.Array) -> int:
     return lanes - KV_SCALE_LANES if k_cache.dtype == jnp.int8 else lanes
 
 
-def quantize_kv_rows(x: jax.Array) -> jax.Array:
+def quantize_kv_rows(x: jax.Array, groups: int = 1) -> jax.Array:
     """Per-row int8 with in-row (e, m) scale lanes: x [N, C] ->
     int8 [N, C + KV_SCALE_LANES]. scale = 2^e·(1+m/256) ≈ absmax/127
     (within 2^-9 relative). One home for the encoding; the kernel's
-    dequant_tile and dequant_kv_rows below are its readers."""
-    xf = x.astype(jnp.float32)
-    absmax = jnp.maximum(jnp.max(jnp.abs(xf), axis=1), 1e-30)
+    dequant_tile and dequant_kv_rows below are its readers.
+
+    ``groups=g`` (tp-sharded pools, parallel/sharding.kv_pspecs): the row
+    is g independent (values, scales) sections — [N, g*(C/g +
+    KV_SCALE_LANES)] — so sharding the lane axis into g equal chunks
+    gives every tp shard whole sections; each shard's local view is
+    exactly the groups=1 encoding over its own KV heads. Under pjit the
+    per-group absmax needs no cross-shard collective. groups=1 is
+    bit-identical to the ungrouped encoding."""
+    N, C = x.shape
+    xf = x.astype(jnp.float32).reshape(N, groups, C // groups)
+    absmax = jnp.maximum(jnp.max(jnp.abs(xf), axis=2), 1e-30)
     target = absmax / 127.0
     e = jnp.floor(jnp.log2(target))
     m = jnp.clip(jnp.round((target / jnp.exp2(e) - 1.0) * 256.0), 0, 255)
     scale = jnp.exp2(e) * (1.0 + m / 256.0)
-    q = jnp.clip(jnp.round(xf / scale[:, None]), -127, 127).astype(jnp.int8)
-    pad = jnp.zeros((x.shape[0], KV_SCALE_LANES), jnp.int8)
-    pad = pad.at[:, 0].set(jnp.clip(e, -127, 127).astype(jnp.int8))
+    q = jnp.clip(jnp.round(xf / scale[:, :, None]),
+                 -127, 127).astype(jnp.int8)
+    pad = jnp.zeros((N, groups, KV_SCALE_LANES), jnp.int8)
+    pad = pad.at[:, :, 0].set(jnp.clip(e, -127, 127).astype(jnp.int8))
     # m 0..255 stored as wrapped int8; readers mask with & 0xFF
-    pad = pad.at[:, 1].set(m.astype(jnp.uint8).astype(jnp.int8))
-    return jnp.concatenate([q, pad], axis=1)
+    pad = pad.at[:, :, 1].set(m.astype(jnp.uint8).astype(jnp.int8))
+    rows = jnp.concatenate([q, pad], axis=2)
+    return rows.reshape(N, groups * (C // groups + KV_SCALE_LANES))
+
+
+def kv_row_groups(lanes: int, C: int) -> int:
+    """Scale-group count of an int8 pool row: lanes = C + g·SCALE_LANES
+    (g = the tp shard count the pool was built for; llama.init_kv_cache
+    kv_shards)."""
+    g = (lanes - C) // KV_SCALE_LANES
+    if g < 1 or C + g * KV_SCALE_LANES != lanes or (g > 1 and C % g != 0):
+        raise ValueError(
+            f"int8 pool row width {lanes} does not decompose as value "
+            f"lanes C={C} plus whole {KV_SCALE_LANES}-lane scale groups")
+    return g
 
 
 def dequant_kv_rows(rows: jax.Array, C: int, out_dtype) -> jax.Array:
-    """Inverse of quantize_kv_rows for gathered rows [..., C+SCALE_LANES]."""
-    e = rows[..., C].astype(jnp.float32)
-    m = (rows[..., C + 1].astype(jnp.int32) & 0xFF).astype(jnp.float32)
+    """Inverse of quantize_kv_rows for gathered rows
+    [..., C + g·SCALE_LANES]; the group count is inferred from the row
+    width (kv_row_groups)."""
+    g = kv_row_groups(rows.shape[-1], C)
+    lead = rows.shape[:-1]
+    r = rows.reshape(lead + (g, rows.shape[-1] // g))
+    cg = C // g
+    e = r[..., cg].astype(jnp.float32)
+    m = (r[..., cg + 1].astype(jnp.int32) & 0xFF).astype(jnp.float32)
     scale = jnp.exp2(e) * (1.0 + m / 256.0)
-    return (rows[..., :C].astype(jnp.float32)
-            * scale[..., None]).astype(out_dtype)
+    vals = r[..., :cg].astype(jnp.float32) * scale[..., None]
+    return vals.reshape(lead + (C,)).astype(out_dtype)
 
 
 def softcap_scores(scores: jax.Array, cap) -> jax.Array:
@@ -390,13 +419,15 @@ def paged_attention_xla(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                         block_tables: jax.Array, seq_lens: jax.Array,
                         *, block_size: int, scale: float,
                         softcap: float | None = None,
-                        win_lo: jax.Array | None = None) -> jax.Array:
+                        win_lo: jax.Array | None = None,
+                        kv_heads: int | None = None) -> jax.Array:
     """q: [B, H, Dh]; k_cache/v_cache: [NTOK, KVH*Dh] (block-major pool;
-    int8 pools carry KV_SCALE_LANES extra in-row scale lanes and
+    int8 pools carry KV_SCALE_LANES extra in-row scale lanes — one group,
+    or ``kv_heads`` sizes the value lanes of a tp-grouped row — and
     dequantize after the gather); block_tables: [B, M] int32; seq_lens:
     [B] (kv length incl. current token). Returns [B, H, Dh]."""
     B, H, Dh = q.shape
-    C = kv_value_lanes(k_cache)
+    C = kv_heads * Dh if kv_heads is not None else kv_value_lanes(k_cache)
     KVH = C // Dh
     g = H // KVH
     idx = flat_token_indices(block_tables, block_size)        # [B, T]
@@ -748,17 +779,41 @@ def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
                     block_size: int, scale: float,
                     impl: str = "auto",
                     softcap: float | None = None,
-                    win_lo: jax.Array | None = None) -> jax.Array:
+                    win_lo: jax.Array | None = None,
+                    kv_heads: int | None = None) -> jax.Array:
     """Dispatch: pallas on TPU (block-major streaming kernel, incl. sliding
     windows, soft-capping, and int8 pools w/ in-row per-token scales), XLA
     gather fallback elsewhere and for geometries the kernel can't tile
-    (lane width KVH*Dh < 128; int8 pools with block_size % 32 != 0)."""
+    (lane width KVH*Dh < 128; int8 pools with block_size % 32 != 0).
+
+    ``kv_heads``: the true KV head count — required to size the value
+    lanes of a tp-GROUPED int8 pool (g scale groups per row; without it
+    the row width is assumed to carry exactly one group). Grouped pools
+    take the XLA path: the kernel's in-score dequant reads a single
+    tail scale group."""
+    B, H, Dh = q.shape
+    groups = 1
+    if k_cache.dtype == jnp.int8:
+        if kv_heads is None:
+            # refuse to infer: a grouped row of width C + g·SCALE_LANES
+            # also validates as a single-group row with inflated C, so
+            # silent inference could misread scale lanes as values
+            raise ValueError(
+                "int8 KV pools require kv_heads= (the row width alone "
+                "cannot distinguish a tp-grouped pool from a wider "
+                "single-group one)")
+        C = kv_heads * Dh
+        groups = kv_row_groups(k_cache.shape[-1], C)
     if impl == "auto":
-        B, H, Dh = q.shape
-        KVH = kv_value_lanes(k_cache) // Dh
-        impl = ("pallas" if _on_tpu()
+        KVH = (kv_heads if kv_heads is not None
+               else kv_value_lanes(k_cache) // Dh)
+        impl = ("pallas" if _on_tpu() and groups == 1
                 and pallas_supported(H, KVH, Dh, block_size,
                                      kv_dtype=k_cache.dtype) else "xla")
+    if groups > 1 and impl in ("pallas", "pallas_interpret"):
+        raise ValueError(
+            f"pallas decode kernel cannot read a tp-grouped int8 pool "
+            f"({groups} scale groups per row); use the XLA path")
     if impl == "pallas":
         return paged_attention_pallas(q, k_cache, v_cache, block_tables,
                                       seq_lens, block_size=block_size,
@@ -771,7 +826,8 @@ def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
                                       win_lo=win_lo, interpret=True)
     return paged_attention_xla(q, k_cache, v_cache, block_tables, seq_lens,
                                block_size=block_size, scale=scale,
-                               softcap=softcap, win_lo=win_lo)
+                               softcap=softcap, win_lo=win_lo,
+                               kv_heads=kv_heads)
 
 
 @functools.cache

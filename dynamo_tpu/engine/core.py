@@ -156,15 +156,24 @@ class EngineCore:
                 "kv_quantization + the host KV tier are not supported "
                 "together yet: the offload pump's wire format assumes "
                 "full-precision pool rows")
-        if (engine_cfg.kv_quantization != "none" and mesh is not None
-                and mesh.shape.get("tp", 1) > 1):
-            raise ValueError(
-                "kv_quantization + tp>1 is not supported yet: the int8 "
-                "pool's in-row scale lanes would be split across the "
-                "tp-sharded lane axis")
+        kv_shards = 1
+        if mesh is not None and engine_cfg.kv_quantization != "none":
+            # int8 + tensor parallelism: the pool row carries one
+            # (values, scales) section per tp shard so the lane-axis tp
+            # sharding never splits a scale group (attention.py
+            # quantize_kv_rows groups)
+            kv_shards = mesh.shape.get("tp", 1)
+            if model_cfg.num_kv_heads % kv_shards != 0:
+                raise ValueError(
+                    f"kv_quantization with tp={kv_shards} needs tp to "
+                    f"divide the KV head count "
+                    f"({model_cfg.num_kv_heads}) — each tp shard must "
+                    f"own whole heads to carry its own in-row scale "
+                    f"group")
         self.kv = llama.init_kv_cache(
             model_cfg, engine_cfg.num_kv_blocks, engine_cfg.kv_block_size,
-            dtype=param_dtype, quantization=engine_cfg.kv_quantization)
+            dtype=param_dtype, quantization=engine_cfg.kv_quantization,
+            kv_shards=kv_shards)
         if mesh is not None:
             # place params/KV under the tp/sp layout; every jitted step then
             # runs SPMD over the mesh with XLA-inserted ICI collectives

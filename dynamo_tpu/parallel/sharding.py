@@ -82,9 +82,10 @@ def kv_pspecs() -> Dict[str, P]:
     # KV heads split over tp — in the block-major pool [L, NTOK, KVH*Dh]
     # head vectors are contiguous lane groups, so sharding the last axis
     # keeps each head's pool wholly on one chip and paged-attention DMA
-    # never crosses chips. (int8 pools widen the lane axis with IN-ROW
-    # scale lanes, which this tp sharding would split mid-row — the
-    # engine refuses kv_quantization + tp>1, core.py.)
+    # never crosses chips. int8 pools widen each tp shard's section with
+    # its own IN-ROW scale group (llama.init_kv_cache kv_shards), so the
+    # same lane-axis sharding gives every shard whole (values, scales)
+    # sections.
     return {"k": P(None, None, "tp"), "v": P(None, None, "tp")}
 
 

@@ -195,9 +195,147 @@ async def test_int8_kv_serving_end_to_end():
         await core.stop()
 
 
+def test_quantize_rows_grouped_roundtrip():
+    """groups=g: each (values, scales) section quantizes independently —
+    the per-group scale equals that group's absmax/127, and dequant
+    reconstructs within half a scale step per element (the tp-sharded
+    encoding, llama.init_kv_cache kv_shards)."""
+    from dynamo_tpu.engine.attention import kv_row_groups
+    rng = np.random.default_rng(5)
+    N, C, g = 16, 64, 2
+    # wildly different magnitudes per group: a shared scale would lose
+    # the small group's resolution; per-group scales must not
+    x = np.concatenate([rng.standard_normal((N, C // 2)) * 100,
+                        rng.standard_normal((N, C // 2)) * 0.01],
+                       axis=1).astype(np.float32)
+    rows = quantize_kv_rows(jnp.asarray(x), groups=g)
+    width = C + g * KV_SCALE_LANES
+    assert rows.shape == (N, width)
+    assert kv_row_groups(width, C) == g
+    deq = np.asarray(dequant_kv_rows(rows, C, jnp.float32))
+    r = np.asarray(rows).reshape(N, g, width // g)
+    cg = C // g
+    e = r[..., cg].astype(np.float32)
+    m = r[..., cg + 1].astype(np.int64) & 0xFF
+    scale = np.exp2(e) * (1 + m / 256.0)              # [N, g]
+    exact = np.abs(x.reshape(N, g, cg)).max(axis=2) / 127.0
+    assert (scale >= exact * (1 - 2 ** -8) - 1e-12).all()
+    assert (scale <= exact * (1 + 2 ** -7) + 1e-12).all()
+    err = np.abs(deq.reshape(N, g, cg) - x.reshape(N, g, cg))
+    assert (err <= scale[..., None] * 0.5 + 1e-7).all()
+    # a row-wide (groups=1) encoding over the same data CANNOT hit the
+    # small group's tolerance — proves the groups are real
+    rows1 = quantize_kv_rows(jnp.asarray(x), groups=1)
+    deq1 = np.asarray(dequant_kv_rows(rows1, C, jnp.float32))
+    small = slice(C // 2, None)
+    assert np.abs(deq1[:, small] - x[:, small]).max() \
+        > np.abs(deq[:, small] - x[:, small]).max() * 10
+    with pytest.raises(ValueError, match="row width"):
+        kv_row_groups(C + KV_SCALE_LANES + 1, C)
+
+
+def test_int8_kv_tp_grouped_pool_matches_single_device():
+    """decode_forward over a tp=2 mesh with a shard-grouped int8 pool
+    matches the same grouped pool run on one device: identical greedy
+    tokens, logits within a small absolute band. (Bit-equality is not the
+    contract: XLA partitioning reorders float reductions, and a scale
+    whose absmax lands on a rounding boundary shifts its whole row by one
+    int8 LSB — a discrete ~0.8% step the band absorbs.)"""
+    from dynamo_tpu.engine.models.llama import (ModelStatics,
+                                                decode_forward,
+                                                prefill_forward)
+    from dynamo_tpu.parallel.sharding import (make_mesh, shard_kv,
+                                              shard_params)
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    cfg = _tiny_cfg()
+    rng = np.random.default_rng(11)
+    params = llama.init_params(cfg, jax.random.PRNGKey(3),
+                               dtype=jnp.float32)
+    statics = ModelStatics(cfg, block_size=8, attn_impl="xla")
+    T, bs, nblocks = 24, 8, 6
+    prompt = jnp.asarray(rng.integers(2, 500, size=(T,)), jnp.int32)
+    table = jnp.asarray(np.arange(1, nblocks + 1), jnp.int32)
+
+    def run(mesh):
+        kv = llama.init_kv_cache(cfg, nblocks + 1, bs,
+                                 quantization="int8", kv_shards=2)
+        p = params
+        if mesh is not None:
+            p = shard_params(p, mesh, cfg)
+            kv = shard_kv(kv, mesh)
+        _lg, kv = prefill_forward(p, kv, prompt, table, jnp.asarray(0),
+                                  jnp.asarray(T), statics)
+        outs = []
+        tok = jnp.asarray([3], jnp.int32)
+        for s in range(4):
+            lg, kv = decode_forward(p, kv, tok,
+                                    jnp.asarray([T + s], jnp.int32),
+                                    table[None, :], statics)
+            outs.append(np.asarray(lg[0]))
+            tok = jnp.asarray([int(np.argmax(outs[-1]))], jnp.int32)
+        return np.stack(outs)
+
+    ref = run(None)
+    got = run(make_mesh(dp=1, tp=2))
+    assert (got.argmax(axis=1) == ref.argmax(axis=1)).all()
+    assert np.abs(got - ref).max() < 0.02 * ref.std()
+
+
 @pytest.mark.asyncio
-async def test_int8_kv_refuses_disagg_host_tier_and_tp():
-    """The current limits fail LOUDLY, not silently (config.py)."""
+async def test_int8_kv_tp_engine_serves_end_to_end():
+    """EngineCore on a tp=2 mesh with an int8 pool (shard-grouped rows)
+    admits and finishes greedy requests — the former tp>1 refusal is
+    closed."""
+    from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineRequest
+    from dynamo_tpu.engine.sampling import SlotSampling
+    from dynamo_tpu.parallel.sharding import make_mesh
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    core = EngineCore(
+        _tiny_cfg(),
+        EngineConfig(max_model_len=128, kv_block_size=8, num_kv_blocks=64,
+                     max_num_seqs=2, prefill_buckets=[32, 64],
+                     decode_steps_per_dispatch=4, kv_quantization="int8"),
+        attn_impl="xla", param_dtype=jnp.float32,
+        mesh=make_mesh(dp=1, tp=2))
+    assert core.kv["k"].shape[-1] == 2 * 32 + 2 * KV_SCALE_LANES
+    try:
+        req = EngineRequest(rid="q", prompt=list(range(2, 40)),
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=8, eos_ids=frozenset())
+        await core.submit(req)
+        toks = []
+        while True:
+            item, _ = await req.out_queue.get()
+            if item is FINISH_SENTINEL:
+                break
+            toks.append(item)
+        assert len(toks) == 8
+        assert all(0 <= t < 512 for t in toks)
+    finally:
+        await core.stop()
+
+
+def test_int8_kv_tp_refuses_indivisible_heads():
+    """tp must divide the KV head count so every shard owns whole heads
+    + its own scale group — fails LOUDLY, not silently."""
+    from dynamo_tpu.parallel.sharding import make_mesh
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices")
+    with pytest.raises(ValueError, match="divide the KV head count"):
+        EngineCore(
+            _tiny_cfg(),          # num_kv_heads=2
+            EngineConfig(max_model_len=128, kv_block_size=8,
+                         num_kv_blocks=64, max_num_seqs=2,
+                         prefill_buckets=[32], kv_quantization="int8"),
+            attn_impl="xla", param_dtype=jnp.float32,
+            mesh=make_mesh(dp=1, tp=4))
+
+
+@pytest.mark.asyncio
+async def test_int8_kv_refuses_disagg_and_host_tier():
+    """The remaining limits fail LOUDLY, not silently."""
     from dynamo_tpu.engine.core import EngineRequest
     from dynamo_tpu.engine.sampling import SlotSampling
     with pytest.raises(ValueError, match="host KV tier"):
@@ -208,17 +346,6 @@ async def test_int8_kv_refuses_disagg_host_tier_and_tp():
                          prefill_buckets=[32], kv_quantization="int8",
                          host_kv_blocks=8),
             attn_impl="xla", param_dtype=jnp.float32)
-    if len(jax.devices()) >= 2:
-        from dynamo_tpu.parallel.sharding import make_mesh
-        with pytest.raises(ValueError, match="tp>1"):
-            EngineCore(
-                _tiny_cfg(),
-                EngineConfig(max_model_len=128, kv_block_size=8,
-                             num_kv_blocks=64, max_num_seqs=2,
-                             prefill_buckets=[32],
-                             kv_quantization="int8"),
-                attn_impl="xla", param_dtype=jnp.float32,
-                mesh=make_mesh(dp=1, tp=2))
     core = _engine("int8")
     try:
         with pytest.raises(NotImplementedError, match="disagg"):

@@ -148,7 +148,8 @@ def main():
                 return paged_attention(q, k_flat, v_flat,
                                        tables + li * nb, seq_lens,
                                        block_size=bs, scale=scale,
-                                       impl=statics.attn_impl)
+                                       impl=statics.attn_impl,
+                                       kv_heads=mcfg.num_kv_heads)
             return attn
 
         @partial(jax.jit, static_argnums=0)
