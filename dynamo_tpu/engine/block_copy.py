@@ -137,11 +137,17 @@ def fetch_wire(stacked: KVCache, n: int, num_heads: int) -> dict:
     ``num_heads`` is the GLOBAL kv-head count; on a multi-controller mesh
     each process harvests only its local head shard and the result's H
     axis is the local count (the host tier is per-rank — multihost mirror
-    pools hold each rank's shard, engine/multihost.py)."""
+    pools hold each rank's shard, engine/multihost.py).
+
+    int8 pools are OPAQUE rows (values + in-row scales; one wire "head",
+    core.wire_kv_heads): the proportional head arithmetic cannot
+    subdivide a single head, so each rank ships its whole local lane
+    shard as one head of whatever width it holds."""
     out = {}
     for k, v in stacked.items():
         arr = _local_np(v)[:, :n]
-        heads = num_heads * arr.shape[-1] // v.shape[-1]
+        heads = (1 if v.dtype == jnp.int8
+                 else num_heads * arr.shape[-1] // v.shape[-1])
         out[k] = to_wire_format(arr, heads)
     return out
 
@@ -207,9 +213,15 @@ def scatter_blocks_from_host(kv: KVCache, block_ids, host_values: dict,
     sample = next(iter(kv.values()))
     if not getattr(sample, "is_fully_addressable", True):
         lo, hi = _local_lane_range(sample)
-        d = next(iter(host_values.values())).shape[-1]
-        host_values = {k: v[:, lo // d:hi // d]
-                       for k, v in host_values.items()}
+        if sample.dtype == jnp.int8:
+            # opaque int8 rows ride the wire as ONE head (fetch_wire):
+            # a rank's shard is a lane slice of it, not a head subrange
+            host_values = {k: v[..., lo:hi]
+                           for k, v in host_values.items()}
+        else:
+            d = next(iter(host_values.values())).shape[-1]
+            host_values = {k: v[:, lo // d:hi // d]
+                           for k, v in host_values.items()}
     ids, vals = prep_host_values(block_ids, host_values)
     return scatter_prepped(kv, ids, vals, block_size)
 

@@ -150,12 +150,6 @@ class EngineCore:
                 params,
                 include_embed=engine_cfg.quantization == "int8")
         self.params = params
-        if (engine_cfg.kv_quantization != "none"
-                and engine_cfg.host_kv_blocks > 0):
-            raise ValueError(
-                "kv_quantization + the host KV tier are not supported "
-                "together yet: the offload pump's wire format assumes "
-                "full-precision pool rows")
         kv_shards = 1
         if mesh is not None and engine_cfg.kv_quantization != "none":
             # int8 + tensor parallelism: the pool row carries one
@@ -208,11 +202,11 @@ class EngineCore:
         host_pool = None
         self.offload_engine = None
         if engine_cfg.host_kv_blocks > 0:
-            from ..llm.kv.offload import HostKvPool, KvOffloadEngine
-            host_pool = HostKvPool(
-                engine_cfg.host_kv_blocks, model_cfg.num_layers,
-                model_cfg.num_kv_heads, engine_cfg.kv_block_size,
-                model_cfg.head_dim, dtype=param_dtype)
+            from ..llm.kv.offload import KvOffloadEngine, make_host_pool
+            host_pool = make_host_pool(
+                engine_cfg.host_kv_blocks, model_cfg,
+                engine_cfg.kv_block_size, engine_cfg.kv_quantization,
+                int(self.kv["k"].shape[-1]), param_dtype)
         self.kv_manager = KvBlockManager(
             engine_cfg.num_kv_blocks, engine_cfg.kv_block_size,
             enable_reuse=engine_cfg.enable_prefix_reuse,
@@ -390,15 +384,50 @@ class EngineCore:
         if self.offload_engine is not None:
             await self.offload_engine.stop()
 
+    @property
+    def wire_kv_heads(self) -> int:
+        """Head count for the head-major KV wire format (block_copy
+        to/from_wire_format): int8 pools ship whole rows — values plus
+        in-row scale lanes — as ONE opaque "head", so handoff/offload
+        round trips are bit-exact with no requantization; full-precision
+        pools use the real KV head count (which the dst-tp>src-tp
+        reshard slices per rank)."""
+        return (1 if self.cfg.kv_quantization != "none"
+                else self.model_cfg.num_kv_heads)
+
+    def _check_kv_payload_layout(self, lanes: int, dtype,
+                                 kind: str) -> None:
+        """A disagg KV payload must match this pool's row layout exactly:
+        same lane width (int8 rows bundle their tp-shard scale groups, so
+        width also encodes the prefill engine's tp) and same dtype.
+        Mismatches fail loudly — a scale-aware repack of int8 rows
+        across kv_quantization or tp settings is not supported."""
+        pool = self.kv["k"]
+        if lanes != pool.shape[-1] or np.dtype(dtype) != pool.dtype:
+            raise ValueError(
+                f"disagg {kind} KV payload layout mismatch: payload rows "
+                f"have {lanes} lanes of {np.dtype(dtype)}, this pool has "
+                f"{pool.shape[-1]} lanes of {pool.dtype} — prefill and "
+                f"decode engines must share kv_quantization (and tp, for "
+                f"int8 pools)")
+
     # ------------------------------------------------------------- frontend
     async def submit(self, req: EngineRequest) -> None:
-        if (self.cfg.kv_quantization != "none"
-                and (req.handoff is not None
-                     or req.precomputed is not None)):
-            raise NotImplementedError(
-                "disagg handoff/onboarding is not supported with an int8 "
-                "KV pool yet: the bulk KV planes move raw pool blocks "
-                "and do not carry the per-token scale arrays")
+        if req.precomputed is not None:
+            # validate the payload layout HERE, synchronously: the caller
+            # gets the error; a raise inside the engine loop's admission
+            # path would kill the loop and hang every in-flight request
+            from ..llm.kv_transport import DeviceKvPayload
+            pc = req.precomputed
+            if isinstance(pc, DeviceKvPayload):
+                sample = next(iter(pc.stacked.values()))
+                self._check_kv_payload_layout(sample.shape[-1],
+                                              sample.dtype, "device")
+            else:
+                sample = next(iter(pc.values.values()))
+                self._check_kv_payload_layout(
+                    sample.shape[1] * sample.shape[4], sample.dtype,
+                    "wire")
         self.ensure_started()
         await self.waiting.put(req)
         self._work_event.set()
@@ -906,6 +935,8 @@ class EngineCore:
                 targets=list(targets), skip=n_already,
                 n_needed=n_prompt_blocks)
         if targets:
+            # (payload layout was validated at submit() — a raise here
+            # would kill the engine loop)
             if isinstance(pc, DeviceKvPayload):
                 # device bulk plane: blocks hop prefill-devices →
                 # decode-devices (ICI, resharding under our mesh) with no
@@ -956,7 +987,7 @@ class EngineCore:
         stacked = gather_blocks_dispatch(self.kv, ids, self.cfg.kv_block_size)
         seq_hashes = list(req.seq.sequence_hashes[:req.registered_blocks])
         handoff = req.handoff
-        kvh = self.model_cfg.num_kv_heads
+        kvh = self.wire_kv_heads
 
         if req.handoff_device:
             # device bulk plane: ship the gather output as device arrays —

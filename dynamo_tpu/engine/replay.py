@@ -205,17 +205,17 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
             # replay of pool state may skip it
             continue
         if kind == "kv_store":
-            from ..llm.kv.offload import HostKvPool
+            from ..llm.kv.offload import make_host_pool
             if mirror is None:
                 if core.cfg.host_kv_blocks <= 0:
                     raise NotImplementedError(
                         "the record offloaded to a host tier but the "
                         "replaying core has host_kv_blocks=0 — replay "
                         "with the recorded engine config")
-                mirror = HostKvPool(
-                    core.cfg.host_kv_blocks, core.model_cfg.num_layers,
-                    core.model_cfg.num_kv_heads, bs,
-                    core.model_cfg.head_dim, dtype=dtype)
+                mirror = make_host_pool(
+                    core.cfg.host_kv_blocks, core.model_cfg, bs,
+                    core.cfg.kv_quantization,
+                    int(core.kv["k"].shape[-1]), dtype)
             top = max(it[1] for it in ev["items"])
             if top >= core.cfg.host_kv_blocks:
                 raise NotImplementedError(

@@ -387,7 +387,7 @@ class PrefillWorker:
                 from ..engine.block_copy import fetch_wire
                 values = await asyncio.to_thread(
                     fetch_wire, dev["stacked"], dev["n_blocks"],
-                    self.core.model_cfg.num_kv_heads)
+                    self.core.wire_kv_heads)
                 # wire fallback needs host scalars (device mode skipped the
                 # prefill-side fetch)
                 await handoff_wire(int(tok), float(logprob), values,

@@ -28,7 +28,7 @@ import numpy as np
 
 logger = logging.getLogger("dynamo_tpu.kv.offload")
 
-__all__ = ["HostKvPool", "KvOffloadEngine", "OffloadJob"]
+__all__ = ["HostKvPool", "KvOffloadEngine", "OffloadJob", "make_host_pool"]
 
 
 class HostKvPool:
@@ -42,7 +42,7 @@ class HostKvPool:
 
     def __init__(self, capacity_blocks: int, num_layers: int,
                  num_kv_heads: int, block_size: int, head_dim: int,
-                 dtype=np.float32):
+                 dtype=np.float32, opaque_rows: bool = False):
         self.capacity = capacity_blocks
         self.num_kv_heads = num_kv_heads
         # the arena materializes on FIRST store: on a multi-controller
@@ -51,6 +51,12 @@ class HostKvPool:
         # (engine/block_copy.py fetch_wire)
         self._shape_tail = (num_layers, num_kv_heads, block_size, head_dim)
         self._dtype = np.dtype(dtype)
+        # opaque_rows (int8 pools): blocks are whole pool rows — values
+        # plus in-row scale lanes — shipped as ONE wire "head" whose
+        # width is the row width (make_host_pool). A multi-controller
+        # rank's shard is then a clean fraction of that width, the same
+        # laziness the head count has for full-precision pools.
+        self.opaque_rows = opaque_rows
         self._arena: Optional[dict] = None
         self._free: List[int] = list(range(capacity_blocks - 1, -1, -1))
         self._by_hash: Dict[int, int] = {}       # seq_hash → slot
@@ -113,12 +119,14 @@ class HostKvPool:
     def _ensure_arena(self, block_kv: np.ndarray) -> None:
         if self._arena is None:
             L, _h, bs, d = self._shape_tail
-            if (block_kv.shape[0], block_kv.shape[2],
-                    block_kv.shape[3]) != (L, bs, d):
+            got_d = block_kv.shape[3]
+            d_ok = (d % got_d == 0 if self.opaque_rows else got_d == d)
+            if (block_kv.shape[0], block_kv.shape[2]) != (L, bs) or not d_ok:
                 raise ValueError(
                     f"host-tier block shape {block_kv.shape} does not "
-                    f"match config {self._shape_tail} (heads may differ "
-                    f"per rank; layers/block_size/head_dim may not)")
+                    f"match config {self._shape_tail} (heads — and for "
+                    f"opaque int8 rows the row width — may differ per "
+                    f"rank; layers/block_size may not)")
             shape = (self.capacity,) + block_kv.shape
             self._arena = {"k": np.zeros(shape, self._dtype),
                            "v": np.zeros(shape, self._dtype)}
@@ -192,6 +200,24 @@ class HostKvPool:
 
     def hit_rate(self) -> float:
         return self.match_hits / max(self.match_queries, 1)
+
+
+def make_host_pool(capacity_blocks: int, model_cfg, block_size: int,
+                   kv_quantization: str, pool_row_lanes: int,
+                   param_dtype) -> HostKvPool:
+    """The one way to build a host pool matched to an engine's device
+    pool (core.py and the offline replayer share it so they can't
+    drift). Full-precision pools use the head-major wire layout
+    [L, KVH, bs, Dh]; int8 pools ship whole rows (values + in-row scale
+    lanes, ``pool_row_lanes`` wide) as one opaque wire "head" — a
+    bit-exact round trip with no requantization error."""
+    if kv_quantization != "none":
+        return HostKvPool(capacity_blocks, model_cfg.num_layers, 1,
+                          block_size, pool_row_lanes, dtype=np.int8,
+                          opaque_rows=True)
+    return HostKvPool(capacity_blocks, model_cfg.num_layers,
+                      model_cfg.num_kv_heads, block_size,
+                      model_cfg.head_dim, dtype=param_dtype)
 
 
 class KvStoreEmitError(RuntimeError):

@@ -364,3 +364,89 @@ async def test_decode_prefix_reuse_after_remote_prefill(prompt):
         await prefill_core.stop()
         await decode_core.stop()
         await rt.shutdown()
+
+
+@pytest.mark.parametrize("plane", ["device", "wire"])
+async def test_remote_prefill_int8_pools_match_local(prompt, plane):
+    """Disagg with int8 KV pools on BOTH engines (the former refusal,
+    now closed): the handoff ships whole int8 rows — values plus in-row
+    scales — bit-exactly on either plane, so the disagg pair reproduces
+    an aggregated int8 engine's greedy tokens exactly."""
+    local_core = make_core(kv_quantization="int8")
+    try:
+        local = JaxEngine(local_core)
+        want = await collect_tokens(
+            await local.generate(make_request(prompt, rid="want8")))
+    finally:
+        await local_core.stop()
+    assert len(want) == 8
+
+    prefill_core = make_core(kv_quantization="int8")
+    decode_core = make_core(kv_quantization="int8")
+    got, engine, worker = await _disagg_pair_run(
+        prefill_core, decode_core, prompt, f"got8-{plane}", plane)
+    try:
+        assert got == want
+        assert prefill_core.total_prefill_tokens == len(prompt)
+        assert decode_core.total_prefill_tokens == 0
+        if plane == "device":
+            assert engine.device_transfers == 1
+    finally:
+        await prefill_core.stop()
+        await decode_core.stop()
+
+
+async def test_disagg_kv_layout_mismatch_fails_loudly():
+    """A decode engine rejects KV payloads whose row layout differs from
+    its own pool — int8 vs full-precision, and int8 rows from a
+    different tp (whose width bundles a different scale-group count).
+    The scale-aware repack is unsupported; the failure must be loud."""
+    core8 = make_core(kv_quantization="int8")
+    core_f = make_core()
+    try:
+        lanes8 = core8.kv["k"].shape[-1]          # C + 128
+        lanes_f = core_f.kv["k"].shape[-1]        # C
+        with pytest.raises(ValueError, match="layout mismatch"):
+            core_f._check_kv_payload_layout(lanes8, np.int8, "wire")
+        with pytest.raises(ValueError, match="layout mismatch"):
+            core8._check_kv_payload_layout(lanes_f, np.float32, "wire")
+        # same width, wrong dtype must not pass either
+        with pytest.raises(ValueError, match="layout mismatch"):
+            core8._check_kv_payload_layout(lanes8, np.float32, "device")
+        # int8 rows from a tp=2 prefill carry 2 scale groups → wider
+        with pytest.raises(ValueError, match="layout mismatch"):
+            core8._check_kv_payload_layout(
+                lanes8 + 128, np.int8, "device")
+        core8._check_kv_payload_layout(lanes8, np.int8, "wire")  # ok
+        core_f._check_kv_payload_layout(lanes_f, np.float32, "wire")
+
+        # end-to-end: submit() delivers the error SYNCHRONOUSLY to the
+        # caller (a raise inside the engine loop would kill it and hang
+        # every in-flight request), and the engine keeps serving after
+        from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineRequest
+        from dynamo_tpu.engine.sampling import SlotSampling
+        bad = KvPayload(
+            request_id="bad", first_token=3, first_logprob=0.0,
+            seq_hashes=[1],
+            values={"k": np.zeros((2, 1, 1, 8, lanes8), np.int8),
+                    "v": np.zeros((2, 1, 1, 8, lanes8), np.int8)})
+        req = EngineRequest(rid="bad", prompt=list(range(2, 12)),
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=2, eos_ids=frozenset(),
+                            precomputed=bad)
+        with pytest.raises(ValueError, match="layout mismatch"):
+            await core_f.submit(req)
+        ok = EngineRequest(rid="ok", prompt=list(range(2, 12)),
+                           sampling=SlotSampling(temperature=0.0),
+                           max_new_tokens=2, eos_ids=frozenset())
+        await core_f.submit(ok)
+        toks = []
+        while True:
+            item, _ = await ok.out_queue.get()
+            if item is FINISH_SENTINEL:
+                break
+            toks.append(item)
+        assert len(toks) == 2
+    finally:
+        await core8.stop()
+        await core_f.stop()

@@ -124,10 +124,16 @@ async def test_offload_engine_write_back_and_manager_fallthrough():
 
 
 @pytest.mark.asyncio
-async def test_engine_core_multi_turn_offload_onboard_equivalence():
+@pytest.mark.parametrize("kv_quant", ["none", "int8"])
+async def test_engine_core_multi_turn_offload_onboard_equivalence(kv_quant):
     """End-to-end through EngineCore: generate with prompt P (registers +
     offloads on finish), wipe the device reuse pool, resubmit P — the host
-    tier restores the prefix and generation is identical to a cold run."""
+    tier restores the prefix and generation is identical to a cold run.
+
+    int8 pools ship whole rows (values + in-row scales) as one opaque
+    wire "head" (offload.make_host_pool), so the host round trip is
+    bit-exact — the restored continuation must match exactly, same as
+    full precision."""
     import jax.numpy as jnp
     from dynamo_tpu.engine.config import EngineConfig, ModelConfig
     from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineCore, EngineRequest
@@ -138,8 +144,12 @@ async def test_engine_core_multi_turn_offload_onboard_equivalence():
                        max_position_embeddings=256)
     ecfg = EngineConfig(max_model_len=64, kv_block_size=4, num_kv_blocks=32,
                         max_num_seqs=2, prefill_buckets=[32, 64],
-                        host_kv_blocks=16)
+                        host_kv_blocks=16, kv_quantization=kv_quant)
     core = EngineCore(mcfg, ecfg, attn_impl="xla", param_dtype=jnp.float32)
+    if kv_quant == "int8":
+        host = core.offload_engine.host_pool
+        assert host.opaque_rows and host.num_kv_heads == 1
+        assert host._dtype == np.int8
     prompt = list(range(1, 13))  # 3 full blocks
 
     async def run_once():

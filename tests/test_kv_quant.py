@@ -334,26 +334,23 @@ def test_int8_kv_tp_refuses_indivisible_heads():
 
 
 @pytest.mark.asyncio
-async def test_int8_kv_refuses_disagg_and_host_tier():
-    """The remaining limits fail LOUDLY, not silently."""
-    from dynamo_tpu.engine.core import EngineRequest
-    from dynamo_tpu.engine.sampling import SlotSampling
-    with pytest.raises(ValueError, match="host KV tier"):
-        EngineCore(
-            _tiny_cfg(),
-            EngineConfig(max_model_len=128, kv_block_size=8,
-                         num_kv_blocks=64, max_num_seqs=2,
-                         prefill_buckets=[32], kv_quantization="int8",
-                         host_kv_blocks=8),
-            attn_impl="xla", param_dtype=jnp.float32)
-    core = _engine("int8")
+async def test_int8_kv_host_tier_and_disagg_are_open():
+    """The former int8 × {host tier, disagg} refusals are closed: an int8
+    engine with a host tier builds an opaque-row int8 host pool, and a
+    handoff request is accepted. (Round-trip equivalence lives in
+    test_kv_offload.py / test_disagg.py; this guards the constructor
+    paths.)"""
+    core = EngineCore(
+        _tiny_cfg(),
+        EngineConfig(max_model_len=128, kv_block_size=8,
+                     num_kv_blocks=64, max_num_seqs=2,
+                     prefill_buckets=[32], kv_quantization="int8",
+                     host_kv_blocks=8),
+        attn_impl="xla", param_dtype=jnp.float32)
     try:
-        with pytest.raises(NotImplementedError, match="disagg"):
-            await core.submit(EngineRequest(
-                rid="h", prompt=[1, 2, 3],
-                sampling=SlotSampling(temperature=0.0),
-                max_new_tokens=1, eos_ids=frozenset(),
-                handoff=lambda *a: None, handoff_device=True))
+        host = core.offload_engine.host_pool
+        assert host.opaque_rows and host.num_kv_heads == 1
+        assert core.wire_kv_heads == 1
     finally:
         await core.stop()
 
