@@ -296,12 +296,15 @@ class EngineConfig:
     # KV tier, no disagg handoff/onboarding (the bulk planes move raw
     # pool blocks and don't carry scale arrays yet).
     kv_quantization: str = "none"
-    # weight-only quantization: "none" | "int8" | "int8-noembed"
-    # (engine/quant.py — int8 weights + per-output-channel scales, dequant
-    # fused into the matmuls; halves the per-step weights-read floor).
-    # "int8-noembed" keeps the embedding (and a tied lm head) in the load
-    # dtype — a quality/bandwidth middle ground. The reference serves FP8
-    # models via its engines; this is the native analog.
+    # weight-only quantization: "none" | "int8" | "int8-noembed" |
+    # "int4" | "int4-noembed" (engine/quant.py — narrow weights with
+    # dequant fused into the matmuls; int8 = per-output-channel scales,
+    # halves the per-step weights-read floor; int4 = AWQ-style
+    # per-(group-of-128, channel) scales on the dense matmuls + lm_head
+    # with an int8 embed, quarters it). "-noembed" keeps the embedding
+    # (and a tied lm head) in the load dtype — a quality/bandwidth middle
+    # ground. The reference serves FP8/AWQ models via its engines; this
+    # is the native analog.
     quantization: str = "none"
     seed: int = 0
 

@@ -129,26 +129,30 @@ class EngineCore:
         self.statics = llama.ModelStatics(
             cfg=model_cfg, block_size=engine_cfg.kv_block_size,
             attn_impl=attn_impl)
-        if engine_cfg.quantization not in ("none", "int8", "int8-noembed"):
+        if engine_cfg.quantization not in ("none", "int8", "int8-noembed",
+                                           "int4", "int4-noembed"):
             raise ValueError(
                 f"unknown quantization {engine_cfg.quantization!r}")
         quantized = engine_cfg.quantization != "none"
+        # int4 = grouped-int4 dense matmuls + lm_head, int8 embed
+        # (quant.py module docstring); -noembed leaves the embed in the
+        # load dtype for either width
+        qbits = 4 if engine_cfg.quantization.startswith("int4") else 8
+        qembed = not engine_cfg.quantization.endswith("-noembed")
         if params is None and quantized:
             # streaming init→quantize: never materializes the full bf16
             # tree (16 GB for 8B geometry — OOM on one 16 GB v5e)
             from .quant import init_params_quantized
             params = init_params_quantized(
                 model_cfg, jax.random.PRNGKey(engine_cfg.seed),
-                dtype=param_dtype,
-                include_embed=engine_cfg.quantization == "int8")
+                dtype=param_dtype, include_embed=qembed, bits=qbits)
         elif params is None:
             params = llama.init_params(
                 model_cfg, jax.random.PRNGKey(engine_cfg.seed), dtype=param_dtype)
         elif quantized:
             from .quant import quantize_params
             params = quantize_params(
-                params,
-                include_embed=engine_cfg.quantization == "int8")
+                params, include_embed=qembed, bits=qbits)
         self.params = params
         kv_shards = 1
         if mesh is not None and engine_cfg.kv_quantization != "none":
@@ -182,10 +186,11 @@ class EngineCore:
                 self.model_cfg = model_cfg
                 self.statics = dataclasses.replace(self.statics,
                                                    cfg=model_cfg)
-        if model_cfg.lm_head_pallas and engine_cfg.quantization != "none":
+        if model_cfg.lm_head_pallas and quantized:
             # eager one-time kernel selftest (must run OUTSIDE jit traces):
             # a lowering failure on this backend degrades to the XLA head
-            # paths instead of breaking every decode program
+            # paths instead of breaking every decode program (the head is
+            # int8 under every quantization mode, incl. int4)
             from .attention import _on_tpu
             from .lm_head import kernel_selftest
             if _on_tpu() and not kernel_selftest():
@@ -267,9 +272,15 @@ class EngineCore:
     # ------------------------------------------------------------------ jit
     def _compile_jits(self) -> None:
         statics = self.statics
+        # packed-int4 weights unpack ONCE at the top of every program —
+        # a K-step decode dispatch then reads S4 at packed bandwidth
+        # (engine/quant.py module docstring; S4 cannot cross the jit
+        # boundary on this backend)
+        from .quant import unpack_params
 
         def prefill(params, kv, tokens, block_table, start_pos, true_len,
                     key, temperature, top_k, top_p):
+            params = unpack_params(params)
             logits, kv = llama.prefill_forward(
                 params, kv, tokens, block_table, start_pos, true_len, statics)
             tok, logprob = sample_tokens(
@@ -281,6 +292,7 @@ class EngineCore:
 
         def decode(params, kv, tokens, positions, block_tables,
                    keys, temperature, top_k, top_p):
+            params = unpack_params(params)
             logits, kv = llama.decode_forward(
                 params, kv, tokens, positions, block_tables, statics)
             toks, logprobs = sample_tokens(logits, keys, temperature,
@@ -298,6 +310,7 @@ class EngineCore:
         def decode_k(params, kv, tokens, positions, block_tables,
                      seeds, steps0, temperature, top_k, top_p,
                      planned, planned_mask):
+            params = unpack_params(params)
             # planned [K, B] / planned_mask [K, B]: lane-prefill slots feed
             # predetermined prompt tokens per step instead of chaining the
             # sample; the step after a lane's last planned token chains the
@@ -339,6 +352,7 @@ class EngineCore:
 
             def prefill_sp(params, kv, tokens, block_table, true_len,
                            key, temperature, top_k, top_p):
+                params = unpack_params(params)
                 logits, kv = llama.prefill_forward_sp(
                     params, kv, tokens, block_table, true_len, statics, mesh)
                 tok, logprob = sample_tokens(

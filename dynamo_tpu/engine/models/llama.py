@@ -383,6 +383,10 @@ def _lm_head_kernel_ok(head: QuantizedArray,
         return False
     if cfg is not None and not cfg.lm_head_pallas:
         return False
+    if head.group or head.q.dtype != jnp.int8:
+        # the fused kernel's dequant is per-column int8; grouped-int4
+        # heads take the XLA paths (mm handles the grouped contraction)
+        return False
     from ..lm_head import TILE_V
     if head.q.shape[1] % TILE_V != 0:
         return False

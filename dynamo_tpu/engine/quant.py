@@ -1,19 +1,42 @@
-"""Weight-only int8 quantization for the serving engine.
+"""Weight-only int8/int4 quantization for the serving engine.
 
-The reference's headline configs serve FP8-quantized models through its
+The reference's headline configs serve quantized models through its
 external engines (BASELINE: R1-Distill-Llama-70B FP8 on vLLM/TRT-LLM;
-docs/architecture.md benchmarks). Our engine owns the model, so the analog
-is native: weights are stored int8 with per-output-channel scales and
-dequantized inside the matmul — XLA reads int8 from HBM and fuses the
-convert+scale into the MXU op, halving the per-decode-step weights-read
-floor (the dominant cost at small batch).
+docs/architecture.md benchmarks; AWQ/int4 checkpoints via vLLM). Our
+engine owns the model, so the analog is native: weights are stored
+int8/int4 and dequantized inside the matmul — XLA reads the narrow dtype
+from HBM and fuses the convert+scale into the MXU op, cutting the
+per-decode-step weights-read floor (the dominant cost at small batch)
+2×/4× vs bf16. int4 HBM streaming measured real on v5e: ~0.5 B/elem
+effective, 1.9× the int8 read rate (PERF.md int4 probe).
 
-Scheme: symmetric absmax per output channel (the last axis of a stacked
-[L, D, F] weight; per row for the [V, D] embedding so the token gather
-dequantizes cheaply and a tied lm head reuses the same scales per column;
-per (layer, expert, out-channel) for the stacked MoE expert tensors —
-for mixtral-class models the experts are the bulk of the weights). Norms,
-biases, and the MoE router stay in the load dtype.
+int8 scheme: symmetric absmax per output channel (the last axis of a
+stacked [L, D, F] weight; per row for the [V, D] embedding so the token
+gather dequantizes cheaply and a tied lm head reuses the same scales per
+column; per (layer, expert, out-channel) for the stacked MoE expert
+tensors — for mixtral-class models the experts are the bulk of the
+weights). Norms, biases, and the MoE router stay in the load dtype.
+
+int4 scheme (AWQ-style group quantization, minus the activation-aware
+calibration which needs calibration data): one scale per
+(stack axes, contraction GROUP of 128, out-channel) — per-channel-only
+int4 is too coarse for real checkpoints' outlier channels. The grouped
+matmul contracts per group and applies scales between the two einsums
+(:func:`mm`). Applied to the dense layer matmuls + lm_head; the
+embedding stays int8 (its per-row gather scheme is already cheap) and
+MoE experts stay int8 (the grouped expert-einsum generalization isn't
+worth its complexity until a MoE config is weights-read-bound at int8).
+
+int4 STORAGE is packed — two signed nibbles per int8 byte, adjacent
+contraction rows paired — because S4 jax.Arrays cannot cross the jit
+boundary on the axon/TPU backend (a relayout device_put recursion bug;
+measured). Each jitted program calls :func:`unpack_params` ONCE at its
+top: bitcast int8→int4 ([.., D/2, F] → [.., D/2, F, 2]), un-interleave,
+and an optimization_barrier pins the unpacked S4 buffer, so a K-step
+decode dispatch pays one ~weights-pass unpack and then K steps read S4
+at packed (0.5 B/elem) bandwidth. Measured on v5e (8192×14336, B=32,
+K=32): 0.040 ms/step incl. amortized unpack vs int8's 0.093 — the win
+scales with decode_steps_per_dispatch.
 """
 
 from __future__ import annotations
@@ -23,39 +46,78 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
-__all__ = ["QuantizedArray", "quantize_array", "quantize_params",
-           "mm", "qeinsum"]
+__all__ = ["QuantizedArray", "quantize_array", "quantize_array_grouped",
+           "quantize_params", "mm", "qeinsum", "GROUP_SIZE",
+           "unpack_params", "pack_int4_rows", "unpack_int4_rows"]
+
+# int4 contraction-group width (AWQ convention; divides every serving
+# model's hidden/intermediate dims — falls back to one whole-axis group
+# for tiny test geometries)
+GROUP_SIZE = 128
 
 
 @jax.tree_util.register_pytree_node_class
 class QuantizedArray:
-    """int8 tensor + broadcastable f32 scale; dequantizes as q * scale."""
+    """int8/int4 tensor + f32 scale; dequantizes as q * scale.
 
-    def __init__(self, q: jax.Array, scale: jax.Array):
+    ``group`` == 0: scale is broadcast-shaped against q (per-channel
+    int8). ``group`` > 0: logical q is [..., D, F] with one scale per
+    (contraction group, out-channel) — scale [..., D/group, F] — the
+    grouped-int4 encoding (module docstring). ``packed4``: q holds two
+    signed nibbles per byte, [..., D/2, F] int8 — unpack with
+    :func:`unpack_int4_rows` (or the tree-level :func:`unpack_params`)
+    before computing. ``no_kernel``: the Pallas grouped matmul
+    (quant_matmul.py) must not serve this leaf — set by shard_params
+    under any multi-device mesh, where pallas_call has no GSPMD
+    partitioning rule."""
+
+    def __init__(self, q: jax.Array, scale: jax.Array, group: int = 0,
+                 packed4: bool = False, no_kernel: bool = False):
         self.q = q
         self.scale = scale
+        self.group = group
+        self.packed4 = packed4
+        self.no_kernel = no_kernel
 
     @property
-    def shape(self):
+    def shape(self):           # the LOGICAL (unpacked) shape
+        if self.packed4:
+            s = self.q.shape
+            return s[:-2] + (s[-2] * 2, s[-1])
         return self.q.shape
 
     @property
     def dtype(self):           # the *logical* dtype callers compute in
         return self.scale.dtype
 
+    def unpacked(self) -> "QuantizedArray":
+        if not self.packed4:
+            return self
+        return QuantizedArray(unpack_int4_rows(self.q), self.scale,
+                              group=self.group)
+
     def dequantize(self, dtype=None) -> jax.Array:
-        out = self.q.astype(self.scale.dtype) * self.scale
+        w = self.unpacked()
+        if w.group:
+            s = jnp.repeat(w.scale, w.group, axis=-2)
+            out = w.q.astype(w.scale.dtype) * s
+        else:
+            out = w.q.astype(w.scale.dtype) * w.scale
         return out.astype(dtype) if dtype is not None else out
 
     def tree_flatten(self):
-        return (self.q, self.scale), None
+        return (self.q, self.scale), (self.group, self.packed4,
+                                      self.no_kernel)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children)
+        return cls(*children, group=aux[0], packed4=aux[1],
+                   no_kernel=aux[2])
 
     def __repr__(self):
-        return f"QuantizedArray(q={self.q.shape}, scale={self.scale.shape})"
+        return (f"QuantizedArray(q={self.q.shape}, "
+                f"scale={self.scale.shape}, group={self.group}, "
+                f"packed4={self.packed4})")
 
 
 def quantize_array(w: jax.Array, *,
@@ -73,10 +135,126 @@ def quantize_array(w: jax.Array, *,
     return QuantizedArray(q, scale.astype(jnp.float32))
 
 
+def pack_int4_rows(q: jax.Array) -> jax.Array:
+    """int4-valued int8 [..., D, F] (D even) -> packed int8 [..., D/2, F]:
+    adjacent contraction rows 2d/2d+1 become the low/high nibble of one
+    byte — the layout jax.lax.bitcast_convert_type(int8 -> int4)
+    reverses (low nibble first; verified identical on CPU and TPU)."""
+    # all-int8 arithmetic: wider intermediates would materialize int32
+    # copies of the whole weight tensor during streaming init (an OOM at
+    # 70B scale); int8 shifts wrap to exactly the bit patterns we want
+    lo = q[..., 0::2, :] & jnp.int8(0xF)
+    hi = jnp.left_shift(q[..., 1::2, :], 4)
+    return lo | hi
+
+
+def unpack_int4_rows(packed: jax.Array) -> jax.Array:
+    """Inverse of :func:`pack_int4_rows`: packed int8 [..., D/2, F] ->
+    int4 [..., D, F]. A bitcast (free view of the packed bytes) plus one
+    un-interleave — call OUTSIDE per-step loops so a K-step dispatch
+    pays it once (module docstring)."""
+    pairs = jax.lax.bitcast_convert_type(packed, jnp.int4)  # [.., D/2, F, 2]
+    un = jnp.moveaxis(pairs, -1, -2)                        # [.., D/2, 2, F]
+    s = packed.shape
+    return un.reshape(s[:-2] + (s[-2] * 2, s[-1]))
+
+
+def _kernel_serves(w: "QuantizedArray") -> bool:
+    """True when the Pallas grouped matmul (quant_matmul.py) will
+    consume this packed leaf directly — the ONE gate shared by
+    unpack_params (which then leaves it packed) and mm (which then calls
+    the kernel), so the two can't disagree.
+
+    DYN_INT4_KERNEL=1 opt-in (trace-time): measured on v5e, XLA's int8
+    matmul streams near peak and the kernel only edges the XLA grouped
+    path in the small-batch/large-F corner — engine-level it lost
+    (25.9 vs 21.4 ms/step on the 70B shard, PERF.md int4 section), so
+    the XLA path is the default."""
+    import os
+    if os.environ.get("DYN_INT4_KERNEL", "0") != "1":
+        return False
+    from .attention import _on_tpu
+    from .quant_matmul import grouped_kernel_eligible
+    if not (w.packed4 and not w.no_kernel and _on_tpu()):
+        return False
+    *_lead, d, f = w.shape
+    return grouped_kernel_eligible(0, d, f, w.group)
+
+
+def unpack_params(params: Dict[str, object]) -> Dict[str, object]:
+    """Unpack packed-int4 leaves of a params tree into their S4 form,
+    behind an optimization_barrier so XLA materializes the unpacked
+    buffer once per program instead of re-deriving it per use. Call at
+    the TOP of each jitted model program (engine/core.py does); outside
+    jit the packed tree is the one that crosses boundaries (S4 arrays
+    cannot — module docstring). Leaves the grouped Pallas kernel will
+    serve stay PACKED — the kernel streams the packed bytes itself, so
+    no unpack pass (or S4 copy) exists at all on that path."""
+    out: Dict[str, object] = {}
+    for k, v in params.items():
+        if isinstance(v, QuantizedArray) and v.packed4 \
+                and not _kernel_serves(v):
+            u = v.unpacked()
+            out[k] = QuantizedArray(jax.lax.optimization_barrier(u.q),
+                                    u.scale, group=u.group)
+        else:
+            out[k] = v
+    return out
+
+
+def quantize_array_grouped(w: jax.Array, group: int = GROUP_SIZE,
+                           bits: int = 4) -> QuantizedArray:
+    """Symmetric absmax with one scale per (leading stack axes,
+    contraction group, out-channel): w [..., D, F] -> logical q
+    [..., D, F] int4/int8, scale [..., D/group, F] f32. When ``group``
+    does not divide D the whole axis becomes one group (tiny test
+    geometries). bits=4 with even D returns PACKED storage
+    (pack_int4_rows); odd-D tiny geometries stay unpacked int8-held."""
+    *_lead, D, F = w.shape
+    if D % group != 0:
+        group = D
+    gn = D // group
+    qmax = 2 ** (bits - 1) - 1
+    w32 = w.astype(jnp.float32).reshape(w.shape[:-2] + (gn, group, F))
+    absmax = jnp.max(jnp.abs(w32), axis=-2)            # [..., gn, F]
+    scale = jnp.maximum(absmax, 1e-12) / qmax
+    q = jnp.clip(jnp.round(w32 / scale[..., None, :]), -qmax, qmax)
+    q = q.reshape(w.shape).astype(jnp.int8)
+    scale = scale.astype(jnp.float32)
+    if bits == 4 and D % 2 == 0:
+        return QuantizedArray(pack_int4_rows(q), scale, group=group,
+                              packed4=True)
+    return QuantizedArray(q, scale, group=group)
+
+
+def _mm_grouped(x: jax.Array, w: QuantizedArray) -> jax.Array:
+    """x [..., D] @ grouped-quantized w [D, F]: contract per group, then
+    fold the [gn, F] scales in a second (tiny) contraction. XLA reads the
+    int4/int8 payload from HBM and converts in-register; under a tp mesh
+    both contractions partition cleanly (q and scale shard together on
+    either axis). Packed weights unpack here for direct callers —
+    per-step loops should pre-unpack the whole tree (unpack_params)."""
+    if w.packed4 and _kernel_serves(w):
+        from .quant_matmul import grouped_int4_matmul
+        x2 = x[None, :] if x.ndim == 1 else x
+        y = grouped_int4_matmul(x2, w.q, w.scale)
+        return y[0] if x.ndim == 1 else y
+    if w.packed4:
+        w = w.unpacked()
+    D = x.shape[-1]
+    gn = D // w.group
+    xg = x.reshape(x.shape[:-1] + (gn, w.group))
+    qg = w.q.astype(x.dtype).reshape(gn, w.group, w.q.shape[-1])
+    part = jnp.einsum("...gd,gdf->...gf", xg, qg)
+    return jnp.einsum("...gf,gf->...f", part, w.scale.astype(x.dtype))
+
+
 def mm(x: jax.Array, w) -> jax.Array:
     """x @ w for a plain array or a QuantizedArray (dequant fused into the
-    matmul: XLA reads int8 and converts in-register)."""
+    matmul: XLA reads int8/int4 and converts in-register)."""
     if isinstance(w, QuantizedArray):
+        if w.group:
+            return _mm_grouped(x, w)
         y = x @ w.q.astype(x.dtype)
         return y * w.scale.astype(x.dtype).reshape(w.scale.shape[-1])
     return x @ w
@@ -88,6 +266,11 @@ def qeinsum(spec: str, a: jax.Array, w) -> jax.Array:
     the broadcast-shaped scale after the contraction. One owner for the
     dequant semantics — keep in sync with mm by calling, not copying."""
     if isinstance(w, QuantizedArray):
+        if w.group:
+            raise NotImplementedError(
+                "grouped-quantized weights are not supported in qeinsum "
+                "(MoE experts stay int8 under --quantization int4; see "
+                "module docstring)")
         return jnp.einsum(spec, a, w.q.astype(a.dtype)) \
             * w.scale.astype(a.dtype)
     return jnp.einsum(spec, a, w)
@@ -103,15 +286,23 @@ _MOE_MATMULS = ("moe_gate", "moe_up", "moe_down")
 
 
 def quantize_params(params: Dict[str, jax.Array],
-                    include_embed: bool = True) -> Dict[str, object]:
-    """Return a params tree with matmul weights int8-quantized.
+                    include_embed: bool = True,
+                    bits: int = 8) -> Dict[str, object]:
+    """Return a params tree with matmul weights quantized.
 
+    bits=8:
     - ``layers.{wq,wk,wv,wo,gate,up,down}``: per-(layer, out-channel).
-    - ``lm_head`` ([D, V]): per out-channel.
-    - ``embed`` ([V, D], optional): per ROW (= per token vector), so the
-      embedding gather dequantizes with one scale per token and a TIED lm
-      head (x @ embed.T) gets per-column scales from the same tensor.
-    - ``layers.{moe_gate,moe_up,moe_down}`` ([L, E, D, F]): per
+    bits=4: the same layer matmuls, int4 with per-(group-of-128,
+    out-channel) scales (module docstring).
+    Either way:
+    - ``lm_head`` ([D, V]): int8 per out-channel (vocab widths don't
+      lane-align for the int4 kernel; the int8 head keeps its fused
+      Pallas kernel).
+    - ``embed`` ([V, D], optional): int8 per ROW (= per token vector), so
+      the embedding gather dequantizes with one scale per token and a
+      TIED lm head (x @ embed.T) gets per-column scales from the same
+      tensor.
+    - ``layers.{moe_gate,moe_up,moe_down}`` ([L, E, D, F]): int8 per
       (layer, expert, out-channel) — for MoE models the experts are the
       bulk of the weights (models/llama.py moe_mlp dequant-fuses them).
     - norms / biases / MoE router untouched.
@@ -119,27 +310,36 @@ def quantize_params(params: Dict[str, jax.Array],
     tied = "lm_head" not in params
     out: Dict[str, object] = {}
     for name, w in params.items():
-        out.update(_quantize_named(name, w, include_embed, tied))
+        out.update(_quantize_named(name, w, include_embed, tied, bits))
     return out
 
 
 def _quantize_named(name: str, w: jax.Array, include_embed: bool,
-                    tied: bool) -> Dict[str, object]:
+                    tied: bool, bits: int = 8) -> Dict[str, object]:
     """The per-tensor dispatch shared by quantize_params (whole-tree,
     eager) and init_params_quantized (streaming, one jit per tensor)."""
     suffix = name.split(".", 1)[1] if name.startswith("layers.") else name
     if name.startswith("layers.") and suffix in _LAYER_MATMULS:
+        if bits == 4:
+            # stacked [L, D, F]: int4, scale [L, D/128, F]
+            return {name: quantize_array_grouped(w, bits=4)}
         # stacked [L, D, F]: per (layer, out-channel) → scale [L, 1, F]
         return {name: quantize_array(w, keep_axes=(0, -1))}
     if name.startswith("layers.") and suffix in _MOE_MATMULS:
         # stacked [L, E, D, F]: per (layer, expert, out-channel)
         # → scale [L, E, 1, F], which broadcasts over the expert
-        # einsums' batched-N axis after the per-layer slice
+        # einsums' batched-N axis after the per-layer slice.
+        # (int8 even under bits=4 — module docstring)
         return {name: quantize_array(w, keep_axes=(0, 1, -1))}
     if name == "lm_head":
+        # int8 even under bits=4: vocab widths (e.g. 128256/8) don't
+        # lane-align for the grouped kernel, the XLA grouped fallback
+        # materializes a [N, D/128, V] partial bigger than the int8 read
+        # it saves, and int8 keeps the fused Pallas head kernel
         return {name: quantize_array(w, keep_axes=(-1,))}
     if name == "embed" and include_embed:
-        # per-row: scale shape [V, 1]
+        # int8 per-row: scale shape [V, 1] (bits=4 keeps the embed int8 —
+        # the gather reads one row per token, not the whole tensor)
         out = {name: quantize_array(w, keep_axes=(0,))}
         if tied:
             # tied head: materialize a PRE-TRANSPOSED int8 head —
@@ -154,7 +354,8 @@ def _quantize_named(name: str, w: jax.Array, include_embed: bool,
 
 
 def init_params_quantized(cfg, key: jax.Array, dtype=jnp.bfloat16,
-                          include_embed: bool = True) -> Dict[str, object]:
+                          include_embed: bool = True,
+                          bits: int = 8) -> Dict[str, object]:
     """Random-init + quantize one stacked tensor at a time, entirely
     inside a jit, so the full bf16 tree is never materialized.
 
@@ -179,7 +380,7 @@ def init_params_quantized(cfg, key: jax.Array, dtype=jnp.bfloat16,
 
         def build(sub, name=name, shape=shape):
             w = init_one_param(cfg, name, shape, sub, dtype)
-            return _quantize_named(name, w, include_embed, tied)
+            return _quantize_named(name, w, include_embed, tied, bits)
 
         out.update(jax.jit(build)(sub))
     return out

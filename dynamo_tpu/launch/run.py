@@ -74,11 +74,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="host (TPU-VM DRAM) KV offload tier size")
     p.add_argument("--no-prefix-reuse", action="store_true")
     p.add_argument("--quantization",
-                   choices=["none", "int8", "int8-noembed"],
+                   choices=["none", "int8", "int8-noembed",
+                            "int4", "int4-noembed"],
                    default="none",
-                   help="weight-only quantization (int8 weights + "
-                        "per-channel scales, dequant fused into matmuls; "
-                        "-noembed keeps the embedding full-precision)")
+                   help="weight-only quantization (int8: per-channel "
+                        "scales; int4: per-group-of-128 scales on dense "
+                        "matmuls + lm_head, int8 embed; dequant fused "
+                        "into matmuls; -noembed keeps the embedding "
+                        "full-precision)")
     p.add_argument("--random-weights", action="store_true",
                    help="skip checkpoint load (benchmarks/smoke)")
     # parallelism (tensor-parallel-size analog + our axes)

@@ -3,32 +3,45 @@
 Reference: launch/dynamo-run/src/hub.rs — `from_hf` lists a repo's files,
 downloads everything except housekeeping files (.gitattributes, LICENSE,
 README.md) and images into the hub cache, and returns the snapshot
-directory. The TPU deployment runs in zero-egress environments, so the
-transport here is a MIRROR — a directory (or file:// URL) laid out like
-the hub (``<mirror>/<org>/<name>/<files>``), typically an NFS/GCS-fuse
-mount — with the same filtering, the same local cache, and per-file
-sha256 validation recorded in a manifest so a torn copy is detected and
-re-fetched instead of served.
+directory. Two transports, selected by the mirror URL's scheme:
+
+- **directory mirror** (path or ``file://``): a tree laid out like the
+  hub (``<mirror>/<org>/<name>/<files>``), typically an NFS/GCS-fuse
+  mount — the zero-egress deployment shape.
+- **HTTP(S) hub** (``http://`` / ``https://``): the HF-hub wire surface
+  the reference's hf-hub crate speaks — repo listing from
+  ``GET {base}/api/models/{repo}`` (``siblings[].rfilename``), file
+  bytes from ``GET {base}/{repo}/resolve/{rev}/{file}`` — with bearer
+  auth from ``HF_TOKEN``/``DYN_HUB_TOKEN``, per-file retry, and Range
+  resume of partial downloads.
+
+Both land in the same local cache with per-file sha256 recorded in a
+manifest, so a torn copy is detected and re-fetched instead of served.
 
 Resolution order (`fetch_model`):
 1. an existing local directory path is returned as-is;
 2. a cached snapshot with a valid manifest is reused;
-3. otherwise the model is copied from the mirror into the cache
+3. otherwise the model is fetched from the mirror into the cache
    atomically (temp dir + rename) and the manifest written last.
 
-Env: ``DYN_HUB_MIRROR`` (mirror root), ``DYN_HUB_CACHE`` (cache root,
-default ``~/.cache/dynamo_tpu/hub``).
+Env: ``DYN_HUB_MIRROR`` (mirror root or hub base URL), ``DYN_HUB_CACHE``
+(cache root, default ``~/.cache/dynamo_tpu/hub``), ``DYN_HUB_REVISION``
+(HTTP revision, default ``main``), ``HF_TOKEN``/``DYN_HUB_TOKEN``.
 """
 
 from __future__ import annotations
 
 import hashlib
+import http.client
 import json
 import logging
 import os
 import shutil
 import tempfile
-from typing import Dict, Optional
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
 
 logger = logging.getLogger("dynamo_tpu.llm.hub")
 
@@ -67,6 +80,133 @@ def _mirror_root(mirror: Optional[str]) -> str:
     if mirror.startswith("file://"):
         mirror = mirror[len("file://"):]
     return mirror
+
+
+def _is_http(mirror: str) -> bool:
+    return mirror.startswith(("http://", "https://"))
+
+
+# ------------------------------------------------------------- HTTP hub
+
+_HTTP_RETRIES = 3
+_HTTP_CHUNK = 1 << 20
+
+
+def _hub_token() -> Optional[str]:
+    return os.environ.get("DYN_HUB_TOKEN") or os.environ.get("HF_TOKEN")
+
+
+class _AuthStrippingRedirectHandler(urllib.request.HTTPRedirectHandler):
+    """Drop the Authorization header when a redirect leaves the original
+    host — the hub 302s LFS shards to CDNs, and forwarding the bearer
+    token to a third-party (or attacker-chosen) host would leak it.
+    (huggingface_hub strips auth on cross-host redirects for the same
+    reason.)"""
+
+    def redirect_request(self, req, fp, code, msg, headers, newurl):
+        new = super().redirect_request(req, fp, code, msg, headers, newurl)
+        if new is not None and new.host != req.host:
+            new.remove_header("Authorization")
+        return new
+
+
+_OPENER = urllib.request.build_opener(_AuthStrippingRedirectHandler)
+
+
+def _http_open(url: str, headers: Optional[dict] = None, timeout=30):
+    req = urllib.request.Request(url, headers=dict(headers or {}))
+    tok = _hub_token()
+    if tok:
+        req.add_header("Authorization", f"Bearer {tok}")
+    return _OPENER.open(req, timeout=timeout)  # noqa: S310
+
+
+def _http_list_files(base: str, repo: str, revision: str) -> List[str]:
+    """Repo file listing via the hub API (hub.rs `api.model(...).info()`):
+    ``GET {base}/api/models/{repo}/revision/{rev}`` ->
+    ``{"siblings": [{"rfilename": ...}]}``."""
+    url = f"{base.rstrip('/')}/api/models/{repo}/revision/{revision}"
+    try:
+        with _http_open(url) as r:
+            info = json.load(r)
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            raise HubError(
+                f"model {repo!r} not found on hub {base} "
+                f"(HTTP 404). Is this a valid model id?") from e
+        raise HubError(f"hub listing failed for {repo!r}: HTTP "
+                       f"{e.code}") from e
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        raise HubError(f"hub listing failed for {repo!r}: {e}") from e
+    names = [s.get("rfilename", "") for s in info.get("siblings", [])]
+    out = []
+    for n in names:
+        if not n or _is_ignored(os.path.basename(n)):
+            continue
+        # the listing is UNTRUSTED input: a hostile server must not be
+        # able to write outside the snapshot via ../ or absolute names
+        if os.path.isabs(n) or n.startswith("~"):
+            raise HubError(f"hub listing for {repo!r} contains an "
+                           f"absolute path {n!r}")
+        norm = os.path.normpath(n)
+        if norm.startswith("..") or os.path.isabs(norm):
+            raise HubError(f"hub listing for {repo!r} contains a "
+                           f"path-traversal name {n!r}")
+        out.append(norm)
+    return sorted(out)
+
+
+def _http_fetch_file(base: str, repo: str, revision: str, name: str,
+                     dst: str) -> None:
+    """Download one file (hub.rs `repo.get(name)` analog):
+    ``GET {base}/{repo}/resolve/{rev}/{name}`` with per-file retries; a
+    partial ``.part`` from a failed attempt resumes via a Range request
+    (checked against 206) instead of restarting multi-GB shards."""
+    url = f"{base.rstrip('/')}/{repo}/resolve/{revision}/{name}"
+    part = dst + ".part"
+    last: Optional[Exception] = None
+    for attempt in range(_HTTP_RETRIES):
+        have = os.path.getsize(part) if os.path.exists(part) else 0
+        headers = {"Range": f"bytes={have}-"} if have else {}
+        try:
+            with _http_open(url, headers) as r:
+                if have and r.status != 206:
+                    # server ignored the Range: restart from zero
+                    have = 0
+                expect = r.headers.get("Content-Length")
+                mode = "ab" if have else "wb"
+                wrote = 0
+                with open(part, mode) as f:
+                    while True:
+                        chunk = r.read(_HTTP_CHUNK)
+                        if not chunk:
+                            break
+                        f.write(chunk)
+                        wrote += len(chunk)
+            if expect is not None and wrote != int(expect):
+                # a dropped connection can surface as a silent short
+                # body — a truncated shard must NEVER be blessed into
+                # the manifest (its sha256 would "validate" the damage)
+                raise OSError(
+                    f"short body: {wrote} of {expect} bytes")
+            os.replace(part, dst)
+            return
+        except urllib.error.HTTPError as e:
+            if e.code in (404, 401, 403):
+                raise HubError(
+                    f"hub download of {repo}/{name} failed: HTTP "
+                    f"{e.code}") from e
+            last = e
+        except (urllib.error.URLError, OSError, http.client.HTTPException
+                ) as e:
+            last = e
+        if attempt < _HTTP_RETRIES - 1:   # no pointless backoff after
+            logger.warning("hub download retry %d/%d for %s/%s: %s",
+                           attempt + 1, _HTTP_RETRIES, repo, name, last)
+            time.sleep(min(2 ** attempt, 5))
+    raise HubError(
+        f"hub download of {repo}/{name} failed after "
+        f"{_HTTP_RETRIES} attempts: {last}")
 
 
 def _cache_root(cache_dir: Optional[str]) -> str:
@@ -130,12 +270,18 @@ def fetch_model(name_or_path: str, mirror: Optional[str] = None,
         logger.info("hub cache hit: %s -> %s", name_or_path, snap)
         return snap
 
-    src = os.path.join(_mirror_root(mirror), name_or_path)
-    if not os.path.isdir(src):
-        raise HubError(
-            f"model {name_or_path!r} not found in hub mirror "
-            f"({src} does not exist). Is this a valid model id?")
-    names = _list_files(src)
+    root = _mirror_root(mirror)
+    if _is_http(root):
+        revision = os.environ.get("DYN_HUB_REVISION", "main")
+        names = _http_list_files(root, name_or_path, revision)
+        src = None
+    else:
+        src = os.path.join(root, name_or_path)
+        if not os.path.isdir(src):
+            raise HubError(
+                f"model {name_or_path!r} not found in hub mirror "
+                f"({src} does not exist). Is this a valid model id?")
+        names = _list_files(src)
     if not names:
         raise HubError(
             f"model {name_or_path!r} exists but contains no usable files")
@@ -147,7 +293,10 @@ def fetch_model(name_or_path: str, mirror: Optional[str] = None,
         for name in names:
             dst = os.path.join(tmp, name)
             os.makedirs(os.path.dirname(dst), exist_ok=True)
-            shutil.copyfile(os.path.join(src, name), dst)
+            if src is None:
+                _http_fetch_file(root, name_or_path, revision, name, dst)
+            else:
+                shutil.copyfile(os.path.join(src, name), dst)
             manifest[name] = {"sha256": _sha256(dst),
                               "size": os.path.getsize(dst)}
         with open(os.path.join(tmp, MANIFEST), "w") as f:

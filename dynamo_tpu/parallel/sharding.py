@@ -149,10 +149,18 @@ def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
         if isinstance(v, QuantizedArray):
             # shared fallback policy for the q tensor (scale fallback
             # stays silent inside put(): for row-parallel weights
-            # replication IS the scale's correct layout)
+            # replication IS the scale's correct layout; a grouped scale
+            # [L, D/g, F] shards alongside q on either axis since the
+            # group width divides every per-shard span)
             q_spec = fit_or_replicate(k, v.q.shape, spec, mesh,
                                       v.q.dtype.itemsize)
-            out[k] = QuantizedArray(put(v.q, q_spec), put(v.scale, spec))
+            out[k] = QuantizedArray(put(v.q, q_spec), put(v.scale, spec),
+                                    group=v.group, packed4=v.packed4,
+                                    # pallas_call has no GSPMD rule:
+                                    # sharded packed leaves take the XLA
+                                    # grouped path after unpack_params
+                                    no_kernel=(v.no_kernel
+                                               or mesh.size > 1))
             continue
         spec = fit_or_replicate(k, v.shape, spec, mesh, v.dtype.itemsize)
         out[k] = put(v, spec)

@@ -135,3 +135,152 @@ async def test_launch_resolves_model_name_through_hub(mirror, cache,
                      "--output-path", str(outp)])
     lines = [json.loads(l) for l in outp.read_text().splitlines()]
     assert lines and lines[0]["text"]
+
+
+# ----------------------------------------------------- HTTP(S) transport
+
+@pytest.fixture
+def hub_server(mirror):
+    """A local HTTP server speaking the HF-hub wire surface the reference
+    consumes (hub.rs via the hf-hub crate): repo listing at
+    /api/models/{repo}/revision/{rev}, file bytes at
+    /{repo}/resolve/{rev}/{file}. Records request headers and can inject
+    one mid-file disconnect to exercise retry + Range resume."""
+    import http.server
+    import threading
+
+    root = mirror
+    state = {"auth": [], "ranges": [], "fail_next_file": False}
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):  # noqa: D102
+            pass
+
+        def do_GET(self):  # noqa: N802
+            state["auth"].append(self.headers.get("Authorization"))
+            parts = self.path.lstrip("/").split("/")
+            if parts[:2] == ["api", "models"]:
+                # /api/models/org/name/revision/main
+                repo = "/".join(parts[2:-2])
+                src = os.path.join(root, repo)
+                if not os.path.isdir(src):
+                    self.send_error(404)
+                    return
+                sib = []
+                for dirpath, _d, files in os.walk(src):
+                    for n in files:
+                        rel = os.path.relpath(os.path.join(dirpath, n), src)
+                        sib.append({"rfilename": rel})
+                body = json.dumps({"siblings": sib}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            # /{repo}/resolve/{rev}/{file...}
+            if "resolve" in parts:
+                i = parts.index("resolve")
+                repo, fname = "/".join(parts[:i]), "/".join(parts[i + 2:])
+                p = os.path.join(root, repo, fname)
+                if not os.path.isfile(p):
+                    self.send_error(404)
+                    return
+                data = open(p, "rb").read()
+                rng = self.headers.get("Range")
+                state["ranges"].append(rng)
+                start = 0
+                if rng:
+                    start = int(rng.split("=")[1].rstrip("-"))
+                    self.send_response(206)
+                else:
+                    self.send_response(200)
+                out = data[start:]
+                if state["fail_next_file"] and len(out) > 8:
+                    # half the payload, then drop the connection
+                    state["fail_next_file"] = False
+                    self.send_header("Content-Length", str(len(out)))
+                    self.end_headers()
+                    self.wfile.write(out[:len(out) // 2])
+                    self.wfile.flush()
+                    self.connection.close()
+                    return
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+                return
+            self.send_error(404)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_address[1]}", state
+    srv.shutdown()
+
+
+def test_http_hub_fetch_and_cache(hub_server, cache, monkeypatch):
+    """The HTTP transport downloads the repo (housekeeping/images skipped
+    by the same filter), writes the manifest, and serves the second call
+    from cache without touching the server; a bearer token from the env
+    rides every request."""
+    base, state = hub_server
+    monkeypatch.setenv("DYN_HUB_TOKEN", "sekrit")
+    snap = fetch_model("testorg/tiny", mirror=base, cache_dir=cache)
+    assert os.path.isfile(os.path.join(snap, "config.json"))
+    assert not os.path.exists(os.path.join(snap, "README.md"))
+    assert not os.path.exists(os.path.join(snap, "logo.png"))
+    man = json.load(open(os.path.join(snap, MANIFEST)))
+    assert "config.json" in man["files"]
+    assert all(a == "Bearer sekrit" for a in state["auth"])
+    n = len(state["auth"])
+    snap2 = fetch_model("testorg/tiny", mirror=base, cache_dir=cache)
+    assert snap2 == snap and len(state["auth"]) == n   # cache hit, no HTTP
+
+
+def test_http_hub_retries_with_range_resume(hub_server, cache):
+    """A mid-file disconnect retries and RESUMES via a Range request
+    (hub.rs relies on hf-hub's retry; multi-GB shards must not restart
+    from byte zero) — and the resumed file still passes sha256."""
+    base, state = hub_server
+    state["fail_next_file"] = True
+    snap = fetch_model("testorg/tiny", mirror=base, cache_dir=cache)
+    assert any(r and r.startswith("bytes=") for r in state["ranges"])
+    from dynamo_tpu.llm.hub import _snapshot_valid
+    assert _snapshot_valid(snap, deep=True)
+
+
+def test_http_hub_unknown_model_404(hub_server, cache):
+    base, _state = hub_server
+    with pytest.raises(HubError, match="not found on hub"):
+        fetch_model("testorg/nope", mirror=base, cache_dir=cache)
+
+
+def test_http_hub_rejects_path_traversal_listing(hub_server, cache,
+                                                 tmp_path):
+    """A hostile server's listing must not write outside the snapshot:
+    ../ and absolute rfilenames are rejected loudly."""
+    import http.server
+    import threading
+
+    class EvilHandler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps({"siblings": [
+                {"rfilename": "../../../../tmp/evil.txt"},
+                {"rfilename": "config.json"}]}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), EvilHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(HubError, match="traversal"):
+            fetch_model("testorg/evil",
+                        mirror=f"http://127.0.0.1:{srv.server_address[1]}",
+                        cache_dir=cache)
+    finally:
+        srv.shutdown()
