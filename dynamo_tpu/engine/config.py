@@ -102,6 +102,13 @@ class ModelConfig:
             raise ValueError("qwen3_moe hybrid sparsity (mlp_only_layers "
                              "/ decoder_sparse_step > 1) is not supported "
                              "— every layer must be sparse")
+        if mt == "phi3" and cfg.get("rope_scaling"):
+            # phi3 128k variants use longrope (per-dim su factors +
+            # short/long switching) — a different rope function entirely;
+            # half-applying llama3-style scaling would decode garbage
+            raise ValueError(
+                "phi3 rope_scaling (longrope/su) is not implemented — "
+                "use a base-context phi3 checkpoint (no rope_scaling)")
         n_heads = int(cfg.get("num_attention_heads", 32))
         hidden = int(cfg.get("hidden_size", 4096))
         rs = None
@@ -165,8 +172,18 @@ class ModelConfig:
                                    if cfg.get("query_pre_attn_scalar")
                                    else None),
             sliding_window=(int(cfg.get("sliding_window") or 4096)
-                            if cfg.get("model_type") == "gemma2" else None),
-            layer_types=cfg.get("layer_types"),
+                            if mt == "gemma2"
+                            else int(cfg["sliding_window"])
+                            if mt == "phi3" and cfg.get("sliding_window")
+                            else None),
+            # phi3 windows EVERY layer (HF Phi3Attention), unlike
+            # gemma2's interleave — synthesize explicit layer_types so
+            # sliding_layer_mask can't fall back to the gemma2 default
+            layer_types=(cfg.get("layer_types")
+                         or (["sliding_attention"]
+                             * int(cfg.get("num_hidden_layers", 32))
+                             if mt == "phi3" and cfg.get("sliding_window")
+                             else None)),
         )
 
     @classmethod

@@ -70,7 +70,33 @@ def _layer_map_for(cfg: ModelConfig) -> Dict[str, tuple]:
         layer_map["post_attention_layernorm.weight"] = ("ln1_post", False)
         layer_map["pre_feedforward_layernorm.weight"] = ("ln2", False)
         layer_map["post_feedforward_layernorm.weight"] = ("ln2_post", False)
+    if cfg.model_type == "phi3":
+        # phi3 ships FUSED projections (_fused_sections); the split
+        # suffixes must not also match
+        for k in ("self_attn.q_proj.weight", "self_attn.k_proj.weight",
+                  "self_attn.v_proj.weight", "mlp.gate_proj.weight",
+                  "mlp.up_proj.weight"):
+            layer_map.pop(k, None)
     return layer_map
+
+
+def _fused_sections(cfg: ModelConfig) -> Dict[str, list]:
+    """Fused HF layer tensors → the row sections (torch [out, in]
+    orientation) that map onto our split keys: phi3 packs q/k/v into
+    ``qkv_proj`` and gate/up into ``gate_up_proj`` (HF Phi3Config).
+    Returns {suffix: [(key, row_offset, row_count)]}; one home for both
+    loaders."""
+    if cfg.model_type != "phi3":
+        return {}
+    qd = cfg.num_heads * cfg.head_dim
+    kvd = cfg.num_kv_heads * cfg.head_dim
+    return {
+        "self_attn.qkv_proj.weight": [
+            ("wq", 0, qd), ("wk", qd, kvd), ("wv", qd + kvd, kvd)],
+        "mlp.gate_up_proj.weight": [
+            ("gate", 0, cfg.intermediate_size),
+            ("up", cfg.intermediate_size, cfg.intermediate_size)],
+    }
 
 
 def load_params_auto(model_dir: str, cfg: Optional[ModelConfig] = None,
@@ -103,6 +129,7 @@ def load_llama_params(model_dir: str, cfg: Optional[ModelConfig] = None,
     cfg = cfg or ModelConfig.from_model_dir(model_dir)
     L, E = cfg.num_layers, cfg.num_experts
     layer_map = _layer_map_for(cfg)
+    fused = _fused_sections(cfg)
     staging: Dict[str, list] = {}
     expert_staging: Dict[str, list] = {}   # key → [L][E] tensors
     singles: Dict[str, np.ndarray] = {}
@@ -128,6 +155,12 @@ def load_llama_params(model_dir: str, cfg: Optional[ModelConfig] = None,
                 grid = expert_staging.setdefault(
                     key, [[None] * E for _ in range(L)])
                 grid[int(idx_str)][int(e_str)] = tensor.T
+                continue
+            if sub in fused:
+                # split the fused tensor's torch rows into our keys
+                for key, off, cnt in fused[sub]:
+                    staging.setdefault(key, [None] * L)[int(idx_str)] = \
+                        tensor[off:off + cnt].T
                 continue
             mapped = layer_map.get(sub)
             if mapped is None:
@@ -210,19 +243,36 @@ def load_llama_params_sharded(model_dir: str, mesh,
         # reach that guidance instead of a bogus missing-layers error
         by_key: Dict[str, list] = {}
         for suffix, (key, transpose) in _layer_map_for(cfg).items():
-            by_key.setdefault(key, []).append((suffix, transpose))
+            by_key.setdefault(key, []).append((suffix, transpose, None))
+        for suffix, sections in _fused_sections(cfg).items():
+            # fused tensors (phi3 qkv_proj / gate_up_proj): each split
+            # key reads a torch-row window of the fused tensor — the
+            # slice reader shifts AND CLAMPS the logical out-axis into
+            # the section (col_off=None means unfused; 0 is a real fused
+            # offset whose open slices must still clamp to the section)
+            for key, off, _cnt in sections:
+                by_key.setdefault(key, []).append((suffix, True, off))
         singles = {"embed": ("model.embed_tokens.weight", False),
                    "final_norm": ("model.norm.weight", False),
                    "lm_head": ("lm_head.weight", True)}
 
-        def read_slice(name: str, idx, transpose: bool) -> np.ndarray:
+        def read_slice(name: str, idx, transpose: bool,
+                       col_off=None, col_dim: int = 0) -> np.ndarray:
             """Read tensor[idx] from disk; idx indexes the LOGICAL
             (already transposed) orientation, so transposed reads swap
-            the slices."""
+            the slices. ``col_off`` (None = unfused) shifts the logical
+            out-axis into a fused tensor's section and CLAMPS open
+            slices to the section width ``col_dim`` — an offset of 0 is
+            a real fused section whose slice(None) would otherwise read
+            the whole fused axis."""
             sl = where[name].get_slice(name)
             if transpose:
                 if len(idx) == 2:
-                    return np.ascontiguousarray(sl[idx[1], idx[0]].T)
+                    c = idx[1]
+                    if col_off is not None:
+                        start, stop, step = c.indices(col_dim)
+                        c = slice(start + col_off, stop + col_off, step)
+                    return np.ascontiguousarray(sl[c, idx[0]].T)
                 return np.ascontiguousarray(sl[idx[0]].T)
             return np.ascontiguousarray(sl[tuple(idx)])
 
@@ -247,7 +297,7 @@ def load_llama_params_sharded(model_dir: str, mesh,
                 continue
             if pkey.startswith("layers.") and pkey[7:] in by_key:
                 cands = by_key[pkey[7:]]
-                suffix, transpose = next(
+                suffix, transpose, col_off = next(
                     (c for c in cands
                      if f"model.layers.0.{c[0]}" in where), cands[0])
                 names = [f"model.layers.{i}.{suffix}" for i in range(L)]
@@ -257,11 +307,14 @@ def load_llama_params_sharded(model_dir: str, mesh,
                     raise ValueError(
                         f"checkpoint missing layers {missing[:4]}… "
                         f"for {pkey}")
+                col_dim = shape[-1]
 
-                def cb(idx, names=names, transpose=transpose):
+                def cb(idx, names=names, transpose=transpose,
+                       col_off=col_off, col_dim=col_dim):
                     l_sl = idx[0]
                     rest = tuple(idx[1:])
-                    rows = [read_slice(names[i], rest, transpose)
+                    rows = [read_slice(names[i], rest, transpose,
+                                       col_off, col_dim)
                             for i in range(*l_sl.indices(L))]
                     return np.stack(rows, axis=0).astype(_np_dtype(dtype))
 
@@ -322,6 +375,18 @@ def save_hf_style(params: Dict[str, jax.Array], cfg: ModelConfig,
         inv_experts = {"moe_gate": "w1", "moe_up": "w3",
                        "moe_down": "w2"}
         expert_prefix = "block_sparse_moe.experts."
+    fused = _fused_sections(cfg)
+    for suffix, sections in fused.items():
+        # phi3 fused tensors: concatenate our split keys back into the
+        # HF torch-row layout (inverse of the loaders' split)
+        for key, _off, _cnt in sections:
+            inv.pop(key, None)
+        L = cfg.num_layers
+        for i in range(L):
+            rows = [np.asarray(params[f"layers.{k}"][i], np.float32).T
+                    for k, _o, _c in sections]
+            out[f"model.layers.{i}.{suffix}"] = np.ascontiguousarray(
+                np.concatenate(rows, axis=0))
     for key, (hf_sub, transpose) in inv.items():
         if f"layers.{key}" not in params:
             continue

@@ -138,7 +138,14 @@ def _http_list_files(base: str, repo: str, revision: str) -> List[str]:
                        f"{e.code}") from e
     except (urllib.error.URLError, OSError, ValueError) as e:
         raise HubError(f"hub listing failed for {repo!r}: {e}") from e
-    names = [s.get("rfilename", "") for s in info.get("siblings", [])]
+    # the body is untrusted: wrong-shaped JSON must be a HubError, not
+    # an AttributeError escaping fetch_model
+    if not isinstance(info, dict) or not isinstance(
+            info.get("siblings", []), list):
+        raise HubError(f"hub listing for {repo!r} is not a model-info "
+                       f"object")
+    names = [s.get("rfilename", "") for s in info.get("siblings", [])
+             if isinstance(s, dict)]
     out = []
     for n in names:
         if not n or _is_ignored(os.path.basename(n)):
