@@ -46,6 +46,13 @@ class ModelConfig:
     # MoE (mixtral-style); num_experts == 0 → dense MLP
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # routing-weight convention: True = softmax renormalized over the
+    # top-k (mixtral, qwen3_moe); False = softmax over ALL experts with
+    # the top-k weights used as-is (qwen2_moe norm_topk_prob=false)
+    moe_norm_topk: bool = True
+    # qwen2_moe shared expert: a dense swiglu MLP of this intermediate
+    # size added to every token, scaled by a learned sigmoid gate
+    shared_expert_size: int = 0
     # qwen3-style per-head q/k norm
     qk_norm: bool = False
     # gemma-family deltas (model_type gemma/gemma2): gelu MLP, scaled
@@ -75,15 +82,26 @@ class ModelConfig:
             # via the gemma defaults would load garbage silently
             raise ValueError(f"unsupported gemma variant {mt!r} "
                              "(gemma and gemma2 are implemented)")
-        if (mt in ("qwen2_moe", "deepseek_v2", "deepseek_v3")
-                or cfg.get("shared_expert_intermediate_size")):
-            # shared-expert MoE families: the generic expert-name matching
-            # would load the routed experts and silently DROP the shared
-            # expert — garbage logits with no error; reject loudly instead
+        if mt != "qwen2_moe" and cfg.get("shared_expert_intermediate_size"):
+            # an UNKNOWN family carrying a shared expert: the generic
+            # expert-name matching would load the routed experts and
+            # silently DROP the shared one — garbage logits, no error
             raise ValueError(
-                f"unsupported MoE family {mt!r} (shared-expert "
-                f"architectures are not implemented; mixtral and "
-                f"qwen3_moe are)")
+                f"unsupported shared-expert MoE family {mt!r} "
+                f"(qwen2_moe is the implemented shared-expert family)")
+        if mt in ("deepseek_v2", "deepseek_v3"):
+            # MLA attention + grouped routing — a different attention
+            # function entirely; half-loading it would decode garbage
+            raise ValueError(
+                f"unsupported MoE family {mt!r} (MLA architectures are "
+                f"not implemented; mixtral, qwen2_moe and qwen3_moe are)")
+        if mt == "qwen2_moe" and (cfg.get("mlp_only_layers")
+                                  or int(cfg.get("decoder_sparse_step",
+                                                 1) or 1) > 1):
+            # same uniform-sparsity constraint as qwen3_moe below
+            raise ValueError("qwen2_moe hybrid sparsity (mlp_only_layers "
+                             "/ decoder_sparse_step > 1) is not supported "
+                             "— every layer must be sparse")
         if mt == "qwen3_moe" and not cfg.get("norm_topk_prob", False):
             # moe_mlp implements the normalized (mixtral-equivalent)
             # routing convention; softmax-then-topk WITHOUT renorm is a
@@ -142,13 +160,32 @@ class ModelConfig:
             rope_theta=float(cfg.get("rope_theta", 10000.0)),
             rope_scaling=rs,
             tie_word_embeddings=bool(cfg.get("tie_word_embeddings", False)),
-            # HF Qwen2 hardcodes qkv bias in the modeling code and ships no
-            # attention_bias key, so default it on for that family
+            # HF Qwen2/Qwen2Moe hardcode qkv bias in the modeling code and
+            # ship no attention_bias key, so default it on for them
             attention_bias=bool(cfg.get(
-                "attention_bias", cfg.get("model_type") == "qwen2")),
+                "attention_bias",
+                cfg.get("model_type") in ("qwen2", "qwen2_moe"))),
             num_experts=int(cfg.get("num_local_experts", 0) or
                             cfg.get("num_experts", 0) or 0),
-            num_experts_per_tok=int(cfg.get("num_experts_per_tok", 2)),
+            # HF save_pretrained omits default-valued keys (use_diff), so
+            # each family's OWN default must apply when the key is absent:
+            # Mixtral 2, Qwen2Moe 4, Qwen3Moe 8
+            num_experts_per_tok=int(cfg.get(
+                "num_experts_per_tok",
+                {"qwen2_moe": 4, "qwen3_moe": 8}.get(mt, 2))),
+            # qwen2_moe DEFAULTS norm_topk_prob=false (weights are the
+            # all-expert softmax values, not renormalized); every other
+            # family renormalizes over the top-k
+            moe_norm_topk=bool(cfg.get("norm_topk_prob", False))
+            if mt == "qwen2_moe" else True,
+            # the qwen2_moe architecture ALWAYS has a shared expert (HF
+            # modeling code is unconditional); an absent key means the
+            # HF-default size 5632, NOT "no shared expert" — silently
+            # dropping it would be the garbage-logits hazard the
+            # unknown-family guard above rejects
+            shared_expert_size=int(
+                cfg.get("shared_expert_intermediate_size",
+                        5632 if mt == "qwen2_moe" else 0) or 0),
             qk_norm=bool(cfg.get("qk_norm", cfg.get("model_type")
                          in ("qwen3", "qwen3_moe"))),
             # hidden_activation is authoritative when present; gemma-1 hub

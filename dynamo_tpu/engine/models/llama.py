@@ -20,7 +20,7 @@ Weight layout matches HF llama checkpoints after transpose (see weights.py).
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -104,31 +104,49 @@ def swiglu(x: jax.Array, gate_w: jax.Array, up_w: jax.Array,
 
 
 def moe_mlp(x: jax.Array, router_w: jax.Array, gate_w: jax.Array,
-            up_w: jax.Array, down_w: jax.Array, top_k: int) -> jax.Array:
-    """Mixtral-style sparse MoE MLP, computed densely over the expert axis.
+            up_w: jax.Array, down_w: jax.Array, top_k: int,
+            norm_topk: bool = True,
+            shared: Optional[tuple] = None) -> jax.Array:
+    """Sparse MoE MLP, computed densely over the expert axis.
 
     x: [N, D]; router_w: [D, E]; gate/up: [E, D, F]; down: [E, F, D].
-    Routing weights are softmax over the top-k router logits (HF Mixtral
-    convention: normalize AFTER the top-k cut). The expert einsums keep E as
-    a contracted/batched axis, so sharding E over the mesh "ep" axis makes
-    XLA compute E/ep experts per device and psum the combine — expert
-    parallelism as a compiler layout, no explicit dispatch.
+    ``norm_topk``: True = softmax renormalized over the top-k logits
+    (HF Mixtral convention, ≡ softmax-then-topk-then-renorm); False =
+    qwen2_moe's norm_topk_prob=false — softmax over ALL experts, the
+    top-k weights used WITHOUT renormalization (a different function:
+    weights no longer sum to 1). ``shared``: qwen2_moe shared expert
+    (sh_gate [D,Fs], sh_up, sh_down [Fs,D], sh_router [D,1]) — a dense
+    swiglu added to every token, scaled by a learned sigmoid gate.
 
-    Dense compute trades FLOPs (E/top_k× the active-expert cost) for static
-    shapes — the right call for serving-batch sizes where a GShard-style
-    sort/permute dispatch would be latency-bound on reshuffles anyway.
+    The expert einsums keep E as a contracted/batched axis, so sharding
+    E over the mesh "ep" axis makes XLA compute E/ep experts per device
+    and psum the combine — expert parallelism as a compiler layout, no
+    explicit dispatch. Dense compute trades FLOPs (E/top_k× the
+    active-expert cost) for static shapes — the right call for
+    serving-batch sizes where a GShard-style sort/permute dispatch would
+    be latency-bound on reshuffles anyway.
     """
     N, E = x.shape[0], router_w.shape[-1]
     logits = (x @ router_w).astype(jnp.float32)                  # [N, E]
-    top_logits, top_idx = jax.lax.top_k(logits, top_k)           # [N, k]
-    top_w = jax.nn.softmax(top_logits, axis=-1)                  # [N, k]
+    if norm_topk:
+        top_logits, top_idx = jax.lax.top_k(logits, top_k)       # [N, k]
+        top_w = jax.nn.softmax(top_logits, axis=-1)              # [N, k]
+    else:
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_w, top_idx = jax.lax.top_k(probs, top_k)
     combine = jnp.sum(
         jax.nn.one_hot(top_idx, E, dtype=jnp.float32)
         * top_w[..., None], axis=1)                              # [N, E]
     g = qeinsum("nd,edf->enf", x, gate_w)
     u = qeinsum("nd,edf->enf", x, up_w)
     y = qeinsum("enf,efd->end", jax.nn.silu(g) * u, down_w)      # [E, N, D]
-    return jnp.einsum("ne,end->nd", combine.astype(y.dtype), y)
+    out = jnp.einsum("ne,end->nd", combine.astype(y.dtype), y)
+    if shared is not None:
+        sh_gate, sh_up, sh_down, sh_router = shared
+        s = swiglu(x, sh_gate, sh_up, sh_down, "silu")
+        sg = jax.nn.sigmoid((x @ sh_router).astype(jnp.float32))  # [N, 1]
+        out = out + sg.astype(out.dtype) * s
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -159,6 +177,15 @@ def param_shapes(cfg: ModelConfig) -> Dict[str, Tuple[int, ...]]:
             "layers.moe_up": (L, E, D, F),
             "layers.moe_down": (L, E, F, D),
         })
+        if cfg.shared_expert_size > 0:
+            # qwen2_moe shared expert: dense swiglu + sigmoid gate
+            Fs = cfg.shared_expert_size
+            shapes.update({
+                "layers.sh_gate": (L, D, Fs),
+                "layers.sh_up": (L, D, Fs),
+                "layers.sh_down": (L, Fs, D),
+                "layers.sh_router": (L, D, 1),
+            })
     else:
         shapes.update({
             "layers.gate": (L, D, F),
@@ -352,9 +379,14 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
         h = h + attn_out
         hn2 = rms_norm(h, lp["ln2"], cfg.rms_norm_eps, p1)
         if cfg.num_experts > 0:
+            shared = (tuple(lp[k] for k in ("sh_gate", "sh_up",
+                                            "sh_down", "sh_router"))
+                      if cfg.shared_expert_size > 0 else None)
             mlp_out = moe_mlp(hn2, lp["router"], lp["moe_gate"],
                               lp["moe_up"], lp["moe_down"],
-                              cfg.num_experts_per_tok)
+                              cfg.num_experts_per_tok,
+                              norm_topk=cfg.moe_norm_topk,
+                              shared=shared)
         else:
             mlp_out = swiglu(hn2, lp["gate"], lp["up"], lp["down"],
                              cfg.hidden_act)

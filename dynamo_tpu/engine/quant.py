@@ -277,7 +277,10 @@ def qeinsum(spec: str, a: jax.Array, w) -> jax.Array:
 
 
 # Weight names quantized (stacked per-layer [L, D, F] → per (L, F) scales).
-_LAYER_MATMULS = ("wq", "wk", "wv", "wo", "gate", "up", "down")
+_LAYER_MATMULS = ("wq", "wk", "wv", "wo", "gate", "up", "down",
+                  # qwen2_moe shared expert (dense swiglu; the sigmoid
+                  # sh_router stays full precision like the MoE router)
+                  "sh_gate", "sh_up", "sh_down")
 # MoE expert tensors [L, E, D, F] → per (L, E, out-channel) scales. For
 # mixtral-class models the experts ARE the weights, so leaving them bf16
 # would forfeit the whole int8 HBM-read win; the router stays full

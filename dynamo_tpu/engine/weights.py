@@ -45,9 +45,14 @@ _LAYER_MAP = {
     "self_attn.k_norm.weight": ("k_norm", False),
     # mixtral MoE router
     "block_sparse_moe.gate.weight": ("router", True),
-    # qwen3-moe router (same role, different HF naming; the expert
-    # tensors live under mlp.experts.{e}.*_proj — see _EXPERT_PREFIXES)
+    # qwen3-moe / qwen2-moe router (same role, different HF naming; the
+    # expert tensors live under mlp.experts.{e}.*_proj — _EXPERT_PREFIXES)
     "mlp.gate.weight": ("router", True),
+    # qwen2_moe shared expert (dense swiglu + sigmoid gate)
+    "mlp.shared_expert.gate_proj.weight": ("sh_gate", True),
+    "mlp.shared_expert.up_proj.weight": ("sh_up", True),
+    "mlp.shared_expert.down_proj.weight": ("sh_down", True),
+    "mlp.shared_expert_gate.weight": ("sh_router", True),
 }
 
 # mixtral expert sub-weights: w1=gate, w3=up, w2=down (all torch [out, in])
@@ -365,7 +370,7 @@ def save_hf_style(params: Dict[str, jax.Array], cfg: ModelConfig,
         inv["ln2_post"] = ("post_feedforward_layernorm.weight", False)
     # two HF namings map to "router"/each expert matmul (mixtral vs
     # qwen3-moe); saving must pick the family's names explicitly
-    if cfg.model_type == "qwen3_moe":
+    if cfg.model_type in ("qwen3_moe", "qwen2_moe"):
         inv["router"] = ("mlp.gate.weight", True)
         inv_experts = {"moe_gate": "gate_proj", "moe_up": "up_proj",
                        "moe_down": "down_proj"}

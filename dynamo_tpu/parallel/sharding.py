@@ -75,6 +75,16 @@ def param_pspecs(cfg: ModelConfig) -> Dict[str, P]:
             "layers.moe_up": P(None, "ep", None, "tp"),
             "layers.moe_down": P(None, "ep", "tp", None),
         })
+        if cfg.shared_expert_size > 0:
+            # qwen2_moe shared expert: dense-MLP tp layout; the sigmoid
+            # gate vector replicates. Only when the family HAS one — a
+            # spec for an absent leaf breaks explicit in_shardings trees
+            specs.update({
+                "layers.sh_gate": P(None, None, "tp"),
+                "layers.sh_up": P(None, None, "tp"),
+                "layers.sh_down": P(None, "tp", None),
+                "layers.sh_router": P(),
+            })
     return specs
 
 

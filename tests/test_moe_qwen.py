@@ -299,16 +299,121 @@ def test_qwen3_moe_config_and_weights_roundtrip(qwen3_moe_params, tmp_path):
 
 
 def test_shared_expert_moe_families_rejected():
-    """qwen2-moe-class checkpoints carry a shared expert the generic
-    expert matching would silently drop — from_hf_config must reject
-    them loudly rather than load garbage."""
-    with pytest.raises(ValueError, match="shared-expert"):
-        ModelConfig.from_hf_config({
-            "model_type": "qwen2_moe", "vocab_size": 128,
-            "hidden_size": 64, "num_attention_heads": 4,
-            "num_experts": 4, "moe_intermediate_size": 96})
+    """UNKNOWN families carrying a shared expert must still reject: the
+    generic expert matching would silently drop the shared expert.
+    (qwen2_moe itself is now supported — test_qwen2_moe_*.)"""
     with pytest.raises(ValueError, match="shared-expert"):
         ModelConfig.from_hf_config({
             "model_type": "mystery_moe", "vocab_size": 128,
             "hidden_size": 64, "num_attention_heads": 4,
             "shared_expert_intermediate_size": 128})
+
+
+QWEN2_MOE_CFG = ModelConfig(
+    model_type="qwen2_moe", vocab_size=128, hidden_size=64,
+    intermediate_size=96, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=16, max_position_embeddings=256, rms_norm_eps=1e-5,
+    rope_theta=10000.0, tie_word_embeddings=False,
+    num_experts=4, num_experts_per_tok=2, attention_bias=True,
+    moe_norm_topk=False, shared_expert_size=80)
+
+
+@pytest.fixture(scope="module")
+def qwen2_moe_params():
+    p = llama.init_params(QWEN2_MOE_CFG, jax.random.PRNGKey(17),
+                          dtype=jnp.float32)
+    return _randomize_biases(p, jax.random.PRNGKey(18))
+
+
+def test_qwen2_moe_config_detection():
+    """qwen2_moe (the former shared-expert refusal, now supported):
+    shared expert size + unnormalized top-k routing + implicit qkv bias
+    all detected; hybrid sparsity still rejects."""
+    base = {"model_type": "qwen2_moe", "vocab_size": 151936,
+            "hidden_size": 2048, "num_hidden_layers": 24,
+            "num_attention_heads": 16, "num_key_value_heads": 16,
+            "num_experts": 60, "num_experts_per_tok": 4,
+            "moe_intermediate_size": 1408,
+            "shared_expert_intermediate_size": 5632,
+            "intermediate_size": 5632}
+    cfg = ModelConfig.from_hf_config(base)
+    assert cfg.num_experts == 60 and cfg.shared_expert_size == 5632
+    assert cfg.intermediate_size == 1408      # experts sized by moe_
+    assert not cfg.moe_norm_topk              # HF default false
+    assert cfg.attention_bias                 # hardcoded in HF modeling
+    # HF save_pretrained omits default-valued keys: absent keys must take
+    # the FAMILY's defaults (shared expert 5632, top-4 routing), never a
+    # silent "no shared expert" / top-2
+    absent = {k: v for k, v in base.items()
+              if k not in ("shared_expert_intermediate_size",
+                           "num_experts_per_tok")}
+    cfg2 = ModelConfig.from_hf_config(absent)
+    assert cfg2.shared_expert_size == 5632
+    assert cfg2.num_experts_per_tok == 4
+    assert ModelConfig.from_hf_config(
+        {**base, "norm_topk_prob": True}).moe_norm_topk
+    with pytest.raises(ValueError, match="hybrid sparsity"):
+        ModelConfig.from_hf_config({**base, "decoder_sparse_step": 2})
+
+
+def test_qwen2_moe_save_load_roundtrip(qwen2_moe_params, tmp_path):
+    from dynamo_tpu.engine.weights import load_llama_params, save_hf_style
+    save_hf_style(qwen2_moe_params, QWEN2_MOE_CFG, str(tmp_path))
+    import json
+    (tmp_path / "config.json").write_text(json.dumps({
+        "model_type": "qwen2_moe", "vocab_size": QWEN2_MOE_CFG.vocab_size,
+        "hidden_size": QWEN2_MOE_CFG.hidden_size,
+        "moe_intermediate_size": QWEN2_MOE_CFG.intermediate_size,
+        "intermediate_size": 999,       # dense size: must NOT be used
+        "num_hidden_layers": QWEN2_MOE_CFG.num_layers,
+        "num_attention_heads": QWEN2_MOE_CFG.num_heads,
+        "num_key_value_heads": QWEN2_MOE_CFG.num_kv_heads,
+        "head_dim": QWEN2_MOE_CFG.head_dim,
+        "num_experts": QWEN2_MOE_CFG.num_experts,
+        "num_experts_per_tok": QWEN2_MOE_CFG.num_experts_per_tok,
+        "shared_expert_intermediate_size":
+            QWEN2_MOE_CFG.shared_expert_size}))
+    loaded = load_llama_params(str(tmp_path), dtype=jnp.float32)
+    for k, v in qwen2_moe_params.items():
+        np.testing.assert_allclose(np.asarray(loaded[k]), np.asarray(v),
+                                   rtol=1e-6, atol=1e-6, err_msg=k)
+
+
+def test_qwen2_moe_prefill_and_decode_match_hf(qwen2_moe_params, tmp_path):
+    """qwen2_moe = qkv-bias attention + sparse MoE with softmax-over-ALL
+    routing weights used WITHOUT renormalization (norm_topk_prob=false,
+    the HF default and released-checkpoint setting) + a shared expert
+    scaled by a learned sigmoid gate. Teacher-forced logits vs
+    transformers' Qwen2MoeForCausalLM."""
+    pytest.importorskip("torch")
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+    cfg = QWEN2_MOE_CFG
+    hf = _save_and_load_hf(
+        qwen2_moe_params, cfg, tmp_path, Qwen2MoeConfig,
+        Qwen2MoeForCausalLM,
+        num_experts=cfg.num_experts,
+        num_experts_per_tok=cfg.num_experts_per_tok,
+        moe_intermediate_size=cfg.intermediate_size,
+        shared_expert_intermediate_size=cfg.shared_expert_size,
+        norm_topk_prob=False, decoder_sparse_step=1, mlp_only_layers=[])
+    rng = np.random.default_rng(19)
+    all_tokens = rng.integers(1, cfg.vocab_size, size=14).tolist()
+    n_prefill = 10
+    ref = _hf_logits(hf, all_tokens)
+
+    logits, kv = _prefill(qwen2_moe_params, cfg, all_tokens[:n_prefill])
+    np.testing.assert_allclose(np.asarray(logits), ref[n_prefill - 1],
+                               rtol=5e-4, atol=5e-4)
+
+    tables = np.zeros((2, 8), np.int32)
+    tables[1, :4] = np.arange(1, 5)
+    for step in range(4):
+        pos = n_prefill + step
+        logits_b, kv = llama.decode_forward(
+            qwen2_moe_params, kv,
+            jnp.asarray(np.array([0, all_tokens[pos]], np.int32)),
+            jnp.asarray(np.array([0, pos], np.int32)),
+            jnp.asarray(tables), _statics(cfg))
+        np.testing.assert_allclose(np.asarray(logits_b)[1], ref[pos],
+                                   rtol=5e-4, atol=5e-4,
+                                   err_msg=f"decode step {step}")
