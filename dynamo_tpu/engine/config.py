@@ -95,13 +95,6 @@ class ModelConfig:
             raise ValueError(
                 f"unsupported MoE family {mt!r} (MLA architectures are "
                 f"not implemented; mixtral, qwen2_moe and qwen3_moe are)")
-        if mt == "qwen2_moe" and (cfg.get("mlp_only_layers")
-                                  or int(cfg.get("decoder_sparse_step",
-                                                 1) or 1) > 1):
-            # same uniform-sparsity constraint as qwen3_moe below
-            raise ValueError("qwen2_moe hybrid sparsity (mlp_only_layers "
-                             "/ decoder_sparse_step > 1) is not supported "
-                             "— every layer must be sparse")
         if mt == "qwen3_moe" and not cfg.get("norm_topk_prob", False):
             # moe_mlp implements the normalized (mixtral-equivalent)
             # routing convention; softmax-then-topk WITHOUT renorm is a
@@ -111,13 +104,13 @@ class ModelConfig:
             raise ValueError("qwen3_moe requires norm_topk_prob=true "
                              "(routing weights must renormalize over "
                              "the top-k)")
-        if mt == "qwen3_moe" and (cfg.get("mlp_only_layers")
-                                  or int(cfg.get("decoder_sparse_step",
-                                                 1) or 1) > 1):
+        if mt in ("qwen2_moe", "qwen3_moe") and (
+                cfg.get("mlp_only_layers")
+                or int(cfg.get("decoder_sparse_step", 1) or 1) > 1):
             # hybrid dense/sparse layer mixes cannot be represented by
             # the uniform stacked expert tensors; failing here beats a
             # misleading "checkpoint missing experts" later
-            raise ValueError("qwen3_moe hybrid sparsity (mlp_only_layers "
+            raise ValueError(f"{mt} hybrid sparsity (mlp_only_layers "
                              "/ decoder_sparse_step > 1) is not supported "
                              "— every layer must be sparse")
         if mt == "phi3" and cfg.get("rope_scaling"):
@@ -147,9 +140,12 @@ class ModelConfig:
             # qwen3-moe sizes the EXPERT mlps by moe_intermediate_size;
             # our stacked expert tensors use intermediate_size for F
             intermediate_size=int(
-                (cfg.get("moe_intermediate_size")
-                 if cfg.get("moe_intermediate_size")
-                 and int(cfg.get("num_experts", 0) or 0) > 0
+                (cfg.get("moe_intermediate_size",
+                         1408 if mt == "qwen2_moe" else 0)
+                 if (cfg.get("moe_intermediate_size",
+                             1408 if mt == "qwen2_moe" else 0)
+                     and (int(cfg.get("num_experts", 0) or 0) > 0
+                          or mt == "qwen2_moe"))
                  else cfg.get("intermediate_size", 4 * hidden))),
             num_layers=int(cfg.get("num_hidden_layers", 32)),
             num_heads=n_heads,
@@ -166,7 +162,13 @@ class ModelConfig:
                 "attention_bias",
                 cfg.get("model_type") in ("qwen2", "qwen2_moe"))),
             num_experts=int(cfg.get("num_local_experts", 0) or
-                            cfg.get("num_experts", 0) or 0),
+                            cfg.get("num_experts",
+                                    # Qwen2MoeConfig class default: a
+                                    # re-saved A2.7B config omits the
+                                    # key (to_diff_dict); 0 would parse
+                                    # a MoE checkpoint as a dense model
+                                    60 if mt == "qwen2_moe" else 0)
+                            or 0),
             # HF save_pretrained omits default-valued keys (use_diff), so
             # each family's OWN default must apply when the key is absent:
             # Mixtral 2, Qwen2Moe 4, Qwen3Moe 8

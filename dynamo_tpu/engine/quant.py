@@ -165,13 +165,15 @@ def _kernel_serves(w: "QuantizedArray") -> bool:
     unpack_params (which then leaves it packed) and mm (which then calls
     the kernel), so the two can't disagree.
 
-    DYN_INT4_KERNEL=1 opt-in (trace-time): measured on v5e, XLA's int8
-    matmul streams near peak and the kernel only edges the XLA grouped
-    path in the small-batch/large-F corner — engine-level it lost
-    (25.9 vs 21.4 ms/step on the 70B shard, PERF.md int4 section), so
-    the XLA path is the default."""
+    Default ON (DYN_INT4_KERNEL=0 falls back to the XLA grouped path):
+    the XLA path materializes a [T, D/128, F] partial that grows with
+    prefill length — measured 14 GB at a 7.7K-token 8B prefill, an OOM
+    on the exact capacity/long-context configs int4 exists for — while
+    the kernel streams with no partial. The kernel is ~15-20% slower at
+    decode than the XLA grouped form (PERF.md int4 sections), a fair
+    price for actually fitting."""
     import os
-    if os.environ.get("DYN_INT4_KERNEL", "0") != "1":
+    if os.environ.get("DYN_INT4_KERNEL", "1") == "0":
         return False
     from .attention import _on_tpu
     from .quant_matmul import grouped_kernel_eligible

@@ -346,10 +346,16 @@ def test_qwen2_moe_config_detection():
     # silent "no shared expert" / top-2
     absent = {k: v for k, v in base.items()
               if k not in ("shared_expert_intermediate_size",
-                           "num_experts_per_tok")}
+                           "num_experts_per_tok", "num_experts",
+                           "moe_intermediate_size")}
     cfg2 = ModelConfig.from_hf_config(absent)
     assert cfg2.shared_expert_size == 5632
     assert cfg2.num_experts_per_tok == 4
+    # Qwen2MoeConfig class defaults (num_experts=60, moe 1408) — a
+    # re-saved A2.7B config omits them; parsing as dense would be silent
+    # garbage
+    assert cfg2.num_experts == 60
+    assert cfg2.intermediate_size == 1408
     assert ModelConfig.from_hf_config(
         {**base, "norm_topk_prob": True}).moe_norm_topk
     with pytest.raises(ValueError, match="hybrid sparsity"):
