@@ -111,6 +111,16 @@ def validate_values(v: dict) -> None:
     bsz = v["kv_block_size"]
     _check(p, is_int(bsz) and 8 <= bsz <= 256 and (bsz & (bsz - 1)) == 0,
            f"kv_block_size must be a power of two in [8, 256], got {bsz!r}")
+    q = v["model"]["quantization"]
+    _check(p, q in ("none", "int8", "int8-noembed", "int4", "int4-noembed"),
+           f"model.quantization must be one of none|int8|int8-noembed|"
+           f"int4|int4-noembed, got {q!r}")
+    kq = v["model"]["kv_quantization"]
+    _check(p, kq in ("none", "int8"),
+           f"model.kv_quantization must be none|int8, got {kq!r}")
+    _check(p, kq != "int8" or (is_int(bsz) and bsz % 32 == 0),
+           f"kv_quantization=int8 needs kv_block_size % 32 == 0 "
+           f"(the int8 sublane tile), got {bsz!r}")
     for comp in ("frontend", "decode", "prefill"):
         r = v[comp]["replicas"]
         _check(p, is_int(r) and r >= 0,
@@ -157,6 +167,8 @@ def _substitutions(v: dict) -> Dict[str, str]:
         "image": v["image"],
         "model_name": v["model"]["name"],
         "model_path": v["model"]["path"],
+        "model_quant": v["model"]["quantization"],
+        "model_kv_quant": v["model"]["kv_quantization"],
         "kv_block_size": str(v["kv_block_size"]),
         "frontend_replicas": str(v["frontend"]["replicas"]),
         "frontend_port": str(v["frontend"]["port"]),

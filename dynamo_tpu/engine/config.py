@@ -122,6 +122,16 @@ class ModelConfig:
                 "use a base-context phi3 checkpoint (no rope_scaling)")
         n_heads = int(cfg.get("num_attention_heads", 32))
         hidden = int(cfg.get("hidden_size", 4096))
+        # HF save_pretrained omits class-default keys (to_diff_dict), so
+        # absent MoE keys must take each FAMILY's class defaults —
+        # otherwise a re-saved MoE config silently parses as dense
+        n_experts = int(cfg.get("num_local_experts", 0)
+                        or cfg.get("num_experts",
+                                   {"qwen2_moe": 60, "qwen3_moe": 128,
+                                    "mixtral": 8}.get(mt, 0)) or 0)
+        moe_inter = int(cfg.get("moe_intermediate_size",
+                                {"qwen2_moe": 1408,
+                                 "qwen3_moe": 768}.get(mt, 0)) or 0)
         rs = None
         raw_rs = cfg.get("rope_scaling")
         if isinstance(raw_rs, dict):
@@ -139,14 +149,11 @@ class ModelConfig:
             hidden_size=hidden,
             # qwen3-moe sizes the EXPERT mlps by moe_intermediate_size;
             # our stacked expert tensors use intermediate_size for F
+            # MoE families size the EXPERT mlps by moe_intermediate_size;
+            # our stacked expert tensors use intermediate_size for F
             intermediate_size=int(
-                (cfg.get("moe_intermediate_size",
-                         1408 if mt == "qwen2_moe" else 0)
-                 if (cfg.get("moe_intermediate_size",
-                             1408 if mt == "qwen2_moe" else 0)
-                     and (int(cfg.get("num_experts", 0) or 0) > 0
-                          or mt == "qwen2_moe"))
-                 else cfg.get("intermediate_size", 4 * hidden))),
+                moe_inter if (moe_inter and n_experts > 0)
+                else cfg.get("intermediate_size", 4 * hidden)),
             num_layers=int(cfg.get("num_hidden_layers", 32)),
             num_heads=n_heads,
             num_kv_heads=int(cfg.get("num_key_value_heads", n_heads)),
@@ -161,14 +168,7 @@ class ModelConfig:
             attention_bias=bool(cfg.get(
                 "attention_bias",
                 cfg.get("model_type") in ("qwen2", "qwen2_moe"))),
-            num_experts=int(cfg.get("num_local_experts", 0) or
-                            cfg.get("num_experts",
-                                    # Qwen2MoeConfig class default: a
-                                    # re-saved A2.7B config omits the
-                                    # key (to_diff_dict); 0 would parse
-                                    # a MoE checkpoint as a dense model
-                                    60 if mt == "qwen2_moe" else 0)
-                            or 0),
+            num_experts=n_experts,
             # HF save_pretrained omits default-valued keys (use_diff), so
             # each family's OWN default must apply when the key is absent:
             # Mixtral 2, Qwen2Moe 4, Qwen3Moe 8

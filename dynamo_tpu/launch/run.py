@@ -73,6 +73,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host-kv-blocks", type=int, default=0,
                    help="host (TPU-VM DRAM) KV offload tier size")
     p.add_argument("--no-prefix-reuse", action="store_true")
+    p.add_argument("--kv-quantization",
+                   choices=["none", "int8"], default="none",
+                   help="KV-cache quantization (int8: per-token in-row "
+                        "scales, 1.6-1.8x KV-byte cut, needs "
+                        "--kv-block-size %% 32 == 0; the long-context "
+                        "capacity lever)")
     p.add_argument("--quantization",
                    choices=["none", "int8", "int8-noembed",
                             "int4", "int4-noembed"],
@@ -163,6 +169,7 @@ def engine_config(args):
         decode_dispatch_pipeline=args.decode_dispatch_pipeline,
         lane_prefill_max_tokens=args.lane_prefill_max_tokens,
         quantization=args.quantization,
+        kv_quantization=args.kv_quantization,
         tp=args.tp, sp=args.sp, dp=args.dp, ep=args.ep)
 
 

@@ -151,6 +151,11 @@ def test_chart_rejects_invalid_values():
         # double-quoted YAML scalar can smuggle one into a command string
         ({"model": {"path": "/models/m\n"}}, "model.path"),
         ({"namespace": "ns\n"}, "namespace"),
+        ({"model": {"quantization": "fp8"}}, "model.quantization"),
+        ({"model": {"kv_quantization": "int4"}}, "model.kv_quantization"),
+        # int8 KV pools need 32-token blocks (the int8 sublane tile)
+        ({"model": {"kv_quantization": "int8"}, "kv_block_size": 16},
+         "kv_quantization=int8"),
     ]
     for overrides, needle in bad_cases:
         with pytest.raises(ChartError) as ei:

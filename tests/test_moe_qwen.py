@@ -356,6 +356,16 @@ def test_qwen2_moe_config_detection():
     # garbage
     assert cfg2.num_experts == 60
     assert cfg2.intermediate_size == 1408
+    # same hazard for the other MoE families' class defaults
+    q3 = ModelConfig.from_hf_config({
+        "model_type": "qwen3_moe", "vocab_size": 128, "hidden_size": 64,
+        "num_attention_heads": 4, "norm_topk_prob": True})
+    assert q3.num_experts == 128 and q3.intermediate_size == 768
+    assert q3.num_experts_per_tok == 8
+    mx = ModelConfig.from_hf_config({
+        "model_type": "mixtral", "vocab_size": 128, "hidden_size": 64,
+        "num_attention_heads": 4, "intermediate_size": 96})
+    assert mx.num_experts == 8 and mx.intermediate_size == 96
     assert ModelConfig.from_hf_config(
         {**base, "norm_topk_prob": True}).moe_norm_topk
     with pytest.raises(ValueError, match="hybrid sparsity"):
