@@ -11,7 +11,7 @@ tok/s/chip for 70B on a v5e-64 pod; `vs_baseline` reports value/2000 so the
 driver has a consistent scalar across rounds.
 
 Env knobs: BENCH_BATCH (default 128), BENCH_STEPS (128), BENCH_PROMPT (128),
-BENCH_MODEL (1b|tiny|8b|70b_tp8shard|moe — 8b is Llama-3-8B geometry,
+BENCH_MODEL (1b|tiny|8b|70b_tp8shard|moe|qwen2moe — 8b is Llama-3-8B geometry,
 random weights; at int8 the weights are ~8 GB of the 16 GB HBM, so pick
 BENCH_BATCH/LEN so KV fits: B=64 with default lengths, B=128 with
 BENCH_HARVEST<=8; 70b_tp8shard is the per-chip slice of 70B under the
@@ -71,10 +71,19 @@ def _param_bytes(params) -> int:
 def _matmul_flops_per_token(mcfg) -> float:
     """2·(matmul weight count) per token: qkv + wo + mlp per layer, + lm
     head. Embedding lookup is free; attention score/update flops are
-    accounted separately (they scale with seq len)."""
+    accounted separately (they scale with seq len). MoE geometries run
+    the dense-over-experts einsum — ALL E experts execute per token
+    (engine moe_mlp) — plus any shared expert, and the MFU must count
+    those real flops (earlier MoE history lines understated this)."""
     D, F = mcfg.hidden_size, mcfg.intermediate_size
     H, KVH, Dh = mcfg.num_heads, mcfg.num_kv_heads, mcfg.head_dim
-    per_layer = D * (H + 2 * KVH) * Dh + H * Dh * D + 3 * D * F
+    if getattr(mcfg, "num_experts", 0) > 0:
+        mlp = (mcfg.num_experts * 3 * D * F
+               + 3 * D * getattr(mcfg, "shared_expert_size", 0)
+               + D * mcfg.num_experts)          # router
+    else:
+        mlp = 3 * D * F
+    per_layer = D * (H + 2 * KVH) * Dh + H * Dh * D + mlp
     return 2.0 * (mcfg.num_layers * per_layer
                   + D * mcfg.vocab_size)
 
@@ -261,7 +270,8 @@ def _metric_name(model: str, batch: int, quant: str,
     name for the default int8 config; any other quantization suffixes
     it — an int4 or int8-KV run must NOT post to the int8 gate
     history."""
-    family = "mixtral_" if model == "moe" else "llama"
+    # the qwen2moe model name already carries its family — no prefix
+    family = {"moe": "mixtral_", "qwen2moe": ""}.get(model, "llama")
     name = (f"decode_tok_per_s_chip_{family}{model}_b{batch}"
             + ("" if quant == "none" else f"_{quant}")
             + ("" if kv_quant == "none" else "_kv8"))

@@ -280,8 +280,20 @@ def bench_model_config(name: str) -> "ModelConfig":
                            head_dim=64, max_position_embeddings=8192,
                            rope_theta=500000.0, num_experts=8,
                            num_experts_per_tok=2)
+    if name == "qwen2moe":
+        # qwen2_moe-class, one-chip (~3.1 GB int8): Qwen1.5-MoE-A2.7B's
+        # D/L/heads/expert-F/shared-F with the expert COUNT cut 60 → 8
+        # to fit (the shared-expert + unnormalized-routing code paths are
+        # what this geometry times; expert count only scales the einsum)
+        return ModelConfig(model_type="qwen2_moe", vocab_size=151936,
+                           hidden_size=2048, intermediate_size=1408,
+                           num_layers=24, num_heads=16, num_kv_heads=16,
+                           head_dim=128, max_position_embeddings=8192,
+                           attention_bias=True, num_experts=8,
+                           num_experts_per_tok=4, moe_norm_topk=False,
+                           shared_expert_size=5632)
     raise ValueError(f"unknown bench model {name!r} "
-                     f"(tiny|1b|8b|70b_tp8shard|moe)")
+                     f"(tiny|1b|8b|70b_tp8shard|moe|qwen2moe)")
 
 
 @dataclasses.dataclass

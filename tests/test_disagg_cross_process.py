@@ -57,7 +57,8 @@ WORKER_SCRIPT = textwrap.dedent("""
             TINY,
             EngineConfig(max_model_len=128, kv_block_size=8,
                          num_kv_blocks=48, max_num_seqs=2,
-                         prefill_buckets=[16, 32, 64, 128], seed=0),
+                         prefill_buckets=[16, 32, 64, 128], seed=0,
+                         kv_quantization={kvq!r}),
             attn_impl="xla", param_dtype=jnp.float32)
         worker = await PrefillWorker(core, rt).start()
         print("PREFILL-WORKER-READY", flush=True)
@@ -67,7 +68,11 @@ WORKER_SCRIPT = textwrap.dedent("""
 """)
 
 
-async def test_cross_process_remote_prefill_matches_local():
+@pytest.mark.parametrize("kv_quant", ["none", "int8"])
+async def test_cross_process_remote_prefill_matches_local(kv_quant):
+    """int8 KV: the wire plane ships whole opaque int8 rows between real
+    OS processes — the disagg pair must still reproduce the aggregated
+    engine exactly (bit-exact rows, no requantization)."""
     TINY = ModelConfig(
         model_type="llama", vocab_size=128, hidden_size=64,
         intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
@@ -79,7 +84,8 @@ async def test_cross_process_remote_prefill_matches_local():
             TINY,
             EngineConfig(max_model_len=128, kv_block_size=8,
                          num_kv_blocks=48, max_num_seqs=2,
-                         prefill_buckets=[16, 32, 64, 128], seed=0),
+                         prefill_buckets=[16, 32, 64, 128], seed=0,
+                         kv_quantization=kv_quant),
             attn_impl="xla", param_dtype=jnp.float32)
 
     rng = np.random.default_rng(42)
@@ -110,7 +116,7 @@ async def test_cross_process_remote_prefill_matches_local():
 
     srv = DiscoveryServer(host="127.0.0.1")
     await srv.start()
-    script = WORKER_SCRIPT.format(repo=REPO)
+    script = WORKER_SCRIPT.format(repo=REPO, kvq=kv_quant)
     env = {k: v for k, v in os.environ.items()
            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     proc = subprocess.Popen([sys.executable, "-c", script, srv.address],
