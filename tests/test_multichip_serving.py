@@ -77,7 +77,7 @@ def long_prompt():
 
 
 # NOTE: the bare EngineCore+JaxEngine serving run on a tp×sp mesh lives in
-# tests/test_ring_attention.py::test_engine_serving_over_tp_sp_mesh (with an
+# tests/test_ring_attention.py::test_engine_serving_over_sp_mesh (with an
 # sp-dispatch counter); this file covers the layers above it.
 
 

@@ -177,12 +177,20 @@ def _request(prompt, rid):
 
 
 @pytest.mark.asyncio
-async def test_engine_serving_over_tp_sp_mesh():
-    """Full serving path (continuous batching + sp prefill + tp decode) on a
-    tp=2 × sp=2 mesh produces the single-device greedy tokens."""
+@pytest.mark.parametrize("mesh_kw,extra_ecfg,seed", [
+    ({"tp": 2, "sp": 2}, {}, 7),
+    # sp + int8 KV pool: sp does not shard the lane axis, so the pool
+    # keeps ONE in-row scale group — the interplay had no coverage
+    ({"sp": 2}, {"kv_quantization": "int8"}, 23),
+])
+async def test_engine_serving_over_sp_mesh(mesh_kw, extra_ecfg, seed):
+    """Full serving path (continuous batching + sp ring prefill) on a
+    mesh produces the single-device greedy tokens — for the plain
+    tp×sp layout and for an int8 KV pool under sp."""
     ecfg = dict(max_model_len=128, kv_block_size=8, num_kv_blocks=48,
-                max_num_seqs=2, prefill_buckets=[16, 32, 64, 128])
-    rng = np.random.default_rng(7)
+                max_num_seqs=2, prefill_buckets=[16, 32, 64, 128],
+                **extra_ecfg)
+    rng = np.random.default_rng(seed)
     prompt = [int(t) for t in rng.integers(2, 120, size=41)]
 
     core1 = EngineCore(TINY, EngineConfig(**ecfg), attn_impl="xla",
@@ -195,10 +203,12 @@ async def test_engine_serving_over_tp_sp_mesh():
         await core1.stop()
     assert len(want) == 8
 
-    mesh = make_mesh(tp=2, sp=2)
+    mesh = make_mesh(**mesh_kw)
     core2 = EngineCore(TINY, EngineConfig(**ecfg, sp=2,
                                           sp_min_prefill_tokens=1),
                        attn_impl="xla", param_dtype=jnp.float32, mesh=mesh)
+    if extra_ecfg.get("kv_quantization") == "int8":
+        assert core2.kv["k"].dtype.name == "int8"
     assert core2._prefill_sp_jit is not None
     # count sp dispatches so the test can't silently take plain prefill
     sp_calls = []
