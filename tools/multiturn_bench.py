@@ -142,7 +142,7 @@ def main():
     # overstated the reference-pillar comparison
     gain = (off["ttft_later_ms"] - on["ttft_later_ms"])         / max(off["ttft_later_ms"], 1e-9)
     print(json.dumps({
-        "metric": "host_tier_ttft_gain_multiturn",
+        "metric": "host_tier_ttft_reduction_multiturn",
         "value": round(gain * 100, 1), "unit": "% TTFT reduction vs no host tier",
         "later_turn_ttft_ms": {"on": on["ttft_later_ms"],
                                "off": off["ttft_later_ms"]},
