@@ -55,6 +55,16 @@ class ModelConfig:
     shared_expert_size: int = 0
     # qwen3-style per-head q/k norm
     qk_norm: bool = False
+    # MLA (deepseek_v2): latent-KV attention dims for models/mla.py.
+    # q_lora_rank 0 = plain q_proj (the -Lite layout). NOTE: only the
+    # model module consumes these so far — from_hf_config does not parse
+    # them and the engine dispatch is pending (from_hf_config still
+    # rejects deepseek_v2/v3); currently set by tests only.
+    q_lora_rank: int = 0
+    kv_lora_rank: int = 0
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int = 0
     # gemma-family deltas (model_type gemma/gemma2): gelu MLP, scaled
     # embeddings, (1+w) RMSNorm, post-block norms, logit soft-capping
     hidden_act: str = "silu"          # silu | gelu_pytorch_tanh
@@ -90,11 +100,16 @@ class ModelConfig:
                 f"unsupported shared-expert MoE family {mt!r} "
                 f"(qwen2_moe is the implemented shared-expert family)")
         if mt in ("deepseek_v2", "deepseek_v3"):
-            # MLA attention + grouped routing — a different attention
-            # function entirely; half-loading it would decode garbage
+            # The MLA model module (engine/models/mla.py: latent-KV
+            # paged cache + absorbed decode, HF-parity-tested) exists,
+            # but engine/serving integration and the deepseek MoE
+            # variants (shared-expert additive, first_k_dense hybrid,
+            # v3 sigmoid-grouped routing) are pending — half-serving
+            # would decode garbage, so the family still rejects
             raise ValueError(
-                f"unsupported MoE family {mt!r} (MLA architectures are "
-                f"not implemented; mixtral, qwen2_moe and qwen3_moe are)")
+                f"{mt!r} serving is not integrated yet (the MLA "
+                f"attention module is implemented and parity-tested; "
+                f"deepseek MoE + engine wiring pending)")
         if mt == "qwen3_moe" and not cfg.get("norm_topk_prob", False):
             # moe_mlp implements the normalized (mixtral-equivalent)
             # routing convention; softmax-then-topk WITHOUT renorm is a

@@ -213,7 +213,8 @@ def init_one_param(cfg: ModelConfig, name: str, shape: tuple,
     init_params so quant.init_params_quantized can build+quantize one
     tensor at a time without materializing the full bf16 tree."""
     if name.endswith(("ln1", "ln2", "ln1_post", "ln2_post",
-                      "q_norm", "k_norm")) or name == "final_norm":
+                      "q_norm", "k_norm",
+                      "kv_norm", "q_a_norm")) or name == "final_norm":
         return (jnp.zeros(shape, dtype=dtype)
                 if cfg.norm_plus_one
                 else jnp.ones(shape, dtype=dtype))

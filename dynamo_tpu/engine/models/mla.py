@@ -1,0 +1,313 @@
+"""Multi-head Latent Attention (MLA, deepseek_v2) in pure JAX with the
+paged LATENT-KV cache.
+
+The reference serves DeepSeek models through its external engines; here
+MLA is an engine-native model definition like models/llama.py. The
+design is what makes MLA attractive for serving: the per-token cache is
+the COMPRESSED latent row — ``[c_kv (kv_lora_rank) | k_pe
+(qk_rope_head_dim)]``, e.g. 512+64 lanes instead of H·(192+128) — and
+decode runs the ABSORBED form, contracting queries into latent space so
+attention reads only those rows (an MQA-shaped read despite H heads).
+The row format drops straight into the block-major paged pool
+``[L, NTOK, rank+rope]`` the whole KV subsystem (reuse, offload,
+handoff) already speaks.
+
+Conventions pinned against HF ``DeepseekV2Attention`` (transformers
+4.57, modeling_deepseek_v2.py:288-400, verified by the parity tests):
+
+- rope is INTERLEAVED complex rotation (pairs (2i, 2i+1), angle
+  pos·inv_freq[i]) — NOT llama's half-split convention;
+- softmax scale is (qk_nope + qk_rope)^-0.5;
+- the cached latent is the POST-RMSNorm compressed kv (k/v expand from
+  it with the pure matmul ``kv_b``), and k_pe is cached post-rope;
+- q path: plain ``q_proj`` when q_lora_rank == 0 (the -Lite layout),
+  else ``q_a → rmsnorm → q_b``.
+
+Scope: dense MLP layers, default rope. Pending before the family can
+serve (config.from_hf_config keeps rejecting deepseek_v2/v3 until ALL
+land): yarn rope scaling + its mscale attention-scale factor (every
+released DeepSeek-V2 checkpoint uses it — parity here is tested with
+rope_scaling=None only), the deepseek MoE variants (shared experts
+additive, first_k_dense hybrid sparsity, v3 sigmoid-grouped routing),
+and the engine/core.py model dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import ModelConfig
+from ..quant import mm
+from .llama import (ModelStatics, _embed, _layer_stack, _logits,
+                    flat_token_indices, rms_norm, swiglu)
+
+Params = Dict[str, jax.Array]
+KVCache = Dict[str, jax.Array]   # {"kv": [L, NTOK, rank + rope]}
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Rope (interleaved complex convention — HF apply_rotary_emb)
+# ---------------------------------------------------------------------------
+
+
+def rope_inv_freq(cfg: ModelConfig) -> np.ndarray:
+    d = cfg.qk_rope_head_dim
+    return (1.0 / (cfg.rope_theta
+                   ** (np.arange(0, d, 2, dtype=np.float64) / d))
+            ).astype(np.float32)
+
+
+def apply_rope_interleaved(x: jax.Array, positions: jax.Array,
+                           inv_freq: jax.Array) -> jax.Array:
+    """x [..., T, d] with the pair (2i, 2i+1) rotated by pos·inv_freq[i]
+    (torch.view_as_complex pairing). positions: [T]."""
+    ang = positions[:, None].astype(jnp.float32) * inv_freq[None, :]
+    cos = jnp.cos(ang)                                  # [T, d/2]
+    sin = jnp.sin(ang)
+    shape = x.shape
+    xp = x.astype(jnp.float32).reshape(shape[:-1] + (shape[-1] // 2, 2))
+    # broadcast the [T, d/2] angles over any middle axes (q_pe carries a
+    # head axis, k_pe does not)
+    for _ in range(xp.ndim - 3):
+        cos = cos[:, None]
+        sin = sin[:, None]
+    x0, x1 = xp[..., 0], xp[..., 1]
+    out = jnp.stack([x0 * cos - x1 * sin, x0 * sin + x1 * cos], axis=-1)
+    return out.reshape(shape).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Parameters / cache
+# ---------------------------------------------------------------------------
+
+
+def param_shapes(cfg: ModelConfig) -> Dict[str, Tuple[int, ...]]:
+    L, D, H = cfg.num_layers, cfg.hidden_size, cfg.num_heads
+    qk = cfg.qk_nope_head_dim + cfg.qk_rope_head_dim
+    shapes: Dict[str, Tuple[int, ...]] = {
+        "embed": (cfg.vocab_size, D),
+        "final_norm": (D,),
+        "layers.ln1": (L, D),
+        "layers.ln2": (L, D),
+        "layers.wkv_a": (L, D, cfg.kv_lora_rank + cfg.qk_rope_head_dim),
+        "layers.kv_norm": (L, cfg.kv_lora_rank),
+        "layers.wkv_b": (L, cfg.kv_lora_rank,
+                         H * (cfg.qk_nope_head_dim + cfg.v_head_dim)),
+        "layers.wo": (L, H * cfg.v_head_dim, D),
+        "layers.gate": (L, D, cfg.intermediate_size),
+        "layers.up": (L, D, cfg.intermediate_size),
+        "layers.down": (L, cfg.intermediate_size, D),
+    }
+    if cfg.q_lora_rank > 0:
+        shapes.update({
+            "layers.wq_a": (L, D, cfg.q_lora_rank),
+            "layers.q_a_norm": (L, cfg.q_lora_rank),
+            "layers.wq_b": (L, cfg.q_lora_rank, H * qk),
+        })
+    else:
+        shapes["layers.wq"] = (L, D, H * qk)
+    if not cfg.tie_word_embeddings:
+        shapes["lm_head"] = (D, cfg.vocab_size)
+    return shapes
+
+
+def init_params(cfg: ModelConfig, key: jax.Array,
+                dtype=jnp.bfloat16) -> Params:
+    from .llama import init_one_param
+    params: Params = {}
+    for name, shape in param_shapes(cfg).items():
+        key, sub = jax.random.split(key)
+        params[name] = init_one_param(cfg, name, shape, sub, dtype)
+    return params
+
+
+def init_kv_cache(cfg: ModelConfig, num_blocks: int,
+                  block_size: int, dtype=jnp.bfloat16) -> KVCache:
+    C = cfg.kv_lora_rank + cfg.qk_rope_head_dim
+    return {"kv": jnp.zeros(
+        (cfg.num_layers, num_blocks * block_size, C), dtype=dtype)}
+
+
+# ---------------------------------------------------------------------------
+# Shared layer body
+# ---------------------------------------------------------------------------
+
+
+def _q_proj(lp, hn, cfg: ModelConfig):
+    """[N, D] -> (q_nope [N, H, dn], q_pe [N, H, dr])."""
+    H = cfg.num_heads
+    dn, dr = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+    if cfg.q_lora_rank > 0:
+        qa = rms_norm(mm(hn, lp["wq_a"]), lp["q_a_norm"], cfg.rms_norm_eps)
+        q = mm(qa, lp["wq_b"])
+    else:
+        q = mm(hn, lp["wq"])
+    q = q.reshape(hn.shape[0], H, dn + dr)
+    return q[..., :dn], q[..., dn:]
+
+
+def _latent_rows(lp, hn, positions, cfg: ModelConfig):
+    """[N, D] -> latent cache rows [N, rank+rope]: post-norm c_kv with
+    post-rope k_pe — the format every reader expands from."""
+    ckv = mm(hn, lp["wkv_a"])
+    c, k_pe = ckv[..., :cfg.kv_lora_rank], ckv[..., cfg.kv_lora_rank:]
+    c = rms_norm(c, lp["kv_norm"], cfg.rms_norm_eps)
+    inv = jnp.asarray(rope_inv_freq(cfg))
+    k_pe = apply_rope_interleaved(k_pe, positions, inv)
+    return jnp.concatenate([c, k_pe], axis=-1)
+
+
+def _run_layers(params: Params, kv: KVCache, x: jax.Array,
+                positions: jax.Array, slots: jax.Array, cfg: ModelConfig,
+                attn_fn) -> Tuple[jax.Array, KVCache]:
+    """attn_fn(q_nope, q_pe, rows_new, kv_flat, lp, li) -> [N, H*v]."""
+    L = cfg.num_layers
+    layer_params = _layer_stack(params)
+    NTOK = kv["kv"].shape[1]
+    inv = jnp.asarray(rope_inv_freq(cfg))
+
+    def layer(carry, xs):
+        h, pool = carry
+        lp, li = xs["lp"], xs["i"]
+        hn = rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
+        q_nope, q_pe = _q_proj(lp, hn, cfg)
+        q_pe = apply_rope_interleaved(q_pe, positions, inv)
+        rows = _latent_rows(lp, hn, positions, cfg)
+        pool = pool.at[li, slots, :].set(rows.astype(pool.dtype),
+                                         mode="drop")
+        attn = attn_fn(q_nope, q_pe, rows,
+                       pool.reshape(L * NTOK, pool.shape[2]), lp, li)
+        h = h + mm(attn, lp["wo"])
+        hn2 = rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
+        h = h + swiglu(hn2, lp["gate"], lp["up"], lp["down"],
+                       cfg.hidden_act)
+        return (h, pool), None
+
+    (x, pool), _ = jax.lax.scan(
+        layer, (x, kv["kv"]),
+        {"lp": layer_params, "i": jnp.arange(L, dtype=jnp.int32)})
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return x, {"kv": pool}
+
+
+def _split_wkv_b(lp, cfg: ModelConfig):
+    """wkv_b [rank, H*(dn+v)] -> (w_k [H, rank, dn], w_v [H, rank, v])."""
+    H, dn, dv = cfg.num_heads, cfg.qk_nope_head_dim, cfg.v_head_dim
+    w = lp["wkv_b"].reshape(cfg.kv_lora_rank, H, dn + dv)
+    return (jnp.moveaxis(w[..., :dn], 1, 0),
+            jnp.moveaxis(w[..., dn:], 1, 0))
+
+
+# ---------------------------------------------------------------------------
+# Prefill: expand k/v from latent rows, dense causal attention
+# ---------------------------------------------------------------------------
+
+
+def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
+                    block_table: jax.Array, start_pos: jax.Array,
+                    true_len: jax.Array, statics: ModelStatics
+                    ) -> Tuple[jax.Array, KVCache]:
+    """Same contract as llama.prefill_forward: tokens [T] (padded),
+    block_table [M], returns (last-token logits [V], new kv). Supports a
+    cached prefix (start_pos > 0 — chunked prefill / prefix reuse): the
+    chunk's rows are scattered first and attention expands k/v for the
+    WHOLE table from the latent pool."""
+    cfg, bsz = statics.cfg, statics.block_size
+    T = tokens.shape[0]
+    H = cfg.num_heads
+    rank, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+    scale = (cfg.qk_nope_head_dim + dr) ** -0.5
+    positions = start_pos + jnp.arange(T, dtype=jnp.int32)
+    valid = jnp.arange(T) < true_len
+    slots = jnp.where(
+        valid, block_table[positions // bsz] * bsz + positions % bsz, 0)
+    seq_len = start_pos + true_len
+
+    def attn(q_nope, q_pe, _rows, kv_flat, lp, li):
+        NTOK = kv_flat.shape[0] // cfg.num_layers
+        idx = (flat_token_indices(block_table[None, :], bsz)[0]
+               + li * NTOK)
+        S = idx.shape[0]
+        rows = jnp.take(kv_flat, idx, axis=0)            # [S, rank+dr]
+        c, k_pe = rows[..., :rank], rows[..., rank:]
+        w_k, w_v = _split_wkv_b(lp, cfg)
+        # expand: k_nope [H, S, dn], v [H, S, dv]
+        k_nope = jnp.einsum("sr,hrd->hsd", c.astype(jnp.float32),
+                            w_k.astype(jnp.float32))
+        v = jnp.einsum("sr,hrd->hsd", c.astype(jnp.float32),
+                       w_v.astype(jnp.float32))
+        qn = q_nope.astype(jnp.float32)                  # [T, H, dn]
+        qp = q_pe.astype(jnp.float32)                    # [T, H, dr]
+        scores = (jnp.einsum("thd,hsd->hts", qn, k_nope)
+                  + jnp.einsum("thd,sd->hts", qp,
+                               k_pe.astype(jnp.float32))) * scale
+        qpos = positions[:, None]
+        kpos = jnp.arange(S)[None, :]
+        mask = (kpos <= qpos) & (kpos < seq_len)
+        scores = jnp.where(mask[None, :, :], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("hts,hsd->thd", probs, v)       # [T, H, dv]
+        return out.reshape(T, H * cfg.v_head_dim).astype(q_nope.dtype)
+
+    x = _embed(params, tokens, cfg)
+    x, kv_new = _run_layers(params, kv, x, positions, slots, cfg, attn)
+    last = x[jnp.maximum(true_len - 1, 0)]
+    return _logits(params, last, cfg), kv_new
+
+
+# ---------------------------------------------------------------------------
+# Decode: the ABSORBED form — attention reads only the latent rows
+# ---------------------------------------------------------------------------
+
+
+def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
+                   positions: jax.Array, block_tables: jax.Array,
+                   statics: ModelStatics) -> Tuple[jax.Array, KVCache]:
+    """Same contract as llama.decode_forward: tokens [B], positions [B],
+    block_tables [B, M] -> (logits [B, V], new kv).
+
+    Absorption: scores_h = (q_nope_h W_k_h)·c + q_pe_h·k_pe and
+    out_h = (probs·c) W_v_h — queries drop into latent space once per
+    step, so the per-token HBM read is ONE (rank+rope)-lane row shared
+    by all H heads (the serving win MLA exists for)."""
+    cfg, bsz = statics.cfg, statics.block_size
+    B = tokens.shape[0]
+    H = cfg.num_heads
+    rank, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+    scale = (cfg.qk_nope_head_dim + dr) ** -0.5
+    slots = (block_tables[jnp.arange(B), positions // bsz] * bsz
+             + positions % bsz)
+    seq_lens = positions + 1
+
+    def attn(q_nope, q_pe, _rows, kv_flat, lp, li):
+        NTOK = kv_flat.shape[0] // cfg.num_layers
+        num_blocks = NTOK // bsz
+        idx = flat_token_indices(block_tables + li * num_blocks, bsz)
+        T = idx.shape[1]
+        rows = jnp.take(kv_flat, idx, axis=0)            # [B, T, rank+dr]
+        c = rows[..., :rank].astype(jnp.float32)
+        k_pe = rows[..., rank:].astype(jnp.float32)
+        w_k, w_v = _split_wkv_b(lp, cfg)
+        # absorb the k expansion into the query: [B, H, rank]
+        q_lat = jnp.einsum("bhd,hrd->bhr", q_nope.astype(jnp.float32),
+                           w_k.astype(jnp.float32))
+        scores = (jnp.einsum("bhr,btr->bht", q_lat, c)
+                  + jnp.einsum("bhd,btd->bht",
+                               q_pe.astype(jnp.float32), k_pe)) * scale
+        mask = jnp.arange(T)[None, :] < seq_lens[:, None]
+        scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bht,btr->bhr", probs, c)       # [B, H, rank]
+        out = jnp.einsum("bhr,hrd->bhd", ctx,
+                         w_v.astype(jnp.float32))        # [B, H, dv]
+        return out.reshape(B, H * cfg.v_head_dim).astype(q_nope.dtype)
+
+    x = _embed(params, tokens, cfg)
+    x, kv_new = _run_layers(params, kv, x, positions, slots, cfg, attn)
+    return _logits(params, x, cfg), kv_new

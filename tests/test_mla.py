@@ -1,0 +1,209 @@
+"""MLA (deepseek_v2) model module: HF torch parity for prefill and the
+ABSORBED decode over the paged latent-KV cache, chunked-prefill
+equivalence, and the latent cache geometry.
+
+Commit-1 scope (ROUND4.md round-5 plan brought forward): the pure model
+module with the llama-compatible forward contract; engine/serving
+integration and the deepseek MoE variants follow. The family stays
+rejected in from_hf_config until the engine serves it.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.engine.models import mla
+from dynamo_tpu.engine.models.llama import ModelStatics
+
+BS = 8
+NUM_BLOCKS = 16
+
+
+def _cfg(q_lora: int = 0) -> ModelConfig:
+    return ModelConfig(
+        model_type="deepseek_v2", vocab_size=256, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=4,
+        head_dim=24,                     # qk dim (nope+rope) — scale base
+        max_position_embeddings=256, rms_norm_eps=1e-6,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        q_lora_rank=q_lora, kv_lora_rank=16, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16)
+
+
+def _statics(cfg):
+    return ModelStatics(cfg=cfg, block_size=BS, attn_impl="xla")
+
+
+def _to_hf(params, cfg):
+    """Our stacked params -> HF DeepseekV2 state dict (torch [out, in])."""
+    import torch
+
+    def t(a):
+        return torch.tensor(np.asarray(a, np.float32))
+
+    sd = {"model.embed_tokens.weight": t(params["embed"]),
+          "model.norm.weight": t(params["final_norm"]),
+          "lm_head.weight": t(params["lm_head"]).T.contiguous()}
+    per = {"ln1": "input_layernorm.weight",
+           "ln2": "post_attention_layernorm.weight",
+           "kv_norm": "self_attn.kv_a_layernorm.weight"}
+    mat = {"wq": "self_attn.q_proj.weight",
+           "wq_a": "self_attn.q_a_proj.weight",
+           "wq_b": "self_attn.q_b_proj.weight",
+           "wkv_a": "self_attn.kv_a_proj_with_mqa.weight",
+           "wkv_b": "self_attn.kv_b_proj.weight",
+           "wo": "self_attn.o_proj.weight",
+           "gate": "mlp.gate_proj.weight",
+           "up": "mlp.up_proj.weight",
+           "down": "mlp.down_proj.weight"}
+    if cfg.q_lora_rank > 0:
+        per["q_a_norm"] = "self_attn.q_a_layernorm.weight"
+    for i in range(cfg.num_layers):
+        for k, hf in per.items():
+            if f"layers.{k}" in params:
+                sd[f"model.layers.{i}.{hf}"] = t(params[f"layers.{k}"][i])
+        for k, hf in mat.items():
+            if f"layers.{k}" in params:
+                sd[f"model.layers.{i}.{hf}"] = t(
+                    params[f"layers.{k}"][i]).T.contiguous()
+    return sd
+
+
+@pytest.fixture(scope="module", params=[0, 12],
+                ids=["q_proj", "q_lora"])
+def mla_setup(request):
+    torch = pytest.importorskip("torch")
+    from transformers import DeepseekV2Config, DeepseekV2ForCausalLM
+    cfg = _cfg(q_lora=request.param)
+    params = mla.init_params(cfg, jax.random.PRNGKey(4), dtype=jnp.float32)
+    hf_cfg = DeepseekV2Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_heads,
+        q_lora_rank=cfg.q_lora_rank or None,
+        kv_lora_rank=cfg.kv_lora_rank,
+        qk_nope_head_dim=cfg.qk_nope_head_dim,
+        qk_rope_head_dim=cfg.qk_rope_head_dim,
+        v_head_dim=cfg.v_head_dim, head_dim=cfg.qk_rope_head_dim,
+        # all-dense: every layer below first_k_dense_replace uses the
+        # plain MLP — the MoE variants are out of this commit's scope
+        first_k_dense_replace=cfg.num_layers,
+        max_position_embeddings=cfg.max_position_embeddings,
+        rms_norm_eps=cfg.rms_norm_eps, rope_theta=cfg.rope_theta,
+        tie_word_embeddings=False, attention_bias=False,
+        attn_implementation="eager")
+    hf = DeepseekV2ForCausalLM(hf_cfg)
+    missing, unexpected = hf.load_state_dict(_to_hf(params, cfg),
+                                             strict=False)
+    assert not missing and not unexpected, (missing, unexpected)
+    hf.eval()
+    return cfg, params, hf
+
+
+def test_latent_cache_row_geometry():
+    cfg = _cfg()
+    kv = mla.init_kv_cache(cfg, NUM_BLOCKS, BS, dtype=jnp.float32)
+    assert set(kv) == {"kv"}
+    # per-token row = compressed latent + rope-k — NOT H*(qk+v); the
+    # serving win: 24 lanes here vs 4*(24+16)=160 for the expanded cache
+    assert kv["kv"].shape == (2, NUM_BLOCKS * BS,
+                              cfg.kv_lora_rank + cfg.qk_rope_head_dim)
+
+
+def test_mla_prefill_matches_hf(mla_setup):
+    import torch
+    cfg, params, hf = mla_setup
+    rng = np.random.default_rng(9)
+    tokens = rng.integers(1, cfg.vocab_size, size=21).tolist()
+    with torch.no_grad():
+        ref = hf(torch.tensor([tokens])).logits[0, -1].numpy()
+    kv = mla.init_kv_cache(cfg, NUM_BLOCKS, BS, dtype=jnp.float32)
+    T = 32
+    padded = np.zeros((T,), np.int32)
+    padded[:len(tokens)] = tokens
+    table = np.zeros((NUM_BLOCKS,), np.int32)
+    table[:T // BS] = np.arange(1, 1 + T // BS)
+    logits, kv = mla.prefill_forward(
+        params, kv, jnp.asarray(padded), jnp.asarray(table),
+        jnp.asarray(0, jnp.int32), jnp.asarray(len(tokens), jnp.int32),
+        _statics(cfg))
+    np.testing.assert_allclose(np.asarray(logits), ref,
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_mla_decode_matches_hf_teacher_forced(mla_setup):
+    """The ABSORBED decode (latent-row reads only) must equal HF's
+    expanded-cache attention step for step."""
+    import torch
+    cfg, params, hf = mla_setup
+    rng = np.random.default_rng(10)
+    tokens = rng.integers(1, cfg.vocab_size, size=12).tolist()
+    steps = 6
+    with torch.no_grad():
+        ref_all = hf(torch.tensor(
+            [tokens + [5] * steps])).logits[0].numpy()
+    kv = mla.init_kv_cache(cfg, NUM_BLOCKS, BS, dtype=jnp.float32)
+    T = 32
+    padded = np.zeros((T,), np.int32)
+    padded[:len(tokens)] = tokens
+    table = np.zeros((NUM_BLOCKS,), np.int32)
+    table[:T // BS] = np.arange(1, 1 + T // BS)
+    _lg, kv = mla.prefill_forward(
+        params, kv, jnp.asarray(padded), jnp.asarray(table),
+        jnp.asarray(0, jnp.int32), jnp.asarray(len(tokens), jnp.int32),
+        _statics(cfg))
+    tables = table[None, :T // BS]
+    for s in range(steps):
+        pos = jnp.asarray([len(tokens) + s], jnp.int32)
+        lg, kv = mla.decode_forward(
+            params, kv, jnp.asarray([5], jnp.int32), pos,
+            jnp.asarray(tables), _statics(cfg))
+        np.testing.assert_allclose(
+            np.asarray(lg[0]), ref_all[len(tokens) + s],
+            rtol=4e-4, atol=4e-4, err_msg=f"decode step {s}")
+
+
+def test_mla_chunked_prefill_matches_whole():
+    """Two prefill chunks through the latent pool == one whole-prompt
+    prefill (the start_pos > 0 path that chunked prefill and prefix
+    reuse share)."""
+    cfg = _cfg()
+    params = mla.init_params(cfg, jax.random.PRNGKey(6),
+                             dtype=jnp.float32)
+    rng = np.random.default_rng(11)
+    tokens = rng.integers(1, cfg.vocab_size, size=24).tolist()
+    table = np.zeros((NUM_BLOCKS,), np.int32)
+    table[:4] = np.arange(1, 5)
+
+    kv1 = mla.init_kv_cache(cfg, NUM_BLOCKS, BS, dtype=jnp.float32)
+    T = 32
+    padded = np.zeros((T,), np.int32)
+    padded[:24] = tokens
+    want, kv1 = mla.prefill_forward(
+        params, kv1, jnp.asarray(padded), jnp.asarray(table),
+        jnp.asarray(0, jnp.int32), jnp.asarray(24, jnp.int32),
+        _statics(cfg))
+
+    kv2 = mla.init_kv_cache(cfg, NUM_BLOCKS, BS, dtype=jnp.float32)
+    c1 = np.zeros((16,), np.int32)
+    c1[:16] = tokens[:16]
+    _g, kv2 = mla.prefill_forward(
+        params, kv2, jnp.asarray(c1), jnp.asarray(table),
+        jnp.asarray(0, jnp.int32), jnp.asarray(16, jnp.int32),
+        _statics(cfg))
+    c2 = np.zeros((16,), np.int32)
+    c2[:8] = tokens[16:]
+    got, kv2 = mla.prefill_forward(
+        params, kv2, jnp.asarray(c2), jnp.asarray(table),
+        jnp.asarray(16, jnp.int32), jnp.asarray(8, jnp.int32),
+        _statics(cfg))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(kv2["kv"]),
+                               np.asarray(kv1["kv"]),
+                               rtol=2e-5, atol=2e-5)
