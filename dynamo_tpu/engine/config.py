@@ -16,13 +16,23 @@ from typing import Any, Dict, List, Optional
 
 @dataclasses.dataclass
 class RopeScaling:
-    """Llama-3 style rope scaling (config.json `rope_scaling`)."""
+    """Rope scaling (config.json `rope_scaling`): llama3-style fields
+    plus the yarn fields deepseek checkpoints carry (models/mla.py
+    rope_params)."""
 
     rope_type: str = "default"
     factor: float = 1.0
     low_freq_factor: float = 1.0
     high_freq_factor: float = 4.0
     original_max_position_embeddings: int = 8192
+    # yarn (deepseek_v2): 0.0 = absent (HF infers attention scaling
+    # from `factor` alone then). attention_factor, when set, OVERRIDES
+    # the mscale inference (HF priority order).
+    mscale: float = 0.0
+    mscale_all_dim: float = 0.0
+    beta_fast: float = 32.0
+    beta_slow: float = 1.0
+    attention_factor: float = 0.0
 
 
 @dataclasses.dataclass
@@ -157,6 +167,13 @@ class ModelConfig:
                 high_freq_factor=float(raw_rs.get("high_freq_factor", 4.0)),
                 original_max_position_embeddings=int(
                     raw_rs.get("original_max_position_embeddings", 8192)),
+                mscale=float(raw_rs.get("mscale", 0.0) or 0.0),
+                mscale_all_dim=float(raw_rs.get("mscale_all_dim", 0.0)
+                                     or 0.0),
+                beta_fast=float(raw_rs.get("beta_fast", 32) or 32),
+                beta_slow=float(raw_rs.get("beta_slow", 1) or 1),
+                attention_factor=float(
+                    raw_rs.get("attention_factor", 0.0) or 0.0),
             )
         return cls(
             model_type=cfg.get("model_type", "llama"),

@@ -23,13 +23,13 @@ Conventions pinned against HF ``DeepseekV2Attention`` (transformers
 - q path: plain ``q_proj`` when q_lora_rank == 0 (the -Lite layout),
   else ``q_a → rmsnorm → q_b``.
 
-Scope: dense MLP layers, default rope. Pending before the family can
-serve (config.from_hf_config keeps rejecting deepseek_v2/v3 until ALL
-land): yarn rope scaling + its mscale attention-scale factor (every
-released DeepSeek-V2 checkpoint uses it — parity here is tested with
-rope_scaling=None only), the deepseek MoE variants (shared experts
-additive, first_k_dense hybrid sparsity, v3 sigmoid-grouped routing),
-and the engine/core.py model dispatch.
+Scope: dense MLP layers; default AND yarn rope (the released-V2
+scaling, incl. the inferred mscale attention factor — parity-tested
+against HF with yarn configured). Pending before the family can serve
+(config.from_hf_config keeps rejecting deepseek_v2/v3 until ALL land):
+the deepseek MoE variants (shared experts additive, first_k_dense
+hybrid sparsity, v3 sigmoid-grouped routing) and the engine/core.py
+model dispatch.
 """
 
 from __future__ import annotations
@@ -56,20 +56,71 @@ NEG_INF = -1e30
 # ---------------------------------------------------------------------------
 
 
-def rope_inv_freq(cfg: ModelConfig) -> np.ndarray:
+def rope_params(cfg: ModelConfig):
+    """(inv_freq [d/2], attention_scaling) — default rope, or yarn
+    (deepseek checkpoints): mirrors HF _compute_yarn_parameters
+    (modeling_rope_utils.py:246-365) — NTK interpolation/extrapolation
+    blend over a linear ramp between the beta_fast/beta_slow correction
+    dims, and the inferred attention factor that multiplies cos/sin
+    (mscale; = 1.0 when mscale == mscale_all_dim, the released-V2
+    setting)."""
+    import math
     d = cfg.qk_rope_head_dim
-    return (1.0 / (cfg.rope_theta
-                   ** (np.arange(0, d, 2, dtype=np.float64) / d))
-            ).astype(np.float32)
+    base = cfg.rope_theta
+    pos_freqs = base ** (np.arange(0, d, 2, dtype=np.float64) / d)
+    inv = 1.0 / pos_freqs
+    rs = cfg.rope_scaling
+    if rs is None:
+        return inv.astype(np.float32), 1.0
+    if rs.rope_type != "yarn":
+        # loud-rejection convention (config.py phi3 longrope): serving a
+        # linear/llama3/longrope deepseek checkpoint with unscaled
+        # positions would decode garbage past the original context
+        raise ValueError(
+            f"MLA rope_scaling type {rs.rope_type!r} is not implemented "
+            f"(yarn is; remove rope_scaling for base-context models)")
+    factor = rs.factor
+
+    def get_mscale(scale, m=1.0):
+        if scale <= 1:
+            return 1.0
+        return 0.1 * m * math.log(scale) + 1.0
+
+    if rs.attention_factor:
+        # HF priority: an explicit attention_factor overrides inference
+        att = rs.attention_factor
+    elif rs.mscale and rs.mscale_all_dim:
+        att = get_mscale(factor, rs.mscale) / get_mscale(
+            factor, rs.mscale_all_dim)
+    else:
+        att = get_mscale(factor)
+    interp = 1.0 / (factor * pos_freqs)
+
+    def corr_dim(num_rot):
+        return (d * math.log(rs.original_max_position_embeddings
+                             / (num_rot * 2 * math.pi))
+                ) / (2 * math.log(base))
+
+    low = max(math.floor(corr_dim(rs.beta_fast)), 0)
+    high = min(math.ceil(corr_dim(rs.beta_slow)), d - 1)
+    if low == high:
+        high += 0.001                    # HF's singularity guard
+    ramp = np.clip((np.arange(d // 2, dtype=np.float64) - low)
+                   / (high - low), 0, 1)
+    extrap = 1.0 - ramp
+    inv_freq = interp * (1 - extrap) + inv * extrap
+    return inv_freq.astype(np.float32), float(att)
 
 
 def apply_rope_interleaved(x: jax.Array, positions: jax.Array,
-                           inv_freq: jax.Array) -> jax.Array:
+                           inv_freq: jax.Array,
+                           scaling: float = 1.0) -> jax.Array:
     """x [..., T, d] with the pair (2i, 2i+1) rotated by pos·inv_freq[i]
-    (torch.view_as_complex pairing). positions: [T]."""
+    (torch.view_as_complex pairing). positions: [T]. ``scaling``
+    multiplies cos/sin (yarn attention factor — HF scales freqs_cis)."""
     ang = positions[:, None].astype(jnp.float32) * inv_freq[None, :]
-    cos = jnp.cos(ang)                                  # [T, d/2]
-    sin = jnp.sin(ang)
+    cos = jnp.cos(ang) * scaling                        # [T, d/2]
+    sin = jnp.sin(ang) * scaling
     shape = x.shape
     xp = x.astype(jnp.float32).reshape(shape[:-1] + (shape[-1] // 2, 2))
     # broadcast the [T, d/2] angles over any middle axes (q_pe carries a
@@ -158,8 +209,8 @@ def _latent_rows(lp, hn, positions, cfg: ModelConfig):
     ckv = mm(hn, lp["wkv_a"])
     c, k_pe = ckv[..., :cfg.kv_lora_rank], ckv[..., cfg.kv_lora_rank:]
     c = rms_norm(c, lp["kv_norm"], cfg.rms_norm_eps)
-    inv = jnp.asarray(rope_inv_freq(cfg))
-    k_pe = apply_rope_interleaved(k_pe, positions, inv)
+    inv, att = rope_params(cfg)
+    k_pe = apply_rope_interleaved(k_pe, positions, jnp.asarray(inv), att)
     return jnp.concatenate([c, k_pe], axis=-1)
 
 
@@ -170,14 +221,15 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
     L = cfg.num_layers
     layer_params = _layer_stack(params)
     NTOK = kv["kv"].shape[1]
-    inv = jnp.asarray(rope_inv_freq(cfg))
+    inv_np, att = rope_params(cfg)
+    inv = jnp.asarray(inv_np)
 
     def layer(carry, xs):
         h, pool = carry
         lp, li = xs["lp"], xs["i"]
         hn = rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
         q_nope, q_pe = _q_proj(lp, hn, cfg)
-        q_pe = apply_rope_interleaved(q_pe, positions, inv)
+        q_pe = apply_rope_interleaved(q_pe, positions, inv, att)
         rows = _latent_rows(lp, hn, positions, cfg)
         pool = pool.at[li, slots, :].set(rows.astype(pool.dtype),
                                          mode="drop")

@@ -207,3 +207,78 @@ def test_mla_chunked_prefill_matches_whole():
     np.testing.assert_allclose(np.asarray(kv2["kv"]),
                                np.asarray(kv1["kv"]),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("mscale,mscale_all", [(0.707, 0.707), (1.0, 0.5)],
+                         ids=["v2-style", "att!=1"])
+def test_mla_yarn_rope_matches_hf(mscale, mscale_all):
+    """yarn rope scaling (every released DeepSeek-V2 checkpoint): the
+    NTK frequency blend AND the inferred attention factor must match HF
+    — v2's mscale == mscale_all_dim gives factor 1.0, the second case
+    forces a non-unit cos/sin scaling so the wiring can't be skipped."""
+    torch = pytest.importorskip("torch")
+    from transformers import DeepseekV2Config, DeepseekV2ForCausalLM
+
+    from dynamo_tpu.engine.config import RopeScaling
+    cfg = _cfg()
+    rs = {"rope_type": "yarn", "factor": 4.0, "mscale": mscale,
+          "mscale_all_dim": mscale_all, "beta_fast": 32, "beta_slow": 1,
+          "original_max_position_embeddings": 64}
+    cfg.rope_scaling = RopeScaling(
+        rope_type="yarn", factor=4.0, mscale=mscale,
+        mscale_all_dim=mscale_all, beta_fast=32, beta_slow=1,
+        original_max_position_embeddings=64)
+    params = mla.init_params(cfg, jax.random.PRNGKey(8), dtype=jnp.float32)
+    hf_cfg = DeepseekV2Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_heads,
+        q_lora_rank=None, kv_lora_rank=cfg.kv_lora_rank,
+        qk_nope_head_dim=cfg.qk_nope_head_dim,
+        qk_rope_head_dim=cfg.qk_rope_head_dim,
+        v_head_dim=cfg.v_head_dim, head_dim=cfg.qk_rope_head_dim,
+        first_k_dense_replace=cfg.num_layers,
+        max_position_embeddings=cfg.max_position_embeddings,
+        rms_norm_eps=cfg.rms_norm_eps, rope_theta=cfg.rope_theta,
+        rope_scaling=rs, tie_word_embeddings=False,
+        attention_bias=False, attn_implementation="eager")
+    hf = DeepseekV2ForCausalLM(hf_cfg)
+    missing, unexpected = hf.load_state_dict(_to_hf(params, cfg),
+                                             strict=False)
+    assert not missing and not unexpected
+    hf.eval()
+
+    rng = np.random.default_rng(12)
+    tokens = rng.integers(1, cfg.vocab_size, size=90).tolist()
+    with torch.no_grad():
+        ref = hf(torch.tensor([tokens])).logits[0, -1].numpy()
+    kv = mla.init_kv_cache(cfg, NUM_BLOCKS, BS, dtype=jnp.float32)
+    T = 96                 # > original_max 64: the extrapolated regime
+    padded = np.zeros((T,), np.int32)
+    padded[:len(tokens)] = tokens
+    table = np.zeros((NUM_BLOCKS,), np.int32)
+    table[:T // BS] = np.arange(1, 1 + T // BS)
+    logits, _kv = mla.prefill_forward(
+        params, kv, jnp.asarray(padded), jnp.asarray(table),
+        jnp.asarray(0, jnp.int32), jnp.asarray(len(tokens), jnp.int32),
+        _statics(cfg))
+    np.testing.assert_allclose(np.asarray(logits), ref,
+                               rtol=4e-4, atol=4e-4)
+
+
+def test_mla_rope_params_edges():
+    """attention_factor overrides the mscale inference (HF priority),
+    and non-yarn scaling types reject loudly instead of serving
+    unscaled positions."""
+    from dynamo_tpu.engine.config import RopeScaling
+    cfg = _cfg()
+    cfg.rope_scaling = RopeScaling(
+        rope_type="yarn", factor=4.0, mscale=0.5, mscale_all_dim=1.0,
+        original_max_position_embeddings=64, attention_factor=1.25)
+    _inv, att = mla.rope_params(cfg)
+    assert att == 1.25
+    cfg.rope_scaling = RopeScaling(rope_type="linear", factor=4.0)
+    with pytest.raises(ValueError, match="not implemented"):
+        mla.rope_params(cfg)
