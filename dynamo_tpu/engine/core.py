@@ -119,6 +119,30 @@ class EngineCore:
         self.model_cfg = model_cfg
         self.cfg = engine_cfg
         self.mesh = mesh
+        # model-family dispatch: MLA (deepseek-class latent-KV attention)
+        # vs the llama family. The MLA integration is single-chip,
+        # full-precision first — each unsupported combination refuses
+        # loudly below rather than serving garbage.
+        self.is_mla = model_cfg.kv_lora_rank > 0
+        if self.is_mla:
+            from .models import mla
+            self.model_mod = mla
+            if mesh is not None:
+                raise NotImplementedError(
+                    "MLA + mesh sharding is not integrated yet "
+                    "(models/mla.py has no param pspecs or sp prefill)")
+            if engine_cfg.kv_quantization != "none":
+                raise NotImplementedError(
+                    "MLA + kv_quantization is not integrated yet (the "
+                    "latent rows carry no in-row scale encoding)")
+            if engine_cfg.quantization != "none":
+                raise NotImplementedError(
+                    "MLA + weight quantization is not integrated yet")
+            if engine_cfg.host_kv_blocks > 0:
+                raise NotImplementedError(
+                    "MLA + the host KV tier is not integrated yet")
+        else:
+            self.model_mod = llama
         if (model_cfg.sliding_window is not None
                 and engine_cfg.max_model_len <= model_cfg.sliding_window):
             # the window can never bind at this serving length: drop it so
@@ -147,7 +171,7 @@ class EngineCore:
                 model_cfg, jax.random.PRNGKey(engine_cfg.seed),
                 dtype=param_dtype, include_embed=qembed, bits=qbits)
         elif params is None:
-            params = llama.init_params(
+            params = self.model_mod.init_params(
                 model_cfg, jax.random.PRNGKey(engine_cfg.seed), dtype=param_dtype)
         elif quantized:
             from .quant import quantize_params
@@ -168,10 +192,16 @@ class EngineCore:
                     f"({model_cfg.num_kv_heads}) — each tp shard must "
                     f"own whole heads to carry its own in-row scale "
                     f"group")
-        self.kv = llama.init_kv_cache(
-            model_cfg, engine_cfg.num_kv_blocks, engine_cfg.kv_block_size,
-            dtype=param_dtype, quantization=engine_cfg.kv_quantization,
-            kv_shards=kv_shards)
+        if self.is_mla:
+            self.kv = self.model_mod.init_kv_cache(
+                model_cfg, engine_cfg.num_kv_blocks,
+                engine_cfg.kv_block_size, dtype=param_dtype)
+        else:
+            self.kv = llama.init_kv_cache(
+                model_cfg, engine_cfg.num_kv_blocks,
+                engine_cfg.kv_block_size, dtype=param_dtype,
+                quantization=engine_cfg.kv_quantization,
+                kv_shards=kv_shards)
         if mesh is not None:
             # place params/KV under the tp/sp layout; every jitted step then
             # runs SPMD over the mesh with XLA-inserted ICI collectives
@@ -281,7 +311,7 @@ class EngineCore:
         def prefill(params, kv, tokens, block_table, start_pos, true_len,
                     key, temperature, top_k, top_p):
             params = unpack_params(params)
-            logits, kv = llama.prefill_forward(
+            logits, kv = self.model_mod.prefill_forward(
                 params, kv, tokens, block_table, start_pos, true_len, statics)
             tok, logprob = sample_tokens(
                 logits[None, :], key[None], temperature[None], top_k[None],
@@ -293,7 +323,7 @@ class EngineCore:
         def decode(params, kv, tokens, positions, block_tables,
                    keys, temperature, top_k, top_p):
             params = unpack_params(params)
-            logits, kv = llama.decode_forward(
+            logits, kv = self.model_mod.decode_forward(
                 params, kv, tokens, positions, block_tables, statics)
             toks, logprobs = sample_tokens(logits, keys, temperature,
                                            top_k, top_p)
@@ -320,7 +350,7 @@ class EngineCore:
                 kv, toks, pos = carry
                 keys = make_slot_keys(seed, seeds, steps0 + xs["k"])
                 tok_in = jnp.where(xs["pm"], xs["pt"], toks)
-                logits, kv = llama.decode_forward(
+                logits, kv = self.model_mod.decode_forward(
                     params, kv, tok_in, pos, block_tables, statics)
                 toks2, logprobs = sample_tokens(logits, keys, temperature,
                                                 top_k, top_p)
@@ -401,12 +431,12 @@ class EngineCore:
     @property
     def wire_kv_heads(self) -> int:
         """Head count for the head-major KV wire format (block_copy
-        to/from_wire_format): int8 pools ship whole rows — values plus
-        in-row scale lanes — as ONE opaque "head", so handoff/offload
-        round trips are bit-exact with no requantization; full-precision
-        pools use the real KV head count (which the dst-tp>src-tp
-        reshard slices per rank)."""
-        return (1 if self.cfg.kv_quantization != "none"
+        to/from_wire_format): int8 pools and MLA latent pools ship whole
+        rows as ONE opaque "head" (in-row scales / latent+rope lanes
+        have no head structure to split), so handoff/offload round trips
+        are bit-exact; full-precision llama pools use the real KV head
+        count (which the dst-tp>src-tp reshard slices per rank)."""
+        return (1 if self.cfg.kv_quantization != "none" or self.is_mla
                 else self.model_cfg.num_kv_heads)
 
     def _check_kv_payload_layout(self, lanes: int, dtype,
@@ -416,7 +446,8 @@ class EngineCore:
         width also encodes the prefill engine's tp) and same dtype.
         Mismatches fail loudly — a scale-aware repack of int8 rows
         across kv_quantization or tp settings is not supported."""
-        pool = self.kv["k"]
+        pool = next(iter(self.kv.values()))   # key-agnostic: llama
+        # pools are {"k","v"}, MLA latent pools are {"kv"}
         if lanes != pool.shape[-1] or np.dtype(dtype) != pool.dtype:
             raise ValueError(
                 f"disagg {kind} KV payload layout mismatch: payload rows "

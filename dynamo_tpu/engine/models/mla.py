@@ -25,11 +25,12 @@ Conventions pinned against HF ``DeepseekV2Attention`` (transformers
 
 Scope: dense MLP layers; default AND yarn rope (the released-V2
 scaling, incl. the inferred mscale attention factor — parity-tested
-against HF with yarn configured). Pending before the family can serve
-(config.from_hf_config keeps rejecting deepseek_v2/v3 until ALL land):
-the deepseek MoE variants (shared experts additive, first_k_dense
-hybrid sparsity, v3 sigmoid-grouped routing) and the engine/core.py
-model dispatch.
+against HF with yarn configured); EngineCore serves MLA end-to-end
+through the model dispatch (core.is_mla — single-chip, full-precision;
+mesh/quantization/host-tier combinations refuse loudly). Pending before
+config.from_hf_config accepts deepseek_v2/v3 checkpoints: the deepseek
+MoE variants (shared experts additive, first_k_dense hybrid sparsity,
+v3 sigmoid-grouped routing) and the checkpoint loader map.
 """
 
 from __future__ import annotations
