@@ -75,6 +75,15 @@ class ModelConfig:
     qk_nope_head_dim: int = 0
     qk_rope_head_dim: int = 0
     v_head_dim: int = 0
+    # deepseek MoE deltas (models/mla.py): the first k layers are DENSE
+    # with their own intermediate size; routed weights scale by
+    # routed_scaling; group-limited routing masks scores to the
+    # topk_group best of n_group expert groups before the top-k
+    first_k_dense: int = 0
+    dense_intermediate_size: int = 0
+    routed_scaling: float = 1.0
+    n_group: int = 0
+    topk_group: int = 0
     # gemma-family deltas (model_type gemma/gemma2): gelu MLP, scaled
     # embeddings, (1+w) RMSNorm, post-block norms, logit soft-capping
     hidden_act: str = "silu"          # silu | gelu_pytorch_tanh

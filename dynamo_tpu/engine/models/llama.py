@@ -103,6 +103,24 @@ def swiglu(x: jax.Array, gate_w: jax.Array, up_w: jax.Array,
     return mm(gated * mm(x, up_w), down_w)
 
 
+def run_experts_dense(x: jax.Array, gate_w: jax.Array, up_w: jax.Array,
+                      down_w: jax.Array, top_idx: jax.Array,
+                      top_w: jax.Array) -> jax.Array:
+    """Dense-over-E expert execution + one-hot combine — the ONE home of
+    the expert einsum layout (E stays a batched/contracted axis so the
+    mesh "ep" sharding turns the combine into an XLA psum; see moe_mlp's
+    rationale). Shared by moe_mlp and mla._moe_mlp so their layouts
+    cannot diverge."""
+    E = gate_w.shape[0]
+    combine = jnp.sum(
+        jax.nn.one_hot(top_idx, E, dtype=jnp.float32)
+        * top_w[..., None], axis=1)                              # [N, E]
+    g = qeinsum("nd,edf->enf", x, gate_w)
+    u = qeinsum("nd,edf->enf", x, up_w)
+    y = qeinsum("enf,efd->end", jax.nn.silu(g) * u, down_w)      # [E, N, D]
+    return jnp.einsum("ne,end->nd", combine.astype(y.dtype), y)
+
+
 def moe_mlp(x: jax.Array, router_w: jax.Array, gate_w: jax.Array,
             up_w: jax.Array, down_w: jax.Array, top_k: int,
             norm_topk: bool = True,
@@ -134,13 +152,7 @@ def moe_mlp(x: jax.Array, router_w: jax.Array, gate_w: jax.Array,
     else:
         probs = jax.nn.softmax(logits, axis=-1)
         top_w, top_idx = jax.lax.top_k(probs, top_k)
-    combine = jnp.sum(
-        jax.nn.one_hot(top_idx, E, dtype=jnp.float32)
-        * top_w[..., None], axis=1)                              # [N, E]
-    g = qeinsum("nd,edf->enf", x, gate_w)
-    u = qeinsum("nd,edf->enf", x, up_w)
-    y = qeinsum("enf,efd->end", jax.nn.silu(g) * u, down_w)      # [E, N, D]
-    out = jnp.einsum("ne,end->nd", combine.astype(y.dtype), y)
+    out = run_experts_dense(x, gate_w, up_w, down_w, top_idx, top_w)
     if shared is not None:
         sh_gate, sh_up, sh_down, sh_router = shared
         s = swiglu(x, sh_gate, sh_up, sh_down, "silu")

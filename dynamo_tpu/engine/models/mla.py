@@ -23,14 +23,15 @@ Conventions pinned against HF ``DeepseekV2Attention`` (transformers
 - q path: plain ``q_proj`` when q_lora_rank == 0 (the -Lite layout),
   else ``q_a → rmsnorm → q_b``.
 
-Scope: dense MLP layers; default AND yarn rope (the released-V2
-scaling, incl. the inferred mscale attention factor — parity-tested
-against HF with yarn configured); EngineCore serves MLA end-to-end
-through the model dispatch (core.is_mla — single-chip, full-precision;
-mesh/quantization/host-tier combinations refuse loudly). Pending before
-config.from_hf_config accepts deepseek_v2/v3 checkpoints: the deepseek
-MoE variants (shared experts additive, first_k_dense hybrid sparsity,
-v3 sigmoid-grouped routing) and the checkpoint loader map.
+Scope: dense MLP layers AND the deepseek MoE block (additive shared
+experts, first_k_dense hybrid sparsity via split scans, greedy +
+group-limited-greedy routing with routed_scaling — all HF-parity
+tested); default AND yarn rope (incl. the inferred mscale attention
+factor); EngineCore serves MLA end-to-end through the model dispatch
+(core.is_mla — single-chip, full-precision; mesh/quantization/host-tier
+combinations refuse loudly). Pending before config.from_hf_config
+accepts deepseek checkpoints: the config-key parse + checkpoint loader
+map, and v3's sigmoid-scored noaux routing.
 """
 
 from __future__ import annotations
@@ -44,7 +45,8 @@ import numpy as np
 from ..config import ModelConfig
 from ..quant import mm
 from .llama import (ModelStatics, _embed, _layer_stack, _logits,
-                    flat_token_indices, rms_norm, swiglu)
+                    flat_token_indices, rms_norm, run_experts_dense,
+                    swiglu)
 
 Params = Dict[str, jax.Array]
 KVCache = Dict[str, jax.Array]   # {"kv": [L, NTOK, rank + rope]}
@@ -152,10 +154,40 @@ def param_shapes(cfg: ModelConfig) -> Dict[str, Tuple[int, ...]]:
         "layers.wkv_b": (L, cfg.kv_lora_rank,
                          H * (cfg.qk_nope_head_dim + cfg.v_head_dim)),
         "layers.wo": (L, H * cfg.v_head_dim, D),
-        "layers.gate": (L, D, cfg.intermediate_size),
-        "layers.up": (L, D, cfg.intermediate_size),
-        "layers.down": (L, cfg.intermediate_size, D),
     }
+    if cfg.num_experts > 0:
+        # deepseek hybrid: the first k layers are DENSE (their own
+        # intermediate size), the rest are MoE with additive shared
+        # experts — two parameter stacks, two scans (_run_layers)
+        k = cfg.first_k_dense
+        Lm = L - k
+        E, F = cfg.num_experts, cfg.intermediate_size
+        if k > 0:
+            Fd = cfg.dense_intermediate_size or F
+            shapes.update({
+                "layers.dense_gate": (k, D, Fd),
+                "layers.dense_up": (k, D, Fd),
+                "layers.dense_down": (k, Fd, D),
+            })
+        shapes.update({
+            "layers.router": (Lm, D, E),
+            "layers.moe_gate": (Lm, E, D, F),
+            "layers.moe_up": (Lm, E, D, F),
+            "layers.moe_down": (Lm, E, F, D),
+        })
+        if cfg.shared_expert_size > 0:
+            Fs = cfg.shared_expert_size
+            shapes.update({
+                "layers.sh_gate": (Lm, D, Fs),
+                "layers.sh_up": (Lm, D, Fs),
+                "layers.sh_down": (Lm, Fs, D),
+            })
+    else:
+        shapes.update({
+            "layers.gate": (L, D, cfg.intermediate_size),
+            "layers.up": (L, D, cfg.intermediate_size),
+            "layers.down": (L, cfg.intermediate_size, D),
+        })
     if cfg.q_lora_rank > 0:
         shapes.update({
             "layers.wq_a": (L, D, cfg.q_lora_rank),
@@ -215,36 +247,108 @@ def _latent_rows(lp, hn, positions, cfg: ModelConfig):
     return jnp.concatenate([c, k_pe], axis=-1)
 
 
+def _moe_mlp(hn, lp, cfg: ModelConfig) -> jax.Array:
+    """deepseek routing (HF DeepseekV2MoEGate + MoE, verified by the
+    parity tests): f32 softmax over ALL experts, greedy (or
+    group-limited greedy) top-k of the SCORES — renormalized over the
+    selection only when moe_norm_topk (deepseek norm_topk_prob) is set —
+    then scaled by routed_scaling; shared experts are a plain additive
+    swiglu. Experts run dense-over-E (llama.run_experts_dense)."""
+    N, E = hn.shape[0], cfg.num_experts
+    logits = (hn.astype(jnp.float32)
+              @ lp["router"].astype(jnp.float32))          # [N, E]
+    scores = jax.nn.softmax(logits, axis=-1)
+    if cfg.n_group > 1:
+        # group-limited greedy (DeepSeek-V2/-Chat): keep only the
+        # topk_group groups with the best per-group max score
+        g = cfg.n_group
+        gmax = scores.reshape(N, g, E // g).max(axis=-1)   # [N, g]
+        _w, gidx = jax.lax.top_k(gmax, cfg.topk_group)
+        gmask = jnp.sum(jax.nn.one_hot(gidx, g, dtype=scores.dtype),
+                        axis=1)                            # [N, g]
+        scores = (scores.reshape(N, g, E // g)
+                  * gmask[..., None]).reshape(N, E)
+    top_w, top_idx = jax.lax.top_k(scores, cfg.num_experts_per_tok)
+    if cfg.moe_norm_topk:
+        # deepseek's norm_topk_prob=true variant (weights renormalize
+        # over the selected experts; v2 released configs use False)
+        top_w = top_w / jnp.maximum(
+            jnp.sum(top_w, axis=-1, keepdims=True), 1e-20)
+    top_w = top_w * cfg.routed_scaling
+    out = run_experts_dense(hn, lp["moe_gate"], lp["moe_up"],
+                            lp["moe_down"], top_idx, top_w)
+    if cfg.shared_expert_size > 0:
+        out = out + swiglu(hn, lp["sh_gate"], lp["sh_up"],
+                           lp["sh_down"], cfg.hidden_act)
+    return out
+
+
 def _run_layers(params: Params, kv: KVCache, x: jax.Array,
                 positions: jax.Array, slots: jax.Array, cfg: ModelConfig,
                 attn_fn) -> Tuple[jax.Array, KVCache]:
-    """attn_fn(q_nope, q_pe, rows_new, kv_flat, lp, li) -> [N, H*v]."""
+    """attn_fn(q_nope, q_pe, rows_new, kv_flat, lp, li) -> [N, H*v].
+
+    deepseek hybrid sparsity (first_k_dense): the layer stacks split
+    into a dense prefix and a MoE suffix, each its own lax.scan with the
+    SAME attention body — the latent pool carries across both, with li
+    addressing rows globally."""
     L = cfg.num_layers
-    layer_params = _layer_stack(params)
+    stack = _layer_stack(params)
     NTOK = kv["kv"].shape[1]
     inv_np, att = rope_params(cfg)
     inv = jnp.asarray(inv_np)
 
-    def layer(carry, xs):
-        h, pool = carry
-        lp, li = xs["lp"], xs["i"]
-        hn = rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
-        q_nope, q_pe = _q_proj(lp, hn, cfg)
-        q_pe = apply_rope_interleaved(q_pe, positions, inv, att)
-        rows = _latent_rows(lp, hn, positions, cfg)
-        pool = pool.at[li, slots, :].set(rows.astype(pool.dtype),
-                                         mode="drop")
-        attn = attn_fn(q_nope, q_pe, rows,
-                       pool.reshape(L * NTOK, pool.shape[2]), lp, li)
-        h = h + mm(attn, lp["wo"])
-        hn2 = rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
-        h = h + swiglu(hn2, lp["gate"], lp["up"], lp["down"],
-                       cfg.hidden_act)
-        return (h, pool), None
+    _ATTN = ("ln1", "ln2", "wq", "wq_a", "q_a_norm", "wq_b", "wkv_a",
+             "kv_norm", "wkv_b", "wo")
 
-    (x, pool), _ = jax.lax.scan(
-        layer, (x, kv["kv"]),
-        {"lp": layer_params, "i": jnp.arange(L, dtype=jnp.int32)})
+    def make_layer(mlp_fn):
+        def layer(carry, xs):
+            h, pool = carry
+            lp, li = xs["lp"], xs["i"]
+            hn = rms_norm(h, lp["ln1"], cfg.rms_norm_eps)
+            q_nope, q_pe = _q_proj(lp, hn, cfg)
+            q_pe = apply_rope_interleaved(q_pe, positions, inv, att)
+            rows = _latent_rows(lp, hn, positions, cfg)
+            pool = pool.at[li, slots, :].set(rows.astype(pool.dtype),
+                                             mode="drop")
+            attn = attn_fn(q_nope, q_pe, rows,
+                           pool.reshape(L * NTOK, pool.shape[2]), lp, li)
+            h = h + mm(attn, lp["wo"])
+            hn2 = rms_norm(h, lp["ln2"], cfg.rms_norm_eps)
+            h = h + mlp_fn(hn2, lp)
+            return (h, pool), None
+        return layer
+
+    pool = kv["kv"]
+    if cfg.num_experts > 0:
+        k = cfg.first_k_dense
+        if k > 0:
+            dense_lp = {n: stack[n][:k] for n in _ATTN if n in stack}
+            dense_lp.update({"gate": stack["dense_gate"],
+                             "up": stack["dense_up"],
+                             "down": stack["dense_down"]})
+            (x, pool), _ = jax.lax.scan(
+                make_layer(lambda hn, lp: swiglu(
+                    hn, lp["gate"], lp["up"], lp["down"], cfg.hidden_act)),
+                (x, pool),
+                {"lp": dense_lp, "i": jnp.arange(k, dtype=jnp.int32)})
+        moe_lp = {n: stack[n][k:] for n in _ATTN if n in stack}
+        for n in ("router", "moe_gate", "moe_up", "moe_down",
+                  "sh_gate", "sh_up", "sh_down"):
+            if n in stack:
+                moe_lp[n] = stack[n]
+        (x, pool), _ = jax.lax.scan(
+            make_layer(lambda hn, lp: _moe_mlp(hn, lp, cfg)),
+            (x, pool),
+            {"lp": moe_lp, "i": jnp.arange(k, L, dtype=jnp.int32)})
+    else:
+        (x, pool), _ = jax.lax.scan(
+            make_layer(lambda hn, lp: swiglu(
+                hn, lp["gate"], lp["up"], lp["down"], cfg.hidden_act)),
+            (x, pool),
+            {"lp": {k: v for k, v in stack.items()
+                    if k in _ATTN or k in ("gate", "up", "down")},
+             "i": jnp.arange(L, dtype=jnp.int32)})
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     return x, {"kv": pool}
 

@@ -345,3 +345,124 @@ def test_mla_engine_unsupported_combinations_refuse():
             EngineCore(cfg, EngineConfig(**base), attn_impl="xla",
                        param_dtype=jnp.float32,
                        mesh=make_mesh(dp=1, tp=2))
+
+
+def _moe_cfg(n_group=0, topk_group=0, scaling=1.0) -> ModelConfig:
+    return ModelConfig(
+        model_type="deepseek_v2", vocab_size=256, hidden_size=64,
+        intermediate_size=48,            # moe expert F
+        num_layers=3, num_heads=4, num_kv_heads=4, head_dim=24,
+        max_position_embeddings=256, rms_norm_eps=1e-6,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        q_lora_rank=0, kv_lora_rank=16, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16,
+        num_experts=4, num_experts_per_tok=2, moe_norm_topk=False,
+        first_k_dense=1, dense_intermediate_size=128,
+        shared_expert_size=96,           # = 2 shared * moe F 48
+        routed_scaling=scaling, n_group=n_group, topk_group=topk_group)
+
+
+def _to_hf_moe(params, cfg):
+    """Extend _to_hf with the deepseek MoE naming: dense prefix layers
+    carry mlp.*_proj; MoE layers carry mlp.gate (router, [E, D]),
+    mlp.experts.{e}.*_proj, mlp.shared_experts.*_proj."""
+    import torch
+
+    def t(a):
+        return torch.tensor(np.asarray(a, np.float32))
+
+    sd = _to_hf(params, cfg)
+    k = cfg.first_k_dense
+    for i in range(k):
+        for ours, hf in (("dense_gate", "gate_proj"),
+                         ("dense_up", "up_proj"),
+                         ("dense_down", "down_proj")):
+            sd[f"model.layers.{i}.mlp.{hf}.weight"] = t(
+                params[f"layers.{ours}"][i]).T.contiguous()
+    for j in range(cfg.num_layers - k):
+        i = k + j
+        sd[f"model.layers.{i}.mlp.gate.weight"] = t(
+            params["layers.router"][j]).T.contiguous()
+        for e in range(cfg.num_experts):
+            for ours, hf in (("moe_gate", "gate_proj"),
+                             ("moe_up", "up_proj"),
+                             ("moe_down", "down_proj")):
+                sd[f"model.layers.{i}.mlp.experts.{e}.{hf}.weight"] = t(
+                    params[f"layers.{ours}"][j][e]).T.contiguous()
+        for ours, hf in (("sh_gate", "gate_proj"), ("sh_up", "up_proj"),
+                         ("sh_down", "down_proj")):
+            sd[f"model.layers.{i}.mlp.shared_experts.{hf}.weight"] = t(
+                params[f"layers.{ours}"][j]).T.contiguous()
+    return sd
+
+
+@pytest.mark.parametrize("n_group,topk_group,scaling", [
+    (0, 0, 1.0),          # -Lite: greedy routing
+    (2, 1, 2.5),          # -V2/-Chat: group-limited greedy + scaling
+], ids=["greedy", "group_limited"])
+def test_mla_deepseek_moe_matches_hf(n_group, topk_group, scaling):
+    """The full deepseek MoE block vs HF: hybrid first_k_dense prefix,
+    softmax-scores routing WITHOUT renormalization, routed_scaling,
+    additive (ungated) shared experts, and group-limited greedy for the
+    -V2 shapes — teacher-forced logits through prefill AND the absorbed
+    decode."""
+    torch = pytest.importorskip("torch")
+    from transformers import DeepseekV2Config, DeepseekV2ForCausalLM
+    cfg = _moe_cfg(n_group, topk_group, scaling)
+    params = mla.init_params(cfg, jax.random.PRNGKey(14),
+                             dtype=jnp.float32)
+    hf_cfg = DeepseekV2Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.dense_intermediate_size,
+        moe_intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_heads,
+        q_lora_rank=None, kv_lora_rank=cfg.kv_lora_rank,
+        qk_nope_head_dim=cfg.qk_nope_head_dim,
+        qk_rope_head_dim=cfg.qk_rope_head_dim,
+        v_head_dim=cfg.v_head_dim, head_dim=cfg.qk_rope_head_dim,
+        n_routed_experts=cfg.num_experts,
+        num_experts_per_tok=cfg.num_experts_per_tok,
+        n_shared_experts=2, first_k_dense_replace=cfg.first_k_dense,
+        topk_method=("group_limited_greedy" if n_group else "greedy"),
+        n_group=n_group or None, topk_group=topk_group or None,
+        routed_scaling_factor=scaling, norm_topk_prob=False,
+        max_position_embeddings=cfg.max_position_embeddings,
+        rms_norm_eps=cfg.rms_norm_eps, rope_theta=cfg.rope_theta,
+        tie_word_embeddings=False, attention_bias=False,
+        attn_implementation="eager")
+    hf = DeepseekV2ForCausalLM(hf_cfg)
+    missing, unexpected = hf.load_state_dict(_to_hf_moe(params, cfg),
+                                             strict=False)
+    assert not missing and not unexpected, (missing, unexpected)
+    hf.eval()
+
+    rng = np.random.default_rng(15)
+    tokens = rng.integers(1, cfg.vocab_size, size=12).tolist()
+    steps = 5
+    with torch.no_grad():
+        ref_all = hf(torch.tensor(
+            [tokens + [7] * steps])).logits[0].numpy()
+
+    kv = mla.init_kv_cache(cfg, NUM_BLOCKS, BS, dtype=jnp.float32)
+    T = 32
+    padded = np.zeros((T,), np.int32)
+    padded[:len(tokens)] = tokens
+    table = np.zeros((NUM_BLOCKS,), np.int32)
+    table[:T // BS] = np.arange(1, 1 + T // BS)
+    lg, kv = mla.prefill_forward(
+        params, kv, jnp.asarray(padded), jnp.asarray(table),
+        jnp.asarray(0, jnp.int32), jnp.asarray(len(tokens), jnp.int32),
+        _statics(cfg))
+    np.testing.assert_allclose(np.asarray(lg), ref_all[len(tokens) - 1],
+                               rtol=5e-4, atol=5e-4)
+    tables = table[None, :T // BS]
+    for s in range(steps):
+        pos = jnp.asarray([len(tokens) + s], jnp.int32)
+        lg, kv = mla.decode_forward(
+            params, kv, jnp.asarray([7], jnp.int32), pos,
+            jnp.asarray(tables), _statics(cfg))
+        np.testing.assert_allclose(
+            np.asarray(lg[0]), ref_all[len(tokens) + s],
+            rtol=5e-4, atol=5e-4, err_msg=f"decode step {s}")
