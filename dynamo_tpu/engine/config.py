@@ -118,17 +118,31 @@ class ModelConfig:
             raise ValueError(
                 f"unsupported shared-expert MoE family {mt!r} "
                 f"(qwen2_moe is the implemented shared-expert family)")
-        if mt in ("deepseek_v2", "deepseek_v3"):
-            # The MLA model module (engine/models/mla.py: latent-KV
-            # paged cache + absorbed decode, HF-parity-tested) exists,
-            # but engine/serving integration and the deepseek MoE
-            # variants (shared-expert additive, first_k_dense hybrid,
-            # v3 sigmoid-grouped routing) are pending — half-serving
-            # would decode garbage, so the family still rejects
+        if mt == "deepseek_v3":
+            # v3 routes by SIGMOID scores with the noaux_tc bias-corrected
+            # group selection — a different routing function from v2's
+            # softmax (models/mla.py implements v2); half-applying it
+            # would decode garbage
             raise ValueError(
-                f"{mt!r} serving is not integrated yet (the MLA "
-                f"attention module is implemented and parity-tested; "
-                f"deepseek MoE + engine wiring pending)")
+                "deepseek_v3 is not implemented (its sigmoid-scored "
+                "noaux_tc routing differs from the v2 routing "
+                "models/mla.py carries); deepseek_v2 is served")
+        if mt == "deepseek_v2":
+            tm = cfg.get("topk_method", "greedy")
+            if cfg.get("n_routed_experts") and tm not in (
+                    "greedy", "group_limited_greedy"):
+                raise ValueError(
+                    f"deepseek_v2 topk_method {tm!r} is not implemented "
+                    f"(greedy and group_limited_greedy are)")
+            if cfg.get("norm_topk_prob"):
+                # transformers' native DeepseekV2 gate reads but never
+                # APPLIES norm_topk_prob (4.57.6), while the original
+                # remote code renorms instead of scaling — the combined
+                # semantics are unpinned, so reject rather than guess
+                raise ValueError(
+                    "deepseek_v2 norm_topk_prob=true is not implemented "
+                    "(reference semantics are unpinned; released V2 "
+                    "configs use false)")
         if mt == "qwen3_moe" and not cfg.get("norm_topk_prob", False):
             # moe_mlp implements the normalized (mixtral-equivalent)
             # routing convention; softmax-then-topk WITHOUT renorm is a
@@ -160,12 +174,14 @@ class ModelConfig:
         # absent MoE keys must take each FAMILY's class defaults —
         # otherwise a re-saved MoE config silently parses as dense
         n_experts = int(cfg.get("num_local_experts", 0)
+                        or cfg.get("n_routed_experts", 0)     # deepseek
                         or cfg.get("num_experts",
                                    {"qwen2_moe": 60, "qwen3_moe": 128,
                                     "mixtral": 8}.get(mt, 0)) or 0)
         moe_inter = int(cfg.get("moe_intermediate_size",
-                                {"qwen2_moe": 1408,
-                                 "qwen3_moe": 768}.get(mt, 0)) or 0)
+                                {"qwen2_moe": 1408, "qwen3_moe": 768,
+                                 # DeepseekV2Config class default (1407!)
+                                 "deepseek_v2": 1407}.get(mt, 0)) or 0)
         rs = None
         raw_rs = cfg.get("rope_scaling")
         if isinstance(raw_rs, dict):
@@ -188,8 +204,6 @@ class ModelConfig:
             model_type=cfg.get("model_type", "llama"),
             vocab_size=int(cfg.get("vocab_size", 32000)),
             hidden_size=hidden,
-            # qwen3-moe sizes the EXPERT mlps by moe_intermediate_size;
-            # our stacked expert tensors use intermediate_size for F
             # MoE families size the EXPERT mlps by moe_intermediate_size;
             # our stacked expert tensors use intermediate_size for F
             intermediate_size=int(
@@ -220,13 +234,19 @@ class ModelConfig:
             # all-expert softmax values, not renormalized); every other
             # family renormalizes over the top-k
             moe_norm_topk=bool(cfg.get("norm_topk_prob", False))
-            if mt == "qwen2_moe" else True,
+            if mt == "qwen2_moe" else True
+            if mt != "deepseek_v2" else False,
             # the qwen2_moe architecture ALWAYS has a shared expert (HF
             # modeling code is unconditional); an absent key means the
             # HF-default size 5632, NOT "no shared expert" — silently
             # dropping it would be the garbage-logits hazard the
             # unknown-family guard above rejects
             shared_expert_size=int(
+                # deepseek: n_shared_experts × the expert width,
+                # additive; the ABSENT key means the class default 2
+                # (to_diff_dict omits defaults), NOT "no shared experts"
+                int(cfg.get("n_shared_experts", 2) or 0) * moe_inter
+                if mt == "deepseek_v2" else
                 cfg.get("shared_expert_intermediate_size",
                         5632 if mt == "qwen2_moe" else 0) or 0),
             qk_norm=bool(cfg.get("qk_norm", cfg.get("model_type")
@@ -251,6 +271,23 @@ class ModelConfig:
             query_pre_attn_scalar=(float(cfg["query_pre_attn_scalar"])
                                    if cfg.get("query_pre_attn_scalar")
                                    else None),
+            q_lora_rank=int(cfg.get("q_lora_rank") or 0),
+            kv_lora_rank=int(cfg.get("kv_lora_rank") or 0)
+            if mt == "deepseek_v2" else 0,
+            qk_nope_head_dim=int(cfg.get("qk_nope_head_dim") or 0),
+            qk_rope_head_dim=int(cfg.get("qk_rope_head_dim") or 0),
+            v_head_dim=int(cfg.get("v_head_dim") or 0),
+            first_k_dense=int(cfg.get("first_k_dense_replace") or 0)
+            if n_experts > 0 else 0,
+            dense_intermediate_size=int(
+                cfg.get("intermediate_size", 0) or 0)
+            if mt == "deepseek_v2" and n_experts > 0 else 0,
+            routed_scaling=float(
+                cfg.get("routed_scaling_factor", 1.0) or 1.0),
+            n_group=int(cfg.get("n_group") or 0)
+            if cfg.get("topk_method") == "group_limited_greedy" else 0,
+            topk_group=int(cfg.get("topk_group") or 0)
+            if cfg.get("topk_method") == "group_limited_greedy" else 0,
             sliding_window=(int(cfg.get("sliding_window") or 4096)
                             if mt == "gemma2"
                             else int(cfg["sliding_window"])

@@ -250,9 +250,8 @@ def _latent_rows(lp, hn, positions, cfg: ModelConfig):
 def _moe_mlp(hn, lp, cfg: ModelConfig) -> jax.Array:
     """deepseek routing (HF DeepseekV2MoEGate + MoE, verified by the
     parity tests): f32 softmax over ALL experts, greedy (or
-    group-limited greedy) top-k of the SCORES — renormalized over the
-    selection only when moe_norm_topk (deepseek norm_topk_prob) is set —
-    then scaled by routed_scaling; shared experts are a plain additive
+    group-limited greedy) top-k of the SCORES without renormalization,
+    scaled by routed_scaling; shared experts are a plain additive
     swiglu. Experts run dense-over-E (llama.run_experts_dense)."""
     N, E = hn.shape[0], cfg.num_experts
     logits = (hn.astype(jnp.float32)
@@ -269,11 +268,8 @@ def _moe_mlp(hn, lp, cfg: ModelConfig) -> jax.Array:
         scores = (scores.reshape(N, g, E // g)
                   * gmask[..., None]).reshape(N, E)
     top_w, top_idx = jax.lax.top_k(scores, cfg.num_experts_per_tok)
-    if cfg.moe_norm_topk:
-        # deepseek's norm_topk_prob=true variant (weights renormalize
-        # over the selected experts; v2 released configs use False)
-        top_w = top_w / jnp.maximum(
-            jnp.sum(top_w, axis=-1, keepdims=True), 1e-20)
+    # NO renormalization: the HF-native reference never applies
+    # norm_topk_prob (from_hf_config rejects true for deepseek_v2)
     top_w = top_w * cfg.routed_scaling
     out = run_experts_dense(hn, lp["moe_gate"], lp["moe_up"],
                             lp["moe_down"], top_idx, top_w)

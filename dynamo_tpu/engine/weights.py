@@ -53,6 +53,17 @@ _LAYER_MAP = {
     "mlp.shared_expert.up_proj.weight": ("sh_up", True),
     "mlp.shared_expert.down_proj.weight": ("sh_down", True),
     "mlp.shared_expert_gate.weight": ("sh_router", True),
+    # deepseek shared experts (PLURAL naming; additive, ungated)
+    "mlp.shared_experts.gate_proj.weight": ("sh_gate", True),
+    "mlp.shared_experts.up_proj.weight": ("sh_up", True),
+    "mlp.shared_experts.down_proj.weight": ("sh_down", True),
+    # deepseek MLA attention (models/mla.py)
+    "self_attn.q_a_proj.weight": ("wq_a", True),
+    "self_attn.q_a_layernorm.weight": ("q_a_norm", False),
+    "self_attn.q_b_proj.weight": ("wq_b", True),
+    "self_attn.kv_a_proj_with_mqa.weight": ("wkv_a", True),
+    "self_attn.kv_a_layernorm.weight": ("kv_norm", False),
+    "self_attn.kv_b_proj.weight": ("wkv_b", True),
 }
 
 # mixtral expert sub-weights: w1=gate, w3=up, w2=down (all torch [out, in])
@@ -75,6 +86,12 @@ def _layer_map_for(cfg: ModelConfig) -> Dict[str, tuple]:
         layer_map["post_attention_layernorm.weight"] = ("ln1_post", False)
         layer_map["pre_feedforward_layernorm.weight"] = ("ln2", False)
         layer_map["post_feedforward_layernorm.weight"] = ("ln2_post", False)
+    if cfg.model_type == "deepseek_v2" and cfg.num_experts > 0:
+        # hybrid sparsity: mlp.*_proj exists only on the dense-prefix
+        # layers and lands in the dense_* stacks (_partial_ranges)
+        layer_map["mlp.gate_proj.weight"] = ("dense_gate", True)
+        layer_map["mlp.up_proj.weight"] = ("dense_up", True)
+        layer_map["mlp.down_proj.weight"] = ("dense_down", True)
     if cfg.model_type == "phi3":
         # phi3 ships FUSED projections (_fused_sections); the split
         # suffixes must not also match
@@ -104,14 +121,30 @@ def _fused_sections(cfg: ModelConfig) -> Dict[str, list]:
     }
 
 
+def _partial_ranges(cfg: ModelConfig):
+    """Stacked keys that cover only a LAYER RANGE (deepseek hybrid
+    sparsity): key -> (lo, hi) global layer bounds. Empty for uniform
+    families."""
+    if cfg.model_type != "deepseek_v2" or cfg.num_experts == 0:
+        return {}
+    k, L = cfg.first_k_dense, cfg.num_layers
+    out = {key: (0, k) for key in ("dense_gate", "dense_up",
+                                   "dense_down")}
+    for key in ("router", "moe_gate", "moe_up", "moe_down",
+                "sh_gate", "sh_up", "sh_down"):
+        out[key] = (k, L)
+    return out
+
+
 def load_params_auto(model_dir: str, cfg: Optional[ModelConfig] = None,
                      mesh=None, dtype=jnp.bfloat16) -> Dict[str, jax.Array]:
     """THE loader entry point: streams shards straight from disk when a
     mesh is given (host peak = one shard — the 70B path), replicated
-    otherwise. MoE checkpoints use the replicated reader even with a
-    mesh (EngineCore's shard_params re-places them)."""
+    otherwise. MoE and MLA checkpoints use the replicated reader even
+    with a mesh (EngineCore's shard_params re-places them; MLA refuses
+    meshes at the engine)."""
     cfg = cfg or ModelConfig.from_model_dir(model_dir)
-    if mesh is not None and cfg.num_experts == 0:
+    if mesh is not None and cfg.num_experts == 0 and cfg.kv_lora_rank == 0:
         return load_llama_params_sharded(model_dir, mesh, cfg, dtype=dtype)
     return load_llama_params(model_dir, cfg, dtype=dtype)
 
@@ -175,22 +208,39 @@ def load_llama_params(model_dir: str, cfg: Optional[ModelConfig] = None,
             staging.setdefault(key, [None] * L)[int(idx_str)] = arr
 
     params: Dict[str, jax.Array] = {}
+    partial = _partial_ranges(cfg)
     for key, arr in singles.items():
         params[key] = jnp.asarray(arr, dtype=dtype)
     for key, per_layer in staging.items():
-        missing = [i for i, a in enumerate(per_layer) if a is None]
-        if missing:
-            raise ValueError(f"checkpoint missing layers {missing} for {key}")
+        lo, hi = partial.get(key, (0, L))
+        rows = per_layer[lo:hi]
+        missing = [lo + i for i, a in enumerate(rows) if a is None]
+        extra = [i for i, a in enumerate(per_layer) if a is not None
+                 and not (lo <= i < hi)]
+        if missing or extra:
+            raise ValueError(
+                f"checkpoint layer coverage wrong for {key}: missing "
+                f"{missing[:4]}, outside-range {extra[:4]} "
+                f"(expected layers [{lo}, {hi}))")
         params[f"layers.{key}"] = jnp.asarray(
-            np.stack(per_layer, axis=0), dtype=dtype)
+            np.stack(rows, axis=0), dtype=dtype)
     for key, grid in expert_staging.items():
-        missing = [(i, j) for i, row in enumerate(grid)
+        lo, hi = partial.get(key, (0, L))
+        rows = grid[lo:hi]
+        missing = [(lo + i, j) for i, row in enumerate(rows)
                    for j, a in enumerate(row) if a is None]
+        extra = [(i, j) for i, row in enumerate(grid)
+                 for j, a in enumerate(row)
+                 if a is not None and not (lo <= i < hi)]
+        if extra:
+            raise ValueError(
+                f"checkpoint expert coverage wrong for {key}: tensors "
+                f"at layers outside [{lo}, {hi}): {extra[:4]}")
         if missing:
             raise ValueError(f"checkpoint missing experts {missing[:4]}… "
                              f"for {key}")
         params[f"layers.{key}"] = jnp.asarray(
-            np.stack([np.stack(row, axis=0) for row in grid], axis=0),
+            np.stack([np.stack(row, axis=0) for row in rows], axis=0),
             dtype=dtype)
     if "lm_head" not in params and not cfg.tie_word_embeddings:
         # some checkpoints tie implicitly by omitting lm_head
@@ -219,6 +269,10 @@ def load_llama_params_sharded(model_dir: str, mesh,
     """
     if not _HAVE_ST:
         raise RuntimeError("safetensors not available")
+    if (cfg or ModelConfig.from_model_dir(model_dir)).kv_lora_rank > 0:
+        raise NotImplementedError(
+            "MLA checkpoints use the replicated loader (the engine "
+            "refuses meshes for MLA; route through load_params_auto)")
     import contextlib
 
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -350,6 +404,11 @@ def save_hf_style(params: Dict[str, jax.Array], cfg: ModelConfig,
     """Write params back out as a single HF-style safetensors file (used by
     tests to cross-check against the torch reference implementation)."""
     from safetensors.numpy import save_file
+    if cfg.model_type == "deepseek_v2" and cfg.num_experts > 0:
+        raise NotImplementedError(
+            "save_hf_style cannot write the deepseek hybrid MoE layout "
+            "(partial layer stacks + deepseek expert naming); the MLA "
+            "tests carry their own converter")
     os.makedirs(out_dir, exist_ok=True)
 
     def c(a) -> np.ndarray:
@@ -364,6 +423,13 @@ def save_hf_style(params: Dict[str, jax.Array], cfg: ModelConfig,
     if "lm_head" in params:
         out["lm_head.weight"] = c(np.asarray(params["lm_head"], np.float32).T)
     inv = {v[0]: (k, v[1]) for k, v in _LAYER_MAP.items()}
+    # _LAYER_MAP maps BOTH shared-expert namings (qwen2 singular,
+    # deepseek plural) onto sh_*; the dict inversion keeps whichever
+    # iterated last — pin the family's own naming explicitly
+    if cfg.model_type == "qwen2_moe":
+        inv["sh_gate"] = ("mlp.shared_expert.gate_proj.weight", True)
+        inv["sh_up"] = ("mlp.shared_expert.up_proj.weight", True)
+        inv["sh_down"] = ("mlp.shared_expert.down_proj.weight", True)
     if cfg.post_norms:   # gemma2 norm naming (see load_llama_params)
         inv["ln1_post"] = ("post_attention_layernorm.weight", False)
         inv["ln2"] = ("pre_feedforward_layernorm.weight", False)

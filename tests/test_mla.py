@@ -466,3 +466,73 @@ def test_mla_deepseek_moe_matches_hf(n_group, topk_group, scaling):
         np.testing.assert_allclose(
             np.asarray(lg[0]), ref_all[len(tokens) + s],
             rtol=5e-4, atol=5e-4, err_msg=f"decode step {s}")
+
+
+def test_deepseek_v2_checkpoint_roundtrip(tmp_path):
+    """config.json + safetensors (HF deepseek naming, fused MoE hybrid)
+    -> from_hf_config + load_llama_params reproduce the params exactly:
+    the checkpoint-level deepseek_v2 gate is open."""
+    import json
+
+    from safetensors.numpy import save_file
+
+    from dynamo_tpu.engine.weights import load_llama_params
+    cfg = _moe_cfg(n_group=2, topk_group=1, scaling=2.5)
+    cfg.q_lora_rank = 12         # exercise the q-LoRA names too
+    params = mla.init_params(cfg, jax.random.PRNGKey(21),
+                             dtype=jnp.float32)
+    sd = {k: np.ascontiguousarray(v.numpy())
+          for k, v in _to_hf_moe(params, cfg).items()}
+    save_file(sd, str(tmp_path / "model.safetensors"))
+    (tmp_path / "config.json").write_text(json.dumps({
+        "model_type": "deepseek_v2", "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.dense_intermediate_size,
+        "moe_intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_heads,
+        "q_lora_rank": cfg.q_lora_rank,
+        "kv_lora_rank": cfg.kv_lora_rank,
+        "qk_nope_head_dim": cfg.qk_nope_head_dim,
+        "qk_rope_head_dim": cfg.qk_rope_head_dim,
+        "v_head_dim": cfg.v_head_dim,
+        "n_routed_experts": cfg.num_experts,
+        "num_experts_per_tok": cfg.num_experts_per_tok,
+        "n_shared_experts": 2,
+        "first_k_dense_replace": cfg.first_k_dense,
+        "topk_method": "group_limited_greedy", "n_group": 2,
+        "topk_group": 1, "routed_scaling_factor": 2.5,
+        "norm_topk_prob": False,
+        "max_position_embeddings": cfg.max_position_embeddings,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "tie_word_embeddings": False}))
+
+    parsed = ModelConfig.from_model_dir(str(tmp_path))
+    assert parsed.kv_lora_rank == cfg.kv_lora_rank
+    assert parsed.num_experts == cfg.num_experts
+    assert parsed.intermediate_size == cfg.intermediate_size
+    assert parsed.dense_intermediate_size == cfg.dense_intermediate_size
+    assert parsed.shared_expert_size == 2 * cfg.intermediate_size
+    assert parsed.first_k_dense == 1 and parsed.n_group == 2
+    assert parsed.routed_scaling == 2.5 and not parsed.moe_norm_topk
+
+    loaded = load_llama_params(str(tmp_path), parsed, dtype=jnp.float32)
+    assert set(loaded) == set(params)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(loaded[k]),
+                                   np.asarray(params[k]),
+                                   rtol=0, atol=0, err_msg=k)
+
+
+def test_deepseek_v3_and_bad_topk_still_reject():
+    with pytest.raises(ValueError, match="deepseek_v3"):
+        ModelConfig.from_hf_config({"model_type": "deepseek_v3"})
+    with pytest.raises(ValueError, match="topk_method"):
+        ModelConfig.from_hf_config({
+            "model_type": "deepseek_v2", "n_routed_experts": 8,
+            "kv_lora_rank": 16, "topk_method": "noaux_tc"})
+    with pytest.raises(ValueError, match="norm_topk_prob"):
+        ModelConfig.from_hf_config({
+            "model_type": "deepseek_v2", "n_routed_experts": 8,
+            "kv_lora_rank": 16, "norm_topk_prob": True})
