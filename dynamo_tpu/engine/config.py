@@ -78,7 +78,11 @@ class ModelConfig:
     # deepseek MoE deltas (models/mla.py): the first k layers are DENSE
     # with their own intermediate size; routed weights scale by
     # routed_scaling; group-limited routing masks scores to the
-    # topk_group best of n_group expert groups before the top-k
+    # topk_group best of n_group expert groups before the top-k.
+    # moe_routing picks the scoring function: "softmax" (deepseek_v2
+    # greedy / group_limited_greedy) or "sigmoid_noaux" (deepseek_v3
+    # noaux_tc: sigmoid scores + e_score_correction_bias group choice)
+    moe_routing: str = "softmax"
     first_k_dense: int = 0
     dense_intermediate_size: int = 0
     routed_scaling: float = 1.0
@@ -119,14 +123,31 @@ class ModelConfig:
                 f"unsupported shared-expert MoE family {mt!r} "
                 f"(qwen2_moe is the implemented shared-expert family)")
         if mt == "deepseek_v3":
-            # v3 routes by SIGMOID scores with the noaux_tc bias-corrected
-            # group selection — a different routing function from v2's
-            # softmax (models/mla.py implements v2); half-applying it
-            # would decode garbage
-            raise ValueError(
-                "deepseek_v3 is not implemented (its sigmoid-scored "
-                "noaux_tc routing differs from the v2 routing "
-                "models/mla.py carries); deepseek_v2 is served")
+            # models/mla.py implements exactly HF DeepseekV3's semantics:
+            # sigmoid-scored noaux_tc routing, interleaved rope, bf16
+            # weights — anything else must reject, not half-apply
+            if str(cfg.get("scoring_func", "sigmoid")) != "sigmoid":
+                raise ValueError(
+                    f"deepseek_v3 scoring_func "
+                    f"{cfg.get('scoring_func')!r} is not implemented "
+                    f"(sigmoid is the v3 routing models/mla.py carries)")
+            tm3 = cfg.get("topk_method", "noaux_tc")
+            if tm3 != "noaux_tc":
+                raise ValueError(
+                    f"deepseek_v3 topk_method {tm3!r} is not implemented "
+                    f"(noaux_tc is)")
+            if cfg.get("rope_interleave") is False:
+                # HF default is True (the released-checkpoint layout);
+                # half-split rope on interleaved weights decodes garbage
+                raise ValueError(
+                    "deepseek_v3 rope_interleave=false is not "
+                    "implemented (the interleaved rotation is)")
+            if cfg.get("quantization_config"):
+                raise ValueError(
+                    "deepseek_v3 fp8 block-quantized checkpoints "
+                    "(quantization_config) are not implemented — load a "
+                    "bf16 conversion (engine-side int8/int4 weight "
+                    "quantization is applied at load, not from fp8)")
         if mt == "deepseek_v2":
             tm = cfg.get("topk_method", "greedy")
             if cfg.get("n_routed_experts") and tm not in (
@@ -170,6 +191,7 @@ class ModelConfig:
                 "use a base-context phi3 checkpoint (no rope_scaling)")
         n_heads = int(cfg.get("num_attention_heads", 32))
         hidden = int(cfg.get("hidden_size", 4096))
+        is_ds = mt in ("deepseek_v2", "deepseek_v3")
         # HF save_pretrained omits class-default keys (to_diff_dict), so
         # absent MoE keys must take each FAMILY's class defaults —
         # otherwise a re-saved MoE config silently parses as dense
@@ -177,11 +199,15 @@ class ModelConfig:
                         or cfg.get("n_routed_experts", 0)     # deepseek
                         or cfg.get("num_experts",
                                    {"qwen2_moe": 60, "qwen3_moe": 128,
-                                    "mixtral": 8}.get(mt, 0)) or 0)
+                                    "mixtral": 8,
+                                    # DeepseekV3Config class default —
+                                    # every released V3/R1 is MoE
+                                    "deepseek_v3": 256}.get(mt, 0)) or 0)
         moe_inter = int(cfg.get("moe_intermediate_size",
                                 {"qwen2_moe": 1408, "qwen3_moe": 768,
                                  # DeepseekV2Config class default (1407!)
-                                 "deepseek_v2": 1407}.get(mt, 0)) or 0)
+                                 "deepseek_v2": 1407,
+                                 "deepseek_v3": 2048}.get(mt, 0)) or 0)
         rs = None
         raw_rs = cfg.get("rope_scaling")
         if isinstance(raw_rs, dict):
@@ -229,13 +255,18 @@ class ModelConfig:
             # Mixtral 2, Qwen2Moe 4, Qwen3Moe 8
             num_experts_per_tok=int(cfg.get(
                 "num_experts_per_tok",
-                {"qwen2_moe": 4, "qwen3_moe": 8}.get(mt, 2))),
+                {"qwen2_moe": 4, "qwen3_moe": 8,
+                 "deepseek_v3": 8}.get(mt, 2))),
             # qwen2_moe DEFAULTS norm_topk_prob=false (weights are the
-            # all-expert softmax values, not renormalized); every other
+            # all-expert softmax values, not renormalized); deepseek_v2
+            # never renormalizes; deepseek_v3 defaults TRUE (HF
+            # DeepseekV3TopkRouter applies it for real); every other
             # family renormalizes over the top-k
-            moe_norm_topk=bool(cfg.get("norm_topk_prob", False))
-            if mt == "qwen2_moe" else True
-            if mt != "deepseek_v2" else False,
+            moe_norm_topk=(bool(cfg.get("norm_topk_prob", False))
+                           if mt == "qwen2_moe"
+                           else False if mt == "deepseek_v2"
+                           else bool(cfg.get("norm_topk_prob", True))
+                           if mt == "deepseek_v3" else True),
             # the qwen2_moe architecture ALWAYS has a shared expert (HF
             # modeling code is unconditional); an absent key means the
             # HF-default size 5632, NOT "no shared expert" — silently
@@ -243,10 +274,13 @@ class ModelConfig:
             # unknown-family guard above rejects
             shared_expert_size=int(
                 # deepseek: n_shared_experts × the expert width,
-                # additive; the ABSENT key means the class default 2
-                # (to_diff_dict omits defaults), NOT "no shared experts"
-                int(cfg.get("n_shared_experts", 2) or 0) * moe_inter
-                if mt == "deepseek_v2" else
+                # additive; the ABSENT key means the class default (2
+                # for v2, 1 for v3 — to_diff_dict omits defaults), NOT
+                # "no shared experts"
+                int(cfg.get("n_shared_experts",
+                            2 if mt == "deepseek_v2" else 1) or 0)
+                * moe_inter
+                if is_ds else
                 cfg.get("shared_expert_intermediate_size",
                         5632 if mt == "qwen2_moe" else 0) or 0),
             qk_norm=bool(cfg.get("qk_norm", cfg.get("model_type")
@@ -271,23 +305,42 @@ class ModelConfig:
             query_pre_attn_scalar=(float(cfg["query_pre_attn_scalar"])
                                    if cfg.get("query_pre_attn_scalar")
                                    else None),
-            q_lora_rank=int(cfg.get("q_lora_rank") or 0),
-            kv_lora_rank=int(cfg.get("kv_lora_rank") or 0)
-            if mt == "deepseek_v2" else 0,
-            qk_nope_head_dim=int(cfg.get("qk_nope_head_dim") or 0),
-            qk_rope_head_dim=int(cfg.get("qk_rope_head_dim") or 0),
-            v_head_dim=int(cfg.get("v_head_dim") or 0),
-            first_k_dense=int(cfg.get("first_k_dense_replace") or 0)
+            # the five MLA dims share class defaults across both
+            # DeepseekV2Config and DeepseekV3Config (512/1536/64/128/
+            # 128) — absent keys in a re-saved config mean THOSE, not
+            # "no MLA" (an explicit null q_lora_rank is the -Lite
+            # plain-q_proj layout, hence `or 0`)
+            moe_routing=("sigmoid_noaux" if mt == "deepseek_v3"
+                         else "softmax"),
+            q_lora_rank=int(cfg.get("q_lora_rank",
+                                    1536 if is_ds else 0) or 0),
+            kv_lora_rank=int(cfg.get("kv_lora_rank", 512) or 0)
+            if is_ds else 0,
+            qk_nope_head_dim=int(cfg.get(
+                "qk_nope_head_dim", 128 if is_ds else 0) or 0),
+            qk_rope_head_dim=int(cfg.get(
+                "qk_rope_head_dim", 64 if is_ds else 0) or 0),
+            v_head_dim=int(cfg.get("v_head_dim",
+                                   128 if is_ds else 0) or 0),
+            first_k_dense=int(cfg.get(
+                "first_k_dense_replace",
+                3 if mt == "deepseek_v3" else 0) or 0)
             if n_experts > 0 else 0,
             dense_intermediate_size=int(
-                cfg.get("intermediate_size", 0) or 0)
-            if mt == "deepseek_v2" and n_experts > 0 else 0,
+                cfg.get("intermediate_size",
+                        18432 if mt == "deepseek_v3" else 0) or 0)
+            if is_ds and n_experts > 0 else 0,
             routed_scaling=float(
-                cfg.get("routed_scaling_factor", 1.0) or 1.0),
+                cfg.get("routed_scaling_factor",
+                        2.5 if mt == "deepseek_v3" else 1.0) or 1.0),
             n_group=int(cfg.get("n_group") or 0)
-            if cfg.get("topk_method") == "group_limited_greedy" else 0,
+            if cfg.get("topk_method") == "group_limited_greedy"
+            else int(cfg.get("n_group", 8) or 0)
+            if mt == "deepseek_v3" else 0,
             topk_group=int(cfg.get("topk_group") or 0)
-            if cfg.get("topk_method") == "group_limited_greedy" else 0,
+            if cfg.get("topk_method") == "group_limited_greedy"
+            else int(cfg.get("topk_group", 4) or 0)
+            if mt == "deepseek_v3" else 0,
             sliding_window=(int(cfg.get("sliding_window") or 4096)
                             if mt == "gemma2"
                             else int(cfg["sliding_window"])

@@ -230,7 +230,7 @@ def init_one_param(cfg: ModelConfig, name: str, shape: tuple,
         return (jnp.zeros(shape, dtype=dtype)
                 if cfg.norm_plus_one
                 else jnp.ones(shape, dtype=dtype))
-    if name.endswith(("bq", "bk", "bv")):
+    if name.endswith(("bq", "bk", "bv", "router_bias")):
         return jnp.zeros(shape, dtype=dtype)
     fan_in = shape[-2] if len(shape) > 1 else shape[-1]
     return (jax.random.normal(sub, shape, dtype=jnp.float32)

@@ -26,12 +26,13 @@ Conventions pinned against HF ``DeepseekV2Attention`` (transformers
 Scope: dense MLP layers AND the deepseek MoE block (additive shared
 experts, first_k_dense hybrid sparsity via split scans, greedy +
 group-limited-greedy routing with routed_scaling — all HF-parity
-tested); default AND yarn rope (incl. the inferred mscale attention
-factor); EngineCore serves MLA end-to-end through the model dispatch
-(core.is_mla — single-chip, full-precision; mesh/quantization/host-tier
-combinations refuse loudly). Pending before config.from_hf_config
-accepts deepseek checkpoints: the config-key parse + checkpoint loader
-map, and v3's sigmoid-scored noaux routing.
+tested); deepseek_v3's sigmoid-scored noaux_tc routing (bias-corrected
+top-2-sum group selection, renormalized top-k, and the yarn mscale²
+score scale HF applies in DeepseekV3Attention); default AND yarn rope
+(incl. the inferred mscale attention factor); EngineCore serves MLA
+end-to-end through the model dispatch (core.is_mla — single-chip,
+full-precision; mesh/quantization/host-tier combinations refuse
+loudly).
 """
 
 from __future__ import annotations
@@ -115,6 +116,22 @@ def rope_params(cfg: ModelConfig):
     return inv_freq.astype(np.float32), float(att)
 
 
+def softmax_scale(cfg: ModelConfig) -> float:
+    """Attention score scale. Base = qk_head_dim^-0.5 for both
+    generations; deepseek_v3 under yarn additionally multiplies by
+    mscale(factor, mscale_all_dim)² (HF DeepseekV3Attention.__init__ —
+    v2 applies its attention factor through cos/sin instead, so the two
+    corrections never double-apply)."""
+    import math
+    s = (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
+    rs = cfg.rope_scaling
+    if (cfg.model_type == "deepseek_v3" and rs is not None
+            and rs.mscale_all_dim and rs.factor > 1):
+        m = 0.1 * rs.mscale_all_dim * math.log(rs.factor) + 1.0
+        s *= m * m
+    return s
+
+
 def apply_rope_interleaved(x: jax.Array, positions: jax.Array,
                            inv_freq: jax.Array,
                            scaling: float = 1.0) -> jax.Array:
@@ -175,6 +192,10 @@ def param_shapes(cfg: ModelConfig) -> Dict[str, Tuple[int, ...]]:
             "layers.moe_up": (Lm, E, D, F),
             "layers.moe_down": (Lm, E, F, D),
         })
+        if cfg.moe_routing == "sigmoid_noaux":
+            # deepseek_v3: the router's e_score_correction_bias buffer —
+            # it biases expert CHOICE only, never the mixing weights
+            shapes["layers.router_bias"] = (Lm, E)
         if cfg.shared_expert_size > 0:
             Fs = cfg.shared_expert_size
             shapes.update({
@@ -248,29 +269,54 @@ def _latent_rows(lp, hn, positions, cfg: ModelConfig):
 
 
 def _moe_mlp(hn, lp, cfg: ModelConfig) -> jax.Array:
-    """deepseek routing (HF DeepseekV2MoEGate + MoE, verified by the
-    parity tests): f32 softmax over ALL experts, greedy (or
-    group-limited greedy) top-k of the SCORES without renormalization,
-    scaled by routed_scaling; shared experts are a plain additive
-    swiglu. Experts run dense-over-E (llama.run_experts_dense)."""
+    """deepseek routing, both generations (verified by the parity
+    tests). v2 (HF DeepseekV2MoEGate): f32 softmax over ALL experts,
+    greedy (or group-limited greedy) top-k of the SCORES without
+    renormalization, scaled by routed_scaling. v3 (HF
+    DeepseekV3TopkRouter, moe_routing == "sigmoid_noaux"): f32 sigmoid
+    scores; expert CHOICE uses scores + e_score_correction_bias with
+    groups selected by the sum of each group's top-2 corrected scores
+    (masked groups ZEROED, matching masked_fill(0.0)); the mixing
+    weights are the UNBIASED sigmoid scores of the chosen experts,
+    renormalized over the top-k (+1e-20) when norm_topk_prob, then
+    scaled. Shared experts are a plain additive swiglu either way.
+    Experts run dense-over-E (llama.run_experts_dense)."""
     N, E = hn.shape[0], cfg.num_experts
     logits = (hn.astype(jnp.float32)
               @ lp["router"].astype(jnp.float32))          # [N, E]
-    scores = jax.nn.softmax(logits, axis=-1)
-    if cfg.n_group > 1:
-        # group-limited greedy (DeepSeek-V2/-Chat): keep only the
-        # topk_group groups with the best per-group max score
-        g = cfg.n_group
-        gmax = scores.reshape(N, g, E // g).max(axis=-1)   # [N, g]
-        _w, gidx = jax.lax.top_k(gmax, cfg.topk_group)
-        gmask = jnp.sum(jax.nn.one_hot(gidx, g, dtype=scores.dtype),
-                        axis=1)                            # [N, g]
-        scores = (scores.reshape(N, g, E // g)
-                  * gmask[..., None]).reshape(N, E)
-    top_w, top_idx = jax.lax.top_k(scores, cfg.num_experts_per_tok)
-    # NO renormalization: the HF-native reference never applies
-    # norm_topk_prob (from_hf_config rejects true for deepseek_v2)
-    top_w = top_w * cfg.routed_scaling
+    if cfg.moe_routing == "sigmoid_noaux":
+        scores = jax.nn.sigmoid(logits)
+        choice = scores + lp["router_bias"][None, :].astype(jnp.float32)
+        if cfg.n_group > 1:
+            g = cfg.n_group
+            top2, _i = jax.lax.top_k(choice.reshape(N, g, E // g), 2)
+            gscore = top2.sum(axis=-1)                     # [N, g]
+            _w, gidx = jax.lax.top_k(gscore, cfg.topk_group)
+            gmask = jnp.sum(jax.nn.one_hot(gidx, g, dtype=choice.dtype),
+                            axis=1)                        # [N, g]
+            choice = (choice.reshape(N, g, E // g)
+                      * gmask[..., None]).reshape(N, E)
+        _cw, top_idx = jax.lax.top_k(choice, cfg.num_experts_per_tok)
+        top_w = jnp.take_along_axis(scores, top_idx, axis=1)
+        if cfg.moe_norm_topk:
+            top_w = top_w / (top_w.sum(axis=-1, keepdims=True) + 1e-20)
+        top_w = top_w * cfg.routed_scaling
+    else:
+        scores = jax.nn.softmax(logits, axis=-1)
+        if cfg.n_group > 1:
+            # group-limited greedy (DeepSeek-V2/-Chat): keep only the
+            # topk_group groups with the best per-group max score
+            g = cfg.n_group
+            gmax = scores.reshape(N, g, E // g).max(axis=-1)  # [N, g]
+            _w, gidx = jax.lax.top_k(gmax, cfg.topk_group)
+            gmask = jnp.sum(jax.nn.one_hot(gidx, g, dtype=scores.dtype),
+                            axis=1)                           # [N, g]
+            scores = (scores.reshape(N, g, E // g)
+                      * gmask[..., None]).reshape(N, E)
+        top_w, top_idx = jax.lax.top_k(scores, cfg.num_experts_per_tok)
+        # NO renormalization: the HF-native reference never applies
+        # norm_topk_prob (from_hf_config rejects true for deepseek_v2)
+        top_w = top_w * cfg.routed_scaling
     out = run_experts_dense(hn, lp["moe_gate"], lp["moe_up"],
                             lp["moe_down"], top_idx, top_w)
     if cfg.shared_expert_size > 0:
@@ -329,8 +375,8 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
                 (x, pool),
                 {"lp": dense_lp, "i": jnp.arange(k, dtype=jnp.int32)})
         moe_lp = {n: stack[n][k:] for n in _ATTN if n in stack}
-        for n in ("router", "moe_gate", "moe_up", "moe_down",
-                  "sh_gate", "sh_up", "sh_down"):
+        for n in ("router", "router_bias", "moe_gate", "moe_up",
+                  "moe_down", "sh_gate", "sh_up", "sh_down"):
             if n in stack:
                 moe_lp[n] = stack[n]
         (x, pool), _ = jax.lax.scan(
@@ -375,7 +421,7 @@ def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
     T = tokens.shape[0]
     H = cfg.num_heads
     rank, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
-    scale = (cfg.qk_nope_head_dim + dr) ** -0.5
+    scale = softmax_scale(cfg)
     positions = start_pos + jnp.arange(T, dtype=jnp.int32)
     valid = jnp.arange(T) < true_len
     slots = jnp.where(
@@ -433,7 +479,7 @@ def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
     B = tokens.shape[0]
     H = cfg.num_heads
     rank, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
-    scale = (cfg.qk_nope_head_dim + dr) ** -0.5
+    scale = softmax_scale(cfg)
     slots = (block_tables[jnp.arange(B), positions // bsz] * bsz
              + positions % bsz)
     seq_lens = positions + 1

@@ -86,12 +86,18 @@ def _layer_map_for(cfg: ModelConfig) -> Dict[str, tuple]:
         layer_map["post_attention_layernorm.weight"] = ("ln1_post", False)
         layer_map["pre_feedforward_layernorm.weight"] = ("ln2", False)
         layer_map["post_feedforward_layernorm.weight"] = ("ln2_post", False)
-    if cfg.model_type == "deepseek_v2" and cfg.num_experts > 0:
+    if (cfg.model_type in ("deepseek_v2", "deepseek_v3")
+            and cfg.num_experts > 0):
         # hybrid sparsity: mlp.*_proj exists only on the dense-prefix
         # layers and lands in the dense_* stacks (_partial_ranges)
         layer_map["mlp.gate_proj.weight"] = ("dense_gate", True)
         layer_map["mlp.up_proj.weight"] = ("dense_up", True)
         layer_map["mlp.down_proj.weight"] = ("dense_down", True)
+    if cfg.moe_routing == "sigmoid_noaux":
+        # deepseek_v3 router bias buffer (persistent, so it is in every
+        # checkpoint's state dict)
+        layer_map["mlp.gate.e_score_correction_bias"] = (
+            "router_bias", False)
     if cfg.model_type == "phi3":
         # phi3 ships FUSED projections (_fused_sections); the split
         # suffixes must not also match
@@ -125,13 +131,14 @@ def _partial_ranges(cfg: ModelConfig):
     """Stacked keys that cover only a LAYER RANGE (deepseek hybrid
     sparsity): key -> (lo, hi) global layer bounds. Empty for uniform
     families."""
-    if cfg.model_type != "deepseek_v2" or cfg.num_experts == 0:
+    if (cfg.model_type not in ("deepseek_v2", "deepseek_v3")
+            or cfg.num_experts == 0):
         return {}
     k, L = cfg.first_k_dense, cfg.num_layers
     out = {key: (0, k) for key in ("dense_gate", "dense_up",
                                    "dense_down")}
-    for key in ("router", "moe_gate", "moe_up", "moe_down",
-                "sh_gate", "sh_up", "sh_down"):
+    for key in ("router", "router_bias", "moe_gate", "moe_up",
+                "moe_down", "sh_gate", "sh_up", "sh_down"):
         out[key] = (k, L)
     return out
 
@@ -181,6 +188,16 @@ def load_llama_params(model_dir: str, cfg: Optional[ModelConfig] = None,
         elif name.startswith("model.layers."):
             rest = name[len("model.layers."):]
             idx_str, sub = rest.split(".", 1)
+            if int(idx_str) >= L:
+                if cfg.model_type == "deepseek_v3":
+                    # MTP heads (num_nextn_predict_layers) live at
+                    # model.layers.{L}+ — generation never runs them
+                    # (HF skips them too); their attention-shaped names
+                    # must not land in the decoder stacks
+                    continue
+                raise ValueError(
+                    f"checkpoint tensor {name} is beyond the config's "
+                    f"{L} layers — config.json/checkpoint mismatch")
             expert_prefix = next(
                 (p for p in _EXPERT_PREFIXES if sub.startswith(p)), None)
             if expert_prefix is not None:
@@ -404,7 +421,8 @@ def save_hf_style(params: Dict[str, jax.Array], cfg: ModelConfig,
     """Write params back out as a single HF-style safetensors file (used by
     tests to cross-check against the torch reference implementation)."""
     from safetensors.numpy import save_file
-    if cfg.model_type == "deepseek_v2" and cfg.num_experts > 0:
+    if (cfg.model_type in ("deepseek_v2", "deepseek_v3")
+            and cfg.num_experts > 0):
         raise NotImplementedError(
             "save_hf_style cannot write the deepseek hybrid MoE layout "
             "(partial layer stacks + deepseek expert naming); the MLA "

@@ -525,9 +525,7 @@ def test_deepseek_v2_checkpoint_roundtrip(tmp_path):
                                    rtol=0, atol=0, err_msg=k)
 
 
-def test_deepseek_v3_and_bad_topk_still_reject():
-    with pytest.raises(ValueError, match="deepseek_v3"):
-        ModelConfig.from_hf_config({"model_type": "deepseek_v3"})
+def test_deepseek_unsupported_variants_reject():
     with pytest.raises(ValueError, match="topk_method"):
         ModelConfig.from_hf_config({
             "model_type": "deepseek_v2", "n_routed_experts": 8,
@@ -536,3 +534,258 @@ def test_deepseek_v3_and_bad_topk_still_reject():
         ModelConfig.from_hf_config({
             "model_type": "deepseek_v2", "n_routed_experts": 8,
             "kv_lora_rank": 16, "norm_topk_prob": True})
+    with pytest.raises(ValueError, match="scoring_func"):
+        ModelConfig.from_hf_config({
+            "model_type": "deepseek_v3", "scoring_func": "softmax"})
+    with pytest.raises(ValueError, match="topk_method"):
+        ModelConfig.from_hf_config({
+            "model_type": "deepseek_v3", "topk_method": "greedy"})
+    with pytest.raises(ValueError, match="rope_interleave"):
+        ModelConfig.from_hf_config({
+            "model_type": "deepseek_v3", "rope_interleave": False})
+    with pytest.raises(ValueError, match="quantization_config"):
+        ModelConfig.from_hf_config({
+            "model_type": "deepseek_v3",
+            "quantization_config": {"quant_method": "fp8"}})
+
+
+# ---------------------------------------------------------------------------
+# deepseek_v3: sigmoid noaux_tc routing, yarn mscale² score scale
+# ---------------------------------------------------------------------------
+
+
+def _v3_cfg() -> ModelConfig:
+    return ModelConfig(
+        model_type="deepseek_v3", vocab_size=256, hidden_size=64,
+        intermediate_size=48,            # moe expert F
+        num_layers=3, num_heads=4, num_kv_heads=4, head_dim=24,
+        max_position_embeddings=256, rms_norm_eps=1e-6,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        q_lora_rank=12, kv_lora_rank=16, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16,
+        num_experts=4, num_experts_per_tok=2, moe_norm_topk=True,
+        moe_routing="sigmoid_noaux",
+        first_k_dense=1, dense_intermediate_size=128,
+        shared_expert_size=48,           # = 1 shared * moe F 48
+        routed_scaling=2.5, n_group=2, topk_group=1)
+
+
+def _to_hf_v3(params, cfg):
+    """_to_hf_moe plus the v3 router bias buffer (persistent, so HF
+    expects it in the state dict)."""
+    import torch
+    sd = _to_hf_moe(params, cfg)
+    k = cfg.first_k_dense
+    for j in range(cfg.num_layers - k):
+        sd[f"model.layers.{k + j}.mlp.gate.e_score_correction_bias"] = \
+            torch.tensor(np.asarray(params["layers.router_bias"][j],
+                                    np.float32))
+    return sd
+
+
+def _hf_v3(cfg, params, rope_scaling=None):
+    import torch  # noqa: F401 — importorskip at callers
+    from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+    hf_cfg = DeepseekV3Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.dense_intermediate_size
+        or cfg.intermediate_size,
+        moe_intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_heads,
+        q_lora_rank=cfg.q_lora_rank or None,
+        kv_lora_rank=cfg.kv_lora_rank,
+        qk_nope_head_dim=cfg.qk_nope_head_dim,
+        qk_rope_head_dim=cfg.qk_rope_head_dim,
+        v_head_dim=cfg.v_head_dim,
+        n_routed_experts=cfg.num_experts or 4,
+        num_experts_per_tok=cfg.num_experts_per_tok,
+        n_shared_experts=1,
+        first_k_dense_replace=(cfg.first_k_dense if cfg.num_experts
+                               else cfg.num_layers),
+        n_group=cfg.n_group or 1, topk_group=cfg.topk_group or 1,
+        routed_scaling_factor=cfg.routed_scaling,
+        norm_topk_prob=cfg.moe_norm_topk,
+        max_position_embeddings=cfg.max_position_embeddings,
+        rms_norm_eps=cfg.rms_norm_eps, rope_theta=cfg.rope_theta,
+        rope_scaling=rope_scaling, tie_word_embeddings=False,
+        attention_bias=False, attn_implementation="eager")
+    hf = DeepseekV3ForCausalLM(hf_cfg)
+    sd = (_to_hf_v3(params, cfg) if cfg.num_experts
+          else _to_hf(params, cfg))
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    assert not missing and not unexpected, (missing, unexpected)
+    hf.eval()
+    return hf
+
+
+def test_mla_deepseek_v3_moe_matches_hf():
+    """v3 noaux_tc routing vs HF DeepseekV3ForCausalLM: sigmoid scores,
+    bias-corrected top-2-sum group selection, renormalized top-k
+    weights from the UNBIASED scores, routed_scaling — teacher-forced
+    through prefill AND the absorbed decode. The bias buffer is
+    RANDOMIZED so biased-choice-vs-unbiased-weights cannot silently
+    collapse into one tensor."""
+    torch = pytest.importorskip("torch")
+    cfg = _v3_cfg()
+    params = mla.init_params(cfg, jax.random.PRNGKey(31),
+                             dtype=jnp.float32)
+    params["layers.router_bias"] = jax.random.normal(
+        jax.random.PRNGKey(32),
+        params["layers.router_bias"].shape, dtype=jnp.float32) * 0.5
+    hf = _hf_v3(cfg, params)
+
+    rng = np.random.default_rng(33)
+    tokens = rng.integers(1, cfg.vocab_size, size=13).tolist()
+    steps = 5
+    with torch.no_grad():
+        ref_all = hf(torch.tensor(
+            [tokens + [9] * steps])).logits[0].numpy()
+
+    kv = mla.init_kv_cache(cfg, NUM_BLOCKS, BS, dtype=jnp.float32)
+    T = 32
+    padded = np.zeros((T,), np.int32)
+    padded[:len(tokens)] = tokens
+    table = np.zeros((NUM_BLOCKS,), np.int32)
+    table[:T // BS] = np.arange(1, 1 + T // BS)
+    lg, kv = mla.prefill_forward(
+        params, kv, jnp.asarray(padded), jnp.asarray(table),
+        jnp.asarray(0, jnp.int32), jnp.asarray(len(tokens), jnp.int32),
+        _statics(cfg))
+    np.testing.assert_allclose(np.asarray(lg), ref_all[len(tokens) - 1],
+                               rtol=5e-4, atol=5e-4)
+    tables = table[None, :T // BS]
+    for s in range(steps):
+        pos = jnp.asarray([len(tokens) + s], jnp.int32)
+        lg, kv = mla.decode_forward(
+            params, kv, jnp.asarray([9], jnp.int32), pos,
+            jnp.asarray(tables), _statics(cfg))
+        np.testing.assert_allclose(
+            np.asarray(lg[0]), ref_all[len(tokens) + s],
+            rtol=5e-4, atol=5e-4, err_msg=f"decode step {s}")
+
+
+def test_mla_v3_yarn_score_scale_matches_hf():
+    """v3 yarn applies mscale(factor, mscale_all_dim)² to the SCORE
+    scale (HF DeepseekV3Attention.__init__) — with mscale ==
+    mscale_all_dim the cos/sin attention factor is 1.0, so only this
+    path carries the correction; skipping it shifts every logit."""
+    torch = pytest.importorskip("torch")
+    from dynamo_tpu.engine.config import RopeScaling
+    cfg = _v3_cfg()
+    cfg.num_experts = 0
+    cfg.intermediate_size = 128
+    cfg.first_k_dense = 0
+    cfg.dense_intermediate_size = 0
+    cfg.shared_expert_size = 0
+    rs = {"rope_type": "yarn", "factor": 4.0, "mscale": 1.0,
+          "mscale_all_dim": 1.0, "beta_fast": 32, "beta_slow": 1,
+          "original_max_position_embeddings": 64}
+    cfg.rope_scaling = RopeScaling(
+        rope_type="yarn", factor=4.0, mscale=1.0, mscale_all_dim=1.0,
+        beta_fast=32, beta_slow=1,
+        original_max_position_embeddings=64)
+    assert mla.softmax_scale(cfg) > (
+        cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
+    params = mla.init_params(cfg, jax.random.PRNGKey(34),
+                             dtype=jnp.float32)
+    hf = _hf_v3(cfg, params, rope_scaling=rs)
+
+    rng = np.random.default_rng(35)
+    tokens = rng.integers(1, cfg.vocab_size, size=90).tolist()
+    with torch.no_grad():
+        ref = hf(torch.tensor([tokens])).logits[0, -1].numpy()
+    kv = mla.init_kv_cache(cfg, NUM_BLOCKS, BS, dtype=jnp.float32)
+    T = 96                 # > original_max 64: the extrapolated regime
+    padded = np.zeros((T,), np.int32)
+    padded[:len(tokens)] = tokens
+    table = np.zeros((NUM_BLOCKS,), np.int32)
+    table[:T // BS] = np.arange(1, 1 + T // BS)
+    logits, _kv = mla.prefill_forward(
+        params, kv, jnp.asarray(padded), jnp.asarray(table),
+        jnp.asarray(0, jnp.int32), jnp.asarray(len(tokens), jnp.int32),
+        _statics(cfg))
+    np.testing.assert_allclose(np.asarray(logits), ref,
+                               rtol=4e-4, atol=4e-4)
+
+
+def test_deepseek_v3_config_class_defaults():
+    """A minimal re-saved v3 config (to_diff_dict omits class-default
+    keys) must parse to the full V3 geometry, not a dense llama."""
+    parsed = ModelConfig.from_hf_config({"model_type": "deepseek_v3"})
+    assert parsed.kv_lora_rank == 512 and parsed.q_lora_rank == 1536
+    assert parsed.qk_nope_head_dim == 128
+    assert parsed.qk_rope_head_dim == 64 and parsed.v_head_dim == 128
+    assert parsed.num_experts == 256
+    assert parsed.intermediate_size == 2048          # expert F
+    assert parsed.dense_intermediate_size == 18432
+    assert parsed.num_experts_per_tok == 8
+    assert parsed.n_group == 8 and parsed.topk_group == 4
+    assert parsed.first_k_dense == 3
+    assert parsed.routed_scaling == 2.5
+    assert parsed.shared_expert_size == 2048         # 1 shared expert
+    assert parsed.moe_routing == "sigmoid_noaux"
+    assert parsed.moe_norm_topk                      # v3 default TRUE
+
+
+def test_deepseek_v3_checkpoint_roundtrip(tmp_path):
+    """v3 config.json + safetensors (incl. the router bias buffer and
+    an MTP layer at index L that must be SKIPPED) -> from_hf_config +
+    load_llama_params reproduce the params exactly."""
+    import json
+
+    from safetensors.numpy import save_file
+
+    from dynamo_tpu.engine.weights import load_llama_params
+    cfg = _v3_cfg()
+    params = mla.init_params(cfg, jax.random.PRNGKey(36),
+                             dtype=jnp.float32)
+    params["layers.router_bias"] = jax.random.normal(
+        jax.random.PRNGKey(37),
+        params["layers.router_bias"].shape, dtype=jnp.float32)
+    sd = {k: np.ascontiguousarray(v.numpy())
+          for k, v in _to_hf_v3(params, cfg).items()}
+    # MTP head (num_nextn_predict_layers=1): attention-shaped names at
+    # layer index L — the loader must skip them, not stack them
+    L = cfg.num_layers
+    sd[f"model.layers.{L}.self_attn.kv_a_layernorm.weight"] = \
+        np.ones((cfg.kv_lora_rank,), np.float32)
+    sd[f"model.layers.{L}.enorm.weight"] = np.ones((cfg.hidden_size,),
+                                                   np.float32)
+    save_file(sd, str(tmp_path / "model.safetensors"))
+    (tmp_path / "config.json").write_text(json.dumps({
+        "model_type": "deepseek_v3", "vocab_size": cfg.vocab_size,
+        "hidden_size": cfg.hidden_size,
+        "intermediate_size": cfg.dense_intermediate_size,
+        "moe_intermediate_size": cfg.intermediate_size,
+        "num_hidden_layers": cfg.num_layers,
+        "num_attention_heads": cfg.num_heads,
+        "num_key_value_heads": cfg.num_heads,
+        "q_lora_rank": cfg.q_lora_rank,
+        "kv_lora_rank": cfg.kv_lora_rank,
+        "qk_nope_head_dim": cfg.qk_nope_head_dim,
+        "qk_rope_head_dim": cfg.qk_rope_head_dim,
+        "v_head_dim": cfg.v_head_dim,
+        "n_routed_experts": cfg.num_experts,
+        "num_experts_per_tok": cfg.num_experts_per_tok,
+        "n_shared_experts": 1,
+        "first_k_dense_replace": cfg.first_k_dense,
+        "n_group": cfg.n_group, "topk_group": cfg.topk_group,
+        "routed_scaling_factor": cfg.routed_scaling,
+        "norm_topk_prob": True, "num_nextn_predict_layers": 1,
+        "max_position_embeddings": cfg.max_position_embeddings,
+        "rms_norm_eps": cfg.rms_norm_eps,
+        "tie_word_embeddings": False}))
+
+    parsed = ModelConfig.from_model_dir(str(tmp_path))
+    assert parsed.moe_routing == "sigmoid_noaux"
+    assert parsed.moe_norm_topk and parsed.routed_scaling == 2.5
+    assert parsed.shared_expert_size == cfg.intermediate_size
+
+    loaded = load_llama_params(str(tmp_path), parsed, dtype=jnp.float32)
+    assert set(loaded) == set(params)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(loaded[k]),
+                                   np.asarray(params[k]),
+                                   rtol=0, atol=0, err_msg=k)
