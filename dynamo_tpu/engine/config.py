@@ -83,6 +83,11 @@ class ModelConfig:
     # greedy / group_limited_greedy) or "sigmoid_noaux" (deepseek_v3
     # noaux_tc: sigmoid scores + e_score_correction_bias group choice)
     moe_routing: str = "softmax"
+    # deepseek_v3 multi-token-prediction heads: checkpoints carry this
+    # many EXTRA layer indices at model.layers.{num_layers}+ that
+    # generation never runs — the loader skips exactly that many and
+    # still fails loudly on any further excess layer
+    num_nextn_predict_layers: int = 0
     first_k_dense: int = 0
     dense_intermediate_size: int = 0
     routed_scaling: float = 1.0
@@ -312,6 +317,9 @@ class ModelConfig:
             # plain-q_proj layout, hence `or 0`)
             moe_routing=("sigmoid_noaux" if mt == "deepseek_v3"
                          else "softmax"),
+            num_nextn_predict_layers=int(
+                cfg.get("num_nextn_predict_layers", 1) or 0)
+            if mt == "deepseek_v3" else 0,
             q_lora_rank=int(cfg.get("q_lora_rank",
                                     1536 if is_ds else 0) or 0),
             kv_lora_rank=int(cfg.get("kv_lora_rank", 512) or 0)

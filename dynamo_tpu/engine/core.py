@@ -127,10 +127,15 @@ class EngineCore:
         if self.is_mla:
             from .models import mla
             self.model_mod = mla
-            if mesh is not None:
+            if mesh is not None and mesh.shape.get("sp", 1) > 1:
+                # dp/tp/ep mesh axes work through the param/KV pspecs
+                # (parallel/sharding.py: head-sharded projections,
+                # replicated latent pool); the ring-attention prefill is
+                # llama-only (llama.prefill_forward_sp)
                 raise NotImplementedError(
-                    "MLA + mesh sharding is not integrated yet "
-                    "(models/mla.py has no param pspecs or sp prefill)")
+                    "MLA + sequence-parallel (sp > 1) prefill is not "
+                    "integrated yet (ring attention expands k/v per "
+                    "shard; the latent-row form needs its own ring)")
             if engine_cfg.kv_quantization != "none":
                 raise NotImplementedError(
                     "MLA + kv_quantization is not integrated yet (the "

@@ -30,9 +30,11 @@ tested); deepseek_v3's sigmoid-scored noaux_tc routing (bias-corrected
 top-2-sum group selection, renormalized top-k, and the yarn mscale²
 score scale HF applies in DeepseekV3Attention); default AND yarn rope
 (incl. the inferred mscale attention factor); EngineCore serves MLA
-end-to-end through the model dispatch (core.is_mla — single-chip,
-full-precision; mesh/quantization/host-tier combinations refuse
-loudly).
+end-to-end through the model dispatch (core.is_mla), including dp/tp/ep
+meshes (parallel/sharding.py: head-sharded projections, replicated
+latent pool, expert-parallel MoE stacks). Still refusing loudly:
+sp > 1 (ring prefill is llama-only), kv/weight quantization, and the
+host KV tier.
 """
 
 from __future__ import annotations
@@ -53,6 +55,16 @@ Params = Dict[str, jax.Array]
 KVCache = Dict[str, jax.Array]   # {"kv": [L, NTOK, rank + rope]}
 
 NEG_INF = -1e30
+
+
+def get_mscale(scale: float, m: float = 1.0) -> float:
+    """HF yarn_get_mscale — the ONE home for the yarn mscale formula
+    (rope_params' cos/sin attention factor AND softmax_scale's v3 score
+    correction derive from it)."""
+    import math
+    if scale <= 1:
+        return 1.0
+    return 0.1 * m * math.log(scale) + 1.0
 
 
 # ---------------------------------------------------------------------------
@@ -84,12 +96,6 @@ def rope_params(cfg: ModelConfig):
             f"MLA rope_scaling type {rs.rope_type!r} is not implemented "
             f"(yarn is; remove rope_scaling for base-context models)")
     factor = rs.factor
-
-    def get_mscale(scale, m=1.0):
-        if scale <= 1:
-            return 1.0
-        return 0.1 * m * math.log(scale) + 1.0
-
     if rs.attention_factor:
         # HF priority: an explicit attention_factor overrides inference
         att = rs.attention_factor
@@ -122,12 +128,11 @@ def softmax_scale(cfg: ModelConfig) -> float:
     mscale(factor, mscale_all_dim)² (HF DeepseekV3Attention.__init__ —
     v2 applies its attention factor through cos/sin instead, so the two
     corrections never double-apply)."""
-    import math
     s = (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
     rs = cfg.rope_scaling
     if (cfg.model_type == "deepseek_v3" and rs is not None
-            and rs.mscale_all_dim and rs.factor > 1):
-        m = 0.1 * rs.mscale_all_dim * math.log(rs.factor) + 1.0
+            and rs.mscale_all_dim):
+        m = get_mscale(rs.factor, rs.mscale_all_dim)
         s *= m * m
     return s
 

@@ -148,8 +148,10 @@ def load_params_auto(model_dir: str, cfg: Optional[ModelConfig] = None,
     """THE loader entry point: streams shards straight from disk when a
     mesh is given (host peak = one shard — the 70B path), replicated
     otherwise. MoE and MLA checkpoints use the replicated reader even
-    with a mesh (EngineCore's shard_params re-places them; MLA refuses
-    meshes at the engine)."""
+    with a mesh (EngineCore's shard_params re-places them) — so a
+    sharded MLA/MoE load stages the FULL model in host RAM; shard-
+    streaming those layouts is the open limit, not the engine (which
+    serves MLA over dp/tp/ep meshes)."""
     cfg = cfg or ModelConfig.from_model_dir(model_dir)
     if mesh is not None and cfg.num_experts == 0 and cfg.kv_lora_rank == 0:
         return load_llama_params_sharded(model_dir, mesh, cfg, dtype=dtype)
@@ -189,15 +191,17 @@ def load_llama_params(model_dir: str, cfg: Optional[ModelConfig] = None,
             rest = name[len("model.layers."):]
             idx_str, sub = rest.split(".", 1)
             if int(idx_str) >= L:
-                if cfg.model_type == "deepseek_v3":
-                    # MTP heads (num_nextn_predict_layers) live at
-                    # model.layers.{L}+ — generation never runs them
-                    # (HF skips them too); their attention-shaped names
-                    # must not land in the decoder stacks
+                if int(idx_str) < L + cfg.num_nextn_predict_layers:
+                    # deepseek_v3 MTP heads live at model.layers.{L}+ —
+                    # generation never runs them (HF skips them too);
+                    # their attention-shaped names must not land in the
+                    # decoder stacks. The bound keeps the mismatch
+                    # guard: only the declared MTP indices skip
                     continue
                 raise ValueError(
                     f"checkpoint tensor {name} is beyond the config's "
-                    f"{L} layers — config.json/checkpoint mismatch")
+                    f"{L} layers (+{cfg.num_nextn_predict_layers} MTP) "
+                    f"— config.json/checkpoint mismatch")
             expert_prefix = next(
                 (p for p in _EXPERT_PREFIXES if sub.startswith(p)), None)
             if expert_prefix is not None:
@@ -288,8 +292,9 @@ def load_llama_params_sharded(model_dir: str, mesh,
         raise RuntimeError("safetensors not available")
     if (cfg or ModelConfig.from_model_dir(model_dir)).kv_lora_rank > 0:
         raise NotImplementedError(
-            "MLA checkpoints use the replicated loader (the engine "
-            "refuses meshes for MLA; route through load_params_auto)")
+            "shard-streaming MLA checkpoints is not implemented — route "
+            "through load_params_auto (replicated read + shard_params; "
+            "host peak = full model)")
     import contextlib
 
     from jax.sharding import NamedSharding, PartitionSpec as P

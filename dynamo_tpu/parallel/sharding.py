@@ -63,6 +63,27 @@ def param_pspecs(cfg: ModelConfig) -> Dict[str, P]:
     # materialized pre-transposed head (engine/quant.py) with the same
     # [D, V] orientation — the spec is harmless when the key is absent
     specs["lm_head"] = P(None, "tp")
+    if cfg.kv_lora_rank > 0:
+        # MLA (models/mla.py): heads shard over tp on the LANE axis of
+        # the head-structured projections — wq/wq_b [L, ., H*(dn+dr)],
+        # wkv_b [L, rank, H*(dn+dv)] — and wo stays row-parallel. The
+        # latent path (wkv_a/kv_norm, wq_a/q_a_norm) produces the
+        # MQA-shaped rows EVERY head expands from: replicated, like the
+        # latent pool itself (kv_pspecs "kv")
+        specs.update({
+            "layers.wq_a": P(), "layers.q_a_norm": P(),
+            "layers.wq_b": P(None, None, "tp"),
+            "layers.wkv_a": P(), "layers.kv_norm": P(),
+            "layers.wkv_b": P(None, None, "tp"),
+        })
+        if cfg.num_experts > 0 and cfg.first_k_dense > 0:
+            # deepseek hybrid: the dense-prefix stacks take the plain
+            # dense-MLP tp layout
+            specs.update({
+                "layers.dense_gate": P(None, None, "tp"),
+                "layers.dense_up": P(None, None, "tp"),
+                "layers.dense_down": P(None, "tp", None),
+            })
     if cfg.attention_bias:
         # biases follow their projection's column sharding
         specs.update({"layers.bq": P(None, "tp"),
@@ -96,6 +117,10 @@ def kv_pspecs() -> Dict[str, P]:
     # its own IN-ROW scale group (llama.init_kv_cache kv_shards), so the
     # same lane-axis sharding gives every shard whole (values, scales)
     # sections.
+    # llama-family pools only — MLA latent pools ({"kv"}) take the
+    # replicated fallback in shard_kv; adding the key HERE would break
+    # callers that pass this dict as an explicit in_shardings tree for
+    # {"k","v"} pools
     return {"k": P(None, None, "tp"), "v": P(None, None, "tp")}
 
 
@@ -178,8 +203,12 @@ def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
 
 
 def shard_kv(kv: dict, mesh: Mesh) -> dict:
+    # MLA latent pools ("kv", [L, NTOK, rank+rope]) REPLICATE: the
+    # latent row is the MQA-shaped read shared by every head — no head
+    # structure on the lane axis to split — and each tp rank scatters
+    # identical rows (wkv_a is replicated)
     specs = kv_pspecs()
-    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+    return {k: jax.device_put(v, NamedSharding(mesh, specs.get(k, P())))
             for k, v in kv.items()}
 
 
