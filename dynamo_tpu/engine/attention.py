@@ -75,6 +75,60 @@ def quantize_kv_rows(x: jax.Array, groups: int = 1) -> jax.Array:
     return rows.reshape(N, groups * (C // groups + KV_SCALE_LANES))
 
 
+def quantize_kv_rows_sections(x: jax.Array,
+                              sections: tuple) -> jax.Array:
+    """Per-row int8 with one independent (e, m) scale pair per UNEQUAL
+    section, all sharing the single KV_SCALE_LANES pad: x [N, C] ->
+    int8 [N, C + KV_SCALE_LANES], section i's scale at pad lanes
+    (2i, 2i+1). Built for MLA latent rows, where the RMSNorm-bounded
+    c_kv (rank lanes) and the UNNORMALIZED post-rope k_pe (rope lanes)
+    can differ in magnitude by 10-50x on real checkpoints — a shared
+    absmax would leave the smaller section a handful of int8 levels.
+    sections=(C,) is bit-identical to quantize_kv_rows(x). The MLA pool
+    never lane-shards (it replicates under tp), so no per-shard section
+    alignment applies."""
+    N, C = x.shape
+    assert sum(sections) == C and 2 * len(sections) <= KV_SCALE_LANES
+    xf = x.astype(jnp.float32)
+    pad = jnp.zeros((N, KV_SCALE_LANES), jnp.int8)
+    qs = []
+    off = 0
+    for i, w in enumerate(sections):
+        seg = xf[:, off:off + w]
+        off += w
+        absmax = jnp.maximum(jnp.max(jnp.abs(seg), axis=1), 1e-30)
+        target = absmax / 127.0
+        e = jnp.floor(jnp.log2(target))
+        m = jnp.clip(jnp.round((target / jnp.exp2(e) - 1.0) * 256.0),
+                     0, 255)
+        scale = jnp.exp2(e) * (1.0 + m / 256.0)
+        qs.append(jnp.clip(jnp.round(seg / scale[:, None]),
+                           -127, 127).astype(jnp.int8))
+        pad = pad.at[:, 2 * i].set(
+            jnp.clip(e, -127, 127).astype(jnp.int8))
+        pad = pad.at[:, 2 * i + 1].set(m.astype(jnp.uint8).astype(jnp.int8))
+    return jnp.concatenate(qs + [pad], axis=1)
+
+
+def dequant_kv_rows_sections(rows: jax.Array, sections: tuple,
+                             out_dtype) -> jax.Array:
+    """Inverse of quantize_kv_rows_sections for gathered rows
+    [..., sum(sections) + KV_SCALE_LANES]."""
+    C = sum(sections)
+    pad = rows[..., C:]
+    outs = []
+    off = 0
+    for i, w in enumerate(sections):
+        e = pad[..., 2 * i].astype(jnp.float32)
+        m = (pad[..., 2 * i + 1].astype(jnp.int32) & 0xFF).astype(
+            jnp.float32)
+        scale = jnp.exp2(e) * (1.0 + m / 256.0)
+        outs.append(rows[..., off:off + w].astype(jnp.float32)
+                    * scale[..., None])
+        off += w
+    return jnp.concatenate(outs, axis=-1).astype(out_dtype)
+
+
 def kv_row_groups(lanes: int, C: int) -> int:
     """Scale-group count of an int8 pool row: lanes = C + g·SCALE_LANES
     (g = the tp shard count the pool was built for; llama.init_kv_cache

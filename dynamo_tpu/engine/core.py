@@ -136,13 +136,15 @@ class EngineCore:
                     "MLA + sequence-parallel (sp > 1) prefill is not "
                     "integrated yet (ring attention expands k/v per "
                     "shard; the latent-row form needs its own ring)")
-            if engine_cfg.kv_quantization != "none":
+            if engine_cfg.quantization.startswith("int4"):
+                # int8 works (quant.py _LAYER_MATMULS carries the MLA
+                # names; wkv_b deliberately stays full precision for the
+                # absorbed einsums); the grouped-int4 paths (Pallas
+                # kernel lane alignment, hybrid-scan slicing of packed
+                # rows) are unvalidated for this family
                 raise NotImplementedError(
-                    "MLA + kv_quantization is not integrated yet (the "
-                    "latent rows carry no in-row scale encoding)")
-            if engine_cfg.quantization != "none":
-                raise NotImplementedError(
-                    "MLA + weight quantization is not integrated yet")
+                    "MLA + int4 weight quantization is not integrated "
+                    "yet (int8 is)")
             if engine_cfg.host_kv_blocks > 0:
                 raise NotImplementedError(
                     "MLA + the host KV tier is not integrated yet")
@@ -184,7 +186,10 @@ class EngineCore:
                 params, include_embed=qembed, bits=qbits)
         self.params = params
         kv_shards = 1
-        if mesh is not None and engine_cfg.kv_quantization != "none":
+        if (mesh is not None and engine_cfg.kv_quantization != "none"
+                and not self.is_mla):
+            # llama pools only: the MLA latent pool replicates under tp
+            # (no per-shard scale sections; mla.init_kv_cache)
             # int8 + tensor parallelism: the pool row carries one
             # (values, scales) section per tp shard so the lane-axis tp
             # sharding never splits a scale group (attention.py
@@ -200,7 +205,8 @@ class EngineCore:
         if self.is_mla:
             self.kv = self.model_mod.init_kv_cache(
                 model_cfg, engine_cfg.num_kv_blocks,
-                engine_cfg.kv_block_size, dtype=param_dtype)
+                engine_cfg.kv_block_size, dtype=param_dtype,
+                quantization=engine_cfg.kv_quantization)
         else:
             self.kv = llama.init_kv_cache(
                 model_cfg, engine_cfg.num_kv_blocks,

@@ -32,9 +32,12 @@ score scale HF applies in DeepseekV3Attention); default AND yarn rope
 (incl. the inferred mscale attention factor); EngineCore serves MLA
 end-to-end through the model dispatch (core.is_mla), including dp/tp/ep
 meshes (parallel/sharding.py: head-sharded projections, replicated
-latent pool, expert-parallel MoE stacks). Still refusing loudly:
-sp > 1 (ring prefill is llama-only), kv/weight quantization, and the
-host KV tier.
+latent pool, expert-parallel MoE stacks), int8 latent-KV pools
+(init_kv_cache quantization="int8": in-row scales, one pair per
+c_kv/k_pe section), and int8 weights (quant._LAYER_MATMULS; wkv_b
+stays full precision for the absorbed einsums). Still refusing loudly:
+sp > 1 (ring prefill is llama-only), int4 weights, and the host KV
+tier.
 """
 
 from __future__ import annotations
@@ -45,6 +48,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..attention import (dequant_kv_rows_sections,
+                         quantize_kv_rows_sections)
 from ..config import ModelConfig
 from ..quant import mm
 from .llama import (ModelStatics, _embed, _layer_stack, _logits,
@@ -238,8 +243,24 @@ def init_params(cfg: ModelConfig, key: jax.Array,
 
 
 def init_kv_cache(cfg: ModelConfig, num_blocks: int,
-                  block_size: int, dtype=jnp.bfloat16) -> KVCache:
+                  block_size: int, dtype=jnp.bfloat16,
+                  quantization: str = "none") -> KVCache:
+    """quantization="int8": the latent row quantizes with one in-row
+    (e, m) scale pair PER c_kv/k_pe section
+    (attention.quantize_kv_rows_sections — both pairs share the single
+    128-lane pad, so the row width matches the llama encoding). Unlike
+    llama pools there is never a per-tp-shard section: the latent pool
+    replicates under tp (parallel/sharding.shard_kv), so every rank
+    reads whole rows."""
     C = cfg.kv_lora_rank + cfg.qk_rope_head_dim
+    if quantization == "int8":
+        from ..attention import KV_SCALE_LANES
+        return {"kv": jnp.zeros(
+            (cfg.num_layers, num_blocks * block_size,
+             C + KV_SCALE_LANES), dtype=jnp.int8)}
+    if quantization != "none":
+        raise ValueError(f"unknown kv quantization {quantization!r} "
+                         f"(none|int8)")
     return {"kv": jnp.zeros(
         (cfg.num_layers, num_blocks * block_size, C), dtype=dtype)}
 
@@ -348,6 +369,8 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
     _ATTN = ("ln1", "ln2", "wq", "wq_a", "q_a_norm", "wq_b", "wkv_a",
              "kv_norm", "wkv_b", "wo")
 
+    quantized = kv["kv"].dtype == jnp.int8
+
     def make_layer(mlp_fn):
         def layer(carry, xs):
             h, pool = carry
@@ -356,8 +379,22 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
             q_nope, q_pe = _q_proj(lp, hn, cfg)
             q_pe = apply_rope_interleaved(q_pe, positions, inv, att)
             rows = _latent_rows(lp, hn, positions, cfg)
-            pool = pool.at[li, slots, :].set(rows.astype(pool.dtype),
-                                             mode="drop")
+            if quantized:
+                # in-row (e, m) scales, one pair PER SECTION — the
+                # RMSNormed c_kv and the unnormalized post-rope k_pe
+                # must not share an absmax (10-50x magnitude skew on
+                # real checkpoints would crush the latent's
+                # resolution). Every reader (incl. this step's own rows
+                # — both attn paths gather from the pool) dequantizes
+                # the same encoding, so the current token sees the same
+                # quantized latent later steps do
+                pool = pool.at[li, slots, :].set(
+                    quantize_kv_rows_sections(
+                        rows, (cfg.kv_lora_rank, cfg.qk_rope_head_dim)),
+                    mode="drop")
+            else:
+                pool = pool.at[li, slots, :].set(rows.astype(pool.dtype),
+                                                 mode="drop")
             attn = attn_fn(q_nope, q_pe, rows,
                            pool.reshape(L * NTOK, pool.shape[2]), lp, li)
             h = h + mm(attn, lp["wo"])
@@ -439,6 +476,9 @@ def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
                + li * NTOK)
         S = idx.shape[0]
         rows = jnp.take(kv_flat, idx, axis=0)            # [S, rank+dr]
+        if rows.dtype == jnp.int8:
+            rows = dequant_kv_rows_sections(rows, (rank, dr),
+                                            jnp.float32)
         c, k_pe = rows[..., :rank], rows[..., rank:]
         w_k, w_v = _split_wkv_b(lp, cfg)
         # expand: k_nope [H, S, dn], v [H, S, dv]
@@ -495,6 +535,9 @@ def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
         idx = flat_token_indices(block_tables + li * num_blocks, bsz)
         T = idx.shape[1]
         rows = jnp.take(kv_flat, idx, axis=0)            # [B, T, rank+dr]
+        if rows.dtype == jnp.int8:
+            rows = dequant_kv_rows_sections(rows, (rank, dr),
+                                            jnp.float32)
         c = rows[..., :rank].astype(jnp.float32)
         k_pe = rows[..., rank:].astype(jnp.float32)
         w_k, w_v = _split_wkv_b(lp, cfg)

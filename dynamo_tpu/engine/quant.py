@@ -90,6 +90,16 @@ class QuantizedArray:
     def dtype(self):           # the *logical* dtype callers compute in
         return self.scale.dtype
 
+    def __getitem__(self, idx) -> "QuantizedArray":
+        """LEADING-axis (layer) indexing only: q and every scale layout
+        share their leading dims (per-channel [L, 1, F], grouped
+        [L, D/g, F], expert [L, E, 1, F]), so the same index applies to
+        both. Used by the deepseek hybrid scans, which split stacked
+        weights into a dense prefix and a MoE suffix."""
+        return QuantizedArray(self.q[idx], self.scale[idx],
+                              group=self.group, packed4=self.packed4,
+                              no_kernel=self.no_kernel)
+
     def unpacked(self) -> "QuantizedArray":
         if not self.packed4:
             return self
@@ -282,7 +292,15 @@ def qeinsum(spec: str, a: jax.Array, w) -> jax.Array:
 _LAYER_MATMULS = ("wq", "wk", "wv", "wo", "gate", "up", "down",
                   # qwen2_moe shared expert (dense swiglu; the sigmoid
                   # sh_router stays full precision like the MoE router)
-                  "sh_gate", "sh_up", "sh_down")
+                  "sh_gate", "sh_up", "sh_down",
+                  # MLA (models/mla.py): the q-LoRA pair, the latent
+                  # down-projection, and the deepseek hybrid dense
+                  # prefix — all consumed through mm(). wkv_b stays
+                  # full precision DELIBERATELY: the absorbed decode
+                  # contracts it raw in einsums (_split_wkv_b), and its
+                  # [rank, H*(dn+dv)] bytes are small
+                  "wq_a", "wq_b", "wkv_a",
+                  "dense_gate", "dense_up", "dense_down")
 # MoE expert tensors [L, E, D, F] → per (L, E, out-channel) scales. For
 # mixtral-class models the experts ARE the weights, so leaving them bf16
 # would forfeit the whole int8 HBM-read win; the router stays full
@@ -376,6 +394,9 @@ def init_params_quantized(cfg, key: jax.Array, dtype=jnp.bfloat16,
     to one-step int8 rounding ties (jit fusion may contract the
     round(w/scale) arithmetic differently than the eager two-pass)."""
     from .models.llama import init_one_param, param_shapes
+    if cfg.kv_lora_rank > 0:
+        # MLA geometry: same init_one_param, different shape map
+        from .models.mla import param_shapes
 
     shapes = param_shapes(cfg)
     tied = "lm_head" not in shapes

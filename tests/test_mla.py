@@ -334,8 +334,7 @@ def test_mla_engine_unsupported_combinations_refuse():
     cfg = _cfg()
     base = dict(max_model_len=128, kv_block_size=8, num_kv_blocks=64,
                 max_num_seqs=2, prefill_buckets=[32])
-    for over, pat in ((dict(kv_quantization="int8"), "kv_quantization"),
-                      (dict(quantization="int8"), "weight quantization"),
+    for over, pat in ((dict(quantization="int4"), "int4"),
                       (dict(host_kv_blocks=8), "host KV tier")):
         with pytest.raises(NotImplementedError, match=pat):
             EngineCore(cfg, EngineConfig(**base, **over),
@@ -402,6 +401,216 @@ async def test_mla_engine_serves_sharded():
     finally:
         await core.stop()
     assert got == want
+
+
+def test_mla_int8_kv_sectioned_scale_isolates_magnitude_skew():
+    """THE scenario the sectioned encoding exists for: k_pe is an
+    UNNORMALIZED projection output while c_kv is RMSNormed, so real
+    checkpoints can carry 10-50x magnitude skew between the sections.
+    With a 20x-hot k_pe, the c_kv reconstruction error must stay at
+    its OWN absmax resolution — a shared absmax would leave it ~6
+    effective levels (the review finding this test pins)."""
+    from dynamo_tpu.engine.attention import (KV_SCALE_LANES,
+                                             dequant_kv_rows_sections,
+                                             quantize_kv_rows_sections)
+    rng = np.random.default_rng(80)
+    rank, dr = 16, 8
+    c = rng.standard_normal((64, rank)).astype(np.float32)
+    k_pe = rng.standard_normal((64, dr)).astype(np.float32) * 20.0
+    x = jnp.asarray(np.concatenate([c, k_pe], axis=1))
+    rows = quantize_kv_rows_sections(x, (rank, dr))
+    assert rows.shape == (64, rank + dr + KV_SCALE_LANES)
+    deq = np.asarray(dequant_kv_rows_sections(rows, (rank, dr),
+                                              jnp.float32))
+    # each section's error bounded by ITS absmax/127 half-step
+    c_scale = np.abs(c).max(axis=1) / 127.0
+    pe_scale = np.abs(k_pe).max(axis=1) / 127.0
+    assert (np.abs(deq[:, :rank] - c)
+            <= c_scale[:, None] * 0.51 + 1e-7).all()
+    assert (np.abs(deq[:, rank:] - k_pe)
+            <= pe_scale[:, None] * 0.51 + 1e-6).all()
+    # single-section degenerates to the llama encoding exactly
+    from dynamo_tpu.engine.attention import quantize_kv_rows
+    one = quantize_kv_rows_sections(x, (rank + dr,))
+    np.testing.assert_array_equal(np.asarray(one),
+                                  np.asarray(quantize_kv_rows(x)))
+
+
+def test_mla_int8_kv_teacher_forced_accuracy_gate():
+    """int8 latent rows (in-row (e, m) scales, one pair per c_kv/k_pe
+    section — the pool never lane-shards) vs the f32 pool,
+    TEACHER-FORCED per the established gate (test_kv_quant.py
+    rationale: free-running greedy compounds one near-tie flip into
+    total divergence on random tiny weights). The latent row is the
+    ONLY cache MLA has, so this also gates the absorbed-decode read
+    path."""
+    from dynamo_tpu.engine.attention import KV_SCALE_LANES
+    cfg = _cfg()
+    rng = np.random.default_rng(60)
+    params = mla.init_params(cfg, jax.random.PRNGKey(61),
+                             dtype=jnp.float32)
+    statics = _statics(cfg)
+    T, steps = 32, 24
+    nblocks = (T + steps + BS - 1) // BS + 1
+    kv_bf = mla.init_kv_cache(cfg, nblocks + 1, BS, dtype=jnp.float32)
+    kv_q8 = mla.init_kv_cache(cfg, nblocks + 1, BS, quantization="int8")
+    C = cfg.kv_lora_rank + cfg.qk_rope_head_dim
+    assert kv_q8["kv"].dtype == jnp.int8
+    assert kv_q8["kv"].shape[-1] == C + KV_SCALE_LANES
+    prompt = jnp.asarray(rng.integers(2, cfg.vocab_size, size=(T,)),
+                         jnp.int32)
+    table = jnp.asarray(np.arange(1, nblocks + 1), jnp.int32)
+    lg_bf, kv_bf = mla.prefill_forward(params, kv_bf, prompt, table,
+                                       jnp.asarray(0), jnp.asarray(T),
+                                       statics)
+    lg_q8, kv_q8 = mla.prefill_forward(params, kv_q8, prompt, table,
+                                       jnp.asarray(0), jnp.asarray(T),
+                                       statics)
+    match = 0
+    max_rel = 0.0
+    tok = int(jnp.argmax(lg_bf))
+    for s in range(steps):
+        pos = jnp.asarray([T + s], jnp.int32)
+        toks = jnp.asarray([tok], jnp.int32)
+        tables = table[None, :]
+        out_bf, kv_bf = mla.decode_forward(params, kv_bf, toks, pos,
+                                           tables, statics)
+        out_q8, kv_q8 = mla.decode_forward(params, kv_q8, toks, pos,
+                                           tables, statics)
+        a, b = np.asarray(out_bf[0]), np.asarray(out_q8[0])
+        match += int(a.argmax() == b.argmax())
+        max_rel = max(max_rel, float(np.abs(a - b).max() / a.std()))
+        tok = int(a.argmax())               # teacher-forced from f32
+    rate = match / steps
+    assert rate >= 0.9, f"teacher-forced argmax match {rate:.2f}"
+    assert max_rel < 0.15, f"logit error {max_rel:.3f} of logit spread"
+
+
+@pytest.mark.asyncio
+async def test_mla_int8_kv_serving_end_to_end():
+    """EngineCore serves MLA on an int8 latent pool — the refusal is
+    gone; streams finish and prefix reuse still engages through the
+    quantized rows (block hashing is token-keyed, format-agnostic)."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import EngineCore
+    cfg = _cfg()
+    core = EngineCore(
+        cfg,
+        EngineConfig(max_model_len=128, kv_block_size=8, num_kv_blocks=64,
+                     max_num_seqs=2, prefill_buckets=[32, 64],
+                     decode_steps_per_dispatch=4, kv_quantization="int8"),
+        attn_impl="xla", param_dtype=jnp.float32)
+    assert core.kv["kv"].dtype == jnp.int8
+    assert core.wire_kv_heads == 1
+    try:
+        toks1 = await _greedy_tokens(core, "q1", list(range(2, 40)))
+        assert len(toks1) == 8
+        toks2 = await _greedy_tokens(core, "q2", list(range(2, 40)))
+        assert toks2 == toks1              # deterministic greedy
+    finally:
+        await core.stop()
+
+
+def test_mla_int8_weights_teacher_forced_accuracy_gate():
+    """int8 weights through the MLA forward (quant._LAYER_MATMULS now
+    carries wq_a/wq_b/wkv_a and the deepseek dense prefix; wkv_b stays
+    full precision for the absorbed einsums), two gates:
+
+    1. PLUMBING (tight): the fused-dequant forward == the same forward
+       run on explicitly dequantized weights, to float tolerance — a
+       wrong scale axis or a missed leaf fails this at any geometry.
+    2. ACCURACY: prefill logit cosine > 0.998 and per-step decode
+       cosine > 0.99 vs the f32 tree, teacher-forced. Looser than
+       llama's 0.999 (test_quant.py) by design: the q-LoRA path chains
+       wq_a->wq_b (two quantized matmuls), wkv_a squeezes through the
+       rank-16 latent bottleneck, and — decode-specific — the two runs
+       CACHE different latent rows (each written by its own weights),
+       so the pools themselves diverge step by step on top of the
+       per-step rounding. A plumbing failure sits far below 0.99;
+       gate 1 pins exactness. The hybrid MoE path (incl.
+       QuantizedArray slicing in the split scans) is served end-to-end
+       by the next test."""
+    from dynamo_tpu.engine.quant import QuantizedArray, quantize_params
+    cfg = _cfg(q_lora=12)                  # exercise wq_a/wq_b quant
+    rng = np.random.default_rng(70)
+    params = mla.init_params(cfg, jax.random.PRNGKey(71),
+                             dtype=jnp.float32)
+    qparams = quantize_params(dict(params))
+    assert isinstance(qparams["layers.wq_b"], QuantizedArray)
+    assert not isinstance(qparams["layers.wkv_b"], QuantizedArray)
+    statics = _statics(cfg)
+    T, steps = 32, 24
+    nblocks = (T + steps + BS - 1) // BS + 1
+    kv_bf = mla.init_kv_cache(cfg, nblocks + 1, BS, dtype=jnp.float32)
+    kv_q = mla.init_kv_cache(cfg, nblocks + 1, BS, dtype=jnp.float32)
+    prompt = jnp.asarray(rng.integers(2, cfg.vocab_size, size=(T,)),
+                         jnp.int32)
+    table = jnp.asarray(np.arange(1, nblocks + 1), jnp.int32)
+    def cos(a, b):
+        return float(np.dot(a, b)
+                     / (np.linalg.norm(a) * np.linalg.norm(b)))
+
+    # gate 1: fused dequant == explicit dequant (plumbing)
+    deq = {k: (v.dequantize(jnp.float32)
+               if isinstance(v, QuantizedArray) else v)
+           for k, v in qparams.items()}
+    kv_a = mla.init_kv_cache(cfg, nblocks + 1, BS, dtype=jnp.float32)
+    kv_b = mla.init_kv_cache(cfg, nblocks + 1, BS, dtype=jnp.float32)
+    lg_fused, _ = mla.prefill_forward(qparams, kv_a, prompt, table,
+                                      jnp.asarray(0), jnp.asarray(T),
+                                      statics)
+    lg_deq, _ = mla.prefill_forward(deq, kv_b, prompt, table,
+                                    jnp.asarray(0), jnp.asarray(T),
+                                    statics)
+    np.testing.assert_allclose(np.asarray(lg_fused), np.asarray(lg_deq),
+                               rtol=2e-4, atol=2e-4)
+
+    # gate 2: accuracy vs f32, teacher-forced
+    lg_bf, kv_bf = mla.prefill_forward(params, kv_bf, prompt, table,
+                                       jnp.asarray(0), jnp.asarray(T),
+                                       statics)
+    lg_q, kv_q = mla.prefill_forward(qparams, kv_q, prompt, table,
+                                     jnp.asarray(0), jnp.asarray(T),
+                                     statics)
+    assert cos(np.asarray(lg_bf), np.asarray(lg_q)) > 0.998
+    tok = int(jnp.argmax(lg_bf))
+    for s in range(steps):
+        pos = jnp.asarray([T + s], jnp.int32)
+        toks = jnp.asarray([tok], jnp.int32)
+        tables = table[None, :]
+        out_bf, kv_bf = mla.decode_forward(params, kv_bf, toks, pos,
+                                           tables, statics)
+        out_q, kv_q = mla.decode_forward(qparams, kv_q, toks, pos,
+                                         tables, statics)
+        c = cos(np.asarray(out_bf[0]), np.asarray(out_q[0]))
+        assert c > 0.99, f"decode step {s}: cos {c:.5f}"
+        tok = int(np.asarray(out_bf[0]).argmax())
+
+
+@pytest.mark.asyncio
+async def test_mla_int8_weights_serving_end_to_end():
+    """EngineCore serves MLA with quantization="int8" (streaming
+    init->quantize path dispatches to mla.param_shapes) — and together
+    with an int8 latent pool: the full low-precision serving stack."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.engine.quant import QuantizedArray
+    cfg = _moe_cfg(n_group=2, topk_group=1, scaling=2.5)
+    core = EngineCore(
+        cfg,
+        EngineConfig(max_model_len=128, kv_block_size=8, num_kv_blocks=64,
+                     max_num_seqs=2, prefill_buckets=[32, 64],
+                     decode_steps_per_dispatch=4, quantization="int8",
+                     kv_quantization="int8"),
+        attn_impl="xla", param_dtype=jnp.float32)
+    assert isinstance(core.params["layers.wq"], QuantizedArray)
+    assert core.kv["kv"].dtype == jnp.int8
+    try:
+        toks = await _greedy_tokens(core, "qw", list(range(2, 40)))
+        assert len(toks) == 8
+        assert all(0 <= t < cfg.vocab_size for t in toks)
+    finally:
+        await core.stop()
 
 
 def _moe_cfg(n_group=0, topk_group=0, scaling=1.0) -> ModelConfig:
