@@ -45,6 +45,24 @@ def kv_value_lanes(k_cache: jax.Array) -> int:
     return lanes - KV_SCALE_LANES if k_cache.dtype == jnp.int8 else lanes
 
 
+def _encode_scale(absmax: jax.Array):
+    """absmax -> (e int8-ready, m 0..255, scale f32): scale =
+    2^e·(1+m/256) ≈ absmax/127 (within 2^-9 relative). THE one home of
+    the (e, m) encode — both row writers call it."""
+    target = jnp.maximum(absmax, 1e-30) / 127.0
+    e = jnp.floor(jnp.log2(target))
+    m = jnp.clip(jnp.round((target / jnp.exp2(e) - 1.0) * 256.0), 0, 255)
+    return e, m, jnp.exp2(e) * (1.0 + m / 256.0)
+
+
+def _decode_scale(e_lane: jax.Array, m_lane: jax.Array) -> jax.Array:
+    """Inverse of _encode_scale from the stored int8 lanes (m is stored
+    uint8-wrapped; mask with & 0xFF). THE one home of the decode."""
+    e = e_lane.astype(jnp.float32)
+    m = (m_lane.astype(jnp.int32) & 0xFF).astype(jnp.float32)
+    return jnp.exp2(e) * (1.0 + m / 256.0)
+
+
 def quantize_kv_rows(x: jax.Array, groups: int = 1) -> jax.Array:
     """Per-row int8 with in-row (e, m) scale lanes: x [N, C] ->
     int8 [N, C + KV_SCALE_LANES]. scale = 2^e·(1+m/256) ≈ absmax/127
@@ -60,11 +78,7 @@ def quantize_kv_rows(x: jax.Array, groups: int = 1) -> jax.Array:
     bit-identical to the ungrouped encoding."""
     N, C = x.shape
     xf = x.astype(jnp.float32).reshape(N, groups, C // groups)
-    absmax = jnp.maximum(jnp.max(jnp.abs(xf), axis=2), 1e-30)
-    target = absmax / 127.0
-    e = jnp.floor(jnp.log2(target))
-    m = jnp.clip(jnp.round((target / jnp.exp2(e) - 1.0) * 256.0), 0, 255)
-    scale = jnp.exp2(e) * (1.0 + m / 256.0)
+    e, m, scale = _encode_scale(jnp.max(jnp.abs(xf), axis=2))
     q = jnp.clip(jnp.round(xf / scale[:, :, None]),
                  -127, 127).astype(jnp.int8)
     pad = jnp.zeros((N, groups, KV_SCALE_LANES), jnp.int8)
@@ -96,12 +110,7 @@ def quantize_kv_rows_sections(x: jax.Array,
     for i, w in enumerate(sections):
         seg = xf[:, off:off + w]
         off += w
-        absmax = jnp.maximum(jnp.max(jnp.abs(seg), axis=1), 1e-30)
-        target = absmax / 127.0
-        e = jnp.floor(jnp.log2(target))
-        m = jnp.clip(jnp.round((target / jnp.exp2(e) - 1.0) * 256.0),
-                     0, 255)
-        scale = jnp.exp2(e) * (1.0 + m / 256.0)
+        e, m, scale = _encode_scale(jnp.max(jnp.abs(seg), axis=1))
         qs.append(jnp.clip(jnp.round(seg / scale[:, None]),
                            -127, 127).astype(jnp.int8))
         pad = pad.at[:, 2 * i].set(
@@ -119,10 +128,7 @@ def dequant_kv_rows_sections(rows: jax.Array, sections: tuple,
     outs = []
     off = 0
     for i, w in enumerate(sections):
-        e = pad[..., 2 * i].astype(jnp.float32)
-        m = (pad[..., 2 * i + 1].astype(jnp.int32) & 0xFF).astype(
-            jnp.float32)
-        scale = jnp.exp2(e) * (1.0 + m / 256.0)
+        scale = _decode_scale(pad[..., 2 * i], pad[..., 2 * i + 1])
         outs.append(rows[..., off:off + w].astype(jnp.float32)
                     * scale[..., None])
         off += w
@@ -149,9 +155,7 @@ def dequant_kv_rows(rows: jax.Array, C: int, out_dtype) -> jax.Array:
     lead = rows.shape[:-1]
     r = rows.reshape(lead + (g, rows.shape[-1] // g))
     cg = C // g
-    e = r[..., cg].astype(jnp.float32)
-    m = (r[..., cg + 1].astype(jnp.int32) & 0xFF).astype(jnp.float32)
-    scale = jnp.exp2(e) * (1.0 + m / 256.0)
+    scale = _decode_scale(r[..., cg], r[..., cg + 1])
     vals = r[..., :cg].astype(jnp.float32) * scale[..., None]
     return vals.reshape(lead + (C,)).astype(out_dtype)
 

@@ -145,9 +145,6 @@ class EngineCore:
                 raise NotImplementedError(
                     "MLA + int4 weight quantization is not integrated "
                     "yet (int8 is)")
-            if engine_cfg.host_kv_blocks > 0:
-                raise NotImplementedError(
-                    "MLA + the host KV tier is not integrated yet")
         else:
             self.model_mod = llama
         if (model_cfg.sliding_window is not None
@@ -252,7 +249,7 @@ class EngineCore:
             host_pool = make_host_pool(
                 engine_cfg.host_kv_blocks, model_cfg,
                 engine_cfg.kv_block_size, engine_cfg.kv_quantization,
-                int(self.kv["k"].shape[-1]), param_dtype)
+                int(next(iter(self.kv.values())).shape[-1]), param_dtype)
         self.kv_manager = KvBlockManager(
             engine_cfg.num_kv_blocks, engine_cfg.kv_block_size,
             enable_reuse=engine_cfg.enable_prefix_reuse,

@@ -34,10 +34,10 @@ end-to-end through the model dispatch (core.is_mla), including dp/tp/ep
 meshes (parallel/sharding.py: head-sharded projections, replicated
 latent pool, expert-parallel MoE stacks), int8 latent-KV pools
 (init_kv_cache quantization="int8": in-row scales, one pair per
-c_kv/k_pe section), and int8 weights (quant._LAYER_MATMULS; wkv_b
-stays full precision for the absorbed einsums). Still refusing loudly:
-sp > 1 (ring prefill is llama-only), int4 weights, and the host KV
-tier.
+c_kv/k_pe section), int8 weights (quant._LAYER_MATMULS; wkv_b stays
+full precision for the absorbed einsums), and the host KV tier (latent
+rows ship whole as one opaque wire head — llm/kv/offload.py). Still
+refusing loudly: sp > 1 (ring prefill is llama-only) and int4 weights.
 """
 
 from __future__ import annotations

@@ -99,7 +99,8 @@ def exec_kv_store_event(kv, ev: dict, pool, block_size: int) -> None:
     values = gather_blocks_to_host(kv, ids, block_size, pool.num_kv_heads)
     for i, (h, hslot, evicted, _bid) in enumerate(ev["items"]):
         pool.apply_store(h, hslot, evicted,
-                         values["k"][:, :, i], values["v"][:, :, i])
+                         {key: arr[:, :, i]
+                          for key, arr in values.items()})
 
 
 def exec_host_restore_event(kv, ev: dict, pool, block_size: int):

@@ -99,45 +99,53 @@ class HostKvPool:
         return slot, evicted
 
     def store(self, seq_hashes: Sequence[int], values: dict) -> list:
-        """Write stacked blocks ({"k": [L, H, n, bs, D]}) under their hashes.
-        Returns the literal placement decisions ``[(hash, slot,
-        evicted_hash | None)]`` — len(result) blocks were stored (capacity
-        may stop early). Multihost follower mirrors replay these decisions
+        """Write stacked blocks (e.g. {"k": [L, H, n, bs, D], "v": …};
+        MLA latent pools ship one "kv" entry) under their hashes — the
+        arena mirrors whatever key set the device pool has. Returns the
+        literal placement decisions ``[(hash, slot, evicted_hash |
+        None)]`` — len(result) blocks were stored (capacity may stop
+        early). Multihost follower mirrors replay these decisions
         verbatim instead of re-running the LRU policy (apply_store)."""
         decisions = []
         for i, h in enumerate(seq_hashes):
             slot, evicted = self._slot_for(h)
             if slot is None:
                 break
-            self._ensure_arena(values["k"][:, :, i])
-            self._arena["k"][slot] = values["k"][:, :, i]
-            self._arena["v"][slot] = values["v"][:, :, i]
+            self._ensure_arena(values)
+            for key, arena in self._arena.items():
+                arena[slot] = values[key][:, :, i]
             self.stored_blocks_total += 1
             decisions.append((h, slot, evicted))
         return decisions
 
-    def _ensure_arena(self, block_kv: np.ndarray) -> None:
+    def _ensure_arena(self, values: dict) -> None:
         if self._arena is None:
+            first = next(iter(values.values()))
+            # per-block shape: stacked values drop the n axis (store),
+            # per-block dicts arrive without it (apply_store)
+            blk = (first.shape[:2] + first.shape[3:]
+                   if first.ndim == 5 else first.shape)
             L, _h, bs, d = self._shape_tail
-            got_d = block_kv.shape[3]
+            got_d = blk[3]
             d_ok = (d % got_d == 0 if self.opaque_rows else got_d == d)
-            if (block_kv.shape[0], block_kv.shape[2]) != (L, bs) or not d_ok:
+            if (blk[0], blk[2]) != (L, bs) or not d_ok:
                 raise ValueError(
-                    f"host-tier block shape {block_kv.shape} does not "
+                    f"host-tier block shape {tuple(blk)} does not "
                     f"match config {self._shape_tail} (heads — and for "
                     f"opaque int8 rows the row width — may differ per "
                     f"rank; layers/block_size may not)")
-            shape = (self.capacity,) + block_kv.shape
-            self._arena = {"k": np.zeros(shape, self._dtype),
-                           "v": np.zeros(shape, self._dtype)}
+            shape = (self.capacity,) + tuple(blk)
+            self._arena = {key: np.zeros(shape, self._dtype)
+                           for key in values}
 
     def apply_store(self, seq_hash: int, slot: int,
-                    evicted_hash: Optional[int], k: np.ndarray,
-                    v: np.ndarray) -> None:
+                    evicted_hash: Optional[int],
+                    block_values: dict) -> None:
         """Apply one of the leader's literal store decisions to a mirror
         pool (multihost follower): same hash→slot placement, same
         eviction, arena bytes from the FOLLOWER's own device KV (which is
-        bit-identical to the leader's by the dispatch-stream induction)."""
+        bit-identical to the leader's by the dispatch-stream induction).
+        ``block_values``: key → ONE block [L, H, bs, D]."""
         if evicted_hash is not None:
             old = self._by_hash.pop(evicted_hash, None)
             self._lru.pop(evicted_hash, None)
@@ -152,9 +160,9 @@ class HostKvPool:
             self._by_hash[seq_hash] = slot
         self._lru.pop(seq_hash, None)
         self._lru[seq_hash] = None
-        self._ensure_arena(k)
-        self._arena["k"][slot] = k
-        self._arena["v"][slot] = v
+        self._ensure_arena(block_values)
+        for key, arena in self._arena.items():
+            arena[slot] = block_values[key]
         self.stored_blocks_total += 1
 
     def match_prefix(self, seq_hashes: Sequence[int]) -> List[int]:
@@ -173,12 +181,12 @@ class HostKvPool:
         return out
 
     def fetch(self, slots: Sequence[int]) -> dict:
-        """Stacked values for ``slots``: {"k": [L, H, n, bs, D]}."""
+        """Stacked values for ``slots``, keyed like the device pool:
+        {key: [L, H, n, bs, D]}."""
         idx = np.asarray(slots, dtype=np.int64)
-        return {"k": np.ascontiguousarray(
-                    self._arena["k"][idx].transpose(1, 2, 0, 3, 4)),
-                "v": np.ascontiguousarray(
-                    self._arena["v"][idx].transpose(1, 2, 0, 3, 4))}
+        return {key: np.ascontiguousarray(
+                    arena[idx].transpose(1, 2, 0, 3, 4))
+                for key, arena in self._arena.items()}
 
     def pin(self, slots: Sequence[int]) -> None:
         """Exclude ``slots`` from LRU eviction while an async onboarding
@@ -207,13 +215,18 @@ def make_host_pool(capacity_blocks: int, model_cfg, block_size: int,
                    param_dtype) -> HostKvPool:
     """The one way to build a host pool matched to an engine's device
     pool (core.py and the offline replayer share it so they can't
-    drift). Full-precision pools use the head-major wire layout
-    [L, KVH, bs, Dh]; int8 pools ship whole rows (values + in-row scale
-    lanes, ``pool_row_lanes`` wide) as one opaque wire "head" — a
-    bit-exact round trip with no requantization error."""
+    drift). Full-precision llama pools use the head-major wire layout
+    [L, KVH, bs, Dh]; int8 pools AND MLA latent pools ship whole rows
+    (``pool_row_lanes`` wide — values + in-row scale lanes for int8,
+    rank+rope lanes for MLA) as one opaque wire "head" — a bit-exact
+    round trip with no requantization error."""
     if kv_quantization != "none":
         return HostKvPool(capacity_blocks, model_cfg.num_layers, 1,
                           block_size, pool_row_lanes, dtype=np.int8,
+                          opaque_rows=True)
+    if model_cfg.kv_lora_rank > 0:
+        return HostKvPool(capacity_blocks, model_cfg.num_layers, 1,
+                          block_size, pool_row_lanes, dtype=param_dtype,
                           opaque_rows=True)
     return HostKvPool(capacity_blocks, model_cfg.num_layers,
                       model_cfg.num_kv_heads, block_size,

@@ -334,11 +334,9 @@ def test_mla_engine_unsupported_combinations_refuse():
     cfg = _cfg()
     base = dict(max_model_len=128, kv_block_size=8, num_kv_blocks=64,
                 max_num_seqs=2, prefill_buckets=[32])
-    for over, pat in ((dict(quantization="int4"), "int4"),
-                      (dict(host_kv_blocks=8), "host KV tier")):
-        with pytest.raises(NotImplementedError, match=pat):
-            EngineCore(cfg, EngineConfig(**base, **over),
-                       attn_impl="xla", param_dtype=jnp.float32)
+    with pytest.raises(NotImplementedError, match="int4"):
+        EngineCore(cfg, EngineConfig(**base, quantization="int4"),
+                   attn_impl="xla", param_dtype=jnp.float32)
     if len(jax.devices()) >= 2:
         # tp meshes WORK now (test_mla_engine_serves_sharded); the ring
         # prefill is still llama-only, so sp > 1 must keep refusing
@@ -609,6 +607,57 @@ async def test_mla_int8_weights_serving_end_to_end():
         toks = await _greedy_tokens(core, "qw", list(range(2, 40)))
         assert len(toks) == 8
         assert all(0 <= t < cfg.vocab_size for t in toks)
+    finally:
+        await core.stop()
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("kv_quant", ["none", "int8"])
+async def test_mla_host_tier_multi_turn_offload_onboard(kv_quant):
+    """MLA latent rows through the host KV tier (the last MLA refusal):
+    generate, offload on finish, wipe the device reuse pool, resubmit —
+    the host tier restores the latent prefix and the continuation is
+    identical. Latent rows ship as one opaque wire "head" whole-row
+    (full precision AND int8 + in-row scales), so the round trip is
+    bit-exact (mirrors test_kv_offload.py's llama equivalence test)."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import (FINISH_SENTINEL, EngineCore,
+                                        EngineRequest)
+    from dynamo_tpu.engine.sampling import SlotSampling
+    cfg = _cfg()
+    ecfg = EngineConfig(max_model_len=64, kv_block_size=4,
+                        num_kv_blocks=32, max_num_seqs=2,
+                        prefill_buckets=[32, 64], host_kv_blocks=16,
+                        kv_quantization=kv_quant)
+    core = EngineCore(cfg, ecfg, attn_impl="xla", param_dtype=jnp.float32)
+    host = core.offload_engine.host_pool
+    assert host.opaque_rows and host.num_kv_heads == 1
+    prompt = list(range(1, 13))            # 3 full blocks
+
+    async def run_once(rid):
+        req = EngineRequest(rid=rid, prompt=list(prompt),
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=4, eos_ids=frozenset())
+        await core.submit(req)
+        toks = []
+        while True:
+            item, _ = await req.out_queue.get()
+            if item is FINISH_SENTINEL:
+                return toks, req.prefix_hit_tokens
+            toks.append(item)
+
+    try:
+        toks1, hit1 = await run_once("h1")
+        assert hit1 == 0
+        await core.offload_engine.drain()
+        assert core.offload_engine.offloaded_blocks_total >= 2
+        # arena holds latent rows under the pool's own key
+        assert set(host._arena) == {"kv"}
+        core.kv_manager.pool.reset()       # only the host tier remains
+        toks2, hit2 = await run_once("h2")
+        assert hit2 >= 8                   # host-tier latent restore
+        assert toks2 == toks1
+        assert core.host_onboards == 1
     finally:
         await core.stop()
 
