@@ -33,6 +33,19 @@ class RopeScaling:
     beta_fast: float = 32.0
     beta_slow: float = 1.0
     attention_factor: float = 0.0
+    # longrope (phi3 128k variants): per-dim frequency divisors, one per
+    # head_dim/2 lane pair. HF switches short→long per forward when
+    # seq_len exceeds original_max; a paged serving engine caches K
+    # post-rope and cannot re-rope on crossing, so selection is STATIC:
+    # "auto" = long iff max_position_embeddings > original_max (the
+    # 128k deployment), "short" = the engine proved every servable
+    # sequence fits the pretrained window (EngineCore downgrades when
+    # max_model_len <= original_max — HF-exact for every request it can
+    # serve). The sqrt(1 + ln(M/O)/ln(O)) attention factor multiplies
+    # cos/sin in BOTH modes, exactly as HF's fixed attention_scaling.
+    short_factor: tuple = ()
+    long_factor: tuple = ()
+    longrope_active: str = "auto"
 
 
 @dataclasses.dataclass
@@ -188,12 +201,31 @@ class ModelConfig:
                              "/ decoder_sparse_step > 1) is not supported "
                              "— every layer must be sparse")
         if mt == "phi3" and cfg.get("rope_scaling"):
-            # phi3 128k variants use longrope (per-dim su factors +
-            # short/long switching) — a different rope function entirely;
-            # half-applying llama3-style scaling would decode garbage
-            raise ValueError(
-                "phi3 rope_scaling (longrope/su) is not implemented — "
-                "use a base-context phi3 checkpoint (no rope_scaling)")
+            # phi3 128k variants: longrope ("su" is the same function's
+            # legacy name in early Phi-3 configs). Anything else would
+            # half-apply a different rope and decode garbage.
+            rrs = cfg["rope_scaling"]
+            rt = rrs.get("rope_type", rrs.get("type", "default"))
+            if rt not in ("longrope", "su"):
+                raise ValueError(
+                    f"phi3 rope_scaling type {rt!r} is not implemented "
+                    f"(longrope is)")
+            d2 = int(cfg.get("head_dim",
+                             int(cfg.get("hidden_size", 4096))
+                             // int(cfg.get("num_attention_heads", 32))
+                             )) // 2
+            sf, lf = rrs.get("short_factor"), rrs.get("long_factor")
+            if (not sf or not lf or len(sf) != d2 or len(lf) != d2):
+                raise ValueError(
+                    f"phi3 longrope needs short_factor and long_factor "
+                    f"of length head_dim/2 = {d2} (got "
+                    f"{len(sf or [])}/{len(lf or [])})")
+            if not cfg.get("original_max_position_embeddings"):
+                raise ValueError(
+                    "phi3 longrope needs top-level "
+                    "original_max_position_embeddings (the pretrained "
+                    "window the factor switch and attention scaling "
+                    "derive from)")
         n_heads = int(cfg.get("num_attention_heads", 32))
         hidden = int(cfg.get("hidden_size", 4096))
         is_ds = mt in ("deepseek_v2", "deepseek_v3")
@@ -216,13 +248,23 @@ class ModelConfig:
         rs = None
         raw_rs = cfg.get("rope_scaling")
         if isinstance(raw_rs, dict):
+            raw_type = raw_rs.get("rope_type",
+                                  raw_rs.get("type", "default"))
             rs = RopeScaling(
-                rope_type=raw_rs.get("rope_type", raw_rs.get("type", "default")),
+                # "su" = longrope's legacy spelling (early Phi-3 configs)
+                rope_type="longrope" if raw_type == "su" else raw_type,
                 factor=float(raw_rs.get("factor", 1.0)),
                 low_freq_factor=float(raw_rs.get("low_freq_factor", 1.0)),
                 high_freq_factor=float(raw_rs.get("high_freq_factor", 4.0)),
+                # phi3 carries original_max at the TOP level, llama3/yarn
+                # inside rope_scaling
                 original_max_position_embeddings=int(
-                    raw_rs.get("original_max_position_embeddings", 8192)),
+                    raw_rs.get(
+                        "original_max_position_embeddings",
+                        cfg.get("original_max_position_embeddings",
+                                8192))),
+                short_factor=tuple(raw_rs.get("short_factor") or ()),
+                long_factor=tuple(raw_rs.get("long_factor") or ()),
                 mscale=float(raw_rs.get("mscale", 0.0) or 0.0),
                 mscale_all_dim=float(raw_rs.get("mscale_all_dim", 0.0)
                                      or 0.0),

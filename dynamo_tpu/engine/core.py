@@ -154,6 +154,19 @@ class EngineCore:
             # the XLA gather implementation)
             model_cfg = dataclasses.replace(model_cfg, sliding_window=None)
             self.model_cfg = model_cfg
+        _rs = model_cfg.rope_scaling
+        if (_rs is not None and _rs.rope_type == "longrope"
+                and _rs.longrope_active == "auto"
+                and engine_cfg.max_model_len
+                <= _rs.original_max_position_embeddings):
+            # every servable sequence fits the pretrained window, so the
+            # SHORT factors are HF-exact for all of them (HF switches to
+            # long only past original_max); the attention scaling stays
+            # config-derived either way (llama.rope_attention_scaling)
+            model_cfg = dataclasses.replace(
+                model_cfg, rope_scaling=dataclasses.replace(
+                    _rs, longrope_active="short"))
+            self.model_cfg = model_cfg
         self.statics = llama.ModelStatics(
             cfg=model_cfg, block_size=engine_cfg.kv_block_size,
             attn_impl=attn_impl)

@@ -74,15 +74,46 @@ def rope_inv_freq(cfg: ModelConfig) -> np.ndarray:
         inv = scaled
     elif rs is not None and rs.rope_type == "linear":
         inv = inv / rs.factor
+    elif rs is not None and rs.rope_type == "longrope":
+        # phi3 128k: per-dim frequency divisors (HF
+        # _compute_longrope_parameters). Selection is STATIC (see
+        # config.RopeScaling): long iff the deployment can exceed the
+        # pretrained window, short when EngineCore proved it can't.
+        use_long = (rs.longrope_active == "long"
+                    or (rs.longrope_active == "auto"
+                        and cfg.max_position_embeddings
+                        > rs.original_max_position_embeddings))
+        ext = np.asarray(rs.long_factor if use_long else rs.short_factor,
+                         np.float64)
+        inv = inv / ext
     return inv.astype(np.float32)
 
 
+def rope_attention_scaling(cfg: ModelConfig) -> float:
+    """cos/sin multiplier — longrope's sqrt(1 + ln(M/O)/ln(O)) (HF
+    attention_scaling, fixed at init from the CONFIG ratio and applied
+    in both short and long modes); 1.0 for every other rope type."""
+    import math
+    rs = cfg.rope_scaling
+    if rs is None or rs.rope_type != "longrope":
+        return 1.0
+    if rs.attention_factor:
+        return rs.attention_factor
+    factor = (cfg.max_position_embeddings
+              / rs.original_max_position_embeddings)
+    if factor <= 1.0:
+        return 1.0
+    return math.sqrt(1 + math.log(factor)
+                     / math.log(rs.original_max_position_embeddings))
+
+
 def apply_rope(x: jax.Array, positions: jax.Array,
-               inv_freq: jax.Array) -> jax.Array:
-    """x: [T, H, Dh]; positions: [T]. HF half-split rotate convention."""
+               inv_freq: jax.Array, scaling: float = 1.0) -> jax.Array:
+    """x: [T, H, Dh]; positions: [T]. HF half-split rotate convention.
+    ``scaling`` multiplies cos/sin (longrope attention factor)."""
     angles = positions[:, None].astype(jnp.float32) * inv_freq[None, :]  # [T, Dh/2]
-    cos = jnp.cos(angles)[:, None, :]
-    sin = jnp.sin(angles)[:, None, :]
+    cos = jnp.cos(angles)[:, None, :] * scaling
+    sin = jnp.sin(angles)[:, None, :] * scaling
     half = x.shape[-1] // 2
     x1, x2 = x[..., :half], x[..., half:]
     x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
@@ -339,6 +370,7 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
     N = x.shape[0]
     L = cfg.num_layers
     inv_freq = jnp.asarray(rope_inv_freq(cfg))
+    rope_att = rope_attention_scaling(cfg)
     layer_params = _layer_stack(params)
     sliding_flags = jnp.asarray(sliding_layer_mask(cfg))
     NTOK = kv["k"].shape[1]
@@ -363,8 +395,8 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
         if cfg.qk_norm:
             q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps, p1)
             k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps, p1)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
+        q = apply_rope(q, positions, inv_freq, rope_att)
+        k = apply_rope(k, positions, inv_freq, rope_att)
         if quantized:
             # per-token int8 write with in-row (e, m) scale lanes;
             # attention reads (incl. this step's own tokens) dequantize

@@ -177,8 +177,10 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
             return
         import hashlib
         h = hashlib.blake2b(digest_size=16)
-        h.update(np.asarray(kv["k"]).tobytes())
-        h.update(np.asarray(kv["v"]).tobytes())
+        # key-agnostic (llama {"k","v"}, MLA {"kv"}); sorted so the
+        # fingerprint is stable across dict orders
+        for key in sorted(kv):
+            h.update(np.asarray(kv[key]).tobytes())
         out["fingerprints"].append((label, h.hexdigest()))
 
     for ev in events:
@@ -216,7 +218,7 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                 mirror = make_host_pool(
                     core.cfg.host_kv_blocks, core.model_cfg, bs,
                     core.cfg.kv_quantization,
-                    int(core.kv["k"].shape[-1]), dtype)
+                    int(next(iter(core.kv.values())).shape[-1]), dtype)
             top = max(it[1] for it in ev["items"])
             if top >= core.cfg.host_kv_blocks:
                 raise NotImplementedError(

@@ -40,12 +40,31 @@ def test_hf_config_detection_and_rejections():
     assert cfg.layer_types == ["sliding_attention"] * 32
     assert llama.sliding_layer_mask(cfg).all()
     assert cfg.hidden_act == "silu" and not cfg.attention_bias
-    # longrope (128k variants) must be rejected loudly, not half-applied
-    with pytest.raises(ValueError, match="longrope"):
+    # longrope parses now; malformed variants must still reject loudly
+    d2 = 3072 // 32 // 2
+    good = {**base, "original_max_position_embeddings": 4096,
+            "max_position_embeddings": 131072,
+            "rope_scaling": {"type": "su",       # legacy spelling
+                             "short_factor": [1.0] * d2,
+                             "long_factor": [1.5] * d2}}
+    parsed = ModelConfig.from_hf_config(good)
+    rs = parsed.rope_scaling
+    assert rs.rope_type == "longrope"            # normalized
+    assert len(rs.short_factor) == d2 and len(rs.long_factor) == d2
+    assert rs.original_max_position_embeddings == 4096
+    assert rs.longrope_active == "auto"
+    with pytest.raises(ValueError, match="not implemented"):
         ModelConfig.from_hf_config(
-            {**base, "rope_scaling": {"type": "longrope",
+            {**base, "rope_scaling": {"type": "linear", "factor": 4.0}})
+    with pytest.raises(ValueError, match="head_dim/2"):
+        ModelConfig.from_hf_config(
+            {**good, "rope_scaling": {"type": "longrope",
                                       "short_factor": [1.0],
                                       "long_factor": [1.5]}})
+    bad = dict(good)
+    bad.pop("original_max_position_embeddings")
+    with pytest.raises(ValueError, match="original_max"):
+        ModelConfig.from_hf_config(bad)
 
 
 @pytest.fixture(scope="module")
@@ -203,3 +222,167 @@ def test_phi3_decode_matches_hf_teacher_forced(phi3_params, hf_phi3):
         np.testing.assert_allclose(
             np.asarray(lg[0]), ref_all[len(tokens) + s],
             rtol=3e-4, atol=3e-4)
+
+
+# ---------------------------------------------------------------------------
+# longrope (128k variants)
+# ---------------------------------------------------------------------------
+
+
+def _longrope_cfg(active="auto"):
+    """Tiny phi3 with a 64-token pretrained window served at 256: the
+    extrapolated regime (M > O) with distinct per-dim factor sets."""
+    import dataclasses
+
+    from dynamo_tpu.engine.config import RopeScaling
+    rng = np.random.default_rng(90)
+    d2 = 16 // 2
+    short = tuple(float(f) for f in rng.uniform(1.0, 1.3, size=d2))
+    long = tuple(float(f) for f in rng.uniform(1.5, 4.0, size=d2))
+    return dataclasses.replace(
+        PHI3_CFG,
+        rope_scaling=RopeScaling(
+            rope_type="longrope", short_factor=short, long_factor=long,
+            original_max_position_embeddings=64,
+            longrope_active=active))
+
+
+def _hf_longrope(cfg, params):
+    import torch  # noqa: F401
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    from dynamo_tpu.engine.weights import save_hf_style
+    import tempfile
+    d = tempfile.mkdtemp(prefix="phi3lr")
+    save_hf_style(params, cfg, d)
+    rs = cfg.rope_scaling
+    hf_cfg = Phi3Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        max_position_embeddings=cfg.max_position_embeddings,
+        original_max_position_embeddings=rs.original_max_position_embeddings,
+        rope_scaling={"type": "longrope",
+                      "short_factor": list(rs.short_factor),
+                      "long_factor": list(rs.long_factor)},
+        rms_norm_eps=cfg.rms_norm_eps, rope_theta=cfg.rope_theta,
+        sliding_window=None, tie_word_embeddings=False,
+        pad_token_id=0, attn_implementation="eager")
+    hf_cfg.save_pretrained(d)
+    import torch
+    model = Phi3ForCausalLM.from_pretrained(
+        d, torch_dtype=torch.float32, attn_implementation="eager")
+    model.eval()
+    return model
+
+
+def test_phi3_longrope_long_regime_matches_hf():
+    """Prompt longer than the pretrained window: HF's dynamic switch
+    picks the long factors for the whole forward, and our static
+    selection (auto -> long since M > O) must reproduce it — including
+    the sqrt(1 + ln(M/O)/ln(O)) cos/sin attention factor."""
+    torch = pytest.importorskip("torch")
+    cfg = _longrope_cfg()
+    assert llama.rope_attention_scaling(cfg) > 1.0
+    params = llama.init_params(cfg, jax.random.PRNGKey(91),
+                               dtype=jnp.float32)
+    hf = _hf_longrope(cfg, params)
+    rng = np.random.default_rng(92)
+    tokens = rng.integers(1, cfg.vocab_size, size=90).tolist()  # > 64
+    with torch.no_grad():
+        ref = hf(torch.tensor([tokens])).logits[0, -1].numpy()
+    kv = llama.init_kv_cache(cfg, NUM_BLOCKS, BS, dtype=jnp.float32)
+    T = 96
+    padded = np.zeros((T,), np.int32)
+    padded[:len(tokens)] = tokens
+    table = np.zeros((NUM_BLOCKS,), np.int32)
+    table[:T // BS] = np.arange(1, 1 + T // BS)
+    statics = llama.ModelStatics(cfg=cfg, block_size=BS, attn_impl="xla")
+    logits, _ = llama.prefill_forward(
+        params, kv, jnp.asarray(padded), jnp.asarray(table),
+        jnp.asarray(0, jnp.int32), jnp.asarray(len(tokens), jnp.int32),
+        statics)
+    np.testing.assert_allclose(np.asarray(logits), ref,
+                               rtol=4e-4, atol=4e-4)
+
+
+def test_phi3_longrope_short_regime_matches_hf():
+    """Sequences within the pretrained window (the EngineCore-downgrade
+    mode, longrope_active="short"): HF uses the short factors below O,
+    STILL multiplied by the config-derived attention factor — both must
+    match, teacher-forced decode included."""
+    torch = pytest.importorskip("torch")
+    cfg = _longrope_cfg(active="short")
+    params = llama.init_params(cfg, jax.random.PRNGKey(93),
+                               dtype=jnp.float32)
+    hf = _hf_longrope(cfg, params)
+    rng = np.random.default_rng(94)
+    tokens = rng.integers(1, cfg.vocab_size, size=12).tolist()
+    steps = 5                               # stays well under O=64
+    with torch.no_grad():
+        ref_all = hf(torch.tensor(
+            [tokens + [3] * steps])).logits[0].numpy()
+    kv = llama.init_kv_cache(cfg, NUM_BLOCKS, BS, dtype=jnp.float32)
+    T = 32
+    padded = np.zeros((T,), np.int32)
+    padded[:len(tokens)] = tokens
+    table = np.zeros((NUM_BLOCKS,), np.int32)
+    table[:T // BS] = np.arange(1, 1 + T // BS)
+    statics = llama.ModelStatics(cfg=cfg, block_size=BS, attn_impl="xla")
+    lg, kv = llama.prefill_forward(
+        params, kv, jnp.asarray(padded), jnp.asarray(table),
+        jnp.asarray(0, jnp.int32), jnp.asarray(len(tokens), jnp.int32),
+        statics)
+    np.testing.assert_allclose(np.asarray(lg), ref_all[len(tokens) - 1],
+                               rtol=4e-4, atol=4e-4)
+    tables = table[None, :T // BS]
+    for s in range(steps):
+        pos = jnp.asarray([len(tokens) + s], jnp.int32)
+        lg, kv = llama.decode_forward(
+            params, kv, jnp.asarray([3], jnp.int32), pos,
+            jnp.asarray(tables), statics)
+        np.testing.assert_allclose(
+            np.asarray(lg[0]), ref_all[len(tokens) + s],
+            rtol=4e-4, atol=4e-4, err_msg=f"decode step {s}")
+
+
+@pytest.mark.asyncio
+async def test_phi3_longrope_engine_downgrade_and_serve():
+    """EngineCore resolves the static factor selection: max_model_len
+    within the pretrained window downgrades auto -> short (HF-exact for
+    every servable request); beyond it stays auto (-> long). Smoke-serve
+    the long deployment."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import (FINISH_SENTINEL, EngineCore,
+                                        EngineRequest)
+    from dynamo_tpu.engine.sampling import SlotSampling
+    cfg = _longrope_cfg()
+    short_core = EngineCore(
+        cfg, EngineConfig(max_model_len=64, kv_block_size=8,
+                          num_kv_blocks=32, max_num_seqs=2,
+                          prefill_buckets=[32, 64]),
+        attn_impl="xla", param_dtype=jnp.float32)
+    assert short_core.model_cfg.rope_scaling.longrope_active == "short"
+    await short_core.stop()
+    core = EngineCore(
+        cfg, EngineConfig(max_model_len=128, kv_block_size=8,
+                          num_kv_blocks=48, max_num_seqs=2,
+                          prefill_buckets=[32, 64, 128]),
+        attn_impl="xla", param_dtype=jnp.float32)
+    assert core.model_cfg.rope_scaling.longrope_active == "auto"
+    try:
+        req = EngineRequest(rid="lr", prompt=list(range(2, 70)),
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=6, eos_ids=frozenset())
+        await core.submit(req)
+        toks = []
+        while True:
+            item, _ = await req.out_queue.get()
+            if item is FINISH_SENTINEL:
+                break
+            toks.append(item)
+        assert len(toks) == 6
+    finally:
+        await core.stop()
