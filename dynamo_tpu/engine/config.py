@@ -48,6 +48,14 @@ class RopeScaling:
     longrope_active: str = "auto"
 
 
+def _rope_type(raw_rs: Dict[str, Any]) -> str:
+    """Normalized rope type of a raw rope_scaling dict — THE one home
+    for the key fallback ("rope_type" | legacy "type") and the
+    "su"→"longrope" aliasing (early Phi-3 configs)."""
+    rt = raw_rs.get("rope_type", raw_rs.get("type", "default"))
+    return "longrope" if rt == "su" else rt
+
+
 @dataclasses.dataclass
 class ModelConfig:
     """Transformer shape config (llama / qwen / mixtral families)."""
@@ -205,11 +213,10 @@ class ModelConfig:
             # legacy name in early Phi-3 configs). Anything else would
             # half-apply a different rope and decode garbage.
             rrs = cfg["rope_scaling"]
-            rt = rrs.get("rope_type", rrs.get("type", "default"))
-            if rt not in ("longrope", "su"):
+            if _rope_type(rrs) != "longrope":
                 raise ValueError(
-                    f"phi3 rope_scaling type {rt!r} is not implemented "
-                    f"(longrope is)")
+                    f"phi3 rope_scaling type {_rope_type(rrs)!r} is not "
+                    f"implemented (longrope is)")
             d2 = int(cfg.get("head_dim",
                              int(cfg.get("hidden_size", 4096))
                              // int(cfg.get("num_attention_heads", 32))
@@ -248,11 +255,8 @@ class ModelConfig:
         rs = None
         raw_rs = cfg.get("rope_scaling")
         if isinstance(raw_rs, dict):
-            raw_type = raw_rs.get("rope_type",
-                                  raw_rs.get("type", "default"))
             rs = RopeScaling(
-                # "su" = longrope's legacy spelling (early Phi-3 configs)
-                rope_type="longrope" if raw_type == "su" else raw_type,
+                rope_type=_rope_type(raw_rs),
                 factor=float(raw_rs.get("factor", 1.0)),
                 low_freq_factor=float(raw_rs.get("low_freq_factor", 1.0)),
                 high_freq_factor=float(raw_rs.get("high_freq_factor", 4.0)),

@@ -147,16 +147,19 @@ def bridge() -> DeviceKvBridge:
     return _BRIDGE
 
 
-def _stacked_kv_sharding(mesh):
+def _stacked_kv_sharding(mesh, key: str):
     """The pool pspec (parallel/sharding.kv_pspecs, [L, NTOK, C]) lifted to
     the stacked-blocks rank [L, n, bs, C]: the block axis is new and
     unsharded, bs inherits the (unsharded) token axis, C keeps its axes —
     derived, not duplicated, so a pool-layout change can't silently
-    diverge the device plane's placement."""
+    diverge the device plane's placement. Keys without a pspec (the MLA
+    latent "kv" pool) replicate, matching shard_kv's fallback."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..parallel.sharding import kv_pspecs
-    s = kv_pspecs()["k"]
+    s = kv_pspecs().get(key, P())
+    if s == P():
+        return NamedSharding(mesh, P())
     return NamedSharding(mesh, P(s[0], None, s[1], s[2]))
 
 
@@ -172,16 +175,17 @@ def scatter_blocks_device(kv, target_ids, payload: DeviceKvPayload,
 
     vals = {k: v[:, skip_blocks:n_needed]
             for k, v in payload.stacked.items()}
-    pool_sharding = kv["k"].sharding
+    pool_sharding = next(iter(kv.values())).sharding
     if mesh is not None:
-        target = _stacked_kv_sharding(mesh)
+        target = {k: _stacked_kv_sharding(mesh, k) for k in vals}
     elif isinstance(pool_sharding, NamedSharding):
-        target = _stacked_kv_sharding(pool_sharding.mesh)
+        target = {k: _stacked_kv_sharding(pool_sharding.mesh, k)
+                  for k in vals}
     else:
         # single-device pool: its placement applies rank-agnostically
-        target = pool_sharding
+        target = {k: pool_sharding for k in vals}
     # the cross-engine (and cross-mesh) hop: device→device over ICI
-    vals = jax.device_put(vals, target)
+    vals = {k: jax.device_put(v, target[k]) for k, v in vals.items()}
     n = n_needed - skip_blocks
     pad = _pad_pow2(n) - n
     ids = list(target_ids) + [0] * pad     # pad scatters hit trash block 0

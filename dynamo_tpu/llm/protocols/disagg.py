@@ -84,28 +84,35 @@ def _np_dtype(name: str):
 
 
 def encode_kv_payload(payload: KvPayload) -> tuple:
-    """→ (header bytes, data bytes) for one TCP DATA frame."""
-    k, v = payload.values["k"], payload.values["v"]
+    """→ (header bytes, data bytes) for one TCP DATA frame. The header
+    names the pool's key set (sorted) so llama {"k","v"} and MLA latent
+    {"kv"} pools share one wire format; all keys share one shape/dtype
+    (k/v are twins, MLA has one array)."""
+    keys = sorted(payload.values)
+    first = payload.values[keys[0]]
     header = json.dumps({
         "request_id": payload.request_id,
         "first_token": payload.first_token,
         "first_logprob": payload.first_logprob,
         "seq_hashes": payload.seq_hashes,
-        "shape": list(k.shape),
-        "dtype": _dtype_of(k),
+        "shape": list(first.shape),
+        "dtype": _dtype_of(first),
+        "keys": keys,
     }).encode()
-    return header, k.tobytes() + v.tobytes()
+    return header, b"".join(payload.values[k].tobytes() for k in keys)
 
 
 def decode_kv_payload(header: bytes, data: bytes) -> KvPayload:
     h = json.loads(header)
     shape = tuple(h["shape"])
     dt = _np_dtype(h["dtype"])
+    keys = h.get("keys", ["k", "v"])   # absent: pre-"keys" wire frames
     nbytes = int(np.prod(shape)) * dt.itemsize
-    k = np.frombuffer(data[:nbytes], dtype=dt).reshape(shape)
-    v = np.frombuffer(data[nbytes:2 * nbytes], dtype=dt).reshape(shape)
+    values = {key: np.frombuffer(
+        data[i * nbytes:(i + 1) * nbytes], dtype=dt).reshape(shape)
+        for i, key in enumerate(keys)}
     return KvPayload(
         request_id=h["request_id"], first_token=int(h["first_token"]),
         first_logprob=float(h["first_logprob"]),
         seq_hashes=[int(x) for x in h["seq_hashes"]],
-        values={"k": k, "v": v})
+        values=values)

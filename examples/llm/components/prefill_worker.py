@@ -30,6 +30,6 @@ class PrefillWorker:
         from dynamo_tpu.llm.engines.jax_engine import JaxEngine
 
         ecfg = EngineConfig(kv_block_size=int(cfg.get("kv_block_size", 16)),
-                            max_slots=int(cfg.get("max_slots", 8)))
+                            max_num_seqs=int(cfg.get("max_slots", 8)))
         eng = JaxEngine.from_model_dir(cfg["model_path"], engine_cfg=ecfg)
         self.loop = await PrefillLoop(eng.core, self.runtime).start()

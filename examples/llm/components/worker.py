@@ -65,7 +65,7 @@ class TpuWorker:
         from dynamo_tpu.llm.engines.jax_engine import JaxEngine
 
         ecfg = EngineConfig(kv_block_size=self.block_size,
-                            max_slots=int(cfg.get("max_slots", 8)))
+                            max_num_seqs=int(cfg.get("max_slots", 8)))
         eng = JaxEngine.from_model_dir(cfg["model_path"], engine_cfg=ecfg)
         if cfg.get("remote_prefill"):
             from dynamo_tpu.llm.disagg import (DisaggEngine,

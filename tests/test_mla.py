@@ -662,6 +662,88 @@ async def test_mla_host_tier_multi_turn_offload_onboard(kv_quant):
         await core.stop()
 
 
+@pytest.mark.asyncio
+@pytest.mark.parametrize("plane,kv_quant", [
+    ("device", "none"), ("wire", "none"),
+    ("device", "int8"), ("wire", "int8"),
+], ids=["device", "wire", "device-int8", "wire-int8"])
+async def test_mla_disagg_remote_prefill_matches_local(plane, kv_quant):
+    """PD disaggregation with MLA pools: a prefill engine hands the
+    latent rows to a decode engine over the device plane (in-process
+    ICI analog) or the TCP wire plane — whole rows as one opaque wire
+    head, full-precision and int8 — and greedy tokens equal the
+    aggregated single-engine run. Exercises the key-agnostic wire codec
+    ("keys" header) and the replicated stacked-sharding path."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.llm.disagg import (DisaggEngine, DisaggregatedRouter,
+                                       PrefillWorker)
+    from dynamo_tpu.llm.engines.jax_engine import JaxEngine
+    from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+    from dynamo_tpu.runtime import Context
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import EngineContext
+    cfg = _cfg()
+
+    def mk():
+        return EngineCore(
+            cfg,
+            EngineConfig(max_model_len=128, kv_block_size=8,
+                         num_kv_blocks=48, max_num_seqs=2,
+                         prefill_buckets=[16, 32, 64, 128],
+                         kv_quantization=kv_quant),
+            attn_impl="xla", param_dtype=jnp.float32)
+
+    def req(rid):
+        pre = PreprocessedRequest(
+            token_ids=list(range(2, 39)),
+            stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+            sampling_options=SamplingOptions(greedy=True))
+        return Context(pre, ctx=EngineContext(rid))
+
+    async def collect(stream):
+        toks = []
+        async for a in stream:
+            if a.data is not None and a.data.token_ids:
+                toks.extend(a.data.token_ids)
+        return toks
+
+    local_core = mk()
+    try:
+        want = await collect(
+            await JaxEngine(local_core).generate(req("want")))
+    finally:
+        await local_core.stop()
+    assert len(want) == 8
+
+    rt = DistributedRuntime.in_process()
+    prefill_core, decode_core = mk(), mk()
+    router = DisaggregatedRouter(rt, "tiny-mla",
+                                 max_local_prefill_length=0,
+                                 conditional=False)
+    engine = DisaggEngine(decode_core, rt, router,
+                          device_plane=(plane == "device"))
+    worker = await PrefillWorker(prefill_core, rt).start()
+    try:
+        got = await collect(
+            await engine.generate(req(f"mla-{plane}-{kv_quant}")))
+        assert got == want
+        assert engine.remote_prefills == 1 and engine.remote_failures == 0
+        assert prefill_core.total_prefill_tokens == 37
+        assert decode_core.total_prefill_tokens == 0
+        if plane == "device":
+            assert engine.device_transfers == 1
+        else:
+            assert engine.device_transfers == 0
+    finally:
+        await worker.stop()
+        await prefill_core.stop()
+        await decode_core.stop()
+        await rt.shutdown()
+
+
 def _moe_cfg(n_group=0, topk_group=0, scaling=1.0) -> ModelConfig:
     return ModelConfig(
         model_type="deepseek_v2", vocab_size=256, hidden_size=64,

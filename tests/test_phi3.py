@@ -247,13 +247,12 @@ def _longrope_cfg(active="auto"):
             longrope_active=active))
 
 
-def _hf_longrope(cfg, params):
-    import torch  # noqa: F401
+def _hf_longrope(cfg, params, tmp_path):
+    import torch
     from transformers import Phi3Config, Phi3ForCausalLM
 
     from dynamo_tpu.engine.weights import save_hf_style
-    import tempfile
-    d = tempfile.mkdtemp(prefix="phi3lr")
+    d = str(tmp_path)
     save_hf_style(params, cfg, d)
     rs = cfg.rope_scaling
     hf_cfg = Phi3Config(
@@ -271,14 +270,13 @@ def _hf_longrope(cfg, params):
         sliding_window=None, tie_word_embeddings=False,
         pad_token_id=0, attn_implementation="eager")
     hf_cfg.save_pretrained(d)
-    import torch
     model = Phi3ForCausalLM.from_pretrained(
         d, torch_dtype=torch.float32, attn_implementation="eager")
     model.eval()
     return model
 
 
-def test_phi3_longrope_long_regime_matches_hf():
+def test_phi3_longrope_long_regime_matches_hf(tmp_path):
     """Prompt longer than the pretrained window: HF's dynamic switch
     picks the long factors for the whole forward, and our static
     selection (auto -> long since M > O) must reproduce it — including
@@ -288,7 +286,7 @@ def test_phi3_longrope_long_regime_matches_hf():
     assert llama.rope_attention_scaling(cfg) > 1.0
     params = llama.init_params(cfg, jax.random.PRNGKey(91),
                                dtype=jnp.float32)
-    hf = _hf_longrope(cfg, params)
+    hf = _hf_longrope(cfg, params, tmp_path)
     rng = np.random.default_rng(92)
     tokens = rng.integers(1, cfg.vocab_size, size=90).tolist()  # > 64
     with torch.no_grad():
@@ -308,7 +306,7 @@ def test_phi3_longrope_long_regime_matches_hf():
                                rtol=4e-4, atol=4e-4)
 
 
-def test_phi3_longrope_short_regime_matches_hf():
+def test_phi3_longrope_short_regime_matches_hf(tmp_path):
     """Sequences within the pretrained window (the EngineCore-downgrade
     mode, longrope_active="short"): HF uses the short factors below O,
     STILL multiplied by the config-derived attention factor — both must
@@ -317,7 +315,7 @@ def test_phi3_longrope_short_regime_matches_hf():
     cfg = _longrope_cfg(active="short")
     params = llama.init_params(cfg, jax.random.PRNGKey(93),
                                dtype=jnp.float32)
-    hf = _hf_longrope(cfg, params)
+    hf = _hf_longrope(cfg, params, tmp_path)
     rng = np.random.default_rng(94)
     tokens = rng.integers(1, cfg.vocab_size, size=12).tolist()
     steps = 5                               # stays well under O=64
