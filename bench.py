@@ -11,7 +11,8 @@ tok/s/chip for 70B on a v5e-64 pod; `vs_baseline` reports value/2000 so the
 driver has a consistent scalar across rounds.
 
 Env knobs: BENCH_BATCH (default 128), BENCH_STEPS (128), BENCH_PROMPT (128),
-BENCH_MODEL (1b|tiny|8b|70b_tp8shard|moe|qwen2moe — 8b is Llama-3-8B geometry,
+BENCH_MODEL (1b|tiny|8b|70b_tp8shard|moe|qwen2moe|mla — 8b is Llama-3-8B
+geometry, mla is DeepSeek-V2-Lite-class (experts cut 64→8);
 random weights; at int8 the weights are ~8 GB of the 16 GB HBM, so pick
 BENCH_BATCH/LEN so KV fits: B=64 with default lengths, B=128 with
 BENCH_HARVEST<=8; 70b_tp8shard is the per-chip slice of 70B under the
@@ -74,18 +75,48 @@ def _matmul_flops_per_token(mcfg) -> float:
     accounted separately (they scale with seq len). MoE geometries run
     the dense-over-experts einsum — ALL E experts execute per token
     (engine moe_mlp) — plus any shared expert, and the MFU must count
-    those real flops (earlier MoE history lines understated this)."""
+    those real flops (earlier MoE history lines understated this).
+    Hybrid deepseek sparsity: the first_k_dense prefix runs its own
+    dense MLP; MLA attention counts the latent projections plus the
+    ABSORBED per-token wkv_b contractions (models/mla.py decode)."""
     D, F = mcfg.hidden_size, mcfg.intermediate_size
     H, KVH, Dh = mcfg.num_heads, mcfg.num_kv_heads, mcfg.head_dim
+    L = mcfg.num_layers
+    k_dense = getattr(mcfg, "first_k_dense", 0)
     if getattr(mcfg, "num_experts", 0) > 0:
-        mlp = (mcfg.num_experts * 3 * D * F
+        moe = (mcfg.num_experts * 3 * D * F
                + 3 * D * getattr(mcfg, "shared_expert_size", 0)
                + D * mcfg.num_experts)          # router
+        dense_f = getattr(mcfg, "dense_intermediate_size", 0) or F
+        mlp_total = (L - k_dense) * moe + k_dense * 3 * D * dense_f
     else:
-        mlp = 3 * D * F
-    per_layer = D * (H + 2 * KVH) * Dh + H * Dh * D + mlp
-    return 2.0 * (mcfg.num_layers * per_layer
-                  + D * mcfg.vocab_size)
+        mlp_total = L * 3 * D * F
+    rank = getattr(mcfg, "kv_lora_rank", 0)
+    if rank > 0:
+        dn = mcfg.qk_nope_head_dim
+        dr = mcfg.qk_rope_head_dim
+        dv = mcfg.v_head_dim
+        ql = getattr(mcfg, "q_lora_rank", 0)
+        q = (D * ql + ql * H * (dn + dr)) if ql else D * H * (dn + dr)
+        attn = (q + D * (rank + dr)               # wkv_a
+                + H * rank * (dn + dv)            # absorbed wkv_b
+                + H * dv * D)                     # wo
+    else:
+        attn = D * (H + 2 * KVH) * Dh + H * Dh * D
+    return 2.0 * (L * attn + mlp_total + D * mcfg.vocab_size)
+
+
+def _attn_seq_flops_per_token(mcfg) -> float:
+    """Attention score+update flops per token PER CACHED POSITION across
+    all layers (multiplied by avg seq len by the callers). llama: q·k
+    and p·v over H heads of Dh. MLA absorbed decode: scores contract
+    (rank+dr) lanes and the update contracts rank lanes per head."""
+    rank = getattr(mcfg, "kv_lora_rank", 0)
+    if rank > 0:
+        dr = mcfg.qk_rope_head_dim
+        return (2.0 * mcfg.num_heads * (2 * rank + dr)
+                * mcfg.num_layers)
+    return 4.0 * mcfg.num_heads * mcfg.head_dim * mcfg.num_layers
 
 
 def device_timing(core, mcfg, batch, pos0, *,
@@ -143,13 +174,12 @@ def device_timing(core, mcfg, batch, pos0, *,
     # bytes per token across all layers, straight from the pool arrays —
     # covers int8 pools (and their scale arrays) without dtype special
     # cases
-    ntok = core.kv["k"].shape[1]
+    ntok = next(iter(core.kv.values())).shape[1]
     kv_bytes = (batch * avg_seq_len
                 * sum(a.nbytes for a in core.kv.values()) / ntok)
     # weight-only int8 dequantizes into bf16 MXU matmuls → bf16 peak
     flops = batch * (_matmul_flops_per_token(mcfg)
-                     + 4.0 * mcfg.num_heads * mcfg.head_dim
-                     * avg_seq_len * mcfg.num_layers)
+                     + _attn_seq_flops_per_token(mcfg) * avg_seq_len)
     return {
         "device_step_ms": round(step_s * 1e3, 3),
         "device_tok_per_s": round(batch / step_s, 1),
@@ -270,8 +300,9 @@ def _metric_name(model: str, batch: int, quant: str,
     name for the default int8 config; any other quantization suffixes
     it — an int4 or int8-KV run must NOT post to the int8 gate
     history."""
-    # the qwen2moe model name already carries its family — no prefix
-    family = {"moe": "mixtral_", "qwen2moe": ""}.get(model, "llama")
+    # qwen2moe / mla model names already carry their family — no prefix
+    family = {"moe": "mixtral_", "qwen2moe": "",
+              "mla": "deepseek_"}.get(model, "llama")
     name = (f"decode_tok_per_s_chip_{family}{model}_b{batch}"
             + ("" if quant == "none" else f"_{quant}")
             + ("" if kv_quant == "none" else "_kv8"))
@@ -437,7 +468,7 @@ def main() -> None:
             # first call paid XLA compilation; time steady-state prefill
             warmed = True
             t_prefill0 = time.monotonic()
-    jax.block_until_ready(core.kv["k"])
+    jax.block_until_ready(next(iter(core.kv.values())))
     prefill_s = time.monotonic() - t_prefill0
     prefill_batch = max(batch - 1, 1)   # first (compile) prefill untimed
 

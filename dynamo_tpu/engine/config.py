@@ -477,8 +477,42 @@ def bench_model_config(name: str) -> "ModelConfig":
                            attention_bias=True, num_experts=8,
                            num_experts_per_tok=4, moe_norm_topk=False,
                            shared_expert_size=5632)
+    if name == "tiny_mla":
+        # CI-sized MLA geometry: exercises the bench's MLA path (latent
+        # {"kv"} pool, absorbed-decode flop accounting, hybrid MoE)
+        # without the real weights (tests/test_bench_smoke.py)
+        return ModelConfig(model_type="deepseek_v2", vocab_size=2048,
+                           hidden_size=256, intermediate_size=128,
+                           num_layers=4, num_heads=8, num_kv_heads=8,
+                           head_dim=48, max_position_embeddings=2048,
+                           q_lora_rank=0, kv_lora_rank=64,
+                           qk_nope_head_dim=32, qk_rope_head_dim=16,
+                           v_head_dim=32, num_experts=4,
+                           num_experts_per_tok=2, moe_norm_topk=False,
+                           first_k_dense=1, dense_intermediate_size=256,
+                           shared_expert_size=256)
+    if name == "mla":
+        # DeepSeek-V2-Lite-class MLA geometry, one-chip (~3.3 GB int8):
+        # Lite's D/L/heads/MLA dims/expert-F/shared/hybrid layout with
+        # the expert COUNT cut 64 → 8 to fit (the qwen2moe precedent:
+        # expert count only scales the dense-over-E einsum). What this
+        # geometry times is the MLA serving win — the absorbed decode
+        # reads ONE 576-lane latent row per token instead of
+        # KVH·Dh·2 expanded lanes — plus the deepseek MoE block.
+        return ModelConfig(model_type="deepseek_v2", vocab_size=102400,
+                           hidden_size=2048, intermediate_size=1408,
+                           num_layers=27, num_heads=16, num_kv_heads=16,
+                           head_dim=192, max_position_embeddings=8192,
+                           rope_theta=10000.0,
+                           q_lora_rank=0, kv_lora_rank=512,
+                           qk_nope_head_dim=128, qk_rope_head_dim=64,
+                           v_head_dim=128, num_experts=8,
+                           num_experts_per_tok=6, moe_norm_topk=False,
+                           first_k_dense=1, dense_intermediate_size=10944,
+                           shared_expert_size=2816)
     raise ValueError(f"unknown bench model {name!r} "
-                     f"(tiny|1b|8b|70b_tp8shard|moe|qwen2moe)")
+                     f"(tiny|tiny_mla|1b|8b|70b_tp8shard|moe|qwen2moe"
+                     f"|mla)")
 
 
 @dataclasses.dataclass

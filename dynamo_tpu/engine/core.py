@@ -127,15 +127,6 @@ class EngineCore:
         if self.is_mla:
             from .models import mla
             self.model_mod = mla
-            if mesh is not None and mesh.shape.get("sp", 1) > 1:
-                # dp/tp/ep mesh axes work through the param/KV pspecs
-                # (parallel/sharding.py: head-sharded projections,
-                # replicated latent pool); the ring-attention prefill is
-                # llama-only (llama.prefill_forward_sp)
-                raise NotImplementedError(
-                    "MLA + sequence-parallel (sp > 1) prefill is not "
-                    "integrated yet (ring attention expands k/v per "
-                    "shard; the latent-row form needs its own ring)")
             if engine_cfg.quantization.startswith("int4"):
                 # int8 works (quant.py _LAYER_MATMULS carries the MLA
                 # names; wkv_b deliberately stays full precision for the
@@ -404,7 +395,7 @@ class EngineCore:
             def prefill_sp(params, kv, tokens, block_table, true_len,
                            key, temperature, top_k, top_p):
                 params = unpack_params(params)
-                logits, kv = llama.prefill_forward_sp(
+                logits, kv = self.model_mod.prefill_forward_sp(
                     params, kv, tokens, block_table, true_len, statics, mesh)
                 tok, logprob = sample_tokens(
                     logits[None, :], key[None], temperature[None],

@@ -35,9 +35,11 @@ meshes (parallel/sharding.py: head-sharded projections, replicated
 latent pool, expert-parallel MoE stacks), int8 latent-KV pools
 (init_kv_cache quantization="int8": in-row scales, one pair per
 c_kv/k_pe section), int8 weights (quant._LAYER_MATMULS; wkv_b stays
-full precision for the absorbed einsums), and the host KV tier (latent
-rows ship whole as one opaque wire head — llm/kv/offload.py). Still
-refusing loudly: sp > 1 (ring prefill is llama-only) and int4 weights.
+full precision for the absorbed einsums), the host KV tier (latent
+rows ship whole as one opaque wire head — llm/kv/offload.py), both
+disagg planes, and sequence-parallel ring prefill (prefill_forward_sp:
+the ring moves compressed latent rows and accumulates in rank-space).
+Still refusing loudly: int4 weights.
 """
 
 from __future__ import annotations
@@ -384,10 +386,11 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
                 # RMSNormed c_kv and the unnormalized post-rope k_pe
                 # must not share an absmax (10-50x magnitude skew on
                 # real checkpoints would crush the latent's
-                # resolution). Every reader (incl. this step's own rows
-                # — both attn paths gather from the pool) dequantizes
-                # the same encoding, so the current token sees the same
-                # quantized latent later steps do
+                # resolution). Every reader dequantizes the same
+                # encoding — the pool-reading attn paths gather these
+                # rows back, and the sp ring round-trips its fresh rows
+                # through the same encode/decode — so the current token
+                # sees the same quantized latent later steps do
                 pool = pool.at[li, slots, :].set(
                     quantize_kv_rows_sections(
                         rows, (cfg.kv_lora_rank, cfg.qk_rope_head_dim)),
@@ -497,6 +500,59 @@ def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
         scores = jnp.where(mask[None, :, :], scores, NEG_INF)
         probs = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("hts,hsd->thd", probs, v)       # [T, H, dv]
+        return out.reshape(T, H * cfg.v_head_dim).astype(q_nope.dtype)
+
+    x = _embed(params, tokens, cfg)
+    x, kv_new = _run_layers(params, kv, x, positions, slots, cfg, attn)
+    last = x[jnp.maximum(true_len - 1, 0)]
+    return _logits(params, last, cfg), kv_new
+
+
+def prefill_forward_sp(params: Params, kv: KVCache, tokens: jax.Array,
+                       block_table: jax.Array, true_len: jax.Array,
+                       statics: ModelStatics, mesh
+                       ) -> Tuple[jax.Array, KVCache]:
+    """Sequence-parallel whole-prompt prefill: same contract as
+    llama.prefill_forward_sp (start_pos fixed at 0; T divides the sp
+    axis). The ring (parallel/ring_attention.ring_attention_mla) is the
+    ABSORBED form lifted to prefill: queries drop into latent space
+    once, the ICI hops move only the compressed [S/sp, rank+rope] row
+    chunks (vs llama's per-head 2·KVH·Dh payload), the softmax
+    accumulates in rank-space with the hop streamed in bounded
+    sub-chunks (ring_attention.RING_SUB_CHUNK), and w_v applies once
+    after the ring. Per-device state is the absorbed form's inherent
+    O(T·H·rank / sp) for q_lat/acc; ring traffic is
+    O(T·(rank+rope) / sp)."""
+    from ...parallel.ring_attention import ring_attention_mla
+
+    cfg, bsz = statics.cfg, statics.block_size
+    T = tokens.shape[0]
+    H = cfg.num_heads
+    rank = cfg.kv_lora_rank
+    dr = cfg.qk_rope_head_dim
+    scale = softmax_scale(cfg)
+    quantized = kv["kv"].dtype == jnp.int8
+    positions = jnp.arange(T, dtype=jnp.int32)
+    valid = positions < true_len
+    slots = jnp.where(
+        valid, block_table[positions // bsz] * bsz + positions % bsz, 0)
+
+    def attn(q_nope, q_pe, rows, _kv_flat, lp, _li):
+        if quantized:
+            # int8-KV invariant (same as the pool-reading paths): this
+            # chunk's attention must see exactly the rows decode will
+            # read later — round-trip through the sectioned encoding
+            rows = dequant_kv_rows_sections(
+                quantize_kv_rows_sections(rows, (rank, dr)),
+                (rank, dr), jnp.float32)
+        w_k, w_v = _split_wkv_b(lp, cfg)
+        q_lat = jnp.einsum("thd,hrd->thr", q_nope.astype(jnp.float32),
+                           w_k.astype(jnp.float32))
+        ctx = ring_attention_mla(
+            q_lat, q_pe.astype(jnp.float32), rows.astype(jnp.float32),
+            mesh, scale=scale, rank=rank, kv_len=true_len)
+        out = jnp.einsum("thr,hrd->thd", ctx.astype(jnp.float32),
+                         w_v.astype(jnp.float32))
         return out.reshape(T, H * cfg.v_head_dim).astype(q_nope.dtype)
 
     x = _embed(params, tokens, cfg)

@@ -28,7 +28,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -1e30
 
-__all__ = ["ring_attention_local", "ring_attention"]
+# MLA hop bodies stream the resident row chunk in sub-chunks of this
+# many tokens so the live [H, Tl, sub] score buffer stays bounded at
+# long context (chunks not divisible by it run as one piece)
+RING_SUB_CHUNK = 1024
+
+__all__ = ["ring_attention_local", "ring_attention",
+           "ring_attention_mla_local", "ring_attention_mla"]
 
 
 def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -130,6 +136,116 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
         step, (k, v, m0, l0, acc0), jnp.arange(n))
     out = acc / jnp.maximum(l, 1e-20)                          # [KVH,g,Tl,Dh]
     return out.transpose(2, 0, 1, 3).reshape(Tl, H, Dh).astype(q.dtype)
+
+
+def ring_attention_mla_local(q_lat: jax.Array, q_pe: jax.Array,
+                             rows: jax.Array, *, axis_name: str,
+                             scale: float, rank: int,
+                             kv_len: Optional[jax.Array] = None
+                             ) -> jax.Array:
+    """Per-shard MLA ring body (models/mla.py absorbed attention, call
+    inside shard_map over ``axis_name``).
+
+    The ring payload is the LATENT ROW chunk [Sl, rank+rope] — e.g. 576
+    lanes per token TOTAL, vs llama's per-head 2·KVH·Dh — and the
+    accumulator lives in latent space: scores contract q_lat·c + q_pe·
+    k_pe per hop and the context accumulates p·c as [Tl, H, rank]; the
+    caller applies w_v ONCE after the ring. That is the absorbed-decode
+    trick lifted to sequence-parallel prefill: per-hop compute is three
+    rank-space matmuls while the ICI hop moves only compressed rows.
+
+    q_lat: [Tl, H, rank] (queries already dropped into latent space via
+    w_k), q_pe: [Tl, H, dr] (post-rope), rows: [Sl, rank+dr] (post-norm
+    c_kv | post-rope k_pe). Returns the latent context [Tl, H, rank].
+
+    Transient memory: the hop body streams the resident chunk in
+    RING_SUB_CHUNK-row sub-chunks through the same online-softmax
+    recurrence, so the live score buffer is [H, Tl, sub] — not
+    [H, Tl, Sl] — and per-hop transients stay bounded at long context
+    (the state itself, q_lat/acc [Tl, H, rank], is the absorbed form's
+    inherent footprint)."""
+    Tl, H, R = q_lat.shape
+    Sl = rows.shape[0]
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    q_offset = me * Tl
+    total = n * Sl if kv_len is None else kv_len
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    qpos = q_offset + jnp.arange(Tl, dtype=jnp.int32)          # [Tl]
+
+    ql = q_lat.astype(jnp.float32) * scale                     # [Tl,H,R]
+    qp = q_pe.astype(jnp.float32) * scale
+
+    sub = RING_SUB_CHUNK if Sl % RING_SUB_CHUNK == 0 else Sl
+    n_sub = Sl // sub
+
+    m0 = jnp.full((H, Tl, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((H, Tl, 1), jnp.float32)
+    acc0 = jnp.zeros((H, Tl, R), jnp.float32)
+
+    def step(carry, s):
+        rows_c, m, l, acc = carry
+        src = (me - s) % n                     # who computed this chunk
+
+        def sub_step(carry2, j):
+            m, l, acc = carry2
+            rows_s = jax.lax.dynamic_slice_in_dim(rows_c, j * sub, sub)
+            c = rows_s[:, :rank].astype(jnp.float32)           # [sub,R]
+            pe = rows_s[:, rank:].astype(jnp.float32)          # [sub,dr]
+            kpos = src * Sl + j * sub + jnp.arange(sub, dtype=jnp.int32)
+            scores = (jnp.einsum("thr,sr->hts", ql, c)
+                      + jnp.einsum("thd,sd->hts", qp, pe))     # [H,Tl,sub]
+            mask = ((kpos[None, :] <= qpos[:, None])
+                    & (kpos[None, :] < total))
+            scores = jnp.where(mask[None, :, :], scores, NEG_INF)
+            m_cur = jnp.max(scores, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, m_cur)
+            p = jnp.exp(scores - m_new)
+            # fully-masked rows: exp(NEG_INF - NEG_INF) = 1 — zero them
+            # so padded chunks contribute nothing
+            p = jnp.where(m_new > NEG_INF / 2, p, 0.0)
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc = acc * alpha + jnp.einsum("hts,sr->htr", p, c)
+            return (m_new, l, acc), None
+
+        (m, l, acc), _ = jax.lax.scan(sub_step, (m, l, acc),
+                                      jnp.arange(n_sub))
+        rows_n = jax.lax.ppermute(rows_c, axis_name, perm)
+        return (rows_n, m, l, acc), None
+
+    (_, m, l, acc), _ = jax.lax.scan(step, (rows, m0, l0, acc0),
+                                     jnp.arange(n))
+    ctx = acc / jnp.maximum(l, 1e-20)                          # [H,Tl,R]
+    return ctx.transpose(1, 0, 2).astype(q_lat.dtype)
+
+
+def ring_attention_mla(q_lat: jax.Array, q_pe: jax.Array,
+                       rows: jax.Array, mesh: Mesh, *, scale: float,
+                       rank: int, axis_name: str = "sp",
+                       tp_axis: Optional[str] = "tp",
+                       kv_len: Optional[jax.Array] = None) -> jax.Array:
+    """Global entry: q_lat [T, H, rank] / q_pe [T, H, dr] with the
+    sequence axis sharded over ``axis_name`` (heads optionally over
+    ``tp_axis``); rows [S, rank+dr] sequence-sharded and REPLICATED over
+    tp (every head reads the same latent rows). T and S must divide by
+    the axis size. Returns the latent context [T, H, rank]."""
+    head_ax = tp_axis if (tp_axis and tp_axis in mesh.shape) else None
+    spec_q = P(axis_name, head_ax, None)
+    spec_rows = P(axis_name, None)
+    kv_spec = None if kv_len is None else P()
+
+    def body(ql, qp, r, *rest):
+        kvl = rest[0] if rest else None
+        return ring_attention_mla_local(ql, qp, r, axis_name=axis_name,
+                                        scale=scale, rank=rank,
+                                        kv_len=kvl)
+
+    args = (q_lat, q_pe, rows) + ((kv_len,) if kv_len is not None else ())
+    in_specs = (spec_q, spec_q, spec_rows) + (
+        (kv_spec,) if kv_len is not None else ())
+    return shard_map(body, mesh=mesh, in_specs=in_specs,
+                     out_specs=spec_q, check_rep=False)(*args)
 
 
 def _default_impl(num_heads: int, num_kv_heads: int, head_dim: int) -> str:

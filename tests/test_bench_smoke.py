@@ -47,6 +47,24 @@ def test_bench_runs_and_prints_json():
     assert out["extra"]["platform"] == "cpu"
 
 
+def test_bench_mla_geometry_runs():
+    """The MLA bench path (latent {"kv"} pool, absorbed-decode flop
+    accounting): bench.py must run the deepseek-class geometry — the
+    device-truth run uses BENCH_MODEL=mla; this smokes the same code
+    with CI-sized shapes."""
+    r = _run(
+        [sys.executable, "bench.py"],
+        {"BENCH_FORCE_CPU": "1", "BENCH_MODEL": "tiny_mla",
+         "BENCH_BATCH": "2", "BENCH_STEPS": "4", "BENCH_PROMPT": "16",
+         "BENCH_HARVEST": "2", "BENCH_QUANT": "none"})
+    assert r.returncode == 0, f"bench.py crashed:\n{r.stderr[-4000:]}"
+    lines = [l for l in r.stdout.strip().splitlines()
+             if l.startswith("{")]
+    out = json.loads(lines[-1])
+    assert out["value"] > 0 and "error" not in out
+    assert "tiny_mla" in out["metric"]
+
+
 def test_bench_pipelined_and_unpipelined():
     """Both harvest modes run (the round-1 breakage was in the multi-step
     dispatch path specifically)."""

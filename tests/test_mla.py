@@ -337,13 +337,7 @@ def test_mla_engine_unsupported_combinations_refuse():
     with pytest.raises(NotImplementedError, match="int4"):
         EngineCore(cfg, EngineConfig(**base, quantization="int4"),
                    attn_impl="xla", param_dtype=jnp.float32)
-    if len(jax.devices()) >= 2:
-        # tp meshes WORK now (test_mla_engine_serves_sharded); the ring
-        # prefill is still llama-only, so sp > 1 must keep refusing
-        with pytest.raises(NotImplementedError, match="sp"):
-            EngineCore(cfg, EngineConfig(**base), attn_impl="xla",
-                       param_dtype=jnp.float32,
-                       mesh=make_mesh(dp=1, tp=1, sp=2))
+    del make_mesh   # tp/ep/sp meshes all work now (tests below)
 
 
 async def _greedy_tokens(core, rid, prompt, n=8):
@@ -609,6 +603,148 @@ async def test_mla_int8_weights_serving_end_to_end():
         assert all(0 <= t < cfg.vocab_size for t in toks)
     finally:
         await core.stop()
+
+
+def test_mla_sp_ring_prefill_matches_whole():
+    """The latent-row ring (parallel/ring_attention.ring_attention_mla):
+    sequence-parallel prefill over an sp=2 mesh must reproduce the
+    plain whole-prompt prefill — logits AND every scattered latent row
+    (the pool is what decode reads later). tp=2 as well, so the
+    head-sharded q_lat and the replicated row chunks cross shardings."""
+    from dynamo_tpu.parallel.sharding import make_mesh
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    cfg = _cfg(q_lora=12)
+    params = mla.init_params(cfg, jax.random.PRNGKey(55),
+                             dtype=jnp.float32)
+    rng = np.random.default_rng(56)
+    tokens = rng.integers(1, cfg.vocab_size, size=56).tolist()
+    T = 64                                  # divides sp=2
+    padded = np.zeros((T,), np.int32)
+    padded[:len(tokens)] = tokens
+    table = np.zeros((NUM_BLOCKS,), np.int32)
+    table[:T // BS] = np.arange(1, 1 + T // BS)
+
+    kv1 = mla.init_kv_cache(cfg, NUM_BLOCKS, BS, dtype=jnp.float32)
+    want, kv1 = mla.prefill_forward(
+        params, kv1, jnp.asarray(padded), jnp.asarray(table),
+        jnp.asarray(0, jnp.int32), jnp.asarray(len(tokens), jnp.int32),
+        _statics(cfg))
+
+    mesh = make_mesh(dp=1, tp=2, sp=2)
+    kv2 = mla.init_kv_cache(cfg, NUM_BLOCKS, BS, dtype=jnp.float32)
+    got, kv2 = mla.prefill_forward_sp(
+        params, kv2, jnp.asarray(padded), jnp.asarray(table),
+        jnp.asarray(len(tokens), jnp.int32), _statics(cfg), mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(kv2["kv"]),
+                               np.asarray(kv1["kv"]),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_mla_sp_ring_sub_chunked_matches_whole(monkeypatch):
+    """The hop body's sub-chunk streaming (bounded [H, Tl, sub] score
+    transients at long context) is exact: with RING_SUB_CHUNK forced
+    tiny so every hop runs multiple sub-steps, the sp prefill still
+    equals the whole-prompt run."""
+    from dynamo_tpu.parallel import ring_attention as ra
+    from dynamo_tpu.parallel.sharding import make_mesh
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    monkeypatch.setattr(ra, "RING_SUB_CHUNK", 8)   # Sl=32 → 4 sub-steps
+    cfg = _cfg()
+    params = mla.init_params(cfg, jax.random.PRNGKey(58),
+                             dtype=jnp.float32)
+    rng = np.random.default_rng(59)
+    tokens = rng.integers(1, cfg.vocab_size, size=50).tolist()
+    T = 64
+    padded = np.zeros((T,), np.int32)
+    padded[:len(tokens)] = tokens
+    table = np.zeros((NUM_BLOCKS,), np.int32)
+    table[:T // BS] = np.arange(1, 1 + T // BS)
+    kv1 = mla.init_kv_cache(cfg, NUM_BLOCKS, BS, dtype=jnp.float32)
+    want, _ = mla.prefill_forward(
+        params, kv1, jnp.asarray(padded), jnp.asarray(table),
+        jnp.asarray(0, jnp.int32), jnp.asarray(len(tokens), jnp.int32),
+        _statics(cfg))
+    kv2 = mla.init_kv_cache(cfg, NUM_BLOCKS, BS, dtype=jnp.float32)
+    got, _ = mla.prefill_forward_sp(
+        params, kv2, jnp.asarray(padded), jnp.asarray(table),
+        jnp.asarray(len(tokens), jnp.int32), _statics(cfg),
+        make_mesh(dp=1, tp=1, sp=2))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.asyncio
+async def test_mla_sp_int8_kv_matches_single_chip():
+    """sp ring + int8 latent pool: the ring round-trips its fresh rows
+    through the sectioned encoding so prefill attention sees exactly
+    the rows decode will read — greedy continuation must equal the
+    single-chip int8-KV engine's (the invariant the non-sp paths keep
+    by gathering from the pool)."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.parallel.sharding import make_mesh
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    cfg = _cfg()
+    params = mla.init_params(cfg, jax.random.PRNGKey(62),
+                             dtype=jnp.float32)
+    ecfg = dict(max_model_len=128, kv_block_size=8, num_kv_blocks=64,
+                max_num_seqs=2, prefill_buckets=[64, 128],
+                sp_min_prefill_tokens=32, decode_steps_per_dispatch=4,
+                kv_quantization="int8")
+    prompt = list(range(2, 60))
+    ref = EngineCore(cfg, EngineConfig(**ecfg), params=dict(params),
+                     attn_impl="xla", param_dtype=jnp.float32)
+    try:
+        want = await _greedy_tokens(ref, "ref", prompt)
+    finally:
+        await ref.stop()
+    core = EngineCore(cfg, EngineConfig(**ecfg), params=dict(params),
+                      attn_impl="xla", param_dtype=jnp.float32,
+                      mesh=make_mesh(dp=1, tp=1, sp=2))
+    try:
+        got = await _greedy_tokens(core, "sp8", prompt)
+    finally:
+        await core.stop()
+    assert got == want
+
+
+@pytest.mark.asyncio
+async def test_mla_engine_serves_over_sp_mesh():
+    """EngineCore's sp dispatch path (model_mod.prefill_forward_sp) with
+    MLA: a long prompt takes the ring prefill and the greedy
+    continuation equals the single-chip engine's."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.parallel.sharding import make_mesh
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 virtual devices")
+    cfg = _cfg()
+    params = mla.init_params(cfg, jax.random.PRNGKey(57),
+                             dtype=jnp.float32)
+    ecfg = dict(max_model_len=128, kv_block_size=8, num_kv_blocks=64,
+                max_num_seqs=2, prefill_buckets=[64, 128],
+                sp_min_prefill_tokens=32, decode_steps_per_dispatch=4)
+    prompt = list(range(2, 60))             # 58 tokens >= sp_min 32
+    ref = EngineCore(cfg, EngineConfig(**ecfg), params=dict(params),
+                     attn_impl="xla", param_dtype=jnp.float32)
+    try:
+        want = await _greedy_tokens(ref, "ref", prompt)
+    finally:
+        await ref.stop()
+    core = EngineCore(cfg, EngineConfig(**ecfg), params=dict(params),
+                      attn_impl="xla", param_dtype=jnp.float32,
+                      mesh=make_mesh(dp=1, tp=1, sp=2))
+    assert core._prefill_sp_jit is not None
+    try:
+        got = await _greedy_tokens(core, "sp", prompt)
+    finally:
+        await core.stop()
+    assert got == want
 
 
 @pytest.mark.asyncio
