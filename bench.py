@@ -302,7 +302,8 @@ def _metric_name(model: str, batch: int, quant: str,
     history."""
     # qwen2moe / mla model names already carry their family — no prefix
     family = {"moe": "mixtral_", "qwen2moe": "",
-              "mla": "deepseek_"}.get(model, "llama")
+              "mla": "deepseek_", "tiny_mla": "deepseek_"}.get(
+                  model, "llama")
     name = (f"decode_tok_per_s_chip_{family}{model}_b{batch}"
             + ("" if quant == "none" else f"_{quant}")
             + ("" if kv_quant == "none" else "_kv8"))

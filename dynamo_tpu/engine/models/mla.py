@@ -244,6 +244,21 @@ def init_params(cfg: ModelConfig, key: jax.Array,
     return params
 
 
+def latent_row_lanes(cfg: ModelConfig, quantization: str = "none") -> int:
+    """Pool row width. int8 rows carry the sectioned in-row scales
+    (rank+rope + KV_SCALE_LANES). Full-precision rows PAD rank+rope up
+    to a 128-lane multiple (e.g. 512+64 -> 640): the lane alignment is
+    what makes the latent pool a legal block-DMA source for the Pallas
+    paged-attention kernel (decode maps onto it as MQA — see
+    decode_forward); readers slice [:rank] / [rank:rank+rope], so the
+    pad lanes are write-only zeros."""
+    C = cfg.kv_lora_rank + cfg.qk_rope_head_dim
+    if quantization == "int8":
+        from ..attention import KV_SCALE_LANES
+        return C + KV_SCALE_LANES
+    return -(-C // 128) * 128
+
+
 def init_kv_cache(cfg: ModelConfig, num_blocks: int,
                   block_size: int, dtype=jnp.bfloat16,
                   quantization: str = "none") -> KVCache:
@@ -253,18 +268,14 @@ def init_kv_cache(cfg: ModelConfig, num_blocks: int,
     128-lane pad, so the row width matches the llama encoding). Unlike
     llama pools there is never a per-tp-shard section: the latent pool
     replicates under tp (parallel/sharding.shard_kv), so every rank
-    reads whole rows."""
-    C = cfg.kv_lora_rank + cfg.qk_rope_head_dim
-    if quantization == "int8":
-        from ..attention import KV_SCALE_LANES
-        return {"kv": jnp.zeros(
-            (cfg.num_layers, num_blocks * block_size,
-             C + KV_SCALE_LANES), dtype=jnp.int8)}
-    if quantization != "none":
+    reads whole rows. Row widths: latent_row_lanes."""
+    if quantization not in ("none", "int8"):
         raise ValueError(f"unknown kv quantization {quantization!r} "
                          f"(none|int8)")
+    W = latent_row_lanes(cfg, quantization)
     return {"kv": jnp.zeros(
-        (cfg.num_layers, num_blocks * block_size, C), dtype=dtype)}
+        (cfg.num_layers, num_blocks * block_size, W),
+        dtype=jnp.int8 if quantization == "int8" else dtype)}
 
 
 # ---------------------------------------------------------------------------
@@ -396,8 +407,13 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
                         rows, (cfg.kv_lora_rank, cfg.qk_rope_head_dim)),
                     mode="drop")
             else:
-                pool = pool.at[li, slots, :].set(rows.astype(pool.dtype),
-                                                 mode="drop")
+                pad = pool.shape[2] - rows.shape[1]
+                # 128-lane row alignment (latent_row_lanes); attn_fn
+                # below must keep seeing the UNPADDED rows
+                padded = (jnp.pad(rows, ((0, 0), (0, pad))) if pad
+                          else rows)
+                pool = pool.at[li, slots, :].set(
+                    padded.astype(pool.dtype), mode="drop")
             attn = attn_fn(q_nope, q_pe, rows,
                            pool.reshape(L * NTOK, pool.shape[2]), lp, li)
             h = h + mm(attn, lp["wo"])
@@ -478,11 +494,11 @@ def prefill_forward(params: Params, kv: KVCache, tokens: jax.Array,
         idx = (flat_token_indices(block_table[None, :], bsz)[0]
                + li * NTOK)
         S = idx.shape[0]
-        rows = jnp.take(kv_flat, idx, axis=0)            # [S, rank+dr]
+        rows = jnp.take(kv_flat, idx, axis=0)            # [S, W]
         if rows.dtype == jnp.int8:
             rows = dequant_kv_rows_sections(rows, (rank, dr),
                                             jnp.float32)
-        c, k_pe = rows[..., :rank], rows[..., rank:]
+        c, k_pe = rows[..., :rank], rows[..., rank:rank + dr]
         w_k, w_v = _split_wkv_b(lp, cfg)
         # expand: k_nope [H, S, dn], v [H, S, dv]
         k_nope = jnp.einsum("sr,hrd->hsd", c.astype(jnp.float32),
@@ -575,7 +591,18 @@ def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
     Absorption: scores_h = (q_nope_h W_k_h)·c + q_pe_h·k_pe and
     out_h = (probs·c) W_v_h — queries drop into latent space once per
     step, so the per-token HBM read is ONE (rank+rope)-lane row shared
-    by all H heads (the serving win MLA exists for)."""
+    by all H heads (the serving win MLA exists for).
+
+    Full-precision pools route through the SHARED paged-attention stack
+    (attention.paged_attention) as MQA: the 128-aligned latent row
+    (latent_row_lanes) is the single "kv head", the combined query
+    [q_lat | q_pe | 0-pad] dots against whole rows (pad lanes are
+    zeros on both sides), the pool serves as k AND v, and the output's
+    first `rank` lanes ARE probs·c. On TPU that is the block-DMA
+    Pallas kernel — the XLA row-gather measured ~27x the pure-bandwidth
+    cost of the latent read at seq ≈1K (PERF.md). int8 pools keep the
+    explicit gather + sectioned dequant (the shared int8 row codec is
+    the llama grouped encoding, not the sectioned one)."""
     cfg, bsz = statics.cfg, statics.block_size
     B = tokens.shape[0]
     H = cfg.num_heads
@@ -588,25 +615,38 @@ def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
     def attn(q_nope, q_pe, _rows, kv_flat, lp, li):
         NTOK = kv_flat.shape[0] // cfg.num_layers
         num_blocks = NTOK // bsz
-        idx = flat_token_indices(block_tables + li * num_blocks, bsz)
-        T = idx.shape[1]
-        rows = jnp.take(kv_flat, idx, axis=0)            # [B, T, rank+dr]
-        if rows.dtype == jnp.int8:
-            rows = dequant_kv_rows_sections(rows, (rank, dr),
-                                            jnp.float32)
-        c = rows[..., :rank].astype(jnp.float32)
-        k_pe = rows[..., rank:].astype(jnp.float32)
+        tables_l = block_tables + li * num_blocks
         w_k, w_v = _split_wkv_b(lp, cfg)
         # absorb the k expansion into the query: [B, H, rank]
         q_lat = jnp.einsum("bhd,hrd->bhr", q_nope.astype(jnp.float32),
                            w_k.astype(jnp.float32))
-        scores = (jnp.einsum("bhr,btr->bht", q_lat, c)
-                  + jnp.einsum("bhd,btd->bht",
-                               q_pe.astype(jnp.float32), k_pe)) * scale
-        mask = jnp.arange(T)[None, :] < seq_lens[:, None]
-        scores = jnp.where(mask[:, None, :], scores, NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1)
-        ctx = jnp.einsum("bht,btr->bhr", probs, c)       # [B, H, rank]
+        if kv_flat.dtype != jnp.int8:
+            from ..attention import paged_attention
+            W = kv_flat.shape[-1]
+            qc = jnp.concatenate(
+                [q_lat, q_pe.astype(jnp.float32),
+                 jnp.zeros((B, H, W - rank - dr), jnp.float32)],
+                axis=-1).astype(kv_flat.dtype)
+            ctx = paged_attention(
+                qc, kv_flat, kv_flat, tables_l, seq_lens,
+                block_size=bsz, scale=scale, impl=statics.attn_impl,
+                kv_heads=1)[..., :rank].astype(jnp.float32)
+        else:
+            idx = flat_token_indices(tables_l, bsz)
+            T = idx.shape[1]
+            rows = jnp.take(kv_flat, idx, axis=0)        # [B, T, W]
+            rows = dequant_kv_rows_sections(rows, (rank, dr),
+                                            jnp.float32)
+            c = rows[..., :rank]
+            k_pe = rows[..., rank:rank + dr]
+            scores = (jnp.einsum("bhr,btr->bht", q_lat, c)
+                      + jnp.einsum("bhd,btd->bht",
+                                   q_pe.astype(jnp.float32),
+                                   k_pe)) * scale
+            mask = jnp.arange(T)[None, :] < seq_lens[:, None]
+            scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+            probs = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bht,btr->bhr", probs, c)   # [B, H, rank]
         out = jnp.einsum("bhr,hrd->bhd", ctx,
                          w_v.astype(jnp.float32))        # [B, H, dv]
         return out.reshape(B, H * cfg.v_head_dim).astype(q_nope.dtype)

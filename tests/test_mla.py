@@ -8,6 +8,8 @@ integration and the deepseek MoE variants follow. The family stays
 rejected in from_hf_config until the engine serves it.
 """
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -109,10 +111,16 @@ def test_latent_cache_row_geometry():
     cfg = _cfg()
     kv = mla.init_kv_cache(cfg, NUM_BLOCKS, BS, dtype=jnp.float32)
     assert set(kv) == {"kv"}
-    # per-token row = compressed latent + rope-k — NOT H*(qk+v); the
-    # serving win: 24 lanes here vs 4*(24+16)=160 for the expanded cache
+    # per-token row = compressed latent + rope-k, padded to a 128-lane
+    # multiple (latent_row_lanes — the Pallas block-DMA alignment); at
+    # the real 512+64 geometry that is 640 lanes vs H*(192+128) for an
+    # expanded cache — the serving win
     assert kv["kv"].shape == (2, NUM_BLOCKS * BS,
-                              cfg.kv_lora_rank + cfg.qk_rope_head_dim)
+                              mla.latent_row_lanes(cfg))
+    assert mla.latent_row_lanes(cfg) == 128       # pad128(16 + 8)
+    big = dataclasses.replace(cfg, kv_lora_rank=512, qk_rope_head_dim=64)
+    assert mla.latent_row_lanes(big) == 640
+    assert mla.latent_row_lanes(big, "int8") == 512 + 64 + 128
 
 
 def test_mla_prefill_matches_hf(mla_setup):
