@@ -531,19 +531,27 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
                        *, block_size: int, chunk: int, scale: float,
                        num_seqs: int, seqs_per_program: int,
                        softcap: float | None = None,
-                       value_lanes: int | None = None):
+                       quant_lanes: int | None = None,
+                       v_lanes: int | None = None):
     """q_ref: [G, Hp, C] sparse-slotted (VMEM); k_hbm/v_hbm: [NTOK, Cx]
     (HBM); o_ref: [G, Hp, C]; k_bufs/v_bufs: [2, chunk*block_size, Cx]
     double buffers; sems: DMA semaphore pair; m/l: [Hp, 1]; acc: [Hp, C]
     f32; wave_ref: [1] SMEM global wave-parity carried ACROSS programs.
 
     int8 KV pools carry their per-token scales IN-ROW (KV_SCALE_LANES;
-    Cx = C + 128, `value_lanes`=C): the block DMA is unchanged — ONE
+    Cx = C + 128, `quant_lanes`=C — the int8 flag AND payload width,
+    distinct from `v_lanes` below): the block DMA is unchanged — ONE
     contiguous copy fetches values + scales — and dequant_tile rescales
     each wave's [cbs, C] tile in ROW space before the dots (keepdim lane
     slices broadcast along lanes with no sublane↔lane movement; the
     score-space variant needed a transpose per wave and measured slower
     on v5e).
+
+    ``v_lanes`` (MLA latent pools, models/mla.py decode): v IS the
+    first v_lanes lanes of each k row (probs·c in the absorbed form),
+    so the v-side DMA is skipped entirely — HALVING the KV stream —
+    and the accumulator/output narrow to v_lanes. v_hbm/v_bufs are
+    untouched in this mode (the wrapper passes dummies).
 
     Each grid program handles G = seqs_per_program sequences (static
     unroll): per-program fixed costs (q/o block pipelining, grid step
@@ -571,8 +579,8 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
         sc = jnp.maximum(win_lo_ref[bi] + 1, 0) // (chunk * block_size)
         return nb, nc, sc
 
-    quantized = value_lanes is not None
-    C = value_lanes if quantized else q_ref.shape[-1]
+    quantized = quant_lanes is not None
+    C = quant_lanes if quantized else q_ref.shape[-1]
 
     def dequant_tile(tile):
         """[cbs, Cx] int8 tile → [cbs, C] f32 values, rescaled from the
@@ -587,9 +595,10 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
         return tile[:, :C].astype(jnp.float32) * scale
 
     def chunk_copies(sq, ci, slot, nb):
-        """2*chunk contiguous block copies of sequence `sq`'s chunk `ci`
-        into buffer `slot` (reconstructed identically at wait time; all
-        on one semaphore)."""
+        """Contiguous block copies of sequence `sq`'s chunk `ci` into
+        buffer `slot` — 2*chunk (k and v), or chunk in v-aliases-k mode
+        (reconstructed identically at wait time; all on one
+        semaphore)."""
         copies = []
         for j in range(chunk):                 # static unroll
             bi = ci * chunk + j
@@ -599,10 +608,11 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
                 k_hbm.at[pl.ds(blk * block_size, block_size), :],
                 k_bufs.at[slot, pl.ds(j * block_size, block_size), :],
                 sems.at[slot]))
-            copies.append(pltpu.make_async_copy(
-                v_hbm.at[pl.ds(blk * block_size, block_size), :],
-                v_bufs.at[slot, pl.ds(j * block_size, block_size), :],
-                sems.at[slot]))
+            if v_lanes is None:                # v aliases k otherwise
+                copies.append(pltpu.make_async_copy(
+                    v_hbm.at[pl.ds(blk * block_size, block_size), :],
+                    v_bufs.at[slot, pl.ds(j * block_size, block_size), :],
+                    sems.at[slot]))
         return copies
 
     @pl.when(pb == 0)
@@ -660,12 +670,13 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
 
             for c in chunk_copies(sq, ci, slot, num_blocks):
                 c.wait()
-            if quantized:
-                k = dequant_tile(k_bufs[slot])        # [cbs, C] f32
+            if quantized:                 # never with v_lanes (wrapper
+                k = dequant_tile(k_bufs[slot])        # refuses int8+alias)
                 v = dequant_tile(v_bufs[slot])
             else:
                 k = k_bufs[slot].astype(jnp.float32)  # [chunk*bs, C]
-                v = v_bufs[slot].astype(jnp.float32)
+                v = (k[:, :v_lanes] if v_lanes is not None
+                     else v_bufs[slot].astype(jnp.float32))
             sm = jax.lax.dot_general(qm, k, (((1,), (1,)), ((), ())))
             if softcap:
                 sm = softcap_scores(sm, softcap)    # [Hp, cbs]
@@ -724,12 +735,17 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                            win_lo: jax.Array | None = None,
                            chunk_blocks: int | None = None,
                            seqs_per_program: int | None = None,
+                           v_lanes: int | None = None,
                            interpret: bool = False) -> jax.Array:
     """Same contract as `paged_attention_xla`; KV stays in HBM and streams
     chunk-by-chunk with double buffering (no [B, M*BS] gather). Sliding
     windows are in-kernel (win_lo: [B], -1 for global layers). int8 pools
     (in-row scales, KV_SCALE_LANES) cut the DMA bytes 1.6× with the same
-    one-copy-per-block structure."""
+    one-copy-per-block structure.
+
+    ``v_lanes`` (MQA/MLA only, KVH == 1): v is the first v_lanes lanes
+    of each k row — the v-side DMA is skipped (HALVING the stream) and
+    the output narrows to [B, H, v_lanes]; v_cache is ignored."""
     B, H, Dh = q.shape
     NTOK, Cx = k_cache.shape
     quantized = k_cache.dtype == jnp.int8
@@ -742,6 +758,20 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             f"block_size={block_size}, kv={k_cache.dtype}): needs "
             f"KVH*Dh % 128 == 0 and block_size % 8 == 0 (int8 pools: "
             f"% 32, the int8 sublane tile) — see pallas_supported")
+    if v_lanes is not None and (KVH != 1 or v_lanes % 128 != 0
+                                or v_lanes > C):
+        raise ValueError(
+            f"v_lanes={v_lanes} needs an MQA-shaped pool (KVH == 1, got "
+            f"{KVH}) and a 128-aligned width <= {C}")
+    if v_lanes is not None and quantized:
+        # v = dequant(k)[:, :v_lanes] would be easy to WRITE but has no
+        # user (MLA int8 pools use the sectioned codec the kernel does
+        # not speak) and no test — refuse rather than ship a dead,
+        # unexercised compile path
+        raise ValueError(
+            "v_lanes on an int8 pool is not supported (MLA int8 pools "
+            "take the XLA sectioned-dequant path)")
+    Cv = C if v_lanes is None else v_lanes
     g = H // KVH
     M = block_tables.shape[1]
     if chunk_blocks is None:
@@ -783,13 +813,16 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             pl.BlockSpec(memory_space=pltpu.ANY),   # k_cache stays in HBM
             pl.BlockSpec(memory_space=pltpu.ANY),   # v_cache stays in HBM
         ],
-        out_specs=pl.BlockSpec((G, Hp, C), lambda b, *_: (b, 0, 0)),
+        out_specs=pl.BlockSpec((G, Hp, Cv), lambda b, *_: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((Hp, 1), jnp.float32),                 # m
             pltpu.VMEM((Hp, 1), jnp.float32),                 # l
-            pltpu.VMEM((Hp, C), jnp.float32),                 # acc
+            pltpu.VMEM((Hp, Cv), jnp.float32),                # acc
             pltpu.VMEM((2, chunk * block_size, Cx), k_cache.dtype),
-            pltpu.VMEM((2, chunk * block_size, Cx), v_cache.dtype),
+            # v buffers shrink to a dummy tile when v aliases k
+            pltpu.VMEM((2, chunk * block_size, Cx)
+                       if v_lanes is None else (1, 8, 128),
+                       v_cache.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SMEM((1,), jnp.int32),   # cross-program wave parity
         ],
@@ -804,15 +837,18 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             m_ref, l_ref, acc_ref, k_bufs, v_bufs, sems, wave_ref,
             block_size=block_size, chunk=chunk, scale=scale,
             num_seqs=Bp, seqs_per_program=G, softcap=softcap,
-            value_lanes=C if quantized else None)
+            quant_lanes=C if quantized else None, v_lanes=v_lanes)
 
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((Bp, Hp, C), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((Bp, Hp, Cv), q.dtype),
         interpret=interpret,
     )(block_tables, seq_lens, jnp.asarray(win_lo, jnp.int32), qm,
       k_cache, v_cache)
+    if v_lanes is not None:
+        # MQA: every head's slot is the whole row — no extraction
+        return out[:B, :H]
     # row h's useful lanes are its kv head's slot; the rest is cross-slot
     # garbage by construction
     out = out.reshape(Bp, Hp, KVH, Dh)[:B, :H]
@@ -838,7 +874,8 @@ def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
                     impl: str = "auto",
                     softcap: float | None = None,
                     win_lo: jax.Array | None = None,
-                    kv_heads: int | None = None) -> jax.Array:
+                    kv_heads: int | None = None,
+                    v_lanes: int | None = None) -> jax.Array:
     """Dispatch: pallas on TPU (block-major streaming kernel, incl. sliding
     windows, soft-capping, and int8 pools w/ in-row per-token scales), XLA
     gather fallback elsewhere and for geometries the kernel can't tile
@@ -876,12 +913,28 @@ def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
         return paged_attention_pallas(q, k_cache, v_cache, block_tables,
                                       seq_lens, block_size=block_size,
                                       scale=scale, softcap=softcap,
-                                      win_lo=win_lo)
+                                      win_lo=win_lo, v_lanes=v_lanes)
     if impl == "pallas_interpret":
         return paged_attention_pallas(q, k_cache, v_cache, block_tables,
                                       seq_lens, block_size=block_size,
                                       scale=scale, softcap=softcap,
-                                      win_lo=win_lo, interpret=True)
+                                      win_lo=win_lo, v_lanes=v_lanes,
+                                      interpret=True)
+    if v_lanes is not None:
+        # the v-aliases-k CONTRACT holds on every impl: v IS k's first
+        # v_lanes lanes and v_cache is ignored — same validation as the
+        # kernel (minus its lane-alignment DMA constraint), so a call
+        # cannot silently mean different things on different backends
+        C_ = kv_value_lanes(k_cache)
+        if C_ // q.shape[-1] != 1 or v_lanes > C_:
+            raise ValueError(
+                f"v_lanes={v_lanes} needs an MQA-shaped pool "
+                f"(KVH == 1) and width <= {C_}")
+        out = paged_attention_xla(q, k_cache, k_cache, block_tables,
+                                  seq_lens, block_size=block_size,
+                                  scale=scale, softcap=softcap,
+                                  win_lo=win_lo, kv_heads=kv_heads)
+        return out[..., :v_lanes]
     return paged_attention_xla(q, k_cache, v_cache, block_tables, seq_lens,
                                block_size=block_size, scale=scale,
                                softcap=softcap, win_lo=win_lo,

@@ -627,10 +627,15 @@ def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
                 [q_lat, q_pe.astype(jnp.float32),
                  jnp.zeros((B, H, W - rank - dr), jnp.float32)],
                 axis=-1).astype(kv_flat.dtype)
+            # v_lanes=rank: v IS the c section of each row — the kernel
+            # skips the v-side DMA entirely (halving the latent stream)
+            # and returns probs·c directly. Ranks that don't lane-align
+            # (tiny test geometries) slice after instead
+            vl = rank if rank % 128 == 0 else None
             ctx = paged_attention(
                 qc, kv_flat, kv_flat, tables_l, seq_lens,
                 block_size=bsz, scale=scale, impl=statics.attn_impl,
-                kv_heads=1)[..., :rank].astype(jnp.float32)
+                kv_heads=1, v_lanes=vl)[..., :rank].astype(jnp.float32)
         else:
             idx = flat_token_indices(tables_l, bsz)
             T = idx.shape[1]

@@ -115,3 +115,49 @@ def test_single_wave_chain():
                                block_size=BS, scale=Dh ** -0.5)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_v_aliases_k_mode_matches_double_dma():
+    """MQA v-aliases-k mode (MLA latent pools, models/mla.py decode):
+    v_lanes skips the v-side DMA and reads v as the first v_lanes lanes
+    of each k tile — output must equal the double-DMA kernel mode
+    sliced, AND the XLA reference, including ragged/zero lengths."""
+    rng = np.random.default_rng(77)
+    W, bs, m, b, h, vl = 256, 16, 8, 9, 8, 128
+    nb = 48
+    pool = jnp.asarray(rng.standard_normal((nb * bs, W)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((b, h, W)), jnp.float32)
+    tables = jnp.asarray(rng.integers(0, nb, size=(b, m)), jnp.int32)
+    lens = rng.integers(0, m * bs + 1, size=(b,))
+    lens[0], lens[1] = 0, m * bs
+    seq_lens = jnp.asarray(lens, jnp.int32)
+    kw = dict(block_tables=tables, seq_lens=seq_lens, block_size=bs,
+              scale=0.07, interpret=True)
+    a = paged_attention_pallas(q, pool, pool, v_lanes=vl, **kw)
+    assert a.shape == (b, h, vl)
+    ref = paged_attention_pallas(q, pool, pool, **kw)[..., :vl]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    xla = paged_attention_xla(q, pool, pool,
+                              block_tables=tables, seq_lens=seq_lens,
+                              block_size=bs, scale=0.07)[..., :vl]
+    live = np.asarray(seq_lens) > 0     # zero-length rows: unspecified
+    np.testing.assert_allclose(np.asarray(a)[live],
+                               np.asarray(xla)[live],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_v_aliases_k_rejects_bad_geometry():
+    pool = jnp.zeros((64 * 16, 256), jnp.float32)
+    q = jnp.zeros((2, 8, 128), jnp.float32)           # KVH = 2
+    tables = jnp.zeros((2, 4), jnp.int32)
+    lens = jnp.ones((2,), jnp.int32)
+    with pytest.raises(ValueError, match="MQA"):
+        paged_attention_pallas(q, pool, pool, v_lanes=128,
+                               block_tables=tables, seq_lens=lens,
+                               block_size=16, scale=1.0, interpret=True)
+    q1 = jnp.zeros((2, 8, 256), jnp.float32)          # KVH = 1
+    with pytest.raises(ValueError, match="128-aligned"):
+        paged_attention_pallas(q1, pool, pool, v_lanes=100,
+                               block_tables=tables, seq_lens=lens,
+                               block_size=16, scale=1.0, interpret=True)
