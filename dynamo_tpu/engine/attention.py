@@ -532,7 +532,8 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
                        num_seqs: int, seqs_per_program: int,
                        softcap: float | None = None,
                        quant_lanes: int | None = None,
-                       v_lanes: int | None = None):
+                       v_lanes: int | None = None,
+                       quant_sections: tuple | None = None):
     """q_ref: [G, Hp, C] sparse-slotted (VMEM); k_hbm/v_hbm: [NTOK, Cx]
     (HBM); o_ref: [G, Hp, C]; k_bufs/v_bufs: [2, chunk*block_size, Cx]
     double buffers; sems: DMA semaphore pair; m/l: [Hp, 1]; acc: [Hp, C]
@@ -552,6 +553,14 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
     so the v-side DMA is skipped entirely — HALVING the KV stream —
     and the accumulator/output narrow to v_lanes. v_hbm/v_bufs are
     untouched in this mode (the wrapper passes dummies).
+
+    ``quant_sections`` (int8 MLA pools; implies v_lanes): rows carry
+    the SECTIONED in-row encoding (quantize_kv_rows_sections — one
+    (e, m) pair per section at pad lanes (2i, 2i+1), then tail zeros
+    to the 128-lane row alignment). dequant produces a q-width tile:
+    dequantized sections followed by zero lanes, so the score dot
+    against the zero-padded query is identical to the full-precision
+    layout.
 
     Each grid program handles G = seqs_per_program sequences (static
     unroll): per-program fixed costs (q/o block pipelining, grid step
@@ -588,11 +597,25 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
         along lanes with no sublane↔lane movement — the score-space
         variant (scale as a [cbs] LANE vector) costs a transpose per
         wave and measured slower than the DMA saving on v5e."""
-        e = tile[:, C:C + 1].astype(jnp.float32)
-        m = (tile[:, C + 1:C + 2].astype(jnp.int32)
-             & 0xFF).astype(jnp.float32)
-        scale = jnp.exp2(e) * (1.0 + m * (1.0 / 256.0))
+        scale = _decode_scale(tile[:, C:C + 1], tile[:, C + 1:C + 2])
         return tile[:, :C].astype(jnp.float32) * scale
+
+    def dequant_tile_sections(tile):
+        """[cbs, Cx] sectioned-int8 tile → [cbs, C] f32: each section
+        rescaled by ITS (e, m) pair (pad lanes 2i, 2i+1 after the
+        values), zero lanes up to the query width C — same keepdim
+        lane-broadcast shape as dequant_tile."""
+        Cs = sum(quant_sections)
+        parts = []
+        off = 0
+        for i, w in enumerate(quant_sections):
+            scale = _decode_scale(tile[:, Cs + 2 * i:Cs + 2 * i + 1],
+                                  tile[:, Cs + 2 * i + 1:Cs + 2 * i + 2])
+            parts.append(tile[:, off:off + w].astype(jnp.float32) * scale)
+            off += w
+        if C > Cs:
+            parts.append(jnp.zeros((tile.shape[0], C - Cs), jnp.float32))
+        return jnp.concatenate(parts, axis=1)
 
     def chunk_copies(sq, ci, slot, nb):
         """Contiguous block copies of sequence `sq`'s chunk `ci` into
@@ -670,8 +693,11 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
 
             for c in chunk_copies(sq, ci, slot, num_blocks):
                 c.wait()
-            if quantized:                 # never with v_lanes (wrapper
-                k = dequant_tile(k_bufs[slot])        # refuses int8+alias)
+            if quant_sections is not None:
+                k = dequant_tile_sections(k_bufs[slot])   # [cbs, C] f32
+                v = k[:, :v_lanes]        # sections mode implies alias
+            elif quantized:
+                k = dequant_tile(k_bufs[slot])        # [cbs, C] f32
                 v = dequant_tile(v_bufs[slot])
             else:
                 k = k_bufs[slot].astype(jnp.float32)  # [chunk*bs, C]
@@ -736,6 +762,7 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                            chunk_blocks: int | None = None,
                            seqs_per_program: int | None = None,
                            v_lanes: int | None = None,
+                           quant_sections: tuple | None = None,
                            interpret: bool = False) -> jax.Array:
     """Same contract as `paged_attention_xla`; KV stays in HBM and streams
     chunk-by-chunk with double buffering (no [B, M*BS] gather). Sliding
@@ -745,11 +772,22 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
     ``v_lanes`` (MQA/MLA only, KVH == 1): v is the first v_lanes lanes
     of each k row — the v-side DMA is skipped (HALVING the stream) and
-    the output narrows to [B, H, v_lanes]; v_cache is ignored."""
+    the output narrows to [B, H, v_lanes]; v_cache is ignored.
+
+    ``quant_sections`` (int8 MLA pools; requires v_lanes): rows carry
+    the sectioned in-row encoding and dequant to the query's width
+    in-kernel (kernel docstring). The row width is
+    pad128(sum + KV_SCALE_LANES); q width must be pad128(sum)."""
     B, H, Dh = q.shape
     NTOK, Cx = k_cache.shape
     quantized = k_cache.dtype == jnp.int8
-    C = kv_value_lanes(k_cache)
+    if quant_sections is not None:
+        if not quantized or v_lanes is None:
+            raise ValueError("quant_sections needs an int8 pool and "
+                             "v_lanes (the MLA sectioned layout)")
+        C = Dh          # dequant produces query-width tiles (KVH == 1)
+    else:
+        C = kv_value_lanes(k_cache)
     KVH = C // Dh
     if not pallas_supported(H, KVH, Dh, block_size,
                             kv_dtype=k_cache.dtype):
@@ -763,14 +801,22 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         raise ValueError(
             f"v_lanes={v_lanes} needs an MQA-shaped pool (KVH == 1, got "
             f"{KVH}) and a 128-aligned width <= {C}")
-    if v_lanes is not None and quantized:
-        # v = dequant(k)[:, :v_lanes] would be easy to WRITE but has no
-        # user (MLA int8 pools use the sectioned codec the kernel does
-        # not speak) and no test — refuse rather than ship a dead,
-        # unexercised compile path
+    if quant_sections is not None:
+        Cs = sum(quant_sections)
+        if (-(-(Cs + KV_SCALE_LANES) // 128) * 128 != Cx
+                or -(-Cs // 128) * 128 != Dh):
+            raise ValueError(
+                f"quant_sections {quant_sections} (sum {Cs}) does not "
+                f"match row width {Cx} = pad128(sum + "
+                f"{KV_SCALE_LANES}) / query width {Dh} = pad128(sum)")
+    if v_lanes is not None and quantized and quant_sections is None:
+        # single-scale int8 rows (the llama encoding) have no
+        # v-aliasing user or test — refuse rather than ship a dead,
+        # unexercised compile path; sectioned MLA pools pass
+        # quant_sections and ARE the supported int8 alias mode
         raise ValueError(
-            "v_lanes on an int8 pool is not supported (MLA int8 pools "
-            "take the XLA sectioned-dequant path)")
+            "v_lanes on a single-scale int8 pool is not supported "
+            "(sectioned MLA pools pass quant_sections)")
     Cv = C if v_lanes is None else v_lanes
     g = H // KVH
     M = block_tables.shape[1]
@@ -820,8 +866,9 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             pltpu.VMEM((Hp, Cv), jnp.float32),                # acc
             pltpu.VMEM((2, chunk * block_size, Cx), k_cache.dtype),
             # v buffers shrink to a dummy tile when v aliases k
+            # (32 sublanes: the int8 tile, legal for every dtype)
             pltpu.VMEM((2, chunk * block_size, Cx)
-                       if v_lanes is None else (1, 8, 128),
+                       if v_lanes is None else (1, 32, 128),
                        v_cache.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SMEM((1,), jnp.int32),   # cross-program wave parity
@@ -837,7 +884,9 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             m_ref, l_ref, acc_ref, k_bufs, v_bufs, sems, wave_ref,
             block_size=block_size, chunk=chunk, scale=scale,
             num_seqs=Bp, seqs_per_program=G, softcap=softcap,
-            quant_lanes=C if quantized else None, v_lanes=v_lanes)
+            quant_lanes=(C if quantized and quant_sections is None
+                         else None),
+            v_lanes=v_lanes, quant_sections=quant_sections)
 
     out = pl.pallas_call(
         kernel,

@@ -245,17 +245,17 @@ def init_params(cfg: ModelConfig, key: jax.Array,
 
 
 def latent_row_lanes(cfg: ModelConfig, quantization: str = "none") -> int:
-    """Pool row width. int8 rows carry the sectioned in-row scales
-    (rank+rope + KV_SCALE_LANES). Full-precision rows PAD rank+rope up
-    to a 128-lane multiple (e.g. 512+64 -> 640): the lane alignment is
-    what makes the latent pool a legal block-DMA source for the Pallas
-    paged-attention kernel (decode maps onto it as MQA — see
-    decode_forward); readers slice [:rank] / [rank:rank+rope], so the
-    pad lanes are write-only zeros."""
+    """Pool row width, PADDED to a 128-lane multiple either way: the
+    lane alignment is what makes the latent pool a legal block-DMA
+    source for the Pallas paged-attention kernel (decode maps onto it
+    as MQA — see decode_forward). Full precision: rank+rope up (e.g.
+    512+64 -> 640). int8: the sectioned encode's rank+rope +
+    KV_SCALE_LANES, padded (e.g. 576+128 -> 768). Readers slice the
+    exact value/scale ranges, so pad lanes are write-only zeros."""
+    from ..attention import KV_SCALE_LANES
     C = cfg.kv_lora_rank + cfg.qk_rope_head_dim
     if quantization == "int8":
-        from ..attention import KV_SCALE_LANES
-        return C + KV_SCALE_LANES
+        C = C + KV_SCALE_LANES
     return -(-C // 128) * 128
 
 
@@ -264,11 +264,13 @@ def init_kv_cache(cfg: ModelConfig, num_blocks: int,
                   quantization: str = "none") -> KVCache:
     """quantization="int8": the latent row quantizes with one in-row
     (e, m) scale pair PER c_kv/k_pe section
-    (attention.quantize_kv_rows_sections — both pairs share the single
-    128-lane pad, so the row width matches the llama encoding). Unlike
-    llama pools there is never a per-tp-shard section: the latent pool
-    replicates under tp (parallel/sharding.shard_kv), so every rank
-    reads whole rows. Row widths: latent_row_lanes."""
+    (attention.quantize_kv_rows_sections — both pairs share one
+    128-lane pad, and the row then PADS to a 128-lane multiple like
+    the full-precision layout: e.g. 576+128 -> 768, wider than the
+    unpadded llama encoding). Unlike llama pools there is never a
+    per-tp-shard section: the latent pool replicates under tp
+    (parallel/sharding.shard_kv), so every rank reads whole rows. Row
+    widths: latent_row_lanes."""
     if quantization not in ("none", "int8"):
         raise ValueError(f"unknown kv quantization {quantization!r} "
                          f"(none|int8)")
@@ -402,18 +404,17 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
                 # rows back, and the sp ring round-trips its fresh rows
                 # through the same encode/decode — so the current token
                 # sees the same quantized latent later steps do
-                pool = pool.at[li, slots, :].set(
-                    quantize_kv_rows_sections(
-                        rows, (cfg.kv_lora_rank, cfg.qk_rope_head_dim)),
-                    mode="drop")
+                enc = quantize_kv_rows_sections(
+                    rows, (cfg.kv_lora_rank, cfg.qk_rope_head_dim))
             else:
-                pad = pool.shape[2] - rows.shape[1]
+                enc = rows.astype(pool.dtype)
+            pad = pool.shape[2] - enc.shape[1]
+            if pad:
                 # 128-lane row alignment (latent_row_lanes); attn_fn
                 # below must keep seeing the UNPADDED rows
-                padded = (jnp.pad(rows, ((0, 0), (0, pad))) if pad
-                          else rows)
-                pool = pool.at[li, slots, :].set(
-                    padded.astype(pool.dtype), mode="drop")
+                enc = jnp.pad(enc, ((0, 0), (0, pad)))
+            pool = pool.at[li, slots, :].set(enc.astype(pool.dtype),
+                                             mode="drop")
             attn = attn_fn(q_nope, q_pe, rows,
                            pool.reshape(L * NTOK, pool.shape[2]), lp, li)
             h = h + mm(attn, lp["wo"])
@@ -600,9 +601,11 @@ def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
     zeros on both sides), the pool serves as k AND v, and the output's
     first `rank` lanes ARE probs·c. On TPU that is the block-DMA
     Pallas kernel — the XLA row-gather measured ~27x the pure-bandwidth
-    cost of the latent read at seq ≈1K (PERF.md). int8 pools keep the
-    explicit gather + sectioned dequant (the shared int8 row codec is
-    the llama grouped encoding, not the sectioned one)."""
+    cost of the latent read at seq ≈1K (PERF.md). int8 pools take the
+    kernel too on TPU (quant_sections: in-kernel per-section dequant +
+    v-aliases-k, the rows stream ONCE at int8 width); the explicit
+    gather + sectioned dequant remains the fallback (CPU, non-aligned
+    ranks, attn_impl=xla)."""
     cfg, bsz = statics.cfg, statics.block_size
     B = tokens.shape[0]
     H = cfg.num_heads
@@ -637,21 +640,39 @@ def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
                 block_size=bsz, scale=scale, impl=statics.attn_impl,
                 kv_heads=1, v_lanes=vl)[..., :rank].astype(jnp.float32)
         else:
-            idx = flat_token_indices(tables_l, bsz)
-            T = idx.shape[1]
-            rows = jnp.take(kv_flat, idx, axis=0)        # [B, T, W]
-            rows = dequant_kv_rows_sections(rows, (rank, dr),
-                                            jnp.float32)
-            c = rows[..., :rank]
-            k_pe = rows[..., rank:rank + dr]
-            scores = (jnp.einsum("bhr,btr->bht", q_lat, c)
-                      + jnp.einsum("bhd,btd->bht",
-                                   q_pe.astype(jnp.float32),
-                                   k_pe)) * scale
-            mask = jnp.arange(T)[None, :] < seq_lens[:, None]
-            scores = jnp.where(mask[:, None, :], scores, NEG_INF)
-            probs = jax.nn.softmax(scores, axis=-1)
-            ctx = jnp.einsum("bht,btr->bhr", probs, c)   # [B, H, rank]
+            from ..attention import (_on_tpu, paged_attention_pallas,
+                                     pallas_supported)
+            Wq = -(-(rank + dr) // 128) * 128
+            if (statics.attn_impl in ("auto", "pallas") and _on_tpu()
+                    and rank % 128 == 0
+                    and pallas_supported(H, 1, Wq, bsz,
+                                         kv_dtype=jnp.int8)):
+                # sectioned-int8 kernel mode: in-kernel per-section
+                # dequant + v-aliases-k — the int8 row streams ONCE
+                qc = jnp.concatenate(
+                    [q_lat, q_pe.astype(jnp.float32),
+                     jnp.zeros((B, H, Wq - rank - dr), jnp.float32)],
+                    axis=-1).astype(jnp.bfloat16)
+                ctx = paged_attention_pallas(
+                    qc, kv_flat, kv_flat, tables_l, seq_lens,
+                    block_size=bsz, scale=scale, v_lanes=rank,
+                    quant_sections=(rank, dr)).astype(jnp.float32)
+            else:
+                idx = flat_token_indices(tables_l, bsz)
+                T = idx.shape[1]
+                rows = jnp.take(kv_flat, idx, axis=0)    # [B, T, W]
+                rows = dequant_kv_rows_sections(rows, (rank, dr),
+                                                jnp.float32)
+                c = rows[..., :rank]
+                k_pe = rows[..., rank:rank + dr]
+                scores = (jnp.einsum("bhr,btr->bht", q_lat, c)
+                          + jnp.einsum("bhd,btd->bht",
+                                       q_pe.astype(jnp.float32),
+                                       k_pe)) * scale
+                mask = jnp.arange(T)[None, :] < seq_lens[:, None]
+                scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+                probs = jax.nn.softmax(scores, axis=-1)
+                ctx = jnp.einsum("bht,btr->bhr", probs, c)  # [B,H,rank]
         out = jnp.einsum("bhr,hrd->bhd", ctx,
                          w_v.astype(jnp.float32))        # [B, H, dv]
         return out.reshape(B, H * cfg.v_head_dim).astype(q_nope.dtype)

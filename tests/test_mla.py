@@ -120,7 +120,9 @@ def test_latent_cache_row_geometry():
     assert mla.latent_row_lanes(cfg) == 128       # pad128(16 + 8)
     big = dataclasses.replace(cfg, kv_lora_rank=512, qk_rope_head_dim=64)
     assert mla.latent_row_lanes(big) == 640
-    assert mla.latent_row_lanes(big, "int8") == 512 + 64 + 128
+    # int8 pads too: pad128(576 + 128) = 768 — the alignment that lets
+    # the sectioned-int8 kernel mode block-DMA the rows
+    assert mla.latent_row_lanes(big, "int8") == 768
 
 
 def test_mla_prefill_matches_hf(mla_setup):
@@ -456,7 +458,8 @@ def test_mla_int8_kv_teacher_forced_accuracy_gate():
     kv_q8 = mla.init_kv_cache(cfg, nblocks + 1, BS, quantization="int8")
     C = cfg.kv_lora_rank + cfg.qk_rope_head_dim
     assert kv_q8["kv"].dtype == jnp.int8
-    assert kv_q8["kv"].shape[-1] == C + KV_SCALE_LANES
+    # pad128(values + scale lanes) — the kernel's DMA alignment
+    assert kv_q8["kv"].shape[-1] == -(-(C + KV_SCALE_LANES) // 128) * 128
     prompt = jnp.asarray(rng.integers(2, cfg.vocab_size, size=(T,)),
                          jnp.int32)
     table = jnp.asarray(np.arange(1, nblocks + 1), jnp.int32)

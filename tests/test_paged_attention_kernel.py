@@ -161,3 +161,49 @@ def test_v_aliases_k_rejects_bad_geometry():
         paged_attention_pallas(q1, pool, pool, v_lanes=100,
                                block_tables=tables, seq_lens=lens,
                                block_size=16, scale=1.0, interpret=True)
+
+
+def test_sectioned_int8_kernel_mode_matches_reference():
+    """quant_sections (int8 MLA pools): in-kernel per-section dequant +
+    v-aliases-k must equal the host-side sectioned dequant reference —
+    the path models/mla.py decode takes on TPU for int8 latent pools."""
+    from dynamo_tpu.engine.attention import (dequant_kv_rows_sections,
+                                             quantize_kv_rows_sections)
+    rng = np.random.default_rng(88)
+    rank, dr = 128, 64                  # sum 192 -> q width 256, row 384
+    Wq, bs, m, b, h = 256, 32, 4, 6, 8
+    nb = 32
+    vals = np.concatenate(
+        [rng.standard_normal((nb * bs, rank)).astype(np.float32),
+         rng.standard_normal((nb * bs, dr)).astype(np.float32) * 15.0],
+        axis=1)                          # skewed k_pe, the MLA reality
+    enc = np.asarray(quantize_kv_rows_sections(jnp.asarray(vals),
+                                               (rank, dr)))
+    pool = jnp.asarray(np.pad(enc, ((0, 0), (0, 384 - enc.shape[1]))))
+    assert pool.shape[1] == 384 and pool.dtype == jnp.int8
+    q = jnp.asarray(rng.standard_normal((b, h, Wq)).astype(np.float32)
+                    * 0.3, jnp.bfloat16)
+    tables = jnp.asarray(rng.integers(0, nb, size=(b, m)), jnp.int32)
+    lens = rng.integers(1, m * bs + 1, size=(b,))
+    seq_lens = jnp.asarray(lens, jnp.int32)
+    got = paged_attention_pallas(
+        q, pool, pool, tables, seq_lens, block_size=bs, scale=0.05,
+        v_lanes=rank, quant_sections=(rank, dr), interpret=True)
+    assert got.shape == (b, h, rank)
+
+    # reference: gather + host-side sectioned dequant + masked softmax
+    deq = np.asarray(dequant_kv_rows_sections(
+        pool[:, :rank + dr + 128], (rank, dr), jnp.float32))
+    qf = np.asarray(q, np.float32)
+    idx = np.asarray(tables)[:, :, None] * bs + np.arange(bs)[None, None]
+    idx = idx.reshape(b, -1)
+    k = deq[idx]                                       # [b, T, 192]
+    kq = np.pad(k, ((0, 0), (0, 0), (0, Wq - rank - dr)))
+    scores = np.einsum("bhw,btw->bht", qf, kq) * 0.05
+    mask = np.arange(m * bs)[None, :] < np.asarray(seq_lens)[:, None]
+    scores = np.where(mask[:, None, :], scores, -1e30)
+    p = np.exp(scores - scores.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    want = np.einsum("bht,btr->bhr", p, k[..., :rank])
+    np.testing.assert_allclose(np.asarray(got, np.float32), want,
+                               rtol=2e-2, atol=2e-2)  # bf16 q rounding
