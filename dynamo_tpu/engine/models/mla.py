@@ -626,6 +626,11 @@ def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
         if kv_flat.dtype != jnp.int8:
             from ..attention import paged_attention
             W = kv_flat.shape[-1]
+            # Deliberate: the kernel dots q against pool rows in the
+            # pool dtype, so the f32 query rounds to bf16 here (the XLA
+            # fallback keeps f32 queries — scores differ in the last
+            # bits). A mixed-precision kernel dot costs a second VREG
+            # stream for no measured accuracy gain.
             qc = jnp.concatenate(
                 [q_lat, q_pe.astype(jnp.float32),
                  jnp.zeros((B, H, W - rank - dr), jnp.float32)],
