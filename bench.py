@@ -581,16 +581,20 @@ def main() -> None:
         # the per-chip-shard geometry measures compute+HBM only; the
         # headline must be NET of the modeled per-layer TP-8 ICI
         # collectives (parallel/ici_model.py books the full serial cost)
-        from dynamo_tpu.parallel.ici_model import tp_decode_step_s
+        from dynamo_tpu.parallel.ici_model import (tp_decode_step_s,
+                                                   tp_decode_sensitivity)
         ici_s = tp_decode_step_s(batch, mcfg.hidden_size,
                                  mcfg.num_layers, 8)
-        base_step_s = (batch / headline) if headline > 0 else 0.0
-        net = batch / (base_step_s + ici_s) if base_step_s > 0 else 0.0
+        sens = tp_decode_sensitivity(batch, mcfg.hidden_size,
+                                     mcfg.num_layers, 8, headline)
+        net = sens["nominal"]
         ici_extra = {
             "ici_step_ms": round(ici_s * 1e3, 3),
             "per_chip_tok_per_s_no_ici": round(headline, 1),
             "ici_model": "2 psums/layer + embed psum, [B,8192] bf16, "
                          "TP-8 @ 100 GB/s effective + 5us/collective",
+            "ici_sensitivity": sens["band"],
+            "ici_worst_corner_tok_per_s": sens["worst"],
         }
         headline = net
 

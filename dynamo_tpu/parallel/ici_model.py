@@ -35,17 +35,55 @@ COLLECTIVE_LATENCY_S = 5e-6         # per-collective fixed cost
 
 
 def allreduce_s(nbytes: int, n_chips: int,
-                eff_bw: float = V5E_ICI_EFFECTIVE_GBPS) -> float:
+                eff_bw: float = V5E_ICI_EFFECTIVE_GBPS,
+                latency_s: float = COLLECTIVE_LATENCY_S) -> float:
     """Ring all-reduce wall time for one [nbytes] buffer over n_chips."""
     if n_chips <= 1:
         return 0.0
-    return (2.0 * nbytes * (n_chips - 1) / n_chips / eff_bw
-            + COLLECTIVE_LATENCY_S)
+    return (2.0 * nbytes * (n_chips - 1) / n_chips / eff_bw + latency_s)
 
 
 def tp_decode_step_s(batch: int, hidden: int, num_layers: int,
-                     n_chips: int, act_itemsize: int = 2) -> float:
+                     n_chips: int, act_itemsize: int = 2,
+                     eff_bw: float = V5E_ICI_EFFECTIVE_GBPS,
+                     latency_s: float = COLLECTIVE_LATENCY_S) -> float:
     """Total modeled ICI time one TP-sharded decode step spends in
     collectives: 2 [B, D] psums per layer + 1 for the embedding."""
-    per = allreduce_s(batch * hidden * act_itemsize, n_chips)
+    per = allreduce_s(batch * hidden * act_itemsize, n_chips,
+                      eff_bw=eff_bw, latency_s=latency_s)
     return (2 * num_layers + 1) * per
+
+
+# Sensitivity grid for the gate metric: the single-point 100 GB/s + 5 us
+# assumption is conservative, but a one-point model invites "what if the
+# link is worse" — so the bench publishes the NET tok/s over the full
+# bw × latency cross product and the gate is judged at the CONSERVATIVE
+# corner (50 GB/s effective, 10 us/collective), not the nominal point.
+SENSITIVITY_BW_GBPS = (50e9, 100e9, 150e9)
+SENSITIVITY_LATENCY_S = (2e-6, 5e-6, 10e-6)
+
+
+def tp_decode_sensitivity(batch: int, hidden: int, num_layers: int,
+                          n_chips: int, device_tok_per_s: float,
+                          act_itemsize: int = 2) -> dict:
+    """Net per-chip tok/s across the bw × latency grid.
+
+    Returns {"band": {"<bw_gbps>GBps/<us>us": net_tok_per_s, ...},
+             "worst": <conservative-corner net>, "best": ...} given the
+    measured compute+HBM-only device throughput.
+    """
+    base_step_s = batch / device_tok_per_s if device_tok_per_s > 0 else 0.0
+    band = {}
+    nominal = 0.0
+    for bw in SENSITIVITY_BW_GBPS:
+        for lat in SENSITIVITY_LATENCY_S:
+            ici = tp_decode_step_s(batch, hidden, num_layers, n_chips,
+                                   act_itemsize, eff_bw=bw, latency_s=lat)
+            net = batch / (base_step_s + ici) if base_step_s > 0 else 0.0
+            band[f"{int(bw / 1e9)}GBps/{int(lat * 1e6)}us"] = round(net, 1)
+            if bw == V5E_ICI_EFFECTIVE_GBPS and lat == COLLECTIVE_LATENCY_S:
+                nominal = net   # unrounded: bench.py's headline source
+    return {"band": band,
+            "nominal": nominal,
+            "worst": min(band.values()) if band else 0.0,
+            "best": max(band.values()) if band else 0.0}
