@@ -67,6 +67,42 @@ def build_tiny_model_dir(path: str, vocab_size: int = 512) -> str:
     return path
 
 
+async def wait_until(pred, what: str, timeout: float = 90.0,
+                     interval: float = 0.05):
+    """Shared monotonic-deadline poll: ``pred`` may be sync or async and
+    should be a PURE READ (no scheduling side effects). The deadline is a
+    hang detector, not a performance budget — round-4 postmortem:
+    iteration-count/short budgets flaked under 3x concurrent pytest load."""
+    import asyncio
+    import inspect
+    import time
+    deadline = time.monotonic() + timeout
+    while True:
+        r = pred()
+        if inspect.isawaitable(r):
+            r = await r
+        if r:
+            return
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timeout waiting for {what}")
+        await asyncio.sleep(interval)
+
+
+def build_tiny_weighted_model_dir(path: str) -> str:
+    """build_tiny_model_dir + random-init safetensors weights, so loaders
+    that stream from disk (JaxEngine.from_model_dir) work end to end."""
+    import jax
+    import jax.numpy as jnp
+    from dynamo_tpu.engine.config import ModelConfig
+    from dynamo_tpu.engine.models import llama
+    from dynamo_tpu.engine.weights import save_hf_style
+    build_tiny_model_dir(path)
+    cfg = ModelConfig.from_model_dir(path)
+    params = llama.init_params(cfg, jax.random.PRNGKey(7), dtype=jnp.float32)
+    save_hf_style(params, cfg, path)
+    return path
+
+
 class RecordingEngine:
     """Closure-style fake engine (reference tests/common/engines.rs pattern):
     records requests, replays a canned list of outputs."""

@@ -12,6 +12,7 @@ state=invalid, and the committed CRD yaml's schema coherence.
 """
 
 import asyncio
+import time
 import json
 import os
 import stat
@@ -24,6 +25,7 @@ from dynamo_tpu.deploy.operator import (OWNED_PREFIX, CrOperator, KubectlCr,
                                         cr_to_spec)
 from dynamo_tpu.deploy.spec import SPEC_PREFIX, DeploymentSpec
 from dynamo_tpu.runtime.distributed import DistributedRuntime
+from tests.fixtures import wait_until
 from tests.test_deploy_controller import FakeLauncher, wait_status
 
 pytestmark = pytest.mark.asyncio
@@ -134,6 +136,10 @@ def test_committed_crd_schema_matches_spec_fields():
         "state", "readyReplicas", "observedGeneration", "message"}
 
 
+
+async def _spec_gone(rt):
+    return (await rt.store.kv_get(SPEC_PREFIX + "web")) is None
+
 async def test_cr_lifecycle_end_to_end(fake_kube):
     """Create → reconcile → status on the CR; update → generation bump;
     delete → replicas stopped + store garbage-collected."""
@@ -152,10 +158,10 @@ async def test_cr_lifecycle_end_to_end(fake_kube):
         # spec mirrored + controller converged + status back on the CR
         await wait_status(rt, "web", lambda s: s["state"] == "running"
                           and s["ready_replicas"] == 2)
-        for _ in range(100):
-            if read_cr("web").get("status", {}).get("state") == "running":
-                break
-            await asyncio.sleep(0.05)
+        await wait_until(
+            lambda: (read_cr("web").get("status", {})
+                     .get("state") == "running"),
+            "CR status.state=running")
         st = read_cr("web")["status"]
         assert st["state"] == "running" and st["readyReplicas"] == 2
         assert st["observedGeneration"] == 1
@@ -169,24 +175,23 @@ async def test_cr_lifecycle_end_to_end(fake_kube):
                  generation=2)
         await wait_status(rt, "web", lambda s: s["ready_replicas"] == 3
                           and s["observed_generation"] == 2)
-        for _ in range(100):
-            if read_cr("web").get("status", {}).get("readyReplicas") == 3:
-                break
-            await asyncio.sleep(0.05)
+        await wait_until(
+            lambda: (read_cr("web").get("status", {})
+                     .get("readyReplicas") == 3),
+            "CR status.readyReplicas=3")
         assert read_cr("web")["status"]["observedGeneration"] == 2
 
         # CR deletion: spec + ownership garbage-collected, replicas die
         delete_cr("web")
-        for _ in range(100):
-            if (await rt.store.kv_get(SPEC_PREFIX + "web")) is None:
-                break
-            await asyncio.sleep(0.05)
+        await wait_until(
+            lambda: _spec_gone(rt),
+            "spec garbage-collected from the store")
         assert (await rt.store.kv_get(SPEC_PREFIX + "web")) is None
         assert (await rt.store.kv_get(OWNED_PREFIX + "web")) is None
-        for _ in range(100):
-            if all(p.returncode is not None for p in launcher.procs):
-                break
-            await asyncio.sleep(0.05)
+        await wait_until(
+            lambda: all(p.returncode is not None
+                        for p in launcher.procs),
+            "all replica processes stopped")
         assert all(p.stopped for p in launcher.procs)
     finally:
         await op.stop()
