@@ -9,9 +9,11 @@ right-multiplication; per-layer tensors are stacked on a leading L axis so
 
 from __future__ import annotations
 
+import contextlib
 import glob
 import json
 import os
+import weakref
 from typing import Dict, Optional
 
 import jax
@@ -145,17 +147,112 @@ def _partial_ranges(cfg: ModelConfig):
 
 def load_params_auto(model_dir: str, cfg: Optional[ModelConfig] = None,
                      mesh=None, dtype=jnp.bfloat16) -> Dict[str, jax.Array]:
-    """THE loader entry point: streams shards straight from disk when a
-    mesh is given (host peak = one shard — the 70B path), replicated
-    otherwise. MoE and MLA checkpoints use the replicated reader even
-    with a mesh (EngineCore's shard_params re-places them) — so a
-    sharded MLA/MoE load stages the FULL model in host RAM; shard-
-    streaming those layouts is the open limit, not the engine (which
-    serves MLA over dp/tp/ep meshes)."""
+    """THE loader entry point: streams each device's shard straight from
+    disk when a mesh is given — llama/qwen/gemma/phi3 AND MoE/MLA
+    (deepseek) layouts — so host peak is one param-stack shard, never the
+    full model (the enabler for 70B / deepseek-class bring-up on a
+    standard TPU-VM host; the reference gets this from its engines'
+    per-rank shard loaders, lib/llm vllm subprocess.rs:37-41). Without a
+    mesh, the replicated reader stages the whole model in host numpy."""
     cfg = cfg or ModelConfig.from_model_dir(model_dir)
-    if mesh is not None and cfg.num_experts == 0 and cfg.kv_lora_rank == 0:
-        return load_llama_params_sharded(model_dir, mesh, cfg, dtype=dtype)
+    if mesh is not None:
+        return load_params_sharded(model_dir, mesh, cfg, dtype=dtype)
     return load_llama_params(model_dir, cfg, dtype=dtype)
+
+
+class LoadAccounting:
+    """Live-host-byte tracker for checkpoint loads (weakref-finalized):
+    ``peak`` is the high-water mark of HEAP bytes simultaneously alive
+    among the loader's STAGING copies — read-slice transients in the
+    streaming path, full param-stack assemblies in the replicated path.
+    The buffers the streaming loader hands to jax.make_array_from_callback
+    are excluded: they become the device shard storage itself (the CPU
+    backend zero-copy-aliases them), i.e. they are the model, not
+    staging. Only arrays that OWN their buffer are counted: safetensors
+    hands out mmap-backed views (file-cache pages the OS can evict — not
+    heap), and a view's lifetime says nothing about its root buffer's
+    anyway."""
+
+    def __init__(self) -> None:
+        self.live = 0
+        self.peak = 0
+        self.total = 0
+        # largest single buffer handed to jax.make_array_from_callback —
+        # the device shard storage itself (alive only until the transfer
+        # completes on a real accelerator; aliased forever on CPU), kept
+        # as its own number so staging and handoff cannot be conflated
+        self.largest_handoff = 0
+
+    def track(self, arr: np.ndarray) -> np.ndarray:
+        if arr.base is not None:   # view — not loader-owned heap
+            return arr
+        nb = int(arr.nbytes)
+        self.live += nb
+        self.total += nb
+        if self.live > self.peak:
+            self.peak = self.live
+        weakref.finalize(arr, self._release, nb)
+        return arr
+
+    def transient(self, nbytes: int) -> None:
+        """Explicit accounting for a lexically-scoped staging buffer:
+        ``nbytes`` live briefly ON TOP of the tracked live set. Used by
+        the streaming read path, whose buffer lifetimes are exact
+        (dead before the next read) — weakref tracking can't see them
+        because safetensors slice reads surface as views of fresh
+        memoryview-backed copies (measured), not as owning arrays."""
+        if self.live + nbytes > self.peak:
+            self.peak = self.live + nbytes
+        self.total += nbytes
+
+    def handoff(self, nbytes: int) -> None:
+        if nbytes > self.largest_handoff:
+            self.largest_handoff = nbytes
+
+    def _release(self, nb: int) -> None:
+        self.live -= nb
+
+
+_ACCOUNTING: Optional[LoadAccounting] = None
+
+
+@contextlib.contextmanager
+def load_accounting():
+    """``with load_accounting() as acct: load(...)`` — afterwards
+    ``acct.peak``/``acct.total`` hold the staging byte counts and
+    ``acct.largest_handoff`` the biggest shard buffer handed to jax, for
+    every loader call made inside the block."""
+    global _ACCOUNTING
+    acct = LoadAccounting()
+    prev = _ACCOUNTING
+    _ACCOUNTING = acct
+    try:
+        yield acct
+    finally:
+        _ACCOUNTING = prev
+
+
+def _track(arr: np.ndarray) -> np.ndarray:
+    if _ACCOUNTING is not None:
+        _ACCOUNTING.track(arr)
+    return arr
+
+
+def _note_handoff(arr: np.ndarray) -> np.ndarray:
+    if _ACCOUNTING is not None:
+        _ACCOUNTING.handoff(int(arr.nbytes))
+    return arr
+
+
+def _note_transient(nbytes: int) -> None:
+    if _ACCOUNTING is not None:
+        _ACCOUNTING.transient(int(nbytes))
+
+
+# safetensors dtype tag -> on-disk bytes per element
+_ST_ITEMSIZE = {"F64": 8, "I64": 8, "U64": 8, "F32": 4, "I32": 4,
+                "U32": 4, "F16": 2, "BF16": 2, "I16": 2, "U16": 2,
+                "I8": 1, "U8": 1, "BOOL": 1, "F8_E4M3": 1, "F8_E5M2": 1}
 
 
 def _iter_safetensors(model_dir: str):
@@ -165,7 +262,7 @@ def _iter_safetensors(model_dir: str):
     for path in files:
         with safe_open(path, framework="np") as f:
             for name in f.keys():
-                yield name, f.get_tensor(name)
+                yield name, _track(f.get_tensor(name))
 
 
 def load_llama_params(model_dir: str, cfg: Optional[ModelConfig] = None,
@@ -244,7 +341,7 @@ def load_llama_params(model_dir: str, cfg: Optional[ModelConfig] = None,
                 f"{missing[:4]}, outside-range {extra[:4]} "
                 f"(expected layers [{lo}, {hi}))")
         params[f"layers.{key}"] = jnp.asarray(
-            np.stack(rows, axis=0), dtype=dtype)
+            _track(np.stack(rows, axis=0)), dtype=dtype)
     for key, grid in expert_staging.items():
         lo, hi = partial.get(key, (0, L))
         rows = grid[lo:hi]
@@ -261,7 +358,8 @@ def load_llama_params(model_dir: str, cfg: Optional[ModelConfig] = None,
             raise ValueError(f"checkpoint missing experts {missing[:4]}… "
                              f"for {key}")
         params[f"layers.{key}"] = jnp.asarray(
-            np.stack([np.stack(row, axis=0) for row in rows], axis=0),
+            _track(np.stack([_track(np.stack(row, axis=0))
+                             for row in rows], axis=0)),
             dtype=dtype)
     if "lm_head" not in params and not cfg.tie_word_embeddings:
         # some checkpoints tie implicitly by omitting lm_head
@@ -269,10 +367,10 @@ def load_llama_params(model_dir: str, cfg: Optional[ModelConfig] = None,
     return params
 
 
-def load_llama_params_sharded(model_dir: str, mesh,
-                              cfg: Optional[ModelConfig] = None,
-                              dtype=jnp.bfloat16) -> Dict[str, jax.Array]:
-    """Load a checkpoint DIRECTLY into its tp-sharded device layout.
+def load_params_sharded(model_dir: str, mesh,
+                        cfg: Optional[ModelConfig] = None,
+                        dtype=jnp.bfloat16) -> Dict[str, jax.Array]:
+    """Load a checkpoint DIRECTLY into its mesh-sharded device layout.
 
     The replicated loader (load_llama_params) stages the whole model in
     host numpy — ~140 GB of host RAM for a 70B bf16 checkpoint, and each
@@ -280,23 +378,19 @@ def load_llama_params_sharded(model_dir: str, mesh,
     loader reads only each device's shard from disk (safetensors
     `get_slice` reads sub-ranges without materializing the tensor) and
     assembles sharded jax Arrays with `make_array_from_callback`, so peak
-    host memory is ONE shard — the practical enabler for 70B TP-8
-    serving (BASELINE config 4; the reference gets this from its external
-    engines' sharded loaders).
+    host memory is ONE param-stack shard — the practical enabler for
+    70B TP-8 and deepseek-class bring-up on a standard TPU-VM host
+    (BASELINE config 4; the reference gets this from its external
+    engines' per-rank shard loaders, vllm subprocess.rs:37-41).
 
-    Llama/qwen/gemma families (stacked dense layers) only. MoE expert
-    checkpoints raise — route them through ``load_params_auto``, which
-    uses the replicated reader + shard_params for them.
+    Covers every family the engine serves: stacked dense layers
+    (llama/qwen/gemma, phi3 fused tensors), MoE expert grids (mixtral /
+    qwen-moe / deepseek hybrid with partial layer ranges), and MLA
+    latent projections. ``load_accounting()`` wraps a load to measure
+    the staging high-water mark.
     """
     if not _HAVE_ST:
         raise RuntimeError("safetensors not available")
-    if (cfg or ModelConfig.from_model_dir(model_dir)).kv_lora_rank > 0:
-        raise NotImplementedError(
-            "shard-streaming MLA checkpoints is not implemented — route "
-            "through load_params_auto (replicated read + shard_params; "
-            "host peak = full model)")
-    import contextlib
-
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..parallel.sharding import fit_or_replicate, param_pspecs
@@ -317,11 +411,10 @@ def load_llama_params_sharded(model_dir: str, mesh,
                 where[name] = f
 
         # "wq" → [(hf_suffix, T?), ...]: some keys have per-family HF
-        # namings (router: mixtral block_sparse_moe.gate vs qwen3-moe
-        # mlp.gate) — resolve by whichever name the checkpoint contains.
-        # No MoE sharded load SUCCEEDS (layers.moe_* raises guidance
-        # below), but resolving the router by presence lets BOTH families
-        # reach that guidance instead of a bogus missing-layers error
+        # namings (router: mixtral block_sparse_moe.gate vs qwen3-moe /
+        # deepseek mlp.gate) — resolve by whichever name the checkpoint
+        # contains at the key's FIRST covered layer (partial-range keys
+        # like the deepseek router never exist at layer 0)
         by_key: Dict[str, list] = {}
         for suffix, (key, transpose) in _layer_map_for(cfg).items():
             by_key.setdefault(key, []).append((suffix, transpose, None))
@@ -336,6 +429,7 @@ def load_llama_params_sharded(model_dir: str, mesh,
         singles = {"embed": ("model.embed_tokens.weight", False),
                    "final_norm": ("model.norm.weight", False),
                    "lm_head": ("lm_head.weight", True)}
+        partial = _partial_ranges(cfg)
 
         def read_slice(name: str, idx, transpose: bool,
                        col_off=None, col_dim: int = 0) -> np.ndarray:
@@ -353,13 +447,42 @@ def load_llama_params_sharded(model_dir: str, mesh,
                     if col_off is not None:
                         start, stop, step = c.indices(col_dim)
                         c = slice(start + col_off, stop + col_off, step)
-                    return np.ascontiguousarray(sl[c, idx[0]].T)
-                return np.ascontiguousarray(sl[idx[0]].T)
-            return np.ascontiguousarray(sl[tuple(idx)])
+                    out = np.ascontiguousarray(sl[c, idx[0]].T)
+                    # the fresh slice copy and its contiguous transpose
+                    # copy coexist inside this call (measured: slice
+                    # reads are heap copies, not mmap views)
+                    _note_transient(2 * out.nbytes)
+                    return out
+                out = np.ascontiguousarray(sl[idx[0]].T)
+                _note_transient(2 * out.nbytes)
+                return out
+            out = np.ascontiguousarray(sl[tuple(idx)])
+            _note_transient(out.nbytes)
+            return out
+
+        def _resolve_expert_naming(lo: int):
+            """(prefix, {stacked key → hf wname}) by checkpoint presence:
+            mixtral block_sparse_moe.experts.{e}.w{1,3,2} vs qwen-moe /
+            deepseek mlp.experts.{e}.{gate,up,down}_proj."""
+            for prefix in _EXPERT_PREFIXES:
+                for wname, key in _EXPERT_MAP.items():
+                    if (f"model.layers.{lo}.{prefix}0.{wname}.weight"
+                            in where):
+                        inv = {k: w for w, k in _EXPERT_MAP.items()
+                               if (f"model.layers.{lo}.{prefix}0."
+                                   f"{w}.weight") in where}
+                        return prefix, inv
+            raise ValueError(
+                f"no expert tensors found at layer {lo} under any of "
+                f"{_EXPERT_PREFIXES} — checkpoint/config mismatch")
 
         specs = param_pspecs(cfg)
         params: Dict[str, jax.Array] = {}
-        from .models.llama import param_shapes
+        if cfg.kv_lora_rank > 0:
+            from .models.mla import param_shapes
+        else:
+            from .models.llama import param_shapes
+        expert_naming = None
         for pkey, shape in param_shapes(cfg).items():
             spec = fit_or_replicate(pkey, shape, specs.get(pkey, P()),
                                     mesh, _np_dtype(dtype).itemsize)
@@ -369,21 +492,92 @@ def load_llama_params_sharded(model_dir: str, mesh,
                 if name not in where:
                     continue        # tied checkpoints omit lm_head
 
-                def cb(idx, name=name, transpose=transpose):
-                    return read_slice(name, idx, transpose).astype(
-                        _np_dtype(dtype))
+                def cb(idx, name=name, transpose=transpose, shape=shape):
+                    # preallocate the handoff buffer and fill it in
+                    # row-CHUNKS read straight off disk, so the staging
+                    # transient is one chunk in the DISK dtype — not the
+                    # whole (possibly f32) shard (a 70B embed shard read
+                    # whole would stage GBs)
+                    dims = [len(range(*sl.indices(dim)))
+                            for sl, dim in zip(idx, shape)]
+                    out = _note_handoff(
+                        np.empty(dims, _np_dtype(dtype)))
+                    r_sl = idx[0]
+                    start, stop, step = r_sl.indices(shape[0])
+                    disk_item = _ST_ITEMSIZE.get(
+                        where[name].get_slice(name).get_dtype(), 4)
+                    row_bytes = max(
+                        np.prod(dims[1:], dtype=np.int64), 1) * disk_item
+                    chunk = max(int((64 << 20) // row_bytes), 1)
+                    for c0 in range(start, stop, chunk * step):
+                        c1 = min(c0 + chunk * step, stop)
+                        out[(c0 - start) // step:
+                            (c1 - start) // step] = read_slice(
+                            name, (slice(c0, c1, step),) + tuple(idx[1:]),
+                            transpose)
+                    return out
 
                 params[pkey] = jax.make_array_from_callback(
                     shape, sharding, cb)
                 continue
-            if pkey.startswith("layers.") and pkey[7:] in by_key:
-                cands = by_key[pkey[7:]]
+            key = pkey[7:] if pkey.startswith("layers.") else pkey
+            lo, hi = partial.get(key, (0, L))
+            Lr = hi - lo
+            if key in ("moe_gate", "moe_up", "moe_down"):
+                # expert grid [Lr, E, in, out]: one disk tensor per
+                # (layer, expert) — each device reads ONLY its ep × tp
+                # sub-grid
+                if expert_naming is None:
+                    expert_naming = _resolve_expert_naming(lo)
+                prefix, inv = expert_naming
+                if key not in inv:
+                    raise ValueError(
+                        f"expert projection for {pkey} not found at layer "
+                        f"{lo} under model.layers.{lo}.{prefix}0.* — "
+                        f"present: {sorted(inv.values())}; the checkpoint "
+                        f"is missing or misnames this projection")
+                wname = inv[key]
+                E = shape[1]
+                names = [[(f"model.layers.{lo + i}.{prefix}{e}."
+                           f"{wname}.weight") for e in range(E)]
+                         for i in range(Lr)]
+                missing = [n for row in names for n in row
+                           if n not in where]
+                if missing:
+                    raise ValueError(
+                        f"checkpoint missing expert tensors for {pkey}: "
+                        f"{missing[:3]}…")
+
+                def cb(idx, names=names, E=E, Lr=Lr, shape=shape):
+                    # preallocate the handoff buffer, fill one
+                    # (layer, expert) piece at a time: the staging
+                    # transient is ONE disk-dtype piece (assignment
+                    # casts in place), never a stacked copy
+                    l_sl, e_sl = idx[0], idx[1]
+                    rest = tuple(idx[2:])
+                    ls = list(range(*l_sl.indices(Lr)))
+                    es = list(range(*e_sl.indices(E)))
+                    dims = [len(range(*sl.indices(dim)))
+                            for sl, dim in zip(rest, shape[2:])]
+                    out = _note_handoff(np.empty(
+                        [len(ls), len(es)] + dims, _np_dtype(dtype)))
+                    for j, i in enumerate(ls):
+                        for m, e in enumerate(es):
+                            out[j, m] = read_slice(names[i][e], rest, True)
+                    return out
+
+                params[pkey] = jax.make_array_from_callback(
+                    shape, sharding, cb)
+                continue
+            if key in by_key:
+                cands = by_key[key]
                 suffix, transpose, col_off = next(
                     (c for c in cands
-                     if f"model.layers.0.{c[0]}" in where), cands[0])
-                names = [f"model.layers.{i}.{suffix}" for i in range(L)]
+                     if f"model.layers.{lo}.{c[0]}" in where), cands[0])
+                names = [f"model.layers.{lo + i}.{suffix}"
+                         for i in range(Lr)]
                 if any(n not in where for n in names):
-                    missing = [i for i, n in enumerate(names)
+                    missing = [lo + i for i, n in enumerate(names)
                                if n not in where]
                     raise ValueError(
                         f"checkpoint missing layers {missing[:4]}… "
@@ -391,25 +585,36 @@ def load_llama_params_sharded(model_dir: str, mesh,
                 col_dim = shape[-1]
 
                 def cb(idx, names=names, transpose=transpose,
-                       col_off=col_off, col_dim=col_dim):
+                       col_off=col_off, col_dim=col_dim, Lr=Lr,
+                       shape=shape):
+                    # prealloc-and-fill (see expert path): transient =
+                    # one layer's disk-dtype slice
                     l_sl = idx[0]
                     rest = tuple(idx[1:])
-                    rows = [read_slice(names[i], rest, transpose,
-                                       col_off, col_dim)
-                            for i in range(*l_sl.indices(L))]
-                    return np.stack(rows, axis=0).astype(_np_dtype(dtype))
+                    ls = list(range(*l_sl.indices(Lr)))
+                    dims = [len(range(*sl.indices(dim)))
+                            for sl, dim in zip(rest, shape[1:])]
+                    out = _note_handoff(np.empty(
+                        [len(ls)] + dims, _np_dtype(dtype)))
+                    for j, i in enumerate(ls):
+                        out[j] = read_slice(
+                            names[i], rest, transpose, col_off, col_dim)
+                    return out
 
                 params[pkey] = jax.make_array_from_callback(
                     shape, sharding, cb)
                 continue
             raise NotImplementedError(
-                f"sharded loading not implemented for {pkey} "
-                f"(MoE checkpoints: use load_params_auto, which falls "
-                f"back to load_llama_params + shard_params)")
+                f"sharded loading not implemented for {pkey}")
 
     if "lm_head" not in params and not cfg.tie_word_embeddings:
         cfg.tie_word_embeddings = True
     return params
+
+
+# Backwards-compatible name (pre-round-5 the streaming loader was
+# llama-family-only; it now covers MoE and MLA too).
+load_llama_params_sharded = load_params_sharded
 
 
 def _np_dtype(dtype):
