@@ -424,8 +424,14 @@ def main() -> None:
     slope_end = pos0 + SLOPE_M2 * harvest
     max_len = max(wall_end, slope_end if device_time else 0) + 64
     # int8 pools need 32-token blocks (int8 sublane tile; attention.py
-    # pallas_supported)
-    bs = 32 if kv_quant == "int8" else 16
+    # pallas_supported). Small-C geometries (the 70B TP-8 shard's 1 kv
+    # head, C=128) are DMA-latency-bound at 16 — a 64-token block
+    # quadruples the per-DMA payload (round-5 probe: kernel 132 → 81
+    # us/call, device step 29.3 → 22.8 ms at the gate config), so the
+    # gate geometry defaults to 64. BENCH_KV_BS overrides either way.
+    small_c = mcfg.num_kv_heads * mcfg.head_dim <= 128
+    default_bs = "64" if small_c else ("32" if kv_quant == "int8" else "16")
+    bs = int(os.environ.get("BENCH_KV_BS", default_bs))
     blocks_per_seq = (max_len + bs - 1) // bs
     ecfg = EngineConfig(
         max_model_len=max_len, kv_block_size=bs,

@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import logging
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -185,6 +186,14 @@ class EngineCore:
             from .quant import quantize_params
             params = quantize_params(
                 params, include_embed=qembed, bits=qbits)
+        if (mesh is None and not self.is_mla
+                and os.environ.get("DYN_FUSE_MATMULS", "1") != "0"):
+            # single-device decode perf: wq|wk|wv → wqkv, gate|up →
+            # gateup (llama.fuse_stacked_matmuls — under a mesh the
+            # fused out axis cannot carry the tp column permutation).
+            # dict(): the transform deletes split keys — never from the
+            # caller's own tree
+            params = llama.fuse_stacked_matmuls(dict(params), model_cfg)
         self.params = params
         kv_shards = 1
         if (mesh is not None and engine_cfg.kv_quantization != "none"

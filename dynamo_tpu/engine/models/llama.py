@@ -123,15 +123,57 @@ def apply_rope(x: jax.Array, positions: jax.Array,
 
 
 def swiglu(x: jax.Array, gate_w: jax.Array, up_w: jax.Array,
-           down_w: jax.Array, act: str = "silu") -> jax.Array:
-    g = mm(x, gate_w)
+           down_w: jax.Array, act: str = "silu",
+           gateup_w=None) -> jax.Array:
+    if gateup_w is not None:      # fused gate|up (fuse_stacked_matmuls)
+        gu = mm(x, gateup_w)
+        F = gu.shape[-1] // 2
+        g, u = gu[..., :F], gu[..., F:]
+    else:
+        g, u = mm(x, gate_w), mm(x, up_w)
     if act in ("gelu_pytorch_tanh", "gelu"):   # gemma families
         gated = jax.nn.gelu(g, approximate=True)
     elif act == "silu":
         gated = jax.nn.silu(g)
     else:
         raise ValueError(f"unsupported hidden_act {act!r}")
-    return mm(gated * mm(x, up_w), down_w)
+    return mm(gated * u, down_w)
+
+
+def fuse_stacked_matmuls(params: dict, cfg: ModelConfig) -> dict:
+    """Concatenate wq|wk|wv → wqkv and gate|up → gateup along the out
+    axis (round-5 decode perf: one wide matmul streams the same weight
+    bytes with fewer fusion boundaries — measured ~16 µs/layer at the
+    70B-shard geometry, PERF.md "Where the next wins are").
+
+    SINGLE-DEVICE layouts only (EngineCore applies it when no mesh is
+    given): under tp, the fused out axis would need a per-shard column
+    permutation that NamedSharding cannot express — each rank of a
+    future shard_map decode path could fuse its LOCAL weights with this
+    same transform. Biases (bq/bk/bv) stay separate: they add after the
+    split, bit-identically. Grouped (int4) weights are left unfused —
+    the Pallas grouped kernel serves them per-tensor."""
+    def cat(keys, new):
+        ws = [params.get(f"layers.{k}") for k in keys]
+        if any(w is None for w in ws):
+            return
+        if all(isinstance(w, QuantizedArray) for w in ws):
+            if any(w.group or w.packed4 for w in ws):
+                return
+            params[f"layers.{new}"] = QuantizedArray(
+                jnp.concatenate([w.q for w in ws], axis=-1),
+                jnp.concatenate([w.scale for w in ws], axis=-1))
+        elif not any(isinstance(w, QuantizedArray) for w in ws):
+            params[f"layers.{new}"] = jnp.concatenate(ws, axis=-1)
+        else:
+            return
+        for k in keys:
+            del params[f"layers.{k}"]
+
+    cat(("wq", "wk", "wv"), "wqkv")
+    if cfg.num_experts == 0:
+        cat(("gate", "up"), "gateup")
+    return params
 
 
 def run_experts_dense(x: jax.Array, gate_w: jax.Array, up_w: jax.Array,
@@ -386,7 +428,14 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
         h, kp, vp = carry
         lp, sliding, li = xs["lp"], xs["sliding"], xs["i"]
         hn = rms_norm(h, lp["ln1"], cfg.rms_norm_eps, p1)
-        q, k, v = mm(hn, lp["wq"]), mm(hn, lp["wk"]), mm(hn, lp["wv"])
+        if "wqkv" in lp:          # fused qkv (fuse_stacked_matmuls)
+            qd = cfg.num_heads * cfg.head_dim
+            kvd = cfg.num_kv_heads * cfg.head_dim
+            qkv = mm(hn, lp["wqkv"])
+            q, k, v = (qkv[:, :qd], qkv[:, qd:qd + kvd],
+                       qkv[:, qd + kvd:])
+        else:
+            q, k, v = mm(hn, lp["wq"]), mm(hn, lp["wk"]), mm(hn, lp["wv"])
         if cfg.attention_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = q.reshape(N, cfg.num_heads, cfg.head_dim)
@@ -433,8 +482,9 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
                               norm_topk=cfg.moe_norm_topk,
                               shared=shared)
         else:
-            mlp_out = swiglu(hn2, lp["gate"], lp["up"], lp["down"],
-                             cfg.hidden_act)
+            mlp_out = swiglu(hn2, lp.get("gate"), lp.get("up"),
+                             lp["down"], cfg.hidden_act,
+                             gateup_w=lp.get("gateup"))
         if cfg.post_norms:
             mlp_out = rms_norm(mlp_out, lp["ln2_post"], cfg.rms_norm_eps, p1)
         h = h + mlp_out

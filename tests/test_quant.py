@@ -522,3 +522,37 @@ def test_int4_sharded_decode_matches_single_device():
         logits, _ = step(sp, kv, tokens, positions, tables)
     np.testing.assert_allclose(np.asarray(logits), np.asarray(ref_logits),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_fused_matmuls_match_split():
+    """fuse_stacked_matmuls (wqkv / gateup) serves the same decode logits
+    as the split form — int8 AND bf16 param trees (round-5 decode perf;
+    fusion is single-device-only, EngineCore gates it on mesh is None)."""
+    from dynamo_tpu.engine.models import llama
+
+    cfg = ModelConfig(
+        model_type="llama", vocab_size=128, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_position_embeddings=128, attention_bias=True,
+        tie_word_embeddings=False)
+    base = llama.init_params(cfg, jax.random.PRNGKey(5), dtype=jnp.float32)
+    kv = llama.init_kv_cache(cfg, 16, 8, dtype=jnp.float32)
+    statics = llama.ModelStatics(cfg=cfg, block_size=8, attn_impl="xla")
+    toks = jnp.asarray([3, 7], jnp.int32)
+    pos = jnp.asarray([1, 2], jnp.int32)
+    tables = jnp.asarray(np.arange(1, 9, dtype=np.int32).reshape(2, 4))
+
+    for quant in (False, True):
+        split = dict(quantize_params(dict(base)) if quant else base)
+        fused = llama.fuse_stacked_matmuls(
+            dict(quantize_params(dict(base)) if quant else base), cfg)
+        assert "layers.wqkv" in fused and "layers.wq" not in fused
+        assert "layers.gateup" in fused and "layers.gate" not in fused
+        want, _ = jax.jit(llama.decode_forward, static_argnums=5)(
+            split, kv, toks, pos, tables, statics)
+        got, _ = jax.jit(llama.decode_forward, static_argnums=5)(
+            fused, kv, toks, pos, tables, statics)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        assert (np.argmax(np.asarray(got), -1)
+                == np.argmax(np.asarray(want), -1)).all()
