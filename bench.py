@@ -436,7 +436,13 @@ def main() -> None:
     ecfg = EngineConfig(
         max_model_len=max_len, kv_block_size=bs,
         num_kv_blocks=batch * blocks_per_seq + 2, max_num_seqs=batch,
-        prefill_buckets=[prompt_len, max_len],
+        prefill_buckets=sorted({prompt_len, max_len, int(os.environ.get(
+            "BENCH_PREFILL_CHUNK", "0")) or prompt_len}),
+        # long-context MoE prefill: dense-over-E expert activations at
+        # whole-prompt N OOM the chip (measured: MLA 12K B=16 needs
+        # 16.0 of 15.75 GB) — BENCH_PREFILL_CHUNK routes the prompt
+        # through the engine's chunked-prefill path instead
+        prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", "0")),
         decode_steps_per_dispatch=harvest, quantization=quant,
         kv_quantization=kv_quant)
 
@@ -459,16 +465,25 @@ def main() -> None:
         table = np.zeros((core.M,), np.int32)
         table[:len(blocks)] = blocks
         core._block_tables[i, :] = table
-        padded = np.zeros((prompt_len,), np.int32)
-        padded[:] = prompts[i]
         key = make_slot_keys(0, jnp.asarray([0]), jnp.asarray(0))[0]
-        last_prefill_args = (
-            jnp.asarray(padded), jnp.asarray(table),
-            jnp.asarray(0, jnp.int32), jnp.asarray(prompt_len, jnp.int32),
-            key, jnp.asarray(0.7, jnp.float32), jnp.asarray(0, jnp.int32),
-            jnp.asarray(1.0, jnp.float32))
-        tok, lp, core.kv = core._prefill_jit(
-            core.params, core.kv, *last_prefill_args)
+        # chunked prompt walk when BENCH_PREFILL_CHUNK is set (the
+        # engine's _chunked_prefill shape: fixed C-token dispatches
+        # continuing at start_pos) — long-context MoE prefill OOMs
+        # whole-prompt (see ecfg comment)
+        C = ecfg.prefill_chunk or prompt_len
+        for lo in range(0, prompt_len, C):
+            piece = prompts[i][lo:lo + C]
+            padded = np.zeros((C,), np.int32)
+            padded[:len(piece)] = piece
+            last_prefill_args = (
+                jnp.asarray(padded), jnp.asarray(table),
+                jnp.asarray(lo, jnp.int32),
+                jnp.asarray(len(piece), jnp.int32),
+                key, jnp.asarray(0.7, jnp.float32),
+                jnp.asarray(0, jnp.int32),
+                jnp.asarray(1.0, jnp.float32))
+            tok, lp, core.kv = core._prefill_jit(
+                core.params, core.kv, *last_prefill_args)
         core._tokens[i] = int(tok)
         core._positions[i] = prompt_len
         if not warmed:
@@ -559,7 +574,8 @@ def main() -> None:
             core, mcfg, batch, pos0,
             temp=temp, topk=topk, topp=topp, seeds=seeds))
         device_extra.update(device_prefill_timing(
-            core, prompt_len, last_prefill_args))
+            core, min(ecfg.prefill_chunk or prompt_len, prompt_len),
+            last_prefill_args))
 
     # device truth is the headline number; the wall loop (host scheduler
     # + tunnel round-trips) rides along in extra. The wall throughput can

@@ -186,7 +186,7 @@ class EngineCore:
             from .quant import quantize_params
             params = quantize_params(
                 params, include_embed=qembed, bits=qbits)
-        if (mesh is None and not self.is_mla
+        if (mesh is None
                 and os.environ.get("DYN_FUSE_MATMULS", "1") != "0"):
             # single-device decode perf: wq|wk|wv → wqkv, gate|up →
             # gateup (llama.fuse_stacked_matmuls — under a mesh the
@@ -287,6 +287,10 @@ class EngineCore:
         self._onboard_tasks: set = set()
         self._handoff_tasks: set = set()
         self.waiting: asyncio.Queue[EngineRequest] = asyncio.Queue()
+        # every submitted-not-finished request by id (slots/waiting
+        # alone can miss one mid-admission) — _fail_pending's registry
+        self._inflight_reqs: dict = {}
+        self._dead: Optional[BaseException] = None
         self._work_event = asyncio.Event()
         self._loop_task: Optional[asyncio.Task] = None
         self._stopping = False
@@ -415,6 +419,12 @@ class EngineCore:
 
     # ------------------------------------------------------------ lifecycle
     def ensure_started(self) -> None:
+        if self._dead is not None:
+            # a fatal loop error already failed every pending request;
+            # silently restarting would re-serve them (round-5 review)
+            raise RuntimeError(
+                f"engine loop died: {self._dead!r} — create a new "
+                f"EngineCore") from self._dead
         if self._loop_task is None or self._loop_task.done():
             self._stopping = False
             self._loop_task = asyncio.get_running_loop().create_task(
@@ -495,6 +505,7 @@ class EngineCore:
                     sample.shape[1] * sample.shape[4], sample.dtype,
                     "wire")
         self.ensure_started()
+        self._inflight_reqs[id(req)] = req
         await self.waiting.put(req)
         self._work_event.set()
 
@@ -524,6 +535,39 @@ class EngineCore:
         return (n_tokens + bs - 1) // bs
 
     async def _run_loop(self) -> None:
+        try:
+            await self._run_loop_inner()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:   # noqa: BLE001 — fatal loop error
+            # Round-5 postmortem: an exception here used to kill the
+            # loop task SILENTLY, leaving every pending request awaiting
+            # an out_queue forever (observed as a test hang, not a
+            # failure). Fail them all loudly instead, then re-raise.
+            logger.exception("engine loop died; failing %d active + %d "
+                             "waiting requests", 
+                             sum(1 for x in self.slots if x is not None),
+                             self.waiting.qsize())
+            self._fail_pending(e)
+            raise
+
+    def _fail_pending(self, exc: BaseException) -> None:
+        from ..llm.protocols.common import FinishReason
+        self._dead = exc
+        for rid, req in list(self._inflight_reqs.items()):
+            req.out_queue.put_nowait((FINISH_SENTINEL,
+                                      FinishReason.ERROR))
+        self._inflight_reqs.clear()
+        # clear scheduler state so nothing can be re-served even if a
+        # caller pokes internals
+        self.slots = [None] * len(self.slots)
+        while not self.waiting.empty():
+            try:
+                self.waiting.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+
+    async def _run_loop_inner(self) -> None:
         logger.info("engine loop starting: %d slots, %d KV blocks, block=%d",
                     self.B, self.cfg.num_kv_blocks, self.cfg.kv_block_size)
         while not self._stopping:
@@ -1510,6 +1554,7 @@ class EngineCore:
 
     def _finish_request(self, req: EngineRequest,
                         reason: FinishReason) -> None:
+        self._inflight_reqs.pop(id(req), None)
         req.out_queue.put_nowait((_FINISH, reason))
 
 

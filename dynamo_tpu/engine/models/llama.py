@@ -171,25 +171,35 @@ def fuse_stacked_matmuls(params: dict, cfg: ModelConfig) -> dict:
             del params[f"layers.{k}"]
 
     cat(("wq", "wk", "wv"), "wqkv")
-    if cfg.num_experts == 0:
-        cat(("gate", "up"), "gateup")
+    cat(("gate", "up"), "gateup")
+    # MoE families: expert grids, shared experts, and the deepseek
+    # hybrid's dense-prefix stacks fuse the same way (cat skips any
+    # pair the family doesn't have)
+    cat(("moe_gate", "moe_up"), "moe_gateup")
+    cat(("sh_gate", "sh_up"), "sh_gateup")
+    cat(("dense_gate", "dense_up"), "dense_gateup")
     return params
 
 
 def run_experts_dense(x: jax.Array, gate_w: jax.Array, up_w: jax.Array,
                       down_w: jax.Array, top_idx: jax.Array,
-                      top_w: jax.Array) -> jax.Array:
+                      top_w: jax.Array, gateup_w=None) -> jax.Array:
     """Dense-over-E expert execution + one-hot combine — the ONE home of
     the expert einsum layout (E stays a batched/contracted axis so the
     mesh "ep" sharding turns the combine into an XLA psum; see moe_mlp's
     rationale). Shared by moe_mlp and mla._moe_mlp so their layouts
     cannot diverge."""
-    E = gate_w.shape[0]
+    E = down_w.shape[0]
     combine = jnp.sum(
         jax.nn.one_hot(top_idx, E, dtype=jnp.float32)
         * top_w[..., None], axis=1)                              # [N, E]
-    g = qeinsum("nd,edf->enf", x, gate_w)
-    u = qeinsum("nd,edf->enf", x, up_w)
+    if gateup_w is not None:      # fused gate|up (fuse_stacked_matmuls)
+        gu = qeinsum("nd,edf->enf", x, gateup_w)
+        F = gu.shape[-1] // 2
+        g, u = gu[..., :F], gu[..., F:]
+    else:
+        g = qeinsum("nd,edf->enf", x, gate_w)
+        u = qeinsum("nd,edf->enf", x, up_w)
     y = qeinsum("enf,efd->end", jax.nn.silu(g) * u, down_w)      # [E, N, D]
     return jnp.einsum("ne,end->nd", combine.astype(y.dtype), y)
 
@@ -197,7 +207,8 @@ def run_experts_dense(x: jax.Array, gate_w: jax.Array, up_w: jax.Array,
 def moe_mlp(x: jax.Array, router_w: jax.Array, gate_w: jax.Array,
             up_w: jax.Array, down_w: jax.Array, top_k: int,
             norm_topk: bool = True,
-            shared: Optional[tuple] = None) -> jax.Array:
+            shared: Optional[tuple] = None,
+            gateup_w=None, shared_gateup=None) -> jax.Array:
     """Sparse MoE MLP, computed densely over the expert axis.
 
     x: [N, D]; router_w: [D, E]; gate/up: [E, D, F]; down: [E, F, D].
@@ -225,10 +236,12 @@ def moe_mlp(x: jax.Array, router_w: jax.Array, gate_w: jax.Array,
     else:
         probs = jax.nn.softmax(logits, axis=-1)
         top_w, top_idx = jax.lax.top_k(probs, top_k)
-    out = run_experts_dense(x, gate_w, up_w, down_w, top_idx, top_w)
+    out = run_experts_dense(x, gate_w, up_w, down_w, top_idx, top_w,
+                            gateup_w=gateup_w)
     if shared is not None:
         sh_gate, sh_up, sh_down, sh_router = shared
-        s = swiglu(x, sh_gate, sh_up, sh_down, "silu")
+        s = swiglu(x, sh_gate, sh_up, sh_down, "silu",
+                   gateup_w=shared_gateup)
         sg = jax.nn.sigmoid((x @ sh_router).astype(jnp.float32))  # [N, 1]
         out = out + sg.astype(out.dtype) * s
     return out
@@ -385,7 +398,7 @@ class ModelStatics:
 
 def _run_layers(params: Params, kv: KVCache, x: jax.Array,
                 positions: jax.Array, slots: jax.Array, cfg: ModelConfig,
-                attn_fn) -> Tuple[jax.Array, KVCache]:
+                attn_fn, final_norm: bool = True) -> Tuple[jax.Array, KVCache]:
     """Shared transformer stack: per layer — qkv projection, rope, KV
     scatter into the paged pool, ``attn_fn`` (the only thing the three
     forward paths differ in), wo residual, swiglu MLP; scanned over the
@@ -473,14 +486,16 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
         h = h + attn_out
         hn2 = rms_norm(h, lp["ln2"], cfg.rms_norm_eps, p1)
         if cfg.num_experts > 0:
-            shared = (tuple(lp[k] for k in ("sh_gate", "sh_up",
-                                            "sh_down", "sh_router"))
+            shared = (tuple(lp.get(k) for k in ("sh_gate", "sh_up",
+                                                "sh_down", "sh_router"))
                       if cfg.shared_expert_size > 0 else None)
-            mlp_out = moe_mlp(hn2, lp["router"], lp["moe_gate"],
-                              lp["moe_up"], lp["moe_down"],
+            mlp_out = moe_mlp(hn2, lp["router"], lp.get("moe_gate"),
+                              lp.get("moe_up"), lp["moe_down"],
                               cfg.num_experts_per_tok,
                               norm_topk=cfg.moe_norm_topk,
-                              shared=shared)
+                              shared=shared,
+                              gateup_w=lp.get("moe_gateup"),
+                              shared_gateup=lp.get("sh_gateup"))
         else:
             mlp_out = swiglu(hn2, lp.get("gate"), lp.get("up"),
                              lp["down"], cfg.hidden_act,
@@ -494,7 +509,8 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
         layer, (x, kv["k"], kv["v"]),
         {"lp": layer_params, "sliding": sliding_flags,
          "i": jnp.arange(L, dtype=jnp.int32)})
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, p1)
+    if final_norm:   # pp stages norm ONCE after the last stage, not per slice
+        x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps, p1)
     return x, {"k": k_new, "v": v_new}
 
 

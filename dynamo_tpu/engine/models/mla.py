@@ -358,11 +358,13 @@ def _moe_mlp(hn, lp, cfg: ModelConfig) -> jax.Array:
         # NO renormalization: the HF-native reference never applies
         # norm_topk_prob (from_hf_config rejects true for deepseek_v2)
         top_w = top_w * cfg.routed_scaling
-    out = run_experts_dense(hn, lp["moe_gate"], lp["moe_up"],
-                            lp["moe_down"], top_idx, top_w)
+    out = run_experts_dense(hn, lp.get("moe_gate"), lp.get("moe_up"),
+                            lp["moe_down"], top_idx, top_w,
+                            gateup_w=lp.get("moe_gateup"))
     if cfg.shared_expert_size > 0:
-        out = out + swiglu(hn, lp["sh_gate"], lp["sh_up"],
-                           lp["sh_down"], cfg.hidden_act)
+        out = out + swiglu(hn, lp.get("sh_gate"), lp.get("sh_up"),
+                           lp["sh_down"], cfg.hidden_act,
+                           gateup_w=lp.get("sh_gateup"))
     return out
 
 
@@ -428,17 +430,22 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
         k = cfg.first_k_dense
         if k > 0:
             dense_lp = {n: stack[n][:k] for n in _ATTN if n in stack}
-            dense_lp.update({"gate": stack["dense_gate"],
-                             "up": stack["dense_up"],
-                             "down": stack["dense_down"]})
+            dense_lp["down"] = stack["dense_down"]
+            if "dense_gateup" in stack:   # fused (fuse_stacked_matmuls)
+                dense_lp["gateup"] = stack["dense_gateup"]
+            else:
+                dense_lp.update({"gate": stack["dense_gate"],
+                                 "up": stack["dense_up"]})
             (x, pool), _ = jax.lax.scan(
                 make_layer(lambda hn, lp: swiglu(
-                    hn, lp["gate"], lp["up"], lp["down"], cfg.hidden_act)),
+                    hn, lp.get("gate"), lp.get("up"), lp["down"],
+                    cfg.hidden_act, gateup_w=lp.get("gateup"))),
                 (x, pool),
                 {"lp": dense_lp, "i": jnp.arange(k, dtype=jnp.int32)})
         moe_lp = {n: stack[n][k:] for n in _ATTN if n in stack}
         for n in ("router", "router_bias", "moe_gate", "moe_up",
-                  "moe_down", "sh_gate", "sh_up", "sh_down"):
+                  "moe_down", "moe_gateup", "sh_gate", "sh_up",
+                  "sh_down", "sh_gateup"):
             if n in stack:
                 moe_lp[n] = stack[n]
         (x, pool), _ = jax.lax.scan(
@@ -448,10 +455,12 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
     else:
         (x, pool), _ = jax.lax.scan(
             make_layer(lambda hn, lp: swiglu(
-                hn, lp["gate"], lp["up"], lp["down"], cfg.hidden_act)),
+                hn, lp.get("gate"), lp.get("up"), lp["down"],
+                cfg.hidden_act, gateup_w=lp.get("gateup"))),
             (x, pool),
             {"lp": {k: v for k, v in stack.items()
-                    if k in _ATTN or k in ("gate", "up", "down")},
+                    if k in _ATTN or k in ("gate", "up", "down",
+                                           "gateup")},
              "i": jnp.arange(L, dtype=jnp.int32)})
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     return x, {"kv": pool}

@@ -1,0 +1,165 @@
+"""Pipeline parallelism: layer-partitioned decode over a ``pp`` mesh axis.
+
+Why PP exists here (VERDICT r4 item 6; reference analog: the vLLM
+engines' ``pipeline_parallel_size=num_nodes``,
+lib/llm/src/engines/vllm/subprocess.rs:41): tensor parallelism needs two
+[B, D] all-reduces PER LAYER, which is only affordable over ICI — across
+hosts on DCN (25 Gb/s) an 80-layer model would spend ~107 ms/step in
+collectives (tools/bandwidth_model.py rates). Pipeline parallelism moves
+ONE [B, D] activation per stage boundary per step — the only viable
+cross-host axis, and the capacity enabler for checkpoints that exceed a
+host's HBM (DeepSeek-V3 int8 ≈ 336 GB > any single v5e/v5p host).
+
+Design (v1, deliberately minimal and correct):
+
+- stacked layer params and the paged KV pool shard their leading L axis
+  over ``pp`` (P("pp", ...)) — each rank OWNS its layer slice and the KV
+  written by those layers; nothing else moves.
+- the forward is a shard_map stage loop: every rank runs its local
+  ``llama._run_layers`` each stage; only the rank whose turn it is has
+  the real activation, and the chain hands it to the next rank with one
+  ppermute per boundary. Off-turn ranks compute garbage at full speed
+  (the classic un-microbatched bubble: utilization 1/pp) and their KV
+  writes are masked to dead slots (scatter mode="drop"), so the pool
+  stays exact.
+- embed runs replicated before the loop; final norm + lm head replicate
+  and run after the last stage's activation is broadcast (psum of a
+  rank-masked copy).
+
+Deliberate v1 limits (documented, loud):
+- no microbatched prefill / token-pipelined decode yet — the bubble
+  makes pp=k cost ~k× a single stage's time, so v1 is the CAPACITY and
+  cross-host-topology axis, not a same-host throughput axis (PERF.md
+  "Round 5: pipeline parallelism" has the measured arithmetic; on one
+  host TP+SP strictly dominates and remains the default).
+- pp composes with nothing else in-engine yet (mesh must factor other
+  axes at 1); tp×pp needs in-stage collectives under shard_map.
+- sliding-window families refuse: the global layer index decides each
+  layer's window flag, and v1 statics are built per-slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine.models import llama
+
+
+def pp_split_config(statics, pp: int):
+    """Per-stage statics: the local stack is num_layers/pp deep."""
+    cfg = statics.cfg
+    if cfg.num_layers % pp != 0:
+        raise ValueError(
+            f"pp={pp} must divide num_layers={cfg.num_layers}")
+    if cfg.sliding_window is not None:
+        raise NotImplementedError(
+            "pp with sliding-window layer patterns is not implemented — "
+            "the window flag depends on the GLOBAL layer index (v1 "
+            "statics are per-slice)")
+    local_cfg = dataclasses.replace(cfg,
+                                    num_layers=cfg.num_layers // pp)
+    return dataclasses.replace(statics, cfg=local_cfg)
+
+
+def pp_decode_forward(params: Dict[str, jax.Array], kv, tokens, positions,
+                      block_tables, statics, mesh) -> Tuple[jax.Array, dict]:
+    """Batched single-token decode over a pp-sharded layer stack.
+
+    Same contract as llama.decode_forward; params' ``layers.*`` stacks
+    and the kv pools must be sharded P("pp") on their leading axis (the
+    caller places them — pp_param_pspecs/pp_kv_pspecs)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    cfg = statics.cfg
+    pp = mesh.shape["pp"]
+    local_statics = pp_split_config(statics, pp)
+    local_cfg = local_statics.cfg
+    B = tokens.shape[0]
+    bsz = statics.block_size
+    scale = llama._attn_scale(cfg)
+    slots = (block_tables[jnp.arange(B), positions // bsz] * bsz
+             + positions % bsz)
+    seq_lens = positions + 1
+
+    stacks = {k: v for k, v in params.items() if k.startswith("layers.")}
+    x0 = llama._embed(params, tokens, cfg)            # [B, D], replicated
+
+    ring = [(i, (i + 1) % pp) for i in range(pp)]
+
+    def stage_fn(stacks_l, kv_l, x, positions, slots, seq_lens,
+                 block_tables):
+        r = jax.lax.axis_index("pp")
+
+        def attn(q, _k, _v, k_flat, v_flat, li, sliding):
+            num_blocks = k_flat.shape[0] // (local_cfg.num_layers * bsz)
+            return llama.paged_attention(
+                q, k_flat, v_flat, block_tables + li * num_blocks,
+                seq_lens, block_size=bsz, scale=scale,
+                impl=local_statics.attn_impl,
+                softcap=local_cfg.attn_logit_softcap,
+                kv_heads=local_cfg.num_kv_heads)
+
+        for s in range(pp):
+            if s:
+                x = jax.lax.ppermute(x, "pp", ring)
+            my_turn = r == s
+            # off-turn ranks run the same program on garbage input (the
+            # un-microbatched bubble) — their KV scatters are masked to
+            # index NTOK, which is genuinely OUT OF BOUNDS and dropped
+            # by mode="drop". (-1 would NOT work: advanced-index
+            # scatter normalizes negatives first, so -1 silently
+            # overwrites the pool's LAST row — round-5 review catch.)
+            ntok = kv_l["k"].shape[1]
+            slots_eff = jnp.where(my_turn, slots, ntok)
+            x2, kv_l = llama._run_layers(stacks_l, kv_l, x, positions,
+                                         slots_eff, local_cfg, attn,
+                                         final_norm=False)
+            x = jnp.where(my_turn, x2, x)
+        # rank pp-1 holds the final activation; hand it around the ring
+        # once and psum a rank-0 mask so every rank returns the same x
+        x = jax.lax.ppermute(x, "pp", ring)
+        x = jax.lax.psum(
+            jnp.where(jax.lax.axis_index("pp") == 0, x, 0.0), "pp")
+        return x, kv_l
+
+    stack_specs = {k: P("pp") for k in stacks}
+    kv_specs = {k: P("pp") for k in kv}
+    fn = shard_map(
+        stage_fn, mesh=mesh,
+        in_specs=(stack_specs, kv_specs, P(), P(), P(), P(), P()),
+        out_specs=(P(), kv_specs),
+        check_rep=False)
+    x, kv_new = fn(stacks, kv, x0, positions, slots, seq_lens,
+                   block_tables)
+    x = llama.rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
+                       cfg.norm_plus_one)
+    return llama._logits(params, x, cfg), kv_new
+
+
+def pp_param_pspecs(cfg) -> Dict[str, "jax.sharding.PartitionSpec"]:
+    """Layer stacks sharded on L over pp; everything else replicated."""
+    from jax.sharding import PartitionSpec as P
+    from ..engine.models.llama import param_shapes
+    out = {}
+    for k in param_shapes(cfg):
+        out[k] = P("pp") if k.startswith("layers.") else P()
+    return out
+
+
+def pp_kv_pspecs() -> Dict[str, "jax.sharding.PartitionSpec"]:
+    from jax.sharding import PartitionSpec as P
+    return {"k": P("pp"), "v": P("pp")}
+
+
+def make_pp_mesh(pp: int, devices=None):
+    import numpy as np
+    from jax.sharding import Mesh
+    devices = list(devices if devices is not None else jax.devices())
+    if pp > len(devices):
+        raise ValueError(f"pp={pp} > {len(devices)} devices")
+    return Mesh(np.array(devices[:pp]), ("pp",))

@@ -1,0 +1,46 @@
+"""A fatal engine-loop error must FAIL pending requests loudly, not
+strand them (round-5 postmortem: a KeyError inside the jitted step
+killed the loop task silently and callers awaited forever — observed
+as a test hang, not a failure)."""
+
+import asyncio
+
+import pytest
+
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineCore, EngineRequest
+from dynamo_tpu.engine.sampling import SlotSampling
+from dynamo_tpu.llm.protocols.common import FinishReason
+
+pytestmark = pytest.mark.asyncio
+
+TINY = ModelConfig(
+    model_type="llama", vocab_size=128, hidden_size=64,
+    intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+    head_dim=16, max_position_embeddings=256, tie_word_embeddings=False)
+
+
+async def test_loop_death_fails_pending_requests(monkeypatch):
+    core = EngineCore(
+        TINY,
+        EngineConfig(max_model_len=64, kv_block_size=8, num_kv_blocks=16,
+                     max_num_seqs=2, prefill_buckets=[16]),
+        attn_impl="xla", param_dtype=jnp.float32)
+
+    def boom(*a, **k):
+        raise RuntimeError("injected step failure")
+
+    monkeypatch.setattr(core, "_prefill_jit", boom)
+    req = EngineRequest(rid="r", prompt=[3, 4, 5],
+                       sampling=SlotSampling(temperature=0.0),
+                       max_new_tokens=4, eos_ids=frozenset())
+    await core.submit(req)
+    item, payload = await asyncio.wait_for(req.out_queue.get(), timeout=30)
+    assert item is FINISH_SENTINEL
+    assert payload == FinishReason.ERROR
+    with pytest.raises(RuntimeError, match="injected"):
+        await asyncio.wait_for(core._loop_task, timeout=10)
+    core._loop_task = None
+    await core.stop()

@@ -1,0 +1,90 @@
+"""Pipeline parallelism (parallel/pipeline_parallel.py): pp-sharded
+layer stacks must decode IDENTICALLY to the single-device model —
+including the KV the owner ranks write (off-turn garbage must land on
+dropped slots, never in the pool). Reference analog: the vLLM engines'
+pipeline_parallel_size flag (subprocess.rs:41); ours is the cross-host
+capacity axis (module docstring has the DCN arithmetic)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.config import ModelConfig
+from dynamo_tpu.engine.models import llama
+from dynamo_tpu.parallel.pipeline_parallel import (make_pp_mesh,
+                                                   pp_decode_forward,
+                                                   pp_kv_pspecs,
+                                                   pp_param_pspecs,
+                                                   pp_split_config)
+
+TINY = ModelConfig(
+    model_type="llama", vocab_size=128, hidden_size=64,
+    intermediate_size=128, num_layers=4, num_heads=4, num_kv_heads=2,
+    head_dim=16, max_position_embeddings=256, tie_word_embeddings=False)
+
+
+def _place(params, kv, mesh):
+    from jax.sharding import NamedSharding
+    specs = pp_param_pspecs(TINY)
+    params = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+              for k, v in params.items()}
+    kvs = pp_kv_pspecs()
+    kv = {k: jax.device_put(v, NamedSharding(mesh, kvs[k]))
+          for k, v in kv.items()}
+    return params, kv
+
+
+@pytest.mark.parametrize("pp", [2, 4])
+def test_pp_decode_matches_single_device(pp):
+    statics = llama.ModelStatics(cfg=TINY, block_size=8, attn_impl="xla")
+    params = llama.init_params(TINY, jax.random.PRNGKey(3),
+                               dtype=jnp.float32)
+    kv0 = llama.init_kv_cache(TINY, 32, 8, dtype=jnp.float32)
+    rng = np.random.default_rng(5)
+    B, M = 2, 4
+    # seq 0 decodes AT the pool's final row (block 31, offset 7 = row
+    # NTOK-1): the off-turn KV mask must never touch it — a -1 mask
+    # would overwrite exactly that row every stage (review catch:
+    # advanced-index scatter normalizes -1 BEFORE mode="drop")
+    tables = jnp.asarray(rng.integers(1, 31, size=(B, M)).astype(np.int32))
+    tables = tables.at[0, M - 1].set(31)
+    toks = jnp.asarray([5, 9], jnp.int32)
+    pos = jnp.asarray([31, 7], jnp.int32)
+
+    # single-device truth: THREE chained steps (the pp pool writes must
+    # feed later steps exactly)
+    want_logits = []
+    kv = jax.tree.map(jnp.copy, kv0)
+    t, p = toks, pos
+    for _ in range(3):
+        lg, kv = jax.jit(llama.decode_forward, static_argnums=5)(
+            params, kv, t, p, tables, statics)
+        want_logits.append(np.asarray(lg))
+        t = jnp.argmax(lg, -1).astype(jnp.int32)
+        p = p + 1
+
+    mesh = make_pp_mesh(pp)
+    pparams, pkv = _place(params, jax.tree.map(jnp.copy, kv0), mesh)
+    got_logits = []
+    t, p = toks, pos
+    fn = jax.jit(pp_decode_forward, static_argnums=(5, 6))
+    for _ in range(3):
+        lg, pkv = fn(pparams, pkv, t, p, tables, statics, mesh)
+        got_logits.append(np.asarray(lg))
+        t = jnp.argmax(lg, -1).astype(jnp.int32)
+        p = p + 1
+
+    for w, g in zip(want_logits, got_logits):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-5)
+
+
+def test_pp_rejects_bad_factorizations():
+    statics = llama.ModelStatics(cfg=TINY, block_size=8, attn_impl="xla")
+    with pytest.raises(ValueError, match="divide"):
+        pp_split_config(statics, 3)
+    import dataclasses
+    sw = dataclasses.replace(TINY, sliding_window=16)
+    with pytest.raises(NotImplementedError, match="sliding"):
+        pp_split_config(dataclasses.replace(statics, cfg=sw), 2)
