@@ -432,17 +432,18 @@ def main() -> None:
     small_c = mcfg.num_kv_heads * mcfg.head_dim <= 128
     default_bs = "64" if small_c else ("32" if kv_quant == "int8" else "16")
     bs = int(os.environ.get("BENCH_KV_BS", default_bs))
+    prefill_chunk = int(os.environ.get("BENCH_PREFILL_CHUNK", "0"))
     blocks_per_seq = (max_len + bs - 1) // bs
     ecfg = EngineConfig(
         max_model_len=max_len, kv_block_size=bs,
         num_kv_blocks=batch * blocks_per_seq + 2, max_num_seqs=batch,
-        prefill_buckets=sorted({prompt_len, max_len, int(os.environ.get(
-            "BENCH_PREFILL_CHUNK", "0")) or prompt_len}),
+        prefill_buckets=sorted({prompt_len, max_len,
+                                prefill_chunk or prompt_len}),
         # long-context MoE prefill: dense-over-E expert activations at
         # whole-prompt N OOM the chip (measured: MLA 12K B=16 needs
         # 16.0 of 15.75 GB) — BENCH_PREFILL_CHUNK routes the prompt
         # through the engine's chunked-prefill path instead
-        prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", "0")),
+        prefill_chunk=prefill_chunk,
         decode_steps_per_dispatch=harvest, quantization=quant,
         kv_quantization=kv_quant)
 
@@ -471,8 +472,10 @@ def main() -> None:
         # continuing at start_pos) — long-context MoE prefill OOMs
         # whole-prompt (see ecfg comment)
         C = ecfg.prefill_chunk or prompt_len
+        last_piece_len = prompt_len
         for lo in range(0, prompt_len, C):
             piece = prompts[i][lo:lo + C]
+            last_piece_len = len(piece)
             padded = np.zeros((C,), np.int32)
             padded[:len(piece)] = piece
             last_prefill_args = (
@@ -574,8 +577,7 @@ def main() -> None:
             core, mcfg, batch, pos0,
             temp=temp, topk=topk, topp=topp, seeds=seeds))
         device_extra.update(device_prefill_timing(
-            core, min(ecfg.prefill_chunk or prompt_len, prompt_len),
-            last_prefill_args))
+            core, last_piece_len, last_prefill_args))
 
     # device truth is the headline number; the wall loop (host scheduler
     # + tunnel round-trips) rides along in extra. The wall throughput can

@@ -438,6 +438,11 @@ class EngineCore:
                 await asyncio.wait_for(self._loop_task, timeout=5)
             except asyncio.TimeoutError:
                 self._loop_task.cancel()
+            except Exception:  # noqa: BLE001 — fatal loop death is a
+                # supported state (_fail_pending already failed every
+                # pending request and logged the exception); stop()'s
+                # remaining cleanup must still run
+                pass
             self._loop_task = None
         if self._admissions:              # finish deferred admissions
             self._complete_admissions()
@@ -475,8 +480,10 @@ class EngineCore:
         """A disagg KV payload must match this pool's row layout exactly:
         same lane width (int8 rows bundle their tp-shard scale groups, so
         width also encodes the prefill engine's tp) and same dtype.
-        Mismatches fail loudly — a scale-aware repack of int8 rows
-        across kv_quantization or tp settings is not supported."""
+        DEVICE-plane payloads with a differing kv_quantization were
+        already repacked (_maybe_repack_kv_payload) before this check;
+        anything still mismatched here — wire-plane cross-quant, int8
+        across differing tp — fails loudly."""
         pool = next(iter(self.kv.values()))   # key-agnostic: llama
         # pools are {"k","v"}, MLA latent pools are {"kv"}
         if lanes != pool.shape[-1] or np.dtype(dtype) != pool.dtype:
@@ -487,6 +494,73 @@ class EngineCore:
                 f"decode engines must share kv_quantization (and tp, for "
                 f"int8 pools)")
 
+    def _maybe_repack_kv_payload(self, pc):
+        """Scale-aware repack of a DEVICE-plane disagg payload whose
+        kv_quantization differs from this pool's (round 5, VERDICT r4
+        item 4; reference analog: block_copy.cu's cross-layout reshard,
+        lib/llm/src/kernels/block_copy.cu:558-728): int8 payload rows
+        dequantize, bf16 rows requantize into THIS pool's group/section
+        layout — all on device, before admission. Same-layout payloads
+        pass through untouched (bit-exact as before). Still refused:
+        int8 payloads whose tp-shard GROUP COUNT differs from this
+        pool's (a group re-split must reshuffle head ownership), and
+        every wire-plane mismatch (the wire is the compatibility
+        fallback; its head-major format carries no scale structure to
+        convert in place)."""
+        import jax.numpy as jnp
+
+        from ..engine.attention import (dequant_kv_rows,
+                                        dequant_kv_rows_sections,
+                                        kv_row_groups, quantize_kv_rows,
+                                        quantize_kv_rows_sections)
+        pool = next(iter(self.kv.values()))
+        want_w, want_dt = pool.shape[-1], pool.dtype
+        sample = next(iter(pc.stacked.values()))
+        have_w, have_dt = sample.shape[-1], sample.dtype
+        if have_w == want_w and have_dt == want_dt:
+            return pc
+        src_q = have_dt == jnp.int8
+        dst_q = want_dt == jnp.int8
+        if not (src_q or dst_q):
+            return pc          # width-only mismatch: the tp reshard path
+        if self.is_mla:
+            sections = (self.model_cfg.kv_lora_rank,
+                        self.model_cfg.qk_rope_head_dim)
+            C = sum(sections)
+        else:
+            sections = None
+            C = self.model_cfg.num_kv_heads * self.model_cfg.head_dim
+        if src_q and dst_q:
+            raise ValueError(
+                f"disagg KV repack across two int8 layouts ({have_w} -> "
+                f"{want_w} lanes) is not supported: the scale GROUP "
+                f"counts encode each engine's tp, and re-splitting "
+                f"groups must reshuffle head ownership")
+
+        def convert(arr):
+            lead = arr.shape[:-1]
+            rows = arr.reshape((-1, arr.shape[-1]))
+            if src_q:
+                mid = jnp.bfloat16 if dst_q else want_dt
+                rows = (dequant_kv_rows_sections(rows, sections, mid)
+                        if sections is not None
+                        else dequant_kv_rows(rows, C, mid))
+            if dst_q:
+                x = rows[..., :C].astype(jnp.bfloat16)
+                rows = (quantize_kv_rows_sections(x, sections)
+                        if sections is not None
+                        else quantize_kv_rows(
+                            x, kv_row_groups(want_w, C)))
+            return rows.reshape(lead + (rows.shape[-1],))
+
+        import dataclasses as _dc
+        new_stacked = {k: convert(v) for k, v in pc.stacked.items()}
+        logger.info("disagg KV payload repacked %s/%d -> %s/%d lanes "
+                    "for %s", have_dt, have_w, want_dt,
+                    new_stacked[next(iter(new_stacked))].shape[-1],
+                    pc.request_id)
+        return _dc.replace(pc, stacked=new_stacked)
+
     # ------------------------------------------------------------- frontend
     async def submit(self, req: EngineRequest) -> None:
         if req.precomputed is not None:
@@ -496,6 +570,7 @@ class EngineCore:
             from ..llm.kv_transport import DeviceKvPayload
             pc = req.precomputed
             if isinstance(pc, DeviceKvPayload):
+                req.precomputed = pc = self._maybe_repack_kv_payload(pc)
                 sample = next(iter(pc.stacked.values()))
                 self._check_kv_payload_layout(sample.shape[-1],
                                               sample.dtype, "device")

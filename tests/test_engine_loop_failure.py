@@ -40,7 +40,11 @@ async def test_loop_death_fails_pending_requests(monkeypatch):
     item, payload = await asyncio.wait_for(req.out_queue.get(), timeout=30)
     assert item is FINISH_SENTINEL
     assert payload == FinishReason.ERROR
-    with pytest.raises(RuntimeError, match="injected"):
-        await asyncio.wait_for(core._loop_task, timeout=10)
-    core._loop_task = None
+    # stop() must complete its cleanup even after loop death (the
+    # loop's exception was already surfaced via ERROR + logging)
     await core.stop()
+    # ... and a dead engine refuses new work instead of restarting
+    with pytest.raises(RuntimeError, match="engine loop died"):
+        await core.submit(EngineRequest(
+            rid="r2", prompt=[1], sampling=SlotSampling(temperature=0.0),
+            max_new_tokens=1, eos_ids=frozenset()))
