@@ -193,10 +193,16 @@ def device_timing(core, mcfg, batch, pos0, *,
     }
 
 
-def device_prefill_timing(core, prompt_len, prefill_args):
+def device_prefill_timing(core, prompt_len, prefill_args_walk):
     """Device time per whole-prompt prefill via the same chained-dispatch
     slope (prefill_jit donates+returns kv, so dispatches chain on device
-    with no host sync until the final token fetch)."""
+    with no host sync until the final token fetch).
+
+    ``prefill_args_walk`` is the FULL list of per-chunk dispatch args for
+    one prompt (one entry when chunking is off). One slope unit = the
+    whole chunk walk, normalized by prompt_len — timing only the padded
+    final chunk wildly distorts the metric when prompt_len % C is small
+    (ADVICE r5)."""
     import numpy as np
 
     from dynamo_tpu.utils.timing import slope_per_unit
@@ -205,8 +211,9 @@ def device_prefill_timing(core, prompt_len, prefill_args):
         tok = None
         t0 = time.monotonic()
         for _ in range(m):
-            tok, _lp, core.kv = core._prefill_jit(
-                core.params, core.kv, *prefill_args)
+            for args in prefill_args_walk:
+                tok, _lp, core.kv = core._prefill_jit(
+                    core.params, core.kv, *args)
         np.asarray(tok)
         return time.monotonic() - t0
 
@@ -216,6 +223,7 @@ def device_prefill_timing(core, prompt_len, prefill_args):
     return {
         "device_prefill_ms": round(per_prefill_s * 1e3, 2),
         "device_prefill_tok_per_s": round(prompt_len / per_prefill_s, 1),
+        "device_prefill_chunks": len(prefill_args_walk),
     }
 
 
@@ -472,21 +480,21 @@ def main() -> None:
         # continuing at start_pos) — long-context MoE prefill OOMs
         # whole-prompt (see ecfg comment)
         C = ecfg.prefill_chunk or prompt_len
-        last_piece_len = prompt_len
+        prefill_args_walk = []
         for lo in range(0, prompt_len, C):
             piece = prompts[i][lo:lo + C]
-            last_piece_len = len(piece)
             padded = np.zeros((C,), np.int32)
             padded[:len(piece)] = piece
-            last_prefill_args = (
+            args = (
                 jnp.asarray(padded), jnp.asarray(table),
                 jnp.asarray(lo, jnp.int32),
                 jnp.asarray(len(piece), jnp.int32),
                 key, jnp.asarray(0.7, jnp.float32),
                 jnp.asarray(0, jnp.int32),
                 jnp.asarray(1.0, jnp.float32))
+            prefill_args_walk.append(args)
             tok, lp, core.kv = core._prefill_jit(
-                core.params, core.kv, *last_prefill_args)
+                core.params, core.kv, *args)
         core._tokens[i] = int(tok)
         core._positions[i] = prompt_len
         if not warmed:
@@ -577,7 +585,7 @@ def main() -> None:
             core, mcfg, batch, pos0,
             temp=temp, topk=topk, topp=topp, seeds=seeds))
         device_extra.update(device_prefill_timing(
-            core, last_piece_len, last_prefill_args))
+            core, prompt_len, prefill_args_walk))
 
     # device truth is the headline number; the wall loop (host scheduler
     # + tunnel round-trips) rides along in extra. The wall throughput can

@@ -70,6 +70,23 @@ class MetricsAggregatorService:
         self.events_received = 0
         self.pushes = 0
         self.latest: Dict[int, ForwardPassMetrics] = {}
+        # planner observability (components/planner.py): decision counters
+        # + live signals scraped from the planner/status/* keys, exported
+        # per namespace; /planner serves the raw snapshots
+        self.planner_status: Dict[str, dict] = {}
+        self._planner_decisions = Gauge(
+            f"{PREFIX}_planner_decisions", "Planner decision counters "
+            "(scraped from planner status)", ["namespace", "action"],
+            registry=self.registry)
+        self._planner_signal = Gauge(
+            f"{PREFIX}_planner_signal", "Planner fleet signals",
+            ["namespace", "signal"], registry=self.registry)
+        self._planner_workers = Gauge(
+            f"{PREFIX}_planner_workers", "Planner worker counts",
+            ["namespace", "state"], registry=self.registry)
+        self._planner_paused = Gauge(
+            f"{PREFIX}_planner_paused", "1 when the planner is paused",
+            ["namespace"], registry=self.registry)
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> "MetricsAggregatorService":
@@ -110,7 +127,43 @@ class MetricsAggregatorService:
                 self._apply_stats(stats)
             except Exception:  # noqa: BLE001
                 logger.exception("stats scrape failed")
+            try:
+                await self._scrape_planner()
+            except Exception:  # noqa: BLE001
+                logger.exception("planner status scrape failed")
             await asyncio.sleep(self.scrape_interval)
+
+    async def _scrape_planner(self) -> None:
+        from ..llm.slo import PLANNER_PREFIX
+        rt = self.endpoint.runtime
+        prefix = f"{PLANNER_PREFIX}status/"
+        snapshot: Dict[str, dict] = {}
+        for e in await rt.store.kv_get_prefix(prefix):
+            try:
+                snapshot[e.key[len(prefix):]] = json.loads(e.value)
+            except Exception:  # noqa: BLE001
+                continue
+        self.planner_status = snapshot
+        for ns, s in snapshot.items():
+            for action, n in (s.get("counters") or {}).items():
+                self._planner_decisions.labels(ns, action).set(n)
+            sig = s.get("signals") or {}
+            for name in ("queue_depth", "slot_util", "kv_util",
+                         "prefill_queue_depth"):
+                if sig.get(name) is not None:
+                    self._planner_signal.labels(ns, name).set(sig[name])
+            if sig.get("ttft_p90_ms") is not None:
+                self._planner_signal.labels(ns, "ttft_p90_ms").set(
+                    sig["ttft_p90_ms"])
+            self._planner_signal.labels(ns, "disagg_threshold").set(
+                s.get("disagg_threshold", 0))
+            workers = s.get("workers") or {}
+            self._planner_workers.labels(ns, "live").set(
+                len(workers.get("live", [])))
+            self._planner_workers.labels(ns, "draining").set(
+                len(workers.get("draining", [])))
+            self._planner_paused.labels(ns).set(
+                1 if s.get("paused") else 0)
 
     def _apply_stats(self, stats: Dict[int, dict]) -> None:
         present = set(stats)
@@ -184,8 +237,14 @@ class MetricsAggregatorService:
             return web.Response(body=self.render(),
                                 content_type="text/plain")
 
+        async def planner(_request):
+            # introspection: the latest planner/status/* snapshots
+            # (SLOs, last decision, per-actuator counters) as JSON
+            return web.json_response(self.planner_status)
+
         app = web.Application()
         app.router.add_get("/metrics", metrics)
+        app.router.add_get("/planner", planner)
         runner = web.AppRunner(app)
         await runner.setup()
         site = web.TCPSite(runner, host, port)

@@ -31,13 +31,26 @@ __all__ = ["MockTokenWorker"]
 
 class _EchoWithKvEvents(AsyncEngine):
     """Echo engine that mimics a paged engine's prefix-cache events: each
-    prompt's full blocks are published as stored (chained hashes)."""
+    prompt's full blocks are published as stored (chained hashes). Tracks
+    live in-flight streams so the worker's scraped ForwardPassMetrics show
+    real occupancy — the planner's drain-wait and scale signals read it."""
 
     def __init__(self, publisher: KvEventPublisher, block_size: int):
         self.inner = EchoEngineCore()
         self.publisher = publisher
         self.block_size = block_size
         self.requests_served = 0
+        self.active = 0
+        # every (seq_hash, tokens_hash, parent) ever announced, in parent
+        # order — replayed by reannounce() after a transient lease expiry
+        # (KNOWN_ISSUES kv-router staleness fix)
+        self._announced: dict = {}
+
+    def reannounce(self) -> int:
+        """Re-publish every stored block (pool-side re-announce hook)."""
+        for sh, (bid, th, parent) in self._announced.items():
+            self.publisher.publish_stored(bid, sh, th, parent)
+        return len(self._announced)
 
     async def generate(self, request: SingleIn) -> ManyOut:
         pre: PreprocessedRequest = request.data
@@ -47,8 +60,20 @@ class _EchoWithKvEvents(AsyncEngine):
         for i, (sh, bh) in enumerate(zip(seq.sequence_hashes,
                                          seq.block_hashes)):
             self.publisher.publish_stored(i, sh, bh, parent)
+            self._announced[sh] = (i, bh, parent)
             parent = seq.sequence_hashes[i]
-        return await self.inner.generate(request)
+        stream = await self.inner.generate(request)
+        self.active += 1
+
+        async def tracked():
+            try:
+                async for item in stream:
+                    yield item
+            finally:
+                self.active -= 1
+
+        from ..runtime.engine import ResponseStream
+        return ResponseStream(tracked(), request.ctx)
 
 
 class MockTokenWorker:
@@ -81,14 +106,49 @@ class MockTokenWorker:
 
         publisher = KvEventPublisher(worker_id=lease.id, sink=sink)
         self.engine = _EchoWithKvEvents(publisher, self.block_size)
+        # transient lease reclaim (daemon blip) → replay the radix index
+        # for this worker (KNOWN_ISSUES kv-router staleness fix)
+        prev = getattr(self.runtime.store, "on_lease_reclaimed", None)
+
+        def reclaimed(lease_id: int) -> None:
+            if prev is not None:
+                prev(lease_id)
+            if lease_id == lease.id and self.engine is not None:
+                n = self.engine.reannounce()
+                logger.info("mock worker %x re-announced %d blocks after "
+                            "lease reclaim", lease_id, n)
+
+        if hasattr(self.runtime.store, "on_lease_reclaimed"):
+            self.runtime.store.on_lease_reclaimed = reclaimed
         self.server = await self.endpoint.serve(
             self.engine,
             decode_req=lambda raw: PreprocessedRequest.from_dict(
                 json.loads(raw)),
             encode_resp=encode_annotated_json,
-            stats_handler=lambda: self.metrics.to_dict(),
+            stats_handler=self._stats,
             stats_interval=0.2)
         return self
+
+    def _stats(self) -> dict:
+        """Base synthetic metrics overlaid with LIVE occupancy, so the
+        planner's signals (queue depth, slot pressure, drain-idle) are
+        real even against the echo engine."""
+        d = self.metrics.to_dict()
+        # server._inflight outlives engine.active by the response tail
+        # (sentinel + finish), so a drain-wait on these stats can't retire
+        # the worker with a stream mid-delivery
+        live = max(self.engine.active,
+                   len(self.server._inflight) if self.server else 0)
+        d["request_active_slots"] = (self.metrics.request_active_slots
+                                     + live)
+        return d
+
+    @property
+    def draining(self) -> bool:
+        return self.server is not None and self.server.draining
+
+    async def drain(self) -> None:
+        await self.server.set_draining(True)
 
     async def stop(self) -> None:
         if self.server is not None:

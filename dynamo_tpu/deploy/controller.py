@@ -171,6 +171,29 @@ class DeploymentController:
                 except Exception:  # noqa: BLE001 — keep the loop alive
                     logger.exception("reconcile failed for %s", name)
 
+    @staticmethod
+    def _replicas_only_change(old: DeploymentSpec,
+                              new: DeploymentSpec) -> bool:
+        """True when the update differs only in replica count (and
+        bookkeeping) — everything a running replica was launched WITH is
+        unchanged, so existing processes stay valid."""
+        return (old.graph == new.graph and old.config == new.config
+                and old.env == new.env
+                and old.max_restarts == new.max_restarts)
+
+    async def scale(self, name: str, replicas: int) -> Optional[object]:
+        """Programmatic scale API (the planner's ControllerActuator): CAS
+        the stored spec; the watch→reconcile path converges in place."""
+        from .spec import update_spec, validate_spec
+        err = validate_spec(name, replicas)
+        if err:
+            raise ValueError(err)
+
+        def mutate(spec):
+            spec.replicas = replicas
+
+        return await update_spec(self.runtime.store, name, mutate)
+
     async def _reconcile_one(self, name: str, m: _Managed) -> None:
         if m.deleted:
             for r in m.replicas:
@@ -181,12 +204,22 @@ class DeploymentController:
                 name=name, state="terminated"))
             return
         if m.pending_spec is not None:
-            # generation bounce: stop the old generation, adopt the spec
-            for r in m.replicas:
-                await self.launcher.stop(r.proc)
-            m.replicas.clear()
-            m.spec, m.pending_spec = m.pending_spec, None
-            m.failed = False
+            new, m.pending_spec = m.pending_spec, None
+            if self._replicas_only_change(m.spec, new):
+                # planner scale path: replica-count-only updates adopt the
+                # spec IN PLACE — running replicas keep serving; the
+                # scale-up/down below converges the count. Bouncing the
+                # whole fleet for a count change would drop every
+                # in-flight request the drain protocol just protected.
+                m.spec = new
+                m.failed = False
+            else:
+                # generation bounce: stop the old generation, adopt
+                for r in m.replicas:
+                    await self.launcher.stop(r.proc)
+                m.replicas.clear()
+                m.spec = new
+                m.failed = False
         spec = m.spec
         want = max(spec.replicas, 0)
 

@@ -25,6 +25,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax<0.5 names it TPUCompilerParams
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams", None))
+
 NEG_INF = -1e30
 
 
@@ -384,7 +388,7 @@ def flash_prefill(q: jax.Array, k: jax.Array, v: jax.Array, *,
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((KVH, Tp * g, Dh), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(meta, qr, kr, vr)
@@ -433,7 +437,7 @@ def flash_prefill_partial(q: jax.Array, k: jax.Array, v: jax.Array, *,
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((KVH, Tp * g, Dh), jnp.float32),
                    jax.ShapeDtypeStruct((KVH, Tp * g, 2), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(meta, qr, kr, vr)

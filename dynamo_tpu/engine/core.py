@@ -276,6 +276,9 @@ class EngineCore:
                 on_store=self._emit_kv_store)
         self.M = engine_cfg.max_blocks_per_seq
         self.B = engine_cfg.max_num_seqs
+        # jitted cross-quant repack converters, keyed by the payload's
+        # (lane width, dtype); shapes re-specialize inside each jit cache
+        self._repack_jits: dict = {}
 
         self.slots: List[Optional[EngineRequest]] = [None] * self.B
         # optional engine.replay.Recorder capturing the schedule decision
@@ -553,8 +556,16 @@ class EngineCore:
                             x, kv_row_groups(want_w, C)))
             return rows.reshape(lead + (rows.shape[-1],))
 
+        # jit per payload layout (ADVICE r5): the eager version walked
+        # every row un-fused on the event loop; the jitted dispatch
+        # returns immediately and the caller awaits readiness off-loop
+        key = (have_w, str(have_dt))
+        fn = self._repack_jits.get(key)
+        if fn is None:
+            fn = jax.jit(convert)
+            self._repack_jits[key] = fn
         import dataclasses as _dc
-        new_stacked = {k: convert(v) for k, v in pc.stacked.items()}
+        new_stacked = {k: fn(v) for k, v in pc.stacked.items()}
         logger.info("disagg KV payload repacked %s/%d -> %s/%d lanes "
                     "for %s", have_dt, have_w, want_dt,
                     new_stacked[next(iter(new_stacked))].shape[-1],
@@ -570,7 +581,15 @@ class EngineCore:
             from ..llm.kv_transport import DeviceKvPayload
             pc = req.precomputed
             if isinstance(pc, DeviceKvPayload):
-                req.precomputed = pc = self._maybe_repack_kv_payload(pc)
+                repacked = self._maybe_repack_kv_payload(pc)
+                if repacked is not pc:
+                    # await device completion in an executor so a long
+                    # cross-quant repack never stalls the event loop (and
+                    # with it the in-flight decode schedule) — ADVICE r5
+                    await asyncio.to_thread(
+                        jax.block_until_ready,
+                        list(repacked.stacked.values()))
+                req.precomputed = pc = repacked
                 sample = next(iter(pc.stacked.values()))
                 self._check_kv_payload_layout(sample.shape[-1],
                                               sample.dtype, "device")
@@ -583,6 +602,17 @@ class EngineCore:
         self._inflight_reqs[id(req)] = req
         await self.waiting.put(req)
         self._work_event.set()
+
+    def reannounce_kv(self) -> int:
+        """Replay every stored-block announcement into the KV event
+        publisher — the lease-reclaim recovery hook (KNOWN_ISSUES
+        kv-router staleness): after a transient lease expiry the router
+        wiped this worker's radix index; the reclaim replays discovery
+        keys but not content events, so the pool re-announces them."""
+        if self.kv_event_publisher is None:
+            return 0
+        return self.kv_manager.pool.reannounce(
+            self.kv_event_publisher.publish_stored)
 
     def metrics(self) -> ForwardPassMetrics:
         active = sum(1 for s in self.slots if s is not None)
@@ -633,6 +663,21 @@ class EngineCore:
             req.out_queue.put_nowait((FINISH_SENTINEL,
                                       FinishReason.ERROR))
         self._inflight_reqs.clear()
+        # free every admitted request's KV allocation (ADVICE r5): the
+        # core itself is unrecoverable (_dead gates ensure_started), but
+        # the pool object may outlive it — a recovery path that rebuilds
+        # the loop around the same kv_manager must not inherit leaked
+        # refcounts. Slot release, not _release_slot: no offload
+        # write-back or sampler-state care is owed to a dead loop.
+        for req in self.slots:
+            if req is not None and req.blocks:
+                self.kv_manager.pool.release(req.blocks)
+                req.blocks = []
+        for req, _slot, plan, _prepped in self._onboards:
+            self.kv_manager.pool.release(plan.all_blocks)
+            if self.kv_manager.host_pool is not None:
+                self.kv_manager.host_pool.unpin(plan.host_slots)
+        self._onboards = []
         # clear scheduler state so nothing can be re-served even if a
         # caller pokes internals
         self.slots = [None] * len(self.slots)

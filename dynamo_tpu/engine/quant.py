@@ -163,10 +163,14 @@ def unpack_int4_rows(packed: jax.Array) -> jax.Array:
     int4 [..., D, F]. A bitcast (free view of the packed bytes) plus one
     un-interleave — call OUTSIDE per-step loops so a K-step dispatch
     pays it once (module docstring)."""
-    pairs = jax.lax.bitcast_convert_type(packed, jnp.int4)  # [.., D/2, F, 2]
-    un = jnp.moveaxis(pairs, -1, -2)                        # [.., D/2, 2, F]
+    # arithmetic nibble split instead of bitcast_convert_type(int8→int4):
+    # the bitcast lowering is broken on jax 0.4.x CPU (rank verifier
+    # rejects it); int8 shifts sign-extend, so lo/hi land already signed
+    lo = jnp.right_shift(jnp.left_shift(packed, 4), 4)      # low nibble
+    hi = jnp.right_shift(packed, 4)                         # high nibble
+    un = jnp.stack([lo, hi], axis=-2)                       # [.., D/2, 2, F]
     s = packed.shape
-    return un.reshape(s[:-2] + (s[-2] * 2, s[-1]))
+    return un.reshape(s[:-2] + (s[-2] * 2, s[-1])).astype(jnp.int4)
 
 
 def _kernel_serves(w: "QuantizedArray") -> bool:

@@ -33,6 +33,29 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("model")
     st.add_argument("value", type=int)
 
+    pl = sub.add_parser("planner", help="dynamic planner admin "
+                                        "(components/planner.py)")
+    plsub = pl.add_subparsers(dest="planner_cmd", required=True)
+    pst = plsub.add_parser("status", help="show planner state/decisions")
+    pst.add_argument("namespace", nargs="?",
+                     help="limit to one namespace (default: all)")
+    pss = plsub.add_parser("set-slo", help="declare/update SLOs (merged "
+                                           "into the stored record)")
+    pss.add_argument("namespace")
+    pss.add_argument("--ttft-p90-ms", type=float)
+    pss.add_argument("--itl-p90-ms", type=float)
+    pss.add_argument("--max-queue-depth", type=float)
+    pss.add_argument("--slot-util-high", type=float)
+    pss.add_argument("--slot-util-low", type=float)
+    pss.add_argument("--kv-util-high", type=float)
+    pss.add_argument("--min-decode-workers", type=int)
+    pss.add_argument("--max-decode-workers", type=int)
+    pss.add_argument("--max-local-prefill-length", type=int)
+    pp = plsub.add_parser("pause", help="stop actuating (keep observing)")
+    pp.add_argument("namespace")
+    pr = plsub.add_parser("resume")
+    pr.add_argument("namespace")
+
     dep = sub.add_parser("deployment",
                          help="manage graph deployments (deploy/ control "
                               "plane — the api-server CRUD over the store)")
@@ -87,11 +110,67 @@ async def amain(argv=None) -> int:
                 disagg_config_key(args.model),
                 json.dumps({"max_local_prefill_length": args.value}).encode())
             print(f"disagg threshold for {args.model} → {args.value}")
+        elif args.cmd == "planner":
+            return await _planner_cmd(runtime, args)
         elif args.cmd == "deployment":
             return await _deployment_cmd(runtime, args)
         return 0
     finally:
         await runtime.shutdown()
+
+
+async def _planner_cmd(runtime, args) -> int:
+    """Planner admin over the planner/* KV keys (llm/slo.py layout): the
+    planner watches slo/control live; status is its published snapshot."""
+    import dataclasses
+    import json
+
+    from ..llm.slo import (PLANNER_PREFIX, ServiceLevelObjective,
+                           control_key, slo_key)
+
+    if args.planner_cmd == "status":
+        prefix = (f"{PLANNER_PREFIX}status/{args.namespace}"
+                  if args.namespace else f"{PLANNER_PREFIX}status/")
+        entries = await runtime.store.kv_get_prefix(prefix)
+        if not entries:
+            print("(no planner status published)")
+            return 1
+        for e in entries:
+            s = json.loads(e.value)
+            ns = e.key.rsplit("/", 1)[-1]
+            print(f"namespace {ns}  endpoint={s.get('endpoint')}  "
+                  f"paused={s.get('paused')}")
+            sig = s.get("signals") or {}
+            workers = s.get("workers") or {}
+            print(f"  workers: {len(workers.get('live', []))} live, "
+                  f"draining={workers.get('draining', [])}")
+            print(f"  signals: queue={sig.get('queue_depth', 0):.2f} "
+                  f"slot_util={sig.get('slot_util', 0):.2f} "
+                  f"kv_util={sig.get('kv_util', 0):.2f} "
+                  f"ttft_p90={sig.get('ttft_p90_ms')}ms")
+            print(f"  disagg_threshold: {s.get('disagg_threshold')}")
+            print(f"  last decision: {s.get('last_decision')}")
+            print(f"  counters: {s.get('counters')}")
+            print(f"  slo: {s.get('slo')}")
+        return 0
+    if args.planner_cmd == "set-slo":
+        entry = await runtime.store.kv_get(slo_key(args.namespace))
+        slo = (ServiceLevelObjective.from_json(entry.value)
+               if entry is not None else ServiceLevelObjective())
+        for field in dataclasses.fields(ServiceLevelObjective):
+            v = getattr(args, field.name, None)
+            if v is not None:
+                setattr(slo, field.name, v)
+        await runtime.store.kv_put(slo_key(args.namespace), slo.to_json())
+        print(f"slo for {args.namespace}: {dataclasses.asdict(slo)}")
+        return 0
+    # pause / resume
+    paused = args.planner_cmd == "pause"
+    await runtime.store.kv_put(
+        control_key(args.namespace),
+        json.dumps({"paused": paused}).encode())
+    print(f"planner {args.planner_cmd}d for {args.namespace}")
+    return 0
 
 
 async def _deployment_cmd(runtime, args) -> int:

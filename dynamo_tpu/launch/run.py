@@ -435,6 +435,22 @@ async def _wire_kv_events(core, runtime, endpoint) -> None:
     core.kv_manager.pool.on_stored = pub.publish_stored
     core.kv_manager.pool.on_removed = pub.publish_removed
 
+    # transient lease expiry → reclaim replays discovery keys but the
+    # router's radix index of OUR blocks was wiped by the DELETE events;
+    # re-announce the pool so KV-aware routing recovers instead of
+    # silently degrading to load-balancing (KNOWN_ISSUES, fixed this PR)
+    prev = getattr(runtime.store, "on_lease_reclaimed", None)
+
+    def reclaimed(lease_id: int) -> None:
+        if prev is not None:
+            prev(lease_id)
+        if lease_id == lease.id:
+            n = core.reannounce_kv()
+            logger.info("re-announced %d stored KV blocks after lease "
+                        "reclaim", n)
+
+    runtime.store.on_lease_reclaimed = reclaimed
+
 
 async def run_prefill_worker(args, core, runtime) -> None:
     from ..llm.disagg import PrefillWorker

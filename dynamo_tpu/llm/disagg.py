@@ -64,12 +64,18 @@ class DisaggregatedRouter:
         self.model_name = model_name
         self.max_local_prefill_length = max_local_prefill_length
         self.conditional = conditional
+        # planner drain flag (docs/planner.md): while the prefill fleet is
+        # being decommissioned, every prefill runs local — no new remote
+        # admissions regardless of length
+        self.prefill_draining = False
         self._watch_task: Optional[asyncio.Task] = None
         self._watcher = None
 
     def prefill_remote(self, prefill_len: int, prefix_hit_len: int) -> bool:
         """disagg_router.rs:239-249: remote iff the *un-cached* prefill work
         exceeds the local threshold."""
+        if self.prefill_draining:
+            return False
         if not self.conditional:
             return True
         return (prefill_len - prefix_hit_len) > self.max_local_prefill_length
@@ -90,8 +96,11 @@ class DisaggregatedRouter:
             cfg = json.loads(raw)
             self.max_local_prefill_length = int(
                 cfg["max_local_prefill_length"])
-            logger.info("disagg threshold for %s → %d", self.model_name,
-                        self.max_local_prefill_length)
+            self.prefill_draining = bool(cfg.get("draining", False))
+            logger.info("disagg threshold for %s → %d%s", self.model_name,
+                        self.max_local_prefill_length,
+                        " (prefill fleet draining)" if self.prefill_draining
+                        else "")
         except (ValueError, KeyError, TypeError):
             logger.warning("bad disagg config update ignored: %r", raw)
 
@@ -100,11 +109,13 @@ class DisaggregatedRouter:
             if ev.type == WatchEventType.PUT:
                 self._apply(ev.entry.value)
 
-    async def publish_threshold(self, value: int) -> None:
+    async def publish_threshold(self, value: int,
+                                draining: bool = False) -> None:
         """Admin write (the llmctl-style live reconfig path)."""
         await self.runtime.store.kv_put(
             disagg_config_key(self.model_name),
-            json.dumps({"max_local_prefill_length": value}).encode())
+            json.dumps({"max_local_prefill_length": value,
+                        "draining": draining}).encode())
 
     async def stop(self) -> None:
         if self._watch_task is not None:
@@ -437,6 +448,18 @@ class PrefillWorker:
                 "prefills_failed": self.prefills_failed,
                 "device_handoffs": self.device_handoffs,
                 "inflight": len(self._inflight)}
+
+    async def drain(self) -> None:
+        """Planner drain: stop pulling NEW queue items, let every in-flight
+        prefill finish its handoff (zero dropped work; the queue's other
+        consumers — or the decode side's local fallback — absorb the rest)."""
+        self._stopping = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self._inflight:
+            await asyncio.gather(*list(self._inflight),
+                                 return_exceptions=True)
 
     async def stop(self) -> None:
         self._stopping = True

@@ -115,7 +115,10 @@ class KvRoutedEngine(AsyncEngine):
     # ------------------------------------------------------------- dispatch
     async def generate(self, request: SingleIn) -> ManyOut:
         tokens = list(request.data.token_ids)
-        pick = self.router.schedule(tokens)
+        # draining instances take no new admissions (docs/planner.md);
+        # client.random below applies the same exclusion on fallback
+        draining = set(self.client.draining_ids())
+        pick = self.router.schedule(tokens, exclude=draining or None)
         if pick is None:
             self.fallback_routed += 1
             return await self.client.random(request)

@@ -79,6 +79,11 @@ class NativeKvBlockPool:
         self._bid_buf = (_I64 * num_blocks)()
         self._hash_buf = (_U64 * num_blocks)()
         self._n_removed = _I64(0)
+        # Python-side shadow of registrations (seq_hash → (bid, tokens_hash,
+        # parent_hash)) so reannounce() works without a C enumerate ABI;
+        # register/alloc_uninit/reset already round-trip through Python, so
+        # the shadow stays exact at zero native-call cost
+        self._registered: dict = {}
 
     def __del__(self):
         h, self._h = getattr(self, "_h", None), None
@@ -137,6 +142,8 @@ class NativeKvBlockPool:
         if rc != 0:
             return None
         removed = list(self._hash_buf[:self._n_removed.value])
+        for h in removed:
+            self._registered.pop(h, None)
         if removed and self.on_removed is not None:
             self.on_removed(removed)
         return list(self._bid_buf[:n])
@@ -149,8 +156,12 @@ class NativeKvBlockPool:
             tokens_hash & 0xFFFFFFFFFFFFFFFF,
             (parent_hash or 0) & 0xFFFFFFFFFFFFFFFF,
             0 if parent_hash is None else 1, priority)
-        if stored and self.on_stored is not None:
-            self.on_stored(bid, seq_hash, tokens_hash, parent_hash)
+        if stored:
+            # shadow keyed by the masked u64 the C side reports removals in
+            self._registered[seq_hash & 0xFFFFFFFFFFFFFFFF] = (
+                bid, seq_hash, tokens_hash, parent_hash)
+            if self.on_stored is not None:
+                self.on_stored(bid, seq_hash, tokens_hash, parent_hash)
 
     def hold(self, blocks: Sequence[int]) -> None:
         if blocks:
@@ -162,5 +173,42 @@ class NativeKvBlockPool:
 
     def reset(self) -> None:
         n = self._lib.kvpool_reset(self._h, self._hash_buf)
+        removed = list(self._hash_buf[:n])
+        for h in removed:
+            self._registered.pop(h, None)
         if n and self.on_removed is not None:
-            self.on_removed(list(self._hash_buf[:n]))
+            self.on_removed(removed)
+
+    # --------------------------------------------------------- reannounce
+    def registered_entries(self):
+        """(bid, seq_hash, tokens_hash, parent_hash) per registered block
+        (from the Python shadow — same shape as KvBlockPool's)."""
+        return [v for v in self._registered.values()]
+
+    def reannounce(self, announce: Optional[Callable] = None) -> int:
+        """Parent-ordered replay of every stored-block announcement — the
+        lease-reclaim recovery hook (see KvBlockPool.reannounce)."""
+        announce = announce or self.on_stored
+        if announce is None:
+            return 0
+        pending = self.registered_entries()
+        emitted: set = set()
+        n = 0
+        while pending:
+            progress = False
+            deferred = []
+            for bid, seq_hash, tokens_hash, parent in pending:
+                if parent is None or parent in emitted:
+                    announce(bid, seq_hash, tokens_hash, parent)
+                    emitted.add(seq_hash)
+                    n += 1
+                    progress = True
+                else:
+                    deferred.append((bid, seq_hash, tokens_hash, parent))
+            if not progress:
+                for bid, seq_hash, tokens_hash, parent in deferred:
+                    announce(bid, seq_hash, tokens_hash, parent)
+                    n += 1
+                break
+            pending = deferred
+        return n

@@ -200,6 +200,50 @@ class KvBlockPool:
             self._invalidate(bid)
             self._free_uninit.append(bid)
 
+    # --------------------------------------------------------- reannounce
+    def registered_entries(self) -> List[Tuple[int, int, int, Optional[int]]]:
+        """Every registered block as (bid, seq_hash, tokens_hash,
+        parent_hash) — the pool-side inventory behind ``reannounce``."""
+        out = []
+        for seq_hash, bid in self._by_hash.items():
+            m = self._meta[bid]
+            out.append((bid, seq_hash, m.tokens_hash, m.parent_hash))
+        return out
+
+    def reannounce(self, announce: Optional[Callable] = None) -> int:
+        """Re-publish every registered block through ``announce`` (default:
+        the ``on_stored`` sink), parents before children so a radix indexer
+        re-chains without re-rooting. The recovery hook for a transient
+        lease expiry: the router wiped this worker's index on the DELETE
+        watch events, the lease reclaim replayed only discovery KEYS —
+        this replays the KV content announcements (KNOWN_ISSUES)."""
+        announce = announce or self.on_stored
+        if announce is None:
+            return 0
+        pending = self.registered_entries()
+        emitted: set = set()
+        n = 0
+        while pending:
+            progress = False
+            deferred = []
+            for bid, seq_hash, tokens_hash, parent in pending:
+                if parent is None or parent in emitted:
+                    announce(bid, seq_hash, tokens_hash, parent)
+                    emitted.add(seq_hash)
+                    n += 1
+                    progress = True
+                else:
+                    deferred.append((bid, seq_hash, tokens_hash, parent))
+            if not progress:
+                # orphans (parent evicted): emit anyway — the indexer
+                # re-roots unknown parents at the top
+                for bid, seq_hash, tokens_hash, parent in deferred:
+                    announce(bid, seq_hash, tokens_hash, parent)
+                    n += 1
+                break
+            pending = deferred
+        return n
+
 
 def make_kv_block_pool(num_blocks: int, on_stored=None, on_removed=None,
                        prefer_native: bool = True):

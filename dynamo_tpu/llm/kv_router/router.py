@@ -48,11 +48,16 @@ class KvRouter:
         self.indexer.remove_worker(worker_id)
 
     # -- decision
-    def schedule(self, token_ids: Sequence[int]) -> Optional[tuple]:
-        """Returns (worker_id, overlap_blocks) or None if no workers."""
+    def schedule(self, token_ids: Sequence[int],
+                 exclude: Optional[set] = None) -> Optional[tuple]:
+        """Returns (worker_id, overlap_blocks) or None if no workers.
+        ``exclude`` bars draining workers from new admissions — their
+        indexed blocks stay in the radix tree (they come back if the
+        drain is cancelled), the scheduler just won't pick them."""
         overlap = self.indexer.find_matches_for_request(token_ids)
         self.last_frequencies = overlap.frequencies
-        worker = self.scheduler.schedule(len(token_ids), overlap.scores)
+        worker = self.scheduler.schedule(len(token_ids), overlap.scores,
+                                         exclude=exclude)
         if worker is None:
             return None
         return worker, overlap.scores.get(worker, 0)

@@ -45,8 +45,12 @@ class KvScheduler:
         self._opt_blocks.clear()
         self._opt_slots.clear()
 
-    def schedule(self, isl_tokens: int, overlap_scores: dict) -> Optional[int]:
-        """Returns the chosen worker id, or None when no worker is usable."""
+    def schedule(self, isl_tokens: int, overlap_scores: dict,
+                 exclude: Optional[set] = None) -> Optional[int]:
+        """Returns the chosen worker id, or None when no worker is usable.
+        ``exclude``: worker ids barred from NEW admissions (the planner's
+        draining set) — skipped like full workers, so a drain shifts load
+        instead of dropping requests."""
         eps = self.endpoints
         if not len(eps):
             return None
@@ -62,6 +66,8 @@ class KvScheduler:
         candidates = list(eps.endpoints.values())
         self._rng.shuffle(candidates)  # tie-break fairness
         for ep in candidates:
+            if exclude and ep.worker_id in exclude:
+                continue
             m = ep.metrics
             slots_used = (m.request_active_slots
                           + self._opt_slots.get(ep.worker_id, 0))

@@ -1,0 +1,219 @@
+"""Service-level objectives for the dynamic planner.
+
+Reference: the Planner pillar (README "dynamic GPU scheduling") that the
+reference ships as ``deploy/sdk/.../planner`` — declared latency/load
+targets drive replica counts of the disaggregated prefill/decode fleet.
+FlowKV/NetKV (PAPERS.md) motivate the signal set: decode-side queue depth
+and KV-pool pressure are the leading indicators; TTFT/ITL percentiles are
+the lagging, user-visible truth.
+
+This module is the PURE half of the planner: the SLO schema, the KV-store
+key layout (SLO / control / status / scale intents), the fleet-signal
+snapshot, and the ``evaluate`` function mapping (signals, slo) → verdict.
+The standing control loop with hysteresis/cooldown and the actuators live
+in :mod:`dynamo_tpu.components.planner`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Dict, List, Optional
+
+__all__ = [
+    "ServiceLevelObjective", "FleetSignals", "SloVerdict", "evaluate",
+    "percentile", "latency_percentiles_from_traces",
+    "slo_key", "control_key", "status_key", "scale_key", "PLANNER_PREFIX",
+]
+
+PLANNER_PREFIX = "planner/"
+
+
+def slo_key(namespace: str) -> str:
+    """Declared SLOs; watched live by the planner (llmctl set-slo)."""
+    return f"{PLANNER_PREFIX}slo/{namespace}"
+
+
+def control_key(namespace: str) -> str:
+    """Admin control record ({"paused": bool}; llmctl planner pause)."""
+    return f"{PLANNER_PREFIX}control/{namespace}"
+
+
+def status_key(namespace: str) -> str:
+    """The planner's periodically-published status snapshot (llmctl
+    planner status and the metrics service's /planner endpoint read it)."""
+    return f"{PLANNER_PREFIX}status/{namespace}"
+
+
+def scale_key(service: str) -> str:
+    """Desired-replica intents the sdk/serve.py supervisor watches."""
+    return f"{PLANNER_PREFIX}scale/{service}"
+
+
+@dataclasses.dataclass
+class ServiceLevelObjective:
+    """Declared targets + scaling bounds. All latencies are milliseconds.
+
+    The utilization watermarks are deliberately far apart (0.85 up /
+    0.25 down): together with the planner's breach-cycle hysteresis and
+    post-action cooldown they keep the loop from flapping under
+    oscillating load."""
+
+    ttft_p90_ms: float = 2000.0
+    itl_p90_ms: float = 200.0
+    # mean waiting requests per NON-draining decode worker
+    max_queue_depth: float = 4.0
+    slot_util_high: float = 0.85
+    slot_util_low: float = 0.25
+    kv_util_high: float = 0.90
+    min_decode_workers: int = 1
+    max_decode_workers: int = 8
+    min_prefill_workers: int = 0
+    max_prefill_workers: int = 4
+    # baseline disagg threshold the retune actuator works around
+    max_local_prefill_length: int = 512
+
+    def to_json(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "ServiceLevelObjective":
+        d = json.loads(raw)
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+@dataclasses.dataclass
+class FleetSignals:
+    """One evaluation's view of the live fleet (draining workers are
+    excluded from capacity math — they take no new admissions, so counting
+    them would mask pressure during a drain)."""
+
+    n_decode: int = 0                 # non-draining decode workers
+    n_draining: int = 0
+    queue_depth: float = 0.0          # mean num_requests_waiting per worker
+    slot_util: float = 0.0            # mean active/total slots
+    kv_util: float = 0.0              # mean gpu_cache_usage_perc
+    ttft_p90_ms: Optional[float] = None
+    itl_p90_ms: Optional[float] = None
+    prefill_queue_depth: int = 0
+
+    @classmethod
+    def from_worker_metrics(cls, metrics: Dict[int, dict],
+                            draining: Optional[set] = None,
+                            ttft_p90_ms: Optional[float] = None,
+                            itl_p90_ms: Optional[float] = None,
+                            prefill_queue_depth: int = 0) -> "FleetSignals":
+        """Aggregate scraped ForwardPassMetrics dicts (worker_id → dict)."""
+        draining = draining or set()
+        live = {w: m for w, m in metrics.items() if w not in draining}
+        n = len(live)
+        if n == 0:
+            return cls(n_decode=0, n_draining=len(draining),
+                       ttft_p90_ms=ttft_p90_ms, itl_p90_ms=itl_p90_ms,
+                       prefill_queue_depth=prefill_queue_depth)
+        q = su = kv = 0.0
+        for m in live.values():
+            q += float(m.get("num_requests_waiting", 0))
+            total = float(m.get("request_total_slots", 0)) or 1.0
+            su += float(m.get("request_active_slots", 0)) / total
+            kv += float(m.get("gpu_cache_usage_perc", 0.0))
+        return cls(n_decode=n, n_draining=len(draining),
+                   queue_depth=q / n, slot_util=su / n, kv_util=kv / n,
+                   ttft_p90_ms=ttft_p90_ms, itl_p90_ms=itl_p90_ms,
+                   prefill_queue_depth=prefill_queue_depth)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class SloVerdict:
+    """Outcome of one evaluation. ``action`` is the RAW per-cycle verdict;
+    the planner applies hysteresis (consecutive breach cycles) and
+    cooldown before actuating."""
+
+    action: str                        # "scale_up" | "scale_down" | "hold"
+    breaches: List[str] = dataclasses.field(default_factory=list)
+    reason: str = ""
+
+
+def evaluate(signals: FleetSignals,
+             slo: ServiceLevelObjective) -> SloVerdict:
+    """Pure decision function: compare one signal snapshot against the SLO.
+
+    Scale-up triggers on ANY pressure breach (queue, slots, KV pool, TTFT,
+    ITL) while below max replicas. Scale-down requires EVERY pressure
+    signal comfortably idle and replicas above min. Anything else holds."""
+    b: List[str] = []
+    if signals.n_decode == 0:
+        return SloVerdict("scale_up", ["no_workers"],
+                          "no live decode workers")
+    if signals.queue_depth > slo.max_queue_depth:
+        b.append(f"queue_depth {signals.queue_depth:.1f} > "
+                 f"{slo.max_queue_depth:g}")
+    if signals.slot_util > slo.slot_util_high:
+        b.append(f"slot_util {signals.slot_util:.2f} > "
+                 f"{slo.slot_util_high:g}")
+    if signals.kv_util > slo.kv_util_high:
+        b.append(f"kv_util {signals.kv_util:.2f} > {slo.kv_util_high:g}")
+    if signals.ttft_p90_ms is not None \
+            and signals.ttft_p90_ms > slo.ttft_p90_ms:
+        b.append(f"ttft_p90 {signals.ttft_p90_ms:.0f}ms > "
+                 f"{slo.ttft_p90_ms:g}ms")
+    if signals.itl_p90_ms is not None \
+            and signals.itl_p90_ms > slo.itl_p90_ms:
+        b.append(f"itl_p90 {signals.itl_p90_ms:.0f}ms > "
+                 f"{slo.itl_p90_ms:g}ms")
+    if b:
+        if signals.n_decode >= slo.max_decode_workers:
+            return SloVerdict("hold", b,
+                              "pressure but already at max_decode_workers")
+        return SloVerdict("scale_up", b, "; ".join(b))
+    idle = (signals.queue_depth == 0
+            and signals.slot_util < slo.slot_util_low
+            and (signals.ttft_p90_ms is None
+                 or signals.ttft_p90_ms < 0.5 * slo.ttft_p90_ms))
+    if idle and signals.n_decode > slo.min_decode_workers:
+        return SloVerdict(
+            "scale_down", [],
+            f"idle: slot_util {signals.slot_util:.2f} < "
+            f"{slo.slot_util_low:g}, empty queue")
+    return SloVerdict("hold", [], "within SLO")
+
+
+# --------------------------------------------------------------- latencies
+def percentile(values: List[float], p: float) -> Optional[float]:
+    """Nearest-rank percentile (p in [0, 100]); None on empty input."""
+    if not values:
+        return None
+    xs = sorted(values)
+    rank = max(int(math.ceil(p / 100.0 * len(xs))) - 1, 0)
+    return xs[min(rank, len(xs) - 1)]
+
+
+def latency_percentiles_from_traces(traces: List[dict], p: float = 90.0
+                                    ) -> Dict[str, Optional[float]]:
+    """TTFT/ITL percentiles (ms) out of tracer ring-buffer dicts
+    (runtime/tracing.py). TTFT is the ``first_response`` event offset on
+    worker-role traces; ITL is the remaining stream time spread over the
+    ``respond`` span after first response (an upper bound when the token
+    count is unknown — traces don't carry it, so we approximate with the
+    respond span's shape: (respond_end - first_response))."""
+    ttfts: List[float] = []
+    itls: List[float] = []
+    for t in traces:
+        spans = {s["name"]: s for s in t.get("spans", ())}
+        first = spans.get("first_response")
+        if first is None:
+            continue
+        ttfts.append(first["at_ms"])
+        respond = spans.get("respond")
+        if respond is not None:
+            tail = respond["at_ms"] + respond["ms"] - first["at_ms"]
+            if tail >= 0:
+                itls.append(tail)
+    return {"ttft_p_ms": percentile(ttfts, p),
+            "itl_p_ms": percentile(itls, p),
+            "n_traces": float(len(ttfts))}

@@ -74,15 +74,19 @@ def tp_decode_sensitivity(batch: int, hidden: int, num_layers: int,
     """
     base_step_s = batch / device_tok_per_s if device_tok_per_s > 0 else 0.0
     band = {}
-    nominal = 0.0
     for bw in SENSITIVITY_BW_GBPS:
         for lat in SENSITIVITY_LATENCY_S:
             ici = tp_decode_step_s(batch, hidden, num_layers, n_chips,
                                    act_itemsize, eff_bw=bw, latency_s=lat)
             net = batch / (base_step_s + ici) if base_step_s > 0 else 0.0
             band[f"{int(bw / 1e9)}GBps/{int(lat * 1e6)}us"] = round(net, 1)
-            if bw == V5E_ICI_EFFECTIVE_GBPS and lat == COLLECTIVE_LATENCY_S:
-                nominal = net   # unrounded: bench.py's headline source
+    # nominal computed DIRECTLY at the default constants (ADVICE r5): the
+    # sweep grid need not contain the nominal point — tuning either
+    # constant off-grid must not silently zero bench.py's headline
+    nominal_ici = tp_decode_step_s(batch, hidden, num_layers, n_chips,
+                                   act_itemsize)
+    nominal = (batch / (base_step_s + nominal_ici)
+               if base_step_s > 0 else 0.0)
     return {"band": band,
             "nominal": nominal,
             "worst": min(band.values()) if band else 0.0,

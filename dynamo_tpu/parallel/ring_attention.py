@@ -63,7 +63,7 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     Tl, H, Dh = q.shape
     Sl, KVH, _ = k.shape
     g = H // KVH
-    n = jax.lax.axis_size(axis_name)
+    n = jax.lax.psum(1, axis_name)   # axis_size is jax>=0.5; psum(1) is portable
     me = jax.lax.axis_index(axis_name)
     if q_offset is None:
         q_offset = me * Tl
@@ -166,7 +166,7 @@ def ring_attention_mla_local(q_lat: jax.Array, q_pe: jax.Array,
     inherent footprint)."""
     Tl, H, R = q_lat.shape
     Sl = rows.shape[0]
-    n = jax.lax.axis_size(axis_name)
+    n = jax.lax.psum(1, axis_name)   # axis_size is jax>=0.5; psum(1) is portable
     me = jax.lax.axis_index(axis_name)
     q_offset = me * Tl
     total = n * Sl if kv_len is None else kv_len

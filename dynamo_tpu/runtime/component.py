@@ -52,20 +52,27 @@ def json_serde(cls: Optional[type] = None):
 @dataclasses.dataclass
 class ComponentEndpointInfo:
     """Discovery record one serving endpoint writes.
-    Reference: ``ComponentEndpointInfo`` (component.rs:90-97)."""
+    Reference: ``ComponentEndpointInfo`` (component.rs:90-97).
+
+    ``draining``: the planner's decommission flag (docs/planner.md). A
+    draining instance stays discoverable — in-flight streams keep their
+    dial-back path — but routers must stop admitting new requests to it."""
 
     subject: str
     worker_id: int
     component: str
     endpoint: str
     namespace: str
+    draining: bool = False
 
     def to_json(self) -> bytes:
         return json.dumps(dataclasses.asdict(self)).encode()
 
     @classmethod
     def from_json(cls, raw: bytes) -> "ComponentEndpointInfo":
-        return cls(**json.loads(raw))
+        d = json.loads(raw)
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
 
 
 @dataclasses.dataclass
@@ -131,6 +138,16 @@ class Endpoint:
     def stats_key(self, lease_id: int) -> str:
         return (f"{self.namespace}/stats/{self.component}/"
                 f"{self.name}:{lease_id:x}")
+
+    def drain_prefix(self) -> str:
+        """Drain-request keys: the planner writes
+        ``{ns}/drain/{comp}/{ep}:{lease:x}`` and the serving endpoint —
+        which owns its discovery entry — answers by re-announcing itself
+        with ``draining=true`` (docs/planner.md drain protocol)."""
+        return f"{self.namespace}/drain/{self.component}/{self.name}:"
+
+    def drain_key(self, lease_id: int) -> str:
+        return f"{self.drain_prefix()}{lease_id:x}"
 
     @property
     def path(self) -> str:

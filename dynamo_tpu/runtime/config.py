@@ -18,7 +18,11 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:          # Python < 3.11
+    import tomli as tomllib
 from typing import Any, Optional
 
 logger = logging.getLogger("dynamo_tpu.runtime.config")

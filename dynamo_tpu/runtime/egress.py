@@ -113,6 +113,20 @@ class Client(AsyncEngine):
     def instance_ids(self) -> List[int]:
         return sorted(self.instances)
 
+    def draining_ids(self) -> List[int]:
+        return sorted(i for i, info in self.instances.items()
+                      if info.draining)
+
+    def available_ids(self) -> List[int]:
+        """Instances eligible for NEW work: the draining ones stay
+        discoverable (their in-flight streams are still live) but take no
+        new admissions (docs/planner.md). If the whole fleet is draining,
+        fall back to all instances — a drain must shift load, never drop
+        requests on the floor."""
+        avail = [i for i, info in sorted(self.instances.items())
+                 if not info.draining]
+        return avail if avail else self.instance_ids()
+
     async def wait_for_instances(self, timeout: float = 30.0) -> List[int]:
         deadline = asyncio.get_running_loop().time() + timeout
         while not self.instances:
@@ -133,13 +147,13 @@ class Client(AsyncEngine):
         return await self.random(request)
 
     async def random(self, request: SingleIn) -> ManyOut:
-        ids = self.instance_ids()
+        ids = self.available_ids()
         if not ids:
             raise RuntimeError(f"no instances for {self.endpoint.path}")
         return await self.direct(request, random.choice(ids))
 
     async def round_robin(self, request: SingleIn) -> ManyOut:
-        ids = self.instance_ids()
+        ids = self.available_ids()
         if not ids:
             raise RuntimeError(f"no instances for {self.endpoint.path}")
         return await self.direct(request, ids[next(self._rr) % len(ids)])

@@ -45,8 +45,17 @@ class EndpointServer:
         self._inbox = None
         self._loop_task: Optional[asyncio.Task] = None
         self._stats_task: Optional[asyncio.Task] = None
+        self._drain_task: Optional[asyncio.Task] = None
+        self._drain_watcher = None
         self._inflight: set = set()
         self._stopping = False
+        # planner drain protocol (docs/planner.md): once draining, the
+        # discovery entry carries draining=true (routers stop admitting),
+        # in-flight requests run to completion, and `on_drained` fires the
+        # moment the server is both draining and idle — the supervisor's
+        # cue that the process can stop with zero dropped requests.
+        self.draining = False
+        self.on_drained: Optional[Callable[[], None]] = None
         # fire-and-forget dedup window (ADVICE r2): the client's dispatch
         # retry is at-least-once; for streaming requests duplicates are
         # harmless (the client consumes only the last dialed-back stream),
@@ -92,16 +101,20 @@ class EndpointServer:
         self.lease = await rt.primary_lease()
         subject = self.endpoint.subject(self.lease.id)
         self._inbox = await rt.bus.serve(subject)
-        info = ComponentEndpointInfo(
+        self._info = ComponentEndpointInfo(
             subject=subject, worker_id=self.lease.id,
             component=self.endpoint.component, endpoint=self.endpoint.name,
             namespace=self.endpoint.namespace)
         created = await rt.store.kv_create(
-            self.endpoint.discovery_key(self.lease.id), info.to_json(),
+            self.endpoint.discovery_key(self.lease.id), self._info.to_json(),
             lease_id=self.lease.id)
         if not created:
             raise RuntimeError(
                 f"endpoint already registered: {self.endpoint.path}")
+        self._drain_watcher = await rt.store.watch_prefix(
+            self.endpoint.drain_key(self.lease.id))
+        self._drain_task = asyncio.get_running_loop().create_task(
+            self._drain_watch_loop(), name=f"drain-{self.endpoint.name}")
         self._loop_task = asyncio.get_running_loop().create_task(
             self._serve_loop(), name=f"endpoint-{self.endpoint.name}")
         if self.stats_handler is not None:
@@ -110,15 +123,57 @@ class EndpointServer:
         logger.info("serving %s as instance %x", self.endpoint.path,
                     self.lease.id)
 
+    async def _drain_watch_loop(self) -> None:
+        from .kvstore import WatchEventType
+        async for ev in self._drain_watcher:
+            if ev.type == WatchEventType.PUT and not self.draining:
+                await self.set_draining(True)
+
+    async def set_draining(self, flag: bool) -> None:
+        """Flip the discovery entry's draining flag (re-put under our own
+        lease, so liveness semantics are untouched). Requests already in
+        flight — and any that race in before routers see the update — are
+        still served; only NEW router admissions stop."""
+        if self.lease is None or self.draining == flag:
+            return
+        self.draining = flag
+        self._info.draining = flag
+        await self.endpoint.runtime.store.kv_put(
+            self.endpoint.discovery_key(self.lease.id), self._info.to_json(),
+            lease_id=self.lease.id)
+        logger.info("endpoint %s instance %x draining=%s (%d in flight)",
+                    self.endpoint.path, self.lease.id, flag,
+                    len(self._inflight))
+        self._maybe_drained()
+
+    @property
+    def idle(self) -> bool:
+        return not self._inflight
+
+    def _maybe_drained(self) -> None:
+        # a message can race into the inbox before routers see the
+        # draining flag — count it as in-flight, not as idle
+        inbox_empty = (self._inbox is None
+                       or getattr(self._inbox, "_queue", None) is None
+                       or self._inbox._queue.empty())
+        if (self.draining and self.idle and inbox_empty
+                and self.on_drained is not None):
+            self.on_drained()
+
     async def _serve_loop(self) -> None:
         while not self._stopping:
             msg = await self._inbox.next(timeout=0.5)
             if msg is None:
+                self._maybe_drained()
                 continue
             task = asyncio.get_running_loop().create_task(
                 self._handle(msg.payload))
             self._inflight.add(task)
-            task.add_done_callback(self._inflight.discard)
+            task.add_done_callback(self._request_done)
+
+    def _request_done(self, task: asyncio.Task) -> None:
+        self._inflight.discard(task)
+        self._maybe_drained()
 
     async def _handle(self, payload: bytes) -> None:
         try:
@@ -206,22 +261,30 @@ class EndpointServer:
             self._loop_task.cancel()
         if self._stats_task is not None:
             self._stats_task.cancel()
+        if self._drain_task is not None:
+            self._drain_task.cancel()
+        if self._drain_watcher is not None:
+            self._drain_watcher.close()
         for t in list(self._inflight):
             t.cancel()
         if self.lease is not None:
             # best-effort, bounded deregistration: if the daemon is gone,
             # lease expiry cleans these up anyway — shutdown must never
             # hang in the netstore reconnect window
-            try:
-                async with asyncio.timeout(2.0):
-                    await rt.bus.unserve(
-                        self.endpoint.subject(self.lease.id))
+            async def _deregister() -> None:
+                await rt.bus.unserve(
+                    self.endpoint.subject(self.lease.id))
+                await rt.store.kv_delete(
+                    self.endpoint.discovery_key(self.lease.id))
+                if self._stats_task is not None:
                     await rt.store.kv_delete(
-                        self.endpoint.discovery_key(self.lease.id))
-                    if self._stats_task is not None:
-                        await rt.store.kv_delete(
-                            self.endpoint.stats_key(self.lease.id))
-            except (TimeoutError, ConnectionError, OSError):
+                        self.endpoint.stats_key(self.lease.id))
+
+            try:
+                # wait_for, not asyncio.timeout: 3.10-compatible
+                await asyncio.wait_for(_deregister(), timeout=2.0)
+            except (asyncio.TimeoutError, TimeoutError, ConnectionError,
+                    OSError):
                 logger.warning("endpoint %s deregistration skipped (daemon "
                                "unreachable); lease expiry will clean up",
                                self.endpoint.path)

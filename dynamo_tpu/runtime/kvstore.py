@@ -142,7 +142,17 @@ class Lease:
 
 
 class KvStore(abc.ABC):
-    """etcd-shaped discovery store interface."""
+    """etcd-shaped discovery store interface.
+
+    ``on_lease_reclaimed(lease_id)``: fired by backends that can reclaim a
+    transiently-expired lease under the same id (NetKvStore after a daemon
+    restart/liveness blip). The worker's discovery KEYS are replayed by the
+    store itself, but derived state — e.g. the KV router's radix index of
+    this worker's cached blocks — was wiped by the DELETE watch events and
+    must be re-announced by whoever owns it (KNOWN_ISSUES kv-router
+    staleness; see KvBlockPool.reannounce)."""
+
+    on_lease_reclaimed: Optional[Callable[[int], None]] = None
 
     @abc.abstractmethod
     async def kv_create(self, key: str, value: bytes, lease_id: int = 0) -> bool:

@@ -46,6 +46,12 @@ class JsonlFormatter(logging.Formatter):
         return json.dumps(out, ensure_ascii=False)
 
 
+# logging.getLevelNamesMapping is 3.11+; build the same name→level map
+_LEVEL_NAMES = {name: lvl for lvl, name in logging._levelToName.items()}
+_LEVEL_NAMES["WARN"] = logging.WARNING
+_LEVEL_NAMES["FATAL"] = logging.CRITICAL
+
+
 def _parse_dyn_log(spec: str) -> tuple:
     """"info,foo.bar=debug" → (root_level, {module: level})."""
     root = logging.INFO
@@ -56,11 +62,10 @@ def _parse_dyn_log(spec: str) -> tuple:
             continue
         if "=" in part:
             mod, _, lvl = part.partition("=")
-            per_module[mod.strip()] = logging.getLevelNamesMapping().get(
+            per_module[mod.strip()] = _LEVEL_NAMES.get(
                 lvl.strip().upper(), logging.INFO)
         else:
-            root = logging.getLevelNamesMapping().get(
-                part.upper(), logging.INFO)
+            root = _LEVEL_NAMES.get(part.upper(), logging.INFO)
     return root, per_module
 
 

@@ -348,6 +348,14 @@ class NetKvStore(KvStore):
         logger.info("lease %x reclaimed after daemon restart (%d keys "
                     "replayed)", lease_id,
                     len(self._leased_keys.get(lease_id, {})))
+        # derived state (router radix index of this worker's blocks) was
+        # wiped by the expiry's DELETE events and is NOT in our key replay
+        # — let the owner re-announce it (KNOWN_ISSUES kv-router staleness)
+        if self.on_lease_reclaimed is not None:
+            try:
+                self.on_lease_reclaimed(lease_id)
+            except Exception:  # noqa: BLE001 — observer must not kill
+                logger.exception("on_lease_reclaimed hook failed")
         return True
 
     async def lease_revoke(self, lease_id: int) -> None:

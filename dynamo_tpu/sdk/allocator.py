@@ -45,23 +45,38 @@ class Allocation:
 
 
 class TpuAllocator:
+    """Free-list allocator (was bump-pointer): the dynamic planner scales
+    replicas up AND down, so released chips must be reusable."""
+
     def __init__(self, total_chips: Optional[int] = None):
         self.total = (_detect_chip_count() if total_chips is None
                       else total_chips)
-        self._next = 0
+        self._free: List[int] = list(range(self.total))
         self.allocations: Dict[str, Allocation] = {}
+
+    @property
+    def free_chips(self) -> int:
+        return len(self._free)
 
     def allocate(self, service: str, n_chips: int) -> Allocation:
         if n_chips == 0:
             alloc = Allocation(service, [])
         else:
-            if self._next + n_chips > self.total:
+            if n_chips > len(self._free):
                 raise RuntimeError(
                     f"service {service!r} wants {n_chips} chips but only "
-                    f"{self.total - self._next}/{self.total} remain")
-            alloc = Allocation(
-                service, list(range(self._next, self._next + n_chips)))
-            self._next += n_chips
+                    f"{len(self._free)}/{self.total} remain")
+            alloc = Allocation(service, self._free[:n_chips])
+            del self._free[:n_chips]
             logger.info("allocated chips %s → %s", alloc.chips, service)
         self.allocations[service] = alloc
         return alloc
+
+    def release(self, alloc: Allocation) -> None:
+        """Return a replica's chips to the pool (planner scale-down)."""
+        if alloc.chips:
+            self._free = sorted(set(self._free) | set(alloc.chips))
+            logger.info("released chips %s ← %s", alloc.chips,
+                        alloc.service)
+        if self.allocations.get(alloc.service) is alloc:
+            self.allocations.pop(alloc.service, None)

@@ -7,12 +7,20 @@ one supervised worker per service (the reference uses a circus arbiter;
 ours is a plain asyncio supervisor with bounded restarts), allocate
 accelerator chips per service, inject per-service YAML config via the
 ``DYNAMO_SERVICE_CONFIG`` env var, and (unless one is given) host the
-discovery/bus daemon in-process."""
+discovery/bus daemon in-process.
+
+Round 6: the watcher list became a :class:`Supervisor` with a live scale
+API — ``scale(service, n)`` programmatically, or desired-replica intents
+written under ``planner/scale/{service}`` in the KV store (the dynamic
+planner's actuator path, components/planner.py). Scale-down is graceful
+by construction: replicas whose serve_worker exits cleanly (rc=0, the
+drain-to-exit path) are reaped as retirements, not crashes."""
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import logging
 import os
 import signal
@@ -33,29 +41,41 @@ class Watcher:
     serving.py:127-166)."""
 
     def __init__(self, target: str, service_name: str, runtime_server: str,
-                 env: Dict[str, str]):
+                 env: Dict[str, str], replica: int = 0, alloc=None):
         self.target = target
         self.service_name = service_name
         self.runtime_server = runtime_server
         self.env = env
+        self.replica = replica
+        self.alloc = alloc                  # chips to release on retirement
         self.proc: Optional[asyncio.subprocess.Process] = None
         self.restarts = 0
+        self.retired = False                # clean drain-to-exit observed
         self._stopping = False
 
     async def start(self) -> None:
-        env = {**os.environ, **self.env}
+        env = {**os.environ, **self.env,
+               "DYN_SERVICE_REPLICA": str(self.replica)}
         self.proc = await asyncio.create_subprocess_exec(
             sys.executable, "-m", "dynamo_tpu.sdk.serve_worker",
             "--target", self.target,
             "--service-name", self.service_name,
             "--runtime-server", self.runtime_server,
             env=env)
-        logger.info("started %s (pid %d)", self.service_name, self.proc.pid)
+        logger.info("started %s[%d] (pid %d)", self.service_name,
+                    self.replica, self.proc.pid)
 
     async def supervise(self) -> None:
         while not self._stopping:
             rc = await self.proc.wait()
             if self._stopping:
+                return
+            if rc == 0:
+                # clean exit = drained worker retiring itself (the planner
+                # drain protocol) — reap, don't restart
+                self.retired = True
+                logger.info("service %s[%d] retired (clean exit)",
+                            self.service_name, self.replica)
                 return
             if self.restarts >= MAX_RESTARTS:
                 raise RuntimeError(
@@ -80,6 +100,162 @@ class Watcher:
             await self.proc.wait()
 
 
+class Supervisor:
+    """Replica manager for one deployed graph: per-service watcher lists,
+    a scale API, and an optional KV-store intent watch so a remote planner
+    can drive it (``planner/scale/{service}`` → replicas)."""
+
+    def __init__(self, target: str, graph, cfg: ServiceConfig,
+                 allocator: TpuAllocator, runtime_server: str):
+        self.target = target
+        self.services = {svc.name: svc for svc in graph}
+        self.cfg = cfg
+        self.allocator = allocator
+        self.runtime_server = runtime_server
+        self.watchers: Dict[str, List[Watcher]] = {
+            name: [] for name in self.services}
+        self._tasks: Dict[Watcher, asyncio.Task] = {}
+        self._next_replica: Dict[str, int] = {name: 0
+                                              for name in self.services}
+        self._failure: Optional[BaseException] = None
+        self._failed = asyncio.Event()
+        self._scale_runtime = None
+        self._scale_watcher = None
+        self._scale_task: Optional[asyncio.Task] = None
+        self.scale_ops = 0
+
+    # ---------------------------------------------------------- replicas
+    def _chips_for(self, name: str) -> int:
+        # YAML `resources: {tpu: n}` overrides the class declaration — e.g.
+        # a TpuWorker running its echo engine needs no chips (the reference
+        # reads resources from the service config the same way,
+        # cli/allocator.py:28-120)
+        override = self.cfg.tpu_override(name)
+        svc = self.services[name]
+        return svc.resources.tpu if override is None else override
+
+    async def start_replica(self, name: str) -> Watcher:
+        idx = self._next_replica[name]
+        self._next_replica[name] += 1
+        alloc = self.allocator.allocate(f"{name}[{idx}]",
+                                        self._chips_for(name))
+        env = {ENV_VAR: self.cfg.to_env(), **alloc.env()}
+        w = Watcher(self.target, name, self.runtime_server, env,
+                    replica=idx, alloc=alloc)
+        self.watchers[name].append(w)
+        await w.start()
+        task = asyncio.get_running_loop().create_task(
+            self._supervise(w), name=f"supervise-{name}-{idx}")
+        self._tasks[w] = task
+        return w
+
+    async def _supervise(self, w: Watcher) -> None:
+        try:
+            await w.supervise()
+        except asyncio.CancelledError:
+            raise
+        except BaseException as e:  # noqa: BLE001 — restart cap exceeded
+            if self._failure is None:
+                self._failure = e
+            self._failed.set()
+            return
+        if w.retired:
+            self._reap(w)
+
+    def _reap(self, w: Watcher) -> None:
+        if w in self.watchers.get(w.service_name, ()):
+            self.watchers[w.service_name].remove(w)
+        self._tasks.pop(w, None)
+        if w.alloc is not None:
+            self.allocator.release(w.alloc)
+
+    def counts(self) -> Dict[str, int]:
+        return {name: len(ws) for name, ws in self.watchers.items()}
+
+    async def scale(self, name: str, replicas: int) -> Dict[str, int]:
+        """Converge ``name`` to ``replicas`` processes. Scale-down stops
+        the youngest replicas (the planner drains the actual victim
+        beforehand via the discovery drain protocol; a drained worker has
+        usually already retired itself by the time this runs)."""
+        if name not in self.services:
+            raise ValueError(f"unknown service {name!r}")
+        replicas = max(replicas, 0)
+        self.scale_ops += 1
+        while len(self.watchers[name]) < replicas:
+            await self.start_replica(name)
+        while len(self.watchers[name]) > replicas:
+            w = self.watchers[name][-1]
+            task = self._tasks.pop(w, None)
+            if task is not None:
+                task.cancel()
+            await w.stop()
+            self.watchers[name].remove(w)
+            if w.alloc is not None:
+                self.allocator.release(w.alloc)
+        logger.info("scaled %s → %d replicas", name, replicas)
+        return self.counts()
+
+    # ------------------------------------------------------- scale intents
+    async def watch_scale_intents(self) -> None:
+        """Watch ``planner/scale/{service}`` for desired-replica intents
+        (the planner's SupervisorActuator writes them). Best-effort: a
+        deployment without a reachable store just skips the watch."""
+        from ..llm.slo import PLANNER_PREFIX
+        from ..runtime.distributed import DistributedRuntime
+        try:
+            self._scale_runtime = await DistributedRuntime.connect(
+                self.runtime_server)
+            self._scale_watcher = await self._scale_runtime.store \
+                .watch_prefix(f"{PLANNER_PREFIX}scale/")
+        except Exception as e:  # noqa: BLE001
+            logger.warning("scale-intent watch unavailable (%s)", e)
+            return
+        self._scale_task = asyncio.get_running_loop().create_task(
+            self._scale_loop(), name="supervisor-scale-watch")
+
+    async def _scale_loop(self) -> None:
+        from ..runtime.kvstore import WatchEventType
+        async for ev in self._scale_watcher:
+            if ev.type != WatchEventType.PUT:
+                continue
+            name = ev.entry.key.rsplit("/", 1)[-1]
+            if name not in self.services:
+                continue
+            try:
+                want = int(json.loads(ev.entry.value)["replicas"])
+            except Exception:  # noqa: BLE001 — admin input
+                logger.warning("bad scale intent ignored: %r",
+                               ev.entry.value)
+                continue
+            try:
+                await self.scale(name, want)
+            except Exception:  # noqa: BLE001 — keep watching
+                logger.exception("scale intent for %s failed", name)
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> "Supervisor":
+        for name in self.services:
+            await self.start_replica(name)
+        return self
+
+    async def wait_failed(self) -> None:
+        await self._failed.wait()
+        raise self._failure  # noqa: B904 — the original watcher error
+
+    async def stop(self) -> None:
+        if self._scale_task is not None:
+            self._scale_task.cancel()
+        if self._scale_watcher is not None:
+            self._scale_watcher.close()
+        if self._scale_runtime is not None:
+            await self._scale_runtime.shutdown()
+        for task in self._tasks.values():
+            task.cancel()
+        for ws in self.watchers.values():
+            for w in list(ws):
+                await w.stop()
+
+
 async def amain(argv=None) -> None:
     p = argparse.ArgumentParser(prog="dynamo-tpu-serve")
     p.add_argument("target", help="graph entry, e.g. graphs.agg:Frontend")
@@ -89,6 +265,8 @@ async def amain(argv=None) -> None:
     p.add_argument("--daemon-port", type=int, default=0)
     p.add_argument("--total-chips", type=int,
                    help="override detected TPU chip count")
+    p.add_argument("--no-scale-api", action="store_true",
+                   help="don't watch planner/scale/* intents")
     p.add_argument("--verbose", "-v", action="store_true")
     args = p.parse_args(argv)
     from ..runtime.log import setup_logging
@@ -111,17 +289,8 @@ async def amain(argv=None) -> None:
         logger.info("hosting discovery daemon on %s", runtime_server)
 
     allocator = TpuAllocator(total_chips=args.total_chips)
-    watchers: List[Watcher] = []
-    for svc in graph:
-        # YAML `resources: {tpu: n}` overrides the class declaration — e.g.
-        # a TpuWorker running its echo engine needs no chips (the reference
-        # reads resources from the service config the same way,
-        # cli/allocator.py:28-120)
-        override = cfg.tpu_override(svc.name)
-        want = svc.resources.tpu if override is None else override
-        alloc = allocator.allocate(svc.name, want)
-        env = {ENV_VAR: cfg.to_env(), **alloc.env()}
-        watchers.append(Watcher(args.target, svc.name, runtime_server, env))
+    supervisor = Supervisor(args.target, graph, cfg, allocator,
+                            runtime_server)
 
     stop_evt = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -132,18 +301,21 @@ async def amain(argv=None) -> None:
             pass
 
     try:
-        for w in watchers:
-            await w.start()
-        tasks = [asyncio.ensure_future(w.supervise()) for w in watchers]
+        await supervisor.start()
+        if not args.no_scale_api:
+            await supervisor.watch_scale_intents()
+        fail_task = asyncio.ensure_future(supervisor.wait_failed())
         stop_task = asyncio.ensure_future(stop_evt.wait())
         done, _ = await asyncio.wait(
-            tasks + [stop_task], return_when=asyncio.FIRST_COMPLETED)
+            [fail_task, stop_task], return_when=asyncio.FIRST_COMPLETED)
+        for t in (fail_task, stop_task):
+            if t not in done:
+                t.cancel()
         for t in done:
             if t is not stop_task and t.exception() is not None:
                 raise t.exception()
     finally:
-        for w in watchers:
-            await w.stop()
+        await supervisor.stop()
         if daemon is not None:
             await daemon.close()
 

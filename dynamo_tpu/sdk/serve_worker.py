@@ -104,11 +104,34 @@ async def amain(argv=None) -> None:
     svc = find_in_graph(entry, args.service_name)
     runtime = await DistributedRuntime.connect(args.runtime_server)
     stop = asyncio.Event()
+    drained = asyncio.Event()
     runtime.on_lease_lost = stop.set
     try:
         await serve_service(svc, runtime)
-        await stop.wait()
-        logger.error("lease lost; exiting")
+        # drain-to-exit (docs/planner.md): once EVERY endpoint this
+        # process serves is draining and idle, exit cleanly (rc=0) — the
+        # supervisor reaps a clean exit as retirement, not a crash
+
+        def maybe_drained() -> None:
+            if runtime._servers and all(s.draining and s.idle
+                                        for s in runtime._servers):
+                drained.set()
+
+        for srv in runtime._servers:
+            srv.on_drained = maybe_drained
+        stop_t = asyncio.ensure_future(stop.wait())
+        drain_t = asyncio.ensure_future(drained.wait())
+        done, pending = await asyncio.wait(
+            [stop_t, drain_t], return_when=asyncio.FIRST_COMPLETED)
+        for t in pending:
+            t.cancel()
+        if drain_t in done:
+            logger.info("all endpoints drained; retiring")
+        else:
+            # rc=1: a lost lease is a failure, not a retirement — the
+            # supervisor must restart us (rc=0 is reserved for drain)
+            logger.error("lease lost; exiting")
+            raise SystemExit(1)
     finally:
         await runtime.shutdown()
 

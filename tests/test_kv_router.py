@@ -319,3 +319,83 @@ async def test_engine_publishes_kv_events_to_router():
     await core.stop()
     scores = indexer.find_matches_for_request([int(t) for t in prompt])
     assert scores.scores.get(42, 0) >= 2  # prompt's full blocks indexed
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("native", [False, True])
+async def test_pool_reannounce_recovers_index_after_lease_reclaim(native):
+    """Regression for the KNOWN_ISSUES kv-router staleness: a transient
+    lease expiry makes the router's membership watch wipe the worker's
+    blocks from the radix index; the reclaim replays discovery KEYS but
+    not KV content EVENTS, so routing silently degraded to
+    load-balancing. The fix: a pool-side re-announce hook on lease
+    reclaim replays every stored-block announcement (parents before
+    children) and the index fully recovers."""
+    from dynamo_tpu.llm.kv.pool import KvBlockPool, make_kv_block_pool
+
+    indexer = KvIndexer(BS, prefer_native=False)
+
+    async def sink(ev):
+        indexer.apply_event(ev)
+
+    pub = KvEventPublisher(worker_id=5, sink=sink)
+    pool = make_kv_block_pool(16, on_stored=pub.publish_stored,
+                              on_removed=pub.publish_removed,
+                              prefer_native=native)
+    if native and isinstance(pool, KvBlockPool):
+        pytest.skip("no C++ toolchain")
+
+    tokens = list(range(16))                       # 4 chained blocks
+    h = compute_block_hashes(tokens, BS)
+    bids = pool.alloc_uninit(len(h))
+    parent = None
+    for bid, sh in zip(bids, h):
+        pool.register(bid, sh, sh ^ 0xABCD, parent)
+        parent = sh
+    await pub.drain()
+    assert indexer.find_matches_for_request(tokens).scores == {5: 4}
+
+    # transient lease expiry → membership watch wipes this worker's index
+    indexer.remove_worker(5)
+    assert indexer.find_matches_for_request(tokens).scores == {}
+
+    # lease reclaim fires the pool-side hook: replay every announcement
+    n = pool.reannounce()
+    assert n == 4
+    await pub.drain()
+    assert indexer.find_matches_for_request(tokens).scores == {5: 4}
+
+    # evicted blocks must NOT be re-announced after invalidation
+    pool.release(bids)
+    pool.reset()
+    await pub.drain()
+    assert pool.reannounce() == 0
+
+
+def test_pool_reannounce_orders_parents_before_children():
+    """The radix indexer re-roots children whose parent is unknown;
+    reannounce avoids that by replaying in parent order regardless of
+    registration (dict) order, and still emits orphans whose parent was
+    evicted."""
+    from dynamo_tpu.llm.kv.pool import KvBlockPool
+
+    pool = KvBlockPool(16)
+    h = compute_block_hashes(list(range(16)), BS)  # 4 chained hashes
+    bids = pool.alloc_uninit(4)
+    # register out of chain order: children first
+    pool.register(bids[3], h[3], 33, h[2])
+    pool.register(bids[2], h[2], 22, h[1])
+    pool.register(bids[1], h[1], 11, h[0])
+    pool.register(bids[0], h[0], 0, None)
+    order = []
+    n = pool.reannounce(lambda bid, sh, th, parent: order.append(sh))
+    assert n == 4
+    assert order == [h[0], h[1], h[2], h[3]]
+    # orphan: drop the root block's registration, replay again — the
+    # chain below it must still be emitted (indexer re-roots it)
+    pool.release(bids)
+    pool._invalidate(bids[0])
+    emitted = []
+    n = pool.reannounce(lambda bid, sh, th, parent: emitted.append(sh))
+    assert n == 3
+    assert set(emitted) == {h[1], h[2], h[3]}

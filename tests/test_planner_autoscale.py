@@ -1,0 +1,441 @@
+"""SLA-driven dynamic planner end-to-end at zero hardware: scale-up under
+queue pressure, scale-down with graceful drain (zero dropped in-flight
+requests), hysteresis under oscillating load, live disagg-threshold retune
+observed by a DisaggregatedRouter without restart, and the admin surface
+(llmctl planner verbs, /planner snapshot, Prometheus counters).
+
+Everything runs against MockTokenWorkers over the real discovery daemon —
+the SURVEY §4 no-GPU tier the planner was designed to be testable in."""
+
+import asyncio
+import json
+from typing import Dict, List
+
+import pytest
+
+from dynamo_tpu.components.mock_worker import MockTokenWorker
+from dynamo_tpu.components.planner import (Planner, PlannerActuator,
+                                           PlannerConfig)
+from dynamo_tpu.llm.slo import (FleetSignals, ServiceLevelObjective,
+                                evaluate, percentile)
+from dynamo_tpu.runtime import Context
+from dynamo_tpu.runtime.distributed import DistributedRuntime, Endpoint
+from dynamo_tpu.runtime.engine import EngineContext
+from dynamo_tpu.runtime.server import DiscoveryServer
+from tests.fixtures import wait_until
+
+pytestmark = pytest.mark.asyncio
+
+PATH = "dyn://plns/worker/generate"
+
+
+@pytest.fixture
+async def daemon():
+    srv = DiscoveryServer(host="127.0.0.1")
+    await srv.start()
+    yield srv
+    await srv.close()
+
+
+class MockFleetActuator(PlannerActuator):
+    """In-process substrate: each 'decode' replica is a MockTokenWorker on
+    its own runtime connection (own lease = own discovery identity)."""
+
+    def __init__(self, addr: str, block_size: int = 4):
+        self.addr = addr
+        self.block_size = block_size
+        self.workers: Dict[int, tuple] = {}       # worker_id → (rt, worker)
+        self.retired: List[int] = []
+        self.was_draining_at_retire: Dict[int, bool] = {}
+
+    async def scale_up(self, role: str, count: int) -> None:
+        assert role == "decode"
+        for _ in range(count):
+            rt = await DistributedRuntime.connect(self.addr)
+            w = await MockTokenWorker(rt, PATH,
+                                      block_size=self.block_size).start()
+            self.workers[w.worker_id] = (rt, w)
+
+    async def retire(self, role: str, worker_id: int) -> None:
+        rt, w = self.workers.pop(worker_id)
+        self.retired.append(worker_id)
+        self.was_draining_at_retire[worker_id] = w.draining
+        await w.stop()
+        await rt.shutdown()
+
+    async def stop_all(self) -> None:
+        for rt, w in list(self.workers.values()):
+            await w.stop()
+            await rt.shutdown()
+        self.workers.clear()
+
+
+def _fast_cfg(**kw) -> PlannerConfig:
+    base = dict(interval_s=0.05, cooldown_s=0.4, breach_cycles=3,
+                drain_timeout_s=20.0, drain_poll_s=0.05,
+                status_interval_s=0.1)
+    base.update(kw)
+    return PlannerConfig(**base)
+
+
+def _req(tokens, rid, max_tokens=4):
+    from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+    pre = PreprocessedRequest(
+        token_ids=list(tokens),
+        stop_conditions=StopConditions(max_tokens=max_tokens,
+                                       ignore_eos=True),
+        sampling_options=SamplingOptions(greedy=True))
+    return Context(pre, ctx=EngineContext(rid))
+
+
+# ---------------------------------------------------------------- scale up
+async def test_scale_up_on_queue_pressure(daemon):
+    addr = daemon.address
+    actuator = MockFleetActuator(addr)
+    await actuator.scale_up("decode", 1)
+    rt = await DistributedRuntime.connect(addr)
+    planner = None
+    try:
+        slo = ServiceLevelObjective(max_queue_depth=2, min_decode_workers=1,
+                                    max_decode_workers=3)
+        planner = await Planner(rt, Endpoint.parse_path(rt, PATH), actuator,
+                                slo=slo, config=_fast_cfg(),
+                                traces=lambda: []).start()
+        # synthetic queue pressure on the lone worker
+        (_rt, w), = actuator.workers.values()
+        w.metrics.num_requests_waiting = 10
+        await wait_until(lambda: len(actuator.workers) == 2,
+                         "scale-up to 2 decode workers")
+        assert planner.counters["scale_up"] >= 1
+        assert planner.last_decision["action"] in ("scale_up", "hold")
+        # pressure persists (both workers report waiting=10 is false — the
+        # new worker reports 0, mean is 5 > 2) → planner keeps growing
+        # until the mean clears or max replicas; relieve it instead
+        for _rt, w in actuator.workers.values():
+            w.metrics.num_requests_waiting = 0
+        before = planner.counters["scale_up"]
+        await asyncio.sleep(0.5)
+        # no runaway growth after pressure clears + cooldown
+        assert len(actuator.workers) <= 3
+        # hysteresis armed from zero again: counters stop climbing
+        later = planner.counters["scale_up"]
+        assert later - before <= 1
+    finally:
+        if planner is not None:
+            await planner.stop()
+        await actuator.stop_all()
+        await rt.shutdown()
+
+
+# ------------------------------------------------- scale down + drain
+async def test_scale_down_graceful_drain_zero_drops(daemon, monkeypatch):
+    """Load drop → planner drains ONE worker: drain flag in discovery,
+    router takes it out of rotation, in-flight requests complete, only
+    then is the worker retired. Zero dropped requests."""
+    monkeypatch.setenv("DYN_TOKEN_ECHO_DELAY_MS", "40")
+    addr = daemon.address
+    actuator = MockFleetActuator(addr)
+    await actuator.scale_up("decode", 2)
+    rt = await DistributedRuntime.connect(addr)
+    planner = None
+    client = None
+    try:
+        from dynamo_tpu.llm.protocols.annotated import decode_annotated_json
+        endpoint = Endpoint.parse_path(rt, PATH)
+        client = endpoint.client(decode_resp=decode_annotated_json)
+        await client.start()
+        await wait_until(lambda: len(client.instance_ids()) == 2,
+                         "both workers discovered")
+        victim_id = max(actuator.workers)        # planner picks max id
+        _vrt, victim = actuator.workers[victim_id]
+
+        # long-running in-flight requests pinned to the future victim
+        streams = [await client.direct(
+            _req(list(range(16)), f"inflight-{i}", max_tokens=12),
+            victim_id) for i in range(3)]
+        await wait_until(lambda: victim.engine.active == 3,
+                         "in-flight requests active on victim")
+
+        slo = ServiceLevelObjective(min_decode_workers=1,
+                                    max_decode_workers=3,
+                                    slot_util_low=0.9,  # idle by slots…
+                                    max_queue_depth=50)
+        # …but num_requests_waiting=0 and slot_util: victim has 3 active
+        # of 8 → mean util 0.1875+0/2 < 0.9 and queue 0 → scale_down
+        planner = await Planner(rt, endpoint, actuator, slo=slo,
+                                config=_fast_cfg(cooldown_s=0.2),
+                                traces=lambda: []).start()
+
+        # drain flag lands in the discovery entry before retirement
+        await wait_until(lambda: victim_id in set(client.draining_ids())
+                         or victim_id in actuator.retired,
+                         "victim flagged draining")
+        # new admissions skip the draining worker
+        if victim_id not in actuator.retired:
+            assert client.available_ids() == [
+                i for i in client.instance_ids() if i != victim_id]
+
+        # in-flight streams run to completion — zero drops
+        outs = await asyncio.gather(*[
+            asyncio.wait_for(_collect(s), timeout=30) for s in streams])
+        for out in outs:
+            assert out, "in-flight stream dropped during drain"
+            assert out[-1].data["finish_reason"] is not None
+
+        await wait_until(lambda: victim_id in actuator.retired,
+                         "victim retired after drain")
+        assert actuator.was_draining_at_retire[victim_id]
+        assert len(actuator.workers) == 1
+        assert planner.counters["drains_completed"] == 1
+        assert planner.counters["drain_timeouts"] == 0
+        # the survivor still serves
+        out = await _collect(await client.random(
+            _req([5, 6, 7, 8], "after-drain")))
+        assert out and out[-1].data["finish_reason"] is not None
+    finally:
+        if planner is not None:
+            await planner.stop()
+        if client is not None:
+            await client.close()
+        await actuator.stop_all()
+        await rt.shutdown()
+
+
+async def _collect(stream):
+    return [x async for x in stream]
+
+
+# ------------------------------------------------------------- hysteresis
+async def test_hysteresis_no_flap_under_oscillating_load(daemon):
+    """Deterministic cycle-level check: breaches that never persist
+    breach_cycles consecutive evaluations must never actuate; a persistent
+    breach actuates exactly once per cooldown window."""
+    addr = daemon.address
+    actuator = MockFleetActuator(addr)
+    await actuator.scale_up("decode", 1)
+    rt = await DistributedRuntime.connect(addr)
+    try:
+        slo = ServiceLevelObjective(max_queue_depth=2,
+                                    max_decode_workers=5)
+        planner = Planner(rt, Endpoint.parse_path(rt, PATH), actuator,
+                          slo=slo,
+                          config=_fast_cfg(breach_cycles=3,
+                                           cooldown_s=30.0),
+                          traces=lambda: [])
+        planner._client = Endpoint.parse_path(rt, PATH).client()
+        await planner._client.start()
+
+        sigs = {"v": FleetSignals(n_decode=1, queue_depth=0.0)}
+
+        async def observe():
+            planner.last_signals = sigs["v"]
+            return sigs["v"]
+
+        planner.observe = observe
+        breach = FleetSignals(n_decode=1, queue_depth=9.0)
+        calm = FleetSignals(n_decode=1, queue_depth=0.5)
+        # oscillating: 2 breaches, 1 calm, repeated — never 3 consecutive
+        for _ in range(8):
+            for v in (breach, breach, calm):
+                sigs["v"] = v
+                await planner._evaluate_once()
+        assert planner.counters["scale_up"] == 0
+        assert len(actuator.workers) == 1
+
+        # persistent breach: actuates exactly once (then cooldown blocks)
+        sigs["v"] = breach
+        for _ in range(10):
+            await planner._evaluate_once()
+        assert planner.counters["scale_up"] == 1
+        await wait_until(lambda: len(actuator.workers) == 2,
+                         "one scale-up under persistent breach")
+        await planner._client.close()
+    finally:
+        await actuator.stop_all()
+        await rt.shutdown()
+
+
+# --------------------------------------------------------------- retune
+async def test_disagg_threshold_retune_round_trip(daemon):
+    """Planner retune → kvstore → DisaggregatedRouter watch applies it
+    live, no restart. Backed-up prefill queue doubles the threshold."""
+    from dynamo_tpu.llm.disagg import DisaggregatedRouter
+    addr = daemon.address
+    actuator = MockFleetActuator(addr)
+    await actuator.scale_up("decode", 1)
+    rt_planner = await DistributedRuntime.connect(addr)
+    rt_decode = await DistributedRuntime.connect(addr)
+    planner = None
+    router = None
+    try:
+        router = await DisaggregatedRouter(
+            rt_decode, "tiny-model", max_local_prefill_length=512).start()
+
+        class StubQueue:
+            def __init__(self):
+                self.depth_value = 0
+
+            async def depth(self):
+                return self.depth_value
+
+        q = StubQueue()
+        slo = ServiceLevelObjective(max_queue_depth=2,
+                                    max_local_prefill_length=512,
+                                    max_decode_workers=1)
+        planner = await Planner(
+            rt_planner, Endpoint.parse_path(rt_planner, PATH), actuator,
+            slo=slo, config=_fast_cfg(), prefill_queue=q,
+            model_name="tiny-model", traces=lambda: []).start()
+        assert router.max_local_prefill_length == 512
+
+        q.depth_value = 10           # prefill fleet backed up → go local
+        await wait_until(lambda: planner.counters["retunes"] >= 1,
+                         "planner retuned the disagg threshold")
+        q.depth_value = 0            # settle: no further retune pressure
+        await asyncio.sleep(0.3)
+        final = planner.disagg_threshold
+        assert final > 512
+        await wait_until(
+            lambda: router.max_local_prefill_length == final,
+            "router observed retuned threshold live")
+
+        # drain flag through the same channel forces local prefill
+        await router.publish_threshold(1024, draining=True)
+        await wait_until(lambda: router.prefill_draining,
+                         "router observed prefill drain flag")
+        assert router.prefill_remote(10_000, 0) is False
+    finally:
+        if planner is not None:
+            await planner.stop()
+        if router is not None:
+            await router.stop()
+        await actuator.stop_all()
+        await rt_planner.shutdown()
+        await rt_decode.shutdown()
+
+
+# --------------------------------------------------------- admin surface
+async def test_llmctl_planner_verbs_and_metrics_surface(daemon, capsys):
+    from dynamo_tpu.components.metrics import MetricsAggregatorService
+    from dynamo_tpu.launch.llmctl import amain as llmctl
+    addr = daemon.address
+    actuator = MockFleetActuator(addr)
+    await actuator.scale_up("decode", 1)
+    rt = await DistributedRuntime.connect(addr)
+    planner = None
+    svc = None
+    try:
+        planner = await Planner(rt, Endpoint.parse_path(rt, PATH),
+                                actuator, config=_fast_cfg(),
+                                traces=lambda: []).start()
+
+        # set-slo merges into the stored record; planner applies it live
+        rc = await llmctl(["--runtime-server", addr, "planner", "set-slo",
+                           "plns", "--max-queue-depth", "7",
+                           "--max-decode-workers", "5"])
+        assert rc == 0
+        await wait_until(lambda: planner.slo.max_queue_depth == 7,
+                         "planner applied SLO update")
+        assert planner.slo.max_decode_workers == 5
+
+        # pause / resume
+        rc = await llmctl(["--runtime-server", addr, "planner", "pause",
+                           "plns"])
+        assert rc == 0
+        await wait_until(lambda: planner.paused, "planner paused")
+        rc = await llmctl(["--runtime-server", addr, "planner", "resume",
+                           "plns"])
+        assert rc == 0
+        await wait_until(lambda: not planner.paused, "planner resumed")
+
+        # status verb reads the published snapshot
+        await wait_until(
+            lambda: rt.store.kv_get_prefix("planner/status/"),
+            "planner status published")
+        rc = await llmctl(["--runtime-server", addr, "planner", "status"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "namespace plns" in out
+        assert "last decision" in out
+        assert "'evaluations'" in out
+
+        # metrics service: /planner snapshot + Prometheus counters
+        svc = await MetricsAggregatorService(
+            Endpoint.parse_path(rt, PATH), scrape_interval=0.1).start()
+        await wait_until(lambda: "plns" in svc.planner_status,
+                         "metrics service scraped planner status")
+        text = svc.render().decode()
+        assert "nv_llm_kv_planner_decisions" in text
+        assert 'action="evaluations"' in text
+        assert "nv_llm_kv_planner_workers" in text
+        # /planner endpoint serves the same snapshot over HTTP
+        import aiohttp
+        runner = await svc.serve_http(host="127.0.0.1", port=0)
+        port = runner.addresses[0][1] if runner.addresses else None
+        if port:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                        f"http://127.0.0.1:{port}/planner") as r:
+                    assert r.status == 200
+                    body = await r.json()
+                    assert "plns" in body
+                    assert "counters" in body["plns"]
+        await runner.cleanup()
+    finally:
+        if svc is not None:
+            await svc.close()
+        if planner is not None:
+            await planner.stop()
+        await actuator.stop_all()
+        await rt.shutdown()
+
+
+# ------------------------------------------------------- slo unit checks
+def test_slo_evaluate_matrix():
+    slo = ServiceLevelObjective(max_queue_depth=4, slot_util_high=0.85,
+                                slot_util_low=0.25, min_decode_workers=1,
+                                max_decode_workers=4)
+    up = evaluate(FleetSignals(n_decode=2, queue_depth=9), slo)
+    assert up.action == "scale_up" and up.breaches
+    at_max = evaluate(FleetSignals(n_decode=4, queue_depth=9), slo)
+    assert at_max.action == "hold"
+    down = evaluate(FleetSignals(n_decode=2, queue_depth=0,
+                                 slot_util=0.1), slo)
+    assert down.action == "scale_down"
+    at_min = evaluate(FleetSignals(n_decode=1, queue_depth=0,
+                                   slot_util=0.1), slo)
+    assert at_min.action == "hold"
+    ttft = evaluate(FleetSignals(n_decode=2, ttft_p90_ms=9000.0), slo)
+    assert ttft.action == "scale_up"
+    none_yet = evaluate(FleetSignals(n_decode=0), slo)
+    assert none_yet.action == "scale_up"
+
+
+def test_percentile_and_signal_aggregation():
+    assert percentile([], 90) is None
+    assert percentile([5.0], 90) == 5.0
+    xs = [float(i) for i in range(1, 101)]
+    assert percentile(xs, 50) == 50.0
+    assert percentile(xs, 90) == 90.0
+    sig = FleetSignals.from_worker_metrics(
+        {1: {"num_requests_waiting": 4, "request_total_slots": 8,
+             "request_active_slots": 4, "gpu_cache_usage_perc": 0.5},
+         2: {"num_requests_waiting": 0, "request_total_slots": 8,
+             "request_active_slots": 0, "gpu_cache_usage_perc": 0.1},
+         3: {"num_requests_waiting": 99, "request_total_slots": 8,
+             "request_active_slots": 8, "gpu_cache_usage_perc": 0.9}},
+        draining={3})
+    assert sig.n_decode == 2 and sig.n_draining == 1
+    assert sig.queue_depth == 2.0
+    assert abs(sig.slot_util - 0.25) < 1e-9
+    assert abs(sig.kv_util - 0.3) < 1e-9
+
+
+def test_slo_json_round_trip_tolerates_unknown_fields():
+    slo = ServiceLevelObjective(ttft_p90_ms=123.0)
+    d = json.loads(slo.to_json())
+    d["future_field"] = "ignored"
+    slo2 = ServiceLevelObjective.from_json(json.dumps(d).encode())
+    assert slo2.ttft_p90_ms == 123.0
