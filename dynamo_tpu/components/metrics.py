@@ -37,6 +37,16 @@ _GAUGE_FIELDS = (
     "gpu_prefix_cache_hit_rate",
 )
 
+# speculative decoding (engine/spec/): ForwardPassMetrics field →
+# exported metric name (the nv_llm_spec_* family the planner and the
+# Grafana speculation panel scrape)
+_SPEC_GAUGES = {
+    "spec_acceptance_rate": "nv_llm_spec_acceptance_rate",
+    "spec_accepted_per_step": "nv_llm_spec_accepted_per_step",
+    "spec_drafted_total": "nv_llm_spec_drafted_tokens",
+    "spec_accepted_total": "nv_llm_spec_accepted_tokens",
+}
+
 
 class MetricsAggregatorService:
     """Aggregates worker load + router hit-rate into one Prometheus registry.
@@ -55,6 +65,10 @@ class MetricsAggregatorService:
             f: Gauge(f"{PREFIX}_{f}", f"worker {f} (scraped stats)",
                      labels, registry=self.registry)
             for f in _GAUGE_FIELDS}
+        self._spec_gauges: Dict[str, Gauge] = {
+            f: Gauge(name, f"speculative decoding: worker {f} "
+                     "(scraped stats)", labels, registry=self.registry)
+            for f, name in _SPEC_GAUGES.items()}
         self.hit_isl_blocks = Counter(
             f"{PREFIX}_hit_rate_isl_blocks_total",
             "Routing decisions: total request blocks (ISL)",
@@ -174,11 +188,14 @@ class MetricsAggregatorService:
             lbl = self._labels(wid)
             for f in _GAUGE_FIELDS:
                 self._gauges[f].labels(*lbl).set(getattr(m, f))
+            for f, g in self._spec_gauges.items():
+                g.labels(*lbl).set(getattr(m, f))
         # drop series for workers whose leases died (the watcher pruned them)
         for gone in self._seen_workers - present:
             self.latest.pop(gone, None)
             lbl = self._labels(gone)
-            for g in self._gauges.values():
+            for g in list(self._gauges.values()) + list(
+                    self._spec_gauges.values()):
                 try:
                     g.remove(*lbl)
                 except KeyError:

@@ -35,12 +35,22 @@ class _EchoWithKvEvents(AsyncEngine):
     live in-flight streams so the worker's scraped ForwardPassMetrics show
     real occupancy — the planner's drain-wait and scale signals read it."""
 
-    def __init__(self, publisher: KvEventPublisher, block_size: int):
+    def __init__(self, publisher: KvEventPublisher, block_size: int,
+                 spec_k: int = 0, spec_acceptance: float = 0.75):
         self.inner = EchoEngineCore()
         self.publisher = publisher
         self.block_size = block_size
         self.requests_served = 0
         self.active = 0
+        # synthetic speculative-decoding counters: each request "drafts"
+        # spec_k tokens and "accepts" the configured fraction, so the
+        # nv_llm_spec_* metrics path (engine/spec/ → stats payload →
+        # MetricsAggregatorService) is exercisable with zero hardware
+        self.spec_k = spec_k
+        self.spec_acceptance = spec_acceptance
+        self.spec_drafted = 0
+        self.spec_accepted = 0
+        self.spec_steps = 0
         # every (seq_hash, tokens_hash, parent) ever announced, in parent
         # order — replayed by reannounce() after a transient lease expiry
         # (KNOWN_ISSUES kv-router staleness fix)
@@ -55,6 +65,10 @@ class _EchoWithKvEvents(AsyncEngine):
     async def generate(self, request: SingleIn) -> ManyOut:
         pre: PreprocessedRequest = request.data
         self.requests_served += 1
+        if self.spec_k > 0:
+            self.spec_steps += 1
+            self.spec_drafted += self.spec_k
+            self.spec_accepted += round(self.spec_k * self.spec_acceptance)
         seq = TokenBlockSequence(self.block_size, pre.token_ids)
         parent = None
         for i, (sh, bh) in enumerate(zip(seq.sequence_hashes,
@@ -82,13 +96,16 @@ class MockTokenWorker:
 
     def __init__(self, runtime: DistributedRuntime, endpoint_path: str,
                  block_size: int = 16,
-                 metrics: Optional[ForwardPassMetrics] = None):
+                 metrics: Optional[ForwardPassMetrics] = None,
+                 spec_k: int = 0, spec_acceptance: float = 0.75):
         self.runtime = runtime
         self.endpoint = Endpoint.parse_path(runtime, endpoint_path)
         self.block_size = block_size
         self.metrics = metrics or ForwardPassMetrics(
             request_active_slots=0, request_total_slots=8,
             kv_active_blocks=0, kv_total_blocks=1024)
+        self.spec_k = spec_k
+        self.spec_acceptance = spec_acceptance
         self.engine: Optional[_EchoWithKvEvents] = None
         self.server = None
 
@@ -105,7 +122,9 @@ class MockTokenWorker:
             await component.publish_event("kv_events", ev)
 
         publisher = KvEventPublisher(worker_id=lease.id, sink=sink)
-        self.engine = _EchoWithKvEvents(publisher, self.block_size)
+        self.engine = _EchoWithKvEvents(publisher, self.block_size,
+                                        spec_k=self.spec_k,
+                                        spec_acceptance=self.spec_acceptance)
         # transient lease reclaim (daemon blip) → replay the radix index
         # for this worker (KNOWN_ISSUES kv-router staleness fix)
         prev = getattr(self.runtime.store, "on_lease_reclaimed", None)
@@ -141,6 +160,15 @@ class MockTokenWorker:
                    len(self.server._inflight) if self.server else 0)
         d["request_active_slots"] = (self.metrics.request_active_slots
                                      + live)
+        eng = self.engine
+        if eng is not None and eng.spec_drafted > 0:
+            # live synthetic speculation counters (see _EchoWithKvEvents)
+            # — shaped exactly like a real EngineCore.metrics() payload
+            d["spec_drafted_total"] = eng.spec_drafted
+            d["spec_accepted_total"] = eng.spec_accepted
+            d["spec_acceptance_rate"] = eng.spec_accepted / eng.spec_drafted
+            d["spec_accepted_per_step"] = (eng.spec_accepted
+                                           / max(eng.spec_steps, 1))
         return d
 
     @property
@@ -160,12 +188,17 @@ async def amain(argv=None) -> None:
     p.add_argument("--runtime-server", required=True)
     p.add_argument("--endpoint", default="dyn://dynamo/worker/generate")
     p.add_argument("--kv-block-size", type=int, default=16)
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="synthetic speculation: drafts per request "
+                        "(exercises the nv_llm_spec_* metrics path)")
+    p.add_argument("--spec-acceptance", type=float, default=0.75)
     args = p.parse_args(argv)
     from ..runtime.log import setup_logging
     setup_logging()
     runtime = await DistributedRuntime.connect(args.runtime_server)
-    worker = await MockTokenWorker(runtime, args.endpoint,
-                                   block_size=args.kv_block_size).start()
+    worker = await MockTokenWorker(
+        runtime, args.endpoint, block_size=args.kv_block_size,
+        spec_k=args.spec_k, spec_acceptance=args.spec_acceptance).start()
     logger.info("mock worker %x serving %s", worker.worker_id, args.endpoint)
     try:
         await asyncio.Event().wait()

@@ -577,6 +577,24 @@ class EngineConfig:
     # one compute-bound dispatch instead of len(prompt) steps).
     # 0 disables; requires decode_steps_per_dispatch > 1.
     lane_prefill_max_tokens: int = 0
+    # speculative decoding (engine/spec/): max draft tokens verified per
+    # dispatch; 0 = off. When > 0 the engine compiles a batched verify
+    # program — [max_num_seqs, spec_k+1] query rows flattened through
+    # the SAME paged decode forward, each row scattering its input
+    # token's KV before attending positions <= its own — so k drafts
+    # plus the bonus position score in ONE dispatch (the ragged
+    # multi-token query shape; see docs/speculative.md). Acceptance is
+    # lockstep token equality against per-position sampling keys:
+    # greedy AND seeded sampling stay bit-exact vs plain decode.
+    # Requests pick their own k <= spec_k via the `speculation` knob
+    # (nvext.speculation on the OpenAI surface); llmctl spec set-k
+    # retunes the live default within [0, spec_k].
+    spec_k: int = 0
+    # prompt-lookup drafter window: trailing n-gram lengths tried
+    # (longest first) and how much history is searched
+    spec_ngram_max: int = 4
+    spec_ngram_min: int = 1
+    spec_window: int = 1024
     # KV-cache quantization: "none" | "int8" (per-token symmetric int8
     # pool + f32 scales — halves the decode KV read stream, the dominant
     # HBM term at seq >= ~1k). Current limits (refused loudly): no host
@@ -600,6 +618,8 @@ class EngineConfig:
             raise ValueError(
                 "decode_dispatch_pipeline requires decode_steps_per_dispatch"
                 " > 1 (the pipeline defers multi-step harvests)")
+        if self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0 (0 disables speculation)")
         if self.lane_prefill_max_tokens > 0 \
                 and self.decode_steps_per_dispatch <= 1:
             raise ValueError(

@@ -99,6 +99,11 @@ class EngineRequest:
     # first of these — f32 numerics differ across program shapes and can
     # legitimately flip a greedy argmax at near-tie logits (KNOWN_ISSUES).
     numeric_boundaries: List[int] = dataclasses.field(default_factory=list)
+    # speculative decoding (engine/spec/): max drafts verified per
+    # dispatch for THIS request. -1 = follow the engine's live default
+    # (EngineCore.spec_k_live, llmctl spec set-k); 0 = explicitly off;
+    # n > 0 clamps to the compiled maximum EngineConfig.spec_k.
+    spec_k: int = -1
     enqueue_time: float = dataclasses.field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
 
@@ -308,6 +313,18 @@ class EngineCore:
             "top_p": np.ones((self.B,), np.float32),
         }
         self._seeds = np.zeros((self.B,), np.int64)
+        # speculative decoding (engine/spec/): host-side drafter + the
+        # live draft budget (llmctl spec set-k moves it within
+        # [0, cfg.spec_k]; the verify program's shape is compiled at
+        # cfg.spec_k+1 rows and never widens at runtime)
+        self.spec_k_live = engine_cfg.spec_k
+        self.drafter = None
+        if engine_cfg.spec_k > 0:
+            from .spec import PromptLookupDrafter
+            self.drafter = PromptLookupDrafter(
+                max_ngram=engine_cfg.spec_ngram_max,
+                min_ngram=engine_cfg.spec_ngram_min,
+                window=engine_cfg.spec_window)
         self._compile_jits()
         # serving stats
         self.total_prefill_tokens = 0
@@ -315,6 +332,11 @@ class EngineCore:
         self.preemptions = 0
         self.lane_admissions = 0
         self.host_onboards = 0
+        # speculation stats (nv_llm_spec_* metrics feed)
+        self.spec_dispatches = 0       # verify dispatches issued
+        self.spec_drafted_tokens = 0   # draft tokens scored
+        self.spec_accepted_tokens = 0  # drafts that matched their sample
+        self.spec_emitted_tokens = 0   # tokens emitted by verify steps
         # synchronous device→host fetches the engine loop has paid
         # (harvests + admission token fetches): count + MEASURED stall
         # seconds. On the tunneled rig each blocking fetch costs ~131 ms;
@@ -400,6 +422,47 @@ class EngineCore:
         # previous dispatch's device tokens, fresh slots feed host values
         self._merge_jit = jax.jit(
             lambda dev, host, mask: jnp.where(mask, dev, host))
+
+        # speculative verify (engine/spec/, docs/speculative.md): score
+        # Tv = spec_k+1 positions per slot in ONE dispatch by flattening
+        # [B, Tv] query rows through the SAME paged decode forward.
+        # decode_forward scatters each row's input-token KV before
+        # attention and row (b, t) attends positions <= pos_b + t, so
+        # the rows of one sequence score its draft chain causally —
+        # parallel scoring at ~one batched step's weight read instead of
+        # Tv sequential steps. Per-position keys are LOCKSTEP with plain
+        # decode (steps0 + t == the key_step decode would use at that
+        # stream index), so sampled row t is bit-identical to what
+        # non-speculative decode would emit there; acceptance is then
+        # host-side token equality (spec.accept_lockstep).
+        self._verify_jit = None
+        if self.cfg.spec_k > 0:
+            Tv = self.cfg.spec_k + 1
+
+            def verify(params, kv, tokens, positions, block_tables,
+                       seeds, steps0, temperature, top_k, top_p):
+                params = unpack_params(params)
+                B = tokens.shape[0]
+                t_off = jnp.arange(Tv, dtype=jnp.int32)
+                flat_tokens = tokens.reshape(B * Tv)
+                flat_pos = (positions[:, None] + t_off[None, :]).reshape(
+                    B * Tv)
+                flat_tables = jnp.repeat(block_tables, Tv, axis=0)
+                logits, kv = self.model_mod.decode_forward(
+                    params, kv, flat_tokens, flat_pos, flat_tables,
+                    statics)
+                keys = make_slot_keys(
+                    seed, jnp.repeat(seeds, Tv),
+                    (steps0[:, None]
+                     + t_off.astype(steps0.dtype)[None, :]).reshape(
+                         B * Tv))
+                toks, logprobs = sample_tokens(
+                    logits, keys, jnp.repeat(temperature, Tv),
+                    jnp.repeat(top_k, Tv), jnp.repeat(top_p, Tv))
+                return (toks.reshape(B, Tv), logprobs.reshape(B, Tv),
+                        kv)
+
+            self._verify_jit = jax.jit(verify, donate_argnums=(1,))
 
         # sequence-parallel long-prompt prefill (ring attention over "sp")
         self._prefill_sp_jit = None
@@ -626,6 +689,14 @@ class EngineCore:
             num_requests_waiting=self.waiting.qsize(),
             gpu_cache_usage_perc=used / max(total_blocks, 1),
             gpu_prefix_cache_hit_rate=self.kv_manager.pool.hit_rate(),
+            spec_drafted_total=self.spec_drafted_tokens,
+            spec_accepted_total=self.spec_accepted_tokens,
+            spec_acceptance_rate=(
+                self.spec_accepted_tokens / self.spec_drafted_tokens
+                if self.spec_drafted_tokens else 0.0),
+            spec_accepted_per_step=(
+                self.spec_accepted_tokens / self.spec_dispatches
+                if self.spec_dispatches else 0.0),
         )
 
     # ------------------------------------------------------------ scheduler
@@ -1258,6 +1329,20 @@ class EngineCore:
 
     # --------------------------------------------------------------- decode
     def _decode_step(self) -> None:
+        if self._verify_jit is not None and self._spec_candidates():
+            # speculation drafts from HARVESTED state, so the pipelined
+            # dispatch (if any) must drain first; spec mode therefore
+            # forfeits the harvest/compute overlap — the multi-token
+            # emission per dispatch is the bigger lever when drafts land
+            if self._pending is not None:
+                prev, self._pending = self._pending, None
+                self._harvest(prev)
+                if not any(s is not None and s.ready for s in self.slots):
+                    return
+            if self._decode_step_spec():
+                return
+            # drafter came up dry everywhere: plain decode this step
+            # (the k=0 degeneracy — speculation costs nothing when idle)
         if self._decode_k_jit is not None:
             self._decode_step_multi(self.cfg.decode_steps_per_dispatch)
             return
@@ -1569,6 +1654,166 @@ class EngineCore:
         if self.recorder is not None and pending.get("id") is not None:
             self.recorder.rec("harvest", id=pending["id"],
                               toks=toks_k.copy(), applied=applied)
+
+    # ---------------------------------------------------------- speculation
+    def _req_spec_k(self, req: EngineRequest) -> int:
+        """Effective draft budget for one request: its own knob (-1 =
+        engine default, live-tunable via llmctl spec set-k) clamped to
+        the compiled verify program's shape."""
+        k = self.spec_k_live if req.spec_k < 0 else req.spec_k
+        return max(0, min(int(k), self.cfg.spec_k))
+
+    def _spec_candidates(self) -> bool:
+        """True when a verify dispatch could be worth attempting. A
+        mid-lane-prefill slot vetoes the whole batch: lanes feed planned
+        prompt tokens through the K-step scan and the verify program has
+        no planned-token plumbing — lanes last a handful of steps, after
+        which speculation resumes."""
+        any_spec = False
+        for s in self.slots:
+            if s is None or not s.ready:
+                continue
+            if s.lane_prompt is not None:
+                return False
+            if s.seq is not None and self._req_spec_k(s) > 0:
+                any_spec = True
+        return any_spec
+
+    def _decode_step_spec(self) -> bool:
+        """One speculative step: draft per slot (host-side n-gram lookup
+        over the request's own history), score every slot's k drafts + 1
+        bonus position in ONE verify dispatch, harvest with lockstep
+        acceptance. Slots without drafts ride along as 1-row decode.
+        Returns False when no slot drafted anything — the caller then
+        runs the plain decode path (k=0 degeneracy)."""
+        drafts: Dict[int, tuple] = {}
+        for i, s in enumerate(self.slots):
+            if (s is None or not s.ready or s.seq is None
+                    or s.last_token < 0):
+                continue
+            k = self._req_spec_k(s)
+            if k <= 0:
+                continue
+            d = self.drafter.draft(list(s.seq.tokens) + [s.last_token], k)
+            if d:
+                drafts[i] = (s, [int(t) for t in d[:k]])
+        if not drafts:
+            return False
+        Tv = self.cfg.spec_k + 1
+        if not self._prepare_multi(Tv):
+            return True            # capacity churn consumed the step
+        steps = np.zeros((self.B,), np.int64)
+        tokens = np.zeros((self.B, Tv), np.int32)
+        n_rows = np.zeros((self.B,), np.int32)
+        dmap: Dict[int, List[int]] = {}
+        for i in range(self.B):
+            s = self.slots[i]
+            if s is None or not s.ready:
+                self._tokens[i] = 0
+                self._positions[i] = 0
+                if s is None:
+                    self._block_tables[i, :] = 0  # trash block
+                continue
+            ent = drafts.get(i)
+            # _prepare_multi may have finished/preempted the drafted
+            # request — only keep drafts whose slot still holds it
+            d = ent[1] if (ent is not None and ent[0] is s) else []
+            self._tokens[i] = s.last_token
+            self._positions[i] = s.pos
+            steps[i] = s.key_step
+            tokens[i, 0] = s.last_token
+            if d:
+                tokens[i, 1:1 + len(d)] = d
+                dmap[i] = d
+            n_rows[i] = 1 + len(d)
+        if not dmap:
+            return False           # every drafted slot churned away
+        tables = self._tables_for_dispatch()
+        self._step += 1
+        did = None
+        if self.recorder is not None:
+            did = self.recorder.next_dispatch_id()
+            self.recorder.rec(
+                "verify", id=did, Tv=Tv, tokens=tokens.copy(),
+                positions=self._positions.copy(), tables=tables.copy(),
+                seeds=self._seeds.copy(), steps=steps.copy(),
+                temperature=self._samp["temperature"].copy(),
+                top_k=self._samp["top_k"].copy(),
+                top_p=self._samp["top_p"].copy(),
+                n_rows=n_rows.copy(),
+                reqs=[s.rid if (s is not None and s.ready) else None
+                      for s in self.slots])
+        toks_T, lps_T, self.kv = self._verify_jit(
+            self.params, self.kv, jnp.asarray(tokens),
+            jnp.asarray(self._positions), jnp.asarray(tables),
+            jnp.asarray(self._seeds), jnp.asarray(steps),
+            jnp.asarray(self._samp["temperature"]),
+            jnp.asarray(self._samp["top_k"]),
+            jnp.asarray(self._samp["top_p"]))
+        self.spec_dispatches += 1
+        self.spec_drafted_tokens += sum(len(d) for d in dmap.values())
+        self._harvest_verify({
+            "toks": toks_T, "logprobs": lps_T, "drafts": dmap, "id": did,
+            "reqs": [s if (s is not None and s.ready) else None
+                     for s in self.slots]})
+        return True
+
+    def _harvest_verify(self, pending: dict) -> None:
+        """Apply one verify dispatch: walk each slot's sampled rows with
+        lockstep acceptance (spec/drafter.py accept_lockstep semantics,
+        inlined here because each accepted row also carries one decode
+        step's bookkeeping). Rejected draft rows roll back by REWIND:
+        ``pos`` never advances over them, and every later dispatch
+        rewrites a stale row before any query attends it (the same
+        write-then-read ordering plain decode relies on)."""
+        self.host_roundtrips += 1
+        _t0 = time.monotonic()
+        toks_T = np.asarray(pending["toks"])       # [B, Tv] — ONE fetch
+        lps_T = np.asarray(pending["logprobs"])
+        self.host_stall_s += time.monotonic() - _t0
+        applied = []
+        for i, req in enumerate(pending["reqs"]):
+            if req is None or self.slots[i] is not req:
+                continue
+            d = pending["drafts"].get(i, [])
+            inputs = [req.last_token] + d
+            n_applied = 0
+            accepted = 0
+            for t in range(len(inputs)):
+                if req.cancelled:
+                    self._release_slot(req)
+                    self._finish_request(req, FinishReason.CANCELLED)
+                    break
+                tok = int(toks_T[i, t])
+                # row t wrote inputs[t]'s KV at this position — the
+                # bookkeeping of exactly one decode step
+                if req.seq is not None:
+                    req.seq.append(int(inputs[t]))
+                    req.registered_blocks = \
+                        self.kv_manager.register_full_blocks(
+                            req.blocks, req.seq, req.registered_blocks)
+                req.pos += 1
+                req.key_step += 1
+                req.generated += 1
+                req.last_token = tok
+                n_applied += 1
+                self.total_decode_tokens += 1
+                self.spec_emitted_tokens += 1
+                if t > 0:          # reaching row t>0 accepted draft t
+                    self.spec_accepted_tokens += 1
+                    accepted += 1
+                if req.first_token_time is None:
+                    req.first_token_time = time.monotonic()
+                self._emit(req, tok, float(lps_T[i, t]))
+                self._maybe_finish_after_emit(req)
+                if self.slots[i] is not req:
+                    break          # finished: drop the overrun rows
+                if t + 1 < len(inputs) and tok != int(inputs[t + 1]):
+                    break          # draft rejected: rewind-rollback
+            applied.append((i, req.rid, n_applied, accepted))
+        if self.recorder is not None and pending.get("id") is not None:
+            self.recorder.rec("spec_harvest", id=pending["id"],
+                              toks=toks_T.copy(), applied=applied)
 
     # ----------------------------------------------------------- preemption
     def _preempt_or_finish(self, req: EngineRequest) -> None:

@@ -229,7 +229,7 @@ def run_follower(core, sock: socket.socket,
     """
     from .replay import (exec_dispatch_event, exec_host_restore_event,
                          exec_kv_store_event, exec_prefill_event,
-                         exec_sp_prefill_event)
+                         exec_sp_prefill_event, exec_verify_event)
 
     disp_toks: "OrderedDict[int, object]" = OrderedDict()
     stats = {"prefills": 0, "dispatches": 0, "kv_stores": 0,
@@ -348,5 +348,11 @@ def run_follower(core, sock: socket.socket,
             while len(disp_toks) > max_chain_keep:
                 disp_toks.popitem(last=False)
             stats["dispatches"] += 1
+        elif kind == "verify":
+            # speculative verify (engine/spec/) is a device program —
+            # run the identical dispatch; acceptance is leader-side
+            # bookkeeping the follower never needs
+            _toks, core.kv = exec_verify_event(core, core.kv, ev)
+            stats["verifies"] = stats.get("verifies", 0) + 1
     logger.info("follower done: %s", stats)
     return stats

@@ -142,11 +142,33 @@ def exec_dispatch_event(core, kv, ev: dict, chain):
     return toks_k, kv
 
 
+def exec_verify_event(core, kv, ev: dict):
+    """Issue the recorded speculative verify dispatch (engine/spec/)
+    against ``kv``. Single home of the event → _verify_jit marshalling
+    (offline replayer + live multihost follower). Returns
+    (toks [B, Tv], kv)."""
+    import jax.numpy as jnp
+
+    if core._verify_jit is None or \
+            core.cfg.spec_k + 1 != np.asarray(ev["tokens"]).shape[1]:
+        raise NotImplementedError(
+            f"recorded verify dispatch has {np.asarray(ev['tokens']).shape[1]}"
+            f" rows/slot but this core compiled spec_k={core.cfg.spec_k} — "
+            f"replay with the recorded engine config")
+    toks, _lps, kv = core._verify_jit(
+        core.params, kv, jnp.array(np.asarray(ev["tokens"])),
+        jnp.array(ev["positions"]), jnp.array(ev["tables"]),
+        jnp.array(ev["seeds"]), jnp.array(ev["steps"]),
+        jnp.array(ev["temperature"]), jnp.array(ev["top_k"]),
+        jnp.array(ev["top_p"]))
+    return toks, kv
+
+
 def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
     """Re-execute the recorded schedule against a fresh KV cache, strictly
     synchronously. `core` supplies params and compiled jits (its own KV is
     untouched). Returns {"prefill": {seq: tok}, "dispatch": {id: [K,B]},
-    "fingerprints": [(label, digest), ...]}.
+    "verify": {id: [B,Tv]}, "fingerprints": [(label, digest), ...]}.
     """
     import jax
 
@@ -159,7 +181,8 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
     kv = llama.init_kv_cache(core.model_cfg, core.cfg.num_kv_blocks,
                              core.cfg.kv_block_size, dtype=dtype,
                              quantization=core.cfg.kv_quantization)
-    out = {"prefill": {}, "dispatch": {}, "fingerprints": []}
+    out = {"prefill": {}, "dispatch": {}, "verify": {},
+           "fingerprints": []}
     disp_toks: Dict[int, object] = {}
     mirror = None          # host-tier mirror pool, built from kv_store
     # events exactly like a multihost follower's (engine/multihost.py):
@@ -314,6 +337,25 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                     int(tables[i, p // bs]) * bs + p % bs
                     for p in range(p0, p0 + K))
             fp(("dispatch", ev["id"]))
+        elif kind == "verify":
+            # speculative verify (engine/spec/): every row — accepted,
+            # rejected, pad — wrote its position's pool slot, so all of
+            # them count as written (stale rows are rewritten by later
+            # events before any read, exactly as in the live run)
+            toks_v, kv = exec_verify_event(core, kv, ev)
+            toks_v = jax.block_until_ready(toks_v)
+            out["verify"][ev["id"]] = np.asarray(toks_v).copy()
+            tables = np.asarray(ev["tables"])
+            positions = np.asarray(ev["positions"])
+            n_rows = np.asarray(ev["n_rows"])
+            for i, rid in enumerate(ev.get("reqs", [])):
+                if rid is None:
+                    continue
+                p0 = int(positions[i])
+                written.update(
+                    int(tables[i, p // bs]) * bs + p % bs
+                    for p in range(p0, p0 + int(n_rows[i])))
+            fp(("verify", ev["id"]))
     return out
 
 
@@ -331,6 +373,17 @@ def compare_replay(events: List[dict], replayed: dict) -> List[str]:
                 bad = np.argwhere(live != rep)
                 diffs.append(
                     f"dispatch {ev['id']}: live != replay at (k,slot) "
+                    f"{bad.tolist()} live={live.tolist()} "
+                    f"replay={rep.tolist()}")
+        elif ev["ev"] == "spec_harvest":
+            rep = replayed.get("verify", {}).get(ev["id"])
+            if rep is None:
+                continue
+            live = np.asarray(ev["toks"])
+            if not np.array_equal(live, rep):
+                bad = np.argwhere(live != rep)
+                diffs.append(
+                    f"verify {ev['id']}: live != replay at (slot,row) "
                     f"{bad.tolist()} live={live.tolist()} "
                     f"replay={rep.tolist()}")
         elif ev["ev"] == "first_token":
@@ -410,13 +463,19 @@ def check_log(events: List[dict], block_size: int) -> List[StaleRead]:
                     w = last_writer.get(ps)
                     if w is not None and w != rid:
                         stale.append(StaleRead(-1, -1, rid, p, ps, w))
-        elif ev["ev"] == "dispatch":
-            K = int(ev["K"])
+        elif ev["ev"] in ("dispatch", "verify"):
+            # a verify dispatch (engine/spec/) is K=n_rows[i] fused
+            # steps per slot from the pool's perspective: row t writes
+            # position p0+t and reads everything <= it through the same
+            # table — identical ownership semantics to a K-step scan
             tables = np.asarray(ev["tables"])
             positions = np.asarray(ev["positions"])
+            n_rows = (np.asarray(ev["n_rows"])
+                      if ev["ev"] == "verify" else None)
             for i, rid in enumerate(ev["reqs"]):
                 if rid is None:
                     continue
+                K = int(ev["K"]) if n_rows is None else int(n_rows[i])
                 p0 = int(positions[i])
                 for k in range(K):
                     p = p0 + k
@@ -494,6 +553,28 @@ def check_inputs(events: List[dict]) -> List[str]:
                     problems.append(
                         f"dispatch {ev['id']} slot {i} ({rid}): host token "
                         f"{int(tokens[i])} != last harvested {st['last']}")
+        elif ev["ev"] == "verify":
+            positions = np.asarray(ev["positions"])
+            steps = np.asarray(ev["steps"])
+            tokens = np.asarray(ev["tokens"])
+            for i, rid in enumerate(ev["reqs"]):
+                if rid is None or rid not in state:
+                    continue
+                st = state[rid]
+                if int(positions[i]) != st["pos"]:
+                    problems.append(
+                        f"verify {ev['id']} slot {i} ({rid}): position "
+                        f"{int(positions[i])} != state {st['pos']}")
+                if int(steps[i]) != st["key_step"]:
+                    problems.append(
+                        f"verify {ev['id']} slot {i} ({rid}): key step "
+                        f"{int(steps[i])} != state {st['key_step']}")
+                if (st["last"] is not None
+                        and int(tokens[i, 0]) != st["last"]):
+                    problems.append(
+                        f"verify {ev['id']} slot {i} ({rid}): row-0 "
+                        f"token {int(tokens[i, 0])} != last harvested "
+                        f"{st['last']}")
         elif ev["ev"] == "harvest":
             toks = np.asarray(ev["toks"])
             for slot, rid, n in ev["applied"]:
@@ -503,6 +584,15 @@ def check_inputs(events: List[dict]) -> List[str]:
                     st["key_step"] += n
                     if n > 0:
                         st["last"] = int(toks[n - 1, slot])
+        elif ev["ev"] == "spec_harvest":
+            toks = np.asarray(ev["toks"])      # [B, Tv]
+            for slot, rid, n, _accepted in ev["applied"]:
+                if rid in state:
+                    st = state[rid]
+                    st["pos"] += n
+                    st["key_step"] += n
+                    if n > 0:
+                        st["last"] = int(toks[slot, n - 1])
         elif ev["ev"] == "preempt":
             state.pop(ev["rid"], None)
     return problems

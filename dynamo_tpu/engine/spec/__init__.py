@@ -1,0 +1,28 @@
+"""Speculative decoding subsystem.
+
+Breaks the one-token-per-step cap of the decode loop: a host-side
+:class:`Drafter` proposes up to k tokens per sequence, the engine scores
+all k+1 positions in ONE batched dispatch against the paged KV pool
+(EngineCore._verify_jit — the ragged multi-token query shape the
+lane-prefill/chunked-prefill scorer path already proves), and acceptance
+is lockstep token equality (drafter.py module docstring): the verify
+program samples every position with the SAME per-(seed, key_step) PRNG
+keys plain decode would use, so accepted streams are bit-identical to
+non-speculative decode — greedy AND temperature>0 — up to the documented
+verify-vs-decode near-tie numerics caveat (KNOWN_ISSUES.md).
+
+Layout:
+- drafter.py — Drafter interface + the n-gram PromptLookupDrafter
+  (no second model; CPU-testable) + the pure acceptance function
+- admin.py — KV-store config keys for the llmctl spec admin surface
+
+docs/speculative.md holds the acceptance contract and tuning notes.
+"""
+
+from .admin import SPEC_PREFIX, SpecConfig, spec_config_key
+from .drafter import Drafter, PromptLookupDrafter, accept_lockstep
+
+__all__ = [
+    "Drafter", "PromptLookupDrafter", "accept_lockstep",
+    "SPEC_PREFIX", "SpecConfig", "spec_config_key",
+]

@@ -1,0 +1,35 @@
+"""Speculative-decoding admin surface: KV-store config keys.
+
+Mirrors the planner admin layout (llm/slo.py): ``llmctl spec set-k``
+writes ``spec/config/{namespace}``, workers watch it
+(launch/run.py _wire_spec_config) and retune their live draft budget
+without restart. The compiled verify program's shape is fixed at
+EngineConfig.spec_k, so the live value can only move WITHIN [0, spec_k]
+— raising it past the compiled maximum clamps (a restart with a larger
+--spec-k is the only way to widen the program)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+SPEC_PREFIX = "spec/"
+
+
+def spec_config_key(namespace: str) -> str:
+    return f"{SPEC_PREFIX}config/{namespace}"
+
+
+@dataclasses.dataclass
+class SpecConfig:
+    """Stored live speculation config for one namespace."""
+
+    k: int = 0
+
+    def to_json(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "SpecConfig":
+        d = json.loads(raw)
+        return cls(k=int(d.get("k", 0)))

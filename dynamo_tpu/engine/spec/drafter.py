@@ -1,0 +1,98 @@
+"""Drafters: propose the next k tokens from a request's own history.
+
+The verify side (EngineCore._verify_jit) is drafter-agnostic — anything
+that returns candidate tokens plugs in. The shipped drafter is
+prompt-lookup (n-gram) decoding: match the history's trailing n-gram
+against an earlier occurrence in the SAME history and propose its
+continuation. It needs no second model, costs microseconds of host time
+per step, and wins exactly where speculation wins most — extraction,
+summarization-with-quotes, code edits, any output that re-uses spans of
+its own prompt. A model-based (EAGLE-style) drafter slots in behind the
+same interface later (ROADMAP.md open items).
+
+Acceptance contract ("lockstep acceptance"): the verify program samples
+position t with the SAME PRNG key (sampling.make_slot_keys of
+(request seed, key_step + t)) that plain decode would use at that stream
+index, so the sampled token s_t is THE token non-speculative decode
+would emit there — for greedy (argmax) and for temperature>0 alike.
+A draft d_{t+1} is accepted iff d_{t+1} == s_t, and the emitted stream
+is always s_0..s_m (accepted drafts ARE the samples). This is rejection
+sampling specialized to a deterministic proposal under common random
+numbers: it preserves the target distribution not just in law but
+bit-exactly per stream — the strongest form of the spec-decoding
+correctness guarantee, and the one the tier-1 exactness tests assert.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+
+class Drafter:
+    """Interface: propose up to ``k`` draft tokens given the request's
+    token history (prompt + everything emitted so far, most recent
+    last). Return [] to skip speculation this step — the engine then
+    falls back to plain decode at zero cost (the k=0 degeneracy)."""
+
+    def draft(self, history: Sequence[int], k: int) -> List[int]:
+        raise NotImplementedError
+
+
+class PromptLookupDrafter(Drafter):
+    """N-gram prompt lookup: find the most recent earlier occurrence of
+    the history's trailing n-gram (longest n first) and propose the k
+    tokens that followed it.
+
+    ``window`` bounds the searched suffix so drafting stays O(window·n)
+    per step regardless of context length. The continuation may overlap
+    the trailing n-gram itself — that is what lets a length-p cycle
+    extend periodically (the repetitive-output case this drafter earns
+    its keep on)."""
+
+    def __init__(self, max_ngram: int = 4, min_ngram: int = 1,
+                 window: int = 1024):
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram "
+                f"(got {min_ngram}..{max_ngram})")
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+        self.window = window
+
+    def draft(self, history: Sequence[int], k: int) -> List[int]:
+        h = list(history[-self.window:])
+        n_hi = min(self.max_ngram, len(h) - 1)
+        for n in range(n_hi, self.min_ngram - 1, -1):
+            pattern = h[-n:]
+            # candidate starts 0..len(h)-n-1: strictly earlier than the
+            # trailing occurrence. Most recent match wins (locality —
+            # the nearest repeat is likeliest to continue the same way),
+            # EXCEPT that a match flush against the history's end can
+            # only propose a truncated continuation, so keep scanning
+            # for one with the full k tokens (a period-p cycle always
+            # has one once the run is long enough)
+            best: List[int] = []
+            for start in range(len(h) - n - 1, -1, -1):
+                if h[start:start + n] == pattern:
+                    cont = h[start + n:start + n + k]
+                    if len(cont) == k:
+                        return list(cont)
+                    if len(cont) > len(best):
+                        best = list(cont)
+            if best:
+                return best
+        return []
+
+
+def accept_lockstep(drafts: Sequence[int],
+                    sampled: Sequence[int]) -> Tuple[int, List[int]]:
+    """The pure acceptance rule, shared by the engine harvest and the
+    bench loop. ``sampled`` is the verify dispatch's per-position output
+    s_0..s_k (lockstep keys); ``drafts`` is d_1..d_k. Returns
+    (accepted_draft_count m, emitted tokens s_0..s_m) — accepted drafts
+    equal their samples by construction, so the emission is always a
+    prefix of ``sampled``."""
+    m = 0
+    while m < len(drafts) and int(sampled[m]) == int(drafts[m]):
+        m += 1
+    return m, [int(t) for t in sampled[:m + 1]]

@@ -56,6 +56,22 @@ def build_parser() -> argparse.ArgumentParser:
     pr = plsub.add_parser("resume")
     pr.add_argument("namespace")
 
+    sp = sub.add_parser("spec", help="speculative decoding admin "
+                                     "(engine/spec/)")
+    spsub = sp.add_subparsers(dest="spec_cmd", required=True)
+    sps = spsub.add_parser("status", help="show stored draft budgets "
+                                          "and live worker acceptance")
+    sps.add_argument("namespace", nargs="?",
+                     help="limit to one namespace (default: all)")
+    spk = spsub.add_parser("set-k", help="set the live draft budget "
+                                         "(clamped to each worker's "
+                                         "compiled --spec-k maximum)")
+    spk.add_argument("namespace")
+    spk.add_argument("k", type=int)
+    spo = spsub.add_parser("off", help="disable speculation live "
+                                       "(equivalent to set-k 0)")
+    spo.add_argument("namespace")
+
     dep = sub.add_parser("deployment",
                          help="manage graph deployments (deploy/ control "
                               "plane — the api-server CRUD over the store)")
@@ -112,6 +128,8 @@ async def amain(argv=None) -> int:
             print(f"disagg threshold for {args.model} → {args.value}")
         elif args.cmd == "planner":
             return await _planner_cmd(runtime, args)
+        elif args.cmd == "spec":
+            return await _spec_cmd(runtime, args)
         elif args.cmd == "deployment":
             return await _deployment_cmd(runtime, args)
         return 0
@@ -170,6 +188,41 @@ async def _planner_cmd(runtime, args) -> int:
         control_key(args.namespace),
         json.dumps({"paused": paused}).encode())
     print(f"planner {args.planner_cmd}d for {args.namespace}")
+    return 0
+
+
+async def _spec_cmd(runtime, args) -> int:
+    """Speculative-decoding admin over the spec/config/* KV keys
+    (engine/spec/admin.py): workers watch their namespace's key
+    (launch/run.py _wire_spec_config) and retune spec_k_live without a
+    restart — mirroring the planner admin surface."""
+    from ..engine.spec import SPEC_PREFIX, SpecConfig, spec_config_key
+
+    if args.spec_cmd == "status":
+        prefix = (spec_config_key(args.namespace)
+                  if args.namespace else f"{SPEC_PREFIX}config/")
+        entries = await runtime.store.kv_get_prefix(prefix)
+        if not entries:
+            print("(no spec config stored)")
+            return 1
+        for e in sorted(entries, key=lambda x: x.key):
+            ns = e.key.rsplit("/", 1)[-1]
+            try:
+                cfg = SpecConfig.from_json(e.value)
+            except ValueError:
+                print(f"namespace {ns}  (malformed config)")
+                continue
+            state = "off" if cfg.k == 0 else f"k={cfg.k}"
+            print(f"namespace {ns}  speculation {state}")
+        return 0
+    k = args.k if args.spec_cmd == "set-k" else 0
+    if k < 0:
+        print("k must be >= 0", file=sys.stderr)
+        return 1
+    await runtime.store.kv_put(spec_config_key(args.namespace),
+                               SpecConfig(k=k).to_json())
+    print(f"speculation for {args.namespace} → "
+          f"{'off' if k == 0 else f'k={k}'}")
     return 0
 
 

@@ -64,6 +64,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "tokens ride the decode batch as planned inputs "
                         "when the engine is busy (continuous batching; "
                         "0 disables, needs K>1)")
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="speculative decoding: max prompt-lookup draft "
+                        "tokens verified per step (engine/spec/; 0 "
+                        "disables; per-request override via "
+                        "nvext.speculation, live retune via llmctl "
+                        "spec set-k)")
     p.add_argument("--decode-dispatch-pipeline", action="store_true",
                    help="overlap each dispatch's token harvest with the "
                         "next dispatch (requires K>1; finish reaction "
@@ -168,6 +174,7 @@ def engine_config(args):
         decode_steps_per_dispatch=args.decode_steps_per_dispatch,
         decode_dispatch_pipeline=args.decode_dispatch_pipeline,
         lane_prefill_max_tokens=args.lane_prefill_max_tokens,
+        spec_k=args.spec_k,
         quantization=args.quantization,
         kv_quantization=args.kv_quantization,
         tp=args.tp, sp=args.sp, dp=args.dp, ep=args.ep)
@@ -391,6 +398,7 @@ async def run_worker_endpoint(args, engine, pipeline, core, runtime,
     if core is not None:
         stats_handler = lambda: core.metrics().to_dict()  # noqa: E731
         await _wire_kv_events(core, runtime, endpoint)
+        await _wire_spec_config(core, runtime, endpoint.namespace)
     if args.protocol == "tokens":
         if mdc is None:
             raise SystemExit(
@@ -450,6 +458,45 @@ async def _wire_kv_events(core, runtime, endpoint) -> None:
                         "reclaim", n)
 
     runtime.store.on_lease_reclaimed = reclaimed
+
+
+async def _wire_spec_config(core, runtime, namespace: str) -> None:
+    """Live speculative-decoding retune (llmctl spec set-k/off): load the
+    stored draft budget for this namespace, then watch the config key and
+    move ``core.spec_k_live`` within [0, cfg.spec_k] — the compiled
+    verify program never widens at runtime (engine/spec/admin.py)."""
+    from ..engine.spec import SpecConfig, spec_config_key
+
+    key = spec_config_key(namespace)
+
+    def apply(raw: bytes) -> None:
+        try:
+            k = SpecConfig.from_json(raw).k
+        except (ValueError, KeyError):
+            logger.warning("ignoring malformed spec config at %s", key)
+            return
+        core.spec_k_live = max(0, min(k, core.cfg.spec_k))
+        if k > core.cfg.spec_k:
+            logger.warning(
+                "spec set-k %d exceeds the compiled maximum %d — "
+                "clamped (restart with a larger --spec-k to widen the "
+                "verify program)", k, core.cfg.spec_k)
+        logger.info("speculation live draft budget -> %d",
+                    core.spec_k_live)
+
+    from ..runtime.kvstore import WatchEventType
+    entry = await runtime.store.kv_get(key)
+    if entry is not None:
+        apply(entry.value)
+    watcher = await runtime.store.watch_prefix(key)
+
+    async def watch_loop() -> None:
+        async for ev in watcher:
+            if ev.type == WatchEventType.PUT:
+                apply(ev.entry.value)
+
+    asyncio.get_running_loop().create_task(watch_loop(),
+                                           name="spec-config-watch")
 
 
 async def run_prefill_worker(args, core, runtime) -> None:

@@ -47,6 +47,9 @@ class JaxEngine(AsyncEngine):
     def build_request(self, request: SingleIn) -> EngineRequest:
         pre: PreprocessedRequest = request.data
         sc = pre.stop_conditions
+        # speculation knob: None = engine live default (spec_k = -1);
+        # explicit values clamp to the compiled verify width at dispatch
+        spec = getattr(pre, "speculation", None)
         return EngineRequest(
             rid=request.id,
             prompt=list(pre.token_ids),
@@ -55,6 +58,7 @@ class JaxEngine(AsyncEngine):
             eos_ids=frozenset(() if sc.ignore_eos else
                               (sc.stop_token_ids_hidden or pre.eos_token_ids)),
             ctx=request.ctx,
+            spec_k=-1 if spec is None else max(0, int(spec)),
         )
 
     async def generate(self, request: SingleIn) -> ManyOut:

@@ -28,6 +28,13 @@ class ForwardPassMetrics:
     num_requests_waiting: int = 0
     gpu_cache_usage_perc: float = 0.0
     gpu_prefix_cache_hit_rate: float = 0.0
+    # speculative decoding (engine/spec/): cumulative draft/accept
+    # counters + derived rates — defaults keep old payloads decoding
+    # (from_dict drops unknown keys, absent keys take these zeros)
+    spec_drafted_total: int = 0
+    spec_accepted_total: int = 0
+    spec_acceptance_rate: float = 0.0
+    spec_accepted_per_step: float = 0.0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

@@ -186,6 +186,9 @@ class OpenAIPreprocessor(Operator):
             output_options=output,
             eos_token_ids=list(info.eos_token_ids),
             mdc_sum=None,
+            # per-request draft budget (engine/spec/); None falls back
+            # to the serving engine's live default
+            speculation=(nvext.speculation if nvext else None),
         )
 
     # ------------------------------------------------------------- operator

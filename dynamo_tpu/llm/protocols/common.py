@@ -92,6 +92,12 @@ class PreprocessedRequest:
     # RemotePrefillParams, container/deps/vllm patch:3584-3645):
     prefix_hit_len: int = 0
     estimated_prefix_hit_blocks: int = 0
+    # Speculative decoding (engine/spec/, docs/speculative.md): max
+    # draft tokens verified per step for this request. None = the
+    # engine's live default (llmctl spec set-k); 0 = explicitly off;
+    # n > 0 clamps to the engine's compiled maximum (EngineConfig
+    # spec_k). Surfaces as nvext.speculation on the OpenAI edge.
+    speculation: Optional[int] = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "PreprocessedRequest":

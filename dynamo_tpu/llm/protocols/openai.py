@@ -31,6 +31,9 @@ class NvExt(BaseModel):
     greed_sampling: Optional[bool] = None
     top_k: Optional[int] = None
     repetition_penalty: Optional[float] = None
+    # speculative decoding: max draft tokens verified per step (None =
+    # engine default, 0 = off; clamped to the worker's compiled maximum)
+    speculation: Optional[int] = None
 
 
 # ---------------------------------------------------------------------------

@@ -27,9 +27,14 @@ def test_bench_runs_and_prints_json():
     (BENCH_FORCE_CPU: the sitecustomize overrides JAX_PLATFORMS, so env
     alone would land these subprocesses on the tunneled TPU — and hang
     the suite whenever the tunnel is down): one compile dispatch
-    + a couple of timed dispatches, then the driver's ONE JSON line."""
+    + a couple of timed dispatches, then the driver's ONE JSON line.
+
+    --spec=2 rides the same run (ISSUE 2 satellite): the line must then
+    also carry the `spec` provenance dict — measured acceptance and
+    effective tok/s next to the baseline row — at the marginal cost of
+    the verify-program compile instead of a second engine build."""
     r = _run(
-        [sys.executable, "bench.py"],
+        [sys.executable, "bench.py", "--spec=2"],
         {"BENCH_FORCE_CPU": "1", "BENCH_MODEL": "tiny", "BENCH_BATCH": "4",
          "BENCH_STEPS": "8", "BENCH_PROMPT": "16", "BENCH_HARVEST": "4",
          "BENCH_QUANT": "none"})
@@ -45,6 +50,16 @@ def test_bench_runs_and_prints_json():
     # value>0 — this test is about main() actually running, so reject it
     assert "error" not in out, f"bench fell back instead of running: {out}"
     assert out["extra"]["platform"] == "cpu"
+    spec = out.get("spec")
+    assert spec, f"no spec provenance in the result: {out}"
+    assert spec["k"] == 2
+    assert 0.0 <= spec["acceptance_rate"] <= 1.0
+    for field in ("accepted_per_step", "emitted_per_step",
+                  "effective_tok_per_s", "device_verify_step_ms"):
+        assert field in spec, f"missing spec field {field}: {spec}"
+    # a verify dispatch emits at least one token per slot per step
+    assert spec["emitted_per_step"] >= 1.0
+    assert spec["effective_tok_per_s"] > 0
 
 
 def test_bench_mla_geometry_runs():

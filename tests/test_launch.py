@@ -196,6 +196,35 @@ async def test_llmctl_add_list_remove(daemon, capsys):
                                "set-threshold", "m1", "123"]) == 0
 
 
+@pytest.mark.spec
+async def test_llmctl_spec_admin(daemon, capsys):
+    """llmctl spec {status,set-k,off} mirror the planner admin surface:
+    writes land on spec/config/{ns} (the key workers watch via
+    launch/run.py _wire_spec_config) and status reads them back."""
+    from dynamo_tpu.engine.spec import SpecConfig, spec_config_key
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    addr = daemon.address
+    assert await llmctl_amain(["--runtime-server", addr, "spec",
+                               "status"]) == 1       # nothing stored yet
+    assert await llmctl_amain(["--runtime-server", addr, "spec",
+                               "set-k", "nsA", "4"]) == 0
+    assert await llmctl_amain(["--runtime-server", addr, "spec",
+                               "status"]) == 0
+    out = capsys.readouterr().out
+    assert "nsA" in out and "k=4" in out
+    rt = await DistributedRuntime.connect(addr)
+    try:
+        entry = await rt.store.kv_get(spec_config_key("nsA"))
+        assert SpecConfig.from_json(entry.value).k == 4
+        assert await llmctl_amain(["--runtime-server", addr, "spec",
+                                   "off", "nsA"]) == 0
+        entry = await rt.store.kv_get(spec_config_key("nsA"))
+        assert SpecConfig.from_json(entry.value).k == 0
+    finally:
+        await rt.shutdown()
+
+
 async def test_llmctl_deployment_max_restarts(daemon):
     """--max-restarts flows through llmctl create into the stored spec
     and is validated (the CLI leg of the per-spec CrashLoopBackOff cap)."""
