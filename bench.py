@@ -68,6 +68,103 @@ def spec_mode_k() -> int:
     return k
 
 
+def kv_disk_mode() -> bool:
+    """Disk-KV-tier bench mode (--kv-disk or BENCH_KV_DISK=1): measures
+    warm-restart TTFT vs cold (ISSUE 3). One parse home for main() and
+    the smoke tests."""
+    return (os.environ.get("BENCH_KV_DISK", "0") != "0"
+            or "--kv-disk" in sys.argv[1:])
+
+
+def run_kv_disk_bench(mcfg) -> dict:
+    """Warm-restart TTFT for the persistent disk (G3) KV tier: run one
+    request through an engine with host+disk tiers, stop it (graceful
+    stop flushes host→disk), then build a FRESH engine pointed at the
+    same --kv-disk-dir and serve the same prompt — the prefix onboards
+    from disk instead of recomputing. Reports cold vs warm TTFT, the
+    disk hit depth, and whether the warm token stream was bit-exact.
+
+    Compile noise control: ONE prefill bucket (every admission compiles
+    the same shape) and a throwaway warmup request per engine life, so
+    both measured TTFTs are steady-state scheduler+compute, not XLA
+    compile time."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import (FINISH_SENTINEL, EngineCore,
+                                        EngineRequest)
+    from dynamo_tpu.engine.sampling import SlotSampling
+
+    prompt_len = int(os.environ.get("BENCH_KV_DISK_PROMPT", "96"))
+    bs = 16
+    blocks = prompt_len // bs
+    keep_dir = os.environ.get("BENCH_KV_DISK_DIR")
+    disk_dir = keep_dir or tempfile.mkdtemp(prefix="kvdisk-bench-")
+    rng = np.random.default_rng(7)
+    prompt = [int(t) for t in rng.integers(1, mcfg.vocab_size,
+                                           size=prompt_len)]
+    warm_prompt = [int(t) for t in rng.integers(1, mcfg.vocab_size,
+                                                size=prompt_len)]
+
+    def make_core():
+        ecfg = EngineConfig(
+            max_model_len=prompt_len + 64, kv_block_size=bs,
+            num_kv_blocks=6 * (blocks + 4), max_num_seqs=2,
+            prefill_buckets=[prompt_len + 64],
+            host_kv_blocks=4 * (blocks + 2),
+            kv_disk_dir=disk_dir, kv_disk_blocks=8 * (blocks + 2))
+        return EngineCore(mcfg, ecfg, attn_impl="xla",
+                          param_dtype=jnp.float32)
+
+    async def serve(core, p, rid):
+        req = EngineRequest(rid=rid, prompt=list(p),
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=4, eos_ids=frozenset())
+        t0 = time.monotonic()
+        await core.submit(req)
+        ttft = None
+        toks = []
+        while True:
+            item, _ = await req.out_queue.get()
+            if ttft is None:
+                ttft = time.monotonic() - t0
+            if item is FINISH_SENTINEL:
+                break
+            toks.append(item)
+        return ttft, toks, req.prefix_hit_tokens
+
+    async def run_once():
+        core = make_core()
+        await serve(core, warm_prompt, "warmup")   # compile + steady state
+        ttft, toks, hit = await serve(core, prompt, "measured")
+        onboards = core.disk_onboards
+        await core.stop()                          # flushes host → disk
+        return ttft, toks, hit, onboards, len(core.disk_store)
+
+    try:
+        cold_ttft, cold_toks, cold_hit, _, spilled = asyncio.run(run_once())
+        warm_ttft, warm_toks, warm_hit, onboards, _ = asyncio.run(run_once())
+    finally:
+        if not keep_dir:
+            shutil.rmtree(disk_dir, ignore_errors=True)
+    return {
+        "prompt_len": prompt_len,
+        "cold_ttft_ms": round(cold_ttft * 1e3, 2),
+        "warm_ttft_ms": round(warm_ttft * 1e3, 2),
+        "ttft_speedup": round(cold_ttft / max(warm_ttft, 1e-9), 3),
+        "cold_hit_tokens": cold_hit,
+        "warm_hit_tokens": warm_hit,
+        "disk_blocks_after_cold": spilled,
+        "warm_restart_onboards": onboards,
+        "tokens_bit_exact": cold_toks == warm_toks,
+    }
+
+
 def run_spec_bench(core, batch, prompt_len, prompts, spec_k,
                    n_dispatch, device_time) -> dict:
     """Speculative serving measurement (ISSUE 2 satellite): drive the
@@ -714,6 +811,13 @@ def main() -> None:
         spec_res = run_spec_bench(core, batch, prompt_len, prompts,
                                   spec_k, n_dispatch, device_time)
 
+    kv_disk_res = None
+    if kv_disk_mode():
+        # independent small engine pair (same model geometry, same seed
+        # → identical weights): cold serve + graceful stop (flush), then
+        # a fresh engine warm-starting from the same disk dir
+        kv_disk_res = run_kv_disk_bench(mcfg)
+
     # device truth is the headline number; the wall loop (host scheduler
     # + tunnel round-trips) rides along in extra. The wall throughput can
     # never exceed the per-step device ceiling when both time the same
@@ -781,6 +885,9 @@ def main() -> None:
         # spec provenance rides every record of this run (BENCH_LOCAL):
         # acceptance + effective tok/s next to the baseline row
         result["spec"] = spec_res
+    if kv_disk_res is not None:
+        # disk (G3) tier provenance: warm-restart TTFT vs cold
+        result["kv_disk"] = kv_disk_res
     _record_success(result)
     print(json.dumps(result))
 

@@ -47,6 +47,26 @@ _SPEC_GAUGES = {
     "spec_accepted_total": "nv_llm_spec_accepted_tokens",
 }
 
+# KV tier ladder (host DRAM tier + persistent disk G3 tier):
+# ForwardPassMetrics field → exported metric name. The host counters
+# were previously module-local only (llm/kv/offload.py stats); now they
+# ride the same scrape as everything else, next to the disk gauges and
+# the two backpressure drop counters (offload write-back queue + disk
+# spill queue) the Grafana "KV tiers" row alerts on.
+_TIER_GAUGES = {
+    "host_stored_total": "nv_llm_kv_host_stored_blocks_total",
+    "host_evicted_total": "nv_llm_kv_host_evicted_blocks_total",
+    "host_hit_rate": "nv_llm_kv_host_hit_rate",
+    "offload_dropped_jobs_total": "nv_llm_kv_host_offload_dropped_jobs_total",
+    "disk_used_blocks": "nv_llm_kv_disk_used_blocks",
+    "disk_capacity_blocks": "nv_llm_kv_disk_capacity_blocks",
+    "disk_stored_total": "nv_llm_kv_disk_stored_blocks_total",
+    "disk_evicted_total": "nv_llm_kv_disk_evicted_blocks_total",
+    "disk_hit_rate": "nv_llm_kv_disk_hit_rate",
+    "disk_bytes_used": "nv_llm_kv_disk_bytes_used",
+    "disk_spill_dropped_total": "nv_llm_kv_disk_spill_dropped_jobs_total",
+}
+
 
 class MetricsAggregatorService:
     """Aggregates worker load + router hit-rate into one Prometheus registry.
@@ -69,6 +89,10 @@ class MetricsAggregatorService:
             f: Gauge(name, f"speculative decoding: worker {f} "
                      "(scraped stats)", labels, registry=self.registry)
             for f, name in _SPEC_GAUGES.items()}
+        self._tier_gauges: Dict[str, Gauge] = {
+            f: Gauge(name, f"KV tier ladder: worker {f} (scraped stats)",
+                     labels, registry=self.registry)
+            for f, name in _TIER_GAUGES.items()}
         self.hit_isl_blocks = Counter(
             f"{PREFIX}_hit_rate_isl_blocks_total",
             "Routing decisions: total request blocks (ISL)",
@@ -190,12 +214,15 @@ class MetricsAggregatorService:
                 self._gauges[f].labels(*lbl).set(getattr(m, f))
             for f, g in self._spec_gauges.items():
                 g.labels(*lbl).set(getattr(m, f))
+            for f, g in self._tier_gauges.items():
+                g.labels(*lbl).set(getattr(m, f))
         # drop series for workers whose leases died (the watcher pruned them)
         for gone in self._seen_workers - present:
             self.latest.pop(gone, None)
             lbl = self._labels(gone)
-            for g in list(self._gauges.values()) + list(
-                    self._spec_gauges.values()):
+            for g in (list(self._gauges.values())
+                      + list(self._spec_gauges.values())
+                      + list(self._tier_gauges.values())):
                 try:
                     g.remove(*lbl)
                 except KeyError:

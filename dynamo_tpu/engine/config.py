@@ -526,6 +526,15 @@ class EngineConfig:
     max_num_seqs: int = 8             # decode batch slots
     enable_prefix_reuse: bool = True  # match prompt blocks against the pool
     host_kv_blocks: int = 0           # host (TPU-VM DRAM) offload tier; 0 = off
+    # persistent disk (G3) KV tier (llm/kv/diskstore.py): a
+    # capacity-bounded content-addressed block store under kv_disk_dir.
+    # Host-tier evictions spill there (async write-behind, bounded queue,
+    # drop-on-backpressure); match_prefix cascades device → host → disk;
+    # acknowledged blocks survive kill -9 and warm-start the next engine
+    # pointed at the same dir. Requires host_kv_blocks > 0 (the disk tier
+    # sits UNDER the host tier — spill feeds on its evictions).
+    kv_disk_dir: str = ""
+    kv_disk_blocks: int = 0           # disk tier capacity; 0 = off
     # pace the offload pump's write-backs to this simulated d2h link
     # (GB/s); 0 = real link speed. Lets a CPU run measure the tier under a
     # realistic TPU-VM link instead of this rig's tunnel (tools/
@@ -620,6 +629,14 @@ class EngineConfig:
                 " > 1 (the pipeline defers multi-step harvests)")
         if self.spec_k < 0:
             raise ValueError("spec_k must be >= 0 (0 disables speculation)")
+        if (self.kv_disk_blocks > 0) != bool(self.kv_disk_dir):
+            raise ValueError(
+                "the disk KV tier needs BOTH kv_disk_dir and "
+                "kv_disk_blocks > 0 (set together, or neither)")
+        if self.kv_disk_blocks > 0 and self.host_kv_blocks <= 0:
+            raise ValueError(
+                "the disk KV tier sits under the host tier (spill feeds "
+                "on host evictions) — set host_kv_blocks > 0 too")
         if self.lane_prefill_max_tokens > 0 \
                 and self.decode_steps_per_dispatch <= 1:
             raise ValueError(

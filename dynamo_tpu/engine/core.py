@@ -256,22 +256,37 @@ class EngineCore:
                 self.statics = dataclasses.replace(self.statics,
                                                    cfg=model_cfg)
         self.kv_event_publisher = kv_event_publisher
-        on_stored = (kv_event_publisher.publish_stored
-                     if kv_event_publisher is not None else None)
-        on_removed = (kv_event_publisher.publish_removed
-                      if kv_event_publisher is not None else None)
         host_pool = None
         self.offload_engine = None
+        self.disk_store = None
+        self.spill_engine = None
+        self._pending_spills: List[int] = []
         if engine_cfg.host_kv_blocks > 0:
             from ..llm.kv.offload import KvOffloadEngine, make_host_pool
             host_pool = make_host_pool(
                 engine_cfg.host_kv_blocks, model_cfg,
                 engine_cfg.kv_block_size, engine_cfg.kv_quantization,
                 int(next(iter(self.kv.values())).shape[-1]), param_dtype)
+        if engine_cfg.kv_disk_blocks > 0:
+            # G3 tier (llm/kv/diskstore.py): content-addressed on-disk
+            # block store under the host pool — host evictions spill
+            # there (write-behind), disk hits promote through the
+            # off-thread onboard path, and acknowledged blocks survive
+            # kill -9 (warm restart). __post_init__ guaranteed the host
+            # tier exists.
+            from ..llm.kv.diskstore import DiskKvStore, DiskSpillEngine
+            self.disk_store = DiskKvStore(
+                engine_cfg.kv_disk_dir, engine_cfg.kv_disk_blocks,
+                expect_block_size=engine_cfg.kv_block_size)
+            self.spill_engine = DiskSpillEngine(
+                self.disk_store, on_commit=self._emit_kv_disk_store)
+            host_pool.on_evict = self._on_host_evict
         self.kv_manager = KvBlockManager(
             engine_cfg.num_kv_blocks, engine_cfg.kv_block_size,
             enable_reuse=engine_cfg.enable_prefix_reuse,
-            on_stored=on_stored, on_removed=on_removed, host_pool=host_pool)
+            on_stored=self._on_block_stored,
+            on_removed=self._on_block_removed, host_pool=host_pool,
+            disk_store=self.disk_store)
         if host_pool is not None:
             self.offload_engine = KvOffloadEngine(
                 host_pool, engine_cfg.kv_block_size,
@@ -332,6 +347,9 @@ class EngineCore:
         self.preemptions = 0
         self.lane_admissions = 0
         self.host_onboards = 0
+        # disk (G3) tier: promote-path admissions + blocks restored
+        self.disk_onboards = 0
+        self.disk_onboarded_blocks = 0
         # speculation stats (nv_llm_spec_* metrics feed)
         self.spec_dispatches = 0       # verify dispatches issued
         self.spec_drafted_tokens = 0   # draft tokens scored
@@ -504,6 +522,14 @@ class EngineCore:
                 await asyncio.wait_for(self._loop_task, timeout=5)
             except asyncio.TimeoutError:
                 self._loop_task.cancel()
+            except asyncio.CancelledError:
+                # wait_for re-raises the LOOP task's cancellation (process
+                # shutdown cancels every task) — that alone must not
+                # abort stop(): the remaining cleanup (incl. the host→
+                # disk flush) is the point of a graceful stop. Only
+                # re-raise when stop() itself was cancelled.
+                if not self._loop_task.done():
+                    raise
             except Exception:  # noqa: BLE001 — fatal loop death is a
                 # supported state (_fail_pending already failed every
                 # pending request and logged the exception); stop()'s
@@ -522,6 +548,8 @@ class EngineCore:
                 self.slots[slot] = None
                 self.kv_manager.pool.release(plan.all_blocks)
                 self.kv_manager.host_pool.unpin(plan.host_slots)
+                if plan.disk_hashes:
+                    self.disk_store.unpin(plan.disk_hashes)
                 self._finish_request(req, FinishReason.CANCELLED)
             self._onboards = []
         if self._pending is not None:     # drain the pipelined dispatch
@@ -529,6 +557,18 @@ class EngineCore:
             self._pending = None
         if self.offload_engine is not None:
             await self.offload_engine.stop()
+        if self.spill_engine is not None:
+            # graceful persist: everything still host-resident goes to
+            # disk so the next engine pointed at kv_disk_dir warm-starts
+            # with the full working set (kill -9 keeps only what the
+            # write-behind pump had already acknowledged)
+            try:
+                await asyncio.wait_for(self.flush_host_to_disk(),
+                                       timeout=30)
+            except asyncio.TimeoutError:
+                logger.warning("host→disk flush timed out on stop")
+            await self.spill_engine.stop()
+            self.disk_store.close()
 
     @property
     def wire_kv_heads(self) -> int:
@@ -674,14 +714,67 @@ class EngineCore:
         keys but not content events, so the pool re-announces them."""
         if self.kv_event_publisher is None:
             return 0
-        return self.kv_manager.pool.reannounce(
+        n = self.kv_manager.pool.reannounce(
             self.kv_event_publisher.publish_stored)
+        # disk (G3) bring-up: a warm-started store holds prefixes the
+        # device pool has never seen — announce them tier-tagged so the
+        # router's radix index can route matching prompts here for a
+        # promote instead of a cold recompute elsewhere
+        if self.disk_store is not None:
+            for h, th, ph in self.disk_store.registered_entries():
+                if not self.kv_manager.pool.peek_prefix([h]):
+                    self.kv_event_publisher.publish_stored(
+                        -1, h, th, ph, tier="disk")
+                    n += 1
+        return n
+
+    async def flush_host_to_disk(self) -> int:
+        """Persist every host-resident block to the disk tier NOW and
+        wait for the writes to be acknowledged (fsync'd manifest) — the
+        llmctl ``kv flush`` barrier, also run on graceful stop(). Returns
+        the number of blocks newly offered to the spill queue."""
+        if self.spill_engine is None:
+            return 0
+        from ..llm.kv.diskstore import SpillJob
+        host = self.kv_manager.host_pool
+        n = 0
+        for h, th, ph, slot in host.resident_entries():
+            if self.disk_store.contains(h):
+                continue
+            if self.spill_engine.offer(SpillJob(
+                    seq_hash=h, tokens_hash=th, parent_hash=ph,
+                    values=host.row_copy(slot))):
+                n += 1
+        await self.spill_engine.drain()
+        return n
 
     def metrics(self) -> ForwardPassMetrics:
         active = sum(1 for s in self.slots if s is not None)
         total_blocks = self.cfg.num_kv_blocks - 1
         used = self.kv_manager.pool.used_blocks
+        host = self.kv_manager.host_pool
+        disk = self.disk_store
+        tier_kw = {}
+        if host is not None:
+            tier_kw.update(
+                host_stored_total=host.stored_blocks_total,
+                host_evicted_total=host.evicted_blocks_total,
+                host_hit_rate=host.hit_rate())
+        if self.offload_engine is not None:
+            tier_kw.update(offload_dropped_jobs_total=self
+                           .offload_engine.dropped_jobs_total)
+        if disk is not None:
+            tier_kw.update(
+                disk_used_blocks=disk.used_blocks,
+                disk_capacity_blocks=disk.capacity,
+                disk_stored_total=disk.stored_blocks_total,
+                disk_evicted_total=disk.evicted_blocks_total,
+                disk_hit_rate=disk.hit_rate(),
+                disk_bytes_used=disk.bytes_used,
+                disk_spill_dropped_total=self
+                .spill_engine.dropped_jobs_total)
         return ForwardPassMetrics(
+            **tier_kw,
             request_active_slots=active,
             request_total_slots=self.B,
             kv_active_blocks=used,
@@ -748,6 +841,8 @@ class EngineCore:
             self.kv_manager.pool.release(plan.all_blocks)
             if self.kv_manager.host_pool is not None:
                 self.kv_manager.host_pool.unpin(plan.host_slots)
+            if plan.disk_hashes and self.disk_store is not None:
+                self.disk_store.unpin(plan.disk_hashes)
         self._onboards = []
         # clear scheduler state so nothing can be re-served even if a
         # caller pokes internals
@@ -815,14 +910,15 @@ class EngineCore:
             # longer than a block table row — reject rather than overflow
             # the table (external prompts are length-checked upstream, but
             # preemption-grown prompts and misconfigured callers land here)
-            self.kv_manager.pool.release(plan.all_blocks)
+            self.kv_manager.abort_plan(plan)
             self._finish_request(req, FinishReason.LENGTH)
             return True
-        if plan.host_slots:
-            # host-tier hits: the wire→block-major copies are pure numpy —
-            # run them OFF the loop (reference overlaps its tier copies
-            # with compute via CopyStream, kv/layer.rs; our analog is a
-            # thread + deferred admission) and finish admitting when ready
+        if plan.host_slots or plan.disk_hashes:
+            # host/disk-tier hits: the wire→block-major copies (and the
+            # disk file reads) are pure host work — run them OFF the loop
+            # (reference overlaps its tier copies with compute via
+            # CopyStream, kv/layer.rs; our analog is a thread + deferred
+            # admission) and finish admitting when ready
             self._start_onboard(req, slot, plan)
             return True
         return self._admit_with_plan(req, slot, plan, None)
@@ -832,30 +928,130 @@ class EngineCore:
         followers AND the offline replayer mirror the store (gathering
         the same device blocks from their own bit-identical KV), making
         host-tier restores replayable in both
-        (replay.exec_kv_store_event)."""
+        (replay.exec_kv_store_event). ``spills`` lists the evicted
+        hashes this batch's host evictions handed to the disk spill
+        queue (the enqueue-accept decision, made synchronously inside
+        host_pool.store via _on_host_evict) — followers stage a copy of
+        exactly those rows so the later "kv_disk_store" commit can apply
+        the leader's literal placements from bit-identical bytes."""
+        spills, self._pending_spills = self._pending_spills, []
         if self.recorder is not None:
-            self.recorder.rec("kv_store", items=items)
+            self.recorder.rec("kv_store", items=items, spills=spills)
+
+    # ------------------------------------------------------- disk (G3) tier
+    def _on_host_evict(self, seq_hash: int, tokens_hash, parent_hash,
+                       values: dict) -> None:
+        """Host-pool eviction hook (fires on the loop, inside the offload
+        pump's store, with a fresh copy of the arena row): offer the
+        block to the disk spill queue — async write-behind, never
+        stalling the loop; saturation drops with a counter."""
+        from ..llm.kv.diskstore import SpillJob
+        accepted = self.spill_engine.offer(SpillJob(
+            seq_hash=seq_hash, tokens_hash=tokens_hash,
+            parent_hash=parent_hash, values=values))
+        if accepted:
+            self._pending_spills.append(seq_hash)
+
+    def _emit_kv_disk_store(self, items: list) -> None:
+        """Spill-pump commit hook: [(hash, tokens_hash, parent, evicted)]
+        per durably-acknowledged disk put. Streams the literal placement
+        decisions to multihost followers (replay.exec_kv_disk_store_event
+        applies them from the staged row copies) and announces the
+        spilled prefixes to the router's radix index with a "disk" tier
+        tag — unless the hash is still device-registered (its device
+        announce stands at full weight)."""
+        if self.recorder is not None:
+            self.recorder.rec("kv_disk_store", items=items)
+        pub = self.kv_event_publisher
+        if pub is None:
+            return
+        for h, th, ph, evicted in items:
+            for gone in evicted:
+                self._publish_tier_removed(gone)
+            if not self.kv_manager.pool.peek_prefix([h]):
+                pub.publish_stored(-1, h, th, ph, tier="disk")
+
+    def _publish_tier_removed(self, seq_hash: int) -> None:
+        """Removed-from-disk announce, suppressed while any warmer tier
+        still holds the hash (the router would otherwise lose a prefix
+        this worker can still serve)."""
+        pub = self.kv_event_publisher
+        if pub is None:
+            return
+        host = self.kv_manager.host_pool
+        if self.kv_manager.pool.peek_prefix([seq_hash]):
+            return
+        if host is not None and host.contains(seq_hash):
+            return
+        pub.publish_removed([seq_hash])
+
+    def _on_block_stored(self, bid: int, seq_hash: int, tokens_hash: int,
+                         parent_hash) -> None:
+        """Device-pool stored hook → tier-tagged router event (default
+        tier "device")."""
+        if self.kv_event_publisher is not None:
+            self.kv_event_publisher.publish_stored(
+                bid, seq_hash, tokens_hash, parent_hash)
+
+    def _on_block_removed(self, seq_hashes: list) -> None:
+        """Device-pool removed hook. A hash still resident in a colder
+        tier is DEMOTED (re-announced with the tier tag) instead of
+        removed — the router's radix index keeps the prefix visible at a
+        discounted depth (kv_router/scoring.py TIER_WEIGHTS) rather than
+        forgetting this worker can still serve it without recompute."""
+        pub = self.kv_event_publisher
+        if pub is None:
+            return
+        host = self.kv_manager.host_pool
+        gone = []
+        for h in seq_hashes:
+            if host is not None and host.contains(h):
+                th, ph = host.meta_for(h)
+                pub.publish_stored(-1, h, th, ph, tier="host")
+            elif self.disk_store is not None and self.disk_store.contains(h):
+                pub.publish_stored(-1, h, None, None, tier="disk")
+            else:
+                gone.append(h)
+        if gone:
+            pub.publish_removed(gone)
 
     def _start_onboard(self, req: EngineRequest, slot: int, plan) -> None:
-        """Reserve the slot, then prepare the host-tier values off-thread;
-        the loop's onboard step completes the admission (the decode batch
-        keeps stepping during the copies)."""
+        """Reserve the slot, then prepare the host/disk-tier values
+        off-thread; the loop's onboard step completes the admission (the
+        decode batch keeps stepping during the copies). Disk hits promote
+        through the SAME path — the tier-2 analog of the CopyStream
+        overlap the host tier already implements; the matched disk
+        entries were pinned at match time (prepare_prefill) and unpin in
+        _complete_onboards."""
         req.slot = slot
         req.ready = False
         self.slots[slot] = req            # reserve (skipped by dispatch)
         self.host_onboards += 1
+        if plan.disk_hashes:
+            self.disk_onboards += 1
+            self.disk_onboarded_blocks += len(plan.disk_hashes)
         host_pool = self.kv_manager.host_pool
+        disk = self.disk_store
         host_pool.pin(plan.host_slots)    # offload stores must not evict
 
         async def prepare() -> None:
             prepped = None
             try:
-                targets = plan.new_blocks[:len(plan.host_slots)]
+                n_onboard = len(plan.host_slots) + len(plan.disk_hashes)
+                targets = plan.new_blocks[:n_onboard]
 
                 def prep():
                     from .block_copy import prep_host_values
-                    return prep_host_values(targets,
-                                            host_pool.fetch(plan.host_slots))
+                    parts = []
+                    if plan.host_slots:
+                        parts.append(host_pool.fetch(plan.host_slots))
+                    if plan.disk_hashes:
+                        parts.append(disk.fetch(plan.disk_hashes))
+                    vals = (parts[0] if len(parts) == 1 else
+                            {k: np.concatenate([p[k] for p in parts],
+                                               axis=2)
+                             for k in parts[0]})
+                    return prep_host_values(targets, vals)
 
                 prepped = await asyncio.to_thread(prep)
             except asyncio.CancelledError:
@@ -894,6 +1090,8 @@ class EngineCore:
                 # _start_onboard pinned these; safe to evict only now
                 # that hit_transfer (if any) is on the stream
                 self.kv_manager.host_pool.unpin(plan.host_slots)
+                if plan.disk_hashes:
+                    self.disk_store.unpin(plan.disk_hashes)
 
     def _admit_with_plan(self, req: EngineRequest, slot: int, plan,
                          onboard) -> bool:
@@ -905,12 +1103,13 @@ class EngineCore:
         # into their device slots before prefill (reference
         # prepare_prefill_offload; the +40% TTFT multi-turn win,
         # docs/architecture.md:91)
-        if plan.host_slots:
+        n_onboard = len(plan.host_slots) + len(plan.disk_hashes)
+        if n_onboard:
             from .block_copy import scatter_prepped
             ids, vals = onboard
             self.kv = scatter_prepped(self.kv, ids, vals,
                                       self.cfg.kv_block_size)
-            targets = plan.new_blocks[:len(plan.host_slots)]
+            targets = plan.new_blocks[:n_onboard]
             # onboarded blocks now hold valid registered content
             n_dev = len(plan.hit_blocks)
             for i, bid in enumerate(targets):
@@ -919,24 +1118,32 @@ class EngineCore:
                 self.kv_manager.pool.register(
                     bid, plan.seq.sequence_hashes[j],
                     plan.seq.block_hashes[j], parent)
-        req.prefix_hit_tokens = plan.hit_tokens + plan.host_hit_tokens
-        n_already = len(plan.hit_blocks) + len(plan.host_slots)
+        req.prefix_hit_tokens = (plan.hit_tokens + plan.host_hit_tokens
+                                 + plan.disk_hit_tokens)
+        n_already = len(plan.hit_blocks) + n_onboard
         if self.recorder is not None and req.prefix_hit_tokens > 0:
             # before the prefill record: read rights over the shared
             # prefix. host_hit + host_slots/targets let multihost
             # followers and the offline replayer re-execute the h2d
             # restore above from their mirror pools
-            # (replay.exec_host_restore_event)
+            # (replay.exec_host_restore_event); disk_hashes/disk_targets
+            # do the same for the G3 promote (the follower fetches the
+            # hashes from its own mirror disk store)
+            n_host = len(plan.host_slots)
             self.recorder.rec("hit_transfer", rid=req.rid,
                               hit=req.prefix_hit_tokens,
                               host_hit=plan.host_hit_tokens,
+                              disk_hit=plan.disk_hit_tokens,
                               blocks=list(plan.all_blocks),
                               # multihost followers replay the h2d restore
                               # from their mirror pool at these slots into
                               # these device blocks (run_follower)
                               host_slots=list(plan.host_slots),
                               host_targets=list(
-                                  plan.new_blocks[:len(plan.host_slots)]))
+                                  plan.new_blocks[:n_host]),
+                              disk_hashes=list(plan.disk_hashes),
+                              disk_targets=list(
+                                  plan.new_blocks[n_host:n_onboard]))
         t0 = time.monotonic()
         suffix_len = n_prompt - req.prefix_hit_tokens
         if (self.cfg.lane_prefill_max_tokens > 0
@@ -1076,9 +1283,9 @@ class EngineCore:
         self._samp["top_p"][slot] = req.sampling.top_p
         self._seeds[slot] = req.sampling.seed
         logger.debug(
-            "admitted %s into slot %d (prompt=%d, hit=%d+%dhost, remote=%s, "
-            "%.1fms)", req.rid, slot, n_prompt, plan.hit_tokens,
-            plan.host_hit_tokens, remote_admit,
+            "admitted %s into slot %d (prompt=%d, hit=%d+%dhost+%ddisk, "
+            "remote=%s, %.1fms)", req.rid, slot, n_prompt, plan.hit_tokens,
+            plan.host_hit_tokens, plan.disk_hit_tokens, remote_admit,
             1e3 * (time.monotonic() - t0))
         if req.ready:
             self._emit(req, tok, float(logprob))
@@ -1910,7 +2117,8 @@ class EngineCore:
             self.kv_manager.pool.hold(pinned)
             self.offload_engine.enqueue(OffloadJob(
                 block_ids=list(pinned),
-                seq_hashes=list(req.seq.sequence_hashes[:n])))
+                seq_hashes=list(req.seq.sequence_hashes[:n]),
+                tokens_hashes=list(req.seq.block_hashes[:n])))
         if self.recorder is not None and req.blocks:
             self.recorder.rec("release", rid=req.rid,
                               blocks=list(req.blocks))

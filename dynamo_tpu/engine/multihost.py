@@ -55,6 +55,18 @@ decisions verbatim (HostKvPool.apply_store) — arena bytes equal by
 induction, no bulk KV on the wire. A host-restored admission then
 replays h2d locally: "hit_transfer" carries the mirror slots + device
 targets and the follower runs the same scatter program the leader ran.
+
+The disk (G3) tier extends the same contract one rung down: each
+"kv_store" event additionally names the evicted hashes the leader's
+disk spill queue ACCEPTED ("spills" — the enqueue decision, made
+synchronously inside the pool store); the follower stages a copy of
+exactly those rows from its mirror arena before the eviction overwrites
+them. The spill pump's later durable commit streams "kv_disk_store"
+(hash + the leader's literal disk-eviction set) and the follower applies
+it verbatim to its OWN local disk store from the staged bytes
+(DiskKvStore.apply_put — no LRU policy re-run, no bulk KV on the wire).
+A disk-promoted admission rides "hit_transfer"'s disk_hashes/
+disk_targets, restored from the follower's mirror disk store.
 """
 
 from __future__ import annotations
@@ -80,8 +92,9 @@ __all__ = ["DispatchStreamLeader", "connect_follower", "run_follower"]
 # host bookkeeping
 WIRE_EVENTS = frozenset(
     {"prefill", "prefill_sp", "dispatch", "hit_transfer",
-     "kv_store", "precomputed_admit", "precomputed_device_admit",
-     "handoff_gather", "prefill_unsupported"})
+     "kv_store", "kv_disk_store", "precomputed_admit",
+     "precomputed_device_admit", "handoff_gather",
+     "prefill_unsupported"})
 _SHUTDOWN = {"ev": "__shutdown__"}
 
 _LEN = struct.Struct(">I")
@@ -147,6 +160,14 @@ class DispatchStreamLeader(Recorder):
             raise ValueError(
                 "attach the dispatch stream before the engine offloads "
                 f"anything (host pool already holds {len(pool)} blocks)")
+        if core.disk_store is not None and len(core.disk_store) > 0:
+            # same staleness hazard one tier down: a warm-started disk
+            # store holds blocks no follower can prove it mirrors
+            raise ValueError(
+                "multihost serving cannot start from a warm disk KV "
+                f"store ({len(core.disk_store)} blocks at "
+                f"{core.disk_store.root}) — clear it (llmctl kv flush "
+                f"--clear) or point --kv-disk-dir at a fresh directory")
         core.recorder = self
 
     def wait_for_followers(self) -> None:
@@ -228,10 +249,17 @@ def run_follower(core, sock: socket.socket,
     carry (``core.kv``) and a bounded chain window.
     """
     from .replay import (exec_dispatch_event, exec_host_restore_event,
-                         exec_kv_store_event, exec_prefill_event,
-                         exec_sp_prefill_event, exec_verify_event)
+                         exec_kv_disk_store_event, exec_kv_store_event,
+                         exec_prefill_event, exec_sp_prefill_event,
+                         exec_verify_event)
 
     disp_toks: "OrderedDict[int, object]" = OrderedDict()
+    # disk-tier staging: evicted-row copies taken at kv_store replay for
+    # the hashes the leader's spill queue accepted, consumed by the
+    # matching kv_disk_store commit. Bounded: a leader-side disk-write
+    # failure orphans its staged rows, and an unbounded dict would leak.
+    spill_stage: "OrderedDict[int, dict]" = OrderedDict()
+    MAX_STAGE = 1024
     stats = {"prefills": 0, "dispatches": 0, "kv_stores": 0,
              "host_restores": 0}
 
@@ -316,22 +344,43 @@ def run_follower(core, sock: socket.socket,
                     "leader streams host-KV-tier stores but this follower "
                     "was built with host_kv_blocks=0 — ranks must share "
                     "one engine config")
-            exec_kv_store_event(core.kv, ev, pool, core.cfg.kv_block_size)
+            exec_kv_store_event(core.kv, ev, pool, core.cfg.kv_block_size,
+                                spill_stage=spill_stage)
+            while len(spill_stage) > MAX_STAGE:
+                spill_stage.popitem(last=False)
             stats["kv_stores"] += 1
             continue
+        if kind == "kv_disk_store":
+            # mirror the leader's disk-tier spill commit: literal
+            # placements, bytes from the staged row copies (or the host
+            # mirror, for flush-driven spills) — shared with the offline
+            # replayer (replay.exec_kv_disk_store_event)
+            if core.disk_store is None:
+                raise ValueError(
+                    "leader streams disk-tier stores but this follower "
+                    "was built with kv_disk_blocks=0 — ranks must share "
+                    "one engine config (kv_disk_dir is per-rank local)")
+            exec_kv_disk_store_event(ev, core.disk_store,
+                                     core.kv_manager.host_pool,
+                                     spill_stage)
+            stats["kv_disk_stores"] = stats.get("kv_disk_stores", 0) + 1
+            continue
         if kind == "hit_transfer":
-            if int(ev.get("host_hit", 0)) > 0:
-                # replay the leader's h2d restore from the mirror pool —
+            if (int(ev.get("host_hit", 0)) > 0
+                    or int(ev.get("disk_hit", 0)) > 0):
+                # replay the leader's h2d restore from the mirror tiers —
                 # shared with the offline replayer
                 # (replay.exec_host_restore_event)
                 pool = core.kv_manager.host_pool
-                if pool is None or pool._arena is None:
+                if int(ev.get("host_hit", 0)) > 0 and (
+                        pool is None or pool._arena is None):
                     raise ValueError(
                         "host restore references slots this follower "
                         "never mirrored (no kv_store seen) — the leader "
                         "must attach the stream before any offloads")
-                core.kv = exec_host_restore_event(core.kv, ev, pool,
-                                                  core.cfg.kv_block_size)
+                core.kv = exec_host_restore_event(
+                    core.kv, ev, pool, core.cfg.kv_block_size,
+                    disk_store=core.disk_store)
                 stats["host_restores"] += 1
             continue   # device-hit-only: prefix hits reuse resident KV
         if kind == "prefill":

@@ -87,31 +87,83 @@ def exec_sp_prefill_event(core, kv, ev: dict):
     return _exec_prefill(core, kv, ev, sp=True)
 
 
-def exec_kv_store_event(kv, ev: dict, pool, block_size: int) -> None:
+def exec_kv_store_event(kv, ev: dict, pool, block_size: int,
+                        spill_stage: Optional[dict] = None) -> None:
     """Mirror one of the leader's offload commits: gather the SAME device
     blocks from ``kv`` (bit-identical by the replay/stream induction) and
     apply the literal hash→slot placements to ``pool``. Single home of
     the kv_store event, shared by the offline replayer and the live
-    multihost follower (engine/multihost.py)."""
+    multihost follower (engine/multihost.py).
+
+    ``spill_stage``: when the leader runs a disk (G3) tier, the event's
+    ``spills`` list names the evicted hashes its spill queue accepted —
+    stage a copy of each such row (read from the mirror arena BEFORE the
+    eviction overwrites it) keyed by hash, so the later "kv_disk_store"
+    commit can apply the leader's literal disk placements from
+    bit-identical bytes (exec_kv_disk_store_event)."""
     from .block_copy import gather_blocks_to_host
 
+    spills = set(ev.get("spills") or ())
     ids = [int(it[3]) for it in ev["items"]]
     values = gather_blocks_to_host(kv, ids, block_size, pool.num_kv_heads)
     for i, (h, hslot, evicted, _bid) in enumerate(ev["items"]):
+        if (spill_stage is not None and evicted is not None
+                and evicted in spills):
+            vslot = pool._by_hash.get(evicted)
+            if vslot is not None and pool._arena is not None:
+                spill_stage[evicted] = pool.row_copy(vslot)
         pool.apply_store(h, hslot, evicted,
                          {key: arr[:, :, i]
                           for key, arr in values.items()})
 
 
-def exec_host_restore_event(kv, ev: dict, pool, block_size: int):
-    """Re-execute a host-tier h2d restore from the mirror ``pool``: same
-    slots, same device targets, same scatter program as the leader's
-    admission. Single home of the hit_transfer host path (see
-    exec_kv_store_event). Returns the new kv."""
+def exec_kv_disk_store_event(ev: dict, disk_store, pool,
+                             spill_stage: dict) -> None:
+    """Apply one of the leader's disk-tier spill commits to a mirror
+    store: literal placements (hash + the leader's eviction set), bytes
+    from the staged row copy (eviction-driven spills) or straight from
+    the host mirror arena (flush-driven spills — the row is still
+    resident there). Never re-runs the LRU policy. Shared by the offline
+    replayer and the live multihost follower."""
+    for h, th, ph, evicted in ev["items"]:
+        values = spill_stage.pop(h, None)
+        if values is None:
+            slot = pool._by_hash.get(h) if pool is not None else None
+            if slot is None:
+                raise ValueError(
+                    f"kv_disk_store for hash {h:#x} has no staged row "
+                    f"copy and no host-mirror residence — the leader's "
+                    f"kv_store spills list and this mirror diverged")
+            values = pool.row_copy(slot)
+        disk_store.apply_put(h, list(evicted), values,
+                             tokens_hash=th, parent_hash=ph)
+
+
+def exec_host_restore_event(kv, ev: dict, pool, block_size: int,
+                            disk_store=None):
+    """Re-execute a host/disk-tier h2d restore from the mirror tiers:
+    same slots/hashes, same device targets, same scatter program as the
+    leader's admission. Single home of the hit_transfer restore path
+    (see exec_kv_store_event). Returns the new kv."""
     from .block_copy import prep_host_values, scatter_prepped
 
-    ids, vals = prep_host_values(list(ev["host_targets"]),
-                                 pool.fetch(list(ev["host_slots"])))
+    parts = []
+    targets: list = []
+    if ev.get("host_slots"):
+        parts.append(pool.fetch(list(ev["host_slots"])))
+        targets += list(ev["host_targets"])
+    if ev.get("disk_hashes"):
+        if disk_store is None:
+            raise ValueError(
+                "hit_transfer references disk-tier hashes but no mirror "
+                "disk store was provided — replay with the recorded "
+                "engine config (kv_disk_dir/kv_disk_blocks)")
+        parts.append(disk_store.fetch(list(ev["disk_hashes"])))
+        targets += list(ev["disk_targets"])
+    vals = (parts[0] if len(parts) == 1 else
+            {k: np.concatenate([p[k] for p in parts], axis=2)
+             for k in parts[0]})
+    ids, vals = prep_host_values(targets, vals)
     return scatter_prepped(kv, ids, vals, block_size)
 
 
@@ -164,6 +216,31 @@ def exec_verify_event(core, kv, ev: dict):
     return toks, kv
 
 
+class _MemDiskMirror:
+    """In-memory stand-in for DiskKvStore during offline replay (the
+    replayer applies the leader's literal disk placements; durability is
+    the live store's concern, not the replay's): apply_put / fetch /
+    contains with the same signatures."""
+
+    def __init__(self) -> None:
+        self._blocks: Dict[int, dict] = {}
+
+    def apply_put(self, h, evicted, values, tokens_hash=None,
+                  parent_hash=None) -> None:
+        for e in evicted:
+            self._blocks.pop(e, None)
+        self._blocks[h] = values
+
+    def contains(self, h) -> bool:
+        return h in self._blocks
+
+    def fetch(self, hashes) -> dict:
+        blocks = [self._blocks[h] for h in hashes]
+        return {k: np.ascontiguousarray(
+                    np.stack([b[k] for b in blocks], axis=2))
+                for k in blocks[0]}
+
+
 def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
     """Re-execute the recorded schedule against a fresh KV cache, strictly
     synchronously. `core` supplies params and compiled jits (its own KV is
@@ -184,6 +261,8 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
     out = {"prefill": {}, "dispatch": {}, "verify": {},
            "fingerprints": []}
     disp_toks: Dict[int, object] = {}
+    disk_mirror = None     # disk (G3) mirror, built from kv_disk_store
+    spill_stage: Dict[int, dict] = {}   # hash → staged evicted-row copy
     mirror = None          # host-tier mirror pool, built from kv_store
     # events exactly like a multihost follower's (engine/multihost.py):
     # gather the SAME blocks from the replay KV, apply literal placements
@@ -256,10 +335,34 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                             f"writer — its content predates the "
                             f"recording; start recording before any "
                             f"blocks are stored")
-            exec_kv_store_event(kv, ev, mirror, bs)
+            exec_kv_store_event(kv, ev, mirror, bs,
+                                spill_stage=spill_stage)
             mirrored_slots.update(int(it[1]) for it in ev["items"])
+        if kind == "kv_disk_store":
+            # the leader's spill-pump commit: apply its literal disk
+            # placements from the rows staged at the kv_store eviction
+            # (or still host-mirror-resident, for flush-driven spills)
+            if disk_mirror is None:
+                disk_mirror = _MemDiskMirror()
+            exec_kv_disk_store_event(ev, disk_mirror, mirror, spill_stage)
         if kind == "hit_transfer" and int(ev.get("hit", 0)) > 0:
-            if int(ev.get("host_hit", 0)) > 0:
+            if int(ev.get("disk_hit", 0)) > 0:
+                if disk_mirror is None:
+                    raise NotImplementedError(
+                        f"disk-restored hit for rid={ev.get('rid')} "
+                        f"references disk blocks with no in-log "
+                        f"kv_disk_store — those spills happened before "
+                        f"recording began")
+                # handles the combined case too (host_slots may be
+                # non-empty alongside the disk hashes)
+                kv = exec_host_restore_event(kv, ev, mirror, bs,
+                                             disk_store=disk_mirror)
+                written.update(int(b) * bs + o
+                               for b in (list(ev.get("host_targets") or [])
+                                         + list(ev["disk_targets"]))
+                               for o in range(bs))
+                fp(("disk_restore", ev.get("rid")))
+            elif int(ev.get("host_hit", 0)) > 0:
                 # host-tier hit: replay the h2d restore from the mirror
                 # (exactly the follower's path); the restored target
                 # blocks gain an in-log writer for the check below
@@ -356,6 +459,10 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                     int(tables[i, p // bs]) * bs + p % bs
                     for p in range(p0, p0 + int(n_rows[i])))
             fp(("verify", ev["id"]))
+    # expose the mirror tiers: follower-equivalence tests compare their
+    # contents against the live engine's pools bit-for-bit
+    out["host_mirror"] = mirror
+    out["disk_mirror"] = disk_mirror
     return out
 
 

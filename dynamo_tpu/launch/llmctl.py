@@ -72,6 +72,21 @@ def build_parser() -> argparse.ArgumentParser:
                                        "(equivalent to set-k 0)")
     spo.add_argument("namespace")
 
+    kv = sub.add_parser("kv", help="KV tier admin (host/disk ladder; "
+                                   "llm/kv/admin.py)")
+    kvsub = kv.add_subparsers(dest="kv_cmd", required=True)
+    kvs = kvsub.add_parser("status", help="show per-namespace host/disk "
+                                          "tier occupancy and hit rates")
+    kvs.add_argument("namespace", nargs="?",
+                     help="limit to one namespace (default: all)")
+    kvf = kvsub.add_parser("flush", help="persist host-resident KV to "
+                                         "the disk tier NOW (the "
+                                         "pre-restart barrier)")
+    kvf.add_argument("namespace")
+    kvf.add_argument("--clear", action="store_true",
+                     help="drop the disk cache instead of persisting "
+                          "into it")
+
     dep = sub.add_parser("deployment",
                          help="manage graph deployments (deploy/ control "
                               "plane — the api-server CRUD over the store)")
@@ -130,6 +145,8 @@ async def amain(argv=None) -> int:
             return await _planner_cmd(runtime, args)
         elif args.cmd == "spec":
             return await _spec_cmd(runtime, args)
+        elif args.cmd == "kv":
+            return await _kv_cmd(runtime, args)
         elif args.cmd == "deployment":
             return await _deployment_cmd(runtime, args)
         return 0
@@ -223,6 +240,53 @@ async def _spec_cmd(runtime, args) -> int:
                                SpecConfig(k=k).to_json())
     print(f"speculation for {args.namespace} → "
           f"{'off' if k == 0 else f'k={k}'}")
+    return 0
+
+
+async def _kv_cmd(runtime, args) -> int:
+    """KV tier admin over the kvtier/* keys (llm/kv/admin.py): workers
+    publish status snapshots and watch the control key; flush makes them
+    persist host-resident blocks into the disk (G3) tier — the barrier
+    to run before a planned restart so the warm start is complete."""
+    import json
+    import time
+
+    from ..llm.kv.admin import (KV_PREFIX, KvTierStatus, kv_control_key,
+                                kv_status_key)
+
+    if args.kv_cmd == "status":
+        prefix = (kv_status_key(args.namespace)
+                  if args.namespace else f"{KV_PREFIX}status/")
+        entries = await runtime.store.kv_get_prefix(prefix)
+        if not entries:
+            print("(no kv tier status published)")
+            return 1
+        for e in sorted(entries, key=lambda x: x.key):
+            try:
+                s = KvTierStatus.from_json(e.value)
+            except (ValueError, KeyError):
+                print(f"{e.key}  (malformed status)")
+                continue
+            print(f"namespace {s.namespace}")
+            print(f"  host:  {s.host_blocks}/{s.host_capacity} blocks  "
+                  f"hit_rate={s.host_hit_rate:.3f}  "
+                  f"offload_dropped={s.offload_dropped}")
+            if s.disk_capacity:
+                print(f"  disk:  {s.disk_blocks}/{s.disk_capacity} blocks "
+                      f"({s.disk_bytes / 1e6:.1f} MB)  "
+                      f"hit_rate={s.disk_hit_rate:.3f}  "
+                      f"spill_dropped={s.spill_dropped}  "
+                      f"onboards={s.disk_onboards}  dir={s.disk_dir}")
+            else:
+                print("  disk:  (tier off)")
+        return 0
+    # flush [--clear]
+    await runtime.store.kv_put(
+        kv_control_key(args.namespace),
+        json.dumps({"flush": time.time(),
+                    "clear": bool(args.clear)}).encode())
+    print(f"kv {'clear' if args.clear else 'flush'} requested for "
+          f"{args.namespace}")
     return 0
 
 

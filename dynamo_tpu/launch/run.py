@@ -78,6 +78,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-num-seqs", type=int, default=8)
     p.add_argument("--host-kv-blocks", type=int, default=0,
                    help="host (TPU-VM DRAM) KV offload tier size")
+    p.add_argument("--kv-disk-dir", default="",
+                   help="persistent disk (G3) KV tier directory "
+                        "(llm/kv/diskstore.py): host-tier evictions "
+                        "spill here and a restarted engine pointed at "
+                        "the same dir warm-starts from the previous "
+                        "run's cache; needs --kv-disk-blocks and "
+                        "--host-kv-blocks")
+    p.add_argument("--kv-disk-blocks", type=int, default=0,
+                   help="disk KV tier capacity in blocks (0 = off)")
     p.add_argument("--no-prefix-reuse", action="store_true")
     p.add_argument("--kv-quantization",
                    choices=["none", "int8"], default="none",
@@ -170,6 +179,8 @@ def engine_config(args):
         max_num_seqs=args.max_num_seqs,
         enable_prefix_reuse=not args.no_prefix_reuse,
         host_kv_blocks=args.host_kv_blocks,
+        kv_disk_dir=args.kv_disk_dir,
+        kv_disk_blocks=args.kv_disk_blocks,
         prefill_chunk=args.prefill_chunk,
         decode_steps_per_dispatch=args.decode_steps_per_dispatch,
         decode_dispatch_pipeline=args.decode_dispatch_pipeline,
@@ -399,6 +410,7 @@ async def run_worker_endpoint(args, engine, pipeline, core, runtime,
         stats_handler = lambda: core.metrics().to_dict()  # noqa: E731
         await _wire_kv_events(core, runtime, endpoint)
         await _wire_spec_config(core, runtime, endpoint.namespace)
+        _wire_kv_admin(core, runtime, endpoint.namespace)
     if args.protocol == "tokens":
         if mdc is None:
             raise SystemExit(
@@ -440,8 +452,22 @@ async def _wire_kv_events(core, runtime, endpoint) -> None:
 
     pub = KvEventPublisher(worker_id=lease.id, sink=sink)
     core.kv_event_publisher = pub
-    core.kv_manager.pool.on_stored = pub.publish_stored
-    core.kv_manager.pool.on_removed = pub.publish_removed
+    # route pool events through the core's tier-aware wrappers: a device
+    # eviction whose hash survives in the host/disk tier DEMOTES the
+    # announce (tier-tagged re-store) instead of removing it, and disk
+    # spills/evictions announce with tier="disk"
+    core.kv_manager.pool.on_stored = core._on_block_stored
+    core.kv_manager.pool.on_removed = core._on_block_removed
+
+    if core.disk_store is not None and len(core.disk_store) > 0:
+        # warm-started disk tier: announce the recovered prefixes so the
+        # router's radix index routes matching prompts here for a
+        # promote instead of a cold recompute elsewhere (the same
+        # reannounce() hook the lease-reclaim recovery uses)
+        n = core.reannounce_kv()
+        logger.info("announced %d KV blocks at bring-up (%d disk-"
+                    "resident from the previous run)", n,
+                    len(core.disk_store))
 
     # transient lease expiry → reclaim replays discovery keys but the
     # router's radix index of OUR blocks was wiped by the DELETE events;
@@ -497,6 +523,21 @@ async def _wire_spec_config(core, runtime, namespace: str) -> None:
 
     asyncio.get_running_loop().create_task(watch_loop(),
                                            name="spec-config-watch")
+
+
+def _wire_kv_admin(core, runtime, namespace: str) -> None:
+    """llmctl kv {status,flush} plumbing (llm/kv/admin.py): publish this
+    worker's tier snapshot and act on flush/clear commands. Wired only
+    when any offload tier exists — a pure-HBM engine has nothing to
+    report or flush."""
+    if core.kv_manager.host_pool is None and core.disk_store is None:
+        return
+    from ..llm.kv.admin import publish_status_loop, watch_control_loop
+    loop = asyncio.get_running_loop()
+    loop.create_task(publish_status_loop(core, runtime, namespace),
+                     name="kv-admin-status")
+    loop.create_task(watch_control_loop(core, runtime, namespace),
+                     name="kv-admin-control")
 
 
 async def run_prefill_worker(args, core, runtime) -> None:
@@ -588,7 +629,16 @@ async def amain(argv=None) -> None:
             raise SystemExit(f"unknown in= source {src!r}")
     finally:
         if 'core' in locals() and core is not None:
-            await core.stop()
+            try:
+                await core.stop()
+            except asyncio.CancelledError:
+                # SIGINT: asyncio.run cancelled amain and the cancel
+                # landed at stop()'s first await — finish the graceful
+                # stop anyway (it flushes the host KV tier to the disk
+                # store; losing it would turn every Ctrl-C restart into
+                # a partially-cold start), then let the cancel proceed
+                await core.stop()
+                raise
         if stream is not None:
             stream.close()   # followers get __shutdown__, exit cleanly
         await runtime.shutdown()

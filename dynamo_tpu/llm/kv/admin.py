@@ -1,0 +1,144 @@
+"""KV tier admin surface: `llmctl kv {status,flush}` over the runtime KV
+store (the planner/spec admin pattern, llm/slo.py / engine/spec/admin.py).
+
+Workers publish a :class:`KvTierStatus` snapshot under
+``kvtier/status/{namespace}`` every few seconds and watch
+``kvtier/control/{namespace}`` for flush commands; `llmctl kv status`
+reads the snapshots, `llmctl kv flush` writes a control nonce that makes
+every watching worker persist its host-resident blocks to the disk (G3)
+tier NOW (EngineCore.flush_host_to_disk — the pre-restart barrier), or
+with ``--clear`` drop the disk cache instead."""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import time
+from typing import Optional
+
+logger = logging.getLogger("dynamo_tpu.kv.admin")
+
+KV_PREFIX = "kvtier/"
+
+
+def kv_status_key(namespace: str) -> str:
+    return f"{KV_PREFIX}status/{namespace}"
+
+
+def kv_control_key(namespace: str) -> str:
+    return f"{KV_PREFIX}control/{namespace}"
+
+
+@dataclasses.dataclass
+class KvTierStatus:
+    """One worker's KV-ladder snapshot (the llmctl kv status payload)."""
+
+    namespace: str = ""
+    host_blocks: int = 0
+    host_capacity: int = 0
+    host_hit_rate: float = 0.0
+    disk_dir: str = ""
+    disk_blocks: int = 0
+    disk_capacity: int = 0
+    disk_hit_rate: float = 0.0
+    disk_bytes: int = 0
+    spill_dropped: int = 0
+    offload_dropped: int = 0
+    disk_onboards: int = 0
+    updated_at: float = 0.0
+
+    def to_json(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "KvTierStatus":
+        d = json.loads(raw)
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+def snapshot(core, namespace: str) -> KvTierStatus:
+    """Current tier state of one EngineCore."""
+    host = core.kv_manager.host_pool
+    disk = core.disk_store
+    return KvTierStatus(
+        namespace=namespace,
+        host_blocks=len(host) if host is not None else 0,
+        host_capacity=host.capacity if host is not None else 0,
+        host_hit_rate=host.hit_rate() if host is not None else 0.0,
+        disk_dir=disk.root if disk is not None else "",
+        disk_blocks=disk.used_blocks if disk is not None else 0,
+        disk_capacity=disk.capacity if disk is not None else 0,
+        disk_hit_rate=disk.hit_rate() if disk is not None else 0.0,
+        disk_bytes=disk.bytes_used if disk is not None else 0,
+        spill_dropped=(core.spill_engine.dropped_jobs_total
+                       if core.spill_engine is not None else 0),
+        offload_dropped=(core.offload_engine.dropped_jobs_total
+                         if core.offload_engine is not None else 0),
+        disk_onboards=core.disk_onboards,
+        updated_at=time.time(),
+    )
+
+
+async def publish_status_loop(core, runtime, namespace: str,
+                              interval: float = 2.0) -> None:
+    """Standing task: publish this worker's tier snapshot (llmctl kv
+    status reads it; components/metrics.py scrapes the same numbers off
+    ForwardPassMetrics — this key is the human/CLI view)."""
+    while True:
+        try:
+            await runtime.store.kv_put(kv_status_key(namespace),
+                                       snapshot(core, namespace).to_json())
+        except Exception:  # noqa: BLE001 — store may flap
+            logger.exception("kv tier status publish failed")
+        await asyncio.sleep(interval)
+
+
+async def watch_control_loop(core, runtime, namespace: str) -> None:
+    """Standing task: act on llmctl kv flush. The control record carries
+    a monotonically fresh nonce so re-delivered watches are idempotent;
+    ``clear`` drops the disk cache instead of persisting into it."""
+    from ...runtime.kvstore import WatchEventType
+
+    key = kv_control_key(namespace)
+    seen: Optional[float] = None
+
+    async def act(raw: bytes) -> None:
+        nonlocal seen
+        try:
+            d = json.loads(raw)
+        except ValueError:
+            logger.warning("ignoring malformed kv control at %s", key)
+            return
+        nonce = d.get("flush")
+        if nonce is None or nonce == seen:
+            return
+        seen = nonce
+        if d.get("clear"):
+            n = core.disk_store.clear() if core.disk_store is not None else 0
+            logger.info("kv control: cleared %d disk blocks", n)
+        else:
+            n = await core.flush_host_to_disk()
+            logger.info("kv control: flushed %d host blocks to disk", n)
+        # acknowledge by refreshing the status snapshot immediately
+        await runtime.store.kv_put(kv_status_key(namespace),
+                                   snapshot(core, namespace).to_json())
+
+    # NOTE: deliberately no act() on the stored value at startup — a
+    # flush requested for the PREVIOUS process must not replay into a
+    # fresh engine; only post-start control writes apply.
+    entry = await runtime.store.kv_get(key)
+    if entry is not None:
+        try:
+            seen = json.loads(entry.value).get("flush")
+        except ValueError:
+            pass
+    watcher = await runtime.store.watch_prefix(key)
+    async for ev in watcher:
+        if ev.type == WatchEventType.PUT:
+            try:
+                await act(ev.entry.value)
+            except Exception:  # noqa: BLE001 — one bad command must not
+                logger.exception("kv control command failed")

@@ -1,0 +1,589 @@
+"""Persistent disk (G3) KV tier: content-addressed block store with
+write-behind spill and cross-restart prefix reuse.
+
+Reference: the KV storage manager's ladder Device → Pinned-Host → Disk →
+Remote (SURVEY §KvStorageManager; README "KV cache offloading across
+memory hierarchies"). Our ladder previously stopped at host DRAM — a
+`HostKvPool` LRU eviction (offload.py) discarded the block forever and
+every engine restart started stone cold. This module adds the capacity
+tier below DRAM:
+
+- :class:`DiskKvStore` — a capacity-bounded, content-addressed on-disk
+  block store keyed by the existing xxh3 chained sequence hashes
+  (blocks.py). Each block is one ``blk-<hash>.npz`` file written
+  tmp → fsync → rename, acknowledged only by an fsync'd append to
+  ``manifest.jsonl`` — so an acknowledged block survives kill -9 and a
+  fresh engine warm-starts from the previous run's cache (the
+  "Prefill-as-a-Service" semantics: cached KV outlives the process that
+  produced it). A partially-written block is invisible on recovery: the
+  rename is atomic and the manifest line lands only after the data file
+  is durable (the runtime/wal.py torn-tail discipline applied per block).
+- :class:`DiskSpillEngine` — the async write-behind pump: host-tier
+  evictions become bounded-queue spill jobs; the file I/O runs off-thread
+  (asyncio.to_thread) so spill never blocks the engine loop, and
+  saturation DROPS the job with a counter instead of stalling
+  (``dropped_jobs_total`` — the same backpressure contract the offload
+  pump has).
+
+Multihost: a follower mirrors the leader's disk tier verbatim — the
+leader streams literal placement decisions ("kv_disk_store": hash +
+evicted set) and the follower applies them via :meth:`DiskKvStore
+.apply_put` with arena bytes staged from its own bit-identical host
+mirror, never re-running the LRU policy (the HostKvPool.apply_store
+contract extended one tier down; engine/multihost.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import io
+import json
+import logging
+import os
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+logger = logging.getLogger("dynamo_tpu.kv.diskstore")
+
+__all__ = ["DiskKvStore", "DiskSpillEngine", "SpillJob"]
+
+_MANIFEST = "manifest.jsonl"
+_META = "meta.json"
+
+
+@dataclasses.dataclass
+class _Entry:
+    seq_hash: int
+    tokens_hash: Optional[int]
+    parent_hash: Optional[int]
+    fname: str
+    nbytes: int
+
+
+def _blk_fname(seq_hash: int) -> str:
+    return f"blk-{seq_hash & 0xFFFFFFFFFFFFFFFF:016x}.npz"
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """Dtype by name, including the ml_dtypes extension types (bfloat16
+    KV pools — np.savez alone would round-trip them as anonymous void
+    '|V2' and the device scatter would reject them)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _pack_block(values: dict) -> dict:
+    """Per-block dict → npz-safe payload: raw uint8 bytes per key plus a
+    JSON ``__meta__`` entry recording each array's true dtype and shape.
+    Byte-exact for ANY dtype (incl. bfloat16 / int8 opaque rows)."""
+    meta = {}
+    out = {}
+    for k, v in values.items():
+        v = np.ascontiguousarray(v)
+        meta[k] = {"dtype": str(v.dtype), "shape": list(v.shape)}
+        out[k] = np.frombuffer(v.tobytes(), np.uint8)
+    out["__meta__"] = np.frombuffer(json.dumps(meta).encode(), np.uint8)
+    return out
+
+
+def _unpack_block(z) -> dict:
+    meta = json.loads(z["__meta__"].tobytes().decode())
+    return {k: np.frombuffer(z[k].tobytes(),
+                             _resolve_dtype(m["dtype"])).reshape(m["shape"])
+            for k, m in meta.items()}
+
+
+class DiskKvStore:
+    """Content-addressed on-disk KV block store (the G3 tier).
+
+    Keys are the chained xxh3 sequence hashes (blocks.py) — the same
+    identity the device pool and host tier use, so a hash found here is
+    byte-identical content by construction. Values are per-block dicts
+    mirroring the host arena's per-row layout ({"k": [L, H, bs, D],
+    "v": …}; int8/MLA pools ship one opaque "kv"/row entry), stored
+    np.savez (no pickle).
+
+    Durability contract (asserted by tests/test_kv_disk.py kill -9):
+    - a block is acknowledged ⇔ its manifest "put" line is fsync'd;
+    - the data file is fsync'd + atomically renamed BEFORE that line, so
+      an acknowledged block always has whole bytes;
+    - a crash between rename and manifest append leaves an orphan data
+      file that recovery deletes — never a corrupt read;
+    - deletes append a manifest "del" line before the unlink, so a crash
+      between them leaves an orphan the next open removes.
+
+    Thread-safety: index mutations lock (the spill pump writes from a
+    worker thread while the engine loop matches/pins); file reads of
+    pinned entries are safe against concurrent eviction because eviction
+    skips pinned hashes (requeue, like the host pool).
+    """
+
+    def __init__(self, root: str, capacity_blocks: int,
+                 expect_block_size: Optional[int] = None):
+        self.root = root
+        self.capacity = int(capacity_blocks)
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.RLock()
+        # insertion order IS the LRU order (match_prefix re-inserts)
+        self._entries: "OrderedDict[int, _Entry]" = OrderedDict()
+        self._pins: Dict[int, int] = {}
+        self._manifest_f: Optional[io.TextIOWrapper] = None
+        self.meta: dict = {}
+        # stats (nv_llm_kv_disk_* feed)
+        self.stored_blocks_total = 0
+        self.evicted_blocks_total = 0
+        self.match_queries = 0
+        self.match_hits = 0
+        self.restored_blocks = 0        # entries recovered at open
+        self.bytes_used = 0
+        self._recover(expect_block_size)
+
+    # ------------------------------------------------------------- recovery
+    def _recover(self, expect_block_size: Optional[int]) -> None:
+        meta_path = os.path.join(self.root, _META)
+        if os.path.exists(meta_path):
+            try:
+                with open(meta_path) as f:
+                    self.meta = json.load(f)
+            except (OSError, ValueError):
+                self.meta = {}
+        if (expect_block_size is not None and self.meta
+                and self.meta.get("block_size") not in (None,
+                                                        expect_block_size)):
+            logger.warning(
+                "disk KV store at %s was written with block_size=%s but "
+                "this engine runs block_size=%d — starting cold (the "
+                "cached blocks are not addressable under the new "
+                "hash/block geometry)", self.root,
+                self.meta.get("block_size"), expect_block_size)
+            self._wipe()
+        man_path = os.path.join(self.root, _MANIFEST)
+        live: "OrderedDict[int, _Entry]" = OrderedDict()
+        if os.path.exists(man_path):
+            with open(man_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        # torn tail: the record was never acknowledged
+                        break
+                    if rec.get("op") == "put":
+                        h = int(rec["h"])
+                        live.pop(h, None)
+                        live[h] = _Entry(
+                            seq_hash=h,
+                            tokens_hash=rec.get("th"),
+                            parent_hash=rec.get("ph"),
+                            fname=rec.get("f", _blk_fname(h)),
+                            nbytes=int(rec.get("n", 0)))
+                    elif rec.get("op") == "del":
+                        live.pop(int(rec["h"]), None)
+        # keep only entries whose data file actually exists (a manifest
+        # line with a vanished file cannot serve reads)
+        for h in list(live):
+            path = os.path.join(self.root, live[h].fname)
+            if not os.path.exists(path):
+                live.pop(h)
+        self._entries = live
+        self.restored_blocks = len(live)
+        self.bytes_used = sum(e.nbytes for e in live.values())
+        # remove orphan data files: written (renamed) but never
+        # acknowledged, or deleted-in-manifest but not yet unlinked
+        keep = {e.fname for e in live.values()}
+        for fn in os.listdir(self.root):
+            if fn in (_MANIFEST, _META) or fn in keep:
+                continue
+            if fn.startswith(("blk-", "tmp-")):
+                try:
+                    os.unlink(os.path.join(self.root, fn))
+                except OSError:
+                    pass
+        # compact: rewrite the manifest as pure puts of the live set
+        self._rewrite_manifest()
+        if expect_block_size is not None:
+            self.meta.setdefault("block_size", expect_block_size)
+            self._write_meta()
+        if live:
+            logger.info("disk KV store warm start: %d blocks (%.1f MB) "
+                        "recovered from %s", len(live),
+                        self.bytes_used / 1e6, self.root)
+
+    def _wipe(self) -> None:
+        for fn in os.listdir(self.root):
+            try:
+                os.unlink(os.path.join(self.root, fn))
+            except OSError:
+                pass
+        self.meta = {}
+        self._entries = OrderedDict()
+        self.bytes_used = 0
+
+    def _write_meta(self) -> None:
+        tmp = os.path.join(self.root, _META + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(self.meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.root, _META))
+
+    def _rewrite_manifest(self) -> None:
+        if self._manifest_f is not None:
+            self._manifest_f.close()
+            self._manifest_f = None
+        tmp = os.path.join(self.root, _MANIFEST + ".tmp")
+        with open(tmp, "w") as f:
+            for e in self._entries.values():
+                f.write(json.dumps({"op": "put", "h": e.seq_hash,
+                                    "th": e.tokens_hash,
+                                    "ph": e.parent_hash,
+                                    "f": e.fname, "n": e.nbytes}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.root, _MANIFEST))
+        self._fsync_dir()
+        self._manifest_f = open(os.path.join(self.root, _MANIFEST), "a")
+
+    def _fsync_dir(self) -> None:
+        try:
+            fd = os.open(self.root, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass                        # not all filesystems support it
+
+    def _append_manifest(self, recs: List[dict]) -> None:
+        if self._manifest_f is None:
+            self._manifest_f = open(os.path.join(self.root, _MANIFEST), "a")
+        for rec in recs:
+            self._manifest_f.write(json.dumps(rec) + "\n")
+        self._manifest_f.flush()
+        os.fsync(self._manifest_f.fileno())
+
+    # -------------------------------------------------------------- queries
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def used_blocks(self) -> int:
+        return len(self._entries)
+
+    def contains(self, seq_hash: int) -> bool:
+        with self._lock:
+            return seq_hash in self._entries
+
+    def hit_rate(self) -> float:
+        return self.match_hits / max(self.match_queries, 1)
+
+    def match_prefix(self, seq_hashes: Sequence[int],
+                     pin: bool = False) -> List[int]:
+        """Longest leading run of hashes present; returns the matched
+        HASHES (content addressing has no slot indirection) and freshens
+        LRU order. ``pin=True`` pins the matched entries atomically under
+        the lock so the spill pump's capacity evictions (worker thread)
+        cannot delete them before the off-thread onboard read."""
+        out: List[int] = []
+        with self._lock:
+            for h in seq_hashes:
+                self.match_queries += 1
+                e = self._entries.get(h)
+                if e is None:
+                    break
+                self.match_hits += 1
+                self._entries.move_to_end(h)
+                if pin:
+                    self._pins[h] = self._pins.get(h, 0) + 1
+                out.append(h)
+        return out
+
+    def pin(self, seq_hashes: Sequence[int]) -> None:
+        with self._lock:
+            for h in seq_hashes:
+                self._pins[h] = self._pins.get(h, 0) + 1
+
+    def unpin(self, seq_hashes: Sequence[int]) -> None:
+        with self._lock:
+            for h in seq_hashes:
+                n = self._pins.get(h, 0) - 1
+                if n <= 0:
+                    self._pins.pop(h, None)
+                else:
+                    self._pins[h] = n
+
+    def registered_entries(self) -> List[tuple]:
+        """Every resident block as (seq_hash, tokens_hash, parent_hash) —
+        the reannounce inventory (router radix index bring-up)."""
+        with self._lock:
+            return [(e.seq_hash, e.tokens_hash, e.parent_hash)
+                    for e in self._entries.values()]
+
+    # ---------------------------------------------------------------- reads
+    def fetch(self, seq_hashes: Sequence[int]) -> dict:
+        """Stacked wire values for ``seq_hashes``, keyed like the host
+        pool's fetch: {key: [L, H, n, bs, D]}. Callers pin first — an
+        unpinned entry may be evicted mid-read."""
+        blocks = []
+        for h in seq_hashes:
+            with self._lock:
+                e = self._entries.get(h)
+            if e is None:
+                raise KeyError(f"disk KV block {h:#x} is not resident")
+            with np.load(os.path.join(self.root, e.fname)) as z:
+                blocks.append(_unpack_block(z))
+        return {k: np.ascontiguousarray(
+                    np.stack([b[k] for b in blocks], axis=2))
+                for k in blocks[0]}
+
+    # --------------------------------------------------------------- writes
+    def _validate_layout(self, values: dict) -> None:
+        layout = {k: [list(v.shape), str(np.dtype(v.dtype))]
+                  for k, v in values.items()}
+        known = self.meta.get("layout")
+        if known is None:
+            self.meta["layout"] = layout
+            self._write_meta()
+        elif known != layout:
+            logger.warning(
+                "disk KV store layout changed (%s -> %s) — dropping the "
+                "stale cache (a restored block of the old shape would "
+                "corrupt the device scatter)", known, layout)
+            with self._lock:
+                self._wipe()
+            self.meta = {"layout": layout,
+                         "block_size": self.meta.get("block_size")}
+            self._write_meta()
+            self._rewrite_manifest()
+
+    def _evict_for_capacity(self) -> List[int]:
+        """Pick LRU victims (skipping pinned, which requeue) until one
+        slot is free. Returns the evicted hashes; [] when nothing had to
+        go; raises BlockingIOError when everything is pinned."""
+        evicted: List[int] = []
+        scanned = 0
+        while len(self._entries) >= self.capacity:
+            if scanned >= len(self._entries):
+                raise BlockingIOError("disk KV store full and all pinned")
+            h = next(iter(self._entries))
+            if self._pins.get(h):
+                self._entries.move_to_end(h)   # requeue pinned candidate
+                scanned += 1
+                continue
+            evicted.append(h)
+            self._delete_locked(h)
+        return evicted
+
+    def _delete_locked(self, h: int) -> None:
+        e = self._entries.pop(h, None)
+        if e is None:
+            return
+        self.bytes_used -= e.nbytes
+        self.evicted_blocks_total += 1
+        # manifest del BEFORE unlink: a crash in between leaves an orphan
+        # file the next open removes — never a live entry without bytes
+        self._append_manifest([{"op": "del", "h": h}])
+        try:
+            os.unlink(os.path.join(self.root, e.fname))
+        except OSError:
+            pass
+
+    def put(self, seq_hash: int, values: dict,
+            tokens_hash: Optional[int] = None,
+            parent_hash: Optional[int] = None) -> Optional[List[int]]:
+        """Store one block under its chained hash. Returns the list of
+        hashes evicted to make room (usually []), or None when the block
+        was skipped (already resident, zero capacity, or everything
+        pinned). Durable on return: data fsync'd + renamed, manifest line
+        fsync'd."""
+        if self.capacity <= 0:
+            return None
+        with self._lock:
+            if seq_hash in self._entries:
+                self._entries.move_to_end(seq_hash)
+                return None
+            try:
+                evicted = self._evict_for_capacity()
+            except BlockingIOError:
+                return None
+            self._validate_layout(values)
+            nbytes = self._write_block(seq_hash, values, tokens_hash,
+                                       parent_hash)
+            self._entries[seq_hash] = _Entry(seq_hash, tokens_hash,
+                                             parent_hash,
+                                             _blk_fname(seq_hash), nbytes)
+            self.bytes_used += nbytes
+            self.stored_blocks_total += 1
+            return evicted
+
+    def _write_block(self, seq_hash: int, values: dict,
+                     tokens_hash, parent_hash) -> int:
+        fname = _blk_fname(seq_hash)
+        tmp = os.path.join(self.root, "tmp-" + fname)
+        with open(tmp, "wb") as f:
+            np.savez(f, **_pack_block(values))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.root, fname))
+        self._fsync_dir()
+        nbytes = os.path.getsize(os.path.join(self.root, fname))
+        # the acknowledgement: manifest line AFTER the durable data file
+        self._append_manifest([{"op": "put", "h": seq_hash,
+                                "th": tokens_hash, "ph": parent_hash,
+                                "f": fname, "n": nbytes}])
+        return nbytes
+
+    def apply_put(self, seq_hash: int, evicted: Sequence[int],
+                  values: dict, tokens_hash: Optional[int] = None,
+                  parent_hash: Optional[int] = None) -> None:
+        """Apply one of the leader's literal spill placements to a mirror
+        store (multihost follower): delete exactly the leader's eviction
+        set, then store — the LRU policy never re-runs on followers
+        (HostKvPool.apply_store one tier down)."""
+        with self._lock:
+            for h in evicted:
+                self._delete_locked(h)
+            if seq_hash in self._entries:
+                return
+            self._validate_layout(values)
+            nbytes = self._write_block(seq_hash, values, tokens_hash,
+                                       parent_hash)
+            self._entries[seq_hash] = _Entry(seq_hash, tokens_hash,
+                                             parent_hash,
+                                             _blk_fname(seq_hash), nbytes)
+            self.bytes_used += nbytes
+            self.stored_blocks_total += 1
+
+    def clear(self) -> int:
+        """Drop every resident block (llmctl kv flush --clear). Returns
+        the number of blocks removed."""
+        with self._lock:
+            n = len(self._entries)
+            for h in list(self._entries):
+                self._delete_locked(h)
+            return n
+
+    def close(self) -> None:
+        if self._manifest_f is not None:
+            self._manifest_f.close()
+            self._manifest_f = None
+
+
+@dataclasses.dataclass
+class SpillJob:
+    """One evicted host-tier block headed for disk. ``values`` is a
+    host-side COPY of the arena row (taken before the eviction's
+    overwrite), so the job owns its bytes outright — no pins needed."""
+
+    seq_hash: int
+    tokens_hash: Optional[int]
+    parent_hash: Optional[int]
+    values: dict
+
+
+class DiskSpillEngine:
+    """Asynchronous host→disk write-behind pump.
+
+    The host pool's eviction hook offers jobs on the engine loop; the
+    pump batches them and runs the fsync-heavy file writes off-thread
+    (asyncio.to_thread), so spill NEVER blocks the engine loop. The
+    queue is bounded: saturation drops the job and counts it
+    (``dropped_jobs_total``) — losing a cache block under pressure is
+    strictly better than stalling decode (the KvOffloadEngine
+    backpressure contract, one tier down)."""
+
+    def __init__(self, store: DiskKvStore, max_queue_jobs: int = 256,
+                 max_batch_jobs: int = 32,
+                 on_commit: Optional[Callable[[list], None]] = None):
+        self.store = store
+        self.max_queue_jobs = max_queue_jobs
+        self.max_batch_jobs = max_batch_jobs
+        # called on the loop with [(hash, tokens_hash, parent, evicted)]
+        # after each committed batch — the leader's dispatch-stream hook
+        # (engine/multihost.py "kv_disk_store")
+        self.on_commit = on_commit
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+        self.spilled_blocks_total = 0
+        self.dropped_jobs_total = 0
+        self.write_s = 0.0
+
+    def offer(self, job: SpillJob) -> bool:
+        """Non-blocking enqueue; False (counted) when the queue is
+        saturated or the block is already resident on disk."""
+        if self.store.contains(job.seq_hash):
+            return False
+        if self._queue.qsize() >= self.max_queue_jobs:
+            self.dropped_jobs_total += 1
+            return False
+        self._queue.put_nowait(job)
+        self._ensure_task()
+        return True
+
+    def _ensure_task(self) -> None:
+        if self._task is None or self._task.done():
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return
+            self._task = loop.create_task(self._run(), name="kv-disk-spill")
+
+    async def _run(self) -> None:
+        while True:
+            job: SpillJob = await self._queue.get()
+            jobs = [job]
+            while (len(jobs) < self.max_batch_jobs
+                   and not self._queue.empty()):
+                jobs.append(self._queue.get_nowait())
+            try:
+                await self._process(jobs)
+            except Exception:  # noqa: BLE001 — spill is best-effort
+                logger.exception("disk spill batch failed")
+            finally:
+                for _ in jobs:
+                    self._queue.task_done()
+            await asyncio.sleep(0)      # yield to the engine loop
+
+    async def _process(self, jobs: List[SpillJob]) -> None:
+        def write_batch():
+            out = []
+            t0 = time.monotonic()
+            for j in jobs:
+                evicted = self.store.put(j.seq_hash, j.values,
+                                         j.tokens_hash, j.parent_hash)
+                if evicted is not None:
+                    out.append((j.seq_hash, j.tokens_hash, j.parent_hash,
+                                list(evicted)))
+            return out, time.monotonic() - t0
+
+        committed, dt = await asyncio.to_thread(write_batch)
+        self.write_s += dt
+        self.spilled_blocks_total += len(committed)
+        if self.on_commit is not None and committed:
+            self.on_commit(committed)
+
+    async def drain(self) -> None:
+        self._ensure_task()
+        await self._queue.join()
+
+    async def stop(self) -> None:
+        try:
+            await asyncio.wait_for(self.drain(), timeout=10)
+        except asyncio.TimeoutError:
+            logger.warning("disk spill drain timed out; dropping queue")
+            while not self._queue.empty():
+                self._queue.get_nowait()
+                self._queue.task_done()
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None

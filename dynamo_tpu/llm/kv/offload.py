@@ -60,13 +60,30 @@ class HostKvPool:
         self._arena: Optional[dict] = None
         self._free: List[int] = list(range(capacity_blocks - 1, -1, -1))
         self._by_hash: Dict[int, int] = {}       # seq_hash → slot
-        self._lru: Dict[int, None] = {}          # seq_hash → (ordered dict)
+        self._lru: Dict[int, None] = {}          # EVICTABLE hashes, LRU order
+        # hashes parked out of the eviction queue because their slot was
+        # pinned when an eviction considered them; unpin re-queues them.
+        # Keeping them out of _lru makes victim selection O(1) amortized
+        # (each park/unpark pairs with one pin cycle) instead of a full
+        # scan past every pinned entry per eviction.
+        self._lru_parked: Dict[int, None] = {}
+        self._hash_by_slot: Dict[int, int] = {}
         self._pins: Dict[int, int] = {}          # slot → pin count
+        # per-hash (tokens_hash, parent_hash) — carried so a disk-tier
+        # spill of an evicted block can re-announce it to the router's
+        # radix index (diskstore.py; publisher tier tags)
+        self._meta: Dict[int, tuple] = {}
+        # write-behind spill hook: called with (evicted_hash, tokens_hash,
+        # parent_hash, values_copy) BEFORE the arena row is overwritten —
+        # the disk (G3) tier's feed. values_copy is a fresh per-block
+        # dict the callee owns outright.
+        self.on_evict: Optional[Callable] = None
         # stats
         self.stored_blocks_total = 0
         self.evicted_blocks_total = 0
         self.match_queries = 0
         self.match_hits = 0
+        self.evict_scan_steps = 0   # pinned-candidate requeues (O(1) test)
 
     def __len__(self) -> int:
         return len(self._by_hash)
@@ -75,42 +92,91 @@ class HostKvPool:
     def free_slots(self) -> int:
         return len(self._free)
 
+    def _touch(self, seq_hash: int) -> None:
+        """Freshen a resident hash's LRU position. Parked hashes (pinned
+        at some eviction check) stay parked — unpin re-queues them."""
+        if seq_hash in self._lru_parked:
+            return
+        self._lru.pop(seq_hash, None)
+        self._lru[seq_hash] = None
+
+    def _place(self, seq_hash: int, slot: int) -> None:
+        self._by_hash[seq_hash] = slot
+        self._hash_by_slot[slot] = seq_hash
+        self._lru_parked.pop(seq_hash, None)
+        self._lru[seq_hash] = None
+
     def _slot_for(self, seq_hash: int):
         """(slot, evicted_hash) — existing slot, else a fresh/evicted one.
-        (None, None) if nothing is placeable (capacity 0 / all pinned)."""
+        (None, None) if nothing is placeable (capacity 0 / all pinned).
+
+        Victim selection is O(1) amortized: candidates pop from the
+        evictable LRU front; a PINNED candidate is PARKED out of the
+        queue entirely (re-queued by unpin) instead of being skipped in
+        place — the old O(n) scan walked past every pinned entry on
+        every eviction, O(n·m) for m stores against a mostly-pinned
+        pool. Each park/unpark pairs with one pin cycle, so the
+        amortized per-eviction cost is constant."""
         slot = self._by_hash.get(seq_hash)
         if slot is not None:
-            self._lru.pop(seq_hash, None)
-            self._lru[seq_hash] = None
+            self._touch(seq_hash)
             return slot, None
         evicted = None
         if not self._free:
-            victim = next((h for h in self._lru
-                           if not self._pins.get(self._by_hash[h])), None)
+            victim = None
+            while self._lru:
+                h = next(iter(self._lru))
+                if self._pins.get(self._by_hash[h]):
+                    self._lru.pop(h)
+                    self._lru_parked[h] = None   # park pinned candidate
+                    self.evict_scan_steps += 1
+                    continue
+                victim = h
+                break
             if victim is None:       # empty, or everything pinned mid-fetch
                 return None, None
             self._lru.pop(victim)
-            self._free.append(self._by_hash.pop(victim))
+            vslot = self._by_hash.pop(victim)
+            self._hash_by_slot.pop(vslot, None)
             self.evicted_blocks_total += 1
+            if self.on_evict is not None and self._arena is not None:
+                th, ph = self._meta.get(victim, (None, None))
+                try:
+                    self.on_evict(victim, th, ph,
+                                  {key: arena[vslot].copy()
+                                   for key, arena in self._arena.items()})
+                except Exception:  # noqa: BLE001 — spill is best-effort
+                    logger.exception("host-tier evict hook failed")
+            self._meta.pop(victim, None)
+            self._free.append(vslot)
             evicted = victim
         slot = self._free.pop()
-        self._by_hash[seq_hash] = slot
-        self._lru[seq_hash] = None
+        self._place(seq_hash, slot)
         return slot, evicted
 
-    def store(self, seq_hashes: Sequence[int], values: dict) -> list:
+    def store(self, seq_hashes: Sequence[int], values: dict,
+              tokens_hashes: Optional[Sequence[int]] = None,
+              parent_hashes: Optional[Sequence[Optional[int]]] = None
+              ) -> list:
         """Write stacked blocks (e.g. {"k": [L, H, n, bs, D], "v": …};
         MLA latent pools ship one "kv" entry) under their hashes — the
         arena mirrors whatever key set the device pool has. Returns the
         literal placement decisions ``[(hash, slot, evicted_hash |
         None)]`` — len(result) blocks were stored (capacity may stop
         early). Multihost follower mirrors replay these decisions
-        verbatim instead of re-running the LRU policy (apply_store)."""
+        verbatim instead of re-running the LRU policy (apply_store).
+        ``tokens_hashes``/``parent_hashes`` (aligned with seq_hashes)
+        ride along so a later disk-tier spill can re-announce the block
+        to the router's radix index with its chain intact."""
         decisions = []
         for i, h in enumerate(seq_hashes):
             slot, evicted = self._slot_for(h)
             if slot is None:
                 break
+            if tokens_hashes is not None:
+                self._meta[h] = (tokens_hashes[i],
+                                 parent_hashes[i] if parent_hashes
+                                 is not None else None)
             self._ensure_arena(values)
             for key, arena in self._arena.items():
                 arena[slot] = values[key][:, :, i]
@@ -149,17 +215,19 @@ class HostKvPool:
         if evicted_hash is not None:
             old = self._by_hash.pop(evicted_hash, None)
             self._lru.pop(evicted_hash, None)
-            if old is not None and old != slot:
-                self._free.append(old)
+            self._lru_parked.pop(evicted_hash, None)
+            self._meta.pop(evicted_hash, None)
+            if old is not None:
+                self._hash_by_slot.pop(old, None)
+                if old != slot:
+                    self._free.append(old)
             self.evicted_blocks_total += 1
         if self._by_hash.get(seq_hash) != slot:
             try:
                 self._free.remove(slot)
             except ValueError:
                 pass
-            self._by_hash[seq_hash] = slot
-        self._lru.pop(seq_hash, None)
-        self._lru[seq_hash] = None
+        self._place(seq_hash, slot)
         self._ensure_arena(block_values)
         for key, arena in self._arena.items():
             arena[slot] = block_values[key]
@@ -175,8 +243,7 @@ class HostKvPool:
             if slot is None:
                 break
             self.match_hits += 1
-            self._lru.pop(h, None)
-            self._lru[h] = None
+            self._touch(h)
             out.append(slot)
         return out
 
@@ -200,6 +267,12 @@ class HostKvPool:
             n = self._pins.get(s, 0) - 1
             if n <= 0:
                 self._pins.pop(s, None)
+                # re-queue a candidate parked while this slot was pinned
+                # (to the LRU back — the documented requeue semantics)
+                h = self._hash_by_slot.get(s)
+                if h is not None and h in self._lru_parked:
+                    self._lru_parked.pop(h)
+                    self._lru[h] = None
             else:
                 self._pins[s] = n
 
@@ -208,6 +281,24 @@ class HostKvPool:
 
     def hit_rate(self) -> float:
         return self.match_hits / max(self.match_queries, 1)
+
+    def meta_for(self, seq_hash: int) -> tuple:
+        """(tokens_hash, parent_hash) recorded at store time (None, None
+        when the storer carried no chain info)."""
+        return self._meta.get(seq_hash, (None, None))
+
+    def resident_entries(self) -> List[tuple]:
+        """Every resident block as (seq_hash, tokens_hash, parent_hash,
+        slot) — the flush-to-disk inventory (EngineCore
+        flush_host_to_disk / llmctl kv flush)."""
+        return [(h, *self._meta.get(h, (None, None)), slot)
+                for h, slot in self._by_hash.items()]
+
+    def row_copy(self, slot: int) -> dict:
+        """Fresh per-block copy of one arena row ({key: [L, H, bs, D]})
+        — what a spill job owns."""
+        return {key: arena[slot].copy()
+                for key, arena in self._arena.items()}
 
 
 def make_host_pool(capacity_blocks: int, model_cfg, block_size: int,
@@ -250,6 +341,12 @@ class OffloadJob:
 
     block_ids: List[int]
     seq_hashes: List[int]
+    # local (unchained) hashes aligned with seq_hashes; optional — when
+    # present the host pool records them so disk-tier spills can
+    # re-announce evicted blocks with their chain intact (diskstore.py).
+    # Jobs always start at a sequence's block 0 (core._release_slot), so
+    # parent_hashes derive as [None, seq_hashes[0], seq_hashes[1], ...].
+    tokens_hashes: Optional[List[int]] = None
 
 
 class KvOffloadEngine:
@@ -267,7 +364,8 @@ class KvOffloadEngine:
                  release_holds: Optional[Callable[[List[int]], None]] = None,
                  max_batch_blocks: int = 64,
                  simulated_gbps: Optional[float] = None,
-                 on_store: Optional[Callable[[list], None]] = None):
+                 on_store: Optional[Callable[[list], None]] = None,
+                 max_queue_jobs: int = 512):
         self.host_pool = host_pool
         self.block_size = block_size
         self.get_kv = get_kv
@@ -283,12 +381,25 @@ class KvOffloadEngine:
         # so an e2e run on a FAST local link (CPU tests) measures the tier
         # under a realistic TPU-VM link instead of this rig's tunnel
         self.simulated_gbps = simulated_gbps
+        # bounded write-back queue: saturation DROPS the job (with its
+        # device holds released and a counter bumped) instead of letting
+        # an unbounded backlog pin device blocks — losing a cache
+        # write-back under pressure is strictly better than KV-pool
+        # starvation. Previously the drop was impossible but the queue
+        # was unbounded and silent.
+        self.max_queue_jobs = max_queue_jobs
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
         self.offloaded_blocks_total = 0
+        self.dropped_jobs_total = 0
         self.simulated_wait_s = 0.0
 
     def enqueue(self, job: OffloadJob) -> None:
+        if self._queue.qsize() >= self.max_queue_jobs:
+            self.dropped_jobs_total += 1
+            if self.release_holds is not None:
+                self.release_holds(job.block_ids)
+            return
         self._queue.put_nowait(job)
         self._ensure_task()
 
@@ -332,6 +443,13 @@ class KvOffloadEngine:
 
         block_ids = [b for j in jobs for b in j.block_ids]
         seq_hashes = [h for j in jobs for h in j.seq_hashes]
+        # chain meta per block: jobs start at block 0 of their sequence,
+        # so parents are the preceding seq hash within the job
+        tok_hashes = [th for j in jobs
+                      for th in (j.tokens_hashes
+                                 or [None] * len(j.seq_hashes))]
+        parents = [p for j in jobs
+                   for p in ([None] + list(j.seq_hashes[:-1]))]
         # skip blocks already resident on host (multi-turn re-offload)
         keep = [i for i, h in enumerate(seq_hashes)
                 if not self.host_pool.contains(h)]
@@ -339,6 +457,8 @@ class KvOffloadEngine:
             return
         ids = [block_ids[i] for i in keep]
         hashes = [seq_hashes[i] for i in keep]
+        toks = [tok_hashes[i] for i in keep]
+        pars = [parents[i] for i in keep]
         # dispatch the on-device gather HERE, on the loop thread: it orders
         # correctly against the engine's donated decode steps and returns a
         # fresh (never-donated) buffer
@@ -357,7 +477,9 @@ class KvOffloadEngine:
             if wait > 0:
                 self.simulated_wait_s += wait
                 await asyncio.sleep(wait)
-        decisions = self.host_pool.store(hashes, values)
+        decisions = self.host_pool.store(hashes, values,
+                                         tokens_hashes=toks,
+                                         parent_hashes=pars)
         self.offloaded_blocks_total += len(decisions)
         if self.on_store is not None and decisions:
             try:

@@ -274,6 +274,12 @@ class PrefillPlan:
     # host-tier hits: slots in the HostKvPool whose content must be copied
     # into the first len(host_slots) entries of new_blocks before prefill
     host_slots: List[int] = dataclasses.field(default_factory=list)
+    # disk-tier (G3) hits: chained hashes resident in the DiskKvStore,
+    # promoted into new_blocks[len(host_slots):len(host_slots) +
+    # len(disk_hashes)] through the same off-thread onboard path. The
+    # matched entries are PINNED against spill-pump eviction until the
+    # admission completes (match_prefix(pin=True)).
+    disk_hashes: List[int] = dataclasses.field(default_factory=list)
 
     @property
     def all_blocks(self) -> List[int]:
@@ -283,21 +289,28 @@ class PrefillPlan:
     def host_hit_tokens(self) -> int:
         return len(self.host_slots) * self.seq.block_size
 
+    @property
+    def disk_hit_tokens(self) -> int:
+        return len(self.disk_hashes) * self.seq.block_size
+
 
 class KvBlockManager:
     """Pool + hashing glue the engine admit path calls. Optionally backed by
-    a host (TPU-VM DRAM) tier: device misses fall through to the host pool
-    (reference `prepare_prefill_offload`)."""
+    a host (TPU-VM DRAM) tier and a persistent disk (G3) tier: device
+    misses cascade host → disk (reference `prepare_prefill_offload`
+    extended one rung down the Device→Pinned→Disk ladder)."""
 
     def __init__(self, num_blocks: int, block_size: int,
                  on_stored=None, on_removed=None, enable_reuse: bool = True,
-                 host_pool=None, prefer_native: bool = True):
+                 host_pool=None, disk_store=None,
+                 prefer_native: bool = True):
         self.block_size = block_size
         self.pool = make_kv_block_pool(num_blocks, on_stored=on_stored,
                                        on_removed=on_removed,
                                        prefer_native=prefer_native)
         self.enable_reuse = enable_reuse
         self.host_pool = host_pool
+        self.disk_store = disk_store
 
     def prepare_prefill(self, prompt: Sequence[int], extra_blocks: int = 1,
                         seq: Optional[TokenBlockSequence] = None
@@ -319,19 +332,36 @@ class KvBlockManager:
                       if self.enable_reuse else [])
         hit_tokens = len(hit_blocks) * self.block_size
         host_slots: List[int] = []
+        disk_hashes: List[int] = []
         if self.enable_reuse and self.host_pool is not None:
             host_slots = self.host_pool.match_prefix(
                 matchable[len(hit_blocks):])
+        if self.enable_reuse and self.disk_store is not None:
+            # G3 cascade: the run of hashes past the host hits. pin=True
+            # holds the matched entries against the spill pump's
+            # capacity evictions (worker thread) until the admission's
+            # off-thread read completes (core unpins)
+            disk_hashes = self.disk_store.match_prefix(
+                matchable[len(hit_blocks) + len(host_slots):], pin=True)
         total_needed = (len(prompt) + extra_blocks * self.block_size
                         + self.block_size - 1) // self.block_size
         n_new = total_needed - len(hit_blocks)
         new_blocks = self.pool.alloc_uninit(n_new)
         if new_blocks is None:
             self.pool.release(hit_blocks)
+            if disk_hashes:
+                self.disk_store.unpin(disk_hashes)
             return None
         return PrefillPlan(hit_blocks=hit_blocks, new_blocks=new_blocks,
                            hit_tokens=hit_tokens, seq=seq,
-                           host_slots=host_slots)
+                           host_slots=host_slots, disk_hashes=disk_hashes)
+
+    def abort_plan(self, plan: "PrefillPlan") -> None:
+        """Release a plan that will never admit: device block holds drop
+        and the disk-tier pins (taken at match) release."""
+        self.pool.release(plan.all_blocks)
+        if plan.disk_hashes and self.disk_store is not None:
+            self.disk_store.unpin(plan.disk_hashes)
 
     def register_full_blocks(self, plan_blocks: List[int],
                              seq: TokenBlockSequence,

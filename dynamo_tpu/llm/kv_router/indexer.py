@@ -34,9 +34,17 @@ class OverlapScores:
     indexer.rs:429-436)."""
 
     def __init__(self, scores: Optional[Dict[int, int]] = None,
-                 frequencies: Optional[List[int]] = None):
+                 frequencies: Optional[List[int]] = None,
+                 weighted: Optional[Dict[int, float]] = None):
         self.scores: Dict[int, int] = scores or {}
         self.frequencies: List[int] = frequencies or []
+        # tier-discounted effective overlap per worker (scoring.py
+        # TIER_WEIGHTS): equals ``scores`` when every matched block is
+        # device-resident. The scheduler consumes this, so a worker whose
+        # matched prefix lives on disk wins ties only against recompute,
+        # not against an HBM-resident copy elsewhere.
+        self.weighted: Dict[int, float] = (
+            dict(weighted) if weighted is not None else dict(self.scores))
 
     def best(self) -> Optional[int]:
         if not self.scores:
@@ -292,6 +300,10 @@ class KvIndexer:
         indexer.rs:525-560)."""
         self.block_size = block_size
         self.tree = make_radix_index(prefer_native, expiration_s)
+        # (worker_id, seq_hash) → tier, tracked OUTSIDE the tree (both
+        # tree backends stay tier-agnostic; device is the implicit
+        # default and never stored here)
+        self._tiers: Dict[tuple, str] = {}
         self._queue: asyncio.Queue = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
 
@@ -300,9 +312,19 @@ class KvIndexer:
         if event.stored is not None:
             self.tree.apply_stored(event.worker_id, event.stored.parent_hash,
                                    event.stored.block_hashes)
+            tier = getattr(event.stored, "tier", "device") or "device"
+            for h in event.stored.block_hashes:
+                key = (event.worker_id, h)
+                if tier == "device":
+                    # promotion back to HBM restores full weight
+                    self._tiers.pop(key, None)
+                else:
+                    self._tiers[key] = tier
         if event.removed is not None:
             self.tree.apply_removed(event.worker_id,
                                     event.removed.block_hashes)
+            for h in event.removed.block_hashes:
+                self._tiers.pop((event.worker_id, h), None)
 
     async def enqueue_event(self, event: RouterEvent) -> None:
         self._ensure_task()
@@ -324,10 +346,21 @@ class KvIndexer:
 
     def remove_worker(self, worker_id: int) -> None:
         self.tree.remove_worker(worker_id)
+        self._tiers = {k: v for k, v in self._tiers.items()
+                       if k[0] != worker_id}
 
     # -- query side
     def find_matches(self, block_hashes: Sequence[int]) -> OverlapScores:
-        return self.tree.find_matches(block_hashes)
+        scores = self.tree.find_matches(block_hashes)
+        if self._tiers:
+            from .scoring import TIER_WEIGHTS
+            for w, depth in scores.scores.items():
+                eff = 0.0
+                for i in range(depth):
+                    tier = self._tiers.get((w, block_hashes[i]), "device")
+                    eff += TIER_WEIGHTS.get(tier, 1.0)
+                scores.weighted[w] = eff
+        return scores
 
     def find_matches_for_request(self, token_ids: Sequence[int]
                                  ) -> OverlapScores:
@@ -359,10 +392,12 @@ class KvIndexerSharded:
     def find_matches_for_request(self, token_ids) -> OverlapScores:
         hashes = compute_block_hashes(token_ids, self.block_size)
         merged: Dict[int, int] = {}
+        weighted: Dict[int, float] = {}
         freqs: List[int] = []
         for sh in self.shards:
             r = sh.find_matches(hashes)
             merged.update(r.scores)
+            weighted.update(r.weighted)
             # each shard tracks its own subtree's uses; take the
             # elementwise max as the merged hotness view
             for i, f in enumerate(r.frequencies):
@@ -370,4 +405,4 @@ class KvIndexerSharded:
                     freqs[i] = max(freqs[i], f)
                 else:
                     freqs.append(f)
-        return OverlapScores(merged, freqs)
+        return OverlapScores(merged, freqs, weighted)

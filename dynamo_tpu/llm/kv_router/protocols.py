@@ -35,6 +35,20 @@ class ForwardPassMetrics:
     spec_accepted_total: int = 0
     spec_acceptance_rate: float = 0.0
     spec_accepted_per_step: float = 0.0
+    # KV tier ladder (llm/kv/offload.py host tier + llm/kv/diskstore.py
+    # G3 disk tier) — the nv_llm_kv_host_* / nv_llm_kv_disk_* gauge
+    # feeds (components/metrics.py). Defaults keep old payloads decoding.
+    host_stored_total: int = 0
+    host_evicted_total: int = 0
+    host_hit_rate: float = 0.0
+    disk_used_blocks: int = 0
+    disk_capacity_blocks: int = 0
+    disk_stored_total: int = 0
+    disk_evicted_total: int = 0
+    disk_hit_rate: float = 0.0
+    disk_bytes_used: int = 0
+    disk_spill_dropped_total: int = 0
+    offload_dropped_jobs_total: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -54,6 +68,14 @@ class KvStoredEvent:
     block_hashes: List[int]
     tokens_hashes: List[int] = dataclasses.field(default_factory=list)
     lora_id: int = 0
+    # which rung of the ladder holds the blocks: "device" (HBM, the
+    # historical default — absent in old payloads), "host" (TPU-VM DRAM)
+    # or "disk" (the persistent G3 store). The router's radix index
+    # keeps tier per (worker, hash) and the scheduler discounts colder
+    # tiers' overlap depth (kv_router/scoring.py TIER_WEIGHTS) — a
+    # disk-resident prefix is worth routing to, but less than an
+    # HBM-resident one.
+    tier: str = "device"
 
 
 @dataclasses.dataclass

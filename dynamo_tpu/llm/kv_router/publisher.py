@@ -39,12 +39,18 @@ class KvEventPublisher:
 
     # engine-side hooks (called synchronously from the engine loop) ---------
     def publish_stored(self, block_id: int, seq_hash: int, tokens_hash: int,
-                       parent_hash: Optional[int]) -> None:
+                       parent_hash: Optional[int],
+                       tier: str = "device") -> None:
+        """``tier`` tags which rung of the KV ladder holds the block
+        (device | host | disk) — the router discounts colder tiers'
+        overlap depth (kv_router/scoring.py TIER_WEIGHTS)."""
         self._enqueue(RouterEvent(
             worker_id=self.worker_id, event_id=self._next_id(),
             stored=KvStoredEvent(parent_hash=parent_hash,
                                  block_hashes=[seq_hash],
-                                 tokens_hashes=[tokens_hash])))
+                                 tokens_hashes=[tokens_hash]
+                                 if tokens_hash is not None else [],
+                                 tier=tier)))
 
     def publish_removed(self, seq_hashes: list) -> None:
         self._enqueue(RouterEvent(

@@ -99,9 +99,11 @@ class KvScheduler:
         self._opt_slots[best_worker.worker_id] = (
             self._opt_slots.get(best_worker.worker_id, 0) + 1)
         if self.on_hit_rate is not None:
+            # tier-weighted overlap may be fractional; the hit-rate
+            # event's contract is whole blocks
             self.on_hit_rate(KVHitRateEvent(
                 worker_id=best_worker.worker_id, isl_blocks=isl_blocks,
-                overlap_blocks=overlap_blocks))
+                overlap_blocks=int(round(overlap_blocks))))
         logger.debug("scheduled worker=%d cost=%.3f overlap=%d/%d alpha=%.1f",
                      best_worker.worker_id, best_cost, overlap_blocks,
                      isl_blocks, alpha)

@@ -1,13 +1,34 @@
 """Endpoint load scoring (reference lib/llm/src/kv_router/scoring.rs:24-55:
-`ProcessedEndpoints` — load average/stddev over kv_active_blocks)."""
+`ProcessedEndpoints` — load average/stddev over kv_active_blocks) plus the
+KV-tier overlap weights: a matched prefix block is worth less the colder
+the tier that holds it, because serving it costs a promote (host h2d
+scatter, or a disk read + h2d scatter) instead of a free HBM reuse."""
 
 from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Dict, List
+from typing import Dict, List, Sequence
 
 from .protocols import ForwardPassMetrics
+
+# Per-tier overlap discount (the indexer tags each (worker, hash) with
+# the announcing event's tier; KvIndexer.tier_weighted applies these).
+# device = free HBM reuse; host = one DRAM→HBM scatter (~the +40% TTFT
+# win's cost side); disk = a file read + scatter — still far cheaper
+# than recomputing the prefix, hence > 0.
+TIER_WEIGHTS: Dict[str, float] = {"device": 1.0, "host": 0.8, "disk": 0.5}
+
+
+def tier_weighted_depth(depth: int, tiers: Sequence[str]) -> float:
+    """Effective overlap of one worker's ``depth`` leading matched blocks
+    given each block's tier tag (entries beyond ``tiers`` default to
+    device)."""
+    total = 0.0
+    for i in range(depth):
+        tier = tiers[i] if i < len(tiers) else "device"
+        total += TIER_WEIGHTS.get(tier, 1.0)
+    return total
 
 
 @dataclasses.dataclass

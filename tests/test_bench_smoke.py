@@ -62,6 +62,35 @@ def test_bench_runs_and_prints_json():
     assert spec["effective_tok_per_s"] > 0
 
 
+def test_bench_kv_disk_mode(tmp_path):
+    """--kv-disk rides a bench run (ISSUE 3 satellite): the result line
+    must carry the `kv_disk` provenance dict — cold vs warm-restart TTFT
+    against a tmpdir disk tier, with the warm run actually hitting the
+    disk and the token streams bit-exact."""
+    import pytest
+    if os.environ.get("CI_SKIP_SLOW"):
+        pytest.skip("slow smoke")
+    r = _run(
+        [sys.executable, "bench.py", "--kv-disk"],
+        {"BENCH_FORCE_CPU": "1", "BENCH_MODEL": "tiny", "BENCH_BATCH": "2",
+         "BENCH_STEPS": "4", "BENCH_PROMPT": "8", "BENCH_HARVEST": "2",
+         "BENCH_QUANT": "none", "BENCH_DEVICE": "0",
+         "BENCH_KV_DISK_PROMPT": "32",
+         "BENCH_KV_DISK_DIR": str(tmp_path / "kvdisk")})
+    assert r.returncode == 0, f"bench.py crashed:\n{r.stderr[-4000:]}"
+    out = json.loads([l for l in r.stdout.strip().splitlines()
+                      if l.startswith("{")][-1])
+    assert "error" not in out, f"bench fell back instead of running: {out}"
+    kd = out.get("kv_disk")
+    assert kd, f"no kv_disk provenance in the result: {out}"
+    assert kd["cold_hit_tokens"] == 0
+    assert kd["warm_hit_tokens"] >= 16          # prefix came from disk
+    assert kd["warm_restart_onboards"] >= 1     # onboarded, not recomputed
+    assert kd["disk_blocks_after_cold"] >= 1
+    assert kd["tokens_bit_exact"] is True
+    assert kd["cold_ttft_ms"] > 0 and kd["warm_ttft_ms"] > 0
+
+
 def test_bench_mla_geometry_runs():
     """The MLA bench path (latent {"kv"} pool, absorbed-decode flop
     accounting): bench.py must run the deepseek-class geometry — the

@@ -73,6 +73,58 @@ def test_host_pool_store_match_lru_eviction():
     assert pool.evicted_blocks_total == 1
 
 
+def test_host_pool_eviction_o1_with_mostly_pinned_pool():
+    """Victim selection must stay O(1) amortized when the pool is mostly
+    pinned: the first eviction requeues the pinned front-runners once
+    (≤ capacity scan steps), after which every eviction finds its victim
+    immediately — the old implementation re-scanned the whole LRU dict
+    per eviction (O(n) each, O(n·m) for m stores)."""
+    cap = 64
+    pool = HostKvPool(capacity_blocks=cap, num_layers=L, num_kv_heads=H,
+                      block_size=BS, head_dim=D)
+    one = {"k": np.zeros((L, H, 1, BS, D), np.float32),
+           "v": np.zeros((L, H, 1, BS, D), np.float32)}
+    for h in range(cap):
+        assert len(pool.store([h], one)) == 1
+    # pin everything except the newest entry
+    pool.pin([pool._by_hash[h] for h in range(cap - 1)])
+    n_stores = 50
+    for h in range(100, 100 + n_stores):
+        assert len(pool.store([h], one)) == 1, "placeable slot missed"
+    # correctness: every pinned block survived
+    assert all(pool.contains(h) for h in range(cap - 1))
+    assert pool.evicted_blocks_total == n_stores
+    # amortized O(1): the pinned prefix requeues once (≤ cap steps), not
+    # once per store (which would be ~n_stores * cap steps)
+    assert pool.evict_scan_steps <= cap + n_stores, (
+        f"{pool.evict_scan_steps} scan steps for {n_stores} evictions — "
+        f"victim selection degraded to O(n) per eviction")
+    # unpinning re-queues the parked candidates (documented semantics:
+    # they rejoin at the LRU back, losing their pre-pin position) — the
+    # pool stays fully placeable and evictions resume normally
+    pool.unpin([pool._by_hash[h] for h in range(cap - 1)])
+    assert len(pool.store([999], one)) == 1
+    assert pool.contains(999) and len(pool) == cap
+
+
+@pytest.mark.asyncio
+async def test_offload_engine_backpressure_drops_with_counter():
+    """A saturated write-back queue DROPS the job (releasing its device
+    holds) and counts it — never an unbounded backlog pinning blocks."""
+    released = []
+    host = HostKvPool(capacity_blocks=4, num_layers=L, num_kv_heads=H,
+                      block_size=BS, head_dim=D)
+    eng = KvOffloadEngine(host, BS, get_kv=lambda: {},
+                          release_holds=released.extend,
+                          max_queue_jobs=0)
+    eng.enqueue(OffloadJob(block_ids=[3, 4], seq_hashes=[13, 14]))
+    assert eng.dropped_jobs_total == 1
+    assert released == [3, 4]          # holds released despite the drop
+    eng.enqueue(OffloadJob(block_ids=[5], seq_hashes=[15]))
+    assert eng.dropped_jobs_total == 2
+    assert eng.offloaded_blocks_total == 0
+
+
 def test_host_pool_fetch_returns_stacked_layout():
     pool = HostKvPool(capacity_blocks=4, num_layers=L, num_kv_heads=H,
                       block_size=BS, head_dim=D)
