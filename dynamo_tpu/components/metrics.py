@@ -47,6 +47,19 @@ _SPEC_GAUGES = {
     "spec_accepted_total": "nv_llm_spec_accepted_tokens",
 }
 
+# pipeline parallelism (parallel/pipeline_parallel.py):
+# ForwardPassMetrics field → exported metric name. Stage count and
+# microbatch slots are topology facts; utilization/bubble are the
+# dispatch-level interleave model (K·pp/(K·pp+pp-1) and complement) —
+# the Grafana "Pipeline" row plots them so a misconfigured K (deep
+# bubble) is visible at a glance.
+_PP_GAUGES = {
+    "pp_stages": "nv_llm_pp_stages",
+    "pp_microbatch": "nv_llm_pp_microbatch_slots",
+    "pp_utilization": "nv_llm_pp_steady_state_utilization",
+    "pp_bubble_fraction": "nv_llm_pp_bubble_fraction",
+}
+
 # KV tier ladder (host DRAM tier + persistent disk G3 tier):
 # ForwardPassMetrics field → exported metric name. The host counters
 # were previously module-local only (llm/kv/offload.py stats); now they
@@ -89,6 +102,10 @@ class MetricsAggregatorService:
             f: Gauge(name, f"speculative decoding: worker {f} "
                      "(scraped stats)", labels, registry=self.registry)
             for f, name in _SPEC_GAUGES.items()}
+        self._pp_gauges: Dict[str, Gauge] = {
+            f: Gauge(name, f"pipeline parallelism: worker {f} "
+                     "(scraped stats)", labels, registry=self.registry)
+            for f, name in _PP_GAUGES.items()}
         self._tier_gauges: Dict[str, Gauge] = {
             f: Gauge(name, f"KV tier ladder: worker {f} (scraped stats)",
                      labels, registry=self.registry)
@@ -214,6 +231,8 @@ class MetricsAggregatorService:
                 self._gauges[f].labels(*lbl).set(getattr(m, f))
             for f, g in self._spec_gauges.items():
                 g.labels(*lbl).set(getattr(m, f))
+            for f, g in self._pp_gauges.items():
+                g.labels(*lbl).set(getattr(m, f))
             for f, g in self._tier_gauges.items():
                 g.labels(*lbl).set(getattr(m, f))
         # drop series for workers whose leases died (the watcher pruned them)
@@ -222,6 +241,7 @@ class MetricsAggregatorService:
             lbl = self._labels(gone)
             for g in (list(self._gauges.values())
                       + list(self._spec_gauges.values())
+                      + list(self._pp_gauges.values())
                       + list(self._tier_gauges.values())):
                 try:
                     g.remove(*lbl)

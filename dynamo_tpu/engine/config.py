@@ -521,6 +521,10 @@ class EngineConfig:
     launch/dynamo-run/src/flags.rs, plus XLA-specific bucketing)."""
 
     max_model_len: int = 2048
+    # 0 = auto-select at engine bring-up from the model geometry
+    # (auto_kv_block_size: the round-5 small-C finding promoted from a
+    # bench.py-only default — KVH·Dh <= 128 rows are DMA-latency-bound
+    # at 16, a 64-token block quadruples the per-DMA payload)
     kv_block_size: int = 16
     num_kv_blocks: int = 512          # HBM KV pool size (blocks across all seqs)
     max_num_seqs: int = 8             # decode batch slots
@@ -549,6 +553,18 @@ class EngineConfig:
     dp: int = 1                       # data parallel replicas inside one engine
     sp: int = 1                       # sequence parallel (ring attention) for prefill
     ep: int = 1                       # expert parallel (MoE)
+    # pipeline parallel (parallel/pipeline_parallel.py): layer stacks +
+    # KV pool shard L over a "pp" stage ring — the DCN-viable cross-host
+    # axis. Decode runs TOKEN-INTERLEAVED: the batch splits into pp
+    # microbatches round-robined through the stages so every rank
+    # computes a live microbatch each tick (steady-state utilization
+    # K·pp/(K·pp+pp-1) per dispatch, vs 1/pp for a bubbled loop), and
+    # prefill chunks pipeline the same way. Composes with tp (in-stage
+    # Megatron split + psum) only; requires decode_steps_per_dispatch>1,
+    # max_num_seqs and every prefill bucket divisible by pp. Refused (at
+    # bring-up, loudly): MLA, weight/KV quantization, speculative
+    # decoding, sp, sliding-window families.
+    pp: int = 1
     # shortest cold prefill worth the ring path (per-layer shard_map +
     # sp-1 ppermute rounds); shorter prompts stay on the chunked program
     sp_min_prefill_tokens: int = 512
@@ -622,7 +638,54 @@ class EngineConfig:
     quantization: str = "none"
     seed: int = 0
 
+    @staticmethod
+    def auto_kv_block_size(model_cfg: "ModelConfig",
+                           kv_quantization: str = "none") -> int:
+        """Bring-up auto-selection for ``kv_block_size=0`` — the ONE home
+        of the block-size policy, shared by EngineCore bring-up and
+        bench.py so the served default and the benched default cannot
+        drift. Small-C geometries (KVH·Dh <= 128 — e.g. the 70B TP-8
+        shard's single KV head) are DMA-latency-bound at 16-token
+        blocks: a 64-token block quadruples the per-DMA payload
+        (round-5 probe: kernel 132 → 81 us/call, device step 29.3 →
+        22.8 ms at the gate config, bs=16). int8 pools need 32 (the
+        int8 sublane tile, attention.py pallas_supported); everything
+        else keeps the 16-token default."""
+        small_c = model_cfg.num_kv_heads * model_cfg.head_dim <= 128
+        if small_c:
+            return 64
+        return 32 if kv_quantization == "int8" else 16
+
     def __post_init__(self) -> None:
+        if self.kv_block_size < 0:
+            raise ValueError("kv_block_size must be >= 0 (0 = auto-select "
+                             "at engine bring-up)")
+        if self.pp > 1:
+            if self.decode_steps_per_dispatch <= 1:
+                raise ValueError(
+                    "pp > 1 requires decode_steps_per_dispatch > 1 (the "
+                    "token-interleaved stage ring amortizes its "
+                    "(pp-1)-tick fill/drain ramp over the K-step "
+                    "dispatch; the single-step decode path has no pp "
+                    "form)")
+            if self.max_num_seqs % self.pp:
+                raise ValueError(
+                    f"pp={self.pp} must divide max_num_seqs="
+                    f"{self.max_num_seqs} (one microbatch per stage)")
+            if self.sp > 1 or self.dp > 1 or self.ep > 1:
+                raise ValueError(
+                    "pp composes with tp only (in-stage split-matmul); "
+                    "sp/dp/ep must stay 1 on a pp engine")
+            if self.spec_k > 0:
+                raise NotImplementedError(
+                    "speculative decoding on a pp engine is not "
+                    "implemented (the verify program has no "
+                    "token-interleaved form yet)")
+            if self.quantization != "none" or self.kv_quantization != "none":
+                raise NotImplementedError(
+                    "pp with weight/KV quantization is not implemented "
+                    "(QuantizedArray leaves under the stage shard_map "
+                    "are unvalidated)")
         if self.decode_dispatch_pipeline and self.decode_steps_per_dispatch <= 1:
             raise ValueError(
                 "decode_dispatch_pipeline requires decode_steps_per_dispatch"
@@ -647,6 +710,13 @@ class EngineConfig:
                 self.max_model_len]
         if self.prefill_buckets[-1] < self.max_model_len:
             self.prefill_buckets.append(self.max_model_len)
+        if self.pp > 1:
+            bad = [b for b in self.prefill_buckets if b % self.pp]
+            if bad or (self.prefill_chunk and self.prefill_chunk % self.pp):
+                raise ValueError(
+                    f"pp={self.pp} must divide every prefill bucket and "
+                    f"prefill_chunk (one sub-chunk per stage): offending "
+                    f"buckets={bad}, chunk={self.prefill_chunk}")
 
     @property
     def max_blocks_per_seq(self) -> int:

@@ -122,9 +122,38 @@ class EngineCore:
                  params: Optional[dict] = None, attn_impl: str = "auto",
                  param_dtype=jnp.bfloat16, mesh=None,
                  kv_event_publisher=None):
+        if engine_cfg.kv_block_size == 0:
+            # bring-up auto-selection (EngineConfig.auto_kv_block_size —
+            # the round-5 small-C finding, promoted from a bench.py-only
+            # default): resolved HERE, before anything reads the block
+            # size, so every downstream consumer sees a concrete value
+            engine_cfg = dataclasses.replace(
+                engine_cfg,
+                kv_block_size=EngineConfig.auto_kv_block_size(
+                    model_cfg, engine_cfg.kv_quantization))
         self.model_cfg = model_cfg
         self.cfg = engine_cfg
         self.mesh = mesh
+        # pipeline parallelism (parallel/pipeline_parallel.py): the mesh
+        # is authoritative — a "pp" axis switches param/KV placement and
+        # the whole compiled program set to the token-interleaved stage
+        # ring. EngineConfig.pp must agree when set (every rank of a
+        # multihost engine builds from identical flags).
+        self.pp = (mesh.shape["pp"]
+                   if mesh is not None and "pp" in mesh.axis_names else 1)
+        if engine_cfg.pp > 1 and engine_cfg.pp != self.pp:
+            raise ValueError(
+                f"EngineConfig.pp={engine_cfg.pp} but the mesh carries "
+                f"pp={self.pp} — build the mesh with make_pp_mesh(pp, tp)")
+        if self.pp > 1:
+            # the mesh can carry pp the config never saw (tests build
+            # meshes directly): re-run the config-level pp validation
+            # against the REAL stage count, then the model-level checks
+            dataclasses.replace(engine_cfg, pp=self.pp)  # raises on misuse
+            if model_cfg.kv_lora_rank > 0:
+                raise NotImplementedError(
+                    "pp with MLA latent-KV attention is not implemented "
+                    "(the latent pool has no per-stage form yet)")
         # model-family dispatch: MLA (deepseek-class latent-KV attention)
         # vs the llama family. The MLA integration is single-chip,
         # full-precision first — each unsupported combination refuses
@@ -194,10 +223,15 @@ class EngineCore:
         if (mesh is None
                 and os.environ.get("DYN_FUSE_MATMULS", "1") != "0"):
             # single-device decode perf: wq|wk|wv → wqkv, gate|up →
-            # gateup (llama.fuse_stacked_matmuls — under a mesh the
-            # fused out axis cannot carry the tp column permutation).
-            # dict(): the transform deletes split keys — never from the
-            # caller's own tree
+            # gateup (llama.fuse_stacked_matmuls). The gate is ANY mesh,
+            # not just tp: under tp the fused out axis cannot carry the
+            # column permutation the TP-8 projection was flagged for,
+            # and under pp (even tp=1) the stage ring shards the UNFUSED
+            # per-tensor layout — a pp mesh silently taking the fused
+            # path would break pp_param_pspecs' per-key placement
+            # (test_pipeline_parallel asserts no fused keys on a pp
+            # core). dict(): the transform deletes split keys — never
+            # from the caller's own tree
             params = llama.fuse_stacked_matmuls(dict(params), model_cfg)
         self.params = params
         kv_shards = 1
@@ -228,7 +262,25 @@ class EngineCore:
                 engine_cfg.kv_block_size, dtype=param_dtype,
                 quantization=engine_cfg.kv_quantization,
                 kv_shards=kv_shards)
-        if mesh is not None:
+        if mesh is not None and self.pp > 1:
+            # pp(×tp) placement: layer stacks + KV pool shard L over the
+            # stage ring; embed/final_norm/lm_head replicate (the last
+            # stage samples locally). Validates layer divisibility and
+            # the sliding-window refusal up front.
+            from ..parallel.pipeline_parallel import (place_pp,
+                                                      pp_split_config)
+            pp_split_config(self.statics, self.pp)
+            self.params, self.kv = place_pp(self.params, self.kv, mesh,
+                                            model_cfg)
+            if model_cfg.lm_head_pallas:
+                # the stage's in-shard_map _logits has no Pallas
+                # partitioning rule — route to the XLA head paths
+                model_cfg = dataclasses.replace(model_cfg,
+                                                lm_head_pallas=False)
+                self.model_cfg = model_cfg
+                self.statics = dataclasses.replace(self.statics,
+                                                   cfg=model_cfg)
+        elif mesh is not None:
             # place params/KV under the tp/sp layout; every jitted step then
             # runs SPMD over the mesh with XLA-inserted ICI collectives
             from ..parallel.sharding import shard_kv, shard_params
@@ -368,7 +420,62 @@ class EngineCore:
         self.host_stall_s = 0.0
 
     # ------------------------------------------------------------------ jit
+    def _compile_jits_pp(self) -> None:
+        """Pipeline-parallel program set (parallel/pipeline_parallel.py),
+        with the SAME host-facing contracts as the single-device
+        programs — prefill(params, kv, tokens, table, start_pos,
+        true_len, key, temp, top_k, top_p) → (tok, logprob, kv) and the
+        K-step decode scan's (toks [K,B], logprobs [K,B], kv). Keeping
+        the contracts identical is what makes every engine path —
+        dispatch pipelining, harvest, preemption, lane prefill, chunked
+        prefill, engine/replay.py and the multihost followers' stage
+        dispatches — compose with pp UNCHANGED: followers and the
+        offline replayer re-issue the recorded events through these same
+        jits. The single-step _decode_jit has no pp form (EngineConfig
+        requires K > 1); spec verify and sp prefill are refused at
+        bring-up."""
+        from ..parallel.pipeline_parallel import (pp_decode_k_forward,
+                                                  pp_prefill_forward)
+        statics = self.statics
+        mesh = self.mesh
+        K = self.cfg.decode_steps_per_dispatch
+        seed = self.cfg.seed
+
+        def prefill(params, kv, tokens, block_table, start_pos, true_len,
+                    key, temperature, top_k, top_p):
+            logits, kv = pp_prefill_forward(
+                params, kv, tokens, block_table, start_pos, true_len,
+                statics, mesh)
+            tok, logprob = sample_tokens(
+                logits[None, :], key[None], temperature[None],
+                top_k[None], top_p[None])
+            return tok[0], logprob[0], kv
+
+        self._prefill_jit = jax.jit(prefill, donate_argnums=(1,))
+        self._decode_jit = None
+
+        def decode_k(params, kv, tokens, positions, block_tables,
+                     seeds, steps0, temperature, top_k, top_p,
+                     planned, planned_mask):
+            return pp_decode_k_forward(
+                params, kv, tokens, positions, block_tables, seeds,
+                steps0, temperature, top_k, top_p, planned,
+                planned_mask, statics, mesh, K, seed)
+
+        self._decode_k_jit = jax.jit(decode_k, donate_argnums=(1,))
+        self._planned_zero = (jnp.zeros((K, self.cfg.max_num_seqs),
+                                        jnp.int32),
+                              jnp.zeros((K, self.cfg.max_num_seqs), bool))
+        self._merge_jit = jax.jit(
+            lambda dev, host, mask: jnp.where(mask, dev, host))
+        self._verify_jit = None
+        self._prefill_sp_jit = None
+        self._sp = 1
+
     def _compile_jits(self) -> None:
+        if self.pp > 1:
+            self._compile_jits_pp()
+            return
         statics = self.statics
         # packed-int4 weights unpack ONCE at the top of every program —
         # a K-step decode dispatch then reads S4 at packed bandwidth
@@ -763,6 +870,15 @@ class EngineCore:
         if self.offload_engine is not None:
             tier_kw.update(offload_dropped_jobs_total=self
                            .offload_engine.dropped_jobs_total)
+        if self.pp > 1:
+            from ..parallel.pipeline_parallel import (
+                pp_bubble_fraction, pp_dispatch_utilization)
+            K = self.cfg.decode_steps_per_dispatch
+            tier_kw.update(
+                pp_stages=self.pp,
+                pp_microbatch=self.B // self.pp,
+                pp_utilization=pp_dispatch_utilization(self.pp, K),
+                pp_bubble_fraction=pp_bubble_fraction(self.pp, K))
         if disk is not None:
             tier_kw.update(
                 disk_used_blocks=disk.used_blocks,

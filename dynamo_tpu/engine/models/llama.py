@@ -398,7 +398,9 @@ class ModelStatics:
 
 def _run_layers(params: Params, kv: KVCache, x: jax.Array,
                 positions: jax.Array, slots: jax.Array, cfg: ModelConfig,
-                attn_fn, final_norm: bool = True) -> Tuple[jax.Array, KVCache]:
+                attn_fn, final_norm: bool = True,
+                reduce_axis: Optional[str] = None
+                ) -> Tuple[jax.Array, KVCache]:
     """Shared transformer stack: per layer — qkv projection, rope, KV
     scatter into the paged pool, ``attn_fn`` (the only thing the three
     forward paths differ in), wo residual, swiglu MLP; scanned over the
@@ -414,6 +416,14 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
     gather indices by li), and ``sliding`` is this layer's
     local-attention flag (bool scalar, traced through the scan — gemma2
     interleaved window layers).
+
+    ``reduce_axis``: mesh axis name to psum the row-parallel matmul
+    outputs (wo, MLP down) over — the manual-collective hook the pp×tp
+    stage loop uses under shard_map, where GSPMD cannot insert the
+    Megatron reductions for it (parallel/pipeline_parallel.py). The
+    psum lands BEFORE any post-norm/residual so the un-reduced partial
+    sums never leak into the stream. None (every jit/GSPMD caller)
+    changes nothing.
 
     The KV pool rides the scan as a CARRY with in-place [li, slots]
     scatters — NOT as per-layer xs/ys slices. The ys form forced XLA to
@@ -480,6 +490,8 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
         attn = attn_fn(q, k, v, kp.reshape(L * NTOK, kp.shape[2]),
                        vp.reshape(L * NTOK, vp.shape[2]), li, sliding)
         attn_out = mm(attn.reshape(N, -1), lp["wo"])
+        if reduce_axis is not None:   # row-parallel wo under shard_map tp
+            attn_out = jax.lax.psum(attn_out, reduce_axis)
         if cfg.post_norms:   # gemma2: norm the block output, then residual
             attn_out = rms_norm(attn_out, lp["ln1_post"],
                                 cfg.rms_norm_eps, p1)
@@ -500,6 +512,8 @@ def _run_layers(params: Params, kv: KVCache, x: jax.Array,
             mlp_out = swiglu(hn2, lp.get("gate"), lp.get("up"),
                              lp["down"], cfg.hidden_act,
                              gateup_w=lp.get("gateup"))
+        if reduce_axis is not None:   # row-parallel down under shard_map tp
+            mlp_out = jax.lax.psum(mlp_out, reduce_axis)
         if cfg.post_norms:
             mlp_out = rms_norm(mlp_out, lp["ln2_post"], cfg.rms_norm_eps, p1)
         h = h + mlp_out

@@ -56,6 +56,17 @@ induction, no bulk KV on the wire. A host-restored admission then
 replays h2d locally: "hit_transfer" carries the mirror slots + device
 targets and the follower runs the same scatter program the leader ran.
 
+Pipeline parallelism rides this stream UNCHANGED: a pp engine's stage
+dispatches are ordinary "prefill"/"dispatch" events — the pp core's
+_prefill_jit/_decode_k_jit keep the single-device host contracts
+(engine/core._compile_jits_pp), so followers re-issue the recorded
+events through their OWN pp-compiled programs and enter the stage
+ring's ppermutes in lockstep. The one pp-specific requirement is the
+standing one: every rank builds from identical flags (--pp/--tp
+included), or the shard_map programs disagree at the first collective.
+attach() keeps enforcing decode_steps_per_dispatch > 1, which a pp
+config guarantees (EngineConfig refuses pp with K=1).
+
 The disk (G3) tier extends the same contract one rung down: each
 "kv_store" event additionally names the evicted hashes the leader's
 disk spill queue ACCEPTED ("spills" — the enqueue decision, made

@@ -24,6 +24,14 @@ device order), then
 Recording copies only small host arrays; it does not synchronize the
 device, so it can run inside the adversarial sweeps without perturbing
 the interleaving materially.
+
+Pipeline-parallel runs record and replay through the SAME event set:
+the pp core's _prefill_jit/_decode_k_jit keep the single-device host
+contracts (engine/core._compile_jits_pp), so exec_prefill_event /
+exec_dispatch_event marshal a recorded pp schedule into the
+token-interleaved stage programs untouched — replay() against a
+same-config pp core is bit-exact (tests/test_pipeline_parallel.py), and
+the live multihost follower consumes the identical stream.
 """
 
 from __future__ import annotations

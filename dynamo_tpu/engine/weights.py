@@ -476,7 +476,15 @@ def load_params_sharded(model_dir: str, mesh,
                 f"no expert tensors found at layer {lo} under any of "
                 f"{_EXPERT_PREFIXES} — checkpoint/config mismatch")
 
-        specs = param_pspecs(cfg)
+        if "pp" in mesh.axis_names and mesh.shape["pp"] > 1:
+            # pipeline-parallel mesh: layer stacks stream straight into
+            # their L-over-"pp" (×in-stage "tp") placement — each rank
+            # reads only ITS layer slice off disk, the per-host working
+            # set the cross-host capacity axis exists for
+            from ..parallel.pipeline_parallel import pp_param_pspecs
+            specs = pp_param_pspecs(cfg, tp=mesh.shape["tp"])
+        else:
+            specs = param_pspecs(cfg)
         params: Dict[str, jax.Array] = {}
         if cfg.kv_lora_rank > 0:
             from .models.mla import param_shapes

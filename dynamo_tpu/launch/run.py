@@ -51,7 +51,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="address other hosts can dial back (DCN)")
     # engine knobs (flags.rs analogs)
     p.add_argument("--max-model-len", type=int, default=4096)
-    p.add_argument("--kv-block-size", type=int, default=16)
+    p.add_argument("--kv-block-size", type=int, default=0,
+                   help="paged-KV block size; 0 (default) auto-selects "
+                        "from the model geometry at bring-up "
+                        "(EngineConfig.auto_kv_block_size: 64 for "
+                        "small-C KVH*Dh<=128 geometries, 32 for int8 "
+                        "KV pools, else 16)")
     p.add_argument("--prefill-chunk", type=int, default=0,
                    help="split prompt prefill into fixed-size chunk "
                         "dispatches (0 = whole-prompt)")
@@ -114,6 +119,16 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="dp")
     p.add_argument("--expert-parallel-size", "--ep", type=int, default=1,
                    dest="ep")
+    p.add_argument("--pipeline-parallel-size", "--pp", type=int, default=1,
+                   dest="pp",
+                   help="pipeline-parallel stages (token-interleaved "
+                        "stage ring, parallel/pipeline_parallel.py): "
+                        "layer stacks + KV pool shard over pp; the "
+                        "decode batch round-robins pp microbatches so "
+                        "every stage computes each tick. The DCN-viable "
+                        "cross-host axis. Composes with --tp only; "
+                        "needs --decode-steps-per-dispatch > 1 and "
+                        "--max-num-seqs divisible by pp")
     # multi-node bootstrap (reference MultiNodeConfig, engines.rs:33-50):
     # every host runs the same command with its own --node-rank; rank 0's
     # address is the coordinator
@@ -188,7 +203,7 @@ def engine_config(args):
         spec_k=args.spec_k,
         quantization=args.quantization,
         kv_quantization=args.kv_quantization,
-        tp=args.tp, sp=args.sp, dp=args.dp, ep=args.ep)
+        tp=args.tp, sp=args.sp, dp=args.dp, ep=args.ep, pp=args.pp)
 
 
 def _model_name(args) -> str:
@@ -263,8 +278,18 @@ def build_jax_core(args):
     from ..engine.core import EngineCore
     if not args.model_path:
         raise SystemExit("out=jax needs --model-path")
+    try:
+        ecfg = engine_config(args)   # validates pp/K/batch combos early
+    except (ValueError, NotImplementedError) as e:
+        raise SystemExit(str(e))
     mesh = None
-    if args.tp * args.sp * args.dp * args.ep > 1:
+    if args.pp > 1:
+        # pp(×tp) mesh: the stage ring crosses "pp" (the DCN-viable
+        # axis — on a real multi-host deployment these are the ranks
+        # that straddle hosts), in-stage collectives reduce over "tp"
+        from ..parallel.pipeline_parallel import make_pp_mesh
+        mesh = make_pp_mesh(args.pp, tp=args.tp)
+    elif args.tp * args.sp * args.dp * args.ep > 1:
         from ..parallel.sharding import make_mesh
         mesh = make_mesh(dp=args.dp, tp=args.tp, sp=args.sp, ep=args.ep)
     model_cfg = ModelConfig.from_model_dir(args.model_path)
@@ -272,8 +297,7 @@ def build_jax_core(args):
     if not args.random_weights:
         from ..engine.weights import load_params_auto
         params = load_params_auto(args.model_path, model_cfg, mesh=mesh)
-    return EngineCore(model_cfg, engine_config(args), params=params,
-                      mesh=mesh)
+    return EngineCore(model_cfg, ecfg, params=params, mesh=mesh)
 
 
 async def run_follower_rank(args, out: str) -> None:

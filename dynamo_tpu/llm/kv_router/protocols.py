@@ -49,6 +49,15 @@ class ForwardPassMetrics:
     disk_bytes_used: int = 0
     disk_spill_dropped_total: int = 0
     offload_dropped_jobs_total: int = 0
+    # pipeline parallelism (parallel/pipeline_parallel.py): stage count,
+    # per-stage microbatch slots, and the dispatch-level interleave
+    # model — steady-state utilization K·pp/(K·pp+pp-1) and its bubble
+    # complement — the nv_llm_pp_* gauge feeds (components/metrics.py
+    # "Pipeline" Grafana row). Zeros on non-pp engines / old payloads.
+    pp_stages: int = 0
+    pp_microbatch: int = 0
+    pp_utilization: float = 0.0
+    pp_bubble_fraction: float = 0.0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

@@ -91,3 +91,80 @@ def tp_decode_sensitivity(batch: int, hidden: int, num_layers: int,
             "nominal": nominal,
             "worst": min(band.values()) if band else 0.0,
             "best": max(band.values()) if band else 0.0}
+
+
+# ---------------------------------------------------------------------------
+# Pipeline parallelism over DCN — the boundary-cost model the bench's
+# --pp mode publishes (bench.py run_pp_bench). Why PP is the cross-host
+# axis: TP books 2 psums/layer of [B, D]; PP moves ONE [B/pp, D]
+# microbatch activation per boundary per tick. At the PERF.md reference
+# point ([128, 8192] bf16 = 2 MB over a 25 Gb/s NIC) a boundary costs
+# ~0.65 ms — vs ~95 ms/step for 80 layers of TP collectives.
+# ---------------------------------------------------------------------------
+
+DCN_EFFECTIVE_GBPS = 3.1e9          # 25 Gb/s NIC ≈ 3.1 GB/s effective
+DCN_BOUNDARY_LATENCY_S = 100e-6     # per-hop fixed cost (RPC + NIC)
+# sensitivity grid, same shape as the TP tables: judged at the
+# conservative corner, published across the band
+PP_SENSITIVITY_BW_GBPS = (1.5e9, 3.1e9, 6.0e9)
+PP_SENSITIVITY_LATENCY_S = (50e-6, 100e-6, 250e-6)
+
+
+def pp_boundary_s(batch: int, hidden: int, pp: int,
+                  act_itemsize: int = 2,
+                  eff_bw: float = DCN_EFFECTIVE_GBPS,
+                  latency_s: float = DCN_BOUNDARY_LATENCY_S) -> float:
+    """Wall time for ONE stage-boundary hop of the token-interleaved
+    ring: a [B/pp, D] activation (the microbatch, not the full batch —
+    interleaving shrinks each hop by pp while adding pp hops per full
+    step, so total bytes/step stay one [B, D] activation)."""
+    if pp <= 1:
+        return 0.0
+    nbytes = (batch // pp) * hidden * act_itemsize
+    return nbytes / eff_bw + latency_s
+
+
+def pp_step_model(batch: int, hidden: int, pp: int, K: int,
+                  device_tick_s: float,
+                  act_itemsize: int = 2) -> dict:
+    """Modeled interleaved-decode step economics over DCN boundaries.
+
+    ``device_tick_s`` is the measured per-tick compute time (one stage
+    over one microbatch — bench.py derives it from the interleaved
+    dispatch slope). The model books the FULL serial boundary cost per
+    tick (XLA overlaps much of it with the next tick's compute on real
+    links — same conservatism as the TP tables). Returns per-step wall
+    time, net tok/s across the bw×latency band, utilization and bubble
+    fraction of the K-step dispatch schedule."""
+    from .pipeline_parallel import (pp_bubble_fraction,
+                                    pp_dispatch_ticks,
+                                    pp_dispatch_utilization)
+    ticks = pp_dispatch_ticks(pp, K)
+    band = {}
+    for bw in PP_SENSITIVITY_BW_GBPS:
+        for lat in PP_SENSITIVITY_LATENCY_S:
+            tick_s = device_tick_s + pp_boundary_s(
+                batch, hidden, pp, act_itemsize, eff_bw=bw, latency_s=lat)
+            step_s = tick_s * ticks / K     # one full-batch step = pp
+            # ticks + the amortized ramp
+            band[f"{bw / 1e9:g}GBps/{int(lat * 1e6)}us"] = round(
+                batch / step_s, 1) if step_s > 0 else 0.0
+    nominal_tick = device_tick_s + pp_boundary_s(batch, hidden, pp,
+                                                 act_itemsize)
+    nominal_step = nominal_tick * ticks / K
+    return {
+        "boundary_ms": round(1e3 * pp_boundary_s(batch, hidden, pp,
+                                                 act_itemsize), 3),
+        "boundary_bytes": (batch // max(pp, 1)) * hidden * act_itemsize,
+        "dispatch_ticks": ticks,
+        "utilization": round(pp_dispatch_utilization(pp, K), 4),
+        "bubble_fraction": round(pp_bubble_fraction(pp, K), 4),
+        "nominal_step_ms": round(1e3 * nominal_step, 3),
+        "nominal_tok_per_s": round(batch / nominal_step, 1)
+        if nominal_step > 0 else 0.0,
+        "dcn_sensitivity": band,
+        "worst_corner_tok_per_s": min(band.values()) if band else 0.0,
+        "dcn_model": f"1 [B/pp, D] hop per tick, pp={pp} hops/step @ "
+                     f"{DCN_EFFECTIVE_GBPS / 1e9:g} GB/s effective + "
+                     f"{DCN_BOUNDARY_LATENCY_S * 1e6:.0f}us/hop",
+    }

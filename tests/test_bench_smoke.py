@@ -12,6 +12,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -89,6 +91,47 @@ def test_bench_kv_disk_mode(tmp_path):
     assert kd["disk_blocks_after_cold"] >= 1
     assert kd["tokens_bit_exact"] is True
     assert kd["cold_ttft_ms"] > 0 and kd["warm_ttft_ms"] > 0
+
+
+def test_bench_pp_mode():
+    """--pp rides a bench run (ISSUE 4): BENCH_FORCE_CPU forces a
+    pp-sized virtual CPU mesh (the 8-device dryrun precedent) and the
+    result line must carry the `pp` provenance dict — the v1-bubbled
+    vs v2-interleaved steady-state step comparison with greedy-token
+    equality between the loops, the schedule's utilization model, and
+    the modeled DCN boundary economics. The smoke keeps the seq window
+    small for speed and asserts structure + correctness; the
+    acceptance-grade ratio (< 0.6x v1 at B=8) is measured at the
+    BENCH_PP_SEQ=1024 default (committed run: 0.447)."""
+    r = _run(
+        [sys.executable, "bench.py", "--pp=2"],
+        {"BENCH_FORCE_CPU": "1", "BENCH_MODEL": "tiny", "BENCH_BATCH": "2",
+         "BENCH_STEPS": "4", "BENCH_PROMPT": "8", "BENCH_HARVEST": "2",
+         "BENCH_QUANT": "none", "BENCH_DEVICE": "0",
+         "BENCH_PP_SEQ": "64", "BENCH_PP_HARVEST": "4"})
+    assert r.returncode == 0, f"bench.py crashed:\n{r.stderr[-4000:]}"
+    out = json.loads([l for l in r.stdout.strip().splitlines()
+                      if l.startswith("{")][-1])
+    assert "error" not in out, f"bench fell back instead of running: {out}"
+    pp = out.get("pp")
+    assert pp, f"no pp provenance in the result: {out}"
+    assert pp["pp"] == 2 and pp["microbatch"] == pp["batch"] // 2
+    # the two loops must agree token-for-token, or the comparison is
+    # between diverged programs
+    assert pp["tokens_match_v1"] is True
+    assert pp["v1_bubbled_step_ms"] > 0
+    assert pp["v2_interleaved_step_ms"] > 0
+    # interleaving must never be SLOWER than the bubbled loop, even at
+    # the smoke's shallow seq window (the acceptance bar itself is
+    # judged at the default window, not under CI noise)
+    assert pp["ratio_v2_over_v1"] < 1.0, pp
+    assert pp["dispatch_ticks"] == 4 * 2 + 1
+    assert 0.0 < pp["bubble_fraction"] < 0.2
+    assert pp["utilization_model"] == pytest.approx(8 / 9, abs=1e-3)
+    dcn = pp["dcn"]
+    assert dcn["boundary_bytes"] == pp["microbatch"] * 256 * 2
+    assert dcn["nominal_tok_per_s"] > 0
+    assert dcn["worst_corner_tok_per_s"] > 0
 
 
 def test_bench_mla_geometry_runs():
