@@ -257,6 +257,93 @@ def run_pp_bench(pp: int) -> dict:
     }
 
 
+def kv_frag_mode() -> bool:
+    """Contiguity A/B bench mode (--kv-frag or BENCH_KV_FRAG=1): the
+    same decode workload over the run-allocator's contiguous layout vs
+    a deliberately fragmented permutation of the SAME blocks (ISSUE 5).
+    One parse home for main() and the smoke tests."""
+    return (os.environ.get("BENCH_KV_FRAG", "0") != "0"
+            or "--kv-frag" in sys.argv[1:])
+
+
+def run_kv_frag_bench(core, batch, blocks_per_seq, pos0, *,
+                      temp, topk, topp, seeds, device_time) -> dict:
+    """Measure what physical contiguity buys the decode step. The main
+    run's slots already hold the run-allocator's layout (consecutive
+    block ids per sequence); the fragmented variant reverses each
+    sequence's table row — same blocks, same KV bytes, but descending
+    ids can never satisfy the kernel's wave-coalescing predicate
+    (attention.wave_contig_table), so every wave degrades to per-block
+    DMAs. Reported always: the CPU-side DMA-copy counts the kernel
+    issues for each layout (the acceptance gate: coalescing must cut
+    issued copies >= 2x on the contiguous pool). On real hardware with
+    device timing enabled: the chained-dispatch step-time delta, which
+    rides into BENCH_LOCAL.jsonl with the rest of the record."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.attention import dma_copy_counts
+    from dynamo_tpu.utils.timing import slope_per_unit
+
+    contig = core._block_tables.copy()
+    frag = contig.copy()
+    frag[:, :blocks_per_seq] = frag[:, :blocks_per_seq][:, ::-1]
+    seq_lens = np.full((batch,), pos0 + 1, np.int32)
+    kw = dict(block_size=core.cfg.kv_block_size,
+              pool_blocks=core.cfg.num_kv_blocks,
+              dual_stream=not core.is_mla)
+    c_contig = dma_copy_counts(contig, seq_lens, **kw)
+    c_frag = dma_copy_counts(frag, seq_lens, **kw)
+    res = {
+        "seq_len": int(seq_lens[0]),
+        "dma_copies_contig": c_contig["copies"],
+        "dma_copies_frag": c_frag["copies"],
+        "dma_copies_per_wave_contig": round(
+            c_contig["copies_per_wave"], 3),
+        "dma_copies_per_wave_frag": round(c_frag["copies_per_wave"], 3),
+        "coalesced_waves": c_contig["coalesced_waves"],
+        "waves": c_contig["waves"],
+        "dma_copy_ratio": round(
+            c_frag["copies"] / max(c_contig["copies"], 1), 3),
+    }
+    if device_time and core._decode_k_jit is not None \
+            and jax.devices()[0].platform != "cpu":
+        K = core.cfg.decode_steps_per_dispatch
+        planned, pmask = core._planned_zero
+
+        def chain_for(tables):
+            tb = jnp.asarray(tables)
+
+            def chain(m):
+                core._positions[:] = pos0
+                toks_k = None
+                t0 = time.monotonic()
+                for _ in range(m):
+                    steps0 = jnp.asarray(np.full(
+                        (batch,), core._positions[0], np.int64))
+                    tokens_in = (jnp.array(core._tokens)
+                                 if toks_k is None else toks_k[-1])
+                    toks_k, _lps, core.kv = core._decode_k_jit(
+                        core.params, core.kv, tokens_in,
+                        jnp.array(core._positions), tb, seeds, steps0,
+                        temp, topk, topp, planned, pmask)
+                    core._positions[:] += K
+                np.asarray(toks_k)
+                return time.monotonic() - t0
+
+            return max(slope_per_unit(chain, SLOPE_M1, SLOPE_M2) / K,
+                       1e-9)
+
+        t_contig = chain_for(contig)
+        t_frag = chain_for(frag)
+        res.update(
+            device_step_ms_contig=round(t_contig * 1e3, 3),
+            device_step_ms_frag=round(t_frag * 1e3, 3),
+            device_step_speedup=round(t_frag / t_contig, 3))
+    return res
+
+
 def kv_disk_mode() -> bool:
     """Disk-KV-tier bench mode (--kv-disk or BENCH_KV_DISK=1): measures
     warm-restart TTFT vs cold (ISSUE 3). One parse home for main() and
@@ -1009,6 +1096,15 @@ def main() -> None:
         # a fresh engine warm-starting from the same disk dir
         kv_disk_res = run_kv_disk_bench(mcfg)
 
+    kv_frag_res = None
+    if kv_frag_mode():
+        # after the baseline/device rows (the frag leg rewrites block
+        # tables and positions); the contiguous leg IS the layout the
+        # run-tracking allocator gave the main run's slots
+        kv_frag_res = run_kv_frag_bench(
+            core, batch, blocks_per_seq, pos0, temp=temp, topk=topk,
+            topp=topp, seeds=seeds, device_time=device_time)
+
     pp_res = None
     if pp_mode() > 0:
         # independent small pp-mesh setup (its own geometry — the
@@ -1086,6 +1182,10 @@ def main() -> None:
     if kv_disk_res is not None:
         # disk (G3) tier provenance: warm-restart TTFT vs cold
         result["kv_disk"] = kv_disk_res
+    if kv_frag_res is not None:
+        # contiguity provenance: DMA-copy counts (always) + device
+        # step-time A/B (when the tunnel allows) per layout
+        result["kv_frag"] = kv_frag_res
     if pp_res is not None:
         # pipeline-parallel provenance: interleaved-vs-bubbled step
         # ratio, per-stage utilization, modeled DCN boundary economics

@@ -47,6 +47,21 @@ _SPEC_GAUGES = {
     "spec_accepted_total": "nv_llm_spec_accepted_tokens",
 }
 
+# contiguity-aware KV layout (llm/kv/pool.py run-tracking allocator +
+# engine/attention.py run-coalesced DMA; docs/kv_layout.md):
+# ForwardPassMetrics field → exported metric name. The Grafana "KV
+# layout" row plots frag_ratio against dma-copies-per-wave so a
+# fragmenting pool (rising copies, coalescing losing its DMA win) is
+# visible before it costs step time; defrag_moves_total confirms the
+# compaction pass is actually reclaiming contiguity.
+_LAYOUT_GAUGES = {
+    "kv_frag_ratio": "nv_llm_kv_frag_ratio",
+    "kv_contig_runs": "nv_llm_kv_contig_runs",
+    "kv_contiguity_ratio": "nv_llm_kv_contiguity_ratio",
+    "kv_defrag_moves_total": "nv_llm_kv_defrag_moves_total",
+    "attn_dma_copies_per_wave": "nv_llm_kv_attn_dma_copies_per_wave",
+}
+
 # pipeline parallelism (parallel/pipeline_parallel.py):
 # ForwardPassMetrics field → exported metric name. Stage count and
 # microbatch slots are topology facts; utilization/bubble are the
@@ -110,6 +125,10 @@ class MetricsAggregatorService:
             f: Gauge(name, f"KV tier ladder: worker {f} (scraped stats)",
                      labels, registry=self.registry)
             for f, name in _TIER_GAUGES.items()}
+        self._layout_gauges: Dict[str, Gauge] = {
+            f: Gauge(name, f"KV layout/contiguity: worker {f} "
+                     "(scraped stats)", labels, registry=self.registry)
+            for f, name in _LAYOUT_GAUGES.items()}
         self.hit_isl_blocks = Counter(
             f"{PREFIX}_hit_rate_isl_blocks_total",
             "Routing decisions: total request blocks (ISL)",
@@ -235,6 +254,8 @@ class MetricsAggregatorService:
                 g.labels(*lbl).set(getattr(m, f))
             for f, g in self._tier_gauges.items():
                 g.labels(*lbl).set(getattr(m, f))
+            for f, g in self._layout_gauges.items():
+                g.labels(*lbl).set(getattr(m, f))
         # drop series for workers whose leases died (the watcher pruned them)
         for gone in self._seen_workers - present:
             self.latest.pop(gone, None)
@@ -242,7 +263,8 @@ class MetricsAggregatorService:
             for g in (list(self._gauges.values())
                       + list(self._spec_gauges.values())
                       + list(self._pp_gauges.values())
-                      + list(self._tier_gauges.values())):
+                      + list(self._tier_gauges.values())
+                      + list(self._layout_gauges.values())):
                 try:
                     g.remove(*lbl)
                 except KeyError:

@@ -169,6 +169,15 @@ class MockTokenWorker:
             d["spec_acceptance_rate"] = eng.spec_accepted / eng.spec_drafted
             d["spec_accepted_per_step"] = (eng.spec_accepted
                                            / max(eng.spec_steps, 1))
+        if eng is not None and not d.get("kv_contiguity_ratio"):
+            # synthetic KV-layout gauges (docs/kv_layout.md): a healthy
+            # contiguous pool — one free run, every alloc one run, two
+            # DMA copies per wave (k + v) — so the nv_llm_kv_frag_* /
+            # _attn_dma_* scrape path runs with zero hardware
+            d["kv_frag_ratio"] = 0.0
+            d["kv_contig_runs"] = 1
+            d["kv_contiguity_ratio"] = 1.0
+            d["attn_dma_copies_per_wave"] = 2.0
         return d
 
     @property

@@ -22,6 +22,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -515,6 +516,96 @@ def paged_attention_xla(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
 
 # ---------------------------------------------------------------------------
+# Decode: run-coalesced DMA support (contiguity-aware KV layout)
+# ---------------------------------------------------------------------------
+#
+# The run-tracking allocator (llm/kv/pool.py FreeRunIndex) lands a
+# sequence's blocks as few maximal runs of physically-adjacent ids. The
+# decode kernel exploits that: when one DMA wave's blocks are consecutive
+# in the pool, the whole wave is ONE contiguous [chunk*block_size, Cx]
+# copy instead of `chunk` per-block copies — the "multi-block-per-DMA
+# layout" PERF.md round-5 names as the next lever for small-C geometries
+# where a 16-token block row is a latency-bound 4 KB payload.
+#
+# The coalescibility table is derived from (block_tables, seq_lens) at
+# trace time — INSIDE the jitted step, so it is always consistent with
+# the tables the kernel reads (a host-precomputed table would go stale
+# mid-K-scan as sequences cross block boundaries). wave_contig_table is
+# the ONE home of the predicate; the numpy call path serves host-side
+# stats (EngineCore metrics, bench --kv-frag, tools/decode_profile.py).
+
+
+def wave_contig_table(block_tables, seq_lens, *, block_size: int,
+                      chunk: int, pool_blocks: int, xp=jnp):
+    """[B, n_waves] int32: 1 where DMA wave w of sequence b may be
+    fetched as ONE contiguous copy of `chunk` blocks.
+
+    A wave is coalescible iff (a) every VALID table entry in it (indices
+    < ceil(seq_len/block_size)) is physically consecutive from the
+    wave's first entry, and (b) the full chunk-block span stays inside
+    the pool (`pool_blocks`). Tail rows past the valid blocks are then
+    fetched from adjacent pool rows instead of the per-block path's
+    trash-block clamp — BOTH are masked by the seq_len bound before the
+    softmax, so the two paths are bit-identical (every pool row is
+    finite by construction: zeros at init, real KV or quantizer output
+    after). ``xp`` picks the array namespace: jnp inside the jitted
+    wrapper, np for host-side DMA accounting."""
+    B, M = block_tables.shape
+    n_waves = -(-M // chunk)
+    pad = n_waves * chunk - M
+    bt = xp.pad(xp.asarray(block_tables), ((0, 0), (0, pad)))
+    bt = bt.reshape(B, n_waves, chunk)
+    nb = (xp.asarray(seq_lens) + block_size - 1) // block_size       # [B]
+    idx = xp.arange(n_waves * chunk).reshape(n_waves, chunk)
+    valid = idx[None] < nb[:, None, None]            # [B, n_waves, chunk]
+    expect = bt[:, :, :1] + xp.arange(chunk)[None, None, :]
+    consec = xp.all((bt == expect) | ~valid, axis=2)
+    in_bounds = bt[:, :, 0] + chunk <= pool_blocks
+    return (consec & in_bounds).astype(xp.int32)
+
+
+def dma_copy_counts(block_tables, seq_lens, *, block_size: int,
+                    pool_blocks: int, chunk_blocks: int | None = None,
+                    dual_stream: bool = True, win_lo=None,
+                    coalesce: bool = True) -> dict:
+    """Host-side count of the DMA copies one Pallas decode call issues
+    over these tables — the CPU-side truth the --kv-frag bench and the
+    coalescing tests gate on (and the attn_dma_copies_per_wave metrics
+    feed). Mirrors the kernel's wave walk exactly: per sequence, waves
+    [start_ci, num_chunks); a coalescible wave is 1 copy per KV stream,
+    a fragmented one is `chunk` per stream. ``dual_stream`` False for
+    v-aliases-k pools (MLA latents: k only)."""
+    bt = np.asarray(block_tables)
+    sl = np.asarray(seq_lens)
+    B, M = bt.shape
+    if chunk_blocks is None:
+        chunk_blocks = int(os.environ.get("DYN_ATTN_CHUNK_BLOCKS", "16"))
+    chunk = max(1, min(chunk_blocks, M))
+    contig = (wave_contig_table(bt, sl, block_size=block_size,
+                                chunk=chunk, pool_blocks=pool_blocks,
+                                xp=np)
+              if coalesce else np.zeros((B, -(-M // chunk)), np.int32))
+    nb = -(-sl // block_size)
+    nc = -(-nb // chunk)
+    start = (np.zeros((B,), np.int64) if win_lo is None
+             else np.maximum(np.asarray(win_lo) + 1, 0)
+             // (chunk * block_size))
+    streams = 2 if dual_stream else 1
+    copies = waves = coalesced = 0
+    for b in range(B):
+        for ci in range(int(start[b]), int(nc[b])):
+            waves += 1
+            if contig[b, ci]:
+                coalesced += 1
+                copies += streams
+            else:
+                copies += streams * chunk
+    return {"waves": waves, "copies": copies,
+            "coalesced_waves": coalesced,
+            "copies_per_wave": copies / max(waves, 1)}
+
+
+# ---------------------------------------------------------------------------
 # Decode: Pallas flash kernel streaming block-major KV from HBM
 # ---------------------------------------------------------------------------
 #
@@ -529,6 +620,7 @@ def paged_attention_xla(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
 
 
 def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
+                       runs_ref,
                        q_ref, k_hbm, v_hbm, o_ref,
                        m_ref, l_ref, acc_ref, k_bufs, v_bufs, sems,
                        wave_ref,
@@ -537,11 +629,17 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
                        softcap: float | None = None,
                        quant_lanes: int | None = None,
                        v_lanes: int | None = None,
-                       quant_sections: tuple | None = None):
+                       quant_sections: tuple | None = None,
+                       coalesce: bool = True):
     """q_ref: [G, Hp, C] sparse-slotted (VMEM); k_hbm/v_hbm: [NTOK, Cx]
     (HBM); o_ref: [G, Hp, C]; k_bufs/v_bufs: [2, chunk*block_size, Cx]
     double buffers; sems: DMA semaphore pair; m/l: [Hp, 1]; acc: [Hp, C]
-    f32; wave_ref: [1] SMEM global wave-parity carried ACROSS programs.
+    f32; wave_ref: [1] SMEM global wave-parity carried ACROSS programs;
+    runs_ref: [B, n_waves] SMEM per-wave coalescibility
+    (wave_contig_table) — with ``coalesce`` a flagged wave streams as
+    ONE contiguous chunk-block copy per KV stream instead of `chunk`
+    per-block copies (wave_dma below; bit-identical output, the
+    fragmented fallback is the per-block path).
 
     int8 KV pools carry their per-token scales IN-ROW (KV_SCALE_LANES;
     Cx = C + 128, `quant_lanes`=C — the int8 flag AND payload width,
@@ -621,9 +719,9 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
             parts.append(jnp.zeros((tile.shape[0], C - Cs), jnp.float32))
         return jnp.concatenate(parts, axis=1)
 
-    def chunk_copies(sq, ci, slot, nb):
-        """Contiguous block copies of sequence `sq`'s chunk `ci` into
-        buffer `slot` — 2*chunk (k and v), or chunk in v-aliases-k mode
+    def block_copies(sq, ci, slot, nb):
+        """Per-block copies of sequence `sq`'s chunk `ci` into buffer
+        `slot` — 2*chunk (k and v), or chunk in v-aliases-k mode
         (reconstructed identically at wait time; all on one
         semaphore)."""
         copies = []
@@ -641,6 +739,43 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
                     v_bufs.at[slot, pl.ds(j * block_size, block_size), :],
                     sems.at[slot]))
         return copies
+
+    def run_copies(sq, ci, slot):
+        """The coalesced form of one wave: the chunk blocks are
+        physically consecutive (runs_ref said so), so the WHOLE wave is
+        one [chunk*block_size, Cx] copy per KV stream — same bytes into
+        the same buffer region, chunk× fewer DMA issues."""
+        blk0 = block_tables_ref[sq, ci * chunk]
+        copies = [pltpu.make_async_copy(
+            k_hbm.at[pl.ds(blk0 * block_size, chunk * block_size), :],
+            k_bufs.at[slot], sems.at[slot])]
+        if v_lanes is None:
+            copies.append(pltpu.make_async_copy(
+                v_hbm.at[pl.ds(blk0 * block_size, chunk * block_size), :],
+                v_bufs.at[slot], sems.at[slot]))
+        return copies
+
+    def wave_dma(op, sq, ci, slot, nb):
+        """Start or wait one wave's DMAs, branching on the wave's
+        coalescibility. The runs table is immutable across the call, so
+        the wait reconstructs the exact copy set the start issued (and
+        either way the semaphore balances: one coalesced copy carries
+        the same byte count as the chunk per-block copies)."""
+        if not coalesce:
+            for c in block_copies(sq, ci, slot, nb):
+                getattr(c, op)()
+            return
+        contig = runs_ref[sq, ci] > 0
+
+        @pl.when(contig)
+        def _():
+            for c in run_copies(sq, ci, slot):
+                getattr(c, op)()
+
+        @pl.when(~contig)
+        def _():
+            for c in block_copies(sq, ci, slot, nb):
+                getattr(c, op)()
 
     @pl.when(pb == 0)
     def _():
@@ -673,9 +808,8 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
         def _(start_ci=start_ci, p0=p0, sq=sq, num_blocks=num_blocks):
             # empty range: an unwaited start would leak semaphore signal
             # into the next sequence's waves
-            for c in chunk_copies(sq, start_ci, jax.lax.rem(p0, 2),
-                                  num_blocks):
-                c.start()
+            wave_dma("start", sq, start_ci, jax.lax.rem(p0, 2),
+                     num_blocks)
 
         def wave_scores(ci, slot, *, sq=sq, num_chunks=num_chunks,
                         num_blocks=num_blocks, seq_len=seq_len,
@@ -685,18 +819,15 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
             one, return (p-ready scores, v)."""
             @pl.when(ci + 1 < num_chunks)
             def _():
-                for c in chunk_copies(sq, ci + 1, 1 - slot, num_blocks):
-                    c.start()
+                wave_dma("start", sq, ci + 1, 1 - slot, num_blocks)
 
             if num_seqs > 1:
                 @pl.when((ci + 1 >= num_chunks) & (sq + 1 < num_seqs)
                          & (next_sc < next_nc))
                 def _():      # last wave: prefetch the successor's first
-                    for c in chunk_copies(nsq, next_sc, 1 - slot, next_nb):
-                        c.start()
+                    wave_dma("start", nsq, next_sc, 1 - slot, next_nb)
 
-            for c in chunk_copies(sq, ci, slot, num_blocks):
-                c.wait()
+            wave_dma("wait", sq, ci, slot, num_blocks)
             if quant_sections is not None:
                 k = dequant_tile_sections(k_bufs[slot])   # [cbs, C] f32
                 v = k[:, :v_lanes]        # sections mode implies alias
@@ -767,12 +898,21 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                            seqs_per_program: int | None = None,
                            v_lanes: int | None = None,
                            quant_sections: tuple | None = None,
+                           coalesce: bool = True,
                            interpret: bool = False) -> jax.Array:
     """Same contract as `paged_attention_xla`; KV stays in HBM and streams
     chunk-by-chunk with double buffering (no [B, M*BS] gather). Sliding
     windows are in-kernel (win_lo: [B], -1 for global layers). int8 pools
     (in-row scales, KV_SCALE_LANES) cut the DMA bytes 1.6× with the same
     one-copy-per-block structure.
+
+    ``coalesce`` (default on): waves whose blocks are physically
+    consecutive in the pool — the run-tracking allocator's layout —
+    stream as ONE DMA per KV stream instead of one per block
+    (wave_contig_table above; bit-identical output either way, asserted
+    in tests/test_kv_contig.py). False forces the per-block path (the
+    --kv-frag A/B baseline and the EngineConfig.kv_contig_alloc=off
+    escape hatch).
 
     ``v_lanes`` (MQA/MLA only, KVH == 1): v is the first v_lanes lanes
     of each k row — the v-side DMA is skipped (HALVING the stream) and
@@ -854,9 +994,17 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
             [seq_lens, jnp.zeros((Bp - B,), seq_lens.dtype)])
         win_lo = jnp.concatenate(
             [win_lo, jnp.full((Bp - B,), -1, jnp.int32)])
+    # per-wave coalescibility, derived from the SAME tables the kernel
+    # reads (trace-time: stays correct as seq_lens advance inside a
+    # K-step scan); zeros = per-block path everywhere
+    runs = (wave_contig_table(block_tables, seq_lens,
+                              block_size=block_size, chunk=chunk,
+                              pool_blocks=NTOK // block_size)
+            if coalesce else
+            jnp.zeros((Bp, -(-M // chunk)), jnp.int32))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
+        num_scalar_prefetch=4,
         grid=(Bp // G,),
         in_specs=[
             pl.BlockSpec((G, Hp, C), lambda b, *_: (b, 0, 0)),
@@ -879,25 +1027,26 @@ def paged_attention_pallas(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
         ],
     )
 
-    def kernel(block_tables_ref, seq_lens_ref, win_lo_ref, q_ref,
-               k_hbm, v_hbm, o_ref, m_ref, l_ref, acc_ref,
+    def kernel(block_tables_ref, seq_lens_ref, win_lo_ref, runs_ref,
+               q_ref, k_hbm, v_hbm, o_ref, m_ref, l_ref, acc_ref,
                k_bufs, v_bufs, sems, wave_ref):
         _paged_attn_kernel(
-            block_tables_ref, seq_lens_ref, win_lo_ref,
+            block_tables_ref, seq_lens_ref, win_lo_ref, runs_ref,
             q_ref, k_hbm, v_hbm, o_ref,
             m_ref, l_ref, acc_ref, k_bufs, v_bufs, sems, wave_ref,
             block_size=block_size, chunk=chunk, scale=scale,
             num_seqs=Bp, seqs_per_program=G, softcap=softcap,
             quant_lanes=(C if quantized and quant_sections is None
                          else None),
-            v_lanes=v_lanes, quant_sections=quant_sections)
+            v_lanes=v_lanes, quant_sections=quant_sections,
+            coalesce=coalesce)
 
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((Bp, Hp, Cv), q.dtype),
         interpret=interpret,
-    )(block_tables, seq_lens, jnp.asarray(win_lo, jnp.int32), qm,
+    )(block_tables, seq_lens, jnp.asarray(win_lo, jnp.int32), runs, qm,
       k_cache, v_cache)
     if v_lanes is not None:
         # MQA: every head's slot is the whole row — no extraction
@@ -928,11 +1077,14 @@ def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
                     softcap: float | None = None,
                     win_lo: jax.Array | None = None,
                     kv_heads: int | None = None,
-                    v_lanes: int | None = None) -> jax.Array:
+                    v_lanes: int | None = None,
+                    coalesce: bool = True) -> jax.Array:
     """Dispatch: pallas on TPU (block-major streaming kernel, incl. sliding
     windows, soft-capping, and int8 pools w/ in-row per-token scales), XLA
     gather fallback elsewhere and for geometries the kernel can't tile
     (lane width KVH*Dh < 128; int8 pools with block_size % 32 != 0).
+    ``coalesce`` gates the kernel's run-coalesced DMA path (ignored by
+    the XLA gather, which has no per-block copy structure).
 
     ``kv_heads``: the true KV head count — required to size the value
     lanes of a tp-GROUPED int8 pool (g scale groups per row; without it
@@ -966,12 +1118,14 @@ def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
         return paged_attention_pallas(q, k_cache, v_cache, block_tables,
                                       seq_lens, block_size=block_size,
                                       scale=scale, softcap=softcap,
-                                      win_lo=win_lo, v_lanes=v_lanes)
+                                      win_lo=win_lo, v_lanes=v_lanes,
+                                      coalesce=coalesce)
     if impl == "pallas_interpret":
         return paged_attention_pallas(q, k_cache, v_cache, block_tables,
                                       seq_lens, block_size=block_size,
                                       scale=scale, softcap=softcap,
                                       win_lo=win_lo, v_lanes=v_lanes,
+                                      coalesce=coalesce,
                                       interpret=True)
     if v_lanes is not None:
         # the v-aliases-k CONTRACT holds on every impl: v IS k's first

@@ -32,7 +32,7 @@ KVCache = Dict[str, jax.Array]
 __all__ = ["gather_blocks", "scatter_blocks", "gather_blocks_dispatch",
            "gather_blocks_to_host", "scatter_blocks_from_host",
            "prep_host_values", "scatter_prepped", "to_wire_format",
-           "from_wire_format", "fetch_wire"]
+           "from_wire_format", "fetch_wire", "move_blocks"]
 
 
 @functools.partial(jax.jit, static_argnames=("block_size",))
@@ -72,6 +72,33 @@ def _pad_pow2(n: int) -> int:
     while p < n:
         p *= 2
     return p
+
+
+@functools.partial(jax.jit, static_argnames=("block_size",),
+                   donate_argnums=(0,))
+def _move_blocks(kv: KVCache, src_ids: jax.Array, dst_ids: jax.Array,
+                 block_size: int) -> KVCache:
+    def one(arr: jax.Array) -> jax.Array:
+        L, _T, HD = arr.shape
+        paged = arr.reshape(L, -1, block_size, HD)
+        vals = jnp.take(paged, src_ids, axis=1)
+        paged = paged.at[:, dst_ids].set(vals)
+        return paged.reshape(L, -1, HD)
+
+    return {k: one(v) for k, v in kv.items()}
+
+
+def move_blocks(kv: KVCache, src_ids, dst_ids, block_size: int) -> KVCache:
+    """On-device block migration src→dst inside the same paged pool (the
+    defrag pass, engine/core.py _maybe_defrag): gather + in-place scatter
+    in ONE donated jit, never staging through the host. Id counts pad to
+    a power of two with trash-block self-copies (block 0 → block 0, its
+    content is never read) so XLA compiles O(log n) programs."""
+    n = len(src_ids)
+    pad = _pad_pow2(n) - n
+    src = jnp.asarray(np.asarray(list(src_ids) + [0] * pad, np.int32))
+    dst = jnp.asarray(np.asarray(list(dst_ids) + [0] * pad, np.int32))
+    return _move_blocks(kv, src, dst, block_size)
 
 
 def to_wire_format(picked: np.ndarray, num_heads: int) -> np.ndarray:

@@ -620,6 +620,28 @@ class EngineConfig:
     spec_ngram_max: int = 4
     spec_ngram_min: int = 1
     spec_window: int = 1024
+    # contiguity-aware KV layout (docs/kv_layout.md): the block pool's
+    # run-tracking allocator (llm/kv/pool.py FreeRunIndex) always lands
+    # new blocks as few maximal runs of adjacent ids; this knob gates
+    # what EXPLOITS that — the decode kernel's run-coalesced DMA
+    # (engine/attention.py wave_contig_table: one copy per contiguous
+    # wave instead of one per block, the PERF round-5 "multi-block-per-
+    # DMA" lever for small-C geometries) and the idle-time defrag pass
+    # below. False = per-block DMAs always, no defrag (A/B escape
+    # hatch; bench.py --kv-frag measures the delta).
+    kv_contig_alloc: bool = True
+    # background compaction: when the engine has no queued work and the
+    # free-run fragmentation (pool.frag_ratio: 1 - largest_run/free)
+    # exceeds this, the worst-fragmented resident sequence migrates
+    # into a free run (engine/block_copy device copy + pool.relocate —
+    # hash registrations follow the blocks). 0 disables. Skipped while
+    # a replay recorder is attached (the copy is a device program the
+    # follower streams don't carry).
+    kv_defrag_threshold: float = 0.5
+    # per-pass migration budget (one sequence, at most this many
+    # blocks) — bounds the copy cost a pass can insert ahead of the
+    # next admission
+    kv_defrag_max_blocks: int = 64
     # KV-cache quantization: "none" | "int8" (per-token symmetric int8
     # pool + f32 scales — halves the decode KV read stream, the dominant
     # HBM term at seq >= ~1k). Current limits (refused loudly): no host
@@ -692,6 +714,10 @@ class EngineConfig:
                 " > 1 (the pipeline defers multi-step harvests)")
         if self.spec_k < 0:
             raise ValueError("spec_k must be >= 0 (0 disables speculation)")
+        if not 0.0 <= self.kv_defrag_threshold <= 1.0:
+            raise ValueError(
+                "kv_defrag_threshold must be in [0, 1] (a frag_ratio "
+                "bound; 0 disables the defrag pass)")
         if (self.kv_disk_blocks > 0) != bool(self.kv_disk_dir):
             raise ValueError(
                 "the disk KV tier needs BOTH kv_disk_dir and "

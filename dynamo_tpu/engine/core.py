@@ -195,7 +195,8 @@ class EngineCore:
             self.model_cfg = model_cfg
         self.statics = llama.ModelStatics(
             cfg=model_cfg, block_size=engine_cfg.kv_block_size,
-            attn_impl=attn_impl)
+            attn_impl=attn_impl,
+            kv_coalesce=engine_cfg.kv_contig_alloc)
         if engine_cfg.quantization not in ("none", "int8", "int8-noembed",
                                            "int4", "int4-noembed"):
             raise ValueError(
@@ -399,6 +400,11 @@ class EngineCore:
         self.preemptions = 0
         self.lane_admissions = 0
         self.host_onboards = 0
+        # contiguity-aware layout (docs/kv_layout.md): defrag passes run
+        # + blocks migrated; per-move truth lives on the pool
+        # (defrag_moves_total — relocate() increments it)
+        self.defrag_passes = 0
+        self._defrag_last_step = -(1 << 30)
         # disk (G3) tier: promote-path admissions + blocks restored
         self.disk_onboards = 0
         self.disk_onboarded_blocks = 0
@@ -855,13 +861,39 @@ class EngineCore:
         await self.spill_engine.drain()
         return n
 
+    def _dma_copies_per_wave(self) -> float:
+        """Decode-DMA issues per wave over the CURRENT batch state — the
+        host-side mirror of the kernel's wave walk (attention.
+        dma_copy_counts), fed to nv_llm_kv_attn_dma_copies_per_wave.
+        chunk× on a fully fragmented pool, 1-2 on a contiguous one."""
+        from .attention import dma_copy_counts
+        seq_lens = np.where(
+            np.array([s is not None and s.ready for s in self.slots]),
+            self._positions + 1, 0).astype(np.int32)
+        if not seq_lens.any():
+            return 0.0
+        counts = dma_copy_counts(
+            self._block_tables, seq_lens,
+            block_size=self.cfg.kv_block_size,
+            pool_blocks=self.cfg.num_kv_blocks,
+            dual_stream=not self.is_mla,
+            coalesce=self.cfg.kv_contig_alloc)
+        return counts["copies_per_wave"]
+
     def metrics(self) -> ForwardPassMetrics:
         active = sum(1 for s in self.slots if s is not None)
         total_blocks = self.cfg.num_kv_blocks - 1
         used = self.kv_manager.pool.used_blocks
         host = self.kv_manager.host_pool
         disk = self.disk_store
-        tier_kw = {}
+        pool = self.kv_manager.pool
+        tier_kw = {
+            "kv_frag_ratio": pool.frag_ratio(),
+            "kv_contig_runs": pool.contig_runs,
+            "kv_contiguity_ratio": pool.contiguity_ratio(),
+            "kv_defrag_moves_total": pool.defrag_moves_total,
+            "attn_dma_copies_per_wave": self._dma_copies_per_wave(),
+        }
         if host is not None:
             tier_kw.update(
                 host_stored_total=host.stored_blocks_total,
@@ -974,6 +1006,11 @@ class EngineCore:
                     self.B, self.cfg.num_kv_blocks, self.cfg.kv_block_size)
         while not self._stopping:
             progressed = False
+            # 0) opportunistic KV compaction: only when no admission is
+            # queued and no dispatch is un-harvested (the pass inserts
+            # one small device copy ahead of the next decode dispatch)
+            if self.waiting.empty() and self._pending is None:
+                self._maybe_defrag()
             # 1) admit waiting work into free slots
             while not self.waiting.empty():
                 slot = self._free_slot_index()
@@ -1016,6 +1053,70 @@ class EngineCore:
             else:
                 await asyncio.sleep(0)  # let producers/consumers run
         logger.info("engine loop stopped")
+
+    # --------------------------------------------------------------- defrag
+    def _maybe_defrag(self) -> bool:
+        """Background compaction (docs/kv_layout.md): when fragmentation
+        exceeds EngineConfig.kv_defrag_threshold, migrate the worst-
+        fragmented resident sequence's movable block suffix into a free
+        run — an on-device gather+scatter (block_copy.move_blocks)
+        followed by pool.relocate, so hash registrations and refcounts
+        follow the blocks and the old ids coalesce back into the
+        free-run index. Constraints: only blocks owned by ONE sequence
+        move (shared prefix-hit blocks stay put), targets come from the
+        UNINIT free space only (never evicts cached prefixes), and the
+        pass is skipped while a replay recorder is attached (the copy
+        is a device program the follower/replay streams don't carry).
+        Rate-limited to one pass per 64 decode steps."""
+        cfg = self.cfg
+        if (not cfg.kv_contig_alloc or cfg.kv_defrag_threshold <= 0
+                or self.recorder is not None
+                or self._step - self._defrag_last_step < 64):
+            return False
+        pool = self.kv_manager.pool
+        thr = cfg.kv_defrag_threshold
+        pool_frag = pool.frag_ratio()
+        best = None   # (runs, seq_frag, slot, suffix_start, suffix)
+        for i, req in enumerate(self.slots):
+            if req is None or not req.ready or len(req.blocks) < 2:
+                continue
+            rcs = pool.refcounts(req.blocks)
+            j = len(req.blocks)
+            while j > 0 and rcs[j - 1] == 1:
+                j -= 1
+            suffix = req.blocks[j:][:cfg.kv_defrag_max_blocks]
+            if len(suffix) < 2:
+                continue
+            runs = pool.count_runs(suffix)
+            if runs < 2:
+                continue
+            seq_frag = (runs - 1) / (len(suffix) - 1)
+            if (pool_frag <= thr and seq_frag <= thr):
+                continue
+            if best is None or runs > best[0]:
+                best = (runs, seq_frag, i, j, suffix)
+        if best is None or pool.free_uninit_blocks < len(best[4]):
+            return False
+        runs, _seq_frag, slot, j, old = best
+        new = pool.alloc_uninit(len(old))
+        if new is None:
+            return False
+        if pool.count_runs(new) >= runs:
+            pool.release(new)       # no layout win — don't thrash
+            return False
+        from .block_copy import move_blocks
+        self.kv = move_blocks(self.kv, old, new, cfg.kv_block_size)
+        pool.relocate(zip(old, new))
+        req = self.slots[slot]
+        req.blocks[j:j + len(old)] = new
+        self._block_tables[slot, :] = 0
+        self._block_tables[slot, :len(req.blocks)] = req.blocks
+        self.defrag_passes += 1
+        self._defrag_last_step = self._step
+        logger.debug("defrag: slot %d moved %d blocks (%d runs → %d), "
+                     "pool frag %.2f", slot, len(old), runs,
+                     pool.count_runs(new), pool_frag)
+        return True
 
     # ---------------------------------------------------------------- admit
     def _try_admit(self, req: EngineRequest, slot: int) -> bool:

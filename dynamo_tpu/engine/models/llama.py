@@ -391,9 +391,13 @@ class ModelStatics:
     cfg: ModelConfig
     block_size: int
     attn_impl: str = "auto"
+    # run-coalesced decode DMA (attention.py wave_contig_table):
+    # EngineConfig.kv_contig_alloc=False forces the per-block path
+    kv_coalesce: bool = True
 
     def __hash__(self):
-        return hash((id(self.cfg), self.block_size, self.attn_impl))
+        return hash((id(self.cfg), self.block_size, self.attn_impl,
+                     self.kv_coalesce))
 
 
 def _run_layers(params: Params, kv: KVCache, x: jax.Array,
@@ -790,7 +794,8 @@ def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
                                impl=statics.attn_impl,
                                softcap=cfg.attn_logit_softcap,
                                win_lo=win_lo,
-                               kv_heads=cfg.num_kv_heads)
+                               kv_heads=cfg.num_kv_heads,
+                               coalesce=statics.kv_coalesce)
 
     x = _embed(params, tokens, cfg)  # [B, D]
     x, kv_new = _run_layers(params, kv, x, positions, slots, cfg, attn)

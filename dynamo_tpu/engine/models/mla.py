@@ -652,7 +652,9 @@ def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
             ctx = paged_attention(
                 qc, kv_flat, kv_flat, tables_l, seq_lens,
                 block_size=bsz, scale=scale, impl=statics.attn_impl,
-                kv_heads=1, v_lanes=vl)[..., :rank].astype(jnp.float32)
+                kv_heads=1, v_lanes=vl,
+                coalesce=statics.kv_coalesce)[..., :rank].astype(
+                    jnp.float32)
         else:
             from ..attention import (_on_tpu, paged_attention_pallas,
                                      pallas_supported)
@@ -670,7 +672,8 @@ def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,
                 ctx = paged_attention_pallas(
                     qc, kv_flat, kv_flat, tables_l, seq_lens,
                     block_size=bsz, scale=scale, v_lanes=rank,
-                    quant_sections=(rank, dr)).astype(jnp.float32)
+                    quant_sections=(rank, dr),
+                    coalesce=statics.kv_coalesce).astype(jnp.float32)
             else:
                 idx = flat_token_indices(tables_l, bsz)
                 T = idx.shape[1]

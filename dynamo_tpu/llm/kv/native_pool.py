@@ -49,6 +49,12 @@ def load_native_pool_lib() -> Optional[ctypes.CDLL]:
     lib.kvpool_release.argtypes = [_P, ctypes.POINTER(_I64), _I64]
     lib.kvpool_reset.restype = _I64
     lib.kvpool_reset.argtypes = [_P, ctypes.POINTER(_U64)]
+    lib.kvpool_layout_stats.argtypes = [_P, ctypes.POINTER(_I64)]
+    lib.kvpool_refcounts.argtypes = [_P, ctypes.POINTER(_I64), _I64,
+                                     ctypes.POINTER(_I64)]
+    lib.kvpool_relocate.restype = _I64
+    lib.kvpool_relocate.argtypes = [_P, ctypes.POINTER(_I64),
+                                    ctypes.POINTER(_I64), _I64]
     lib._kvpool_ready = True
     return lib
 
@@ -113,6 +119,77 @@ class NativeKvBlockPool:
 
     def hit_rate(self) -> float:
         return self.match_hits / max(self.match_queries, 1)
+
+    # ---------------------------------------------------- layout/contiguity
+    def _layout_stats(self):
+        buf = (_I64 * 7)()
+        self._lib.kvpool_layout_stats(self._h, buf)
+        return list(buf)
+
+    @property
+    def contig_runs(self) -> int:
+        return self._layout_stats()[0]
+
+    @property
+    def free_uninit_blocks(self) -> int:
+        return self._layout_stats()[2]
+
+    @property
+    def alloc_blocks_total(self) -> int:
+        return self._layout_stats()[3]
+
+    @property
+    def alloc_runs_total(self) -> int:
+        return self._layout_stats()[4]
+
+    @property
+    def alloc_requests_total(self) -> int:
+        return self._layout_stats()[5]
+
+    @property
+    def defrag_moves_total(self) -> int:
+        return self._layout_stats()[6]
+
+    def frag_ratio(self) -> float:
+        _runs, largest, free, *_ = self._layout_stats()
+        return 0.0 if free == 0 else 1.0 - largest / free
+
+    def contiguity_ratio(self) -> float:
+        s = self._layout_stats()
+        possible = s[3] - s[5]
+        return 1.0 if possible <= 0 else (s[3] - s[4]) / possible
+
+    @staticmethod
+    def count_runs(blocks: Sequence[int]) -> int:
+        from .pool import KvBlockPool
+        return KvBlockPool.count_runs(blocks)
+
+    def refcounts(self, blocks: Sequence[int]) -> List[int]:
+        if not blocks:
+            return []
+        out = (_I64 * len(blocks))()
+        self._lib.kvpool_refcounts(self._h, _i64s(blocks),
+                                   len(blocks), out)
+        return list(out)
+
+    def relocate(self, moves) -> None:
+        moves = list(moves)
+        if not moves:
+            return
+        olds = [o for o, _ in moves]
+        news = [n for _, n in moves]
+        rc = self._lib.kvpool_relocate(self._h, _i64s(olds), _i64s(news),
+                                       len(moves))
+        if rc != 0:
+            raise ValueError("relocate target not a fresh uninit block "
+                             "or source not resident")
+        # the reannounce shadow tracks bids — rebind moved registrations
+        remap = dict(zip(olds, news))
+        for h, (bid, seq_hash, tokens_hash, parent) in list(
+                self._registered.items()):
+            if bid in remap:
+                self._registered[h] = (remap[bid], seq_hash, tokens_hash,
+                                       parent)
 
     # ------------------------------------------------------------ matching
     def match_prefix(self, seq_hashes: Sequence[int]) -> List[int]:

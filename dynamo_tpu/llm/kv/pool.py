@@ -20,7 +20,9 @@ reuse.rs:638; here the asyncio loop IS the actor).
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
+import heapq
 import logging
 import os
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -28,6 +30,90 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from .blocks import TokenBlockSequence
 
 logger = logging.getLogger("dynamo_tpu.kv.pool")
+
+
+class FreeRunIndex:
+    """Coalescing index over the uninitialized free blocks: maximal runs
+    of physically-adjacent block ids, with best-fit-run allocation.
+
+    This is the device-pool half of the contiguity story (docs/
+    kv_layout.md): logically paged KV does not have to be physically
+    scattered — when a sequence's blocks land as few maximal runs, the
+    decode kernel coalesces each run into ONE DMA per wave
+    (engine/attention.py wave-coalescing) instead of one per block.
+
+    Determinism contract (the native C++ pool mirrors this EXACTLY —
+    tests/test_kv_pool.py differential fuzz): best fit = the smallest
+    run with length >= n, ties broken by smallest start; when no run
+    fits, take the LARGEST run (ties: smallest start) whole and repeat.
+    Blocks are handed out ascending from each run's start.
+    """
+
+    def __init__(self):
+        self._start: Dict[int, int] = {}   # run start -> length
+        self._end: Dict[int, int] = {}     # run end (exclusive) -> start
+        self._sorted: List[Tuple[int, int]] = []  # (length, start) sorted
+        self.count = 0
+
+    def __len__(self) -> int:
+        return self.count
+
+    @property
+    def num_runs(self) -> int:
+        return len(self._start)
+
+    @property
+    def largest_run(self) -> int:
+        return self._sorted[-1][0] if self._sorted else 0
+
+    def _remove_run(self, start: int, length: int) -> None:
+        del self._start[start]
+        del self._end[start + length]
+        i = bisect.bisect_left(self._sorted, (length, start))
+        assert self._sorted[i] == (length, start)
+        self._sorted.pop(i)
+
+    def _insert_run(self, start: int, length: int) -> None:
+        self._start[start] = length
+        self._end[start + length] = start
+        bisect.insort(self._sorted, (length, start))
+
+    def add(self, bid: int) -> None:
+        """Return one block, coalescing with adjacent free runs."""
+        start, length = bid, 1
+        left = self._end.get(bid)
+        if left is not None:                 # run ends exactly at bid
+            llen = self._start[left]
+            self._remove_run(left, llen)
+            start, length = left, llen + 1
+        rlen = self._start.get(bid + 1)
+        if rlen is not None:                 # run starts right after bid
+            self._remove_run(bid + 1, rlen)
+            length += rlen
+        self._insert_run(start, length)
+        self.count += 1
+
+    def take(self, n: int) -> List[int]:
+        """Allocate n blocks as few maximal runs (contract above).
+        Caller guarantees n <= len(self)."""
+        out: List[int] = []
+        while n > 0:
+            i = bisect.bisect_left(self._sorted, (n, -1))
+            if i < len(self._sorted):        # best fit: smallest len >= n
+                length, start = self._sorted[i]
+                take = n
+            else:                            # largest run (tie: min start)
+                length = self._sorted[-1][0]
+                j = bisect.bisect_left(self._sorted, (length, -1))
+                length, start = self._sorted[j]
+                take = length
+            self._remove_run(start, length)
+            if take < length:
+                self._insert_run(start + take, length - take)
+            out.extend(range(start, start + take))
+            n -= take
+        self.count -= len(out)
+        return out
 
 
 @dataclasses.dataclass
@@ -50,15 +136,35 @@ class KvBlockPool:
         self.num_blocks = num_blocks
         self._meta: Dict[int, BlockMeta] = {
             i: BlockMeta(i) for i in range(1, num_blocks)}
-        self._free_uninit: List[int] = list(range(num_blocks - 1, 0, -1))
+        # run-tracking free structure: maximal runs of adjacent block
+        # ids, best-fit allocation — a sequence's new blocks land as few
+        # physically-contiguous runs (the decode kernel's coalesced-DMA
+        # contract, engine/attention.py)
+        self._free_uninit = FreeRunIndex()
+        for i in range(1, num_blocks):
+            self._free_uninit.add(i)
         self._by_hash: Dict[int, int] = {}          # seq_hash → block_id
         self._reusable: Dict[int, int] = {}         # block_id → seq_hash (dict = insertion/LRU order)
+        # lazy eviction heap keyed (priority, return_tick, bid): pushed
+        # when a block becomes reusable; stale entries (re-matched,
+        # re-registered with a new priority, already evicted) are
+        # skipped at pop time by comparing against live meta — the
+        # amortized-victim-selection treatment HostKvPool._slot_for got
+        # (was an O(n) min() scan per eviction)
+        self._evict_heap: List[Tuple[int, int, int]] = []
+        self.evict_heap_skips = 0     # stale entries popped (regression stat)
         self._tick = 0
         self.on_stored = on_stored
         self.on_removed = on_removed
         # stats
         self.match_queries = 0
         self.match_hits = 0
+        # contiguity accounting (nv_llm_kv_* layout gauges): how many
+        # maximal runs each alloc was served as, vs the one-run ideal
+        self.alloc_blocks_total = 0
+        self.alloc_runs_total = 0
+        self.alloc_requests_total = 0
+        self.defrag_moves_total = 0
 
     # ------------------------------------------------------------- queries
     @property
@@ -73,8 +179,48 @@ class KvBlockPool:
     def reusable_blocks(self) -> int:
         return len(self._reusable)
 
+    @property
+    def free_uninit_blocks(self) -> int:
+        """Uninitialized free blocks only (no reusable content at
+        stake) — the defrag pass allocates its target runs strictly
+        from these so a layout move never evicts cached prefixes."""
+        return len(self._free_uninit)
+
     def hit_rate(self) -> float:
         return self.match_hits / max(self.match_queries, 1)
+
+    @property
+    def contig_runs(self) -> int:
+        """Maximal free runs in the uninit index (1 = fully coalesced)."""
+        return self._free_uninit.num_runs
+
+    def frag_ratio(self) -> float:
+        """Fragmentation of the uninit free space: 1 - largest_run/free.
+        0 = one maximal run (or nothing free); → 1 as the free space
+        shatters into single blocks."""
+        n = len(self._free_uninit)
+        if n == 0:
+            return 0.0
+        return 1.0 - self._free_uninit.largest_run / n
+
+    def contiguity_ratio(self) -> float:
+        """Adjacency delivered / adjacency possible across all allocs:
+        an n-block alloc served as r runs delivers n - r of its n - 1
+        possible adjacent pairs. 1.0 = every alloc was one run."""
+        possible = self.alloc_blocks_total - self.alloc_requests_total
+        if possible <= 0:
+            return 1.0
+        return (self.alloc_blocks_total
+                - self.alloc_runs_total) / possible
+
+    @staticmethod
+    def count_runs(blocks: Sequence[int]) -> int:
+        """Maximal runs of consecutive ids in an ORDERED block list —
+        the per-sequence fragmentation score the defrag pass ranks by."""
+        if not blocks:
+            return 0
+        return 1 + sum(1 for a, b in zip(blocks, blocks[1:])
+                       if b != a + 1)
 
     # ------------------------------------------------------------ matching
     def match_prefix(self, seq_hashes: Sequence[int]) -> List[int]:
@@ -109,27 +255,37 @@ class KvBlockPool:
 
     # ----------------------------------------------------------- allocate
     def alloc_uninit(self, n: int) -> Optional[List[int]]:
-        """n fresh blocks (content garbage), evicting reusable LRU if needed.
+        """n fresh blocks (content garbage) as few maximal runs of
+        adjacent ids (best-fit over the free-run index). When the uninit
+        index runs short, reusable blocks are evicted FIRST — in strict
+        priority-then-LRU order, preserving the eviction contract — and
+        returned to the index (coalescing), THEN the runs are carved.
         Returns None if even eviction can't satisfy."""
         if n > self.free_blocks:
             return None
-        out: List[int] = []
-        for _ in range(n):
-            if self._free_uninit:
-                bid = self._free_uninit.pop()
-            else:
-                bid = self._evict_one()
-            meta = self._meta[bid]
-            meta.refcount = 1
-            out.append(bid)
+        for _ in range(n - len(self._free_uninit)):
+            self._free_uninit.add(self._evict_one())
+        out = self._free_uninit.take(n)
+        for bid in out:
+            self._meta[bid].refcount = 1
+        if n:
+            self.alloc_requests_total += 1
+            self.alloc_blocks_total += n
+            self.alloc_runs_total += self.count_runs(out)
         return out
 
     def _evict_one(self) -> int:
         # priority first (lower first), then LRU by return_tick — the
-        # reference's PriorityKey ordering (reuse.rs).
-        bid = min(self._reusable,
-                  key=lambda b: (self._meta[b].priority,
-                                 self._meta[b].return_tick))
+        # reference's PriorityKey ordering (reuse.rs) — via the lazy
+        # heap: stale entries (block re-matched / re-keyed since push)
+        # are skipped by comparing against live meta.
+        while True:
+            prio, tick, bid = heapq.heappop(self._evict_heap)
+            meta = self._meta[bid]
+            if (bid in self._reusable and meta.priority == prio
+                    and meta.return_tick == tick):
+                break
+            self.evict_heap_skips += 1
         self._invalidate(bid)
         return bid
 
@@ -163,6 +319,11 @@ class KvBlockPool:
         meta.seq_hash = seq_hash
         meta.tokens_hash = tokens_hash
         meta.parent_hash = parent_hash
+        if meta.priority != priority and bid in self._reusable:
+            # re-key the lazy-heap entry: the old one goes stale and is
+            # skipped at pop time (the C++ pool re-keys its set entry)
+            heapq.heappush(self._evict_heap,
+                           (priority, meta.return_tick, bid))
         meta.priority = priority
         self._by_hash[seq_hash] = bid
         if self.on_stored is not None:
@@ -191,14 +352,54 @@ class KvBlockPool:
                 meta.return_tick = self._tick
                 if meta.seq_hash is not None:
                     self._reusable[bid] = meta.seq_hash
+                    heapq.heappush(
+                        self._evict_heap,
+                        (meta.priority, meta.return_tick, bid))
                 else:
-                    self._free_uninit.append(bid)
+                    self._free_uninit.add(bid)
 
     def reset(self) -> None:
         """Drop all reusable content (reference reuse.rs `reset`)."""
         for bid in list(self._reusable):
             self._invalidate(bid)
-            self._free_uninit.append(bid)
+            self._free_uninit.add(bid)
+
+    # ------------------------------------------------------------ relocate
+    def refcounts(self, blocks: Sequence[int]) -> List[int]:
+        """Live refcounts (0 for the trash block) — the defrag pass
+        skips blocks shared across sequences (refcount != 1)."""
+        return [0 if bid == 0 else self._meta[bid].refcount
+                for bid in blocks]
+
+    def relocate(self, moves: Sequence[Tuple[int, int]]) -> None:
+        """Rebind resident blocks old→new after the engine copied their
+        DEVICE contents (engine/core.py defrag): hash registrations and
+        refcounts follow the move, the old ids return to the free-run
+        index. Each `new` must be a freshly alloc_uninit'd block
+        (refcount 1, unregistered) and each `old` a resident block; no
+        stored/removed events fire — the hashes are unchanged and block
+        ids are worker-local."""
+        for old, new in moves:
+            m_old, m_new = self._meta[old], self._meta[new]
+            if m_new.seq_hash is not None or m_new.refcount != 1:
+                raise ValueError(
+                    f"relocate target {new} is not a fresh uninit block")
+            if m_old.refcount < 1:
+                raise ValueError(f"relocate source {old} is not resident")
+            m_new.refcount = m_old.refcount
+            m_new.priority = m_old.priority
+            m_new.return_tick = m_old.return_tick
+            if m_old.seq_hash is not None:
+                m_new.seq_hash = m_old.seq_hash
+                m_new.tokens_hash = m_old.tokens_hash
+                m_new.parent_hash = m_old.parent_hash
+                self._by_hash[m_new.seq_hash] = new
+            m_old.seq_hash = None
+            m_old.tokens_hash = None
+            m_old.parent_hash = None
+            m_old.refcount = 0
+            self._free_uninit.add(old)
+            self.defrag_moves_total += 1
 
     # --------------------------------------------------------- reannounce
     def registered_entries(self) -> List[Tuple[int, int, int, Optional[int]]]:
@@ -352,6 +553,23 @@ class KvBlockManager:
             if disk_hashes:
                 self.disk_store.unpin(disk_hashes)
             return None
+        if len(new_blocks) < len(host_slots) + len(disk_hashes):
+            # the onboard path scatters host/disk hits into
+            # new_blocks[:n_onboard] — a plan where the allocation can't
+            # cover the pinned disk hits would silently DROP tier hits
+            # (or scatter past the allocation). The cascade math above
+            # guarantees this never happens; if a tier's match_prefix
+            # over-returns (a buggy store), fail loudly instead of
+            # serving garbage. Release every hold first so the loud
+            # failure doesn't also leak pool refcounts / disk pins.
+            self.pool.release(hit_blocks + new_blocks)
+            if disk_hashes:
+                self.disk_store.unpin(disk_hashes)
+            raise RuntimeError(
+                f"prepare_prefill invariant violated: {len(new_blocks)} "
+                f"new blocks cannot cover {len(host_slots)} host + "
+                f"{len(disk_hashes)} disk tier hits (prompt "
+                f"{len(prompt)}, device hits {len(hit_blocks)})")
         return PrefillPlan(hit_blocks=hit_blocks, new_blocks=new_blocks,
                            hit_tokens=hit_tokens, seq=seq,
                            host_slots=host_slots, disk_hashes=disk_hashes)

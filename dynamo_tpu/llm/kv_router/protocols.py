@@ -49,6 +49,16 @@ class ForwardPassMetrics:
     disk_bytes_used: int = 0
     disk_spill_dropped_total: int = 0
     offload_dropped_jobs_total: int = 0
+    # contiguity-aware KV layout (llm/kv/pool.py run-tracking allocator
+    # + engine/attention.py run-coalesced DMA; docs/kv_layout.md) — the
+    # nv_llm_kv_frag_ratio / _contig_runs / _defrag_moves_total /
+    # _attn_dma_copies_per_wave gauge feeds (components/metrics.py
+    # "KV layout" Grafana row). Zeros on old payloads.
+    kv_frag_ratio: float = 0.0          # 1 - largest_free_run/free
+    kv_contig_runs: int = 0             # maximal free runs (1 = coalesced)
+    kv_contiguity_ratio: float = 0.0    # adjacency delivered/possible
+    kv_defrag_moves_total: int = 0      # blocks migrated by compaction
+    attn_dma_copies_per_wave: float = 0.0  # decode DMA issues per wave
     # pipeline parallelism (parallel/pipeline_parallel.py): stage count,
     # per-stage microbatch slots, and the dispatch-level interleave
     # model — steady-state utilization K·pp/(K·pp+pp-1) and its bubble

@@ -173,7 +173,8 @@ def pp_decode_forward(params: Dict[str, jax.Array], kv, tokens, positions,
                 seq_lens, block_size=bsz, scale=scale,
                 impl=local_statics.attn_impl,
                 softcap=local_cfg.attn_logit_softcap,
-                kv_heads=local_cfg.num_kv_heads)
+                kv_heads=local_cfg.num_kv_heads,
+                coalesce=local_statics.kv_coalesce)
 
         for s in range(pp):
             if s:
@@ -296,7 +297,8 @@ def pp_decode_k_forward(params, kv, tokens, positions, block_tables,
                     seq_lens, block_size=bsz, scale=scale,
                     impl=local_statics.attn_impl,
                     softcap=local_cfg.attn_logit_softcap,
-                    kv_heads=local_cfg.num_kv_heads)
+                    kv_heads=local_cfg.num_kv_heads,
+                    coalesce=local_statics.kv_coalesce)
 
             y, kv_new = llama._run_layers(
                 stacks_l, {"k": kvk, "v": kvv}, x, pos_mb, slots,

@@ -93,6 +93,37 @@ def test_bench_kv_disk_mode(tmp_path):
     assert kd["cold_ttft_ms"] > 0 and kd["warm_ttft_ms"] > 0
 
 
+@pytest.mark.kvfrag
+def test_bench_kv_frag_mode():
+    """--kv-frag rides a bench run (ISSUE 5 satellite): the result line
+    must carry the `kv_frag` provenance dict — the CPU-side DMA-copy
+    A/B between the run-allocator's contiguous layout and the reversed
+    (fragmented) permutation of the same blocks. The always-on
+    acceptance gate: coalescing cuts issued DMA copies >= 2x on the
+    contiguous pool. (The device step-time A/B rides only on real
+    hardware; this CPU smoke asserts the counting gate.)
+
+    BENCH_KV_BS pins block_size 16 (the tiny geometry is small-C and
+    would default to 64-token blocks, collapsing the smoke's short
+    sequences into a single block — nothing to coalesce)."""
+    r = _run(
+        [sys.executable, "bench.py", "--kv-frag"],
+        {"BENCH_FORCE_CPU": "1", "BENCH_MODEL": "tiny", "BENCH_BATCH": "4",
+         "BENCH_STEPS": "8", "BENCH_PROMPT": "64", "BENCH_HARVEST": "4",
+         "BENCH_QUANT": "none", "BENCH_DEVICE": "0", "BENCH_KV_BS": "16"})
+    assert r.returncode == 0, f"bench.py crashed:\n{r.stderr[-4000:]}"
+    out = json.loads([l for l in r.stdout.strip().splitlines()
+                      if l.startswith("{")][-1])
+    assert "error" not in out, f"bench fell back instead of running: {out}"
+    kf = out.get("kv_frag")
+    assert kf, f"no kv_frag provenance in the result: {out}"
+    assert kf["waves"] > 0 and kf["coalesced_waves"] > 0
+    assert kf["dma_copies_contig"] < kf["dma_copies_frag"]
+    # the acceptance criterion's always-on CPU gate
+    assert kf["dma_copy_ratio"] >= 2.0, kf
+    assert kf["dma_copies_per_wave_frag"] > kf["dma_copies_per_wave_contig"]
+
+
 def test_bench_pp_mode():
     """--pp rides a bench run (ISSUE 4): BENCH_FORCE_CPU forces a
     pp-sized virtual CPU mesh (the 8-device dryrun precedent) and the

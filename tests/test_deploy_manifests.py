@@ -220,7 +220,8 @@ def test_grafana_dashboard_queries_real_metrics():
         metric_names.update(re.findall(r"[a-z_]{4,}_(?:total|seconds_bucket|"
                                        r"requests|blocks|slots|waiting|perc|"
                                        r"rate)", e))
-    from dynamo_tpu.components.metrics import (_GAUGE_FIELDS, _PP_GAUGES,
+    from dynamo_tpu.components.metrics import (_GAUGE_FIELDS,
+                                               _LAYOUT_GAUGES, _PP_GAUGES,
                                                _SPEC_GAUGES, _TIER_GAUGES,
                                                PREFIX)
     from dynamo_tpu.llm.http.metrics import PREFIX as HTTP_PREFIX
@@ -228,6 +229,7 @@ def test_grafana_dashboard_queries_real_metrics():
     exported |= set(_SPEC_GAUGES.values())
     exported |= set(_TIER_GAUGES.values())
     exported |= set(_PP_GAUGES.values())
+    exported |= set(_LAYOUT_GAUGES.values())
     exported |= {f"{PREFIX}_hit_rate_isl_blocks_total",
                  f"{PREFIX}_hit_rate_overlap_blocks_total",
                  f"{HTTP_PREFIX}_requests_total",

@@ -49,6 +49,52 @@ def test_diskstore_put_match_fetch_roundtrip(tmp_path):
     assert store.hit_rate() > 0
 
 
+def test_prepare_prefill_asserts_disk_pin_coverage(tmp_path):
+    """ISSUE 5 satellite: prepare_prefill must verify the allocation can
+    cover the host+disk tier hits before building the plan — a
+    disk store whose match_prefix over-returns (more pinned hashes than
+    the prompt has unmatched full blocks) would otherwise scatter past
+    new_blocks silently. The loud failure must also release the device
+    holds and the disk pins it took."""
+    from dynamo_tpu.llm.kv.pool import KvBlockManager
+
+    store = DiskKvStore(str(tmp_path), capacity_blocks=16)
+    mgr = KvBlockManager(num_blocks=32, block_size=4, disk_store=store,
+                         prefer_native=False)
+    prompt = list(range(10))               # 2 full blocks + 2 tokens
+
+    class OverReturningStore:
+        def __init__(self, inner):
+            self.inner = inner
+            self.pinned = []
+            self.unpinned = []
+
+        def match_prefix(self, hashes, pin=False):
+            # over-return: more "hits" than the unmatched full blocks
+            fake = list(range(900, 908))
+            self.pinned.extend(fake)
+            return fake
+
+        def unpin(self, hashes):
+            self.unpinned.extend(hashes)
+
+    mgr.disk_store = OverReturningStore(store)
+    free_before = mgr.pool.free_blocks
+    with pytest.raises(RuntimeError, match="invariant"):
+        mgr.prepare_prefill(prompt)
+    # holds and pins released by the failure path
+    assert mgr.pool.free_blocks == free_before
+    assert mgr.disk_store.unpinned == mgr.disk_store.pinned
+
+    # the honest store path still plans cleanly (invariant holds)
+    mgr.disk_store = store
+    plan = mgr.prepare_prefill(prompt)
+    assert plan is not None
+    assert len(plan.new_blocks) >= len(plan.host_slots) + len(
+        plan.disk_hashes)
+    mgr.abort_plan(plan)
+
+
 def test_diskstore_capacity_lru_eviction_and_pins(tmp_path):
     store = DiskKvStore(str(tmp_path), capacity_blocks=3)
     for i in range(3):

@@ -17,7 +17,11 @@ Env: PROF_MODEL (1b|8b — 8b weighs ~8 GB int8, so pass explicit batches
      that keep batch*(seq+256) KV inside the remaining HBM: B<=32 at
      seq 512 with bf16 KV; the 1b default batch list OOMs at 8b),
      PROF_QUANT (int8|none, default int8), PROF_SEQ (kv len, default
-     512), PROF_ATTN (auto|pallas|xla).
+     512), PROF_ATTN (auto|pallas|xla), PROF_TABLES (random|contig,
+     default random — the historical layout; "contig" gives each slot a
+     consecutive block run, the run-tracking allocator's layout, so the
+     kernel's wave-coalesced DMA path engages; the header line reports
+     the DMA copies/wave either way so the two layouts are comparable).
 """
 
 import os
@@ -96,9 +100,27 @@ def main():
                           param_dtype=jnp.bfloat16)
         statics = core.statics
         rng = np.random.default_rng(0)
-        tables = jnp.asarray(
-            rng.integers(1, ecfg.num_kv_blocks, size=(batch, core.M)),
-            jnp.int32)
+        layout = os.environ.get("PROF_TABLES", "random")
+        if layout == "contig":
+            # the run-tracking allocator's layout: one consecutive run
+            # per slot (wraps at the pool end for oversized sweeps)
+            t = (np.arange(batch * core.M).reshape(batch, core.M)
+                 % (ecfg.num_kv_blocks - 1)) + 1
+            tables_np = t.astype(np.int32)
+        else:
+            tables_np = rng.integers(
+                1, ecfg.num_kv_blocks, size=(batch, core.M)).astype(
+                    np.int32)
+        from dynamo_tpu.engine.attention import dma_copy_counts
+        dma = dma_copy_counts(
+            tables_np, np.full((batch,), seq + 1, np.int32),
+            block_size=bs, pool_blocks=ecfg.num_kv_blocks,
+            dual_stream=mcfg.kv_lora_rank == 0)
+        print(f"# tables={layout} dma_copies/wave="
+              f"{dma['copies_per_wave']:.2f} "
+              f"({dma['coalesced_waves']}/{dma['waves']} waves "
+              f"coalesced)", file=sys.stderr)
+        tables = jnp.asarray(tables_np, jnp.int32)
         positions = jnp.asarray(np.full((batch,), seq, np.int32))
         tokens = jnp.asarray(rng.integers(1, 1000, size=(batch,)), jnp.int32)
         params, kv = core.params, core.kv
