@@ -441,6 +441,186 @@ def run_kv_disk_bench(mcfg) -> dict:
     }
 
 
+def kv_remote_mode() -> bool:
+    """Fleet-KV-fabric bench mode (--kv-remote or BENCH_KV_REMOTE=1):
+    cold-prefill vs remote-fetch TTFT A/B over loopback tcp (ISSUE 6).
+    One parse home for main() and the smoke tests."""
+    return (os.environ.get("BENCH_KV_REMOTE", "0") != "0"
+            or "--kv-remote" in sys.argv[1:])
+
+
+def run_kv_remote_bench(mcfg) -> dict:
+    """Remote-fetch TTFT for the fleet KV fabric (llm/kv/fabric.py):
+    worker A prefills a prompt and evicts it to disk; worker B (cold)
+    recomputes the same prompt; worker C fetches A's prefix over a REAL
+    loopback kv_fabric RPC (discovery daemon + bus + tcp dial-back) and
+    onboards it. Reports cold vs remote TTFT, bit-exactness of the two
+    token streams, and the admission model's PREDICTED fetch/recompute/
+    crossover next to the MEASURED ones — the honesty check on the gate
+    that decides when a remote hit is worth taking.
+
+    Compile noise control as in run_kv_disk_bench: one prefill bucket +
+    a throwaway warmup request per engine life."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import (FINISH_SENTINEL, EngineCore,
+                                        EngineRequest)
+    from dynamo_tpu.engine.sampling import SlotSampling
+    from dynamo_tpu.llm.kv.fabric import KvFabric
+    from dynamo_tpu.runtime.distributed import DistributedRuntime, Endpoint
+    from dynamo_tpu.runtime.server import DiscoveryServer
+
+    prompt_len = int(os.environ.get("BENCH_KV_REMOTE_PROMPT", "96"))
+    bs = 16
+    blocks = prompt_len // bs
+    root = tempfile.mkdtemp(prefix="kvremote-bench-")
+    rng = np.random.default_rng(11)
+    prompt = [int(t) for t in rng.integers(1, mcfg.vocab_size,
+                                           size=prompt_len)]
+    warm_prompt = [int(t) for t in rng.integers(1, mcfg.vocab_size,
+                                                size=prompt_len)]
+
+    def make_core(sub):
+        ecfg = EngineConfig(
+            max_model_len=prompt_len + 64, kv_block_size=bs,
+            num_kv_blocks=6 * (blocks + 4), max_num_seqs=2,
+            prefill_buckets=[prompt_len + 64],
+            host_kv_blocks=4 * (blocks + 2),
+            kv_disk_dir=os.path.join(root, sub),
+            kv_disk_blocks=8 * (blocks + 2))
+        return EngineCore(mcfg, ecfg, attn_impl="xla",
+                          param_dtype=jnp.float32)
+
+    async def serve(core, p, rid):
+        req = EngineRequest(rid=rid, prompt=list(p),
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=4, eos_ids=frozenset())
+        t0 = time.monotonic()
+        await core.submit(req)
+        ttft = None
+        toks = []
+        while True:
+            item, _ = await req.out_queue.get()
+            if ttft is None:
+                ttft = time.monotonic() - t0
+            if item is FINISH_SENTINEL:
+                break
+            toks.append(item)
+        return ttft, toks, req.prefix_hit_tokens
+
+    async def run():
+        # worker A: seed the prompt's prefix onto disk
+        core_a = make_core("a")
+        await serve(core_a, warm_prompt, "warmupA")
+        await serve(core_a, prompt, "seed")
+        await core_a.stop()                # graceful stop flushes → disk
+
+        srv = DiscoveryServer(host="127.0.0.1")
+        await srv.start()
+        rt_a = rt_c = None
+        fab_a = fab_c = None
+        try:
+            rt_a = await DistributedRuntime.connect(srv.address)
+            fab_a = await KvFabric.attach(
+                core_a, rt_a,
+                Endpoint.parse_path(rt_a, "dyn://bench/worker/generate"))
+            wid_a = rt_a.worker_id
+
+            # worker B: cold recompute baseline
+            core_b = make_core("b")
+            await serve(core_b, warm_prompt, "warmupB")
+            cold_ttft, cold_toks, _ = await serve(core_b, prompt, "cold")
+            await core_b.stop()
+
+            # worker C: fabric fetch of A's prefix over loopback tcp
+            core_c = make_core("c")
+            rt_c = await DistributedRuntime.connect(srv.address)
+            fab_c = await KvFabric.attach(
+                core_c, rt_c,
+                Endpoint.parse_path(rt_c, "dyn://bench/worker/generate"))
+            await serve(core_c, warm_prompt, "warmupC")
+            # the warmup's XLA compile dominates the measured prefill
+            # rate; reset and take one steady-state sample so the
+            # admission model prices recompute honestly
+            core_c.prefill_wall_s = 0.0
+            core_c.total_prefill_tokens = 0
+            steady = [int(t) for t in rng.integers(
+                1, mcfg.vocab_size, size=prompt_len)]
+            await serve(core_c, steady, "steadyC")
+            hashes = [h for h, _t, _p
+                      in core_a.disk_store.registered_entries()]
+            fab_c.store.note_peer_stored(wid_a, hashes)
+            # record what the auto gate WOULD decide on this rig's
+            # measured link and prefill rate, then force-admit so the
+            # A/B measures the fetch path either way — the predicted-
+            # vs-measured crossover below is the model's honesty check
+            link = fab_c.links.get(wid_a)
+            gate = fab_c.gate
+            auto_admit = gate.admit(len(hashes), link)
+            gate.mode = "always"
+            remote_ttft, remote_toks, remote_hit = await serve(
+                core_c, prompt, "remote")
+            n_fetched = remote_hit // bs
+            predicted_fetch_s = gate.modeled_fetch_s(max(n_fetched, 1),
+                                                     link)
+            predicted_rec_s = gate.modeled_recompute_s(max(n_fetched, 1))
+            predicted_cross = gate.crossover_blocks(link)
+            # measured crossover from the measured A/B: per-block gain g
+            # includes the amortized RTT, so per-block link gain is
+            # g + rtt/n and the depth where RTT is paid back is
+            # rtt / (g + rtt/n)
+            measured_gain_s = cold_ttft - remote_ttft
+            g = measured_gain_s / max(n_fetched, 1)
+            per_block_gain = g + link.rtt_s / max(n_fetched, 1)
+            measured_cross = (link.rtt_s / per_block_gain
+                              if per_block_gain > 0 else float("inf"))
+            await core_c.stop()
+            return {
+                "prompt_len": prompt_len,
+                "cold_ttft_ms": round(cold_ttft * 1e3, 2),
+                "remote_ttft_ms": round(remote_ttft * 1e3, 2),
+                "ttft_speedup": round(cold_ttft / max(remote_ttft, 1e-9),
+                                      3),
+                "remote_hit_tokens": remote_hit,
+                "fetched_blocks": n_fetched,
+                "peer_fetches": fab_c.peer_fetches_total,
+                "tokens_bit_exact": cold_toks == remote_toks,
+                "admission_auto_verdict": ("admit" if auto_admit
+                                           else "reject"),
+                "measured_link_gbps": round(link.gbps, 4),
+                "measured_link_rtt_ms": round(link.rtt_s * 1e3, 3),
+                "predicted_fetch_ms": round(predicted_fetch_s * 1e3, 2),
+                "predicted_recompute_ms": (
+                    None if predicted_rec_s == float("inf")
+                    else round(predicted_rec_s * 1e3, 2)),
+                "predicted_crossover_blocks": (
+                    None if predicted_cross == float("inf")
+                    else round(predicted_cross, 2)),
+                "measured_crossover_blocks": (
+                    None if measured_cross == float("inf")
+                    else round(measured_cross, 2)),
+            }
+        finally:
+            for fab in (fab_c, fab_a):
+                if fab is not None:
+                    await fab.close()
+            for rt in (rt_c, rt_a):
+                if rt is not None:
+                    await rt.shutdown()
+            await srv.close()
+
+    try:
+        return asyncio.run(run())
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def run_spec_bench(core, batch, prompt_len, prompts, spec_k,
                    n_dispatch, device_time) -> dict:
     """Speculative serving measurement (ISSUE 2 satellite): drive the
@@ -1096,6 +1276,13 @@ def main() -> None:
         # a fresh engine warm-starting from the same disk dir
         kv_disk_res = run_kv_disk_bench(mcfg)
 
+    kv_remote_res = None
+    if kv_remote_mode():
+        # independent three-engine loopback setup (seed → cold → fetch):
+        # the fabric A/B plus the admission model's predicted-vs-
+        # measured crossover honesty check
+        kv_remote_res = run_kv_remote_bench(mcfg)
+
     kv_frag_res = None
     if kv_frag_mode():
         # after the baseline/device rows (the frag leg rewrites block
@@ -1182,6 +1369,10 @@ def main() -> None:
     if kv_disk_res is not None:
         # disk (G3) tier provenance: warm-restart TTFT vs cold
         result["kv_disk"] = kv_disk_res
+    if kv_remote_res is not None:
+        # fleet-fabric (G4) provenance: remote-fetch TTFT vs cold +
+        # predicted/measured admission crossover
+        result["kv_remote"] = kv_remote_res
     if kv_frag_res is not None:
         # contiguity provenance: DMA-copy counts (always) + device
         # step-time A/B (when the tunnel allows) per layout

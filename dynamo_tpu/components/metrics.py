@@ -95,6 +95,29 @@ _TIER_GAUGES = {
     "disk_spill_dropped_total": "nv_llm_kv_disk_spill_dropped_jobs_total",
 }
 
+# remote (G4) fleet KV fabric (llm/kv/remotestore.py + fabric.py):
+# ForwardPassMetrics field → exported metric name. The Grafana "KV
+# fabric" row plots tier occupancy and hit rate next to the MEASURED
+# link model (decay-averaged peer gbps/rtt) and the two health signals
+# worth alerting on: fetch failures (peers vanishing mid-fetch — the
+# engine recomputes, but rising failures mean churn) and admission
+# rejects (the latency gate refusing hits — expected on slow links,
+# suspicious on fast ones). netstore retries ride along: the same
+# daemon link the fabric's discovery depends on.
+_REMOTE_GAUGES = {
+    "remote_used_blocks": "nv_llm_kv_remote_used_blocks",
+    "remote_capacity_blocks": "nv_llm_kv_remote_capacity_blocks",
+    "remote_peer_blocks": "nv_llm_kv_remote_peer_blocks",
+    "remote_stored_total": "nv_llm_kv_remote_stored_blocks_total",
+    "remote_hit_rate": "nv_llm_kv_remote_hit_rate",
+    "remote_fetch_failures_total": "nv_llm_kv_remote_fetch_failures_total",
+    "remote_admission_rejects_total":
+        "nv_llm_kv_remote_admission_rejects_total",
+    "remote_link_gbps": "nv_llm_kv_remote_link_gbps",
+    "remote_link_rtt_s": "nv_llm_kv_remote_link_rtt_seconds",
+    "netstore_retries_total": "nv_llm_netstore_retries_total",
+}
+
 
 class MetricsAggregatorService:
     """Aggregates worker load + router hit-rate into one Prometheus registry.
@@ -129,6 +152,10 @@ class MetricsAggregatorService:
             f: Gauge(name, f"KV layout/contiguity: worker {f} "
                      "(scraped stats)", labels, registry=self.registry)
             for f, name in _LAYOUT_GAUGES.items()}
+        self._remote_gauges: Dict[str, Gauge] = {
+            f: Gauge(name, f"KV fabric (remote tier): worker {f} "
+                     "(scraped stats)", labels, registry=self.registry)
+            for f, name in _REMOTE_GAUGES.items()}
         self.hit_isl_blocks = Counter(
             f"{PREFIX}_hit_rate_isl_blocks_total",
             "Routing decisions: total request blocks (ISL)",
@@ -256,6 +283,8 @@ class MetricsAggregatorService:
                 g.labels(*lbl).set(getattr(m, f))
             for f, g in self._layout_gauges.items():
                 g.labels(*lbl).set(getattr(m, f))
+            for f, g in self._remote_gauges.items():
+                g.labels(*lbl).set(getattr(m, f))
         # drop series for workers whose leases died (the watcher pruned them)
         for gone in self._seen_workers - present:
             self.latest.pop(gone, None)
@@ -264,7 +293,8 @@ class MetricsAggregatorService:
                       + list(self._spec_gauges.values())
                       + list(self._pp_gauges.values())
                       + list(self._tier_gauges.values())
-                      + list(self._layout_gauges.values())):
+                      + list(self._layout_gauges.values())
+                      + list(self._remote_gauges.values())):
                 try:
                     g.remove(*lbl)
                 except KeyError:

@@ -178,6 +178,20 @@ class MockTokenWorker:
             d["kv_contig_runs"] = 1
             d["kv_contiguity_ratio"] = 1.0
             d["attn_dma_copies_per_wave"] = 2.0
+        if eng is not None and not d.get("remote_link_gbps"):
+            # synthetic KV-fabric gauges (docs/kv_fabric.md): a healthy
+            # fabric — some object-tier residency, a ~10 GB/s / 1 ms
+            # measured link, zero failures — so the nv_llm_kv_remote_*
+            # scrape path and the router's NetKV scoring inputs
+            # (kv_bytes_per_block / prefill_tok_per_s) are exercisable
+            # with zero hardware
+            d["remote_used_blocks"] = eng.requests_served
+            d["remote_peer_blocks"] = 4 * eng.requests_served
+            d["remote_hit_rate"] = 0.5
+            d["remote_link_gbps"] = 10.0
+            d["remote_link_rtt_s"] = 1e-3
+            d["kv_bytes_per_block"] = 1 << 20
+            d["prefill_tok_per_s"] = 5e4
         return d
 
     @property

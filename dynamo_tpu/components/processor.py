@@ -55,6 +55,12 @@ async def amain(argv=None) -> None:
     endpoint = Endpoint.parse_path(runtime, args.endpoint)
     engine = await KvRoutedEngine.start(endpoint,
                                         block_size=args.kv_block_size)
+    # router-side tier-weight retune (llmctl kv set-weights): the
+    # scheduler's TIER_WEIGHTS follow the kvtier/weights/{ns} key live
+    from ..llm.kv.admin import watch_weights_loop
+    weights_task = asyncio.get_running_loop().create_task(
+        watch_weights_loop(runtime, endpoint.namespace),
+        name="kv-weights-watch")
     pipeline = link(OpenAIPreprocessor(mdc), Backend(mdc), engine)
     svc = HttpService(port=args.port, host=args.host)
     svc.manager.add_chat_model(name, pipeline)
@@ -64,6 +70,7 @@ async def amain(argv=None) -> None:
     try:
         await svc.run_forever()
     finally:
+        weights_task.cancel()
         await engine.close()
         await runtime.shutdown()
 

@@ -539,6 +539,21 @@ class EngineConfig:
     # sits UNDER the host tier — spill feeds on its evictions).
     kv_disk_dir: str = ""
     kv_disk_blocks: int = 0           # disk tier capacity; 0 = off
+    # remote (G4) fleet KV fabric (llm/kv/remotestore.py + fabric.py).
+    # kv_remote_dir roots the object-store backend (GCS/S3-shaped,
+    # filesystem-rooted — a mounted bucket in production): disk-tier
+    # capacity evictions promote there write-behind (acknowledged iff
+    # durable) and ANY worker pointed at the same root reuses them; the
+    # peer-worker backend (another worker's disk over the kv_fabric RPC
+    # plane) needs no dir and attaches at runtime (launch/run.py
+    # --kv-fabric). Requires the disk tier (the promotion pump feeds on
+    # its evictions). kv_remote_blocks 0 = unbounded object capacity.
+    kv_remote_dir: str = ""
+    kv_remote_blocks: int = 0
+    # latency-aware admission for remote hits (fabric.AdmissionGate):
+    # "auto" promotes only when modeled fetch beats modeled recompute;
+    # "always"/"never" are ops overrides
+    kv_remote_admission: str = "auto"
     # pace the offload pump's write-backs to this simulated d2h link
     # (GB/s); 0 = real link speed. Lets a CPU run measure the tier under a
     # realistic TPU-VM link instead of this rig's tunnel (tools/
@@ -726,6 +741,18 @@ class EngineConfig:
             raise ValueError(
                 "the disk KV tier sits under the host tier (spill feeds "
                 "on host evictions) — set host_kv_blocks > 0 too")
+        if self.kv_remote_dir and self.kv_disk_blocks <= 0:
+            raise ValueError(
+                "the remote (G4) object tier sits under the disk tier "
+                "(promotion feeds on disk evictions) — set kv_disk_dir/"
+                "kv_disk_blocks too")
+        if self.kv_remote_blocks > 0 and not self.kv_remote_dir:
+            raise ValueError(
+                "kv_remote_blocks needs kv_remote_dir (the object-store "
+                "root); the peer fabric alone has no local capacity")
+        if self.kv_remote_admission not in ("auto", "always", "never"):
+            raise ValueError(
+                "kv_remote_admission must be auto | always | never")
         if self.lane_prefill_max_tokens > 0 \
                 and self.decode_steps_per_dispatch <= 1:
             raise ValueError(

@@ -334,12 +334,31 @@ class EngineCore:
             self.spill_engine = DiskSpillEngine(
                 self.disk_store, on_commit=self._emit_kv_disk_store)
             host_pool.on_evict = self._on_host_evict
+        self.remote_store = None
+        self.remote_spill_engine = None
+        self.kv_fabric = None            # llm/kv/fabric.py, attached at run
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        if engine_cfg.kv_remote_dir:
+            # G4 tier (llm/kv/remotestore.py): the fleet fabric's durable
+            # rung — disk-tier capacity evictions promote to the shared
+            # object store (write-behind, acknowledged iff durable), and
+            # remote hits onboard through the same off-thread path as
+            # disk. The peer-worker backend attaches at runtime
+            # (attach_kv_fabric). __post_init__ guaranteed the disk tier
+            # exists.
+            from ..llm.kv.diskstore import DiskSpillEngine
+            from ..llm.kv.remotestore import ObjectKvBackend, RemoteKvStore
+            self.remote_store = RemoteKvStore(ObjectKvBackend(
+                engine_cfg.kv_remote_dir, engine_cfg.kv_remote_blocks))
+            self.remote_spill_engine = DiskSpillEngine(
+                self.remote_store, on_commit=self._emit_kv_remote_store)
+            self.disk_store.on_evict = self._on_disk_evict
         self.kv_manager = KvBlockManager(
             engine_cfg.num_kv_blocks, engine_cfg.kv_block_size,
             enable_reuse=engine_cfg.enable_prefix_reuse,
             on_stored=self._on_block_stored,
             on_removed=self._on_block_removed, host_pool=host_pool,
-            disk_store=self.disk_store)
+            disk_store=self.disk_store, remote_store=self.remote_store)
         if host_pool is not None:
             self.offload_engine = KvOffloadEngine(
                 host_pool, engine_cfg.kv_block_size,
@@ -408,6 +427,16 @@ class EngineCore:
         # disk (G3) tier: promote-path admissions + blocks restored
         self.disk_onboards = 0
         self.disk_onboarded_blocks = 0
+        # remote (G4) fabric tier: fetch-path admissions + the graceful
+        # fallbacks (a failed peer fetch recomputes, never errors)
+        self.remote_onboards = 0
+        self.remote_onboarded_blocks = 0
+        self.remote_fetch_failures = 0
+        # measured prefill rate feed for the fabric's admission gate and
+        # the router's NetKV scoring: wall seconds spent in prefill
+        # admissions (dispatch + host glue — an upper bound, so the
+        # modeled recompute it feeds is conservative)
+        self.prefill_wall_s = 0.0
         # speculation stats (nv_llm_spec_* metrics feed)
         self.spec_dispatches = 0       # verify dispatches issued
         self.spec_drafted_tokens = 0   # draft tokens scored
@@ -624,7 +653,10 @@ class EngineCore:
                 f"EngineCore") from self._dead
         if self._loop_task is None or self._loop_task.done():
             self._stopping = False
-            self._loop_task = asyncio.get_running_loop().create_task(
+            # worker-thread hooks (disk-evict → remote promotion) need a
+            # handle to reach the loop via call_soon_threadsafe
+            self._loop = asyncio.get_running_loop()
+            self._loop_task = self._loop.create_task(
                 self._run_loop(), name="engine-core-loop")
 
     async def stop(self) -> None:
@@ -663,6 +695,8 @@ class EngineCore:
                 self.kv_manager.host_pool.unpin(plan.host_slots)
                 if plan.disk_hashes:
                     self.disk_store.unpin(plan.disk_hashes)
+                if plan.remote_hashes:
+                    self.remote_store.unpin(plan.remote_hashes)
                 self._finish_request(req, FinishReason.CANCELLED)
             self._onboards = []
         if self._pending is not None:     # drain the pipelined dispatch
@@ -682,6 +716,11 @@ class EngineCore:
                 logger.warning("host→disk flush timed out on stop")
             await self.spill_engine.stop()
             self.disk_store.close()
+        if self.remote_spill_engine is not None:
+            # drain AFTER the disk pump: the flush above may have forced
+            # disk evictions whose promotion jobs are still queued
+            await self.remote_spill_engine.stop()
+            self.remote_store.close()
 
     @property
     def wire_kv_heads(self) -> int:
@@ -839,6 +878,16 @@ class EngineCore:
                     self.kv_event_publisher.publish_stored(
                         -1, h, th, ph, tier="disk")
                     n += 1
+        # remote (G4) object tier: durable blocks THIS worker can fetch
+        # back (peer-held hashes are the peer's to announce)
+        if self.remote_store is not None:
+            for h, th, ph in self.remote_store.registered_entries():
+                if (not self.kv_manager.pool.peek_prefix([h])
+                        and not (self.disk_store is not None
+                                 and self.disk_store.contains(h))):
+                    self.kv_event_publisher.publish_stored(
+                        -1, h, th, ph, tier="remote")
+                    n += 1
         return n
 
     async def flush_host_to_disk(self) -> int:
@@ -921,7 +970,26 @@ class EngineCore:
                 disk_bytes_used=disk.bytes_used,
                 disk_spill_dropped_total=self
                 .spill_engine.dropped_jobs_total)
+        if self.remote_store is not None or self.kv_fabric is not None:
+            # remote (G4) fabric: tier occupancy + the measured link
+            # model the router's NetKV scoring consumes (kv_router/
+            # scoring.py network_adjusted_overlap)
+            if self.kv_fabric is not None:
+                tier_kw.update(self.kv_fabric.metrics())
+            else:
+                rs = self.remote_store
+                tier_kw.update(
+                    remote_used_blocks=rs.used_blocks,
+                    remote_capacity_blocks=rs.capacity,
+                    remote_peer_blocks=rs.peer_block_count(),
+                    remote_stored_total=rs.stored_blocks_total,
+                    remote_hit_rate=rs.hit_rate(),
+                    remote_fetch_failures_total=rs.fetch_failures_total,
+                    remote_admission_rejects_total=rs
+                    .admission_rejects_total)
         return ForwardPassMetrics(
+            kv_bytes_per_block=self.kv_bytes_per_block(),
+            prefill_tok_per_s=self.measured_prefill_tok_per_s(),
             **tier_kw,
             request_active_slots=active,
             request_total_slots=self.B,
@@ -991,6 +1059,8 @@ class EngineCore:
                 self.kv_manager.host_pool.unpin(plan.host_slots)
             if plan.disk_hashes and self.disk_store is not None:
                 self.disk_store.unpin(plan.disk_hashes)
+            if plan.remote_hashes and self.remote_store is not None:
+                self.remote_store.unpin(plan.remote_hashes)
         self._onboards = []
         # clear scheduler state so nothing can be re-served even if a
         # caller pokes internals
@@ -1130,12 +1200,13 @@ class EngineCore:
             self.kv_manager.abort_plan(plan)
             self._finish_request(req, FinishReason.LENGTH)
             return True
-        if plan.host_slots or plan.disk_hashes:
-            # host/disk-tier hits: the wire→block-major copies (and the
-            # disk file reads) are pure host work — run them OFF the loop
-            # (reference overlaps its tier copies with compute via
-            # CopyStream, kv/layer.rs; our analog is a thread + deferred
-            # admission) and finish admitting when ready
+        if plan.host_slots or plan.disk_hashes or plan.remote_hashes:
+            # host/disk/remote-tier hits: the wire→block-major copies
+            # (and the disk file reads / fabric fetches) are pure host
+            # work — run them OFF the loop (reference overlaps its tier
+            # copies with compute via CopyStream, kv/layer.rs; our
+            # analog is a thread + deferred admission) and finish
+            # admitting when ready
             self._start_onboard(req, slot, plan)
             return True
         return self._admit_with_plan(req, slot, plan, None)
@@ -1188,10 +1259,82 @@ class EngineCore:
             if not self.kv_manager.pool.peek_prefix([h]):
                 pub.publish_stored(-1, h, th, ph, tier="disk")
 
+    # ---------------------------------------------------- remote (G4) tier
+    def _on_disk_evict(self, seq_hash: int, tokens_hash, parent_hash,
+                       values: dict) -> None:
+        """Disk-tier capacity-eviction hook: offer the block to the
+        remote promotion pump (object-store write-behind) so a prefix
+        leaving this worker's disk survives in the fleet. Fires on the
+        spill pump's WORKER thread (inside DiskKvStore.put's eviction) —
+        hop to the loop before touching the asyncio queue."""
+        if self.remote_spill_engine is None or self._loop is None:
+            return
+        from ..llm.kv.diskstore import SpillJob
+        job = SpillJob(seq_hash=seq_hash, tokens_hash=tokens_hash,
+                       parent_hash=parent_hash, values=values)
+        try:
+            self._loop.call_soon_threadsafe(self._offer_remote_spill, job)
+        except RuntimeError:
+            pass                           # loop already closed (shutdown)
+
+    def _offer_remote_spill(self, job) -> None:
+        self.remote_spill_engine.offer(job)
+
+    def _emit_kv_remote_store(self, items: list) -> None:
+        """Remote promotion commit hook: [(hash, tokens_hash, parent,
+        evicted)] per durably-acknowledged object put. Announces the
+        promoted prefixes tier="remote" — unless a warmer tier still
+        holds the hash (its announce stands at a better weight). The
+        remote tier is NOT mirrored to multihost followers: the object
+        store is fleet-shared state, not per-rank state, and followers
+        never run the admission cascade."""
+        pub = self.kv_event_publisher
+        if pub is None:
+            return
+        host = self.kv_manager.host_pool
+        for h, th, ph, evicted in items:
+            for gone in evicted:
+                self._publish_tier_removed(gone)
+            if self.kv_manager.pool.peek_prefix([h]):
+                continue
+            if host is not None and host.contains(h):
+                continue
+            if self.disk_store is not None and self.disk_store.contains(h):
+                continue
+            pub.publish_stored(-1, h, th, ph, tier="remote")
+
+    def attach_kv_fabric(self, fabric) -> None:
+        """Wire an attached fleet fabric (llm/kv/fabric.py KvFabric):
+        its RemoteKvStore becomes the cascade's G4 rung. Engine-side
+        construction (kv_remote_dir) may already have built an
+        object-backed store — the fabric wraps that same store, so this
+        is idempotent on the manager side."""
+        self.kv_fabric = fabric
+        self.remote_store = fabric.store
+        self.kv_manager.remote_store = fabric.store
+
+    def kv_bytes_per_block(self) -> int:
+        """Wire bytes one KV block moves (all layers/streams) — the
+        admission gate's and the router's transfer-cost unit."""
+        total = sum(int(np.prod(v.shape)) * v.dtype.itemsize
+                    for v in self.kv.values())
+        return max(total // max(self.cfg.num_kv_blocks, 1), 1)
+
+    def measured_prefill_tok_per_s(self) -> float:
+        """MEASURED prefill rate (tokens per wall second spent in
+        prefill admissions) — the recompute side of the fabric's
+        fetch-vs-recompute model. 0.0 until the first prefill lands
+        (the gate treats unknown as admit)."""
+        if self.prefill_wall_s <= 0:
+            return 0.0
+        return self.total_prefill_tokens / self.prefill_wall_s
+
     def _publish_tier_removed(self, seq_hash: int) -> None:
-        """Removed-from-disk announce, suppressed while any warmer tier
-        still holds the hash (the router would otherwise lose a prefix
-        this worker can still serve)."""
+        """Removed-from-disk announce, suppressed while any warmer OR
+        colder tier still holds the hash (the router would otherwise
+        lose a prefix this worker can still serve). A disk eviction
+        whose block was promoted to the durable remote tier DEMOTES the
+        announce to tier="remote" instead."""
         pub = self.kv_event_publisher
         if pub is None:
             return
@@ -1199,6 +1342,10 @@ class EngineCore:
         if self.kv_manager.pool.peek_prefix([seq_hash]):
             return
         if host is not None and host.contains(seq_hash):
+            return
+        if (self.remote_store is not None
+                and self.remote_store.holds_durable(seq_hash)):
+            pub.publish_stored(-1, seq_hash, None, None, tier="remote")
             return
         pub.publish_removed([seq_hash])
 
@@ -1227,6 +1374,9 @@ class EngineCore:
                 pub.publish_stored(-1, h, th, ph, tier="host")
             elif self.disk_store is not None and self.disk_store.contains(h):
                 pub.publish_stored(-1, h, None, None, tier="disk")
+            elif (self.remote_store is not None
+                  and self.remote_store.holds_durable(h)):
+                pub.publish_stored(-1, h, None, None, tier="remote")
             else:
                 gone.append(h)
         if gone:
@@ -1247,16 +1397,17 @@ class EngineCore:
         if plan.disk_hashes:
             self.disk_onboards += 1
             self.disk_onboarded_blocks += len(plan.disk_hashes)
+        if plan.remote_hashes:
+            self.remote_onboards += 1
+            self.remote_onboarded_blocks += len(plan.remote_hashes)
         host_pool = self.kv_manager.host_pool
         disk = self.disk_store
+        remote = self.remote_store
         host_pool.pin(plan.host_slots)    # offload stores must not evict
 
         async def prepare() -> None:
             prepped = None
             try:
-                n_onboard = len(plan.host_slots) + len(plan.disk_hashes)
-                targets = plan.new_blocks[:n_onboard]
-
                 def prep():
                     from .block_copy import prep_host_values
                     parts = []
@@ -1264,6 +1415,33 @@ class EngineCore:
                         parts.append(host_pool.fetch(plan.host_slots))
                     if plan.disk_hashes:
                         parts.append(disk.fetch(plan.disk_hashes))
+                    if plan.remote_hashes:
+                        # G4 fetch: peer RPC / object read. Unreachable
+                        # (peer died, object torn) is NOT an error — drop
+                        # the remote tail from the plan and the engine
+                        # recomputes those tokens (graceful fallback:
+                        # the fabric must never make serving worse than
+                        # a cold prefill)
+                        try:
+                            parts.append(remote.fetch(plan.remote_hashes))
+                        except Exception:  # noqa: BLE001
+                            logger.warning(
+                                "remote KV fetch of %d block(s) failed "
+                                "for %s — recomputing the tail",
+                                len(plan.remote_hashes), req.rid,
+                                exc_info=True)
+                            self.remote_fetch_failures += 1
+                            self.remote_onboarded_blocks -= len(
+                                plan.remote_hashes)
+                            remote.unpin(plan.remote_hashes)
+                            plan.remote_hashes = []
+                    if not parts:
+                        # every tier hit fell away: admit with no onboard
+                        return [], {}
+                    n_onboard = (len(plan.host_slots)
+                                 + len(plan.disk_hashes)
+                                 + len(plan.remote_hashes))
+                    targets = plan.new_blocks[:n_onboard]
                     vals = (parts[0] if len(parts) == 1 else
                             {k: np.concatenate([p[k] for p in parts],
                                                axis=2)
@@ -1305,10 +1483,14 @@ class EngineCore:
                 self._admit_with_plan(req, slot, plan, prepped)
             finally:
                 # _start_onboard pinned these; safe to evict only now
-                # that hit_transfer (if any) is on the stream
+                # that hit_transfer (if any) is on the stream. A failed
+                # remote fetch already unpinned and cleared remote_hashes
+                # inside the prep (graceful fallback).
                 self.kv_manager.host_pool.unpin(plan.host_slots)
                 if plan.disk_hashes:
                     self.disk_store.unpin(plan.disk_hashes)
+                if plan.remote_hashes:
+                    self.remote_store.unpin(plan.remote_hashes)
 
     def _admit_with_plan(self, req: EngineRequest, slot: int, plan,
                          onboard) -> bool:
@@ -1320,7 +1502,8 @@ class EngineCore:
         # into their device slots before prefill (reference
         # prepare_prefill_offload; the +40% TTFT multi-turn win,
         # docs/architecture.md:91)
-        n_onboard = len(plan.host_slots) + len(plan.disk_hashes)
+        n_onboard = (len(plan.host_slots) + len(plan.disk_hashes)
+                     + len(plan.remote_hashes))
         if n_onboard:
             from .block_copy import scatter_prepped
             ids, vals = onboard
@@ -1336,7 +1519,8 @@ class EngineCore:
                     bid, plan.seq.sequence_hashes[j],
                     plan.seq.block_hashes[j], parent)
         req.prefix_hit_tokens = (plan.hit_tokens + plan.host_hit_tokens
-                                 + plan.disk_hit_tokens)
+                                 + plan.disk_hit_tokens
+                                 + plan.remote_hit_tokens)
         n_already = len(plan.hit_blocks) + n_onboard
         if self.recorder is not None and req.prefix_hit_tokens > 0:
             # before the prefill record: read rights over the shared
@@ -1347,6 +1531,17 @@ class EngineCore:
             # do the same for the G3 promote (the follower fetches the
             # hashes from its own mirror disk store)
             n_host = len(plan.host_slots)
+            n_hd = n_host + len(plan.disk_hashes)
+            if plan.remote_hashes:
+                # no remote_* fields on the record: the fabric is
+                # leader-only (the object store / peer fleet is shared,
+                # not per-rank state), so a remote-assisted admission
+                # cannot be replayed on a follower mirror — refuse at
+                # the stream source instead of diverging silently
+                raise RuntimeError(
+                    "remote (G4) KV onboarding is not supported on a "
+                    "recorded/multihost engine — disable the fabric or "
+                    "the recorder")
             self.recorder.rec("hit_transfer", rid=req.rid,
                               hit=req.prefix_hit_tokens,
                               host_hit=plan.host_hit_tokens,
@@ -1360,7 +1555,7 @@ class EngineCore:
                                   plan.new_blocks[:n_host]),
                               disk_hashes=list(plan.disk_hashes),
                               disk_targets=list(
-                                  plan.new_blocks[n_host:n_onboard]))
+                                  plan.new_blocks[n_host:n_hd]))
         t0 = time.monotonic()
         suffix_len = n_prompt - req.prefix_hit_tokens
         if (self.cfg.lane_prefill_max_tokens > 0
@@ -1449,6 +1644,11 @@ class EngineCore:
                     jnp.asarray(req.sampling.top_k, jnp.int32),
                     jnp.asarray(req.sampling.top_p, jnp.float32))
             self.total_prefill_tokens += len(chunk)
+            # measured prefill rate (fabric admission gate + the
+            # router's NetKV recompute model): wall time from plan to
+            # dispatched prefill — an upper bound on the true compute
+            # cost, so the modeled recompute stays conservative
+            self.prefill_wall_s += time.monotonic() - t0
             # defer the device→host fetch of the first token: it overlaps
             # the next decode dispatch instead of stalling the loop. Wire
             # handoff needs the host value immediately; DEVICE handoff
@@ -1500,9 +1700,10 @@ class EngineCore:
         self._samp["top_p"][slot] = req.sampling.top_p
         self._seeds[slot] = req.sampling.seed
         logger.debug(
-            "admitted %s into slot %d (prompt=%d, hit=%d+%dhost+%ddisk, "
-            "remote=%s, %.1fms)", req.rid, slot, n_prompt, plan.hit_tokens,
-            plan.host_hit_tokens, plan.disk_hit_tokens, remote_admit,
+            "admitted %s into slot %d (prompt=%d, hit=%d+%dhost+%ddisk+"
+            "%dremote, handoff=%s, %.1fms)", req.rid, slot, n_prompt,
+            plan.hit_tokens, plan.host_hit_tokens, plan.disk_hit_tokens,
+            plan.remote_hit_tokens, remote_admit,
             1e3 * (time.monotonic() - t0))
         if req.ready:
             self._emit(req, tok, float(logprob))

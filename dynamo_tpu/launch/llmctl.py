@@ -86,6 +86,17 @@ def build_parser() -> argparse.ArgumentParser:
     kvf.add_argument("--clear", action="store_true",
                      help="drop the disk cache instead of persisting "
                           "into it")
+    kvw = kvsub.add_parser(
+        "set-weights",
+        help="retune the router's per-tier overlap weights live "
+             "(kv_router/scoring.py TIER_WEIGHTS): workers and routers "
+             "watching kvtier/weights/{ns} apply the change without "
+             "restart")
+    kvw.add_argument("namespace")
+    kvw.add_argument("--device", type=float, default=None)
+    kvw.add_argument("--host", type=float, default=None)
+    kvw.add_argument("--disk", type=float, default=None)
+    kvw.add_argument("--remote", type=float, default=None)
 
     dep = sub.add_parser("deployment",
                          help="manage graph deployments (deploy/ control "
@@ -252,8 +263,19 @@ async def _kv_cmd(runtime, args) -> int:
     import time
 
     from ..llm.kv.admin import (KV_PREFIX, KvTierStatus, kv_control_key,
-                                kv_status_key)
+                                kv_status_key, kv_weights_key)
 
+    if args.kv_cmd == "set-weights":
+        weights = {t: getattr(args, t) for t in ("device", "host", "disk",
+                                                 "remote")
+                   if getattr(args, t) is not None}
+        if not weights:
+            print("nothing to set (pass --device/--host/--disk/--remote)")
+            return 1
+        await runtime.store.kv_put(kv_weights_key(args.namespace),
+                                   json.dumps(weights).encode())
+        print(f"kv tier weights for {args.namespace} → {weights}")
+        return 0
     if args.kv_cmd == "status":
         prefix = (kv_status_key(args.namespace)
                   if args.namespace else f"{KV_PREFIX}status/")
@@ -279,6 +301,15 @@ async def _kv_cmd(runtime, args) -> int:
                       f"onboards={s.disk_onboards}  dir={s.disk_dir}")
             else:
                 print("  disk:  (tier off)")
+            if s.remote_capacity or s.remote_blocks or s.remote_peer_blocks:
+                print(f"  remote: {s.remote_blocks} object blocks"
+                      f"{f'/{s.remote_capacity}' if s.remote_capacity else ''}"
+                      f"  peers hold {s.remote_peer_blocks}  "
+                      f"hit_rate={s.remote_hit_rate:.3f}  "
+                      f"onboards={s.remote_onboards}  "
+                      f"fetch_failures={s.remote_fetch_failures}  "
+                      f"link={s.remote_link_gbps:.2f}GB/s "
+                      f"rtt={s.remote_link_rtt_s * 1e3:.1f}ms")
         return 0
     # flush [--clear]
     await runtime.store.kv_put(

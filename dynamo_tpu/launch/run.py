@@ -92,6 +92,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "--host-kv-blocks")
     p.add_argument("--kv-disk-blocks", type=int, default=0,
                    help="disk KV tier capacity in blocks (0 = off)")
+    p.add_argument("--kv-remote-dir", default="",
+                   help="remote (G4) object-store root (llm/kv/"
+                        "remotestore.py — a mounted bucket/NFS export "
+                        "shared across the fleet): disk-tier evictions "
+                        "promote here write-behind and any worker "
+                        "pointed at the same root reuses them; needs "
+                        "the disk tier")
+    p.add_argument("--kv-remote-blocks", type=int, default=0,
+                   help="object tier capacity in blocks (0 = unbounded)")
+    p.add_argument("--kv-fabric", action="store_true",
+                   help="join the fleet KV fabric (llm/kv/fabric.py): "
+                        "serve this worker's disk/host KV to peers over "
+                        "a kv_fabric endpoint and fetch peers' prefixes "
+                        "instead of recomputing them, behind a "
+                        "latency-aware admission gate")
+    p.add_argument("--kv-remote-admission",
+                   choices=["auto", "always", "never"], default="auto",
+                   help="remote-hit admission: auto = promote only when "
+                        "the modeled fetch beats the modeled recompute")
     p.add_argument("--no-prefix-reuse", action="store_true")
     p.add_argument("--kv-quantization",
                    choices=["none", "int8"], default="none",
@@ -196,6 +215,9 @@ def engine_config(args):
         host_kv_blocks=args.host_kv_blocks,
         kv_disk_dir=args.kv_disk_dir,
         kv_disk_blocks=args.kv_disk_blocks,
+        kv_remote_dir=args.kv_remote_dir,
+        kv_remote_blocks=args.kv_remote_blocks,
+        kv_remote_admission=args.kv_remote_admission,
         prefill_chunk=args.prefill_chunk,
         decode_steps_per_dispatch=args.decode_steps_per_dispatch,
         decode_dispatch_pipeline=args.decode_dispatch_pipeline,
@@ -431,10 +453,23 @@ async def run_worker_endpoint(args, engine, pipeline, core, runtime,
     endpoint = Endpoint.parse_path(runtime, path)
     stats_handler = None
     if core is not None:
-        stats_handler = lambda: core.metrics().to_dict()  # noqa: E731
+        def stats_handler():
+            from ..runtime import netstore
+            d = core.metrics().to_dict()
+            # process-wide daemon-retry counter rides the worker's
+            # scrape (nv_llm_netstore_retries_total)
+            d["netstore_retries_total"] = netstore.retries_total()
+            return d
         await _wire_kv_events(core, runtime, endpoint)
         await _wire_spec_config(core, runtime, endpoint.namespace)
         _wire_kv_admin(core, runtime, endpoint.namespace)
+        _wire_kv_weights(runtime, endpoint.namespace)
+        if args.kv_fabric:
+            # fleet KV fabric (llm/kv/fabric.py): serve our disk/host
+            # blocks at dyn://{ns}/{comp}/kv_fabric, fetch peers' —
+            # the G4 rung behind the same KvBlockManager cascade
+            from ..llm.kv.fabric import KvFabric
+            await KvFabric.attach(core, runtime, endpoint)
     if args.protocol == "tokens":
         if mdc is None:
             raise SystemExit(
@@ -562,6 +597,17 @@ def _wire_kv_admin(core, runtime, namespace: str) -> None:
                      name="kv-admin-status")
     loop.create_task(watch_control_loop(core, runtime, namespace),
                      name="kv-admin-control")
+
+
+def _wire_kv_weights(runtime, namespace: str) -> None:
+    """llmctl kv set-weights plumbing: apply the namespace's stored tier
+    weights and keep applying live updates (llm/kv/admin.py
+    watch_weights_loop). Runs on every worker — and any process hosting
+    a KV router gets the same watch via KvRoutedEngine — so the fleet's
+    scoring stays coherent."""
+    from ..llm.kv.admin import watch_weights_loop
+    asyncio.get_running_loop().create_task(
+        watch_weights_loop(runtime, namespace), name="kv-weights-watch")
 
 
 async def run_prefill_worker(args, core, runtime) -> None:

@@ -31,6 +31,13 @@ def kv_control_key(namespace: str) -> str:
     return f"{KV_PREFIX}control/{namespace}"
 
 
+def kv_weights_key(namespace: str) -> str:
+    """llmctl kv set-weights target: a JSON {tier: weight} partial map
+    every watching worker/router applies live
+    (kv_router/scoring.set_tier_weights)."""
+    return f"{KV_PREFIX}weights/{namespace}"
+
+
 @dataclasses.dataclass
 class KvTierStatus:
     """One worker's KV-ladder snapshot (the llmctl kv status payload)."""
@@ -47,6 +54,15 @@ class KvTierStatus:
     spill_dropped: int = 0
     offload_dropped: int = 0
     disk_onboards: int = 0
+    # remote (G4) fleet fabric (llm/kv/remotestore.py + fabric.py)
+    remote_blocks: int = 0
+    remote_capacity: int = 0
+    remote_peer_blocks: int = 0
+    remote_hit_rate: float = 0.0
+    remote_onboards: int = 0
+    remote_fetch_failures: int = 0
+    remote_link_gbps: float = 0.0
+    remote_link_rtt_s: float = 0.0
     updated_at: float = 0.0
 
     def to_json(self) -> bytes:
@@ -63,7 +79,21 @@ def snapshot(core, namespace: str) -> KvTierStatus:
     """Current tier state of one EngineCore."""
     host = core.kv_manager.host_pool
     disk = core.disk_store
+    remote = getattr(core, "remote_store", None)
+    fabric = getattr(core, "kv_fabric", None)
     return KvTierStatus(
+        remote_blocks=remote.used_blocks if remote is not None else 0,
+        remote_capacity=remote.capacity if remote is not None else 0,
+        remote_peer_blocks=(remote.peer_block_count()
+                            if remote is not None else 0),
+        remote_hit_rate=remote.hit_rate() if remote is not None else 0.0,
+        remote_onboards=getattr(core, "remote_onboards", 0),
+        remote_fetch_failures=(remote.fetch_failures_total
+                               if remote is not None else 0),
+        remote_link_gbps=(fabric.links.avg_gbps()
+                          if fabric is not None else 0.0),
+        remote_link_rtt_s=(fabric.links.avg_rtt_s()
+                           if fabric is not None else 0.0),
         namespace=namespace,
         host_blocks=len(host) if host is not None else 0,
         host_capacity=host.capacity if host is not None else 0,
@@ -142,3 +172,37 @@ async def watch_control_loop(core, runtime, namespace: str) -> None:
                 await act(ev.entry.value)
             except Exception:  # noqa: BLE001 — one bad command must not
                 logger.exception("kv control command failed")
+
+
+async def watch_weights_loop(runtime, namespace: str) -> None:
+    """Standing task: apply `llmctl kv set-weights` live. Unlike the
+    flush control, the STORED value applies at startup too — tier
+    weights are declarative config, not a one-shot command, so a late
+    joiner must converge to the namespace's current table. Workers and
+    routers both run this; the scoring module's TIER_WEIGHTS dict is
+    mutated in place so every importer (indexer tier discounting,
+    scheduler NetKV credit) sees the change without restart."""
+    from ...runtime.kvstore import WatchEventType
+    from ..kv_router.scoring import set_tier_weights
+
+    key = kv_weights_key(namespace)
+
+    def apply(raw: bytes) -> None:
+        try:
+            weights = json.loads(raw)
+        except ValueError:
+            logger.warning("ignoring malformed kv weights at %s", key)
+            return
+        if not isinstance(weights, dict):
+            logger.warning("ignoring non-dict kv weights at %s", key)
+            return
+        eff = set_tier_weights(weights)
+        logger.info("kv tier weights -> %s", eff)
+
+    entry = await runtime.store.kv_get(key)
+    if entry is not None:
+        apply(entry.value)
+    watcher = await runtime.store.watch_prefix(key)
+    async for ev in watcher:
+        if ev.type == WatchEventType.PUT:
+            apply(ev.entry.value)

@@ -137,12 +137,21 @@ class DiskKvStore:
         self._pins: Dict[int, int] = {}
         self._manifest_f: Optional[io.TextIOWrapper] = None
         self.meta: dict = {}
+        # capacity-eviction hook: called with (seq_hash, tokens_hash,
+        # parent_hash, values) BEFORE the block leaves the store — the
+        # remote (G4) promotion feed (remotestore.py), mirroring the
+        # host pool's on_evict one rung up. Fires on whichever thread
+        # ran the put (usually the spill pump's worker thread); the
+        # callee owns the values dict outright. clear()/apply_put
+        # deletions do NOT fire it — only capacity pressure promotes.
+        self.on_evict: Optional[Callable] = None
         # stats (nv_llm_kv_disk_* feed)
         self.stored_blocks_total = 0
         self.evicted_blocks_total = 0
         self.match_queries = 0
         self.match_hits = 0
         self.restored_blocks = 0        # entries recovered at open
+        self.reaped_corrupt_blocks = 0  # missing/truncated payloads reaped
         self.bytes_used = 0
         self._recover(expect_block_size)
 
@@ -189,12 +198,27 @@ class DiskKvStore:
                             nbytes=int(rec.get("n", 0)))
                     elif rec.get("op") == "del":
                         live.pop(int(rec["h"]), None)
-        # keep only entries whose data file actually exists (a manifest
-        # line with a vanished file cannot serve reads)
+        # keep only entries whose data file actually exists AND has the
+        # acknowledged byte count — a manifest line with a vanished or
+        # truncated payload cannot serve reads. Our own writes are
+        # atomic (tmp → fsync → rename), so a short file means external
+        # damage (fs corruption, a copied-around cache dir): reap it and
+        # count, never surface it (the kill-during-put regression in
+        # tests/test_kv_disk.py).
         for h in list(live):
-            path = os.path.join(self.root, live[h].fname)
-            if not os.path.exists(path):
+            e = live[h]
+            path = os.path.join(self.root, e.fname)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
                 live.pop(h)
+                continue
+            if e.nbytes and size < e.nbytes:
+                live.pop(h)
+                self.reaped_corrupt_blocks += 1
+                logger.warning(
+                    "disk KV block %x payload truncated (%d < %d bytes) "
+                    "— reaped", h & 0xFFFFFFFFFFFFFFFF, size, e.nbytes)
         self._entries = live
         self.restored_blocks = len(live)
         self.bytes_used = sum(e.nbytes for e in live.values())
@@ -380,6 +404,17 @@ class DiskKvStore:
                 self._entries.move_to_end(h)   # requeue pinned candidate
                 scanned += 1
                 continue
+            if self.on_evict is not None:
+                # read the bytes BEFORE the unlink and hand them to the
+                # remote (G4) promotion feed; best-effort — a failed
+                # read just forfeits the promotion, never the eviction
+                e = self._entries[h]
+                try:
+                    with np.load(os.path.join(self.root, e.fname)) as z:
+                        values = _unpack_block(z)
+                    self.on_evict(h, e.tokens_hash, e.parent_hash, values)
+                except Exception:  # noqa: BLE001
+                    logger.exception("disk-tier evict hook failed")
             evicted.append(h)
             self._delete_locked(h)
         return evicted

@@ -481,6 +481,14 @@ class PrefillPlan:
     # matched entries are PINNED against spill-pump eviction until the
     # admission completes (match_prefix(pin=True)).
     disk_hashes: List[int] = dataclasses.field(default_factory=list)
+    # remote (G4) fabric hits: chained hashes reachable through the
+    # RemoteKvStore (a peer worker's disk over the kv_fabric RPC plane,
+    # or the shared object store) — the tail of the onboard run, after
+    # the disk hits. Admission-gated at match time (remotestore.py:
+    # modeled fetch must beat modeled recompute) and fetched on the same
+    # off-thread onboard path; a fetch failure clears this list and the
+    # engine gracefully recomputes the tail (never an error).
+    remote_hashes: List[int] = dataclasses.field(default_factory=list)
 
     @property
     def all_blocks(self) -> List[int]:
@@ -494,16 +502,21 @@ class PrefillPlan:
     def disk_hit_tokens(self) -> int:
         return len(self.disk_hashes) * self.seq.block_size
 
+    @property
+    def remote_hit_tokens(self) -> int:
+        return len(self.remote_hashes) * self.seq.block_size
+
 
 class KvBlockManager:
     """Pool + hashing glue the engine admit path calls. Optionally backed by
-    a host (TPU-VM DRAM) tier and a persistent disk (G3) tier: device
-    misses cascade host → disk (reference `prepare_prefill_offload`
-    extended one rung down the Device→Pinned→Disk ladder)."""
+    a host (TPU-VM DRAM) tier, a persistent disk (G3) tier, and a remote
+    (G4) fleet-fabric tier: device misses cascade host → disk → remote
+    (reference `prepare_prefill_offload` extended down the
+    Device→Pinned→Disk→Remote ladder)."""
 
     def __init__(self, num_blocks: int, block_size: int,
                  on_stored=None, on_removed=None, enable_reuse: bool = True,
-                 host_pool=None, disk_store=None,
+                 host_pool=None, disk_store=None, remote_store=None,
                  prefer_native: bool = True):
         self.block_size = block_size
         self.pool = make_kv_block_pool(num_blocks, on_stored=on_stored,
@@ -512,6 +525,7 @@ class KvBlockManager:
         self.enable_reuse = enable_reuse
         self.host_pool = host_pool
         self.disk_store = disk_store
+        self.remote_store = remote_store
 
     def prepare_prefill(self, prompt: Sequence[int], extra_blocks: int = 1,
                         seq: Optional[TokenBlockSequence] = None
@@ -544,6 +558,17 @@ class KvBlockManager:
             # off-thread read completes (core unpins)
             disk_hashes = self.disk_store.match_prefix(
                 matchable[len(hit_blocks) + len(host_slots):], pin=True)
+        remote_hashes: List[int] = []
+        if self.enable_reuse and self.remote_store is not None:
+            # G4 cascade: the run past the disk hits, reachable through
+            # the fleet fabric (peer disk over RPC, or the shared object
+            # store). The store's match is admission-gated — it reports
+            # a miss when the modeled fetch loses to recompute — and
+            # pin=True holds object-held entries against the capacity
+            # reaper until the admission's off-thread read completes.
+            remote_hashes = self.remote_store.match_prefix(
+                matchable[len(hit_blocks) + len(host_slots)
+                          + len(disk_hashes):], pin=True)
         total_needed = (len(prompt) + extra_blocks * self.block_size
                         + self.block_size - 1) // self.block_size
         n_new = total_needed - len(hit_blocks)
@@ -552,34 +577,43 @@ class KvBlockManager:
             self.pool.release(hit_blocks)
             if disk_hashes:
                 self.disk_store.unpin(disk_hashes)
+            if remote_hashes:
+                self.remote_store.unpin(remote_hashes)
             return None
-        if len(new_blocks) < len(host_slots) + len(disk_hashes):
-            # the onboard path scatters host/disk hits into
+        if len(new_blocks) < (len(host_slots) + len(disk_hashes)
+                              + len(remote_hashes)):
+            # the onboard path scatters host/disk/remote hits into
             # new_blocks[:n_onboard] — a plan where the allocation can't
-            # cover the pinned disk hits would silently DROP tier hits
-            # (or scatter past the allocation). The cascade math above
+            # cover the pinned tier hits would silently DROP them (or
+            # scatter past the allocation). The cascade math above
             # guarantees this never happens; if a tier's match_prefix
             # over-returns (a buggy store), fail loudly instead of
             # serving garbage. Release every hold first so the loud
-            # failure doesn't also leak pool refcounts / disk pins.
+            # failure doesn't also leak pool refcounts / tier pins.
             self.pool.release(hit_blocks + new_blocks)
             if disk_hashes:
                 self.disk_store.unpin(disk_hashes)
+            if remote_hashes:
+                self.remote_store.unpin(remote_hashes)
             raise RuntimeError(
                 f"prepare_prefill invariant violated: {len(new_blocks)} "
                 f"new blocks cannot cover {len(host_slots)} host + "
-                f"{len(disk_hashes)} disk tier hits (prompt "
-                f"{len(prompt)}, device hits {len(hit_blocks)})")
+                f"{len(disk_hashes)} disk + {len(remote_hashes)} remote "
+                f"tier hits (prompt {len(prompt)}, device hits "
+                f"{len(hit_blocks)})")
         return PrefillPlan(hit_blocks=hit_blocks, new_blocks=new_blocks,
                            hit_tokens=hit_tokens, seq=seq,
-                           host_slots=host_slots, disk_hashes=disk_hashes)
+                           host_slots=host_slots, disk_hashes=disk_hashes,
+                           remote_hashes=remote_hashes)
 
     def abort_plan(self, plan: "PrefillPlan") -> None:
         """Release a plan that will never admit: device block holds drop
-        and the disk-tier pins (taken at match) release."""
+        and the disk/remote-tier pins (taken at match) release."""
         self.pool.release(plan.all_blocks)
         if plan.disk_hashes and self.disk_store is not None:
             self.disk_store.unpin(plan.disk_hashes)
+        if plan.remote_hashes and self.remote_store is not None:
+            self.remote_store.unpin(plan.remote_hashes)
 
     def register_full_blocks(self, plan_blocks: List[int],
                              seq: TokenBlockSequence,

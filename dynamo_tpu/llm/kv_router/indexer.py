@@ -35,7 +35,8 @@ class OverlapScores:
 
     def __init__(self, scores: Optional[Dict[int, int]] = None,
                  frequencies: Optional[List[int]] = None,
-                 weighted: Optional[Dict[int, float]] = None):
+                 weighted: Optional[Dict[int, float]] = None,
+                 remote_blocks: Optional[Dict[int, int]] = None):
         self.scores: Dict[int, int] = scores or {}
         self.frequencies: List[int] = frequencies or []
         # tier-discounted effective overlap per worker (scoring.py
@@ -45,6 +46,18 @@ class OverlapScores:
         # not against an HBM-resident copy elsewhere.
         self.weighted: Dict[int, float] = (
             dict(weighted) if weighted is not None else dict(self.scores))
+        # worker → how many of its matched blocks carry tier "remote"
+        # (a fabric fetch away, not local). The scheduler's NetKV
+        # scoring keeps their credit only when that worker's modeled
+        # transfer beats its modeled recompute (scoring.py
+        # network_adjusted_overlap).
+        self.remote_blocks: Dict[int, int] = dict(remote_blocks or {})
+
+    @property
+    def fleet_depth(self) -> int:
+        """Deepest overlap any worker holds — the fabric makes those
+        blocks fetchable by every attached candidate."""
+        return max(self.scores.values(), default=0)
 
     def best(self) -> Optional[int]:
         if not self.scores:
@@ -356,10 +369,15 @@ class KvIndexer:
             from .scoring import TIER_WEIGHTS
             for w, depth in scores.scores.items():
                 eff = 0.0
+                remote = 0
                 for i in range(depth):
                     tier = self._tiers.get((w, block_hashes[i]), "device")
                     eff += TIER_WEIGHTS.get(tier, 1.0)
+                    if tier == "remote":
+                        remote += 1
                 scores.weighted[w] = eff
+                if remote:
+                    scores.remote_blocks[w] = remote
         return scores
 
     def find_matches_for_request(self, token_ids: Sequence[int]
@@ -393,11 +411,13 @@ class KvIndexerSharded:
         hashes = compute_block_hashes(token_ids, self.block_size)
         merged: Dict[int, int] = {}
         weighted: Dict[int, float] = {}
+        remote: Dict[int, int] = {}
         freqs: List[int] = []
         for sh in self.shards:
             r = sh.find_matches(hashes)
             merged.update(r.scores)
             weighted.update(r.weighted)
+            remote.update(r.remote_blocks)
             # each shard tracks its own subtree's uses; take the
             # elementwise max as the merged hotness view
             for i, f in enumerate(r.frequencies):
@@ -405,4 +425,4 @@ class KvIndexerSharded:
                     freqs[i] = max(freqs[i], f)
                 else:
                     freqs.append(f)
-        return OverlapScores(merged, freqs, weighted)
+        return OverlapScores(merged, freqs, weighted, remote)

@@ -49,6 +49,28 @@ class ForwardPassMetrics:
     disk_bytes_used: int = 0
     disk_spill_dropped_total: int = 0
     offload_dropped_jobs_total: int = 0
+    # remote (G4) fleet KV fabric (llm/kv/remotestore.py + fabric.py) —
+    # the nv_llm_kv_remote_* gauge feeds, plus the MEASURED link/cost
+    # model the router's NetKV scoring prices candidates with
+    # (kv_router/scoring.py network_adjusted_overlap). remote_link_gbps
+    # and remote_link_rtt_s are the fabric's decay-averaged peer-link
+    # estimates (probe at attach, refined per transfer);
+    # kv_bytes_per_block and prefill_tok_per_s complete the
+    # transfer-vs-recompute model. Zeros on old payloads / no fabric.
+    remote_used_blocks: int = 0
+    remote_capacity_blocks: int = 0
+    remote_peer_blocks: int = 0
+    remote_stored_total: int = 0
+    remote_hit_rate: float = 0.0
+    remote_fetch_failures_total: int = 0
+    remote_admission_rejects_total: int = 0
+    remote_link_gbps: float = 0.0
+    remote_link_rtt_s: float = 0.0
+    kv_bytes_per_block: int = 0
+    prefill_tok_per_s: float = 0.0
+    # runtime/netstore.py client retry counter (bounded jittered retry;
+    # a rising rate means the discovery daemon link is flapping)
+    netstore_retries_total: int = 0
     # contiguity-aware KV layout (llm/kv/pool.py run-tracking allocator
     # + engine/attention.py run-coalesced DMA; docs/kv_layout.md) — the
     # nv_llm_kv_frag_ratio / _contig_runs / _defrag_moves_total /
@@ -88,12 +110,15 @@ class KvStoredEvent:
     tokens_hashes: List[int] = dataclasses.field(default_factory=list)
     lora_id: int = 0
     # which rung of the ladder holds the blocks: "device" (HBM, the
-    # historical default — absent in old payloads), "host" (TPU-VM DRAM)
-    # or "disk" (the persistent G3 store). The router's radix index
+    # historical default — absent in old payloads), "host" (TPU-VM
+    # DRAM), "disk" (the persistent G3 store) or "remote" (the G4 fleet
+    # fabric — a fetch over a real link away). The router's radix index
     # keeps tier per (worker, hash) and the scheduler discounts colder
     # tiers' overlap depth (kv_router/scoring.py TIER_WEIGHTS) — a
     # disk-resident prefix is worth routing to, but less than an
-    # HBM-resident one.
+    # HBM-resident one, and a remote-resident one counts only while the
+    # announcing worker's modeled transfer beats its modeled recompute
+    # (NetKV network-aware scoring).
     tier: str = "device"
 
 

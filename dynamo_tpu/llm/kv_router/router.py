@@ -56,11 +56,12 @@ class KvRouter:
         drain is cancelled), the scheduler just won't pick them."""
         overlap = self.indexer.find_matches_for_request(token_ids)
         self.last_frequencies = overlap.frequencies
-        # tier-discounted depth (scoring.py TIER_WEIGHTS): equals the raw
-        # block depth when every matched block is device-resident, less
-        # when the match lives in the host/disk tiers (a promote costs
-        # more than HBM reuse, but far less than recompute)
-        worker = self.scheduler.schedule(len(token_ids), overlap.weighted,
+        # the scheduler gets the FULL OverlapScores: tier-discounted
+        # depth (scoring.py TIER_WEIGHTS) plus the NetKV network
+        # adjustment — remote-tier credit gated on each candidate's
+        # modeled transfer beating its modeled recompute, and
+        # fabric-fetchable credit for blocks other workers hold
+        worker = self.scheduler.schedule(len(token_ids), overlap,
                                          exclude=exclude)
         if worker is None:
             return None

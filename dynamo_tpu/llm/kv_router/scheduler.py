@@ -45,12 +45,42 @@ class KvScheduler:
         self._opt_blocks.clear()
         self._opt_slots.clear()
 
-    def schedule(self, isl_tokens: int, overlap_scores: dict,
+    def _effective_overlap(self, ep, overlap, fleet_depth: int) -> float:
+        """One candidate's overlap credit. With a full OverlapScores in
+        hand the credit is NETWORK-AWARE (NetKV): tier-discounted depth,
+        with remote-tier blocks kept only when the candidate's modeled
+        transfer beats its modeled recompute, plus fabric-fetchable
+        credit for blocks other workers hold (scoring.py
+        network_adjusted_overlap). A plain dict scores as before."""
+        from .indexer import OverlapScores
+        if not isinstance(overlap, OverlapScores):
+            return overlap.get(ep.worker_id, 0)
+        from .scoring import network_adjusted_overlap
+        wid = ep.worker_id
+        return network_adjusted_overlap(
+            weighted=overlap.weighted.get(wid, 0.0),
+            own_depth=overlap.scores.get(wid, 0),
+            remote_depth=overlap.remote_blocks.get(wid, 0),
+            fleet_depth=fleet_depth,
+            block_size=self.block_size,
+            m=ep.metrics)
+
+    @staticmethod
+    def _raw_overlap(overlap, worker_id: int):
+        from .indexer import OverlapScores
+        if isinstance(overlap, OverlapScores):
+            return overlap.scores.get(worker_id, 0)
+        return overlap.get(worker_id, 0)
+
+    def schedule(self, isl_tokens: int, overlap_scores,
                  exclude: Optional[set] = None) -> Optional[int]:
         """Returns the chosen worker id, or None when no worker is usable.
-        ``exclude``: worker ids barred from NEW admissions (the planner's
-        draining set) — skipped like full workers, so a drain shifts load
-        instead of dropping requests."""
+        ``overlap_scores``: an indexer OverlapScores (network-aware
+        scoring) or a plain {worker_id: effective_overlap} dict (legacy
+        callers). ``exclude``: worker ids barred from NEW admissions
+        (the planner's draining set) — skipped like full workers, so a
+        drain shifts load instead of dropping requests."""
+        from .indexer import OverlapScores
         eps = self.endpoints
         if not len(eps):
             return None
@@ -60,6 +90,8 @@ class KvScheduler:
         load_std = eps.load_std
         balance_mode = load_std > 0.1 * load_avg
         alpha = 0.7 if balance_mode else 0.3
+        fleet_depth = (overlap_scores.fleet_depth
+                       if isinstance(overlap_scores, OverlapScores) else 0)
 
         best_cost = None
         best_worker = None
@@ -73,8 +105,9 @@ class KvScheduler:
                           + self._opt_slots.get(ep.worker_id, 0))
             if m.request_total_slots and slots_used >= m.request_total_slots:
                 continue  # full worker
-            overlap_blocks = min(overlap_scores.get(ep.worker_id, 0),
-                                 isl_blocks)
+            overlap_blocks = min(
+                self._effective_overlap(ep, overlap_scores, fleet_depth),
+                isl_blocks)
             new_blocks = isl_blocks - overlap_blocks
             normalized_new = new_blocks / isl_blocks
             load = ep.load + self._opt_blocks.get(ep.worker_id, 0)
@@ -90,7 +123,11 @@ class KvScheduler:
                 best_worker = ep
         if best_worker is None:
             return None
-        overlap_blocks = min(overlap_scores.get(best_worker.worker_id, 0),
+        # optimistic accounting + routing hints use the RAW local depth:
+        # the chosen worker's prefill skips exactly the blocks it itself
+        # holds (a fabric fetch still allocates device blocks for them)
+        overlap_blocks = min(self._raw_overlap(overlap_scores,
+                                               best_worker.worker_id),
                              isl_blocks)
         # optimistic accounting until the next metrics scrape
         self._opt_blocks[best_worker.worker_id] = (

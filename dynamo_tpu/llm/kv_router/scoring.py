@@ -1,8 +1,12 @@
 """Endpoint load scoring (reference lib/llm/src/kv_router/scoring.rs:24-55:
 `ProcessedEndpoints` — load average/stddev over kv_active_blocks) plus the
-KV-tier overlap weights: a matched prefix block is worth less the colder
-the tier that holds it, because serving it costs a promote (host h2d
-scatter, or a disk read + h2d scatter) instead of a free HBM reuse."""
+KV-tier overlap weights and the NetKV-style transfer model: a matched
+prefix block is worth less the colder the tier that holds it, because
+serving it costs a promote (host h2d scatter, a disk read + scatter, or a
+fabric fetch over a real network link) instead of a free HBM reuse — and a
+remote block is worth NOTHING when the modeled transfer loses to simply
+recomputing it (NetKV, arXiv:2606.03910: score decode instances by
+measured transfer cost, not overlap depth alone)."""
 
 from __future__ import annotations
 
@@ -13,11 +17,37 @@ from typing import Dict, List, Sequence
 from .protocols import ForwardPassMetrics
 
 # Per-tier overlap discount (the indexer tags each (worker, hash) with
-# the announcing event's tier; KvIndexer.tier_weighted applies these).
+# the announcing event's tier; KvIndexer.find_matches applies these).
 # device = free HBM reuse; host = one DRAM→HBM scatter (~the +40% TTFT
 # win's cost side); disk = a file read + scatter — still far cheaper
-# than recomputing the prefix, hence > 0.
-TIER_WEIGHTS: Dict[str, float] = {"device": 1.0, "host": 0.8, "disk": 0.5}
+# than recomputing the prefix, hence > 0; remote = a fabric fetch (peer
+# RPC or object-store read) + scatter — the coldest rung that still
+# beats recompute WHEN the link pays (the scheduler additionally gates
+# remote credit on the transfer model below).
+#
+# Runtime-configurable: `llmctl kv set-weights` writes the kvtier/weights
+# key and every watching worker/router applies it live via
+# set_tier_weights() — the dict is mutated IN PLACE so module importers
+# see the change without re-importing.
+TIER_WEIGHTS: Dict[str, float] = {"device": 1.0, "host": 0.8, "disk": 0.5,
+                                  "remote": 0.25}
+_DEFAULT_TIER_WEIGHTS: Dict[str, float] = dict(TIER_WEIGHTS)
+
+
+def set_tier_weights(weights: Dict[str, float]) -> Dict[str, float]:
+    """Apply a (partial) weight override live (llmctl kv set-weights →
+    kvtier/weights/{ns} → admin.watch_weights_loop). Unknown tiers are
+    ignored; values clamp to [0, 1] (an overlap block can never be worth
+    more than a device-resident one). Returns the effective table."""
+    for k, v in weights.items():
+        if k in TIER_WEIGHTS and v is not None:
+            TIER_WEIGHTS[k] = min(max(float(v), 0.0), 1.0)
+    return dict(TIER_WEIGHTS)
+
+
+def reset_tier_weights() -> None:
+    """Restore the defaults (test isolation)."""
+    TIER_WEIGHTS.update(_DEFAULT_TIER_WEIGHTS)
 
 
 def tier_weighted_depth(depth: int, tiers: Sequence[str]) -> float:
@@ -29,6 +59,78 @@ def tier_weighted_depth(depth: int, tiers: Sequence[str]) -> float:
         tier = tiers[i] if i < len(tiers) else "device"
         total += TIER_WEIGHTS.get(tier, 1.0)
     return total
+
+
+# ---------------------------------------------------------------------------
+# NetKV transfer model: would moving the blocks beat recomputing them?
+# The inputs ride ForwardPassMetrics — each worker publishes its measured
+# fabric link (remote_link_gbps / remote_link_rtt_s, decay-averaged by
+# llm/kv/fabric.PeerLinkTable), its KV wire density (kv_bytes_per_block)
+# and its measured prefill rate (prefill_tok_per_s) — so the ROUTER
+# prices a candidate's fetch with the candidate's own numbers.
+# ---------------------------------------------------------------------------
+
+
+def modeled_transfer_s(n_blocks: int, bytes_per_block: int, gbps: float,
+                       rtt_s: float) -> float:
+    """Modeled wall time to move ``n_blocks`` of KV over a link."""
+    if gbps <= 0:
+        return float("inf")
+    return rtt_s + n_blocks * bytes_per_block / (gbps * 1e9)
+
+
+def modeled_recompute_s(n_blocks: int, block_size: int,
+                        prefill_tok_per_s: float) -> float:
+    """Modeled wall time to re-prefill ``n_blocks`` worth of tokens.
+    inf when the rate is unknown (no prefill measured yet) — transfer
+    then wins by default, matching the fabric's optimistic admission."""
+    if prefill_tok_per_s <= 0:
+        return float("inf")
+    return n_blocks * block_size / prefill_tok_per_s
+
+
+def transfer_pays(n_blocks: int, block_size: int,
+                  m: "ForwardPassMetrics") -> bool:
+    """Does fetching ``n_blocks`` to the worker described by ``m`` beat
+    recomputing them there? False when the worker has no fabric link."""
+    if n_blocks <= 0 or m.remote_link_gbps <= 0 or m.kv_bytes_per_block <= 0:
+        return False
+    t = modeled_transfer_s(n_blocks, m.kv_bytes_per_block,
+                           m.remote_link_gbps, m.remote_link_rtt_s)
+    r = modeled_recompute_s(n_blocks, block_size, m.prefill_tok_per_s)
+    return t < r
+
+
+def network_adjusted_overlap(weighted: float, own_depth: int,
+                             remote_depth: int, fleet_depth: int,
+                             block_size: int,
+                             m: "ForwardPassMetrics") -> float:
+    """NetKV scoring for ONE candidate: tier-discounted overlap minus
+    modeled transfer cost, in block units.
+
+    - ``remote_depth`` matched blocks sit in the candidate's REMOTE tier
+      (a fabric fetch away). Their TIER_WEIGHTS["remote"] credit stands
+      only when the candidate's modeled transfer beats its modeled
+      recompute — the router prefers the holder only when the fetch
+      pays; otherwise those blocks are priced exactly like a miss.
+    - ``fleet_depth - own_depth`` blocks exist elsewhere in the fleet;
+      a fabric-attached candidate can fetch them, so they earn remote
+      credit scaled by the modeled saving fraction (1 - transfer /
+      recompute): a near-free link earns almost full remote weight, a
+      barely-winning link earns almost nothing.
+    """
+    w_remote = TIER_WEIGHTS.get("remote", 0.0)
+    eff = weighted
+    if remote_depth > 0 and not transfer_pays(remote_depth, block_size, m):
+        eff -= remote_depth * w_remote
+    extra = fleet_depth - own_depth
+    if extra > 0 and transfer_pays(extra, block_size, m):
+        t = modeled_transfer_s(extra, m.kv_bytes_per_block,
+                               m.remote_link_gbps, m.remote_link_rtt_s)
+        r = modeled_recompute_s(extra, block_size, m.prefill_tok_per_s)
+        saving = 1.0 if math.isinf(r) else max(1.0 - t / r, 0.0)
+        eff += extra * w_remote * saving
+    return max(eff, 0.0)
 
 
 @dataclasses.dataclass

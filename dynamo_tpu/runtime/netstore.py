@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import logging
+import random
 from typing import Dict, List, Optional
 
 from .bus import BusMessage, MessageBus, Subscription, WorkItem, WorkQueue
@@ -21,6 +22,21 @@ from .kvstore import (KvEntry, KvStore, Lease, PrefixWatcher, WatchEvent,
 from .server import recv_msg, send_msg
 
 logger = logging.getLogger("dynamo_tpu.runtime.netstore")
+
+# process-wide retry counter across every daemon connection — the
+# nv_llm_netstore_retries_total feed (a rising rate means the discovery
+# daemon link is flapping; each worker's stats handler exports it via
+# ForwardPassMetrics.netstore_retries_total)
+_retries_total = 0
+
+
+def retries_total() -> int:
+    return _retries_total
+
+
+def _count_retry() -> None:
+    global _retries_total
+    _retries_total += 1
 
 
 def _b64(b: bytes) -> str:
@@ -48,9 +64,17 @@ class _Conn:
     """
 
     RETRY_WINDOW = 30.0
+    # bounded retry for one call(): whichever of the attempt budget and
+    # the time window runs out first ends the retry loop — a partitioned
+    # daemon fails callers in bounded time instead of spinning
+    MAX_CALL_RETRIES = 8
+    # jitter factor range on every backoff sleep: N reconnecting clients
+    # of a restarted daemon must not stampede it in lockstep
+    RETRY_JITTER = (0.5, 1.5)
 
     def __init__(self, addr: str):
         self.addr = addr
+        self.retries_total = 0
         self.reader: Optional[asyncio.StreamReader] = None
         self.writer: Optional[asyncio.StreamWriter] = None
         self._next_rid = 1
@@ -171,7 +195,10 @@ class _Conn:
                     if self.closed or loop.time() + delay > deadline:
                         raise ConnectionError(
                             f"daemon unreachable at {self.addr}")
-                    await asyncio.sleep(delay)
+                    # jittered like call(): a fleet reconnecting to a
+                    # restarted daemon must not arrive in lockstep
+                    await asyncio.sleep(delay * random.uniform(
+                        *self.RETRY_JITTER))
                     delay = min(delay * 2, 1.0)
             self.reconnects += 1
             logger.info("reconnected to daemon %s (attempt %d); replaying "
@@ -206,17 +233,30 @@ class _Conn:
         return reply
 
     async def call(self, op: str, **kwargs) -> dict:
+        """One logical request with bounded, jittered retry: a transient
+        daemon hiccup (restart, dropped socket) retries up to
+        MAX_CALL_RETRIES times inside RETRY_WINDOW with exponential
+        backoff × uniform jitter, counting each retry
+        (``retries_total`` per connection + the module counter feeding
+        nv_llm_netstore_retries_total) — instead of surfacing the first
+        flap as a hard error to the caller."""
         loop = asyncio.get_running_loop()
         deadline = loop.time() + self.RETRY_WINDOW
         delay = 0.05
+        attempts = 0
         while True:
             try:
                 await self._ensure_connected()
                 return await self._call_once(op, **kwargs)
             except ConnectionError:
-                if self.closed or loop.time() >= deadline:
+                attempts += 1
+                if (self.closed or loop.time() >= deadline
+                        or attempts >= self.MAX_CALL_RETRIES):
                     raise
-                await asyncio.sleep(delay)
+                self.retries_total += 1
+                _count_retry()
+                await asyncio.sleep(delay * random.uniform(
+                    *self.RETRY_JITTER))
                 delay = min(delay * 2, 1.0)
 
     async def close(self) -> None:

@@ -93,6 +93,37 @@ def test_bench_kv_disk_mode(tmp_path):
     assert kd["cold_ttft_ms"] > 0 and kd["warm_ttft_ms"] > 0
 
 
+@pytest.mark.kvfabric
+def test_bench_kv_remote_mode():
+    """--kv-remote rides a bench run (ISSUE 6 satellite): the result
+    line must carry the `kv_remote` provenance dict — cold-prefill vs
+    remote-fetch TTFT over a REAL loopback kv_fabric RPC, bit-exact,
+    with the admission model's predicted fetch/recompute/crossover
+    reported next to the measured link."""
+    import pytest as _pytest
+    if os.environ.get("CI_SKIP_SLOW"):
+        _pytest.skip("slow smoke")
+    r = _run(
+        [sys.executable, "bench.py", "--kv-remote"],
+        {"BENCH_FORCE_CPU": "1", "BENCH_MODEL": "tiny", "BENCH_BATCH": "2",
+         "BENCH_STEPS": "4", "BENCH_PROMPT": "8", "BENCH_HARVEST": "2",
+         "BENCH_QUANT": "none", "BENCH_DEVICE": "0",
+         "BENCH_KV_REMOTE_PROMPT": "32"})
+    assert r.returncode == 0, f"bench.py crashed:\n{r.stderr[-4000:]}"
+    out = json.loads([l for l in r.stdout.strip().splitlines()
+                      if l.startswith("{")][-1])
+    assert "error" not in out, f"bench fell back instead of running: {out}"
+    kr = out.get("kv_remote")
+    assert kr, f"no kv_remote provenance in the result: {out}"
+    assert kr["remote_hit_tokens"] >= 16        # prefix came over the wire
+    assert kr["fetched_blocks"] >= 1 and kr["peer_fetches"] >= 1
+    assert kr["tokens_bit_exact"] is True
+    assert kr["cold_ttft_ms"] > 0 and kr["remote_ttft_ms"] > 0
+    assert kr["measured_link_gbps"] > 0
+    assert kr["admission_auto_verdict"] in ("admit", "reject")
+    assert kr["predicted_fetch_ms"] > 0
+
+
 @pytest.mark.kvfrag
 def test_bench_kv_frag_mode():
     """--kv-frag rides a bench run (ISSUE 5 satellite): the result line

@@ -222,6 +222,7 @@ def test_grafana_dashboard_queries_real_metrics():
                                        r"rate)", e))
     from dynamo_tpu.components.metrics import (_GAUGE_FIELDS,
                                                _LAYOUT_GAUGES, _PP_GAUGES,
+                                               _REMOTE_GAUGES,
                                                _SPEC_GAUGES, _TIER_GAUGES,
                                                PREFIX)
     from dynamo_tpu.llm.http.metrics import PREFIX as HTTP_PREFIX
@@ -230,6 +231,7 @@ def test_grafana_dashboard_queries_real_metrics():
     exported |= set(_TIER_GAUGES.values())
     exported |= set(_PP_GAUGES.values())
     exported |= set(_LAYOUT_GAUGES.values())
+    exported |= set(_REMOTE_GAUGES.values())
     exported |= {f"{PREFIX}_hit_rate_isl_blocks_total",
                  f"{PREFIX}_hit_rate_overlap_blocks_total",
                  f"{HTTP_PREFIX}_requests_total",

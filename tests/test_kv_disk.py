@@ -181,6 +181,37 @@ def test_diskstore_torn_manifest_and_orphans(tmp_path):
     np.testing.assert_allclose(store2.fetch([2])["k"][:, :, 0], 2.0)
 
 
+def test_diskstore_recovery_reaps_truncated_payload(tmp_path):
+    """ISSUE 6 satellite (kill-during-put regression alongside the torn
+    manifest case): recovery must skip manifest entries whose npz
+    payload is missing or TRUNCATED — a short file can't serve reads and
+    must be reaped + counted, never surfaced. Our own writes are atomic
+    (tmp → fsync → rename), so truncation models external damage (fs
+    corruption, a cache dir copied mid-write)."""
+    d = str(tmp_path / "kv")
+    store = DiskKvStore(d, capacity_blocks=8)
+    store.put(1, _blk(1.0), tokens_hash=11)
+    store.put(2, _blk(2.0), tokens_hash=22)
+    store.put(3, _blk(3.0), tokens_hash=33)
+    fname2 = next(e.fname for e in store._entries.values()
+                  if e.seq_hash == 2)
+    store.close()
+    # block 2's payload is cut short; block 3's vanishes entirely
+    with open(os.path.join(d, fname2), "r+b") as f:
+        f.truncate(16)
+    os.unlink(os.path.join(d, fname2.replace(
+        fname2, next(e.fname for e in store._entries.values()
+                     if e.seq_hash == 3))))
+    store2 = DiskKvStore(d, capacity_blocks=8)
+    assert [h for h, _t, _p in store2.registered_entries()] == [1]
+    assert store2.reaped_corrupt_blocks == 1       # truncated (3 = missing)
+    np.testing.assert_allclose(store2.fetch([1])["k"][:, :, 0], 1.0)
+    # the truncated file is gone (orphan sweep) and a re-put re-admits
+    assert not os.path.exists(os.path.join(d, fname2))
+    assert store2.put(2, _blk(2.0)) == []
+    np.testing.assert_allclose(store2.fetch([2])["k"][:, :, 0], 2.0)
+
+
 def test_diskstore_roundtrips_bfloat16_and_int8(tmp_path):
     """Production pools are bfloat16 (and int8 opaque rows) — np.savez
     alone round-trips ml_dtypes arrays as anonymous void '|V2', which
