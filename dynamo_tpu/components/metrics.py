@@ -95,6 +95,19 @@ _TIER_GAUGES = {
     "disk_spill_dropped_total": "nv_llm_kv_disk_spill_dropped_jobs_total",
 }
 
+# fleet tracing + engine flight recorder (runtime/tracing.py sampling
+# counter + engine/flight_recorder.py loop-lag probe): dropped log
+# lines rise by design when sampling is on; loop lag rising means the
+# ENGINE loop is being blocked (sync I/O, long host glue) — the most
+# actionable single gauge on a slow worker. The latency HISTOGRAMS
+# (TTFT/ITL/queue-wait with trace_id exemplars) live on the trace
+# collector, not here — they are fed per trace, not per scrape.
+_TRACE_GAUGES = {
+    "trace_dropped_log_lines_total": "nv_llm_trace_dropped_log_lines_total",
+    "loop_lag_ms": "nv_llm_engine_loop_lag_ms",
+    "loop_lag_max_ms": "nv_llm_engine_loop_lag_max_ms",
+}
+
 # remote (G4) fleet KV fabric (llm/kv/remotestore.py + fabric.py):
 # ForwardPassMetrics field → exported metric name. The Grafana "KV
 # fabric" row plots tier occupancy and hit rate next to the MEASURED
@@ -127,10 +140,19 @@ class MetricsAggregatorService:
     """
 
     def __init__(self, endpoint: Endpoint, scrape_interval: float = 1.0,
-                 registry: Optional[CollectorRegistry] = None):
+                 registry: Optional[CollectorRegistry] = None,
+                 collector=None):
         self.endpoint = endpoint
         self.scrape_interval = scrape_interval
         self.registry = registry or CollectorRegistry()
+        # fleet trace collector (components/trace_collector.py): fed by
+        # the trace_events subscription, serves /traces/{id} (stitched
+        # tree + Perfetto export) and owns the TTFT/ITL/queue-wait
+        # histograms whose buckets carry trace_id exemplars
+        if collector is None:
+            from .trace_collector import TraceCollector
+            collector = TraceCollector(registry=self.registry)
+        self.collector = collector
         labels = ["component", "endpoint", "worker_id"]
         self._gauges: Dict[str, Gauge] = {
             f: Gauge(f"{PREFIX}_{f}", f"worker {f} (scraped stats)",
@@ -156,6 +178,10 @@ class MetricsAggregatorService:
             f: Gauge(name, f"KV fabric (remote tier): worker {f} "
                      "(scraped stats)", labels, registry=self.registry)
             for f, name in _REMOTE_GAUGES.items()}
+        self._trace_gauges: Dict[str, Gauge] = {
+            f: Gauge(name, f"fleet tracing: worker {f} (scraped stats)",
+                     labels, registry=self.registry)
+            for f, name in _TRACE_GAUGES.items()}
         self.hit_isl_blocks = Counter(
             f"{PREFIX}_hit_rate_isl_blocks_total",
             "Routing decisions: total request blocks (ISL)",
@@ -167,6 +193,7 @@ class MetricsAggregatorService:
         self._seen_workers: Set[int] = set()
         self._client = None
         self._sub = None
+        self._trace_sub = None
         self._tasks: list = []
         self.events_received = 0
         self.pushes = 0
@@ -191,21 +218,27 @@ class MetricsAggregatorService:
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> "MetricsAggregatorService":
+        from .trace_collector import TRACE_EVENTS_SUBJECT
         ep = self.endpoint
         self._client = ep.client()
         await self._client.start()
         self._sub = await ep.parent_component().subscribe_event(
             KV_HIT_RATE_SUBJECT)
+        self._trace_sub = await ep.parent_component().subscribe_event(
+            TRACE_EVENTS_SUBJECT)
         loop = asyncio.get_running_loop()
         self._tasks = [
             loop.create_task(self._scrape_loop(), name="metrics-scrape"),
             loop.create_task(self._hit_rate_loop(), name="metrics-hitrate"),
+            loop.create_task(self._trace_loop(), name="metrics-traces"),
         ]
         return self
 
     async def close(self) -> None:
         if self._sub is not None:
             self._sub.close()
+        if self._trace_sub is not None:
+            self._trace_sub.close()
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:
@@ -285,6 +318,8 @@ class MetricsAggregatorService:
                 g.labels(*lbl).set(getattr(m, f))
             for f, g in self._remote_gauges.items():
                 g.labels(*lbl).set(getattr(m, f))
+            for f, g in self._trace_gauges.items():
+                g.labels(*lbl).set(getattr(m, f))
         # drop series for workers whose leases died (the watcher pruned them)
         for gone in self._seen_workers - present:
             self.latest.pop(gone, None)
@@ -294,7 +329,8 @@ class MetricsAggregatorService:
                       + list(self._pp_gauges.values())
                       + list(self._tier_gauges.values())
                       + list(self._layout_gauges.values())
-                      + list(self._remote_gauges.values())):
+                      + list(self._remote_gauges.values())
+                      + list(self._trace_gauges.values())):
                 try:
                     g.remove(*lbl)
                 except KeyError:
@@ -313,9 +349,28 @@ class MetricsAggregatorService:
             except Exception:  # noqa: BLE001
                 logger.exception("bad hit-rate event dropped")
 
+    async def _trace_loop(self) -> None:
+        """Completed trace dicts published by workers/frontends
+        (trace_events subject) → the collector's tree store + latency
+        histograms (components/trace_collector.py)."""
+        async for msg in self._trace_sub:
+            try:
+                self.collector.feed(json.loads(msg.payload))
+            except Exception:  # noqa: BLE001
+                logger.exception("bad trace event dropped")
+
     # ----------------------------------------------------------------- serve
     def render(self) -> bytes:
         return generate_latest(self.registry)
+
+    def render_openmetrics(self) -> bytes:
+        """OpenMetrics exposition — the format that CARRIES exemplars
+        (classic Prometheus text silently drops them). Grafana's
+        exemplar-click-through needs this negotiated via the Accept
+        header, which serve_http honors."""
+        from prometheus_client.openmetrics.exposition import (
+            generate_latest as generate_openmetrics)
+        return generate_openmetrics(self.registry)
 
     async def serve_push(self, gateway: str,
                          job: str = "dynamo_tpu_metrics",
@@ -349,7 +404,14 @@ class MetricsAggregatorService:
         runner (caller owns cleanup)."""
         from aiohttp import web
 
-        async def metrics(_request):
+        async def metrics(request):
+            # OpenMetrics when asked for (the exemplar-carrying format
+            # Grafana's trace click-through scrapes); classic text else
+            if "application/openmetrics-text" in request.headers.get(
+                    "Accept", ""):
+                return web.Response(
+                    body=self.render_openmetrics(),
+                    content_type="application/openmetrics-text")
             return web.Response(body=self.render(),
                                 content_type="text/plain")
 
@@ -358,9 +420,26 @@ class MetricsAggregatorService:
             # (SLOs, last decision, per-actuator counters) as JSON
             return web.json_response(self.planner_status)
 
+        async def traces(_request):
+            return web.json_response(
+                {"traces": self.collector.summaries(),
+                 **self.collector.stats()})
+
+        async def trace_by_id(request):
+            key = request.match_info["trace_id"]
+            tid = self.collector.find(key)
+            if tid is None:
+                return web.json_response(
+                    {"error": f"unknown trace {key!r}"}, status=404)
+            if request.query.get("format") == "perfetto":
+                return web.json_response(self.collector.perfetto(tid))
+            return web.json_response(self.collector.tree(tid))
+
         app = web.Application()
         app.router.add_get("/metrics", metrics)
         app.router.add_get("/planner", planner)
+        app.router.add_get("/traces", traces)
+        app.router.add_get("/traces/{trace_id}", trace_by_id)
         runner = web.AppRunner(app)
         await runner.setup()
         site = web.TCPSite(runner, host, port)

@@ -97,7 +97,9 @@ class MockTokenWorker:
     def __init__(self, runtime: DistributedRuntime, endpoint_path: str,
                  block_size: int = 16,
                  metrics: Optional[ForwardPassMetrics] = None,
-                 spec_k: int = 0, spec_acceptance: float = 0.75):
+                 spec_k: int = 0, spec_acceptance: float = 0.75,
+                 publish_traces: bool = True,
+                 synthetic_trace_interval: float = 0.0):
         self.runtime = runtime
         self.endpoint = Endpoint.parse_path(runtime, endpoint_path)
         self.block_size = block_size
@@ -108,6 +110,18 @@ class MockTokenWorker:
         self.spec_acceptance = spec_acceptance
         self.engine: Optional[_EchoWithKvEvents] = None
         self.server = None
+        # fleet tracing fixture (components/trace_collector.py): served
+        # requests already produce REAL worker traces (ingress opens
+        # one per request); publish_traces ships them over the
+        # trace_events subject like a real worker would, and
+        # synthetic_trace_interval > 0 additionally fabricates plausible
+        # traces on a timer — collector + Grafana "Tracing" panels are
+        # testable with zero engines AND zero traffic
+        self.publish_traces = publish_traces
+        self.synthetic_trace_interval = synthetic_trace_interval
+        self._trace_pub = None
+        self._synth_task = None
+        self.synthetic_traces_emitted = 0
 
     @property
     def worker_id(self) -> int:
@@ -146,7 +160,43 @@ class MockTokenWorker:
             encode_resp=encode_annotated_json,
             stats_handler=self._stats,
             stats_interval=0.2)
+        if self.publish_traces:
+            from .trace_collector import wire_trace_publisher
+            self._trace_pub = wire_trace_publisher(component)
+        if self.synthetic_trace_interval > 0:
+            self._synth_task = asyncio.get_running_loop().create_task(
+                self._synthetic_trace_loop(), name="mock-synth-traces")
         return self
+
+    async def _synthetic_trace_loop(self) -> None:
+        """Fabricate plausible finished worker traces on a timer — they
+        flow through the REAL tracer (ring, sampling, publisher), so the
+        whole collector/histogram/Grafana path exercises without any
+        traffic at all."""
+        import random
+        import time as _time
+
+        from ..runtime.tracing import Trace, tracer
+        while True:
+            await asyncio.sleep(self.synthetic_trace_interval)
+            self.synthetic_traces_emitted += 1
+            t = Trace(f"synthetic-{self.worker_id:x}-"
+                      f"{self.synthetic_traces_emitted}", role="worker")
+            now = _time.monotonic()
+            queue_ms = random.uniform(0.1, 3.0)
+            ttft_ms = queue_ms + random.uniform(5.0, 60.0)
+            total_ms = ttft_ms + random.uniform(20.0, 400.0)
+            t.start = now - total_ms / 1e3
+            t.start_epoch = _time.time() - total_ms / 1e3
+            t.origin_ts = t.start_epoch
+            t.add_span("engine.queue_wait", t.start,
+                       t.start + queue_ms / 1e3)
+            t.add_span("engine.accept", t.start, t.start + 1e-3)
+            first = t.start + ttft_ms / 1e3
+            t.add_span("first_response", first, first)
+            t.add_span("respond", t.start + 2e-3, now,
+                       synthetic=True)
+            tracer.finish(t)
 
     def _stats(self) -> dict:
         """Base synthetic metrics overlaid with LIVE occupancy, so the
@@ -202,6 +252,14 @@ class MockTokenWorker:
         await self.server.set_draining(True)
 
     async def stop(self) -> None:
+        if self._synth_task is not None:
+            self._synth_task.cancel()
+            self._synth_task = None
+        if self._trace_pub is not None:
+            # detach from the process tracer (it is a singleton; a
+            # dangling hook would publish other fixtures' traces)
+            self._trace_pub.close()
+            self._trace_pub = None
         if self.server is not None:
             await self.server.stop()
 
@@ -215,13 +273,18 @@ async def amain(argv=None) -> None:
                    help="synthetic speculation: drafts per request "
                         "(exercises the nv_llm_spec_* metrics path)")
     p.add_argument("--spec-acceptance", type=float, default=0.75)
+    p.add_argument("--synthetic-trace-interval", type=float, default=0.0,
+                   help="emit a fabricated worker trace every N seconds "
+                        "(exercises the trace collector + Grafana "
+                        "'Tracing' row with zero traffic)")
     args = p.parse_args(argv)
     from ..runtime.log import setup_logging
     setup_logging()
     runtime = await DistributedRuntime.connect(args.runtime_server)
     worker = await MockTokenWorker(
         runtime, args.endpoint, block_size=args.kv_block_size,
-        spec_k=args.spec_k, spec_acceptance=args.spec_acceptance).start()
+        spec_k=args.spec_k, spec_acceptance=args.spec_acceptance,
+        synthetic_trace_interval=args.synthetic_trace_interval).start()
     logger.info("mock worker %x serving %s", worker.worker_id, args.endpoint)
     try:
         await asyncio.Event().wait()

@@ -42,8 +42,8 @@ import time
 from typing import Dict, List, Optional
 
 from ..llm.slo import (FleetSignals, ServiceLevelObjective, SloVerdict,
-                       control_key, evaluate,
-                       latency_percentiles_from_traces, slo_key, status_key)
+                       control_key, evaluate, latency_percentiles,
+                       slo_key, status_key)
 from ..runtime.distributed import DistributedRuntime, Endpoint
 from ..runtime.kvstore import WatchEventType
 
@@ -155,7 +155,7 @@ class Planner:
                  config: Optional[PlannerConfig] = None,
                  prefill_queue=None,
                  model_name: Optional[str] = None,
-                 traces=None):
+                 traces=None, collector=None):
         self.runtime = runtime
         self.endpoint = decode_endpoint
         self.actuator = actuator
@@ -164,10 +164,13 @@ class Planner:
         self.prefill_queue = prefill_queue
         # model whose disagg threshold the retune actuator manages
         self.model_name = model_name
-        # traces: callable returning tracing dicts (default: the process
-        # tracer ring buffer — meaningful when the planner is embedded
-        # next to the frontend/worker; remote planners rely on scraped
-        # metrics only)
+        # latency sources, preferred first: `collector` is a fleet trace
+        # collector (components/trace_collector.py — every worker's
+        # published traces, the honest fleet picture); `traces` is the
+        # FALLBACK callable returning local tracing dicts (the process
+        # tracer ring — frontend-local truth, meaningful when the
+        # planner is embedded next to the frontend/worker)
+        self.collector = collector
         if traces is None:
             from ..runtime.tracing import tracer
             traces = tracer.recent
@@ -268,7 +271,8 @@ class Planner:
                 pq_depth = await self.prefill_queue.depth()
             except Exception:  # noqa: BLE001 — queue may not exist yet
                 pq_depth = 0
-        lat = latency_percentiles_from_traces(self._traces())
+        lat = latency_percentiles(collector=self.collector,
+                                  traces=self._traces())
         signals = FleetSignals.from_worker_metrics(
             stats, draining=draining,
             ttft_p90_ms=lat.get("ttft_p_ms"),

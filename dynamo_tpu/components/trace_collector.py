@@ -1,0 +1,338 @@
+"""Fleet trace collector: assembles per-request trace TREES from the
+completed trace dicts workers publish over the event plane, serves them
+stitched, and exports Chrome-trace-event/Perfetto JSON.
+
+The propagation half lives in runtime/tracing.py (TraceContext on the
+request-plane control message, the disagg handoff, and kv_fabric RPCs);
+this module is the aggregation half, wired into the metrics service
+(components/metrics.py) the way the KV hit-rate subscription already is:
+
+- Workers (and frontends) attach a :class:`TracePublisher` to the
+  process tracer via :func:`wire_trace_publisher`; every finished trace
+  dict rides the component's ``trace_events`` subject.
+- The collector keys members by ``trace_id``, stitches parent/child
+  edges on ``parent_span`` → ``span_id``, and serves ``/traces/{id}``
+  as a tree plus ``?format=perfetto`` as Chrome trace-event JSON
+  (load it at ui.perfetto.dev or chrome://tracing).
+- **Tail-based retention**: the interesting traces are the slow, the
+  errored, and the preempted — so when the tree store fills, those are
+  protected and the fast/boring majority is evicted first (plus an
+  every-Nth survivor so the baseline shape stays observable). The
+  TTFT/ITL/queue-wait HISTOGRAMS are fed from every trace regardless
+  of retention, each observation carrying a ``trace_id`` exemplar — a
+  Grafana latency spike clicks through to the exact trace.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional
+
+from ..runtime.tracing import (TRACE_EVENTS_SUBJECT, TracePublisher,
+                               tracer as process_tracer)
+
+logger = logging.getLogger("dynamo_tpu.components.trace_collector")
+
+__all__ = ["TraceCollector", "wire_trace_publisher",
+           "TRACE_EVENTS_SUBJECT"]
+
+# spans/events that mark a trace tree worth keeping in full
+_RETAIN_EVENTS = frozenset({"engine.preempted"})
+
+
+def wire_trace_publisher(component, tracer=None,
+                         topic: str = TRACE_EVENTS_SUBJECT) -> TracePublisher:
+    """Attach a publisher to the (process-global) tracer that ships every
+    finished trace dict over ``component``'s event subject — the same
+    pattern the KV event publisher uses. Call ``.close()`` to detach
+    (tests share the process tracer)."""
+    tracer = tracer or process_tracer
+
+    async def sink(trace_dict: dict) -> None:
+        await component.publish_event(topic, trace_dict)
+
+    return TracePublisher(sink, tracer_=tracer)
+
+
+class TraceCollector:
+    """Holds recent per-request trace trees + fleet latency histograms.
+
+    ``keep_trees`` bounds the store; ``sample_every`` keeps every Nth
+    boring tree when evicting (the baseline-shape survivors);
+    ``slow_fraction`` protects the slowest tail (default: the top 1% —
+    "keep full trees for the slowest p99" in tail-sampling terms)."""
+
+    def __init__(self, keep_trees: int = 512, sample_every: int = 8,
+                 slow_fraction: float = 0.01, registry=None):
+        self.keep_trees = keep_trees
+        self.sample_every = max(int(sample_every), 1)
+        self.slow_fraction = slow_fraction
+        # trace_id → {"members": {span_id: trace_dict}, "last_at": float,
+        #             "protected": bool, "seq": int}
+        self._trees: "OrderedDict[str, dict]" = OrderedDict()
+        self._seq = 0
+        # rolling root latency window for the slow-tail threshold
+        self._totals: deque = deque(maxlen=1024)
+        # percentile feeds (fed on EVERY trace, independent of retention)
+        self._ttft_ms: deque = deque(maxlen=1024)
+        self._itl_ms: deque = deque(maxlen=1024)
+        self._queue_wait_ms: deque = deque(maxlen=1024)
+        self.received = 0
+        self.evicted = 0
+        self.protected_kept = 0
+        self._make_histograms(registry)
+
+    # ------------------------------------------------------------ histograms
+    def _make_histograms(self, registry) -> None:
+        """TTFT/ITL/queue-wait HISTOGRAMS (not gauges) with a trace_id
+        exemplar per observation. Rendered with exemplars under the
+        OpenMetrics exposition (components/metrics.py render_openmetrics);
+        classic Prometheus text simply omits them."""
+        if registry is None:
+            self.ttft_hist = self.itl_hist = self.queue_wait_hist = None
+            return
+        from prometheus_client import Histogram
+        self.ttft_hist = Histogram(
+            "nv_llm_trace_ttft_seconds",
+            "Fleet TTFT from collected worker traces (exemplar: trace_id)",
+            registry=registry,
+            buckets=(.005, .01, .025, .05, .1, .25, .5, 1.0, 2.5, 5.0,
+                     10.0))
+        self.itl_hist = Histogram(
+            "nv_llm_trace_itl_seconds",
+            "Fleet decode-tail latency after first token (exemplar: "
+            "trace_id)", registry=registry,
+            buckets=(.002, .005, .01, .025, .05, .1, .25, .5, 1.0, 2.5))
+        self.queue_wait_hist = Histogram(
+            "nv_llm_trace_queue_wait_seconds",
+            "Engine admission queue wait (exemplar: trace_id)",
+            registry=registry,
+            buckets=(.001, .005, .01, .05, .1, .25, .5, 1.0, 2.5, 5.0))
+
+    def _observe(self, d: dict) -> None:
+        spans = {s["name"]: s for s in d.get("spans", ())}
+        ex = {"trace_id": d.get("trace_id", "")[:64]} \
+            if d.get("trace_id") else None
+        first = spans.get("first_response")
+        if first is not None:
+            ttft = first["at_ms"]
+            self._ttft_ms.append(ttft)
+            if self.ttft_hist is not None:
+                self.ttft_hist.observe(ttft / 1e3, exemplar=ex)
+            respond = spans.get("respond")
+            if respond is not None:
+                tail = respond["at_ms"] + respond["ms"] - first["at_ms"]
+                if tail >= 0:
+                    self._itl_ms.append(tail)
+                    if self.itl_hist is not None:
+                        self.itl_hist.observe(tail / 1e3, exemplar=ex)
+        qw = spans.get("engine.queue_wait")
+        if qw is not None:
+            self._queue_wait_ms.append(qw["ms"])
+            if self.queue_wait_hist is not None:
+                self.queue_wait_hist.observe(qw["ms"] / 1e3, exemplar=ex)
+
+    # ------------------------------------------------------------------ feed
+    def feed(self, trace_dict: dict) -> None:
+        """One finished per-process trace dict (runtime/tracing.py
+        Trace.to_dict shape). Members dedupe on span_id, so re-delivery
+        is harmless."""
+        tid = trace_dict.get("trace_id")
+        sid = trace_dict.get("span_id")
+        if not tid or not sid:
+            return
+        self.received += 1
+        self._observe(trace_dict)
+        tree = self._trees.get(tid)
+        if tree is None:
+            self._seq += 1
+            tree = {"members": {}, "last_at": 0.0, "protected": False,
+                    "seq": self._seq}
+            self._trees[tid] = tree
+        tree["members"][sid] = trace_dict
+        tree["last_at"] = time.time()
+        self._trees.move_to_end(tid)
+        if self._is_interesting(trace_dict):
+            tree["protected"] = True
+        if trace_dict.get("parent_span") is None:
+            # roots carry the request's end-to-end latency
+            self._totals.append(trace_dict.get("total_ms", 0.0))
+        self._retain()
+
+    def _is_interesting(self, d: dict) -> bool:
+        if d.get("error"):
+            return True
+        if any(s["name"] in _RETAIN_EVENTS for s in d.get("spans", ())):
+            return True
+        if self._totals and d.get("parent_span") is None:
+            xs = sorted(self._totals)
+            k = max(int(len(xs) * (1.0 - self.slow_fraction)) - 1, 0)
+            # STRICTLY greater: in a uniform-latency workload the p99
+            # threshold equals the common value and >= would protect
+            # everything (no tail = nothing to keep)
+            if d.get("total_ms", 0.0) > xs[min(k, len(xs) - 1)]:
+                return True
+        return False
+
+    def _retain(self) -> None:
+        """Tail-based retention: over capacity, evict boring trees first
+        (oldest-first), keeping every ``sample_every``-th of them as a
+        baseline sample; protected (slow/errored/preempted) trees go
+        only when even they exceed capacity."""
+        while len(self._trees) > self.keep_trees:
+            victim = None
+            for tid, tree in self._trees.items():     # oldest first
+                if tree["protected"]:
+                    continue
+                if tree["seq"] % self.sample_every == 0:
+                    continue                          # baseline survivor
+                victim = tid
+                break
+            if victim is None:
+                # no plain-boring tree left: baseline samples go next;
+                # protected (slow/errored/preempted) trees only as the
+                # true last resort
+                victim = next((tid for tid, tr in self._trees.items()
+                               if not tr["protected"]), None)
+            if victim is None:
+                victim = next(iter(self._trees))
+            self.evicted += 1
+            self._trees.pop(victim, None)
+        self.protected_kept = sum(
+            1 for t in self._trees.values() if t["protected"])
+
+    # ----------------------------------------------------------------- reads
+    def find(self, key: str) -> Optional[str]:
+        """Resolve a trace_id OR request id to a trace_id."""
+        if key in self._trees:
+            return key
+        for tid in reversed(self._trees):
+            for m in self._trees[tid]["members"].values():
+                if m.get("request_id") == key:
+                    return tid
+        return None
+
+    def tree(self, trace_id: str) -> Optional[dict]:
+        """The stitched fleet tree: members nested on parent_span →
+        span_id edges; processes whose parent never arrived (lost event,
+        sampling) attach under the root as orphans rather than vanish."""
+        t = self._trees.get(trace_id)
+        if t is None:
+            return None
+        members = dict(t["members"])
+        children: Dict[str, List[dict]] = {}
+        roots, orphans = [], []
+        for m in members.values():
+            ps = m.get("parent_span")
+            if ps is None:
+                roots.append(m)
+            elif ps in members:
+                children.setdefault(ps, []).append(m)
+            else:
+                orphans.append(m)
+
+        def node(m: dict) -> dict:
+            kids = sorted(children.get(m["span_id"], ()),
+                          key=lambda x: x.get("origin_offset_ms", 0.0))
+            return {**m, "children": [node(k) for k in kids]}
+
+        roots.sort(key=lambda x: x.get("origin_offset_ms", 0.0))
+        orphans.sort(key=lambda x: x.get("origin_offset_ms", 0.0))
+        root = node(roots[0]) if roots else None
+        if root is not None and orphans:
+            root["children"].extend(node(o) for o in orphans)
+        out = {
+            "trace_id": trace_id,
+            "request_id": (roots[0] if roots else
+                           next(iter(members.values())))["request_id"],
+            "n_processes": len(members),
+            "roles": sorted({m.get("role", "") for m in members.values()}),
+            "protected": t["protected"],
+            "root": root if root is not None else
+            {"children": [node(o) for o in orphans]},
+        }
+        return out
+
+    def summaries(self, n: int = 64) -> List[dict]:
+        out = []
+        for tid in list(reversed(self._trees))[:n]:
+            t = self._trees[tid]
+            root = next((m for m in t["members"].values()
+                         if m.get("parent_span") is None), None)
+            any_m = root or next(iter(t["members"].values()))
+            out.append({
+                "trace_id": tid,
+                "request_id": any_m.get("request_id"),
+                "roles": sorted({m.get("role", "")
+                                 for m in t["members"].values()}),
+                "total_ms": (root or {}).get("total_ms"),
+                "error": any(m.get("error")
+                             for m in t["members"].values()),
+                "protected": t["protected"],
+            })
+        return out
+
+    # -------------------------------------------------------------- perfetto
+    def perfetto(self, trace_id: str) -> Optional[dict]:
+        """Chrome-trace-event JSON (the Perfetto/chrome://tracing load
+        format): one complete-event ("ph": "X") per span, processes
+        keyed by role, all timestamps on the ORIGIN's wall clock in
+        microseconds. Loadable shape: {"traceEvents": [...]} with
+        name/ph/ts/dur/pid/tid on every slice."""
+        t = self._trees.get(trace_id)
+        if t is None:
+            return None
+        members = list(t["members"].values())
+        origin = min((m.get("origin_ts", 0.0) for m in members),
+                     default=0.0)
+        events: List[dict] = []
+        pids = {}
+        for m in sorted(members,
+                        key=lambda x: x.get("origin_offset_ms", 0.0)):
+            role = m.get("role") or "process"
+            pid = pids.setdefault(role, len(pids) + 1)
+            base_us = (m.get("start_epoch", origin) - origin) * 1e6
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": f"{role} ({m.get('request_id', '')})"}})
+            events.append({
+                "name": f"{role}:{m.get('request_id', '')}",
+                "cat": role, "ph": "X",
+                "ts": round(base_us, 1),
+                "dur": round(m.get("total_ms", 0.0) * 1e3, 1),
+                "pid": pid, "tid": 1,
+                "args": {"trace_id": trace_id,
+                         "span_id": m.get("span_id"),
+                         "parent_span": m.get("parent_span"),
+                         **({"error": m["error"]} if m.get("error")
+                            else {})},
+            })
+            for s in m.get("spans", ()):
+                events.append({
+                    "name": s["name"], "cat": role, "ph": "X",
+                    "ts": round(base_us + s.get("at_ms", 0.0) * 1e3, 1),
+                    "dur": round(s.get("ms", 0.0) * 1e3, 1),
+                    "pid": pid, "tid": 2,
+                    "args": dict(s.get("attrs", {})),
+                })
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "otherData": {"trace_id": trace_id}}
+
+    # ------------------------------------------------------------ percentile
+    def latency_percentiles(self, p: float = 90.0) -> dict:
+        """Fleet-wide TTFT/ITL percentiles out of every collected worker
+        trace — the planner's collector-backed SLO source (llm/slo.py
+        latency_percentiles falls back to the frontend-local ring when
+        this is empty)."""
+        from ..llm.slo import percentile
+        return {"ttft_p_ms": percentile(list(self._ttft_ms), p),
+                "itl_p_ms": percentile(list(self._itl_ms), p),
+                "n_traces": float(len(self._ttft_ms))}
+
+    def stats(self) -> dict:
+        return {"received": self.received, "trees": len(self._trees),
+                "evicted": self.evicted,
+                "protected": self.protected_kept,
+                "ttft_window": len(self._ttft_ms)}

@@ -106,6 +106,12 @@ class EngineRequest:
     spec_k: int = -1
     enqueue_time: float = dataclasses.field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
+    # the request's runtime Trace (runtime/tracing.py) — attached by
+    # submit() from the ambient contextvar so the engine can feed
+    # per-phase spans (queue wait, KV onboard incl. fabric fetch,
+    # preemption markers) into the same fleet trace the frontend opened.
+    # Kept as `object` to stay dependency-light; None = untraced.
+    trace: object = None
 
     @property
     def cancelled(self) -> bool:
@@ -453,6 +459,14 @@ class EngineCore:
         # next #7)
         self.host_roundtrips = 0
         self.host_stall_s = 0.0
+        # flight recorder (engine/flight_recorder.py): bounded ring of
+        # per-dispatch records + loop-lag probe, dumpable via /debug and
+        # llmctl trace dump; per-phase spans feed each request's trace
+        from .flight_recorder import FlightRecorder, register_recorder
+        self.flight = FlightRecorder()
+        register_recorder(self.flight)
+        self._flight_prev_stall_s = 0.0
+        self._flight_cycle_end = time.monotonic()
 
     # ------------------------------------------------------------------ jit
     def _compile_jits_pp(self) -> None:
@@ -658,9 +672,11 @@ class EngineCore:
             self._loop = asyncio.get_running_loop()
             self._loop_task = self._loop.create_task(
                 self._run_loop(), name="engine-core-loop")
+            self.flight.start_lag_probe()
 
     async def stop(self) -> None:
         self._stopping = True
+        self.flight.stop_lag_probe()
         self._work_event.set()
         if self._loop_task is not None:
             try:
@@ -853,6 +869,12 @@ class EngineCore:
                 self._check_kv_payload_layout(
                     sample.shape[1] * sample.shape[4], sample.dtype,
                     "wire")
+        if req.trace is None:
+            # bind the ambient request trace (frontend-opened for
+            # in-process pipelines, ingress-opened child for the request
+            # plane) so engine phases land in the fleet tree
+            from ..runtime.tracing import current_trace
+            req.trace = current_trace()
         self.ensure_started()
         self._inflight_reqs[id(req)] = req
         await self.waiting.put(req)
@@ -987,9 +1009,13 @@ class EngineCore:
                     remote_fetch_failures_total=rs.fetch_failures_total,
                     remote_admission_rejects_total=rs
                     .admission_rejects_total)
+        from ..runtime.tracing import tracer as _tracer
         return ForwardPassMetrics(
             kv_bytes_per_block=self.kv_bytes_per_block(),
             prefill_tok_per_s=self.measured_prefill_tok_per_s(),
+            trace_dropped_log_lines_total=_tracer.dropped_log_lines,
+            loop_lag_ms=self.flight.loop_lag_ms,
+            loop_lag_max_ms=self.flight.loop_lag_max_ms,
             **tier_kw,
             request_active_slots=active,
             request_total_slots=self.B,
@@ -1072,6 +1098,11 @@ class EngineCore:
                 break
 
     async def _run_loop_inner(self) -> None:
+        # the loop task is created from the FIRST submit()'s context and
+        # would inherit that request's ambient trace forever — detach;
+        # per-request trace identity rides EngineRequest.trace instead
+        from ..runtime.tracing import detach_trace
+        detach_trace()
         logger.info("engine loop starting: %d slots, %d KV blocks, block=%d",
                     self.B, self.cfg.num_kv_blocks, self.cfg.kv_block_size)
         while not self._stopping:
@@ -1183,6 +1214,7 @@ class EngineCore:
         self._block_tables[slot, :len(req.blocks)] = req.blocks
         self.defrag_passes += 1
         self._defrag_last_step = self._step
+        self.flight.record("defrag", moved=len(old), runs_before=runs)
         logger.debug("defrag: slot %d moved %d blocks (%d runs → %d), "
                      "pool frag %.2f", slot, len(old), runs,
                      pool.count_runs(new), pool_frag)
@@ -1405,16 +1437,28 @@ class EngineCore:
         remote = self.remote_store
         host_pool.pin(plan.host_slots)    # offload stores must not evict
 
+        # trace identity travels BY VALUE into the prep thread (contextvars
+        # don't cross to_thread): fabric RPCs forward it so the serving
+        # peer's read lands in the same fleet tree
+        trace_ctx = (req.trace.wire_context()
+                     if req.trace is not None else None)
+
         async def prepare() -> None:
             prepped = None
+            _t_prep0 = time.monotonic()
+            fetch_ms = {"host": 0.0, "disk": 0.0, "remote": 0.0}
             try:
                 def prep():
                     from .block_copy import prep_host_values
                     parts = []
                     if plan.host_slots:
+                        _t = time.monotonic()
                         parts.append(host_pool.fetch(plan.host_slots))
+                        fetch_ms["host"] = 1e3 * (time.monotonic() - _t)
                     if plan.disk_hashes:
+                        _t = time.monotonic()
                         parts.append(disk.fetch(plan.disk_hashes))
+                        fetch_ms["disk"] = 1e3 * (time.monotonic() - _t)
                     if plan.remote_hashes:
                         # G4 fetch: peer RPC / object read. Unreachable
                         # (peer died, object torn) is NOT an error — drop
@@ -1422,8 +1466,10 @@ class EngineCore:
                         # recomputes those tokens (graceful fallback:
                         # the fabric must never make serving worse than
                         # a cold prefill)
+                        _t = time.monotonic()
                         try:
-                            parts.append(remote.fetch(plan.remote_hashes))
+                            parts.append(remote.fetch(plan.remote_hashes,
+                                                      trace_ctx=trace_ctx))
                         except Exception:  # noqa: BLE001
                             logger.warning(
                                 "remote KV fetch of %d block(s) failed "
@@ -1435,6 +1481,7 @@ class EngineCore:
                                 plan.remote_hashes)
                             remote.unpin(plan.remote_hashes)
                             plan.remote_hashes = []
+                        fetch_ms["remote"] = 1e3 * (time.monotonic() - _t)
                     if not parts:
                         # every tier hit fell away: admit with no onboard
                         return [], {}
@@ -1455,6 +1502,23 @@ class EngineCore:
                 logger.exception("host-tier onboard prep failed for %s",
                                  req.rid)
             finally:
+                _t_prep1 = time.monotonic()
+                self.flight.record(
+                    "onboard", rid=req.rid,
+                    host_blocks=len(plan.host_slots),
+                    disk_blocks=len(plan.disk_hashes),
+                    remote_blocks=len(plan.remote_hashes),
+                    host_ms=round(fetch_ms["host"], 3),
+                    disk_ms=round(fetch_ms["disk"], 3),
+                    fabric_fetch_ms=round(fetch_ms["remote"], 3),
+                    total_ms=round(1e3 * (_t_prep1 - _t_prep0), 3))
+                if req.trace is not None:
+                    req.trace.add_span(
+                        "kv.onboard", _t_prep0, _t_prep1,
+                        host_blocks=len(plan.host_slots),
+                        disk_blocks=len(plan.disk_hashes),
+                        remote_blocks=len(plan.remote_hashes),
+                        fabric_fetch_ms=round(fetch_ms["remote"], 3))
                 # pins release in _complete_onboards, AFTER the admission
                 # records hit_transfer: an offload-pump eviction of these
                 # slots must not be stream-ordered before the event, or a
@@ -1495,6 +1559,12 @@ class EngineCore:
     def _admit_with_plan(self, req: EngineRequest, slot: int, plan,
                          onboard) -> bool:
         n_prompt = len(req.prompt)
+        _t_admit = time.monotonic()
+        if req.trace is not None:
+            # queue-wait phase on the request's fleet trace: enqueue →
+            # the moment a slot + KV plan existed for it
+            req.trace.add_span("engine.queue_wait", req.enqueue_time,
+                               _t_admit)
         req.slot = slot
         req.blocks = plan.all_blocks
         req.seq = plan.seq
@@ -1705,6 +1775,25 @@ class EngineCore:
             plan.hit_tokens, plan.host_hit_tokens, plan.disk_hit_tokens,
             plan.remote_hit_tokens, remote_admit,
             1e3 * (time.monotonic() - t0))
+        now = time.monotonic()
+        self.flight.record(
+            "prefill", rid=req.rid, prompt=n_prompt,
+            planned_tokens=suffix_len, batch_fill=sum(
+                1 for s in self.slots if s is not None),
+            hit_device=plan.hit_tokens, hit_host=plan.host_hit_tokens,
+            hit_disk=plan.disk_hit_tokens,
+            hit_remote=plan.remote_hit_tokens,
+            precomputed=remote_admit,
+            host_ms=round(1e3 * (now - t0), 3),
+            queue_wait_ms=round(1e3 * (_t_admit - req.enqueue_time), 3))
+        if req.trace is not None:
+            req.trace.add_span(
+                "engine.prefill", t0, now, suffix=suffix_len,
+                hit=req.prefix_hit_tokens,
+                tiers={"device": plan.hit_tokens,
+                       "host": plan.host_hit_tokens,
+                       "disk": plan.disk_hit_tokens,
+                       "remote": plan.remote_hit_tokens})
         if req.ready:
             self._emit(req, tok, float(logprob))
             self._maybe_finish_after_emit(req)
@@ -2044,6 +2133,15 @@ class EngineCore:
                 self._block_tables[i, len(req.blocks) - 1] = new[0]
             self._emit(req, tok, float(logprobs[i]))
             self._maybe_finish_after_emit(req)
+        _now = time.monotonic()
+        self.flight.record(
+            "decode", K=1, batch_fill=len(active_idx),
+            planned_tokens=len(active_idx),
+            emitted=len(active_idx),
+            device_ms=0.0,
+            host_gap_ms=round(
+                1e3 * (_now - self._flight_cycle_end), 3))
+        self._flight_cycle_end = _now
 
     def _decode_step_multi(self, K: int) -> None:
         """K fused decode steps, one dispatch, one host harvest: sampled
@@ -2279,6 +2377,24 @@ class EngineCore:
         if self.recorder is not None and pending.get("id") is not None:
             self.recorder.rec("harvest", id=pending["id"],
                               toks=toks_k.copy(), applied=applied)
+        # flight record: one line per dispatch-harvest cycle. device_ms is
+        # the measured host stall on the fetch (what the loop actually
+        # waited for the device); host_gap_ms is everything since the last
+        # cycle ended that was NOT that wait — scheduling, admissions,
+        # python glue. Together they answer "device-bound or host-bound?"
+        _now = time.monotonic()
+        _stall = self.host_stall_s - self._flight_prev_stall_s
+        self._flight_prev_stall_s = self.host_stall_s
+        self.flight.record(
+            "decode", K=K,
+            batch_fill=len(applied),
+            planned_tokens=K * len(applied),
+            emitted=sum(n for _i, _r, n in applied),
+            device_ms=round(1e3 * _stall, 3),
+            host_gap_ms=round(
+                max(1e3 * (_now - self._flight_cycle_end - _stall), 0.0),
+                3))
+        self._flight_cycle_end = _now
 
     # ---------------------------------------------------------- speculation
     def _req_spec_k(self, req: EngineRequest) -> int:
@@ -2439,6 +2555,11 @@ class EngineCore:
         if self.recorder is not None and pending.get("id") is not None:
             self.recorder.rec("spec_harvest", id=pending["id"],
                               toks=toks_T.copy(), applied=applied)
+        self.flight.record(
+            "verify", batch_fill=len(applied),
+            spec_k=self.cfg.spec_k,
+            emitted=sum(n for _i, _r, n, _a in applied),
+            accepted=sum(a for _i, _r, _n, a in applied))
 
     # ----------------------------------------------------------- preemption
     def _preempt_or_finish(self, req: EngineRequest) -> None:
@@ -2472,6 +2593,12 @@ class EngineCore:
         self.preemptions += 1
         logger.info("preempting %s after %d tokens (KV exhausted; "
                     "recompute on re-admission)", req.rid, req.generated)
+        self.flight.record("preempt", rid=req.rid,
+                           generated=req.generated)
+        if req.trace is not None:
+            # marks the trace for tail-based retention (the collector
+            # keeps full trees for preempted requests)
+            req.trace.event("engine.preempted", generated=req.generated)
         if self.recorder is not None:
             self.recorder.rec("preempt", rid=req.rid,
                               generated=req.generated)

@@ -1,0 +1,172 @@
+"""Engine flight recorder: a bounded ring of per-dispatch records plus an
+event-loop lag probe, dumpable on demand.
+
+Motivation (ISSUE 7): when a fleet trace shows a worker spending 80 ms in
+"decode" the next question is always *which dispatches* — batch fill,
+planned tokens, device time vs host gap, which KV tier fed the admission,
+how speculation behaved. That truth only exists inside the engine loop
+for an instant; the flight recorder keeps the last N dispatch records in
+memory (zero steady-state I/O — strictly cheaper than logging) so a
+``/debug`` hit or ``llmctl trace dump`` can reconstruct the recent past
+of any worker, the same way an aircraft recorder is read after the fact.
+
+Pieces:
+
+- :class:`FlightRecorder` — the ring. ``record(kind, **fields)`` is
+  called synchronously from the engine loop (append-only, no locks
+  needed under the GIL); ``dump()`` returns the ring newest-last.
+- Event-loop **lag probe**: a periodic task that measures how late
+  asyncio wakes it up — the direct observable for "something is blocking
+  the engine loop" (sync file I/O, long host work), feeding the
+  ``nv_llm_engine_loop_lag_ms`` gauge.
+- A process-global registry (weak, keyed by name) so the HTTP
+  ``/debug`` endpoint can enumerate recorders without plumbing.
+- The ``trace/`` KV-store key layout + worker-side watch loop behind
+  ``llmctl trace dump``: the CLI writes the control key, every watching
+  worker publishes its ring under its lease, the CLI collects.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import time
+import weakref
+from collections import deque
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("dynamo_tpu.engine.flight")
+
+__all__ = ["FlightRecorder", "register_recorder", "all_recorders",
+           "trace_control_key", "trace_dump_key", "watch_trace_dump_loop",
+           "TRACE_PREFIX"]
+
+_REGISTRY: "weakref.WeakValueDictionary[str, FlightRecorder]" = \
+    weakref.WeakValueDictionary()
+_ids = itertools.count()
+
+
+class FlightRecorder:
+    """Bounded ring of per-dispatch records + loop-lag probe."""
+
+    def __init__(self, capacity: int = 512,
+                 lag_probe_interval: float = 0.5):
+        self._ring: deque = deque(maxlen=capacity)
+        self.capacity = capacity
+        self.records_total = 0
+        self.lag_probe_interval = lag_probe_interval
+        self.loop_lag_ms = 0.0       # last probe's scheduling delay
+        self.loop_lag_max_ms = 0.0   # high-water mark since start
+        self._probe_task: Optional[asyncio.Task] = None
+
+    # --------------------------------------------------------------- records
+    def record(self, kind: str, **fields) -> None:
+        """Append one dispatch record (engine-loop synchronous; must stay
+        allocation-light — scalar fields only, no arrays)."""
+        self.records_total += 1
+        self._ring.append({"kind": kind, "t": time.time(), **fields})
+
+    def dump(self, last: Optional[int] = None) -> List[dict]:
+        out = list(self._ring)
+        return out[-last:] if last else out
+
+    def stats(self) -> dict:
+        kinds: Dict[str, int] = {}
+        for r in self._ring:
+            kinds[r["kind"]] = kinds.get(r["kind"], 0) + 1
+        return {"records_total": self.records_total,
+                "ring": len(self._ring), "capacity": self.capacity,
+                "kinds": kinds,
+                "loop_lag_ms": round(self.loop_lag_ms, 3),
+                "loop_lag_max_ms": round(self.loop_lag_max_ms, 3)}
+
+    # ------------------------------------------------------------- lag probe
+    def start_lag_probe(self) -> None:
+        """Idempotent; requires a running loop."""
+        if self._probe_task is not None and not self._probe_task.done():
+            return
+        self._probe_task = asyncio.get_running_loop().create_task(
+            self._probe_loop(), name="engine-lag-probe")
+
+    def stop_lag_probe(self) -> None:
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            self._probe_task = None
+
+    async def _probe_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(self.lag_probe_interval)
+            lag_ms = max(loop.time() - t0 - self.lag_probe_interval,
+                         0.0) * 1e3
+            self.loop_lag_ms = lag_ms
+            if lag_ms > self.loop_lag_max_ms:
+                self.loop_lag_max_ms = lag_ms
+                if lag_ms > 100.0:
+                    logger.warning("event-loop lag %.0fms — something is "
+                                   "blocking the engine loop", lag_ms)
+
+
+def register_recorder(recorder: FlightRecorder,
+                      name: Optional[str] = None) -> str:
+    """Register for /debug enumeration (weak: a collected engine's
+    recorder silently drops out). Returns the registry name."""
+    name = name or f"engine-{next(_ids)}"
+    _REGISTRY[name] = recorder
+    return name
+
+
+def all_recorders() -> Dict[str, FlightRecorder]:
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# llmctl trace dump plumbing (the kvtier admin pattern, llm/kv/admin.py)
+# ---------------------------------------------------------------------------
+
+TRACE_PREFIX = "trace/"
+
+
+def trace_control_key(namespace: str) -> str:
+    """llmctl writes {"dump": <epoch>} here; watching workers answer."""
+    return f"{TRACE_PREFIX}control/{namespace}"
+
+
+def trace_dump_key(namespace: str, worker_id: int) -> str:
+    return f"{TRACE_PREFIX}dump/{namespace}/{worker_id:x}"
+
+
+async def watch_trace_dump_loop(core, runtime, namespace: str,
+                                last: int = 128) -> None:
+    """Worker side of ``llmctl trace dump``: on every control-key write,
+    publish this worker's flight-recorder ring + tracer stats under its
+    lease (so a dead worker's stale dump expires with it)."""
+    from ..runtime.kvstore import WatchEventType
+    from ..runtime.tracing import tracer
+    import json
+
+    lease = await runtime.primary_lease()
+    watcher = await runtime.store.watch_prefix(trace_control_key(namespace))
+    async for ev in watcher:
+        if ev.type != WatchEventType.PUT:
+            continue
+        try:
+            n = int(json.loads(ev.entry.value).get("last", last))
+        except Exception:  # noqa: BLE001 — admin input
+            n = last
+        flight = getattr(core, "flight", None)
+        payload = {
+            "at": time.time(),
+            "worker_id": f"{lease.id:x}",
+            "tracer": tracer.stats(),
+            "flight": flight.stats() if flight is not None else None,
+            "records": flight.dump(last=n) if flight is not None else [],
+        }
+        try:
+            await runtime.store.kv_put(
+                trace_dump_key(namespace, lease.id),
+                json.dumps(payload).encode(), lease_id=lease.id)
+        except Exception:  # noqa: BLE001
+            logger.exception("trace dump publish failed")

@@ -98,6 +98,21 @@ def build_parser() -> argparse.ArgumentParser:
     kvw.add_argument("--disk", type=float, default=None)
     kvw.add_argument("--remote", type=float, default=None)
 
+    tr = sub.add_parser("trace", help="fleet tracing admin "
+                                      "(engine/flight_recorder.py)")
+    trsub = tr.add_subparsers(dest="trace_cmd", required=True)
+    trd = trsub.add_parser(
+        "dump",
+        help="collect every worker's engine flight-recorder ring "
+             "(per-dispatch records: step kind, batch fill, device vs "
+             "host-gap ms, KV tier hits, spec accept) + tracer stats")
+    trd.add_argument("namespace")
+    trd.add_argument("--last", type=int, default=32,
+                     help="records per worker (default 32)")
+    trd.add_argument("--timeout", type=float, default=5.0)
+    trd.add_argument("--json", action="store_true",
+                     help="print raw JSON dumps instead of a summary")
+
     dep = sub.add_parser("deployment",
                          help="manage graph deployments (deploy/ control "
                               "plane — the api-server CRUD over the store)")
@@ -158,6 +173,8 @@ async def amain(argv=None) -> int:
             return await _spec_cmd(runtime, args)
         elif args.cmd == "kv":
             return await _kv_cmd(runtime, args)
+        elif args.cmd == "trace":
+            return await _trace_cmd(runtime, args)
         elif args.cmd == "deployment":
             return await _deployment_cmd(runtime, args)
         return 0
@@ -318,6 +335,67 @@ async def _kv_cmd(runtime, args) -> int:
                     "clear": bool(args.clear)}).encode())
     print(f"kv {'clear' if args.clear else 'flush'} requested for "
           f"{args.namespace}")
+    return 0
+
+
+async def _trace_cmd(runtime, args) -> int:
+    """``llmctl trace dump``: write the trace/control/{ns} key; every
+    worker watching it (launch/run.py _wire_tracing) publishes its
+    flight-recorder ring under trace/dump/{ns}/{worker:x} within its
+    lease; collect and print (engine/flight_recorder.py key layout)."""
+    import asyncio as _asyncio
+    import json
+    import time
+
+    from ..engine.flight_recorder import trace_control_key, trace_dump_key
+
+    requested_at = time.time()
+    await runtime.store.kv_put(
+        trace_control_key(args.namespace),
+        json.dumps({"dump": requested_at, "last": args.last}).encode())
+    prefix = trace_dump_key(args.namespace, 0).rsplit("/", 1)[0] + "/"
+    deadline = time.monotonic() + args.timeout
+    dumps = {}
+    while time.monotonic() < deadline:
+        for e in await runtime.store.kv_get_prefix(prefix):
+            try:
+                d = json.loads(e.value)
+            except ValueError:
+                continue
+            if d.get("at", 0) >= requested_at:
+                dumps[e.key] = d
+        if dumps:
+            # one settle pass so stragglers land, then report
+            await _asyncio.sleep(0.3)
+            for e in await runtime.store.kv_get_prefix(prefix):
+                try:
+                    d = json.loads(e.value)
+                except ValueError:
+                    continue
+                if d.get("at", 0) >= requested_at:
+                    dumps[e.key] = d
+            break
+        await _asyncio.sleep(0.1)
+    if not dumps:
+        print(f"(no worker answered the trace dump in {args.timeout:g}s "
+              f"— is anything serving namespace {args.namespace!r}?)")
+        return 1
+    if args.json:
+        print(json.dumps(list(dumps.values()), indent=2))
+        return 0
+    for key in sorted(dumps):
+        d = dumps[key]
+        fl = d.get("flight") or {}
+        tr = d.get("tracer") or {}
+        print(f"worker {d.get('worker_id')}  records={fl.get('ring', 0)}"
+              f"/{fl.get('records_total', 0)}  "
+              f"loop_lag={fl.get('loop_lag_ms', 0):.1f}ms "
+              f"(max {fl.get('loop_lag_max_ms', 0):.1f}ms)  "
+              f"traces={tr.get('completed', 0)} "
+              f"log_dropped={tr.get('dropped_log_lines', 0)}")
+        for r in d.get("records", []):
+            extra = {k: v for k, v in r.items() if k not in ("kind", "t")}
+            print(f"  {r['kind']:8s} {extra}")
     return 0
 
 

@@ -176,6 +176,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--unconditional-disagg", action="store_true",
                    help="always prefill remotely (skip the threshold)")
     # batch mode
+    p.add_argument("--trace-log-every", type=int, default=None,
+                   help="log 1 of every N completed request traces "
+                        "(slow/errored always log; skipped lines feed "
+                        "nv_llm_trace_dropped_log_lines_total). Default: "
+                        "env DYN_TRACE_LOG_EVERY or 1 (log all)")
+    p.add_argument("--trace-log-slow-ms", type=float, default=None,
+                   help="always log traces slower than this many ms, "
+                        "regardless of sampling")
     p.add_argument("--output-path", help="batch: output JSONL path")
     p.add_argument("--max-tokens", type=int, default=256,
                    help="text/stdin/batch: generation budget")
@@ -464,6 +472,7 @@ async def run_worker_endpoint(args, engine, pipeline, core, runtime,
         await _wire_spec_config(core, runtime, endpoint.namespace)
         _wire_kv_admin(core, runtime, endpoint.namespace)
         _wire_kv_weights(runtime, endpoint.namespace)
+        _wire_tracing(args, core, runtime, endpoint)
         if args.kv_fabric:
             # fleet KV fabric (llm/kv/fabric.py): serve our disk/host
             # blocks at dyn://{ns}/{comp}/kv_fabric, fetch peers' —
@@ -496,6 +505,26 @@ async def run_worker_endpoint(args, engine, pipeline, core, runtime,
     logger.info("worker serving %s (%s protocol)", endpoint.path,
                 args.protocol)
     await asyncio.Event().wait()
+
+
+def _wire_tracing(args, core, runtime, endpoint) -> None:
+    """Fleet tracing wiring (docs/observability.md): configure the
+    process tracer's log sampling, publish every finished trace over the
+    component's trace_events subject (the collector on the metrics
+    service assembles the fleet trees), and watch the trace/control key
+    so ``llmctl trace dump`` can pull this worker's flight recorder."""
+    from ..components.trace_collector import wire_trace_publisher
+    from ..engine.flight_recorder import watch_trace_dump_loop
+    from ..runtime.tracing import tracer
+
+    tracer.configure(log_every=getattr(args, "trace_log_every", None),
+                     slow_ms=getattr(args, "trace_log_slow_ms", None))
+    component = runtime.namespace(endpoint.namespace).component(
+        endpoint.component)
+    wire_trace_publisher(component)
+    asyncio.get_running_loop().create_task(
+        watch_trace_dump_loop(core, runtime, endpoint.namespace),
+        name="trace-dump-watch")
 
 
 async def _wire_kv_events(core, runtime, endpoint) -> None:

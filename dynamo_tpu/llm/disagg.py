@@ -192,14 +192,19 @@ class DisaggEngine(JaxEngine):
         return n * bs
 
     async def generate(self, request: SingleIn) -> ManyOut:
+        from ..runtime.tracing import span
         req = self.build_request(request)
         hit = self._estimate_prefix_hit(req)
         if self.disagg_router.prefill_remote(len(req.prompt), hit):
-            payload = await self._remote_prefill(req, hit)
+            with span("disagg.remote_prefill", prompt=len(req.prompt),
+                      prefix_hit=hit) as s:
+                payload = await self._remote_prefill(req, hit)
             if payload is not None:
                 req.precomputed = payload
                 self.remote_prefills += 1
             else:
+                if s is not None:
+                    s.attrs["fallback"] = True
                 self.remote_failures += 1
                 self.local_prefills += 1
         else:
@@ -209,6 +214,7 @@ class DisaggEngine(JaxEngine):
 
     async def _remote_prefill(self, req: EngineRequest,
                               hit: int) -> Optional[KvPayload]:
+        from ..runtime.tracing import current_wire_context
         from .kv_transport import PROC_TOKEN, bridge
         rt = self.runtime
         await rt.tcp.start()
@@ -219,7 +225,8 @@ class DisaggEngine(JaxEngine):
             sampling=dataclasses.asdict(req.sampling),
             connection_info=rt.tcp.connection_info(rx).to_dict(),
             engine_id=rt.worker_uuid, prefix_hit_tokens=hit,
-            device_bridge=PROC_TOKEN if self.device_plane else "")
+            device_bridge=PROC_TOKEN if self.device_plane else "",
+            trace=current_wire_context())
         try:
             await self.queue.enqueue(rpr)
             prologue = await rx.wait_connected(timeout=self.prefill_timeout)
@@ -332,13 +339,25 @@ class PrefillWorker:
             logger.exception("undecodable prefill work item %d", item.id)
             await self.queue.ack(item.id)
             return
+        from ..runtime.tracing import Trace, use_trace
+        # open a CHILD trace of the decode-side request (wire-propagated
+        # context on the queue item) so the disagg handoff appears inside
+        # the one fleet tree the collector assembles
+        with use_trace(Trace.from_wire(rpr.trace, rpr.request_id,
+                                       role="prefill")) as ptrace:
+            await self._run_prefill(item, rpr, ptrace)
+
+    async def _run_prefill(self, item, rpr: RemotePrefillRequest,
+                           ptrace) -> None:
         conn = ConnectionInfo.from_dict(rpr.connection_info)
         try:
-            sender = await open_stream_sender(conn, timeout=5.0)
+            with ptrace.span("dial_back"):
+                sender = await open_stream_sender(conn, timeout=5.0)
         except Exception:
             # decode worker unreachable — retry a bounded number of times
             # (it may be us who's partitioned), then drop: the decode side
             # falls back to local prefill on its own timeout.
+            ptrace.set_error("decode worker sink unreachable")
             if item.deliveries >= self.MAX_DELIVERIES:
                 logger.warning("dropping prefill item %d after %d deliveries",
                                item.id, item.deliveries)
@@ -419,15 +438,18 @@ class PrefillWorker:
         await self.core.submit(req)
         try:
             # drain the engine's (token, finish) signals, then await the send
-            while True:
-                out, _ = await asyncio.wait_for(req.out_queue.get(),
-                                                self.send_timeout)
-                if out is FINISH_SENTINEL:
-                    break
-            await asyncio.wait_for(sent, self.send_timeout)
+            with ptrace.span("prefill.engine", tokens=len(rpr.token_ids)):
+                while True:
+                    out, _ = await asyncio.wait_for(req.out_queue.get(),
+                                                    self.send_timeout)
+                    if out is FINISH_SENTINEL:
+                        break
+            with ptrace.span("prefill.handoff"):
+                await asyncio.wait_for(sent, self.send_timeout)
             await self.queue.ack(item.id)
             self.prefills_done += 1
         except Exception as e:  # noqa: BLE001
+            ptrace.set_error(str(e))
             self.prefills_failed += 1
             logger.warning("prefill handoff failed for %s (%s)",
                            rpr.request_id, e)

@@ -239,6 +239,7 @@ class HttpService:
         self.app.router.add_get("/health", self._health)
         self.app.router.add_get("/live", self._health)
         self.app.router.add_get("/traces", self._traces)
+        self.app.router.add_get("/debug", self._debug)
         self._runner: Optional[web.AppRunner] = None
         self._site: Optional[web.TCPSite] = None
 
@@ -281,6 +282,24 @@ class HttpService:
         data = tracer.find(rid) if rid else tracer.recent()
         return web.json_response({"traces": data,
                                   "completed": tracer.completed})
+
+    async def _debug(self, request: web.Request) -> web.Response:
+        """Operator introspection: tracer sampling state + every
+        in-process engine flight recorder's ring (per-dispatch records,
+        event-loop lag) — the same payload ``llmctl trace dump``
+        collects from remote workers (engine/flight_recorder.py)."""
+        from ...engine.flight_recorder import all_recorders
+        from ...runtime.tracing import tracer
+        try:
+            last = int(request.query.get("last", "64"))
+        except ValueError:
+            last = 64
+        return web.json_response({
+            "tracer": tracer.stats(),
+            "flight_recorders": {
+                name: {"stats": fr.stats(), "records": fr.dump(last=last)}
+                for name, fr in all_recorders().items()},
+        })
 
     async def _models(self, request: web.Request) -> web.Response:
         now = int(time.time())
@@ -333,7 +352,7 @@ class HttpService:
         # per-request trace (reference egress/push.rs:134-151): stage
         # latencies from HTTP ingress through dispatch to last byte, keyed
         # by the request id the control plane already carries everywhere
-        with use_trace(Trace(ectx.id, role="frontend")):
+        with use_trace(Trace(ectx.id, role="frontend")) as ftrace:
             with span("dispatch", model=model, endpoint=endpoint):
                 try:
                     if n_choices == 1:
@@ -342,9 +361,11 @@ class HttpService:
                         stream = await _start_fanout(engine, body, ectx,
                                                      n_choices)
                 except ValueError as e:
+                    ftrace.set_error(str(e))
                     guard.close()
                     return _error_response(400, str(e))
                 except Exception as e:  # noqa: BLE001 — engine boundary
+                    ftrace.set_error(str(e))
                     logger.exception("engine error on %s", endpoint)
                     guard.close()
                     return _error_response(
@@ -365,7 +386,10 @@ class HttpService:
             folded = await (aggregate_chat_stream(stream) if is_chat
                             else aggregate_completion_stream(stream))
             guard.mark_ok()
-            return web.json_response(folded)
+            # surface the request id so a user report joins the
+            # collector's trace tree (docs/observability.md)
+            return web.json_response(
+                folded, headers={"X-Request-Id": ectx.id})
         except RuntimeError as e:
             return _error_response(500, str(e), "internal_error")
         finally:
@@ -379,6 +403,8 @@ class HttpService:
             "Cache-Control": "no-cache",
             "Connection": "keep-alive",
             "X-Accel-Buffering": "no",
+            # join a user report to the collector's trace tree
+            "X-Request-Id": ectx.id,
         })
         try:
             await resp.prepare(request)
@@ -401,11 +427,23 @@ class HttpService:
                     return
 
         monitor_task = asyncio.create_task(monitor())
+        first_chunk = True
         try:
             async for ann in stream:
                 if not isinstance(ann, Annotated):
                     ann = Annotated.from_data(ann)
                 chunk = ann.data
+                if first_chunk and isinstance(chunk, dict):
+                    # nvext.request_id on the first SSE chunk: SSE
+                    # consumers that never see response headers (EventSource
+                    # wrappers, log captures) can still join user reports
+                    # to collector traces
+                    first_chunk = False
+                    chunk = dict(chunk)
+                    chunk["nvext"] = {**(chunk.get("nvext") or {}),
+                                      "request_id": ectx.id}
+                    ann = Annotated(data=chunk, id=ann.id, event=ann.event,
+                                    comment=ann.comment)
                 if isinstance(chunk, dict) and not include_usage:
                     # usage chunks / piggybacked usage are opt-in for SSE
                     if chunk.get("usage") is not None and not chunk.get("choices"):

@@ -315,7 +315,21 @@ class KvFabricServer(AsyncEngine):
                         blocks[str(h)] = _b64(data)
                 return blocks, missing
 
-            blocks, missing = await asyncio.to_thread(read_all)
+            # the requesting worker forwarded its request's TraceContext:
+            # serve the fetch under a CHILD trace so the peer-side read
+            # lands in the same fleet tree the collector assembles
+            from ...runtime.tracing import Trace, use_trace
+            tctx = d.get("trace")
+            if tctx:
+                with use_trace(Trace.from_wire(
+                        tctx, tctx.get("trace_id", "?"),
+                        role="kv_peer")) as ptrace:
+                    with ptrace.span("fabric.fetch", blocks=len(hashes)):
+                        blocks, missing = await asyncio.to_thread(read_all)
+                    if missing:
+                        ptrace.event("fabric.missing", n=len(missing))
+            else:
+                blocks, missing = await asyncio.to_thread(read_all)
             self.fetches_served += 1
             self.blocks_served += len(blocks)
             return {"ok": True, "blocks": blocks, "missing": missing}
@@ -456,8 +470,15 @@ class KvFabric:
                                              ev.removed.block_hashes)
 
     # -------------------------------------------------------------- probes
-    async def _call(self, worker_id: int, payload: dict) -> dict:
-        stream = await self.client.direct(Context(payload), worker_id)
+    async def _call(self, worker_id: int, payload: dict,
+                    trace_ctx: Optional[dict] = None) -> dict:
+        # explicit propagation (metadata override in runtime/egress.py):
+        # this coroutine runs off the request's async chain, so the
+        # request's trace identity arrives by value, not contextvar
+        ctx = Context(payload,
+                      metadata={"trace_context": trace_ctx}
+                      if trace_ctx else None)
+        stream = await self.client.direct(ctx, worker_id)
         async for item in stream:
             if not item.get("ok"):
                 raise RuntimeError(item.get("error", "fabric call failed"))
@@ -480,16 +501,19 @@ class KvFabric:
         return self.links.get(worker_id)
 
     # ------------------------------------------------------------- fetches
-    async def fetch_async(self, worker_id: int,
-                          seq_hashes: Sequence[int]) -> dict:
+    async def fetch_async(self, worker_id: int, seq_hashes: Sequence[int],
+                          trace_ctx: Optional[dict] = None) -> dict:
         """One peer RPC for a run of blocks → stacked wire values
         ({key: [L, H, n, bs, D]}). KeyError when the peer cannot serve
         every requested hash (evicted since the announce) — the
-        graceful-fallback signal."""
+        graceful-fallback signal. ``trace_ctx`` (TraceContext dict)
+        rides the RPC so the peer serves under a child trace."""
         t0 = time.monotonic()
-        r = await self._call(worker_id,
-                             {"op": "fetch",
-                              "hashes": [int(h) for h in seq_hashes]})
+        payload = {"op": "fetch",
+                   "hashes": [int(h) for h in seq_hashes]}
+        if trace_ctx:
+            payload["trace"] = trace_ctx
+        r = await self._call(worker_id, payload, trace_ctx=trace_ctx)
         if r.get("missing"):
             raise KeyError(f"peer {worker_id:x} no longer holds "
                            f"{len(r['missing'])} requested block(s)")
@@ -502,14 +526,17 @@ class KvFabric:
                     np.stack([b[k] for b in blocks], axis=2))
                 for k in blocks[0]}
 
-    def fetch_sync(self, worker_id: int, seq_hashes: Sequence[int]) -> dict:
+    def fetch_sync(self, worker_id: int, seq_hashes: Sequence[int],
+                   trace_ctx: Optional[dict] = None) -> dict:
         """RemoteKvStore.peer_fetch plug: called from the admission's
         off-thread onboard prep, so blocking on the loop's RPC future is
-        safe (and the loop keeps decoding throughout)."""
+        safe (and the loop keeps decoding throughout). ``trace_ctx`` is
+        passed explicitly because contextvars don't cross the thread
+        hop — the requesting request's trace identity travels by value."""
         if self._loop is None:
             raise KeyError("fabric not attached")
         fut = asyncio.run_coroutine_threadsafe(
-            self.fetch_async(worker_id, seq_hashes), self._loop)
+            self.fetch_async(worker_id, seq_hashes, trace_ctx), self._loop)
         try:
             return fut.result(timeout=self.FETCH_TIMEOUT_S)
         except Exception as e:

@@ -554,13 +554,19 @@ class RemoteKvStore:
             self.object.unpin(seq_hashes)
 
     # ---------------------------------------------------------------- reads
-    def fetch(self, seq_hashes: Sequence[int]) -> dict:
+    def fetch(self, seq_hashes: Sequence[int],
+              trace_ctx: Optional[dict] = None) -> dict:
         """Stacked wire values ({key: [L, H, n, bs, D]}) like the disk
         tier's fetch. Runs on the off-thread onboard path. Raises
         KeyError when any block is unreachable (peer gone, object torn)
-        — the engine's graceful-fallback signal, never a crash."""
+        — the engine's graceful-fallback signal, never a crash.
+
+        ``trace_ctx`` is the requesting request's propagation record
+        (runtime/tracing.py TraceContext dict): peer RPCs forward it so
+        the serving peer's fetch appears as a child span in the one
+        fleet trace tree."""
         try:
-            blocks = self._fetch_blocks(seq_hashes)
+            blocks = self._fetch_blocks(seq_hashes, trace_ctx)
         except Exception:
             self.fetch_failures_total += 1
             raise
@@ -569,7 +575,8 @@ class RemoteKvStore:
                     np.stack([b[k] for b in blocks], axis=2))
                 for k in blocks[0]}
 
-    def _fetch_blocks(self, seq_hashes: Sequence[int]) -> List[dict]:
+    def _fetch_blocks(self, seq_hashes: Sequence[int],
+                      trace_ctx: Optional[dict] = None) -> List[dict]:
         # contiguous segmentation: object-held blocks read locally, the
         # rest grouped into per-peer runs so one RPC serves each run
         out: List[Optional[dict]] = [None] * len(seq_hashes)
@@ -585,7 +592,7 @@ class RemoteKvStore:
                 peer_runs.setdefault(holders[0], []).append(i)
         for wid, idxs in peer_runs.items():
             hashes = [seq_hashes[i] for i in idxs]
-            stacked = self.peer_fetch(wid, hashes)
+            stacked = self.peer_fetch(wid, hashes, trace_ctx)
             for j, i in enumerate(idxs):
                 out[i] = {k: np.ascontiguousarray(v[:, :, j])
                           for k, v in stacked.items()}

@@ -90,6 +90,15 @@ class ForwardPassMetrics:
     pp_microbatch: int = 0
     pp_utilization: float = 0.0
     pp_bubble_fraction: float = 0.0
+    # fleet tracing + engine flight recorder (runtime/tracing.py +
+    # engine/flight_recorder.py): trace log lines the sampler skipped
+    # (nv_llm_trace_dropped_log_lines_total — rising means sampling is
+    # active, by design at fleet QPS), and the event-loop lag probe
+    # (nv_llm_engine_loop_lag_ms — rising means something is BLOCKING
+    # the engine loop: sync I/O, long host glue). Zeros on old payloads.
+    trace_dropped_log_lines_total: int = 0
+    loop_lag_ms: float = 0.0
+    loop_lag_max_ms: float = 0.0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)

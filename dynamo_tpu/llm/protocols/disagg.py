@@ -51,6 +51,12 @@ class RemotePrefillRequest:
     # SAME process takes the device-to-device bulk plane (ICI) and sends
     # only a control frame over TCP; others stream the wire payload
     device_bridge: str = ""
+    # distributed-tracing propagation (runtime/tracing.py TraceContext):
+    # the prefill worker opens its trace as a CHILD of the decode-side
+    # request trace, so the disagg handoff appears inside the one fleet
+    # tree instead of as a disjoint prefill-side trace. None on old
+    # senders; ignored by old receivers (from_json passes it through).
+    trace: Optional[Dict] = None
 
     def to_json(self) -> bytes:
         return json.dumps(dataclasses.asdict(self)).encode()

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional
 __all__ = [
     "ServiceLevelObjective", "FleetSignals", "SloVerdict", "evaluate",
     "percentile", "latency_percentiles_from_traces",
+    "latency_percentiles",
     "slo_key", "control_key", "status_key", "scale_key", "PLANNER_PREFIX",
 ]
 
@@ -217,3 +218,22 @@ def latency_percentiles_from_traces(traces: List[dict], p: float = 90.0
     return {"ttft_p_ms": percentile(ttfts, p),
             "itl_p_ms": percentile(itls, p),
             "n_traces": float(len(ttfts))}
+
+
+def latency_percentiles(p: float = 90.0, collector=None,
+                        traces: Optional[List[dict]] = None
+                        ) -> Dict[str, Optional[float]]:
+    """FLEET-wide latency percentiles with local fallback: prefer the
+    trace collector's window (components/trace_collector.py — fed by
+    every worker's published traces, so the planner scales on what the
+    whole fleet experienced), fall back to the frontend-local tracer
+    ring when no collector is wired or it hasn't seen traffic yet.
+    The SLO inputs degrade gracefully instead of flipping to None."""
+    if collector is not None:
+        try:
+            d = collector.latency_percentiles(p)
+        except Exception:  # noqa: BLE001 — observability never breaks SLOs
+            d = None
+        if d and d.get("n_traces"):
+            return d
+    return latency_percentiles_from_traces(traces or [], p)

@@ -114,18 +114,27 @@ class ConnectionInfo:
 class RequestControlMessage:
     """Header half of a request two-part message.
     Reference: ``RequestControlMessage{id, request_type, response_type,
-    connection_info}`` (network/egress/push.rs)."""
+    connection_info}`` (network/egress/push.rs).
+
+    ``trace`` is the optional distributed-tracing propagation record
+    ``{trace_id, parent_span, origin_ts}`` (runtime/tracing.py
+    TraceContext): when present, the serving side opens its trace as a
+    CHILD of the caller's instead of a disjoint root — the fleet-tree
+    stitch edge. Absent on old senders; ignored by old receivers."""
 
     id: str
     request_type: str = "single_in"     # single_in | many_in
     response_type: str = "many_out"
     connection_info: Optional[ConnectionInfo] = None
+    trace: Optional[dict] = None
 
     def to_json(self) -> bytes:
         d = {"id": self.id, "request_type": self.request_type,
              "response_type": self.response_type}
         if self.connection_info is not None:
             d["connection_info"] = self.connection_info.to_dict()
+        if self.trace is not None:
+            d["trace"] = self.trace
         return json.dumps(d).encode()
 
     @classmethod
@@ -135,7 +144,8 @@ class RequestControlMessage:
         return cls(id=d["id"],
                    request_type=d.get("request_type", "single_in"),
                    response_type=d.get("response_type", "many_out"),
-                   connection_info=ConnectionInfo.from_dict(ci) if ci else None)
+                   connection_info=ConnectionInfo.from_dict(ci) if ci else None,
+                   trace=d.get("trace"))
 
 
 # ----------------------------------------------------------------- framing

@@ -202,11 +202,20 @@ class Client(AsyncEngine):
         stream — the same contract as the reference's NATS request plane.
         (Fire-and-forget requests are deduped worker-side by id —
         runtime/ingress.py.)"""
+        from .tracing import current_wire_context
         loop = asyncio.get_running_loop()
         last_err: Exception = RuntimeError("dispatch failed")
+        # propagate the request trace on the wire so the worker opens a
+        # CHILD trace of ours (runtime/tracing.py TraceContext). An
+        # explicit metadata["trace_context"] wins over the ambient
+        # contextvar — callers dispatching OFF the request's async chain
+        # (fabric RPCs hopping threads) pass identity by value.
+        wire_trace = (ctx.metadata.get("trace_context")
+                      or current_wire_context())
         for attempt in range(self.DISPATCH_ATTEMPTS):
             conn = rt.tcp.connection_info(rx)
-            ctrl = RequestControlMessage(id=ctx.id, connection_info=conn)
+            ctrl = RequestControlMessage(id=ctx.id, connection_info=conn,
+                                         trace=wire_trace)
             payload = encode_two_part(ctrl, self.encode_req(ctx.data))
             deadline = loop.time() + self.DIAL_BACK_TIMEOUT
             delay = 0.05

@@ -200,12 +200,17 @@ class EndpointServer:
         from .tracing import Trace, span, use_trace
         ctx = Context(request, ctx=EngineContext(ctrl.id))
         # worker-side trace under the SAME request id the frontend logged
-        # (ingress prologue → engine → first frame → stream end)
-        with use_trace(Trace(ctrl.id, role="worker")) as trace:
+        # (ingress prologue → engine → first frame → stream end). When the
+        # control message carries a propagated TraceContext this becomes a
+        # CHILD of the caller's trace — the collector stitches the edge;
+        # without one it stays a root (old senders, direct dispatch).
+        with use_trace(Trace.from_wire(ctrl.trace, ctrl.id,
+                                       role="worker")) as trace:
             with span("engine.accept"):
                 try:
                     stream = await self.engine.generate(ctx)
                 except Exception as e:
+                    trace.set_error(str(e))
                     logger.exception("engine rejected request %s", ctrl.id)
                     if info is not None:
                         sender = await open_stream_sender(info, error=str(e))
@@ -236,9 +241,11 @@ class EndpointServer:
                             first = False
                             trace.event("first_response")
                     await sender.finish()
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError) as e:
+                trace.set_error(f"connection lost: {e}")
                 ctx.ctx.kill()
             except Exception as e:
+                trace.set_error(str(e))
                 logger.exception("stream failed for %s", ctrl.id)
                 await sender.finish(error=str(e))
 

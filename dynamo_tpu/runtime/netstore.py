@@ -239,7 +239,13 @@ class _Conn:
         backoff × uniform jitter, counting each retry
         (``retries_total`` per connection + the module counter feeding
         nv_llm_netstore_retries_total) — instead of surfacing the first
-        flap as a hard error to the caller."""
+        flap as a hard error to the caller.
+
+        When a request trace is ambient (runtime/tracing.py) the call is
+        recorded as a ``netstore.{op}`` span — control-plane RPCs issued
+        on a request's critical path (discovery lookups, lease work)
+        show up in the fleet trace instead of hiding in the daemon."""
+        from .tracing import span as _span
         loop = asyncio.get_running_loop()
         deadline = loop.time() + self.RETRY_WINDOW
         delay = 0.05
@@ -247,7 +253,8 @@ class _Conn:
         while True:
             try:
                 await self._ensure_connected()
-                return await self._call_once(op, **kwargs)
+                with _span(f"netstore.{op}"):
+                    return await self._call_once(op, **kwargs)
             except ConnectionError:
                 attempts += 1
                 if (self.closed or loop.time() >= deadline

@@ -224,7 +224,7 @@ def test_grafana_dashboard_queries_real_metrics():
                                                _LAYOUT_GAUGES, _PP_GAUGES,
                                                _REMOTE_GAUGES,
                                                _SPEC_GAUGES, _TIER_GAUGES,
-                                               PREFIX)
+                                               _TRACE_GAUGES, PREFIX)
     from dynamo_tpu.llm.http.metrics import PREFIX as HTTP_PREFIX
     exported = {f"{PREFIX}_{f}" for f in _GAUGE_FIELDS}
     exported |= set(_SPEC_GAUGES.values())
@@ -232,6 +232,12 @@ def test_grafana_dashboard_queries_real_metrics():
     exported |= set(_PP_GAUGES.values())
     exported |= set(_LAYOUT_GAUGES.values())
     exported |= set(_REMOTE_GAUGES.values())
+    exported |= set(_TRACE_GAUGES.values())
+    # trace-collector latency histograms (components/trace_collector.py
+    # — exemplar-carrying; the Grafana "Tracing" row queries them)
+    exported |= {"nv_llm_trace_ttft_seconds_bucket",
+                 "nv_llm_trace_itl_seconds_bucket",
+                 "nv_llm_trace_queue_wait_seconds_bucket"}
     exported |= {f"{PREFIX}_hit_rate_isl_blocks_total",
                  f"{PREFIX}_hit_rate_overlap_blocks_total",
                  f"{HTTP_PREFIX}_requests_total",

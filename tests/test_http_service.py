@@ -83,6 +83,60 @@ async def test_chat_streaming_sse(service):
 
 
 @pytest.mark.asyncio
+async def test_request_id_surfaced_to_clients(service):
+    """ISSUE 7 satellite: the request/trace id reaches the CLIENT —
+    X-Request-Id on unary and SSE responses, plus an nvext.request_id
+    field on the first SSE chunk — so a user report joins the
+    collector's trace tree (and the frontend's local /traces ring)."""
+    async with aiohttp.ClientSession() as s:
+        # unary: header present and joinable against /traces
+        async with s.post(_url(service, "/v1/chat/completions"), json={
+            "model": "echo",
+            "messages": [{"role": "user", "content": "hi"}],
+        }) as r:
+            assert r.status == 200
+            rid = r.headers.get("X-Request-Id")
+            assert rid
+        async with s.get(_url(service, "/traces"),
+                         params={"request_id": rid}) as r:
+            traces = (await r.json())["traces"]
+        assert traces and traces[-1]["request_id"] == rid
+
+        # SSE: header AND the nvext field on the first chunk
+        async with s.post(_url(service, "/v1/chat/completions"), json={
+            "model": "echo", "stream": True,
+            "messages": [{"role": "user", "content": "a b"}],
+        }) as r:
+            assert r.status == 200
+            sse_rid = r.headers.get("X-Request-Id")
+            assert sse_rid and sse_rid != rid
+            anns = [a async for a in parse_sse_stream(r.content.iter_any())]
+    chunks = [a.data for a in anns if a.data]
+    assert chunks[0]["nvext"]["request_id"] == sse_rid
+    # only the first chunk carries it (no per-token overhead)
+    assert all("nvext" not in c for c in chunks[1:])
+
+
+@pytest.mark.asyncio
+async def test_debug_endpoint_exposes_tracer_and_flight_recorders(service):
+    """/debug: tracer sampling stats + every in-process engine flight
+    recorder ring (the llmctl trace dump payload, served locally)."""
+    from dynamo_tpu.engine.flight_recorder import (FlightRecorder,
+                                                   register_recorder)
+    fr = FlightRecorder(capacity=4)
+    fr.record("decode", K=2, batch_fill=1)
+    name = register_recorder(fr, name="http-debug-test")
+    async with aiohttp.ClientSession() as s:
+        async with s.get(_url(service, "/debug")) as r:
+            assert r.status == 200
+            body = await r.json()
+    assert "completed" in body["tracer"]
+    rec = body["flight_recorders"][name]
+    assert rec["stats"]["records_total"] == 1
+    assert rec["records"][0]["kind"] == "decode"
+
+
+@pytest.mark.asyncio
 async def test_unknown_model_404(service):
     async with aiohttp.ClientSession() as s:
         async with s.post(_url(service, "/v1/chat/completions"), json={

@@ -126,6 +126,12 @@ async def test_http_exposition(daemon):
                 assert resp.status == 200
                 body = await resp.text()
         assert "nv_llm_kv_kv_total_blocks" in body
+        # fleet-tracing observability rides the same scrape: the
+        # log-sampling drop counter and the engine loop-lag probe
+        # (per-worker gauges), plus the collector's latency histograms
+        assert "nv_llm_trace_dropped_log_lines_total" in body
+        assert "nv_llm_engine_loop_lag_ms" in body
+        assert "nv_llm_trace_ttft_seconds" in body
     finally:
         if runner is not None:
             await runner.cleanup()

@@ -1,13 +1,17 @@
 """Per-request tracing spans (reference egress/push.rs:134-151): stage
-latencies from HTTP ingress through router egress to worker ingress,
-correlated by request id, surfaced in logs and on /traces."""
+latencies from HTTP ingress through router egress to worker ingress —
+now with ON-WIRE context propagation (ISSUE 7): the worker side opens a
+CHILD trace of the frontend's via the TraceContext riding the control
+message, log lines are sampled at fleet QPS, and finished traces flow
+to publication hooks."""
 
 import asyncio
 import json
 
 import pytest
 
-from dynamo_tpu.runtime.tracing import (Trace, current_trace, span, tracer,
+from dynamo_tpu.runtime.tracing import (Trace, TraceContext, Tracer,
+                                        current_trace, span, tracer,
                                         use_trace)
 
 pytestmark = pytest.mark.asyncio
@@ -33,6 +37,81 @@ async def test_trace_spans_and_contextvar():
 async def test_span_without_trace_is_noop():
     with span("orphan") as s:
         assert s is None
+
+
+async def test_wire_context_opens_child_trace():
+    """The propagation contract: wire_context → from_wire yields a child
+    sharing the trace id and origin timestamp, parented on the sender's
+    span id; a malformed/absent context falls back to a fresh root."""
+    root = Trace("req-x", role="frontend")
+    ctx = root.wire_context()
+    assert ctx == {"trace_id": root.trace_id, "parent_span": root.span_id,
+                   "origin_ts": root.origin_ts}
+    child = Trace.from_wire(ctx, "req-x", role="worker")
+    assert child.trace_id == root.trace_id
+    assert child.parent_span == root.span_id
+    assert child.origin_ts == root.origin_ts
+    assert child.span_id != root.span_id
+    # grandchild chains through the child, not the root
+    grand = Trace.from_wire(child.wire_context(), "req-x", role="kv_peer")
+    assert grand.trace_id == root.trace_id
+    assert grand.parent_span == child.span_id
+    # serialization carries the stitch fields + origin offset
+    d = child.to_dict()
+    assert d["trace_id"] == root.trace_id
+    assert d["parent_span"] == root.span_id
+    assert d["origin_offset_ms"] >= 0
+    # degenerate inputs never fail a request
+    assert Trace.from_wire(None, "r").parent_span is None
+    assert Trace.from_wire({}, "r").parent_span is None
+    assert TraceContext.from_dict({"parent_span": "zz"}) is None
+
+
+async def test_log_sampling_counts_dropped_lines(caplog):
+    """Satellite: at fleet QPS one INFO line per request is log-spam.
+    log_every=N logs every Nth; slow/errored traces ALWAYS log; skips
+    feed the dropped_log_lines counter behind
+    nv_llm_trace_dropped_log_lines_total."""
+    import logging
+    t = Tracer(keep=16, log_every=3, slow_ms=1000.0)
+    with caplog.at_level(logging.INFO, logger="dynamo_tpu.trace"):
+        for i in range(6):
+            t.finish(Trace(f"s-{i}"))
+    lines = [r for r in caplog.records if "trace s-" in r.message]
+    assert len(lines) == 2              # every 3rd of 6
+    assert t.dropped_log_lines == 4
+    # errored traces bypass sampling
+    caplog.clear()
+    with caplog.at_level(logging.INFO, logger="dynamo_tpu.trace"):
+        err = Trace("s-err")
+        err.set_error("boom")
+        t.finish(err)
+    assert any("s-err" in r.message for r in caplog.records)
+    assert t.dropped_log_lines == 4     # unchanged
+    # a slow trace bypasses sampling too
+    caplog.clear()
+    slow = Trace("s-slow")
+    slow.start -= 2.0                   # fake 2s of latency
+    with caplog.at_level(logging.INFO, logger="dynamo_tpu.trace"):
+        t.finish(slow)
+    assert any("s-slow" in r.message for r in caplog.records)
+    # live retune (the --trace-log-every path)
+    t.configure(log_every=1)
+    assert t.log_every == 1
+
+
+async def test_finish_hooks_receive_trace_dicts():
+    """on_finish hooks are the publication path (TracePublisher); a
+    failing hook must not break finish."""
+    t = Tracer(keep=4)
+    got = []
+    t.on_finish.append(got.append)
+    t.on_finish.append(lambda d: 1 / 0)      # hostile hook
+    tr = Trace("hooked")
+    tr.event("mark")
+    t.finish(tr)
+    assert len(got) == 1 and got[0]["request_id"] == "hooked"
+    assert got[0]["spans"][0]["name"] == "mark"
 
 
 async def test_http_request_produces_trace(tiny_model_dir, aiohttp_client=None):
@@ -115,6 +194,13 @@ async def test_distributed_roundtrip_traces_both_sides(caplog):
         assert sides == {"frontend", "worker"}
         front = [t for t in tracer.find(rid) if t["role"] == "frontend"][0]
         work = [t for t in tracer.find(rid) if t["role"] == "worker"][0]
+        # ISSUE 7 tentpole: the control message carried the TraceContext,
+        # so the worker trace is a CHILD of the frontend trace — same
+        # trace id, parented on the frontend's span — not a disjoint root
+        assert work["trace_id"] == front["trace_id"]
+        assert work["parent_span"] == front["span_id"]
+        assert work["origin_ts"] == front["origin_ts"]
+        assert work["origin_offset_ms"] >= 0
         assert any(s["name"] == "egress" for s in front["spans"])
         wnames = [s["name"] for s in work["spans"]]
         assert {"engine.accept", "dial_back", "respond",

@@ -1,0 +1,254 @@
+"""ISSUE 7 acceptance: fleet-wide distributed tracing, device-deep.
+
+A two-worker loopback request that takes a kv_fabric remote-fetch path
+must yield ONE collector-assembled trace tree — frontend, router
+(egress), decode-worker, and peer-fetch spans under a single trace id —
+exported as valid Chrome-trace-event JSON, with TTFT/ITL histogram
+exemplars referencing that trace id. Builds on the test_kv_fabric
+loopback harness (worker A holds the prefix on disk; worker B serves
+the request over the REAL request plane and fetches the prefix over the
+kv_fabric RPC)."""
+
+import asyncio
+import json
+
+import pytest
+
+from dynamo_tpu.llm.kv.fabric import KvFabric
+from dynamo_tpu.runtime.tracing import Trace, tracer, use_trace
+
+pytestmark = [pytest.mark.asyncio, pytest.mark.tracing]
+
+PATH = "dyn://fleettrace/worker/generate"
+
+
+def _mcfg():
+    from dynamo_tpu.engine.config import ModelConfig
+    return ModelConfig(vocab_size=128, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=2, head_dim=16,
+                       max_position_embeddings=256)
+
+
+def _make_core(disk_dir, **kw):
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import EngineCore
+    kw = {"max_model_len": 64, "kv_block_size": 4, "num_kv_blocks": 32,
+          "max_num_seqs": 2, "prefill_buckets": [32, 64],
+          "host_kv_blocks": 16, "kv_disk_dir": str(disk_dir),
+          "kv_disk_blocks": 32, **kw}
+    return EngineCore(_mcfg(), EngineConfig(**kw), attn_impl="xla",
+                      param_dtype=jnp.float32)
+
+
+async def _serve_direct(core, prompt, rid, max_new=4):
+    from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineRequest
+    from dynamo_tpu.engine.sampling import SlotSampling
+    req = EngineRequest(rid=rid, prompt=list(prompt),
+                        sampling=SlotSampling(temperature=0.0),
+                        max_new_tokens=max_new, eos_ids=frozenset())
+    await core.submit(req)
+    toks = []
+    while True:
+        item, _ = await asyncio.wait_for(req.out_queue.get(), 60)
+        if item is FINISH_SENTINEL:
+            return toks
+        toks.append(int(item))
+
+
+class _CoreTokenEngine:
+    """Minimal request-plane adapter: JSON {token_ids, max_tokens} →
+    EngineCore stream (the worker side of the acceptance path)."""
+
+    def __init__(self, core):
+        self.core = core
+
+    async def generate(self, request):
+        from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineRequest
+        from dynamo_tpu.engine.sampling import SlotSampling
+        from dynamo_tpu.runtime.engine import ResponseStream
+        d = request.data
+        req = EngineRequest(rid=request.id, prompt=list(d["token_ids"]),
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=int(d.get("max_tokens", 4)),
+                            eos_ids=frozenset(), ctx=request.ctx)
+        await self.core.submit(req)
+
+        async def gen():
+            while True:
+                item, _ = await req.out_queue.get()
+                if item is FINISH_SENTINEL:
+                    return
+                yield {"token": int(item)}
+
+        return ResponseStream(gen(), request.ctx)
+
+
+@pytest.fixture
+async def daemon():
+    from dynamo_tpu.runtime.server import DiscoveryServer
+    srv = DiscoveryServer(host="127.0.0.1")
+    await srv.start()
+    yield srv
+    await srv.close()
+
+
+async def test_fleet_trace_tree_through_kv_fabric_fetch(tmp_path, daemon):
+    from dynamo_tpu.components.metrics import MetricsAggregatorService
+    from dynamo_tpu.components.trace_collector import wire_trace_publisher
+    from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+    from dynamo_tpu.runtime import Context
+    from dynamo_tpu.runtime.distributed import DistributedRuntime, Endpoint
+    from dynamo_tpu.runtime.engine import EngineContext
+
+    prompt = list(range(1, 13))            # 3 full blocks (bs=4)
+
+    # ---- seed worker A's disk with the prefix, then restart it warm
+    core_cold = _make_core(tmp_path / "a")
+    ref_toks = await _serve_direct(core_cold, prompt, "cold")
+    await core_cold.stop()                 # flush host → disk
+    assert len(core_cold.disk_store) >= 2
+
+    core_a = _make_core(tmp_path / "a")
+    rt_a = await DistributedRuntime.connect(daemon.address)
+    fab_a = await KvFabric.attach(core_a, rt_a,
+                                  Endpoint.parse_path(rt_a, PATH))
+    rt_b = rt_fe = rt_m = fab_b = core_b = svc = server_b = pub = None
+    try:
+        wid_a = rt_a.worker_id
+        core_b = _make_core(tmp_path / "b")
+        rt_b = await DistributedRuntime.connect(daemon.address)
+        ep_b = Endpoint.parse_path(rt_b, PATH)
+        fab_b = await KvFabric.attach(core_b, rt_b, ep_b)
+
+        # A announces its disk prefixes over kv_events (router feed)
+        comp_a = rt_a.namespace("fleettrace").component("worker")
+
+        async def sink(ev):
+            await comp_a.publish_event("kv_events", ev)
+
+        core_a.kv_event_publisher = KvEventPublisher(worker_id=wid_a,
+                                                     sink=sink)
+        assert core_a.reannounce_kv() >= 2
+        await core_a.kv_event_publisher.drain()
+        for _ in range(100):
+            if fab_b.store.peer_block_count() >= 2:
+                break
+            await asyncio.sleep(0.05)
+        assert fab_b.store.peer_block_count() >= 2
+
+        # ---- worker B serves the request plane; traces publish over
+        # the SAME component's trace_events subject (all roles share the
+        # process tracer in this loopback, one publisher covers them)
+        server_b = await ep_b.serve(
+            _CoreTokenEngine(core_b),
+            decode_req=lambda raw: json.loads(raw))
+        pub = wire_trace_publisher(comp_a)
+
+        rt_m = await DistributedRuntime.connect(daemon.address)
+        svc = await MetricsAggregatorService(
+            Endpoint.parse_path(rt_m, PATH), scrape_interval=0.2).start()
+
+        # ---- the traced request: frontend → router egress → worker B
+        # (which fetches the prefix from peer A over the fabric RPC)
+        rt_fe = await DistributedRuntime.connect(daemon.address)
+        client = Endpoint.parse_path(rt_fe, PATH).client()
+        await client.start()
+        await client.wait_for_instances(10)
+
+        rid = "fleet-traced-req"
+        with use_trace(Trace(rid, role="frontend")) as ftrace:
+            stream = await client.generate(
+                Context({"token_ids": prompt, "max_tokens": 4},
+                        ctx=EngineContext(rid)))
+            toks = [d["token"] async for d in stream]
+        assert toks == ref_toks            # fabric path, bit-exact
+        assert core_b.remote_onboards == 1
+        assert fab_b.peer_fetches_total >= 1
+        tid = ftrace.trace_id
+
+        # ---- ONE collector-assembled tree under the single trace id
+        # (wait until the frontend ROOT and at least worker + peer landed
+        # — publication is async per process)
+        for _ in range(100):
+            t = svc.collector.tree(tid)
+            if (t is not None and t["n_processes"] >= 3
+                    and t["root"] is not None
+                    and t["root"].get("role") == "frontend"):
+                break
+            await asyncio.sleep(0.05)
+        tree = svc.collector.tree(tid)
+        assert tree is not None, "collector never assembled the tree"
+        assert tree["request_id"] == rid
+        assert {"frontend", "worker", "kv_peer"} <= set(tree["roles"])
+
+        # parent/child span edges: frontend → decode worker → peer fetch
+        root = tree["root"]
+        assert root["role"] == "frontend"
+        # the router leg is the frontend's egress span, tagged with the
+        # chosen instance
+        egress = [s for s in root["spans"] if s["name"] == "egress"]
+        assert egress and egress[0]["attrs"]["path"] == PATH
+        worker = [c for c in root["children"] if c["role"] == "worker"]
+        assert worker, "decode-worker trace not a child of the frontend"
+        worker = worker[0]
+        assert worker["parent_span"] == root["span_id"]
+        wnames = [s["name"] for s in worker["spans"]]
+        assert "engine.queue_wait" in wnames       # engine phase spans
+        assert "kv.onboard" in wnames              # tier-hit breakdown
+        assert "first_response" in wnames
+        onboard = [s for s in worker["spans"]
+                   if s["name"] == "kv.onboard"][0]
+        assert onboard["attrs"]["remote_blocks"] >= 2
+        assert onboard["attrs"]["fabric_fetch_ms"] > 0
+        peer = [c for c in worker["children"] if c["role"] == "kv_peer"]
+        assert peer, "peer-fetch trace not a child of the decode worker"
+        peer = peer[0]
+        assert peer["parent_span"] == worker["span_id"]
+        assert any(s["name"] == "fabric.fetch" for s in peer["spans"])
+
+        # monotonic stage offsets on the origin timeline
+        assert root["origin_offset_ms"] == 0.0
+        assert 0 <= worker["origin_offset_ms"]
+        assert worker["origin_offset_ms"] <= peer["origin_offset_ms"]
+
+        # ---- valid Chrome-trace-event JSON (Perfetto-loadable shape)
+        pf = json.loads(json.dumps(svc.collector.perfetto(tid)))
+        assert pf["traceEvents"]
+        slices = [e for e in pf["traceEvents"] if e["ph"] == "X"]
+        assert all({"name", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+                   for e in slices)
+        cats = {e.get("cat") for e in slices}
+        assert {"frontend", "worker", "kv_peer"} <= cats
+        assert any(e["name"] == "fabric.fetch" for e in slices)
+
+        # ---- TTFT/ITL histogram exemplars reference THIS trace id
+        om = svc.render_openmetrics().decode()
+        ttft_lines = [ln for ln in om.splitlines()
+                      if ln.startswith("nv_llm_trace_ttft_seconds_bucket")
+                      and f'trace_id="{tid}"' in ln]
+        assert ttft_lines, "no TTFT exemplar referencing the trace id"
+        assert any(
+            ln.startswith("nv_llm_trace_itl_seconds_bucket")
+            and f'trace_id="{tid}"' in ln for ln in om.splitlines()), \
+            "no ITL exemplar referencing the trace id"
+
+        # the flight recorder saw the dispatches that served this request
+        kinds = {r["kind"] for r in core_b.flight.dump()}
+        assert {"prefill", "onboard", "decode"} <= kinds
+    finally:
+        if pub is not None:
+            pub.close()
+        if svc is not None:
+            await svc.close()
+        for fab in (fab_b, fab_a):
+            if fab is not None:
+                await fab.close()
+        if core_b is not None:
+            await core_b.stop()
+        await core_a.stop()
+        for rt in (rt_fe, rt_m, rt_b, rt_a):
+            if rt is not None:
+                await rt.shutdown()
