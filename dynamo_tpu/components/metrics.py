@@ -255,6 +255,10 @@ class MetricsAggregatorService:
                 f"{worker_id:x}")
 
     async def _scrape_loop(self) -> None:
+        # long-lived task: shed whatever ambient trace the spawning
+        # context carried (runtime/tracing.py detach_trace contract)
+        from ..runtime.tracing import detach_trace
+        detach_trace()
         while True:
             try:
                 stats = await self._client.collect_stats()
@@ -353,6 +357,8 @@ class MetricsAggregatorService:
         """Completed trace dicts published by workers/frontends
         (trace_events subject) → the collector's tree store + latency
         histograms (components/trace_collector.py)."""
+        from ..runtime.tracing import detach_trace
+        detach_trace()
         async for msg in self._trace_sub:
             try:
                 self.collector.feed(json.loads(msg.payload))

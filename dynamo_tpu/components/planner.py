@@ -288,6 +288,11 @@ class Planner:
 
     # ---------------------------------------------------------------- loop
     async def _run_loop(self) -> None:
+        # long-lived task: detach the spawning context's ambient trace
+        # (runtime/tracing.py) so scrape/actuate RPC spans never attach
+        # to whatever request started the planner
+        from ..runtime.tracing import detach_trace
+        detach_trace()
         while True:
             try:
                 await self._evaluate_once()
@@ -463,6 +468,8 @@ class Planner:
         }
 
     async def _status_loop(self) -> None:
+        from ..runtime.tracing import detach_trace
+        detach_trace()
         key = status_key(self.endpoint.namespace)
         lease = await self.runtime.primary_lease()
         while True:

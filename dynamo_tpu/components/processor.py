@@ -50,8 +50,8 @@ async def amain(argv=None) -> None:
     name = args.model_name or os.path.basename(
         os.path.normpath(args.model_path))
     runtime = await DistributedRuntime.connect(args.runtime_server)
-    mdc = ModelDeploymentCard.from_local_path(args.model_path,
-                                              display_name=name)
+    mdc = await asyncio.to_thread(ModelDeploymentCard.from_local_path,
+                                  args.model_path, display_name=name)
     endpoint = Endpoint.parse_path(runtime, args.endpoint)
     engine = await KvRoutedEngine.start(endpoint,
                                         block_size=args.kv_block_size)

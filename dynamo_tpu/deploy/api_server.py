@@ -27,7 +27,7 @@ from typing import Optional
 from aiohttp import web
 
 from .spec import (SPEC_PREFIX, STATUS_PREFIX, DeploymentSpec,
-                   DeploymentStatus, update_spec, validate_spec)
+                   update_spec, validate_spec)
 
 logger = logging.getLogger("dynamo_tpu.deploy.api")
 

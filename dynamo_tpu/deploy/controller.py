@@ -158,6 +158,10 @@ class DeploymentController:
 
     # ----------------------------------------------------------- reconciling
     async def _reconcile_loop(self) -> None:
+        # long-lived task: detach the spawning context's ambient trace
+        # (runtime/tracing.py detach_trace contract)
+        from ..runtime.tracing import detach_trace
+        detach_trace()
         while not self._stopping:
             try:
                 await asyncio.wait_for(self._dirty.wait(),

@@ -2660,10 +2660,16 @@ class EngineCore:
             n = req.registered_blocks
             pinned = req.blocks[:n]
             self.kv_manager.pool.hold(pinned)
-            self.offload_engine.enqueue(OffloadJob(
-                block_ids=list(pinned),
-                seq_hashes=list(req.seq.sequence_hashes[:n]),
-                tokens_hashes=list(req.seq.block_hashes[:n])))
+            try:
+                self.offload_engine.enqueue(OffloadJob(
+                    block_ids=list(pinned),
+                    seq_hashes=list(req.seq.sequence_hashes[:n]),
+                    tokens_hashes=list(req.seq.block_hashes[:n])))
+            except Exception:
+                # a failed enqueue must not strand the extra hold — the
+                # pump only releases holds for jobs it actually received
+                self.kv_manager.pool.release(pinned)
+                raise
         if self.recorder is not None and req.blocks:
             self.recorder.rec("release", rid=req.rid,
                               blocks=list(req.blocks))

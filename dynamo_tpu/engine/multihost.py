@@ -88,9 +88,7 @@ import socket
 import struct
 import time
 from collections import OrderedDict
-from typing import List, Optional
-
-import numpy as np
+from typing import List
 
 from .replay import Recorder
 

@@ -10,7 +10,6 @@ sampled ids and chosen logprobs leave HBM).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

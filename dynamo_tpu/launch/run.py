@@ -24,7 +24,7 @@ import json
 import logging
 import os
 import sys
-from typing import Optional, Tuple
+from typing import Tuple
 
 logger = logging.getLogger("dynamo_tpu.launch")
 
@@ -256,7 +256,8 @@ async def build_engine(args, out: str, runtime):
         from ..llm.engines.echo import EchoEngineCore
         if not args.model_path:
             raise SystemExit("out=echo_core needs --model-path (tokenizer)")
-        mdc = ModelDeploymentCard.from_local_path(
+        mdc = await asyncio.to_thread(
+            ModelDeploymentCard.from_local_path,
             args.model_path, display_name=_model_name(args))
         return EchoEngineCore(), mdc, None
     if out.startswith("pystr:") or out.startswith("pytok:"):
@@ -270,7 +271,8 @@ async def build_engine(args, out: str, runtime):
             return PythonFileEngineFull(path, engine_args), None, None
         if not args.model_path:
             raise SystemExit("out=pytok needs --model-path (tokenizer)")
-        mdc = ModelDeploymentCard.from_local_path(
+        mdc = await asyncio.to_thread(
+            ModelDeploymentCard.from_local_path,
             args.model_path, display_name=_model_name(args))
         return PythonFileEngineCore(path, engine_args), mdc, None
     if out.startswith("dyn://") or out.count(".") == 2:
@@ -284,7 +286,8 @@ async def build_engine(args, out: str, runtime):
         from ..llm.engines.jax_engine import JaxEngine
         if not args.model_path:
             raise SystemExit("out=jax needs --model-path")
-        mdc = ModelDeploymentCard.from_local_path(
+        mdc = await asyncio.to_thread(
+            ModelDeploymentCard.from_local_path,
             args.model_path, display_name=_model_name(args))
         core = build_jax_core(args)
         engine = JaxEngine(core)
@@ -340,7 +343,8 @@ async def run_follower_rank(args, out: str) -> None:
     from ..engine.multihost import connect_follower, run_follower
     core = build_jax_core(args)
     host = args.leader_addr.rsplit(":", 1)[0]
-    sock = connect_follower(f"{host}:{args.dispatch_stream_port}")
+    sock = await asyncio.to_thread(
+        connect_follower, f"{host}:{args.dispatch_stream_port}")
     logger.info("follower rank %d/%d replaying the leader dispatch stream",
                 args.node_rank, args.num_nodes)
     stats = await asyncio.to_thread(run_follower, core, sock)
@@ -413,8 +417,17 @@ async def run_batch(args, pipeline, path: str) -> None:
     out_path = args.output_path or (path.rsplit(".jsonl", 1)[0] + ".out.jsonl")
     done = 0
     failed = 0
-    with open(path) as fin, open(out_path, "w") as fout:
-        for line in fin:
+
+    def _read_lines() -> list:
+        with open(path) as fin:
+            return fin.readlines()
+
+    # file reads/writes ride to_thread so generation on this loop (e.g. a
+    # co-located in-process engine) keeps stepping during the I/O
+    lines = await asyncio.to_thread(_read_lines)
+    fout = await asyncio.to_thread(open, out_path, "w")
+    try:
+        for line in lines:
             line = line.strip()
             if not line:
                 continue
@@ -430,14 +443,18 @@ async def run_batch(args, pipeline, path: str) -> None:
                     req["temperature"] = d["temperature"]
                 stream = await pipeline.generate(Context(req))
                 text = await collect_chat_text(stream)
-                fout.write(json.dumps({**d, "response": text}) + "\n")
+                out_line = json.dumps({**d, "response": text}) + "\n"
             except json.JSONDecodeError as e:
                 failed += 1
-                fout.write(json.dumps({"input": line, "error": str(e)}) + "\n")
+                out_line = json.dumps({"input": line,
+                                       "error": str(e)}) + "\n"
             except Exception as e:  # noqa: BLE001 — per-row isolation
                 failed += 1
-                fout.write(json.dumps({**d, "error": str(e)}) + "\n")
+                out_line = json.dumps({**d, "error": str(e)}) + "\n"
+            await asyncio.to_thread(fout.write, out_line)
             done += 1
+    finally:
+        await asyncio.to_thread(fout.close)
     level = logging.WARNING if failed else logging.INFO
     logger.log(level, "batch complete: %d requests (%d failed) → %s",
                done, failed, out_path)
@@ -660,7 +677,11 @@ async def amain(argv=None) -> None:
         # NAME is fetched into the local cache; a directory passes through)
         from ..llm.hub import HubError, fetch_model
         try:
-            args.model_path = fetch_model(args.model_path)
+            # hub download + manifest validation is bulk file I/O — keep
+            # it off the loop even at startup (a co-located server on
+            # this loop would stall behind a 70B snapshot check)
+            args.model_path = await asyncio.to_thread(
+                fetch_model, args.model_path)
         except HubError as e:
             raise SystemExit(str(e))
 

@@ -15,7 +15,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
-from typing import Optional, Set
+from typing import Set
 
 from ...runtime.distributed import Client, Endpoint
 from ...runtime.engine import AsyncEngine, ManyOut, SingleIn
@@ -98,6 +98,10 @@ class KvRoutedEngine(AsyncEngine):
                 logger.exception("bad kv event dropped")
 
     async def _scrape_loop(self) -> None:
+        # long-lived task: detach the spawning context's ambient trace
+        # (runtime/tracing.py detach_trace contract)
+        from ...runtime.tracing import detach_trace
+        detach_trace()
         while True:
             try:
                 stats = await self.client.collect_stats()

@@ -117,6 +117,8 @@ async def publish_status_loop(core, runtime, namespace: str,
     """Standing task: publish this worker's tier snapshot (llmctl kv
     status reads it; components/metrics.py scrapes the same numbers off
     ForwardPassMetrics — this key is the human/CLI view)."""
+    from ...runtime.tracing import detach_trace
+    detach_trace()
     while True:
         try:
             await runtime.store.kv_put(kv_status_key(namespace),
@@ -131,7 +133,9 @@ async def watch_control_loop(core, runtime, namespace: str) -> None:
     a monotonically fresh nonce so re-delivered watches are idempotent;
     ``clear`` drops the disk cache instead of persisting into it."""
     from ...runtime.kvstore import WatchEventType
+    from ...runtime.tracing import detach_trace
 
+    detach_trace()
     key = kv_control_key(namespace)
     seen: Optional[float] = None
 

@@ -521,10 +521,16 @@ class KvFabric:
         self.links.observe_transfer(worker_id, sum(len(b) for b in blobs),
                                     time.monotonic() - t0)
         self.peer_fetches_total += 1
-        blocks = [unpack_block_bytes(b)[0] for b in blobs]
-        return {k: np.ascontiguousarray(
-                    np.stack([b[k] for b in blocks], axis=2))
-                for k in blocks[0]}
+
+        def unpack_all():
+            # npz decode + stack is bulk CPU work — decode keeps stepping
+            # on this loop while the fetched run is unpacked off-thread
+            blocks = [unpack_block_bytes(b)[0] for b in blobs]
+            return {k: np.ascontiguousarray(
+                        np.stack([b[k] for b in blocks], axis=2))
+                    for k in blocks[0]}
+
+        return await asyncio.to_thread(unpack_all)
 
     def fetch_sync(self, worker_id: int, seq_hashes: Sequence[int],
                    trace_ctx: Optional[dict] = None) -> dict:

@@ -559,48 +559,61 @@ class KvBlockManager:
             disk_hashes = self.disk_store.match_prefix(
                 matchable[len(hit_blocks) + len(host_slots):], pin=True)
         remote_hashes: List[int] = []
-        if self.enable_reuse and self.remote_store is not None:
-            # G4 cascade: the run past the disk hits, reachable through
-            # the fleet fabric (peer disk over RPC, or the shared object
-            # store). The store's match is admission-gated — it reports
-            # a miss when the modeled fetch loses to recompute — and
-            # pin=True holds object-held entries against the capacity
-            # reaper until the admission's off-thread read completes.
-            remote_hashes = self.remote_store.match_prefix(
-                matchable[len(hit_blocks) + len(host_slots)
-                          + len(disk_hashes):], pin=True)
-        total_needed = (len(prompt) + extra_blocks * self.block_size
-                        + self.block_size - 1) // self.block_size
-        n_new = total_needed - len(hit_blocks)
-        new_blocks = self.pool.alloc_uninit(n_new)
-        if new_blocks is None:
+        # Everything between the pin-taking disk match above and the
+        # returned plan (which transfers pin ownership to the caller)
+        # runs under an except-all: an unexpected raise — a buggy remote
+        # store, a native-pool ABI error in alloc_uninit — must release
+        # the device holds and tier pins before propagating, or the
+        # engine slot leaks spill-pump victims forever (dynalint DL003,
+        # PR 5's runtime assert made static for exception edges too).
+        try:
+            if self.enable_reuse and self.remote_store is not None:
+                # G4 cascade: the run past the disk hits, reachable
+                # through the fleet fabric (peer disk over RPC, or the
+                # shared object store). The store's match is
+                # admission-gated — it reports a miss when the modeled
+                # fetch loses to recompute — and pin=True holds
+                # object-held entries against the capacity reaper until
+                # the admission's off-thread read completes.
+                remote_hashes = self.remote_store.match_prefix(
+                    matchable[len(hit_blocks) + len(host_slots)
+                              + len(disk_hashes):], pin=True)
+            total_needed = (len(prompt) + extra_blocks * self.block_size
+                            + self.block_size - 1) // self.block_size
+            n_new = total_needed - len(hit_blocks)
+            new_blocks = self.pool.alloc_uninit(n_new)
+            if new_blocks is None:
+                self.pool.release(hit_blocks)
+                if disk_hashes:
+                    self.disk_store.unpin(disk_hashes)
+                if remote_hashes:
+                    self.remote_store.unpin(remote_hashes)
+                return None
+            if len(new_blocks) < (len(host_slots) + len(disk_hashes)
+                                  + len(remote_hashes)):
+                # the onboard path scatters host/disk/remote hits into
+                # new_blocks[:n_onboard] — a plan where the allocation
+                # can't cover the pinned tier hits would silently DROP
+                # them (or scatter past the allocation). The cascade
+                # math above guarantees this never happens; if a tier's
+                # match_prefix over-returns (a buggy store), fail loudly
+                # instead of serving garbage. The except below releases
+                # every hold so the loud failure doesn't also leak pool
+                # refcounts / tier pins.
+                self.pool.release(new_blocks)
+                raise RuntimeError(
+                    f"prepare_prefill invariant violated: "
+                    f"{len(new_blocks)} new blocks cannot cover "
+                    f"{len(host_slots)} host + {len(disk_hashes)} disk "
+                    f"+ {len(remote_hashes)} remote tier hits (prompt "
+                    f"{len(prompt)}, device hits {len(hit_blocks)})")
+        except Exception:
             self.pool.release(hit_blocks)
             if disk_hashes:
                 self.disk_store.unpin(disk_hashes)
             if remote_hashes:
                 self.remote_store.unpin(remote_hashes)
-            return None
-        if len(new_blocks) < (len(host_slots) + len(disk_hashes)
-                              + len(remote_hashes)):
-            # the onboard path scatters host/disk/remote hits into
-            # new_blocks[:n_onboard] — a plan where the allocation can't
-            # cover the pinned tier hits would silently DROP them (or
-            # scatter past the allocation). The cascade math above
-            # guarantees this never happens; if a tier's match_prefix
-            # over-returns (a buggy store), fail loudly instead of
-            # serving garbage. Release every hold first so the loud
-            # failure doesn't also leak pool refcounts / tier pins.
-            self.pool.release(hit_blocks + new_blocks)
-            if disk_hashes:
-                self.disk_store.unpin(disk_hashes)
-            if remote_hashes:
-                self.remote_store.unpin(remote_hashes)
-            raise RuntimeError(
-                f"prepare_prefill invariant violated: {len(new_blocks)} "
-                f"new blocks cannot cover {len(host_slots)} host + "
-                f"{len(disk_hashes)} disk + {len(remote_hashes)} remote "
-                f"tier hits (prompt {len(prompt)}, device hits "
-                f"{len(hit_blocks)})")
+            raise
         return PrefillPlan(hit_blocks=hit_blocks, new_blocks=new_blocks,
                            hit_tokens=hit_tokens, seq=seq,
                            host_slots=host_slots, disk_hashes=disk_hashes,

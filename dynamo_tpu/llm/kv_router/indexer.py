@@ -105,6 +105,8 @@ class RadixIndexNative:
             ctypes.c_size_t, ctypes.c_int]
         lib.dyn_kv_index_node_count.restype = ctypes.c_size_t
         lib.dyn_kv_index_node_count.argtypes = [ctypes.c_void_p]
+        lib.dyn_kv_index_event_count.restype = ctypes.c_uint64
+        lib.dyn_kv_index_event_count.argtypes = [ctypes.c_void_p]
         lib.dyn_kv_index_set_expiration.argtypes = [ctypes.c_void_p,
                                                     ctypes.c_double]
         lib.dyn_kv_index_find_matches2.restype = ctypes.c_size_t
@@ -170,6 +172,11 @@ class RadixIndexNative:
     def node_count(self) -> int:
         return int(self._lib.dyn_kv_index_node_count(self._ptr))
 
+    def event_count(self) -> int:
+        """Events applied (stored/removed/remove_worker) since creation —
+        the staleness/liveness stat the router status surface reads."""
+        return int(self._lib.dyn_kv_index_event_count(self._ptr))
+
 
 # ---------------------------------------------------------------------------
 # Python fallback (same semantics)
@@ -196,6 +203,7 @@ class RadixIndexPython:
         if expiration_s is not None and expiration_s <= 0:
             expiration_s = None
         self.expiration_s = expiration_s
+        self._event_count = 0    # mirrors RadixIndex::event_count
 
     def _find(self, h: Optional[int]) -> Optional[_PyNode]:
         if not h:
@@ -203,6 +211,7 @@ class RadixIndexPython:
         return self._by_hash.get(h)
 
     def apply_stored(self, worker_id, parent_hash, block_hashes) -> None:
+        self._event_count += 1
         node = self._find(parent_hash) or self._root
         for h in block_hashes:
             child = node.children.get(h)
@@ -224,6 +233,7 @@ class RadixIndexPython:
             node = parent
 
     def apply_removed(self, worker_id, block_hashes) -> None:
+        self._event_count += 1
         for h in block_hashes:
             node = self._by_hash.get(h)
             if node is None:
@@ -237,6 +247,7 @@ class RadixIndexPython:
     def remove_worker(self, worker_id) -> None:
         # mirror the native tree exactly: snapshot hash values, then detach
         # via the flat map's current holder (kv_radix_index.cpp remove_worker)
+        self._event_count += 1
         nodes = self._worker_nodes.pop(worker_id, set())
         hashes = []
         for node in nodes:
@@ -283,6 +294,10 @@ class RadixIndexPython:
         def cnt(n: _PyNode) -> int:
             return 1 + sum(cnt(c) for c in n.children.values())
         return cnt(self._root) - 1
+
+    def event_count(self) -> int:
+        """Events applied — mirrors RadixIndexNative.event_count."""
+        return self._event_count
 
 
 def make_radix_index(prefer_native: bool = True,

@@ -13,7 +13,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import List, Optional, Sequence, Union
+from typing import List, Optional, Sequence
 
 try:
     from tokenizers import Tokenizer as _HFTokenizer

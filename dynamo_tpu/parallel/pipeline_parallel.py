@@ -66,7 +66,7 @@ window flag depends on the GLOBAL layer index; statics are per-slice).
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -18,7 +18,6 @@ the classic ring-attention latency-hiding schedule).
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

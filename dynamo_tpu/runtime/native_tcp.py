@@ -76,7 +76,9 @@ class NativeStreamSender:
     async def connect(cls, info: ConnectionInfo,
                       error: Optional[str] = None,
                       timeout: float = 10.0) -> "NativeStreamSender":
-        lib = load_data_plane_lib()
+        # first call may g++-compile the data plane — off the loop
+        # (memoized afterwards; tcp.open_stream_sender does the same)
+        lib = await asyncio.to_thread(load_data_plane_lib)
         if lib is None:
             raise RuntimeError("native data plane unavailable")
         host, port = info.address.rsplit(":", 1)

@@ -17,7 +17,7 @@ from __future__ import annotations
 import abc
 from typing import Generic, TypeVar
 
-from .engine import AsyncEngine, Context, ManyOut, SingleIn
+from .engine import AsyncEngine, ManyOut, SingleIn
 
 Tin = TypeVar("Tin")
 Tmid = TypeVar("Tmid")

@@ -317,7 +317,7 @@ class DiscoveryServer:
 
     async def _recover(self) -> int:
         assert self.wal is not None
-        snap, records = self.wal.load()
+        snap, records = await asyncio.to_thread(self.wal.load)
         if snap is not None:
             await self.store.restore_state(snap.get("store", {}))
             await self.bus.restore_state(snap.get("bus", {}))
@@ -361,8 +361,9 @@ class DiscoveryServer:
                 # fold a non-trivial replay immediately: without this the
                 # WAL grows without bound across crash-restart cycles
                 # (each run replays the previous runs' records but never
-                # reaches the in-run snapshot threshold)
-                self._write_snapshot()
+                # reaches the in-run snapshot threshold). No sessions yet,
+                # so the off-thread fold cannot race a wal_append.
+                await asyncio.to_thread(self._write_snapshot)
         # hook AFTER recovery (a replayed lease_revoke must not re-log):
         # every lease drop — explicit revoke or TTL expiry — reaches the
         # WAL, so a crash after an expiry cannot resurrect the dead
@@ -398,7 +399,9 @@ class DiscoveryServer:
             await self._server.wait_closed()
             self._server = None
         if self.wal is not None:
-            self._write_snapshot()        # fold the WAL on graceful exit
+            # fold the WAL on graceful exit; sessions are closed above,
+            # so no wal_append can race the off-thread fold
+            await asyncio.to_thread(self._write_snapshot)
             self.wal.close()
         await self.store.close()
 

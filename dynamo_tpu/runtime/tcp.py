@@ -38,7 +38,9 @@ async def open_stream_sender(info: "ConnectionInfo",
     connection failures propagate identically for both paths."""
     if os.environ.get("DYN_NATIVE_DATAPLANE", "1") != "0":
         from .native_tcp import NativeStreamSender, load_data_plane_lib
-        if load_data_plane_lib() is not None:
+        # first use may g++-compile csrc/data_plane.cpp — off the loop
+        # (memoized, so the hop is a dict hit afterwards)
+        if await asyncio.to_thread(load_data_plane_lib) is not None:
             return await NativeStreamSender.connect(info, error=error,
                                                     timeout=timeout)
     return await StreamSender.connect(info, error=error, timeout=timeout)

@@ -276,8 +276,8 @@ async def amain(argv=None) -> None:
     graph = entry.graph()
     logger.info("deploying graph: %s", " → ".join(s.name for s in graph))
 
-    cfg = (ServiceConfig.from_yaml(args.config) if args.config
-           else ServiceConfig())
+    cfg = (await asyncio.to_thread(ServiceConfig.from_yaml, args.config)
+           if args.config else ServiceConfig())
 
     daemon = None
     runtime_server = args.runtime_server

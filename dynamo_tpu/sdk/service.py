@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import dataclasses
 import inspect
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 __all__ = ["service", "dynamo_endpoint", "async_on_start", "depends",
            "Depends", "DynamoService"]

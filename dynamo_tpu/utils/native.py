@@ -21,34 +21,88 @@ _LOCK = threading.Lock()
 _CACHE: dict = {}
 
 
-def _build(name: str, sources: list, extra_flags: Optional[list] = None) -> str:
+# sanitizer build mode (csrc differential-fuzz hardening): the env knob
+# DYN_NATIVE_SANITIZE selects instrumented builds — "asan", "ubsan", or
+# "asan,ubsan". Sanitized objects land next to the normal ones under a
+# distinct name (lib<name>.asan.so) so the two build flavors never
+# clobber each other's mtime caching. NOTE: dlopen'ing an ASan build
+# into a non-ASan python requires LD_PRELOAD of libasan — the sanitized
+# smoke test (tests/test_native_sanitize.py) runs its fuzz round in a
+# subprocess with the preload set; in-process load() of an asan build
+# without the preload fails and falls back to Python cleanly.
+_SAN_FLAGS = {
+    "asan": ["-fsanitize=address", "-fno-omit-frame-pointer", "-g", "-O1"],
+    "ubsan": ["-fsanitize=undefined", "-fno-sanitize-recover=undefined",
+              "-g", "-O1"],
+}
+
+
+def sanitize_mode() -> Optional[str]:
+    """Normalized DYN_NATIVE_SANITIZE value ("asan", "ubsan",
+    "asan,ubsan") or None. Unknown tokens are rejected loudly — a typo'd
+    knob silently building uninstrumented would defeat the fuzz ride."""
+    raw = os.environ.get("DYN_NATIVE_SANITIZE", "").strip()
+    if not raw or raw == "0":
+        return None
+    modes = sorted({m.strip() for m in raw.split(",") if m.strip()})
+    for m in modes:
+        if m not in _SAN_FLAGS:
+            raise ValueError(
+                f"DYN_NATIVE_SANITIZE={raw!r}: unknown sanitizer {m!r} "
+                f"(supported: {sorted(_SAN_FLAGS)})")
+    return ",".join(modes)
+
+
+def _build(name: str, sources: list, extra_flags: Optional[list] = None,
+           sanitize: Optional[str] = None) -> str:
     os.makedirs(_BUILD_DIR, exist_ok=True)
-    out = os.path.join(_BUILD_DIR, f"lib{name}.so")
+    tag = "" if not sanitize else "." + sanitize.replace(",", "-")
+    out = os.path.join(_BUILD_DIR, f"lib{name}{tag}.so")
     srcs = [os.path.join(_CSRC, s) for s in sources]
     newest_src = max(os.path.getmtime(s) for s in srcs)
     if os.path.exists(out) and os.path.getmtime(out) >= newest_src:
         return out
+    san_flags = [f for m in (sanitize.split(",") if sanitize else [])
+                 for f in _SAN_FLAGS[m]]
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", out,
-           *srcs, *(extra_flags or [])]
+           *srcs, *san_flags, *(extra_flags or [])]
     logger.info("building native lib: %s", " ".join(cmd))
     subprocess.run(cmd, check=True, capture_output=True, text=True)
     return out
 
 
+def build(name: str, sources: list,
+          extra_flags: Optional[list] = None,
+          sanitize: Optional[str] = None) -> Optional[str]:
+    """Build without dlopen'ing (the sanitized-fuzz harness builds in the
+    parent and loads in an LD_PRELOADed subprocess). Returns the .so path
+    or None when the toolchain is missing/fails."""
+    try:
+        return _build(name, sources, extra_flags, sanitize=sanitize)
+    except (subprocess.CalledProcessError, OSError) as e:
+        detail = getattr(e, "stderr", "") or str(e)
+        logger.warning("native build %s failed (%s)", name,
+                       detail.strip()[:500])
+        return None
+
+
 def load(name: str, sources: list,
          extra_flags: Optional[list] = None) -> Optional[ctypes.CDLL]:
-    """Build (if stale) and dlopen csrc/<sources> as lib<name>.so.
-    Returns None when the toolchain or build fails."""
+    """Build (if stale) and dlopen csrc/<sources> as lib<name>.so —
+    instrumented per DYN_NATIVE_SANITIZE when set. Returns None when the
+    toolchain or build fails."""
+    sanitize = sanitize_mode()
+    key = (name, sanitize)
     with _LOCK:
-        if name in _CACHE:
-            return _CACHE[name]
+        if key in _CACHE:
+            return _CACHE[key]
         try:
-            path = _build(name, sources, extra_flags)
+            path = _build(name, sources, extra_flags, sanitize=sanitize)
             lib = ctypes.CDLL(path)
         except (subprocess.CalledProcessError, OSError) as e:
             detail = getattr(e, "stderr", "") or str(e)
             logger.warning("native lib %s unavailable (%s); using Python "
                            "fallback", name, detail.strip()[:500])
             lib = None
-        _CACHE[name] = lib
+        _CACHE[key] = lib
         return lib

@@ -9,7 +9,7 @@ how long the consumer waits).
 from __future__ import annotations
 
 import asyncio
-from typing import AsyncIterator, Optional, TypeVar
+from typing import AsyncIterator, TypeVar
 
 T = TypeVar("T")
 

@@ -1,0 +1,494 @@
+"""dynalint test suite (tier-1, `lint` marker).
+
+Three layers:
+1. seeded-violation fixtures — every rule must FIRE on its seeded bug
+   and stay silent on the clean twin (the analyzer's own regression
+   harness);
+2. the repo-wide gate — `run_lint` over the real tree must report ZERO
+   unbaselined findings inside the tier-1 time budget (this is the
+   check that makes dynalint a merge gate rather than a suggestion);
+3. behavior regressions for the real violations this PR fixed
+   (prepare_prefill exception-edge pin release, the event_count mirror).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.dynalint.engine import load_context, run_lint
+from tools.dynalint.rules.dl004_schema import update_lock
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_repo(tmp_path, files):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    return str(tmp_path)
+
+
+def lint_fixture(root, rules, scan_roots=("pkg",), **overrides):
+    ctx = load_context(root, scan_roots=scan_roots, **overrides)
+    findings, suppressed, _ = run_lint(
+        root, rules=rules, ctx=ctx,
+        baseline_path=os.path.join(root, "no-baseline.json"))
+    return findings, suppressed
+
+
+# ---------------------------------------------------------------- DL001
+
+DL001_SRC = """
+import asyncio
+import time
+
+
+def helper():
+    time.sleep(1)           # blocking primitive
+
+
+def offloaded_helper():
+    time.sleep(1)           # same primitive, but only reached off-loop
+
+
+async def bad_direct():
+    data = open("f").read()     # seeded violation: open() on the loop
+    return data
+
+
+async def bad_via_chain():
+    helper()                    # seeded violation: async -> sync -> sleep
+
+
+async def clean():
+    await asyncio.to_thread(offloaded_helper)
+    await asyncio.sleep(0)      # asyncio.sleep is not time.sleep
+"""
+
+
+def test_dl001_fires_and_clean_twin(tmp_path):
+    root = make_repo(tmp_path, {"pkg/app.py": DL001_SRC})
+    findings, _ = lint_fixture(root, ["DL001"])
+    msgs = [f.message for f in findings]
+    assert any("open()" in m and "bad_direct" in m for m in msgs), msgs
+    assert any("time.sleep" in m and "bad_via_chain" in m for m in msgs)
+    # the offloaded helper and asyncio.sleep must NOT fire
+    assert not any("offloaded_helper" in m for m in msgs)
+    assert len(findings) == 2
+
+
+def test_dl001_inline_waiver(tmp_path):
+    src = DL001_SRC.replace(
+        'data = open("f").read()     # seeded violation: open() on the loop',
+        'data = open("f").read()  # dynalint: ok DL001 startup-only read')
+    root = make_repo(tmp_path, {"pkg/app.py": src})
+    findings, suppressed = lint_fixture(root, ["DL001"])
+    assert not any("open()" in f.message for f in findings)
+    assert any("open()" in f.message for f in suppressed)
+
+
+# ---------------------------------------------------------------- DL002
+
+DL002_CV_SRC = """
+import contextvars
+
+_cv = contextvars.ContextVar("x", default=None)
+
+
+def leak(v):
+    _cv.set(v)              # seeded violation: no reset
+
+
+def ok(v):
+    tok = _cv.set(v)
+    try:
+        return 1
+    finally:
+        _cv.reset(tok)
+
+
+def detach():
+    _cv.set(None)           # the cure, not the disease
+"""
+
+DL002_TRACING_SRC = """
+def current_trace():
+    return None
+
+
+def detach_trace():
+    pass
+"""
+
+DL002_TASK_SRC = """
+import asyncio
+
+from .tracing import current_trace, detach_trace
+
+
+async def pump():
+    while True:             # seeded violation: loops + reads ambient,
+        current_trace()     # never detaches
+
+
+async def good_pump():
+    detach_trace()
+    while True:
+        current_trace()
+
+
+def start():
+    loop = asyncio.get_event_loop()
+    loop.create_task(pump())
+    loop.create_task(good_pump())
+"""
+
+
+def test_dl002_token_discipline(tmp_path):
+    root = make_repo(tmp_path, {"pkg/cv.py": DL002_CV_SRC})
+    findings, _ = lint_fixture(root, ["DL002"])
+    assert len(findings) == 1
+    assert findings[0].symbol == "leak:set"
+
+
+def test_dl002_task_detach(tmp_path):
+    root = make_repo(tmp_path, {"pkg/tracing.py": DL002_TRACING_SRC,
+                                "pkg/app.py": DL002_TASK_SRC})
+    findings, _ = lint_fixture(root, ["DL002"])
+    assert len(findings) == 1
+    assert "pump" in findings[0].message
+    assert "good_pump" not in findings[0].message
+
+
+# ---------------------------------------------------------------- DL003
+
+DL003_SRC = """
+def validate(x):
+    return x
+
+
+def leaked(store, hashes):
+    store.pin(hashes)       # seeded violation: pinned, never released,
+    n = len(hashes)         # never handed to an owner (len() is
+    return n                # bookkeeping, not an ownership transfer)
+
+
+def exception_edge(store, hashes):
+    got = store.match_prefix(hashes, pin=True)
+    validate(got)           # can raise -> pins leak on the raise edge
+    store.unpin(got)
+    return len(got)
+
+
+def clean_finally(store, hashes):
+    got = store.match_prefix(hashes, pin=True)
+    try:
+        validate(got)
+    finally:
+        store.unpin(got)
+    return len(got)
+
+
+def clean_transfer(store, hashes, job_cls):
+    store.pin(hashes)
+    return job_cls(pinned=hashes)   # ownership transferred to the job
+"""
+
+
+def test_dl003_fires_and_clean_twins(tmp_path):
+    root = make_repo(tmp_path, {"pkg/pins.py": DL003_SRC})
+    findings, _ = lint_fixture(root, ["DL003"])
+    syms = sorted(f.symbol for f in findings)
+    assert "exception_edge:store.match_prefix:exc" in syms, syms
+    assert "leaked:store.pin" in syms, syms
+    assert not any("clean_finally" in s or "clean_transfer" in s
+                   for s in syms)
+    assert len(findings) == 2
+
+
+# ---------------------------------------------------------------- DL004
+
+DL004_V1 = """
+import dataclasses
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class WireThing:
+    request_id: str
+    blocks: List[int]
+    tier: str = "device"
+"""
+
+# drifted: `tier` type mutated, `blocks` removed, new field w/o default
+DL004_V2 = """
+import dataclasses
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class WireThing:
+    request_id: str
+    tier: int = 0
+    mandatory_new: str
+"""
+
+DL004_BAD_TYPE = """
+import dataclasses
+import socket
+
+
+@dataclasses.dataclass
+class WireThing:
+    request_id: str
+    conn: socket.socket = None
+"""
+
+
+def test_dl004_lock_ritual_and_drift(tmp_path):
+    root = make_repo(tmp_path, {"pkg/proto.py": DL004_V1})
+    overrides = dict(schema_paths=("pkg/proto.py",),
+                     schema_lock_path="lock.json")
+    # no lockfile yet -> the missing-lock finding
+    findings, _ = lint_fixture(root, ["DL004"], **overrides)
+    assert any(f.symbol == "lockfile:missing" for f in findings)
+    # the one-command ritual: generate, then clean
+    ctx = load_context(root, scan_roots=("pkg",), **overrides)
+    update_lock(ctx)
+    findings, _ = lint_fixture(root, ["DL004"], **overrides)
+    assert findings == []
+    # drift the schema: removed field + changed type + defaultless new
+    (tmp_path / "pkg/proto.py").write_text(DL004_V2)
+    findings, _ = lint_fixture(root, ["DL004"], **overrides)
+    syms = {f.symbol for f in findings}
+    assert "WireThing.blocks:removed" in syms, syms
+    assert "WireThing.tier:type-changed" in syms
+    assert "WireThing.mandatory_new:no-default" in syms
+    # ritual again -> clean again
+    ctx = load_context(root, scan_roots=("pkg",), **overrides)
+    update_lock(ctx)
+    findings, _ = lint_fixture(root, ["DL004"], **overrides)
+    assert findings == []
+
+
+def test_dl004_non_json_type(tmp_path):
+    root = make_repo(tmp_path, {"pkg/proto.py": DL004_BAD_TYPE})
+    overrides = dict(schema_paths=("pkg/proto.py",),
+                     schema_lock_path="lock.json")
+    ctx = load_context(root, scan_roots=("pkg",), **overrides)
+    update_lock(ctx)
+    findings, _ = lint_fixture(root, ["DL004"], **overrides)
+    assert any(f.symbol == "WireThing.conn:type" for f in findings)
+
+
+# ---------------------------------------------------------------- DL005
+
+DL005_SRC = """
+import time
+
+import jax
+
+
+@jax.jit
+def bad_clock(x):
+    return x * time.time()      # seeded violation: wall clock in trace
+
+
+@jax.jit
+def good(x, t):
+    return x * t
+
+
+def make_programs():
+    def bad_wrapped(x):
+        import random
+        return x * random.random()   # seeded violation: stdlib random
+    return jax.jit(bad_wrapped)
+"""
+
+
+def test_dl005_fires_and_clean_twin(tmp_path):
+    root = make_repo(tmp_path, {"pkg/kern.py": DL005_SRC})
+    findings, _ = lint_fixture(root, ["DL005"])
+    msgs = [f.message for f in findings]
+    assert any("time.time" in m and "bad_clock" in m for m in msgs), msgs
+    assert any("random" in m and "bad_wrapped" in m for m in msgs)
+    assert not any("good" in f.symbol for f in findings)
+
+
+# ---------------------------------------------------------------- DL006
+
+DL006_CPP = """
+#include <cstdint>
+
+extern "C" {
+
+int64_t abc_add(void* p, int64_t a, int64_t b) { return a + b; }
+
+void abc_stats(void* p, int64_t* out) {
+    out[0] = 1;
+    out[1] = 2;
+}
+
+void abc_orphan(void* p) { }
+
+}  // extern "C"
+"""
+
+DL006_PY = """
+import ctypes
+
+
+def setup(lib):
+    lib.abc_add.restype = ctypes.c_int64
+    lib.abc_add.argtypes = [ctypes.c_void_p, ctypes.c_int64]  # 2 != 3
+    lib.abc_missing.argtypes = [ctypes.c_void_p]
+
+
+def stats(lib, h):
+    buf = (ctypes.c_int64 * 3)()      # C writes out[0..1] -> width 2
+    lib.abc_stats(h, buf)
+    return list(buf)
+"""
+
+
+def test_dl006_mirror_drift(tmp_path):
+    root = make_repo(tmp_path, {"native.cpp": DL006_CPP,
+                                "pkg/wrap.py": DL006_PY})
+    findings, _ = lint_fixture(
+        root, ["DL006"],
+        mirror_pairs=(("native.cpp", "pkg/wrap.py", ("abc_",)),))
+    syms = {f.symbol for f in findings}
+    assert "abc_add:arity" in syms, syms
+    assert "abc_missing:missing-export" in syms
+    assert "abc_orphan:orphan-export" in syms
+    assert "abc_stats:out-buffer" in syms
+
+
+def test_dl006_clean_twin(tmp_path):
+    clean_py = DL006_PY.replace(
+        "[ctypes.c_void_p, ctypes.c_int64]  # 2 != 3",
+        "[ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]"
+    ).replace("    lib.abc_missing.argtypes = [ctypes.c_void_p]\n", ""
+              ).replace("(ctypes.c_int64 * 3)()", "(ctypes.c_int64 * 2)()")
+    clean_cpp = DL006_CPP.replace(
+        "void abc_orphan(void* p) { }\n\n", "")
+    root = make_repo(tmp_path, {"native.cpp": clean_cpp,
+                                "pkg/wrap.py": clean_py})
+    findings, _ = lint_fixture(
+        root, ["DL006"],
+        mirror_pairs=(("native.cpp", "pkg/wrap.py", ("abc_",)),))
+    assert findings == []
+
+
+# ------------------------------------------------------- repo-wide gate
+
+def test_repo_wide_zero_findings():
+    """THE gate: the real tree holds zero unbaselined findings. Every
+    rule runs; waivers/baseline entries are visible in `suppressed` so
+    deferred debt stays countable."""
+    findings, suppressed, stats = run_lint(REPO_ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+    # the gate must fit tier-1: well under the 30s acceptance budget
+    assert stats["elapsed_s"] < 30, stats
+    # sanity: the analyzer actually scanned the tree
+    assert stats["files"] > 100 and stats["functions"] > 1000, stats
+
+
+def test_cli_entrypoint_runs():
+    """`python -m tools.dynalint` is the committed interface (CI and
+    humans share it)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.dynalint", "--rules", "DL006"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_schema_lock_is_current():
+    """The committed lockfile matches the tree — i.e. nobody edited a
+    wire dataclass without running --update-schemas."""
+    from tools.dynalint.rules.dl004_schema import extract_schemas
+    ctx = load_context(REPO_ROOT)
+    current = extract_schemas(ctx)
+    with open(os.path.join(REPO_ROOT,
+                           "tools/dynalint/schemas.lock.json")) as f:
+        locked = json.load(f)
+    assert current == locked, (
+        "wire schemas drifted from the lockfile — if intentional, run "
+        "`python -m tools.dynalint --update-schemas` and commit the diff")
+
+
+# --------------------------------------- behavior regressions (fixes)
+
+class _RecordingDisk:
+    """DiskKvStore-shaped stub: matches the first hash offered, records
+    pin/unpin traffic."""
+
+    def __init__(self):
+        self.pinned = []
+        self.unpinned = []
+
+    def match_prefix(self, hashes, pin=False):
+        hit = list(hashes[:1])
+        if pin:
+            self.pinned.extend(hit)
+        return hit
+
+    def unpin(self, hashes):
+        self.unpinned.extend(hashes)
+
+
+class _ExplodingRemote:
+    def match_prefix(self, hashes, pin=False):
+        raise RuntimeError("buggy remote store")
+
+    def unpin(self, hashes):
+        pass
+
+
+def test_prepare_prefill_releases_pins_on_exception():
+    """The DL003 fix: an unexpected raise mid-cascade (here: a buggy
+    remote store) must release the device holds AND the disk pins taken
+    earlier in the same prepare_prefill call. Before the fix the disk
+    pins leaked and the entries were unevictable forever."""
+    from dynamo_tpu.llm.kv.pool import KvBlockManager
+
+    disk = _RecordingDisk()
+    mgr = KvBlockManager(num_blocks=16, block_size=4,
+                         disk_store=disk, remote_store=_ExplodingRemote(),
+                         prefer_native=False)
+    free_before = mgr.pool.free_blocks
+    with pytest.raises(RuntimeError, match="buggy remote store"):
+        mgr.prepare_prefill(list(range(12)))
+    # every pin taken before the raise was released on the way out
+    assert disk.pinned, "fixture must actually exercise the disk rung"
+    assert disk.unpinned == disk.pinned
+    # and no device block is left held
+    assert mgr.pool.free_blocks == free_before
+
+
+def test_radix_index_event_count_mirror():
+    """The DL006 fix: dyn_kv_index_event_count was exported by the C++
+    index but wrapped by neither twin. Both now expose event_count()
+    with identical semantics (one bump per apply/remove op)."""
+    from dynamo_tpu.llm.kv_router.indexer import (RadixIndexPython,
+                                                  make_radix_index)
+
+    def drive(idx):
+        idx.apply_stored(1, None, [11, 12])
+        idx.apply_stored(2, None, [11])
+        idx.apply_removed(1, [12])
+        idx.remove_worker(2)
+        return idx.event_count()
+
+    assert drive(RadixIndexPython()) == 4
+    native = make_radix_index(prefer_native=True)
+    if type(native).__name__ == "RadixIndexNative":
+        assert drive(native) == 4

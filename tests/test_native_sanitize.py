@@ -1,0 +1,140 @@
+"""ASan/UBSan build mode for the csrc differential-fuzz harness.
+
+The C++ pools are fuzz-locked against their Python twins
+(tests/test_kv_pool.py), but the uninstrumented fuzz only catches
+SEMANTIC drift — a heap overrun that happens to return the right answer
+sails through. This smoke ride builds csrc/kv_reuse_pool.cpp with
+``-fsanitize=address,undefined`` (utils/native.py DYN_NATIVE_SANITIZE
+knob) and drives one differential fuzz round under the instrumented
+library in an LD_PRELOADed subprocess, so memory bugs abort the round
+instead of corrupting silently.
+
+Skips cleanly when the toolchain or sanitizer runtimes are absent (the
+serving container always has g++; minimal CI images may not).
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_FUZZ_DRIVER = """
+import numpy as np
+from dynamo_tpu.llm.kv.blocks import compute_block_hashes
+from dynamo_tpu.llm.kv.native_pool import (NativeKvBlockPool,
+                                           load_native_pool_lib)
+from dynamo_tpu.llm.kv.pool import KvBlockPool
+
+lib = load_native_pool_lib()
+assert lib is not None, "sanitized lib failed to load under LD_PRELOAD"
+
+rng = np.random.default_rng(1337)
+py, cc = KvBlockPool(32), NativeKvBlockPool(32, lib=lib)
+hashes = compute_block_hashes(list(range(400)), 4)
+held = []
+for step in range(800):
+    op = int(rng.integers(0, 5))
+    if op == 0:
+        n = int(rng.integers(1, 5))
+        a, b = py.alloc_uninit(n), cc.alloc_uninit(n)
+        assert a == b, step
+        if a:
+            held.extend(a)
+    elif op == 1 and held:
+        i = int(rng.integers(0, len(held)))
+        j = int(rng.integers(0, len(hashes)))
+        parent = hashes[j - 1] if j else None
+        py.register(held[i], hashes[j], j, parent)
+        cc.register(held[i], hashes[j], j, parent)
+    elif op == 2 and held:
+        k = int(rng.integers(1, len(held) + 1))
+        py.release(held[:k])
+        cc.release(held[:k])
+        del held[:k]
+    elif op == 3:
+        j = int(rng.integers(1, len(hashes)))
+        a, b = py.match_prefix(hashes[:j]), cc.match_prefix(hashes[:j])
+        assert a == b, step
+        held.extend(a)
+    else:
+        j = int(rng.integers(1, len(hashes)))
+        assert py.peek_prefix(hashes[:j]) == cc.peek_prefix(hashes[:j])
+    assert py.free_blocks == cc.free_blocks, step
+    assert py.reusable_blocks == cc.reusable_blocks, step
+# exercise the out-buffer ABIs under the sanitizer too
+assert cc.refcounts(held[:8]) == py.refcounts(held[:8])
+cc._layout_stats()
+py.reset()
+cc.reset()
+assert py.free_blocks == cc.free_blocks
+print("SAN_FUZZ_OK")
+"""
+
+
+def _san_runtime(name: str):
+    """Path of the sanitizer runtime .so, or None when the toolchain
+    can't name one (gcc echoes the bare name back when not found)."""
+    try:
+        out = subprocess.run(["gcc", f"-print-file-name={name}"],
+                             capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    path = out.stdout.strip()
+    return path if os.path.sep in path and os.path.exists(path) else None
+
+
+def test_sanitized_differential_fuzz_round():
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    libasan, libubsan = _san_runtime("libasan.so"), _san_runtime(
+        "libubsan.so")
+    if libasan is None or libubsan is None:
+        pytest.skip("sanitizer runtimes not installed")
+
+    from dynamo_tpu.utils import native
+    so = native.build("kv_reuse_pool", ["kv_reuse_pool.cpp"],
+                      sanitize="asan,ubsan")
+    if so is None:
+        pytest.skip("sanitized build failed (toolchain without asan)")
+
+    env = dict(os.environ)
+    env.update({
+        "LD_PRELOAD": f"{libasan} {libubsan}",
+        "DYN_NATIVE_SANITIZE": "asan,ubsan",
+        # python itself is not leak-clean; we want memory ERRORS, and
+        # they must fail the round loudly
+        "ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1",
+        "UBSAN_OPTIONS": "halt_on_error=1",
+        "PYTHONPATH": REPO_ROOT + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    proc = subprocess.run([sys.executable, "-c", _FUZZ_DRIVER],
+                          cwd=REPO_ROOT, env=env, capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 0, (
+        f"sanitized fuzz round failed\nstdout:\n{proc.stdout[-2000:]}\n"
+        f"stderr:\n{proc.stderr[-4000:]}")
+    assert "SAN_FUZZ_OK" in proc.stdout
+
+
+def test_sanitize_mode_knob():
+    """The env knob parses strictly: unknown sanitizers are rejected
+    loudly instead of silently building uninstrumented."""
+    from dynamo_tpu.utils import native
+    old = os.environ.pop("DYN_NATIVE_SANITIZE", None)
+    try:
+        assert native.sanitize_mode() is None
+        os.environ["DYN_NATIVE_SANITIZE"] = "ubsan,asan"
+        assert native.sanitize_mode() == "asan,ubsan"   # normalized order
+        os.environ["DYN_NATIVE_SANITIZE"] = "msan"
+        with pytest.raises(ValueError, match="unknown sanitizer"):
+            native.sanitize_mode()
+    finally:
+        os.environ.pop("DYN_NATIVE_SANITIZE", None)
+        if old is not None:
+            os.environ["DYN_NATIVE_SANITIZE"] = old

@@ -1,0 +1,34 @@
+"""dynalint: repo-native static analysis for the invariants this codebase
+polices by hand.
+
+Dynamo's Rust core gets its engine-loop, ownership, and wire-contract
+invariants checked at compile time; the Python reproduction re-states the
+same rules in comments and catches violations at runtime (the flight
+recorder's loop-lag probe, prepare_prefill's loud assert, the differential
+fuzzer). dynalint rejects those bug classes before merge:
+
+- DL001  blocking call reachable from an ``async def`` without a
+         ``to_thread``/executor hop (the engine-loop stall class)
+- DL002  contextvar leak: ambient-trace ``.set()`` without a paired
+         reset, and long-lived tasks that read the ambient trace
+         without detaching at entry (the PR-7 engine-loop bug)
+- DL003  pin/hold balance: every pin acquisition reaches a release on
+         all paths including exception edges (PR-5's runtime assert,
+         made static)
+- DL004  wire-schema lock: request/event-plane dataclasses checked
+         against a committed lockfile (append-only evolution,
+         JSON-serializable field types)
+- DL005  jit-boundary purity: functions handed to jax.jit/shard_map/
+         pallas_call must not read wall-clock, stdlib random, or
+         mutate engine state (the recorded-replay determinism contract)
+- DL006  Python<->C++ mirror drift: csrc exported ABI symbols and
+         arities vs their ctypes wrappers (the "mirrored EXACTLY"
+         contract behind the fuzz-locked pools)
+
+Run ``python -m tools.dynalint`` from the repo root. See
+docs/static_analysis.md for the rule catalog and baseline etiquette.
+"""
+
+from .engine import Finding, RepoContext, run_lint  # noqa: F401
+
+__all__ = ["Finding", "RepoContext", "run_lint"]

@@ -1,0 +1,115 @@
+"""CLI: ``python -m tools.dynalint`` from the repo root.
+
+Exit codes: 0 = clean (or suppressed-only), 1 = unbaselined findings,
+2 = usage/config error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+from .engine import (DEFAULT_SCAN_ROOTS, load_context, run_lint,
+                     write_baseline)
+
+
+def _run_ruff(root: str) -> int:
+    """Optional satellite pass: `ruff check` under the curated ruff.toml
+    when the binary exists (the container may not ship it — report and
+    skip cleanly, never fail the lint on a missing tool)."""
+    ruff = shutil.which("ruff")
+    if ruff is None:
+        print("ruff: not installed — skipping the optional ruff pass "
+              "(pip install ruff to enable)")
+        return 0
+    cmd = [ruff, "check", "--config", os.path.join(root, "ruff.toml"),
+           *(os.path.join(root, r) for r in DEFAULT_SCAN_ROOTS
+             if os.path.exists(os.path.join(root, r)))]
+    print(f"ruff: {' '.join(cmd)}")
+    proc = subprocess.run(cmd)
+    return proc.returncode
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.dynalint",
+        description="repo-native static analysis (rule catalog: "
+                    "docs/static_analysis.md)")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="roots to scan (default: dynamo_tpu tools "
+                         "bench.py)")
+    ap.add_argument("--root", default=".",
+                    help="repo root (default: cwd)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids (default: all)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: "
+                         "tools/dynalint/baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current unsuppressed findings as the "
+                         "new baseline (deferral ritual — every entry "
+                         "needs a KNOWN_ISSUES.md pointer)")
+    ap.add_argument("--update-schemas", action="store_true",
+                    help="regenerate tools/dynalint/schemas.lock.json "
+                         "from the current wire dataclasses")
+    ap.add_argument("--with-ruff", action="store_true",
+                    help="also run `ruff check` under the repo "
+                         "ruff.toml when ruff is installed")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root)
+    if not os.path.isdir(root):
+        print(f"error: not a directory: {root}", file=sys.stderr)
+        return 2
+
+    if args.update_schemas:
+        from .rules.dl004_schema import update_lock
+        ctx = load_context(root)
+        path = update_lock(ctx)
+        print(f"wire-schema lock regenerated: {path}")
+        print("review the diff — it IS the protocol change record")
+        return 0
+
+    scan_roots = tuple(args.paths) if args.paths else DEFAULT_SCAN_ROOTS
+    rules = args.rules.split(",") if args.rules else None
+    findings, suppressed, stats = run_lint(
+        root, rules=rules, baseline_path=args.baseline,
+        scan_roots=scan_roots)
+
+    if args.write_baseline:
+        path = args.baseline or os.path.join(
+            root, "tools/dynalint/baseline.json")
+        write_baseline(path, findings)
+        print(f"baseline written: {path} ({len(findings)} entries) — "
+              f"fill in the reasons and add KNOWN_ISSUES.md pointers")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.__dict__ for f in findings],
+            "suppressed": [f.__dict__ for f in suppressed],
+            "stats": stats}, indent=1))
+    else:
+        for f in findings:
+            print(f.render())
+        print(f"dynalint: {len(findings)} finding(s), "
+              f"{len(suppressed)} suppressed "
+              f"(waiver/baseline), {stats['files']} files, "
+              f"{stats['functions']} functions, "
+              f"{stats['elapsed_s']}s")
+
+    rc = 1 if findings else 0
+    if args.with_ruff:
+        ruff_rc = _run_ruff(root)
+        rc = rc or (1 if ruff_rc else 0)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

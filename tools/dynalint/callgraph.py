@@ -1,0 +1,378 @@
+"""Module index + lightweight call graph over the repo's Python sources.
+
+Design goals (shared by every dynalint rule):
+
+- stdlib ``ast`` only — no new dependencies;
+- conservative edges: an edge exists only when the target is resolvable
+  with high confidence (same-scope nested function, same-module function,
+  ``self.method`` in the defining class, a repo-internal ``from X import
+  f`` / ``mod.f`` call, or a method name defined by exactly ONE class in
+  the repo). Ambiguity yields NO edge — precision over recall, because a
+  tier-1 gate must hold zero false positives;
+- offload-aware: a function referenced (not called) as an argument to
+  ``asyncio.to_thread`` / ``loop.run_in_executor`` / ``Thread(target=…)``
+  / ``executor.submit`` runs OFF the event loop, so no call edge is
+  created from the enclosing (async) function;
+- constructor calls (``SomeClass(…)``) create no edges: ``__init__``
+  chains are overwhelmingly startup-time and would drown the async
+  reachability analysis in engine-construction noise.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+# callables whose function-valued argument runs off the event loop
+_OFFLOADERS = {"to_thread", "run_in_executor", "submit", "Thread",
+               "start_new_thread", "run_sync_in_worker_thread"}
+
+# module aliases that never resolve to repo code (pruned before the
+# unique-method fallback can mistake e.g. ``np.load`` for a repo method)
+_EXTERNAL_MODULES = {
+    "os", "sys", "io", "json", "time", "math", "struct", "socket",
+    "asyncio", "subprocess", "threading", "logging", "contextlib",
+    "dataclasses", "functools", "itertools", "collections", "typing",
+    "numpy", "np", "jax", "jnp", "ctypes", "base64", "random", "secrets",
+    "heapq", "bisect", "shutil", "tempfile", "signal", "uuid", "enum",
+    "re", "pickle", "hashlib", "urllib", "http", "gzip", "pathlib",
+    "inspect", "traceback", "warnings", "errno", "stat", "string",
+    "textwrap", "argparse", "xxhash", "ml_dtypes",
+}
+
+
+@dataclasses.dataclass
+class CallSite:
+    node: ast.Call
+    lineno: int
+    # dotted text of the callee, e.g. "self.pool.release", "np.load"
+    text: str
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    fid: str                       # "relpath::Class.name" / "relpath::name"
+    path: str                      # repo-relative source path
+    module: "ModuleInfo"
+    name: str
+    qualname: str                  # Class.name or outer.<locals>.name
+    node: ast.AST
+    is_async: bool
+    cls_name: Optional[str] = None
+    parent_fid: Optional[str] = None
+    calls: List[CallSite] = dataclasses.field(default_factory=list)
+    # bare-Name references handed to an offloader (run off-loop)
+    offloaded_refs: Set[str] = dataclasses.field(default_factory=set)
+    # nested function names defined directly in this function's body
+    nested: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def lineno(self) -> int:
+        return self.node.lineno
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    name: str
+    path: str
+    bases: List[str]
+    methods: Dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str                      # repo-relative path
+    dotted: Optional[str]          # "dynamo_tpu.llm.kv.pool" when a package
+    tree: ast.Module
+    source: str
+    lines: List[str]
+    # import alias -> dotted module ("np" -> "numpy")
+    imports: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # from-import: local name -> (dotted module, original name)
+    from_imports: Dict[str, Tuple[str, str]] = dataclasses.field(
+        default_factory=dict)
+    functions: Dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = dataclasses.field(default_factory=dict)
+
+
+def dotted_text(node: ast.expr) -> Optional[str]:
+    """``a.b.c`` for Name/Attribute chains, None for anything fancier."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _FuncCollector(ast.NodeVisitor):
+    """Collects direct calls + offloaded references for ONE function body,
+    without descending into nested function/lambda bodies (those become
+    their own FuncInfo nodes)."""
+
+    def __init__(self, info: FuncInfo):
+        self.info = info
+        self._root = info.node
+
+    def _collect(self) -> None:
+        for stmt in self._root.body:
+            self.visit(stmt)
+
+    def visit_FunctionDef(self, node):            # noqa: N802
+        return  # nested: separate node
+
+    def visit_AsyncFunctionDef(self, node):       # noqa: N802
+        return
+
+    def visit_Lambda(self, node):                 # noqa: N802
+        return
+
+    def visit_Call(self, node):                   # noqa: N802
+        text = dotted_text(node.func)
+        if text is not None:
+            self.info.calls.append(CallSite(node, node.lineno, text))
+            tail = text.rsplit(".", 1)[-1]
+            if tail in _OFFLOADERS:
+                args = list(node.args)
+                for kw in node.keywords:
+                    args.append(kw.value)
+                for a in args:
+                    if isinstance(a, ast.Name):
+                        self.info.offloaded_refs.add(a.id)
+                    elif isinstance(a, ast.Attribute):
+                        t = dotted_text(a)
+                        if t:
+                            self.info.offloaded_refs.add(t)
+        self.generic_visit(node)
+
+
+class RepoGraph:
+    """Index of every module/class/function plus on-demand call edges."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.modules: Dict[str, ModuleInfo] = {}        # relpath -> module
+        self.by_dotted: Dict[str, ModuleInfo] = {}      # dotted -> module
+        self.funcs: Dict[str, FuncInfo] = {}            # fid -> info
+        self.method_index: Dict[str, List[FuncInfo]] = {}  # name -> methods
+        self.func_index: Dict[str, List[FuncInfo]] = {}    # name -> module fns
+
+    # ------------------------------------------------------------- loading
+    def add_source(self, relpath: str, source: str) -> Optional[ModuleInfo]:
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            return None
+        dotted = None
+        if relpath.endswith(".py"):
+            dotted = relpath[:-3].replace(os.sep, ".").replace("/", ".")
+            if dotted.endswith(".__init__"):
+                dotted = dotted[: -len(".__init__")]
+        mod = ModuleInfo(path=relpath, dotted=dotted, tree=tree,
+                         source=source, lines=source.splitlines())
+        self._collect_imports(mod)
+        self._collect_defs(mod)
+        self.modules[relpath] = mod
+        if dotted:
+            self.by_dotted[dotted] = mod
+        return mod
+
+    def _collect_imports(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                base = self._resolve_from(mod, node)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    mod.from_imports[a.asname or a.name] = (base, a.name)
+
+    def _resolve_from(self, mod: ModuleInfo, node: ast.ImportFrom) -> str:
+        if node.level == 0:
+            return node.module or ""
+        pkg_parts = (mod.dotted or "").split(".")
+        # level 1 = current package; strip the module's own name first
+        pkg_parts = pkg_parts[: -node.level]
+        if node.module:
+            pkg_parts.append(node.module)
+        return ".".join(p for p in pkg_parts if p)
+
+    def _collect_defs(self, mod: ModuleInfo) -> None:
+        def add_func(node, qualname, cls_name=None, parent_fid=None):
+            fid = f"{mod.path}::{qualname}"
+            info = FuncInfo(
+                fid=fid, path=mod.path, module=mod, name=node.name,
+                qualname=qualname, node=node,
+                is_async=isinstance(node, ast.AsyncFunctionDef),
+                cls_name=cls_name, parent_fid=parent_fid)
+            _FuncCollector(info)._collect()
+            self.funcs[fid] = info
+            if parent_fid and parent_fid in self.funcs:
+                self.funcs[parent_fid].nested[node.name] = fid
+            # recurse into directly-nested defs (shallow walk stops at
+            # nested scopes, so each def is added exactly once)
+            for stmt in _shallow_descendants(node):
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    add_func(stmt, f"{qualname}.<locals>.{stmt.name}",
+                             cls_name=cls_name, parent_fid=fid)
+            return info
+
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info = add_func(node, node.name)
+                mod.functions[node.name] = info
+                self.func_index.setdefault(node.name, []).append(info)
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(name=node.name, path=mod.path,
+                               bases=[dotted_text(b) or "" for b in
+                                      node.bases])
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        fi = add_func(item, f"{node.name}.{item.name}",
+                                      cls_name=node.name)
+                        ci.methods[item.name] = fi
+                        self.method_index.setdefault(item.name,
+                                                     []).append(fi)
+                mod.classes[node.name] = ci
+
+def _shallow_descendants(node: ast.AST) -> Iterable[ast.AST]:
+    """All descendants of ``node`` that are not inside a nested function/
+    class scope."""
+    out = []
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        out.append(n)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def shallow_walk(node: ast.AST) -> Iterable[ast.AST]:
+    """Public alias: descendants excluding nested scopes."""
+    return _shallow_descendants(node)
+
+
+# --------------------------------------------------------------------------
+# edge resolution
+# --------------------------------------------------------------------------
+
+
+def resolve_call(graph: RepoGraph, func: FuncInfo, call: CallSite,
+                 union: bool = False) -> List[FuncInfo]:
+    """Resolve one call site to repo FuncInfos (possibly empty).
+
+    ``union=False`` (default): high-confidence only — ambiguous method
+    names resolve to NOTHING. ``union=True``: ambiguous method names
+    resolve to EVERY repo method of that name (recall mode, used by
+    reachability queries where over-approximation is the safe side).
+    """
+    text = call.text
+    mod = func.module
+    parts = text.split(".")
+
+    if len(parts) == 1:
+        name = parts[0]
+        # nested function in the lexical parent chain
+        cur: Optional[FuncInfo] = func
+        while cur is not None:
+            if name in cur.nested:
+                return [graph.funcs[cur.nested[name]]]
+            cur = graph.funcs.get(cur.parent_fid) if cur.parent_fid else None
+        if name in mod.functions:
+            return [mod.functions[name]]
+        if name in mod.from_imports:
+            src_mod, orig = mod.from_imports[name]
+            target = graph.by_dotted.get(src_mod)
+            if target and orig in target.functions:
+                return [target.functions[orig]]
+        return []
+
+    head, meth = parts[0], parts[-1]
+    if head == "self" and len(parts) == 2 and func.cls_name:
+        ci = mod.classes.get(func.cls_name)
+        seen: Set[str] = set()
+        while ci is not None:
+            if meth in ci.methods:
+                return [ci.methods[meth]]
+            seen.add(ci.name)
+            nxt = None
+            for b in ci.bases:
+                bname = b.split(".")[-1]
+                if bname in mod.classes and bname not in seen:
+                    nxt = mod.classes[bname]
+                    break
+                # base imported from a repo module
+                if bname in mod.from_imports:
+                    src_mod, orig = mod.from_imports[bname]
+                    tm = graph.by_dotted.get(src_mod)
+                    if tm and orig in tm.classes and orig not in seen:
+                        nxt = tm.classes[orig]
+                        mod = tm  # continue base walk in that module
+                        break
+            ci = nxt
+        mod = func.module  # restore
+        # fall through to unique-method resolution
+
+    # module-attribute call: alias.f(...) where alias is an import
+    if len(parts) == 2 and head in mod.imports:
+        dotted = mod.imports[head]
+        if dotted.split(".")[0] in _EXTERNAL_MODULES:
+            return []
+        target = graph.by_dotted.get(dotted)
+        if target and meth in target.functions:
+            return [target.functions[meth]]
+        return []
+    if len(parts) == 2 and head in mod.from_imports:
+        src_mod, orig = mod.from_imports[head]
+        dotted = f"{src_mod}.{orig}" if src_mod else orig
+        target = graph.by_dotted.get(dotted)
+        if target and meth in target.functions:
+            return [target.functions[meth]]
+        if dotted.split(".")[0] in _EXTERNAL_MODULES:
+            return []
+
+    if head in _EXTERNAL_MODULES:
+        return []
+
+    # unique-method fallback over the whole repo
+    candidates = graph.method_index.get(meth, [])
+    if len(candidates) == 1:
+        return [candidates[0]]
+    if union and candidates:
+        return list(candidates)
+    return []
+
+
+def async_reachable(graph: RepoGraph) -> Dict[str, List[str]]:
+    """fid -> example call chain (list of fids, async root first) for every
+    SYNC function reachable from an async function without an offload hop.
+    Async functions themselves are roots (chain = [root])."""
+    chains: Dict[str, List[str]] = {}
+    work: List[FuncInfo] = []
+    for f in graph.funcs.values():
+        if f.is_async:
+            chains[f.fid] = [f.fid]
+            work.append(f)
+    while work:
+        cur = work.pop()
+        for call in cur.calls:
+            # a bare call of an offloaded name from the same function is
+            # still on-loop; the offload set only suppresses *references*
+            for target in resolve_call(graph, cur, call):
+                if target.is_async:
+                    continue            # its own root
+                if target.fid in chains:
+                    continue
+                chains[target.fid] = chains[cur.fid] + [target.fid]
+                work.append(target)
+    return chains

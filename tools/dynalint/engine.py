@@ -1,0 +1,223 @@
+"""dynalint driver: repo loading, inline waivers, baseline, rule running.
+
+Silencing a finding (docs/static_analysis.md "baseline etiquette"):
+
+1. Fix it. The default, and the only option for new code.
+2. Inline waiver — a comment ``# dynalint: ok DL001 <reason>`` on the
+   flagged line or the line directly above. For DELIBERATE design choices
+   (e.g. the WAL's fsync-per-commit durability trade) where blocking the
+   loop IS the contract. The reason is mandatory by convention.
+3. Baseline — ``tools/dynalint/baseline.json`` entries keyed
+   (rule, path, symbol), for found-but-deferred debt. Every baseline
+   entry needs a KNOWN_ISSUES.md pointer; the repo-wide tier-1 gate
+   fails on any finding that is neither waived nor baselined.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import json
+import os
+import re
+import time
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .callgraph import RepoGraph
+
+_WAIVER_RE = re.compile(r"#\s*dynalint:\s*ok\s+([A-Z0-9,\s]+?)(?:\s+\S.*)?$")
+
+DEFAULT_SCAN_ROOTS = ("dynamo_tpu", "tools", "bench.py")
+EXCLUDE_PATTERNS = ("*/__pycache__/*", "tools/dynalint/fixtures/*")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str           # repo-relative
+    line: int
+    message: str
+    hint: str = ""
+    # line-stable identity for baselining: the enclosing function/class
+    # qualname (or a rule-chosen token). Baselines match (rule, path,
+    # symbol) so findings survive unrelated line drift.
+    symbol: str = ""
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.symbol or str(self.line))
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        out = f"{loc}: {self.rule} {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+@dataclasses.dataclass
+class RepoContext:
+    """Everything a rule needs. Built once per run; rules are pure
+    functions ``rule(ctx) -> List[Finding]``."""
+
+    root: str
+    graph: RepoGraph
+    # waivers[path] = {lineno: set(rule_ids) or {"*"}}
+    waivers: Dict[str, Dict[int, Set[str]]]
+    # rule-specific configuration (overridable by fixture tests)
+    schema_paths: Sequence[str] = (
+        "dynamo_tpu/runtime/codec.py",
+        "dynamo_tpu/llm/protocols/common.py",
+        "dynamo_tpu/llm/protocols/disagg.py",
+        "dynamo_tpu/llm/protocols/openai.py",
+        "dynamo_tpu/llm/protocols/sse.py",
+        "dynamo_tpu/llm/protocols/annotated.py",
+        "dynamo_tpu/llm/kv_router/protocols.py",
+    )
+    schema_lock_path: str = "tools/dynalint/schemas.lock.json"
+    # (cpp path, wrapper .py path, symbol prefixes) — the mirrored ABIs
+    mirror_pairs: Sequence[Tuple[str, str, Tuple[str, ...]]] = (
+        ("csrc/kv_reuse_pool.cpp", "dynamo_tpu/llm/kv/native_pool.py",
+         ("kvpool_",)),
+        ("csrc/kv_radix_index.cpp", "dynamo_tpu/llm/kv_router/indexer.py",
+         ("dyn_kv_index_",)),
+        ("csrc/data_plane.cpp", "dynamo_tpu/runtime/native_tcp.py",
+         ("dpsend_", "dprecv_")),
+        ("csrc/kv_event_abi.cpp", "dynamo_tpu/llm/kv_router/c_abi.py",
+         ("dynamo_llm_", "dynamo_kv_event_", "dyn_kv_event_",
+          "dyn_kv_abi_")),
+    )
+
+    def read_file(self, relpath: str) -> Optional[str]:
+        p = os.path.join(self.root, relpath)
+        try:
+            with open(p, encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+
+def _collect_waivers(source: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _WAIVER_RE.search(line)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            out[i] = rules or {"*"}
+    return out
+
+
+def _excluded(relpath: str) -> bool:
+    return any(fnmatch.fnmatch(relpath, pat) for pat in EXCLUDE_PATTERNS)
+
+
+def load_context(root: str,
+                 scan_roots: Sequence[str] = DEFAULT_SCAN_ROOTS,
+                 **overrides) -> RepoContext:
+    graph = RepoGraph(root)
+    waivers: Dict[str, Dict[int, Set[str]]] = {}
+    for entry in scan_roots:
+        full = os.path.join(root, entry)
+        if os.path.isfile(full):
+            paths = [entry]
+        else:
+            paths = []
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        paths.append(os.path.relpath(
+                            os.path.join(dirpath, fn), root))
+        for rel in paths:
+            rel = rel.replace(os.sep, "/")
+            if _excluded(rel):
+                continue
+            try:
+                with open(os.path.join(root, rel), encoding="utf-8") as f:
+                    src = f.read()
+            except OSError:
+                continue
+            if graph.add_source(rel, src) is not None:
+                w = _collect_waivers(src)
+                if w:
+                    waivers[rel] = w
+    return RepoContext(root=root, graph=graph, waivers=waivers, **overrides)
+
+
+def is_waived(ctx: RepoContext, finding: Finding) -> bool:
+    file_waivers = ctx.waivers.get(finding.path)
+    if not file_waivers:
+        return False
+    for ln in (finding.line, finding.line - 1):
+        rules = file_waivers.get(ln)
+        if rules and (finding.rule in rules or "*" in rules):
+            return True
+    return False
+
+
+# ------------------------------------------------------------------ baseline
+
+def load_baseline(path: str) -> List[dict]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return []
+    return list(data.get("suppressions", []))
+
+
+def baseline_matches(entry: dict, finding: Finding) -> bool:
+    return (entry.get("rule") == finding.rule
+            and entry.get("path") == finding.path
+            and entry.get("symbol", "") == (finding.symbol or ""))
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    sup = [{"rule": f.rule, "path": f.path, "symbol": f.symbol or "",
+            "reason": "TODO: justify or fix (see docs/static_analysis.md)"}
+           for f in findings]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "suppressions": sup}, f, indent=2)
+        f.write("\n")
+
+
+# --------------------------------------------------------------------- run
+
+def run_lint(root: str,
+             rules: Optional[Sequence[str]] = None,
+             baseline_path: Optional[str] = None,
+             scan_roots: Sequence[str] = DEFAULT_SCAN_ROOTS,
+             ctx: Optional[RepoContext] = None,
+             ) -> Tuple[List[Finding], List[Finding], dict]:
+    """Run the suite. Returns (unsuppressed, suppressed, stats)."""
+    from .rules import ALL_RULES
+
+    t0 = time.monotonic()
+    if ctx is None:
+        ctx = load_context(root, scan_roots=scan_roots)
+    selected = {r.upper() for r in rules} if rules else None
+    findings: List[Finding] = []
+    per_rule: Dict[str, float] = {}
+    for rule_id, rule_fn in ALL_RULES.items():
+        if selected is not None and rule_id not in selected:
+            continue
+        t = time.monotonic()
+        findings.extend(rule_fn(ctx))
+        per_rule[rule_id] = round(time.monotonic() - t, 3)
+
+    baseline = load_baseline(
+        baseline_path if baseline_path is not None
+        else os.path.join(root, "tools/dynalint/baseline.json"))
+    unsuppressed: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        if is_waived(ctx, f) or any(baseline_matches(e, f)
+                                    for e in baseline):
+            suppressed.append(f)
+        else:
+            unsuppressed.append(f)
+    stats = {"files": len(ctx.graph.modules),
+             "functions": len(ctx.graph.funcs),
+             "elapsed_s": round(time.monotonic() - t0, 3),
+             "per_rule_s": per_rule,
+             "suppressed": len(suppressed)}
+    return unsuppressed, suppressed, stats

@@ -1,0 +1,12 @@
+"""Rule registry. Each rule module exposes ``RULE_ID`` and
+``check(ctx) -> List[Finding]``; adding a rule = adding a module here
+(docs/static_analysis.md "adding a rule")."""
+
+from . import (dl001_blocking, dl002_contextvar, dl003_pins, dl004_schema,
+               dl005_jit, dl006_mirror)
+
+ALL_RULES = {
+    m.RULE_ID: m.check
+    for m in (dl001_blocking, dl002_contextvar, dl003_pins, dl004_schema,
+              dl005_jit, dl006_mirror)
+}

@@ -1,0 +1,95 @@
+"""DL001: blocking call reachable from an ``async def`` without an
+off-loop hop.
+
+The invariant this repo polices by hand ("file I/O via to_thread, never
+on the engine loop" — diskstore.py, offload.py) and only *observes* at
+runtime via the flight recorder's loop-lag probe. A blocking primitive —
+file open, fsync, np.savez/np.load, time.sleep, subprocess, a device
+sync — executed on the event loop stalls every in-flight request for its
+duration; at fleet QPS that is a tail-latency incident.
+
+Mechanics: every ``async def`` is a root; call edges (tools/dynalint/
+callgraph.py, conservative resolution) extend reachability through SYNC
+functions only. Functions *referenced* into ``asyncio.to_thread`` /
+``run_in_executor`` / ``Thread(target=…)`` get no edge — that is the
+sanctioned escape hatch. A finding is reported at the blocking call
+site, with one example async→…→call chain.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..callgraph import CallSite, FuncInfo, async_reachable
+from ..engine import Finding, RepoContext
+
+RULE_ID = "DL001"
+
+# dotted-name blocking primitives, keyed by canonical module
+_BLOCKING_BY_MODULE = {
+    "time": {"sleep"},
+    "os": {"fsync"},
+    "subprocess": {"run", "call", "check_call", "check_output", "Popen"},
+    "numpy": {"savez", "savez_compressed", "load", "save"},
+    "jax": {"block_until_ready", "device_get"},
+    "socket": {"create_connection"},
+}
+# attribute-call tails that block regardless of receiver (device syncs)
+_BLOCKING_METHOD_TAILS = {"block_until_ready"}
+
+_HINT = ("run it off-loop: `await asyncio.to_thread(fn, ...)` (or "
+         "loop.run_in_executor), or move the work to a sync context; "
+         "waive deliberate blocking with `# dynalint: ok DL001 <reason>`")
+
+
+def _blocking_desc(func: FuncInfo, call: CallSite) -> Optional[str]:
+    """Human name of the blocking primitive, or None."""
+    text = call.text
+    parts = text.split(".")
+    mod = func.module
+    if len(parts) == 1:
+        if parts[0] == "open" and parts[0] not in mod.from_imports \
+                and parts[0] not in mod.functions:
+            return "open()"
+        # from-imported primitive, e.g. `from time import sleep`
+        if parts[0] in mod.from_imports:
+            src, orig = mod.from_imports[parts[0]]
+            if orig in _BLOCKING_BY_MODULE.get(src, ()):
+                return f"{src}.{orig}"
+        return None
+    head, tail = parts[0], parts[-1]
+    if len(parts) == 2:
+        canonical = mod.imports.get(head, head)
+        if tail in _BLOCKING_BY_MODULE.get(canonical, ()):
+            return f"{canonical}.{tail}"
+    if tail in _BLOCKING_METHOD_TAILS:
+        return f".{tail}()"
+    return None
+
+
+def check(ctx: RepoContext) -> List[Finding]:
+    graph = ctx.graph
+    chains = async_reachable(graph)
+    findings: List[Finding] = []
+    seen: set = set()
+    for fid, chain in chains.items():
+        func = graph.funcs[fid]
+        for call in func.calls:
+            desc = _blocking_desc(func, call)
+            if desc is None:
+                continue
+            key = (func.path, call.lineno, desc)
+            if key in seen:
+                continue
+            seen.add(key)
+            via = " -> ".join(
+                graph.funcs[f].qualname for f in chain)
+            root = graph.funcs[chain[0]]
+            findings.append(Finding(
+                rule=RULE_ID, path=func.path, line=call.lineno,
+                symbol=f"{func.qualname}:{desc}",
+                message=(f"blocking call {desc} runs on the event loop "
+                         f"(reachable from async "
+                         f"`{root.path}::{root.qualname}` via {via})"),
+                hint=_HINT))
+    return findings

@@ -1,0 +1,211 @@
+"""DL002: contextvar leaks around the ambient trace.
+
+Two sub-checks, both grounded in the bug PR 7 fixed in the engine loop
+(runtime/tracing.py `detach_trace` docstring):
+
+(a) token discipline — a ``.set(value)`` on a module-level
+    ``contextvars.ContextVar`` must either set ``None`` (a detach) or
+    capture the token and ``.reset(token)`` it in the same function,
+    with the reset on a ``finally`` edge. An unpaired set leaks the
+    binding into every later task created from that context.
+
+(b) long-lived task detach — an ``async def`` that (1) is spawned via
+    ``create_task`` / ``ensure_future``, (2) loops (``while``/``async
+    for``: it outlives the request whose context spawned it), and
+    (3) transitively reaches an ambient-trace READER
+    (``current_trace`` / ``current_wire_context`` / ``tracing.span`` /
+    ``use_trace``) must call ``detach_trace()`` in its body. Otherwise
+    the FIRST request's trace parents every span the task ever records
+    — the exact mis-attachment the engine loop shipped. Reachability
+    here uses union (recall-mode) method resolution: over-approximating
+    "might read the ambient trace" is the safe side, and the fix — one
+    ``detach_trace()`` at task entry — is always correct for a task
+    that owns no request.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..callgraph import (FuncInfo, dotted_text, resolve_call,
+                         shallow_walk)
+from ..engine import Finding, RepoContext
+
+RULE_ID = "DL002"
+
+_READER_NAMES = {"current_trace", "current_wire_context", "use_trace",
+                 "span"}
+_SPAWNER_TAILS = {"create_task", "ensure_future"}
+_MAX_DEPTH = 5
+
+
+def _module_contextvars(mod) -> Set[str]:
+    """Names bound at module level to ``contextvars.ContextVar(...)``."""
+    out: Set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            callee = dotted_text(value.func) or ""
+            if callee.split(".")[-1] == "ContextVar":
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def _check_token_discipline(ctx: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for mod in ctx.graph.modules.values():
+        cvars = _module_contextvars(mod)
+        if not cvars:
+            continue
+        for func in ctx.graph.funcs.values():
+            if func.module is not mod:
+                continue
+            sets: List[ast.Call] = []
+            resets: List[ast.Call] = []
+            resets_in_finally: bool = False
+            for n in shallow_walk(func.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                t = dotted_text(n.func)
+                if t is None or "." not in t:
+                    continue
+                recv, meth = t.rsplit(".", 1)
+                if recv not in cvars:
+                    continue
+                if meth == "set":
+                    sets.append(n)
+                elif meth == "reset":
+                    resets.append(n)
+            if not sets:
+                continue
+            # is any reset on a finally edge?
+            for try_node in shallow_walk(func.node):
+                if isinstance(try_node, ast.Try):
+                    for stmt in try_node.finalbody:
+                        for n in ast.walk(stmt):
+                            if (isinstance(n, ast.Call)
+                                    and (dotted_text(n.func) or "")
+                                    .endswith(".reset")):
+                                resets_in_finally = True
+            for s in sets:
+                if (s.args and isinstance(s.args[0], ast.Constant)
+                        and s.args[0].value is None):
+                    continue        # detach — the cure, not the disease
+                if resets and resets_in_finally:
+                    continue
+                detail = ("no `.reset(token)` in this function"
+                          if not resets else
+                          "`.reset(token)` is not on a finally edge — "
+                          "an exception leaks the binding")
+                findings.append(Finding(
+                    rule=RULE_ID, path=func.path, line=s.lineno,
+                    symbol=f"{func.qualname}:set",
+                    message=(f"contextvar `.set()` without a paired "
+                             f"reset ({detail}); the binding leaks into "
+                             f"every task created from this context"),
+                    hint=("capture `token = var.set(...)` and "
+                          "`var.reset(token)` in a finally block, or "
+                          "use a contextmanager like tracing.use_trace")))
+    return findings
+
+
+def _spawned_funcs(ctx: RepoContext) -> Set[str]:
+    """Names of functions that appear as ``create_task(<name>(...))``
+    (or ``ensure_future``) anywhere in the repo."""
+    spawned: Set[str] = set()
+    for func in ctx.graph.funcs.values():
+        for call in func.calls:
+            if call.text.rsplit(".", 1)[-1] not in _SPAWNER_TAILS:
+                continue
+            for a in call.node.args:
+                if isinstance(a, ast.Call):
+                    t = dotted_text(a.func)
+                    if t:
+                        spawned.add(t.rsplit(".", 1)[-1])
+    return spawned
+
+
+def _is_reader_call(func: FuncInfo, text: str) -> bool:
+    """True when ``text`` calls one of runtime/tracing.py's ambient-trace
+    readers (resolved through this module's imports, so an arbitrary
+    method that happens to be called ``span`` does not count)."""
+    mod = func.module
+    parts = text.split(".")
+    if len(parts) == 1:
+        entry = mod.from_imports.get(parts[0])
+        return (entry is not None and entry[1] in _READER_NAMES
+                and entry[0].endswith("tracing"))
+    head, tail = parts[0], parts[-1]
+    if tail not in _READER_NAMES:
+        return False
+    dotted = mod.imports.get(head, "")
+    if not dotted and head in mod.from_imports:
+        src, orig = mod.from_imports[head]
+        dotted = f"{src}.{orig}" if src else orig
+    return dotted.endswith("tracing")
+
+
+def _reaches_ambient_reader(ctx: RepoContext, func: FuncInfo,
+                            cache: Dict[str, bool],
+                            depth: int = 0) -> bool:
+    if func.fid in cache:
+        return cache[func.fid]
+    cache[func.fid] = False           # cycle guard
+    if depth > _MAX_DEPTH:
+        return False
+    for call in func.calls:
+        if _is_reader_call(func, call.text):
+            cache[func.fid] = True
+            return True
+        for target in resolve_call(ctx.graph, func, call, union=True):
+            if _reaches_ambient_reader(ctx, target, cache, depth + 1):
+                cache[func.fid] = True
+                return True
+    return False
+
+
+def _has_loop(func: FuncInfo) -> bool:
+    return any(isinstance(n, (ast.While, ast.AsyncFor))
+               for n in shallow_walk(func.node))
+
+
+def _calls_detach(func: FuncInfo) -> bool:
+    return any(c.text.rsplit(".", 1)[-1] == "detach_trace"
+               for c in func.calls)
+
+
+def _check_task_detach(ctx: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+    spawned = _spawned_funcs(ctx)
+    cache: Dict[str, bool] = {}
+    for func in ctx.graph.funcs.values():
+        if not func.is_async or func.name not in spawned:
+            continue
+        if func.path.endswith("runtime/tracing.py"):
+            continue                  # the machinery itself
+        if not _has_loop(func) or _calls_detach(func):
+            continue
+        if not _reaches_ambient_reader(ctx, func, cache):
+            continue
+        findings.append(Finding(
+            rule=RULE_ID, path=func.path, line=func.lineno,
+            symbol=f"{func.qualname}:detach",
+            message=(f"long-lived task `{func.qualname}` loops and "
+                     f"(transitively) reads the ambient trace but never "
+                     f"detaches — it inherits the spawning request's "
+                     f"trace forever and mis-attaches every span"),
+            hint=("call runtime.tracing.detach_trace() at task entry; "
+                  "per-request identity must travel by value "
+                  "(EngineRequest.trace, trace_ctx parameters)")))
+    return findings
+
+
+def check(ctx: RepoContext) -> List[Finding]:
+    return _check_token_discipline(ctx) + _check_task_detach(ctx)

@@ -1,0 +1,216 @@
+"""DL003: pin/hold balance — every pin acquisition reaches a release on
+all paths, including exception edges.
+
+PR 5's ``prepare_prefill`` loud assert made static. Acquisition
+primitives and their matching releases:
+
+    <recv>.hold(blocks)               ->  <recv>.release(...)
+    <recv>.pin(slots|hashes)          ->  <recv>.unpin(...)
+    <recv>.match_prefix(..., pin=True)->  <recv>.unpin(...)
+
+Per-function analysis (the pin receivers are actor-local state, so
+cross-function lifetimes are always ownership transfers):
+
+- OWNERSHIP TRANSFER: the acquisition's bound name (or its argument's
+  root name) escapes — appears in a `return`/`yield` expression, is
+  stored on an attribute, or is handed to another call (e.g. packed
+  into a PrefillPlan / OffloadJob whose consumer releases). Transferred
+  pins are the caller's problem; no local release required.
+- LOCAL LIFETIME: releases exist in this function. Then the exception
+  edge must be covered: if any statement between the acquisition and
+  the first matching release contains a call (= can raise), some
+  matching release must sit in a `finally` or `except` handler —
+  otherwise a raise leaks the pin (the engine slot then holds a
+  spill-pump victim forever).
+- LEAK: no release and no escape — flagged outright.
+
+Tier-wrapper primitives (functions literally named pin/unpin/hold/
+release/match_prefix, which forward to an inner store) are exempt: they
+ARE the primitive, the balance obligation sits with their callers.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional
+
+from ..callgraph import FuncInfo, dotted_text, shallow_walk
+from ..engine import Finding, RepoContext
+
+RULE_ID = "DL003"
+
+_ACQ_RELEASE = {"hold": "release", "pin": "unpin", "match_prefix": "unpin"}
+_WRAPPER_NAMES = {"pin", "unpin", "hold", "release", "match_prefix",
+                  "abort_plan"}
+# read-only builtins: passing the pinned collection through these does
+# NOT transfer ownership (len(pins) is bookkeeping, OffloadJob(pins) is
+# a handoff)
+_PURE_BUILTINS = {"len", "min", "max", "sum", "sorted", "enumerate",
+                  "range", "print", "repr", "str", "int", "bool", "any",
+                  "all", "zip", "iter", "next", "id", "isinstance"}
+
+
+@dataclasses.dataclass
+class _Acq:
+    node: ast.Call
+    lineno: int
+    recv: str                 # receiver text, e.g. "self.disk_store"
+    kind: str                 # hold | pin | match_prefix
+    bound_name: Optional[str]  # x = recv.match_prefix(...)
+    arg_root: Optional[str]    # recv.hold(ids) -> "ids"
+
+
+def _root_name(node: ast.expr) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    if isinstance(node, ast.Call):      # list(pinned) etc.
+        if node.args:
+            return _root_name(node.args[0])
+        return None
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _find_acquisitions(func: FuncInfo) -> List[_Acq]:
+    out: List[_Acq] = []
+    assigns: Dict[int, str] = {}       # id(call node) -> bound name
+    for n in shallow_walk(func.node):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name):
+            assigns[id(n.value)] = n.targets[0].id
+    for n in shallow_walk(func.node):
+        if not isinstance(n, ast.Call):
+            continue
+        text = dotted_text(n.func)
+        if text is None or "." not in text:
+            continue
+        recv, meth = text.rsplit(".", 1)
+        if meth not in _ACQ_RELEASE:
+            continue
+        if meth == "match_prefix":
+            pin_kw = next((kw for kw in n.keywords if kw.arg == "pin"),
+                          None)
+            if pin_kw is None or not (
+                    isinstance(pin_kw.value, ast.Constant)
+                    and pin_kw.value.value is True):
+                continue
+        arg_root = _root_name(n.args[0]) if n.args else None
+        out.append(_Acq(node=n, lineno=n.lineno, recv=recv, kind=meth,
+                        bound_name=assigns.get(id(n)), arg_root=arg_root))
+    return out
+
+
+def _release_calls(func: FuncInfo, recv: str, kind: str) -> List[ast.Call]:
+    want = _ACQ_RELEASE[kind]
+    out = []
+    for n in shallow_walk(func.node):
+        if isinstance(n, ast.Call):
+            text = dotted_text(n.func)
+            if text == f"{recv}.{want}":
+                out.append(n)
+    return out
+
+
+def _in_handler_or_finally(func: FuncInfo, call: ast.Call) -> bool:
+    for n in shallow_walk(func.node):
+        if isinstance(n, ast.Try):
+            for region in (n.finalbody,
+                           [s for h in n.handlers for s in h.body]):
+                for stmt in region:
+                    if any(sub is call for sub in ast.walk(stmt)):
+                        return True
+    return False
+
+
+def _escapes(func: FuncInfo, acq: _Acq) -> bool:
+    names = {n for n in (acq.bound_name, acq.arg_root) if n}
+    if not names:
+        return False
+    release_calls = {id(c) for kind in _ACQ_RELEASE
+                     for c in _release_calls(func, acq.recv, kind)}
+    for n in shallow_walk(func.node):
+        if isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)) \
+                and n.value is not None:
+            for sub in ast.walk(n.value):
+                if isinstance(sub, ast.Name) and sub.id in names:
+                    return True
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Attribute):
+                    for sub in ast.walk(n.value):
+                        if isinstance(sub, ast.Name) and sub.id in names:
+                            return True
+        if isinstance(n, ast.Call) and n is not acq.node \
+                and id(n) not in release_calls \
+                and not (isinstance(n.func, ast.Name)
+                         and n.func.id in _PURE_BUILTINS):
+            for sub in ast.walk(n):
+                if isinstance(sub, ast.Name) and sub.id in names \
+                        and sub is not n.func:
+                    return True
+    # a nested function closing over the name also transfers ownership
+    # (e.g. the off-thread onboard prep closure)
+    graph_names = names
+    for sub in ast.walk(func.node):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)) and sub is not func.node:
+            for inner in ast.walk(sub):
+                if isinstance(inner, ast.Name) and inner.id in graph_names:
+                    return True
+    return False
+
+
+def _calls_between(func: FuncInfo, start_line: int,
+                   end_line: int) -> bool:
+    """Any call strictly between the two lines (shallow scope) — the
+    can-raise approximation."""
+    for n in shallow_walk(func.node):
+        if isinstance(n, ast.Call) and start_line < n.lineno < end_line:
+            return True
+    return False
+
+
+def check(ctx: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for func in ctx.graph.funcs.values():
+        if func.name in _WRAPPER_NAMES:
+            continue
+        acqs = _find_acquisitions(func)
+        for acq in acqs:
+            releases = _release_calls(func, acq.recv, acq.kind)
+            escapes = _escapes(func, acq)
+            if not releases:
+                if escapes:
+                    continue            # ownership transferred
+                findings.append(Finding(
+                    rule=RULE_ID, path=func.path, line=acq.lineno,
+                    symbol=f"{func.qualname}:{acq.recv}.{acq.kind}",
+                    message=(f"`{acq.recv}.{acq.kind}(...)` acquires a "
+                             f"pin that is never released and never "
+                             f"escapes `{func.qualname}` — the entry "
+                             f"stays pinned forever"),
+                    hint=(f"release with `{acq.recv}."
+                          f"{_ACQ_RELEASE[acq.kind]}(...)` on every "
+                          f"path, or hand the pins to an owner that "
+                          f"does")))
+                continue
+            # local lifetime: exception edge must be covered
+            covered = any(_in_handler_or_finally(func, r)
+                          for r in releases)
+            first_rel = min(r.lineno for r in releases)
+            if not covered and _calls_between(func, acq.lineno,
+                                              first_rel):
+                findings.append(Finding(
+                    rule=RULE_ID, path=func.path, line=acq.lineno,
+                    symbol=f"{func.qualname}:{acq.recv}.{acq.kind}:exc",
+                    message=(f"`{acq.recv}.{acq.kind}(...)` is released "
+                             f"on the normal path but a call between "
+                             f"acquisition (line {acq.lineno}) and the "
+                             f"first release (line {first_rel}) can "
+                             f"raise — the exception edge leaks the "
+                             f"pin"),
+                    hint=(f"move the release into a finally/except so "
+                          f"`{acq.recv}.{_ACQ_RELEASE[acq.kind]}` also "
+                          f"runs on the raise path")))
+    return findings

@@ -1,0 +1,271 @@
+"""DL004: wire-schema lock for the request/event-plane dataclasses.
+
+The frontend, workers, and multihost followers exchange JSON payloads
+shaped by the dataclasses in runtime/codec.py, llm/protocols/ and
+llm/kv_router/protocols.py. Those classes all decode defensively
+("defaults keep old payloads decoding", "absent on old senders; ignored
+by old receivers") — but nothing ENFORCES that discipline, so a careless
+edit silently drifts the fleet until a mixed-version deploy starts
+dropping fields. This rule locks the schemas into a committed file
+(tools/dynalint/schemas.lock.json) and fails the lint on:
+
+- a removed class or removed field (old peers still send/expect it);
+- a changed field type (old payloads decode into the wrong shape);
+- a reordered committed-field prefix (positional construction breaks);
+- a NEW field without a default (old payloads stop constructing) —
+  append-only evolution, the same rule the reference enforces with
+  serde defaults;
+- a field type outside the JSON-serializable grammar (primitives,
+  Optional/List/Dict/Union/... over them, schema-set classes, enums).
+  Binary-plane classes (length-prefixed codec frames, device KV
+  payloads) may additionally use ``bytes`` / ``np.ndarray``.
+
+Intentional protocol changes are a one-command ritual:
+``python -m tools.dynalint --update-schemas`` regenerates the lock;
+the diff then documents the protocol change in review.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Set
+
+from ..engine import Finding, RepoContext
+
+RULE_ID = "DL004"
+
+# classes carried by a binary transport (not the JSON request plane):
+# codec frames are length-prefixed byte containers; KV payloads ship
+# device arrays over the dedicated KV stream
+_BINARY_PLANE_EXTRA = {
+    "Frame": {"bytes"},
+    "KvPayload": {"np.ndarray", "ndarray"},
+    "DeviceKvPayload": {"np.ndarray", "ndarray"},
+}
+
+_ALLOWED_ATOMS = {"str", "int", "float", "bool", "dict", "list", "None",
+                  "Any", "object", "Dict", "List", "Tuple", "Sequence"}
+_ALLOWED_WRAPPERS = {"Optional", "Union", "List", "Dict", "Tuple",
+                     "Sequence", "Annotated", "ClassVar"}
+
+
+def _is_dataclass(node: ast.ClassDef) -> bool:
+    for dec in node.decorator_list:
+        text = ast.unparse(dec)
+        if text.split("(")[0].rsplit(".", 1)[-1] == "dataclass":
+            return True
+    return False
+
+
+def _class_fields(node: ast.ClassDef) -> List[dict]:
+    out = []
+    for stmt in node.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target,
+                                                          ast.Name):
+            ann = ast.unparse(stmt.annotation)
+            if ann.startswith("ClassVar"):
+                continue
+            out.append({"name": stmt.target.id, "type": ann,
+                        "has_default": stmt.value is not None})
+    return out
+
+
+def extract_schemas(ctx: RepoContext) -> Dict[str, dict]:
+    """{ClassName: {"path", "fields"}} over the schema files. Also
+    returns enum names via the '__enums__' pseudo-entry consumed by the
+    type checker."""
+    schemas: Dict[str, dict] = {}
+    enums: Set[str] = set()
+    typevars: Set[str] = set()
+    for rel in ctx.schema_paths:
+        mod = ctx.graph.modules.get(rel)
+        if mod is None:
+            continue
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                bases = {ast.unparse(b).rsplit(".", 1)[-1]
+                         for b in node.bases}
+                if bases & {"Enum", "IntEnum", "StrEnum", "Flag"}:
+                    enums.add(node.name)
+                elif _is_dataclass(node):
+                    schemas[node.name] = {"path": rel,
+                                          "fields": _class_fields(node)}
+            elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                             ast.Call):
+                callee = ast.unparse(node.value.func).rsplit(".", 1)[-1]
+                if callee == "TypeVar":
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            typevars.add(t.id)
+    schemas["__enums__"] = {"path": "", "fields": sorted(enums)}
+    schemas["__typevars__"] = {"path": "", "fields": sorted(typevars)}
+    return schemas
+
+
+def _type_leaves(ann: str) -> Optional[List[str]]:
+    """Leaf type names of an annotation, or None when unparseable."""
+    try:
+        tree = ast.parse(ann, mode="eval")
+    except SyntaxError:
+        return None
+    leaves: List[str] = []
+
+    def walk(node: ast.expr) -> None:
+        if isinstance(node, ast.Subscript):
+            head = ast.unparse(node.value).rsplit(".", 1)[-1]
+            if head in _ALLOWED_WRAPPERS or head in _ALLOWED_ATOMS:
+                walk(node.slice)
+            else:
+                leaves.append(ast.unparse(node))
+        elif isinstance(node, ast.Tuple):
+            for e in node.elts:
+                walk(e)
+        elif isinstance(node, (ast.Name, ast.Attribute)):
+            leaves.append(ast.unparse(node))
+        elif isinstance(node, ast.Constant):
+            leaves.append(repr(node.value) if node.value is not None
+                          else "None")
+        elif isinstance(node, ast.BinOp) and isinstance(node.op,
+                                                        ast.BitOr):
+            walk(node.left)
+            walk(node.right)
+        else:
+            leaves.append(ast.unparse(node))
+
+    walk(tree.body)
+    return leaves
+
+
+def _check_types(schemas: Dict[str, dict]) -> List[Finding]:
+    findings: List[Finding] = []
+    known = set(schemas) | set(schemas["__enums__"]["fields"]) \
+        | set(schemas["__typevars__"]["fields"])
+    for cls, info in schemas.items():
+        if cls.startswith("__"):
+            continue
+        extra = _BINARY_PLANE_EXTRA.get(cls, set())
+        for field in info["fields"]:
+            leaves = _type_leaves(field["type"])
+            if leaves is None:
+                continue
+            for leaf in leaves:
+                short = leaf.rsplit(".", 1)[-1]
+                if (leaf in _ALLOWED_ATOMS or short in _ALLOWED_ATOMS
+                        or leaf in known or short in known
+                        or leaf in extra or short in extra):
+                    continue
+                findings.append(Finding(
+                    rule=RULE_ID, path=info["path"], line=1,
+                    symbol=f"{cls}.{field['name']}:type",
+                    message=(f"wire dataclass field `{cls}."
+                             f"{field['name']}: {field['type']}` uses "
+                             f"non-JSON-serializable type `{leaf}` on "
+                             f"the request/event plane"),
+                    hint=("use JSON-able primitives/containers or a "
+                          "schema-set dataclass; binary-plane classes "
+                          "are whitelisted in dl004_schema.py")))
+    return findings
+
+
+def _diff_against_lock(schemas: Dict[str, dict],
+                       lock: Dict[str, dict]) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls, linfo in lock.items():
+        if cls.startswith("__"):
+            continue
+        cur = schemas.get(cls)
+        if cur is None:
+            findings.append(Finding(
+                rule=RULE_ID, path=linfo.get("path", "?"), line=1,
+                symbol=f"{cls}:removed",
+                message=(f"wire dataclass `{cls}` was removed but is "
+                         f"committed in the schema lock — old peers "
+                         f"still speak it"),
+                hint="restore it, or run --update-schemas and document "
+                     "the protocol break"))
+            continue
+        cur_fields = {f["name"]: f for f in cur["fields"]}
+        cur_order = [f["name"] for f in cur["fields"]]
+        lock_order = [f["name"] for f in linfo["fields"]]
+        for lf in linfo["fields"]:
+            cf = cur_fields.get(lf["name"])
+            if cf is None:
+                findings.append(Finding(
+                    rule=RULE_ID, path=cur["path"], line=1,
+                    symbol=f"{cls}.{lf['name']}:removed",
+                    message=(f"field `{cls}.{lf['name']}` was removed "
+                             f"from the wire schema — old payloads "
+                             f"still carry it / old peers still expect "
+                             f"it"),
+                    hint="deprecate in place (keep the field, default "
+                         "it) or --update-schemas with a fleet-upgrade "
+                         "plan"))
+            elif cf["type"] != lf["type"]:
+                findings.append(Finding(
+                    rule=RULE_ID, path=cur["path"], line=1,
+                    symbol=f"{cls}.{lf['name']}:type-changed",
+                    message=(f"field `{cls}.{lf['name']}` changed type "
+                             f"`{lf['type']}` -> `{cf['type']}` — old "
+                             f"payloads decode into the wrong shape"),
+                    hint="add a NEW defaulted field instead of mutating "
+                         "the committed one (append-only evolution)"))
+        # committed fields that survive must keep their relative order
+        # (positional construction across the fleet)
+        surviving = [n for n in lock_order if n in cur_fields]
+        cur_positions = {n: i for i, n in enumerate(cur_order)}
+        if surviving != sorted(surviving, key=lambda n: cur_positions[n]):
+            findings.append(Finding(
+                rule=RULE_ID, path=cur["path"], line=1,
+                symbol=f"{cls}:reordered",
+                message=(f"committed fields of `{cls}` were reordered — "
+                         f"positional construction across fleet "
+                         f"versions breaks"),
+                hint="append new fields AFTER the committed prefix"))
+        # new fields must default (old payloads lack them)
+        committed = set(lock_order)
+        for f in cur["fields"]:
+            if f["name"] not in committed and not f["has_default"]:
+                findings.append(Finding(
+                    rule=RULE_ID, path=cur["path"], line=1,
+                    symbol=f"{cls}.{f['name']}:no-default",
+                    message=(f"new wire field `{cls}.{f['name']}` has "
+                             f"no default — payloads from old senders "
+                             f"stop constructing"),
+                    hint="give it a default (the 'zeros on old "
+                         "payloads' convention) then --update-schemas"))
+    return findings
+
+
+def update_lock(ctx: RepoContext) -> str:
+    schemas = extract_schemas(ctx)
+    path = os.path.join(ctx.root, ctx.schema_lock_path)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(schemas, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def check(ctx: RepoContext) -> List[Finding]:
+    schemas = extract_schemas(ctx)
+    findings = _check_types(schemas)
+    lock_raw = ctx.read_file(ctx.schema_lock_path)
+    if lock_raw is None:
+        findings.append(Finding(
+            rule=RULE_ID, path=ctx.schema_lock_path, line=1,
+            symbol="lockfile:missing",
+            message="wire-schema lockfile is missing",
+            hint="generate it: python -m tools.dynalint --update-schemas"))
+        return findings
+    try:
+        lock = json.loads(lock_raw)
+    except ValueError:
+        findings.append(Finding(
+            rule=RULE_ID, path=ctx.schema_lock_path, line=1,
+            symbol="lockfile:corrupt",
+            message="wire-schema lockfile is not valid JSON",
+            hint="regenerate: python -m tools.dynalint --update-schemas"))
+        return findings
+    findings.extend(_diff_against_lock(schemas, lock))
+    return findings

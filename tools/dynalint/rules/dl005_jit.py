@@ -1,0 +1,197 @@
+"""DL005: jit-boundary purity — functions handed to ``jax.jit`` /
+``shard_map`` / ``pl.pallas_call`` must be deterministic pure traces.
+
+The recorded-replay and multihost-follower machinery depend on every
+compiled program being a pure function of its arguments: a follower
+replays the leader's dispatch stream and must produce bit-identical
+device state. A jitted function that reads wall-clock or stdlib random
+bakes a trace-time value into the compiled program (different per
+process — followers diverge); one that MUTATES engine attributes runs
+the mutation once at trace time and never again (silent state skew).
+
+Flagged inside a jit-target body (and its nested defs):
+
+- wall-clock reads: ``time.time/monotonic/perf_counter/time_ns``,
+  ``datetime.now/utcnow``
+- non-JAX randomness: stdlib ``random.*``, ``np.random.*``,
+  ``secrets.*``, ``uuid.*`` (``jax.random`` with explicit keys is the
+  sanctioned source)
+- environment reads: ``os.environ`` / ``os.getenv`` (trace-time
+  constants that differ across hosts)
+- attribute mutation: assignment/augassign to ``self.X`` or to a
+  ``global`` — trace-time side effects
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..callgraph import FuncInfo, dotted_text, shallow_walk
+from ..engine import Finding, RepoContext
+
+RULE_ID = "DL005"
+
+_JIT_ENTRYPOINTS = {"jit", "shard_map", "pallas_call", "named_call",
+                    "checkpoint", "custom_vjp"}
+_IMPURE_CALLS = {
+    "time": {"time", "monotonic", "perf_counter", "time_ns",
+             "process_time"},
+    "datetime": {"now", "utcnow", "today"},
+    "os": {"getenv"},
+    "secrets": {"token_hex", "token_bytes", "randbits", "choice"},
+    "uuid": {"uuid1", "uuid4"},
+}
+_IMPURE_MODULES = {"random", "secrets", "uuid"}
+
+
+def _jit_targets(ctx: RepoContext) -> List[FuncInfo]:
+    """FuncInfos referenced as the function argument of a jit-like
+    entrypoint: decorators (@jax.jit, @partial(jax.jit, ...)) and direct
+    wrapping calls (jax.jit(f), shard_map(f, ...), pl.pallas_call(k,...))."""
+    out: List[FuncInfo] = []
+    seen: Set[str] = set()
+
+    def add(func: Optional[FuncInfo]) -> None:
+        if func is not None and func.fid not in seen:
+            seen.add(func.fid)
+            out.append(func)
+
+    def resolve_name(enclosing: Optional[FuncInfo], mod, name: str
+                     ) -> Optional[FuncInfo]:
+        cur = enclosing
+        while cur is not None:
+            if name in cur.nested:
+                return ctx.graph.funcs[cur.nested[name]]
+            cur = (ctx.graph.funcs.get(cur.parent_fid)
+                   if cur.parent_fid else None)
+        return mod.functions.get(name)
+
+    for func in ctx.graph.funcs.values():
+        # decorators on the function itself
+        for dec in getattr(func.node, "decorator_list", []):
+            texts = []
+            if isinstance(dec, ast.Call):
+                texts.append(dotted_text(dec.func) or "")
+                texts.extend(dotted_text(a) or "" for a in dec.args)
+            else:
+                texts.append(dotted_text(dec) or "")
+            for t in texts:
+                if t.rsplit(".", 1)[-1] in _JIT_ENTRYPOINTS:
+                    add(func)
+        # wrapping calls inside function bodies
+        for call in func.calls:
+            if call.text.rsplit(".", 1)[-1] not in _JIT_ENTRYPOINTS:
+                continue
+            args = list(call.node.args) + [kw.value
+                                           for kw in call.node.keywords
+                                           if kw.arg in ("f", "fun",
+                                                         "kernel")]
+            for a in args:
+                if isinstance(a, ast.Name):
+                    add(resolve_name(func, func.module, a.id))
+    # module-level wrapping: f_jit = jax.jit(f)
+    for mod in ctx.graph.modules.values():
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                callee = dotted_text(node.value.func) or ""
+                if callee.rsplit(".", 1)[-1] in _JIT_ENTRYPOINTS:
+                    for a in node.value.args:
+                        if isinstance(a, ast.Name):
+                            add(mod.functions.get(a.id))
+    return out
+
+
+def _impure_call_desc(func: FuncInfo, text: str) -> Optional[str]:
+    parts = text.split(".")
+    mod = func.module
+    if len(parts) == 1:
+        entry = mod.from_imports.get(parts[0])
+        if entry and entry[1] in _IMPURE_CALLS.get(entry[0], ()):
+            return f"{entry[0]}.{entry[1]}"
+        return None
+    head = mod.imports.get(parts[0], parts[0])
+    if head in _IMPURE_MODULES:
+        return text
+    if head == "numpy" and len(parts) >= 2 and parts[1] == "random":
+        return text
+    tail = parts[-1]
+    if tail in _IMPURE_CALLS.get(head, ()):
+        return f"{head}.{tail}"
+    # datetime.datetime.now()
+    if head == "datetime" and tail in _IMPURE_CALLS["datetime"]:
+        return text
+    return None
+
+
+def _check_body(ctx: RepoContext, func: FuncInfo,
+                findings: List[Finding]) -> None:
+    for call in func.calls:
+        desc = _impure_call_desc(func, call.text)
+        if desc:
+            findings.append(Finding(
+                rule=RULE_ID, path=func.path, line=call.lineno,
+                symbol=f"{func.qualname}:{desc}",
+                message=(f"jit-boundary impurity: `{desc}` inside "
+                         f"jitted `{func.qualname}` bakes a trace-time "
+                         f"value into the compiled program (followers/"
+                         f"replay diverge)"),
+                hint=("pass the value in as an argument, or use "
+                      "jax.random with an explicit threaded key")))
+        if call.text == "os.environ.get" or call.text.startswith(
+                "os.environ"):
+            findings.append(Finding(
+                rule=RULE_ID, path=func.path, line=call.lineno,
+                symbol=f"{func.qualname}:environ",
+                message=(f"jit-boundary impurity: environment read "
+                         f"inside jitted `{func.qualname}` is a "
+                         f"trace-time constant that differs across "
+                         f"hosts"),
+                hint="thread it through as a static argument"))
+    for n in shallow_walk(func.node):
+        if isinstance(n, ast.Global):
+            findings.append(Finding(
+                rule=RULE_ID, path=func.path, line=n.lineno,
+                symbol=f"{func.qualname}:global",
+                message=(f"jit-boundary impurity: `global` mutation in "
+                         f"jitted `{func.qualname}` runs once at trace "
+                         f"time, never per step"),
+                hint="return the value instead of mutating state"))
+        targets = []
+        if isinstance(n, ast.Assign):
+            targets = n.targets
+        elif isinstance(n, ast.AugAssign):
+            targets = [n.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute):
+                root = t
+                while isinstance(root, (ast.Attribute, ast.Subscript)):
+                    root = root.value
+                if isinstance(root, ast.Name) and root.id == "self":
+                    findings.append(Finding(
+                        rule=RULE_ID, path=func.path, line=n.lineno,
+                        symbol=f"{func.qualname}:self-mutation",
+                        message=(f"jit-boundary impurity: `self` "
+                                 f"attribute mutation in jitted "
+                                 f"`{func.qualname}` happens at trace "
+                                 f"time only — silent state skew"),
+                        hint="hoist the mutation out of the traced "
+                             "function"))
+
+
+def check(ctx: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+    visited: Set[str] = set()
+    for target in _jit_targets(ctx):
+        stack = [target]
+        while stack:
+            f = stack.pop()
+            if f.fid in visited:
+                continue
+            visited.add(f.fid)
+            _check_body(ctx, f, findings)
+            # nested defs trace as part of the parent
+            for fid in f.nested.values():
+                stack.append(ctx.graph.funcs[fid])
+    return findings

@@ -1,0 +1,243 @@
+"""DL006: Python<->C++ mirror drift across the csrc ABI boundary.
+
+The C++ pools/indexes are declared "mirrored EXACTLY" by their Python
+twins (pool.py <-> kv_reuse_pool.cpp) and today only the differential
+fuzzer notices drift — at runtime, after the drift shipped. This rule
+checks the boundary statically for every csrc library:
+
+- every ABI symbol the ctypes wrapper references (``lib.kvpool_x``,
+  ``getattr(lib, "kvpool_x")``) must be exported from the cpp's
+  ``extern "C"`` block (a missing symbol is an AttributeError at
+  runtime, on the serving path);
+- every exported symbol must be referenced by its wrapper (an orphan
+  export is drift in the making: one side added an op the other never
+  learned);
+- declared ``argtypes`` arity must equal the C parameter count (ctypes
+  happily under/over-marshals and corrupts the stack silently);
+- a non-void C return REQUIRES ``restype`` on the wrapper (ctypes
+  defaults to c_int — a truncated pointer on 64-bit is a crash that
+  only reproduces under memory pressure);
+- out-buffer contracts: ``kvpool_layout_stats`` writes ``out[0..N]``;
+  the wrapper's scratch buffer must be exactly N+1 wide (the PR-5
+  stats-mirror contract).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Tuple
+
+from ..callgraph import dotted_text
+from ..engine import Finding, RepoContext
+
+RULE_ID = "DL006"
+
+_EXTERN_START_RE = re.compile(r'extern\s+"C"\s*\{')
+_FUNC_RE = re.compile(
+    r'^\s*((?:unsigned\s+)?[A-Za-z_][\w:]*\s*\*?)\s+'   # return type
+    r'([A-Za-z_]\w*)\s*\(([^)]*)\)\s*\{',               # name(params) {
+    re.MULTILINE | re.DOTALL)
+
+
+def parse_cpp_exports(source: str,
+                      prefixes: Tuple[str, ...]) -> Dict[str, dict]:
+    """{symbol: {"params": int, "returns_void": bool, "line": int,
+    "out_writes": {param_name: max_index}}}"""
+    out: Dict[str, dict] = {}
+    for extern in _EXTERN_START_RE.finditer(source):
+        # balanced-brace scan from the opening brace of the extern block
+        start = source.index("{", extern.start())
+        depth, i = 0, start
+        while i < len(source):
+            if source[i] == "{":
+                depth += 1
+            elif source[i] == "}":
+                depth -= 1
+                if depth == 0:
+                    break
+            i += 1
+        body = source[start + 1: i]
+        base_line = source[: start].count("\n") + 1
+        for m in _FUNC_RE.finditer(body):
+            ret, name, params = m.group(1).strip(), m.group(2), m.group(3)
+            if not name.startswith(prefixes):
+                continue
+            params = params.strip()
+            n_params = 0 if params in ("", "void") else params.count(",") + 1
+            # find the function body (balanced braces from the def)
+            start = m.end() - 1
+            depth, i = 0, start
+            while i < len(body):
+                if body[i] == "{":
+                    depth += 1
+                elif body[i] == "}":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                i += 1
+            fn_body = body[start:i + 1]
+            writes: Dict[str, int] = {}
+            for w in re.finditer(r"([A-Za-z_]\w*)\[(\d+)\]\s*=", fn_body):
+                pname, idx = w.group(1), int(w.group(2))
+                writes[pname] = max(writes.get(pname, -1), idx)
+            out[name] = {
+                "params": n_params,
+                "returns_void": ret == "void",
+                "line": base_line + body[: m.start()].count("\n"),
+                "out_writes": writes,
+            }
+    return out
+
+
+def parse_wrapper_refs(mod) -> Dict[str, dict]:
+    """{symbol: {"argtypes": Optional[int], "restype": bool, "line"}}
+    from ``lib.<sym>.argtypes = [...]`` / ``.restype = ...`` assignments,
+    ``getattr(lib, "<sym>")`` and ``lib.<sym>(...)`` references."""
+    refs: Dict[str, dict] = {}
+
+    def entry(sym: str, line: int) -> dict:
+        return refs.setdefault(sym, {"argtypes": None, "restype": False,
+                                     "line": line})
+
+    class V(ast.NodeVisitor):
+        def visit_Assign(self, node):             # noqa: N802
+            for t in node.targets:
+                text = dotted_text(t)
+                if text is None:
+                    continue
+                parts = text.split(".")
+                if len(parts) >= 3 and parts[-1] in ("argtypes",
+                                                     "restype"):
+                    sym = parts[-2]
+                    e = entry(sym, node.lineno)
+                    if parts[-1] == "argtypes":
+                        if isinstance(node.value, (ast.List, ast.Tuple)):
+                            e["argtypes"] = len(node.value.elts)
+                    else:
+                        e["restype"] = True
+            self.generic_visit(node)
+
+        def visit_Call(self, node):               # noqa: N802
+            text = dotted_text(node.func)
+            if text == "getattr" and len(node.args) >= 2 \
+                    and isinstance(node.args[1], ast.Constant) \
+                    and isinstance(node.args[1].value, str):
+                entry(node.args[1].value, node.lineno)
+            elif text is not None and "." in text:
+                entry(text.rsplit(".", 1)[-1], node.lineno)
+            self.generic_visit(node)
+
+        def visit_Constant(self, node):           # noqa: N802
+            # string-iterated registration loops:
+            # for fn in ("kvpool_a", "kvpool_b"): getattr(lib, fn)...
+            if isinstance(node.value, str) \
+                    and re.fullmatch(r"[A-Za-z_]\w*", node.value):
+                entry(node.value, getattr(node, "lineno", 1))
+
+    V().visit(mod.tree)
+    return refs
+
+
+def _scratch_buffer_sizes(mod) -> Dict[str, int]:
+    """Sizes of ctypes scratch buffers built as ``(_I64 * N)()`` in
+    functions that call an out-buffer ABI (keyed by enclosing function
+    name)."""
+    sizes: Dict[str, int] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Call) and not sub.args
+                        and isinstance(sub.func, ast.BinOp)
+                        and isinstance(sub.func.op, ast.Mult)
+                        and isinstance(sub.func.right, ast.Constant)
+                        and isinstance(sub.func.right.value, int)):
+                    sizes[node.name] = sub.func.right.value
+    return sizes
+
+
+def check(ctx: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for cpp_path, py_path, prefixes in ctx.mirror_pairs:
+        cpp_src = ctx.read_file(cpp_path)
+        mod = ctx.graph.modules.get(py_path)
+        if cpp_src is None or mod is None:
+            continue
+        exports = parse_cpp_exports(cpp_src, tuple(prefixes))
+        refs = {sym: info for sym, info in parse_wrapper_refs(mod).items()
+                if sym.startswith(tuple(prefixes))}
+
+        for sym, info in refs.items():
+            exp = exports.get(sym)
+            if exp is None:
+                findings.append(Finding(
+                    rule=RULE_ID, path=py_path, line=info["line"],
+                    symbol=f"{sym}:missing-export",
+                    message=(f"wrapper references ABI symbol `{sym}` "
+                             f"that {cpp_path} does not export — "
+                             f"AttributeError on the serving path"),
+                    hint=f"export it from {cpp_path} extern \"C\" or "
+                         f"drop the reference"))
+                continue
+            if info["argtypes"] is not None \
+                    and info["argtypes"] != exp["params"]:
+                findings.append(Finding(
+                    rule=RULE_ID, path=py_path, line=info["line"],
+                    symbol=f"{sym}:arity",
+                    message=(f"`{sym}` argtypes arity "
+                             f"{info['argtypes']} != C parameter count "
+                             f"{exp['params']} ({cpp_path}:"
+                             f"{exp['line']}) — ctypes will silently "
+                             f"mis-marshal the call"),
+                    hint="make the argtypes list match the C signature "
+                         "exactly"))
+            if not exp["returns_void"] and info["argtypes"] is not None \
+                    and not info["restype"]:
+                findings.append(Finding(
+                    rule=RULE_ID, path=py_path, line=info["line"],
+                    symbol=f"{sym}:restype",
+                    message=(f"`{sym}` returns non-void in {cpp_path} "
+                             f"but the wrapper sets no restype — "
+                             f"ctypes truncates to c_int"),
+                    hint="declare lib.{}.restype".format(sym)))
+
+        for sym, exp in exports.items():
+            if sym not in refs:
+                findings.append(Finding(
+                    rule=RULE_ID, path=cpp_path, line=exp["line"],
+                    symbol=f"{sym}:orphan-export",
+                    message=(f"{cpp_path} exports `{sym}` but "
+                             f"{py_path} never references it — the "
+                             f"mirror halves have drifted"),
+                    hint=f"wrap it in {py_path} or remove the export"))
+
+        # out-buffer width contracts (the PR-5 stats mirror):
+        # kvpool_layout_stats writes out[0..N]; the wrapper's scratch
+        # buffer in the calling function must be exactly N+1 wide
+        sizes = _scratch_buffer_sizes(mod)
+        for sym, exp in exports.items():
+            writes = exp["out_writes"].get("out", -1)
+            if writes < 0 or sym not in refs:
+                continue
+            # find wrapper functions whose body calls this symbol
+            for fname, size in sizes.items():
+                caller = None
+                for fid, fi in ctx.graph.funcs.items():
+                    if fi.module is mod and fi.name == fname and any(
+                            c.text.rsplit(".", 1)[-1] == sym
+                            for c in fi.calls):
+                        caller = fi
+                        break
+                if caller is None:
+                    continue
+                if size != writes + 1:
+                    findings.append(Finding(
+                        rule=RULE_ID, path=py_path, line=caller.lineno,
+                        symbol=f"{sym}:out-buffer",
+                        message=(f"`{fname}` passes a {size}-wide "
+                                 f"scratch buffer to `{sym}` but the C "
+                                 f"side writes out[0..{writes}] — "
+                                 f"buffer overrun or dropped stats"),
+                        hint="size the buffer to the C contract and "
+                             "keep both sides in one commit"))
+    return findings

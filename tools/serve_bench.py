@@ -140,7 +140,7 @@ def main():
         if platform != "cpu":
             # record the defensible <500ms-p50-TTFT proxy (BENCH_LOCAL)
             import bench
-            bench._record_success({
+            await asyncio.to_thread(bench._record_success, {
                 "metric": "serving_ttft_p50_host_ms",
                 "value": round(pct(ttfts_host, .5) * 1e3, 1),
                 "unit": "ms",
