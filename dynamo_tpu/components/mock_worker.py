@@ -36,7 +36,12 @@ class _EchoWithKvEvents(AsyncEngine):
     real occupancy — the planner's drain-wait and scale signals read it."""
 
     def __init__(self, publisher: KvEventPublisher, block_size: int,
-                 spec_k: int = 0, spec_acceptance: float = 0.75):
+                 spec_k: int = 0, spec_acceptance: float = 0.75,
+                 delay_fn=None):
+        # optional per-request service delay (BehaviorProfile slow-start
+        # / latency inflation — sim/profiles.py, shared with the fleet
+        # simulator's worker model)
+        self.delay_fn = delay_fn
         self.inner = EchoEngineCore()
         self.publisher = publisher
         self.block_size = block_size
@@ -65,6 +70,10 @@ class _EchoWithKvEvents(AsyncEngine):
     async def generate(self, request: SingleIn) -> ManyOut:
         pre: PreprocessedRequest = request.data
         self.requests_served += 1
+        if self.delay_fn is not None:
+            d = self.delay_fn()
+            if d > 0:
+                await asyncio.sleep(d)
         if self.spec_k > 0:
             self.spec_steps += 1
             self.spec_drafted += self.spec_k
@@ -94,15 +103,35 @@ class MockTokenWorker:
     """Embeddable fixture: serve a token-protocol endpoint with synthetic
     metrics + KV events."""
 
+    # class-level defaults so partially-constructed fixtures (the
+    # __new__-then-assign shape some stats tests use) still have a
+    # coherent profile/_stats surface
+    block_size = 16
+    _started_mono = 0.0
+
     def __init__(self, runtime: DistributedRuntime, endpoint_path: str,
                  block_size: int = 16,
                  metrics: Optional[ForwardPassMetrics] = None,
                  spec_k: int = 0, spec_acceptance: float = 0.75,
                  publish_traces: bool = True,
-                 synthetic_trace_interval: float = 0.0):
+                 synthetic_trace_interval: float = 0.0,
+                 profile=None):
         self.runtime = runtime
         self.endpoint = Endpoint.parse_path(runtime, endpoint_path)
         self.block_size = block_size
+        # synthetic behavior profile (sim/profiles.py — the SAME
+        # vocabulary the fleet simulator's worker model runs, so a
+        # scenario rehearsed in simulation replays against this live
+        # fixture): slow-start/latency inflate service delays,
+        # crash-at-T stops the worker cold, drain-ignore makes it deaf
+        # to the planner's drain key (the drain-timeout path).
+        from ..sim.profiles import BehaviorProfile
+        if isinstance(profile, str):
+            profile = BehaviorProfile.parse(profile)
+        self.profile = profile or BehaviorProfile()
+        self._started_mono: float = 0.0
+        self._crash_task = None
+        self.crashed = False
         self.metrics = metrics or ForwardPassMetrics(
             request_active_slots=0, request_total_slots=8,
             kv_active_blocks=0, kv_total_blocks=1024)
@@ -136,9 +165,17 @@ class MockTokenWorker:
             await component.publish_event("kv_events", ev)
 
         publisher = KvEventPublisher(worker_id=lease.id, sink=sink)
+        import time as _time
+        self._started_mono = _time.monotonic()
+
+        def _delay() -> float:
+            return self.profile.service_delay_s(
+                _time.monotonic() - self._started_mono)
+
         self.engine = _EchoWithKvEvents(publisher, self.block_size,
                                         spec_k=self.spec_k,
-                                        spec_acceptance=self.spec_acceptance)
+                                        spec_acceptance=self.spec_acceptance,
+                                        delay_fn=_delay)
         # transient lease reclaim (daemon blip) → replay the radix index
         # for this worker (KNOWN_ISSUES kv-router staleness fix)
         prev = getattr(self.runtime.store, "on_lease_reclaimed", None)
@@ -166,7 +203,26 @@ class MockTokenWorker:
         if self.synthetic_trace_interval > 0:
             self._synth_task = asyncio.get_running_loop().create_task(
                 self._synthetic_trace_loop(), name="mock-synth-traces")
+        if self.profile.drain_ignore:
+            # deaf to the planner's drain key: kill the server's drain
+            # watch so only the planner's drain-timeout path can retire
+            # this worker
+            if self.server._drain_task is not None:
+                self.server._drain_task.cancel()
+                self.server._drain_task = None
+        if self.profile.crash_at_s > 0:
+            self._crash_task = asyncio.get_running_loop().create_task(
+                self._crash_after(self.profile.crash_at_s),
+                name="mock-crash-at")
         return self
+
+    async def _crash_after(self, delay_s: float) -> None:
+        await asyncio.sleep(delay_s)
+        self.crashed = True
+        self._crash_task = None     # don't self-cancel inside stop()
+        logger.info("mock worker %x crashing (profile crash-at:%g)",
+                    self.worker_id, delay_s)
+        await self.stop()
 
     async def _synthetic_trace_loop(self) -> None:
         """Fabricate plausible finished worker traces on a timer — they
@@ -241,7 +297,19 @@ class MockTokenWorker:
             d["remote_link_gbps"] = 10.0
             d["remote_link_rtt_s"] = 1e-3
             d["kv_bytes_per_block"] = 1 << 20
+            d["kv_block_size"] = self.block_size
             d["prefill_tok_per_s"] = 5e4
+        profile = getattr(self, "profile", None)
+        if profile is not None and (profile.slow_start_s > 0
+                                    or profile.latency_factor != 1.0):
+            # young/slow worker: the published prefill rate tracks the
+            # profile's speed factor, so the router's NetKV recompute
+            # model and the planner's crossover stats see the ramp
+            import time as _time
+            f = profile.speed_factor(
+                _time.monotonic() - self._started_mono)
+            if d.get("prefill_tok_per_s"):
+                d["prefill_tok_per_s"] = d["prefill_tok_per_s"] * f
         return d
 
     @property
@@ -252,6 +320,9 @@ class MockTokenWorker:
         await self.server.set_draining(True)
 
     async def stop(self) -> None:
+        if self._crash_task is not None:
+            self._crash_task.cancel()
+            self._crash_task = None
         if self._synth_task is not None:
             self._synth_task.cancel()
             self._synth_task = None
@@ -277,6 +348,10 @@ async def amain(argv=None) -> None:
                    help="emit a fabricated worker trace every N seconds "
                         "(exercises the trace collector + Grafana "
                         "'Tracing' row with zero traffic)")
+    p.add_argument("--profile", default="",
+                   help="synthetic behavior profile (sim/profiles.py), "
+                        "e.g. 'slow-start:30', 'crash-at:120', "
+                        "'drain-ignore', 'latency:2.5' — comma-joined")
     args = p.parse_args(argv)
     from ..runtime.log import setup_logging
     setup_logging()
@@ -284,7 +359,8 @@ async def amain(argv=None) -> None:
     worker = await MockTokenWorker(
         runtime, args.endpoint, block_size=args.kv_block_size,
         spec_k=args.spec_k, spec_acceptance=args.spec_acceptance,
-        synthetic_trace_interval=args.synthetic_trace_interval).start()
+        synthetic_trace_interval=args.synthetic_trace_interval,
+        profile=args.profile).start()
     logger.info("mock worker %x serving %s", worker.worker_id, args.endpoint)
     try:
         await asyncio.Event().wait()

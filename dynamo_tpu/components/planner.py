@@ -192,9 +192,15 @@ class Planner:
             "evaluations": 0, "scale_up": 0, "scale_down": 0,
             "drains_started": 0, "drains_completed": 0,
             "drain_timeouts": 0, "retunes": 0, "holds": 0,
+            "retune_crossover_holds": 0,
         }
         self.last_decision: dict = {}
         self.last_signals: Optional[FleetSignals] = None
+        # raw per-worker metrics from the last scrape — the fleet-level
+        # fetch-vs-recompute crossover input (scoring.py) the retune
+        # floor consumes
+        self.last_stats: Dict[int, dict] = {}
+        self.fleet_crossover_tokens: Optional[float] = None
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> "Planner":
@@ -264,6 +270,7 @@ class Planner:
     # ------------------------------------------------------------- signals
     async def observe(self) -> FleetSignals:
         stats = await self._client.collect_stats()
+        self.last_stats = stats
         draining = set(self._client.draining_ids())
         pq_depth = 0
         if self.prefill_queue is not None:
@@ -418,7 +425,15 @@ class Planner:
         backed-up prefill queue pushes work LOCAL (threshold up — the
         remote fleet is the bottleneck); an empty queue under TTFT
         pressure pulls long prompts REMOTE (threshold down). Published
-        through the kvstore watch every DisaggregatedRouter honors."""
+        through the kvstore watch every DisaggregatedRouter honors.
+
+        Fleet crossover floor (ROADMAP KV-fabric item (c)): a downward
+        retune is FLOORED at the fleet's median fetch-vs-recompute
+        crossover depth (scoring.fleet_crossover_tokens over the last
+        scrape) — below that depth, moving prefix KV across the fabric
+        loses to recomputing it locally, so pushing shorter prompts
+        remote can only burn link budget. A fleet whose links never pay
+        (crossover inf) effectively refuses to lower at all."""
         if self.model_name is None or self.prefill_queue is None:
             return
         if time.monotonic() < self._retune_cooldown_until:
@@ -432,6 +447,16 @@ class Planner:
               and signals.ttft_p90_ms > self.slo.ttft_p90_ms
               and cur > self.slo.max_local_prefill_length):
             new = max(cur // 2, self.cfg.retune_min)
+        if new < cur:
+            from ..llm.kv_router.scoring import fleet_crossover_tokens
+            xo = fleet_crossover_tokens(self.last_stats)
+            self.fleet_crossover_tokens = xo
+            if xo is not None:
+                floor = min(max(int(min(xo, self.cfg.retune_max)),
+                                self.cfg.retune_min), self.cfg.retune_max)
+                if new < floor:
+                    self.counters["retune_crossover_holds"] += 1
+                    new = min(floor, cur)
         if new == cur:
             return
         from ..llm.disagg import disagg_config_key
@@ -441,9 +466,12 @@ class Planner:
         self.disagg_threshold = new
         self._retune_cooldown_until = time.monotonic() + self.cfg.cooldown_s
         self.counters["retunes"] += 1
+        xo = self.fleet_crossover_tokens
         self.last_decision = {
             "action": "retune", "max_local_prefill_length": new,
-            "was": cur, "at": time.time()}
+            "was": cur, "fleet_crossover_tokens":
+                None if xo is None or xo == float("inf") else round(xo, 1),
+            "at": time.time()}
         logger.info("disagg threshold retuned %d → %d (prefill queue "
                     "depth %d)", cur, new, signals.prefill_queue_depth)
 
@@ -462,6 +490,10 @@ class Planner:
                              self._client.draining_ids()],
             } if self._client is not None else {},
             "disagg_threshold": self.disagg_threshold,
+            "fleet_crossover_tokens": (
+                None if self.fleet_crossover_tokens is None
+                or self.fleet_crossover_tokens == float("inf")
+                else round(self.fleet_crossover_tokens, 1)),
             "last_decision": self.last_decision,
             "counters": dict(self.counters),
             "at": time.time(),

@@ -441,8 +441,14 @@ class EngineCore:
         # measured prefill rate feed for the fabric's admission gate and
         # the router's NetKV scoring: wall seconds spent in prefill
         # admissions (dispatch + host glue — an upper bound, so the
-        # modeled recompute it feeds is conservative)
+        # modeled recompute it feeds is conservative). The cumulative
+        # totals stay for bench provenance; the RATE the gate prices
+        # with is age-weighted (fabric.PrefillRateEstimator) so XLA-
+        # compile-inflated early admissions on a young engine don't skew
+        # fetch-vs-recompute pricing.
         self.prefill_wall_s = 0.0
+        from ..llm.kv.fabric import PrefillRateEstimator
+        self.prefill_rate_estimator = PrefillRateEstimator()
         # speculation stats (nv_llm_spec_* metrics feed)
         self.spec_dispatches = 0       # verify dispatches issued
         self.spec_drafted_tokens = 0   # draft tokens scored
@@ -1012,6 +1018,7 @@ class EngineCore:
         from ..runtime.tracing import tracer as _tracer
         return ForwardPassMetrics(
             kv_bytes_per_block=self.kv_bytes_per_block(),
+            kv_block_size=self.cfg.kv_block_size,
             prefill_tok_per_s=self.measured_prefill_tok_per_s(),
             trace_dropped_log_lines_total=_tracer.dropped_log_lines,
             loop_lag_ms=self.flight.loop_lag_ms,
@@ -1353,13 +1360,13 @@ class EngineCore:
         return max(total // max(self.cfg.num_kv_blocks, 1), 1)
 
     def measured_prefill_tok_per_s(self) -> float:
-        """MEASURED prefill rate (tokens per wall second spent in
-        prefill admissions) — the recompute side of the fabric's
-        fetch-vs-recompute model. 0.0 until the first prefill lands
-        (the gate treats unknown as admit)."""
-        if self.prefill_wall_s <= 0:
-            return 0.0
-        return self.total_prefill_tokens / self.prefill_wall_s
+        """MEASURED prefill rate — the recompute side of the fabric's
+        fetch-vs-recompute model. AGE-WEIGHTED (llm/kv/fabric.
+        PrefillRateEstimator): the first admissions — which include XLA
+        compile on a young engine — are excluded, and later ones decay-
+        average, so the gate prices recompute at the warmed-up rate.
+        0.0 while young/unknown (the gate treats unknown as admit)."""
+        return self.prefill_rate_estimator.rate()
 
     def _publish_tier_removed(self, seq_hash: int) -> None:
         """Removed-from-disk announce, suppressed while any warmer OR
@@ -1718,7 +1725,9 @@ class EngineCore:
             # router's NetKV recompute model): wall time from plan to
             # dispatched prefill — an upper bound on the true compute
             # cost, so the modeled recompute stays conservative
-            self.prefill_wall_s += time.monotonic() - t0
+            admit_wall_s = time.monotonic() - t0
+            self.prefill_wall_s += admit_wall_s
+            self.prefill_rate_estimator.observe(len(chunk), admit_wall_s)
             # defer the device→host fetch of the first token: it overlaps
             # the next decode dispatch instead of stalling the loop. Wire
             # handoff needs the host value immediately; DEVICE handoff

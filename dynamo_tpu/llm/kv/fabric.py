@@ -52,7 +52,7 @@ from .remotestore import (RemoteKvStore, pack_block_bytes,
 logger = logging.getLogger("dynamo_tpu.kv.fabric")
 
 __all__ = ["FABRIC_ENDPOINT", "LinkStats", "PeerLinkTable", "AdmissionGate",
-           "KvFabricServer", "KvFabric"]
+           "PrefillRateEstimator", "KvFabricServer", "KvFabric"]
 
 FABRIC_ENDPOINT = "kv_fabric"
 PROBE_BYTES = 256 * 1024
@@ -157,6 +157,52 @@ class PeerLinkTable:
 # ---------------------------------------------------------------------------
 # Latency-aware admission
 # ---------------------------------------------------------------------------
+
+
+class PrefillRateEstimator:
+    """Age-weighted measured prefill rate (ROADMAP KV-fabric item (c)):
+    the admission gate's recompute side.
+
+    A cumulative tokens/wall ratio is the wrong estimator on a YOUNG
+    engine: the first prefill admissions include XLA compilation, so
+    their rate is 10-100x below steady state and a cumulative mean stays
+    skewed for thousands of admissions — making modeled recompute look
+    expensive and over-admitting remote fetches that lose to a warmed-up
+    recompute. This estimator
+
+    - EXCLUDES the first ``warmup_samples`` admissions outright (while
+      young it reports 0.0 — "rate unknown", which the gate and the
+      router's NetKV model already treat as admit-optimistically, the
+      correct posture for a cold engine), and
+    - decay-averages per-admission rates afterwards (EMA, same alpha
+      discipline as PeerLinkTable), so one anomalous admission — a GC
+      pause, a host stall — washes out instead of anchoring the price.
+    """
+
+    def __init__(self, warmup_samples: int = 2, alpha: float = 0.3):
+        self.warmup_samples = int(warmup_samples)
+        self.alpha = float(alpha)
+        self.samples = 0
+        self.warmup_skipped = 0
+        self._rate = 0.0
+
+    def observe(self, tokens: int, wall_s: float) -> None:
+        if tokens <= 0 or wall_s <= 0:
+            return
+        self.samples += 1
+        if self.samples <= self.warmup_samples:
+            self.warmup_skipped += 1
+            return
+        r = tokens / wall_s
+        if self._rate <= 0:
+            self._rate = r
+        else:
+            self._rate += self.alpha * (r - self._rate)
+
+    def rate(self) -> float:
+        """tok/s estimate; 0.0 until warmup passes (unknown → the gate
+        admits, matching the tiers' optimistic cold behavior)."""
+        return self._rate
 
 
 class AdmissionGate:

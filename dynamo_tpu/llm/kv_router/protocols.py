@@ -68,6 +68,13 @@ class ForwardPassMetrics:
     remote_link_rtt_s: float = 0.0
     kv_bytes_per_block: int = 0
     prefill_tok_per_s: float = 0.0
+    # tokens per KV block (EngineConfig.kv_block_size) — closes the
+    # transfer-vs-recompute model fleet-side: with it, the planner can
+    # derive each worker's fetch-vs-recompute CROSSOVER DEPTH in tokens
+    # (kv_router/scoring.py crossover_tokens) and floor the disagg
+    # retune there. Zero on old payloads (crossover then unknowable for
+    # that worker — it simply drops out of the fleet median).
+    kv_block_size: int = 0
     # runtime/netstore.py client retry counter (bounded jittered retry;
     # a rising rate means the discovery daemon link is flapping)
     netstore_retries_total: int = 0
@@ -101,7 +108,10 @@ class ForwardPassMetrics:
     loop_lag_max_ms: float = 0.0
 
     def to_dict(self) -> dict:
-        return dataclasses.asdict(self)
+        # every field is a scalar; dataclasses.asdict would deep-copy
+        # recursively — measurable on the per-second stats publish path
+        # at fleet scale (and per-scrape × workers on the planner side)
+        return dict(self.__dict__)
 
     @classmethod
     def from_dict(cls, d: dict) -> "ForwardPassMetrics":

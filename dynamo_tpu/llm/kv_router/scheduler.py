@@ -27,6 +27,20 @@ logger = logging.getLogger("dynamo_tpu.kv_scheduler")
 
 GAMMA = 0.2
 
+# indexer ⇄ scheduler would cycle at import time; resolve once on first
+# use instead of per call (the routing hot path runs _effective_overlap
+# once per candidate per decision)
+_LAZY: tuple = ()
+
+
+def _lazy_imports():
+    global _LAZY
+    if not _LAZY:
+        from .indexer import OverlapScores
+        from .scoring import network_adjusted_overlap
+        _LAZY = (OverlapScores, network_adjusted_overlap)
+    return _LAZY
+
 
 class KvScheduler:
     def __init__(self, block_size: int,
@@ -51,11 +65,14 @@ class KvScheduler:
         with remote-tier blocks kept only when the candidate's modeled
         transfer beats its modeled recompute, plus fabric-fetchable
         credit for blocks other workers hold (scoring.py
-        network_adjusted_overlap). A plain dict scores as before."""
-        from .indexer import OverlapScores
+        network_adjusted_overlap). A plain dict scores as before.
+
+        (Imports are module-lazy via _lazy_imports(), NOT per-call: this
+        runs once per candidate per routing decision — the router's
+        hottest loop at fleet scale.)"""
+        OverlapScores, network_adjusted_overlap = _lazy_imports()
         if not isinstance(overlap, OverlapScores):
             return overlap.get(ep.worker_id, 0)
-        from .scoring import network_adjusted_overlap
         wid = ep.worker_id
         return network_adjusted_overlap(
             weighted=overlap.weighted.get(wid, 0.0),
@@ -67,7 +84,7 @@ class KvScheduler:
 
     @staticmethod
     def _raw_overlap(overlap, worker_id: int):
-        from .indexer import OverlapScores
+        OverlapScores, _ = _lazy_imports()
         if isinstance(overlap, OverlapScores):
             return overlap.scores.get(worker_id, 0)
         return overlap.get(worker_id, 0)
@@ -80,7 +97,7 @@ class KvScheduler:
         callers). ``exclude``: worker ids barred from NEW admissions
         (the planner's draining set) — skipped like full workers, so a
         drain shifts load instead of dropping requests."""
-        from .indexer import OverlapScores
+        OverlapScores, _ = _lazy_imports()
         eps = self.endpoints
         if not len(eps):
             return None

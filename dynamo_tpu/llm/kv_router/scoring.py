@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from .protocols import ForwardPassMetrics
 
@@ -124,13 +124,59 @@ def network_adjusted_overlap(weighted: float, own_depth: int,
     if remote_depth > 0 and not transfer_pays(remote_depth, block_size, m):
         eff -= remote_depth * w_remote
     extra = fleet_depth - own_depth
-    if extra > 0 and transfer_pays(extra, block_size, m):
+    if extra > 0 and m.remote_link_gbps > 0 and m.kv_bytes_per_block > 0:
+        # transfer_pays inlined so the t/r the saving needs aren't
+        # modeled twice — this runs once per candidate per routing
+        # decision, the router's hottest loop at fleet scale
         t = modeled_transfer_s(extra, m.kv_bytes_per_block,
                                m.remote_link_gbps, m.remote_link_rtt_s)
         r = modeled_recompute_s(extra, block_size, m.prefill_tok_per_s)
-        saving = 1.0 if math.isinf(r) else max(1.0 - t / r, 0.0)
-        eff += extra * w_remote * saving
+        if t < r:
+            saving = 1.0 if math.isinf(r) else max(1.0 - t / r, 0.0)
+            eff += extra * w_remote * saving
     return max(eff, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# Fleet-level fetch-vs-recompute crossover (ROADMAP KV-fabric item (c),
+# second half): the planner's disagg retune consumes the fleet's
+# aggregate crossover depth — there is no point pushing the disagg
+# threshold BELOW the depth at which moving KV across the fabric starts
+# beating recompute, because a remote prefill's payoff rides the same
+# link economics the per-worker AdmissionGate prices.
+# ---------------------------------------------------------------------------
+
+
+def crossover_tokens(m: dict) -> Optional[float]:
+    """One worker's fetch-vs-recompute crossover depth in TOKENS, from
+    its published ForwardPassMetrics dict: the depth where
+    rtt + tokens·(bytes_per_block/block_size)/bw  ==  tokens/rate.
+
+    Returns None when the worker's inputs are absent (no fabric, old
+    payload, rate still unknown) and +inf when its link NEVER beats
+    recompute (per-token transfer >= per-token recompute)."""
+    rate = float(m.get("prefill_tok_per_s", 0) or 0)
+    gbps = float(m.get("remote_link_gbps", 0) or 0)
+    bpb = float(m.get("kv_bytes_per_block", 0) or 0)
+    bs = float(m.get("kv_block_size", 0) or 0)
+    rtt = float(m.get("remote_link_rtt_s", 0) or 0)
+    if rate <= 0 or gbps <= 0 or bpb <= 0 or bs <= 0:
+        return None
+    per_tok_gain = 1.0 / rate - bpb / (bs * gbps * 1e9)
+    if per_tok_gain <= 0:
+        return math.inf
+    return rtt / per_tok_gain
+
+
+def fleet_crossover_tokens(stats: Dict[int, dict]) -> Optional[float]:
+    """Median per-worker crossover depth across the scraped fleet — the
+    robust aggregate the planner's disagg retune floors at. None when no
+    worker published usable inputs."""
+    vals = sorted(v for v in (crossover_tokens(m) for m in stats.values())
+                  if v is not None)
+    if not vals:
+        return None
+    return vals[len(vals) // 2]
 
 
 @dataclasses.dataclass

@@ -250,6 +250,11 @@ class EndpointServer:
                 await sender.finish(error=str(e))
 
     async def _stats_loop(self) -> None:
+        # long-lived task spawned from serve(): detach the caller's
+        # ambient trace so the periodic kv_put's netstore spans never
+        # attach to whatever request started the server (DL002)
+        from .tracing import detach_trace
+        detach_trace()
         rt = self.endpoint.runtime
         key = self.endpoint.stats_key(self.lease.id)
         while not self._stopping:

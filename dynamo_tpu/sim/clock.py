@@ -1,0 +1,145 @@
+"""Virtual time for the fleet simulator: a discrete-event asyncio loop.
+
+The control plane under test (components/planner.py, runtime/kvstore.py
+leases, runtime/egress.py watches) is ordinary asyncio code that sleeps,
+schedules timers and reads ``time.monotonic()``. Rather than reimplement
+it against an ad-hoc event queue — which would test a COPY of the
+planner, not the planner — the simulator runs the real code on a real
+asyncio event loop whose notion of time is virtual:
+
+- :class:`VirtualTimeLoop` is a ``SelectorEventLoop`` whose ``time()``
+  reads a :class:`VirtualClock`, and whose selector never blocks: when
+  no callback is ready and no fd fired, it ADVANCES the clock straight
+  to the next scheduled timer. ``asyncio.sleep(300)`` costs microseconds
+  of wall time; a simulated hour of planner evaluations completes in
+  seconds.
+- :func:`virtual_time` patches ``time.monotonic`` / ``time.time`` /
+  ``time.perf_counter`` to the same clock for the duration of a run, so
+  code that timestamps outside the loop (planner hysteresis/cooldown,
+  kvstore lease deadlines, trace offsets) sees one consistent timeline.
+
+Determinism: a single loop, no real I/O waits, seeded RNGs and a fixed
+virtual epoch mean the same seed replays the exact same event sequence —
+the byte-identical-event-log gate in tests/test_fleet_sim.py. The sim
+core deliberately never reads the wall clock or unseeded randomness
+(the DL005 discipline extended outside jit; the determinism test is the
+enforcement).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import selectors
+import time
+
+__all__ = ["VirtualClock", "VirtualTimeLoop", "virtual_time",
+           "run_simulation", "REAL_MONOTONIC", "REAL_PERF_COUNTER"]
+
+# Wall-clock handles captured BEFORE any patching — the tier-1 wall-time
+# budget assertions must keep measuring real time while virtual time is
+# in effect.
+REAL_MONOTONIC = time.monotonic
+REAL_PERF_COUNTER = time.perf_counter
+_REAL_TIME = time.time
+
+# Fixed virtual epoch: ``time.time()`` under virtual_time() returns
+# EPOCH + clock.now, so epoch timestamps in planner decisions / status
+# records are seed-deterministic too.
+VIRTUAL_EPOCH = 1_700_000_000.0
+
+
+class VirtualClock:
+    """The simulation's single source of time (seconds, starts at 0)."""
+
+    def __init__(self, start: float = 0.0):
+        self.now = float(start)
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+    def monotonic(self) -> float:
+        return self.now
+
+    def time(self) -> float:
+        return VIRTUAL_EPOCH + self.now
+
+    def perf_counter(self) -> float:
+        return self.now
+
+
+class _VirtualSelector(selectors.SelectSelector):
+    """Selector that never blocks: polls real fds (the loop's self-pipe,
+    a lazily-bound TcpStreamServer listener) with timeout 0, and when
+    nothing is ready jumps virtual time forward by the requested timeout
+    — which the event loop computed as the gap to its next timer."""
+
+    def __init__(self, clock: VirtualClock):
+        super().__init__()
+        self._clock = clock
+
+    def select(self, timeout=None):
+        ready = super().select(0)
+        if ready:
+            return ready
+        if timeout is None:
+            # No ready callbacks, no scheduled timers, no fd activity:
+            # the simulation deadlocked. Fail loudly instead of hanging.
+            raise RuntimeError(
+                "virtual-time deadlock: the loop is waiting on I/O that "
+                "can never arrive (no timers scheduled)")
+        if timeout > 0:
+            self._clock.advance(timeout)
+        return ready
+
+
+class VirtualTimeLoop(asyncio.SelectorEventLoop):
+    """SelectorEventLoop running on a :class:`VirtualClock`."""
+
+    def __init__(self, clock: VirtualClock):
+        super().__init__(_VirtualSelector(clock))
+        self.clock = clock
+
+    def time(self) -> float:
+        return self.clock.now
+
+
+@contextlib.contextmanager
+def virtual_time(clock: VirtualClock):
+    """Patch the stdlib time sources to ``clock`` (restored on exit)."""
+    time.monotonic = clock.monotonic
+    time.time = clock.time
+    time.perf_counter = clock.perf_counter
+    try:
+        yield clock
+    finally:
+        time.monotonic = REAL_MONOTONIC
+        time.time = _REAL_TIME
+        time.perf_counter = REAL_PERF_COUNTER
+
+
+def run_simulation(main_fn, clock: VirtualClock = None):
+    """Run ``await main_fn()`` to completion on a fresh virtual-time loop
+    with the stdlib clocks patched, then tear the loop down (pending
+    tasks cancelled and awaited). Returns the coroutine's result.
+
+    ``main_fn`` is a zero-arg coroutine FUNCTION so the coroutine object
+    is created with the virtual loop already current.
+    """
+    clock = clock or VirtualClock()
+    loop = VirtualTimeLoop(clock)
+    asyncio.set_event_loop(loop)
+    try:
+        with virtual_time(clock):
+            result = loop.run_until_complete(main_fn())
+            pending = [t for t in asyncio.all_tasks(loop) if not t.done()]
+            for t in pending:
+                t.cancel()
+            if pending:
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True))
+            loop.run_until_complete(loop.shutdown_asyncgens())
+        return result
+    finally:
+        asyncio.set_event_loop(None)
+        loop.close()

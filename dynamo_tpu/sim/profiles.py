@@ -1,0 +1,82 @@
+"""Synthetic worker behavior profiles, shared between the fleet
+simulator's worker model (sim/worker.py) and the live mock worker
+(components/mock_worker.py --profile) — the same fault vocabulary drives
+both, so a scenario rehearsed in simulation is replayable against real
+processes in a smoke test.
+
+Profiles compose from four knobs:
+
+- ``slow-start:T[:F]`` — for the first T seconds after start the worker
+  serves F× slower (default 4×): the XLA-compile / cold-cache ramp. The
+  admission gate's age-weighted prefill-rate estimator
+  (llm/kv/fabric.PrefillRateEstimator) exists precisely because of this
+  window.
+- ``crash-at:T`` — the worker dies T seconds after start: discovery
+  entry gone, in-flight requests lost (the router/planner must absorb
+  it — the cascading-failure ingredient).
+- ``drain-ignore`` — the worker never honors a drain request: the
+  planner's drain-timeout path (retire-anyway) is the only way out.
+- ``latency:F`` — every service time inflated F× for the worker's whole
+  life (the chronically-slow replica).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["BehaviorProfile"]
+
+
+@dataclasses.dataclass
+class BehaviorProfile:
+    name: str = "steady"
+    slow_start_s: float = 0.0
+    slow_start_factor: float = 4.0
+    crash_at_s: float = 0.0          # 0 = never
+    drain_ignore: bool = False
+    latency_factor: float = 1.0
+
+    def speed_factor(self, age_s: float) -> float:
+        """Service-rate multiplier at worker age ``age_s`` (1.0 = the
+        perf model's nominal rates; <1 = slower)."""
+        f = 1.0 / max(self.latency_factor, 1e-6)
+        if self.slow_start_s > 0 and age_s < self.slow_start_s:
+            f /= max(self.slow_start_factor, 1.0)
+        return f
+
+    def service_delay_s(self, age_s: float, unit_s: float = 0.01) -> float:
+        """Additive per-request delay for REAL-TIME fixtures (the mock
+        worker): the same shape as speed_factor, expressed as small
+        absolute delays so live smoke tests stay fast."""
+        d = (self.latency_factor - 1.0) * unit_s
+        if self.slow_start_s > 0 and age_s < self.slow_start_s:
+            d += (self.slow_start_factor - 1.0) * unit_s
+        return max(d, 0.0)
+
+    @classmethod
+    def parse(cls, spec: str) -> "BehaviorProfile":
+        """Parse a comma-joined spec, e.g.
+        ``slow-start:30``, ``crash-at:120,latency:2``,
+        ``drain-ignore``. Empty/"steady" → the neutral profile."""
+        p = cls(name=spec or "steady")
+        if not spec or spec == "steady":
+            return p
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, val = part.partition(":")
+            if key == "slow-start":
+                args = val.split(":") if val else []
+                p.slow_start_s = float(args[0]) if args else 30.0
+                if len(args) > 1:
+                    p.slow_start_factor = float(args[1])
+            elif key == "crash-at":
+                p.crash_at_s = float(val)
+            elif key == "drain-ignore":
+                p.drain_ignore = True
+            elif key == "latency":
+                p.latency_factor = float(val)
+            else:
+                raise ValueError(f"unknown profile knob {part!r}")
+        return p

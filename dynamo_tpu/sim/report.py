@@ -1,0 +1,46 @@
+"""Event log + scenario report for the fleet simulator.
+
+The event log is the determinism contract: every simulation appends
+(virtual_time, kind, fields) tuples for request lifecycle, worker
+lifecycle, planner actions and retunes — and two runs with the same seed
+must serialize to BYTE-IDENTICAL JSONL (tests/test_fleet_sim.py gate).
+Nothing wall-clock-derived or hash-randomized may enter an entry.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import List, Tuple
+
+__all__ = ["EventLog"]
+
+
+class EventLog:
+    """Append-only (t, kind, fields) log on the virtual clock."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self.entries: List[Tuple[float, str, dict]] = []
+
+    def log(self, kind: str, **fields) -> None:
+        self.entries.append((round(self.clock.now, 6), kind, fields))
+
+    def count(self, kind: str) -> int:
+        return sum(1 for _, k, _f in self.entries if k == kind)
+
+    def of_kind(self, kind: str) -> List[Tuple[float, dict]]:
+        return [(t, f) for t, k, f in self.entries if k == kind]
+
+    def to_jsonl_bytes(self) -> bytes:
+        out = []
+        for t, kind, fields in self.entries:
+            out.append(json.dumps({"t": t, "ev": kind, **fields},
+                                  sort_keys=True, separators=(",", ":")))
+        return ("\n".join(out) + "\n").encode()
+
+    def digest(self) -> str:
+        return hashlib.sha256(self.to_jsonl_bytes()).hexdigest()
+
+    def __len__(self) -> int:
+        return len(self.entries)

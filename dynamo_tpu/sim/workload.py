@@ -1,0 +1,171 @@
+"""Trace-driven workload generation for the fleet simulator.
+
+A workload is a time-ordered list of request specs (the JSONL trace
+format documented in docs/fleet_sim.md):
+
+    {"at": 12.125, "rid": "r000042", "tenant": "t03",
+     "session": "t03-s0007", "turn": 2, "isl": 1536, "osl": 96}
+
+``at`` is the virtual arrival offset in seconds; ``isl``/``osl`` are
+input/output sequence lengths in tokens. Requests in the same
+``session`` share a token prefix: turn N's prompt is turn N-1's prompt
+plus its output plus fresh user tokens, so agentic multi-turn traffic
+produces the DEEP prefix reuse the KV router's radix index is built for.
+Token ids themselves are materialized lazily and deterministically by
+the fleet (sim/fleet.py TokenCatalog) from (seed, session).
+
+Generators (all seed-deterministic):
+
+- diurnal burst: sinusoidal arrival rate between base_rps and peak_rps
+  (thinned-Poisson sampling);
+- multi-tenant skew: tenants drawn from a Zipf-like weight vector, each
+  with a shared per-tenant system-prefix block (cross-request reuse);
+- agentic multi-turn: a fraction of arrivals continue an open session
+  (prompt grows by the previous turn), the rest open new sessions;
+- long-context tails: a small fraction of prompts inflated ~8-16×.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import random
+from typing import Dict, Iterator, List, Optional
+
+__all__ = ["RequestSpec", "Workload", "generate_workload", "diurnal_rate"]
+
+
+@dataclasses.dataclass
+class RequestSpec:
+    at: float
+    rid: str
+    tenant: str
+    session: str
+    turn: int
+    isl: int
+    osl: int
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RequestSpec":
+        return cls(at=float(d["at"]), rid=d["rid"], tenant=d["tenant"],
+                   session=d["session"], turn=int(d["turn"]),
+                   isl=int(d["isl"]), osl=int(d["osl"]))
+
+
+class Workload:
+    def __init__(self, specs: List[RequestSpec]):
+        self.specs = sorted(specs, key=lambda s: (s.at, s.rid))
+
+    def __len__(self) -> int:
+        return len(self.specs)
+
+    def __iter__(self) -> Iterator[RequestSpec]:
+        return iter(self.specs)
+
+    @property
+    def duration_s(self) -> float:
+        return self.specs[-1].at if self.specs else 0.0
+
+    def save_jsonl(self, path: str) -> None:
+        with open(path, "w") as f:
+            for s in self.specs:
+                f.write(json.dumps(s.to_dict(), sort_keys=True) + "\n")
+
+    @classmethod
+    def load_jsonl(cls, path: str) -> "Workload":
+        specs = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    specs.append(RequestSpec.from_dict(json.loads(line)))
+        return cls(specs)
+
+
+def diurnal_rate(t: float, base_rps: float, peak_rps: float,
+                 period_s: float, phase: float = 0.0) -> float:
+    """Sinusoidal arrival rate: base at the trough, peak at the crest —
+    one ``period_s`` models a compressed diurnal cycle."""
+    mid = (base_rps + peak_rps) / 2.0
+    amp = (peak_rps - base_rps) / 2.0
+    return mid + amp * math.sin(2.0 * math.pi * (t / period_s) + phase)
+
+
+@dataclasses.dataclass
+class _Session:
+    sid: str
+    turn: int
+    context_tokens: int      # accumulated prompt length (isl of next turn
+    #                          starts from here)
+
+
+def generate_workload(duration_s: float, seed: int = 0, *,
+                      base_rps: float = 2.0, peak_rps: float = 8.0,
+                      period_s: Optional[float] = None,
+                      tenants: int = 8, zipf_a: float = 1.1,
+                      agentic_frac: float = 0.35,
+                      long_tail_frac: float = 0.04,
+                      isl_base: int = 256, isl_spread: int = 768,
+                      osl_base: int = 24, osl_spread: int = 104,
+                      burst_at: Optional[float] = None,
+                      burst_len_s: float = 0.0,
+                      burst_factor: float = 1.0) -> Workload:
+    """The mixed default trace: diurnal burst x multi-tenant skew x
+    agentic multi-turn x long-context tails. ``burst_*`` overlays a
+    square-wave surge (the scale-storm ingredient) on the diurnal base.
+    """
+    rng = random.Random(seed)
+    period = period_s or duration_s
+    # Zipf-like tenant weights
+    weights = [1.0 / (i + 1) ** zipf_a for i in range(tenants)]
+    wsum = sum(weights)
+    weights = [w / wsum for w in weights]
+    tenant_ids = [f"t{i:02d}" for i in range(tenants)]
+    open_sessions: Dict[str, List[_Session]] = {t: [] for t in tenant_ids}
+    session_count = 0
+
+    rate_max = max(peak_rps, base_rps) * max(burst_factor, 1.0)
+    specs: List[RequestSpec] = []
+    t = 0.0
+    n = 0
+    while True:
+        # thinned Poisson: candidate arrivals at the envelope rate,
+        # accepted with probability rate(t)/rate_max
+        t += rng.expovariate(rate_max)
+        if t >= duration_s:
+            break
+        rate = diurnal_rate(t, base_rps, peak_rps, period)
+        if (burst_at is not None and burst_at <= t < burst_at + burst_len_s):
+            rate *= burst_factor
+        if rng.random() * rate_max > rate:
+            continue
+        tenant = rng.choices(tenant_ids, weights=weights)[0]
+        sessions = open_sessions[tenant]
+        osl = osl_base + int(rng.random() * osl_spread)
+        if sessions and rng.random() < agentic_frac:
+            # continue an open session: prompt = full prior context +
+            # fresh user turn (deep prefix reuse)
+            s = rng.choice(sessions)
+            s.turn += 1
+            new_user = 32 + int(rng.random() * 128)
+            isl = s.context_tokens + new_user
+        else:
+            session_count += 1
+            s = _Session(sid=f"{tenant}-s{session_count:05d}", turn=0,
+                         context_tokens=0)
+            sessions.append(s)
+            if len(sessions) > 32:      # bound open-session memory
+                sessions.pop(0)
+            isl = isl_base + int(rng.random() * isl_spread)
+            if rng.random() < long_tail_frac:
+                isl *= 8 + int(rng.random() * 8)   # long-context tail
+        s.context_tokens = isl + osl
+        specs.append(RequestSpec(
+            at=round(t, 6), rid=f"r{n:06d}", tenant=tenant, session=s.sid,
+            turn=s.turn, isl=isl, osl=osl))
+        n += 1
+    return Workload(specs)

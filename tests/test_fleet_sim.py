@@ -1,0 +1,333 @@
+"""Fleet-scale co-simulation (dynamo_tpu/sim/, docs/fleet_sim.md).
+
+The acceptance surface of ISSUE 9:
+
+- 200+ virtual replicas serve a full simulated hour of bursty
+  trace-driven traffic on CPU inside an explicit wall-clock budget, with
+  the REAL planner + KvScheduler + disagg-retune code in the loop;
+- scale-storm and drain-storm scenarios assert SLO attainment and zero
+  dropped in-flight requests;
+- a fixed seed reproduces a BYTE-IDENTICAL event log (the determinism
+  gate — the DL005 wall-clock/randomness discipline extended to the sim
+  core by test);
+- the planner's anti-thrash hysteresis holds under oscillating load;
+- the fleet fetch-vs-recompute crossover floors the disagg retune
+  (fast fabric lowers freely, slow fabric holds).
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from dynamo_tpu.sim.clock import (REAL_PERF_COUNTER, VirtualClock,
+                                  run_simulation)
+from dynamo_tpu.sim.profiles import BehaviorProfile
+from dynamo_tpu.sim.scenarios import SCENARIOS, run_scenario
+from dynamo_tpu.sim.workload import Workload, generate_workload
+
+pytestmark = pytest.mark.sim
+
+# Explicit wall-clock budgets (seconds of REAL time). The flagship
+# 200-replica hour historically runs in ~55s on a dev box; the budget
+# leaves CI headroom without letting the suite rot into minutes.
+WALL_BUDGET_HOUR_S = float(os.environ.get("SIM_WALL_BUDGET_HOUR", "300"))
+WALL_BUDGET_STORM_S = float(os.environ.get("SIM_WALL_BUDGET_STORM", "120"))
+
+
+# ------------------------------------------------------------ virtual clock
+def test_virtual_clock_advances_without_wall_time():
+    """A simulated hour of sleeps costs (much) less than a second of
+    wall time, and virtual time.monotonic() is patched consistently."""
+    import time as _time
+
+    async def main():
+        t0 = _time.monotonic()
+        await asyncio.sleep(3600.0)
+        return _time.monotonic() - t0
+
+    w0 = REAL_PERF_COUNTER()
+    elapsed_virtual = run_simulation(main)
+    wall = REAL_PERF_COUNTER() - w0
+    assert elapsed_virtual == pytest.approx(3600.0, abs=1e-3)
+    assert wall < 5.0
+    # patch restored
+    assert _time.monotonic is not None
+    t0 = _time.monotonic()
+    _ = _time.monotonic() - t0   # real clock callable again
+
+
+def test_virtual_clock_timer_ordering():
+    """Timers fire in virtual-time order regardless of schedule order."""
+    order = []
+
+    async def main():
+        loop = asyncio.get_running_loop()
+        loop.call_later(3.0, lambda: order.append("c"))
+        loop.call_later(1.0, lambda: order.append("a"))
+        loop.call_later(2.0, lambda: order.append("b"))
+        await asyncio.sleep(4.0)
+
+    run_simulation(main)
+    assert order == ["a", "b", "c"]
+
+
+def test_virtual_clock_deadlock_detected():
+    """Waiting on I/O that can never arrive fails loudly instead of
+    hanging the suite."""
+
+    async def main():
+        await asyncio.get_running_loop().create_future()
+
+    with pytest.raises(RuntimeError, match="deadlock"):
+        run_simulation(main)
+
+
+# --------------------------------------------------------------- workload
+def test_workload_generator_deterministic_and_bursty():
+    a = generate_workload(600.0, seed=3)
+    b = generate_workload(600.0, seed=3)
+    c = generate_workload(600.0, seed=4)
+    assert [s.to_dict() for s in a] == [s.to_dict() for s in b]
+    assert [s.to_dict() for s in a] != [s.to_dict() for s in c]
+    assert len(a) > 100
+    # agentic continuation: some specs are turn > 0 with grown prompts
+    turns = [s for s in a if s.turn > 0]
+    assert turns, "no multi-turn traffic generated"
+    by_session = {}
+    for s in a:
+        by_session.setdefault(s.session, []).append(s)
+    multi = [v for v in by_session.values() if len(v) > 1]
+    assert multi and all(v[0].isl < v[-1].isl for v in multi[:5]), \
+        "session prompts must grow turn over turn (prefix reuse)"
+
+
+def test_workload_trace_roundtrip(tmp_path):
+    wl = generate_workload(300.0, seed=1)
+    p = tmp_path / "trace.jsonl"
+    wl.save_jsonl(str(p))
+    back = Workload.load_jsonl(str(p))
+    assert [s.to_dict() for s in back] == [s.to_dict() for s in wl]
+
+
+# --------------------------------------------------------------- profiles
+def test_behavior_profile_parse_and_semantics():
+    p = BehaviorProfile.parse("slow-start:30:5,latency:2")
+    assert p.slow_start_s == 30 and p.slow_start_factor == 5
+    assert p.latency_factor == 2
+    # young: 5x slow-start on top of 2x latency inflation
+    assert p.speed_factor(0.0) == pytest.approx(0.1)
+    assert p.speed_factor(31.0) == pytest.approx(0.5)
+    q = BehaviorProfile.parse("crash-at:120,drain-ignore")
+    assert q.crash_at_s == 120 and q.drain_ignore
+    assert BehaviorProfile.parse("").speed_factor(0.0) == 1.0
+    with pytest.raises(ValueError):
+        BehaviorProfile.parse("warp-speed:9")
+
+
+@pytest.mark.asyncio
+async def test_mock_worker_profiles_live():
+    """The SAME profile vocabulary drives the live mock worker: crash-at
+    stops the worker (discovery entry gone), drain-ignore makes it deaf
+    to the planner's drain key."""
+    from dynamo_tpu.components.mock_worker import MockTokenWorker
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = DistributedRuntime.in_process()
+    # distinct components: one in-process runtime = one lease = one
+    # serve subject per endpoint
+    deaf = await MockTokenWorker(rt, "dyn://simprof/deaf/generate",
+                                 block_size=4, profile="drain-ignore",
+                                 publish_traces=False).start()
+    doomed = await MockTokenWorker(rt, "dyn://simprof/doomed/generate",
+                                   block_size=4, profile="crash-at:0.3",
+                                   publish_traces=False).start()
+    try:
+        assert len(await rt.store.kv_get_prefix(
+            deaf.endpoint.discovery_prefix())) == 1
+        # drain request for the deaf worker: it must NOT flip draining
+        await rt.store.kv_put(deaf.endpoint.drain_key(deaf.worker_id),
+                              b"{}")
+        await asyncio.sleep(0.2)
+        assert not deaf.draining
+        # the doomed worker crashes on schedule: discovery entry gone
+        for _ in range(40):
+            if doomed.crashed:
+                break
+            await asyncio.sleep(0.05)
+        assert doomed.crashed
+        assert await rt.store.kv_get_prefix(
+            doomed.endpoint.discovery_prefix()) == []
+        assert len(await rt.store.kv_get_prefix(
+            deaf.endpoint.discovery_prefix())) == 1
+    finally:
+        await deaf.stop()
+        try:
+            await doomed.stop()
+        except Exception:  # noqa: BLE001 — already stopped by the crash
+            pass
+        await rt.shutdown()
+
+
+# ------------------------------------------------------ fleet crossover
+def test_fleet_crossover_tokens_math():
+    from dynamo_tpu.llm.kv_router.scoring import (crossover_tokens,
+                                                  fleet_crossover_tokens)
+    fast = {"prefill_tok_per_s": 3000.0, "remote_link_gbps": 10.0,
+            "remote_link_rtt_s": 1e-3, "kv_bytes_per_block": 1 << 20,
+            "kv_block_size": 32}
+    xo = crossover_tokens(fast)
+    assert xo is not None and 0 < xo < 100
+    # per-token transfer slower than recompute → the link never pays
+    slow = dict(fast, remote_link_gbps=0.05)
+    assert crossover_tokens(slow) == float("inf")
+    # absent inputs (old payload / no fabric) → None, drops out
+    assert crossover_tokens({}) is None
+    med = fleet_crossover_tokens({1: fast, 2: slow, 3: {}})
+    assert med == crossover_tokens(slow)   # median of [xo, inf]
+    assert fleet_crossover_tokens({}) is None
+
+
+# -------------------------------------------------------------- scenarios
+def test_scale_storm_slo_attainment():
+    w0 = REAL_PERF_COUNTER()
+    r = run_scenario("scale_storm", seed=0)
+    assert REAL_PERF_COUNTER() - w0 < WALL_BUDGET_STORM_S
+    assert r["violations"] == [], r["violations"]
+    assert r["requests"]["dropped"] == 0
+    assert r["planner"]["counters"]["scale_up"] >= 2
+    assert r["replicas"]["peak"] > r["replicas"]["start"]
+    assert r["slo"]["late_attainment"] >= 0.85
+
+
+def test_drain_storm_zero_dropped_in_flight():
+    w0 = REAL_PERF_COUNTER()
+    r = run_scenario("drain_storm", seed=0)
+    assert REAL_PERF_COUNTER() - w0 < WALL_BUDGET_STORM_S
+    assert r["violations"] == [], r["violations"]
+    # the headline contract: every admitted request completed — nothing
+    # dropped, nothing cut by a forced retire — while the fleet shrank
+    assert r["requests"]["dropped"] == 0
+    assert r["requests"]["completed"] == r["requests"]["arrived"]
+    assert r["requests"]["forced_exits"] == 0
+    assert r["requests"]["clean_exits"] >= 8
+    assert r["replicas"]["end"] < r["replicas"]["start"]
+
+
+def test_crash_cascade_retries_absorb():
+    r = run_scenario("crash_cascade", seed=0)
+    assert r["violations"] == [], r["violations"]
+    assert r["requests"]["crashes"] == 5
+    assert r["requests"]["lost"] > 0          # crashes DID cut requests
+    assert r["requests"]["dropped"] == 0      # ...and retries absorbed all
+    assert r["requests"]["completed"] == r["requests"]["arrived"]
+
+
+def test_prefix_flush_eviction_storm():
+    r = run_scenario("prefix_flush", seed=0)
+    assert r["violations"] == [], r["violations"]
+
+
+def test_planner_anti_thrash_under_oscillating_load():
+    """Satellite: load oscillates across the scale-up boundary faster
+    than the breach-cycle window — the REAL planner's hysteresis must
+    hold (no scale flapping), while the boundary is demonstrably
+    crossed."""
+    r = run_scenario("oscillate", seed=0)
+    assert r["violations"] == [], r["violations"]
+    c = r["planner"]["counters"]
+    assert c["scale_up"] + c["drains_started"] <= 1
+    assert c["evaluations"] > 100
+
+
+def test_disagg_retune_crossover_floor():
+    """Satellite: the planner's disagg retune consumes fleet-level
+    fetch-vs-recompute crossover stats end-to-end. A fast fabric
+    (crossover ~ a few tokens) lowers the threshold freely; a fabric
+    whose links never pay (crossover inf) HOLDS every attempted
+    lowering at the floor."""
+    fast = run_scenario("disagg_retune", seed=0)
+    assert fast["violations"] == [], fast["violations"]
+    assert fast["planner"]["counters"]["retunes"] >= 2
+    assert fast["planner"]["counters"]["retune_crossover_holds"] == 0
+
+    slow = run_scenario("disagg_retune", seed=0, link_gbps=0.05,
+                        link_rtt_s=0.5)
+    assert slow["planner"]["counters"]["retune_crossover_holds"] > 0
+    # threshold went up under queue pressure but never came back down:
+    # every threshold in the retune sequence is monotonically >= prior
+    assert slow["planner"]["disagg_threshold"] >= \
+        fast["planner"]["disagg_threshold"]
+
+
+# ------------------------------------------------------------ determinism
+def test_event_log_byte_identical_same_seed():
+    """The determinism gate: same (scenario, seed) → byte-identical
+    event log; different seed → different log. (The sim core never
+    reads the wall clock or unseeded randomness — the DL005 discipline
+    outside jit, enforced here.)"""
+    a = run_scenario("scale_storm", seed=7, duration_s=450.0)
+    b = run_scenario("scale_storm", seed=7, duration_s=450.0)
+    c = run_scenario("scale_storm", seed=8, duration_s=450.0)
+    assert a["event_log_digest"] == b["event_log_digest"]
+    assert a["events"] == b["events"]
+    assert a["event_log_digest"] != c["event_log_digest"]
+
+
+# ------------------------------------------------------- the flagship hour
+def test_fleet_hour_200_replicas_real_control_plane():
+    """ISSUE 9 acceptance: >= 200 virtual replicas through >= 1 simulated
+    hour of bursty trace-driven traffic on CPU, real planner +
+    KvScheduler + disagg-retune code in the loop, inside an explicit
+    wall budget."""
+    w0 = REAL_PERF_COUNTER()
+    r = run_scenario("baseline_hour", seed=0)
+    wall = REAL_PERF_COUNTER() - w0
+    assert wall < WALL_BUDGET_HOUR_S, \
+        f"simulated hour took {wall:.0f}s wall (budget " \
+        f"{WALL_BUDGET_HOUR_S:.0f}s)"
+    assert r["violations"] == [], r["violations"]
+    assert r["replicas"]["start"] >= 200
+    assert r["virtual_s"] >= 3600.0
+    assert r["requests"]["arrived"] > 12000
+    assert r["requests"]["dropped"] == 0
+    assert r["slo"]["ttft_attainment"] >= 0.9
+    # the REAL control plane demonstrably ran: planner evaluated and
+    # published status, the radix/scheduler path routed every request,
+    # prefix reuse materialized through the real indexer
+    assert r["planner"]["counters"]["evaluations"] >= 100
+    assert r["router"]["kv_events"] > 1000
+    assert r["router"]["hit_rate_blocks"] > 0.05
+
+
+# ------------------------------------------------------------------ CLI
+def test_fleetsim_cli_smoke(capsys):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, os.path.join(repo, "tools", "fleetsim.py"),
+         "--scenario", "oscillate", "--seed", "1",
+         "--duration", "620", "--json"],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rep["scenario"] == "oscillate"
+    assert rep["event_log_digest"]
+    # --list in-process (the modules are already imported; a second
+    # subprocess would just re-pay the cold import)
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import fleetsim
+        assert fleetsim.main(["--list"]) == 0
+    finally:
+        sys.path.pop(0)
+    listing = capsys.readouterr().out
+    for name in SCENARIOS:
+        assert name in listing
+
+
+def test_virtual_clock_reexports():
+    assert isinstance(VirtualClock().monotonic(), float)

@@ -123,6 +123,67 @@ def test_admission_gate_accepts_and_rejects_both_ways():
         AdmissionGate(1, 1, 1.0, mode="sometimes")
 
 
+def test_prefill_rate_estimator_age_weights_young_engine():
+    """ROADMAP item (c), first half: a synthetic young-engine sample
+    stream — the first admissions XLA-compile-inflated (~100 tok/s),
+    steady state ~10k tok/s. The age-weighted estimator must (a) report
+    'unknown' (0.0 → gate admits) during warmup instead of a garbage
+    rate, and (b) converge to the steady rate, where the old cumulative
+    tokens/wall estimator stays skewed ~3x low."""
+    from dynamo_tpu.llm.kv.fabric import PrefillRateEstimator
+    est = PrefillRateEstimator(warmup_samples=2, alpha=0.3)
+    # young engine: two compile-inflated admissions
+    stream = [(512, 5.0), (512, 4.0)] + [(512, 0.05)] * 20
+    total_tok = total_wall = 0.0
+    for tok, wall in stream[:2]:
+        est.observe(tok, wall)
+        total_tok += tok
+        total_wall += wall
+        assert est.rate() == 0.0        # warmup: unknown, gate admits
+    assert est.warmup_skipped == 2
+    for tok, wall in stream[2:]:
+        est.observe(tok, wall)
+        total_tok += tok
+        total_wall += wall
+    steady = 512 / 0.05
+    assert est.rate() == pytest.approx(steady, rel=0.01)
+    # the estimator this replaces: cumulative mean, still ~3x low after
+    # 20 steady admissions — the skew the satellite kills
+    cumulative = total_tok / total_wall
+    assert cumulative < 0.4 * steady
+    # decay: one anomalous slow admission moves the EMA by at most alpha
+    est.observe(512, 5.0)
+    assert est.rate() > (1 - 0.31) * steady
+    # degenerate inputs ignored
+    est.observe(0, 1.0)
+    est.observe(512, 0.0)
+    assert est.samples == len(stream) + 1
+
+
+def test_prefill_rate_estimator_feeds_engine_measured_rate():
+    """EngineCore.measured_prefill_tok_per_s delegates to the estimator
+    (construction-level check: no live engine needed — the estimator
+    object is the one the admission gate closure reads)."""
+    from dynamo_tpu.llm.kv.fabric import PrefillRateEstimator
+
+    class _Core:
+        # mirrors the EngineCore wiring (engine/core.py)
+        def __init__(self):
+            self.prefill_rate_estimator = PrefillRateEstimator()
+
+        def measured_prefill_tok_per_s(self) -> float:
+            return self.prefill_rate_estimator.rate()
+
+    core = _Core()
+    gate = AdmissionGate(1 << 20, 16,
+                         prefill_tok_per_s=core.measured_prefill_tok_per_s)
+    slow = LinkStats(rtt_s=0.5, gbps=1e-4)
+    assert gate.admit(4, slow)            # young → unknown → admit
+    for _ in range(3):
+        core.prefill_rate_estimator.observe(4096, 0.1)   # warmed: 41k tok/s
+    assert not gate.admit(4, slow)        # warmed → slow link loses
+
+
 def test_peer_link_table_probe_then_decay_average():
     links = PeerLinkTable(default_gbps=1.0, default_rtt_s=1e-3)
     links.observe_rtt(7, 0.010)

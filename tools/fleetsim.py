@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""fleetsim: run fleet-scale co-simulation scenarios (docs/fleet_sim.md).
+
+The discrete-event fleet simulator (dynamo_tpu/sim/) drives the REAL
+control plane — SLA planner, KV router, disagg-threshold retune, fabric
+admission gate — against hundreds of simulated replicas on a virtual
+clock. This CLI runs one named scenario and prints its report.
+
+Examples:
+
+    python tools/fleetsim.py --list
+    python tools/fleetsim.py --scenario scale_storm --seed 3
+    python tools/fleetsim.py --scenario baseline_hour --replicas 300 \\
+        --duration 7200 --report out.json --event-log events.jsonl
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="fleetsim", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--scenario", default=None,
+                   help="scenario name (see --list)")
+    p.add_argument("--list", action="store_true",
+                   help="list scenarios and exit")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--replicas", type=int, default=None,
+                   help="override the scenario's replica count")
+    p.add_argument("--duration", type=float, default=None,
+                   help="override the scenario's duration (virtual s)")
+    p.add_argument("--report", default=None,
+                   help="write the full report JSON here")
+    p.add_argument("--event-log", default=None,
+                   help="write the deterministic event log (JSONL) here")
+    p.add_argument("--json", action="store_true",
+                   help="print the report as one JSON line (tooling mode)")
+    args = p.parse_args(argv)
+
+    from dynamo_tpu.sim.scenarios import SCENARIOS
+
+    if args.list or args.scenario is None:
+        print("scenarios:")
+        for name, sc in SCENARIOS.items():
+            print(f"  {name:16s} {sc.description}")
+        return 0
+    if args.scenario not in SCENARIOS:
+        print(f"unknown scenario {args.scenario!r}; --list shows them",
+              file=sys.stderr)
+        return 2
+
+    overrides = {}
+    if args.replicas is not None:
+        overrides["replicas"] = args.replicas
+    if args.duration is not None:
+        overrides["duration_s"] = args.duration
+
+    report = _run(args.scenario, args.seed, overrides, args.event_log)
+
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        _print_report(report)
+    return 1 if report["violations"] else 0
+
+
+def _run(name: str, seed: int, overrides: dict, event_log_path):
+    """run_scenario, optionally capturing the event log to a file (the
+    capture rides the same deterministic JSONL serialization the digest
+    is computed over)."""
+    from dynamo_tpu.sim import scenarios as _sc
+    from dynamo_tpu.sim.clock import REAL_PERF_COUNTER, run_simulation
+    from dynamo_tpu.sim.fleet import SimFleet
+
+    sc = _sc.SCENARIOS[name]
+    cfg, wl, faults, run_s = sc.build(seed, **overrides)
+
+    async def main_coro():
+        fleet = await SimFleet(cfg, seed=seed).start()
+        t0 = REAL_PERF_COUNTER()
+        await fleet.run(wl, faults=faults, duration_s=run_s)
+        report = fleet.report(wall_s=REAL_PERF_COUNTER() - t0)
+        report["scenario"] = name
+        report["slo"]["late_attainment"] = round(
+            _sc._late_attainment(fleet, cfg.slo), 4)
+        report["violations"] = sc.check(fleet, report)
+        log_bytes = fleet.log.to_jsonl_bytes() if event_log_path else None
+        await fleet.stop()
+        return report, log_bytes
+
+    report, log_bytes = run_simulation(main_coro)
+    if event_log_path:
+        with open(event_log_path, "wb") as f:
+            f.write(log_bytes)
+    return report
+
+
+def _print_report(r: dict) -> None:
+    req = r["requests"]
+    lat = r["latency_ms"]
+    print(f"scenario {r['scenario']} seed={r['seed']}  "
+          f"virtual {r['virtual_s']:.0f}s  wall {r.get('wall_s', 0):.1f}s")
+    print(f"  replicas  start={r['replicas']['start']} "
+          f"peak={r['replicas']['peak']} end={r['replicas']['end']}")
+    print(f"  requests  arrived={req['arrived']} "
+          f"completed={req['completed']} dropped={req['dropped']} "
+          f"retried={req['retried']} remote_prefill={req['remote_prefills']}")
+    p50 = lat["ttft_p50"]
+    p90 = lat["ttft_p90"]
+    p99 = lat["ttft_p99"]
+    print(f"  ttft_ms   p50={p50 and round(p50)} p90={p90 and round(p90)} "
+          f"p99={p99 and round(p99)}  attainment="
+          f"{r['slo']['ttft_attainment']} (late "
+          f"{r['slo'].get('late_attainment')})")
+    print(f"  router    hit_rate={r['router']['hit_rate_blocks']} "
+          f"kv_events={r['router']['kv_events']} "
+          f"fabric_fetch_blocks={r['router']['fabric_fetch_blocks']}")
+    if "planner" in r:
+        c = {k: v for k, v in r["planner"]["counters"].items() if v}
+        print(f"  planner   {c} disagg_threshold="
+              f"{r['planner']['disagg_threshold']}")
+    print(f"  events    {r['events']}  digest "
+          f"{r['event_log_digest'][:16]}…")
+    if r["violations"]:
+        print("  VIOLATIONS:")
+        for v in r["violations"]:
+            print(f"    - {v}")
+    else:
+        print("  checks    all expectations held")
+
+
+if __name__ == "__main__":
+    sys.exit(main())
