@@ -257,6 +257,163 @@ def run_pp_bench(pp: int) -> dict:
     }
 
 
+def ragged_mode() -> bool:
+    """Unified-ragged-dispatch bench mode (--ragged or BENCH_RAGGED=1):
+    mixed-traffic A/B between the split prefill/decode program path and
+    the one-program ragged path (ISSUE 10). One parse home for main()
+    and the smoke tests."""
+    on = os.environ.get("BENCH_RAGGED", "0") != "0"
+    return on or any(a == "--ragged" for a in sys.argv[1:])
+
+
+def run_ragged_bench(mcfg) -> dict:
+    """Mixed-traffic A/B: the SAME staggered prompt workload served by
+    (a) the split path — per-bucket prefill programs + the batched
+    decode program, composed on the host — and (b) the unified ragged
+    path, where ONE compiled program carries prefill chunks and decode
+    rows together (engine/ragged.py; docs/ragged_attention.md).
+
+    Reported: dispatches issued per emitted token (the batch-boundary
+    bubble count), the ragged path's tokens-per-dispatch fill and
+    mixed-batch ratios, COMPILED-program counts (jit cache entries
+    actually populated — the compile-time + program-HBM footprint), and
+    each path's compile wall. Token streams are compared up to each
+    request's first numeric boundary (ragged admissions derive the
+    first token through the ragged program — the lane-prefill numeric
+    contract; every stream is exact past admission by the per-row
+    bit-exactness the ragged tests gate)."""
+    import asyncio
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import (FINISH_SENTINEL, EngineCore,
+                                        EngineRequest)
+    from dynamo_tpu.engine.sampling import SlotSampling
+
+    B = int(os.environ.get("BENCH_RAGGED_BATCH", "4"))
+    n_req = int(os.environ.get("BENCH_RAGGED_REQUESTS", str(3 * B)))
+    p_len = int(os.environ.get("BENCH_RAGGED_PROMPT", "48"))
+    max_new = int(os.environ.get("BENCH_RAGGED_NEW", "16"))
+    rows = int(os.environ.get("BENCH_RAGGED_SEQ_ROWS", "16"))
+    bs = int(os.environ.get("BENCH_RAGGED_KV_BS", "16"))
+    max_len = p_len + max_new + 2 * bs
+    blocks = B * ((max_len + bs - 1) // bs) + n_req + 2
+    base = dict(max_model_len=max_len, kv_block_size=bs,
+                num_kv_blocks=blocks, max_num_seqs=B,
+                prefill_buckets=sorted({p_len // 2, p_len, max_len}),
+                seed=0)
+
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, mcfg.vocab_size,
+                            size=int(l)).tolist()
+               for l in rng.integers(p_len // 3, p_len + 1,
+                                     size=n_req)]
+
+    async def serve_one(core, prompt, rid):
+        req = EngineRequest(rid=rid, prompt=list(prompt),
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=max_new, eos_ids=frozenset())
+        await core.submit(req)
+        toks = []
+        while True:
+            item, payload = await asyncio.wait_for(req.out_queue.get(),
+                                                   120)
+            if item is FINISH_SENTINEL:
+                return toks, req
+            toks.append(item)
+
+    async def drive(core):
+        # staggered submission: later requests admit while earlier
+        # ones decode, so prefill work genuinely contends with decode
+        # dispatches (the mixed-traffic shape the ragged batch packs)
+        async def delayed(i):
+            await asyncio.sleep(0.02 * i)
+            return await serve_one(core, prompts[i], f"r{i}")
+        return await asyncio.gather(*[delayed(i)
+                                      for i in range(n_req)])
+
+    def run_path(cfg) -> dict:
+        core = EngineCore(mcfg, cfg, attn_impl="auto",
+                          param_dtype=jnp.bfloat16)
+
+        async def run_all():
+            res = await drive(core)
+            await core.stop()
+            return res
+
+        t0 = time.monotonic()
+        results = asyncio.run(run_all())
+        wall_s = time.monotonic() - t0
+        kinds = core.flight.stats().get("kinds", {})
+        emitted = sum(len(t) for t, _ in results)
+        # compiled-program count: jit cache entries actually populated
+        # (each prefill bucket shape is its own executable)
+        jits = [core._prefill_jit, core._decode_jit, core._decode_k_jit,
+                core._verify_jit, core._ragged_jit, core._merge_jit]
+        compiled = sum(int(f._cache_size()) for f in jits
+                       if f is not None and hasattr(f, "_cache_size"))
+        return {
+            "core": core,
+            "streams": [t for t, _ in results],
+            "boundaries": [list(r.numeric_boundaries)
+                           for _, r in results],
+            "emitted": emitted,
+            "wall_s": wall_s,
+            "dispatches": (core.ragged_dispatches
+                           if cfg.ragged_dispatch else
+                           kinds.get("prefill", 0)
+                           + kinds.get("decode", 0)),
+            "compiled_programs": compiled,
+        }
+
+    split = run_path(EngineConfig(**base, decode_steps_per_dispatch=1))
+    rag = run_path(EngineConfig(**base, ragged_dispatch=True,
+                                ragged_max_seq_rows=rows))
+    rcore = rag["core"]
+
+    # stream agreement up to each request's first numeric boundary
+    # (the lane-admission contract; tests/test_ragged_attention.py
+    # gates full exactness against a lane-mode reference)
+    exact_to_boundary = True
+    for ts, tr, bounds in zip(split["streams"], rag["streams"],
+                              rag["boundaries"]):
+        bound = min(bounds) if bounds else min(len(ts), len(tr))
+        if ts[:bound] != tr[:bound]:
+            exact_to_boundary = False
+    out = {
+        "requests": n_req,
+        "emitted_tokens": rag["emitted"],
+        "split_dispatches": split["dispatches"],
+        "ragged_dispatches": rag["dispatches"],
+        "split_dispatches_per_token": round(
+            split["dispatches"] / max(split["emitted"], 1), 4),
+        "ragged_dispatches_per_token": round(
+            rag["dispatches"] / max(rag["emitted"], 1), 4),
+        "ragged_fill_ratio": round(
+            rcore.ragged_rows_total
+            / max(rcore.ragged_dispatches
+                  * rcore.cfg.ragged_max_tokens, 1), 4),
+        "ragged_mixed_ratio": round(
+            rcore.ragged_mixed_dispatches
+            / max(rcore.ragged_dispatches, 1), 4),
+        "ragged_dispatches_saved": rcore.ragged_dispatches_saved,
+        "split_compiled_programs": split["compiled_programs"],
+        "ragged_compiled_programs": rag["compiled_programs"],
+        "split_wall_s": round(split["wall_s"], 3),
+        "ragged_wall_s": round(rag["wall_s"], 3),
+        "tokens_exact_to_boundary": exact_to_boundary,
+    }
+    print(f"# ragged A/B: dispatches {out['split_dispatches']} -> "
+          f"{out['ragged_dispatches']}, compiled programs "
+          f"{out['split_compiled_programs']} -> "
+          f"{out['ragged_compiled_programs']}, fill "
+          f"{out['ragged_fill_ratio']}, mixed "
+          f"{out['ragged_mixed_ratio']}", file=sys.stderr)
+    return out
+
+
 def kv_frag_mode() -> bool:
     """Contiguity A/B bench mode (--kv-frag or BENCH_KV_FRAG=1): the
     same decode workload over the run-allocator's contiguous layout vs
@@ -1299,6 +1456,13 @@ def main() -> None:
         # interleaved steady-state step time + the modeled DCN story
         pp_res = run_pp_bench(pp_mode())
 
+    ragged_res = None
+    if ragged_mode():
+        # independent two-engine A/B (same geometry/seed → identical
+        # weights): the split prefill/decode program path vs the
+        # unified ragged dispatch over one staggered mixed workload
+        ragged_res = run_ragged_bench(mcfg)
+
     # device truth is the headline number; the wall loop (host scheduler
     # + tunnel round-trips) rides along in extra. The wall throughput can
     # never exceed the per-step device ceiling when both time the same
@@ -1381,6 +1545,11 @@ def main() -> None:
         # pipeline-parallel provenance: interleaved-vs-bubbled step
         # ratio, per-stage utilization, modeled DCN boundary economics
         result["pp"] = pp_res
+    if ragged_res is not None:
+        # unified-ragged-dispatch provenance: dispatches/token and
+        # compiled-program count A/B vs the split path, fill + mixed
+        # ratios (ISSUE 10)
+        result["ragged"] = ragged_res
     _record_success(result)
     print(json.dumps(result))
 

@@ -95,6 +95,20 @@ _TIER_GAUGES = {
     "disk_spill_dropped_total": "nv_llm_kv_disk_spill_dropped_jobs_total",
 }
 
+# unified ragged dispatch (engine/ragged.py + docs/ragged_attention.md):
+# ForwardPassMetrics field → exported metric name. The Grafana "Ragged
+# dispatch" panel plots fill ratio (how close each unified dispatch
+# runs to its compiled token capacity — LOW fill under load means the
+# capacity is oversized or admissions are starving) next to the
+# mixed-batch ratio (prefill chunks actually riding decode dispatches —
+# the batch-boundary bubbles being eliminated) and the cumulative
+# split-path dispatches the packing saved.
+_RAGGED_GAUGES = {
+    "ragged_fill_ratio": "nv_llm_ragged_fill_ratio",
+    "ragged_mixed_ratio": "nv_llm_ragged_mixed_batch_ratio",
+    "ragged_dispatches_saved_total": "nv_llm_ragged_dispatches_saved_total",
+}
+
 # fleet tracing + engine flight recorder (runtime/tracing.py sampling
 # counter + engine/flight_recorder.py loop-lag probe): dropped log
 # lines rise by design when sampling is on; loop lag rising means the
@@ -178,6 +192,10 @@ class MetricsAggregatorService:
             f: Gauge(name, f"KV fabric (remote tier): worker {f} "
                      "(scraped stats)", labels, registry=self.registry)
             for f, name in _REMOTE_GAUGES.items()}
+        self._ragged_gauges: Dict[str, Gauge] = {
+            f: Gauge(name, f"ragged dispatch: worker {f} "
+                     "(scraped stats)", labels, registry=self.registry)
+            for f, name in _RAGGED_GAUGES.items()}
         self._trace_gauges: Dict[str, Gauge] = {
             f: Gauge(name, f"fleet tracing: worker {f} (scraped stats)",
                      labels, registry=self.registry)
@@ -322,6 +340,8 @@ class MetricsAggregatorService:
                 g.labels(*lbl).set(getattr(m, f))
             for f, g in self._remote_gauges.items():
                 g.labels(*lbl).set(getattr(m, f))
+            for f, g in self._ragged_gauges.items():
+                g.labels(*lbl).set(getattr(m, f))
             for f, g in self._trace_gauges.items():
                 g.labels(*lbl).set(getattr(m, f))
         # drop series for workers whose leases died (the watcher pruned them)
@@ -334,6 +354,7 @@ class MetricsAggregatorService:
                       + list(self._tier_gauges.values())
                       + list(self._layout_gauges.values())
                       + list(self._remote_gauges.values())
+                      + list(self._ragged_gauges.values())
                       + list(self._trace_gauges.values())):
                 try:
                     g.remove(*lbl)

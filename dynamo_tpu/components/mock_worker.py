@@ -284,6 +284,16 @@ class MockTokenWorker:
             d["kv_contig_runs"] = 1
             d["kv_contiguity_ratio"] = 1.0
             d["attn_dma_copies_per_wave"] = 2.0
+        if eng is not None and not d.get("ragged_fill_ratio"):
+            # synthetic ragged-dispatch gauges (docs/ragged_attention.md):
+            # a healthy unified-dispatch engine — ~70% token fill, a
+            # third of dispatches mixing prefill chunks into the decode
+            # batch, saved dispatches growing with served requests — so
+            # the nv_llm_ragged_* scrape path and the Grafana "Ragged
+            # dispatch" panels run with zero hardware
+            d["ragged_fill_ratio"] = 0.7
+            d["ragged_mixed_ratio"] = 0.33
+            d["ragged_dispatches_saved_total"] = eng.requests_served
         if eng is not None and not d.get("remote_link_gbps"):
             # synthetic KV-fabric gauges (docs/kv_fabric.md): a healthy
             # fabric — some object-tier residency, a ~10 GB/s / 1 ms

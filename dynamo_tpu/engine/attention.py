@@ -606,6 +606,127 @@ def dma_copy_counts(block_tables, seq_lens, *, block_size: int,
 
 
 # ---------------------------------------------------------------------------
+# Shared wave-DMA machinery (decode kernel + ragged kernel)
+# ---------------------------------------------------------------------------
+#
+# The round-7 run-coalesced DMA walk is the ONE home of the KV wave
+# fetch: a wave of `chunk` blocks streams either as one contiguous
+# [chunk*block_size, Cx] copy per KV stream (runs_ref said the blocks
+# are physically consecutive — wave_contig_table above) or as `chunk`
+# per-block copies. The ragged kernel below reuses it unchanged —
+# ragged waves are just variable-length contiguous runs, exactly the
+# shape the coalescing machinery was built for.
+
+
+def _make_wave_dma(block_tables_ref, runs_ref, k_hbm, v_hbm,
+                   k_bufs, v_bufs, sems, *, block_size: int, chunk: int,
+                   v_lanes: int | None, coalesce: bool):
+    """Build the `wave_dma(op, sq, ci, slot, nb)` closure both Pallas
+    kernels share. ``op`` is "start" or "wait"; ``sq`` the sequence row
+    in block_tables_ref; ``ci`` the wave (chunk) index; ``slot`` the
+    double-buffer slot; ``nb`` the sequence's valid block count (tail
+    clamp for the per-block path)."""
+
+    def block_copies(sq, ci, slot, nb):
+        """Per-block copies of sequence `sq`'s chunk `ci` into buffer
+        `slot` — 2*chunk (k and v), or chunk in v-aliases-k mode
+        (reconstructed identically at wait time; all on one
+        semaphore)."""
+        copies = []
+        for j in range(chunk):                 # static unroll
+            bi = ci * chunk + j
+            bi = jax.lax.select(bi < nb, bi, 0)  # clamp tail
+            blk = block_tables_ref[sq, bi]
+            copies.append(pltpu.make_async_copy(
+                k_hbm.at[pl.ds(blk * block_size, block_size), :],
+                k_bufs.at[slot, pl.ds(j * block_size, block_size), :],
+                sems.at[slot]))
+            if v_lanes is None:                # v aliases k otherwise
+                copies.append(pltpu.make_async_copy(
+                    v_hbm.at[pl.ds(blk * block_size, block_size), :],
+                    v_bufs.at[slot, pl.ds(j * block_size, block_size), :],
+                    sems.at[slot]))
+        return copies
+
+    def run_copies(sq, ci, slot):
+        """The coalesced form of one wave: the chunk blocks are
+        physically consecutive (runs_ref said so), so the WHOLE wave is
+        one [chunk*block_size, Cx] copy per KV stream — same bytes into
+        the same buffer region, chunk× fewer DMA issues."""
+        blk0 = block_tables_ref[sq, ci * chunk]
+        copies = [pltpu.make_async_copy(
+            k_hbm.at[pl.ds(blk0 * block_size, chunk * block_size), :],
+            k_bufs.at[slot], sems.at[slot])]
+        if v_lanes is None:
+            copies.append(pltpu.make_async_copy(
+                v_hbm.at[pl.ds(blk0 * block_size, chunk * block_size), :],
+                v_bufs.at[slot], sems.at[slot]))
+        return copies
+
+    def wave_dma(op, sq, ci, slot, nb):
+        """Start or wait one wave's DMAs, branching on the wave's
+        coalescibility. The runs table is immutable across the call, so
+        the wait reconstructs the exact copy set the start issued (and
+        either way the semaphore balances: one coalesced copy carries
+        the same byte count as the chunk per-block copies)."""
+        if not coalesce:
+            for c in block_copies(sq, ci, slot, nb):
+                getattr(c, op)()
+            return
+        contig = runs_ref[sq, ci] > 0
+
+        @pl.when(contig)
+        def _():
+            for c in run_copies(sq, ci, slot):
+                getattr(c, op)()
+
+        @pl.when(~contig)
+        def _():
+            for c in block_copies(sq, ci, slot, nb):
+                getattr(c, op)()
+
+    return wave_dma
+
+
+def _make_dequant_tile(quant_lanes: int | None, quant_sections,
+                       q_width: int):
+    """The kernels' in-VMEM int8 row dequant, shared by the decode and
+    ragged kernels. Returns (dequant_tile, dequant_tile_sections) — the
+    single- and sectioned-scale readers of the in-row (e, m) encoding
+    (quantize_kv_rows / quantize_kv_rows_sections)."""
+    C = quant_lanes if quant_lanes is not None else q_width
+
+    def dequant_tile(tile):
+        """[cbs, Cx] int8 tile → [cbs, C] f32 values, rescaled from the
+        in-row (e, m) lanes. Keepdim lane slices ([cbs, 1]) broadcast
+        along lanes with no sublane↔lane movement — the score-space
+        variant (scale as a [cbs] LANE vector) costs a transpose per
+        wave and measured slower than the DMA saving on v5e."""
+        scale = _decode_scale(tile[:, C:C + 1], tile[:, C + 1:C + 2])
+        return tile[:, :C].astype(jnp.float32) * scale
+
+    def dequant_tile_sections(tile):
+        """[cbs, Cx] sectioned-int8 tile → [cbs, q_width] f32: each
+        section rescaled by ITS (e, m) pair (pad lanes 2i, 2i+1 after
+        the values), zero lanes up to the query width — same keepdim
+        lane-broadcast shape as dequant_tile."""
+        Cs = sum(quant_sections)
+        parts = []
+        off = 0
+        for i, w in enumerate(quant_sections):
+            scale = _decode_scale(tile[:, Cs + 2 * i:Cs + 2 * i + 1],
+                                  tile[:, Cs + 2 * i + 1:Cs + 2 * i + 2])
+            parts.append(tile[:, off:off + w].astype(jnp.float32) * scale)
+            off += w
+        if q_width > Cs:
+            parts.append(jnp.zeros((tile.shape[0], q_width - Cs),
+                                   jnp.float32))
+        return jnp.concatenate(parts, axis=1)
+
+    return dequant_tile, dequant_tile_sections
+
+
+# ---------------------------------------------------------------------------
 # Decode: Pallas flash kernel streaming block-major KV from HBM
 # ---------------------------------------------------------------------------
 #
@@ -693,89 +814,14 @@ def _paged_attn_kernel(block_tables_ref, seq_lens_ref, win_lo_ref,
     quantized = quant_lanes is not None
     C = quant_lanes if quantized else q_ref.shape[-1]
 
-    def dequant_tile(tile):
-        """[cbs, Cx] int8 tile → [cbs, C] f32 values, rescaled from the
-        in-row (e, m) lanes. Keepdim lane slices ([cbs, 1]) broadcast
-        along lanes with no sublane↔lane movement — the score-space
-        variant (scale as a [cbs] LANE vector) costs a transpose per
-        wave and measured slower than the DMA saving on v5e."""
-        scale = _decode_scale(tile[:, C:C + 1], tile[:, C + 1:C + 2])
-        return tile[:, :C].astype(jnp.float32) * scale
-
-    def dequant_tile_sections(tile):
-        """[cbs, Cx] sectioned-int8 tile → [cbs, C] f32: each section
-        rescaled by ITS (e, m) pair (pad lanes 2i, 2i+1 after the
-        values), zero lanes up to the query width C — same keepdim
-        lane-broadcast shape as dequant_tile."""
-        Cs = sum(quant_sections)
-        parts = []
-        off = 0
-        for i, w in enumerate(quant_sections):
-            scale = _decode_scale(tile[:, Cs + 2 * i:Cs + 2 * i + 1],
-                                  tile[:, Cs + 2 * i + 1:Cs + 2 * i + 2])
-            parts.append(tile[:, off:off + w].astype(jnp.float32) * scale)
-            off += w
-        if C > Cs:
-            parts.append(jnp.zeros((tile.shape[0], C - Cs), jnp.float32))
-        return jnp.concatenate(parts, axis=1)
-
-    def block_copies(sq, ci, slot, nb):
-        """Per-block copies of sequence `sq`'s chunk `ci` into buffer
-        `slot` — 2*chunk (k and v), or chunk in v-aliases-k mode
-        (reconstructed identically at wait time; all on one
-        semaphore)."""
-        copies = []
-        for j in range(chunk):                 # static unroll
-            bi = ci * chunk + j
-            bi = jax.lax.select(bi < nb, bi, 0)  # clamp tail
-            blk = block_tables_ref[sq, bi]
-            copies.append(pltpu.make_async_copy(
-                k_hbm.at[pl.ds(blk * block_size, block_size), :],
-                k_bufs.at[slot, pl.ds(j * block_size, block_size), :],
-                sems.at[slot]))
-            if v_lanes is None:                # v aliases k otherwise
-                copies.append(pltpu.make_async_copy(
-                    v_hbm.at[pl.ds(blk * block_size, block_size), :],
-                    v_bufs.at[slot, pl.ds(j * block_size, block_size), :],
-                    sems.at[slot]))
-        return copies
-
-    def run_copies(sq, ci, slot):
-        """The coalesced form of one wave: the chunk blocks are
-        physically consecutive (runs_ref said so), so the WHOLE wave is
-        one [chunk*block_size, Cx] copy per KV stream — same bytes into
-        the same buffer region, chunk× fewer DMA issues."""
-        blk0 = block_tables_ref[sq, ci * chunk]
-        copies = [pltpu.make_async_copy(
-            k_hbm.at[pl.ds(blk0 * block_size, chunk * block_size), :],
-            k_bufs.at[slot], sems.at[slot])]
-        if v_lanes is None:
-            copies.append(pltpu.make_async_copy(
-                v_hbm.at[pl.ds(blk0 * block_size, chunk * block_size), :],
-                v_bufs.at[slot], sems.at[slot]))
-        return copies
-
-    def wave_dma(op, sq, ci, slot, nb):
-        """Start or wait one wave's DMAs, branching on the wave's
-        coalescibility. The runs table is immutable across the call, so
-        the wait reconstructs the exact copy set the start issued (and
-        either way the semaphore balances: one coalesced copy carries
-        the same byte count as the chunk per-block copies)."""
-        if not coalesce:
-            for c in block_copies(sq, ci, slot, nb):
-                getattr(c, op)()
-            return
-        contig = runs_ref[sq, ci] > 0
-
-        @pl.when(contig)
-        def _():
-            for c in run_copies(sq, ci, slot):
-                getattr(c, op)()
-
-        @pl.when(~contig)
-        def _():
-            for c in block_copies(sq, ci, slot, nb):
-                getattr(c, op)()
+    # shared wave-DMA walk + int8 tile dequant (ONE home with the
+    # ragged kernel — _make_wave_dma / _make_dequant_tile above)
+    dequant_tile, dequant_tile_sections = _make_dequant_tile(
+        quant_lanes, quant_sections, C)
+    wave_dma = _make_wave_dma(
+        block_tables_ref, runs_ref, k_hbm, v_hbm, k_bufs, v_bufs, sems,
+        block_size=block_size, chunk=chunk, v_lanes=v_lanes,
+        coalesce=coalesce)
 
     @pl.when(pb == 0)
     def _():
@@ -1146,6 +1192,321 @@ def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
                                block_size=block_size, scale=scale,
                                softcap=softcap, win_lo=win_lo,
                                kv_heads=kv_heads)
+
+
+# ---------------------------------------------------------------------------
+# Ragged dispatch: ONE kernel walks a [sum(T_i)] mixed prefill+decode batch
+# ---------------------------------------------------------------------------
+#
+# The unified ragged kernel (PAPERS.md "Ragged Paged Attention"): a flat
+# [TT, H, Dh] query batch where sequence s owns the CONSECUTIVE rows
+# [starts[s], starts[s]+counts[s]) at consecutive absolute positions
+# ending at seq_lens[s]-1. A decode step is counts[s] == 1; a prefill
+# chunk is counts[s] == T_chunk — the same kernel serves both in one
+# dispatch, so the scheduler can fill every dispatch to token capacity
+# with whatever mix of prefill chunks and decode rows is pending
+# (engine/ragged.py owns the packing policy and metadata contract).
+#
+# KV streaming reuses the round-7 run-coalesced wave machinery verbatim
+# (_make_wave_dma / wave_contig_table): per sequence, KV streams in
+# double-buffered waves exactly as in the decode kernel — but ONE wave
+# fetch now feeds ALL of the sequence's query rows (the ragged win: a
+# T-row prefill chunk reads each KV byte once instead of T times), and
+# a coalescible wave is still one contiguous copy per KV stream.
+#
+# Query layout is the decode kernel's sparse-slot trick per row
+# (qm[r, h, kh(h)*Dh:(kh(h)+1)*Dh] = q[r, h]), so scores for every
+# (row, head) are one [Lmax*Hp, C] x [C, cbs] MXU dot per wave and the
+# int8 in-row dequant / MLA v-aliases-k / sectioned-int8 modes compose
+# unchanged. Per-row causality is pure mask arithmetic: row r of
+# sequence s sits at position seq_lens[s] - counts[s] + r and attends
+# kv_pos <= that (plus the sliding-window floor win_base[s] + r).
+#
+# Grid is (S,) sequential; each sequence DMAs its q rows in (dynamic
+# start — the batch stays ragged in HBM, no [S, Lmax] dense padding)
+# and writes its output rows back the same way. The write covers the
+# full static Lmax window; the overhang past counts[s] lands in the
+# NEXT sequence's region and is rewritten by it (the grid is
+# sequential), so the builder must hand the kernel ASCENDING starts.
+# Unlike the decode kernel there is no cross-sequence wave prefetch yet
+# (one exposed first-wave latency per sequence) — at ragged batch sizes
+# the per-sequence q/o DMAs already overlap it in practice.
+
+# per-sequence sliding-window base for GLOBAL layers: hugely negative so
+# win_base + row never masks anything (a real floor is pos0 - window,
+# bounded below by -window)
+RAGGED_WIN_SENTINEL = -(1 << 30)
+
+
+def _ragged_attn_kernel(block_tables_ref, starts_ref, counts_ref,
+                        seq_lens_ref, win_base_ref, runs_ref,
+                        q_hbm, k_hbm, v_hbm, o_hbm,
+                        q_buf, o_buf, m_ref, l_ref, acc_ref,
+                        k_bufs, v_bufs, sems, qo_sem,
+                        *, block_size: int, chunk: int, scale: float,
+                        Lmax: int, Hp: int,
+                        softcap: float | None = None,
+                        quant_lanes: int | None = None,
+                        v_lanes: int | None = None,
+                        quant_sections: tuple | None = None,
+                        coalesce: bool = True):
+    """One grid program = one sequence: DMA its q rows, stream its KV
+    waves (shared machinery), online-softmax all rows at once, DMA the
+    output rows back. q_hbm/o_hbm: [TT + Lmax, Hp, C/Cv] (ANY memory,
+    Lmax overhang rows so the static-window copies stay in bounds);
+    scalar-prefetched metadata as in the module comment above."""
+    s = pl.program_id(0)
+    quantized = quant_lanes is not None
+    C = quant_lanes if quantized else q_buf.shape[-1]
+    dequant_tile, dequant_tile_sections = _make_dequant_tile(
+        quant_lanes, quant_sections, C)
+    wave_dma = _make_wave_dma(
+        block_tables_ref, runs_ref, k_hbm, v_hbm, k_bufs, v_bufs, sems,
+        block_size=block_size, chunk=chunk, v_lanes=v_lanes,
+        coalesce=coalesce)
+    L = counts_ref[s]
+
+    @pl.when(L > 0)
+    def _():
+        start = starts_ref[s]
+        seq_len = seq_lens_ref[s]
+        win_base = win_base_ref[s]
+        pos0 = seq_len - L           # row r sits at position pos0 + r
+        nb = (seq_len + block_size - 1) // block_size
+        nc = (nb + chunk - 1) // chunk
+        # sliding windows: waves entirely below every row's window are
+        # dead — the FIRST row's floor is the loosest bound
+        start_ci = jnp.maximum(win_base + 1, 0) // (chunk * block_size)
+
+        qc = pltpu.make_async_copy(
+            q_hbm.at[pl.ds(start, Lmax)], q_buf, qo_sem)
+        qc.start()
+        wave_dma("start", s, start_ci, 0, nb)
+        qc.wait()
+        qm = q_buf[...].reshape(Lmax * Hp, C).astype(jnp.float32) * scale
+
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        cbs = chunk * block_size
+        row = jax.lax.broadcasted_iota(
+            jnp.int32, (Lmax * Hp, cbs), 0) // Hp
+        rpos = pos0 + row                       # absolute row positions
+        live = row < L                          # overhang rows are dead
+        win_lo_r = win_base + row               # sentinel stays huge-neg
+
+        def body(ci, _):
+            slot = jax.lax.rem(ci - start_ci, 2)
+
+            @pl.when(ci + 1 < nc)
+            def _():
+                wave_dma("start", s, ci + 1, 1 - slot, nb)
+
+            wave_dma("wait", s, ci, slot, nb)
+            if quant_sections is not None:
+                k = dequant_tile_sections(k_bufs[slot])   # [cbs, C] f32
+                v = k[:, :v_lanes]        # sections mode implies alias
+            elif quantized:
+                k = dequant_tile(k_bufs[slot])
+                v = dequant_tile(v_bufs[slot])
+            else:
+                k = k_bufs[slot].astype(jnp.float32)
+                v = (k[:, :v_lanes] if v_lanes is not None
+                     else v_bufs[slot].astype(jnp.float32))
+            sm = jax.lax.dot_general(qm, k, (((1,), (1,)), ((), ())))
+            if softcap:
+                sm = softcap_scores(sm, softcap)
+            kv_pos = ci * cbs + jax.lax.broadcasted_iota(
+                jnp.int32, sm.shape, dimension=1)
+            mask = ((kv_pos <= rpos) & (kv_pos < seq_len) & live
+                    & (kv_pos > win_lo_r))
+            sm = jnp.where(mask, sm, NEG_INF)
+            m_prev = m_ref[:]
+            m_new = jnp.maximum(m_prev,
+                                jnp.max(sm, axis=1, keepdims=True))
+            p = jnp.exp(sm - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1,
+                                                  keepdims=True)
+            acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())))
+            m_ref[:] = m_new
+            return 0
+
+        jax.lax.fori_loop(start_ci, nc, body, 0)
+        o_buf[...] = (acc_ref[:] /
+                      jnp.maximum(l_ref[:], 1e-20)).reshape(
+            Lmax, Hp, acc_ref.shape[-1]).astype(o_buf.dtype)
+        oc = pltpu.make_async_copy(
+            o_buf, o_hbm.at[pl.ds(start, Lmax)], qo_sem)
+        oc.start()
+        oc.wait()
+
+
+def ragged_paged_attention_pallas(q: jax.Array, k_cache: jax.Array,
+                                  v_cache: jax.Array,
+                                  block_tables: jax.Array,
+                                  seq_starts: jax.Array,
+                                  seq_counts: jax.Array,
+                                  seq_lens: jax.Array, *,
+                                  block_size: int, scale: float,
+                                  max_rows: int,
+                                  softcap: float | None = None,
+                                  win_base: jax.Array | None = None,
+                                  chunk_blocks: int | None = None,
+                                  v_lanes: int | None = None,
+                                  quant_sections: tuple | None = None,
+                                  coalesce: bool = True,
+                                  interpret: bool = False) -> jax.Array:
+    """Ragged mixed prefill+decode attention in ONE dispatch.
+
+    q: [TT, H, Dh] flat token rows; block_tables: [S, M]; sequence s
+    owns rows [seq_starts[s], seq_starts[s]+seq_counts[s]) (starts must
+    ascend in s; counts[s] == 0 skips the sequence) at consecutive
+    positions ending at seq_lens[s]-1. ``max_rows`` (static) bounds any
+    sequence's row count per dispatch and sizes the kernel's q/acc VMEM
+    window — the builder splits longer chunks across dispatches.
+    ``win_base``: [S] first-row sliding floor (pos0 - window), or
+    RAGGED_WIN_SENTINEL for global layers / None.
+
+    int8 pools (in-row scales), MLA v-aliases-k (``v_lanes``) and
+    sectioned-int8 MLA rows (``quant_sections``) follow the decode
+    kernel's contracts exactly. Returns [TT, H, Dh-or-v_lanes]; rows not
+    owned by any sequence return garbage (the engine reads only sample
+    rows and the tests compare only owned rows)."""
+    TT, H, Dh = q.shape
+    NTOK, Cx = k_cache.shape
+    S, M = block_tables.shape
+    quantized = k_cache.dtype == jnp.int8
+    if quant_sections is not None:
+        if not quantized or v_lanes is None:
+            raise ValueError("quant_sections needs an int8 pool and "
+                             "v_lanes (the MLA sectioned layout)")
+        C = Dh          # dequant produces query-width tiles (KVH == 1)
+    else:
+        C = kv_value_lanes(k_cache)
+    KVH = C // Dh
+    if not pallas_supported(H, KVH, Dh, block_size,
+                            kv_dtype=k_cache.dtype):
+        raise ValueError(
+            f"unsupported ragged pallas geometry (H={H}, KVH={KVH}, "
+            f"Dh={Dh}, block_size={block_size}, kv={k_cache.dtype}) — "
+            f"see pallas_supported")
+    if v_lanes is not None and (KVH != 1 or v_lanes % 128 != 0
+                                or v_lanes > C):
+        raise ValueError(
+            f"v_lanes={v_lanes} needs an MQA-shaped pool (KVH == 1, got "
+            f"{KVH}) and a 128-aligned width <= {C}")
+    if v_lanes is not None and quantized and quant_sections is None:
+        raise ValueError(
+            "v_lanes on a single-scale int8 pool is not supported "
+            "(sectioned MLA pools pass quant_sections)")
+    Cv = C if v_lanes is None else v_lanes
+    g = H // KVH
+    if chunk_blocks is None:
+        chunk_blocks = int(os.environ.get("DYN_ATTN_CHUNK_BLOCKS", "16"))
+    chunk = max(1, min(chunk_blocks, M))
+    Hp = max(8, H)
+    Lmax = max(8, int(max_rows))     # 8-sublane floor for the q window
+    # sparse slot placement per ROW (the decode kernel's trick), with
+    # Lmax overhang rows so the per-sequence static-window DMAs stay in
+    # bounds
+    qm = jnp.zeros((TT + Lmax, Hp, KVH, Dh), q.dtype)
+    qm = qm.at[:TT, jnp.arange(H), jnp.arange(H) // g, :].set(q)
+    qm = qm.reshape(TT + Lmax, Hp, C)
+    if win_base is None:
+        win_base = jnp.full((S,), RAGGED_WIN_SENTINEL, jnp.int32)
+    runs = (wave_contig_table(block_tables, seq_lens,
+                              block_size=block_size, chunk=chunk,
+                              pool_blocks=NTOK // block_size)
+            if coalesce else
+            jnp.zeros((S, -(-M // chunk)), jnp.int32))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(S,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),   # q stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),   # k_cache
+            pl.BlockSpec(memory_space=pltpu.ANY),   # v_cache
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((Lmax, Hp, C), q.dtype),               # q window
+            pltpu.VMEM((Lmax, Hp, Cv), q.dtype),              # o window
+            pltpu.VMEM((Lmax * Hp, 1), jnp.float32),          # m
+            pltpu.VMEM((Lmax * Hp, 1), jnp.float32),          # l
+            pltpu.VMEM((Lmax * Hp, Cv), jnp.float32),         # acc
+            pltpu.VMEM((2, chunk * block_size, Cx), k_cache.dtype),
+            pltpu.VMEM((2, chunk * block_size, Cx)
+                       if v_lanes is None else (1, 32, 128),
+                       v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA,          # q/o window copies
+        ],
+    )
+
+    def kernel(block_tables_ref, starts_ref, counts_ref, seq_lens_ref,
+               win_base_ref, runs_ref, q_hbm, k_hbm, v_hbm, o_hbm,
+               q_buf, o_buf, m_ref, l_ref, acc_ref, k_bufs, v_bufs,
+               sems, qo_sem):
+        _ragged_attn_kernel(
+            block_tables_ref, starts_ref, counts_ref, seq_lens_ref,
+            win_base_ref, runs_ref, q_hbm, k_hbm, v_hbm, o_hbm,
+            q_buf, o_buf, m_ref, l_ref, acc_ref, k_bufs, v_bufs,
+            sems, qo_sem,
+            block_size=block_size, chunk=chunk, scale=scale,
+            Lmax=Lmax, Hp=Hp, softcap=softcap,
+            quant_lanes=(C if quantized and quant_sections is None
+                         else None),
+            v_lanes=v_lanes, quant_sections=quant_sections,
+            coalesce=coalesce)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((TT + Lmax, Hp, Cv), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(block_tables, jnp.asarray(seq_starts, jnp.int32),
+      jnp.asarray(seq_counts, jnp.int32),
+      jnp.asarray(seq_lens, jnp.int32),
+      jnp.asarray(win_base, jnp.int32), runs, qm, k_cache, v_cache)
+    out = out[:TT]
+    if v_lanes is not None:
+        # MQA: every head's slot is the whole row — no extraction
+        return out[:, :H]
+    out = out.reshape(TT, Hp, KVH, Dh)[:, :H]
+    kh = (jnp.arange(H) // g)[None, :, None, None]
+    return jnp.take_along_axis(out, kh, axis=2)[:, :, 0].reshape(
+        TT, H, Dh)
+
+
+# VMEM budget for the ragged kernel's per-sequence windows (q + o + acc
+# + m/l scratch); conservative — the real bound also carries the KV
+# wave buffers, which ragged_supported charges separately
+_RAGGED_VMEM_BUDGET = 8 << 20
+
+
+def ragged_supported(num_heads: int, num_kv_heads: int, head_dim: int,
+                     block_size: int, max_rows: int,
+                     kv_dtype=None) -> bool:
+    """True if the ragged Pallas kernel handles this geometry at this
+    per-sequence row budget: the decode kernel's lane/sublane
+    constraints (pallas_supported) plus the q/acc VMEM window fitting
+    the budget — [Lmax*Hp, C] f32 scores duplicate query rows across
+    sublanes, so large GQA geometries bound Lmax (MQA/MLA pools,
+    KVH == 1, carry no duplication and take the deepest windows)."""
+    if not pallas_supported(num_heads, num_kv_heads, head_dim,
+                            block_size, kv_dtype=kv_dtype):
+        return False
+    Hp = max(8, num_heads)
+    C = num_kv_heads * head_dim
+    Lmax = max(8, max_rows)
+    window_bytes = Lmax * Hp * C * (2 + 2 + 4 + 4)   # q + o + acc(+m/l)
+    return window_bytes <= _RAGGED_VMEM_BUDGET
 
 
 @functools.cache

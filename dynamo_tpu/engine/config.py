@@ -617,6 +617,31 @@ class EngineConfig:
     # one compute-bound dispatch instead of len(prompt) steps).
     # 0 disables; requires decode_steps_per_dispatch > 1.
     lane_prefill_max_tokens: int = 0
+    # unified ragged dispatch (engine/ragged.py + models/*.ragged_forward;
+    # docs/ragged_attention.md): ONE compiled program serves mixed
+    # prefill+decode batches — the step loop packs pending prefill
+    # chunks and due decode rows into a token-capacity-filled ragged
+    # [sum(T_i)] batch, making continuous batching the only serving
+    # code path. Admissions ride the batch lane-style (the sampled
+    # first token comes from the ragged program, a recorded numeric
+    # boundary exactly like lane prefill); per-row math is bit-exact
+    # with the decode/lane programs. Kept OFF the following paths,
+    # which fall back to / refuse loudly: disagg handoff + precomputed
+    # admissions use the dedicated prefill program (their gather/
+    # scatter contracts are prefill-shaped), and pp / sp / speculative
+    # decoding / pipelined-dispatch composition is refused at
+    # bring-up.
+    ragged_dispatch: bool = False
+    # token capacity of one ragged dispatch (the [sum(T_i)] row
+    # budget, a compiled static shape). 0 = auto: max_num_seqs +
+    # 2*ragged_max_seq_rows. Must cover one row per slot.
+    ragged_max_tokens: int = 0
+    # per-sequence row budget per dispatch: bounds the ragged kernel's
+    # per-sequence VMEM q window (attention.ragged_supported) and how
+    # much of one prompt a single dispatch may consume — longer
+    # prompts stream across consecutive dispatches (each a chunked-
+    # prefill continuation riding the decode batch)
+    ragged_max_seq_rows: int = 64
     # speculative decoding (engine/spec/): max draft tokens verified per
     # dispatch; 0 = off. When > 0 the engine compiles a batched verify
     # program — [max_num_seqs, spec_k+1] query rows flattened through
@@ -753,6 +778,43 @@ class EngineConfig:
         if self.kv_remote_admission not in ("auto", "always", "never"):
             raise ValueError(
                 "kv_remote_admission must be auto | always | never")
+        if self.ragged_dispatch:
+            if self.ragged_max_seq_rows <= 0:
+                raise ValueError("ragged_max_seq_rows must be > 0")
+            if self.ragged_max_tokens == 0:
+                self.ragged_max_tokens = (self.max_num_seqs
+                                          + 2 * self.ragged_max_seq_rows)
+            if self.ragged_max_tokens < max(self.max_num_seqs + 1,
+                                            self.ragged_max_seq_rows):
+                raise ValueError(
+                    f"ragged_max_tokens={self.ragged_max_tokens} must "
+                    f"cover one decode row per slot plus prefill "
+                    f"headroom (>= max_num_seqs+1 = "
+                    f"{self.max_num_seqs + 1}) and at least one full "
+                    f"per-sequence chunk (>= ragged_max_seq_rows = "
+                    f"{self.ragged_max_seq_rows})")
+            if self.pp > 1:
+                raise NotImplementedError(
+                    "ragged dispatch on a pp engine is not implemented "
+                    "(the ragged program has no token-interleaved "
+                    "stage form yet)")
+            if self.sp > 1:
+                raise NotImplementedError(
+                    "ragged dispatch with sequence-parallel prefill is "
+                    "not implemented (long cold prompts would bypass "
+                    "the ragged batch; run one or the other)")
+            if self.spec_k > 0:
+                raise NotImplementedError(
+                    "ragged dispatch with speculative decoding is not "
+                    "implemented (draft rows and prompt rows would "
+                    "contend for the same ragged capacity; compose "
+                    "them in a later round)")
+            if self.decode_dispatch_pipeline:
+                raise NotImplementedError(
+                    "ragged dispatch with decode_dispatch_pipeline is "
+                    "not implemented (the ragged step harvests every "
+                    "dispatch; pipelining it needs a chained-sample "
+                    "merge the ragged program doesn't carry yet)")
         if self.lane_prefill_max_tokens > 0 \
                 and self.decode_steps_per_dispatch <= 1:
             raise ValueError(

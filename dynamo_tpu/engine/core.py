@@ -449,6 +449,16 @@ class EngineCore:
         self.prefill_wall_s = 0.0
         from ..llm.kv.fabric import PrefillRateEstimator
         self.prefill_rate_estimator = PrefillRateEstimator()
+        # ragged-dispatch stats (nv_llm_ragged_* metrics feed;
+        # docs/ragged_attention.md). "saved" counts the split-path
+        # dispatches each ragged batch stood in for, minus itself
+        # (ragged.RaggedBatch.dispatches_replaced).
+        self.ragged_dispatches = 0
+        self.ragged_rows_total = 0
+        self.ragged_prefill_rows_total = 0
+        self.ragged_decode_rows_total = 0
+        self.ragged_mixed_dispatches = 0
+        self.ragged_dispatches_saved = 0
         # speculation stats (nv_llm_spec_* metrics feed)
         self.spec_dispatches = 0       # verify dispatches issued
         self.spec_drafted_tokens = 0   # draft tokens scored
@@ -524,6 +534,7 @@ class EngineCore:
         self._merge_jit = jax.jit(
             lambda dev, host, mask: jnp.where(mask, dev, host))
         self._verify_jit = None
+        self._ragged_jit = None   # EngineConfig refuses ragged + pp
         self._prefill_sp_jit = None
         self._sp = 1
 
@@ -602,6 +613,36 @@ class EngineCore:
         # previous dispatch's device tokens, fresh slots feed host values
         self._merge_jit = jax.jit(
             lambda dev, host, mask: jnp.where(mask, dev, host))
+
+        # unified ragged dispatch (engine/ragged.py +
+        # docs/ragged_attention.md): ONE program serves a flat
+        # [ragged_max_tokens] mixed prefill+decode token batch — each
+        # slot's contiguous row span scatters its KV and attends masked
+        # at its own positions (per-row the decode program's exact
+        # math), and each slot samples from its LAST row's logits with
+        # the same per-(seed, key_step) key discipline the split
+        # programs use. One compiled shape serves every batch mix, so
+        # the per-bucket prefill program family never compiles when
+        # ragged serving is on.
+        self._ragged_jit = None
+        if self.cfg.ragged_dispatch:
+            Lmax = self.cfg.ragged_max_seq_rows
+
+            def ragged(params, kv, tokens, positions, tables, row_slot,
+                       seq_starts, seq_counts, sample_rows, seeds,
+                       steps, temperature, top_k, top_p):
+                params = unpack_params(params)
+                logits, kv = self.model_mod.ragged_forward(
+                    params, kv, tokens, positions, tables, row_slot,
+                    seq_starts, seq_counts, sample_rows, statics,
+                    max_rows=Lmax)
+                keys = make_slot_keys(seed, seeds, steps)
+                toks, logprobs = sample_tokens(logits, keys,
+                                               temperature, top_k,
+                                               top_p)
+                return toks, logprobs, kv
+
+            self._ragged_jit = jax.jit(ragged, donate_argnums=(1,))
 
         # speculative verify (engine/spec/, docs/speculative.md): score
         # Tv = spec_k+1 positions per slot in ONE dispatch by flattening
@@ -979,6 +1020,20 @@ class EngineCore:
         if self.offload_engine is not None:
             tier_kw.update(offload_dropped_jobs_total=self
                            .offload_engine.dropped_jobs_total)
+        if self.cfg.ragged_dispatch:
+            # ragged dispatch (docs/ragged_attention.md): how full each
+            # unified dispatch runs, how often prefill and decode share
+            # one, and the split-path dispatches the packing saved
+            tier_kw.update(
+                ragged_fill_ratio=(
+                    self.ragged_rows_total
+                    / (self.ragged_dispatches
+                       * self.cfg.ragged_max_tokens)
+                    if self.ragged_dispatches else 0.0),
+                ragged_mixed_ratio=(
+                    self.ragged_mixed_dispatches / self.ragged_dispatches
+                    if self.ragged_dispatches else 0.0),
+                ragged_dispatches_saved_total=self.ragged_dispatches_saved)
         if self.pp > 1:
             from ..parallel.pipeline_parallel import (
                 pp_bubble_fraction, pp_dispatch_utilization)
@@ -1635,6 +1690,15 @@ class EngineCore:
                                   plan.new_blocks[n_host:n_hd]))
         t0 = time.monotonic()
         suffix_len = n_prompt - req.prefix_hit_tokens
+        if (self._ragged_jit is not None and req.handoff is None
+                and req.precomputed is None and suffix_len > 0):
+            # ragged serving: EVERY normal admission rides the ragged
+            # batch as a prefill lane — no dedicated prefill dispatch,
+            # continuous batching is the only code path. Disagg
+            # handoff/precomputed admissions keep the prefill program
+            # (their gather/scatter contracts are prefill-shaped).
+            self._admit_lane(req, slot, n_already)
+            return True
         if (self.cfg.lane_prefill_max_tokens > 0
                 and self._decode_k_jit is not None
                 and req.handoff is None and req.precomputed is None
@@ -2052,6 +2116,12 @@ class EngineCore:
 
     # --------------------------------------------------------------- decode
     def _decode_step(self) -> None:
+        if self._ragged_jit is not None:
+            # ragged serving: ONE dispatch per loop iteration carries
+            # every ready slot's work — pending prompt rows and due
+            # decode rows together (docs/ragged_attention.md)
+            self._ragged_step()
+            return
         if self._verify_jit is not None and self._spec_candidates():
             # speculation drafts from HARVESTED state, so the pipelined
             # dispatch (if any) must drain first; spec mode therefore
@@ -2403,6 +2473,191 @@ class EngineCore:
             host_gap_ms=round(
                 max(1e3 * (_now - self._flight_cycle_end - _stall), 0.0),
                 3))
+        self._flight_cycle_end = _now
+
+    # --------------------------------------------------------------- ragged
+    def _ragged_step(self) -> None:
+        """One unified ragged dispatch (engine/ragged.py): pack every
+        ready slot's pending work — mid-prompt lanes contribute up to
+        ragged_max_seq_rows prompt rows, decoding slots one chained
+        token row — into a single token-capacity-filled batch, dispatch
+        the ONE compiled ragged program, harvest synchronously.
+
+        Block growth runs BEFORE packing at each slot's maximum
+        possible row count this dispatch (the packer only ever shrinks
+        a span, and over-grown blocks stay owned by their request —
+        the _prepare_multi precedent); a slot that cannot grow preempts
+        or finishes exactly as the split path would."""
+        from .ragged import build_ragged_batch
+        cfg = self.cfg
+        Lmax = cfg.ragged_max_seq_rows
+        capacity = self.M * cfg.kv_block_size
+        for i, s in enumerate(self.slots):
+            if s is None or not s.ready:
+                continue
+            in_prompt = (s.lane_prompt is not None
+                         and s.pos < len(s.lane_prompt))
+            want = (min(len(s.lane_prompt) - s.pos, Lmax) if in_prompt
+                    else 1)
+            if s.pos + want + 1 > capacity:
+                self._release_slot(s)
+                self._finish_request(s, FinishReason.LENGTH)
+                continue
+            need = self._blocks_needed(s.pos + want + 1)
+            if need > len(s.blocks):
+                new = self.kv_manager.pool.alloc_uninit(
+                    need - len(s.blocks))
+                if new is None:
+                    self._preempt_or_finish(s)
+                    continue
+                s.blocks.extend(new)
+                self._block_tables[i, :len(s.blocks)] = s.blocks
+
+        decode_rows = []
+        prefill_lanes = []
+        for i, s in enumerate(self.slots):
+            if s is None or not s.ready:
+                continue
+            if s.lane_prompt is not None and s.pos < len(s.lane_prompt):
+                prefill_lanes.append(
+                    (i, s.lane_prompt[s.pos:s.pos + Lmax], s.pos))
+            else:
+                decode_rows.append((i, s.last_token, s.pos))
+        batch = build_ragged_batch(cfg.ragged_max_tokens, self.B,
+                                   decode_rows, prefill_lanes, Lmax)
+        if batch is None:
+            return
+
+        steps = np.zeros((self.B + 1,), np.int64)
+        seeds = np.zeros((self.B + 1,), np.int64)
+        temp = np.zeros((self.B + 1,), np.float32)
+        top_k = np.zeros((self.B + 1,), np.int32)
+        top_p = np.ones((self.B + 1,), np.float32)
+        seeds[:self.B] = self._seeds
+        temp[:self.B] = self._samp["temperature"]
+        top_k[:self.B] = self._samp["top_k"]
+        top_p[:self.B] = self._samp["top_p"]
+        for sq in batch.seqs:
+            s = self.slots[sq.slot]
+            # the LAST row of a span samples at the key_step the split
+            # path would use there: lane's skew convention makes that
+            # key_step + len - 1 (== key_step for decode rows)
+            steps[sq.slot] = s.key_step + sq.length - 1
+        tables = np.zeros((self.B + 1, self.M), np.int32)
+        tables[:self.B] = self._tables_for_dispatch()
+        self._step += 1
+        did = None
+        if self.recorder is not None:
+            did = self.recorder.next_dispatch_id()
+            self.recorder.rec(
+                "ragged", id=did, tokens=batch.tokens.copy(),
+                positions=batch.positions.copy(),
+                row_slot=batch.row_slot.copy(),
+                starts=batch.seq_starts.copy(),
+                counts=batch.seq_counts.copy(),
+                sample_rows=batch.sample_rows.copy(),
+                tables=tables.copy(), seeds=seeds.copy(),
+                steps=steps.copy(), temperature=temp.copy(),
+                top_k=top_k.copy(), top_p=top_p.copy(),
+                seqs=batch.seqs_meta(),
+                reqs=[s.rid if (s is not None and s.ready) else None
+                      for s in self.slots])
+        toks, logprobs, self.kv = self._ragged_jit(
+            self.params, self.kv,
+            jnp.asarray(batch.tokens), jnp.asarray(batch.positions),
+            jnp.asarray(tables), jnp.asarray(batch.row_slot),
+            jnp.asarray(batch.seq_starts),
+            jnp.asarray(batch.seq_counts),
+            jnp.asarray(batch.sample_rows),
+            jnp.asarray(seeds), jnp.asarray(steps),
+            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p))
+        self.ragged_dispatches += 1
+        self.ragged_rows_total += batch.rows_used
+        self.ragged_prefill_rows_total += batch.prefill_rows
+        self.ragged_decode_rows_total += batch.rows_used - batch.prefill_rows
+        if batch.mixed:
+            self.ragged_mixed_dispatches += 1
+        self.ragged_dispatches_saved += batch.dispatches_replaced - 1
+        self._harvest_ragged({
+            "batch": batch, "toks": toks, "logprobs": logprobs,
+            "id": did,
+            "reqs": [s if (s is not None and s.ready) else None
+                     for s in self.slots]})
+
+    def _harvest_ragged(self, pending: dict) -> None:
+        """Apply one ragged dispatch: per span, the consumed prompt
+        rows' bookkeeping (hash chain, registration, pos/key_step —
+        exactly the lane harvest's per-token walk) and, when the span
+        ends in a sample (decode row, or the row consuming the LAST
+        prompt token), the emission + finish checks of one decode
+        step."""
+        self.host_roundtrips += 1
+        _t0 = time.monotonic()
+        toks = np.asarray(pending["toks"])           # [B+1] — ONE fetch
+        logprobs = np.asarray(pending["logprobs"])
+        self.host_stall_s += time.monotonic() - _t0
+        batch = pending["batch"]
+        applied = []
+        for sq in batch.seqs:
+            i = sq.slot
+            req = pending["reqs"][i]
+            if req is None or self.slots[i] is not req:
+                continue
+            if req.cancelled:
+                self._release_slot(req)
+                self._finish_request(req, FinishReason.CANCELLED)
+                continue
+            if sq.mode == "prefill":
+                for t in range(sq.length):
+                    req.seq.append(req.lane_prompt[req.pos])
+                    req.registered_blocks = \
+                        self.kv_manager.register_full_blocks(
+                            req.blocks, req.seq, req.registered_blocks)
+                    req.pos += 1
+                    req.key_step += 1
+                self.total_prefill_tokens += sq.length
+                if req.pos < len(req.lane_prompt):
+                    applied.append((i, req.rid, sq.length, 0))
+                    continue               # still mid-prompt: no sample
+                req.lane_prompt = None     # plain decode from here on
+            else:
+                req.seq.append(int(req.last_token))
+                req.registered_blocks = \
+                    self.kv_manager.register_full_blocks(
+                        req.blocks, req.seq, req.registered_blocks)
+                req.pos += 1
+                req.key_step += 1
+                self.total_decode_tokens += 1
+            tok = int(toks[i])
+            req.generated += 1
+            req.last_token = tok
+            if req.first_token_time is None:
+                req.first_token_time = time.monotonic()
+            self._emit(req, tok, float(logprobs[i]))
+            self._maybe_finish_after_emit(req)
+            applied.append((i, req.rid, sq.length, 1))
+        if self.recorder is not None and pending.get("id") is not None:
+            self.recorder.rec("ragged_harvest", id=pending["id"],
+                              toks=toks.copy(), applied=applied)
+        _now = time.monotonic()
+        _stall = self.host_stall_s - self._flight_prev_stall_s
+        self._flight_prev_stall_s = self.host_stall_s
+        # per-dispatch mode mix rides the flight recorder ring — the
+        # /debug + llmctl trace dump view of how full and how mixed
+        # each ragged dispatch ran
+        self.flight.record(
+            "ragged", rows=batch.rows_used,
+            capacity=batch.capacity,
+            fill=round(batch.fill_ratio, 4),
+            prefill_rows=batch.prefill_rows,
+            decode_rows=batch.rows_used - batch.prefill_rows,
+            n_prefill=batch.n_prefill, n_decode=batch.n_decode,
+            mixed=batch.mixed,
+            emitted=sum(e for _i, _r, _n, e in applied),
+            device_ms=round(1e3 * _stall, 3),
+            host_gap_ms=round(
+                max(1e3 * (_now - self._flight_cycle_end - _stall),
+                    0.0), 3))
         self._flight_cycle_end = _now
 
     # ---------------------------------------------------------- speculation

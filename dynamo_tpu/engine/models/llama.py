@@ -27,10 +27,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..attention import causal_attention  # noqa: F401  (used by sp path)
-from ..attention import (KV_SCALE_LANES, _on_tpu, dequant_kv_rows,
-                         flash_prefill, flash_prefill_supported,
-                         flat_token_indices, kv_row_groups,
-                         paged_attention, quantize_kv_rows,
+from ..attention import (KV_SCALE_LANES, RAGGED_WIN_SENTINEL, _on_tpu,
+                         dequant_kv_rows, flash_prefill,
+                         flash_prefill_supported, flat_token_indices,
+                         kv_row_groups, paged_attention,
+                         quantize_kv_rows, ragged_paged_attention_pallas,
+                         ragged_supported,
                          softcap_scores as _softcap)
 from ..config import ModelConfig
 from ..quant import QuantizedArray, mm, qeinsum
@@ -759,6 +761,122 @@ def prefill_forward_sp(params: Params, kv: KVCache, tokens: jax.Array,
     x, kv_new = _run_layers(params, kv, x, positions, slots, cfg, attn)
     last = x[jnp.maximum(true_len - 1, 0)]
     return _logits(params, last, cfg), kv_new
+
+
+def ragged_attn_impl(statics: ModelStatics, max_rows: int, kv_dtype,
+                     kv_groups: int = 1):
+    """Ragged attention dispatch: the sequence-grouped Pallas kernel on
+    TPU when the geometry tiles (attention.ragged_supported), the
+    per-row paged path elsewhere. Mirrors _prefill_flash_impl's impl
+    resolution — including raising on a forced impl the geometry can't
+    run, so a parity test can never silently compare the row path
+    against itself. Grouped int8 pools (one scale section per tp shard)
+    always take the row path, exactly as paged_attention refuses them
+    for the decode kernel."""
+    cfg = statics.cfg
+    ok = (kv_groups == 1
+          and ragged_supported(cfg.num_heads, cfg.num_kv_heads,
+                               cfg.head_dim, statics.block_size,
+                               max_rows, kv_dtype=kv_dtype))
+    impl = statics.attn_impl
+    if impl == "auto":
+        return _on_tpu() and ok
+    if impl in ("pallas", "pallas_interpret"):
+        if not ok:
+            raise ValueError(
+                f"ragged attention impl {impl!r} forced but unsupported "
+                f"geometry (H={cfg.num_heads}, KVH={cfg.num_kv_heads}, "
+                f"Dh={cfg.head_dim}, block={statics.block_size}, "
+                f"max_rows={max_rows}, groups={kv_groups}) — see "
+                f"ragged_supported")
+        return "interpret" if impl == "pallas_interpret" else True
+    return False
+
+
+def ragged_forward(params: Params, kv: KVCache, tokens: jax.Array,
+                   positions: jax.Array, block_tables: jax.Array,
+                   row_slot: jax.Array, seq_starts: jax.Array,
+                   seq_counts: jax.Array, sample_rows: jax.Array,
+                   statics: ModelStatics, max_rows: int = 8
+                   ) -> Tuple[jax.Array, KVCache]:
+    """Unified ragged mixed prefill+decode step (one dispatch serves
+    prefill chunks AND decode rows; docs/ragged_attention.md).
+
+    tokens/positions: [TT] flat token rows; block_tables: [S, M] where
+    the LAST row is all-zeros (the trash sequence dead rows aim at);
+    row_slot: [TT] row → sequence; seq_starts/seq_counts: [S] each
+    sequence's contiguous row span, ascending starts (the (start, len)
+    half of the engine/ragged.py metadata contract — `mode` is packing
+    metadata; the math is identical for both modes, a decode step is
+    simply len == 1); sample_rows: [S] the row whose hidden state each
+    sequence's logits come from (its LAST row; inactive sequences point
+    at row 0 and their sample is discarded). Returns
+    (logits [S, V], new kv).
+
+    Per ROW this is exactly decode_forward's math: the same rope/
+    scatter at (table, position), the same paged attention masked at the
+    row's own position — so a ragged dispatch is bit-exact per row with
+    the decode/lane programs (row-count independence of every per-row
+    op; the spec-verify program's flattening precedent). On TPU the
+    sequence-grouped ragged kernel instead streams each sequence's KV
+    waves ONCE for all its rows (attention.ragged_paged_attention_
+    pallas) — same contract, kernel-grade DMA economics."""
+    cfg = statics.cfg
+    TT = tokens.shape[0]
+    bsz = statics.block_size
+    scale = _attn_scale(cfg)
+    quantized = kv["k"].dtype == jnp.int8
+    kv_groups = (kv_row_groups(kv["k"].shape[2],
+                               cfg.num_kv_heads * cfg.head_dim)
+                 if quantized else 1)
+    use_kernel = ragged_attn_impl(statics, max_rows, kv["k"].dtype,
+                                  kv_groups)
+
+    row_tables = jnp.take(block_tables, row_slot, axis=0)      # [TT, M]
+    slots = (row_tables[jnp.arange(TT), positions // bsz] * bsz
+             + positions % bsz)
+    seq_lens = positions + 1
+    if use_kernel:
+        last_rows = seq_starts + jnp.maximum(seq_counts - 1, 0)
+        seq_ctx = jnp.where(seq_counts > 0,
+                            jnp.take(positions, last_rows) + 1, 0)
+        pos0 = seq_ctx - seq_counts
+
+    def attn(q, _k, _v, k_flat, v_flat, li, sliding):
+        num_blocks = k_flat.shape[0] // (cfg.num_layers * bsz)
+        if use_kernel:
+            win_base = None
+            if cfg.sliding_window is not None:
+                win_base = jnp.where(
+                    sliding & (seq_counts > 0),
+                    pos0 - cfg.sliding_window,
+                    jnp.full_like(pos0, RAGGED_WIN_SENTINEL))
+            return ragged_paged_attention_pallas(
+                q, k_flat, v_flat, block_tables + li * num_blocks,
+                seq_starts, seq_counts, seq_ctx, block_size=bsz,
+                scale=scale, max_rows=max_rows,
+                softcap=cfg.attn_logit_softcap or None,
+                win_base=win_base, coalesce=statics.kv_coalesce,
+                interpret=(use_kernel == "interpret"))
+        win_lo = None
+        if cfg.sliding_window is not None:
+            win_lo = jnp.where(sliding, positions - cfg.sliding_window,
+                               jnp.full_like(positions, -1))
+        # the decode program's attention verbatim, over row-expanded
+        # tables — the bit-exactness anchor of the ragged contract
+        return paged_attention(q, k_flat, v_flat,
+                               row_tables + li * num_blocks, seq_lens,
+                               block_size=bsz, scale=scale,
+                               impl=statics.attn_impl,
+                               softcap=cfg.attn_logit_softcap,
+                               win_lo=win_lo,
+                               kv_heads=cfg.num_kv_heads,
+                               coalesce=statics.kv_coalesce)
+
+    x = _embed(params, tokens, cfg)  # [TT, D]
+    x, kv_new = _run_layers(params, kv, x, positions, slots, cfg, attn)
+    sel = jnp.take(x, sample_rows, axis=0)                     # [S, D]
+    return _logits(params, sel, cfg), kv_new
 
 
 def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,

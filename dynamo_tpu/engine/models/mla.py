@@ -51,7 +51,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..attention import (dequant_kv_rows_sections,
-                         quantize_kv_rows_sections)
+                         quantize_kv_rows_sections,
+                         ragged_paged_attention_pallas)
 from ..config import ModelConfig
 from ..quant import mm
 from .llama import (ModelStatics, _embed, _layer_stack, _logits,
@@ -590,6 +591,111 @@ def prefill_forward_sp(params: Params, kv: KVCache, tokens: jax.Array,
 # ---------------------------------------------------------------------------
 # Decode: the ABSORBED form — attention reads only the latent rows
 # ---------------------------------------------------------------------------
+
+
+def ragged_forward(params: Params, kv: KVCache, tokens: jax.Array,
+                   positions: jax.Array, block_tables: jax.Array,
+                   row_slot: jax.Array, seq_starts: jax.Array,
+                   seq_counts: jax.Array, sample_rows: jax.Array,
+                   statics: ModelStatics, max_rows: int = 8
+                   ) -> Tuple[jax.Array, KVCache]:
+    """MLA form of llama.ragged_forward (same metadata contract): one
+    ragged [TT] token batch serves prefill chunks and decode steps in
+    one absorbed-attention dispatch. Per row this is decode_forward's
+    math over row-expanded tables (bit-exact per row with MLA decode);
+    on TPU the full-precision latent pool takes the sequence-grouped
+    ragged kernel as MQA with v-aliases-k (one latent-row stream per
+    sequence for ALL its rows). int8 latent pools keep the explicit
+    gather + sectioned dequant of the decode fallback — the sectioned
+    ragged-kernel mode exists (attention.ragged_paged_attention_pallas
+    quant_sections) but is unwired here until it has device truth."""
+    from ..attention import _on_tpu, ragged_supported
+
+    cfg, bsz = statics.cfg, statics.block_size
+    TT = tokens.shape[0]
+    H = cfg.num_heads
+    rank, dr = cfg.kv_lora_rank, cfg.qk_rope_head_dim
+    scale = softmax_scale(cfg)
+    row_tables = jnp.take(block_tables, row_slot, axis=0)      # [TT, M]
+    slots = (row_tables[jnp.arange(TT), positions // bsz] * bsz
+             + positions % bsz)
+    seq_lens = positions + 1
+    quantized = kv["kv"].dtype == jnp.int8
+    # the latent pool is MQA-shaped for the kernel: one "kv head" of
+    # the full row width (decode_forward's MQA framing); unsupported
+    # geometries / int8 rows fall back to the per-row paths, so a
+    # forced impl never hard-fails here (decode_forward's leniency)
+    W = kv["kv"].shape[2]
+    ok = (not quantized and rank % 128 == 0
+          and ragged_supported(H, 1, W, bsz, max_rows,
+                               kv_dtype=kv["kv"].dtype))
+    impl = statics.attn_impl
+    use_kernel = False
+    if ok:
+        if impl == "auto":
+            use_kernel = _on_tpu()
+        elif impl == "pallas_interpret":
+            use_kernel = "interpret"
+        elif impl == "pallas":
+            use_kernel = True
+    if use_kernel:
+        last_rows = seq_starts + jnp.maximum(seq_counts - 1, 0)
+        seq_ctx = jnp.where(seq_counts > 0,
+                            jnp.take(positions, last_rows) + 1, 0)
+
+    def attn(q_nope, q_pe, _rows, kv_flat, lp, li):
+        NTOK = kv_flat.shape[0] // cfg.num_layers
+        num_blocks = NTOK // bsz
+        tables_l = row_tables + li * num_blocks
+        w_k, w_v = _split_wkv_b(lp, cfg)
+        q_lat = jnp.einsum("bhd,hrd->bhr", q_nope.astype(jnp.float32),
+                           w_k.astype(jnp.float32))
+        if not quantized:
+            vl = rank if rank % 128 == 0 else None
+            qc = jnp.concatenate(
+                [q_lat, q_pe.astype(jnp.float32),
+                 jnp.zeros((TT, H, W - rank - dr), jnp.float32)],
+                axis=-1).astype(kv_flat.dtype)
+            if use_kernel:
+                ctx = ragged_paged_attention_pallas(
+                    qc, kv_flat, kv_flat,
+                    block_tables + li * num_blocks, seq_starts,
+                    seq_counts, seq_ctx, block_size=bsz, scale=scale,
+                    max_rows=max_rows, v_lanes=vl,
+                    coalesce=statics.kv_coalesce,
+                    interpret=(use_kernel == "interpret"))
+            else:
+                from ..attention import paged_attention
+                ctx = paged_attention(
+                    qc, kv_flat, kv_flat, tables_l, seq_lens,
+                    block_size=bsz, scale=scale,
+                    impl=statics.attn_impl, kv_heads=1, v_lanes=vl,
+                    coalesce=statics.kv_coalesce)
+            ctx = ctx[..., :rank].astype(jnp.float32)
+        else:
+            idx = flat_token_indices(tables_l, bsz)
+            T = idx.shape[1]
+            rows = jnp.take(kv_flat, idx, axis=0)    # [TT, T, W]
+            rows = dequant_kv_rows_sections(rows, (rank, dr),
+                                            jnp.float32)
+            c = rows[..., :rank]
+            k_pe = rows[..., rank:rank + dr]
+            scores = (jnp.einsum("bhr,btr->bht", q_lat, c)
+                      + jnp.einsum("bhd,btd->bht",
+                                   q_pe.astype(jnp.float32),
+                                   k_pe)) * scale
+            mask = jnp.arange(T)[None, :] < seq_lens[:, None]
+            scores = jnp.where(mask[:, None, :], scores, NEG_INF)
+            probs = jax.nn.softmax(scores, axis=-1)
+            ctx = jnp.einsum("bht,btr->bhr", probs, c)
+        out = jnp.einsum("bhr,hrd->bhd", ctx,
+                         w_v.astype(jnp.float32))
+        return out.reshape(TT, H * cfg.v_head_dim).astype(q_nope.dtype)
+
+    x = _embed(params, tokens, cfg)
+    x, kv_new = _run_layers(params, kv, x, positions, slots, cfg, attn)
+    sel = jnp.take(x, sample_rows, axis=0)                     # [S, D]
+    return _logits(params, sel, cfg), kv_new
 
 
 def decode_forward(params: Params, kv: KVCache, tokens: jax.Array,

@@ -259,8 +259,8 @@ def run_follower(core, sock: socket.socket,
     """
     from .replay import (exec_dispatch_event, exec_host_restore_event,
                          exec_kv_disk_store_event, exec_kv_store_event,
-                         exec_prefill_event, exec_sp_prefill_event,
-                         exec_verify_event)
+                         exec_prefill_event, exec_ragged_event,
+                         exec_sp_prefill_event, exec_verify_event)
 
     disp_toks: "OrderedDict[int, object]" = OrderedDict()
     # disk-tier staging: evicted-row copies taken at kv_store replay for
@@ -412,5 +412,12 @@ def run_follower(core, sock: socket.socket,
             # bookkeeping the follower never needs
             _toks, core.kv = exec_verify_event(core, core.kv, ev)
             stats["verifies"] = stats.get("verifies", 0) + 1
+        elif kind == "ragged":
+            # unified ragged dispatch (engine/ragged.py) is a device
+            # program with the same host contract as dispatch/verify —
+            # run the identical packing; span bookkeeping (lane
+            # consumption, boundary samples) is leader-side
+            _toks, core.kv = exec_ragged_event(core, core.kv, ev)
+            stats["ragged"] = stats.get("ragged", 0) + 1
     logger.info("follower done: %s", stats)
     return stats

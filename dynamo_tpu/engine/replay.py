@@ -224,6 +224,40 @@ def exec_verify_event(core, kv, ev: dict):
     return toks, kv
 
 
+def exec_ragged_event(core, kv, ev: dict):
+    """Issue the recorded unified ragged dispatch (engine/ragged.py)
+    against ``kv``. Single home of the event → _ragged_jit marshalling
+    (offline replayer + live multihost follower). Returns
+    (toks [S], kv)."""
+    import jax.numpy as jnp
+
+    if core._ragged_jit is None:
+        raise NotImplementedError(
+            "recorded ragged dispatch but this core compiled without "
+            "ragged_dispatch — replay with the recorded engine config")
+    if core.cfg.ragged_max_tokens != np.asarray(ev["tokens"]).shape[0]:
+        raise NotImplementedError(
+            f"recorded ragged dispatch has "
+            f"{np.asarray(ev['tokens']).shape[0]} token rows but this "
+            f"core compiled ragged_max_tokens="
+            f"{core.cfg.ragged_max_tokens} — replay with the recorded "
+            f"engine config")
+    toks, _lps, kv = core._ragged_jit(
+        core.params, kv, jnp.array(np.asarray(ev["tokens"])),
+        jnp.array(np.asarray(ev["positions"])),
+        jnp.array(np.asarray(ev["tables"])),
+        jnp.array(np.asarray(ev["row_slot"])),
+        jnp.array(np.asarray(ev["starts"])),
+        jnp.array(np.asarray(ev["counts"])),
+        jnp.array(np.asarray(ev["sample_rows"])),
+        jnp.array(np.asarray(ev["seeds"])),
+        jnp.array(np.asarray(ev["steps"])),
+        jnp.array(np.asarray(ev["temperature"])),
+        jnp.array(np.asarray(ev["top_k"])),
+        jnp.array(np.asarray(ev["top_p"])))
+    return toks, kv
+
+
 class _MemDiskMirror:
     """In-memory stand-in for DiskKvStore during offline replay (the
     replayer applies the leader's literal disk placements; durability is
@@ -266,7 +300,7 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
     kv = llama.init_kv_cache(core.model_cfg, core.cfg.num_kv_blocks,
                              core.cfg.kv_block_size, dtype=dtype,
                              quantization=core.cfg.kv_quantization)
-    out = {"prefill": {}, "dispatch": {}, "verify": {},
+    out = {"prefill": {}, "dispatch": {}, "verify": {}, "ragged": {},
            "fingerprints": []}
     disp_toks: Dict[int, object] = {}
     disk_mirror = None     # disk (G3) mirror, built from kv_disk_store
@@ -448,6 +482,22 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
                     int(tables[i, p // bs]) * bs + p % bs
                     for p in range(p0, p0 + K))
             fp(("dispatch", ev["id"]))
+        elif kind == "ragged":
+            # unified ragged dispatch (engine/ragged.py): every span's
+            # rows wrote their positions' pool slots through the span's
+            # slot table — prefill chunks and decode rows alike
+            toks_r, kv = exec_ragged_event(core, kv, ev)
+            toks_r = jax.block_until_ready(toks_r)
+            out["ragged"][ev["id"]] = np.asarray(toks_r).copy()
+            tables = np.asarray(ev["tables"])
+            positions = np.asarray(ev["positions"])
+            starts = np.asarray(ev["starts"])
+            counts = np.asarray(ev["counts"])
+            for slot in range(counts.shape[0]):
+                for r in range(int(counts[slot])):
+                    p = int(positions[starts[slot] + r])
+                    written.add(int(tables[slot, p // bs]) * bs + p % bs)
+            fp(("ragged", ev["id"]))
         elif kind == "verify":
             # speculative verify (engine/spec/): every row — accepted,
             # rejected, pad — wrote its position's pool slot, so all of
@@ -499,6 +549,17 @@ def compare_replay(events: List[dict], replayed: dict) -> List[str]:
                 bad = np.argwhere(live != rep)
                 diffs.append(
                     f"verify {ev['id']}: live != replay at (slot,row) "
+                    f"{bad.tolist()} live={live.tolist()} "
+                    f"replay={rep.tolist()}")
+        elif ev["ev"] == "ragged_harvest":
+            rep = replayed.get("ragged", {}).get(ev["id"])
+            if rep is None:
+                continue
+            live = np.asarray(ev["toks"])
+            if not np.array_equal(live, rep):
+                bad = np.argwhere(live != rep)
+                diffs.append(
+                    f"ragged {ev['id']}: live != replay at slots "
                     f"{bad.tolist()} live={live.tolist()} "
                     f"replay={rep.tolist()}")
         elif ev["ev"] == "first_token":
@@ -578,6 +639,31 @@ def check_log(events: List[dict], block_size: int) -> List[StaleRead]:
                     w = last_writer.get(ps)
                     if w is not None and w != rid:
                         stale.append(StaleRead(-1, -1, rid, p, ps, w))
+        elif ev["ev"] == "ragged":
+            # a ragged dispatch (engine/ragged.py) is counts[slot]
+            # fused steps per slot from the pool's perspective: span
+            # row r writes position pos0+r and reads everything <= it
+            # through the slot's table — the verify event's ownership
+            # semantics with per-slot row counts
+            tables = np.asarray(ev["tables"])
+            positions = np.asarray(ev["positions"])
+            starts = np.asarray(ev["starts"])
+            counts = np.asarray(ev["counts"])
+            for i, rid in enumerate(ev["reqs"]):
+                if rid is None or int(counts[i]) == 0:
+                    continue
+                for r in range(int(counts[i])):
+                    p = int(positions[int(starts[i]) + r])
+                    ps = (int(tables[i, p // block_size]) * block_size
+                          + p % block_size)
+                    write(ps, rid)
+                    for q in range(0, p + 1):
+                        qs = (int(tables[i, q // block_size])
+                              * block_size + q % block_size)
+                        w = last_writer.get(qs)
+                        if w is not None and w != rid:
+                            stale.append(StaleRead(
+                                ev["id"], i, rid, q, qs, w))
         elif ev["ev"] in ("dispatch", "verify"):
             # a verify dispatch (engine/spec/) is K=n_rows[i] fused
             # steps per slot from the pool's perspective: row t writes
@@ -690,6 +776,37 @@ def check_inputs(events: List[dict]) -> List[str]:
                         f"verify {ev['id']} slot {i} ({rid}): row-0 "
                         f"token {int(tokens[i, 0])} != last harvested "
                         f"{st['last']}")
+        elif ev["ev"] == "ragged":
+            positions = np.asarray(ev["positions"])
+            starts = np.asarray(ev["starts"])
+            counts = np.asarray(ev["counts"])
+            steps = np.asarray(ev["steps"])
+            for i, rid in enumerate(ev["reqs"]):
+                if rid is None or rid not in state \
+                        or int(counts[i]) == 0:
+                    continue
+                st = state[rid]
+                p0 = int(positions[int(starts[i])])
+                if p0 != st["pos"]:
+                    problems.append(
+                        f"ragged {ev['id']} slot {i} ({rid}): first-row "
+                        f"position {p0} != state {st['pos']}")
+                # the span's LAST row samples at key_step + len - 1
+                # (the lane skew convention)
+                if int(steps[i]) != st["key_step"] + int(counts[i]) - 1:
+                    problems.append(
+                        f"ragged {ev['id']} slot {i} ({rid}): sample "
+                        f"key step {int(steps[i])} != state "
+                        f"{st['key_step']}+{int(counts[i]) - 1}")
+        elif ev["ev"] == "ragged_harvest":
+            toks = np.asarray(ev["toks"])
+            for slot, rid, n, emitted in ev["applied"]:
+                if rid in state:
+                    st = state[rid]
+                    st["pos"] += n
+                    st["key_step"] += n
+                    if emitted:
+                        st["last"] = int(toks[slot])
         elif ev["ev"] == "harvest":
             toks = np.asarray(ev["toks"])
             for slot, rid, n in ev["applied"]:

@@ -69,6 +69,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "tokens ride the decode batch as planned inputs "
                         "when the engine is busy (continuous batching; "
                         "0 disables, needs K>1)")
+    p.add_argument("--ragged", action="store_true",
+                   help="unified ragged dispatch (engine/ragged.py): "
+                        "ONE compiled program serves mixed prefill+"
+                        "decode batches — admissions ride the batch as "
+                        "prefill lanes, continuous batching becomes "
+                        "the only serving code path "
+                        "(docs/ragged_attention.md)")
+    p.add_argument("--ragged-max-tokens", type=int, default=0,
+                   help="token capacity of one ragged dispatch (0 = "
+                        "auto: max_num_seqs + 2*ragged-max-seq-rows)")
+    p.add_argument("--ragged-max-seq-rows", type=int, default=64,
+                   help="per-sequence row budget per ragged dispatch "
+                        "(longer prompts stream across dispatches)")
     p.add_argument("--spec-k", type=int, default=0,
                    help="speculative decoding: max prompt-lookup draft "
                         "tokens verified per step (engine/spec/; 0 "
@@ -230,6 +243,9 @@ def engine_config(args):
         decode_steps_per_dispatch=args.decode_steps_per_dispatch,
         decode_dispatch_pipeline=args.decode_dispatch_pipeline,
         lane_prefill_max_tokens=args.lane_prefill_max_tokens,
+        ragged_dispatch=args.ragged,
+        ragged_max_tokens=args.ragged_max_tokens,
+        ragged_max_seq_rows=args.ragged_max_seq_rows,
         spec_k=args.spec_k,
         quantization=args.quantization,
         kv_quantization=args.kv_quantization,

@@ -73,15 +73,21 @@ class JaxEngine(AsyncEngine):
 
         async def stream() -> AsyncIterator[Annotated[BackendOutput]]:
             first = True
+            emitted = 0
             while True:
                 item, payload = await req.out_queue.get()
                 if item is FINISH_SENTINEL:
                     reason: FinishReason = payload
                     if trace is not None:
-                        trace.event("engine.finish", reason=str(reason))
+                        # isl/osl ride the finish marker so collected
+                        # traces are exportable as a replayable workload
+                        # (tools/fleetsim.py export-trace)
+                        trace.event("engine.finish", reason=str(reason),
+                                    isl=len(req.prompt), osl=emitted)
                     yield Annotated.from_data(BackendOutput.final(reason))
                     return
                 token, logprob = item, payload
+                emitted += 1
                 if first:
                     first = False
                     if trace is not None:   # TTFT marker on the trace

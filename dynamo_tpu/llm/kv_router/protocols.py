@@ -97,6 +97,15 @@ class ForwardPassMetrics:
     pp_microbatch: int = 0
     pp_utilization: float = 0.0
     pp_bubble_fraction: float = 0.0
+    # unified ragged dispatch (engine/ragged.py +
+    # docs/ragged_attention.md) — the nv_llm_ragged_* gauge feeds:
+    # tokens-per-dispatch fill ratio against the compiled capacity,
+    # the fraction of dispatches serving prefill AND decode rows
+    # together, and the cumulative split-path dispatches the packing
+    # replaced. Zeros on old payloads / non-ragged engines.
+    ragged_fill_ratio: float = 0.0
+    ragged_mixed_ratio: float = 0.0
+    ragged_dispatches_saved_total: int = 0
     # fleet tracing + engine flight recorder (runtime/tracing.py +
     # engine/flight_recorder.py): trace log lines the sampler skipped
     # (nv_llm_trace_dropped_log_lines_total — rising means sampling is

@@ -196,6 +196,43 @@ def test_bench_pp_mode():
     assert dcn["worst_corner_tok_per_s"] > 0
 
 
+@pytest.mark.ragged
+def test_bench_ragged_mode():
+    """--ragged rides a bench run (ISSUE 10 satellite): the result line
+    must carry the `ragged` provenance dict — the mixed-traffic A/B
+    between the split prefill/decode program path and the unified
+    ragged dispatch. The acceptance gates: FEWER dispatches per emitted
+    token, a REDUCED compiled-program count (one ragged program vs the
+    per-bucket prefill family + decode), genuinely mixed batches, and
+    stream agreement up to each request's first numeric boundary."""
+    if os.environ.get("CI_SKIP_SLOW"):
+        pytest.skip("slow smoke")
+    r = _run(
+        [sys.executable, "bench.py", "--ragged"],
+        {"BENCH_FORCE_CPU": "1", "BENCH_MODEL": "tiny", "BENCH_BATCH": "2",
+         "BENCH_STEPS": "4", "BENCH_PROMPT": "8", "BENCH_HARVEST": "2",
+         "BENCH_QUANT": "none", "BENCH_DEVICE": "0",
+         "BENCH_RAGGED_BATCH": "4", "BENCH_RAGGED_PROMPT": "48",
+         "BENCH_RAGGED_SEQ_ROWS": "16"})
+    assert r.returncode == 0, f"bench.py crashed:\n{r.stderr[-4000:]}"
+    out = json.loads([l for l in r.stdout.strip().splitlines()
+                      if l.startswith("{")][-1])
+    assert "error" not in out, f"bench fell back instead of running: {out}"
+    rg = out.get("ragged")
+    assert rg, f"no ragged provenance in the result: {out}"
+    # the acceptance criteria's always-on CPU gates
+    assert rg["ragged_dispatches_per_token"] \
+        < rg["split_dispatches_per_token"], rg
+    assert rg["ragged_compiled_programs"] \
+        < rg["split_compiled_programs"], rg
+    assert rg["ragged_dispatches_saved"] >= 1
+    assert 0.0 < rg["ragged_fill_ratio"] <= 1.0
+    assert rg["ragged_mixed_ratio"] > 0.0, (
+        "the staggered workload never mixed prefill rows into a decode "
+        "dispatch — the A/B measured nothing ragged")
+    assert rg["tokens_exact_to_boundary"] is True
+
+
 def test_bench_mla_geometry_runs():
     """The MLA bench path (latent {"kv"} pool, absorbed-decode flop
     accounting): bench.py must run the deepseek-class geometry — the

@@ -222,6 +222,7 @@ def test_grafana_dashboard_queries_real_metrics():
                                        r"rate)", e))
     from dynamo_tpu.components.metrics import (_GAUGE_FIELDS,
                                                _LAYOUT_GAUGES, _PP_GAUGES,
+                                               _RAGGED_GAUGES,
                                                _REMOTE_GAUGES,
                                                _SPEC_GAUGES, _TIER_GAUGES,
                                                _TRACE_GAUGES, PREFIX)
@@ -232,6 +233,7 @@ def test_grafana_dashboard_queries_real_metrics():
     exported |= set(_PP_GAUGES.values())
     exported |= set(_LAYOUT_GAUGES.values())
     exported |= set(_REMOTE_GAUGES.values())
+    exported |= set(_RAGGED_GAUGES.values())
     exported |= set(_TRACE_GAUGES.values())
     # trace-collector latency histograms (components/trace_collector.py
     # — exemplar-carrying; the Grafana "Tracing" row queries them)

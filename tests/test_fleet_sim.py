@@ -331,3 +331,55 @@ def test_fleetsim_cli_smoke(capsys):
 
 def test_virtual_clock_reexports():
     assert isinstance(VirtualClock().monotonic(), float)
+
+
+def test_export_trace_roundtrip(tmp_path):
+    """ROADMAP fleet-sim extension (b): collected traces → workload
+    exporter. Real Trace.to_dict dicts (the engine.finish isl/osl
+    marker + a legacy trace relying on the engine.prefill fallback) go
+    through ``fleetsim export-trace`` and come back through
+    Workload.load_jsonl with arrivals relative to the earliest origin
+    and token counts intact; a countless trace is skipped, not
+    fabricated."""
+    from dynamo_tpu.runtime.tracing import Trace
+
+    traces = []
+    for i in range(3):
+        t = Trace(f"req-{i}", role="worker")
+        t.origin_ts = 1000.0 + 2.5 * i
+        t.add_span("engine.prefill", t.start, t.start + 0.01,
+                   suffix=100 + i, hit=20)
+        t.event("engine.finish", reason="FinishReason.LENGTH",
+                isl=120 + i, osl=30 + i)
+        traces.append(t.to_dict())
+    legacy = Trace("req-legacy", role="worker")
+    legacy.origin_ts = 1009.0
+    legacy.add_span("engine.prefill", legacy.start, legacy.start + 0.01,
+                    suffix=64, hit=8)
+    traces.append(legacy.to_dict())
+    junk = Trace("req-junk", role="frontend")
+    junk.origin_ts = 1010.0
+    traces.append(junk.to_dict())
+
+    src = tmp_path / "traces.json"
+    out = tmp_path / "workload.jsonl"
+    src.write_text(json.dumps(traces))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    try:
+        import fleetsim
+        rc = fleetsim.main(["export-trace", "--traces", str(src),
+                            "--out", str(out)])
+    finally:
+        sys.path.pop(0)
+    assert rc == 0
+    wl = Workload.load_jsonl(str(out))
+    assert len(wl) == 4                      # junk skipped
+    specs = {s.rid: s for s in wl}
+    assert specs["req-0"].at == 0.0          # relative to earliest origin
+    assert specs["req-2"].at == 5.0
+    assert specs["req-1"].isl == 121 and specs["req-1"].osl == 31
+    assert specs["req-legacy"].isl == 72     # suffix + hit fallback
+    assert specs["req-legacy"].osl == 16     # default osl
+    # the exported file IS the sim's trace format: the fleet can run it
+    assert wl.duration_s == 9.0

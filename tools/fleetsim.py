@@ -23,6 +23,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "export-trace":
+        # ROADMAP fleet-sim extension (b): collected production traces →
+        # a Workload.load_jsonl-compatible trace the simulator replays
+        return export_trace_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="fleetsim", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -136,6 +141,96 @@ def _print_report(r: dict) -> None:
             print(f"    - {v}")
     else:
         print("  checks    all expectations held")
+
+
+def traces_to_workload(trace_dicts, *, default_osl: int = 16,
+                       tenant: str = "t00"):
+    """Collected trace dicts (runtime/tracing.py ``Trace.to_dict``
+    shape — what workers publish on the ``trace_events`` subject and
+    the collector stores per tree member) → a sim Workload.
+
+    Per trace tree (grouped on ``trace_id``): arrival ``at`` is the
+    origin wall clock relative to the earliest trace in the set; ``rid``
+    the request id; ``isl``/``osl`` come from the worker trace's
+    ``engine.finish`` marker attrs (llm/engines/jax_engine.py stamps
+    them), with ``engine.prefill``'s suffix+hit as the isl fallback.
+    Traces with no token counts at all are skipped (returned count).
+    Tenant/session/turn have no trace-side source yet, so each request
+    becomes its own session of ``tenant`` — prefix-reuse structure is
+    the one thing a replayed production trace currently loses."""
+    from dynamo_tpu.sim.workload import RequestSpec, Workload
+
+    trees = {}
+    for d in trace_dicts:
+        tid = d.get("trace_id")
+        if tid:
+            trees.setdefault(tid, []).append(d)
+    specs, skipped = [], 0
+    origin0 = min((min(m.get("origin_ts", 0.0) or 0.0 for m in ms)
+                   for ms in trees.values()), default=0.0)
+    for tid, members in sorted(trees.items()):
+        isl = osl = None
+        rid = None
+        at = None
+        for m in sorted(members, key=lambda x: x.get("origin_offset_ms",
+                                                     0.0)):
+            rid = rid or m.get("request_id")
+            if at is None and m.get("origin_ts"):
+                at = float(m["origin_ts"]) - origin0
+            spans = {s["name"]: s for s in m.get("spans", ())}
+            fin = spans.get("engine.finish", {}).get("attrs", {})
+            if isl is None and fin.get("isl") is not None:
+                isl = int(fin["isl"])
+            if osl is None and fin.get("osl") is not None:
+                osl = int(fin["osl"])
+            pf = spans.get("engine.prefill", {}).get("attrs", {})
+            if isl is None and pf.get("suffix") is not None:
+                isl = int(pf.get("suffix", 0)) + int(pf.get("hit", 0))
+        if isl is None or not rid:
+            skipped += 1
+            continue
+        specs.append(RequestSpec(
+            at=round(max(at or 0.0, 0.0), 6), rid=str(rid),
+            tenant=tenant, session=f"{tenant}-{rid}", turn=0,
+            isl=max(int(isl), 1),
+            osl=max(int(osl if osl is not None else default_osl), 1)))
+    return Workload(specs), skipped
+
+
+def export_trace_main(argv) -> int:
+    """``fleetsim export-trace``: trace-collector dumps → a replayable
+    workload JSONL (sim/workload.py Workload.load_jsonl format).
+
+    Input: a JSON file holding a LIST of trace dicts (or {"traces":
+    [...]}): e.g. the members of ``GET /traces/{id}`` trees, or traces
+    captured straight off the ``trace_events`` subject. Output rides
+    Workload.save_jsonl, so load_jsonl round-trips it verbatim."""
+    p = argparse.ArgumentParser(
+        prog="fleetsim export-trace",
+        description=export_trace_main.__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--traces", required=True,
+                   help="JSON file: list of collected trace dicts")
+    p.add_argument("--out", required=True,
+                   help="workload JSONL to write "
+                        "(Workload.load_jsonl-compatible)")
+    p.add_argument("--tenant", default="t00",
+                   help="tenant label stamped on every request")
+    p.add_argument("--default-osl", type=int, default=16,
+                   help="osl for traces whose finish marker predates "
+                        "the isl/osl attrs")
+    args = p.parse_args(argv)
+    with open(args.traces) as f:
+        raw = json.load(f)
+    if isinstance(raw, dict):
+        raw = raw.get("traces", [])
+    wl, skipped = traces_to_workload(raw, default_osl=args.default_osl,
+                                     tenant=args.tenant)
+    wl.save_jsonl(args.out)
+    print(f"exported {len(wl)} request(s) to {args.out}"
+          + (f" ({skipped} trace(s) skipped: no token counts)"
+             if skipped else ""))
+    return 0 if len(wl) else 2
 
 
 if __name__ == "__main__":
