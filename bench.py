@@ -324,22 +324,23 @@ def run_ragged_bench(mcfg) -> dict:
                 return toks, req
             toks.append(item)
 
-    async def drive(core):
+    async def drive(core, workload):
         # staggered submission: later requests admit while earlier
         # ones decode, so prefill work genuinely contends with decode
         # dispatches (the mixed-traffic shape the ragged batch packs)
         async def delayed(i):
             await asyncio.sleep(0.02 * i)
-            return await serve_one(core, prompts[i], f"r{i}")
+            return await serve_one(core, workload[i], f"r{i}")
         return await asyncio.gather(*[delayed(i)
                                       for i in range(n_req)])
 
-    def run_path(cfg) -> dict:
+    def run_path(cfg, workload=None) -> dict:
         core = EngineCore(mcfg, cfg, attn_impl="auto",
                           param_dtype=jnp.bfloat16)
 
         async def run_all():
-            res = await drive(core)
+            res = await drive(core, workload if workload is not None
+                              else prompts)
             await core.stop()
             return res
 
@@ -365,6 +366,7 @@ def run_ragged_bench(mcfg) -> dict:
                            if cfg.ragged_dispatch else
                            kinds.get("prefill", 0)
                            + kinds.get("decode", 0)),
+            "kinds": kinds,
             "compiled_programs": compiled,
         }
 
@@ -411,6 +413,72 @@ def run_ragged_bench(mcfg) -> dict:
           f"{out['ragged_compiled_programs']}, fill "
           f"{out['ragged_fill_ratio']}, mixed "
           f"{out['ragged_mixed_ratio']}", file=sys.stderr)
+
+    spec_k = spec_mode_k()
+    if spec_k > 0:
+        # --ragged --spec combination leg (round 11): the SAME
+        # staggered workload — repetitive prompts so the n-gram
+        # drafter engages — served by (a) the split SPEC path
+        # (per-bucket prefill + decode + the dedicated verify program)
+        # and (b) the unified ragged path with spec spans riding the
+        # one compiled program. The measured story: dispatches per
+        # emitted token, accepted draft tokens per dispatch, compiled
+        # programs (must stay 1), and the wave-prefetch hit ratio.
+        period = max(2, p_len // 8)
+        spec_prompts = []
+        for l in rng.integers(p_len // 2, p_len + 1, size=n_req):
+            pat = rng.integers(1, mcfg.vocab_size,
+                               size=period).tolist()
+            spec_prompts.append((pat * (int(l) // period + 1))[:int(l)])
+        sp_split = run_path(EngineConfig(**base,
+                                         decode_steps_per_dispatch=1,
+                                         spec_k=spec_k),
+                            workload=spec_prompts)
+        sp_rag = run_path(EngineConfig(**base, ragged_dispatch=True,
+                                       ragged_max_seq_rows=rows,
+                                       spec_k=spec_k),
+                          workload=spec_prompts)
+        sc, rc = sp_split["core"], sp_rag["core"]
+        split_disp = (sp_split["dispatches"]
+                      + sp_split["kinds"].get("verify", 0))
+        exact = True
+        for ts, tr, bounds in zip(sp_split["streams"],
+                                  sp_rag["streams"],
+                                  sp_rag["boundaries"]):
+            bound = min(bounds) if bounds else min(len(ts), len(tr))
+            if ts[:bound] != tr[:bound]:
+                exact = False
+        out["spec"] = {
+            "spec_k": spec_k,
+            "emitted_tokens": sp_rag["emitted"],
+            "split_spec_dispatches": split_disp,
+            "ragged_spec_dispatches": sp_rag["dispatches"],
+            "split_spec_dispatches_per_token": round(
+                split_disp / max(sp_split["emitted"], 1), 4),
+            "ragged_spec_dispatches_per_token": round(
+                sp_rag["dispatches"] / max(sp_rag["emitted"], 1), 4),
+            "split_accepted_per_dispatch": round(
+                sc.spec_accepted_tokens / max(split_disp, 1), 4),
+            "ragged_accepted_per_dispatch": round(
+                rc.spec_accepted_tokens / max(sp_rag["dispatches"], 1),
+                4),
+            "ragged_spec_rows": rc.ragged_spec_rows,
+            "ragged_spec_accepted": rc.spec_accepted_tokens,
+            "split_spec_accepted": sc.spec_accepted_tokens,
+            "ragged_compiled_programs": sp_rag["compiled_programs"],
+            "prefetch_hit_ratio": round(
+                rc.ragged_prefetched_waves
+                / max(rc.ragged_first_waves, 1), 4),
+            "tokens_exact_to_boundary": exact,
+        }
+        print(f"# ragged --spec leg: dispatches/token "
+              f"{out['spec']['split_spec_dispatches_per_token']} -> "
+              f"{out['spec']['ragged_spec_dispatches_per_token']}, "
+              f"accepted/dispatch "
+              f"{out['spec']['split_accepted_per_dispatch']} -> "
+              f"{out['spec']['ragged_accepted_per_dispatch']}, "
+              f"prefetch hit {out['spec']['prefetch_hit_ratio']}",
+              file=sys.stderr)
     return out
 
 

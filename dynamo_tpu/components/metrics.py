@@ -102,11 +102,17 @@ _TIER_GAUGES = {
 # capacity is oversized or admissions are starving) next to the
 # mixed-batch ratio (prefill chunks actually riding decode dispatches —
 # the batch-boundary bubbles being eliminated) and the cumulative
-# split-path dispatches the packing saved.
+# split-path dispatches the packing saved. Round 11 adds the
+# cross-sequence wave-prefetch hit ratio (first waves a predecessor's
+# last wave already covered — LOW under load means dispatches carry too
+# few concurrent spans to chain) and the cumulative draft rows that
+# rode ragged dispatches as speculative spans.
 _RAGGED_GAUGES = {
     "ragged_fill_ratio": "nv_llm_ragged_fill_ratio",
     "ragged_mixed_ratio": "nv_llm_ragged_mixed_batch_ratio",
     "ragged_dispatches_saved_total": "nv_llm_ragged_dispatches_saved_total",
+    "ragged_prefetch_hit_ratio": "nv_llm_ragged_prefetch_hit_ratio",
+    "ragged_spec_rows_total": "nv_llm_ragged_spec_rows_total",
 }
 
 # fleet tracing + engine flight recorder (runtime/tracing.py sampling

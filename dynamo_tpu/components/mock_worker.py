@@ -294,6 +294,11 @@ class MockTokenWorker:
             d["ragged_fill_ratio"] = 0.7
             d["ragged_mixed_ratio"] = 0.33
             d["ragged_dispatches_saved_total"] = eng.requests_served
+            # round 11: a healthy prefetch chain (most first waves
+            # covered by a predecessor) and spec draft rows riding the
+            # ragged batch, growing with traffic
+            d["ragged_prefetch_hit_ratio"] = 0.8
+            d["ragged_spec_rows_total"] = 3 * eng.requests_served
         if eng is not None and not d.get("remote_link_gbps"):
             # synthetic KV-fabric gauges (docs/kv_fabric.md): a healthy
             # fabric — some object-tier residency, a ~10 GB/s / 1 ms

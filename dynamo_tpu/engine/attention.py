@@ -1228,9 +1228,23 @@ def paged_attention(q, k_cache, v_cache, block_tables, seq_lens, *,
 # full static Lmax window; the overhang past counts[s] lands in the
 # NEXT sequence's region and is rewritten by it (the grid is
 # sequential), so the builder must hand the kernel ASCENDING starts.
-# Unlike the decode kernel there is no cross-sequence wave prefetch yet
-# (one exposed first-wave latency per sequence) — at ragged batch sizes
-# the per-sequence q/o DMAs already overlap it in practice.
+#
+# Cross-sequence wave prefetch (round 11): the decode kernel's
+# wave-parity trick, ported. Scratch persists over the sequential grid,
+# so each sequence's LAST KV wave starts the SUCCESSOR's first wave —
+# without it every sequence exposes one first-wave DMA latency (at
+# short ragged spans that is 1 exposed wave in 2, the same economics
+# the decode kernel measured at ~44% of HBM peak). Buffer slots follow
+# a GLOBAL wave parity carried in SMEM (wave_ref) rather than the
+# per-sequence chunk index, so producer and consumer agree on the
+# double-buffer slot across sequence boundaries. `seq_shape` is the
+# ONE home of a sequence's wave geometry — the prefetching predecessor
+# and the consuming sequence both derive (nb, nc, start_ci) from it,
+# so a prefetch is issued iff the consumer will wait for it. A
+# zero-row or zero-wave sequence breaks the chain (its successor
+# starts its own first wave), exactly like the decode kernel's
+# empty-predecessor case. ``prefetch=False`` keeps the round-10 walk
+# (the A/B baseline; BIT-identical output either way).
 
 # per-sequence sliding-window base for GLOBAL layers: hugely negative so
 # win_base + row never masks anything (a real floor is pos0 - window,
@@ -1242,20 +1256,24 @@ def _ragged_attn_kernel(block_tables_ref, starts_ref, counts_ref,
                         seq_lens_ref, win_base_ref, runs_ref,
                         q_hbm, k_hbm, v_hbm, o_hbm,
                         q_buf, o_buf, m_ref, l_ref, acc_ref,
-                        k_bufs, v_bufs, sems, qo_sem,
+                        k_bufs, v_bufs, sems, qo_sem, wave_ref,
                         *, block_size: int, chunk: int, scale: float,
                         Lmax: int, Hp: int,
                         softcap: float | None = None,
                         quant_lanes: int | None = None,
                         v_lanes: int | None = None,
                         quant_sections: tuple | None = None,
-                        coalesce: bool = True):
+                        coalesce: bool = True,
+                        prefetch: bool = True):
     """One grid program = one sequence: DMA its q rows, stream its KV
     waves (shared machinery), online-softmax all rows at once, DMA the
     output rows back. q_hbm/o_hbm: [TT + Lmax, Hp, C/Cv] (ANY memory,
     Lmax overhang rows so the static-window copies stay in bounds);
-    scalar-prefetched metadata as in the module comment above."""
+    scalar-prefetched metadata as in the module comment above;
+    wave_ref: [1] SMEM global wave parity carried ACROSS programs (the
+    cross-sequence prefetch chain — module comment)."""
     s = pl.program_id(0)
+    S = pl.num_programs(0)
     quantized = quant_lanes is not None
     C = quant_lanes if quantized else q_buf.shape[-1]
     dequant_tile, dequant_tile_sections = _make_dequant_tile(
@@ -1264,7 +1282,28 @@ def _ragged_attn_kernel(block_tables_ref, starts_ref, counts_ref,
         block_tables_ref, runs_ref, k_hbm, v_hbm, k_bufs, v_bufs, sems,
         block_size=block_size, chunk=chunk, v_lanes=v_lanes,
         coalesce=coalesce)
+
+    def seq_shape(si):
+        """(num_blocks, num_chunks, start_ci) for sequence si — the ONE
+        home of the wave geometry the prefetch chain's producer and
+        consumer must agree on. Zero rows → zero waves; start_ci is
+        clamped to nc so `nc - start_ci` IS the wave count."""
+        nb = (seq_lens_ref[si] + block_size - 1) // block_size
+        nc = (nb + chunk - 1) // chunk
+        nc = jnp.where(counts_ref[si] > 0, nc, 0)
+        # sliding windows: waves entirely below every row's window are
+        # dead — the FIRST row's floor is the loosest bound
+        sc = jnp.minimum(
+            jnp.maximum(win_base_ref[si] + 1, 0) // (chunk * block_size),
+            nc)
+        return nb, nc, sc
+
     L = counts_ref[s]
+
+    if prefetch:
+        @pl.when(s == 0)
+        def _():
+            wave_ref[0] = 0
 
     @pl.when(L > 0)
     def _():
@@ -1272,16 +1311,33 @@ def _ragged_attn_kernel(block_tables_ref, starts_ref, counts_ref,
         seq_len = seq_lens_ref[s]
         win_base = win_base_ref[s]
         pos0 = seq_len - L           # row r sits at position pos0 + r
-        nb = (seq_len + block_size - 1) // block_size
-        nc = (nb + chunk - 1) // chunk
-        # sliding windows: waves entirely below every row's window are
-        # dead — the FIRST row's floor is the loosest bound
-        start_ci = jnp.maximum(win_base + 1, 0) // (chunk * block_size)
+        nb, nc, start_ci = seq_shape(s)
+
+        if prefetch:
+            p0 = wave_ref[0]  # global parity of this seq's first wave
+            # this sequence's first wave was already started by the
+            # previous sequence's last loop iteration — unless there is
+            # no predecessor or the predecessor had no waves
+            if S > 1:
+                _, prev_nc, prev_sc = seq_shape(jnp.maximum(s - 1, 0))
+                pred_started = (s > 0) & (prev_sc < prev_nc)
+                nsq = jnp.minimum(s + 1, S - 1)
+                next_nb, next_nc, next_sc = seq_shape(nsq)
+            else:
+                pred_started = jnp.bool_(False)
+        else:
+            p0 = jnp.int32(0)
+            pred_started = jnp.bool_(False)
 
         qc = pltpu.make_async_copy(
             q_hbm.at[pl.ds(start, Lmax)], q_buf, qo_sem)
         qc.start()
-        wave_dma("start", s, start_ci, 0, nb)
+
+        @pl.when((start_ci < nc) & ~pred_started)
+        def _():
+            # empty wave range: an unwaited start would leak semaphore
+            # signal into the next sequence's waves
+            wave_dma("start", s, start_ci, jax.lax.rem(p0, 2), nb)
         qc.wait()
         qm = q_buf[...].reshape(Lmax * Hp, C).astype(jnp.float32) * scale
 
@@ -1297,11 +1353,17 @@ def _ragged_attn_kernel(block_tables_ref, starts_ref, counts_ref,
         win_lo_r = win_base + row               # sentinel stays huge-neg
 
         def body(ci, _):
-            slot = jax.lax.rem(ci - start_ci, 2)
+            slot = jax.lax.rem(p0 + ci - start_ci, 2)
 
             @pl.when(ci + 1 < nc)
             def _():
                 wave_dma("start", s, ci + 1, 1 - slot, nb)
+
+            if prefetch and S > 1:
+                @pl.when((ci + 1 >= nc) & (s + 1 < S)
+                         & (next_sc < next_nc))
+                def _():   # last wave: prefetch the successor's first
+                    wave_dma("start", nsq, next_sc, 1 - slot, next_nb)
 
             wave_dma("wait", s, ci, slot, nb)
             if quant_sections is not None:
@@ -1343,6 +1405,12 @@ def _ragged_attn_kernel(block_tables_ref, starts_ref, counts_ref,
         oc.start()
         oc.wait()
 
+        if prefetch:
+            # hand the successor its first-wave parity: the last-wave
+            # prefetch above placed it at rem(p0 + waves, 2)
+            wave_ref[0] = jax.lax.rem(
+                p0 + jnp.maximum(nc - start_ci, 0), 2)
+
 
 def ragged_paged_attention_pallas(q: jax.Array, k_cache: jax.Array,
                                   v_cache: jax.Array,
@@ -1358,6 +1426,7 @@ def ragged_paged_attention_pallas(q: jax.Array, k_cache: jax.Array,
                                   v_lanes: int | None = None,
                                   quant_sections: tuple | None = None,
                                   coalesce: bool = True,
+                                  prefetch: bool = True,
                                   interpret: bool = False) -> jax.Array:
     """Ragged mixed prefill+decode attention in ONE dispatch.
 
@@ -1374,7 +1443,14 @@ def ragged_paged_attention_pallas(q: jax.Array, k_cache: jax.Array,
     sectioned-int8 MLA rows (``quant_sections``) follow the decode
     kernel's contracts exactly. Returns [TT, H, Dh-or-v_lanes]; rows not
     owned by any sequence return garbage (the engine reads only sample
-    rows and the tests compare only owned rows)."""
+    rows and the tests compare only owned rows).
+
+    ``prefetch`` (default on): carry the wave parity across the
+    sequential grid so each sequence's last KV wave starts the
+    successor's first — the cross-sequence prefetch chain (module
+    comment; BIT-identical output, asserted across the geometry sweep).
+    False keeps the round-10 walk with one exposed first-wave latency
+    per sequence (the A/B baseline and escape hatch)."""
     TT, H, Dh = q.shape
     NTOK, Cx = k_cache.shape
     S, M = block_tables.shape
@@ -1444,24 +1520,25 @@ def ragged_paged_attention_pallas(q: jax.Array, k_cache: jax.Array,
                        v_cache.dtype),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA,          # q/o window copies
+            pltpu.SMEM((1,), jnp.int32),   # cross-sequence wave parity
         ],
     )
 
     def kernel(block_tables_ref, starts_ref, counts_ref, seq_lens_ref,
                win_base_ref, runs_ref, q_hbm, k_hbm, v_hbm, o_hbm,
                q_buf, o_buf, m_ref, l_ref, acc_ref, k_bufs, v_bufs,
-               sems, qo_sem):
+               sems, qo_sem, wave_ref):
         _ragged_attn_kernel(
             block_tables_ref, starts_ref, counts_ref, seq_lens_ref,
             win_base_ref, runs_ref, q_hbm, k_hbm, v_hbm, o_hbm,
             q_buf, o_buf, m_ref, l_ref, acc_ref, k_bufs, v_bufs,
-            sems, qo_sem,
+            sems, qo_sem, wave_ref,
             block_size=block_size, chunk=chunk, scale=scale,
             Lmax=Lmax, Hp=Hp, softcap=softcap,
             quant_lanes=(C if quantized and quant_sections is None
                          else None),
             v_lanes=v_lanes, quant_sections=quant_sections,
-            coalesce=coalesce)
+            coalesce=coalesce, prefetch=prefetch)
 
     out = pl.pallas_call(
         kernel,
@@ -1482,6 +1559,43 @@ def ragged_paged_attention_pallas(q: jax.Array, k_cache: jax.Array,
     kh = (jnp.arange(H) // g)[None, :, None, None]
     return jnp.take_along_axis(out, kh, axis=2)[:, :, 0].reshape(
         TT, H, Dh)
+
+
+def ragged_prefetch_counts(seq_counts, seq_lens, win_base=None, *,
+                           block_size: int,
+                           blocks_per_table: int | None = None,
+                           chunk_blocks: int | None = None) -> dict:
+    """Host-side count of the ragged kernel's cross-sequence prefetch
+    chain over one dispatch — the CPU-side truth the ragged prefetch
+    gauges and bench ride (the dma_copy_counts precedent: the metric is
+    the kernel's wave walk mirrored exactly, so it is honest on CPU
+    where the XLA fallback runs no kernel at all).
+
+    Per sequence (in grid order): it has a first wave iff it owns rows
+    and at least one KV wave survives its window floor (the kernel's
+    `seq_shape`); that first wave is PREFETCHED iff the immediately
+    preceding sequence also had >= 1 wave (its last wave started ours —
+    the parity chain). ``win_base`` None = global layers (floor 0).
+    Returns {first_waves, prefetched, exposed, hit_ratio}."""
+    counts = np.asarray(seq_counts)
+    sl = np.asarray(seq_lens)
+    if chunk_blocks is None:
+        chunk_blocks = int(os.environ.get("DYN_ATTN_CHUNK_BLOCKS", "16"))
+    chunk = max(1, (min(chunk_blocks, blocks_per_table)
+                    if blocks_per_table else chunk_blocks))
+    nb = -(-sl // block_size)
+    nc = np.where(counts > 0, -(-nb // chunk), 0)
+    if win_base is None:
+        sc = np.zeros_like(nc)
+    else:
+        sc = np.minimum(np.maximum(np.asarray(win_base) + 1, 0)
+                        // (chunk * block_size), nc)
+    has = (nc - sc) > 0
+    first_waves = int(has.sum())
+    prefetched = int((has[1:] & has[:-1]).sum())
+    return {"first_waves": first_waves, "prefetched": prefetched,
+            "exposed": first_waves - prefetched,
+            "hit_ratio": prefetched / max(first_waves, 1)}
 
 
 # VMEM budget for the ragged kernel's per-sequence windows (q + o + acc

@@ -748,10 +748,14 @@ class EngineConfig:
                     "pp with weight/KV quantization is not implemented "
                     "(QuantizedArray leaves under the stage shard_map "
                     "are unvalidated)")
-        if self.decode_dispatch_pipeline and self.decode_steps_per_dispatch <= 1:
+        if (self.decode_dispatch_pipeline
+                and self.decode_steps_per_dispatch <= 1
+                and not self.ragged_dispatch):
             raise ValueError(
                 "decode_dispatch_pipeline requires decode_steps_per_dispatch"
-                " > 1 (the pipeline defers multi-step harvests)")
+                " > 1 (the pipeline defers multi-step harvests) — except "
+                "under ragged_dispatch, whose single-step dispatches "
+                "pipeline via the chained-sample merge")
         if self.spec_k < 0:
             raise ValueError("spec_k must be >= 0 (0 disables speculation)")
         if not 0.0 <= self.kv_defrag_threshold <= 1.0:
@@ -793,28 +797,28 @@ class EngineConfig:
                     f"{self.max_num_seqs + 1}) and at least one full "
                     f"per-sequence chunk (>= ragged_max_seq_rows = "
                     f"{self.ragged_max_seq_rows})")
+            # composition matrix (docs/ragged_attention.md §composition):
+            # ragged composes with speculative decoding (spec spans —
+            # draft rows are just more span rows) and with
+            # decode_dispatch_pipeline (the chained-sample merge); the
+            # two survivors below are the full refusal set.
             if self.pp > 1:
                 raise NotImplementedError(
                     "ragged dispatch on a pp engine is not implemented "
-                    "(the ragged program has no token-interleaved "
-                    "stage form yet)")
+                    "(the ragged program has no token-interleaved stage "
+                    "form yet). Ragged composes with tp, int8 KV, MLA, "
+                    "sliding windows, speculative decoding (spec_k), "
+                    "and decode_dispatch_pipeline — see docs/"
+                    "ragged_attention.md §composition")
             if self.sp > 1:
                 raise NotImplementedError(
                     "ragged dispatch with sequence-parallel prefill is "
                     "not implemented (long cold prompts would bypass "
-                    "the ragged batch; run one or the other)")
-            if self.spec_k > 0:
-                raise NotImplementedError(
-                    "ragged dispatch with speculative decoding is not "
-                    "implemented (draft rows and prompt rows would "
-                    "contend for the same ragged capacity; compose "
-                    "them in a later round)")
-            if self.decode_dispatch_pipeline:
-                raise NotImplementedError(
-                    "ragged dispatch with decode_dispatch_pipeline is "
-                    "not implemented (the ragged step harvests every "
-                    "dispatch; pipelining it needs a chained-sample "
-                    "merge the ragged program doesn't carry yet)")
+                    "the ragged batch; run one or the other). Ragged "
+                    "composes with tp, int8 KV, MLA, sliding windows, "
+                    "speculative decoding (spec_k), and "
+                    "decode_dispatch_pipeline — see docs/"
+                    "ragged_attention.md §composition")
         if self.lane_prefill_max_tokens > 0 \
                 and self.decode_steps_per_dispatch <= 1:
             raise ValueError(

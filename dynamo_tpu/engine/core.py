@@ -383,6 +383,7 @@ class EngineCore:
         # log (dispatch inputs in device order) for deterministic replay
         self.recorder = None
         self._pending: Optional[dict] = None   # un-harvested decode dispatch
+        self._ragged_pending: Optional[dict] = None  # pipelined ragged
         self._admissions: List[tuple] = []     # (req, tok_dev, logprob_dev)
         self._onboards: List[tuple] = []       # (req, slot, plan, prepped)
         self._onboard_tasks: set = set()
@@ -459,6 +460,15 @@ class EngineCore:
         self.ragged_decode_rows_total = 0
         self.ragged_mixed_dispatches = 0
         self.ragged_dispatches_saved = 0
+        # ragged×spec: draft rows that rode ragged dispatches (the
+        # nv_llm_ragged_spec_rows_total feed); acceptance rides the
+        # shared spec_* counters below
+        self.ragged_spec_rows = 0
+        # cross-sequence wave prefetch (attention.ragged_prefetch_counts
+        # — the host-side mirror of the kernel's parity chain): first
+        # waves seen / first waves a predecessor prefetched
+        self.ragged_first_waves = 0
+        self.ragged_prefetched_waves = 0
         # speculation stats (nv_llm_spec_* metrics feed)
         self.spec_dispatches = 0       # verify dispatches issued
         self.spec_drafted_tokens = 0   # draft tokens scored
@@ -535,6 +545,7 @@ class EngineCore:
             lambda dev, host, mask: jnp.where(mask, dev, host))
         self._verify_jit = None
         self._ragged_jit = None   # EngineConfig refuses ragged + pp
+        self._ragged_row_sampled = False
         self._prefill_sp_jit = None
         self._sp = 1
 
@@ -624,25 +635,69 @@ class EngineCore:
         # programs use. One compiled shape serves every batch mix, so
         # the per-bucket prefill program family never compiles when
         # ragged serving is on.
+        #
+        # spec_k > 0 compiles the ROW-SAMPLED variant instead (still
+        # exactly ONE program): logits and a sample for EVERY token
+        # row, each row keyed at its slot's key_step + row offset —
+        # the verify program's lockstep-PRNG discipline riding the
+        # ragged batch, so speculative spans verify in the same
+        # dispatch as prefill chunks and plain decode rows. At the
+        # sample row of a non-spec span the key (and hence the token)
+        # is identical to the slot-sampled variant by construction:
+        # row r of a span keys at key_step + r, the last row at
+        # key_step + len - 1 — the lane skew convention.
         self._ragged_jit = None
+        self._ragged_row_sampled = False
         if self.cfg.ragged_dispatch:
             Lmax = self.cfg.ragged_max_seq_rows
+            self._ragged_row_sampled = self.cfg.spec_k > 0
 
-            def ragged(params, kv, tokens, positions, tables, row_slot,
-                       seq_starts, seq_counts, sample_rows, seeds,
-                       steps, temperature, top_k, top_p):
-                params = unpack_params(params)
-                logits, kv = self.model_mod.ragged_forward(
-                    params, kv, tokens, positions, tables, row_slot,
-                    seq_starts, seq_counts, sample_rows, statics,
-                    max_rows=Lmax)
-                keys = make_slot_keys(seed, seeds, steps)
-                toks, logprobs = sample_tokens(logits, keys,
-                                               temperature, top_k,
-                                               top_p)
-                return toks, logprobs, kv
+            if self._ragged_row_sampled:
+                def ragged(params, kv, tokens, positions, tables,
+                           row_slot, seq_starts, seq_counts,
+                           sample_rows, seeds, steps, temperature,
+                           top_k, top_p):
+                    # steps is [capacity] ROW steps here; the other
+                    # sampling params stay per-slot and gather through
+                    # row_slot (the trailing trash slot holds zeros)
+                    params = unpack_params(params)
+                    logits, kv = self.model_mod.ragged_forward(
+                        params, kv, tokens, positions, tables,
+                        row_slot, seq_starts, seq_counts, sample_rows,
+                        statics, max_rows=Lmax, sample_all_rows=True)
+                    keys = make_slot_keys(
+                        seed, jnp.take(seeds, row_slot), steps)
+                    toks, logprobs = sample_tokens(
+                        logits, keys,
+                        jnp.take(temperature, row_slot),
+                        jnp.take(top_k, row_slot),
+                        jnp.take(top_p, row_slot))
+                    return toks, logprobs, kv
+            else:
+                def ragged(params, kv, tokens, positions, tables,
+                           row_slot, seq_starts, seq_counts,
+                           sample_rows, seeds, steps, temperature,
+                           top_k, top_p):
+                    params = unpack_params(params)
+                    logits, kv = self.model_mod.ragged_forward(
+                        params, kv, tokens, positions, tables,
+                        row_slot, seq_starts, seq_counts, sample_rows,
+                        statics, max_rows=Lmax)
+                    keys = make_slot_keys(seed, seeds, steps)
+                    toks, logprobs = sample_tokens(logits, keys,
+                                                   temperature, top_k,
+                                                   top_p)
+                    return toks, logprobs, kv
 
             self._ragged_jit = jax.jit(ragged, donate_argnums=(1,))
+            # pipelined-dispatch chained-sample merge (ragged form):
+            # chained rows take the PREVIOUS dispatch's device token at
+            # their slot's recorded sample row; everything else feeds
+            # host values. jnp.take covers both variants ([S] slot
+            # toks index by slot, [capacity] row toks by sample row).
+            self._ragged_merge_jit = jax.jit(
+                lambda prev, srows, host, mask: jnp.where(
+                    mask, jnp.take(prev, srows), host))
 
         # speculative verify (engine/spec/, docs/speculative.md): score
         # Tv = spec_k+1 positions per slot in ONE dispatch by flattening
@@ -765,6 +820,9 @@ class EngineCore:
         if self._pending is not None:     # drain the pipelined dispatch
             self._harvest(self._pending)
             self._pending = None
+        if self._ragged_pending is not None:  # the ragged form of same
+            prev, self._ragged_pending = self._ragged_pending, None
+            self._harvest_ragged(prev)
         if self.offload_engine is not None:
             await self.offload_engine.stop()
         if self.spill_engine is not None:
@@ -1033,7 +1091,15 @@ class EngineCore:
                 ragged_mixed_ratio=(
                     self.ragged_mixed_dispatches / self.ragged_dispatches
                     if self.ragged_dispatches else 0.0),
-                ragged_dispatches_saved_total=self.ragged_dispatches_saved)
+                ragged_dispatches_saved_total=self.ragged_dispatches_saved,
+                # cross-sequence wave prefetch: first waves a
+                # predecessor's last wave covered (host mirror of the
+                # kernel's parity chain) / draft rows that rode ragged
+                ragged_prefetch_hit_ratio=(
+                    self.ragged_prefetched_waves
+                    / self.ragged_first_waves
+                    if self.ragged_first_waves else 0.0),
+                ragged_spec_rows_total=self.ragged_spec_rows)
         if self.pp > 1:
             from ..parallel.pipeline_parallel import (
                 pp_bubble_fraction, pp_dispatch_utilization)
@@ -1172,7 +1238,8 @@ class EngineCore:
             # 0) opportunistic KV compaction: only when no admission is
             # queued and no dispatch is un-harvested (the pass inserts
             # one small device copy ahead of the next decode dispatch)
-            if self.waiting.empty() and self._pending is None:
+            if (self.waiting.empty() and self._pending is None
+                    and self._ragged_pending is None):
                 self._maybe_defrag()
             # 1) admit waiting work into free slots
             while not self.waiting.empty():
@@ -1198,6 +1265,11 @@ class EngineCore:
                 # buffers don't sit retained across an idle period
                 self._harvest(self._pending)
                 self._pending = None
+                progressed = True
+            elif self._ragged_pending is not None:
+                # same drain for a pipelined ragged dispatch
+                prev, self._ragged_pending = self._ragged_pending, None
+                self._harvest_ragged(prev)
                 progressed = True
             # 3) deferred admissions: their async fetch overlapped step 2
             if self._admissions:
@@ -2480,25 +2552,93 @@ class EngineCore:
         """One unified ragged dispatch (engine/ragged.py): pack every
         ready slot's pending work — mid-prompt lanes contribute up to
         ragged_max_seq_rows prompt rows, decoding slots one chained
-        token row — into a single token-capacity-filled batch, dispatch
-        the ONE compiled ragged program, harvest synchronously.
+        token row or, with spec_k, a [1+k]-row speculative span — into
+        a single token-capacity-filled batch, dispatch the ONE compiled
+        ragged program, harvest.
+
+        With ``decode_dispatch_pipeline`` a pure-decode dispatch defers
+        its harvest one iteration: the next dispatch chains off the
+        in-flight device tokens (the chained-sample merge — each
+        chained row takes the previous dispatch's token at its slot's
+        sample row), so the device→host fetch overlaps the next
+        dispatch's compute exactly like the fused decode pipeline. Any
+        churn — admissions, prefill lanes, spec drafts (which draft
+        from HARVESTED history, the split path's rule), slot turnover,
+        growth failure — drains the pipeline first and costs one
+        un-overlapped dispatch.
 
         Block growth runs BEFORE packing at each slot's maximum
         possible row count this dispatch (the packer only ever shrinks
         a span, and over-grown blocks stay owned by their request —
         the _prepare_multi precedent); a slot that cannot grow preempts
         or finishes exactly as the split path would."""
+        if self._ragged_pending is not None:
+            nxt = self._ragged_dispatch_pipelined()
+            prev, self._ragged_pending = self._ragged_pending, None
+            self._harvest_ragged(prev)
+            if nxt is not None:
+                self._ragged_pending = nxt
+                return
+            if not any(s is not None and s.ready for s in self.slots):
+                return
+            # couldn't chain (churn / drafts due / growth failure):
+            # fall through to a fresh host-fed dispatch against the
+            # harvested state
+        pending = self._ragged_dispatch_fresh()
+        if pending is None:
+            return
+        if (self.cfg.decode_dispatch_pipeline
+                and all(sq.mode == "decode"
+                        for sq in pending["batch"].seqs)):
+            # pure-decode dispatch: defer the harvest so the next
+            # iteration can chain off it (prefill/spec spans harvest
+            # synchronously — their bookkeeping gates the next packing)
+            self._ragged_pending = pending
+        else:
+            self._harvest_ragged(pending)
+
+    def _ragged_draft(self) -> Dict[int, tuple]:
+        """Host-side n-gram drafts for every decoding slot with a live
+        spec budget — the spec spans this dispatch will carry. Drafting
+        reads HARVESTED history only (the _decode_step_spec rule), so
+        the caller must have drained any pipelined dispatch."""
+        drafts: Dict[int, tuple] = {}
+        if self.drafter is None:
+            return drafts
+        for i, s in enumerate(self.slots):
+            if (s is None or not s.ready or s.seq is None
+                    or s.last_token < 0):
+                continue
+            if s.lane_prompt is not None and s.pos < len(s.lane_prompt):
+                continue               # mid-prompt: decode hasn't begun
+            k = self._req_spec_k(s)
+            if k <= 0:
+                continue
+            d = self.drafter.draft(list(s.seq.tokens) + [s.last_token],
+                                   k)
+            if d:
+                drafts[i] = (s, [int(t) for t in d[:k]])
+        return drafts
+
+    def _ragged_dispatch_fresh(self) -> Optional[dict]:
+        """Draft, grow, pack and launch one host-fed ragged dispatch.
+        Returns the pending record (un-harvested), or None when nothing
+        was dispatched."""
         from .ragged import build_ragged_batch
         cfg = self.cfg
         Lmax = cfg.ragged_max_seq_rows
         capacity = self.M * cfg.kv_block_size
+        drafts = self._ragged_draft()
         for i, s in enumerate(self.slots):
             if s is None or not s.ready:
                 continue
             in_prompt = (s.lane_prompt is not None
                          and s.pos < len(s.lane_prompt))
+            ent = drafts.get(i)
+            n_draft = (len(ent[1]) if ent is not None and ent[0] is s
+                       else 0)
             want = (min(len(s.lane_prompt) - s.pos, Lmax) if in_prompt
-                    else 1)
+                    else 1 + n_draft)
             if s.pos + want + 1 > capacity:
                 self._release_slot(s)
                 self._finish_request(s, FinishReason.LENGTH)
@@ -2515,20 +2655,85 @@ class EngineCore:
 
         decode_rows = []
         prefill_lanes = []
+        spec_lanes = []
         for i, s in enumerate(self.slots):
             if s is None or not s.ready:
                 continue
             if s.lane_prompt is not None and s.pos < len(s.lane_prompt):
                 prefill_lanes.append(
                     (i, s.lane_prompt[s.pos:s.pos + Lmax], s.pos))
+                continue
+            ent = drafts.get(i)
+            # growth may have preempted/finished the drafted request —
+            # keep drafts only for slots that still hold it
+            if ent is not None and ent[0] is s:
+                spec_lanes.append((i, [s.last_token] + ent[1], s.pos))
             else:
                 decode_rows.append((i, s.last_token, s.pos))
         batch = build_ragged_batch(cfg.ragged_max_tokens, self.B,
-                                   decode_rows, prefill_lanes, Lmax)
+                                   decode_rows, prefill_lanes, Lmax,
+                                   spec_lanes=spec_lanes)
         if batch is None:
-            return
+            return None
+        return self._ragged_dispatch(batch)
 
-        steps = np.zeros((self.B + 1,), np.int64)
+    def _ragged_dispatch_pipelined(self) -> Optional[dict]:
+        """Steady-state pipelined ragged dispatch: chain off the
+        in-flight dispatch's device tokens. Returns the new pending
+        record, or None when the pipeline must drain first (the
+        _dispatch_pipelined contract: any churn restarts from harvested
+        host state)."""
+        prev = self._ragged_pending
+        now = [s if (s is not None and s.ready) else None
+               for s in self.slots]
+        if any(now[i] is not prev["reqs"][i] for i in range(self.B)):
+            return None
+        live = [i for i in range(self.B) if now[i] is not None]
+        if not live:
+            return None
+        for i in live:
+            s = now[i]
+            if s.lane_prompt is not None and s.pos < len(s.lane_prompt):
+                return None        # admission churn mid-flight
+            if (self.drafter is not None and s.seq is not None
+                    and self._req_spec_k(s) > 0):
+                # speculation drafts from HARVESTED state — drain, the
+                # next fresh dispatch carries the spec span (the split
+                # path forfeits the overlap the same way)
+                return None
+        # capacity/growth one token ahead; never finish/preempt with an
+        # un-harvested token in flight — drain instead
+        capacity = self.M * self.cfg.kv_block_size
+        from .ragged import build_ragged_batch
+        for i in live:
+            s = now[i]
+            if s.pos + 1 + 2 > capacity:
+                return None
+            need = self._blocks_needed(s.pos + 1 + 2)
+            if need > len(s.blocks):
+                new = self.kv_manager.pool.alloc_uninit(
+                    need - len(s.blocks))
+                if new is None:
+                    return None
+                s.blocks.extend(new)
+                self._block_tables[i, :len(s.blocks)] = s.blocks
+        batch = build_ragged_batch(
+            self.cfg.ragged_max_tokens, self.B,
+            [(i, now[i].last_token, now[i].pos + 1) for i in live],
+            [], self.cfg.ragged_max_seq_rows)
+        if batch is None:
+            return None
+        return self._ragged_dispatch(batch, chain=prev, ahead=1)
+
+    def _ragged_dispatch(self, batch, chain: Optional[dict] = None,
+                         ahead: int = 0) -> dict:
+        """Launch one ragged dispatch over ``batch``. ``chain`` is the
+        in-flight pending record whose device tokens feed this
+        dispatch's decode rows (the chained-sample merge); ``ahead``
+        is how many un-harvested tokens each chained slot runs ahead
+        of host state (positions/key_steps were already advanced by
+        the caller's packing). Returns the pending record."""
+        cfg = self.cfg
         seeds = np.zeros((self.B + 1,), np.int64)
         temp = np.zeros((self.B + 1,), np.float32)
         top_k = np.zeros((self.B + 1,), np.int32)
@@ -2537,14 +2742,38 @@ class EngineCore:
         temp[:self.B] = self._samp["temperature"]
         top_k[:self.B] = self._samp["top_k"]
         top_p[:self.B] = self._samp["top_p"]
-        for sq in batch.seqs:
-            s = self.slots[sq.slot]
-            # the LAST row of a span samples at the key_step the split
-            # path would use there: lane's skew convention makes that
-            # key_step + len - 1 (== key_step for decode rows)
-            steps[sq.slot] = s.key_step + sq.length - 1
+        if self._ragged_row_sampled:
+            # ROW steps: row r of a span keys at key_step + r — the
+            # verify program's lockstep discipline; at a span's last
+            # row this is the slot-sampled key by the skew convention
+            steps = np.zeros((cfg.ragged_max_tokens,), np.int64)
+            for sq in batch.seqs:
+                s = self.slots[sq.slot]
+                steps[sq.start:sq.start + sq.length] = (
+                    s.key_step + ahead + np.arange(sq.length))
+        else:
+            steps = np.zeros((self.B + 1,), np.int64)
+            for sq in batch.seqs:
+                s = self.slots[sq.slot]
+                # the LAST row of a span samples at the key_step the
+                # split path would use there: lane's skew convention
+                # makes that key_step + len - 1 (== key_step for
+                # decode rows)
+                steps[sq.slot] = s.key_step + ahead + sq.length - 1
         tables = np.zeros((self.B + 1, self.M), np.int32)
         tables[:self.B] = self._tables_for_dispatch()
+        mask = srows = None
+        if chain is not None:
+            # chained-sample merge: each chained row takes the previous
+            # dispatch's device token at its slot's sample row
+            prev_batch = chain["batch"]
+            mask = np.zeros((cfg.ragged_max_tokens,), bool)
+            srows = np.zeros((cfg.ragged_max_tokens,), np.int32)
+            for sq in batch.seqs:
+                mask[sq.start] = True
+                srows[sq.start] = (
+                    int(prev_batch.sample_rows[sq.slot])
+                    if self._ragged_row_sampled else sq.slot)
         self._step += 1
         did = None
         if self.recorder is not None:
@@ -2560,29 +2789,57 @@ class EngineCore:
                 steps=steps.copy(), temperature=temp.copy(),
                 top_k=top_k.copy(), top_p=top_p.copy(),
                 seqs=batch.seqs_meta(),
+                chained_from=(chain["id"] if chain is not None
+                              else None),
+                mask=(mask.copy() if mask is not None else None),
+                srows=(srows.copy() if srows is not None else None),
                 reqs=[s.rid if (s is not None and s.ready) else None
                       for s in self.slots])
+        # jnp.array COPIES the host mirrors (the _dispatch_multi
+        # aliasing note): a deferred-harvest dispatch may still be
+        # executing while the next iteration mutates them
+        host_tokens = jnp.array(batch.tokens)
+        if chain is not None:
+            tokens_in = self._ragged_merge_jit(
+                chain["toks"], jnp.array(srows), host_tokens,
+                jnp.array(mask))
+        else:
+            tokens_in = host_tokens
         toks, logprobs, self.kv = self._ragged_jit(
             self.params, self.kv,
-            jnp.asarray(batch.tokens), jnp.asarray(batch.positions),
-            jnp.asarray(tables), jnp.asarray(batch.row_slot),
-            jnp.asarray(batch.seq_starts),
-            jnp.asarray(batch.seq_counts),
-            jnp.asarray(batch.sample_rows),
-            jnp.asarray(seeds), jnp.asarray(steps),
-            jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p))
+            tokens_in, jnp.array(batch.positions),
+            jnp.array(tables), jnp.array(batch.row_slot),
+            jnp.array(batch.seq_starts),
+            jnp.array(batch.seq_counts),
+            jnp.array(batch.sample_rows),
+            jnp.array(seeds), jnp.array(steps),
+            jnp.array(temp), jnp.array(top_k), jnp.array(top_p))
         self.ragged_dispatches += 1
         self.ragged_rows_total += batch.rows_used
         self.ragged_prefill_rows_total += batch.prefill_rows
-        self.ragged_decode_rows_total += batch.rows_used - batch.prefill_rows
+        self.ragged_decode_rows_total += (batch.rows_used
+                                          - batch.prefill_rows)
         if batch.mixed:
             self.ragged_mixed_dispatches += 1
         self.ragged_dispatches_saved += batch.dispatches_replaced - 1
-        self._harvest_ragged({
-            "batch": batch, "toks": toks, "logprobs": logprobs,
-            "id": did,
-            "reqs": [s if (s is not None and s.ready) else None
-                     for s in self.slots]})
+        if batch.n_spec:
+            self.spec_dispatches += 1
+            self.spec_drafted_tokens += batch.spec_rows
+            self.ragged_spec_rows += batch.spec_rows
+        # cross-sequence wave prefetch accounting: the host-side mirror
+        # of the kernel's parity chain over THIS dispatch's geometry
+        # (attention.ragged_prefetch_counts — honest on CPU, where the
+        # XLA fallback runs no kernel; the global-layer walk)
+        from .attention import ragged_prefetch_counts
+        pf = ragged_prefetch_counts(
+            batch.seq_counts, batch.positions[batch.sample_rows] + 1,
+            block_size=cfg.kv_block_size, blocks_per_table=self.M)
+        self.ragged_first_waves += pf["first_waves"]
+        self.ragged_prefetched_waves += pf["prefetched"]
+        return {"batch": batch, "toks": toks, "logprobs": logprobs,
+                "id": did, "prefetch": pf, "chained": chain is not None,
+                "reqs": [s if (s is not None and s.ready) else None
+                         for s in self.slots]}
 
     def _harvest_ragged(self, pending: dict) -> None:
         """Apply one ragged dispatch: per span, the consumed prompt
@@ -2590,13 +2847,24 @@ class EngineCore:
         exactly the lane harvest's per-token walk) and, when the span
         ends in a sample (decode row, or the row consuming the LAST
         prompt token), the emission + finish checks of one decode
-        step."""
+        step. Speculative spans walk their rows with LOCKSTEP
+        acceptance (the _harvest_verify discipline verbatim: rejected
+        draft rows roll back by rewind — pos never advances over them,
+        and later dispatches rewrite every stale row before any query
+        attends it).
+
+        ``applied`` entries are (slot, rid, rows_applied, emitted) —
+        emitted is a COUNT (spec spans emit one token per applied
+        row)."""
         self.host_roundtrips += 1
         _t0 = time.monotonic()
-        toks = np.asarray(pending["toks"])           # [B+1] — ONE fetch
+        # [B+1] slot samples, or [capacity] row samples in the
+        # spec-enabled row-sampled variant — ONE fetch either way
+        toks = np.asarray(pending["toks"])
         logprobs = np.asarray(pending["logprobs"])
         self.host_stall_s += time.monotonic() - _t0
         batch = pending["batch"]
+        row_sampled = self._ragged_row_sampled
         applied = []
         for sq in batch.seqs:
             i = sq.slot
@@ -2606,6 +2874,38 @@ class EngineCore:
             if req.cancelled:
                 self._release_slot(req)
                 self._finish_request(req, FinishReason.CANCELLED)
+                continue
+            if sq.mode == "spec":
+                # lockstep-acceptance walk over the span's rows: row t
+                # wrote inputs[t]'s KV — one decode step's bookkeeping;
+                # reaching row t>0 accepted draft t
+                inputs = batch.tokens[sq.start:sq.start + sq.length]
+                n_applied = 0
+                for t in range(sq.length):
+                    tok = int(toks[sq.start + t])
+                    req.seq.append(int(inputs[t]))
+                    req.registered_blocks = \
+                        self.kv_manager.register_full_blocks(
+                            req.blocks, req.seq, req.registered_blocks)
+                    req.pos += 1
+                    req.key_step += 1
+                    req.generated += 1
+                    req.last_token = tok
+                    n_applied += 1
+                    self.total_decode_tokens += 1
+                    self.spec_emitted_tokens += 1
+                    if t > 0:
+                        self.spec_accepted_tokens += 1
+                    if req.first_token_time is None:
+                        req.first_token_time = time.monotonic()
+                    self._emit(req, tok, float(logprobs[sq.start + t]))
+                    self._maybe_finish_after_emit(req)
+                    if self.slots[i] is not req:
+                        break      # finished: drop the overrun rows
+                    if (t + 1 < sq.length
+                            and tok != int(inputs[t + 1])):
+                        break      # draft rejected: rewind-rollback
+                applied.append((i, req.rid, n_applied, n_applied))
                 continue
             if sq.mode == "prefill":
                 for t in range(sq.length):
@@ -2628,12 +2928,13 @@ class EngineCore:
                 req.pos += 1
                 req.key_step += 1
                 self.total_decode_tokens += 1
-            tok = int(toks[i])
+            sample = (sq.start + sq.length - 1) if row_sampled else i
+            tok = int(toks[sample])
             req.generated += 1
             req.last_token = tok
             if req.first_token_time is None:
                 req.first_token_time = time.monotonic()
-            self._emit(req, tok, float(logprobs[i]))
+            self._emit(req, tok, float(logprobs[sample]))
             self._maybe_finish_after_emit(req)
             applied.append((i, req.rid, sq.length, 1))
         if self.recorder is not None and pending.get("id") is not None:
@@ -2643,8 +2944,9 @@ class EngineCore:
         _stall = self.host_stall_s - self._flight_prev_stall_s
         self._flight_prev_stall_s = self.host_stall_s
         # per-dispatch mode mix rides the flight recorder ring — the
-        # /debug + llmctl trace dump view of how full and how mixed
-        # each ragged dispatch ran
+        # /debug + llmctl trace dump view of how full, how mixed, how
+        # speculative, and how well-prefetched each ragged dispatch ran
+        pf = pending.get("prefetch") or {}
         self.flight.record(
             "ragged", rows=batch.rows_used,
             capacity=batch.capacity,
@@ -2652,6 +2954,10 @@ class EngineCore:
             prefill_rows=batch.prefill_rows,
             decode_rows=batch.rows_used - batch.prefill_rows,
             n_prefill=batch.n_prefill, n_decode=batch.n_decode,
+            n_spec=batch.n_spec, spec_rows=batch.spec_rows,
+            prefetch_first_waves=pf.get("first_waves", 0),
+            prefetch_hits=pf.get("prefetched", 0),
+            chained=bool(pending.get("chained")),
             mixed=batch.mixed,
             emitted=sum(e for _i, _r, _n, e in applied),
             device_ms=round(1e3 * _stall, 3),

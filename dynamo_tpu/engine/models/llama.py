@@ -797,7 +797,8 @@ def ragged_forward(params: Params, kv: KVCache, tokens: jax.Array,
                    positions: jax.Array, block_tables: jax.Array,
                    row_slot: jax.Array, seq_starts: jax.Array,
                    seq_counts: jax.Array, sample_rows: jax.Array,
-                   statics: ModelStatics, max_rows: int = 8
+                   statics: ModelStatics, max_rows: int = 8,
+                   sample_all_rows: bool = False
                    ) -> Tuple[jax.Array, KVCache]:
     """Unified ragged mixed prefill+decode step (one dispatch serves
     prefill chunks AND decode rows; docs/ragged_attention.md).
@@ -820,7 +821,14 @@ def ragged_forward(params: Params, kv: KVCache, tokens: jax.Array,
     op; the spec-verify program's flattening precedent). On TPU the
     sequence-grouped ragged kernel instead streams each sequence's KV
     waves ONCE for all its rows (attention.ragged_paged_attention_
-    pallas) — same contract, kernel-grade DMA economics."""
+    pallas) — same contract, kernel-grade DMA economics.
+
+    ``sample_all_rows`` (static; the ragged×spec variant): return
+    logits for EVERY token row ([TT, V]) instead of gathering
+    sample_rows — speculative spans need a sample at each draft row
+    for lockstep acceptance (the verify program's per-row sampling,
+    now riding the ragged batch). sample_rows is ignored in this
+    mode."""
     cfg = statics.cfg
     TT = tokens.shape[0]
     bsz = statics.block_size
@@ -875,6 +883,8 @@ def ragged_forward(params: Params, kv: KVCache, tokens: jax.Array,
 
     x = _embed(params, tokens, cfg)  # [TT, D]
     x, kv_new = _run_layers(params, kv, x, positions, slots, cfg, attn)
+    if sample_all_rows:
+        return _logits(params, x, cfg), kv_new             # [TT, V]
     sel = jnp.take(x, sample_rows, axis=0)                     # [S, D]
     return _logits(params, sel, cfg), kv_new
 

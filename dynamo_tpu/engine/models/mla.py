@@ -597,7 +597,8 @@ def ragged_forward(params: Params, kv: KVCache, tokens: jax.Array,
                    positions: jax.Array, block_tables: jax.Array,
                    row_slot: jax.Array, seq_starts: jax.Array,
                    seq_counts: jax.Array, sample_rows: jax.Array,
-                   statics: ModelStatics, max_rows: int = 8
+                   statics: ModelStatics, max_rows: int = 8,
+                   sample_all_rows: bool = False
                    ) -> Tuple[jax.Array, KVCache]:
     """MLA form of llama.ragged_forward (same metadata contract): one
     ragged [TT] token batch serves prefill chunks and decode steps in
@@ -694,6 +695,10 @@ def ragged_forward(params: Params, kv: KVCache, tokens: jax.Array,
 
     x = _embed(params, tokens, cfg)
     x, kv_new = _run_layers(params, kv, x, positions, slots, cfg, attn)
+    if sample_all_rows:
+        # ragged×spec variant (llama.ragged_forward): per-row logits
+        # for lockstep acceptance over speculative spans
+        return _logits(params, x, cfg), kv_new             # [TT, V]
     sel = jnp.take(x, sample_rows, axis=0)                     # [S, D]
     return _logits(params, sel, cfg), kv_new
 

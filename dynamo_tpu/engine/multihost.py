@@ -416,8 +416,22 @@ def run_follower(core, sock: socket.socket,
             # unified ragged dispatch (engine/ragged.py) is a device
             # program with the same host contract as dispatch/verify —
             # run the identical packing; span bookkeeping (lane
-            # consumption, boundary samples) is leader-side
-            _toks, core.kv = exec_ragged_event(core, core.kv, ev)
+            # consumption, boundary samples, spec acceptance) is
+            # leader-side. Pipelined ragged events chain off the
+            # previous ragged dispatch's device tokens, so the follower
+            # keeps them in the same bounded chain window.
+            chain = (disp_toks.get(ev["chained_from"])
+                     if ev.get("chained_from") is not None else None)
+            if ev.get("chained_from") is not None and chain is None:
+                raise NotImplementedError(
+                    f"ragged dispatch {ev['id']} chains from "
+                    f"{ev['chained_from']} which left the follower's "
+                    f"chain window — raise max_chain_keep")
+            toks_r, core.kv = exec_ragged_event(core, core.kv, ev,
+                                                chain)
+            disp_toks[ev["id"]] = toks_r
+            while len(disp_toks) > max_chain_keep:
+                disp_toks.popitem(last=False)
             stats["ragged"] = stats.get("ragged", 0) + 1
     logger.info("follower done: %s", stats)
     return stats

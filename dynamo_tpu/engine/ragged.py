@@ -11,23 +11,31 @@ every participating slot contributes a contiguous row span described by
 - ``mode == "prefill"``: up to ``max_seq_rows`` consecutive prompt
   tokens (a prefill chunk riding the same dispatch; the row consuming
   the LAST prompt token is the one whose sample becomes the first
-  generation).
+  generation);
+- ``mode == "spec"``: a speculative verify span — the slot's chained
+  last token plus up to k draft tokens, [1+k] rows at consecutive
+  positions (the SpecInfer-style batched verify's [B, k+1] flattening
+  IS a ragged span). Draft rows are just more span rows to the kernel;
+  the harvest walks them with lockstep acceptance.
 
 The kernel math never reads ``mode`` — a decode step IS a length-1
 chunk — but the scheduler, recorder, metrics, and flight recorder do:
 mode is what makes "dispatches saved" and the mixed-batch ratio
 well-defined.
 
-Packing policy (deterministic, capacity-greedy): decode rows first (one
-per decoding slot — a ragged dispatch never starves token emission),
-then one MINIMUM row per pending prefill lane (progress guarantee:
-every admitted prompt advances every dispatch), then the remaining
-capacity round-robins across the prefill lanes one row at a time (fair
-sharing — a long prompt cannot lock out a short one) up to each lane's
-``max_seq_rows``/remaining-prompt bound. Rows are laid out in slot
-order with ascending starts — the ragged kernel's overhang-rewrite
-contract (attention.py) requires it, and determinism of the packing is
-what makes recorded ragged schedules replayable.
+Packing policy (deterministic, capacity-greedy): decode/spec row-0
+rows first (one per decoding slot — a ragged dispatch never starves
+token emission), then one MINIMUM row per pending prefill lane
+(progress guarantee: every admitted prompt advances every dispatch),
+then spec spans take their draft rows in slot order (ATOMIC within the
+dispatch: a span is never split across dispatches — surplus drafts
+that don't fit are simply dropped, they are speculation, not prompt),
+then the remaining capacity round-robins across the prefill lanes one
+row at a time (fair sharing — a long prompt cannot lock out a short
+one) up to each lane's ``max_seq_rows``/remaining-prompt bound. Rows
+are laid out in slot order with ascending starts — the ragged kernel's
+overhang-rewrite contract (attention.py) requires it, and determinism
+of the packing is what makes recorded ragged schedules replayable.
 
 The builder is pure host-side numpy: it never touches the engine, so
 the policy is unit-testable and the packing a recorded "ragged" event
@@ -53,7 +61,7 @@ class RaggedSeq:
     slot: int
     start: int
     length: int
-    mode: str          # "prefill" | "decode"
+    mode: str          # "prefill" | "decode" | "spec"
     pos0: int
 
 
@@ -93,22 +101,35 @@ class RaggedBatch:
         return sum(1 for s in self.seqs if s.mode == "decode")
 
     @property
+    def n_spec(self) -> int:
+        return sum(1 for s in self.seqs if s.mode == "spec")
+
+    @property
     def prefill_rows(self) -> int:
         return int(sum(s.length for s in self.seqs
                        if s.mode == "prefill"))
 
     @property
+    def spec_rows(self) -> int:
+        """Draft rows riding this dispatch (rows BEYOND each spec
+        span's mandatory row 0 — the ragged_spec_rows metric feed)."""
+        return int(sum(s.length - 1 for s in self.seqs
+                       if s.mode == "spec"))
+
+    @property
     def mixed(self) -> bool:
         """True when prefill chunks and decode steps share the
         dispatch — the batch-boundary bubble the split path pays."""
-        return self.n_prefill > 0 and self.n_decode > 0
+        return self.n_prefill > 0 and (self.n_decode + self.n_spec) > 0
 
     @property
     def dispatches_replaced(self) -> int:
         """How many split-path dispatches this one batch stands in
         for: each prefill chunk would be its own prefill-program
-        dispatch and the decode rows together one decode dispatch."""
-        return self.n_prefill + (1 if self.n_decode else 0)
+        dispatch and the decode/verify rows together one decode (or
+        verify) dispatch."""
+        return self.n_prefill + (1 if self.n_decode + self.n_spec
+                                 else 0)
 
     def seqs_meta(self) -> List[Tuple[int, int, int, str]]:
         """(slot, start, len, mode) rows for the recorder / flight
@@ -120,18 +141,26 @@ def build_ragged_batch(
         capacity: int, n_slots: int,
         decode_rows: Sequence[Tuple[int, int, int]],
         prefill_lanes: Sequence[Tuple[int, Sequence[int], int]],
-        max_seq_rows: int) -> Optional[RaggedBatch]:
+        max_seq_rows: int,
+        spec_lanes: Sequence[Tuple[int, Sequence[int], int]] = ()
+        ) -> Optional[RaggedBatch]:
     """Pack pending work into one token-capacity-filled ragged batch.
 
     ``decode_rows``: (slot, input_token, position) per decoding slot.
     ``prefill_lanes``: (slot, remaining_prompt_tokens, position) per
     slot still consuming its prompt (position = absolute position of
     remaining_prompt_tokens[0]).
+    ``spec_lanes``: (slot, [last_token, draft_1..draft_k], position)
+    per decoding slot with a live draft chain — row 0 is the slot's
+    mandatory decode row, draft rows ride as surplus (module
+    docstring: atomic within the dispatch, truncated — never split —
+    under capacity pressure; a span truncated to 1 row degrades to a
+    plain decode row).
 
     Returns None when there is nothing to dispatch. Raises when the
     decode rows alone exceed capacity (an EngineConfig validation
     failure — ragged_max_tokens must cover max_num_seqs)."""
-    n_decode = len(decode_rows)
+    n_decode = len(decode_rows) + len(spec_lanes)
     if n_decode + len(prefill_lanes) == 0:
         return None
     if n_decode + len(prefill_lanes) > capacity:
@@ -140,13 +169,23 @@ def build_ragged_batch(
             f"each of {n_decode} decode + {len(prefill_lanes)} prefill "
             f"slots — raise ragged_max_tokens")
     budget = capacity - n_decode
-    # minimum one row per lane, then round-robin the surplus one row at
-    # a time (fairness across prompt lengths)
+    # minimum one row per prefill lane first (progress guarantee) ...
     lane_rows = []
     for slot, toks, _pos in prefill_lanes:
         cap = min(len(toks), max_seq_rows)
         lane_rows.append(max(min(1, cap), 0))
         budget -= lane_rows[-1]
+    # ... then spec draft rows in slot order (accepted drafts multiply
+    # tokens/dispatch — a better use of a marginal row than one more
+    # prompt row, which only moves admission latency) ...
+    spec_rows = []
+    for slot, toks, _pos in sorted(spec_lanes):
+        want = min(len(toks), max_seq_rows) - 1
+        take = max(min(want, budget), 0)
+        spec_rows.append(1 + take)
+        budget -= take
+    # ... then round-robin the surplus one prompt row at a time
+    # (fairness across prompt lengths)
     grew = True
     while budget > 0 and grew:
         grew = False
@@ -169,6 +208,11 @@ def build_ragged_batch(
     per_slot: dict = {}
     for slot, tok, pos in decode_rows:
         per_slot[slot] = ("decode", [int(tok)], int(pos))
+    for si, (slot, toks, pos) in enumerate(sorted(spec_lanes)):
+        mode = "spec" if spec_rows[si] > 1 else "decode"
+        per_slot[slot] = (mode,
+                          [int(t) for t in toks[:spec_rows[si]]],
+                          int(pos))
     for li, (slot, toks, pos) in enumerate(prefill_lanes):
         per_slot[slot] = ("prefill",
                           [int(t) for t in toks[:lane_rows[li]]],

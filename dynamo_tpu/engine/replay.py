@@ -224,11 +224,12 @@ def exec_verify_event(core, kv, ev: dict):
     return toks, kv
 
 
-def exec_ragged_event(core, kv, ev: dict):
+def exec_ragged_event(core, kv, ev: dict, chain=None):
     """Issue the recorded unified ragged dispatch (engine/ragged.py)
     against ``kv``. Single home of the event → _ragged_jit marshalling
-    (offline replayer + live multihost follower). Returns
-    (toks [S], kv)."""
+    (offline replayer + live multihost follower). ``chain`` is the
+    chained-from dispatch's device tokens for a pipelined ragged event
+    (None when host-fed). Returns (toks [S or capacity], kv)."""
     import jax.numpy as jnp
 
     if core._ragged_jit is None:
@@ -242,8 +243,27 @@ def exec_ragged_event(core, kv, ev: dict):
             f"core compiled ragged_max_tokens="
             f"{core.cfg.ragged_max_tokens} — replay with the recorded "
             f"engine config")
+    # the steps array's shape IS the sampling-variant marker: [B+1]
+    # slot steps (spec_k == 0) vs [capacity] row steps (the spec-
+    # enabled row-sampled program) — a mismatch means the replaying
+    # core compiled the other variant
+    row_sampled = (np.asarray(ev["steps"]).shape[0]
+                   == np.asarray(ev["tokens"]).shape[0])
+    if row_sampled != core._ragged_row_sampled:
+        raise NotImplementedError(
+            f"recorded ragged dispatch was "
+            f"{'row' if row_sampled else 'slot'}-sampled but this core "
+            f"compiled spec_k={core.cfg.spec_k} — replay with the "
+            f"recorded engine config")
+    host_tokens = jnp.array(np.asarray(ev["tokens"]))
+    if ev.get("chained_from") is not None:
+        tokens_in = core._ragged_merge_jit(
+            chain, jnp.array(np.asarray(ev["srows"])), host_tokens,
+            jnp.array(np.asarray(ev["mask"])))
+    else:
+        tokens_in = host_tokens
     toks, _lps, kv = core._ragged_jit(
-        core.params, kv, jnp.array(np.asarray(ev["tokens"])),
+        core.params, kv, tokens_in,
         jnp.array(np.asarray(ev["positions"])),
         jnp.array(np.asarray(ev["tables"])),
         jnp.array(np.asarray(ev["row_slot"])),
@@ -485,9 +505,14 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
         elif kind == "ragged":
             # unified ragged dispatch (engine/ragged.py): every span's
             # rows wrote their positions' pool slots through the span's
-            # slot table — prefill chunks and decode rows alike
-            toks_r, kv = exec_ragged_event(core, kv, ev)
+            # slot table — prefill chunks, decode rows, and spec spans
+            # alike; pipelined events chain off the previous ragged
+            # dispatch's device tokens
+            chain = (disp_toks[ev["chained_from"]]
+                     if ev.get("chained_from") is not None else None)
+            toks_r, kv = exec_ragged_event(core, kv, ev, chain)
             toks_r = jax.block_until_ready(toks_r)
+            disp_toks[ev["id"]] = toks_r
             out["ragged"][ev["id"]] = np.asarray(toks_r).copy()
             tables = np.asarray(ev["tables"])
             positions = np.asarray(ev["positions"])
@@ -711,6 +736,8 @@ def check_inputs(events: List[dict]) -> List[str]:
     problems = []
     state: Dict[str, dict] = {}       # rid -> {pos, key_step, last_tok}
     disp: Dict[int, dict] = {}
+    rag_disp: Dict[int, dict] = {}    # ragged events by id (harvest
+    #                                   needs starts for row-sampled toks)
     for ev in events:
         if ev["ev"] == "admit":
             state[ev["rid"]] = {
@@ -777,36 +804,65 @@ def check_inputs(events: List[dict]) -> List[str]:
                         f"token {int(tokens[i, 0])} != last harvested "
                         f"{st['last']}")
         elif ev["ev"] == "ragged":
+            rag_disp[ev["id"]] = ev
             positions = np.asarray(ev["positions"])
             starts = np.asarray(ev["starts"])
             counts = np.asarray(ev["counts"])
             steps = np.asarray(ev["steps"])
+            # [capacity] row steps = the spec-enabled row-sampled
+            # variant; [B+1] slot steps = the slot-sampled one
+            row_sampled = steps.shape[0] == positions.shape[0]
+            mask = (np.asarray(ev["mask"])
+                    if ev.get("chained_from") is not None else None)
             for i, rid in enumerate(ev["reqs"]):
                 if rid is None or rid not in state \
                         or int(counts[i]) == 0:
                     continue
                 st = state[rid]
+                # pipelined ragged: chained spans run one un-harvested
+                # token ahead of host state (the dispatch-event mask
+                # convention; chained spans are single decode rows)
+                ahead = int(mask is not None
+                            and mask[int(starts[i])])
                 p0 = int(positions[int(starts[i])])
-                if p0 != st["pos"]:
+                if p0 != st["pos"] + ahead:
                     problems.append(
                         f"ragged {ev['id']} slot {i} ({rid}): first-row "
-                        f"position {p0} != state {st['pos']}")
-                # the span's LAST row samples at key_step + len - 1
-                # (the lane skew convention)
-                if int(steps[i]) != st["key_step"] + int(counts[i]) - 1:
+                        f"position {p0} != state {st['pos']}+{ahead}")
+                if row_sampled:
+                    # row r keys at key_step + r — check the first row
+                    if int(steps[int(starts[i])]) \
+                            != st["key_step"] + ahead:
+                        problems.append(
+                            f"ragged {ev['id']} slot {i} ({rid}): "
+                            f"first-row key step "
+                            f"{int(steps[int(starts[i])])} != state "
+                            f"{st['key_step']}+{ahead}")
+                elif int(steps[i]) != (st["key_step"] + ahead
+                                       + int(counts[i]) - 1):
+                    # the span's LAST row samples at key_step + len - 1
+                    # (the lane skew convention)
                     problems.append(
                         f"ragged {ev['id']} slot {i} ({rid}): sample "
                         f"key step {int(steps[i])} != state "
-                        f"{st['key_step']}+{int(counts[i]) - 1}")
+                        f"{st['key_step']}+{ahead}+{int(counts[i]) - 1}")
         elif ev["ev"] == "ragged_harvest":
             toks = np.asarray(ev["toks"])
+            src = rag_disp.get(ev["id"])
             for slot, rid, n, emitted in ev["applied"]:
                 if rid in state:
                     st = state[rid]
                     st["pos"] += n
                     st["key_step"] += n
-                    if emitted:
-                        st["last"] = int(toks[slot])
+                    if emitted and n > 0:
+                        if (src is not None and toks.shape[0]
+                                == np.asarray(src["positions"]).shape[0]):
+                            # row-sampled: the last APPLIED row's token
+                            # (spec spans may rewind before the span end)
+                            start = int(np.asarray(src["starts"])[slot])
+                            st["last"] = int(toks[start + n - 1])
+                        else:
+                            st["last"] = int(toks[slot])
         elif ev["ev"] == "harvest":
             toks = np.asarray(ev["toks"])
             for slot, rid, n in ev["applied"]:

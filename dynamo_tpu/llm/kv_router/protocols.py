@@ -115,6 +115,15 @@ class ForwardPassMetrics:
     trace_dropped_log_lines_total: int = 0
     loop_lag_ms: float = 0.0
     loop_lag_max_ms: float = 0.0
+    # ragged takeover round 11 (appended — DL004 append-only evolution):
+    # the cross-sequence wave-prefetch hit ratio (first waves whose DMA
+    # a predecessor's last wave already started — the host mirror of
+    # the kernel's parity chain, attention.ragged_prefetch_counts) and
+    # the cumulative draft rows that rode ragged dispatches as spec
+    # spans (ragged × speculative decoding). Zeros on old payloads /
+    # non-ragged engines.
+    ragged_prefetch_hit_ratio: float = 0.0
+    ragged_spec_rows_total: int = 0
 
     def to_dict(self) -> dict:
         # every field is a scalar; dataclasses.asdict would deep-copy

@@ -233,6 +233,43 @@ def test_bench_ragged_mode():
     assert rg["tokens_exact_to_boundary"] is True
 
 
+@pytest.mark.ragged
+def test_bench_ragged_spec_leg():
+    """--ragged --spec combination leg (round 11): the result's ragged
+    dict must carry the `spec` sub-dict — the split spec path (prefill
+    + decode + verify programs) vs spec spans riding the ONE ragged
+    program. Acceptance gates: compiled programs stay 1, dispatches per
+    emitted token strictly below the split spec path under mixed
+    traffic, drafts actually accepted, and a positive wave-prefetch
+    hit ratio."""
+    if os.environ.get("CI_SKIP_SLOW"):
+        pytest.skip("slow smoke")
+    r = _run(
+        [sys.executable, "bench.py", "--ragged", "--spec=3"],
+        {"BENCH_FORCE_CPU": "1", "BENCH_MODEL": "tiny", "BENCH_BATCH": "2",
+         "BENCH_STEPS": "4", "BENCH_PROMPT": "8", "BENCH_HARVEST": "2",
+         "BENCH_QUANT": "none", "BENCH_DEVICE": "0",
+         "BENCH_RAGGED_BATCH": "4", "BENCH_RAGGED_PROMPT": "48",
+         "BENCH_RAGGED_SEQ_ROWS": "16"})
+    assert r.returncode == 0, f"bench.py crashed:\n{r.stderr[-4000:]}"
+    out = json.loads([l for l in r.stdout.strip().splitlines()
+                      if l.startswith("{")][-1])
+    assert "error" not in out, f"bench fell back instead of running: {out}"
+    sp = out.get("ragged", {}).get("spec")
+    assert sp, f"no ragged spec leg in the result: {out.get('ragged')}"
+    assert sp["ragged_compiled_programs"] == 1, (
+        "ragged×spec must stay at ONE compiled program — the verify "
+        "program's flattening IS a ragged batch")
+    assert sp["ragged_spec_dispatches_per_token"] \
+        < sp["split_spec_dispatches_per_token"], sp
+    assert sp["ragged_spec_accepted"] > 0, (
+        "repetitive workload accepted zero drafts through ragged spans")
+    assert sp["ragged_spec_rows"] > 0
+    assert sp["prefetch_hit_ratio"] > 0.0, (
+        "concurrent spans never chained a wave prefetch")
+    assert sp["tokens_exact_to_boundary"] is True
+
+
 def test_bench_mla_geometry_runs():
     """The MLA bench path (latent {"kv"} pool, absorbed-decode flop
     accounting): bench.py must run the deepseek-class geometry — the

@@ -95,7 +95,9 @@ def _row_expand(tables, starts, counts, ctx):
 def test_ragged_kernel_vs_xla_geometry_sweep(H, KVH, Dh):
     """Ragged kernel (interpret) vs the XLA reference over the corner
     mix — GQA slotting and MQA — at the established kernel tolerance,
-    plus coalesced-vs-per-block BIT-identity on a contiguous layout."""
+    plus coalesced-vs-per-block AND prefetch-on-vs-off BIT-identity
+    (the cross-sequence wave-prefetch chain must never change a bit —
+    the mix includes a zero-length span, which breaks the chain)."""
     rng = np.random.default_rng(0)
     C = KVH * Dh
     k, v = _pool(rng, C)
@@ -115,6 +117,13 @@ def test_ragged_kernel_vs_xla_geometry_sweep(H, KVH, Dh):
         np.testing.assert_allclose(np.asarray(got)[rows],
                                    np.asarray(want), rtol=2e-5,
                                    atol=2e-5)
+        nopf = ragged_paged_attention_pallas(
+            q, k, v, jnp.asarray(tables), starts, counts, ctx,
+            block_size=BS, scale=0.11, max_rows=16, chunk_blocks=2,
+            prefetch=False, interpret=True)
+        assert np.array_equal(np.asarray(got)[rows],
+                              np.asarray(nopf)[rows]), (
+            "cross-sequence prefetch changed the output")
         if contig:
             off = ragged_paged_attention_pallas(
                 q, k, v, jnp.asarray(tables), starts, counts, ctx,
@@ -160,6 +169,13 @@ def test_ragged_kernel_int8_rows():
                                scale=0.09)
     np.testing.assert_allclose(np.asarray(got)[rows], np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+    nopf = ragged_paged_attention_pallas(
+        q, k8, v8, jnp.asarray(tables), jnp.asarray(starts),
+        jnp.asarray(counts), jnp.asarray(ctx), block_size=bs32,
+        scale=0.09, max_rows=max(bs32, 16), chunk_blocks=2,
+        prefetch=False, interpret=True)
+    assert np.array_equal(np.asarray(got)[rows], np.asarray(nopf)[rows]), \
+        "cross-sequence prefetch changed int8 output"
 
 
 def test_ragged_kernel_v_aliases_k():
@@ -181,6 +197,12 @@ def test_ragged_kernel_v_aliases_k():
                                scale=0.07)[..., :vl]
     np.testing.assert_allclose(np.asarray(got)[rows], np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+    nopf = ragged_paged_attention_pallas(
+        q, k, k, jnp.asarray(tables), starts, counts, ctx,
+        block_size=BS, scale=0.07, max_rows=16, chunk_blocks=2,
+        v_lanes=vl, prefetch=False, interpret=True)
+    assert np.array_equal(np.asarray(got)[rows], np.asarray(nopf)[rows]), \
+        "cross-sequence prefetch changed v-aliases-k output"
 
 
 def test_ragged_kernel_sliding_window():
@@ -208,6 +230,40 @@ def test_ragged_kernel_sliding_window():
                                scale=0.1, win_lo=jnp.asarray(win_lo))
     np.testing.assert_allclose(np.asarray(got)[rows], np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+    nopf = ragged_paged_attention_pallas(
+        q, k, v, jnp.asarray(tables), starts, counts, ctx,
+        block_size=BS, scale=0.1, max_rows=16, chunk_blocks=2,
+        win_base=jnp.asarray(win_base), prefetch=False, interpret=True)
+    assert np.array_equal(np.asarray(got)[rows], np.asarray(nopf)[rows]), \
+        "cross-sequence prefetch changed sliding-window output"
+
+
+def test_ragged_prefetch_counts_mirror():
+    """The host-side mirror of the kernel's prefetch chain: a sequence
+    has a first wave iff it owns rows; zero-row sequences break the
+    chain (their successor starts its own first wave); sliding-window
+    floors can kill every wave of a sequence."""
+    from dynamo_tpu.engine.attention import ragged_prefetch_counts
+
+    counts = np.asarray([9, 8, 1, 0, 1], np.int32)
+    ctx = np.asarray([21, 16, 17, 0, 1], np.int32)
+    pf = ragged_prefetch_counts(counts, ctx, block_size=BS,
+                                chunk_blocks=2, blocks_per_table=5)
+    # slots 0..2 chain (2 hits); slot 3 is empty, so slot 4 is exposed
+    assert pf == {"first_waves": 4, "prefetched": 2, "exposed": 2,
+                  "hit_ratio": 0.5}
+    # no sequences → no waves, ratio well-defined at 0
+    pf0 = ragged_prefetch_counts(np.zeros(3, np.int32),
+                                 np.zeros(3, np.int32), block_size=BS)
+    assert pf0["first_waves"] == 0 and pf0["hit_ratio"] == 0.0
+    # a window floor past the last wave kills the middle sequence's
+    # waves entirely — both its own first wave and the chain through it
+    win = np.asarray([-(1 << 30), 10_000, -(1 << 30)], np.int32)
+    pfw = ragged_prefetch_counts(
+        np.asarray([1, 1, 1], np.int32),
+        np.asarray([40, 40, 40], np.int32), win_base=win,
+        block_size=BS, chunk_blocks=2)
+    assert pfw["first_waves"] == 2 and pfw["prefetched"] == 0
 
 
 def test_ragged_supported_bounds():
@@ -424,6 +480,116 @@ def test_builder_respects_max_seq_rows_and_capacity():
     assert build_ragged_batch(8, 2, [], [], 4) is None
 
 
+def test_builder_spec_spans():
+    """Spec spans (ragged × speculative decoding): row 0 is the
+    mandatory decode row, draft rows ride as surplus AFTER prefill
+    minimums, truncate deterministically under pressure (never split),
+    and a span truncated to one row degrades to a plain decode row."""
+    b = build_ragged_batch(
+        16, 4, decode_rows=[(0, 7, 30)],
+        prefill_lanes=[(1, list(range(100, 140)), 0)],
+        max_seq_rows=32,
+        spec_lanes=[(2, [9, 10, 11, 12], 12)])
+    meta = {slot: (start, ln, mode)
+            for slot, start, ln, mode in b.seqs_meta()}
+    assert meta[2][2] == "spec" and meta[2][1] == 4
+    assert b.n_spec == 1 and b.spec_rows == 3
+    assert b.mixed and b.dispatches_replaced == 2
+    # the spec span's rows carry the chained token + drafts at
+    # consecutive positions
+    s2 = next(s for s in b.seqs if s.slot == 2)
+    assert list(b.tokens[s2.start:s2.start + 4]) == [9, 10, 11, 12]
+    assert list(b.positions[s2.start:s2.start + 4]) == [12, 13, 14, 15]
+    # capacity pressure: drafts truncate (atomic — the span still
+    # appears whole in THIS dispatch, surplus drafts are dropped)
+    tight = build_ragged_batch(
+        4, 4, decode_rows=[(0, 7, 30), (1, 8, 5)],
+        prefill_lanes=[],
+        max_seq_rows=32,
+        spec_lanes=[(2, [9, 10, 11, 12], 12), (3, [5, 6], 2)])
+    meta = {slot: (start, ln, mode)
+            for slot, start, ln, mode in tight.seqs_meta()}
+    assert tight.rows_used == 4
+    # slot order: slot 2 takes the single surplus row... capacity 4 =
+    # 2 decode + 2 spec row-0; zero surplus → both degrade to decode
+    assert meta[2][2] == "decode" and meta[2][1] == 1
+    assert meta[3][2] == "decode" and meta[3][1] == 1
+    # one more row of capacity goes to the FIRST spec lane in slot order
+    tight5 = build_ragged_batch(
+        5, 4, decode_rows=[(0, 7, 30), (1, 8, 5)],
+        prefill_lanes=[], max_seq_rows=32,
+        spec_lanes=[(2, [9, 10, 11, 12], 12), (3, [5, 6], 2)])
+    meta = {slot: (start, ln, mode)
+            for slot, start, ln, mode in tight5.seqs_meta()}
+    assert meta[2][2] == "spec" and meta[2][1] == 2
+    assert meta[3][2] == "decode" and meta[3][1] == 1
+
+
+def test_builder_fuzz_invariants():
+    """Property/fuzz sweep over random pending sets: every packing must
+    satisfy the metadata contract — ascending contiguous starts, token
+    capacity respected, every decode/spec slot present (decode rows
+    first: emission never starves), min-progress per prefill lane, spec
+    spans atomic (whole in one dispatch, row 0 = the chained token,
+    consecutive positions), trash sequence pinned past the live rows."""
+    rng = np.random.default_rng(1234)
+    for trial in range(200):
+        n_slots = int(rng.integers(1, 9))
+        max_rows = int(rng.integers(1, 9))
+        roles = rng.integers(0, 4, size=n_slots)   # 0 free, 1 decode,
+        decode_rows, prefill_lanes, spec_lanes = [], [], []
+        for slot in range(n_slots):
+            pos = int(rng.integers(0, 50))
+            if roles[slot] == 1:
+                decode_rows.append((slot, int(rng.integers(1, 99)), pos))
+            elif roles[slot] == 2:                 # 2 prefill
+                toks = rng.integers(1, 99,
+                                    size=int(rng.integers(1, 30))).tolist()
+                prefill_lanes.append((slot, toks, pos))
+            elif roles[slot] == 3:                 # 3 spec
+                toks = rng.integers(1, 99,
+                                    size=int(rng.integers(1, 6))).tolist()
+                spec_lanes.append((slot, toks, pos))
+        n_mand = len(decode_rows) + len(spec_lanes) + len(prefill_lanes)
+        capacity = int(rng.integers(max(n_mand, 1), n_mand + 24))
+        b = build_ragged_batch(capacity, n_slots, decode_rows,
+                               prefill_lanes, max_rows,
+                               spec_lanes=spec_lanes)
+        if n_mand == 0:
+            assert b is None
+            continue
+        assert b.rows_used <= capacity, "token capacity violated"
+        # ascending contiguous starts in slot order; trash start after
+        starts = [s.start for s in b.seqs]
+        ends = [s.start + s.length for s in b.seqs]
+        assert starts == sorted(starts)
+        assert all(starts[i + 1] == ends[i]
+                   for i in range(len(ends) - 1))
+        assert b.seq_starts[n_slots] == b.rows_used
+        assert (b.row_slot[b.rows_used:] == n_slots).all()
+        by_slot = {s.slot: s for s in b.seqs}
+        for slot, tok, pos in decode_rows:        # decode rows first
+            assert by_slot[slot].length == 1
+            assert b.tokens[by_slot[slot].start] == tok
+        for slot, toks, pos in prefill_lanes:     # min-progress
+            sp = by_slot[slot]
+            assert 1 <= sp.length <= min(len(toks), max_rows)
+            assert list(b.tokens[sp.start:sp.start + sp.length]) \
+                == [int(t) for t in toks[:sp.length]]
+        for slot, toks, pos in spec_lanes:        # spec spans atomic
+            sp = by_slot[slot]
+            assert 1 <= sp.length <= min(len(toks), max_rows)
+            assert sp.mode == ("spec" if sp.length > 1 else "decode")
+            assert list(b.tokens[sp.start:sp.start + sp.length]) \
+                == [int(t) for t in toks[:sp.length]]
+            assert list(b.positions[sp.start:sp.start + sp.length]) \
+                == list(range(pos, pos + sp.length))
+        # every span's positions are consecutive from its pos0
+        for sp in b.seqs:
+            assert (b.positions[sp.start:sp.start + sp.length]
+                    == sp.pos0 + np.arange(sp.length)).all()
+
+
 def test_engine_config_ragged_validation():
     base = dict(max_model_len=128, kv_block_size=8, num_kv_blocks=32,
                 max_num_seqs=4, ragged_dispatch=True)
@@ -431,16 +597,28 @@ def test_engine_config_ragged_validation():
     assert cfg.ragged_max_tokens == 4 + 2 * 64     # auto resolution
     with pytest.raises(ValueError):
         EngineConfig(**base, ragged_max_tokens=3)
-    with pytest.raises(NotImplementedError):
-        EngineConfig(**base, spec_k=2)
-    with pytest.raises(NotImplementedError):
-        EngineConfig(**base, sp=2)
-    with pytest.raises(NotImplementedError):
-        EngineConfig(**base, decode_steps_per_dispatch=4,
+    # round 11 retired the spec and pipelined-dispatch refusals: both
+    # compose with ragged now (spec spans + the chained-sample merge) —
+    # including pipelining WITHOUT a K-step scan (ragged dispatches are
+    # single-step)
+    EngineConfig(**base, spec_k=2)
+    EngineConfig(**base, decode_dispatch_pipeline=True)
+    EngineConfig(**base, spec_k=2, decode_dispatch_pipeline=True)
+    # the pipeline still needs K > 1 on a NON-ragged engine
+    with pytest.raises(ValueError):
+        EngineConfig(max_model_len=128, kv_block_size=8,
+                     num_kv_blocks=32, max_num_seqs=4,
                      decode_dispatch_pipeline=True)
-    with pytest.raises(NotImplementedError):
-        EngineConfig(**{**base, "pp": 2,
-                        "decode_steps_per_dispatch": 4})
+    # the two SURVIVING refusals (docs/ragged_attention.md
+    # §composition) must stay loud and must say what composes
+    for kw in ({"sp": 2},
+               {"pp": 2, "decode_steps_per_dispatch": 4}):
+        with pytest.raises(NotImplementedError) as ei:
+            EngineConfig(**{**base, **kw})
+        msg = str(ei.value)
+        assert "ragged_attention.md" in msg and "composes" in msg, (
+            f"refusal for {kw} must point at the composition matrix: "
+            f"{msg}")
 
 
 # --------------------------------------------------------------------------
@@ -609,6 +787,224 @@ async def test_engine_ragged_preemption_exact_and_replayable():
         assert check_inputs(events) == []
     finally:
         await small.stop()
+
+
+# --------------------------------------------------------------------------
+# EngineCore: ragged × speculative decoding (round 11)
+# --------------------------------------------------------------------------
+
+
+def _repetitive(rng, period=6, reps=5):
+    return rng.integers(1, TINY.vocab_size, size=period).tolist() * reps
+
+
+async def _run_seeded(core, prompt, rid, max_new=16, temperature=0.8,
+                      seed=77):
+    from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineRequest
+    from dynamo_tpu.engine.sampling import SlotSampling
+
+    req = EngineRequest(rid=rid, prompt=list(prompt),
+                        sampling=SlotSampling(temperature=temperature,
+                                              seed=seed),
+                        max_new_tokens=max_new, eos_ids=frozenset())
+    await core.submit(req)
+    toks = []
+    while True:
+        item, _ = await asyncio.wait_for(req.out_queue.get(), 120)
+        if item is FINISH_SENTINEL:
+            return toks
+        toks.append(item)
+
+
+@pytest.mark.asyncio
+async def test_engine_ragged_spec_bit_exact_greedy_and_seeded():
+    """The acceptance anchor: the ragged×spec stream must be BIT-exact
+    vs the NON-ragged spec engine — greedy and seeded — because both
+    sample every stream index under the same per-(seed, key_step) keys
+    (lockstep PRNG riding the ragged batch). Speculation must actually
+    engage (drafts accepted) and draft rows must ride ragged spans."""
+    _, run_req = _harness()
+    rng = np.random.default_rng(101)
+    prompt = _repetitive(rng)
+
+    base = _make_core(False, spec_k=3)
+    try:
+        ref, _, _ = await run_req(base, prompt, 32, rid="a")
+    finally:
+        await base.stop()
+    rag = _make_core(True, spec_k=3)
+    try:
+        got, _, _ = await run_req(rag, prompt, 32, rid="a")
+        assert rag.spec_dispatches > 0, "speculation never engaged"
+        assert rag.spec_accepted_tokens > 0, \
+            "repetitive prompt produced zero accepted drafts"
+        assert rag.ragged_spec_rows > 0, \
+            "no draft rows rode ragged spans"
+        assert got == ref, \
+            "greedy ragged×spec diverged from the split spec engine"
+    finally:
+        await rag.stop()
+
+    base = _make_core(False, spec_k=3)
+    try:
+        ref_s = await _run_seeded(base, prompt, "a")
+    finally:
+        await base.stop()
+    rag = _make_core(True, spec_k=3)
+    try:
+        got_s = await _run_seeded(rag, prompt, "a")
+        assert rag.spec_dispatches > 0
+        assert got_s == ref_s, \
+            "seeded ragged×spec diverged from the split spec engine"
+    finally:
+        await rag.stop()
+
+
+@pytest.mark.asyncio
+async def test_engine_ragged_spec_mixed_traffic_and_metrics():
+    """Spec spans and prefill lanes in the SAME engine run (the refusal
+    this round retired: draft rows and prompt rows sharing ragged
+    capacity): streams match the non-ragged spec engine, and the new
+    observability fields are live — ragged_spec_rows_total,
+    ragged_prefetch_hit_ratio (two concurrent spans chain waves), and
+    the flight recorder's per-dispatch spec/prefetch columns."""
+    _, run_req = _harness()
+    rng = np.random.default_rng(61)
+    p1 = _repetitive(rng)
+    p2 = _repetitive(rng)
+
+    ref_core = _make_core(False, spec_k=3)
+    try:
+        r1, _, _ = await run_req(ref_core, p1, 20, rid="a")
+        r2, _, _ = await run_req(ref_core, p2, 20, rid="b")
+    finally:
+        await ref_core.stop()
+
+    rag = _make_core(True, spec_k=3, ragged_max_seq_rows=6)
+    try:
+        (g1, _, _), (g2, _, _) = await asyncio.gather(
+            run_req(rag, p1, 20, rid="a"), run_req(rag, p2, 20, rid="b"))
+        assert rag.spec_dispatches > 0 and rag.ragged_spec_rows > 0
+        assert g1 == r1, "ragged×spec stream a diverged"
+        assert g2 == r2, "ragged×spec stream b diverged"
+        m = rag.metrics().to_dict()
+        assert m["ragged_spec_rows_total"] == rag.ragged_spec_rows > 0
+        assert 0.0 < m["ragged_prefetch_hit_ratio"] <= 1.0, (
+            "two concurrent spans never chained a wave prefetch")
+        recs = [r for r in rag.flight.dump() if r["kind"] == "ragged"]
+        assert recs
+        for r in recs:
+            assert {"n_spec", "spec_rows", "prefetch_first_waves",
+                    "prefetch_hits", "chained"} <= set(r)
+        assert any(r["spec_rows"] > 0 for r in recs)
+        assert any(r["prefetch_hits"] > 0 for r in recs)
+        # wire round trip: the appended fields survive from_dict and
+        # old payloads (without them) still decode to zeros
+        from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+        assert ForwardPassMetrics.from_dict(m).ragged_spec_rows_total \
+            == m["ragged_spec_rows_total"]
+        legacy = {k: v for k, v in m.items()
+                  if not k.startswith("ragged_prefetch")
+                  and not k.startswith("ragged_spec")}
+        assert ForwardPassMetrics.from_dict(
+            legacy).ragged_prefetch_hit_ratio == 0.0
+    finally:
+        await rag.stop()
+
+
+@pytest.mark.asyncio
+async def test_engine_ragged_spec_preemption_exact_and_replayable():
+    """The acceptance criterion's hard case: ragged×spec under KV
+    contention — recompute preemptions fire, streams stay exact to
+    their recompute boundaries vs the NON-ragged spec engine, and the
+    recorded ragged schedule (row-sampled variant, spec spans and all)
+    replays bit-exactly and passes both static checkers."""
+    from dynamo_tpu.engine.replay import (Recorder, check_inputs,
+                                          check_log, compare_replay,
+                                          replay)
+    from dynamo_tpu.llm.protocols.common import FinishReason
+
+    assert_exact_to_recompute_boundary, run_req = _harness()
+    rng = np.random.default_rng(61)
+    p1 = _repetitive(rng)
+    p2 = _repetitive(rng)
+    max_new = 40
+
+    big = _make_core(False, spec_k=3, num_kv_blocks=64)
+    try:
+        ref1, _, _ = await run_req(big, p1, max_new)
+        ref2, _, _ = await run_req(big, p2, max_new)
+    finally:
+        await big.stop()
+    assert len(ref1) == max_new
+
+    small = _make_core(True, spec_k=3, num_kv_blocks=16)
+    small.recorder = Recorder()
+    try:
+        (g1, r1, q1), (g2, r2, q2) = await asyncio.gather(
+            run_req(small, p1, max_new, rid="a"),
+            run_req(small, p2, max_new, rid="b"))
+        assert r1 == FinishReason.LENGTH and r2 == FinishReason.LENGTH
+        assert len(g1) == max_new and len(g2) == max_new
+        assert small.preemptions > 0, \
+            "contention never triggered preemption"
+        assert small.spec_dispatches > 0, "speculation never engaged"
+        assert_exact_to_recompute_boundary(g1, ref1, q1, "rspec-a")
+        assert_exact_to_recompute_boundary(g2, ref2, q2, "rspec-b")
+        events = small.recorder.events
+        assert any(e["ev"] == "ragged"
+                   and any(m == "spec" for *_x, m in e["seqs"])
+                   for e in events), "no spec span was ever recorded"
+        rep = replay(small, events)
+        assert compare_replay(events, rep) == []
+        assert check_log(events, 8) == []
+        assert check_inputs(events) == []
+    finally:
+        await small.stop()
+
+
+@pytest.mark.asyncio
+async def test_engine_ragged_pipelined_dispatch():
+    """Ragged × decode_dispatch_pipeline (the other retired refusal):
+    steady pure-decode phases chain dispatch N+1 off dispatch N's
+    device tokens (the chained-sample merge), streams stay BIT-exact
+    vs the unpipelined ragged engine, chained events replay bit-exactly
+    through the recorded schedule, and both static checkers pass."""
+    from dynamo_tpu.engine.replay import (Recorder, check_inputs,
+                                          check_log, compare_replay,
+                                          replay)
+
+    _, run_req = _harness()
+    rng = np.random.default_rng(23)
+    p1 = rng.integers(1, TINY.vocab_size, size=30).tolist()
+    p2 = rng.integers(1, TINY.vocab_size, size=17).tolist()
+
+    plain = _make_core(True)
+    try:
+        (a1, _, _), (a2, _, _) = await asyncio.gather(
+            run_req(plain, p1, 24, rid="a"),
+            run_req(plain, p2, 24, rid="b"))
+    finally:
+        await plain.stop()
+
+    piped = _make_core(True, decode_dispatch_pipeline=True)
+    piped.recorder = Recorder()
+    try:
+        (b1, _, _), (b2, _, _) = await asyncio.gather(
+            run_req(piped, p1, 24, rid="a"),
+            run_req(piped, p2, 24, rid="b"))
+        assert b1 == a1 and b2 == a2, \
+            "pipelined ragged streams diverged from synchronous ragged"
+        events = piped.recorder.events
+        chained = [e for e in events if e["ev"] == "ragged"
+                   and e.get("chained_from") is not None]
+        assert chained, "the pipeline never chained a ragged dispatch"
+        rep = replay(piped, events)
+        assert compare_replay(events, rep) == []
+        assert check_log(events, 8) == []
+        assert check_inputs(events) == []
+    finally:
+        await piped.stop()
 
 
 @pytest.mark.asyncio
