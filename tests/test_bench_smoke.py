@@ -250,7 +250,7 @@ def test_bench_ragged_spec_leg():
          "BENCH_STEPS": "4", "BENCH_PROMPT": "8", "BENCH_HARVEST": "2",
          "BENCH_QUANT": "none", "BENCH_DEVICE": "0",
          "BENCH_RAGGED_BATCH": "4", "BENCH_RAGGED_PROMPT": "48",
-         "BENCH_RAGGED_SEQ_ROWS": "16"})
+         "BENCH_RAGGED_REQUESTS": "8", "BENCH_RAGGED_SEQ_ROWS": "16"})
     assert r.returncode == 0, f"bench.py crashed:\n{r.stderr[-4000:]}"
     out = json.loads([l for l in r.stdout.strip().splitlines()
                       if l.startswith("{")][-1])
