@@ -792,6 +792,32 @@ def run_kv_remote_bench(mcfg) -> dict:
             remote_ttft, remote_toks, remote_hit = await serve(
                 core_c, prompt, "remote")
             n_fetched = remote_hit // bs
+
+            # --- dataplane-vs-JSON A/B (ISSUE 12 satellite): the same
+            # hash run fetched over the native data plane and over the
+            # base64-over-JSON fallback — fetch wall + bytes copied.
+            # REPEAT_FETCHES batches several fetches per sample so the
+            # systematic JSON overhead (base64 both ways + JSON parse of
+            # the bulk payload + 33% more wire bytes) dominates loopback
+            # jitter; min-of-samples is the standard noise floor.
+            REPEAT_FETCHES, SAMPLES = 5, 3
+
+            async def time_leg(fetch):
+                walls, nbytes = [], 0
+                for _ in range(SAMPLES):
+                    t0 = time.monotonic()
+                    for _ in range(REPEAT_FETCHES):
+                        blobs = await fetch(wid_a, hashes)
+                        if blobs is None:
+                            raise RuntimeError(
+                                "native dataplane unavailable for the "
+                                "kv-remote A/B leg (toolchain missing?)")
+                    walls.append(time.monotonic() - t0)
+                    nbytes = sum(len(b) for b in blobs)
+                return min(walls) * 1e3, nbytes
+
+            dp_ms, dp_bytes = await time_leg(fab_c._fetch_blobs_native)
+            js_ms, js_bytes = await time_leg(fab_c._fetch_blobs_json)
             predicted_fetch_s = gate.modeled_fetch_s(max(n_fetched, 1),
                                                      link)
             predicted_rec_s = gate.modeled_recompute_s(max(n_fetched, 1))
@@ -830,6 +856,16 @@ def run_kv_remote_bench(mcfg) -> dict:
                 "measured_crossover_blocks": (
                     None if measured_cross == float("inf")
                     else round(measured_cross, 2)),
+                # dataplane A/B leg (x REPEAT_FETCHES per sample)
+                "dataplane_fetch_ms": round(dp_ms, 3),
+                "json_fetch_ms": round(js_ms, 3),
+                "dataplane_bytes": dp_bytes,
+                "json_bytes": js_bytes,
+                "dataplane_vs_json_speedup": round(
+                    js_ms / max(dp_ms, 1e-9), 3),
+                "dataplane_fetches_total": fab_c.dataplane_fetches_total,
+                "dataplane_fallbacks_total":
+                    fab_c.dataplane_fallbacks_total,
             }
         finally:
             for fab in (fab_c, fab_a):

@@ -1,4 +1,4 @@
-// Native response-plane stream sender: two-part frame writer + control-frame
+// Native data-plane stream sender: two-part frame writer + control-frame
 // reader on one socket, driven by a dedicated poll thread.
 //
 // C++ core behind dynamo_tpu/runtime/native_tcp.py — the TPU-native analog
@@ -10,6 +10,12 @@
 // the GIL thread; control state surfaces as atomic flags the engine polls at
 // step granularity (the same cadence at which cancellation can take effect
 // anyway).
+//
+// Two producers ride this plane: per-token response streams
+// (runtime/ingress.py) and — since round 12 — the KV fabric's bulk block
+// fetches (llm/kv/fabric.py "fetch_native": one frame per KV block, npz
+// bytes in the data part, the hash in the header part; the NIXL-transfer
+// analog), so fleet KV bytes never transit the JSON request plane.
 //
 // Frame layout (big-endian): [kind u8][header_len u32][data_len u32][header][data]
 

@@ -148,6 +148,16 @@ _REMOTE_GAUGES = {
         "nv_llm_kv_remote_admission_rejects_total",
     "remote_link_gbps": "nv_llm_kv_remote_link_gbps",
     "remote_link_rtt_s": "nv_llm_kv_remote_link_rtt_seconds",
+    # native KV dataplane + prefill-as-a-service (round 12): fetches
+    # riding the C++ data plane vs the base64-over-JSON fallback, and
+    # prefix blocks published to the object tier by prefill-publish
+    # workers (components/prefill_service.py)
+    "remote_dataplane_fetches_total":
+        "nv_llm_kv_remote_dataplane_fetches_total",
+    "remote_dataplane_fallbacks_total":
+        "nv_llm_kv_remote_dataplane_fallbacks_total",
+    "prefill_published_blocks_total":
+        "nv_llm_kv_remote_prefill_published_blocks_total",
     "netstore_retries_total": "nv_llm_netstore_retries_total",
 }
 

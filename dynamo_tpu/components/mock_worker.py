@@ -314,6 +314,12 @@ class MockTokenWorker:
             d["kv_bytes_per_block"] = 1 << 20
             d["kv_block_size"] = self.block_size
             d["prefill_tok_per_s"] = 5e4
+            # round 12: a healthy native dataplane (every fetch rides
+            # it, zero JSON fallbacks) and a prefill-publish worker
+            # steadily feeding the object tier
+            d["remote_dataplane_fetches_total"] = 2 * eng.requests_served
+            d["remote_dataplane_fallbacks_total"] = 0
+            d["prefill_published_blocks_total"] = 3 * eng.requests_served
         profile = getattr(self, "profile", None)
         if profile is not None and (profile.slow_start_s > 0
                                     or profile.latency_factor != 1.0):

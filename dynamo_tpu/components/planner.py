@@ -154,6 +154,7 @@ class Planner:
                  slo: Optional[ServiceLevelObjective] = None,
                  config: Optional[PlannerConfig] = None,
                  prefill_queue=None,
+                 prefill_endpoint: Optional[Endpoint] = None,
                  model_name: Optional[str] = None,
                  traces=None, collector=None):
         self.runtime = runtime
@@ -162,6 +163,12 @@ class Planner:
         self.slo = slo or ServiceLevelObjective()
         self.cfg = config or PlannerConfig()
         self.prefill_queue = prefill_queue
+        # discovery endpoint of the prefill fleet: with it the planner
+        # ACTUATES prefill replicas (scale out on sustained queue
+        # backlog, drain-then-retire on sustained idleness) instead of
+        # only retuning the disagg threshold around a fixed tier
+        self.prefill_endpoint = prefill_endpoint
+        self._prefill_client = None
         # model whose disagg threshold the retune actuator manages
         self.model_name = model_name
         # latency sources, preferred first: `collector` is a fleet trace
@@ -185,6 +192,12 @@ class Planner:
         self._down_breaches = 0
         self._cooldown_until = 0.0
         self._retune_cooldown_until = 0.0
+        # prefill-tier hysteresis (independent of the decode counters —
+        # a decode breach must not mask a prefill backlog or vice versa)
+        self._pq_breaches = 0
+        self._pq_idle_cycles = 0
+        self._prefill_cooldown_until = 0.0
+        self._prefill_drain_task: Optional[asyncio.Task] = None
         # current disagg threshold (applied via retune)
         self.disagg_threshold = self.slo.max_local_prefill_length
         # observability
@@ -193,6 +206,8 @@ class Planner:
             "drains_started": 0, "drains_completed": 0,
             "drain_timeouts": 0, "retunes": 0, "holds": 0,
             "retune_crossover_holds": 0,
+            "prefill_scale_up": 0, "prefill_scale_down": 0,
+            "prefill_drains_started": 0,
         }
         self.last_decision: dict = {}
         self.last_signals: Optional[FleetSignals] = None
@@ -206,6 +221,9 @@ class Planner:
     async def start(self) -> "Planner":
         self._client = self.endpoint.client()
         await self._client.start()
+        if self.prefill_endpoint is not None:
+            self._prefill_client = self.prefill_endpoint.client()
+            await self._prefill_client.start()
         # live SLO + control watches (llmctl writes these)
         ns = self.endpoint.namespace
         entry = await self.runtime.store.kv_get(slo_key(ns))
@@ -237,6 +255,8 @@ class Planner:
             t.cancel()
         if self._drain_task is not None:
             self._drain_task.cancel()
+        if self._prefill_drain_task is not None:
+            self._prefill_drain_task.cancel()
         for t in self._tasks:
             try:
                 await t
@@ -246,6 +266,8 @@ class Planner:
             w.close()
         if self._client is not None:
             await self._client.close()
+        if self._prefill_client is not None:
+            await self._prefill_client.close()
 
     # ------------------------------------------------------------- watches
     async def _watch_loop(self, watcher, apply) -> None:
@@ -356,7 +378,67 @@ class Planner:
             self.counters["holds"] += 1
             if not self.last_decision:
                 self._record("hold", verdict, {})
+        await self._maybe_scale_prefill(signals)
         await self._maybe_retune(signals)
+
+    # ------------------------------------------------------- prefill fleet
+    async def _maybe_scale_prefill(self, signals: FleetSignals) -> None:
+        """Prefill-fleet actuation (the ROADMAP's 'planner currently only
+        actuates decode replicas' gap, closed): a prefill-queue backlog
+        sustained for ``breach_cycles`` evaluations scales the prefill
+        tier out; a queue pinned at ZERO for twice that long drains the
+        youngest prefill worker and retires it — same hysteresis +
+        cooldown discipline as the decode loop, independent counters so
+        neither tier's pressure masks the other's."""
+        if self._prefill_client is None:
+            return
+        depth = signals.prefill_queue_depth
+        if depth > self.slo.max_queue_depth:
+            self._pq_breaches += 1
+            self._pq_idle_cycles = 0
+        elif depth == 0:
+            self._pq_idle_cycles += 1
+            self._pq_breaches = 0
+        else:
+            self._pq_breaches = 0
+            self._pq_idle_cycles = 0
+        now = time.monotonic()
+        if now < self._prefill_cooldown_until:
+            return
+        drain_busy = (self._prefill_drain_task is not None
+                      and not self._prefill_drain_task.done())
+        draining = set(self._prefill_client.draining_ids())
+        live = [i for i in self._prefill_client.instance_ids()
+                if i not in draining]
+        if self._pq_breaches >= self.cfg.breach_cycles and not drain_busy:
+            step = min(self.cfg.scale_step,
+                       self.slo.max_prefill_workers - len(live))
+            if step > 0:
+                await self.actuator.scale_up("prefill", step)
+                self.counters["prefill_scale_up"] += 1
+                self.last_decision = {
+                    "action": "prefill_scale_up", "added": step,
+                    "prefill_queue_depth": depth, "at": time.time()}
+                logger.info("planner decision: prefill_scale_up +%d "
+                            "(queue depth %d)", step, depth)
+                self._prefill_cooldown_until = (time.monotonic()
+                                                + self.cfg.cooldown_s)
+                self._pq_breaches = 0
+        elif (self._pq_idle_cycles >= 2 * self.cfg.breach_cycles
+                and not drain_busy
+                and len(live) > self.slo.min_prefill_workers):
+            victim = max(live)             # youngest lease, like decode
+            self.counters["prefill_drains_started"] += 1
+            self.last_decision = {
+                "action": "prefill_drain_start",
+                "worker": f"{victim:x}", "at": time.time()}
+            self._prefill_drain_task = (
+                asyncio.get_running_loop().create_task(
+                    self._drain_and_retire(victim, role="prefill"),
+                    name=f"planner-prefill-drain-{victim:x}"))
+            self._prefill_cooldown_until = (time.monotonic()
+                                            + self.cfg.cooldown_s)
+            self._pq_idle_cycles = 0
 
     def _arm_cooldown(self) -> None:
         self._cooldown_until = time.monotonic() + self.cfg.cooldown_s
@@ -380,23 +462,30 @@ class Planner:
             return None
         return max(candidates)
 
-    async def _drain_and_retire(self, worker_id: int) -> None:
+    async def _drain_and_retire(self, worker_id: int,
+                                role: str = "decode") -> None:
         """The drain protocol (docs/planner.md): flag → no new admissions
-        → wait in-flight completion → retire. Zero dropped requests."""
+        → wait in-flight completion → retire. Zero dropped requests.
+        ``role`` selects the fleet (decode by default; "prefill" drains
+        through the prefill endpoint's keys/client and books its own
+        counters)."""
+        prefill = role == "prefill"
+        client = self._prefill_client if prefill else self._client
+        endpoint = self.prefill_endpoint if prefill else self.endpoint
         store = self.runtime.store
         await store.kv_put(
-            self.endpoint.drain_key(worker_id),
+            endpoint.drain_key(worker_id),
             json.dumps({"requested_at": time.time()}).encode())
         deadline = time.monotonic() + self.cfg.drain_timeout_s
         drained = False
         while time.monotonic() < deadline:
             # gone from discovery entirely (drain-to-exit) counts as done
-            if worker_id not in self._client.instances:
+            if worker_id not in client.instances:
                 drained = True
                 break
-            stats = await self._client.collect_stats()
+            stats = await client.collect_stats()
             m = stats.get(worker_id)
-            if (worker_id in set(self._client.draining_ids())
+            if (worker_id in set(client.draining_ids())
                     and m is not None
                     and int(m.get("request_active_slots", 1)) == 0
                     and int(m.get("num_requests_waiting", 1)) == 0):
@@ -409,15 +498,20 @@ class Planner:
                            "anyway (in-flight work may be cut)", worker_id,
                            self.cfg.drain_timeout_s)
         try:
-            await self.actuator.retire("decode", worker_id)
+            await self.actuator.retire(role, worker_id)
         finally:
-            self.counters["drains_completed"] += 1
-            self.counters["scale_down"] += 1
+            if prefill:
+                self.counters["prefill_scale_down"] += 1
+            else:
+                self.counters["drains_completed"] += 1
+                self.counters["scale_down"] += 1
             self.last_decision = {
-                "action": "drain_complete", "worker": f"{worker_id:x}",
+                "action": ("prefill_drain_complete" if prefill
+                           else "drain_complete"),
+                "worker": f"{worker_id:x}",
                 "clean": drained, "at": time.time()}
-            logger.info("worker %x drained and retired (clean=%s)",
-                        worker_id, drained)
+            logger.info("%s worker %x drained and retired (clean=%s)",
+                        role, worker_id, drained)
 
     # --------------------------------------------------------------- retune
     async def _maybe_retune(self, signals: FleetSignals) -> None:
@@ -489,6 +583,12 @@ class Planner:
                 "draining": [f"{i:x}" for i in
                              self._client.draining_ids()],
             } if self._client is not None else {},
+            "prefill_workers": {
+                "live": [f"{i:x}" for i in
+                         self._prefill_client.instance_ids()],
+                "draining": [f"{i:x}" for i in
+                             self._prefill_client.draining_ids()],
+            } if self._prefill_client is not None else {},
             "disagg_threshold": self.disagg_threshold,
             "fleet_crossover_tokens": (
                 None if self.fleet_crossover_tokens is None

@@ -1,0 +1,238 @@
+"""Prefill-as-a-Service: a dedicated prefill fleet over the object tier.
+
+PAPERS.md "Prefill-as-a-Service: KVCache of Next-Generation Models Could
+Go Cross-Datacenter" argues the subsystem this module implements: prefix
+KV is worth computing ONCE, close to cheap compute, and serving to
+decode fleets anywhere — across regions — through a durable KV store,
+admitted only when the measured fetch beats the measured recompute.
+
+This repo already had every ingredient:
+
+- the **object tier** (llm/kv/remotestore.py ObjectKvBackend) — a
+  content-addressed, durable, fleet-shared block store keyed by the
+  same chained hashes every KV tier uses;
+- the **admission economics** (llm/kv/fabric.AdmissionGate) — decode
+  workers price a remote hit with their own measured link + prefill
+  rate and recompute when fetching loses;
+- the **prefill queue** shape (llm/disagg.PrefillQueue) — at-least-once
+  work distribution over the bus.
+
+:class:`PrefillService` is the missing role: ``run.py --role
+prefill-publish`` workers pull :class:`PrefillPublishRequest` items
+from the ``prefill_publish`` work queue (and answer the same op over a
+direct endpoint RPC), run prefill on their own engine, and publish the
+prompt's full prefix blocks to the object tier
+(EngineCore.publish_prefix_to_remote). There is NO per-request decode
+sink and NO handoff stream — the handoff IS the durable store, which is
+what makes the role cross-region viable: the publish and the admit may
+be minutes and continents apart.
+
+Contrast with the existing disagg ``PrefillWorker`` (llm/disagg.py):
+that role serves one decode worker's in-flight request over a dialed
+stream (latency-coupled); this role warms a SHARED tier for whole
+fleets (latency-decoupled). The planner scales both through the same
+``role="prefill"`` actuator (components/planner.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from ..engine.core import FINISH_SENTINEL, EngineRequest
+from ..engine.sampling import SlotSampling
+from ..llm.disagg import PrefillQueue
+from ..llm.protocols.disagg import PrefillPublishRequest
+from ..runtime.engine import AsyncEngine, ManyOut, ResponseStream
+
+logger = logging.getLogger("dynamo_tpu.components.prefill_service")
+
+__all__ = ["PrefillService", "PREFILL_PUBLISH_QUEUE",
+           "PREFILL_PUBLISH_ENDPOINT"]
+
+PREFILL_PUBLISH_QUEUE = "prefill_publish"
+PREFILL_PUBLISH_ENDPOINT = "prefill_publish"
+
+
+class PrefillService(AsyncEngine):
+    """One prefill-publish worker: queue consumer + direct RPC server.
+
+    Ops (request = one JSON dict, response = one JSON dict):
+    - ``publish``: {"token_ids": [...], "sampling": {...}} → run
+      prefill, publish the prefix to the object tier, reply
+      {"hashes": [...], "published": n, "first_token": t}. The reply's
+      hashes let the caller route follow-up decodes at workers whose
+      radix index (or shared object root) already holds the prefix.
+    - ``status``: queue depth + cumulative publish counters — the
+      prefill-queue signal a planner embedding this service scrapes.
+    """
+
+    MAX_DELIVERIES = 3
+
+    def __init__(self, core, runtime,
+                 queue: Optional[PrefillQueue] = None):
+        if core.remote_store is None or core.remote_store.object is None:
+            raise ValueError(
+                "--role prefill-publish needs the durable object tier — "
+                "start with --kv-remote-dir pointing at the fleet-shared "
+                "root")
+        self.core = core
+        self.runtime = runtime
+        self.queue = queue or PrefillQueue(runtime,
+                                           name=PREFILL_PUBLISH_QUEUE)
+        self._task: Optional[asyncio.Task] = None
+        self._inflight: set = set()
+        self._stopping = False
+        self.publishes_done = 0
+        self.publishes_failed = 0
+        self.blocks_published = 0
+
+    # --------------------------------------------------------------- core
+    async def publish(self, token_ids, sampling: Optional[dict] = None,
+                      rid: str = "publish") -> dict:
+        """Run prefill for ``token_ids`` on the local engine and publish
+        the prompt's full prefix blocks to the object tier. The engine's
+        prefix cache makes re-publishing a warm chain nearly free (full
+        device hit → no prefill dispatch, content-addressed puts skip)."""
+        req = EngineRequest(
+            rid=rid, prompt=[int(t) for t in token_ids],
+            sampling=SlotSampling(**(sampling or {})),
+            max_new_tokens=1, eos_ids=frozenset())
+        await self.core.submit(req)
+        first_token = None
+        while True:
+            out, _ = await req.out_queue.get()
+            if out is FINISH_SENTINEL:
+                break
+            first_token = out
+        if req.seq is None:
+            raise RuntimeError(f"publish request {rid} was never admitted")
+        n = await self.core.publish_prefix_to_remote(req.seq)
+        self.blocks_published += n
+        return {"ok": True,
+                "hashes": [int(h) for h in req.seq.sequence_hashes],
+                "published": n,
+                "first_token": first_token,
+                "prefix_hit_tokens": req.prefix_hit_tokens}
+
+    # ------------------------------------------------------ direct RPC op
+    async def _handle(self, d: dict) -> dict:
+        op = d.get("op", "publish")
+        if op == "publish":
+            from ..runtime.tracing import Trace, use_trace
+            tctx = d.get("trace")
+            try:
+                if tctx:
+                    with use_trace(Trace.from_wire(
+                            tctx, tctx.get("trace_id", "?"),
+                            role="prefill_publish")) as ptrace:
+                        with ptrace.span("prefill.publish",
+                                         tokens=len(d.get("token_ids",
+                                                          ()))):
+                            r = await self.publish(
+                                d.get("token_ids", []),
+                                d.get("sampling"),
+                                rid=d.get("request_id", "publish"))
+                else:
+                    r = await self.publish(d.get("token_ids", []),
+                                           d.get("sampling"),
+                                           rid=d.get("request_id",
+                                                     "publish"))
+                self.publishes_done += 1
+                return r
+            except Exception as e:  # noqa: BLE001 — per-request isolation
+                self.publishes_failed += 1
+                logger.exception("prefill publish failed")
+                return {"ok": False, "error": str(e)}
+        if op == "status":
+            try:
+                depth = await self.queue.depth()
+            except Exception:  # noqa: BLE001 — queue may not exist yet
+                depth = 0
+            return {"ok": True, "queue_depth": depth, **self.stats()}
+        return {"ok": False, "error": f"unknown prefill op {op!r}"}
+
+    async def generate(self, request) -> ManyOut:
+        resp = await self._handle(request.data)
+        return ResponseStream.from_iterable([resp], request.ctx)
+
+    # ------------------------------------------------------ queue consumer
+    async def start(self) -> "PrefillService":
+        self._stopping = False
+        self._task = asyncio.get_running_loop().create_task(
+            self._loop(), name="prefill-publish")
+        return self
+
+    async def _loop(self) -> None:
+        from ..runtime.tracing import detach_trace
+        detach_trace()
+        backoff = 0.5
+        while not self._stopping:
+            try:
+                item = await self.queue.dequeue(timeout=0.5)
+                backoff = 0.5
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — transient bus errors
+                logger.warning("prefill-publish dequeue failed (%s); "
+                               "retrying in %.1fs", e, backoff)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 10.0)
+                continue
+            if item is None:
+                continue
+            t = asyncio.get_running_loop().create_task(
+                self._handle_item(item),
+                name=f"prefill-publish-{item.id}")
+            self._inflight.add(t)
+            t.add_done_callback(self._inflight.discard)
+
+    async def _handle_item(self, item) -> None:
+        try:
+            ppr = PrefillPublishRequest.from_json(item.payload)
+        except Exception:  # noqa: BLE001
+            logger.exception("undecodable prefill-publish item %d", item.id)
+            await self.queue.ack(item.id)
+            return
+        try:
+            await self._handle({"op": "publish",
+                                "request_id": ppr.request_id,
+                                "token_ids": ppr.token_ids,
+                                "sampling": ppr.sampling,
+                                "trace": ppr.trace})
+            await self.queue.ack(item.id)
+        except Exception as e:  # noqa: BLE001 — engine-level failure
+            logger.warning("prefill-publish item %d failed (%s)",
+                           item.id, e)
+            if item.deliveries >= self.MAX_DELIVERIES:
+                await self.queue.ack(item.id)   # bounded: drop poison work
+            else:
+                await self.queue.nack(item.id)
+
+    # -------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        return {"prefill_publishes_done": self.publishes_done,
+                "prefill_publishes_failed": self.publishes_failed,
+                "prefill_published_blocks_total": self.blocks_published,
+                "inflight": len(self._inflight)}
+
+    async def drain(self) -> None:
+        """Planner drain: stop pulling NEW queue items, finish in-flight
+        publishes (durable puts are never cut mid-write — the object
+        store's tmp→fsync→rename keeps partial work invisible)."""
+        self._stopping = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self._inflight:
+            await asyncio.gather(*list(self._inflight),
+                                 return_exceptions=True)
+
+    async def stop(self) -> None:
+        self._stopping = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        for t in list(self._inflight):
+            t.cancel()

@@ -385,7 +385,8 @@ class EngineCore:
         self._pending: Optional[dict] = None   # un-harvested decode dispatch
         self._ragged_pending: Optional[dict] = None  # pipelined ragged
         self._admissions: List[tuple] = []     # (req, tok_dev, logprob_dev)
-        self._onboards: List[tuple] = []       # (req, slot, plan, prepped)
+        self._onboards: List[tuple] = []  # (req, slot, plan, prepped,
+        #                                    remote_values-for-recorder)
         self._onboard_tasks: set = set()
         self._handoff_tasks: set = set()
         self.waiting: asyncio.Queue[EngineRequest] = asyncio.Queue()
@@ -439,6 +440,9 @@ class EngineCore:
         self.remote_onboards = 0
         self.remote_onboarded_blocks = 0
         self.remote_fetch_failures = 0
+        # prefill-as-a-service (components/prefill_service.py): prefix
+        # blocks this engine published to the durable object tier
+        self.prefill_published_blocks = 0
         # measured prefill rate feed for the fabric's admission gate and
         # the router's NetKV scoring: wall seconds spent in prefill
         # admissions (dispatch + host glue — an upper bound, so the
@@ -807,7 +811,7 @@ class EngineCore:
             await asyncio.gather(*list(self._onboard_tasks),
                                  return_exceptions=True)
         if self._onboards:                # release reserved onboard blocks
-            for req, slot, plan, _prepped in self._onboards:
+            for req, slot, plan, _prepped, _rvals in self._onboards:
                 self.slots[slot] = None
                 self.kv_manager.pool.release(plan.all_blocks)
                 self.kv_manager.host_pool.unpin(plan.host_slots)
@@ -1123,6 +1127,8 @@ class EngineCore:
             # remote (G4) fabric: tier occupancy + the measured link
             # model the router's NetKV scoring consumes (kv_router/
             # scoring.py network_adjusted_overlap)
+            tier_kw.update(prefill_published_blocks_total=self
+                           .prefill_published_blocks)
             if self.kv_fabric is not None:
                 tier_kw.update(self.kv_fabric.metrics())
             else:
@@ -1207,7 +1213,7 @@ class EngineCore:
             if req is not None and req.blocks:
                 self.kv_manager.pool.release(req.blocks)
                 req.blocks = []
-        for req, _slot, plan, _prepped in self._onboards:
+        for req, _slot, plan, _prepped, _rvals in self._onboards:
             self.kv_manager.pool.release(plan.all_blocks)
             if self.kv_manager.host_pool is not None:
                 self.kv_manager.host_pool.unpin(plan.host_slots)
@@ -1495,6 +1501,58 @@ class EngineCore:
         0.0 while young/unknown (the gate treats unknown as admit)."""
         return self.prefill_rate_estimator.rate()
 
+    async def publish_prefix_to_remote(self, seq) -> int:
+        """Prefill-as-a-Service publish (components/prefill_service.py):
+        push every still-registered FULL block of ``seq``'s chain from
+        the device pool to the durable remote (object) tier, keyed by
+        the same chained hashes every other tier uses. Any decode fleet
+        pointed at the same object root then admits the prefix through
+        the existing cascade, priced by its own measured AdmissionGate
+        crossover — no new decode path, no handoff stream.
+
+        The device gather dispatches on the loop (ordered before any
+        later donated KV update by the single device stream); the host
+        fetch, npz pack, and object puts run off-thread (DL001: file
+        I/O never rides the engine loop). Already-resident objects are
+        skipped (content-addressed no-op). Returns blocks published."""
+        rs = self.remote_store
+        if rs is None or rs.object is None:
+            return 0
+        pool = self.kv_manager.pool
+        # longest still-registered run of the chain, with refcount holds
+        # so the blocks cannot be evicted under the gather (works on both
+        # the Python and the native C++ pool)
+        bids = pool.match_prefix(seq.sequence_hashes)
+        if not bids:
+            return 0
+        entries = [(bids[j], seq.sequence_hashes[j], seq.block_hashes[j],
+                    seq.sequence_hashes[j - 1] if j > 0 else None)
+                   for j in range(len(bids))]
+        try:
+            from .block_copy import fetch_wire, gather_blocks_dispatch
+            stacked = gather_blocks_dispatch(
+                self.kv, [bid for bid, _h, _t, _p in entries],
+                self.cfg.kv_block_size)
+
+            def publish_all() -> int:
+                values = fetch_wire(stacked, len(entries),
+                                    self.wire_kv_heads)
+                n = 0
+                for i, (_bid, h, th, ph) in enumerate(entries):
+                    if rs.object.contains(h):
+                        continue           # content-addressed no-op
+                    rs.put(h, {k: np.ascontiguousarray(v[:, :, i])
+                               for k, v in values.items()},
+                           tokens_hash=th, parent_hash=ph)
+                    n += 1
+                return n
+
+            n = await asyncio.to_thread(publish_all)
+        finally:
+            pool.release(bids)
+        self.prefill_published_blocks += n
+        return n
+
     def _publish_tier_removed(self, seq_hash: int) -> None:
         """Removed-from-disk announce, suppressed while any warmer OR
         colder tier still holds the hash (the router would otherwise
@@ -1577,6 +1635,12 @@ class EngineCore:
         trace_ctx = (req.trace.wire_context()
                      if req.trace is not None else None)
 
+        # the recorder's kv_remote_restore event ships the FETCHED bytes
+        # (the fleet-shared tier cannot be re-walked by a follower);
+        # captured here only when a recorder is attached — otherwise the
+        # bulk values are dropped as soon as they are scattered
+        rec_remote: dict = {}
+
         async def prepare() -> None:
             prepped = None
             _t_prep0 = time.monotonic()
@@ -1602,8 +1666,11 @@ class EngineCore:
                         # a cold prefill)
                         _t = time.monotonic()
                         try:
-                            parts.append(remote.fetch(plan.remote_hashes,
-                                                      trace_ctx=trace_ctx))
+                            fetched = remote.fetch(plan.remote_hashes,
+                                                   trace_ctx=trace_ctx)
+                            parts.append(fetched)
+                            if self.recorder is not None:
+                                rec_remote["values"] = fetched
                         except Exception:  # noqa: BLE001
                             logger.warning(
                                 "remote KV fetch of %d block(s) failed "
@@ -1659,7 +1726,8 @@ class EngineCore:
                 # multihost follower's mirror restore would read the
                 # clobbered slot (the leader scatters prefetched values
                 # and would not notice the divergence)
-                self._onboards.append((req, slot, plan, prepped))
+                self._onboards.append((req, slot, plan, prepped,
+                                       rec_remote.get("values")))
                 self._work_event.set()
 
         task = asyncio.get_running_loop().create_task(
@@ -1669,7 +1737,7 @@ class EngineCore:
 
     def _complete_onboards(self) -> None:
         pending, self._onboards = self._onboards, []
-        for req, slot, plan, prepped in pending:
+        for req, slot, plan, prepped, remote_values in pending:
             self.slots[slot] = None       # _admit_with_plan re-reserves
             try:
                 if req.cancelled or prepped is None:
@@ -1678,7 +1746,8 @@ class EngineCore:
                         req, FinishReason.CANCELLED if req.cancelled
                         else FinishReason.ERROR)
                     continue
-                self._admit_with_plan(req, slot, plan, prepped)
+                self._admit_with_plan(req, slot, plan, prepped,
+                                      remote_values=remote_values)
             finally:
                 # _start_onboard pinned these; safe to evict only now
                 # that hit_transfer (if any) is on the stream. A failed
@@ -1691,7 +1760,7 @@ class EngineCore:
                     self.remote_store.unpin(plan.remote_hashes)
 
     def _admit_with_plan(self, req: EngineRequest, slot: int, plan,
-                         onboard) -> bool:
+                         onboard, remote_values=None) -> bool:
         n_prompt = len(req.prompt)
         _t_admit = time.monotonic()
         if req.trace is not None:
@@ -1737,15 +1806,30 @@ class EngineCore:
             n_host = len(plan.host_slots)
             n_hd = n_host + len(plan.disk_hashes)
             if plan.remote_hashes:
-                # no remote_* fields on the record: the fabric is
-                # leader-only (the object store / peer fleet is shared,
-                # not per-rank state), so a remote-assisted admission
-                # cannot be replayed on a follower mirror — refuse at
-                # the stream source instead of diverging silently
-                raise RuntimeError(
-                    "remote (G4) KV onboarding is not supported on a "
-                    "recorded/multihost engine — disable the fabric or "
-                    "the recorder")
+                # fleet-shared (G4) tier: followers never run the
+                # admission cascade, so a remote-assisted admission
+                # streams as its OWN event carrying the fetched hashes
+                # AND the fetched bytes — recorded BEFORE hit_transfer
+                # so the replayed restore marks the remote targets
+                # written before the hit walk reads them. Followers and
+                # the offline replayer scatter the literal bytes
+                # (replay.exec_kv_remote_restore_event); a follower
+                # whose OWN remote store holds the hashes may fetch
+                # them instead (fetch-or-bytes — the object tier is
+                # content-addressed, so the bytes are identical by
+                # construction). This retired the round-6 refusal.
+                if remote_values is None:
+                    raise RuntimeError(
+                        "recorded remote onboarding without captured "
+                        "fetch values — prep/recorder wiring drifted")
+                self.recorder.rec(
+                    "kv_remote_restore", rid=req.rid,
+                    remote_hashes=list(plan.remote_hashes),
+                    remote_targets=list(
+                        plan.new_blocks[n_hd:n_hd
+                                        + len(plan.remote_hashes)]),
+                    values={k: np.asarray(v)
+                            for k, v in remote_values.items()})
             self.recorder.rec("hit_transfer", rid=req.rid,
                               hit=req.prefix_hit_tokens,
                               host_hit=plan.host_hit_tokens,

@@ -78,6 +78,16 @@ it verbatim to its OWN local disk store from the staged bytes
 (DiskKvStore.apply_put — no LRU policy re-run, no bulk KV on the wire).
 A disk-promoted admission rides "hit_transfer"'s disk_hashes/
 disk_targets, restored from the follower's mirror disk store.
+
+The remote (G4) fleet tier closed the LAST tier refusal (round 12):
+the object store / peer fleet is shared state no follower can re-walk,
+so a remote-assisted admission streams as "kv_remote_restore" — the
+fetched hashes plus the fetched BYTES — ordered before its
+hit_transfer; the follower scatters the literal bytes with the same
+program the leader ran (replay.exec_kv_remote_restore_event). A
+follower whose own remote store shares the leader's content-addressed
+object root may fetch the hashes instead of reading the event's bytes
+(fetch-or-bytes): equal hash ⇒ equal bytes by construction.
 """
 
 from __future__ import annotations
@@ -101,8 +111,8 @@ __all__ = ["DispatchStreamLeader", "connect_follower", "run_follower"]
 # host bookkeeping
 WIRE_EVENTS = frozenset(
     {"prefill", "prefill_sp", "dispatch", "hit_transfer",
-     "kv_store", "kv_disk_store", "precomputed_admit",
-     "precomputed_device_admit", "handoff_gather",
+     "kv_store", "kv_disk_store", "kv_remote_restore",
+     "precomputed_admit", "precomputed_device_admit", "handoff_gather",
      "prefill_unsupported"})
 _SHUTDOWN = {"ev": "__shutdown__"}
 
@@ -258,7 +268,8 @@ def run_follower(core, sock: socket.socket,
     carry (``core.kv``) and a bounded chain window.
     """
     from .replay import (exec_dispatch_event, exec_host_restore_event,
-                         exec_kv_disk_store_event, exec_kv_store_event,
+                         exec_kv_disk_store_event,
+                         exec_kv_remote_restore_event, exec_kv_store_event,
                          exec_prefill_event, exec_ragged_event,
                          exec_sp_prefill_event, exec_verify_event)
 
@@ -373,6 +384,18 @@ def run_follower(core, sock: socket.socket,
                                      core.kv_manager.host_pool,
                                      spill_stage)
             stats["kv_disk_stores"] = stats.get("kv_disk_stores", 0) + 1
+            continue
+        if kind == "kv_remote_restore":
+            # remote (G4) tier restore: scatter the leader's fetched
+            # bytes (or fetch the hashes from OUR remote store when the
+            # event omitted them and this rank shares the leader's
+            # content-addressed object root) into the same device
+            # targets — shared with the offline replayer
+            # (replay.exec_kv_remote_restore_event)
+            core.kv = exec_kv_remote_restore_event(
+                core.kv, ev, core.cfg.kv_block_size,
+                remote_store=core.remote_store)
+            stats["remote_restores"] = stats.get("remote_restores", 0) + 1
             continue
         if kind == "hit_transfer":
             if (int(ev.get("host_hit", 0)) > 0

@@ -147,6 +147,33 @@ def exec_kv_disk_store_event(ev: dict, disk_store, pool,
                              tokens_hash=th, parent_hash=ph)
 
 
+def exec_kv_remote_restore_event(kv, ev: dict, block_size: int,
+                                 remote_store=None):
+    """Re-execute a remote (G4) tier restore: scatter the leader's
+    FETCHED bytes into the same device targets with the same program
+    the leader's admission ran. Single home of the kv_remote_restore
+    event (offline replayer + live multihost follower).
+
+    Fetch-or-bytes: the event normally carries ``values`` (the stacked
+    wire dict the leader fetched — the fleet-shared tier cannot be
+    re-walked per rank); when absent, the hashes are fetched from
+    ``remote_store`` instead — correct whenever the store shares the
+    leader's content-addressed object root, where equal hash ⇒ equal
+    bytes by construction. Returns the new kv."""
+    from .block_copy import prep_host_values, scatter_prepped
+
+    vals = ev.get("values")
+    if vals is None:
+        if remote_store is None:
+            raise ValueError(
+                "kv_remote_restore carries no values and no remote "
+                "store was provided — replay with the recorded engine "
+                "config (kv_remote_dir) or a bytes-mode recording")
+        vals = remote_store.fetch(list(ev["remote_hashes"]))
+    ids, pv = prep_host_values(list(ev["remote_targets"]), vals)
+    return scatter_prepped(kv, ids, pv, block_size)
+
+
 def exec_host_restore_event(kv, ev: dict, pool, block_size: int,
                             disk_store=None):
     """Re-execute a host/disk-tier h2d restore from the mirror tiers:
@@ -407,6 +434,18 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
             if disk_mirror is None:
                 disk_mirror = _MemDiskMirror()
             exec_kv_disk_store_event(ev, disk_mirror, mirror, spill_stage)
+        if kind == "kv_remote_restore":
+            # remote (G4) tier restore: scatter the leader's fetched
+            # bytes (carried on the event — the fleet-shared tier is not
+            # per-rank replayable) into the same targets; ordered BEFORE
+            # the admission's hit_transfer, so the restored blocks gain
+            # their in-log writer before the hit walk below reads them
+            kv = exec_kv_remote_restore_event(kv, ev, bs,
+                                              remote_store=core.remote_store)
+            written.update(int(b) * bs + o
+                           for b in ev["remote_targets"]
+                           for o in range(bs))
+            fp(("kv_remote_restore", ev.get("rid")))
         if kind == "hit_transfer" and int(ev.get("hit", 0)) > 0:
             if int(ev.get("disk_hit", 0)) > 0:
                 if disk_mirror is None:

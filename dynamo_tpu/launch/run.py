@@ -185,6 +185,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "prefill queue")
     p.add_argument("--is-prefill-worker", action="store_true",
                    help="serve the prefill side of disaggregation")
+    p.add_argument("--role", choices=["serve", "prefill-publish"],
+                   default="serve",
+                   help="prefill-publish: prefill-as-a-service worker "
+                        "(components/prefill_service.py) — pull the "
+                        "prefill_publish queue + answer publish RPCs, "
+                        "run prefill, publish prefix KV to the shared "
+                        "object tier (--kv-remote-dir) for decode "
+                        "fleets anywhere to admit via their measured "
+                        "fetch-vs-recompute crossover")
     p.add_argument("--max-local-prefill-length", type=int, default=512)
     p.add_argument("--unconditional-disagg", action="store_true",
                    help="always prefill remotely (skip the threshold)")
@@ -682,6 +691,41 @@ async def run_prefill_worker(args, core, runtime) -> None:
         await worker.stop()
 
 
+async def run_prefill_publish(args, core, runtime, src: str) -> None:
+    """--role prefill-publish: the prefill-as-a-service worker
+    (components/prefill_service.py). Serves publish/status RPCs at a
+    discoverable endpoint (in=dyn://… or the default
+    dyn://{ns}/prefill/prefill_publish) and pulls the shared
+    prefill_publish work queue; published prefix KV lands in the
+    --kv-remote-dir object tier for any decode fleet to admit."""
+    from ..components.prefill_service import (PREFILL_PUBLISH_ENDPOINT,
+                                              PrefillService)
+    from ..runtime.distributed import Endpoint
+    try:
+        svc = await PrefillService(core, runtime).start()
+    except ValueError as e:
+        raise SystemExit(str(e))
+    if src.startswith("dyn://") or src.count(".") == 2:
+        endpoint = Endpoint.parse_path(runtime, src)
+    else:
+        endpoint = Endpoint(runtime, args.namespace, "prefill",
+                            PREFILL_PUBLISH_ENDPOINT)
+
+    def stats_handler():
+        d = core.metrics().to_dict()
+        d.update(svc.stats())
+        return d
+
+    await endpoint.serve(svc, decode_req=lambda raw: json.loads(raw),
+                         stats_handler=stats_handler)
+    logger.info("prefill-publish worker serving %s (object root %s)",
+                endpoint.path, core.cfg.kv_remote_dir)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await svc.stop()
+
+
 async def amain(argv=None) -> None:
     args = build_parser().parse_args(argv)
     from ..runtime.log import setup_logging
@@ -746,6 +790,11 @@ async def amain(argv=None) -> None:
             if core is None:
                 raise SystemExit("--is-prefill-worker requires out=jax")
             await run_prefill_worker(args, core, runtime)
+            return
+        if args.role == "prefill-publish":
+            if core is None:
+                raise SystemExit("--role prefill-publish requires out=jax")
+            await run_prefill_publish(args, core, runtime, src)
             return
         pipeline = link_pipeline(engine, mdc)
         if src == "http":

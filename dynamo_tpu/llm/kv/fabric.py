@@ -28,23 +28,33 @@ What makes it production-shaped rather than a dumb cache:
   tier-discounted overlap instead of chasing overlap depth alone.
 
 Wire format: blocks travel as the self-describing npz bytes of
-remotestore.pack_block_bytes, base64-framed over the runtime's JSON
-request plane. (A production deployment would ride the native
-dataplane; the contract — and every test — is transport-agnostic.)
+remotestore.pack_block_bytes over the NATIVE data plane — the request
+plane carries only a small ``fetch_native`` control message naming the
+hashes and a dial-back address; the serving peer then streams each
+block as one length-prefixed two-part frame (csrc/data_plane.cpp via
+runtime/tcp.open_stream_sender: framing + socket writes on a dedicated
+C++ thread, falling through to the pure-asyncio sender with identical
+frames when the toolchain is missing) and the fetching side unpacks the
+raw frame bytes off its event loop. When the native library is absent
+on the serving peer it declines and the fetch gracefully falls back to
+the legacy base64-over-JSON ``fetch`` op (counted in
+``dataplane_fallbacks_total``) — the block payload is byte-identical on
+both paths by construction (tests/test_kv_fabric.py differential).
 """
 
 from __future__ import annotations
 
 import asyncio
-import base64
 import dataclasses
 import json
 import logging
+import os
 import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ...runtime.codec import ConnectionInfo, FrameKind
 from ...runtime.engine import AsyncEngine, Context, ManyOut, ResponseStream
 from .remotestore import (RemoteKvStore, pack_block_bytes,
                           unpack_block_bytes)
@@ -52,18 +62,25 @@ from .remotestore import (RemoteKvStore, pack_block_bytes,
 logger = logging.getLogger("dynamo_tpu.kv.fabric")
 
 __all__ = ["FABRIC_ENDPOINT", "LinkStats", "PeerLinkTable", "AdmissionGate",
-           "PrefillRateEstimator", "KvFabricServer", "KvFabric"]
+           "PrefillRateEstimator", "KvFabricServer", "KvFabric",
+           "dataplane_serving_available"]
 
 FABRIC_ENDPOINT = "kv_fabric"
 PROBE_BYTES = 256 * 1024
+# ops/test lever: DYN_KV_FABRIC_DATAPLANE=0 forces the JSON fallback on
+# both sides (the differential test drives each path deliberately)
+DATAPLANE_ENV = "DYN_KV_FABRIC_DATAPLANE"
 
 
-def _b64(b: bytes) -> str:
-    return base64.b64encode(b).decode()
-
-
-def _unb64(s: str) -> bytes:
-    return base64.b64decode(s)
+def dataplane_serving_available() -> bool:
+    """Whether THIS process can serve native-dataplane fetches: the env
+    gate is on and the C++ data plane (csrc/data_plane.cpp) loads. A
+    peer where either fails declines ``fetch_native`` and the fetching
+    side falls back to the JSON path — never an error."""
+    if os.environ.get(DATAPLANE_ENV, "1") == "0":
+        return False
+    from ...runtime.native_tcp import load_data_plane_lib
+    return load_data_plane_lib() is not None
 
 
 # ---------------------------------------------------------------------------
@@ -289,18 +306,29 @@ class KvFabricServer(AsyncEngine):
     - ``probe``: echo ``nbytes`` of payload — the client times the round
       trip to measure RTT (nbytes=0) and bandwidth (nbytes large).
     - ``match``: which of ``hashes`` this worker can serve.
-    - ``fetch``: the blocks themselves, packed npz + base64, disk tier
-      preferred (pinned across the read), host tier fallback. Missing
-      hashes are reported, never fatal — the caller recomputes.
+    - ``fetch_native``: the DEFAULT block transport — the request names
+      the hashes plus the caller's dial-back ``conn`` (its process
+      stream server, runtime/tcp.TcpStreamServer); the blocks stream
+      back as raw length-prefixed two-part frames on the native data
+      plane (csrc/data_plane.cpp), one DATA frame per block with the
+      hash in the JSON header and the npz bytes as the data part —
+      no base64, no JSON in the bulk path. A peer without the native
+      lib (or with DYN_KV_FABRIC_DATAPLANE=0) declines with
+      ``fallback`` and the caller retries over ``fetch``.
+    - ``fetch``: the JSON fallback — packed npz, base64-framed in the
+      response dict. Byte-identical payloads to the native path.
 
-    File reads run off-thread; the serving loop never blocks on I/O
-    (the disk tier's loop-stall contract extended to serving peers)."""
+    Missing hashes are reported, never fatal — the caller recomputes.
+    File reads and frame unpacks run off-thread; the serving loop never
+    blocks on I/O (the disk tier's loop-stall contract extended to
+    serving peers)."""
 
     def __init__(self, core):
         self.core = core
         self.fetches_served = 0
         self.blocks_served = 0
         self.probes_served = 0
+        self.dataplane_fetches_served = 0
 
     def _read_block(self, seq_hash: int) -> Optional[bytes]:
         """One packed block from the coldest-first local tiers (runs in a
@@ -338,28 +366,66 @@ class KvFabricServer(AsyncEngine):
         return ((disk is not None and disk.contains(seq_hash))
                 or (host is not None and host.contains(seq_hash)))
 
+    def _read_all(self, hashes: Sequence[int]):
+        """Packed bytes per hash (worker thread) → ({hash: bytes},
+        [missing]). Shared by both transports — byte-identical payloads
+        by construction."""
+        blocks, missing = {}, []
+        for h in hashes:
+            data = self._read_block(h)
+            if data is None:
+                missing.append(h)
+            else:
+                blocks[h] = data
+        return blocks, missing
+
+    async def _stream_native(self, conn: dict, hashes: Sequence[int],
+                             blocks: Dict[int, bytes]) -> bool:
+        """Dial the caller back and stream one two-part frame per block
+        over the native data plane (open_stream_sender picks the C++
+        sender; identical frames from the asyncio sender otherwise).
+        Returns False when the dial-back itself failed — the caller
+        falls back to the JSON path; a mid-stream failure surfaces to
+        the caller as a torn stream (→ recompute), never an error."""
+        from ...runtime.tcp import open_stream_sender
+        try:
+            sender = await open_stream_sender(
+                ConnectionInfo.from_dict(conn), timeout=5.0)
+        except Exception:  # noqa: BLE001 — caller's server unreachable
+            logger.warning("fabric dataplane dial-back to %s failed",
+                           conn.get("address"), exc_info=True)
+            return False
+        try:
+            for h in hashes:
+                await sender.send(blocks[h],
+                                  header=json.dumps({"h": int(h)}).encode())
+            await sender.finish()
+        except Exception as e:  # noqa: BLE001 — torn stream: caller recomputes
+            logger.warning("fabric dataplane stream failed mid-fetch: %s", e)
+            try:
+                await sender.finish(error=str(e))
+            except Exception:  # noqa: BLE001
+                pass
+        return True
+
     async def _handle(self, d: dict) -> dict:
+        import base64
         op = d.get("op")
         if op == "probe":
             self.probes_served += 1
             n = int(d.get("nbytes", 0))
-            return {"ok": True, "payload": _b64(b"\0" * n)}
+            return {"ok": True, "payload": "0" * n}
         if op == "match":
             hashes = [int(h) for h in d.get("hashes", [])]
             return {"ok": True,
                     "resident": [self._serveable(h) for h in hashes]}
-        if op == "fetch":
+        if op in ("fetch", "fetch_native"):
             hashes = [int(h) for h in d.get("hashes", [])]
-
-            def read_all():
-                blocks, missing = {}, []
-                for h in hashes:
-                    data = self._read_block(h)
-                    if data is None:
-                        missing.append(h)
-                    else:
-                        blocks[str(h)] = _b64(data)
-                return blocks, missing
+            native = (op == "fetch_native")
+            if native and not await asyncio.to_thread(
+                    dataplane_serving_available):
+                # lib absent / env-gated: decline, the caller rides JSON
+                return {"ok": True, "fallback": "json"}
 
             # the requesting worker forwarded its request's TraceContext:
             # serve the fetch under a CHILD trace so the peer-side read
@@ -370,15 +436,34 @@ class KvFabricServer(AsyncEngine):
                 with use_trace(Trace.from_wire(
                         tctx, tctx.get("trace_id", "?"),
                         role="kv_peer")) as ptrace:
-                    with ptrace.span("fabric.fetch", blocks=len(hashes)):
-                        blocks, missing = await asyncio.to_thread(read_all)
+                    with ptrace.span("fabric.fetch", blocks=len(hashes),
+                                     dataplane=native):
+                        blocks, missing = await asyncio.to_thread(
+                            self._read_all, hashes)
                     if missing:
                         ptrace.event("fabric.missing", n=len(missing))
             else:
-                blocks, missing = await asyncio.to_thread(read_all)
+                blocks, missing = await asyncio.to_thread(
+                    self._read_all, hashes)
+            if missing:
+                # caller recomputes; nothing streams (native included)
+                return {"ok": True, "blocks": {}, "missing": missing}
+            if native:
+                if not await self._stream_native(d.get("conn") or {},
+                                                 hashes, blocks):
+                    return {"ok": True, "fallback": "json"}
+                self.fetches_served += 1
+                self.dataplane_fetches_served += 1
+                self.blocks_served += len(blocks)
+                return {"ok": True, "dataplane": True,
+                        "blocks": len(blocks), "missing": []}
             self.fetches_served += 1
             self.blocks_served += len(blocks)
-            return {"ok": True, "blocks": blocks, "missing": missing}
+            # bulk base64 is CPU work — encode off the serving loop
+            enc = await asyncio.to_thread(
+                lambda: {str(h): base64.b64encode(b).decode()
+                         for h, b in blocks.items()})
+            return {"ok": True, "blocks": enc, "missing": []}
         return {"ok": False, "error": f"unknown fabric op {op!r}"}
 
     async def generate(self, request) -> ManyOut:
@@ -408,7 +493,8 @@ class KvFabric:
     FETCH_TIMEOUT_S = 60.0
 
     def __init__(self, store: RemoteKvStore, links: PeerLinkTable,
-                 gate: AdmissionGate, worker_id: Optional[int] = None):
+                 gate: AdmissionGate, worker_id: Optional[int] = None,
+                 runtime=None):
         self.store = store
         self.links = links
         self.gate = gate
@@ -416,10 +502,17 @@ class KvFabric:
         self.server: Optional[KvFabricServer] = None
         self.client = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._runtime = runtime       # dial-back stream server for fetches
         self._sub = None
         self._tasks: List[asyncio.Task] = []
         self._known_peers: set = set()
         self.peer_fetches_total = 0
+        # native-dataplane fetch accounting (the nv_llm_kv_remote_
+        # dataplane_* gauge feeds): fallbacks = fetches that had to ride
+        # the JSON path because the peer declined (lib absent/env off)
+        self.dataplane_fetches_total = 0
+        self.dataplane_fallbacks_total = 0
+        self.use_dataplane = os.environ.get(DATAPLANE_ENV, "1") != "0"
         store.peer_fetch = self.fetch_sync
         store.admission = self._admit
 
@@ -444,7 +537,7 @@ class KvFabric:
             block_size=core.cfg.kv_block_size,
             prefill_tok_per_s=core.measured_prefill_tok_per_s,
             mode=core.cfg.kv_remote_admission)
-        self = cls(store, links, gate)
+        self = cls(store, links, gate, runtime=runtime)
         self._loop = asyncio.get_running_loop()
 
         # serve our blocks to the fleet
@@ -535,26 +628,84 @@ class KvFabric:
                     nbytes: int = PROBE_BYTES) -> LinkStats:
         """Measure the peer's link at attach: a zero-payload round trip
         for RTT, then a bulk echo for bandwidth. Decay-averaged into the
-        link table (later real transfers keep refining it)."""
+        link table (later real transfers — which ride the data plane —
+        keep refining it toward the link fetches actually see)."""
         t0 = time.monotonic()
         await self._call(worker_id, {"op": "probe", "nbytes": 0})
         self.links.observe_rtt(worker_id, time.monotonic() - t0)
         t0 = time.monotonic()
         r = await self._call(worker_id, {"op": "probe", "nbytes": nbytes})
         dt = time.monotonic() - t0
-        got = len(_unb64(r.get("payload", "")))
+        got = len(r.get("payload", ""))
         self.links.observe_transfer(worker_id, got, dt)
         return self.links.get(worker_id)
 
     # ------------------------------------------------------------- fetches
-    async def fetch_async(self, worker_id: int, seq_hashes: Sequence[int],
-                          trace_ctx: Optional[dict] = None) -> dict:
-        """One peer RPC for a run of blocks → stacked wire values
-        ({key: [L, H, n, bs, D]}). KeyError when the peer cannot serve
-        every requested hash (evicted since the announce) — the
-        graceful-fallback signal. ``trace_ctx`` (TraceContext dict)
-        rides the RPC so the peer serves under a child trace."""
-        t0 = time.monotonic()
+    async def _fetch_blobs_native(self, worker_id: int,
+                                  seq_hashes: Sequence[int],
+                                  trace_ctx: Optional[dict] = None
+                                  ) -> Optional[List[bytes]]:
+        """Native-dataplane fetch: register a dial-back stream on this
+        process's TcpStreamServer, send the control RPC, drain one
+        two-part frame per block. Returns the packed bytes in request
+        order; None when the peer DECLINED (lib absent / env off — the
+        caller falls back to JSON); KeyError on missing hashes or a
+        torn/timed-out stream (the caller recomputes)."""
+        rt = self._runtime
+        if rt is None:
+            return None
+        await rt.tcp.start()
+        rx = rt.tcp.register()
+        try:
+            payload = {"op": "fetch_native",
+                       "hashes": [int(h) for h in seq_hashes],
+                       "conn": rt.tcp.connection_info(rx).to_dict()}
+            if trace_ctx:
+                payload["trace"] = trace_ctx
+            r = await self._call(worker_id, payload, trace_ctx=trace_ctx)
+            if r.get("missing"):
+                raise KeyError(f"peer {worker_id:x} no longer holds "
+                               f"{len(r['missing'])} requested block(s)")
+            if not r.get("dataplane"):
+                return None               # peer declined → JSON fallback
+            by_hash: Dict[int, bytes] = {}
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + self.FETCH_TIMEOUT_S
+            while True:
+                f = await rx.next_frame(
+                    timeout=max(deadline - loop.time(), 0.001))
+                if f is None:
+                    raise KeyError(
+                        f"dataplane fetch from peer {worker_id:x} timed "
+                        f"out after {self.FETCH_TIMEOUT_S:.0f}s")
+                if f.kind == FrameKind.DATA:
+                    by_hash[int(f.header_json()["h"])] = f.data
+                elif f.kind == FrameKind.SENTINEL:
+                    break
+                elif f.kind == FrameKind.ERROR:
+                    raise KeyError(
+                        f"dataplane fetch from peer {worker_id:x} tore "
+                        f"mid-stream: "
+                        f"{f.header_json().get('error', 'stream error')}")
+            try:
+                blobs = [by_hash[int(h)] for h in seq_hashes]
+            except KeyError:
+                raise KeyError(
+                    f"dataplane fetch from peer {worker_id:x} ended "
+                    f"with {len(by_hash)}/{len(seq_hashes)} block frames")
+            self.dataplane_fetches_total += 1
+            return blobs
+        finally:
+            rx.close()
+            rt.tcp.unregister(rx.stream_id)
+
+    async def _fetch_blobs_json(self, worker_id: int,
+                                seq_hashes: Sequence[int],
+                                trace_ctx: Optional[dict] = None
+                                ) -> List[bytes]:
+        """Legacy request-plane fetch (base64-framed JSON) — the
+        graceful fallback when the peer lacks the native data plane."""
+        import base64
         payload = {"op": "fetch",
                    "hashes": [int(h) for h in seq_hashes]}
         if trace_ctx:
@@ -563,7 +714,33 @@ class KvFabric:
         if r.get("missing"):
             raise KeyError(f"peer {worker_id:x} no longer holds "
                            f"{len(r['missing'])} requested block(s)")
-        blobs = [_unb64(r["blocks"][str(int(h))]) for h in seq_hashes]
+        blocks = r["blocks"]
+        return await asyncio.to_thread(
+            lambda: [base64.b64decode(blocks[str(int(h))])
+                     for h in seq_hashes])
+
+    async def fetch_async(self, worker_id: int, seq_hashes: Sequence[int],
+                          trace_ctx: Optional[dict] = None) -> dict:
+        """One peer fetch for a run of blocks → stacked wire values
+        ({key: [L, H, n, bs, D]}). Block bytes ride the native data
+        plane by default (length-prefixed binary frames, zero-copy
+        unpack off the loop); a peer without the native lib serves the
+        base64-over-JSON fallback with byte-identical payloads.
+        KeyError when the peer cannot serve every requested hash
+        (evicted since the announce) or the stream tears — the
+        graceful-fallback-to-recompute signal. ``trace_ctx``
+        (TraceContext dict) rides the RPC so the peer serves under a
+        child trace."""
+        t0 = time.monotonic()
+        blobs = None
+        if self.use_dataplane:
+            blobs = await self._fetch_blobs_native(worker_id, seq_hashes,
+                                                   trace_ctx)
+            if blobs is None:
+                self.dataplane_fallbacks_total += 1
+        if blobs is None:
+            blobs = await self._fetch_blobs_json(worker_id, seq_hashes,
+                                                 trace_ctx)
         self.links.observe_transfer(worker_id, sum(len(b) for b in blobs),
                                     time.monotonic() - t0)
         self.peer_fetches_total += 1
@@ -617,6 +794,9 @@ class KvFabric:
             "remote_admission_rejects_total": s.admission_rejects_total,
             "remote_link_gbps": self.links.avg_gbps(),
             "remote_link_rtt_s": self.links.avg_rtt_s(),
+            "remote_dataplane_fetches_total": self.dataplane_fetches_total,
+            "remote_dataplane_fallbacks_total":
+                self.dataplane_fallbacks_total,
         }
 
     async def close(self) -> None:

@@ -124,6 +124,16 @@ class ForwardPassMetrics:
     # non-ragged engines.
     ragged_prefetch_hit_ratio: float = 0.0
     ragged_spec_rows_total: int = 0
+    # prefill-as-a-service over the native KV dataplane round 12
+    # (appended — DL004 append-only evolution): fetches that rode the
+    # native data plane vs the base64-over-JSON fallback (llm/kv/
+    # fabric.py — a rising fallback rate means peers without the C++
+    # toolchain), and the prefix blocks this worker published to the
+    # durable object tier as a prefill-publish worker
+    # (components/prefill_service.py). Zeros on old payloads.
+    remote_dataplane_fetches_total: int = 0
+    remote_dataplane_fallbacks_total: int = 0
+    prefill_published_blocks_total: int = 0
 
     def to_dict(self) -> dict:
         # every field is a scalar; dataclasses.asdict would deep-copy

@@ -26,8 +26,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["RemotePrefillRequest", "KvPayload", "KV_CHUNK_BYTES",
-           "encode_kv_payload", "decode_kv_payload"]
+__all__ = ["RemotePrefillRequest", "PrefillPublishRequest", "KvPayload",
+           "KV_CHUNK_BYTES", "encode_kv_payload", "decode_kv_payload"]
 
 # One KV handoff can be GBs for long prompts (a Llama-8B-class model is
 # ~128 KB of K+V per token); split it across frames so no single frame
@@ -63,6 +63,32 @@ class RemotePrefillRequest:
 
     @classmethod
     def from_json(cls, raw: bytes) -> "RemotePrefillRequest":
+        return cls(**json.loads(raw))
+
+
+@dataclasses.dataclass
+class PrefillPublishRequest:
+    """One unit of work on the prefill-PUBLISH queue (components/
+    prefill_service.py): run prefill and publish the prompt's prefix KV
+    to the shared object tier — no per-request decode sink, no handoff
+    stream. Decode fleets anywhere admit the published prefix through
+    the remote (G4) cascade, priced by their own AdmissionGate
+    crossover."""
+
+    request_id: str
+    token_ids: List[int]
+    # SlotSampling fields for the single sampled token (the publish
+    # worker samples one token like any prefill; callers usually leave
+    # the default greedy)
+    sampling: Dict = dataclasses.field(default_factory=dict)
+    # distributed-tracing propagation (see RemotePrefillRequest.trace)
+    trace: Optional[Dict] = None
+
+    def to_json(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "PrefillPublishRequest":
         return cls(**json.loads(raw))
 
 

@@ -154,11 +154,13 @@ class SimActuator(PlannerActuator):
     async def scale_up(self, role: str, count: int) -> None:
         self.fleet.log.log("planner_scale_up", role=role, count=count)
         for _ in range(count):
-            self.fleet.schedule_spawn(self.fleet.cfg.new_worker_profile)
+            self.fleet.schedule_spawn(self.fleet.cfg.new_worker_profile,
+                                      prefill=(role == "prefill"))
 
     async def retire(self, role: str, worker_id: int) -> None:
         self.fleet.log.log("planner_retire", role=role, worker=worker_id)
-        w = self.fleet.workers.get(worker_id)
+        w = (self.fleet.workers.get(worker_id)
+             or self.fleet.prefill_workers.get(worker_id))
         if w is not None and not w.dead:
             w.exit(clean=False)
 
@@ -218,6 +220,7 @@ class SimFleet:
         self.itl_ms: List[float] = []
         self.kv_events = 0
         self.replica_peak = 0
+        self.prefill_peak = 0
 
     # ------------------------------------------------------------ wiring
     def spawn(self, coro) -> asyncio.Task:
@@ -254,12 +257,18 @@ class SimFleet:
                 self.cfg.slo.max_local_prefill_length
                 if self.cfg.slo else 512))
         await self.disagg_router.start()
-        # drain watch: ONE fleet-level watcher dispatching to workers
-        # (the worker-side half of the planner's drain protocol)
+        # drain watch: ONE fleet-level watcher per tier dispatching to
+        # workers (the worker-side half of the planner's drain protocol;
+        # the prefill tier drains through its own endpoint's keys)
         w = await store.watch_prefix(self.endpoint.drain_prefix())
         self._watchers.append(w)
-        self._tasks.append(loop.create_task(self._drain_watch(w),
-                                            name="sim-drain-watch"))
+        self._tasks.append(loop.create_task(
+            self._drain_watch(w, self.workers), name="sim-drain-watch"))
+        wp = await store.watch_prefix(self.prefill_endpoint.drain_prefix())
+        self._watchers.append(wp)
+        self._tasks.append(loop.create_task(
+            self._drain_watch(wp, self.prefill_workers),
+            name="sim-prefill-drain-watch"))
         # retune observability: log threshold changes into the event log
         w2 = await store.watch_prefix(disagg_config_key(self.cfg.model_name))
         self._watchers.append(w2)
@@ -276,6 +285,9 @@ class SimFleet:
                 slo=self.cfg.slo, config=self.cfg.planner_cfg,
                 prefill_queue=(self.prefill_queue
                                if self.cfg.prefill_replicas > 0 else None),
+                prefill_endpoint=(self.prefill_endpoint
+                                  if self.cfg.prefill_replicas > 0
+                                  else None),
                 model_name=(self.cfg.model_name
                             if self.cfg.prefill_replicas > 0 else None),
                 traces=lambda: [], collector=self.collector)
@@ -325,19 +337,26 @@ class SimFleet:
         (self.prefill_workers if prefill else self.workers)[wid] = w
         self.counters["spawned"] += 1
         self.replica_peak = max(self.replica_peak, self.live_decode_count())
+        self.prefill_peak = max(self.prefill_peak,
+                                self.live_prefill_count())
         self.log.log("worker_up", worker=wid, prefill=prefill,
                      profile=w.profile.name)
         if prefill:
             self._pump_prefill_queue()
         return w
 
-    def schedule_spawn(self, profile: str = "") -> None:
+    def schedule_spawn(self, profile: str = "",
+                       prefill: bool = False) -> None:
         asyncio.get_running_loop().call_later(
             self.cfg.provision_delay_s,
-            lambda: self.spawn(self._spawn_worker(profile=profile)))
+            lambda: self.spawn(self._spawn_worker(profile=profile,
+                                                  prefill=prefill)))
 
     def live_decode_count(self) -> int:
         return sum(1 for w in self.workers.values() if not w.dead)
+
+    def live_prefill_count(self) -> int:
+        return sum(1 for w in self.prefill_workers.values() if not w.dead)
 
     def on_worker_exit(self, w: SimWorker, clean: bool) -> None:
         self.draining.discard(w.worker_id)
@@ -367,7 +386,8 @@ class SimFleet:
         self.draining.add(w.worker_id)
         self.log.log("drain_begin", worker=w.worker_id)
 
-    async def _drain_watch(self, watcher) -> None:
+    async def _drain_watch(self, watcher, pool: Dict[int, SimWorker]
+                           ) -> None:
         from ..runtime.tracing import detach_trace
         detach_trace()
         async for ev in watcher:
@@ -377,7 +397,7 @@ class SimFleet:
                 wid = int(ev.entry.key.rsplit(":", 1)[-1], 16)
             except ValueError:
                 continue
-            w = self.workers.get(wid)
+            w = pool.get(wid)
             if w is not None:
                 w.begin_drain()
 
@@ -652,6 +672,9 @@ class SimFleet:
             "replicas": {"start": self.cfg.replicas,
                          "end": self.live_decode_count(),
                          "peak": self.replica_peak},
+            "prefill_replicas": {"start": self.cfg.prefill_replicas,
+                                 "end": self.live_prefill_count(),
+                                 "peak": self.prefill_peak},
             "latency_ms": {
                 "ttft_p50": percentile(self.ttft_ms, 50),
                 "ttft_p90": percentile(self.ttft_ms, 90),

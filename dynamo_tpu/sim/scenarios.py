@@ -358,6 +358,10 @@ def _disagg_retune(seed: int, replicas: int = 8,
     slo = ServiceLevelObjective(
         ttft_p90_ms=1500.0, itl_p90_ms=500.0, max_queue_depth=2.0,
         min_decode_workers=replicas, max_decode_workers=replicas,
+        # pin the prefill tier: this scenario proves the RETUNE lever,
+        # so the (round-12) prefill-fleet actuator is held at its start
+        # size rather than absorbing the backlog the retune should see
+        min_prefill_workers=2, max_prefill_workers=2,
         max_local_prefill_length=512)
     cfg = FleetConfig(
         replicas=replicas, prefill_replicas=2, slots=4, kv_blocks=512,
@@ -375,6 +379,66 @@ def _disagg_retune(seed: int, replicas: int = 8,
                            agentic_frac=0.1, long_tail_frac=0.0,
                            osl_base=32, osl_spread=64)
     return cfg, wl, (), duration_s
+
+
+def _prefill_storm(seed: int, replicas: int = 10,
+                   duration_s: float = 1400.0):
+    """Prefill-as-a-Service proving ground (ISSUE 12 rung (c)): a
+    prefix-MISS surge — long fresh-session prompts with no reuse —
+    drives the prefill queue while the decode tier stays comfortable;
+    the planner must scale the PREFILL tier out (the new actuator, not
+    the decode one or the retune) and late-window SLO must recover."""
+    slo = ServiceLevelObjective(
+        ttft_p90_ms=4000.0, itl_p90_ms=400.0, max_queue_depth=2.0,
+        # decode pinned: the storm is a prefill-capacity problem
+        min_decode_workers=replicas, max_decode_workers=replicas,
+        min_prefill_workers=2, max_prefill_workers=12,
+        max_local_prefill_length=256)
+    cfg = FleetConfig(
+        replicas=replicas, prefill_replicas=2, slots=4, kv_blocks=512,
+        perf=_perf_small(), slo=slo,
+        # retune_max == the threshold itself: the disagg-retune lever is
+        # deliberately out of headroom, so only the prefill-fleet
+        # actuator can absorb the storm
+        planner_cfg=PlannerConfig(interval_s=2.0, cooldown_s=20.0,
+                                  breach_cycles=3, scale_step=2,
+                                  drain_timeout_s=120.0, drain_poll_s=0.5,
+                                  status_interval_s=10.0,
+                                  retune_max=256),
+        stats_interval_s=2.0, scrape_interval_s=1.0,
+        provision_delay_s=15.0, new_worker_profile="slow-start:20",
+        drainout_s=600.0)
+    # fresh long prompts (agentic_frac=0: every session is new, so the
+    # prefix indexes miss) crossing the 256-token disagg threshold; the
+    # surge quadruples arrivals for ~8 minutes
+    wl = generate_workload(duration_s * 0.7, seed, base_rps=1.0,
+                           peak_rps=1.6, burst_at=240.0, burst_len_s=480.0,
+                           burst_factor=5.0, tenants=16,
+                           agentic_frac=0.0, long_tail_frac=0.0,
+                           isl_base=768, isl_spread=1024,
+                           osl_base=32, osl_spread=64)
+    return cfg, wl, (), duration_s
+
+
+def _check_prefill_storm(fleet: SimFleet, r: dict) -> List[str]:
+    v = []
+    c = r["planner"]["counters"]
+    if r["requests"]["remote_prefills"] < 50:
+        v.append("prefill queue barely exercised — storm never formed")
+    if c.get("prefill_scale_up", 0) < 1:
+        v.append("planner never scaled the prefill tier into the storm")
+    if r["prefill_replicas"]["peak"] <= r["prefill_replicas"]["start"]:
+        v.append("prefill tier did not grow under the surge")
+    if c["scale_up"] != 0:
+        v.append("decode tier scaled — the storm leaked out of the "
+                 "prefill tier (decode is pinned by the SLO bounds)")
+    if r["slo"]["late_attainment"] < 0.85:
+        v.append(f"late-window TTFT attainment "
+                 f"{r['slo']['late_attainment']} < 0.85 — scaling the "
+                 f"prefill tier did not restore SLO")
+    if r["requests"]["dropped"]:
+        v.append(f"dropped {r['requests']['dropped']} requests")
+    return v
 
 
 def _check_disagg_retune(fleet: SimFleet, r: dict) -> List[str]:
@@ -421,6 +485,11 @@ SCENARIOS: Dict[str, Scenario] = {
         "prefill-queue backlog drives the disagg threshold retune, "
         "floored at the fleet fetch-vs-recompute crossover",
         _disagg_retune, _check_disagg_retune),
+    "prefill_storm": Scenario(
+        "prefill_storm",
+        "prefix-miss surge backs up the prefill queue; the planner "
+        "scales the prefill tier out and SLO recovers",
+        _prefill_storm, _check_prefill_storm),
 }
 
 

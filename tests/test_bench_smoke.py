@@ -122,6 +122,16 @@ def test_bench_kv_remote_mode():
     assert kr["measured_link_gbps"] > 0
     assert kr["admission_auto_verdict"] in ("admit", "reject")
     assert kr["predicted_fetch_ms"] > 0
+    # ISSUE 12 satellite: the dataplane-vs-JSON A/B leg — the native
+    # transport moves byte-identical payloads (same count both legs,
+    # JSON's base64 framing inflates its wire bytes) at a wall no worse
+    # than the base64-over-JSON path it replaced
+    assert kr["dataplane_bytes"] == kr["json_bytes"] > 0
+    assert kr["dataplane_fetches_total"] >= 1
+    assert kr["dataplane_fallbacks_total"] == 0
+    assert kr["dataplane_fetch_ms"] <= kr["json_fetch_ms"], (
+        f"native dataplane fetch slower than the JSON fallback: "
+        f"{kr['dataplane_fetch_ms']}ms vs {kr['json_fetch_ms']}ms")
 
 
 @pytest.mark.kvfrag

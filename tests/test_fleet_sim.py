@@ -243,6 +243,32 @@ def test_planner_anti_thrash_under_oscillating_load():
     assert c["evaluations"] > 100
 
 
+def test_prefill_storm_scales_prefill_tier():
+    """ISSUE 12 rung (c): a prefix-miss surge backs up the prefill
+    queue; the planner's NEW prefill-fleet actuator (not the decode
+    one, which is pinned, and not the retune, which is out of headroom)
+    scales the tier out, SLO recovers in the late window, and the tier
+    drains back toward its floor once the storm passes — with the
+    event-log determinism gate preserved."""
+    w0 = REAL_PERF_COUNTER()
+    r = run_scenario("prefill_storm", seed=0)
+    assert REAL_PERF_COUNTER() - w0 < WALL_BUDGET_STORM_S
+    assert r["violations"] == [], r["violations"]
+    c = r["planner"]["counters"]
+    assert c["prefill_scale_up"] >= 1
+    assert c["scale_up"] == 0                 # decode tier untouched
+    assert r["prefill_replicas"]["peak"] > r["prefill_replicas"]["start"]
+    assert r["slo"]["late_attainment"] >= 0.85
+    assert r["requests"]["dropped"] == 0
+    # post-storm: the tier shrank back (drain-based scale-down respects
+    # min_prefill_workers)
+    assert c["prefill_scale_down"] >= 1
+    assert r["prefill_replicas"]["end"] >= 2
+    # determinism: same (scenario, seed) → byte-identical event log
+    r2 = run_scenario("prefill_storm", seed=0)
+    assert r2["event_log_digest"] == r["event_log_digest"]
+
+
 def test_disagg_retune_crossover_floor():
     """Satellite: the planner's disagg retune consumes fleet-level
     fetch-vs-recompute crossover stats end-to-end. A fast fabric

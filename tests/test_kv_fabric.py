@@ -8,13 +8,15 @@ scoring, live tier-weight retune, and the netstore bounded-retry
 satellite."""
 
 import asyncio
+import json
 import os
 import time
 
 import numpy as np
 import pytest
 
-from dynamo_tpu.llm.kv.fabric import (AdmissionGate, KvFabric, LinkStats,
+from dynamo_tpu.llm.kv.fabric import (AdmissionGate, KvFabric,
+                                      KvFabricServer, LinkStats,
                                       PeerLinkTable)
 from dynamo_tpu.llm.kv.remotestore import (FsObjectStore, ObjectKvBackend,
                                            RemoteKvStore, pack_block_bytes,
@@ -249,7 +251,7 @@ def _make_core(disk_dir, **kw):
                       param_dtype=jnp.float32)
 
 
-async def _serve(core, prompt, rid, max_new=4):
+async def _serve_req(core, prompt, rid, max_new=4):
     from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineRequest
     from dynamo_tpu.engine.sampling import SlotSampling
     req = EngineRequest(rid=rid, prompt=list(prompt),
@@ -260,8 +262,13 @@ async def _serve(core, prompt, rid, max_new=4):
     while True:
         item, _ = await asyncio.wait_for(req.out_queue.get(), 60)
         if item is FINISH_SENTINEL:
-            return toks, req.prefix_hit_tokens
+            return toks, req
         toks.append(item)
+
+
+async def _serve(core, prompt, rid, max_new=4):
+    toks, req = await _serve_req(core, prompt, rid, max_new=max_new)
+    return toks, req.prefix_hit_tokens
 
 
 @pytest.fixture
@@ -425,6 +432,244 @@ async def test_disk_eviction_promotes_to_object_store(tmp_path):
     assert any(e.stored is not None and e.stored.tier == "remote"
                for e in events), "device eviction published no remote demote"
     await core.stop()
+
+
+# ------------------------------------------------ native dataplane (ISSUE 12)
+
+
+class _StubFabricServer(KvFabricServer):
+    """A kv_fabric peer serving canned packed bytes — the transport
+    differential/fuzz substrate (no engine, no tiers)."""
+
+    def __init__(self, blobs):
+        super().__init__(core=None)
+        self.blobs = blobs
+
+    def _read_block(self, seq_hash):
+        return self.blobs.get(seq_hash)
+
+    def _serveable(self, seq_hash):
+        return seq_hash in self.blobs
+
+
+async def _client_fabric(daemon, path="dyn://ns/worker/kv_fabric"):
+    """A fetch-side KvFabric wired by hand (no engine): the client half
+    of the transport tests."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime, Endpoint
+    rt = await DistributedRuntime.connect(daemon.address)
+    fab = KvFabric(RemoteKvStore(), PeerLinkTable(),
+                   AdmissionGate(1, 1, 1.0), runtime=rt)
+    fab._loop = asyncio.get_running_loop()
+    fab.client = Endpoint.parse_path(rt, path).client()
+    await fab.client.start()
+    return rt, fab
+
+
+@pytest.mark.asyncio
+async def test_dataplane_vs_json_byte_identical_fuzz(daemon):
+    """ISSUE 12 differential: the native-dataplane fetch returns
+    BYTE-identical block payloads to the base64-over-JSON path, fuzzed
+    over block counts, shapes/dtypes (f32 / bf16 / int8 rows), and run
+    lengths; and unpacking recovers the original arrays exactly."""
+    import ml_dtypes
+    from dynamo_tpu.runtime.distributed import DistributedRuntime, Endpoint
+
+    rng = np.random.default_rng(12)
+    blobs, originals = {}, {}
+    for i in range(12):
+        L_, H_ = int(rng.integers(1, 3)), int(rng.integers(1, 3))
+        BS_, D_ = int(rng.choice([2, 4])), int(rng.choice([4, 8]))
+        kind = i % 3
+        if kind == 0:
+            vals = {"k": rng.normal(size=(L_, H_, BS_, D_))
+                    .astype(np.float32),
+                    "v": rng.normal(size=(L_, H_, BS_, D_))
+                    .astype(np.float32)}
+        elif kind == 1:
+            vals = {"k": rng.normal(size=(L_, H_, BS_, D_))
+                    .astype(ml_dtypes.bfloat16)}
+        else:                              # int8 opaque rows (quantized KV)
+            vals = {"kv": rng.integers(-128, 127, size=(L_, 1, BS_, 64))
+                    .astype(np.int8)}
+        h = 1000 + i
+        blobs[h] = pack_block_bytes(vals, tokens_hash=i, parent_hash=None)
+        originals[h] = vals
+
+    rt_s = await DistributedRuntime.connect(daemon.address)
+    server = _StubFabricServer(blobs)
+    ep = Endpoint.parse_path(rt_s, "dyn://ns/worker/kv_fabric")
+    await ep.serve(server, decode_req=json.loads)
+    rt_c = fab = None
+    try:
+        rt_c, fab = await _client_fabric(daemon)
+        await fab.client.wait_for_instances()
+        wid = rt_s.worker_id
+        hashes = sorted(blobs)
+        for run in ([hashes[0]], hashes[:5], hashes[3:9], hashes):
+            native = await fab._fetch_blobs_native(wid, run)
+            via_json = await fab._fetch_blobs_json(wid, run)
+            assert native is not None
+            assert native == via_json == [blobs[h] for h in run]
+            for h, blob in zip(run, native):
+                vals, th, _ph = unpack_block_bytes(blob)
+                assert th == h - 1000
+                for k, arr in originals[h].items():
+                    np.testing.assert_array_equal(vals[k], arr)
+        assert fab.dataplane_fetches_total == 4
+        assert server.dataplane_fetches_served == 4
+        # a missing hash is a KeyError on BOTH paths (never a crash)
+        with pytest.raises(KeyError):
+            await fab._fetch_blobs_native(wid, [999999])
+        with pytest.raises(KeyError):
+            await fab._fetch_blobs_json(wid, [999999])
+    finally:
+        if fab is not None:
+            await fab.close()
+        for rt in (rt_c, rt_s):
+            if rt is not None:
+                await rt.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_dataplane_declined_falls_back_to_json(daemon,
+                                                     monkeypatch):
+    """A peer without the native lib (env-gated here) declines
+    fetch_native; fetch_async rides the JSON path transparently and the
+    fallback is counted — the nv_llm_kv_remote_dataplane_fallbacks feed."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime, Endpoint
+
+    vals = _blk(4.0)
+    blobs = {7: pack_block_bytes(vals, tokens_hash=1)}
+    rt_s = await DistributedRuntime.connect(daemon.address)
+    await Endpoint.parse_path(rt_s, "dyn://ns/worker/kv_fabric").serve(
+        _StubFabricServer(blobs),
+        decode_req=json.loads)
+    rt_c = fab = None
+    try:
+        rt_c, fab = await _client_fabric(daemon)
+        await fab.client.wait_for_instances()
+        monkeypatch.setenv("DYN_KV_FABRIC_DATAPLANE", "0")  # server side
+        out = await fab.fetch_async(rt_s.worker_id, [7])
+        np.testing.assert_allclose(out["k"][:, :, 0], vals["k"])
+        assert fab.dataplane_fallbacks_total == 1
+        assert fab.dataplane_fetches_total == 0
+    finally:
+        if fab is not None:
+            await fab.close()
+        for rt in (rt_c, rt_s):
+            if rt is not None:
+                await rt.shutdown()
+
+
+@pytest.mark.asyncio
+async def test_torn_native_frame_falls_back_to_recompute(tmp_path, daemon):
+    """ISSUE 12 satellite: a torn/truncated block payload arriving over
+    the native data plane is a fetch failure, not an error — the engine
+    recomputes the tail and the stream stays bit-exact."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime, Endpoint
+
+    prompt = list(range(1, 13))
+    core_a = _make_core(tmp_path / "a")
+    ref_toks, _ = await _serve(core_a, prompt, "cold")
+    await core_a.stop()
+    hashes = [h for h, _t, _p in core_a.disk_store.registered_entries()]
+    # the "peer": serves the right hashes but TRUNCATED payloads
+    torn = {h: b"\x93NUMPY-torn-payload" for h in hashes}
+    rt_s = await DistributedRuntime.connect(daemon.address)
+    await Endpoint.parse_path(rt_s, "dyn://ns/worker/kv_fabric").serve(
+        _StubFabricServer(torn),
+        decode_req=json.loads)
+
+    core_b = _make_core(tmp_path / "b")
+    rt_b, fab_b = await _attach_fabric(core_b, daemon)
+    try:
+        fab_b.store.note_peer_stored(rt_s.worker_id, hashes)
+        toks, _hit = await _serve(core_b, prompt, "torn-fetch")
+        assert toks == ref_toks            # recomputed, bit-exact
+        assert core_b.remote_fetch_failures == 1
+        # the frames ARRIVED over the data plane — the tear surfaced at
+        # unpack, proving transport success is not treated as payload
+        # validity
+        assert fab_b.dataplane_fetches_total == 1
+        # healthy afterwards
+        toks2, _ = await _serve(core_b, prompt, "again")
+        assert toks2 == ref_toks
+    finally:
+        await fab_b.close()
+        await core_b.stop()
+        await rt_b.shutdown()
+        await rt_s.shutdown()
+
+
+# ------------------------------------- prefill-as-a-service (ISSUE 12)
+
+
+@pytest.mark.asyncio
+async def test_prefill_publish_then_remote_admit_and_replay(tmp_path):
+    """The PaaS loop end to end, plus the retired refusal: a
+    prefill-publish worker publishes a prompt's prefix KV to the shared
+    object tier (components/prefill_service.py); a RECORDED decode
+    worker pointed at the same root admits the prefix through the
+    remote cascade, decodes bit-exact vs cold recompute, and the
+    admission streams as a kv_remote_restore event that replays
+    bit-exact — both from the event's carried bytes AND by follower-
+    side fetch from the shared store (fetch-or-bytes)."""
+    from dynamo_tpu.components.prefill_service import PrefillService
+    from dynamo_tpu.engine.replay import Recorder, compare_replay, replay
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    root = str(tmp_path / "obj")
+    prompt = list(range(1, 13))            # 3 full blocks (bs=4)
+
+    # reference: cold recompute
+    core_ref = _make_core(tmp_path / "ref")
+    ref_toks, _ = await _serve(core_ref, prompt, "cold")
+    await core_ref.stop()
+
+    # prefill-publish worker
+    runtime = DistributedRuntime.in_process()
+    core_p = _make_core(tmp_path / "p", kv_remote_dir=root)
+    svc = PrefillService(core_p, runtime)
+    r = await svc.publish(prompt, rid="pub-1")
+    assert r["ok"] and r["published"] >= 3
+    assert core_p.prefill_published_blocks >= 3
+    assert len(r["hashes"]) >= 3
+    # content-addressed: re-publishing a warm chain writes nothing
+    r2 = await svc.publish(prompt, rid="pub-2")
+    assert r2["published"] == 0 and r2["prefix_hit_tokens"] >= 8
+    status = await svc._handle({"op": "status"})
+    assert status["prefill_publishes_done"] == 0  # direct publish() calls
+    assert status["prefill_published_blocks_total"] >= 3
+    await core_p.stop()
+
+    # recorded decode worker, same object root: the admission that used
+    # to refuse ("remote onboarding not supported on a recorded engine")
+    core_d = _make_core(tmp_path / "d", kv_remote_dir=root)
+    core_d.recorder = Recorder()
+    toks, hit = await _serve(core_d, prompt, "admit")
+    assert hit >= 8                        # prefix fetched, not recomputed
+    assert core_d.remote_onboards == 1
+    assert toks == ref_toks                # bit-exact decode
+    events = core_d.recorder.events
+    restores = [e for e in events if e["ev"] == "kv_remote_restore"]
+    assert len(restores) == 1
+    assert restores[0]["remote_hashes"] and restores[0]["values"]
+    assert len(restores[0]["remote_targets"]) \
+        == len(restores[0]["remote_hashes"])
+
+    # offline replay from the event's carried bytes: bit-exact
+    rep = replay(core_d, events)
+    assert compare_replay(events, rep) == []
+
+    # fetch-or-bytes: strip the values — the replayer (standing in for
+    # a follower whose remote store shares the content-addressed root)
+    # fetches the hashes itself and still replays bit-exact
+    stripped = [dict(e, values=None) if e["ev"] == "kv_remote_restore"
+                else e for e in events]
+    rep2 = replay(core_d, stripped)
+    assert compare_replay(stripped, rep2) == []
+    await core_d.stop()
+    await runtime.shutdown()
 
 
 # --------------------------------------------------- NetKV router scoring
