@@ -161,6 +161,26 @@ _REMOTE_GAUGES = {
     "netstore_retries_total": "nv_llm_netstore_retries_total",
 }
 
+# chaos-hardening / graceful degradation (runtime/faults.py failpoints,
+# end-to-end deadlines/cancellation, fabric circuit breaker —
+# docs/chaos.md): ForwardPassMetrics field → exported metric name. The
+# Grafana "Degradation" row plots cancelled + deadline-exceeded next to
+# the breaker state (open peers / cumulative trips) and the two "the
+# fleet is shedding instead of hanging" signals: spill writes shed on
+# disk pressure and netstore calls that burned their whole deadline
+# against a partitioned daemon.
+_DEGRADE_GAUGES = {
+    "requests_cancelled_total": "nv_llm_requests_cancelled_total",
+    "requests_deadline_exceeded_total":
+        "nv_llm_requests_deadline_exceeded_total",
+    "netstore_deadline_exceeded_total":
+        "nv_llm_netstore_deadline_exceeded_total",
+    "remote_breaker_open_peers": "nv_llm_kv_remote_breaker_open_peers",
+    "remote_breaker_trips_total":
+        "nv_llm_kv_remote_breaker_trips_total",
+    "disk_spill_shed_total": "nv_llm_kv_disk_spill_shed_writes_total",
+}
+
 
 class MetricsAggregatorService:
     """Aggregates worker load + router hit-rate into one Prometheus registry.
@@ -216,6 +236,10 @@ class MetricsAggregatorService:
             f: Gauge(name, f"fleet tracing: worker {f} (scraped stats)",
                      labels, registry=self.registry)
             for f, name in _TRACE_GAUGES.items()}
+        self._degrade_gauges: Dict[str, Gauge] = {
+            f: Gauge(name, f"graceful degradation: worker {f} "
+                     "(scraped stats)", labels, registry=self.registry)
+            for f, name in _DEGRADE_GAUGES.items()}
         self.hit_isl_blocks = Counter(
             f"{PREFIX}_hit_rate_isl_blocks_total",
             "Routing decisions: total request blocks (ISL)",
@@ -360,6 +384,8 @@ class MetricsAggregatorService:
                 g.labels(*lbl).set(getattr(m, f))
             for f, g in self._trace_gauges.items():
                 g.labels(*lbl).set(getattr(m, f))
+            for f, g in self._degrade_gauges.items():
+                g.labels(*lbl).set(getattr(m, f))
         # drop series for workers whose leases died (the watcher pruned them)
         for gone in self._seen_workers - present:
             self.latest.pop(gone, None)
@@ -371,7 +397,8 @@ class MetricsAggregatorService:
                       + list(self._layout_gauges.values())
                       + list(self._remote_gauges.values())
                       + list(self._ragged_gauges.values())
-                      + list(self._trace_gauges.values())):
+                      + list(self._trace_gauges.values())
+                      + list(self._degrade_gauges.values())):
                 try:
                     g.remove(*lbl)
                 except KeyError:

@@ -320,6 +320,23 @@ class MockTokenWorker:
             d["remote_dataplane_fetches_total"] = 2 * eng.requests_served
             d["remote_dataplane_fallbacks_total"] = 0
             d["prefill_published_blocks_total"] = 3 * eng.requests_served
+        if eng is not None and not d.get("requests_cancelled_total"):
+            # round 13: synthetic graceful-degradation counters
+            # (docs/chaos.md) — a lightly-chaotic fleet: a few cancels
+            # and deadline misses growing with traffic, one tripped peer
+            # that recovered (trips > open), a handful of shed spill
+            # writes — so the nv_llm_requests_cancelled_total /
+            # nv_llm_kv_remote_breaker_* / nv_llm_kv_disk_spill_shed_*
+            # scrape path and the Grafana "Degradation" row run with
+            # zero engines
+            d["requests_cancelled_total"] = max(eng.requests_served // 4,
+                                                1)
+            d["requests_deadline_exceeded_total"] = \
+                eng.requests_served // 8
+            d["netstore_deadline_exceeded_total"] = 0
+            d["remote_breaker_open_peers"] = 0
+            d["remote_breaker_trips_total"] = 1
+            d["disk_spill_shed_total"] = eng.requests_served // 6
         profile = getattr(self, "profile", None)
         if profile is not None and (profile.slow_start_s > 0
                                     or profile.latency_factor != 1.0):

@@ -102,7 +102,10 @@ class PrefillService(AsyncEngine):
         await self.core.submit(req)
         first_token = None
         while True:
-            out, _ = await req.out_queue.get()
+            # bounded receive (DL007): a wedged engine fails the publish
+            # RPC to its caller instead of pinning this worker's slot
+            out, _ = await asyncio.wait_for(req.out_queue.get(),
+                                            timeout=600.0)
             if out is FINISH_SENTINEL:
                 break
             first_token = out

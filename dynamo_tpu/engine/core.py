@@ -113,9 +113,19 @@ class EngineRequest:
     # Kept as `object` to stay dependency-light; None = untraced.
     trace: object = None
 
+    # tier-hit onboard prep failed once: the re-admission skips the
+    # host/disk/remote cascade and recomputes cold (graceful fallback —
+    # a broken tier must never make serving worse than no tier)
+    cold_admission: bool = False
+
     @property
     def cancelled(self) -> bool:
-        return bool(self.ctx is not None and self.ctx.is_stopped)
+        """Client-stop OR deadline-exceeded — both vacate the slot the
+        same way; _finish_request counts them apart."""
+        if self.ctx is None:
+            return False
+        return bool(self.ctx.is_stopped
+                    or getattr(self.ctx, "deadline_exceeded", False))
 
 
 _FINISH = object()  # queue sentinel
@@ -443,6 +453,16 @@ class EngineCore:
         # prefill-as-a-service (components/prefill_service.py): prefix
         # blocks this engine published to the durable object tier
         self.prefill_published_blocks = 0
+        # end-to-end cancellation/deadlines (docs/chaos.md): requests
+        # vacated because the client stopped caring (disconnect → KILL
+        # frame → ctx.kill) vs because their wire-propagated deadline
+        # budget ran out engine-side — the nv_llm_requests_cancelled_
+        # total / _deadline_exceeded_total feeds
+        self.requests_cancelled_total = 0
+        self.requests_deadline_exceeded_total = 0
+        # tier-hit onboards whose off-thread prep failed and were
+        # re-admitted COLD (full recompute) instead of erroring out
+        self.onboard_cold_retries = 0
         # measured prefill rate feed for the fabric's admission gate and
         # the router's NetKV scoring: wall seconds spent in prefill
         # admissions (dispatch + host glue — an upper bound, so the
@@ -776,6 +796,11 @@ class EngineCore:
             # worker-thread hooks (disk-evict → remote promotion) need a
             # handle to reach the loop via call_soon_threadsafe
             self._loop = asyncio.get_running_loop()
+            # a fresh wakeup event per (re)start: asyncio primitives
+            # loop-bind on first wait, and a core restarted on a NEW
+            # loop (module-scoped test fixtures, embedders re-running
+            # asyncio.run) would otherwise die on the old loop's event
+            self._work_event = asyncio.Event()
             self._loop_task = self._loop.create_task(
                 self._run_loop(), name="engine-core-loop")
             self.flight.start_lag_probe()
@@ -1122,7 +1147,9 @@ class EngineCore:
                 disk_hit_rate=disk.hit_rate(),
                 disk_bytes_used=disk.bytes_used,
                 disk_spill_dropped_total=self
-                .spill_engine.dropped_jobs_total)
+                .spill_engine.dropped_jobs_total,
+                disk_spill_shed_total=self
+                .spill_engine.shed_writes_total)
         if self.remote_store is not None or self.kv_fabric is not None:
             # remote (G4) fabric: tier occupancy + the measured link
             # model the router's NetKV scoring consumes (kv_router/
@@ -1144,6 +1171,9 @@ class EngineCore:
                     .admission_rejects_total)
         from ..runtime.tracing import tracer as _tracer
         return ForwardPassMetrics(
+            requests_cancelled_total=self.requests_cancelled_total,
+            requests_deadline_exceeded_total=self
+            .requests_deadline_exceeded_total,
             kv_bytes_per_block=self.kv_bytes_per_block(),
             kv_block_size=self.cfg.kv_block_size,
             prefill_tok_per_s=self.measured_prefill_tok_per_s(),
@@ -1247,6 +1277,11 @@ class EngineCore:
             if (self.waiting.empty() and self._pending is None
                     and self._ragged_pending is None):
                 self._maybe_defrag()
+            # 0.5) cancellation/deadline sweep: vacate slots and purge
+            # the waiting queue for requests whose client stopped caring
+            # — one loop tick, no waiting for the next emit
+            if self._sweep_cancelled():
+                progressed = True
             # 1) admit waiting work into free slots
             while not self.waiting.empty():
                 slot = self._free_slot_index()
@@ -1360,9 +1395,42 @@ class EngineCore:
                      pool.count_runs(new), pool_frag)
         return True
 
+    def _sweep_cancelled(self) -> bool:
+        """One pass of the end-to-end cancellation contract
+        (docs/chaos.md): cancelled/deadline-exceeded requests leave the
+        waiting queue before ever taking a slot, and READY slots are
+        vacated immediately — blocks released, offload write-back still
+        honored via _release_slot. Slots with an un-harvested dispatch
+        in flight are left to their harvest's own cancel check (same
+        loop tick); non-ready slots (onboard in flight) resolve at
+        _complete_onboards."""
+        progressed = False
+        if not self.waiting.empty():
+            survivors: List[EngineRequest] = []
+            while not self.waiting.empty():
+                try:
+                    r: EngineRequest = self.waiting.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                if r.cancelled:
+                    self._finish_request(r, FinishReason.CANCELLED)
+                    progressed = True
+                else:
+                    survivors.append(r)
+            for r in survivors:
+                self.waiting.put_nowait(r)
+        if self._pending is None and self._ragged_pending is None:
+            for req in list(self.slots):
+                if req is not None and req.ready and req.cancelled:
+                    self._release_slot(req)
+                    self._finish_request(req, FinishReason.CANCELLED)
+                    progressed = True
+        return progressed
+
     # ---------------------------------------------------------------- admit
     def _try_admit(self, req: EngineRequest, slot: int) -> bool:
-        plan = self.kv_manager.prepare_prefill(req.prompt, seq=req.seq)
+        plan = self.kv_manager.prepare_prefill(req.prompt, seq=req.seq,
+                                               cold=req.cold_admission)
         if plan is None:
             return False
         if len(plan.all_blocks) > self.M:
@@ -1535,15 +1603,27 @@ class EngineCore:
                 self.cfg.kv_block_size)
 
             def publish_all() -> int:
+                from ..runtime.faults import hit as _fault
                 values = fetch_wire(stacked, len(entries),
                                     self.wire_kv_heads)
                 n = 0
                 for i, (_bid, h, th, ph) in enumerate(entries):
                     if rs.object.contains(h):
                         continue           # content-addressed no-op
-                    rs.put(h, {k: np.ascontiguousarray(v[:, :, i])
-                               for k, v in values.items()},
-                           tokens_hash=th, parent_hash=ph)
+                    try:
+                        _fault("prefill.publish")   # enospc/delay chaos
+                        rs.put(h, {k: np.ascontiguousarray(v[:, :, i])
+                                   for k, v in values.items()},
+                               tokens_hash=th, parent_hash=ph)
+                    except OSError as e:
+                        # a refusing object tier (full bucket, chaos)
+                        # forfeits THIS block's publish and keeps going:
+                        # decode fleets simply recompute what never
+                        # landed — publish is an optimization, not a
+                        # correctness dependency
+                        logger.warning("prefix publish of %x failed: %s",
+                                       h & 0xFFFFFFFFFFFFFFFF, e)
+                        continue
                     n += 1
                 return n
 
@@ -1647,7 +1727,9 @@ class EngineCore:
             fetch_ms = {"host": 0.0, "disk": 0.0, "remote": 0.0}
             try:
                 def prep():
+                    from ..runtime.faults import hit as _fault
                     from .block_copy import prep_host_values
+                    _fault("engine.onboard")   # chaos: tier prep fails
                     parts = []
                     if plan.host_slots:
                         _t = time.monotonic()
@@ -1742,9 +1824,25 @@ class EngineCore:
             try:
                 if req.cancelled or prepped is None:
                     self.kv_manager.pool.release(plan.all_blocks)
-                    self._finish_request(
-                        req, FinishReason.CANCELLED if req.cancelled
-                        else FinishReason.ERROR)
+                    if req.cancelled:
+                        self._finish_request(req, FinishReason.CANCELLED)
+                    elif not req.cold_admission:
+                        # tier onboard prep failed (dead disk, torn
+                        # fetch, chaos injection): re-admit COLD — skip
+                        # the offload cascade and recompute the prefix.
+                        # A broken cache tier must degrade to a cold
+                        # prefill, never to a failed request.
+                        self.onboard_cold_retries += 1
+                        req.cold_admission = True
+                        req.slot = -1
+                        req.ready = True
+                        logger.warning(
+                            "onboard prep failed for %s — retrying as a "
+                            "cold admission (recompute)", req.rid)
+                        self.waiting.put_nowait(req)
+                        self._work_event.set()
+                    else:
+                        self._finish_request(req, FinishReason.ERROR)
                     continue
                 self._admit_with_plan(req, slot, plan, prepped,
                                       remote_values=remote_values)
@@ -2559,6 +2657,10 @@ class EngineCore:
         """Apply one dispatch's results: emissions, seq bookkeeping,
         EOS/budget/cancel finishes. Device overrun past a finish — or past
         a slot whose request changed since dispatch — is discarded."""
+        from ..runtime.faults import hit as _fault
+        _fault("engine.harvest")    # chaos: loop-fatal boundary — an
+        # injected error here kills the loop LOUDLY and _fail_pending
+        # releases every slot/hold (asserted in tests/test_chaos.py)
         self.host_roundtrips += 1
         _t0 = time.monotonic()
         toks_k = np.asarray(pending["toks"])       # [K, B] — ONE host fetch
@@ -3332,6 +3434,18 @@ class EngineCore:
 
     def _finish_request(self, req: EngineRequest,
                         reason: FinishReason) -> None:
+        if reason == FinishReason.CANCELLED:
+            # client-stop vs deadline-budget-exhausted, counted apart
+            # (nv_llm_requests_cancelled_total / _deadline_exceeded_total)
+            ctx = req.ctx
+            if (ctx is not None and not ctx.is_stopped
+                    and getattr(ctx, "deadline_exceeded", False)):
+                self.requests_deadline_exceeded_total += 1
+            else:
+                self.requests_cancelled_total += 1
+            if req.trace is not None:
+                req.trace.event("engine.cancelled",
+                                generated=req.generated)
         self._inflight_reqs.pop(id(req), None)
         req.out_queue.put_nowait((_FINISH, reason))
 

@@ -98,6 +98,23 @@ def build_parser() -> argparse.ArgumentParser:
     kvw.add_argument("--disk", type=float, default=None)
     kvw.add_argument("--remote", type=float, default=None)
 
+    fl = sub.add_parser("faults", help="failpoint chaos drills "
+                                       "(runtime/faults.py; docs/chaos.md)")
+    flsub = fl.add_subparsers(dest="faults_cmd", required=True)
+    fls = flsub.add_parser("set", help="arm one failpoint fleet-wide "
+                                       "(merged into the stored table)")
+    fls.add_argument("namespace")
+    fls.add_argument("site", help="registered site, e.g. netstore.call")
+    fls.add_argument("spec", help="[1-in-N,]error|delay:ms|torn|enospc")
+    flc = flsub.add_parser("clear", help="disarm one site (or all with "
+                                         "--all)")
+    flc.add_argument("namespace")
+    flc.add_argument("site", nargs="?")
+    flc.add_argument("--all", action="store_true")
+    flt = flsub.add_parser("status", help="show the stored failpoint "
+                                          "table + the site catalog")
+    flt.add_argument("namespace", nargs="?")
+
     tr = sub.add_parser("trace", help="fleet tracing admin "
                                       "(engine/flight_recorder.py)")
     trsub = tr.add_subparsers(dest="trace_cmd", required=True)
@@ -173,6 +190,8 @@ async def amain(argv=None) -> int:
             return await _spec_cmd(runtime, args)
         elif args.cmd == "kv":
             return await _kv_cmd(runtime, args)
+        elif args.cmd == "faults":
+            return await _faults_cmd(runtime, args)
         elif args.cmd == "trace":
             return await _trace_cmd(runtime, args)
         elif args.cmd == "deployment":
@@ -335,6 +354,72 @@ async def _kv_cmd(runtime, args) -> int:
                     "clear": bool(args.clear)}).encode())
     print(f"kv {'clear' if args.clear else 'flush'} requested for "
           f"{args.namespace}")
+    return 0
+
+
+async def _faults_cmd(runtime, args) -> int:
+    """``llmctl faults`` — arm/disarm deterministic failpoints
+    fleet-wide over the faults/control/{ns} key (runtime/faults.py;
+    every worker's watch_faults_loop applies the stored table live).
+    Specs are validated HERE so a typo'd drill fails at the CLI, not
+    silently fault-free on the fleet."""
+    import json
+
+    from ..runtime.faults import SITES, faults_control_key, parse_spec
+
+    if args.faults_cmd == "status":
+        prefix = (faults_control_key(args.namespace)
+                  if args.namespace else "faults/control/")
+        entries = await runtime.store.kv_get_prefix(prefix)
+        if not entries:
+            print("(no failpoints armed)")
+        for e in sorted(entries, key=lambda x: x.key):
+            ns = e.key.rsplit("/", 1)[-1]
+            try:
+                table = json.loads(e.value)
+            except ValueError:
+                print(f"namespace {ns}  (malformed table)")
+                continue
+            print(f"namespace {ns}")
+            for site, spec in sorted(table.items()):
+                print(f"  {site:26s} {spec}")
+        print("\nregistered sites:")
+        for site, desc in sorted(SITES.items()):
+            print(f"  {site:26s} {desc}")
+        return 0
+
+    key = faults_control_key(args.namespace)
+    entry = await runtime.store.kv_get(key)
+    table = {}
+    if entry is not None:
+        try:
+            table = json.loads(entry.value)
+        except ValueError:
+            table = {}
+    if args.faults_cmd == "set":
+        if args.site not in SITES:
+            print(f"unknown site {args.site!r} (llmctl faults status "
+                  f"lists the catalog)", file=sys.stderr)
+            return 1
+        try:
+            parse_spec(args.site, args.spec)
+        except ValueError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        table[args.site] = args.spec
+        await runtime.store.kv_put(key, json.dumps(table).encode())
+        print(f"armed {args.site}={args.spec} for {args.namespace}")
+        return 0
+    # clear
+    if args.all:
+        table = {}
+    elif args.site:
+        table.pop(args.site, None)
+    else:
+        print("pass a site or --all", file=sys.stderr)
+        return 1
+    await runtime.store.kv_put(key, json.dumps(table).encode())
+    print(f"faults table for {args.namespace}: {table or '(clear)'}")
     return 0
 
 

@@ -506,14 +506,17 @@ async def run_worker_endpoint(args, engine, pipeline, core, runtime,
         def stats_handler():
             from ..runtime import netstore
             d = core.metrics().to_dict()
-            # process-wide daemon-retry counter rides the worker's
-            # scrape (nv_llm_netstore_retries_total)
+            # process-wide daemon-link counters ride the worker's scrape
+            # (nv_llm_netstore_retries_total / _deadline_exceeded_total)
             d["netstore_retries_total"] = netstore.retries_total()
+            d["netstore_deadline_exceeded_total"] = \
+                netstore.deadline_exceeded_total()
             return d
         await _wire_kv_events(core, runtime, endpoint)
         await _wire_spec_config(core, runtime, endpoint.namespace)
         _wire_kv_admin(core, runtime, endpoint.namespace)
         _wire_kv_weights(runtime, endpoint.namespace)
+        _wire_faults(runtime, endpoint.namespace)
         _wire_tracing(args, core, runtime, endpoint)
         if args.kv_fabric:
             # fleet KV fabric (llm/kv/fabric.py): serve our disk/host
@@ -679,6 +682,15 @@ def _wire_kv_weights(runtime, namespace: str) -> None:
     from ..llm.kv.admin import watch_weights_loop
     asyncio.get_running_loop().create_task(
         watch_weights_loop(runtime, namespace), name="kv-weights-watch")
+
+
+def _wire_faults(runtime, namespace: str) -> None:
+    """llmctl faults plumbing (runtime/faults.py): apply the
+    namespace's stored failpoint table and keep applying live updates —
+    the fleet-wide chaos-drill lever (docs/chaos.md)."""
+    from ..runtime.faults import watch_faults_loop
+    asyncio.get_running_loop().create_task(
+        watch_faults_loop(runtime, namespace), name="faults-watch")
 
 
 async def run_prefill_worker(args, core, runtime) -> None:

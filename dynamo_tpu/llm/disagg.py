@@ -226,7 +226,10 @@ class DisaggEngine(JaxEngine):
             connection_info=rt.tcp.connection_info(rx).to_dict(),
             engine_id=rt.worker_uuid, prefix_hit_tokens=hit,
             device_bridge=PROC_TOKEN if self.device_plane else "",
-            trace=current_wire_context())
+            trace=current_wire_context(),
+            deadline_ms=(req.ctx.remaining_ms()
+                         if req.ctx is not None
+                         and hasattr(req.ctx, "remaining_ms") else None))
         try:
             await self.queue.enqueue(rpr)
             prologue = await rx.wait_connected(timeout=self.prefill_timeout)
@@ -428,7 +431,15 @@ class PrefillWorker:
 
         from ..engine.sampling import SlotSampling
         from ..runtime.engine import EngineContext
-        ctx = EngineContext(rpr.request_id)
+        # re-anchor the decode side's remaining budget on OUR clock; a
+        # job whose budget is already gone is dropped unstarted (the
+        # decode worker cancelled/fell back long ago)
+        ctx = EngineContext(rpr.request_id, deadline_ms=rpr.deadline_ms)
+        if ctx.deadline_exceeded:
+            ptrace.set_error("deadline exceeded before prefill started")
+            await sender.finish(error="deadline exceeded")
+            await self.queue.ack(item.id)
+            return
         req = EngineRequest(
             rid=rpr.request_id, prompt=list(rpr.token_ids),
             sampling=SlotSampling(**rpr.sampling), max_new_tokens=1,

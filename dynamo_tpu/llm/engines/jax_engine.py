@@ -72,10 +72,29 @@ class JaxEngine(AsyncEngine):
         trace = current_trace()
 
         async def stream() -> AsyncIterator[Annotated[BackendOutput]]:
+            import asyncio
+
             first = True
             emitted = 0
             while True:
-                item, payload = await req.out_queue.get()
+                # bounded receive (DL007): the engine contract is that
+                # every request ends in a FINISH sentinel (even loop
+                # death routes through _fail_pending) — but a hung loop
+                # must not hang this stream forever. Each timeout polls
+                # the request's cancellation; a killed client's stream
+                # ends instead of waiting on an engine that stopped
+                # answering. The get_nowait fast path keeps the token
+                # hot path free of wait_for's per-item task overhead.
+                try:
+                    item, payload = req.out_queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    try:
+                        item, payload = await asyncio.wait_for(
+                            req.out_queue.get(), timeout=30.0)
+                    except asyncio.TimeoutError:
+                        if req.ctx is not None and req.ctx.is_killed:
+                            return
+                        continue
                 if item is FINISH_SENTINEL:
                     reason: FinishReason = payload
                     if trace is not None:

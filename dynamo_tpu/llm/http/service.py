@@ -209,6 +209,7 @@ async def _start_fanout(engine, body: dict, ectx: "_FanoutContext",
         sub["n"] = 1
         sub["seed"] = base + i
         sctx = EngineContext(f"{ectx.id}-c{i}")
+        sctx.deadline_s = ectx.deadline_s   # children inherit the budget
         ectx.children.append(sctx)
         return await engine.generate(Context(sub, sctx))
 
@@ -349,6 +350,18 @@ class HttpService:
         streaming = bool(body.get("stream", False))
         guard = self.metrics.inflight_guard(model, endpoint, streaming)
         ectx = EngineContext() if n_choices == 1 else _FanoutContext()
+        # end-to-end deadline (docs/chaos.md): nvext.deadline_ms or the
+        # X-Request-Deadline-Ms header arms a budget that rides the
+        # request plane (codec.RequestControlMessage.deadline_ms) all
+        # the way into the engine's per-tick cancellation sweep
+        deadline_ms = ((body.get("nvext") or {}).get("deadline_ms")
+                       or request.headers.get("X-Request-Deadline-Ms"))
+        if deadline_ms is not None:
+            try:
+                ectx.set_deadline_ms(float(deadline_ms))
+            except (TypeError, ValueError):
+                return _error_response(
+                    400, f"invalid deadline_ms: {deadline_ms!r}")
         # per-request trace (reference egress/push.rs:134-151): stage
         # latencies from HTTP ingress through dispatch to last byte, keyed
         # by the request id the control plane already carries everywhere

@@ -176,28 +176,38 @@ class DiskKvStore:
             self._wipe()
         man_path = os.path.join(self.root, _MANIFEST)
         live: "OrderedDict[int, _Entry]" = OrderedDict()
-        if os.path.exists(man_path):
-            with open(man_path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        # torn tail: the record was never acknowledged
-                        break
-                    if rec.get("op") == "put":
-                        h = int(rec["h"])
-                        live.pop(h, None)
-                        live[h] = _Entry(
-                            seq_hash=h,
-                            tokens_hash=rec.get("th"),
-                            parent_hash=rec.get("ph"),
-                            fname=rec.get("f", _blk_fname(h)),
-                            nbytes=int(rec.get("n", 0)))
-                    elif rec.get("op") == "del":
-                        live.pop(int(rec["h"]), None)
+        try:
+            from ...runtime.faults import hit as _fault
+            _fault("diskstore.recovery", exc=OSError)
+            if os.path.exists(man_path):
+                with open(man_path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            # torn tail: never acknowledged
+                            break
+                        if rec.get("op") == "put":
+                            h = int(rec["h"])
+                            live.pop(h, None)
+                            live[h] = _Entry(
+                                seq_hash=h,
+                                tokens_hash=rec.get("th"),
+                                parent_hash=rec.get("ph"),
+                                fname=rec.get("f", _blk_fname(h)),
+                                nbytes=int(rec.get("n", 0)))
+                        elif rec.get("op") == "del":
+                            live.pop(int(rec["h"]), None)
+        except OSError:
+            # an unreadable manifest (I/O error, yanked volume) must not
+            # refuse serving: start cold — the cache is re-creatable,
+            # the engine is not (graceful degradation over availability)
+            logger.exception("disk KV manifest unreadable at %s — "
+                             "starting cold", man_path)
+            live = OrderedDict()
         # keep only entries whose data file actually exists AND has the
         # acknowledged byte count — a manifest line with a vanished or
         # truncated payload cannot serve reads. Our own writes are
@@ -463,15 +473,24 @@ class DiskKvStore:
 
     def _write_block(self, seq_hash: int, values: dict,
                      tokens_hash, parent_hash) -> int:
+        from ...runtime.faults import hit as _fault
+        from ...runtime.faults import mangle as _mangle
+        _fault("diskstore.write")           # enospc/delay chaos site
         fname = _blk_fname(seq_hash)
         tmp = os.path.join(self.root, "tmp-" + fname)
+        buf = io.BytesIO()
+        np.savez(buf, **_pack_block(values))
+        data = buf.getvalue()
+        nbytes = len(data)                  # the INTENDED byte count —
+        # a torn write (chaos or external damage) leaves fewer bytes on
+        # disk than the manifest acknowledges, which is exactly what
+        # recovery's size check reaps
         with open(tmp, "wb") as f:
-            np.savez(f, **_pack_block(values))
+            f.write(_mangle("diskstore.write", data))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, os.path.join(self.root, fname))
         self._fsync_dir()
-        nbytes = os.path.getsize(os.path.join(self.root, fname))
         # the acknowledgement: manifest line AFTER the durable data file
         self._append_manifest([{"op": "put", "h": seq_hash,
                                 "th": tokens_hash, "ph": parent_hash,
@@ -551,6 +570,10 @@ class DiskSpillEngine:
         self._task: Optional[asyncio.Task] = None
         self.spilled_blocks_total = 0
         self.dropped_jobs_total = 0
+        # writes the disk refused (ENOSPC, I/O error): the pump SHEDS
+        # the job — losing a re-creatable cache block — and serving
+        # continues (nv_llm_kv_disk_spill_shed_writes_total)
+        self.shed_writes_total = 0
         self.write_s = 0.0
 
     def offer(self, job: SpillJob) -> bool:
@@ -591,18 +614,32 @@ class DiskSpillEngine:
 
     async def _process(self, jobs: List[SpillJob]) -> None:
         def write_batch():
+            from ...runtime.faults import hit as _fault
             out = []
+            shed = 0
             t0 = time.monotonic()
             for j in jobs:
-                evicted = self.store.put(j.seq_hash, j.values,
-                                         j.tokens_hash, j.parent_hash)
+                try:
+                    _fault("diskstore.spill")   # enospc/delay chaos site
+                    evicted = self.store.put(j.seq_hash, j.values,
+                                             j.tokens_hash, j.parent_hash)
+                except OSError as e:
+                    # full/failing disk mid-spill: SHED the write-behind
+                    # job (the block is re-creatable from recompute) and
+                    # keep pumping — disk pressure must degrade the
+                    # cache, never the serving path
+                    shed += 1
+                    logger.warning("disk spill shed block %x: %s",
+                                   j.seq_hash & 0xFFFFFFFFFFFFFFFF, e)
+                    continue
                 if evicted is not None:
                     out.append((j.seq_hash, j.tokens_hash, j.parent_hash,
                                 list(evicted)))
-            return out, time.monotonic() - t0
+            return out, shed, time.monotonic() - t0
 
-        committed, dt = await asyncio.to_thread(write_batch)
+        committed, shed, dt = await asyncio.to_thread(write_batch)
         self.write_s += dt
+        self.shed_writes_total += shed
         self.spilled_blocks_total += len(committed)
         if self.on_commit is not None and committed:
             self.on_commit(committed)

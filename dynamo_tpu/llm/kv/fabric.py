@@ -63,7 +63,7 @@ logger = logging.getLogger("dynamo_tpu.kv.fabric")
 
 __all__ = ["FABRIC_ENDPOINT", "LinkStats", "PeerLinkTable", "AdmissionGate",
            "PrefillRateEstimator", "KvFabricServer", "KvFabric",
-           "dataplane_serving_available"]
+           "CircuitBreaker", "dataplane_serving_available"]
 
 FABRIC_ENDPOINT = "kv_fabric"
 PROBE_BYTES = 256 * 1024
@@ -100,17 +100,132 @@ class LinkStats:
         return dataclasses.asdict(self)
 
 
+class CircuitBreaker:
+    """Per-peer circuit breaker: consecutive-failure / latency-SLO trip
+    → open (the peer earns NO fetch traffic, NO admission-gate credit)
+    → half-open after ``cooldown_s`` (exactly ONE trial fetch allowed)
+    → closed on trial success, re-opened on trial failure.
+
+    Why latency trips too: a browning-out peer — alive enough to answer
+    probes, slow enough to lose to recompute — never produces a hard
+    failure, yet every fetch routed to it burns the caller's TTFT. When
+    ``latency_slo_s`` is set, ``failure_threshold`` consecutive
+    transfers slower than the SLO trip the breaker exactly like errors.
+
+    ``now`` is injectable (tests, the virtual-clock sim) — the breaker
+    never reads a clock the caller didn't choose."""
+
+    def __init__(self, failure_threshold: int = 3,
+                 cooldown_s: float = 30.0,
+                 latency_slo_s: Optional[float] = None,
+                 now=time.monotonic):
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.latency_slo_s = latency_slo_s
+        self._now = now
+        self.state = "closed"             # closed | open | half_open
+        self.consecutive_failures = 0
+        self.slow_streak = 0
+        self.trips_total = 0
+        self._opened_at = 0.0
+        self._trial_inflight = False
+
+    def _trip(self) -> None:
+        if self.state != "open":
+            self.trips_total += 1
+        self.state = "open"
+        self._opened_at = self._now()
+        self._trial_inflight = False
+
+    def _refresh(self) -> None:
+        if (self.state == "open"
+                and self._now() - self._opened_at >= self.cooldown_s):
+            self.state = "half_open"      # cooldown elapsed: probe-able
+            self._trial_inflight = False
+
+    def would_allow(self) -> bool:
+        """Pure check (pricing/holder filtering): could a fetch be
+        routed here right now? Never consumes the half-open trial slot."""
+        self._refresh()
+        if self.state == "closed":
+            return True
+        if self.state == "open":
+            return False
+        return not self._trial_inflight   # half-open: one trial at a time
+
+    def allow(self) -> bool:
+        """Consuming check (the fetch path): like :meth:`would_allow`,
+        but a half-open True CLAIMS the single trial slot — released by
+        record_success/record_failure."""
+        if not self.would_allow():
+            return False
+        if self.state == "half_open":
+            self._trial_inflight = True
+        return True
+
+    def record_success(self, latency_s: Optional[float] = None) -> None:
+        self._trial_inflight = False
+        self.consecutive_failures = 0
+        if (self.latency_slo_s is not None and latency_s is not None
+                and latency_s > self.latency_slo_s):
+            # "success" slower than the SLO is a brownout datapoint, not
+            # a recovery — streaks of them trip exactly like failures
+            self.slow_streak += 1
+            if self.state == "half_open":
+                self._trip()              # trial was too slow: back off
+            elif self.slow_streak >= self.failure_threshold:
+                self._trip()
+            return
+        self.slow_streak = 0
+        if self.state in ("half_open", "open"):
+            self.state = "closed"         # half-open trial passed
+        # closed stays closed — success never flaps state (hysteresis)
+
+    def record_failure(self) -> None:
+        self._trial_inflight = False
+        self.consecutive_failures += 1
+        if self.state == "half_open":
+            self._trip()                  # trial failed: full cooldown again
+        elif self.consecutive_failures >= self.failure_threshold:
+            self._trip()
+
+    def describe(self) -> dict:
+        return {"state": self.state,
+                "consecutive_failures": self.consecutive_failures,
+                "slow_streak": self.slow_streak,
+                "trips_total": self.trips_total}
+
+
 class PeerLinkTable:
     """Measured per-peer link costs. Probed once at attach, then every
     real transfer folds into an exponential moving average (alpha 0.3:
-    responsive to a changed path, stable against one slow batch)."""
+    responsive to a changed path, stable against one slow batch).
+
+    Every peer also carries a :class:`CircuitBreaker`: tripped peers are
+    skipped by ``link_for_holders`` (their holdings price as a dead link
+    → the admission gate rejects → the engine recomputes), which is how
+    a browning-out peer loses NetKV routing credit without any central
+    coordination."""
 
     ALPHA = 0.3
 
     def __init__(self, default_gbps: float = 1.0,
-                 default_rtt_s: float = 1e-3):
+                 default_rtt_s: float = 1e-3,
+                 breaker_failure_threshold: int = 3,
+                 breaker_cooldown_s: float = 30.0,
+                 breaker_latency_slo_s: Optional[float] = None,
+                 now=time.monotonic):
         self.default = LinkStats(rtt_s=default_rtt_s, gbps=default_gbps)
         self._links: Dict[int, LinkStats] = {}
+        self._now = now
+        self._breaker_kw = dict(
+            failure_threshold=breaker_failure_threshold,
+            cooldown_s=breaker_cooldown_s,
+            latency_slo_s=breaker_latency_slo_s)
+        self._breakers: Dict[int, CircuitBreaker] = {}
+        # a LinkStats with no bandwidth: what a fully-tripped holder set
+        # prices as (modeled fetch = inf → the gate always rejects)
+        self._dead = LinkStats(rtt_s=float("inf"), gbps=0.0)
 
     def get(self, worker_id: Optional[int]) -> LinkStats:
         if worker_id is None:
@@ -147,15 +262,54 @@ class PeerLinkTable:
 
     def drop(self, worker_id: int) -> None:
         self._links.pop(worker_id, None)
+        self._breakers.pop(worker_id, None)
+
+    # ------------------------------------------------------ circuit breaker
+    def breaker(self, worker_id: int) -> CircuitBreaker:
+        b = self._breakers.get(worker_id)
+        if b is None:
+            b = CircuitBreaker(now=self._now, **self._breaker_kw)
+            self._breakers[worker_id] = b
+        return b
+
+    def usable(self, worker_id: int) -> bool:
+        """False while the peer's breaker is open (and not yet due for a
+        half-open trial) — the RemoteKvStore.peer_usable plug. Pure:
+        never claims the half-open trial slot (the fetch path does)."""
+        return self.breaker(worker_id).would_allow()
+
+    def record_success(self, worker_id: int,
+                       latency_s: Optional[float] = None) -> None:
+        self.breaker(worker_id).record_success(latency_s)
+
+    def record_failure(self, worker_id: int) -> None:
+        self.breaker(worker_id).record_failure()
+
+    def open_breaker_count(self) -> int:
+        return sum(1 for b in self._breakers.values()
+                   if b.state != "closed")
+
+    def breaker_trips_total(self) -> int:
+        return sum(b.trips_total for b in self._breakers.values())
+
+    def breaker_snapshot(self) -> Dict[int, dict]:
+        return {wid: b.describe() for wid, b in self._breakers.items()}
 
     def link_for_holders(self, holders: Sequence[Sequence[int]]) -> LinkStats:
-        """The link the fetch of a matched run would ride: the first peer
-        holder's measured link, or the object-store default when every
-        block is object-held."""
+        """The link the fetch of a matched run would ride: the first
+        UNTRIPPED peer holder's measured link, the object-store default
+        when every block is object-held, or a dead link (gbps=0 →
+        modeled fetch inf → the gate rejects) when every holder's
+        breaker is open — a browning-out peer's blocks price like a
+        miss, so the engine recomputes instead of waiting it out."""
+        any_peer = False
         for hs in holders:
-            if hs:
-                return self.get(hs[0])
-        return self.default
+            for wid in hs:
+                any_peer = True
+                b = self._breakers.get(wid)
+                if b is None or b.would_allow():
+                    return self.get(wid)
+        return self._dead if any_peer else self.default
 
     def avg_gbps(self) -> float:
         if not self._links:
@@ -387,8 +541,11 @@ class KvFabricServer(AsyncEngine):
         Returns False when the dial-back itself failed — the caller
         falls back to the JSON path; a mid-stream failure surfaces to
         the caller as a torn stream (→ recompute), never an error."""
+        from ...runtime.faults import hit_async as _fault
+        from ...runtime.faults import mangle as _mangle
         from ...runtime.tcp import open_stream_sender
         try:
+            await _fault("fabric.dialback", exc=ConnectionError)
             sender = await open_stream_sender(
                 ConnectionInfo.from_dict(conn), timeout=5.0)
         except Exception:  # noqa: BLE001 — caller's server unreachable
@@ -397,7 +554,9 @@ class KvFabricServer(AsyncEngine):
             return False
         try:
             for h in hashes:
-                await sender.send(blocks[h],
+                # torn-frame chaos site: truncated npz bytes must surface
+                # on the fetching side as a failed unpack → recompute
+                await sender.send(_mangle("dataplane.frame", blocks[h]),
                                   header=json.dumps({"h": int(h)}).encode())
             await sender.finish()
         except Exception as e:  # noqa: BLE001 — torn stream: caller recomputes
@@ -515,6 +674,10 @@ class KvFabric:
         self.use_dataplane = os.environ.get(DATAPLANE_ENV, "1") != "0"
         store.peer_fetch = self.fetch_sync
         store.admission = self._admit
+        # circuit breaker (docs/chaos.md): tripped peers vanish from the
+        # store's holder view, so their matched runs fall through to
+        # recompute instead of waiting out a browning-out link
+        store.peer_usable = links.usable
 
     # ------------------------------------------------------------ wiring
     @classmethod
@@ -609,6 +772,8 @@ class KvFabric:
                                              ev.removed.block_hashes)
 
     # -------------------------------------------------------------- probes
+    RPC_TIMEOUT_S = 15.0
+
     async def _call(self, worker_id: int, payload: dict,
                     trace_ctx: Optional[dict] = None) -> dict:
         # explicit propagation (metadata override in runtime/egress.py):
@@ -617,12 +782,26 @@ class KvFabric:
         ctx = Context(payload,
                       metadata={"trace_context": trace_ctx}
                       if trace_ctx else None)
-        stream = await self.client.direct(ctx, worker_id)
-        async for item in stream:
-            if not item.get("ok"):
-                raise RuntimeError(item.get("error", "fabric call failed"))
-            return item
-        raise RuntimeError("fabric peer closed the stream without a reply")
+
+        async def call_once() -> dict:
+            stream = await self.client.direct(ctx, worker_id)
+            async for item in stream:
+                if not item.get("ok"):
+                    raise RuntimeError(item.get("error",
+                                                "fabric call failed"))
+                return item
+            raise RuntimeError(
+                "fabric peer closed the stream without a reply")
+
+        # bounded: a partitioned peer must fail this worker's admission
+        # in RPC_TIMEOUT_S, not hold the onboard path for the transport
+        # stack's worst case (chaos contract: no unbounded fabric await)
+        try:
+            return await asyncio.wait_for(call_once(), self.RPC_TIMEOUT_S)
+        except (asyncio.TimeoutError, TimeoutError):
+            raise RuntimeError(
+                f"fabric call to peer {worker_id:x} timed out after "
+                f"{self.RPC_TIMEOUT_S:.0f}s (partitioned?)") from None
 
     async def probe(self, worker_id: int,
                     nbytes: int = PROBE_BYTES) -> LinkStats:
@@ -730,20 +909,30 @@ class KvFabric:
         (evicted since the announce) or the stream tears — the
         graceful-fallback-to-recompute signal. ``trace_ctx``
         (TraceContext dict) rides the RPC so the peer serves under a
-        child trace."""
+        child trace.
+
+        Every outcome feeds the peer's circuit breaker: failures and
+        SLO-slow transfers trip it (the peer loses holder credit and
+        admission eligibility until a half-open trial passes);
+        successes close it."""
+        from ...runtime.faults import hit_async as _fault
         t0 = time.monotonic()
-        blobs = None
-        if self.use_dataplane:
-            blobs = await self._fetch_blobs_native(worker_id, seq_hashes,
-                                                   trace_ctx)
+        if not self.links.breaker(worker_id).allow():
+            raise KeyError(f"peer {worker_id:x} circuit breaker is open")
+        try:
+            await _fault("fabric.fetch", exc=KeyError)
+            blobs = None
+            if self.use_dataplane:
+                blobs = await self._fetch_blobs_native(
+                    worker_id, seq_hashes, trace_ctx)
+                if blobs is None:
+                    self.dataplane_fallbacks_total += 1
             if blobs is None:
-                self.dataplane_fallbacks_total += 1
-        if blobs is None:
-            blobs = await self._fetch_blobs_json(worker_id, seq_hashes,
-                                                 trace_ctx)
-        self.links.observe_transfer(worker_id, sum(len(b) for b in blobs),
-                                    time.monotonic() - t0)
-        self.peer_fetches_total += 1
+                blobs = await self._fetch_blobs_json(worker_id, seq_hashes,
+                                                     trace_ctx)
+        except Exception:
+            self.links.record_failure(worker_id)
+            raise
 
         def unpack_all():
             # npz decode + stack is bulk CPU work — decode keeps stepping
@@ -753,7 +942,18 @@ class KvFabric:
                         np.stack([b[k] for b in blocks], axis=2))
                     for k in blocks[0]}
 
-        return await asyncio.to_thread(unpack_all)
+        try:
+            unpacked = await asyncio.to_thread(unpack_all)
+        except Exception:
+            # torn frames (truncated npz) are a peer-quality signal too
+            self.links.record_failure(worker_id)
+            raise
+        elapsed = time.monotonic() - t0
+        self.links.record_success(worker_id, elapsed)
+        self.links.observe_transfer(worker_id, sum(len(b) for b in blobs),
+                                    elapsed)
+        self.peer_fetches_total += 1
+        return unpacked
 
     def fetch_sync(self, worker_id: int, seq_hashes: Sequence[int],
                    trace_ctx: Optional[dict] = None) -> dict:
@@ -797,6 +997,11 @@ class KvFabric:
             "remote_dataplane_fetches_total": self.dataplane_fetches_total,
             "remote_dataplane_fallbacks_total":
                 self.dataplane_fallbacks_total,
+            # circuit breaker (the Grafana "Degradation" row): peers
+            # currently tripped/half-open + cumulative trips
+            "remote_breaker_open_peers": self.links.open_breaker_count(),
+            "remote_breaker_trips_total":
+                self.links.breaker_trips_total(),
         }
 
     async def close(self) -> None:

@@ -528,14 +528,18 @@ class KvBlockManager:
         self.remote_store = remote_store
 
     def prepare_prefill(self, prompt: Sequence[int], extra_blocks: int = 1,
-                        seq: Optional[TokenBlockSequence] = None
+                        seq: Optional[TokenBlockSequence] = None,
+                        cold: bool = False
                         ) -> Optional[PrefillPlan]:
         """Match the prompt's full blocks against the pool (device tier, then
         host tier), allocate the remainder (+ room for `extra_blocks` of
         generation). None = out of memory. At least one prompt token is
         always left to recompute so prefill produces the first-token
         logits. ``seq`` may carry the prompt's already-computed hash chain
-        (e.g. from the disagg router's estimate) to avoid re-hashing."""
+        (e.g. from the disagg router's estimate) to avoid re-hashing.
+        ``cold=True`` skips the host/disk/remote cascade entirely (device
+        hits need no onboard) — the engine's graceful fallback after a
+        tier onboard prep failed (EngineRequest.cold_admission)."""
         if seq is None:
             seq = TokenBlockSequence(self.block_size, prompt)
         matchable = seq.sequence_hashes
@@ -548,10 +552,10 @@ class KvBlockManager:
         hit_tokens = len(hit_blocks) * self.block_size
         host_slots: List[int] = []
         disk_hashes: List[int] = []
-        if self.enable_reuse and self.host_pool is not None:
+        if self.enable_reuse and not cold and self.host_pool is not None:
             host_slots = self.host_pool.match_prefix(
                 matchable[len(hit_blocks):])
-        if self.enable_reuse and self.disk_store is not None:
+        if self.enable_reuse and not cold and self.disk_store is not None:
             # G3 cascade: the run of hashes past the host hits. pin=True
             # holds the matched entries against the spill pump's
             # capacity evictions (worker thread) until the admission's
@@ -567,7 +571,8 @@ class KvBlockManager:
         # engine slot leaks spill-pump victims forever (dynalint DL003,
         # PR 5's runtime assert made static for exception edges too).
         try:
-            if self.enable_reuse and self.remote_store is not None:
+            if (self.enable_reuse and not cold
+                    and self.remote_store is not None):
                 # G4 cascade: the run past the disk hits, reachable
                 # through the fleet fabric (peer disk over RPC, or the
                 # shared object store). The store's match is

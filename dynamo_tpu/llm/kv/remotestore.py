@@ -127,6 +127,10 @@ class FsObjectStore:
         return os.path.join(self.root, key)
 
     def put_object(self, key: str, data: bytes) -> int:
+        from ...runtime.faults import hit as _fault
+        from ...runtime.faults import mangle as _mangle
+        _fault("remotestore.put")           # enospc/delay chaos site
+        data = _mangle("remotestore.put", data)
         path = self._path(key)
         d = os.path.dirname(path)
         os.makedirs(d, exist_ok=True)
@@ -406,6 +410,11 @@ class RemoteKvStore:
         self.peer_fetch: Optional[Callable] = None
         #   admission(n_blocks, holders) -> bool  (fabric.AdmissionGate)
         self.admission: Optional[Callable] = None
+        #   peer_usable(worker_id) -> bool (fabric circuit breaker): a
+        #   tripped peer's holdings stop counting as reachable — its
+        #   matched runs fall through to recompute instead of waiting
+        #   out a browning-out link (docs/chaos.md)
+        self.peer_usable: Optional[Callable] = None
         self._lock = threading.RLock()
         # hash → {worker_id: announce monotonic time} (insertion-ordered;
         # first holder is the fetch's first choice)
@@ -453,7 +462,10 @@ class RemoteKvStore:
     # ------------------------------------------------------------- queries
     def holders_of(self, seq_hash: int) -> List[int]:
         with self._lock:
-            return list(self._peers.get(seq_hash, ()))
+            holders = list(self._peers.get(seq_hash, ()))
+        if self.peer_usable is not None:
+            holders = [w for w in holders if self.peer_usable(w)]
+        return holders
 
     def holds_durable(self, seq_hash: int) -> bool:
         """True when OUR durable (object) backend holds the hash — the

@@ -134,6 +134,22 @@ class ForwardPassMetrics:
     remote_dataplane_fetches_total: int = 0
     remote_dataplane_fallbacks_total: int = 0
     prefill_published_blocks_total: int = 0
+    # chaos-hardening round 13 (appended — DL004 append-only evolution;
+    # docs/chaos.md): the graceful-degradation counters the Grafana
+    # "Degradation" row plots. Requests vacated because the client
+    # stopped caring (disconnect → KILL → engine sweep) vs because the
+    # wire-propagated deadline budget ran out engine-side; netstore
+    # calls that burned their whole per-call deadline (a partitioned —
+    # not merely flapping — discovery daemon); the fabric circuit
+    # breaker's currently-tripped peer count + cumulative trips; and
+    # write-behind spill jobs SHED because the disk refused (ENOSPC) —
+    # serving continued without them. Zeros on old payloads.
+    requests_cancelled_total: int = 0
+    requests_deadline_exceeded_total: int = 0
+    netstore_deadline_exceeded_total: int = 0
+    remote_breaker_open_peers: int = 0
+    remote_breaker_trips_total: int = 0
+    disk_spill_shed_total: int = 0
 
     def to_dict(self) -> dict:
         # every field is a scalar; dataclasses.asdict would deep-copy

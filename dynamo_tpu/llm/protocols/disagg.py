@@ -57,6 +57,11 @@ class RemotePrefillRequest:
     # tree instead of as a disjoint prefill-side trace. None on old
     # senders; ignored by old receivers (from_json passes it through).
     trace: Optional[Dict] = None
+    # end-to-end deadline (docs/chaos.md): the REMAINING budget in ms at
+    # enqueue time — the prefill worker re-anchors it to its own clock
+    # and drops the job unstarted when the budget is already gone (the
+    # decode side has long since cancelled). None on old senders.
+    deadline_ms: Optional[float] = None
 
     def to_json(self) -> bytes:
         return json.dumps(dataclasses.asdict(self)).encode()

@@ -120,13 +120,22 @@ class RequestControlMessage:
     ``{trace_id, parent_span, origin_ts}`` (runtime/tracing.py
     TraceContext): when present, the serving side opens its trace as a
     CHILD of the caller's instead of a disjoint root — the fleet-tree
-    stitch edge. Absent on old senders; ignored by old receivers."""
+    stitch edge. Absent on old senders; ignored by old receivers.
+
+    ``deadline_ms`` is the request's REMAINING end-to-end budget at
+    send time (runtime/engine.py EngineContext deadline): the serving
+    side re-anchors it against its own monotonic clock, so the deadline
+    survives hops without clock synchronization. A worker whose budget
+    runs out cancels the request engine-side (slot/hold release within
+    one loop tick) even if the client vanished without a KILL frame.
+    Absent = no deadline; ignored by old receivers."""
 
     id: str
     request_type: str = "single_in"     # single_in | many_in
     response_type: str = "many_out"
     connection_info: Optional[ConnectionInfo] = None
     trace: Optional[dict] = None
+    deadline_ms: Optional[float] = None
 
     def to_json(self) -> bytes:
         d = {"id": self.id, "request_type": self.request_type,
@@ -135,6 +144,8 @@ class RequestControlMessage:
             d["connection_info"] = self.connection_info.to_dict()
         if self.trace is not None:
             d["trace"] = self.trace
+        if self.deadline_ms is not None:
+            d["deadline_ms"] = self.deadline_ms
         return json.dumps(d).encode()
 
     @classmethod
@@ -145,7 +156,8 @@ class RequestControlMessage:
                    request_type=d.get("request_type", "single_in"),
                    response_type=d.get("response_type", "many_out"),
                    connection_info=ConnectionInfo.from_dict(ci) if ci else None,
-                   trace=d.get("trace"))
+                   trace=d.get("trace"),
+                   deadline_ms=d.get("deadline_ms"))
 
 
 # ----------------------------------------------------------------- framing

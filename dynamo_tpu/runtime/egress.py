@@ -212,15 +212,21 @@ class Client(AsyncEngine):
         # (fabric RPCs hopping threads) pass identity by value.
         wire_trace = (ctx.metadata.get("trace_context")
                       or current_wire_context())
+        from .faults import hit_async as _fault
         for attempt in range(self.DISPATCH_ATTEMPTS):
             conn = rt.tcp.connection_info(rx)
+            # deadline propagation: put the REMAINING budget on the wire
+            # (re-sampled per attempt — a retried dispatch must not
+            # resurrect budget already burned waiting)
             ctrl = RequestControlMessage(id=ctx.id, connection_info=conn,
-                                         trace=wire_trace)
+                                         trace=wire_trace,
+                                         deadline_ms=ctx.ctx.remaining_ms())
             payload = encode_two_part(ctrl, self.encode_req(ctx.data))
             deadline = loop.time() + self.DIAL_BACK_TIMEOUT
             delay = 0.05
             try:
                 while True:   # no-responders backoff within this attempt
+                    await _fault("request.egress", exc=RuntimeError)
                     n = await rt.bus.publish(info.subject, payload)
                     if n is None or n > 0:  # None: bus without counts
                         break

@@ -46,15 +46,26 @@ class EngineContext:
       step, emit what it has, and stop issuing new work.
     - ``kill()`` — hard: downstream should drop the stream as soon as possible
       (used by the HTTP layer when a client disconnects mid-SSE).
+    - ``deadline_s`` — optional absolute end-to-end deadline
+      (``time.monotonic()`` clock). Set at the frontend from the
+      request's ``deadline_ms`` budget, propagated on the wire as the
+      REMAINING budget (codec.RequestControlMessage.deadline_ms), and
+      polled by engines between steps exactly like cancellation — a
+      request whose client stopped caring vacates its slot instead of
+      burning capacity.
     """
 
-    __slots__ = ("_id", "_stopped", "_killed", "_stop_event")
+    __slots__ = ("_id", "_stopped", "_killed", "_stop_event", "deadline_s")
 
-    def __init__(self, request_id: Optional[str] = None):
+    def __init__(self, request_id: Optional[str] = None,
+                 deadline_ms: Optional[float] = None):
         self._id = request_id or uuid.uuid4().hex
         self._stopped = False
         self._killed = False
         self._stop_event: Optional[asyncio.Event] = None
+        self.deadline_s: Optional[float] = None
+        if deadline_ms is not None:
+            self.set_deadline_ms(deadline_ms)
 
     @property
     def id(self) -> str:
@@ -84,6 +95,32 @@ class EngineContext:
             if self._stopped:
                 self._stop_event.set()
         await self._stop_event.wait()
+
+    # ----------------------------------------------------------- deadline
+    def set_deadline_ms(self, budget_ms: float) -> None:
+        """Arm (or tighten) the end-to-end deadline ``budget_ms`` from
+        now. A second call never LOOSENS an armed deadline — each hop
+        may only shrink the remaining budget."""
+        import time
+        d = time.monotonic() + max(float(budget_ms), 0.0) / 1e3
+        if self.deadline_s is None or d < self.deadline_s:
+            self.deadline_s = d
+
+    def remaining_ms(self) -> Optional[float]:
+        """Remaining budget in ms (clamped at 0), or None when no
+        deadline is armed — what egress puts on the wire so the serving
+        side re-anchors to its own clock."""
+        if self.deadline_s is None:
+            return None
+        import time
+        return max(self.deadline_s - time.monotonic(), 0.0) * 1e3
+
+    @property
+    def deadline_exceeded(self) -> bool:
+        if self.deadline_s is None:
+            return False
+        import time
+        return time.monotonic() >= self.deadline_s
 
 
 class Context(Generic[T]):

@@ -197,8 +197,13 @@ class EndpointServer:
                 self._ff_forget(ctrl.id)
             return
         from .engine import EngineContext
+        from .faults import hit_async as _fault
         from .tracing import Trace, span, use_trace
-        ctx = Context(request, ctx=EngineContext(ctrl.id))
+        # deadline re-anchoring: the wire carries the REMAINING budget;
+        # binding it to this side's monotonic clock here means engines
+        # poll one absolute deadline with no cross-host clock coupling
+        ctx = Context(request, ctx=EngineContext(
+            ctrl.id, deadline_ms=ctrl.deadline_ms))
         # worker-side trace under the SAME request id the frontend logged
         # (ingress prologue → engine → first frame → stream end). When the
         # control message carries a propagated TraceContext this becomes a
@@ -208,6 +213,7 @@ class EndpointServer:
                                        role="worker")) as trace:
             with span("engine.accept"):
                 try:
+                    await _fault("request.ingress")
                     stream = await self.engine.generate(ctx)
                 except Exception as e:
                     trace.set_error(str(e))

@@ -115,15 +115,34 @@ class Lease:
                 self._keepalive_loop(), name=f"lease-keepalive-{self.id:x}")
 
     async def _keepalive_loop(self) -> None:
+        from .faults import hit_async as _fault
         interval = max(self.ttl / 3.0, 0.05)
         while not self._revoked:
             await asyncio.sleep(interval)
             if self._revoked:
                 return
-            try:
-                ok = await self.store.lease_refresh(self.id)
-            except Exception:
-                ok = False
+            # transient-flap tolerance (chaos-hardening): a refresh that
+            # RAISED (store link hiccup) is retried quickly inside the
+            # remaining TTL window before the lease is declared lost —
+            # one dropped RPC must not tear down a healthy worker. A
+            # refresh that RETURNED False is authoritative (the store
+            # says the lease is gone): give up immediately; NetKvStore's
+            # lease_refresh already attempts reclaim-by-id internally.
+            deadline = asyncio.get_running_loop().time() + (
+                self.ttl - interval)
+            ok = False
+            while not self._revoked:
+                try:
+                    await _fault("kvstore.lease.keepalive",
+                                 exc=ConnectionError)
+                    ok = await self.store.lease_refresh(self.id)
+                    break
+                except Exception:
+                    if asyncio.get_running_loop().time() >= deadline:
+                        break
+                    await asyncio.sleep(min(interval / 4, 0.25))
+            if self._revoked:
+                return
             if not ok:
                 self._revoked = True
                 if self.on_lost is not None:

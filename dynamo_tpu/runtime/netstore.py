@@ -13,6 +13,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import logging
+import os
 import random
 from typing import Dict, List, Optional
 
@@ -28,15 +29,35 @@ logger = logging.getLogger("dynamo_tpu.runtime.netstore")
 # daemon link is flapping; each worker's stats handler exports it via
 # ForwardPassMetrics.netstore_retries_total)
 _retries_total = 0
+# process-wide deadline-exceeded counter (nv_llm_netstore_deadline_
+# exceeded_total): calls that burned their whole per-call budget —
+# rising means the daemon is partitioned/unresponsive, not just flapping
+_deadline_exceeded_total = 0
+
+
+class NetstoreDeadlineExceeded(ConnectionError):
+    """A call()'s total per-call deadline elapsed — the typed signal
+    that the daemon is partitioned (connected-but-unresponsive) rather
+    than flapping. Subclasses ConnectionError so existing degradation
+    paths (retry ladders, best-effort deregistration) keep engaging."""
 
 
 def retries_total() -> int:
     return _retries_total
 
 
+def deadline_exceeded_total() -> int:
+    return _deadline_exceeded_total
+
+
 def _count_retry() -> None:
     global _retries_total
     _retries_total += 1
+
+
+def _count_deadline() -> None:
+    global _deadline_exceeded_total
+    _deadline_exceeded_total += 1
 
 
 def _b64(b: bytes) -> str:
@@ -68,6 +89,14 @@ class _Conn:
     # the time window runs out first ends the retry loop — a partitioned
     # daemon fails callers in bounded time instead of spinning
     MAX_CALL_RETRIES = 8
+    # TOTAL per-call deadline on top of the retry ladder: the window
+    # above only binds BETWEEN attempts, so a connected-but-unresponsive
+    # (partitioned) daemon could hold one attempt's reply future
+    # forever. Every in-flight attempt is clipped to the remaining
+    # budget and exhaustion raises NetstoreDeadlineExceeded (counted in
+    # nv_llm_netstore_deadline_exceeded_total).
+    CALL_DEADLINE = float(os.environ.get("DYN_NETSTORE_CALL_DEADLINE",
+                                         "20.0"))
     # jitter factor range on every backoff sleep: N reconnecting clients
     # of a restarted daemon must not stampede it in lockstep
     RETRY_JITTER = (0.5, 1.5)
@@ -244,17 +273,41 @@ class _Conn:
         When a request trace is ambient (runtime/tracing.py) the call is
         recorded as a ``netstore.{op}`` span — control-plane RPCs issued
         on a request's critical path (discovery lookups, lease work)
-        show up in the fleet trace instead of hiding in the daemon."""
+        show up in the fleet trace instead of hiding in the daemon.
+
+        A TOTAL per-call deadline (CALL_DEADLINE) rides on top: each
+        attempt's reply wait is clipped to the remaining budget, so a
+        partitioned daemon — connected but never answering — fails the
+        caller in bounded time with :class:`NetstoreDeadlineExceeded`
+        instead of holding it for the full jittered retry ladder."""
+        from . import faults
         from .tracing import span as _span
         loop = asyncio.get_running_loop()
         deadline = loop.time() + self.RETRY_WINDOW
+        call_deadline = loop.time() + self.CALL_DEADLINE
         delay = 0.05
         attempts = 0
         while True:
+            remaining = call_deadline - loop.time()
+            if remaining <= 0:
+                _count_deadline()
+                raise NetstoreDeadlineExceeded(
+                    f"netstore call {op!r} exceeded its "
+                    f"{self.CALL_DEADLINE:.0f}s deadline after "
+                    f"{attempts} retries")
             try:
+                await faults.hit_async("netstore.call",
+                                       exc=ConnectionError)
                 await self._ensure_connected()
                 with _span(f"netstore.{op}"):
-                    return await self._call_once(op, **kwargs)
+                    return await asyncio.wait_for(
+                        self._call_once(op, **kwargs), remaining)
+            except (asyncio.TimeoutError, TimeoutError):
+                _count_deadline()
+                raise NetstoreDeadlineExceeded(
+                    f"netstore call {op!r} exceeded its "
+                    f"{self.CALL_DEADLINE:.0f}s deadline mid-attempt "
+                    f"(daemon partitioned?)") from None
             except ConnectionError:
                 attempts += 1
                 if (self.closed or loop.time() >= deadline
@@ -262,8 +315,9 @@ class _Conn:
                     raise
                 self.retries_total += 1
                 _count_retry()
-                await asyncio.sleep(delay * random.uniform(
-                    *self.RETRY_JITTER))
+                await asyncio.sleep(min(
+                    delay * random.uniform(*self.RETRY_JITTER),
+                    max(call_deadline - loop.time(), 0.001)))
                 delay = min(delay * 2, 1.0)
 
     async def close(self) -> None:

@@ -107,7 +107,12 @@ class Wal:
         return self._f
 
     def append(self, rec: dict) -> None:
-        """Durably append one record; returns only once it is on disk."""
+        """Durably append one record; returns only once it is on disk.
+        Raises OSError (e.g. ENOSPC) when the disk refuses — the daemon
+        fails THAT op to its caller instead of acknowledging an append
+        that never became durable."""
+        from .faults import hit as _fault
+        _fault("wal.append")                 # enospc/delay chaos site
         f = self._file()
         f.write(json.dumps(rec) + "\n")
         f.flush()

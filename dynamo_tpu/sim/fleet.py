@@ -214,7 +214,7 @@ class SimFleet:
             "retried": 0, "no_capacity": 0, "remote_prefills": 0,
             "fabric_fetch_blocks": 0, "hit_blocks": 0, "isl_blocks": 0,
             "crashes": 0, "clean_exits": 0, "forced_exits": 0,
-            "spawned": 0,
+            "spawned": 0, "shed_writes": 0,
         }
         self.ttft_ms: List[float] = []
         self.itl_ms: List[float] = []
@@ -386,6 +386,12 @@ class SimFleet:
         self.draining.add(w.worker_id)
         self.log.log("drain_begin", worker=w.worker_id)
 
+    def on_shed_writes(self, w: SimWorker, n: int) -> None:
+        """Disk-pressure fault: a demote the colder tier refused — the
+        write-behind sheds and serving continues (disk_pressure
+        scenario's asserted behavior)."""
+        self.counters["shed_writes"] += n
+
     async def _drain_watch(self, watcher, pool: Dict[int, SimWorker]
                            ) -> None:
         from ..runtime.tracing import detach_trace
@@ -421,13 +427,17 @@ class SimFleet:
         while True:
             for w in list(self.workers.values()) + list(
                     self.prefill_workers.values()):
-                if not w.dead:
+                if not w.dead and not w.partitioned:
+                    # a partitioned worker's stats plane is dark: its
+                    # last-published record goes stale — the planner's
+                    # view of the brownout (sim/scenarios.py
+                    # partition_brownout)
                     await store.kv_put(
                         w.endpoint.stats_key(w.worker_id), w.stats_json())
             await asyncio.sleep(self.cfg.stats_interval_s)
 
     def _scrape_once(self, sample: bool = False) -> None:
-        eps = [ScoringEndpoint(w.worker_id, w.refresh_metrics())
+        eps = [ScoringEndpoint(w.worker_id, w.scraped_metrics())
                for w in self.workers.values() if not w.dead]
         self.scheduler.update_endpoints(ProcessedEndpoints(eps))
         if sample and eps:

@@ -82,6 +82,41 @@ def _fault_flush(fleet: SimFleet) -> None:
     fleet.log.log("prefix_flush", blocks=n)
 
 
+def _fault_brownout(count: int, latency_factor: float = 8.0):
+    """Slow-not-dead + stats partition: ``count`` workers keep serving
+    at latency_factor× while their published stats freeze — the router
+    and planner keep seeing the healthy pre-brownout numbers (the
+    kvstore-partition shape). Deterministic victim choice (sorted)."""
+
+    def fault(fleet: SimFleet) -> None:
+        live = sorted(w for w, x in fleet.workers.items() if not x.dead)
+        for wid in live[-count:]:
+            fleet.workers[wid].set_brownout(latency_factor,
+                                            partition=True)
+            fleet.log.log("brownout", worker=wid,
+                          factor=latency_factor)
+    return fault
+
+
+def _fault_brownout_recover(fleet: SimFleet) -> None:
+    for wid, w in sorted(fleet.workers.items()):
+        if not w.dead and w.partitioned:
+            w.clear_brownout()
+            fleet.log.log("brownout_recover", worker=wid)
+
+
+def _fault_disk_pressure(full: bool):
+    """ENOSPC mid-spill fleet-wide: every worker's demote tier refuses
+    writes; the write-behind SHEDS (counted) and serving continues."""
+
+    def fault(fleet: SimFleet) -> None:
+        for wid, w in sorted(fleet.workers.items()):
+            if not w.dead:
+                w.disk_full = full
+        fleet.log.log("disk_pressure", full=full)
+    return fault
+
+
 # --------------------------------------------------------------- builders
 def _baseline_hour(seed: int, replicas: int = 200,
                    duration_s: float = 3600.0):
@@ -441,6 +476,105 @@ def _check_prefill_storm(fleet: SimFleet, r: dict) -> List[str]:
     return v
 
 
+def _partition_brownout(seed: int, replicas: int = 12,
+                        duration_s: float = 1400.0):
+    """Chaos-hardening scenario (ISSUE 13): 3 replicas brown out at
+    t=240 — serving 8× slower with FROZEN published stats (the router
+    and planner see the stale healthy view) — and recover at t=700.
+    The fleet must absorb the brownout without hanging or dropping:
+    retries/queueing carry the slow window, the planner may scale into
+    the pressure, and late-window SLO must recover once the brownout
+    lifts."""
+    slo = ServiceLevelObjective(
+        ttft_p90_ms=5000.0, itl_p90_ms=600.0, max_queue_depth=3.0,
+        min_decode_workers=replicas - 2, max_decode_workers=replicas + 8)
+    cfg = FleetConfig(
+        replicas=replicas, slots=4, kv_blocks=512,
+        perf=_perf_small(), slo=slo,
+        planner_cfg=PlannerConfig(interval_s=2.0, cooldown_s=20.0,
+                                  breach_cycles=3, scale_step=2,
+                                  drain_timeout_s=120.0, drain_poll_s=0.5,
+                                  status_interval_s=10.0),
+        stats_interval_s=2.0, scrape_interval_s=1.0,
+        provision_delay_s=15.0, drainout_s=600.0)
+    wl = generate_workload(duration_s * 0.7, seed, base_rps=2.0,
+                           peak_rps=3.5, osl_base=48, osl_spread=96)
+    faults = ((240.0, "brownout", _fault_brownout(3, 8.0)),
+              (700.0, "brownout_recover", _fault_brownout_recover))
+    return cfg, wl, faults, duration_s
+
+
+def _check_partition_brownout(fleet: SimFleet, r: dict) -> List[str]:
+    v = []
+    if fleet.log.count("brownout") < 3:
+        v.append("brownout fault never browned out 3 workers")
+    if fleet.log.count("brownout_recover") < 3:
+        v.append("browned-out workers never recovered")
+    if r["requests"]["dropped"]:
+        v.append(f"dropped {r['requests']['dropped']} in-flight requests")
+    if r["requests"]["completed"] != r["requests"]["arrived"]:
+        v.append("not every request completed — something hung")
+    # the brownout must actually BITE: TTFT p90 across the brownout
+    # window above the pre-brownout window (slow-not-dead, not a no-op)
+    from ..llm.slo import percentile
+    pre = percentile([f["ttft_ms"] for t, f in
+                      fleet.log.of_kind("complete") if t < 240.0], 90)
+    mid = percentile([f["ttft_ms"] for t, f in
+                      fleet.log.of_kind("complete")
+                      if 260.0 <= t < 700.0], 90)
+    if pre is not None and mid is not None and mid <= pre:
+        v.append("brownout produced no TTFT degradation — "
+                 "the fault was a no-op")
+    if r["slo"]["late_attainment"] < 0.9:
+        v.append(f"late-window TTFT attainment "
+                 f"{r['slo']['late_attainment']} < 0.9 — SLO never "
+                 f"recovered after the brownout lifted")
+    return v
+
+
+def _disk_pressure(seed: int, replicas: int = 8,
+                   duration_s: float = 1200.0):
+    """Chaos-hardening scenario (ISSUE 13): fleet-wide ENOSPC mid-spill
+    at t=300 (every demote refused until t=700). Write-behind must SHED
+    — cache blocks are lost, counted, and serving continues — with zero
+    drops and late-window SLO recovered."""
+    slo = ServiceLevelObjective(
+        ttft_p90_ms=5000.0, itl_p90_ms=600.0, max_queue_depth=3.0,
+        min_decode_workers=replicas, max_decode_workers=replicas + 4)
+    cfg = FleetConfig(
+        # small device tier + agentic reuse → steady demote pressure,
+        # so the refused-writes window has real traffic to shed
+        replicas=replicas, slots=4, kv_blocks=96, host_blocks=64,
+        perf=_perf_small(), slo=slo,
+        planner_cfg=PlannerConfig(interval_s=5.0, cooldown_s=30.0,
+                                  status_interval_s=20.0),
+        stats_interval_s=2.0, scrape_interval_s=1.0, drainout_s=600.0)
+    wl = generate_workload(duration_s * 0.7, seed, base_rps=2.5,
+                           peak_rps=5.0, tenants=4, agentic_frac=0.6,
+                           osl_base=48, osl_spread=96)
+    faults = ((300.0, "disk_pressure_on", _fault_disk_pressure(True)),
+              (700.0, "disk_pressure_off", _fault_disk_pressure(False)))
+    return cfg, wl, faults, duration_s
+
+
+def _check_disk_pressure(fleet: SimFleet, r: dict) -> List[str]:
+    v = []
+    if fleet.log.count("disk_pressure") < 2:
+        v.append("disk pressure fault never toggled on+off")
+    if r["requests"]["shed_writes"] < 20:
+        v.append(f"only {r['requests']['shed_writes']} writes shed — "
+                 f"the pressure window never refused real spill traffic")
+    if r["requests"]["dropped"]:
+        v.append(f"dropped {r['requests']['dropped']} requests — "
+                 f"disk pressure must shed cache, not serving")
+    if r["requests"]["completed"] != r["requests"]["arrived"]:
+        v.append("not every request completed — something hung")
+    if r["slo"]["late_attainment"] < 0.9:
+        v.append(f"late-window TTFT attainment "
+                 f"{r['slo']['late_attainment']} < 0.9")
+    return v
+
+
 def _check_disagg_retune(fleet: SimFleet, r: dict) -> List[str]:
     v = []
     if r["requests"]["remote_prefills"] < 10:
@@ -490,6 +624,16 @@ SCENARIOS: Dict[str, Scenario] = {
         "prefix-miss surge backs up the prefill queue; the planner "
         "scales the prefill tier out and SLO recovers",
         _prefill_storm, _check_prefill_storm),
+    "partition_brownout": Scenario(
+        "partition_brownout",
+        "slow-not-dead replicas with frozen (partitioned) stats; zero "
+        "hangs, zero drops, SLO recovers after the brownout lifts",
+        _partition_brownout, _check_partition_brownout),
+    "disk_pressure": Scenario(
+        "disk_pressure",
+        "fleet-wide ENOSPC mid-spill; write-behind sheds (counted), "
+        "serving continues, SLO holds",
+        _disk_pressure, _check_disk_pressure),
 }
 
 

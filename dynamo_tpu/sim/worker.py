@@ -128,6 +128,16 @@ class SimWorker:
         self.exited_clean = False
         self.prefills_done = 0
         self.decodes_done = 0
+        # chaos-scenario state (docs/chaos.md): a PARTITIONED worker
+        # keeps serving but its stats/scrape view freezes (the
+        # kvstore-partition shape — routers/planner see stale numbers);
+        # a worker under disk pressure SHEDS demote writes instead of
+        # landing them in the colder tier (the ENOSPC write-behind
+        # shape), counted in shed_writes.
+        self.partitioned = False
+        self.frozen_metrics: Optional[ForwardPassMetrics] = None
+        self.disk_full = False
+        self.shed_writes = 0
         self._timer: Optional[asyncio.TimerHandle] = None
         self._crash_timer: Optional[asyncio.TimerHandle] = None
         self._last_t = 0.0
@@ -318,9 +328,51 @@ class SimWorker:
         if evicted:
             self._demote(evicted)
 
+    # ----------------------------------------------------- chaos controls
+    def set_brownout(self, latency_factor: float,
+                     partition: bool = True) -> None:
+        """Slow-not-dead: inflate every service time ``latency_factor``×
+        and (optionally) freeze the worker's published stats — the
+        router/planner keep seeing the pre-brownout numbers, exactly the
+        stale-view a kvstore partition produces."""
+        self.profile = BehaviorProfile(
+            name=f"brownout:{latency_factor:g}",
+            latency_factor=latency_factor)
+        self.partitioned = partition
+        if partition and self.frozen_metrics is None:
+            self.frozen_metrics = ForwardPassMetrics.from_dict(
+                self.refresh_metrics().to_dict())
+        if not partition:
+            self.frozen_metrics = None
+        self._fire()                     # reschedule at the new speed
+
+    def clear_brownout(self) -> None:
+        self.profile = BehaviorProfile(name="steady")
+        self.partitioned = False
+        self.frozen_metrics = None
+        self._fire()
+
+    def scraped_metrics(self) -> ForwardPassMetrics:
+        """What the router/planner see: live numbers, or the frozen
+        pre-partition snapshot while the stats plane is dark."""
+        if self.partitioned and self.frozen_metrics is not None:
+            return self.frozen_metrics
+        return self.refresh_metrics()
+
     def _demote(self, hashes: List[int]) -> None:
         """Device eviction → host-tier demote announce; host overflow →
-        removed announce (the router's tier-weighted view tracks both)."""
+        removed announce (the router's tier-weighted view tracks both).
+        Under disk pressure (``disk_full``) the demote is SHED: the
+        blocks leave the ladder immediately (removed announce) and the
+        shed is counted — the sim analog of the spill pump's
+        ENOSPC-shedding (diskstore.DiskSpillEngine.shed_writes_total)."""
+        if self.disk_full:
+            self.shed_writes += len(hashes)
+            self.fleet.on_shed_writes(self, len(hashes))
+            self.fleet.apply_kv_event(RouterEvent(
+                worker_id=self.worker_id,
+                removed=KvRemovedEvent(block_hashes=list(hashes))))
+            return
         host = self.host_resident
         for h in hashes:
             host[h] = None

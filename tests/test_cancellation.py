@@ -1,0 +1,334 @@
+"""End-to-end cancellation/deadline edges (docs/chaos.md): a request
+whose client stopped caring — disconnect, explicit stop, or an expired
+deadline budget — vacates engine slots, KV holds, and tier pins within
+one engine-loop tick, while SURVIVING requests stream bit-exact vs an
+uncontended run. Covers mid-prefill (waiting), mid-decode, mid-onboard,
+mid-disagg-handoff, the live loopback request-plane chain, and recorded
+replay with a cancellation in the schedule."""
+
+import asyncio
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.config import EngineConfig, ModelConfig
+from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineCore, EngineRequest
+from dynamo_tpu.engine.sampling import SlotSampling
+from dynamo_tpu.llm.protocols.common import FinishReason
+from dynamo_tpu.runtime import faults
+from dynamo_tpu.runtime.engine import Context, EngineContext
+
+from fixtures import wait_until
+
+pytestmark = [pytest.mark.asyncio, pytest.mark.chaos]
+
+TINY = ModelConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                   num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                   max_position_embeddings=256)
+
+
+def make_core(**over) -> EngineCore:
+    cfg = EngineConfig(**{
+        "max_model_len": 64, "kv_block_size": 4, "num_kv_blocks": 32,
+        "max_num_seqs": 2, "prefill_buckets": [16, 32, 64], **over})
+    return EngineCore(TINY, cfg, attn_impl="xla", param_dtype=jnp.float32)
+
+
+def make_req(prompt, rid="r", max_new=8, ctx=None):
+    return EngineRequest(rid=rid, prompt=list(prompt),
+                         sampling=SlotSampling(temperature=0.0),
+                         max_new_tokens=max_new, eos_ids=frozenset(),
+                         ctx=ctx)
+
+
+async def drain(req, timeout=120):
+    toks = []
+    while True:
+        item, payload = await asyncio.wait_for(req.out_queue.get(), timeout)
+        if item is FINISH_SENTINEL:
+            return toks, payload
+        toks.append(item)
+
+
+def assert_pool_baseline(core):
+    """No leaked holds/pins/slots: the acceptance criterion asserted
+    after every cancellation edge."""
+    assert core.kv_manager.pool.used_blocks == 0
+    assert all(s is None for s in core.slots)
+    host = core.kv_manager.host_pool
+    if host is not None:
+        assert not host._pins
+    if core.disk_store is not None:
+        assert not core.disk_store._pins
+
+
+@pytest.fixture(autouse=True)
+def _disarm_after():
+    yield
+    faults.disarm_all()
+
+
+async def test_cancel_mid_decode_frees_within_a_tick_survivor_exact():
+    rng = np.random.default_rng(5)
+    pa = rng.integers(1, 120, size=12).tolist()
+    pb = rng.integers(1, 120, size=12).tolist()
+
+    ref_core = make_core()
+    try:
+        ref, _ = await drain(await _submit(ref_core, pb, "ref", 20))
+    finally:
+        await ref_core.stop()
+
+    core = make_core()
+    try:
+        ca = EngineContext("a")
+        ra = make_req(pa, "a", max_new=40, ctx=ca)
+        await core.submit(ra)
+        rb = make_req(pb, "b", max_new=20)
+        await core.submit(rb)
+        # let A emit a little, then the client goes away
+        for _ in range(3):
+            await asyncio.wait_for(ra.out_queue.get(), 60)
+        ca.kill()
+        toks_a, reason_a = await drain(ra)
+        assert reason_a == FinishReason.CANCELLED
+        toks_b, reason_b = await drain(rb)
+        assert reason_b == FinishReason.LENGTH
+        assert toks_b == ref                  # survivor bit-exact
+        assert core.requests_cancelled_total == 1
+        assert core.requests_deadline_exceeded_total == 0
+        await wait_until(lambda: core.kv_manager.pool.used_blocks == 0,
+                         "cancelled blocks released")
+        assert_pool_baseline(core)
+    finally:
+        await core.stop()
+
+
+async def _submit(core, prompt, rid, max_new, ctx=None):
+    req = make_req(prompt, rid, max_new=max_new, ctx=ctx)
+    await core.submit(req)
+    return req
+
+
+async def test_cancel_mid_prefill_queue_never_takes_a_slot():
+    core = make_core(max_num_seqs=1)
+    try:
+        ra = await _submit(core, list(range(1, 13)), "a", 30)
+        cb = EngineContext("b")
+        rb = await _submit(core, list(range(20, 32)), "b",
+                           30, ctx=cb)
+        cb.stop_generating()                  # cancelled while WAITING
+        _toks, reason = await drain(rb)
+        assert reason == FinishReason.CANCELLED
+        assert rb.slot == -1 and rb.generated == 0   # never admitted
+        _ = await drain(ra)
+        assert core.requests_cancelled_total == 1
+        assert_pool_baseline(core)
+    finally:
+        await core.stop()
+
+
+async def test_cancel_mid_onboard_rewinds_holds_and_pins():
+    """Client disconnect while the host-tier onboard prep is in flight:
+    the deferred admission resolves to CANCELLED, the plan's blocks and
+    the tier pins all release."""
+    core = make_core(host_kv_blocks=16)
+    try:
+        prompt = list(range(1, 13))
+        await drain(await _submit(core, prompt, "warm", 4))
+        await core.offload_engine.drain()
+        core.kv_manager.pool.reset()          # force the host-tier path
+        faults.arm("engine.onboard", "delay:300")
+        ctx = EngineContext("c")
+        req = await _submit(core, prompt, "c", 4, ctx=ctx)
+        # wait for the onboard to START (slot reserved, not ready)
+        await wait_until(lambda: any(s is req and not req.ready
+                                     for s in core.slots),
+                         "onboard reservation")
+        ctx.kill()                            # mid-onboard disconnect
+        _toks, reason = await drain(req)
+        assert reason == FinishReason.CANCELLED
+        assert core.requests_cancelled_total == 1
+        assert_pool_baseline(core)
+        # the engine still serves (nothing wedged by the rewind)
+        faults.disarm_all()
+        toks, reason = await drain(await _submit(core, prompt, "after", 4))
+        assert reason == FinishReason.LENGTH and len(toks) == 4
+    finally:
+        await core.stop()
+
+
+async def test_deadline_exceeded_mid_decode_counted_separately():
+    core = make_core()
+    try:
+        ctx = EngineContext("d", deadline_ms=250.0)
+        req = await _submit(core, list(range(1, 13)), "d", 10_000,
+                            ctx=ctx)
+        _toks, reason = await drain(req)
+        assert reason == FinishReason.CANCELLED
+        assert core.requests_deadline_exceeded_total == 1
+        assert core.requests_cancelled_total == 0
+        assert_pool_baseline(core)
+    finally:
+        await core.stop()
+
+
+async def test_recorded_replay_with_cancellation_in_schedule():
+    """A schedule containing a cancellation replays: the recorded
+    dispatches + releases reproduce every harvested token (the
+    surviving stream's bit-exactness holds through the recorder too)."""
+    from dynamo_tpu.engine.replay import Recorder, compare_replay, replay
+    rng = np.random.default_rng(9)
+    pa = rng.integers(1, 120, size=12).tolist()
+    pb = rng.integers(1, 120, size=12).tolist()
+    core = make_core(decode_steps_per_dispatch=4)
+    core.recorder = Recorder()
+    try:
+        ca = EngineContext("a")
+        ra = await _submit(core, pa, "a", 40, ctx=ca)
+        rb = await _submit(core, pb, "b", 16)
+        for _ in range(2):
+            await asyncio.wait_for(ra.out_queue.get(), 60)
+        ca.kill()
+        _ta, reason_a = await drain(ra)
+        tb, reason_b = await drain(rb)
+        assert reason_a == FinishReason.CANCELLED
+        assert reason_b == FinishReason.LENGTH and len(tb) == 16
+        rep = replay(core, core.recorder.events)
+        assert compare_replay(core.recorder.events, rep) == []
+        assert_pool_baseline(core)
+    finally:
+        await core.stop()
+
+
+async def test_loopback_chain_client_disconnect_vacates_engine():
+    """The acceptance chain: frontend-side kill → KILL control frame on
+    the response stream → worker-side ctx.kill → engine sweep frees the
+    slot and holds — over the REAL request plane (bus dispatch + TCP
+    dial-back), within one engine-loop tick."""
+    from dynamo_tpu.llm.engines.jax_engine import JaxEngine
+    from dynamo_tpu.llm.protocols.annotated import encode_annotated_json
+    from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+    from dynamo_tpu.runtime.distributed import DistributedRuntime, Endpoint
+
+    rt = DistributedRuntime.in_process()
+    core = make_core()
+    ep = Endpoint(rt, "ns", "worker", "generate")
+    await ep.serve(
+        JaxEngine(core),
+        decode_req=lambda raw: PreprocessedRequest.from_dict(
+            json.loads(raw)),
+        encode_resp=encode_annotated_json)
+    client = await ep.client().start()
+    await client.wait_for_instances(30)
+    try:
+        pre = PreprocessedRequest(
+            token_ids=list(range(1, 13)),
+            stop_conditions=StopConditions(max_tokens=10_000,
+                                           ignore_eos=True),
+            sampling_options=SamplingOptions(greedy=True))
+        import dataclasses as _dc
+        ctx = Context(_dc.asdict(pre), ctx=EngineContext("kill-me"))
+        stream = await client.random(ctx)
+        it = stream.__aiter__()
+        for _ in range(2):                    # stream is live
+            await asyncio.wait_for(it.__anext__(), 60)
+        ctx.ctx.kill()                        # the client disconnect
+        with pytest.raises(StopAsyncIteration):
+            while True:
+                await asyncio.wait_for(it.__anext__(), 60)
+        await wait_until(
+            lambda: (core.requests_cancelled_total == 1
+                     and core.kv_manager.pool.used_blocks == 0
+                     and all(s is None for s in core.slots)),
+            "engine vacated after client kill")
+        assert_pool_baseline(core)
+    finally:
+        await client.close()
+        await rt.shutdown()
+        await core.stop()
+
+
+async def test_loopback_chain_deadline_rides_the_wire():
+    """deadline_ms set frontend-side rides RequestControlMessage, is
+    re-anchored worker-side, and the engine counts the expiry as
+    deadline-exceeded (not a plain cancel)."""
+    from dynamo_tpu.llm.engines.jax_engine import JaxEngine
+    from dynamo_tpu.llm.protocols.annotated import encode_annotated_json
+    from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+    from dynamo_tpu.runtime.distributed import DistributedRuntime, Endpoint
+
+    rt = DistributedRuntime.in_process()
+    core = make_core()
+    ep = Endpoint(rt, "ns", "worker", "generate")
+    await ep.serve(
+        JaxEngine(core),
+        decode_req=lambda raw: PreprocessedRequest.from_dict(
+            json.loads(raw)),
+        encode_resp=encode_annotated_json)
+    client = await ep.client().start()
+    await client.wait_for_instances(30)
+    try:
+        pre = PreprocessedRequest(
+            token_ids=list(range(1, 13)),
+            stop_conditions=StopConditions(max_tokens=10_000,
+                                           ignore_eos=True),
+            sampling_options=SamplingOptions(greedy=True))
+        import dataclasses as _dc
+        ctx = Context(_dc.asdict(pre),
+                      ctx=EngineContext("dl", deadline_ms=300.0))
+        stream = await client.random(ctx)
+        async for _ in stream:
+            pass                              # ends when the budget does
+        await wait_until(
+            lambda: core.requests_deadline_exceeded_total == 1,
+            "worker-side deadline enforcement")
+        assert core.requests_cancelled_total == 0
+        assert_pool_baseline(core)
+    finally:
+        await client.close()
+        await rt.shutdown()
+        await core.stop()
+
+
+async def test_disagg_handoff_deadline_expired_job_dropped_unstarted():
+    """Mid-disagg-handoff edge: a prefill job whose wire-propagated
+    budget is already gone is dropped before any engine work — acked off
+    the queue, error frame to the (long-gone) decode sink, zero
+    prefills run."""
+    from dynamo_tpu.llm.disagg import PrefillQueue, PrefillWorker
+    from dynamo_tpu.llm.protocols.disagg import RemotePrefillRequest
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    rt = DistributedRuntime.in_process()
+    await rt.tcp.start()
+    core = make_core()
+    worker = await PrefillWorker(core, rt).start()
+    try:
+        rx = rt.tcp.register()
+        rpr = RemotePrefillRequest(
+            request_id="late", token_ids=list(range(1, 13)),
+            sampling={"temperature": 0.0},
+            connection_info=rt.tcp.connection_info(rx).to_dict(),
+            deadline_ms=0.0)                  # budget already burned
+        await PrefillQueue(rt).enqueue(rpr)
+        # the decode-side sink sees the error frame, not a KV payload
+        from dynamo_tpu.runtime.codec import FrameKind
+        await rx.wait_connected(timeout=30)
+        f = await rx.next_frame(timeout=30)
+        assert f is not None and f.kind == FrameKind.ERROR
+        assert "deadline" in f.header_json().get("error", "")
+        await wait_until(lambda: not worker._inflight, "job retired")
+        assert core.total_prefill_tokens == 0     # never ran
+        assert worker.prefills_done == 0
+        assert await PrefillQueue(rt).depth() == 0    # acked, not stuck
+    finally:
+        await worker.stop()
+        await core.stop()
+        await rt.shutdown()

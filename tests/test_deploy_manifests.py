@@ -220,7 +220,8 @@ def test_grafana_dashboard_queries_real_metrics():
         metric_names.update(re.findall(r"[a-z_]{4,}_(?:total|seconds_bucket|"
                                        r"requests|blocks|slots|waiting|perc|"
                                        r"rate)", e))
-    from dynamo_tpu.components.metrics import (_GAUGE_FIELDS,
+    from dynamo_tpu.components.metrics import (_DEGRADE_GAUGES,
+                                               _GAUGE_FIELDS,
                                                _LAYOUT_GAUGES, _PP_GAUGES,
                                                _RAGGED_GAUGES,
                                                _REMOTE_GAUGES,
@@ -235,6 +236,7 @@ def test_grafana_dashboard_queries_real_metrics():
     exported |= set(_REMOTE_GAUGES.values())
     exported |= set(_RAGGED_GAUGES.values())
     exported |= set(_TRACE_GAUGES.values())
+    exported |= set(_DEGRADE_GAUGES.values())
     # trace-collector latency histograms (components/trace_collector.py
     # — exemplar-carrying; the Grafana "Tracing" row queries them)
     exported |= {"nv_llm_trace_ttft_seconds_bucket",

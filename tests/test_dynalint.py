@@ -389,6 +389,62 @@ def test_dl006_clean_twin(tmp_path):
 
 # ------------------------------------------------------- repo-wide gate
 
+# ---------------------------------------------------------------- DL007
+
+DL007_SRC = """
+import asyncio
+
+
+async def bad_receive(rx):
+    f = await rx.next_frame()            # seeded: unbounded frame wait
+    p = await rx.wait_connected()        # seeded: unbounded dial-back
+    item = await q.dequeue()             # seeded: unbounded queue pop
+    return f, p, item
+
+
+async def bad_engine_queue(req):
+    out = await req.out_queue.get()      # seeded: unbounded engine queue
+    return out
+
+
+async def clean(rx, q, req):
+    f = await rx.next_frame(timeout=0.5)
+    p = await rx.wait_connected(timeout=10.0)
+    item = await q.dequeue(1.0, ack_deadline=30.0)   # positional timeout
+    out = await asyncio.wait_for(req.out_queue.get(), 30)  # wrapped
+    return f, p, item, out
+
+
+async def explicit_none_is_flagged(rx):
+    return await rx.next_frame(timeout=None)   # seeded: explicit opt-out
+"""
+
+
+def test_dl007_fires_and_clean_twin(tmp_path):
+    root = make_repo(tmp_path, {"pkg/app.py": DL007_SRC})
+    findings, _ = lint_fixture(root, ["DL007"])
+    msgs = [f"{f.symbol} {f.message}" for f in findings]
+    assert any(".next_frame()" in m and "bad_receive" in m for m in msgs)
+    assert any(".wait_connected()" in m for m in msgs), msgs
+    assert any(".dequeue()" in m for m in msgs), msgs
+    assert any(".out_queue.get()" in m and "bad_engine_queue" in m
+               for m in msgs), msgs
+    assert any("explicit_none_is_flagged" in m for m in msgs), msgs
+    # the bounded twins must NOT fire
+    assert not any("clean" in f.symbol for f in findings), msgs
+    assert len(findings) == 5
+
+
+def test_dl007_inline_waiver(tmp_path):
+    src = DL007_SRC.replace(
+        "out = await req.out_queue.get()      # seeded: unbounded engine queue",
+        "out = await req.out_queue.get()  # dynalint: ok DL007 event pump")
+    root = make_repo(tmp_path, {"pkg/app.py": src})
+    findings, suppressed = lint_fixture(root, ["DL007"])
+    assert not any("bad_engine_queue" in f.symbol for f in findings)
+    assert any("bad_engine_queue" in f.symbol for f in suppressed)
+
+
 def test_repo_wide_zero_findings():
     """THE gate: the real tree holds zero unbaselined findings. Every
     rule runs; waivers/baseline entries are visible in `suppressed` so

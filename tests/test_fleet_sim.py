@@ -269,6 +269,40 @@ def test_prefill_storm_scales_prefill_tier():
     assert r2["event_log_digest"] == r["event_log_digest"]
 
 
+def test_partition_brownout_slo_recovers_zero_hangs():
+    """ISSUE 13 chaos scenario: 3 replicas serve 8× slower with FROZEN
+    published stats (the kvstore-partition view) mid-run. The fleet
+    must neither hang nor drop: every request completes, the brownout
+    visibly degrades TTFT while it lasts, and late-window SLO recovers
+    once it lifts — deterministically (byte-identical event log)."""
+    w0 = REAL_PERF_COUNTER()
+    r = run_scenario("partition_brownout", seed=0)
+    assert REAL_PERF_COUNTER() - w0 < WALL_BUDGET_STORM_S
+    assert r["violations"] == [], r["violations"]
+    assert r["requests"]["dropped"] == 0
+    assert r["requests"]["completed"] == r["requests"]["arrived"]
+    assert r["slo"]["late_attainment"] >= 0.9
+    r2 = run_scenario("partition_brownout", seed=0)
+    assert r2["event_log_digest"] == r["event_log_digest"]
+
+
+def test_disk_pressure_sheds_and_serving_continues():
+    """ISSUE 13 chaos scenario: fleet-wide ENOSPC mid-spill. The
+    write-behind SHEDS refused demotes (counted) instead of stalling or
+    erroring; zero dropped in-flight; late-window SLO holds; the event
+    log stays byte-identical per seed."""
+    w0 = REAL_PERF_COUNTER()
+    r = run_scenario("disk_pressure", seed=0)
+    assert REAL_PERF_COUNTER() - w0 < WALL_BUDGET_STORM_S
+    assert r["violations"] == [], r["violations"]
+    assert r["requests"]["shed_writes"] >= 20
+    assert r["requests"]["dropped"] == 0
+    assert r["requests"]["completed"] == r["requests"]["arrived"]
+    assert r["slo"]["late_attainment"] >= 0.9
+    r2 = run_scenario("disk_pressure", seed=0)
+    assert r2["event_log_digest"] == r["event_log_digest"]
+
+
 def test_disagg_retune_crossover_floor():
     """Satellite: the planner's disagg retune consumes fleet-level
     fetch-vs-recompute crossover stats end-to-end. A fast fabric

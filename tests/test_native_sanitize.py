@@ -122,6 +122,150 @@ def test_sanitized_differential_fuzz_round():
     assert "SAN_FUZZ_OK" in proc.stdout
 
 
+_RADIX_FUZZ_DRIVER = """
+import numpy as np
+from dynamo_tpu.llm.kv.blocks import chain_hash
+from dynamo_tpu.llm.kv_router.indexer import (RadixIndexNative,
+                                              RadixIndexPython)
+
+cc = RadixIndexNative()          # DYN_NATIVE_SANITIZE env → sanitized lib
+py = RadixIndexPython()
+
+rng = np.random.default_rng(4242)
+# a few chained hash families (shared prefixes), like real kv_events
+chains = []
+for c in range(6):
+    parent = None
+    chain = []
+    for i in range(24):
+        parent = chain_hash(parent, int(rng.integers(1, 1 << 60)))
+        chain.append(parent)
+    chains.append(chain)
+
+workers = [0x51, 0x52, 0x53]
+for step in range(600):
+    op = int(rng.integers(0, 4))
+    chain = chains[int(rng.integers(0, len(chains)))]
+    w = workers[int(rng.integers(0, len(workers)))]
+    i = int(rng.integers(0, len(chain)))
+    j = int(rng.integers(i, len(chain))) + 1
+    if op == 0:
+        parent = chain[i - 1] if i > 0 else None
+        py.apply_stored(w, parent, chain[i:j])
+        cc.apply_stored(w, parent, chain[i:j])
+    elif op == 1:
+        py.apply_removed(w, chain[i:j])
+        cc.apply_removed(w, chain[i:j])
+    elif op == 2 and step % 37 == 0:
+        py.remove_worker(w)
+        cc.remove_worker(w)
+    else:
+        a = py.find_matches(chain[:j])
+        b = cc.find_matches(chain[:j])
+        assert a.scores == b.scores, (step, a.scores, b.scores)
+    assert py.node_count() == cc.node_count(), step
+print("SAN_RADIX_OK")
+"""
+
+_DATAPLANE_FUZZ_DRIVER = """
+import asyncio
+import os
+
+import numpy as np
+
+from dynamo_tpu.runtime.codec import ConnectionInfo, FrameKind
+from dynamo_tpu.runtime.native_tcp import (NativeStreamSender,
+                                           load_data_plane_lib)
+from dynamo_tpu.runtime.tcp import TcpStreamServer
+
+lib = load_data_plane_lib()
+assert lib is not None, "sanitized data plane failed to load"
+
+async def main():
+    rng = np.random.default_rng(77)
+    tcp = TcpStreamServer("127.0.0.1")
+    await tcp.start()
+    rx = tcp.register()
+    sender = await NativeStreamSender.connect(tcp.connection_info(rx))
+    sent = []
+    for i in range(40):
+        hdr = bytes(rng.integers(0, 256, size=int(rng.integers(0, 64)),
+                                 dtype=np.uint8))
+        data = bytes(rng.integers(0, 256, size=int(rng.integers(0, 4096)),
+                                  dtype=np.uint8))
+        sent.append((hdr, data))
+        await sender.send(data, header=hdr)
+    await sender.finish()
+    got = []
+    while True:
+        f = await rx.next_frame(timeout=30)
+        assert f is not None
+        if f.kind == FrameKind.SENTINEL:
+            break
+        assert f.kind == FrameKind.DATA
+        got.append((f.header, f.data))
+    assert got == sent, "frames diverged under the sanitized sender"
+    rx.close()
+    tcp.unregister(rx.stream_id)
+    await tcp.close()
+
+asyncio.run(main())
+print("SAN_DATAPLANE_OK")
+"""
+
+
+def _run_sanitized(driver: str, so_name: str, sources: list,
+                   ok_token: str, extra_flags=None):
+    """Shared harness: build one csrc target with -fsanitize, run the
+    differential driver in an LD_PRELOADed subprocess, fail loudly on
+    any memory error (abort_on_error) or semantic divergence."""
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    libasan, libubsan = _san_runtime("libasan.so"), _san_runtime(
+        "libubsan.so")
+    if libasan is None or libubsan is None:
+        pytest.skip("sanitizer runtimes not installed")
+    from dynamo_tpu.utils import native
+    so = native.build(so_name, sources, extra_flags=extra_flags,
+                      sanitize="asan,ubsan")
+    if so is None:
+        pytest.skip("sanitized build failed (toolchain without asan)")
+    env = dict(os.environ)
+    env.update({
+        "LD_PRELOAD": f"{libasan} {libubsan}",
+        "DYN_NATIVE_SANITIZE": "asan,ubsan",
+        "DYN_NATIVE_DATAPLANE": "1",
+        "ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1",
+        "UBSAN_OPTIONS": "halt_on_error=1",
+        "PYTHONPATH": REPO_ROOT + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    proc = subprocess.run([sys.executable, "-c", driver],
+                          cwd=REPO_ROOT, env=env, capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 0, (
+        f"sanitized round failed\nstdout:\n{proc.stdout[-2000:]}\n"
+        f"stderr:\n{proc.stderr[-4000:]}")
+    assert ok_token in proc.stdout
+
+
+def test_sanitized_radix_index_differential_fuzz():
+    """ISSUE 13 satellite: extend the sanitized ride to csrc/
+    kv_radix_index — the router's hot prefix index, exercised here with
+    chained-hash store/remove/match traffic vs its Python twin."""
+    _run_sanitized(_RADIX_FUZZ_DRIVER, "dynkv", ["kv_radix_index.cpp"],
+                   "SAN_RADIX_OK")
+
+
+def test_sanitized_data_plane_frame_roundtrip():
+    """ISSUE 13 satellite: the C++ data-plane sender under ASan/UBSan —
+    load-bearing now that torn-frame failpoints exercise the decoder:
+    randomized header/data sizes (incl. zero-length) must round-trip
+    byte-identically through the native framing thread."""
+    _run_sanitized(_DATAPLANE_FUZZ_DRIVER, "data_plane",
+                   ["data_plane.cpp"], "SAN_DATAPLANE_OK",
+                   extra_flags=["-pthread"])
+
+
 def test_sanitize_mode_knob():
     """The env knob parses strictly: unknown sanitizers are rejected
     loudly instead of silently building uninstrumented."""

@@ -3,10 +3,10 @@
 (docs/static_analysis.md "adding a rule")."""
 
 from . import (dl001_blocking, dl002_contextvar, dl003_pins, dl004_schema,
-               dl005_jit, dl006_mirror)
+               dl005_jit, dl006_mirror, dl007_await)
 
 ALL_RULES = {
     m.RULE_ID: m.check
     for m in (dl001_blocking, dl002_contextvar, dl003_pins, dl004_schema,
-              dl005_jit, dl006_mirror)
+              dl005_jit, dl006_mirror, dl007_await)
 }

@@ -1,0 +1,118 @@
+"""DL007: unbounded cross-process await.
+
+The chaos-hardening invariant (docs/chaos.md): every ``await`` that
+blocks on ANOTHER process — a worker's dial-back stream, a work-queue
+pop, a response frame — must carry an explicit timeout, because the
+other side can be partitioned, browning out, or dead-but-connected. An
+unbounded receive turns a peer failure into a local hang: the engine
+loop keeps serving but THIS request (or this pump) waits forever, which
+is exactly the failure shape the failpoint suite injects.
+
+What counts as a cross-process receive site (curated, like DL001's
+blocking-primitive table):
+
+- ``.next_frame(...)``      — runtime/tcp.StreamReceiver (response frames)
+- ``.wait_connected(...)``  — runtime/tcp.StreamReceiver (dial-back)
+- ``.dequeue(...)``         — runtime/bus work queues (cross-process pop)
+- ``.out_queue.get()``      — engine→stream handoff queue; unbounded
+  means a dead engine loop hangs the client stream forever
+
+A call is compliant when it passes a ``timeout=`` keyword (any value —
+``timeout=None`` is an EXPLICIT opt-out and is flagged), or when it is
+not directly awaited (e.g. wrapped in ``asyncio.wait_for(...)``).
+Deliberately-unbounded pumps waive with
+``# dynalint: ok DL007 <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..engine import Finding, RepoContext
+
+RULE_ID = "DL007"
+
+_RECEIVE_TAILS = {"next_frame", "wait_connected", "dequeue"}
+
+_HINT = ("pass an explicit timeout= (or wrap in asyncio.wait_for); a "
+         "partitioned peer must fail this await in bounded time — waive "
+         "a deliberately-unbounded pump with `# dynalint: ok DL007 "
+         "<reason>`")
+
+
+def _is_out_queue_get(call: ast.Call) -> bool:
+    """``<expr>.out_queue.get(...)`` — the engine's per-request stream
+    handoff queue."""
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "get"
+            and isinstance(f.value, ast.Attribute)
+            and f.value.attr == "out_queue")
+
+
+def _receive_desc(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _RECEIVE_TAILS:
+        return f".{f.attr}()"
+    if _is_out_queue_get(call):
+        return ".out_queue.get()"
+    return ""
+
+
+def _has_bounded_timeout(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "timeout":
+            return not (isinstance(kw.value, ast.Constant)
+                        and kw.value.value is None)
+    # positional timeout: next_frame(t) / wait_connected(t) /
+    # dequeue(t, ...) all take timeout first
+    if call.args and not _is_out_queue_get(call):
+        first = call.args[0]
+        return not (isinstance(first, ast.Constant)
+                    and first.value is None)
+    return False
+
+
+class _AwaitVisitor(ast.NodeVisitor):
+    def __init__(self) -> None:
+        self.findings: List[tuple] = []   # (lineno, desc)
+        self._func_stack: List[str] = []
+
+    def _visit_func(self, node) -> None:
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Await(self, node: ast.Await) -> None:
+        call = node.value
+        if isinstance(call, ast.Call):
+            desc = _receive_desc(call)
+            if desc and not _has_bounded_timeout(call):
+                qual = ".".join(self._func_stack) or "<module>"
+                self.findings.append((node.lineno, desc, qual))
+        self.generic_visit(node)
+
+
+def check(ctx: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in sorted(ctx.graph.modules):
+        src = ctx.read_file(rel)
+        if src is None:
+            continue
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        v = _AwaitVisitor()
+        v.visit(tree)
+        for lineno, desc, qual in v.findings:
+            findings.append(Finding(
+                rule=RULE_ID, path=rel, line=lineno,
+                symbol=f"{qual}:{desc}",
+                message=(f"unbounded cross-process await {desc} — a "
+                         f"partitioned peer hangs this caller forever"),
+                hint=_HINT))
+    return findings
