@@ -182,6 +182,22 @@ _DEGRADE_GAUGES = {
 }
 
 
+# multi-tenant serving plane (llm/tenancy.py; docs/multi_tenant.md):
+# ForwardPassMetrics.tenant_stats {tenant: {field: value}} → one series
+# per (worker, tenant). The Grafana "Tenants" row plots per-tenant
+# admitted vs throttled (a flooding tenant shows throttles rising while
+# everyone else's admissions hold — the fair-share contract visualized)
+# next to per-tenant resident KV blocks (quota headroom) and prefix hit
+# rate (the isolation guarantee: one tenant's eviction storm must not
+# crater another's curve).
+_TENANT_GAUGES = {
+    "admitted": "nv_llm_tenant_admitted_total",
+    "throttled": "nv_llm_tenant_throttled_total",
+    "kv_blocks": "nv_llm_tenant_kv_blocks",
+    "hit_rate": "nv_llm_tenant_hit_rate",
+}
+
+
 class MetricsAggregatorService:
     """Aggregates worker load + router hit-rate into one Prometheus registry.
 
@@ -240,6 +256,12 @@ class MetricsAggregatorService:
             f: Gauge(name, f"graceful degradation: worker {f} "
                      "(scraped stats)", labels, registry=self.registry)
             for f, name in _DEGRADE_GAUGES.items()}
+        self._tenant_gauges: Dict[str, Gauge] = {
+            f: Gauge(name, f"multi-tenant serving: per-tenant {f} "
+                     "(scraped stats)", labels + ["tenant"],
+                     registry=self.registry)
+            for f, name in _TENANT_GAUGES.items()}
+        self._seen_tenants: Dict[int, Set[str]] = {}
         self.hit_isl_blocks = Counter(
             f"{PREFIX}_hit_rate_isl_blocks_total",
             "Routing decisions: total request blocks (ISL)",
@@ -386,10 +408,30 @@ class MetricsAggregatorService:
                 g.labels(*lbl).set(getattr(m, f))
             for f, g in self._degrade_gauges.items():
                 g.labels(*lbl).set(getattr(m, f))
+            # per-tenant labeled series (llm/tenancy.py tenant_stats)
+            tenants = m.tenant_stats or {}
+            for t, stats in tenants.items():
+                if not isinstance(stats, dict):
+                    continue
+                for f, g in self._tenant_gauges.items():
+                    g.labels(*lbl, t).set(stats.get(f, 0))
+            for gone_t in self._seen_tenants.get(wid, set()) - set(tenants):
+                for g in self._tenant_gauges.values():
+                    try:
+                        g.remove(*lbl, gone_t)
+                    except KeyError:
+                        pass
+            self._seen_tenants[wid] = set(tenants)
         # drop series for workers whose leases died (the watcher pruned them)
         for gone in self._seen_workers - present:
             self.latest.pop(gone, None)
             lbl = self._labels(gone)
+            for gone_t in self._seen_tenants.pop(gone, set()):
+                for g in self._tenant_gauges.values():
+                    try:
+                        g.remove(*lbl, gone_t)
+                    except KeyError:
+                        pass
             for g in (list(self._gauges.values())
                       + list(self._spec_gauges.values())
                       + list(self._pp_gauges.values())

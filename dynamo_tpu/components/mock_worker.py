@@ -115,7 +115,7 @@ class MockTokenWorker:
                  spec_k: int = 0, spec_acceptance: float = 0.75,
                  publish_traces: bool = True,
                  synthetic_trace_interval: float = 0.0,
-                 profile=None):
+                 profile=None, tenants: int = 0):
         self.runtime = runtime
         self.endpoint = Endpoint.parse_path(runtime, endpoint_path)
         self.block_size = block_size
@@ -146,6 +146,12 @@ class MockTokenWorker:
         # synthetic_trace_interval > 0 additionally fabricates plausible
         # traces on a timer — collector + Grafana "Tracing" panels are
         # testable with zero engines AND zero traffic
+        # synthetic multi-tenant feed (--tenants N): per-tenant
+        # admitted/throttled/kv_blocks/hit_rate stats shaped exactly
+        # like a tenancy-enabled EngineCore's tenant_stats payload, so
+        # the nv_llm_tenant_* labeled-gauge path and the Grafana
+        # "Tenants" row run with zero engines
+        self.tenants = tenants
         self.publish_traces = publish_traces
         self.synthetic_trace_interval = synthetic_trace_interval
         self._trace_pub = None
@@ -337,6 +343,20 @@ class MockTokenWorker:
             d["remote_breaker_open_peers"] = 0
             d["remote_breaker_trips_total"] = 1
             d["disk_spill_shed_total"] = eng.requests_served // 6
+        tenants = getattr(self, "tenants", 0)
+        if eng is not None and tenants > 0:
+            # round 14: synthetic per-tenant stats — a Zipf-ish spread
+            # where tenant 0 floods (and is the only one throttled),
+            # everyone else's hit rate holds (the fair-share story the
+            # Grafana "Tenants" row should show)
+            served = max(eng.requests_served, 1)
+            d["tenant_stats"] = {
+                f"t{i:02d}": {
+                    "admitted": max(served // (i + 1), 1),
+                    "throttled": served // 2 if i == 0 else 0,
+                    "kv_blocks": 64 // (i + 1),
+                    "hit_rate": 0.3 if i == 0 else 0.6,
+                } for i in range(tenants)}
         profile = getattr(self, "profile", None)
         if profile is not None and (profile.slow_start_s > 0
                                     or profile.latency_factor != 1.0):
@@ -390,6 +410,11 @@ async def amain(argv=None) -> None:
                    help="synthetic behavior profile (sim/profiles.py), "
                         "e.g. 'slow-start:30', 'crash-at:120', "
                         "'drain-ignore', 'latency:2.5' — comma-joined")
+    p.add_argument("--tenants", type=int, default=0,
+                   help="publish synthetic per-tenant stats for N "
+                        "tenants (exercises the nv_llm_tenant_* "
+                        "labeled gauges + Grafana 'Tenants' row with "
+                        "zero engines)")
     args = p.parse_args(argv)
     from ..runtime.log import setup_logging
     setup_logging()
@@ -398,7 +423,7 @@ async def amain(argv=None) -> None:
         runtime, args.endpoint, block_size=args.kv_block_size,
         spec_k=args.spec_k, spec_acceptance=args.spec_acceptance,
         synthetic_trace_interval=args.synthetic_trace_interval,
-        profile=args.profile).start()
+        profile=args.profile, tenants=args.tenants).start()
     logger.info("mock worker %x serving %s", worker.worker_id, args.endpoint)
     try:
         await asyncio.Event().wait()

@@ -4,11 +4,25 @@ to token-protocol workers → detokenize.
 Reference: the Processor + Router components of the disagg reference graph
 (examples/llm/components/{processor,kv_router}.py; SURVEY.md §2.6, §3.3) —
 preprocessing happens *before* routing so the router can match the prompt's
-block hashes against its radix index. Run:
+block hashes against its radix index.
+
+Two modes:
+
+- single model (the historical shape)::
 
     python -m dynamo_tpu.components.processor \
         --runtime-server HOST:PORT --model-path DIR \
         --endpoint dyn://dynamo/worker/generate --port 8080
+
+- multi-model multiplexing (``--registry``): the OpenAI ``model`` field
+  resolves through the model registry (llm/registry.py): every card
+  under ``modelreg/cards/`` gets its OWN pipeline — preprocessor from
+  the card's tokenizer ref, and a per-model :class:`KvRoutedEngine`
+  whose KvIndexer/KvScheduler watch THAT card's worker fleet at the
+  card's block size. Cards added/removed (``llmctl model {add,rm}``, or
+  self-registering workers) start/stop serving live; an unknown model
+  404s at the HTTP layer. One frontend, N models, N independent
+  routing planes.
 
 Workers: `python -m dynamo_tpu.launch.run in=dyn://dynamo/worker/generate \
 out=jax --protocol tokens --model-path DIR --runtime-server HOST:PORT`.
@@ -24,54 +38,181 @@ import os
 logger = logging.getLogger("dynamo_tpu.components.processor")
 
 
+class ModelMux:
+    """Registry-driven model multiplexer: one pipeline + KV routing
+    plane per registry card, kept in sync with ``modelreg/cards/``."""
+
+    def __init__(self, runtime, manager, default_block_size: int = 16):
+        self.runtime = runtime
+        self.manager = manager
+        self.default_block_size = default_block_size
+        self.watcher = None
+        # name → (engine, card) — engine is the per-model KvRoutedEngine
+        self._engines: dict = {}
+
+    async def start(self) -> "ModelMux":
+        from ..llm.registry import RegistryWatcher
+        self.watcher = await RegistryWatcher(
+            self.runtime, self._on_card, self._on_removed).start()
+        return self
+
+    async def _build_pipeline(self, card):
+        from ..llm.backend import Backend
+        from ..llm.engines.kv_routed import KvRoutedEngine
+        from ..llm.model_card import ModelDeploymentCard
+        from ..llm.preprocessor import OpenAIPreprocessor
+        from ..runtime import link
+        from ..runtime.distributed import Endpoint
+
+        if card.model_path:
+            mdc = await asyncio.to_thread(
+                ModelDeploymentCard.from_local_path, card.model_path,
+                display_name=card.name)
+        else:
+            raise ValueError(f"registry card {card.name!r} has no "
+                             f"model_path — the frontend cannot "
+                             f"preprocess for it")
+        endpoint = Endpoint.parse_path(self.runtime, card.endpoint)
+        engine = await KvRoutedEngine.start(
+            endpoint,
+            block_size=card.kv_block_size or self.default_block_size)
+        pipeline = link(OpenAIPreprocessor(mdc), Backend(mdc), engine)
+        return engine, pipeline
+
+    async def _on_card(self, card) -> None:
+        old = self._engines.pop(card.name, None)
+        try:
+            engine, pipeline = await self._build_pipeline(card)
+        except Exception:  # noqa: BLE001 — one bad card must not kill the mux
+            logger.exception("registry card %s rejected", card.name)
+            if old is not None:
+                self._engines[card.name] = old   # keep serving the old rev
+            return
+        self._engines[card.name] = (engine, card)
+        import dataclasses
+        card_d = dataclasses.asdict(card)
+        types = card.types()
+        if "chat" in types:
+            self.manager.add_chat_model(card.name, pipeline, card=card_d)
+        if "completion" in types:
+            self.manager.add_completion_model(card.name, pipeline,
+                                              card=card_d)
+        if old is not None:
+            await old[0].close()
+        logger.info("model %s (rev %d) → %s (program_set %s)",
+                    card.name, card.revision, card.endpoint,
+                    card.program_set)
+
+    async def _on_removed(self, name: str) -> None:
+        self.manager.remove_model(name)
+        old = self._engines.pop(name, None)
+        if old is not None:
+            await old[0].close()
+        logger.info("model %s removed from the serving plane", name)
+
+    def tenant_counters(self) -> dict:
+        """Aggregated per-tenant admission counters across every
+        model's routing plane (the /metrics tenant feed)."""
+        out: dict = {}
+        for engine, _card in self._engines.values():
+            for t, c in engine.admission.counters().items():
+                agg = out.setdefault(t, {"admitted": 0, "throttled": 0})
+                agg["admitted"] += c["admitted"]
+                agg["throttled"] += c["throttled"]
+        return out
+
+    async def stop(self) -> None:
+        if self.watcher is not None:
+            await self.watcher.stop()
+        for engine, _card in self._engines.values():
+            await engine.close()
+        self._engines.clear()
+
+
 async def amain(argv=None) -> None:
     p = argparse.ArgumentParser(prog="dynamo-tpu-processor")
     p.add_argument("--runtime-server", required=True)
-    p.add_argument("--model-path", required=True)
+    p.add_argument("--model-path",
+                   help="single-model mode: HF-style model dir")
     p.add_argument("--model-name")
+    p.add_argument("--registry", action="store_true",
+                   help="multi-model mode: serve every model registry "
+                        "card (llm/registry.py), resolved live — the "
+                        "OpenAI 'model' field multiplexes onto the "
+                        "card's worker fleet")
     p.add_argument("--endpoint", default="dyn://dynamo/worker/generate")
+    p.add_argument("--namespace", default="dynamo",
+                   help="namespace whose tenant policy table this "
+                        "frontend watches (llmctl tenant)")
     p.add_argument("--port", type=int, default=8080)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--kv-block-size", type=int, default=16,
-                   help="must match the workers' engine block size")
+                   help="must match the workers' engine block size "
+                        "(single-model mode; registry cards carry "
+                        "their own)")
     p.add_argument("--verbose", "-v", action="store_true")
     args = p.parse_args(argv)
     from ..runtime.log import setup_logging
     setup_logging('debug' if args.verbose else None)
 
-    from ..llm.backend import Backend
-    from ..llm.engines.kv_routed import KvRoutedEngine
-    from ..llm.http import HttpService
-    from ..llm.model_card import ModelDeploymentCard
-    from ..llm.preprocessor import OpenAIPreprocessor
-    from ..runtime import link
-    from ..runtime.distributed import DistributedRuntime, Endpoint
+    if not args.registry and not args.model_path:
+        raise SystemExit("pass --model-path (single model) or "
+                         "--registry (multi-model)")
 
-    name = args.model_name or os.path.basename(
-        os.path.normpath(args.model_path))
+    from ..llm.http import HttpService
+    from ..runtime.distributed import DistributedRuntime
+
     runtime = await DistributedRuntime.connect(args.runtime_server)
-    mdc = await asyncio.to_thread(ModelDeploymentCard.from_local_path,
-                                  args.model_path, display_name=name)
-    endpoint = Endpoint.parse_path(runtime, args.endpoint)
-    engine = await KvRoutedEngine.start(endpoint,
-                                        block_size=args.kv_block_size)
-    # router-side tier-weight retune (llmctl kv set-weights): the
-    # scheduler's TIER_WEIGHTS follow the kvtier/weights/{ns} key live
-    from ..llm.kv.admin import watch_weights_loop
-    weights_task = asyncio.get_running_loop().create_task(
-        watch_weights_loop(runtime, endpoint.namespace),
-        name="kv-weights-watch")
-    pipeline = link(OpenAIPreprocessor(mdc), Backend(mdc), engine)
     svc = HttpService(port=args.port, host=args.host)
-    svc.manager.add_chat_model(name, pipeline)
-    svc.manager.add_completion_model(name, pipeline)
-    logger.info("processor serving %s on %s:%d → %s (KV-aware)",
-                name, args.host, args.port, args.endpoint)
+    loop = asyncio.get_running_loop()
+    # router-side live retunes: tier weights (llmctl kv set-weights) and
+    # tenant policies (llmctl tenant set-weight/set-quota)
+    from ..llm.kv.admin import watch_weights_loop
+    from ..llm.tenancy import watch_tenants_loop
+    watch_tasks = [
+        loop.create_task(watch_weights_loop(runtime, args.namespace),
+                         name="kv-weights-watch"),
+        loop.create_task(watch_tenants_loop(runtime, args.namespace),
+                         name="tenant-watch"),
+    ]
+
+    mux = None
+    engine = None
+    if args.registry:
+        mux = await ModelMux(runtime, svc.manager,
+                             default_block_size=args.kv_block_size).start()
+        logger.info("processor multiplexing the model registry on "
+                    "%s:%d (KV-aware, per-model routing planes)",
+                    args.host, args.port)
+    else:
+        from ..llm.backend import Backend
+        from ..llm.engines.kv_routed import KvRoutedEngine
+        from ..llm.model_card import ModelDeploymentCard
+        from ..llm.preprocessor import OpenAIPreprocessor
+        from ..runtime import link
+        from ..runtime.distributed import Endpoint
+
+        name = args.model_name or os.path.basename(
+            os.path.normpath(args.model_path))
+        mdc = await asyncio.to_thread(ModelDeploymentCard.from_local_path,
+                                      args.model_path, display_name=name)
+        endpoint = Endpoint.parse_path(runtime, args.endpoint)
+        engine = await KvRoutedEngine.start(endpoint,
+                                            block_size=args.kv_block_size)
+        pipeline = link(OpenAIPreprocessor(mdc), Backend(mdc), engine)
+        svc.manager.add_chat_model(name, pipeline)
+        svc.manager.add_completion_model(name, pipeline)
+        logger.info("processor serving %s on %s:%d → %s (KV-aware)",
+                    name, args.host, args.port, args.endpoint)
     try:
         await svc.run_forever()
     finally:
-        weights_task.cancel()
-        await engine.close()
+        for t in watch_tasks:
+            t.cancel()
+        if mux is not None:
+            await mux.stop()
+        if engine is not None:
+            await engine.close()
         await runtime.shutdown()
 
 

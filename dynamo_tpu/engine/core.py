@@ -104,6 +104,12 @@ class EngineRequest:
     # (EngineCore.spec_k_live, llmctl spec set-k); 0 = explicitly off;
     # n > 0 clamps to the compiled maximum EngineConfig.spec_k.
     spec_k: int = -1
+    # multi-tenant serving plane (llm/tenancy.py): tenant attributes
+    # this request's registered KV blocks in the tiers' quota ledger
+    # ("" = the implicit single tenant — untenanted behavior exactly);
+    # session groups requests for exported-trace prefix structure.
+    tenant: str = ""
+    session: str = ""
     enqueue_time: float = dataclasses.field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
     # the request's runtime Trace (runtime/tracing.py) — attached by
@@ -460,6 +466,14 @@ class EngineCore:
         # total / _deadline_exceeded_total feeds
         self.requests_cancelled_total = 0
         self.requests_deadline_exceeded_total = 0
+        # multi-tenant serving plane (llm/tenancy.py): attached by
+        # enable_tenancy() — per-tenant block ledger threaded through the
+        # device/host/disk/remote tiers (quota-preferred eviction) plus
+        # per-tenant admission counters, the nv_llm_tenant_* gauge feed
+        self.tenancy = None
+        self.tenant_admitted: dict = {}
+        self.tenant_hits: dict = {}
+        self.tenant_queries: dict = {}
         # tier-hit onboards whose off-thread prep failed and were
         # re-admitted COLD (full recompute) instead of erroring out
         self.onboard_cold_retries = 0
@@ -1169,6 +1183,19 @@ class EngineCore:
                     remote_fetch_failures_total=rs.fetch_failures_total,
                     remote_admission_rejects_total=rs
                     .admission_rejects_total)
+        if self.tenant_admitted:
+            # per-tenant serving stats (llm/tenancy.py; the
+            # nv_llm_tenant_* labeled-gauge feed): admitted requests,
+            # resident KV blocks across tiers, and prefix hit rate
+            ledger = self.tenancy
+            tier_kw["tenant_stats"] = {
+                t: {"admitted": n,
+                    "throttled": 0,
+                    "kv_blocks": (ledger.blocks(t)
+                                  if ledger is not None else 0),
+                    "hit_rate": (self.tenant_hits.get(t, 0)
+                                 / max(self.tenant_queries.get(t, 0), 1))}
+                for t, n in sorted(self.tenant_admitted.items())}
         from ..runtime.tracing import tracer as _tracer
         return ForwardPassMetrics(
             requests_cancelled_total=self.requests_cancelled_total,
@@ -1433,6 +1460,18 @@ class EngineCore:
                                                cold=req.cold_admission)
         if plan is None:
             return False
+        if req.tenant:
+            # per-tenant admission + prefix-hit accounting (the
+            # nv_llm_tenant_* gauge feed; llm/tenancy.py)
+            t = req.tenant
+            self.tenant_admitted[t] = self.tenant_admitted.get(t, 0) + 1
+            self.tenant_queries[t] = (self.tenant_queries.get(t, 0)
+                                      + len(plan.all_blocks))
+            self.tenant_hits[t] = (self.tenant_hits.get(t, 0)
+                                   + len(plan.hit_blocks)
+                                   + len(plan.host_slots)
+                                   + len(plan.disk_hashes)
+                                   + len(plan.remote_hashes))
         if len(plan.all_blocks) > self.M:
             # longer than a block table row — reject rather than overflow
             # the table (external prompts are length-checked upstream, but
@@ -1542,6 +1581,26 @@ class EngineCore:
             if self.disk_store is not None and self.disk_store.contains(h):
                 continue
             pub.publish_stored(-1, h, th, ph, tier="remote")
+
+    def enable_tenancy(self, ledger=None) -> None:
+        """Attach a per-tenant block ledger (llm/tenancy.py
+        TenantBlockLedger) and thread it through every present KV tier:
+        device pool eviction prefers over-quota tenants' blocks, and
+        the host/disk/remote stores account + quota-prefer likewise.
+        Idempotent; untenanted engines never pay for any of it."""
+        from ..llm.tenancy import TenantBlockLedger
+        if ledger is None:
+            ledger = self.tenancy or TenantBlockLedger()
+        self.tenancy = ledger
+        self.kv_manager.pool.tenancy = ledger
+        self.kv_manager.tenancy = ledger
+        host = self.kv_manager.host_pool
+        if host is not None:
+            host.tenancy = ledger
+        if self.disk_store is not None:
+            self.disk_store.tenancy = ledger
+        if self.remote_store is not None:
+            self.remote_store.tenancy = ledger
 
     def attach_kv_fabric(self, fabric) -> None:
         """Wire an attached fleet fabric (llm/kv/fabric.py KvFabric):
@@ -2065,7 +2124,8 @@ class EngineCore:
         req.key_step += 1
         # the prompt's full blocks now hold valid KV — register for reuse
         req.registered_blocks = self.kv_manager.register_full_blocks(
-            req.blocks, plan.seq, already_registered=n_already)
+            req.blocks, plan.seq, already_registered=n_already,
+            tenant=req.tenant or None)
         if self.recorder is not None:
             self.recorder.rec(
                 "admit", rid=req.rid, slot=slot, pos=req.pos,
@@ -2435,7 +2495,8 @@ class EngineCore:
             if req.seq is not None:
                 req.seq.append(int(self._tokens[i]))
                 req.registered_blocks = self.kv_manager.register_full_blocks(
-                    req.blocks, req.seq, req.registered_blocks)
+                    req.blocks, req.seq, req.registered_blocks,
+                    tenant=req.tenant or None)
             req.pos += 1
             req.generated += 1
             req.key_step += 1
@@ -2688,7 +2749,8 @@ class EngineCore:
                     req.seq.append(input_tok)
                     req.registered_blocks = \
                         self.kv_manager.register_full_blocks(
-                            req.blocks, req.seq, req.registered_blocks)
+                            req.blocks, req.seq, req.registered_blocks,
+                            tenant=req.tenant or None)
                 req.pos += 1
                 req.key_step += 1
                 n_applied += 1
@@ -3072,7 +3134,8 @@ class EngineCore:
                     req.seq.append(int(inputs[t]))
                     req.registered_blocks = \
                         self.kv_manager.register_full_blocks(
-                            req.blocks, req.seq, req.registered_blocks)
+                            req.blocks, req.seq, req.registered_blocks,
+                            tenant=req.tenant or None)
                     req.pos += 1
                     req.key_step += 1
                     req.generated += 1
@@ -3098,7 +3161,8 @@ class EngineCore:
                     req.seq.append(req.lane_prompt[req.pos])
                     req.registered_blocks = \
                         self.kv_manager.register_full_blocks(
-                            req.blocks, req.seq, req.registered_blocks)
+                            req.blocks, req.seq, req.registered_blocks,
+                            tenant=req.tenant or None)
                     req.pos += 1
                     req.key_step += 1
                 self.total_prefill_tokens += sq.length
@@ -3110,7 +3174,8 @@ class EngineCore:
                 req.seq.append(int(req.last_token))
                 req.registered_blocks = \
                     self.kv_manager.register_full_blocks(
-                        req.blocks, req.seq, req.registered_blocks)
+                        req.blocks, req.seq, req.registered_blocks,
+                        tenant=req.tenant or None)
                 req.pos += 1
                 req.key_step += 1
                 self.total_decode_tokens += 1
@@ -3288,7 +3353,8 @@ class EngineCore:
                     req.seq.append(int(inputs[t]))
                     req.registered_blocks = \
                         self.kv_manager.register_full_blocks(
-                            req.blocks, req.seq, req.registered_blocks)
+                            req.blocks, req.seq, req.registered_blocks,
+                            tenant=req.tenant or None)
                 req.pos += 1
                 req.key_step += 1
                 req.generated += 1

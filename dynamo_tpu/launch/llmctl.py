@@ -27,6 +27,50 @@ def build_parser() -> argparse.ArgumentParser:
     rm.add_argument("name")
     hsub.add_parser("list")
 
+    mdl = sub.add_parser("model", help="model registry cards "
+                                       "(llm/registry.py — the multi-"
+                                       "model serving plane's records)")
+    msub = mdl.add_subparsers(dest="model_cmd", required=True)
+    madd = msub.add_parser("add", help="register (or revise) a card; "
+                                       "watching frontends start "
+                                       "serving the name immediately")
+    madd.add_argument("name")
+    madd.add_argument("endpoint", help="dyn://ns/comp/ep or ns.comp.ep")
+    madd.add_argument("--model-path", help="HF-style dir the frontend's "
+                                           "preprocessor loads")
+    madd.add_argument("--kv-block-size", type=int, default=16)
+    madd.add_argument("--model-type", default="chat+completion",
+                      choices=["chat", "completion", "chat+completion"])
+    madd.add_argument("--geometry", default=None,
+                      help='JSON geometry dict, e.g. \'{"tp": 8}\' — '
+                           "feeds the derived program-set key")
+    mrm = msub.add_parser("rm", help="remove a card; watching frontends "
+                                     "drop the model (404 from then on)")
+    mrm.add_argument("name")
+    msub.add_parser("list")
+
+    tn = sub.add_parser("tenant", help="multi-tenant policy admin "
+                                       "(llm/tenancy.py): fair-share "
+                                       "weights + per-tier KV quotas, "
+                                       "applied live by watching "
+                                       "workers/routers")
+    tnsub = tn.add_subparsers(dest="tenant_cmd", required=True)
+    tns = tnsub.add_parser("status", help="show the stored policy table")
+    tns.add_argument("namespace", nargs="?")
+    tnw = tnsub.add_parser("set-weight", help="fair-share weight (WDRR "
+                                              "quantum scale)")
+    tnw.add_argument("namespace")
+    tnw.add_argument("tenant")
+    tnw.add_argument("weight", type=float)
+    tnq = tnsub.add_parser("set-quota", help="per-tier resident KV "
+                                             "block quota (0 = "
+                                             "unlimited); over-quota "
+                                             "tenants' blocks evict "
+                                             "first")
+    tnq.add_argument("namespace")
+    tnq.add_argument("tenant")
+    tnq.add_argument("blocks", type=int)
+
     dis = sub.add_parser("disagg", help="live disagg-router config")
     dsub = dis.add_subparsers(dest="disagg_cmd", required=True)
     st = dsub.add_parser("set-threshold")
@@ -184,6 +228,10 @@ async def amain(argv=None) -> int:
                 disagg_config_key(args.model),
                 json.dumps({"max_local_prefill_length": args.value}).encode())
             print(f"disagg threshold for {args.model} → {args.value}")
+        elif args.cmd == "model":
+            return await _model_cmd(runtime, args)
+        elif args.cmd == "tenant":
+            return await _tenant_cmd(runtime, args)
         elif args.cmd == "planner":
             return await _planner_cmd(runtime, args)
         elif args.cmd == "spec":
@@ -199,6 +247,102 @@ async def amain(argv=None) -> int:
         return 0
     finally:
         await runtime.shutdown()
+
+
+async def _model_cmd(runtime, args) -> int:
+    """``llmctl model {add,list,rm}`` — registry cards on the kvstore
+    (llm/registry.py). A frontend watching the registry starts/stops
+    serving the name live; ``add`` on an existing name bumps its
+    revision (frontends rebuild the pipeline)."""
+    import json
+
+    from ..llm.registry import (RegistryCard, list_cards, register_card,
+                                remove_card)
+
+    if args.model_cmd == "add":
+        geometry = {}
+        if args.geometry:
+            try:
+                geometry = json.loads(args.geometry)
+            except ValueError as e:
+                print(f"--geometry is not valid JSON: {e}", file=sys.stderr)
+                return 1
+            if not isinstance(geometry, dict):
+                print("--geometry must be a JSON object", file=sys.stderr)
+                return 1
+        card = RegistryCard(name=args.name, endpoint=args.endpoint,
+                            model_path=args.model_path,
+                            model_type=args.model_type,
+                            kv_block_size=args.kv_block_size,
+                            geometry=geometry)
+        await register_card(runtime, card)
+        print(f"registered card {args.name} → {args.endpoint} "
+              f"(program_set {card.program_set}, rev {card.revision})")
+        return 0
+    if args.model_cmd == "rm":
+        ok = await remove_card(runtime, args.name)
+        print(f"{'removed' if ok else 'not found'}: {args.name}")
+        return 0 if ok else 1
+    cards = await list_cards(runtime)
+    if not cards:
+        print("(no registry cards)")
+    for name, c in sorted(cards.items()):
+        print(f"{name:28s} {c.endpoint:32s} {c.model_type:16s} "
+              f"bs={c.kv_block_size} prog={c.program_set} rev={c.revision}")
+    return 0
+
+
+async def _tenant_cmd(runtime, args) -> int:
+    """``llmctl tenant`` — the tenant/control/{ns} policy table
+    (llm/tenancy.py): every watching worker/router applies updates
+    live (fair-share weights feed the WDRR admission; quotas feed the
+    tiers' eviction preference)."""
+    from ..llm.tenancy import TenantTable, tenant_control_key
+
+    if args.tenant_cmd == "status":
+        prefix = (tenant_control_key(args.namespace)
+                  if args.namespace else "tenant/control/")
+        entries = await runtime.store.kv_get_prefix(prefix)
+        if not entries:
+            print("(no tenant policies stored)")
+            return 1
+        for e in sorted(entries, key=lambda x: x.key):
+            ns = e.key.rsplit("/", 1)[-1]
+            try:
+                table = TenantTable.from_json(e.value)
+            except ValueError:
+                print(f"namespace {ns}  (malformed table)")
+                continue
+            print(f"namespace {ns}")
+            for t, pol in sorted(table.policies.items()):
+                quota = (pol.kv_quota_blocks
+                         if pol.kv_quota_blocks else "unlimited")
+                print(f"  {t:20s} weight={pol.weight:g} "
+                      f"kv_quota={quota} qos={pol.qos}")
+        return 0
+    key = tenant_control_key(args.namespace)
+    entry = await runtime.store.kv_get(key)
+    table = TenantTable()
+    if entry is not None:
+        try:
+            table = TenantTable.from_json(entry.value)
+        except ValueError:
+            pass
+    if args.tenant_cmd == "set-weight":
+        if args.weight <= 0:
+            print("weight must be > 0", file=sys.stderr)
+            return 1
+        pol = table.set(args.tenant, weight=args.weight)
+    else:   # set-quota
+        if args.blocks < 0:
+            print("quota must be >= 0 (0 = unlimited)", file=sys.stderr)
+            return 1
+        pol = table.set(args.tenant, kv_quota_blocks=args.blocks)
+    await runtime.store.kv_put(key, table.to_json())
+    print(f"tenant {args.tenant} in {args.namespace}: "
+          f"weight={pol.weight:g} kv_quota={pol.kv_quota_blocks} "
+          f"qos={pol.qos}")
+    return 0
 
 
 async def _planner_cmd(runtime, args) -> int:

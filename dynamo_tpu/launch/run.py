@@ -114,6 +114,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "the disk tier")
     p.add_argument("--kv-remote-blocks", type=int, default=0,
                    help="object tier capacity in blocks (0 = unbounded)")
+    p.add_argument("--tenancy", action="store_true",
+                   help="multi-tenant serving plane (llm/tenancy.py): "
+                        "per-tenant KV block accounting + quota-"
+                        "preferred eviction across the device/host/"
+                        "disk/remote tiers, per-tenant nv_llm_tenant_* "
+                        "stats, and the tenant/control/{ns} policy "
+                        "watch (llmctl tenant {set-weight,set-quota})")
     p.add_argument("--kv-fabric", action="store_true",
                    help="join the fleet KV fabric (llm/kv/fabric.py): "
                         "serve this worker's disk/host KV to peers over "
@@ -518,6 +525,12 @@ async def run_worker_endpoint(args, engine, pipeline, core, runtime,
         _wire_kv_weights(runtime, endpoint.namespace)
         _wire_faults(runtime, endpoint.namespace)
         _wire_tracing(args, core, runtime, endpoint)
+        if getattr(args, "tenancy", False):
+            # multi-tenant quotas (llm/tenancy.py): per-tenant block
+            # ledger across the KV tiers + live policy watch
+            # (llmctl tenant {set-weight,set-quota})
+            core.enable_tenancy()
+            _wire_tenants(runtime, endpoint.namespace)
         if args.kv_fabric:
             # fleet KV fabric (llm/kv/fabric.py): serve our disk/host
             # blocks at dyn://{ns}/{comp}/kv_fabric, fetch peers' —
@@ -547,9 +560,36 @@ async def run_worker_endpoint(args, engine, pipeline, core, runtime,
                 await register_model(runtime, ModelEntry(
                     name=_model_name(args), endpoint=endpoint.path,
                     model_type=mt), lease_id=lease.id)
+            # registry card (llm/registry.py): the model's deployment
+            # record — tokenizer ref, geometry, program-set key — under
+            # the same lease, so multi-model frontends can multiplex
+            # the OpenAI `model` field onto this fleet
+            from ..llm.registry import RegistryCard, register_card
+            geometry = {
+                "tp": args.tp, "pp": args.pp, "sp": args.sp,
+                "quantization": args.quantization or None,
+                "kv_quantization": args.kv_quantization or None,
+                "spec_k": args.spec_k, "ragged": bool(args.ragged),
+                "max_seq_len": args.max_model_len,
+            }
+            await register_card(runtime, RegistryCard(
+                name=_model_name(args), endpoint=endpoint.path,
+                model_path=args.model_path,
+                kv_block_size=(core.cfg.kv_block_size if core is not None
+                               else args.kv_block_size or 16),
+                geometry=geometry), lease_id=lease.id)
     logger.info("worker serving %s (%s protocol)", endpoint.path,
                 args.protocol)
     await asyncio.Event().wait()
+
+
+def _wire_tenants(runtime, namespace: str) -> None:
+    """llmctl tenant plumbing (llm/tenancy.py): converge to the stored
+    tenant/control/{ns} policy table and keep applying live updates —
+    the TIER_WEIGHTS retune pattern for tenant weights/quotas."""
+    from ..llm.tenancy import watch_tenants_loop
+    asyncio.get_running_loop().create_task(
+        watch_tenants_loop(runtime, namespace), name="tenant-watch")
 
 
 def _wire_tracing(args, core, runtime, endpoint) -> None:

@@ -59,6 +59,12 @@ class JaxEngine(AsyncEngine):
                               (sc.stop_token_ids_hidden or pre.eos_token_ids)),
             ctx=request.ctx,
             spec_k=-1 if spec is None else max(0, int(spec)),
+            # multi-tenant identity (llm/tenancy.py): payload fields
+            # win, the wire-propagated context identity backs them up —
+            # the KV tiers' per-tenant quota accounting keys on this
+            tenant=(getattr(pre, "tenant_id", None)
+                    or getattr(request.ctx, "tenant", None) or ""),
+            session=getattr(pre, "session_id", None) or "",
         )
 
     async def generate(self, request: SingleIn) -> ManyOut:
@@ -98,11 +104,15 @@ class JaxEngine(AsyncEngine):
                 if item is FINISH_SENTINEL:
                     reason: FinishReason = payload
                     if trace is not None:
-                        # isl/osl ride the finish marker so collected
-                        # traces are exportable as a replayable workload
-                        # (tools/fleetsim.py export-trace)
+                        # isl/osl + tenant/session ride the finish
+                        # marker so collected traces are exportable as a
+                        # replayable workload PRESERVING tenant and
+                        # prefix-reuse structure (tools/fleetsim.py
+                        # export-trace; ROADMAP sim item (d))
                         trace.event("engine.finish", reason=str(reason),
-                                    isl=len(req.prompt), osl=emitted)
+                                    isl=len(req.prompt), osl=emitted,
+                                    tenant=req.tenant or None,
+                                    session=req.session or None)
                     yield Annotated.from_data(BackendOutput.final(reason))
                     return
                 token, logprob = item, payload

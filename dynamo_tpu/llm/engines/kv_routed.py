@@ -48,6 +48,14 @@ class KvRoutedEngine(AsyncEngine):
         self._known_workers: Set[int] = set()
         self._hit_component = None
         self._pub_tasks: Set[asyncio.Task] = set()
+        # tenant fair-share admission (llm/tenancy.py): a tenant whose
+        # in-flight dispatches exceed its fair share of fleet slots
+        # WAITS here in WDRR order (QoS classes drain first) instead of
+        # starving the fleet — the flooding-tenant throttle. Capacity
+        # tracks the scheduler's scraped slot totals live.
+        from ..tenancy import FairShareAdmission
+        self.admission = FairShareAdmission(
+            router.scheduler.fleet_total_slots)
         # observability
         self.kv_hits = 0
         self.kv_routed = 0
@@ -119,10 +127,47 @@ class KvRoutedEngine(AsyncEngine):
     # ------------------------------------------------------------- dispatch
     async def generate(self, request: SingleIn) -> ManyOut:
         tokens = list(request.data.token_ids)
+        # tenant identity: the preprocessed payload's fields win, the
+        # context's (wire-propagated) identity backs them up
+        tenant = (getattr(request.data, "tenant_id", None)
+                  or request.ctx.tenant)
+        qos = getattr(request.data, "qos", None) or request.ctx.qos
+        # fair-share admission BEFORE placement: under contention an
+        # over-share tenant queues here in WDRR order; the stream's end
+        # releases the slot (tenant-blind placement keeps cache
+        # affinity; fairness is a question of WHEN, not WHERE)
+        t = await self.admission.acquire(tenant, qos)
+        released = False
+
+        def release_once():
+            nonlocal released
+            if not released:
+                released = True
+                self.admission.release(t)
+
+        try:
+            stream = await self._dispatch(request, tokens, tenant)
+        except BaseException:
+            release_once()
+            raise
+
+        async def tracked():
+            try:
+                async for item in stream:
+                    yield item
+            finally:
+                release_once()
+
+        from ...runtime.engine import ResponseStream
+        return ResponseStream(tracked(), request.ctx)
+
+    async def _dispatch(self, request: SingleIn, tokens,
+                        tenant) -> ManyOut:
         # draining instances take no new admissions (docs/planner.md);
         # client.random below applies the same exclusion on fallback
         draining = set(self.client.draining_ids())
-        pick = self.router.schedule(tokens, exclude=draining or None)
+        pick = self.router.schedule(tokens, exclude=draining or None,
+                                    tenant=tenant)
         if pick is None:
             self.fallback_routed += 1
             return await self.client.random(request)
@@ -148,7 +193,8 @@ class KvRoutedEngine(AsyncEngine):
     def stats(self) -> dict:
         return {"kv_routed": self.kv_routed, "kv_hits": self.kv_hits,
                 "fallback_routed": self.fallback_routed,
-                "known_workers": sorted(self._known_workers)}
+                "known_workers": sorted(self._known_workers),
+                "tenants": self.admission.counters()}
 
     async def close(self) -> None:
         if self._sub is not None:

@@ -210,6 +210,8 @@ async def _start_fanout(engine, body: dict, ectx: "_FanoutContext",
         sub["seed"] = base + i
         sctx = EngineContext(f"{ectx.id}-c{i}")
         sctx.deadline_s = ectx.deadline_s   # children inherit the budget
+        sctx.tenant = ectx.tenant           # ...and the tenant identity
+        sctx.qos = ectx.qos
         ectx.children.append(sctx)
         return await engine.generate(Context(sub, sctx))
 
@@ -304,12 +306,21 @@ class HttpService:
 
     async def _models(self, request: web.Request) -> web.Response:
         now = int(time.time())
-        return web.json_response({
-            "object": "list",
-            "data": [{"id": m, "object": "model", "created": now,
-                      "owned_by": "dynamo-tpu"}
-                     for m in self.manager.list_models()],
-        })
+        data = []
+        for m in self.manager.list_models():
+            entry = {"id": m, "object": "model", "created": now,
+                     "owned_by": "dynamo-tpu"}
+            card = self.manager._cards.get(m)
+            if card:
+                # registry provenance (llm/registry.py): geometry +
+                # program-set key so a client can tell which compiled
+                # program family is serving the name
+                entry["nvext"] = {k: card[k] for k in
+                                  ("program_set", "revision", "endpoint",
+                                   "kv_block_size")
+                                  if card.get(k) is not None}
+            data.append(entry)
+        return web.json_response({"object": "list", "data": data})
 
     async def _metrics(self, request: web.Request) -> web.Response:
         return web.Response(body=self.metrics.render(),
@@ -350,6 +361,14 @@ class HttpService:
         streaming = bool(body.get("stream", False))
         guard = self.metrics.inflight_guard(model, endpoint, streaming)
         ectx = EngineContext() if n_choices == 1 else _FanoutContext()
+        # multi-tenant identity (llm/tenancy.py): tenant + QoS class ride
+        # the EngineContext so egress stamps them on the request-plane
+        # control message (codec.RequestControlMessage tenant/priority)
+        nvext = body.get("nvext") or {}
+        if nvext.get("tenant") is not None:
+            ectx.tenant = str(nvext["tenant"])
+        if nvext.get("priority") is not None:
+            ectx.qos = str(nvext["priority"])
         # end-to-end deadline (docs/chaos.md): nvext.deadline_ms or the
         # X-Request-Deadline-Ms header arms a budget that rides the
         # request plane (codec.RequestControlMessage.deadline_ms) all

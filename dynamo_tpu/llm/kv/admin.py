@@ -187,8 +187,10 @@ async def watch_weights_loop(runtime, namespace: str) -> None:
     mutated in place so every importer (indexer tier discounting,
     scheduler NetKV credit) sees the change without restart."""
     from ...runtime.kvstore import WatchEventType
+    from ...runtime.tracing import detach_trace
     from ..kv_router.scoring import set_tier_weights
 
+    detach_trace()
     key = kv_weights_key(namespace)
 
     def apply(raw: bytes) -> None:

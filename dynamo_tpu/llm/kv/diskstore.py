@@ -145,6 +145,13 @@ class DiskKvStore:
         # callee owns the values dict outright. clear()/apply_put
         # deletions do NOT fire it — only capacity pressure promotes.
         self.on_evict: Optional[Callable] = None
+        # multi-tenant quota enforcement (llm/tenancy.py): optional
+        # TenantBlockLedger — puts note each hash's tenant in the
+        # "disk" tier (owner remembered from warmer tiers), capacity
+        # eviction prefers an over-quota tenant's blocks. None = the
+        # untenanted LRU exactly.
+        self.tenancy = None
+        self.tenant_evictions = 0
         # stats (nv_llm_kv_disk_* feed)
         self.stored_blocks_total = 0
         self.evicted_blocks_total = 0
@@ -400,16 +407,35 @@ class DiskKvStore:
             self._write_meta()
             self._rewrite_manifest()
 
+    def _tenant_victim(self) -> Optional[int]:
+        """Bounded LRU-front scan for an unpinned block whose tenant is
+        over its disk-tier quota (llm/tenancy.py) — it evicts before
+        anyone else's. None = no preferred victim in scan range."""
+        if self.tenancy is None:
+            return None
+        for i, h in enumerate(self._entries):
+            if i >= 64:
+                break
+            if self._pins.get(h):
+                continue
+            if self.tenancy.is_over_quota_hash(h, "disk"):
+                self.tenant_evictions += 1
+                return h
+        return None
+
     def _evict_for_capacity(self) -> List[int]:
         """Pick LRU victims (skipping pinned, which requeue) until one
-        slot is free. Returns the evicted hashes; [] when nothing had to
-        go; raises BlockingIOError when everything is pinned."""
+        slot is free; an over-quota tenant's blocks go first
+        (_tenant_victim). Returns the evicted hashes; [] when nothing
+        had to go; raises BlockingIOError when everything is pinned."""
         evicted: List[int] = []
         scanned = 0
         while len(self._entries) >= self.capacity:
             if scanned >= len(self._entries):
                 raise BlockingIOError("disk KV store full and all pinned")
-            h = next(iter(self._entries))
+            h = self._tenant_victim()
+            if h is None:
+                h = next(iter(self._entries))
             if self._pins.get(h):
                 self._entries.move_to_end(h)   # requeue pinned candidate
                 scanned += 1
@@ -435,6 +461,8 @@ class DiskKvStore:
             return
         self.bytes_used -= e.nbytes
         self.evicted_blocks_total += 1
+        if self.tenancy is not None:
+            self.tenancy.forget(h, "disk")
         # manifest del BEFORE unlink: a crash in between leaves an orphan
         # file the next open removes — never a live entry without bytes
         self._append_manifest([{"op": "del", "h": h}])
@@ -469,6 +497,9 @@ class DiskKvStore:
                                              _blk_fname(seq_hash), nbytes)
             self.bytes_used += nbytes
             self.stored_blocks_total += 1
+            if self.tenancy is not None:
+                # owner carried from the warmer tiers (ledger memory)
+                self.tenancy.note(seq_hash, None, "disk")
             return evicted
 
     def _write_block(self, seq_hash: int, values: dict,

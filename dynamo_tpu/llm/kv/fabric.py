@@ -567,6 +567,38 @@ class KvFabricServer(AsyncEngine):
                 pass
         return True
 
+    async def _probe_stream(self, conn: dict, nbytes: int) -> bool:
+        """Dial the prober back and stream ``nbytes`` of payload over
+        the native data plane — the SAME path fetches ride, so the
+        measured bandwidth prices the transfers that will actually
+        happen (the request-plane echo measured the wrong path once
+        dataplane fetch was the default). False = dial-back failed →
+        the prober falls back to the request-plane echo."""
+        from ...runtime.tcp import open_stream_sender
+        try:
+            sender = await open_stream_sender(
+                ConnectionInfo.from_dict(conn), timeout=5.0)
+        except Exception:  # noqa: BLE001 — prober's server unreachable
+            logger.warning("fabric probe dial-back to %s failed",
+                           conn.get("address"), exc_info=True)
+            return False
+        chunk = bytes(min(max(nbytes, 1), 1 << 18))
+        sent = 0
+        try:
+            while sent < nbytes:
+                part = chunk[:nbytes - sent] if nbytes - sent < len(chunk) \
+                    else chunk
+                await sender.send(part, header=b"{}")
+                sent += len(part)
+            await sender.finish()
+        except Exception as e:  # noqa: BLE001 — torn probe: prober times out
+            logger.warning("fabric probe stream failed: %s", e)
+            try:
+                await sender.finish(error=str(e))
+            except Exception:  # noqa: BLE001
+                pass
+        return True
+
     async def _handle(self, d: dict) -> dict:
         import base64
         op = d.get("op")
@@ -574,6 +606,16 @@ class KvFabricServer(AsyncEngine):
             self.probes_served += 1
             n = int(d.get("nbytes", 0))
             return {"ok": True, "payload": "0" * n}
+        if op == "probe_native":
+            # bandwidth probe over the native data plane (the path
+            # fetches ride); decline → request-plane echo fallback
+            self.probes_served += 1
+            if not await asyncio.to_thread(dataplane_serving_available):
+                return {"ok": True, "fallback": "json"}
+            n = int(d.get("nbytes", 0))
+            if not await self._probe_stream(d.get("conn") or {}, n):
+                return {"ok": True, "fallback": "json"}
+            return {"ok": True, "dataplane": True, "nbytes": n}
         if op == "match":
             hashes = [int(h) for h in d.get("hashes", [])]
             return {"ok": True,
@@ -671,6 +713,9 @@ class KvFabric:
         # the JSON path because the peer declined (lib absent/env off)
         self.dataplane_fetches_total = 0
         self.dataplane_fallbacks_total = 0
+        # probes that had to ride the request-plane echo because the
+        # peer declined the native-dataplane probe (ROADMAP PaaS ext.)
+        self.probe_fallbacks_total = 0
         self.use_dataplane = os.environ.get(DATAPLANE_ENV, "1") != "0"
         store.peer_fetch = self.fetch_sync
         store.admission = self._admit
@@ -803,15 +848,71 @@ class KvFabric:
                 f"fabric call to peer {worker_id:x} timed out after "
                 f"{self.RPC_TIMEOUT_S:.0f}s (partitioned?)") from None
 
+    async def _probe_native(self, worker_id: int,
+                            nbytes: int) -> Optional[tuple]:
+        """Bandwidth probe over the native data plane — the SAME path
+        fetches ride (csrc/data_plane.cpp), so the measured gbps prices
+        real transfers instead of the request-plane JSON hop. Returns
+        (bytes_received, wall_s) or None when the peer declined (lib
+        absent / env off) or we have no dial-back server — the caller
+        falls back to the request-plane echo."""
+        rt = self._runtime
+        if rt is None or not self.use_dataplane:
+            return None
+        await rt.tcp.start()
+        rx = rt.tcp.register()
+        try:
+            t0 = time.monotonic()
+            r = await self._call(worker_id, {
+                "op": "probe_native", "nbytes": int(nbytes),
+                "conn": rt.tcp.connection_info(rx).to_dict()})
+            if not r.get("dataplane"):
+                return None               # peer declined → echo fallback
+            got = 0
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + self.RPC_TIMEOUT_S
+            while True:
+                f = await rx.next_frame(
+                    timeout=max(deadline - loop.time(), 0.001))
+                if f is None or f.kind == FrameKind.ERROR:
+                    raise RuntimeError(
+                        f"dataplane probe of peer {worker_id:x} tore")
+                if f.kind == FrameKind.SENTINEL:
+                    break
+                if f.kind == FrameKind.DATA:
+                    got += len(f.data)
+            return got, time.monotonic() - t0
+        finally:
+            rx.close()
+            rt.tcp.unregister(rx.stream_id)
+
     async def probe(self, worker_id: int,
                     nbytes: int = PROBE_BYTES) -> LinkStats:
         """Measure the peer's link at attach: a zero-payload round trip
-        for RTT, then a bulk echo for bandwidth. Decay-averaged into the
-        link table (later real transfers — which ride the data plane —
-        keep refining it toward the link fetches actually see)."""
+        for RTT, then a bulk transfer for bandwidth — over the NATIVE
+        data plane by default (the path fetches actually ride; ROADMAP
+        PaaS extension), falling back to the request-plane echo when
+        either side lacks the native lib. Decay-averaged into the link
+        table (later real transfers keep refining it)."""
         t0 = time.monotonic()
         await self._call(worker_id, {"op": "probe", "nbytes": 0})
-        self.links.observe_rtt(worker_id, time.monotonic() - t0)
+        rtt = time.monotonic() - t0
+        self.links.observe_rtt(worker_id, rtt)
+        native = None
+        try:
+            native = await self._probe_native(worker_id, nbytes)
+        except Exception:  # noqa: BLE001 — torn probe: echo still works
+            logger.warning("native dataplane probe of peer %x failed; "
+                           "falling back to request-plane echo",
+                           worker_id, exc_info=True)
+        if native is not None:
+            got, dt = native
+            # the control RPC's round trip rides inside dt — subtract
+            # the measured rtt so the estimate reflects the stream
+            self.links.observe_transfer(worker_id, got,
+                                        max(dt - rtt, 1e-6))
+            return self.links.get(worker_id)
+        self.probe_fallbacks_total += 1
         t0 = time.monotonic()
         r = await self._call(worker_id, {"op": "probe", "nbytes": nbytes})
         dt = time.monotonic() - t0

@@ -90,6 +90,13 @@ class NativeKvBlockPool:
         # register/alloc_uninit/reset already round-trip through Python, so
         # the shadow stays exact at zero native-call cost
         self._registered: dict = {}
+        # multi-tenant ledger (llm/tenancy.py): the native pool ACCOUNTS
+        # per-tenant residency (note on register, forget on removal) but
+        # eviction order stays the C side's priority/LRU — quota-
+        # preferred device eviction needs the Python pool
+        # (DYN_NATIVE_KVPOOL=0); colder tiers quota-prefer either way.
+        self.tenancy = None
+        self.tenant_evictions = 0
 
     def __del__(self):
         h, self._h = getattr(self, "_h", None), None
@@ -221,13 +228,21 @@ class NativeKvBlockPool:
         removed = list(self._hash_buf[:self._n_removed.value])
         for h in removed:
             self._registered.pop(h, None)
+            if self.tenancy is not None:
+                self.tenancy.forget(
+                    h - (1 << 64) if h >= (1 << 63) else h, "device")
         if removed and self.on_removed is not None:
             self.on_removed(removed)
         return list(self._bid_buf[:n])
 
     # ------------------------------------------------------------ register
     def register(self, bid: int, seq_hash: int, tokens_hash: int,
-                 parent_hash: Optional[int], priority: int = 0) -> None:
+                 parent_hash: Optional[int], priority: int = 0,
+                 tenant: Optional[str] = None) -> None:
+        if self.tenancy is not None and tenant is not None:
+            # ledger keys on the SIGNED hash view the rest of the tier
+            # ladder uses (removals below convert back from the C u64)
+            self.tenancy.note(seq_hash, tenant, "device")
         stored = self._lib.kvpool_register(
             self._h, bid, seq_hash & 0xFFFFFFFFFFFFFFFF,
             tokens_hash & 0xFFFFFFFFFFFFFFFF,
@@ -253,6 +268,9 @@ class NativeKvBlockPool:
         removed = list(self._hash_buf[:n])
         for h in removed:
             self._registered.pop(h, None)
+            if self.tenancy is not None:
+                self.tenancy.forget(
+                    h - (1 << 64) if h >= (1 << 63) else h, "device")
         if n and self.on_removed is not None:
             self.on_removed(removed)
 

@@ -78,6 +78,14 @@ class HostKvPool:
         # the disk (G3) tier's feed. values_copy is a fresh per-block
         # dict the callee owns outright.
         self.on_evict: Optional[Callable] = None
+        # multi-tenant quota enforcement (llm/tenancy.py): when a
+        # TenantBlockLedger is attached, stores note each hash's tenant
+        # in the "host" tier (owner remembered from the device tier's
+        # registration) and victim selection prefers an OVER-QUOTA
+        # tenant's blocks (bounded scan) before the plain LRU front.
+        # None keeps eviction byte-identical to the untenanted pool.
+        self.tenancy = None
+        self.tenant_evictions = 0
         # stats
         self.stored_blocks_total = 0
         self.evicted_blocks_total = 0
@@ -124,7 +132,20 @@ class HostKvPool:
         evicted = None
         if not self._free:
             victim = None
-            while self._lru:
+            if self.tenancy is not None:
+                # quota preference: the first unpinned over-quota
+                # tenant's block within a bounded LRU-front scan evicts
+                # before anyone else's (llm/tenancy.py)
+                for i, h in enumerate(self._lru):
+                    if i >= 64:
+                        break
+                    if self._pins.get(self._by_hash[h]):
+                        continue
+                    if self.tenancy.is_over_quota_hash(h, "host"):
+                        victim = h
+                        self.tenant_evictions += 1
+                        break
+            while victim is None and self._lru:
                 h = next(iter(self._lru))
                 if self._pins.get(self._by_hash[h]):
                     self._lru.pop(h)
@@ -139,6 +160,8 @@ class HostKvPool:
             vslot = self._by_hash.pop(victim)
             self._hash_by_slot.pop(vslot, None)
             self.evicted_blocks_total += 1
+            if self.tenancy is not None:
+                self.tenancy.forget(victim, "host")
             if self.on_evict is not None and self._arena is not None:
                 th, ph = self._meta.get(victim, (None, None))
                 try:
@@ -181,6 +204,10 @@ class HostKvPool:
             for key, arena in self._arena.items():
                 arena[slot] = values[key][:, :, i]
             self.stored_blocks_total += 1
+            if self.tenancy is not None:
+                # owner carried over from the device-tier registration
+                # (ledger hash→tenant memory, llm/tenancy.py)
+                self.tenancy.note(h, None, "host")
             decisions.append((h, slot, evicted))
         return decisions
 

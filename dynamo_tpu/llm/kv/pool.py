@@ -156,6 +156,16 @@ class KvBlockPool:
         self._tick = 0
         self.on_stored = on_stored
         self.on_removed = on_removed
+        # multi-tenant quota enforcement (llm/tenancy.py,
+        # docs/multi_tenant.md): when a TenantBlockLedger is attached,
+        # register() notes each hash's tenant in the device tier and
+        # _evict_one prefers victims belonging to an OVER-QUOTA tenant
+        # (bounded scan) — one tenant's eviction storm lands on its own
+        # blocks first. None (the default) keeps eviction byte-identical
+        # to the untenanted pool (the C++ mirror's differential-fuzz
+        # contract is untouched).
+        self.tenancy = None
+        self.tenant_evictions = 0     # victims taken by quota preference
         # stats
         self.match_queries = 0
         self.match_hits = 0
@@ -274,11 +284,19 @@ class KvBlockPool:
             self.alloc_runs_total += self.count_runs(out)
         return out
 
+    TENANT_EVICT_SCAN = 64   # bounded over-quota preference scan depth
+
     def _evict_one(self) -> int:
         # priority first (lower first), then LRU by return_tick — the
         # reference's PriorityKey ordering (reuse.rs) — via the lazy
         # heap: stale entries (block re-matched / re-keyed since push)
         # are skipped by comparing against live meta.
+        if self.tenancy is not None:
+            bid = self._evict_one_tenant_preferred()
+            if bid is not None:
+                self.tenant_evictions += 1
+                self._invalidate(bid)
+                return bid
         while True:
             prio, tick, bid = heapq.heappop(self._evict_heap)
             meta = self._meta[bid]
@@ -289,11 +307,39 @@ class KvBlockPool:
         self._invalidate(bid)
         return bid
 
+    def _evict_one_tenant_preferred(self) -> Optional[int]:
+        """Bounded scan of the eviction heap for a victim whose tenant
+        is over its device-tier quota (llm/tenancy.py). Live entries
+        passed over are pushed back (heap order preserved — they were
+        popped, so no duplicates); stale entries are dropped exactly as
+        the normal pop would. None = no over-quota victim in scan range
+        → the caller falls through to the standard priority/LRU pop."""
+        stash: List[Tuple[int, int, int]] = []
+        found: Optional[int] = None
+        for _ in range(min(len(self._evict_heap), self.TENANT_EVICT_SCAN)):
+            if not self._evict_heap:
+                break
+            prio, tick, bid = heapq.heappop(self._evict_heap)
+            meta = self._meta[bid]
+            if not (bid in self._reusable and meta.priority == prio
+                    and meta.return_tick == tick):
+                self.evict_heap_skips += 1
+                continue
+            if self.tenancy.is_over_quota_hash(meta.seq_hash, "device"):
+                found = bid
+                break
+            stash.append((prio, tick, bid))
+        for e in stash:
+            heapq.heappush(self._evict_heap, e)
+        return found
+
     def _invalidate(self, bid: int) -> None:
         meta = self._meta[bid]
         self._reusable.pop(bid, None)
         if meta.seq_hash is not None:
             self._by_hash.pop(meta.seq_hash, None)
+            if self.tenancy is not None:
+                self.tenancy.forget(meta.seq_hash, "device")
             if self.on_removed is not None:
                 self.on_removed([meta.seq_hash])
         meta.seq_hash = None
@@ -302,9 +348,16 @@ class KvBlockPool:
 
     # ------------------------------------------------------------ register
     def register(self, bid: int, seq_hash: int, tokens_hash: int,
-                 parent_hash: Optional[int], priority: int = 0) -> None:
+                 parent_hash: Optional[int], priority: int = 0,
+                 tenant: Optional[str] = None) -> None:
         """Declare a block's content: it now holds the KV for the block whose
-        chained hash is seq_hash. Emits a `stored` event."""
+        chained hash is seq_hash. Emits a `stored` event. ``tenant``
+        attributes the block in the attached TenantBlockLedger (quota
+        accounting; no-op without a ledger)."""
+        if self.tenancy is not None and tenant is not None:
+            # note even on the duplicate/early-return paths below: the
+            # content exists and serves this tenant's prefix either way
+            self.tenancy.note(seq_hash, tenant, "device")
         meta = self._meta[bid]
         if meta.seq_hash == seq_hash:
             return
@@ -526,6 +579,9 @@ class KvBlockManager:
         self.host_pool = host_pool
         self.disk_store = disk_store
         self.remote_store = remote_store
+        # multi-tenant ledger (llm/tenancy.py) — attached by
+        # EngineCore.enable_tenancy alongside the per-tier hooks
+        self.tenancy = None
 
     def prepare_prefill(self, prompt: Sequence[int], extra_blocks: int = 1,
                         seq: Optional[TokenBlockSequence] = None,
@@ -635,14 +691,17 @@ class KvBlockManager:
 
     def register_full_blocks(self, plan_blocks: List[int],
                              seq: TokenBlockSequence,
-                             already_registered: int) -> int:
+                             already_registered: int,
+                             tenant: Optional[str] = None) -> int:
         """Register every newly-full block of `seq` (device block order ==
-        block-hash order). Returns the new count of registered blocks."""
+        block-hash order). Returns the new count of registered blocks.
+        ``tenant`` attributes the blocks for per-tenant quota accounting
+        (llm/tenancy.py; no-op without an attached ledger)."""
         n_full = seq.num_full_blocks
         for i in range(already_registered, n_full):
             if i >= len(plan_blocks):
                 break
             parent = seq.sequence_hashes[i - 1] if i > 0 else None
             self.pool.register(plan_blocks[i], seq.sequence_hashes[i],
-                               seq.block_hashes[i], parent)
+                               seq.block_hashes[i], parent, tenant=tenant)
         return min(n_full, len(plan_blocks))

@@ -222,6 +222,11 @@ class ObjectKvBackend:
         self.stored_blocks_total = 0
         self.evicted_blocks_total = 0
         self.reaped_corrupt_total = 0
+        # multi-tenant quota enforcement (llm/tenancy.py): the capacity
+        # reaper takes an over-quota tenant's objects first. None = the
+        # untenanted oldest-mtime reap exactly.
+        self.tenancy = None
+        self.tenant_evictions = 0
         self._refresh_index()
 
     def _key(self, seq_hash: int) -> str:
@@ -330,6 +335,9 @@ class ObjectKvBackend:
         with self._lock:
             self._index[seq_hash] = len(data)
             self.stored_blocks_total += 1
+        if self.tenancy is not None:
+            # owner carried from the warmer tiers (ledger memory)
+            self.tenancy.note(seq_hash, None, "remote")
         return self._reap_for_capacity()
 
     def _reap_for_capacity(self) -> List[int]:
@@ -337,6 +345,15 @@ class ObjectKvBackend:
             return []
         aged = sorted(((mtime, key) for key, _sz, mtime
                        in self.store.list_objects(self._PREFIX)))
+        if self.tenancy is not None:
+            # quota preference (llm/tenancy.py): an over-quota tenant's
+            # objects reap before anyone else's, age order within each
+            # class — its eviction storm consumes its own residency
+            over = [e for e in aged if self.tenancy.is_over_quota_hash(
+                self._hash_of_key(e[1]), "remote")]
+            if over:
+                self.tenant_evictions += len(over)
+                aged = over + [e for e in aged if e not in over]
         evicted: List[int] = []
         with self._lock:
             excess = len(self._index) - self.capacity
@@ -349,6 +366,8 @@ class ObjectKvBackend:
             self.store.delete_object(key)
             with self._lock:
                 self._index.pop(h, None)
+            if self.tenancy is not None:
+                self.tenancy.forget(h, "remote")
             self.evicted_blocks_total += 1
             evicted.append(h)
             excess -= 1
@@ -427,6 +446,18 @@ class RemoteKvStore:
         self.fetched_blocks_total = 0
         self.fetch_failures_total = 0
         self.peer_fetched_blocks_total = 0
+
+    # ---------------------------------------------------------- tenancy
+    @property
+    def tenancy(self):
+        """Per-tenant quota ledger (llm/tenancy.py) — lives on the
+        object backend, where capacity reaping happens."""
+        return self.object.tenancy if self.object is not None else None
+
+    @tenancy.setter
+    def tenancy(self, ledger) -> None:
+        if self.object is not None:
+            self.object.tenancy = ledger
 
     # ---------------------------------------------------------- index feed
     def note_peer_stored(self, worker_id: int,

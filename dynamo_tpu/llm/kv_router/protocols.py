@@ -150,6 +150,13 @@ class ForwardPassMetrics:
     remote_breaker_open_peers: int = 0
     remote_breaker_trips_total: int = 0
     disk_spill_shed_total: int = 0
+    # multi-tenant serving plane round 14 (appended — DL004 append-only
+    # evolution; llm/tenancy.py, docs/multi_tenant.md): per-tenant
+    # serving stats — {tenant: {admitted, throttled, kv_blocks,
+    # hit_rate}} — the nv_llm_tenant_* LABELED gauge feed
+    # (components/metrics.py exports one series per tenant). Empty on
+    # old payloads / untenanted engines.
+    tenant_stats: dict = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> dict:
         # every field is a scalar; dataclasses.asdict would deep-copy

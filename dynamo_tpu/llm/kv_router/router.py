@@ -49,11 +49,13 @@ class KvRouter:
 
     # -- decision
     def schedule(self, token_ids: Sequence[int],
-                 exclude: Optional[set] = None) -> Optional[tuple]:
+                 exclude: Optional[set] = None,
+                 tenant: Optional[str] = None) -> Optional[tuple]:
         """Returns (worker_id, overlap_blocks) or None if no workers.
         ``exclude`` bars draining workers from new admissions — their
         indexed blocks stay in the radix tree (they come back if the
-        drain is cancelled), the scheduler just won't pick them."""
+        drain is cancelled), the scheduler just won't pick them.
+        ``tenant`` attributes the decision for fair-share accounting."""
         overlap = self.indexer.find_matches_for_request(token_ids)
         self.last_frequencies = overlap.frequencies
         # the scheduler gets the FULL OverlapScores: tier-discounted
@@ -62,7 +64,7 @@ class KvRouter:
         # modeled transfer beating its modeled recompute, and
         # fabric-fetchable credit for blocks other workers hold
         worker = self.scheduler.schedule(len(token_ids), overlap,
-                                         exclude=exclude)
+                                         exclude=exclude, tenant=tenant)
         if worker is None:
             return None
         return worker, overlap.scores.get(worker, 0)

@@ -53,11 +53,31 @@ class KvScheduler:
         # optimistic deltas applied on top of the last scrape
         self._opt_blocks: dict = {}
         self._opt_slots: dict = {}
+        # multi-tenant accounting (llm/tenancy.py; docs/multi_tenant.md):
+        # per-tenant routing decisions + optimistic in-flight slots since
+        # the last scrape — the nv_llm_tenant_* gauge feed and the
+        # FairShareAdmission gate's contention signal
+        self.tenant_admitted: dict = {}
+        self._opt_tenant_slots: dict = {}
 
     def update_endpoints(self, endpoints: ProcessedEndpoints) -> None:
         self.endpoints = endpoints
         self._opt_blocks.clear()
         self._opt_slots.clear()
+        self._opt_tenant_slots.clear()
+
+    def fleet_total_slots(self) -> int:
+        """Sum of scraped request slots — the FairShareAdmission gate's
+        live capacity input (llm/tenancy.py): a tenant's fair-share
+        bound tracks scale-out without re-plumbing."""
+        return sum(ep.metrics.request_total_slots
+                   for ep in self.endpoints.endpoints.values())
+
+    def tenant_counters(self) -> dict:
+        """tenant → admitted routing decisions since start (the
+        scheduler's half of the nv_llm_tenant_* feed; throttles are
+        counted by the admission gate that actually queues)."""
+        return dict(self.tenant_admitted)
 
     def _effective_overlap(self, ep, overlap, fleet_depth: int) -> float:
         """One candidate's overlap credit. With a full OverlapScores in
@@ -90,13 +110,18 @@ class KvScheduler:
         return overlap.get(worker_id, 0)
 
     def schedule(self, isl_tokens: int, overlap_scores,
-                 exclude: Optional[set] = None) -> Optional[int]:
+                 exclude: Optional[set] = None,
+                 tenant: Optional[str] = None) -> Optional[int]:
         """Returns the chosen worker id, or None when no worker is usable.
         ``overlap_scores``: an indexer OverlapScores (network-aware
         scoring) or a plain {worker_id: effective_overlap} dict (legacy
         callers). ``exclude``: worker ids barred from NEW admissions
         (the planner's draining set) — skipped like full workers, so a
-        drain shifts load instead of dropping requests."""
+        drain shifts load instead of dropping requests. ``tenant``
+        attributes the decision for per-tenant fair-share accounting
+        (llm/tenancy.py FairShareAdmission queues BEFORE this runs —
+        placement itself stays tenant-blind so cache affinity is never
+        sacrificed to fairness)."""
         OverlapScores, _ = _lazy_imports()
         eps = self.endpoints
         if not len(eps):
@@ -152,6 +177,11 @@ class KvScheduler:
             + (isl_blocks - overlap_blocks))
         self._opt_slots[best_worker.worker_id] = (
             self._opt_slots.get(best_worker.worker_id, 0) + 1)
+        if tenant is not None:
+            self.tenant_admitted[tenant] = (
+                self.tenant_admitted.get(tenant, 0) + 1)
+            self._opt_tenant_slots[tenant] = (
+                self._opt_tenant_slots.get(tenant, 0) + 1)
         if self.on_hit_rate is not None:
             # tier-weighted overlap may be fractional; the hit-rate
             # event's contract is whole blocks

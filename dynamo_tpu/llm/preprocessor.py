@@ -189,6 +189,12 @@ class OpenAIPreprocessor(Operator):
             # per-request draft budget (engine/spec/); None falls back
             # to the serving engine's live default
             speculation=(nvext.speculation if nvext else None),
+            # multi-tenant plane (llm/tenancy.py): tenant/QoS/session
+            # ride into the router's fair-share admission and the KV
+            # tiers' quota accounting
+            tenant_id=(nvext.tenant if nvext else None),
+            qos=(nvext.priority if nvext else None),
+            session_id=(nvext.session_id if nvext else None),
         )
 
     # ------------------------------------------------------------- operator

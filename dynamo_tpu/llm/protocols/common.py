@@ -98,6 +98,17 @@ class PreprocessedRequest:
     # n > 0 clamps to the engine's compiled maximum (EngineConfig
     # spec_k). Surfaces as nvext.speculation on the OpenAI edge.
     speculation: Optional[int] = None
+    # Multi-tenant serving plane (llm/tenancy.py, appended — DL004
+    # append-only evolution): tenant id + QoS class ("interactive" |
+    # "standard" | "batch") from nvext.tenant/nvext.priority — the
+    # router's fair-share admission and the KV tiers' per-tenant quota
+    # accounting key on these; session_id (nvext.session_id) groups
+    # requests so exported traces preserve prefix-reuse structure
+    # (tools/fleetsim.py export-trace). None = the implicit single
+    # tenant (old senders decode unchanged).
+    tenant_id: Optional[str] = None
+    qos: Optional[str] = None
+    session_id: Optional[str] = None
 
     @classmethod
     def from_dict(cls, d: dict) -> "PreprocessedRequest":

@@ -34,6 +34,15 @@ class NvExt(BaseModel):
     # speculative decoding: max draft tokens verified per step (None =
     # engine default, 0 = off; clamped to the worker's compiled maximum)
     speculation: Optional[int] = None
+    # multi-tenant serving plane (llm/tenancy.py; docs/multi_tenant.md):
+    # tenant id + QoS class ride the wire into the router's fair-share
+    # admission and the tiers' per-tenant quota accounting. priority is
+    # one of "interactive" | "standard" | "batch" (unknown values fall
+    # back to the tenant's default class). session_id groups requests
+    # for prefix-reuse structure (fleetsim export-trace preserves it).
+    tenant: Optional[str] = None
+    priority: Optional[str] = None
+    session_id: Optional[str] = None
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,199 @@
+"""Model registry: deployment cards on the kvstore, watched live.
+
+The multi-model serving plane's source of truth (PAPER.md layers 2/4 —
+the reference's ``ModelDeploymentCard`` travelling through etcd so
+frontends can serve models they never loaded). A :class:`RegistryCard`
+names everything a frontend/processor needs to multiplex the OpenAI
+``model`` field onto a worker fleet:
+
+- the served ``name`` and its ``endpoint`` (dyn://ns/comp/ep),
+- the ``model_path``/tokenizer ref the preprocessor loads,
+- the serving ``geometry`` (tp/pp/quant/spec/ragged/... — whatever the
+  fleet was launched with), and
+- the derived ``program_set_key`` — a stable digest of the geometry
+  features that select a compiled program set. Two fleets with the same
+  key serve bit-compatible programs; this is the seam the
+  composition-closure refactor (ROADMAP) plugs its unified program-set
+  builder into: one key → one builder invocation.
+
+Cards live under ``modelreg/cards/{name}``; self-registering workers
+attach their primary lease (the card dies with the fleet's last
+worker... actually with the registering process — llmctl-managed cards
+persist). :class:`RegistryWatcher` keeps any consumer in sync — the
+processor builds/tears down per-model pipelines from it, each with its
+own per-model KvIndexer/KvScheduler (llm/engines/kv_routed.py), so one
+frontend serves N models with N independent routing planes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import hashlib
+import json
+import logging
+from typing import Dict, Optional
+
+logger = logging.getLogger("dynamo_tpu.llm.registry")
+
+__all__ = ["REGISTRY_PREFIX", "RegistryCard", "card_key",
+           "program_set_key", "register_card", "remove_card", "get_card",
+           "list_cards", "RegistryWatcher"]
+
+REGISTRY_PREFIX = "modelreg/cards/"
+
+# geometry keys that select a compiled program set, in canonical order;
+# anything else in the geometry dict is descriptive only
+_PROGRAM_KEYS = ("tp", "pp", "sp", "quantization", "kv_quantization",
+                 "mla", "spec_k", "sliding_window", "ragged",
+                 "kv_block_size", "max_seq_len")
+
+
+def card_key(name: str) -> str:
+    return f"{REGISTRY_PREFIX}{name}"
+
+
+def program_set_key(geometry: Dict[str, object]) -> str:
+    """Stable digest of the program-selecting geometry features. The
+    canonical key order (not dict order) and JSON scalar encoding make
+    the key reproducible across processes — the composition-closure
+    builder's future cache key."""
+    sel = {k: geometry.get(k) for k in _PROGRAM_KEYS
+           if geometry.get(k) not in (None, 0, False, "")}
+    blob = json.dumps(sel, sort_keys=True).encode()
+    return hashlib.blake2s(blob, digest_size=8).hexdigest()
+
+
+@dataclasses.dataclass
+class RegistryCard:
+    """One served model's deployment card (the registry record)."""
+
+    name: str
+    endpoint: str                     # dyn://ns/comp/ep or ns.comp.ep
+    model_path: Optional[str] = None  # tokenizer/config ref (HF-style dir)
+    model_type: str = "chat+completion"   # chat | completion | chat+completion
+    kv_block_size: int = 16
+    geometry: Dict[str, object] = dataclasses.field(default_factory=dict)
+    program_set: str = ""             # derived when empty (see __post_init__)
+    revision: int = 0
+    mdcsum: Optional[str] = None      # preprocessing checksum when known
+
+    def __post_init__(self):
+        if not self.program_set:
+            geo = dict(self.geometry)
+            geo.setdefault("kv_block_size", self.kv_block_size)
+            self.program_set = program_set_key(geo)
+
+    def types(self) -> tuple:
+        return tuple(t for t in self.model_type.split("+")
+                     if t in ("chat", "completion")) or ("chat",)
+
+    def to_json(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "RegistryCard":
+        d = json.loads(raw)
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+async def register_card(runtime, card: RegistryCard,
+                        lease_id: int = 0) -> None:
+    """Write (or bump) a card. Self-registering workers pass their
+    primary lease so the card dies with the fleet's registering process;
+    llmctl-managed cards persist until removed."""
+    existing = await get_card(runtime, card.name)
+    if existing is not None:
+        card.revision = existing.revision + 1
+    await runtime.store.kv_put(card_key(card.name), card.to_json(),
+                               lease_id=lease_id)
+
+
+async def remove_card(runtime, name: str) -> bool:
+    return await runtime.store.kv_delete(card_key(name))
+
+
+async def get_card(runtime, name: str) -> Optional[RegistryCard]:
+    entry = await runtime.store.kv_get(card_key(name))
+    if entry is None:
+        return None
+    try:
+        return RegistryCard.from_json(entry.value)
+    except (ValueError, TypeError):
+        logger.warning("malformed registry card at %s", card_key(name))
+        return None
+
+
+async def list_cards(runtime) -> Dict[str, RegistryCard]:
+    out: Dict[str, RegistryCard] = {}
+    for e in await runtime.store.kv_get_prefix(REGISTRY_PREFIX):
+        try:
+            card = RegistryCard.from_json(e.value)
+        except (ValueError, TypeError):
+            logger.warning("malformed registry card at %s", e.key)
+            continue
+        out[card.name] = card
+    return out
+
+
+class RegistryWatcher:
+    """Watches ``modelreg/cards/`` and drives async add/remove
+    callbacks: ``on_card(card)`` on PUT (adds AND revisions),
+    ``on_removed(name)`` on DELETE. Consumers (the processor's
+    multiplexer, test harnesses) own whatever state the callbacks
+    build; the watcher only sequences kvstore events."""
+
+    def __init__(self, runtime, on_card, on_removed):
+        self.runtime = runtime
+        self.on_card = on_card
+        self.on_removed = on_removed
+        self._watcher = None
+        self._task: Optional[asyncio.Task] = None
+        self.cards: Dict[str, RegistryCard] = {}
+
+    async def start(self) -> "RegistryWatcher":
+        from ..runtime.tracing import detach_trace
+        # replay current cards before watching so a late-started
+        # frontend converges to the registry's present state
+        self._watcher = await self.runtime.store.watch_prefix(
+            REGISTRY_PREFIX)
+        for name, card in sorted((await list_cards(self.runtime)).items()):
+            self.cards[name] = card
+            await self.on_card(card)
+
+        async def loop():
+            detach_trace()
+            from ..runtime.kvstore import WatchEventType
+            async for ev in self._watcher:
+                name = ev.entry.key[len(REGISTRY_PREFIX):]
+                try:
+                    if ev.type == WatchEventType.PUT:
+                        card = RegistryCard.from_json(ev.entry.value)
+                        prev = self.cards.get(name)
+                        if (prev is not None
+                                and prev.to_json() == card.to_json()):
+                            continue      # startup-replay echo
+                        self.cards[name] = card
+                        await self.on_card(card)
+                    else:
+                        self.cards.pop(name, None)
+                        await self.on_removed(name)
+                except Exception:  # noqa: BLE001 — one bad card must not
+                    logger.exception("registry watch event failed for %s",
+                                     name)
+
+        self._task = asyncio.get_running_loop().create_task(
+            loop(), name="model-registry-watch")
+        return self
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+        if self._watcher is not None:
+            self._watcher.close()

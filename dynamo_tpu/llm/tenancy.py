@@ -1,0 +1,545 @@
+"""Multi-tenant serving policy: fair-share scheduling + KV quotas.
+
+The last unserved axis of the ROADMAP's millions-of-users north star:
+every request now carries a tenant id and a QoS class (``nvext.tenant``
+/ ``nvext.priority`` → ``PreprocessedRequest.tenant_id``/``qos`` →
+``RequestControlMessage``), and this module is the one home of the
+policy machinery that prices them:
+
+- :class:`TenantPolicy` / the module-level :data:`TENANT_TABLE` — the
+  per-tenant (weight, kv_quota_blocks, default qos) record, retuned
+  LIVE over the ``tenant/control/{ns}`` kvstore key (``llmctl tenant
+  {set-weight,set-quota,status}``) exactly like the router's
+  TIER_WEIGHTS: the dict is mutated in place so every importer — the
+  KvScheduler's share math, the tiers' quota checks, sim workers —
+  sees a retune without restart.
+
+- :class:`FairShareQueue` — weighted deficit round-robin over
+  per-tenant queues with QoS preemption-priority ordering
+  (interactive > standard > batch). A flooding tenant's backlog sits
+  in ITS queue; drain order gives every backlogged tenant service
+  proportional to its weight, so the flood is throttled to its share
+  instead of starving the fleet (FlowKV's load-aware-per-flow lesson
+  applied at admission). Deterministic: tenant order is sorted, no
+  wall clock, no randomness — safe inside the virtual-clock sim and
+  recorded replay.
+
+- :class:`FairShareAdmission` — the serving-path gate
+  (llm/engines/kv_routed.py): a tenant whose in-flight share exceeds
+  its fair share of the fleet's slots WAITS in the fair-share queue
+  instead of dispatching; releases wake waiters in WDRR order.
+
+- :class:`TenantBlockLedger` — per-tenant block accounting across the
+  KV tiers (device/host/disk/remote). Tiers note/forget residency per
+  (tier, hash); eviction victim selection asks
+  :meth:`is_over_quota_hash` FIRST, so one tenant's eviction storm
+  lands on its own over-quota blocks before it can crater another
+  tenant's hit rate (NetKV's instance-selection lesson generalized:
+  state is priced per tenant, not just globally).
+
+Everything here is control-plane pure Python: no jit, no wall clock,
+no randomness — the noisy_neighbor sim scenario runs these exact
+classes under the byte-identical-event-log determinism gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from collections import deque
+from typing import Deque, Dict, Iterable, List, Optional, Tuple
+
+logger = logging.getLogger("dynamo_tpu.llm.tenancy")
+
+__all__ = [
+    "DEFAULT_TENANT", "QOS_CLASSES", "QOS_PRIORITY",
+    "TenantPolicy", "TenantTable", "TENANT_TABLE", "set_tenant_policies",
+    "tenant_control_key", "watch_tenants_loop",
+    "FairShareQueue", "FairShareAdmission", "TenantBlockLedger",
+]
+
+DEFAULT_TENANT = "default"
+
+# QoS preemption-priority order: lower rank drains first. Unknown
+# classes coerce to "standard" (a typo'd priority must not jump or
+# starve the queue).
+QOS_CLASSES = ("interactive", "standard", "batch")
+QOS_PRIORITY = {name: i for i, name in enumerate(QOS_CLASSES)}
+
+TENANT_PREFIX = "tenant/"
+
+
+def tenant_control_key(namespace: str) -> str:
+    """``llmctl tenant`` target: a JSON {tenant: policy} table every
+    watching worker/router applies live (the TIER_WEIGHTS retune
+    pattern)."""
+    return f"{TENANT_PREFIX}control/{namespace}"
+
+
+@dataclasses.dataclass
+class TenantPolicy:
+    """One tenant's share contract.
+
+    ``weight``: fair-share weight (WDRR quantum scale; share =
+    weight / sum of ACTIVE tenants' weights).
+    ``kv_quota_blocks``: per-tier resident-block quota; 0 = unlimited.
+    ``qos``: default QoS class for requests that don't name one."""
+
+    weight: float = 1.0
+    kv_quota_blocks: int = 0
+    qos: str = "standard"
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"tenant weight must be > 0, got {self.weight}")
+        if self.qos not in QOS_PRIORITY:
+            self.qos = "standard"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TenantPolicy":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
+
+class TenantTable:
+    """The live {tenant: TenantPolicy} map. Unknown tenants get the
+    default policy (weight 1.0, no quota) — multi-tenancy is opt-in
+    per tenant, never a hard gate on traffic."""
+
+    def __init__(self, policies: Optional[Dict[str, TenantPolicy]] = None):
+        self.policies: Dict[str, TenantPolicy] = dict(policies or {})
+        self.default = TenantPolicy()
+
+    def get(self, tenant: Optional[str]) -> TenantPolicy:
+        return self.policies.get(tenant or DEFAULT_TENANT, self.default)
+
+    def weight(self, tenant: Optional[str]) -> float:
+        return self.get(tenant).weight
+
+    def quota(self, tenant: Optional[str]) -> int:
+        return self.get(tenant).kv_quota_blocks
+
+    def qos_of(self, tenant: Optional[str],
+               requested: Optional[str]) -> str:
+        if requested in QOS_PRIORITY:
+            return requested
+        return self.get(tenant).qos
+
+    def share(self, tenant: Optional[str],
+              active: Iterable[str]) -> float:
+        """Fair share of ``tenant`` among the ACTIVE tenant set (itself
+        included whether listed or not)."""
+        names = set(active)
+        names.add(tenant or DEFAULT_TENANT)
+        total = sum(self.weight(t) for t in names)
+        if total <= 0:
+            return 1.0
+        return self.weight(tenant) / total
+
+    def set(self, tenant: str, **updates) -> TenantPolicy:
+        pol = self.policies.get(tenant, TenantPolicy())
+        d = pol.to_dict()
+        d.update({k: v for k, v in updates.items() if v is not None})
+        pol = TenantPolicy.from_dict(d)
+        self.policies[tenant] = pol
+        return pol
+
+    def to_json(self) -> bytes:
+        return json.dumps({t: p.to_dict()
+                           for t, p in sorted(self.policies.items())}).encode()
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> "TenantTable":
+        d = json.loads(raw)
+        if not isinstance(d, dict):
+            raise ValueError("tenant table must be a JSON object")
+        return cls({t: TenantPolicy.from_dict(p) for t, p in d.items()
+                    if isinstance(p, dict)})
+
+
+# The process-wide table (the TIER_WEIGHTS pattern): mutated in place by
+# set_tenant_policies so every importer — scheduler share math, tier
+# quota checks — follows a live retune without re-plumbing references.
+TENANT_TABLE = TenantTable()
+
+
+def set_tenant_policies(policies: Dict[str, dict],
+                        table: Optional[TenantTable] = None) -> TenantTable:
+    """Replace the live table's policies in place from a JSON-shaped
+    {tenant: {weight, kv_quota_blocks, qos}} map. Malformed entries are
+    skipped loudly rather than poisoning the table."""
+    table = table if table is not None else TENANT_TABLE
+    fresh: Dict[str, TenantPolicy] = {}
+    for t, p in policies.items():
+        try:
+            fresh[t] = TenantPolicy.from_dict(p)
+        except (TypeError, ValueError) as e:
+            logger.warning("ignoring malformed tenant policy %s: %s", t, e)
+    table.policies.clear()
+    table.policies.update(fresh)
+    return table
+
+
+async def watch_tenants_loop(runtime, namespace: str,
+                             table: Optional[TenantTable] = None) -> None:
+    """Standing task: apply ``llmctl tenant set-*`` live. Like the
+    tier-weights watch, the STORED value applies at startup too —
+    tenant policy is declarative config, so a late joiner converges to
+    the namespace's current table."""
+    from ..runtime.kvstore import WatchEventType
+    from ..runtime.tracing import detach_trace
+    detach_trace()
+    key = tenant_control_key(namespace)
+
+    def apply(raw: bytes) -> None:
+        try:
+            d = json.loads(raw)
+        except ValueError:
+            logger.warning("ignoring malformed tenant table at %s", key)
+            return
+        if not isinstance(d, dict):
+            logger.warning("ignoring non-dict tenant table at %s", key)
+            return
+        eff = set_tenant_policies(d, table)
+        logger.info("tenant policies -> %s",
+                    {t: p.to_dict() for t, p in eff.policies.items()})
+
+    entry = await runtime.store.kv_get(key)
+    if entry is not None:
+        apply(entry.value)
+    watcher = await runtime.store.watch_prefix(key)
+    async for ev in watcher:
+        if ev.type == WatchEventType.PUT:
+            apply(ev.entry.value)
+
+
+# ---------------------------------------------------------------------------
+# Weighted deficit round-robin with QoS classes
+# ---------------------------------------------------------------------------
+
+
+class FairShareQueue:
+    """Per-tenant queues drained by weighted deficit round-robin, with
+    QoS preemption-priority between classes.
+
+    ``push(item, tenant, qos, cost)`` enqueues; ``pop()`` returns the
+    next item: the highest-priority QoS class with ANY backlog drains
+    first (an interactive request never waits behind a batch flood);
+    within a class, tenants take turns in sorted-name order, each
+    spending a deficit counter replenished by ``quantum × weight`` per
+    round — a tenant whose items cost more than its deficit skips the
+    round, which is exactly the throttle: a 10× flooding tenant gets
+    ~its weight share of pops, no more.
+
+    Deterministic by construction (sorted tenant order, no clock, no
+    randomness): safe under the sim's byte-identical-event-log gate
+    and in recorded replay."""
+
+    QUANTUM = 4.0   # deficit replenished per round per unit weight
+
+    def __init__(self, table: Optional[TenantTable] = None):
+        self.table = table if table is not None else TENANT_TABLE
+        # qos rank → tenant → deque of (item, cost)
+        self._queues: Dict[int, Dict[str, Deque[Tuple[object, float]]]] = {}
+        self._deficit: Dict[Tuple[int, str], float] = {}
+        # round-robin cursor per qos class (tenant name last served)
+        self._cursor: Dict[int, Optional[str]] = {}
+        self._len = 0
+        self.pushed_total: Dict[str, int] = {}
+        self.popped_total: Dict[str, int] = {}
+
+    def __len__(self) -> int:
+        return self._len
+
+    def backlog(self, tenant: str) -> int:
+        return sum(len(q.get(tenant, ()))
+                   for q in self._queues.values())
+
+    def push(self, item, tenant: Optional[str] = None,
+             qos: Optional[str] = None, cost: float = 1.0) -> None:
+        tenant = tenant or DEFAULT_TENANT
+        rank = QOS_PRIORITY.get(
+            self.table.qos_of(tenant, qos), QOS_PRIORITY["standard"])
+        per_class = self._queues.setdefault(rank, {})
+        q = per_class.get(tenant)
+        if q is None:
+            q = per_class[tenant] = deque()
+            self._deficit.setdefault((rank, tenant), 0.0)
+        q.append((item, max(float(cost), 0.0)))
+        self._len += 1
+        self.pushed_total[tenant] = self.pushed_total.get(tenant, 0) + 1
+
+    def _tenants_after(self, rank: int, names: List[str]) -> List[str]:
+        """Backlogged tenants of one class in round-robin order starting
+        AFTER the class cursor (sorted base order)."""
+        cur = self._cursor.get(rank)
+        if cur is None or cur not in names:
+            return names
+        i = names.index(cur)
+        return names[i + 1:] + names[:i + 1]
+
+    def pop(self):
+        """Next (item, tenant) by QoS-then-WDRR order; None when empty."""
+        if self._len == 0:
+            return None
+        for rank in sorted(self._queues):
+            per_class = self._queues[rank]
+            names = sorted(t for t, q in per_class.items() if q)
+            if not names:
+                continue
+            order = self._tenants_after(rank, names)
+            # at most two replenish rounds are ever needed: after one
+            # full round every backlogged tenant's deficit >= quantum ×
+            # weight >= the head item's cost for any sane cost scale;
+            # the guard below hard-caps pathological costs
+            for _round in range(64):
+                for t in order:
+                    q = per_class[t]
+                    if not q:
+                        continue
+                    key = (rank, t)
+                    item, cost = q[0]
+                    if self._deficit[key] >= cost:
+                        q.popleft()
+                        self._deficit[key] -= cost
+                        if not q:
+                            # an emptied queue forfeits its leftover
+                            # deficit: WDRR's anti-burst rule
+                            self._deficit[key] = 0.0
+                        self._cursor[rank] = t
+                        self._len -= 1
+                        self.popped_total[t] = (
+                            self.popped_total.get(t, 0) + 1)
+                        return item, t
+                # replenish and go around again
+                for t in order:
+                    if per_class[t]:
+                        self._deficit[(rank, t)] += (
+                            self.QUANTUM * self.table.weight(t))
+            # pathological cost scale: serve the head of the first
+            # backlogged tenant rather than spin
+            t = order[0]
+            item, cost = per_class[t].popleft()
+            self._deficit[(rank, t)] = 0.0
+            self._cursor[rank] = t
+            self._len -= 1
+            self.popped_total[t] = self.popped_total.get(t, 0) + 1
+            return item, t
+        return None
+
+    def popleft(self):
+        """Deque-compatible spelling: returns the item alone (the sim
+        worker's waiting-queue drop-in)."""
+        got = self.pop()
+        if got is None:
+            raise IndexError("pop from empty FairShareQueue")
+        return got[0]
+
+    def __iter__(self):
+        for per_class in self._queues.values():
+            for q in per_class.values():
+                for item, _cost in q:
+                    yield item
+
+    def clear(self) -> None:
+        self._queues.clear()
+        self._deficit.clear()
+        self._cursor.clear()
+        self._len = 0
+
+
+# ---------------------------------------------------------------------------
+# Serving-path admission gate
+# ---------------------------------------------------------------------------
+
+
+class FairShareAdmission:
+    """Router-side admission: bound each tenant's IN-FLIGHT dispatches
+    to its fair share of fleet capacity whenever there is contention.
+
+    ``acquire(tenant, qos)`` returns immediately while the fleet has
+    headroom OR the tenant is under its share; otherwise the caller
+    waits in a :class:`FairShareQueue` and is woken by ``release`` in
+    WDRR order. ``capacity`` is a callable returning the fleet's total
+    request slots (the scheduler's scraped view) so the bound tracks
+    scale-out live; 0/unknown capacity admits everything (cold fleet:
+    admit-optimistic, the tiers' posture)."""
+
+    def __init__(self, capacity, table: Optional[TenantTable] = None,
+                 headroom: float = 0.85):
+        import asyncio
+        self._asyncio = asyncio
+        self.capacity = capacity
+        self.table = table if table is not None else TENANT_TABLE
+        self.headroom = headroom
+        self.inflight: Dict[str, int] = {}
+        self.waiters = FairShareQueue(self.table)
+        self.admitted_total: Dict[str, int] = {}
+        self.throttled_total: Dict[str, int] = {}
+
+    def _inflight_total(self) -> int:
+        return sum(self.inflight.values())
+
+    def would_throttle(self, tenant: str) -> bool:
+        cap = int(self.capacity() or 0)
+        if cap <= 0:
+            return False
+        total = self._inflight_total()
+        if total < self.headroom * cap:
+            return False          # headroom: nobody queues
+        active = [t for t, n in self.inflight.items() if n > 0]
+        share = self.table.share(tenant, active)
+        return self.inflight.get(tenant, 0) >= max(share * cap, 1.0)
+
+    async def acquire(self, tenant: Optional[str] = None,
+                      qos: Optional[str] = None) -> str:
+        tenant = tenant or DEFAULT_TENANT
+        if self.would_throttle(tenant):
+            self.throttled_total[tenant] = (
+                self.throttled_total.get(tenant, 0) + 1)
+            fut = self._asyncio.get_running_loop().create_future()
+            self.waiters.push(fut, tenant, qos)
+            await fut
+        self.inflight[tenant] = self.inflight.get(tenant, 0) + 1
+        self.admitted_total[tenant] = (
+            self.admitted_total.get(tenant, 0) + 1)
+        return tenant
+
+    def release(self, tenant: str) -> None:
+        n = self.inflight.get(tenant, 0)
+        if n <= 1:
+            self.inflight.pop(tenant, None)
+        else:
+            self.inflight[tenant] = n - 1
+        # wake the next eligible waiter (WDRR order); skip waiters whose
+        # tenant is STILL over its share — they re-queue at the tail of
+        # their tenant queue, preserving the share bound
+        requeue = []
+        while len(self.waiters):
+            got = self.waiters.pop()
+            if got is None:
+                break
+            fut, t = got
+            if fut.cancelled():
+                continue
+            if self.would_throttle(t):
+                requeue.append((fut, t))
+                continue
+            fut.set_result(None)
+            break
+        for fut, t in requeue:
+            self.waiters.push(fut, t)
+
+    def counters(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {}
+        for t in set(self.admitted_total) | set(self.throttled_total):
+            out[t] = {"admitted": self.admitted_total.get(t, 0),
+                      "throttled": self.throttled_total.get(t, 0)}
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant KV block accounting + quota enforcement
+# ---------------------------------------------------------------------------
+
+
+class TenantBlockLedger:
+    """Per-(tier, tenant) resident-block accounting shared by the KV
+    tiers. Tiers call :meth:`note`/:meth:`forget` at registration /
+    invalidation; eviction victim selection asks
+    :meth:`is_over_quota_hash` to land evictions on the over-quota
+    tenant's blocks FIRST (device pool ``_evict_one``, host pool
+    ``_slot_for``, disk/remote LRU reapers) — one tenant's eviction
+    storm consumes its own residency before anyone else's."""
+
+    TIERS = ("device", "host", "disk", "remote")
+
+    KNOWN_CAP = 1 << 20   # hash→tenant memory bound (FIFO reap)
+
+    def __init__(self, table: Optional[TenantTable] = None):
+        self.table = table if table is not None else TENANT_TABLE
+        # tier → hash → tenant
+        self._present: Dict[str, Dict[int, str]] = {t: {}
+                                                    for t in self.TIERS}
+        # tier → tenant → count (maintained incrementally)
+        self._counts: Dict[str, Dict[str, int]] = {t: {}
+                                                   for t in self.TIERS}
+        # persistent hash→tenant memory: a block evicted from the device
+        # tier keeps its owner as it demotes host→disk→remote (the
+        # colder tiers note residency AFTER the warmer tier forgot).
+        # Bounded FIFO so a long-lived server never grows without limit.
+        self._known: Dict[int, str] = {}
+
+    def note(self, seq_hash: int, tenant: Optional[str],
+             tier: str = "device") -> None:
+        if tenant is None:
+            tenant = self._known.get(seq_hash)
+        if tenant is None:
+            return
+        self._known.pop(seq_hash, None)
+        self._known[seq_hash] = tenant
+        while len(self._known) > self.KNOWN_CAP:
+            self._known.pop(next(iter(self._known)))
+        present = self._present.setdefault(tier, {})
+        old = present.get(seq_hash)
+        if old == tenant:
+            return
+        counts = self._counts.setdefault(tier, {})
+        if old is not None:
+            counts[old] = max(counts.get(old, 0) - 1, 0)
+        present[seq_hash] = tenant
+        counts[tenant] = counts.get(tenant, 0) + 1
+
+    def forget(self, seq_hash: int, tier: str = "device") -> None:
+        present = self._present.get(tier)
+        if not present:
+            return
+        tenant = present.pop(seq_hash, None)
+        if tenant is not None:
+            counts = self._counts[tier]
+            counts[tenant] = max(counts.get(tenant, 0) - 1, 0)
+
+    def tenant_of(self, seq_hash: int,
+                  tier: Optional[str] = None) -> Optional[str]:
+        if tier is not None:
+            got = self._present.get(tier, {}).get(seq_hash)
+        else:
+            got = next((self._present[t][seq_hash] for t in self.TIERS
+                        if seq_hash in self._present[t]), None)
+        return got if got is not None else self._known.get(seq_hash)
+
+    def blocks(self, tenant: str, tier: Optional[str] = None) -> int:
+        if tier is not None:
+            return self._counts.get(tier, {}).get(tenant, 0)
+        return sum(c.get(tenant, 0) for c in self._counts.values())
+
+    def is_over_quota(self, tenant: Optional[str],
+                      tier: str = "device") -> bool:
+        if tenant is None:
+            return False
+        quota = self.table.quota(tenant)
+        if quota <= 0:
+            return False
+        return self._counts.get(tier, {}).get(tenant, 0) > quota
+
+    def is_over_quota_hash(self, seq_hash: Optional[int],
+                           tier: str = "device") -> bool:
+        """Victim-preference predicate: True when the hash belongs to a
+        tenant currently over its quota in this tier."""
+        if seq_hash is None:
+            return False
+        return self.is_over_quota(
+            self._present.get(tier, {}).get(seq_hash), tier)
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        """tenant → {tier: blocks} for status surfaces."""
+        out: Dict[str, Dict[str, int]] = {}
+        for tier, counts in self._counts.items():
+            for tenant, n in counts.items():
+                if n:
+                    out.setdefault(tenant, {})[tier] = n
+        return out

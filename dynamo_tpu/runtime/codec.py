@@ -128,7 +128,13 @@ class RequestControlMessage:
     survives hops without clock synchronization. A worker whose budget
     runs out cancels the request engine-side (slot/hold release within
     one loop tick) even if the client vanished without a KILL frame.
-    Absent = no deadline; ignored by old receivers."""
+    Absent = no deadline; ignored by old receivers.
+
+    ``tenant`` / ``priority`` are the multi-tenant identity
+    (llm/tenancy.py): the serving side re-attaches them to its
+    EngineContext so fair-share admission and per-tenant KV quotas
+    price the request without re-parsing the payload. Absent = the
+    implicit single tenant; ignored by old receivers."""
 
     id: str
     request_type: str = "single_in"     # single_in | many_in
@@ -136,6 +142,8 @@ class RequestControlMessage:
     connection_info: Optional[ConnectionInfo] = None
     trace: Optional[dict] = None
     deadline_ms: Optional[float] = None
+    tenant: Optional[str] = None
+    priority: Optional[str] = None
 
     def to_json(self) -> bytes:
         d = {"id": self.id, "request_type": self.request_type,
@@ -146,6 +154,10 @@ class RequestControlMessage:
             d["trace"] = self.trace
         if self.deadline_ms is not None:
             d["deadline_ms"] = self.deadline_ms
+        if self.tenant is not None:
+            d["tenant"] = self.tenant
+        if self.priority is not None:
+            d["priority"] = self.priority
         return json.dumps(d).encode()
 
     @classmethod
@@ -157,7 +169,9 @@ class RequestControlMessage:
                    response_type=d.get("response_type", "many_out"),
                    connection_info=ConnectionInfo.from_dict(ci) if ci else None,
                    trace=d.get("trace"),
-                   deadline_ms=d.get("deadline_ms"))
+                   deadline_ms=d.get("deadline_ms"),
+                   tenant=d.get("tenant"),
+                   priority=d.get("priority"))
 
 
 # ----------------------------------------------------------------- framing

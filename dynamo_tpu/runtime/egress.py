@@ -220,7 +220,9 @@ class Client(AsyncEngine):
             # resurrect budget already burned waiting)
             ctrl = RequestControlMessage(id=ctx.id, connection_info=conn,
                                          trace=wire_trace,
-                                         deadline_ms=ctx.ctx.remaining_ms())
+                                         deadline_ms=ctx.ctx.remaining_ms(),
+                                         tenant=ctx.ctx.tenant,
+                                         priority=ctx.ctx.qos)
             payload = encode_two_part(ctrl, self.encode_req(ctx.data))
             deadline = loop.time() + self.DIAL_BACK_TIMEOUT
             delay = 0.05

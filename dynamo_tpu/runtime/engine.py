@@ -53,17 +53,27 @@ class EngineContext:
       polled by engines between steps exactly like cancellation — a
       request whose client stopped caring vacates its slot instead of
       burning capacity.
+    - ``tenant`` / ``qos`` — multi-tenant identity (llm/tenancy.py):
+      set at the frontend from ``nvext.tenant``/``nvext.priority`` and
+      propagated on the wire (codec.RequestControlMessage tenant /
+      priority) so routers and workers price per-tenant fair share and
+      KV quotas without re-parsing the payload.
     """
 
-    __slots__ = ("_id", "_stopped", "_killed", "_stop_event", "deadline_s")
+    __slots__ = ("_id", "_stopped", "_killed", "_stop_event", "deadline_s",
+                 "tenant", "qos")
 
     def __init__(self, request_id: Optional[str] = None,
-                 deadline_ms: Optional[float] = None):
+                 deadline_ms: Optional[float] = None,
+                 tenant: Optional[str] = None,
+                 qos: Optional[str] = None):
         self._id = request_id or uuid.uuid4().hex
         self._stopped = False
         self._killed = False
         self._stop_event: Optional[asyncio.Event] = None
         self.deadline_s: Optional[float] = None
+        self.tenant = tenant
+        self.qos = qos
         if deadline_ms is not None:
             self.set_deadline_ms(deadline_ms)
 

@@ -203,7 +203,8 @@ class EndpointServer:
         # binding it to this side's monotonic clock here means engines
         # poll one absolute deadline with no cross-host clock coupling
         ctx = Context(request, ctx=EngineContext(
-            ctrl.id, deadline_ms=ctrl.deadline_ms))
+            ctrl.id, deadline_ms=ctrl.deadline_ms,
+            tenant=ctrl.tenant, qos=ctrl.priority))
         # worker-side trace under the SAME request id the frontend logged
         # (ingress prologue → engine → first frame → stream end). When the
         # control message carries a propagated TraceContext this becomes a

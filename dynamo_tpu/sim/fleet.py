@@ -81,6 +81,11 @@ class FleetConfig:
     planner_cfg: Optional[PlannerConfig] = None
     new_worker_profile: str = "slow-start:20"
     initial_profiles: Tuple[str, ...] = ()   # cycled over initial workers
+    # multi-tenant serving plane (llm/tenancy.py): {tenant: {weight,
+    # kv_quota_blocks, qos}} policies. Non-None turns on fair-share
+    # waiting queues (WDRR + QoS) and per-worker quota-preferred
+    # eviction — the REAL policy classes under the determinism gate.
+    tenant_policies: Optional[Dict[str, dict]] = None
 
 
 class SimLatencyCollector:
@@ -196,6 +201,14 @@ class SimFleet:
         self.indexer = KvIndexer(cfg.block_size, prefer_native=False)
         self.scheduler = KvScheduler(cfg.block_size,
                                      rng=random.Random(seed ^ 0x5C3D))
+        # instance-local tenant table (NOT the process-global one: two
+        # fleets in one test must not share policy state)
+        self.tenant_table = None
+        if cfg.tenant_policies is not None:
+            from ..llm.tenancy import TenantPolicy, TenantTable
+            self.tenant_table = TenantTable(
+                {t: TenantPolicy(**p)
+                 for t, p in cfg.tenant_policies.items()})
         self.catalog = HashCatalog(seed, cfg.block_size,
                                    cfg.tenant_prefix_blocks)
         self.prefill_queue = SimPrefillQueue()
@@ -214,7 +227,7 @@ class SimFleet:
             "retried": 0, "no_capacity": 0, "remote_prefills": 0,
             "fabric_fetch_blocks": 0, "hit_blocks": 0, "isl_blocks": 0,
             "crashes": 0, "clean_exits": 0, "forced_exits": 0,
-            "spawned": 0, "shed_writes": 0,
+            "spawned": 0, "shed_writes": 0, "tenant_evictions": 0,
         }
         self.ttft_ms: List[float] = []
         self.itl_ms: List[float] = []
@@ -487,7 +500,8 @@ class SimFleet:
         hashes = self.catalog.chain(spec.tenant, spec.session, isl_blocks)
         overlap = self.indexer.find_matches(hashes)
         exclude = set(self.draining)
-        wid = self.scheduler.schedule(spec.isl, overlap, exclude=exclude)
+        wid = self.scheduler.schedule(spec.isl, overlap, exclude=exclude,
+                                      tenant=spec.tenant)
         if wid is not None and wid in self.workers \
                 and not self.workers[wid].dead:
             return wid, hashes, overlap
@@ -495,6 +509,22 @@ class SimFleet:
         # back to least-backlogged so pressure lands in worker queues —
         # the num_requests_waiting signal the planner scales on — and a
         # full fleet NEVER drops a request.
+        #
+        # With tenancy on, the fallback keeps CACHE AFFINITY instead:
+        # the per-tenant WDRR waiting queues guarantee a victim tenant's
+        # request is popped at its fair share no matter how deep the
+        # flooding tenant's backlog on that worker is — so routing into
+        # a backlogged affinity worker is safe, and a flood can no
+        # longer strip everyone else's hit rate by saturating the fleet
+        # (backlog-blind affinity is exactly what fair-share queues buy).
+        if self.tenant_table is not None:
+            best = [(-overlap.weighted.get(wid_, 0.0),
+                     len(w.waiting) + w.active_slots, wid_)
+                    for wid_, w in self.workers.items()
+                    if not w.dead and wid_ not in exclude]
+            if best:
+                best.sort()
+                return best[0][2], hashes, overlap
         live = [(len(w.waiting) + w.active_slots, wid_)
                 for wid_, w in self.workers.items()
                 if not w.dead and wid_ not in exclude]
@@ -554,8 +584,9 @@ class SimFleet:
                          fetch_s=fetch_s, fetched_blocks=fetched,
                          hit_blocks=hit, arrive_t=self.clock.now)
         req.retries = retries
-        self.log.log("route", rid=spec.rid, worker=wid, hit=hit,
-                     fetched=fetched, blocks=isl_blocks, remote=False)
+        self.log.log("route", rid=spec.rid, tenant=spec.tenant,
+                     worker=wid, hit=hit, fetched=fetched,
+                     blocks=isl_blocks, remote=False)
         w.submit(req)
 
     # ------------------------------------------------- disagg prefill leg
@@ -622,8 +653,8 @@ class SimFleet:
         if itl_ms is not None:
             self.itl_ms.append(itl_ms)
         self.collector.record(ttft_ms, itl_ms)
-        self.log.log("complete", rid=req.spec.rid, worker=w.worker_id,
-                     ttft_ms=round(ttft_ms, 3),
+        self.log.log("complete", rid=req.spec.rid, tenant=req.spec.tenant,
+                     worker=w.worker_id, ttft_ms=round(ttft_ms, 3),
                      itl_ms=round(itl_ms, 3) if itl_ms is not None else None)
 
     def on_requests_lost(self, reqs: List[SimRequest]) -> None:
@@ -704,6 +735,17 @@ class SimFleet:
             "events": len(self.log),
             "event_log_digest": self.log.digest(),
         }
+        if self.tenant_table is not None:
+            # per-tenant serving summary (noisy_neighbor's check input):
+            # routed decisions + residual residency per live worker
+            r["tenants"] = {
+                "admitted": self.scheduler.tenant_counters(),
+                "kv_blocks": {
+                    t: sum(sum(w.ledger.snapshot().get(t, {}).values())
+                           for w in self.workers.values()
+                           if not w.dead and w.ledger is not None)
+                    for t in sorted(self.tenant_table.policies)},
+            }
         if self.planner is not None:
             r["planner"] = {
                 "counters": dict(self.planner.counters),

@@ -575,6 +575,116 @@ def _check_disk_pressure(fleet: SimFleet, r: dict) -> List[str]:
     return v
 
 
+NOISY_FLOOD_AT = 300.0
+NOISY_FLOOD_LEN = 400.0
+
+
+def _noisy_neighbor(seed: int, replicas: int = 10,
+                    duration_s: float = 1200.0):
+    """Multi-tenant fair-share proving ground (ISSUE 14,
+    docs/multi_tenant.md): 8 Zipf-weighted tenants; at t=300 tenant t00
+    FLOODS ~10× its organic rate for 400s. The fleet is PINNED (no
+    scale-out escape hatch) so the only thing standing between the
+    flood and everyone else is the tenant machinery: fair-share WDRR
+    waiting queues throttle t00 to its weight share of service, and
+    per-tenant KV quotas land its eviction storm on its OWN blocks.
+    Victims must keep late-window SLO >= 0.9 and a flood-window prefix
+    hit rate within 10% of their quiet baseline, with zero drops."""
+    slo = ServiceLevelObjective(
+        ttft_p90_ms=5000.0, itl_p90_ms=600.0, max_queue_depth=30.0,
+        # pinned: fairness carries the storm, not the planner
+        min_decode_workers=replicas, max_decode_workers=replicas)
+    policies = {f"t{i:02d}": {"weight": 1.0, "kv_quota_blocks": 192}
+                for i in range(8)}
+    cfg = FleetConfig(
+        replicas=replicas, slots=4, kv_blocks=512, host_blocks=256,
+        perf=_perf_small(), slo=slo,
+        tenant_policies=policies,
+        planner_cfg=PlannerConfig(interval_s=5.0, cooldown_s=60.0,
+                                  status_interval_s=20.0),
+        stats_interval_s=2.0, scrape_interval_s=1.0, drainout_s=600.0)
+    # agentic mix builds every tenant's warm prefix state BEFORE the
+    # flood, so the quota story (the flood must not crater the victims'
+    # hit rate) has a real baseline to protect
+    wl = generate_workload(duration_s * 0.85, seed, base_rps=0.8,
+                           peak_rps=1.2, tenants=8, zipf_a=0.6,
+                           agentic_frac=0.6, long_tail_frac=0.0,
+                           osl_base=48, osl_spread=96,
+                           flood_tenant="t00", flood_at=NOISY_FLOOD_AT,
+                           flood_len_s=NOISY_FLOOD_LEN, flood_factor=10.0)
+    return cfg, wl, (), duration_s
+
+
+def _check_noisy_neighbor(fleet: SimFleet, r: dict) -> List[str]:
+    from ..llm.slo import percentile
+    v = []
+    f0, f1 = NOISY_FLOOD_AT, NOISY_FLOOD_AT + NOISY_FLOOD_LEN
+    arrivals = {}
+    for _t, f in fleet.log.of_kind("arrive"):
+        arrivals[f["tenant"]] = arrivals.get(f["tenant"], 0) + 1
+    flood_n = arrivals.get("t00", 0)
+    victim_n = sum(n for t, n in arrivals.items() if t != "t00")
+    if flood_n < 2 * victim_n:
+        v.append(f"flood never formed (t00 sent {flood_n} vs "
+                 f"{victim_n} victim arrivals)")
+    if r["requests"]["dropped"]:
+        v.append(f"dropped {r['requests']['dropped']} requests")
+    if r["requests"]["completed"] != r["requests"]["arrived"]:
+        v.append("not every request completed — something starved")
+    # victims' late-window SLO holds despite the flood
+    cut = fleet.clock.now * 0.75
+    late_victims = [f["ttft_ms"] for t, f in fleet.log.of_kind("complete")
+                    if t >= cut and f["tenant"] != "t00"]
+    slo = fleet.cfg.slo
+    if late_victims:
+        att = (sum(1 for x in late_victims if x <= slo.ttft_p90_ms)
+               / len(late_victims))
+        if att < 0.9:
+            v.append(f"victim late-window TTFT attainment {att:.3f} < 0.9")
+    else:
+        v.append("no victim traffic in the late window")
+    # the throttle: inside the flood window the flooder queues behind
+    # its own backlog — its TTFT p90 must sit well above the victims'
+    flood_ttft = percentile([f["ttft_ms"] for t, f in
+                             fleet.log.of_kind("complete")
+                             if f0 + 60 <= t < f1 and f["tenant"] == "t00"],
+                            90)
+    victim_ttft = percentile([f["ttft_ms"] for t, f in
+                              fleet.log.of_kind("complete")
+                              if f0 + 60 <= t < f1
+                              and f["tenant"] != "t00"], 90)
+    if flood_ttft is None or victim_ttft is None:
+        v.append("flood window saw no completions on one side")
+    elif flood_ttft < 1.5 * victim_ttft:
+        v.append(f"flooder was not throttled: its in-flood TTFT p90 "
+                 f"{flood_ttft:.0f}ms vs victims' {victim_ttft:.0f}ms")
+    # quota isolation: victims' prefix hit rate in the flood window
+    # stays within 10% of their pre-flood baseline
+    def victim_hit(lo, hi):
+        fr = [f["hit"] / max(f["blocks"], 1)
+              for t, f in fleet.log.of_kind("route")
+              if lo <= t < hi and f.get("tenant") not in (None, "t00")]
+        return sum(fr) / len(fr) if fr else None
+    pre = victim_hit(f0 - 200, f0)
+    mid = victim_hit(f0 + 60, f1)
+    if pre is None or mid is None:
+        v.append("no victim routing around the flood window")
+    else:
+        if pre < 0.15:
+            v.append(f"victims' prefix reuse never warmed up "
+                     f"(pre-flood hit {pre:.2f})")
+        if mid < 0.9 * pre:
+            v.append(f"flood cratered victims' hit rate: "
+                     f"{pre:.3f} → {mid:.3f} (>10% drop)")
+    # the quota machinery must actually engage: the flooder's over-
+    # quota blocks took preferred evictions
+    if r["requests"].get("tenant_evictions", 0) < 10:
+        v.append("tenant-quota eviction preference never engaged")
+    if victim_n == 0:
+        v.append("no victim arrivals at all (workload misconfigured)")
+    return v
+
+
 def _check_disagg_retune(fleet: SimFleet, r: dict) -> List[str]:
     v = []
     if r["requests"]["remote_prefills"] < 10:
@@ -634,6 +744,12 @@ SCENARIOS: Dict[str, Scenario] = {
         "fleet-wide ENOSPC mid-spill; write-behind sheds (counted), "
         "serving continues, SLO holds",
         _disk_pressure, _check_disk_pressure),
+    "noisy_neighbor": Scenario(
+        "noisy_neighbor",
+        "one tenant floods 10x against a pinned fleet; fair-share WDRR "
+        "throttles it to its share and KV quotas keep victims' hit "
+        "rate intact (llm/tenancy.py; docs/multi_tenant.md)",
+        _noisy_neighbor, _check_noisy_neighbor),
 }
 
 

@@ -103,7 +103,20 @@ class SimWorker:
         self.block_size = block_size
         self.prefill_only = prefill_only
 
-        self.waiting: Deque[SimRequest] = deque()
+        # multi-tenant serving (llm/tenancy.py — the REAL policy
+        # machinery, not a sim reimplementation): with fleet tenancy on,
+        # the waiting queue drains in weighted-deficit-round-robin order
+        # with QoS classes (a flooding tenant's backlog sits in ITS
+        # queue) and a per-worker TenantBlockLedger quota-prefers the
+        # over-quota tenant's blocks at eviction time.
+        self.tenant_table = getattr(fleet, "tenant_table", None)
+        self.ledger = None
+        if self.tenant_table is not None:
+            from ..llm.tenancy import FairShareQueue, TenantBlockLedger
+            self.waiting = FairShareQueue(self.tenant_table)
+            self.ledger = TenantBlockLedger(self.tenant_table)
+        else:
+            self.waiting: Deque[SimRequest] = deque()
         self.prefill: Optional[_Prefill] = None
         self.decoding: List[_Decode] = []
         # device-tier LRU of resident block seq-hashes; evictions demote
@@ -187,7 +200,15 @@ class SimWorker:
             self.fleet.on_requests_lost([req])
             return
         req.worker_id = self.worker_id
-        self.waiting.append(req)
+        if self.tenant_table is not None:
+            # fair-share order (WDRR + QoS): cost = the request's new
+            # prefill blocks, so a flooding tenant's LONG prompts spend
+            # its deficit faster, exactly like its flood rate does
+            self.waiting.push(
+                req, tenant=req.spec.tenant,
+                cost=max(req.new_tokens / self.block_size, 1.0))
+        else:
+            self.waiting.append(req)
         self._fire()
 
     def _speed(self, now: float) -> float:
@@ -257,7 +278,7 @@ class SimWorker:
             # shaped) samples are excluded/decayed exactly as on a
             # young live engine
             self.estimator.observe(req.new_tokens, wall)
-        self._register_blocks(req.hashes)
+        self._register_blocks(req.hashes, tenant=req.spec.tenant)
         if req.kind == "prefill":
             self.fleet.on_prefill_handoff(req, self)
             return
@@ -299,11 +320,15 @@ class SimWorker:
                 now + dt, self._fire)
 
     # ----------------------------------------------------------- KV model
-    def _register_blocks(self, hashes: List[int]) -> None:
+    def _register_blocks(self, hashes: List[int],
+                         tenant: Optional[str] = None) -> None:
         """Device-tier residency with chained stored-announces: the
         longest already-resident prefix is touched (LRU), the suffix is
         announced tier=device off its parent — feeding the REAL radix
-        indexer the router queries."""
+        indexer the router queries. With tenancy on, new blocks are
+        noted in the worker's ledger and eviction victims come from an
+        OVER-QUOTA tenant first (bounded LRU-front scan — the device
+        pool's quota preference, llm/tenancy.py)."""
         resident = self.resident
         i = 0
         for h in hashes:
@@ -318,13 +343,30 @@ class SimWorker:
             for h in new:
                 resident[h] = None
                 self.host_resident.pop(h, None)
+                if self.ledger is not None:
+                    self.ledger.forget(h, "host")
+                    self.ledger.note(h, tenant, "device")
             self.fleet.apply_kv_event(RouterEvent(
                 worker_id=self.worker_id,
                 stored=KvStoredEvent(parent_hash=parent, block_hashes=new)))
         evicted = []
         while len(resident) > self.kv_blocks:
-            h, _ = resident.popitem(last=False)
-            evicted.append(h)
+            victim = None
+            if self.ledger is not None:
+                for j, h in enumerate(resident):
+                    if j >= 64:
+                        break
+                    if self.ledger.is_over_quota_hash(h, "device"):
+                        victim = h
+                        break
+            if victim is None:
+                victim, _ = resident.popitem(last=False)
+            else:
+                resident.pop(victim)
+                self.fleet.counters["tenant_evictions"] += 1
+            if self.ledger is not None:
+                self.ledger.forget(victim, "device")
+            evicted.append(victim)
         if evicted:
             self._demote(evicted)
 
@@ -376,14 +418,30 @@ class SimWorker:
         host = self.host_resident
         for h in hashes:
             host[h] = None
+            if self.ledger is not None:
+                self.ledger.note(h, None, "host")   # owner from ledger memory
         self.fleet.apply_kv_event(RouterEvent(
             worker_id=self.worker_id,
             stored=KvStoredEvent(parent_hash=None, block_hashes=hashes,
                                  tier="host")))
         removed = []
         while len(host) > self.host_blocks:
-            h, _ = host.popitem(last=False)
-            removed.append(h)
+            victim = None
+            if self.ledger is not None:
+                for j, h in enumerate(host):
+                    if j >= 64:
+                        break
+                    if self.ledger.is_over_quota_hash(h, "host"):
+                        victim = h
+                        break
+            if victim is None:
+                victim, _ = host.popitem(last=False)
+            else:
+                host.pop(victim)
+                self.fleet.counters["tenant_evictions"] += 1
+            if self.ledger is not None:
+                self.ledger.forget(victim, "host")
+            removed.append(victim)
         if removed:
             self.fleet.apply_kv_event(RouterEvent(
                 worker_id=self.worker_id,
@@ -394,6 +452,11 @@ class SimWorker:
         block and announce the removals (an eviction storm for the
         router index)."""
         hashes = list(self.resident) + list(self.host_resident)
+        if self.ledger is not None:
+            for h in self.resident:
+                self.ledger.forget(h, "device")
+            for h in self.host_resident:
+                self.ledger.forget(h, "host")
         self.resident.clear()
         self.host_resident.clear()
         if hashes:
@@ -425,6 +488,13 @@ class SimWorker:
         m.kv_block_size = self.block_size
         m.prefill_tok_per_s = self.estimator.rate()
         m.remote_admission_rejects_total = self.gate.rejects_total
+        if self.ledger is not None:
+            # per-tenant residency (the nv_llm_tenant_kv_blocks shape);
+            # admission/throttle counters live fleet-side in the sim
+            m.tenant_stats = {
+                t: {"admitted": 0, "throttled": 0,
+                    "kv_blocks": sum(tiers.values()), "hit_rate": 0.0}
+                for t, tiers in sorted(self.ledger.snapshot().items())}
         return m
 
     def stats_json(self) -> bytes:

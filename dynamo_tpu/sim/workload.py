@@ -113,11 +113,19 @@ def generate_workload(duration_s: float, seed: int = 0, *,
                       osl_base: int = 24, osl_spread: int = 104,
                       burst_at: Optional[float] = None,
                       burst_len_s: float = 0.0,
-                      burst_factor: float = 1.0) -> Workload:
+                      burst_factor: float = 1.0,
+                      flood_tenant: Optional[str] = None,
+                      flood_at: float = 0.0,
+                      flood_len_s: float = 0.0,
+                      flood_factor: float = 1.0) -> Workload:
     """The mixed default trace: diurnal burst x multi-tenant skew x
     agentic multi-turn x long-context tails. ``burst_*`` overlays a
     square-wave surge (the scale-storm ingredient) on the diurnal base.
-    """
+    ``flood_*`` overlays a NOISY-NEIGHBOR surge: during the flood
+    window, ``flood_tenant``'s arrival rate multiplies ``flood_factor``×
+    while everyone else's traffic is untouched — the adversary the
+    tenant fair-share scheduler and KV quotas must absorb
+    (docs/multi_tenant.md; scenario ``noisy_neighbor``)."""
     rng = random.Random(seed)
     period = period_s or duration_s
     # Zipf-like tenant weights
@@ -168,4 +176,26 @@ def generate_workload(duration_s: float, seed: int = 0, *,
             at=round(t, 6), rid=f"r{n:06d}", tenant=tenant, session=s.sid,
             turn=s.turn, isl=isl, osl=osl))
         n += 1
+    if flood_tenant is not None and flood_len_s > 0 and flood_factor > 1:
+        # noisy-neighbor overlay: an INDEPENDENT seeded Poisson stream
+        # of fresh-session arrivals for the flooding tenant during the
+        # window, on top of its organic share — (factor-1)× the mean
+        # base rate, so factor≈ the tenant's total amplification
+        frng = random.Random(seed ^ 0xF100D)
+        mean_rps = (base_rps + peak_rps) / 2.0
+        flood_rps = (flood_factor - 1.0) * mean_rps
+        t = flood_at
+        fn = 0
+        while True:
+            t += frng.expovariate(flood_rps)
+            if t >= min(flood_at + flood_len_s, duration_s):
+                break
+            session_count += 1
+            isl = isl_base + int(frng.random() * isl_spread)
+            osl = osl_base + int(frng.random() * osl_spread)
+            specs.append(RequestSpec(
+                at=round(t, 6), rid=f"f{fn:06d}", tenant=flood_tenant,
+                session=f"{flood_tenant}-f{session_count:05d}", turn=0,
+                isl=isl, osl=osl))
+            fn += 1
     return Workload(specs)

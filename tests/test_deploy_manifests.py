@@ -225,7 +225,9 @@ def test_grafana_dashboard_queries_real_metrics():
                                                _LAYOUT_GAUGES, _PP_GAUGES,
                                                _RAGGED_GAUGES,
                                                _REMOTE_GAUGES,
-                                               _SPEC_GAUGES, _TIER_GAUGES,
+                                               _SPEC_GAUGES,
+                                               _TENANT_GAUGES,
+                                               _TIER_GAUGES,
                                                _TRACE_GAUGES, PREFIX)
     from dynamo_tpu.llm.http.metrics import PREFIX as HTTP_PREFIX
     exported = {f"{PREFIX}_{f}" for f in _GAUGE_FIELDS}
@@ -237,6 +239,7 @@ def test_grafana_dashboard_queries_real_metrics():
     exported |= set(_RAGGED_GAUGES.values())
     exported |= set(_TRACE_GAUGES.values())
     exported |= set(_DEGRADE_GAUGES.values())
+    exported |= set(_TENANT_GAUGES.values())
     # trace-collector latency histograms (components/trace_collector.py
     # — exemplar-carrying; the Grafana "Tracing" row queries them)
     exported |= {"nv_llm_trace_ttft_seconds_bucket",

@@ -877,3 +877,41 @@ def test_remote_metrics_exported_as_gauges():
     assert 'nv_llm_kv_remote_fetch_failures_total{component="worker"' \
         in text
     assert "nv_llm_netstore_retries_total" in text
+
+@pytest.mark.asyncio
+async def test_probe_rides_native_dataplane_with_fallback(daemon,
+                                                          monkeypatch):
+    """ISSUE 14 satellite (ROADMAP PaaS extension): the bandwidth probe
+    rides the native data plane — the SAME path fetches ride — so
+    PeerLinkTable gbps prices the real transfer path; a peer that
+    declines (lib absent / env off) falls back to the request-plane
+    echo, counted in probe_fallbacks_total."""
+    from dynamo_tpu.llm.kv.fabric import (KvFabricServer,
+                                          dataplane_serving_available)
+    from dynamo_tpu.runtime.distributed import DistributedRuntime, Endpoint
+
+    rt_s = await DistributedRuntime.connect(daemon.address)
+    # probe ops never touch the engine — a core-less server suffices
+    await Endpoint.parse_path(rt_s, "dyn://ns/worker/kv_fabric").serve(
+        KvFabricServer(None), decode_req=json.loads)
+    rt_c = fab = None
+    try:
+        rt_c, fab = await _client_fabric(daemon)
+        await fab.client.wait_for_instances()
+        link = await fab.probe(rt_s.worker_id, nbytes=1 << 16)
+        assert link.samples >= 2 and link.gbps > 0
+        if dataplane_serving_available():
+            # the native path served it: no fallback burned
+            assert fab.probe_fallbacks_total == 0
+        # peer declines (env-gated): the probe still measures, via echo
+        monkeypatch.setenv("DYN_KV_FABRIC_DATAPLANE", "0")
+        before = fab.links.get(rt_s.worker_id).samples
+        link2 = await fab.probe(rt_s.worker_id, nbytes=1 << 14)
+        assert fab.probe_fallbacks_total == 1
+        assert link2.samples > before and link2.gbps > 0
+    finally:
+        if fab is not None:
+            await fab.close()
+        for rt in (rt_c, rt_s):
+            if rt is not None:
+                await rt.shutdown()

@@ -190,3 +190,47 @@ async def test_push_mode_to_fake_gateway(daemon):
         for rt in (rt_w, rt_metrics):
             await rt.shutdown()
         await runner.cleanup()
+
+async def test_tenant_labeled_gauges_from_mock_worker(daemon):
+    """ISSUE 14 satellite: mock_worker --tenants publishes synthetic
+    per-tenant stats; the aggregator exports one nv_llm_tenant_* series
+    per (worker, tenant) and prunes them with the worker."""
+    addr = daemon.address
+    rt_w = await DistributedRuntime.connect(addr)
+    rt_metrics = await DistributedRuntime.connect(addr)
+    worker = await MockTokenWorker(rt_w, PATH, block_size=4,
+                                   tenants=3).start()
+    svc = None
+    try:
+        svc = await MetricsAggregatorService(
+            Endpoint.parse_path(rt_metrics, PATH),
+            scrape_interval=0.1).start()
+        for _ in range(100):
+            if worker.worker_id in svc.latest:
+                break
+            await asyncio.sleep(0.05)
+        m = svc.latest[worker.worker_id]
+        assert set(m.tenant_stats) == {"t00", "t01", "t02"}
+        # the synthetic story: t00 floods (throttled), others hold
+        assert m.tenant_stats["t00"]["throttled"] >= 0
+        assert m.tenant_stats["t01"]["hit_rate"] == 0.6
+        text = svc.render().decode()
+        wid_hex = f"{worker.worker_id:x}"
+        assert (f'nv_llm_tenant_hit_rate{{component="worker",'
+                f'endpoint="generate",tenant="t01",'
+                f'worker_id="{wid_hex}"}} 0.6') in text
+        assert 'nv_llm_tenant_admitted_total' in text
+        assert 'nv_llm_tenant_kv_blocks' in text
+        # worker death prunes every tenant series
+        await worker.stop()
+        for _ in range(100):
+            if worker.worker_id not in svc.latest:
+                break
+            await asyncio.sleep(0.05)
+        text = svc.render().decode()
+        assert f'tenant="t01",worker_id="{wid_hex}"' not in text
+    finally:
+        if svc is not None:
+            await svc.close()
+        for rt in (rt_w, rt_metrics):
+            await rt.shutdown()

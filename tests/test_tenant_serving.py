@@ -1,0 +1,591 @@
+"""Multi-model, multi-tenant serving plane (ISSUE 14; docs/multi_tenant.md).
+
+Acceptance surface:
+
+- model registry cards on the kvstore (llm/registry.py): add/rm over a
+  REAL daemon, watched live; ``llmctl model {add,list,rm}``;
+- frontend multiplexing: TWO models served concurrently behind one
+  frontend, registry-routed streams BIT-EXACT vs each model served
+  alone, unknown-model 404;
+- tenant fair-share (llm/tenancy.py): WDRR + QoS queue semantics,
+  admission-gate throttling, scheduler per-tenant accounting;
+- tenant identity on the wire: nvext → PreprocessedRequest →
+  RequestControlMessage → the serving EngineContext;
+- per-tenant KV quotas: device-pool + host-pool quota-preferred
+  eviction (the noisy_neighbor sim scenario proves the fleet-scale
+  story; tests here prove the per-tier mechanics);
+- ``llmctl tenant {status,set-weight,set-quota}`` applied live by the
+  tenant/control watch.
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.launch.llmctl import amain as llmctl_amain
+from dynamo_tpu.launch.run import amain as run_amain
+from dynamo_tpu.llm.tenancy import (FairShareAdmission, FairShareQueue,
+                                    TenantBlockLedger, TenantPolicy,
+                                    TenantTable)
+from dynamo_tpu.runtime.server import DiscoveryServer
+
+pytestmark = [pytest.mark.asyncio, pytest.mark.tenant]
+
+
+@pytest.fixture
+async def daemon():
+    srv = DiscoveryServer(host="127.0.0.1")
+    await srv.start()
+    yield srv
+    await srv.close()
+
+
+# ------------------------------------------------------------ fair share
+
+
+def test_fair_share_queue_wdrr_shares():
+    """A 10x flooding tenant drains at ~its weight share: with equal
+    weights and both backlogged, pops alternate instead of serving the
+    flood's FIFO burst first."""
+    tb = TenantTable({"flood": TenantPolicy(weight=1.0),
+                      "quiet": TenantPolicy(weight=1.0)})
+    q = FairShareQueue(tb)
+    for i in range(50):
+        q.push(f"f{i}", "flood")
+    for i in range(5):
+        q.push(f"q{i}", "quiet")
+    first_ten = [q.pop()[1] for _ in range(10)]
+    # the quiet tenant is interleaved from the start, not starved
+    assert "quiet" in first_ten[:2]
+    assert first_ten.count("quiet") >= 4
+    # everything eventually drains
+    drained = len(first_ten)
+    while q.pop() is not None:
+        drained += 1
+    assert drained == 55 and len(q) == 0
+
+
+def test_fair_share_queue_weights_bias_service():
+    """weight 3 vs 1 → ~3x the pops while both stay backlogged."""
+    tb = TenantTable({"big": TenantPolicy(weight=3.0),
+                      "small": TenantPolicy(weight=1.0)})
+    q = FairShareQueue(tb)
+    for i in range(40):
+        q.push(i, "big")
+        q.push(i, "small")
+    first = [q.pop()[1] for _ in range(24)]
+    big = first.count("big")
+    assert 14 <= big <= 20, first   # ~3:1, not FIFO and not 1:1
+
+
+def test_fair_share_queue_qos_classes_preempt():
+    """interactive > standard > batch: a batch flood never delays an
+    interactive request; unknown classes coerce to standard."""
+    q = FairShareQueue(TenantTable())
+    for i in range(20):
+        q.push(f"b{i}", "flood", qos="batch")
+    q.push("x", "user", qos="interactive")
+    q.push("y", "user", qos="bogus-class")      # → standard
+    assert q.pop() == ("x", "user")             # interactive first
+    assert q.pop() == ("y", "user")             # then standard
+    assert q.pop()[1] == "flood"                # batch last
+
+
+def test_fair_share_queue_deterministic():
+    def run():
+        tb = TenantTable({f"t{i}": TenantPolicy(weight=1.0 + i)
+                          for i in range(4)})
+        q = FairShareQueue(tb)
+        for i in range(60):
+            q.push(i, f"t{i % 4}", cost=1.0 + (i % 3))
+        out = []
+        while True:
+            got = q.pop()
+            if got is None:
+                return out
+            out.append(got)
+    assert run() == run()
+
+
+async def test_fair_share_admission_throttles_over_share_tenant():
+    """Under contention, the over-share tenant WAITS; a release wakes
+    it. Under headroom, nobody queues."""
+    cap = 4
+    adm = FairShareAdmission(lambda: cap,
+                             TenantTable({"a": TenantPolicy(),
+                                          "b": TenantPolicy()}))
+    # headroom (total < 0.85*cap = 3.4): the first 3 admit instantly
+    for _ in range(3):
+        await adm.acquire("a")
+    await adm.acquire("b")
+    assert adm.throttled_total.get("a", 0) == 0
+    # contention (4 in flight): "a" holds 3 — over its 1/2-share bound
+    # of 2 — so the next "a" queues
+    waiter = asyncio.get_running_loop().create_task(adm.acquire("a"))
+    await asyncio.sleep(0.01)
+    assert not waiter.done()
+    assert adm.throttled_total["a"] == 1
+    # "b" is under its share → admits immediately even at contention
+    await asyncio.wait_for(adm.acquire("b"), 1.0)
+    # releasing two of "a"'s slots brings it under the bound → wakes
+    adm.release("a")
+    adm.release("a")
+    await asyncio.wait_for(waiter, 1.0)
+    counters = adm.counters()
+    assert counters["a"]["admitted"] == 4
+    assert counters["a"]["throttled"] == 1
+    assert counters["b"]["admitted"] == 2
+    assert counters["b"]["throttled"] == 0
+
+
+def test_scheduler_tenant_accounting():
+    from dynamo_tpu.llm.kv_router.protocols import ForwardPassMetrics
+    from dynamo_tpu.llm.kv_router.scheduler import KvScheduler
+    from dynamo_tpu.llm.kv_router.scoring import (Endpoint,
+                                                  ProcessedEndpoints)
+    s = KvScheduler(16)
+    s.update_endpoints(ProcessedEndpoints([
+        Endpoint(1, ForwardPassMetrics(request_total_slots=8,
+                                       kv_total_blocks=128))]))
+    assert s.fleet_total_slots() == 8
+    assert s.schedule(64, {1: 0}, tenant="acme") == 1
+    assert s.schedule(64, {1: 0}, tenant="acme") == 1
+    assert s.schedule(64, {1: 0}) == 1          # untenanted: not counted
+    assert s.tenant_counters() == {"acme": 2}
+
+
+# ------------------------------------------------------------- KV quotas
+
+
+def test_device_pool_quota_preferred_eviction():
+    """Python device pool: with a ledger attached, eviction victims
+    come from the OVER-QUOTA tenant first even when the other tenant's
+    blocks are older (plain LRU would take the victim's)."""
+    from dynamo_tpu.llm.kv.pool import KvBlockPool
+    table = TenantTable({"flood": TenantPolicy(kv_quota_blocks=2),
+                         "quiet": TenantPolicy(kv_quota_blocks=64)})
+    ledger = TenantBlockLedger(table)
+    pool = KvBlockPool(10)                      # 9 usable blocks
+    pool.tenancy = ledger
+    removed = []
+    pool.on_removed = removed.extend
+    # quiet registers FIRST (oldest in LRU), flood after — and over quota
+    blocks = pool.alloc_uninit(8)
+    for i, bid in enumerate(blocks[:3]):
+        pool.register(bid, 100 + i, 200 + i, None, tenant="quiet")
+    for i, bid in enumerate(blocks[3:]):
+        pool.register(bid, 300 + i, 400 + i, None, tenant="flood")
+    pool.release(blocks)                        # all evictable now
+    assert ledger.blocks("flood", "device") == 5
+    assert ledger.is_over_quota("flood", "device")
+    # one uninit block is still free, so this forces 3 evictions
+    got = pool.alloc_uninit(4)
+    assert got is not None
+    # every eviction hit the over-quota flood tenant, not quiet's LRU
+    # (plain LRU would have taken quiet's 100-102 first)
+    assert removed and all(300 <= h < 400 for h in removed), removed
+    assert pool.tenant_evictions == 3
+    assert ledger.blocks("quiet", "device") == 3
+    assert ledger.blocks("flood", "device") == 2
+
+
+def test_device_pool_untenanted_behavior_unchanged():
+    """No ledger → eviction order is byte-identical to the historical
+    priority/LRU pop (the C++ mirror's differential-fuzz contract)."""
+    from dynamo_tpu.llm.kv.pool import KvBlockPool
+    pool = KvBlockPool(8)
+    removed = []
+    pool.on_removed = removed.extend
+    blocks = pool.alloc_uninit(7)
+    for i, bid in enumerate(blocks):
+        pool.register(bid, 50 + i, 60 + i, None)
+    pool.release(blocks)
+    pool.alloc_uninit(2)
+    assert removed == [50, 51]                  # strict LRU order
+    assert pool.tenant_evictions == 0
+
+
+def test_host_pool_quota_preferred_eviction():
+    import numpy as np
+
+    from dynamo_tpu.llm.kv.offload import HostKvPool
+    table = TenantTable({"flood": TenantPolicy(kv_quota_blocks=2)})
+    ledger = TenantBlockLedger(table)
+    pool = HostKvPool(capacity_blocks=6, num_layers=1, num_kv_heads=1,
+                      block_size=4, head_dim=2)
+    pool.tenancy = ledger
+    values = {"k": np.zeros((1, 1, 1, 4, 2), dtype=np.float32),
+              "v": np.zeros((1, 1, 1, 4, 2), dtype=np.float32)}
+    # the ledger remembers owners from the device tier (the demote path)
+    for h in (1, 2):
+        ledger.note(h, "quiet", "device")
+        ledger.forget(h, "device")
+    for h in (3, 4, 5, 6):
+        ledger.note(h, "flood", "device")
+        ledger.forget(h, "device")
+    for h in (1, 2, 3, 4, 5, 6):
+        pool.store([h], values)
+    assert ledger.blocks("flood", "host") == 4
+    # capacity full; the next store must evict — flood is over quota, so
+    # its OLDEST block (3) goes, not the LRU front (quiet's 1)
+    ledger.note(7, "quiet", "device")
+    pool.store([7], values)
+    assert pool.contains(1) and pool.contains(2)
+    assert not pool.contains(3)
+    assert pool.tenant_evictions == 1
+
+
+def test_ledger_tracks_tiers_and_owner_memory():
+    table = TenantTable({"a": TenantPolicy(kv_quota_blocks=1)})
+    led = TenantBlockLedger(table)
+    led.note(11, "a", "device")
+    led.note(12, "a", "device")
+    assert led.blocks("a") == 2
+    assert led.is_over_quota("a", "device")
+    # demote: device forgets, colder tier notes WITHOUT knowing the
+    # owner — the ledger's hash→tenant memory carries it
+    led.forget(11, "device")
+    led.note(11, None, "disk")
+    assert led.tenant_of(11, "disk") == "a"
+    assert led.blocks("a", "disk") == 1
+    assert not led.is_over_quota("a", "device")
+    assert led.snapshot() == {"a": {"device": 1, "disk": 1}}
+
+
+# --------------------------------------------------- wire / nvext plumbing
+
+
+def test_nvext_tenant_rides_preprocessed_request(tiny_model_dir):
+    from dynamo_tpu.llm.model_card import ModelDeploymentCard
+    from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+    from dynamo_tpu.llm.protocols.openai import ChatCompletionRequest
+    mdc = ModelDeploymentCard.from_local_path(tiny_model_dir)
+    pre = OpenAIPreprocessor(mdc).preprocess_chat(
+        ChatCompletionRequest.model_validate({
+            "model": "m", "messages": [{"role": "user", "content": "hi"}],
+            "nvext": {"tenant": "acme", "priority": "interactive",
+                      "session_id": "acme-s1"}}))
+    assert pre.tenant_id == "acme"
+    assert pre.qos == "interactive"
+    assert pre.session_id == "acme-s1"
+    # wire decode round-trips the new fields (old payloads: defaults)
+    import dataclasses
+
+    from dynamo_tpu.llm.protocols.common import PreprocessedRequest
+    back = PreprocessedRequest.from_dict(
+        json.loads(json.dumps(dataclasses.asdict(pre))))
+    assert back.tenant_id == "acme" and back.qos == "interactive"
+    legacy = dataclasses.asdict(pre)
+    for k in ("tenant_id", "qos", "session_id"):
+        legacy.pop(k)
+    assert PreprocessedRequest.from_dict(legacy).tenant_id is None
+
+
+def test_request_control_message_carries_tenant():
+    from dynamo_tpu.runtime.codec import RequestControlMessage
+    m = RequestControlMessage(id="r1", tenant="acme",
+                              priority="interactive")
+    back = RequestControlMessage.from_json(m.to_json())
+    assert back.tenant == "acme" and back.priority == "interactive"
+    # absent on old senders
+    old = RequestControlMessage.from_json(
+        RequestControlMessage(id="r2").to_json())
+    assert old.tenant is None and old.priority is None
+
+
+# ------------------------------------------------------ registry + llmctl
+
+
+async def test_registry_card_add_watch_remove(daemon):
+    from dynamo_tpu.llm.registry import (RegistryCard, RegistryWatcher,
+                                         get_card, list_cards,
+                                         register_card, remove_card)
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    rt = await DistributedRuntime.connect(daemon.address)
+    try:
+        added, removed = [], []
+
+        async def on_card(card):
+            added.append(card)
+
+        async def on_removed(name):
+            removed.append(name)
+
+        await register_card(rt, RegistryCard(
+            name="m1", endpoint="dyn://ns/w1/gen",
+            geometry={"tp": 8, "quantization": "int8"}))
+        watcher = await RegistryWatcher(rt, on_card, on_removed).start()
+        assert [c.name for c in added] == ["m1"]        # startup replay
+        prog1 = added[0].program_set
+        assert prog1                                     # derived key
+        await register_card(rt, RegistryCard(
+            name="m2", endpoint="dyn://ns/w2/gen",
+            geometry={"tp": 8}))
+        for _ in range(100):
+            if len(added) == 2:
+                break
+            await asyncio.sleep(0.05)
+        assert {c.name for c in added} == {"m1", "m2"}
+        # same-geometry models share a program-set key; int8 differs
+        assert added[1].program_set != prog1
+        # revision bump on re-add
+        await register_card(rt, RegistryCard(
+            name="m1", endpoint="dyn://ns/w1b/gen"))
+        for _ in range(100):
+            if len(added) == 3:
+                break
+            await asyncio.sleep(0.05)
+        assert (await get_card(rt, "m1")).revision == 1
+        await remove_card(rt, "m1")
+        for _ in range(100):
+            if removed:
+                break
+            await asyncio.sleep(0.05)
+        assert removed == ["m1"]
+        assert set(await list_cards(rt)) == {"m2"}
+        await watcher.stop()
+    finally:
+        await rt.shutdown()
+
+
+async def test_llmctl_model_and_tenant_admin(daemon, capsys):
+    addr = daemon.address
+    assert await llmctl_amain([
+        "--runtime-server", addr, "model", "add", "chat-a",
+        "dyn://ns/a/gen", "--geometry", '{"tp": 4}']) == 0
+    assert await llmctl_amain([
+        "--runtime-server", addr, "model", "list"]) == 0
+    out = capsys.readouterr().out
+    assert "chat-a" in out and "dyn://ns/a/gen" in out
+    assert await llmctl_amain([
+        "--runtime-server", addr, "model", "rm", "chat-a"]) == 0
+    assert await llmctl_amain([
+        "--runtime-server", addr, "model", "rm", "chat-a"]) == 1
+    # tenant policy: set-weight/set-quota merge into the stored table
+    assert await llmctl_amain([
+        "--runtime-server", addr, "tenant", "set-weight", "ns",
+        "acme", "3.0"]) == 0
+    assert await llmctl_amain([
+        "--runtime-server", addr, "tenant", "set-quota", "ns",
+        "acme", "128"]) == 0
+    assert await llmctl_amain([
+        "--runtime-server", addr, "tenant", "status", "ns"]) == 0
+    out = capsys.readouterr().out
+    assert "acme" in out and "weight=3" in out and "128" in out
+
+
+async def test_tenant_watch_applies_policies_live(daemon):
+    """The tenant/control/{ns} watch (run.py _wire_tenants analog):
+    llmctl writes land in a LIVE TenantTable without restart — the
+    TIER_WEIGHTS retune pattern."""
+    from dynamo_tpu.llm.tenancy import watch_tenants_loop
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    rt = await DistributedRuntime.connect(daemon.address)
+    table = TenantTable()
+    task = asyncio.get_running_loop().create_task(
+        watch_tenants_loop(rt, "tns", table))
+    try:
+        assert await llmctl_amain([
+            "--runtime-server", daemon.address, "tenant", "set-weight",
+            "tns", "acme", "2.5"]) == 0
+        for _ in range(100):
+            if table.weight("acme") == 2.5:
+                break
+            await asyncio.sleep(0.05)
+        assert table.weight("acme") == 2.5
+        assert await llmctl_amain([
+            "--runtime-server", daemon.address, "tenant", "set-quota",
+            "tns", "acme", "64"]) == 0
+        for _ in range(100):
+            if table.quota("acme") == 64:
+                break
+            await asyncio.sleep(0.05)
+        assert table.quota("acme") == 64
+        assert table.weight("acme") == 2.5      # merged, not replaced
+    finally:
+        task.cancel()
+        await rt.shutdown()
+
+
+# ------------------------------------- two models behind one frontend
+
+
+async def _serve_worker(endpoint, model_dir, name, addr):
+    return asyncio.ensure_future(run_amain(
+        [f"in={endpoint}", "out=echo_core", "--protocol", "tokens",
+         "--model-path", model_dir, "--model-name", name,
+         "--runtime-server", addr]))
+
+
+async def _collect_text(engine, req) -> str:
+    from dynamo_tpu.runtime import Context
+    stream = await engine.generate(Context(req))
+    text = ""
+    async for ann in stream:
+        d = ann.data
+        if d and d.get("choices"):
+            text += d["choices"][0]["delta"].get("content") or ""
+    return text
+
+
+@pytest.mark.distributed
+async def test_two_models_one_frontend_bit_exact(tiny_model_dir, daemon):
+    """The multiplexing contract: two registry cards → one HttpService
+    serving both names through per-model pipelines/routing planes;
+    streams are BIT-EXACT vs each model served alone; an unknown model
+    404s; removing a card drops the model live."""
+    from dynamo_tpu.components.processor import ModelMux
+    from dynamo_tpu.llm.http.service import HttpService
+    from dynamo_tpu.llm.registry import (RegistryCard, register_card,
+                                         remove_card)
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    addr = daemon.address
+    w1 = await _serve_worker("dyn://tns/w1/gen", tiny_model_dir, "m1",
+                             addr)
+    w2 = await _serve_worker("dyn://tns/w2/gen", tiny_model_dir, "m2",
+                             addr)
+    rt = await DistributedRuntime.connect(addr)
+    svc = HttpService(port=0, host="127.0.0.1")
+    mux = None
+    try:
+        await register_card(rt, RegistryCard(
+            name="m1", endpoint="dyn://tns/w1/gen",
+            model_path=tiny_model_dir, kv_block_size=16))
+        await register_card(rt, RegistryCard(
+            name="m2", endpoint="dyn://tns/w2/gen",
+            model_path=tiny_model_dir, kv_block_size=16))
+        mux = await ModelMux(rt, svc.manager).start()
+        for _ in range(200):
+            if (svc.manager.chat_engine("m1") is not None
+                    and svc.manager.chat_engine("m2") is not None):
+                break
+            await asyncio.sleep(0.05)
+        e1 = svc.manager.chat_engine("m1")
+        e2 = svc.manager.chat_engine("m2")
+        assert e1 is not None and e2 is not None and e1 is not e2
+
+        def req_for(model, text):
+            return {"model": model, "max_tokens": 12, "stream": True,
+                    "messages": [{"role": "user", "content": text}],
+                    "nvext": {"tenant": "acme"}}
+
+        # concurrent streams through BOTH models' planes
+        t1, t2 = await asyncio.gather(
+            _collect_text(e1, req_for("m1", "alpha prompt")),
+            _collect_text(e2, req_for("m2", "beta prompt")))
+        assert "alpha prompt" in t1 and "beta prompt" in t2
+
+        # bit-exact vs each model served ALONE (a fresh single-model
+        # pipeline straight at the same worker fleet)
+        from dynamo_tpu.llm.backend import Backend
+        from dynamo_tpu.llm.engines.kv_routed import KvRoutedEngine
+        from dynamo_tpu.llm.model_card import ModelDeploymentCard
+        from dynamo_tpu.llm.preprocessor import OpenAIPreprocessor
+        from dynamo_tpu.runtime import link
+        from dynamo_tpu.runtime.distributed import Endpoint
+        mdc = ModelDeploymentCard.from_local_path(tiny_model_dir,
+                                                  display_name="m1")
+        solo_engine = await KvRoutedEngine.start(
+            Endpoint.parse_path(rt, "dyn://tns/w1/gen"), block_size=16)
+        solo = link(OpenAIPreprocessor(mdc), Backend(mdc), solo_engine)
+        t_solo = await _collect_text(solo, req_for("m1", "alpha prompt"))
+        assert t_solo == t1          # registry routing changed NOTHING
+        await solo_engine.close()
+
+        # HTTP layer: /v1/models lists both with registry provenance;
+        # unknown model 404s
+        await svc.start()
+        base = f"http://127.0.0.1:{svc.port}"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/v1/models") as r:
+                models = await r.json()
+            ids = {m["id"]: m for m in models["data"]}
+            assert set(ids) == {"m1", "m2"}
+            assert ids["m1"]["nvext"]["endpoint"] == "dyn://tns/w1/gen"
+            assert ids["m1"]["nvext"]["program_set"]
+            async with s.post(f"{base}/v1/chat/completions",
+                              json=req_for("ghost-model", "x")) as r:
+                assert r.status == 404
+                body = await r.json()
+                assert body["error"]["type"] == "model_not_found"
+        # per-tenant admission accounting rode BOTH planes (checked
+        # before removal — a removed model's plane closes with it)
+        assert mux.tenant_counters().get("acme", {}).get("admitted",
+                                                         0) >= 2
+        # live removal: the card goes, the model 404s
+        await remove_card(rt, "m2")
+        for _ in range(200):
+            if svc.manager.chat_engine("m2") is None:
+                break
+            await asyncio.sleep(0.05)
+        assert svc.manager.chat_engine("m2") is None
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions",
+                              json=req_for("m2", "x")) as r:
+                assert r.status == 404
+    finally:
+        if mux is not None:
+            await mux.stop()
+        await svc.stop()
+        for w in (w1, w2):
+            w.cancel()
+            try:
+                await w
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        await rt.shutdown()
+
+
+# ------------------------------------------------- engine-level tenancy
+
+
+async def test_engine_core_tenant_accounting(tmp_path):
+    """EngineCore.enable_tenancy threads one ledger through every tier
+    and tags registrations with the request's tenant: served requests
+    show up in tenant_stats (admitted / kv_blocks / hit_rate) — the
+    nv_llm_tenant_* feed — and a repeat prompt's prefix hit is
+    attributed to its tenant."""
+    from tests.test_kv_fabric import _make_core, _serve_req
+
+    core = _make_core(tmp_path / "t")
+    core.enable_tenancy()
+    try:
+        from dynamo_tpu.engine.core import EngineRequest  # noqa: F401
+        prompt = list(range(1, 13))                       # 3 blocks (bs=4)
+        toks_a, req_a = await _serve_req(core, prompt, "a1")
+        assert req_a.tenant == ""                         # untagged default
+        # tagged request: EngineRequest.tenant rides into registration
+        from dynamo_tpu.engine.core import FINISH_SENTINEL
+        from dynamo_tpu.engine.sampling import SlotSampling
+        req = EngineRequest(rid="t1", prompt=list(range(20, 32)),
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=4, eos_ids=frozenset(),
+                            tenant="acme")
+        await core.submit(req)
+        while True:
+            item, _ = await asyncio.wait_for(req.out_queue.get(), 60)
+            if item is FINISH_SENTINEL:
+                break
+        assert core.tenancy.blocks("acme", "device") >= 3
+        m = core.metrics()
+        assert m.tenant_stats["acme"]["admitted"] == 1
+        assert m.tenant_stats["acme"]["kv_blocks"] >= 3
+        assert m.tenant_stats["acme"]["hit_rate"] == 0.0  # cold
+        # repeat: the prefix hit is attributed to the tenant
+        req2 = EngineRequest(rid="t2", prompt=list(range(20, 32)),
+                             sampling=SlotSampling(temperature=0.0),
+                             max_new_tokens=4, eos_ids=frozenset(),
+                             tenant="acme")
+        await core.submit(req2)
+        while True:
+            item, _ = await asyncio.wait_for(req2.out_queue.get(), 60)
+            if item is FINISH_SENTINEL:
+                break
+        m = core.metrics()
+        assert m.tenant_stats["acme"]["admitted"] == 2
+        assert m.tenant_stats["acme"]["hit_rate"] > 0.0
+    finally:
+        await core.stop()

@@ -151,13 +151,16 @@ def traces_to_workload(trace_dicts, *, default_osl: int = 16,
 
     Per trace tree (grouped on ``trace_id``): arrival ``at`` is the
     origin wall clock relative to the earliest trace in the set; ``rid``
-    the request id; ``isl``/``osl`` come from the worker trace's
-    ``engine.finish`` marker attrs (llm/engines/jax_engine.py stamps
-    them), with ``engine.prefill``'s suffix+hit as the isl fallback.
-    Traces with no token counts at all are skipped (returned count).
-    Tenant/session/turn have no trace-side source yet, so each request
-    becomes its own session of ``tenant`` — prefix-reuse structure is
-    the one thing a replayed production trace currently loses."""
+    the request id; ``isl``/``osl``/``tenant``/``session`` come from
+    the worker trace's ``engine.finish`` marker attrs
+    (llm/engines/jax_engine.py stamps them — tenant/session from
+    nvext.tenant/nvext.session_id via PreprocessedRequest), with
+    ``engine.prefill``'s suffix+hit as the isl fallback. Traces with no
+    token counts at all are skipped (returned count). Session turns are
+    reconstructed per session in arrival order, so exported workloads
+    PRESERVE tenant and prefix-reuse structure (ROADMAP sim item (d));
+    traces predating the tenant/session attrs fall back to the CLI
+    ``tenant`` label with one session per request."""
     from dynamo_tpu.sim.workload import RequestSpec, Workload
 
     trees = {}
@@ -168,10 +171,12 @@ def traces_to_workload(trace_dicts, *, default_osl: int = 16,
     specs, skipped = [], 0
     origin0 = min((min(m.get("origin_ts", 0.0) or 0.0 for m in ms)
                    for ms in trees.values()), default=0.0)
+    rows = []
     for tid, members in sorted(trees.items()):
         isl = osl = None
         rid = None
         at = None
+        r_tenant = r_session = None
         for m in sorted(members, key=lambda x: x.get("origin_offset_ms",
                                                      0.0)):
             rid = rid or m.get("request_id")
@@ -183,17 +188,30 @@ def traces_to_workload(trace_dicts, *, default_osl: int = 16,
                 isl = int(fin["isl"])
             if osl is None and fin.get("osl") is not None:
                 osl = int(fin["osl"])
+            if r_tenant is None and fin.get("tenant"):
+                r_tenant = str(fin["tenant"])
+            if r_session is None and fin.get("session"):
+                r_session = str(fin["session"])
             pf = spans.get("engine.prefill", {}).get("attrs", {})
             if isl is None and pf.get("suffix") is not None:
                 isl = int(pf.get("suffix", 0)) + int(pf.get("hit", 0))
         if isl is None or not rid:
             skipped += 1
             continue
+        rows.append((max(at or 0.0, 0.0), str(rid), r_tenant, r_session,
+                     max(int(isl), 1),
+                     max(int(osl if osl is not None else default_osl), 1)))
+    # session turns in arrival order (the prefix-reuse structure the
+    # sim's HashCatalog chains on)
+    turn_of: dict = {}
+    for at, rid, r_tenant, r_session, isl, osl in sorted(rows):
+        t = r_tenant or tenant
+        session = r_session or f"{t}-{rid}"
+        turn = turn_of.get(session, -1) + 1
+        turn_of[session] = turn
         specs.append(RequestSpec(
-            at=round(max(at or 0.0, 0.0), 6), rid=str(rid),
-            tenant=tenant, session=f"{tenant}-{rid}", turn=0,
-            isl=max(int(isl), 1),
-            osl=max(int(osl if osl is not None else default_osl), 1)))
+            at=round(at, 6), rid=rid, tenant=t, session=session,
+            turn=turn, isl=isl, osl=osl))
     return Workload(specs), skipped
 
 
