@@ -182,6 +182,20 @@ _DEGRADE_GAUGES = {
 }
 
 
+# fetch-vs-recompute cost model (kv_router/scoring.py
+# network_adjusted_overlap / crossover_tokens): the three fields the
+# router and planner price candidates with. Exporting them closes the
+# metrics plane (DL010): the crossover inputs are debuggable per worker
+# next to the link gauges instead of living only inside routing
+# decisions — a worker advertising kv_block_size=0 (old payload) or a
+# wildly-off prefill rate is visible at a glance.
+_COST_GAUGES = {
+    "kv_bytes_per_block": "nv_llm_kv_bytes_per_block",
+    "prefill_tok_per_s": "nv_llm_prefill_tok_per_s",
+    "kv_block_size": "nv_llm_kv_block_size_tokens",
+}
+
+
 # multi-tenant serving plane (llm/tenancy.py; docs/multi_tenant.md):
 # ForwardPassMetrics.tenant_stats {tenant: {field: value}} → one series
 # per (worker, tenant). The Grafana "Tenants" row plots per-tenant
@@ -256,6 +270,10 @@ class MetricsAggregatorService:
             f: Gauge(name, f"graceful degradation: worker {f} "
                      "(scraped stats)", labels, registry=self.registry)
             for f, name in _DEGRADE_GAUGES.items()}
+        self._cost_gauges: Dict[str, Gauge] = {
+            f: Gauge(name, f"fetch-vs-recompute cost model: worker {f} "
+                     "(scraped stats)", labels, registry=self.registry)
+            for f, name in _COST_GAUGES.items()}
         self._tenant_gauges: Dict[str, Gauge] = {
             f: Gauge(name, f"multi-tenant serving: per-tenant {f} "
                      "(scraped stats)", labels + ["tenant"],
@@ -408,6 +426,8 @@ class MetricsAggregatorService:
                 g.labels(*lbl).set(getattr(m, f))
             for f, g in self._degrade_gauges.items():
                 g.labels(*lbl).set(getattr(m, f))
+            for f, g in self._cost_gauges.items():
+                g.labels(*lbl).set(getattr(m, f))
             # per-tenant labeled series (llm/tenancy.py tenant_stats)
             tenants = m.tenant_stats or {}
             for t, stats in tenants.items():
@@ -440,7 +460,8 @@ class MetricsAggregatorService:
                       + list(self._remote_gauges.values())
                       + list(self._ragged_gauges.values())
                       + list(self._trace_gauges.values())
-                      + list(self._degrade_gauges.values())):
+                      + list(self._degrade_gauges.values())
+                      + list(self._cost_gauges.values())):
                 try:
                     g.remove(*lbl)
                 except KeyError:

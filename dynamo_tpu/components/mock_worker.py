@@ -343,6 +343,44 @@ class MockTokenWorker:
             d["remote_breaker_open_peers"] = 0
             d["remote_breaker_trips_total"] = 1
             d["disk_spill_shed_total"] = eng.requests_served // 6
+        if eng is not None and not d.get("disk_capacity_blocks"):
+            # synthetic tier-ladder + worker-health gauges: a healthy
+            # host/disk ladder (steady stores, warm hit rates, no
+            # dropped jobs), a quiet loop-lag probe, and cost-model
+            # inputs — every remaining gauge-table field fed so the
+            # zero-TPU fixture lights EVERY Grafana panel (the DL010
+            # closure: a field the mock can't feed is a panel no
+            # no-hardware test can ever prove works)
+            served = eng.requests_served
+            d["num_requests_waiting"] = max(live - 4, 0)
+            d["gpu_cache_usage_perc"] = min(0.1 + 0.01 * live, 0.9)
+            d["gpu_prefix_cache_hit_rate"] = 0.45
+            d["host_stored_total"] = 2 * served
+            d["host_evicted_total"] = served // 2
+            d["host_hit_rate"] = 0.55
+            d["offload_dropped_jobs_total"] = 0
+            d["disk_used_blocks"] = served
+            d["disk_capacity_blocks"] = 4096
+            d["disk_stored_total"] = served
+            d["disk_evicted_total"] = served // 4
+            d["disk_hit_rate"] = 0.35
+            d["disk_bytes_used"] = served * (1 << 20)
+            d["disk_spill_dropped_total"] = 0
+            d["remote_capacity_blocks"] = 1 << 16
+            d["remote_stored_total"] = 3 * served
+            d["remote_fetch_failures_total"] = 0
+            d["remote_admission_rejects_total"] = served // 10
+            d["kv_defrag_moves_total"] = served // 8
+            # a mildly-interleaved pipeline profile (pp=2, K=4 →
+            # utilization K·pp/(K·pp+pp-1) = 8/9)
+            d["pp_stages"] = 2
+            d["pp_microbatch"] = 4
+            d["pp_utilization"] = 8 / 9
+            d["pp_bubble_fraction"] = 1 / 9
+            d["trace_dropped_log_lines_total"] = served // 3
+            d["loop_lag_ms"] = 0.4
+            d["loop_lag_max_ms"] = 2.5
+            d["netstore_retries_total"] = 0
         tenants = getattr(self, "tenants", 0)
         if eng is not None and tenants > 0:
             # round 14: synthetic per-tenant stats — a Zipf-ish spread

@@ -423,7 +423,10 @@ class Planner:
                             "(queue depth %d)", step, depth)
                 self._prefill_cooldown_until = (time.monotonic()
                                                 + self.cfg.cooldown_s)
-                self._pq_breaches = 0
+                # single planner control task; resetting the breach
+                # counter AFTER the actuation is the designed
+                # hysteresis (breaches during the await are absorbed)
+                self._pq_breaches = 0  # dynalint: ok DL008 single-writer control loop
         elif (self._pq_idle_cycles >= 2 * self.cfg.breach_cycles
                 and not drain_busy
                 and len(live) > self.slo.min_prefill_workers):

@@ -76,9 +76,11 @@ class DeploymentApi:
         return self
 
     async def stop(self) -> None:
-        if self._runner is not None:
-            await self._runner.cleanup()
-            self._runner = None
+        # claim before the await (DL008): a racing second stop() sees
+        # None instead of double-cleaning the runner
+        runner, self._runner = self._runner, None
+        if runner is not None:
+            await runner.cleanup()
 
     # ------------------------------------------------------------- handlers
     async def _spec(self, name: str) -> Optional[DeploymentSpec]:

@@ -107,11 +107,15 @@ logger = logging.getLogger("dynamo_tpu.engine.multihost")
 __all__ = ["DispatchStreamLeader", "connect_follower", "run_follower"]
 
 # events a follower needs for device-state lockstep; everything else the
-# recorder sees (admit/harvest/first_token/preempt/release) is leader-side
-# host bookkeeping
+# recorder sees (replay.HOST_EVENTS: admit/harvest/first_token/preempt/
+# release) is leader-side host bookkeeping. dynalint DL009 holds this
+# set equal to run_follower's handled kinds — `ragged` and `verify` were
+# missing here while run_follower already handled them, so a ragged or
+# speculative leader silently dropped those dispatches on the floor and
+# follower device state diverged.
 WIRE_EVENTS = frozenset(
-    {"prefill", "prefill_sp", "dispatch", "hit_transfer",
-     "kv_store", "kv_disk_store", "kv_remote_restore",
+    {"prefill", "prefill_sp", "dispatch", "ragged", "verify",
+     "hit_transfer", "kv_store", "kv_disk_store", "kv_remote_restore",
      "precomputed_admit", "precomputed_device_admit", "handoff_gather",
      "prefill_unsupported"})
 _SHUTDOWN = {"ev": "__shutdown__"}

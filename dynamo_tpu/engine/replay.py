@@ -42,6 +42,18 @@ from typing import Dict, List, Optional
 import numpy as np
 
 
+# Leader-side host bookkeeping: events the recorder emits for stream
+# accounting, divergence diffing (compare_replay), and preemption-policy
+# forensics — they carry NO device-state transition, so neither the
+# offline replayer nor a multihost follower executes them. Every event
+# the recorder emits must be EITHER replayed below OR listed here
+# (dynalint DL009 enforces the classification is total and disjoint
+# from multihost.WIRE_EVENTS).
+HOST_EVENTS = frozenset(
+    {"admit", "first_token", "harvest", "ragged_harvest", "spec_harvest",
+     "preempt", "release"})
+
+
 class Recorder:
     """Collects scheduler events in device-dispatch order."""
 
@@ -376,6 +388,11 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
 
     for ev in events:
         kind = ev["ev"]
+        if kind in HOST_EVENTS:
+            # leader-side bookkeeping (see HOST_EVENTS): the replay
+            # re-derives device state only; compare_replay reads the
+            # harvest family out of the SAME event list for the diff
+            continue
         if kind == "prefill_unsupported":
             raise NotImplementedError(
                 f"run used an unrecorded admission path "

@@ -637,7 +637,12 @@ async def _wire_kv_events(core, runtime, endpoint) -> None:
         # router's radix index routes matching prompts here for a
         # promote instead of a cold recompute elsewhere (the same
         # reannounce() hook the lease-reclaim recovery uses)
-        n = core.reannounce_kv()
+        # off-loop: the remote-tier inventory walk reads every durable
+        # object's chain meta (per-object file I/O, proportional to the
+        # warm tier) — the engine loop isn't serving yet, but frontends
+        # sharing this process's loop are (DL001, found by the typed-
+        # chain resolution this PR added)
+        n = await asyncio.to_thread(core.reannounce_kv)
         logger.info("announced %d KV blocks at bring-up (%d disk-"
                     "resident from the previous run)", n,
                     len(core.disk_store))

@@ -134,7 +134,9 @@ class PrefillQueue:
 
     async def _queue(self):
         if self._q is None:
-            self._q = await self.runtime.bus.work_queue(self.name)
+            # racing first callers bind the SAME named queue (work_queue
+            # is idempotent by name); last-writer-wins is equivalent
+            self._q = await self.runtime.bus.work_queue(self.name)  # dynalint: ok DL008 idempotent-by-name bind
         return self._q
 
     async def enqueue(self, req: RemotePrefillRequest) -> int:

@@ -151,13 +151,15 @@ class ModelWatcher:
             logger.info("model removed: %s (%s)", parts[1], parts[0])
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
+        # claim before the await (DL008): a racing second stop() must not
+        # re-cancel/re-await the same pump
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
             try:
-                await self._task          # let an in-flight _add finish/abort
+                await task                # let an in-flight _add finish/abort
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
-            self._task = None
         if self._watcher is not None:
             self._watcher.close()
         for engine in self._engines.values():

@@ -260,9 +260,11 @@ class HttpService:
         logger.info("HTTP service listening on %s:%s", self.host, self.port)
 
     async def stop(self) -> None:
-        if self._runner is not None:
-            await self._runner.cleanup()
-            self._runner = None
+        # claim before the await (DL008): concurrent stop()s must not
+        # both run cleanup
+        runner, self._runner = self._runner, None
+        if runner is not None:
+            await runner.cleanup()
 
     async def run_forever(self) -> None:
         await self.start()

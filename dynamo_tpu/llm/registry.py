@@ -188,12 +188,13 @@ class RegistryWatcher:
         return self
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
+        # claim before the await (DL008)
+        task, self._task = self._task, None
+        if task is not None:
+            task.cancel()
             try:
-                await self._task
+                await task
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
-            self._task = None
         if self._watcher is not None:
             self._watcher.close()

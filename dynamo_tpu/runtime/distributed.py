@@ -29,6 +29,7 @@ keep working.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
 import uuid
@@ -71,6 +72,7 @@ class DistributedRuntime:
         self.tcp = TcpStreamServer(tcp_host, advertise)
         self.worker_uuid = uuid.uuid4().hex
         self._primary_lease: Optional[Lease] = None
+        self._lease_lock = asyncio.Lock()
         self._servers: List[EndpointServer] = []
         self.on_lease_lost: Optional[Callable[[], None]] = None
         self._closed = False
@@ -92,11 +94,16 @@ class DistributedRuntime:
         return cls(store, bus, advertise=advertise)
 
     async def primary_lease(self) -> Lease:
+        # double-checked lock (DL008): two concurrent first callers would
+        # otherwise BOTH mint a lease — one becomes an orphan with a live
+        # keepalive and the worker's identity is whichever won the write
         if self._primary_lease is None:
-            lease = await self.store.lease_create(self.LEASE_TTL)
-            lease.on_lost = self._lease_lost
-            lease.start_keepalive()
-            self._primary_lease = lease
+            async with self._lease_lock:
+                if self._primary_lease is None:
+                    lease = await self.store.lease_create(self.LEASE_TTL)
+                    lease.on_lost = self._lease_lost
+                    lease.start_keepalive()
+                    self._primary_lease = lease
         return self._primary_lease
 
     def _lease_lost(self) -> None:
@@ -121,9 +128,9 @@ class DistributedRuntime:
         self._closed = True
         for srv in list(self._servers):
             await srv.stop()
-        if self._primary_lease is not None:
-            await self._primary_lease.revoke()
-            self._primary_lease = None
+        lease, self._primary_lease = self._primary_lease, None
+        if lease is not None:   # claimed before the await (DL008)
+            await lease.revoke()
         await self.tcp.close()
         await self.bus.close()
         await self.store.close()

@@ -388,16 +388,16 @@ class DiscoveryServer:
         return f"{self.host}:{self.port}"
 
     async def close(self) -> None:
-        if self._server is not None:
-            self._server.close()
+        server, self._server = self._server, None  # claim (DL008)
+        if server is not None:
+            server.close()
             # drop live client connections too: wait_closed() (3.12+)
             # otherwise blocks on them, and a killed daemon must look
             # KILLED to clients (their reconnect path takes over)
             for session in list(self._sessions):
                 if not session.writer.is_closing():
                     session.writer.close()
-            await self._server.wait_closed()
-            self._server = None
+            await server.wait_closed()
         if self.wal is not None:
             # fold the WAL on graceful exit; sessions are closed above,
             # so no wal_append can race the off-thread fold

@@ -160,10 +160,12 @@ class TcpStreamServer:
                 writer.close()
 
     async def close(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        # claim before the await (DL008): double-close waits on a dead
+        # server instead of racing the teardown
+        server, self._server = self._server, None
+        if server is not None:
+            server.close()
+            await server.wait_closed()
 
 
 class StreamSender:

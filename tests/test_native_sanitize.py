@@ -248,6 +248,75 @@ def _run_sanitized(driver: str, so_name: str, sources: list,
     assert ok_token in proc.stdout
 
 
+_KVEVENT_FUZZ_DRIVER = """
+import asyncio
+
+import numpy as np
+
+from dynamo_tpu.llm.kv.blocks import compute_block_hashes, hash_tokens
+from dynamo_tpu.llm.kv_router.c_abi import CtypesKvEventPublisher, DYN_OK
+from dynamo_tpu.llm.kv_router.indexer import KvIndexer
+from dynamo_tpu.llm.kv_router.publisher import KvEventPublisher
+
+BS = 4
+WID = 0x77
+
+abi = CtypesKvEventPublisher("sanns", "worker", WID, BS)
+cc_idx, py_idx = KvIndexer(block_size=BS), KvIndexer(block_size=BS)
+
+async def main():
+    rng = np.random.default_rng(20260805)
+    # chained prompt families with shared prefixes, like real kv traffic
+    prompts = [list(map(int, rng.integers(1, 1 << 20, size=12 * BS)))
+               for _ in range(5)]
+    ev = 0
+    for step in range(300):
+        p = prompts[int(rng.integers(0, len(prompts)))]
+        j = int(rng.integers(1, len(p) // BS)) * BS
+        blocks = [p[i:i + BS] for i in range(0, j, BS)]
+        hashes = compute_block_hashes(p[:j], BS)
+        op = int(rng.integers(0, 3))
+        ev += 1
+        if op < 2:
+            rc = abi.publish_stored(ev, blocks, hashes, parent_hash=None)
+            assert rc == DYN_OK, (step, rc)
+            parent = None
+            pyp = KvEventPublisher(worker_id=WID,
+                                   sink=lambda e: _apply(py_idx, e))
+            for blk, h in zip(blocks, hashes):
+                pyp.publish_stored(ev, h, hash_tokens(blk), parent)
+                parent = h
+            await pyp.drain()
+        else:
+            rc = abi.publish_removed(ev, [hashes[-1]])
+            assert rc == DYN_OK, (step, rc)
+            pyp = KvEventPublisher(worker_id=WID,
+                                   sink=lambda e: _apply(py_idx, e))
+            pyp.publish_removed([hashes[-1]])
+            await pyp.drain()
+        drained = await abi.drain_pending(
+            lambda e: _apply(cc_idx, e))
+        assert drained >= 1, step
+        if step % 17 == 0:
+            for q in prompts:
+                a = cc_idx.find_matches_for_request(q).scores
+                b = py_idx.find_matches_for_request(q).scores
+                assert a == b, (step, a, b)
+    # out-ABIs under the sanitizer too
+    assert abi.pending == 0
+    assert abi.dropped == 0
+    info = abi.info()
+    assert info and info.get("kv_block_size") == BS, info
+
+async def _apply(idx, e):
+    idx.apply_event(e)
+
+asyncio.run(main())
+abi.shutdown()
+print("SAN_KVEVENT_OK")
+"""
+
+
 def test_sanitized_radix_index_differential_fuzz():
     """ISSUE 13 satellite: extend the sanitized ride to csrc/
     kv_radix_index — the router's hot prefix index, exercised here with
@@ -264,6 +333,17 @@ def test_sanitized_data_plane_frame_roundtrip():
     _run_sanitized(_DATAPLANE_FUZZ_DRIVER, "data_plane",
                    ["data_plane.cpp"], "SAN_DATAPLANE_OK",
                    extra_flags=["-pthread"])
+
+
+def test_sanitized_kv_event_abi_differential_fuzz():
+    """ISSUE 15 satellite (closes the KNOWN_ISSUES dynalint-scope gap):
+    csrc/kv_event_abi.cpp under ASan/UBSan — randomized stored/removed
+    traffic through the ctypes publisher, drained into an indexer and
+    score-compared against the in-process Python publisher, with the
+    string-returning out-ABIs (poll/info) exercised under the
+    instrumented allocator."""
+    _run_sanitized(_KVEVENT_FUZZ_DRIVER, "dynkvabi", ["kv_event_abi.cpp"],
+                   "SAN_KVEVENT_OK")
 
 
 def test_sanitize_mode_knob():

@@ -13,8 +13,25 @@ import shutil
 import subprocess
 import sys
 
-from .engine import (DEFAULT_SCAN_ROOTS, load_context, run_lint,
-                     write_baseline)
+from .engine import (DEFAULT_SCAN_ROOTS, changed_closure, load_context,
+                     run_lint, write_baseline)
+
+
+def _git_changed_files(root: str, base: str = None) -> set:
+    """Repo-relative changed files: worktree + staged diffs (vs ``base``
+    when given) plus untracked files. Raises on a non-git tree."""
+    def lines(*args):
+        out = subprocess.run(["git", *args], cwd=root, text=True,
+                             capture_output=True, check=True)
+        return [ln.strip() for ln in out.stdout.splitlines() if ln.strip()]
+
+    changed = set()
+    diff_base = [base] if base else []
+    changed.update(lines("diff", "--name-only", *diff_base))
+    if not base:
+        changed.update(lines("diff", "--name-only", "--cached"))
+    changed.update(lines("ls-files", "--others", "--exclude-standard"))
+    return changed
 
 
 def _run_ruff(root: str) -> int:
@@ -56,6 +73,14 @@ def main(argv=None) -> int:
     ap.add_argument("--update-schemas", action="store_true",
                     help="regenerate tools/dynalint/schemas.lock.json "
                          "from the current wire dataclasses")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="incremental mode: scan only git-changed files "
+                         "plus the call graph's reverse closure "
+                         "(pre-commit speed); closure rules run only "
+                         "when their input files changed")
+    ap.add_argument("--base", default=None,
+                    help="with --changed-only: diff against this ref "
+                         "instead of the worktree (e.g. origin/main)")
     ap.add_argument("--with-ruff", action="store_true",
                     help="also run `ruff check` under the repo "
                          "ruff.toml when ruff is installed")
@@ -78,9 +103,32 @@ def main(argv=None) -> int:
 
     scan_roots = tuple(args.paths) if args.paths else DEFAULT_SCAN_ROOTS
     rules = args.rules.split(",") if args.rules else None
-    findings, suppressed, stats = run_lint(
-        root, rules=rules, baseline_path=args.baseline,
-        scan_roots=scan_roots)
+    only_paths = None
+    if args.changed_only:
+        try:
+            changed = _git_changed_files(root, args.base)
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"error: --changed-only needs a git worktree: {e}",
+                  file=sys.stderr)
+            return 2
+        if not changed:
+            print("dynalint: --changed-only with a clean diff — "
+                  "nothing to scan")
+            return 0
+        ctx = load_context(root, scan_roots=scan_roots)
+        # python closure over the call/import graph; non-py changes
+        # (csrc, the Grafana JSON, the chaos tests) ride along verbatim
+        # so the closure rules keyed on them still trigger
+        only_paths = changed_closure(
+            ctx.graph, {c for c in changed if c in ctx.graph.modules})
+        only_paths |= changed
+        findings, suppressed, stats = run_lint(
+            root, rules=rules, baseline_path=args.baseline,
+            scan_roots=scan_roots, ctx=ctx, only_paths=only_paths)
+    else:
+        findings, suppressed, stats = run_lint(
+            root, rules=rules, baseline_path=args.baseline,
+            scan_roots=scan_roots)
 
     if args.write_baseline:
         path = args.baseline or os.path.join(
@@ -98,11 +146,14 @@ def main(argv=None) -> int:
     else:
         for f in findings:
             print(f.render())
+        scoped = (f" [changed-only: {stats['scoped_files']} files in "
+                  f"closure]" if stats.get("scoped_files") is not None
+                  else "")
         print(f"dynalint: {len(findings)} finding(s), "
               f"{len(suppressed)} suppressed "
               f"(waiver/baseline), {stats['files']} files, "
               f"{stats['functions']} functions, "
-              f"{stats['elapsed_s']}s")
+              f"{stats['elapsed_s']}s{scoped}")
 
     rc = 1 if findings else 0
     if args.with_ruff:

@@ -1,0 +1,444 @@
+"""Lightweight interprocedural dataflow over the RepoGraph.
+
+Three passes, each deliberately shallow (stdlib ``ast``, no fixpoints):
+
+1. **String-constant propagation** (:class:`ModuleConsts`) — module-level
+   assignments of string literals and of string collections
+   (tuple/list/set/frozenset/dict-of-strings, including ``frozenset({..})``
+   wrapping and ``A | B`` unions of resolvable parts) become a per-module
+   environment, followed across ``from X import NAME``. This is what lets
+   the closure rules (DL009-DL011) read ``WIRE_EVENTS``, gauge tables, and
+   key-prefix constants without executing the modules.
+
+2. **Attribute-type resolution** (:class:`AttrTypes`) — ``self.X`` is
+   resolved to a repo class via, in confidence order: a class-body or
+   ``__init__`` annotated assignment (``self.wal: Optional[Wal] = ...``),
+   a direct constructor call (``self.pool = KvBlockPool(...)``), or an
+   annotated ``__init__`` parameter aliased onto the attribute
+   (``def __init__(self, server: "DiscoveryServer"): self.server =
+   server``). ``Optional[T]`` unwraps to ``T``. The call-graph resolver
+   uses this to connect ``self.pool.release(...)``-style chains that the
+   PR-8 resolver dropped as ambiguous — the documented DL001 blind spot
+   (the discovery daemon's WAL fsync behind sync session glue) closes
+   through exactly this pass.
+
+3. **Await-point segmentation** (:func:`await_epochs`) — a source-order
+   walk of an async function body yielding ``(node, epoch)`` where the
+   epoch increments after every ``await`` (including ``async for`` /
+   ``async with`` headers). DL008's stale-read detection is a comparison
+   of binding epochs against use epochs on this numbering.
+
+Everything here follows the PR-8 precision contract: ambiguity yields
+*nothing* (no constant, no type, no edge) — a tier-1 zero-findings gate
+cannot afford optimistic guesses.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .callgraph import ModuleInfo, RepoGraph, dotted_text
+
+# ---------------------------------------------------------------- constants
+
+
+def _literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class ModuleConsts:
+    """Module-level string/str-collection constant environment."""
+
+    def __init__(self, graph: RepoGraph):
+        self.graph = graph
+        self._strs: Dict[str, Dict[str, str]] = {}
+        self._sets: Dict[str, Dict[str, Set[str]]] = {}
+        self._dicts: Dict[str, Dict[str, Dict[str, str]]] = {}
+        for mod in graph.modules.values():
+            self._collect(mod)
+
+    def _collect(self, mod: ModuleInfo) -> None:
+        strs: Dict[str, str] = {}
+        sets: Dict[str, Set[str]] = {}
+        dicts: Dict[str, Dict[str, str]] = {}
+        for node in mod.tree.body:
+            targets: List[ast.expr] = []
+            value: Optional[ast.AST] = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                s = _literal_str(value)
+                if s is not None:
+                    strs[t.id] = s
+                    continue
+                ss = self._eval_str_set(mod, value, strs, sets)
+                if ss is not None:
+                    sets[t.id] = ss
+                    continue
+                d = self._eval_str_dict(value)
+                if d is not None:
+                    dicts[t.id] = d
+        self._strs[mod.path] = strs
+        self._sets[mod.path] = sets
+        self._dicts[mod.path] = dicts
+
+    def _eval_str_set(self, mod: ModuleInfo, node: ast.AST,
+                      strs: Dict[str, str],
+                      sets: Dict[str, Set[str]]) -> Optional[Set[str]]:
+        """String-collection literal → set of strings, or None."""
+        if isinstance(node, ast.Call):
+            callee = dotted_text(node.func) or ""
+            if callee.rsplit(".", 1)[-1] in ("frozenset", "set", "tuple",
+                                             "list", "sorted"):
+                if len(node.args) == 1:
+                    return self._eval_str_set(mod, node.args[0], strs, sets)
+                if not node.args:
+                    return set()
+            return None
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out: Set[str] = set()
+            for el in node.elts:
+                s = _literal_str(el)
+                if s is None:
+                    return None
+                out.add(s)
+            return out
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+            left = self._eval_str_set(mod, node.left, strs, sets)
+            right = self._eval_str_set(mod, node.right, strs, sets)
+            if left is not None and right is not None:
+                return left | right
+            return None
+        if isinstance(node, ast.Name):
+            if node.id in sets:
+                return set(sets[node.id])
+            return self.str_set(mod, node.id)
+        return None
+
+    def _eval_str_dict(self, node: ast.AST) -> Optional[Dict[str, str]]:
+        if not isinstance(node, ast.Dict):
+            return None
+        out: Dict[str, str] = {}
+        for k, v in zip(node.keys, node.values):
+            ks = _literal_str(k) if k is not None else None
+            vs = _literal_str(v) if v is not None else None
+            if ks is None or vs is None:
+                return None
+            out[ks] = vs
+        return out
+
+    # -------------------------------------------------------------- queries
+    def _follow_import(self, mod: ModuleInfo,
+                       name: str) -> Optional[Tuple[ModuleInfo, str]]:
+        if name in mod.from_imports:
+            src, orig = mod.from_imports[name]
+            target = self.graph.by_dotted.get(src)
+            if target is not None:
+                return target, orig
+        return None
+
+    def const_str(self, mod: ModuleInfo, name: str) -> Optional[str]:
+        v = self._strs.get(mod.path, {}).get(name)
+        if v is not None:
+            return v
+        hop = self._follow_import(mod, name)
+        if hop is not None:
+            return self.const_str(*hop)
+        return None
+
+    def str_set(self, mod: ModuleInfo, name: str) -> Optional[Set[str]]:
+        v = self._sets.get(mod.path, {}).get(name)
+        if v is not None:
+            return v
+        hop = self._follow_import(mod, name)
+        if hop is not None:
+            return self.str_set(*hop)
+        return None
+
+    def str_dict(self, mod: ModuleInfo,
+                 name: str) -> Optional[Dict[str, str]]:
+        v = self._dicts.get(mod.path, {}).get(name)
+        if v is not None:
+            return v
+        hop = self._follow_import(mod, name)
+        if hop is not None:
+            return self.str_dict(*hop)
+        return None
+
+    def resolve_str_expr(self, mod: ModuleInfo,
+                         node: ast.AST) -> Optional[str]:
+        """Literal, module constant, or an f-string/concat whose parts
+        all resolve — used to resolve key expressions like
+        ``f"{PREFIX}control/{ns}"`` down to a match PREFIX."""
+        s = _literal_str(node)
+        if s is not None:
+            return s
+        if isinstance(node, ast.Name):
+            return self.const_str(mod, node.id)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            left = self.resolve_str_expr(mod, node.left)
+            right = self.resolve_str_expr(mod, node.right)
+            if left is None:
+                return None
+            # an unresolvable tail (a runtime name) is a wildcard hole,
+            # same as an f-string's formatted value
+            return left + (right if right is not None else "\x00")
+        if isinstance(node, ast.JoinedStr):
+            parts: List[str] = []
+            for v in node.values:
+                s = _literal_str(v)
+                if s is not None:
+                    parts.append(s)
+                elif isinstance(v, ast.FormattedValue):
+                    inner = self.resolve_str_expr(mod, v.value)
+                    # an unresolvable hole (a runtime argument like the
+                    # namespace) resolves as a wildcard marker — callers
+                    # match on the static PREFIX before the first hole
+                    parts.append(inner if inner is not None else "\x00")
+            return "".join(parts)
+        return None
+
+
+# -------------------------------------------------------------- attr types
+
+
+def _annotation_class_name(node: ast.AST) -> Optional[str]:
+    """``Wal`` / ``"Wal"`` / ``Optional[Wal]`` / ``mod.Wal`` → "Wal"."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        # string forward reference, possibly "Optional[X]"
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(node, ast.Subscript):
+        base = dotted_text(node.value) or ""
+        if base.rsplit(".", 1)[-1] in ("Optional",):
+            return _annotation_class_name(node.slice)
+        return None
+    text = dotted_text(node)
+    if text is None:
+        return None
+    return text.rsplit(".", 1)[-1]
+
+
+class AttrTypes:
+    """(module path, class name, attr) → class name, high-confidence only.
+
+    Conflicting evidence (two inits assigning different classes) removes
+    the entry — precision over recall, as everywhere in dynalint.
+    """
+
+    _CONFLICT = "\x00conflict"
+
+    def __init__(self, graph: RepoGraph):
+        self.graph = graph
+        # (path, cls, attr) -> class name
+        self._types: Dict[Tuple[str, str, str], str] = {}
+        for mod in graph.modules.values():
+            for ci in mod.classes.values():
+                self._collect_class(mod, ci)
+
+    def _note(self, key: Tuple[str, str, str], cls_name: str) -> None:
+        cur = self._types.get(key)
+        if cur is None:
+            self._types[key] = cls_name
+        elif cur != cls_name:
+            self._types[key] = self._CONFLICT
+
+    def _collect_class(self, mod: ModuleInfo, ci) -> None:
+        # class-body annotations:  pool: KvBlockPool
+        cls_node = None
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef) and node.name == ci.name:
+                cls_node = node
+                break
+        if cls_node is not None:
+            for item in cls_node.body:
+                if isinstance(item, ast.AnnAssign) and isinstance(
+                        item.target, ast.Name):
+                    cn = _annotation_class_name(item.annotation)
+                    if cn and self._is_repo_class(mod, cn):
+                        self._note((mod.path, ci.name, item.target.id), cn)
+        init = ci.methods.get("__init__")
+        if init is None:
+            return
+        params: Dict[str, str] = {}
+        args = init.node.args
+        for a in list(args.args) + list(args.kwonlyargs):
+            if a.annotation is not None:
+                cn = _annotation_class_name(a.annotation)
+                if cn and self._is_repo_class(mod, cn):
+                    params[a.arg] = cn
+        for stmt in ast.walk(init.node):
+            target = None
+            value = None
+            if isinstance(stmt, ast.AnnAssign):
+                target, value = stmt.target, stmt.value
+                if (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"):
+                    cn = _annotation_class_name(stmt.annotation)
+                    if cn and self._is_repo_class(mod, cn):
+                        self._note((mod.path, ci.name, target.attr), cn)
+                    continue
+            elif isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target, value = stmt.targets[0], stmt.value
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self") or value is None:
+                continue
+            key = (mod.path, ci.name, target.attr)
+            # self.pool = KvBlockPool(...)
+            if isinstance(value, ast.Call):
+                callee = dotted_text(value.func) or ""
+                cn = callee.rsplit(".", 1)[-1]
+                if cn and cn[:1].isupper() and self._is_repo_class(mod, cn):
+                    self._note(key, cn)
+                continue
+            # self.server = server   (annotated __init__ param)
+            if isinstance(value, ast.Name) and value.id in params:
+                self._note(key, params[value.id])
+
+    def _is_repo_class(self, mod: ModuleInfo, name: str) -> bool:
+        if name in mod.classes:
+            return True
+        if name in mod.from_imports:
+            src, orig = mod.from_imports[name]
+            target = self.graph.by_dotted.get(src)
+            return target is not None and orig in target.classes
+        return False  # no global fallback: module-scoped visibility only
+
+    def _find_class(self, mod: ModuleInfo, name: str):
+        """ClassInfo + its module for a name visible from ``mod``."""
+        if name in mod.classes:
+            return mod.classes[name], mod
+        if name in mod.from_imports:
+            src, orig = mod.from_imports[name]
+            target = self.graph.by_dotted.get(src)
+            if target is not None and orig in target.classes:
+                return target.classes[orig], target
+        return None, None
+
+    def attr_class(self, mod: ModuleInfo, cls_name: str, attr: str):
+        """ClassInfo (and its module) for ``self.<attr>`` inside
+        ``cls_name``, following single-module base classes; None when
+        unknown or conflicting."""
+        seen: Set[str] = set()
+        cur, cur_mod = mod.classes.get(cls_name), mod
+        while cur is not None and cur.name not in seen:
+            seen.add(cur.name)
+            cn = self._types.get((cur_mod.path, cur.name, attr))
+            if cn == self._CONFLICT:
+                return None, None
+            if cn is not None:
+                return self._find_class(cur_mod, cn)
+            nxt, nxt_mod = None, None
+            for b in cur.bases:
+                bname = b.split(".")[-1]
+                cand, cand_mod = self._find_class(cur_mod, bname)
+                if cand is not None and cand.name not in seen:
+                    nxt, nxt_mod = cand, cand_mod
+                    break
+            cur, cur_mod = nxt, nxt_mod
+        return None, None
+
+
+# ----------------------------------------------------- await segmentation
+
+
+class _EpochWalker:
+    """Source-order walk with an epoch that bumps after every await."""
+
+    def __init__(self) -> None:
+        self.epoch = 0
+        self.out: List[Tuple[ast.AST, int]] = []
+
+    def walk(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _expr(self, node: ast.AST) -> None:
+        """Post-order over an expression: an Await's operand evaluates
+        BEFORE the suspension, uses after it see the next epoch."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Await):
+            self._expr(node.value)
+            self.out.append((node, self.epoch))
+            self.epoch += 1
+            return
+        for child in ast.iter_child_nodes(node):
+            self._expr(child)
+        self.out.append((node, self.epoch))
+
+    def _stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._expr(stmt.test)
+            self.out.append((stmt, self.epoch))
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._expr(stmt.iter)
+            if isinstance(stmt, ast.AsyncFor):
+                self.epoch += 1      # each item crosses a suspension
+            self._expr(stmt.target)
+            self.out.append((stmt, self.epoch))
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._expr(item.optional_vars)
+            if isinstance(stmt, ast.AsyncWith):
+                self.epoch += 1      # __aenter__ suspends
+            self.out.append((stmt, self.epoch))
+            self.walk(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            self.out.append((stmt, self.epoch))
+            self.walk(stmt.body)
+            for h in stmt.handlers:
+                self.walk(h.body)
+            self.walk(stmt.orelse)
+            self.walk(stmt.finalbody)
+            return
+        # plain statement: post-order its expressions, THEN the statement
+        # itself — so an ``x = await f()`` binding carries the POST-await
+        # epoch (the bound value is as fresh as the suspension it crossed)
+        for child in ast.iter_child_nodes(stmt):
+            self._expr(child)
+        self.out.append((stmt, self.epoch))
+
+
+def await_epochs(func_node: ast.AST) -> List[Tuple[ast.AST, int]]:
+    """``[(node, epoch)]`` in evaluation order for an (async) function
+    body; the epoch increments at every suspension point. Nested function
+    bodies are excluded (they run in their own context)."""
+    w = _EpochWalker()
+    w.walk(func_node.body)
+    return w.out
+
+
+def iter_assign_names(node: ast.AST) -> Iterator[str]:
+    """Names bound by an assignment target (flattening tuples)."""
+    if isinstance(node, ast.Name):
+        yield node.id
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for el in node.elts:
+            yield from iter_assign_names(el)

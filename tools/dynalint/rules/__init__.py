@@ -3,10 +3,14 @@
 (docs/static_analysis.md "adding a rule")."""
 
 from . import (dl001_blocking, dl002_contextvar, dl003_pins, dl004_schema,
-               dl005_jit, dl006_mirror, dl007_await)
+               dl005_jit, dl006_mirror, dl007_await, dl008_atomicity,
+               dl009_replay_closure, dl010_metrics_closure,
+               dl011_control_keys, dl012_determinism)
 
 ALL_RULES = {
     m.RULE_ID: m.check
     for m in (dl001_blocking, dl002_contextvar, dl003_pins, dl004_schema,
-              dl005_jit, dl006_mirror, dl007_await)
+              dl005_jit, dl006_mirror, dl007_await, dl008_atomicity,
+              dl009_replay_closure, dl010_metrics_closure,
+              dl011_control_keys, dl012_determinism)
 }

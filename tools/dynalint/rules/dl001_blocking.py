@@ -74,6 +74,8 @@ def check(ctx: RepoContext) -> List[Finding]:
     seen: set = set()
     for fid, chain in chains.items():
         func = graph.funcs[fid]
+        if not ctx.in_scope(func.path):
+            continue        # --changed-only: report only in the closure
         for call in func.calls:
             desc = _blocking_desc(func, call)
             if desc is None:

@@ -173,7 +173,7 @@ def _calls_between(func: FuncInfo, start_line: int,
 
 def check(ctx: RepoContext) -> List[Finding]:
     findings: List[Finding] = []
-    for func in ctx.graph.funcs.values():
+    for func in ctx.iter_funcs():
         if func.name in _WRAPPER_NAMES:
             continue
         acqs = _find_acquisitions(func)

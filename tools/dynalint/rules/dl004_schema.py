@@ -248,6 +248,8 @@ def update_lock(ctx: RepoContext) -> str:
 
 
 def check(ctx: RepoContext) -> List[Finding]:
+    if not ctx.closure_relevant(*ctx.schema_paths, ctx.schema_lock_path):
+        return []      # --changed-only: no wire dataclass touched
     schemas = extract_schemas(ctx)
     findings = _check_types(schemas)
     lock_raw = ctx.read_file(ctx.schema_lock_path)

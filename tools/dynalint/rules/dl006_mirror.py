@@ -157,6 +157,9 @@ def _scratch_buffer_sizes(mod) -> Dict[str, int]:
 
 
 def check(ctx: RepoContext) -> List[Finding]:
+    if not ctx.closure_relevant(*(p for pair in ctx.mirror_pairs
+                                  for p in pair[:2])):
+        return []      # --changed-only: no mirrored ABI touched
     findings: List[Finding] = []
     for cpp_path, py_path, prefixes in ctx.mirror_pairs:
         cpp_src = ctx.read_file(cpp_path)

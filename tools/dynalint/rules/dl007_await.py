@@ -98,16 +98,10 @@ class _AwaitVisitor(ast.NodeVisitor):
 
 def check(ctx: RepoContext) -> List[Finding]:
     findings: List[Finding] = []
-    for rel in sorted(ctx.graph.modules):
-        src = ctx.read_file(rel)
-        if src is None:
-            continue
-        try:
-            tree = ast.parse(src)
-        except SyntaxError:
-            continue
+    for mod in ctx.iter_modules():
+        rel = mod.path
         v = _AwaitVisitor()
-        v.visit(tree)
+        v.visit(mod.tree)
         for lineno, desc, qual in v.findings:
             findings.append(Finding(
                 rule=RULE_ID, path=rel, line=lineno,

@@ -1,0 +1,301 @@
+"""DL008: async-atomicity — stale shared-state snapshots across awaits.
+
+The engine loop is single-tasked by design, but every OTHER async
+function in the serving plane interleaves with it at each ``await``: a
+``self.``-state read taken BEFORE a suspension point describes a world
+that may no longer exist AFTER it. The bug class this rule rejects is
+the check-then-act race (the stale-slot / ``_sweep_cancelled`` vs
+harvest interleaving shape): a guard or index derived from shared state,
+an ``await``, then an action that trusts the pre-await value without
+re-reading.
+
+Two detected shapes, both built on the dataflow layer's await-point
+segmentation (``dataflow.await_epochs``):
+
+1. **stale snapshot acting on shared state** — a local bound from a
+   ``self.X`` read at epoch *b* is used at epoch *u* > *b* (an await
+   intervened) as the INDEX of a shared-state subscript store/delete
+   (``self.Y[v] = …`` / ``del self.Y[v]``) or as an argument to a
+   mutating method on shared state (``self.Y.pop(v)``, ``.remove``,
+   ``.release``, ``.unpin``, ``.discard``, ``.vacate``), without being
+   rebound after the last await before the use.
+
+2. **check-then-act guard** — an ``if``/``while`` test reads ``self.X``
+   at epoch *g*; the governed body crosses an await and then mutates the
+   SAME ``self.X`` root (subscript store/delete, mutating method, or
+   plain reassignment) at a later epoch, with no re-read of that root
+   between the last intervening await and the mutation.
+
+Suppressions that keep the repo-wide gate honest rather than noisy:
+``self.cfg`` / ``self.config`` / ``self.model_cfg``-rooted reads
+(immutable engine config), ALL-CAPS attribute constants, and any re-read
+of the root between the await and the act (re-validation is exactly the
+fix the rule asks for). Deliberate single-writer pumps waive with
+``# dynalint: ok DL008 <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..callgraph import FuncInfo
+from ..dataflow import await_epochs, iter_assign_names
+from ..engine import Finding, RepoContext
+
+RULE_ID = "DL008"
+
+# receiver-method tails that MUTATE the receiver (curated like DL001's
+# blocking table; add here when a new shared-state mutator appears)
+_MUTATOR_TAILS = {"pop", "remove", "discard", "release", "unpin",
+                  "vacate", "popitem", "clear"}
+
+# self attributes that are configuration, not shared mutable state
+_CONFIG_ATTRS = {"cfg", "config", "model_cfg", "_cfg"}
+
+_HINT = ("re-read the shared state after the await (the world moved "
+         "while you were suspended), or hoist the await out of the "
+         "check-then-act window; waive a deliberately single-writer "
+         "pump with `# dynalint: ok DL008 <reason>`")
+
+
+def _self_roots(node: ast.AST, taint: bool = False) -> Set[str]:
+    """Attr names X for every ``self.X`` LOAD inside ``node``, excluding
+    config attrs, constants, and ``self.X(...)`` method positions.
+
+    ``taint=True`` is the stricter snapshot-source form: reads inside a
+    ``Call`` (constructor/helper arguments) don't taint the bound value
+    — the value is the callee's product, not a raw state snapshot — and
+    a value that contains an ``Await`` is POST-suspension data, which is
+    as fresh as it gets."""
+    roots: Set[str] = set()
+    if taint and any(isinstance(n, ast.Await) for n in ast.walk(node)):
+        return roots
+    skip: Set[int] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            if taint:
+                # anything inside a call — receiver, args — feeds the
+                # CALLEE; the bound value is the callee's product
+                skip.update(id(d) for d in ast.walk(n))
+                skip.discard(id(n))
+            else:
+                skip.add(id(n.func))
+    for n in ast.walk(node):
+        if (isinstance(n, ast.Attribute)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == "self"
+                and isinstance(n.ctx, ast.Load)
+                and id(n) not in skip
+                and n.attr not in _CONFIG_ATTRS
+                and not n.attr.isupper()):
+            roots.add(n.attr)
+    return roots
+
+
+def _mutated_self_root(node: ast.AST) -> Optional[Tuple[str, ast.AST]]:
+    """(attr, index/arg expr) when ``node`` is a shared-state mutation:
+    ``self.X[i] = / del self.X[i]`` or ``self.X.<mutator>(arg)`` (incl.
+    one attribute hop: ``self.X.Y.pop(arg)`` roots at X)."""
+    if isinstance(node, (ast.Assign, ast.Delete)):
+        targets = node.targets
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                recv = t.value
+                while isinstance(recv, (ast.Attribute, ast.Subscript)):
+                    if (isinstance(recv, ast.Attribute)
+                            and isinstance(recv.value, ast.Name)
+                            and recv.value.id == "self"):
+                        return recv.attr, t.slice
+                    recv = recv.value
+    if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+        call = node.value
+        f = call.func
+        if (isinstance(f, ast.Attribute) and f.attr in _MUTATOR_TAILS):
+            recv = f.value
+            while isinstance(recv, (ast.Attribute, ast.Subscript)):
+                if (isinstance(recv, ast.Attribute)
+                        and isinstance(recv.value, ast.Name)
+                        and recv.value.id == "self"):
+                    arg = call.args[0] if call.args else None
+                    return recv.attr, arg
+                recv = recv.value
+    return None
+
+
+class _FuncState:
+    """Epoch-indexed dataflow facts for one async function body."""
+
+    def __init__(self, func: FuncInfo):
+        self.func = func
+        self.seq = await_epochs(func.node)
+        # evaluation order position per node id (for "between" queries)
+        self.order: Dict[int, int] = {id(n): i
+                                      for i, (n, _) in enumerate(self.seq)}
+        self.epoch: Dict[int, int] = {id(n): e for n, e in self.seq}
+        # node ids inside an ``async with self.<lock>`` region: the
+        # sanctioned double-checked-lock discipline serializes its
+        # guards with its mutations, so they are exempt
+        self.locked: Set[int] = set()
+        for n in ast.walk(func.node):
+            if not isinstance(n, ast.AsyncWith):
+                continue
+            for item in n.items:
+                t = item.context_expr
+                tail = t.attr if isinstance(t, ast.Attribute) else (
+                    t.id if isinstance(t, ast.Name) else "")
+                if "lock" in tail.lower() or "sem" in tail.lower():
+                    self.locked.update(id(d) for s in n.body
+                                       for d in ast.walk(s))
+                    break
+
+    def epoch_of(self, node: ast.AST) -> Optional[int]:
+        return self.epoch.get(id(node))
+
+    def reads_between(self, root: str, lo_pos: int, hi_pos: int) -> bool:
+        """Any ``self.<root>`` load strictly between two positions?"""
+        for i in range(lo_pos + 1, hi_pos):
+            n, _ = self.seq[i]
+            if (isinstance(n, ast.Attribute)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == "self"
+                    and n.attr == root
+                    and isinstance(n.ctx, ast.Load)):
+                return True
+        return False
+
+    def last_await_before(self, pos: int) -> Optional[int]:
+        for i in range(pos - 1, -1, -1):
+            n, _ = self.seq[i]
+            if isinstance(n, ast.Await):
+                return i
+        return None
+
+
+def _check_snapshots(st: _FuncState, findings: List[Finding]) -> None:
+    func = st.func
+    # bindings: name -> list of (position, epoch, snapshot_roots)
+    binds: Dict[str, List[Tuple[int, int, Set[str]]]] = {}
+    # keys this function itself STORED under (``self.X[rid] = …``): a
+    # later pop/del keyed by the same local is the owner cleaning up its
+    # own entry (the netstore rid/wid discipline), not check-then-act
+    owned_keys: Set[str] = set()
+    for pos, (node, epoch) in enumerate(st.seq):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = getattr(node, "value", None)
+            roots = (_self_roots(value, taint=True)
+                     if value is not None else set())
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Subscript) and isinstance(
+                        t.slice, ast.Name):
+                    owned_keys.add(t.slice.id)
+                for name in iter_assign_names(t):
+                    binds.setdefault(name, []).append((pos, epoch, roots))
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            roots = _self_roots(node.iter, taint=True)
+            for name in iter_assign_names(node.target):
+                binds.setdefault(name, []).append(
+                    (st.order[id(node)], epoch, roots))
+
+    def latest_bind(name: str, pos: int):
+        cand = None
+        for b in binds.get(name, []):
+            if b[0] < pos:
+                cand = b
+        return cand
+
+    seen: Set[Tuple[int, str]] = set()
+    for pos, (node, epoch) in enumerate(st.seq):
+        if id(node) in st.locked:
+            continue
+        mut = _mutated_self_root(node)
+        if mut is None or mut[1] is None:
+            continue
+        root, arg = mut
+        for n in ast.walk(arg):
+            if not (isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)):
+                continue
+            if n.id in owned_keys:
+                continue
+            b = latest_bind(n.id, pos)
+            if b is None:
+                continue
+            b_pos, b_epoch, b_roots = b
+            if not b_roots or b_epoch >= epoch:
+                continue  # not a shared snapshot, or no await crossed
+            # re-validation: the snapshot's source root re-read after the
+            # last await before the act
+            la = st.last_await_before(pos)
+            if la is not None and any(
+                    st.reads_between(r, la, pos) for r in b_roots):
+                continue
+            key = (node.lineno, n.id)
+            if key in seen:
+                continue
+            seen.add(key)
+            src = ", ".join(f"self.{r}" for r in sorted(b_roots))
+            findings.append(Finding(
+                rule=RULE_ID, path=func.path, line=node.lineno,
+                symbol=f"{func.qualname}:{n.id}@self.{root}",
+                message=(f"async-atomicity: `{n.id}` (snapshot of {src}, "
+                         f"epoch {b_epoch}) drives a mutation of "
+                         f"`self.{root}` after an intervening await "
+                         f"(epoch {epoch}) without re-validation — the "
+                         f"stale-slot check-then-act race"),
+                hint=_HINT))
+
+
+def _check_guards(st: _FuncState, findings: List[Finding]) -> None:
+    func = st.func
+    for pos, (node, epoch) in enumerate(st.seq):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        guard_roots = _self_roots(node.test)
+        if not guard_roots:
+            continue
+        body_nodes = {id(n) for n in ast.walk(node)} - {id(node)}
+        for pos2 in range(pos + 1, len(st.seq)):
+            n2, e2 = st.seq[pos2]
+            if id(n2) not in body_nodes or id(n2) in st.locked:
+                continue
+            if e2 <= epoch:
+                continue                 # no await crossed yet
+            mut = _mutated_self_root(n2)
+            root: Optional[str] = None
+            if mut is not None and mut[0] in guard_roots:
+                root = mut[0]
+            elif (isinstance(n2, ast.Assign)
+                  and len(n2.targets) == 1
+                  and isinstance(n2.targets[0], ast.Attribute)
+                  and isinstance(n2.targets[0].value, ast.Name)
+                  and n2.targets[0].value.id == "self"
+                  and n2.targets[0].attr in guard_roots):
+                root = n2.targets[0].attr
+            if root is None:
+                continue
+            la = st.last_await_before(pos2)
+            if la is not None and la > pos and st.reads_between(
+                    root, la, pos2):
+                continue                 # re-validated after the await
+            findings.append(Finding(
+                rule=RULE_ID, path=func.path, line=n2.lineno,
+                symbol=f"{func.qualname}:guard@self.{root}",
+                message=(f"async-atomicity: guard on `self.{root}` "
+                         f"(line {node.lineno}) and the act on it "
+                         f"straddle an await — the guarded condition "
+                         f"may no longer hold when the mutation runs"),
+                hint=_HINT))
+            break    # one finding per guard is enough signal
+
+
+def check(ctx: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for func in ctx.iter_funcs():
+        if not func.is_async or func.cls_name is None:
+            continue
+        st = _FuncState(func)
+        _check_snapshots(st, findings)
+        _check_guards(st, findings)
+    return findings

@@ -1,0 +1,239 @@
+"""DL009: event↔replay closure + static failpoint coverage.
+
+The recorded-replay / multihost-follower machinery is a four-party
+contract that until now only runtime tests policed:
+
+- every event type the recorder emits (``recorder.rec("<name>", …)`` in
+  the engine) must have a **home in engine/replay.py** — either a
+  replayed kind (an ``ev["ev"] == …`` / ``kind == …`` comparison) or an
+  explicit entry in the leader-side ``HOST_EVENTS`` classification.
+  An emitted event replay has never heard of silently falls through the
+  replayer's if/elif chain — recorded runs stop being re-executable;
+- every event kind the **follower** handles (engine/multihost.py
+  ``run_follower``) must be in ``WIRE_EVENTS`` — otherwise the leader's
+  ``DispatchStreamLeader.rec`` DROPS it on the floor and follower device
+  state silently diverges (this rule's first catch on the real tree:
+  ``ragged`` and ``verify`` were handled but never forwarded);
+- every ``WIRE_EVENTS`` member must be handled by ``run_follower`` —
+  a forwarded-but-unhandled kind is the same divergence from the other
+  side — and must also be offline-replayable (or explicitly refused,
+  which is a comparison too);
+- ``HOST_EVENTS`` and ``WIRE_EVENTS`` must be disjoint: an event cannot
+  be both leader-side bookkeeping and device-state lockstep.
+
+Plus the chaos half (the runtime coverage gate of tests/test_chaos.py
+made static): every failpoint site registered in ``faults.SITES`` must
+be referenced from tests/test_chaos.py AND actually hit somewhere in
+the tree (``faults.hit/hit_async/mangle`` with that literal); every hit
+must name a registered site.
+
+All sets are READ FROM THE CODE via the dataflow constant pass — there
+is no curated copy of the event list inside the rule to drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from ..engine import Finding, RepoContext
+
+RULE_ID = "DL009"
+
+_HINT_EVENT = ("classify the event: add an exec_* handler (device-state "
+               "events) or a HOST_EVENTS entry (leader-side bookkeeping) "
+               "in engine/replay.py, and keep WIRE_EVENTS in lockstep "
+               "with run_follower's handled kinds")
+_HINT_FAULT = ("every registered failpoint needs a chaos test that arms "
+               "it and a hit() at the real failure site "
+               "(docs/chaos.md); remove dead registry entries")
+
+
+def _emitted_events(ctx: RepoContext) -> dict:
+    """{event: first lineno} for every ``*.rec("<lit>", …)`` emission in
+    the configured emit paths."""
+    out: dict = {}
+    for rel in ctx.recorder_emit_paths:
+        mod = ctx.graph.modules.get(rel)
+        if mod is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "rec" and node.args):
+                continue
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                out.setdefault(a0.value, (rel, node.lineno))
+    return out
+
+
+def _compared_kinds(ctx: RepoContext, rel: str,
+                    func_name: Optional[str] = None) -> Set[str]:
+    """String literals compared against an event-kind expression
+    (``kind == "x"``, ``kind in ("x", …)``, ``ev["ev"] == "x"``) in one
+    module (optionally scoped to one function)."""
+    mod = ctx.graph.modules.get(rel)
+    if mod is None:
+        return set()
+    scope: ast.AST = mod.tree
+    if func_name is not None:
+        for f in ctx.graph.funcs.values():
+            if f.path == rel and f.name == func_name:
+                scope = f.node
+                break
+    out: Set[str] = set()
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Compare):
+            continue
+        for comp in node.comparators:
+            if isinstance(comp, ast.Constant) and isinstance(
+                    comp.value, str):
+                out.add(comp.value)
+            elif isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                out.update(el.value for el in comp.elts
+                           if isinstance(el, ast.Constant)
+                           and isinstance(el.value, str))
+    return out
+
+
+def _const_set(ctx: RepoContext, rel: str, name: str) -> Optional[Set[str]]:
+    mod = ctx.graph.modules.get(rel)
+    if mod is None:
+        return None
+    return ctx.graph.consts.str_set(mod, name)
+
+
+def _module_finding(ctx: RepoContext, rel: str, symbol: str, msg: str,
+                    hint: str, line: int = 1) -> Finding:
+    return Finding(rule=RULE_ID, path=rel, line=line, symbol=symbol,
+                   message=msg, hint=hint)
+
+
+def _check_events(ctx: RepoContext, findings: List[Finding]) -> None:
+    emitted = _emitted_events(ctx)
+    if not emitted:
+        return            # fixture tree without a recorder — nothing on
+    replay_rel = ctx.replay_module
+    mh_rel = ctx.multihost_module
+    offline = _compared_kinds(ctx, replay_rel)
+    follower = _compared_kinds(ctx, mh_rel, func_name="run_follower")
+    follower.discard("__shutdown__")
+    wire = _const_set(ctx, mh_rel, ctx.wire_events_name)
+    host = _const_set(ctx, replay_rel, ctx.host_events_name)
+
+    if wire is None:
+        findings.append(_module_finding(
+            ctx, mh_rel, f"{ctx.wire_events_name}:missing",
+            f"no statically-resolvable `{ctx.wire_events_name}` set — "
+            f"the leader cannot prove its forwarding closure",
+            _HINT_EVENT))
+        wire = set()
+    if host is None:
+        findings.append(_module_finding(
+            ctx, replay_rel, f"{ctx.host_events_name}:missing",
+            f"no statically-resolvable `{ctx.host_events_name}` "
+            f"classification in the replay module — leader-side "
+            f"bookkeeping events must be declared, not implied",
+            _HINT_EVENT))
+        host = set()
+
+    for ev, (rel, line) in sorted(emitted.items()):
+        if ev not in offline and ev not in host:
+            findings.append(Finding(
+                rule=RULE_ID, path=rel, line=line, symbol=f"{ev}:no-home",
+                message=(f"recorded event `{ev}` has no home in "
+                         f"{replay_rel}: neither replayed nor classified "
+                         f"as leader-side bookkeeping (HOST_EVENTS) — "
+                         f"recorded runs with it are silently "
+                         f"un-replayable"),
+                hint=_HINT_EVENT))
+    for ev in sorted(follower - wire):
+        findings.append(_module_finding(
+            ctx, mh_rel, f"{ev}:dropped-on-wire",
+            f"follower handles event `{ev}` but {ctx.wire_events_name} "
+            f"omits it — DispatchStreamLeader.rec drops it and follower "
+            f"device state silently diverges", _HINT_EVENT))
+    for ev in sorted(wire - follower):
+        findings.append(_module_finding(
+            ctx, mh_rel, f"{ev}:unhandled-on-follower",
+            f"`{ev}` rides the dispatch stream ({ctx.wire_events_name}) "
+            f"but run_follower has no handler for it — it falls through "
+            f"the if/elif chain silently", _HINT_EVENT))
+    for ev in sorted(wire - offline):
+        findings.append(_module_finding(
+            ctx, replay_rel, f"{ev}:not-offline-replayable",
+            f"wire event `{ev}` is not handled (or explicitly refused) "
+            f"by the offline replayer in {replay_rel}", _HINT_EVENT))
+    for ev in sorted(host & wire):
+        findings.append(_module_finding(
+            ctx, replay_rel, f"{ev}:host-and-wire",
+            f"`{ev}` is classified host-side bookkeeping AND forwarded "
+            f"on the wire — pick one", _HINT_EVENT))
+
+
+def _fault_hits(ctx: RepoContext) -> Set[str]:
+    """Site literals passed to faults.hit / hit_async / mangle anywhere
+    in the scanned tree."""
+    out: Set[str] = set()
+    for func in ctx.graph.funcs.values():
+        if func.path == ctx.faults_module:
+            continue        # the registry's own plumbing
+        for call in func.calls:
+            # aliasing idiom included: `from .faults import hit as _fault`
+            base = call.text.rsplit(".", 1)[-1].lstrip("_")
+            if base not in ("hit", "hit_async", "mangle", "fault",
+                            "fault_async"):
+                continue
+            if not call.node.args:
+                continue
+            a0 = call.node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                out.add(a0.value)
+    return out
+
+
+def _check_faults(ctx: RepoContext, findings: List[Finding]) -> None:
+    mod = ctx.graph.modules.get(ctx.faults_module)
+    if mod is None:
+        return
+    sites = ctx.graph.consts.str_dict(mod, ctx.faults_sites_name)
+    if sites is None:
+        findings.append(_module_finding(
+            ctx, ctx.faults_module, f"{ctx.faults_sites_name}:missing",
+            f"failpoint registry `{ctx.faults_sites_name}` is not a "
+            f"statically-resolvable literal dict", _HINT_FAULT))
+        return
+    chaos_src = ctx.read_file(ctx.chaos_test_path) or ""
+    hits = _fault_hits(ctx)
+    for site in sorted(sites):
+        if not re.search(rf'"{re.escape(site)}"', chaos_src):
+            findings.append(_module_finding(
+                ctx, ctx.faults_module, f"{site}:untested",
+                f"failpoint site `{site}` is registered but never "
+                f"referenced from {ctx.chaos_test_path} — the runtime "
+                f"coverage gate would fail; this is it, before merge",
+                _HINT_FAULT))
+        if site not in hits:
+            findings.append(_module_finding(
+                ctx, ctx.faults_module, f"{site}:never-hit",
+                f"failpoint site `{site}` is registered but no "
+                f"faults.hit/hit_async/mangle call names it — a dead "
+                f"registry entry arms nothing", _HINT_FAULT))
+    for site in sorted(hits - set(sites)):
+        findings.append(_module_finding(
+            ctx, ctx.faults_module, f"{site}:unregistered",
+            f"faults.hit(\"{site}\") names a site missing from "
+            f"{ctx.faults_sites_name} — it would raise KeyError at the "
+            f"first disarmed hit", _HINT_FAULT))
+
+
+def check(ctx: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+    if ctx.closure_relevant(*ctx.recorder_emit_paths, ctx.replay_module,
+                            ctx.multihost_module):
+        _check_events(ctx, findings)
+    if ctx.closure_relevant(ctx.faults_module, ctx.chaos_test_path):
+        _check_faults(ctx, findings)
+    return findings

@@ -1,0 +1,200 @@
+"""DL010: metrics-plane closure — field → gauge → dashboard → mock feed.
+
+The metrics plane is a four-hop producer→consumer chain that grew one
+hand-policed hop per PR: an engine counter becomes a
+``ForwardPassMetrics`` field, the field feeds a gauge table in
+``components/metrics.py``, the exported ``nv_llm_*`` series appears on
+the Grafana dashboard, and ``mock_worker`` feeds it synthetically so the
+whole stack runs with zero TPUs. A hop someone forgets is a silent gap:
+a counter nobody scrapes, a gauge nobody plots, a panel the no-GPU
+fixture never lights up.
+
+Three checks, all against sets READ FROM THE CODE (dataflow constant
+pass — no curated copy inside the rule):
+
+1. every ``ForwardPassMetrics`` dataclass field appears as a key in one
+   of the metrics module's gauge tables (``_GAUGE_FIELDS`` or any
+   module-level ``*_GAUGES`` dict; dict-valued fields like
+   ``tenant_stats`` are covered by a labeled table whose name carries
+   the field's family);
+2. every exported gauge/counter NAME — gauge-table values, the derived
+   ``{PREFIX}_{field}`` family, and any ``Gauge("literal", …)``
+   registration — appears in the Grafana dashboard JSON;
+3. every gauge-table FIELD is fed by mock_worker (referenced as a
+   string key, attribute, or constructor kwarg in its source) — the
+   zero-TPU fixture must light every panel.
+
+Waive a deliberately-unplotted internal gauge at the table entry line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..engine import Finding, RepoContext
+
+RULE_ID = "DL010"
+
+_HINT = ("wire the full plane: ForwardPassMetrics field → a *_GAUGES "
+         "table (components/metrics.py) → the Grafana dashboard JSON → "
+         "a mock_worker synthetic feed (docs/static_analysis.md "
+         "'adding a plane')")
+
+
+def _dataclass_fields(ctx: RepoContext) -> Dict[str, int]:
+    """{field: lineno} of the metrics dataclass."""
+    mod = ctx.graph.modules.get(ctx.metrics_protocol_module)
+    if mod is None:
+        return {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.ClassDef) and \
+                node.name == ctx.metrics_dataclass:
+            out: Dict[str, int] = {}
+            for item in node.body:
+                if isinstance(item, ast.AnnAssign) and isinstance(
+                        item.target, ast.Name):
+                    out[item.target.id] = item.lineno
+            return out
+    return {}
+
+
+def _gauge_tables(ctx: RepoContext):
+    """(field → exported name, field → table lineno, plain-field set,
+    labeled-table names) from the metrics module."""
+    mod = ctx.graph.modules.get(ctx.metrics_module)
+    if mod is None:
+        return {}, {}, set(), set()
+    consts = ctx.graph.consts
+    field_to_name: Dict[str, str] = {}
+    field_line: Dict[str, int] = {}
+    labeled_tables: Set[str] = set()
+    plain_fields: Set[str] = set()
+    prefix = consts.const_str(mod, "PREFIX") or "nv_llm"
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        tname = node.targets[0].id
+        if tname == "_GAUGE_FIELDS":
+            fields = consts.str_set(mod, tname) or set()
+            for f in fields:
+                plain_fields.add(f)
+                field_to_name[f] = f"{prefix}_{f}"
+                field_line[f] = node.lineno
+        elif tname.endswith("_GAUGES"):
+            table = consts.str_dict(mod, tname)
+            if table is None:
+                continue
+            labeled_tables.add(tname)
+            for f, name in table.items():
+                field_to_name.setdefault(f, name)
+                field_line.setdefault(f, node.lineno)
+    return field_to_name, field_line, plain_fields, labeled_tables
+
+
+def _registered_names(ctx: RepoContext) -> Set[str]:
+    """Metric names passed literally (or PREFIX-resolvably) to
+    Gauge()/Counter()/Histogram() registrations in the metrics module."""
+    mod = ctx.graph.modules.get(ctx.metrics_module)
+    if mod is None:
+        return set()
+    consts = ctx.graph.consts
+    out: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and node.args):
+            continue
+        callee = node.func
+        tail = (callee.attr if isinstance(callee, ast.Attribute)
+                else callee.id if isinstance(callee, ast.Name) else "")
+        if tail not in ("Gauge", "Counter", "Histogram", "Summary"):
+            continue
+        name = consts.resolve_str_expr(mod, node.args[0])
+        if name and "\x00" not in name:
+            out.add(name)
+    return out
+
+
+def _mock_worker_tokens(ctx: RepoContext) -> Set[str]:
+    mod = ctx.graph.modules.get(ctx.mock_worker_module)
+    if mod is None:
+        return set()
+    out: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            out.add(node.value)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+        elif isinstance(node, ast.keyword) and node.arg:
+            out.add(node.arg)
+    return out
+
+
+def check(ctx: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+    if not ctx.closure_relevant(ctx.metrics_module,
+                                ctx.metrics_protocol_module,
+                                ctx.mock_worker_module,
+                                ctx.grafana_dashboard_path):
+        return []      # --changed-only: metrics plane untouched
+    fields = _dataclass_fields(ctx)
+    if not fields:
+        return findings        # fixture tree without the protocol module
+    field_to_name, field_line, _plain, labeled = _gauge_tables(ctx)
+    metrics_rel = ctx.metrics_module
+    proto_rel = ctx.metrics_protocol_module
+
+    # dict-valued fields (per-tenant stats) are covered by a labeled
+    # table named after their family: tenant_stats ↔ _TENANT_GAUGES
+    def _labeled_covers(field: str) -> bool:
+        stem = field.split("_")[0].upper()
+        return any(t.strip("_").startswith(stem) for t in labeled)
+
+    for f, line in sorted(fields.items()):
+        if f in field_to_name or _labeled_covers(f):
+            continue
+        findings.append(Finding(
+            rule=RULE_ID, path=proto_rel, line=line,
+            symbol=f"{ctx.metrics_dataclass}.{f}:unscraped",
+            message=(f"ForwardPassMetrics.{f} is published by every "
+                     f"worker but no gauge table in {metrics_rel} "
+                     f"consumes it — a counter nobody scrapes"),
+            hint=_HINT))
+
+    dashboard = ctx.read_file(ctx.grafana_dashboard_path)
+    exported = set(field_to_name.values()) | _registered_names(ctx)
+    if dashboard is None:
+        findings.append(Finding(
+            rule=RULE_ID, path=ctx.grafana_dashboard_path, line=1,
+            symbol="dashboard:missing",
+            message=f"Grafana dashboard {ctx.grafana_dashboard_path} "
+                    f"not found — the gauge allowlist has no home",
+            hint=_HINT))
+    else:
+        for name in sorted(exported):
+            if name not in dashboard:
+                line = 1
+                for f, n in field_to_name.items():
+                    if n == name:
+                        line = field_line.get(f, 1)
+                        break
+                findings.append(Finding(
+                    rule=RULE_ID, path=metrics_rel, line=line,
+                    symbol=f"{name}:unplotted",
+                    message=(f"exported metric `{name}` is missing from "
+                             f"{ctx.grafana_dashboard_path} — a gauge "
+                             f"nobody plots (or a stale export)"),
+                    hint=_HINT))
+
+    mock_tokens = _mock_worker_tokens(ctx)
+    if mock_tokens:
+        for f in sorted(field_to_name):
+            if f not in mock_tokens:
+                findings.append(Finding(
+                    rule=RULE_ID, path=ctx.mock_worker_module, line=1,
+                    symbol=f"{f}:unfed",
+                    message=(f"gauge-table field `{f}` is never fed by "
+                             f"{ctx.mock_worker_module} — the zero-TPU "
+                             f"fixture leaves its panel dark"),
+                    hint=_HINT))
+    return findings

@@ -1,0 +1,253 @@
+"""DL011: control-key closure — every llmctl write has a live consumer.
+
+``llmctl`` is the fleet's control surface: every subcommand that works
+does so because the kvstore key it writes has a watcher loop (or a
+poll-read) wired into a running process. Nothing enforces that pairing —
+a new ``llmctl foo set`` that writes ``foo/control/{ns}`` with no
+``watch_foo_loop`` anywhere ships a knob connected to nothing, and an
+unreferenced ``watch_*_loop`` is the same bug from the other side.
+
+Mechanics (all on the dataflow layer — no curated key list in the rule):
+
+- **writes**: ``kv_put`` / ``kv_create`` call sites in the llmctl module
+  and in repo functions it directly calls (one resolved hop — the model
+  registry's ``add_model`` shape). The key argument resolves to a *key
+  ref*: the ``*_key()`` helper it calls (directly or through a local
+  ``key = helper(...)`` alias, or a ``<local>.key()`` method on a
+  constructed repo object), plus — via the string-constant pass — the
+  helper's static return prefix (f-string holes become wildcards);
+- **reads**: ``kv_get`` / ``kv_get_prefix`` / ``watch_prefix`` call
+  sites in every OTHER module, resolved the same way;
+- a write is closed when some read shares its helper or its static
+  prefix. Findings land on the unconsumed ``kv_put`` line;
+- **orphan watchers**: a module-level ``watch_*_loop`` function that no
+  other module references (``create_task(watch_x_loop(...))`` in
+  launch/run.py or components/processor.py is the canonical wiring) is
+  flagged at its def line.
+
+A deliberately write-only key (an audit trail) waives at the kv_put.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..callgraph import FuncInfo, dotted_text, resolve_call
+from ..engine import Finding, RepoContext
+
+RULE_ID = "DL011"
+
+_WRITE_TAILS = {"kv_put", "kv_create", "kv_create_or_validate"}
+_READ_TAILS = {"kv_get", "kv_get_prefix", "watch_prefix"}
+
+_HINT = ("wire the consumer: a watch_*_loop spawned from launch/run.py "
+         "or components/processor.py (or a poll-read in the owning "
+         "component); a deliberately write-only audit key waives with "
+         "`# dynalint: ok DL011 <reason>`")
+
+
+def _helper_prefix(ctx: RepoContext, helper: FuncInfo) -> Optional[str]:
+    """Static return-string prefix of a ``*_key()`` helper (holes →
+    wildcard marker, prefix = text before the first hole)."""
+    for node in ast.walk(helper.node):
+        if isinstance(node, ast.Return) and node.value is not None:
+            s = ctx.graph.consts.resolve_str_expr(helper.module, node.value)
+            if s is not None:
+                return s.split("\x00", 1)[0]
+    return None
+
+
+def _constructed_method(ctx: RepoContext, func: FuncInfo, local: str,
+                        meth: str) -> Optional[FuncInfo]:
+    """Method ``meth`` on the class a local var was constructed from
+    (``spec = DeploymentSpec(...); spec.key()``)."""
+    from ..callgraph import _resolve_method_in_class
+    for node in ast.walk(func.node):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == local
+                and isinstance(node.value, ast.Call)):
+            callee = dotted_text(node.value.func) or ""
+            cname = callee.rsplit(".", 1)[-1]
+            if cname[:1].isupper():
+                ci, ci_mod = ctx.graph.attr_types._find_class(
+                    func.module, cname)
+                return _resolve_method_in_class(ctx.graph, ci, ci_mod,
+                                                meth)
+    return None
+
+
+class _KeyRef:
+    __slots__ = ("helper", "prefix", "line")
+
+    def __init__(self, helper: Optional[str], prefix: Optional[str],
+                 line: int):
+        self.helper = helper
+        self.prefix = prefix
+        self.line = line
+
+    def matches(self, other: "_KeyRef") -> bool:
+        if self.helper and other.helper and self.helper == other.helper:
+            return True
+        if self.prefix and other.prefix:
+            a, b = self.prefix, other.prefix
+            return bool(a) and bool(b) and (a.startswith(b)
+                                            or b.startswith(a))
+        return False
+
+    def describe(self) -> str:
+        if self.helper:
+            return f"{self.helper}(…)"
+        return f"\"{self.prefix}…\""
+
+
+def _resolve_key_expr(ctx: RepoContext, func: FuncInfo,
+                      expr: ast.AST,
+                      local_aliases: Dict[str, Tuple[str, Optional[str]]],
+                      line: int) -> Optional[_KeyRef]:
+    """Key expression → _KeyRef, or None when unresolvable."""
+    mod = func.module
+    # helper call:  tenant_control_key(ns)  /  spec.key()
+    if isinstance(expr, ast.Call):
+        text = dotted_text(expr.func)
+        if text is None:
+            return None
+        name = text.rsplit(".", 1)[-1]
+        targets = resolve_call(
+            ctx.graph, func,
+            type("C", (), {"node": expr, "lineno": line, "text": text})())
+        if not targets:
+            # <local>.key() where the local was constructed in this
+            # function: resolve the method in the constructed class
+            parts = text.split(".")
+            if len(parts) == 2:
+                t = _constructed_method(ctx, func, parts[0], name)
+                if t is not None:
+                    targets = [t]
+        prefix = None
+        for t in targets:
+            prefix = _helper_prefix(ctx, t)
+            if prefix:
+                break
+        return _KeyRef(name, prefix, line)
+    # local alias:  key = helper(...);  kv_put(key, …)
+    if isinstance(expr, ast.Name) and expr.id in local_aliases:
+        helper, prefix = local_aliases[expr.id]
+        return _KeyRef(helper, prefix, line)
+    # resolvable string/f-string
+    s = ctx.graph.consts.resolve_str_expr(mod, expr)
+    if s is not None:
+        p = s.split("\x00", 1)[0]
+        if p:
+            return _KeyRef(None, p, line)
+    return None
+
+
+def _collect_refs(ctx: RepoContext, func: FuncInfo,
+                  tails: Set[str]) -> List[_KeyRef]:
+    out: List[_KeyRef] = []
+    # pre-pass: local ``name = helper(...)`` aliases
+    aliases: Dict[str, Tuple[str, Optional[str]]] = {}
+    for node in ast.walk(func.node):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            text = dotted_text(node.value.func)
+            if text is None:
+                continue
+            name = text.rsplit(".", 1)[-1]
+            if not (name.endswith("_key") or name == "key"):
+                continue
+            targets = resolve_call(
+                ctx.graph, func,
+                type("C", (), {"node": node.value,
+                               "lineno": node.lineno, "text": text})())
+            prefix = None
+            for t in targets:
+                prefix = _helper_prefix(ctx, t)
+                if prefix:
+                    break
+            aliases[node.targets[0].id] = (name, prefix)
+    for call in func.calls:
+        tail = call.text.rsplit(".", 1)[-1]
+        if tail not in tails or not call.node.args:
+            continue
+        ref = _resolve_key_expr(ctx, func, call.node.args[0], aliases,
+                                call.lineno)
+        if ref is not None:
+            out.append(ref)
+    return out
+
+
+def check(ctx: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+    llmctl = ctx.graph.modules.get(ctx.llmctl_module)
+    if llmctl is None:
+        return findings
+    # the write-closure half keys on llmctl itself (--changed-only: a
+    # key-helper edit pulls llmctl into the reverse closure via imports)
+    if ctx.in_scope(ctx.llmctl_module):
+        # writer functions: llmctl's own + one resolved call hop out
+        writer_funcs: Dict[str, FuncInfo] = {
+            f.fid: f for f in ctx.graph.funcs.values()
+            if f.path == ctx.llmctl_module}
+        for f in list(writer_funcs.values()):
+            for call in f.calls:
+                for t in resolve_call(ctx.graph, f, call):
+                    writer_funcs.setdefault(t.fid, t)
+
+        writes: List[Tuple[FuncInfo, _KeyRef]] = []
+        for f in writer_funcs.values():
+            for ref in _collect_refs(ctx, f, _WRITE_TAILS):
+                writes.append((f, ref))
+
+        reads: List[_KeyRef] = []
+        for f in ctx.graph.funcs.values():
+            if f.fid in writer_funcs:
+                continue
+            reads.extend(_collect_refs(ctx, f, _READ_TAILS))
+
+        for f, w in writes:
+            if any(w.matches(r) for r in reads):
+                continue
+            findings.append(Finding(
+                rule=RULE_ID, path=f.path, line=w.line,
+                symbol=f"{f.qualname}:{w.helper or w.prefix}",
+                message=(f"llmctl writes control key {w.describe()} but "
+                         f"no watcher/reader outside the control surface "
+                         f"consumes it — a knob wired to nothing"),
+                hint=_HINT))
+
+    # orphan watcher loops: defined (in scope), never referenced
+    # cross-module. One referenced-name pass over each module instead of
+    # a walk per watcher.
+    watchers = [f for f in ctx.iter_funcs()
+                if f.name.startswith("watch_")
+                and f.name.endswith("_loop")
+                and f.cls_name is None and f.parent_fid is None]
+    if not watchers:
+        return findings
+    # reference index off the already-collected call sites (a watcher is
+    # wired as `create_task(watch_x_loop(...))` — an inner call — or
+    # offloaded by reference); no tree re-walks
+    referenced_by_module: Dict[str, Set[str]] = {}
+    wanted = {f.name for f in watchers}
+    for fn in ctx.graph.funcs.values():
+        hits = {c.text.rsplit(".", 1)[-1] for c in fn.calls} & wanted
+        hits |= {r.rsplit(".", 1)[-1] for r in fn.offloaded_refs} & wanted
+        if hits:
+            referenced_by_module.setdefault(fn.path, set()).update(hits)
+    for f in watchers:
+        referenced = any(f.name in names
+                         for path, names in referenced_by_module.items()
+                         if path != f.path)
+        if not referenced:
+            findings.append(Finding(
+                rule=RULE_ID, path=f.path, line=f.lineno,
+                symbol=f"{f.qualname}:orphan-watcher",
+                message=(f"`{f.qualname}` is a watcher loop no other "
+                         f"module spawns — the control key it watches "
+                         f"converges nowhere"),
+                hint=_HINT))
+    return findings

@@ -1,0 +1,225 @@
+"""DL012: sim/event-log determinism — DL005's purity discipline for the
+co-simulator and replay-affecting paths.
+
+The fleet simulator's whole value rests on one property: the SAME
+scenario + seed produces a byte-identical EventLog (the tier-1
+determinism gate diffs the bytes). DL005 protects jit-traced bodies;
+nothing protected the sim itself, where the same three leak classes
+break the byte-identity promise instead of follower lockstep:
+
+- **wall clock** — ``time.time/monotonic/perf_counter/time_ns`` or
+  ``datetime.now/utcnow`` read inside the determinism roots. The sim
+  runs on a VIRTUAL clock (sim/clock.py); a real-clock read smuggles
+  wall time into event ordering or payloads. (sim/clock.py itself — the
+  patcher — references the real functions without calling them and
+  stays clean by construction.)
+- **ambient randomness** — module-function stdlib ``random.*`` /
+  ``np.random.*`` / ``secrets`` / ``uuid``. Seeded instances
+  (``random.Random(seed)`` held on a local/attr and called as a method)
+  are the sanctioned source and are NOT flagged: the receiver
+  distinguishes them statically.
+- **unordered-set iteration** — ``for x in <set>`` / comprehensions /
+  ``"".join(<set>)``-style consumption where the iterable is provably a
+  set (a set literal, a ``set(...)`` call, a name/attr annotated or
+  assigned as a set in the same scope) and not wrapped in ``sorted()``.
+  Python sets iterate in hash order, which varies per process — exactly
+  the nondeterminism the EventLog gate exists to catch. Membership
+  tests and ``len()`` are fine; only iteration orders leak.
+
+Scope: ``RepoContext.determinism_paths`` (dynamo_tpu/sim/ and
+engine/replay.py by default). Deliberate escapes (e.g. a wall-clock
+timestamp in a REPORT footer that never enters the log) waive inline.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..callgraph import FuncInfo, dotted_text, shallow_walk
+from ..engine import Finding, RepoContext
+
+RULE_ID = "DL012"
+
+_WALL_CLOCK = {"time", "monotonic", "perf_counter", "time_ns",
+               "process_time"}
+_DT_CLOCK = {"now", "utcnow", "today"}
+_RANDOM_MODULES = {"random", "secrets", "uuid"}
+
+_HINT = ("route time through the sim's virtual clock and randomness "
+         "through a seeded random.Random(seed); iterate sets as "
+         "sorted(...) — hash order varies per process and breaks the "
+         "byte-identical EventLog gate; waive a provably log-invisible "
+         "escape with `# dynalint: ok DL012 <reason>`")
+
+
+def _in_scope(ctx: RepoContext, path: str) -> bool:
+    return any(path.startswith(root) if root.endswith("/")
+               else path == root
+               for root in ctx.determinism_paths)
+
+
+def _impure_call(func: FuncInfo, text: str) -> Optional[str]:
+    parts = text.split(".")
+    mod = func.module
+    if len(parts) == 1:
+        entry = mod.from_imports.get(parts[0])
+        if entry and entry[0] == "time" and entry[1] in _WALL_CLOCK:
+            return f"time.{entry[1]}"
+        if entry and entry[0] in _RANDOM_MODULES:
+            return f"{entry[0]}.{entry[1]}"
+        return None
+    head = mod.imports.get(parts[0], parts[0])
+    tail = parts[-1]
+    if head == "time" and tail in _WALL_CLOCK:
+        return f"time.{tail}"
+    if head == "datetime" and tail in _DT_CLOCK:
+        return text
+    if head in _RANDOM_MODULES and len(parts) == 2:
+        # module-FUNCTION randomness (ambient global RNG). A call on a
+        # seeded instance has a non-module receiver and lands elsewhere.
+        if head == "random" and tail in ("Random", "SystemRandom"):
+            return None      # constructing a seeded instance is the fix
+        return text
+    if head in ("numpy", "np") and len(parts) >= 3 and parts[1] == "random":
+        if tail in ("default_rng", "Generator", "RandomState"):
+            return None
+        return text
+    return None
+
+
+# ------------------------------------------------------- set iteration
+
+
+class _SetEnv:
+    """Names/attrs provably set-typed within one function (assignments
+    from set literals / ``set(...)`` / set-typed annotations)."""
+
+    def __init__(self, func: FuncInfo):
+        self.names: Set[str] = set()
+        self.attrs: Set[str] = set()
+        for node in shallow_walk(func.node):
+            value = None
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+                ann = node.annotation
+                ann_txt = (dotted_text(ann) or "").rsplit(".", 1)[-1]
+                if isinstance(ann, ast.Subscript):
+                    ann_txt = (dotted_text(ann.value) or "").rsplit(
+                        ".", 1)[-1]
+                if ann_txt in ("set", "Set", "frozenset", "FrozenSet"):
+                    self._add(targets)
+                value = node.value
+            if value is not None and self._is_set_expr(value):
+                self._add(targets)
+
+    def _add(self, targets: List[ast.expr]) -> None:
+        for t in targets:
+            if isinstance(t, ast.Name):
+                self.names.add(t.id)
+            elif (isinstance(t, ast.Attribute)
+                  and isinstance(t.value, ast.Name)
+                  and t.value.id == "self"):
+                self.attrs.add(t.attr)
+
+    @staticmethod
+    def _is_set_expr(node: ast.AST) -> bool:
+        if isinstance(node, ast.Set) or isinstance(node, ast.SetComp):
+            return True
+        if isinstance(node, ast.Call):
+            name = (dotted_text(node.func) or "").rsplit(".", 1)[-1]
+            return name in ("set", "frozenset")
+        if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.BitOr, ast.BitAnd, ast.Sub)):
+            return (_SetEnv._is_set_expr(node.left)
+                    or _SetEnv._is_set_expr(node.right))
+        return False
+
+    def is_set(self, node: ast.AST) -> bool:
+        if self._is_set_expr(node):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr in self.attrs
+        return False
+
+
+_CLASS_ATTR_CACHE: Dict[str, Set[str]] = {}
+
+
+def _class_set_attrs(ctx: RepoContext, func: FuncInfo) -> Set[str]:
+    """self attributes assigned/annotated as sets anywhere in the class
+    (cached per class — every method shares the answer)."""
+    if func.cls_name is None:
+        return set()
+    key = f"{func.path}::{func.cls_name}"
+    hit = _CLASS_ATTR_CACHE.get(key)
+    if hit is not None:
+        return hit
+    mod = func.module
+    ci = mod.classes.get(func.cls_name)
+    attrs: Set[str] = set()
+    if ci is not None:
+        for m in ci.methods.values():
+            attrs |= _SetEnv(m).attrs
+    _CLASS_ATTR_CACHE[key] = attrs
+    return attrs
+
+
+def _iter_exprs(func: FuncInfo):
+    """(expr, lineno) iterated by for-loops and comprehensions."""
+    for node in shallow_walk(func.node):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            yield node.iter, node.lineno
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in node.generators:
+                yield gen.iter, node.lineno
+
+
+def check(ctx: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+    _CLASS_ATTR_CACHE.clear()   # per-run cache (fixture trees may reuse
+    # the same relpath::Class key across different roots)
+    for func in ctx.iter_funcs():
+        if not _in_scope(ctx, func.path):
+            continue
+        for call in func.calls:
+            desc = _impure_call(func, call.text)
+            if desc:
+                findings.append(Finding(
+                    rule=RULE_ID, path=func.path, line=call.lineno,
+                    symbol=f"{func.qualname}:{desc}",
+                    message=(f"determinism leak: `{desc}` in "
+                             f"`{func.qualname}` feeds the byte-"
+                             f"identical EventLog path with per-process "
+                             f"state (wall clock / ambient RNG)"),
+                    hint=_HINT))
+        env = _SetEnv(func)
+        env.attrs |= _class_set_attrs(ctx, func)
+        for it, lineno in _iter_exprs(func):
+            # sorted(...) / list(sorted(...)) wrapping is the fix
+            if isinstance(it, ast.Call):
+                name = (dotted_text(it.func) or "").rsplit(".", 1)[-1]
+                if name == "sorted":
+                    continue
+                if name in ("list", "tuple") and it.args and isinstance(
+                        it.args[0], ast.Call) and (dotted_text(
+                            it.args[0].func) or "").endswith("sorted"):
+                    continue
+            if env.is_set(it):
+                findings.append(Finding(
+                    rule=RULE_ID, path=func.path, line=lineno,
+                    symbol=f"{func.qualname}:set-iteration",
+                    message=(f"determinism leak: `{func.qualname}` "
+                             f"iterates a set in hash order — two "
+                             f"identical runs may order these events "
+                             f"differently (wrap in sorted(...))"),
+                    hint=_HINT))
+    return findings
