@@ -882,6 +882,155 @@ def run_kv_remote_bench(mcfg) -> dict:
         shutil.rmtree(root, ignore_errors=True)
 
 
+def disagg_stream_mode() -> bool:
+    """Streaming-handoff bench mode (--disagg-stream or
+    BENCH_DISAGG_STREAM=1): streamed vs monolithic P→D KV handoff TTFT
+    A/B over real loopback TCP (llm/kv/stream.py). One parse home for
+    main() and the smoke tests."""
+    return (os.environ.get("BENCH_DISAGG_STREAM", "0") != "0"
+            or "--disagg-stream" in sys.argv[1:])
+
+
+def run_disagg_stream_bench(mcfg) -> dict:
+    """Streamed vs monolithic disagg KV handoff TTFT (llm/kv/stream.py):
+    two independent decode+prefill engine pairs (same geometry/seed →
+    identical weights) serve the same prompts through remote prefill
+    over the real TCP wire plane — one pair with per-layer streaming,
+    one with the monolithic payload. Reports min-of-N TTFT per leg, the
+    MEASURED transfer-hidden time the streaming consumer banked
+    (engine-side hidden-work clock), and the overlap model's PREDICTED
+    exposed transfer next to it — the honesty check on the pricing the
+    router and AdmissionGate use (exposed_transfer_s).
+
+    Compile noise control as in run_kv_remote_bench: one prefill bucket
+    + a throwaway warmup request through the FULL disagg path per engine
+    pair (compiles the leg's own scatter program)."""
+    import asyncio
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.core import EngineCore
+    from dynamo_tpu.llm.disagg import (DisaggEngine, DisaggregatedRouter,
+                                       PrefillWorker)
+    from dynamo_tpu.llm.kv.stream import exposed_transfer_s
+    from dynamo_tpu.llm.protocols.common import (PreprocessedRequest,
+                                                 SamplingOptions,
+                                                 StopConditions)
+    from dynamo_tpu.runtime import Context
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.runtime.engine import EngineContext
+
+    prompt_len = int(os.environ.get("BENCH_DISAGG_STREAM_PROMPT", "96"))
+    bs = 16
+    ITERS = int(os.environ.get("BENCH_DISAGG_STREAM_ITERS", "3"))
+    rng = np.random.default_rng(23)
+
+    def make_prompt():
+        return [int(t) for t in rng.integers(1, mcfg.vocab_size,
+                                             size=prompt_len)]
+
+    def make_core():
+        ecfg = EngineConfig(
+            max_model_len=prompt_len + 64, kv_block_size=bs,
+            num_kv_blocks=6 * (prompt_len // bs + 4), max_num_seqs=2,
+            prefill_buckets=[prompt_len + 64])
+        return EngineCore(mcfg, ecfg, attn_impl="xla",
+                          param_dtype=jnp.float32)
+
+    def make_request(prompt, rid):
+        pre = PreprocessedRequest(
+            token_ids=list(prompt),
+            stop_conditions=StopConditions(max_tokens=4, ignore_eos=True),
+            sampling_options=SamplingOptions(greedy=True))
+        return Context(pre, ctx=EngineContext(rid))
+
+    async def serve_ttft(eng, prompt, rid):
+        t0 = time.monotonic()
+        stream = await eng.generate(make_request(prompt, rid))
+        ttft = None
+        toks = []
+        async for a in stream:
+            if a.data is not None and a.data.token_ids:
+                if ttft is None:
+                    ttft = time.monotonic() - t0
+                toks.extend(a.data.token_ids)
+        return ttft, toks
+
+    async def run_leg(layer_stream, prompts):
+        rt = DistributedRuntime.in_process()
+        core_p, core_d = make_core(), make_core()
+        router = DisaggregatedRouter(rt, "bench",
+                                     max_local_prefill_length=0,
+                                     conditional=False)
+        eng = DisaggEngine(core_d, rt, router, device_plane=False,
+                           layer_stream=layer_stream)
+        worker = await PrefillWorker(core_p, rt).start()
+        try:
+            leg = "stream" if layer_stream else "mono"
+            # warmup through the FULL disagg path: compiles prefill,
+            # handoff gather, and this leg's scatter program
+            await serve_ttft(eng, make_prompt(), f"warm-{leg}")
+            ttfts, tok_runs = [], []
+            for i, p in enumerate(prompts):
+                ttft, toks = await serve_ttft(eng, p, f"{leg}-{i}")
+                ttfts.append(ttft)
+                tok_runs.append(toks)
+            if eng.remote_failures:
+                raise RuntimeError(
+                    f"{leg} leg fell back to local prefill "
+                    f"({eng.remote_failures}x) — the A/B would compare "
+                    f"different paths; refusing to publish")
+            return {
+                "ttft_ms": min(ttfts) * 1e3,
+                "tokens": tok_runs,
+                "hidden_s": core_d.disagg_stream_hidden_s,
+                "exposed_s": core_d.disagg_stream_exposed_s,
+                "stream_admits": core_d.disagg_stream_admits,
+                "stream_fallbacks": core_d.disagg_stream_fallbacks,
+            }
+        finally:
+            await worker.stop()
+            await core_p.stop()
+            await core_d.stop()
+            await rt.shutdown()
+
+    async def run():
+        prompts = [make_prompt() for _ in range(ITERS)]
+        mono = await run_leg(False, prompts)
+        streamed = await run_leg(True, prompts)
+        # predicted exposed transfer at the measured wire wall: the
+        # monolithic leg's full transfer is (hidden + exposed)-free, so
+        # model it from the streamed leg's own wall — serial transfer
+        # T = hidden + exposed as measured, pipeline depth = layers
+        per_admit = max(streamed["stream_admits"], 1)
+        t_serial = (streamed["hidden_s"] + streamed["exposed_s"]) \
+            / per_admit
+        predicted_exposed_s = exposed_transfer_s(
+            t_serial, mcfg.num_layers,
+            streamed["hidden_s"] / per_admit)
+        return {
+            "prompt_len": prompt_len,
+            "iters": ITERS,
+            "layers": mcfg.num_layers,
+            "mono_ttft_ms": round(mono["ttft_ms"], 2),
+            "stream_ttft_ms": round(streamed["ttft_ms"], 2),
+            "ttft_speedup": round(mono["ttft_ms"]
+                                  / max(streamed["ttft_ms"], 1e-9), 3),
+            "tokens_bit_exact": streamed["tokens"] == mono["tokens"],
+            "stream_admits": streamed["stream_admits"],
+            "stream_fallbacks": streamed["stream_fallbacks"],
+            "transfer_hidden_ms": round(
+                streamed["hidden_s"] / per_admit * 1e3, 3),
+            "transfer_exposed_ms": round(
+                streamed["exposed_s"] / per_admit * 1e3, 3),
+            "predicted_exposed_ms": round(predicted_exposed_s * 1e3, 3),
+        }
+
+    return asyncio.run(run())
+
+
 def run_spec_bench(core, batch, prompt_len, prompts, spec_k,
                    n_dispatch, device_time) -> dict:
     """Speculative serving measurement (ISSUE 2 satellite): drive the
@@ -1544,6 +1693,13 @@ def main() -> None:
         # measured crossover honesty check
         kv_remote_res = run_kv_remote_bench(mcfg)
 
+    disagg_stream_res = None
+    if disagg_stream_mode():
+        # independent two-pair loopback setup (streamed vs monolithic
+        # P→D handoff over real TCP): min-of-N TTFT A/B + the measured
+        # transfer-hidden time vs the overlap model's prediction
+        disagg_stream_res = run_disagg_stream_bench(mcfg)
+
     kv_frag_res = None
     if kv_frag_mode():
         # after the baseline/device rows (the frag leg rewrites block
@@ -1641,6 +1797,11 @@ def main() -> None:
         # fleet-fabric (G4) provenance: remote-fetch TTFT vs cold +
         # predicted/measured admission crossover
         result["kv_remote"] = kv_remote_res
+    if disagg_stream_res is not None:
+        # streaming-handoff provenance: streamed vs monolithic TTFT,
+        # measured transfer-hidden-ms next to the predicted exposed
+        # transfer (ISSUE 18)
+        result["disagg_stream"] = disagg_stream_res
     if kv_frag_res is not None:
         # contiguity provenance: DMA-copy counts (always) + device
         # step-time A/B (when the tunnel allows) per layout

@@ -196,6 +196,25 @@ _COST_GAUGES = {
 }
 
 
+# streaming layer-wise KV handoff (llm/kv/stream.py; docs/kv_fabric.md
+# "Streaming handoff"): ForwardPassMetrics field → exported metric name.
+# The Grafana "Disagg streaming" panels plot the cumulative layers this
+# decode worker progressively scattered and the degradations (torn frame
+# → monolithic fill, dead stream → cold recompute; rising fallbacks mean
+# a flaky handoff plane) next to the two pricing inputs: the measured
+# overlap ratio (fraction of stream-onboard wall time spent on hidden
+# prep/scatter work rather than exposed wire waiting — near 1.0 means
+# the transfer is fully hidden behind compute) and the measured
+# streaming depth the router's overlap credit divides by.
+_DISAGG_STREAM_GAUGES = {
+    "disagg_stream_layers_total": "nv_llm_disagg_stream_layers_total",
+    "disagg_stream_fallbacks_total":
+        "nv_llm_disagg_stream_fallbacks_total",
+    "disagg_stream_overlap_ratio": "nv_llm_disagg_stream_overlap_ratio",
+    "disagg_stream_layers": "nv_llm_disagg_stream_layers",
+}
+
+
 # multi-tenant serving plane (llm/tenancy.py; docs/multi_tenant.md):
 # ForwardPassMetrics.tenant_stats {tenant: {field: value}} → one series
 # per (worker, tenant). The Grafana "Tenants" row plots per-tenant
@@ -274,6 +293,10 @@ class MetricsAggregatorService:
             f: Gauge(name, f"fetch-vs-recompute cost model: worker {f} "
                      "(scraped stats)", labels, registry=self.registry)
             for f, name in _COST_GAUGES.items()}
+        self._disagg_stream_gauges: Dict[str, Gauge] = {
+            f: Gauge(name, f"streaming KV handoff: worker {f} "
+                     "(scraped stats)", labels, registry=self.registry)
+            for f, name in _DISAGG_STREAM_GAUGES.items()}
         self._tenant_gauges: Dict[str, Gauge] = {
             f: Gauge(name, f"multi-tenant serving: per-tenant {f} "
                      "(scraped stats)", labels + ["tenant"],
@@ -428,6 +451,8 @@ class MetricsAggregatorService:
                 g.labels(*lbl).set(getattr(m, f))
             for f, g in self._cost_gauges.items():
                 g.labels(*lbl).set(getattr(m, f))
+            for f, g in self._disagg_stream_gauges.items():
+                g.labels(*lbl).set(getattr(m, f))
             # per-tenant labeled series (llm/tenancy.py tenant_stats)
             tenants = m.tenant_stats or {}
             for t, stats in tenants.items():
@@ -461,7 +486,8 @@ class MetricsAggregatorService:
                       + list(self._ragged_gauges.values())
                       + list(self._trace_gauges.values())
                       + list(self._degrade_gauges.values())
-                      + list(self._cost_gauges.values())):
+                      + list(self._cost_gauges.values())
+                      + list(self._disagg_stream_gauges.values())):
                 try:
                     g.remove(*lbl)
                 except KeyError:

@@ -381,6 +381,18 @@ class MockTokenWorker:
             d["loop_lag_ms"] = 0.4
             d["loop_lag_max_ms"] = 2.5
             d["netstore_retries_total"] = 0
+        if eng is not None and not d.get("disagg_stream_layers_total"):
+            # round 15: synthetic streaming-handoff gauges (docs/
+            # kv_fabric.md "Streaming handoff") — a healthy plane: a
+            # 32-layer measured pipeline depth, layers growing with
+            # traffic, the occasional degraded stream, transfer mostly
+            # hidden — so the nv_llm_disagg_stream_* scrape path and
+            # the Grafana "Disagg streaming" panels run with zero
+            # hardware
+            d["disagg_stream_layers_total"] = 32 * eng.requests_served
+            d["disagg_stream_fallbacks_total"] = eng.requests_served // 16
+            d["disagg_stream_overlap_ratio"] = 0.85
+            d["disagg_stream_layers"] = 32
         tenants = getattr(self, "tenants", 0)
         if eng is not None and tenants > 0:
             # round 14: synthetic per-tenant stats — a Zipf-ish spread

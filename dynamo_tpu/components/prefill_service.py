@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+from collections import OrderedDict
 from typing import Optional
 
 from ..engine.core import FINISH_SENTINEL, EngineRequest
@@ -69,9 +70,14 @@ class PrefillService(AsyncEngine):
     """
 
     MAX_DELIVERIES = 3
+    # bounded prompt-sighting ledger: beyond this the coldest entries
+    # age out (their counts restart — a genuinely hot prompt re-earns
+    # its publish in two sightings)
+    MAX_TRACKED_PROMPTS = 4096
 
     def __init__(self, core, runtime,
-                 queue: Optional[PrefillQueue] = None):
+                 queue: Optional[PrefillQueue] = None,
+                 publish_min_hits: int = 2):
         if core.remote_store is None or core.remote_store.object is None:
             raise ValueError(
                 "--role prefill-publish needs the durable object tier — "
@@ -81,12 +87,28 @@ class PrefillService(AsyncEngine):
         self.runtime = runtime
         self.queue = queue or PrefillQueue(runtime,
                                            name=PREFILL_PUBLISH_QUEUE)
+        # queue-path publish POLICY (direct publish()/RPC calls are an
+        # explicit ask and always run): a prompt earns its durable
+        # publish on its publish_min_hits-th sighting — EXACTLY that
+        # sighting, counter-gated and deterministic (no sampling). The
+        # default of 2 skips one-shot prompts (a prefix nobody re-asks
+        # for is pure object-tier churn: a prefill + N puts that no
+        # decode fleet will ever admit), and the exactly-once trigger
+        # plus the in-flight dedupe set keep a thundering herd of
+        # identical enqueues from stampeding the engine with duplicate
+        # prefills — the herd's first qualifying item publishes, the
+        # rest skip (the content-addressed store makes the one publish
+        # serve them all).
+        self.publish_min_hits = max(int(publish_min_hits), 1)
+        self._prompt_hits: "OrderedDict[int, int]" = OrderedDict()
+        self._publishing: set = set()
         self._task: Optional[asyncio.Task] = None
         self._inflight: set = set()
         self._stopping = False
         self.publishes_done = 0
         self.publishes_failed = 0
         self.blocks_published = 0
+        self.publish_skips = 0
 
     # --------------------------------------------------------------- core
     async def publish(self, token_ids, sampling: Optional[dict] = None,
@@ -191,6 +213,21 @@ class PrefillService(AsyncEngine):
             self._inflight.add(t)
             t.add_done_callback(self._inflight.discard)
 
+    def _publish_decision(self, token_ids) -> tuple:
+        """Counter-gated queue-path policy (see __init__): returns
+        (publish?, key). Deterministic — the publish_min_hits-th
+        sighting of a prompt publishes, every other sighting skips
+        (earlier: one-shot/too-rare; later: already durable; in-flight:
+        herd duplicate)."""
+        key = hash(tuple(int(t) for t in token_ids))
+        hits = self._prompt_hits.pop(key, 0) + 1
+        self._prompt_hits[key] = hits
+        while len(self._prompt_hits) > self.MAX_TRACKED_PROMPTS:
+            self._prompt_hits.popitem(last=False)
+        if key in self._publishing:
+            return False, key              # herd duplicate: one in flight
+        return hits == self.publish_min_hits, key
+
     async def _handle_item(self, item) -> None:
         try:
             ppr = PrefillPublishRequest.from_json(item.payload)
@@ -198,6 +235,12 @@ class PrefillService(AsyncEngine):
             logger.exception("undecodable prefill-publish item %d", item.id)
             await self.queue.ack(item.id)
             return
+        publish, key = self._publish_decision(ppr.token_ids)
+        if not publish:
+            self.publish_skips += 1
+            await self.queue.ack(item.id)
+            return
+        self._publishing.add(key)
         try:
             await self._handle({"op": "publish",
                                 "request_id": ppr.request_id,
@@ -212,12 +255,15 @@ class PrefillService(AsyncEngine):
                 await self.queue.ack(item.id)   # bounded: drop poison work
             else:
                 await self.queue.nack(item.id)
+        finally:
+            self._publishing.discard(key)
 
     # -------------------------------------------------------------- stats
     def stats(self) -> dict:
         return {"prefill_publishes_done": self.publishes_done,
                 "prefill_publishes_failed": self.publishes_failed,
                 "prefill_published_blocks_total": self.blocks_published,
+                "prefill_publish_skipped_total": self.publish_skips,
                 "inflight": len(self._inflight)}
 
     async def drain(self) -> None:

@@ -32,7 +32,9 @@ KVCache = Dict[str, jax.Array]
 __all__ = ["gather_blocks", "scatter_blocks", "gather_blocks_dispatch",
            "gather_blocks_to_host", "scatter_blocks_from_host",
            "prep_host_values", "scatter_prepped", "to_wire_format",
-           "from_wire_format", "fetch_wire", "move_blocks"]
+           "from_wire_format", "fetch_wire", "move_blocks",
+           "fetch_wire_layer", "prep_layer_values", "scatter_layer_prepped",
+           "scatter_layer_from_host"]
 
 
 @functools.partial(jax.jit, static_argnames=("block_size",))
@@ -179,6 +181,121 @@ def fetch_wire(stacked: KVCache, n: int, num_heads: int) -> dict:
     return out
 
 
+def fetch_wire_layer(stacked: KVCache, n: int, num_heads: int,
+                     layer: int) -> dict:
+    """ONE layer of a dispatched gather → per-layer wire format
+    {"k": [H, n, bs, D]} — the producer half of the streaming layer-wise
+    handoff (llm/kv/stream.py). Only that layer's slice crosses
+    device→host, so layer ``l+1``'s fetch overlaps layer ``l``'s wire
+    send. Per-layer arrays stacked over the layer axis are bit-identical
+    to ``fetch_wire``'s [L, H, n, bs, D] (same transpose, same opaque
+    one-head int8 rows).
+
+    Requires a fully-addressable gather (the caller gates: a
+    multi-controller prefill engine keeps the monolithic handoff)."""
+    out = {}
+    for k, v in stacked.items():
+        arr = np.asarray(v[layer])[:n]          # [n, bs, H*D], one layer
+        heads = (1 if v.dtype == jnp.int8
+                 else num_heads * arr.shape[-1] // v.shape[-1])
+        nb, bs, HD = arr.shape
+        d = HD // heads
+        out[k] = np.ascontiguousarray(
+            arr.reshape(nb, bs, heads, d).transpose(2, 0, 1, 3))
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("block_size",),
+                   donate_argnums=(0,))
+def _scatter_layer(kv: KVCache, block_ids: jax.Array, layer: jax.Array,
+                   values: KVCache, block_size: int) -> KVCache:
+    """Write one layer's stacked block values ([n, bs, H*D]) into pool
+    row slices ``block_ids`` of layer ``layer`` (traced, so every layer
+    shares one compiled program); kv is donated — in-place HBM update."""
+
+    def one(arr: jax.Array, val: jax.Array) -> jax.Array:
+        L, _T, HD = arr.shape
+        paged = arr.reshape(L, -1, block_size, HD)
+        paged = jax.lax.dynamic_update_index_in_dim(
+            paged, paged[layer].at[block_ids].set(val.astype(arr.dtype)),
+            layer, axis=0)
+        return paged.reshape(L, -1, HD)
+
+    return {k: one(arr, values[k]) for k, arr in kv.items()}
+
+
+def prep_layer_values(block_ids, layer_values: dict) -> tuple:
+    """Pure-numpy half of a per-layer host→device scatter: per-layer wire
+    {"k": [H, n, bs, D]} → block-major [n_padded, bs, H*D] + pow2-padded
+    ids. Safe OFF the loop thread (the streaming onboard runs it in
+    asyncio.to_thread like the tier-onboard prep). Padding targets the
+    trash block (id 0), whose content is never read."""
+    n = len(block_ids)
+    pad = _pad_pow2(n) - n
+    ids = np.asarray(list(block_ids) + [0] * pad, dtype=np.int32)
+    out = {}
+    for k, v in layer_values.items():
+        v = np.asarray(v)
+        H, nb, bs, d = v.shape
+        v = np.ascontiguousarray(
+            v.transpose(1, 2, 0, 3).reshape(nb, bs, H * d))
+        if pad:
+            v = np.concatenate(
+                [v, np.zeros((pad,) + v.shape[1:], v.dtype)], axis=0)
+        out[k] = v
+    return ids, out
+
+
+def scatter_layer_prepped(kv: KVCache, layer: int, ids: np.ndarray,
+                          vals: dict, block_size: int) -> KVCache:
+    """Run the per-layer h2d scatter for prep_layer_values output against
+    ``kv``'s actual placement (single-process direct upload; multi-
+    controller assembles per-rank head shards like scatter_prepped)."""
+    sample = next(iter(kv.values()))
+    if getattr(sample, "is_fully_addressable", True):
+        vj = {k: jnp.asarray(v) for k, v in vals.items()}
+    else:
+        sh = sample.sharding
+        spec = tuple(sh.spec) + (None,) * (sample.ndim - len(sh.spec))
+        vsh = jax.sharding.NamedSharding(
+            sh.mesh, jax.sharding.PartitionSpec(None, None, spec[-1]))
+        vj = {k: jax.make_array_from_process_local_data(vsh, v)
+              for k, v in vals.items()}
+    return _scatter_layer(kv, jnp.asarray(ids),
+                          jnp.asarray(layer, jnp.int32), vj, block_size)
+
+
+def slice_local_lanes(kv: KVCache, host_values: dict) -> dict:
+    """Slice GLOBAL-head wire values down to THIS process's lane shard of
+    a multi-controller ``kv`` (identity on a fully-addressable cache).
+    Works for whole-stack ([L, H, n, bs, D]) and per-layer
+    ([H, n, bs, D]) wire arrays — the head axis is axis -4 either way."""
+    sample = next(iter(kv.values()))
+    if getattr(sample, "is_fully_addressable", True):
+        return host_values
+    lo, hi = _local_lane_range(sample)
+    if sample.dtype == jnp.int8:
+        # opaque int8 rows ride the wire as ONE head (fetch_wire): a
+        # rank's shard is a lane slice of it, not a head subrange
+        return {k: v[..., lo:hi] for k, v in host_values.items()}
+    d = next(iter(host_values.values())).shape[-1]
+    return {k: v[..., lo // d:hi // d, :, :, :]
+            for k, v in host_values.items()}
+
+
+def scatter_layer_from_host(kv: KVCache, block_ids, layer: int,
+                            layer_values: dict,
+                            block_size: int) -> KVCache:
+    """TPU-VM DRAM → device for ONE layer: the replay/follower half of
+    the ``kv_layer_stream`` event (engine/replay.py, engine/multihost.py)
+    and the synchronous form of the engine's streaming onboard.
+    ``layer_values`` is GLOBAL-head per-layer wire format [H, n, bs, D];
+    multi-controller ranks slice their local head shard first."""
+    ids, vals = prep_layer_values(
+        block_ids, slice_local_lanes(kv, layer_values))
+    return scatter_layer_prepped(kv, layer, ids, vals, block_size)
+
+
 def gather_blocks_to_host(kv: KVCache, block_ids, block_size: int,
                           num_heads: int) -> dict:
     """Device -> TPU-VM DRAM: gather on device (one DMA-friendly slice), then
@@ -237,19 +354,8 @@ def scatter_blocks_from_host(kv: KVCache, block_ids, host_values: dict,
     local head shard before uploading (scatter_prepped assembles the
     global array from the per-rank locals). Returns the new
     (donated-in-place) cache."""
-    sample = next(iter(kv.values()))
-    if not getattr(sample, "is_fully_addressable", True):
-        lo, hi = _local_lane_range(sample)
-        if sample.dtype == jnp.int8:
-            # opaque int8 rows ride the wire as ONE head (fetch_wire):
-            # a rank's shard is a lane slice of it, not a head subrange
-            host_values = {k: v[..., lo:hi]
-                           for k, v in host_values.items()}
-        else:
-            d = next(iter(host_values.values())).shape[-1]
-            host_values = {k: v[:, lo // d:hi // d]
-                           for k, v in host_values.items()}
-    ids, vals = prep_host_values(block_ids, host_values)
+    ids, vals = prep_host_values(
+        block_ids, slice_local_lanes(kv, host_values))
     return scatter_prepped(kv, ids, vals, block_size)
 
 

@@ -62,6 +62,11 @@ class EngineRequest:
     #   instead of host wire values — the in-process ICI bulk plane
     #   (llm/kv_transport.py); no device→host fetch happens at all.
     handoff_device: bool = False
+    # - wire mode with layer streaming negotiated (llm/kv/stream.py): the
+    #   handoff receives a LayeredHarvest (per-layer device→host fetches)
+    #   instead of whole-stack host values, so the prefill worker chains
+    #   per-layer DATA frames while later layers are still fetching
+    handoff_layered: bool = False
     # - decode worker: KV arrived from a remote prefill (KvPayload with
     #   host wire values, or kv_transport.DeviceKvPayload with device
     #   arrays); admission scatters it instead of running the prefill
@@ -459,6 +464,19 @@ class EngineCore:
         # prefill-as-a-service (components/prefill_service.py): prefix
         # blocks this engine published to the durable object tier
         self.prefill_published_blocks = 0
+        # streaming layer-wise KV handoff (llm/kv/stream.py): layers this
+        # DECODE engine progressively scattered, stream admissions that
+        # fell back (torn → monolithic fill, dead stream → cold
+        # recompute), and the transfer-overlap split — busy seconds the
+        # engine spent prepping/scattering already-arrived layers (work
+        # hidden behind the in-flight transfer) vs seconds it sat exposed
+        # waiting on the wire. The nv_llm_disagg_stream_* gauge feed.
+        self.disagg_stream_admits = 0
+        self.disagg_stream_layers_scattered = 0
+        self.disagg_stream_fallbacks = 0
+        self.disagg_stream_hidden_s = 0.0
+        self.disagg_stream_exposed_s = 0.0
+        self._stream_tasks: set = set()
         # end-to-end cancellation/deadlines (docs/chaos.md): requests
         # vacated because the client stopped caring (disconnect → KILL
         # frame → ctx.kill) vs because their wire-propagated deadline
@@ -849,6 +867,11 @@ class EngineCore:
                 t.cancel()
             await asyncio.gather(*list(self._onboard_tasks),
                                  return_exceptions=True)
+        if self._stream_tasks:            # in-flight layer-stream onboards
+            for t in list(self._stream_tasks):
+                t.cancel()
+            await asyncio.gather(*list(self._stream_tasks),
+                                 return_exceptions=True)
         if self._onboards:                # release reserved onboard blocks
             for req, slot, plan, _prepped, _rvals in self._onboards:
                 self.slots[slot] = None
@@ -915,6 +938,27 @@ class EngineCore:
                 f"{pool.shape[-1]} lanes of {pool.dtype} — prefill and "
                 f"decode engines must share kv_quantization (and tp, for "
                 f"int8 pools)")
+
+    def _check_layer_stream_layout(self, manifest) -> None:
+        """Layer-stream manifests announce geometry before any bulk
+        frame: per-layer wire shape [H, n, bs, D] plus layer count and
+        dtype — validated against the pool like a monolithic payload,
+        plus the layer axis (a stream describing a different depth could
+        otherwise scatter past the pool's layer extent)."""
+        import ml_dtypes  # noqa: F401 — registers bf16 et al. for np.dtype
+        h, _n, bs, d = (manifest.shape + [0, 0, 0, 0])[:4]
+        self._check_kv_payload_layout(h * d, manifest.dtype, "wire")
+        pool = next(iter(self.kv.values()))
+        if manifest.num_layers != pool.shape[0]:
+            raise ValueError(
+                f"disagg wire KV payload layout mismatch: layer stream "
+                f"announces {manifest.num_layers} layers, this pool has "
+                f"{pool.shape[0]}")
+        if bs != self.cfg.kv_block_size:
+            raise ValueError(
+                f"disagg wire KV payload layout mismatch: layer stream "
+                f"block size {bs} != pool block size "
+                f"{self.cfg.kv_block_size}")
 
     def _maybe_repack_kv_payload(self, pc):
         """Scale-aware repack of a DEVICE-plane disagg payload whose
@@ -1013,10 +1057,16 @@ class EngineCore:
                 self._check_kv_payload_layout(sample.shape[-1],
                                               sample.dtype, "device")
             else:
-                sample = next(iter(pc.values.values()))
-                self._check_kv_payload_layout(
-                    sample.shape[1] * sample.shape[4], sample.dtype,
-                    "wire")
+                from ..llm.kv.stream import LayerStreamPayload
+                if isinstance(pc, LayerStreamPayload):
+                    # layer stream: the manifest announced the geometry
+                    # up front — validate before any frame is scattered
+                    self._check_layer_stream_layout(pc.manifest)
+                else:
+                    sample = next(iter(pc.values.values()))
+                    self._check_kv_payload_layout(
+                        sample.shape[1] * sample.shape[4], sample.dtype,
+                        "wire")
         if req.trace is None:
             # bind the ambient request trace (frontend-opened for
             # in-process pipelines, ingress-opened child for the request
@@ -1196,6 +1246,22 @@ class EngineCore:
                     "hit_rate": (self.tenant_hits.get(t, 0)
                                  / max(self.tenant_queries.get(t, 0), 1))}
                 for t, n in sorted(self.tenant_admitted.items())}
+        _stream_wall = (self.disagg_stream_hidden_s
+                        + self.disagg_stream_exposed_s)
+        tier_kw.update(
+            # streaming layer-wise KV handoff (llm/kv/stream.py): the
+            # nv_llm_disagg_stream_* gauge feed. disagg_stream_layers is
+            # the MEASURED streaming depth the router's overlap credit
+            # prices with (scoring.network_adjusted_overlap) — 0 until
+            # the first streamed admission proves the plane is live.
+            disagg_stream_layers_total=self.disagg_stream_layers_scattered,
+            disagg_stream_fallbacks_total=self.disagg_stream_fallbacks,
+            disagg_stream_overlap_ratio=(
+                self.disagg_stream_hidden_s / _stream_wall
+                if _stream_wall > 0 else 0.0),
+            disagg_stream_layers=(
+                self.model_cfg.num_layers
+                if self.disagg_stream_admits > 0 else 0))
         from ..runtime.tracing import tracer as _tracer
         return ForwardPassMetrics(
             requests_cancelled_total=self.requests_cancelled_total,
@@ -2023,6 +2089,16 @@ class EngineCore:
             return True
         defer = False
         remote_admit = req.precomputed is not None
+        if remote_admit:
+            from ..llm.kv.stream import LayerStreamPayload
+            if (isinstance(req.precomputed, LayerStreamPayload)
+                    and not req.precomputed.complete):
+                # streaming layer-wise handoff: admit NOW (slot reserved,
+                # decode-invisible) and scatter layers as frames land —
+                # the request becomes decode-ready the tick the last
+                # layer arrives (llm/kv/stream.py; _stream_onboard)
+                return self._admit_stream(req, slot, plan, n_already,
+                                          _t_admit)
         if req.precomputed is not None:
             tok, logprob = self._admit_precomputed(req, n_already)
             # device payloads ship the first token as a device scalar (the
@@ -2366,6 +2442,158 @@ class EngineCore:
         req.precomputed = None
         return pc.first_token, pc.first_logprob
 
+    def _admit_stream(self, req: EngineRequest, slot: int, plan,
+                      n_already: int, t_admit: float) -> bool:
+        """Admission against a still-arriving LayerStreamPayload
+        (llm/kv/stream.py): the slot is reserved with the admission-time
+        bookkeeping of a precomputed admit (pos/key_step/mirrors — so the
+        later decode stream is bit-identical to the monolithic handoff),
+        but the request stays ``ready=False`` — dispatches aim it at the
+        trash block — while _stream_onboard scatters layers as they land.
+        First-token emit, block registration, and the ``first_token``
+        record all defer to stream completion; a dead stream re-admits
+        COLD (the same graceful rung as a failed tier onboard)."""
+        n_prompt = len(req.prompt)
+        n_prompt_blocks = self._blocks_needed(n_prompt)
+        req.pos = n_prompt
+        req.generated = 1
+        req.key_step += 1
+        req.ready = False
+        req.last_token = -1
+        self.disagg_stream_admits += 1
+        if self.recorder is not None:
+            self.recorder.rec(
+                "admit", rid=req.rid, slot=slot, pos=req.pos,
+                key_step=req.key_step, blocks=list(req.blocks),
+                hit=req.prefix_hit_tokens, prompt=list(req.prompt))
+        self.slots[slot] = req
+        self._block_tables[slot, :] = 0
+        self._block_tables[slot, :len(req.blocks)] = req.blocks
+        self._samp["temperature"][slot] = req.sampling.temperature
+        self._samp["top_k"][slot] = req.sampling.top_k
+        self._samp["top_p"][slot] = req.sampling.top_p
+        self._seeds[slot] = req.sampling.seed
+        logger.debug(
+            "stream-admitted %s into slot %d (prompt=%d, hit=%d, "
+            "%d layers inbound)", req.rid, slot, n_prompt,
+            req.prefix_hit_tokens, req.precomputed.num_layers)
+        self.flight.record(
+            "prefill", rid=req.rid, prompt=n_prompt,
+            planned_tokens=0, batch_fill=sum(
+                1 for s in self.slots if s is not None),
+            hit_device=plan.hit_tokens, hit_host=plan.host_hit_tokens,
+            hit_disk=plan.disk_hit_tokens,
+            hit_remote=plan.remote_hit_tokens,
+            precomputed=True,
+            queue_wait_ms=round(1e3 * (t_admit - req.enqueue_time), 3))
+        task = asyncio.get_running_loop().create_task(
+            self._stream_onboard(req, plan, n_already, n_prompt_blocks),
+            name=f"kv-stream-onboard-{req.rid}")
+        self._stream_tasks.add(task)
+        task.add_done_callback(self._stream_tasks.discard)
+        return True
+
+    async def _stream_onboard(self, req: EngineRequest, plan,
+                              n_already: int,
+                              n_prompt_blocks: int) -> None:
+        """Progressive onboard of a layer stream: per layer, await the
+        frame, prep OFF-thread (the existing tier-onboard discipline —
+        the wire→block-major transpose never stalls the loop), then
+        record ``kv_layer_stream`` and dispatch the scatter ADJACENTLY
+        (no await between them, so recorder order equals device
+        submission order — the bit-exact replay/follower contract)."""
+        from .block_copy import (prep_layer_values, scatter_layer_prepped,
+                                 slice_local_lanes)
+        pc = req.precomputed
+        t_wait = t_busy = 0.0
+        try:
+            for layer in range(pc.num_layers):
+                _t0 = time.monotonic()
+                vals = await pc.wait_layer(layer)
+                _t1 = time.monotonic()
+                t_wait += _t1 - _t0
+                if req.cancelled or self.slots[req.slot] is not req:
+                    return      # swept/raced away; blocks already handled
+                # defrag may relocate this request's blocks between
+                # layers (it copies content, so earlier layers move with
+                # them) — re-read the live suffix targets each layer
+                targets = req.blocks[n_already:n_prompt_blocks]
+                if targets:
+                    sliced = slice_local_lanes(
+                        self.kv,
+                        {k: v[:, n_already:n_prompt_blocks]
+                         for k, v in vals.items()})
+                    ids, prepped = await asyncio.to_thread(
+                        prep_layer_values, targets, sliced)
+                    if (req.cancelled
+                            or self.slots[req.slot] is not req):
+                        return
+                    if self.recorder is not None:
+                        self.recorder.rec(
+                            "kv_layer_stream", rid=req.rid, layer=layer,
+                            num_layers=pc.num_layers,
+                            targets=list(targets),
+                            values={k: np.asarray(v)
+                                    for k, v in sliced.items()})
+                    self.kv = scatter_layer_prepped(
+                        self.kv, layer, ids, prepped,
+                        self.cfg.kv_block_size)
+                self.disagg_stream_layers_scattered += 1
+                t_busy += time.monotonic() - _t1
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — dead stream → cold rung
+            self.disagg_stream_fallbacks += 1
+            self.disagg_stream_hidden_s += t_busy
+            self.disagg_stream_exposed_s += t_wait
+            if self.slots[req.slot] is not req:
+                return
+            logger.warning(
+                "kv layer stream failed for %s (%s) — re-admitting as a "
+                "cold recompute", req.rid, e)
+            self._release_slot(req)
+            if req.cancelled:
+                self._finish_request(req, FinishReason.CANCELLED)
+                return
+            # restore pre-admission sampling state so the local
+            # recompute samples exactly what an uncontended run would
+            # (no key was consumed: the first token was the producer's)
+            req.key_step -= 1
+            req.pos = 0
+            req.generated = 0
+            req.precomputed = None
+            req.seq = None
+            req.slot = -1
+            req.registered_blocks = 0
+            req.prefix_hit_tokens = 0
+            req.ready = True
+            req.cold_admission = True
+            self.waiting.put_nowait(req)
+            self._work_event.set()
+            return
+        # completion: the pool now holds the full prompt KV — register,
+        # surface the producer's first token, join the decode batch
+        self.disagg_stream_hidden_s += t_busy
+        self.disagg_stream_exposed_s += t_wait
+        if pc.fallback_monolithic:
+            self.disagg_stream_fallbacks += 1
+        req.precomputed = None
+        if req.cancelled or self.slots[req.slot] is not req:
+            return
+        req.registered_blocks = self.kv_manager.register_full_blocks(
+            req.blocks, plan.seq, already_registered=n_already,
+            tenant=req.tenant or None)
+        tok, logprob = int(pc.first_token), float(pc.first_logprob)
+        req.last_token = tok
+        req.first_token_time = time.monotonic()
+        req.ready = True
+        if self.recorder is not None:
+            self.recorder.rec("first_token", rid=req.rid, pf_seq=None,
+                              tok=tok)
+        self._emit(req, tok, logprob)
+        self._maybe_finish_after_emit(req)
+        self._work_event.set()
+
     def _handoff_and_finish(self, req: EngineRequest, tok: int,
                             logprob: float) -> None:
         """Prefill-worker epilogue: dispatch an on-device gather of the
@@ -2398,6 +2626,26 @@ class EngineCore:
                 await handoff(tok, logprob,
                               {"stacked": stacked, "n_blocks": n_blocks},
                               seq_hashes)
+        elif req.handoff_layered and all(
+                getattr(v, "is_fully_addressable", True)
+                for v in stacked.values()):
+            # streaming layer-wise handoff (llm/kv/stream.py): hand the
+            # worker per-layer fetch handles over the ONE dispatched
+            # gather — layer l+1's device→host fetch overlaps layer l's
+            # wire send, and the decode side scatters as frames land.
+            # Multi-controller gathers keep the monolithic path (their
+            # per-rank shards are assembled whole by fetch_wire).
+            from .block_copy import fetch_wire_layer
+            from ..llm.kv.stream import LayeredHarvest
+            num_layers = next(iter(stacked.values())).shape[0]
+
+            async def send() -> None:
+                harvest = LayeredHarvest(
+                    num_layers=num_layers,
+                    fetch_layer=lambda l: fetch_wire_layer(
+                        stacked, n_blocks, kvh, l),
+                    fetch_all=lambda: fetch_wire(stacked, n_blocks, kvh))
+                await handoff(tok, logprob, harvest, seq_hashes)
         else:
             async def send() -> None:
                 values = await asyncio.to_thread(

@@ -117,7 +117,7 @@ WIRE_EVENTS = frozenset(
     {"prefill", "prefill_sp", "dispatch", "ragged", "verify",
      "hit_transfer", "kv_store", "kv_disk_store", "kv_remote_restore",
      "precomputed_admit", "precomputed_device_admit", "handoff_gather",
-     "prefill_unsupported"})
+     "prefill_unsupported", "kv_layer_stream"})
 _SHUTDOWN = {"ev": "__shutdown__"}
 
 _LEN = struct.Struct(">I")
@@ -307,6 +307,18 @@ def run_follower(core, sock: socket.socket,
                 core.kv, list(ev["targets"]), ev["values"],
                 core.cfg.kv_block_size)
             stats["precomputed"] = stats.get("precomputed", 0) + 1
+            continue
+        if kind == "kv_layer_stream":
+            # streaming layer-wise disagg admission (llm/kv/stream.py):
+            # one event per arrived layer with its (global-head) suffix
+            # values — run the same single-layer scatter the leader ran,
+            # slicing our shard's heads; device order is preserved
+            # because the leader records adjacent to its own scatter
+            from .block_copy import scatter_layer_from_host
+            core.kv = scatter_layer_from_host(
+                core.kv, list(ev["targets"]), int(ev["layer"]),
+                ev["values"], core.cfg.kv_block_size)
+            stats["layer_streams"] = stats.get("layer_streams", 0) + 1
             continue
         if kind == "handoff_gather":
             # prefill-engine follower: run the leader's handoff gather (a

@@ -527,6 +527,20 @@ def replay(core, events: List[dict], fingerprint: bool = False) -> dict:
             written.update(int(b) * bs + o for b in ev["targets"]
                            for o in range(bs))
             fp(("precomputed_admit", ev.get("rid")))
+        if kind == "kv_layer_stream":
+            # streaming layer-wise disagg admission (llm/kv/stream.py):
+            # one event per arrived layer, carrying the already-sliced
+            # suffix values — replay applies the identical single-layer
+            # scatter. Target blocks gain their in-log writer at the
+            # LAST layer, when the live engine marked the slot ready.
+            from .block_copy import scatter_layer_from_host
+            kv = scatter_layer_from_host(kv, list(ev["targets"]),
+                                         int(ev["layer"]), ev["values"],
+                                         bs)
+            if int(ev["layer"]) == int(ev["num_layers"]) - 1:
+                written.update(int(b) * bs + o for b in ev["targets"]
+                               for o in range(bs))
+            fp(("kv_layer_stream", ev.get("rid"), int(ev["layer"])))
         if kind in ("prefill", "prefill_sp"):
             tok, kv = (exec_prefill_event(core, kv, ev)
                        if kind == "prefill"
@@ -702,6 +716,12 @@ def check_log(events: List[dict], block_size: int) -> List[StaleRead]:
                 write(ps, ev["rid"])
         if ev["ev"] == "precomputed_admit":
             # wire-plane disagg scatter writes whole target blocks
+            for b in ev["targets"]:
+                for o in range(block_size):
+                    write(int(b) * block_size + o, ev["rid"])
+        if ev["ev"] == "kv_layer_stream":
+            # streaming disagg scatter: each layer event writes the same
+            # whole target blocks (per-slot ownership is layer-agnostic)
             for b in ev["targets"]:
                 for o in range(block_size):
                     write(int(b) * block_size + o, ev["rid"])

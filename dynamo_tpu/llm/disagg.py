@@ -27,6 +27,7 @@ import asyncio
 import dataclasses
 import json
 import logging
+import os
 from typing import Optional
 
 from ..engine.core import EngineCore, FINISH_SENTINEL, EngineRequest
@@ -37,8 +38,12 @@ from ..runtime.kvstore import WatchEventType
 from ..runtime.tcp import open_stream_sender
 from .engines.jax_engine import JaxEngine
 from .kv.blocks import TokenBlockSequence
+from .kv.stream import (LAYER_KIND, MANIFEST_KIND, LayeredHarvest,
+                        LayerStreamManifest, LayerStreamPayload,
+                        decode_layer_frame, send_layer_stream,
+                        send_monolithic_payload)
 from .protocols.disagg import (KvPayload, RemotePrefillRequest,
-                               decode_kv_payload, encode_kv_payload)
+                               decode_kv_payload)
 
 logger = logging.getLogger("dynamo_tpu.llm.disagg")
 
@@ -170,7 +175,8 @@ class DisaggEngine(JaxEngine):
                  disagg_router: DisaggregatedRouter,
                  queue: Optional[PrefillQueue] = None,
                  prefill_timeout: float = 30.0,
-                 device_plane: bool = True):
+                 device_plane: bool = True,
+                 layer_stream: Optional[bool] = None):
         super().__init__(core)
         self.runtime = runtime
         self.disagg_router = disagg_router
@@ -179,11 +185,23 @@ class DisaggEngine(JaxEngine):
         # advertise the in-process ICI bulk plane (kv_transport) to prefill
         # workers; False forces the TCP wire path even in-process
         self.device_plane = device_plane
+        # streaming layer-wise wire handoff (llm/kv/stream.py): announce
+        # layer-stream consumption to prefill workers so the TTFT-serial
+        # transfer pipelines per layer. Default ON; DYN_DISAGG_LAYER_
+        # STREAM=0 forces the monolithic payload (ops escape hatch + the
+        # bench's A/B lever).
+        self.layer_stream = (layer_stream if layer_stream is not None
+                             else os.environ.get(
+                                 "DYN_DISAGG_LAYER_STREAM", "1") != "0")
         # observability
         self.remote_prefills = 0
         self.local_prefills = 0
         self.remote_failures = 0
         self.device_transfers = 0   # handoffs that rode the ICI bulk plane
+        self.stream_transfers = 0   # handoffs that arrived layer-streamed
+        # layer-stream drain pumps, one per in-flight streamed handoff —
+        # each owns its receiver's cleanup (see _spawn_stream_drain)
+        self._drain_tasks: set = set()
 
     def _estimate_prefix_hit(self, req: EngineRequest) -> int:
         """Hold-free device-tier prefix estimate (in tokens). The hash chain
@@ -231,7 +249,9 @@ class DisaggEngine(JaxEngine):
             trace=current_wire_context(),
             deadline_ms=(req.ctx.remaining_ms()
                          if req.ctx is not None
-                         and hasattr(req.ctx, "remaining_ms") else None))
+                         and hasattr(req.ctx, "remaining_ms") else None),
+            layer_stream=self.layer_stream)
+        handed_off = False
         try:
             await self.queue.enqueue(rpr)
             prologue = await rx.wait_connected(timeout=self.prefill_timeout)
@@ -250,6 +270,18 @@ class DisaggEngine(JaxEngine):
                     continue
                 if f.kind == FrameKind.DATA:
                     if f.header:
+                        h = json.loads(f.header)
+                        if h.get("stream") == MANIFEST_KIND:
+                            # streaming layer-wise handoff: admit against
+                            # the manifest NOW — a drain task keeps
+                            # consuming layer frames while the engine
+                            # progressively scatters (llm/kv/stream.py)
+                            payload = LayerStreamPayload(
+                                LayerStreamManifest.from_header(h))
+                            self._spawn_stream_drain(req.rid, rx, payload)
+                            handed_off = True
+                            self.stream_transfers += 1
+                            return payload
                         meta_header = f.header
                     chunks.append(f.data)
                 elif f.kind == FrameKind.ERROR:
@@ -272,15 +304,72 @@ class DisaggEngine(JaxEngine):
                            "falling back to local", req.rid, e)
             return None
         finally:
-            bridge().cancel(req.rid)
-            rx.close()
-            rt.tcp.unregister(rx.stream_id)
+            if not handed_off:
+                bridge().cancel(req.rid)
+                rx.close()
+                rt.tcp.unregister(rx.stream_id)
+
+    def _spawn_stream_drain(self, rid: str, rx, payload) -> None:
+        """Stand up the frame→payload pump for one layer stream; stream
+        cleanup (bridge rendezvous, receiver, tcp registration) moves
+        here from _remote_prefill's finally — the stream outlives that
+        call by design."""
+        from .kv_transport import bridge
+        rt = self.runtime
+
+        async def drain() -> None:
+            from ..runtime.codec import FrameKind
+            mono_header: Optional[bytes] = None
+            chunks: list = []
+            try:
+                while True:
+                    f = await rx.next_frame(timeout=self.prefill_timeout)
+                    if f is None:
+                        continue
+                    if f.kind == FrameKind.DATA:
+                        h = (json.loads(f.header) if f.header else None)
+                        if h is not None and h.get("stream") == LAYER_KIND:
+                            payload.put_layer(
+                                int(h["layer"]),
+                                decode_layer_frame(payload.manifest,
+                                                   f.data))
+                        else:
+                            # the producer tore a frame and degraded to
+                            # the monolithic payload on this same stream
+                            # (stream.py rung 1) — accumulate its chunks
+                            if f.header:
+                                mono_header = f.header
+                            chunks.append(f.data)
+                    elif f.kind == FrameKind.ERROR:
+                        payload.fail(
+                            f.header_json().get("error", "remote"))
+                        return
+                    elif f.kind == FrameKind.SENTINEL:
+                        if mono_header is not None:
+                            mono = decode_kv_payload(mono_header,
+                                                     b"".join(chunks))
+                            payload.put_all(mono.values)
+                        payload.finish()
+                        return
+            except Exception as e:  # noqa: BLE001 — dead peer/short frame
+                # → the engine's cold-recompute rung, never an error
+                payload.fail(str(e))
+            finally:
+                bridge().cancel(rid)
+                rx.close()
+                rt.tcp.unregister(rx.stream_id)
+
+        t = asyncio.get_running_loop().create_task(
+            drain(), name=f"kv-stream-drain-{rid}")
+        self._drain_tasks.add(t)
+        t.add_done_callback(self._drain_tasks.discard)
 
     def stats(self) -> dict:
         return {"remote_prefills": self.remote_prefills,
                 "local_prefills": self.local_prefills,
                 "remote_failures": self.remote_failures,
                 "device_transfers": self.device_transfers,
+                "stream_transfers": self.stream_transfers,
                 "max_local_prefill_length":
                     self.disagg_router.max_local_prefill_length}
 
@@ -309,6 +398,8 @@ class PrefillWorker:
         self.prefills_done = 0
         self.prefills_failed = 0
         self.device_handoffs = 0    # handoffs that rode the ICI bulk plane
+        self.stream_handoffs = 0    # handoffs sent as per-layer streams
+        self.stream_fallbacks = 0   # streams degraded to monolithic mid-way
 
     async def start(self) -> "PrefillWorker":
         self._stopping = False
@@ -382,16 +473,24 @@ class PrefillWorker:
 
         async def handoff_wire(tok, logprob, values, seq_hashes) -> None:
             try:
-                payload = KvPayload(
-                    request_id=rpr.request_id, first_token=tok,
-                    first_logprob=logprob, seq_hashes=seq_hashes,
-                    values=values)
-                header, data = encode_kv_payload(payload)
-                from .protocols.disagg import KV_CHUNK_BYTES
-                await sender.send(data[:KV_CHUNK_BYTES], header=header)
-                for off in range(KV_CHUNK_BYTES, len(data), KV_CHUNK_BYTES):
-                    await sender.send(data[off:off + KV_CHUNK_BYTES])
-                await sender.finish()
+                if isinstance(values, LayeredHarvest):
+                    # streaming layer-wise handoff: one frame per layer,
+                    # next layer's gather overlapped with this frame's
+                    # send; degrades to the monolithic payload on this
+                    # same stream if a frame tears (llm/kv/stream.py)
+                    res = await send_layer_stream(
+                        sender, rpr.request_id, tok, logprob, seq_hashes,
+                        values)
+                    self.stream_handoffs += 1
+                    if res["fallback"]:
+                        self.stream_fallbacks += 1
+                else:
+                    payload = KvPayload(
+                        request_id=rpr.request_id, first_token=tok,
+                        first_logprob=logprob, seq_hashes=seq_hashes,
+                        values=values)
+                    await send_monolithic_payload(sender, payload)
+                    await sender.finish()
                 if not sent.done():
                     sent.set_result(True)
             except Exception as e:  # noqa: BLE001
@@ -447,7 +546,8 @@ class PrefillWorker:
             sampling=SlotSampling(**rpr.sampling), max_new_tokens=1,
             eos_ids=frozenset(), ctx=ctx,
             handoff=handoff_device if use_device else handoff_wire,
-            handoff_device=use_device)
+            handoff_device=use_device,
+            handoff_layered=(rpr.layer_stream and not use_device))
         await self.core.submit(req)
         try:
             # drain the engine's (token, finish) signals, then await the send
@@ -482,6 +582,8 @@ class PrefillWorker:
         return {"prefills_done": self.prefills_done,
                 "prefills_failed": self.prefills_failed,
                 "device_handoffs": self.device_handoffs,
+                "stream_handoffs": self.stream_handoffs,
+                "stream_fallbacks": self.stream_fallbacks,
                 "inflight": len(self._inflight)}
 
     async def drain(self) -> None:

@@ -411,6 +411,21 @@ class AdmissionGate:
         return link.rtt_s + n_blocks * self.bytes_per_block / (link.gbps
                                                                * 1e9)
 
+    def modeled_fetch_overlap_s(self, n_blocks: int, link: LinkStats,
+                                n_layers: int,
+                                hidden_compute_s: float = 0.0) -> float:
+        """Overlap-aware fetch model (llm/kv/stream.py): when the bytes
+        arrive as a per-layer stream the consumer scatters layer l while
+        layer l+1 is on the wire, so only max(serial/L, serial − hidden)
+        of the transfer is EXPOSED on the critical path. n_layers ≤ 1
+        (monolithic payload) degrades to modeled_fetch_s exactly."""
+        if link.gbps <= 0:
+            return float("inf")
+        from .stream import exposed_transfer_s
+        serial = n_blocks * self.bytes_per_block / (link.gbps * 1e9)
+        return link.rtt_s + exposed_transfer_s(serial, n_layers,
+                                               hidden_compute_s)
+
     def modeled_recompute_s(self, n_blocks: int) -> float:
         rate = self.prefill_tok_per_s()
         if rate <= 0:
@@ -443,6 +458,26 @@ class AdmissionGate:
             return float("inf")
         per_block_gain = (self.block_size / rate
                           - self.bytes_per_block / (link.gbps * 1e9))
+        if per_block_gain <= 0:
+            return float("inf")
+        return link.rtt_s / per_block_gain
+
+    def crossover_blocks_overlap(self, link: LinkStats,
+                                 n_layers: int) -> float:
+        """crossover_blocks under the streaming bound: with L layers
+        pipelined, the exposed per-block transfer is 1/L of the serial
+        cost (the other L−1 frames hide under the consumer's scatter),
+        so the fetch starts paying at a SHALLOWER depth. n_layers ≤ 1
+        degrades to crossover_blocks exactly."""
+        rate = self.prefill_tok_per_s()
+        if rate <= 0:
+            return 0.0                   # unknown rate: everything admits
+        if link.gbps <= 0:
+            return float("inf")
+        layers = max(int(n_layers), 1)
+        per_block_gain = (self.block_size / rate
+                          - self.bytes_per_block / (link.gbps * 1e9)
+                          / layers)
         if per_block_gain <= 0:
             return float("inf")
         return link.rtt_s / per_block_gain

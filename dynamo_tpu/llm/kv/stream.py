@@ -1,0 +1,321 @@
+"""Streaming layer-wise KV handoff plane (FlowKV, PAPERS.md).
+
+The monolithic disagg handoff (llm/disagg.py ``handoff_wire``) ships the
+whole prompt's KV in one chunked payload AFTER the prefill-side gather
+completes, so the entire device→host fetch + DCN transfer + decode-side
+scatter sits serially on TTFT. This module pipelines that tail per layer:
+
+- **producer** (PrefillWorker): the prefill engine's gather output is
+  wrapped as a :class:`LayeredHarvest` — per-layer host fetches off the
+  one dispatched device gather. :func:`send_layer_stream` announces the
+  geometry up front with a :class:`LayerStreamManifest` frame, then chains
+  one DATA frame per layer on the SAME dial-back stream the monolithic
+  handoff uses (native dataplane when available, JSON fallback
+  byte-identical — the frames are opaque header+payload pairs either way).
+  Layer ``l+1``'s device→host fetch overlaps layer ``l``'s send.
+- **consumer** (DisaggEngine → EngineCore): frames land in a
+  :class:`LayerStreamPayload`; the decode engine admits the request
+  immediately (slot reserved, not decode-visible) and scatters each layer
+  into the paged pool as it arrives via the existing off-thread prep
+  (engine/core.py ``_stream_onboard``), recorded per layer as the
+  ``kv_layer_stream`` wire event. The request becomes decode-ready the
+  tick the last layer lands.
+- **fallback ladder** (never an error):
+  1. a torn mid-stream layer frame (``disagg.layer_stream`` failpoint)
+     degrades to the monolithic payload ON THE SAME STREAM — the consumer
+     fills every remaining layer from it, bit-exactly;
+  2. a dead stream / short frame / peer death fails the payload — the
+     decode engine releases the half-onboarded blocks and re-admits COLD
+     (local recompute, engine/core.py ``_stream_onboard`` failure path);
+  3. no stream at all (old peer, device plane, multi-controller gather)
+     is simply the monolithic handoff, unchanged.
+
+Pricing: :func:`exposed_transfer_s` is the overlap cost model both
+``AdmissionGate.modeled_fetch_overlap_s`` (llm/kv/fabric.py) and the
+router's ``scoring.network_adjusted_overlap`` use — a transfer streamed
+over ``n_layers`` frames and overlapped with ``hidden_s`` of compute
+exposes only ``max(T / n_layers, T - hidden_s)`` of its serial cost
+``T`` on the critical path (the first frame can't overlap anything that
+hasn't started; compute can hide at most ``hidden_s`` of the rest).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ...runtime import faults
+from ..protocols.disagg import (KV_CHUNK_BYTES, KvPayload,
+                                encode_kv_payload)
+
+logger = logging.getLogger("dynamo_tpu.llm.kv.stream")
+
+__all__ = ["LayerStreamManifest", "LayeredHarvest", "LayerStreamPayload",
+           "MANIFEST_KIND", "LAYER_KIND", "send_layer_stream",
+           "send_monolithic_payload", "decode_layer_frame",
+           "exposed_transfer_s"]
+
+# header "stream" discriminators — a consumer that sees neither treats
+# the frame as the monolithic KV payload (protocols/disagg.py)
+MANIFEST_KIND = "kv_layer_manifest"
+LAYER_KIND = "kv_layer"
+
+
+@dataclasses.dataclass
+class LayerStreamManifest:
+    """First frame of a layer stream: everything the consumer needs to
+    admit the request and decode every later frame — the first token,
+    the block hashes, and the per-layer array geometry. Wire dataclass
+    (DL004-locked): evolve append-only with defaulted fields."""
+
+    request_id: str
+    first_token: int
+    first_logprob: float
+    seq_hashes: List[int]          # chained hashes of the FULL blocks
+    num_layers: int
+    shape: List[int]               # per-layer wire shape [H, n, bs, D]
+    dtype: str                     # numpy dtype name (bf16 via ml_dtypes)
+    keys: List[str]                # sorted pool key set ({"k","v"}/{"kv"})
+
+    def to_header(self) -> bytes:
+        d = dataclasses.asdict(self)
+        d["stream"] = MANIFEST_KIND
+        return json.dumps(d).encode()
+
+    @classmethod
+    def from_header(cls, h: dict) -> "LayerStreamManifest":
+        return cls(request_id=h["request_id"],
+                   first_token=int(h["first_token"]),
+                   first_logprob=float(h["first_logprob"]),
+                   seq_hashes=[int(x) for x in h["seq_hashes"]],
+                   num_layers=int(h["num_layers"]),
+                   shape=[int(x) for x in h["shape"]],
+                   dtype=str(h["dtype"]), keys=list(h["keys"]))
+
+
+@dataclasses.dataclass
+class LayeredHarvest:
+    """Prefill-side handle over ONE dispatched device gather: per-layer
+    host fetches plus the whole-stack fetch the fallback ladder needs.
+    Produced by EngineCore._handoff_and_finish when the decode side
+    negotiated layer streaming; consumed by send_layer_stream (the
+    callables run off-thread — they are device→host fetches)."""
+
+    num_layers: int
+    fetch_layer: Callable[[int], Dict[str, np.ndarray]]  # {"k": [H,n,bs,D]}
+    fetch_all: Callable[[], Dict[str, np.ndarray]]       # {"k": [L,H,n,bs,D]}
+
+
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_layer_frame(layer: int,
+                       values: Dict[str, np.ndarray],
+                       keys: List[str]) -> tuple:
+    """→ (header, data) for one per-layer DATA frame. Byte layout matches
+    the monolithic payload's per-key concatenation, restricted to one
+    layer — the consumer's reassembled stack is bit-identical to a
+    decoded monolithic payload."""
+    header = json.dumps({"stream": LAYER_KIND, "layer": layer}).encode()
+    return header, b"".join(np.ascontiguousarray(values[k]).tobytes()
+                            for k in keys)
+
+
+def decode_layer_frame(manifest: LayerStreamManifest,
+                       data: bytes) -> Dict[str, np.ndarray]:
+    """One layer's bytes → {key: [H, n, bs, D]}. A short/long payload
+    raises ValueError — the consumer's cold-recompute rung, never a
+    silently-corrupt scatter."""
+    shape = tuple(manifest.shape)
+    dt = _np_dtype(manifest.dtype)
+    nbytes = int(np.prod(shape)) * dt.itemsize
+    if len(data) != nbytes * len(manifest.keys):
+        raise ValueError(
+            f"short layer frame: {len(data)} bytes, expected "
+            f"{nbytes * len(manifest.keys)}")
+    return {key: np.frombuffer(
+        data[i * nbytes:(i + 1) * nbytes], dtype=dt).reshape(shape)
+        for i, key in enumerate(manifest.keys)}
+
+
+class LayerStreamPayload:
+    """Consumer-side assembler: the decode engine admits against this the
+    moment the manifest lands; per-layer values fill in as frames arrive.
+
+    Duck-compatible with KvPayload where admission needs it
+    (request_id / first_token / first_logprob / seq_hashes); the engine's
+    progressive onboard awaits :meth:`wait_layer` instead of reading
+    ``.values``."""
+
+    def __init__(self, manifest: LayerStreamManifest):
+        self.manifest = manifest
+        self.request_id = manifest.request_id
+        self.first_token = manifest.first_token
+        self.first_logprob = manifest.first_logprob
+        self.seq_hashes = list(manifest.seq_hashes)
+        self.num_layers = manifest.num_layers
+        self._layers: Dict[int, Dict[str, np.ndarray]] = {}
+        self._event = asyncio.Event()
+        self._error: Optional[str] = None
+        self.fallback_monolithic = False   # filled from a monolithic tail
+
+    @property
+    def complete(self) -> bool:
+        return len(self._layers) >= self.num_layers
+
+    @property
+    def failed(self) -> bool:
+        return self._error is not None
+
+    @property
+    def values(self) -> Dict[str, np.ndarray]:
+        """Whole-stack view ({key: [L, H, n, bs, D]}) — valid only once
+        complete; lets a fully-arrived payload admit through the
+        monolithic precomputed path bit-identically."""
+        if not self.complete:
+            raise RuntimeError("layer stream incomplete")
+        return {key: np.stack([self._layers[l][key]
+                               for l in range(self.num_layers)])
+                for key in self.manifest.keys}
+
+    def put_layer(self, layer: int, vals: Dict[str, np.ndarray]) -> None:
+        if not (0 <= layer < self.num_layers):
+            raise ValueError(f"layer {layer} outside [0, {self.num_layers})")
+        self._layers[layer] = vals
+        self._event.set()
+
+    def put_all(self, values: Dict[str, np.ndarray]) -> None:
+        """Monolithic-fallback fill: a whole-stack payload arrived on the
+        stream (the producer hit a torn frame) — every layer not yet
+        delivered is sliced out of it."""
+        self.fallback_monolithic = True
+        for l in range(self.num_layers):
+            if l not in self._layers:
+                self._layers[l] = {k: v[l] for k, v in values.items()}
+        self._event.set()
+
+    def fail(self, msg: str) -> None:
+        if self._error is None:
+            self._error = msg
+        self._event.set()
+
+    def finish(self) -> None:
+        """Stream ended: an incomplete payload is a failure (rung 2)."""
+        if not self.complete:
+            self.fail(f"layer stream ended at {len(self._layers)}/"
+                      f"{self.num_layers} layers")
+
+    async def wait_layer(self, layer: int) -> Dict[str, np.ndarray]:
+        """Block until ``layer`` is available (or the stream failed)."""
+        while True:
+            if self._error is not None:
+                raise RuntimeError(
+                    f"kv layer stream failed: {self._error}")
+            if layer in self._layers:
+                return self._layers[layer]
+            self._event.clear()
+            await self._event.wait()
+
+
+async def send_monolithic_payload(sender, payload: KvPayload) -> None:
+    """The whole-stack payload as chunked DATA frames (the pre-streaming
+    wire handoff, kept as the shared fallback rung). Does NOT finish the
+    stream — the caller owns the SENTINEL."""
+    header, data = encode_kv_payload(payload)
+    await sender.send(data[:KV_CHUNK_BYTES], header=header)
+    for off in range(KV_CHUNK_BYTES, len(data), KV_CHUNK_BYTES):
+        await sender.send(data[off:off + KV_CHUNK_BYTES])
+
+
+async def send_layer_stream(sender, request_id: str, first_token: int,
+                            first_logprob: float, seq_hashes: List[int],
+                            harvest: LayeredHarvest) -> dict:
+    """Producer driver: manifest frame, then one DATA frame per layer,
+    pipelining layer ``l+1``'s device→host fetch behind layer ``l``'s
+    send. A torn frame (``disagg.layer_stream`` failpoint — the site
+    models the wire tearing mid-stream) degrades to the monolithic
+    payload on the same stream; the consumer never sees an error.
+
+    Returns {"layers": n_streamed, "fallback": bool} for the worker's
+    stats."""
+    first = await asyncio.to_thread(harvest.fetch_layer, 0)
+    keys = sorted(first)
+    sample = first[keys[0]]
+    manifest = LayerStreamManifest(
+        request_id=request_id, first_token=first_token,
+        first_logprob=first_logprob, seq_hashes=list(seq_hashes),
+        num_layers=harvest.num_layers, shape=list(sample.shape),
+        dtype=sample.dtype.name, keys=keys)
+    await sender.send(b"", header=manifest.to_header())
+
+    streamed = 0
+    vals: Optional[Dict[str, np.ndarray]] = first
+    prefetch: Optional[asyncio.Task] = None
+    try:
+        for layer in range(harvest.num_layers):
+            if vals is None:
+                vals = await prefetch
+                prefetch = None
+            if layer + 1 < harvest.num_layers:
+                prefetch = asyncio.get_running_loop().create_task(
+                    asyncio.to_thread(harvest.fetch_layer, layer + 1))
+            header, data = encode_layer_frame(layer, vals, keys)
+            expected = len(data)
+            data = faults.mangle("disagg.layer_stream", data)
+            if len(data) != expected:
+                # rung 1: the frame tore mid-stream — degrade to the
+                # monolithic payload on this same stream (byte-identical
+                # to the pre-streaming handoff; the consumer fills every
+                # remaining layer from it)
+                logger.warning(
+                    "layer stream for %s torn at layer %d/%d — "
+                    "degrading to the monolithic handoff", request_id,
+                    layer, harvest.num_layers)
+                if prefetch is not None:
+                    prefetch.cancel()
+                    prefetch = None
+                values = await asyncio.to_thread(harvest.fetch_all)
+                await send_monolithic_payload(sender, KvPayload(
+                    request_id=request_id, first_token=first_token,
+                    first_logprob=first_logprob,
+                    seq_hashes=list(seq_hashes), values=values))
+                await sender.finish()
+                return {"layers": streamed, "fallback": True}
+            await sender.send(data, header=header)
+            streamed += 1
+            vals = None
+        await sender.finish()
+        return {"layers": streamed, "fallback": False}
+    finally:
+        if prefetch is not None:
+            prefetch.cancel()
+
+
+def exposed_transfer_s(transfer_s: float, n_layers: int,
+                       hidden_s: float = 0.0) -> float:
+    """Critical-path cost of a transfer of serial duration ``transfer_s``
+    streamed as ``n_layers`` frames with ``hidden_s`` seconds of
+    overlappable compute behind it.
+
+    - The consumer can't act before the FIRST frame lands: at least
+      ``transfer_s / n_layers`` is always exposed.
+    - Compute hides at most ``hidden_s`` of the rest:
+      ``transfer_s - hidden_s`` stays exposed when compute runs short.
+
+    Monolithic transfers are the ``n_layers <= 1, hidden_s = 0`` case:
+    exposed == transfer_s exactly, so gates pricing with this model are
+    backwards-compatible by construction."""
+    if transfer_s <= 0.0:
+        return 0.0
+    n = max(int(n_layers), 1)
+    return max(transfer_s / n, transfer_s - max(hidden_s, 0.0))

@@ -157,6 +157,22 @@ class ForwardPassMetrics:
     # (components/metrics.py exports one series per tenant). Empty on
     # old payloads / untenanted engines.
     tenant_stats: dict = dataclasses.field(default_factory=dict)
+    # streaming layer-wise KV handoff round 15 (appended — DL004
+    # append-only evolution; llm/kv/stream.py, docs/kv_fabric.md): the
+    # nv_llm_disagg_stream_* gauge feed plus the router's overlap-credit
+    # input. Layers this decode worker progressively scattered; stream
+    # admissions that degraded (torn frame → monolithic fill, dead
+    # stream → cold recompute); the fraction of stream-onboard wall time
+    # the engine spent doing hidden work (prep/scatter of arrived
+    # layers) rather than exposed waiting on the wire; and the MEASURED
+    # streaming depth — the model's layer count once a streamed
+    # admission has proven the plane live, 0 before (scoring.
+    # network_adjusted_overlap prices the overlapped transfer with it).
+    # Zeros on old payloads / non-streaming engines.
+    disagg_stream_layers_total: int = 0
+    disagg_stream_fallbacks_total: int = 0
+    disagg_stream_overlap_ratio: float = 0.0
+    disagg_stream_layers: int = 0
 
     def to_dict(self) -> dict:
         # every field is a scalar; dataclasses.asdict would deep-copy

@@ -79,6 +79,23 @@ def modeled_transfer_s(n_blocks: int, bytes_per_block: int, gbps: float,
     return rtt_s + n_blocks * bytes_per_block / (gbps * 1e9)
 
 
+def modeled_overlap_transfer_s(n_blocks: int, bytes_per_block: int,
+                               gbps: float, rtt_s: float, n_layers: int,
+                               hidden_s: float = 0.0) -> float:
+    """Modeled EXPOSED wall time of the same move when the receiver
+    consumes it as a per-layer stream (llm/kv/stream.py): scatter of
+    layer l overlaps the wire time of layer l+1, so only
+    max(serial/L, serial − hidden) sits on the critical path. A worker
+    that published ``disagg_stream_layers == 0`` (monolithic consumer /
+    old payload) is priced via n_layers ≤ 1, which degrades to
+    modeled_transfer_s exactly."""
+    if gbps <= 0:
+        return float("inf")
+    from ..kv.stream import exposed_transfer_s
+    serial = n_blocks * bytes_per_block / (gbps * 1e9)
+    return rtt_s + exposed_transfer_s(serial, n_layers, hidden_s)
+
+
 def modeled_recompute_s(n_blocks: int, block_size: int,
                         prefill_tok_per_s: float) -> float:
     """Modeled wall time to re-prefill ``n_blocks`` worth of tokens.
@@ -127,9 +144,15 @@ def network_adjusted_overlap(weighted: float, own_depth: int,
     if extra > 0 and m.remote_link_gbps > 0 and m.kv_bytes_per_block > 0:
         # transfer_pays inlined so the t/r the saving needs aren't
         # modeled twice — this runs once per candidate per routing
-        # decision, the router's hottest loop at fleet scale
-        t = modeled_transfer_s(extra, m.kv_bytes_per_block,
-                               m.remote_link_gbps, m.remote_link_rtt_s)
+        # decision, the router's hottest loop at fleet scale. A
+        # candidate whose streaming plane has proven live (it published
+        # a MEASURED disagg_stream_layers > 0) is priced at the exposed
+        # overlapped transfer, not the serial one — streaming consumers
+        # earn more fetch credit because their fetch costs less.
+        layers = max(int(getattr(m, "disagg_stream_layers", 0) or 0), 1)
+        t = modeled_overlap_transfer_s(extra, m.kv_bytes_per_block,
+                                       m.remote_link_gbps,
+                                       m.remote_link_rtt_s, layers)
         r = modeled_recompute_s(extra, block_size, m.prefill_tok_per_s)
         if t < r:
             saving = 1.0 if math.isinf(r) else max(1.0 - t / r, 0.0)
@@ -162,7 +185,13 @@ def crossover_tokens(m: dict) -> Optional[float]:
     rtt = float(m.get("remote_link_rtt_s", 0) or 0)
     if rate <= 0 or gbps <= 0 or bpb <= 0 or bs <= 0:
         return None
-    per_tok_gain = 1.0 / rate - bpb / (bs * gbps * 1e9)
+    # a worker whose streaming handoff plane has proven live publishes
+    # its measured pipeline depth (disagg_stream_layers); its exposed
+    # per-token transfer is 1/L of the serial cost (llm/kv/stream.py),
+    # so its crossover sits shallower. 0 (old payload / monolithic
+    # consumer) prices serially — identical to the pre-streaming model.
+    layers = max(int(m.get("disagg_stream_layers", 0) or 0), 1)
+    per_tok_gain = 1.0 / rate - bpb / (bs * gbps * 1e9) / layers
     if per_tok_gain <= 0:
         return math.inf
     return rtt / per_tok_gain

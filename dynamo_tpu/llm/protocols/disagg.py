@@ -62,6 +62,14 @@ class RemotePrefillRequest:
     # and drops the job unstarted when the budget is already gone (the
     # decode side has long since cancelled). None on old senders.
     deadline_ms: Optional[float] = None
+    # streaming layer-wise KV handoff (llm/kv/stream.py): the decode
+    # side can consume per-layer DATA frames (manifest + one frame per
+    # layer) on the wire plane — the prefill worker streams each layer
+    # as it fetches instead of one monolithic payload. False on old
+    # senders (and ignored on the device plane, whose ICI bulk deposit
+    # never serializes at all); the producer may still degrade to the
+    # monolithic payload mid-stream (torn frame), byte-identically.
+    layer_stream: bool = False
 
     def to_json(self) -> bytes:
         return json.dumps(dataclasses.asdict(self)).encode()

@@ -112,6 +112,9 @@ SITES: Dict[str, str] = {
         "engine/core.py — off-thread tier-hit onboard prep",
     "engine.harvest":
         "engine/core.py — post-dispatch harvest (loop-fatal boundary)",
+    "disagg.layer_stream":
+        "llm/kv/stream.py — one per-layer KV frame of a streamed handoff "
+        "(torn mid-stream)",
 }
 
 

@@ -86,6 +86,12 @@ class FleetConfig:
     # waiting queues (WDRR + QoS) and per-worker quota-preferred
     # eviction — the REAL policy classes under the determinism gate.
     tenant_policies: Optional[Dict[str, dict]] = None
+    # streaming layer-wise KV handoff (llm/kv/stream.py): > 0 prices the
+    # disagg P→D handoff at the EXPOSED overlapped transfer for that
+    # pipeline depth (AdmissionGate.modeled_fetch_overlap_s) instead of
+    # the serial cost — the sim's lever for predicting what streaming
+    # buys a fleet before turning it on. 0 = monolithic (unchanged).
+    stream_layers: int = 0
 
 
 class SimLatencyCollector:
@@ -624,7 +630,10 @@ class SimFleet:
             wid = live[0]
             w = self.workers[wid]
         n_blocks = len(req.hashes)
-        handoff_s = w.gate.modeled_fetch_s(n_blocks, w.link)
+        handoff_s = (w.gate.modeled_fetch_overlap_s(
+            n_blocks, w.link, self.cfg.stream_layers)
+            if self.cfg.stream_layers > 0
+            else w.gate.modeled_fetch_s(n_blocks, w.link))
         dreq = SimRequest(req.spec, req.hashes, new_tokens=0,
                           fetch_s=handoff_s, fetched_blocks=n_blocks,
                           hit_blocks=req.hit_blocks,

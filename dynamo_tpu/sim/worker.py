@@ -488,6 +488,11 @@ class SimWorker:
         m.kv_block_size = self.block_size
         m.prefill_tok_per_s = self.estimator.rate()
         m.remote_admission_rejects_total = self.gate.rejects_total
+        if self.fleet.cfg.stream_layers > 0:
+            # streaming handoff plane on: publish the pipeline depth so
+            # the REAL scoring path (network_adjusted_overlap /
+            # crossover_tokens) prices this worker's fetches overlapped
+            m.disagg_stream_layers = self.fleet.cfg.stream_layers
         if self.ledger is not None:
             # per-tenant residency (the nv_llm_tenant_kv_blocks shape);
             # admission/throttle counters live fleet-side in the sim

@@ -134,6 +134,47 @@ def test_bench_kv_remote_mode():
         f"{kr['dataplane_fetch_ms']}ms vs {kr['json_fetch_ms']}ms")
 
 
+@pytest.mark.kvfabric
+def test_bench_disagg_stream_mode():
+    """--disagg-stream rides a bench run (ISSUE 18 satellite): the result
+    line must carry the `disagg_stream` provenance dict — the monolithic
+    vs layer-streamed P→D handoff TTFT A/B over a REAL loopback TCP
+    dial-back, bit-exact both legs, with the measured hidden/exposed
+    transfer split reported next to the pricing model's prediction."""
+    if os.environ.get("CI_SKIP_SLOW"):
+        pytest.skip("slow smoke")
+    r = _run(
+        [sys.executable, "bench.py", "--disagg-stream"],
+        {"BENCH_FORCE_CPU": "1", "BENCH_MODEL": "tiny", "BENCH_BATCH": "2",
+         "BENCH_STEPS": "4", "BENCH_PROMPT": "8", "BENCH_HARVEST": "2",
+         "BENCH_QUANT": "none", "BENCH_DEVICE": "0",
+         "BENCH_DISAGG_STREAM_PROMPT": "64",
+         # min-of-5 per leg: the TTFT ordering gate must not flake on a
+         # noisy CI box (one slow outlier iter would flip a min-of-3)
+         "BENCH_DISAGG_STREAM_ITERS": "5"})
+    assert r.returncode == 0, f"bench.py crashed:\n{r.stderr[-4000:]}"
+    out = json.loads([l for l in r.stdout.strip().splitlines()
+                      if l.startswith("{")][-1])
+    assert "error" not in out, f"bench fell back instead of running: {out}"
+    ds = out.get("disagg_stream")
+    assert ds, f"no disagg_stream provenance in the result: {out}"
+    # both legs must produce the same greedy tokens or the TTFT A/B is
+    # comparing diverged programs
+    assert ds["tokens_bit_exact"] is True
+    assert ds["stream_admits"] >= 1
+    assert ds["stream_fallbacks"] == 0, (
+        "the streamed leg degraded to monolithic mid-bench — the A/B "
+        f"measured a mixed path: {ds}")
+    # the acceptance gate: overlap must actually hide transfer behind
+    # prefill compute, and streamed TTFT must not regress the handoff
+    assert ds["transfer_hidden_ms"] > 0, ds
+    assert ds["mono_ttft_ms"] > 0 and ds["stream_ttft_ms"] > 0
+    assert ds["stream_ttft_ms"] <= ds["mono_ttft_ms"], (
+        f"streamed handoff slower than monolithic: "
+        f"{ds['stream_ttft_ms']}ms vs {ds['mono_ttft_ms']}ms")
+    assert ds["layers"] >= 2 and ds["predicted_exposed_ms"] >= 0
+
+
 @pytest.mark.kvfrag
 def test_bench_kv_frag_mode():
     """--kv-frag rides a bench run (ISSUE 5 satellite): the result line

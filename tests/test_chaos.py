@@ -583,6 +583,56 @@ async def test_prefill_publish_failpoint_sheds_blocks(tmp_path):
         await core.stop()
 
 
+async def test_layer_stream_torn_frame_degrades_to_monolithic():
+    """A torn per-layer frame mid-stream ("disagg.layer_stream", rung 1
+    of the fallback ladder) degrades to the monolithic payload ON THE
+    SAME STREAM: the decode side fills the remaining layers from it and
+    the served tokens are byte-identical to an untorn run — never an
+    error, never a cold recompute."""
+    from dynamo_tpu.llm.disagg import (DisaggEngine, DisaggregatedRouter,
+                                       PrefillWorker)
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from tests.test_disagg import collect_tokens, make_core, make_request
+
+    rng = np.random.default_rng(31)
+    prompt = [int(t) for t in rng.integers(2, 120, size=37)]
+
+    async def wire_run(rid):
+        rt = DistributedRuntime.in_process()
+        prefill_core = make_core()
+        decode_core = make_core()
+        router = DisaggregatedRouter(rt, "tiny", max_local_prefill_length=0,
+                                     conditional=False)
+        engine = DisaggEngine(decode_core, rt, router, device_plane=False,
+                              layer_stream=True)
+        worker = await PrefillWorker(prefill_core, rt).start()
+        try:
+            got = await collect_tokens(
+                await engine.generate(make_request(prompt, rid=rid)))
+            assert engine.remote_failures == 0
+            return got, worker, decode_core
+        finally:
+            await worker.stop()
+            await prefill_core.stop()
+            await decode_core.stop()
+            await rt.shutdown()
+
+    want, _w, _c = await wire_run("untorn")
+    faults.arm("disagg.layer_stream", "1-in-2,torn")
+    try:
+        got, worker, decode_core = await wire_run("torn")
+    finally:
+        faults.disarm("disagg.layer_stream")
+    assert got == want                      # byte-identical degradation
+    assert faults.fired_count("disagg.layer_stream") >= 1
+    assert worker.stream_fallbacks >= 1     # producer took rung 1
+    assert worker.prefills_done == 1        # served, not retried
+    # the consumer saw the monolithic tail and counted the fallback —
+    # the request was NOT re-admitted cold
+    assert decode_core.disagg_stream_fallbacks >= 1
+    assert decode_core.total_prefill_tokens == 0
+
+
 # -------------------------------------------------------- fleet-ops plumbing
 
 

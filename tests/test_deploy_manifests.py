@@ -221,6 +221,7 @@ def test_grafana_dashboard_queries_real_metrics():
                                        r"requests|blocks|slots|waiting|perc|"
                                        r"rate)", e))
     from dynamo_tpu.components.metrics import (_DEGRADE_GAUGES,
+                                               _DISAGG_STREAM_GAUGES,
                                                _GAUGE_FIELDS,
                                                _LAYOUT_GAUGES, _PP_GAUGES,
                                                _RAGGED_GAUGES,
@@ -240,6 +241,7 @@ def test_grafana_dashboard_queries_real_metrics():
     exported |= set(_TRACE_GAUGES.values())
     exported |= set(_DEGRADE_GAUGES.values())
     exported |= set(_TENANT_GAUGES.values())
+    exported |= set(_DISAGG_STREAM_GAUGES.values())
     # trace-collector latency histograms (components/trace_collector.py
     # — exemplar-carrying; the Grafana "Tracing" row queries them)
     exported |= {"nv_llm_trace_ttft_seconds_bucket",

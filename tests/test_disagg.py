@@ -453,6 +453,185 @@ async def test_disagg_kv_layout_mismatch_fails_loudly():
         await core_f.stop()
 
 
+# ------------------------------------------- layer-wise streaming handoff
+
+def make_seeded_request(prompt, rid) -> Context:
+    """Seeded stochastic sampling: the bit-exactness bar for the layer
+    stream covers the sampled path too (same seed → same key stream →
+    same tokens, streamed or monolithic)."""
+    pre = PreprocessedRequest(
+        token_ids=list(prompt),
+        stop_conditions=StopConditions(max_tokens=8, ignore_eos=True),
+        sampling_options=SamplingOptions(temperature=0.8, top_k=20,
+                                         seed=1234))
+    return Context(pre, ctx=EngineContext(rid))
+
+
+async def _wire_disagg_run(prompt, rid, layer_stream, seeded=False):
+    rt = DistributedRuntime.in_process()
+    prefill_core = make_core()
+    decode_core = make_core()
+    router = DisaggregatedRouter(rt, "tiny", max_local_prefill_length=0,
+                                 conditional=False)
+    engine = DisaggEngine(decode_core, rt, router, device_plane=False,
+                          layer_stream=layer_stream)
+    worker = await PrefillWorker(prefill_core, rt).start()
+    try:
+        req = (make_seeded_request(prompt, rid) if seeded
+               else make_request(prompt, rid=rid))
+        got = await collect_tokens(await engine.generate(req))
+        assert engine.remote_prefills == 1 and engine.remote_failures == 0
+        return got, engine, worker, decode_core
+    finally:
+        await worker.stop()
+        await prefill_core.stop()
+        await decode_core.stop()
+        await rt.shutdown()
+
+
+@pytest.mark.parametrize("seeded", [False, True], ids=["greedy", "seeded"])
+async def test_layer_stream_matches_monolithic(prompt, seeded):
+    """ISSUE 18 tentpole: the layer-streamed wire handoff must produce
+    BIT-exactly the tokens of the monolithic handoff (and, under greedy,
+    of a local aggregated run) — the overlap is a latency optimisation,
+    never a numerics change. Covers both greedy and seeded sampling."""
+    if not seeded:
+        local_core = make_core()
+        try:
+            want = await collect_tokens(await JaxEngine(local_core).generate(
+                make_request(prompt, rid="ls")))
+        finally:
+            await local_core.stop()
+
+    mono, eng_m, _w, core_m = await _wire_disagg_run(
+        prompt, "ls", layer_stream=False, seeded=seeded)
+    streamed, eng_s, wrk_s, core_s = await _wire_disagg_run(
+        prompt, "ls", layer_stream=True, seeded=seeded)
+    assert streamed == mono
+    if not seeded:
+        assert streamed == want
+    assert len(streamed) == 8
+    # the streamed leg really took the per-layer path end to end
+    assert core_s.disagg_stream_admits == 1
+    assert core_s.disagg_stream_fallbacks == 0
+    assert core_s.disagg_stream_layers_scattered == TINY.num_layers
+    assert wrk_s.stream_handoffs == 1 and wrk_s.stream_fallbacks == 0
+    assert eng_s.stream_transfers == 1
+    # and the monolithic leg never touched it
+    assert core_m.disagg_stream_admits == 0
+    assert eng_m.stream_transfers == 0
+    # decode engine never prefilled on either leg — the KV came over the
+    # wire both times
+    assert core_s.total_prefill_tokens == 0
+    assert core_m.total_prefill_tokens == 0
+
+
+async def test_layer_stream_recorded_replay(prompt):
+    """kv_layer_stream is a first-class wire event: a recorded streamed
+    handoff passes the schedule checkers and replays bit-exactly (the
+    replayer re-applies each per-layer scatter from the logged values —
+    the same arm the multihost follower runs)."""
+    from dynamo_tpu.engine.replay import (Recorder, check_log,
+                                          compare_replay, replay)
+    rt = DistributedRuntime.in_process()
+    prefill_core = make_core()
+    decode_core = make_core()
+    decode_core.recorder = Recorder()
+    router = DisaggregatedRouter(rt, "tiny", max_local_prefill_length=0,
+                                 conditional=False)
+    engine = DisaggEngine(decode_core, rt, router, device_plane=False,
+                          layer_stream=True)
+    worker = await PrefillWorker(prefill_core, rt).start()
+    try:
+        got = await collect_tokens(
+            await engine.generate(make_request(prompt, rid="rec")))
+        assert len(got) == 8
+        assert decode_core.disagg_stream_admits == 1
+    finally:
+        await worker.stop()
+        await prefill_core.stop()
+        await decode_core.stop()
+        await rt.shutdown()
+
+    events = decode_core.recorder.events
+    ls = [e for e in events if e["ev"] == "kv_layer_stream"]
+    assert sorted(e["layer"] for e in ls) == list(range(TINY.num_layers)), (
+        "streamed admit must record one kv_layer_stream event per layer")
+    assert all(e["num_layers"] == TINY.num_layers for e in ls)
+    assert all(e["rid"] == "rec" and e["targets"] for e in ls)
+    assert check_log(events, block_size=ECFG["kv_block_size"]) == []
+    rep = replay(decode_core, events)
+    assert compare_replay(events, rep) == []
+
+
+async def test_layer_stream_peer_death_recovers_cold(prompt):
+    """Rung 2 of the fallback ladder: the producer dies mid-stream (one
+    layer landed, the rest never will) — the decode engine releases the
+    half-onboarded slot and re-admits COLD, serving exactly the tokens an
+    uncontended local run produces, with no leaked blocks or pins."""
+    from dynamo_tpu.engine.core import FINISH_SENTINEL, EngineRequest
+    from dynamo_tpu.engine.sampling import SlotSampling
+    from dynamo_tpu.llm.kv.stream import (LayerStreamManifest,
+                                          LayerStreamPayload)
+    from tests.test_cancellation import assert_pool_baseline
+
+    ref_core = make_core()
+    try:
+        want = await collect_tokens(await JaxEngine(ref_core).generate(
+            make_request(prompt, rid="want")))
+    finally:
+        await ref_core.stop()
+    assert len(want) == 8
+
+    core = make_core()
+    try:
+        n_blocks = -(-len(prompt) // ECFG["kv_block_size"])
+        manifest = LayerStreamManifest(
+            request_id="dead", first_token=0, first_logprob=0.0,
+            seq_hashes=[1, 2, 3, 4], num_layers=TINY.num_layers,
+            shape=[TINY.num_kv_heads, n_blocks, ECFG["kv_block_size"],
+                   TINY.head_dim],
+            dtype="float32", keys=["k", "v"])
+        payload = LayerStreamPayload(manifest)
+        req = EngineRequest(rid="dead", prompt=list(prompt),
+                            sampling=SlotSampling(temperature=0.0),
+                            max_new_tokens=8, eos_ids=frozenset(),
+                            precomputed=payload)
+        await core.submit(req)
+        # layer 0 lands and scatters; layer 1 never arrives — peer died
+        rng = np.random.default_rng(7)
+        payload.put_layer(0, {
+            k: rng.standard_normal(manifest.shape).astype(np.float32)
+            for k in ("k", "v")})
+        for _ in range(100):
+            if core.disagg_stream_layers_scattered >= 1:
+                break
+            await asyncio.sleep(0.02)
+        assert core.disagg_stream_admits == 1
+        payload.fail("peer died mid-stream")
+
+        toks = []
+        while True:
+            item, _ = await asyncio.wait_for(req.out_queue.get(), 60)
+            if item is FINISH_SENTINEL:
+                break
+            toks.append(item)
+        # the cold recompute reproduces the uncontended run exactly: the
+        # producer's first token was never emitted and no sampling key
+        # was consumed by the dead stream
+        assert toks == want
+        assert core.disagg_stream_fallbacks == 1
+        assert core.total_prefill_tokens == len(prompt)   # really recomputed
+        # wait out the request's own release, then: nothing leaked
+        for _ in range(100):
+            if all(s is None for s in core.slots):
+                break
+            await asyncio.sleep(0.02)
+        assert_pool_baseline(core)
+    finally:
+        await core.stop()
+
+
 @pytest.mark.parametrize("src_q,dst_q", [("none", "int8"),
                                          ("int8", "none")])
 async def test_remote_prefill_cross_quant_repack(prompt, src_q, dst_q):

@@ -72,6 +72,7 @@ class RepoContext:
         "dynamo_tpu/llm/protocols/sse.py",
         "dynamo_tpu/llm/protocols/annotated.py",
         "dynamo_tpu/llm/kv_router/protocols.py",
+        "dynamo_tpu/llm/kv/stream.py",
     )
     schema_lock_path: str = "tools/dynalint/schemas.lock.json"
     # (cpp path, wrapper .py path, symbol prefixes) — the mirrored ABIs

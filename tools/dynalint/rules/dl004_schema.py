@@ -43,6 +43,12 @@ _BINARY_PLANE_EXTRA = {
     "Frame": {"bytes"},
     "KvPayload": {"np.ndarray", "ndarray"},
     "DeviceKvPayload": {"np.ndarray", "ndarray"},
+    # LayeredHarvest never serializes: it is the producer's HOST-LOCAL
+    # handle over one dispatched device gather (llm/kv/stream.py) — it
+    # lives in a schema-watched module only because the wire manifest
+    # (LayerStreamManifest) does
+    "LayeredHarvest": {"Callable[[int], Dict[str, np.ndarray]]",
+                       "Callable[[], Dict[str, np.ndarray]]"},
 }
 
 _ALLOWED_ATOMS = {"str", "int", "float", "bool", "dict", "list", "None",
